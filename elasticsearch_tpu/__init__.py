@@ -1,0 +1,18 @@
+"""elasticsearch_tpu: a TPU-native distributed search & analytics framework.
+
+A ground-up re-design of the capabilities of Elasticsearch 8.14 (reference
+surveyed in SURVEY.md) for TPU hardware:
+
+- Host side (Python/C++): analysis, document parsing, blocked-CSR index
+  packing, WAL durability, cluster metadata, REST API (Query DSL compatible).
+- Device side (JAX/XLA/Pallas): BM25/boolean scoring over HBM-resident
+  postings blocks, vectorized DocValues aggregation scans, exact/ANN vector
+  scoring on the MXU, shard parallelism via `shard_map` over a TPU mesh with
+  `lax.top_k` + ICI collectives for the global merge.
+
+Nothing in this package is a translation of the reference's Java; reference
+citations in docstrings (file:line under /root/reference) document *behavioral
+parity targets* only.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+from .analyzers import (
+    Analyzer,
+    StandardAnalyzer,
+    WhitespaceAnalyzer,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StopAnalyzer,
+    ENGLISH_STOP_WORDS,
+    get_analyzer,
+)
+
+__all__ = [
+    "Analyzer",
+    "StandardAnalyzer",
+    "WhitespaceAnalyzer",
+    "KeywordAnalyzer",
+    "SimpleAnalyzer",
+    "StopAnalyzer",
+    "ENGLISH_STOP_WORDS",
+    "get_analyzer",
+]

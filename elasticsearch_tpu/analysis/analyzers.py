@@ -1,0 +1,165 @@
+"""Text analysis chain: char filters -> tokenizer -> token filters.
+
+Behavioral parity target: the reference registers built-in analyzers in
+modules/analysis-common (reference: modules/analysis-common/.../CommonAnalysisPlugin.java)
+with `standard` as the default for `text` fields
+(reference: server/.../index/analysis/AnalysisRegistry.java).
+
+The `standard` analyzer = Unicode-word-boundary tokenizer + lowercase filter,
+no stopwords by default (matching ES `standard`). Analysis is pure host-side
+work that happens once at index time and once per query string; it never
+touches the device, so plain Python (optionally the C++ tokenizer in
+native/) is the right tool — tokens become integer term ids before anything
+reaches HBM.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Iterable
+
+# ES `_english_` stop set (reference: modules/analysis-common stopword lists,
+# same set as Lucene EnglishAnalyzer.ENGLISH_STOP_WORDS_SET).
+ENGLISH_STOP_WORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+# Unicode word tokenizer: runs of word chars (letters/digits/underscore minus
+# underscore handling below) — approximates UAX#29 word-break used by Lucene's
+# StandardTokenizer for alphanumeric text. Keeps interior apostrophes out
+# (Lucene splits "don't" -> "don't" actually keeps it; we match common case by
+# keeping word chars only). Numbers are kept as tokens.
+_WORD_RE = re.compile(r"[^\W_]+(?:['’][^\W_]+)?", re.UNICODE)
+
+_TOKEN_CHARS_RE = {
+    "letter": re.compile(r"[^\W\d_]+", re.UNICODE),
+    "whitespace": re.compile(r"\S+"),
+}
+
+
+class Token:
+    __slots__ = ("term", "position", "start_offset", "end_offset")
+
+    def __init__(self, term: str, position: int, start: int, end: int):
+        self.term = term
+        self.position = position
+        self.start_offset = start
+        self.end_offset = end
+
+    def __repr__(self):
+        return f"Token({self.term!r}@{self.position})"
+
+
+class Analyzer:
+    """Base analyzer. Subclasses implement `tokenize`; filters applied after."""
+
+    name = "base"
+    lowercase = False
+    stopwords: frozenset[str] = frozenset()
+    max_token_length = 255
+
+    def tokenize(self, text: str) -> Iterable[tuple[str, int, int]]:
+        raise NotImplementedError
+
+    def analyze(self, text: str) -> list[Token]:
+        """Full chain -> positioned tokens. Stopword removal leaves position
+        gaps, matching Lucene's StopFilter position-increment behavior."""
+        out: list[Token] = []
+        pos = 0
+        for term, start, end in self.tokenize(text):
+            if len(term) > self.max_token_length:
+                # Lucene StandardTokenizer splits overlong tokens; we split at
+                # max_token_length boundaries.
+                for i in range(0, len(term), self.max_token_length):
+                    piece = term[i : i + self.max_token_length]
+                    piece2 = piece.lower() if self.lowercase else piece
+                    if piece2 in self.stopwords:
+                        pos += 1
+                        continue
+                    out.append(Token(piece2, pos, start + i, start + i + len(piece)))
+                    pos += 1
+                continue
+            if self.lowercase:
+                term = term.lower()
+            if term in self.stopwords:
+                pos += 1  # position gap
+                continue
+            out.append(Token(term, pos, start, end))
+            pos += 1
+        return out
+
+    def terms(self, text: str) -> list[str]:
+        return [t.term for t in self.analyze(text)]
+
+
+class StandardAnalyzer(Analyzer):
+    """ES `standard`: standard tokenizer + lowercase, no stopwords."""
+
+    name = "standard"
+    lowercase = True
+
+    def __init__(self, stopwords: Iterable[str] | None = None, max_token_length: int = 255):
+        if stopwords is not None:
+            self.stopwords = frozenset(s.lower() for s in stopwords)
+        self.max_token_length = max_token_length
+
+    def tokenize(self, text: str):
+        text = unicodedata.normalize("NFC", text)
+        for m in _WORD_RE.finditer(text):
+            yield m.group(0), m.start(), m.end()
+
+
+class WhitespaceAnalyzer(Analyzer):
+    name = "whitespace"
+
+    def tokenize(self, text: str):
+        for m in _TOKEN_CHARS_RE["whitespace"].finditer(text):
+            yield m.group(0), m.start(), m.end()
+
+
+class SimpleAnalyzer(Analyzer):
+    """Letters-only tokenizer + lowercase (ES `simple`)."""
+
+    name = "simple"
+    lowercase = True
+
+    def tokenize(self, text: str):
+        for m in _TOKEN_CHARS_RE["letter"].finditer(text):
+            yield m.group(0), m.start(), m.end()
+
+
+class StopAnalyzer(SimpleAnalyzer):
+    name = "stop"
+    stopwords = ENGLISH_STOP_WORDS
+
+
+class KeywordAnalyzer(Analyzer):
+    """Whole input as a single token (ES `keyword` analyzer / keyword fields)."""
+
+    name = "keyword"
+
+    def tokenize(self, text: str):
+        if text:
+            yield text, 0, len(text)
+
+
+_BUILTIN = {
+    "standard": StandardAnalyzer,
+    "whitespace": WhitespaceAnalyzer,
+    "simple": SimpleAnalyzer,
+    "stop": StopAnalyzer,
+    "keyword": KeywordAnalyzer,
+    "english": lambda: StandardAnalyzer(stopwords=ENGLISH_STOP_WORDS),
+}
+
+
+def get_analyzer(name: str, **kwargs) -> Analyzer:
+    try:
+        cls = _BUILTIN[name]
+    except KeyError:
+        from ..utils.errors import IllegalArgumentError
+
+        raise IllegalArgumentError(f"unknown analyzer [{name}]")
+    return cls(**kwargs) if kwargs else cls()

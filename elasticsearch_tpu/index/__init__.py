@@ -1,0 +1,4 @@
+from .mappings import Mappings, FieldType
+from .pack import ShardPack, PackBuilder, BLOCK
+
+__all__ = ["Mappings", "FieldType", "ShardPack", "PackBuilder", "BLOCK"]

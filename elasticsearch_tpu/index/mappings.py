@@ -1,0 +1,323 @@
+"""Index mappings: field types, dynamic mapping, document parsing.
+
+Behavioral parity targets from the reference mapper layer
+(reference: server/.../index/mapper/MapperService.java:52,
+DocumentParser.java:50 — JSON -> typed fields; dynamic mapping rules in
+DynamicFieldsBuilder). Supported types are the subset needed by the baseline
+configs plus the common primitives; each maps to a columnar device layout:
+
+  text         -> postings (blocked CSR) + norms; no docvalues
+  keyword      -> postings (single token) + ordinal docvalues
+  long/integer/short/byte -> int64 docvalues
+  double/float/half_float -> float docvalues
+  date         -> int64 epoch-millis docvalues
+  boolean      -> int64 {0,1} docvalues
+  dense_vector -> [N, dims] matrix for MXU scoring
+
+Dynamic mapping mirrors ES defaults: JSON string -> `text` with a `.keyword`
+sub-field (ignore_above 256), integral number -> `long`, float -> `float`,
+bool -> `boolean`, ISO-8601-looking string -> `date`
+(reference: index/mapper/DynamicFieldsBuilder.java).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from dataclasses import dataclass, field
+
+from ..analysis import get_analyzer, Analyzer
+from ..utils.errors import MapperParsingError
+
+TEXT_TYPES = {"text"}
+KEYWORD_TYPES = {"keyword"}
+INT_TYPES = {"long", "integer", "short", "byte"}
+FLOAT_TYPES = {"double", "float", "half_float"}
+NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
+DATE_TYPES = {"date"}
+BOOL_TYPES = {"boolean"}
+VECTOR_TYPES = {"dense_vector"}
+ALL_TYPES = (
+    TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | BOOL_TYPES | VECTOR_TYPES
+    | {"object"}
+)
+
+_INT_BOUNDS = {
+    "long": (-(2**63), 2**63 - 1),
+    "integer": (-(2**31), 2**31 - 1),
+    "short": (-(2**15), 2**15 - 1),
+    "byte": (-128, 127),
+}
+
+# strict_date_optional_time detection for dynamic date mapping
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}([T ]\d{2}:\d{2}(:\d{2}(\.\d+)?)?(Z|[+-]\d{2}:?\d{2})?)?$")
+
+
+def parse_date_to_millis(value) -> int:
+    """Parse ES default `strict_date_optional_time||epoch_millis` to epoch ms
+    (reference: server/.../common/time/DateFormatters.java default format)."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"failed to parse date [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value)
+    if isinstance(value, str):
+        s = value.strip()
+        # date_optional_time admits year and year-month prefixes; try the
+        # calendar interpretations before falling back to epoch_millis,
+        # matching ES's left-to-right format list.
+        if re.fullmatch(r"\d{4}", s):
+            return int(_dt.datetime(int(s), 1, 1, tzinfo=_dt.timezone.utc).timestamp() * 1000)
+        if re.fullmatch(r"\d{4}-\d{2}", s):
+            y, mo = s.split("-")
+            return int(_dt.datetime(int(y), int(mo), 1, tzinfo=_dt.timezone.utc).timestamp() * 1000)
+        try:
+            s2 = s.replace("Z", "+00:00")
+            if " " in s2 and "T" not in s2:
+                s2 = s2.replace(" ", "T", 1)
+            # normalize no-colon utc offsets ("+0100" -> "+01:00")
+            s2 = re.sub(r"([+-]\d{2})(\d{2})$", r"\1:\2", s2)
+            dt = _dt.datetime.fromisoformat(s2)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            pass
+        if re.fullmatch(r"-?\d+", s):
+            return int(s)
+    raise MapperParsingError(f"failed to parse date value [{value}]")
+
+
+@dataclass
+class FieldType:
+    name: str  # full dotted path
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: str | None = None
+    index: bool = True
+    doc_values: bool = True
+    ignore_above: int | None = None  # keyword only
+    dims: int | None = None  # dense_vector only
+    similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
+    fields: dict = field(default_factory=dict)  # sub-fields (e.g. .keyword)
+
+    _analyzer_obj: Analyzer | None = None
+
+    def get_analyzer(self) -> Analyzer:
+        if self._analyzer_obj is None:
+            self._analyzer_obj = get_analyzer(self.analyzer)
+        return self._analyzer_obj
+
+    def get_search_analyzer(self) -> Analyzer:
+        if self.search_analyzer:
+            return get_analyzer(self.search_analyzer)
+        return self.get_analyzer()
+
+    def to_dict(self) -> dict:
+        d: dict = {"type": self.type}
+        if self.type in TEXT_TYPES and self.analyzer != "standard":
+            d["analyzer"] = self.analyzer
+        if self.type in VECTOR_TYPES:
+            d["dims"] = self.dims
+            d["similarity"] = self.similarity
+        if self.ignore_above is not None:
+            d["ignore_above"] = self.ignore_above
+        if self.fields:
+            d["fields"] = {
+                k: sub.to_dict() for k, sub in self.fields.items()
+            }
+        return d
+
+
+class Mappings:
+    """Mutable field-type registry for one index; merge-only like the
+    reference (`MapperService.merge` — new fields may be added, existing
+    types may not change)."""
+
+    _TOP_LEVEL_KEYS = {"properties", "dynamic", "_source", "_meta", "dynamic_templates", "_routing"}
+
+    def __init__(self, mapping_dict: dict | None = None, dynamic: str = "true"):
+        self.fields: dict[str, FieldType] = {}
+        # "true" | "false" | "strict" (ES `dynamic` mapping parameter)
+        self.dynamic = dynamic
+        if mapping_dict:
+            if mapping_dict.keys() & self._TOP_LEVEL_KEYS or not mapping_dict:
+                props = mapping_dict.get("properties", {})
+            else:
+                props = mapping_dict  # bare properties map shorthand
+            self._parse_properties(props, prefix="")
+            dyn = mapping_dict.get("dynamic", dynamic)
+            self.dynamic = {True: "true", False: "false"}.get(dyn, str(dyn))
+
+    # ---- mapping definition parsing -------------------------------------
+
+    def _parse_properties(self, props: dict, prefix: str):
+        for name, spec in props.items():
+            full = f"{prefix}{name}"
+            if not isinstance(spec, dict):
+                raise MapperParsingError(f"invalid mapping for field [{full}]")
+            ftype = spec.get("type")
+            if ftype is None and "properties" in spec:
+                self._parse_properties(spec["properties"], prefix=f"{full}.")
+                continue
+            if ftype not in ALL_TYPES:
+                raise MapperParsingError(f"no handler for type [{ftype}] declared on field [{full}]")
+            if ftype == "object":
+                self._parse_properties(spec.get("properties", {}), prefix=f"{full}.")
+                continue
+            ft = FieldType(
+                name=full,
+                type=ftype,
+                analyzer=spec.get("analyzer", "standard"),
+                search_analyzer=spec.get("search_analyzer"),
+                index=spec.get("index", True),
+                doc_values=spec.get("doc_values", ftype not in TEXT_TYPES),
+                ignore_above=spec.get("ignore_above"),
+                dims=spec.get("dims"),
+                similarity=spec.get("similarity", "cosine"),
+            )
+            if ftype == "dense_vector" and not ft.dims:
+                raise MapperParsingError(f"dense_vector field [{full}] requires [dims]")
+            for sub_name, sub_spec in spec.get("fields", {}).items():
+                sub = FieldType(
+                    name=f"{full}.{sub_name}",
+                    type=sub_spec.get("type", "keyword"),
+                    analyzer=sub_spec.get("analyzer", "standard"),
+                    ignore_above=sub_spec.get("ignore_above"),
+                )
+                ft.fields[sub_name] = sub
+                self.fields[sub.name] = sub
+            self.fields[full] = ft
+
+    def merge(self, mapping_dict: dict):
+        other = Mappings(mapping_dict)
+        for name, ft in other.fields.items():
+            existing = self.fields.get(name)
+            if existing is not None and existing.type != ft.type:
+                raise MapperParsingError(
+                    f"mapper [{name}] cannot be changed from type "
+                    f"[{existing.type}] to [{ft.type}]"
+                )
+            if existing is None:
+                self.fields[name] = ft
+            else:
+                # adopt new sub-fields onto the existing parent so document
+                # parsing populates them (MapperService.merge adds new
+                # multi-fields to existing mappers)
+                for sub_name, sub in ft.fields.items():
+                    if sub_name not in existing.fields:
+                        existing.fields[sub_name] = sub
+                        self.fields[sub.name] = sub
+
+    # ---- dynamic mapping -------------------------------------------------
+
+    def _dynamic_field(self, name: str, value) -> FieldType | None:
+        if isinstance(value, bool):
+            ft = FieldType(name, "boolean")
+        elif isinstance(value, int):
+            ft = FieldType(name, "long")
+        elif isinstance(value, float):
+            ft = FieldType(name, "float")
+        elif isinstance(value, str):
+            if _DATE_RE.match(value.strip()):
+                ft = FieldType(name, "date")
+            else:
+                ft = FieldType(name, "text")
+                kw = FieldType(f"{name}.keyword", "keyword", ignore_above=256)
+                ft.fields["keyword"] = kw
+                self.fields[kw.name] = kw
+        else:
+            return None
+        self.fields[name] = ft
+        return ft
+
+    # ---- document parsing ------------------------------------------------
+
+    def parse_document(self, source: dict) -> dict[str, list]:
+        """Flatten a JSON document into {field_path: [values]} according to
+        the mappings, adding dynamic mappings as needed. Arrays flatten into
+        multiple values of the same field (ES semantics: an array is just a
+        multi-valued field)."""
+        out: dict[str, list] = {}
+        self._parse_obj(source, "", out)
+        return out
+
+    def _parse_obj(self, obj: dict, prefix: str, out: dict):
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            self._parse_value(full, value, out)
+
+    def _parse_value(self, full: str, value, out: dict):
+        if value is None:
+            return
+        if isinstance(value, dict):
+            self._parse_obj(value, f"{full}.", out)
+            return
+        if isinstance(value, list):
+            for v in value:
+                self._parse_value(full, v, out)
+            return
+        ft = self.fields.get(full)
+        if ft is None:
+            if self.dynamic == "strict":
+                raise MapperParsingError(
+                    f"mapping set to strict, dynamic introduction of [{full}] is not allowed"
+                )
+            if self.dynamic == "false":
+                return
+            ft = self._dynamic_field(full, value)
+            if ft is None:
+                return
+        values = out.setdefault(full, [])
+        values.append(self._coerce(ft, value))
+        for sub in ft.fields.values():
+            out.setdefault(sub.name, []).append(self._coerce(sub, value))
+
+    @staticmethod
+    def _coerce(ft: FieldType, value):
+        t = ft.type
+        if t in TEXT_TYPES or t in KEYWORD_TYPES:
+            if isinstance(value, bool):
+                return "true" if value else "false"
+            return str(value)
+        if t in INT_TYPES:
+            try:
+                iv = int(value)
+            except (TypeError, ValueError):
+                raise MapperParsingError(f"failed to parse field [{ft.name}] of type [{t}]: [{value}]")
+            lo, hi = _INT_BOUNDS[t]
+            if not (lo <= iv <= hi):
+                raise MapperParsingError(f"value [{value}] out of range for type [{t}]")
+            return iv
+        if t in FLOAT_TYPES:
+            try:
+                return float(value)
+            except (TypeError, ValueError):
+                raise MapperParsingError(f"failed to parse field [{ft.name}] of type [{t}]: [{value}]")
+        if t in DATE_TYPES:
+            return parse_date_to_millis(value)
+        if t in BOOL_TYPES:
+            if isinstance(value, bool):
+                return value
+            if value in ("true", "false"):
+                return value == "true"
+            raise MapperParsingError(f"failed to parse boolean field [{ft.name}]: [{value}]")
+        if t in VECTOR_TYPES:
+            if not isinstance(value, (int, float)):
+                raise MapperParsingError(f"dense_vector [{ft.name}] expects numbers")
+            return float(value)
+        raise MapperParsingError(f"unsupported type [{t}]")
+
+    def to_dict(self) -> dict:
+        props: dict = {}
+        for name, ft in sorted(self.fields.items()):
+            if "." in name:
+                parent = name.rsplit(".", 1)[0]
+                pft = self.fields.get(parent)
+                if pft is not None and name.split(".")[-1] in pft.fields:
+                    continue  # rendered as sub-field of parent
+            node = props
+            parts = name.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = ft.to_dict()
+        return {"properties": props}

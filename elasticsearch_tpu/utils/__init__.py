@@ -1,0 +1,19 @@
+from .errors import (
+    ElasticsearchTpuError,
+    IndexNotFoundError,
+    IndexAlreadyExistsError,
+    MapperParsingError,
+    DocumentMissingError,
+    VersionConflictError,
+    QueryParsingError,
+)
+
+__all__ = [
+    "ElasticsearchTpuError",
+    "IndexNotFoundError",
+    "IndexAlreadyExistsError",
+    "MapperParsingError",
+    "DocumentMissingError",
+    "VersionConflictError",
+    "QueryParsingError",
+]

@@ -1,0 +1,64 @@
+"""Exception hierarchy mirroring the reference's REST error surface.
+
+The reference maps exceptions to HTTP statuses centrally
+(reference: server/.../ElasticsearchException.java, rest/RestController.java:326).
+Each exception here carries `status` and an ES-style `type` string so the REST
+layer can emit the standard error envelope:
+  {"error": {"type": ..., "reason": ...}, "status": N}
+"""
+
+
+class ElasticsearchTpuError(Exception):
+    status = 500
+    type = "exception"
+
+    def __init__(self, reason: str = "", **meta):
+        super().__init__(reason)
+        self.reason = reason
+        self.meta = meta
+
+    def to_dict(self):
+        err = {"type": self.type, "reason": self.reason}
+        err.update(self.meta)
+        return {"error": err, "status": self.status}
+
+
+class IndexNotFoundError(ElasticsearchTpuError):
+    status = 404
+    type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+
+
+class IndexAlreadyExistsError(ElasticsearchTpuError):
+    status = 400
+    type = "resource_already_exists_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+
+
+class MapperParsingError(ElasticsearchTpuError):
+    status = 400
+    type = "mapper_parsing_exception"
+
+
+class DocumentMissingError(ElasticsearchTpuError):
+    status = 404
+    type = "document_missing_exception"
+
+
+class VersionConflictError(ElasticsearchTpuError):
+    status = 409
+    type = "version_conflict_engine_exception"
+
+
+class QueryParsingError(ElasticsearchTpuError):
+    status = 400
+    type = "parsing_exception"
+
+
+class IllegalArgumentError(ElasticsearchTpuError):
+    status = 400
+    type = "illegal_argument_exception"

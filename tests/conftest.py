@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing multi-node behavior in one
+process (reference: test/framework/.../InternalTestCluster.java:175) — here,
+multi-*chip* behavior on virtual devices. Must run before jax import.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

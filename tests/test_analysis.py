@@ -1,0 +1,62 @@
+from elasticsearch_tpu.analysis import (
+    StandardAnalyzer,
+    WhitespaceAnalyzer,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StopAnalyzer,
+    get_analyzer,
+)
+
+
+def test_standard_lowercases_and_splits():
+    a = StandardAnalyzer()
+    assert a.terms("The Quick-Brown FOX, jumped!") == ["the", "quick", "brown", "fox", "jumped"]
+
+
+def test_standard_keeps_numbers():
+    a = StandardAnalyzer()
+    assert a.terms("error 404 at 10.0.0.1") == ["error", "404", "at", "10", "0", "0", "1"]
+
+
+def test_standard_no_stopwords_by_default():
+    a = StandardAnalyzer()
+    assert "the" in a.terms("the end")
+
+
+def test_english_removes_stopwords_with_position_gap():
+    a = get_analyzer("english")
+    toks = a.analyze("the quick fox")
+    assert [t.term for t in toks] == ["quick", "fox"]
+    assert [t.position for t in toks] == [1, 2]  # gap at position 0
+
+
+def test_whitespace():
+    a = WhitespaceAnalyzer()
+    assert a.terms("Foo Bar-Baz") == ["Foo", "Bar-Baz"]
+
+
+def test_simple_letters_only():
+    a = SimpleAnalyzer()
+    assert a.terms("Foo2Bar baz") == ["foo", "bar", "baz"]
+
+
+def test_stop_analyzer():
+    a = StopAnalyzer()
+    assert a.terms("The Quick fox") == ["quick", "fox"]
+
+
+def test_keyword_single_token():
+    a = KeywordAnalyzer()
+    assert a.terms("New York City") == ["New York City"]
+
+
+def test_offsets():
+    a = StandardAnalyzer()
+    toks = a.analyze("Hello world")
+    assert (toks[0].start_offset, toks[0].end_offset) == (0, 5)
+    assert (toks[1].start_offset, toks[1].end_offset) == (6, 11)
+
+
+def test_unicode():
+    a = StandardAnalyzer()
+    assert a.terms("Café Zürich") == ["café", "zürich"]

@@ -1,0 +1,141 @@
+import pytest
+
+from elasticsearch_tpu.index.mappings import Mappings, parse_date_to_millis
+from elasticsearch_tpu.utils.errors import MapperParsingError
+
+
+def test_explicit_mapping_parse():
+    m = Mappings(
+        {
+            "properties": {
+                "title": {"type": "text"},
+                "tag": {"type": "keyword"},
+                "count": {"type": "long"},
+                "price": {"type": "double"},
+                "ts": {"type": "date"},
+                "ok": {"type": "boolean"},
+                "emb": {"type": "dense_vector", "dims": 4},
+            }
+        }
+    )
+    assert m.fields["title"].type == "text"
+    assert m.fields["emb"].dims == 4
+    parsed = m.parse_document(
+        {"title": "hello", "tag": "a", "count": 3, "price": 1.5, "ts": "2024-01-01", "ok": True, "emb": [1, 2, 3, 4]}
+    )
+    assert parsed["count"] == [3]
+    assert parsed["emb"] == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_nested_object_flattening():
+    m = Mappings({"properties": {"user": {"properties": {"name": {"type": "keyword"}}}}})
+    parsed = m.parse_document({"user": {"name": "kimchy"}})
+    assert parsed["user.name"] == ["kimchy"]
+
+
+def test_dynamic_mapping_string_gets_keyword_subfield():
+    m = Mappings()
+    parsed = m.parse_document({"msg": "hello world"})
+    assert m.fields["msg"].type == "text"
+    assert m.fields["msg.keyword"].type == "keyword"
+    assert parsed["msg"] == ["hello world"]
+    assert parsed["msg.keyword"] == ["hello world"]
+
+
+def test_dynamic_mapping_numbers_and_dates():
+    m = Mappings()
+    m.parse_document({"n": 5, "f": 1.5, "b": False, "d": "2023-05-01T10:00:00Z"})
+    assert m.fields["n"].type == "long"
+    assert m.fields["f"].type == "float"
+    assert m.fields["b"].type == "boolean"
+    assert m.fields["d"].type == "date"
+
+
+def test_arrays_are_multivalued():
+    m = Mappings({"properties": {"tags": {"type": "keyword"}}})
+    parsed = m.parse_document({"tags": ["a", "b", "c"]})
+    assert parsed["tags"] == ["a", "b", "c"]
+
+
+def test_merge_conflict():
+    m = Mappings({"properties": {"a": {"type": "long"}}})
+    with pytest.raises(MapperParsingError):
+        m.merge({"properties": {"a": {"type": "text"}}})
+
+
+def test_merge_adds_fields():
+    m = Mappings({"properties": {"a": {"type": "long"}}})
+    m.merge({"properties": {"b": {"type": "keyword"}}})
+    assert m.fields["b"].type == "keyword"
+
+
+def test_int_range_validation():
+    m = Mappings({"properties": {"a": {"type": "byte"}}})
+    with pytest.raises(MapperParsingError):
+        m.parse_document({"a": 1000})
+
+
+def test_date_parsing():
+    assert parse_date_to_millis("1970-01-01") == 0
+    assert parse_date_to_millis("1970-01-01T00:00:01Z") == 1000
+    assert parse_date_to_millis(1234) == 1234
+    # 4-digit strings hit strict_date_optional_time first (year), like ES
+    assert parse_date_to_millis("1234") == parse_date_to_millis("1234-01-01")
+    assert parse_date_to_millis("123456") == 123456
+
+
+def test_vector_dim_mismatch():
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 3}}})
+    b = PackBuilder(m)
+    with pytest.raises(MapperParsingError):
+        b.add_document(m.parse_document({"v": [1.0, 2.0]}))
+
+
+def test_to_dict_roundtrip():
+    spec = {
+        "properties": {
+            "title": {"type": "text"},
+            "user": {"properties": {"name": {"type": "keyword"}}},
+        }
+    }
+    m = Mappings(spec)
+    d = m.to_dict()
+    assert d["properties"]["title"]["type"] == "text"
+    assert d["properties"]["user"]["properties"]["name"]["type"] == "keyword"
+
+
+def test_strict_dynamic_rejects_unknown_field():
+    m = Mappings({"dynamic": "strict", "properties": {"a": {"type": "long"}}})
+    with pytest.raises(MapperParsingError):
+        m.parse_document({"a": 1, "unknown": "x"})
+
+
+def test_dynamic_false_drops_unknown_field():
+    m = Mappings({"dynamic": False, "properties": {"a": {"type": "long"}}})
+    parsed = m.parse_document({"a": 1, "unknown": "x"})
+    assert parsed == {"a": [1]}
+
+
+def test_mapping_without_properties_key():
+    m = Mappings({"dynamic": "strict"})
+    assert m.fields == {}
+    assert m.dynamic == "strict"
+
+
+def test_merge_adds_subfield_to_existing_parent():
+    m = Mappings({"properties": {"title": {"type": "text"}}})
+    m.merge({"properties": {"title": {"type": "text", "fields": {"keyword": {"type": "keyword"}}}}})
+    parsed = m.parse_document({"title": "abc"})
+    assert parsed["title.keyword"] == ["abc"]
+
+
+def test_date_year_and_month_prefixes():
+    assert parse_date_to_millis("1970") == 0
+    assert parse_date_to_millis("1970-02") == 31 * 86400000
+    assert parse_date_to_millis("2024") == parse_date_to_millis("2024-01-01")
+
+
+def test_date_nocolon_offset():
+    assert parse_date_to_millis("1970-01-01T01:00:00+0100") == 0

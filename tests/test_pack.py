@@ -1,0 +1,117 @@
+import numpy as np
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder, BLOCK
+from elasticsearch_tpu.index.smallfloat import quantize_lengths
+
+
+def build_pack(docs, mapping=None):
+    m = Mappings(mapping or {})
+    b = PackBuilder(m)
+    for d in docs:
+        b.add_document(m.parse_document(d))
+    return b.build(), m
+
+
+def test_basic_postings():
+    pack, _ = build_pack(
+        [
+            {"body": "the quick brown fox"},
+            {"body": "the lazy dog"},
+            {"body": "quick quick dog"},
+        ]
+    )
+    s, n, df = pack.term_blocks("body", "quick")
+    assert df == 2
+    assert n == 1
+    docids = pack.post_docids[s][: df]
+    np.testing.assert_array_equal(docids, [0, 2])
+    tfs = pack.post_tfs[s][: df]
+    np.testing.assert_array_equal(tfs, [1.0, 2.0])
+
+
+def test_absent_term():
+    pack, _ = build_pack([{"body": "hello"}])
+    assert pack.term_blocks("body", "zzz") == (0, 0, 0)
+    assert pack.term_blocks("nofield", "hello") == (0, 0, 0)
+
+
+def test_block_padding_sentinel():
+    pack, _ = build_pack([{"body": "a b"}])
+    s, n, df = pack.term_blocks("body", "a")
+    # padding slots hold num_docs sentinel
+    assert (pack.post_docids[s][df:] == pack.num_docs).all()
+    # row 0 reserved all-padding
+    assert (pack.post_docids[0] == pack.num_docs).all()
+    assert (pack.post_tfs[0] == 0).all()
+
+
+def test_multi_block_term():
+    docs = [{"body": "common"} for _ in range(BLOCK + 10)]
+    pack, _ = build_pack(docs)
+    s, n, df = pack.term_blocks("body", "common")
+    assert df == BLOCK + 10
+    assert n == 2
+    assert (pack.post_docids[s] == np.arange(BLOCK)).all()
+    np.testing.assert_array_equal(pack.post_docids[s + 1][:10], np.arange(BLOCK, BLOCK + 10))
+
+
+def test_norms_quantized():
+    text = " ".join(f"w{i}" for i in range(100))  # length 100 -> quantized
+    pack, _ = build_pack([{"body": text}, {"body": "short text"}])
+    expected = quantize_lengths(np.array([100, 2]))
+    np.testing.assert_array_equal(pack.norms["body"], expected)
+    # avgdl uses exact (unquantized) lengths
+    assert pack.avgdl("body") == (100 + 2) / 2
+
+
+def test_docvalues_int_and_ord():
+    pack, _ = build_pack(
+        [
+            {"n": 5, "k": "b"},
+            {"n": 7, "k": "a"},
+            {"k": "b"},
+        ],
+        {"properties": {"n": {"type": "long"}, "k": {"type": "keyword"}}},
+    )
+    col = pack.docvalues["n"]
+    assert col.kind == "int"
+    np.testing.assert_array_equal(col.values[:2], [5, 7])
+    np.testing.assert_array_equal(col.has_value, [True, True, False])
+    kcol = pack.docvalues["k"]
+    assert kcol.kind == "ord"
+    assert kcol.ord_terms == ["a", "b"]
+    np.testing.assert_array_equal(kcol.values, [1, 0, 1])
+
+
+def test_keyword_postings_for_term_query():
+    pack, _ = build_pack(
+        [{"k": "x"}, {"k": "y"}, {"k": "x"}],
+        {"properties": {"k": {"type": "keyword"}}},
+    )
+    s, n, df = pack.term_blocks("k", "x")
+    assert df == 2
+    np.testing.assert_array_equal(pack.post_docids[s][:2], [0, 2])
+
+
+def test_vectors():
+    pack, _ = build_pack(
+        [{"v": [1.0, 0.0]}, {"v": [0.0, 1.0]}],
+        {"properties": {"v": {"type": "dense_vector", "dims": 2}}},
+    )
+    vc = pack.vectors["v"]
+    assert vc.values.shape == (2, 2)
+    assert vc.similarity == "cosine"
+
+
+def test_term_dict_deterministic():
+    docs = [{"body": "b a c"}, {"body": "a d"}]
+    p1, _ = build_pack(docs)
+    p2, _ = build_pack(docs)
+    assert list(p1.term_dict) == list(p2.term_dict)
+    assert list(p1.term_dict) == sorted(p1.term_dict)
+
+
+def test_avgdl_excludes_empty_field_docs():
+    pack, _ = build_pack([{"body": ""}, {"body": "a b"}])
+    assert pack.avgdl("body") == 2.0

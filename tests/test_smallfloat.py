@@ -1,0 +1,53 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.smallfloat import (
+    int_to_byte4,
+    byte4_to_int,
+    quantize_lengths,
+    NUM_FREE_VALUES,
+    DECODE_TABLE,
+)
+
+
+def test_small_values_exact():
+    for i in range(NUM_FREE_VALUES):
+        assert byte4_to_int(int_to_byte4(i)) == i
+
+
+def test_monotone_encode():
+    prev = -1
+    for i in range(0, 100000, 7):
+        e = int_to_byte4(i)
+        assert e >= prev or byte4_to_int(e) >= 0
+        prev = max(prev, e)
+
+
+def test_roundtrip_idempotent():
+    for i in [0, 1, 23, 24, 25, 100, 255, 1000, 65536, 10**6, 2**31 - 1]:
+        eff = byte4_to_int(int_to_byte4(i))
+        assert eff <= i
+        # re-encoding the effective value must be stable
+        assert byte4_to_int(int_to_byte4(eff)) == eff
+
+
+def test_encode_fits_in_byte():
+    assert int_to_byte4(2**31 - 1) <= 255
+    for i in [0, 23, 24, 10**9]:
+        assert 0 <= int_to_byte4(i) <= 255
+
+
+def test_decode_table_monotone():
+    assert (np.diff(DECODE_TABLE) >= 0).all()
+
+
+def test_quantize_lengths_matches_scalar():
+    xs = np.array([0, 1, 5, 23, 24, 30, 100, 1000, 12345, 10**6])
+    out = quantize_lengths(xs)
+    expect = np.array([byte4_to_int(int_to_byte4(int(x))) for x in xs], dtype=np.float32)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_negative_raises():
+    with pytest.raises(ValueError):
+        int_to_byte4(-1)
