@@ -81,6 +81,8 @@ class ShardPack:
     term_dict: dict[tuple[str, str], int]
     # norms per text field
     norms: dict[str, np.ndarray]  # field -> [N] float32 (dequantized lengths)
+    # text-field presence (a value existed, even if it analyzed to 0 tokens)
+    text_present: dict[str, np.ndarray]  # field -> [N] bool
     field_stats: dict[str, dict]  # field -> {sum_dl, doc_count} (exact, for avgdl)
     # columnar docvalues
     docvalues: dict[str, DocValuesColumn]
@@ -128,6 +130,8 @@ class PackBuilder:
         # (field, term) -> {docid: tf}
         self.postings: dict[tuple[str, str], dict[int, int]] = {}
         self.doc_field_lengths: dict[str, list[tuple[int, int]]] = {}
+        # field -> (last_docid_seen, docs_with_field); docids arrive in order
+        self.field_doc_counts: dict[str, list[int]] = {}
         self.docvalue_raw: dict[str, list[tuple[int, Any]]] = {}
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
         self.num_docs = 0
@@ -160,10 +164,14 @@ class PackBuilder:
                     if ft.ignore_above is not None and len(v) > ft.ignore_above:
                         continue
                     kept.append(v)
-                if ft.index:
+                if ft.index and kept:
                     for v in set(kept):
                         p = self.postings.setdefault((fld, v), {})
                         p[docid] = p.get(docid, 0) + 1
+                    fc = self.field_doc_counts.setdefault(fld, [-1, 0])
+                    if fc[0] != docid:
+                        fc[0] = docid
+                        fc[1] += 1
                 if ft.doc_values and kept:
                     # single-valued docvalues column; first value wins
                     # (multi-valued ordinal CSR is a later milestone)
@@ -196,16 +204,25 @@ class PackBuilder:
 
         # ---- norms (quantized doc lengths) ------------------------------
         norms: dict[str, np.ndarray] = {}
+        text_present: dict[str, np.ndarray] = {}
         field_stats: dict[str, dict] = {}
         for fld, pairs in self.doc_field_lengths.items():
             lengths = np.zeros(N, dtype=np.int64)
+            present = np.zeros(N, dtype=bool)
             for docid, ln in pairs:
                 lengths[docid] += ln
+                present[docid] = True
             norms[fld] = quantize_lengths(lengths)
+            text_present[fld] = present
             # Lucene avgdl = sumTotalTermFreq / docCount where docCount counts
             # docs with at least one term for the field (Terms.getDocCount)
             docs_with = len({docid for docid, ln in pairs if ln > 0})
             field_stats[fld] = {"sum_dl": float(lengths.sum()), "doc_count": docs_with}
+        # norm-less indexed fields (keyword) still need per-field docCount
+        # for idf (Lucene CollectionStatistics.docCount)
+        for fld, (_, cnt) in self.field_doc_counts.items():
+            if fld not in field_stats:
+                field_stats[fld] = {"sum_dl": 0.0, "doc_count": cnt}
         # keyword fields used in scoring need norms too (constant length 1,
         # matching Lucene: keyword fields omit norms => norm = 1)
         # handled at query time by norm fallback.
@@ -301,6 +318,7 @@ class PackBuilder:
             block_min_len=block_min_len,
             term_dict=term_dict,
             norms=norms,
+            text_present=text_present,
             field_stats=field_stats,
             docvalues=docvalues,
             vectors=vectors,

@@ -1,0 +1,3 @@
+from .scoring import bm25_idf, term_score_blocks, DEAD_SLOT_PAD
+
+__all__ = ["bm25_idf", "term_score_blocks", "DEAD_SLOT_PAD"]

@@ -1,0 +1,99 @@
+"""Device-side BM25 scoring ops over blocked-CSR postings.
+
+TPU-first inversion of the reference's hot loop (reference behavior:
+search/internal/ContextIndexSearcher.java:411-431 — per-segment
+`BulkScorer.score` pulling doc-at-a-time postings through BM25 and a top-k
+heap). Here the same math runs data-parallel:
+
+    gather postings blocks -> vectorized BM25 over [B, 128] lanes
+    -> scatter-add into a dense per-doc score accumulator -> lax.top_k
+
+The dense accumulator has N+1 slots; slot N is a dead slot that absorbs all
+padding lanes (padding docids == N), so no masking branches exist anywhere in
+the kernel. Scoring is exact (no early termination); block-max pruning is a
+later optimization that *filters the block list* host/device-side rather than
+branching inside the kernel (SURVEY.md hard part #2).
+
+BM25 formula parity (Lucene 9 BM25Similarity, wired as ES's default at
+server/.../index/similarity/SimilarityService.java:43-58):
+
+    idf(t)  = ln(1 + (docCount - df + 0.5) / (df + 0.5))
+    tfn     = tf / (tf + k1 * (1 - b + b * dl / avgdl))   [norms present]
+    tfn     = tf / (tf + k1)                              [norms omitted]
+    score   = boost * idf * tfn
+
+with dl the 1-byte-quantized doc length (index/smallfloat.py) and avgdl the
+exact sumTotalTermFreq/docCount. k1=1.2, b=0.75 defaults.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEAD_SLOT_PAD = 1  # dense accumulators are sized N + 1
+
+
+def bm25_idf(doc_count: int, df: int) -> float:
+    """Host-side idf. doc_count = docs with >=1 term in the field."""
+    if df <= 0:
+        return 0.0
+    return math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+
+def term_score_blocks(
+    post_docids: jax.Array,  # [num_blocks, BLOCK] int32
+    post_tfs: jax.Array,  # [num_blocks, BLOCK] float32
+    rows: jax.Array,  # [B] int32 block rows for this term (0-padded)
+    weight: jax.Array,  # scalar f32: boost * idf
+    norms: jax.Array | None,  # [N] f32 dequantized doc lengths, or None
+    avgdl: jax.Array | float,  # scalar
+    num_docs: int,
+    k1: float = 1.2,
+    b: float = 0.75,
+) -> tuple[jax.Array, jax.Array]:
+    """Score one term's postings blocks.
+
+    Returns (scores[N+1] f32, match[N+1] bool). Padding lanes (docid == N,
+    tf == 0) score exactly 0 and scatter into the dead slot.
+    """
+    docids = post_docids[rows]  # [B, 128]
+    tfs = post_tfs[rows]  # [B, 128]
+    if norms is not None:
+        dl = norms[jnp.minimum(docids, num_docs - 1)]
+        denom = tfs + k1 * (1.0 - b + b * dl / avgdl)
+    else:
+        denom = tfs + k1
+    # tf==0 padding -> 0/k1' = 0
+    block_scores = weight * tfs / denom
+    flat_ids = docids.reshape(-1)
+    scores = jnp.zeros(num_docs + DEAD_SLOT_PAD, jnp.float32).at[flat_ids].add(
+        block_scores.reshape(-1), mode="drop"
+    )
+    match = jnp.zeros(num_docs + DEAD_SLOT_PAD, bool).at[flat_ids].set(
+        (tfs > 0).reshape(-1), mode="drop"
+    )
+    return scores, match
+
+
+def top_k_with_total(
+    scores: jax.Array,  # [N+1] f32
+    match: jax.Array,  # [N+1] bool
+    live: jax.Array,  # [N] bool
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Global top-k by (score desc, docid asc) + exact total hit count.
+
+    `lax.top_k` breaks score ties by lowest index, and index == docid, which
+    reproduces Lucene's (score, docid) tie-break order exactly
+    (reference behavior: TopScoreDocCollector via
+    search/query/QueryPhaseCollectorManager.java:416).
+    """
+    n = live.shape[0]
+    ok = match[:n] & live
+    total = jnp.sum(ok, dtype=jnp.int32)
+    masked = jnp.where(ok, scores[:n], -jnp.inf)
+    top_scores, top_ids = jax.lax.top_k(masked, k)
+    return top_scores, top_ids, total

@@ -1,0 +1,4 @@
+from .dsl import parse_query
+from .executor import ShardSearcher
+
+__all__ = ["parse_query", "ShardSearcher"]

@@ -1,0 +1,320 @@
+"""JSON Query DSL -> plan nodes.
+
+Parity target: the reference's query parsers (reference behavior:
+index/query/*QueryBuilder.java `fromXContent`, registered in
+search/SearchModule.java). Field-type-aware desugaring happens here:
+
+- `match` on text  -> bool-should (or must for operator=and) of TermNodes,
+  terms produced by the field's search analyzer — exactly how
+  MatchQueryBuilder builds a BooleanQuery of TermQuerys.
+- `term`/`terms` on numeric/date/bool fields -> docvalue equality (the
+  reference uses point queries; same result set, constant score).
+- `multi_match` (best_fields) -> DisMax over per-field match queries.
+"""
+
+from __future__ import annotations
+
+from ..index.mappings import (
+    Mappings,
+    TEXT_TYPES,
+    KEYWORD_TYPES,
+    INT_TYPES,
+    FLOAT_TYPES,
+    DATE_TYPES,
+    BOOL_TYPES,
+    parse_date_to_millis,
+)
+from ..utils.errors import QueryParsingError
+from .nodes import (
+    QueryNode,
+    TermNode,
+    MatchAllNode,
+    MatchNoneNode,
+    RangeNode,
+    TermsNode,
+    ExistsNode,
+    ConstantScoreNode,
+    DisMaxNode,
+    BoolNode,
+)
+
+
+def parse_query(q: dict | None, mappings: Mappings) -> QueryNode:
+    if q is None:
+        return MatchAllNode()
+    if not isinstance(q, dict) or len(q) != 1:
+        raise QueryParsingError(f"query must be an object with exactly one key, got {q!r}")
+    (kind, body), = q.items()
+    parser = _PARSERS.get(kind)
+    if parser is None:
+        raise QueryParsingError(f"unknown query [{kind}]")
+    return parser(body, mappings)
+
+
+def _field_type(mappings: Mappings, fld: str) -> str | None:
+    ft = mappings.fields.get(fld)
+    return ft.type if ft else None
+
+
+def _coerce_for_field(mappings: Mappings, fld: str, value):
+    """-> (kind, coerced_value) where kind selects the docvalue column type."""
+    t = _field_type(mappings, fld)
+    if t in DATE_TYPES:
+        return "int", parse_date_to_millis(value)
+    if t in BOOL_TYPES:
+        if isinstance(value, str):
+            value = value == "true"
+        return "int", int(bool(value))
+    if t in INT_TYPES:
+        return "int", int(value)
+    if t in FLOAT_TYPES:
+        return "float", float(value)
+    return "ord", str(value)
+
+
+def _parse_match(body, mappings):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[match] query expects {field: ...}")
+    (fld, spec), = body.items()
+    if isinstance(spec, dict):
+        text = spec.get("query")
+        operator = spec.get("operator", "or")
+        boost = float(spec.get("boost", 1.0))
+        msm = spec.get("minimum_should_match")
+    else:
+        text, operator, boost, msm = spec, "or", 1.0, None
+    if text is None:
+        raise QueryParsingError("[match] requires [query]")
+    t = _field_type(mappings, fld)
+    if t is not None and t not in TEXT_TYPES and t not in KEYWORD_TYPES:
+        # match on numeric/date/bool degrades to equality, like ES
+        kind, v = _coerce_for_field(mappings, fld, text)
+        return RangeNode(fld, v, v, kind=kind, boost=boost)
+    ft = mappings.fields.get(fld)
+    if ft is not None and ft.type in KEYWORD_TYPES:
+        terms = [str(text)]
+    else:
+        analyzer = ft.get_search_analyzer() if ft else None
+        if analyzer is None:
+            from ..analysis import get_analyzer
+
+            analyzer = get_analyzer("standard")
+        terms = analyzer.terms(str(text))
+    if not terms:
+        return MatchNoneNode()
+    leaves = [TermNode(fld, term) for term in terms]
+    if len(leaves) == 1:
+        leaves[0].boost = boost
+        return leaves[0]
+    if operator == "and":
+        return BoolNode(must=leaves, boost=boost)
+    return BoolNode(should=leaves, boost=boost, minimum_should_match=int(msm) if msm else None)
+
+
+def _parse_multi_match(body, mappings):
+    if not isinstance(body, dict):
+        raise QueryParsingError("[multi_match] expects an object")
+    text = body.get("query")
+    fields = body.get("fields") or []
+    mm_type = body.get("type", "best_fields")
+    tie = float(body.get("tie_breaker", 0.0))
+    boost = float(body.get("boost", 1.0))
+    if text is None or not fields:
+        raise QueryParsingError("[multi_match] requires [query] and [fields]")
+    children = []
+    for f in fields:
+        fboost = 1.0
+        if "^" in f:
+            f, fb = f.split("^", 1)
+            fboost = float(fb)
+        child = _parse_match({f: {"query": text, "boost": fboost}}, mappings)
+        children.append(child)
+    if mm_type == "most_fields":
+        return BoolNode(should=children, boost=boost)
+    return DisMaxNode(children=children, tie_breaker=tie, boost=boost)
+
+
+def _parse_term(body, mappings):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[term] query expects {field: value}")
+    (fld, spec), = body.items()
+    if isinstance(spec, dict):
+        value = spec.get("value")
+        boost = float(spec.get("boost", 1.0))
+    else:
+        value, boost = spec, 1.0
+    t = _field_type(mappings, fld)
+    if t in TEXT_TYPES or t in KEYWORD_TYPES or t is None:
+        return TermNode(fld, str(value), boost=boost)
+    kind, v = _coerce_for_field(mappings, fld, value)
+    return RangeNode(fld, v, v, kind=kind, boost=boost)
+
+
+def _parse_terms(body, mappings):
+    if not isinstance(body, dict):
+        raise QueryParsingError("[terms] expects an object")
+    boost = float(body.get("boost", 1.0))
+    items = [(f, v) for f, v in body.items() if f != "boost"]
+    if len(items) != 1:
+        raise QueryParsingError("[terms] query expects a single field")
+    fld, values = items[0]
+    if not isinstance(values, list):
+        raise QueryParsingError("[terms] values must be an array")
+    t = _field_type(mappings, fld)
+    if t in INT_TYPES or t in DATE_TYPES or t in BOOL_TYPES:
+        coerced = [_coerce_for_field(mappings, fld, v)[1] for v in values]
+        return TermsNode(fld, coerced, kind="int", boost=boost)
+    if t in FLOAT_TYPES:
+        return TermsNode(fld, [float(v) for v in values], kind="float", boost=boost)
+    if t in KEYWORD_TYPES or (t is None):
+        return TermsNode(fld, [str(v) for v in values], kind="ord", boost=boost)
+    # text field: OR of term queries, constant score
+    return ConstantScoreNode(
+        BoolNode(should=[TermNode(fld, str(v)) for v in values]), boost=boost
+    )
+
+
+def _parse_range(body, mappings):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[range] query expects {field: bounds}")
+    (fld, spec), = body.items()
+    if not isinstance(spec, dict):
+        raise QueryParsingError("[range] bounds must be an object")
+    boost = float(spec.get("boost", 1.0))
+    lo = hi = None
+    inc_lo = inc_hi = True
+    kind = None
+    for op in ("gte", "gt", "lte", "lt"):
+        if op in spec:
+            k, v = _coerce_for_field(mappings, fld, spec[op])
+            kind = kind or k
+            if op == "gte":
+                lo = v
+            elif op == "gt":
+                lo, inc_lo = v, False
+            elif op == "lte":
+                hi = v
+            else:
+                hi, inc_hi = v, False
+    if kind == "ord":
+        # keyword ranges resolve against the sorted ordinal dictionary at
+        # prepare() time; represented as string bounds here
+        return _KeywordRangeNode(fld, spec.get("gte", spec.get("gt")), spec.get("lte", spec.get("lt")), inc_lo, inc_hi, boost)
+    return RangeNode(fld, lo, hi, inc_lo, inc_hi, boost=boost, kind=kind or "int")
+
+
+def _parse_bool(body, mappings):
+    if not isinstance(body, dict):
+        raise QueryParsingError("[bool] expects an object")
+
+    def clause(name):
+        c = body.get(name, [])
+        if isinstance(c, dict):
+            c = [c]
+        return [parse_query(q, mappings) for q in c]
+
+    msm = body.get("minimum_should_match")
+    return BoolNode(
+        must=clause("must"),
+        filter=clause("filter"),
+        should=clause("should"),
+        must_not=clause("must_not"),
+        minimum_should_match=int(msm) if msm is not None else None,
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_constant_score(body, mappings):
+    if not isinstance(body, dict) or "filter" not in body:
+        raise QueryParsingError("[constant_score] requires [filter]")
+    return ConstantScoreNode(
+        parse_query(body["filter"], mappings), boost=float(body.get("boost", 1.0))
+    )
+
+
+def _parse_dis_max(body, mappings):
+    if not isinstance(body, dict) or "queries" not in body:
+        raise QueryParsingError("[dis_max] requires [queries]")
+    return DisMaxNode(
+        children=[parse_query(q, mappings) for q in body["queries"]],
+        tie_breaker=float(body.get("tie_breaker", 0.0)),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+def _parse_exists(body, mappings):
+    if not isinstance(body, dict) or "field" not in body:
+        raise QueryParsingError("[exists] requires [field]")
+    return ExistsNode(body["field"], boost=float(body.get("boost", 1.0)))
+
+
+def _parse_match_all(body, mappings):
+    body = body or {}
+    return MatchAllNode(boost=float(body.get("boost", 1.0)))
+
+
+def _parse_match_none(body, mappings):
+    return MatchNoneNode()
+
+
+def _parse_ids(body, mappings):
+    # resolved by the engine layer (docid lookup is host-side state); the
+    # parser represents it as a terms query on the reserved _id keyword column
+    if not isinstance(body, dict) or "values" not in body:
+        raise QueryParsingError("[ids] requires [values]")
+    return TermsNode("_id", [str(v) for v in body["values"]], kind="ord")
+
+
+class _KeywordRangeNode(RangeNode):
+    """Range on a keyword field: string bounds -> ordinal bounds at prepare."""
+
+    def __init__(self, fld, lo_s, hi_s, inc_lo, inc_hi, boost):
+        super().__init__(fld, None, None, inc_lo, inc_hi, boost=boost, kind="ord")
+        self.lo_s = lo_s
+        self.hi_s = hi_s
+
+    def prepare(self, pack):
+        import bisect
+        import numpy as np
+
+        col = pack.docvalues.get(self.fld)
+        terms = col.ord_terms if col is not None and col.ord_terms else []
+        # map string bounds to ordinal space: find tightest ordinal range
+        lo_ord, hi_ord = 0, len(terms) - 1
+        inc_lo, inc_hi = True, True
+        if self.lo_s is not None:
+            lo_ord = (
+                bisect.bisect_left(terms, str(self.lo_s))
+                if self.include_lo
+                else bisect.bisect_right(terms, str(self.lo_s))
+            )
+        if self.hi_s is not None:
+            hi_ord = (
+                bisect.bisect_right(terms, str(self.hi_s)) - 1
+                if self.include_hi
+                else bisect.bisect_left(terms, str(self.hi_s)) - 1
+            )
+        params = (
+            np.asarray(lo_ord, np.int64),
+            np.asarray(hi_ord, np.int64),
+            np.asarray(True),
+            np.asarray(True),
+            np.float32(self.boost),
+        )
+        return params, ("range", self.fld, "ord", col is None)
+
+
+_PARSERS = {
+    "match": _parse_match,
+    "multi_match": _parse_multi_match,
+    "match_all": _parse_match_all,
+    "match_none": _parse_match_none,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "range": _parse_range,
+    "bool": _parse_bool,
+    "constant_score": _parse_constant_score,
+    "dis_max": _parse_dis_max,
+    "exists": _parse_exists,
+    "ids": _parse_ids,
+}

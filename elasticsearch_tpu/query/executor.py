@@ -1,0 +1,122 @@
+"""Shard-level query execution: pack on device + compiled plan cache.
+
+This is the TPU analog of the reference's per-shard query phase (reference
+behavior: search/query/QueryPhase.java:61-149 — build collectors, run the
+searcher, emit QuerySearchResult of top-k docids/scores + total). One
+`ShardSearcher` owns the device-resident pack; each distinct query *shape*
+(plan structure + block-bucket sizes + k) compiles once and is cached, so
+steady-state queries are a single XLA executable launch with small host->
+device parameter transfers (block row lists, idf weights).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.pack import ShardPack
+from ..ops.scoring import top_k_with_total
+from .dsl import parse_query
+from .nodes import ExecContext, QueryNode
+
+
+def pack_to_device(pack: ShardPack, device=None) -> dict:
+    """Ship a host ShardPack to HBM as a flat dict-of-arrays pytree."""
+    from ..utils.jax_env import ensure_x64
+
+    ensure_x64()
+    put = lambda x: jax.device_put(x, device) if device else jnp.asarray(x)
+    dev = {
+        "post_docids": put(pack.post_docids),
+        "post_tfs": put(pack.post_tfs),
+        "norms": {f: put(a) for f, a in pack.norms.items()},
+        "text_has": {f: put(a) for f, a in pack.text_present.items()},
+        "dv_int": {},
+        "dv_float": {},
+        "dv_ord": {},
+        "live": put(pack.live),
+        "vec": {},
+        "vec_has": {},
+    }
+    for f, col in pack.docvalues.items():
+        key = {"int": "dv_int", "float": "dv_float", "ord": "dv_ord"}[col.kind]
+        vals = col.values if col.kind != "ord" else col.values.astype(np.int64)
+        dev[key][f] = (put(vals), put(col.has_value))
+    for f, vc in pack.vectors.items():
+        dev["vec"][f] = put(vc.values)
+        dev["vec_has"][f] = put(vc.has_value)
+    return dev
+
+
+@dataclass
+class ShardResult:
+    doc_ids: np.ndarray  # [<=k] int32 local docids
+    scores: np.ndarray  # [<=k] float32
+    total: int
+    max_score: float | None
+
+
+class ShardSearcher:
+    def __init__(self, pack: ShardPack, device=None, mappings=None):
+        self.pack = pack
+        self.mappings = mappings
+        self.dev = pack_to_device(pack, device)
+        self.ctx = ExecContext(
+            num_docs=pack.num_docs,
+            avgdl={f: pack.avgdl(f) for f in pack.norms},
+            has_norms=frozenset(pack.norms),
+        )
+        self._cache: dict = {}
+
+    # -- compilation -------------------------------------------------------
+
+    def _compiled(self, node: QueryNode, struct_key: tuple, k: int):
+        key = (struct_key, k)
+        fn = self._cache.get(key)
+        if fn is None:
+            ctx = self.ctx
+
+            def run(dev, params):
+                scores, match = node.device_eval(dev, params, ctx)
+                return top_k_with_total(scores, match, dev["live"], k)
+
+            fn = jax.jit(run)
+            self._cache[key] = fn
+        return fn
+
+    # -- entry points ------------------------------------------------------
+
+    def search(
+        self,
+        query: dict | QueryNode | None,
+        size: int = 10,
+        from_: int = 0,
+        mappings=None,
+    ) -> ShardResult:
+        if isinstance(query, QueryNode):
+            node = query
+        else:
+            m = mappings if mappings is not None else self.mappings
+            if m is None:
+                from ..utils.errors import QueryParsingError
+
+                raise QueryParsingError("no mappings available to parse the query")
+            node = parse_query(query, m)
+        if self.pack.num_docs == 0:
+            return ShardResult(np.array([], np.int32), np.array([], np.float32), 0, None)
+        params, struct_key = node.prepare(self.pack)
+        k = min(max(size + from_, 1), self.pack.num_docs)
+        fn = self._compiled(node, struct_key, k)
+        top_scores, top_ids, total = jax.device_get(fn(self.dev, params))
+        valid = np.isfinite(top_scores)
+        max_score = float(top_scores[0]) if valid.any() else None
+        end = max(size + from_, 0)
+        ids = top_ids[valid][from_:end]
+        scs = top_scores[valid][from_:end]
+        return ShardResult(ids.astype(np.int32), scs.astype(np.float32), int(total), max_score)
+
+    def count(self, query: dict | QueryNode | None, mappings=None) -> int:
+        return self.search(query, size=1, mappings=mappings).total

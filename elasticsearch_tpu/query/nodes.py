@@ -1,0 +1,358 @@
+"""Query plan nodes: host-side prepare + device-side evaluation.
+
+The reference compiles its JSON Query DSL through `QueryBuilder.toQuery()`
+into Lucene `Query`/`Weight`/`Scorer` trees pulled doc-at-a-time (reference:
+server/.../index/query/AbstractQueryBuilder.java, BoolQueryBuilder.java).
+Here every node instead evaluates to a pair of dense device arrays
+
+    (scores[N+1] float32, match[N+1] bool)
+
+over the whole shard, and boolean composition is elementwise arithmetic —
+the natural XLA shape: no iterators, no branches, fused by the compiler.
+
+Protocol:
+  prepare(pack)  -> (params pytree of numpy arrays, structural cache key)
+     host work: term-dict lookups, idf, block-row padding to pow2 buckets.
+     The cache key captures everything that changes the traced computation
+     (node types, fields, bucket sizes) but NOT term values, so repeated
+     queries with the same shape reuse the compiled executable.
+  device_eval(dev, params, ctx) -> (scores, match)
+     pure-jnp, called inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.pack import ShardPack
+from ..ops.scoring import bm25_idf, term_score_blocks
+
+MIN_BUCKET = 4
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_rows(start: int, count: int) -> np.ndarray:
+    """Block-row list padded to a pow2 bucket with the reserved padding row 0."""
+    b = _bucket(count)
+    rows = np.zeros(b, dtype=np.int32)
+    rows[:count] = np.arange(start, start + count, dtype=np.int32)
+    return rows
+
+
+@dataclass
+class ExecContext:
+    """Static per-pack info available during tracing."""
+
+    num_docs: int
+    avgdl: dict[str, float]
+    has_norms: frozenset[str]
+    k1: float = 1.2
+    b: float = 0.75
+
+
+class QueryNode:
+    boost: float = 1.0
+
+    def prepare(self, pack: ShardPack) -> tuple[Any, tuple]:
+        raise NotImplementedError
+
+    def device_eval(self, dev: dict, params: Any, ctx: ExecContext):
+        raise NotImplementedError
+
+
+@dataclass
+class TermNode(QueryNode):
+    """Exact term match with BM25 scoring (reference behavior:
+    index/query/TermQueryBuilder.java -> Lucene TermQuery)."""
+
+    fld: str
+    term: str
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        start, count, df = pack.term_blocks(self.fld, self.term)
+        rows = _pad_rows(start, count)
+        if df > 0:
+            doc_count = pack.field_stats.get(self.fld, {}).get("doc_count") or pack.num_docs
+            weight = np.float32(self.boost * bm25_idf(doc_count, df))
+        else:
+            weight = np.float32(0.0)
+        return (rows, weight), ("term", self.fld, len(rows))
+
+    def device_eval(self, dev, params, ctx):
+        rows, weight = params
+        norms = dev["norms"].get(self.fld) if self.fld in ctx.has_norms else None
+        return term_score_blocks(
+            dev["post_docids"],
+            dev["post_tfs"],
+            rows,
+            weight,
+            norms,
+            ctx.avgdl.get(self.fld, 1.0),
+            ctx.num_docs,
+            ctx.k1,
+            ctx.b,
+        )
+
+
+@dataclass
+class MatchAllNode(QueryNode):
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        return (np.float32(self.boost),), ("match_all",)
+
+    def device_eval(self, dev, params, ctx):
+        (boost,) = params
+        n1 = ctx.num_docs + 1
+        return jnp.full(n1, boost, jnp.float32), jnp.ones(n1, bool)
+
+
+@dataclass
+class MatchNoneNode(QueryNode):
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        return (), ("match_none",)
+
+    def device_eval(self, dev, params, ctx):
+        n1 = ctx.num_docs + 1
+        return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+
+
+@dataclass
+class RangeNode(QueryNode):
+    """Range over numeric/date/keyword docvalues; constant score = boost
+    (reference behavior: index/query/RangeQueryBuilder.java — point/DV range
+    queries score constantly)."""
+
+    fld: str
+    lo: float | int | None
+    hi: float | int | None
+    include_lo: bool = True
+    include_hi: bool = True
+    boost: float = 1.0
+    kind: str = "int"  # int | float | ord
+
+    def prepare(self, pack):
+        col = pack.docvalues.get(self.fld)
+        dtype = np.int64 if self.kind in ("int", "ord") else np.float32
+        info_min = np.iinfo(np.int64).min if dtype == np.int64 else -np.inf
+        info_max = np.iinfo(np.int64).max if dtype == np.int64 else np.inf
+        lo = info_min if self.lo is None else self.lo
+        hi = info_max if self.hi is None else self.hi
+        params = (
+            np.asarray(lo, dtype),
+            np.asarray(hi, dtype),
+            np.asarray(self.include_lo),
+            np.asarray(self.include_hi),
+            np.float32(self.boost),
+        )
+        return params, ("range", self.fld, self.kind, col is None)
+
+    def device_eval(self, dev, params, ctx):
+        lo, hi, inc_lo, inc_hi, boost = params
+        n1 = ctx.num_docs + 1
+        kinds = {"int": "dv_int", "float": "dv_float", "ord": "dv_ord"}
+        store = dev[kinds[self.kind]]
+        if self.fld not in store:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        vals, has = store[self.fld]
+        above = jnp.where(inc_lo, vals >= lo, vals > lo)
+        below = jnp.where(inc_hi, vals <= hi, vals < hi)
+        m = has & above & below
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(m)
+        return boost * match.astype(jnp.float32), match
+
+
+@dataclass
+class TermsNode(QueryNode):
+    """`terms` query: doc matches any of the values; constant score = boost
+    (reference behavior: index/query/TermsQueryBuilder.java -> Lucene
+    TermInSetQuery under ConstantScore)."""
+
+    fld: str
+    values: list
+    boost: float = 1.0
+    kind: str = "ord"  # ord | int | float
+
+    def prepare(self, pack):
+        col = pack.docvalues.get(self.fld)
+        if self.kind == "ord":
+            terms = col.ord_terms if col is not None else []
+            ord_of = {t: i for i, t in enumerate(terms)}
+            ids = [ord_of[v] for v in map(str, self.values) if v in ord_of]
+            arr = np.full(_bucket(max(len(ids), 1)), -2, dtype=np.int64)
+            arr[: len(ids)] = ids
+        else:
+            dtype = np.int64 if self.kind == "int" else np.float32
+            arr = np.full(_bucket(max(len(self.values), 1)), np.iinfo(np.int64).min + 1 if dtype == np.int64 else np.nan, dtype=dtype)
+            arr[: len(self.values)] = [v for v in self.values]
+        return (arr, np.float32(self.boost)), ("terms", self.fld, self.kind, len(arr), col is None)
+
+    def device_eval(self, dev, params, ctx):
+        arr, boost = params
+        n1 = ctx.num_docs + 1
+        kinds = {"int": "dv_int", "float": "dv_float", "ord": "dv_ord"}
+        store = dev[kinds[self.kind]]
+        if self.fld not in store:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        vals, has = store[self.fld]
+        if self.kind == "ord":
+            vals = vals.astype(jnp.int64)
+        m = has & (vals[:, None] == arr[None, :]).any(axis=1)
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(m)
+        return boost * match.astype(jnp.float32), match
+
+
+@dataclass
+class ExistsNode(QueryNode):
+    fld: str
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        has_dv = (
+            self.fld in pack.docvalues
+            or self.fld in pack.vectors
+            or self.fld in pack.text_present
+        )
+        return (np.float32(self.boost),), ("exists", self.fld, has_dv)
+
+    def device_eval(self, dev, params, ctx):
+        (boost,) = params
+        n1 = ctx.num_docs + 1
+        m = None
+        for store_key in ("dv_int", "dv_float", "dv_ord"):
+            if self.fld in dev[store_key]:
+                m = dev[store_key][self.fld][1]
+                break
+        if m is None and self.fld in dev.get("vec_has", {}):
+            m = dev["vec_has"][self.fld]
+        if m is None and self.fld in dev["text_has"]:
+            m = dev["text_has"][self.fld]
+        if m is None:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(m)
+        return boost * match.astype(jnp.float32), match
+
+
+@dataclass
+class ConstantScoreNode(QueryNode):
+    child: QueryNode = None
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        cp, ck = self.child.prepare(pack)
+        return (cp, np.float32(self.boost)), ("const", ck)
+
+    def device_eval(self, dev, params, ctx):
+        cp, boost = params
+        _, m = self.child.device_eval(dev, cp, ctx)
+        return boost * m.astype(jnp.float32), m
+
+
+@dataclass
+class DisMaxNode(QueryNode):
+    """Max over children + tie_breaker * sum(rest) (reference behavior:
+    index/query/DisMaxQueryBuilder.java)."""
+
+    children: list = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        parts = [c.prepare(pack) for c in self.children]
+        return (
+            tuple(p for p, _ in parts),
+            np.float32(self.tie_breaker),
+            np.float32(self.boost),
+        ), ("dismax", tuple(k for _, k in parts))
+
+    def device_eval(self, dev, params, ctx):
+        child_params, tie, boost = params
+        n1 = ctx.num_docs + 1
+        best = jnp.zeros(n1, jnp.float32)
+        total = jnp.zeros(n1, jnp.float32)
+        match = jnp.zeros(n1, bool)
+        for c, p in zip(self.children, child_params):
+            s, m = c.device_eval(dev, p, ctx)
+            best = jnp.maximum(best, s)
+            total = total + s
+            match = match | m
+        score = boost * (best + tie * (total - best))
+        return jnp.where(match, score, 0.0), match
+
+
+@dataclass
+class BoolNode(QueryNode):
+    """Boolean composition (reference behavior:
+    index/query/BoolQueryBuilder.java — must/filter/should/must_not with
+    minimum_should_match; should is optional when must/filter present)."""
+
+    must: list = dc_field(default_factory=list)
+    filter: list = dc_field(default_factory=list)
+    should: list = dc_field(default_factory=list)
+    must_not: list = dc_field(default_factory=list)
+    minimum_should_match: int | None = None
+    boost: float = 1.0
+
+    def _msm(self) -> int:
+        if self.minimum_should_match is not None:
+            return self.minimum_should_match
+        if self.should and not (self.must or self.filter):
+            return 1
+        return 0
+
+    def prepare(self, pack):
+        groups = []
+        keys = []
+        for grp in (self.must, self.filter, self.should, self.must_not):
+            parts = [c.prepare(pack) for c in grp]
+            groups.append(tuple(p for p, _ in parts))
+            keys.append(tuple(k for _, k in parts))
+        return (tuple(groups), np.float32(self.boost)), (
+            "bool",
+            tuple(keys),
+            self._msm(),
+        )
+
+    def device_eval(self, dev, params, ctx):
+        groups, boost = params
+        must_p, filter_p, should_p, not_p = groups
+        n1 = ctx.num_docs + 1
+        score = jnp.zeros(n1, jnp.float32)
+        ok = jnp.ones(n1, bool)
+        any_clause = bool(self.must or self.filter or self.should)
+        for c, p in zip(self.must, must_p):
+            s, m = c.device_eval(dev, p, ctx)
+            score = score + s
+            ok = ok & m
+        for c, p in zip(self.filter, filter_p):
+            _, m = c.device_eval(dev, p, ctx)
+            ok = ok & m
+        msm = self._msm()
+        if self.should:
+            cnt = jnp.zeros(n1, jnp.int32)
+            for c, p in zip(self.should, should_p):
+                s, m = c.device_eval(dev, p, ctx)
+                score = score + s
+                cnt = cnt + m.astype(jnp.int32)
+            if msm > 0:
+                ok = ok & (cnt >= msm)
+        for c, p in zip(self.must_not, not_p):
+            _, m = c.device_eval(dev, p, ctx)
+            ok = ok & ~m
+        if not any_clause and not self.must_not:
+            pass  # empty bool matches everything (ok already all-true)
+        score = jnp.where(ok, boost * score, 0.0)
+        return score, ok

@@ -1,0 +1,19 @@
+"""JAX runtime configuration for the framework.
+
+int64 DocValues (dates are epoch millis ~2^41, longs are arbitrary) need
+64-bit integer device arrays, so x64 must be enabled; XLA lowers s64 on TPU
+to u32 pairs. All floating-point arrays in this codebase use explicit
+float32/bfloat16 dtypes, so enabling x64 does not introduce f64 compute
+anywhere on the hot path.
+"""
+
+import jax
+
+_done = False
+
+
+def ensure_x64():
+    global _done
+    if not _done:
+        jax.config.update("jax_enable_x64", True)
+        _done = True

@@ -2,7 +2,12 @@
 
 Mirrors the reference's strategy of testing multi-node behavior in one
 process (reference: test/framework/.../InternalTestCluster.java:175) — here,
-multi-*chip* behavior on virtual devices. Must run before jax import.
+multi-*chip* behavior on virtual devices.
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin and
+explicitly sets jax_platforms at interpreter start, so env vars alone are
+not enough — we must override the jax config *after* jax import (which
+sitecustomize already performed) and before any backend is instantiated.
 """
 
 import os
@@ -11,10 +16,21 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    yield
 
 
 @pytest.fixture

@@ -1,0 +1,207 @@
+"""Independent pure-Python BM25/bool oracle for parity testing.
+
+Deliberately structured nothing like the engine (per-doc loops, dicts) so a
+shared bug is unlikely. Implements Lucene 9 BM25 + ES bool semantics.
+"""
+
+from __future__ import annotations
+
+import math
+
+from elasticsearch_tpu.analysis import get_analyzer
+from elasticsearch_tpu.index.smallfloat import int_to_byte4, byte4_to_int
+
+K1, B = 1.2, 0.75
+
+
+class Oracle:
+    def __init__(self, docs, mappings):
+        self.docs = docs
+        self.m = mappings
+        # field -> term -> {doc: tf}; field -> doc -> quantized len
+        self.tf: dict = {}
+        self.dl: dict = {}
+        self.raw_dl: dict = {}
+        self.vals: dict = {}
+        for i, d in enumerate(docs):
+            parsed = mappings.parse_document(d)
+            for fld, values in parsed.items():
+                ft = mappings.fields[fld]
+                if ft.type == "text":
+                    a = ft.get_analyzer()
+                    toks = [t for v in values for t in a.terms(v)]
+                    for t in toks:
+                        self.tf.setdefault(fld, {}).setdefault(t, {}).setdefault(i, 0)
+                        self.tf[fld][t][i] += 1
+                    self.dl.setdefault(fld, {})[i] = byte4_to_int(int_to_byte4(len(toks)))
+                    self.raw_dl.setdefault(fld, {})[i] = len(toks)
+                elif ft.type == "keyword":
+                    for v in set(values):
+                        # keyword fields index DOCS only (no freqs): tf = 1
+                        if ft.ignore_above and len(v) > ft.ignore_above:
+                            continue
+                        self.tf.setdefault(fld, {}).setdefault(v, {})[i] = 1
+                    self.vals.setdefault(fld, {}).setdefault(i, values[0] if values else None)
+                else:
+                    if values:
+                        self.vals.setdefault(fld, {})[i] = values[0]
+
+    def _avgdl(self, fld):
+        # exact (unquantized) sum / docs-with-terms, cached from __init__
+        lens = self.raw_dl.get(fld, {})
+        cnt = sum(1 for ln in lens.values() if ln > 0)
+        return sum(lens.values()) / cnt if cnt else 1.0
+
+    def _doc_count(self, fld):
+        seen = set()
+        for t, post in self.tf.get(fld, {}).items():
+            seen.update(post)
+        return len(seen)
+
+    def _idf(self, fld, term):
+        df = len(self.tf.get(fld, {}).get(term, {}))
+        if df == 0:
+            return 0.0
+        return math.log(1 + (self._doc_count(fld) - df + 0.5) / (df + 0.5))
+
+    # ---- scoring: returns (scores: {doc: float}, matches: set) ----------
+
+    def eval(self, q) -> tuple[dict, set]:
+        (kind, body), = q.items()
+        return getattr(self, f"_q_{kind}")(body)
+
+    def _term_leaf(self, fld, term, boost=1.0):
+        post = self.tf.get(fld, {}).get(term, {})
+        idf = self._idf(fld, term)
+        ft = self.m.fields.get(fld)
+        has_norms = ft is not None and ft.type == "text"
+        scores, match = {}, set()
+        if has_norms:
+            avgdl = self._avgdl(fld)
+        for doc, tf in post.items():
+            if has_norms:
+                dl = self.dl[fld][doc]
+                tfn = tf / (tf + K1 * (1 - B + B * dl / avgdl))
+            else:
+                tfn = tf / (tf + K1)
+            scores[doc] = boost * idf * tfn
+            match.add(doc)
+        return scores, match
+
+    def _q_term(self, body):
+        (fld, spec), = body.items()
+        value = spec["value"] if isinstance(spec, dict) else spec
+        boost = spec.get("boost", 1.0) if isinstance(spec, dict) else 1.0
+        ft = self.m.fields.get(fld)
+        if ft and ft.type not in ("text", "keyword"):
+            match = {i for i, v in self.vals.get(fld, {}).items() if v == value}
+            return {i: boost for i in match}, match
+        return self._term_leaf(fld, str(value), boost)
+
+    def _q_match(self, body):
+        (fld, spec), = body.items()
+        text = spec["query"] if isinstance(spec, dict) else spec
+        op = spec.get("operator", "or") if isinstance(spec, dict) else "or"
+        boost = spec.get("boost", 1.0) if isinstance(spec, dict) else 1.0
+        ft = self.m.fields.get(fld)
+        analyzer = ft.get_search_analyzer() if ft else get_analyzer("standard")
+        terms = [text] if (ft and ft.type == "keyword") else analyzer.terms(str(text))
+        if op == "and":
+            return self._q_bool({"must": [{"term": {fld: t}} for t in terms], "boost": boost})
+        return self._q_bool({"should": [{"term": {fld: t}} for t in terms], "boost": boost})
+
+    def _q_match_all(self, body):
+        boost = (body or {}).get("boost", 1.0)
+        match = set(range(len(self.docs)))
+        return {i: boost for i in match}, match
+
+    def _q_range(self, body):
+        (fld, spec), = body.items()
+        boost = spec.get("boost", 1.0)
+        from elasticsearch_tpu.index.mappings import parse_date_to_millis
+
+        ft = self.m.fields.get(fld)
+
+        def conv(v):
+            if ft and ft.type == "date":
+                return parse_date_to_millis(v)
+            return v
+
+        match = set()
+        for i, v in self.vals.get(fld, {}).items():
+            if v is None:
+                continue
+            ok = True
+            if "gte" in spec:
+                ok &= v >= conv(spec["gte"])
+            if "gt" in spec:
+                ok &= v > conv(spec["gt"])
+            if "lte" in spec:
+                ok &= v <= conv(spec["lte"])
+            if "lt" in spec:
+                ok &= v < conv(spec["lt"])
+            if ok:
+                match.add(i)
+        return {i: boost for i in match}, match
+
+    def _q_terms(self, body):
+        items = [(f, v) for f, v in body.items() if f != "boost"]
+        (fld, values), = items
+        boost = body.get("boost", 1.0)
+        match = set()
+        for i, v in self.vals.get(fld, {}).items():
+            if v in values:
+                match.add(i)
+        return {i: boost for i in match}, match
+
+    def _q_constant_score(self, body):
+        _, match = self.eval(body["filter"])
+        boost = body.get("boost", 1.0)
+        return {i: boost for i in match}, match
+
+    def _q_dis_max(self, body):
+        tie = body.get("tie_breaker", 0.0)
+        boost = body.get("boost", 1.0)
+        per_child = [self.eval(q) for q in body["queries"]]
+        match = set().union(*(m for _, m in per_child)) if per_child else set()
+        scores = {}
+        for doc in match:
+            ss = [s.get(doc, 0.0) for s, _ in per_child]
+            best = max(ss)
+            scores[doc] = boost * (best + tie * (sum(ss) - best))
+        return scores, match
+
+    def _q_bool(self, body):
+        boost = body.get("boost", 1.0)
+
+        def clause(name):
+            c = body.get(name, [])
+            return [c] if isinstance(c, dict) else c
+
+        must = [self.eval(q) for q in clause("must")]
+        filt = [self.eval(q) for q in clause("filter")]
+        should = [self.eval(q) for q in clause("should")]
+        must_not = [self.eval(q) for q in clause("must_not")]
+        msm = body.get("minimum_should_match")
+        if msm is None:
+            msm = 1 if should and not (must or filt) else 0
+        candidates = set(range(len(self.docs)))
+        for _, m in must:
+            candidates &= m
+        for _, m in filt:
+            candidates &= m
+        for _, m in must_not:
+            candidates -= m
+        if msm > 0:
+            candidates = {d for d in candidates if sum(d in m for _, m in should) >= msm}
+        scores = {}
+        for d in candidates:
+            s = sum(sc.get(d, 0.0) for sc, _ in must)
+            s += sum(sc.get(d, 0.0) for sc, _ in should)
+            scores[d] = boost * s
+        return scores, candidates
+
+    def search(self, query, size=10):
+        scores, match = self.eval(query)
+        ranked = sorted(((d, scores.get(d, 0.0)) for d in match), key=lambda x: (-x[1], x[0]))
+        return ranked[:size], len(match)

@@ -1,0 +1,316 @@
+"""Query execution tests: engine vs pure-python oracle parity."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.query import ShardSearcher
+
+from reference_scorer import Oracle
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "price": {"type": "double"},
+        "ts": {"type": "date"},
+        "flag": {"type": "boolean"},
+    }
+}
+
+DOCS = [
+    {"body": "the quick brown fox jumps over the lazy dog", "tag": "animal", "n": 1, "price": 9.5, "ts": "2024-01-01", "flag": True},
+    {"body": "quick quick quick fox", "tag": "animal", "n": 2, "price": 1.0, "ts": "2024-01-02", "flag": False},
+    {"body": "the lazy dog sleeps all day", "tag": "pet", "n": 3, "price": 5.0, "ts": "2024-02-01", "flag": True},
+    {"body": "a fox and a dog become friends", "tag": "story", "n": 4, "price": 7.25, "ts": "2024-02-15", "flag": False},
+    {"body": "nothing to see here", "tag": "misc", "n": 5, "price": 2.0, "ts": "2024-03-01", "flag": True},
+    {"body": "brown bears and brown foxes", "tag": "animal", "n": 6, "price": 3.5, "ts": "2024-03-15", "flag": False},
+]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS:
+        b.add_document(m.parse_document(d))
+    pack = b.build()
+    return ShardSearcher(pack), Oracle(DOCS, Mappings(MAPPING)), m
+
+
+def check_parity(setup, query, size=10):
+    searcher, oracle, m = setup
+    res = searcher.search(query, size=size, mappings=m)
+    expected, total = oracle.search(query, size=size)
+    assert res.total == total, f"total mismatch for {query}"
+    assert len(res.doc_ids) == len(expected)
+    for (eid, escore), gid, gscore in zip(expected, res.doc_ids, res.scores):
+        assert eid == gid, f"doc order mismatch for {query}: {expected} vs {list(zip(res.doc_ids, res.scores))}"
+        assert abs(escore - gscore) < 1e-5, f"score mismatch for {query} doc {eid}"
+    return res
+
+
+def test_match_single_term(setup):
+    check_parity(setup, {"match": {"body": "fox"}})
+
+
+def test_match_multi_term(setup):
+    check_parity(setup, {"match": {"body": "quick brown fox"}})
+
+
+def test_match_operator_and(setup):
+    res = check_parity(setup, {"match": {"body": {"query": "lazy dog", "operator": "and"}}})
+    assert res.total == 2
+
+
+def test_match_repeated_tf_scoring(setup):
+    # doc 1 has tf(quick)=3 -> must outrank doc 0 (tf=1)
+    res = check_parity(setup, {"match": {"body": "quick"}})
+    assert res.doc_ids[0] == 1
+
+
+def test_term_keyword(setup):
+    res = check_parity(setup, {"term": {"tag": "animal"}})
+    assert res.total == 3
+
+
+def test_term_numeric(setup):
+    res = check_parity(setup, {"term": {"n": 3}})
+    assert res.total == 1 and res.doc_ids[0] == 2
+
+
+def test_term_boolean(setup):
+    res = check_parity(setup, {"term": {"flag": True}})
+    assert res.total == 3
+
+
+def test_match_all(setup):
+    res = check_parity(setup, {"match_all": {}})
+    assert res.total == len(DOCS)
+
+
+def test_range_long(setup):
+    res = check_parity(setup, {"range": {"n": {"gte": 2, "lt": 5}}})
+    assert res.total == 3
+
+
+def test_range_double(setup):
+    check_parity(setup, {"range": {"price": {"gt": 2.0, "lte": 7.25}}})
+
+
+def test_range_date(setup):
+    res = check_parity(setup, {"range": {"ts": {"gte": "2024-02-01"}}})
+    assert res.total == 4
+
+
+def test_terms_keyword(setup):
+    res = check_parity(setup, {"terms": {"tag": ["animal", "pet"]}})
+    assert res.total == 4
+
+
+def test_terms_numeric(setup):
+    res = check_parity(setup, {"terms": {"n": [1, 4, 99]}})
+    assert res.total == 2
+
+
+def test_bool_must_should(setup):
+    check_parity(
+        setup,
+        {"bool": {"must": [{"match": {"body": "dog"}}], "should": [{"match": {"body": "lazy"}}]}},
+    )
+
+
+def test_bool_filter_no_score(setup):
+    res = check_parity(
+        setup,
+        {"bool": {"must": [{"match": {"body": "fox"}}], "filter": [{"term": {"tag": "animal"}}]}},
+    )
+    assert res.total == 2
+
+
+def test_bool_must_not(setup):
+    res = check_parity(
+        setup,
+        {"bool": {"must": [{"match": {"body": "dog"}}], "must_not": [{"term": {"tag": "pet"}}]}},
+    )
+    assert 2 not in res.doc_ids
+
+
+def test_bool_minimum_should_match(setup):
+    res = check_parity(
+        setup,
+        {
+            "bool": {
+                "should": [
+                    {"match": {"body": "fox"}},
+                    {"match": {"body": "dog"}},
+                    {"match": {"body": "brown"}},
+                ],
+                "minimum_should_match": 2,
+            }
+        },
+    )
+    assert res.total == 2  # doc 0 (fox+dog+brown), doc 3 (fox+dog)
+
+
+def test_nested_bool(setup):
+    check_parity(
+        setup,
+        {
+            "bool": {
+                "must": [
+                    {
+                        "bool": {
+                            "should": [
+                                {"match": {"body": "fox"}},
+                                {"match": {"body": "bears"}},
+                            ]
+                        }
+                    }
+                ],
+                "filter": [{"range": {"n": {"lte": 6}}}],
+            }
+        },
+    )
+
+
+def test_constant_score(setup):
+    res = check_parity(setup, {"constant_score": {"filter": {"term": {"tag": "animal"}}, "boost": 2.5}})
+    assert all(abs(s - 2.5) < 1e-6 for s in res.scores)
+
+
+def test_dis_max(setup):
+    check_parity(
+        setup,
+        {
+            "dis_max": {
+                "queries": [{"match": {"body": "fox"}}, {"match": {"body": "dog"}}],
+                "tie_breaker": 0.3,
+            }
+        },
+    )
+
+
+def test_boost(setup):
+    r1 = check_parity(setup, {"match": {"body": {"query": "fox", "boost": 3.0}}})
+    r2 = check_parity(setup, {"match": {"body": "fox"}})
+    np.testing.assert_allclose(r1.scores, 3.0 * r2.scores, rtol=1e-6)
+
+
+def test_exists(setup):
+    searcher, _, m = setup
+    res = searcher.search({"exists": {"field": "n"}}, mappings=m)
+    assert res.total == len(DOCS)
+
+
+def test_match_none(setup):
+    searcher, _, m = setup
+    res = searcher.search({"match_none": {}}, mappings=m)
+    assert res.total == 0 and len(res.doc_ids) == 0
+
+
+def test_pagination(setup):
+    searcher, oracle, m = setup
+    full = searcher.search({"match": {"body": "fox dog"}}, size=10, mappings=m)
+    page = searcher.search({"match": {"body": "fox dog"}}, size=2, from_=2, mappings=m)
+    np.testing.assert_array_equal(page.doc_ids, full.doc_ids[2:4])
+
+
+def test_size_zero_still_counts(setup):
+    searcher, _, m = setup
+    res = searcher.search({"match": {"body": "fox"}}, size=0, mappings=m)
+    assert res.total == 3
+
+
+def test_unknown_query_type(setup):
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    searcher, _, m = setup
+    with pytest.raises(QueryParsingError):
+        searcher.search({"fuzzy_wuzzy": {}}, mappings=m)
+
+
+def test_unknown_field_matches_nothing(setup):
+    searcher, _, m = setup
+    res = searcher.search({"match": {"nope": "x"}}, mappings=m)
+    assert res.total == 0
+
+
+def test_compile_cache_reuse(setup):
+    searcher, _, m = setup
+    searcher.search({"match": {"body": "fox"}}, mappings=m)
+    n_before = len(searcher._cache)
+    searcher.search({"match": {"body": "dog"}}, mappings=m)  # same shape
+    assert len(searcher._cache) == n_before
+
+
+def test_scores_match_reference_formula(setup):
+    """Explicit hand-computed BM25 check on one doc, independent of oracle."""
+    import math
+
+    searcher, _, m = setup
+    res = searcher.search({"match": {"body": "sleeps"}}, mappings=m)
+    # df=1, docCount = 6 docs with body terms
+    idf = math.log(1 + (6 - 1 + 0.5) / (1 + 0.5))
+    # doc 2 "the lazy dog sleeps all day" -> dl=6, quantized 6
+    dls = [9, 4, 6, 7, 4, 5]
+    avgdl = sum(dls) / 6
+    tfn = 1 / (1 + 1.2 * (1 - 0.75 + 0.75 * 6 / avgdl))
+    assert abs(res.scores[0] - idf * tfn) < 1e-6
+
+
+def test_size_zero_returns_no_hits(setup):
+    searcher, _, m = setup
+    res = searcher.search({"match": {"body": "fox"}}, size=0, mappings=m)
+    assert res.total == 3 and len(res.doc_ids) == 0
+
+
+def test_terms_query_dict_not_mutated(setup):
+    searcher, _, m = setup
+    q = {"terms": {"tag": ["animal"], "boost": 2.0}}
+    r1 = searcher.search(q, mappings=m)
+    r2 = searcher.search(q, mappings=m)
+    np.testing.assert_array_equal(r1.scores, r2.scores)
+    assert abs(r1.scores[0] - 2.0) < 1e-6
+
+
+def test_mappings_stored_on_searcher():
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS[:2]:
+        b.add_document(m.parse_document(d))
+    s = ShardSearcher(b.build(), mappings=m)
+    assert s.search({"match": {"body": "fox"}}).total == 2
+
+
+def test_exists_zero_token_text():
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"body": "!!!"}))  # analyzes to 0 tokens
+    b.add_document(m.parse_document({}))
+    s = ShardSearcher(b.build(), mappings=m)
+    res = s.search({"exists": {"field": "body"}})
+    assert res.total == 1 and res.doc_ids[0] == 0
+
+
+def test_oracle_keyword_duplicate_values():
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"tag": {"type": "keyword"}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"tag": ["a", "a"]}))
+    b.add_document(m.parse_document({"tag": ["b"]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    o = Oracle([{"tag": ["a", "a"]}, {"tag": ["b"]}], Mappings({"properties": {"tag": {"type": "keyword"}}}))
+    res = s.search({"term": {"tag": "a"}})
+    exp, _ = o.search({"term": {"tag": "a"}})
+    assert abs(res.scores[0] - exp[0][1]) < 1e-6
