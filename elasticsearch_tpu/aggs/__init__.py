@@ -1,0 +1,4 @@
+from .parse import parse_aggs
+from .nodes import AggNode
+
+__all__ = ["parse_aggs", "AggNode"]

@@ -1,0 +1,131 @@
+"""Aggregation DSL JSON -> AggNode tree.
+
+Parity target: agg parsing registered in search/SearchModule.java (reference)
+with the {"<name>": {"<type>": {...}, "aggs": {...}}} request shape.
+"""
+
+from __future__ import annotations
+
+from ..query.dsl import parse_query
+from ..utils.errors import QueryParsingError
+from .nodes import (
+    AggNode,
+    AvgAgg,
+    CardinalityAgg,
+    DateHistogramAgg,
+    FilterAgg,
+    FiltersAgg,
+    GlobalAgg,
+    HistogramAgg,
+    MaxAgg,
+    MinAgg,
+    MissingAgg,
+    PercentilesAgg,
+    RangeAgg,
+    StatsAgg,
+    SumAgg,
+    TermsAgg,
+    ValueCountAgg,
+)
+
+_METRICS = {
+    "min": MinAgg,
+    "max": MaxAgg,
+    "sum": SumAgg,
+    "avg": AvgAgg,
+    "stats": StatsAgg,
+    "value_count": ValueCountAgg,
+    "cardinality": CardinalityAgg,
+}
+
+
+def parse_aggs(aggs_dict: dict, mappings) -> dict[str, AggNode]:
+    """-> {agg_name: AggNode} for one level (children parsed recursively)."""
+    if not isinstance(aggs_dict, dict):
+        raise QueryParsingError("[aggs] must be an object")
+    out: dict[str, AggNode] = {}
+    for name, spec in aggs_dict.items():
+        if not isinstance(spec, dict):
+            raise QueryParsingError(f"aggregation [{name}] must be an object")
+        sub = spec.get("aggs") or spec.get("aggregations") or {}
+        children = parse_aggs(sub, mappings) if sub else {}
+        types = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
+        if len(types) != 1:
+            raise QueryParsingError(f"aggregation [{name}] must define exactly one type")
+        typ = types[0]
+        body = spec[typ]
+        out[name] = _build(name, typ, body, children, mappings)
+    return out
+
+
+def _field_of(name, typ, body):
+    fld = body.get("field")
+    if not fld:
+        raise QueryParsingError(f"[{typ}] aggregation [{name}] requires [field]")
+    return fld
+
+
+def _build(name, typ, body, children, mappings) -> AggNode:
+    if typ in _METRICS:
+        cls = _METRICS[typ]
+        return cls(name, _field_of(name, typ, body), children=children or None)
+    if typ == "percentiles":
+        return PercentilesAgg(
+            name, _field_of(name, typ, body), percents=body.get("percents"), children=children or None
+        )
+    if typ == "terms":
+        return TermsAgg(
+            name,
+            _field_of(name, typ, body),
+            size=int(body.get("size", 10)),
+            order=body.get("order"),
+            children=children or None,
+        )
+    if typ == "histogram":
+        if "interval" not in body:
+            raise QueryParsingError(f"[histogram] aggregation [{name}] requires [interval]")
+        return HistogramAgg(
+            name,
+            _field_of(name, typ, body),
+            interval=body["interval"],
+            offset=body.get("offset", 0.0),
+            min_doc_count=body.get("min_doc_count"),
+            children=children or None,
+        )
+    if typ == "date_histogram":
+        return DateHistogramAgg(
+            name,
+            _field_of(name, typ, body),
+            fixed_interval=body.get("fixed_interval") or body.get("interval"),
+            calendar_interval=body.get("calendar_interval"),
+            offset=body.get("offset", 0),
+            min_doc_count=body.get("min_doc_count"),
+            format=body.get("format"),
+            children=children or None,
+        )
+    if typ == "range":
+        if "ranges" not in body:
+            raise QueryParsingError(f"[range] aggregation [{name}] requires [ranges]")
+        return RangeAgg(
+            name,
+            _field_of(name, typ, body),
+            ranges=body["ranges"],
+            keyed=bool(body.get("keyed", False)),
+            children=children or None,
+        )
+    if typ == "filter":
+        return FilterAgg(name, parse_query(body, mappings), children=children or None)
+    if typ == "filters":
+        named = body.get("filters")
+        if not isinstance(named, dict):
+            raise QueryParsingError(f"[filters] aggregation [{name}] requires keyed [filters]")
+        return FiltersAgg(
+            name,
+            {n: parse_query(q, mappings) for n, q in named.items()},
+            children=children or None,
+        )
+    if typ == "missing":
+        return MissingAgg(name, _field_of(name, typ, body), children=children or None)
+    if typ == "global":
+        return GlobalAgg(name, children or None)
+    raise QueryParsingError(f"unknown aggregation type [{typ}]")

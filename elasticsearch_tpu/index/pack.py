@@ -55,6 +55,13 @@ class DocValuesColumn:
     values: np.ndarray  # [N] int64 | float32 | int32 ordinals (-1 = missing)
     has_value: np.ndarray  # [N] bool
     ord_terms: list[str] | None = None  # sorted terms for kind == "ord"
+    # terms-agg support for numeric columns: sorted unique values + per-doc
+    # ordinal (the analog of Lucene sorted-numeric global ordinals)
+    uniq_values: np.ndarray | None = None  # [V] int64
+    uniq_ords: np.ndarray | None = None  # [N] int32 (-1 = missing)
+    # column min/max over present values (static histogram bucket planning)
+    vmin: float | int = 0
+    vmax: float | int = 0
 
 
 @dataclass
@@ -288,14 +295,28 @@ class PackBuilder:
                     if not has[docid]:
                         vals[docid] = v
                         has[docid] = True
-                docvalues[fld] = DocValuesColumn("float", vals, has)
+                col = DocValuesColumn("float", vals, has)
+                if has.any():
+                    col.vmin = float(vals[has].min())
+                    col.vmax = float(vals[has].max())
+                docvalues[fld] = col
             else:  # int / date / boolean
                 vals = np.zeros(N, dtype=np.int64)
                 for docid, v in pairs:
                     if not has[docid]:
                         vals[docid] = v
                         has[docid] = True
-                docvalues[fld] = DocValuesColumn("int", vals, has)
+                col = DocValuesColumn("int", vals, has)
+                if has.any():
+                    present = vals[has]
+                    col.vmin = int(present.min())
+                    col.vmax = int(present.max())
+                    uniq, inv = np.unique(present, return_inverse=True)
+                    ords = np.full(N, -1, dtype=np.int32)
+                    ords[has] = inv.astype(np.int32)
+                    col.uniq_values = uniq
+                    col.uniq_ords = ords
+                docvalues[fld] = col
 
         # ---- vectors -----------------------------------------------------
         vectors: dict[str, VectorColumn] = {}

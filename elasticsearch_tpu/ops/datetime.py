@@ -1,0 +1,54 @@
+"""Vectorized civil-calendar math on epoch-millis columns.
+
+The reference implements calendar rounding host-side per value
+(reference behavior: server/.../common/Rounding.java — date_histogram
+calendar_interval month/quarter/year). On TPU we decompose epoch days into
+(year, month, day) with Howard Hinnant's civil-from-days algorithm — pure
+integer arithmetic, branch-free, vectorizes over the whole column.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MS_PER_DAY = 86_400_000
+
+
+def civil_from_millis(ms: jnp.ndarray):
+    """epoch millis (int64, UTC) -> (year, month 1..12, day 1..31), int64."""
+    days = jnp.floor_divide(ms, MS_PER_DAY)
+    z = days + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097  # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+    )  # [0, 399]
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = jnp.floor_divide(5 * doy + 2, 153)  # [0, 11]
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1  # [1, 31]
+    m = mp + 3 - 12 * (mp // 10)  # [1, 12]
+    y = y + (mp // 10)
+    return y, m, d
+
+
+def month_index_from_millis(ms: jnp.ndarray) -> jnp.ndarray:
+    """epoch millis -> months since year 0 (y*12 + m-1); monotone in time."""
+    y, m, _ = civil_from_millis(ms)
+    return y * 12 + (m - 1)
+
+
+def days_from_civil(y: int, m: int, d: int) -> int:
+    """Host-side inverse (scalar): civil date -> epoch days."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def millis_of_month_index(idx: int) -> int:
+    """Host-side: month index (y*12+m-1) -> epoch millis of month start."""
+    y, m = divmod(idx, 12)
+    return days_from_civil(y, m + 1, 1) * MS_PER_DAY

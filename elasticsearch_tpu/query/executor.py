@@ -41,10 +41,13 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         "vec": {},
         "vec_has": {},
     }
+    dev["dv_int_ord"] = {}
     for f, col in pack.docvalues.items():
         key = {"int": "dv_int", "float": "dv_float", "ord": "dv_ord"}[col.kind]
         vals = col.values if col.kind != "ord" else col.values.astype(np.int64)
         dev[key][f] = (put(vals), put(col.has_value))
+        if col.uniq_ords is not None:
+            dev["dv_int_ord"][f] = put(col.uniq_ords)
     for f, vc in pack.vectors.items():
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
@@ -57,6 +60,7 @@ class ShardResult:
     scores: np.ndarray  # [<=k] float32
     total: int
     max_score: float | None
+    aggregations: dict | None = None
 
 
 class ShardSearcher:
@@ -73,15 +77,24 @@ class ShardSearcher:
 
     # -- compilation -------------------------------------------------------
 
-    def _compiled(self, node: QueryNode, struct_key: tuple, k: int):
-        key = (struct_key, k)
+    def _compiled(self, node: QueryNode, struct_key: tuple, k: int, agg_nodes=None, agg_key=()):
+        key = (struct_key, k, agg_key)
         fn = self._cache.get(key)
         if fn is None:
             ctx = self.ctx
+            n = self.pack.num_docs
 
-            def run(dev, params):
+            def run(dev, params, agg_params):
                 scores, match = node.device_eval(dev, params, ctx)
-                return top_k_with_total(scores, match, dev["live"], k)
+                ok = match[:n] & dev["live"]
+                agg_out = {}
+                if agg_nodes:
+                    seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                    for name, anode in agg_nodes.items():
+                        agg_out[name] = anode.device_eval_segmented(
+                            dev, agg_params[name], seg, 1, ok, ctx
+                        )
+                return (*top_k_with_total(scores, match, dev["live"], k), agg_out)
 
             fn = jax.jit(run)
             self._cache[key] = fn
@@ -95,28 +108,49 @@ class ShardSearcher:
         size: int = 10,
         from_: int = 0,
         mappings=None,
+        aggs: dict | None = None,
     ) -> ShardResult:
-        if isinstance(query, QueryNode):
-            node = query
-        else:
-            m = mappings if mappings is not None else self.mappings
-            if m is None:
-                from ..utils.errors import QueryParsingError
+        m = mappings if mappings is not None else self.mappings
+        if m is None and (aggs or not isinstance(query, QueryNode)):
+            from ..utils.errors import QueryParsingError
 
-                raise QueryParsingError("no mappings available to parse the query")
-            node = parse_query(query, m)
+            raise QueryParsingError("no mappings available to parse the request")
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        agg_nodes = None
+        if aggs:
+            from ..aggs import parse_aggs
+
+            agg_nodes = parse_aggs(aggs, m)
         if self.pack.num_docs == 0:
-            return ShardResult(np.array([], np.int32), np.array([], np.float32), 0, None)
+            return ShardResult(
+                np.array([], np.int32), np.array([], np.float32), 0, None,
+                {} if aggs else None,
+            )
         params, struct_key = node.prepare(self.pack)
+        agg_params, agg_key = {}, ()
+        if agg_nodes:
+            parts = {n: a.prepare(self.pack, m) for n, a in agg_nodes.items()}
+            agg_params = {n: p for n, (p, _) in parts.items()}
+            agg_key = tuple((n, k) for n, (_, k) in sorted(parts.items()))
         k = min(max(size + from_, 1), self.pack.num_docs)
-        fn = self._compiled(node, struct_key, k)
-        top_scores, top_ids, total = jax.device_get(fn(self.dev, params))
+        fn = self._compiled(node, struct_key, k, agg_nodes, agg_key)
+        top_scores, top_ids, total, agg_out = jax.device_get(
+            fn(self.dev, params, agg_params)
+        )
+        aggregations = None
+        if agg_nodes:
+            aggregations = {
+                name: anode.finalize(agg_out[name], 1)[0]
+                for name, anode in agg_nodes.items()
+            }
         valid = np.isfinite(top_scores)
         max_score = float(top_scores[0]) if valid.any() else None
         end = max(size + from_, 0)
         ids = top_ids[valid][from_:end]
         scs = top_scores[valid][from_:end]
-        return ShardResult(ids.astype(np.int32), scs.astype(np.float32), int(total), max_score)
+        return ShardResult(
+            ids.astype(np.int32), scs.astype(np.float32), int(total), max_score, aggregations
+        )
 
     def count(self, query: dict | QueryNode | None, mappings=None) -> int:
         return self.search(query, size=1, mappings=mappings).total

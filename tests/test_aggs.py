@@ -1,0 +1,304 @@
+"""Aggregation tests: engine vs hand-computed numpy expectations."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mappings import Mappings, parse_date_to_millis
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.query import ShardSearcher
+
+MAPPING = {
+    "properties": {
+        "status": {"type": "keyword"},
+        "bytes": {"type": "long"},
+        "price": {"type": "double"},
+        "ts": {"type": "date"},
+        "msg": {"type": "text"},
+    }
+}
+
+DOCS = [
+    {"status": "200", "bytes": 100, "price": 1.5, "ts": "2024-01-01T00:30:00Z", "msg": "ok request"},
+    {"status": "200", "bytes": 300, "price": 2.5, "ts": "2024-01-01T01:30:00Z", "msg": "ok request"},
+    {"status": "404", "bytes": 50, "price": 0.5, "ts": "2024-01-01T02:30:00Z", "msg": "missing page"},
+    {"status": "200", "bytes": 700, "price": 9.0, "ts": "2024-01-02T00:10:00Z", "msg": "ok big request"},
+    {"status": "500", "bytes": 20, "price": 4.0, "ts": "2024-01-02T03:30:00Z", "msg": "server error"},
+    {"status": "404", "bytes": 60, "ts": "2024-03-01T10:00:00Z", "msg": "gone missing"},
+    {"bytes": 10, "price": 7.0, "ts": "2024-03-02T11:00:00Z", "msg": "anonymous"},
+]
+
+
+@pytest.fixture(scope="module")
+def s():
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS:
+        b.add_document(m.parse_document(d))
+    return ShardSearcher(b.build(), mappings=m)
+
+
+def agg(s, aggs, query=None, **kw):
+    return s.search(query, size=0, aggs=aggs, **kw).aggregations
+
+
+def test_terms_keyword(s):
+    out = agg(s, {"by_status": {"terms": {"field": "status"}}})
+    b = out["by_status"]["buckets"]
+    assert [(x["key"], x["doc_count"]) for x in b] == [("200", 3), ("404", 2), ("500", 1)]
+    assert out["by_status"]["sum_other_doc_count"] == 0
+    assert out["by_status"]["doc_count_error_upper_bound"] == 0
+
+
+def test_terms_size_and_other(s):
+    out = agg(s, {"a": {"terms": {"field": "status", "size": 1}}})
+    assert out["a"]["buckets"] == [{"key": "200", "doc_count": 3}]
+    assert out["a"]["sum_other_doc_count"] == 3
+
+
+def test_terms_order_key(s):
+    out = agg(s, {"a": {"terms": {"field": "status", "order": {"_key": "desc"}}}})
+    assert [x["key"] for x in out["a"]["buckets"]] == ["500", "404", "200"]
+
+
+def test_terms_numeric_field(s):
+    out = agg(s, {"a": {"terms": {"field": "bytes", "size": 3}}})
+    # all counts 1 except bytes values unique; ties -> key asc
+    assert [x["key"] for x in out["a"]["buckets"]] == [10, 20, 50]
+
+
+def test_terms_filtered_by_query(s):
+    out = agg(s, {"a": {"terms": {"field": "status"}}}, query={"match": {"msg": "request"}})
+    assert [(x["key"], x["doc_count"]) for x in out["a"]["buckets"]] == [("200", 3)]
+
+
+def test_metrics(s):
+    out = agg(
+        s,
+        {
+            "mn": {"min": {"field": "bytes"}},
+            "mx": {"max": {"field": "bytes"}},
+            "sm": {"sum": {"field": "bytes"}},
+            "av": {"avg": {"field": "bytes"}},
+            "vc": {"value_count": {"field": "price"}},
+            "st": {"stats": {"field": "bytes"}},
+        },
+    )
+    vals = [100, 300, 50, 700, 20, 60, 10]
+    assert out["mn"]["value"] == 10 and out["mx"]["value"] == 700
+    assert out["sm"]["value"] == sum(vals)
+    assert abs(out["av"]["value"] - np.mean(vals)) < 1e-6
+    assert out["vc"]["value"] == 6  # doc 5 has no price
+    st = out["st"]
+    assert st["count"] == 7 and st["min"] == 10 and st["max"] == 700 and st["sum"] == sum(vals)
+
+
+def test_metrics_empty_result_set(s):
+    out = agg(s, {"mn": {"min": {"field": "bytes"}}, "av": {"avg": {"field": "bytes"}}},
+              query={"term": {"status": "418"}})
+    assert out["mn"]["value"] is None
+    assert out["av"]["value"] is None
+
+
+def test_cardinality(s):
+    out = agg(s, {"c": {"cardinality": {"field": "status"}}, "cb": {"cardinality": {"field": "bytes"}}})
+    assert out["c"]["value"] == 3
+    assert out["cb"]["value"] == 7
+
+
+def test_percentiles(s):
+    out = agg(s, {"p": {"percentiles": {"field": "bytes", "percents": [50, 95]}}})
+    vals = np.array([100, 300, 50, 700, 20, 60, 10], dtype=np.float64)
+    assert abs(out["p"]["values"]["50.0"] - np.percentile(vals, 50)) < 1e-3
+    assert abs(out["p"]["values"]["95.0"] - np.percentile(vals, 95)) < 1e-3
+
+
+def test_histogram(s):
+    out = agg(s, {"h": {"histogram": {"field": "price", "interval": 2.0}}})
+    b = {x["key"]: x["doc_count"] for x in out["h"]["buckets"]}
+    # prices: 1.5,2.5,0.5,9.0,4.0,7.0 -> buckets 0:2(1.5,0.5), 2:1, 4:1, 6:1, 8:1
+    assert b == {0.0: 2, 2.0: 1, 4.0: 1, 6.0: 1, 8.0: 1}
+
+
+def test_date_histogram_hourly(s):
+    out = agg(s, {"h": {"date_histogram": {"field": "ts", "fixed_interval": "1h"}}})
+    b = out["h"]["buckets"]
+    assert b[0]["key"] == parse_date_to_millis("2024-01-01T00:00:00Z")
+    assert b[0]["doc_count"] == 1
+    assert b[0]["key_as_string"] == "2024-01-01T00:00:00.000Z"
+    total = sum(x["doc_count"] for x in b)
+    assert total == 7
+    # hours 0,1,2 on day1 each 1 doc
+    assert [x["doc_count"] for x in b[:3]] == [1, 1, 1]
+
+
+def test_date_histogram_daily_counts(s):
+    out = agg(s, {"h": {"date_histogram": {"field": "ts", "fixed_interval": "1d"}}})
+    counts = {x["key_as_string"][:10]: x["doc_count"] for x in out["h"]["buckets"] if x["doc_count"]}
+    assert counts == {"2024-01-01": 3, "2024-01-02": 2, "2024-03-01": 1, "2024-03-02": 1}
+
+
+def test_date_histogram_calendar_month(s):
+    out = agg(s, {"h": {"date_histogram": {"field": "ts", "calendar_interval": "month"}}})
+    b = out["h"]["buckets"]
+    assert [x["key_as_string"][:7] for x in b] == ["2024-01", "2024-02", "2024-03"]
+    assert [x["doc_count"] for x in b] == [5, 0, 2]
+    assert b[0]["key"] == parse_date_to_millis("2024-01-01")
+
+
+def test_date_histogram_min_doc_count(s):
+    out = agg(s, {"h": {"date_histogram": {"field": "ts", "calendar_interval": "month", "min_doc_count": 1}}})
+    assert [x["doc_count"] for x in out["h"]["buckets"]] == [5, 2]
+
+
+def test_terms_with_sub_metric(s):
+    out = agg(
+        s,
+        {"by_status": {"terms": {"field": "status"}, "aggs": {"total_bytes": {"sum": {"field": "bytes"}}}}},
+    )
+    b = {x["key"]: x["total_bytes"]["value"] for x in out["by_status"]["buckets"]}
+    assert b == {"200": 1100.0, "404": 110.0, "500": 20.0}
+
+
+def test_date_histogram_with_sub_terms(s):
+    out = agg(
+        s,
+        {
+            "per_day": {
+                "date_histogram": {"field": "ts", "fixed_interval": "1d"},
+                "aggs": {"statuses": {"terms": {"field": "status"}}},
+            }
+        },
+    )
+    day1 = out["per_day"]["buckets"][0]
+    assert day1["doc_count"] == 3
+    assert {x["key"]: x["doc_count"] for x in day1["statuses"]["buckets"]} == {"200": 2, "404": 1}
+
+
+def test_range_agg(s):
+    out = agg(
+        s,
+        {
+            "r": {
+                "range": {
+                    "field": "bytes",
+                    "ranges": [{"to": 100}, {"from": 100, "to": 500}, {"from": 500}],
+                }
+            }
+        },
+    )
+    b = out["r"]["buckets"]
+    assert [x["doc_count"] for x in b] == [4, 2, 1]
+    assert b[0]["key"] == "*-100"
+
+
+def test_filter_agg(s):
+    out = agg(
+        s,
+        {"ok": {"filter": {"term": {"status": "200"}}, "aggs": {"avg_b": {"avg": {"field": "bytes"}}}}},
+    )
+    assert out["ok"]["doc_count"] == 3
+    assert abs(out["ok"]["avg_b"]["value"] - (100 + 300 + 700) / 3) < 1e-6
+
+
+def test_filters_agg(s):
+    out = agg(
+        s,
+        {
+            "f": {
+                "filters": {
+                    "filters": {
+                        "ok": {"term": {"status": "200"}},
+                        "err": {"terms": {"status": ["404", "500"]}},
+                    }
+                }
+            }
+        },
+    )
+    assert out["f"]["buckets"]["ok"]["doc_count"] == 3
+    assert out["f"]["buckets"]["err"]["doc_count"] == 3
+
+
+def test_missing_agg(s):
+    out = agg(s, {"no_status": {"missing": {"field": "status"}}})
+    assert out["no_status"]["doc_count"] == 1
+
+
+def test_global_agg(s):
+    out = agg(
+        s,
+        {"all": {"global": {}, "aggs": {"s": {"sum": {"field": "bytes"}}}}},
+        query={"term": {"status": "500"}},
+    )
+    assert out["all"]["doc_count"] == len(DOCS)
+    assert out["all"]["s"]["value"] == 1240.0
+
+
+def test_unknown_agg_type(s):
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    with pytest.raises(QueryParsingError):
+        agg(s, {"x": {"wavelet": {"field": "bytes"}}})
+
+
+def test_agg_on_unmapped_field(s):
+    out = agg(s, {"a": {"terms": {"field": "nope"}}, "b": {"sum": {"field": "nope"}}})
+    assert out["a"]["buckets"] == []
+    assert out["b"]["value"] == 0.0
+
+
+def test_nested_three_levels(s):
+    out = agg(
+        s,
+        {
+            "per_day": {
+                "date_histogram": {"field": "ts", "fixed_interval": "1d"},
+                "aggs": {
+                    "statuses": {
+                        "terms": {"field": "status"},
+                        "aggs": {"b": {"max": {"field": "bytes"}}},
+                    }
+                },
+            }
+        },
+    )
+    day1_statuses = out["per_day"]["buckets"][0]["statuses"]["buckets"]
+    by = {x["key"]: x["b"]["value"] for x in day1_statuses}
+    assert by == {"200": 300.0, "404": 50.0}
+
+
+def test_range_agg_different_bounds_no_stale_cache(s):
+    o1 = agg(s, {"r": {"range": {"field": "bytes", "ranges": [{"to": 50}]}}})
+    o2 = agg(s, {"r": {"range": {"field": "bytes", "ranges": [{"to": 100}]}}})
+    assert o1["r"]["buckets"][0]["doc_count"] == 2  # 20, 10
+    assert o2["r"]["buckets"][0]["doc_count"] == 4  # 20, 10, 50, 60
+
+
+def test_calendar_month_with_offset(s):
+    # 10-day offset shifts early-Jan docs into the offset-December bucket;
+    # every doc must still be counted exactly once
+    out = agg(s, {"h": {"date_histogram": {"field": "ts", "calendar_interval": "month", "offset": "10d"}}})
+    assert sum(x["doc_count"] for x in out["h"]["buckets"]) == len(DOCS)
+
+
+def test_terms_unmapped_field_with_subagg(s):
+    out = agg(s, {"t": {"terms": {"field": "no_such"}, "aggs": {"m": {"max": {"field": "price"}}}}})
+    assert out["t"]["buckets"] == []
+
+
+def test_cardinality_float_field_raises(s):
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+    with pytest.raises(IllegalArgumentError):
+        agg(s, {"c": {"cardinality": {"field": "price"}}})
+
+
+def test_aggs_without_mappings_raises():
+    from elasticsearch_tpu.query.nodes import MatchAllNode
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    b.add_document(m.parse_document(DOCS[0]))
+    searcher = ShardSearcher(b.build())  # no mappings stored
+    with pytest.raises(QueryParsingError):
+        searcher.search(MatchAllNode(), aggs={"f": {"filter": {"term": {"status": "200"}}}})
