@@ -99,6 +99,39 @@ class AggNode:
                 per_seg[i][n] = frags[i]
         return per_seg
 
+    # ---- shard merge: host-side reduction of stacked per-shard partials ----
+    # `stacked` mirrors the device output pytree with a leading shard axis on
+    # every array (the TPU analog of the reference's coordinator-side
+    # InternalAggregations.reduce). _MERGE_RULES maps output keys to
+    # reduction ops; children recurse.
+
+    _MERGE_RULES: dict[str, str] = {}
+
+    def merge_partials(self, stacked: dict) -> dict:
+        out = {}
+        for key, rule in self._MERGE_RULES.items():
+            if key not in stacked:
+                continue
+            arr = np.asarray(stacked[key])
+            if rule == "sum":
+                out[key] = arr.sum(axis=0)
+            elif rule == "min":
+                out[key] = arr.min(axis=0)
+            elif rule == "max":
+                out[key] = arr.max(axis=0)
+            elif rule == "any":
+                out[key] = arr.any(axis=0)
+            elif rule == "concat_sorted":
+                out[key] = np.sort(arr.reshape(-1))
+        if "children" in stacked:
+            # a bucket agg over an absent field emits children={} (nothing
+            # was evaluated); keep it empty rather than recursing
+            present = stacked["children"]
+            out["children"] = {
+                n: c.merge_partials(present[n]) for n, c in self.children.items() if n in present
+            }
+        return out
+
 
 # ---------------------------------------------------------------------------
 # metric aggs
@@ -126,6 +159,8 @@ def _seg_scatter(seg, nseg, valid, values, init, op):
 
 
 class SumAgg(_FieldMetricAgg):
+    _MERGE_RULES = {"sum": "sum", "count": "sum"}
+
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _numeric_values(dev, self.fld, ctx)
         if got is None:
@@ -143,6 +178,7 @@ class SumAgg(_FieldMetricAgg):
 
 class MinAgg(_FieldMetricAgg):
     op, init, resp = "min", np.inf, min
+    _MERGE_RULES = {"v": "min"}
 
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _numeric_values(dev, self.fld, ctx)
@@ -161,9 +197,12 @@ class MinAgg(_FieldMetricAgg):
 
 class MaxAgg(MinAgg):
     op, init = "max", -np.inf
+    _MERGE_RULES = {"v": "max"}
 
 
 class ValueCountAgg(_FieldMetricAgg):
+    _MERGE_RULES = {"count": "sum"}
+
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _col_arrays(dev, self.fld)
         if got is None:
@@ -185,6 +224,8 @@ class AvgAgg(SumAgg):
 
 
 class StatsAgg(_FieldMetricAgg):
+    _MERGE_RULES = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _numeric_values(dev, self.fld, ctx)
         if got is None:
@@ -238,9 +279,13 @@ class CardinalityAgg(_FieldMetricAgg):
         self.V = V
         return {}, ("card", self.fld, V)
 
+    _MERGE_RULES = {"present": "any"}
+
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         V = self.V
         if V == 0:
+            if ctx.sharded:
+                return {"present": jnp.zeros((nseg, 1), bool)}
             return {"card": jnp.zeros(nseg, jnp.int32)}
         if nseg * V > MAX_SEGMENT_PRODUCT:
             raise IllegalArgumentError(
@@ -249,12 +294,19 @@ class CardinalityAgg(_FieldMetricAgg):
         ords, h = _ordinal_column(dev, self.fld)
         ok = valid & h & (ords >= 0)
         flat = jnp.where(ok, seg * V + ords, nseg * V)
-        present = jnp.zeros(nseg * V + 1, bool).at[flat].set(True)
-        card = present[: nseg * V].reshape(nseg, V).sum(axis=1, dtype=jnp.int32)
-        return {"card": card}
+        present = jnp.zeros(nseg * V + 1, bool).at[flat].set(True)[: nseg * V].reshape(nseg, V)
+        if ctx.sharded:
+            # bitmap (not a count) so shard partials union with OR; with
+            # shared global ordinals the union is exact across shards
+            return {"present": present}
+        return {"card": present.sum(axis=1, dtype=jnp.int32)}
 
     def finalize(self, out, nseg):
-        return [{"value": int(out["card"][i])} for i in range(nseg)]
+        if "card" in out:
+            card = np.asarray(out["card"])
+        else:
+            card = np.asarray(out["present"]).sum(axis=1)
+        return [{"value": int(card[i])} for i in range(nseg)]
 
 
 class PercentilesAgg(_FieldMetricAgg):
@@ -272,32 +324,49 @@ class PercentilesAgg(_FieldMetricAgg):
         col = pack.docvalues.get(self.fld)
         return {}, ("pct", self.fld, self.percents, col is None)
 
+    _MERGE_RULES = {"sorted": "concat_sorted", "n": "sum"}
+
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         if nseg != 1:
             raise IllegalArgumentError("percentiles under bucket aggs is not yet supported")
         got = _numeric_values(dev, self.fld, ctx)
         if got is None:
-            return {"q": jnp.full(len(self.percents), jnp.nan, jnp.float32), "n": jnp.int32(0)}
+            if ctx.sharded:
+                return {"sorted": jnp.full(1, jnp.inf, jnp.float32), "n": jnp.zeros((), jnp.int32)}
+            return {"q": jnp.full(len(self.percents), jnp.nan, jnp.float32), "n": jnp.zeros((), jnp.int32)}
         v, h, kind = got
         ok = valid & h
-        n = ok.sum()
-        vf = jnp.where(ok, v.astype(jnp.float32), jnp.inf)
-        s = jnp.sort(vf)
+        n = ok.sum().astype(jnp.int32)
+        # invalid slots float to the tail as +inf
+        s = jnp.sort(jnp.where(ok, v.astype(jnp.float32), jnp.inf))
+        if ctx.sharded:
+            # per-shard sorted partials merge by concatenation + resort
+            return {"sorted": s, "n": n}
+        # single shard: interpolate on device, ship only len(percents) floats
         qs = []
         for p in self.percents:
-            # linear interpolation on the sorted array, numpy 'linear' method
-            pos = (n - 1).astype(jnp.float32) * (p / 100.0)
-            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, None)
-            hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, None)
+            pos = jnp.maximum(n - 1, 0).astype(jnp.float32) * (p / 100.0)
+            lo = jnp.floor(pos).astype(jnp.int32)
+            hi = jnp.ceil(pos).astype(jnp.int32)
             frac = pos - lo.astype(jnp.float32)
             qs.append(s[lo] * (1 - frac) + s[hi] * frac)
         return {"q": jnp.stack(qs), "n": n}
 
     def finalize(self, out, nseg):
-        n = int(out["n"])
+        n = int(np.asarray(out["n"]))
+        if "q" in out:
+            qvals = np.asarray(out["q"])
+            pairs = zip(self.percents, qvals)
+            vals = {
+                (f"{p:g}" if p != int(p) else f"{p:.1f}"): (float(q) if n else None)
+                for p, q in pairs
+            }
+            return [{"values": vals}]
+        s = np.asarray(out["sorted"])[:n]
         vals = {}
-        for p, q in zip(self.percents, np.asarray(out["q"])):
-            vals[f"{p:g}" if p != int(p) else f"{p:.1f}"] = float(q) if n else None
+        for p in self.percents:
+            key = f"{p:g}" if p != int(p) else f"{p:.1f}"
+            vals[key] = float(np.percentile(s, p)) if n else None
         return [{"values": vals}]
 
 
@@ -322,6 +391,8 @@ class TermsAgg(AggNode):
     global-ordinal -> term resolution; default order _count desc, _key asc
     tiebreak, which top-index selection reproduces since ordinals sort
     lexicographically)."""
+
+    _MERGE_RULES = {"counts": "sum"}
 
     def __init__(self, name, fld, size=10, order=None, children=None, missing=None):
         super().__init__(name, children)
@@ -399,6 +470,8 @@ class TermsAgg(AggNode):
 class _BaseHistogramAgg(AggNode):
     """Shared fixed-interval bucketing: bucket = (v - offset)//interval,
     rebased by the column-min bucket; nb static from pack min/max."""
+
+    _MERGE_RULES = {"counts": "sum"}
 
     def __init__(self, name, fld, children=None, min_doc_count=None):
         super().__init__(name, children)
@@ -609,6 +682,19 @@ class RangeAgg(AggNode):
             )
         return {"ranges": outs}
 
+    def merge_partials(self, stacked):
+        return {
+            "ranges": [
+                {
+                    "count": np.asarray(o["count"]).sum(axis=0),
+                    "children": {
+                        n: c.merge_partials(o["children"][n]) for n, c in self.children.items()
+                    },
+                }
+                for o in stacked["ranges"]
+            ]
+        }
+
     def finalize(self, out, nseg):
         res = [{"buckets": {} if self.keyed else []} for _ in range(nseg)]
         for r, o in zip(self.ranges, out["ranges"]):
@@ -638,6 +724,8 @@ class RangeAgg(AggNode):
 
 class FilterAgg(AggNode):
     """Single-filter bucket (reference behavior: bucket/filter/FilterAggregator)."""
+
+    _MERGE_RULES = {"count": "sum"}
 
     def __init__(self, name, query_node, children=None):
         super().__init__(name, children)
@@ -684,6 +772,9 @@ class FiltersAgg(AggNode):
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         return {n: s.device_eval_segmented(dev, params[n], seg, nseg, valid, ctx) for n, s in self._subs.items()}
 
+    def merge_partials(self, stacked):
+        return {n: s.merge_partials(stacked[n]) for n, s in self._subs.items()}
+
     def finalize(self, out, nseg):
         res = [{"buckets": {}} for _ in range(nseg)]
         for n, s in self._subs.items():
@@ -694,6 +785,8 @@ class FiltersAgg(AggNode):
 
 
 class MissingAgg(AggNode):
+    _MERGE_RULES = {"count": "sum"}
+
     def __init__(self, name, fld, children=None):
         super().__init__(name, children)
         self.fld = fld
@@ -717,6 +810,8 @@ class MissingAgg(AggNode):
 class GlobalAgg(AggNode):
     """Ignores the query: buckets over all live docs (reference behavior:
     bucket/global/GlobalAggregator — only legal at top level)."""
+
+    _MERGE_RULES = {"count": "sum"}
 
     def prepare(self, pack, mappings):
         cparams, ckey = self._prepare_children(pack, mappings)

@@ -1,0 +1,3 @@
+from .routing import murmur3_32, shard_for_id
+
+__all__ = ["murmur3_32", "shard_for_id"]

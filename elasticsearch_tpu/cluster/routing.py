@@ -1,0 +1,57 @@
+"""Document -> shard routing.
+
+Parity target: the reference routes by Murmur3(routing_key) mod shards
+(reference behavior: cluster/routing/IndexRouting.java:132,
+Murmur3HashFunction). Same scheme here: murmur3 x86 32-bit over the UTF-8
+routing key, floor-mod number_of_shards, so a fixed corpus distributes
+identically across runs.
+"""
+
+from __future__ import annotations
+
+
+def _rotl32(x: int, r: int) -> int:
+    x &= 0xFFFFFFFF
+    return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86 32-bit, returns signed 32-bit int."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = _rotl32(k, 15)
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = _rotl32(k, 15)
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def shard_for_id(doc_id: str, num_shards: int) -> int:
+    # the reference hashes the id's UTF-16 code units little-endian
+    # (Murmur3HashFunction.hash(String): bytes[i*2]=c, bytes[i*2+1]=c>>>8),
+    # so encode utf-16-le for identical shard assignment
+    return murmur3_32(doc_id.encode("utf-16-le")) % num_shards

@@ -1,0 +1,4 @@
+from .stacked import StackedPack, build_stacked_pack
+from .sharded import StackedSearcher, make_mesh
+
+__all__ = ["StackedPack", "build_stacked_pack", "StackedSearcher", "make_mesh"]

@@ -1,0 +1,214 @@
+"""StackedPack: S shard packs fused into [S, ...] arrays for a device mesh.
+
+This is where the framework diverges hardest from the reference. The
+reference's shards are independent Lucene indexes on separate nodes with
+shard-local term dictionaries and ordinals, merged by string key at the
+coordinator (reference behavior: SearchPhaseController.java:232 top-docs
+merge; GlobalOrdinalsStringTermsAggregator + coordinator reduce for terms
+aggs). On a TPU slice all shards pack in one process, so we can afford
+**global dictionaries**: keyword ordinals, numeric uniq-ordinals, histogram
+bucket plans, and avgdl/docCount stats are shared across shards. Shard merge
+then degenerates to array reductions (sum/min/max/OR) instead of key-space
+remapping — the agg reduce rides ICI/host memcpy, not string hashing.
+
+Per-shard state that stays local: postings + term dictionary (each shard
+scores its own term blocks; per-shard df supports the reference's default
+query_then_fetch idf, global df supports dfs_query_then_fetch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..cluster.routing import shard_for_id
+from ..index.mappings import Mappings
+from ..index.pack import BLOCK, DocValuesColumn, PackBuilder, ShardPack, VectorColumn
+
+
+@dataclass
+class _ShardView:
+    """ShardPack facade handing global stats to query planning.
+
+    `term_blocks` resolves against the shard's own postings but reports the
+    global df; `field_stats` and `docvalues` come from the global (stacked)
+    dictionaries so every shard plans identical shapes and scores with
+    identical statistics. This is the reference's dfs_query_then_fetch
+    semantics (search/dfs/DfsPhase.java aggregates term/collection stats
+    before scoring) — the only sharded scoring mode here, chosen because
+    cross-shard-consistent scores are strictly more useful and global stats
+    are free when all shards pack in one process."""
+
+    pack: ShardPack
+    stacked: "StackedPack"
+
+    @property
+    def num_docs(self):
+        # padded width: dense accumulators must be the same size on every
+        # device of the mesh
+        return self.stacked.n_max
+
+    @property
+    def field_stats(self):
+        return self.stacked.field_stats
+
+    @property
+    def docvalues(self):
+        return self.stacked.global_docvalues
+
+    @property
+    def vectors(self):
+        return self.pack.vectors
+
+    @property
+    def norms(self):
+        return self.pack.norms
+
+    @property
+    def text_present(self):
+        return self.pack.text_present
+
+    def avgdl(self, fld):
+        st = self.stacked.field_stats.get(fld)
+        if not st or st["doc_count"] == 0:
+            return 1.0
+        return st["sum_dl"] / st["doc_count"]
+
+    def term_blocks(self, fld, term):
+        s, n, df = self.pack.term_blocks(fld, term)
+        return s, n, self.stacked.global_df.get((fld, term), df)
+
+
+class StackedPack:
+    def __init__(self, shards: list[ShardPack], mappings: Mappings):
+        self.shards = shards
+        self.mappings = mappings
+        self.S = len(shards)
+        self.n_max = max((p.num_docs for p in shards), default=0)
+        self.nb_max = max((p.num_blocks for p in shards), default=1)
+
+        # ---- global stats ------------------------------------------------
+        self.field_stats: dict[str, dict] = {}
+        for p in shards:
+            for fld, st in p.field_stats.items():
+                g = self.field_stats.setdefault(fld, {"sum_dl": 0.0, "doc_count": 0})
+                g["sum_dl"] += st["sum_dl"]
+                g["doc_count"] += st["doc_count"]
+        self.global_df: dict[tuple[str, str], int] = {}
+        for p in shards:
+            for key, tid in p.term_dict.items():
+                self.global_df[key] = self.global_df.get(key, 0) + int(p.term_df[tid])
+
+        # ---- global docvalue dictionaries + remapped columns -------------
+        # built as columns padded to n_max and stacked [S, n_max]
+        self.global_docvalues: dict[str, DocValuesColumn] = {}
+        self.stacked_docvalues: dict[str, DocValuesColumn] = {}
+        fields = sorted({f for p in shards for f in p.docvalues})
+        for fld in fields:
+            cols = [p.docvalues.get(fld) for p in shards]
+            kind = next(c.kind for c in cols if c is not None)
+            vals = []
+            has = []
+            if kind == "ord":
+                terms = sorted({t for c in cols if c and c.ord_terms for t in c.ord_terms})
+                ord_of = {t: i for i, t in enumerate(terms)}
+                for p, c in zip(shards, cols):
+                    v = np.full(self.n_max, -1, np.int32)
+                    h = np.zeros(self.n_max, bool)
+                    if c is not None:
+                        remap = np.array(
+                            [ord_of[t] for t in (c.ord_terms or [])] + [-1], np.int32
+                        )
+                        v[: p.num_docs] = remap[c.values]
+                        h[: p.num_docs] = c.has_value
+                    vals.append(v)
+                    has.append(h)
+                g = DocValuesColumn(kind, np.stack(vals), np.stack(has), terms)
+            else:
+                dtype = np.int64 if kind == "int" else np.float32
+                present_vals = [
+                    c.values[c.has_value] for c in cols if c is not None and c.has_value.any()
+                ]
+                allv = np.concatenate(present_vals) if present_vals else np.array([], dtype)
+                uniq = np.unique(allv) if kind == "int" else None
+                for p, c in zip(shards, cols):
+                    v = np.zeros(self.n_max, dtype)
+                    h = np.zeros(self.n_max, bool)
+                    if c is not None:
+                        v[: p.num_docs] = c.values
+                        h[: p.num_docs] = c.has_value
+                    vals.append(v)
+                    has.append(h)
+                g = DocValuesColumn(kind, np.stack(vals), np.stack(has))
+                if len(allv):
+                    g.vmin = allv.min().item()
+                    g.vmax = allv.max().item()
+                if kind == "int" and uniq is not None and len(uniq):
+                    g.uniq_values = uniq
+                    ords = []
+                    for p, c in zip(shards, cols):
+                        o = np.full(self.n_max, -1, np.int32)
+                        if c is not None and c.has_value.any():
+                            o[: p.num_docs][c.has_value] = np.searchsorted(
+                                uniq, c.values[c.has_value]
+                            ).astype(np.int32)
+                        ords.append(o)
+                    g.uniq_ords = np.stack(ords)
+            self.stacked_docvalues[fld] = g
+            # planning view: same dict/stats, values not used by prepare
+            self.global_docvalues[fld] = g
+
+        # ---- stacked postings & norms ------------------------------------
+        self.post_docids = np.full((self.S, self.nb_max, BLOCK), self.n_max, np.int32)
+        self.post_tfs = np.zeros((self.S, self.nb_max, BLOCK), np.float32)
+        self.live = np.zeros((self.S, self.n_max), bool)
+        for i, p in enumerate(shards):
+            d = p.post_docids.copy()
+            d[d == p.num_docs] = self.n_max  # re-sentinel padding to n_max
+            self.post_docids[i, : p.num_blocks] = d
+            self.post_tfs[i, : p.num_blocks] = p.post_tfs
+            self.live[i, : p.num_docs] = p.live
+        norm_fields = sorted({f for p in shards for f in p.norms})
+        self.norms = {}
+        self.text_present = {}
+        for fld in norm_fields:
+            arr = np.ones((self.S, self.n_max), np.float32)
+            pres = np.zeros((self.S, self.n_max), bool)
+            for i, p in enumerate(shards):
+                if fld in p.norms:
+                    arr[i, : p.num_docs] = p.norms[fld]
+                    pres[i, : p.num_docs] = p.text_present[fld]
+            self.norms[fld] = arr
+            self.text_present[fld] = pres
+        # ---- stacked vectors ---------------------------------------------
+        self.vectors: dict[str, VectorColumn] = {}
+        vec_fields = sorted({f for p in shards for f in p.vectors})
+        for fld in vec_fields:
+            vc0 = next(p.vectors[fld] for p in shards if fld in p.vectors)
+            vals = np.zeros((self.S, self.n_max, vc0.dims), np.float32)
+            has = np.zeros((self.S, self.n_max), bool)
+            for i, p in enumerate(shards):
+                if fld in p.vectors:
+                    vals[i, : p.num_docs] = p.vectors[fld].values
+                    has[i, : p.num_docs] = p.vectors[fld].has_value
+            self.vectors[fld] = VectorColumn(vals, has, vc0.similarity, vc0.dims)
+
+    @property
+    def num_docs(self) -> int:
+        return sum(p.num_docs for p in self.shards)
+
+    def shard_view(self, s: int) -> _ShardView:
+        return _ShardView(self.shards[s], self)
+
+
+def build_stacked_pack(
+    docs: list[tuple[str, dict]], mappings: Mappings, num_shards: int
+) -> StackedPack:
+    """Route (id, source) docs to shards (Murmur3 like the reference) and
+    pack each shard."""
+    builders = [PackBuilder(mappings) for _ in range(num_shards)]
+    for doc_id, source in docs:
+        s = shard_for_id(doc_id, num_shards)
+        builders[s].add_document(mappings.parse_document(source))
+    return StackedPack([b.build() for b in builders], mappings)

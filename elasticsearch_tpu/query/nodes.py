@@ -58,6 +58,9 @@ class ExecContext:
     has_norms: frozenset[str]
     k1: float = 1.2
     b: float = 0.75
+    # True when per-shard partials will be merged host-side: agg nodes then
+    # emit mergeable forms (bitmaps, sorted arrays) instead of final values
+    sharded: bool = False
 
 
 class QueryNode:
