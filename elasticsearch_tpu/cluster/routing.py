@@ -50,8 +50,26 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     return h - (1 << 32) if h >= (1 << 31) else h
 
 
-def shard_for_id(doc_id: str, num_shards: int) -> int:
+def default_routing_num_shards(num_shards: int) -> int:
+    """The reference over-partitions the hash space to allow index splitting:
+    routing shards default to num_shards * 2^k, maximized while <= 1024
+    (reference behavior: cluster/metadata/MetadataCreateIndexService
+    routing-shard calculation)."""
+    if num_shards >= 1024:
+        return num_shards
+    r = num_shards
+    while r * 2 <= 1024:
+        r *= 2
+    return r
+
+
+def shard_for_id(doc_id: str, num_shards: int, routing_num_shards: int | None = None) -> int:
     # the reference hashes the id's UTF-16 code units little-endian
-    # (Murmur3HashFunction.hash(String): bytes[i*2]=c, bytes[i*2+1]=c>>>8),
-    # so encode utf-16-le for identical shard assignment
-    return murmur3_32(doc_id.encode("utf-16-le")) % num_shards
+    # (Murmur3HashFunction.hash(String): bytes[i*2]=c, bytes[i*2+1]=c>>>8)
+    # then maps floorMod(hash, routing_num_shards) / routing_factor
+    # (IndexRouting.java:132)
+    if routing_num_shards is None:
+        routing_num_shards = default_routing_num_shards(num_shards)
+    routing_factor = routing_num_shards // num_shards
+    h = murmur3_32(doc_id.encode("utf-16-le"))
+    return (h % routing_num_shards) // routing_factor

@@ -1,0 +1,3 @@
+from .engine import Engine, EsIndex
+
+__all__ = ["Engine", "EsIndex"]

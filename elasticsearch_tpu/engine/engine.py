@@ -1,0 +1,354 @@
+"""Host-side engine: mutable document state around immutable device packs.
+
+The reference's per-shard engine is versioned CRUD over a Lucene IndexWriter
+with a translog WAL for durability between commits (reference behavior:
+index/engine/InternalEngine.java:1135 index() -> versioning -> Lucene write
+-> translog append :1223; index/translog/Translog.java; refresh makes writes
+searchable). The TPU design keeps the same contract with a different split:
+
+  - mutation lives entirely on host: an id -> (seq_no, version, source) map
+    (the LiveVersionMap analog, so GETs are realtime) + an append-only
+    JSON-lines WAL with fsync
+  - `refresh()` rebuilds the immutable stacked pack from live docs and ships
+    it to the mesh — the analog of reopening a Lucene searcher, except a
+    "segment" here is the whole HBM pack (incremental tail packs are a later
+    optimization; the contract — writes invisible until refresh — is the
+    same)
+  - restart recovery = WAL replay (the reference's translog recovery,
+    RecoverySourceHandler.java:318 phase2 analog for the local case)
+
+seq_nos are per index (the reference assigns per shard,
+index/seqno/LocalCheckpointTracker.java — a documented simplification).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..index.mappings import Mappings
+from ..parallel.sharded import StackedSearcher, make_mesh
+from ..parallel.stacked import StackedPack, build_stacked_pack
+from ..utils.errors import (
+    DocumentMissingError,
+    IndexAlreadyExistsError,
+    IndexNotFoundError,
+    VersionConflictError,
+    IllegalArgumentError,
+)
+
+_AUTO_ID_ALPHABET = "0123456789abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def _auto_id() -> str:
+    import secrets
+
+    return "".join(secrets.choice(_AUTO_ID_ALPHABET) for _ in range(20))
+
+
+@dataclass
+class _DocEntry:
+    source: dict
+    version: int
+    seq_no: int
+    alive: bool
+
+
+class EsIndex:
+    def __init__(self, name: str, mappings: Mappings, settings: dict, data_dir: str | None):
+        self.name = name
+        self.mappings = mappings
+        self.settings = {"number_of_shards": 1, "number_of_replicas": 0, "refresh_interval": "1s"}
+        self.settings.update(settings or {})
+        self.num_shards = int(self.settings["number_of_shards"])
+        if self.num_shards < 1:
+            raise IllegalArgumentError("number_of_shards must be >= 1")
+        self.docs: dict[str, _DocEntry] = {}
+        self.seq_no = 0
+        self.data_dir = data_dir
+        self._wal = None
+        self._dirty = True
+        self._last_refresh = 0.0
+        self.searcher: StackedSearcher | None = None
+        self.shard_docs: list[list[tuple[str, dict]]] = []
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._persist_meta()
+            self._wal = open(os.path.join(data_dir, "translog.log"), "a", encoding="utf-8")
+        # a new index is immediately searchable (as empty) — writes stay
+        # invisible until the next refresh, like a fresh Lucene reader
+        self.refresh()
+
+    # ---- durability ------------------------------------------------------
+
+    def _persist_meta(self):
+        if not self.data_dir:
+            return
+        with open(os.path.join(self.data_dir, "meta.json"), "w", encoding="utf-8") as f:
+            json.dump({"mappings": self.mappings.to_dict(), "settings": self.settings}, f)
+
+    def _wal_append(self, record: dict):
+        if self._wal is None:
+            return
+        self._wal.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+
+    @classmethod
+    def open(cls, name: str, data_dir: str) -> "EsIndex":
+        """Recover an index from disk: meta + WAL replay."""
+        with open(os.path.join(data_dir, "meta.json"), encoding="utf-8") as f:
+            meta = json.load(f)
+        idx = cls(name, Mappings(meta["mappings"]), meta["settings"], data_dir=None)
+        idx.data_dir = data_dir
+        wal_path = os.path.join(data_dir, "translog.log")
+        if os.path.exists(wal_path):
+            with open(wal_path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if rec["op"] == "index":
+                        idx.mappings.parse_document(rec["source"])  # re-grow dynamic mappings
+                        idx.docs[rec["id"]] = _DocEntry(
+                            rec["source"], rec["version"], rec["seq_no"], True
+                        )
+                    elif rec["op"] == "delete":
+                        e = idx.docs.get(rec["id"])
+                        if e is not None:
+                            e.alive = False
+                            e.version = rec["version"]
+                            e.seq_no = rec["seq_no"]
+                    idx.seq_no = max(idx.seq_no, rec["seq_no"] + 1)
+        idx._wal = open(wal_path, "a", encoding="utf-8")
+        # recovery refresh: replayed ops are searchable after restart, as
+        # after the reference's translog recovery
+        idx.refresh()
+        return idx
+
+    # ---- CRUD ------------------------------------------------------------
+
+    def index_doc(self, doc_id: str | None, source: dict, op_type: str = "index",
+                  if_seq_no: int | None = None, if_primary_term: int | None = None):
+        if doc_id is None:
+            doc_id = _auto_id()
+            op_type = "create"
+        existing = self.docs.get(doc_id)
+        if op_type == "create" and existing is not None and existing.alive:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, document already exists (current version [{existing.version}])"
+            )
+        if if_seq_no is not None:
+            cur = existing.seq_no if existing is not None else -1
+            if cur != if_seq_no:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], current [{cur}]"
+                )
+        # validate + grow dynamic mappings before accepting
+        n_fields = len(self.mappings.fields)
+        self.mappings.parse_document(source)
+        version = (existing.version + 1) if existing is not None else 1
+        seq = self.seq_no
+        self.seq_no += 1
+        self.docs[doc_id] = _DocEntry(source, version, seq, True)
+        self._wal_append({"op": "index", "id": doc_id, "source": source, "version": version, "seq_no": seq})
+        if len(self.mappings.fields) != n_fields:
+            self._persist_meta()  # dynamic mappings grew
+        self._dirty = True
+        created = existing is None or not existing.alive
+        return {"_id": doc_id, "_version": version, "_seq_no": seq,
+                "result": "created" if created else "updated"}
+
+    def delete_doc(self, doc_id: str):
+        e = self.docs.get(doc_id)
+        if e is None or not e.alive:
+            raise DocumentMissingError(f"[{doc_id}]: document missing", index=self.name)
+        e.alive = False
+        e.version += 1
+        e.seq_no = self.seq_no
+        self.seq_no += 1
+        self._wal_append({"op": "delete", "id": doc_id, "version": e.version, "seq_no": e.seq_no})
+        self._dirty = True
+        return {"_id": doc_id, "_version": e.version, "_seq_no": e.seq_no, "result": "deleted"}
+
+    def get_doc(self, doc_id: str):
+        """Realtime get from the version map (reference behavior:
+        action/get/TransportGetAction.java:55 realtime reads via
+        LiveVersionMap/translog, no refresh needed)."""
+        e = self.docs.get(doc_id)
+        if e is None or not e.alive:
+            return None
+        return {"_id": doc_id, "_version": e.version, "_seq_no": e.seq_no, "_source": e.source}
+
+    @property
+    def live_count(self) -> int:
+        return sum(1 for e in self.docs.values() if e.alive)
+
+    # ---- refresh / search ------------------------------------------------
+
+    def refresh(self, mesh=None):
+        live_docs = [(i, e.source) for i, e in self.docs.items() if e.alive]
+        sp = build_stacked_pack(live_docs, self.mappings, self.num_shards)
+        if mesh is None:
+            mesh = make_mesh(self.num_shards)
+        self.searcher = StackedSearcher(sp, mesh=mesh)
+        # point-in-time snapshot: (shard, local docid) -> (_id, source) in the
+        # builder's insertion order, so hits serve the _source that was
+        # actually matched (the analog of stored fields in a sealed segment)
+        from ..cluster.routing import shard_for_id
+
+        self.shard_docs: list[list[tuple[str, dict]]] = [[] for _ in range(self.num_shards)]
+        for doc_id, src in live_docs:
+            self.shard_docs[shard_for_id(doc_id, self.num_shards)].append((doc_id, src))
+        self._dirty = False
+        self._last_refresh = time.monotonic()
+
+    def _maybe_refresh(self):
+        if self.searcher is None:  # safety; construction always refreshes
+            self.refresh()
+            return
+        if not self._dirty:
+            return
+        from ..utils.durations import parse_duration_seconds
+
+        try:
+            secs = parse_duration_seconds(self.settings.get("refresh_interval", "1s"), 1.0)
+        except IllegalArgumentError:
+            secs = 1.0
+        if secs is None:  # "-1": only explicit refresh
+            return
+        if time.monotonic() - self._last_refresh >= secs:
+            self.refresh()
+
+    def search(self, query=None, size=10, from_=0, aggs=None):
+        self._maybe_refresh()
+        res = self.searcher.search(query, size=size, from_=from_, aggs=aggs)
+        hits = []
+        for s, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
+            doc_id, src = self.shard_docs[s][d]
+            hits.append(
+                {
+                    "_index": self.name,
+                    "_id": doc_id,
+                    "_score": float(score),
+                    "_source": src,
+                }
+            )
+        return {
+            "hits": {
+                "total": {"value": res.total, "relation": "eq"},
+                "max_score": res.max_score,
+                "hits": hits,
+            },
+            **({"aggregations": res.aggregations} if res.aggregations is not None else {}),
+        }
+
+    def count(self, query=None) -> int:
+        self._maybe_refresh()
+        return self.searcher.count(query)
+
+    def close(self):
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+
+class Engine:
+    """Multi-index node engine (the analog of the per-node IndicesService,
+    reference: indices/IndicesService registry of IndexShard instances)."""
+
+    def __init__(self, data_path: str | None = None):
+        self.data_path = data_path
+        self.indices: dict[str, EsIndex] = {}
+        if data_path:
+            os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
+            for name in sorted(os.listdir(os.path.join(data_path, "indices"))):
+                d = os.path.join(data_path, "indices", name)
+                if os.path.isdir(d) and os.path.exists(os.path.join(d, "meta.json")):
+                    self.indices[name] = EsIndex.open(name, d)
+
+    def _dir_for(self, name: str) -> str | None:
+        if not self.data_path:
+            return None
+        return os.path.join(self.data_path, "indices", name)
+
+    def create_index(self, name: str, mappings: dict | None = None, settings: dict | None = None) -> EsIndex:
+        if name in self.indices:
+            raise IndexAlreadyExistsError(name)
+        if not name or name != name.lower() or name.startswith(("_", "-", "+")):
+            raise IllegalArgumentError(f"invalid index name [{name}]")
+        idx = EsIndex(name, Mappings(mappings or {}), settings or {}, self._dir_for(name))
+        self.indices[name] = idx
+        return idx
+
+    def get_index(self, name: str) -> EsIndex:
+        idx = self.indices.get(name)
+        if idx is None:
+            raise IndexNotFoundError(name)
+        return idx
+
+    def get_or_autocreate(self, name: str) -> EsIndex:
+        """Auto-create on first write, like the reference's
+        action.auto_create_index default (TransportBulkAction auto-create)."""
+        if name not in self.indices:
+            return self.create_index(name)
+        return self.indices[name]
+
+    def delete_index(self, name: str):
+        idx = self.get_index(name)
+        idx.close()
+        del self.indices[name]
+        d = self._dir_for(name)
+        if d and os.path.isdir(d):
+            import shutil
+
+            shutil.rmtree(d)
+
+    def bulk(self, operations: list[tuple[str, str, str | None, dict | None]]):
+        """operations: (action, index, id, source). Returns per-item results;
+        failures are per-item, not transactional (reference behavior:
+        TransportShardBulkAction.java:308 executeBulkItemRequest)."""
+        items = []
+        errors = False
+        for action, index_name, doc_id, source in operations:
+            try:
+                idx = self.get_or_autocreate(index_name)
+                if action in ("index", "create"):
+                    r = idx.index_doc(doc_id, source, op_type=action)
+                    status = 201 if r["result"] == "created" else 200
+                    items.append({action: {"_index": index_name, **r, "status": status}})
+                elif action == "delete":
+                    r = idx.delete_doc(doc_id)
+                    items.append({action: {"_index": index_name, **r, "status": 200}})
+                elif action == "update":
+                    if not isinstance(source, dict) or not isinstance(source.get("doc"), dict):
+                        raise IllegalArgumentError("update action requires a [doc] object")
+                    e = idx.docs.get(doc_id)
+                    if e is None or not e.alive:
+                        raise DocumentMissingError(f"[{doc_id}]: document missing")
+                    merged = {**e.source, **source["doc"]}
+                    r = idx.index_doc(doc_id, merged)
+                    items.append({action: {"_index": index_name, **r, "status": 200}})
+                else:
+                    raise IllegalArgumentError(f"unknown bulk action [{action}]")
+            except Exception as ex:  # per-item error envelope
+                errors = True
+                from ..utils.errors import ElasticsearchTpuError
+
+                if isinstance(ex, ElasticsearchTpuError):
+                    err = {"type": ex.type, "reason": ex.reason}
+                    status = ex.status
+                else:
+                    err = {"type": "exception", "reason": str(ex)}
+                    status = 500
+                items.append(
+                    {action: {"_index": index_name, "_id": doc_id, "status": status, "error": err}}
+                )
+        return {"errors": errors, "items": items}
+
+    def close(self):
+        for idx in self.indices.values():
+            idx.close()

@@ -1,0 +1,236 @@
+"""Engine tests: CRUD, versioning, WAL recovery, refresh visibility."""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import (
+    DocumentMissingError,
+    IndexAlreadyExistsError,
+    IndexNotFoundError,
+    VersionConflictError,
+    IllegalArgumentError,
+)
+
+
+@pytest.fixture
+def engine(tmp_path):
+    e = Engine(str(tmp_path))
+    yield e
+    e.close()
+
+
+def test_create_index_and_document_crud(engine):
+    idx = engine.create_index("logs", {"properties": {"msg": {"type": "text"}}})
+    r = idx.index_doc("1", {"msg": "hello world"})
+    assert r["result"] == "created" and r["_version"] == 1 and r["_seq_no"] == 0
+    got = idx.get_doc("1")
+    assert got["_source"] == {"msg": "hello world"}
+    r2 = idx.index_doc("1", {"msg": "hello again"})
+    assert r2["result"] == "updated" and r2["_version"] == 2
+    rd = idx.delete_doc("1")
+    assert rd["result"] == "deleted" and rd["_version"] == 3
+    assert idx.get_doc("1") is None
+
+
+def test_create_conflict(engine):
+    idx = engine.create_index("i")
+    idx.index_doc("1", {"a": 1})
+    with pytest.raises(VersionConflictError):
+        idx.index_doc("1", {"a": 2}, op_type="create")
+
+
+def test_if_seq_no_conflict(engine):
+    idx = engine.create_index("i")
+    r = idx.index_doc("1", {"a": 1})
+    idx.index_doc("1", {"a": 2})
+    with pytest.raises(VersionConflictError):
+        idx.index_doc("1", {"a": 3}, if_seq_no=r["_seq_no"])
+
+
+def test_delete_missing(engine):
+    idx = engine.create_index("i")
+    with pytest.raises(DocumentMissingError):
+        idx.delete_doc("nope")
+
+
+def test_index_name_validation(engine):
+    for bad in ("_x", "-x", "UPPER", ""):
+        with pytest.raises(IllegalArgumentError):
+            engine.create_index(bad)
+    with pytest.raises(IndexNotFoundError):
+        engine.get_index("missing")
+    engine.create_index("ok")
+    with pytest.raises(IndexAlreadyExistsError):
+        engine.create_index("ok")
+
+
+def test_refresh_visibility(engine):
+    idx = engine.create_index("i", settings={"refresh_interval": "-1"})
+    idx.index_doc("1", {"msg": "findme"})
+    idx.refresh()
+    assert idx.search({"match": {"msg": "findme"}})["hits"]["total"]["value"] == 1
+    idx.index_doc("2", {"msg": "findme too"})
+    # not refreshed: still 1 visible
+    assert idx.search({"match": {"msg": "findme"}})["hits"]["total"]["value"] == 1
+    idx.refresh()
+    assert idx.search({"match": {"msg": "findme"}})["hits"]["total"]["value"] == 2
+
+
+def test_delete_then_search(engine):
+    idx = engine.create_index("i", settings={"refresh_interval": "-1"})
+    idx.index_doc("1", {"msg": "target"})
+    idx.index_doc("2", {"msg": "target"})
+    idx.refresh()
+    idx.delete_doc("1")
+    idx.refresh()
+    res = idx.search({"match": {"msg": "target"}})
+    assert res["hits"]["total"]["value"] == 1
+    assert res["hits"]["hits"][0]["_id"] == "2"
+
+
+def test_search_hits_shape(engine):
+    idx = engine.create_index("i")
+    idx.index_doc("a", {"title": "quick brown fox", "n": 1})
+    idx.index_doc("b", {"title": "lazy dog", "n": 2})
+    idx.refresh()
+    res = idx.search({"match": {"title": "fox"}})
+    h = res["hits"]["hits"][0]
+    assert h["_id"] == "a" and h["_index"] == "i"
+    assert h["_source"]["title"] == "quick brown fox"
+    assert res["hits"]["max_score"] == pytest.approx(h["_score"])
+
+
+def test_wal_recovery(tmp_path):
+    e = Engine(str(tmp_path))
+    idx = e.create_index("logs", {"properties": {"msg": {"type": "text"}}})
+    idx.index_doc("1", {"msg": "persisted"})
+    idx.index_doc("2", {"msg": "deleted later"})
+    idx.delete_doc("2")
+    idx.index_doc("3", {"msg": "persisted too", "n": 42})
+    e.close()
+
+    e2 = Engine(str(tmp_path))
+    idx2 = e2.get_index("logs")
+    assert idx2.get_doc("1")["_source"] == {"msg": "persisted"}
+    assert idx2.get_doc("2") is None
+    assert idx2.get_doc("3")["_version"] == 1
+    assert idx2.seq_no == 4
+    # dynamic mapping for "n" regrown on replay
+    assert idx2.mappings.fields["n"].type == "long"
+    idx2.refresh()
+    assert idx2.search({"match": {"msg": "persisted"}})["hits"]["total"]["value"] == 2
+    # versions continue after recovery
+    r = idx2.index_doc("1", {"msg": "updated"})
+    assert r["_version"] == 2 and r["_seq_no"] == 4
+    e2.close()
+
+
+def test_bulk(engine):
+    res = engine.bulk(
+        [
+            ("index", "b", "1", {"x": 1}),
+            ("index", "b", "2", {"x": 2}),
+            ("create", "b", "1", {"x": 9}),  # conflict
+            ("delete", "b", "2", None),
+            ("update", "b", "1", {"doc": {"y": 5}}),
+            ("delete", "b", "404", None),  # missing
+        ]
+    )
+    assert res["errors"] is True
+    items = res["items"]
+    assert items[0]["index"]["status"] == 201
+    assert items[2]["create"]["status"] == 409
+    assert items[3]["delete"]["status"] == 200
+    assert items[4]["update"]["status"] == 200
+    assert items[5]["delete"]["status"] == 404
+    idx = engine.get_index("b")
+    assert idx.get_doc("1")["_source"] == {"x": 1, "y": 5}
+
+
+def test_bulk_auto_id(engine):
+    res = engine.bulk([("index", "auto", None, {"x": 1})])
+    item = res["items"][0]["index"]
+    assert item["status"] == 201 and len(item["_id"]) == 20
+
+
+def test_multi_shard_index(engine):
+    idx = engine.create_index("sharded", settings={"number_of_shards": 4, "refresh_interval": "-1"})
+    for i in range(50):
+        idx.index_doc(f"d{i}", {"msg": f"common word{i % 5}"})
+    idx.refresh()
+    res = idx.search({"match": {"msg": "common"}}, size=50)
+    assert res["hits"]["total"]["value"] == 50
+    ids = {h["_id"] for h in res["hits"]["hits"]}
+    assert len(ids) == 50  # id resolution across shards is unique/correct
+
+
+def test_delete_index(tmp_path):
+    e = Engine(str(tmp_path))
+    e.create_index("gone").index_doc("1", {"a": 1})
+    e.delete_index("gone")
+    assert not os.path.exists(os.path.join(str(tmp_path), "indices", "gone"))
+    with pytest.raises(IndexNotFoundError):
+        e.get_index("gone")
+    e.close()
+
+
+def test_count_and_aggs_through_engine(engine):
+    idx = engine.create_index("m", settings={"refresh_interval": "-1"})
+    for i in range(10):
+        idx.index_doc(str(i), {"k": "even" if i % 2 == 0 else "odd", "v": i})
+    idx.refresh()
+    assert idx.count({"term": {"k": "even"}}) == 5
+    res = idx.search(None, size=0, aggs={"by_k": {"terms": {"field": "k.keyword"}}})
+    assert {b["key"]: b["doc_count"] for b in res["aggregations"]["by_k"]["buckets"]} == {
+        "even": 5,
+        "odd": 5,
+    }
+
+
+def test_unrefreshed_index_invisible_even_first_search(engine):
+    idx = engine.create_index("fresh", settings={"refresh_interval": "-1"})
+    idx.index_doc("1", {"msg": "hidden"})
+    assert idx.search({"match": {"msg": "hidden"}})["hits"]["total"]["value"] == 0
+    idx.refresh()
+    assert idx.search({"match": {"msg": "hidden"}})["hits"]["total"]["value"] == 1
+
+
+def test_point_in_time_source_snapshot(engine):
+    idx = engine.create_index("pit", settings={"refresh_interval": "-1"})
+    idx.index_doc("1", {"body": "hello unique"})
+    idx.refresh()
+    idx.index_doc("1", {"body": "totally different now"})
+    res = idx.search({"match": {"body": "hello"}})
+    assert res["hits"]["total"]["value"] == 1
+    # matched against old pack -> serves the matched (old) source
+    assert res["hits"]["hits"][0]["_source"] == {"body": "hello unique"}
+
+
+def test_refresh_interval_parsing():
+    from elasticsearch_tpu.utils.durations import parse_duration_seconds
+
+    assert parse_duration_seconds("500ms") == 0.5
+    assert parse_duration_seconds("30m") == 1800.0
+    assert parse_duration_seconds("1h") == 3600.0
+    assert parse_duration_seconds("-1") is None
+    assert parse_duration_seconds(2000) == 2.0
+
+
+def test_bulk_update_without_doc_is_400(engine):
+    res = engine.bulk([("index", "u", "1", {"a": 1}), ("update", "u", "1", None)])
+    assert res["items"][1]["update"]["status"] == 400
+
+
+def test_routing_factor_semantics():
+    from elasticsearch_tpu.cluster.routing import default_routing_num_shards, shard_for_id, murmur3_32
+
+    assert default_routing_num_shards(8) == 1024
+    assert default_routing_num_shards(5) == 640
+    assert default_routing_num_shards(1) == 1024
+    # golden regression anchors for the utf-16-le + floor-mod path
+    assert murmur3_32("abc".encode("utf-16-le")) == 1118836419
+    assert murmur3_32("doc-0".encode("utf-16-le")) == 1609172137
+    h = murmur3_32("doc-0".encode("utf-16-le"))
+    assert shard_for_id("doc-0", 8) == (h % 1024) // 128
