@@ -70,6 +70,11 @@ def shard_for_id(doc_id: str, num_shards: int, routing_num_shards: int | None = 
     # (IndexRouting.java:132)
     if routing_num_shards is None:
         routing_num_shards = default_routing_num_shards(num_shards)
+    if routing_num_shards < num_shards or routing_num_shards % num_shards != 0:
+        raise ValueError(
+            f"routing_num_shards [{routing_num_shards}] must be a multiple of "
+            f"num_shards [{num_shards}]"
+        )
     routing_factor = routing_num_shards // num_shards
     h = murmur3_32(doc_id.encode("utf-16-le"))
     return (h % routing_num_shards) // routing_factor

@@ -30,7 +30,6 @@ from dataclasses import dataclass, field
 
 from ..index.mappings import Mappings
 from ..parallel.sharded import StackedSearcher, make_mesh
-from ..parallel.stacked import StackedPack, build_stacked_pack
 from ..utils.errors import (
     DocumentMissingError,
     IndexAlreadyExistsError,
@@ -57,7 +56,14 @@ class _DocEntry:
 
 
 class EsIndex:
-    def __init__(self, name: str, mappings: Mappings, settings: dict, data_dir: str | None):
+    def __init__(
+        self,
+        name: str,
+        mappings: Mappings,
+        settings: dict,
+        data_dir: str | None,
+        _recovering: bool = False,
+    ):
         self.name = name
         self.mappings = mappings
         self.settings = {"number_of_shards": 1, "number_of_replicas": 0, "refresh_interval": "1s"}
@@ -67,6 +73,7 @@ class EsIndex:
             raise IllegalArgumentError("number_of_shards must be >= 1")
         self.docs: dict[str, _DocEntry] = {}
         self.seq_no = 0
+        self.primary_term = 1
         self.data_dir = data_dir
         self._wal = None
         self._dirty = True
@@ -77,9 +84,10 @@ class EsIndex:
             os.makedirs(data_dir, exist_ok=True)
             self._persist_meta()
             self._wal = open(os.path.join(data_dir, "translog.log"), "a", encoding="utf-8")
-        # a new index is immediately searchable (as empty) — writes stay
-        # invisible until the next refresh, like a fresh Lucene reader
-        self.refresh()
+        if not _recovering:
+            # a new index is immediately searchable (as empty) — writes stay
+            # invisible until the next refresh, like a fresh Lucene reader
+            self.refresh()
 
     # ---- durability ------------------------------------------------------
 
@@ -96,13 +104,53 @@ class EsIndex:
         self._wal.flush()
         os.fsync(self._wal.fileno())
 
+    def flush(self):
+        """Commit: snapshot live state + truncate the WAL + purge tombstones
+        (the analog of a Lucene commit followed by translog generation
+        rollover, index/translog/Translog.java trimUnreferencedReaders)."""
+        if not self.data_dir:
+            # purely in-memory index: just drop tombstones
+            self.docs = {i: e for i, e in self.docs.items() if e.alive}
+            return
+        snap_tmp = os.path.join(self.data_dir, "commit.json.tmp")
+        snap = os.path.join(self.data_dir, "commit.json")
+        with open(snap_tmp, "w", encoding="utf-8") as f:
+            state = {
+                "seq_no": self.seq_no,
+                "docs": [
+                    {"id": i, "source": e.source, "version": e.version, "seq_no": e.seq_no}
+                    for i, e in self.docs.items()
+                    if e.alive
+                ],
+            }
+            json.dump(state, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(snap_tmp, snap)
+        # tombstones are durably superseded by the commit; purge them
+        self.docs = {i: e for i, e in self.docs.items() if e.alive}
+        if self._wal is not None:
+            self._wal.close()
+        wal_path = os.path.join(self.data_dir, "translog.log")
+        self._wal = open(wal_path, "w", encoding="utf-8")
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+
     @classmethod
     def open(cls, name: str, data_dir: str) -> "EsIndex":
-        """Recover an index from disk: meta + WAL replay."""
+        """Recover an index from disk: commit snapshot + WAL replay."""
         with open(os.path.join(data_dir, "meta.json"), encoding="utf-8") as f:
             meta = json.load(f)
-        idx = cls(name, Mappings(meta["mappings"]), meta["settings"], data_dir=None)
+        idx = cls(name, Mappings(meta["mappings"]), meta["settings"], data_dir=None, _recovering=True)
         idx.data_dir = data_dir
+        snap_path = os.path.join(data_dir, "commit.json")
+        if os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                state = json.load(f)
+            idx.seq_no = state["seq_no"]
+            for d in state["docs"]:
+                idx.mappings.parse_document(d["source"])
+                idx.docs[d["id"]] = _DocEntry(d["source"], d["version"], d["seq_no"], True)
         wal_path = os.path.join(data_dir, "translog.log")
         if os.path.exists(wal_path):
             with open(wal_path, encoding="utf-8") as f:
@@ -141,18 +189,28 @@ class EsIndex:
             raise VersionConflictError(
                 f"[{doc_id}]: version conflict, document already exists (current version [{existing.version}])"
             )
+        if (if_seq_no is None) != (if_primary_term is None):
+            raise IllegalArgumentError(
+                "if_seq_no and if_primary_term must be provided together"
+            )
         if if_seq_no is not None:
             cur = existing.seq_no if existing is not None else -1
-            if cur != if_seq_no:
+            if cur != if_seq_no or if_primary_term != self.primary_term:
                 raise VersionConflictError(
-                    f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], current [{cur}]"
+                    f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}] and "
+                    f"primary term [{if_primary_term}], current seqNo [{cur}] and "
+                    f"term [{self.primary_term}]"
                 )
-        # validate + grow dynamic mappings before accepting
+        # validate + grow dynamic mappings before accepting; snapshot the
+        # source through its WAL serialization so later caller mutation
+        # cannot diverge memory state from the durable log
         n_fields = len(self.mappings.fields)
         self.mappings.parse_document(source)
         version = (existing.version + 1) if existing is not None else 1
         seq = self.seq_no
         self.seq_no += 1
+        src_json = json.dumps(source, separators=(",", ":"))
+        source = json.loads(src_json)
         self.docs[doc_id] = _DocEntry(source, version, seq, True)
         self._wal_append({"op": "index", "id": doc_id, "source": source, "version": version, "seq_no": seq})
         if len(self.mappings.fields) != n_fields:
@@ -190,19 +248,18 @@ class EsIndex:
     # ---- refresh / search ------------------------------------------------
 
     def refresh(self, mesh=None):
+        from ..parallel.stacked import build_stacked_pack_routed, route_docs
+
         live_docs = [(i, e.source) for i, e in self.docs.items() if e.alive]
-        sp = build_stacked_pack(live_docs, self.mappings, self.num_shards)
+        # one routing pass: the same per-shard (id, source) lists drive both
+        # pack building and hit-id resolution, and double as the point-in-time
+        # _source snapshot (the analog of stored fields in a sealed segment)
+        routed = route_docs(live_docs, self.num_shards)
+        sp = build_stacked_pack_routed(routed, self.mappings)
         if mesh is None:
             mesh = make_mesh(self.num_shards)
         self.searcher = StackedSearcher(sp, mesh=mesh)
-        # point-in-time snapshot: (shard, local docid) -> (_id, source) in the
-        # builder's insertion order, so hits serve the _source that was
-        # actually matched (the analog of stored fields in a sealed segment)
-        from ..cluster.routing import shard_for_id
-
-        self.shard_docs: list[list[tuple[str, dict]]] = [[] for _ in range(self.num_shards)]
-        for doc_id, src in live_docs:
-            self.shard_docs[shard_for_id(doc_id, self.num_shards)].append((doc_id, src))
+        self.shard_docs = routed
         self._dirty = False
         self._last_refresh = time.monotonic()
 

@@ -202,13 +202,31 @@ class StackedPack:
         return _ShardView(self.shards[s], self)
 
 
+def route_docs(
+    docs: list[tuple[str, dict]], num_shards: int
+) -> list[list[tuple[str, dict]]]:
+    """Murmur3-route (id, source) docs to per-shard lists — the single
+    source of truth for doc->shard placement; pack building and hit-id
+    resolution both consume this."""
+    routed: list[list[tuple[str, dict]]] = [[] for _ in range(num_shards)]
+    for doc_id, source in docs:
+        routed[shard_for_id(doc_id, num_shards)].append((doc_id, source))
+    return routed
+
+
+def build_stacked_pack_routed(
+    routed: list[list[tuple[str, dict]]], mappings: Mappings
+) -> StackedPack:
+    builders = [PackBuilder(mappings) for _ in range(len(routed))]
+    for b, shard_docs in zip(builders, routed):
+        for _, source in shard_docs:
+            b.add_document(mappings.parse_document(source))
+    return StackedPack([b.build() for b in builders], mappings)
+
+
 def build_stacked_pack(
     docs: list[tuple[str, dict]], mappings: Mappings, num_shards: int
 ) -> StackedPack:
     """Route (id, source) docs to shards (Murmur3 like the reference) and
     pack each shard."""
-    builders = [PackBuilder(mappings) for _ in range(num_shards)]
-    for doc_id, source in docs:
-        s = shard_for_id(doc_id, num_shards)
-        builders[s].add_document(mappings.parse_document(source))
-    return StackedPack([b.build() for b in builders], mappings)
+    return build_stacked_pack_routed(route_docs(docs, num_shards), mappings)

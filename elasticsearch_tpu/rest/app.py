@@ -1,0 +1,456 @@
+"""REST API layer (aiohttp): the Elasticsearch HTTP contract.
+
+Endpoint shapes follow the reference's API specs (reference:
+rest-api-spec/src/main/resources/rest-api-spec/api/*.json — search.json,
+bulk.json, index.json, indices.create.json, count.json, msearch.json, … —
+and handler routing in rest/RestController.java:326). Engine work runs on a
+single-thread executor so the event loop stays responsive and engine state
+is accessed serially (the write path of the reference is likewise
+single-writer per shard via operation permits, index/shard/IndexShard.java).
+
+Error envelope parity: {"error": {"type", "reason", ...}, "status": N}
+(reference behavior: ElasticsearchException REST rendering).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+from aiohttp import web
+
+from .. import __version__
+from ..engine import Engine
+from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
+
+JSON = "application/json"
+
+
+def _err_response(ex: Exception) -> web.Response:
+    if isinstance(ex, ElasticsearchTpuError):
+        body = ex.to_dict()
+        status = ex.status
+    else:
+        body = {"error": {"type": "exception", "reason": str(ex)}, "status": 500}
+        status = 500
+    return web.json_response(body, status=status)
+
+
+def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.Application:
+    engine = engine or Engine(data_path)
+    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app["engine"] = engine
+    # single-thread executor: serializes engine mutation, keeps the loop free
+    app["pool"] = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
+
+    async def call(fn, *args, **kwargs):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(app["pool"], lambda: fn(*args, **kwargs))
+
+    def handler(fn):
+        async def wrapped(request: web.Request):
+            try:
+                return await fn(request)
+            except ElasticsearchTpuError as ex:
+                return _err_response(ex)
+            except json.JSONDecodeError as ex:
+                return _err_response(IllegalArgumentError(f"failed to parse request body: {ex}"))
+            except Exception as ex:  # noqa: BLE001 - error envelope boundary
+                return _err_response(ex)
+
+        return wrapped
+
+    async def body_json(request, default=None):
+        raw = await request.read()
+        if not raw:
+            return default
+        return json.loads(raw)
+
+    # ---- root / info -----------------------------------------------------
+
+    @handler
+    async def root(request):
+        return web.json_response(
+            {
+                "name": "elasticsearch-tpu",
+                "cluster_name": "elasticsearch-tpu",
+                "version": {
+                    "number": "8.14.0",
+                    "build_flavor": "tpu",
+                    "framework_version": __version__,
+                    "lucene_version": "none (blocked-CSR HBM packs)",
+                },
+                "tagline": "You Know, for Search (on TPUs)",
+            }
+        )
+
+    # ---- index management ------------------------------------------------
+
+    @handler
+    async def create_index(request):
+        name = request.match_info["index"]
+        body = await body_json(request, {}) or {}
+        mappings = body.get("mappings")
+        settings = body.get("settings", {})
+        if "index" in settings:
+            settings = {**settings, **settings.pop("index")}
+        await call(engine.create_index, name, mappings, settings)
+        return web.json_response({"acknowledged": True, "shards_acknowledged": True, "index": name})
+
+    @handler
+    async def delete_index(request):
+        await call(engine.delete_index, request.match_info["index"])
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def get_index(request):
+        idx = engine.get_index(request.match_info["index"])
+        return web.json_response(
+            {
+                idx.name: {
+                    "aliases": {},
+                    "mappings": idx.mappings.to_dict(),
+                    "settings": {"index": {k: str(v) for k, v in idx.settings.items()}},
+                }
+            }
+        )
+
+    @handler
+    async def head_index(request):
+        if request.match_info["index"] in engine.indices:
+            return web.Response(status=200)
+        return web.Response(status=404)
+
+    @handler
+    async def get_mapping(request):
+        idx = engine.get_index(request.match_info["index"])
+        return web.json_response({idx.name: {"mappings": idx.mappings.to_dict()}})
+
+    @handler
+    async def put_mapping(request):
+        idx = engine.get_index(request.match_info["index"])
+        body = await body_json(request, {}) or {}
+        await call(idx.mappings.merge, body)
+        idx._persist_meta()
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def refresh_index(request):
+        name = request.match_info.get("index")
+        targets = [engine.get_index(name)] if name else list(engine.indices.values())
+        for idx in targets:
+            await call(idx.refresh)
+        n = len(targets)
+        return web.json_response({"_shards": {"total": n, "successful": n, "failed": 0}})
+
+    @handler
+    async def flush_index(request):
+        idx = engine.get_index(request.match_info["index"])
+        await call(idx.flush)
+        return web.json_response({"_shards": {"total": 1, "successful": 1, "failed": 0}})
+
+    # ---- documents -------------------------------------------------------
+
+    def _doc_result(r, index_name):
+        return {
+            "_index": index_name,
+            "_id": r["_id"],
+            "_version": r["_version"],
+            "_seq_no": r["_seq_no"],
+            "_primary_term": 1,
+            "result": r["result"],
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    @handler
+    async def put_doc(request):
+        name = request.match_info["index"]
+        doc_id = request.match_info.get("id")
+        body = await body_json(request)
+        if not isinstance(body, dict):
+            raise IllegalArgumentError("request body is required")
+        op_type = request.query.get("op_type", "index")
+        idx = await call(engine.get_or_autocreate, name)
+        r = await call(idx.index_doc, doc_id, body, op_type)
+        status = 201 if r["result"] == "created" else 200
+        return web.json_response(_doc_result(r, name), status=status)
+
+    @handler
+    async def create_doc(request):
+        name = request.match_info["index"]
+        doc_id = request.match_info["id"]
+        body = await body_json(request)
+        if not isinstance(body, dict):
+            raise IllegalArgumentError("request body is required")
+        idx = await call(engine.get_or_autocreate, name)
+        r = await call(idx.index_doc, doc_id, body, "create")
+        return web.json_response(_doc_result(r, name), status=201)
+
+    @handler
+    async def get_doc(request):
+        idx = engine.get_index(request.match_info["index"])
+        got = idx.get_doc(request.match_info["id"])
+        if got is None:
+            return web.json_response(
+                {"_index": idx.name, "_id": request.match_info["id"], "found": False},
+                status=404,
+            )
+        return web.json_response({"_index": idx.name, "found": True, **got})
+
+    @handler
+    async def head_doc(request):
+        idx = engine.get_index(request.match_info["index"])
+        return web.Response(status=200 if idx.get_doc(request.match_info["id"]) else 404)
+
+    @handler
+    async def get_source(request):
+        idx = engine.get_index(request.match_info["index"])
+        got = idx.get_doc(request.match_info["id"])
+        if got is None:
+            return web.json_response(
+                {"error": {"type": "resource_not_found_exception"}, "status": 404}, status=404
+            )
+        return web.json_response(got["_source"])
+
+    @handler
+    async def delete_doc(request):
+        name = request.match_info["index"]
+        idx = engine.get_index(name)
+        r = await call(idx.delete_doc, request.match_info["id"])
+        return web.json_response({**_doc_result(r, name), "result": "deleted"})
+
+    @handler
+    async def update_doc(request):
+        name = request.match_info["index"]
+        body = await body_json(request, {}) or {}
+        res = await call(
+            engine.bulk, [("update", name, request.match_info["id"], body)]
+        )
+        item = res["items"][0]["update"]
+        if "error" in item:
+            return web.json_response(
+                {"error": item["error"], "status": item["status"]}, status=item["status"]
+            )
+        return web.json_response(_doc_result(item, name))
+
+    # ---- bulk ------------------------------------------------------------
+
+    @handler
+    async def bulk(request):
+        default_index = request.match_info.get("index")
+        raw = (await request.read()).decode("utf-8")
+        ops = []
+        lines = [ln for ln in raw.split("\n")]
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            i += 1
+            if not line:
+                continue
+            action_line = json.loads(line)
+            (action, meta), = action_line.items()
+            if action not in ("index", "create", "delete", "update"):
+                raise IllegalArgumentError(f"Malformed action/metadata line: unknown action [{action}]")
+            index_name = meta.get("_index", default_index)
+            if not index_name:
+                raise IllegalArgumentError("bulk item missing _index")
+            doc_id = meta.get("_id")
+            source = None
+            if action != "delete":
+                while i < len(lines) and not lines[i].strip():
+                    i += 1
+                if i >= len(lines):
+                    raise IllegalArgumentError("bulk action missing source line")
+                source = json.loads(lines[i])
+                i += 1
+            ops.append((action, index_name, doc_id, source))
+        import time
+
+        t0 = time.monotonic()
+        res = await call(engine.bulk, ops)
+        res["took"] = int((time.monotonic() - t0) * 1000)
+        return web.json_response(res)
+
+    # ---- search ----------------------------------------------------------
+
+    def _search_index(request):
+        name = request.match_info.get("index")
+        if not name or name in ("_all", "*"):
+            names = list(engine.indices)
+            if len(names) != 1:
+                raise IllegalArgumentError(
+                    "multi-index search requires a single concrete index in this version"
+                )
+            name = names[0]
+        return engine.get_index(name)
+
+    async def _run_search(idx, body, query_params):
+        body = body or {}
+        query = body.get("query")
+        size = int(query_params.get("size", body.get("size", 10)))
+        from_ = int(query_params.get("from", body.get("from", 0)))
+        aggs = body.get("aggs") or body.get("aggregations")
+        import time
+
+        t0 = time.monotonic()
+        res = await call(idx.search, query, size, from_, aggs)
+        took = int((time.monotonic() - t0) * 1000)
+        src_filter = body.get("_source")
+        if src_filter is False:
+            for h in res["hits"]["hits"]:
+                h.pop("_source", None)
+        elif isinstance(src_filter, (list, str)):
+            wanted = [src_filter] if isinstance(src_filter, str) else src_filter
+            for h in res["hits"]["hits"]:
+                h["_source"] = {k: v for k, v in h["_source"].items() if k in wanted}
+        return {
+            "took": took,
+            "timed_out": False,
+            "_shards": {
+                "total": idx.num_shards,
+                "successful": idx.num_shards,
+                "skipped": 0,
+                "failed": 0,
+            },
+            **res,
+        }
+
+    @handler
+    async def search(request):
+        idx = _search_index(request)
+        body = await body_json(request, {})
+        return web.json_response(await _run_search(idx, body, request.query))
+
+    @handler
+    async def msearch(request):
+        raw = (await request.read()).decode("utf-8")
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        if len(lines) % 2 != 0:
+            raise IllegalArgumentError("msearch body must be header/body line pairs")
+        responses = []
+        for i in range(0, len(lines), 2):
+            header = json.loads(lines[i])
+            body = json.loads(lines[i + 1])
+            name = header.get("index", request.match_info.get("index"))
+            try:
+                idx = engine.get_index(name) if name else _search_index(request)
+                responses.append({**(await _run_search(idx, body, {})), "status": 200})
+            except ElasticsearchTpuError as ex:
+                responses.append({**ex.to_dict(), "status": ex.status})
+        return web.json_response({"took": 0, "responses": responses})
+
+    @handler
+    async def count(request):
+        idx = _search_index(request)
+        body = await body_json(request, {}) or {}
+        n = await call(idx.count, body.get("query"))
+        return web.json_response(
+            {"count": n, "_shards": {"total": idx.num_shards, "successful": idx.num_shards, "skipped": 0, "failed": 0}}
+        )
+
+    # ---- cluster / cat ---------------------------------------------------
+
+    @handler
+    async def cluster_health(request):
+        n = len(engine.indices)
+        shards = sum(i.num_shards for i in engine.indices.values())
+        return web.json_response(
+            {
+                "cluster_name": "elasticsearch-tpu",
+                "status": "green",
+                "timed_out": False,
+                "number_of_nodes": 1,
+                "number_of_data_nodes": 1,
+                "active_primary_shards": shards,
+                "active_shards": shards,
+                "relocating_shards": 0,
+                "initializing_shards": 0,
+                "unassigned_shards": 0,
+                "active_shards_percent_as_number": 100.0,
+            }
+        )
+
+    @handler
+    async def cat_indices(request):
+        rows = []
+        for name, idx in sorted(engine.indices.items()):
+            rows.append(
+                {
+                    "health": "green",
+                    "status": "open",
+                    "index": name,
+                    "pri": str(idx.num_shards),
+                    "rep": "0",
+                    "docs.count": str(idx.live_count),
+                    "docs.deleted": str(sum(1 for e in idx.docs.values() if not e.alive)),
+                }
+            )
+        if request.query.get("format") == "json":
+            return web.json_response(rows)
+        text = "\n".join(
+            f"{r['health']} {r['status']} {r['index']} {r['pri']} {r['rep']} {r['docs.count']}"
+            for r in rows
+        )
+        return web.Response(text=text + ("\n" if text else ""), content_type="text/plain")
+
+    @handler
+    async def nodes_stats(request):
+        import jax
+
+        devices = [str(d) for d in jax.devices()]
+        total_docs = sum(i.live_count for i in engine.indices.values())
+        return web.json_response(
+            {
+                "_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "cluster_name": "elasticsearch-tpu",
+                "nodes": {
+                    "node-0": {
+                        "name": "node-0",
+                        "roles": ["master", "data", "ingest"],
+                        "indices": {"docs": {"count": total_docs}},
+                        "tpu": {"devices": devices},
+                    }
+                },
+            }
+        )
+
+    app.router.add_get("/", root)
+    app.router.add_get("/_cluster/health", cluster_health)
+    app.router.add_get("/_cat/indices", cat_indices)
+    app.router.add_get("/_nodes/stats", nodes_stats)
+    app.router.add_post("/_bulk", bulk)
+    app.router.add_post("/_msearch", msearch)
+    app.router.add_route("*", "/_search", search)
+    app.router.add_post("/_refresh", refresh_index)
+
+    app.router.add_put("/{index}", create_index)
+    app.router.add_delete("/{index}", delete_index)
+    app.router.add_get("/{index}", get_index, allow_head=False)
+    app.router.add_head("/{index}", head_index)
+    app.router.add_get("/{index}/_mapping", get_mapping)
+    app.router.add_put("/{index}/_mapping", put_mapping)
+    app.router.add_post("/{index}/_refresh", refresh_index)
+    app.router.add_get("/{index}/_refresh", refresh_index)
+    app.router.add_post("/{index}/_flush", flush_index)
+    app.router.add_post("/{index}/_bulk", bulk)
+    app.router.add_route("*", "/{index}/_search", search)
+    app.router.add_post("/{index}/_msearch", msearch)
+    app.router.add_route("*", "/{index}/_count", count)
+    app.router.add_post("/{index}/_doc", put_doc)
+    app.router.add_put("/{index}/_doc/{id}", put_doc)
+    app.router.add_post("/{index}/_doc/{id}", put_doc)
+    app.router.add_get("/{index}/_doc/{id}", get_doc, allow_head=False)
+    app.router.add_head("/{index}/_doc/{id}", head_doc)
+    app.router.add_delete("/{index}/_doc/{id}", delete_doc)
+    app.router.add_put("/{index}/_create/{id}", create_doc)
+    app.router.add_post("/{index}/_create/{id}", create_doc)
+    app.router.add_get("/{index}/_source/{id}", get_source)
+    app.router.add_post("/{index}/_update/{id}", update_doc)
+
+    async def on_cleanup(app):
+        app["pool"].shutdown(wait=True)
+        engine.close()
+
+    app.on_cleanup.append(on_cleanup)
+    return app

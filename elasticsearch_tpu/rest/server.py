@@ -1,0 +1,23 @@
+"""Server entry point: python -m elasticsearch_tpu.rest.server --port 9200."""
+
+from __future__ import annotations
+
+import argparse
+
+from aiohttp import web
+
+from .app import make_app
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="elasticsearch-tpu REST server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument("--data-path", default=None, help="durable data directory (WAL, meta)")
+    args = parser.parse_args(argv)
+    app = make_app(data_path=args.data_path)
+    web.run_app(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,11 +23,15 @@ def parse_duration_seconds(value, default: float | None = None) -> float | None:
     if value is None:
         return default
     if isinstance(value, (int, float)):
-        return None if value == -1 else float(value) / 1000.0  # bare number = millis
+        if value == -1:
+            return None
+        if value < 0:
+            raise IllegalArgumentError(f"negative time value [{value}] is not supported")
+        return float(value) / 1000.0  # bare number = millis
     s = str(value).strip()
     if s == "-1":
         return None
-    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d)", s)
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d)", s)
     if not m:
         raise IllegalArgumentError(f"failed to parse time value [{value}]")
     return float(m.group(1)) * _UNITS_SECONDS[m.group(2)]

@@ -46,7 +46,7 @@ def test_if_seq_no_conflict(engine):
     r = idx.index_doc("1", {"a": 1})
     idx.index_doc("1", {"a": 2})
     with pytest.raises(VersionConflictError):
-        idx.index_doc("1", {"a": 3}, if_seq_no=r["_seq_no"])
+        idx.index_doc("1", {"a": 3}, if_seq_no=r["_seq_no"], if_primary_term=1)
 
 
 def test_delete_missing(engine):
@@ -234,3 +234,60 @@ def test_routing_factor_semantics():
     assert murmur3_32("doc-0".encode("utf-16-le")) == 1609172137
     h = murmur3_32("doc-0".encode("utf-16-le"))
     assert shard_for_id("doc-0", 8) == (h % 1024) // 128
+
+
+def test_flush_truncates_wal_and_purges_tombstones(tmp_path):
+    e = Engine(str(tmp_path))
+    idx = e.create_index("f")
+    for i in range(5):
+        idx.index_doc(str(i), {"n": i})
+    idx.delete_doc("0")
+    idx.delete_doc("1")
+    idx.flush()
+    assert len(idx.docs) == 3  # tombstones purged
+    wal = os.path.join(str(tmp_path), "indices", "f", "translog.log")
+    assert os.path.getsize(wal) == 0  # truncated
+    idx.index_doc("9", {"n": 9})  # post-flush op goes to fresh WAL
+    e.close()
+    e2 = Engine(str(tmp_path))
+    idx2 = e2.get_index("f")
+    assert idx2.get_doc("0") is None and idx2.get_doc("2") is not None
+    assert idx2.get_doc("9")["_source"] == {"n": 9}
+    assert idx2.seq_no >= 8
+    e2.close()
+
+
+def test_source_mutation_does_not_corrupt_index(engine):
+    idx = engine.create_index("mut", settings={"refresh_interval": "-1"})
+    src = {"a": 1, "nested": {"b": 2}}
+    idx.index_doc("1", src)
+    src["a"] = 999
+    src["nested"]["b"] = 999
+    assert idx.get_doc("1")["_source"] == {"a": 1, "nested": {"b": 2}}
+
+
+def test_if_primary_term_checked(engine):
+    idx = engine.create_index("cas")
+    r = idx.index_doc("1", {"a": 1})
+    with pytest.raises(IllegalArgumentError):
+        idx.index_doc("1", {"a": 2}, if_seq_no=r["_seq_no"])  # missing term
+    with pytest.raises(VersionConflictError):
+        idx.index_doc("1", {"a": 2}, if_seq_no=r["_seq_no"], if_primary_term=99)
+    r2 = idx.index_doc("1", {"a": 2}, if_seq_no=r["_seq_no"], if_primary_term=1)
+    assert r2["_version"] == 2
+
+
+def test_negative_duration_rejected():
+    from elasticsearch_tpu.utils.durations import parse_duration_seconds
+
+    with pytest.raises(IllegalArgumentError):
+        parse_duration_seconds("-5s")
+
+
+def test_routing_num_shards_validation():
+    from elasticsearch_tpu.cluster.routing import shard_for_id
+
+    with pytest.raises(ValueError):
+        shard_for_id("x", 8, routing_num_shards=4)
+    with pytest.raises(ValueError):
+        shard_for_id("x", 8, routing_num_shards=12)

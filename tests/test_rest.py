@@ -1,0 +1,277 @@
+"""REST API contract tests via the aiohttp test client."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.rest import make_app
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture
+def client_run(tmp_path):
+    """Returns a runner that executes an async scenario against a fresh app."""
+
+    def _run(scenario):
+        async def wrapper():
+            app = make_app(data_path=str(tmp_path / "data"))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                return await scenario(client)
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(wrapper())
+        finally:
+            loop.close()
+
+    return _run
+
+
+def test_root_banner(client_run):
+    async def scenario(c):
+        r = await c.get("/")
+        assert r.status == 200
+        body = await r.json()
+        assert body["version"]["number"] == "8.14.0"
+        assert body["tagline"].startswith("You Know")
+
+    client_run(scenario)
+
+
+def test_index_lifecycle(client_run):
+    async def scenario(c):
+        r = await c.put("/books", json={
+            "settings": {"number_of_shards": 2, "refresh_interval": "-1"},
+            "mappings": {"properties": {"title": {"type": "text"}, "year": {"type": "integer"}}},
+        })
+        assert r.status == 200 and (await r.json())["acknowledged"] is True
+        assert (await c.head("/books")).status == 200
+        assert (await c.head("/missing")).status == 404
+        r = await c.get("/books")
+        body = await r.json()
+        assert body["books"]["mappings"]["properties"]["title"]["type"] == "text"
+        assert body["books"]["settings"]["index"]["number_of_shards"] == "2"
+        r = await c.put("/books")
+        assert r.status == 400  # already exists
+        assert (await r.json())["error"]["type"] == "resource_already_exists_exception"
+        r = await c.delete("/books")
+        assert (await r.json())["acknowledged"] is True
+        assert (await c.head("/books")).status == 404
+
+    client_run(scenario)
+
+
+def test_document_crud(client_run):
+    async def scenario(c):
+        r = await c.put("/idx/_doc/1", json={"title": "hello world"})
+        assert r.status == 201
+        body = await r.json()
+        assert body["result"] == "created" and body["_version"] == 1
+        r = await c.put("/idx/_doc/1", json={"title": "hello again"})
+        assert r.status == 200 and (await r.json())["result"] == "updated"
+        r = await c.get("/idx/_doc/1")
+        body = await r.json()
+        assert body["found"] is True and body["_source"]["title"] == "hello again"
+        r = await c.get("/idx/_source/1")
+        assert await r.json() == {"title": "hello again"}
+        r = await c.put("/idx/_create/1", json={"title": "conflict"})
+        assert r.status == 409
+        r = await c.post("/idx/_update/1", json={"doc": {"extra": 5}})
+        assert r.status == 200
+        assert (await (await c.get("/idx/_source/1")).json()) == {"title": "hello again", "extra": 5}
+        r = await c.delete("/idx/_doc/1")
+        assert (await r.json())["result"] == "deleted"
+        assert (await c.get("/idx/_doc/1")).status == 404
+        assert (await c.head("/idx/_doc/1")).status == 404
+
+    client_run(scenario)
+
+
+def test_auto_id_post(client_run):
+    async def scenario(c):
+        r = await c.post("/idx/_doc", json={"a": 1})
+        assert r.status == 201
+        body = await r.json()
+        assert len(body["_id"]) == 20
+
+    client_run(scenario)
+
+
+def test_bulk_and_search(client_run):
+    async def scenario(c):
+        nd = "\n".join(
+            [
+                json.dumps({"index": {"_index": "logs", "_id": "1"}}),
+                json.dumps({"msg": "error connecting to db", "level": "error", "code": 500}),
+                json.dumps({"index": {"_index": "logs", "_id": "2"}}),
+                json.dumps({"msg": "connection ok", "level": "info", "code": 200}),
+                json.dumps({"index": {"_index": "logs", "_id": "3"}}),
+                json.dumps({"msg": "another error in worker", "level": "error", "code": 500}),
+            ]
+        ) + "\n"
+        r = await c.post("/_bulk", data=nd, headers={"Content-Type": "application/x-ndjson"})
+        body = await r.json()
+        assert body["errors"] is False and len(body["items"]) == 3
+        await c.post("/logs/_refresh")
+        r = await c.post("/logs/_search", json={"query": {"match": {"msg": "error"}}})
+        body = await r.json()
+        assert body["hits"]["total"] == {"value": 2, "relation": "eq"}
+        assert {h["_id"] for h in body["hits"]["hits"]} == {"1", "3"}
+        assert body["_shards"]["successful"] == 1
+        # aggs through REST
+        r = await c.post(
+            "/logs/_search",
+            json={"size": 0, "aggs": {"levels": {"terms": {"field": "level.keyword"}}}},
+        )
+        body = await r.json()
+        assert {b["key"]: b["doc_count"] for b in body["aggregations"]["levels"]["buckets"]} == {
+            "error": 2,
+            "info": 1,
+        }
+        # count
+        r = await c.post("/logs/_count", json={"query": {"term": {"level.keyword": "error"}}})
+        assert (await r.json())["count"] == 2
+
+    client_run(scenario)
+
+
+def test_bulk_default_index_and_errors(client_run):
+    async def scenario(c):
+        nd = "\n".join(
+            [
+                json.dumps({"index": {"_id": "1"}}),
+                json.dumps({"x": 1}),
+                json.dumps({"delete": {"_id": "missing"}}),
+            ]
+        ) + "\n"
+        r = await c.post("/b/_bulk", data=nd)
+        body = await r.json()
+        assert body["errors"] is True
+        assert body["items"][0]["index"]["status"] == 201
+        assert body["items"][1]["delete"]["status"] == 404
+
+    client_run(scenario)
+
+
+def test_msearch(client_run):
+    async def scenario(c):
+        await c.put("/a/_doc/1", json={"t": "alpha"})
+        await c.put("/b2/_doc/1", json={"t": "beta"})
+        await c.post("/_refresh")
+        nd = "\n".join(
+            [
+                json.dumps({"index": "a"}),
+                json.dumps({"query": {"match": {"t": "alpha"}}}),
+                json.dumps({"index": "b2"}),
+                json.dumps({"query": {"match": {"t": "beta"}}}),
+                json.dumps({"index": "nope"}),
+                json.dumps({"query": {"match_all": {}}}),
+            ]
+        ) + "\n"
+        r = await c.post("/_msearch", data=nd)
+        body = await r.json()
+        rs = body["responses"]
+        assert rs[0]["hits"]["total"]["value"] == 1
+        assert rs[1]["hits"]["total"]["value"] == 1
+        assert rs[2]["status"] == 404
+
+    client_run(scenario)
+
+
+def test_search_source_filtering(client_run):
+    async def scenario(c):
+        await c.put("/s/_doc/1", json={"a": 1, "b": 2})
+        await c.post("/s/_refresh")
+        r = await c.post("/s/_search", json={"query": {"match_all": {}}, "_source": ["a"]})
+        hits = (await r.json())["hits"]["hits"]
+        assert hits[0]["_source"] == {"a": 1}
+        r = await c.post("/s/_search", json={"query": {"match_all": {}}, "_source": False})
+        hits = (await r.json())["hits"]["hits"]
+        assert "_source" not in hits[0]
+
+    client_run(scenario)
+
+
+def test_error_envelopes(client_run):
+    async def scenario(c):
+        r = await c.post("/missing/_search", json={})
+        assert r.status == 404
+        body = await r.json()
+        assert body["error"]["type"] == "index_not_found_exception"
+        assert body["status"] == 404
+        await c.put("/e/_doc/1", json={"x": 1})
+        r = await c.post("/e/_search", json={"query": {"bogus_query": {}}})
+        assert r.status == 400
+        assert (await r.json())["error"]["type"] == "parsing_exception"
+        r = await c.post("/e/_search", data="{not json", headers={"Content-Type": JSON_CT})
+        assert r.status == 400
+
+    JSON_CT = "application/json"
+    client_run(scenario)
+
+
+def test_cluster_and_cat(client_run):
+    async def scenario(c):
+        await c.put("/one", json={"settings": {"number_of_shards": 2}})
+        await c.put("/one/_doc/1", json={"a": 1})
+        r = await c.get("/_cluster/health")
+        body = await r.json()
+        assert body["status"] == "green" and body["active_shards"] == 2
+        r = await c.get("/_cat/indices?format=json")
+        rows = await r.json()
+        assert rows[0]["index"] == "one" and rows[0]["docs.count"] == "1"
+        r = await c.get("/_cat/indices")
+        assert "one" in await r.text()
+        r = await c.get("/_nodes/stats")
+        body = await r.json()
+        assert body["nodes"]["node-0"]["indices"]["docs"]["count"] == 1
+
+    client_run(scenario)
+
+
+def test_mapping_endpoints(client_run):
+    async def scenario(c):
+        await c.put("/m", json={"mappings": {"properties": {"a": {"type": "keyword"}}}})
+        r = await c.put("/m/_mapping", json={"properties": {"b": {"type": "long"}}})
+        assert (await r.json())["acknowledged"] is True
+        r = await c.get("/m/_mapping")
+        props = (await r.json())["m"]["mappings"]["properties"]
+        assert props["a"]["type"] == "keyword" and props["b"]["type"] == "long"
+        # conflicting merge -> 400
+        r = await c.put("/m/_mapping", json={"properties": {"a": {"type": "long"}}})
+        assert r.status == 400
+
+    client_run(scenario)
+
+
+def test_persistence_across_restart(tmp_path):
+    async def fill():
+        app = make_app(data_path=str(tmp_path / "d"))
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        await c.put("/p/_doc/1", json={"msg": "survives restart"})
+        await c.close()
+
+    async def check():
+        app = make_app(data_path=str(tmp_path / "d"))
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        r = await c.get("/p/_doc/1")
+        body = await r.json()
+        await c.close()
+        return body
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(fill())
+    body = loop.run_until_complete(check())
+    loop.close()
+    assert body["found"] is True and body["_source"]["msg"] == "survives restart"
