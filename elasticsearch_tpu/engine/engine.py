@@ -280,9 +280,32 @@ class EsIndex:
         if time.monotonic() - self._last_refresh >= secs:
             self.refresh()
 
-    def search(self, query=None, size=10, from_=0, aggs=None):
+    def search(self, query=None, size=10, from_=0, aggs=None, knn=None):
         self._maybe_refresh()
+        if knn is not None:
+            # knn section: standalone -> knn hits; with a query -> union with
+            # scores summed where a doc appears in both (reference behavior:
+            # SearchSourceBuilder knn + query combination)
+            from ..query.dsl import parse_knn, parse_query
+            from ..query.nodes import BoolNode
+
+            knn_nodes = [parse_knn(k, self.mappings) for k in (knn if isinstance(knn, list) else [knn])]
+            knn_only = query is None
+            k_total = sum(kn.k for kn in knn_nodes)
+            if not knn_only:
+                qnode = parse_query(query, self.mappings)
+                query = BoolNode(should=[qnode, *knn_nodes], minimum_should_match=1)
+            elif len(knn_nodes) == 1:
+                query = knn_nodes[0]
+            else:
+                query = BoolNode(should=knn_nodes, minimum_should_match=1)
+            if knn_only:
+                # each shard contributes up to k candidates; the global result
+                # is the top k overall (KnnSearchBuilder.java:44 semantics)
+                size = min(size, max(k_total - from_, 0))
         res = self.searcher.search(query, size=size, from_=from_, aggs=aggs)
+        if knn is not None and knn_only:
+            res.total = min(res.total, k_total)
         hits = []
         for s, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
             doc_id, src = self.shard_docs[s][d]

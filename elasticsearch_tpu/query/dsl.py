@@ -36,6 +36,7 @@ from .nodes import (
     ConstantScoreNode,
     DisMaxNode,
     BoolNode,
+    KnnNode,
 )
 
 
@@ -257,6 +258,33 @@ def _parse_match_none(body, mappings):
     return MatchNoneNode()
 
 
+def parse_knn(body, mappings) -> KnnNode:
+    """knn section/query: {"field", "query_vector", "k", "num_candidates",
+    "filter", "boost", "similarity"}."""
+    if not isinstance(body, dict) or "field" not in body or "query_vector" not in body:
+        raise QueryParsingError("[knn] requires [field] and [query_vector]")
+    k = int(body.get("k", 10))
+    nc = int(body["num_candidates"]) if body.get("num_candidates") else None
+    if k < 1 or (nc is not None and nc < k):
+        raise QueryParsingError("[knn] k must be >= 1 and num_candidates >= k")
+    filt = body.get("filter")
+    fnode = None
+    if filt is not None:
+        if isinstance(filt, list):
+            fnode = BoolNode(filter=[parse_query(q, mappings) for q in filt])
+        else:
+            fnode = parse_query(filt, mappings)
+    return KnnNode(
+        fld=body["field"],
+        qvec=[float(x) for x in body["query_vector"]],
+        k=k,
+        num_candidates=nc,
+        filter_node=fnode,
+        boost=float(body.get("boost", 1.0)),
+        similarity_threshold=float(body["similarity"]) if body.get("similarity") is not None else None,
+    )
+
+
 def _parse_ids(body, mappings):
     # resolved by the engine layer (docid lookup is host-side state); the
     # parser represents it as a terms query on the reserved _id keyword column
@@ -317,4 +345,5 @@ _PARSERS = {
     "dis_max": _parse_dis_max,
     "exists": _parse_exists,
     "ids": _parse_ids,
+    "knn": parse_knn,
 }

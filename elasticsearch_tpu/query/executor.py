@@ -48,9 +48,11 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         dev[key][f] = (put(vals), put(col.has_value))
         if col.uniq_ords is not None:
             dev["dv_int_ord"][f] = put(col.uniq_ords)
+    dev["vec_sq"] = {}
     for f, vc in pack.vectors.items():
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
+        dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
     return dev
 
 

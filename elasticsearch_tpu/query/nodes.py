@@ -25,6 +25,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field as dc_field
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -294,6 +295,83 @@ class DisMaxNode(QueryNode):
             match = match | m
         score = boost * (best + tie * (total - best))
         return jnp.where(match, score, 0.0), match
+
+
+@dataclass
+class KnnNode(QueryNode):
+    """Exact k-nearest-neighbor retrieval (reference behavior:
+    search/vectors/KnnVectorQueryBuilder.java:54 + KnnSearchBuilder.java:44 —
+    per-shard top num_candidates then global k). Here the scan is exact, so
+    num_candidates only caps the per-shard match set; an optional filter is
+    applied BEFORE neighbor selection (ES pre-filtering semantics)."""
+
+    fld: str = ""
+    qvec: list | None = None
+    k: int = 10
+    num_candidates: int | None = None
+    filter_node: QueryNode | None = None
+    boost: float = 1.0
+    similarity_threshold: float | None = None
+
+    def prepare(self, pack):
+        vc = pack.vectors.get(self.fld)
+        fp, fk = (None, None)
+        if self.filter_node is not None:
+            fp, fk = self.filter_node.prepare(pack)
+        qv = np.zeros(vc.dims if vc else 1, np.float32)
+        if vc is not None:
+            if len(self.qvec) != vc.dims:
+                from ..utils.errors import IllegalArgumentError
+
+                raise IllegalArgumentError(
+                    f"knn query vector has {len(self.qvec)} dims, field [{self.fld}] has {vc.dims}"
+                )
+            qv = np.asarray(self.qvec, np.float32)
+        kk = min(self.num_candidates or self.k, max(pack.num_docs, 1))
+        self._sim = vc.similarity if vc else "cosine"
+        # threshold is a trace-time constant -> must be in the cache key
+        return (qv, np.float32(self.boost), fp), (
+            "knn", self.fld, vc is None, kk, self.similarity_threshold, fk,
+        )
+
+    def _score_threshold(self) -> float:
+        """ES expresses `similarity` in the raw metric space; convert to the
+        _score space the kernel compares against (reference behavior:
+        VectorSimilarityQuery score translation)."""
+        t = self.similarity_threshold
+        if self._sim in ("cosine", "dot_product"):
+            return (1.0 + t) / 2.0
+        if self._sim == "l2_norm":
+            return 1.0 / (1.0 + t * t)
+        if self._sim == "max_inner_product":
+            return 1.0 / (1.0 - t) if t < 0 else t + 1.0
+        return t
+
+    def device_eval(self, dev, params, ctx):
+        from ..ops.vector import knn_scores
+
+        qv, boost, fp = params
+        n1 = ctx.num_docs + 1
+        if self.fld not in dev["vec"]:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        vecs = dev["vec"][self.fld]
+        has = dev["vec_has"][self.fld]
+        scores = knn_scores(vecs, dev["vec_sq"][self.fld], qv, self._sim)
+        ok = has & dev["live"]
+        if self.filter_node is not None:
+            _, fm = self.filter_node.device_eval(dev, fp, ctx)
+            ok = ok & fm[: ctx.num_docs]
+        if self.similarity_threshold is not None:
+            ok = ok & (scores >= self._score_threshold())
+        kk = min(self.num_candidates or self.k, ctx.num_docs)
+        masked = jnp.where(ok, scores, -jnp.inf)
+        kth = jax.lax.top_k(masked, kk)[0][-1]
+        match_n = ok & (masked >= kth) & jnp.isfinite(masked)
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(match_n)
+        score = jnp.zeros(n1, jnp.float32).at[: ctx.num_docs].set(
+            jnp.where(match_n, boost * scores, 0.0)
+        )
+        return score, match
 
 
 @dataclass

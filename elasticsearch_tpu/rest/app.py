@@ -288,13 +288,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def _run_search(idx, body, query_params):
         body = body or {}
         query = body.get("query")
+        knn = body.get("knn")
         size = int(query_params.get("size", body.get("size", 10)))
         from_ = int(query_params.get("from", body.get("from", 0)))
         aggs = body.get("aggs") or body.get("aggregations")
         import time
 
         t0 = time.monotonic()
-        res = await call(idx.search, query, size, from_, aggs)
+        res = await call(idx.search, query, size, from_, aggs, knn)
         took = int((time.monotonic() - t0) * 1000)
         src_filter = body.get("_source")
         if src_filter is False:

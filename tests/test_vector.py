@@ -1,0 +1,193 @@
+"""Vector search tests: exact knn vs numpy, similarities, filters, sharding."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.parallel import StackedSearcher, build_stacked_pack, make_mesh
+from elasticsearch_tpu.query import ShardSearcher
+
+D = 16
+
+
+def make_vectors(n, seed=0):
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(n, D)).astype(np.float32)
+    return v
+
+
+def np_scores(vectors, q, similarity):
+    dots = vectors @ q
+    if similarity == "cosine":
+        return (1 + dots / (np.linalg.norm(vectors, axis=1) * np.linalg.norm(q))) / 2
+    if similarity == "dot_product":
+        return (1 + dots) / 2
+    if similarity == "l2_norm":
+        return 1.0 / (1.0 + ((vectors - q) ** 2).sum(axis=1))
+    raise ValueError(similarity)
+
+
+@pytest.mark.parametrize("similarity", ["cosine", "dot_product", "l2_norm"])
+def test_knn_exact_parity(similarity):
+    vecs = make_vectors(50)
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": D, "similarity": similarity}}})
+    b = PackBuilder(m)
+    for row in vecs:
+        b.add_document(m.parse_document({"v": [float(x) for x in row]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    q = make_vectors(1, seed=9)[0]
+    res = s.search({"knn": {"field": "v", "query_vector": q.tolist(), "k": 5}}, size=5)
+    expected = np_scores(vecs, q, similarity)
+    order = np.argsort(-expected, kind="stable")[:5]
+    np.testing.assert_array_equal(res.doc_ids, order)
+    np.testing.assert_allclose(res.scores, expected[order], rtol=1e-5)
+    assert res.total == 5  # only k nearest "match"
+
+
+def test_knn_with_filter():
+    vecs = make_vectors(40)
+    m = Mappings(
+        {
+            "properties": {
+                "v": {"type": "dense_vector", "dims": D, "similarity": "l2_norm"},
+                "tag": {"type": "keyword"},
+            }
+        }
+    )
+    b = PackBuilder(m)
+    for i, row in enumerate(vecs):
+        b.add_document(m.parse_document({"v": [float(x) for x in row], "tag": "even" if i % 2 == 0 else "odd"}))
+    s = ShardSearcher(b.build(), mappings=m)
+    q = make_vectors(1, seed=4)[0]
+    res = s.search(
+        {"knn": {"field": "v", "query_vector": q.tolist(), "k": 4, "filter": {"term": {"tag": "even"}}}},
+        size=4,
+    )
+    expected = np_scores(vecs, q, "l2_norm")
+    even_ids = np.arange(0, 40, 2)
+    order = even_ids[np.argsort(-expected[even_ids], kind="stable")[:4]]
+    np.testing.assert_array_equal(np.sort(res.doc_ids), np.sort(order))
+    assert all(d % 2 == 0 for d in res.doc_ids)
+
+
+def test_knn_sharded_equals_single():
+    vecs = make_vectors(120, seed=2)
+    mp = {"properties": {"v": {"type": "dense_vector", "dims": D, "similarity": "cosine"}}}
+    docs = [(f"d{i}", {"v": [float(x) for x in row]}) for i, row in enumerate(vecs)]
+    m1 = Mappings(mp)
+    sp = build_stacked_pack(docs, m1, num_shards=8)
+    sharded = StackedSearcher(sp, mesh=make_mesh(8))
+    q = make_vectors(1, seed=7)[0]
+    knnq = {"knn": {"field": "v", "query_vector": q.tolist(), "k": 10, "num_candidates": 10}}
+    r1 = sharded.search(knnq, size=10)
+    expected = np_scores(vecs, q, "cosine")
+    top = np.sort(expected)[::-1][:10]
+    np.testing.assert_allclose(np.sort(r1.scores)[::-1], top, rtol=1e-5)
+
+
+def test_knn_section_through_engine_with_query_union():
+    e = Engine(None)
+    idx = e.create_index(
+        "kb",
+        {
+            "properties": {
+                "text": {"type": "text"},
+                "emb": {"type": "dense_vector", "dims": 4, "similarity": "dot_product"},
+            }
+        },
+        {"refresh_interval": "-1"},
+    )
+    idx.index_doc("1", {"text": "apple pie recipe", "emb": [1, 0, 0, 0]})
+    idx.index_doc("2", {"text": "banana bread", "emb": [0, 1, 0, 0]})
+    idx.index_doc("3", {"text": "apple tart", "emb": [0, 0, 1, 0]})
+    idx.refresh()
+    # knn alone
+    res = idx.search(knn={"field": "emb", "query_vector": [1, 0, 0, 0], "k": 1})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["1"]
+    # query + knn union: doc1 matches both (score sum) and must rank first
+    res = idx.search(
+        query={"match": {"text": "apple"}},
+        knn={"field": "emb", "query_vector": [0, 0, 1, 0], "k": 1},
+    )
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    assert ids[0] == "3"  # knn hit + text match
+    assert set(ids) == {"1", "3"}
+
+
+def test_knn_dim_mismatch_raises():
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 4}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"v": [1.0, 0.0, 0.0, 0.0]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+    with pytest.raises(IllegalArgumentError):
+        s.search({"knn": {"field": "v", "query_vector": [1.0, 2.0]}})
+
+
+def test_knn_missing_field_matches_nothing():
+    m = Mappings({"properties": {"a": {"type": "keyword"}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"a": "x"}))
+    s = ShardSearcher(b.build(), mappings=m)
+    res = s.search({"knn": {"field": "nope", "query_vector": [1.0]}})
+    assert res.total == 0
+
+
+def test_knn_docs_without_vectors_excluded():
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 2, "similarity": "l2_norm"}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"v": [1.0, 0.0]}))
+    b.add_document(m.parse_document({}))  # no vector
+    b.add_document(m.parse_document({"v": [0.0, 1.0]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    res = s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 3}}, size=3)
+    assert 1 not in res.doc_ids
+    assert res.total == 2
+
+
+def test_knn_only_caps_hits_at_k_multi_shard():
+    e = Engine(None)
+    idx = e.create_index(
+        "caps",
+        {"properties": {"v": {"type": "dense_vector", "dims": 2, "similarity": "l2_norm"}}},
+        {"number_of_shards": 2, "refresh_interval": "-1"},
+    )
+    for i in range(10):
+        idx.index_doc(f"d{i}", {"v": [float(i), 0.0]})
+    idx.refresh()
+    res = idx.search(knn={"field": "v", "query_vector": [0.0, 0.0], "k": 2})
+    assert len(res["hits"]["hits"]) == 2
+    assert res["hits"]["total"]["value"] == 2
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d0", "d1"]
+
+
+def test_knn_similarity_threshold_native_space():
+    # cosine similarity threshold 0.5 -> only docs with raw cos >= 0.5
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 2, "similarity": "cosine"}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"v": [1.0, 0.0]}))      # cos=1
+    b.add_document(m.parse_document({"v": [1.0, 1.0]}))      # cos=0.707
+    b.add_document(m.parse_document({"v": [0.0, 1.0]}))      # cos=0
+    b.add_document(m.parse_document({"v": [-1.0, 0.0]}))     # cos=-1
+    s = ShardSearcher(b.build(), mappings=m)
+    res = s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 4, "similarity": 0.5}}, size=4)
+    assert res.total == 2  # cos 1 and 0.707 only
+    # distinct thresholds must not share a compiled executable
+    res2 = s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 4, "similarity": -0.5}}, size=4)
+    assert res2.total == 3
+
+
+def test_knn_k_validation():
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 2}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"v": [1.0, 0.0]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    with pytest.raises(QueryParsingError):
+        s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 0}})
+    with pytest.raises(QueryParsingError):
+        s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 5, "num_candidates": 2}})
