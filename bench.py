@@ -1,0 +1,122 @@
+"""Headline benchmark: batched BM25 match-query throughput (north-star config 1/2).
+
+Mirrors the reference's headline esrally configuration — `match` / bool-should
+multi-term BM25 top-10 over an msmarco-passage-like corpus (BASELINE.json
+configs[0-1]) — on this framework's device path: blocked-CSR postings gather
+-> vectorized BM25 -> dense scatter-add -> lax.top_k, vmapped over a query
+batch (the `_msearch` batching axis, BASELINE.json configs[4]).
+
+The reference repo publishes no absolute numbers (benchmarks/README.md:7-9
+delegates to external nightly Rally runs), so `vs_baseline` is the ratio
+against a fixed stand-in: 1,500 QPS, a representative single-shard
+match-top-10 esrally result for Elasticsearch 8.x on a 32-vCPU host.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_QPS = 1500.0  # stand-in: 32-vCPU ES 8.x, single-shard match top-10
+
+N_DOCS = 30_000
+VOCAB = 4_000
+DOC_LEN_MEAN = 40  # msmarco passages average ~55 terms; keep pack build fast
+N_QUERIES = 256  # one batch = one _msearch fan-in
+TERMS_PER_QUERY = 4
+TOP_K = 10
+WARMUP = 3
+ITERS = 20
+
+
+def build_corpus(rng):
+    """Zipf-distributed synthetic passages (term-id strings)."""
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    zipf /= zipf.sum()
+    lens = rng.poisson(DOC_LEN_MEAN, size=N_DOCS).clip(4, None)
+    all_terms = rng.choice(VOCAB, size=int(lens.sum()), p=zipf)
+    docs, off = [], 0
+    for i, ln in enumerate(lens):
+        body = " ".join(f"t{t}" for t in all_terms[off : off + ln])
+        off += ln
+        docs.append((f"doc-{i}", {"body": body}))
+    return docs
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+    from elasticsearch_tpu.ops.scoring import bm25_idf, term_score_blocks, top_k_with_total
+    from elasticsearch_tpu.query.executor import pack_to_device
+
+    rng = np.random.default_rng(42)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    for _, src in build_corpus(rng):
+        b.add_document(m.parse_document(src))
+    pack = b.build()
+    dev = pack_to_device(pack)
+    avgdl = pack.avgdl("body")
+    n_docs = pack.num_docs
+    doc_count = int(pack.field_stats["body"]["doc_count"])
+
+    # Query batch: mid-frequency terms (heads are stopword-like, tails trivial).
+    cands = [
+        (t, pack.term_blocks("body", f"t{t}"))
+        for t in range(20, VOCAB)
+    ]
+    cands = [(t, sbn) for t, sbn in cands if sbn[1] > 0]
+    max_blocks = max(sbn[1] for _, sbn in cands)
+    B = 1 << (max_blocks - 1).bit_length()
+    rows = np.zeros((N_QUERIES, TERMS_PER_QUERY, B), np.int32)
+    weights = np.zeros((N_QUERIES, TERMS_PER_QUERY), np.float32)
+    pick = rng.choice(len(cands), size=(N_QUERIES, TERMS_PER_QUERY))
+    for q in range(N_QUERIES):
+        for j in range(TERMS_PER_QUERY):
+            t, (s0, nb, df) = cands[pick[q, j]]
+            rows[q, j, :nb] = np.arange(s0, s0 + nb)
+            weights[q, j] = bm25_idf(doc_count, df)
+    rows_d = jnp.asarray(rows)
+    weights_d = jnp.asarray(weights)
+
+    def one_query(r, w):  # bool-should disjunction: sum of per-term BM25
+        def one_term(rr, ww):
+            return term_score_blocks(
+                dev["post_docids"], dev["post_tfs"], rr, ww,
+                dev["norms"]["body"], avgdl, n_docs,
+            )
+        s, mt = jax.vmap(one_term)(r, w)
+        return top_k_with_total(s.sum(0), mt.any(0), dev["live"], TOP_K)
+
+    batch = jax.jit(jax.vmap(one_query))
+
+    for _ in range(WARMUP):
+        out = batch(rows_d, weights_d)
+        jax.block_until_ready(out)
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = batch(rows_d, weights_d)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    p50 = float(np.median(times))
+    qps = N_QUERIES / p50
+
+    print(json.dumps({
+        "metric": "bm25_match_top10_batched_qps",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / BASELINE_QPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

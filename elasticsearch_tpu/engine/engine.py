@@ -28,6 +28,8 @@ import os
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..index.mappings import Mappings
 from ..parallel.sharded import StackedSearcher, make_mesh
 from ..utils.errors import (
@@ -287,14 +289,30 @@ class EsIndex:
             # scores summed where a doc appears in both (reference behavior:
             # SearchSourceBuilder knn + query combination)
             from ..query.dsl import parse_knn, parse_query
-            from ..query.nodes import BoolNode
+            from ..query.nodes import BoolNode, PinnedScoresNode
 
             knn_nodes = [parse_knn(k, self.mappings) for k in (knn if isinstance(knn, list) else [knn])]
             knn_only = query is None
             k_total = sum(kn.k for kn in knn_nodes)
             if not knn_only:
+                # hybrid: each knn section first retrieves its GLOBAL top k
+                # (per-shard candidates, cross-shard re-selection), and only
+                # those score-docs join the user query as a should clause
+                # (reference behavior: KnnScoreDocQueryBuilder rewrite)
                 qnode = parse_query(query, self.mappings)
-                query = BoolNode(should=[qnode, *knn_nodes], minimum_should_match=1)
+                S = self.searcher.sp.S
+                pinned = []
+                for kn in knn_nodes:
+                    kres = self.searcher.search(kn, size=kn.k)
+                    per_shard = [([], []) for _ in range(S)]
+                    for s, d, sc in zip(kres.doc_shards, kres.doc_ids, kres.scores):
+                        per_shard[s][0].append(int(d))
+                        per_shard[s][1].append(float(sc))
+                    pinned.append(PinnedScoresNode(per_shard=[
+                        (np.asarray(ids, np.int32), np.asarray(scs, np.float32))
+                        for ids, scs in per_shard
+                    ]))
+                query = BoolNode(should=[qnode, *pinned], minimum_should_match=1)
             elif len(knn_nodes) == 1:
                 query = knn_nodes[0]
             else:

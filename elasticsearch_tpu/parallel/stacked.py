@@ -42,6 +42,7 @@ class _ShardView:
 
     pack: ShardPack
     stacked: "StackedPack"
+    shard_index: int = 0
 
     @property
     def num_docs(self):
@@ -59,7 +60,10 @@ class _ShardView:
 
     @property
     def vectors(self):
-        return self.pack.vectors
+        # the stacked union, NOT the per-shard dict: planning state derived
+        # here (similarity, dims, field presence) must be identical on every
+        # shard because device_eval is traced once for the whole mesh
+        return self.stacked.vectors
 
     @property
     def norms(self):
@@ -199,7 +203,7 @@ class StackedPack:
         return sum(p.num_docs for p in self.shards)
 
     def shard_view(self, s: int) -> _ShardView:
-        return _ShardView(self.shards[s], self)
+        return _ShardView(self.shards[s], self, s)
 
 
 def route_docs(

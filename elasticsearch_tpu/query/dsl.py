@@ -264,7 +264,7 @@ def parse_knn(body, mappings) -> KnnNode:
     if not isinstance(body, dict) or "field" not in body or "query_vector" not in body:
         raise QueryParsingError("[knn] requires [field] and [query_vector]")
     k = int(body.get("k", 10))
-    nc = int(body["num_candidates"]) if body.get("num_candidates") else None
+    nc = int(body["num_candidates"]) if body.get("num_candidates") is not None else None
     if k < 1 or (nc is not None and nc < k):
         raise QueryParsingError("[knn] k must be >= 1 and num_candidates >= k")
     filt = body.get("filter")

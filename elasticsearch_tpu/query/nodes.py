@@ -312,6 +312,7 @@ class KnnNode(QueryNode):
     filter_node: QueryNode | None = None
     boost: float = 1.0
     similarity_threshold: float | None = None
+    _sim: str = "cosine"
 
     def prepare(self, pack):
         vc = pack.vectors.get(self.fld)
@@ -327,11 +328,15 @@ class KnnNode(QueryNode):
                     f"knn query vector has {len(self.qvec)} dims, field [{self.fld}] has {vc.dims}"
                 )
             qv = np.asarray(self.qvec, np.float32)
-        kk = min(self.num_candidates or self.k, max(pack.num_docs, 1))
-        self._sim = vc.similarity if vc else "cosine"
+        # trace-time constants consumed by device_eval; set ONLY here so the
+        # struct key below always describes the plan that gets traced
+        self._kk = min(self.num_candidates or self.k, max(pack.num_docs, 1))
+        if vc is not None:
+            self._sim = vc.similarity
         # threshold is a trace-time constant -> must be in the cache key
         return (qv, np.float32(self.boost), fp), (
-            "knn", self.fld, vc is None, kk, self.similarity_threshold, fk,
+            "knn", self.fld, vc is None, self._kk, self._sim,
+            self.similarity_threshold, fk,
         )
 
     def _score_threshold(self) -> float:
@@ -363,15 +368,45 @@ class KnnNode(QueryNode):
             ok = ok & fm[: ctx.num_docs]
         if self.similarity_threshold is not None:
             ok = ok & (scores >= self._score_threshold())
-        kk = min(self.num_candidates or self.k, ctx.num_docs)
         masked = jnp.where(ok, scores, -jnp.inf)
-        kth = jax.lax.top_k(masked, kk)[0][-1]
+        kth = jax.lax.top_k(masked, self._kk)[0][-1]
         match_n = ok & (masked >= kth) & jnp.isfinite(masked)
         match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(match_n)
         score = jnp.zeros(n1, jnp.float32).at[: ctx.num_docs].set(
             jnp.where(match_n, boost * scores, 0.0)
         )
         return score, match
+
+
+@dataclass
+class PinnedScoresNode(QueryNode):
+    """Matches a fixed (shard, docid) -> score set — the engine rewrites the
+    knn section of a hybrid search to one of these holding the GLOBAL top-k
+    knn hits (reference behavior: KnnSearchBuilder/KnnScoreDocQueryBuilder —
+    per-shard num_candidates retrieval, then the global-k ScoreDocs become a
+    query clause combined with the user query)."""
+
+    per_shard: list = dc_field(default_factory=list)  # [(ids i32[m], scores f32[m])]
+
+    def prepare(self, pack):
+        s = getattr(pack, "shard_index", 0)
+        n = pack.num_docs
+        width = max((len(ids) for ids, _ in self.per_shard), default=0)
+        width = max(width, 1)
+        ids = np.full(width, n, np.int32)  # pad -> dead slot
+        scs = np.zeros(width, np.float32)
+        if self.per_shard:
+            sids, sscs = self.per_shard[s]
+            ids[: len(sids)] = sids
+            scs[: len(sscs)] = sscs
+        return (ids, scs), ("pinned", width)
+
+    def device_eval(self, dev, params, ctx):
+        ids, scs = params
+        n1 = ctx.num_docs + 1
+        scores = jnp.zeros(n1, jnp.float32).at[ids].set(scs, mode="drop")
+        match = jnp.zeros(n1, bool).at[ids].set(True, mode="drop")
+        return scores, match.at[ctx.num_docs].set(False)
 
 
 @dataclass

@@ -191,3 +191,66 @@ def test_knn_k_validation():
         s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 0}})
     with pytest.raises(QueryParsingError):
         s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 5, "num_candidates": 2}})
+
+
+def test_hybrid_knn_global_k_across_shards():
+    # ES semantics: the knn section contributes only the GLOBAL top-k docs to
+    # the hybrid union, not per-shard top-k (KnnScoreDocQueryBuilder rewrite).
+    e = Engine(None)
+    idx = e.create_index(
+        "hyb",
+        {
+            "properties": {
+                "text": {"type": "text"},
+                "v": {"type": "dense_vector", "dims": 2, "similarity": "l2_norm"},
+            }
+        },
+        {"number_of_shards": 4, "refresh_interval": "-1"},
+    )
+    # every doc matches the text query; vectors are distinct distances from 0
+    for i in range(12):
+        idx.index_doc(f"d{i}", {"text": "common token", "v": [float(i), 0.0]})
+    idx.refresh()
+    res = idx.search(
+        query={"match": {"text": "common"}},
+        knn={"field": "v", "query_vector": [0.0, 0.0], "k": 1},
+        size=12,
+    )
+    # all 12 match the text part, but ONLY d0 (the single global nearest)
+    # may receive a knn score contribution -> it must rank first, and no
+    # other doc's score may include a knn term
+    assert res["hits"]["total"]["value"] == 12
+    hits = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+    text_only = idx.search(query={"match": {"text": "common"}}, size=12)
+    base = {h["_id"]: h["_score"] for h in text_only["hits"]["hits"]}
+    boosted = [i for i in hits if hits[i] - base[i] > 1e-6]
+    assert boosted == ["d0"]
+
+
+def test_knn_similarity_consistent_when_one_shard_lacks_vectors():
+    # regression: a shard with no vector-bearing docs must not reset the
+    # similarity used for the whole (once-traced) mesh program to cosine
+    vecs = [[3.0, 0.0], [0.0, 4.0], [1.0, 1.0]]
+    mp = Mappings(
+        {"properties": {"v": {"type": "dense_vector", "dims": 2, "similarity": "l2_norm"},
+                        "k": {"type": "keyword"}}}
+    )
+    # 8 shards, 3 docs -> most shards have no vectors at all
+    docs = [(f"d{i}", {"v": v, "k": "x"}) for i, v in enumerate(vecs)]
+    sp = build_stacked_pack(docs, mp, num_shards=8)
+    s = StackedSearcher(sp, mesh=make_mesh(8))
+    r = s.search({"knn": {"field": "v", "query_vector": [3.0, 0.0], "k": 3}}, size=3)
+    got = np.sort(r.scores)[::-1]
+    exp = np.sort(np_scores(np.array(vecs, np.float32), np.array([3.0, 0.0], np.float32), "l2_norm"))[::-1]
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_knn_num_candidates_zero_rejected():
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 2}}})
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"v": [1.0, 0.0]}))
+    s = ShardSearcher(b.build(), mappings=m)
+    with pytest.raises(QueryParsingError):
+        s.search({"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 5, "num_candidates": 0}})
