@@ -48,6 +48,28 @@ from .smallfloat import quantize_lengths
 
 BLOCK = 128  # TPU lane width; one postings block = one vector register row
 
+# BM25 defaults baked into dense-tier tfn rows (reference behavior:
+# index/similarity/SimilarityService.java:43-58 — BM25 k1=1.2, b=0.75)
+BM25_K1 = 1.2
+BM25_B = 0.75
+
+
+def default_dense_min_df(n_docs: int) -> int:
+    """df threshold above which a term moves to the dense tier. ~1 posting
+    per 2 doc-chunks: dense rows then cost at most ~2x their CSR form."""
+    return max(64, n_docs // 256)
+
+
+def compute_tfn(
+    tfs: np.ndarray, dls: np.ndarray | None, avgdl: float, has_norms: bool
+) -> np.ndarray:
+    """Host-side tf/(tf + K): the doc-length-normalized BM25 tf saturation."""
+    if has_norms:
+        K = BM25_K1 * (1.0 - BM25_B + BM25_B * dls / avgdl)
+    else:
+        K = BM25_K1
+    return (tfs / (tfs + K)).astype(np.float32)
+
 
 @dataclass
 class DocValuesColumn:
@@ -80,6 +102,7 @@ class ShardPack:
     # postings
     post_docids: np.ndarray  # [num_blocks, BLOCK] int32; pad = num_docs
     post_tfs: np.ndarray  # [num_blocks, BLOCK] float32; pad = 0
+    post_dls: np.ndarray  # [num_blocks, BLOCK] float32 doc length per posting; pad = 1
     term_block_start: np.ndarray  # [T+1] int32 (row ranges; row 0 reserved)
     term_df: np.ndarray  # [T] int32
     block_max_tf: np.ndarray  # [num_blocks] float32
@@ -95,6 +118,14 @@ class ShardPack:
     docvalues: dict[str, DocValuesColumn]
     vectors: dict[str, VectorColumn]
     live: np.ndarray  # [N] bool live-docs bitmap (deletes)
+    # dense tier: terms with df >= dense_min_df stored as precomputed
+    # tf/(tf+K) rows [V_dense, N] — scored on the MXU (matmul / elementwise)
+    # with no gather or scatter. K bakes this pack's avgdl and BM25 defaults.
+    dense_tfn: np.ndarray | None = None
+    dense_dict: dict[tuple[str, str], int] = dc_field(default_factory=dict)
+
+    def dense_row_of(self, fld: str, term: str) -> int | None:
+        return self.dense_dict.get((fld, term))
 
     @property
     def num_blocks(self) -> int:
@@ -200,9 +231,11 @@ class PackBuilder:
                     self.vector_raw.setdefault(fld, []).append((docid, [float(x) for x in values]))
         return docid
 
-    def build(self) -> ShardPack:
+    def build(self, dense_min_df: int | None = None) -> ShardPack:
         N = self.num_docs
         mappings = self.mappings
+        if dense_min_df is None:
+            dense_min_df = default_dense_min_df(N)
 
         # ---- term dictionary: stable order = sorted by (field, term) ----
         keys = sorted(self.postings.keys())
@@ -243,6 +276,7 @@ class PackBuilder:
 
         post_docids = np.full((total_blocks, BLOCK), N, dtype=np.int32)
         post_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
+        post_dls = np.ones((total_blocks, BLOCK), dtype=np.float32)
         term_block_start = np.zeros(T + 1, dtype=np.int32)
         term_df = np.zeros(T, dtype=np.int32)
         block_max_tf = np.zeros(total_blocks, dtype=np.float32)
@@ -266,6 +300,7 @@ class PackBuilder:
                 post_tfs[row, : len(chunk_t)] = chunk_t
                 block_max_tf[row] = float(chunk_t.max())
                 if fld_norms is not None:
+                    post_dls[row, : len(chunk_d)] = fld_norms[chunk_d]
                     block_min_len[row] = float(fld_norms[chunk_d].min())
                 else:
                     block_min_len[row] = 1.0
@@ -329,10 +364,32 @@ class PackBuilder:
                 has[docid] = True
             vectors[fld] = VectorColumn(vals, has, ft.similarity, ft.dims)
 
+        # ---- dense tier --------------------------------------------------
+        dense_keys = [k for k in keys if len(self.postings[k]) >= dense_min_df]
+        dense_dict = {k: i for i, k in enumerate(dense_keys)}
+        dense_tfn = None
+        if dense_keys:
+            dense_tfn = np.zeros((len(dense_keys), N), dtype=np.float32)
+            for i, k in enumerate(dense_keys):
+                fld = k[0]
+                plist = self.postings[k]
+                docs = np.fromiter(plist.keys(), np.int32, count=len(plist))
+                tfs = np.fromiter(plist.values(), np.float32, count=len(plist))
+                fld_norms = norms.get(fld)
+                st = field_stats.get(fld, {"sum_dl": 0.0, "doc_count": 0})
+                avgdl = st["sum_dl"] / max(st["doc_count"], 1) or 1.0
+                dense_tfn[i, docs] = compute_tfn(
+                    tfs,
+                    fld_norms[docs] if fld_norms is not None else None,
+                    avgdl,
+                    fld_norms is not None,
+                )
+
         return ShardPack(
             num_docs=N,
             post_docids=post_docids,
             post_tfs=post_tfs,
+            post_dls=post_dls,
             term_block_start=term_block_start,
             term_df=term_df,
             block_max_tf=block_max_tf,
@@ -344,4 +401,6 @@ class PackBuilder:
             docvalues=docvalues,
             vectors=vectors,
             live=np.ones(N, dtype=bool),
+            dense_tfn=dense_tfn,
+            dense_dict=dense_dict,
         )

@@ -1,0 +1,204 @@
+"""Batched multi-query BM25 execution: the `_msearch` fast path.
+
+The reference executes an _msearch as independent async per-shard searches
+(reference behavior: action/search/TransportMultiSearchAction.java fan-out).
+On TPU a batch of term-disjunction queries is a single fused program with NO
+scatter anywhere (profiling: element scatter runs ~200ns/element on TPU — the
+one pattern to design out):
+
+  dense tier:  scores[Q, N] = W[Q, V_dense] @ dense_tfn[V_dense, N]   (MXU)
+  sparse tail: gather CSR rows -> per-posting partial scores -> sort by
+               docid -> run-sum (cummax segmented-scan trick) -> explicit
+               (docid, score) candidates
+  merge:       dense top-k (candidates masked out) ++ candidates -> top-k
+
+Exactness: every sparse candidate's full score = its run-sum + the dense-tier
+score gathered at its docid; a doc with only dense contributions is exact in
+the matmul; duplicates between the two lists are removed by masking the dense
+top-k entries that appear among candidates. Totals are exact:
+|{dense match}| + |{candidates with zero dense score}|.
+
+Constraint: all term weights must be > 0 (true for BM25: idf > 0, boost > 0),
+so "matches" == "score > 0". The generic per-query path handles boost == 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.pack import BLOCK
+
+
+@dataclass
+class BatchPlan:
+    """Host-side per-batch inputs (all fixed-shape, stackable)."""
+
+    W: np.ndarray  # [Q, V_dense] f32 dense-tier weights (0 = term unused)
+    sparse_rows: np.ndarray  # [Q, Ts, B] int32 CSR block rows (0-padded)
+    sparse_weights: np.ndarray  # [Q, Ts] f32
+    k: int
+
+
+def batch_term_disjunction(
+    dev: dict,
+    plan_shapes: tuple,  # (Ts, B, k) — trace-time constants
+    W: jax.Array,
+    sparse_rows: jax.Array,
+    sparse_weights: jax.Array,
+    avgdl: float,
+    num_docs: int,
+    k1: float = 1.2,
+    b: float = 0.75,
+    has_norms: bool = True,
+):
+    """-> (scores [Q,k], docids [Q,k], totals [Q]). Jit-traceable."""
+    Ts, B, k = plan_shapes
+    live = dev["live"]
+    n = num_docs
+
+    # ---- dense tier on the MXU ------------------------------------------
+    dense = dev.get("dense_tfn")
+    if dense is not None and W.shape[1] > 0:
+        # HIGHEST: full-f32 MXU passes — default TPU matmul rounds through
+        # bf16, which costs ~1e-4 relative score error vs the scalar path
+        scores_d = jnp.matmul(W, dense, precision=jax.lax.Precision.HIGHEST)
+    else:
+        scores_d = jnp.zeros((W.shape[0], n), jnp.float32)
+    scores_d = jnp.where(live[None, :], scores_d, 0.0)
+
+    # ---- sparse tail: explicit candidates, no scatter -------------------
+    docids = dev["post_docids"][sparse_rows]  # [Q, Ts, B, 128]
+    tfs = dev["post_tfs"][sparse_rows]
+    if has_norms:
+        dls = dev["post_dls"][sparse_rows]
+        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
+    else:
+        denom = tfs + k1
+    part = sparse_weights[:, :, None, None] * tfs / denom  # pad lanes -> 0
+    Q = docids.shape[0]
+    C = Ts * B * BLOCK
+    cd = docids.reshape(Q, C)
+    cs = part.reshape(Q, C)
+    # padding lanes carry docid == num_docs and score 0; sort pushes them last
+    order = jnp.argsort(cd, axis=1)
+    sd = jnp.take_along_axis(cd, order, axis=1)
+    sv = jnp.take_along_axis(cs, order, axis=1)
+    # run sums: csum - (csum just before this run's start), run start base
+    # propagated forward by cummax (csum - sv is non-decreasing: sv >= 0)
+    csum = jnp.cumsum(sv, axis=1)
+    col = jnp.arange(C)
+    starts = jnp.where(col[None, :] == 0, True, sd != jnp.roll(sd, 1, axis=1))
+    base = jnp.where(starts, csum - sv, -jnp.inf)
+    run_base = jax.lax.cummax(base, axis=1)
+    run_sum = csum - run_base
+    is_end = jnp.where(col[None, :] == C - 1, True, sd != jnp.roll(sd, -1, axis=1))
+    live_c = live[jnp.minimum(sd, n - 1)] & (sd < n)
+    valid_end = is_end & live_c
+    # full candidate score = sparse run sum + dense score at that doc
+    dg = jnp.take_along_axis(scores_d, jnp.minimum(sd, n - 1), axis=1)
+    cand = jnp.where(valid_end, run_sum + dg, -jnp.inf)
+
+    # ---- merge ----------------------------------------------------------
+    masked_d = jnp.where(live[None, :] & (scores_d > 0), scores_d, -jnp.inf)
+    dv, di = jax.lax.top_k(masked_d, k)  # [Q, k]
+    dup = (di[:, :, None] == sd[:, None, :]) & valid_end[:, None, :]
+    dv = jnp.where(dup.any(-1), -jnp.inf, dv)
+    all_v = jnp.concatenate([cand, dv], axis=1)
+    all_i = jnp.concatenate([sd, di], axis=1)
+    # exact (score desc, docid asc) order across both lists: non-negative IEEE
+    # f32 bit patterns sort like values as int32 (and -inf sorts below all),
+    # so pack [score_bits | ~docid] into one int64 rank key
+    score_bits = jax.lax.bitcast_convert_type(all_v, jnp.int32).astype(jnp.int64)
+    rank = (score_bits << 32) + (jnp.int64(0xFFFFFFFF) - all_i.astype(jnp.int64))
+    _, fidx = jax.lax.top_k(rank, k)
+    fv = jnp.take_along_axis(all_v, fidx, axis=1)
+    fids = jnp.take_along_axis(all_i, fidx, axis=1)
+
+    totals = (masked_d > 0).sum(axis=1) + (valid_end & (dg <= 0) & (run_sum > 0)).sum(axis=1)
+    return fv, fids, totals.astype(jnp.int32)
+
+
+class BatchTermSearcher:
+    """Compiled-plan cache for batched term-disjunction queries against one
+    ShardSearcher's device pack."""
+
+    def __init__(self, searcher):
+        self.searcher = searcher
+        self._cache = {}
+
+    def _compiled(self, key):
+        fn = self._cache.get(key)
+        if fn is None:
+            Ts, B, k, fld = key
+            pack = self.searcher.pack
+            fn = jax.jit(
+                lambda dev, W, sr, sw: batch_term_disjunction(
+                    dev,
+                    (Ts, B, k),
+                    W,
+                    sr,
+                    sw,
+                    avgdl=pack.avgdl(fld),
+                    num_docs=pack.num_docs,
+                    has_norms=fld in self.searcher.ctx.has_norms,
+                )
+            )
+            self._cache[key] = fn
+        return fn
+
+    def plan(self, fld: str, queries: list[list[tuple[str, float]]], k: int) -> BatchPlan:
+        """queries: per query a list of (term, boost) on field `fld`."""
+        from .scoring import bm25_idf
+
+        pack = self.searcher.pack
+        k = min(max(k, 1), max(pack.num_docs, 1))
+        V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
+        Q = len(queries)
+        doc_count = pack.field_stats.get(fld, {}).get("doc_count") or pack.num_docs
+        max_ts, max_b = 1, 1
+        parsed = []
+        for terms in queries:
+            dense, sparse = [], []
+            for term, boost in terms:
+                w = 0.0
+                s0, nb, df = pack.term_blocks(fld, term)
+                if df > 0:
+                    w = boost * bm25_idf(doc_count, df)
+                dr = pack.dense_row_of(fld, term)
+                if dr is not None:
+                    dense.append((dr, w))
+                elif nb > 0:
+                    sparse.append((s0, nb, w))
+                    max_b = max(max_b, nb)
+            max_ts = max(max_ts, len(sparse))
+            parsed.append((dense, sparse))
+        B = 1 << (max_b - 1).bit_length()
+        W = np.zeros((Q, V), np.float32)
+        rows = np.zeros((Q, max_ts, B), np.int32)
+        ws = np.zeros((Q, max_ts), np.float32)
+        for qi, (dense, sparse) in enumerate(parsed):
+            for dr, w in dense:
+                W[qi, dr] += w
+            for ti, (s0, nb, w) in enumerate(sparse):
+                rows[qi, ti, :nb] = np.arange(s0, s0 + nb)
+                ws[qi, ti] = w
+        return BatchPlan(W, rows, ws, k)
+
+    def run(self, fld: str, plan: BatchPlan):
+        """-> (scores [Q,k], docids [Q,k], totals [Q]) on device (async)."""
+        fn = self._compiled(
+            (plan.sparse_rows.shape[1], plan.sparse_rows.shape[2], plan.k, fld)
+        )
+        return fn(
+            self.searcher.dev,
+            jnp.asarray(plan.W),
+            jnp.asarray(plan.sparse_rows),
+            jnp.asarray(plan.sparse_weights),
+        )
+
+    def search(self, fld: str, queries: list[list[tuple[str, float]]], k: int = 10):
+        return jax.device_get(self.run(fld, self.plan(fld, queries, k)))

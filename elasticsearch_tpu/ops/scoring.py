@@ -46,24 +46,29 @@ def bm25_idf(doc_count: int, df: int) -> float:
 def term_score_blocks(
     post_docids: jax.Array,  # [num_blocks, BLOCK] int32
     post_tfs: jax.Array,  # [num_blocks, BLOCK] float32
+    post_dls: jax.Array,  # [num_blocks, BLOCK] float32 (dl per posting)
     rows: jax.Array,  # [B] int32 block rows for this term (0-padded)
     weight: jax.Array,  # scalar f32: boost * idf
-    norms: jax.Array | None,  # [N] f32 dequantized doc lengths, or None
     avgdl: jax.Array | float,  # scalar
     num_docs: int,
     k1: float = 1.2,
     b: float = 0.75,
+    has_norms: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
     """Score one term's postings blocks.
+
+    The doc length rides IN the postings block (`post_dls`), so BM25 is pure
+    FMA over gathered rows — no random-access norms gather, which profiling
+    shows is ~100x slower than row gathers on TPU.
 
     Returns (scores[N+1] f32, match[N+1] bool). Padding lanes (docid == N,
     tf == 0) score exactly 0 and scatter into the dead slot.
     """
     docids = post_docids[rows]  # [B, 128]
     tfs = post_tfs[rows]  # [B, 128]
-    if norms is not None:
-        dl = norms[jnp.minimum(docids, num_docs - 1)]
-        denom = tfs + k1 * (1.0 - b + b * dl / avgdl)
+    if has_norms:
+        dls = post_dls[rows]
+        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
     else:
         denom = tfs + k1
     # tf==0 padding -> 0/k1' = 0
@@ -75,6 +80,23 @@ def term_score_blocks(
     match = jnp.zeros(num_docs + DEAD_SLOT_PAD, bool).at[flat_ids].set(
         (tfs > 0).reshape(-1), mode="drop"
     )
+    return scores, match
+
+
+def dense_term_scores(
+    tfn_row: jax.Array,  # [N] f32 precomputed tf/(tf + K) for this term
+    weight: jax.Array,  # scalar f32: boost * idf
+    num_docs: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Score one dense-tier term (df above the dense threshold).
+
+    High-df terms are stored as dense tfn rows ([V_dense, N] in the pack);
+    scoring is a pure elementwise scale — no gather, no scatter. tfn > 0
+    iff tf > 0, so the row doubles as the match bitmap.
+    """
+    n1 = num_docs + DEAD_SLOT_PAD
+    scores = jnp.zeros(n1, jnp.float32).at[:num_docs].set(weight * tfn_row)
+    match = jnp.zeros(n1, bool).at[:num_docs].set(tfn_row > 0)
     return scores, match
 
 

@@ -74,7 +74,7 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
     dev = {
         "post_docids": put(sp.post_docids),
         "post_tfs": put(sp.post_tfs),
-        "norms": {f: put(a) for f, a in sp.norms.items()},
+        "post_dls": put(sp.post_dls),
         "text_has": {f: put(a) for f, a in sp.text_present.items()},
         "dv_int": {},
         "dv_float": {},
@@ -95,6 +95,8 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
+    if sp.dense_tfn is not None:
+        dev["dense_tfn"] = put(sp.dense_tfn)
     return dev
 
 
@@ -126,6 +128,11 @@ class StackedSearcher:
             avgdl={f: self._avgdl(f) for f in stacked.norms},
             has_norms=frozenset(stacked.norms),
             sharded=True,
+        )
+        from ..index.pack import BM25_K1, BM25_B
+
+        assert not stacked.dense_dict or (self.ctx.k1, self.ctx.b) == (BM25_K1, BM25_B), (
+            "dense-tier packs bake default k1/b; rebuild with dense disabled"
         )
         self._cache: dict = {}
 

@@ -83,9 +83,18 @@ class _ShardView:
         s, n, df = self.pack.term_blocks(fld, term)
         return s, n, self.stacked.global_df.get((fld, term), df)
 
+    def dense_row_of(self, fld, term):
+        # global tier decision: identical on every shard (see StackedPack)
+        return self.stacked.dense_dict.get((fld, term))
+
 
 class StackedPack:
-    def __init__(self, shards: list[ShardPack], mappings: Mappings):
+    def __init__(
+        self,
+        shards: list[ShardPack],
+        mappings: Mappings,
+        dense_min_df: int | None = None,
+    ):
         self.shards = shards
         self.mappings = mappings
         self.S = len(shards)
@@ -166,12 +175,14 @@ class StackedPack:
         # ---- stacked postings & norms ------------------------------------
         self.post_docids = np.full((self.S, self.nb_max, BLOCK), self.n_max, np.int32)
         self.post_tfs = np.zeros((self.S, self.nb_max, BLOCK), np.float32)
+        self.post_dls = np.ones((self.S, self.nb_max, BLOCK), np.float32)
         self.live = np.zeros((self.S, self.n_max), bool)
         for i, p in enumerate(shards):
             d = p.post_docids.copy()
             d[d == p.num_docs] = self.n_max  # re-sentinel padding to n_max
             self.post_docids[i, : p.num_blocks] = d
             self.post_tfs[i, : p.num_blocks] = p.post_tfs
+            self.post_dls[i, : p.num_blocks] = p.post_dls
             self.live[i, : p.num_docs] = p.live
         norm_fields = sorted({f for p in shards for f in p.norms})
         self.norms = {}
@@ -197,6 +208,38 @@ class StackedPack:
                     vals[i, : p.num_docs] = p.vectors[fld].values
                     has[i, : p.num_docs] = p.vectors[fld].has_value
             self.vectors[fld] = VectorColumn(vals, has, vc0.similarity, vc0.dims)
+
+        # ---- global dense tier -------------------------------------------
+        # tier membership must be a GLOBAL decision (global df) so every
+        # shard's query plan routes each term identically — the per-shard
+        # program is traced once for the whole mesh. tfn rows bake the
+        # GLOBAL avgdl (dfs_query_then_fetch stats, like all scoring here).
+        from ..index.pack import compute_tfn, default_dense_min_df
+
+        n_total = sum(p.num_docs for p in shards)
+        thresh = dense_min_df if dense_min_df is not None else default_dense_min_df(n_total)
+        dense_keys = sorted(k for k, df in self.global_df.items() if df >= thresh)
+        self.dense_dict: dict[tuple[str, str], int] = {
+            k: i for i, k in enumerate(dense_keys)
+        }
+        self.dense_tfn = None
+        if dense_keys:
+            self.dense_tfn = np.zeros((self.S, len(dense_keys), self.n_max), np.float32)
+            for i, k in enumerate(dense_keys):
+                fld = k[0]
+                st = self.field_stats.get(fld, {"sum_dl": 0.0, "doc_count": 0})
+                avgdl = st["sum_dl"] / max(st["doc_count"], 1) or 1.0
+                for s, p in enumerate(shards):
+                    s0, nb, _df = p.term_blocks(fld, k[1])
+                    if nb == 0:
+                        continue
+                    docs = p.post_docids[s0 : s0 + nb].ravel()
+                    valid = docs < p.num_docs
+                    docs = docs[valid]
+                    tfs = p.post_tfs[s0 : s0 + nb].ravel()[valid]
+                    has_norms = fld in p.norms
+                    dls = p.post_dls[s0 : s0 + nb].ravel()[valid] if has_norms else None
+                    self.dense_tfn[s, i, docs] = compute_tfn(tfs, dls, avgdl, has_norms)
 
     @property
     def num_docs(self) -> int:
@@ -225,7 +268,10 @@ def build_stacked_pack_routed(
     for b, shard_docs in zip(builders, routed):
         for _, source in shard_docs:
             b.add_document(mappings.parse_document(source))
-    return StackedPack([b.build() for b in builders], mappings)
+    # per-shard dense tiers disabled: StackedPack builds its own global one
+    # (global df decisions + global avgdl), so a local tier would only burn
+    # build time and host RAM
+    return StackedPack([b.build(dense_min_df=1 << 62) for b in builders], mappings)
 
 
 def build_stacked_pack(

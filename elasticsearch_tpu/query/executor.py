@@ -32,7 +32,7 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
     dev = {
         "post_docids": put(pack.post_docids),
         "post_tfs": put(pack.post_tfs),
-        "norms": {f: put(a) for f, a in pack.norms.items()},
+        "post_dls": put(pack.post_dls),
         "text_has": {f: put(a) for f, a in pack.text_present.items()},
         "dv_int": {},
         "dv_float": {},
@@ -53,6 +53,8 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
+    if pack.dense_tfn is not None:
+        dev["dense_tfn"] = put(pack.dense_tfn)
     return dev
 
 
@@ -74,6 +76,11 @@ class ShardSearcher:
             num_docs=pack.num_docs,
             avgdl={f: pack.avgdl(f) for f in pack.norms},
             has_norms=frozenset(pack.norms),
+        )
+        from ..index.pack import BM25_K1, BM25_B
+
+        assert not pack.dense_dict or (self.ctx.k1, self.ctx.b) == (BM25_K1, BM25_B), (
+            "dense-tier packs bake default k1/b; rebuild with dense disabled"
         )
         self._cache: dict = {}
 

@@ -52,7 +52,12 @@ def _pad_rows(start: int, count: int) -> np.ndarray:
 
 @dataclass
 class ExecContext:
-    """Static per-pack info available during tracing."""
+    """Static per-pack info available during tracing.
+
+    k1/b apply to the sparse CSR scoring path only; dense-tier tfn rows bake
+    the BM25 defaults at pack build (index/pack.py BM25_K1/BM25_B), so
+    non-default values require building the pack with the dense tier
+    disabled (dense_min_df large) — enforced by the searchers."""
 
     num_docs: int
     avgdl: dict[str, float]
@@ -82,30 +87,40 @@ class TermNode(QueryNode):
     fld: str
     term: str
     boost: float = 1.0
+    _dense: bool = False
 
     def prepare(self, pack):
         start, count, df = pack.term_blocks(self.fld, self.term)
-        rows = _pad_rows(start, count)
         if df > 0:
             doc_count = pack.field_stats.get(self.fld, {}).get("doc_count") or pack.num_docs
             weight = np.float32(self.boost * bm25_idf(doc_count, df))
         else:
             weight = np.float32(0.0)
+        dr = pack.dense_row_of(self.fld, self.term)
+        self._dense = dr is not None
+        if self._dense:
+            return (np.int32(dr), weight), ("term_dense", self.fld)
+        rows = _pad_rows(start, count)
         return (rows, weight), ("term", self.fld, len(rows))
 
     def device_eval(self, dev, params, ctx):
+        if self._dense:
+            from ..ops.scoring import dense_term_scores
+
+            dr, weight = params
+            return dense_term_scores(dev["dense_tfn"][dr], weight, ctx.num_docs)
         rows, weight = params
-        norms = dev["norms"].get(self.fld) if self.fld in ctx.has_norms else None
         return term_score_blocks(
             dev["post_docids"],
             dev["post_tfs"],
+            dev["post_dls"],
             rows,
             weight,
-            norms,
             ctx.avgdl.get(self.fld, 1.0),
             ctx.num_docs,
             ctx.k1,
             ctx.b,
+            has_norms=self.fld in ctx.has_norms,
         )
 
 

@@ -1,0 +1,71 @@
+"""Batched _msearch fast path vs the generic per-query path: exact parity."""
+
+import numpy as np
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.ops.batched import BatchTermSearcher
+from elasticsearch_tpu.query import ShardSearcher
+from elasticsearch_tpu.query.nodes import BoolNode, TermNode
+
+
+def _assert_hits_match(scores_q, ids_q, ref, ctx=()):
+    """Hits equal the reference, except docs whose scores agree to ~1e-5
+    relative may swap ranks: the two paths sum in different orders, so
+    fp-ties (incl. at the k boundary) can resolve differently."""
+    nhits = len(ref.doc_ids)
+    got_v = scores_q[np.isfinite(scores_q)][:nhits]
+    got_i = ids_q[:nhits]
+    np.testing.assert_allclose(got_v, ref.scores, rtol=1e-5)
+    for pos, (gi, ri) in enumerate(zip(got_i, ref.doc_ids)):
+        if gi != ri:
+            a, b = float(got_v[pos]), float(ref.scores[pos])
+            assert abs(a - b) <= 1e-5 * max(abs(b), 1.0), (*ctx, pos, gi, ri, a, b)
+
+
+def _build(n_docs=300, vocab=40, seed=3, dense_min_df=20):
+    rng = np.random.default_rng(seed)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    # zipf-ish: low word-ids common, high rare
+    for _ in range(n_docs):
+        ln = int(rng.integers(3, 12))
+        words = (rng.zipf(1.4, size=ln) - 1) % vocab
+        b.add_document(m.parse_document({"body": " ".join(f"w{w}" for w in words)}))
+    pack = b.build(dense_min_df=dense_min_df)
+    return ShardSearcher(pack, mappings=m), rng
+
+
+def test_batched_matches_per_query():
+    s, rng = _build()
+    assert s.pack.dense_dict, "corpus should produce dense-tier terms"
+    bs = BatchTermSearcher(s)
+    queries = []
+    for _ in range(32):
+        nt = int(rng.integers(1, 5))
+        queries.append([(f"w{int(rng.integers(0, 45))}", 1.0) for _ in range(nt)])
+    k = 7
+    scores, ids, totals = bs.search("body", queries, k=k)
+    for qi, terms in enumerate(queries):
+        node = BoolNode(
+            should=[TermNode("body", t) for t, _ in terms], minimum_should_match=1
+        )
+        ref = s.search(node, size=k)
+        assert totals[qi] == ref.total, (qi, terms)
+        _assert_hits_match(scores[qi], ids[qi], ref, ctx=(qi, terms))
+
+
+def test_batched_all_sparse_and_all_dense():
+    for dmd in (1, 10**9):  # everything dense / everything sparse
+        s, rng = _build(dense_min_df=dmd)
+        bs = BatchTermSearcher(s)
+        queries = [[("w1", 1.0), ("w30", 2.0)], [("w0", 1.0)], [("missing", 1.0)]]
+        scores, ids, totals = bs.search("body", queries, k=5)
+        for qi, terms in enumerate(queries):
+            node = BoolNode(
+                should=[TermNode("body", t, boost=bo) for t, bo in terms],
+                minimum_should_match=1,
+            )
+            ref = s.search(node, size=5)
+            assert totals[qi] == ref.total
+            _assert_hits_match(scores[qi], ids[qi], ref, ctx=(dmd, qi))

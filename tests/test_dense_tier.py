@@ -1,0 +1,99 @@
+"""Dense-tier scoring parity: forcing every term dense must not change any
+result vs the sparse blocked-CSR path or the pure-python oracle."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.parallel import StackedSearcher, make_mesh
+from elasticsearch_tpu.parallel.stacked import StackedPack, route_docs
+from elasticsearch_tpu.query import ShardSearcher
+
+from reference_scorer import Oracle
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+DOCS = [
+    {"body": "the quick brown fox jumps over the lazy dog", "tag": "animal"},
+    {"body": "quick quick quick fox", "tag": "animal"},
+    {"body": "the lazy dog sleeps all day", "tag": "pet"},
+    {"body": "a fox and a dog become friends", "tag": "story"},
+    {"body": "nothing to see here", "tag": "misc"},
+    {"body": "brown bears and brown foxes", "tag": "animal"},
+]
+
+QUERIES = [
+    {"match": {"body": "fox"}},
+    {"match": {"body": "quick brown fox"}},
+    {"term": {"tag": "animal"}},
+    {"bool": {"must": [{"match": {"body": "dog"}}], "should": [{"match": {"body": "lazy"}}]}},
+    {"bool": {"should": [{"match": {"body": "fox"}}, {"term": {"tag": "pet"}}]}},
+]
+
+
+def _searcher(dense_min_df):
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS:
+        b.add_document(m.parse_document(d))
+    return ShardSearcher(b.build(dense_min_df=dense_min_df), mappings=m), m
+
+
+@pytest.mark.parametrize("query", QUERIES)
+def test_all_dense_matches_oracle(query):
+    s, m = _searcher(dense_min_df=1)  # every term dense
+    oracle = Oracle(DOCS, Mappings(MAPPING))
+    res = s.search(query, size=10)
+    expected, total = oracle.search(query, size=10)
+    assert res.total == total
+    for (eid, escore), gid, gscore in zip(expected, res.doc_ids, res.scores):
+        assert eid == gid
+        assert abs(escore - gscore) < 1e-5
+
+
+def test_mixed_tier_matches_all_sparse():
+    # df threshold 3: fox/dog/the/brown land dense, the rest sparse
+    s_mixed, m = _searcher(dense_min_df=3)
+    s_sparse, _ = _searcher(dense_min_df=10**9)
+    assert s_mixed.pack.dense_dict, "threshold should have produced dense terms"
+    assert not s_sparse.pack.dense_dict
+    for query in QUERIES:
+        a = s_mixed.search(query, size=10)
+        b = s_sparse.search(query, size=10)
+        assert a.total == b.total
+        np.testing.assert_array_equal(a.doc_ids, b.doc_ids)
+        np.testing.assert_allclose(a.scores, b.scores, rtol=1e-6)
+
+
+def test_stacked_dense_tier_matches_single():
+    docs = [(f"d{i}", d) for i, d in enumerate(DOCS * 4)]
+    m = Mappings(MAPPING)
+    sp = StackedPack(
+        [_pack_for(shard, m) for shard in route_docs(docs, 4)], m, dense_min_df=2
+    )
+    assert sp.dense_dict
+    sharded = StackedSearcher(sp, mesh=make_mesh(4))
+    b = PackBuilder(m)
+    for _, d in docs:
+        b.add_document(m.parse_document(d))
+    single = ShardSearcher(b.build(dense_min_df=10**9), mappings=m)
+    for query in QUERIES:
+        rs = sharded.search(query, size=24)
+        r1 = single.search(query, size=24)
+        assert rs.total == r1.total, query
+        np.testing.assert_allclose(
+            np.sort(rs.scores)[::-1], np.sort(r1.scores)[::-1], rtol=1e-5
+        )
+
+
+def _pack_for(shard_docs, m):
+    b = PackBuilder(m)
+    for _, d in shard_docs:
+        b.add_document(m.parse_document(d))
+    return b.build()
