@@ -31,11 +31,11 @@ BASELINE_QPS = 1500.0  # stand-in: 32-vCPU ES 8.x, single-shard match top-10
 N_DOCS = 30_000
 VOCAB = 4_000
 DOC_LEN_MEAN = 40  # msmarco passages average ~55 terms; keep pack build fast
-N_QUERIES = 512  # one batch = one _msearch fan-in
+N_QUERIES = 4096  # one batch = one _msearch fan-in; large batch amortizes tunnel RTT
 TERMS_PER_QUERY = 4
 TOP_K = 10
 WARMUP = 3
-ITERS = 30
+ITERS = 12
 
 
 def build_corpus(rng):

@@ -53,6 +53,13 @@ BLOCK = 128  # TPU lane width; one postings block = one vector register row
 BM25_K1 = 1.2
 BM25_B = 0.75
 
+# Position keys: docid * POS_L + position, in blocked sorted int64 arrays.
+# POS_L is a GLOBAL constant (not per-pack) so one traced phrase program
+# serves every shard of a mesh. 2^17 positions per doc ~ Lucene's practical
+# token limit; key range fits int64 with room for the +INF padding sentinel.
+POS_L = 1 << 17
+POS_INF = np.int64(1) << 62
+
 
 def default_dense_min_df(n_docs: int) -> int:
     """df threshold above which a term moves to the dense tier. ~1 posting
@@ -123,6 +130,11 @@ class ShardPack:
     # with no gather or scatter. K bakes this pack's avgdl and BM25 defaults.
     dense_tfn: np.ndarray | None = None
     dense_dict: dict[tuple[str, str], int] = dc_field(default_factory=dict)
+    # positions (phrase queries): blocked sorted int64 keys docid*POS_L+pos;
+    # pad lanes = POS_INF; row 0 reserved all-padding (query lists 0-pad)
+    pos_keys: np.ndarray | None = None  # [num_pos_blocks, BLOCK] int64
+    term_pos_start: np.ndarray | None = None  # [T+1] int32 block row ranges
+    term_pos_count: np.ndarray | None = None  # [T] int32 total positions
 
     def dense_row_of(self, fld: str, term: str) -> int | None:
         return self.dense_dict.get((fld, term))
@@ -153,6 +165,28 @@ class ShardPack:
         e = int(self.term_block_start[tid + 1])
         return s, e - s, int(self.term_df[tid])
 
+    def term_pos_blocks(self, fld: str, term: str) -> tuple[int, int, int]:
+        """-> (pos_block_row_start, n_blocks, n_positions); zeros if absent."""
+        tid = self.term_dict.get((fld, term))
+        if tid is None or self.term_pos_start is None:
+            return 0, 0, 0
+        s = int(self.term_pos_start[tid])
+        e = int(self.term_pos_start[tid + 1])
+        return s, e - s, int(self.term_pos_count[tid])
+
+    def terms_for_field(self, fld: str) -> list[str]:
+        """Sorted terms of one field (host-side term dictionary slice — the
+        analog of Lucene's per-field FST enum, used by multi-term query
+        expansion: prefix/wildcard/regexp/fuzzy). Cached per field."""
+        cache = getattr(self, "_field_terms_cache", None)
+        if cache is None:
+            cache = self._field_terms_cache = {}
+        terms = cache.get(fld)
+        if terms is None:
+            # term_dict iteration order is sorted (field, term): build() sorts
+            terms = cache[fld] = [t for (f, t) in self.term_dict if f == fld]
+        return terms
+
 
 class PackBuilder:
     """Accumulates parsed documents for one shard, then packs.
@@ -167,6 +201,8 @@ class PackBuilder:
         self.mappings = mappings
         # (field, term) -> {docid: tf}
         self.postings: dict[tuple[str, str], dict[int, int]] = {}
+        # (field, term) -> {docid: [positions]} (phrase support)
+        self.positions: dict[tuple[str, str], dict[int, list[int]]] = {}
         self.doc_field_lengths: dict[str, list[tuple[int, int]]] = {}
         # field -> (last_docid_seen, docs_with_field); docids arrive in order
         self.field_doc_counts: dict[str, list[int]] = {}
@@ -189,12 +225,30 @@ class PackBuilder:
                 analyzer = ft.get_analyzer()
                 length = 0
                 counts: dict[str, int] = {}
+                pos_lists: dict[str, list[int]] = {}
+                pos_base = 0
                 for v in values:
+                    last_pos = -1
                     for tok in analyzer.analyze(v):
                         counts[tok.term] = counts.get(tok.term, 0) + 1
+                        pos = pos_base + tok.position
+                        # positions beyond the key range are dropped (the doc
+                        # still matches term queries; phrases can't see its
+                        # tail — the analog of Lucene's MAX_POSITION bound,
+                        # made lossy instead of fatal so one oversized doc
+                        # can't poison every later refresh)
+                        if pos < POS_L - 64:
+                            pos_lists.setdefault(tok.term, []).append(pos)
+                        last_pos = max(last_pos, tok.position)
                         length += 1
+                    # multi-valued text: position gap between values
+                    # (reference behavior: TextFieldMapper position_increment_gap
+                    # default 100)
+                    pos_base += last_pos + 1 + 100
                 for term, tf in counts.items():
                     self.postings.setdefault((fld, term), {})[docid] = tf
+                    if term in pos_lists:
+                        self.positions.setdefault((fld, term), {})[docid] = pos_lists[term]
                 self.doc_field_lengths.setdefault(fld, []).append((docid, length))
             elif t in KEYWORD_TYPES:
                 kept = []
@@ -364,6 +418,37 @@ class PackBuilder:
                 has[docid] = True
             vectors[fld] = VectorColumn(vals, has, ft.similarity, ft.dims)
 
+        # ---- position blocks (text terms only) ---------------------------
+        pos_keys = None
+        term_pos_start = None
+        term_pos_count = None
+        if self.positions:
+            n_pos_blocks_per_term = []
+            for k in keys:
+                plists = self.positions.get(k)
+                npos = sum(len(v) for v in plists.values()) if plists else 0
+                n_pos_blocks_per_term.append((npos + BLOCK - 1) // BLOCK)
+            total_pos_blocks = 1 + int(sum(n_pos_blocks_per_term))
+            pos_keys = np.full((total_pos_blocks, BLOCK), POS_INF, dtype=np.int64)
+            term_pos_start = np.zeros(T + 1, dtype=np.int32)
+            term_pos_count = np.zeros(T, dtype=np.int32)
+            prow = 1
+            for tid, k in enumerate(keys):
+                term_pos_start[tid] = prow
+                plists = self.positions.get(k)
+                if not plists:
+                    continue
+                flat = np.array(
+                    [d * POS_L + p for d in sorted(plists) for p in plists[d]],
+                    dtype=np.int64,
+                )
+                term_pos_count[tid] = len(flat)
+                for off in range(0, len(flat), BLOCK):
+                    chunk = flat[off : off + BLOCK]
+                    pos_keys[prow, : len(chunk)] = chunk
+                    prow += 1
+            term_pos_start[T] = prow
+
         # ---- dense tier --------------------------------------------------
         dense_keys = [k for k in keys if len(self.postings[k]) >= dense_min_df]
         dense_dict = {k: i for i, k in enumerate(dense_keys)}
@@ -403,4 +488,7 @@ class PackBuilder:
             live=np.ones(N, dtype=bool),
             dense_tfn=dense_tfn,
             dense_dict=dense_dict,
+            pos_keys=pos_keys,
+            term_pos_start=term_pos_start,
+            term_pos_count=term_pos_count,
         )

@@ -75,6 +75,7 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         "post_docids": put(sp.post_docids),
         "post_tfs": put(sp.post_tfs),
         "post_dls": put(sp.post_dls),
+        "norms": {f: put(a) for f, a in sp.norms.items()},
         "text_has": {f: put(a) for f, a in sp.text_present.items()},
         "dv_int": {},
         "dv_float": {},
@@ -97,6 +98,8 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
     if sp.dense_tfn is not None:
         dev["dense_tfn"] = put(sp.dense_tfn)
+    if sp.pos_keys is not None:
+        dev["pos_keys"] = put(sp.pos_keys)
     return dev
 
 

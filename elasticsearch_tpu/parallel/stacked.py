@@ -87,6 +87,14 @@ class _ShardView:
         # global tier decision: identical on every shard (see StackedPack)
         return self.stacked.dense_dict.get((fld, term))
 
+    def terms_for_field(self, fld):
+        # expansion is per-shard (each shard enumerates its own dictionary),
+        # matching the reference's per-shard MultiTermQuery rewrite
+        return self.pack.terms_for_field(fld)
+
+    def term_pos_blocks(self, fld, term):
+        return self.pack.term_pos_blocks(fld, term)
+
 
 class StackedPack:
     def __init__(
@@ -184,6 +192,19 @@ class StackedPack:
             self.post_tfs[i, : p.num_blocks] = p.post_tfs
             self.post_dls[i, : p.num_blocks] = p.post_dls
             self.live[i, : p.num_docs] = p.live
+        # ---- stacked position blocks -------------------------------------
+        self.pos_keys = None
+        if any(p.pos_keys is not None for p in shards):
+            from ..index.pack import POS_INF
+
+            nbp_max = max(
+                (p.pos_keys.shape[0] for p in shards if p.pos_keys is not None),
+                default=1,
+            )
+            self.pos_keys = np.full((self.S, nbp_max, BLOCK), POS_INF, np.int64)
+            for i, p in enumerate(shards):
+                if p.pos_keys is not None:
+                    self.pos_keys[i, : p.pos_keys.shape[0]] = p.pos_keys
         norm_fields = sorted({f for p in shards for f in p.norms})
         self.norms = {}
         self.text_present = {}

@@ -36,6 +36,8 @@ from .nodes import (
     ConstantScoreNode,
     DisMaxNode,
     BoolNode,
+    ExpandedTermsNode,
+    PhraseNode,
     KnnNode,
 )
 
@@ -133,6 +135,38 @@ def _parse_multi_match(body, mappings):
     if mm_type == "most_fields":
         return BoolNode(should=children, boost=boost)
     return DisMaxNode(children=children, tie_breaker=tie, boost=boost)
+
+
+def _parse_match_phrase(body, mappings):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[match_phrase] query expects {field: ...}")
+    (fld, spec), = body.items()
+    if not isinstance(spec, dict):
+        spec = {"query": spec}
+    if "query" not in spec:
+        raise QueryParsingError("[match_phrase] requires [query]")
+    text = str(spec["query"])
+    boost = float(spec.get("boost", 1.0))
+    slop = int(spec.get("slop", 0))
+    ft = mappings.fields.get(fld)
+    if ft is None or ft.type in KEYWORD_TYPES:
+        return TermNode(fld, text, boost=boost)
+    if ft.type not in TEXT_TYPES:
+        kind, v = _coerce_for_field(mappings, fld, text)
+        return RangeNode(fld, v, v, kind=kind, boost=boost)
+    analyzer = ft.get_search_analyzer()
+    if analyzer is None:
+        from ..analysis import get_analyzer
+
+        analyzer = get_analyzer("standard")
+    toks = analyzer.analyze(text)
+    if not toks:
+        return MatchNoneNode()
+    if len(toks) == 1:
+        return TermNode(fld, toks[0].term, boost=boost)
+    return PhraseNode(
+        fld, [(t.term, t.position) for t in toks], boost=boost, slop=slop
+    )
 
 
 def _parse_term(body, mappings):
@@ -285,6 +319,153 @@ def parse_knn(body, mappings) -> KnnNode:
     )
 
 
+def _single_field_body(kind, body, value_key="value"):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError(f"[{kind}] query expects {{field: ...}}")
+    (fld, spec), = body.items()
+    if isinstance(spec, dict):
+        if value_key not in spec:
+            raise QueryParsingError(f"[{kind}] requires [{value_key}]")
+        return fld, spec
+    return fld, {value_key: spec}
+
+
+def _parse_prefix(body, mappings):
+    fld, spec = _single_field_body("prefix", body)
+    value = str(spec["value"])
+    ci = bool(spec.get("case_insensitive", False))
+    pre = value.lower() if ci else value
+    matcher = (lambda t: t.lower().startswith(pre)) if ci else (lambda t: t.startswith(pre))
+    return ExpandedTermsNode(
+        kind="prefix", fld=fld, matcher=matcher, boost=float(spec.get("boost", 1.0))
+    )
+
+
+def _wildcard_regex(pattern: str) -> str:
+    import re as _re
+
+    out = []
+    for ch in pattern:
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        else:
+            out.append(_re.escape(ch))
+    return "".join(out)
+
+
+def _parse_wildcard(body, mappings):
+    import re
+
+    if isinstance(body, dict) and len(body) == 1:
+        # legacy body form {field: {"wildcard": "pat*"}} (still accepted by ES)
+        (fld0, spec0), = body.items()
+        if isinstance(spec0, dict) and "value" not in spec0 and "wildcard" in spec0:
+            body = {fld0: {**spec0, "value": spec0["wildcard"]}}
+    fld, spec = _single_field_body("wildcard", body)
+    pattern = str(spec["value"])
+    flags = re.IGNORECASE if spec.get("case_insensitive", False) else 0
+    rx = re.compile(_wildcard_regex(pattern), flags)
+    return ExpandedTermsNode(
+        kind="wildcard",
+        fld=fld,
+        matcher=lambda t: rx.fullmatch(t) is not None,
+        boost=float(spec.get("boost", 1.0)),
+    )
+
+
+def _parse_regexp(body, mappings):
+    """Lucene RegExp core operators map onto Python re for the common cases;
+    exotic Lucene operators (&, ~ intersection/complement) are unsupported."""
+    import re
+
+    fld, spec = _single_field_body("regexp", body)
+    pattern = str(spec["value"])
+    flags = re.IGNORECASE if spec.get("case_insensitive", False) else 0
+    try:
+        rx = re.compile(pattern, flags)
+    except re.error as e:
+        raise QueryParsingError(f"[regexp] invalid pattern [{pattern}]: {e}")
+    return ExpandedTermsNode(
+        kind="regexp",
+        fld=fld,
+        matcher=lambda t: rx.fullmatch(t) is not None,
+        boost=float(spec.get("boost", 1.0)),
+    )
+
+
+def _edit_distance_within(a: str, b: str, maxd: int, transpositions: bool = True) -> bool:
+    """Banded (Damerau-)Levenshtein with early exit at maxd."""
+    if abs(len(a) - len(b)) > maxd:
+        return False
+    if maxd == 0:
+        return a == b
+    prev2 = None
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cost = 0 if ca == cb else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (
+                transpositions
+                and prev2 is not None
+                and i > 1
+                and j > 1
+                and ca == b[j - 2]
+                and a[i - 2] == cb
+            ):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
+            row_min = min(row_min, cur[j])
+        if row_min > maxd:
+            return False
+        prev2, prev = prev, cur
+    return prev[len(b)] <= maxd
+
+
+def _fuzzy_max_dist(fuzziness, term: str) -> int:
+    s = "AUTO" if fuzziness is None else str(fuzziness).upper()
+    if s.startswith("AUTO"):
+        low, high = 3, 6
+        if s.startswith("AUTO:"):  # AUTO:low,high custom thresholds
+            try:
+                low, high = (int(x) for x in s[5:].split(","))
+            except ValueError:
+                raise QueryParsingError(f"failed to parse fuzziness [{fuzziness}]")
+        n = len(term)
+        return 0 if n < low else (1 if n < high else 2)
+    try:
+        return int(float(s))
+    except ValueError:
+        raise QueryParsingError(f"failed to parse fuzziness [{fuzziness}]")
+
+
+def _parse_fuzzy(body, mappings):
+    fld, spec = _single_field_body("fuzzy", body)
+    value = str(spec["value"])
+    maxd = _fuzzy_max_dist(spec.get("fuzziness"), value)
+    prefix_length = int(spec.get("prefix_length", 0))
+    transpositions = bool(spec.get("transpositions", True))
+    max_expansions = int(spec.get("max_expansions", 50))
+    pre = value[:prefix_length]
+
+    def matcher(t):
+        if prefix_length and not t.startswith(pre):
+            return False
+        return _edit_distance_within(t, value, maxd, transpositions)
+
+    return ExpandedTermsNode(
+        kind="fuzzy",
+        fld=fld,
+        matcher=matcher,
+        boost=float(spec.get("boost", 1.0)),
+        scored=True,
+        max_expansions=max_expansions,
+    )
+
+
 def _parse_ids(body, mappings):
     # resolved by the engine layer (docid lookup is host-side state); the
     # parser represents it as a terms query on the reserved _id keyword column
@@ -334,6 +515,7 @@ class _KeywordRangeNode(RangeNode):
 
 _PARSERS = {
     "match": _parse_match,
+    "match_phrase": _parse_match_phrase,
     "multi_match": _parse_multi_match,
     "match_all": _parse_match_all,
     "match_none": _parse_match_none,
@@ -346,4 +528,8 @@ _PARSERS = {
     "exists": _parse_exists,
     "ids": _parse_ids,
     "knn": parse_knn,
+    "prefix": _parse_prefix,
+    "wildcard": _parse_wildcard,
+    "regexp": _parse_regexp,
+    "fuzzy": _parse_fuzzy,
 }

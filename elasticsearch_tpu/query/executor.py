@@ -33,6 +33,9 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         "post_docids": put(pack.post_docids),
         "post_tfs": put(pack.post_tfs),
         "post_dls": put(pack.post_dls),
+        # [N]-aligned doc lengths: phrase scoring normalizes its per-doc
+        # phrase frequency elementwise against these
+        "norms": {f: put(a) for f, a in pack.norms.items()},
         "text_has": {f: put(a) for f, a in pack.text_present.items()},
         "dv_int": {},
         "dv_float": {},
@@ -55,6 +58,8 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
     if pack.dense_tfn is not None:
         dev["dense_tfn"] = put(pack.dense_tfn)
+    if pack.pos_keys is not None:
+        dev["pos_keys"] = put(pack.pos_keys)
     return dev
 
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..index.pack import ShardPack
-from ..ops.scoring import bm25_idf, term_score_blocks
+from ..ops.scoring import DEAD_SLOT_PAD, bm25_idf, term_score_blocks
 
 MIN_BUCKET = 4
 
@@ -391,6 +391,171 @@ class KnnNode(QueryNode):
             jnp.where(match_n, boost * scores, 0.0)
         )
         return score, match
+
+
+MAX_CLAUSE_COUNT = 4096  # reference behavior: indices.query.bool.max_clause_count
+
+
+@dataclass
+class PhraseNode(QueryNode):
+    """Exact phrase match (reference behavior: index/query/MatchPhraseQueryBuilder
+    -> Lucene PhraseQuery, slop=0). TPU shape: positions are blocked sorted
+    int64 keys (docid*POS_L + position); phrase matching is an m-way sorted-set
+    intersection — the rarest term's keys probe each other term's key set via
+    vectorized binary search (searchsorted), offset by the phrase positions.
+    Phrase frequency (occurrence count per doc) feeds BM25 with the summed
+    per-term idf, matching Lucene's PhraseQuery/BM25 scoring."""
+
+    fld: str = ""
+    terms: list = dc_field(default_factory=list)  # [(term, rel_position)]
+    boost: float = 1.0
+    slop: int = 0
+    _no_pos: bool = False
+
+    def prepare(self, pack):
+        from ..utils.errors import IllegalArgumentError
+
+        if self.slop != 0:
+            raise IllegalArgumentError("[match_phrase] slop > 0 is not supported yet")
+        stacked = getattr(pack, "stacked", None)
+        pos = stacked.pos_keys if stacked is not None else getattr(pack, "pos_keys", None)
+        self._no_pos = pos is None
+        if self._no_pos:
+            # no text tokens indexed anywhere -> nothing can match
+            return (), ("phrase_empty", self.fld)
+        doc_count = pack.field_stats.get(self.fld, {}).get("doc_count") or pack.num_docs
+        idf_sum = 0.0
+        infos = []
+        for term, off in self.terms:
+            ps, nb, cnt = pack.term_pos_blocks(self.fld, term)
+            _s, _n, df = pack.term_blocks(self.fld, term)
+            if df > 0:
+                idf_sum += bm25_idf(doc_count, df)
+            infos.append((ps, nb, cnt, off))
+        # rarest term first: its positions become the probe set
+        infos.sort(key=lambda x: x[2])
+        rows = tuple(_pad_rows(ps, nb) for ps, nb, _c, _o in infos)
+        offsets = np.array([o for _s, _n, _c, o in infos], np.int64)
+        weight = np.float32(self.boost * idf_sum)
+        return (rows, offsets, weight), (
+            "phrase", self.fld, tuple(len(r) for r in rows),
+        )
+
+    def device_eval(self, dev, params, ctx):
+        from ..index.pack import POS_INF, POS_L
+
+        if self._no_pos:
+            n1 = ctx.num_docs + DEAD_SLOT_PAD
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        rows, offsets, weight = params
+        n = ctx.num_docs
+        n1 = n + DEAD_SLOT_PAD
+        pos_keys = dev["pos_keys"]
+        probe = pos_keys[rows[0]].reshape(-1)  # sorted; POS_INF padding
+        base = probe - offsets[0]
+        alive = probe < POS_INF
+        for i in range(1, len(rows)):
+            table = pos_keys[rows[i]].reshape(-1)
+            want = base + offsets[i]
+            idx = jnp.searchsorted(table, want)
+            hit = table[jnp.minimum(idx, table.shape[0] - 1)] == want
+            alive = alive & hit
+        ids = jnp.where(alive, (base // POS_L).astype(jnp.int32), n)
+        phrase_tf = jnp.zeros(n1, jnp.float32).at[ids].add(
+            jnp.where(alive, 1.0, 0.0), mode="drop"
+        )
+        tf = phrase_tf[:n]
+        if self.fld in ctx.has_norms:
+            dl = dev["norms"][self.fld]
+            denom = tf + ctx.k1 * (1.0 - ctx.b + ctx.b * dl / ctx.avgdl.get(self.fld, 1.0))
+        else:
+            denom = tf + ctx.k1
+        scores_n = jnp.where(tf > 0, weight * tf / denom, 0.0)
+        scores = jnp.zeros(n1, jnp.float32).at[:n].set(scores_n)
+        match = jnp.zeros(n1, bool).at[:n].set(tf > 0)
+        return scores, match
+
+
+@dataclass
+class ExpandedTermsNode(QueryNode):
+    """Multi-term query rewritten by host-side term-dictionary expansion
+    (reference behavior: index/query/{Prefix,Wildcard,Regexp,Fuzzy}QueryBuilder
+    -> Lucene MultiTermQuery; the dictionary enum runs host-side like Lucene's
+    FST walk, the doc-set union runs on device).
+
+    scored=False (prefix/wildcard/regexp): constant_score rewrite — every
+    matching doc scores `boost`, like ES's default CONSTANT_SCORE rewrite.
+    scored=True (fuzzy): each expanded term scores BM25 with its own idf and
+    a per-term multiplier from `term_boost` (e.g. edit-distance decay).
+    Divergence from Lucene's TopTermsBlendedFreq rewrite: per-term scores sum
+    (bool-should semantics) instead of blending df across expanded terms.
+    """
+
+    kind: str = ""  # "prefix" | "wildcard" | "regexp" | "fuzzy" (cache tag)
+    fld: str = ""
+    matcher: Any = None  # host predicate: term -> False | True | weight-mult
+    boost: float = 1.0
+    scored: bool = False
+    max_expansions: int | None = None  # cap on expanded terms (fuzzy: 50)
+
+    def prepare(self, pack):
+        from ..utils.errors import IllegalArgumentError
+
+        expanded = []  # (term, multiplier)
+        for t in pack.terms_for_field(self.fld):
+            m = self.matcher(t)
+            if m:
+                expanded.append((t, 1.0 if m is True else float(m)))
+        if self.max_expansions is not None and len(expanded) > self.max_expansions:
+            # keep highest-df terms, like Lucene's top-terms rewrites
+            expanded.sort(key=lambda tm: -pack.term_blocks(self.fld, tm[0])[2])
+            expanded = expanded[: self.max_expansions]
+        if len(expanded) > MAX_CLAUSE_COUNT:
+            raise IllegalArgumentError(
+                f"[{self.kind}] on [{self.fld}] expands to {len(expanded)} terms, "
+                f"more than max_clause_count [{MAX_CLAUSE_COUNT}]"
+            )
+        doc_count = pack.field_stats.get(self.fld, {}).get("doc_count") or pack.num_docs
+        rows_list, w_list = [], []
+        for t, mult in expanded:
+            s0, nb, df = pack.term_blocks(self.fld, t)
+            if nb == 0:
+                continue
+            w = self.boost * mult * bm25_idf(doc_count, df) if self.scored else 1.0
+            rows_list.extend(range(s0, s0 + nb))
+            w_list.extend([w] * nb)
+        r = max(len(rows_list), 1)
+        width = 1 << (r - 1).bit_length()
+        rows = np.zeros(width, np.int32)
+        ws = np.zeros(width, np.float32)
+        rows[: len(rows_list)] = rows_list
+        ws[: len(w_list)] = w_list
+        return (rows, ws, np.float32(self.boost)), (
+            self.kind, self.fld, self.scored, width,
+        )
+
+    def device_eval(self, dev, params, ctx):
+        rows, ws, boost = params
+        n1 = ctx.num_docs + DEAD_SLOT_PAD
+        docids = dev["post_docids"][rows]  # [R, 128]
+        tfs = dev["post_tfs"][rows]
+        flat_ids = docids.reshape(-1)
+        if not self.scored:
+            match = jnp.zeros(n1, bool).at[flat_ids].set((tfs > 0).reshape(-1), mode="drop")
+            match = match.at[ctx.num_docs].set(False)
+            return jnp.where(match, boost, 0.0), match
+        has_norms = self.fld in ctx.has_norms
+        if has_norms:
+            dls = dev["post_dls"][rows]
+            denom = tfs + ctx.k1 * (1.0 - ctx.b + ctx.b * dls / ctx.avgdl.get(self.fld, 1.0))
+        else:
+            denom = tfs + ctx.k1
+        lane_scores = ws[:, None] * tfs / denom
+        scores = jnp.zeros(n1, jnp.float32).at[flat_ids].add(
+            lane_scores.reshape(-1), mode="drop"
+        )
+        match = jnp.zeros(n1, bool).at[flat_ids].set((tfs > 0).reshape(-1), mode="drop")
+        return scores, match
 
 
 @dataclass

@@ -110,6 +110,50 @@ class Oracle:
             return self._q_bool({"must": [{"term": {fld: t}} for t in terms], "boost": boost})
         return self._q_bool({"should": [{"term": {fld: t}} for t in terms], "boost": boost})
 
+    def _q_match_phrase(self, body):
+        (fld, spec), = body.items()
+        text = spec["query"] if isinstance(spec, dict) else spec
+        boost = spec.get("boost", 1.0) if isinstance(spec, dict) else 1.0
+        ft = self.m.fields.get(fld)
+        if ft and ft.type == "keyword":
+            return self._term_leaf(fld, str(text), boost)
+        analyzer = ft.get_search_analyzer() if ft else get_analyzer("standard")
+        toks = analyzer.analyze(str(text))
+        if not toks:
+            return {}, set()
+        if len(toks) == 1:
+            return self._term_leaf(fld, toks[0].term, boost)
+        # per-doc token streams with position_increment_gap=100 across values
+        idf_sum = sum(self._idf(fld, t.term) for t in toks)
+        k1, b = 1.2, 0.75
+        avgdl = self._avgdl(fld)
+        scores, match = {}, set()
+        for i, d in enumerate(self.docs):
+            values = self.m.parse_document(d).get(fld)
+            if not values:
+                continue
+            positions = {}
+            base = 0
+            for v in values:
+                last = -1
+                for t in analyzer.analyze(v):
+                    positions.setdefault(t.term, []).append(base + t.position)
+                    last = max(last, t.position)
+                base += last + 1 + 100
+            freq = 0
+            for p in positions.get(toks[0].term, []):
+                if all(
+                    (p - toks[0].position + t.position) in positions.get(t.term, [])
+                    for t in toks[1:]
+                ):
+                    freq += 1
+            if freq > 0:
+                dl = self.dl[fld][i]
+                tfn = freq / (freq + k1 * (1 - b + b * dl / avgdl))
+                scores[i] = boost * idf_sum * tfn
+                match.add(i)
+        return scores, match
+
     def _q_match_all(self, body):
         boost = (body or {}).get("boost", 1.0)
         match = set(range(len(self.docs)))

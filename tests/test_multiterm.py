@@ -1,0 +1,130 @@
+"""Multi-term queries (prefix/wildcard/regexp/fuzzy): expansion + scoring."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.query import ShardSearcher
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+DOCS = [
+    {"body": "apple pie baking", "tag": "food-dessert"},
+    {"body": "application server", "tag": "tech-infra"},
+    {"body": "apply for a job", "tag": "work"},
+    {"body": "banana bread", "tag": "food-bread"},
+    {"body": "grape jelly", "tag": "food-spread"},
+]
+
+
+@pytest.fixture(scope="module")
+def s():
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS:
+        b.add_document(m.parse_document(d))
+    return ShardSearcher(b.build(), mappings=m)
+
+
+def test_prefix_text_tokens(s):
+    r = s.search({"prefix": {"body": "appl"}}, size=10)
+    assert sorted(r.doc_ids.tolist()) == [0, 1, 2]
+    np.testing.assert_allclose(r.scores, 1.0)  # constant_score rewrite
+
+
+def test_prefix_keyword_and_boost(s):
+    r = s.search({"prefix": {"tag": {"value": "food", "boost": 2.5}}}, size=10)
+    assert sorted(r.doc_ids.tolist()) == [0, 3, 4]
+    np.testing.assert_allclose(r.scores, 2.5)
+
+
+def test_prefix_shorthand(s):
+    assert s.search({"prefix": {"tag": "tech"}}, size=10).total == 1
+
+
+def test_wildcard(s):
+    r = s.search({"wildcard": {"tag": "food-*d"}}, size=10)
+    assert sorted(r.doc_ids.tolist()) == [3, 4]  # bread, spread
+    assert s.search({"wildcard": {"body": "appl?"}}, size=10).total == 2  # apple, apply
+    assert s.search({"wildcard": {"tag": {"value": "FOOD-*", "case_insensitive": True}}}, size=10).total == 3
+
+
+def test_regexp(s):
+    r = s.search({"regexp": {"tag": "food-(bread|spread)"}}, size=10)
+    assert sorted(r.doc_ids.tolist()) == [3, 4]
+    with pytest.raises(QueryParsingError):
+        s.search({"regexp": {"tag": "food-("}}, size=10)
+
+
+def test_fuzzy_scored(s):
+    # "aple" -> apple (dist 1), apply (dist 2 > AUTO(4)=1 -> no)
+    r = s.search({"fuzzy": {"body": "aple"}}, size=10)
+    assert r.doc_ids.tolist() == [0]
+    assert r.scores[0] > 0  # BM25-scored, not constant
+    # explicit fuzziness 2 widens the net: apple, apply
+    r2 = s.search({"fuzzy": {"body": {"value": "aple", "fuzziness": 2}}}, size=10)
+    assert sorted(r2.doc_ids.tolist()) == [0, 2]
+
+
+def test_fuzzy_transpositions_and_prefix_length(s):
+    # "appel" -> apple needs a transposition (distance 1 with, 2 without)
+    assert s.search({"fuzzy": {"body": {"value": "appel", "fuzziness": 1}}}, size=10).total == 1
+    assert (
+        s.search(
+            {"fuzzy": {"body": {"value": "appel", "fuzziness": 1, "transpositions": False}}},
+            size=10,
+        ).total
+        == 0
+    )
+    # prefix_length pins the first chars
+    assert (
+        s.search(
+            {"fuzzy": {"body": {"value": "bpple", "fuzziness": 1, "prefix_length": 1}}},
+            size=10,
+        ).total
+        == 0
+    )
+
+
+def test_multiterm_in_bool_filter(s):
+    r = s.search(
+        {"bool": {"must": [{"match": {"body": "bread"}}], "filter": [{"prefix": {"tag": "food"}}]}},
+        size=10,
+    )
+    assert r.doc_ids.tolist() == [3]
+
+
+def test_multiterm_sharded_engine():
+    e = Engine(None)
+    idx = e.create_index("mt", MAPPING, {"number_of_shards": 3, "refresh_interval": "-1"})
+    for i, d in enumerate(DOCS * 3):
+        idx.index_doc(f"d{i}", d)
+    idx.refresh()
+    r = idx.search(query={"prefix": {"body": "appl"}}, size=20)
+    assert r["hits"]["total"]["value"] == 9
+    r = idx.search(query={"fuzzy": {"body": "aple"}}, size=20)
+    assert r["hits"]["total"]["value"] == 3
+    r = idx.search(query={"wildcard": {"tag": "*-bread"}}, size=20)
+    assert r["hits"]["total"]["value"] == 3
+
+
+def test_fuzzy_auto_low_high(s):
+    # AUTO:6,8 -> 4-letter query term gets distance 0
+    assert (
+        s.search({"fuzzy": {"body": {"value": "aple", "fuzziness": "AUTO:6,8"}}}, size=10).total
+        == 0
+    )
+    with pytest.raises(QueryParsingError):
+        s.search({"fuzzy": {"body": {"value": "aple", "fuzziness": "AUTO:x,y"}}}, size=10)
+
+
+def test_wildcard_legacy_body_key(s):
+    assert s.search({"wildcard": {"tag": {"wildcard": "food-*"}}}, size=10).total == 3

@@ -1,0 +1,154 @@
+"""match_phrase: positions intersection + phrase-frequency BM25 parity."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.query import ShardSearcher
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+from reference_scorer import Oracle
+
+MAPPING = {"properties": {"body": {"type": "text"}, "tag": {"type": "keyword"}}}
+
+DOCS = [
+    {"body": "the quick brown fox jumps over the lazy dog", "tag": "a"},
+    {"body": "quick brown foxes and quick brown bears", "tag": "b"},
+    {"body": "brown quick reversal here", "tag": "a"},
+    {"body": "quick thinking saves the brown fox", "tag": "c"},
+    {"body": "nothing relevant at all", "tag": "a"},
+    {"body": "quick brown quick brown quick brown", "tag": "b"},
+]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS:
+        b.add_document(m.parse_document(d))
+    return ShardSearcher(b.build(), mappings=m), Oracle(DOCS, Mappings(MAPPING)), m
+
+
+def check_parity(setup, query, size=10):
+    searcher, oracle, m = setup
+    res = searcher.search(query, size=size, mappings=m)
+    expected, total = oracle.search(query, size=size)
+    assert res.total == total, f"total mismatch for {query}"
+    for (eid, escore), gid, gscore in zip(expected, res.doc_ids, res.scores):
+        assert eid == gid, f"order mismatch for {query}"
+        assert abs(escore - gscore) < 1e-5, f"score mismatch for {query} doc {eid}"
+
+
+def test_phrase_basic(setup):
+    check_parity(setup, {"match_phrase": {"body": "quick brown"}})
+
+
+def test_phrase_order_matters(setup):
+    s, _, m = setup
+    r = s.search({"match_phrase": {"body": "brown quick"}}, size=10)
+    # only doc 2 and doc 5 (brown quick at 1->2? doc5: quick brown quick...
+    # pairs (brown,quick) at positions (1,2),(3,4)) contain "brown quick"
+    assert sorted(r.doc_ids.tolist()) == [2, 5]
+    check_parity(setup, {"match_phrase": {"body": "brown quick"}})
+
+
+def test_phrase_freq_scoring(setup):
+    # doc 5 has "quick brown" three times -> higher phrase tf than doc 1 (2x)
+    check_parity(setup, {"match_phrase": {"body": "quick brown"}})
+    s, _, m = setup
+    r = s.search({"match_phrase": {"body": "quick brown"}}, size=10)
+    assert r.doc_ids[0] == 5  # highest phrase frequency (and shortest)
+
+
+def test_phrase_three_terms(setup):
+    check_parity(setup, {"match_phrase": {"body": "quick brown fox"}})
+    s, _, m = setup
+    r = s.search({"match_phrase": {"body": "quick brown fox"}}, size=10)
+    assert r.doc_ids.tolist() == [0]
+
+
+def test_phrase_no_match(setup):
+    s, _, m = setup
+    assert s.search({"match_phrase": {"body": "lazy fox"}}, size=10).total == 0
+    assert s.search({"match_phrase": {"body": "quick missing"}}, size=10).total == 0
+
+
+def test_phrase_single_term_degenerates(setup):
+    check_parity(setup, {"match_phrase": {"body": "fox"}})
+
+
+def test_phrase_keyword_is_exact_term(setup):
+    s, _, m = setup
+    r = s.search({"match_phrase": {"tag": "a"}}, size=10)
+    assert r.total == 3
+
+
+def test_phrase_in_bool(setup):
+    check_parity(
+        setup,
+        {"bool": {"must": [{"match_phrase": {"body": "quick brown"}}],
+                  "filter": [{"term": {"tag": "b"}}]}},
+    )
+
+
+def test_phrase_slop_unsupported(setup):
+    s, _, m = setup
+    with pytest.raises(IllegalArgumentError):
+        s.search({"match_phrase": {"body": {"query": "quick fox", "slop": 2}}}, size=10)
+
+
+def test_phrase_multivalue_gap():
+    # two values of one field must NOT match a phrase across the boundary
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"body": ["ends with quick", "brown starts"]}))
+    b.add_document(m.parse_document({"body": "clearly quick brown here"}))
+    s = ShardSearcher(b.build(), mappings=m)
+    r = s.search({"match_phrase": {"body": "quick brown"}}, size=10)
+    assert r.doc_ids.tolist() == [1]
+
+
+def test_phrase_repeated_term():
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"body": "badger badger mushroom"}))
+    b.add_document(m.parse_document({"body": "badger mushroom badger"}))
+    s = ShardSearcher(b.build(), mappings=m)
+    r = s.search({"match_phrase": {"body": "badger badger"}}, size=10)
+    assert r.doc_ids.tolist() == [0]
+
+
+def test_phrase_sharded_engine():
+    e = Engine(None)
+    idx = e.create_index("ph", MAPPING, {"number_of_shards": 3, "refresh_interval": "-1"})
+    for i, d in enumerate(DOCS * 3):
+        idx.index_doc(f"d{i}", d)
+    idx.refresh()
+    r = idx.search(query={"match_phrase": {"body": "quick brown"}}, size=30)
+    # docs 0, 1, 3(no: 'quick thinking' not phrase), 5 per copy -> 3 copies
+    matching_per_copy = {0, 1, 5}
+    assert r["hits"]["total"]["value"] == 3 * len(matching_per_copy)
+    # single-shard result for comparison
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    for d in DOCS * 3:
+        b.add_document(m.parse_document(d))
+    s1 = ShardSearcher(b.build(), mappings=m)
+    r1 = s1.search({"match_phrase": {"body": "quick brown"}}, size=30)
+    np.testing.assert_allclose(
+        np.sort([h["_score"] for h in r["hits"]["hits"]])[::-1],
+        np.sort(r1.scores)[::-1],
+        rtol=1e-5,
+    )
+
+
+def test_phrase_on_index_without_text_tokens():
+    # no text tokens anywhere -> phrase matches nothing (not a crash)
+    m = Mappings(MAPPING)
+    b = PackBuilder(m)
+    b.add_document(m.parse_document({"tag": "only-keyword"}))
+    s = ShardSearcher(b.build(), mappings=m)
+    assert s.search({"match_phrase": {"body": "quick brown"}}, size=10).total == 0
