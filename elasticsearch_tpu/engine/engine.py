@@ -282,8 +282,43 @@ class EsIndex:
         if time.monotonic() - self._last_refresh >= secs:
             self.refresh()
 
-    def search(self, query=None, size=10, from_=0, aggs=None, knn=None):
+    def search(
+        self, query=None, size=10, from_=0, aggs=None, knn=None,
+        sort=None, search_after=None,
+    ):
         self._maybe_refresh()
+        from ..query.sort import is_score_only, parse_sort
+
+        sort_fields = parse_sort(sort)
+        if not is_score_only(sort_fields):
+            if knn is not None:
+                raise IllegalArgumentError("knn with field sort is not supported")
+            hits_raw, total, aggregations = self.searcher.search_sorted(
+                query, sort_fields, size=size, from_=from_,
+                search_after=search_after, aggs=aggs,
+            )
+            hits = []
+            for s, d, values in hits_raw:
+                doc_id, src = self.shard_docs[s][d]
+                hits.append({
+                    "_index": self.name,
+                    "_id": doc_id,
+                    "_score": None,
+                    "_source": src,
+                    "sort": values,
+                })
+            return {
+                "hits": {
+                    "total": {"value": total, "relation": "eq"},
+                    "max_score": None,
+                    "hits": hits,
+                },
+                **({"aggregations": aggregations} if aggregations is not None else {}),
+            }
+        if search_after is not None:
+            raise IllegalArgumentError(
+                "search_after requires an explicit sort on fields"
+            )
         if knn is not None:
             # knn section: standalone -> knn hits; with a query -> union with
             # scores summed where a doc appears in both (reference behavior:

@@ -264,3 +264,147 @@ class StackedSearcher:
 
     def count(self, query=None) -> int:
         return self.search(query, size=1).total
+
+    # -- field-sorted search ----------------------------------------------
+
+    def _compiled_sorted(self, node, key_t, k, plan, has_after, agg_nodes, agg_key):
+        cache_key = ("sorted", key_t, k, plan.struct_key(), has_after, agg_key, self.mesh is None)
+        fn = self._cache.get(cache_key)
+        if fn is not None:
+            return fn
+        ctx = self.ctx
+        n = self.sp.n_max
+        k_local = min(k, max(n, 1))
+
+        def shard_body(dev1, par1, after, agg_par1):
+            scores, match = node.device_eval(dev1, par1, ctx)
+            ok = match[:n] & dev1["live"]
+            total = jnp.sum(ok, dtype=jnp.int32)
+            agg_out = {}
+            if agg_nodes:
+                seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                for name, anode in agg_nodes.items():
+                    agg_out[name] = anode.device_eval_segmented(
+                        dev1, agg_par1[name], seg, 1, ok, ctx
+                    )
+            keys = plan.device_keys(dev1, scores, n)
+            sel = ok
+            if has_after:
+                gt = jnp.zeros(n, bool)
+                eq = jnp.ones(n, bool)
+                for kk, aa in zip(keys, after):
+                    gt = gt | (eq & (kk > aa))
+                    eq = eq & (kk == aa)
+                sel = sel & gt
+            invalid = (~sel).astype(jnp.int32)
+            docs = jnp.arange(n, dtype=jnp.int32)
+            sorted_ops = jax.lax.sort((invalid, *keys, docs), num_keys=1 + len(keys))
+            return (
+                sorted_ops[0][:k_local],
+                tuple(o[:k_local] for o in sorted_ops[1:-1]),
+                sorted_ops[-1][:k_local],
+                total,
+                agg_out,
+            )
+
+        if self.mesh is not None:
+            import jax.tree_util as jtu
+
+            def spmd(dev, params, after, agg_params):
+                def body(dev_s, par_s, agg_s):
+                    sq = lambda t: jtu.tree_map(lambda x: x[0], t)
+                    outs = shard_body(sq(dev_s), sq(par_s), after, sq(agg_s))
+                    return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+
+                return jax.shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=(P("shards"), P("shards"), P(), P("shards")),
+                    out_specs=P("shards"),
+                )(dev, params, after, agg_params)
+
+            fn = jax.jit(spmd)
+        else:
+
+            def vm(dev, params, after, agg_params):
+                return jax.vmap(
+                    lambda d, p, a: shard_body(d, p, after, a)
+                )(dev, params, agg_params)
+
+            fn = jax.jit(vm)
+        self._cache[cache_key] = fn
+        return fn
+
+    def search_sorted(
+        self,
+        query,
+        sort_fields,
+        size: int = 10,
+        from_: int = 0,
+        search_after=None,
+        aggs: dict | None = None,
+    ):
+        """-> (hits: [(shard, docid, sort_values)], total, aggregations)."""
+        from ..query.sort import SortPlan
+
+        m = self.sp.mappings
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        agg_nodes = None
+        if aggs:
+            from ..aggs import parse_aggs
+
+            agg_nodes = parse_aggs(aggs, m)
+        S = self.sp.S
+        views = [self.sp.shard_view(s) for s in range(S)]
+        # one plan per shard view (global dv dictionaries -> identical keys)
+        plan = SortPlan(sort_fields, views[0], m)
+        per_shard, keys_t = [], []
+        for v in views:
+            p, k_ = node.prepare(v)
+            per_shard.append(p)
+            keys_t.append(k_)
+        params = _stack_shard_params(per_shard)
+        agg_params, agg_key = {}, ()
+        if agg_nodes:
+            per_shard_aggs, akeys = [], []
+            for v in views:
+                parts = {nm: a.prepare(v, m) for nm, a in agg_nodes.items()}
+                per_shard_aggs.append({nm: p for nm, (p, _) in parts.items()})
+                akeys.append(tuple((nm, kk) for nm, (_, kk) in sorted(parts.items())))
+            agg_params = _stack_shard_params(per_shard_aggs)
+            agg_key = tuple(akeys)
+        k = min(max(size + from_, 1), max(self.sp.n_max, 1))
+        after = ()
+        if search_after is not None:
+            after = plan.after_keys(search_after, self.sp)
+        fn = self._compiled_sorted(
+            node, tuple(keys_t), k, plan, search_after is not None, agg_nodes, agg_key
+        )
+        inv, keys_s, docs, totals, agg_out = jax.device_get(
+            fn(self.dev, params, after, agg_params)
+        )
+        aggregations = None
+        if agg_nodes:
+            aggregations = {
+                name: anode.finalize(anode.merge_partials(agg_out[name]), 1)[0]
+                for name, anode in agg_nodes.items()
+            }
+        # host-side coordinator merge: lexsort by (keys..., shard) over the
+        # S*k_local candidates, skipping invalid slots
+        S_, kl = inv.shape
+        flat_inv = inv.reshape(-1)
+        shard_of = np.repeat(np.arange(S_, dtype=np.int32), kl)
+        flat_docs = docs.reshape(-1)
+        flat_keys = [np.asarray(kk).reshape(-1) for kk in keys_s]
+        order = np.lexsort(tuple([shard_of] + flat_keys[::-1] + [flat_inv]))
+        valid = flat_inv[order] == 0
+        order = order[valid]
+        take = order[from_ : size + from_]
+        # per-position values in original space
+        key_cols = [fk[take] for fk in flat_keys]
+        values = plan.hit_values(key_cols, list(range(len(take))))
+        hits = [
+            (int(shard_of[i]), int(flat_docs[i]), v)
+            for i, v in zip(take, values)
+        ]
+        return hits, int(totals.sum()), aggregations

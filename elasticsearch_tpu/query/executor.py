@@ -168,3 +168,98 @@ class ShardSearcher:
 
     def count(self, query: dict | QueryNode | None, mappings=None) -> int:
         return self.search(query, size=1, mappings=mappings).total
+
+    # -- field-sorted search ----------------------------------------------
+
+    def _compiled_sorted(self, node, struct_key, k, plan, has_after, agg_nodes, agg_key):
+        key = ("sorted", struct_key, k, plan.struct_key(), has_after, agg_key)
+        fn = self._cache.get(key)
+        if fn is None:
+            ctx = self.ctx
+            n = self.pack.num_docs
+
+            def run(dev, params, after, agg_params):
+                scores, match = node.device_eval(dev, params, ctx)
+                ok = match[:n] & dev["live"]
+                total = jnp.sum(ok, dtype=jnp.int32)
+                agg_out = {}
+                if agg_nodes:
+                    seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                    for name, anode in agg_nodes.items():
+                        agg_out[name] = anode.device_eval_segmented(
+                            dev, agg_params[name], seg, 1, ok, ctx
+                        )
+                keys = plan.device_keys(dev, scores, n)
+                sel = ok
+                if has_after:
+                    # lexicographic "strictly after the cursor"
+                    gt = jnp.zeros(n, bool)
+                    eq = jnp.ones(n, bool)
+                    for kk, aa in zip(keys, after):
+                        gt = gt | (eq & (kk > aa))
+                        eq = eq & (kk == aa)
+                    sel = sel & gt
+                invalid = (~sel).astype(jnp.int32)
+                docs = jnp.arange(n, dtype=jnp.int32)
+                sorted_ops = jax.lax.sort(
+                    (invalid, *keys, docs), num_keys=1 + len(keys)
+                )
+                inv_s = sorted_ops[0][:k]
+                keys_s = tuple(o[:k] for o in sorted_ops[1:-1])
+                docs_s = sorted_ops[-1][:k]
+                return inv_s, keys_s, docs_s, total, agg_out
+
+            fn = jax.jit(run)
+            self._cache[key] = fn
+        return fn
+
+    def search_sorted(
+        self,
+        query,
+        sort_fields,
+        size: int = 10,
+        from_: int = 0,
+        search_after=None,
+        mappings=None,
+        aggs: dict | None = None,
+    ):
+        """-> (hits: [(docid, sort_values)], total, aggregations)."""
+        from .sort import SortPlan
+
+        m = mappings if mappings is not None else self.mappings
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        agg_nodes = None
+        if aggs:
+            from ..aggs import parse_aggs
+
+            agg_nodes = parse_aggs(aggs, m)
+        if self.pack.num_docs == 0:
+            return [], 0, ({} if aggs else None)
+        plan = SortPlan(sort_fields, self.pack, m)
+        params, struct_key = node.prepare(self.pack)
+        agg_params, agg_key = {}, ()
+        if agg_nodes:
+            parts = {nm: a.prepare(self.pack, m) for nm, a in agg_nodes.items()}
+            agg_params = {nm: p for nm, (p, _) in parts.items()}
+            agg_key = tuple((nm, kk) for nm, (_, kk) in sorted(parts.items()))
+        k = min(max(size + from_, 1), self.pack.num_docs)
+        after = ()
+        if search_after is not None:
+            after = plan.after_keys(search_after, self.pack)
+        fn = self._compiled_sorted(
+            node, struct_key, k, plan, search_after is not None, agg_nodes, agg_key
+        )
+        inv, keys_s, docs, total, agg_out = jax.device_get(
+            fn(self.dev, params, after, agg_params)
+        )
+        aggregations = None
+        if agg_nodes:
+            aggregations = {
+                name: anode.finalize(agg_out[name], 1)[0]
+                for name, anode in agg_nodes.items()
+            }
+        nvalid = int((inv == 0).sum())
+        take = list(range(min(nvalid, k)))[from_ : size + from_]
+        values = plan.hit_values(keys_s, take)
+        hits = [(int(docs[i]), v) for i, v in zip(take, values)]
+        return hits, int(total), aggregations

@@ -1,0 +1,219 @@
+"""Field sorting + search_after (reference behavior: search/sort/
+FieldSortBuilder.java -> Lucene SortField over DocValues, merged at the
+coordinator by SearchPhaseController with (key..., shard, doc) order).
+
+TPU shape: every sort key becomes an ascending-sortable device array
+("transformed key space"): descending numerics negate, keyword ordinals
+double (2*ord) so absent search_after values land between ordinals as odd
+integers, missing values take +/- sentinels (_last/_first). The per-shard
+top-k is a lax.sort over (key_1, ..., key_m, docid); the cross-shard merge
+is a host-side lexsort over S*k candidates — tiny, and exactly the
+coordinator-side TopFieldDocs.merge of the reference."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.errors import IllegalArgumentError, QueryParsingError
+
+F64_SENTINEL = np.float64(np.finfo(np.float64).max)
+I64_SENTINEL = np.int64(2**62)
+
+
+@dataclass
+class SortField:
+    field: str  # field name, or "_score" / "_doc"
+    order: str = "asc"
+    missing: object = "_last"
+
+    @property
+    def desc(self) -> bool:
+        return self.order == "desc"
+
+
+def parse_sort(spec) -> list[SortField]:
+    """["f", {"f": "desc"}, {"f": {"order": "desc", "missing": "_first"}},
+    "_score", ...] -> [SortField]."""
+    if spec is None:
+        return []
+    if not isinstance(spec, list):
+        spec = [spec]
+    out = []
+    for s in spec:
+        if isinstance(s, str):
+            order = "desc" if s == "_score" else "asc"
+            out.append(SortField(s, order))
+        elif isinstance(s, dict) and len(s) == 1:
+            (fld, body), = s.items()
+            if isinstance(body, str):
+                out.append(SortField(fld, body))
+            elif isinstance(body, dict):
+                out.append(
+                    SortField(
+                        fld,
+                        body.get("order", "desc" if fld == "_score" else "asc"),
+                        body.get("missing", "_last"),
+                    )
+                )
+            else:
+                raise QueryParsingError(f"malformed sort clause for [{fld}]")
+        else:
+            raise QueryParsingError(f"malformed sort clause {s!r}")
+    for sf in out:
+        if sf.order not in ("asc", "desc"):
+            raise QueryParsingError(f"unknown sort order [{sf.order}]")
+    return out
+
+
+def is_score_only(sort: list[SortField]) -> bool:
+    return not sort or (len(sort) == 1 and sort[0].field == "_score" and sort[0].desc)
+
+
+class SortPlan:
+    """Host-side plan: per sort field, how to build the transformed device
+    key, convert search_after values in, and convert hit keys back out."""
+
+    def __init__(self, sort: list[SortField], pack, mappings):
+        self.sort = sort
+        self.fields = []  # (SortField, kind, col) kind: score|doc|int|float|ord
+        self.needs_scores = False
+        for sf in sort:
+            if sf.field == "_score":
+                self.fields.append((sf, "score", None))
+                self.needs_scores = True
+                continue
+            if sf.field == "_doc":
+                self.fields.append((sf, "doc", None))
+                continue
+            ft = mappings.fields.get(sf.field) if mappings else None
+            if ft is not None and ft.type in ("text",):
+                raise IllegalArgumentError(
+                    f"Text fields are not optimised for operations that require "
+                    f"per-document field data like sorting: [{sf.field}]"
+                )
+            col = pack.docvalues.get(sf.field)
+            if col is None:
+                # unmapped/absent column: every doc "missing"
+                self.fields.append((sf, "absent", None))
+                continue
+            self.fields.append((sf, col.kind, col))
+
+    def struct_key(self):
+        return tuple(
+            (sf.field, sf.order, str(sf.missing), kind)
+            for sf, kind, _ in self.fields
+        )
+
+    # ---- transformed key space ------------------------------------------
+
+    def _sentinels(self, sf, kind):
+        sent = F64_SENTINEL if kind in ("float", "absent") else I64_SENTINEL
+        lo = -sent
+        # missing sorts last by default regardless of order (ES default)
+        if sf.missing == "_last":
+            return sent
+        if sf.missing == "_first":
+            return lo
+        # concrete missing value: transform like a real value
+        v = sf.missing
+        if kind == "ord":
+            raise IllegalArgumentError("custom missing on keyword sort not supported")
+        v = float(v) if kind in ("float", "absent") else int(v)
+        return -v if sf.desc else v
+
+    def device_keys(self, dev, scores, num_docs):
+        """-> tuple of [N] ascending-sortable key arrays (traced)."""
+        import jax.numpy as jnp
+
+        keys = []
+        for sf, kind, col in self.fields:
+            if kind == "score":
+                k = -scores[:num_docs] if sf.desc else scores[:num_docs]
+                keys.append(k.astype(jnp.float64))
+                continue
+            if kind == "doc":
+                d = jnp.arange(num_docs, dtype=jnp.int64)
+                keys.append(-d if sf.desc else d)
+                continue
+            if kind == "absent":
+                keys.append(
+                    jnp.full(num_docs, self._sentinels(sf, kind), jnp.float64)
+                )
+                continue
+            if kind == "ord":
+                vals, has = dev["dv_ord"][sf.field]
+                k = vals.astype(jnp.int64) * 2
+            elif kind == "float":
+                vals, has = dev["dv_float"][sf.field]
+                k = vals.astype(jnp.float64)
+            else:
+                vals, has = dev["dv_int"][sf.field]
+                k = vals.astype(jnp.int64)
+            if sf.desc:
+                k = -k
+            k = jnp.where(has, k, self._sentinels(sf, kind))
+            keys.append(k)
+        return tuple(keys)
+
+    # ---- search_after conversion ----------------------------------------
+
+    def after_keys(self, after_values, pack) -> tuple:
+        """Original-space search_after values -> transformed key scalars."""
+        if len(after_values) != len(self.fields):
+            raise IllegalArgumentError(
+                f"search_after has {len(after_values)} values, sort has "
+                f"{len(self.fields)}"
+            )
+        out = []
+        for v, (sf, kind, col) in zip(after_values, self.fields):
+            if kind == "score":
+                k = np.float64(v)
+                out.append(-k if sf.desc else k)
+            elif kind == "doc":
+                k = np.int64(v)
+                out.append(-k if sf.desc else k)
+            elif kind == "absent":
+                out.append(np.float64(self._sentinels(sf, kind)))
+            elif kind == "ord":
+                terms = col.ord_terms or []
+                i = int(np.searchsorted(terms, str(v)))
+                exact = i < len(terms) and terms[i] == str(v)
+                k = np.int64(2 * i if exact else 2 * i - 1)
+                out.append(-k if sf.desc else k)
+            elif kind == "float":
+                out.append(np.float64(-float(v) if sf.desc else float(v)))
+            else:
+                out.append(np.int64(-int(v) if sf.desc else int(v)))
+        return tuple(out)
+
+    # ---- hit values back to original space ------------------------------
+
+    def hit_values(self, key_arrays, positions):
+        """Transformed keys at hit positions -> response `sort` arrays.
+        Sentinel keys (missing values) come back as None."""
+        out = []
+        for pos in positions:
+            row = []
+            for (sf, kind, col), karr in zip(self.fields, key_arrays):
+                k = karr[pos]
+                if kind in ("float", "absent", "score"):
+                    kv = float(k)
+                    if abs(kv) >= float(F64_SENTINEL):
+                        row.append(None)
+                        continue
+                    row.append(-kv if sf.desc else kv)
+                    continue
+                ki = int(k)
+                if abs(ki) >= int(I64_SENTINEL):
+                    row.append(None)
+                    continue
+                ki = -ki if sf.desc else ki
+                if kind == "ord":
+                    terms = col.ord_terms or []
+                    row.append(terms[ki // 2] if 0 <= ki // 2 < len(terms) else None)
+                else:
+                    row.append(ki)
+            out.append(row)
+        return out

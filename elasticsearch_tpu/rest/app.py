@@ -292,10 +292,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         size = int(query_params.get("size", body.get("size", 10)))
         from_ = int(query_params.get("from", body.get("from", 0)))
         aggs = body.get("aggs") or body.get("aggregations")
+        sort = body.get("sort")
+        search_after = body.get("search_after")
         import time
 
         t0 = time.monotonic()
-        res = await call(idx.search, query, size, from_, aggs, knn)
+        res = await call(
+            idx.search, query, size, from_, aggs, knn, sort, search_after
+        )
         took = int((time.monotonic() - t0) * 1000)
         src_filter = body.get("_source")
         if src_filter is False:

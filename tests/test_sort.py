@@ -1,0 +1,162 @@
+"""Field sorting + search_after: order, missing values, merge across shards."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "price": {"type": "double"},
+        "ts": {"type": "date"},
+    }
+}
+
+DOCS = [
+    ("a", {"body": "x common", "tag": "beta", "n": 5, "price": 1.5, "ts": "2024-03-01"}),
+    ("b", {"body": "x common", "tag": "alpha", "n": 2, "price": 9.0, "ts": "2024-01-01"}),
+    ("c", {"body": "x common", "tag": "gamma", "n": 9, "price": 4.0, "ts": "2024-02-01"}),
+    ("d", {"body": "x common", "tag": "alpha", "n": 2, "price": 2.5}),  # no ts
+    ("e", {"body": "x common", "n": 7, "price": 0.5, "ts": "2024-04-01"}),  # no tag
+]
+
+
+def make_index(num_shards=1):
+    e = Engine(None)
+    idx = e.create_index(
+        f"s{num_shards}", MAPPING, {"number_of_shards": num_shards, "refresh_interval": "-1"}
+    )
+    for doc_id, src in DOCS:
+        idx.index_doc(doc_id, src)
+    idx.refresh()
+    return idx
+
+
+@pytest.fixture(scope="module", params=[1, 3])
+def idx(request):
+    return make_index(request.param)
+
+
+def ids(res):
+    return [h["_id"] for h in res["hits"]["hits"]]
+
+
+def test_sort_long_asc_desc(idx):
+    r = idx.search(query={"match_all": {}}, sort=[{"n": "asc"}], size=10)
+    assert ids(r) == ["b", "d", "a", "e", "c"]  # ties b/d broken by shard/doc
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == [2, 2, 5, 7, 9]
+    assert r["hits"]["hits"][0]["_score"] is None
+    r = idx.search(query={"match_all": {}}, sort=[{"n": "desc"}], size=10)
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == [9, 7, 5, 2, 2]
+
+
+def test_sort_double_and_date(idx):
+    r = idx.search(query={"match_all": {}}, sort=[{"price": "desc"}], size=10)
+    assert ids(r) == ["b", "c", "d", "a", "e"]
+    r = idx.search(query={"match_all": {}}, sort=[{"ts": "asc"}], size=10)
+    # missing ts (d) sorts last by default
+    assert ids(r) == ["b", "c", "a", "e", "d"]
+    assert r["hits"]["hits"][-1]["sort"] == [None]
+
+
+def test_sort_keyword(idx):
+    r = idx.search(query={"match_all": {}}, sort=[{"tag": "asc"}], size=10)
+    assert ids(r)[:3] == ["b", "d", "a"]  # alpha, alpha, beta
+    assert ids(r)[-1] == "e"  # missing tag last
+    assert r["hits"]["hits"][0]["sort"] == ["alpha"]
+    r = idx.search(query={"match_all": {}}, sort=[{"tag": "desc"}], size=10)
+    assert ids(r)[0] == "c"  # gamma first
+
+
+def test_sort_multi_key(idx):
+    r = idx.search(
+        query={"match_all": {}}, sort=[{"n": "asc"}, {"price": "desc"}], size=10
+    )
+    # n=2 tie between b (9.0) and d (2.5): price desc puts b first
+    assert ids(r)[:2] == ["b", "d"]
+    assert r["hits"]["hits"][0]["sort"] == [2, 9.0]
+
+
+def test_sort_missing_first(idx):
+    r = idx.search(
+        query={"match_all": {}},
+        sort=[{"ts": {"order": "asc", "missing": "_first"}}],
+        size=10,
+    )
+    assert ids(r)[0] == "d"
+
+
+def test_sort_with_query_filter(idx):
+    r = idx.search(query={"range": {"n": {"gte": 5}}}, sort=[{"n": "asc"}], size=10)
+    assert ids(r) == ["a", "e", "c"]
+    assert r["hits"]["total"]["value"] == 3
+
+
+def test_search_after(idx):
+    page1 = idx.search(query={"match_all": {}}, sort=[{"n": "asc"}], size=2)
+    assert ids(page1) == ["b", "d"]
+    cursor = page1["hits"]["hits"][-1]["sort"]
+    page2 = idx.search(
+        query={"match_all": {}}, sort=[{"n": "asc"}], size=2, search_after=cursor
+    )
+    # NOTE: n-only cursor is ambiguous for ties; ES recommends a tiebreak
+    # field. After (n=2) strictly -> n>2.
+    assert ids(page2) == ["a", "e"]
+    assert page2["hits"]["total"]["value"] == 5  # totals unaffected by cursor
+    page3 = idx.search(
+        query={"match_all": {}}, sort=[{"n": "asc"}], size=2,
+        search_after=page2["hits"]["hits"][-1]["sort"],
+    )
+    assert ids(page3) == ["c"]
+
+
+def test_search_after_multi_key_pagination(idx):
+    seen = []
+    cursor = None
+    for _ in range(6):
+        r = idx.search(
+            query={"match_all": {}},
+            sort=[{"n": "asc"}, {"price": "asc"}],
+            size=1,
+            search_after=cursor,
+        )
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        seen.append(hits[0]["_id"])
+        cursor = hits[0]["sort"]
+    assert seen == ["d", "b", "a", "e", "c"]
+
+
+def test_sort_score_explicit(idx):
+    # explicit [{"_score": "desc"}, {"n": "asc"}]: scored + tiebreak by field
+    r = idx.search(
+        query={"match": {"body": "common"}},
+        sort=[{"_score": "desc"}, {"n": "asc"}],
+        size=10,
+    )
+    assert [h["sort"][1] for h in r["hits"]["hits"]] == [2, 2, 5, 7, 9]
+    assert r["hits"]["hits"][0]["sort"][0] > 0
+
+
+def test_sort_text_field_rejected(idx):
+    with pytest.raises(IllegalArgumentError):
+        idx.search(query={"match_all": {}}, sort=[{"body": "asc"}], size=10)
+
+
+def test_search_after_requires_sort(idx):
+    with pytest.raises(IllegalArgumentError):
+        idx.search(query={"match_all": {}}, search_after=[1], size=10)
+
+
+def test_sorted_with_aggs(idx):
+    r = idx.search(
+        query={"match_all": {}}, sort=[{"n": "desc"}], size=2,
+        aggs={"mx": {"max": {"field": "n"}}},
+    )
+    assert r["aggregations"]["mx"]["value"] == 9.0
+    assert ids(r) == ["c", "e"]
