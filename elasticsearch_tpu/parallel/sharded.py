@@ -311,9 +311,9 @@ class StackedSearcher:
             import jax.tree_util as jtu
 
             def spmd(dev, params, after, agg_params):
-                def body(dev_s, par_s, agg_s):
+                def body(dev_s, par_s, after_s, agg_s):
                     sq = lambda t: jtu.tree_map(lambda x: x[0], t)
-                    outs = shard_body(sq(dev_s), sq(par_s), after, sq(agg_s))
+                    outs = shard_body(sq(dev_s), sq(par_s), after_s, sq(agg_s))
                     return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
 
                 return jax.shard_map(
