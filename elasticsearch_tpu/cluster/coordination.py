@@ -1,0 +1,643 @@
+"""Cluster coordination: term/quorum master election + 2-phase publication.
+
+The reference elects a single master through a Raft-like protocol — pre-vote,
+term bump, quorum of joins, then 2-phase (publish -> commit) state broadcast
+with a safety core that makes accepted-state ordering monotone (reference
+behavior: cluster/coordination/Coordinator.java:542 startElection, :631
+handleJoinRequest, :796 becomeLeader; CoordinationState.java safety invariants;
+PublicationTransportHandler.java publication; FollowersChecker.java:63 /
+LeaderChecker.java:58 ping-based failure detection, 3 strikes).
+
+This module implements the same protocol shape, event-driven over the
+Transport abstraction so it runs identically on the deterministic simulation
+network (tests) and the TCP network (real deployments). Simplifications,
+documented: static voting configuration (the reference reconfigures voting
+nodes dynamically, CoordinationState.VoteCollection/VotingConfiguration);
+full-state publication (no diffs); no cluster-state persistence to disk on
+every commit (the reference writes a local Lucene index,
+gateway/PersistedClusterStateService.java:930 — here the data WAL plus
+master re-election recovers metadata).
+
+Vote safety (why at most one master per term): a node grants at most one
+join (vote) per term, a candidate needs a quorum (majority of the static
+voting config) of joins for exactly its term, and two majorities intersect.
+State safety: a node accepts a publish only for its current term from the
+master it voted in, and only with a version above its last-accepted — so a
+quorum always carries the newest committed (term, version).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from ..transport.base import TransportService
+from .state import ClusterState
+
+# action names (the reference's string-keyed transport actions)
+PRE_VOTE = "internal:cluster/coordination/pre_vote"
+REQUEST_JOIN = "internal:cluster/coordination/join"
+PUBLISH = "internal:cluster/coordination/publish"
+COMMIT = "internal:cluster/coordination/commit"
+FOLLOWER_CHECK = "internal:cluster/coordination/follower_check"
+LEADER_CHECK = "internal:cluster/coordination/leader_check"
+PEER_FIND = "internal:cluster/coordination/peer_find"
+JOIN_EXISTING = "internal:cluster/coordination/join_existing"
+FETCH_STATE = "internal:cluster/coordination/fetch_state"
+
+CANDIDATE, LEADER, FOLLOWER = "CANDIDATE", "LEADER", "FOLLOWER"
+
+
+class CoordinationState:
+    """Safety core: term/vote/accept invariants (CoordinationState.java)."""
+
+    def __init__(self, node_id: str, voting_nodes: list[str]):
+        self.node_id = node_id
+        self.voting_nodes = sorted(voting_nodes)
+        self.current_term = 0
+        self.join_granted_this_term = False
+        self.last_accepted = ClusterState()  # highest accepted (maybe uncommitted)
+        self.last_committed = ClusterState()
+
+    def quorum(self, votes: set[str]) -> bool:
+        n = len(self.voting_nodes)
+        return len([v for v in votes if v in self.voting_nodes]) * 2 > n
+
+    # -- voting ------------------------------------------------------------
+
+    def handle_join_request(self, term: int, cand_term: int, cand_version: int) -> bool:
+        """Grant at most one join per term; candidate state must be at least
+        as fresh as ours (the Raft up-to-date check)."""
+        if term > self.current_term:
+            self.current_term = term
+            self.join_granted_this_term = False
+        if term < self.current_term or self.join_granted_this_term:
+            return False
+        if (cand_term, cand_version) < (
+            self.last_accepted.term,
+            self.last_accepted.version,
+        ):
+            return False
+        self.join_granted_this_term = True
+        return True
+
+    # -- publication -------------------------------------------------------
+
+    def handle_publish(self, state: ClusterState) -> bool:
+        if state.term > self.current_term:
+            # a legitimately elected master can be ahead of us (we missed the
+            # election); adopt its term
+            self.current_term = state.term
+            self.join_granted_this_term = True  # cannot vote again in this term
+        if state.term != self.current_term:
+            return False
+        if (state.term, state.version) <= (
+            self.last_accepted.term,
+            self.last_accepted.version,
+        ):
+            return False
+        self.last_accepted = state
+        return True
+
+    def handle_commit(self, term: int, version: int) -> bool:
+        if (
+            term == self.last_accepted.term
+            and version == self.last_accepted.version
+            and (term, version)
+            > (self.last_committed.term, self.last_committed.version)
+        ):
+            self.last_committed = self.last_accepted
+            return True
+        return False
+
+
+@dataclass
+class _Publication:
+    state: ClusterState
+    acked: set
+    committed: bool
+    on_done: Callable[[bool, str], None]
+    commit_sent: bool = False
+
+
+class Coordinator:
+    """Election + publication + failure detection for one node."""
+
+    # timing knobs (virtual seconds in simulation, wall seconds on TCP)
+    ELECTION_MIN = 0.1
+    ELECTION_MAX = 0.5
+    CHECK_INTERVAL = 1.0
+    CHECK_TIMEOUT = 2.0
+    STRIKES = 3
+    LEADER_LEASE = 3.0
+    PUBLISH_TIMEOUT = 5.0
+
+    def __init__(
+        self,
+        node_id: str,
+        voting_nodes: list[str],
+        service: TransportService,
+        network,
+        node_info: dict | None = None,
+    ):
+        self.node_id = node_id
+        self.service = service
+        self.network = network
+        self.node_info = node_info or {"roles": ["master", "data"]}
+        self.cs = CoordinationState(node_id, voting_nodes)
+        self.mode = CANDIDATE
+        self.leader: str | None = None
+        self._last_leader_msg = -1e9
+        self._joins: set[str] = set()
+        self._election_gen = 0
+        self._check_gen = 0
+        self._leader_fail_count: dict[str, int] = {}
+        self._my_fail_count = 0
+        self._publication: _Publication | None = None
+        self._pending_tasks: list[tuple[str, Callable]] = []
+        self._applied_listeners: list[Callable[[ClusterState], None]] = []
+        self._started = False
+
+        service.register_handler(PRE_VOTE, self._on_pre_vote)
+        service.register_handler(REQUEST_JOIN, self._on_join_request)
+        service.register_handler(PUBLISH, self._on_publish)
+        service.register_handler(COMMIT, self._on_commit)
+        service.register_handler(FOLLOWER_CHECK, self._on_follower_check)
+        service.register_handler(LEADER_CHECK, self._on_leader_check)
+        service.register_handler(PEER_FIND, self._on_peer_find)
+        service.register_handler(JOIN_EXISTING, self._on_join_existing)
+        service.register_handler(FETCH_STATE, self._on_fetch_state)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._started = True
+        self._schedule_election()
+        self._schedule_checks()
+
+    def stop(self):
+        self._started = False
+        self._election_gen += 1
+        self._check_gen += 1
+
+    @property
+    def applied_state(self) -> ClusterState:
+        return self.cs.last_committed
+
+    def add_applied_listener(self, fn: Callable[[ClusterState], None]):
+        self._applied_listeners.append(fn)
+
+    def _now(self) -> float:
+        return self.network.queue.now if hasattr(self.network, "queue") else self.network.now()
+
+    def _peers(self) -> list[str]:
+        known = set(self.cs.voting_nodes) | set(self.applied_state.nodes)
+        known.discard(self.node_id)
+        return sorted(known)
+
+    def is_voting(self) -> bool:
+        return self.node_id in self.cs.voting_nodes
+
+    # -- election ----------------------------------------------------------
+
+    def _schedule_election(self, attempt: int = 0):
+        if not self._started or not self.is_voting():
+            return
+        self._election_gen += 1
+        gen = self._election_gen
+        rnd = (
+            self.network.queue.random.uniform(self.ELECTION_MIN, self.ELECTION_MAX)
+            if hasattr(self.network, "queue")
+            else __import__("random").uniform(self.ELECTION_MIN, self.ELECTION_MAX)
+        )
+        delay = rnd * (1 + min(attempt, 10))
+        self.network.schedule(delay, lambda: self._maybe_start_election(gen, attempt))
+
+    def _maybe_start_election(self, gen: int, attempt: int):
+        if gen != self._election_gen or not self._started:
+            return
+        if self.mode == LEADER:
+            return
+        if self.leader is not None and self._now() - self._last_leader_msg < self.LEADER_LEASE:
+            # a live leader exists; re-arm quietly
+            self._schedule_election(0)
+            return
+        # pre-vote round: don't bump terms unless a quorum would elect us
+        grants: set[str] = {self.node_id}
+        la = self.cs.last_accepted
+        req = {
+            "term": self.cs.current_term + 1,
+            "last_term": la.term,
+            "last_version": la.version,
+        }
+        expected = self._election_gen
+
+        def on_grant(peer):
+            def cb(resp):
+                if expected != self._election_gen:
+                    return
+                if resp.get("grant"):
+                    grants.add(peer)
+                    if self.cs.quorum(grants):
+                        self._start_real_election(expected, attempt)
+            return cb
+
+        for p in self._peers():
+            self.service.send_request(
+                p, PRE_VOTE, req, on_grant(p), lambda e: None, timeout=self.CHECK_TIMEOUT
+            )
+        if self.cs.quorum(grants):  # single-node cluster
+            self._start_real_election(expected, attempt)
+            return
+
+        # retry later WITHOUT bumping the generation now — in-flight pre-vote
+        # grants must stay valid until the retry actually fires
+        def retry():
+            if self._election_gen == expected and self.mode != LEADER:
+                self._schedule_election(attempt + 1)
+
+        self.network.schedule(self.CHECK_TIMEOUT, retry)
+
+
+    def _start_real_election(self, gen: int, attempt: int):
+        if gen != self._election_gen or self.mode == LEADER:
+            return
+        self._election_gen += 1  # cancel the pending retry; we commit to this round
+        new_term = self.cs.current_term + 1
+        self.cs.current_term = new_term
+        self.cs.join_granted_this_term = True  # vote for self
+        self._joins = {self.node_id}
+        la = self.cs.last_accepted
+        req = {"term": new_term, "cand_term": la.term, "cand_version": la.version}
+        term_at_start = new_term
+
+        def on_join(peer):
+            def cb(resp):
+                if self.cs.current_term != term_at_start or self.mode == LEADER:
+                    return
+                if resp.get("granted"):
+                    self._joins.add(peer)
+                    if self.cs.quorum(self._joins):
+                        self._become_leader()
+                elif resp.get("term", 0) > self.cs.current_term:
+                    self.cs.current_term = resp["term"]
+                    self.cs.join_granted_this_term = False
+            return cb
+
+        for p in self._peers():
+            self.service.send_request(
+                p, REQUEST_JOIN, req, on_join(p), lambda e: None, timeout=self.CHECK_TIMEOUT
+            )
+        if self.cs.quorum(self._joins):
+            self._become_leader()
+            return
+        self._schedule_election(attempt + 1)
+
+    def _become_leader(self):
+        if self.mode == LEADER:
+            return
+        self.mode = LEADER
+        self.leader = self.node_id
+        self._leader_fail_count = {}
+        # first publication of the new term: adopt last accepted content,
+        # stamp ourselves master, ensure all voters present as nodes
+        base = self.cs.last_accepted
+        nodes = dict(base.nodes)
+        nodes[self.node_id] = self.node_info
+        from dataclasses import replace
+
+        st = replace(
+            base.with_master(self.cs.current_term, base.version + 1, self.node_id),
+            nodes=nodes,
+        )
+        self._publish(st, lambda ok, why: None)
+
+    def _become_follower(self, leader: str, term: int):
+        stepped_down = self.mode == LEADER
+        self.mode = FOLLOWER
+        self.leader = leader
+        self._last_leader_msg = self._now()
+        self._my_fail_count = 0
+        if stepped_down:
+            self._fail_master_work("stepped down")
+        if self.node_id not in self.applied_state.nodes:
+            # not yet in the cluster state: ask the master to add us (the
+            # reference's join flow for nodes beyond the electing quorum)
+            self._request_join_existing(leader)
+        self._schedule_election(0)  # re-arm in case this leader dies
+
+    def _become_candidate(self, why: str):
+        if self.mode == LEADER:
+            self._fail_master_work(f"stepped down: {why}")
+        self.mode = CANDIDATE
+        self.leader = None
+        self._schedule_election(0)
+
+    def _fail_master_work(self, why: str):
+        if self._publication is not None:
+            pub, self._publication = self._publication, None
+            pub.on_done(False, why)
+        tasks, self._pending_tasks = self._pending_tasks, []
+        for _desc, _update, on_done in tasks:
+            on_done(False, why)
+
+    # -- election handlers -------------------------------------------------
+
+    def _on_pre_vote(self, req, from_node):
+        la = self.cs.last_accepted
+        fresh = (req["last_term"], req["last_version"]) >= (la.term, la.version)
+        no_live_leader = (
+            self.leader is None
+            or self._now() - self._last_leader_msg >= self.LEADER_LEASE
+        ) and self.mode != LEADER
+        return {"grant": bool(fresh and no_live_leader and req["term"] > self.cs.current_term)}
+
+    def _on_join_request(self, req, from_node):
+        granted = self.cs.handle_join_request(
+            req["term"], req["cand_term"], req["cand_version"]
+        )
+        if granted and self.mode == LEADER:
+            # we were leader in an older term; a new term started
+            self._become_candidate("voted in newer term")
+        return {"granted": granted, "term": self.cs.current_term}
+
+    # -- publication -------------------------------------------------------
+
+    def _publish(self, state: ClusterState, on_done: Callable[[bool, str], None]):
+        """Leader-only 2-phase broadcast. One in flight at a time (the
+        MasterService above this serializes)."""
+        if self.mode != LEADER:
+            on_done(False, "not master")
+            return
+        assert self._publication is None, "publication already in flight"
+        pub = _Publication(state, {self.node_id}, False, on_done)
+        self._publication = pub
+        # self-accept through the same safety core
+        ok = self.cs.handle_publish(state)
+        if not ok:
+            self._publication = None
+            on_done(False, "rejected locally")
+            return
+        wire = state.to_dict()
+        targets = set(state.nodes) | set(self.cs.voting_nodes)
+        targets.discard(self.node_id)
+
+        def on_ack(peer):
+            def cb(resp):
+                if self._publication is not pub:
+                    return
+                if resp.get("accepted"):
+                    pub.acked.add(peer)
+                    self._maybe_commit(pub)
+                elif resp.get("term", 0) > self.cs.current_term:
+                    self.cs.current_term = resp["term"]
+                    self._publication = None
+                    self._become_candidate("publication rejected by higher term")
+                    pub.on_done(False, "higher term seen")
+            return cb
+
+        for p in sorted(targets):
+            self.service.send_request(
+                p, PUBLISH, {"state": wire}, on_ack(p), lambda e: None,
+                timeout=self.PUBLISH_TIMEOUT,
+            )
+        self._maybe_commit(pub)
+        # timeout the publication as a whole
+        def timeout():
+            if self._publication is pub and not pub.committed:
+                self._publication = None
+                pub.on_done(False, "publication timed out")
+                self._become_candidate("publication timed out")
+
+        self.network.schedule(self.PUBLISH_TIMEOUT, timeout)
+
+    def _maybe_commit(self, pub: _Publication):
+        if pub.commit_sent or not self.cs.quorum(pub.acked):
+            return
+        pub.commit_sent = True
+        pub.committed = True
+        st = pub.state
+        self.cs.handle_commit(st.term, st.version)
+        self._apply(st)
+        msg = {"term": st.term, "version": st.version}
+        for p in sorted(set(st.nodes) | set(self.cs.voting_nodes)):
+            if p != self.node_id:
+                self.service.send_request(
+                    p, COMMIT, msg, lambda r: None, lambda e: None,
+                    timeout=self.PUBLISH_TIMEOUT,
+                )
+        self._publication = None
+        pub.on_done(True, "committed")
+        self._drain_tasks()
+
+    def _on_publish(self, req, from_node):
+        state = ClusterState.from_dict(req["state"])
+        accepted = self.cs.handle_publish(state)
+        if accepted:
+            self._become_follower(state.master_id or from_node, state.term)
+        return {"accepted": accepted, "term": self.cs.current_term}
+
+    def _on_commit(self, req, from_node):
+        applied = self.cs.handle_commit(req["term"], req["version"])
+        if applied:
+            self._last_leader_msg = self._now()
+            self._apply(self.cs.last_committed)
+        return {"applied": applied}
+
+    def _apply(self, state: ClusterState):
+        for fn in self._applied_listeners:
+            fn(state)
+
+    # -- master service (serialized state updates) -------------------------
+
+    def submit_state_update(
+        self,
+        description: str,
+        update: Callable[[ClusterState], ClusterState],
+        on_done: Callable[[bool, str], None] | None = None,
+    ):
+        """Run `update` on the latest state and publish the result; tasks are
+        serialized like the reference's single masterService#updateTask thread
+        (cluster/service/MasterService.java:204)."""
+        self._pending_tasks.append((description, update, on_done or (lambda ok, why: None)))
+        self._drain_tasks()
+
+    def _drain_tasks(self):
+        if self.mode != LEADER or self._publication is not None or not self._pending_tasks:
+            return
+        desc, update, on_done = self._pending_tasks.pop(0)
+        base = self.cs.last_accepted
+        try:
+            new_state = update(base)
+        except Exception as ex:
+            on_done(False, f"update failed: {ex!r}")
+            self.network.schedule(0, self._drain_tasks)
+            return
+        if new_state is base or new_state is None:
+            on_done(True, "no change")
+            self.network.schedule(0, self._drain_tasks)
+            return
+        new_state = new_state.with_master(
+            self.cs.current_term, base.version + 1, self.node_id
+        )
+        self._publish(new_state, on_done)
+
+    # -- failure detection -------------------------------------------------
+
+    def _schedule_checks(self):
+        if not self._started:
+            return
+        self._check_gen += 1
+        gen = self._check_gen
+        self.network.schedule(self.CHECK_INTERVAL, lambda: self._run_checks(gen))
+
+    def _run_checks(self, gen):
+        if gen != self._check_gen or not self._started:
+            return
+        if self.mode == LEADER:
+            self._check_followers()
+        elif self.leader is not None:
+            self._check_leader()
+        self._check_gen += 1
+        gen2 = self._check_gen
+        self.network.schedule(self.CHECK_INTERVAL, lambda: self._run_checks(gen2))
+
+    def _check_followers(self):
+        term = self.cs.current_term
+        for p in self._peers():
+
+            def ok(peer):
+                def cb(resp):
+                    if resp.get("term", 0) > self.cs.current_term:
+                        self._become_candidate("follower at higher term")
+                    else:
+                        self._leader_fail_count[peer] = 0
+                return cb
+
+            def fail(peer):
+                def cb(err):
+                    if self.mode != LEADER:
+                        return
+                    c = self._leader_fail_count.get(peer, 0) + 1
+                    self._leader_fail_count[peer] = c
+                    if c >= self.STRIKES:
+                        self._leader_fail_count[peer] = 0
+                        self._remove_node(peer)
+                return cb
+
+            lc = self.cs.last_committed
+            self.service.send_request(
+                p, FOLLOWER_CHECK,
+                {
+                    "term": term,
+                    "leader": self.node_id,
+                    "committed_term": lc.term,
+                    "committed_version": lc.version,
+                },
+                ok(p), fail(p), timeout=self.CHECK_TIMEOUT,
+            )
+
+    def _remove_node(self, node_id: str):
+        def update(st: ClusterState):
+            if node_id not in st.nodes:
+                return st
+            return st.without_node(node_id)
+
+        self.submit_state_update(f"node-left [{node_id}]", update)
+
+    def _check_leader(self):
+        leader = self.leader
+
+        def ok(resp):
+            if leader == self.leader:
+                self._my_fail_count = 0
+                self._last_leader_msg = self._now()
+
+        def fail(err):
+            if leader != self.leader or self.mode == LEADER:
+                return
+            self._my_fail_count += 1
+            if self._my_fail_count >= self.STRIKES:
+                self._my_fail_count = 0
+                self._become_candidate("leader unreachable")
+
+        self.service.send_request(
+            leader, LEADER_CHECK, {"from": self.node_id}, ok, fail,
+            timeout=self.CHECK_TIMEOUT,
+        )
+
+    def _on_follower_check(self, req, from_node):
+        if req["term"] < self.cs.current_term:
+            return {"term": self.cs.current_term}
+        if req["term"] > self.cs.current_term:
+            self.cs.current_term = req["term"]
+            self.cs.join_granted_this_term = True
+        self._become_follower(req["leader"], req["term"])
+        # a node not yet in the cluster state joins via the master
+        if self.node_id not in self.applied_state.nodes:
+            self._request_join_existing(req["leader"])
+        # lag detection: if the leader has committed past us (e.g. we were
+        # partitioned through a publication), pull the full committed state —
+        # the reference instead re-publishes to lagging nodes and removes
+        # hopeless laggards (LagDetector); a pull fast-path is equivalent for
+        # full-state publication
+        lc = self.cs.last_committed
+        if (req.get("committed_term", 0), req.get("committed_version", 0)) > (
+            lc.term,
+            lc.version,
+        ):
+            self._fetch_state(req["leader"])
+        return {"term": self.cs.current_term, "ok": True}
+
+    def _on_leader_check(self, req, from_node):
+        return {"master": self.mode == LEADER}
+
+    # -- discovery / late joins --------------------------------------------
+
+    def _on_peer_find(self, req, from_node):
+        return {"master": self.leader, "term": self.cs.current_term}
+
+    def _request_join_existing(self, master: str):
+        self.service.send_request(
+            master,
+            JOIN_EXISTING,
+            {"node_id": self.node_id, "info": self.node_info},
+            lambda r: None,
+            lambda e: None,
+            timeout=self.CHECK_TIMEOUT,
+        )
+
+    def _fetch_state(self, master: str):
+        def on_state(resp):
+            st = ClusterState.from_dict(resp["state"])
+            lc = self.cs.last_committed
+            la = self.cs.last_accepted
+            if (st.term, st.version) <= (lc.term, lc.version):
+                return
+            # adopting a quorum-committed state is safe at any term
+            if st.term > self.cs.current_term:
+                self.cs.current_term = st.term
+                self.cs.join_granted_this_term = True
+            if (st.term, st.version) > (la.term, la.version):
+                self.cs.last_accepted = st
+            self.cs.last_committed = st
+            self._apply(st)
+
+        self.service.send_request(
+            master, FETCH_STATE, {}, on_state, lambda e: None,
+            timeout=self.CHECK_TIMEOUT,
+        )
+
+    def _on_fetch_state(self, req, from_node):
+        return {"state": self.cs.last_committed.to_dict()}
+
+    def _on_join_existing(self, req, from_node):
+        node_id, info = req["node_id"], req["info"]
+
+        def update(st: ClusterState):
+            if node_id in st.nodes:
+                return st
+            return st.with_node(node_id, info)
+
+        self.submit_state_update(f"node-join [{node_id}]", update)
+        return {"ok": True}

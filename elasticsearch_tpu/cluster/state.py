@@ -1,0 +1,126 @@
+"""Immutable cluster state: nodes, index metadata, routing table.
+
+The reference's ClusterState is an immutable, versioned value replicated from
+the elected master to every node (reference behavior: cluster/ClusterState.java,
+published via cluster/coordination/PublicationTransportHandler.java). Here it
+is a frozen value object with copy-on-write `with_*` helpers and a dict wire
+form. Full-state publication only — the reference's diff machinery is an
+optimization this framework does not need at its cluster sizes (documented
+simplification of cluster/ClusterState.java Diff support).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class ShardAssignment:
+    node: str
+    primary: bool
+    state: str = "STARTED"  # INITIALIZING | STARTED | RELOCATING
+
+    def to_dict(self):
+        return {"node": self.node, "primary": self.primary, "state": self.state}
+
+    @staticmethod
+    def from_dict(d):
+        return ShardAssignment(d["node"], d["primary"], d.get("state", "STARTED"))
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    """term/version pair orders states: a state is newer iff
+    (term, version) is lexicographically greater — the same ordering the
+    reference's coordination safety core uses
+    (cluster/coordination/CoordinationState.java)."""
+
+    term: int = 0
+    version: int = 0
+    master_id: str | None = None
+    # node_id -> {"address": ..., "roles": [...]}
+    nodes: dict = field(default_factory=dict)
+    # index name -> {"mappings": {...}, "settings": {...}, "uuid": str}
+    indices: dict = field(default_factory=dict)
+    # index name -> {shard_num(str): [ShardAssignment-dict, ...]}
+    routing: dict = field(default_factory=dict)
+
+    # -- copy-on-write helpers --------------------------------------------
+
+    def with_master(self, term: int, version: int, master_id: str | None):
+        return replace(self, term=term, version=version, master_id=master_id)
+
+    def with_node(self, node_id: str, info: dict):
+        nodes = dict(self.nodes)
+        nodes[node_id] = info
+        return replace(self, nodes=nodes)
+
+    def without_node(self, node_id: str):
+        nodes = {k: v for k, v in self.nodes.items() if k != node_id}
+        routing = {
+            idx: {
+                s: [a for a in assigns if a["node"] != node_id]
+                for s, assigns in shards.items()
+            }
+            for idx, shards in self.routing.items()
+        }
+        return replace(self, nodes=nodes, routing=routing)
+
+    def with_index(self, name: str, meta: dict, routing: dict):
+        indices = dict(self.indices)
+        indices[name] = meta
+        routing_all = dict(self.routing)
+        routing_all[name] = routing
+        return replace(self, indices=indices, routing=routing_all)
+
+    def without_index(self, name: str):
+        indices = {k: v for k, v in self.indices.items() if k != name}
+        routing = {k: v for k, v in self.routing.items() if k != name}
+        return replace(self, indices=indices, routing=routing)
+
+    def with_routing(self, index: str, routing: dict):
+        routing_all = dict(self.routing)
+        routing_all[index] = routing
+        return replace(self, routing=routing_all)
+
+    # -- queries -----------------------------------------------------------
+
+    def is_newer_than(self, other: "ClusterState") -> bool:
+        return (self.term, self.version) > (other.term, other.version)
+
+    def primary_node(self, index: str, shard: int) -> str | None:
+        for a in self.routing.get(index, {}).get(str(shard), []):
+            if a["primary"] and a.get("state") != "INITIALIZING":
+                return a["node"]
+        return None
+
+    def replica_nodes(self, index: str, shard: int) -> list[str]:
+        return [
+            a["node"]
+            for a in self.routing.get(index, {}).get(str(shard), [])
+            if not a["primary"]
+        ]
+
+    # -- wire --------------------------------------------------------------
+
+    def to_dict(self):
+        return {
+            "term": self.term,
+            "version": self.version,
+            "master_id": self.master_id,
+            "nodes": copy.deepcopy(self.nodes),
+            "indices": copy.deepcopy(self.indices),
+            "routing": copy.deepcopy(self.routing),
+        }
+
+    @staticmethod
+    def from_dict(d) -> "ClusterState":
+        return ClusterState(
+            term=d["term"],
+            version=d["version"],
+            master_id=d.get("master_id"),
+            nodes=copy.deepcopy(d.get("nodes", {})),
+            indices=copy.deepcopy(d.get("indices", {})),
+            routing=copy.deepcopy(d.get("routing", {})),
+        )

@@ -1,0 +1,18 @@
+from .base import (
+    ConnectTransportError,
+    NodeDisconnectedError,
+    ReceiveTimeoutError,
+    RemoteTransportError,
+    TransportService,
+)
+from .deterministic import DeterministicTaskQueue, LocalTransportNetwork
+
+__all__ = [
+    "TransportService",
+    "RemoteTransportError",
+    "ConnectTransportError",
+    "NodeDisconnectedError",
+    "ReceiveTimeoutError",
+    "DeterministicTaskQueue",
+    "LocalTransportNetwork",
+]

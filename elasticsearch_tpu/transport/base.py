@@ -1,0 +1,142 @@
+"""Transport abstraction: string-keyed action RPC between nodes.
+
+The reference's node-to-node communication is a framed TCP RPC where every
+distributed behavior registers a named handler and sends point-to-point
+requests (reference behavior: transport/TransportService.java:294
+registerRequestHandler, :741 sendRequest; the wire itself is
+transport/TcpTransport.java). This framework keeps the same shape — the
+control plane (coordination, replication, recovery) is host-side RPC — while
+the data plane (scoring, top-k merge) is XLA collectives over ICI, not RPC.
+
+Two implementations:
+  - deterministic.LocalTransportNetwork — in-process, virtual-time, with
+    programmable disruptions (the DisruptableMockTransport analog) for
+    deterministic simulation tests of the control plane.
+  - tcp.TcpTransportNetwork — length-prefixed JSON frames over real sockets
+    for multi-process deployments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class TransportError(Exception):
+    pass
+
+
+class RemoteTransportError(TransportError):
+    """Handler on the remote node raised; carries the remote reason."""
+
+
+class ConnectTransportError(TransportError):
+    """Destination unreachable (unknown node / network drop)."""
+
+
+class NodeDisconnectedError(ConnectTransportError):
+    """Connection dropped while a request was in flight."""
+
+
+class ReceiveTimeoutError(TransportError):
+    """No response within the request timeout."""
+
+
+@dataclass
+class ResponseHandler:
+    """Callback pair for an in-flight request."""
+
+    on_response: Callable[[Any], None]
+    on_failure: Callable[[Exception], None]
+
+
+Handler = Callable[[Any, str], Any]
+"""Request handler: (request, from_node) -> response (or raises)."""
+
+
+class TransportService:
+    """Per-node action registry + request dispatch over a Transport.
+
+    `transport` must provide:
+      send(from_node, to_node, action, request, request_id)  — one-way message
+      respond(to_node, request_id, response, error)          — response path
+    and call back into `handle_inbound` / `handle_response` on this service.
+    """
+
+    def __init__(self, node_id: str, network):
+        self.node_id = node_id
+        self.network = network
+        self._handlers: dict[str, Handler] = {}
+        self._pending: dict[int, ResponseHandler] = {}
+        self._next_request_id = 0
+        network.attach(node_id, self)
+
+    # -- registration ------------------------------------------------------
+
+    def register_handler(self, action: str, handler: Handler) -> None:
+        if action in self._handlers:
+            raise ValueError(f"handler already registered for [{action}]")
+        self._handlers[action] = handler
+
+    # -- outbound ----------------------------------------------------------
+
+    def send_request(
+        self,
+        to_node: str,
+        action: str,
+        request: Any,
+        on_response: Callable[[Any], None],
+        on_failure: Callable[[Exception], None],
+        timeout: float | None = None,
+    ) -> None:
+        rid = self._next_request_id
+        self._next_request_id += 1
+        self._pending[rid] = ResponseHandler(on_response, on_failure)
+        if timeout is not None:
+            self.network.schedule(
+                timeout, lambda: self._timeout(rid, action, to_node)
+            )
+        self.network.send(self.node_id, to_node, action, request, rid)
+
+    def _timeout(self, rid: int, action: str, to_node: str) -> None:
+        handler = self._pending.pop(rid, None)
+        if handler is not None:
+            handler.on_failure(
+                ReceiveTimeoutError(f"[{action}] to [{to_node}] timed out")
+            )
+
+    # -- inbound (called by the network impl) ------------------------------
+
+    def handle_inbound(self, from_node: str, action: str, request: Any, rid: int):
+        handler = self._handlers.get(action)
+        if handler is None:
+            self.network.respond(
+                self.node_id, from_node, rid, None,
+                f"no handler for action [{action}]",
+            )
+            return
+        try:
+            response = handler(request, from_node)
+        except Exception as ex:  # remote error envelope
+            self.network.respond(self.node_id, from_node, rid, None, repr(ex))
+            return
+        self.network.respond(self.node_id, from_node, rid, response, None)
+
+    def handle_response(self, rid: int, response: Any, error: str | None):
+        handler = self._pending.pop(rid, None)
+        if handler is None:
+            return  # already timed out / node shut down
+        if error is not None:
+            handler.on_failure(RemoteTransportError(error))
+        else:
+            handler.on_response(response)
+
+    def handle_connection_failure(self, rid: int, reason: str):
+        handler = self._pending.pop(rid, None)
+        if handler is not None:
+            handler.on_failure(ConnectTransportError(reason))
+
+    def fail_all_pending(self, reason: str):
+        pending, self._pending = self._pending, {}
+        for handler in pending.values():
+            handler.on_failure(NodeDisconnectedError(reason))

@@ -1,0 +1,175 @@
+"""Deterministic simulation substrate: virtual time + disruptable transport.
+
+The reference tests its coordination layer multi-node WITHOUT threads or
+sockets: a seeded discrete-event queue (reference behavior:
+common/util/concurrent/DeterministicTaskQueue.java:47 — virtual time, random
+choice among runnable tasks) plus an in-memory transport with programmable
+black-holes and disconnects (transport/DisruptableMockTransport.java). Every
+run is reproducible from its seed. This module is that substrate for the TPU
+framework's control plane; tests/test_coordination.py uses it the way
+AbstractCoordinatorTestCase.runRandomly/stabilise does.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Any, Callable
+
+
+class DeterministicTaskQueue:
+    """Seeded virtual-time scheduler. Tasks at the same readiness run in a
+    random (but seed-deterministic) order."""
+
+    def __init__(self, seed: int = 0):
+        self.random = random.Random(seed)
+        self.now = 0.0
+        self._heap: list[tuple[float, float, int, Callable[[], None]]] = []
+        self._counter = 0
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        # jitter the priority among same-time tasks for random runnable order
+        self._counter += 1
+        heapq.heappush(
+            self._heap, (self.now + max(delay, 0.0), self.random.random(), self._counter, fn)
+        )
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self.schedule(0.0, fn)
+
+    @property
+    def has_tasks(self) -> bool:
+        return bool(self._heap)
+
+    def run_one(self) -> bool:
+        if not self._heap:
+            return False
+        t, _, _, fn = heapq.heappop(self._heap)
+        self.now = max(self.now, t)
+        fn()
+        return True
+
+    def run_until_idle(self, max_tasks: int = 100_000) -> None:
+        n = 0
+        while self.run_one():
+            n += 1
+            if n >= max_tasks:
+                raise RuntimeError("task queue did not go idle (livelock?)")
+
+    def run_for(self, duration: float, max_tasks: int = 100_000) -> None:
+        """Advance virtual time by `duration`, running everything due."""
+        deadline = self.now + duration
+        n = 0
+        while self._heap and self._heap[0][0] <= deadline:
+            self.run_one()
+            n += 1
+            if n >= max_tasks:
+                raise RuntimeError("too many tasks within window")
+        self.now = deadline
+
+
+class LocalTransportNetwork:
+    """In-process network of TransportServices over a DeterministicTaskQueue.
+
+    Disruption API (the NetworkDisruption / DisruptableMockTransport analog):
+      blackhole(a, b)    — messages a->b vanish silently (requests time out)
+      disconnect(a, b)   — messages a->b fail fast with ConnectTransportError
+      partition({A}, {B}) — blackhole both directions between the two sets
+      heal()             — clear all rules
+      kill(node)         — detach a node entirely (restartable via attach)
+    Rules are directional and checked at delivery time as well as send time,
+    so a message in flight when the partition forms is also lost — the same
+    in-flight-loss semantics the reference's disruption schemes exercise.
+    """
+
+    def __init__(self, queue: DeterministicTaskQueue, min_delay=0.001, max_delay=0.01):
+        self.queue = queue
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self._services: dict[str, Any] = {}
+        self._blackholes: set[tuple[str, str]] = set()
+        self._disconnects: set[tuple[str, str]] = set()
+        self._dead: set[str] = set()
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, node_id: str, service) -> None:
+        self._services[node_id] = service
+        self._dead.discard(node_id)
+
+    def kill(self, node_id: str) -> None:
+        self._dead.add(node_id)
+        svc = self._services.get(node_id)
+        if svc is not None:
+            svc.fail_all_pending(f"node [{node_id}] stopped")
+
+    def restart(self, node_id: str) -> None:
+        self._dead.discard(node_id)
+
+    def schedule(self, delay: float, fn) -> None:
+        self.queue.schedule(delay, fn)
+
+    # -- disruptions -------------------------------------------------------
+
+    def blackhole(self, a: str, b: str) -> None:
+        self._blackholes.add((a, b))
+
+    def disconnect(self, a: str, b: str) -> None:
+        self._disconnects.add((a, b))
+
+    def partition(self, side_a, side_b) -> None:
+        for a in side_a:
+            for b in side_b:
+                self._blackholes.add((a, b))
+                self._blackholes.add((b, a))
+
+    def isolate(self, node: str) -> None:
+        others = [n for n in self._services if n != node]
+        self.partition([node], others)
+
+    def heal(self) -> None:
+        self._blackholes.clear()
+        self._disconnects.clear()
+
+    def _dropped(self, a: str, b: str) -> bool:
+        return (a, b) in self._blackholes or a in self._dead or b in self._dead
+
+    def _delay(self) -> float:
+        return self.queue.random.uniform(self.min_delay, self.max_delay)
+
+    # -- message paths -----------------------------------------------------
+
+    def send(self, from_node: str, to_node: str, action: str, request, rid: int):
+        svc_from = self._services.get(from_node)
+        if (from_node, to_node) in self._disconnects or to_node not in self._services:
+            self.queue.schedule(
+                self._delay(),
+                lambda: svc_from.handle_connection_failure(
+                    rid, f"[{to_node}] disconnected"
+                ),
+            )
+            return
+        if self._dropped(from_node, to_node):
+            return  # silently lost
+
+        def deliver():
+            if self._dropped(from_node, to_node):
+                return  # lost in flight
+            svc = self._services.get(to_node)
+            if svc is not None and to_node not in self._dead:
+                svc.handle_inbound(from_node, action, request, rid)
+
+        self.queue.schedule(self._delay(), deliver)
+
+    def respond(self, from_node: str, to_node: str, rid: int, response, error):
+        if self._dropped(from_node, to_node) or (from_node, to_node) in self._disconnects:
+            return  # response lost — requester times out
+
+        def deliver():
+            if self._dropped(from_node, to_node):
+                return
+            svc = self._services.get(to_node)
+            if svc is not None and to_node not in self._dead:
+                svc.handle_response(rid, response, error)
+
+        self.queue.schedule(self._delay(), deliver)

@@ -1,0 +1,237 @@
+"""Deterministic-simulation tests of the coordination layer.
+
+The reference tests election/publication safety+liveness with a seeded
+discrete-event simulator and a disruptable in-memory transport (reference
+behavior: cluster/coordination/AbstractCoordinatorTestCase.java:371
+runRandomly then :344 stabilise; DeterministicTaskQueue.java:47;
+DisruptableMockTransport.java). Same pattern here: virtual time, seeded
+randomness, programmable partitions, then assert exactly-one-leader and
+state convergence.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from elasticsearch_tpu.cluster.coordination import Coordinator, LEADER
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.transport import (
+    DeterministicTaskQueue,
+    LocalTransportNetwork,
+    TransportService,
+)
+
+
+class SimCluster:
+    def __init__(self, n: int, seed: int = 0):
+        self.queue = DeterministicTaskQueue(seed)
+        self.net = LocalTransportNetwork(self.queue)
+        self.node_ids = [f"node-{i}" for i in range(n)]
+        self.coordinators: dict[str, Coordinator] = {}
+        for nid in self.node_ids:
+            svc = TransportService(nid, self.net)
+            self.coordinators[nid] = Coordinator(nid, list(self.node_ids), svc, self.net)
+        for c in self.coordinators.values():
+            c.start()
+
+    def run(self, seconds: float):
+        self.queue.run_for(seconds, max_tasks=500_000)
+
+    def stabilise(self, seconds: float = 60.0):
+        self.net.heal()
+        self.run(seconds)
+
+    def leaders(self):
+        return [c for c in self.coordinators.values() if c.mode == LEADER]
+
+    def the_leader(self) -> Coordinator:
+        max_term = max(c.cs.current_term for c in self.coordinators.values())
+        leaders = [c for c in self.leaders() if c.cs.current_term == max_term]
+        assert len(leaders) == 1, (
+            f"expected exactly one leader at max term {max_term}, got "
+            f"{[(c.node_id, c.cs.current_term, c.mode) for c in self.coordinators.values()]}"
+        )
+        return leaders[0]
+
+    def assert_converged(self):
+        leader = self.the_leader()
+        want = leader.applied_state
+        assert want.master_id == leader.node_id
+        for c in self.coordinators.values():
+            got = c.applied_state
+            assert (got.term, got.version) == (want.term, want.version), (
+                f"{c.node_id} applied {(got.term, got.version)} != {(want.term, want.version)}"
+            )
+            assert got.master_id == leader.node_id
+        # every node eventually joins the cluster state
+        assert set(want.nodes) == set(self.node_ids)
+        return leader
+
+
+def test_initial_election_three_nodes():
+    cluster = SimCluster(3, seed=1)
+    cluster.stabilise()
+    cluster.assert_converged()
+
+
+def test_single_node_cluster():
+    cluster = SimCluster(1, seed=2)
+    cluster.stabilise(30)
+    leader = cluster.assert_converged()
+    assert leader.node_id == "node-0"
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_leader_isolation_failover(seed):
+    cluster = SimCluster(3, seed=seed)
+    cluster.stabilise()
+    old = cluster.assert_converged()
+    old_term = old.cs.current_term
+
+    cluster.net.isolate(old.node_id)
+    cluster.run(30)
+    # majority side elected a fresh leader in a higher term
+    others = [c for c in cluster.coordinators.values() if c.node_id != old.node_id]
+    new_leaders = [c for c in others if c.mode == LEADER]
+    assert len(new_leaders) == 1
+    assert new_leaders[0].cs.current_term > old_term
+
+    cluster.stabilise()
+    leader = cluster.assert_converged()
+    assert leader.cs.current_term > old_term
+
+
+def test_minority_master_cannot_commit():
+    cluster = SimCluster(5, seed=7)
+    cluster.stabilise()
+    old = cluster.assert_converged()
+    minority_peer = next(
+        c.node_id for c in cluster.coordinators.values() if c.node_id != old.node_id
+    )
+    minority = [old.node_id, minority_peer]
+    majority = [n for n in cluster.node_ids if n not in minority]
+    cluster.net.partition(minority, majority)
+
+    results = []
+    old.submit_state_update(
+        "create-index-on-minority",
+        lambda st: st.with_index("idx", {"settings": {}}, {}),
+        lambda ok, why: results.append(ok),
+    )
+    cluster.run(60)
+    # the isolated ex-master could not commit — the update must have failed
+    assert results == [False]
+    new_leader = [
+        c for c in cluster.coordinators.values()
+        if c.mode == LEADER and c.node_id in majority
+    ]
+    assert len(new_leader) == 1
+    assert "idx" not in new_leader[0].applied_state.indices
+
+    cluster.stabilise()
+    leader = cluster.assert_converged()
+    assert "idx" not in leader.applied_state.indices
+
+
+def test_committed_update_survives_failover():
+    cluster = SimCluster(3, seed=11)
+    cluster.stabilise()
+    leader = cluster.assert_converged()
+
+    results = []
+    leader.submit_state_update(
+        "create-index",
+        lambda st: st.with_index("logs", {"settings": {"number_of_shards": 2}}, {}),
+        lambda ok, why: results.append((ok, why)),
+    )
+    cluster.run(30)
+    assert results and results[0][0] is True
+
+    cluster.net.isolate(leader.node_id)
+    cluster.run(30)
+    cluster.stabilise()
+    new_leader = cluster.assert_converged()
+    # the committed index survived the master change (quorum intersection)
+    assert "logs" in new_leader.applied_state.indices
+
+
+def test_node_left_detected_and_removed():
+    cluster = SimCluster(3, seed=13)
+    cluster.stabilise()
+    leader = cluster.assert_converged()
+    victim = next(n for n in cluster.node_ids if n != leader.node_id)
+    cluster.net.kill(victim)
+    cluster.run(60)
+    assert victim not in leader.applied_state.nodes
+    # cluster still works: updates commit with the remaining quorum
+    results = []
+    leader.submit_state_update(
+        "post-departure-update",
+        lambda st: st.with_index("after", {}, {}),
+        lambda ok, why: results.append(ok),
+    )
+    cluster.run(30)
+    assert results == [True]
+
+
+@pytest.mark.parametrize("seed", list(range(20, 26)))
+def test_random_disruptions_converge(seed):
+    """runRandomly-then-stabilise: random partitions/heals/updates, then
+    assert single-leader convergence and applied-state monotonicity."""
+    cluster = SimCluster(5, seed=seed)
+    rnd = cluster.queue.random
+
+    applied_log: dict[str, list[tuple[int, int]]] = {n: [] for n in cluster.node_ids}
+    for nid, c in cluster.coordinators.items():
+        c.add_applied_listener(
+            lambda st, nid=nid: applied_log[nid].append((st.term, st.version))
+        )
+
+    committed_indices: set[str] = set()
+    update_no = 0
+    for step in range(30):
+        action = rnd.random()
+        if action < 0.25:
+            side = rnd.sample(cluster.node_ids, rnd.randint(1, 2))
+            other = [n for n in cluster.node_ids if n not in side]
+            cluster.net.partition(side, other)
+        elif action < 0.45:
+            cluster.net.heal()
+        elif action < 0.8:
+            leaders = cluster.leaders()
+            if leaders:
+                name = f"idx-{update_no}"
+                update_no += 1
+
+                def mk(nm):
+                    def done(ok, why):
+                        if ok:
+                            committed_indices.add(nm)
+                    return done
+
+                leaders[0].submit_state_update(
+                    f"create {name}",
+                    lambda st, nm=name: st.with_index(nm, {}, {}),
+                    mk(name),
+                )
+        cluster.run(rnd.uniform(0.5, 5.0))
+
+    cluster.stabilise(120)
+    leader = cluster.assert_converged()
+    # every update acknowledged as committed is present after convergence
+    for name in committed_indices:
+        assert name in leader.applied_state.indices, f"lost committed index {name}"
+    # per-node applied (term, version) is non-decreasing — no rollbacks
+    for nid, log in applied_log.items():
+        for a, b in zip(log, log[1:]):
+            assert b >= a, f"{nid} applied state went backwards: {a} -> {b}"
+
+
+def test_determinism_same_seed_same_outcome():
+    def run_once():
+        cluster = SimCluster(3, seed=99)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        return (leader.node_id, leader.cs.current_term, leader.applied_state.version)
+
+    assert run_once() == run_once()
