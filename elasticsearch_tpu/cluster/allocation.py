@@ -1,0 +1,199 @@
+"""Shard allocation: assign primaries/replicas to nodes, promote on failure.
+
+The reference computes a desired balance and reconciles it under 21 deciders
+(reference behavior: cluster/routing/allocation/BalancedShardsAllocator.java:79,
+DesiredBalanceShardsAllocator.java:46); promotion safety comes from the
+in-sync allocation set persisted in index metadata — only a copy that was
+in-sync for every acked write may become primary
+(index/seqno/ReplicationTracker.java in-sync tracking, IndexMetadata
+inSyncAllocationIds). This module is the same contract with a least-loaded
+placement heuristic instead of the balancer: correctness (in-sync promotion,
+primary terms) is kept, the optimization machinery is not.
+
+Routing entry: {"node", "primary", "state", "allocation_id"}
+Index meta keys used: settings.number_of_shards/number_of_replicas,
+"in_sync": {shard: [allocation_ids]}, "primary_terms": {shard: int},
+"alloc_counter": int (deterministic allocation-id source).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from .state import ClusterState
+
+
+def data_nodes(state: ClusterState) -> list[str]:
+    return sorted(
+        n for n, info in state.nodes.items() if "data" in info.get("roles", ["data"])
+    )
+
+
+def _node_load(state: ClusterState) -> dict[str, int]:
+    load = {n: 0 for n in data_nodes(state)}
+    for shards in state.routing.values():
+        for assigns in shards.values():
+            for a in assigns:
+                if a["node"] in load:
+                    load[a["node"]] += 1
+    return load
+
+
+def allocate(state: ClusterState) -> ClusterState:
+    """Recompute assignments: drop dead nodes, promote in-sync replicas to
+    primary (bumping the primary term), backfill missing replicas as
+    INITIALIZING copies. Pure function: returns a new state (or the input
+    unchanged)."""
+    live = set(data_nodes(state))
+    load = _node_load(state)
+    new_indices = {}
+    new_routing = {}
+    changed = False
+
+    for index, meta in state.indices.items():
+        meta = copy.deepcopy(meta)
+        settings = meta.get("settings", {})
+        n_shards = int(settings.get("number_of_shards", 1))
+        n_replicas = int(settings.get("number_of_replicas", 0))
+        in_sync = meta.setdefault("in_sync", {})
+        terms = meta.setdefault("primary_terms", {})
+        routing = {s: list(assigns) for s, assigns in state.routing.get(index, {}).items()}
+
+        def next_alloc_id() -> str:
+            meta["alloc_counter"] = meta.get("alloc_counter", 0) + 1
+            return f"{index}-a{meta['alloc_counter']}"
+
+        for s in range(n_shards):
+            key = str(s)
+            terms.setdefault(key, 1)
+            in_sync.setdefault(key, [])
+            assigns = [a for a in routing.get(key, []) if a["node"] in live]
+            if len(assigns) != len(routing.get(key, [])):
+                changed = True
+            has_primary = any(a["primary"] for a in assigns)
+            if not has_primary:
+                # promote: only an in-sync STARTED replica may take over
+                promotable = [
+                    a
+                    for a in assigns
+                    if a["allocation_id"] in in_sync[key] and a["state"] == "STARTED"
+                ]
+                if promotable:
+                    promotable[0]["primary"] = True
+                    terms[key] += 1
+                    changed = True
+                elif not assigns and not in_sync[key]:
+                    # brand-new shard: place an empty primary, immediately
+                    # started and in-sync
+                    if load:
+                        node = min(load, key=lambda n: (load[n], n))
+                        aid = next_alloc_id()
+                        assigns = [
+                            {"node": node, "primary": True, "state": "STARTED",
+                             "allocation_id": aid}
+                        ]
+                        in_sync[key] = [aid]
+                        load[node] += 1
+                        changed = True
+                # else: red shard — every in-sync copy is gone; stay
+                # unassigned rather than silently lose acked writes
+                # (the reference requires explicit allocate_stale_primary)
+            # backfill replicas
+            n_live_replicas = sum(1 for a in assigns if not a["primary"])
+            occupied = {a["node"] for a in assigns}
+            has_started_primary = any(
+                a["primary"] and a["state"] == "STARTED" for a in assigns
+            )
+            while (
+                has_started_primary
+                and n_live_replicas < n_replicas
+                and (live - occupied)
+            ):
+                free = {n: load[n] for n in live - occupied}
+                node = min(free, key=lambda n: (free[n], n))
+                assigns.append(
+                    {"node": node, "primary": False, "state": "INITIALIZING",
+                     "allocation_id": next_alloc_id()}
+                )
+                occupied.add(node)
+                load[node] += 1
+                n_live_replicas += 1
+                changed = True
+            # prune in-sync ids whose assignment is gone AND that are not the
+            # promotion survivors; keep in-sync ids of missing copies so an
+            # unassigned shard stays red (safety) — only drop when a live
+            # primary exists and the id is no longer assigned
+            if any(a["primary"] and a["state"] == "STARTED" for a in assigns):
+                present = {a["allocation_id"] for a in assigns}
+                kept = [aid for aid in in_sync[key] if aid in present]
+                if kept != in_sync[key]:
+                    in_sync[key] = kept
+                    changed = True
+            routing[key] = assigns
+        new_indices[index] = meta
+        new_routing[index] = routing
+
+    if not changed:
+        return state
+    from dataclasses import replace
+
+    return replace(state, indices=new_indices, routing=new_routing)
+
+
+def mark_shard_started(
+    state: ClusterState, index: str, shard: int, allocation_id: str
+) -> ClusterState:
+    """Recovery finished: flip INITIALIZING -> STARTED and add to in-sync
+    (the reference's shard-started cluster state task)."""
+    meta = copy.deepcopy(state.indices.get(index))
+    if meta is None:
+        return state
+    key = str(shard)
+    routing = {s: [dict(a) for a in assigns] for s, assigns in state.routing.get(index, {}).items()}
+    hit = False
+    for a in routing.get(key, []):
+        if a["allocation_id"] == allocation_id and a["state"] == "INITIALIZING":
+            a["state"] = "STARTED"
+            hit = True
+    if not hit:
+        return state
+    in_sync = meta.setdefault("in_sync", {}).setdefault(key, [])
+    if allocation_id not in in_sync:
+        in_sync.append(allocation_id)
+    return state.with_index(index, meta, routing)
+
+
+def mark_shard_failed(
+    state: ClusterState, index: str, shard: int, allocation_id: str
+) -> ClusterState:
+    """Drop a failed copy from routing and the in-sync set (the reference's
+    shard-failed task; ReplicationOperation.java:613 fail-stale-copy)."""
+    meta = copy.deepcopy(state.indices.get(index))
+    if meta is None:
+        return state
+    key = str(shard)
+    routing = {s: [dict(a) for a in assigns] for s, assigns in state.routing.get(index, {}).items()}
+    before = len(routing.get(key, []))
+    routing[key] = [a for a in routing.get(key, []) if a["allocation_id"] != allocation_id]
+    if len(routing[key]) == before:
+        return state
+    in_sync = meta.setdefault("in_sync", {})
+    in_sync[key] = [aid for aid in in_sync.get(key, []) if aid != allocation_id]
+    return allocate(state.with_index(index, meta, routing))
+
+
+def create_index_state(
+    state: ClusterState, index: str, mappings: dict, settings: dict
+) -> ClusterState:
+    from ..utils.errors import IndexAlreadyExistsError
+
+    if index in state.indices:
+        raise IndexAlreadyExistsError(index)
+    meta = {
+        "mappings": mappings or {},
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0, **(settings or {})},
+        "in_sync": {},
+        "primary_terms": {},
+        "alloc_counter": 0,
+    }
+    return allocate(state.with_index(index, meta, {}))

@@ -156,6 +156,10 @@ class Coordinator:
         self._publication: _Publication | None = None
         self._pending_tasks: list[tuple[str, Callable]] = []
         self._applied_listeners: list[Callable[[ClusterState], None]] = []
+        # applied to every master-side state update (e.g. shard allocation
+        # reacting to membership changes — the reference's reroute-after-
+        # node-left, AllocationService.disassociateDeadNodes + reroute)
+        self.reconcilers: list[Callable[[ClusterState], ClusterState]] = []
         self._started = False
 
         service.register_handler(PRE_VOTE, self._on_pre_vote)
@@ -469,6 +473,9 @@ class Coordinator:
         base = self.cs.last_accepted
         try:
             new_state = update(base)
+            if new_state is not None and new_state is not base:
+                for rec in self.reconcilers:
+                    new_state = rec(new_state)
         except Exception as ex:
             on_done(False, f"update failed: {ex!r}")
             self.network.schedule(0, self._drain_tasks)

@@ -1,0 +1,537 @@
+"""ClusterNode: coordinator + replicated data shards + search scatter/gather.
+
+One ClusterNode = one node process (the reference's Node + IndicesService +
+IndicesClusterStateService + the replication/search transport actions). All
+inter-node communication goes through the Transport abstraction, so the whole
+multi-node data path runs under the deterministic simulator exactly like the
+coordination layer.
+
+Write path (reference behavior: TransportBulkAction routes items by
+Murmur3(_id) % shards, cluster/routing/IndexRouting.java:132; then
+TransportReplicationAction primary->replica fan-out,
+ReplicationOperation.java:107,210; failed copies are reported to the master
+and dropped from the in-sync set :613-625):
+    client -> any node (route by shard) -> primary (assign seq-nos, apply)
+           -> all replica copies in parallel -> acks from in-sync STARTED
+           -> global checkpoint advance -> client ack.
+Acked writes therefore exist on every in-sync copy, and promotion only picks
+in-sync copies (allocation.py), so acked writes survive primary failover.
+
+Read/search path (reference behavior: AbstractSearchAsyncAction.java:301
+scatter, SearchPhaseController.java:232 merge): scatter to one STARTED copy
+per shard, per-shard top-k on the engine pack, merge by (score desc,
+shard asc) at the coordinating node. On a TPU slice the same merge runs as
+an ICI collective (parallel/sharded.py); this module is the DCN/multi-host
+tier above it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..transport.base import TransportService
+from .allocation import (
+    allocate,
+    create_index_state,
+    mark_shard_failed,
+    mark_shard_started,
+)
+from .coordination import Coordinator
+from .routing import shard_for_id
+from .shard import ShardCopy
+from .state import ClusterState
+
+A_BULK_PRIMARY = "indices:data/write/bulk[p]"
+A_BULK_REPLICA = "indices:data/write/bulk[r]"
+A_GET = "indices:data/read/get"
+A_SHARD_SEARCH = "indices:data/read/search[shard]"
+A_START_RECOVERY = "internal:index/shard/recovery/start"
+A_MASTER_TASK = "internal:cluster/master_task"
+
+
+class ClusterNode:
+    REPLICATION_TIMEOUT = 5.0
+
+    def __init__(self, node_id: str, voting_nodes: list[str], network,
+                 roles: list[str] | None = None):
+        self.node_id = node_id
+        self.network = network
+        self.service = TransportService(node_id, network)
+        self.coordinator = Coordinator(
+            node_id, voting_nodes, self.service, network,
+            node_info={"roles": roles or ["master", "data"]},
+        )
+        self.shards: dict[tuple[str, int], ShardCopy] = {}
+        self._searchers: dict[tuple[str, int], tuple[int, object]] = {}
+        self._recovering: set[tuple[str, int]] = set()
+        self.coordinator.add_applied_listener(self._apply_cluster_state)
+        self.coordinator.reconcilers.append(allocate)
+
+        self.service.register_async_handler(A_BULK_PRIMARY, self._on_bulk_primary)
+        self.service.register_handler(A_BULK_REPLICA, self._on_bulk_replica)
+        self.service.register_handler(A_GET, self._on_get)
+        self.service.register_handler(A_SHARD_SEARCH, self._on_shard_search)
+        self.service.register_handler(A_START_RECOVERY, self._on_start_recovery)
+        self.service.register_async_handler(A_MASTER_TASK, self._on_master_task)
+
+    def start(self):
+        self.coordinator.start()
+
+    @property
+    def state(self) -> ClusterState:
+        return self.coordinator.applied_state
+
+    # ------------------------------------------------------------------
+    # cluster state application (IndicesClusterStateService analog)
+    # ------------------------------------------------------------------
+
+    def _apply_cluster_state(self, state: ClusterState):
+        seen: set[tuple[str, int]] = set()
+        for index, shards in state.routing.items():
+            meta = state.indices[index]
+            for s_key, assigns in shards.items():
+                s = int(s_key)
+                for a in assigns:
+                    if a["node"] != self.node_id:
+                        continue
+                    seen.add((index, s))
+                    copy = self.shards.get((index, s))
+                    if copy is None or copy.allocation_id != a["allocation_id"]:
+                        copy = ShardCopy(index, s, a["allocation_id"])
+                        self.shards[(index, s)] = copy
+                        self._searchers.pop((index, s), None)
+                    copy.primary_term = max(
+                        copy.primary_term, meta["primary_terms"].get(s_key, 1)
+                    )
+                    if a["state"] == "INITIALIZING" and not a["primary"]:
+                        self._maybe_start_recovery(state, index, s, a)
+        # drop copies no longer assigned here
+        for key in [k for k in self.shards if k not in seen]:
+            del self.shards[key]
+            self._searchers.pop(key, None)
+
+    # ------------------------------------------------------------------
+    # master-side tasks (any node forwards to the elected master)
+    # ------------------------------------------------------------------
+
+    def _submit_to_master(self, task: dict, on_done=None):
+        """on_done receives {"acknowledged": bool, ...} — True only after the
+        resulting cluster state COMMITTED (the reference's master-ack
+        semantics; a primary may not complete a write that depends on a
+        shard-failed update until the master confirms it,
+        ReplicationOperation.java fail-shard listener)."""
+        on_done = on_done or (lambda resp: None)
+        master = self.coordinator.leader
+        if self.coordinator.mode == "LEADER":
+            self._run_master_task(task, on_done)
+        elif master is not None:
+            self.service.send_request(
+                master, A_MASTER_TASK, task, on_done,
+                lambda e: on_done({"acknowledged": False, "why": str(e)}),
+                timeout=10.0,
+            )
+        else:
+            on_done({"acknowledged": False, "why": "no master"})
+
+    def _on_master_task(self, req, from_node, channel):
+        self._run_master_task(req, channel.send_response)
+
+    def _run_master_task(self, task: dict, on_done):
+        kind = task["kind"]
+
+        def update(st: ClusterState) -> ClusterState:
+            if kind == "create_index":
+                return create_index_state(st, task["index"], task.get("mappings"),
+                                          task.get("settings"))
+            if kind == "delete_index":
+                return allocate(st.without_index(task["index"]))
+            if kind == "shard_started":
+                return mark_shard_started(st, task["index"], task["shard"],
+                                          task["allocation_id"])
+            if kind == "shard_failed":
+                return mark_shard_failed(st, task["index"], task["shard"],
+                                         task["allocation_id"])
+            if kind == "reallocate":
+                return allocate(st)
+            raise ValueError(f"unknown master task [{kind}]")
+
+        self.coordinator.submit_state_update(
+            kind, update, lambda ok, why: on_done({"acknowledged": ok, "why": why})
+        )
+
+    # -- public cluster APIs ----------------------------------------------
+
+    def create_index(self, name: str, mappings: dict | None = None,
+                     settings: dict | None = None, on_done=None):
+        self._submit_to_master(
+            {"kind": "create_index", "index": name, "mappings": mappings,
+             "settings": settings},
+            on_done,
+        )
+
+    def delete_index(self, name: str, on_done=None):
+        self._submit_to_master({"kind": "delete_index", "index": name}, on_done)
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def client_bulk(self, index: str, ops: list[tuple], on_done: Callable[[dict], None]):
+        """ops: [(action, doc_id, source)]. Groups by shard, forwards each
+        group to its primary, merges per-item results in request order."""
+        state = self.state
+        meta = state.indices.get(index)
+        if meta is None:
+            on_done({"errors": True, "items": [],
+                     "error": f"index [{index}] missing"})
+            return
+        n_shards = int(meta["settings"].get("number_of_shards", 1))
+        groups: dict[int, list] = {}
+        order: dict[int, list[int]] = {}
+        for i, (action, doc_id, source) in enumerate(ops):
+            s = shard_for_id(doc_id, n_shards)
+            groups.setdefault(s, []).append((action, doc_id, source))
+            order.setdefault(s, []).append(i)
+
+        results: list = [None] * len(ops)
+        pending = {"n": len(groups), "errors": False}
+
+        def finish_group(s, group_resp):
+            for slot, item in zip(order[s], group_resp["items"]):
+                results[slot] = item
+                if "error" in item:
+                    pending["errors"] = True
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                on_done({"errors": pending["errors"], "items": results})
+
+        for s, group in groups.items():
+            primary_node = state.primary_node(index, s)
+            if primary_node is None:
+                finish_group(s, {"items": [
+                    {"error": "no active primary", "status": 503} for _ in group
+                ]})
+                continue
+            req = {"index": index, "shard": s, "ops": group}
+            if primary_node == self.node_id:
+                self._exec_bulk_primary(req, finish_group_cb(s, finish_group))
+            else:
+                self.service.send_request(
+                    primary_node, A_BULK_PRIMARY, req,
+                    lambda resp, s=s: finish_group(s, resp),
+                    lambda err, s=s, n=len(group): finish_group(
+                        s, {"items": [{"error": str(err), "status": 503}] * n}
+                    ),
+                    timeout=self.REPLICATION_TIMEOUT * 2,
+                )
+
+    def index_doc(self, index: str, doc_id: str, source: dict, on_done):
+        def done(resp):
+            item = resp["items"][0] if resp.get("items") else {"error": resp.get("error")}
+            on_done(item)
+
+        self.client_bulk(index, [("index", doc_id, source)], done)
+
+    # -- primary side ------------------------------------------------------
+
+    def _on_bulk_primary(self, req, from_node, channel):
+        self._exec_bulk_primary(req, channel.send_response,
+                                fail=channel.send_failure)
+
+    def _exec_bulk_primary(self, req, respond, fail=None):
+        done = {"v": False}
+        inner_respond, inner_fail = respond, fail
+
+        def respond(payload):
+            if not done["v"]:
+                done["v"] = True
+                inner_respond(payload)
+
+        def fail(reason):
+            if done["v"]:
+                return
+            done["v"] = True
+            if inner_fail is not None:
+                inner_fail(reason)
+            else:
+                inner_respond(
+                    {"items": [{"error": reason, "status": 503}] * len(req["ops"])}
+                )
+        index, s = req["index"], req["shard"]
+        state = self.state
+        copy = self.shards.get((index, s))
+        assigns = state.routing.get(index, {}).get(str(s), [])
+        my = next((a for a in assigns
+                   if a["node"] == self.node_id and a["primary"]), None)
+        if copy is None or my is None:
+            fail(f"[{index}][{s}] not primary on [{self.node_id}]")
+            return
+        meta = state.indices[index]
+        term = meta["primary_terms"].get(str(s), 1)
+        in_sync = meta.get("in_sync", {}).get(str(s), [])
+        # apply on primary, assigning seq-nos
+        ops_wire = []
+        items = []
+        for action, doc_id, source in req["ops"]:
+            op = copy.prepare_primary_op(action, doc_id, source)
+            r = copy.apply_op(op)
+            items.append({action: {**r, "status": 200}})
+            ops_wire.append(op)
+        self._searchers.pop((index, s), None)
+
+        # fan out to every other assigned copy (including INITIALIZING ones —
+        # they catch concurrent writes during recovery); acks required only
+        # from in-sync STARTED replicas
+        targets = [a for a in assigns if a["node"] != self.node_id]
+        required = {a["allocation_id"] for a in targets
+                    if a["state"] == "STARTED" and a["allocation_id"] in in_sync}
+        pending = {"required": set(required)}
+
+        def maybe_done():
+            if pending["required"]:
+                return
+            gcp = copy.compute_global_checkpoint(in_sync)
+            respond({"items": items, "global_checkpoint": gcp})
+
+        def on_ack(a):
+            def cb(resp):
+                copy.update_replica_checkpoint(
+                    a["allocation_id"], resp.get("local_checkpoint", -1)
+                )
+                pending["required"].discard(a["allocation_id"])
+                maybe_done()
+            return cb
+
+        def on_fail(a):
+            def cb(err):
+                # report the stale copy; the write may only complete once the
+                # master commits its removal from in-sync
+                # (ReplicationOperation.java:613) — an isolated primary cannot
+                # reach the master, so it cannot spuriously ack
+                def after(resp):
+                    if resp.get("acknowledged"):
+                        pending["required"].discard(a["allocation_id"])
+                        maybe_done()
+                    else:
+                        fail(
+                            f"replica [{a['allocation_id']}] failed and master "
+                            f"unavailable: {resp.get('why')}"
+                        )
+
+                self._submit_to_master({
+                    "kind": "shard_failed", "index": index, "shard": s,
+                    "allocation_id": a["allocation_id"],
+                }, after)
+            return cb
+
+        for a in targets:
+            self.service.send_request(
+                a["node"], A_BULK_REPLICA,
+                {"index": index, "shard": s, "term": term, "ops": ops_wire,
+                 "allocation_id": a["allocation_id"],
+                 "global_checkpoint": copy.global_checkpoint},
+                on_ack(a), on_fail(a),
+                timeout=self.REPLICATION_TIMEOUT,
+            )
+        maybe_done()
+
+    # -- replica side ------------------------------------------------------
+
+    def _on_bulk_replica(self, req, from_node):
+        index, s = req["index"], req["shard"]
+        copy = self.shards.get((index, s))
+        if copy is None or copy.allocation_id != req["allocation_id"]:
+            raise RuntimeError(f"[{index}][{s}] no such copy on [{self.node_id}]")
+        if req["term"] < copy.primary_term:
+            raise RuntimeError(
+                f"stale primary term [{req['term']}] < [{copy.primary_term}]"
+            )
+        copy.primary_term = req["term"]
+        for op in req["ops"]:
+            copy.apply_op(op)
+        copy.global_checkpoint = max(copy.global_checkpoint, req["global_checkpoint"])
+        self._searchers.pop((index, s), None)
+        return {"local_checkpoint": copy.tracker.checkpoint}
+
+    # ------------------------------------------------------------------
+    # recovery (peer, ops+snapshot based)
+    # ------------------------------------------------------------------
+
+    def _maybe_start_recovery(self, state: ClusterState, index: str, s: int, assign):
+        key = (index, s)
+        if key in self._recovering:
+            return
+        primary_node = state.primary_node(index, s)
+        if primary_node is None:
+            return
+        self._recovering.add(key)
+        alloc_id = assign["allocation_id"]
+
+        def on_snapshot(snap):
+            self._recovering.discard(key)
+            copy = self.shards.get(key)
+            if copy is None or copy.allocation_id != alloc_id:
+                return
+            copy.restore_from_snapshot(snap)
+            self._submit_to_master({
+                "kind": "shard_started", "index": index, "shard": s,
+                "allocation_id": alloc_id,
+            })
+
+        def on_err(err):
+            self._recovering.discard(key)
+            # retried on the next cluster state application / check tick
+            self.network.schedule(1.0, lambda: self._retry_recovery(index, s, alloc_id))
+
+        self.service.send_request(
+            primary_node, A_START_RECOVERY,
+            {"index": index, "shard": s},
+            on_snapshot, on_err, timeout=self.REPLICATION_TIMEOUT * 4,
+        )
+
+    def _retry_recovery(self, index, s, alloc_id):
+        state = self.state
+        for a in state.routing.get(index, {}).get(str(s), []):
+            if (a["node"] == self.node_id and a["allocation_id"] == alloc_id
+                    and a["state"] == "INITIALIZING"):
+                self._maybe_start_recovery(state, index, s, a)
+
+    def _on_start_recovery(self, req, from_node):
+        copy = self.shards.get((req["index"], req["shard"]))
+        if copy is None:
+            raise RuntimeError("no local copy to recover from")
+        return copy.snapshot_for_recovery()
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def client_get(self, index: str, doc_id: str, on_done):
+        state = self.state
+        meta = state.indices.get(index)
+        if meta is None:
+            on_done(None)
+            return
+        n_shards = int(meta["settings"].get("number_of_shards", 1))
+        s = shard_for_id(doc_id, n_shards)
+        primary_node = state.primary_node(index, s)
+        if primary_node is None:
+            on_done(None)
+            return
+        req = {"index": index, "shard": s, "id": doc_id}
+        if primary_node == self.node_id:
+            on_done(self._on_get(req, self.node_id))
+        else:
+            self.service.send_request(
+                primary_node, A_GET, req, on_done, lambda e: on_done(None),
+                timeout=self.REPLICATION_TIMEOUT,
+            )
+
+    def _on_get(self, req, from_node):
+        copy = self.shards.get((req["index"], req["shard"]))
+        if copy is None:
+            return None
+        return copy.get(req["id"])
+
+    # ------------------------------------------------------------------
+    # search scatter/gather
+    # ------------------------------------------------------------------
+
+    def client_search(self, index: str, body: dict, on_done, size: int = 10):
+        state = self.state
+        meta = state.indices.get(index)
+        if meta is None:
+            on_done({"error": f"index [{index}] missing"})
+            return
+        n_shards = int(meta["settings"].get("number_of_shards", 1))
+        shard_targets = {}
+        for s in range(n_shards):
+            assigns = [a for a in state.routing.get(index, {}).get(str(s), [])
+                       if a["state"] == "STARTED"]
+            if not assigns:
+                on_done({"error": f"shard [{s}] unavailable"})
+                return
+            primary = next((a for a in assigns if a["primary"]), assigns[0])
+            shard_targets[s] = primary["node"]
+
+        partials: dict[int, dict] = {}
+        pending = {"n": len(shard_targets)}
+
+        def finish(s, resp):
+            partials[s] = resp
+            pending["n"] -= 1
+            if pending["n"] > 0:
+                return
+            # coordinator merge: (score desc, shard asc, rank asc)
+            hits = []
+            total = 0
+            for sh in sorted(partials):
+                p = partials[sh]
+                total += p["total"]
+                for rank, h in enumerate(p["hits"]):
+                    hits.append((-h["_score"], sh, rank, h))
+            hits.sort(key=lambda t: t[:3])
+            merged = [h for _, _, _, h in hits[:size]]
+            on_done({
+                "hits": {
+                    "total": {"value": total, "relation": "eq"},
+                    "max_score": merged[0]["_score"] if merged else None,
+                    "hits": merged,
+                }
+            })
+
+        req_body = {"index": index, "body": body, "size": size}
+        for s, node in shard_targets.items():
+            req = {**req_body, "shard": s}
+            if node == self.node_id:
+                try:
+                    finish(s, self._on_shard_search(req, self.node_id))
+                except Exception as ex:
+                    finish(s, {"total": 0, "hits": [], "error": repr(ex)})
+            else:
+                self.service.send_request(
+                    node, A_SHARD_SEARCH, req,
+                    lambda resp, s=s: finish(s, resp),
+                    lambda err, s=s: finish(s, {"total": 0, "hits": [],
+                                                "error": str(err)}),
+                    timeout=self.REPLICATION_TIMEOUT * 2,
+                )
+
+    def _on_shard_search(self, req, from_node):
+        """Per-shard query execution on the real engine pack (the data-node
+        side of the reference's query phase, SearchService.executeQueryPhase)."""
+        index, s = req["index"], req["shard"]
+        copy = self.shards.get((index, s))
+        if copy is None:
+            raise RuntimeError(f"no copy of [{index}][{s}] here")
+        searcher, id_list = self._searcher_for(index, copy)
+        body = req.get("body") or {}
+        res = searcher.search(body.get("query"), size=req.get("size", 10))
+        hits = []
+        for sh, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
+            doc_id, src = id_list[int(d)]
+            hits.append({"_index": index, "_id": doc_id, "_score": float(score),
+                         "_source": src})
+        return {"total": res.total, "hits": hits}
+
+    def _searcher_for(self, index: str, copy: ShardCopy):
+        key = (index, copy.shard_id)
+        cached = self._searchers.get(key)
+        if cached is not None and cached[0] == copy.max_seq_no:
+            return cached[1], cached[2]
+        from ..index.mappings import Mappings
+        from ..parallel.sharded import StackedSearcher
+        from ..parallel.stacked import build_stacked_pack_routed
+
+        meta = self.state.indices[index]
+        mappings = Mappings(dict(meta.get("mappings") or {}))
+        live = [(i, d.source) for i, d in sorted(copy.docs.items()) if d.alive]
+        sp = build_stacked_pack_routed([live], mappings)
+        searcher = StackedSearcher(sp, mesh=None)
+        entry = (copy.max_seq_no, searcher, live)
+        self._searchers[key] = entry
+        return searcher, live
+
+
+def finish_group_cb(s, finish_group):
+    return lambda resp: finish_group(s, resp)

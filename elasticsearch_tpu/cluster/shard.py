@@ -1,0 +1,164 @@
+"""Per-shard replicated document state: seq-nos, checkpoints, op history.
+
+The reference's shard copy assigns a monotone sequence number to every
+operation on the primary, tracks the highest contiguous seq-no per copy
+(local checkpoint) and the minimum over in-sync copies (global checkpoint),
+and retains an operation history so replicas can resync ops-only (reference
+behavior: index/seqno/LocalCheckpointTracker.java, ReplicationTracker.java:68
+global checkpoint :147, per-copy CheckpointState :636; op-based recovery via
+retention leases RecoverySourceHandler.java:198-205).
+
+Same model here. Ops are idempotent by (seq_no per doc): an op only wins if
+its seq_no exceeds the doc's current one — exactly the reference's
+per-document seq-no CAS on replicas (InternalEngine plan resolution).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ShardDoc:
+    source: dict | None  # None => tombstone
+    seq_no: int
+    version: int
+
+    @property
+    def alive(self) -> bool:
+        return self.source is not None
+
+
+class LocalCheckpointTracker:
+    """Highest contiguous processed seq-no (LocalCheckpointTracker.java)."""
+
+    def __init__(self):
+        self.checkpoint = -1
+        self._processed: set[int] = set()
+
+    def mark(self, seq_no: int) -> None:
+        if seq_no <= self.checkpoint:
+            return
+        self._processed.add(seq_no)
+        while self.checkpoint + 1 in self._processed:
+            self._processed.discard(self.checkpoint + 1)
+            self.checkpoint += 1
+
+
+class ShardCopy:
+    """One copy (primary or replica) of one shard."""
+
+    def __init__(self, index: str, shard_id: int, allocation_id: str):
+        self.index = index
+        self.shard_id = shard_id
+        self.allocation_id = allocation_id
+        self.docs: dict[str, ShardDoc] = {}
+        self.ops: dict[int, dict] = {}  # seq_no -> op record (retained history)
+        self.tracker = LocalCheckpointTracker()
+        self.max_seq_no = -1
+        self.global_checkpoint = -1
+        self.primary_term = 0
+        # primary-only state
+        self.next_seq_no = 0
+        self.replica_checkpoints: dict[str, int] = {}  # allocation_id -> local ckpt
+
+    # -- op application (both roles) ---------------------------------------
+
+    def apply_op(self, op: dict) -> dict:
+        """op: {"op": "index"|"delete", "id", "source"?, "seq_no", "version"}.
+        Returns a result record; stale ops (seq_no <= doc's) are no-ops."""
+        seq = op["seq_no"]
+        self.ops[seq] = op
+        self.max_seq_no = max(self.max_seq_no, seq)
+        # keep the assignable seq-no ahead even when applying as a replica,
+        # so a later promotion continues the sequence instead of reusing it
+        self.next_seq_no = max(self.next_seq_no, seq + 1)
+        self.tracker.mark(seq)
+        cur = self.docs.get(op["id"])
+        if cur is not None and cur.seq_no >= seq:
+            return {"_id": op["id"], "result": "noop", "_seq_no": seq}
+        if op["op"] == "index":
+            self.docs[op["id"]] = ShardDoc(op["source"], seq, op["version"])
+            return {"_id": op["id"], "result": "created" if cur is None or not cur.alive else "updated",
+                    "_seq_no": seq, "_version": op["version"]}
+        else:
+            self.docs[op["id"]] = ShardDoc(None, seq, op["version"])
+            return {"_id": op["id"], "result": "deleted", "_seq_no": seq,
+                    "_version": op["version"]}
+
+    # -- primary-side ------------------------------------------------------
+
+    def prepare_primary_op(self, action: str, doc_id: str, source: dict | None) -> dict:
+        cur = self.docs.get(doc_id)
+        version = (cur.version + 1) if cur is not None else 1
+        op = {
+            "op": "index" if action in ("index", "create") else "delete",
+            "id": doc_id,
+            "seq_no": self.next_seq_no,
+            "version": version,
+        }
+        if op["op"] == "index":
+            op["source"] = source
+        self.next_seq_no += 1
+        return op
+
+    def update_replica_checkpoint(self, allocation_id: str, checkpoint: int) -> None:
+        prev = self.replica_checkpoints.get(allocation_id, -1)
+        self.replica_checkpoints[allocation_id] = max(prev, checkpoint)
+
+    def compute_global_checkpoint(self, in_sync_allocations: list[str]) -> int:
+        """min local checkpoint over in-sync copies (ReplicationTracker:147)."""
+        ckpts = [self.tracker.checkpoint]
+        for aid in in_sync_allocations:
+            if aid != self.allocation_id:
+                ckpts.append(self.replica_checkpoints.get(aid, -1))
+        self.global_checkpoint = max(self.global_checkpoint, min(ckpts))
+        return self.global_checkpoint
+
+    # -- recovery ----------------------------------------------------------
+
+    def snapshot_for_recovery(self) -> dict:
+        """Full-copy phase (the file-phase analog, RecoverySourceHandler:286):
+        doc table + seq state. Ops arriving concurrently also reach the
+        initializing copy through normal replication, and seq-no idempotency
+        makes the overlap safe."""
+        return {
+            "docs": {
+                i: {"source": d.source, "seq_no": d.seq_no, "version": d.version}
+                for i, d in self.docs.items()
+            },
+            "max_seq_no": self.max_seq_no,
+            "primary_term": self.primary_term,
+            "global_checkpoint": self.global_checkpoint,
+        }
+
+    def restore_from_snapshot(self, snap: dict) -> None:
+        for i, d in snap["docs"].items():
+            cur = self.docs.get(i)
+            if cur is None or cur.seq_no < d["seq_no"]:
+                self.docs[i] = ShardDoc(d["source"], d["seq_no"], d["version"])
+            self.tracker.mark(d["seq_no"])
+        # seq-nos below the snapshot's max may have gaps in our tracker even
+        # though their effects are present; fast-forward the checkpoint
+        if snap["max_seq_no"] > self.tracker.checkpoint:
+            self.tracker.checkpoint = snap["max_seq_no"]
+        self.max_seq_no = max(self.max_seq_no, snap["max_seq_no"])
+        self.next_seq_no = max(self.next_seq_no, self.max_seq_no + 1)
+        self.primary_term = max(self.primary_term, snap["primary_term"])
+        self.global_checkpoint = max(self.global_checkpoint, snap["global_checkpoint"])
+
+    def ops_since(self, seq_no: int) -> list[dict]:
+        return [self.ops[s] for s in sorted(self.ops) if s > seq_no]
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, doc_id: str) -> dict | None:
+        d = self.docs.get(doc_id)
+        if d is None or not d.alive:
+            return None
+        return {"_id": doc_id, "_source": d.source, "_seq_no": d.seq_no,
+                "_version": d.version}
+
+    @property
+    def live_count(self) -> int:
+        return sum(1 for d in self.docs.values() if d.alive)

@@ -54,6 +54,31 @@ Handler = Callable[[Any, str], Any]
 """Request handler: (request, from_node) -> response (or raises)."""
 
 
+class TransportChannel:
+    """Deferred response path for async handlers (the reference's
+    TransportChannel: a handler may complete the request later, e.g. the
+    primary replication action responds only after replica acks)."""
+
+    def __init__(self, network, node_id: str, to_node: str, rid: int):
+        self._network = network
+        self._node_id = node_id
+        self._to_node = to_node
+        self._rid = rid
+        self._done = False
+
+    def send_response(self, response: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._network.respond(self._node_id, self._to_node, self._rid, response, None)
+
+    def send_failure(self, reason: str) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._network.respond(self._node_id, self._to_node, self._rid, None, reason)
+
+
 class TransportService:
     """Per-node action registry + request dispatch over a Transport.
 
@@ -67,6 +92,7 @@ class TransportService:
         self.node_id = node_id
         self.network = network
         self._handlers: dict[str, Handler] = {}
+        self._async_handlers: dict[str, Callable] = {}
         self._pending: dict[int, ResponseHandler] = {}
         self._next_request_id = 0
         network.attach(node_id, self)
@@ -74,9 +100,16 @@ class TransportService:
     # -- registration ------------------------------------------------------
 
     def register_handler(self, action: str, handler: Handler) -> None:
-        if action in self._handlers:
+        if action in self._handlers or action in self._async_handlers:
             raise ValueError(f"handler already registered for [{action}]")
         self._handlers[action] = handler
+
+    def register_async_handler(self, action: str, handler) -> None:
+        """handler(request, from_node, channel) — responds via the channel,
+        possibly after further RPCs complete."""
+        if action in self._handlers or action in self._async_handlers:
+            raise ValueError(f"handler already registered for [{action}]")
+        self._async_handlers[action] = handler
 
     # -- outbound ----------------------------------------------------------
 
@@ -108,6 +141,14 @@ class TransportService:
     # -- inbound (called by the network impl) ------------------------------
 
     def handle_inbound(self, from_node: str, action: str, request: Any, rid: int):
+        async_handler = self._async_handlers.get(action)
+        if async_handler is not None:
+            channel = TransportChannel(self.network, self.node_id, from_node, rid)
+            try:
+                async_handler(request, from_node, channel)
+            except Exception as ex:
+                channel.send_failure(repr(ex))
+            return
         handler = self._handlers.get(action)
         if handler is None:
             self.network.respond(
