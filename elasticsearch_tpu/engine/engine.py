@@ -282,9 +282,42 @@ class EsIndex:
         if time.monotonic() - self._last_refresh >= secs:
             self.refresh()
 
+    def _apply_script_fields(self, hits: list, script_fields: dict | None):
+        """script_fields: {name: {"script": ...}} evaluated over the hits'
+        source values host-side (the fetch sub-phase analog,
+        search/fetch/subphase/ScriptFieldsPhase.java) with the same compiled
+        expression engine the device scoring path uses."""
+        if not script_fields or not hits:
+            return
+        from ..script import compile_script
+
+        for name, spec in script_fields.items():
+            spec = spec.get("script", spec) if isinstance(spec, dict) else spec
+            cs = compile_script(spec)
+            env = {}
+            for f in cs.fields:
+                vals = []
+                for h in hits:
+                    v = h.get("_source", {}).get(f, 0)
+                    if isinstance(v, str):
+                        from ..index.mappings import parse_date_to_millis
+
+                        try:
+                            v = parse_date_to_millis(v)
+                        except Exception:
+                            v = 0
+                    vals.append(float(v) if isinstance(v, (int, float, bool)) else 0.0)
+                env[f] = np.asarray(vals, np.float32)
+            scores = np.asarray(
+                [h.get("_score") or 0.0 for h in hits], np.float32
+            )
+            out = np.asarray(cs.evaluate(env, score=scores))
+            for h, v in zip(hits, out):
+                h.setdefault("fields", {})[name] = [float(v)]
+
     def search(
         self, query=None, size=10, from_=0, aggs=None, knn=None,
-        sort=None, search_after=None,
+        sort=None, search_after=None, script_fields=None,
     ):
         self._maybe_refresh()
         from ..query.sort import is_score_only, parse_sort
@@ -307,6 +340,7 @@ class EsIndex:
                     "_source": src,
                     "sort": values,
                 })
+            self._apply_script_fields(hits, script_fields)
             return {
                 "hits": {
                     "total": {"value": total, "relation": "eq"},
@@ -370,6 +404,7 @@ class EsIndex:
                     "_source": src,
                 }
             )
+        self._apply_script_fields(hits, script_fields)
         return {
             "hits": {
                 "total": {"value": res.total, "relation": "eq"},

@@ -513,6 +513,24 @@ class _KeywordRangeNode(RangeNode):
         return params, ("range", self.fld, "ord", col is None)
 
 
+def _parse_function_score(body, mappings):
+    from .script_nodes import parse_function_score
+
+    return parse_function_score(body, mappings, parse_query)
+
+
+def _parse_script_score(body, mappings):
+    from .script_nodes import parse_script_score
+
+    return parse_script_score(body, mappings, parse_query)
+
+
+def _parse_script_filter(body, mappings):
+    from .script_nodes import parse_script_filter
+
+    return parse_script_filter(body, mappings, parse_query)
+
+
 _PARSERS = {
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
@@ -532,4 +550,7 @@ _PARSERS = {
     "wildcard": _parse_wildcard,
     "regexp": _parse_regexp,
     "fuzzy": _parse_fuzzy,
+    "function_score": _parse_function_score,
+    "script_score": _parse_script_score,
+    "script": _parse_script_filter,
 }

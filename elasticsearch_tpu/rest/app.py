@@ -298,7 +298,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
         t0 = time.monotonic()
         res = await call(
-            idx.search, query, size, from_, aggs, knn, sort, search_after
+            idx.search, query, size, from_, aggs, knn, sort, search_after,
+            body.get("script_fields"),
         )
         took = int((time.monotonic() - t0) * 1000)
         src_filter = body.get("_source")

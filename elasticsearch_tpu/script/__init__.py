@@ -1,0 +1,3 @@
+from .expression import CompiledScript, ScriptError, compile_script
+
+__all__ = ["compile_script", "CompiledScript", "ScriptError"]

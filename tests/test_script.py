@@ -1,0 +1,180 @@
+"""Scripting: expression compiler + script_score / function_score / script
+filter queries (reference behavior: ScriptScoreQueryBuilder,
+FunctionScoreQueryBuilder, ScriptQueryBuilder; expression engine
+modules/lang-expression)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine.engine import Engine
+from elasticsearch_tpu.script import ScriptError, compile_script
+
+
+def test_compile_and_eval_arithmetic():
+    s = compile_script("2 * x + 1")
+    assert s.fields == frozenset({"x"})
+    out = np.asarray(s.evaluate({"x": np.array([0.0, 1.0, 2.0], np.float32)}))
+    assert out.tolist() == [1.0, 3.0, 5.0]
+
+
+def test_doc_value_syntax_and_params():
+    s = compile_script({
+        "source": "doc['price'].value * params.rate + doc.qty.value",
+        "params": {"rate": 2.0},
+    })
+    assert s.fields == {"price", "qty"}
+    out = np.asarray(s.evaluate({
+        "price": np.array([1.0, 3.0], np.float32),
+        "qty": np.array([10.0, 20.0], np.float32),
+    }))
+    assert out.tolist() == [12.0, 26.0]
+
+
+def test_math_functions_ternary_comparison():
+    s = compile_script("x > 2 ? Math.log(x) : sqrt(min(x, 1))")
+    x = np.array([1.0, 4.0], np.float32)
+    out = np.asarray(s.evaluate({"x": x}))
+    assert out[0] == pytest.approx(1.0)
+    assert out[1] == pytest.approx(math.log(4.0), rel=1e-5)
+
+
+def test_score_reference():
+    s = compile_script("_score * 2 + x")
+    out = np.asarray(s.evaluate(
+        {"x": np.array([1.0], np.float32)}, score=np.array([3.0], np.float32)
+    ))
+    assert out.tolist() == [7.0]
+
+
+def test_bad_scripts_raise():
+    with pytest.raises(ScriptError):
+        compile_script("x +")
+    with pytest.raises(ScriptError):
+        compile_script("params.missing + 1")
+    with pytest.raises(ScriptError):
+        compile_script({"source": "unknownfn(1, 2, 3)"}).evaluate({})
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    idx = e.create_index("p", mappings={"properties": {
+        "name": {"type": "keyword"},
+        "price": {"type": "float"},
+        "likes": {"type": "long"},
+        "body": {"type": "text"},
+    }})
+    docs = [
+        ("a", {"name": "a", "price": 10.0, "likes": 0, "body": "red fox"}),
+        ("b", {"name": "b", "price": 20.0, "likes": 3, "body": "red wine"}),
+        ("c", {"name": "c", "price": 30.0, "likes": 10, "body": "blue sky"}),
+        ("d", {"name": "d", "price": 5.0, "likes": 1, "body": "red sky"}),
+    ]
+    for i, src in docs:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return idx
+
+
+def test_script_score_query(eng):
+    res = eng.search(query={"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "doc['price'].value"},
+    }})
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    scores = [h["_score"] for h in res["hits"]["hits"]]
+    assert ids == ["c", "b", "a", "d"]
+    assert scores == [30.0, 20.0, 10.0, 5.0]
+
+
+def test_script_score_uses_inner_score(eng):
+    base = eng.search(query={"match": {"body": "red"}})
+    doubled = eng.search(query={"script_score": {
+        "query": {"match": {"body": "red"}},
+        "script": "_score * 2",
+    }})
+    base_scores = {h["_id"]: h["_score"] for h in base["hits"]["hits"]}
+    for h in doubled["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(2 * base_scores[h["_id"]], rel=1e-5)
+    assert doubled["hits"]["total"]["value"] == base["hits"]["total"]["value"]
+
+
+def test_script_filter_query(eng):
+    res = eng.search(query={"bool": {
+        "filter": [{"script": {"script": "doc['likes'].value >= 2"}}],
+    }})
+    assert {h["_id"] for h in res["hits"]["hits"]} == {"b", "c"}
+
+
+def test_function_score_field_value_factor(eng):
+    res = eng.search(query={"function_score": {
+        "query": {"match_all": {}},
+        "functions": [
+            {"field_value_factor": {"field": "likes", "factor": 2.0,
+                                    "modifier": "ln1p", "missing": 0}},
+        ],
+        "boost_mode": "replace",
+    }})
+    got = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+    for doc_id, likes in (("a", 0), ("b", 3), ("c", 10), ("d", 1)):
+        assert got[doc_id] == pytest.approx(math.log1p(2.0 * likes), rel=1e-5)
+
+
+def test_function_score_weight_filter_sum(eng):
+    res = eng.search(query={"function_score": {
+        "query": {"match_all": {}},
+        "functions": [
+            {"filter": {"term": {"name": "a"}}, "weight": 5.0},
+            {"filter": {"range": {"price": {"gte": 15}}}, "weight": 7.0},
+        ],
+        "score_mode": "sum",
+        "boost_mode": "replace",
+    }})
+    got = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+    assert got["a"] == 5.0
+    assert got["b"] == 7.0 and got["c"] == 7.0
+    assert got["d"] == 1.0  # no function applied -> factor 1
+
+
+def test_function_score_decay_gauss(eng):
+    res = eng.search(query={"function_score": {
+        "query": {"match_all": {}},
+        "functions": [{"gauss": {"price": {"origin": 10, "scale": 10, "decay": 0.5}}}],
+        "boost_mode": "replace",
+    }})
+    got = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+    assert got["a"] == pytest.approx(1.0, abs=1e-5)  # at origin
+    assert got["b"] == pytest.approx(0.5, abs=1e-4)  # one scale away
+    assert got["c"] < got["b"] < got["a"]
+
+
+def test_function_score_max_boost_and_min_score(eng):
+    res = eng.search(query={"function_score": {
+        "query": {"match_all": {}},
+        "functions": [{"field_value_factor": {"field": "price"}}],
+        "boost_mode": "replace",
+        "max_boost": 15.0,
+        "min_score": 9.0,
+    }})
+    got = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+    # capped at 15, docs under min_score 9 dropped (price 5 -> out)
+    assert got == {"a": 10.0, "b": 15.0, "c": 15.0}
+
+
+def test_random_score_deterministic(eng):
+    body = {"function_score": {
+        "query": {"match_all": {}},
+        "functions": [{"random_score": {"seed": 42}}],
+        "boost_mode": "replace",
+    }}
+    r1 = eng.search(query=body)
+    r2 = eng.search(query=body)
+    s1 = [h["_score"] for h in r1["hits"]["hits"]]
+    s2 = [h["_score"] for h in r2["hits"]["hits"]]
+    assert s1 == s2
+    assert all(0.0 <= s < 1.0 for s in s1)
+    assert len(set(s1)) == len(s1)  # distinct per doc
