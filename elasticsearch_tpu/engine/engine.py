@@ -429,8 +429,11 @@ class Engine:
     reference: indices/IndicesService registry of IndexShard instances)."""
 
     def __init__(self, data_path: str | None = None):
+        from ..ingest import IngestService
+
         self.data_path = data_path
         self.indices: dict[str, EsIndex] = {}
+        self.ingest = IngestService()
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):
@@ -475,7 +478,29 @@ class Engine:
 
             shutil.rmtree(d)
 
-    def bulk(self, operations: list[tuple[str, str, str | None, dict | None]]):
+    def run_pipelines(self, index_name: str, source: dict,
+                      pipeline: str | None = None, doc_id: str | None = None):
+        """Apply request/default pipeline then final_pipeline (reference
+        behavior: IngestService.executeBulkRequest + the
+        index.default_pipeline / index.final_pipeline settings). Returns the
+        transformed source, or None if a drop processor fired."""
+        idx = self.indices.get(index_name)
+        settings = idx.settings if idx is not None else {}
+        first = pipeline if pipeline not in (None, "_none") else None
+        if first is None and pipeline != "_none":
+            dp = settings.get("default_pipeline") or settings.get("index.default_pipeline")
+            if dp and dp != "_none":
+                first = dp
+        for name in (first, settings.get("final_pipeline") or settings.get("index.final_pipeline")):
+            if not name or name == "_none":
+                continue
+            source = self.ingest.execute(name, source, index=index_name, doc_id=doc_id)
+            if source is None:
+                return None
+        return source
+
+    def bulk(self, operations: list[tuple[str, str, str | None, dict | None]],
+             pipeline: str | None = None):
         """operations: (action, index, id, source). Returns per-item results;
         failures are per-item, not transactional (reference behavior:
         TransportShardBulkAction.java:308 executeBulkItemRequest)."""
@@ -485,6 +510,13 @@ class Engine:
             try:
                 idx = self.get_or_autocreate(index_name)
                 if action in ("index", "create"):
+                    source = self.run_pipelines(index_name, source, pipeline, doc_id)
+                    if source is None:  # dropped by pipeline
+                        items.append({action: {
+                            "_index": index_name, "_id": doc_id,
+                            "result": "noop", "status": 200,
+                        }})
+                        continue
                     r = idx.index_doc(doc_id, source, op_type=action)
                     status = 201 if r["result"] == "created" else 200
                     items.append({action: {"_index": index_name, **r, "status": status}})

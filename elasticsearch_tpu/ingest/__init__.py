@@ -1,0 +1,182 @@
+"""IngestService: pipeline registry + execution on the bulk path.
+
+Reference behavior: ingest/IngestService.java:98 (pipeline CRUD via cluster
+state, execution hook in the bulk path :701), Pipeline/CompoundProcessor
+(on_failure semantics), _ingest/pipeline REST APIs + _simulate."""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.errors import IllegalArgumentError
+from .processors import (
+    PROCESSOR_TYPES,
+    DropDocument,
+    ForeachProcessor,
+    IngestProcessorError,
+    PipelineProcessor,
+    Processor,
+)
+
+
+class Pipeline:
+    def __init__(self, name: str, config: dict, service: "IngestService"):
+        self.name = name
+        self.config = config
+        self.description = config.get("description")
+        self.version = config.get("version")
+        self.service = service
+        self.processors = [self._build(p) for p in config.get("processors") or []]
+        self.on_failure = [self._build(p) for p in config.get("on_failure") or []]
+
+    def _build(self, spec: dict) -> Processor:
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise IllegalArgumentError(
+                f"processor must be an object with exactly one key, got {spec!r}"
+            )
+        (ptype, config), = spec.items()
+        config = dict(config or {})
+        on_failure = config.pop("on_failure", None)
+        if ptype == "pipeline":
+            proc = PipelineProcessor(config, ingest_service=self.service)
+        elif ptype == "foreach":
+            proc = ForeachProcessor(config, build_processor=self._build)
+        elif ptype in PROCESSOR_TYPES:
+            proc = PROCESSOR_TYPES[ptype](config)
+        else:
+            raise IllegalArgumentError(f"No processor type exists with name [{ptype}]")
+        if on_failure:
+            proc.on_failure = [self._build(p) for p in on_failure]
+        else:
+            proc.on_failure = None
+        return proc
+
+    def run(self, ctx: dict) -> dict | None:
+        """Returns the transformed ctx, or None if the document was dropped."""
+        try:
+            for proc in self.processors:
+                try:
+                    if not proc.should_run(ctx):
+                        continue
+                    proc.process(ctx)
+                except DropDocument:
+                    raise
+                except Exception as ex:
+                    if proc.ignore_failure:
+                        continue
+                    if proc.on_failure:
+                        self._run_failure_chain(proc.on_failure, ctx, ex)
+                        continue
+                    raise
+        except DropDocument:
+            return None
+        except Exception as ex:
+            if self.on_failure:
+                try:
+                    self._run_failure_chain(self.on_failure, ctx, ex)
+                    return ctx
+                except DropDocument:
+                    return None
+            raise
+        return ctx
+
+    @staticmethod
+    def _run_failure_chain(processors, ctx, ex):
+        meta = ctx.setdefault("_ingest", {})
+        meta["on_failure_message"] = str(ex)
+        meta["on_failure_processor_type"] = getattr(ex, "processor_type", None)
+        for proc in processors:
+            if proc.should_run(ctx):
+                proc.process(ctx)
+
+
+class IngestService:
+    def __init__(self):
+        self.pipelines: dict[str, dict] = {}
+        self._compiled: dict[str, Pipeline] = {}
+
+    # -- CRUD --------------------------------------------------------------
+
+    def put_pipeline(self, name: str, config: dict):
+        # compile eagerly: invalid configs are rejected at PUT time, as the
+        # reference validates on put (IngestService.validatePipeline)
+        pipe = Pipeline(name, config, self)
+        self.pipelines[name] = config
+        self._compiled[name] = pipe
+        return {"acknowledged": True}
+
+    def get_pipeline(self, name: str) -> Pipeline | None:
+        return self._compiled.get(name)
+
+    def get_pipeline_config(self, name: str) -> dict | None:
+        return self.pipelines.get(name)
+
+    def delete_pipeline(self, name: str) -> bool:
+        self._compiled.pop(name, None)
+        return self.pipelines.pop(name, None) is not None
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, pipeline_name: str, source: dict, index: str | None = None,
+                doc_id: str | None = None) -> dict | None:
+        """Run a document through a pipeline. Returns the new source, or None
+        if dropped. Raises on missing pipeline or unhandled processor error."""
+        pipe = self._compiled.get(pipeline_name)
+        if pipe is None:
+            raise IllegalArgumentError(f"pipeline with id [{pipeline_name}] does not exist")
+        ctx = dict(source)
+        ctx["_ingest"] = {"timestamp": _iso_now(), "pipeline": pipeline_name}
+        if index is not None:
+            ctx["_index"] = index
+        if doc_id is not None:
+            ctx["_id"] = doc_id
+        out = pipe.run(ctx)
+        if out is None:
+            return None
+        out.pop("_ingest", None)
+        out.pop("_index", None)
+        out.pop("_id", None)
+        return out
+
+    def simulate(self, config_or_name, docs: list[dict], verbose: bool = False) -> dict:
+        """_ingest/pipeline/_simulate."""
+        if isinstance(config_or_name, str):
+            pipe = self._compiled.get(config_or_name)
+            if pipe is None:
+                raise IllegalArgumentError(
+                    f"pipeline with id [{config_or_name}] does not exist"
+                )
+        else:
+            pipe = Pipeline("_simulate_pipeline", config_or_name, self)
+        results = []
+        for d in docs:
+            src = dict(d.get("_source") or {})
+            ctx = dict(src)
+            ctx["_ingest"] = {"timestamp": _iso_now()}
+            for k in ("_index", "_id"):
+                if k in d:
+                    ctx[k] = d[k]
+            try:
+                out = pipe.run(ctx)
+                if out is None:
+                    results.append({"doc": None})
+                else:
+                    meta = out.pop("_ingest", None)
+                    results.append({"doc": {
+                        "_index": out.pop("_index", d.get("_index", "_index")),
+                        "_id": out.pop("_id", d.get("_id", "_id")),
+                        "_source": out,
+                        "_ingest": meta,
+                    }})
+            except Exception as ex:
+                results.append({"error": {
+                    "type": getattr(ex, "type", "exception"),
+                    "reason": str(ex),
+                }})
+        return {"docs": results}
+
+
+def _iso_now() -> str:
+    from datetime import datetime, timezone
+
+    return datetime.now(timezone.utc).isoformat()

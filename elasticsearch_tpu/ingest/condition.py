@@ -1,0 +1,251 @@
+"""Host-side condition/script evaluation over an ingest document context.
+
+The reference evaluates processor `if` conditions and `script` processors as
+Painless against a ctx map (reference behavior: ingest/ConditionalProcessor.java,
+modules/ingest-common ScriptProcessor). This module reuses the expression
+parser (script/expression.py) with a host resolver that adds strings, null,
+ctx.path access, and string methods — the imperative host-side subset, kept
+separate from the device compiler on purpose: device scripts must be pure
+array math; ingest runs on the host mutation path where strings are fine.
+"""
+
+from __future__ import annotations
+
+from ..script.expression import ScriptError, _Parser, _tokenize
+
+
+def _lookup(ctx: dict, path: list[str]):
+    cur = ctx
+    for p in path:
+        if isinstance(cur, dict) and p in cur:
+            cur = cur[p]
+        else:
+            return None
+    return cur
+
+
+def _resolve_path(ast) -> list[str] | None:
+    """('name','ctx') / attr/index chains -> field path list, else None."""
+    parts: list[str] = []
+    while True:
+        if ast[0] == "attr":
+            parts.append(ast[2])
+            ast = ast[1]
+        elif ast[0] == "index":
+            parts.append(ast[2])
+            ast = ast[1]
+        elif ast == ("name", "ctx"):
+            return list(reversed(parts))
+        else:
+            return None
+
+
+class HostExpr:
+    """Evaluate a parsed expression against a ctx dict (returns python
+    scalars/strings/lists)."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.ast = _Parser(_tokenize(source)).parse()
+
+    def eval(self, ctx: dict):
+        return self._eval(self.ast, ctx)
+
+    def _eval(self, ast, ctx):
+        kind = ast[0]
+        if kind == "num":
+            v = ast[1]
+            return int(v) if float(v).is_integer() else v
+        if kind == "strlit":
+            return ast[1]
+        if kind == "name":
+            n = ast[1]
+            if n == "ctx":
+                return ctx
+            if n == "null":
+                return None
+            if n in ("true", "false"):
+                return n == "true"
+            raise ScriptError(f"unknown identifier [{n}] (use ctx.field)")
+        path = _resolve_path(ast)
+        if path is not None:
+            return _lookup(ctx, path)
+        if kind in ("attr", "index"):
+            base = self._eval(ast[1], ctx)
+            key = ast[2]
+            if isinstance(base, dict):
+                return base.get(key)
+            if key == "length" and isinstance(base, (str, list)):
+                return len(base)
+            return None
+        if kind == "call":
+            return self._call(ast, ctx)
+        if kind == "un":
+            v = self._eval(ast[2], ctx)
+            if ast[1] == "-":
+                return -(v or 0)
+            return not self._truthy(v)
+        if kind == "bin":
+            a = self._eval(ast[2], ctx)
+            b = self._eval(ast[3], ctx)
+            op = ast[1]
+            if op == "+":
+                if isinstance(a, str) or isinstance(b, str):
+                    return f"{'' if a is None else a}{'' if b is None else b}"
+                return (a or 0) + (b or 0)
+            a = a or 0
+            b = b or 0
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+            if op == "/":
+                return a / b
+            if op == "%":
+                return a % b
+            return a**b
+        if kind == "cmp":
+            a = self._eval(ast[2], ctx)
+            b = self._eval(ast[3], ctx)
+            op = ast[1]
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if a is None or b is None:
+                return False
+            return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+        if kind == "bool":
+            a = self._truthy(self._eval(ast[2], ctx))
+            if ast[1] == "or":
+                return a or self._truthy(self._eval(ast[3], ctx))
+            return a and self._truthy(self._eval(ast[3], ctx))
+        if kind == "tern":
+            return (
+                self._eval(ast[2], ctx)
+                if self._truthy(self._eval(ast[1], ctx))
+                else self._eval(ast[3], ctx)
+            )
+        raise ScriptError(f"unsupported in ingest context: {kind}")
+
+    def _call(self, ast, ctx):
+        fn, args = ast[1], ast[2]
+        vals = [self._eval(a, ctx) for a in args]
+        if fn[0] == "attr":
+            recv = self._eval(fn[1], ctx)
+            method = fn[2]
+            if method == "contains":
+                return vals[0] in recv if recv is not None else False
+            if method == "containsKey":
+                return isinstance(recv, dict) and vals[0] in recv
+            if method == "startsWith":
+                return isinstance(recv, str) and recv.startswith(vals[0])
+            if method == "endsWith":
+                return isinstance(recv, str) and recv.endswith(vals[0])
+            if method == "toLowerCase":
+                return recv.lower() if isinstance(recv, str) else recv
+            if method == "toUpperCase":
+                return recv.upper() if isinstance(recv, str) else recv
+            if method == "trim":
+                return recv.strip() if isinstance(recv, str) else recv
+            if method == "isEmpty":
+                return recv is None or len(recv) == 0
+            if method == "size" or method == "length":
+                return len(recv) if recv is not None else 0
+            raise ScriptError(f"unknown method [{method}]")
+        if fn == ("name", "abs"):
+            return abs(vals[0] or 0)
+        if fn == ("name", "min"):
+            return min(vals)
+        if fn == ("name", "max"):
+            return max(vals)
+        raise ScriptError(f"unknown function {fn}")
+
+    @staticmethod
+    def _truthy(v) -> bool:
+        return bool(v)
+
+
+class Condition:
+    """A processor `if` condition."""
+
+    def __init__(self, source: str):
+        self.expr = HostExpr(source)
+
+    def matches(self, ctx: dict) -> bool:
+        return HostExpr._truthy(self.expr.eval(ctx))
+
+
+class HostScript:
+    """`script` processor body: semicolon-separated `ctx.path = expr`
+    assignments (plus bare expressions, ignored results)."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.statements: list[tuple[list[str] | None, HostExpr]] = []
+        for stmt in self._split(source):
+            stmt = stmt.strip()
+            if not stmt:
+                continue
+            target, expr = self._parse_assignment(stmt)
+            self.statements.append((target, HostExpr(expr)))
+
+    @staticmethod
+    def _split(src: str) -> list[str]:
+        out, cur, in_str, q = [], [], False, ""
+        for ch in src:
+            if in_str:
+                cur.append(ch)
+                if ch == q:
+                    in_str = False
+            elif ch in "'\"":
+                in_str, q = True, ch
+                cur.append(ch)
+            elif ch == ";":
+                out.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    @staticmethod
+    def _parse_assignment(stmt: str):
+        depth = 0
+        in_str, q = False, ""
+        for i, ch in enumerate(stmt):
+            if in_str:
+                if ch == q:
+                    in_str = False
+            elif ch in "'\"":
+                in_str, q = True, ch
+            elif ch in "([":
+                depth += 1
+            elif ch in ")]":
+                depth -= 1
+            elif ch == "=" and depth == 0:
+                prev = stmt[i - 1] if i else ""
+                nxt = stmt[i + 1] if i + 1 < len(stmt) else ""
+                if prev not in "=!<>" and nxt != "=":
+                    lhs = stmt[:i].strip()
+                    ast = _Parser(_tokenize(lhs)).parse()
+                    path = _resolve_path(ast)
+                    if path is None:
+                        raise ScriptError(f"assignment target must be ctx.path: [{lhs}]")
+                    return path, stmt[i + 1 :].strip()
+        return None, stmt
+
+    def run(self, ctx: dict):
+        for target, expr in self.statements:
+            val = expr.eval(ctx)
+            if target is None:
+                continue
+            cur = ctx
+            for p in target[:-1]:
+                nxt = cur.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    cur[p] = nxt
+                cur = nxt
+            cur[target[-1]] = val

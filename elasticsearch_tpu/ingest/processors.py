@@ -1,0 +1,653 @@
+"""Ingest processors (reference behavior: ingest/Processor SPI +
+modules/ingest-common/src/main/java/org/elasticsearch/ingest/common/*).
+
+Each processor transforms a ctx dict (the document source plus _index/_id
+metadata under reserved keys). Dotted field paths address nested objects, as
+in the reference's IngestDocument."""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any
+
+from ..utils.errors import IllegalArgumentError
+from .condition import Condition, HostScript
+
+
+class IngestProcessorError(Exception):
+    def __init__(self, message: str, processor_type: str):
+        super().__init__(message)
+        self.processor_type = processor_type
+
+
+class DropDocument(Exception):
+    """Raised by the drop processor: the document is discarded, not indexed."""
+
+
+# -- field path helpers ----------------------------------------------------
+
+
+def _split_path(path: str) -> list[str]:
+    if not path:
+        raise IllegalArgumentError("field path cannot be empty")
+    return path.split(".")
+
+
+def get_field(ctx: dict, path: str, default=None):
+    cur: Any = ctx
+    for p in _split_path(path):
+        if isinstance(cur, dict) and p in cur:
+            cur = cur[p]
+        else:
+            return default
+    return cur
+
+
+def has_field(ctx: dict, path: str) -> bool:
+    sentinel = object()
+    return get_field(ctx, path, sentinel) is not sentinel
+
+
+def set_field(ctx: dict, path: str, value):
+    parts = _split_path(path)
+    cur = ctx
+    for p in parts[:-1]:
+        nxt = cur.get(p)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[p] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def remove_field(ctx: dict, path: str) -> bool:
+    parts = _split_path(path)
+    cur = ctx
+    for p in parts[:-1]:
+        cur = cur.get(p)
+        if not isinstance(cur, dict):
+            return False
+    return cur.pop(parts[-1], None) is not None
+
+
+def render_template(tmpl: str, ctx: dict) -> str:
+    """Mustache-style {{field}} substitution (the reference renders values
+    through lang-mustache)."""
+
+    def sub(m):
+        v = get_field(ctx, m.group(1).strip())
+        return "" if v is None else str(v)
+
+    return re.sub(r"\{\{\{?([^}]+?)\}?\}\}", sub, tmpl)
+
+
+# -- the processors --------------------------------------------------------
+
+
+class Processor:
+    type: str = "?"
+
+    def __init__(self, config: dict):
+        self.config = config
+        self.if_cond = Condition(config["if"]) if config.get("if") else None
+        self.ignore_failure = bool(config.get("ignore_failure", False))
+        self.on_failure = config.get("on_failure")  # built by the pipeline
+        self.tag = config.get("tag")
+        self.description = config.get("description")
+
+    def should_run(self, ctx: dict) -> bool:
+        return self.if_cond is None or self.if_cond.matches(ctx)
+
+    def process(self, ctx: dict) -> None:
+        raise NotImplementedError
+
+    def _fail(self, msg: str):
+        raise IngestProcessorError(msg, self.type)
+
+    def _field(self, key="field") -> str:
+        v = self.config.get(key)
+        if not v:
+            self._fail(f"[{key}] required property is missing")
+        return v
+
+
+class SetProcessor(Processor):
+    type = "set"
+
+    def process(self, ctx):
+        field = self._field()
+        if self.config.get("override", True) is False and get_field(ctx, field) is not None:
+            return
+        if "copy_from" in self.config:
+            val = get_field(ctx, self.config["copy_from"])
+        else:
+            val = self.config.get("value")
+            if isinstance(val, str) and "{{" in val:
+                val = render_template(val, ctx)
+        set_field(ctx, field, val)
+
+
+class RemoveProcessor(Processor):
+    type = "remove"
+
+    def process(self, ctx):
+        fields = self.config.get("field")
+        fields = fields if isinstance(fields, list) else [fields]
+        for f in fields:
+            found = remove_field(ctx, f)
+            if not found and not self.config.get("ignore_missing", False):
+                self._fail(f"field [{f}] not present as part of path [{f}]")
+
+
+class RenameProcessor(Processor):
+    type = "rename"
+
+    def process(self, ctx):
+        src, dst = self._field(), self._field("target_field")
+        if not has_field(ctx, src):
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{src}] doesn't exist")
+        if has_field(ctx, dst) and not self.config.get("override", False):
+            self._fail(f"field [{dst}] already exists")
+        val = get_field(ctx, src)
+        remove_field(ctx, src)
+        set_field(ctx, dst, val)
+
+
+class ConvertProcessor(Processor):
+    type = "convert"
+
+    def process(self, ctx):
+        field = self._field()
+        target = self.config.get("target_field", field)
+        typ = self.config.get("type")
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] not present")
+
+        def conv1(v):
+            try:
+                if typ in ("integer", "long"):
+                    return int(str(v), 0) if isinstance(v, str) else int(v)
+                if typ in ("float", "double"):
+                    return float(v)
+                if typ == "string":
+                    return str(v).lower() if isinstance(v, bool) else str(v)
+                if typ == "boolean":
+                    s = str(v).lower()
+                    if s in ("true", "false"):
+                        return s == "true"
+                    raise ValueError(s)
+                if typ == "auto":
+                    s = str(v)
+                    for f in (lambda: int(s), lambda: float(s)):
+                        try:
+                            return f()
+                        except ValueError:
+                            pass
+                    if s.lower() in ("true", "false"):
+                        return s.lower() == "true"
+                    return v
+                if typ == "ip":
+                    import ipaddress
+
+                    ipaddress.ip_address(str(v))
+                    return str(v)
+            except (ValueError, TypeError):
+                self._fail(f"unable to convert [{v}] to {typ}")
+            self._fail(f"type [{typ}] not supported")
+
+        set_field(ctx, target, [conv1(v) for v in val] if isinstance(val, list) else conv1(val))
+
+
+class _StringProcessor(Processor):
+    def transform(self, s: str) -> str:
+        raise NotImplementedError
+
+    def process(self, ctx):
+        field = self._field()
+        target = self.config.get("target_field", field)
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        if isinstance(val, list):
+            set_field(ctx, target, [self.transform(str(v)) for v in val])
+        else:
+            set_field(ctx, target, self.transform(str(val)))
+
+
+class LowercaseProcessor(_StringProcessor):
+    type = "lowercase"
+
+    def transform(self, s):
+        return s.lower()
+
+
+class UppercaseProcessor(_StringProcessor):
+    type = "uppercase"
+
+    def transform(self, s):
+        return s.upper()
+
+
+class TrimProcessor(_StringProcessor):
+    type = "trim"
+
+    def transform(self, s):
+        return s.strip()
+
+
+class HtmlStripProcessor(_StringProcessor):
+    type = "html_strip"
+
+    def transform(self, s):
+        return re.sub(r"<[^>]*>", "", s)
+
+
+class UrldecodeProcessor(_StringProcessor):
+    type = "urldecode"
+
+    def transform(self, s):
+        from urllib.parse import unquote_plus
+
+        return unquote_plus(s)
+
+
+class SplitProcessor(Processor):
+    type = "split"
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        sep = self.config.get("separator")
+        if sep is None:
+            self._fail("[separator] required property is missing")
+        parts = re.split(sep, str(val))
+        if not self.config.get("preserve_trailing", False):
+            while parts and parts[-1] == "":
+                parts.pop()
+        set_field(ctx, self.config.get("target_field", field), parts)
+
+
+class JoinProcessor(Processor):
+    type = "join"
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        if not isinstance(val, list):
+            self._fail(f"field [{field}] of type [{type(val).__name__}] cannot be cast to a list")
+        sep = self.config.get("separator", "")
+        set_field(ctx, self.config.get("target_field", field),
+                  sep.join(str(v) for v in val))
+
+
+class AppendProcessor(Processor):
+    type = "append"
+
+    def process(self, ctx):
+        field = self._field()
+        value = self.config.get("value")
+        values = value if isinstance(value, list) else [value]
+        values = [render_template(v, ctx) if isinstance(v, str) and "{{" in v else v
+                  for v in values]
+        cur = get_field(ctx, field)
+        if cur is None:
+            cur = []
+        elif not isinstance(cur, list):
+            cur = [cur]
+        if self.config.get("allow_duplicates", True):
+            cur = cur + values
+        else:
+            cur = cur + [v for v in values if v not in cur]
+        set_field(ctx, field, cur)
+
+
+class GsubProcessor(Processor):
+    type = "gsub"
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        out = re.sub(self.config.get("pattern", ""),
+                     self.config.get("replacement", ""), str(val))
+        set_field(ctx, self.config.get("target_field", field), out)
+
+
+class DateProcessor(Processor):
+    type = "date"
+
+    def process(self, ctx):
+        from ..index.mappings import parse_date_to_millis
+        from datetime import datetime, timezone
+
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            self._fail(f"field [{field}] is null or missing")
+        formats = self.config.get("formats", ["ISO8601"])
+        ms = None
+        last = None
+        for fmt in formats:
+            try:
+                if fmt in ("ISO8601", "strict_date_optional_time", "date_optional_time"):
+                    ms = parse_date_to_millis(val)
+                elif fmt == "UNIX":
+                    ms = int(float(val) * 1000)
+                elif fmt == "UNIX_MS":
+                    ms = int(val)
+                else:
+                    # java date format subset -> python strptime
+                    py = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
+                          .replace("dd", "%d").replace("HH", "%H")
+                          .replace("mm", "%M").replace("ss", "%S"))
+                    dt = datetime.strptime(str(val), py).replace(tzinfo=timezone.utc)
+                    ms = int(dt.timestamp() * 1000)
+                break
+            except Exception as ex:
+                last = ex
+        if ms is None:
+            self._fail(f"unable to parse date [{val}]: {last}")
+        dt = datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+        out = dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+        set_field(ctx, self.config.get("target_field", "@timestamp"), out)
+
+
+class FailProcessor(Processor):
+    type = "fail"
+
+    def process(self, ctx):
+        self._fail(render_template(self.config.get("message", "fail"), ctx))
+
+
+class DropProcessor(Processor):
+    type = "drop"
+
+    def process(self, ctx):
+        raise DropDocument()
+
+
+class JsonProcessor(Processor):
+    type = "json"
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        try:
+            parsed = json.loads(val)
+        except (TypeError, ValueError) as ex:
+            self._fail(f"unable to parse JSON in field [{field}]: {ex}")
+        if self.config.get("add_to_root", False):
+            if not isinstance(parsed, dict):
+                self._fail("cannot add non-object JSON to root")
+            ctx.update(parsed)
+        else:
+            set_field(ctx, self.config.get("target_field", field), parsed)
+
+
+class KvProcessor(Processor):
+    type = "kv"
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        fs = self.config.get("field_split", " ")
+        vs = self.config.get("value_split", "=")
+        target = self.config.get("target_field")
+        include = self.config.get("include_keys")
+        exclude = set(self.config.get("exclude_keys") or [])
+        out = {}
+        for pair in re.split(fs, str(val)):
+            if not pair:
+                continue
+            kv = re.split(vs, pair, maxsplit=1)
+            if len(kv) != 2:
+                continue
+            k, v = kv
+            if include is not None and k not in include:
+                continue
+            if k in exclude:
+                continue
+            out[k] = v
+        for k, v in out.items():
+            set_field(ctx, f"{target}.{k}" if target else k, v)
+
+
+class CsvProcessor(Processor):
+    type = "csv"
+
+    def process(self, ctx):
+        import csv as _csv
+        import io
+
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        targets = self.config.get("target_fields") or []
+        sep = self.config.get("separator", ",")
+        quote = self.config.get("quote", '"')
+        row = next(_csv.reader(io.StringIO(str(val)), delimiter=sep, quotechar=quote))
+        for name, v in zip(targets, row):
+            set_field(ctx, name, v)
+
+
+class DissectProcessor(Processor):
+    """%{key} pattern splitter (libs/dissect DissectParser)."""
+
+    type = "dissect"
+
+    def process(self, ctx):
+        field = self._field()
+        pattern = self.config.get("pattern")
+        if pattern is None:
+            self._fail("[pattern] required property is missing")
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        sep = self.config.get("append_separator", "")
+        keys = re.findall(r"%\{([^}]*)\}", pattern)
+        rx_parts = re.split(r"%\{[^}]*\}", pattern)
+        rx = "".join(
+            re.escape(p) + ("(.*?)" if i < len(keys) else "")
+            for i, p in enumerate(rx_parts)
+        ) + "$"
+        m = re.match(rx, str(val), re.DOTALL)
+        if m is None:
+            self._fail(f"Unable to find match for dissect pattern: {pattern} "
+                       f"against source: {val}")
+        appends: dict[str, list] = {}
+        for key, g in zip(keys, m.groups()):
+            if not key or key.startswith("?"):
+                continue
+            if key.startswith("+"):
+                appends.setdefault(key[1:], []).append(g)
+            else:
+                set_field(ctx, key, g)
+        for key, parts in appends.items():
+            base = get_field(ctx, key)
+            all_parts = ([base] if base is not None else []) + parts
+            set_field(ctx, key, sep.join(str(p) for p in all_parts))
+
+
+_GROK_PATTERNS = {
+    "WORD": r"\w+",
+    "NOTSPACE": r"\S+",
+    "SPACE": r"\s*",
+    "DATA": r".*?",
+    "GREEDYDATA": r".*",
+    "INT": r"[+-]?\d+",
+    "NUMBER": r"[+-]?\d+(?:\.\d+)?",
+    "BASE10NUM": r"[+-]?\d+(?:\.\d+)?",
+    "POSINT": r"\d+",
+    "IP": r"\d{1,3}\.\d{1,3}\.\d{1,3}\.\d{1,3}",
+    "IPORHOST": r"[\w.\-:]+",
+    "HOSTNAME": r"[\w.\-]+",
+    "USER": r"[\w.\-]+",
+    "USERNAME": r"[\w.\-]+",
+    "EMAILADDRESS": r"[\w.+\-]+@[\w.\-]+",
+    "UUID": r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}",
+    "TIMESTAMP_ISO8601": r"\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}:\d{2}(?:\.\d+)?(?:Z|[+-]\d{2}:?\d{2})?",
+    "LOGLEVEL": r"(?:TRACE|DEBUG|INFO|NOTICE|WARN(?:ING)?|ERROR|SEVERE|CRIT(?:ICAL)?|FATAL)",
+    "HTTPDATE": r"\d{2}/\w{3}/\d{4}:\d{2}:\d{2}:\d{2} [+-]\d{4}",
+    "QS": r"\"[^\"]*\"",
+    "QUOTEDSTRING": r"\"[^\"]*\"",
+    "URIPATH": r"/[^\s?#]*",
+    "URIPARAM": r"\?[^\s#]*",
+}
+
+
+class GrokProcessor(Processor):
+    """Grok with the core built-in pattern set (the reference bundles the full
+    pattern bank in libs/grok; this is the commonly-used subset)."""
+
+    type = "grok"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.patterns = config.get("patterns") or []
+        if not self.patterns:
+            self._fail("[patterns] required property is missing")
+        bank = dict(_GROK_PATTERNS)
+        bank.update(config.get("pattern_definitions") or {})
+        self.compiled = []
+        for p in self.patterns:
+            self.compiled.append(re.compile(self._to_regex(p, bank)))
+
+    def _to_regex(self, pattern: str, bank: dict, depth=0) -> str:
+        if depth > 10:
+            self._fail("circular grok pattern reference")
+
+        def sub(m):
+            name = m.group(1)
+            field = m.group(3)
+            typ = m.group(5)
+            body = bank.get(name)
+            if body is None:
+                self._fail(f"Unable to find pattern [{name}]")
+            body = self._to_regex(body, bank, depth + 1)
+            if field:
+                safe = field.replace(".", "__DOT__").replace("@", "__AT__")
+                return f"(?P<{safe}>{body})"
+            return f"(?:{body})"
+
+        return re.sub(r"%\{(\w+)(:([\w.@]+)(:(int|long|float|double))?)?\}", sub, pattern)
+
+    def process(self, ctx):
+        field = self._field()
+        val = get_field(ctx, field)
+        if val is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        for pat_src, rx in zip(self.patterns, self.compiled):
+            m = rx.search(str(val))
+            if m is None:
+                continue
+            types = dict(re.findall(r"%\{\w+:([\w.@]+):(int|long|float|double)\}", pat_src))
+            for k, v in m.groupdict().items():
+                if v is None:
+                    continue
+                k = k.replace("__DOT__", ".").replace("__AT__", "@")
+                t = types.get(k)
+                if t in ("int", "long"):
+                    v = int(v)
+                elif t in ("float", "double"):
+                    v = float(v)
+                set_field(ctx, k, v)
+            return
+        self._fail(f"Provided Grok expressions do not match field value: [{val}]")
+
+
+class ScriptProcessor(Processor):
+    type = "script"
+
+    def __init__(self, config):
+        super().__init__(config)
+        spec = config.get("source") or (config.get("script") or {})
+        src = spec if isinstance(spec, str) else spec.get("source")
+        if not src:
+            self._fail("[source] required property is missing")
+        self.script = HostScript(src)
+
+    def process(self, ctx):
+        self.script.run(ctx)
+
+
+class PipelineProcessor(Processor):
+    type = "pipeline"
+
+    def __init__(self, config, ingest_service=None):
+        super().__init__(config)
+        self.ingest_service = ingest_service
+
+    def process(self, ctx):
+        name = self.config.get("name")
+        pipeline = self.ingest_service.get_pipeline(name)
+        if pipeline is None:
+            if self.config.get("ignore_missing_pipeline", False):
+                return
+            self._fail(f"Pipeline processor configured for non-existent pipeline [{name}]")
+        pipeline.run(ctx)
+
+
+class ForeachProcessor(Processor):
+    type = "foreach"
+
+    def __init__(self, config, build_processor=None):
+        super().__init__(config)
+        spec = config.get("processor")
+        if not spec or len(spec) != 1:
+            self._fail("[processor] required property is missing")
+        self.inner = build_processor(spec)
+
+    def process(self, ctx):
+        field = self._field()
+        vals = get_field(ctx, field)
+        if vals is None:
+            if self.config.get("ignore_missing", False):
+                return
+            self._fail(f"field [{field}] is null or missing")
+        if not isinstance(vals, list):
+            self._fail(f"field [{field}] is not a list")
+        out = []
+        for v in vals:
+            ctx["_ingest"] = {**ctx.get("_ingest", {}), "_value": v}
+            self.inner.process(ctx)
+            out.append(ctx["_ingest"]["_value"])
+        set_field(ctx, field, out)
+
+
+PROCESSOR_TYPES = {
+    cls.type: cls
+    for cls in (
+        SetProcessor, RemoveProcessor, RenameProcessor, ConvertProcessor,
+        LowercaseProcessor, UppercaseProcessor, TrimProcessor,
+        HtmlStripProcessor, UrldecodeProcessor, SplitProcessor, JoinProcessor,
+        AppendProcessor, GsubProcessor, DateProcessor, FailProcessor,
+        DropProcessor, JsonProcessor, KvProcessor, CsvProcessor,
+        DissectProcessor, GrokProcessor, ScriptProcessor,
+    )
+}

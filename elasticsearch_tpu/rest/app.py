@@ -268,9 +268,54 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         import time
 
         t0 = time.monotonic()
-        res = await call(engine.bulk, ops)
+        res = await call(engine.bulk, ops, request.query.get("pipeline"))
         res["took"] = int((time.monotonic() - t0) * 1000)
         return web.json_response(res)
+
+    # ---- ingest pipelines ------------------------------------------------
+
+    @handler
+    async def put_pipeline(request):
+        body = await body_json(request, {})
+        return web.json_response(
+            await call(engine.ingest.put_pipeline, request.match_info["id"], body)
+        )
+
+    @handler
+    async def get_pipeline(request):
+        pid = request.match_info.get("id")
+        if pid is None:
+            return web.json_response(engine.ingest.pipelines)
+        cfg = engine.ingest.get_pipeline_config(pid)
+        if cfg is None:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"pipeline [{pid}] is missing")
+        return web.json_response({pid: cfg})
+
+    @handler
+    async def delete_pipeline(request):
+        found = engine.ingest.delete_pipeline(request.match_info["id"])
+        if not found:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(
+                f"pipeline [{request.match_info['id']}] is missing"
+            )
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def simulate_pipeline(request):
+        body = await body_json(request, {})
+        docs = body.get("docs") or []
+        pid = request.match_info.get("id")
+        target = pid if pid is not None else {
+            k: v for k, v in body.items() if k != "docs"
+        }
+        verbose = request.query.get("verbose") in ("", "true")
+        return web.json_response(
+            await call(engine.ingest.simulate, target, docs, verbose)
+        )
 
     # ---- search ----------------------------------------------------------
 
@@ -422,6 +467,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         )
 
     app.router.add_get("/", root)
+    app.router.add_put("/_ingest/pipeline/{id}", put_pipeline)
+    app.router.add_get("/_ingest/pipeline/{id}", get_pipeline)
+    app.router.add_get("/_ingest/pipeline", get_pipeline)
+    app.router.add_delete("/_ingest/pipeline/{id}", delete_pipeline)
+    app.router.add_post("/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+    app.router.add_post("/_ingest/pipeline/_simulate", simulate_pipeline)
     app.router.add_get("/_cluster/health", cluster_health)
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)

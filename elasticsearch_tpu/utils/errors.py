@@ -62,3 +62,13 @@ class QueryParsingError(ElasticsearchTpuError):
 class IllegalArgumentError(ElasticsearchTpuError):
     status = 400
     type = "illegal_argument_exception"
+
+
+class ResourceNotFoundError(ElasticsearchTpuError):
+    status = 404
+    type = "resource_not_found_exception"
+
+
+class ResourceAlreadyExistsError(ElasticsearchTpuError):
+    status = 400
+    type = "resource_already_exists_exception"

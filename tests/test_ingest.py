@@ -1,0 +1,206 @@
+"""Ingest pipelines + processors (reference behavior: ingest/IngestService.java,
+modules/ingest-common processors, ConditionalProcessor, on_failure chains)."""
+
+from __future__ import annotations
+
+import pytest
+
+from elasticsearch_tpu.engine.engine import Engine
+from elasticsearch_tpu.ingest import IngestService
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+@pytest.fixture
+def svc():
+    return IngestService()
+
+
+def run(svc, processors, doc, **kw):
+    svc.put_pipeline("p", {"processors": processors})
+    return svc.execute("p", doc, **kw)
+
+
+def test_set_remove_rename(svc):
+    out = run(svc, [
+        {"set": {"field": "a.b", "value": 1}},
+        {"set": {"field": "greeting", "value": "hello {{name}}"}},
+        {"rename": {"field": "old", "target_field": "new"}},
+        {"remove": {"field": "junk"}},
+    ], {"name": "world", "old": 5, "junk": True})
+    assert out == {"name": "world", "a": {"b": 1}, "greeting": "hello world", "new": 5}
+
+
+def test_set_override_false_and_copy_from(svc):
+    out = run(svc, [
+        {"set": {"field": "x", "value": 9, "override": False}},
+        {"set": {"field": "y", "copy_from": "x"}},
+    ], {"x": 1})
+    assert out == {"x": 1, "y": 1}
+
+
+def test_convert_types(svc):
+    out = run(svc, [
+        {"convert": {"field": "n", "type": "integer"}},
+        {"convert": {"field": "f", "type": "float"}},
+        {"convert": {"field": "b", "type": "boolean"}},
+        {"convert": {"field": "s", "type": "string"}},
+        {"convert": {"field": "many", "type": "integer"}},
+    ], {"n": "42", "f": "2.5", "b": "true", "s": 7, "many": ["1", "2"]})
+    assert out == {"n": 42, "f": 2.5, "b": True, "s": "7", "many": [1, 2]}
+
+
+def test_string_processors(svc):
+    out = run(svc, [
+        {"lowercase": {"field": "a"}},
+        {"uppercase": {"field": "b"}},
+        {"trim": {"field": "c"}},
+        {"gsub": {"field": "d", "pattern": "-", "replacement": "_"}},
+        {"split": {"field": "e", "separator": ","}},
+        {"join": {"field": "f", "separator": "-"}},
+        {"html_strip": {"field": "g"}},
+    ], {"a": "ABC", "b": "abc", "c": "  x  ", "d": "a-b-c", "e": "1,2,3",
+        "f": ["x", "y"], "g": "<b>bold</b>"})
+    assert out == {"a": "abc", "b": "ABC", "c": "x", "d": "a_b_c",
+                   "e": ["1", "2", "3"], "f": "x-y", "g": "bold"}
+
+
+def test_append_and_duplicates(svc):
+    out = run(svc, [
+        {"append": {"field": "tags", "value": ["x", "y"]}},
+        {"append": {"field": "tags", "value": "x", "allow_duplicates": False}},
+    ], {"tags": ["a"]})
+    assert out == {"tags": ["a", "x", "y"]}
+
+
+def test_conditional_if(svc):
+    procs = [{"set": {"field": "flag", "value": 1,
+                      "if": "ctx.status == 'error'"}}]
+    assert run(svc, procs, {"status": "error"})["flag"] == 1
+    svc2 = IngestService()
+    assert "flag" not in run(svc2, procs, {"status": "ok"})
+
+
+def test_drop_processor(svc):
+    procs = [{"drop": {"if": "ctx.level == 'debug'"}}]
+    assert run(svc, procs, {"level": "debug"}) is None
+    svc2 = IngestService()
+    assert run(svc2, procs, {"level": "info"}) == {"level": "info"}
+
+
+def test_fail_and_on_failure_chain(svc):
+    out = run(svc, [
+        {"fail": {"message": "boom {{id}}", "on_failure": [
+            {"set": {"field": "err", "value": "{{_ingest.on_failure_message}}"}},
+        ]}},
+    ], {"id": "7"})
+    assert out["err"] == "boom 7"
+
+
+def test_pipeline_level_on_failure(svc):
+    svc.put_pipeline("p", {
+        "processors": [{"fail": {"message": "nope"}}],
+        "on_failure": [{"set": {"field": "rescued", "value": True}}],
+    })
+    out = svc.execute("p", {"a": 1})
+    assert out["rescued"] is True
+
+
+def test_date_processor(svc):
+    out = run(svc, [{"date": {"field": "ts", "formats": ["UNIX_MS"]}}],
+              {"ts": 1700000000000})
+    assert out["@timestamp"].startswith("2023-11-14T22:13:20")
+
+
+def test_json_kv_csv(svc):
+    out = run(svc, [
+        {"json": {"field": "payload"}},
+        {"kv": {"field": "pairs", "field_split": " ", "value_split": "="}},
+        {"csv": {"field": "row", "target_fields": ["x", "y"]}},
+    ], {"payload": '{"a": 1}', "pairs": "k1=v1 k2=v2", "row": "10,20"})
+    assert out["payload"] == {"a": 1}
+    assert out["k1"] == "v1" and out["k2"] == "v2"
+    assert out["x"] == "10" and out["y"] == "20"
+
+
+def test_dissect(svc):
+    out = run(svc, [{"dissect": {
+        "field": "msg", "pattern": "%{clientip} - %{verb} %{url}"}}],
+        {"msg": "1.2.3.4 - GET /index.html"})
+    assert out["clientip"] == "1.2.3.4"
+    assert out["verb"] == "GET"
+    assert out["url"] == "/index.html"
+
+
+def test_grok_with_types(svc):
+    out = run(svc, [{"grok": {
+        "field": "line",
+        "patterns": ["%{IP:client} %{WORD:method} %{NUMBER:bytes:int} %{GREEDYDATA:rest}"],
+    }}], {"line": "127.0.0.1 GET 3049 some trailing text"})
+    assert out["client"] == "127.0.0.1"
+    assert out["method"] == "GET"
+    assert out["bytes"] == 3049
+    assert out["rest"] == "some trailing text"
+
+
+def test_script_processor(svc):
+    out = run(svc, [{"script": {
+        "source": "ctx.total = ctx.price * ctx.qty; ctx.label = ctx.name.toUpperCase()",
+    }}], {"price": 2.5, "qty": 4, "name": "ab"})
+    assert out["total"] == 10.0
+    assert out["label"] == "AB"
+
+
+def test_foreach(svc):
+    out = run(svc, [{"foreach": {
+        "field": "vals",
+        "processor": {"uppercase": {"field": "_ingest._value"}},
+    }}], {"vals": ["a", "b"]})
+    assert out["vals"] == ["A", "B"]
+
+
+def test_pipeline_processor(svc):
+    svc.put_pipeline("inner", {"processors": [{"set": {"field": "via", "value": "inner"}}]})
+    svc.put_pipeline("outer", {"processors": [{"pipeline": {"name": "inner"}}]})
+    assert svc.execute("outer", {})["via"] == "inner"
+
+
+def test_invalid_pipeline_rejected_at_put(svc):
+    with pytest.raises(IllegalArgumentError):
+        svc.put_pipeline("bad", {"processors": [{"nosuch": {}}]})
+    assert svc.get_pipeline("bad") is None
+
+
+def test_simulate(svc):
+    res = svc.simulate(
+        {"processors": [{"set": {"field": "x", "value": 1}}]},
+        [{"_source": {"a": 1}}, {"_source": {"b": 2}}],
+    )
+    assert [d["doc"]["_source"] for d in res["docs"]] == [
+        {"a": 1, "x": 1}, {"b": 2, "x": 1}]
+
+
+def test_engine_bulk_with_pipeline_and_default_pipeline():
+    e = Engine()
+    e.ingest.put_pipeline("add-tag", {"processors": [
+        {"set": {"field": "tagged", "value": True}},
+        {"drop": {"if": "ctx.skip == true"}},
+    ]})
+    e.create_index("docs", settings={"default_pipeline": "add-tag"})
+    res = e.bulk([
+        ("index", "docs", "1", {"v": 1}),
+        ("index", "docs", "2", {"v": 2, "skip": True}),
+    ])
+    assert not res["errors"]
+    idx = e.get_index("docs")
+    assert idx.get_doc("1")["_source"] == {"v": 1, "tagged": True}
+    assert idx.get_doc("2") is None  # dropped
+    assert res["items"][1]["index"]["result"] == "noop"
+
+
+def test_engine_final_pipeline_runs_after():
+    e = Engine()
+    e.ingest.put_pipeline("first", {"processors": [{"set": {"field": "a", "value": 1}}]})
+    e.ingest.put_pipeline("last", {"processors": [{"set": {"field": "b", "value": "{{a}}"}}]})
+    e.create_index("d", settings={"default_pipeline": "first", "final_pipeline": "last"})
+    e.bulk([("index", "d", "1", {})])
+    assert e.get_index("d").get_doc("1")["_source"] == {"a": 1, "b": "1"}
