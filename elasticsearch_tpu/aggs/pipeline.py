@@ -84,10 +84,15 @@ def _series(buckets: list[dict], path: str, gap_policy: str):
 
 
 def _buckets_of(result: dict):
+    """-> ([(key_or_None, bucket)], keyed). Keyed form (filters agg with
+    keyed buckets) keeps the user's bucket names attached so filtering and
+    sorting pipelines preserve them."""
     b = result.get("buckets")
     if isinstance(b, dict):  # keyed filters agg
-        return list(b.values()), True
-    return b, False
+        return list(b.items()), True
+    if b is None:
+        return None, False
+    return [(None, x) for x in b], False
 
 
 def apply_pipeline_aggs(request: dict | None, results: dict | None):
@@ -105,9 +110,9 @@ def apply_pipeline_aggs(request: dict | None, results: dict | None):
         if not sub or name not in results:
             continue
         res = results[name]
-        buckets, _ = _buckets_of(res)
-        if buckets is not None:
-            for b in buckets:
+        items, _ = _buckets_of(res)
+        if items is not None:
+            for _, b in items:
                 apply_pipeline_aggs(sub, b)
             _apply_parent_pipelines(sub, res)
         else:
@@ -122,8 +127,8 @@ def apply_pipeline_aggs(request: dict | None, results: dict | None):
 
 
 def _apply_parent_pipelines(sub_request: dict, parent_result: dict):
-    buckets, keyed = _buckets_of(parent_result)
-    if buckets is None:
+    items, keyed = _buckets_of(parent_result)
+    if items is None:
         return
     for name, spec in sub_request.items():
         t = _spec_type(spec)
@@ -133,24 +138,25 @@ def _apply_parent_pipelines(sub_request: dict, parent_result: dict):
         gap = body.get("gap_policy", "skip")
         if t == "bucket_sort":
             _bucket_sort(parent_result, body)
-            buckets, keyed = _buckets_of(parent_result)
+            items, keyed = _buckets_of(parent_result)
             continue
         if t == "bucket_selector":
             keep = []
-            for b in buckets:
-                v = _eval_bucket_script(body, b, gap)
+            for kb in items:
+                v = _eval_bucket_script(body, kb[1], gap)
                 if v is not None and bool(v):
-                    keep.append(b)
+                    keep.append(kb)
             _set_buckets(parent_result, keep, keyed)
-            buckets = keep
+            items = keep
             continue
         if t == "bucket_script":
-            for b in buckets:
+            for _, b in items:
                 v = _eval_bucket_script(body, b, gap)
                 if v is not None:
                     b[name] = {"value": float(v)}
             continue
         path = (body.get("buckets_path") or "_count")
+        buckets = [b for _, b in items]
         series = _series(buckets, path, gap)
         if t == "cumulative_sum":
             total = 0.0
@@ -170,24 +176,27 @@ def _apply_parent_pipelines(sub_request: dict, parent_result: dict):
                 if i >= lag and series[i] is not None and series[i - lag] is not None:
                     b[name] = {"value": series[i] - series[i - lag]}
         elif t == "moving_fn":
+            # window covers the `window` buckets BEFORE the current one at
+            # shift=0 (reference behavior: MovFnPipelineAggregator — shift
+            # moves the window right, shift=window/2 centers it)
             window = int(body.get("window", 1))
             shift = int(body.get("shift", 0))
             for i, b in enumerate(buckets):
-                lo = i - window + 1 + shift
-                hi = i + 1 + shift
+                lo = i - window + shift
+                hi = i + shift
                 win = [v for v in series[max(lo, 0):max(hi, 0)] if v is not None]
                 b[name] = {"value": float(np.mean(win)) if win else None}
 
 
-def _set_buckets(parent_result: dict, buckets: list, keyed: bool):
+def _set_buckets(parent_result: dict, items: list, keyed: bool):
     if keyed:
-        parent_result["buckets"] = {b.get("key", str(i)): b for i, b in enumerate(buckets)}
+        parent_result["buckets"] = {k: b for k, b in items}
     else:
-        parent_result["buckets"] = buckets
+        parent_result["buckets"] = [b for _, b in items]
 
 
 def _bucket_sort(parent_result: dict, body: dict):
-    buckets, keyed = _buckets_of(parent_result)
+    items, keyed = _buckets_of(parent_result)
     sort_specs = body.get("sort") or []
     from_ = int(body.get("from", 0))
     size = body.get("size")
@@ -201,19 +210,19 @@ def _bucket_sort(parent_result: dict, body: dict):
 
     specs = [norm(s) for s in sort_specs]
 
-    def sort_key(b):
+    def sort_key(kb):
         out = []
         for path, order in specs:
-            v = _bucket_value(b, path)
+            v = _bucket_value(kb[1], path)
             v = float("-inf") if v is None else v
             out.append(-v if order == "desc" else v)
         return out
 
     if specs:
-        buckets = sorted(buckets, key=sort_key)
+        items = sorted(items, key=sort_key)
     end = from_ + int(size) if size is not None else None
-    buckets = buckets[from_:end]
-    _set_buckets(parent_result, buckets, keyed)
+    items = items[from_:end]
+    _set_buckets(parent_result, items, keyed)
 
 
 def _eval_bucket_script(body: dict, bucket: dict, gap: str):
@@ -251,10 +260,11 @@ def _compute_sibling(t: str, body: dict, results: dict):
     target = results.get(first)
     if target is None:
         raise IllegalArgumentError(f"No aggregation found for path [{path}]")
-    buckets, _ = _buckets_of(target)
-    if buckets is None:
+    items, _ = _buckets_of(target)
+    if items is None:
         raise IllegalArgumentError(f"[{first}] is not a multi-bucket aggregation")
     gap = body.get("gap_policy", "skip")
+    buckets = [b for _, b in items]
     series = [v for v in _series(buckets, rest or "_count", gap) if v is not None]
     if t == "avg_bucket":
         return {"value": float(np.mean(series)) if series else None}

@@ -36,6 +36,7 @@ from ..utils.errors import (
     DocumentMissingError,
     IndexAlreadyExistsError,
     IndexNotFoundError,
+    ResourceNotFoundError,
     VersionConflictError,
     IllegalArgumentError,
 )
@@ -47,6 +48,22 @@ def _auto_id() -> str:
     import secrets
 
     return "".join(secrets.choice(_AUTO_ID_ALPHABET) for _ in range(20))
+
+
+class _StrKey:
+    """Orderable wrapper so descending string sort keys compose with numeric
+    keys in one tuple sort during the cross-index merge."""
+
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc):
+        self.v, self.desc = v, desc
+
+    def __lt__(self, other):
+        return (self.v > other.v) if self.desc else (self.v < other.v)
+
+    def __eq__(self, other):
+        return self.v == other.v
 
 
 @dataclass
@@ -320,7 +337,15 @@ class EsIndex:
         sort=None, search_after=None, script_fields=None,
     ):
         self._maybe_refresh()
+        from ..aggs.pipeline import apply_pipeline_aggs, strip_pipeline_aggs
         from ..query.sort import is_score_only, parse_sort
+
+        # pipeline aggs are host-side post-reduction transforms; the device
+        # only ever sees the stripped tree (reference behavior: pipeline
+        # aggregators run at coordinator reduce, search/aggregations/pipeline/)
+        aggs_request = aggs
+        aggs, had_pipeline = strip_pipeline_aggs(aggs)
+        aggs = aggs or None
 
         sort_fields = parse_sort(sort)
         if not is_score_only(sort_fields):
@@ -341,6 +366,8 @@ class EsIndex:
                     "sort": values,
                 })
             self._apply_script_fields(hits, script_fields)
+            if had_pipeline and aggregations is not None:
+                apply_pipeline_aggs(aggs_request, aggregations)
             return {
                 "hits": {
                     "total": {"value": total, "relation": "eq"},
@@ -405,6 +432,8 @@ class EsIndex:
                 }
             )
         self._apply_script_fields(hits, script_fields)
+        if had_pipeline and res.aggregations is not None:
+            apply_pipeline_aggs(aggs_request, res.aggregations)
         return {
             "hits": {
                 "total": {"value": res.total, "relation": "eq"},
@@ -429,11 +458,13 @@ class Engine:
     reference: indices/IndicesService registry of IndexShard instances)."""
 
     def __init__(self, data_path: str | None = None):
+        from ..cluster.metadata import MetadataStore
         from ..ingest import IngestService
 
         self.data_path = data_path
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
+        self.meta = MetadataStore(data_path)
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):
@@ -446,13 +477,44 @@ class Engine:
             return None
         return os.path.join(self.data_path, "indices", name)
 
-    def create_index(self, name: str, mappings: dict | None = None, settings: dict | None = None) -> EsIndex:
+    def create_index(self, name: str, mappings: dict | None = None,
+                     settings: dict | None = None, aliases: dict | None = None) -> EsIndex:
         if name in self.indices:
             raise IndexAlreadyExistsError(name)
+        if name in self.meta.aliases:
+            raise IllegalArgumentError(
+                f"an alias with the name [{name}] already exists"
+            )
         if not name or name != name.lower() or name.startswith(("_", "-", "+")):
             raise IllegalArgumentError(f"invalid index name [{name}]")
-        idx = EsIndex(name, Mappings(mappings or {}), settings or {}, self._dir_for(name))
+        # composable index templates apply first, request body overlays
+        # (reference behavior: MetadataCreateIndexService applies the matched
+        # v2 template's resolved settings/mappings/aliases under the request)
+        from ..cluster.metadata import deep_merge
+
+        composed = self.meta.compose_for_index(name)
+        if composed:
+            tset = dict(composed.get("settings") or {})
+            if "index" in tset:
+                tset.update(tset.pop("index"))
+            tset = {k.removeprefix("index."): v for k, v in tset.items()}
+            settings = deep_merge(tset, settings or {})
+            mappings = deep_merge(composed.get("mappings") or {}, mappings or {})
+            aliases = {**(composed.get("aliases") or {}), **(aliases or {})}
+        m = Mappings(mappings or {})
+        # validate aliases BEFORE creating the index so a bad alias leaves no
+        # half-created state behind
+        for alias, props in (aliases or {}).items():
+            if not alias or alias in ("_all", "*") or alias in self.indices or alias == name:
+                raise IllegalArgumentError(f"invalid alias name [{alias}]")
+            if isinstance(props, dict) and props.get("filter"):
+                from ..query.dsl import parse_query
+
+                parse_query(props["filter"], m)
+        idx = EsIndex(name, m, settings or {}, self._dir_for(name))
         self.indices[name] = idx
+        for alias, props in (aliases or {}).items():
+            self.meta.put_alias(name, alias, props)
         return idx
 
     def get_index(self, name: str) -> EsIndex:
@@ -461,22 +523,214 @@ class Engine:
             raise IndexNotFoundError(name)
         return idx
 
+    def resolve_write_index(self, name: str) -> str:
+        """Alias → its write index; concrete names pass through."""
+        if name in self.meta.aliases and name not in self.indices:
+            return self.meta.write_index_of(name)
+        return name
+
+    def resolve_search(self, expression, ignore_unavailable: bool = False,
+                       allow_no_indices: bool = True) -> list[tuple[EsIndex, dict | None]]:
+        """Resolve an index expression to [(index, alias_filter)]."""
+        targets = self.meta.search_targets(
+            expression, list(self.indices), ignore_unavailable, allow_no_indices
+        )
+        return [(self.get_index(n), f) for n, f in targets]
+
     def get_or_autocreate(self, name: str) -> EsIndex:
         """Auto-create on first write, like the reference's
         action.auto_create_index default (TransportBulkAction auto-create)."""
+        name = self.resolve_write_index(name)
         if name not in self.indices:
             return self.create_index(name)
         return self.indices[name]
 
     def delete_index(self, name: str):
+        if name in self.meta.aliases and name not in self.indices:
+            raise IllegalArgumentError(
+                f"The provided expression [{name}] matches an alias, specify the "
+                "corresponding concrete indices instead."
+            )
         idx = self.get_index(name)
         idx.close()
         del self.indices[name]
+        self.meta.drop_index(name)
         d = self._dir_for(name)
         if d and os.path.isdir(d):
             import shutil
 
             shutil.rmtree(d)
+
+    # ---- alias management (reference: TransportIndicesAliasesAction) -----
+
+    def update_aliases(self, actions: list[dict]):
+        """POST /_aliases action list: add / remove / remove_index."""
+        parsed = []
+        for a in actions:
+            if not isinstance(a, dict) or len(a) != 1:
+                raise IllegalArgumentError("malformed alias action")
+            (kind, body), = a.items()
+            if kind not in ("add", "remove", "remove_index"):
+                raise IllegalArgumentError(f"unknown alias action [{kind}]")
+            idx_expr = body.get("indices", body.get("index"))
+            if idx_expr is None:
+                raise IllegalArgumentError("alias action requires an index")
+            names = self.meta.resolve_expression(idx_expr, list(self.indices))
+            if kind == "remove_index":
+                parsed.append((kind, names, None, body))
+                continue
+            aliases = body.get("aliases", body.get("alias"))
+            if aliases is None:
+                raise IllegalArgumentError("alias action requires an alias")
+            if isinstance(aliases, str):
+                aliases = [aliases]
+            parsed.append((kind, names, aliases, body))
+        # validate everything first, then apply — the whole action list is one
+        # atomic cluster-state update in the reference
+        # (TransportIndicesAliasesAction submits a single state task)
+        import fnmatch as _fn
+
+        from ..query.dsl import parse_query
+
+        staged_adds: set[tuple[str, str]] = set()
+        for kind, names, aliases, body in parsed:
+            if kind == "remove_index":
+                continue
+            for alias in aliases:
+                if kind == "add":
+                    if not alias or alias in ("_all", "*"):
+                        raise IllegalArgumentError(f"invalid alias name [{alias}]")
+                    if alias in self.indices:
+                        raise IllegalArgumentError(
+                            f"an index exists with the same name as the alias [{alias}]"
+                        )
+                    for n in names:
+                        if body.get("filter"):
+                            parse_query(body["filter"], self.indices[n].mappings)
+                        staged_adds.add((n, alias))
+                elif body.get("must_exist", True):
+                    for n in names:
+                        present = any(
+                            _fn.fnmatchcase(a, alias) and n in members
+                            for a, members in self.meta.aliases.items()
+                        ) or any(
+                            _fn.fnmatchcase(a, alias) and n == i
+                            for i, a in staged_adds
+                        )
+                        if not present:
+                            raise ResourceNotFoundError(
+                                f"aliases [{alias}] missing on index [{n}]"
+                            )
+        for kind, names, aliases, body in parsed:
+            for n in names:
+                if kind == "remove_index":
+                    self.delete_index(n)
+                    continue
+                for alias in aliases:
+                    if kind == "add":
+                        self.meta.put_alias(n, alias, {
+                            "filter": body.get("filter"),
+                            "is_write_index": body.get("is_write_index"),
+                            "routing": body.get("routing"),
+                        })
+                    else:
+                        self.meta.remove_alias(n, alias, must_exist=False)
+        return {"acknowledged": True}
+
+    # ---- multi-index search (scatter/gather across indices) --------------
+
+    def search_multi(self, expression, *, ignore_unavailable=False,
+                     allow_no_indices=True, **kwargs):
+        """Search over an index expression. One concrete unfiltered target
+        uses the index path directly; multiple targets fan out and merge at
+        this coordinator (reference behavior: TransportSearchAction shards
+        span all resolved indices; merge in SearchPhaseController)."""
+        targets = self.resolve_search(expression, ignore_unavailable, allow_no_indices)
+        if not targets:
+            return {
+                "hits": {"total": {"value": 0, "relation": "eq"},
+                         "max_score": None, "hits": []},
+            }
+
+        def with_filter(query, alias_filter):
+            if alias_filter is None:
+                return query
+            if query is None:
+                return {"bool": {"filter": [alias_filter]}}
+            return {"bool": {"must": [query], "filter": [alias_filter]}}
+
+        if len(targets) == 1:
+            idx, alias_filter = targets[0]
+            kw = dict(kwargs)
+            kw["query"] = with_filter(kw.get("query"), alias_filter)
+            return idx.search(**kw)
+
+        if kwargs.get("aggs"):
+            raise IllegalArgumentError(
+                "aggregations over multiple indices are not supported yet; "
+                "target a single concrete index"
+            )
+        if kwargs.get("knn"):
+            raise IllegalArgumentError(
+                "knn over multiple indices is not supported yet"
+            )
+        size = kwargs.get("size", 10)
+        from_ = kwargs.get("from_", 0)
+        sub_results = []
+        for idx, alias_filter in targets:
+            kw = dict(kwargs)
+            kw["query"] = with_filter(kw.get("query"), alias_filter)
+            kw["size"] = size + from_
+            kw["from_"] = 0
+            sub_results.append(idx.search(**kw))
+        # merge: total sums; hits re-sorted globally (score desc, or the
+        # explicit sort's transformed keys which each sub-search returns in
+        # hit["sort"]) — the coordinator-side TopDocs.merge of the reference
+        from ..query.sort import parse_sort, is_score_only
+
+        sort_fields = parse_sort(kwargs.get("sort"))
+        all_hits = [h for r in sub_results for h in r["hits"]["hits"]]
+        if is_score_only(sort_fields):
+            all_hits.sort(key=lambda h: (-(h["_score"] or 0.0), h["_index"], h["_id"]))
+        else:
+            def key(h):
+                # each field key is (missing_rank, value) so None (missing
+                # field) orders per the sort's missing policy without ever
+                # comparing across types
+                ks = []
+                for v, sf in zip(h["sort"], sort_fields):
+                    if v is None:
+                        rank = -1 if sf.missing == "_first" else 1
+                        ks.append((rank, 0))
+                    elif isinstance(v, str):
+                        ks.append((0, _StrKey(v, sf.desc)))
+                    elif isinstance(v, bool) or not isinstance(v, (int, float)):
+                        ks.append((0, _StrKey(str(v), sf.desc)))
+                    else:
+                        ks.append((0, -v if sf.desc else v))
+                return ks
+            all_hits.sort(key=key)
+        total = sum(r["hits"]["total"]["value"] for r in sub_results)
+        max_scores = [r["hits"]["max_score"] for r in sub_results
+                      if r["hits"]["max_score"] is not None]
+        return {
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max(max_scores) if max_scores else None,
+                "hits": all_hits[from_:from_ + size],
+            },
+        }
+
+    def count_multi(self, expression, query=None, **res_kw) -> int:
+        targets = self.resolve_search(expression, **res_kw)
+        total = 0
+        for idx, alias_filter in targets:
+            q = query
+            if alias_filter is not None:
+                q = {"bool": {"filter": [alias_filter]}} if q is None else \
+                    {"bool": {"must": [q], "filter": [alias_filter]}}
+            total += idx.count(q)
+        return total
 
     def run_pipelines(self, index_name: str, source: dict,
                       pipeline: str | None = None, doc_id: str | None = None):
@@ -508,6 +762,9 @@ class Engine:
         errors = False
         for action, index_name, doc_id, source in operations:
             try:
+                # resolve write alias up front so ingest pipeline settings and
+                # item results both see the concrete index
+                index_name = self.resolve_write_index(index_name)
                 idx = self.get_or_autocreate(index_name)
                 if action in ("index", "create"):
                     source = self.run_pipelines(index_name, source, pipeline, doc_id)

@@ -95,7 +95,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         settings = body.get("settings", {})
         if "index" in settings:
             settings = {**settings, **settings.pop("index")}
-        await call(engine.create_index, name, mappings, settings)
+        await call(engine.create_index, name, mappings, settings, body.get("aliases"))
         return web.json_response({"acknowledged": True, "shards_acknowledged": True, "index": name})
 
     @handler
@@ -105,11 +105,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def get_index(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         return web.json_response(
             {
                 idx.name: {
-                    "aliases": {},
+                    "aliases": engine.meta.aliases_of(idx.name),
                     "mappings": idx.mappings.to_dict(),
                     "settings": {"index": {k: str(v) for k, v in idx.settings.items()}},
                 }
@@ -124,12 +124,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def get_mapping(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         return web.json_response({idx.name: {"mappings": idx.mappings.to_dict()}})
 
     @handler
     async def put_mapping(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         body = await body_json(request, {}) or {}
         await call(idx.mappings.merge, body)
         idx._persist_meta()
@@ -138,7 +138,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     @handler
     async def refresh_index(request):
         name = request.match_info.get("index")
-        targets = [engine.get_index(name)] if name else list(engine.indices.values())
+        targets = (
+            [i for i, _ in engine.resolve_search(name)]
+            if name
+            else list(engine.indices.values())
+        )
         for idx in targets:
             await call(idx.refresh)
         n = len(targets)
@@ -146,11 +150,15 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def flush_index(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         await call(idx.flush)
         return web.json_response({"_shards": {"total": 1, "successful": 1, "failed": 0}})
 
     # ---- documents -------------------------------------------------------
+
+    def _concrete(name):
+        return engine.get_index(engine.resolve_write_index(name))
+
 
     def _doc_result(r, index_name):
         return {
@@ -189,7 +197,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def get_doc(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         got = idx.get_doc(request.match_info["id"])
         if got is None:
             return web.json_response(
@@ -200,12 +208,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def head_doc(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         return web.Response(status=200 if idx.get_doc(request.match_info["id"]) else 404)
 
     @handler
     async def get_source(request):
-        idx = engine.get_index(request.match_info["index"])
+        idx = _concrete(request.match_info["index"])
         got = idx.get_doc(request.match_info["id"])
         if got is None:
             return web.json_response(
@@ -215,10 +223,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def delete_doc(request):
-        name = request.match_info["index"]
-        idx = engine.get_index(name)
+        idx = _concrete(request.match_info["index"])
         r = await call(idx.delete_doc, request.match_info["id"])
-        return web.json_response({**_doc_result(r, name), "result": "deleted"})
+        return web.json_response({**_doc_result(r, idx.name), "result": "deleted"})
 
     @handler
     async def update_doc(request):
@@ -319,18 +326,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- search ----------------------------------------------------------
 
-    def _search_index(request):
-        name = request.match_info.get("index")
-        if not name or name in ("_all", "*"):
-            names = list(engine.indices)
-            if len(names) != 1:
-                raise IllegalArgumentError(
-                    "multi-index search requires a single concrete index in this version"
-                )
-            name = names[0]
-        return engine.get_index(name)
+    def _bool_param(query_params, name, default=False):
+        v = query_params.get(name)
+        if v is None:
+            return default
+        return v in ("", "true", "1")
 
-    async def _run_search(idx, body, query_params):
+    async def _run_search(expression, body, query_params):
         body = body or {}
         query = body.get("query")
         knn = body.get("knn")
@@ -343,8 +345,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
         t0 = time.monotonic()
         res = await call(
-            idx.search, query, size, from_, aggs, knn, sort, search_after,
-            body.get("script_fields"),
+            engine.search_multi, expression,
+            ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
+            allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
+            query=query, size=size, from_=from_, aggs=aggs, knn=knn, sort=sort,
+            search_after=search_after, script_fields=body.get("script_fields"),
         )
         took = int((time.monotonic() - t0) * 1000)
         src_filter = body.get("_source")
@@ -355,12 +360,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             wanted = [src_filter] if isinstance(src_filter, str) else src_filter
             for h in res["hits"]["hits"]:
                 h["_source"] = {k: v for k, v in h["_source"].items() if k in wanted}
+        n_shards = sum(
+            i.num_shards for i, _ in engine.resolve_search(
+                expression, _bool_param(query_params, "ignore_unavailable"), True
+            )
+        )
         return {
             "took": took,
             "timed_out": False,
             "_shards": {
-                "total": idx.num_shards,
-                "successful": idx.num_shards,
+                "total": n_shards,
+                "successful": n_shards,
                 "skipped": 0,
                 "failed": 0,
             },
@@ -369,9 +379,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def search(request):
-        idx = _search_index(request)
         body = await body_json(request, {})
-        return web.json_response(await _run_search(idx, body, request.query))
+        return web.json_response(
+            await _run_search(request.match_info.get("index"), body, request.query)
+        )
 
     @handler
     async def msearch(request):
@@ -385,20 +396,159 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             body = json.loads(lines[i + 1])
             name = header.get("index", request.match_info.get("index"))
             try:
-                idx = engine.get_index(name) if name else _search_index(request)
-                responses.append({**(await _run_search(idx, body, {})), "status": 200})
+                responses.append({**(await _run_search(name, body, {})), "status": 200})
             except ElasticsearchTpuError as ex:
                 responses.append({**ex.to_dict(), "status": ex.status})
         return web.json_response({"took": 0, "responses": responses})
 
     @handler
     async def count(request):
-        idx = _search_index(request)
         body = await body_json(request, {}) or {}
-        n = await call(idx.count, body.get("query"))
+        expression = request.match_info.get("index")
+        n = await call(engine.count_multi, expression, body.get("query"))
+        n_shards = sum(i.num_shards for i, _ in engine.resolve_search(expression))
         return web.json_response(
-            {"count": n, "_shards": {"total": idx.num_shards, "successful": idx.num_shards, "skipped": 0, "failed": 0}}
+            {"count": n, "_shards": {"total": n_shards, "successful": n_shards, "skipped": 0, "failed": 0}}
         )
+
+    # ---- aliases ---------------------------------------------------------
+
+    @handler
+    async def post_aliases(request):
+        body = await body_json(request, {}) or {}
+        actions = body.get("actions")
+        if not isinstance(actions, list):
+            raise IllegalArgumentError("No action specified")
+        return web.json_response(await call(engine.update_aliases, actions))
+
+    @handler
+    async def put_alias(request):
+        name = request.match_info["index"]
+        alias = request.match_info["alias"]
+        body = await body_json(request, {}) or {}
+        action = {"add": {"index": name, "alias": alias, **body}}
+        return web.json_response(await call(engine.update_aliases, [action]))
+
+    @handler
+    async def delete_alias(request):
+        action = {"remove": {
+            "index": request.match_info["index"],
+            "alias": request.match_info["alias"],
+        }}
+        return web.json_response(await call(engine.update_aliases, [action]))
+
+    def _alias_table(index_pattern=None, alias_pattern=None):
+        import fnmatch
+
+        out = {}
+        for name, idx in engine.indices.items():
+            if index_pattern and not any(
+                fnmatch.fnmatchcase(name, p) for p in index_pattern.split(",")
+            ):
+                continue
+            aliases = engine.meta.aliases_of(name)
+            if alias_pattern is not None:
+                aliases = {
+                    a: p for a, p in aliases.items()
+                    if any(fnmatch.fnmatchcase(a, ap) for ap in alias_pattern.split(","))
+                }
+                if not aliases:
+                    continue
+            out[name] = {"aliases": {
+                a: {k: v for k, v in p.items() if v is not None}
+                for a, p in aliases.items()
+            }}
+        return out
+
+    @handler
+    async def get_alias(request):
+        index_pattern = request.match_info.get("index")
+        alias_pattern = request.match_info.get("alias")
+        table = _alias_table(index_pattern, alias_pattern)
+        if alias_pattern is not None and not table:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"alias [{alias_pattern}] missing")
+        return web.json_response(table)
+
+    @handler
+    async def head_alias(request):
+        table = _alias_table(request.match_info.get("index"), request.match_info["alias"])
+        return web.Response(status=200 if table else 404)
+
+    # ---- templates -------------------------------------------------------
+
+    @handler
+    async def put_index_template(request):
+        body = await body_json(request, {}) or {}
+        await call(engine.meta.put_index_template, request.match_info["name"], body)
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def get_index_template(request):
+        import fnmatch
+
+        pattern = request.match_info.get("name", "*")
+        matched = [
+            {"name": n, "index_template": b}
+            for n, b in sorted(engine.meta.index_templates.items())
+            if fnmatch.fnmatchcase(n, pattern)
+        ]
+        if not matched and "*" not in pattern:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"index template matching [{pattern}] not found")
+        return web.json_response({"index_templates": matched})
+
+    @handler
+    async def head_index_template(request):
+        import fnmatch
+
+        pattern = request.match_info["name"]
+        ok = any(fnmatch.fnmatchcase(n, pattern) for n in engine.meta.index_templates)
+        return web.Response(status=200 if ok else 404)
+
+    @handler
+    async def delete_index_template(request):
+        await call(engine.meta.delete_index_template, request.match_info["name"])
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def put_component_template(request):
+        body = await body_json(request, {}) or {}
+        await call(engine.meta.put_component_template, request.match_info["name"], body)
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def get_component_template(request):
+        import fnmatch
+
+        pattern = request.match_info.get("name", "*")
+        matched = [
+            {"name": n, "component_template": b}
+            for n, b in sorted(engine.meta.component_templates.items())
+            if fnmatch.fnmatchcase(n, pattern)
+        ]
+        if not matched and "*" not in pattern:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"component template matching [{pattern}] not found")
+        return web.json_response({"component_templates": matched})
+
+    @handler
+    async def delete_component_template(request):
+        await call(engine.meta.delete_component_template, request.match_info["name"])
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def simulate_index_template(request):
+        name = request.match_info["name"]
+        composed = engine.meta.compose_for_index(name)
+        return web.json_response({"template": {
+            "settings": composed.get("settings", {}),
+            "mappings": composed.get("mappings", {}),
+            "aliases": composed.get("aliases", {}),
+        }, "overlapping": []})
 
     # ---- cluster / cat ---------------------------------------------------
 
@@ -474,6 +624,22 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     app.router.add_post("/_ingest/pipeline/_simulate", simulate_pipeline)
     app.router.add_get("/_cluster/health", cluster_health)
+    app.router.add_post("/_aliases", post_aliases)
+    app.router.add_get("/_alias", get_alias)
+    app.router.add_get("/_alias/{alias}", get_alias, allow_head=False)
+    app.router.add_head("/_alias/{alias}", head_alias)
+    app.router.add_put("/_index_template/{name}", put_index_template)
+    app.router.add_post("/_index_template/{name}", put_index_template)
+    app.router.add_get("/_index_template", get_index_template)
+    app.router.add_get("/_index_template/{name}", get_index_template, allow_head=False)
+    app.router.add_head("/_index_template/{name}", head_index_template)
+    app.router.add_delete("/_index_template/{name}", delete_index_template)
+    app.router.add_post("/_index_template/_simulate_index/{name}", simulate_index_template)
+    app.router.add_put("/_component_template/{name}", put_component_template)
+    app.router.add_post("/_component_template/{name}", put_component_template)
+    app.router.add_get("/_component_template", get_component_template)
+    app.router.add_get("/_component_template/{name}", get_component_template)
+    app.router.add_delete("/_component_template/{name}", delete_component_template)
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
     app.router.add_post("/_bulk", bulk)
@@ -504,6 +670,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/{index}/_create/{id}", create_doc)
     app.router.add_get("/{index}/_source/{id}", get_source)
     app.router.add_post("/{index}/_update/{id}", update_doc)
+    app.router.add_put("/{index}/_alias/{alias}", put_alias)
+    app.router.add_post("/{index}/_alias/{alias}", put_alias)
+    app.router.add_put("/{index}/_aliases/{alias}", put_alias)
+    app.router.add_delete("/{index}/_alias/{alias}", delete_alias)
+    app.router.add_delete("/{index}/_aliases/{alias}", delete_alias)
+    app.router.add_get("/{index}/_alias", get_alias)
+    app.router.add_get("/{index}/_alias/{alias}", get_alias, allow_head=False)
+    app.router.add_head("/{index}/_alias/{alias}", head_alias)
 
     async def on_cleanup(app):
         app["pool"].shutdown(wait=True)

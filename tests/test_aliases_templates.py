@@ -1,0 +1,258 @@
+"""Aliases, index templates, component templates, multi-index search.
+
+Reference behavior: cluster/metadata/AliasMetadata.java (alias add/remove,
+filtered aliases, write index), IndexNameExpressionResolver.java (wildcard
+expression resolution), MetadataIndexTemplateService.java (composable
+template resolution), TransportIndicesAliasesAction (atomic action lists).
+"""
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError,
+    IndexNotFoundError,
+    ResourceNotFoundError,
+)
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    yield e
+    e.close()
+
+
+def _seed(eng, name, docs):
+    idx = eng.create_index(name, {"properties": {"body": {"type": "text"},
+                                                 "tag": {"type": "keyword"},
+                                                 "n": {"type": "long"}}})
+    for i, d in enumerate(docs):
+        idx.index_doc(f"{name}-{i}", d)
+    idx.refresh()
+    return idx
+
+
+class TestAliases:
+    def test_add_and_search_through_alias(self, eng):
+        _seed(eng, "logs-1", [{"body": "alpha beta", "n": 1}])
+        eng.update_aliases([{"add": {"index": "logs-1", "alias": "logs"}}])
+        res = eng.search_multi("logs", query={"match": {"body": "alpha"}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_alias_over_two_indices_merges_hits(self, eng):
+        _seed(eng, "a1", [{"body": "common alpha", "n": 1}])
+        _seed(eng, "a2", [{"body": "common beta", "n": 2}])
+        eng.update_aliases([
+            {"add": {"index": "a1", "alias": "both"}},
+            {"add": {"index": "a2", "alias": "both"}},
+        ])
+        res = eng.search_multi("both", query={"match": {"body": "common"}})
+        assert res["hits"]["total"]["value"] == 2
+        assert {h["_index"] for h in res["hits"]["hits"]} == {"a1", "a2"}
+
+    def test_filtered_alias(self, eng):
+        _seed(eng, "f1", [{"body": "x", "tag": "keep", "n": 1},
+                          {"body": "x", "tag": "drop", "n": 2}])
+        eng.update_aliases([{"add": {
+            "index": "f1", "alias": "kept", "filter": {"term": {"tag": "keep"}},
+        }}])
+        res = eng.search_multi("kept", query={"match": {"body": "x"}})
+        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["hits"][0]["_source"]["tag"] == "keep"
+        # direct index access bypasses the filter
+        res = eng.search_multi("f1", query={"match": {"body": "x"}})
+        assert res["hits"]["total"]["value"] == 2
+
+    def test_write_index_resolution(self, eng):
+        _seed(eng, "w1", [])
+        _seed(eng, "w2", [])
+        eng.update_aliases([
+            {"add": {"index": "w1", "alias": "w"}},
+            {"add": {"index": "w2", "alias": "w", "is_write_index": True}},
+        ])
+        idx = eng.get_or_autocreate("w")
+        assert idx.name == "w2"
+
+    def test_write_to_multi_alias_without_write_index_fails(self, eng):
+        _seed(eng, "w1", [])
+        _seed(eng, "w2", [])
+        eng.update_aliases([
+            {"add": {"index": "w1", "alias": "w"}},
+            {"add": {"index": "w2", "alias": "w"}},
+        ])
+        with pytest.raises(IllegalArgumentError, match="no write index"):
+            eng.get_or_autocreate("w")
+
+    def test_single_member_alias_is_writable(self, eng):
+        _seed(eng, "solo", [])
+        eng.update_aliases([{"add": {"index": "solo", "alias": "s"}}])
+        assert eng.get_or_autocreate("s").name == "solo"
+
+    def test_remove_alias(self, eng):
+        _seed(eng, "r1", [])
+        eng.update_aliases([{"add": {"index": "r1", "alias": "r"}}])
+        eng.update_aliases([{"remove": {"index": "r1", "alias": "r"}}])
+        with pytest.raises(IndexNotFoundError):
+            eng.search_multi("r", allow_no_indices=False)
+
+    def test_remove_missing_alias_raises(self, eng):
+        _seed(eng, "r1", [])
+        with pytest.raises(ResourceNotFoundError):
+            eng.update_aliases([{"remove": {"index": "r1", "alias": "nope"}}])
+
+    def test_remove_index_action(self, eng):
+        _seed(eng, "ri", [])
+        eng.update_aliases([{"remove_index": {"index": "ri"}}])
+        assert "ri" not in eng.indices
+
+    def test_delete_index_drops_aliases(self, eng):
+        _seed(eng, "d1", [])
+        eng.update_aliases([{"add": {"index": "d1", "alias": "da"}}])
+        eng.delete_index("d1")
+        assert "da" not in eng.meta.aliases
+
+    def test_alias_name_conflicts_with_index(self, eng):
+        _seed(eng, "c1", [])
+        eng.update_aliases([{"add": {"index": "c1", "alias": "seen"}}])
+        with pytest.raises(IllegalArgumentError, match="already exists"):
+            eng.create_index("seen")
+
+
+class TestExpressionResolution:
+    def test_wildcard(self, eng):
+        _seed(eng, "log-1", [{"n": 1}])
+        _seed(eng, "log-2", [{"n": 2}])
+        _seed(eng, "other", [{"n": 3}])
+        names = [i.name for i, _ in eng.resolve_search("log-*")]
+        assert names == ["log-1", "log-2"]
+
+    def test_exclusion(self, eng):
+        _seed(eng, "log-1", [])
+        _seed(eng, "log-2", [])
+        names = [i.name for i, _ in eng.resolve_search("log-*,-log-2")]
+        assert names == ["log-1"]
+
+    def test_all_and_comma_list(self, eng):
+        _seed(eng, "x1", [])
+        _seed(eng, "x2", [])
+        assert len(eng.resolve_search("_all")) == 2
+        assert len(eng.resolve_search("x1,x2")) == 2
+
+    def test_missing_index_raises_unless_ignored(self, eng):
+        with pytest.raises(IndexNotFoundError):
+            eng.resolve_search("missing")
+        assert eng.resolve_search("missing", ignore_unavailable=True) == []
+
+    def test_multi_index_search_scores_merge(self, eng):
+        _seed(eng, "m1", [{"body": "quick fox", "n": 1}])
+        _seed(eng, "m2", [{"body": "quick quick quick", "n": 2},
+                          {"body": "slow snail", "n": 3}])
+        res = eng.search_multi("m1,m2", query={"match": {"body": "quick"}})
+        assert res["hits"]["total"]["value"] == 2
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_multi_index_sorted_search(self, eng):
+        _seed(eng, "s1", [{"n": 5}, {"n": 1}])
+        _seed(eng, "s2", [{"n": 3}])
+        res = eng.search_multi("s1,s2", query=None, sort=[{"n": "desc"}])
+        vals = [h["_source"]["n"] for h in res["hits"]["hits"]]
+        assert vals == [5, 3, 1]
+
+    def test_count_multi(self, eng):
+        _seed(eng, "c1", [{"n": 1}])
+        _seed(eng, "c2", [{"n": 2}])
+        assert eng.count_multi("c1,c2") == 2
+
+
+class TestTemplates:
+    def test_index_template_applies_on_create(self, eng):
+        eng.meta.put_index_template("logs", {
+            "index_patterns": ["logs-*"],
+            "template": {
+                "settings": {"number_of_shards": 2},
+                "mappings": {"properties": {"msg": {"type": "text"}}},
+                "aliases": {"logs-all": {}},
+            },
+        })
+        idx = eng.create_index("logs-2026.07")
+        assert idx.num_shards == 2
+        assert "msg" in idx.mappings.fields
+        assert "logs-all" in eng.meta.aliases
+
+    def test_component_composition_order(self, eng):
+        eng.meta.put_component_template("base", {
+            "template": {"settings": {"number_of_shards": 1},
+                         "mappings": {"properties": {"a": {"type": "keyword"}}}},
+        })
+        eng.meta.put_component_template("extra", {
+            "template": {"settings": {"number_of_shards": 3}},
+        })
+        eng.meta.put_index_template("t", {
+            "index_patterns": ["t-*"],
+            "composed_of": ["base", "extra"],
+            "template": {"mappings": {"properties": {"b": {"type": "long"}}}},
+        })
+        idx = eng.create_index("t-1")
+        assert idx.num_shards == 3  # later component wins
+        assert "a" in idx.mappings.fields and "b" in idx.mappings.fields
+
+    def test_priority_selection(self, eng):
+        eng.meta.put_index_template("low", {
+            "index_patterns": ["p-*"], "priority": 1,
+            "template": {"settings": {"number_of_shards": 1}},
+        })
+        eng.meta.put_index_template("high", {
+            "index_patterns": ["p-x*"], "priority": 10,
+            "template": {"settings": {"number_of_shards": 4}},
+        })
+        assert eng.create_index("p-x1").num_shards == 4
+        assert eng.create_index("p-other").num_shards == 1
+
+    def test_request_overrides_template(self, eng):
+        eng.meta.put_index_template("t", {
+            "index_patterns": ["o-*"],
+            "template": {"settings": {"number_of_shards": 2}},
+        })
+        idx = eng.create_index("o-1", settings={"number_of_shards": 5})
+        assert idx.num_shards == 5
+
+    def test_missing_component_rejected(self, eng):
+        with pytest.raises(IllegalArgumentError, match="do not exist"):
+            eng.meta.put_index_template("bad", {
+                "index_patterns": ["b-*"], "composed_of": ["ghost"],
+            })
+
+    def test_delete_component_in_use_rejected(self, eng):
+        eng.meta.put_component_template("c", {"template": {"settings": {}}})
+        eng.meta.put_index_template("t", {
+            "index_patterns": ["z-*"], "composed_of": ["c"],
+        })
+        with pytest.raises(IllegalArgumentError, match="still in use"):
+            eng.meta.delete_component_template("c")
+
+    def test_auto_create_applies_template(self, eng):
+        eng.meta.put_index_template("tmpl", {
+            "index_patterns": ["auto-*"],
+            "template": {"mappings": {"properties": {"f": {"type": "keyword"}}}},
+        })
+        idx = eng.get_or_autocreate("auto-1")
+        assert "f" in idx.mappings.fields
+
+
+class TestMetadataPersistence:
+    def test_aliases_and_templates_survive_restart(self, tmp_path):
+        p = str(tmp_path)
+        e1 = Engine(p)
+        e1.create_index("persist-1")
+        e1.update_aliases([{"add": {"index": "persist-1", "alias": "pa"}}])
+        e1.meta.put_index_template("t", {"index_patterns": ["persist-*"]})
+        e1.close()
+        e2 = Engine(p)
+        try:
+            assert "pa" in e2.meta.aliases
+            assert "t" in e2.meta.index_templates
+            assert [i.name for i, _ in e2.resolve_search("pa")] == ["persist-1"]
+        finally:
+            e2.close()

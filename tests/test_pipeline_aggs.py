@@ -1,0 +1,169 @@
+"""Pipeline aggregations: host-side post-reduction transforms.
+
+Reference behavior: search/aggregations/pipeline/* — sibling pipelines
+(avg_bucket, sum_bucket, …) computed beside a multi-bucket agg; parent
+pipelines (derivative, cumulative_sum, bucket_script, bucket_selector,
+bucket_sort, serial_diff, moving_fn) computed inside one.
+"""
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    idx = e.create_index("sales", {"properties": {
+        "date": {"type": "date"},
+        "price": {"type": "double"},
+        "kind": {"type": "keyword"},
+    }})
+    rows = [
+        ("2024-01-05", 100.0, "a"),
+        ("2024-01-20", 200.0, "b"),
+        ("2024-02-10", 50.0, "a"),
+        ("2024-02-15", 150.0, "a"),
+        ("2024-03-02", 400.0, "b"),
+    ]
+    for i, (d, p, k) in enumerate(rows):
+        idx.index_doc(str(i), {"date": d, "price": p, "kind": k})
+    idx.refresh()
+    yield e
+    e.close()
+
+
+def _monthly(eng, extra):
+    res = eng.get_index("sales").search(aggs={
+        "by_month": {
+            "date_histogram": {"field": "date", "calendar_interval": "month"},
+            "aggs": {"total": {"sum": {"field": "price"}}, **extra.get("sub", {})},
+        },
+        **extra.get("top", {}),
+    }, size=0)
+    return res["aggregations"]
+
+
+class TestSiblingPipelines:
+    def test_avg_and_sum_bucket(self, eng):
+        aggs = _monthly(eng, {"top": {
+            "avg_monthly": {"avg_bucket": {"buckets_path": "by_month>total"}},
+            "sum_monthly": {"sum_bucket": {"buckets_path": "by_month>total"}},
+        }})
+        # months: Jan=300, Feb=200, Mar=400
+        assert aggs["avg_monthly"]["value"] == pytest.approx(300.0)
+        assert aggs["sum_monthly"]["value"] == pytest.approx(900.0)
+
+    def test_min_max_bucket(self, eng):
+        aggs = _monthly(eng, {"top": {
+            "mn": {"min_bucket": {"buckets_path": "by_month>total"}},
+            "mx": {"max_bucket": {"buckets_path": "by_month>total"}},
+        }})
+        assert aggs["mn"]["value"] == pytest.approx(200.0)
+        assert aggs["mx"]["value"] == pytest.approx(400.0)
+
+    def test_stats_and_percentiles_bucket(self, eng):
+        aggs = _monthly(eng, {"top": {
+            "st": {"stats_bucket": {"buckets_path": "by_month>total"}},
+            "es": {"extended_stats_bucket": {"buckets_path": "by_month>total"}},
+            "pc": {"percentiles_bucket": {"buckets_path": "by_month>total",
+                                          "percents": [50.0]}},
+        }})
+        assert aggs["st"]["count"] == 3
+        assert aggs["st"]["sum"] == pytest.approx(900.0)
+        assert aggs["es"]["variance"] == pytest.approx(6666.666, rel=1e-3)
+        assert aggs["pc"]["values"]["50.0"] == pytest.approx(300.0)
+
+    def test_count_path(self, eng):
+        aggs = _monthly(eng, {"top": {
+            "total_docs": {"sum_bucket": {"buckets_path": "by_month>_count"}},
+        }})
+        assert aggs["total_docs"]["value"] == pytest.approx(5.0)
+
+
+class TestParentPipelines:
+    def test_cumulative_sum(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "cum": {"cumulative_sum": {"buckets_path": "total"}},
+        }})
+        cums = [b["cum"]["value"] for b in aggs["by_month"]["buckets"]]
+        assert cums == [pytest.approx(300.0), pytest.approx(500.0), pytest.approx(900.0)]
+
+    def test_derivative(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "d": {"derivative": {"buckets_path": "total"}},
+        }})
+        bs = aggs["by_month"]["buckets"]
+        assert "d" not in bs[0]
+        assert bs[1]["d"]["value"] == pytest.approx(-100.0)
+        assert bs[2]["d"]["value"] == pytest.approx(200.0)
+
+    def test_bucket_script(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "per_doc": {"bucket_script": {
+                "buckets_path": {"t": "total", "n": "_count"},
+                "script": "params.t / params.n",
+            }},
+        }})
+        bs = aggs["by_month"]["buckets"]
+        assert bs[0]["per_doc"]["value"] == pytest.approx(150.0)
+
+    def test_bucket_selector(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "keep": {"bucket_selector": {
+                "buckets_path": {"t": "total"},
+                "script": "params.t > 250",
+            }},
+        }})
+        totals = [b["total"]["value"] for b in aggs["by_month"]["buckets"]]
+        assert totals == [pytest.approx(300.0), pytest.approx(400.0)]
+
+    def test_bucket_sort(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "srt": {"bucket_sort": {"sort": [{"total": "desc"}], "size": 2}},
+        }})
+        totals = [b["total"]["value"] for b in aggs["by_month"]["buckets"]]
+        assert totals == [pytest.approx(400.0), pytest.approx(300.0)]
+
+    def test_serial_diff_and_moving_fn(self, eng):
+        aggs = _monthly(eng, {"sub": {
+            "sd": {"serial_diff": {"buckets_path": "total", "lag": 1}},
+            "mv": {"moving_fn": {"buckets_path": "total", "window": 2}},
+        }})
+        bs = aggs["by_month"]["buckets"]
+        assert bs[2]["sd"]["value"] == pytest.approx(200.0)
+        assert bs[2]["mv"]["value"] == pytest.approx(250.0)  # mean(300,200): window excludes current
+
+    def test_keyed_filters_selector_preserves_names(self, eng):
+        res = eng.get_index("sales").search(aggs={
+            "kinds": {
+                "filters": {"filters": {
+                    "ka": {"term": {"kind": "a"}},
+                    "kb": {"term": {"kind": "b"}},
+                }},
+                "aggs": {
+                    "total": {"sum": {"field": "price"}},
+                    "keep": {"bucket_selector": {
+                        "buckets_path": {"t": "total"}, "script": "params.t > 350",
+                    }},
+                },
+            },
+        }, size=0)
+        buckets = res["aggregations"]["kinds"]["buckets"]
+        assert set(buckets) == {"kb"}  # a=300, b=600 -> only kb kept, name intact
+
+
+class TestMultiIndexSortedMergeMissing:
+    def test_missing_sort_value_does_not_crash(self):
+        e = Engine()
+        try:
+            a = e.create_index("ma", {"properties": {"n": {"type": "long"}}})
+            b = e.create_index("mb", {"properties": {"n": {"type": "long"}}})
+            a.index_doc("1", {"n": 5})
+            b.index_doc("2", {})  # missing sort field
+            a.refresh(); b.refresh()
+            res = e.search_multi("ma,mb", query=None, sort=[{"n": "asc"}])
+            ids = [h["_id"] for h in res["hits"]["hits"]]
+            assert ids == ["1", "2"]  # missing sorts last
+        finally:
+            e.close()
