@@ -447,6 +447,59 @@ class EsIndex:
         self._maybe_refresh()
         return self.searcher.count(query)
 
+    def explain(self, doc_id: str, query=None) -> dict:
+        """Score breakdown for one document (reference behavior:
+        action/explain/TransportExplainAction.java — runs the query against
+        the single shard holding the doc and renders Explanation). The TPU
+        path re-scores with the query filtered to the doc id; per-clause
+        detail comes from scoring each top-level clause the same way."""
+        if self.get_doc(doc_id) is None:
+            raise DocumentMissingError(f"[{doc_id}]: document missing", index=self.name)
+        self._maybe_refresh()
+        from ..query.dsl import parse_query
+
+        def score_of(q):
+            wrapped = {
+                "bool": {
+                    "must": [q if q is not None else {"match_all": {}}],
+                    "filter": [{"ids": {"values": [doc_id]}}],
+                }
+            }
+            res = self.searcher.search(parse_query(wrapped, self.mappings), size=1)
+            if res.total == 0:
+                return None
+            return float(res.scores[0])
+
+        top = score_of(query)
+        if top is None:
+            return {
+                "_id": doc_id, "matched": False,
+                "explanation": {"value": 0.0, "description": "no matching term", "details": []},
+            }
+        details = []
+        # per-clause detail for bool queries: score each scoring clause alone
+        if isinstance(query, dict) and "bool" in query:
+            b = query["bool"]
+            clauses = (b.get("must") or []) + (b.get("should") or [])
+            if not isinstance(clauses, list):
+                clauses = [clauses]
+            for c in clauses:
+                s = score_of(c)
+                if s is not None:
+                    details.append({
+                        "value": s,
+                        "description": f"clause {json.dumps(c, separators=(',', ':'))[:120]}",
+                        "details": [],
+                    })
+        return {
+            "_id": doc_id, "matched": True,
+            "explanation": {
+                "value": top,
+                "description": "sum of:" if details else "score, computed from query",
+                "details": details,
+            },
+        }
+
     def close(self):
         if self._wal is not None:
             self._wal.close()
@@ -461,10 +514,13 @@ class Engine:
         from ..cluster.metadata import MetadataStore
         from ..ingest import IngestService
 
+        from .contexts import ContextRegistry
+
         self.data_path = data_path
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
         self.meta = MetadataStore(data_path)
+        self.contexts = ContextRegistry()
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):
@@ -719,6 +775,131 @@ class Engine:
                 "max_score": max(max_scores) if max_scores else None,
                 "hits": all_hits[from_:from_ + size],
             },
+        }
+
+    # ---- scroll / point-in-time ------------------------------------------
+
+    def _pins_for(self, expression) -> list:
+        from .contexts import _Pin
+
+        pins = []
+        for idx, _ in self.resolve_search(expression):
+            idx._maybe_refresh()
+            pins.append(_Pin(idx.name, idx.searcher, idx.shard_docs))
+        return pins
+
+    def open_pit(self, expression, keep_alive) -> str:
+        """POST /{index}/_pit (reference: TransportOpenPointInTimeAction —
+        opens reader contexts on every shard and returns a composite id)."""
+        from .contexts import encode_pit_id
+
+        ctx = self.contexts.open(self._pins_for(expression), keep_alive)
+        return encode_pit_id(ctx.id)
+
+    def close_pit(self, pit_id: str) -> bool:
+        from .contexts import decode_pit_id
+
+        return self.contexts.close(decode_pit_id(pit_id))
+
+    def search_pit(self, pit_id: str, keep_alive=None, **kwargs):
+        from .contexts import decode_pit_id, pinned
+
+        ctx = self.contexts.get(decode_pit_id(pit_id), keep_alive)
+        expression = ",".join(p.index_name for p in ctx.pins)
+        with pinned(self, ctx):
+            res = self.search_multi(expression, **kwargs)
+        res["pit_id"] = pit_id
+        return res
+
+    def scroll_search(self, expression, scroll, **kwargs):
+        """Initial ?scroll= search: pins the snapshot, returns page 1 and a
+        scroll id (reference behavior: scroll reader contexts in
+        SearchService; continuation via TransportSearchScrollAction)."""
+        from .contexts import pinned
+
+        pins = self._pins_for(expression)
+        request = dict(kwargs)
+        ctx = self.contexts.open(pins, scroll, request=request)
+        with pinned(self, ctx):
+            res = self.search_multi(expression, **kwargs)
+        ctx.cursor = int(kwargs.get("from_") or 0) + len(res["hits"]["hits"])
+        res["_scroll_id"] = ctx.id
+        return res
+
+    def continue_scroll(self, scroll_id: str, scroll=None):
+        from .contexts import pinned
+
+        ctx = self.contexts.get(scroll_id, scroll)
+        kwargs = dict(ctx.request or {})
+        kwargs["from_"] = ctx.cursor
+        expression = ",".join(p.index_name for p in ctx.pins)
+        with pinned(self, ctx):
+            res = self.search_multi(expression, **kwargs)
+        ctx.cursor += len(res["hits"]["hits"])
+        res["_scroll_id"] = ctx.id
+        return res
+
+    def clear_scroll(self, scroll_ids) -> int:
+        if scroll_ids in ("_all", None):
+            return self.contexts.close_all()
+        if isinstance(scroll_ids, str):
+            scroll_ids = [scroll_ids]
+        return sum(1 for sid in scroll_ids if self.contexts.close(sid))
+
+    # ---- mget / field_caps ----------------------------------------------
+
+    def mget(self, items: list[tuple[str, str]]) -> list[dict]:
+        """items: [(index, id)] -> ES mget doc envelopes (realtime, like
+        TransportShardMultiGetAction over the version map)."""
+        out = []
+        for index_name, doc_id in items:
+            try:
+                idx = self.get_index(self.resolve_write_index(index_name))
+            except (IndexNotFoundError, IllegalArgumentError) as ex:
+                out.append({
+                    "_index": index_name, "_id": doc_id,
+                    "error": {"type": ex.type, "reason": ex.reason},
+                })
+                continue
+            got = idx.get_doc(doc_id)
+            if got is None:
+                out.append({"_index": idx.name, "_id": doc_id, "found": False})
+            else:
+                out.append({"_index": idx.name, "found": True, **got})
+        return out
+
+    def field_caps(self, expression, fields="*") -> dict:
+        """Union field schema over resolved indices (reference behavior:
+        action/fieldcaps/TransportFieldCapabilitiesAction.java:68 — merge of
+        per-index FieldCapabilitiesIndexResponses)."""
+        import fnmatch as _fn
+
+        targets = self.resolve_search(expression)
+        pats = fields.split(",") if isinstance(fields, str) else list(fields)
+        caps: dict[str, dict[str, dict]] = {}
+        per_type_indices: dict[tuple[str, str], list[str]] = {}
+        for idx, _ in targets:
+            for name, ft in idx.mappings.fields.items():
+                if not any(_fn.fnmatchcase(name, p) for p in pats):
+                    continue
+                searchable = bool(ft.index)
+                aggregatable = bool(ft.doc_values) and ft.type != "text"
+                caps.setdefault(name, {}).setdefault(ft.type, {
+                    "type": ft.type,
+                    "metadata_field": False,
+                    "searchable": searchable,
+                    "aggregatable": aggregatable,
+                })
+                per_type_indices.setdefault((name, ft.type), []).append(idx.name)
+        # a field mapped to >1 type across indices lists which indices hold
+        # each type, like the reference response
+        for name, by_type in caps.items():
+            if len(by_type) > 1:
+                for t, body in by_type.items():
+                    body["indices"] = sorted(per_type_indices[(name, t)])
+        return {
+            "indices": [i.name for i, _ in targets],
+            "fields": caps,
         }
 
     def count_multi(self, expression, query=None, **res_kw) -> int:

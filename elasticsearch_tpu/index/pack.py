@@ -210,10 +210,15 @@ class PackBuilder:
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
         self.num_docs = 0
 
-    def add_document(self, parsed: dict[str, list]) -> int:
-        """parsed = Mappings.parse_document output; returns local docid."""
+    def add_document(self, parsed: dict[str, list], doc_id: str | None = None) -> int:
+        """parsed = Mappings.parse_document output; returns local docid.
+        doc_id, when given, is stored in the reserved `_id` ordinal column so
+        ids queries/sorts run on device (the reference indexes _id as a
+        keyword-like metadata field, index/mapper/IdFieldMapper.java)."""
         docid = self.num_docs
         self.num_docs += 1
+        if doc_id is not None:
+            self.docvalue_raw.setdefault("_id", []).append((docid, str(doc_id)))
         for fld, values in parsed.items():
             ft = self.mappings.fields.get(fld)
             if ft is None:
@@ -367,9 +372,9 @@ class PackBuilder:
         # ---- docvalues ---------------------------------------------------
         docvalues: dict[str, DocValuesColumn] = {}
         for fld, pairs in self.docvalue_raw.items():
-            ft = mappings.fields[fld]
+            ftype = "keyword" if fld == "_id" else mappings.fields[fld].type
             has = np.zeros(N, dtype=bool)
-            if ft.type in KEYWORD_TYPES:
+            if ftype in KEYWORD_TYPES:
                 terms_sorted = sorted({v for _, v in pairs})
                 ord_of = {t: i for i, t in enumerate(terms_sorted)}
                 vals = np.full(N, -1, dtype=np.int32)
@@ -378,7 +383,7 @@ class PackBuilder:
                         vals[docid] = ord_of[v]
                         has[docid] = True
                 docvalues[fld] = DocValuesColumn("ord", vals, has, terms_sorted)
-            elif ft.type in FLOAT_TYPES:
+            elif ftype in FLOAT_TYPES:
                 vals = np.zeros(N, dtype=np.float32)
                 for docid, v in pairs:
                     if not has[docid]:

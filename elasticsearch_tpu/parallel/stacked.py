@@ -287,8 +287,8 @@ def build_stacked_pack_routed(
 ) -> StackedPack:
     builders = [PackBuilder(mappings) for _ in range(len(routed))]
     for b, shard_docs in zip(builders, routed):
-        for _, source in shard_docs:
-            b.add_document(mappings.parse_document(source))
+        for doc_id, source in shard_docs:
+            b.add_document(mappings.parse_document(source), doc_id=doc_id)
     # per-shard dense tiers disabled: StackedPack builds its own global one
     # (global df decisions + global avgdl), so a local tier would only burn
     # build time and host RAM

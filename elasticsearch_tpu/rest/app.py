@@ -341,16 +341,30 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         aggs = body.get("aggs") or body.get("aggregations")
         sort = body.get("sort")
         search_after = body.get("search_after")
+        pit = body.get("pit")
+        scroll = query_params.get("scroll")
         import time
 
         t0 = time.monotonic()
-        res = await call(
-            engine.search_multi, expression,
-            ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
-            allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
+        kwargs = dict(
             query=query, size=size, from_=from_, aggs=aggs, knn=knn, sort=sort,
             search_after=search_after, script_fields=body.get("script_fields"),
         )
+        if pit is not None:
+            if not isinstance(pit, dict) or "id" not in pit:
+                raise IllegalArgumentError("[pit] must be an object with an [id]")
+            res = await call(
+                engine.search_pit, pit["id"], pit.get("keep_alive"), **kwargs
+            )
+        elif scroll:
+            res = await call(engine.scroll_search, expression, scroll, **kwargs)
+        else:
+            res = await call(
+                engine.search_multi, expression,
+                ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
+                allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
+                **kwargs,
+            )
         took = int((time.monotonic() - t0) * 1000)
         src_filter = body.get("_source")
         if src_filter is False:
@@ -410,6 +424,87 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response(
             {"count": n, "_shards": {"total": n_shards, "successful": n_shards, "skipped": 0, "failed": 0}}
         )
+
+    @handler
+    async def scroll_continue(request):
+        body = await body_json(request, {}) or {}
+        sid = body.get("scroll_id") or request.query.get("scroll_id") \
+            or request.match_info.get("scroll_id")
+        if not sid:
+            raise IllegalArgumentError("scroll_id is required")
+        scroll = body.get("scroll") or request.query.get("scroll")
+        res = await call(engine.continue_scroll, sid, scroll)
+        return web.json_response({"took": 0, "timed_out": False, **res})
+
+    @handler
+    async def scroll_clear(request):
+        sid = request.match_info.get("scroll_id")
+        if sid is None:
+            body = await body_json(request, {}) or {}
+            sid = body.get("scroll_id", "_all")
+        n = await call(engine.clear_scroll, sid)
+        return web.json_response({"succeeded": True, "num_freed": n})
+
+    @handler
+    async def open_pit(request):
+        keep_alive = request.query.get("keep_alive")
+        if not keep_alive:
+            raise IllegalArgumentError("[keep_alive] is required")
+        pit_id = await call(engine.open_pit, request.match_info["index"], keep_alive)
+        return web.json_response({"id": pit_id})
+
+    @handler
+    async def close_pit(request):
+        body = await body_json(request, {}) or {}
+        pit_id = body.get("id")
+        if not pit_id:
+            raise IllegalArgumentError("[id] is required")
+        found = await call(engine.close_pit, pit_id)
+        return web.json_response(
+            {"succeeded": found, "num_freed": 1 if found else 0},
+            status=200 if found else 404,
+        )
+
+    @handler
+    async def mget(request):
+        body = await body_json(request, {}) or {}
+        default_index = request.match_info.get("index")
+        items = []
+        if "docs" in body:
+            for d in body["docs"]:
+                name = d.get("_index", default_index)
+                if not name:
+                    raise IllegalArgumentError("mget doc missing _index")
+                if "_id" not in d:
+                    raise IllegalArgumentError("mget doc missing _id")
+                items.append((name, d["_id"]))
+        elif "ids" in body:
+            if not default_index:
+                raise IllegalArgumentError("ids form requires an index in the path")
+            items = [(default_index, i) for i in body["ids"]]
+        else:
+            raise IllegalArgumentError("unexpected content, expected [docs] or [ids]")
+        docs = await call(engine.mget, items)
+        return web.json_response({"docs": docs})
+
+    @handler
+    async def explain_doc(request):
+        body = await body_json(request, {}) or {}
+        q = body.get("query")
+        if q is None and request.query.get("q") is None:
+            raise IllegalArgumentError("query is missing")
+        idx = _concrete(request.match_info["index"])
+        res = await call(idx.explain, request.match_info["id"], q)
+        return web.json_response({"_index": idx.name, **res})
+
+    @handler
+    async def field_caps(request):
+        body = await body_json(request, {}) or {}
+        fields = request.query.get("fields") or body.get("fields") or "*"
+        res = await call(
+            engine.field_caps, request.match_info.get("index"), fields
+        )
+        return web.json_response(res)
 
     # ---- aliases ---------------------------------------------------------
 
@@ -644,7 +739,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_nodes/stats", nodes_stats)
     app.router.add_post("/_bulk", bulk)
     app.router.add_post("/_msearch", msearch)
+    app.router.add_post("/_search/scroll", scroll_continue)
+    app.router.add_get("/_search/scroll", scroll_continue)
+    app.router.add_delete("/_search/scroll", scroll_clear)
+    app.router.add_post("/_search/scroll/{scroll_id}", scroll_continue)
+    app.router.add_delete("/_search/scroll/{scroll_id}", scroll_clear)
     app.router.add_route("*", "/_search", search)
+    app.router.add_route("*", "/_count", count)
+    app.router.add_delete("/_pit", close_pit)
+    app.router.add_post("/_mget", mget)
+    app.router.add_get("/_mget", mget)
+    app.router.add_route("*", "/_field_caps", field_caps)
     app.router.add_post("/_refresh", refresh_index)
 
     app.router.add_put("/{index}", create_index)
@@ -678,6 +783,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/{index}/_alias", get_alias)
     app.router.add_get("/{index}/_alias/{alias}", get_alias, allow_head=False)
     app.router.add_head("/{index}/_alias/{alias}", head_alias)
+    app.router.add_post("/{index}/_mget", mget)
+    app.router.add_get("/{index}/_mget", mget)
+    app.router.add_route("*", "/{index}/_explain/{id}", explain_doc)
+    app.router.add_route("*", "/{index}/_field_caps", field_caps)
+    app.router.add_post("/{index}/_pit", open_pit)
 
     async def on_cleanup(app):
         app["pool"].shutdown(wait=True)

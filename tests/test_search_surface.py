@@ -1,0 +1,168 @@
+"""Scroll, PIT, mget, field_caps, explain, _count.
+
+Reference behavior: search/SearchService.java reader contexts (scroll +
+point-in-time keep-alives), TransportMultiGetAction (realtime mget),
+TransportFieldCapabilitiesAction (schema union), TransportExplainAction.
+"""
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.engine.contexts import SearchContextMissingError
+from elasticsearch_tpu.utils.errors import DocumentMissingError, IllegalArgumentError
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    idx = e.create_index("docs", {"properties": {
+        "body": {"type": "text"},
+        "n": {"type": "long"},
+        "tag": {"type": "keyword"},
+    }})
+    for i in range(25):
+        idx.index_doc(f"d{i}", {"body": f"word{'x' if i % 2 else 'y'} common",
+                                "n": i, "tag": f"t{i % 3}"})
+    idx.refresh()
+    yield e
+    e.close()
+
+
+class TestScroll:
+    def test_scroll_pages_through_everything(self, eng):
+        res = eng.scroll_search("docs", "1m", query={"match": {"body": "common"}},
+                                size=10, sort=[{"n": "asc"}])
+        sid = res["_scroll_id"]
+        seen = [h["_id"] for h in res["hits"]["hits"]]
+        assert len(seen) == 10
+        while True:
+            res = eng.continue_scroll(sid)
+            hits = res["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+            sid = res["_scroll_id"]
+        assert len(seen) == 25
+        assert len(set(seen)) == 25
+
+    def test_scroll_is_snapshot_isolated(self, eng):
+        res = eng.scroll_search("docs", "1m", query=None, size=5, sort=[{"n": "asc"}])
+        sid = res["_scroll_id"]
+        idx = eng.get_index("docs")
+        idx.index_doc("new", {"body": "common", "n": -100})
+        idx.refresh()
+        # scroll continues over the pinned snapshot: never sees the new doc
+        total = len(res["hits"]["hits"])
+        while True:
+            res = eng.continue_scroll(sid)
+            if not res["hits"]["hits"]:
+                break
+            assert all(h["_id"] != "new" for h in res["hits"]["hits"])
+            total += len(res["hits"]["hits"])
+        assert total == 25
+        # a fresh search sees it
+        assert eng.get_index("docs").count() == 26
+
+    def test_clear_scroll(self, eng):
+        res = eng.scroll_search("docs", "1m", query=None, size=5)
+        sid = res["_scroll_id"]
+        assert eng.clear_scroll(sid) == 1
+        with pytest.raises(SearchContextMissingError):
+            eng.continue_scroll(sid)
+
+    def test_expired_scroll_missing(self, eng):
+        res = eng.scroll_search("docs", "1ms", query=None, size=5)
+        import time
+
+        time.sleep(0.05)
+        with pytest.raises(SearchContextMissingError):
+            eng.continue_scroll(res["_scroll_id"])
+
+    def test_keep_alive_too_large(self, eng):
+        with pytest.raises(IllegalArgumentError, match="too large"):
+            eng.scroll_search("docs", "2d", query=None, size=5)
+
+
+class TestPit:
+    def test_pit_search_and_close(self, eng):
+        pit = eng.open_pit("docs", "1m")
+        res = eng.search_pit(pit, query={"match": {"body": "common"}}, size=3)
+        assert res["pit_id"] == pit
+        assert res["hits"]["total"]["value"] == 25
+        assert eng.close_pit(pit) is True
+        with pytest.raises(SearchContextMissingError):
+            eng.search_pit(pit, query=None)
+
+    def test_pit_snapshot_with_search_after(self, eng):
+        pit = eng.open_pit("docs", "1m")
+        idx = eng.get_index("docs")
+        idx.index_doc("late", {"body": "common", "n": 999})
+        idx.refresh()
+        seen = []
+        after = None
+        while True:
+            res = eng.search_pit(pit, query=None, size=10,
+                                 sort=[{"n": "asc"}], search_after=after)
+            hits = res["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_id"] for h in hits)
+            after = hits[-1]["sort"]
+        assert "late" not in seen
+        assert len(seen) == 25
+
+
+class TestMget:
+    def test_mget_mixed(self, eng):
+        docs = eng.mget([("docs", "d1"), ("docs", "nope"), ("ghost", "d1")])
+        assert docs[0]["found"] is True and docs[0]["_source"]["n"] == 1
+        assert docs[1]["found"] is False
+        assert docs[2]["error"]["type"] == "index_not_found_exception"
+
+
+class TestFieldCaps:
+    def test_union_across_indices(self, eng):
+        idx2 = eng.create_index("docs2", {"properties": {
+            "n": {"type": "double"}, "extra": {"type": "keyword"},
+        }})
+        idx2.refresh()
+        res = eng.field_caps("docs,docs2", "*")
+        assert set(res["indices"]) == {"docs", "docs2"}
+        assert set(res["fields"]["n"]) == {"long", "double"}
+        assert res["fields"]["n"]["long"]["indices"] == ["docs"]
+        assert res["fields"]["body"]["text"]["aggregatable"] is False
+        assert res["fields"]["tag"]["keyword"]["aggregatable"] is True
+
+    def test_field_filter(self, eng):
+        res = eng.field_caps("docs", "n,ta*")
+        assert set(res["fields"]) == {"n", "tag"}
+
+
+class TestExplain:
+    def test_explain_matching(self, eng):
+        idx = eng.get_index("docs")
+        r = idx.explain("d1", {"match": {"body": "wordx"}})
+        assert r["matched"] is True
+        assert r["explanation"]["value"] > 0
+        # score matches the search's score for the same doc
+        res = idx.search(query={"match": {"body": "wordx"}}, size=25)
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert r["explanation"]["value"] == pytest.approx(by_id["d1"], rel=1e-5)
+
+    def test_explain_non_matching(self, eng):
+        r = eng.get_index("docs").explain("d2", {"match": {"body": "wordx"}})
+        assert r["matched"] is False
+
+    def test_explain_missing_doc(self, eng):
+        with pytest.raises(DocumentMissingError):
+            eng.get_index("docs").explain("nope", {"match_all": {}})
+
+    def test_explain_bool_details(self, eng):
+        r = eng.get_index("docs").explain("d1", {"bool": {
+            "must": [{"match": {"body": "wordx"}}],
+            "should": [{"match": {"body": "common"}}],
+        }})
+        assert r["matched"] is True
+        assert len(r["explanation"]["details"]) == 2
+        total = sum(d["value"] for d in r["explanation"]["details"])
+        assert r["explanation"]["value"] == pytest.approx(total, rel=1e-5)
