@@ -366,14 +366,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 **kwargs,
             )
         took = int((time.monotonic() - t0) * 1000)
-        src_filter = body.get("_source")
-        if src_filter is False:
-            for h in res["hits"]["hits"]:
-                h.pop("_source", None)
-        elif isinstance(src_filter, (list, str)):
-            wanted = [src_filter] if isinstance(src_filter, str) else src_filter
-            for h in res["hits"]["hits"]:
-                h["_source"] = {k: v for k, v in h["_source"].items() if k in wanted}
+        from ..search import apply_fetch_phase
+
+        apply_fetch_phase(
+            res["hits"]["hits"], body,
+            lambda name: engine.get_index(name).mappings,
+        )
         n_shards = sum(
             i.num_shards for i, _ in engine.resolve_search(
                 expression, _bool_param(query_params, "ignore_unavailable"), True

@@ -1,0 +1,10 @@
+"""Search-layer host components: fetch sub-phases + highlighting.
+
+The reference splits shard search into query phase (top-k doc ids on
+device here) and fetch phase (loading `_source`, fields, highlights for the
+final hits — reference: search/fetch/FetchPhase.java + 20 sub-phases under
+search/fetch/subphase/). Fetch work is per-final-hit host-side string
+processing, so it stays off-device by design.
+"""
+
+from .fetch import apply_fetch_phase, filter_source  # noqa: F401

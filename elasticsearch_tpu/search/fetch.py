@@ -1,0 +1,189 @@
+"""Fetch sub-phases: _source filtering, fields, docvalue_fields, stored_fields.
+
+Reference behavior: search/fetch/subphase/FetchSourcePhase.java (_source
+includes/excludes with wildcards), FetchFieldsPhase.java (the `fields`
+option, mapped-type-aware flattened values), FetchDocValuesPhase.java
+(docvalue_fields), StoredFieldsPhase.java (`stored_fields`, `_none_`
+suppresses source loading).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+from ..utils.errors import IllegalArgumentError
+
+
+def _match_path(path: str, pattern: str) -> bool:
+    """ES source-filter matching: a bare object name selects its subtree."""
+    return (
+        fnmatch.fnmatchcase(path, pattern)
+        or fnmatch.fnmatchcase(path, pattern + ".*")
+    )
+
+
+class _Missing:
+    __slots__ = ()
+
+
+_MISSING = _Missing()
+
+
+def _filter_node(node, path: str, includes, excludes):
+    """Recursively filter a source node; returns the kept value or the
+    removal sentinel. An excluded path drops its whole subtree; an empty
+    filtered container is dropped (except the root)."""
+    if path and excludes and any(_match_path(path, p) for p in excludes):
+        return _MISSING
+    if isinstance(node, dict):
+        out = {}
+        for k, v in node.items():
+            kept = _filter_node(v, f"{path}.{k}" if path else k, includes, excludes)
+            if kept is not _MISSING:
+                out[k] = kept
+        if not path:
+            return out
+        return out if out else _MISSING
+    if isinstance(node, list):
+        out_l = []
+        for v in node:
+            kept = _filter_node(v, path, includes, excludes)
+            if kept is not _MISSING:
+                out_l.append(kept)
+        return out_l if out_l else _MISSING
+    return node if not includes or any(_match_path(path, p) for p in includes) else _MISSING
+
+
+def filter_source(src: dict, source_spec) -> dict | None:
+    """Apply a `_source` spec: True/False, "pat", ["p1","p2"],
+    {"includes": [...], "excludes": [...]}. Returns None when _source is
+    disabled entirely."""
+    if source_spec is None or source_spec is True:
+        return src
+    if source_spec is False:
+        return None
+    if isinstance(source_spec, str):
+        includes, excludes = [source_spec], []
+    elif isinstance(source_spec, list):
+        includes, excludes = [str(p) for p in source_spec], []
+    elif isinstance(source_spec, dict):
+        inc = source_spec.get("includes", source_spec.get("include"))
+        exc = source_spec.get("excludes", source_spec.get("exclude"))
+        includes = [inc] if isinstance(inc, str) else list(inc or [])
+        excludes = [exc] if isinstance(exc, str) else list(exc or [])
+    else:
+        raise IllegalArgumentError(f"unsupported _source spec {source_spec!r}")
+    out = _filter_node(src, "", includes, excludes)
+    return out if out is not _MISSING else {}
+
+
+def flatten_source(src: dict, prefix: str = "") -> dict[str, list]:
+    """Leaf values by dotted path (lists flattened), the value view the
+    `fields` option returns (reference behavior: FieldFetcher flattens
+    through objects and arrays)."""
+    out: dict[str, list] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{path}.{k}" if path else k)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v, path)
+        else:
+            out.setdefault(path, []).append(node)
+
+    walk(src, prefix)
+    return out
+
+
+def _norm_field_specs(specs) -> list[tuple[str, str | None]]:
+    out = []
+    for s in specs:
+        if isinstance(s, str):
+            out.append((s, None))
+        elif isinstance(s, dict) and "field" in s:
+            out.append((s["field"], s.get("format")))
+        else:
+            raise IllegalArgumentError(f"malformed field spec {s!r}")
+    return out
+
+
+def _format_date(v, fmt: str | None):
+    from ..index.mappings import parse_date_to_millis
+
+    if fmt == "epoch_millis":
+        try:
+            return parse_date_to_millis(v)
+        except Exception:
+            return v
+    return v
+
+
+def fields_option(hit_source: dict, specs, mappings) -> dict[str, list]:
+    """The search `fields` option: wildcard-capable flattened values."""
+    flat = flatten_source(hit_source or {})
+    out: dict[str, list] = {}
+    for pattern, fmt in _norm_field_specs(specs):
+        for path, values in flat.items():
+            if not fnmatch.fnmatchcase(path, pattern):
+                continue
+            ft = mappings.fields.get(path)
+            if ft is not None and ft.type == "date":
+                values = [_format_date(v, fmt) for v in values]
+            out.setdefault(path, []).extend(values)
+    return out
+
+
+def docvalue_fields_option(hit_source: dict, specs, mappings) -> dict[str, list]:
+    """docvalue_fields: only doc_values-enabled fields participate."""
+    flat = flatten_source(hit_source or {})
+    out: dict[str, list] = {}
+    for pattern, fmt in _norm_field_specs(specs):
+        for path, values in flat.items():
+            if not fnmatch.fnmatchcase(path, pattern):
+                continue
+            ft = mappings.fields.get(path)
+            if ft is None or not ft.doc_values or ft.type == "text":
+                continue
+            if ft.type == "date":
+                values = [_format_date(v, fmt or "epoch_millis") for v in values]
+            out.setdefault(path, []).extend(values)
+    return out
+
+
+def apply_fetch_phase(hits: list[dict], body: dict, mappings_of) -> None:
+    """Run the fetch sub-phases over final hits, in the reference's order:
+    stored_fields gate -> source filtering -> fields -> docvalue_fields ->
+    highlight. `mappings_of(index_name)` resolves per-index mappings."""
+    source_spec = body.get("_source")
+    fields = body.get("fields")
+    docvalue_fields = body.get("docvalue_fields")
+    stored_fields = body.get("stored_fields")
+    highlight = body.get("highlight")
+
+    suppress_source = stored_fields == "_none_" or (
+        isinstance(stored_fields, list) and "_none_" in stored_fields
+    )
+
+    for h in hits:
+        mappings = mappings_of(h["_index"])
+        src = h.get("_source")
+        if fields:
+            vals = fields_option(src, fields, mappings)
+            if vals:
+                h.setdefault("fields", {}).update(vals)
+        if docvalue_fields:
+            vals = docvalue_fields_option(src, docvalue_fields, mappings)
+            if vals:
+                h.setdefault("fields", {}).update(vals)
+        if highlight:
+            from .highlight import highlight_hit
+
+            hl = highlight_hit(src, highlight, body.get("query"), mappings)
+            if hl:
+                h["highlight"] = hl
+        if suppress_source or source_spec is False:
+            h.pop("_source", None)
+        elif source_spec is not None and source_spec is not True:
+            h["_source"] = filter_source(src or {}, source_spec)

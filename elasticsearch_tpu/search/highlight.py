@@ -1,0 +1,224 @@
+"""Unified-style highlighter: re-analyze stored text, mark query terms.
+
+Reference behavior: search/fetch/subphase/highlight/ — the unified
+highlighter (DefaultHighlighter.java wrapping Lucene's UnifiedHighlighter)
+re-analyzes the source text, finds query-term occurrences by offset, and
+emits up to `number_of_fragments` fragments of ~`fragment_size` chars with
+`pre_tags`/`post_tags` around matches, ordered by score when
+`order: "score"`. require_field_match (default true) restricts a field's
+highlights to terms the query addressed to that field.
+
+Host-side by design: highlighting touches only the final page of hits and
+is pure string work — the same reasoning that keeps it out of the scoring
+kernels keeps it off the TPU.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+from ..utils.errors import IllegalArgumentError
+
+_ALL_FIELDS = "*all*"
+
+
+def extract_query_terms(query, mappings) -> dict[str, set[str]]:
+    """Walk the raw query DSL and collect, per field, the analyzed terms the
+    query matches (the analog of Lucene Query.visit term extraction used by
+    the unified highlighter). `_ALL_FIELDS` collects terms whose target
+    field is dynamic (multi_match without concrete resolution)."""
+    terms: dict[str, set[str]] = {}
+
+    def add(fld, values):
+        terms.setdefault(fld, set()).update(values)
+
+    def analyze(fld, text):
+        ft = mappings.fields.get(fld)
+        if ft is None or ft.type not in ("text", "match_only_text", "search_as_you_type"):
+            return [str(text)]
+        return [t.term for t in ft.get_search_analyzer().analyze(str(text))]
+
+    def walk(q):
+        if not isinstance(q, dict) or not q:
+            return
+        (kind, body), = list(q.items())[:1] if len(q) == 1 else [(None, None)]
+        if kind is None:
+            return
+        if kind == "bool":
+            for sect in ("must", "should", "filter"):
+                clauses = body.get(sect) or []
+                if isinstance(clauses, dict):
+                    clauses = [clauses]
+                for c in clauses:
+                    walk(c)
+        elif kind in ("dis_max",):
+            for c in body.get("queries") or []:
+                walk(c)
+        elif kind == "constant_score":
+            walk(body.get("filter"))
+        elif kind == "function_score":
+            walk(body.get("query"))
+        elif kind in ("match", "match_phrase", "match_phrase_prefix"):
+            (fld, spec), = body.items()
+            text = spec.get("query") if isinstance(spec, dict) else spec
+            add(fld, analyze(fld, text))
+        elif kind == "multi_match":
+            text = body.get("query")
+            for f in body.get("fields") or []:
+                f = f.split("^")[0]
+                add(f, analyze(f, text))
+        elif kind == "term":
+            (fld, spec), = body.items()
+            v = spec.get("value") if isinstance(spec, dict) else spec
+            add(fld, [str(v)])
+        elif kind == "terms":
+            for fld, vals in body.items():
+                if fld in ("boost",):
+                    continue
+                if isinstance(vals, list):
+                    add(fld, [str(v) for v in vals])
+        elif kind in ("prefix", "wildcard", "fuzzy", "regexp"):
+            (fld, spec), = body.items()
+            v = spec.get("value") if isinstance(spec, dict) else spec
+            # represented as a wildcard pattern matched against doc tokens
+            pat = str(v).lower()
+            if kind == "prefix":
+                pat += "*"
+            elif kind == "fuzzy":
+                pat = pat  # exact-only approximation
+            elif kind == "regexp":
+                pat = None  # not expanded
+            if pat is not None:
+                terms.setdefault(fld, set()).add(("__pattern__", pat))
+
+    walk(query)
+    return terms
+
+
+def _token_matches(term: str, wanted: set) -> bool:
+    for w in wanted:
+        if isinstance(w, tuple):  # ("__pattern__", pat)
+            if fnmatch.fnmatchcase(term, w[1]):
+                return True
+        elif term == w:
+            return True
+    return False
+
+
+def _fragment_spans(text: str, matches: list[tuple[int, int]],
+                    fragment_size: int) -> list[tuple[int, int, list[tuple[int, int]]]]:
+    """Greedy windows: group match offsets into fragments of about
+    fragment_size chars. Returns (frag_start, frag_end, contained_matches)."""
+    frags = []
+    i = 0
+    while i < len(matches):
+        s0 = matches[i][0]
+        # window start: back up to give leading context, snapped to a space
+        start = max(0, s0 - max((fragment_size - (matches[i][1] - s0)) // 2, 0))
+        sp = text.rfind(" ", 0, start + 1)
+        if sp >= 0 and start > 0:
+            start = sp + 1
+        end = min(len(text), start + fragment_size)
+        group = []
+        while i < len(matches) and matches[i][1] <= end:
+            group.append(matches[i])
+            i += 1
+        if i < len(matches) and matches[i][0] < end:
+            end = matches[i][0]  # don't cut a match in half
+        else:
+            sp = text.find(" ", end)
+            if sp >= 0:
+                end = sp
+            else:
+                end = len(text)
+        frags.append((start, end, group))
+    return frags
+
+
+def _render(text: str, start: int, end: int, group, pre: str, post: str) -> str:
+    out = []
+    cur = start
+    for ms, me in group:
+        out.append(text[cur:ms])
+        out.append(pre)
+        out.append(text[ms:me])
+        out.append(post)
+        cur = me
+    out.append(text[cur:end])
+    return "".join(out)
+
+
+def highlight_field(text: str, wanted: set, ft, opts: dict) -> list[str]:
+    fragment_size = int(opts.get("fragment_size", 100))
+    number_of_fragments = int(opts.get("number_of_fragments", 5))
+    pre = (opts.get("pre_tags") or ["<em>"])[0]
+    post = (opts.get("post_tags") or ["</em>"])[0]
+    order = opts.get("order", "none")
+
+    analyzer = ft.get_analyzer() if ft is not None else None
+    if analyzer is None:
+        return []
+    matches = [
+        (t.start_offset, t.end_offset)
+        for t in analyzer.analyze(text)
+        if _token_matches(t.term, wanted)
+    ]
+    if not matches:
+        return []
+    if number_of_fragments == 0:
+        # whole field value as one fragment
+        return [_render(text, 0, len(text), matches, pre, post)]
+    frags = _fragment_spans(text, matches, fragment_size)
+    if order == "score":
+        frags.sort(key=lambda f: -len(f[2]))
+    frags = frags[:number_of_fragments]
+    return [_render(text, s, e, g, pre, post) for s, e, g in frags]
+
+
+def highlight_hit(source: dict, spec: dict, query, mappings) -> dict[str, list[str]]:
+    """-> {field: [fragments]} for one hit."""
+    if not isinstance(spec, dict) or "fields" not in spec:
+        raise IllegalArgumentError("[highlight] requires [fields]")
+    from .fetch import flatten_source
+
+    fields_spec = spec["fields"]
+    if isinstance(fields_spec, list):  # explicit-order array form
+        merged = {}
+        for entry in fields_spec:
+            merged.update(entry)
+        fields_spec = merged
+    query_terms = extract_query_terms(query, mappings)
+    require_field_match = spec.get("require_field_match", True)
+    flat = flatten_source(source or {})
+    out: dict[str, list[str]] = {}
+    global_opts = {k: v for k, v in spec.items() if k != "fields"}
+    for pattern, f_opts in fields_spec.items():
+        opts = {**global_opts, **(f_opts or {})}
+        hl_query = opts.get("highlight_query")
+        if hl_query is not None:
+            local_terms = extract_query_terms(hl_query, mappings)
+        else:
+            local_terms = query_terms
+        for path, values in flat.items():
+            if not fnmatch.fnmatchcase(path, pattern):
+                continue
+            ft = mappings.fields.get(path)
+            if ft is None or ft.type not in ("text", "match_only_text", "keyword"):
+                continue
+            if opts.get("require_field_match", require_field_match):
+                wanted = local_terms.get(path, set())
+            else:
+                wanted = set().union(*local_terms.values()) if local_terms else set()
+            if not wanted:
+                continue
+            frags: list[str] = []
+            for v in values:
+                if not isinstance(v, str):
+                    continue
+                frags.extend(highlight_field(v, wanted, ft, opts))
+            if frags:
+                n = int(opts.get("number_of_fragments", 5))
+                if n > 0:
+                    frags = frags[:n]
+                out[path] = frags
+    return out

@@ -1,0 +1,182 @@
+"""Fetch sub-phases: _source filtering, fields, docvalue_fields, highlight.
+
+Reference behavior: search/fetch/subphase/FetchSourcePhase.java,
+FetchFieldsPhase.java, FetchDocValuesPhase.java, highlight/ (unified
+highlighter fragmenting + pre/post tags + require_field_match).
+"""
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.search.fetch import (
+    apply_fetch_phase,
+    docvalue_fields_option,
+    fields_option,
+    filter_source,
+)
+from elasticsearch_tpu.search.highlight import extract_query_terms, highlight_hit
+
+SRC = {
+    "title": "quick brown fox",
+    "meta": {"author": "jane", "year": 2024, "tags": ["a", "b"]},
+    "stats": {"views": 10, "likes": 3},
+    "date": "2024-03-05T12:00:00Z",
+}
+
+MAPPINGS = Mappings({"properties": {
+    "title": {"type": "text"},
+    "meta": {"properties": {
+        "author": {"type": "keyword"},
+        "year": {"type": "long"},
+        "tags": {"type": "keyword"},
+    }},
+    "stats": {"properties": {
+        "views": {"type": "long"}, "likes": {"type": "long"},
+    }},
+    "date": {"type": "date"},
+}})
+
+
+class TestSourceFiltering:
+    def test_true_false(self):
+        assert filter_source(SRC, True) is SRC
+        assert filter_source(SRC, False) is None
+
+    def test_include_list(self):
+        out = filter_source(SRC, ["title", "meta.author"])
+        assert out == {"title": "quick brown fox", "meta": {"author": "jane"}}
+
+    def test_include_object_selects_subtree(self):
+        out = filter_source(SRC, "meta")
+        assert out == {"meta": SRC["meta"]}
+
+    def test_wildcard_include(self):
+        out = filter_source(SRC, "stats.*")
+        assert out == {"stats": {"views": 10, "likes": 3}}
+
+    def test_excludes(self):
+        out = filter_source(SRC, {"excludes": ["meta.tags", "stats"]})
+        assert out == {
+            "title": "quick brown fox",
+            "meta": {"author": "jane", "year": 2024},
+            "date": "2024-03-05T12:00:00Z",
+        }
+
+    def test_include_and_exclude(self):
+        out = filter_source(SRC, {"includes": ["meta.*"], "excludes": ["meta.year"]})
+        assert out == {"meta": {"author": "jane", "tags": ["a", "b"]}}
+
+    def test_exclude_subtree_by_name(self):
+        out = filter_source(SRC, {"excludes": ["meta"]})
+        assert "meta" not in out and "title" in out
+
+
+class TestFieldsOption:
+    def test_flatten_and_wildcard(self):
+        out = fields_option(SRC, ["meta.*"], MAPPINGS)
+        assert out["meta.author"] == ["jane"]
+        assert out["meta.tags"] == ["a", "b"]
+
+    def test_date_epoch_format(self):
+        out = fields_option(SRC, [{"field": "date", "format": "epoch_millis"}], MAPPINGS)
+        assert out["date"] == [1709640000000]
+
+    def test_docvalue_fields_skip_text(self):
+        out = docvalue_fields_option(SRC, ["title", "meta.author"], MAPPINGS)
+        assert "title" not in out
+        assert out["meta.author"] == ["jane"]
+
+
+class TestTermExtraction:
+    def test_match_analyzed(self):
+        t = extract_query_terms({"match": {"title": "Quick FOX"}}, MAPPINGS)
+        assert t["title"] == {"quick", "fox"}
+
+    def test_bool_and_term(self):
+        t = extract_query_terms({"bool": {
+            "must": [{"match": {"title": "brown"}}],
+            "filter": [{"term": {"meta.author": "jane"}}],
+        }}, MAPPINGS)
+        assert t["title"] == {"brown"}
+        assert t["meta.author"] == {"jane"}
+
+    def test_prefix_pattern(self):
+        t = extract_query_terms({"prefix": {"title": {"value": "qui"}}}, MAPPINGS)
+        assert ("__pattern__", "qui*") in t["title"]
+
+
+class TestHighlight:
+    def test_basic_fragments(self):
+        hl = highlight_hit(SRC, {"fields": {"title": {}}},
+                           {"match": {"title": "fox"}}, MAPPINGS)
+        assert hl["title"] == ["quick brown <em>fox</em>"]
+
+    def test_custom_tags(self):
+        hl = highlight_hit(SRC, {"fields": {"title": {}},
+                                 "pre_tags": ["<b>"], "post_tags": ["</b>"]},
+                           {"match": {"title": "quick"}}, MAPPINGS)
+        assert hl["title"] == ["<b>quick</b> brown fox"]
+
+    def test_require_field_match(self):
+        # query targets meta.author; title must not highlight
+        hl = highlight_hit(SRC, {"fields": {"title": {}}},
+                           {"term": {"meta.author": "jane"}}, MAPPINGS)
+        assert hl == {}
+        hl2 = highlight_hit(
+            SRC,
+            {"fields": {"title": {"require_field_match": False}}},
+            {"match": {"title": "jane quick"}}, MAPPINGS,
+        )
+        assert "title" in hl2
+
+    def test_fragmenting_long_text(self):
+        long_src = {"title": ("alpha " * 30) + "needle " + ("beta " * 30)
+                    + "needle tail"}
+        hl = highlight_hit(
+            long_src,
+            {"fields": {"title": {"fragment_size": 40, "number_of_fragments": 2}}},
+            {"match": {"title": "needle"}},
+            MAPPINGS,
+        )
+        frags = hl["title"]
+        assert 1 <= len(frags) <= 2
+        assert all("<em>needle</em>" in f for f in frags)
+        assert all(len(f) < 80 for f in frags)
+
+    def test_number_of_fragments_zero_whole_field(self):
+        hl = highlight_hit(SRC, {"fields": {"title": {"number_of_fragments": 0}}},
+                           {"match": {"title": "quick fox"}}, MAPPINGS)
+        assert hl["title"] == ["<em>quick</em> brown <em>fox</em>"]
+
+    def test_prefix_highlighting(self):
+        hl = highlight_hit(SRC, {"fields": {"title": {}}},
+                           {"prefix": {"title": {"value": "bro"}}}, MAPPINGS)
+        assert hl["title"] == ["quick <em>brown</em> fox"]
+
+
+class TestEndToEnd:
+    def test_search_with_fetch_phase(self):
+        e = Engine()
+        try:
+            idx = e.create_index("docs", {"properties": {
+                "body": {"type": "text"},
+                "tag": {"type": "keyword"},
+                "n": {"type": "long"},
+            }})
+            idx.index_doc("1", {"body": "the quick brown fox jumps", "tag": "x", "n": 7})
+            idx.refresh()
+            res = e.search_multi("docs", query={"match": {"body": "fox"}})
+            hits = res["hits"]["hits"]
+            apply_fetch_phase(hits, {
+                "_source": ["tag"],
+                "fields": ["n"],
+                "highlight": {"fields": {"body": {}}},
+                "query": {"match": {"body": "fox"}},
+            }, lambda name: e.get_index(name).mappings)
+            h = hits[0]
+            assert h["_source"] == {"tag": "x"}
+            assert h["fields"]["n"] == [7]
+            assert "<em>fox</em>" in h["highlight"]["body"][0]
+        finally:
+            e.close()
