@@ -846,6 +846,207 @@ class Engine:
             scroll_ids = [scroll_ids]
         return sum(1 for sid in scroll_ids if self.contexts.close(sid))
 
+    # ---- update / by-query ops / reindex ---------------------------------
+
+    def update_doc_api(self, index_name: str, doc_id: str, body: dict,
+                       pipeline: str | None = None) -> dict:
+        """POST /{index}/_update/{id}: doc merge, scripted update, upsert,
+        doc_as_upsert, detect_noop (reference behavior:
+        action/update/UpdateHelper.java prepare/prepareUpdateScriptRequest)."""
+        idx = self.get_or_autocreate(index_name)
+        e = idx.docs.get(doc_id)
+        exists = e is not None and e.alive
+        doc = body.get("doc")
+        script = body.get("script")
+        if doc is not None and script is not None:
+            raise IllegalArgumentError("can't provide both script and doc")
+        if doc is None and script is None:
+            raise IllegalArgumentError("script or doc is missing")
+        if not exists:
+            if body.get("doc_as_upsert") and doc is not None:
+                r = idx.index_doc(doc_id, dict(doc))
+                return {**r, "result": "created"}
+            upsert = body.get("upsert")
+            if upsert is None:
+                raise DocumentMissingError(f"[{doc_id}]: document missing",
+                                           index=idx.name)
+            if script is not None and body.get("scripted_upsert"):
+                from ..script.update import UpdateScript
+
+                src = dict(upsert)
+                op = UpdateScript(script).apply(src)
+                if op == "noop":
+                    return {"_id": doc_id, "result": "noop",
+                            "_version": 0, "_seq_no": -1}
+                if op == "delete":
+                    return {"_id": doc_id, "result": "noop",
+                            "_version": 0, "_seq_no": -1}
+                r = idx.index_doc(doc_id, src)
+            else:
+                r = idx.index_doc(doc_id, dict(upsert))
+            return {**r, "result": "created"}
+        if script is not None:
+            from ..script.update import UpdateScript
+
+            src = json.loads(json.dumps(e.source))
+            op = UpdateScript(script).apply(src)
+            if op == "noop":
+                return {"_id": doc_id, "result": "noop",
+                        "_version": e.version, "_seq_no": e.seq_no}
+            if op == "delete":
+                r = idx.delete_doc(doc_id)
+                return {**r, "result": "deleted"}
+            r = idx.index_doc(doc_id, src)
+            return r
+        merged = {**e.source, **doc}
+        if body.get("detect_noop", True) and merged == e.source:
+            return {"_id": doc_id, "result": "noop",
+                    "_version": e.version, "_seq_no": e.seq_no}
+        return idx.index_doc(doc_id, merged)
+
+    def _matching_ids(self, idx: EsIndex, query, alias_filter=None,
+                      max_docs=None) -> list[str]:
+        if alias_filter is not None:
+            query = ({"bool": {"filter": [alias_filter]}} if query is None
+                     else {"bool": {"must": [query], "filter": [alias_filter]}})
+        n = idx.count(query)
+        if n == 0:
+            return []
+        size = n if max_docs is None else min(n, max_docs)
+        res = idx.search(query=query, size=size)
+        return [h["_id"] for h in res["hits"]["hits"]]
+
+    def delete_by_query(self, expression, query=None, max_docs=None,
+                        refresh=False, **res_kw) -> dict:
+        """POST /{index}/_delete_by_query (reference behavior:
+        reindex module AbstractAsyncBulkByScrollAction over scroll+bulk)."""
+        t0 = time.monotonic()
+        deleted = 0
+        total = 0
+        for idx, alias_filter in self.resolve_search(expression, **res_kw):
+            remaining = None if max_docs is None else max_docs - deleted
+            if remaining is not None and remaining <= 0:
+                break
+            ids = self._matching_ids(idx, query, alias_filter, remaining)
+            total += len(ids)
+            for i in ids:
+                idx.delete_doc(i)
+                deleted += 1
+            if refresh and ids:
+                idx.refresh()
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False, "total": total, "deleted": deleted,
+            "batches": 1 if total else 0, "version_conflicts": 0,
+            "noops": 0, "failures": [],
+        }
+
+    def update_by_query(self, expression, query=None, script=None,
+                        max_docs=None, refresh=False, pipeline=None,
+                        **res_kw) -> dict:
+        """POST /{index}/_update_by_query: re-index matching docs, optionally
+        transformed by an update script and/or ingest pipeline."""
+        from ..script.update import UpdateScript
+
+        t0 = time.monotonic()
+        us = UpdateScript(script) if script is not None else None
+        updated = 0
+        noops = 0
+        deleted = 0
+        total = 0
+        for idx, alias_filter in self.resolve_search(expression, **res_kw):
+            remaining = None if max_docs is None else max_docs - (updated + noops)
+            if remaining is not None and remaining <= 0:
+                break
+            ids = self._matching_ids(idx, query, alias_filter, remaining)
+            total += len(ids)
+            for i in ids:
+                e = idx.docs[i]
+                src = json.loads(json.dumps(e.source))
+                op = "index"
+                if us is not None:
+                    op = us.apply(src)
+                if pipeline is not None:
+                    src = self.ingest.execute(pipeline, src, index=idx.name, doc_id=i)
+                    if src is None:
+                        op = "delete"
+                if op == "noop":
+                    noops += 1
+                    continue
+                if op == "delete":
+                    idx.delete_doc(i)
+                    deleted += 1
+                    continue
+                idx.index_doc(i, src)
+                updated += 1
+            if refresh and ids:
+                idx.refresh()
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False, "total": total, "updated": updated,
+            "deleted": deleted, "batches": 1 if total else 0,
+            "version_conflicts": 0, "noops": noops, "failures": [],
+        }
+
+    def reindex(self, body: dict) -> dict:
+        """POST /_reindex {source: {index, query?}, dest: {index, pipeline?,
+        op_type?}, script?, max_docs?} (reference: modules/reindex
+        TransportReindexAction — scroll source, bulk into dest)."""
+        from ..script.update import UpdateScript
+
+        t0 = time.monotonic()
+        source = body.get("source") or {}
+        dest = body.get("dest") or {}
+        if not source.get("index") or not dest.get("index"):
+            raise IllegalArgumentError("reindex requires source.index and dest.index")
+        max_docs = body.get("max_docs")
+        us = UpdateScript(body["script"]) if body.get("script") else None
+        op_type = dest.get("op_type", "index")
+        created = 0
+        updated = 0
+        noops = 0
+        total = 0
+        conflicts = 0
+        proceed_on_conflict = body.get("conflicts") == "proceed"
+        for idx, alias_filter in self.resolve_search(source["index"]):
+            remaining = None if max_docs is None else max_docs - total
+            if remaining is not None and remaining <= 0:
+                break
+            ids = self._matching_ids(idx, source.get("query"), alias_filter, remaining)
+            dst = self.get_or_autocreate(dest["index"])
+            for i in ids:
+                total += 1
+                src = json.loads(json.dumps(idx.docs[i].source))
+                if us is not None:
+                    op = us.apply(src)
+                    if op == "noop":
+                        noops += 1
+                        continue
+                if dest.get("pipeline"):
+                    src = self.ingest.execute(dest["pipeline"], src,
+                                              index=dst.name, doc_id=i)
+                    if src is None:
+                        noops += 1
+                        continue
+                try:
+                    r = dst.index_doc(i, src, op_type=op_type)
+                except VersionConflictError:
+                    if proceed_on_conflict:
+                        conflicts += 1
+                        continue
+                    raise
+                if r["result"] == "created":
+                    created += 1
+                else:
+                    updated += 1
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False, "total": total, "created": created,
+            "updated": updated, "deleted": 0, "batches": 1 if total else 0,
+            "version_conflicts": conflicts, "noops": noops,
+            "retries": {"bulk": 0, "search": 0}, "failures": [],
+        }
+
     # ---- mget / field_caps ----------------------------------------------
 
     def mget(self, items: list[tuple[str, str]]) -> list[dict]:

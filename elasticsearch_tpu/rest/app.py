@@ -231,15 +231,41 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def update_doc(request):
         name = request.match_info["index"]
         body = await body_json(request, {}) or {}
-        res = await call(
-            engine.bulk, [("update", name, request.match_info["id"], body)]
+        r = await call(
+            engine.update_doc_api, name, request.match_info["id"], body
         )
-        item = res["items"][0]["update"]
-        if "error" in item:
-            return web.json_response(
-                {"error": item["error"], "status": item["status"]}, status=item["status"]
-            )
-        return web.json_response(_doc_result(item, name))
+        status = 201 if r["result"] == "created" else 200
+        return web.json_response(_doc_result(r, engine.resolve_write_index(name)),
+                                 status=status)
+
+    @handler
+    async def update_by_query(request):
+        body = await body_json(request, {}) or {}
+        res = await call(
+            engine.update_by_query, request.match_info["index"],
+            query=body.get("query"), script=body.get("script"),
+            max_docs=body.get("max_docs"),
+            refresh=_bool_param(request.query, "refresh"),
+            pipeline=request.query.get("pipeline"),
+        )
+        return web.json_response(res)
+
+    @handler
+    async def delete_by_query(request):
+        body = await body_json(request, {}) or {}
+        if "query" not in body:
+            raise IllegalArgumentError("query is missing")
+        res = await call(
+            engine.delete_by_query, request.match_info["index"],
+            query=body.get("query"), max_docs=body.get("max_docs"),
+            refresh=_bool_param(request.query, "refresh"),
+        )
+        return web.json_response(res)
+
+    @handler
+    async def reindex(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(engine.reindex, body))
 
     # ---- bulk ------------------------------------------------------------
 
@@ -773,6 +799,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/{index}/_create/{id}", create_doc)
     app.router.add_get("/{index}/_source/{id}", get_source)
     app.router.add_post("/{index}/_update/{id}", update_doc)
+    app.router.add_post("/{index}/_update_by_query", update_by_query)
+    app.router.add_post("/{index}/_delete_by_query", delete_by_query)
+    app.router.add_post("/_reindex", reindex)
     app.router.add_put("/{index}/_alias/{alias}", put_alias)
     app.router.add_post("/{index}/_alias/{alias}", put_alias)
     app.router.add_put("/{index}/_aliases/{alias}", put_alias)

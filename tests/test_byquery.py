@@ -1,0 +1,148 @@
+"""_update (scripted/upsert), _update_by_query, _delete_by_query, _reindex.
+
+Reference behavior: action/update/UpdateHelper.java (doc merge, scripts,
+upserts, detect_noop), modules/reindex (scroll+bulk by-query actions).
+"""
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.script.update import UpdateScript
+from elasticsearch_tpu.utils.errors import (
+    DocumentMissingError,
+    IllegalArgumentError,
+)
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    idx = e.create_index("src", {"properties": {
+        "n": {"type": "long"}, "tag": {"type": "keyword"},
+        "body": {"type": "text"},
+    }})
+    for i in range(10):
+        idx.index_doc(f"d{i}", {"n": i, "tag": "even" if i % 2 == 0 else "odd",
+                                "body": f"doc number {i}"})
+    idx.refresh()
+    yield e
+    e.close()
+
+
+class TestUpdateScript:
+    def test_assign_and_compound(self):
+        s = UpdateScript({"source": "ctx._source.n += params.d", "params": {"d": 5}})
+        src = {"n": 3}
+        assert s.apply(src) == "index"
+        assert src["n"] == 8
+
+    def test_string_and_bool_literal(self):
+        s = UpdateScript("ctx._source.tag = 'fixed'; ctx._source.ok = true")
+        src = {}
+        s.apply(src)
+        assert src == {"tag": "fixed", "ok": True}
+
+    def test_remove_and_nested(self):
+        s = UpdateScript("ctx._source.remove('old'); ctx._source.a.b = 2")
+        src = {"old": 1}
+        s.apply(src)
+        assert src == {"a": {"b": 2}}
+
+    def test_ctx_op_and_rhs_reference(self):
+        s = UpdateScript("ctx._source.total = ctx._source.a + ctx._source.b")
+        src = {"a": 2, "b": 3}
+        s.apply(src)
+        assert src["total"] == 5
+        assert UpdateScript("ctx.op = 'noop'").apply({}) == "noop"
+        assert UpdateScript("ctx.op = 'delete'").apply({}) == "delete"
+
+    def test_bad_statement(self):
+        with pytest.raises(IllegalArgumentError):
+            UpdateScript("for (x in y) {}").apply({})
+
+
+class TestUpdateApi:
+    def test_doc_merge_and_noop(self, eng):
+        r = eng.update_doc_api("src", "d1", {"doc": {"tag": "changed"}})
+        assert r["result"] == "updated"
+        r = eng.update_doc_api("src", "d1", {"doc": {"tag": "changed"}})
+        assert r["result"] == "noop"
+        r = eng.update_doc_api("src", "d1", {"doc": {"tag": "changed"},
+                                             "detect_noop": False})
+        assert r["result"] == "updated"
+
+    def test_scripted_update(self, eng):
+        eng.update_doc_api("src", "d2", {"script": {
+            "source": "ctx._source.n += params.x", "params": {"x": 100}}})
+        assert eng.get_index("src").get_doc("d2")["_source"]["n"] == 102
+
+    def test_script_delete(self, eng):
+        r = eng.update_doc_api("src", "d3", {"script": "ctx.op = 'delete'"})
+        assert r["result"] == "deleted"
+        assert eng.get_index("src").get_doc("d3") is None
+
+    def test_upsert_paths(self, eng):
+        with pytest.raises(DocumentMissingError):
+            eng.update_doc_api("src", "new1", {"doc": {"n": 1}})
+        r = eng.update_doc_api("src", "new1", {"doc": {"n": 1}, "doc_as_upsert": True})
+        assert r["result"] == "created"
+        r = eng.update_doc_api("src", "new2", {"script": "ctx._source.n = 9",
+                                               "upsert": {"n": 0}})
+        assert r["result"] == "created"
+        assert eng.get_index("src").get_doc("new2")["_source"]["n"] == 0
+        r = eng.update_doc_api("src", "new3", {
+            "script": "ctx._source.n = 9", "upsert": {"n": 0},
+            "scripted_upsert": True})
+        assert eng.get_index("src").get_doc("new3")["_source"]["n"] == 9
+
+
+class TestByQuery:
+    def test_delete_by_query(self, eng):
+        res = eng.delete_by_query("src", {"term": {"tag": "odd"}}, refresh=True)
+        assert res["deleted"] == 5
+        assert eng.get_index("src").count() == 5
+
+    def test_update_by_query_with_script(self, eng):
+        res = eng.update_by_query("src", {"term": {"tag": "even"}},
+                                  script="ctx._source.n += 1000", refresh=True)
+        assert res["updated"] == 5
+        idx = eng.get_index("src")
+        assert idx.get_doc("d0")["_source"]["n"] == 1000
+        assert idx.get_doc("d1")["_source"]["n"] == 1  # untouched
+
+    def test_max_docs(self, eng):
+        res = eng.delete_by_query("src", {"match_all": {}}, max_docs=3)
+        assert res["deleted"] == 3
+
+
+class TestReindex:
+    def test_basic_reindex(self, eng):
+        res = eng.reindex({"source": {"index": "src"}, "dest": {"index": "dst"}})
+        assert res["created"] == 10
+        eng.get_index("dst").refresh()
+        assert eng.get_index("dst").count() == 10
+
+    def test_reindex_with_query_and_script(self, eng):
+        res = eng.reindex({
+            "source": {"index": "src", "query": {"term": {"tag": "even"}}},
+            "dest": {"index": "dst2"},
+            "script": "ctx._source.n *= 2",
+        })
+        assert res["created"] == 5
+        assert eng.get_index("dst2").get_doc("d4")["_source"]["n"] == 8
+
+    def test_reindex_op_type_create_conflicts(self, eng):
+        eng.reindex({"source": {"index": "src"}, "dest": {"index": "dst3"}})
+        # second run with op_type create: all conflict; proceed counts them
+        res = eng.reindex({
+            "source": {"index": "src"},
+            "dest": {"index": "dst3", "op_type": "create"},
+            "conflicts": "proceed",
+        })
+        assert res["version_conflicts"] == 10
+        assert res["created"] == 0
+
+    def test_reindex_max_docs(self, eng):
+        res = eng.reindex({"source": {"index": "src"},
+                           "dest": {"index": "dst4"}, "max_docs": 4})
+        assert res["created"] == 4
