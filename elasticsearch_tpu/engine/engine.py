@@ -521,6 +521,9 @@ class Engine:
         self.ingest = IngestService()
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
+        from ..snapshots import SnapshotService
+
+        self.snapshots = SnapshotService(self)
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):

@@ -669,6 +669,68 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             "aliases": composed.get("aliases", {}),
         }, "overlapping": []})
 
+    # ---- snapshots -------------------------------------------------------
+
+    @handler
+    async def put_repository(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(
+            await call(engine.snapshots.put_repository,
+                       request.match_info["repo"], body)
+        )
+
+    @handler
+    async def get_repository(request):
+        return web.json_response(
+            engine.snapshots.get_repository(request.match_info.get("repo"))
+        )
+
+    @handler
+    async def delete_repository(request):
+        return web.json_response(
+            await call(engine.snapshots.delete_repository, request.match_info["repo"])
+        )
+
+    @handler
+    async def create_snapshot(request):
+        body = await body_json(request, {}) or {}
+        res = await call(
+            engine.snapshots.create_snapshot,
+            request.match_info["repo"], request.match_info["snap"],
+            body.get("indices", "*"), body.get("include_global_state", True),
+        )
+        return web.json_response({"snapshot": res})
+
+    @handler
+    async def get_snapshot(request):
+        res = await call(
+            engine.snapshots.get_snapshots,
+            request.match_info["repo"], request.match_info["snap"],
+        )
+        return web.json_response({"snapshots": res})
+
+    @handler
+    async def delete_snapshot(request):
+        return web.json_response(
+            await call(engine.snapshots.delete_snapshot,
+                       request.match_info["repo"], request.match_info["snap"])
+        )
+
+    @handler
+    async def restore_snapshot(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(
+            await call(engine.snapshots.restore_snapshot,
+                       request.match_info["repo"], request.match_info["snap"], body)
+        )
+
+    @handler
+    async def snapshot_status(request):
+        return web.json_response(
+            await call(engine.snapshots.status,
+                       request.match_info["repo"], request.match_info["snap"])
+        )
+
     # ---- cluster / cat ---------------------------------------------------
 
     @handler
@@ -743,6 +805,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     app.router.add_post("/_ingest/pipeline/_simulate", simulate_pipeline)
     app.router.add_get("/_cluster/health", cluster_health)
+    app.router.add_put("/_snapshot/{repo}", put_repository)
+    app.router.add_post("/_snapshot/{repo}", put_repository)
+    app.router.add_get("/_snapshot", get_repository)
+    app.router.add_get("/_snapshot/{repo}", get_repository)
+    app.router.add_delete("/_snapshot/{repo}", delete_repository)
+    app.router.add_put("/_snapshot/{repo}/{snap}", create_snapshot)
+    app.router.add_post("/_snapshot/{repo}/{snap}", create_snapshot)
+    app.router.add_get("/_snapshot/{repo}/{snap}", get_snapshot)
+    app.router.add_delete("/_snapshot/{repo}/{snap}", delete_snapshot)
+    app.router.add_post("/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
+    app.router.add_get("/_snapshot/{repo}/{snap}/_status", snapshot_status)
     app.router.add_post("/_aliases", post_aliases)
     app.router.add_get("/_alias", get_alias)
     app.router.add_get("/_alias/{alias}", get_alias, allow_head=False)

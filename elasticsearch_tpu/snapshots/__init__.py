@@ -1,0 +1,9 @@
+"""Snapshot/restore over content-addressed blob repositories.
+
+Reference: snapshots/SnapshotsService.java:138 (cluster-state driven
+orchestration), repositories/blobstore/BlobStoreRepository.java:174
+(incremental content-addressed blob layout), snapshots/RestoreService.java.
+"""
+
+from .repository import FsRepository, Repository  # noqa: F401
+from .service import SnapshotService  # noqa: F401

@@ -1,0 +1,166 @@
+"""Blob repositories: content-addressed storage with incremental reuse.
+
+Layout mirrors the reference's BlobStoreRepository
+(repositories/blobstore/BlobStoreRepository.java:174 — a root `index-N`
+generation file listing snapshots, per-snapshot metadata blobs, and
+content-addressed data blobs that later snapshots reuse when unchanged;
+package javadoc documents the scheme):
+
+    root/
+      index-<N>          repository generation: snapshot list (JSON)
+      snap-<name>.json   per-snapshot metadata (indices, chunk refs, state)
+      blobs/<sha256>     immutable doc-chunk blobs (zlib JSON), shared
+                         across snapshots — incrementality falls out of
+                         content addressing
+
+The reference snapshots Lucene segment files; the TPU engine's durable unit
+is the doc set (packs are derived data rebuilt on refresh), so chunks are
+sorted runs of (id, source, version, seq_no) — unchanged runs hash
+identically and cost nothing in later snapshots.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import zlib
+
+from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
+
+
+class RepositoryMissingError(ElasticsearchTpuError):
+    status = 404
+    type = "repository_missing_exception"
+
+
+class SnapshotMissingError(ElasticsearchTpuError):
+    status = 404
+    type = "snapshot_missing_exception"
+
+
+class InvalidSnapshotNameError(ElasticsearchTpuError):
+    status = 400
+    type = "invalid_snapshot_name_exception"
+
+
+CHUNK_DOCS = 1024
+
+
+class Repository:
+    """Abstract blob container API (the reference's BlobContainer)."""
+
+    def read(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, name: str, data: bytes):
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, name: str):
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+    # ---- repository-generation helpers ----------------------------------
+
+    def _gen(self) -> int:
+        gens = [int(n.split("-", 1)[1]) for n in self.list("index-")
+                if re.fullmatch(r"index-\d+", n)]
+        return max(gens, default=-1)
+
+    def load_root(self) -> dict:
+        g = self._gen()
+        if g < 0:
+            return {"gen": -1, "snapshots": []}
+        return {"gen": g, **json.loads(self.read(f"index-{g}"))}
+
+    def store_root(self, root: dict):
+        g = root.get("gen", -1) + 1
+        body = {"snapshots": root["snapshots"]}
+        self.write(f"index-{g}", json.dumps(body).encode())
+        old = f"index-{g - 1}"
+        if g > 0 and self.exists(old):
+            self.delete(old)
+
+    # ---- content-addressed blobs ----------------------------------------
+
+    def put_blob(self, payload: bytes) -> str:
+        digest = hashlib.sha256(payload).hexdigest()
+        name = f"blobs/{digest}"
+        if not self.exists(name):
+            self.write(name, zlib.compress(payload, 6))
+        return digest
+
+    def get_blob(self, digest: str) -> bytes:
+        return zlib.decompress(self.read(f"blobs/{digest}"))
+
+
+class FsRepository(Repository):
+    """Shared-filesystem repository (reference: fs type,
+    repositories/fs/FsRepository.java)."""
+
+    def __init__(self, location: str):
+        if not location:
+            raise IllegalArgumentError("[location] is required for fs repositories")
+        self.location = location
+        os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        p = os.path.normpath(os.path.join(self.location, name))
+        if not p.startswith(os.path.normpath(self.location)):
+            raise IllegalArgumentError(f"invalid blob name [{name}]")
+        return p
+
+    def read(self, name: str) -> bytes:
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise SnapshotMissingError(f"blob [{name}] missing")
+
+    def write(self, name: str, data: bytes):
+        p = self._path(name)
+        tmp = p + ".part"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def delete(self, name: str):
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> list[str]:
+        base = self.location
+        out = []
+        for root, _, files in os.walk(base):
+            for f in files:
+                rel = os.path.relpath(os.path.join(root, f), base)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return out
+
+
+def chunk_docs(docs: list[dict]) -> list[bytes]:
+    """Deterministic chunking: docs sorted by id, fixed-size runs. A doc
+    set that didn't change between snapshots produces identical chunk bytes
+    -> identical hashes -> zero new data blobs."""
+    docs = sorted(docs, key=lambda d: d["id"])
+    out = []
+    for off in range(0, len(docs), CHUNK_DOCS):
+        payload = json.dumps(docs[off:off + CHUNK_DOCS],
+                             separators=(",", ":"), sort_keys=True).encode()
+        out.append(payload)
+    return out

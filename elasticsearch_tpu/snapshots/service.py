@@ -1,0 +1,271 @@
+"""SnapshotService: create/get/delete/restore snapshots over repositories.
+
+Reference behavior: snapshots/SnapshotsService.java:138 (create/delete
+orchestration, in-progress state), snapshots/SnapshotShardsService.java:71
+(per-shard data capture), snapshots/RestoreService.java (restore into the
+routing table with rename support), repositories/RepositoriesService.java
+(registry of named repositories).
+
+Orchestration is synchronous here (one host owns the engine); the
+distributed variant rides the coordinator's cluster state like every other
+metadata change. Data capture is incremental via content addressing
+(repository.py) rather than Lucene file diffing — same contract, different
+storage unit.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import re
+import time
+
+from ..utils.errors import (
+    IllegalArgumentError,
+    IndexNotFoundError,
+    ResourceAlreadyExistsError,
+)
+from .repository import (
+    FsRepository,
+    InvalidSnapshotNameError,
+    Repository,
+    RepositoryMissingError,
+    SnapshotMissingError,
+    chunk_docs,
+)
+
+_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_.-]*$")
+
+
+class SnapshotService:
+    def __init__(self, engine):
+        self.engine = engine
+        self.repositories: dict[str, dict] = {}  # name -> {type, settings}
+        self._repos: dict[str, Repository] = {}
+
+    # ---- repositories ----------------------------------------------------
+
+    def put_repository(self, name: str, body: dict):
+        rtype = body.get("type")
+        settings = body.get("settings") or {}
+        if rtype != "fs":
+            raise IllegalArgumentError(
+                f"repository type [{rtype}] does not exist (supported: fs)"
+            )
+        repo = FsRepository(settings.get("location"))
+        self.repositories[name] = {"type": rtype, "settings": settings}
+        self._repos[name] = repo
+        return {"acknowledged": True}
+
+    def get_repository(self, name: str | None = None) -> dict:
+        if name in (None, "_all", "*"):
+            return dict(self.repositories)
+        if name not in self.repositories:
+            raise RepositoryMissingError(f"[{name}] missing")
+        return {name: self.repositories[name]}
+
+    def delete_repository(self, name: str):
+        if name not in self.repositories:
+            raise RepositoryMissingError(f"[{name}] missing")
+        del self.repositories[name]
+        del self._repos[name]
+        return {"acknowledged": True}
+
+    def _repo(self, name: str) -> Repository:
+        repo = self._repos.get(name)
+        if repo is None:
+            raise RepositoryMissingError(f"[{name}] missing")
+        return repo
+
+    # ---- snapshots -------------------------------------------------------
+
+    def create_snapshot(self, repo_name: str, snap_name: str,
+                        indices="*", include_global_state=True) -> dict:
+        repo = self._repo(repo_name)
+        if not _NAME_RE.match(snap_name or ""):
+            raise InvalidSnapshotNameError(
+                f"[{repo_name}:{snap_name}] Invalid snapshot name: must be lowercase"
+            )
+        root = repo.load_root()
+        if any(s["snapshot"] == snap_name for s in root["snapshots"]):
+            raise ResourceAlreadyExistsError(
+                f"[{repo_name}:{snap_name}] snapshot with the same name already exists"
+            )
+        t0 = time.time()
+        targets = self.engine.resolve_search(indices)
+        index_meta = {}
+        for idx, _ in targets:
+            docs = [
+                {"id": i, "source": e.source, "version": e.version, "seq_no": e.seq_no}
+                for i, e in sorted(idx.docs.items())
+                if e.alive
+            ]
+            chunks = [repo.put_blob(c) for c in chunk_docs(docs)]
+            index_meta[idx.name] = {
+                "mappings": idx.mappings.to_dict(),
+                "settings": idx.settings,
+                "doc_count": len(docs),
+                "chunks": chunks,
+                "aliases": self.engine.meta.aliases_of(idx.name),
+            }
+        snap = {
+            "snapshot": snap_name,
+            "uuid": f"{repo_name}-{snap_name}-{int(t0 * 1000)}",
+            "state": "SUCCESS",
+            "indices": index_meta,
+            "include_global_state": bool(include_global_state),
+            "global_state": (
+                {
+                    "index_templates": dict(self.engine.meta.index_templates),
+                    "component_templates": dict(self.engine.meta.component_templates),
+                    "ingest_pipelines": dict(self.engine.ingest.pipelines),
+                }
+                if include_global_state
+                else None
+            ),
+            "start_time_in_millis": int(t0 * 1000),
+            "end_time_in_millis": int(time.time() * 1000),
+            "version": "8.14.0-tpu",
+        }
+        repo.write(f"snap-{snap_name}.json", json.dumps(snap).encode())
+        root["snapshots"].append({"snapshot": snap_name, "state": "SUCCESS",
+                                  "indices": sorted(index_meta)})
+        repo.store_root(root)
+        return self._render(snap)
+
+    @staticmethod
+    def _render(snap: dict) -> dict:
+        n = sum(1 for _ in snap["indices"])
+        return {
+            "snapshot": snap["snapshot"],
+            "uuid": snap["uuid"],
+            "state": snap["state"],
+            "indices": sorted(snap["indices"]),
+            "include_global_state": snap["include_global_state"],
+            "start_time_in_millis": snap["start_time_in_millis"],
+            "end_time_in_millis": snap["end_time_in_millis"],
+            "duration_in_millis": snap["end_time_in_millis"] - snap["start_time_in_millis"],
+            "shards": {"total": n, "failed": 0, "successful": n},
+            "failures": [],
+        }
+
+    def _load_snap(self, repo: Repository, snap_name: str) -> dict:
+        if not repo.exists(f"snap-{snap_name}.json"):
+            raise SnapshotMissingError(f"[{snap_name}] is missing")
+        return json.loads(repo.read(f"snap-{snap_name}.json"))
+
+    def get_snapshots(self, repo_name: str, pattern: str = "_all") -> list[dict]:
+        repo = self._repo(repo_name)
+        root = repo.load_root()
+        names = [s["snapshot"] for s in root["snapshots"]]
+        if pattern not in ("_all", "*"):
+            wanted = pattern.split(",")
+            matched = [n for n in names
+                       if any(fnmatch.fnmatchcase(n, w) for w in wanted)]
+            if not matched and not any("*" in w or "?" in w for w in wanted):
+                raise SnapshotMissingError(f"[{pattern}] is missing")
+            names = matched
+        return [self._render(self._load_snap(repo, n)) for n in names]
+
+    def delete_snapshot(self, repo_name: str, snap_name: str):
+        repo = self._repo(repo_name)
+        snap = self._load_snap(repo, snap_name)
+        root = repo.load_root()
+        root["snapshots"] = [s for s in root["snapshots"]
+                             if s["snapshot"] != snap_name]
+        repo.store_root(root)
+        repo.delete(f"snap-{snap_name}.json")
+        # blob GC: drop chunks referenced by no remaining snapshot
+        # (the reference's stale-blob cleanup on delete,
+        # BlobStoreRepository cleanup of unreferenced blobs)
+        live: set[str] = set()
+        for s in root["snapshots"]:
+            meta = self._load_snap(repo, s["snapshot"])
+            for im in meta["indices"].values():
+                live.update(im["chunks"])
+        for digest in set(snap_chunks(snap)) - live:
+            repo.delete(f"blobs/{digest}")
+        return {"acknowledged": True}
+
+    # ---- restore ---------------------------------------------------------
+
+    def restore_snapshot(self, repo_name: str, snap_name: str,
+                         body: dict | None = None) -> dict:
+        body = body or {}
+        repo = self._repo(repo_name)
+        snap = self._load_snap(repo, snap_name)
+        indices = body.get("indices", "*")
+        if isinstance(indices, str):
+            indices = [p for p in indices.split(",") if p]
+        rename_pattern = body.get("rename_pattern")
+        rename_replacement = body.get("rename_replacement")
+        targets = [
+            n for n in snap["indices"]
+            if any(fnmatch.fnmatchcase(n, p) or n == p for p in indices)
+        ]
+        # concrete (non-wildcard) names must exist in the snapshot; an empty
+        # wildcard expansion is fine (reference: RestoreService index resolution)
+        for p in indices:
+            if "*" not in p and "?" not in p and p not in snap["indices"]:
+                raise IndexNotFoundError(p)
+        restored = []
+        for name in sorted(targets):
+            meta = snap["indices"][name]
+            new_name = name
+            if rename_pattern and rename_replacement is not None:
+                new_name = re.sub(rename_pattern, rename_replacement, name)
+            if new_name in self.engine.indices:
+                raise IllegalArgumentError(
+                    f"cannot restore index [{new_name}] because an open index with "
+                    "same name already exists in the cluster. Either close or delete "
+                    "the existing index or restore the index under a different name"
+                )
+            idx = self.engine.create_index(
+                new_name, meta["mappings"], dict(meta["settings"]),
+                aliases=meta.get("aliases") if body.get("include_aliases", True) else None,
+            )
+            for digest in meta["chunks"]:
+                for d in json.loads(repo.get_blob(digest)):
+                    idx.index_doc(d["id"], d["source"])
+            idx.refresh()
+            restored.append(new_name)
+        if body.get("include_global_state") and snap.get("global_state"):
+            gs = snap["global_state"]
+            self.engine.meta.index_templates.update(gs.get("index_templates", {}))
+            self.engine.meta.component_templates.update(gs.get("component_templates", {}))
+            self.engine.meta.save()
+            for pid, cfg in gs.get("ingest_pipelines", {}).items():
+                self.engine.ingest.put_pipeline(pid, cfg)
+        return {
+            "snapshot": {
+                "snapshot": snap_name,
+                "indices": restored,
+                "shards": {"total": len(restored), "failed": 0,
+                           "successful": len(restored)},
+            }
+        }
+
+    def status(self, repo_name: str, snap_name: str) -> dict:
+        repo = self._repo(repo_name)
+        snap = self._load_snap(repo, snap_name)
+        return {
+            "snapshots": [{
+                "snapshot": snap_name,
+                "repository": repo_name,
+                "state": snap["state"],
+                "indices": {
+                    n: {"shards_stats": {"done": 1, "failed": 0, "total": 1},
+                        "stats": {"total": {"file_count": len(m["chunks"]),
+                                            "size_in_bytes": 0}},
+                        "doc_count": m["doc_count"]}
+                    for n, m in snap["indices"].items()
+                },
+            }]
+        }
+
+
+def snap_chunks(snap: dict) -> list[str]:
+    out = []
+    for im in snap["indices"].values():
+        out.extend(im["chunks"])
+    return out

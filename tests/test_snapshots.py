@@ -1,0 +1,143 @@
+"""Snapshot/restore + content-addressed fs repository.
+
+Reference behavior: repositories/blobstore/BlobStoreRepository.java:174
+(incremental content-addressed layout, stale-blob GC on delete),
+snapshots/SnapshotsService.java / RestoreService.java (create / get /
+delete / restore with rename).
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.snapshots.repository import (
+    RepositoryMissingError,
+    SnapshotMissingError,
+)
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+)
+
+
+@pytest.fixture
+def eng(tmp_path):
+    e = Engine()
+    idx = e.create_index("books", {"properties": {
+        "title": {"type": "text"}, "n": {"type": "long"},
+    }})
+    for i in range(30):
+        idx.index_doc(f"b{i}", {"title": f"book {i}", "n": i})
+    idx.refresh()
+    e.snapshots.put_repository("repo1", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo1")},
+    })
+    yield e
+    e.close()
+
+
+def _blob_count(tmp_path):
+    d = tmp_path / "repo1" / "blobs"
+    return len(list(d.iterdir())) if d.exists() else 0
+
+
+class TestRepository:
+    def test_unknown_type_rejected(self, eng):
+        with pytest.raises(IllegalArgumentError, match="does not exist"):
+            eng.snapshots.put_repository("bad", {"type": "s3", "settings": {}})
+
+    def test_missing_repo(self, eng):
+        with pytest.raises(RepositoryMissingError):
+            eng.snapshots.create_snapshot("ghost", "s1")
+
+    def test_get_delete_repository(self, eng):
+        assert "repo1" in eng.snapshots.get_repository()
+        eng.snapshots.delete_repository("repo1")
+        with pytest.raises(RepositoryMissingError):
+            eng.snapshots.get_repository("repo1")
+
+
+class TestSnapshotLifecycle:
+    def test_create_get_delete(self, eng):
+        r = eng.snapshots.create_snapshot("repo1", "snap1")
+        assert r["state"] == "SUCCESS"
+        assert r["indices"] == ["books"]
+        got = eng.snapshots.get_snapshots("repo1", "snap1")
+        assert got[0]["snapshot"] == "snap1"
+        assert [s["snapshot"] for s in eng.snapshots.get_snapshots("repo1")] == ["snap1"]
+        eng.snapshots.delete_snapshot("repo1", "snap1")
+        with pytest.raises(SnapshotMissingError):
+            eng.snapshots.get_snapshots("repo1", "snap1")
+
+    def test_duplicate_name_rejected(self, eng):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        with pytest.raises(ResourceAlreadyExistsError):
+            eng.snapshots.create_snapshot("repo1", "snap1")
+
+    def test_invalid_name(self, eng):
+        from elasticsearch_tpu.snapshots.repository import InvalidSnapshotNameError
+
+        with pytest.raises(InvalidSnapshotNameError):
+            eng.snapshots.create_snapshot("repo1", "Bad Name")
+
+    def test_incremental_dedup(self, eng, tmp_path):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        n1 = _blob_count(tmp_path)
+        # unchanged corpus: second snapshot adds zero data blobs
+        eng.snapshots.create_snapshot("repo1", "snap2")
+        assert _blob_count(tmp_path) == n1
+        # one mutation: only the affected chunk is new
+        eng.get_index("books").index_doc("b0", {"title": "changed", "n": 999})
+        eng.snapshots.create_snapshot("repo1", "snap3")
+        assert _blob_count(tmp_path) == n1 + 1
+
+    def test_delete_gc_keeps_shared_blobs(self, eng, tmp_path):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        eng.snapshots.create_snapshot("repo1", "snap2")  # shares all chunks
+        n = _blob_count(tmp_path)
+        eng.snapshots.delete_snapshot("repo1", "snap1")
+        assert _blob_count(tmp_path) == n  # still referenced by snap2
+        eng.snapshots.delete_snapshot("repo1", "snap2")
+        assert _blob_count(tmp_path) == 0  # unreferenced -> GC'd
+
+
+class TestRestore:
+    def test_restore_rename(self, eng):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        res = eng.snapshots.restore_snapshot("repo1", "snap1", {
+            "indices": "books",
+            "rename_pattern": "books", "rename_replacement": "books-restored",
+        })
+        assert res["snapshot"]["indices"] == ["books-restored"]
+        ridx = eng.get_index("books-restored")
+        assert ridx.count() == 30
+        assert ridx.get_doc("b7")["_source"]["n"] == 7
+
+    def test_restore_existing_index_rejected(self, eng):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        with pytest.raises(IllegalArgumentError, match="already exists"):
+            eng.snapshots.restore_snapshot("repo1", "snap1", {"indices": "books"})
+
+    def test_restore_after_delete_roundtrip(self, eng):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        eng.delete_index("books")
+        eng.snapshots.restore_snapshot("repo1", "snap1", {})
+        assert eng.get_index("books").count() == 30
+        # search works on restored data
+        res = eng.search_multi("books", query={"match": {"title": "book"}})
+        assert res["hits"]["total"]["value"] == 30
+
+    def test_restore_global_state(self, eng):
+        eng.meta.put_index_template("tmpl", {"index_patterns": ["t-*"]})
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        eng.meta.delete_index_template("tmpl")
+        eng.snapshots.restore_snapshot("repo1", "snap1", {
+            "indices": "none-*", "include_global_state": True,
+        })
+        assert "tmpl" in eng.meta.index_templates
+
+    def test_status(self, eng):
+        eng.snapshots.create_snapshot("repo1", "snap1")
+        st = eng.snapshots.status("repo1", "snap1")
+        assert st["snapshots"][0]["indices"]["books"]["doc_count"] == 30
