@@ -1,0 +1,4 @@
+"""Core runtime services: typed settings registry, circuit breakers."""
+
+from .breaker import CircuitBreakerService, CircuitBreakingError  # noqa: F401
+from .settings import ClusterSettings, IndexScopedSettings, Setting  # noqa: F401

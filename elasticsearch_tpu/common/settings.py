@@ -1,0 +1,238 @@
+"""Typed, scoped, dynamically-updatable settings.
+
+Reference behavior: common/settings/Setting.java:80 (typed parsers,
+Dynamic/Final properties, validators), common/settings/ClusterSettings.java:139
+(registry of cluster-scoped settings; update consumers invoked on applied
+changes; persistent vs transient), common/settings/IndexScopedSettings.java
+(per-index registry; non-dynamic settings rejected on a live index).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable
+
+from ..utils.errors import IllegalArgumentError
+
+_SIZE_RE = re.compile(r"^(\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|%)?$", re.I)
+_SIZE_MULT = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30,
+              "tb": 1 << 40, "pb": 1 << 50}
+
+
+def parse_bytes(v, total_for_percent: int | None = None) -> int:
+    """'512mb', '85%', 1024 -> bytes (reference: ByteSizeValue +
+    MemorySizeValue percentage parsing)."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return int(v)
+    m = _SIZE_RE.match(str(v).strip())
+    if not m:
+        raise IllegalArgumentError(f"failed to parse byte size [{v}]")
+    num, unit = float(m.group(1)), (m.group(2) or "b").lower()
+    if unit == "%":
+        if total_for_percent is None:
+            raise IllegalArgumentError(f"percentage not allowed here [{v}]")
+        return int(total_for_percent * num / 100.0)
+    return int(num * _SIZE_MULT[unit])
+
+
+class Setting:
+    """One typed setting: key, default, parser, dynamic flag, validator."""
+
+    def __init__(self, key: str, default, parser: Callable = str, *,
+                 dynamic: bool = False, validator: Callable | None = None):
+        self.key = key
+        self.default = default
+        self.parser = parser
+        self.dynamic = dynamic
+        self.validator = validator
+
+    def parse(self, raw):
+        try:
+            v = self.parser(raw)
+        except IllegalArgumentError:
+            raise
+        except Exception as ex:
+            raise IllegalArgumentError(
+                f"failed to parse value [{raw}] for setting [{self.key}]: {ex}"
+            )
+        if self.validator is not None:
+            self.validator(v)
+        return v
+
+    # common parsers
+    @staticmethod
+    def int_(raw):
+        return int(raw)
+
+    @staticmethod
+    def float_(raw):
+        return float(raw)
+
+    @staticmethod
+    def bool_(raw):
+        if isinstance(raw, bool):
+            return raw
+        if str(raw).lower() in ("true", "1"):
+            return True
+        if str(raw).lower() in ("false", "0"):
+            return False
+        raise IllegalArgumentError(f"cannot parse boolean [{raw}]")
+
+    @staticmethod
+    def positive_int(raw):
+        v = int(raw)
+        if v < 0:
+            raise IllegalArgumentError(f"must be >= 0, got [{raw}]")
+        return v
+
+
+class ClusterSettings:
+    """Registry + live values + update consumers + persistence.
+
+    `update({persistent: {...}, transient: {...}})` validates every key
+    against the registry first, then applies and notifies consumers — one
+    bad key rejects the whole request (the reference applies settings as a
+    single cluster-state update)."""
+
+    def __init__(self, registry: list[Setting], data_path: str | None = None):
+        self.registry = {s.key: s for s in registry}
+        self.persistent: dict = {}
+        self.transient: dict = {}
+        self._consumers: dict[str, list[Callable]] = {}
+        self.data_path = data_path
+        self._load()
+
+    def _file(self):
+        return (os.path.join(self.data_path, "cluster_settings.json")
+                if self.data_path else None)
+
+    def _load(self):
+        f = self._file()
+        if f and os.path.exists(f):
+            with open(f, encoding="utf-8") as fh:
+                state = json.load(fh)
+            self.persistent = state.get("persistent", {})
+            # transient settings do not survive restart (reference semantics)
+
+    def _save(self):
+        f = self._file()
+        if not f:
+            return
+        tmp = f + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"persistent": self.persistent}, fh)
+        os.replace(tmp, f)
+
+    def _lookup(self, key: str) -> Setting:
+        s = self.registry.get(key)
+        if s is None:
+            # group/wildcard settings: logger.* is dynamic free-form
+            for pat, setting in self.registry.items():
+                if pat.endswith(".*") and key.startswith(pat[:-1]):
+                    return setting
+            raise IllegalArgumentError(
+                f"transient setting [{key}], not recognized"
+            )
+        return s
+
+    def get(self, key: str):
+        if key in self.transient:
+            return self._lookup(key).parse(self.transient[key])
+        if key in self.persistent:
+            return self._lookup(key).parse(self.persistent[key])
+        s = self.registry.get(key)
+        if s is None:
+            raise IllegalArgumentError(f"setting [{key}] not recognized")
+        return s.default
+
+    def add_consumer(self, key: str, fn: Callable):
+        self._consumers.setdefault(key, []).append(fn)
+
+    def update(self, body: dict) -> dict:
+        changes = []
+        for scope in ("persistent", "transient"):
+            for key, raw in (body.get(scope) or {}).items():
+                s = self._lookup(key)
+                if raw is not None:
+                    if not s.dynamic:
+                        raise IllegalArgumentError(
+                            f"final cluster setting [{key}], not updateable"
+                        )
+                    s.parse(raw)  # validate before applying anything
+                changes.append((scope, key, raw))
+        for scope, key, raw in changes:
+            store = self.persistent if scope == "persistent" else self.transient
+            if raw is None:
+                store.pop(key, None)
+            else:
+                store[key] = raw
+            for fn in self._consumers.get(key, []):
+                fn(self.get(key) if raw is not None else self._lookup(key).default)
+        self._save()
+        return {
+            "acknowledged": True,
+            "persistent": dict(self.persistent),
+            "transient": dict(self.transient),
+        }
+
+
+def default_cluster_settings() -> list[Setting]:
+    return [
+        Setting("cluster.name", "elasticsearch-tpu"),
+        Setting("indices.breaker.total.limit", "95%", str, dynamic=True),
+        Setting("indices.breaker.fielddata.limit", "40%", str, dynamic=True),
+        Setting("indices.breaker.request.limit", "60%", str, dynamic=True),
+        Setting("search.default_search_timeout", "-1", str, dynamic=True),
+        Setting("search.max_buckets", 65536, Setting.positive_int, dynamic=True),
+        Setting("action.auto_create_index", True, Setting.bool_, dynamic=True),
+        Setting("cluster.max_shards_per_node", 1000, Setting.positive_int, dynamic=True),
+        Setting("logger.*", "info", str, dynamic=True),
+    ]
+
+
+# ---- index-scoped --------------------------------------------------------
+
+INDEX_SETTINGS: dict[str, Setting] = {s.key: s for s in [
+    Setting("number_of_shards", 1, Setting.int_, dynamic=False,
+            validator=lambda v: None if v >= 1 else (_ for _ in ()).throw(
+                IllegalArgumentError("number_of_shards must be >= 1"))),
+    Setting("number_of_replicas", 0, Setting.positive_int, dynamic=True),
+    Setting("refresh_interval", "1s", str, dynamic=True),
+    Setting("default_pipeline", None, str, dynamic=True),
+    Setting("final_pipeline", None, str, dynamic=True),
+    Setting("max_result_window", 10000, Setting.positive_int, dynamic=True),
+    Setting("hidden", False, Setting.bool_, dynamic=True),
+    Setting("blocks.read_only", False, Setting.bool_, dynamic=True),
+    Setting("blocks.write", False, Setting.bool_, dynamic=True),
+]}
+
+
+class IndexScopedSettings:
+    """Validates index settings at create and on dynamic update."""
+
+    @staticmethod
+    def normalize(key: str) -> str:
+        return key.removeprefix("index.")
+
+    @classmethod
+    def validate_update(cls, current: dict, updates: dict) -> dict:
+        """-> normalized updates; rejects non-dynamic keys on a live index
+        (reference behavior: MetadataUpdateSettingsService — 'final ... ,
+        not updateable on open indices')."""
+        out = {}
+        for key, raw in updates.items():
+            nk = cls.normalize(key)
+            s = INDEX_SETTINGS.get(nk)
+            if s is None:
+                # unknown settings are stored opaquely (plugins do this in
+                # the reference via IndexScopedSettings groups)
+                out[nk] = raw
+                continue
+            if not s.dynamic:
+                raise IllegalArgumentError(
+                    f"Can't update non dynamic settings [[index.{nk}]] for open indices"
+                )
+            out[nk] = s.parse(raw) if raw is not None else None
+        return out

@@ -82,14 +82,22 @@ class EsIndex:
         settings: dict,
         data_dir: str | None,
         _recovering: bool = False,
+        breaker_account=None,
     ):
+        from ..common.settings import INDEX_SETTINGS
+
         self.name = name
         self.mappings = mappings
         self.settings = {"number_of_shards": 1, "number_of_replicas": 0, "refresh_interval": "1s"}
-        self.settings.update(settings or {})
+        for k, v in (settings or {}).items():
+            s = INDEX_SETTINGS.get(k)
+            if s is not None and v is not None:
+                s.parse(v)  # typed validation at create (Setting.java parsers)
+            self.settings[k] = v
         self.num_shards = int(self.settings["number_of_shards"])
         if self.num_shards < 1:
             raise IllegalArgumentError("number_of_shards must be >= 1")
+        self._breaker_account = breaker_account
         self.docs: dict[str, _DocEntry] = {}
         self.seq_no = 0
         self.primary_term = 1
@@ -155,12 +163,28 @@ class EsIndex:
         self._wal.flush()
         os.fsync(self._wal.fileno())
 
+    def update_settings(self, updates: dict):
+        """PUT /{index}/_settings: dynamic settings only (reference behavior:
+        MetadataUpdateSettingsService — non-dynamic keys rejected on open
+        indices)."""
+        from ..common.settings import IndexScopedSettings
+
+        norm = IndexScopedSettings.validate_update(self.settings, updates)
+        for k, v in norm.items():
+            if v is None:
+                self.settings.pop(k, None)
+            else:
+                self.settings[k] = v
+        self._persist_meta()
+        return {"acknowledged": True}
+
     @classmethod
-    def open(cls, name: str, data_dir: str) -> "EsIndex":
+    def open(cls, name: str, data_dir: str, breaker_account=None) -> "EsIndex":
         """Recover an index from disk: commit snapshot + WAL replay."""
         with open(os.path.join(data_dir, "meta.json"), encoding="utf-8") as f:
             meta = json.load(f)
-        idx = cls(name, Mappings(meta["mappings"]), meta["settings"], data_dir=None, _recovering=True)
+        idx = cls(name, Mappings(meta["mappings"]), meta["settings"], data_dir=None,
+                  _recovering=True, breaker_account=breaker_account)
         idx.data_dir = data_dir
         snap_path = os.path.join(data_dir, "commit.json")
         if os.path.exists(snap_path):
@@ -275,6 +299,10 @@ class EsIndex:
         # _source snapshot (the analog of stored fields in a sealed segment)
         routed = route_docs(live_docs, self.num_shards)
         sp = build_stacked_pack_routed(routed, self.mappings)
+        if self._breaker_account is not None:
+            # admission control BEFORE shipping to the device: on trip, the
+            # old searcher stays live (HierarchyCircuitBreakerService analog)
+            self._breaker_account(sp.nbytes())
         if mesh is None:
             mesh = make_mesh(self.num_shards)
         self.searcher = StackedSearcher(sp, mesh=mesh)
@@ -521,15 +549,36 @@ class Engine:
         self.ingest = IngestService()
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
+        from ..common.breaker import CircuitBreakerService
+        from ..common.settings import ClusterSettings, default_cluster_settings
         from ..snapshots import SnapshotService
 
         self.snapshots = SnapshotService(self)
+        self.settings = ClusterSettings(default_cluster_settings(), data_path)
+        self.breakers = CircuitBreakerService(limits={
+            "total": self.settings.get("indices.breaker.total.limit"),
+            "fielddata": self.settings.get("indices.breaker.fielddata.limit"),
+            "request": self.settings.get("indices.breaker.request.limit"),
+        })
+        for key, child in (("indices.breaker.total.limit", "total"),
+                           ("indices.breaker.fielddata.limit", "fielddata"),
+                           ("indices.breaker.request.limit", "request")):
+            self.settings.add_consumer(
+                key, lambda raw, c=child: self.breakers.set_limit(c, raw)
+            )
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):
                 d = os.path.join(data_path, "indices", name)
                 if os.path.isdir(d) and os.path.exists(os.path.join(d, "meta.json")):
-                    self.indices[name] = EsIndex.open(name, d)
+                    self.indices[name] = EsIndex.open(
+                        name, d, breaker_account=self._pack_accounter(name)
+                    )
+
+    def _pack_accounter(self, name: str):
+        return lambda n: self.breakers.set_steady(
+            "fielddata", name, n, label=f"index [{name}] packs"
+        )
 
     def _dir_for(self, name: str) -> str | None:
         if not self.data_path:
@@ -570,7 +619,8 @@ class Engine:
                 from ..query.dsl import parse_query
 
                 parse_query(props["filter"], m)
-        idx = EsIndex(name, m, settings or {}, self._dir_for(name))
+        idx = EsIndex(name, m, settings or {}, self._dir_for(name),
+                      breaker_account=self._pack_accounter(name))
         self.indices[name] = idx
         for alias, props in (aliases or {}).items():
             self.meta.put_alias(name, alias, props)
@@ -614,6 +664,7 @@ class Engine:
         idx.close()
         del self.indices[name]
         self.meta.drop_index(name)
+        self.breakers.set_steady("fielddata", name, 0)
         d = self._dir_for(name)
         if d and os.path.isdir(d):
             import shutil

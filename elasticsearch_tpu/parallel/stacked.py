@@ -106,6 +106,7 @@ class StackedPack:
         self.shards = shards
         self.mappings = mappings
         self.S = len(shards)
+        self._nbytes_cache: int | None = None
         self.n_max = max((p.num_docs for p in shards), default=0)
         self.nb_max = max((p.num_blocks for p in shards), default=1)
 
@@ -268,6 +269,38 @@ class StackedPack:
 
     def shard_view(self, s: int) -> _ShardView:
         return _ShardView(self.shards[s], self, s)
+
+    def nbytes(self) -> int:
+        """Total array bytes of the stacked device-bound structures (the
+        memory the circuit breaker must admit before the pack ships to HBM)."""
+        if self._nbytes_cache is not None:
+            return self._nbytes_cache
+
+        seen: set[int] = set()
+        total = 0
+
+        def walk(obj):
+            nonlocal total
+            if isinstance(obj, (str, int, float, bool, type(None))):
+                return
+            if id(obj) in seen:
+                return
+            seen.add(id(obj))
+            if isinstance(obj, np.ndarray):
+                total += obj.nbytes
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    walk(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v)
+            elif hasattr(obj, "__dict__"):
+                for v in vars(obj).values():
+                    walk(v)
+
+        walk({k: v for k, v in vars(self).items() if k != "mappings"})
+        self._nbytes_cache = total
+        return total
 
 
 def route_docs(

@@ -669,6 +669,48 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             "aliases": composed.get("aliases", {}),
         }, "overlapping": []})
 
+    # ---- settings --------------------------------------------------------
+
+    @handler
+    async def get_cluster_settings(request):
+        body = {
+            "persistent": dict(engine.settings.persistent),
+            "transient": dict(engine.settings.transient),
+        }
+        if _bool_param(request.query, "include_defaults"):
+            body["defaults"] = {
+                k: s.default for k, s in engine.settings.registry.items()
+                if k not in engine.settings.persistent
+                and k not in engine.settings.transient
+            }
+        return web.json_response(body)
+
+    @handler
+    async def put_cluster_settings(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(engine.settings.update, body))
+
+    @handler
+    async def get_index_settings(request):
+        out = {}
+        for idx, _ in engine.resolve_search(request.match_info["index"]):
+            out[idx.name] = {"settings": {"index": {
+                k: (str(v) if not isinstance(v, (dict, list)) else v)
+                for k, v in idx.settings.items()
+            }}}
+        return web.json_response(out)
+
+    @handler
+    async def put_index_settings(request):
+        body = await body_json(request, {}) or {}
+        updates = body.get("settings", body) or {}
+        if "index" in updates and isinstance(updates["index"], dict):
+            updates = {**updates, **updates.pop("index")}
+        res = None
+        for idx, _ in engine.resolve_search(request.match_info["index"]):
+            res = await call(idx.update_settings, updates)
+        return web.json_response(res or {"acknowledged": True})
+
     # ---- snapshots -------------------------------------------------------
 
     @handler
@@ -791,6 +833,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         "name": "node-0",
                         "roles": ["master", "data", "ingest"],
                         "indices": {"docs": {"count": total_docs}},
+                        "breakers": engine.breakers.stats(),
                         "tpu": {"devices": devices},
                     }
                 },
@@ -805,6 +848,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     app.router.add_post("/_ingest/pipeline/_simulate", simulate_pipeline)
     app.router.add_get("/_cluster/health", cluster_health)
+    app.router.add_get("/_cluster/settings", get_cluster_settings)
+    app.router.add_put("/_cluster/settings", put_cluster_settings)
     app.router.add_put("/_snapshot/{repo}", put_repository)
     app.router.add_post("/_snapshot/{repo}", put_repository)
     app.router.add_get("/_snapshot", get_repository)
@@ -855,6 +900,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_head("/{index}", head_index)
     app.router.add_get("/{index}/_mapping", get_mapping)
     app.router.add_put("/{index}/_mapping", put_mapping)
+    app.router.add_get("/{index}/_settings", get_index_settings)
+    app.router.add_put("/{index}/_settings", put_index_settings)
     app.router.add_post("/{index}/_refresh", refresh_index)
     app.router.add_get("/{index}/_refresh", refresh_index)
     app.router.add_post("/{index}/_flush", flush_index)

@@ -1,0 +1,187 @@
+"""Settings registry + circuit breakers.
+
+Reference behavior: common/settings/Setting.java (typed parsers, dynamic
+vs final), ClusterSettings.java:139 (update consumers),
+MetadataUpdateSettingsService (index dynamic updates),
+indices/breaker/HierarchyCircuitBreakerService.java:52 (child + parent
+limits, trip accounting, 429 circuit_breaking_exception).
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import (
+    CircuitBreakerService,
+    CircuitBreakingError,
+)
+from elasticsearch_tpu.common.settings import (
+    ClusterSettings,
+    Setting,
+    default_cluster_settings,
+    parse_bytes,
+)
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+class TestParsers:
+    def test_parse_bytes(self):
+        assert parse_bytes("512b") == 512
+        assert parse_bytes("2kb") == 2048
+        assert parse_bytes("1.5gb") == int(1.5 * (1 << 30))
+        assert parse_bytes("50%", 1000) == 500
+        assert parse_bytes(1234) == 1234
+        with pytest.raises(IllegalArgumentError):
+            parse_bytes("oops")
+        with pytest.raises(IllegalArgumentError):
+            parse_bytes("50%")  # no total given
+
+    def test_setting_validation(self):
+        s = Setting("x", 1, Setting.positive_int, dynamic=True)
+        assert s.parse("5") == 5
+        with pytest.raises(IllegalArgumentError):
+            s.parse("-2")
+
+
+class TestClusterSettings:
+    def test_defaults_and_update(self):
+        cs = ClusterSettings(default_cluster_settings())
+        assert cs.get("search.max_buckets") == 65536
+        cs.update({"persistent": {"search.max_buckets": 100}})
+        assert cs.get("search.max_buckets") == 100
+        # transient wins over persistent
+        cs.update({"transient": {"search.max_buckets": 7}})
+        assert cs.get("search.max_buckets") == 7
+        # null removes
+        cs.update({"transient": {"search.max_buckets": None}})
+        assert cs.get("search.max_buckets") == 100
+
+    def test_unknown_and_final_rejected(self):
+        cs = ClusterSettings(default_cluster_settings())
+        with pytest.raises(IllegalArgumentError, match="not recognized"):
+            cs.update({"persistent": {"no.such.setting": 1}})
+        with pytest.raises(IllegalArgumentError, match="not updateable"):
+            cs.update({"persistent": {"cluster.name": "x"}})
+
+    def test_validation_precedes_application(self):
+        cs = ClusterSettings(default_cluster_settings())
+        with pytest.raises(IllegalArgumentError):
+            cs.update({"persistent": {
+                "search.max_buckets": 5, "no.such": 1,
+            }})
+        assert cs.get("search.max_buckets") == 65536  # nothing applied
+
+    def test_consumer_notified(self):
+        cs = ClusterSettings(default_cluster_settings())
+        seen = []
+        cs.add_consumer("search.max_buckets", seen.append)
+        cs.update({"persistent": {"search.max_buckets": 42}})
+        assert seen == [42]
+
+    def test_wildcard_logger_settings(self):
+        cs = ClusterSettings(default_cluster_settings())
+        cs.update({"transient": {"logger.org.acme": "debug"}})
+        assert cs.get("logger.org.acme") == "debug"
+
+    def test_persistence(self, tmp_path):
+        cs = ClusterSettings(default_cluster_settings(), str(tmp_path))
+        cs.update({"persistent": {"search.max_buckets": 9}})
+        cs.update({"transient": {"search.max_buckets": 10}})
+        cs2 = ClusterSettings(default_cluster_settings(), str(tmp_path))
+        assert cs2.get("search.max_buckets") == 9  # transient dropped
+
+
+class TestIndexSettings:
+    def test_dynamic_update(self):
+        e = Engine()
+        try:
+            idx = e.create_index("i1")
+            idx.update_settings({"index.refresh_interval": "5s",
+                                 "number_of_replicas": 2})
+            assert idx.settings["refresh_interval"] == "5s"
+            assert idx.settings["number_of_replicas"] == 2
+        finally:
+            e.close()
+
+    def test_non_dynamic_rejected(self):
+        e = Engine()
+        try:
+            idx = e.create_index("i1")
+            with pytest.raises(IllegalArgumentError, match="non dynamic"):
+                idx.update_settings({"number_of_shards": 4})
+        finally:
+            e.close()
+
+    def test_create_validates_types(self):
+        e = Engine()
+        try:
+            with pytest.raises(IllegalArgumentError):
+                e.create_index("bad", settings={"number_of_replicas": -1})
+        finally:
+            e.close()
+
+
+class TestBreakers:
+    def test_child_trip(self):
+        svc = CircuitBreakerService(total_bytes=1000)
+        svc.add_estimate("fielddata", 300, "packs")  # limit 400
+        with pytest.raises(CircuitBreakingError) as ei:
+            svc.add_estimate("fielddata", 200, "packs")
+        assert ei.value.status == 429
+        assert svc.children["fielddata"].trip_count == 1
+        svc.release("fielddata", 300)
+        assert svc.children["fielddata"].used == 0
+
+    def test_parent_trip(self):
+        svc = CircuitBreakerService(
+            total_bytes=1000, limits={"fielddata": "90%", "request": "90%"})
+        svc.add_estimate("fielddata", 500, "a")
+        with pytest.raises(CircuitBreakingError, match=r"\[parent\]"):
+            svc.add_estimate("request", 600, "b")
+
+    def test_set_steady_replaces(self):
+        svc = CircuitBreakerService(total_bytes=10_000)
+        svc.set_steady("fielddata", "idx1", 1000)
+        svc.set_steady("fielddata", "idx1", 1500)
+        assert svc.children["fielddata"].used == 1500
+        svc.set_steady("fielddata", "idx1", 0)
+        assert svc.children["fielddata"].used == 0
+
+    def test_engine_accounts_packs(self):
+        e = Engine()
+        try:
+            idx = e.create_index("acct", {"properties": {"b": {"type": "text"}}})
+            idx.index_doc("1", {"b": "hello world"})
+            idx.refresh()
+            used = e.breakers.children["fielddata"].used
+            assert used > 0
+            e.delete_index("acct")
+            assert e.breakers.children["fielddata"].used == 0
+        finally:
+            e.close()
+
+    def test_breaker_blocks_oversized_refresh(self):
+        e = Engine()
+        try:
+            idx = e.create_index("big", {"properties": {"b": {"type": "text"}}})
+            e.breakers.children["fielddata"].limit = \
+                e.breakers.children["fielddata"].used  # no headroom left
+            for i in range(50):
+                idx.index_doc(str(i), {"b": f"hello breaker number {i}"})
+            with pytest.raises(CircuitBreakingError):
+                idx.refresh()
+            # the old (empty) searcher survived the trip
+            assert idx.searcher is not None and idx.searcher.sp.num_docs == 0
+        finally:
+            e.close()
+
+    def test_settings_consumer_resizes_breaker(self):
+        e = Engine()
+        try:
+            e.settings.update({"persistent": {
+                "indices.breaker.fielddata.limit": "10%",
+            }})
+            assert e.breakers.children["fielddata"].limit == int(
+                e.breakers.total * 0.10
+            )
+        finally:
+            e.close()
