@@ -41,6 +41,7 @@ class BatchPlan:
     sparse_rows: np.ndarray  # [Q, Ts, B] int32 CSR block rows (0-padded)
     sparse_weights: np.ndarray  # [Q, Ts] f32
     k: int
+    dense_only: bool = False  # no sparse terms anywhere -> fused Pallas path
 
 
 def batch_term_disjunction(
@@ -186,10 +187,20 @@ class BatchTermSearcher:
             for ti, (s0, nb, w) in enumerate(sparse):
                 rows[qi, ti, :nb] = np.arange(s0, s0 + nb)
                 ws[qi, ti] = w
-        return BatchPlan(W, rows, ws, k)
+        dense_only = V > 0 and all(not sparse for _, sparse in parsed)
+        return BatchPlan(W, rows, ws, k, dense_only)
 
     def run(self, fld: str, plan: BatchPlan):
         """-> (scores [Q,k], docids [Q,k], totals [Q]) on device (async)."""
+        if plan.dense_only:
+            # whole batch lives in the dense tier: fused Pallas scan+topk —
+            # scores never leave VMEM (ops/kernels.py)
+            from .kernels import scan_topk
+
+            dev = self.searcher.dev
+            return scan_topk(
+                jnp.asarray(plan.W), dev["dense_tfn"], dev["live"], plan.k
+            )
         fn = self._compiled(
             (plan.sparse_rows.shape[1], plan.sparse_rows.shape[2], plan.k, fld)
         )

@@ -1,0 +1,290 @@
+"""Pallas TPU kernels for the scoring hot loop.
+
+The reference's innermost hot loop is Lucene's `BulkScorer.score` — a
+doc-at-a-time pull iterator feeding a top-k heap (reference behavior:
+search/internal/ContextIndexSearcher.java:411-431). The TPU inversion keeps
+the FLOPs on the MXU and the heap in VMEM:
+
+    fused_scan_topk:  grid over doc tiles; per step a [TILE_B, D] x [D, TILE_N]
+    matmul (MXU) produces a tile of scores, which updates a running
+    (score desc, docid asc) top-k held in VMEM scratch. TPU grids execute
+    sequentially on a core, so the scratch accumulator is race-free — the
+    Pallas analog of Lucene's per-segment collector state.
+
+Two input modes share the merge machinery:
+  - matmul mode: q [B, D] against mat_t [D, N] — serves batched dense-tier
+    BM25 (q = per-query term weights, mat_t = dense tfn rows) and exact kNN
+    scans (q = query vectors, mat_t = transposed doc vectors).
+  - streamed mode: precomputed scores [B, N] — a bandwidth-optimal top-k
+    + match-count pass replacing sort-based `lax.top_k`.
+
+Why fusion matters: materializing [B, N] f32 scores for a 4k-query batch over
+a 1M-doc shard is ~16 GB of HBM traffic before top-k even starts; the fused
+kernel keeps scores in VMEM and writes only [B, k].
+
+The kernel reproduces the exact result order of ops/scoring.top_k_with_total:
+score descending, docid ascending on ties, -inf for dead lanes. On non-TPU
+backends `scan_topk` dispatches to an XLA reference implementation with
+identical semantics (tests compare both, running the kernel in interpret
+mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu import works on CPU too (needed for interpret-mode tests)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_I32_MAX = np.int32(2**31 - 1)
+
+
+def _pick_tiles(B: int, D: int, N: int, k: int) -> tuple[int, int]:
+    """Choose (TILE_B, TILE_N) fitting q + mat + scratch in ~10MB of VMEM."""
+    tile_b = 128 if B > 8 else 8
+    budget = 10 * 1024 * 1024
+    # bytes per step ~ 2*(q block + mat block) for double buffering
+    for tile_n in (512, 256, 128):
+        need = 2 * 4 * (tile_b * D + D * tile_n) + 4 * tile_b * (2 * k + tile_n)
+        if need <= budget:
+            return tile_b, tile_n
+    return tile_b, 128
+
+
+def _merge_topk(vals, idxs, acc_v, acc_i, k):
+    """One merge round: running top-k + a tile of candidates -> new top-k.
+
+    k unrolled (max, argmin-id, mask) rounds over [TB, k + TILE_N]; every op
+    is a VPU reduction/select, no sort. Tie-break: lowest docid wins among
+    equal scores, matching Lucene's TopScoreDocCollector order.
+    """
+    cand_v = jnp.concatenate([acc_v, vals], axis=1)
+    cand_i = jnp.concatenate([acc_i, idxs], axis=1)
+    out_v, out_i = [], []
+    for _ in range(k):
+        vmax = jnp.max(cand_v, axis=1, keepdims=True)
+        ismax = cand_v == vmax
+        imin = jnp.min(jnp.where(ismax, cand_i, _I32_MAX), axis=1, keepdims=True)
+        out_v.append(vmax)
+        out_i.append(imin)
+        cand_v = jnp.where(ismax & (cand_i == imin), -jnp.inf, cand_v)
+    return jnp.concatenate(out_v, axis=1), jnp.concatenate(out_i, axis=1)
+
+
+def _apply_transform(dots, transform, auxd_row, auxq_col):
+    """Map raw dots to _score space (see ops/vector.py conventions)."""
+    if transform == "identity":
+        return dots
+    if transform == "cosine":
+        # auxd = 1/||d||, auxq = 1/||q||
+        return (1.0 + dots * auxd_row[None, :] * auxq_col) / 2.0
+    if transform == "dot_product":
+        return (1.0 + dots) / 2.0
+    if transform == "l2_norm":
+        # auxd = ||d||^2, auxq = ||q||^2
+        l2 = jnp.maximum(auxd_row[None, :] - 2.0 * dots + auxq_col, 0.0)
+        return 1.0 / (1.0 + l2)
+    if transform == "max_inner_product":
+        return jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
+    raise ValueError(f"unknown transform [{transform}]")
+
+
+def _scan_topk_kernel(
+    q_ref, m_ref, live_ref, auxd_ref, auxq_ref,
+    ov_ref, oi_ref, ot_ref,
+    acc_v, acc_i, cnt,
+    *, k, tile_n, transform, count_positive, matmul,
+):
+    j = pl.program_id(1)
+    nn = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_v[:] = jnp.full_like(acc_v, -jnp.inf)
+        acc_i[:] = jnp.zeros_like(acc_i)
+        cnt[:] = jnp.zeros_like(cnt)
+
+    if matmul:
+        # HIGHEST: full-f32 MXU passes for bit-parity with the unfused path
+        dots = jnp.dot(
+            q_ref[:], m_ref[:],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    else:
+        dots = m_ref[:]
+    scores = _apply_transform(dots, transform, auxd_ref[0, :], auxq_ref[:])
+    ids = j * tile_n + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    ok = live_ref[0, :] > 0
+    scores = jnp.where(ok[None, :], scores, -jnp.inf)
+    if count_positive:
+        # BM25 match semantics: score <= 0 means "no matching term" (all term
+        # weights are > 0), so such lanes are not hits and not candidates
+        scores = jnp.where(scores > 0, scores, -jnp.inf)
+        cnt[:] += (scores > 0).astype(jnp.float32)
+    else:
+        cnt[:] += jnp.broadcast_to(ok[None, :], scores.shape).astype(jnp.float32)
+    new_v, new_i = _merge_topk(scores, ids, acc_v[:], acc_i[:], k)
+    acc_v[:] = new_v
+    acc_i[:] = new_i
+
+    @pl.when(j == nn - 1)
+    def _():
+        ov_ref[:] = acc_v[:]
+        oi_ref[:] = acc_i[:]
+        ot_ref[:] = jnp.sum(cnt[:], axis=1, keepdims=True).astype(jnp.int32)
+
+
+def _pad_to(x, mult, axis, value):
+    size = x.shape[axis]
+    rem = (-size) % mult
+    if rem == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "transform", "count_positive", "interpret", "tiles"),
+)
+def _scan_topk_pallas(
+    q, mat_t, live, aux_doc, aux_q,
+    *, k, transform, count_positive, interpret, tiles,
+):
+    matmul = q is not None
+    B = q.shape[0] if matmul else mat_t.shape[0]
+    D = q.shape[1] if matmul else 1
+    N = mat_t.shape[1]
+    tile_b, tile_n = tiles
+    if matmul:
+        qp = _pad_to(q, tile_b, 0, 0.0)
+        mp = _pad_to(mat_t, tile_n, 1, 0.0)
+    else:
+        qp = jnp.zeros((pl.cdiv(B, tile_b) * tile_b, 1), jnp.float32)
+        mp = _pad_to(_pad_to(mat_t, tile_b, 0, 0.0), tile_n, 1, 0.0)
+    livep = _pad_to(live.astype(jnp.float32)[None, :], tile_n, 1, 0.0)
+    auxdp = _pad_to(aux_doc[None, :], tile_n, 1, 0.0)
+    auxqp = _pad_to(aux_q[:, None], tile_b, 0, 0.0)
+    Bp = qp.shape[0] if matmul else mp.shape[0]
+    Np = mp.shape[1]
+    nb, nn = Bp // tile_b, Np // tile_n
+
+    kernel = functools.partial(
+        _scan_topk_kernel,
+        k=k, tile_n=tile_n, transform=transform,
+        count_positive=count_positive, matmul=matmul,
+    )
+    m_spec = (
+        pl.BlockSpec((D, tile_n), lambda i, j: (0, j))
+        if matmul
+        else pl.BlockSpec((tile_b, tile_n), lambda i, j: (i, j))
+    )
+    out_v, out_i, out_t = pl.pallas_call(
+        kernel,
+        grid=(nb, nn),
+        in_specs=[
+            pl.BlockSpec((tile_b, qp.shape[1]), lambda i, j: (i, 0)),
+            m_spec,
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, k), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, k), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_b, k), jnp.float32),
+            pltpu.VMEM((tile_b, k), jnp.int32),
+            pltpu.VMEM((tile_b, tile_n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, mp, livep, auxdp, auxqp)
+    return out_v[:B], out_i[:B], out_t[:B, 0]
+
+
+def scan_topk_xla(q, mat_t, live, aux_doc, aux_q, *, k, transform, count_positive):
+    """XLA reference with identical semantics (and the non-TPU fast path)."""
+    dots = (
+        jnp.matmul(q, mat_t, precision=jax.lax.Precision.HIGHEST)
+        if q is not None
+        else mat_t
+    )
+    auxq = aux_q[:, None] if aux_q.ndim == 1 else aux_q
+    scores = _apply_transform(dots, transform, aux_doc, auxq)
+    scores = jnp.where(live[None, :] > 0, scores, -jnp.inf)
+    if count_positive:
+        scores = jnp.where(scores > 0, scores, -jnp.inf)
+        totals = jnp.sum(scores > 0, axis=1, dtype=jnp.int32)
+    else:
+        totals = jnp.broadcast_to(
+            jnp.sum(live > 0, dtype=jnp.int32), (scores.shape[0],)
+        )
+    top_v, top_i = jax.lax.top_k(scores, k)
+    return top_v, top_i.astype(jnp.int32), totals
+
+
+def use_pallas() -> bool:
+    flag = os.environ.get("ES_TPU_PALLAS", "auto")
+    if flag == "0":
+        return False
+    if flag in ("1", "force"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def scan_topk(
+    q: jax.Array | None,  # [B, D] f32 or None (streamed mode)
+    mat_t: jax.Array,  # [D, N] f32 (matmul mode) | [B, N] scores (streamed)
+    live: jax.Array,  # [N] bool/float mask
+    k: int,
+    *,
+    transform: str = "identity",
+    aux_doc: jax.Array | None = None,  # [N] per-doc transform input
+    aux_q: jax.Array | None = None,  # [B] per-query transform input
+    count_positive: bool = True,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (top_v [B, k] f32, top_i [B, k] i32, totals [B] i32).
+
+    totals counts `score > 0 & live` when count_positive (BM25 match
+    semantics: all term weights > 0) else counts live lanes (kNN candidate
+    counts).
+    """
+    B = q.shape[0] if q is not None else mat_t.shape[0]
+    N = mat_t.shape[1]
+    k = max(1, min(k, N))
+    if aux_doc is None:
+        aux_doc = jnp.zeros((N,), jnp.float32)
+    if aux_q is None:
+        aux_q = jnp.zeros((B,), jnp.float32)
+    if interpret is None:
+        if not use_pallas():
+            return scan_topk_xla(
+                q, mat_t, live, aux_doc, aux_q,
+                k=k, transform=transform, count_positive=count_positive,
+            )
+        interpret = jax.default_backend() != "tpu"
+    D = q.shape[1] if q is not None else 1
+    tiles = _pick_tiles(B, D, N, k)
+    return _scan_topk_pallas(
+        q, mat_t, live, aux_doc, aux_q,
+        k=k, transform=transform, count_positive=count_positive,
+        interpret=bool(interpret), tiles=tiles,
+    )

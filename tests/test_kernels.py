@@ -1,0 +1,122 @@
+"""Pallas fused scan+topk kernel vs the XLA reference (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ops.kernels import scan_topk, scan_topk_xla
+
+
+def _run_both(q, mat_t, live, k, **kw):
+    got = scan_topk(
+        None if q is None else jnp.asarray(q),
+        jnp.asarray(mat_t),
+        jnp.asarray(live),
+        k,
+        interpret=True,
+        **kw,
+    )
+    aux_doc = kw.get("aux_doc")
+    aux_q = kw.get("aux_q")
+    B = mat_t.shape[0] if q is None else q.shape[0]
+    N = mat_t.shape[1]
+    want = scan_topk_xla(
+        None if q is None else jnp.asarray(q),
+        jnp.asarray(mat_t),
+        jnp.asarray(live),
+        jnp.zeros(N, jnp.float32) if aux_doc is None else jnp.asarray(aux_doc),
+        jnp.zeros(B, jnp.float32) if aux_q is None else jnp.asarray(aux_q),
+        k=k,
+        transform=kw.get("transform", "identity"),
+        count_positive=kw.get("count_positive", True),
+    )
+    return [np.asarray(x) for x in got], [np.asarray(x) for x in want]
+
+
+def _check(got, want):
+    gv, gi, gt = got
+    wv, wi, wt = want
+    np.testing.assert_allclose(gv, wv, rtol=1e-5, atol=1e-6)
+    # ids must agree wherever the score is finite (dead lanes have arbitrary id)
+    finite = np.isfinite(wv)
+    np.testing.assert_array_equal(gi[finite], wi[finite])
+    np.testing.assert_array_equal(gt, wt)
+
+
+def test_matmul_identity_basic(rng):
+    B, D, N, k = 5, 16, 300, 10
+    q = rng.normal(size=(B, D)).astype(np.float32)
+    mat = np.abs(rng.normal(size=(D, N))).astype(np.float32)
+    live = np.ones(N, bool)
+    live[rng.choice(N, 40, replace=False)] = False
+    _check(*_run_both(q, mat, live, k))
+
+
+def test_streamed_mode(rng):
+    B, N, k = 9, 700, 7
+    scores = rng.normal(size=(B, N)).astype(np.float32)
+    live = rng.random(N) > 0.3
+    _check(*_run_both(None, scores, live, k))
+
+
+def test_tie_break_lowest_docid():
+    # equal scores everywhere: top-k must be docids 0..k-1 in order
+    scores = np.ones((2, 257), np.float32)
+    live = np.ones(257, bool)
+    (gv, gi, gt), _ = _run_both(None, scores, live, 5)
+    np.testing.assert_array_equal(gi, np.tile(np.arange(5), (2, 1)))
+    np.testing.assert_array_equal(gt, [257, 257])
+
+
+def test_k_larger_than_matches(rng):
+    scores = np.full((3, 40), -1.0, np.float32)
+    scores[:, 3] = 2.0
+    live = np.zeros(40, bool)
+    live[:8] = True
+    (gv, gi, gt), (wv, wi, wt) = _run_both(None, scores, live, 6, count_positive=True)
+    _check((gv, gi, gt), (wv, wi, wt))
+    assert gt.tolist() == [1, 1, 1]  # only docid 3 scores > 0
+
+
+@pytest.mark.parametrize("sim", ["cosine", "dot_product", "l2_norm", "max_inner_product"])
+def test_vector_transforms(rng, sim):
+    B, D, N, k = 4, 8, 130, 5
+    q = rng.normal(size=(B, D)).astype(np.float32)
+    vecs = rng.normal(size=(N, D)).astype(np.float32)
+    live = np.ones(N, bool)
+    sq = (vecs * vecs).sum(-1)
+    if sim == "cosine":
+        aux_doc = 1.0 / np.sqrt(np.maximum(sq, 1e-30))
+        aux_q = 1.0 / np.sqrt(np.maximum((q * q).sum(-1), 1e-30))
+    elif sim == "l2_norm":
+        aux_doc = sq
+        aux_q = (q * q).sum(-1)
+    else:
+        aux_doc = np.zeros(N)
+        aux_q = np.zeros(B)
+    got, want = _run_both(
+        q, vecs.T.copy(), live, k,
+        transform=sim,
+        aux_doc=aux_doc.astype(np.float32),
+        aux_q=aux_q.astype(np.float32),
+        count_positive=False,
+    )
+    _check(got, want)
+    # cross-check against the reference scoring op
+    from elasticsearch_tpu.ops.vector import knn_scores
+
+    full = np.stack(
+        [np.asarray(knn_scores(jnp.asarray(vecs), jnp.asarray(sq), jnp.asarray(q[i]), sim))
+         for i in range(B)]
+    )
+    order = np.argsort(-full, axis=1, kind="stable")[:, :k]
+    np.testing.assert_allclose(got[0], np.take_along_axis(full, order, 1), rtol=1e-5)
+
+
+def test_unaligned_shapes(rng):
+    # B, N deliberately not multiples of any tile size
+    B, D, N, k = 11, 7, 1037, 13
+    q = rng.normal(size=(B, D)).astype(np.float32)
+    mat = rng.normal(size=(D, N)).astype(np.float32)
+    live = rng.random(N) > 0.5
+    _check(*_run_both(q, mat, live, k, count_positive=False))
