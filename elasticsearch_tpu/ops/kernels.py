@@ -47,8 +47,12 @@ except ImportError:  # pragma: no cover
 _I32_MAX = np.int32(2**31 - 1)
 
 
-def _pick_tiles(B: int, D: int, N: int, k: int) -> tuple[int, int]:
-    """Choose (TILE_B, TILE_N) fitting q + mat + scratch in ~10MB of VMEM."""
+MAX_FUSED_K = 128  # beyond this the unrolled merge loses to sort-based top_k
+
+
+def _pick_tiles(B: int, D: int, N: int, k: int) -> tuple[int, int] | None:
+    """Choose (TILE_B, TILE_N) fitting q + mat + scratch in ~10MB of VMEM.
+    None when nothing fits (caller falls back to the XLA path)."""
     tile_b = 128 if B > 8 else 8
     budget = 10 * 1024 * 1024
     # bytes per step ~ 2*(q block + mat block) for double buffering
@@ -56,7 +60,7 @@ def _pick_tiles(B: int, D: int, N: int, k: int) -> tuple[int, int]:
         need = 2 * 4 * (tile_b * D + D * tile_n) + 4 * tile_b * (2 * k + tile_n)
         if need <= budget:
             return tile_b, tile_n
-    return tile_b, 128
+    return None
 
 
 def _merge_topk(vals, idxs, acc_v, acc_i, k):
@@ -274,15 +278,20 @@ def scan_topk(
         aux_doc = jnp.zeros((N,), jnp.float32)
     if aux_q is None:
         aux_q = jnp.zeros((B,), jnp.float32)
+    D = q.shape[1] if q is not None else 1
+    tiles = _pick_tiles(B, D, N, k) if k <= MAX_FUSED_K else None
     if interpret is None:
-        if not use_pallas():
+        if not use_pallas() or tiles is None:
             return scan_topk_xla(
                 q, mat_t, live, aux_doc, aux_q,
                 k=k, transform=transform, count_positive=count_positive,
             )
         interpret = jax.default_backend() != "tpu"
-    D = q.shape[1] if q is not None else 1
-    tiles = _pick_tiles(B, D, N, k)
+    if tiles is None:  # explicit interpret request but shape won't fit
+        return scan_topk_xla(
+            q, mat_t, live, aux_doc, aux_q,
+            k=k, transform=transform, count_positive=count_positive,
+        )
     return _scan_topk_pallas(
         q, mat_t, live, aux_doc, aux_q,
         k=k, transform=transform, count_positive=count_positive,

@@ -69,3 +69,23 @@ def test_batched_all_sparse_and_all_dense():
             ref = s.search(node, size=5)
             assert totals[qi] == ref.total
             _assert_hits_match(scores[qi], ids[qi], ref, ctx=(dmd, qi))
+
+
+def test_batched_dense_only_pallas_interpret(monkeypatch):
+    """End-to-end dense_only dispatch through the Pallas kernel (interpret
+    mode on CPU via ES_TPU_PALLAS=force) against the per-query path."""
+    monkeypatch.setenv("ES_TPU_PALLAS", "force")
+    s, rng = _build(dense_min_df=1)  # every term dense
+    bs = BatchTermSearcher(s)
+    queries = [[("w1", 1.0), ("w30", 2.0)], [("w0", 1.0)], [("missing", 1.0)]]
+    plan = bs.plan("body", queries, k=5)
+    assert plan.dense_only
+    scores, ids, totals = bs.search("body", queries, k=5)
+    for qi, terms in enumerate(queries):
+        node = BoolNode(
+            should=[TermNode("body", t, boost=bo) for t, bo in terms],
+            minimum_should_match=1,
+        )
+        ref = s.search(node, size=5)
+        assert totals[qi] == ref.total
+        _assert_hits_match(scores[qi], ids[qi], ref, ctx=("pallas", qi))
