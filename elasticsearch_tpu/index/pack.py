@@ -197,7 +197,7 @@ class PackBuilder:
     immutable searchable pack.
     """
 
-    def __init__(self, mappings: Mappings):
+    def __init__(self, mappings: Mappings, use_native: bool | None = None):
         self.mappings = mappings
         # (field, term) -> {docid: tf}
         self.postings: dict[tuple[str, str], dict[int, int]] = {}
@@ -209,6 +209,19 @@ class PackBuilder:
         self.docvalue_raw: dict[str, list[tuple[int, Any]]] = {}
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
         self.num_docs = 0
+        # C++ accumulator owns the per-token hot loop when available
+        # (native/packing.cpp); dict fallback otherwise. Packs are
+        # bit-compatible either way (tests/test_native.py).
+        self._native = None
+        if use_native is not False:
+            from .. import native as native_mod
+
+            if native_mod.available():
+                from ..native.accumulator import NativeAccumulator
+
+                self._native = NativeAccumulator()
+            elif use_native:
+                raise RuntimeError("native packing requested but unavailable")
 
     def add_document(self, parsed: dict[str, list], doc_id: str | None = None) -> int:
         """parsed = Mappings.parse_document output; returns local docid.
@@ -228,6 +241,9 @@ class PackBuilder:
                 if not ft.index:
                     continue
                 analyzer = ft.get_analyzer()
+                if self._native is not None:
+                    self._add_text_native(fld, docid, analyzer, values)
+                    continue
                 length = 0
                 counts: dict[str, int] = {}
                 pos_lists: dict[str, list[int]] = {}
@@ -262,9 +278,12 @@ class PackBuilder:
                         continue
                     kept.append(v)
                 if ft.index and kept:
-                    for v in set(kept):
-                        p = self.postings.setdefault((fld, v), {})
-                        p[docid] = p.get(docid, 0) + 1
+                    if self._native is not None:
+                        self._native.add_tokens(fld, docid, list(set(kept)), None)
+                    else:
+                        for v in set(kept):
+                            p = self.postings.setdefault((fld, v), {})
+                            p[docid] = p.get(docid, 0) + 1
                     fc = self.field_doc_counts.setdefault(fld, [-1, 0])
                     if fc[0] != docid:
                         fc[0] = docid
@@ -290,14 +309,96 @@ class PackBuilder:
                     self.vector_raw.setdefault(fld, []).append((docid, [float(x) for x in values]))
         return docid
 
+    def _add_text_native(self, fld: str, docid: int, analyzer, values):
+        """Text-field token routing into the C++ accumulator. The ASCII fast
+        path requires exact standard-analyzer semantics; anything else is
+        Python-analyzed and fed as pre-tokenized terms."""
+        from ..analysis.analyzers import StandardAnalyzer
+
+        nat = self._native
+        fast = (
+            type(analyzer) is StandardAnalyzer
+            and not analyzer.stopwords
+            and analyzer.max_token_length == 255
+        )
+        length = 0
+        pos_base = 0
+        for v in values:
+            ret = nat.add_text(fld, docid, v, pos_base) if fast else -1
+            if ret < 0:
+                toks = analyzer.analyze(v)
+                nat.add_tokens(
+                    fld, docid,
+                    [tk.term for tk in toks],
+                    [pos_base + tk.position for tk in toks],
+                )
+                last_pos = max((tk.position for tk in toks), default=-1)
+                length += len(toks)
+                pos_base += last_pos + 1 + 100
+            else:
+                length += ret
+                pos_base += ret + 100
+        self.doc_field_lengths.setdefault(fld, []).append((docid, length))
+
+    def _flat_csr_from_dicts(self):
+        """Convert the dict-form postings/positions to the flat-CSR form the
+        vectorized packer consumes (same layout the native accumulator
+        emits)."""
+        keys = sorted(self.postings.keys())
+        T = len(keys)
+        df = np.fromiter(
+            (len(self.postings[k]) for k in keys), np.int64, count=T
+        )
+        post_offsets = np.zeros(T + 1, np.int64)
+        np.cumsum(df, out=post_offsets[1:])
+        total = int(post_offsets[-1])
+        flat_docs = np.empty(total, np.int32)
+        flat_tfs = np.empty(total, np.float32)
+        for i, k in enumerate(keys):
+            plist = self.postings[k]
+            docs = np.fromiter(plist.keys(), np.int32, count=len(plist))
+            tfs = np.fromiter(plist.values(), np.float32, count=len(plist))
+            order = np.argsort(docs, kind="stable")
+            s, e = post_offsets[i], post_offsets[i + 1]
+            flat_docs[s:e] = docs[order]
+            flat_tfs[s:e] = tfs[order]
+        pos_counts = np.zeros(T, np.int64)
+        for i, k in enumerate(keys):
+            plists = self.positions.get(k)
+            if plists:
+                pos_counts[i] = sum(len(v) for v in plists.values())
+        pos_offsets = np.zeros(T + 1, np.int64)
+        np.cumsum(pos_counts, out=pos_offsets[1:])
+        flat_pos = np.empty(int(pos_offsets[-1]), np.int64)
+        for i, k in enumerate(keys):
+            plists = self.positions.get(k)
+            if not plists:
+                continue
+            s = pos_offsets[i]
+            for d in sorted(plists):
+                for p in plists[d]:
+                    flat_pos[s] = d * POS_L + p
+                    s += 1
+        return keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos
+
     def build(self, dense_min_df: int | None = None) -> ShardPack:
         N = self.num_docs
         mappings = self.mappings
         if dense_min_df is None:
             dense_min_df = default_dense_min_df(N)
 
-        # ---- term dictionary: stable order = sorted by (field, term) ----
-        keys = sorted(self.postings.keys())
+        # ---- flat CSR (native accumulator or dict fallback) --------------
+        if self._native is not None:
+            keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos = (
+                self._native.pack()
+            )
+            self._native.close()
+            self._native = None
+        else:
+            keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos = (
+                self._flat_csr_from_dicts()
+            )
+        # term dictionary: stable order = sorted by (field, term)
         term_dict = {k: i for i, k in enumerate(keys)}
         T = len(keys)
 
@@ -326,47 +427,54 @@ class PackBuilder:
         # matching Lucene: keyword fields omit norms => norm = 1)
         # handled at query time by norm fallback.
 
-        # ---- blocked postings -------------------------------------------
-        n_blocks_per_term = []
-        for k in keys:
-            n_post = len(self.postings[k])
-            n_blocks_per_term.append((n_post + BLOCK - 1) // BLOCK)
-        total_blocks = 1 + int(sum(n_blocks_per_term))  # row 0 reserved padding
+        # ---- blocked postings (vectorized scatter from flat CSR) ---------
+        df = post_offsets[1:] - post_offsets[:-1]
+        term_df = df.astype(np.int32)
+        nblk = (df + BLOCK - 1) // BLOCK
+        row_base = np.empty(T + 1, dtype=np.int64)
+        row_base[0] = 1  # row 0 reserved all-padding
+        row_base[1:] = 1 + np.cumsum(nblk)
+        total_blocks = int(row_base[-1]) if T else 1
+        term_block_start = row_base.astype(np.int32)
 
         post_docids = np.full((total_blocks, BLOCK), N, dtype=np.int32)
         post_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
         post_dls = np.ones((total_blocks, BLOCK), dtype=np.float32)
-        term_block_start = np.zeros(T + 1, dtype=np.int32)
-        term_df = np.zeros(T, dtype=np.int32)
         block_max_tf = np.zeros(total_blocks, dtype=np.float32)
         block_min_len = np.full(total_blocks, np.inf, dtype=np.float32)
 
-        row = 1
-        for tid, k in enumerate(keys):
-            plist = self.postings[k]
-            docs = np.fromiter(plist.keys(), dtype=np.int32, count=len(plist))
-            tfs = np.fromiter(plist.values(), dtype=np.float32, count=len(plist))
-            order = np.argsort(docs, kind="stable")
-            docs, tfs = docs[order], tfs[order]
-            term_df[tid] = len(docs)
-            term_block_start[tid] = row
-            fld = k[0]
-            fld_norms = norms.get(fld)
-            for off in range(0, len(docs), BLOCK):
-                chunk_d = docs[off : off + BLOCK]
-                chunk_t = tfs[off : off + BLOCK]
-                post_docids[row, : len(chunk_d)] = chunk_d
-                post_tfs[row, : len(chunk_t)] = chunk_t
-                block_max_tf[row] = float(chunk_t.max())
-                if fld_norms is not None:
-                    post_dls[row, : len(chunk_d)] = fld_norms[chunk_d]
-                    block_min_len[row] = float(fld_norms[chunk_d].min())
-                else:
-                    block_min_len[row] = 1.0
-                row += 1
-        term_block_start[T] = row
-        # term_block_start[tid] for tid with 0 postings cannot occur (terms
-        # only exist with >=1 posting), so CSR is well-formed.
+        NP = len(flat_docs) if T else 0
+        field_names = sorted({k[0] for k in keys})
+        fld_code = {f: i for i, f in enumerate(field_names)}
+        field_of_term = np.fromiter(
+            (fld_code[k[0]] for k in keys), np.int64, count=T
+        )
+        if NP:
+            term_of_post = np.repeat(np.arange(T), df)
+            local = np.arange(NP, dtype=np.int64) - np.repeat(
+                post_offsets[:-1], df
+            )
+            dest_row = row_base[:-1][term_of_post] + local // BLOCK
+            dest_col = local % BLOCK
+            post_docids[dest_row, dest_col] = flat_docs
+            post_tfs[dest_row, dest_col] = flat_tfs
+            # per-posting doc length (1.0 for norm-less fields)
+            post_dl_flat = np.ones(NP, dtype=np.float32)
+            fop = field_of_term[term_of_post]
+            for f, nrm in norms.items():
+                code = fld_code.get(f)
+                if code is None:
+                    continue
+                sel = fop == code
+                if sel.any():
+                    post_dl_flat[sel] = nrm[flat_docs[sel]]
+            post_dls[dest_row, dest_col] = post_dl_flat
+            # per-block stats: flat order is block-contiguous, so reduceat
+            # over block-start boundaries gives segment max/min
+            starts = np.flatnonzero(np.diff(dest_row, prepend=-1))
+            block_rows = dest_row[starts]
+            block_max_tf[block_rows] = np.maximum.reduceat(flat_tfs, starts)
+            block_min_len[block_rows] = np.minimum.reduceat(post_dl_flat, starts)
         block_min_len[~np.isfinite(block_min_len)] = 1.0
 
         # ---- docvalues ---------------------------------------------------
@@ -423,57 +531,60 @@ class PackBuilder:
                 has[docid] = True
             vectors[fld] = VectorColumn(vals, has, ft.similarity, ft.dims)
 
-        # ---- position blocks (text terms only) ---------------------------
+        # ---- position blocks (vectorized scatter from flat CSR) ----------
         pos_keys = None
         term_pos_start = None
         term_pos_count = None
-        if self.positions:
-            n_pos_blocks_per_term = []
-            for k in keys:
-                plists = self.positions.get(k)
-                npos = sum(len(v) for v in plists.values()) if plists else 0
-                n_pos_blocks_per_term.append((npos + BLOCK - 1) // BLOCK)
-            total_pos_blocks = 1 + int(sum(n_pos_blocks_per_term))
+        n_positions = int(pos_offsets[-1]) if T else 0
+        if n_positions:
+            pos_df = pos_offsets[1:] - pos_offsets[:-1]
+            pnblk = (pos_df + BLOCK - 1) // BLOCK
+            prow_base = np.empty(T + 1, dtype=np.int64)
+            prow_base[0] = 1
+            prow_base[1:] = 1 + np.cumsum(pnblk)
+            total_pos_blocks = int(prow_base[-1])
             pos_keys = np.full((total_pos_blocks, BLOCK), POS_INF, dtype=np.int64)
-            term_pos_start = np.zeros(T + 1, dtype=np.int32)
-            term_pos_count = np.zeros(T, dtype=np.int32)
-            prow = 1
-            for tid, k in enumerate(keys):
-                term_pos_start[tid] = prow
-                plists = self.positions.get(k)
-                if not plists:
-                    continue
-                flat = np.array(
-                    [d * POS_L + p for d in sorted(plists) for p in plists[d]],
-                    dtype=np.int64,
-                )
-                term_pos_count[tid] = len(flat)
-                for off in range(0, len(flat), BLOCK):
-                    chunk = flat[off : off + BLOCK]
-                    pos_keys[prow, : len(chunk)] = chunk
-                    prow += 1
-            term_pos_start[T] = prow
+            term_pos_start = prow_base.astype(np.int32)
+            term_pos_count = pos_df.astype(np.int32)
+            pterm = np.repeat(np.arange(T), pos_df)
+            plocal = np.arange(n_positions, dtype=np.int64) - np.repeat(
+                pos_offsets[:-1], pos_df
+            )
+            pos_keys[
+                prow_base[:-1][pterm] + plocal // BLOCK, plocal % BLOCK
+            ] = flat_pos
 
-        # ---- dense tier --------------------------------------------------
-        dense_keys = [k for k in keys if len(self.postings[k]) >= dense_min_df]
+        # ---- dense tier (vectorized over all dense postings) -------------
+        dense_ids = np.flatnonzero(df >= dense_min_df) if T else np.array([], np.int64)
+        dense_keys = [keys[i] for i in dense_ids]
         dense_dict = {k: i for i, k in enumerate(dense_keys)}
         dense_tfn = None
         if dense_keys:
             dense_tfn = np.zeros((len(dense_keys), N), dtype=np.float32)
-            for i, k in enumerate(dense_keys):
-                fld = k[0]
-                plist = self.postings[k]
-                docs = np.fromiter(plist.keys(), np.int32, count=len(plist))
-                tfs = np.fromiter(plist.values(), np.float32, count=len(plist))
-                fld_norms = norms.get(fld)
-                st = field_stats.get(fld, {"sum_dl": 0.0, "doc_count": 0})
-                avgdl = st["sum_dl"] / max(st["doc_count"], 1) or 1.0
-                dense_tfn[i, docs] = compute_tfn(
-                    tfs,
-                    fld_norms[docs] if fld_norms is not None else None,
-                    avgdl,
-                    fld_norms is not None,
-                )
+            # per-field scoring constants, indexed by field code
+            avgdl_of_field = np.ones(len(field_names), dtype=np.float64)
+            has_norms_of_field = np.zeros(len(field_names), dtype=bool)
+            for f, code in fld_code.items():
+                st = field_stats.get(f, {"sum_dl": 0.0, "doc_count": 0})
+                avgdl_of_field[code] = (
+                    st["sum_dl"] / max(st["doc_count"], 1)
+                ) or 1.0
+                has_norms_of_field[code] = f in norms
+            dense_rank = np.full(T, -1, dtype=np.int64)
+            dense_rank[dense_ids] = np.arange(len(dense_ids))
+            dmask = dense_rank[term_of_post] >= 0
+            rows = dense_rank[term_of_post[dmask]]
+            cols = flat_docs[dmask]
+            tfs_d = flat_tfs[dmask]
+            dls_d = post_dl_flat[dmask]
+            fcode = field_of_term[term_of_post[dmask]]
+            K = np.where(
+                has_norms_of_field[fcode],
+                BM25_K1
+                * (1.0 - BM25_B + BM25_B * dls_d / avgdl_of_field[fcode]),
+                BM25_K1,
+            )
+            dense_tfn[rows, cols] = (tfs_d / (tfs_d + K)).astype(np.float32)
 
         return ShardPack(
             num_docs=N,

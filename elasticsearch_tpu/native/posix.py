@@ -1,0 +1,57 @@
+"""POSIX native access: mlockall + rlimit probes for bootstrap checks.
+
+The reference locks process memory and validates rlimits at boot
+(reference behavior: libs/native/.../PosixNativeAccess.java mlockall;
+bootstrap/BootstrapChecks.java memory-lock / max-file-descriptors checks).
+TPU hosts care for the same reason: the host-side pack build and WAL must
+not page out under memory pressure while feeding HBM.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import resource
+
+MCL_CURRENT = 1
+MCL_FUTURE = 2
+
+_libc: ctypes.CDLL | None = None
+
+
+def _lc() -> ctypes.CDLL | None:
+    global _libc
+    if _libc is None:
+        try:
+            _libc = ctypes.CDLL(None, use_errno=True)
+        except OSError:
+            return None
+    return _libc
+
+
+def mlockall() -> bool:
+    """Lock all current+future pages; False (with no exception) on failure,
+    matching the reference's warn-and-continue behavior."""
+    lc = _lc()
+    if lc is None or not hasattr(lc, "mlockall"):
+        return False
+    return lc.mlockall(MCL_CURRENT | MCL_FUTURE) == 0
+
+
+def max_open_files() -> int:
+    return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+
+
+def max_address_space_unlimited() -> bool:
+    return resource.getrlimit(resource.RLIMIT_AS)[0] == resource.RLIM_INFINITY
+
+
+def bootstrap_checks() -> list[str]:
+    """Non-fatal warnings, the analog of BootstrapChecks in dev mode."""
+    warnings = []
+    if max_open_files() < 65535:
+        warnings.append(
+            f"max file descriptors [{max_open_files()}] is low; 65535+ recommended"
+        )
+    if not max_address_space_unlimited():
+        warnings.append("max size virtual memory is not unlimited")
+    return warnings

@@ -1,0 +1,88 @@
+"""ctypes binding to system libzstd, zlib fallback.
+
+The reference ships prebuilt zstd natives bound via JNA/Panama
+(reference behavior: libs/native/libraries/build.gradle:21,46-51 and
+libs/native/.../Zstd.java) and uses them for transport message and stored
+field compression. Same role here for WAL segments and snapshot blobs.
+
+Framed format tag byte: b'Z' + zstd frame, or b'G' + zlib stream, so either
+side can decompress regardless of which codec was available at write time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import zlib
+
+_zstd: ctypes.CDLL | None = None
+_tried = False
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _zstd, _tried
+    if not _tried:
+        _tried = True
+        name = ctypes.util.find_library("zstd")
+        if name:
+            try:
+                lib = ctypes.CDLL(name)
+                lib.ZSTD_compressBound.restype = ctypes.c_size_t
+                lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+                lib.ZSTD_compress.restype = ctypes.c_size_t
+                lib.ZSTD_compress.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+                ]
+                lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+                lib.ZSTD_getFrameContentSize.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                ]
+                lib.ZSTD_decompress.restype = ctypes.c_size_t
+                lib.ZSTD_decompress.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t,
+                ]
+                lib.ZSTD_isError.restype = ctypes.c_uint
+                lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+                _zstd = lib
+            except OSError:
+                _zstd = None
+    return _zstd
+
+
+def zstd_available() -> bool:
+    return _lib() is not None
+
+
+def compress(data: bytes, level: int = 3) -> bytes:
+    lib = _lib()
+    if lib is None:
+        return b"G" + zlib.compress(data, 6)
+    bound = lib.ZSTD_compressBound(len(data))
+    buf = ctypes.create_string_buffer(bound)
+    n = lib.ZSTD_compress(buf, bound, data, len(data), level)
+    if lib.ZSTD_isError(n):
+        return b"G" + zlib.compress(data, 6)
+    return b"Z" + buf.raw[:n]
+
+
+def decompress(framed: bytes) -> bytes:
+    if not framed:
+        return b""
+    tag, payload = framed[:1], framed[1:]
+    if tag == b"G":
+        return zlib.decompress(payload)
+    if tag != b"Z":
+        raise ValueError(f"unknown compression frame tag {tag!r}")
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("zstd frame but libzstd unavailable on this host")
+    size = lib.ZSTD_getFrameContentSize(payload, len(payload))
+    if size in (2**64 - 1, 2**64 - 2):  # ERROR / UNKNOWN
+        raise ValueError("corrupt zstd frame")
+    buf = ctypes.create_string_buffer(int(size) or 1)
+    n = lib.ZSTD_decompress(buf, int(size) or 1, payload, len(payload))
+    if lib.ZSTD_isError(n):
+        raise ValueError("zstd decompression failed")
+    return buf.raw[:n]

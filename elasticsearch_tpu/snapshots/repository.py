@@ -90,14 +90,24 @@ class Repository:
     # ---- content-addressed blobs ----------------------------------------
 
     def put_blob(self, payload: bytes) -> str:
+        # zstd via the native binding when present (the reference compresses
+        # repository blobs too; its zstd natives are libs/native — see
+        # native/zstd.py), tagged frames with zlib fallback
+        from ..native import zstd as zstd_codec
+
         digest = hashlib.sha256(payload).hexdigest()
         name = f"blobs/{digest}"
         if not self.exists(name):
-            self.write(name, zlib.compress(payload, 6))
+            self.write(name, zstd_codec.compress(payload))
         return digest
 
     def get_blob(self, digest: str) -> bytes:
-        return zlib.decompress(self.read(f"blobs/{digest}"))
+        raw = self.read(f"blobs/{digest}")
+        if raw[:1] in (b"Z", b"G"):
+            from ..native import zstd as zstd_codec
+
+            return zstd_codec.decompress(raw)
+        return zlib.decompress(raw)  # pre-zstd repository layout
 
 
 class FsRepository(Repository):

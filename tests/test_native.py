@@ -1,0 +1,149 @@
+"""C++ accumulator vs pure-Python PackBuilder: packs must be bit-identical."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import native
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+MAPPING = Mappings(
+    {
+        "properties": {
+            "body": {"type": "text"},
+            "title": {"type": "text", "analyzer": "english"},
+            "ws": {"type": "text", "analyzer": "whitespace"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "integer"},
+        }
+    }
+)
+
+
+def _build_pair(docs, mapping=MAPPING, dense_min_df=2):
+    packs = []
+    for use_native in (False, True):
+        b = PackBuilder(mapping, use_native=use_native)
+        for i, src in enumerate(docs):
+            b.add_document(mapping.parse_document(src), doc_id=f"d{i}")
+        packs.append(b.build(dense_min_df=dense_min_df))
+    return packs
+
+
+def _assert_packs_equal(py, nat):
+    assert py.term_dict == nat.term_dict
+    np.testing.assert_array_equal(py.post_docids, nat.post_docids)
+    np.testing.assert_array_equal(py.post_tfs, nat.post_tfs)
+    np.testing.assert_array_equal(py.post_dls, nat.post_dls)
+    np.testing.assert_array_equal(py.term_block_start, nat.term_block_start)
+    np.testing.assert_array_equal(py.term_df, nat.term_df)
+    np.testing.assert_array_equal(py.block_max_tf, nat.block_max_tf)
+    np.testing.assert_array_equal(py.block_min_len, nat.block_min_len)
+    for f in py.norms:
+        np.testing.assert_array_equal(py.norms[f], nat.norms[f])
+    assert py.field_stats == nat.field_stats
+    assert py.dense_dict == nat.dense_dict
+    if py.dense_tfn is None:
+        assert nat.dense_tfn is None
+    else:
+        np.testing.assert_array_equal(py.dense_tfn, nat.dense_tfn)
+    if py.pos_keys is None:
+        assert nat.pos_keys is None
+    else:
+        np.testing.assert_array_equal(py.pos_keys, nat.pos_keys)
+        np.testing.assert_array_equal(py.term_pos_start, nat.term_pos_start)
+        np.testing.assert_array_equal(py.term_pos_count, nat.term_pos_count)
+
+
+def test_parity_basic_corpus(rng):
+    words = [f"w{i}" for i in range(50)]
+    docs = []
+    for i in range(120):
+        body = " ".join(rng.choice(words, size=int(rng.integers(1, 20))))
+        docs.append({"body": body, "tag": f"t{i % 7}", "n": i})
+    _assert_packs_equal(*_build_pair(docs))
+
+
+def test_parity_tokenizer_edges():
+    docs = [
+        {"body": "Don't stop-me now; it's 2024!"},
+        {"body": "O'Neil's co'op ''quoted'' a'b'c trailing'"},
+        {"body": "x" * 600 + " tail"},  # overlong token splits at 255
+        {"body": ["multi", "valued text values"]},  # position gap 100
+        {"body": "   "},
+        {"body": ""},
+        {"body": "MiXeD CaSe UPPER lower 123abc 456"},
+        {"body": "_underscore_ under_score"},  # _ is not a word char
+    ]
+    py, nat = _build_pair(docs)
+    _assert_packs_equal(py, nat)
+    assert ("body", "don't") in py.term_dict
+    assert ("body", "x" * 255) in py.term_dict
+
+
+def test_parity_non_ascii_fallback():
+    docs = [
+        {"body": "café déjà-vu naïve"},
+        {"body": "ascii only here"},
+        {"body": "日本語 テスト mixed ascii"},
+        {"body": "Müller's größe"},
+    ]
+    py, nat = _build_pair(docs)
+    _assert_packs_equal(py, nat)
+    assert ("body", "café") in py.term_dict
+    assert ("body", "日本語") in py.term_dict
+
+
+def test_parity_stopword_and_custom_analyzers(rng):
+    # english (stopwords -> python tokens into native accumulator) and
+    # whitespace (no lowercase) both bypass the ASCII fast path
+    docs = [
+        {"title": "the quick brown fox and the lazy dog"},
+        {"title": "To Be or Not to Be"},
+        {"ws": "Keep-Case AND punct,uation! as-is"},
+        {"title": "stops at the end of"},
+    ]
+    _assert_packs_equal(*_build_pair(docs))
+
+
+def test_parity_search_results(rng):
+    from elasticsearch_tpu.query import ShardSearcher
+    from elasticsearch_tpu.query.nodes import BoolNode, PhraseNode, TermNode
+
+    words = [f"w{i}" for i in range(30)]
+    docs = []
+    for i in range(200):
+        body = " ".join(rng.choice(words, size=int(rng.integers(2, 15))))
+        docs.append({"body": body, "tag": f"t{i % 5}"})
+    py, nat = _build_pair(docs, dense_min_df=8)
+    s_py = ShardSearcher(py, mappings=MAPPING)
+    s_nat = ShardSearcher(nat, mappings=MAPPING)
+    for q in [
+        TermNode("body", "w3"),
+        BoolNode(should=[TermNode("body", "w1"), TermNode("body", "w7")], minimum_should_match=1),
+        PhraseNode("body", [("w1", 0), ("w2", 1)]),
+    ]:
+        r1 = s_py.search(q, size=10)
+        r2 = s_nat.search(q, size=10)
+        assert r1.total == r2.total
+        np.testing.assert_array_equal(r1.doc_ids, r2.doc_ids)
+        np.testing.assert_array_equal(r1.scores, r2.scores)
+
+
+def test_zstd_roundtrip():
+    from elasticsearch_tpu.native.zstd import compress, decompress
+
+    for payload in [b"", b"x", b"repetitive " * 5000, bytes(range(256)) * 100]:
+        assert decompress(compress(payload)) == payload
+
+
+def test_zlib_fallback_frame():
+    import zlib
+
+    from elasticsearch_tpu.native.zstd import decompress
+
+    assert decompress(b"G" + zlib.compress(b"fallback data")) == b"fallback data"
