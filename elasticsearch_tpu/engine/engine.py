@@ -541,12 +541,14 @@ class Engine:
     def __init__(self, data_path: str | None = None):
         from ..cluster.metadata import MetadataStore
         from ..ingest import IngestService
+        from ..tasks import TaskManager
 
         from .contexts import ContextRegistry
 
         self.data_path = data_path
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
+        self.tasks = TaskManager()
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -971,9 +973,11 @@ class Engine:
         return [h["_id"] for h in res["hits"]["hits"]]
 
     def delete_by_query(self, expression, query=None, max_docs=None,
-                        refresh=False, **res_kw) -> dict:
+                        refresh=False, task=None, **res_kw) -> dict:
         """POST /{index}/_delete_by_query (reference behavior:
-        reindex module AbstractAsyncBulkByScrollAction over scroll+bulk)."""
+        reindex module AbstractAsyncBulkByScrollAction over scroll+bulk).
+        `task` is polled cooperatively per doc, the analog of the
+        reference's per-scroll-batch cancellation checks."""
         t0 = time.monotonic()
         deleted = 0
         total = 0
@@ -984,6 +988,8 @@ class Engine:
             ids = self._matching_ids(idx, query, alias_filter, remaining)
             total += len(ids)
             for i in ids:
+                if task is not None:
+                    task.ensure_not_cancelled()
                 idx.delete_doc(i)
                 deleted += 1
             if refresh and ids:
@@ -997,7 +1003,7 @@ class Engine:
 
     def update_by_query(self, expression, query=None, script=None,
                         max_docs=None, refresh=False, pipeline=None,
-                        **res_kw) -> dict:
+                        task=None, **res_kw) -> dict:
         """POST /{index}/_update_by_query: re-index matching docs, optionally
         transformed by an update script and/or ingest pipeline."""
         from ..script.update import UpdateScript
@@ -1015,6 +1021,8 @@ class Engine:
             ids = self._matching_ids(idx, query, alias_filter, remaining)
             total += len(ids)
             for i in ids:
+                if task is not None:
+                    task.ensure_not_cancelled()
                 e = idx.docs[i]
                 src = json.loads(json.dumps(e.source))
                 op = "index"
@@ -1042,7 +1050,7 @@ class Engine:
             "version_conflicts": 0, "noops": noops, "failures": [],
         }
 
-    def reindex(self, body: dict) -> dict:
+    def reindex(self, body: dict, task=None) -> dict:
         """POST /_reindex {source: {index, query?}, dest: {index, pipeline?,
         op_type?}, script?, max_docs?} (reference: modules/reindex
         TransportReindexAction — scroll source, bulk into dest)."""
@@ -1069,6 +1077,8 @@ class Engine:
             ids = self._matching_ids(idx, source.get("query"), alias_filter, remaining)
             dst = self.get_or_autocreate(dest["index"])
             for i in ids:
+                if task is not None:
+                    task.ensure_not_cancelled()
                 total += 1
                 src = json.loads(json.dumps(idx.docs[i].source))
                 if us is not None:

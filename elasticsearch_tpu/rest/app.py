@@ -238,34 +238,114 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response(_doc_result(r, engine.resolve_write_index(name)),
                                  status=status)
 
+    async def run_task(request, action, description, fn):
+        """Run `fn(task)` under a registered task. wait_for_completion=false
+        detaches: the result lands in the task results store (the analog of
+        the reference's `.tasks` results index) and {"task": id} returns
+        immediately (reference behavior: rest-api-spec update_by_query.json /
+        reindex.json wait_for_completion param)."""
+        tm = engine.tasks
+        task = tm.register(action, description)
+        if _bool_param(request.query, "wait_for_completion", True):
+            try:
+                return web.json_response(await call(fn, task))
+            finally:
+                tm.unregister(task)
+        tm.store_placeholder(task)
+
+        def bg():
+            try:
+                tm.store_result(task, response=fn(task))
+            except ElasticsearchTpuError as ex:
+                tm.store_result(task, error=ex.to_dict()["error"])
+            except Exception as ex:  # noqa: BLE001
+                tm.store_result(task, error={"type": "exception", "reason": str(ex)})
+            finally:
+                tm.unregister(task)
+
+        app["pool"].submit(bg)
+        return web.json_response({"task": task.task_id})
+
     @handler
     async def update_by_query(request):
         body = await body_json(request, {}) or {}
-        res = await call(
-            engine.update_by_query, request.match_info["index"],
-            query=body.get("query"), script=body.get("script"),
-            max_docs=body.get("max_docs"),
-            refresh=_bool_param(request.query, "refresh"),
-            pipeline=request.query.get("pipeline"),
+        index = request.match_info["index"]
+        return await run_task(
+            request, "indices:data/write/update/byquery",
+            f"update-by-query [{index}]",
+            lambda task: engine.update_by_query(
+                index,
+                query=body.get("query"), script=body.get("script"),
+                max_docs=body.get("max_docs"),
+                refresh=_bool_param(request.query, "refresh"),
+                pipeline=request.query.get("pipeline"),
+                task=task,
+            ),
         )
-        return web.json_response(res)
 
     @handler
     async def delete_by_query(request):
         body = await body_json(request, {}) or {}
         if "query" not in body:
             raise IllegalArgumentError("query is missing")
-        res = await call(
-            engine.delete_by_query, request.match_info["index"],
-            query=body.get("query"), max_docs=body.get("max_docs"),
-            refresh=_bool_param(request.query, "refresh"),
+        index = request.match_info["index"]
+        return await run_task(
+            request, "indices:data/write/delete/byquery",
+            f"delete-by-query [{index}]",
+            lambda task: engine.delete_by_query(
+                index,
+                query=body.get("query"), max_docs=body.get("max_docs"),
+                refresh=_bool_param(request.query, "refresh"),
+                task=task,
+            ),
         )
-        return web.json_response(res)
 
     @handler
     async def reindex(request):
         body = await body_json(request, {}) or {}
-        return web.json_response(await call(engine.reindex, body))
+        return await run_task(
+            request, "indices:data/write/reindex", "reindex",
+            lambda task: engine.reindex(body, task=task),
+        )
+
+    # ---- task management -------------------------------------------------
+
+    def _tasks_by_node(tasks):
+        return {
+            "nodes": {
+                engine.tasks.node: {
+                    "name": engine.tasks.node,
+                    "transport_address": "127.0.0.1:9300",
+                    "tasks": {t.task_id: t.to_dict() for t in tasks},
+                }
+            }
+        } if tasks else {"nodes": {}}
+
+    @handler
+    async def tasks_list(request):
+        tasks = engine.tasks.list(
+            actions=request.query.get("actions"),
+            parent_task_id=request.query.get("parent_task_id"),
+        )
+        return web.json_response(_tasks_by_node(tasks))
+
+    @handler
+    async def tasks_get(request):
+        task_id = request.match_info["task_id"]
+        stored = engine.tasks.get_result(task_id)
+        if stored is not None:
+            return web.json_response(stored)
+        t = engine.tasks.get(task_id)
+        return web.json_response({"completed": False, "task": t.to_dict()})
+
+    @handler
+    async def tasks_cancel(request):
+        task_id = request.match_info.get("task_id")
+        if task_id:
+            cancelled = engine.tasks.cancel(task_id)
+        else:
+            cancelled = engine.tasks.cancel_matching(request.query.get("actions"))
+        return web.json_response(_tasks_by_node(cancelled))
 
     # ---- bulk ------------------------------------------------------------
 
@@ -919,6 +999,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/{index}/_create/{id}", create_doc)
     app.router.add_get("/{index}/_source/{id}", get_source)
     app.router.add_post("/{index}/_update/{id}", update_doc)
+    app.router.add_get("/_tasks", tasks_list)
+    app.router.add_get("/_tasks/{task_id}", tasks_get)
+    app.router.add_post("/_tasks/_cancel", tasks_cancel)
+    app.router.add_post("/_tasks/{task_id}/_cancel", tasks_cancel)
     app.router.add_post("/{index}/_update_by_query", update_by_query)
     app.router.add_post("/{index}/_delete_by_query", delete_by_query)
     app.router.add_post("/_reindex", reindex)

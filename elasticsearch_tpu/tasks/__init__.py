@@ -1,0 +1,197 @@
+"""Task management: registry, parent/child tree, cancellation propagation.
+
+The reference keeps every in-flight action in a per-node TaskManager
+(reference behavior: tasks/TaskManager.java:64 `register`, :116 unregister;
+tasks/CancellableTask.java cancellation flag checked cooperatively; ban
+propagation to child tasks via TaskCancellationService). Same model here:
+long-running engine operations register a Task, poll `ensure_not_cancelled`
+at batch boundaries (the reference checks per segment/scroll batch), and
+`wait_for_completion=false` parks results in an in-memory results store (the
+analog of the reference's `.tasks` results index,
+action/admin/cluster/node/tasks/get/TransportGetTaskAction.java).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.errors import ElasticsearchTpuError, ResourceNotFoundError
+
+
+class TaskCancelledException(ElasticsearchTpuError):
+    status = 400
+    es_type = "task_cancelled_exception"
+
+
+@dataclass
+class Task:
+    id: int
+    node: str
+    action: str
+    description: str = ""
+    cancellable: bool = False
+    parent_task_id: str | None = None
+    start_time_millis: int = 0
+    cancelled: bool = False
+    cancel_reason: str | None = None
+    children: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.node}:{self.id}"
+
+    def cancel(self, reason: str = "by user request"):
+        with self._lock:
+            if not self.cancellable or self.cancelled:
+                ok = False
+            else:
+                self.cancelled = True
+                self.cancel_reason = reason
+                ok = True
+        if ok:
+            for child in list(self.children):
+                child.cancel(reason)
+
+    def ensure_not_cancelled(self):
+        if self.cancelled:
+            raise TaskCancelledException(
+                f"task cancelled [{self.cancel_reason or 'by user request'}]"
+            )
+
+    def to_dict(self) -> dict:
+        d = {
+            "node": self.node,
+            "id": self.id,
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": self.start_time_millis,
+            "running_time_in_nanos": int(
+                (time.time() * 1000 - self.start_time_millis) * 1e6
+            ),
+            "cancellable": self.cancellable,
+            "cancelled": self.cancelled,
+        }
+        if self.parent_task_id:
+            d["parent_task_id"] = self.parent_task_id
+        return d
+
+
+class TaskManager:
+    def __init__(self, node_name: str = "node-0"):
+        self.node = node_name
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tasks: dict[int, Task] = {}
+        # task_id -> {"completed": bool, "response"/"error": ...} for
+        # wait_for_completion=false submissions
+        self._results: dict[str, dict] = {}
+
+    def register(
+        self,
+        action: str,
+        description: str = "",
+        cancellable: bool = True,
+        parent_task_id: str | None = None,
+    ) -> Task:
+        with self._lock:
+            self._seq += 1
+            task = Task(
+                id=self._seq,
+                node=self.node,
+                action=action,
+                description=description,
+                cancellable=cancellable,
+                parent_task_id=parent_task_id,
+                start_time_millis=int(time.time() * 1000),
+            )
+            self._tasks[task.id] = task
+            if parent_task_id:
+                parent = self._find(parent_task_id)
+                if parent is not None:
+                    parent.children.append(task)
+        return task
+
+    def unregister(self, task: Task):
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            if task.parent_task_id:
+                parent = self._find(task.parent_task_id)
+                if parent is not None and task in parent.children:
+                    parent.children.remove(task)
+
+    def _find(self, task_id: str) -> Task | None:
+        try:
+            node, num = task_id.rsplit(":", 1)
+            num = int(num)
+        except ValueError:
+            return None
+        if node != self.node:
+            return None
+        return self._tasks.get(num)
+
+    def get(self, task_id: str) -> Task:
+        t = self._find(task_id)
+        if t is None:
+            raise ResourceNotFoundError(f"task [{task_id}] isn't running and hasn't stored its results")
+        return t
+
+    def list(
+        self, actions: str | None = None, parent_task_id: str | None = None
+    ) -> list[Task]:
+        import fnmatch
+
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            pats = [p.strip() for p in actions.split(",") if p.strip()]
+
+            def match(t):
+                for p in pats:
+                    neg = p.startswith("-")
+                    hit = fnmatch.fnmatch(t.action, p.lstrip("-"))
+                    if neg and hit:
+                        return False
+                    if not neg and hit:
+                        return True
+                return all(p.startswith("-") for p in pats)
+
+            tasks = [t for t in tasks if match(t)]
+        if parent_task_id:
+            tasks = [t for t in tasks if t.parent_task_id == parent_task_id]
+        return tasks
+
+    def cancel(self, task_id: str, reason: str = "by user request") -> list[Task]:
+        t = self.get(task_id)
+        t.cancel(reason)
+        return [t]
+
+    def cancel_matching(self, actions: str | None, reason: str = "by user request") -> list[Task]:
+        out = []
+        for t in self.list(actions=actions):
+            if t.cancellable and not t.cancelled:
+                t.cancel(reason)
+                out.append(t)
+        return out
+
+    # ---- async results store (`.tasks` index analog) ---------------------
+
+    def store_placeholder(self, task: Task):
+        self._results[task.task_id] = {"completed": False, "task": task.to_dict()}
+
+    def store_result(self, task: Task, response=None, error=None):
+        entry = {"completed": True, "task": task.to_dict()}
+        if error is not None:
+            entry["error"] = error
+        else:
+            entry["response"] = response
+        self._results[task.task_id] = entry
+
+    def get_result(self, task_id: str) -> dict | None:
+        return self._results.get(task_id)
+
+    def delete_result(self, task_id: str) -> bool:
+        return self._results.pop(task_id, None) is not None

@@ -1,0 +1,101 @@
+"""Task registry, cancellation tree, async byquery/reindex tasks."""
+
+import asyncio
+
+import pytest
+
+from elasticsearch_tpu.tasks import TaskCancelledException, TaskManager
+
+
+def test_register_list_unregister():
+    tm = TaskManager("n1")
+    t1 = tm.register("indices:data/write/reindex", "r1")
+    t2 = tm.register("indices:data/read/search", "s1")
+    assert {t.task_id for t in tm.list()} == {t1.task_id, t2.task_id}
+    assert [t.task_id for t in tm.list(actions="*reindex")] == [t1.task_id]
+    assert [t.task_id for t in tm.list(actions="-*search")] == [t1.task_id]
+    tm.unregister(t1)
+    assert [t.task_id for t in tm.list()] == [t2.task_id]
+
+
+def test_cancel_propagates_to_children():
+    tm = TaskManager("n1")
+    parent = tm.register("parent", "")
+    child = tm.register("child", "", parent_task_id=parent.task_id)
+    tm.cancel(parent.task_id)
+    assert parent.cancelled and child.cancelled
+    with pytest.raises(TaskCancelledException):
+        child.ensure_not_cancelled()
+
+
+def test_engine_byquery_cancellation(tmp_path):
+    from elasticsearch_tpu.engine import Engine
+
+    engine = Engine(None)
+    engine.create_index("i", {"mappings": {"properties": {"n": {"type": "integer"}}}})
+    idx = engine.indices["i"]
+    for i in range(20):
+        idx.index_doc(str(i), {"n": i})
+    idx.refresh()
+    task = engine.tasks.register("indices:data/write/update/byquery", "")
+    task.cancel("test")
+    with pytest.raises(TaskCancelledException):
+        engine.update_by_query("i", query={"match_all": {}},
+                               script={"source": "ctx._source.n += 1"}, task=task)
+
+
+async def _rest_roundtrip():
+    import json
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    engine = app["engine"]
+    r = await client.put("/idx", json={"mappings": {"properties": {"n": {"type": "integer"}}}})
+    assert r.status == 200
+    lines = []
+    for i in range(10):
+        lines.append(json.dumps({"index": {"_index": "idx", "_id": str(i)}}))
+        lines.append(json.dumps({"n": i}))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/idx/_refresh")
+
+    # async update_by_query -> task id -> poll result
+    r = await client.post(
+        "/idx/_update_by_query?wait_for_completion=false",
+        json={"query": {"match_all": {}}, "script": {"source": "ctx._source.n += 10"}},
+    )
+    body = await r.json()
+    task_id = body["task"]
+    assert ":" in task_id
+    for _ in range(100):
+        r = await client.get(f"/_tasks/{task_id}")
+        got = await r.json()
+        if got["completed"]:
+            break
+        await asyncio.sleep(0.05)
+    assert got["completed"] and got["response"]["updated"] == 10
+
+    # running task visible in list + cancellable over REST
+    t = engine.tasks.register("indices:data/read/search", "slow search")
+    r = await client.get("/_tasks?actions=*search")
+    listing = await r.json()
+    assert t.task_id in listing["nodes"][engine.tasks.node]["tasks"]
+    r = await client.post(f"/_tasks/{t.task_id}/_cancel")
+    assert (await r.json())["nodes"]
+    assert t.cancelled
+    engine.tasks.unregister(t)
+
+    # unknown task -> 404
+    r = await client.get("/_tasks/node-0:99999")
+    assert r.status == 404
+    await client.close()
+
+
+def test_rest_async_task_flow():
+    asyncio.run(_rest_roundtrip())
