@@ -1,0 +1,489 @@
+"""Admin / observability engine operations.
+
+Covers the stats and introspection API family (reference specs:
+rest-api-spec/api/indices.analyze.json, indices.stats.json,
+indices.segments.json, indices.validate_query.json, termvectors.json,
+cluster.state.json, cluster.stats.json, nodes.info.json,
+indices.resolve_index.json, cat.*.json; server entry points:
+rest/action/admin/* and rest/action/cat/*)."""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import platform
+import sys
+import time
+
+from ..utils.errors import IllegalArgumentError
+
+_START_TIME = time.time()
+
+
+# ---- _analyze ------------------------------------------------------------
+
+def analyze(engine, index: str | None, body: dict) -> dict:
+    """POST /_analyze: run an analysis chain over text and show tokens."""
+    from ..analysis.analyzers import get_analyzer
+
+    texts = body.get("text")
+    if texts is None:
+        raise IllegalArgumentError("[text] is missing")
+    if isinstance(texts, str):
+        texts = [texts]
+    analyzer = None
+    if body.get("field") and index:
+        idx = engine.get_index(index)
+        ft = idx.mappings.fields.get(body["field"])
+        if ft is not None and hasattr(ft, "get_analyzer"):
+            try:
+                analyzer = ft.get_analyzer()
+            except Exception:  # noqa: BLE001 - non-text field
+                analyzer = None
+    if analyzer is None:
+        analyzer = get_analyzer(body.get("analyzer", "standard"))
+    tokens = []
+    pos_base = 0
+    for text in texts:
+        last = -1
+        for tok in analyzer.analyze(text):
+            tokens.append(
+                {
+                    "token": tok.term,
+                    "start_offset": tok.start_offset,
+                    "end_offset": tok.end_offset,
+                    "type": "<ALPHANUM>",
+                    "position": pos_base + tok.position,
+                }
+            )
+            last = max(last, tok.position)
+        pos_base += last + 1 + 100
+    return {"tokens": tokens}
+
+
+# ---- _validate/query -----------------------------------------------------
+
+def validate_query(engine, expression: str | None, body: dict, explain=False) -> dict:
+    from ..query.dsl import parse_query
+
+    query = (body or {}).get("query") or {"match_all": {}}
+    targets = engine.resolve_search(expression or "_all", allow_no_indices=True)
+    valid = True
+    error = None
+    explanations = []
+    for idx, _ in targets:
+        try:
+            node = parse_query(query, idx.mappings)
+            if explain:
+                explanations.append(
+                    {"index": idx.name, "valid": True, "explanation": repr(node)}
+                )
+        except Exception as ex:  # noqa: BLE001 - validation boundary
+            valid = False
+            error = str(ex)
+            if explain:
+                explanations.append(
+                    {"index": idx.name, "valid": False, "error": str(ex)}
+                )
+    out = {"valid": valid, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+    if explain:
+        out["explanations"] = explanations
+    if error and not explain:
+        out["error"] = error
+    return out
+
+
+# ---- _termvectors --------------------------------------------------------
+
+def termvectors(engine, index: str, doc_id: str, body: dict | None,
+                fields: str | None = None) -> dict:
+    """GET /{index}/_termvectors/{id}: re-analyze the stored source (the
+    reference computes these on the fly the same way when the field has no
+    stored term vectors, TermVectorsService.java)."""
+    idx = engine.get_index(index)
+    entry = idx.docs.get(doc_id)
+    if entry is None or not entry.alive:
+        return {"_index": index, "_id": doc_id, "found": False}
+    body = body or {}
+    want = None
+    if fields:
+        want = [f.strip() for f in fields.split(",")]
+    elif body.get("fields"):
+        want = list(body["fields"])
+    term_stats = bool(body.get("term_statistics"))
+    idx._maybe_refresh() if hasattr(idx, "_maybe_refresh") else None
+    parsed = idx.mappings.parse_document(entry.source)
+    tv = {}
+    for fld, values in parsed.items():
+        ft = idx.mappings.fields.get(fld)
+        if ft is None or ft.type not in ("text", "match_only_text"):
+            continue
+        if want is not None and fld not in want:
+            continue
+        analyzer = ft.get_analyzer()
+        terms: dict[str, dict] = {}
+        pos_base = 0
+        for v in values:
+            last = -1
+            for tok in analyzer.analyze(v):
+                t = terms.setdefault(tok.term, {"term_freq": 0, "tokens": []})
+                t["term_freq"] += 1
+                t["tokens"].append(
+                    {
+                        "position": pos_base + tok.position,
+                        "start_offset": tok.start_offset,
+                        "end_offset": tok.end_offset,
+                    }
+                )
+                last = max(last, tok.position)
+            pos_base += last + 1 + 100
+        if term_stats and idx.searcher is not None:
+            pack = getattr(idx.searcher, "sp", None)
+            for term, t in terms.items():
+                df = 0
+                if pack is not None:
+                    df = pack.global_df.get((fld, term), 0)
+                t["doc_freq"] = int(df)
+        tv[fld] = {
+            "field_statistics": {
+                "sum_doc_freq": sum(t["term_freq"] for t in terms.values()),
+                "doc_count": 1,
+                "sum_ttf": -1,
+            },
+            "terms": terms,
+        }
+    return {
+        "_index": index,
+        "_id": doc_id,
+        "_version": entry.version,
+        "found": True,
+        "took": 0,
+        "term_vectors": tv,
+    }
+
+
+# ---- stats / segments ----------------------------------------------------
+
+def _index_store_bytes(idx) -> int:
+    searcher = getattr(idx, "searcher", None)
+    stacked = getattr(searcher, "sp", None) if searcher else None
+    if stacked is not None:
+        return int(stacked.nbytes())
+    return 0
+
+
+def _index_stats_body(idx) -> dict:
+    live = sum(1 for e in idx.docs.values() if e.alive)
+    deleted = len(idx.docs) - live
+    c = getattr(idx, "counters", {})
+    primaries = {
+        "docs": {"count": live, "deleted": deleted},
+        "store": {"size_in_bytes": _index_store_bytes(idx),
+                  "total_data_set_size_in_bytes": _index_store_bytes(idx)},
+        "indexing": {
+            "index_total": c.get("index_total", 0),
+            "delete_total": c.get("delete_total", 0),
+            "index_time_in_millis": c.get("index_time_ms", 0),
+            "is_throttled": False,
+        },
+        "search": {
+            "query_total": c.get("query_total", 0),
+            "query_time_in_millis": c.get("query_time_ms", 0),
+            "fetch_total": c.get("query_total", 0),
+            "open_contexts": 0,
+        },
+        "refresh": {"total": c.get("refresh_total", 0)},
+        "get": {"total": c.get("get_total", 0)},
+    }
+    return {"uuid": getattr(idx, "uuid", idx.name), "primaries": primaries,
+            "total": primaries}
+
+
+def index_stats(engine, expression: str | None) -> dict:
+    targets = (
+        engine.resolve_search(expression, allow_no_indices=True)
+        if expression and expression not in ("_all", "*")
+        else [(i, None) for i in engine.indices.values()]
+    )
+    indices = {}
+    agg_docs = 0
+    agg_store = 0
+    for idx, _ in targets:
+        body = _index_stats_body(idx)
+        indices[idx.name] = body
+        agg_docs += body["primaries"]["docs"]["count"]
+        agg_store += body["primaries"]["store"]["size_in_bytes"]
+    return {
+        "_shards": {"total": len(indices), "successful": len(indices), "failed": 0},
+        "_all": {
+            "primaries": {"docs": {"count": agg_docs},
+                          "store": {"size_in_bytes": agg_store}},
+            "total": {"docs": {"count": agg_docs},
+                      "store": {"size_in_bytes": agg_store}},
+        },
+        "indices": indices,
+    }
+
+
+def index_segments(engine, expression: str | None) -> dict:
+    indices = {}
+    for idx, _ in engine.resolve_search(expression or "_all", allow_no_indices=True):
+        idx._maybe_refresh()
+        shards = {}
+        searcher = getattr(idx, "searcher", None)
+        stacked = getattr(searcher, "sp", None) if searcher else None
+        packs = getattr(stacked, "shards", None) if stacked else []
+        for s, pack in enumerate(packs):
+            live = int(pack.live.sum()) if pack.num_docs else 0
+            shards[str(s)] = [
+                {
+                    "routing": {"state": "STARTED", "primary": True, "node": engine.tasks.node},
+                    "num_committed_segments": 1,
+                    "num_search_segments": 1,
+                    "segments": {
+                        "_0": {
+                            "generation": 0,
+                            "num_docs": live,
+                            "deleted_docs": int(pack.num_docs) - live,
+                            "size_in_bytes": _index_store_bytes(idx) // max(len(packs), 1),
+                            "committed": True,
+                            "search": True,
+                            "version": "tpu-pack-1",
+                            "compound": False,
+                        }
+                    },
+                }
+            ]
+        indices[idx.name] = {"shards": shards}
+    return {"_shards": {"total": len(indices), "successful": len(indices), "failed": 0},
+            "indices": indices}
+
+
+# ---- cluster state / stats / nodes ---------------------------------------
+
+def cluster_state(engine, metrics: str | None = None) -> dict:
+    indices_meta = {}
+    for name, idx in engine.indices.items():
+        indices_meta[name] = {
+            "state": "open",
+            "settings": {"index": {k: str(v) for k, v in idx.settings.items()}},
+            "mappings": idx.mappings.to_dict() if hasattr(idx.mappings, "to_dict") else {},
+            "aliases": sorted(engine.meta.aliases_of(name))
+            if hasattr(engine.meta, "aliases_of") else [],
+        }
+    routing = {
+        name: {
+            "shards": {
+                str(s): [{"state": "STARTED", "primary": True,
+                          "node": engine.tasks.node, "shard": s, "index": name}]
+                for s in range(idx.num_shards)
+            }
+        }
+        for name, idx in engine.indices.items()
+    }
+    state = {
+        "cluster_name": "elasticsearch-tpu",
+        "cluster_uuid": "tpu-cluster",
+        "version": 1,
+        "state_uuid": "state-1",
+        "master_node": engine.tasks.node,
+        "nodes": {engine.tasks.node: _node_info_body()},
+        "metadata": {"indices": indices_meta, "cluster_uuid": "tpu-cluster"},
+        "routing_table": {"indices": routing},
+    }
+    if metrics:
+        keep = {m.strip() for m in metrics.split(",")}
+        if "_all" not in keep:
+            state = {k: v for k, v in state.items()
+                     if k in keep | {"cluster_name", "cluster_uuid"}}
+    return state
+
+
+def _node_info_body() -> dict:
+    import jax
+
+    return {
+        "name": "node-0",
+        "transport_address": "127.0.0.1:9300",
+        "host": "127.0.0.1",
+        "ip": "127.0.0.1",
+        "roles": ["master", "data", "ingest"],
+        "version": "8.14.0",
+        "attributes": {"accelerator": jax.default_backend()},
+    }
+
+
+def cluster_stats(engine) -> dict:
+    import jax
+
+    total_docs = 0
+    total_store = 0
+    for idx in engine.indices.values():
+        total_docs += sum(1 for e in idx.docs.values() if e.alive)
+        total_store += _index_store_bytes(idx)
+    return {
+        "cluster_name": "elasticsearch-tpu",
+        "cluster_uuid": "tpu-cluster",
+        "status": "green",
+        "indices": {
+            "count": len(engine.indices),
+            "docs": {"count": total_docs, "deleted": 0},
+            "store": {"size_in_bytes": total_store},
+            "shards": {"total": sum(i.num_shards for i in engine.indices.values())},
+        },
+        "nodes": {
+            "count": {"total": 1, "data": 1, "master": 1, "ingest": 1},
+            "versions": ["8.14.0"],
+            "os": {"available_processors": os.cpu_count(),
+                   "names": [{"name": platform.system(), "count": 1}]},
+            "jvm": {"versions": [{"version": sys.version.split()[0],
+                                  "vm_name": "CPython", "count": 1}]},
+            "accelerators": {"backend": jax.default_backend(),
+                             "device_count": jax.device_count()},
+        },
+    }
+
+
+def nodes_info(engine) -> dict:
+    return {
+        "_nodes": {"total": 1, "successful": 1, "failed": 0},
+        "cluster_name": "elasticsearch-tpu",
+        "nodes": {engine.tasks.node: {
+            **_node_info_body(),
+            "settings": {},
+            "os": {"name": platform.system(), "arch": platform.machine(),
+                   "available_processors": os.cpu_count()},
+            "process": {"id": os.getpid(), "mlockall": False},
+            "jvm": {"version": sys.version.split()[0], "vm_name": "CPython",
+                    "start_time_in_millis": int(_START_TIME * 1000)},
+        }},
+    }
+
+
+def resolve_index(engine, expression: str) -> dict:
+    names = [p.strip() for p in expression.split(",")]
+    indices = []
+    aliases = []
+    seen = set()
+    alias_map = getattr(engine.meta, "aliases", {}) or {}
+    for pat in names:
+        for name in sorted(engine.indices):
+            if fnmatch.fnmatch(name, pat) and name not in seen:
+                seen.add(name)
+                indices.append({"name": name, "attributes": ["open"]})
+        for alias in sorted(alias_map):
+            if fnmatch.fnmatch(alias, pat):
+                aliases.append({"name": alias,
+                                "indices": sorted(alias_map[alias])})
+    return {"indices": indices, "aliases": aliases, "data_streams": []}
+
+
+# ---- _cat ----------------------------------------------------------------
+
+def cat_render(rows: list[dict], request_query) -> tuple[str, str]:
+    """Shared _cat renderer: text columns or JSON; `h` selects columns,
+    `v` adds the header line (reference behavior: rest/action/cat/
+    AbstractCatAction + RestTable)."""
+    import json as _json
+
+    cols = list(rows[0].keys()) if rows else []
+    if request_query.get("h"):
+        want = [c.strip() for c in request_query["h"].split(",")]
+        cols = [c for c in want if not rows or c in rows[0]]
+    if request_query.get("format") == "json":
+        return (
+            _json.dumps([{c: r.get(c) for c in cols} for r in rows]),
+            "application/json",
+        )
+    verbose = request_query.get("v") in ("", "true", "1")
+    table = [[str(r.get(c, "")) for c in cols] for r in rows]
+    if verbose:
+        table.insert(0, cols)
+    widths = [max((len(row[i]) for row in table), default=0) for i in range(len(cols))]
+    lines = [" ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+             for row in table]
+    return ("\n".join(lines) + ("\n" if lines else ""), "text/plain")
+
+
+def cat_health(engine) -> list[dict]:
+    h = engine.cluster_health() if hasattr(engine, "cluster_health") else {}
+    return [{
+        "epoch": int(time.time()),
+        "timestamp": time.strftime("%H:%M:%S"),
+        "cluster": "elasticsearch-tpu",
+        "status": h.get("status", "green"),
+        "node.total": 1, "node.data": 1,
+        "shards": h.get("active_primary_shards",
+                        sum(i.num_shards for i in engine.indices.values())),
+        "pri": h.get("active_primary_shards",
+                     sum(i.num_shards for i in engine.indices.values())),
+        "relo": 0, "init": 0, "unassign": 0,
+        "pending_tasks": 0,
+        "active_shards_percent": "100.0%",
+    }]
+
+
+def cat_nodes(engine) -> list[dict]:
+    import jax
+
+    return [{
+        "ip": "127.0.0.1", "heap.percent": 0, "ram.percent": 0, "cpu": 0,
+        "load_1m": "", "load_5m": "", "load_15m": "",
+        "node.role": "dim", "master": "*", "name": engine.tasks.node,
+        "accelerator": jax.default_backend(),
+    }]
+
+
+def cat_count(engine, expression: str | None) -> list[dict]:
+    total = 0
+    targets = (
+        engine.resolve_search(expression, allow_no_indices=True)
+        if expression else [(i, None) for i in engine.indices.values()]
+    )
+    for idx, _ in targets:
+        total += sum(1 for e in idx.docs.values() if e.alive)
+    return [{"epoch": int(time.time()),
+             "timestamp": time.strftime("%H:%M:%S"), "count": total}]
+
+
+def cat_shards(engine, expression: str | None) -> list[dict]:
+    out = []
+    for name in sorted(engine.indices):
+        if expression and not any(
+            fnmatch.fnmatch(name, p) for p in expression.split(",")
+        ):
+            continue
+        idx = engine.indices[name]
+        live = sum(1 for e in idx.docs.values() if e.alive)
+        per = _index_store_bytes(idx) // max(idx.num_shards, 1)
+        for s in range(idx.num_shards):
+            out.append({
+                "index": name, "shard": s, "prirep": "p", "state": "STARTED",
+                "docs": live // max(idx.num_shards, 1), "store": f"{per}b",
+                "ip": "127.0.0.1", "node": engine.tasks.node,
+            })
+    return out
+
+
+def cat_aliases(engine) -> list[dict]:
+    alias_map = getattr(engine.meta, "aliases", {}) or {}
+    out = []
+    for alias in sorted(alias_map):
+        for index in sorted(alias_map[alias]):
+            meta = alias_map[alias][index] if isinstance(alias_map[alias], dict) else {}
+            out.append({
+                "alias": alias, "index": index,
+                "filter": "*" if (meta or {}).get("filter") else "-",
+                "routing.index": "-", "routing.search": "-", "is_write_index": "-",
+            })
+    return out
+
+
+def cat_templates(engine) -> list[dict]:
+    templates = getattr(engine.meta, "index_templates", {}) or {}
+    return [
+        {"name": name, "index_patterns": str(t.get("index_patterns", [])),
+         "order": t.get("priority", 0), "version": t.get("version", ""),
+         "composed_of": str(t.get("composed_of", []))}
+        for name, t in sorted(templates.items())
+    ]

@@ -107,6 +107,9 @@ class EsIndex:
         self._last_refresh = 0.0
         self.searcher: StackedSearcher | None = None
         self.shard_docs: list[list[tuple[str, dict]]] = []
+        # operation counters surfaced by _stats (reference behavior:
+        # index/shard/ shard-level CommonStats)
+        self.counters: dict[str, int] = {}
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._persist_meta()
@@ -259,6 +262,7 @@ class EsIndex:
         if len(self.mappings.fields) != n_fields:
             self._persist_meta()  # dynamic mappings grew
         self._dirty = True
+        self.counters["index_total"] = self.counters.get("index_total", 0) + 1
         created = existing is None or not existing.alive
         return {"_id": doc_id, "_version": version, "_seq_no": seq,
                 "result": "created" if created else "updated"}
@@ -273,6 +277,7 @@ class EsIndex:
         self.seq_no += 1
         self._wal_append({"op": "delete", "id": doc_id, "version": e.version, "seq_no": e.seq_no})
         self._dirty = True
+        self.counters["delete_total"] = self.counters.get("delete_total", 0) + 1
         return {"_id": doc_id, "_version": e.version, "_seq_no": e.seq_no, "result": "deleted"}
 
     def get_doc(self, doc_id: str):
@@ -309,6 +314,7 @@ class EsIndex:
         self.shard_docs = routed
         self._dirty = False
         self._last_refresh = time.monotonic()
+        self.counters["refresh_total"] = self.counters.get("refresh_total", 0) + 1
 
     def _maybe_refresh(self):
         if self.searcher is None:  # safety; construction always refreshes
@@ -365,6 +371,7 @@ class EsIndex:
         sort=None, search_after=None, script_fields=None,
     ):
         self._maybe_refresh()
+        self.counters["query_total"] = self.counters.get("query_total", 0) + 1
         from ..aggs.pipeline import apply_pipeline_aggs, strip_pipeline_aggs
         from ..query.sort import is_score_only, parse_sort
 

@@ -308,6 +308,109 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             lambda task: engine.reindex(body, task=task),
         )
 
+    # ---- admin / observability -------------------------------------------
+
+    @handler
+    async def analyze_api(request):
+        from ..engine import admin
+
+        body = await body_json(request, {}) or {}
+        # GET variant allows text/analyzer as query params
+        for p in ("text", "analyzer", "field"):
+            if p in request.query and p not in body:
+                body[p] = request.query[p]
+        return web.json_response(
+            await call(admin.analyze, engine, request.match_info.get("index"), body)
+        )
+
+    @handler
+    async def validate_query_api(request):
+        from ..engine import admin
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            admin.validate_query, engine, request.match_info.get("index"),
+            body, _bool_param(request.query, "explain"),
+        ))
+
+    @handler
+    async def termvectors_api(request):
+        from ..engine import admin
+
+        body = await body_json(request, None)
+        return web.json_response(await call(
+            admin.termvectors, engine, request.match_info["index"],
+            request.match_info["id"], body, request.query.get("fields"),
+        ))
+
+    @handler
+    async def index_stats_api(request):
+        from ..engine import admin
+
+        return web.json_response(
+            await call(admin.index_stats, engine, request.match_info.get("index"))
+        )
+
+    @handler
+    async def index_segments_api(request):
+        from ..engine import admin
+
+        return web.json_response(
+            await call(admin.index_segments, engine, request.match_info.get("index"))
+        )
+
+    @handler
+    async def cluster_state_api(request):
+        from ..engine import admin
+
+        return web.json_response(await call(
+            admin.cluster_state, engine, request.match_info.get("metrics")
+        ))
+
+    @handler
+    async def cluster_stats_api(request):
+        from ..engine import admin
+
+        return web.json_response(await call(admin.cluster_stats, engine))
+
+    @handler
+    async def nodes_info_api(request):
+        from ..engine import admin
+
+        return web.json_response(await call(admin.nodes_info, engine))
+
+    @handler
+    async def resolve_index_api(request):
+        from ..engine import admin
+
+        return web.json_response(await call(
+            admin.resolve_index, engine, request.match_info["name"]
+        ))
+
+    def _cat_endpoint(rows_fn):
+        @handler
+        async def cat(request):
+            from ..engine import admin
+
+            rows = await call(rows_fn, request)
+            text, ctype = admin.cat_render(rows, request.query)
+            return web.Response(text=text, content_type=ctype)
+
+        return cat
+
+    from ..engine import admin as _admin
+
+    cat_health_api = _cat_endpoint(lambda req: _admin.cat_health(engine))
+    cat_nodes_api = _cat_endpoint(lambda req: _admin.cat_nodes(engine))
+    cat_count_api = _cat_endpoint(
+        lambda req: _admin.cat_count(engine, req.match_info.get("index"))
+    )
+    cat_shards_api = _cat_endpoint(
+        lambda req: _admin.cat_shards(engine, req.match_info.get("index"))
+    )
+    cat_aliases_api = _cat_endpoint(lambda req: _admin.cat_aliases(engine))
+    cat_templates_api = _cat_endpoint(lambda req: _admin.cat_templates(engine))
+
     # ---- task management -------------------------------------------------
 
     def _tasks_by_node(tasks):
@@ -999,6 +1102,28 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/{index}/_create/{id}", create_doc)
     app.router.add_get("/{index}/_source/{id}", get_source)
     app.router.add_post("/{index}/_update/{id}", update_doc)
+    app.router.add_route("*", "/_analyze", analyze_api)
+    app.router.add_route("*", "/{index}/_analyze", analyze_api)
+    app.router.add_route("*", "/_validate/query", validate_query_api)
+    app.router.add_route("*", "/{index}/_validate/query", validate_query_api)
+    app.router.add_route("*", "/{index}/_termvectors/{id}", termvectors_api)
+    app.router.add_get("/_stats", index_stats_api)
+    app.router.add_get("/{index}/_stats", index_stats_api)
+    app.router.add_get("/_segments", index_segments_api)
+    app.router.add_get("/{index}/_segments", index_segments_api)
+    app.router.add_get("/_cluster/state", cluster_state_api)
+    app.router.add_get("/_cluster/state/{metrics}", cluster_state_api)
+    app.router.add_get("/_cluster/stats", cluster_stats_api)
+    app.router.add_get("/_nodes", nodes_info_api)
+    app.router.add_get("/_resolve/index/{name}", resolve_index_api)
+    app.router.add_get("/_cat/health", cat_health_api)
+    app.router.add_get("/_cat/nodes", cat_nodes_api)
+    app.router.add_get("/_cat/count", cat_count_api)
+    app.router.add_get("/_cat/count/{index}", cat_count_api)
+    app.router.add_get("/_cat/shards", cat_shards_api)
+    app.router.add_get("/_cat/shards/{index}", cat_shards_api)
+    app.router.add_get("/_cat/aliases", cat_aliases_api)
+    app.router.add_get("/_cat/templates", cat_templates_api)
     app.router.add_get("/_tasks", tasks_list)
     app.router.add_get("/_tasks/{task_id}", tasks_get)
     app.router.add_post("/_tasks/_cancel", tasks_cancel)

@@ -1,0 +1,90 @@
+"""Admin/observability API surface: _analyze, _validate, _termvectors,
+_stats, _segments, _cluster/state+stats, _nodes, _resolve, _cat/*."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.rest.app import make_app
+
+
+async def _setup():
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/logs", json={"mappings": {"properties": {
+        "msg": {"type": "text"}, "level": {"type": "keyword"}}}})
+    lines = []
+    for i in range(6):
+        lines.append(json.dumps({"index": {"_index": "logs", "_id": str(i)}}))
+        lines.append(json.dumps({"msg": f"error in module {i}", "level": "ERROR" if i % 2 else "INFO"}))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/logs/_refresh")
+    return app, client
+
+
+async def _drive():
+    app, client = await _setup()
+
+    r = await client.post("/_analyze", json={"analyzer": "standard", "text": "Hello, World's TPUs!"})
+    toks = (await r.json())["tokens"]
+    assert [t["token"] for t in toks] == ["hello", "world's", "tpus"]
+    assert toks[0]["start_offset"] == 0 and toks[0]["position"] == 0
+
+    r = await client.post("/logs/_analyze", json={"field": "msg", "text": "A B"})
+    assert [t["token"] for t in (await r.json())["tokens"]] == ["a", "b"]
+
+    r = await client.post("/logs/_validate/query?explain=true",
+                          json={"query": {"match": {"msg": "error"}}})
+    body = await r.json()
+    assert body["valid"] and body["explanations"][0]["valid"]
+    r = await client.post("/logs/_validate/query",
+                          json={"query": {"no_such_query": {}}})
+    assert (await r.json())["valid"] is False
+
+    r = await client.get("/logs/_termvectors/1?term_statistics=true")
+    tv = await r.json()
+    assert tv["found"] and "msg" in tv["term_vectors"]
+    assert tv["term_vectors"]["msg"]["terms"]["error"]["term_freq"] == 1
+
+    r = await client.get("/logs/_stats")
+    st = await r.json()
+    assert st["indices"]["logs"]["primaries"]["docs"]["count"] == 6
+    assert st["indices"]["logs"]["primaries"]["indexing"]["index_total"] == 6
+    assert st["indices"]["logs"]["primaries"]["store"]["size_in_bytes"] > 0
+
+    r = await client.get("/logs/_segments")
+    seg = await r.json()
+    assert "0" in seg["indices"]["logs"]["shards"]
+
+    r = await client.get("/_cluster/state")
+    cs = await r.json()
+    assert "logs" in cs["metadata"]["indices"]
+    assert "logs" in cs["routing_table"]["indices"]
+
+    r = await client.get("/_cluster/stats")
+    assert (await r.json())["indices"]["docs"]["count"] == 6
+
+    r = await client.get("/_nodes")
+    assert (await r.json())["_nodes"]["total"] == 1
+
+    r = await client.get("/_resolve/index/lo*")
+    assert (await r.json())["indices"][0]["name"] == "logs"
+
+    r = await client.get("/_cat/health")
+    assert "green" in await r.text()
+    r = await client.get("/_cat/count?format=json")
+    assert json.loads(await r.text())[0]["count"] == 6
+    r = await client.get("/_cat/shards?v=true")
+    text = await r.text()
+    assert "logs" in text and "STARTED" in text
+    r = await client.get("/_cat/nodes?h=name,accelerator")
+    assert "node-0" in await r.text()
+
+    await client.close()
+
+
+def test_admin_apis():
+    asyncio.run(_drive())
