@@ -829,3 +829,490 @@ class GlobalAgg(AggNode):
         }
 
     finalize = FilterAgg.finalize
+
+
+class ExtendedStatsAgg(_FieldMetricAgg):
+    """stats + sum_of_squares/variance/std_deviation (+bounds), matching the
+    reference's population statistics (reference behavior:
+    search/aggregations/metrics/ExtendedStatsAggregator.java)."""
+
+    _MERGE_RULES = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+                    "sumsq": "sum"}
+
+    def __init__(self, name, fld, sigma=2.0, children=None):
+        super().__init__(name, fld, children)
+        self.sigma = float(sigma)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        got = _numeric_values(dev, self.fld, ctx)
+        if got is None:
+            z = jnp.zeros(nseg, jnp.float32)
+            return {"sum": z, "count": jnp.zeros(nseg, jnp.int32),
+                    "min": z + np.inf, "max": z - np.inf, "sumsq": z}
+        v, h, kind = got
+        ok = valid & h
+        vf = v.astype(jnp.float32)
+        return {
+            "sum": _seg_scatter(seg, nseg, ok, vf, jnp.float32(0), "add"),
+            "sumsq": _seg_scatter(seg, nseg, ok, vf * vf, jnp.float32(0), "add"),
+            "count": _seg_scatter(seg, nseg, ok, jnp.ones_like(seg), jnp.int32(0), "add"),
+            "min": _seg_scatter(seg, nseg, ok, vf, jnp.float32(np.inf), "min"),
+            "max": _seg_scatter(seg, nseg, ok, vf, jnp.float32(-np.inf), "max"),
+        }
+
+    def finalize(self, out, nseg):
+        res = []
+        for i in range(nseg):
+            c = int(out["count"][i])
+            s = float(out["sum"][i])
+            sq = float(out["sumsq"][i])
+            if c:
+                avg = s / c
+                var = max(sq / c - avg * avg, 0.0)
+                std = var ** 0.5
+            else:
+                avg = var = std = None
+            entry = {
+                "count": c,
+                "min": float(out["min"][i]) if c else None,
+                "max": float(out["max"][i]) if c else None,
+                "avg": avg, "sum": s,
+                "sum_of_squares": sq if c else None,
+                "variance": var,
+                "variance_population": var,
+                "std_deviation": std,
+                "std_deviation_population": std,
+            }
+            if c:
+                entry["std_deviation_bounds"] = {
+                    "upper": avg + self.sigma * std,
+                    "lower": avg - self.sigma * std,
+                }
+            res.append(entry)
+        return res
+
+
+class WeightedAvgAgg(AggNode):
+    """weighted_avg {value: {field}, weight: {field}} (reference behavior:
+    search/aggregations/metrics/WeightedAvgAggregator.java — docs missing
+    either side are skipped)."""
+
+    _MERGE_RULES = {"vw": "sum", "w": "sum"}
+
+    def __init__(self, name, value_field, weight_field, children=None):
+        super().__init__(name, children)
+        if children:
+            raise IllegalArgumentError("weighted_avg cannot have sub-aggregations")
+        self.vf = value_field
+        self.wf = weight_field
+
+    def prepare(self, pack, mappings):
+        return {}, ("weighted_avg", self.vf, self.wf,
+                    pack.docvalues.get(self.vf) is None,
+                    pack.docvalues.get(self.wf) is None)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        gv = _numeric_values(dev, self.vf, ctx)
+        gw = _numeric_values(dev, self.wf, ctx)
+        z = jnp.zeros(nseg, jnp.float32)
+        if gv is None or gw is None:
+            return {"vw": z, "w": z}
+        v, hv, _ = gv
+        w, hw, _ = gw
+        ok = valid & hv & hw
+        vf = v.astype(jnp.float32)
+        wf = w.astype(jnp.float32)
+        return {
+            "vw": _seg_scatter(seg, nseg, ok, vf * wf, jnp.float32(0), "add"),
+            "w": _seg_scatter(seg, nseg, ok, wf, jnp.float32(0), "add"),
+        }
+
+    def finalize(self, out, nseg):
+        res = []
+        for i in range(nseg):
+            w = float(out["w"][i])
+            res.append({"value": float(out["vw"][i]) / w if w else None})
+        return res
+
+
+class RareTermsAgg(TermsAgg):
+    """rare_terms: buckets whose doc_count <= max_doc_count, ordered by count
+    asc then key asc (reference behavior:
+    bucket/terms/RareTermsAggregator.java — exact here, no CuckooFilter)."""
+
+    def __init__(self, name, fld, max_doc_count=1, children=None, missing=None):
+        super().__init__(name, fld, size=MAX_BUCKETS, children=children)
+        self.max_doc_count = int(max_doc_count)
+
+    def prepare(self, pack, mappings):
+        params, key = super().prepare(pack, mappings)
+        return params, ("rare",) + key[1:] + (self.max_doc_count,)
+
+    def finalize(self, out, nseg):
+        V = self.V
+        counts = np.asarray(out["counts"])
+        child_frags = self._finalize_children(out, nseg * V) if (self.children and V > 0) else None
+        res = []
+        for i in range(nseg):
+            if V == 0:
+                res.append({"buckets": []})
+                continue
+            c = counts[i]
+            sel = np.flatnonzero((c > 0) & (c <= self.max_doc_count))
+            sel = sel[np.argsort(c[sel], kind="stable")]
+            buckets = []
+            for j in sel:
+                b = {"key": self.keys[j], "doc_count": int(c[j])}
+                if child_frags is not None:
+                    b.update(child_frags[i * V + j])
+                buckets.append(b)
+            res.append({"buckets": buckets})
+        return res
+
+
+class MultiTermsAgg(AggNode):
+    """multi_terms: compound keys over 2+ ordinal fields (reference behavior:
+    bucket/terms/MultiTermsAggregator.java). Bucket space is the static
+    product of per-field vocabularies; empty combos trim host-side."""
+
+    _MERGE_RULES = {"counts": "sum"}
+
+    def __init__(self, name, fields, size=10, order=None, children=None):
+        super().__init__(name, children)
+        if len(fields) < 2:
+            raise IllegalArgumentError("multi_terms requires at least 2 terms sources")
+        self.flds = fields
+        self.size = size
+        self.order = order or {"_count": "desc"}
+
+    def prepare(self, pack, mappings):
+        self.keys_per = []
+        for f in self.flds:
+            col = pack.docvalues.get(f)
+            if col is None:
+                self.keys_per.append([])
+            elif col.kind == "ord":
+                self.keys_per.append(list(col.ord_terms or []))
+            elif col.uniq_values is not None:
+                self.keys_per.append([int(x) for x in col.uniq_values])
+            else:
+                raise IllegalArgumentError(
+                    f"multi_terms on float field [{f}] is not supported")
+        self.Vs = [len(k) for k in self.keys_per]
+        cparams, ckey = self._prepare_children(pack, mappings)
+        return {"children": cparams}, ("multi_terms", tuple(self.flds),
+                                       tuple(self.Vs), self.size, ckey)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        V = 1
+        for v in self.Vs:
+            V *= v
+        self.V = V
+        if V == 0:
+            return {"counts": jnp.zeros((nseg, 1), jnp.int32), "children": {}}
+        if nseg * V > MAX_SEGMENT_PRODUCT:
+            raise IllegalArgumentError(
+                f"multi_terms{self.flds}: {nseg}x{V} buckets exceeds bucket budget")
+        sub = seg
+        ok = valid
+        for f, vsize in zip(self.flds, self.Vs):
+            ords, h = _ordinal_column(dev, f)
+            ok = ok & h & (ords >= 0)
+            sub = sub * vsize + jnp.where(ords >= 0, ords, 0)
+        counts = _seg_scatter(sub, nseg * V, ok, jnp.ones_like(seg), jnp.int32(0), "add").reshape(nseg, V)
+        return {
+            "counts": counts,
+            "children": self._eval_children(dev, {"children": params["children"]}, sub, nseg * V, ok, ctx),
+        }
+
+    def finalize(self, out, nseg):
+        V = getattr(self, "V", 1)
+        counts = np.asarray(out["counts"])
+        child_frags = self._finalize_children(out, nseg * V) if (self.children and V > 0) else None
+        (order_key, order_dir), = self.order.items()
+        res = []
+        for i in range(nseg):
+            if V == 0:
+                res.append({"buckets": []})
+                continue
+            c = counts[i]
+            if order_key == "_key":
+                idx = np.arange(V) if order_dir == "asc" else np.arange(V)[::-1]
+                idx = idx[c[idx] > 0][: self.size]
+            else:
+                idx = np.argsort(-c, kind="stable")[: self.size]
+                idx = idx[c[idx] > 0]
+            buckets = []
+            for j in idx:
+                parts = []
+                rem = int(j)
+                for vsize in reversed(self.Vs):
+                    parts.append(rem % vsize)
+                    rem //= vsize
+                key = [self.keys_per[d][p] for d, p in enumerate(reversed(parts))]
+                b = {
+                    "key": key,
+                    "key_as_string": "|".join(str(k) for k in key),
+                    "doc_count": int(c[j]),
+                }
+                if child_frags is not None:
+                    b.update(child_frags[i * V + j])
+                buckets.append(b)
+            res.append({"doc_count_error_upper_bound": 0,
+                        "sum_other_doc_count": int(c.sum() - sum(b["doc_count"] for b in buckets)),
+                        "buckets": buckets})
+        return res
+
+
+class SignificantTermsAgg(AggNode):
+    """significant_terms via JLH scoring of foreground (query matches) vs
+    background (whole index) frequencies (reference behavior:
+    bucket/terms/SignificantTermsAggregatorFactory.java + JLHScore.java)."""
+
+    _MERGE_RULES = {"fg": "sum", "bg": "sum", "fg_total": "sum", "bg_total": "sum"}
+
+    def __init__(self, name, fld, size=10, min_doc_count=3, children=None):
+        super().__init__(name, children)
+        self.fld = fld
+        self.size = size
+        self.min_doc_count = int(min_doc_count)
+
+    def prepare(self, pack, mappings):
+        col = pack.docvalues.get(self.fld)
+        self.keys = []
+        if col is not None:
+            if col.kind == "ord":
+                self.keys = list(col.ord_terms or [])
+            elif col.uniq_values is not None:
+                self.keys = [int(x) for x in col.uniq_values]
+        self.V = len(self.keys)
+        cparams, ckey = self._prepare_children(pack, mappings)
+        return {"children": cparams}, ("sig_terms", self.fld, self.V, self.size,
+                                       self.min_doc_count, ckey)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        V = self.V
+        if V == 0:
+            z = jnp.zeros((nseg, 1), jnp.int32)
+            return {"fg": z, "bg": jnp.zeros(1, jnp.int32),
+                    "fg_total": jnp.zeros(nseg, jnp.int32),
+                    "bg_total": jnp.zeros((), jnp.int32), "children": {}}
+        if nseg * V > MAX_SEGMENT_PRODUCT:
+            raise IllegalArgumentError(
+                f"significant_terms[{self.fld}]: bucket budget exceeded")
+        ords, h = _ordinal_column(dev, self.fld)
+        live = dev["live"]
+        ok = valid & h & (ords >= 0)
+        bg_ok = live & h & (ords >= 0)
+        sub = seg * V + ords
+        fg = _seg_scatter(sub, nseg * V, ok, jnp.ones_like(seg), jnp.int32(0), "add").reshape(nseg, V)
+        bg = _seg_scatter(jnp.where(ords >= 0, ords, 0), V, bg_ok,
+                          jnp.ones_like(seg), jnp.int32(0), "add")
+        return {
+            "fg": fg,
+            "bg": bg,
+            "fg_total": _seg_scatter(seg, nseg, valid, jnp.ones_like(seg), jnp.int32(0), "add"),
+            "bg_total": jnp.sum(live, dtype=jnp.int32),
+            "children": self._eval_children(dev, {"children": params["children"]}, sub, nseg * V, ok, ctx),
+        }
+
+    def finalize(self, out, nseg):
+        V = self.V
+        if V == 0:
+            return [{"doc_count": 0, "bg_count": 0, "buckets": []} for _ in range(nseg)]
+        fg = np.asarray(out["fg"], np.float64)
+        bg = np.asarray(out["bg"], np.float64)
+        fg_total = np.asarray(out["fg_total"], np.float64).reshape(nseg)
+        bg_total = float(np.asarray(out["bg_total"]).reshape(-1)[0])
+        child_frags = self._finalize_children(out, nseg * V) if self.children else None
+        res = []
+        for i in range(nseg):
+            ft = fg_total[i]
+            buckets = []
+            if ft > 0 and bg_total > 0:
+                fr = fg[i] / ft
+                br = np.where(bg > 0, bg / bg_total, 0.0)
+                # JLH: (fg% - bg%) * (fg% / bg%), only when fg% > bg%
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    score = np.where(
+                        (fr > br) & (br > 0), (fr - br) * (fr / br), 0.0
+                    )
+                sel = np.flatnonzero((score > 0) & (fg[i] >= self.min_doc_count))
+                sel = sel[np.argsort(-score[sel], kind="stable")][: self.size]
+                for j in sel:
+                    b = {
+                        "key": self.keys[j],
+                        "doc_count": int(fg[i][j]),
+                        "score": float(score[j]),
+                        "bg_count": int(bg[j]),
+                    }
+                    if child_frags is not None:
+                        b.update(child_frags[i * V + j])
+                    buckets.append(b)
+            res.append({"doc_count": int(ft), "bg_count": int(bg_total), "buckets": buckets})
+        return res
+
+
+class DateRangeAgg(RangeAgg):
+    """date_range: range agg with date-expression bounds resolved to epoch
+    millis at parse time (reference behavior:
+    bucket/range/DateRangeAggregationBuilder.java)."""
+
+    def __init__(self, name, fld, ranges, keyed=False, children=None, format=None):
+        from ..index.mappings import parse_date_to_millis
+
+        resolved = []
+        self._raw = ranges
+        for r in ranges:
+            rr = dict(r)
+            for side in ("from", "to"):
+                if rr.get(side) is not None and not isinstance(rr[side], (int, float)):
+                    rr[side] = parse_date_to_millis(rr[side])
+            resolved.append(rr)
+        super().__init__(name, fld, resolved, keyed, children)
+
+
+class TopHitsAgg(AggNode):
+    """top_hits: per-bucket top-k docs by query score, docid-asc tie-break
+    (reference behavior: search/aggregations/metrics/TopHitsAggregator.java).
+    Device emits (score, local docid) pairs; the engine resolves them to
+    _id/_source host-side (EsIndex.search top-hits resolution), the analog of
+    the reference's fetch-phase sub-search."""
+
+    def __init__(self, name, size=3, children=None):
+        super().__init__(name, children)
+        if children:
+            raise IllegalArgumentError("top_hits cannot have sub-aggregations")
+        self.size = max(1, int(size))
+
+    def prepare(self, pack, mappings):
+        return {}, ("top_hits", self.size)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        scores = dev.get("_query_scores")
+        n = seg.shape[0]
+        if scores is None:  # sorted-search path: no scores; doc order
+            scores = jnp.zeros(n, jnp.float32)
+        else:
+            scores = scores[:n]
+        docids = jnp.arange(n, dtype=jnp.int32)
+        remaining = valid
+        out_s, out_d = [], []
+        for _ in range(self.size):
+            m = _seg_scatter(seg, nseg, remaining, scores, jnp.float32(-np.inf), "max")
+            seg_c = jnp.clip(seg, 0, nseg - 1)
+            ismax = remaining & (scores == m[seg_c])
+            dmin = _seg_scatter(seg, nseg, ismax, docids, jnp.int32(2**31 - 1), "min")
+            out_s.append(m)
+            out_d.append(dmin)
+            remaining = remaining & ~(ismax & (docids == dmin[seg_c]))
+        return {
+            "scores": jnp.stack(out_s, axis=1),  # [nseg, k]
+            "ids": jnp.stack(out_d, axis=1),
+            "count": _seg_scatter(seg, nseg, valid, jnp.ones_like(seg), jnp.int32(0), "add"),
+        }
+
+    def merge_partials(self, stacked):
+        # keep per-shard candidates; finalize picks the global top and tags
+        # each hit with its shard
+        return {
+            "scores": np.asarray(stacked["scores"]),  # [S, nseg, k]
+            "ids": np.asarray(stacked["ids"]),
+            "count": np.asarray(stacked["count"]).sum(axis=0),
+            "_sharded": True,
+        }
+
+    def finalize(self, out, nseg):
+        scores = np.asarray(out["scores"])
+        ids = np.asarray(out["ids"])
+        counts = np.asarray(out["count"]).reshape(nseg)
+        if not out.get("_sharded"):
+            scores = scores[None, :]  # [1, nseg, k]
+            ids = ids[None, :]
+        S, _, k = scores.shape
+        res = []
+        for i in range(nseg):
+            cands = []
+            for s in range(S):
+                for j in range(k):
+                    sc = float(scores[s, i, j])
+                    d = int(ids[s, i, j])
+                    if np.isfinite(sc) and d != 2**31 - 1:
+                        cands.append((-sc, s, d))
+            cands.sort()
+            hits = [
+                {"_shard": s, "_doc": d, "_score": -negs, "_resolve_top_hit": True}
+                for negs, s, d in cands[: self.size]
+            ]
+            total = int(counts[i])
+            res.append({
+                "hits": {
+                    "total": {"value": total, "relation": "eq"},
+                    "max_score": hits[0]["_score"] if hits else None,
+                    "hits": hits,
+                }
+            })
+        return res
+
+
+# ES auto_date_histogram rounding ladder (reference behavior:
+# bucket/histogram/AutoDateHistogramAggregationBuilder.java RoundingInfos):
+# (fixed millis, label) tiers below month; month/year tiers via month index.
+_AUTO_DH_FIXED = [
+    (1000, "1s"), (5000, "5s"), (10000, "10s"), (30000, "30s"),
+    (60000, "1m"), (300000, "5m"), (600000, "10m"), (1800000, "30m"),
+    (3600000, "1h"), (10800000, "3h"), (43200000, "12h"),
+    (86400000, "1d"), (604800000, "7d"),
+]
+_AUTO_DH_MONTHS = [(1, "1M"), (3, "3M"), (12, "1y"), (60, "5y"),
+                   (120, "10y"), (240, "20y"), (600, "50y"), (1200, "100y")]
+
+
+class AutoDateHistogramAgg(AggNode):
+    """auto_date_histogram: picks the smallest rounding that keeps the bucket
+    count under `buckets` from the column's min/max (static at prepare time,
+    like every other bucket plan here), then delegates to DateHistogramAgg."""
+
+    def __init__(self, name, fld, buckets=10, children=None, format=None):
+        super().__init__(name, children)
+        self.fld = fld
+        self.target = max(1, int(buckets))
+
+    def _choose(self, vmin: int, vmax: int) -> tuple[str, str]:
+        span = max(vmax - vmin, 0)
+        for ms, label in _AUTO_DH_FIXED:
+            if span // ms + 1 <= self.target:
+                return "fixed", label
+        lo, hi = _month_index_host(vmin), _month_index_host(vmax)
+        for months, label in _AUTO_DH_MONTHS:
+            if (hi - lo) // months + 1 <= self.target:
+                return "calendar", label
+        return "calendar", _AUTO_DH_MONTHS[-1][1]
+
+    def prepare(self, pack, mappings):
+        col = pack.docvalues.get(self.fld)
+        if col is None or not col.has_value.any():
+            mode, label = "fixed", "1s"
+        else:
+            mode, label = self._choose(int(col.vmin), int(col.vmax))
+        self.interval_label = label
+        self._delegate = DateHistogramAgg(
+            self.name, self.fld,
+            fixed_interval=label if mode == "fixed" else None,
+            calendar_interval=label if mode == "calendar" else None,
+            children=self.children, min_doc_count=1,
+        )
+        params, key = self._delegate.prepare(pack, mappings)
+        return params, ("auto_dh", label) + key
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        return self._delegate.device_eval_segmented(dev, params, seg, nseg, valid, ctx)
+
+    def merge_partials(self, stacked):
+        return self._delegate.merge_partials(stacked)
+
+    def finalize(self, out, nseg):
+        frags = self._delegate.finalize(out, nseg)
+        for f in frags:
+            f["interval"] = self.interval_label
+        return frags

@@ -10,9 +10,12 @@ from ..query.dsl import parse_query
 from ..utils.errors import QueryParsingError
 from .nodes import (
     AggNode,
+    AutoDateHistogramAgg,
     AvgAgg,
     CardinalityAgg,
     DateHistogramAgg,
+    DateRangeAgg,
+    ExtendedStatsAgg,
     FilterAgg,
     FiltersAgg,
     GlobalAgg,
@@ -20,12 +23,17 @@ from .nodes import (
     MaxAgg,
     MinAgg,
     MissingAgg,
+    MultiTermsAgg,
     PercentilesAgg,
     RangeAgg,
+    RareTermsAgg,
+    SignificantTermsAgg,
     StatsAgg,
     SumAgg,
     TermsAgg,
+    TopHitsAgg,
     ValueCountAgg,
+    WeightedAvgAgg,
 )
 
 _METRICS = {
@@ -128,4 +136,61 @@ def _build(name, typ, body, children, mappings) -> AggNode:
         return MissingAgg(name, _field_of(name, typ, body), children=children or None)
     if typ == "global":
         return GlobalAgg(name, children or None)
+    if typ == "extended_stats":
+        return ExtendedStatsAgg(
+            name, _field_of(name, typ, body),
+            sigma=float(body.get("sigma", 2.0)), children=children or None,
+        )
+    if typ == "weighted_avg":
+        value = (body.get("value") or {}).get("field")
+        weight = (body.get("weight") or {}).get("field")
+        if not value or not weight:
+            raise QueryParsingError(
+                f"[weighted_avg] aggregation [{name}] requires value.field and weight.field"
+            )
+        return WeightedAvgAgg(name, value, weight, children=children or None)
+    if typ == "rare_terms":
+        return RareTermsAgg(
+            name, _field_of(name, typ, body),
+            max_doc_count=int(body.get("max_doc_count", 1)),
+            children=children or None,
+        )
+    if typ == "multi_terms":
+        sources = body.get("terms")
+        if not isinstance(sources, list) or len(sources) < 2:
+            raise QueryParsingError(
+                f"[multi_terms] aggregation [{name}] requires a [terms] array of 2+ fields"
+            )
+        return MultiTermsAgg(
+            name, [s["field"] for s in sources],
+            size=int(body.get("size", 10)),
+            order=body.get("order"),
+            children=children or None,
+        )
+    if typ == "significant_terms":
+        return SignificantTermsAgg(
+            name, _field_of(name, typ, body),
+            size=int(body.get("size", 10)),
+            min_doc_count=int(body.get("min_doc_count", 3)),
+            children=children or None,
+        )
+    if typ == "date_range":
+        if "ranges" not in body:
+            raise QueryParsingError(f"[date_range] aggregation [{name}] requires [ranges]")
+        return DateRangeAgg(
+            name, _field_of(name, typ, body),
+            ranges=body["ranges"],
+            keyed=bool(body.get("keyed", False)),
+            format=body.get("format"),
+            children=children or None,
+        )
+    if typ == "auto_date_histogram":
+        return AutoDateHistogramAgg(
+            name, _field_of(name, typ, body),
+            buckets=int(body.get("buckets", 10)),
+            format=body.get("format"),
+            children=children or None,
+        )
+    if typ == "top_hits":
+        return TopHitsAgg(name, size=int(body.get("size", 3)))
     raise QueryParsingError(f"unknown aggregation type [{typ}]")

@@ -333,6 +333,36 @@ class EsIndex:
         if time.monotonic() - self._last_refresh >= secs:
             self.refresh()
 
+    def _resolve_top_hits(self, aggregations):
+        """Replace top_hits (shard, docid) placeholders with real hit
+        envelopes (the fetch sub-search of
+        search/aggregations/metrics/TopHitsAggregator.java)."""
+        if not aggregations:
+            return
+
+        def walk(obj):
+            if isinstance(obj, dict):
+                inner = obj.get("hits")
+                if isinstance(inner, dict) and isinstance(inner.get("hits"), list):
+                    resolved = []
+                    for h in inner["hits"]:
+                        if isinstance(h, dict) and h.pop("_resolve_top_hit", False):
+                            doc_id, src = self.shard_docs[h.pop("_shard")][h.pop("_doc")]
+                            resolved.append({
+                                "_index": self.name, "_id": doc_id,
+                                "_score": h["_score"], "_source": src,
+                            })
+                        else:
+                            resolved.append(h)
+                    inner["hits"] = resolved
+                for v in obj.values():
+                    walk(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    walk(v)
+
+        walk(aggregations)
+
     def _apply_script_fields(self, hits: list, script_fields: dict | None):
         """script_fields: {name: {"script": ...}} evaluated over the hits'
         source values host-side (the fetch sub-phase analog,
@@ -403,6 +433,7 @@ class EsIndex:
             self._apply_script_fields(hits, script_fields)
             if had_pipeline and aggregations is not None:
                 apply_pipeline_aggs(aggs_request, aggregations)
+            self._resolve_top_hits(aggregations)
             return {
                 "hits": {
                     "total": {"value": total, "relation": "eq"},
@@ -469,6 +500,7 @@ class EsIndex:
         self._apply_script_fields(hits, script_fields)
         if had_pipeline and res.aggregations is not None:
             apply_pipeline_aggs(aggs_request, res.aggregations)
+        self._resolve_top_hits(res.aggregations)
         return {
             "hits": {
                 "total": {"value": res.total, "relation": "eq"},

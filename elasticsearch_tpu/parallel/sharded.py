@@ -164,9 +164,10 @@ class StackedSearcher:
             if agg_nodes:
                 ok = match[:n] & dev1["live"]
                 seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                dev_a = {**dev1, "_query_scores": scores[:n]}
                 for name, anode in agg_nodes.items():
                     agg_out[name] = anode.device_eval_segmented(
-                        dev1, agg_par1[name], seg, 1, ok, ctx
+                        dev_a, agg_par1[name], seg, 1, ok, ctx
                     )
             return ts, ti, tot, agg_out
 
@@ -283,9 +284,10 @@ class StackedSearcher:
             agg_out = {}
             if agg_nodes:
                 seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                dev_a = {**dev1, "_query_scores": scores[:n]}
                 for name, anode in agg_nodes.items():
                     agg_out[name] = anode.device_eval_segmented(
-                        dev1, agg_par1[name], seg, 1, ok, ctx
+                        dev_a, agg_par1[name], seg, 1, ok, ctx
                     )
             keys = plan.device_keys(dev1, scores, n)
             sel = ok

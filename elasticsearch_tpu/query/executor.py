@@ -104,9 +104,10 @@ class ShardSearcher:
                 agg_out = {}
                 if agg_nodes:
                     seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                    dev_a = {**dev, "_query_scores": scores[:n]}
                     for name, anode in agg_nodes.items():
                         agg_out[name] = anode.device_eval_segmented(
-                            dev, agg_params[name], seg, 1, ok, ctx
+                            dev_a, agg_params[name], seg, 1, ok, ctx
                         )
                 return (*top_k_with_total(scores, match, dev["live"], k), agg_out)
 
@@ -185,9 +186,10 @@ class ShardSearcher:
                 agg_out = {}
                 if agg_nodes:
                     seg = jnp.where(ok, 0, 1).astype(jnp.int32)
+                    dev_a = {**dev, "_query_scores": scores[:n]}
                     for name, anode in agg_nodes.items():
                         agg_out[name] = anode.device_eval_segmented(
-                            dev, agg_params[name], seg, 1, ok, ctx
+                            dev_a, agg_params[name], seg, 1, ok, ctx
                         )
                 keys = plan.device_keys(dev, scores, n)
                 sel = ok

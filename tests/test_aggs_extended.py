@@ -1,0 +1,135 @@
+"""New agg types vs hand-computed numpy: extended_stats, weighted_avg,
+rare_terms, multi_terms, significant_terms, date_range, auto_date_histogram,
+top_hits."""
+
+import numpy as np
+
+from elasticsearch_tpu.engine import Engine
+
+
+def _engine(rng, n=60):
+    e = Engine(None)
+    e.create_index("t", {"properties": {
+        "cat": {"type": "keyword"}, "sub": {"type": "keyword"},
+        "v": {"type": "integer"}, "w": {"type": "float"},
+        "ts": {"type": "date"}, "body": {"type": "text"},
+    }})
+    idx = e.indices["t"]
+    docs = []
+    base = 1700000000000
+    for i in range(n):
+        cat = f"c{i % 4}"
+        sub = f"s{i % 3}"
+        doc = {
+            "cat": cat, "sub": sub, "v": int(rng.integers(0, 50)),
+            "w": float(rng.random() + 0.1),
+            "ts": base + i * 3600_000,  # hourly
+            "body": "alpha common" if i % 4 == 0 else "beta common",
+        }
+        docs.append(doc)
+        idx.index_doc(str(i), doc)
+    idx.refresh()
+    return e, idx, docs
+
+
+def _search(e, **kw):
+    return e.indices["t"].search(**kw)
+
+
+def test_extended_stats(rng):
+    e, idx, docs = _engine(rng)
+    r = _search(e, aggs={"es": {"extended_stats": {"field": "v"}}})
+    out = r["aggregations"]["es"]
+    vs = np.array([d["v"] for d in docs], np.float32)
+    assert out["count"] == len(vs)
+    np.testing.assert_allclose(out["sum"], vs.sum(), rtol=1e-5)
+    np.testing.assert_allclose(out["avg"], vs.mean(), rtol=1e-5)
+    np.testing.assert_allclose(out["sum_of_squares"], (vs * vs).sum(), rtol=1e-5)
+    var = (vs * vs).mean() - vs.mean() ** 2
+    np.testing.assert_allclose(out["variance"], var, rtol=1e-4)
+    np.testing.assert_allclose(
+        out["std_deviation_bounds"]["upper"], vs.mean() + 2 * var ** 0.5, rtol=1e-4
+    )
+
+
+def test_weighted_avg(rng):
+    e, idx, docs = _engine(rng)
+    r = _search(e, aggs={"wa": {"weighted_avg": {
+        "value": {"field": "v"}, "weight": {"field": "w"}}}})
+    vs = np.array([d["v"] for d in docs], np.float64)
+    ws = np.array([np.float32(d["w"]) for d in docs], np.float64)
+    np.testing.assert_allclose(
+        r["aggregations"]["wa"]["value"], (vs * ws).sum() / ws.sum(), rtol=1e-4
+    )
+
+
+def test_rare_terms(rng):
+    e, idx, docs = _engine(rng)
+    # add one unique category
+    idx.index_doc("rare1", {"cat": "unique_cat", "v": 1})
+    idx.refresh()
+    r = _search(e, aggs={"r": {"rare_terms": {"field": "cat", "max_doc_count": 1}}})
+    buckets = r["aggregations"]["r"]["buckets"]
+    assert [b["key"] for b in buckets] == ["unique_cat"]
+
+
+def test_multi_terms(rng):
+    e, idx, docs = _engine(rng)
+    r = _search(e, aggs={"mt": {"multi_terms": {
+        "terms": [{"field": "cat"}, {"field": "sub"}], "size": 5}}})
+    buckets = r["aggregations"]["mt"]["buckets"]
+    from collections import Counter
+
+    expect = Counter((d["cat"], d["sub"]) for d in docs)
+    top = expect.most_common()
+    assert buckets[0]["doc_count"] == top[0][1]
+    got = {tuple(b["key"]): b["doc_count"] for b in buckets}
+    for k, v in got.items():
+        assert expect[k] == v
+
+
+def test_significant_terms(rng):
+    e, idx, docs = _engine(rng)
+    # foreground: docs matching "alpha" (i%4==0) are all cat c0
+    r = _search(
+        e, query={"match": {"body": "alpha"}},
+        aggs={"sig": {"significant_terms": {"field": "cat", "min_doc_count": 3}}},
+    )
+    buckets = r["aggregations"]["sig"]["buckets"]
+    assert buckets and buckets[0]["key"] == "c0"
+    assert buckets[0]["bg_count"] > 0 and buckets[0]["score"] > 0
+
+
+def test_date_range_and_auto_histogram(rng):
+    e, idx, docs = _engine(rng)
+    base = 1700000000000
+    split = base + 30 * 3600_000
+    r = _search(e, aggs={"dr": {"date_range": {"field": "ts", "ranges": [
+        {"to": split}, {"from": split}]}}})
+    buckets = r["aggregations"]["dr"]["buckets"]
+    assert buckets[0]["doc_count"] == 30 and buckets[1]["doc_count"] == 30
+
+    r = _search(e, aggs={"adh": {"auto_date_histogram": {"field": "ts", "buckets": 12}}})
+    out = r["aggregations"]["adh"]
+    assert 1 <= len(out["buckets"]) <= 12
+    assert out["interval"] in ("12h", "1d", "7d", "3h")
+    assert sum(b["doc_count"] for b in out["buckets"]) == 60
+
+
+def test_top_hits_in_terms(rng):
+    e, idx, docs = _engine(rng)
+    r = _search(
+        e, query={"match": {"body": "common"}},
+        aggs={"cats": {"terms": {"field": "cat", "size": 2},
+                       "aggs": {"top": {"top_hits": {"size": 2}}}}},
+    )
+    for b in r["aggregations"]["cats"]["buckets"]:
+        hits = b["top"]["hits"]["hits"]
+        assert 1 <= len(hits) <= 2
+        assert b["top"]["hits"]["total"]["value"] == b["doc_count"]
+        for h in hits:
+            assert h["_source"]["cat"] == b["key"]
+            assert "_id" in h and h["_score"] is not None
+    # scores in a bucket are descending
+    hs = r["aggregations"]["cats"]["buckets"][0]["top"]["hits"]["hits"]
+    assert hs == sorted(hs, key=lambda h: -h["_score"])

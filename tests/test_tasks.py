@@ -32,7 +32,7 @@ def test_engine_byquery_cancellation(tmp_path):
     from elasticsearch_tpu.engine import Engine
 
     engine = Engine(None)
-    engine.create_index("i", {"mappings": {"properties": {"n": {"type": "integer"}}}})
+    engine.create_index("i", {"properties": {"n": {"type": "integer"}}})
     idx = engine.indices["i"]
     for i in range(20):
         idx.index_doc(str(i), {"n": i})
