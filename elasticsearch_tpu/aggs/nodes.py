@@ -1316,3 +1316,169 @@ class AutoDateHistogramAgg(AggNode):
         for f in frags:
             f["interval"] = self.interval_label
         return frags
+
+
+class CompositeAgg(AggNode):
+    """composite: paginated compound buckets over terms / (date_)histogram
+    sources (reference behavior: bucket/composite/CompositeAggregator.java).
+    Buckets order by the key tuple (per-source asc/desc); `after` resumes.
+    Top-level only, like the reference. The full (static-shaped) bucket
+    product is counted on device; pagination trims host-side."""
+
+    _MERGE_RULES = {"counts": "sum"}
+
+    def __init__(self, name, sources, size=10, after=None, children=None):
+        super().__init__(name, children)
+        # sources: [(src_name, type, field, opts)] in request order
+        self.sources = sources
+        self.size = int(size)
+        self.after = after
+
+    def prepare(self, pack, mappings):
+        self.plans = []  # per source: dict(kind, V, keys|first+interval)
+        for (sname, styp, fld, opts) in self.sources:
+            col = pack.docvalues.get(fld)
+            if styp == "terms":
+                if col is None:
+                    keys = []
+                elif col.kind == "ord":
+                    keys = list(col.ord_terms or [])
+                elif col.uniq_values is not None:
+                    keys = [int(x) for x in col.uniq_values]
+                else:
+                    raise IllegalArgumentError(
+                        f"composite terms source on float field [{fld}]")
+                self.plans.append({"kind": "terms", "V": len(keys), "keys": keys,
+                                   "order": opts.get("order", "asc")})
+            else:  # histogram / date_histogram (fixed interval)
+                if styp == "histogram":
+                    interval = float(opts["interval"])
+                else:
+                    interval = float(parse_fixed_interval(
+                        opts.get("fixed_interval") or opts.get("calendar_interval")
+                        or opts.get("interval")))
+                if col is None or not col.has_value.any():
+                    first, nb = 0, 1
+                else:
+                    first = int(np.floor(float(col.vmin) / interval))
+                    last = int(np.floor(float(col.vmax) / interval))
+                    nb = last - first + 1
+                self.plans.append({"kind": styp, "V": nb, "first": first,
+                                   "interval": interval,
+                                   "order": opts.get("order", "asc")})
+        cparams, ckey = self._prepare_children(pack, mappings)
+        shape_key = tuple(
+            (p["kind"], p["V"], p.get("interval"), p.get("first")) for p in self.plans
+        )
+        return {"children": cparams}, ("composite", tuple(s[2] for s in self.sources),
+                                       shape_key, self.size, ckey)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        V = 1
+        for p in self.plans:
+            V *= max(p["V"], 1)
+        self.V = V
+        if V == 0 or any(p["V"] == 0 for p in self.plans):
+            return {"counts": jnp.zeros((nseg, 1), jnp.int32), "children": {}}
+        if nseg * V > MAX_SEGMENT_PRODUCT:
+            raise IllegalArgumentError(
+                f"composite [{self.name}]: {V} buckets exceeds bucket budget")
+        sub = seg
+        ok = valid
+        for (sname, styp, fld, opts), p in zip(self.sources, self.plans):
+            if p["kind"] == "terms":
+                ords, h = _ordinal_column(dev, fld)
+                if ords is None:
+                    ok = ok & False
+                    b = jnp.zeros_like(seg)
+                else:
+                    ok = ok & h & (ords >= 0)
+                    b = jnp.where(ords >= 0, ords, 0)
+            else:
+                got = _numeric_values(dev, fld, ctx)
+                if got is None:
+                    ok = ok & False
+                    b = jnp.zeros_like(seg)
+                else:
+                    v, h, kind = got
+                    ok = ok & h
+                    b = (jnp.floor(v.astype(jnp.float64) / p["interval"])
+                         .astype(jnp.int32) - p["first"])
+                    b = jnp.clip(b, 0, p["V"] - 1)
+            sub = sub * p["V"] + b
+        counts = _seg_scatter(sub, nseg * V, ok, jnp.ones_like(seg), jnp.int32(0), "add").reshape(nseg, V)
+        return {
+            "counts": counts,
+            "children": self._eval_children(dev, {"children": params["children"]}, sub, nseg * V, ok, ctx),
+        }
+
+    def _key_tuple(self, j):
+        parts = []
+        rem = int(j)
+        for p in reversed(self.plans):
+            parts.append(rem % p["V"])
+            rem //= p["V"]
+        parts.reverse()
+        out = []
+        for p, o in zip(self.plans, parts):
+            if p["kind"] == "terms":
+                out.append(p["keys"][o])
+            elif p["kind"] == "histogram":
+                out.append((p["first"] + o) * p["interval"])
+            else:
+                out.append(int((p["first"] + o) * p["interval"]))
+        return tuple(out)
+
+    def finalize(self, out, nseg):
+        V = getattr(self, "V", 1)
+        counts = np.asarray(out["counts"]).reshape(nseg, -1)
+        child_frags = (
+            self._finalize_children(out, nseg * V)
+            if (self.children and counts.shape[1] == V) else None
+        )
+        res = []
+        for i in range(nseg):
+            c = counts[i]
+            present = np.flatnonzero(c > 0)
+            keyed = []
+            for j in present:
+                kt = self._key_tuple(j)
+                # per-source sort rank honoring order direction
+                rank = tuple(
+                    (_neg_rank(k) if p["order"] == "desc" else _pos_rank(k))
+                    for k, p in zip(kt, self.plans)
+                )
+                keyed.append((rank, kt, int(j)))
+            keyed.sort(key=lambda x: x[0])
+            if self.after is not None:
+                after_vals = tuple(self.after[s[0]] for s in self.sources)
+                after_rank = tuple(
+                    (_neg_rank(k) if p["order"] == "desc" else _pos_rank(k))
+                    for k, p in zip(after_vals, self.plans)
+                )
+                keyed = [x for x in keyed if x[0] > after_rank]
+            page = keyed[: self.size]
+            buckets = []
+            for _, kt, j in page:
+                b = {"key": {s[0]: k for s, k in zip(self.sources, kt)},
+                     "doc_count": int(c[j])}
+                if child_frags is not None:
+                    b.update(child_frags[i * V + j])
+                buckets.append(b)
+            frag = {"buckets": buckets}
+            if page:
+                frag["after_key"] = buckets[-1]["key"]
+            res.append(frag)
+        return res
+
+
+def _pos_rank(k):
+    """Sortable rank for a composite key part (str or number)."""
+    return (0, k) if isinstance(k, str) else (0, k)
+
+
+def _neg_rank(k):
+    if isinstance(k, str):
+        # invert byte order for desc string sort
+        return (1, tuple(255 - b for b in k.encode("utf-8")))
+    return (1, -k)

@@ -11,6 +11,7 @@ from ..utils.errors import QueryParsingError
 from .nodes import (
     AggNode,
     AutoDateHistogramAgg,
+    CompositeAgg,
     AvgAgg,
     CardinalityAgg,
     DateHistogramAgg,
@@ -47,7 +48,7 @@ _METRICS = {
 }
 
 
-def parse_aggs(aggs_dict: dict, mappings) -> dict[str, AggNode]:
+def parse_aggs(aggs_dict: dict, mappings, _top=True) -> dict[str, AggNode]:
     """-> {agg_name: AggNode} for one level (children parsed recursively)."""
     if not isinstance(aggs_dict, dict):
         raise QueryParsingError("[aggs] must be an object")
@@ -55,8 +56,12 @@ def parse_aggs(aggs_dict: dict, mappings) -> dict[str, AggNode]:
     for name, spec in aggs_dict.items():
         if not isinstance(spec, dict):
             raise QueryParsingError(f"aggregation [{name}] must be an object")
+        if "composite" in spec and not _top:
+            raise QueryParsingError(
+                f"[composite] aggregation [{name}] cannot be used as a sub-aggregation"
+            )
         sub = spec.get("aggs") or spec.get("aggregations") or {}
-        children = parse_aggs(sub, mappings) if sub else {}
+        children = parse_aggs(sub, mappings, _top=False) if sub else {}
         types = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
         if len(types) != 1:
             raise QueryParsingError(f"aggregation [{name}] must define exactly one type")
@@ -193,4 +198,21 @@ def _build(name, typ, body, children, mappings) -> AggNode:
         )
     if typ == "top_hits":
         return TopHitsAgg(name, size=int(body.get("size", 3)))
+    if typ == "composite":
+        raw = body.get("sources")
+        if not isinstance(raw, list) or not raw:
+            raise QueryParsingError(
+                f"[composite] aggregation [{name}] requires [sources]")
+        sources = []
+        for entry in raw:
+            (sname, sdef), = entry.items()
+            (styp, sbody), = sdef.items()
+            if styp not in ("terms", "histogram", "date_histogram"):
+                raise QueryParsingError(
+                    f"[composite] unsupported source type [{styp}]")
+            sources.append((sname, styp, sbody["field"], sbody))
+        return CompositeAgg(
+            name, sources, size=int(body.get("size", 10)),
+            after=body.get("after"), children=children or None,
+        )
     raise QueryParsingError(f"unknown aggregation type [{typ}]")

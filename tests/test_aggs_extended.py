@@ -133,3 +133,57 @@ def test_top_hits_in_terms(rng):
     # scores in a bucket are descending
     hs = r["aggregations"]["cats"]["buckets"][0]["top"]["hits"]["hits"]
     assert hs == sorted(hs, key=lambda h: -h["_score"])
+
+
+def test_composite_pagination(rng):
+    e, idx, docs = _engine(rng)
+    body = {"size": 5, "sources": [
+        {"c": {"terms": {"field": "cat"}}},
+        {"s": {"terms": {"field": "sub"}}},
+    ]}
+    seen = []
+    after = None
+    for _ in range(10):
+        b = dict(body)
+        if after is not None:
+            b["after"] = after
+        r = _search(e, aggs={"comp": {"composite": b}})
+        frag = r["aggregations"]["comp"]
+        if not frag["buckets"]:
+            break
+        seen.extend(frag["buckets"])
+        after = frag.get("after_key")
+        if after is None:
+            break
+    from collections import Counter
+
+    expect = Counter((d["cat"], d["sub"]) for d in docs)
+    assert len(seen) == len(expect)
+    got_keys = [(b["key"]["c"], b["key"]["s"]) for b in seen]
+    assert got_keys == sorted(got_keys)  # ordered by key tuple asc
+    for b in seen:
+        assert expect[(b["key"]["c"], b["key"]["s"])] == b["doc_count"]
+
+
+def test_composite_histogram_source(rng):
+    e, idx, docs = _engine(rng)
+    r = _search(e, aggs={"comp": {"composite": {"size": 100, "sources": [
+        {"vb": {"histogram": {"field": "v", "interval": 10}}}]}}})
+    buckets = r["aggregations"]["comp"]["buckets"]
+    from collections import Counter
+
+    expect = Counter((d["v"] // 10) * 10 for d in docs)
+    assert {b["key"]["vb"]: b["doc_count"] for b in buckets} == {
+        float(k): v for k, v in expect.items()
+    }
+
+
+def test_composite_rejected_as_subagg(rng):
+    import pytest
+
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+    e, idx, docs = _engine(rng)
+    with pytest.raises(QueryParsingError):
+        _search(e, aggs={"t": {"terms": {"field": "cat"},
+                               "aggs": {"c": {"composite": {"sources": [
+                                   {"s": {"terms": {"field": "sub"}}}]}}}}})
