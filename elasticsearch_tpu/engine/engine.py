@@ -399,9 +399,12 @@ class EsIndex:
     def search(
         self, query=None, size=10, from_=0, aggs=None, knn=None,
         sort=None, search_after=None, script_fields=None,
+        collapse=None, rescore=None,
     ):
         self._maybe_refresh()
         self.counters["query_total"] = self.counters.get("query_total", 0) + 1
+        if collapse is not None and rescore is not None:
+            raise IllegalArgumentError("cannot use [collapse] in conjunction with [rescore]")
         from ..aggs.pipeline import apply_pipeline_aggs, strip_pipeline_aggs
         from ..query.sort import is_score_only, parse_sort
 
@@ -416,6 +419,10 @@ class EsIndex:
         if not is_score_only(sort_fields):
             if knn is not None:
                 raise IllegalArgumentError("knn with field sort is not supported")
+            if collapse is not None or rescore is not None:
+                raise IllegalArgumentError(
+                    "collapse/rescore with field sort is not supported"
+                )
             hits_raw, total, aggregations = self.searcher.search_sorted(
                 query, sort_fields, size=size, from_=from_,
                 search_after=search_after, aggs=aggs,
@@ -483,20 +490,82 @@ class EsIndex:
                 # each shard contributes up to k candidates; the global result
                 # is the top k overall (KnnSearchBuilder.java:44 semantics)
                 size = min(size, max(k_total - from_, 0))
-        res = self.searcher.search(query, size=size, from_=from_, aggs=aggs)
+        collapse_keys = None
+        if collapse is not None:
+            cfld = collapse.get("field") if isinstance(collapse, dict) else collapse
+            if not cfld:
+                raise IllegalArgumentError("no [field] specified for collapse")
+            res = self.searcher.search_collapse(query, cfld, size=size, from_=from_)
+            collapse_keys = getattr(res, "collapse_keys", None)
+            if aggs:
+                # aggs compute over the pre-collapse match set (reference
+                # behavior: collapsing only affects the hit list)
+                res_a = self.searcher.search(query, size=1, aggs=aggs)
+                res.aggregations = res_a.aggregations
+        elif rescore is not None:
+            specs = rescore if isinstance(rescore, list) else [rescore]
+            windows = [int(sp.get("window_size", 10)) for sp in specs]
+            k_fetch = max(size + from_, max(windows))
+            res = self.searcher.search(query, size=k_fetch, from_=0, aggs=aggs)
+            order = list(zip(res.doc_shards, res.doc_ids, res.scores))
+            for spec, w in zip(specs, windows):
+                q2 = (spec.get("query") or {})
+                rq = q2.get("rescore_query")
+                if rq is None:
+                    raise IllegalArgumentError("rescore requires [rescore_query]")
+                qw = float(q2.get("query_weight", 1.0))
+                rw = float(q2.get("rescore_query_weight", 1.0))
+                mode = q2.get("score_mode", "total")
+                win = order[:w]
+                if not win:
+                    continue
+                sh = np.asarray([x[0] for x in win], np.int32)
+                di = np.asarray([x[1] for x in win], np.int32)
+                s2, ok2 = self.searcher.scores_at(rq, sh, di)
+                combined = []
+                for (s_, d_, s1), sc2, k2 in zip(win, s2, ok2):
+                    a, b = qw * float(s1), rw * float(sc2)
+                    if not k2:
+                        c = a
+                    elif mode == "total":
+                        c = a + b
+                    elif mode == "multiply":
+                        c = a * b
+                    elif mode == "avg":
+                        c = (a + b) / 2.0
+                    elif mode == "max":
+                        c = max(a, b)
+                    elif mode == "min":
+                        c = min(a, b)
+                    else:
+                        raise IllegalArgumentError(f"unsupported rescore score_mode [{mode}]")
+                    combined.append(c)
+                rescored = sorted(
+                    zip(win, combined), key=lambda t: -t[1]
+                )
+                order = [(s_, d_, c) for (s_, d_, _), c in rescored] + order[w:]
+            order = order[from_: from_ + size]
+            res.doc_shards = np.asarray([x[0] for x in order], np.int32)
+            res.doc_ids = np.asarray([x[1] for x in order], np.int32)
+            res.scores = np.asarray([x[2] for x in order], np.float32)
+            res.max_score = float(order[0][2]) if order else None
+        else:
+            res = self.searcher.search(query, size=size, from_=from_, aggs=aggs)
         if knn is not None and knn_only:
             res.total = min(res.total, k_total)
         hits = []
-        for s, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
+        for i, (s, d, score) in enumerate(zip(res.doc_shards, res.doc_ids, res.scores)):
             doc_id, src = self.shard_docs[s][d]
-            hits.append(
-                {
-                    "_index": self.name,
-                    "_id": doc_id,
-                    "_score": float(score),
-                    "_source": src,
-                }
-            )
+            h = {
+                "_index": self.name,
+                "_id": doc_id,
+                "_score": float(score),
+                "_source": src,
+            }
+            if collapse_keys is not None and i < len(collapse_keys):
+                cfld = collapse.get("field") if isinstance(collapse, dict) else collapse
+                h["fields"] = {cfld: [collapse_keys[i]]}
+            hits.append(h)
         self._apply_script_fields(hits, script_fields)
         if had_pipeline and res.aggregations is not None:
             apply_pipeline_aggs(aggs_request, res.aggregations)
@@ -861,6 +930,21 @@ class Engine:
                         ks.append((0, -v if sf.desc else v))
                 return ks
             all_hits.sort(key=key)
+        cfld = (kwargs.get("collapse") or {}).get("field") if isinstance(
+            kwargs.get("collapse"), dict) else kwargs.get("collapse")
+        if cfld:
+            # cross-index group dedupe: keep the best hit per collapse key
+            # (each sub-search already collapsed within its index)
+            seen_keys = set()
+            deduped = []
+            for h in all_hits:
+                ck = (h.get("fields") or {}).get(cfld, [None])[0]
+                marker = ("null",) if ck is None else ("k", ck)
+                if marker in seen_keys:
+                    continue
+                seen_keys.add(marker)
+                deduped.append(h)
+            all_hits = deduped
         total = sum(r["hits"]["total"]["value"] for r in sub_results)
         max_scores = [r["hits"]["max_score"] for r in sub_results
                       if r["hits"]["max_score"] is not None]
@@ -1205,6 +1289,18 @@ class Engine:
             "indices": [i.name for i, _ in targets],
             "fields": caps,
         }
+
+    def suggest_multi(self, expression, body: dict) -> dict:
+        """Suggest over an index expression; single concrete target only
+        (cross-index suggest merge is not supported yet)."""
+        from ..search.suggest import run_suggest
+
+        targets = self.resolve_search(expression or "_all", allow_no_indices=True)
+        if len(targets) != 1:
+            raise IllegalArgumentError(
+                "suggest over multiple indices is not supported; target one index"
+            )
+        return run_suggest(targets[0][0], body)
 
     def count_multi(self, expression, query=None, **res_kw) -> int:
         targets = self.resolve_search(expression, **res_kw)

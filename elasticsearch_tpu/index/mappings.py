@@ -37,9 +37,10 @@ NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 DATE_TYPES = {"date"}
 BOOL_TYPES = {"boolean"}
 VECTOR_TYPES = {"dense_vector"}
+COMPLETION_TYPES = {"completion"}
 ALL_TYPES = (
     TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | BOOL_TYPES | VECTOR_TYPES
-    | {"object"}
+    | COMPLETION_TYPES | {"object"}
 )
 
 _INT_BOUNDS = {
@@ -248,6 +249,12 @@ class Mappings:
 
     def _parse_value(self, full: str, value, out: dict):
         if value is None:
+            return
+        ft_pre = self.fields.get(full)
+        if ft_pre is not None and ft_pre.type == "completion":
+            # completion values keep their raw shape (str | [str] |
+            # {"input": ..., "weight": n}); the pack builder normalizes
+            out.setdefault(full, []).append(value)
             return
         if isinstance(value, dict):
             self._parse_obj(value, f"{full}.", out)

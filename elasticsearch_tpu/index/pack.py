@@ -135,6 +135,9 @@ class ShardPack:
     pos_keys: np.ndarray | None = None  # [num_pos_blocks, BLOCK] int64
     term_pos_start: np.ndarray | None = None  # [T+1] int32 block row ranges
     term_pos_count: np.ndarray | None = None  # [T] int32 total positions
+    # completion-suggester inputs, host-side only:
+    # field -> sorted list of (input, weight, docid)
+    completion: dict[str, list] = dc_field(default_factory=dict)
 
     def dense_row_of(self, fld: str, term: str) -> int | None:
         return self.dense_dict.get((fld, term))
@@ -208,6 +211,7 @@ class PackBuilder:
         self.field_doc_counts: dict[str, list[int]] = {}
         self.docvalue_raw: dict[str, list[tuple[int, Any]]] = {}
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
+        self.completion_raw: dict[str, list[tuple[str, int, int]]] = {}
         self.num_docs = 0
         # C++ accumulator owns the per-token hot loop when available
         # (native/packing.cpp); dict fallback otherwise. Packs are
@@ -298,6 +302,21 @@ class PackBuilder:
             elif t in FLOAT_TYPES:
                 if ft.doc_values and values:
                     self.docvalue_raw.setdefault(fld, []).append((docid, float(values[0])))
+            elif t == "completion":
+                for v in values:
+                    if isinstance(v, dict):
+                        inputs = v.get("input") or []
+                        if isinstance(inputs, str):
+                            inputs = [inputs]
+                        weight = int(v.get("weight", 1))
+                    elif isinstance(v, list):
+                        inputs, weight = v, 1
+                    else:
+                        inputs, weight = [v], 1
+                    for inp in inputs:
+                        self.completion_raw.setdefault(fld, []).append(
+                            (str(inp), weight, docid)
+                        )
             elif t in VECTOR_TYPES:
                 if values:
                     if len(values) != ft.dims:
@@ -586,6 +605,9 @@ class PackBuilder:
             )
             dense_tfn[rows, cols] = (tfs_d / (tfs_d + K)).astype(np.float32)
 
+        completion = {
+            fld: sorted(entries) for fld, entries in self.completion_raw.items()
+        }
         return ShardPack(
             num_docs=N,
             post_docids=post_docids,
@@ -607,4 +629,5 @@ class PackBuilder:
             pos_keys=pos_keys,
             term_pos_start=term_pos_start,
             term_pos_count=term_pos_count,
+            completion=completion,
         )

@@ -207,6 +207,181 @@ class StackedSearcher:
         self._cache[cache_key] = fn
         return fn
 
+    def _compiled_collapse(self, node, key, fld, k):
+        """Field collapsing: best hit per field value (reference behavior:
+        search/collapse/CollapseBuilder.java + Lucene CollapsingTopDocsCollector).
+        Groups = global ordinals of `fld`; docs missing the field share the
+        null group. Per shard: scatter-max score per group + lowest-docid
+        winner; global: max over shards per group, then top-k groups."""
+        cache_key = ("collapse", key, fld, k, self.mesh is None)
+        fn = self._cache.get(cache_key)
+        if fn is not None:
+            return fn
+        ctx = self.ctx
+        n = self.sp.n_max
+        S = self.sp.S
+
+        col = self.sp.global_docvalues.get(fld)
+        V = len(col.ord_terms) if (col is not None and col.kind == "ord") else (
+            len(col.uniq_values) if (col is not None and col.uniq_values is not None) else 0
+        )
+
+        def shard_body(dev1, par1):
+            scores, match = node.device_eval(dev1, par1, ctx)
+            ok = match[:n] & dev1["live"]
+            total = jnp.sum(ok, dtype=jnp.int32)
+            s = scores[:n]
+            if fld in dev1["dv_ord"]:
+                ords, h = dev1["dv_ord"][fld]
+                ords = ords.astype(jnp.int32)
+            elif fld in dev1["dv_int_ord"]:
+                ords, h = dev1["dv_int_ord"][fld], dev1["dv_int"][fld][1]
+            else:
+                ords = jnp.full(n, -1, jnp.int32)
+                h = jnp.zeros(n, bool)
+            grp = jnp.where(h & (ords >= 0), ords, V)  # null group = V
+            docids = jnp.arange(n, dtype=jnp.int32)
+            masked = jnp.where(ok, s, -jnp.inf)
+            gmax = jnp.full(V + 1, -jnp.inf, jnp.float32).at[grp].max(masked)
+            ismax = ok & (masked == gmax[grp]) & jnp.isfinite(masked)
+            # non-winner lanes scatter INT_MAX, which never wins a min
+            gdoc = jnp.full(V + 1, 2**31 - 1, jnp.int32).at[grp].min(
+                jnp.where(ismax, docids, 2**31 - 1)
+            )
+            return gmax, gdoc, total
+
+        if self.mesh is not None:
+            import jax.tree_util as jtu
+
+            def inner(dev, params):
+                def body(dev_s, par_s):
+                    sq = lambda t: jtu.tree_map(lambda x: x[0], t)
+                    outs = shard_body(sq(dev_s), sq(par_s))
+                    return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+
+                return jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
+                )(dev, params)
+        else:
+
+            def inner(dev, params):
+                return jax.vmap(shard_body)(dev, params)
+
+        def run(dev, params):
+            gmax, gdoc, tot = inner(dev, params)  # [S, V+1] x2, [S]
+            best = jnp.max(gmax, axis=0)  # [V+1]
+            # winner shard: lowest shard index among maxima (merge tie-break)
+            is_best = gmax == best[None, :]
+            shard_sel = jnp.min(
+                jnp.where(is_best, jnp.arange(S)[:, None], S), axis=0
+            )
+            shard_c = jnp.clip(shard_sel, 0, S - 1)
+            doc_sel = jnp.take_along_axis(gdoc, shard_c[None, :], axis=0)[0]
+            kk = min(k, V + 1)
+            top_s, top_g = jax.lax.top_k(jnp.where(jnp.isfinite(best), best, -jnp.inf), kk)
+            return (
+                top_s, shard_c[top_g], doc_sel[top_g], top_g,
+                tot.sum(),
+            )
+
+        fn = jax.jit(run)
+        self._cache[cache_key] = (fn, V)
+        return fn, V
+
+    def search_collapse(self, query, fld: str, size=10, from_=0) -> StackedResult:
+        m = self.sp.mappings
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        S = self.sp.S
+        views = [self.sp.shard_view(s) for s in range(S)]
+        per_shard, keys = [], []
+        for v in views:
+            p, k_ = node.prepare(v)
+            per_shard.append(p)
+            keys.append(k_)
+        params = _stack_shard_params(per_shard)
+        k = max(size + from_, 1)
+        got = self._compiled_collapse(node, tuple(keys), fld, k)
+        fn, V = got
+        top_s, top_shard, top_doc, top_g, total = jax.device_get(fn(self.dev, params))
+        col = self.sp.global_docvalues.get(fld)
+        valid = np.isfinite(top_s)
+        res_keys = []
+        for g, ok_ in zip(top_g, valid):
+            if not ok_:
+                continue
+            if int(g) >= V or col is None:
+                res_keys.append(None)
+            elif col.kind == "ord":
+                res_keys.append(col.ord_terms[int(g)])
+            else:
+                res_keys.append(int(col.uniq_values[int(g)]))
+        end = max(size + from_, 0)
+        out = StackedResult(
+            top_shard[valid][from_:end].astype(np.int32),
+            top_doc[valid][from_:end].astype(np.int32),
+            top_s[valid][from_:end].astype(np.float32),
+            int(total),
+            float(top_s[0]) if valid.any() else None,
+        )
+        out.collapse_keys = res_keys[from_:end]
+        return out
+
+    def scores_at(self, query, doc_shards: np.ndarray, doc_ids: np.ndarray):
+        """Evaluate `query`'s scores at specific (shard, docid) hits — the
+        rescore gather (reference behavior: QueryRescorer.java combines
+        window scores)."""
+        m = self.sp.mappings
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        S = self.sp.S
+        views = [self.sp.shard_view(s) for s in range(S)]
+        per_shard, keys = [], []
+        for v in views:
+            p, k_ = node.prepare(v)
+            per_shard.append(p)
+            keys.append(k_)
+        params = _stack_shard_params(per_shard)
+        cache_key = ("scores_at", tuple(keys), len(doc_ids), self.mesh is None)
+        fn = self._cache.get(cache_key)
+        if fn is None:
+            ctx = self.ctx
+            n = self.sp.n_max
+
+            def shard_body(dev1, par1):
+                scores, match = node.device_eval(dev1, par1, ctx)
+                return scores[:n], match[:n] & dev1["live"]
+
+            if self.mesh is not None:
+                import jax.tree_util as jtu
+
+                def inner(dev, params):
+                    def body(dev_s, par_s):
+                        sq = lambda t: jtu.tree_map(lambda x: x[0], t)
+                        outs = shard_body(sq(dev_s), sq(par_s))
+                        return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+
+                    return jax.shard_map(
+                        body, mesh=self.mesh,
+                        in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
+                    )(dev, params)
+            else:
+
+                def inner(dev, params):
+                    return jax.vmap(shard_body)(dev, params)
+
+            def run(dev, params, sh, di):
+                scores, match = inner(dev, params)  # [S, n]
+                s = scores[sh, di]
+                ok = match[sh, di]
+                return jnp.where(ok, s, 0.0), ok
+
+            fn = jax.jit(run)
+            self._cache[cache_key] = fn
+        s, ok = jax.device_get(
+            fn(self.dev, params, jnp.asarray(doc_shards), jnp.asarray(doc_ids))
+        )
+        return s, ok
+
     def search(
         self,
         query: dict | QueryNode | None,

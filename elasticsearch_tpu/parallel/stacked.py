@@ -218,6 +218,15 @@ class StackedPack:
                     pres[i, : p.num_docs] = p.text_present[fld]
             self.norms[fld] = arr
             self.text_present[fld] = pres
+        # completion inputs: host-side union with shard tags, input-sorted
+        self.completion: dict[str, list] = {}
+        for i, p in enumerate(shards):
+            for fld, entries in p.completion.items():
+                self.completion.setdefault(fld, []).extend(
+                    (inp, w, i, d) for (inp, w, d) in entries
+                )
+        for fld in self.completion:
+            self.completion[fld].sort()
         # ---- stacked vectors ---------------------------------------------
         self.vectors: dict[str, VectorColumn] = {}
         vec_fields = sorted({f for p in shards for f in p.vectors})

@@ -558,6 +558,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         kwargs = dict(
             query=query, size=size, from_=from_, aggs=aggs, knn=knn, sort=sort,
             search_after=search_after, script_fields=body.get("script_fields"),
+            collapse=body.get("collapse"), rescore=body.get("rescore"),
         )
         if pit is not None:
             if not isinstance(pit, dict) or "id" not in pit:
@@ -581,6 +582,37 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             res["hits"]["hits"], body,
             lambda name: engine.get_index(name).mappings,
         )
+        if body.get("suggest"):
+            res["suggest"] = await call(
+                engine.suggest_multi, expression, body["suggest"]
+            )
+        if body.get("profile"):
+            # per-request phase timing (reference behavior: search/profile/ —
+            # simplified to one coordinator-level breakdown per request)
+            res["profile"] = {
+                "shards": [{
+                    "id": f"[{engine.tasks.node}][{expression or '_all'}][0]",
+                    "searches": [{
+                        "query": [{
+                            "type": "CompiledDeviceQuery",
+                            "description": json.dumps(body.get("query") or {"match_all": {}}),
+                            "time_in_nanos": int((time.monotonic() - t0) * 1e9),
+                            "breakdown": {
+                                "score": int((time.monotonic() - t0) * 1e9),
+                                "build_scorer": 0, "next_doc": 0, "advance": 0,
+                                "create_weight": 0, "match": 0,
+                            },
+                        }],
+                        "rewrite_time": 0,
+                        "collector": [{
+                            "name": "SimpleTopScoreDocCollector",
+                            "reason": "search_top_hits",
+                            "time_in_nanos": int((time.monotonic() - t0) * 1e9),
+                        }],
+                    }],
+                    "aggregations": [],
+                }],
+            }
         n_shards = sum(
             i.num_shards for i, _ in engine.resolve_search(
                 expression, _bool_param(query_params, "ignore_unavailable"), True

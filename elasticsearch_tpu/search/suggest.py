@@ -1,0 +1,225 @@
+"""Suggesters: term, phrase, completion.
+
+Parity targets (reference): search/suggest/term/TermSuggester.java (Lucene
+DirectSpellChecker candidates, string-similarity scoring),
+search/suggest/phrase/PhraseSuggester.java (candidate generation + scoring —
+simplified here to per-token best corrections without the n-gram language
+model), search/suggest/completion/CompletionSuggester.java (here a host-side
+prefix scan over the pack's completion inputs instead of an FST; shard-sized
+sorted-list bisect is plenty on the host, the device never sees suggesters).
+
+Suggest runs entirely host-side: it reads the term dictionary / df stats and
+completion inputs of the stacked pack, never device arrays.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from ..query.dsl import _edit_distance_within
+from ..utils.errors import IllegalArgumentError
+
+
+def _similarity(a: str, b: str) -> float:
+    """DirectSpellChecker-style similarity: 1 - ed/min_len_cap."""
+    for d in (0, 1, 2):
+        if _edit_distance_within(a, b, d):
+            return 1.0 - d / max(min(len(a), len(b)), 1)
+    return 0.0
+
+
+def _field_terms_with_df(sp, fld: str) -> list[tuple[str, int]]:
+    """Sorted (term, global df) for one field from the stacked pack."""
+    out = [(t, df) for (f, t), df in sp.global_df.items() if f == fld]
+    out.sort()
+    return out
+
+
+def _analyzer_for(mappings, fld: str):
+    ft = mappings.fields.get(fld)
+    if ft is None:
+        raise IllegalArgumentError(f"no mapping found for field [{fld}]")
+    return ft.get_search_analyzer() if hasattr(ft, "get_search_analyzer") else ft.get_analyzer()
+
+
+def _term_candidates(sp, fld, token, *, max_edits, prefix_length, size,
+                     suggest_mode, own_df):
+    cands = []
+    for term, df in _field_terms_with_df(sp, fld):
+        if term == token:
+            continue
+        if prefix_length and term[:prefix_length] != token[:prefix_length]:
+            continue
+        if abs(len(term) - len(token)) > max_edits:
+            continue
+        if not _edit_distance_within(token, term, max_edits):
+            continue
+        if suggest_mode == "popular" and df <= own_df:
+            continue
+        score = _similarity(token, term)
+        cands.append({"text": term, "score": round(score, 6), "freq": int(df)})
+    cands.sort(key=lambda c: (-c["score"], -c["freq"], c["text"]))
+    return cands[:size]
+
+
+def term_suggest(sp, mappings, text: str, spec: dict) -> list[dict]:
+    fld = spec.get("field")
+    if not fld:
+        raise IllegalArgumentError("[term] suggester requires [field]")
+    size = int(spec.get("size", 5))
+    max_edits = int(spec.get("max_edits", 2))
+    prefix_length = int(spec.get("prefix_length", 1))
+    mode = spec.get("suggest_mode", "missing")
+    analyzer = _analyzer_for(mappings, fld)
+    entries = []
+    for tok in analyzer.analyze(text):
+        own_df = sp.global_df.get((fld, tok.term), 0)
+        options = []
+        if not (mode == "missing" and own_df > 0):
+            options = _term_candidates(
+                sp, fld, tok.term, max_edits=max_edits,
+                prefix_length=prefix_length, size=size,
+                suggest_mode=mode, own_df=own_df,
+            )
+        entries.append({
+            "text": tok.term,
+            "offset": tok.start_offset,
+            "length": tok.end_offset - tok.start_offset,
+            "options": options,
+        })
+    return entries
+
+
+def phrase_suggest(sp, mappings, text: str, spec: dict) -> list[dict]:
+    fld = spec.get("field")
+    if not fld:
+        raise IllegalArgumentError("[phrase] suggester requires [field]")
+    size = int(spec.get("size", 5))
+    max_errors = spec.get("max_errors", 1.0)
+    highlight = spec.get("highlight") or {}
+    pre = highlight.get("pre_tag", "")
+    post = highlight.get("post_tag", "")
+    analyzer = _analyzer_for(mappings, fld)
+    toks = list(analyzer.analyze(text))
+    if not toks:
+        return [{"text": text, "offset": 0, "length": len(text), "options": []}]
+    per_tok = []
+    max_fix = max(1, int(max_errors if max_errors >= 1 else max_errors * len(toks)))
+    for tok in toks:
+        own_df = sp.global_df.get((fld, tok.term), 0)
+        cands = _term_candidates(
+            sp, fld, tok.term, max_edits=2, prefix_length=1, size=3,
+            suggest_mode="always", own_df=own_df,
+        )
+        per_tok.append((tok, own_df, cands))
+    # candidate phrases: correct the k most-suspect tokens (df==0 first)
+    options = []
+    suspects = sorted(
+        (i for i, (_, df, cs) in enumerate(per_tok) if cs),
+        key=lambda i: (per_tok[i][1], -per_tok[i][2][0]["score"]),
+    )[:max_fix]
+    import itertools
+
+    choice_sets = []
+    for i, (tok, df, cands) in enumerate(per_tok):
+        if i in suspects and df == 0 and cands:
+            choice_sets.append([(c["text"], c["score"], True) for c in cands[:2]]
+                               or [(tok.term, 1.0, False)])
+        elif i in suspects and cands and cands[0]["score"] >= 0.5:
+            choice_sets.append([(tok.term, 1.0, False)]
+                               + [(c["text"], c["score"], True) for c in cands[:1]])
+        else:
+            choice_sets.append([(tok.term, 1.0, False)])
+    for combo in itertools.product(*choice_sets):
+        if all(not ch for _, _, ch in combo):
+            continue
+        score = 1.0
+        parts = []
+        hparts = []
+        for (t, s, changed) in combo:
+            score *= s
+            parts.append(t)
+            hparts.append(f"{pre}{t}{post}" if changed and (pre or post) else t)
+        opt = {"text": " ".join(parts), "score": round(score / len(toks), 6)}
+        if pre or post:
+            opt["highlighted"] = " ".join(hparts)
+        options.append(opt)
+    options.sort(key=lambda o: (-o["score"], o["text"]))
+    seen = set()
+    uniq = []
+    for o in options:
+        if o["text"] in seen:
+            continue
+        seen.add(o["text"])
+        uniq.append(o)
+    return [{
+        "text": text, "offset": 0, "length": len(text), "options": uniq[:size],
+    }]
+
+
+def completion_suggest(sp, shard_docs, index_name, prefix: str, spec: dict) -> list[dict]:
+    fld = spec.get("field")
+    if not fld:
+        raise IllegalArgumentError("[completion] suggester requires [field]")
+    size = int(spec.get("size", 5))
+    entries = getattr(sp, "completion", {}).get(fld, [])
+    skip_dup = bool(spec.get("skip_duplicates", False))
+    lo = bisect.bisect_left(entries, (prefix,))
+    options = []
+    seen_ids = set()
+    seen_text = set()
+    matched = []
+    for i in range(lo, len(entries)):
+        inp, w, s, d = entries[i]
+        if not inp.startswith(prefix):
+            break
+        matched.append((-w, inp, s, d))
+    matched.sort()
+    for negw, inp, s, d in matched:
+        if (s, d) in seen_ids:
+            continue
+        if skip_dup and inp in seen_text:
+            continue
+        seen_ids.add((s, d))
+        seen_text.add(inp)
+        doc_id, src = shard_docs[s][d]
+        options.append({
+            "text": inp, "_index": index_name, "_id": doc_id,
+            "_score": float(-negw), "_source": src,
+        })
+        if len(options) >= size:
+            break
+    return [{
+        "text": prefix, "offset": 0, "length": len(prefix), "options": options,
+    }]
+
+
+def run_suggest(idx, body: dict) -> dict:
+    """Execute a full `suggest` section against one index (reference
+    behavior: rest-api-spec search.json `suggest` body section)."""
+    idx._maybe_refresh()
+    sp = idx.searcher.sp
+    mappings = idx.mappings
+    global_text = body.get("text")
+    out = {}
+    for name, spec in body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise IllegalArgumentError(f"suggestion [{name}] must be an object")
+        text = spec.get("text", global_text)
+        prefix = spec.get("prefix")
+        if "term" in spec:
+            out[name] = term_suggest(sp, mappings, text or "", spec["term"])
+        elif "phrase" in spec:
+            out[name] = phrase_suggest(sp, mappings, text or "", spec["phrase"])
+        elif "completion" in spec:
+            out[name] = completion_suggest(
+                sp, idx.shard_docs, idx.name, prefix or text or "",
+                spec["completion"],
+            )
+        else:
+            raise IllegalArgumentError(
+                f"suggestion [{name}] requires one of [term, phrase, completion]"
+            )
+    return out

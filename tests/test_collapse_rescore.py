@@ -1,0 +1,108 @@
+"""Field collapsing and query rescoring."""
+
+import numpy as np
+
+from elasticsearch_tpu.engine import Engine
+
+
+def _engine(n_shards=2):
+    e = Engine(None)
+    e.create_index("p", {"properties": {
+        "title": {"type": "text"}, "brand": {"type": "keyword"},
+        "rank": {"type": "integer"},
+    }}, settings={"number_of_shards": n_shards})
+    idx = e.indices["p"]
+    docs = [
+        ("1", {"title": "red shoe sale", "brand": "acme", "rank": 5}),
+        ("2", {"title": "red shoe", "brand": "acme", "rank": 1}),
+        ("3", {"title": "red boot shoe shoe", "brand": "bolt", "rank": 9}),
+        ("4", {"title": "blue shoe", "brand": "bolt", "rank": 2}),
+        ("5", {"title": "red sandal", "brand": "core", "rank": 7}),
+        ("6", {"title": "green shoe", "brand": None, "rank": 3}),
+    ]
+    for i, src in docs:
+        if src["brand"] is None:
+            src = {k: v for k, v in src.items() if k != "brand"}
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e, idx
+
+
+def test_collapse_one_hit_per_group():
+    e, idx = _engine()
+    r = idx.search(query={"match": {"title": "shoe"}},
+                   collapse={"field": "brand"})
+    hits = r["hits"]["hits"]
+    brands = [(h.get("fields") or {}).get("brand", [None])[0] for h in hits]
+    assert len(brands) == len(set(map(str, brands)))
+    # total counts all matching docs, not groups
+    assert r["hits"]["total"]["value"] == 5
+    # each group's representative is its best-scoring doc
+    full = idx.search(query={"match": {"title": "shoe"}}, size=10)["hits"]["hits"]
+    best = {}
+    for h in full:
+        b = h["_source"].get("brand")
+        if b not in best:
+            best[b] = h["_id"]
+    for h in hits:
+        b = h["_source"].get("brand")
+        assert h["_id"] == best[b]
+    # scores descending
+    scores = [h["_score"] for h in hits]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_collapse_null_group():
+    e, idx = _engine()
+    r = idx.search(query={"match": {"title": "shoe"}}, collapse={"field": "brand"})
+    null_hits = [h for h in r["hits"]["hits"] if h["_source"].get("brand") is None]
+    assert len(null_hits) == 1 and null_hits[0]["_id"] == "6"
+
+
+def test_rescore_total_mode():
+    e, idx = _engine()
+    base = idx.search(query={"match": {"title": "shoe"}}, size=10)["hits"]["hits"]
+    r = idx.search(
+        query={"match": {"title": "shoe"}},
+        rescore={"window_size": 10, "query": {
+            "rescore_query": {"match": {"title": "red"}},
+            "query_weight": 1.0, "rescore_query_weight": 2.0,
+        }},
+    )
+    hits = r["hits"]["hits"]
+    # docs matching "red" must gain score vs their base
+    base_by_id = {h["_id"]: h["_score"] for h in base}
+    for h in hits:
+        if "red" in h["_source"]["title"]:
+            assert h["_score"] > base_by_id[h["_id"]]
+        else:
+            assert abs(h["_score"] - base_by_id[h["_id"]]) < 1e-5
+    scores = [h["_score"] for h in hits]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_rescore_window_limits_scope():
+    e, idx = _engine()
+    base = idx.search(query={"match": {"title": "shoe"}}, size=10)["hits"]["hits"]
+    r = idx.search(
+        query={"match": {"title": "shoe"}},
+        rescore={"window_size": 2, "query": {
+            "rescore_query": {"match": {"title": "red"}},
+            "rescore_query_weight": 100.0,
+        }},
+        size=10,
+    )
+    hits = r["hits"]["hits"]
+    # outside the window, original order preserved
+    assert [h["_id"] for h in hits[2:]] == [h["_id"] for h in base[2:]]
+
+
+def test_collapse_rejected_with_rescore():
+    import pytest
+
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+    e, idx = _engine()
+    with pytest.raises(IllegalArgumentError):
+        idx.search(query={"match_all": {}}, collapse={"field": "brand"},
+                   rescore={"query": {"rescore_query": {"match_all": {}}}})

@@ -1,0 +1,97 @@
+"""Term / phrase / completion suggesters + profile response section."""
+
+import asyncio
+import json
+
+from elasticsearch_tpu.engine import Engine
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("s", {"properties": {
+        "body": {"type": "text"},
+        "sug": {"type": "completion"},
+    }})
+    idx = e.indices["s"]
+    docs = [
+        ("1", {"body": "the quick brown fox", "sug": {"input": ["quick fox", "quality"], "weight": 3}}),
+        ("2", {"body": "quick silver surfer", "sug": "quick silver"}),
+        ("3", {"body": "brown bread recipe", "sug": {"input": "bread", "weight": 10}}),
+        ("4", {"body": "slow brown snail", "sug": "snail pace"}),
+    ]
+    for i, src in docs:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e, idx
+
+
+def test_term_suggester_corrects_typo():
+    e, idx = _engine()
+    out = e.suggest_multi("s", {"fix": {"text": "quik browm", "term": {"field": "body"}}})
+    entries = out["fix"]
+    assert [en["text"] for en in entries] == ["quik", "browm"]
+    assert entries[0]["options"][0]["text"] == "quick"
+    assert entries[1]["options"][0]["text"] == "brown"
+    assert entries[0]["options"][0]["freq"] == 2  # df of "quick"
+    # a correctly-spelled indexed word yields no options in missing mode
+    out = e.suggest_multi("s", {"ok": {"text": "brown", "term": {"field": "body"}}})
+    assert out["ok"][0]["options"] == []
+
+
+def test_phrase_suggester():
+    e, idx = _engine()
+    out = e.suggest_multi("s", {"p": {
+        "text": "quik brown",
+        "phrase": {"field": "body", "highlight": {"pre_tag": "<em>", "post_tag": "</em>"}},
+    }})
+    opts = out["p"][0]["options"]
+    assert opts and opts[0]["text"] == "quick brown"
+    assert "<em>quick</em>" in opts[0]["highlighted"]
+
+
+def test_completion_suggester_prefix_and_weight():
+    e, idx = _engine()
+    out = e.suggest_multi("s", {"c": {"prefix": "qu", "completion": {"field": "sug"}}})
+    opts = out["c"][0]["options"]
+    texts = [o["text"] for o in opts]
+    # weight desc: "quick fox"/"quality" (w=3) before "quick silver" (w=1);
+    # one option per doc
+    assert texts[0] in ("quick fox", "quality")
+    assert opts[0]["_score"] == 3.0
+    assert {o["_id"] for o in opts} == {"1", "2"}
+    out = e.suggest_multi("s", {"c": {"prefix": "bre", "completion": {"field": "sug"}}})
+    assert out["c"][0]["options"][0]["_id"] == "3"
+
+
+async def _rest_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/s", json={"mappings": {"properties": {
+        "body": {"type": "text"}, "sug": {"type": "completion"}}}})
+    lines = []
+    for i, src in [("1", {"body": "quick brown fox", "sug": "quick"}),
+                   ("2", {"body": "lazy dog", "sug": "lazy"})]:
+        lines.append(json.dumps({"index": {"_index": "s", "_id": i}}))
+        lines.append(json.dumps(src))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/s/_refresh")
+    r = await client.post("/s/_search", json={
+        "query": {"match": {"body": "quick"}},
+        "suggest": {"sg": {"text": "quik", "term": {"field": "body"}}},
+        "profile": True,
+    })
+    body = await r.json()
+    assert body["suggest"]["sg"][0]["options"][0]["text"] == "quick"
+    assert body["profile"]["shards"][0]["searches"][0]["query"][0]["time_in_nanos"] > 0
+    assert body["hits"]["total"]["value"] == 1
+    await client.close()
+
+
+def test_rest_suggest_and_profile():
+    asyncio.run(_rest_drive())
