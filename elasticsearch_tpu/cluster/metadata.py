@@ -53,6 +53,7 @@ class MetadataStore:
         self.aliases: dict[str, dict[str, dict]] = {}
         self.index_templates: dict[str, dict] = {}
         self.component_templates: dict[str, dict] = {}
+        self.stored_scripts: dict[str, dict] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -68,6 +69,7 @@ class MetadataStore:
             self.aliases = state.get("aliases", {})
             self.index_templates = state.get("index_templates", {})
             self.component_templates = state.get("component_templates", {})
+            self.stored_scripts = state.get("stored_scripts", {})
 
     def save(self):
         f = self._file()
@@ -80,6 +82,7 @@ class MetadataStore:
                     "aliases": self.aliases,
                     "index_templates": self.index_templates,
                     "component_templates": self.component_templates,
+                    "stored_scripts": self.stored_scripts,
                 },
                 fh,
             )

@@ -399,12 +399,30 @@ class EsIndex:
     def search(
         self, query=None, size=10, from_=0, aggs=None, knn=None,
         sort=None, search_after=None, script_fields=None,
-        collapse=None, rescore=None,
+        collapse=None, rescore=None, runtime_mappings=None,
     ):
         self._maybe_refresh()
         self.counters["query_total"] = self.counters.get("query_total", 0) + 1
         if collapse is not None and rescore is not None:
             raise IllegalArgumentError("cannot use [collapse] in conjunction with [rescore]")
+        m_eff = None
+        if runtime_mappings:
+            import copy
+
+            from ..index.mappings import FieldType
+
+            m_eff = copy.copy(self.mappings)
+            m_eff.fields = dict(self.mappings.fields)
+            for nm, spec in runtime_mappings.items():
+                if not isinstance(spec, dict) or "script" not in spec:
+                    raise IllegalArgumentError(
+                        f"runtime field [{nm}] requires a [script]"
+                    )
+                rtype = spec.get("type", "double")
+                self.searcher.ensure_runtime_field(nm, rtype, spec["script"])
+                ftype = {"long": "long", "double": "double",
+                         "date": "date", "boolean": "boolean"}.get(rtype)
+                m_eff.fields[nm] = FieldType(name=nm, type=ftype, index=False)
         from ..aggs.pipeline import apply_pipeline_aggs, strip_pipeline_aggs
         from ..query.sort import is_score_only, parse_sort
 
@@ -425,7 +443,7 @@ class EsIndex:
                 )
             hits_raw, total, aggregations = self.searcher.search_sorted(
                 query, sort_fields, size=size, from_=from_,
-                search_after=search_after, aggs=aggs,
+                search_after=search_after, aggs=aggs, mappings=m_eff,
             )
             hits = []
             for s, d, values in hits_raw:
@@ -506,7 +524,8 @@ class EsIndex:
             specs = rescore if isinstance(rescore, list) else [rescore]
             windows = [int(sp.get("window_size", 10)) for sp in specs]
             k_fetch = max(size + from_, max(windows))
-            res = self.searcher.search(query, size=k_fetch, from_=0, aggs=aggs)
+            res = self.searcher.search(query, size=k_fetch, from_=0, aggs=aggs,
+                                       mappings=m_eff)
             order = list(zip(res.doc_shards, res.doc_ids, res.scores))
             for spec, w in zip(specs, windows):
                 q2 = (spec.get("query") or {})
@@ -550,7 +569,8 @@ class EsIndex:
             res.scores = np.asarray([x[2] for x in order], np.float32)
             res.max_score = float(order[0][2]) if order else None
         else:
-            res = self.searcher.search(query, size=size, from_=from_, aggs=aggs)
+            res = self.searcher.search(query, size=size, from_=from_, aggs=aggs,
+                                       mappings=m_eff)
         if knn is not None and knn_only:
             res.total = min(res.total, k_total)
         hits = []

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.scoring import top_k_with_total
 from ..query.dsl import parse_query
+from ..utils.errors import IllegalArgumentError
 from ..query.nodes import ExecContext, QueryNode
 from .stacked import StackedPack
 
@@ -207,6 +208,92 @@ class StackedSearcher:
         self._cache[cache_key] = fn
         return fn
 
+    def ensure_runtime_field(self, name: str, rtype: str, script) -> None:
+        """Materialize a runtime field as a docvalues column (reference
+        behavior: search-request runtime_mappings, mapper/RuntimeField.java —
+        script-computed per query; here computed once per unique script and
+        cached on the searcher, then visible to queries/aggs/sort like any
+        mapped column).
+
+        The script is the expression language (script/expression.py); ES
+        `emit(expr)` sources are accepted by unwrapping the emit call."""
+        from ..index.pack import DocValuesColumn
+        from ..script.expression import compile_script
+
+        if not hasattr(self, "_runtime_fields"):
+            self._runtime_fields = {}
+        src = script.get("source") if isinstance(script, dict) else script
+        cache_key = (name, rtype, src)
+        if self._runtime_fields.get(name) == cache_key:
+            return
+        if name in self.sp.global_docvalues and name not in self._runtime_fields:
+            raise IllegalArgumentError(
+                f"runtime field [{name}] shadows a mapped field"
+            )
+        if rtype not in ("long", "double", "date", "boolean"):
+            raise IllegalArgumentError(
+                f"runtime field type [{rtype}] is not supported (numeric only)"
+            )
+        s = src.strip()
+        if s.startswith("emit(") and s.endswith(")"):
+            s = s[5:-1]
+        compiled = compile_script(
+            {"source": s, "params": (script.get("params") if isinstance(script, dict) else None) or {}}
+        )
+        S = self.sp.S
+        n_max = self.sp.n_max
+        dtype = np.int64 if rtype in ("long", "date", "boolean") else np.float32
+        vals = np.zeros((S, n_max), dtype)
+        has = np.zeros((S, n_max), bool)
+        for i, p in enumerate(self.sp.shards):
+            n = p.num_docs
+            if n == 0:
+                continue
+            env = {}
+            h_all = np.ones(n, bool)
+            for f in compiled.fields:
+                col = p.docvalues.get(f)
+                if col is None or col.kind == "ord":
+                    env[f] = np.zeros(n, np.float32)
+                    h_all &= False
+                else:
+                    env[f] = np.where(col.has_value, col.values, 0).astype(np.float32)
+                    h_all &= col.has_value
+            out = np.asarray(compiled.evaluate(env))
+            out = np.broadcast_to(out, (n,))
+            vals[i, :n] = out.astype(dtype)
+            has[i, :n] = h_all
+        kind = "int" if dtype == np.int64 else "float"
+        g = DocValuesColumn(kind, vals, has)
+        present = vals[has]
+        if present.size:
+            g.vmin = present.min().item()
+            g.vmax = present.max().item()
+            if kind == "int":
+                uniq = np.unique(present)
+                g.uniq_values = uniq
+                ords = np.full((S, n_max), -1, np.int32)
+                ords[has] = np.searchsorted(uniq, vals[has]).astype(np.int32)
+                g.uniq_ords = ords
+        self.sp.stacked_docvalues[name] = g
+        self.sp.global_docvalues[name] = g
+        # per-shard planning view (prepare() reads pack.docvalues)
+        for i, p in enumerate(self.sp.shards):
+            pc = DocValuesColumn(kind, vals[i, : p.num_docs], has[i, : p.num_docs])
+            pc.vmin, pc.vmax = g.vmin, g.vmax
+            if g.uniq_values is not None:
+                pc.uniq_values = g.uniq_values
+                pc.uniq_ords = g.uniq_ords[i, : p.num_docs]
+            p.docvalues[name] = pc
+        put = (lambda x: jax.device_put(
+            x, NamedSharding(self.mesh, P("shards", *([None] * (np.ndim(x) - 1))))
+        )) if self.mesh is not None else jnp.asarray
+        key = {"int": "dv_int", "float": "dv_float"}[kind]
+        self.dev[key][name] = (put(vals), put(has))
+        if g.uniq_ords is not None:
+            self.dev["dv_int_ord"][name] = put(g.uniq_ords)
+        self._runtime_fields[name] = cache_key
+
     def _compiled_collapse(self, node, key, fld, k):
         """Field collapsing: best hit per field value (reference behavior:
         search/collapse/CollapseBuilder.java + Lucene CollapsingTopDocsCollector).
@@ -388,8 +475,9 @@ class StackedSearcher:
         size: int = 10,
         from_: int = 0,
         aggs: dict | None = None,
+        mappings=None,
     ) -> StackedResult:
-        m = self.sp.mappings
+        m = mappings if mappings is not None else self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
         agg_nodes = None
         if aggs:
@@ -520,11 +608,12 @@ class StackedSearcher:
         from_: int = 0,
         search_after=None,
         aggs: dict | None = None,
+        mappings=None,
     ):
         """-> (hits: [(shard, docid, sort_values)], total, aggregations)."""
         from ..query.sort import SortPlan
 
-        m = self.sp.mappings
+        m = mappings if mappings is not None else self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
         agg_nodes = None
         if aggs:

@@ -124,13 +124,20 @@ def _parse_multi_match(body, mappings):
     boost = float(body.get("boost", 1.0))
     if text is None or not fields:
         raise QueryParsingError("[multi_match] requires [query] and [fields]")
+    if mm_type not in ("best_fields", "most_fields", "phrase"):
+        raise QueryParsingError(f"[multi_match] type [{mm_type}] is not supported")
     children = []
     for f in fields:
         fboost = 1.0
         if "^" in f:
             f, fb = f.split("^", 1)
             fboost = float(fb)
-        child = _parse_match({f: {"query": text, "boost": fboost}}, mappings)
+        if mm_type == "phrase":
+            child = _parse_match_phrase(
+                {f: {"query": text, "boost": fboost}}, mappings
+            )
+        else:
+            child = _parse_match({f: {"query": text, "boost": fboost}}, mappings)
         children.append(child)
     if mm_type == "most_fields":
         return BoolNode(should=children, boost=boost)
@@ -553,4 +560,18 @@ _PARSERS = {
     "function_score": _parse_function_score,
     "script_score": _parse_script_score,
     "script": _parse_script_filter,
+    "query_string": lambda body, m: _parse_query_string(body, m),
+    "simple_query_string": lambda body, m: _parse_simple_query_string(body, m),
 }
+
+
+def _parse_query_string(body, mappings):
+    from .querystring import parse_query_string
+
+    return parse_query(parse_query_string(body, mappings), mappings)
+
+
+def _parse_simple_query_string(body, mappings):
+    from .querystring import parse_simple_query_string
+
+    return parse_query(parse_simple_query_string(body, mappings), mappings)

@@ -308,6 +308,61 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             lambda task: engine.reindex(body, task=task),
         )
 
+    # ---- search templates / stored scripts -------------------------------
+
+    @handler
+    async def search_template(request):
+        from ..search.templates import resolve_template
+
+        body = await body_json(request, {}) or {}
+        _, parsed = resolve_template(engine.meta, body)
+        return web.json_response(
+            await _run_search(request.match_info.get("index"), parsed, request.query)
+        )
+
+    @handler
+    async def render_search_template(request):
+        from ..search.templates import resolve_template
+
+        body = await body_json(request, {}) or {}
+        tid = request.match_info.get("id")
+        if tid:
+            body = {**body, "id": tid}
+        _, parsed = resolve_template(engine.meta, body)
+        return web.json_response({"template_output": parsed})
+
+    @handler
+    async def put_stored_script(request):
+        body = await body_json(request, {}) or {}
+        script = body.get("script")
+        if not isinstance(script, dict) or "source" not in script:
+            raise IllegalArgumentError("stored script requires [script.source]")
+        engine.meta.stored_scripts[request.match_info["id"]] = {
+            "lang": script.get("lang", "mustache"),
+            "source": script["source"],
+        }
+        engine.meta.save()
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def get_stored_script(request):
+        sid = request.match_info["id"]
+        script = engine.meta.stored_scripts.get(sid)
+        if script is None:
+            return web.json_response({"_id": sid, "found": False}, status=404)
+        return web.json_response({"_id": sid, "found": True, "script": script})
+
+    @handler
+    async def delete_stored_script(request):
+        sid = request.match_info["id"]
+        if sid not in engine.meta.stored_scripts:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"stored script [{sid}] not found")
+        del engine.meta.stored_scripts[sid]
+        engine.meta.save()
+        return web.json_response({"acknowledged": True})
+
     # ---- admin / observability -------------------------------------------
 
     @handler
@@ -559,6 +614,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             query=query, size=size, from_=from_, aggs=aggs, knn=knn, sort=sort,
             search_after=search_after, script_fields=body.get("script_fields"),
             collapse=body.get("collapse"), rescore=body.get("rescore"),
+            runtime_mappings=body.get("runtime_mappings"),
         )
         if pit is not None:
             if not isinstance(pit, dict) or "id" not in pit:
@@ -1134,6 +1190,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/{index}/_create/{id}", create_doc)
     app.router.add_get("/{index}/_source/{id}", get_source)
     app.router.add_post("/{index}/_update/{id}", update_doc)
+    app.router.add_route("*", "/_search/template", search_template)
+    app.router.add_route("*", "/{index}/_search/template", search_template)
+    app.router.add_route("*", "/_render/template", render_search_template)
+    app.router.add_route("*", "/_render/template/{id}", render_search_template)
+    app.router.add_put("/_scripts/{id}", put_stored_script)
+    app.router.add_post("/_scripts/{id}", put_stored_script)
+    app.router.add_get("/_scripts/{id}", get_stored_script)
+    app.router.add_delete("/_scripts/{id}", delete_stored_script)
     app.router.add_route("*", "/_analyze", analyze_api)
     app.router.add_route("*", "/{index}/_analyze", analyze_api)
     app.router.add_route("*", "/_validate/query", validate_query_api)

@@ -1,0 +1,83 @@
+"""Search templates: mustache-lite rendering of stored/inline templates.
+
+Parity target: the reference renders search templates with Mustache
+(reference behavior: modules/lang-mustache/.../MustacheScriptEngine.java;
+rest-api-spec/api/search_template.json, render_search_template.json). The
+subset here covers what search templates actually use: `{{var}}`
+substitution, `{{#toJson}}var{{/toJson}}`, and `{{^var}}default{{/var}}`
+fallback sections.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from ..utils.errors import IllegalArgumentError, ResourceNotFoundError
+
+_TOJSON = re.compile(r"\{\{#toJson\}\}\s*([\w.]+)\s*\{\{/toJson\}\}")
+_INVERTED = re.compile(r"\{\{\^([\w.]+)\}\}(.*?)\{\{/\1\}\}", re.DOTALL)
+_VAR = re.compile(r"\{\{([\w.]+)\}\}")
+
+
+def _lookup(params: dict, path: str):
+    cur = params
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def render_template(source, params: dict | None) -> str:
+    """-> rendered JSON text of the search body."""
+    params = params or {}
+    if isinstance(source, dict):
+        source = json.dumps(source)
+    if not isinstance(source, str):
+        raise IllegalArgumentError("template [source] must be a string or object")
+
+    def sub_tojson(m):
+        v = _lookup(params, m.group(1))
+        return json.dumps(v)
+
+    def sub_inverted(m):
+        return "" if _lookup(params, m.group(1)) is not None else m.group(2)
+
+    def sub_var(m):
+        v = _lookup(params, m.group(1))
+        if v is None:
+            return ""
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return json.dumps(v)
+        # string content escaped for in-string substitution
+        return json.dumps(str(v))[1:-1]
+
+    out = _TOJSON.sub(sub_tojson, source)
+    out = _INVERTED.sub(sub_inverted, out)
+    out = _VAR.sub(sub_var, out)
+    return out
+
+
+def resolve_template(meta, body: dict) -> tuple[str, dict]:
+    """search_template request -> (rendered_json, parsed_body)."""
+    params = body.get("params") or {}
+    if body.get("id"):
+        stored = meta.stored_scripts.get(body["id"])
+        if stored is None:
+            raise ResourceNotFoundError(f"stored script [{body['id']}] not found")
+        source = stored.get("source")
+    else:
+        source = body.get("source")
+        if source is None:
+            raise IllegalArgumentError("search template requires [source] or [id]")
+    rendered = render_template(source, params)
+    try:
+        parsed = json.loads(rendered)
+    except json.JSONDecodeError as ex:
+        raise IllegalArgumentError(
+            f"rendered template is not valid JSON: {ex}"
+        )
+    return rendered, parsed

@@ -1,0 +1,160 @@
+"""Runtime fields, query_string / simple_query_string, search templates."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("b", {"properties": {
+        "title": {"type": "text"}, "body": {"type": "text"},
+        "price": {"type": "integer"}, "qty": {"type": "integer"},
+        "tag": {"type": "keyword"},
+    }})
+    idx = e.indices["b"]
+    rows = [
+        ("1", {"title": "red widget", "body": "a fine red widget", "price": 10, "qty": 3, "tag": "a"}),
+        ("2", {"title": "blue widget", "body": "blue and shiny", "price": 20, "qty": 5, "tag": "b"}),
+        ("3", {"title": "red gadget", "body": "gadget of red color", "price": 30, "qty": 2, "tag": "a"}),
+        ("4", {"title": "green thing", "body": "just a thing", "price": 40, "qty": 1, "tag": "c"}),
+    ]
+    for i, src in rows:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e, idx
+
+
+# ---- runtime fields -------------------------------------------------------
+
+def test_runtime_field_in_query_and_agg():
+    e, idx = _engine()
+    rm = {"total_value": {"type": "double",
+                          "script": {"source": "emit(doc['price'].value * doc['qty'].value)"}}}
+    r = idx.search(query={"range": {"total_value": {"gte": 60}}},
+                   runtime_mappings=rm)
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    # 1: 30, 2: 100, 3: 60, 4: 40
+    assert ids == {"2", "3"}
+    r = idx.search(runtime_mappings=rm, aggs={"m": {"max": {"field": "total_value"}}})
+    assert r["aggregations"]["m"]["value"] == 100.0
+
+
+def test_runtime_field_sort():
+    e, idx = _engine()
+    rm = {"neg_price": {"type": "long", "script": {"source": "emit(0 - doc['price'].value)"}}}
+    r = idx.search(sort=[{"neg_price": "asc"}], runtime_mappings=rm)
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["4", "3", "2", "1"]
+
+
+def test_runtime_field_shadow_rejected():
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+    e, idx = _engine()
+    with pytest.raises(IllegalArgumentError):
+        idx.search(runtime_mappings={"price": {"type": "long",
+                                               "script": {"source": "emit(1)"}}})
+
+
+# ---- query_string ---------------------------------------------------------
+
+def _qs(idx, q, **kw):
+    body = {"query": q, **kw}
+    return idx.search(query={"query_string": body}, size=10)
+
+
+def test_query_string_basics():
+    e, idx = _engine()
+    assert {h["_id"] for h in _qs(idx, "red widget")["hits"]["hits"]} == {"1", "2", "3"}
+    assert {h["_id"] for h in _qs(idx, "red AND widget")["hits"]["hits"]} == {"1"}
+    assert {h["_id"] for h in _qs(idx, "title:red")["hits"]["hits"]} == {"1", "3"}
+    assert {h["_id"] for h in _qs(idx, "red -gadget")["hits"]["hits"]} == {"1"}
+    assert {h["_id"] for h in _qs(idx, '"red widget"')["hits"]["hits"]} == {"1"}
+    assert {h["_id"] for h in _qs(idx, "price:[20 TO 30]")["hits"]["hits"]} == {"2", "3"}
+    assert {h["_id"] for h in _qs(idx, "price:>=30")["hits"]["hits"]} == {"3", "4"}
+    assert {h["_id"] for h in _qs(idx, "wid*")["hits"]["hits"]} == {"1", "2"}
+    assert {h["_id"] for h in _qs(idx, "_exists_:tag")["hits"]["hits"]} == {"1", "2", "3", "4"}
+    assert {h["_id"] for h in _qs(idx, "(red OR blue) AND widget")["hits"]["hits"]} == {"1", "2"}
+    assert {h["_id"] for h in _qs(idx, "widgte~")["hits"]["hits"]} == {"1", "2"}
+
+
+def test_query_string_malformed_raises():
+    e, idx = _engine()
+    with pytest.raises(QueryParsingError):
+        _qs(idx, "(unclosed AND paren")
+
+
+def test_simple_query_string_forgiving():
+    e, idx = _engine()
+
+    def sqs(q, **kw):
+        return idx.search(query={"simple_query_string": {"query": q, **kw}}, size=10)
+
+    assert {h["_id"] for h in sqs("red widget")["hits"]["hits"]} == {"1", "2", "3"}
+    assert {h["_id"] for h in sqs("red +widget")["hits"]["hits"]} == {"1"}
+    assert {h["_id"] for h in sqs('"red widget"')["hits"]["hits"]} == {"1"}
+    assert {h["_id"] for h in sqs("wid*")["hits"]["hits"]} == {"1", "2"}
+    # malformed input must not raise
+    sqs("((((")
+    sqs('"unclosed')
+
+
+# ---- search templates -----------------------------------------------------
+
+async def _template_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/t", json={"mappings": {"properties": {
+        "name": {"type": "text"}, "n": {"type": "integer"}}}})
+    lines = []
+    for i in range(5):
+        lines.append(json.dumps({"index": {"_index": "t", "_id": str(i)}}))
+        lines.append(json.dumps({"name": f"item {i}", "n": i}))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/t/_refresh")
+
+    # inline template
+    r = await client.post("/t/_search/template", json={
+        "source": '{"query": {"range": {"n": {"gte": {{min_n}}{{^min_n}}0{{/min_n}}}}}, "size": {{size}}}',
+        "params": {"min_n": 3, "size": 10},
+    })
+    body = await r.json()
+    assert body["hits"]["total"]["value"] == 2
+
+    # stored template
+    r = await client.put("/_scripts/my-tpl", json={"script": {
+        "lang": "mustache",
+        "source": '{"query": {"match": {"name": "{{q}}"}}}'}})
+    assert (await r.json())["acknowledged"]
+    r = await client.post("/t/_search/template", json={"id": "my-tpl", "params": {"q": "item 2"}})
+    assert (await (r).json())["hits"]["total"]["value"] >= 1
+
+    # render only
+    r = await client.post("/_render/template", json={
+        "source": '{"query": {"terms": {"n": {{#toJson}}ns{{/toJson}}}}}',
+        "params": {"ns": [1, 2]},
+    })
+    assert (await r.json())["template_output"] == {"query": {"terms": {"n": [1, 2]}}}
+
+    r = await client.get("/_scripts/my-tpl")
+    assert (await r.json())["found"]
+    r = await client.delete("/_scripts/my-tpl")
+    assert (await r.json())["acknowledged"]
+    r = await client.get("/_scripts/my-tpl")
+    assert r.status == 404
+    await client.close()
+
+
+def test_search_templates():
+    asyncio.run(_template_drive())
