@@ -40,7 +40,7 @@ VECTOR_TYPES = {"dense_vector"}
 COMPLETION_TYPES = {"completion"}
 ALL_TYPES = (
     TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | BOOL_TYPES | VECTOR_TYPES
-    | COMPLETION_TYPES | {"object"}
+    | COMPLETION_TYPES | {"object", "percolator"}
 )
 
 _INT_BOUNDS = {
@@ -251,9 +251,9 @@ class Mappings:
         if value is None:
             return
         ft_pre = self.fields.get(full)
-        if ft_pre is not None and ft_pre.type == "completion":
-            # completion values keep their raw shape (str | [str] |
-            # {"input": ..., "weight": n}); the pack builder normalizes
+        if ft_pre is not None and ft_pre.type in ("completion", "percolator"):
+            # completion/percolator values keep their raw shape; the pack
+            # builder stores them host-side
             out.setdefault(full, []).append(value)
             return
         if isinstance(value, dict):

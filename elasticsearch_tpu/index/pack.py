@@ -138,6 +138,8 @@ class ShardPack:
     # completion-suggester inputs, host-side only:
     # field -> sorted list of (input, weight, docid)
     completion: dict[str, list] = dc_field(default_factory=dict)
+    # percolator queries, host-side only: field -> list of (docid, query_dict)
+    percolator: dict[str, list] = dc_field(default_factory=dict)
 
     def dense_row_of(self, fld: str, term: str) -> int | None:
         return self.dense_dict.get((fld, term))
@@ -212,6 +214,7 @@ class PackBuilder:
         self.docvalue_raw: dict[str, list[tuple[int, Any]]] = {}
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
         self.completion_raw: dict[str, list[tuple[str, int, int]]] = {}
+        self.percolator_raw: dict[str, list] = {}
         self.num_docs = 0
         # C++ accumulator owns the per-token hot loop when available
         # (native/packing.cpp); dict fallback otherwise. Packs are
@@ -302,6 +305,15 @@ class PackBuilder:
             elif t in FLOAT_TYPES:
                 if ft.doc_values and values:
                     self.docvalue_raw.setdefault(fld, []).append((docid, float(values[0])))
+            elif t == "percolator":
+                for v in values:
+                    if not isinstance(v, dict):
+                        from ..utils.errors import MapperParsingError
+
+                        raise MapperParsingError(
+                            f"percolator field [{fld}] requires a query object"
+                        )
+                    self.percolator_raw.setdefault(fld, []).append((docid, v))
             elif t == "completion":
                 for v in values:
                     if isinstance(v, dict):
@@ -608,6 +620,7 @@ class PackBuilder:
         completion = {
             fld: sorted(entries) for fld, entries in self.completion_raw.items()
         }
+        percolator = dict(self.percolator_raw)
         return ShardPack(
             num_docs=N,
             post_docids=post_docids,
@@ -630,4 +643,5 @@ class PackBuilder:
             term_pos_start=term_pos_start,
             term_pos_count=term_pos_count,
             completion=completion,
+            percolator=percolator,
         )

@@ -560,9 +560,16 @@ _PARSERS = {
     "function_score": _parse_function_score,
     "script_score": _parse_script_score,
     "script": _parse_script_filter,
+    "percolate": lambda body, m: _parse_percolate(body, m),
     "query_string": lambda body, m: _parse_query_string(body, m),
     "simple_query_string": lambda body, m: _parse_simple_query_string(body, m),
 }
+
+
+def _parse_percolate(body, mappings):
+    from .percolate import parse_percolate
+
+    return parse_percolate(body, mappings)
 
 
 def _parse_query_string(body, mappings):

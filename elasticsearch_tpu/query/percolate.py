@@ -1,0 +1,88 @@
+"""Percolate query: reverse search over stored queries.
+
+Parity target: modules/percolator (reference behavior:
+PercolateQueryBuilder.java — stored queries in `percolator` fields are run
+against an in-memory index of the candidate document(s); matching query-docs
+become hits). Here each shard keeps its stored queries host-side
+(pack.percolator); at percolate time the candidate documents build a tiny
+pack once, every stored query runs against it, and the matching query-doc
+ids feed the device as an explicit id set — so percolate composes with any
+enclosing bool query like a normal clause."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import IllegalArgumentError
+from .nodes import QueryNode
+
+
+def _bucket(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length() if n > 1 else 1
+
+
+@dataclass
+class PercolateNode(QueryNode):
+    fld: str = ""
+    documents: list = dc_field(default_factory=list)
+    mappings: object = None
+    boost: float = 1.0
+    _matcher: object = None
+
+    def _ensure_matcher(self):
+        if self._matcher is not None:
+            return
+        from ..index.pack import PackBuilder
+        from ..query.executor import ShardSearcher
+
+        b = PackBuilder(self.mappings, use_native=False)
+        for d in self.documents:
+            b.add_document(self.mappings.parse_document(d))
+        pack = b.build(dense_min_df=1 << 62)
+        self._matcher = ShardSearcher(pack, mappings=self.mappings)
+
+    def _query_matches(self, qdict) -> bool:
+        try:
+            return self._matcher.count(qdict) > 0
+        except Exception:  # noqa: BLE001 - malformed stored query never matches
+            return False
+
+    def prepare(self, pack):
+        real = getattr(pack, "pack", pack)
+        stored = real.percolator.get(self.fld, [])
+        self._ensure_matcher()
+        matched = [docid for docid, q in stored if self._query_matches(q)]
+        width = _bucket(max(len(matched), 1))
+        ids = np.full(width, -1, np.int32)
+        ids[: len(matched)] = matched
+        return (ids, np.float32(self.boost)), ("percolate", self.fld, width)
+
+    def device_eval(self, dev, params, ctx):
+        ids, boost = params
+        n1 = ctx.num_docs + 1
+        tgt = jnp.where(ids >= 0, ids, ctx.num_docs)  # pad -> dead slot
+        match = jnp.zeros(n1, bool).at[tgt].set(ids >= 0)
+        match = match.at[ctx.num_docs].set(False)
+        score = jnp.where(match, boost, 0.0)
+        return score, match
+
+
+def parse_percolate(body, mappings) -> PercolateNode:
+    if not isinstance(body, dict):
+        raise IllegalArgumentError("[percolate] expects an object")
+    fld = body.get("field")
+    if not fld:
+        raise IllegalArgumentError("[percolate] requires [field]")
+    docs = body.get("documents")
+    if docs is None:
+        doc = body.get("document")
+        if doc is None:
+            raise IllegalArgumentError("[percolate] requires [document] or [documents]")
+        docs = [doc]
+    return PercolateNode(
+        fld=fld, documents=list(docs), mappings=mappings,
+        boost=float(body.get("boost", 1.0)),
+    )

@@ -366,6 +366,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     # ---- admin / observability -------------------------------------------
 
     @handler
+    async def rank_eval_api(request):
+        from ..search.rankeval import rank_eval
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(rank_eval, engine, body))
+
+    @handler
     async def analyze_api(request):
         from ..engine import admin
 
@@ -598,6 +605,23 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     async def _run_search(expression, body, query_params):
         body = body or {}
+        if body.get("retriever") is not None:
+            from ..search.rankeval import rrf_retriever_search
+
+            import time
+
+            t0 = time.monotonic()
+            res = await call(
+                rrf_retriever_search, engine, expression, body["retriever"],
+                int(query_params.get("size", body.get("size", 10))),
+                int(query_params.get("from", body.get("from", 0))),
+            )
+            return {
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+                **res,
+            }
         query = body.get("query")
         knn = body.get("knn")
         size = int(query_params.get("size", body.get("size", 10)))
@@ -1198,6 +1222,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_route("*", "/_rank_eval", rank_eval_api)
+    app.router.add_route("*", "/{index}/_rank_eval", rank_eval_api)
     app.router.add_route("*", "/_analyze", analyze_api)
     app.router.add_route("*", "/{index}/_analyze", analyze_api)
     app.router.add_route("*", "/_validate/query", validate_query_api)

@@ -1,0 +1,143 @@
+"""Percolator, _rank_eval metrics, RRF retriever."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+
+
+def test_percolate_matches_stored_queries():
+    e = Engine(None)
+    e.create_index("alerts", {"properties": {
+        "query": {"type": "percolator"},
+        "msg": {"type": "text"}, "level": {"type": "keyword"},
+    }})
+    idx = e.indices["alerts"]
+    idx.index_doc("q1", {"query": {"match": {"msg": "error"}}})
+    idx.index_doc("q2", {"query": {"bool": {"must": [
+        {"match": {"msg": "disk"}}, {"term": {"level": "FATAL"}}]}}})
+    idx.index_doc("q3", {"query": {"range": {"code": {"gte": 500}}}})
+    idx.refresh()
+
+    r = idx.search(query={"percolate": {"field": "query",
+                                        "document": {"msg": "disk error", "level": "WARN"}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"q1"}
+
+    r = idx.search(query={"percolate": {"field": "query",
+                                        "document": {"msg": "disk full", "level": "FATAL"}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"q2"}
+
+    r = idx.search(query={"percolate": {"field": "query",
+                                        "document": {"code": 503}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"q3"}
+
+    # multiple documents: query matches if it matches ANY document
+    r = idx.search(query={"percolate": {"field": "query", "documents": [
+        {"msg": "all good"}, {"msg": "error here"}]}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"q1"}
+
+    # composes inside bool
+    r = idx.search(query={"bool": {"must": [
+        {"percolate": {"field": "query", "document": {"msg": "error"}}},
+        {"ids": {"values": ["q2", "q3"]}},
+    ]}})
+    assert r["hits"]["total"]["value"] == 0
+
+
+def _ratings_engine():
+    e = Engine(None)
+    e.create_index("d", {"properties": {"t": {"type": "text"}}})
+    idx = e.indices["d"]
+    for i, txt in [("1", "apple apple apple"), ("2", "apple banana"),
+                   ("3", "banana cherry"), ("4", "apple")]:
+        idx.index_doc(i, {"t": txt})
+    idx.refresh()
+    return e
+
+
+def test_rank_eval_precision_and_mrr():
+    e = _ratings_engine()
+    body = {
+        "requests": [{
+            "id": "q1",
+            "request": {"query": {"match": {"t": "apple"}}, "size": 4},
+            "ratings": [
+                {"_index": "d", "_id": "1", "rating": 1},
+                {"_index": "d", "_id": "2", "rating": 1},
+                {"_index": "d", "_id": "3", "rating": 0},
+            ],
+        }],
+        "metric": {"precision": {"k": 3}},
+    }
+    from elasticsearch_tpu.search.rankeval import rank_eval
+
+    out = rank_eval(e, body)
+    # top-3 by BM25 for "apple": docs 1, 4, 2 -> rated relevant: 1 and 2
+    assert out["details"]["q1"]["metric_score"] == pytest.approx(2 / 3)
+    assert {d["_id"] for d in out["details"]["q1"]["unrated_docs"]} == {"4"}
+
+    body["metric"] = {"mean_reciprocal_rank": {"k": 4}}
+    out = rank_eval(e, body)
+    assert out["metric_score"] == 1.0  # first hit is rated relevant
+
+    body["metric"] = {"dcg": {"k": 4, "normalize": True}}
+    out = rank_eval(e, body)
+    assert 0 < out["metric_score"] <= 1.0
+
+
+def test_rrf_retriever():
+    e = Engine(None)
+    e.create_index("r", {"properties": {
+        "t": {"type": "text"}, "v": {"type": "dense_vector", "dims": 2}}})
+    idx = e.indices["r"]
+    idx.index_doc("1", {"t": "alpha beta", "v": [1.0, 0.0]})
+    idx.index_doc("2", {"t": "alpha", "v": [0.0, 1.0]})
+    idx.index_doc("3", {"t": "beta gamma", "v": [0.9, 0.1]})
+    idx.refresh()
+    from elasticsearch_tpu.search.rankeval import rrf_retriever_search
+
+    res = rrf_retriever_search(e, "r", {"rrf": {"retrievers": [
+        {"standard": {"query": {"match": {"t": "alpha"}}}},
+        {"knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 3,
+                 "num_candidates": 3}},
+    ], "rank_constant": 60}}, size=3, from_=0)
+    hits = res["hits"]["hits"]
+    # doc 1 ranks in both lists -> fused first
+    assert hits[0]["_id"] == "1"
+    assert hits[0]["_score"] > hits[1]["_score"]
+    assert {h["_id"] for h in hits} == {"1", "2", "3"}
+
+
+async def _rest_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/d", json={"mappings": {"properties": {"t": {"type": "text"}}}})
+    lines = []
+    for i, txt in [("1", "x y"), ("2", "x")]:
+        lines.append(json.dumps({"index": {"_index": "d", "_id": i}}))
+        lines.append(json.dumps({"t": txt}))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/d/_refresh")
+    r = await client.post("/d/_rank_eval", json={
+        "requests": [{"id": "a", "request": {"query": {"match": {"t": "x"}}},
+                      "ratings": [{"_index": "d", "_id": "2", "rating": 1}]}],
+        "metric": {"recall": {"k": 2}},
+    })
+    assert (await r.json())["metric_score"] == 1.0
+    r = await client.post("/d/_search", json={"retriever": {"standard": {
+        "query": {"match": {"t": "x"}}}}})
+    assert (await r.json())["hits"]["total"]["value"] == 2
+    await client.close()
+
+
+def test_rest_rank_eval_and_retriever():
+    asyncio.run(_rest_drive())
