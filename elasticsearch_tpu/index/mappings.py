@@ -99,6 +99,9 @@ class FieldType:
     ignore_above: int | None = None  # keyword only
     dims: int | None = None  # dense_vector only
     similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
+    # ANN index options (dense_vector): partitions for the IVF index (the
+    # TPU-native ANN; hnsw/int8_hnsw index_options map onto it)
+    ann_nlist: int | None = None
     fields: dict = field(default_factory=dict)  # sub-fields (e.g. .keyword)
 
     _analyzer_obj: Analyzer | None = None
@@ -178,6 +181,13 @@ class Mappings:
             )
             if ftype == "dense_vector" and not ft.dims:
                 raise MapperParsingError(f"dense_vector field [{full}] requires [dims]")
+            if ftype == "dense_vector":
+                io = spec.get("index_options") or {}
+                # hnsw/int8_hnsw request ANN; the TPU-native ANN is IVF
+                # (nlist from m, or explicit "nlist" for type "ivf")
+                if io.get("type") in ("hnsw", "int8_hnsw", "int4_hnsw", "ivf"):
+                    # 0 = auto (sqrt(N) at pack-build time)
+                    ft.ann_nlist = int(io.get("nlist", 0))
             for sub_name, sub_spec in spec.get("fields", {}).items():
                 sub = FieldType(
                     name=f"{full}.{sub_name}",

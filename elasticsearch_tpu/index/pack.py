@@ -99,6 +99,8 @@ class VectorColumn:
     has_value: np.ndarray  # [N] bool
     similarity: str  # cosine | dot_product | l2_norm
     dims: int
+    # optional IVF ANN partition index (ops/vector.build_ivf output)
+    ivf: dict | None = None
 
 
 @dataclass
@@ -560,7 +562,13 @@ class PackBuilder:
             for docid, vec in pairs:
                 vals[docid] = vec
                 has[docid] = True
-            vectors[fld] = VectorColumn(vals, has, ft.similarity, ft.dims)
+            vc = VectorColumn(vals, has, ft.similarity, ft.dims)
+            if ft.ann_nlist is not None:
+                from ..ops.vector import build_ivf
+
+                nlist = ft.ann_nlist or max(1, int(has.sum() ** 0.5))
+                vc.ivf = build_ivf(vals, has, nlist)
+            vectors[fld] = vc
 
         # ---- position blocks (vectorized scatter from flat CSR) ----------
         pos_keys = None

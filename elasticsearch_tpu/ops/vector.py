@@ -16,6 +16,7 @@ Score functions match the reference's `_score` conventions:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -41,3 +42,80 @@ def knn_scores(
     if similarity == "max_inner_product":
         return jnp.where(dots < 0, 1.0 / (1.0 - dots), dots + 1.0)
     raise ValueError(f"unknown similarity [{similarity}]")
+
+
+def kmeans_ivf(vectors, nlist: int, iters: int = 8):
+    """Host-driven k-means for the IVF partition index (the TPU-native ANN
+    replacing the reference's HNSW graphs, index/codec/vectors/ — a graph
+    walk is pointer-chasing; nprobe-partitioned brute force is MXU-shaped).
+
+    -> (centroids [C, D] f32, assign [N] int32). Runs the Lloyd iterations
+    as jax matmuls (device-accelerated when one is present)."""
+    import numpy as np
+
+    vecs = jnp.asarray(vectors, jnp.float32)
+    N, D = vecs.shape
+    C = max(1, min(nlist, N))
+    # deterministic strided init over the corpus
+    init_idx = (jnp.arange(C) * (N // C)).astype(jnp.int32)
+    centroids = vecs[init_idx]
+    for _ in range(iters):
+        # argmin ||v-c||^2 == argmax v.c - ||c||^2/2
+        logits = vecs @ centroids.T - 0.5 * jnp.sum(centroids * centroids, axis=1)[None, :]
+        assign = jnp.argmax(logits, axis=1)
+        sums = jnp.zeros((C, D), jnp.float32).at[assign].add(vecs)
+        counts = jnp.zeros((C,), jnp.float32).at[assign].add(1.0)
+        centroids = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centroids
+        )
+    logits = vecs @ centroids.T - 0.5 * jnp.sum(centroids * centroids, axis=1)[None, :]
+    assign = jnp.argmax(logits, axis=1)
+    return np.asarray(centroids), np.asarray(assign, np.int32)
+
+
+def build_ivf(vectors, has_value, nlist: int):
+    """-> dict(centroids, order, part_start, max_part) partition index over
+    the present vectors; None when the corpus is too small to help."""
+    import numpy as np
+
+    present = np.flatnonzero(has_value)
+    if len(present) < 4 * max(nlist, 1) or nlist <= 1:
+        return None
+    centroids, assign = kmeans_ivf(vectors[present], nlist)
+    C = centroids.shape[0]
+    order_local = np.argsort(assign, kind="stable")
+    order = present[order_local].astype(np.int32)  # partition-sorted docids
+    sizes = np.bincount(assign, minlength=C)
+    part_start = np.zeros(C + 1, np.int64)
+    np.cumsum(sizes, out=part_start[1:])
+    return {
+        "centroids": centroids.astype(np.float32),
+        "order": order,
+        "part_start": part_start.astype(np.int32),
+        "max_part": int(sizes.max()),
+    }
+
+
+def ivf_candidates(
+    ivf_centroids,  # [C, D] f32
+    ivf_order,  # [NV] int32 partition-sorted docids (padded with -1)
+    ivf_part_start,  # [C+1] int32
+    qvec,  # [D]
+    nprobe: int,
+    max_part: int,
+):
+    """-> (cand_ids [nprobe*max_part] int32 with -1 padding). Probes the
+    nprobe closest partitions by centroid distance."""
+    C = ivf_centroids.shape[0]
+    logits = ivf_centroids @ qvec - 0.5 * jnp.sum(
+        ivf_centroids * ivf_centroids, axis=1
+    )
+    _, probe = jax.lax.top_k(logits, min(nprobe, C))
+    starts = ivf_part_start[probe]  # [P]
+    ends = ivf_part_start[probe + 1]
+    offs = jnp.arange(max_part, dtype=jnp.int32)[None, :]
+    idx = starts[:, None] + offs  # [P, max_part]
+    valid = idx < ends[:, None]
+    idx = jnp.clip(idx, 0, ivf_order.shape[0] - 1)
+    ids = jnp.where(valid, ivf_order[idx], -1)
+    return ids.reshape(-1)

@@ -93,10 +93,17 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         if col.uniq_ords is not None:
             dev["dv_int_ord"][f] = put(col.uniq_ords)
     dev["vec_sq"] = {}
+    dev["vec_ivf"] = {}
     for f, vc in sp.vectors.items():
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
+        if vc.ivf is not None:
+            dev["vec_ivf"][f] = {
+                "centroids": put(vc.ivf["centroids"]),
+                "order": put(vc.ivf["order"]),
+                "part_start": put(vc.ivf["part_start"]),
+            }
     if sp.dense_tfn is not None:
         dev["dense_tfn"] = put(sp.dense_tfn)
     if sp.pos_keys is not None:

@@ -238,7 +238,34 @@ class StackedPack:
                 if fld in p.vectors:
                     vals[i, : p.num_docs] = p.vectors[fld].values
                     has[i, : p.num_docs] = p.vectors[fld].has_value
-            self.vectors[fld] = VectorColumn(vals, has, vc0.similarity, vc0.dims)
+            svc = VectorColumn(vals, has, vc0.similarity, vc0.dims)
+            # stacked IVF: present only when EVERY populated shard built one
+            # (uniform nlist ensured by shared mappings)
+            ivfs = [p.vectors[fld].ivf for p in shards if fld in p.vectors]
+            if ivfs and all(v is not None for v in ivfs):
+                C = max(v["centroids"].shape[0] for v in ivfs)
+                max_part = max(v["max_part"] for v in ivfs)
+                nv_max = max(len(v["order"]) for v in ivfs)
+                # pad centroids get a huge norm so their assignment logit
+                # (c.q - ||c||^2/2) can never win a probe
+                cents = np.full((self.S, C, vc0.dims), 1e6, np.float32)
+                order = np.full((self.S, max(nv_max, 1)), -1, np.int32)
+                pstart = np.zeros((self.S, C + 1), np.int32)
+                for i, p in enumerate(shards):
+                    v = p.vectors[fld].ivf if fld in p.vectors else None
+                    if v is None:
+                        continue
+                    c_i = v["centroids"].shape[0]
+                    cents[i, :c_i] = v["centroids"]
+                    # empty pad partitions keep start==end at the tail
+                    pstart[i, : c_i + 1] = v["part_start"]
+                    pstart[i, c_i + 1:] = v["part_start"][-1]
+                    order[i, : len(v["order"])] = v["order"]
+                svc.ivf = {
+                    "centroids": cents, "order": order,
+                    "part_start": pstart, "max_part": max_part,
+                }
+            self.vectors[fld] = svc
 
         # ---- global dense tier -------------------------------------------
         # tier membership must be a GLOBAL decision (global df) so every

@@ -348,10 +348,22 @@ class KnnNode(QueryNode):
         self._kk = min(self.num_candidates or self.k, max(pack.num_docs, 1))
         if vc is not None:
             self._sim = vc.similarity
-        # threshold is a trace-time constant -> must be in the cache key
+        # IVF ANN path: only for plain knn (filters/thresholds fall back to
+        # the exact scan — the reference's filtered HNSW analog would need
+        # candidate over-probing); nprobe sized so the probed partitions
+        # cover ~num_candidates vectors
+        self._ivf = None
+        ivf = getattr(vc, "ivf", None) if vc is not None else None
+        if (ivf is not None and self.filter_node is None
+                and self.similarity_threshold is None):
+            C = ivf["centroids"].shape[-2]
+            nv = ivf["order"].shape[-1]
+            avg_part = max(1, nv // max(C, 1))
+            nprobe = min(C, max(1, -(-self._kk // avg_part) + 1))
+            self._ivf = (C, int(ivf["max_part"]), int(nprobe))
         return (qv, np.float32(self.boost), fp), (
             "knn", self.fld, vc is None, self._kk, self._sim,
-            self.similarity_threshold, fk,
+            self.similarity_threshold, fk, self._ivf,
         )
 
     def _score_threshold(self) -> float:
@@ -368,7 +380,7 @@ class KnnNode(QueryNode):
         return t
 
     def device_eval(self, dev, params, ctx):
-        from ..ops.vector import knn_scores
+        from ..ops.vector import ivf_candidates, knn_scores
 
         qv, boost, fp = params
         n1 = ctx.num_docs + 1
@@ -376,8 +388,27 @@ class KnnNode(QueryNode):
             return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
         vecs = dev["vec"][self.fld]
         has = dev["vec_has"][self.fld]
-        scores = knn_scores(vecs, dev["vec_sq"][self.fld], qv, self._sim)
-        ok = has & dev["live"]
+        if self._ivf is not None and self.fld in dev.get("vec_ivf", {}):
+            # ANN: score only the probed partitions' vectors, scatter the
+            # candidate scores into the dense accumulator
+            ivf = dev["vec_ivf"][self.fld]
+            C, max_part, nprobe = self._ivf
+            cand = ivf_candidates(
+                ivf["centroids"], ivf["order"], ivf["part_start"],
+                qv, nprobe, max_part,
+            )
+            safe = jnp.where(cand >= 0, cand, 0)
+            sub_scores = knn_scores(
+                vecs[safe], dev["vec_sq"][self.fld][safe], qv, self._sim
+            )
+            tgt = jnp.where(cand >= 0, cand, ctx.num_docs)
+            scores_n1 = jnp.zeros(n1, jnp.float32).at[tgt].set(sub_scores)
+            in_cand = jnp.zeros(n1, bool).at[tgt].set(cand >= 0)
+            scores = scores_n1[: ctx.num_docs]
+            ok = in_cand[: ctx.num_docs] & has & dev["live"]
+        else:
+            scores = knn_scores(vecs, dev["vec_sq"][self.fld], qv, self._sim)
+            ok = has & dev["live"]
         if self.filter_node is not None:
             _, fm = self.filter_node.device_eval(dev, fp, ctx)
             ok = ok & fm[: ctx.num_docs]
