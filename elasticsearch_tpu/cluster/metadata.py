@@ -54,6 +54,8 @@ class MetadataStore:
         self.index_templates: dict[str, dict] = {}
         self.component_templates: dict[str, dict] = {}
         self.stored_scripts: dict[str, dict] = {}
+        self.data_streams: dict[str, dict] = {}
+        self.ilm_policies: dict[str, dict] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -70,6 +72,8 @@ class MetadataStore:
             self.index_templates = state.get("index_templates", {})
             self.component_templates = state.get("component_templates", {})
             self.stored_scripts = state.get("stored_scripts", {})
+            self.data_streams = state.get("data_streams", {})
+            self.ilm_policies = state.get("ilm_policies", {})
 
     def save(self):
         f = self._file()
@@ -83,6 +87,8 @@ class MetadataStore:
                     "index_templates": self.index_templates,
                     "component_templates": self.component_templates,
                     "stored_scripts": self.stored_scripts,
+                    "data_streams": self.data_streams,
+                    "ilm_policies": self.ilm_policies,
                 },
                 fh,
             )
@@ -197,8 +203,15 @@ class MetadataStore:
                     if fnmatch.fnmatchcase(alias, pat):
                         for m in self.aliases[alias]:
                             add(m)
+                for ds in sorted(self.data_streams):
+                    if fnmatch.fnmatchcase(ds, pat):
+                        for m in self.data_streams[ds]["indices"]:
+                            add(m)
             elif pat in self.aliases:
                 for m in self.aliases[pat]:
+                    add(m)
+            elif pat in self.data_streams:
+                for m in self.data_streams[pat]["indices"]:
                     add(m)
             elif pat in concrete:
                 add(pat)

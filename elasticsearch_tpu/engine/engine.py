@@ -749,7 +749,9 @@ class Engine:
                 from ..query.dsl import parse_query
 
                 parse_query(props["filter"], m)
-        idx = EsIndex(name, m, settings or {}, self._dir_for(name),
+        settings = dict(settings or {})
+        settings.setdefault("creation_date", int(time.time() * 1000))
+        idx = EsIndex(name, m, settings, self._dir_for(name),
                       breaker_account=self._pack_accounter(name))
         self.indices[name] = idx
         for alias, props in (aliases or {}).items():
@@ -763,7 +765,10 @@ class Engine:
         return idx
 
     def resolve_write_index(self, name: str) -> str:
-        """Alias → its write index; concrete names pass through."""
+        """Alias/data-stream → its write index; concrete names pass
+        through."""
+        if name in self.meta.data_streams:
+            return self.meta.data_streams[name]["indices"][-1]
         if name in self.meta.aliases and name not in self.indices:
             return self.meta.write_index_of(name)
         return name
@@ -778,7 +783,15 @@ class Engine:
 
     def get_or_autocreate(self, name: str) -> EsIndex:
         """Auto-create on first write, like the reference's
-        action.auto_create_index default (TransportBulkAction auto-create)."""
+        action.auto_create_index default (TransportBulkAction auto-create).
+        A name matching a data_stream template auto-creates the stream
+        (reference behavior: TransportBulkAction data-stream auto-create)."""
+        if name not in self.indices and name not in self.meta.aliases \
+                and name not in self.meta.data_streams:
+            from .lifecycle import _matching_ds_template, create_data_stream
+
+            if _matching_ds_template(self, name) is not None:
+                create_data_stream(self, name)
         name = self.resolve_write_index(name)
         if name not in self.indices:
             return self.create_index(name)

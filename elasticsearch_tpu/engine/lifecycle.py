@@ -1,0 +1,334 @@
+"""Data streams, rollover, and index lifecycle management (ILM).
+
+Parity targets (reference): modules/data-streams +
+cluster/metadata/DataStream.java:70 (generation-numbered backing indices,
+`.ds-<name>-<date>-<generation>` naming, write index = latest generation);
+rollover in MetadataRolloverService.java (conditions max_age/max_docs/
+max_size evaluated against the write index); ILM in x-pack/plugin/ilm
+(policy phases hot/warm/delete driven by index age + rollover state,
+IndexLifecycleService periodic tick)."""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+import time
+
+from ..utils.errors import IllegalArgumentError, ResourceAlreadyExistsError, ResourceNotFoundError
+from ..utils.durations import parse_duration_millis
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def _backing_name(stream: str, generation: int) -> str:
+    date = time.strftime("%Y.%m.%d")
+    return f".ds-{stream}-{date}-{generation:06d}"
+
+
+def _matching_ds_template(engine, name: str) -> dict | None:
+    best = None
+    best_prio = -1
+    for tname, t in engine.meta.index_templates.items():
+        if "data_stream" not in t:
+            continue
+        pats = t.get("index_patterns") or []
+        if any(fnmatch.fnmatch(name, p) for p in pats):
+            prio = int(t.get("priority", 0))
+            if prio > best_prio:
+                best, best_prio = t, prio
+    return best
+
+
+# ---- data streams ---------------------------------------------------------
+
+def _create_backing(engine, tpl: dict, backing: str):
+    t = (tpl or {}).get("template") or {}
+    mappings = dict(t.get("mappings") or {})
+    props = dict(mappings.get("properties") or {})
+    props.setdefault("@timestamp", {"type": "date"})
+    mappings["properties"] = props
+    settings = dict(t.get("settings") or {})
+    if "index" in settings:
+        inner = settings.pop("index")
+        settings.update({k: v for k, v in inner.items()})
+    settings = {k.removeprefix("index."): v for k, v in settings.items()}
+    engine.create_index(backing, mappings=mappings, settings=settings)
+
+
+def create_data_stream(engine, name: str) -> dict:
+    if name in engine.meta.data_streams:
+        raise ResourceAlreadyExistsError(f"data_stream [{name}] already exists")
+    if name in engine.indices or name in engine.meta.aliases:
+        raise IllegalArgumentError(
+            f"data stream [{name}] conflicts with an existing index or alias"
+        )
+    tpl = _matching_ds_template(engine, name)
+    if tpl is None:
+        raise IllegalArgumentError(
+            f"no matching index template with a data_stream definition for [{name}]"
+        )
+    backing = _backing_name(name, 1)
+    _create_backing(engine, tpl, backing)
+    engine.meta.data_streams[name] = {
+        "generation": 1,
+        "indices": [backing],
+        "timestamp_field": "@timestamp",
+        "created": _now_ms(),
+    }
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def delete_data_stream(engine, name: str) -> dict:
+    ds = engine.meta.data_streams.get(name)
+    if ds is None:
+        raise ResourceNotFoundError(f"data_stream [{name}] not found")
+    for backing in list(ds["indices"]):
+        if backing in engine.indices:
+            engine.delete_index(backing)
+    del engine.meta.data_streams[name]
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def get_data_streams(engine, pattern: str | None = None) -> dict:
+    out = []
+    for name in sorted(engine.meta.data_streams):
+        if pattern and pattern not in ("*", "_all") and not any(
+            fnmatch.fnmatch(name, p) for p in pattern.split(",")
+        ):
+            continue
+        ds = engine.meta.data_streams[name]
+        out.append({
+            "name": name,
+            "timestamp_field": {"name": ds["timestamp_field"]},
+            "indices": [{"index_name": n} for n in ds["indices"]],
+            "generation": ds["generation"],
+            "status": "GREEN",
+            "template": "",
+        })
+    return {"data_streams": out}
+
+
+def ds_write_index(engine, name: str) -> str | None:
+    ds = engine.meta.data_streams.get(name)
+    if ds is None:
+        return None
+    return ds["indices"][-1]
+
+
+# ---- rollover -------------------------------------------------------------
+
+_SUFFIX_RE = re.compile(r"^(.*?)-(\d{6})$")
+
+
+def _next_index_name(current: str) -> str:
+    m = _SUFFIX_RE.match(current)
+    if m:
+        return f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+    return f"{current}-000002"
+
+
+def _evaluate_conditions(engine, idx, conditions: dict) -> dict:
+    live = sum(1 for e in idx.docs.values() if e.alive)
+    age_ms = _now_ms() - int(idx.settings.get("creation_date") or _now_ms())
+    from .admin import _index_store_bytes
+
+    size = _index_store_bytes(idx)
+    results = {}
+    for cond, want in (conditions or {}).items():
+        if cond == "max_docs":
+            results["[max_docs: %d]" % int(want)] = live >= int(want)
+        elif cond == "max_age":
+            results[f"[max_age: {want}]"] = age_ms >= parse_duration_millis(want)
+        elif cond in ("max_size", "max_primary_shard_size"):
+            results[f"[{cond}: {want}]"] = size >= _parse_bytes(want)
+        elif cond == "max_primary_shard_docs":
+            results["[max_primary_shard_docs: %d]" % int(want)] = (
+                live // max(idx.num_shards, 1) >= int(want)
+            )
+        else:
+            raise IllegalArgumentError(f"unknown rollover condition [{cond}]")
+    return results
+
+
+def _parse_bytes(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    for suffix, mult in (("pb", 1 << 50), ("tb", 1 << 40), ("gb", 1 << 30),
+                         ("mb", 1 << 20), ("kb", 1 << 10), ("b", 1)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s))
+
+
+def rollover(engine, target: str, body: dict | None, dry_run=False) -> dict:
+    body = body or {}
+    conditions = body.get("conditions") or {}
+    ds = engine.meta.data_streams.get(target)
+    if ds is not None:
+        old_index = ds["indices"][-1]
+        new_index = _backing_name(target, ds["generation"] + 1)
+    else:
+        aliases = engine.meta.aliases.get(target)
+        if not aliases:
+            raise IllegalArgumentError(
+                f"rollover target [{target}] is not a data stream or alias"
+            )
+        old_index = engine.meta.write_index_of(target)
+        new_index = body.get("new_index") or _next_index_name(old_index)
+    idx = engine.get_index(old_index)
+    results = _evaluate_conditions(engine, idx, conditions)
+    met = all(results.values()) if results else True
+    rolled = False
+    if met and not dry_run:
+        if ds is not None:
+            _create_backing(engine, _matching_ds_template(engine, target), new_index)
+            ds["indices"].append(new_index)
+            ds["generation"] += 1
+            engine.meta.save()
+        else:
+            engine.create_index(new_index)
+            props = engine.meta.aliases[target].pop(old_index, {}) or {}
+            props.pop("is_write_index", None)
+            # old index keeps the alias for reads, write flag moves
+            engine.meta.aliases[target][old_index] = props
+            engine.meta.aliases[target][new_index] = {"is_write_index": True}
+            engine.meta.save()
+        rolled = True
+    return {
+        "acknowledged": rolled,
+        "shards_acknowledged": rolled,
+        "old_index": old_index,
+        "new_index": new_index,
+        "rolled_over": rolled,
+        "dry_run": dry_run,
+        "conditions": results,
+    }
+
+
+# ---- ILM ------------------------------------------------------------------
+
+def put_policy(engine, name: str, body: dict) -> dict:
+    policy = (body or {}).get("policy")
+    if not isinstance(policy, dict) or "phases" not in policy:
+        raise IllegalArgumentError("[policy] with [phases] is required")
+    engine.meta.ilm_policies[name] = {
+        "policy": policy, "version": engine.meta.ilm_policies.get(
+            name, {}).get("version", 0) + 1,
+        "modified_date": _now_ms(),
+    }
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def get_policy(engine, name: str | None = None) -> dict:
+    if name:
+        p = engine.meta.ilm_policies.get(name)
+        if p is None:
+            raise ResourceNotFoundError(f"ilm policy [{name}] not found")
+        return {name: p}
+    return dict(engine.meta.ilm_policies)
+
+
+def delete_policy(engine, name: str) -> dict:
+    if name not in engine.meta.ilm_policies:
+        raise ResourceNotFoundError(f"ilm policy [{name}] not found")
+    del engine.meta.ilm_policies[name]
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def _index_policy(engine, idx) -> tuple[str, dict] | None:
+    pname = idx.settings.get("lifecycle.name") or idx.settings.get("index.lifecycle.name")
+    if not pname:
+        return None
+    p = engine.meta.ilm_policies.get(pname)
+    if p is None:
+        return None
+    return pname, p["policy"]
+
+
+def explain(engine, expression: str) -> dict:
+    out = {}
+    for idx, _ in engine.resolve_search(expression, allow_no_indices=True):
+        got = _index_policy(engine, idx)
+        age_ms = _now_ms() - int(idx.settings.get("creation_date") or _now_ms())
+        if got is None:
+            out[idx.name] = {"index": idx.name, "managed": False}
+            continue
+        pname, policy = got
+        out[idx.name] = {
+            "index": idx.name, "managed": True, "policy": pname,
+            "age": f"{age_ms // 1000}s",
+            "phase": _current_phase(policy, age_ms),
+        }
+    return {"indices": out}
+
+
+def _phase_min_age(policy: dict, phase: str) -> int:
+    spec = (policy.get("phases") or {}).get(phase) or {}
+    return parse_duration_millis(spec.get("min_age", "0ms"))
+
+
+def _current_phase(policy: dict, age_ms: int) -> str:
+    phases = policy.get("phases") or {}
+    current = "new"
+    for ph in ("hot", "warm", "cold", "frozen", "delete"):
+        if ph in phases and age_ms >= _phase_min_age(policy, ph):
+            current = ph
+    return current
+
+
+def tick(engine) -> dict:
+    """One ILM evaluation pass over managed indices (the analog of
+    IndexLifecycleService#triggerPolicies on its poll interval)."""
+    actions = []
+    for name in list(engine.indices):
+        idx = engine.indices.get(name)
+        if idx is None:
+            continue
+        got = _index_policy(engine, idx)
+        if got is None:
+            continue
+        pname, policy = got
+        phases = policy.get("phases") or {}
+        age_ms = _now_ms() - int(idx.settings.get("creation_date") or _now_ms())
+        # delete phase wins when its min_age passed
+        if "delete" in phases and age_ms >= _phase_min_age(policy, "delete"):
+            in_ds = None
+            for ds_name, ds in engine.meta.data_streams.items():
+                if name in ds["indices"]:
+                    in_ds = ds
+                    break
+            is_write = in_ds is not None and name == in_ds["indices"][-1]
+            if not is_write:  # never delete a write index; fall through
+                if in_ds is not None:
+                    in_ds["indices"].remove(name)
+                    engine.meta.save()
+                engine.delete_index(name)
+                actions.append({"index": name, "action": "delete"})
+                continue
+        hot = phases.get("hot") or {}
+        roll_cond = (hot.get("actions") or {}).get("rollover")
+        if roll_cond is not None:
+            # rollover applies to the write index of its stream/alias
+            target = None
+            for ds_name, ds in engine.meta.data_streams.items():
+                if ds["indices"] and ds["indices"][-1] == name:
+                    target = ds_name
+                    break
+            if target is None:
+                alias = idx.settings.get("lifecycle.rollover_alias") or idx.settings.get(
+                    "index.lifecycle.rollover_alias")
+                if alias and engine.meta.write_index_of(alias) == name:
+                    target = alias
+            if target is not None:
+                res = rollover(engine, target, {"conditions": roll_cond})
+                if res["rolled_over"]:
+                    actions.append({"index": name, "action": "rollover",
+                                    "new_index": res["new_index"]})
+    return {"actions": actions}

@@ -365,6 +365,68 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- data streams / rollover / ILM -----------------------------------
+
+    @handler
+    async def put_data_stream(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.create_data_stream, engine, request.match_info["name"]))
+
+    @handler
+    async def get_data_stream(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.get_data_streams, engine, request.match_info.get("name")))
+
+    @handler
+    async def delete_data_stream(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.delete_data_stream, engine, request.match_info["name"]))
+
+    @handler
+    async def rollover_api(request):
+        from ..engine import lifecycle
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            lifecycle.rollover, engine, request.match_info["target"], body,
+            _bool_param(request.query, "dry_run"),
+        ))
+
+    @handler
+    async def ilm_put_policy(request):
+        from ..engine import lifecycle
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            lifecycle.put_policy, engine, request.match_info["name"], body))
+
+    @handler
+    async def ilm_get_policy(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.get_policy, engine, request.match_info.get("name")))
+
+    @handler
+    async def ilm_delete_policy(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.delete_policy, engine, request.match_info["name"]))
+
+    @handler
+    async def ilm_explain(request):
+        from ..engine import lifecycle
+
+        return web.json_response(await call(
+            lifecycle.explain, engine, request.match_info["index"]))
+
     @handler
     async def rank_eval_api(request):
         from ..search.rankeval import rank_eval
@@ -1222,6 +1284,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_put("/_data_stream/{name}", put_data_stream)
+    app.router.add_get("/_data_stream", get_data_stream)
+    app.router.add_get("/_data_stream/{name}", get_data_stream)
+    app.router.add_delete("/_data_stream/{name}", delete_data_stream)
+    app.router.add_post("/{target}/_rollover", rollover_api)
+    app.router.add_post("/{target}/_rollover/{new_index}", rollover_api)
+    app.router.add_put("/_ilm/policy/{name}", ilm_put_policy)
+    app.router.add_get("/_ilm/policy", ilm_get_policy)
+    app.router.add_get("/_ilm/policy/{name}", ilm_get_policy)
+    app.router.add_delete("/_ilm/policy/{name}", ilm_delete_policy)
+    app.router.add_get("/{index}/_ilm/explain", ilm_explain)
     app.router.add_route("*", "/_rank_eval", rank_eval_api)
     app.router.add_route("*", "/{index}/_rank_eval", rank_eval_api)
     app.router.add_route("*", "/_analyze", analyze_api)

@@ -1,0 +1,128 @@
+"""Data streams, rollover, ILM policies + tick."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.engine import lifecycle as lc
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def _engine_with_template():
+    e = Engine(None)
+    e.meta.index_templates["logs-tpl"] = {
+        "index_patterns": ["logs-*"],
+        "data_stream": {},
+        "priority": 100,
+        "template": {"mappings": {"properties": {
+            "msg": {"type": "text"}, "level": {"type": "keyword"}}}},
+    }
+    return e
+
+
+def test_data_stream_create_write_search():
+    e = _engine_with_template()
+    lc.create_data_stream(e, "logs-app")
+    ds = e.meta.data_streams["logs-app"]
+    assert len(ds["indices"]) == 1 and ds["indices"][0].startswith(".ds-logs-app-")
+    # @timestamp mapping auto-added
+    backing = e.indices[ds["indices"][0]]
+    assert backing.mappings.fields["@timestamp"].type == "date"
+    # write through the stream name routes to the write index
+    idx = e.get_or_autocreate("logs-app")
+    assert idx.name == ds["indices"][0]
+    idx.index_doc("1", {"@timestamp": 1700000000000, "msg": "boot", "level": "INFO"})
+    idx.refresh()
+    # search via stream name
+    res = e.search_multi("logs-app", query={"match": {"msg": "boot"}})
+    assert res["hits"]["total"]["value"] == 1
+    assert res["hits"]["hits"][0]["_index"].startswith(".ds-logs-app-")
+
+
+def test_data_stream_autocreate_on_write():
+    e = _engine_with_template()
+    idx = e.get_or_autocreate("logs-web")
+    assert "logs-web" in e.meta.data_streams
+    assert idx.name.startswith(".ds-logs-web-")
+
+
+def test_data_stream_requires_template():
+    e = Engine(None)
+    with pytest.raises(IllegalArgumentError):
+        lc.create_data_stream(e, "nope")
+
+
+def test_data_stream_rollover_and_delete():
+    e = _engine_with_template()
+    lc.create_data_stream(e, "logs-a")
+    first = e.meta.data_streams["logs-a"]["indices"][0]
+    out = lc.rollover(e, "logs-a", None)
+    assert out["rolled_over"] and out["old_index"] == first
+    ds = e.meta.data_streams["logs-a"]
+    assert ds["generation"] == 2 and len(ds["indices"]) == 2
+    assert e.resolve_write_index("logs-a") == ds["indices"][-1]
+    # search spans all generations
+    assert len(e.resolve_search("logs-a")) == 2
+    lc.delete_data_stream(e, "logs-a")
+    assert first not in e.indices and "logs-a" not in e.meta.data_streams
+
+
+def test_alias_rollover_conditions():
+    e = Engine(None)
+    e.create_index("w-000001", {"properties": {"x": {"type": "integer"}}})
+    e.meta.put_alias("w-000001", "w", {"is_write_index": True})
+    idx = e.indices["w-000001"]
+    for i in range(5):
+        idx.index_doc(str(i), {"x": i})
+    # not met
+    out = lc.rollover(e, "w", {"conditions": {"max_docs": 100}})
+    assert not out["rolled_over"]
+    # met
+    out = lc.rollover(e, "w", {"conditions": {"max_docs": 5}})
+    assert out["rolled_over"] and out["new_index"] == "w-000002"
+    assert e.meta.write_index_of("w") == "w-000002"
+    # reads via alias still span both
+    assert {i.name for i, _ in e.resolve_search("w")} == {"w-000001", "w-000002"}
+    # dry run
+    out = lc.rollover(e, "w", {"conditions": {}}, dry_run=True)
+    assert out["dry_run"] and not out["rolled_over"]
+
+
+def test_ilm_policy_and_tick():
+    e = _engine_with_template()
+    lc.put_policy(e, "logs-pol", {"policy": {"phases": {
+        "hot": {"actions": {"rollover": {"max_docs": 2}}},
+        "delete": {"min_age": "0ms", "actions": {"delete": {}}},
+    }}})
+    assert "logs-pol" in lc.get_policy(e)
+    lc.create_data_stream(e, "logs-p")
+    # attach policy to the backing index
+    ds = e.meta.data_streams["logs-p"]
+    e.indices[ds["indices"][0]].settings["lifecycle.name"] = "logs-pol"
+    idx = e.get_or_autocreate("logs-p")
+    for i in range(3):
+        idx.index_doc(str(i), {"@timestamp": 1, "msg": "m"})
+    out = lc.tick(e)
+    assert any(a["action"] == "rollover" for a in out["actions"])
+    ds = e.meta.data_streams["logs-p"]
+    assert ds["generation"] == 2
+    # mark the new write index managed too; old one now deletable (min_age 0)
+    e.indices[ds["indices"][-1]].settings["lifecycle.name"] = "logs-pol"
+    out = lc.tick(e)
+    deleted = [a for a in out["actions"] if a["action"] == "delete"]
+    assert deleted and ds["indices"][0] not in [a.get("new_index") for a in out["actions"]]
+    assert len(e.meta.data_streams["logs-p"]["indices"]) == 1
+
+    lc.delete_policy(e, "logs-pol")
+    with pytest.raises(Exception):
+        lc.get_policy(e, "logs-pol")
+
+
+def test_ilm_explain():
+    e = _engine_with_template()
+    lc.put_policy(e, "p", {"policy": {"phases": {"hot": {"actions": {}}}}})
+    e.create_index("plain", {"properties": {}})
+    e.indices["plain"].settings["lifecycle.name"] = "p"
+    out = lc.explain(e, "plain")
+    assert out["indices"]["plain"]["managed"] and out["indices"]["plain"]["phase"] == "hot"
