@@ -56,6 +56,7 @@ class MetadataStore:
         self.stored_scripts: dict[str, dict] = {}
         self.data_streams: dict[str, dict] = {}
         self.ilm_policies: dict[str, dict] = {}
+        self.persistent_tasks: dict[str, dict] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -74,6 +75,7 @@ class MetadataStore:
             self.stored_scripts = state.get("stored_scripts", {})
             self.data_streams = state.get("data_streams", {})
             self.ilm_policies = state.get("ilm_policies", {})
+            self.persistent_tasks = state.get("persistent_tasks", {})
 
     def save(self):
         f = self._file()
@@ -89,6 +91,7 @@ class MetadataStore:
                     "stored_scripts": self.stored_scripts,
                     "data_streams": self.data_streams,
                     "ilm_policies": self.ilm_policies,
+                    "persistent_tasks": self.persistent_tasks,
                 },
                 fh,
             )

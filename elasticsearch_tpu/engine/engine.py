@@ -677,6 +677,9 @@ class Engine:
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
         self.tasks = TaskManager()
+        from ..tasks.persistent import PersistentTasksService
+
+        self.persistent = PersistentTasksService(self)
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService

@@ -365,6 +365,116 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- async search ----------------------------------------------------
+    # reference behavior: x-pack/plugin/async-search
+    # TransportSubmitAsyncSearchAction.java:41 — submit returns within
+    # wait_for_completion_timeout or hands back an id; results are kept
+    # keep_alive long (here: in-memory store with expiry)
+
+    app["async_searches"] = {}
+
+    def _async_gc():
+        import time as _t
+
+        now = _t.time()
+        store = app["async_searches"]
+        for k in [k for k, v in store.items() if v.get("expires", 1e18) < now]:
+            store.pop(k, None)
+
+    def _async_envelope(sid, entry):
+        out = {
+            "id": sid,
+            "is_partial": entry.get("response") is None,
+            "is_running": entry["is_running"],
+            "start_time_in_millis": entry["start_ms"],
+            "expiration_time_in_millis": int(entry["expires"] * 1000),
+        }
+        if entry.get("response") is not None:
+            out["response"] = entry["response"]
+            out["is_partial"] = False
+        if entry.get("error") is not None:
+            out["error"] = entry["error"]
+        return out
+
+    @handler
+    async def submit_async_search(request):
+        import secrets
+        import time as _t
+
+        from ..utils.durations import parse_duration_seconds
+
+        _async_gc()
+        body = await body_json(request, {}) or {}
+        wait_s = parse_duration_seconds(
+            request.query.get("wait_for_completion_timeout"), 1.0)
+        keep_s = parse_duration_seconds(request.query.get("keep_alive"), 300.0)
+        sid = secrets.token_urlsafe(16)
+        entry = {
+            "is_running": True, "start_ms": int(_t.time() * 1000),
+            "expires": _t.time() + (keep_s or 300.0),
+            "response": None, "error": None,
+        }
+        app["async_searches"][sid] = entry
+
+        async def run():
+            try:
+                entry["response"] = await _run_search(
+                    request.match_info.get("index"), body, request.query)
+            except ElasticsearchTpuError as ex:
+                entry["error"] = ex.to_dict()["error"]
+            except Exception as ex:  # noqa: BLE001
+                entry["error"] = {"type": "exception", "reason": str(ex)}
+            finally:
+                entry["is_running"] = False
+
+        task = asyncio.create_task(run())
+        try:
+            await asyncio.wait_for(asyncio.shield(task), timeout=wait_s or 1.0)
+        except asyncio.TimeoutError:
+            pass
+        return web.json_response(_async_envelope(sid, entry))
+
+    @handler
+    async def get_async_search(request):
+        _async_gc()
+        sid = request.match_info["id"]
+        entry = app["async_searches"].get(sid)
+        if entry is None:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"async search [{sid}] not found")
+        if request.query.get("keep_alive"):
+            import time as _t
+
+            from ..utils.durations import parse_duration_seconds
+
+            entry["expires"] = _t.time() + (
+                parse_duration_seconds(request.query["keep_alive"], 300.0) or 300.0)
+        return web.json_response(_async_envelope(sid, entry))
+
+    @handler
+    async def get_async_search_status(request):
+        sid = request.match_info["id"]
+        entry = app["async_searches"].get(sid)
+        if entry is None:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"async search [{sid}] not found")
+        env = _async_envelope(sid, entry)
+        env.pop("response", None)
+        if not entry["is_running"] and entry.get("error") is None:
+            env["completion_status"] = 200
+        return web.json_response(env)
+
+    @handler
+    async def delete_async_search(request):
+        sid = request.match_info["id"]
+        if app["async_searches"].pop(sid, None) is None:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"async search [{sid}] not found")
+        return web.json_response({"acknowledged": True})
+
     # ---- data streams / rollover / ILM -----------------------------------
 
     @handler
@@ -1284,6 +1394,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_post("/_async_search", submit_async_search)
+    app.router.add_post("/{index}/_async_search", submit_async_search)
+    app.router.add_get("/_async_search/status/{id}", get_async_search_status)
+    app.router.add_get("/_async_search/{id}", get_async_search)
+    app.router.add_delete("/_async_search/{id}", delete_async_search)
     app.router.add_put("/_data_stream/{name}", put_data_stream)
     app.router.add_get("/_data_stream", get_data_stream)
     app.router.add_get("/_data_stream/{name}", get_data_stream)

@@ -1,0 +1,98 @@
+"""Persistent tasks: cluster-state-stored tasks that survive restarts.
+
+Parity target: the reference's persistent task framework
+(reference behavior: persistent/PersistentTasksCustomMetadata stored in
+cluster state; persistent/PersistentTasksNodeService allocates tasks to
+nodes and restarts them after node restart; CCR/transform/ML run on it).
+Here tasks persist in the MetadataStore and re-run through their registered
+executor on engine start / on each scheduler tick."""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.errors import IllegalArgumentError, ResourceAlreadyExistsError, ResourceNotFoundError
+
+
+class PersistentTasksService:
+    """Registry + scheduler for named long-running tasks."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.executors: dict[str, object] = {}
+
+    # executor: object with tick(engine, task_dict) -> None (mutates
+    # task_dict["state"]); called on every scheduler pass while allocated
+    def register_executor(self, task_name: str, executor) -> None:
+        self.executors[task_name] = executor
+
+    @property
+    def _store(self) -> dict:
+        meta = self.engine.meta
+        if not hasattr(meta, "persistent_tasks"):
+            meta.persistent_tasks = {}
+        return meta.persistent_tasks
+
+    def start(self, task_id: str, task_name: str, params: dict) -> dict:
+        if task_name not in self.executors:
+            raise IllegalArgumentError(f"unknown persistent task type [{task_name}]")
+        if task_id in self._store:
+            raise ResourceAlreadyExistsError(f"persistent task [{task_id}] already exists")
+        task = {
+            "id": task_id,
+            "name": task_name,
+            "params": params,
+            "state": {},
+            "allocation_id": 1,
+            "started_ms": int(time.time() * 1000),
+            "stopped": False,
+        }
+        self._store[task_id] = task
+        self.engine.meta.save()
+        return task
+
+    def stop(self, task_id: str) -> dict:
+        task = self.get(task_id)
+        task["stopped"] = True
+        self.engine.meta.save()
+        return task
+
+    def resume(self, task_id: str) -> dict:
+        task = self.get(task_id)
+        task["stopped"] = False
+        task["allocation_id"] += 1
+        self.engine.meta.save()
+        return task
+
+    def remove(self, task_id: str):
+        if task_id not in self._store:
+            raise ResourceNotFoundError(f"persistent task [{task_id}] not found")
+        del self._store[task_id]
+        self.engine.meta.save()
+
+    def get(self, task_id: str) -> dict:
+        task = self._store.get(task_id)
+        if task is None:
+            raise ResourceNotFoundError(f"persistent task [{task_id}] not found")
+        return task
+
+    def list(self, task_name: str | None = None) -> list[dict]:
+        return [
+            t for t in self._store.values()
+            if task_name is None or t["name"] == task_name
+        ]
+
+    def tick(self) -> list[str]:
+        """Run one pass of every allocated (non-stopped) task's executor."""
+        ran = []
+        for task in list(self._store.values()):
+            if task.get("stopped"):
+                continue
+            ex = self.executors.get(task["name"])
+            if ex is None:
+                continue
+            ex.tick(self.engine, task)
+            ran.append(task["id"])
+        if ran:
+            self.engine.meta.save()
+        return ran

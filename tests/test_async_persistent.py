@@ -1,0 +1,109 @@
+"""Async search API + persistent task framework."""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+
+
+async def _drive_async_search():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/a", json={"mappings": {"properties": {"t": {"type": "text"}}}})
+    lines = []
+    for i in range(20):
+        lines.append(json.dumps({"index": {"_index": "a", "_id": str(i)}}))
+        lines.append(json.dumps({"t": f"word{i % 4} common"}))
+    await client.post("/_bulk", data="\n".join(lines) + "\n",
+                      headers={"Content-Type": "application/x-ndjson"})
+    await client.post("/a/_refresh")
+
+    # fast search completes within wait_for_completion_timeout
+    r = await client.post("/a/_async_search?wait_for_completion_timeout=10s",
+                          json={"query": {"match": {"t": "common"}}})
+    body = await r.json()
+    assert body["is_running"] is False and body["is_partial"] is False
+    assert body["response"]["hits"]["total"]["value"] == 20
+    sid = body["id"]
+
+    # retrievable until deleted, status endpoint works
+    r = await client.get(f"/_async_search/{sid}")
+    assert (await r.json())["response"]["hits"]["total"]["value"] == 20
+    r = await client.get(f"/_async_search/status/{sid}")
+    st = await r.json()
+    assert st["completion_status"] == 200 and "response" not in st
+    r = await client.delete(f"/_async_search/{sid}")
+    assert (await r.json())["acknowledged"]
+    r = await client.get(f"/_async_search/{sid}")
+    assert r.status == 404
+
+    # zero wait -> likely still running envelope, then poll to completion
+    r = await client.post("/a/_async_search?wait_for_completion_timeout=1ms",
+                          json={"query": {"match_all": {}}})
+    body = await r.json()
+    sid = body["id"]
+    for _ in range(100):
+        r = await client.get(f"/_async_search/{sid}")
+        body = await r.json()
+        if not body["is_running"]:
+            break
+        await asyncio.sleep(0.02)
+    assert body["response"]["hits"]["total"]["value"] == 20
+    await client.close()
+
+
+def test_async_search():
+    asyncio.run(_drive_async_search())
+
+
+class _CountingExecutor:
+    def __init__(self):
+        self.calls = 0
+
+    def tick(self, engine, task):
+        self.calls += 1
+        task["state"]["count"] = task["state"].get("count", 0) + 1
+
+
+def test_persistent_tasks_lifecycle():
+    e = Engine(None)
+    ex = _CountingExecutor()
+    e.persistent.register_executor("counter", ex)
+    t = e.persistent.start("t1", "counter", {"p": 1})
+    assert t["params"] == {"p": 1}
+    e.persistent.tick()
+    e.persistent.tick()
+    assert ex.calls == 2
+    assert e.persistent.get("t1")["state"]["count"] == 2
+    e.persistent.stop("t1")
+    e.persistent.tick()
+    assert ex.calls == 2  # stopped tasks don't run
+    e.persistent.resume("t1")
+    e.persistent.tick()
+    assert ex.calls == 3
+    e.persistent.remove("t1")
+    with pytest.raises(ResourceNotFoundError):
+        e.persistent.get("t1")
+
+
+def test_persistent_tasks_survive_restart(tmp_path):
+    d = str(tmp_path / "data")
+    e = Engine(d)
+    ex = _CountingExecutor()
+    e.persistent.register_executor("counter", ex)
+    e.persistent.start("t1", "counter", {"x": 2})
+    e.persistent.tick()
+    # new engine over the same data path sees the task + its state
+    e2 = Engine(d)
+    e2.persistent.register_executor("counter", _CountingExecutor())
+    t = e2.persistent.get("t1")
+    assert t["params"] == {"x": 2} and t["state"]["count"] == 1
+    assert e2.persistent.tick() == ["t1"]
