@@ -1,0 +1,1 @@
+from .engine import esql_query  # noqa: F401

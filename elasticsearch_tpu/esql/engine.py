@@ -1,0 +1,515 @@
+"""ES|QL columnar execution over the stacked packs.
+
+The reference's ESQL compute engine streams Page/Block batches through
+Driver pipelines with exchange operators (reference behavior:
+x-pack/plugin/esql/compute/.../operator/Driver.java:44, data/Block.java:38,
+DataPartitioning SHARD/SEGMENT/DOC). The TPU mapping (SURVEY.md P6): a
+column IS a device-resident array; each pipe stage is a vectorized
+whole-column transform, so the pipeline is array programming — numeric
+stages run as jax/numpy array ops over the same columnar stores the
+aggregation framework scans; string columns evaluate host-side from the
+pack dictionaries (device sees only ordinals).
+
+Result shape matches the ESQL REST contract:
+{"columns": [{"name", "type"}], "values": [[row], ...]}.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+
+import numpy as np
+
+from ..utils.errors import IllegalArgumentError
+from .parser import EsqlParseError, parse
+
+
+class Column:
+    """values: numpy array (float64 | int64 | object for strings/bools);
+    null: bool mask (True = missing)."""
+
+    __slots__ = ("values", "null", "type")
+
+    def __init__(self, values, null, type_):
+        self.values = values
+        self.null = null
+        self.type = type_
+
+    @classmethod
+    def of(cls, values, null=None, type_=None):
+        values = np.asarray(values)
+        if null is None:
+            null = np.zeros(len(values), bool)
+        return cls(values, null, type_ or _np_type(values))
+
+    def take(self, idx):
+        return Column(self.values[idx], self.null[idx], self.type)
+
+
+def _np_type(arr) -> str:
+    if arr.dtype.kind in "iu":
+        return "long"
+    if arr.dtype.kind == "f":
+        return "double"
+    if arr.dtype.kind == "b":
+        return "boolean"
+    return "keyword"
+
+
+class Table:
+    def __init__(self, columns: dict[str, Column], nrows: int):
+        self.columns = columns
+        self.nrows = nrows
+
+    def take(self, idx):
+        return Table({n: c.take(idx) for n, c in self.columns.items()}, len(idx))
+
+
+def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
+    """Pull every doc-values column of the matched indices into one global
+    columnar table (plus _index and requested metadata columns)."""
+    targets = engine.resolve_search(index_expr, allow_no_indices=True)
+    col_names: set[str] = set()
+    for idx, _ in targets:
+        idx._maybe_refresh()
+        sp = idx.searcher.sp
+        for f, col in sp.global_docvalues.items():
+            if f != "_id":
+                col_names.add(f)
+    parts: dict[str, list] = {n: [] for n in col_names}
+    index_col = []
+    id_col = []
+    total = 0
+    for idx, _ in targets:
+        sp = idx.searcher.sp
+        for s, pack in enumerate(sp.shards):
+            live = pack.live
+            n = int(live.sum())
+            if pack.num_docs == 0:
+                continue
+            sel = np.flatnonzero(live)
+            total += len(sel)
+            index_col.extend([idx.name] * len(sel))
+            for d in sel:
+                id_col.append(idx.shard_docs[s][d][0] if s < len(idx.shard_docs) else "")
+            for name in col_names:
+                col = pack.docvalues.get(name)
+                if col is None:
+                    parts[name].append((None, len(sel)))
+                    continue
+                if col.kind == "ord":
+                    terms = col.ord_terms or []
+                    vals = np.array(
+                        [terms[o] if o >= 0 else None for o in col.values[sel]],
+                        object,
+                    )
+                    null = ~col.has_value[sel]
+                    parts[name].append((Column(vals, null, "keyword"), len(sel)))
+                else:
+                    t = "long" if col.kind == "int" else "double"
+                    parts[name].append(
+                        (Column(col.values[sel].astype(
+                            np.int64 if col.kind == "int" else np.float64),
+                            ~col.has_value[sel], t), len(sel))
+                    )
+    columns: dict[str, Column] = {}
+    for name, chunks in parts.items():
+        types = {c.type for c, _ in chunks if c is not None}
+        t = (types or {"keyword"}).pop()
+        vals_list = []
+        null_list = []
+        for c, n in chunks:
+            if c is None:
+                vals_list.append(np.array([None] * n, object) if t == "keyword"
+                                 else np.zeros(n, np.float64 if t == "double" else np.int64))
+                null_list.append(np.ones(n, bool))
+            else:
+                vals_list.append(c.values)
+                null_list.append(c.null)
+        if vals_list:
+            columns[name] = Column(
+                np.concatenate(vals_list), np.concatenate(null_list), t)
+        else:
+            columns[name] = Column(np.array([], object), np.array([], bool), t)
+    columns["_index"] = Column(np.array(index_col, object),
+                               np.zeros(total, bool), "keyword")
+    if "_id" in metadata:
+        columns["_id"] = Column(np.array(id_col, object),
+                                np.zeros(total, bool), "keyword")
+    return Table(columns, total)
+
+
+# ---- expression evaluation ------------------------------------------------
+
+def _eval_expr(ast, t: Table):
+    """-> Column over t.nrows."""
+    kind = ast[0]
+    n = t.nrows
+    if kind == "lit":
+        v = ast[1]
+        if v is None:
+            return Column(np.zeros(n, np.float64), np.ones(n, bool), "double")
+        if isinstance(v, bool):
+            return Column.of(np.full(n, v), type_="boolean")
+        if isinstance(v, str):
+            return Column(np.array([v] * n, object), np.zeros(n, bool), "keyword")
+        if isinstance(v, int):
+            return Column.of(np.full(n, v, np.int64))
+        return Column.of(np.full(n, float(v), np.float64))
+    if kind == "col":
+        c = t.columns.get(ast[1])
+        if c is None:
+            raise IllegalArgumentError(f"Unknown column [{ast[1]}]")
+        return c
+    if kind == "neg":
+        c = _eval_expr(ast[1], t)
+        return Column(-c.values, c.null, c.type)
+    if kind == "bin":
+        op, a, b = ast[1], _eval_expr(ast[2], t), _eval_expr(ast[3], t)
+        null = a.null | b.null
+        av, bv = a.values, b.values
+        if a.type == "keyword" or b.type == "keyword":
+            if op != "+":
+                raise IllegalArgumentError(f"operator [{op}] not valid on text")
+            out = np.array([f"{x}{y}" for x, y in zip(av, bv)], object)
+            return Column(out, null, "keyword")
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if op == "+":
+                out = av + bv
+            elif op == "-":
+                out = av - bv
+            elif op == "*":
+                out = av * bv
+            elif op == "/":
+                out = np.asarray(av, np.float64) / bv
+            else:
+                out = np.mod(av, bv)
+        bad = ~np.isfinite(np.asarray(out, np.float64))
+        return Column(np.where(bad, 0, out), null | bad, _np_type(np.asarray(out)))
+    if kind == "cmp":
+        op, a, b = ast[1], _eval_expr(ast[2], t), _eval_expr(ast[3], t)
+        null = a.null | b.null
+        av, bv = a.values, b.values
+        if a.type == "keyword" or b.type == "keyword":
+            sa = np.array([None if x is None else str(x) for x in av], object)
+            sb = np.array([None if x is None else str(x) for x in bv], object)
+            eq = np.array([x == y for x, y in zip(sa, sb)], bool)
+            if op == "==":
+                out = eq
+            elif op == "!=":
+                out = ~eq
+            else:
+                out = np.array(
+                    [(x is not None and y is not None) and _str_cmp(op, x, y)
+                     for x, y in zip(sa, sb)], bool)
+        else:
+            out = {"==": np.equal, "!=": np.not_equal, "<": np.less,
+                   "<=": np.less_equal, ">": np.greater,
+                   ">=": np.greater_equal}[op](av, bv)
+        return Column(np.where(null, False, out), np.zeros(len(out), bool), "boolean")
+    if kind == "and":
+        a, b = _eval_expr(ast[1], t), _eval_expr(ast[2], t)
+        return Column(a.values.astype(bool) & b.values.astype(bool),
+                      np.zeros(t.nrows, bool), "boolean")
+    if kind == "or":
+        a, b = _eval_expr(ast[1], t), _eval_expr(ast[2], t)
+        return Column(a.values.astype(bool) | b.values.astype(bool),
+                      np.zeros(t.nrows, bool), "boolean")
+    if kind == "not":
+        a = _eval_expr(ast[1], t)
+        return Column(~a.values.astype(bool), np.zeros(t.nrows, bool), "boolean")
+    if kind == "in":
+        a = _eval_expr(ast[1], t)
+        hits = np.zeros(t.nrows, bool)
+        for item in ast[2]:
+            hits |= _eval_expr(("cmp", "==", ast[1], item), t).values.astype(bool)
+        return Column(hits, np.zeros(t.nrows, bool), "boolean")
+    if kind == "like":
+        a = _eval_expr(ast[1], t)
+        pat = ast[2]
+        out = np.array(
+            [x is not None and fnmatch.fnmatchcase(str(x), pat) for x in a.values],
+            bool)
+        return Column(out & ~a.null, np.zeros(t.nrows, bool), "boolean")
+    if kind == "isnull":
+        a = _eval_expr(ast[1], t)
+        neg = ast[2]
+        out = ~a.null if neg else a.null
+        return Column(out, np.zeros(t.nrows, bool), "boolean")
+    if kind == "call":
+        return _eval_call(ast[1], ast[2], t)
+    raise IllegalArgumentError(f"cannot evaluate ES|QL expression [{kind}]")
+
+
+def _str_cmp(op, x, y):
+    return {"<": x < y, "<=": x <= y, ">": x > y, ">=": x >= y}[op]
+
+
+def _eval_call(fn, args, t: Table):
+    if fn in ("abs", "round", "floor", "ceil", "sqrt", "log10", "to_long",
+              "to_double", "to_integer"):
+        a = _eval_expr(args[0], t)
+        v = np.asarray(a.values, np.float64)
+        if fn == "abs":
+            out, ty = np.abs(a.values), a.type
+        elif fn == "round":
+            digits = 0
+            if len(args) > 1:
+                digits = int(args[1][1])
+            out, ty = np.round(v, digits), "double" if digits else "long"
+            out = out.astype(np.int64) if not digits else out
+        elif fn == "floor":
+            out, ty = np.floor(v).astype(np.int64), "long"
+        elif fn == "ceil":
+            out, ty = np.ceil(v).astype(np.int64), "long"
+        elif fn == "sqrt":
+            out, ty = np.sqrt(np.maximum(v, 0)), "double"
+        elif fn == "log10":
+            out, ty = np.log10(np.maximum(v, 1e-300)), "double"
+        elif fn in ("to_long", "to_integer"):
+            out, ty = v.astype(np.int64), "long"
+        else:
+            out, ty = v, "double"
+        return Column(out, a.null, ty)
+    if fn in ("upper", "lower", "trim", "length", "to_string"):
+        a = _eval_expr(args[0], t)
+        vals = a.values
+        if fn == "length":
+            out = np.array([len(str(x)) if x is not None else 0 for x in vals], np.int64)
+            return Column(out, a.null, "long")
+        f = {"upper": lambda s: s.upper(), "lower": lambda s: s.lower(),
+             "trim": lambda s: s.strip(), "to_string": str}[fn]
+        out = np.array([f(str(x)) if x is not None else None for x in vals], object)
+        return Column(out, a.null, "keyword")
+    if fn == "concat":
+        cols = [_eval_expr(a, t) for a in args]
+        null = np.zeros(t.nrows, bool)
+        for c in cols:
+            null |= c.null
+        out = np.array(
+            ["".join(str(c.values[i]) for c in cols) for i in range(t.nrows)],
+            object)
+        return Column(out, null, "keyword")
+    if fn == "starts_with":
+        a, b = _eval_expr(args[0], t), _eval_expr(args[1], t)
+        out = np.array(
+            [x is not None and str(x).startswith(str(y))
+             for x, y in zip(a.values, b.values)], bool)
+        return Column(out, np.zeros(t.nrows, bool), "boolean")
+    if fn == "coalesce":
+        cols = [_eval_expr(a, t) for a in args]
+        out = cols[0]
+        vals = out.values.copy()
+        null = out.null.copy()
+        for c in cols[1:]:
+            fill = null & ~c.null
+            vals[fill] = c.values[fill]
+            null[fill] = False
+        return Column(vals, null, out.type)
+    if fn == "case":
+        # case(cond1, v1, cond2, v2, ..., default?)
+        pairs = args
+        default = None
+        if len(pairs) % 2 == 1:
+            default = pairs[-1]
+            pairs = pairs[:-1]
+        vals = None
+        null = np.ones(t.nrows, bool)
+        decided = np.zeros(t.nrows, bool)
+        ty = "keyword"
+        for cond_ast, val_ast in zip(pairs[::2], pairs[1::2]):
+            cond = _eval_expr(cond_ast, t).values.astype(bool) & ~decided
+            v = _eval_expr(val_ast, t)
+            if vals is None:
+                vals = v.values.copy()
+                ty = v.type
+            vals[cond] = v.values[cond]
+            null[cond] = v.null[cond]
+            decided |= cond
+        if default is not None:
+            v = _eval_expr(default, t)
+            rest = ~decided
+            if vals is None:
+                vals = v.values.copy()
+                ty = v.type
+            vals[rest] = v.values[rest]
+            null[rest] = v.null[rest]
+        return Column(vals if vals is not None else np.zeros(t.nrows), null, ty)
+    raise IllegalArgumentError(f"unknown ES|QL function [{fn}]")
+
+
+# ---- aggregates -----------------------------------------------------------
+
+def _agg_value(fn, args, t: Table, sel: np.ndarray):
+    if fn == "count":
+        if not args or args[0][0] == "star":
+            return int(sel.sum()), "long"
+        c = _eval_expr(args[0], t)
+        return int((sel & ~c.null).sum()), "long"
+    if fn == "count_distinct":
+        c = _eval_expr(args[0], t)
+        ok = sel & ~c.null
+        return int(len(set(c.values[ok].tolist()))), "long"
+    c = _eval_expr(args[0], t)
+    ok = sel & ~c.null
+    if not ok.any():
+        return None, "double"
+    v = c.values[ok]
+    if fn == "sum":
+        out = v.sum()
+        return (int(out) if c.type == "long" else float(out)), c.type
+    if fn == "avg":
+        return float(np.asarray(v, np.float64).mean()), "double"
+    if fn == "min":
+        return (v.min().item() if c.type != "keyword" else sorted(v)[0]), c.type
+    if fn == "max":
+        return (v.max().item() if c.type != "keyword" else sorted(v)[-1]), c.type
+    if fn == "median":
+        return float(np.median(np.asarray(v, np.float64))), "double"
+    if fn in ("values", "mv_dedupe"):
+        return sorted(set(v.tolist())), c.type
+    raise IllegalArgumentError(f"unknown ES|QL aggregate [{fn}]")
+
+
+def _run_stats(t: Table, aggs, by: list[str]) -> Table:
+    if not by:
+        cols = {}
+        sel = np.ones(t.nrows, bool)
+        for name, call in aggs:
+            val, ty = _agg_value(call[1], call[2], t, sel)
+            cols[name] = Column(np.array([val], object if ty == "keyword" else None),
+                                np.array([val is None]), ty)
+        return Table(cols, 1)
+    key_cols = []
+    for b in by:
+        if b not in t.columns:
+            raise IllegalArgumentError(f"Unknown column [{b}]")
+        key_cols.append(t.columns[b])
+    keys = list(zip(*[
+        [None if c.null[i] else (c.values[i].item() if hasattr(c.values[i], "item")
+                                 else c.values[i]) for i in range(t.nrows)]
+        for c in key_cols
+    ])) if t.nrows else []
+    uniq = sorted(set(keys), key=lambda k: tuple(
+        (x is None, x if x is not None else 0) if not isinstance(x, str) else (x is None, x)
+        for x in k))
+    out_cols: dict[str, list] = {b: [] for b in by}
+    agg_rows: dict[str, list] = {name: [] for name, _ in aggs}
+    agg_types: dict[str, str] = {}
+    keys_arr = np.array([hash(k) for k in keys], np.int64) if keys else np.array([], np.int64)
+    for k in uniq:
+        sel = keys_arr == hash(k)
+        # hash collisions: verify exact
+        exact = np.array([keys[i] == k for i in np.flatnonzero(sel)])
+        idxs = np.flatnonzero(sel)[exact]
+        sel2 = np.zeros(t.nrows, bool)
+        sel2[idxs] = True
+        for b, kv in zip(by, k):
+            out_cols[b].append(kv)
+        for name, call in aggs:
+            val, ty = _agg_value(call[1], call[2], t, sel2)
+            agg_rows[name].append(val)
+            agg_types[name] = ty
+    columns: dict[str, Column] = {}
+    for name, _ in aggs:
+        vals = agg_rows[name]
+        ty = agg_types.get(name, "double")
+        columns[name] = Column(np.array(vals, object),
+                               np.array([v is None for v in vals]), ty)
+    for b, c in zip(by, key_cols):
+        vals = out_cols[b]
+        columns[b] = Column(np.array(vals, object),
+                            np.array([v is None for v in vals]), c.type)
+    return Table(columns, len(uniq))
+
+
+# ---- driver ---------------------------------------------------------------
+
+def execute(engine, query: str) -> Table:
+    stages = parse(query)
+    t: Table | None = None
+    for kind, payload in stages:
+        if kind == "from":
+            t = _collect_table(engine, ",".join(payload["indices"]),
+                               payload["metadata"])
+        elif kind == "row":
+            cols = {}
+            for name, expr in payload:
+                one = Table({}, 1)
+                cols[name] = _eval_expr(expr, one)
+            t = Table(cols, 1)
+        elif kind == "where":
+            mask = _eval_expr(payload, t).values.astype(bool)
+            t = t.take(np.flatnonzero(mask))
+        elif kind == "eval":
+            for name, expr in payload:
+                t.columns[name] = _eval_expr(expr, t)
+        elif kind == "stats":
+            t = _run_stats(t, payload["aggs"], payload["by"])
+        elif kind == "sort":
+            order = np.arange(t.nrows)
+            for name, desc, nulls_first in reversed(payload):
+                c = t.columns.get(name)
+                if c is None:
+                    raise IllegalArgumentError(f"Unknown column [{name}]")
+                vals = c.values[order]
+                nulls = c.null[order]
+                if c.type == "keyword":
+                    key = np.array([("" if v is None else str(v)) for v in vals])
+                    rank = np.argsort(key, kind="stable")
+                else:
+                    rank = np.argsort(np.asarray(vals, np.float64), kind="stable")
+                if desc:
+                    rank = rank[::-1]
+                # nulls ordering: default nulls last (asc), first (desc)
+                nf = nulls_first if nulls_first is not None else desc
+                nn = nulls[rank]
+                rank = np.concatenate([rank[nn], rank[~nn]] if nf
+                                      else [rank[~nn], rank[nn]])
+                order = order[rank]
+            t = t.take(order)
+        elif kind == "limit":
+            t = t.take(np.arange(min(payload, t.nrows)))
+        elif kind == "keep":
+            keep = []
+            for pat in payload:
+                for name in t.columns:
+                    if fnmatch.fnmatchcase(name, pat) and name not in keep:
+                        keep.append(name)
+            t = Table({n: t.columns[n] for n in keep}, t.nrows)
+        elif kind == "drop":
+            for pat in payload:
+                for name in [n for n in t.columns if fnmatch.fnmatchcase(n, pat)]:
+                    del t.columns[name]
+        elif kind == "rename":
+            for old, new in payload:
+                if old not in t.columns:
+                    raise IllegalArgumentError(f"Unknown column [{old}]")
+                t.columns = {
+                    (new if n == old else n): c for n, c in t.columns.items()
+                }
+    return t
+
+
+def esql_query(engine, body: dict) -> dict:
+    query = (body or {}).get("query")
+    if not isinstance(query, str):
+        raise IllegalArgumentError("[query] string is required")
+    t = execute(engine, query)
+    columns = [{"name": n, "type": c.type} for n, c in t.columns.items()]
+    values = []
+    for i in range(t.nrows):
+        row = []
+        for c in t.columns.values():
+            if c.null[i]:
+                row.append(None)
+            else:
+                v = c.values[i]
+                if hasattr(v, "item"):
+                    v = v.item()
+                if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+                    v = None
+                row.append(v)
+        values.append(row)
+    return {"columns": columns, "values": values}

@@ -365,6 +365,30 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- ESQL / SQL / EQL ------------------------------------------------
+
+    @handler
+    async def esql_api(request):
+        from ..esql import esql_query
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(esql_query, engine, body))
+
+    @handler
+    async def sql_api(request):
+        from ..esql.sql import sql_query
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(sql_query, engine, body))
+
+    @handler
+    async def eql_api(request):
+        from ..esql.eql import eql_search
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            eql_search, engine, request.match_info["index"], body))
+
     # ---- async search ----------------------------------------------------
     # reference behavior: x-pack/plugin/async-search
     # TransportSubmitAsyncSearchAction.java:41 — submit returns within
@@ -1394,6 +1418,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_post("/_query", esql_api)
+    app.router.add_post("/_esql/query", esql_api)
+    app.router.add_post("/_sql", sql_api)
+    app.router.add_route("*", "/{index}/_eql/search", eql_api)
     app.router.add_post("/_async_search", submit_async_search)
     app.router.add_post("/{index}/_async_search", submit_async_search)
     app.router.add_get("/_async_search/status/{id}", get_async_search_status)

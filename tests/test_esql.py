@@ -1,0 +1,149 @@
+"""ES|QL pipeline engine, SQL translation, EQL event/sequence queries."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.esql import esql_query
+from elasticsearch_tpu.esql.eql import eql_search
+from elasticsearch_tpu.esql.sql import sql_query
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("emp", {"properties": {
+        "name": {"type": "keyword"}, "dept": {"type": "keyword"},
+        "salary": {"type": "integer"}, "age": {"type": "integer"},
+    }})
+    idx = e.indices["emp"]
+    rows = [
+        ("1", {"name": "ann", "dept": "eng", "salary": 100, "age": 30}),
+        ("2", {"name": "bob", "dept": "eng", "salary": 80, "age": 25}),
+        ("3", {"name": "cat", "dept": "ops", "salary": 60, "age": 40}),
+        ("4", {"name": "dan", "dept": "ops", "salary": 70, "age": 35}),
+        ("5", {"name": "eve", "dept": "sales", "salary": 90}),  # age missing
+    ]
+    for i, src in rows:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e
+
+
+def _vals(out):
+    return out["values"]
+
+
+def test_esql_where_eval_sort_limit():
+    e = _engine()
+    out = esql_query(e, {"query":
+        'FROM emp | WHERE salary >= 70 | EVAL bonus = salary * 0.1 '
+        '| SORT salary DESC | LIMIT 3 | KEEP name, salary, bonus'})
+    assert [c["name"] for c in out["columns"]] == ["name", "salary", "bonus"]
+    assert _vals(out) == [["ann", 100, 10.0], ["eve", 90, 9.0], ["bob", 80, 8.0]]
+
+
+def test_esql_stats_by():
+    e = _engine()
+    out = esql_query(e, {"query":
+        'FROM emp | STATS c = COUNT(*), avg_sal = AVG(salary) BY dept '
+        '| SORT dept'})
+    byname = {row[2]: (row[0], row[1]) for row in _vals(out)}
+    assert byname["eng"] == (2, 90.0)
+    assert byname["ops"] == (2, 65.0)
+    assert byname["sales"] == (1, 90.0)
+
+
+def test_esql_global_stats_and_null_handling():
+    e = _engine()
+    out = esql_query(e, {"query": 'FROM emp | STATS n = COUNT(age), m = MAX(age)'})
+    assert _vals(out) == [[4, 40]]
+    out = esql_query(e, {"query": 'FROM emp | WHERE age IS NULL | KEEP name'})
+    assert _vals(out) == [["eve"]]
+
+
+def test_esql_string_functions_and_like():
+    e = _engine()
+    out = esql_query(e, {"query":
+        'FROM emp | WHERE name LIKE "a*" OR name == "bob" '
+        '| EVAL u = UPPER(name), tag = CONCAT(dept, "-", name) '
+        '| SORT name | KEEP u, tag'})
+    assert _vals(out) == [["ANN", "eng-ann"], ["BOB", "eng-bob"]]
+
+
+def test_esql_row_and_case():
+    e = _engine()
+    out = esql_query(e, {"query": 'ROW a = 1, b = "x" | EVAL c = a + 2'})
+    assert _vals(out) == [[1, "x", 3]]
+    out = esql_query(e, {"query":
+        'FROM emp | EVAL band = CASE(salary >= 90, "high", salary >= 70, "mid", "low") '
+        '| SORT name | KEEP name, band'})
+    assert _vals(out) == [["ann", "high"], ["bob", "mid"], ["cat", "low"],
+                         ["dan", "mid"], ["eve", "high"]]
+
+
+def test_esql_errors():
+    e = _engine()
+    with pytest.raises(IllegalArgumentError):
+        esql_query(e, {"query": "FROM emp | WHERE nosuch > 1"})
+    with pytest.raises(IllegalArgumentError):
+        esql_query(e, {"query": "WHERE x > 1"})
+
+
+def test_sql_select_group_order():
+    e = _engine()
+    out = sql_query(e, {"query":
+        "SELECT dept, COUNT(*) AS c, AVG(salary) AS avg_sal FROM emp "
+        "WHERE salary > 50 GROUP BY dept ORDER BY 2 DESC, dept LIMIT 10"})
+    assert [c["name"] for c in out["columns"]] == ["dept", "c", "avg_sal"]
+    assert out["rows"][0][1] == 2
+    rows = {r[0]: r for r in out["rows"]}
+    assert rows["eng"][2] == 90.0
+
+
+def test_sql_plain_select():
+    e = _engine()
+    out = sql_query(e, {"query":
+        "SELECT name, salary FROM emp WHERE dept = 'eng' ORDER BY salary DESC"})
+    assert out["rows"] == [["ann", 100], ["bob", 80]]
+
+
+def _eql_engine():
+    e = Engine(None)
+    e.create_index("ev", {"properties": {
+        "@timestamp": {"type": "date"},
+        "event.category": {"type": "keyword"},
+        "host": {"type": "keyword"},
+        "pid": {"type": "integer"},
+    }})
+    idx = e.indices["ev"]
+    rows = [
+        (1000, "process", "h1", 5),
+        (2000, "network", "h1", 5),
+        (3000, "file", "h1", 5),
+        (1500, "process", "h2", 9),
+        (9000, "network", "h2", 9),  # too late for maxspan
+    ]
+    for i, (ts, cat, host, pid) in enumerate(rows):
+        idx.index_doc(str(i), {"@timestamp": ts, "event.category": cat,
+                               "host": host, "pid": pid})
+    idx.refresh()
+    return e
+
+
+def test_eql_event_query():
+    e = _eql_engine()
+    out = eql_search(e, "ev", {"query": 'process where pid == 5'})
+    assert out["hits"]["total"]["value"] == 1
+    assert out["hits"]["events"][0]["_source"]["host"] == "h1"
+
+
+def test_eql_sequence_with_maxspan():
+    e = _eql_engine()
+    out = eql_search(e, "ev", {"query":
+        'sequence by host with maxspan=5s [process where true] [network where true]'})
+    seqs = out["hits"]["sequences"]
+    assert out["hits"]["total"]["value"] == 1
+    assert seqs[0]["join_keys"] == ["h1"]
+    cats = [ev["_source"]["event.category"] for ev in seqs[0]["events"]]
+    assert cats == ["process", "network"]
