@@ -57,6 +57,7 @@ class MetadataStore:
         self.data_streams: dict[str, dict] = {}
         self.ilm_policies: dict[str, dict] = {}
         self.persistent_tasks: dict[str, dict] = {}
+        self.security: dict = {"users": {}, "roles": {}, "api_keys": {}}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -76,6 +77,8 @@ class MetadataStore:
             self.data_streams = state.get("data_streams", {})
             self.ilm_policies = state.get("ilm_policies", {})
             self.persistent_tasks = state.get("persistent_tasks", {})
+            self.security = state.get(
+                "security", {"users": {}, "roles": {}, "api_keys": {}})
 
     def save(self):
         f = self._file()
@@ -92,6 +95,7 @@ class MetadataStore:
                     "data_streams": self.data_streams,
                     "ilm_policies": self.ilm_policies,
                     "persistent_tasks": self.persistent_tasks,
+                    "security": self.security,
                 },
                 fh,
             )

@@ -189,6 +189,7 @@ def default_cluster_settings() -> list[Setting]:
         Setting("action.auto_create_index", True, Setting.bool_, dynamic=True),
         Setting("cluster.max_shards_per_node", 1000, Setting.positive_int, dynamic=True),
         Setting("logger.*", "info", str, dynamic=True),
+        Setting("xpack.security.enabled", False, Setting.bool_, dynamic=True),
     ]
 
 

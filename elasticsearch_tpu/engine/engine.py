@@ -680,6 +680,7 @@ class Engine:
         from ..tasks.persistent import PersistentTasksService
 
         self.persistent = PersistentTasksService(self)
+        self._security = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -707,6 +708,14 @@ class Engine:
                     self.indices[name] = EsIndex.open(
                         name, d, breaker_account=self._pack_accounter(name)
                     )
+
+    @property
+    def security(self):
+        from ..security import SecurityService
+
+        if self._security is None:
+            self._security = SecurityService(self)
+        return self._security
 
     def _pack_accounter(self, name: str):
         return lambda n: self.breakers.set_steady(

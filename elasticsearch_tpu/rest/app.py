@@ -37,9 +37,34 @@ def _err_response(ex: Exception) -> web.Response:
     return web.json_response(body, status=status)
 
 
+@web.middleware
+async def _security_middleware(request: web.Request, handler):
+    engine = request.app["engine"]
+    sec = engine.security
+    if not sec.enabled:
+        return await handler(request)
+    from ..security import AuthenticationError, AuthorizationError
+    from ..security.authz import classify
+
+    try:
+        principal = sec.authenticate(request.headers.get("Authorization"))
+        action, indices = classify(request.method, request.path)
+        if action != "authenticated":
+            sec.authorize(principal, action, indices)
+        request["principal"] = principal
+    except (AuthenticationError, AuthorizationError) as ex:
+        resp = _err_response(ex)
+        if ex.status == 401:
+            resp.headers["WWW-Authenticate"] = 'Basic realm="security"'
+        return resp
+    return await handler(request)
+
+
 def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.Application:
     engine = engine or Engine(data_path)
-    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app = web.Application(
+        client_max_size=512 * 1024 * 1024, middlewares=[_security_middleware]
+    )
     app["engine"] = engine
     # single-thread executor: serializes engine mutation, keeps the loop free
     app["pool"] = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
@@ -364,6 +389,87 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response({"acknowledged": True})
 
     # ---- admin / observability -------------------------------------------
+
+    # ---- security --------------------------------------------------------
+
+    @handler
+    async def security_authenticate(request):
+        principal = request.get("principal")
+        if principal is None:
+            # security disabled: anonymous superuser view
+            principal = {"username": "_anonymous", "roles": ["superuser"],
+                         "authentication_type": "anonymous"}
+        u = engine.security.store["users"].get(principal["username"], {})
+        return web.json_response({
+            "username": principal["username"],
+            "roles": principal["roles"],
+            "full_name": u.get("full_name"),
+            "email": u.get("email"),
+            "metadata": u.get("metadata", {}),
+            "enabled": True,
+            "authentication_realm": {"name": "native", "type": "native"},
+            "authentication_type": principal.get("authentication_type", "realm"),
+        })
+
+    @handler
+    async def security_put_user(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(
+            engine.security.put_user(request.match_info["name"], body))
+
+    @handler
+    async def security_get_user(request):
+        return web.json_response(
+            engine.security.get_user(request.match_info.get("name")))
+
+    @handler
+    async def security_delete_user(request):
+        return web.json_response(
+            engine.security.delete_user(request.match_info["name"]))
+
+    @handler
+    async def security_change_password(request):
+        body = await body_json(request, {}) or {}
+        name = request.match_info.get("name") or request.get(
+            "principal", {}).get("username")
+        if not body.get("password"):
+            raise IllegalArgumentError("password is required")
+        engine.security.change_password(name, body["password"])
+        return web.json_response({})
+
+    @handler
+    async def security_put_role(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(
+            engine.security.put_role(request.match_info["name"], body))
+
+    @handler
+    async def security_get_role(request):
+        return web.json_response(
+            engine.security.get_role(request.match_info.get("name")))
+
+    @handler
+    async def security_delete_role(request):
+        return web.json_response(
+            engine.security.delete_role(request.match_info["name"]))
+
+    @handler
+    async def security_create_api_key(request):
+        body = await body_json(request, {}) or {}
+        username = request.get("principal", {}).get("username", "_anonymous")
+        return web.json_response(engine.security.create_api_key(username, body))
+
+    @handler
+    async def security_get_api_keys(request):
+        return web.json_response(engine.security.get_api_keys())
+
+    @handler
+    async def security_invalidate_api_key(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(engine.security.invalidate_api_key(
+            key_id=body.get("id") or (body.get("ids") or [None])[0],
+            name=body.get("name"),
+        ))
 
     # ---- ESQL / SQL / EQL ------------------------------------------------
 
@@ -1418,6 +1524,23 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_get("/_security/_authenticate", security_authenticate)
+    app.router.add_put("/_security/user/{name}", security_put_user)
+    app.router.add_post("/_security/user/{name}", security_put_user)
+    app.router.add_get("/_security/user", security_get_user)
+    app.router.add_get("/_security/user/{name}", security_get_user)
+    app.router.add_delete("/_security/user/{name}", security_delete_user)
+    app.router.add_post("/_security/user/{name}/_password", security_change_password)
+    app.router.add_post("/_security/user/_password", security_change_password)
+    app.router.add_put("/_security/role/{name}", security_put_role)
+    app.router.add_post("/_security/role/{name}", security_put_role)
+    app.router.add_get("/_security/role", security_get_role)
+    app.router.add_get("/_security/role/{name}", security_get_role)
+    app.router.add_delete("/_security/role/{name}", security_delete_role)
+    app.router.add_post("/_security/api_key", security_create_api_key)
+    app.router.add_put("/_security/api_key", security_create_api_key)
+    app.router.add_get("/_security/api_key", security_get_api_keys)
+    app.router.add_delete("/_security/api_key", security_invalidate_api_key)
     app.router.add_post("/_query", esql_api)
     app.router.add_post("/_esql/query", esql_api)
     app.router.add_post("/_sql", sql_api)

@@ -1,0 +1,338 @@
+"""Security: native users, roles, API keys, RBAC authorization.
+
+Parity targets (reference): x-pack/plugin/security —
+AuthenticationService.java:54 (realm chain; Basic + ApiKey credentials),
+AuthorizationService.java:109 (role resolution -> cluster/index privilege
+checks), ApiKeyService (hashed secrets, invalidation), native users realm
+(file/native realm users with bcrypt hashes; PBKDF2 here).
+
+Disabled by default (xpack.security.enabled=false) like a dev-mode cluster;
+when enabled the REST layer authenticates every request and authorizes it
+against the resolved roles before dispatch.
+"""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import hashlib
+import os
+import secrets
+import time
+
+from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError, ResourceNotFoundError
+
+
+class AuthenticationError(ElasticsearchTpuError):
+    status = 401
+    es_type = "security_exception"
+
+
+class AuthorizationError(ElasticsearchTpuError):
+    status = 403
+    es_type = "security_exception"
+
+
+_PBKDF2_ITERS = 10000
+
+CLUSTER_PRIVS = {"all", "monitor", "manage", "manage_security"}
+INDEX_PRIVS = {"all", "read", "write", "index", "delete", "create_index",
+               "manage", "view_index_metadata", "monitor"}
+
+# privilege implication map
+_INDEX_IMPLIES = {
+    "all": INDEX_PRIVS,
+    "write": {"write", "index", "delete"},
+    "manage": {"manage", "create_index", "view_index_metadata", "monitor"},
+    "read": {"read"},
+    "index": {"index"},
+    "delete": {"delete"},
+    "create_index": {"create_index"},
+    "view_index_metadata": {"view_index_metadata"},
+    "monitor": {"monitor"},
+}
+
+_RESERVED_ROLES = {
+    "superuser": {
+        "cluster": ["all"],
+        "indices": [{"names": ["*"], "privileges": ["all"]}],
+    },
+    "viewer": {
+        "cluster": ["monitor"],
+        "indices": [{"names": ["*"], "privileges": ["read", "view_index_metadata"]}],
+    },
+    "editor": {
+        "cluster": ["monitor"],
+        "indices": [{"names": ["*"], "privileges": ["read", "write", "view_index_metadata"]}],
+    },
+}
+
+
+def _hash_password(password: str, salt: bytes | None = None) -> str:
+    salt = salt or secrets.token_bytes(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _PBKDF2_ITERS)
+    return f"{salt.hex()}${dk.hex()}"
+
+
+def _verify_password(password: str, stored: str) -> bool:
+    try:
+        salt_hex, dk_hex = stored.split("$", 1)
+    except ValueError:
+        return False
+    dk = hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), bytes.fromhex(salt_hex), _PBKDF2_ITERS)
+    return secrets.compare_digest(dk.hex(), dk_hex)
+
+
+class SecurityService:
+    def __init__(self, engine):
+        self.engine = engine
+        meta = engine.meta
+        if not hasattr(meta, "security"):
+            meta.security = {"users": {}, "roles": {}, "api_keys": {}}
+        self.store = meta.security
+        if "elastic" not in self.store["users"]:
+            # bootstrap superuser (reference: reserved realm `elastic` user,
+            # password via the keystore / ES_PASSWORD bootstrap)
+            pw = os.environ.get("ES_TPU_ELASTIC_PASSWORD", "changeme")
+            self.store["users"]["elastic"] = {
+                "password": _hash_password(pw),
+                "roles": ["superuser"],
+                "full_name": None, "email": None, "enabled": True,
+                "metadata": {"_reserved": True},
+            }
+
+    @property
+    def enabled(self) -> bool:
+        try:
+            return bool(self.engine.settings.get("xpack.security.enabled"))
+        except Exception:  # noqa: BLE001 - settings registry may lack the key
+            return False
+
+    def _save(self):
+        self.engine.meta.save()
+
+    # ---- user management -------------------------------------------------
+
+    def put_user(self, username: str, body: dict) -> dict:
+        if not username or "/" in username:
+            raise IllegalArgumentError(f"invalid username [{username}]")
+        existing = self.store["users"].get(username)
+        entry = {
+            "roles": list(body.get("roles") or []),
+            "full_name": body.get("full_name"),
+            "email": body.get("email"),
+            "enabled": bool(body.get("enabled", True)),
+            "metadata": body.get("metadata") or {},
+        }
+        if body.get("password"):
+            if len(body["password"]) < 6:
+                raise IllegalArgumentError("passwords must be at least 6 characters")
+            entry["password"] = _hash_password(body["password"])
+        elif existing:
+            entry["password"] = existing["password"]
+        else:
+            raise IllegalArgumentError("password is required for new users")
+        self.store["users"][username] = entry
+        self._save()
+        return {"created": existing is None}
+
+    def get_user(self, username: str | None = None) -> dict:
+        def public(name, u):
+            return {"username": name, "roles": u["roles"],
+                    "full_name": u["full_name"], "email": u["email"],
+                    "enabled": u["enabled"], "metadata": u["metadata"]}
+
+        if username:
+            u = self.store["users"].get(username)
+            if u is None:
+                raise ResourceNotFoundError(f"user [{username}] not found")
+            return {username: public(username, u)}
+        return {n: public(n, u) for n, u in self.store["users"].items()}
+
+    def delete_user(self, username: str) -> dict:
+        if username not in self.store["users"]:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        if (self.store["users"][username].get("metadata") or {}).get("_reserved"):
+            raise IllegalArgumentError(f"user [{username}] is reserved")
+        del self.store["users"][username]
+        self._save()
+        return {"found": True}
+
+    def change_password(self, username: str, password: str):
+        u = self.store["users"].get(username)
+        if u is None:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        u["password"] = _hash_password(password)
+        self._save()
+
+    # ---- role management -------------------------------------------------
+
+    def put_role(self, name: str, body: dict) -> dict:
+        for p in body.get("cluster") or []:
+            if p not in CLUSTER_PRIVS:
+                raise IllegalArgumentError(f"unknown cluster privilege [{p}]")
+        for spec in body.get("indices") or []:
+            for p in spec.get("privileges") or []:
+                if p not in INDEX_PRIVS:
+                    raise IllegalArgumentError(f"unknown index privilege [{p}]")
+        created = name not in self.store["roles"]
+        self.store["roles"][name] = {
+            "cluster": list(body.get("cluster") or []),
+            "indices": [
+                {"names": list(s.get("names") or []),
+                 "privileges": list(s.get("privileges") or [])}
+                for s in body.get("indices") or []
+            ],
+        }
+        self._save()
+        return {"role": {"created": created}}
+
+    def get_role(self, name: str | None = None) -> dict:
+        roles = {**_RESERVED_ROLES, **self.store["roles"]}
+        if name:
+            if name not in roles:
+                raise ResourceNotFoundError(f"role [{name}] not found")
+            return {name: roles[name]}
+        return roles
+
+    def delete_role(self, name: str) -> dict:
+        if name in _RESERVED_ROLES:
+            raise IllegalArgumentError(f"role [{name}] is reserved")
+        if name not in self.store["roles"]:
+            raise ResourceNotFoundError(f"role [{name}] not found")
+        del self.store["roles"][name]
+        self._save()
+        return {"found": True}
+
+    # ---- API keys --------------------------------------------------------
+
+    def create_api_key(self, username: str, body: dict) -> dict:
+        name = (body or {}).get("name")
+        if not name:
+            raise IllegalArgumentError("api key [name] is required")
+        key_id = secrets.token_urlsafe(12)
+        secret = secrets.token_urlsafe(24)
+        expiration = None
+        if body.get("expiration"):
+            from ..utils.durations import parse_duration_millis
+
+            expiration = int(time.time() * 1000) + parse_duration_millis(
+                body["expiration"])
+        self.store["api_keys"][key_id] = {
+            "name": name,
+            "hash": hashlib.sha256(secret.encode()).hexdigest(),
+            "username": username,
+            "roles": list((body.get("role_descriptors") or {}).keys()) or None,
+            "role_descriptors": body.get("role_descriptors") or {},
+            "creation": int(time.time() * 1000),
+            "expiration": expiration,
+            "invalidated": False,
+        }
+        self._save()
+        return {
+            "id": key_id, "name": name, "api_key": secret,
+            "encoded": base64.b64encode(f"{key_id}:{secret}".encode()).decode(),
+            "expiration": expiration,
+        }
+
+    def get_api_keys(self) -> dict:
+        out = []
+        for kid, k in self.store["api_keys"].items():
+            out.append({"id": kid, "name": k["name"], "username": k["username"],
+                        "creation": k["creation"], "expiration": k["expiration"],
+                        "invalidated": k["invalidated"]})
+        return {"api_keys": out}
+
+    def invalidate_api_key(self, key_id: str | None = None, name: str | None = None) -> dict:
+        hit = []
+        for kid, k in self.store["api_keys"].items():
+            if (key_id and kid == key_id) or (name and k["name"] == name):
+                if not k["invalidated"]:
+                    k["invalidated"] = True
+                    hit.append(kid)
+        self._save()
+        return {"invalidated_api_keys": hit, "error_count": 0}
+
+    # ---- authentication --------------------------------------------------
+
+    def authenticate(self, authorization: str | None) -> dict:
+        """Authorization header -> principal {username, roles, role_descriptors?}."""
+        if not authorization:
+            raise AuthenticationError("missing authentication credentials")
+        scheme, _, payload = authorization.partition(" ")
+        scheme = scheme.lower()
+        if scheme == "basic":
+            try:
+                user, _, pw = base64.b64decode(payload).decode().partition(":")
+            except Exception:  # noqa: BLE001
+                raise AuthenticationError("failed to decode basic credentials")
+            u = self.store["users"].get(user)
+            if u is None or not u["enabled"] or not _verify_password(pw, u["password"]):
+                raise AuthenticationError(
+                    f"unable to authenticate user [{user}] for REST request")
+            return {"username": user, "roles": u["roles"],
+                    "authentication_type": "realm"}
+        if scheme == "apikey":
+            try:
+                kid, _, secret = base64.b64decode(payload).decode().partition(":")
+            except Exception:  # noqa: BLE001
+                raise AuthenticationError("failed to decode api key credentials")
+            k = self.store["api_keys"].get(kid)
+            if (k is None or k["invalidated"]
+                    or hashlib.sha256(secret.encode()).hexdigest() != k["hash"]):
+                raise AuthenticationError("invalid api key")
+            if k["expiration"] and time.time() * 1000 > k["expiration"]:
+                raise AuthenticationError("api key is expired")
+            owner = self.store["users"].get(k["username"])
+            roles = list(k["role_descriptors"].keys()) or (
+                owner["roles"] if owner else [])
+            return {"username": k["username"], "roles": roles,
+                    "role_descriptors": k["role_descriptors"],
+                    "authentication_type": "api_key"}
+        raise AuthenticationError(f"unsupported authorization scheme [{scheme}]")
+
+    # ---- authorization ---------------------------------------------------
+
+    def _resolved_roles(self, principal: dict) -> list[dict]:
+        all_roles = {**_RESERVED_ROLES, **self.store["roles"]}
+        descriptors = principal.get("role_descriptors") or {}
+        out = []
+        for r in principal["roles"]:
+            if r in descriptors:
+                out.append(descriptors[r])
+            elif r in all_roles:
+                out.append(all_roles[r])
+        return out
+
+    def authorize(self, principal: dict, action: str, indices: list[str]):
+        """action: 'cluster:<priv>' or 'indices:<priv>'."""
+        roles = self._resolved_roles(principal)
+        kind, _, priv = action.partition(":")
+        if kind == "cluster":
+            for role in roles:
+                cp = set(role.get("cluster") or [])
+                if "all" in cp or priv in cp:
+                    return
+            raise AuthorizationError(
+                f"action [{action}] is unauthorized for user "
+                f"[{principal['username']}]")
+        for index in indices or ["*"]:
+            ok = False
+            for role in roles:
+                for spec in role.get("indices") or []:
+                    if not any(fnmatch.fnmatchcase(index, p)
+                               for p in spec.get("names") or []):
+                        continue
+                    granted = set()
+                    for p in spec.get("privileges") or []:
+                        granted |= _INDEX_IMPLIES.get(p, {p})
+                    if priv in granted or "all" in spec.get("privileges", []):
+                        ok = True
+                        break
+                if ok:
+                    break
+            if not ok:
+                raise AuthorizationError(
+                    f"action [indices:{priv}] is unauthorized for user "
+                    f"[{principal['username']}] on indices [{index}]")

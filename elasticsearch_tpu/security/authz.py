@@ -1,0 +1,80 @@
+"""REST request -> (action, indices) classification for authorization.
+
+The reference authorizes transport actions by name
+(AuthorizationService.java:109 over action names like
+"indices:data/read/search"); at this framework's REST boundary the
+classification happens on (method, path) before dispatch, yielding the same
+privilege classes."""
+
+from __future__ import annotations
+
+_READ_SUFFIXES = (
+    "_search", "_msearch", "_count", "_mget", "_explain", "_field_caps",
+    "_termvectors", "_validate", "_analyze", "_rank_eval", "_eql",
+    "_async_search", "_knn_search", "_graph",
+)
+_WRITE_SUFFIXES = (
+    "_doc", "_create", "_update", "_bulk", "_update_by_query",
+    "_delete_by_query", "_rollover",
+)
+_META_SUFFIXES = ("_mapping", "_settings", "_stats", "_segments", "_alias",
+                  "_aliases", "_refresh", "_flush", "_ilm", "_source")
+
+
+def classify(method: str, path: str) -> tuple[str, list[str]]:
+    """-> (action, indices). action = 'cluster:<priv>' | 'indices:<priv>'
+    | 'authenticated' (any logged-in principal)."""
+    parts = [p for p in path.split("/") if p]
+    method = method.upper()
+    if not parts:
+        return "cluster:monitor", []
+    head = parts[0]
+    if head == "_security":
+        if len(parts) > 1 and parts[1] == "_authenticate":
+            return "authenticated", []
+        if len(parts) > 1 and parts[1] == "api_key" and method in ("POST", "PUT", "GET", "DELETE"):
+            # own-key management allowed for any authenticated principal;
+            # cross-user management still gated by handler semantics
+            return "authenticated", []
+        return "cluster:manage_security", []
+    if head in ("_cluster", "_nodes", "_cat", "_tasks", "_remote", "_resolve",
+                "_stats", "_segments"):
+        if method == "GET" or (head == "_tasks" and method == "POST"):
+            return "cluster:monitor", []
+        return "cluster:manage", []
+    if head in ("_snapshot", "_ilm", "_slm", "_ingest", "_scripts",
+                "_index_template", "_component_template", "_template",
+                "_data_stream", "_enrich", "_transform", "_ccr"):
+        if method in ("GET", "HEAD"):
+            return "cluster:monitor", []
+        return "cluster:manage", []
+    if head in ("_search", "_msearch", "_count", "_mget", "_field_caps",
+                "_async_search", "_sql", "_query", "_esql", "_eql",
+                "_render", "_rank_eval", "_analyze", "_validate", "_pit"):
+        return "indices:read", ["*"]
+    if head in ("_bulk", "_reindex", "_update_by_query", "_delete_by_query"):
+        return "indices:write", ["*"]
+    if head == "_aliases":
+        return "cluster:manage", []
+    if head.startswith("_"):
+        return "cluster:manage", []
+    # /{index}/...
+    indices = head.split(",")
+    if len(parts) == 1:
+        if method in ("PUT", "POST"):
+            return "indices:create_index", indices
+        if method == "DELETE":
+            return "indices:manage", indices
+        return "indices:view_index_metadata", indices
+    sub = parts[1]
+    if sub in ("_doc", "_create", "_source") and method in ("GET", "HEAD"):
+        return "indices:read", indices
+    if any(sub == s or sub.startswith(s) for s in _WRITE_SUFFIXES):
+        return "indices:write", indices
+    if any(sub == s or sub.startswith(s) for s in _READ_SUFFIXES):
+        return "indices:read", indices
+    if any(sub == s or sub.startswith(s) for s in _META_SUFFIXES):
+        if method in ("GET", "HEAD"):
+            return "indices:view_index_metadata", indices
+        return "indices:manage", indices
+    return "indices:manage", indices

@@ -1,0 +1,130 @@
+"""Security: authc (basic + api key), RBAC, user/role/api-key APIs."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.security import (
+    AuthenticationError,
+    AuthorizationError,
+    SecurityService,
+)
+
+
+def _basic(user, pw):
+    return "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()
+
+
+def test_authc_and_rbac_unit():
+    e = Engine(None)
+    sec = e.security
+    sec.put_user("alice", {"password": "secret1", "roles": ["logs_reader"]})
+    sec.put_role("logs_reader", {"indices": [
+        {"names": ["logs-*"], "privileges": ["read"]}]})
+
+    p = sec.authenticate(_basic("alice", "secret1"))
+    assert p["username"] == "alice"
+    with pytest.raises(AuthenticationError):
+        sec.authenticate(_basic("alice", "wrong"))
+    with pytest.raises(AuthenticationError):
+        sec.authenticate(None)
+
+    sec.authorize(p, "indices:read", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "indices:read", ["secrets"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "indices:write", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "cluster:manage", [])
+
+    # superuser can do anything
+    root = sec.authenticate(_basic("elastic", "changeme"))
+    sec.authorize(root, "cluster:manage_security", [])
+    sec.authorize(root, "indices:write", ["anything"])
+
+
+def test_api_keys_unit():
+    e = Engine(None)
+    sec = e.security
+    created = sec.create_api_key("elastic", {"name": "ci"})
+    header = "ApiKey " + created["encoded"]
+    p = sec.authenticate(header)
+    assert p["username"] == "elastic" and p["authentication_type"] == "api_key"
+    # restricted role descriptors override owner roles
+    created2 = sec.create_api_key("elastic", {"name": "ro", "role_descriptors": {
+        "ro": {"indices": [{"names": ["pub-*"], "privileges": ["read"]}]}}})
+    p2 = sec.authenticate("ApiKey " + created2["encoded"])
+    sec.authorize(p2, "indices:read", ["pub-1"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p2, "indices:read", ["private"])
+    sec.invalidate_api_key(key_id=created["id"])
+    with pytest.raises(AuthenticationError):
+        sec.authenticate(header)
+
+
+async def _rest_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    engine = app["engine"]
+
+    # no security: everything open
+    r = await client.put("/logs-a", json={"mappings": {"properties": {"m": {"type": "text"}}}})
+    assert r.status == 200
+
+    # enable security
+    engine.settings.update({"transient": {"xpack.security.enabled": True}})
+    r = await client.get("/logs-a/_search")
+    assert r.status == 401
+    root = {"Authorization": _basic("elastic", "changeme")}
+    r = await client.get("/logs-a/_search", headers=root)
+    assert r.status == 200
+
+    # create role + restricted user over REST
+    r = await client.put("/_security/role/reader", headers=root, json={
+        "indices": [{"names": ["logs-*"], "privileges": ["read"]}]})
+    assert r.status == 200
+    r = await client.put("/_security/user/bob", headers=root, json={
+        "password": "bobpass", "roles": ["reader"]})
+    assert (await r.json())["created"]
+
+    bob = {"Authorization": _basic("bob", "bobpass")}
+    r = await client.get("/_security/_authenticate", headers=bob)
+    assert (await r.json())["username"] == "bob"
+    r = await client.post("/logs-a/_search", headers=bob, json={})
+    assert r.status == 200
+    r = await client.put("/logs-a/_doc/1", headers=bob, json={"m": "x"})
+    assert r.status == 403
+    r = await client.put("/secret", headers=bob, json={})
+    assert r.status == 403
+    r = await client.get("/_security/user", headers=bob)
+    assert r.status == 403
+
+    # api key round trip over REST
+    r = await client.post("/_security/api_key", headers=root, json={"name": "k1"})
+    key = await r.json()
+    kh = {"Authorization": "ApiKey " + key["encoded"]}
+    r = await client.get("/logs-a/_search", headers=kh)
+    assert r.status == 200
+    r = await client.delete("/_security/api_key", headers=root,
+                            json={"id": key["id"]})
+    assert key["id"] in (await r.json())["invalidated_api_keys"]
+    r = await client.get("/logs-a/_search", headers=kh)
+    assert r.status == 401
+
+    # disable again: open access restored
+    engine.settings.update({"transient": {"xpack.security.enabled": False}})
+    r = await client.get("/logs-a/_search")
+    assert r.status == 200
+    await client.close()
+
+
+def test_security_rest():
+    asyncio.run(_rest_drive())
