@@ -58,6 +58,7 @@ class MetadataStore:
         self.ilm_policies: dict[str, dict] = {}
         self.persistent_tasks: dict[str, dict] = {}
         self.security: dict = {"users": {}, "roles": {}, "api_keys": {}}
+        self.transforms: dict[str, dict] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -79,6 +80,7 @@ class MetadataStore:
             self.persistent_tasks = state.get("persistent_tasks", {})
             self.security = state.get(
                 "security", {"users": {}, "roles": {}, "api_keys": {}})
+            self.transforms = state.get("transforms", {})
 
     def save(self):
         f = self._file()
@@ -96,6 +98,7 @@ class MetadataStore:
                     "ilm_policies": self.ilm_policies,
                     "persistent_tasks": self.persistent_tasks,
                     "security": self.security,
+                    "transforms": self.transforms,
                 },
                 fh,
             )

@@ -190,6 +190,9 @@ def default_cluster_settings() -> list[Setting]:
         Setting("cluster.max_shards_per_node", 1000, Setting.positive_int, dynamic=True),
         Setting("logger.*", "info", str, dynamic=True),
         Setting("xpack.security.enabled", False, Setting.bool_, dynamic=True),
+        # remote clusters for CCS; the seed is the remote's HTTP endpoint
+        # (this framework's transport IS HTTP — reference 9300 seeds analog)
+        Setting("cluster.remote.*", None, lambda v: v, dynamic=True),
     ]
 
 

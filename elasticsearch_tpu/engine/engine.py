@@ -904,12 +904,94 @@ class Engine:
 
     # ---- multi-index search (scatter/gather across indices) --------------
 
+    def remote_clusters(self) -> dict[str, str]:
+        """{alias: http_url} from cluster.remote.<alias>.seeds settings
+        (reference behavior: transport/RemoteClusterService.java:63 — here
+        the seed IS the remote's HTTP endpoint, since HTTP is the
+        transport)."""
+        out = {}
+        for store in (self.settings.persistent, self.settings.transient):
+            for key, raw in store.items():
+                if not key.startswith("cluster.remote.") or raw is None:
+                    continue
+                rest = key[len("cluster.remote."):]
+                alias, _, leaf = rest.partition(".")
+                if leaf not in ("seeds", "proxy_address", "url"):
+                    continue
+                seed = raw[0] if isinstance(raw, list) and raw else raw
+                if isinstance(seed, str) and seed:
+                    if not seed.startswith("http"):
+                        seed = f"http://{seed}"
+                    out[alias] = seed
+        return out
+
+    def _search_remote(self, url: str, index_expr: str, alias: str, kwargs) -> dict:
+        """One remote sub-search over HTTP (the CCS fan-out leg,
+        TransportSearchAction.java:693-760)."""
+        import urllib.request
+
+        body = {}
+        if kwargs.get("query") is not None:
+            body["query"] = kwargs["query"]
+        body["size"] = kwargs.get("size", 10) + kwargs.get("from_", 0)
+        req = urllib.request.Request(
+            f"{url}/{index_expr}/_search", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        for h in out["hits"]["hits"]:
+            h["_index"] = f"{alias}:{h['_index']}"
+        return out
+
     def search_multi(self, expression, *, ignore_unavailable=False,
                      allow_no_indices=True, **kwargs):
         """Search over an index expression. One concrete unfiltered target
         uses the index path directly; multiple targets fan out and merge at
         this coordinator (reference behavior: TransportSearchAction shards
-        span all resolved indices; merge in SearchPhaseController)."""
+        span all resolved indices; merge in SearchPhaseController). Parts
+        like `remote:index` fan out to registered remote clusters (CCS)."""
+        if isinstance(expression, str) and ":" in expression:
+            remotes = self.remote_clusters()
+            local_parts, remote_parts = [], []
+            for part in expression.split(","):
+                alias, _, rest = part.partition(":")
+                if rest and alias in remotes:
+                    remote_parts.append((alias, remotes[alias], rest))
+                else:
+                    local_parts.append(part)
+            if remote_parts:
+                if kwargs.get("aggs") or kwargs.get("knn") or kwargs.get("sort"):
+                    raise IllegalArgumentError(
+                        "cross-cluster search supports query/size only"
+                    )
+                subs = []
+                if local_parts:
+                    subs.append(self.search_multi(
+                        ",".join(local_parts),
+                        ignore_unavailable=ignore_unavailable,
+                        allow_no_indices=allow_no_indices, **kwargs))
+                for alias, url, rest in remote_parts:
+                    subs.append(self._search_remote(url, rest, alias, kwargs))
+                size = kwargs.get("size", 10)
+                from_ = kwargs.get("from_", 0)
+                all_hits = [h for r in subs for h in r["hits"]["hits"]]
+                all_hits.sort(key=lambda h: (-(h["_score"] or 0.0),
+                                             h["_index"], h["_id"]))
+                total = sum(r["hits"]["total"]["value"] for r in subs)
+                max_scores = [r["hits"]["max_score"] for r in subs
+                              if r["hits"].get("max_score") is not None]
+                return {
+                    "hits": {
+                        "total": {"value": total, "relation": "eq"},
+                        "max_score": max(max_scores) if max_scores else None,
+                        "hits": all_hits[from_:from_ + size],
+                    },
+                    "_clusters": {
+                        "total": len(remote_parts) + (1 if local_parts else 0),
+                        "successful": len(subs), "skipped": 0,
+                    },
+                }
         targets = self.resolve_search(expression, ignore_unavailable, allow_no_indices)
         if not targets:
             return {

@@ -206,6 +206,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         op_type = request.query.get("op_type", "index")
         idx = await call(engine.get_or_autocreate, name)
         r = await call(idx.index_doc, doc_id, body, op_type)
+        if request.query.get("refresh") in ("", "true", "wait_for"):
+            await call(idx.refresh)
         status = 201 if r["result"] == "created" else 200
         return web.json_response(_doc_result(r, name), status=status)
 
@@ -218,6 +220,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             raise IllegalArgumentError("request body is required")
         idx = await call(engine.get_or_autocreate, name)
         r = await call(idx.index_doc, doc_id, body, "create")
+        if request.query.get("refresh") in ("", "true", "wait_for"):
+            await call(idx.refresh)
         return web.json_response(_doc_result(r, name), status=201)
 
     @handler
@@ -389,6 +393,78 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response({"acknowledged": True})
 
     # ---- admin / observability -------------------------------------------
+
+    # ---- transform / downsample / CCS ------------------------------------
+
+    @handler
+    async def transform_put(request):
+        from .. import transform as tf
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            tf.put_transform, engine, request.match_info["id"], body))
+
+    @handler
+    async def transform_get(request):
+        from .. import transform as tf
+
+        return web.json_response(await call(
+            tf.get_transform, engine, request.match_info.get("id")))
+
+    @handler
+    async def transform_stats(request):
+        from .. import transform as tf
+
+        return web.json_response(await call(
+            tf.get_transform_stats, engine, request.match_info["id"]))
+
+    @handler
+    async def transform_delete(request):
+        from .. import transform as tf
+
+        return web.json_response(await call(
+            tf.delete_transform, engine, request.match_info["id"]))
+
+    @handler
+    async def transform_start(request):
+        from .. import transform as tf
+
+        return web.json_response(await call(
+            tf.start_transform, engine, request.match_info["id"]))
+
+    @handler
+    async def transform_stop(request):
+        from .. import transform as tf
+
+        return web.json_response(await call(
+            tf.stop_transform, engine, request.match_info["id"]))
+
+    @handler
+    async def transform_preview(request):
+        from .. import transform as tf
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(tf.preview_transform, engine, body))
+
+    @handler
+    async def downsample_api(request):
+        from ..transform import downsample
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            downsample, engine, request.match_info["index"],
+            request.match_info["target"], body))
+
+    @handler
+    async def remote_info(request):
+        remotes = engine.remote_clusters()
+        return web.json_response({
+            alias: {
+                "connected": True, "mode": "proxy", "proxy_address": url,
+                "num_proxy_sockets_connected": 1, "skip_unavailable": False,
+            }
+            for alias, url in remotes.items()
+        })
 
     # ---- security --------------------------------------------------------
 
@@ -960,10 +1036,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         took = int((time.monotonic() - t0) * 1000)
         from ..search import apply_fetch_phase
 
-        apply_fetch_phase(
-            res["hits"]["hits"], body,
-            lambda name: engine.get_index(name).mappings,
-        )
+        def _mappings_of(name):
+            if ":" in name:  # remote (CCS) hit: sub-phases already applied there
+                return None
+            return engine.get_index(name).mappings
+
+        apply_fetch_phase(res["hits"]["hits"], body, _mappings_of)
         if body.get("suggest"):
             res["suggest"] = await call(
                 engine.suggest_multi, expression, body["suggest"]
@@ -995,11 +1073,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     "aggregations": [],
                 }],
             }
-        n_shards = sum(
-            i.num_shards for i, _ in engine.resolve_search(
-                expression, _bool_param(query_params, "ignore_unavailable"), True
+        try:
+            n_shards = sum(
+                i.num_shards for i, _ in engine.resolve_search(
+                    expression, _bool_param(query_params, "ignore_unavailable"), True
+                )
             )
-        )
+        except ElasticsearchTpuError:
+            n_shards = 1  # e.g. remote-cluster expressions resolve elsewhere
         return {
             "took": took,
             "timed_out": False,
@@ -1524,6 +1605,16 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_put("/_transform/{id}", transform_put)
+    app.router.add_get("/_transform", transform_get)
+    app.router.add_get("/_transform/{id}", transform_get)
+    app.router.add_get("/_transform/{id}/_stats", transform_stats)
+    app.router.add_delete("/_transform/{id}", transform_delete)
+    app.router.add_post("/_transform/{id}/_start", transform_start)
+    app.router.add_post("/_transform/{id}/_stop", transform_stop)
+    app.router.add_post("/_transform/_preview", transform_preview)
+    app.router.add_post("/{index}/_downsample/{target}", downsample_api)
+    app.router.add_get("/_remote/info", remote_info)
     app.router.add_get("/_security/_authenticate", security_authenticate)
     app.router.add_put("/_security/user/{name}", security_put_user)
     app.router.add_post("/_security/user/{name}", security_put_user)

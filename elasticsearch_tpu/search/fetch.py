@@ -168,6 +168,8 @@ def apply_fetch_phase(hits: list[dict], body: dict, mappings_of) -> None:
 
     for h in hits:
         mappings = mappings_of(h["_index"])
+        if mappings is None:  # remote hit: sub-phases ran on the remote
+            continue
         src = h.get("_source")
         if fields:
             vals = fields_option(src, fields, mappings)

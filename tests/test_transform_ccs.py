@@ -1,0 +1,135 @@
+"""Pivot transforms, downsample, cross-cluster search."""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu import transform as tf
+
+
+def _sales_engine():
+    e = Engine(None)
+    e.create_index("sales", {"properties": {
+        "product": {"type": "keyword"}, "qty": {"type": "integer"},
+        "price": {"type": "float"}, "@timestamp": {"type": "date"},
+    }})
+    idx = e.indices["sales"]
+    rows = [
+        ("a", 2, 10.0, 1000), ("a", 3, 10.0, 2000),
+        ("b", 1, 5.0, 1500), ("b", 4, 5.0, 90_000_000),
+    ]
+    for i, (p, q, pr, ts) in enumerate(rows):
+        idx.index_doc(str(i), {"product": p, "qty": q, "price": pr, "@timestamp": ts})
+    idx.refresh()
+    return e
+
+
+def test_transform_pivot_lifecycle():
+    e = _sales_engine()
+    tf.put_transform(e, "sales-sum", {
+        "source": {"index": "sales"},
+        "dest": {"index": "sales_by_product"},
+        "pivot": {
+            "group_by": {"product": {"terms": {"field": "product"}}},
+            "aggregations": {"total_qty": {"sum": {"field": "qty"}},
+                             "avg_price": {"avg": {"field": "price"}}},
+        },
+    })
+    assert tf.get_transform(e)["count"] == 1
+    tf.start_transform(e, "sales-sum")
+    dest = e.indices["sales_by_product"]
+    dest.refresh()
+    res = dest.search(size=10, sort=[{"product": "asc"}])
+    rows = {h["_source"]["product"]: h["_source"] for h in res["hits"]["hits"]}
+    assert rows["a"]["total_qty"] == 5.0 and rows["b"]["total_qty"] == 5.0
+    assert rows["a"]["avg_price"] == 10.0
+    stats = tf.get_transform_stats(e, "sales-sum")
+    assert stats["transforms"][0]["stats"]["documents_indexed"] == 2
+    # continuous: new doc + tick updates the dest (same ids overwritten)
+    e.indices["sales"].index_doc("9", {"product": "a", "qty": 10, "price": 10.0,
+                                       "@timestamp": 3000})
+    e.indices["sales"].refresh()
+    e.persistent.tick()
+    dest.refresh()
+    res = dest.search(size=10)
+    rows = {h["_source"]["product"]: h["_source"] for h in res["hits"]["hits"]}
+    assert rows["a"]["total_qty"] == 15.0
+    tf.stop_transform(e, "sales-sum")
+    tf.delete_transform(e, "sales-sum")
+    assert tf.get_transform(e)["count"] == 0
+
+
+def test_transform_preview():
+    e = _sales_engine()
+    out = tf.preview_transform(e, {
+        "source": {"index": "sales"},
+        "pivot": {"group_by": {"product": {"terms": {"field": "product"}}},
+                  "aggregations": {"n": {"value_count": {"field": "qty"}}}},
+    })
+    assert {p["product"]: p["n"] for p in out["preview"]} == {"a": 2.0, "b": 2.0}
+
+
+def test_downsample():
+    e = _sales_engine()
+    out = tf.downsample(e, "sales", "sales_1h", {"fixed_interval": "1h"})
+    assert out["acknowledged"]
+    dest = e.indices["sales_1h"]
+    res = dest.search(size=10)
+    # buckets: hour 0 (a:2 docs qty 2+3, b:1 doc) and hour 25 (b:1 doc)
+    srcs = [h["_source"] for h in res["hits"]["hits"]]
+    a0 = next(s for s in srcs if s.get("product") == "a")
+    assert a0["qty_value_count"] == 2 and a0["qty_min"] == 2 and a0["qty_max"] == 3
+    b_late = [s for s in srcs if s.get("product") == "b" and s["@timestamp"] > 0]
+    assert len([s for s in srcs if s.get("product") == "b"]) == 2
+
+
+async def _ccs_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    # remote cluster
+    remote_app = make_app()
+    remote_client = TestClient(TestServer(remote_app))
+    await remote_client.start_server()
+    await remote_client.put("/web", json={"mappings": {"properties": {"t": {"type": "text"}}}})
+    lines = []
+    for i, txt in [("r1", "remote alpha"), ("r2", "remote beta")]:
+        lines.append(json.dumps({"index": {"_index": "web", "_id": i}}))
+        lines.append(json.dumps({"t": txt}))
+    await remote_client.post("/_bulk", data="\n".join(lines) + "\n",
+                             headers={"Content-Type": "application/x-ndjson"})
+    await remote_client.post("/web/_refresh")
+    port = remote_client.server.port
+
+    # local cluster with the remote registered
+    local_app = make_app()
+    local_client = TestClient(TestServer(local_app))
+    await local_client.start_server()
+    await local_client.put("/web", json={"mappings": {"properties": {"t": {"type": "text"}}}})
+    await local_client.put("/web/_doc/l1?refresh=true", json={"t": "local alpha"})
+    r = await local_client.put("/_cluster/settings", json={
+        "persistent": {"cluster.remote.europe.seeds": [f"127.0.0.1:{port}"]}})
+    assert r.status == 200
+    r = await local_client.get("/_remote/info")
+    info = await r.json()
+    assert info["europe"]["connected"]
+
+    r = await local_client.post("/web,europe:web/_search",
+                                json={"query": {"match": {"t": "alpha"}}})
+    body = await r.json()
+    hits = body["hits"]["hits"]
+    assert body["hits"]["total"]["value"] == 2
+    indices = {h["_index"] for h in hits}
+    assert indices == {"web", "europe:web"}
+    ids = {h["_id"] for h in hits}
+    assert ids == {"l1", "r1"}
+
+    await local_client.close()
+    await remote_client.close()
+
+
+def test_cross_cluster_search():
+    asyncio.run(_ccs_drive())
