@@ -1482,3 +1482,195 @@ def _neg_rank(k):
         # invert byte order for desc string sort
         return (1, tuple(255 - b for b in k.encode("utf-8")))
     return (1, -k)
+
+
+class GeoBoundsAgg(AggNode):
+    """geo_bounds: bounding box of matching points (reference behavior:
+    search/aggregations/metrics/GeoBoundsAggregator.java)."""
+
+    _MERGE_RULES = {"top": "max", "bottom": "min", "left": "min", "right": "max",
+                    "count": "sum"}
+
+    def __init__(self, name, fld, children=None):
+        super().__init__(name, children)
+        if children:
+            raise IllegalArgumentError("geo_bounds cannot have sub-aggregations")
+        self.fld = fld
+
+    def prepare(self, pack, mappings):
+        return {}, ("geo_bounds", self.fld,
+                    pack.docvalues.get(f"{self.fld}#lat") is None)
+
+    def _cols(self, dev):
+        lat = dev["dv_float"].get(f"{self.fld}#lat")
+        lon = dev["dv_float"].get(f"{self.fld}#lon")
+        if lat is None or lon is None:
+            return None
+        return lat[0], lat[1] & lon[1], lon[0]
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        got = self._cols(dev)
+        z = jnp.zeros(nseg, jnp.float32)
+        if got is None:
+            return {"top": z - np.inf, "bottom": z + np.inf,
+                    "left": z + np.inf, "right": z - np.inf,
+                    "count": jnp.zeros(nseg, jnp.int32)}
+        lat, has, lon = got
+        ok = valid & has
+        return {
+            "top": _seg_scatter(seg, nseg, ok, lat, jnp.float32(-np.inf), "max"),
+            "bottom": _seg_scatter(seg, nseg, ok, lat, jnp.float32(np.inf), "min"),
+            "left": _seg_scatter(seg, nseg, ok, lon, jnp.float32(np.inf), "min"),
+            "right": _seg_scatter(seg, nseg, ok, lon, jnp.float32(-np.inf), "max"),
+            "count": _seg_scatter(seg, nseg, ok, jnp.ones_like(seg), jnp.int32(0), "add"),
+        }
+
+    def finalize(self, out, nseg):
+        res = []
+        for i in range(nseg):
+            if int(out["count"][i]) == 0:
+                res.append({})
+                continue
+            res.append({"bounds": {
+                "top_left": {"lat": float(out["top"][i]), "lon": float(out["left"][i])},
+                "bottom_right": {"lat": float(out["bottom"][i]), "lon": float(out["right"][i])},
+            }})
+        return res
+
+
+class GeoCentroidAgg(GeoBoundsAgg):
+    """geo_centroid: mean point (reference behavior:
+    GeoCentroidAggregator.java — arithmetic mean of lat/lon)."""
+
+    _MERGE_RULES = {"lat_sum": "sum", "lon_sum": "sum", "count": "sum"}
+
+    def prepare(self, pack, mappings):
+        return {}, ("geo_centroid", self.fld,
+                    pack.docvalues.get(f"{self.fld}#lat") is None)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        got = self._cols(dev)
+        z = jnp.zeros(nseg, jnp.float32)
+        if got is None:
+            return {"lat_sum": z, "lon_sum": z, "count": jnp.zeros(nseg, jnp.int32)}
+        lat, has, lon = got
+        ok = valid & has
+        return {
+            "lat_sum": _seg_scatter(seg, nseg, ok, lat, jnp.float32(0), "add"),
+            "lon_sum": _seg_scatter(seg, nseg, ok, lon, jnp.float32(0), "add"),
+            "count": _seg_scatter(seg, nseg, ok, jnp.ones_like(seg), jnp.int32(0), "add"),
+        }
+
+    def finalize(self, out, nseg):
+        res = []
+        for i in range(nseg):
+            c = int(out["count"][i])
+            if c == 0:
+                res.append({"count": 0})
+                continue
+            res.append({
+                "location": {"lat": float(out["lat_sum"][i]) / c,
+                             "lon": float(out["lon_sum"][i]) / c},
+                "count": c,
+            })
+        return res
+
+
+class GeotileGridAgg(AggNode):
+    """geotile_grid: web-mercator tile buckets at a zoom level (reference
+    behavior: bucket/geogrid/GeoTileGridAggregator.java — keys "z/x/y").
+    Pure arithmetic per doc: ideal device bucketing (no dictionary)."""
+
+    _MERGE_RULES = {"counts": "sum"}
+
+    def __init__(self, name, fld, precision=7, size=10000, children=None):
+        super().__init__(name, children)
+        self.fld = fld
+        self.precision = int(precision)
+        self.size = int(size)
+        if not (0 <= self.precision <= 29):
+            raise IllegalArgumentError("geotile_grid precision must be in [0, 29]")
+
+    def prepare(self, pack, mappings):
+        # static tile-id space from the column's bounding box
+        latc = pack.docvalues.get(f"{self.fld}#lat")
+        lonc = pack.docvalues.get(f"{self.fld}#lon")
+        n_tiles = 1 << self.precision
+        if latc is None or not latc.has_value.any():
+            self.x0, self.y0, self.nx, self.ny = 0, 0, 1, 1
+        else:
+            xs, ys = _tile_of(np.asarray(latc.values, np.float64),
+                              np.asarray(lonc.values, np.float64), self.precision)
+            sel = latc.has_value & lonc.has_value
+            if sel.any():
+                self.x0 = int(xs[sel].min())
+                self.y0 = int(ys[sel].min())
+                self.nx = int(xs[sel].max()) - self.x0 + 1
+                self.ny = int(ys[sel].max()) - self.y0 + 1
+            else:
+                self.x0, self.y0, self.nx, self.ny = 0, 0, 1, 1
+        cparams, ckey = self._prepare_children(pack, mappings)
+        return {"children": cparams}, (
+            "geotile", self.fld, self.precision, self.x0, self.y0,
+            self.nx, self.ny, ckey)
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        V = self.nx * self.ny
+        if nseg * V > MAX_SEGMENT_PRODUCT:
+            raise IllegalArgumentError("geotile_grid bucket budget exceeded")
+        lat = dev["dv_float"].get(f"{self.fld}#lat")
+        lon = dev["dv_float"].get(f"{self.fld}#lon")
+        if lat is None or lon is None:
+            return {"counts": jnp.zeros((nseg, V), jnp.int32), "children": {}}
+        latv, lath = lat
+        lonv, lonh = lon
+        n_tiles = 1 << self.precision
+        latc = jnp.clip(latv, -85.05112878, 85.05112878)
+        x = jnp.clip(((lonv + 180.0) / 360.0 * n_tiles).astype(jnp.int32), 0, n_tiles - 1)
+        lat_rad = jnp.deg2rad(latc)
+        yf = (1.0 - jnp.log(jnp.tan(lat_rad) + 1.0 / jnp.cos(lat_rad)) / jnp.pi) / 2.0
+        y = jnp.clip((yf * n_tiles).astype(jnp.int32), 0, n_tiles - 1)
+        bx = jnp.clip(x - self.x0, 0, self.nx - 1)
+        by = jnp.clip(y - self.y0, 0, self.ny - 1)
+        b = by * self.nx + bx
+        ok = valid & lath & lonh & (x >= self.x0) & (x < self.x0 + self.nx) \
+            & (y >= self.y0) & (y < self.y0 + self.ny)
+        sub = seg * V + b
+        counts = _seg_scatter(sub, nseg * V, ok, jnp.ones_like(seg),
+                              jnp.int32(0), "add").reshape(nseg, V)
+        return {
+            "counts": counts,
+            "children": self._eval_children(
+                dev, {"children": params["children"]}, sub, nseg * V, ok, ctx),
+        }
+
+    def finalize(self, out, nseg):
+        V = self.nx * self.ny
+        counts = np.asarray(out["counts"]).reshape(nseg, -1)
+        child_frags = (self._finalize_children(out, nseg * V)
+                       if self.children else None)
+        res = []
+        for i in range(nseg):
+            c = counts[i]
+            idx = np.argsort(-c, kind="stable")
+            idx = idx[c[idx] > 0][: self.size]
+            buckets = []
+            for j in idx:
+                x = self.x0 + int(j) % self.nx
+                y = self.y0 + int(j) // self.nx
+                b = {"key": f"{self.precision}/{x}/{y}", "doc_count": int(c[j])}
+                if child_frags is not None:
+                    b.update(child_frags[i * V + j])
+                buckets.append(b)
+            res.append({"buckets": buckets})
+        return res
+
+
+def _tile_of(lat, lon, precision):
+    n = 1 << precision
+    latc = np.clip(lat, -85.05112878, 85.05112878)
+    x = np.clip(((lon + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+    lat_rad = np.deg2rad(latc)
+    yf = (1.0 - np.log(np.tan(lat_rad) + 1.0 / np.cos(lat_rad)) / np.pi) / 2.0
+    y = np.clip((yf * n).astype(np.int64), 0, n - 1)
+    return x, y

@@ -10,6 +10,9 @@ from ..query.dsl import parse_query
 from ..utils.errors import QueryParsingError
 from .nodes import (
     AggNode,
+    GeoBoundsAgg,
+    GeoCentroidAgg,
+    GeotileGridAgg,
     AutoDateHistogramAgg,
     CompositeAgg,
     AvgAgg,
@@ -194,6 +197,17 @@ def _build(name, typ, body, children, mappings) -> AggNode:
             name, _field_of(name, typ, body),
             buckets=int(body.get("buckets", 10)),
             format=body.get("format"),
+            children=children or None,
+        )
+    if typ == "geo_bounds":
+        return GeoBoundsAgg(name, _field_of(name, typ, body))
+    if typ == "geo_centroid":
+        return GeoCentroidAgg(name, _field_of(name, typ, body))
+    if typ == "geotile_grid":
+        return GeotileGridAgg(
+            name, _field_of(name, typ, body),
+            precision=body.get("precision", 7),
+            size=int(body.get("size", 10000)),
             children=children or None,
         )
     if typ == "top_hits":

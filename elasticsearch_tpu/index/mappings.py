@@ -38,9 +38,10 @@ DATE_TYPES = {"date"}
 BOOL_TYPES = {"boolean"}
 VECTOR_TYPES = {"dense_vector"}
 COMPLETION_TYPES = {"completion"}
+GEO_TYPES = {"geo_point"}
 ALL_TYPES = (
     TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | BOOL_TYPES | VECTOR_TYPES
-    | COMPLETION_TYPES | {"object", "percolator"}
+    | COMPLETION_TYPES | GEO_TYPES | {"object", "nested", "percolator"}
 )
 
 _INT_BOUNDS = {
@@ -141,6 +142,11 @@ class Mappings:
 
     def __init__(self, mapping_dict: dict | None = None, dynamic: str = "true"):
         self.fields: dict[str, FieldType] = {}
+        # nested object paths (reference: ObjectMapper nested=true; fields
+        # under these paths additionally index into the parent doc here —
+        # the include_in_parent behavior — while `nested` queries match
+        # per-object against the stored source)
+        self.nested_paths: set[str] = set()
         # "true" | "false" | "strict" (ES `dynamic` mapping parameter)
         self.dynamic = dynamic
         if mapping_dict:
@@ -166,6 +172,10 @@ class Mappings:
             if ftype not in ALL_TYPES:
                 raise MapperParsingError(f"no handler for type [{ftype}] declared on field [{full}]")
             if ftype == "object":
+                self._parse_properties(spec.get("properties", {}), prefix=f"{full}.")
+                continue
+            if ftype == "nested":
+                self.nested_paths.add(full)
                 self._parse_properties(spec.get("properties", {}), prefix=f"{full}.")
                 continue
             ft = FieldType(
@@ -261,7 +271,8 @@ class Mappings:
         if value is None:
             return
         ft_pre = self.fields.get(full)
-        if ft_pre is not None and ft_pre.type in ("completion", "percolator"):
+        if ft_pre is not None and ft_pre.type in ("completion", "percolator",
+                                                  "geo_point"):
             # completion/percolator values keep their raw shape; the pack
             # builder stores them host-side
             out.setdefault(full, []).append(value)

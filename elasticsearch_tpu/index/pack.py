@@ -61,6 +61,30 @@ POS_L = 1 << 17
 POS_INF = np.int64(1) << 62
 
 
+def _parse_geo_point(v):
+    """ES geo_point forms -> (lat, lon): {"lat","lon"} | "lat,lon" |
+    [lon, lat] (GeoJSON order!) | {"type": "Point", "coordinates": [lon,lat]}
+    (reference behavior: common/geo/GeoPoint.java parsing)."""
+    try:
+        if isinstance(v, dict):
+            if "lat" in v and "lon" in v:
+                return float(v["lat"]), float(v["lon"])
+            if v.get("type", "").lower() == "point" and v.get("coordinates"):
+                lon, lat = v["coordinates"][:2]
+                return float(lat), float(lon)
+            return None
+        if isinstance(v, str):
+            lat_s, lon_s = v.split(",", 1)
+            return float(lat_s), float(lon_s)
+        if isinstance(v, (list, tuple)) and len(v) >= 2:
+            return float(v[1]), float(v[0])
+    except (ValueError, TypeError):
+        from ..utils.errors import MapperParsingError
+
+        raise MapperParsingError(f"failed to parse geo_point value [{v!r}]")
+    return None
+
+
 def default_dense_min_df(n_docs: int) -> int:
     """df threshold above which a term moves to the dense tier. ~1 posting
     per 2 doc-chunks: dense rows then cost at most ~2x their CSR form."""
@@ -307,6 +331,15 @@ class PackBuilder:
             elif t in FLOAT_TYPES:
                 if ft.doc_values and values:
                     self.docvalue_raw.setdefault(fld, []).append((docid, float(values[0])))
+            elif t == "geo_point":
+                for v in values:
+                    latlon = _parse_geo_point(v)
+                    if latlon is not None:
+                        self.docvalue_raw.setdefault(f"{fld}#lat", []).append(
+                            (docid, latlon[0]))
+                        self.docvalue_raw.setdefault(f"{fld}#lon", []).append(
+                            (docid, latlon[1]))
+                        break  # single-valued column: first point wins
             elif t == "percolator":
                 for v in values:
                     if not isinstance(v, dict):
@@ -513,7 +546,12 @@ class PackBuilder:
         # ---- docvalues ---------------------------------------------------
         docvalues: dict[str, DocValuesColumn] = {}
         for fld, pairs in self.docvalue_raw.items():
-            ftype = "keyword" if fld == "_id" else mappings.fields[fld].type
+            if fld == "_id":
+                ftype = "keyword"
+            elif "#" in fld:
+                ftype = "float"  # geo_point lat/lon sub-columns
+            else:
+                ftype = mappings.fields[fld].type
             has = np.zeros(N, dtype=bool)
             if ftype in KEYWORD_TYPES:
                 terms_sorted = sorted({v for _, v in pairs})

@@ -361,7 +361,12 @@ def build_stacked_pack_routed(
     # per-shard dense tiers disabled: StackedPack builds its own global one
     # (global df decisions + global avgdl), so a local tier would only burn
     # build time and host RAM
-    return StackedPack([b.build(dense_min_df=1 << 62) for b in builders], mappings)
+    packs = [b.build(dense_min_df=1 << 62) for b in builders]
+    for p, shard_docs in zip(packs, routed):
+        # source references (shared with EsIndex.shard_docs) for host-side
+        # per-object matching (nested queries, query/nested.py)
+        p.doc_sources = [src for _, src in shard_docs]
+    return StackedPack(packs, mappings)
 
 
 def build_stacked_pack(

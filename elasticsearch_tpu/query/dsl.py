@@ -561,6 +561,9 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "script": _parse_script_filter,
     "percolate": lambda body, m: _parse_percolate(body, m),
+    "nested": lambda body, m: _parse_nested_q(body, m),
+    "geo_bounding_box": lambda body, m: _parse_geo_bbox(body, m),
+    "geo_distance": lambda body, m: _parse_geo_dist(body, m),
     "query_string": lambda body, m: _parse_query_string(body, m),
     "simple_query_string": lambda body, m: _parse_simple_query_string(body, m),
 }
@@ -570,6 +573,24 @@ def _parse_percolate(body, mappings):
     from .percolate import parse_percolate
 
     return parse_percolate(body, mappings)
+
+
+def _parse_nested_q(body, mappings):
+    from .nested import parse_nested
+
+    return parse_nested(body, mappings)
+
+
+def _parse_geo_bbox(body, mappings):
+    from .geo import parse_geo_bounding_box
+
+    return parse_geo_bounding_box(body, mappings)
+
+
+def _parse_geo_dist(body, mappings):
+    from .geo import parse_geo_distance
+
+    return parse_geo_distance(body, mappings)
 
 
 def _parse_query_string(body, mappings):

@@ -1,0 +1,149 @@
+"""Geo queries: geo_bounding_box, geo_distance.
+
+Parity targets (reference): index/query/GeoBoundingBoxQueryBuilder.java
+(dateline-crossing boxes), GeoDistanceQueryBuilder.java (haversine arc
+distance). geo_point columns live as paired float docvalues
+(`field#lat` / `field#lon`, index/pack.py), so both queries are pure
+vectorized arithmetic over two columns — ideal device shape."""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..index.pack import _parse_geo_point
+from ..utils.errors import IllegalArgumentError, QueryParsingError
+from .nodes import QueryNode
+
+EARTH_RADIUS_M = 6371008.7714  # mean radius, matches Lucene GeoUtils
+
+_DIST_UNITS = {
+    "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+    "in": 0.0254, "ft": 0.3048, "yd": 0.9144, "mi": 1609.344,
+    "nmi": 1852.0, "nauticalmiles": 1852.0, "kilometers": 1000.0,
+    "meters": 1.0, "miles": 1609.344, "feet": 0.3048, "inch": 0.0254,
+}
+
+
+def parse_distance_meters(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*", str(v))
+    if not m:
+        raise IllegalArgumentError(f"failed to parse distance [{v}]")
+    unit = m.group(2).lower() or "m"
+    if unit not in _DIST_UNITS:
+        raise IllegalArgumentError(f"unknown distance unit [{unit}]")
+    return float(m.group(1)) * _DIST_UNITS[unit]
+
+
+def _geo_cols(dev, fld, ctx):
+    lat = dev["dv_float"].get(f"{fld}#lat")
+    lon = dev["dv_float"].get(f"{fld}#lon")
+    if lat is None or lon is None:
+        return None
+    return lat[0], lat[1] & lon[1], lon[0]
+
+
+@dataclass
+class GeoBoundingBoxNode(QueryNode):
+    fld: str = ""
+    top: float = 90.0
+    bottom: float = -90.0
+    left: float = -180.0
+    right: float = 180.0
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        return (), ("geo_bbox", self.fld, self.top, self.bottom,
+                    self.left, self.right, self.boost)
+
+    def device_eval(self, dev, params, ctx):
+        n1 = ctx.num_docs + 1
+        got = _geo_cols(dev, self.fld, ctx)
+        if got is None:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        lat, has, lon = got
+        ok = has & (lat <= self.top) & (lat >= self.bottom)
+        if self.left <= self.right:
+            ok = ok & (lon >= self.left) & (lon <= self.right)
+        else:  # crosses the dateline
+            ok = ok & ((lon >= self.left) | (lon <= self.right))
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(ok[: ctx.num_docs])
+        score = jnp.where(match, jnp.float32(self.boost), 0.0)
+        return score, match
+
+
+@dataclass
+class GeoDistanceNode(QueryNode):
+    fld: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        return (), ("geo_dist", self.fld, self.lat, self.lon,
+                    self.distance_m, self.boost)
+
+    def device_eval(self, dev, params, ctx):
+        n1 = ctx.num_docs + 1
+        got = _geo_cols(dev, self.fld, ctx)
+        if got is None:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        lat, has, lon = got
+        la1 = jnp.deg2rad(lat)
+        lo1 = jnp.deg2rad(lon)
+        la2 = math.radians(self.lat)
+        lo2 = math.radians(self.lon)
+        dphi = la1 - la2
+        dlmb = lo1 - lo2
+        a = jnp.sin(dphi / 2) ** 2 + jnp.cos(la1) * math.cos(la2) * jnp.sin(dlmb / 2) ** 2
+        dist = 2.0 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+        ok = has & (dist <= self.distance_m)
+        match = jnp.zeros(n1, bool).at[: ctx.num_docs].set(ok[: ctx.num_docs])
+        score = jnp.where(match, jnp.float32(self.boost), 0.0)
+        return score, match
+
+
+def parse_geo_bounding_box(body, mappings) -> GeoBoundingBoxNode:
+    body = dict(body)
+    boost = float(body.pop("boost", 1.0))
+    body.pop("validation_method", None)
+    body.pop("ignore_unmapped", None)
+    if len(body) != 1:
+        raise QueryParsingError("[geo_bounding_box] expects one field")
+    (fld, spec), = body.items()
+    if "top_left" in spec and "bottom_right" in spec:
+        tl = _parse_geo_point(spec["top_left"])
+        br = _parse_geo_point(spec["bottom_right"])
+        top, left = tl
+        bottom, right = br
+    else:
+        top = float(spec["top"])
+        bottom = float(spec["bottom"])
+        left = float(spec["left"])
+        right = float(spec["right"])
+    return GeoBoundingBoxNode(fld=fld, top=top, bottom=bottom,
+                              left=left, right=right, boost=boost)
+
+
+def parse_geo_distance(body, mappings) -> GeoDistanceNode:
+    body = dict(body)
+    boost = float(body.pop("boost", 1.0))
+    distance = body.pop("distance", None)
+    body.pop("distance_type", None)
+    body.pop("validation_method", None)
+    body.pop("ignore_unmapped", None)
+    if distance is None:
+        raise QueryParsingError("[geo_distance] requires [distance]")
+    if len(body) != 1:
+        raise QueryParsingError("[geo_distance] expects one origin field")
+    (fld, origin), = body.items()
+    lat, lon = _parse_geo_point(origin)
+    return GeoDistanceNode(fld=fld, lat=lat, lon=lon,
+                           distance_m=parse_distance_meters(distance),
+                           boost=boost)

@@ -1,0 +1,137 @@
+"""geo_point type, geo queries/aggs, nested type + nested query."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+
+def _geo_engine():
+    e = Engine(None)
+    e.create_index("places", {"properties": {
+        "name": {"type": "keyword"},
+        "loc": {"type": "geo_point"},
+    }})
+    idx = e.indices["places"]
+    pts = [
+        ("berlin", {"lat": 52.52, "lon": 13.40}),
+        ("paris", "48.85,2.35"),
+        ("london", [-0.12, 51.50]),  # GeoJSON order lon,lat
+        ("nyc", {"lat": 40.71, "lon": -74.00}),
+        ("sydney", {"lat": -33.87, "lon": 151.21}),
+    ]
+    for name, loc in pts:
+        idx.index_doc(name, {"name": name, "loc": loc})
+    idx.index_doc("nowhere", {"name": "nowhere"})
+    idx.refresh()
+    return e, idx
+
+
+def test_geo_bounding_box():
+    e, idx = _geo_engine()
+    r = idx.search(query={"geo_bounding_box": {"loc": {
+        "top_left": {"lat": 55.0, "lon": -1.0},
+        "bottom_right": {"lat": 48.0, "lon": 14.0},
+    }}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"berlin", "paris", "london"}
+
+
+def test_geo_bounding_box_dateline():
+    e, idx = _geo_engine()
+    # box crossing the dateline: covers sydney(151E) via left=140,right=-60
+    r = idx.search(query={"geo_bounding_box": {"loc": {
+        "top": 0.0, "bottom": -60.0, "left": 140.0, "right": -60.0,
+    }}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"sydney"}
+
+
+def test_geo_distance():
+    e, idx = _geo_engine()
+    # ~878km Berlin-Paris, ~343km Paris-London
+    r = idx.search(query={"geo_distance": {
+        "distance": "400km", "loc": {"lat": 48.85, "lon": 2.35}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"paris", "london"}
+    r = idx.search(query={"geo_distance": {
+        "distance": "1000km", "loc": {"lat": 48.85, "lon": 2.35}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"paris", "london", "berlin"}
+
+
+def test_geo_aggs():
+    e, idx = _geo_engine()
+    r = idx.search(aggs={
+        "box": {"geo_bounds": {"field": "loc"}},
+        "center": {"geo_centroid": {"field": "loc"}},
+        "tiles": {"geotile_grid": {"field": "loc", "precision": 3}},
+    })
+    b = r["aggregations"]["box"]["bounds"]
+    assert b["top_left"]["lat"] == pytest.approx(52.52, abs=0.01)
+    assert b["bottom_right"]["lat"] == pytest.approx(-33.87, abs=0.01)
+    assert b["top_left"]["lon"] == pytest.approx(-74.0, abs=0.01)
+    c = r["aggregations"]["center"]
+    assert c["count"] == 5
+    expect_lat = (52.52 + 48.85 + 51.50 + 40.71 - 33.87) / 5
+    assert c["location"]["lat"] == pytest.approx(expect_lat, abs=0.01)
+    tiles = r["aggregations"]["tiles"]["buckets"]
+    assert sum(t["doc_count"] for t in tiles) == 5
+    assert all(t["key"].startswith("3/") for t in tiles)
+
+
+def _nested_engine():
+    e = Engine(None)
+    e.create_index("users", {"properties": {
+        "group": {"type": "keyword"},
+        "user": {"type": "nested", "properties": {
+            "first": {"type": "keyword"},
+            "last": {"type": "keyword"},
+            "age": {"type": "integer"},
+        }},
+    }})
+    idx = e.indices["users"]
+    idx.index_doc("1", {"group": "fans", "user": [
+        {"first": "John", "last": "Smith", "age": 30},
+        {"first": "Alice", "last": "White", "age": 40},
+    ]})
+    idx.index_doc("2", {"group": "fans", "user": [
+        {"first": "John", "last": "White", "age": 20},
+    ]})
+    idx.refresh()
+    return e, idx
+
+
+def test_nested_cross_field_alignment():
+    e, idx = _nested_engine()
+    # the classic: John+Smith must only match doc 1 (same object), even
+    # though doc 2 has John and doc 1 has White
+    q = {"nested": {"path": "user", "query": {"bool": {"must": [
+        {"term": {"user.first": {"value": "John"}}},
+        {"term": {"user.last": {"value": "Smith"}}},
+    ]}}}}
+    r = idx.search(query=q, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+    # flattened (non-nested) query DOES match both, include_in_parent style
+    r = idx.search(query={"bool": {"must": [
+        {"term": {"user.first": "John"}}, {"term": {"user.last": "White"}},
+    ]}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+
+
+def test_nested_range_and_bool():
+    e, idx = _nested_engine()
+    q = {"nested": {"path": "user", "query": {"bool": {"must": [
+        {"term": {"user.first": {"value": "John"}}},
+        {"range": {"user.age": {"gte": 25}}},
+    ]}}}}
+    r = idx.search(query=q, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+    # composes with outer bool
+    q2 = {"bool": {"must": [q, {"term": {"group": "fans"}}]}}
+    r = idx.search(query=q2, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+
+
+def test_nested_unknown_path_rejected():
+    e, idx = _nested_engine()
+    with pytest.raises(QueryParsingError):
+        idx.search(query={"nested": {"path": "nope",
+                                     "query": {"match_all": {}}}})
