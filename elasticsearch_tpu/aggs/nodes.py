@@ -1474,13 +1474,14 @@ class CompositeAgg(AggNode):
 
 def _pos_rank(k):
     """Sortable rank for a composite key part (str or number)."""
-    return (0, k) if isinstance(k, str) else (0, k)
+    return (0, k)
 
 
 def _neg_rank(k):
     if isinstance(k, str):
-        # invert byte order for desc string sort
-        return (1, tuple(255 - b for b in k.encode("utf-8")))
+        # inverted byte order + a high terminator so prefixes order AFTER
+        # their extensions, the mirror of ascending prefix-first order
+        return (1, tuple(255 - b for b in k.encode("utf-8")) + (256,))
     return (1, -k)
 
 

@@ -182,7 +182,9 @@ def rollover(engine, target: str, body: dict | None, dry_run=False) -> dict:
         new_index = body.get("new_index") or _next_index_name(old_index)
     idx = engine.get_index(old_index)
     results = _evaluate_conditions(engine, idx, conditions)
-    met = all(results.values()) if results else True
+    # reference behavior: rollover when ANY condition is met
+    # (MetadataRolloverService areConditionsMet -> anyMatch)
+    met = any(results.values()) if results else True
     rolled = False
     if met and not dry_run:
         if ds is not None:

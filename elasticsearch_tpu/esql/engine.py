@@ -455,13 +455,19 @@ def execute(engine, query: str) -> Table:
                     raise IllegalArgumentError(f"Unknown column [{name}]")
                 vals = c.values[order]
                 nulls = c.null[order]
+                # desc sorts on an inverted key (reversing a stable argsort
+                # would flip tie order and break secondary sort keys)
                 if c.type == "keyword":
                     key = np.array([("" if v is None else str(v)) for v in vals])
-                    rank = np.argsort(key, kind="stable")
+                    if desc:
+                        uniq = np.unique(key)
+                        inv = np.searchsorted(uniq, key)
+                        rank = np.argsort(-inv, kind="stable")
+                    else:
+                        rank = np.argsort(key, kind="stable")
                 else:
-                    rank = np.argsort(np.asarray(vals, np.float64), kind="stable")
-                if desc:
-                    rank = rank[::-1]
+                    nkey = np.asarray(vals, np.float64)
+                    rank = np.argsort(-nkey if desc else nkey, kind="stable")
                 # nulls ordering: default nulls last (asc), first (desc)
                 nf = nulls_first if nulls_first is not None else desc
                 nn = nulls[rank]

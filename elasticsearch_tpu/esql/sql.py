@@ -87,6 +87,7 @@ def sql_query(engine, body: dict) -> dict:
     table = m.group("table")
     select = _split_commas(m.group("select"))
     group = _split_commas(m.group("group")) if m.group("group") else []
+    display: dict[str, str] = {}
     pipeline = [f"FROM {table}"]
     if m.group("where"):
         pipeline.append(f"WHERE {_norm_expr(m.group('where'))}")
@@ -97,26 +98,48 @@ def sql_query(engine, body: dict) -> dict:
     )
     if is_agg_query:
         aggs = []
+        norm_to_name = {}
         for s in select:
             am = re.match(r"^(.*?)\s+as\s+(\w+)$", s, re.IGNORECASE)
             alias = None
             if am:
                 s, alias = am.group(1).strip(), am.group(2)
             if re.match(rf"^\s*({'|'.join(_AGG_FNS)})\s*\(", s, re.IGNORECASE):
-                name = alias or re.sub(r"\s+", "", s.lower())
+                norm = re.sub(r"\s+", "", s.lower())
+                # stats names must be plain identifiers; unaliased aggregates
+                # get an internal name and keep the SQL text as display label
+                name = alias or f"__a{len(norm_to_name)}"
+                display[name] = alias or s.strip()
                 aggs.append(f"{name} = {_norm_expr(s.lower())}")
+                norm_to_name[norm] = name
                 sel_names.append(name)
             else:
                 if s not in group:
                     raise IllegalArgumentError(
                         f"[{s}] must appear in GROUP BY or be an aggregate")
                 sel_names.append(alias or s)
+        having = m.group("having")
+        if having:
+            # unaliased aggregates in HAVING resolve to (or create) stat
+            # columns — the ES|QL WHERE stage has no aggregate functions
+            def _sub_agg(am2):
+                norm = re.sub(r"\s+", "", am2.group(0).lower())
+                name = norm_to_name.get(norm)
+                if name is None:
+                    name = f"__h{len(norm_to_name)}"
+                    aggs.append(f"{name} = {_norm_expr(norm)}")
+                    norm_to_name[norm] = name
+                return name
+
+            having = re.sub(
+                rf"({'|'.join(_AGG_FNS)})\s*\(\s*[^)]*\s*\)",
+                _sub_agg, having, flags=re.IGNORECASE)
         stats = "STATS " + ", ".join(aggs)
         if group:
             stats += " BY " + ", ".join(group)
         pipeline.append(stats)
-        if m.group("having"):
-            pipeline.append(f"WHERE {_norm_expr(m.group('having'))}")
+        if having:
+            pipeline.append(f"WHERE {_norm_expr(having)}")
     else:
         if select == ["*"]:
             sel_names = []
@@ -152,7 +175,8 @@ def sql_query(engine, body: dict) -> dict:
         pipeline.append("KEEP " + ", ".join(sel_names))
     t = execute(engine, " | ".join(pipeline))
     order = sel_names or list(t.columns)
-    columns = [{"name": n, "type": t.columns[n].type} for n in order]
+    columns = [{"name": display.get(n, n), "type": t.columns[n].type}
+               for n in order]
     rows = []
     for i in range(t.nrows):
         row = []

@@ -254,6 +254,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def delete_doc(request):
         idx = _concrete(request.match_info["index"])
         r = await call(idx.delete_doc, request.match_info["id"])
+        if request.query.get("refresh") in ("", "true", "wait_for"):
+            await call(idx.refresh)
         return web.json_response({**_doc_result(r, idx.name), "result": "deleted"})
 
     @handler
@@ -263,6 +265,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         r = await call(
             engine.update_doc_api, name, request.match_info["id"], body
         )
+        if request.query.get("refresh") in ("", "true", "wait_for"):
+            await call(_concrete(name).refresh)
         status = 201 if r["result"] == "created" else 200
         return web.json_response(_doc_result(r, engine.resolve_write_index(name)),
                                  status=status)
@@ -535,16 +539,37 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         username = request.get("principal", {}).get("username", "_anonymous")
         return web.json_response(engine.security.create_api_key(username, body))
 
+    def _is_key_manager(request):
+        """manage_security holders see/invalidate all keys; everyone else
+        only their own (reference behavior: own-API-key privileges)."""
+        principal = request.get("principal")
+        if principal is None:
+            return True, None  # security disabled
+        from ..security import AuthorizationError
+
+        try:
+            engine.security.authorize(principal, "cluster:manage_security", [])
+            return True, principal["username"]
+        except AuthorizationError:
+            return False, principal["username"]
+
     @handler
     async def security_get_api_keys(request):
-        return web.json_response(engine.security.get_api_keys())
+        manager, username = _is_key_manager(request)
+        out = engine.security.get_api_keys()
+        if not manager:
+            out["api_keys"] = [k for k in out["api_keys"]
+                               if k["username"] == username]
+        return web.json_response(out)
 
     @handler
     async def security_invalidate_api_key(request):
         body = await body_json(request, {}) or {}
+        manager, username = _is_key_manager(request)
         return web.json_response(engine.security.invalidate_api_key(
             key_id=body.get("id") or (body.get("ids") or [None])[0],
             name=body.get("name"),
+            owner=None if manager else username,
         ))
 
     # ---- ESQL / SQL / EQL ------------------------------------------------
@@ -634,10 +659,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 entry["is_running"] = False
 
         task = asyncio.create_task(run())
-        try:
-            await asyncio.wait_for(asyncio.shield(task), timeout=wait_s or 1.0)
-        except asyncio.TimeoutError:
-            pass
+        wait_timeout = 1.0 if wait_s is None else wait_s
+        if wait_timeout > 0:
+            try:
+                await asyncio.wait_for(asyncio.shield(task), timeout=wait_timeout)
+            except asyncio.TimeoutError:
+                pass
+        else:
+            await asyncio.sleep(0)  # give the task a chance to start
         return web.json_response(_async_envelope(sid, entry))
 
     @handler
@@ -925,6 +954,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
         t0 = time.monotonic()
         res = await call(engine.bulk, ops, request.query.get("pipeline"))
+        if request.query.get("refresh") in ("", "true", "wait_for"):
+            for touched in {n for _, n, _, _ in ops}:
+                try:
+                    await call(_concrete(touched).refresh)
+                except ElasticsearchTpuError:
+                    pass  # e.g. every item for this index failed to index
         res["took"] = int((time.monotonic() - t0) * 1000)
         return web.json_response(res)
 

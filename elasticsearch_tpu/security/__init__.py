@@ -118,6 +118,8 @@ class SecurityService:
         if not username or "/" in username:
             raise IllegalArgumentError(f"invalid username [{username}]")
         existing = self.store["users"].get(username)
+        if existing and (existing.get("metadata") or {}).get("_reserved"):
+            raise IllegalArgumentError(f"user [{username}] is reserved")
         entry = {
             "roles": list(body.get("roles") or []),
             "full_name": body.get("full_name"),
@@ -244,9 +246,16 @@ class SecurityService:
                         "invalidated": k["invalidated"]})
         return {"api_keys": out}
 
-    def invalidate_api_key(self, key_id: str | None = None, name: str | None = None) -> dict:
+    def invalidate_api_key(self, key_id: str | None = None,
+                           name: str | None = None,
+                           owner: str | None = None) -> dict:
+        """owner (when set) restricts invalidation to that user's own keys
+        (reference behavior: non-privileged callers manage only their own
+        API keys)."""
         hit = []
         for kid, k in self.store["api_keys"].items():
+            if owner is not None and k["username"] != owner:
+                continue
             if (key_id and kid == key_id) or (name and k["name"] == name):
                 if not k["invalidated"]:
                     k["invalidated"] = True

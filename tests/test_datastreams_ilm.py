@@ -126,3 +126,15 @@ def test_ilm_explain():
     e.indices["plain"].settings["lifecycle.name"] = "p"
     out = lc.explain(e, "plain")
     assert out["indices"]["plain"]["managed"] and out["indices"]["plain"]["phase"] == "hot"
+
+
+def test_rollover_any_condition_met():
+    e = Engine(None)
+    e.create_index("r-000001", {"properties": {"x": {"type": "integer"}}})
+    e.meta.put_alias("r-000001", "r", {"is_write_index": True})
+    idx = e.indices["r-000001"]
+    for i in range(10):
+        idx.index_doc(str(i), {"x": i})
+    # max_docs met, max_age not -> still rolls (ES anyMatch semantics)
+    out = lc.rollover(e, "r", {"conditions": {"max_docs": 5, "max_age": "7d"}})
+    assert out["rolled_over"]

@@ -147,3 +147,19 @@ def test_eql_sequence_with_maxspan():
     assert seqs[0]["join_keys"] == ["h1"]
     cats = [ev["_source"]["event.category"] for ev in seqs[0]["events"]]
     assert cats == ["process", "network"]
+
+
+def test_esql_sort_desc_secondary_key_stable():
+    e = _engine()
+    out = esql_query(e, {"query":
+        'FROM emp | SORT dept DESC, salary ASC | KEEP dept, salary'})
+    assert _vals(out) == [["sales", 90], ["ops", 60], ["ops", 70],
+                         ["eng", 80], ["eng", 100]]
+
+
+def test_sql_having_unaliased_aggregate():
+    e = _engine()
+    out = sql_query(e, {"query":
+        "SELECT dept, COUNT(*) FROM emp GROUP BY dept HAVING COUNT(*) > 1 ORDER BY dept"})
+    assert [r[0] for r in out["rows"]] == ["eng", "ops"]
+    assert all(r[1] == 2 for r in out["rows"])

@@ -128,3 +128,24 @@ async def _rest_drive():
 
 def test_security_rest():
     asyncio.run(_rest_drive())
+
+
+def test_reserved_user_cannot_be_overwritten():
+    e = Engine(None)
+    sec = e.security
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        sec.put_user("elastic", {"password": "hacked1", "roles": []})
+
+
+def test_api_key_owner_scoping():
+    e = Engine(None)
+    sec = e.security
+    sec.put_user("alice", {"password": "secret1", "roles": ["viewer"]})
+    k_root = sec.create_api_key("elastic", {"name": "rootkey"})
+    k_alice = sec.create_api_key("alice", {"name": "alicekey"})
+    # owner-scoped invalidation cannot touch another user's key
+    out = sec.invalidate_api_key(name="rootkey", owner="alice")
+    assert out["invalidated_api_keys"] == []
+    out = sec.invalidate_api_key(key_id=k_alice["id"], owner="alice")
+    assert out["invalidated_api_keys"] == [k_alice["id"]]
