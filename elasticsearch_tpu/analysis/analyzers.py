@@ -145,13 +145,36 @@ class KeywordAnalyzer(Analyzer):
             yield text, 0, len(text)
 
 
+def _english_analyzer():
+    """ES `english`: standard tokenizer, lowercase, possessive strip,
+    english stopwords, porter stemmer (reference behavior:
+    Lucene EnglishAnalyzer wired by modules/analysis-common)."""
+    from .custom import CustomAnalyzer, _make_tokenizer, porter_stem
+
+    def possessive(toks):
+        return [(t[:-2] if t.endswith(("'s", "\u2019s")) else t, a, b)
+                for t, a, b in toks]
+
+    def lower(toks):
+        return [(t.lower(), a, b) for t, a, b in toks]
+
+    def stop(toks):
+        return [(t, a, b) for t, a, b in toks if t not in ENGLISH_STOP_WORDS]
+
+    def stem(toks):
+        return [(porter_stem(t), a, b) for t, a, b in toks]
+
+    return CustomAnalyzer(_make_tokenizer("standard", {}),
+                          [lower, possessive, stop, stem], [])
+
+
 _BUILTIN = {
     "standard": StandardAnalyzer,
     "whitespace": WhitespaceAnalyzer,
     "simple": SimpleAnalyzer,
     "stop": StopAnalyzer,
     "keyword": KeywordAnalyzer,
-    "english": lambda: StandardAnalyzer(stopwords=ENGLISH_STOP_WORDS),
+    "english": _english_analyzer,
 }
 
 

@@ -94,6 +94,10 @@ class EsIndex:
             if s is not None and v is not None:
                 s.parse(v)  # typed validation at create (Setting.java parsers)
             self.settings[k] = v
+        if self.settings.get("analysis"):
+            from ..analysis.custom import build_analysis_registry
+
+            mappings.set_analysis(build_analysis_registry(self.settings["analysis"]))
         self.num_shards = int(self.settings["number_of_shards"])
         if self.num_shards < 1:
             raise IllegalArgumentError("number_of_shards must be >= 1")

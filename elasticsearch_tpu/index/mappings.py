@@ -109,12 +109,14 @@ class FieldType:
 
     def get_analyzer(self) -> Analyzer:
         if self._analyzer_obj is None:
-            self._analyzer_obj = get_analyzer(self.analyzer)
+            reg = getattr(self, "_registry", None) or {}
+            self._analyzer_obj = reg.get(self.analyzer) or get_analyzer(self.analyzer)
         return self._analyzer_obj
 
     def get_search_analyzer(self) -> Analyzer:
         if self.search_analyzer:
-            return get_analyzer(self.search_analyzer)
+            reg = getattr(self, "_registry", None) or {}
+            return reg.get(self.search_analyzer) or get_analyzer(self.search_analyzer)
         return self.get_analyzer()
 
     def to_dict(self) -> dict:
@@ -147,6 +149,8 @@ class Mappings:
         # the include_in_parent behavior — while `nested` queries match
         # per-object against the stored source)
         self.nested_paths: set[str] = set()
+        # per-index custom analyzers (settings `analysis` section)
+        self.analysis_registry: dict[str, Analyzer] = {}
         # "true" | "false" | "strict" (ES `dynamic` mapping parameter)
         self.dynamic = dynamic
         if mapping_dict:
@@ -157,6 +161,17 @@ class Mappings:
             self._parse_properties(props, prefix="")
             dyn = mapping_dict.get("dynamic", dynamic)
             self.dynamic = {True: "true", False: "false"}.get(dyn, str(dyn))
+
+    def set_analysis(self, registry: dict[str, Analyzer]) -> None:
+        """Attach custom analyzers built from index settings; field types
+        resolve names through this registry before the builtins."""
+        self.analysis_registry = registry or {}
+        for ft in self.fields.values():
+            ft._registry = self.analysis_registry
+            ft._analyzer_obj = None
+            for sub in ft.fields.values():
+                sub._registry = self.analysis_registry
+                sub._analyzer_obj = None
 
     # ---- mapping definition parsing -------------------------------------
 

@@ -1,0 +1,73 @@
+"""Custom analyzers, Porter stemming, synonyms, char filters."""
+
+import pytest
+
+from elasticsearch_tpu.analysis.custom import build_analysis_registry, porter_stem
+from elasticsearch_tpu.engine import Engine
+
+
+def test_porter_stemmer_classics():
+    cases = {
+        "caresses": "caress", "ponies": "poni", "running": "run",
+        "relational": "relat", "conditional": "condit", "happy": "happi",
+        "hopping": "hop", "generalization": "gener", "adjustable": "adjust",
+        "cats": "cat", "agreed": "agre", "controllable": "control",
+    }
+    for w, want in cases.items():
+        assert porter_stem(w) == want, (w, porter_stem(w), want)
+
+
+def test_custom_analyzer_chain():
+    reg = build_analysis_registry({
+        "char_filter": {"strip_html": {"type": "html_strip"}},
+        "filter": {
+            "my_stop": {"type": "stop", "stopwords": ["the", "a", "is"]},
+            "my_stem": {"type": "stemmer", "language": "english"},
+            "my_syn": {"type": "synonym", "synonyms": ["tv => television",
+                                                       "fast, quick"]},
+        },
+        "analyzer": {"my_an": {
+            "type": "custom", "tokenizer": "standard",
+            "char_filter": ["strip_html"],
+            "filter": ["lowercase", "my_stop", "my_syn", "my_stem"],
+        }},
+    })
+    an = reg["my_an"]
+    terms = [t.term for t in an.analyze("<b>The</b> RUNNING tv is fast")]
+    assert terms == ["run", "televis", "fast", "quick"]
+
+
+def test_index_with_custom_analyzer_end_to_end():
+    e = Engine(None)
+    e.create_index("docs", {"properties": {
+        "body": {"type": "text", "analyzer": "stemmed"},
+    }}, settings={"analysis": {
+        "analyzer": {"stemmed": {"type": "custom", "tokenizer": "standard",
+                                 "filter": ["lowercase", "porter_stem"]}},
+    }})
+    idx = e.indices["docs"]
+    idx.index_doc("1", {"body": "running shoes"})
+    idx.index_doc("2", {"body": "he runs daily"})
+    idx.index_doc("3", {"body": "unrelated text"})
+    idx.refresh()
+    # query analyzed with the same chain: "runs" -> "run" matches both
+    r = idx.search(query={"match": {"body": "runs"}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+
+
+def test_english_analyzer_stems():
+    from elasticsearch_tpu.analysis import get_analyzer
+
+    an = get_analyzer("english")
+    assert [t.term for t in an.analyze("The running foxes")] == ["run", "fox"]
+
+
+def test_edge_ngram_autocomplete():
+    reg = build_analysis_registry({
+        "filter": {"autocomplete": {"type": "edge_ngram", "min_gram": 2,
+                                    "max_gram": 4}},
+        "analyzer": {"ac": {"type": "custom", "tokenizer": "standard",
+                            "filter": ["lowercase", "autocomplete"]}},
+    })
+    terms = [t.term for t in reg["ac"].analyze("Search")]
+    assert terms == ["se", "sea", "sear"]
