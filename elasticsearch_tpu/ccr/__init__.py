@@ -1,0 +1,167 @@
+"""Cross-cluster replication: follower indices tailing a remote leader.
+
+Parity target: x-pack/plugin/ccr (reference behavior:
+ShardFollowNodeTask.java:68 — followers poll the leader's shard changes by
+sequence number and replay them locally; ShardFollowTasksExecutor.java:95
+runs followers on the persistent-task framework). Here the leader exposes
+its op log over HTTP (`GET /{index}/_changes?from_seq_no=N`, served from the
+version map which keeps tombstones until flush) and the follower executor
+replays batches on every scheduler tick, checkpointing the applied seq_no."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..utils.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+)
+
+
+def changes(engine, index: str, from_seq_no: int, size: int = 512) -> dict:
+    """Leader-side op feed: index/delete ops with seq_no >= from_seq_no in
+    seq_no order (the analog of the reference's internal shard changes
+    action)."""
+    idx = engine.get_index(index)
+    ops = []
+    for doc_id, e in idx.docs.items():
+        if e.seq_no >= from_seq_no:
+            if e.alive:
+                ops.append({"op": "index", "id": doc_id, "seq_no": e.seq_no,
+                            "version": e.version, "source": e.source})
+            else:
+                ops.append({"op": "delete", "id": doc_id, "seq_no": e.seq_no,
+                            "version": e.version})
+    ops.sort(key=lambda o: o["seq_no"])
+    return {
+        "ops": ops[:size],
+        "max_seq_no": idx.seq_no - 1,
+        "mappings": idx.mappings.to_dict(),
+    }
+
+
+def _fetch_remote_changes(url: str, leader: str, from_seq_no: int) -> dict:
+    req = urllib.request.Request(
+        f"{url}/{leader}/_changes?from_seq_no={from_seq_no}")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+class FollowExecutor:
+    """Persistent-task executor: one tick replays pending leader ops for
+    every active follower."""
+
+    def tick(self, engine, task):
+        for name, f in list(_store(engine).items()):
+            if f.get("paused"):
+                continue
+            try:
+                self._replay(engine, name, f)
+            except Exception as ex:  # noqa: BLE001 - keep other followers alive
+                f["last_error"] = str(ex)
+        engine.meta.save()
+
+    def _replay(self, engine, follower: str, f: dict):
+        remotes = engine.remote_clusters()
+        url = remotes.get(f["remote_cluster"])
+        if url is None:
+            raise IllegalArgumentError(
+                f"unknown remote cluster [{f['remote_cluster']}]")
+        got = _fetch_remote_changes(url, f["leader_index"], f["checkpoint"] + 1)
+        if follower not in engine.indices:
+            engine.create_index(follower, mappings=got.get("mappings"))
+        idx = engine.indices[follower]
+        for op in got["ops"]:
+            if op["op"] == "index":
+                idx.index_doc(op["id"], op["source"])
+            else:
+                try:
+                    idx.delete_doc(op["id"])
+                except Exception:  # noqa: BLE001 - already absent
+                    pass
+            f["checkpoint"] = op["seq_no"]
+            f["ops_replayed"] = f.get("ops_replayed", 0) + 1
+        f["last_error"] = None
+
+
+def _store(engine) -> dict:
+    return engine.meta.extras.setdefault("ccr_followers", {})
+
+
+def _ensure_executor(engine):
+    if "ccr" not in engine.persistent.executors:
+        engine.persistent.register_executor("ccr", FollowExecutor())
+        if "ccr-driver" not in engine.meta.persistent_tasks:
+            engine.persistent.start("ccr-driver", "ccr", {})
+
+
+def follow(engine, follower: str, body: dict) -> dict:
+    remote = (body or {}).get("remote_cluster")
+    leader = (body or {}).get("leader_index")
+    if not remote or not leader:
+        raise IllegalArgumentError(
+            "[remote_cluster] and [leader_index] are required")
+    if follower in _store(engine):
+        raise ResourceAlreadyExistsError(f"follower [{follower}] already exists")
+    if remote not in engine.remote_clusters():
+        raise IllegalArgumentError(f"unknown remote cluster [{remote}]")
+    _store(engine)[follower] = {
+        "remote_cluster": remote, "leader_index": leader,
+        "checkpoint": -1, "paused": False, "ops_replayed": 0,
+        "last_error": None,
+    }
+    engine.meta.save()
+    _ensure_executor(engine)
+    # first replay happens synchronously so the follower exists immediately
+    engine.persistent.tick()
+    return {"follow_index_created": True, "follow_index_shards_acked": True,
+            "index_following_started": True}
+
+
+def pause_follow(engine, follower: str) -> dict:
+    f = _store(engine).get(follower)
+    if f is None:
+        raise ResourceNotFoundError(f"follower [{follower}] not found")
+    f["paused"] = True
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def resume_follow(engine, follower: str) -> dict:
+    f = _store(engine).get(follower)
+    if f is None:
+        raise ResourceNotFoundError(f"follower [{follower}] not found")
+    f["paused"] = False
+    engine.meta.save()
+    engine.persistent.tick()
+    return {"acknowledged": True}
+
+
+def unfollow(engine, follower: str) -> dict:
+    f = _store(engine).get(follower)
+    if f is None:
+        raise ResourceNotFoundError(f"follower [{follower}] not found")
+    if not f["paused"]:
+        raise IllegalArgumentError(
+            f"cannot convert the follower index [{follower}] to a non-follower, "
+            "because it has not been paused")
+    del _store(engine)[follower]
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def ccr_stats(engine) -> dict:
+    out = []
+    for name, f in _store(engine).items():
+        out.append({
+            "index": name,
+            "remote_cluster": f["remote_cluster"],
+            "leader_index": f["leader_index"],
+            "status": "paused" if f["paused"] else "active",
+            "follower_global_checkpoint": f["checkpoint"],
+            "operations_written": f.get("ops_replayed", 0),
+            "last_error": f.get("last_error"),
+        })
+    return {"follow_stats": {"indices": out}}

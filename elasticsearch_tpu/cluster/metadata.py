@@ -59,6 +59,9 @@ class MetadataStore:
         self.persistent_tasks: dict[str, dict] = {}
         self.security: dict = {"users": {}, "roles": {}, "api_keys": {}}
         self.transforms: dict[str, dict] = {}
+        # free-form persisted buckets for feature modules (slm/watcher/
+        # enrich/ccr/...): {bucket_name: {key: json-able value}}
+        self.extras: dict[str, dict] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -81,6 +84,7 @@ class MetadataStore:
             self.security = state.get(
                 "security", {"users": {}, "roles": {}, "api_keys": {}})
             self.transforms = state.get("transforms", {})
+            self.extras = state.get("extras", {})
 
     def save(self):
         f = self._file()
@@ -99,6 +103,7 @@ class MetadataStore:
                     "persistent_tasks": self.persistent_tasks,
                     "security": self.security,
                     "transforms": self.transforms,
+                    "extras": self.extras,
                 },
                 fh,
             )

@@ -680,6 +680,7 @@ class Engine:
         self.data_path = data_path
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
+        self.ingest.engine = self  # enrich processors look policies up here
         self.tasks = TaskManager()
         from ..tasks.persistent import PersistentTasksService
 

@@ -43,6 +43,8 @@ class Pipeline:
             proc = ForeachProcessor(config, build_processor=self._build)
         elif ptype in PROCESSOR_TYPES:
             proc = PROCESSOR_TYPES[ptype](config)
+            if ptype == "enrich":
+                proc.engine = getattr(self.service, "engine", None)
         else:
             raise IllegalArgumentError(f"No processor type exists with name [{ptype}]")
         if on_failure:

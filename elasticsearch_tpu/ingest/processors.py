@@ -640,6 +640,40 @@ class ForeachProcessor(Processor):
         set_field(ctx, field, out)
 
 
+class EnrichProcessor(Processor):
+    """enrich: add fields from an executed enrich policy's lookup table
+    (reference behavior: x-pack/plugin/enrich MatchProcessor — exact-match
+    lookup by the policy's match_field). The owning engine is attached by
+    Pipeline._build (`self.engine`)."""
+
+    type = "enrich"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.policy_name = self._field("policy_name")
+        self.fld = self._field("field")
+        self.target = self._field("target_field")
+        self.override = bool(self.config.get("override", True))
+        self.ignore_missing = bool(self.config.get("ignore_missing", False))
+        self.engine = None
+
+    def process(self, ctx):
+        from ..xpack import enrich_lookup
+
+        if self.engine is None:
+            self._fail("enrich processor has no engine attached")
+        value = get_field(ctx, self.fld)
+        if value is None:
+            if self.ignore_missing:
+                return
+            self._fail(f"field [{self.fld}] is missing")
+        row = enrich_lookup(self.engine, self.policy_name, value)
+        if row is None:
+            return
+        if self.override or not has_field(ctx, self.target):
+            set_field(ctx, self.target, dict(row))
+
+
 PROCESSOR_TYPES = {
     cls.type: cls
     for cls in (
@@ -648,6 +682,6 @@ PROCESSOR_TYPES = {
         HtmlStripProcessor, UrldecodeProcessor, SplitProcessor, JoinProcessor,
         AppendProcessor, GsubProcessor, DateProcessor, FailProcessor,
         DropProcessor, JsonProcessor, KvProcessor, CsvProcessor,
-        DissectProcessor, GrokProcessor, ScriptProcessor,
+        DissectProcessor, GrokProcessor, ScriptProcessor, EnrichProcessor,
     )
 }

@@ -398,6 +398,121 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- CCR / SLM / Watcher / Enrich / health ---------------------------
+
+    def _xcall(mod_name, fn_name, *args):
+        import importlib
+
+        mod = importlib.import_module(f"elasticsearch_tpu.{mod_name}")
+        return call(getattr(mod, fn_name), engine, *args)
+
+    @handler
+    async def ccr_changes(request):
+        from .. import ccr as ccr_mod
+
+        return web.json_response(await call(
+            ccr_mod.changes, engine, request.match_info["index"],
+            int(request.query.get("from_seq_no", 0)),
+            int(request.query.get("size", 512)),
+        ))
+
+    @handler
+    async def ccr_follow(request):
+        from .. import ccr as ccr_mod
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            ccr_mod.follow, engine, request.match_info["index"], body))
+
+    @handler
+    async def ccr_pause(request):
+        return web.json_response(await _xcall("ccr", "pause_follow",
+                                              request.match_info["index"]))
+
+    @handler
+    async def ccr_resume(request):
+        return web.json_response(await _xcall("ccr", "resume_follow",
+                                              request.match_info["index"]))
+
+    @handler
+    async def ccr_unfollow(request):
+        return web.json_response(await _xcall("ccr", "unfollow",
+                                              request.match_info["index"]))
+
+    @handler
+    async def ccr_stats_api(request):
+        return web.json_response(await _xcall("ccr", "ccr_stats"))
+
+    @handler
+    async def slm_put(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await _xcall(
+            "xpack", "slm_put_policy", request.match_info["id"], body))
+
+    @handler
+    async def slm_get(request):
+        return web.json_response(await _xcall(
+            "xpack", "slm_get_policy", request.match_info.get("id")))
+
+    @handler
+    async def slm_delete(request):
+        return web.json_response(await _xcall(
+            "xpack", "slm_delete_policy", request.match_info["id"]))
+
+    @handler
+    async def slm_execute_api(request):
+        return web.json_response(await _xcall(
+            "xpack", "slm_execute", request.match_info["id"]))
+
+    @handler
+    async def watcher_put_api(request):
+        from ..xpack import watcher_ensure_executor
+
+        body = await body_json(request, {}) or {}
+        res = await _xcall("xpack", "watcher_put", request.match_info["id"], body)
+        await call(watcher_ensure_executor, engine)
+        return web.json_response(res)
+
+    @handler
+    async def watcher_get_api(request):
+        return web.json_response(await _xcall(
+            "xpack", "watcher_get", request.match_info["id"]))
+
+    @handler
+    async def watcher_delete_api(request):
+        return web.json_response(await _xcall(
+            "xpack", "watcher_delete", request.match_info["id"]))
+
+    @handler
+    async def watcher_execute_api(request):
+        return web.json_response(await _xcall(
+            "xpack", "watcher_execute", request.match_info["id"]))
+
+    @handler
+    async def enrich_put(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await _xcall(
+            "xpack", "enrich_put_policy", request.match_info["name"], body))
+
+    @handler
+    async def enrich_execute(request):
+        return web.json_response(await _xcall(
+            "xpack", "enrich_execute_policy", request.match_info["name"]))
+
+    @handler
+    async def enrich_get(request):
+        return web.json_response(await _xcall(
+            "xpack", "enrich_get_policy", request.match_info.get("name")))
+
+    @handler
+    async def enrich_delete(request):
+        return web.json_response(await _xcall(
+            "xpack", "enrich_delete_policy", request.match_info["name"]))
+
+    @handler
+    async def health_report_api(request):
+        return web.json_response(await _xcall("xpack", "health_report"))
+
     # ---- transform / downsample / CCS ------------------------------------
 
     @handler
@@ -1640,6 +1755,28 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_get("/{index}/_changes", ccr_changes)
+    app.router.add_put("/{index}/_ccr/follow", ccr_follow)
+    app.router.add_post("/{index}/_ccr/pause_follow", ccr_pause)
+    app.router.add_post("/{index}/_ccr/resume_follow", ccr_resume)
+    app.router.add_post("/{index}/_ccr/unfollow", ccr_unfollow)
+    app.router.add_get("/_ccr/stats", ccr_stats_api)
+    app.router.add_put("/_slm/policy/{id}", slm_put)
+    app.router.add_get("/_slm/policy", slm_get)
+    app.router.add_get("/_slm/policy/{id}", slm_get)
+    app.router.add_delete("/_slm/policy/{id}", slm_delete)
+    app.router.add_post("/_slm/policy/{id}/_execute", slm_execute_api)
+    app.router.add_put("/_watcher/watch/{id}", watcher_put_api)
+    app.router.add_post("/_watcher/watch/{id}", watcher_put_api)
+    app.router.add_get("/_watcher/watch/{id}", watcher_get_api)
+    app.router.add_delete("/_watcher/watch/{id}", watcher_delete_api)
+    app.router.add_post("/_watcher/watch/{id}/_execute", watcher_execute_api)
+    app.router.add_put("/_enrich/policy/{name}", enrich_put)
+    app.router.add_post("/_enrich/policy/{name}/_execute", enrich_execute)
+    app.router.add_get("/_enrich/policy", enrich_get)
+    app.router.add_get("/_enrich/policy/{name}", enrich_get)
+    app.router.add_delete("/_enrich/policy/{name}", enrich_delete)
+    app.router.add_get("/_health_report", health_report_api)
     app.router.add_put("/_transform/{id}", transform_put)
     app.router.add_get("/_transform", transform_get)
     app.router.add_get("/_transform/{id}", transform_get)

@@ -1,0 +1,337 @@
+"""x-pack long tail: SLM, Watcher, Enrich, health report.
+
+Parity targets (reference): x-pack/plugin/slm (SnapshotLifecycleService —
+scheduled snapshots + retention), x-pack/plugin/watcher (scheduled
+input->condition->actions watches, simplified to search input / compare
+condition / index+logging actions), x-pack/plugin/enrich (enrich policies
+building lookup indices consumed by the enrich ingest processor),
+health/HealthService.java (indicator-based _health_report)."""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+
+from ..utils.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+)
+
+
+def _bucket(engine, name: str) -> dict:
+    return engine.meta.extras.setdefault(name, {})
+
+
+# ---- SLM ------------------------------------------------------------------
+
+def slm_put_policy(engine, pid: str, body: dict) -> dict:
+    if not (body or {}).get("repository"):
+        raise IllegalArgumentError("[repository] is required")
+    pol = {
+        "name": body.get("name", f"<{pid}-{{now/d}}>"),
+        "schedule": body.get("schedule", "0 30 1 * * ?"),
+        "repository": body["repository"],
+        "config": body.get("config") or {},
+        "retention": body.get("retention") or {},
+        "version": _bucket(engine, "slm_policies").get(pid, {}).get("version", 0) + 1,
+        "modified_date_millis": int(time.time() * 1000),
+        "last_success": None,
+        "last_failure": None,
+    }
+    _bucket(engine, "slm_policies")[pid] = pol
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def slm_get_policy(engine, pid: str | None = None) -> dict:
+    pols = _bucket(engine, "slm_policies")
+    if pid:
+        if pid not in pols:
+            raise ResourceNotFoundError(f"slm policy [{pid}] not found")
+        return {pid: {"policy": pols[pid], "version": pols[pid]["version"]}}
+    return {p: {"policy": v, "version": v["version"]} for p, v in pols.items()}
+
+
+def slm_delete_policy(engine, pid: str) -> dict:
+    pols = _bucket(engine, "slm_policies")
+    if pid not in pols:
+        raise ResourceNotFoundError(f"slm policy [{pid}] not found")
+    del pols[pid]
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def slm_execute(engine, pid: str) -> dict:
+    pols = _bucket(engine, "slm_policies")
+    pol = pols.get(pid)
+    if pol is None:
+        raise ResourceNotFoundError(f"slm policy [{pid}] not found")
+    snap_name = f"{pid}-{int(time.time() * 1000)}"
+    indices = (pol["config"] or {}).get("indices", "*")
+    if isinstance(indices, list):
+        indices = ",".join(indices)
+    engine.snapshots.create_snapshot(pol["repository"], snap_name,
+                                     indices=indices)
+    pol["last_success"] = {"snapshot_name": snap_name,
+                           "time": int(time.time() * 1000)}
+    # retention: keep at most max_count snapshots taken by this policy
+    retention = pol.get("retention") or {}
+    max_count = retention.get("max_count")
+    if max_count:
+        snaps = [s for s in engine.snapshots.get_snapshots(pol["repository"])
+                 if s["snapshot"].startswith(pid + "-")]
+        snaps.sort(key=lambda s: s["snapshot"])
+        for s in snaps[: max(0, len(snaps) - int(max_count))]:
+            engine.snapshots.delete_snapshot(pol["repository"], s["snapshot"])
+    engine.meta.save()
+    return {"snapshot_name": snap_name}
+
+
+# ---- Watcher --------------------------------------------------------------
+
+def watcher_put(engine, wid: str, body: dict) -> dict:
+    if not isinstance((body or {}).get("trigger"), dict):
+        raise IllegalArgumentError("watch requires [trigger]")
+    created = wid not in _bucket(engine, "watches")
+    _bucket(engine, "watches")[wid] = {
+        "trigger": body["trigger"],
+        "input": body.get("input") or {},
+        "condition": body.get("condition") or {"always": {}},
+        "actions": body.get("actions") or {},
+        "status": {"state": {"active": True}, "actions": {}},
+    }
+    engine.meta.save()
+    return {"_id": wid, "created": created}
+
+
+def watcher_get(engine, wid: str) -> dict:
+    w = _bucket(engine, "watches").get(wid)
+    if w is None:
+        raise ResourceNotFoundError(f"watch [{wid}] not found")
+    return {"_id": wid, "found": True, "watch": w, "status": w["status"]}
+
+
+def watcher_delete(engine, wid: str) -> dict:
+    ws = _bucket(engine, "watches")
+    if wid not in ws:
+        raise ResourceNotFoundError(f"watch [{wid}] not found")
+    del ws[wid]
+    engine.meta.save()
+    return {"_id": wid, "found": True}
+
+
+def _resolve_ctx_path(ctx: dict, path: str):
+    cur = ctx
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def watcher_execute(engine, wid: str, record=True) -> dict:
+    w = _bucket(engine, "watches").get(wid)
+    if w is None:
+        raise ResourceNotFoundError(f"watch [{wid}] not found")
+    # input
+    payload = {}
+    if "search" in w["input"]:
+        req = w["input"]["search"].get("request") or {}
+        body = req.get("body") or {}
+        res = engine.search_multi(
+            ",".join(req.get("indices", ["_all"])),
+            query=body.get("query"), size=int(body.get("size", 10)),
+        )
+        payload = res
+    elif "simple" in w["input"]:
+        payload = dict(w["input"]["simple"])
+    ctx = {"payload": payload}
+    # condition
+    met = True
+    cond = w["condition"]
+    if "compare" in cond:
+        (path, op_spec), = cond["compare"].items()
+        (op, want), = op_spec.items()
+        got = _resolve_ctx_path(ctx, path.replace("ctx.", ""))
+        if got is None:
+            met = False
+        else:
+            met = {
+                "eq": got == want, "not_eq": got != want,
+                "gt": got > want, "gte": got >= want,
+                "lt": got < want, "lte": got <= want,
+            }.get(op, False)
+    elif "never" in cond:
+        met = False
+    # actions
+    executed = []
+    if met:
+        for aname, aspec in w["actions"].items():
+            if "index" in aspec:
+                target = aspec["index"]["index"]
+                doc = {"watch_id": wid, "result": payload,
+                       "timestamp": int(time.time() * 1000)}
+                engine.get_or_autocreate(target).index_doc(None, doc)
+                executed.append(aname)
+            elif "logging" in aspec:
+                text = aspec["logging"].get("text", "")
+                _bucket(engine, "watcher_log").setdefault(wid, []).append(text)
+                executed.append(aname)
+            w["status"]["actions"][aname] = {
+                "ack": {"state": "ackable"},
+                "last_execution": {"successful": True},
+            }
+    if record:
+        engine.meta.save()
+    return {
+        "_id": wid,
+        "watch_record": {
+            "watch_id": wid,
+            "state": "executed" if met else "execution_not_needed",
+            "condition_met": met,
+            "actions_executed": executed,
+        },
+    }
+
+
+class WatcherExecutor:
+    """Persistent-task executor: fires every active watch each tick (the
+    scheduler granularity stands in for the reference's cron triggers)."""
+
+    def tick(self, engine, task):
+        for wid, w in list(_bucket(engine, "watches").items()):
+            if w["status"]["state"].get("active"):
+                try:
+                    watcher_execute(engine, wid, record=False)
+                except Exception:  # noqa: BLE001 - a broken watch must not stop others
+                    pass
+        engine.meta.save()
+
+
+def watcher_ensure_executor(engine):
+    if "watcher" not in engine.persistent.executors:
+        engine.persistent.register_executor("watcher", WatcherExecutor())
+        if "watcher-driver" not in engine.meta.persistent_tasks:
+            engine.persistent.start("watcher-driver", "watcher", {})
+
+
+# ---- Enrich ---------------------------------------------------------------
+
+def enrich_put_policy(engine, name: str, body: dict) -> dict:
+    if name in _bucket(engine, "enrich_policies"):
+        raise ResourceAlreadyExistsError(f"enrich policy [{name}] already exists")
+    match = (body or {}).get("match") or (body or {}).get("range")
+    if not match or not match.get("indices") or not match.get("match_field"):
+        raise IllegalArgumentError(
+            "enrich policy requires match.indices and match.match_field")
+    _bucket(engine, "enrich_policies")[name] = {
+        "match": match, "executed": False,
+    }
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def enrich_execute_policy(engine, name: str) -> dict:
+    pol = _bucket(engine, "enrich_policies").get(name)
+    if pol is None:
+        raise ResourceNotFoundError(f"enrich policy [{name}] not found")
+    match = pol["match"]
+    indices = match["indices"]
+    if isinstance(indices, list):
+        indices = ",".join(indices)
+    key_field = match["match_field"]
+    enrich_fields = match.get("enrich_fields") or []
+    lookup: dict[str, dict] = {}
+    for idx, _ in engine.resolve_search(indices):
+        for e in idx.docs.values():
+            if not e.alive:
+                continue
+            key = e.source.get(key_field)
+            if key is None:
+                continue
+            row = {f: e.source[f] for f in enrich_fields if f in e.source}
+            row[key_field] = key
+            lookup[str(key)] = row
+    pol["lookup"] = lookup
+    pol["executed"] = True
+    engine.meta.save()
+    return {"status": {"phase": "COMPLETE"}}
+
+
+def enrich_get_policy(engine, name: str | None = None) -> dict:
+    pols = _bucket(engine, "enrich_policies")
+    items = (
+        [(name, pols[name])] if name and name in pols
+        else ([] if name else list(pols.items()))
+    )
+    if name and name not in pols:
+        raise ResourceNotFoundError(f"enrich policy [{name}] not found")
+    return {"policies": [
+        {"config": {"match": {**p["match"], "name": n}}} for n, p in items
+    ]}
+
+
+def enrich_delete_policy(engine, name: str) -> dict:
+    pols = _bucket(engine, "enrich_policies")
+    if name not in pols:
+        raise ResourceNotFoundError(f"enrich policy [{name}] not found")
+    del pols[name]
+    engine.meta.save()
+    return {"acknowledged": True}
+
+
+def enrich_lookup(engine, policy_name: str, value) -> dict | None:
+    pol = _bucket(engine, "enrich_policies").get(policy_name)
+    if pol is None or not pol.get("executed"):
+        raise IllegalArgumentError(
+            f"enrich policy [{policy_name}] does not exist or was not executed")
+    return (pol.get("lookup") or {}).get(str(value))
+
+
+# ---- health report --------------------------------------------------------
+
+def health_report(engine) -> dict:
+    indicators = {}
+    # shards availability: green when every index has a live searcher
+    unassigned = [n for n, i in engine.indices.items() if i.searcher is None]
+    indicators["shards_availability"] = {
+        "status": "red" if unassigned else "green",
+        "symptom": ("This cluster has unavailable shards"
+                    if unassigned else "This cluster has all shards available"),
+        **({"impacts": [{"severity": 1, "description":
+                         f"indices {unassigned} are unavailable"}]}
+           if unassigned else {}),
+    }
+    # disk
+    import shutil as _sh
+
+    usage = _sh.disk_usage(engine.data_path or "/")
+    pct = usage.used / usage.total if usage.total else 0.0
+    indicators["disk"] = {
+        "status": "green" if pct < 0.85 else ("yellow" if pct < 0.95 else "red"),
+        "symptom": f"The cluster has enough available disk space ({pct:.0%} used)"
+        if pct < 0.85 else f"Disk usage is high ({pct:.0%})",
+    }
+    # ilm/slm running states
+    indicators["ilm"] = {"status": "green",
+                         "symptom": "ILM is running",
+                         "details": {"policies": len(getattr(engine.meta, "ilm_policies", {}))}}
+    indicators["slm"] = {"status": "green",
+                         "symptom": "SLM is running",
+                         "details": {"policies": len(_bucket(engine, "slm_policies"))}}
+    # master stability (single-node: trivially stable)
+    indicators["master_is_stable"] = {
+        "status": "green",
+        "symptom": "The cluster has a stable master node",
+    }
+    worst = "green"
+    for ind in indicators.values():
+        if ind["status"] == "red":
+            worst = "red"
+            break
+        if ind["status"] == "yellow":
+            worst = "yellow"
+    return {"status": worst, "cluster_name": "elasticsearch-tpu",
+            "indicators": indicators}

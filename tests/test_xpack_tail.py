@@ -1,0 +1,154 @@
+"""CCR follower replication, SLM, watcher, enrich, health report."""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu import xpack
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def test_slm_policy_and_execute(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_index("a", {"properties": {"x": {"type": "integer"}}})
+    e.indices["a"].index_doc("1", {"x": 1})
+    e.snapshots.put_repository("backup", {"type": "fs", "settings": {
+        "location": str(tmp_path / "repo")}})
+    xpack.slm_put_policy(e, "nightly", {
+        "repository": "backup", "config": {"indices": "a"},
+        "retention": {"max_count": 2}})
+    s1 = xpack.slm_execute(e, "nightly")["snapshot_name"]
+    import time
+
+    time.sleep(0.002)
+    s2 = xpack.slm_execute(e, "nightly")["snapshot_name"]
+    time.sleep(0.002)
+    s3 = xpack.slm_execute(e, "nightly")["snapshot_name"]
+    names = {s["snapshot"] for s in e.snapshots.get_snapshots("backup")}
+    assert names == {s2, s3}  # retention trimmed s1
+    pol = xpack.slm_get_policy(e, "nightly")["nightly"]["policy"]
+    assert pol["last_success"]["snapshot_name"] == s3
+    xpack.slm_delete_policy(e, "nightly")
+
+
+def test_watcher_search_condition_actions():
+    e = Engine(None)
+    e.create_index("logs", {"properties": {"level": {"type": "keyword"}}})
+    idx = e.indices["logs"]
+    for i in range(3):
+        idx.index_doc(str(i), {"level": "ERROR"})
+    idx.refresh()
+    xpack.watcher_put(e, "errors", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"search": {"request": {"indices": ["logs"], "body": {
+            "query": {"term": {"level": "ERROR"}}}}}},
+        "condition": {"compare": {"ctx.payload.hits.total.value": {"gte": 3}}},
+        "actions": {
+            "note": {"logging": {"text": "errors spiked"}},
+            "record": {"index": {"index": "alerts"}},
+        },
+    })
+    out = xpack.watcher_execute(e, "errors")
+    assert out["watch_record"]["condition_met"]
+    assert set(out["watch_record"]["actions_executed"]) == {"note", "record"}
+    assert "alerts" in e.indices
+    e.indices["alerts"].refresh()
+    assert e.indices["alerts"].search(size=10)["hits"]["total"]["value"] == 1
+    # condition not met after raising the threshold
+    xpack.watcher_put(e, "quiet", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"search": {"request": {"indices": ["logs"], "body": {
+            "query": {"term": {"level": "FATAL"}}}}}},
+        "condition": {"compare": {"ctx.payload.hits.total.value": {"gte": 1}}},
+        "actions": {"note": {"logging": {"text": "x"}}},
+    })
+    out = xpack.watcher_execute(e, "quiet")
+    assert not out["watch_record"]["condition_met"]
+
+
+def test_enrich_policy_and_processor():
+    e = Engine(None)
+    e.create_index("users", {"properties": {
+        "email": {"type": "keyword"}, "name": {"type": "keyword"},
+        "city": {"type": "keyword"}}})
+    u = e.indices["users"]
+    u.index_doc("1", {"email": "a@x.com", "name": "Ann", "city": "Berlin"})
+    u.index_doc("2", {"email": "b@x.com", "name": "Bob", "city": "Paris"})
+    xpack.enrich_put_policy(e, "user-info", {"match": {
+        "indices": "users", "match_field": "email",
+        "enrich_fields": ["name", "city"]}})
+    xpack.enrich_execute_policy(e, "user-info")
+    # enrich processor in a pipeline
+    e.ingest.put_pipeline("add-user", {"processors": [
+        {"enrich": {"policy_name": "user-info", "field": "email",
+                    "target_field": "user"}}]})
+    out = e.ingest.execute("add-user", {"email": "a@x.com", "msg": "hi"})
+    assert out["user"]["name"] == "Ann" and out["user"]["city"] == "Berlin"
+    out = e.ingest.execute("add-user", {"email": "nobody@x.com"})
+    assert "user" not in out
+
+
+def test_health_report():
+    e = Engine(None)
+    e.create_index("h", {"properties": {}})
+    out = xpack.health_report(e)
+    assert out["status"] in ("green", "yellow")
+    assert out["indicators"]["shards_availability"]["status"] == "green"
+    assert "master_is_stable" in out["indicators"]
+
+
+async def _ccr_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    leader_app = make_app()
+    lc = TestClient(TestServer(leader_app))
+    await lc.start_server()
+    await lc.put("/products", json={"mappings": {"properties": {
+        "sku": {"type": "keyword"}}}})
+    await lc.put("/products/_doc/p1?refresh=true", json={"sku": "A"})
+    await lc.put("/products/_doc/p2?refresh=true", json={"sku": "B"})
+    port = lc.server.port
+
+    follower_app = make_app()
+    fc = TestClient(TestServer(follower_app))
+    await fc.start_server()
+    fe = follower_app["engine"]
+    fe.settings.update({"persistent": {
+        "cluster.remote.main.seeds": [f"127.0.0.1:{port}"]}})
+
+    r = await fc.put("/products_copy/_ccr/follow", json={
+        "remote_cluster": "main", "leader_index": "products"})
+    assert (await r.json())["index_following_started"]
+    assert "products_copy" in fe.indices
+    fe.indices["products_copy"].refresh()
+    r = await fc.post("/products_copy/_search", json={})
+    assert (await r.json())["hits"]["total"]["value"] == 2
+
+    # new doc + delete on the leader replicate on next tick
+    await lc.put("/products/_doc/p3?refresh=true", json={"sku": "C"})
+    await lc.delete("/products/_doc/p1")
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, fe.persistent.tick)
+    fe.indices["products_copy"].refresh()
+    r = await fc.post("/products_copy/_search", json={"size": 10})
+    ids = {h["_id"] for h in (await r.json())["hits"]["hits"]}
+    assert ids == {"p2", "p3"}
+
+    r = await fc.get("/_ccr/stats")
+    stats = (await r.json())["follow_stats"]["indices"][0]
+    assert stats["index"] == "products_copy" and stats["operations_written"] >= 3
+
+    # pause -> unfollow
+    await fc.post("/products_copy/_ccr/pause_follow")
+    r = await fc.post("/products_copy/_ccr/unfollow")
+    assert (await r.json())["acknowledged"]
+    await fc.close()
+    await lc.close()
+
+
+def test_ccr_follow_replication():
+    asyncio.run(_ccr_drive())
