@@ -244,13 +244,23 @@ def scan_topk_xla(q, mat_t, live, aux_doc, aux_q, *, k, transform, count_positiv
     return top_v, top_i.astype(jnp.int32), totals
 
 
-def use_pallas() -> bool:
+# auto mode switches to the fused kernel when materializing [B, N] scores
+# would cost more HBM traffic than this threshold — below it XLA's own
+# matmul+top_k fusion wins (measured on real hardware)
+PALLAS_SCORE_BYTES_THRESHOLD = 1 << 31  # 2 GB
+
+
+def use_pallas(score_bytes: int | None = None) -> bool:
     flag = os.environ.get("ES_TPU_PALLAS", "auto")
     if flag == "0":
         return False
     if flag in ("1", "force"):
         return True
-    return jax.default_backend() == "tpu"
+    if jax.default_backend() != "tpu":
+        return False
+    if score_bytes is None:
+        return True
+    return score_bytes >= PALLAS_SCORE_BYTES_THRESHOLD
 
 
 def scan_topk(
@@ -281,7 +291,7 @@ def scan_topk(
     D = q.shape[1] if q is not None else 1
     tiles = _pick_tiles(B, D, N, k) if k <= MAX_FUSED_K else None
     if interpret is None:
-        if not use_pallas() or tiles is None:
+        if not use_pallas(score_bytes=4 * B * N) or tiles is None:
             return scan_topk_xla(
                 q, mat_t, live, aux_doc, aux_q,
                 k=k, transform=transform, count_positive=count_positive,
