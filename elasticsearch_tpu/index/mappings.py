@@ -32,7 +32,7 @@ from ..utils.errors import MapperParsingError
 TEXT_TYPES = {"text"}
 KEYWORD_TYPES = {"keyword"}
 INT_TYPES = {"long", "integer", "short", "byte"}
-FLOAT_TYPES = {"double", "float", "half_float"}
+FLOAT_TYPES = {"double", "float", "half_float", "rank_feature"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 DATE_TYPES = {"date"}
 BOOL_TYPES = {"boolean"}

@@ -561,12 +561,25 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "script": _parse_script_filter,
     "percolate": lambda body, m: _parse_percolate(body, m),
+    "more_like_this": lambda body, m: _x("parse_more_like_this", body, m),
+    "terms_set": lambda body, m: _x("parse_terms_set", body, m),
+    "combined_fields": lambda body, m: _x("parse_combined_fields", body, m),
+    "rank_feature": lambda body, m: _x("parse_rank_feature", body, m),
+    "distance_feature": lambda body, m: _x("parse_distance_feature", body, m),
+    "pinned": lambda body, m: _x("parse_pinned", body, m),
+    "wrapper": lambda body, m: _x("parse_wrapper", body, m),
     "nested": lambda body, m: _parse_nested_q(body, m),
     "geo_bounding_box": lambda body, m: _parse_geo_bbox(body, m),
     "geo_distance": lambda body, m: _parse_geo_dist(body, m),
     "query_string": lambda body, m: _parse_query_string(body, m),
     "simple_query_string": lambda body, m: _parse_simple_query_string(body, m),
 }
+
+
+def _x(fn_name, body, mappings):
+    from . import extra
+
+    return getattr(extra, fn_name)(body, mappings)
 
 
 def _parse_percolate(body, mappings):

@@ -1,0 +1,445 @@
+"""Long-tail query types.
+
+Parity targets (reference): index/query/MoreLikeThisQueryBuilder.java
+(TF-IDF term selection from like texts/docs), TermsSetQueryBuilder.java
+(per-doc minimum_should_match from a field), CombinedFieldsQueryBuilder.java
+(cross-field term matching — approximated as a per-field should-bool, a
+documented divergence from true BM25F), RankFeatureQueryBuilder.java
+(saturation/log/sigmoid/linear over a positive feature column),
+DistanceFeatureQueryBuilder.java (decay by distance from an origin in
+date/geo space), PinnedQueryBuilder.java (promoted ids above organic
+results), WrapperQueryBuilder.java (base64-embedded query)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import math
+from dataclasses import dataclass, field as dc_field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.mappings import parse_date_to_millis
+from ..utils.errors import IllegalArgumentError, QueryParsingError
+from .nodes import BoolNode, QueryNode, TermNode
+
+
+# ---- more_like_this -------------------------------------------------------
+
+@dataclass
+class MoreLikeThisNode(QueryNode):
+    fields: list = dc_field(default_factory=list)
+    like_texts: list = dc_field(default_factory=list)
+    like_ids: list = dc_field(default_factory=list)
+    unlike_texts: list = dc_field(default_factory=list)
+    mappings: object = None
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    minimum_should_match: str = "30%"
+    boost: float = 1.0
+    _inner: QueryNode | None = None
+
+    def _select_terms(self, stacked) -> list[tuple[str, str]]:
+        """TF-IDF-ranked (field, term) candidates from the like sources."""
+        from collections import Counter
+
+        tf: Counter = Counter()
+        for fld in self.fields:
+            ft = self.mappings.fields.get(fld)
+            if ft is None or ft.type not in ("text", "keyword"):
+                continue
+            analyzer = ft.get_analyzer() if ft.type == "text" else None
+            texts = list(self.like_texts)
+            for like_id in self.like_ids:
+                for pack in stacked.shards:
+                    sources = getattr(pack, "doc_sources", None)
+                    col = pack.docvalues.get("_id")
+                    if sources is None or col is None:
+                        continue
+                    terms_list = col.ord_terms or []
+                    for docid, src in enumerate(sources):
+                        if (docid < len(col.values) and col.values[docid] >= 0
+                                and terms_list[col.values[docid]] == like_id):
+                            v = src.get(fld)
+                            if isinstance(v, str):
+                                texts.append(v)
+            unlike_terms = set()
+            for u in self.unlike_texts:
+                if analyzer:
+                    unlike_terms |= {t.term for t in analyzer.analyze(u)}
+                else:
+                    unlike_terms.add(u)
+            for text in texts:
+                toks = ([t.term for t in analyzer.analyze(text)]
+                        if analyzer else [text])
+                for t in toks:
+                    if t not in unlike_terms:
+                        tf[(fld, t)] += 1
+        n_docs = max(stacked.n_max * stacked.S, 1)
+        scored = []
+        for (fld, term), f in tf.items():
+            if f < self.min_term_freq:
+                continue
+            df = stacked.global_df.get((fld, term), 0)
+            if df < self.min_doc_freq:
+                continue
+            idf = math.log(1 + (n_docs - df + 0.5) / (df + 0.5))
+            scored.append((f * idf, fld, term))
+        scored.sort(key=lambda x: (-x[0], x[1], x[2]))
+        return [(fld, term) for _, fld, term in scored[: self.max_query_terms]]
+
+    def prepare(self, pack):
+        stacked = getattr(pack, "stacked", None)
+        if stacked is None:
+            # bare ShardPack (e.g. percolate matcher): single-shard view
+            class _One:
+                shards = [pack]
+                global_df = {k: int(pack.term_df[v])
+                             for k, v in pack.term_dict.items()}
+                n_max = pack.num_docs
+                S = 1
+
+            stacked = _One()
+        if self._inner is None:
+            selected = self._select_terms(stacked)
+            if not selected:
+                from .nodes import MatchNoneNode
+
+                self._inner = MatchNoneNode()
+            else:
+                msm = self.minimum_should_match
+                if isinstance(msm, str) and msm.endswith("%"):
+                    msm_n = max(1, int(len(selected) * int(msm[:-1]) / 100))
+                else:
+                    msm_n = int(msm)
+                self._inner = BoolNode(
+                    should=[TermNode(f, t) for f, t in selected],
+                    minimum_should_match=msm_n, boost=self.boost,
+                )
+        return self._inner.prepare(pack)
+
+    def device_eval(self, dev, params, ctx):
+        return self._inner.device_eval(dev, params, ctx)
+
+
+def parse_more_like_this(body, mappings) -> MoreLikeThisNode:
+    fields = body.get("fields")
+    if not fields:
+        fields = sorted(f for f, ft in mappings.fields.items() if ft.type == "text")
+    likes = body.get("like")
+    if likes is None:
+        raise QueryParsingError("[more_like_this] requires [like]")
+    if not isinstance(likes, list):
+        likes = [likes]
+    texts, ids = [], []
+    for like in likes:
+        if isinstance(like, str):
+            texts.append(like)
+        elif isinstance(like, dict) and "_id" in like:
+            ids.append(like["_id"])
+        else:
+            raise QueryParsingError(f"cannot parse [like] entry {like!r}")
+    unlikes = body.get("unlike") or []
+    if not isinstance(unlikes, list):
+        unlikes = [unlikes]
+    return MoreLikeThisNode(
+        fields=list(fields), like_texts=texts, like_ids=ids,
+        unlike_texts=[u for u in unlikes if isinstance(u, str)],
+        mappings=mappings,
+        max_query_terms=int(body.get("max_query_terms", 25)),
+        min_term_freq=int(body.get("min_term_freq", 2)),
+        min_doc_freq=int(body.get("min_doc_freq", 5)),
+        minimum_should_match=body.get("minimum_should_match", "30%"),
+        boost=float(body.get("boost", 1.0)),
+    )
+
+
+# ---- terms_set ------------------------------------------------------------
+
+@dataclass
+class TermsSetNode(QueryNode):
+    fld: str = ""
+    terms: list = dc_field(default_factory=list)
+    msm_field: str = ""
+    boost: float = 1.0
+    _nodes: list = dc_field(default_factory=list)
+
+    def prepare(self, pack):
+        self._nodes = [TermNode(self.fld, t) for t in self.terms]
+        parts = [n.prepare(pack) for n in self._nodes]
+        return (
+            tuple(p for p, _ in parts), np.float32(self.boost),
+        ), ("terms_set", self.fld, tuple(k for _, k in parts), self.msm_field)
+
+    def device_eval(self, dev, params, ctx):
+        childs, boost = params
+        n1 = ctx.num_docs + 1
+        total = jnp.zeros(n1, jnp.float32)
+        cnt = jnp.zeros(n1, jnp.int32)
+        for node, p in zip(self._nodes, childs):
+            s, m = node.device_eval(dev, p, ctx)
+            total = total + jnp.where(m, s, 0.0)
+            cnt = cnt + m.astype(jnp.int32)
+        got = dev["dv_int"].get(self.msm_field)
+        if got is None:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        msm_v, msm_h = got
+        n = ctx.num_docs
+        required = jnp.where(msm_h, msm_v, 2**31 - 1).astype(jnp.int32)
+        ok_n = (cnt[:n] >= required) & (cnt[:n] > 0)
+        match = jnp.zeros(n1, bool).at[:n].set(ok_n)
+        return jnp.where(match, boost * total, 0.0), match
+
+
+# ---- rank_feature ---------------------------------------------------------
+
+@dataclass
+class RankFeatureNode(QueryNode):
+    fld: str = ""
+    mode: str = "saturation"  # saturation | log | sigmoid | linear
+    pivot: float | None = None
+    exponent: float = 1.0
+    scaling_factor: float = 1.0
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        if self.pivot is None and self.mode in ("saturation", "sigmoid"):
+            # default pivot: approximate mean of the feature (the reference
+            # uses a stored geometric mean; the column mean is the analog)
+            col = pack.docvalues.get(self.fld)
+            vals = None
+            if col is not None and col.kind == "float" and col.has_value.any():
+                vals = col.values[col.has_value]
+            self.pivot = float(np.mean(vals)) if vals is not None else 1.0
+        return (), ("rank_feature", self.fld, self.mode, self.pivot,
+                    self.exponent, self.scaling_factor, self.boost)
+
+    def device_eval(self, dev, params, ctx):
+        n1 = ctx.num_docs + 1
+        got = dev["dv_float"].get(self.fld)
+        if got is None:
+            return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+        v, h = got
+        n = ctx.num_docs
+        x = jnp.maximum(v[:n].astype(jnp.float32), 0.0)
+        if self.mode == "saturation":
+            s = x / (x + jnp.float32(self.pivot))
+        elif self.mode == "log":
+            s = jnp.log(jnp.float32(self.scaling_factor) + x)
+        elif self.mode == "sigmoid":
+            xp = x ** jnp.float32(self.exponent)
+            s = xp / (xp + jnp.float32(self.pivot) ** jnp.float32(self.exponent))
+        else:  # linear
+            s = x
+        match = jnp.zeros(n1, bool).at[:n].set(h[:n])
+        score = jnp.zeros(n1, jnp.float32).at[:n].set(
+            jnp.where(h[:n], self.boost * s, 0.0))
+        return score, match
+
+
+# ---- distance_feature -----------------------------------------------------
+
+@dataclass
+class DistanceFeatureNode(QueryNode):
+    fld: str = ""
+    kind: str = "numeric"  # numeric (date) | geo
+    origin: float = 0.0
+    origin_lat: float = 0.0
+    origin_lon: float = 0.0
+    pivot: float = 1.0
+    boost: float = 1.0
+
+    def prepare(self, pack):
+        return (), ("distance_feature", self.fld, self.kind, self.origin,
+                    self.origin_lat, self.origin_lon, self.pivot, self.boost)
+
+    def device_eval(self, dev, params, ctx):
+        n1 = ctx.num_docs + 1
+        n = ctx.num_docs
+        if self.kind == "geo":
+            from .geo import EARTH_RADIUS_M, _geo_cols
+
+            got = _geo_cols(dev, self.fld, ctx)
+            if got is None:
+                return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+            lat, h, lon = got
+            la1 = jnp.deg2rad(lat[:n])
+            lo1 = jnp.deg2rad(lon[:n])
+            la2 = math.radians(self.origin_lat)
+            lo2 = math.radians(self.origin_lon)
+            a = (jnp.sin((la1 - la2) / 2) ** 2
+                 + jnp.cos(la1) * math.cos(la2) * jnp.sin((lo1 - lo2) / 2) ** 2)
+            dist = 2.0 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
+            h = h[:n]
+        else:
+            got = dev["dv_int"].get(self.fld) or dev["dv_float"].get(self.fld)
+            if got is None:
+                return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
+            v, h0 = got
+            dist = jnp.abs(v[:n].astype(jnp.float32) - jnp.float32(self.origin))
+            h = h0[:n]
+        s = jnp.float32(self.pivot) / (jnp.float32(self.pivot) + dist)
+        match = jnp.zeros(n1, bool).at[:n].set(h)
+        score = jnp.zeros(n1, jnp.float32).at[:n].set(
+            jnp.where(h, self.boost * s, 0.0))
+        return score, match
+
+
+# ---- pinned ---------------------------------------------------------------
+
+@dataclass
+class PinnedNode(QueryNode):
+    ids: list = dc_field(default_factory=list)
+    organic: QueryNode = None
+
+    def prepare(self, pack):
+        from .nodes import _pad_rows  # noqa: F401 - parity with other nodes
+
+        col = pack.docvalues.get("_id") if hasattr(pack, "docvalues") else None
+        real = getattr(pack, "pack", pack)
+        col = real.docvalues.get("_id")
+        matched = []
+        ranks = []
+        if col is not None and col.ord_terms:
+            ord_of = {t: i for i, t in enumerate(col.ord_terms)}
+            id_ords = col.values
+            for rank, want in enumerate(self.ids):
+                o = ord_of.get(str(want))
+                if o is None:
+                    continue
+                hits = np.flatnonzero(id_ords == o)
+                for d in hits:
+                    matched.append(int(d))
+                    ranks.append(rank)
+        width = max(1, 1 << max(0, (len(matched) - 1)).bit_length()) if matched else 1
+        ids = np.full(width, -1, np.int32)
+        rks = np.zeros(width, np.float32)
+        ids[: len(matched)] = matched
+        rks[: len(matched)] = ranks
+        op, ok = self.organic.prepare(pack)
+        return (ids, rks, op), ("pinned", width, ok)
+
+    def device_eval(self, dev, params, ctx):
+        ids, ranks, op = params
+        n1 = ctx.num_docs + 1
+        os_, om = self.organic.device_eval(dev, op, ctx)
+        # pinned docs score above any organic BM25 score, ordered by list
+        # position (reference behavior: PinnedQueryBuilder MAX_ORGANIC_SCORE)
+        tgt = jnp.where(ids >= 0, ids, ctx.num_docs)
+        # rank step must exceed the f32 ulp at the pin base (~1.4e11)
+        pin_score = jnp.float32(1.7e18) - ranks * jnp.float32(1e12)
+        scores = jnp.where(om, os_, 0.0)
+        scores = scores.at[tgt].set(jnp.where(ids >= 0, pin_score, scores[tgt]))
+        match = om.at[tgt].set((ids >= 0) | om[tgt])
+        match = match.at[ctx.num_docs].set(False)
+        return scores, match
+
+
+# ---- parsers --------------------------------------------------------------
+
+def parse_terms_set(body, mappings) -> TermsSetNode:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[terms_set] expects {field: {...}}")
+    (fld, spec), = body.items()
+    terms = spec.get("terms")
+    msm_field = spec.get("minimum_should_match_field")
+    if not isinstance(terms, list) or not msm_field:
+        raise QueryParsingError(
+            "[terms_set] requires [terms] and [minimum_should_match_field]")
+    return TermsSetNode(fld=fld, terms=[str(t) for t in terms],
+                        msm_field=msm_field,
+                        boost=float(spec.get("boost", 1.0)))
+
+
+def parse_combined_fields(body, mappings) -> QueryNode:
+    text = body.get("query")
+    fields = body.get("fields")
+    if text is None or not fields:
+        raise QueryParsingError("[combined_fields] requires [query] and [fields]")
+    from .dsl import _parse_match
+
+    operator = body.get("operator", "or")
+    children = [
+        _parse_match({f.split("^")[0]: {"query": text, "operator": operator}},
+                     mappings)
+        for f in fields
+    ]
+    return BoolNode(should=children, minimum_should_match=1,
+                    boost=float(body.get("boost", 1.0)))
+
+
+def parse_rank_feature(body, mappings) -> RankFeatureNode:
+    fld = body.get("field")
+    if not fld:
+        raise QueryParsingError("[rank_feature] requires [field]")
+    mode = "saturation"
+    pivot = None
+    exponent = 1.0
+    scaling = 1.0
+    for m in ("saturation", "log", "sigmoid", "linear"):
+        if m in body:
+            mode = m
+            spec = body[m] or {}
+            pivot = spec.get("pivot")
+            exponent = float(spec.get("exponent", 1.0))
+            scaling = float(spec.get("scaling_factor", 1.0))
+    return RankFeatureNode(fld=fld, mode=mode,
+                           pivot=float(pivot) if pivot is not None else None,
+                           exponent=exponent, scaling_factor=scaling,
+                           boost=float(body.get("boost", 1.0)))
+
+
+def parse_distance_feature(body, mappings) -> DistanceFeatureNode:
+    fld = body.get("field")
+    origin = body.get("origin")
+    pivot = body.get("pivot")
+    if fld is None or origin is None or pivot is None:
+        raise QueryParsingError(
+            "[distance_feature] requires [field], [origin] and [pivot]")
+    ft = mappings.fields.get(fld)
+    if ft is not None and ft.type == "geo_point":
+        from ..index.pack import _parse_geo_point
+        from .geo import parse_distance_meters
+
+        lat, lon = _parse_geo_point(origin)
+        return DistanceFeatureNode(
+            fld=fld, kind="geo", origin_lat=lat, origin_lon=lon,
+            pivot=parse_distance_meters(pivot),
+            boost=float(body.get("boost", 1.0)))
+    if ft is not None and ft.type == "date":
+        from ..utils.durations import parse_duration_millis
+
+        return DistanceFeatureNode(
+            fld=fld, kind="numeric",
+            origin=float(parse_date_to_millis(origin)),
+            pivot=float(parse_duration_millis(pivot)),
+            boost=float(body.get("boost", 1.0)))
+    return DistanceFeatureNode(fld=fld, kind="numeric", origin=float(origin),
+                               pivot=float(pivot),
+                               boost=float(body.get("boost", 1.0)))
+
+
+def parse_pinned(body, mappings) -> PinnedNode:
+    ids = body.get("ids")
+    organic = body.get("organic")
+    if not isinstance(ids, list) or organic is None:
+        raise QueryParsingError("[pinned] requires [ids] and [organic]")
+    from .dsl import parse_query
+
+    return PinnedNode(ids=[str(i) for i in ids],
+                      organic=parse_query(organic, mappings))
+
+
+def parse_wrapper(body, mappings) -> QueryNode:
+    raw = body.get("query")
+    if not raw:
+        raise QueryParsingError("[wrapper] requires base64 [query]")
+    from .dsl import parse_query
+
+    try:
+        inner = json.loads(base64.b64decode(raw))
+    except Exception as ex:  # noqa: BLE001
+        raise QueryParsingError(f"failed to decode wrapper query: {ex}")
+    return parse_query(inner, mappings)

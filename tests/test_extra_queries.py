@@ -1,0 +1,128 @@
+"""Long-tail queries: MLT, terms_set, combined_fields, rank_feature,
+distance_feature, pinned, wrapper."""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("art", {"properties": {
+        "title": {"type": "text"}, "body": {"type": "text"},
+        "tags": {"type": "keyword"}, "pagerank": {"type": "rank_feature"},
+        "published": {"type": "date"}, "codes": {"type": "keyword"},
+        "required_matches": {"type": "integer"},
+    }})
+    idx = e.indices["art"]
+    docs = [
+        ("1", {"title": "jax on tpus", "body": "jax compiles numpy programs for tpus and gpus using xla",
+               "pagerank": 10.0, "published": 1700000000000,
+               "codes": ["a", "b"], "required_matches": 2}),
+        ("2", {"title": "pallas kernels", "body": "pallas writes custom tpu kernels inside jax programs",
+               "pagerank": 50.0, "published": 1700086400000,
+               "codes": ["a"], "required_matches": 1}),
+        ("3", {"title": "cooking pasta", "body": "boil water add salt cook pasta drain and serve",
+               "pagerank": 1.0, "published": 1600000000000,
+               "codes": ["c"], "required_matches": 1}),
+        ("4", {"title": "tpu programs", "body": "xla programs run fast on tpu hardware with jax",
+               "pagerank": 5.0, "published": 1700172800000,
+               "codes": ["a", "b", "c"], "required_matches": 3}),
+    ]
+    for i, src in docs:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e, idx
+
+
+def test_more_like_this():
+    e, idx = _engine()
+    r = idx.search(query={"more_like_this": {
+        "fields": ["body"], "like": [{"_id": "1"}],
+        "min_term_freq": 1, "min_doc_freq": 2,
+        "minimum_should_match": "30%"}}, size=10)
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    # docs about jax/tpu/xla rank above pasta (which can only match via
+    # incidental terms like "and")
+    assert set(ids) >= {"2", "4"}
+    if "3" in ids:
+        assert ids.index("3") == len(ids) - 1
+    # like raw text
+    r = idx.search(query={"more_like_this": {
+        "fields": ["body"], "like": "custom tpu kernels with jax",
+        "min_term_freq": 1, "min_doc_freq": 1,
+        "minimum_should_match": 1}}, size=10)
+    assert r["hits"]["hits"][0]["_id"] == "2"
+
+
+def test_terms_set():
+    e, idx = _engine()
+    # codes is multi-valued keyword: doc matches when it has at least
+    # required_matches of [a, b, c]... (first-value columns: doc stores all
+    # postings, so term matches count per posting)
+    r = idx.search(query={"terms_set": {"codes": {
+        "terms": ["a", "b", "c"],
+        "minimum_should_match_field": "required_matches"}}}, size=10)
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    # doc1 needs 2, has a+b -> yes; doc2 needs 1, has a -> yes;
+    # doc3 needs 1, has c -> yes; doc4 needs 3, has a+b+c -> yes
+    assert ids == {"1", "2", "3", "4"}
+    r = idx.search(query={"terms_set": {"codes": {
+        "terms": ["a", "b"],
+        "minimum_should_match_field": "required_matches"}}}, size=10)
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    # doc4 needs 3 but only a,b in the terms list -> out; doc3 needs 1 has none
+    assert ids == {"1", "2"}
+
+
+def test_combined_fields():
+    e, idx = _engine()
+    r = idx.search(query={"combined_fields": {
+        "query": "pasta kernels", "fields": ["title", "body"]}}, size=10)
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {"2", "3"}
+
+
+def test_rank_feature_modes():
+    e, idx = _engine()
+    r = idx.search(query={"rank_feature": {"field": "pagerank",
+                                           "saturation": {"pivot": 10}}}, size=10)
+    assert [h["_id"] for h in r["hits"]["hits"]][0] == "2"  # pagerank 50
+    scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert scores["2"] == pytest.approx(50 / 60)
+    assert scores["1"] == pytest.approx(10 / 20)
+    r = idx.search(query={"rank_feature": {"field": "pagerank",
+                                           "log": {"scaling_factor": 1}}}, size=10)
+    assert [h["_id"] for h in r["hits"]["hits"]][0] == "2"
+
+
+def test_distance_feature_date():
+    e, idx = _engine()
+    r = idx.search(query={"bool": {
+        "must": [{"match": {"body": "tpu"}}],
+        "should": [{"distance_feature": {
+            "field": "published", "origin": 1700172800000, "pivot": "1d"}}],
+    }}, size=10)
+    # doc4 is at the origin date -> biggest boost among tpu docs
+    assert r["hits"]["hits"][0]["_id"] == "4"
+
+
+def test_pinned_query():
+    e, idx = _engine()
+    r = idx.search(query={"pinned": {
+        "ids": ["3", "1"],
+        "organic": {"match": {"body": "tpu"}}}}, size=10)
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids[0] == "3" and ids[1] == "1"  # pinned order, above organic
+    assert set(ids[2:]) == {"2", "4"}
+
+
+def test_wrapper_query():
+    e, idx = _engine()
+    inner = base64.b64encode(json.dumps(
+        {"match": {"body": "pasta"}}).encode()).decode()
+    r = idx.search(query={"wrapper": {"query": inner}}, size=10)
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
