@@ -487,3 +487,39 @@ def cat_templates(engine) -> list[dict]:
          "composed_of": str(t.get("composed_of", []))}
         for name, t in sorted(templates.items())
     ]
+
+
+def cat_allocation(engine) -> list[dict]:
+    total = sum(_index_store_bytes(i) for i in engine.indices.values())
+    shards = sum(i.num_shards for i in engine.indices.values())
+    return [{"shards": shards, "disk.indices": f"{total}b",
+             "disk.used": "-", "disk.avail": "-", "disk.percent": "-",
+             "host": "127.0.0.1", "ip": "127.0.0.1", "node": engine.tasks.node}]
+
+
+def cat_master(engine) -> list[dict]:
+    return [{"id": engine.tasks.node, "host": "127.0.0.1",
+             "ip": "127.0.0.1", "node": engine.tasks.node}]
+
+
+def cat_recovery(engine) -> list[dict]:
+    out = []
+    for name, idx in sorted(engine.indices.items()):
+        for s in range(idx.num_shards):
+            out.append({"index": name, "shard": s, "time": "0ms",
+                        "type": "empty_store", "stage": "done",
+                        "source_node": "-", "target_node": engine.tasks.node,
+                        "files_percent": "100.0%", "bytes_percent": "100.0%"})
+    return out
+
+
+def cat_plugins(engine) -> list[dict]:
+    return [
+        {"name": engine.tasks.node, "component": comp, "version": "8.14.0"}
+        for comp in ("analysis-common", "data-streams", "ingest-common",
+                     "lang-expression", "mapper-extras", "percolator",
+                     "rank-eval", "reindex", "transform", "x-pack-ccr",
+                     "x-pack-ilm", "x-pack-security", "x-pack-slm",
+                     "x-pack-watcher", "x-pack-enrich", "x-pack-esql",
+                     "x-pack-sql", "x-pack-eql", "x-pack-async-search")
+    ]

@@ -229,8 +229,19 @@ class EsIndex:
 
     # ---- CRUD ------------------------------------------------------------
 
+    def _check_writable(self):
+        from ..utils.errors import ClusterBlockError, IndexClosedError
+
+        if self.settings.get("closed"):
+            raise IndexClosedError(f"closed index [{self.name}]")
+        if self.settings.get("blocks.write") or self.settings.get("blocks.read_only"):
+            raise ClusterBlockError(
+                f"index [{self.name}] blocked by: [FORBIDDEN/8/index write (api)]"
+            )
+
     def index_doc(self, doc_id: str | None, source: dict, op_type: str = "index",
                   if_seq_no: int | None = None, if_primary_term: int | None = None):
+        self._check_writable()
         if doc_id is None:
             doc_id = _auto_id()
             op_type = "create"
@@ -272,6 +283,7 @@ class EsIndex:
                 "result": "created" if created else "updated"}
 
     def delete_doc(self, doc_id: str):
+        self._check_writable()
         e = self.docs.get(doc_id)
         if e is None or not e.alive:
             raise DocumentMissingError(f"[{doc_id}]: document missing", index=self.name)
@@ -796,7 +808,17 @@ class Engine:
         targets = self.meta.search_targets(
             expression, list(self.indices), ignore_unavailable, allow_no_indices
         )
-        return [(self.get_index(n), f) for n, f in targets]
+        out = []
+        for n, f in targets:
+            idx = self.get_index(n)
+            if idx.settings.get("closed"):
+                from ..utils.errors import IndexClosedError
+
+                if expression in (None, "", "_all", "*") or "*" in str(expression):
+                    continue  # wildcards skip closed indices (ES default)
+                raise IndexClosedError(f"closed index [{n}]")
+            out.append((idx, f))
+        return out
 
     def get_or_autocreate(self, name: str) -> EsIndex:
         """Auto-create on first write, like the reference's
@@ -1316,6 +1338,8 @@ class Engine:
         dest = body.get("dest") or {}
         if not source.get("index") or not dest.get("index"):
             raise IllegalArgumentError("reindex requires source.index and dest.index")
+        if source.get("remote"):
+            return self._reindex_from_remote(source, dest, body, t0)
         max_docs = body.get("max_docs")
         us = UpdateScript(body["script"]) if body.get("script") else None
         op_type = dest.get("op_type", "index")
@@ -1363,6 +1387,43 @@ class Engine:
             "timed_out": False, "total": total, "created": created,
             "updated": updated, "deleted": 0, "batches": 1 if total else 0,
             "version_conflicts": conflicts, "noops": noops,
+            "retries": {"bulk": 0, "search": 0}, "failures": [],
+        }
+
+    def _reindex_from_remote(self, source: dict, dest: dict, body: dict, t0) -> dict:
+        """Reindex from a remote cluster over HTTP (reference behavior:
+        modules/reindex remote reindex via the low-level REST client)."""
+        import urllib.request
+
+        host = source["remote"].get("host")
+        if not host:
+            raise IllegalArgumentError("source.remote requires [host]")
+        if not host.startswith("http"):
+            host = f"http://{host}"
+        req_body = {"size": min(int(body.get("max_docs") or 10000), 10000)}
+        if source.get("query") is not None:
+            req_body["query"] = source["query"]
+        req = urllib.request.Request(
+            f"{host}/{source['index']}/_search",
+            data=json.dumps(req_body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        dst = self.get_or_autocreate(dest["index"])
+        created = 0
+        updated = 0
+        for h in out["hits"]["hits"]:
+            r = dst.index_doc(h["_id"], h["_source"])
+            if r["result"] == "created":
+                created += 1
+            else:
+                updated += 1
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False, "total": created + updated,
+            "created": created, "updated": updated, "deleted": 0,
+            "batches": 1, "version_conflicts": 0, "noops": 0,
             "retries": {"bulk": 0, "search": 0}, "failures": [],
         }
 
@@ -1421,6 +1482,50 @@ class Engine:
             "indices": [i.name for i, _ in targets],
             "fields": caps,
         }
+
+    def close_index(self, name: str) -> dict:
+        """POST /{index}/_close (reference behavior:
+        MetadataIndexStateService — closed indices reject reads/writes but
+        keep their data)."""
+        idx = self.get_index(name)
+        idx.settings["closed"] = True
+        idx._persist_meta()
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": {name: {"closed": True}}}
+
+    def open_index(self, name: str) -> dict:
+        idx = self.get_index(name)
+        idx.settings.pop("closed", None)
+        idx._persist_meta()
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def add_block(self, name: str, block: str) -> dict:
+        if block not in ("write", "read_only", "read", "metadata"):
+            raise IllegalArgumentError(f"unknown block [{block}]")
+        idx = self.get_index(name)
+        idx.settings[f"blocks.{block}"] = True
+        idx._persist_meta()
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": [{"name": name, "blocked": True}]}
+
+    def clone_index(self, source: str, target: str) -> dict:
+        """POST /{index}/_clone/{target} (reference behavior:
+        TransportResizeAction — requires a write block on the source)."""
+        src = self.get_index(source)
+        if not (src.settings.get("blocks.write") or src.settings.get("blocks.read_only")):
+            raise IllegalArgumentError(
+                f"index [{source}] must be read-only to clone (add a write block)"
+            )
+        if target in self.indices:
+            raise IndexAlreadyExistsError(target)
+        settings = {k: v for k, v in src.settings.items()
+                    if not k.startswith("blocks.") and k not in ("closed", "creation_date")}
+        self.create_index(target, mappings=src.mappings.to_dict(), settings=settings)
+        dst = self.indices[target]
+        for doc_id, e in src.docs.items():
+            if e.alive:
+                dst.index_doc(doc_id, e.source)
+        return {"acknowledged": True, "shards_acknowledged": True, "index": target}
 
     def suggest_multi(self, expression, body: dict) -> dict:
         """Suggest over an index expression; single concrete target only

@@ -176,6 +176,64 @@ def _parse_match_phrase(body, mappings):
     )
 
 
+def _parse_match_phrase_prefix(body, mappings):
+    """match_phrase_prefix: phrase whose last term is a prefix (reference
+    behavior: MatchPhrasePrefixQueryBuilder — last position expands to up to
+    max_expansions terms; here the expansion happens against the field's
+    term dictionary at prepare time via a dis_max of full phrases)."""
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[match_phrase_prefix] query expects {field: ...}")
+    (fld, spec), = body.items()
+    if not isinstance(spec, dict):
+        spec = {"query": spec}
+    text = str(spec.get("query", ""))
+    boost = float(spec.get("boost", 1.0))
+    max_exp = int(spec.get("max_expansions", 50))
+    ft = mappings.fields.get(fld)
+    if ft is None or ft.type not in TEXT_TYPES:
+        return _parse_prefix({fld: {"value": text.lower()}}, mappings)
+    analyzer = ft.get_search_analyzer()
+    toks = analyzer.analyze(text)
+    if not toks:
+        return MatchNoneNode()
+    if len(toks) == 1:
+        return _parse_prefix({fld: {"value": toks[0].term, "boost": boost}}, mappings)
+    from .prefix_phrase import PhrasePrefixNode
+
+    return PhrasePrefixNode(
+        fld=fld,
+        terms=[(t.term, t.position) for t in toks[:-1]],
+        prefix=toks[-1].term,
+        prefix_position=toks[-1].position,
+        max_expansions=max_exp,
+        boost=boost,
+    )
+
+
+def _parse_match_bool_prefix(body, mappings):
+    """match_bool_prefix: bool-should of terms + a prefix on the last
+    (reference behavior: MatchBoolPrefixQueryBuilder)."""
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[match_bool_prefix] query expects {field: ...}")
+    (fld, spec), = body.items()
+    if not isinstance(spec, dict):
+        spec = {"query": spec}
+    text = str(spec.get("query", ""))
+    boost = float(spec.get("boost", 1.0))
+    ft = mappings.fields.get(fld)
+    analyzer = ft.get_search_analyzer() if ft else None
+    if analyzer is None:
+        from ..analysis import get_analyzer as _ga
+
+        analyzer = _ga("standard")
+    terms = [t.term for t in analyzer.analyze(text)]
+    if not terms:
+        return MatchNoneNode()
+    clauses = [TermNode(fld, t) for t in terms[:-1]]
+    clauses.append(_parse_prefix({fld: {"value": terms[-1]}}, mappings))
+    return BoolNode(should=clauses, minimum_should_match=1, boost=boost)
+
+
 def _parse_term(body, mappings):
     if not isinstance(body, dict) or len(body) != 1:
         raise QueryParsingError("[term] query expects {field: value}")
@@ -541,6 +599,8 @@ def _parse_script_filter(body, mappings):
 _PARSERS = {
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "match_bool_prefix": _parse_match_bool_prefix,
     "multi_match": _parse_multi_match,
     "match_all": _parse_match_all,
     "match_none": _parse_match_none,

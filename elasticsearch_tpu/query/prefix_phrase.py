@@ -1,0 +1,58 @@
+"""match_phrase_prefix execution: phrase with an expanded last term.
+
+The last term expands against the field's term dictionary at prepare time
+(bounded by max_expansions, like the reference's MultiPhrasePrefixQuery);
+the node evaluates the per-expansion phrases on device and takes the best
+score per doc (dis_max semantics over complete phrases)."""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field as dc_field
+
+import jax.numpy as jnp
+
+from .nodes import DisMaxNode, MatchNoneNode, PhraseNode, QueryNode
+
+
+@dataclass
+class PhrasePrefixNode(QueryNode):
+    fld: str = ""
+    terms: list = dc_field(default_factory=list)  # [(term, position)] head
+    prefix: str = ""
+    prefix_position: int = 0
+    max_expansions: int = 50
+    boost: float = 1.0
+    _inner: QueryNode | None = None
+
+    def prepare(self, pack):
+        # expansions must be GLOBAL so every shard's traced program has the
+        # same structure (stacked shard params stack leaf-wise)
+        stacked = getattr(pack, "stacked", None)
+        if stacked is not None:
+            all_terms = sorted({
+                t for p in stacked.shards for t in p.terms_for_field(self.fld)
+            })
+        else:
+            all_terms = getattr(pack, "pack", pack).terms_for_field(self.fld)
+        lo = bisect.bisect_left(all_terms, self.prefix)
+        expansions = []
+        for i in range(lo, len(all_terms)):
+            if not all_terms[i].startswith(self.prefix):
+                break
+            expansions.append(all_terms[i])
+            if len(expansions) >= self.max_expansions:
+                break
+        if not expansions:
+            self._inner = MatchNoneNode()
+        else:
+            self._inner = DisMaxNode(children=[
+                PhraseNode(self.fld, self.terms + [(t, self.prefix_position)],
+                           boost=self.boost)
+                for t in expansions
+            ])
+        params, key = self._inner.prepare(pack)
+        return params, ("phrase_prefix", self.fld, key)
+
+    def device_eval(self, dev, params, ctx):
+        return self._inner.device_eval(dev, params, ctx)

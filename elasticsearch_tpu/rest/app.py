@@ -398,6 +398,76 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- index state / resize --------------------------------------------
+
+    @handler
+    async def close_index_api(request):
+        return web.json_response(await call(
+            engine.close_index, request.match_info["index"]))
+
+    @handler
+    async def open_index_api(request):
+        return web.json_response(await call(
+            engine.open_index, request.match_info["index"]))
+
+    @handler
+    async def add_block_api(request):
+        return web.json_response(await call(
+            engine.add_block, request.match_info["index"],
+            request.match_info["block"]))
+
+    @handler
+    async def clone_index_api(request):
+        return web.json_response(await call(
+            engine.clone_index, request.match_info["index"],
+            request.match_info["target"]))
+
+    @handler
+    async def msearch_template(request):
+        from ..search.templates import resolve_template
+
+        raw = (await request.read()).decode("utf-8")
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        responses = []
+        for i in range(0, len(lines) - 1, 2):
+            header = json.loads(lines[i])
+            tpl = json.loads(lines[i + 1])
+            try:
+                _, parsed = resolve_template(engine.meta, tpl)
+                res = await _run_search(
+                    header.get("index") or request.match_info.get("index"),
+                    parsed, {})
+                responses.append({**res, "status": 200})
+            except ElasticsearchTpuError as ex:
+                responses.append({**ex.to_dict(), "status": ex.status})
+        return web.json_response({"took": 1, "responses": responses})
+
+    @handler
+    async def mtermvectors(request):
+        from ..engine import admin
+
+        body = await body_json(request, {}) or {}
+        docs = body.get("docs") or []
+        default_index = request.match_info.get("index")
+        out = []
+        for d in docs:
+            out.append(await call(
+                admin.termvectors, engine, d.get("_index", default_index),
+                d["_id"], d, None))
+        return web.json_response({"docs": out})
+
+    @handler
+    async def cluster_allocation_explain(request):
+        return web.json_response({
+            "note": "every shard is assigned on this node",
+            "can_allocate": "yes",
+            "allocate_explanation": "single-node engine: shards colocate with packs",
+        })
+
+    @handler
+    async def cluster_pending_tasks(request):
+        return web.json_response({"tasks": []})
+
     # ---- CCR / SLM / Watcher / Enrich / health ---------------------------
 
     def _xcall(mod_name, fn_name, *args):
@@ -994,6 +1064,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     )
     cat_aliases_api = _cat_endpoint(lambda req: _admin.cat_aliases(engine))
     cat_templates_api = _cat_endpoint(lambda req: _admin.cat_templates(engine))
+    cat_allocation_api = _cat_endpoint(lambda req: _admin.cat_allocation(engine))
+    cat_master_api = _cat_endpoint(lambda req: _admin.cat_master(engine))
+    cat_recovery_api = _cat_endpoint(lambda req: _admin.cat_recovery(engine))
+    cat_plugins_api = _cat_endpoint(lambda req: _admin.cat_plugins(engine))
 
     # ---- task management -------------------------------------------------
 
@@ -1755,6 +1829,18 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_post("/{index}/_close", close_index_api)
+    app.router.add_post("/{index}/_open", open_index_api)
+    app.router.add_put("/{index}/_block/{block}", add_block_api)
+    app.router.add_post("/{index}/_clone/{target}", clone_index_api)
+    app.router.add_put("/{index}/_clone/{target}", clone_index_api)
+    app.router.add_route("*", "/_msearch/template", msearch_template)
+    app.router.add_route("*", "/{index}/_msearch/template", msearch_template)
+    app.router.add_route("*", "/_mtermvectors", mtermvectors)
+    app.router.add_route("*", "/{index}/_mtermvectors", mtermvectors)
+    app.router.add_get("/_cluster/allocation/explain", cluster_allocation_explain)
+    app.router.add_post("/_cluster/allocation/explain", cluster_allocation_explain)
+    app.router.add_get("/_cluster/pending_tasks", cluster_pending_tasks)
     app.router.add_get("/{index}/_changes", ccr_changes)
     app.router.add_put("/{index}/_ccr/follow", ccr_follow)
     app.router.add_post("/{index}/_ccr/pause_follow", ccr_pause)
@@ -1847,6 +1933,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/shards", cat_shards_api)
     app.router.add_get("/_cat/shards/{index}", cat_shards_api)
     app.router.add_get("/_cat/aliases", cat_aliases_api)
+    app.router.add_get("/_cat/allocation", cat_allocation_api)
+    app.router.add_get("/_cat/master", cat_master_api)
+    app.router.add_get("/_cat/recovery", cat_recovery_api)
+    app.router.add_get("/_cat/plugins", cat_plugins_api)
     app.router.add_get("/_cat/templates", cat_templates_api)
     app.router.add_get("/_tasks", tasks_list)
     app.router.add_get("/_tasks/{task_id}", tasks_get)

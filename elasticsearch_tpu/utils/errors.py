@@ -72,3 +72,13 @@ class ResourceNotFoundError(ElasticsearchTpuError):
 class ResourceAlreadyExistsError(ElasticsearchTpuError):
     status = 400
     type = "resource_already_exists_exception"
+
+
+class ClusterBlockError(ElasticsearchTpuError):
+    status = 403
+    es_type = "cluster_block_exception"
+
+
+class IndexClosedError(ElasticsearchTpuError):
+    status = 400
+    es_type = "index_closed_exception"

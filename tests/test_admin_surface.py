@@ -1,0 +1,144 @@
+"""open/close/blocks/clone, msearch/template, mtermvectors, phrase-prefix
+queries, reindex-from-remote, extra cat endpoints."""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import (
+    ClusterBlockError,
+    IllegalArgumentError,
+    IndexClosedError,
+)
+
+
+def test_close_open_blocks_clone():
+    e = Engine(None)
+    e.create_index("a", {"properties": {"t": {"type": "text"}}})
+    idx = e.indices["a"]
+    idx.index_doc("1", {"t": "hello world"})
+    idx.refresh()
+
+    e.close_index("a")
+    with pytest.raises(IndexClosedError):
+        idx.index_doc("2", {"t": "x"})
+    with pytest.raises(IndexClosedError):
+        e.search_multi("a", query={"match_all": {}})
+    # wildcards silently skip closed
+    assert e.resolve_search("*") == []
+    e.open_index("a")
+    assert e.search_multi("a", query={"match_all": {}})["hits"]["total"]["value"] == 1
+
+    # write block + clone
+    with pytest.raises(IllegalArgumentError):
+        e.clone_index("a", "b")  # needs write block first
+    e.add_block("a", "write")
+    with pytest.raises(ClusterBlockError):
+        idx.index_doc("2", {"t": "x"})
+    e.clone_index("a", "b")
+    e.indices["b"].refresh()
+    assert e.search_multi("b", query={"match": {"t": "hello"}})["hits"]["total"]["value"] == 1
+
+
+def test_match_phrase_prefix_and_bool_prefix():
+    e = Engine(None)
+    e.create_index("p", {"properties": {"t": {"type": "text"}}})
+    idx = e.indices["p"]
+    idx.index_doc("1", {"t": "quick brown fox"})
+    idx.index_doc("2", {"t": "quick brownie recipe"})
+    idx.index_doc("3", {"t": "brown quick reversed"})
+    idx.refresh()
+    r = idx.search(query={"match_phrase_prefix": {"t": "quick bro"}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    r = idx.search(query={"match_phrase_prefix": {"t": "quick brown"}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    r = idx.search(query={"match_bool_prefix": {"t": "reversed qu"}}, size=10)
+    assert "3" in {h["_id"] for h in r["hits"]["hits"]}
+    # single term -> plain prefix
+    r = idx.search(query={"match_phrase_prefix": {"t": "brow"}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2", "3"}
+
+
+async def _rest_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/d", json={"mappings": {"properties": {"t": {"type": "text"}}}})
+    await client.put("/d/_doc/1?refresh=true", json={"t": "alpha beta"})
+
+    # msearch/template
+    lines = [json.dumps({"index": "d"}),
+             json.dumps({"source": '{"query": {"match": {"t": "{{w}}"}}}',
+                         "params": {"w": "alpha"}})]
+    r = await client.post("/_msearch/template", data="\n".join(lines) + "\n",
+                          headers={"Content-Type": "application/x-ndjson"})
+    body = await r.json()
+    assert body["responses"][0]["hits"]["total"]["value"] == 1
+
+    # mtermvectors
+    r = await client.post("/_mtermvectors", json={"docs": [
+        {"_index": "d", "_id": "1"}]})
+    docs = (await r.json())["docs"]
+    assert docs[0]["found"] and "t" in docs[0]["term_vectors"]
+
+    # close/open via REST
+    r = await client.post("/d/_close")
+    assert (await r.json())["acknowledged"]
+    r = await client.post("/d/_search", json={})
+    assert r.status == 400
+    await client.post("/d/_open")
+    r = await client.post("/d/_search", json={})
+    assert r.status == 200
+
+    # cat endpoints
+    for path in ("/_cat/allocation", "/_cat/master", "/_cat/recovery",
+                 "/_cat/plugins"):
+        r = await client.get(path)
+        assert r.status == 200
+    r = await client.get("/_cluster/pending_tasks")
+    assert (await r.json())["tasks"] == []
+    await client.close()
+
+
+def test_admin_rest_surface():
+    asyncio.run(_rest_drive())
+
+
+async def _remote_reindex_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    remote = make_app()
+    rc = TestClient(TestServer(remote))
+    await rc.start_server()
+    await rc.put("/src", json={"mappings": {"properties": {"v": {"type": "integer"}}}})
+    for i in range(4):
+        await rc.put(f"/src/_doc/{i}?refresh=true", json={"v": i})
+    port = rc.server.port
+
+    local = make_app()
+    lc = TestClient(TestServer(local))
+    await lc.start_server()
+    r = await lc.post("/_reindex", json={
+        "source": {"index": "src", "remote": {"host": f"127.0.0.1:{port}"},
+                   "query": {"range": {"v": {"gte": 1}}}},
+        "dest": {"index": "copied"},
+    })
+    body = await r.json()
+    assert body["created"] == 3
+    le = local["engine"]
+    le.indices["copied"].refresh()
+    assert le.search_multi("copied", query={"match_all": {}})["hits"]["total"]["value"] == 3
+    await lc.close()
+    await rc.close()
+
+
+def test_reindex_from_remote():
+    asyncio.run(_remote_reindex_drive())
