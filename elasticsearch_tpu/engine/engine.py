@@ -241,6 +241,7 @@ class EsIndex:
 
     def index_doc(self, doc_id: str | None, source: dict, op_type: str = "index",
                   if_seq_no: int | None = None, if_primary_term: int | None = None):
+        _t_index0 = time.monotonic()
         self._check_writable()
         if doc_id is None:
             doc_id = _auto_id()
@@ -278,6 +279,13 @@ class EsIndex:
             self._persist_meta()  # dynamic mappings grew
         self._dirty = True
         self.counters["index_total"] = self.counters.get("index_total", 0) + 1
+        if "indexing.slowlog.threshold.index.warn" in self.settings or any(
+                k.startswith("indexing.slowlog") for k in self.settings):
+            from ..telemetry import record_indexing_slowlog
+
+            record_indexing_slowlog(
+                self.name, self.settings,
+                (time.monotonic() - _t_index0) * 1000, doc_id)
         created = existing is None or not existing.alive
         return {"_id": doc_id, "_version": version, "_seq_no": seq,
                 "result": "created" if created else "updated"}
@@ -419,6 +427,33 @@ class EsIndex:
     ):
         self._maybe_refresh()
         self.counters["query_total"] = self.counters.get("query_total", 0) + 1
+        from ..telemetry import TRACER, record_search_slowlog
+
+        _t_search0 = time.monotonic()
+        _trace_ctx = TRACER.span("executeQueryPhase", index=self.name)
+        _trace_span = _trace_ctx.__enter__()
+        try:
+            return self._search_inner(
+                query=query, size=size, from_=from_, aggs=aggs, knn=knn,
+                sort=sort, search_after=search_after,
+                script_fields=script_fields, collapse=collapse,
+                rescore=rescore, runtime_mappings=runtime_mappings,
+            )
+        finally:
+            _trace_ctx.__exit__(None, None, None)
+            took_ms = (time.monotonic() - _t_search0) * 1000
+            self.counters["query_time_ms"] = (
+                self.counters.get("query_time_ms", 0) + int(took_ms))
+            record_search_slowlog(
+                self.name, self.settings, took_ms,
+                json.dumps(query)[:512] if query is not None else "{}",
+            )
+
+    def _search_inner(
+        self, query=None, size=10, from_=0, aggs=None, knn=None,
+        sort=None, search_after=None, script_fields=None,
+        collapse=None, rescore=None, runtime_mappings=None,
+    ):
         if collapse is not None and rescore is not None:
             raise IllegalArgumentError("cannot use [collapse] in conjunction with [rescore]")
         m_eff = None

@@ -38,6 +38,19 @@ def _err_response(ex: Exception) -> web.Response:
 
 
 @web.middleware
+async def _warnings_middleware(request: web.Request, handler):
+    """Deprecation warnings emitted during the request become RFC-7234
+    `Warning` response headers (HeaderWarning analog)."""
+    from ..telemetry import begin_request_warnings, drain_request_warnings, warning_header_value
+
+    begin_request_warnings()
+    resp = await handler(request)
+    for msg in drain_request_warnings():
+        resp.headers.add("Warning", warning_header_value(msg))
+    return resp
+
+
+@web.middleware
 async def _security_middleware(request: web.Request, handler):
     engine = request.app["engine"]
     sec = engine.security
@@ -63,7 +76,8 @@ async def _security_middleware(request: web.Request, handler):
 def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.Application:
     engine = engine or Engine(data_path)
     app = web.Application(
-        client_max_size=512 * 1024 * 1024, middlewares=[_security_middleware]
+        client_max_size=512 * 1024 * 1024,
+        middlewares=[_warnings_middleware, _security_middleware],
     )
     app["engine"] = engine
     # single-thread executor: serializes engine mutation, keeps the loop free
@@ -397,6 +411,73 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response({"acknowledged": True})
 
     # ---- admin / observability -------------------------------------------
+
+    # ---- legacy index templates (deprecated API) -------------------------
+
+    _LEGACY_TPL_WARNING = (
+        "Legacy index templates are deprecated in favor of composable "
+        "templates."
+    )
+
+    @handler
+    async def legacy_put_template(request):
+        from ..telemetry import add_deprecation_warning
+
+        add_deprecation_warning(_LEGACY_TPL_WARNING)
+        body = await body_json(request, {}) or {}
+        name = request.match_info["name"]
+        tpl = {
+            "index_patterns": body.get("index_patterns") or [],
+            "priority": int(body.get("order", 0)),
+            "template": {
+                "settings": body.get("settings") or {},
+                "mappings": body.get("mappings") or {},
+                "aliases": body.get("aliases") or {},
+            },
+            "_legacy": True,
+        }
+        engine.meta.index_templates[name] = tpl
+        engine.meta.save()
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def legacy_get_template(request):
+        from ..telemetry import add_deprecation_warning
+
+        add_deprecation_warning(_LEGACY_TPL_WARNING)
+        name = request.match_info.get("name")
+        out = {}
+        for n, t in engine.meta.index_templates.items():
+            if not t.get("_legacy"):
+                continue
+            if name and n != name:
+                continue
+            body = t.get("template") or {}
+            out[n] = {"index_patterns": t.get("index_patterns", []),
+                      "order": t.get("priority", 0),
+                      "settings": body.get("settings", {}),
+                      "mappings": body.get("mappings", {}),
+                      "aliases": body.get("aliases", {})}
+        if name and not out:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"index_template [{name}] missing")
+        return web.json_response(out)
+
+    @handler
+    async def legacy_delete_template(request):
+        from ..telemetry import add_deprecation_warning
+
+        add_deprecation_warning(_LEGACY_TPL_WARNING)
+        name = request.match_info["name"]
+        t = engine.meta.index_templates.get(name)
+        if t is None or not t.get("_legacy"):
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"index_template [{name}] missing")
+        del engine.meta.index_templates[name]
+        engine.meta.save()
+        return web.json_response({"acknowledged": True})
 
     # ---- index state / resize --------------------------------------------
 
@@ -1829,6 +1910,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_put("/_template/{name}", legacy_put_template)
+    app.router.add_post("/_template/{name}", legacy_put_template)
+    app.router.add_get("/_template", legacy_get_template)
+    app.router.add_get("/_template/{name}", legacy_get_template)
+    app.router.add_delete("/_template/{name}", legacy_delete_template)
     app.router.add_post("/{index}/_close", close_index_api)
     app.router.add_post("/{index}/_open", open_index_api)
     app.router.add_put("/{index}/_block/{block}", add_block_api)

@@ -1,0 +1,142 @@
+"""Telemetry: tracing spans, slow logs, deprecation warnings.
+
+Parity targets (reference): telemetry/tracing/Tracer.java:33 (OTel-API
+abstraction; spans started around search phases, SearchService.java:677),
+index/SearchSlowLog.java + IndexingSlowLog.java (per-index thresholds,
+dedicated loggers), common/logging/HeaderWarning.java (deprecation warnings
+returned as RFC-7234 `Warning` response headers and logged once)."""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+log = logging.getLogger("elasticsearch_tpu")
+slowlog_search = logging.getLogger("elasticsearch_tpu.slowlog.search")
+slowlog_index = logging.getLogger("elasticsearch_tpu.slowlog.index")
+deprecation_log = logging.getLogger("elasticsearch_tpu.deprecation")
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float | None = None
+    attributes: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.monotonic()) - self.start) * 1000
+
+
+class Tracer:
+    """In-memory tracer: spans nest via a context variable; the last
+    `keep` root spans are retained for inspection (the APM exporter of the
+    reference maps to a log/OTLP sink here)."""
+
+    def __init__(self, keep: int = 256):
+        self.finished: deque[Span] = deque(maxlen=keep)
+        self._current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+            "current_span", default=None)
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        s = Span(name=name, start=time.monotonic(), attributes=dict(attributes))
+        parent = self._current.get()
+        token = self._current.set(s)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+            self._current.reset(token)
+            if parent is not None:
+                parent.children.append(s)
+            else:
+                self.finished.append(s)
+                log.debug("span %s %.2fms %s", name, s.duration_ms, s.attributes)
+
+
+TRACER = Tracer()
+
+
+# ---- slow logs ------------------------------------------------------------
+
+_LEVELS = (("warn", logging.WARNING), ("info", logging.INFO),
+           ("debug", logging.DEBUG), ("trace", 5))
+
+SLOWLOG_KEEP = 128
+recent_slowlogs: deque[dict] = deque(maxlen=SLOWLOG_KEEP)
+
+
+def _threshold_ms(settings: dict, prefix: str, level: str):
+    from .utils.durations import parse_duration_seconds
+
+    raw = settings.get(f"{prefix}.{level}")
+    if raw is None:
+        return None
+    sec = parse_duration_seconds(raw, None)
+    return None if sec is None else sec * 1000
+
+
+def record_search_slowlog(index_name: str, settings: dict, took_ms: float,
+                          query_desc: str):
+    """Log at the highest matching threshold (reference behavior:
+    SearchSlowLog — one record per phase at the matched level)."""
+    for level, py_level in _LEVELS:
+        t = _threshold_ms(settings, "search.slowlog.threshold.query", level)
+        if t is not None and took_ms >= t:
+            entry = {"index": index_name, "took_ms": round(took_ms, 3),
+                     "level": level, "source": query_desc, "kind": "search"}
+            recent_slowlogs.append(entry)
+            slowlog_search.log(py_level,
+                               "[%s] took[%dms], source[%s]",
+                               index_name, took_ms, query_desc)
+            return
+
+
+def record_indexing_slowlog(index_name: str, settings: dict, took_ms: float,
+                            doc_id: str):
+    for level, py_level in _LEVELS:
+        t = _threshold_ms(settings, "indexing.slowlog.threshold.index", level)
+        if t is not None and took_ms >= t:
+            entry = {"index": index_name, "took_ms": round(took_ms, 3),
+                     "level": level, "id": doc_id, "kind": "indexing"}
+            recent_slowlogs.append(entry)
+            slowlog_index.log(py_level, "[%s] took[%dms], id[%s]",
+                              index_name, took_ms, doc_id)
+            return
+
+
+# ---- deprecation warnings -------------------------------------------------
+
+_request_warnings: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "deprecation_warnings", default=None)
+
+
+def begin_request_warnings() -> None:
+    _request_warnings.set([])
+
+
+def add_deprecation_warning(message: str) -> None:
+    """Collect a warning for the in-flight REST request and log it
+    (HeaderWarning.addWarning analog)."""
+    deprecation_log.warning(message)
+    bucket = _request_warnings.get()
+    if bucket is not None and message not in bucket:
+        bucket.append(message)
+
+
+def drain_request_warnings() -> list[str]:
+    out = _request_warnings.get() or []
+    _request_warnings.set(None)
+    return out
+
+
+def warning_header_value(message: str) -> str:
+    # RFC 7234 warn-code 299 (miscellaneous persistent warning), as ES emits
+    return f'299 Elasticsearch-tpu "{message}"'

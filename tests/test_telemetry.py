@@ -1,0 +1,77 @@
+"""Tracing spans, slow logs, deprecation warning headers, legacy templates."""
+
+import asyncio
+import json
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu import telemetry
+
+
+def test_search_tracing_spans():
+    e = Engine(None)
+    e.create_index("t", {"properties": {"x": {"type": "text"}}})
+    idx = e.indices["t"]
+    idx.index_doc("1", {"x": "hello"})
+    idx.refresh()
+    before = len(telemetry.TRACER.finished)
+    idx.search(query={"match": {"x": "hello"}})
+    spans = list(telemetry.TRACER.finished)[before:]
+    assert any(s.name == "executeQueryPhase" and s.attributes.get("index") == "t"
+               for s in spans)
+    assert all(s.end is not None for s in spans)
+
+
+def test_search_slowlog_threshold():
+    e = Engine(None)
+    e.create_index("s", {"properties": {"x": {"type": "text"}}},
+                   settings={"search.slowlog.threshold.query.warn": "0ms"})
+    idx = e.indices["s"]
+    idx.index_doc("1", {"x": "hello"})
+    idx.refresh()
+    telemetry.recent_slowlogs.clear()
+    idx.search(query={"match": {"x": "hello"}})
+    entries = [r for r in telemetry.recent_slowlogs if r["index"] == "s"]
+    assert entries and entries[-1]["level"] == "warn"
+    assert "hello" in entries[-1]["source"]
+
+
+def test_indexing_slowlog():
+    e = Engine(None)
+    e.create_index("w", {"properties": {"x": {"type": "integer"}}},
+                   settings={"indexing.slowlog.threshold.index.info": "0ms"})
+    telemetry.recent_slowlogs.clear()
+    e.indices["w"].index_doc("7", {"x": 1})
+    entries = [r for r in telemetry.recent_slowlogs if r["kind"] == "indexing"]
+    assert entries and entries[-1]["id"] == "7"
+
+
+async def _legacy_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.put("/_template/old-style", json={
+        "index_patterns": ["legacy-*"], "order": 5,
+        "mappings": {"properties": {"f": {"type": "keyword"}}}})
+    assert r.status == 200
+    warnings = r.headers.getall("Warning", [])
+    assert warnings and "deprecated" in warnings[0]
+    # template applies to matching index creation (shares the v2 registry)
+    await client.put("/legacy-1/_doc/1?refresh=true", json={"f": "x"})
+    r = await client.get("/legacy-1/_mapping")
+    body = await r.json()
+    assert body["legacy-1"]["mappings"]["properties"]["f"]["type"] == "keyword"
+    r = await client.get("/_template/old-style")
+    assert (await r.json())["old-style"]["order"] == 5
+    r = await client.delete("/_template/old-style")
+    assert (await r.json())["acknowledged"]
+    r = await client.get("/_template/old-style")
+    assert r.status == 404
+    await client.close()
+
+
+def test_legacy_templates_with_deprecation_header():
+    asyncio.run(_legacy_drive())
