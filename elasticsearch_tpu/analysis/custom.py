@@ -231,7 +231,10 @@ def _make_filter(name: str, spec: dict):
         # "a, b => c" replaces; "a, b, c" expands to all
         replace: dict[str, list[str]] = {}
         expand: dict[str, list[str]] = {}
-        for rule in spec.get("synonyms", []):
+        rules = spec.get("synonyms", [])
+        if not rules and spec.get("_resolved_set"):
+            rules = spec["_resolved_set"]
+        for rule in rules:
             if "=>" in rule:
                 lhs, rhs = rule.split("=>", 1)
                 targets = [x.strip().lower() for x in rhs.split(",") if x.strip()]

@@ -815,6 +815,16 @@ class Engine:
                 parse_query(props["filter"], m)
         settings = dict(settings or {})
         settings.setdefault("creation_date", int(time.time() * 1000))
+        # resolve named synonym sets (PUT /_synonyms/{set}) into the
+        # analyzer filter specs before the index builds its registry
+        for fspec in ((settings.get("analysis") or {}).get("filter") or {}).values():
+            if isinstance(fspec, dict) and fspec.get("synonyms_set"):
+                rules = self.meta.extras.get("synonym_sets", {}).get(
+                    fspec["synonyms_set"])
+                if rules is None:
+                    raise IllegalArgumentError(
+                        f"synonyms set [{fspec['synonyms_set']}] not found")
+                fspec["_resolved_set"] = list(rules)
         idx = EsIndex(name, m, settings, self._dir_for(name),
                       breaker_account=self._pack_accounter(name))
         self.indices[name] = idx

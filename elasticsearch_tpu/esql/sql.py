@@ -25,6 +25,40 @@ _SQL_RE = re.compile(
 _AGG_FNS = ("count", "sum", "avg", "min", "max", "median")
 
 
+def _meta_command(engine, query: str) -> dict | None:
+    """SHOW TABLES / DESCRIBE <table> (reference behavior: x-pack sql
+    SysTables/SysColumns commands)."""
+    q = query.strip().rstrip(";").strip()
+    m = re.match(r"^show\s+tables$", q, re.IGNORECASE)
+    if m:
+        rows = [["elasticsearch-tpu", name, "TABLE", "INDEX"]
+                for name in sorted(engine.indices)]
+        return {"columns": [
+            {"name": "catalog", "type": "keyword"},
+            {"name": "name", "type": "keyword"},
+            {"name": "type", "type": "keyword"},
+            {"name": "kind", "type": "keyword"},
+        ], "rows": rows}
+    m = re.match(r"^(?:describe|desc)\s+([\w.\-]+)$", q, re.IGNORECASE)
+    if m:
+        idx = engine.get_index(m.group(1))
+        rows = []
+        for fname, ft in sorted(idx.mappings.fields.items()):
+            sql_type = {
+                "text": "TEXT", "keyword": "VARCHAR", "long": "BIGINT",
+                "integer": "INTEGER", "short": "SMALLINT", "byte": "TINYINT",
+                "double": "DOUBLE", "float": "REAL", "half_float": "REAL",
+                "date": "TIMESTAMP", "boolean": "BOOLEAN",
+            }.get(ft.type, ft.type.upper())
+            rows.append([fname, sql_type, ft.type])
+        return {"columns": [
+            {"name": "column", "type": "keyword"},
+            {"name": "type", "type": "keyword"},
+            {"name": "mapping", "type": "keyword"},
+        ], "rows": rows}
+    return None
+
+
 def _split_commas(s: str) -> list[str]:
     out, depth, buf = [], 0, []
     for ch in s:
@@ -81,6 +115,9 @@ def sql_query(engine, body: dict) -> dict:
     query = (body or {}).get("query")
     if not isinstance(query, str):
         raise IllegalArgumentError("[query] string is required")
+    meta = _meta_command(engine, query)
+    if meta is not None:
+        return meta
     m = _SQL_RE.match(query)
     if m is None:
         raise IllegalArgumentError(f"cannot parse SQL [{query}]")

@@ -412,6 +412,80 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    # ---- graph / synonyms / recovery -------------------------------------
+
+    @handler
+    async def graph_explore(request):
+        from ..xpack.graph import explore
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            explore, engine, request.match_info["index"], body))
+
+    @handler
+    async def put_synonyms(request):
+        """PUT /_synonyms/{set}: named synonym sets usable by synonym token
+        filters via "synonyms_set" (reference behavior: synonyms API +
+        ReloadableCustomAnalyzer — here analyzers resolve sets lazily)."""
+        body = await body_json(request, {}) or {}
+        rules = body.get("synonyms_set")
+        if not isinstance(rules, list):
+            raise IllegalArgumentError("[synonyms_set] list is required")
+        engine.meta.extras.setdefault("synonym_sets", {})[
+            request.match_info["set"]] = [
+            r["synonyms"] if isinstance(r, dict) else str(r) for r in rules
+        ]
+        engine.meta.save()
+        return web.json_response({"result": "created"})
+
+    @handler
+    async def get_synonyms(request):
+        sets = engine.meta.extras.get("synonym_sets", {})
+        name = request.match_info.get("set")
+        if name:
+            if name not in sets:
+                from ..utils.errors import ResourceNotFoundError
+
+                raise ResourceNotFoundError(f"synonym set [{name}] not found")
+            return web.json_response({
+                "count": len(sets[name]),
+                "synonyms_set": [{"id": str(i), "synonyms": r}
+                                 for i, r in enumerate(sets[name])],
+            })
+        return web.json_response({"count": len(sets), "results": [
+            {"synonyms_set": n, "count": len(r)} for n, r in sorted(sets.items())
+        ]})
+
+    @handler
+    async def delete_synonyms(request):
+        sets = engine.meta.extras.get("synonym_sets", {})
+        name = request.match_info["set"]
+        if name not in sets:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"synonym set [{name}] not found")
+        del sets[name]
+        engine.meta.save()
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def index_recovery(request):
+        from ..engine import admin
+
+        out = {}
+        for idx, _ in engine.resolve_search(
+                request.match_info.get("index") or "_all", allow_no_indices=True):
+            out[idx.name] = {"shards": [
+                {"id": sh, "type": "EMPTY_STORE", "stage": "DONE",
+                 "primary": True,
+                 "source": {}, "target": {"name": engine.tasks.node},
+                 "index": {"size": {"total_in_bytes":
+                                    admin._index_store_bytes(idx)},
+                           "files": {"percent": "100.0%"}}}
+                for sh in range(idx.num_shards)
+            ]}
+        return web.json_response(out)
+
     # ---- legacy index templates (deprecated API) -------------------------
 
     _LEGACY_TPL_WARNING = (
@@ -1910,6 +1984,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_post("/{index}/_graph/explore", graph_explore)
+    app.router.add_get("/{index}/_graph/explore", graph_explore)
+    app.router.add_put("/_synonyms/{set}", put_synonyms)
+    app.router.add_get("/_synonyms", get_synonyms)
+    app.router.add_get("/_synonyms/{set}", get_synonyms)
+    app.router.add_delete("/_synonyms/{set}", delete_synonyms)
+    app.router.add_get("/_recovery", index_recovery)
+    app.router.add_get("/{index}/_recovery", index_recovery)
     app.router.add_put("/_template/{name}", legacy_put_template)
     app.router.add_post("/_template/{name}", legacy_put_template)
     app.router.add_get("/_template", legacy_get_template)

@@ -1,0 +1,83 @@
+"""Graph explore: significant-term vertices + co-occurrence connections.
+
+Parity target: x-pack/plugin/graph (reference behavior:
+TransportGraphExploreAction — seed-query docs vote for vertex terms;
+connections weight by shared-document counts; breadth-first hops)."""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+
+from ..utils.errors import IllegalArgumentError
+
+
+def _doc_terms(src: dict, field: str) -> list:
+    cur = src
+    for part in field.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return []
+    if cur is None:
+        return []
+    return cur if isinstance(cur, list) else [cur]
+
+
+def explore(engine, index_expr: str, body: dict) -> dict:
+    body = body or {}
+    query = body.get("query") or {"match_all": {}}
+    vertices_spec = body.get("vertices") or []
+    if not vertices_spec:
+        raise IllegalArgumentError("[graph] requires [vertices]")
+    controls = body.get("controls") or {}
+    sample_size = int(controls.get("sample_size", 100))
+
+    # seed docs: top sample_size by relevance
+    res = engine.search_multi(index_expr, query=query, size=sample_size)
+    hits = res["hits"]["hits"]
+
+    vertices = []
+    vertex_index: dict[tuple[str, str], int] = {}
+    per_doc_vertices: list[list[int]] = []
+    for spec in vertices_spec:
+        field = spec.get("field")
+        if not field:
+            raise IllegalArgumentError("graph vertex requires [field]")
+        size = int(spec.get("size", 5))
+        min_doc_count = int(spec.get("min_doc_count", 3))
+        counts: Counter = Counter()
+        for h in hits:
+            for term in set(map(str, _doc_terms(h["_source"], field))):
+                counts[term] += 1
+        for term, c in counts.most_common(size):
+            if c < min_doc_count:
+                continue
+            vertex_index[(field, term)] = len(vertices)
+            vertices.append({
+                "field": field, "term": term, "weight": c / max(len(hits), 1),
+                "depth": 0,
+            })
+    for h in hits:
+        mine = []
+        for (field, term), vi in vertex_index.items():
+            if term in set(map(str, _doc_terms(h["_source"], field))):
+                mine.append(vi)
+        per_doc_vertices.append(mine)
+
+    # connections: vertex pairs sharing documents
+    pair_counts: defaultdict = defaultdict(int)
+    for mine in per_doc_vertices:
+        for i in range(len(mine)):
+            for j in range(i + 1, len(mine)):
+                a, b = sorted((mine[i], mine[j]))
+                pair_counts[(a, b)] += 1
+    connections = [
+        {"source": a, "target": b, "weight": c / max(len(hits), 1),
+         "doc_count": c}
+        for (a, b), c in sorted(pair_counts.items(), key=lambda kv: -kv[1])
+    ]
+    return {
+        "took": 0, "timed_out": False,
+        "vertices": vertices,
+        "connections": connections,
+    }

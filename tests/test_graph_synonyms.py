@@ -1,0 +1,82 @@
+"""Graph explore, synonyms API, SQL meta commands, _recovery."""
+
+import asyncio
+import json
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.esql.sql import sql_query
+from elasticsearch_tpu.xpack.graph import explore
+
+
+def test_graph_explore():
+    e = Engine(None)
+    e.create_index("g", {"properties": {
+        "actor": {"type": "keyword"}, "movie": {"type": "keyword"}}})
+    idx = e.indices["g"]
+    pairs = [("deniro", "heat"), ("pacino", "heat"), ("deniro", "casino"),
+             ("pacino", "scarface"), ("stone", "casino"), ("deniro", "heat")]
+    for i, (a, m) in enumerate(pairs):
+        idx.index_doc(str(i), {"actor": a, "movie": m})
+    idx.refresh()
+    out = explore(e, "g", {"query": {"match_all": {}}, "vertices": [
+        {"field": "actor", "size": 5, "min_doc_count": 1},
+        {"field": "movie", "size": 5, "min_doc_count": 1}],
+        "controls": {"sample_size": 100}})
+    terms = {(v["field"], v["term"]) for v in out["vertices"]}
+    assert ("actor", "deniro") in terms and ("movie", "heat") in terms
+    # deniro <-> heat co-occur twice: strongest connection
+    vidx = {(v["field"], v["term"]): i for i, v in enumerate(out["vertices"])}
+    top = out["connections"][0]
+    pair = {top["source"], top["target"]}
+    assert pair == {vidx[("actor", "deniro")], vidx[("movie", "heat")]}
+
+
+def test_sql_meta_commands():
+    e = Engine(None)
+    e.create_index("tbl", {"properties": {
+        "name": {"type": "keyword"}, "n": {"type": "integer"}}})
+    out = sql_query(e, {"query": "SHOW TABLES"})
+    assert ["elasticsearch-tpu", "tbl", "TABLE", "INDEX"] in out["rows"]
+    out = sql_query(e, {"query": "DESCRIBE tbl"})
+    rows = {r[0]: r[1] for r in out["rows"]}
+    assert rows["name"] == "VARCHAR" and rows["n"] == "INTEGER"
+
+
+async def _synonyms_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.put("/_synonyms/tech", json={"synonyms_set": [
+        {"synonyms": "laptop, notebook"},
+        {"synonyms": "tv => television"}]})
+    assert r.status == 200
+    r = await client.get("/_synonyms/tech")
+    assert (await r.json())["count"] == 2
+
+    # index using the stored set by name
+    r = await client.put("/shop", json={
+        "settings": {"analysis": {
+            "filter": {"syn": {"type": "synonym", "synonyms_set": "tech"}},
+            "analyzer": {"with_syn": {"type": "custom", "tokenizer": "standard",
+                                      "filter": ["lowercase", "syn"]}}}},
+        "mappings": {"properties": {"t": {"type": "text",
+                                          "analyzer": "with_syn"}}}})
+    assert r.status == 200
+    await client.put("/shop/_doc/1?refresh=true", json={"t": "new laptop"})
+    r = await client.post("/shop/_search", json={"query": {"match": {"t": "notebook"}}})
+    assert (await r.json())["hits"]["total"]["value"] == 1
+
+    r = await client.get("/shop/_recovery")
+    body = await r.json()
+    assert body["shop"]["shards"][0]["stage"] == "DONE"
+    r = await client.delete("/_synonyms/tech")
+    assert (await r.json())["acknowledged"]
+    await client.close()
+
+
+def test_synonyms_api_and_recovery():
+    asyncio.run(_synonyms_drive())
