@@ -279,8 +279,7 @@ class EsIndex:
             self._persist_meta()  # dynamic mappings grew
         self._dirty = True
         self.counters["index_total"] = self.counters.get("index_total", 0) + 1
-        if "indexing.slowlog.threshold.index.warn" in self.settings or any(
-                k.startswith("indexing.slowlog") for k in self.settings):
+        if any(k.startswith("indexing.slowlog") for k in self.settings):
             from ..telemetry import record_indexing_slowlog
 
             record_indexing_slowlog(
@@ -853,15 +852,23 @@ class Engine:
         targets = self.meta.search_targets(
             expression, list(self.indices), ignore_unavailable, allow_no_indices
         )
+        explicit = set()
+        if isinstance(expression, str):
+            explicit = {p for p in expression.split(",")
+                        if p and "*" not in p and "?" not in p}
+        elif isinstance(expression, (list, tuple)):
+            explicit = {p for p in expression if "*" not in p and "?" not in p}
         out = []
         for n, f in targets:
             idx = self.get_index(n)
             if idx.settings.get("closed"):
                 from ..utils.errors import IndexClosedError
 
-                if expression in (None, "", "_all", "*") or "*" in str(expression):
-                    continue  # wildcards skip closed indices (ES default)
-                raise IndexClosedError(f"closed index [{n}]")
+                if n in explicit:
+                    # a concretely named closed index is an error (ES default
+                    # forbid_closed_indices); wildcard matches skip silently
+                    raise IndexClosedError(f"closed index [{n}]")
+                continue
             out.append((idx, f))
         return out
 

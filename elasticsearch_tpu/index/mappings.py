@@ -204,6 +204,7 @@ class Mappings:
                 dims=spec.get("dims"),
                 similarity=spec.get("similarity", "cosine"),
             )
+            ft._registry = self.analysis_registry
             if ftype == "dense_vector" and not ft.dims:
                 raise MapperParsingError(f"dense_vector field [{full}] requires [dims]")
             if ftype == "dense_vector":
@@ -220,6 +221,7 @@ class Mappings:
                     analyzer=sub_spec.get("analyzer", "standard"),
                     ignore_above=sub_spec.get("ignore_above"),
                 )
+                sub._registry = self.analysis_registry
                 ft.fields[sub_name] = sub
                 self.fields[sub.name] = sub
             self.fields[full] = ft

@@ -295,9 +295,6 @@ class PinnedNode(QueryNode):
     organic: QueryNode = None
 
     def prepare(self, pack):
-        from .nodes import _pad_rows  # noqa: F401 - parity with other nodes
-
-        col = pack.docvalues.get("_id") if hasattr(pack, "docvalues") else None
         real = getattr(pack, "pack", pack)
         col = real.docvalues.get("_id")
         matched = []

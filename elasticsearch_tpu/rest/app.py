@@ -431,12 +431,32 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         rules = body.get("synonyms_set")
         if not isinstance(rules, list):
             raise IllegalArgumentError("[synonyms_set] list is required")
-        engine.meta.extras.setdefault("synonym_sets", {})[
-            request.match_info["set"]] = [
-            r["synonyms"] if isinstance(r, dict) else str(r) for r in rules
-        ]
+        set_name = request.match_info["set"]
+        resolved = [r["synonyms"] if isinstance(r, dict) else str(r)
+                    for r in rules]
+        created = set_name not in engine.meta.extras.get("synonym_sets", {})
+        engine.meta.extras.setdefault("synonym_sets", {})[set_name] = resolved
+
+        def reload_analyzers():
+            # push the new rules into every index whose analysis references
+            # the set (the reload-search-analyzers analog; documents indexed
+            # under the old rules keep them until reindex, as in ES)
+            from ..analysis.custom import build_analysis_registry
+
+            for idx in engine.indices.values():
+                analysis = idx.settings.get("analysis") or {}
+                touched = False
+                for fspec in (analysis.get("filter") or {}).values():
+                    if isinstance(fspec, dict) and fspec.get("synonyms_set") == set_name:
+                        fspec["_resolved_set"] = list(resolved)
+                        touched = True
+                if touched:
+                    idx.mappings.set_analysis(build_analysis_registry(analysis))
+                    idx._persist_meta()
+
+        await call(reload_analyzers)
         engine.meta.save()
-        return web.json_response({"result": "created"})
+        return web.json_response({"result": "created" if created else "updated"})
 
     @handler
     async def get_synonyms(request):
@@ -500,6 +520,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         add_deprecation_warning(_LEGACY_TPL_WARNING)
         body = await body_json(request, {}) or {}
         name = request.match_info["name"]
+        existing = engine.meta.index_templates.get(name)
+        if existing is not None and not existing.get("_legacy"):
+            raise IllegalArgumentError(
+                f"a composable index template [{name}] already exists; "
+                "legacy and composable templates cannot share a name"
+            )
         tpl = {
             "index_patterns": body.get("index_patterns") or [],
             "priority": int(body.get("order", 0)),
@@ -602,13 +628,25 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         from ..engine import admin
 
         body = await body_json(request, {}) or {}
-        docs = body.get("docs") or []
         default_index = request.match_info.get("index")
+        docs = body.get("docs")
+        if docs is None and body.get("ids"):
+            docs = [{"_id": i} for i in body["ids"]]
         out = []
-        for d in docs:
-            out.append(await call(
-                admin.termvectors, engine, d.get("_index", default_index),
-                d["_id"], d, None))
+        for d in docs or []:
+            index_name = d.get("_index", default_index)
+            doc_id = d.get("_id")
+            if not index_name or doc_id is None:
+                out.append({"_index": index_name, "_id": doc_id,
+                            "error": {"type": "illegal_argument_exception",
+                                      "reason": "[_index] and [_id] are required"}})
+                continue
+            try:
+                out.append(await call(
+                    admin.termvectors, engine, index_name, doc_id, d, None))
+            except ElasticsearchTpuError as ex:
+                out.append({"_index": index_name, "_id": doc_id,
+                            **ex.to_dict()})
         return web.json_response({"docs": out})
 
     @handler

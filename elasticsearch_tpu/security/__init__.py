@@ -25,12 +25,12 @@ from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError, Resource
 
 class AuthenticationError(ElasticsearchTpuError):
     status = 401
-    es_type = "security_exception"
+    type = "security_exception"
 
 
 class AuthorizationError(ElasticsearchTpuError):
     status = 403
-    es_type = "security_exception"
+    type = "security_exception"
 
 
 _PBKDF2_ITERS = 10000

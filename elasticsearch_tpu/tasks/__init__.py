@@ -22,7 +22,7 @@ from ..utils.errors import ElasticsearchTpuError, ResourceNotFoundError
 
 class TaskCancelledException(ElasticsearchTpuError):
     status = 400
-    es_type = "task_cancelled_exception"
+    type = "task_cancelled_exception"
 
 
 @dataclass

@@ -76,9 +76,9 @@ class ResourceAlreadyExistsError(ElasticsearchTpuError):
 
 class ClusterBlockError(ElasticsearchTpuError):
     status = 403
-    es_type = "cluster_block_exception"
+    type = "cluster_block_exception"
 
 
 class IndexClosedError(ElasticsearchTpuError):
     status = 400
-    es_type = "index_closed_exception"
+    type = "index_closed_exception"

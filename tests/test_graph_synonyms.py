@@ -80,3 +80,37 @@ async def _synonyms_drive():
 
 def test_synonyms_api_and_recovery():
     asyncio.run(_synonyms_drive())
+
+
+async def _synonym_reload_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/_synonyms/s1", json={"synonyms_set": [
+        {"synonyms": "car, auto"}]})
+    await client.put("/garage", json={
+        "settings": {"analysis": {
+            "filter": {"syn": {"type": "synonym", "synonyms_set": "s1"}},
+            "analyzer": {"a": {"type": "custom", "tokenizer": "standard",
+                               "filter": ["lowercase", "syn"]}}}},
+        "mappings": {"properties": {"t": {"type": "text",
+                                          "search_analyzer": "a",
+                                          "analyzer": "standard"}}}})
+    await client.put("/garage/_doc/1?refresh=true", json={"t": "bike"})
+    r = await client.post("/garage/_search", json={"query": {"match": {"t": "cycle"}}})
+    assert (await r.json())["hits"]["total"]["value"] == 0
+    # update the set: "cycle" now expands to "bike" at SEARCH time
+    r = await client.put("/_synonyms/s1", json={"synonyms_set": [
+        {"synonyms": "car, auto"}, {"synonyms": "bike, cycle"}]})
+    assert (await r.json())["result"] == "updated"
+    r = await client.post("/garage/_search", json={"query": {"match": {"t": "cycle"}}})
+    assert (await r.json())["hits"]["total"]["value"] == 1
+    await client.close()
+
+
+def test_synonym_set_update_reloads_search_analyzers():
+    asyncio.run(_synonym_reload_drive())
