@@ -628,6 +628,7 @@ _PARSERS = {
     "distance_feature": lambda body, m: _x("parse_distance_feature", body, m),
     "pinned": lambda body, m: _x("parse_pinned", body, m),
     "wrapper": lambda body, m: _x("parse_wrapper", body, m),
+    "intervals": lambda body, m: _parse_intervals_q(body, m),
     "nested": lambda body, m: _parse_nested_q(body, m),
     "geo_bounding_box": lambda body, m: _parse_geo_bbox(body, m),
     "geo_distance": lambda body, m: _parse_geo_dist(body, m),
@@ -646,6 +647,12 @@ def _parse_percolate(body, mappings):
     from .percolate import parse_percolate
 
     return parse_percolate(body, mappings)
+
+
+def _parse_intervals_q(body, mappings):
+    from .intervals import parse_intervals
+
+    return parse_intervals(body, mappings)
 
 
 def _parse_nested_q(body, mappings):

@@ -1,0 +1,154 @@
+"""Intervals query: proximity rules over term positions.
+
+Parity target: index/query/IntervalQueryBuilder.java (reference behavior:
+Lucene intervals — `match` with ordered/unordered + max_gaps, and
+`all_of`/`any_of` combinators). Positions come from the pack's host-side
+position keys (docid * POS_L + position, the same arrays the phrase kernel
+uses on device); interval window evaluation runs host-side per candidate doc
+at prepare time and feeds the device an explicit id set, so the clause
+composes like any other. Scoring is constant boost (interval queries score
+by slop in the reference — a documented simplification)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.pack import POS_L
+from ..utils.errors import QueryParsingError
+from .nodes import QueryNode
+
+
+def _term_positions_by_doc(pack, fld: str, term: str) -> dict[int, list[int]]:
+    """Decode one term's (docid -> sorted positions) from the blocked keys."""
+    s, nb, npos = pack.term_pos_blocks(fld, term)
+    if nb == 0 or pack.pos_keys is None:
+        return {}
+    keys = pack.pos_keys[s: s + nb].reshape(-1)[:npos]
+    out: dict[int, list[int]] = {}
+    for k in keys:
+        out.setdefault(int(k) // POS_L, []).append(int(k) % POS_L)
+    return out
+
+
+def _match_windows(pos_lists: list[list[int]], ordered: bool,
+                   max_gaps: int) -> bool:
+    """Does any assignment of one position per term fit in a window with at
+    most max_gaps interior gaps (window width <= n + max_gaps)?"""
+    n = len(pos_lists)
+    if any(not p for p in pos_lists):
+        return False
+    if n == 1:
+        return True
+    width_limit = n + max_gaps if max_gaps >= 0 else 1 << 30
+
+    if ordered:
+        return any(
+            _ordered_fits(pos_lists, start, width_limit)
+            for start in pos_lists[0]
+        )
+    # unordered: sliding window over the merged positions
+    events = sorted(
+        (p, i) for i, plist in enumerate(pos_lists) for p in plist
+    )
+    from collections import Counter
+
+    have: Counter = Counter()
+    j = 0
+    for i in range(len(events)):
+        have[events[i][1]] += 1
+        while events[i][0] - events[j][0] + 1 > width_limit:
+            have[events[j][1]] -= 1
+            if have[events[j][1]] == 0:
+                del have[events[j][1]]
+            j += 1
+        if len(have) == n:
+            return True
+    return False
+
+
+def _ordered_fits(pos_lists, start: int, width_limit: int) -> bool:
+    prev = start
+    for plist in pos_lists[1:]:
+        nxt = None
+        for p in plist:
+            if p > prev:
+                nxt = p
+                break
+        if nxt is None:
+            return False
+        prev = nxt
+    return prev - start + 1 <= width_limit
+
+
+@dataclass
+class IntervalsNode(QueryNode):
+    fld: str = ""
+    rule: dict = dc_field(default_factory=dict)
+    mappings: object = None
+    boost: float = 1.0
+
+    def _eval_rule(self, pack, rule: dict) -> set[int]:
+        (kind, spec), = rule.items()
+        if kind == "match":
+            ft = self.mappings.fields.get(self.fld)
+            analyzer = ft.get_search_analyzer() if ft else None
+            terms = ([t.term for t in analyzer.analyze(str(spec.get("query", "")))]
+                     if analyzer else str(spec.get("query", "")).split())
+            if not terms:
+                return set()
+            per_term = [_term_positions_by_doc(pack, self.fld, t) for t in terms]
+            docs = set(per_term[0])
+            for m in per_term[1:]:
+                docs &= set(m)
+            ordered = bool(spec.get("ordered", False))
+            max_gaps = int(spec.get("max_gaps", -1))
+            return {
+                d for d in docs
+                if _match_windows([m[d] for m in per_term], ordered, max_gaps)
+            }
+        if kind == "any_of":
+            out: set[int] = set()
+            for sub in spec.get("intervals", []):
+                out |= self._eval_rule(pack, sub)
+            return out
+        if kind == "all_of":
+            subs = spec.get("intervals", [])
+            if not subs:
+                return set()
+            out = self._eval_rule(pack, subs[0])
+            for sub in subs[1:]:
+                out &= self._eval_rule(pack, sub)
+            return out
+        raise QueryParsingError(f"unsupported intervals rule [{kind}]")
+
+    def prepare(self, pack):
+        real = getattr(pack, "pack", pack)
+        matched = sorted(self._eval_rule(real, self.rule))
+        width = 1 << max(0, (max(len(matched), 1) - 1)).bit_length()
+        ids = np.full(width, -1, np.int32)
+        ids[: len(matched)] = matched
+        return (ids, np.float32(self.boost)), ("intervals", self.fld, width)
+
+    def device_eval(self, dev, params, ctx):
+        ids, boost = params
+        n1 = ctx.num_docs + 1
+        tgt = jnp.where(ids >= 0, ids, ctx.num_docs)
+        match = jnp.zeros(n1, bool).at[tgt].set(ids >= 0)
+        match = match.at[ctx.num_docs].set(False)
+        return jnp.where(match, boost, 0.0), match
+
+
+def parse_intervals(body, mappings) -> IntervalsNode:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingError("[intervals] expects {field: {rule}}")
+    (fld, spec), = body.items()
+    boost = 1.0
+    spec = dict(spec)
+    if "boost" in spec:
+        boost = float(spec.pop("boost"))
+    if len(spec) != 1:
+        raise QueryParsingError("[intervals] expects exactly one rule")
+    return IntervalsNode(fld=fld, rule=spec, mappings=mappings, boost=boost)

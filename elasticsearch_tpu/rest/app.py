@@ -412,6 +412,24 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- admin / observability -------------------------------------------
 
+    @handler
+    async def knn_search_api(request):
+        """Deprecated 8.x _knn_search endpoint (knn now lives in _search)."""
+        from ..telemetry import add_deprecation_warning
+
+        add_deprecation_warning(
+            "The kNN search API has been replaced by the `knn` option in the "
+            "search API.")
+        body = await body_json(request, {}) or {}
+        knn = body.get("knn")
+        if not isinstance(knn, dict):
+            raise IllegalArgumentError("[knn] object is required")
+        return web.json_response(await _run_search(
+            request.match_info["index"],
+            {"knn": knn, "size": knn.get("k", 10),
+             "_source": body.get("_source"), "fields": body.get("fields")},
+            request.query))
+
     # ---- graph / synonyms / recovery -------------------------------------
 
     @handler
@@ -2022,6 +2040,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_scripts/{id}", put_stored_script)
     app.router.add_get("/_scripts/{id}", get_stored_script)
     app.router.add_delete("/_scripts/{id}", delete_stored_script)
+    app.router.add_route("*", "/{index}/_knn_search", knn_search_api)
     app.router.add_post("/{index}/_graph/explore", graph_explore)
     app.router.add_get("/{index}/_graph/explore", graph_explore)
     app.router.add_put("/_synonyms/{set}", put_synonyms)

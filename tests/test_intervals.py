@@ -1,0 +1,80 @@
+"""Intervals query: ordered/unordered windows, combinators; _knn_search."""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("iv", {"properties": {"t": {"type": "text"}}})
+    idx = e.indices["iv"]
+    docs = {
+        "1": "the quick brown fox jumps",
+        "2": "brown dog and a quick cat",
+        "3": "quick as a very very very brown thing",
+        "4": "unrelated words here",
+    }
+    for i, t in docs.items():
+        idx.index_doc(i, {"t": t})
+    idx.refresh()
+    return idx
+
+
+def test_intervals_match_ordered():
+    idx = _engine()
+    r = idx.search(query={"intervals": {"t": {"match": {
+        "query": "quick brown", "ordered": True, "max_gaps": 0}}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1"}
+    r = idx.search(query={"intervals": {"t": {"match": {
+        "query": "quick brown", "ordered": True, "max_gaps": 5}}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "3"}
+
+
+def test_intervals_match_unordered():
+    idx = _engine()
+    r = idx.search(query={"intervals": {"t": {"match": {
+        "query": "quick brown", "max_gaps": 3}}}}, size=10)
+    # doc2: brown .. quick within window (brown@0, quick@4 -> width 5 = 2+3)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    r = idx.search(query={"intervals": {"t": {"match": {
+        "query": "quick brown"}}}}, size=10)  # unlimited gaps
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2", "3"}
+
+
+def test_intervals_combinators():
+    idx = _engine()
+    r = idx.search(query={"intervals": {"t": {"any_of": {"intervals": [
+        {"match": {"query": "fox"}}, {"match": {"query": "cat"}}]}}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2"}
+    r = idx.search(query={"intervals": {"t": {"all_of": {"intervals": [
+        {"match": {"query": "quick"}}, {"match": {"query": "brown"}}]}}}}, size=10)
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"1", "2", "3"}
+
+
+async def _knn_drive():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest.app import make_app
+
+    app = make_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    await client.put("/v", json={"mappings": {"properties": {
+        "vec": {"type": "dense_vector", "dims": 2}}}})
+    for i, v in [("1", [1.0, 0.0]), ("2", [0.0, 1.0])]:
+        await client.put(f"/v/_doc/{i}?refresh=true", json={"vec": v})
+    r = await client.post("/v/_knn_search", json={"knn": {
+        "field": "vec", "query_vector": [1.0, 0.1], "k": 1,
+        "num_candidates": 2}})
+    body = await r.json()
+    assert body["hits"]["hits"][0]["_id"] == "1"
+    assert any("replaced" in w for w in r.headers.getall("Warning", []))
+    await client.close()
+
+
+def test_deprecated_knn_search_endpoint():
+    asyncio.run(_knn_drive())
