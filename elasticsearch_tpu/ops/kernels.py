@@ -223,8 +223,13 @@ def _scan_topk_pallas(
     return out_v[:B], out_i[:B], out_t[:B, 0]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("k", "transform", "count_positive")
+)
 def scan_topk_xla(q, mat_t, live, aux_doc, aux_q, *, k, transform, count_positive):
-    """XLA reference with identical semantics (and the non-TPU fast path)."""
+    """XLA reference with identical semantics (and the non-TPU fast path).
+    Jitted: callers outside a trace (e.g. the batched dense-only dispatch)
+    must not fall back to eager per-op execution."""
     dots = (
         jnp.matmul(q, mat_t, precision=jax.lax.Precision.HIGHEST)
         if q is not None
