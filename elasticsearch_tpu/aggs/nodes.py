@@ -424,6 +424,19 @@ class TermsAgg(AggNode):
             raise IllegalArgumentError(
                 f"terms[{self.fld}]: {nseg}x{V} buckets exceeds bucket budget"
             )
+        if self.fld in dev["dv_mv"] and not self.children:
+            # multi-valued keyword: count one bucket entry per (doc, value)
+            # pair (reference behavior: SortedSetDocValues iterate all ords).
+            # Sub-aggs keep the single-value path: the per-doc segment
+            # protocol cannot express multi-bucket membership (documented).
+            pdocs, pords = dev["dv_mv"][self.fld]
+            safe = jnp.where(pdocs >= 0, pdocs, 0)
+            pvalid = (pdocs >= 0) & valid[safe]
+            psub = seg[safe] * V + pords
+            counts = _seg_scatter(
+                psub, nseg * V, pvalid, jnp.ones_like(psub), jnp.int32(0), "add"
+            ).reshape(nseg, V)
+            return {"counts": counts, "children": {}}
         ords, h = _ordinal_column(dev, self.fld)
         ok = valid & h & (ords >= 0)
         sub = seg * V + ords

@@ -115,6 +115,11 @@ class DocValuesColumn:
     # column min/max over present values (static histogram bucket planning)
     vmin: float | int = 0
     vmax: float | int = 0
+    # multi-valued keyword support: (doc, ordinal) pairs covering EVERY
+    # value (the single-value arrays above keep first-value semantics for
+    # sort/collapse); None when no doc has >1 value
+    mv_pair_docs: np.ndarray | None = None  # [P] int32 sorted by doc
+    mv_pair_ords: np.ndarray | None = None  # [P] int32
 
 
 @dataclass
@@ -241,6 +246,7 @@ class PackBuilder:
         self.vector_raw: dict[str, list[tuple[int, list[float]]]] = {}
         self.completion_raw: dict[str, list[tuple[str, int, int]]] = {}
         self.percolator_raw: dict[str, list] = {}
+        self.mv_extra_raw: dict[str, list] = {}  # extra keyword values beyond the first
         self.num_docs = 0
         # C++ accumulator owns the per-token hot loop when available
         # (native/packing.cpp); dict fallback otherwise. Packs are
@@ -322,9 +328,14 @@ class PackBuilder:
                         fc[0] = docid
                         fc[1] += 1
                 if ft.doc_values and kept:
-                    # single-valued docvalues column; first value wins
-                    # (multi-valued ordinal CSR is a later milestone)
+                    # first value drives sort/collapse; ALL values feed the
+                    # multi-value pair arrays for terms/cardinality aggs
                     self.docvalue_raw.setdefault(fld, []).append((docid, kept[0]))
+                    if len(set(kept)) > 1:
+                        self.mv_extra_raw.setdefault(fld, []).extend(
+                            (docid, v) for v in sorted(set(kept))[1:]
+                            if v != kept[0]
+                        )
             elif t in INT_TYPES or t in DATE_TYPES or t in BOOL_TYPES:
                 if ft.doc_values and values:
                     self.docvalue_raw.setdefault(fld, []).append((docid, int(values[0])))
@@ -554,14 +565,24 @@ class PackBuilder:
                 ftype = mappings.fields[fld].type
             has = np.zeros(N, dtype=bool)
             if ftype in KEYWORD_TYPES:
-                terms_sorted = sorted({v for _, v in pairs})
+                extras = self.mv_extra_raw.get(fld, [])
+                terms_sorted = sorted({v for _, v in pairs}
+                                      | {v for _, v in extras})
                 ord_of = {t: i for i, t in enumerate(terms_sorted)}
                 vals = np.full(N, -1, dtype=np.int32)
                 for docid, v in pairs:
                     if not has[docid]:
                         vals[docid] = ord_of[v]
                         has[docid] = True
-                docvalues[fld] = DocValuesColumn("ord", vals, has, terms_sorted)
+                col = DocValuesColumn("ord", vals, has, terms_sorted)
+                if extras:
+                    all_pairs = sorted(
+                        {(docid, ord_of[v]) for docid, v in pairs if v in ord_of}
+                        | {(docid, ord_of[v]) for docid, v in extras}
+                    )
+                    col.mv_pair_docs = np.array([d for d, _ in all_pairs], np.int32)
+                    col.mv_pair_ords = np.array([o for _, o in all_pairs], np.int32)
+                docvalues[fld] = col
             elif ftype in FLOAT_TYPES:
                 vals = np.zeros(N, dtype=np.float32)
                 for docid, v in pairs:

@@ -81,6 +81,7 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         "dv_int": {},
         "dv_float": {},
         "dv_ord": {},
+        "dv_mv": {},
         "dv_int_ord": {},
         "live": put(sp.live),
         "vec": {},
@@ -92,6 +93,8 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         dev[key][f] = (put(vals), put(col.has_value))
         if col.uniq_ords is not None:
             dev["dv_int_ord"][f] = put(col.uniq_ords)
+        if col.mv_pair_docs is not None:
+            dev["dv_mv"][f] = (put(col.mv_pair_docs), put(col.mv_pair_ords))
     dev["vec_sq"] = {}
     dev["vec_ivf"] = {}
     for f, vc in sp.vectors.items():

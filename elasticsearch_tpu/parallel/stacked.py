@@ -135,6 +135,9 @@ class StackedPack:
             if kind == "ord":
                 terms = sorted({t for c in cols if c and c.ord_terms for t in c.ord_terms})
                 ord_of = {t: i for i, t in enumerate(terms)}
+                mv_any = any(c is not None and c.mv_pair_docs is not None
+                             for c in cols)
+                mv_docs_list, mv_ords_list = [], []
                 for p, c in zip(shards, cols):
                     v = np.full(self.n_max, -1, np.int32)
                     h = np.zeros(self.n_max, bool)
@@ -144,9 +147,31 @@ class StackedPack:
                         )
                         v[: p.num_docs] = remap[c.values]
                         h[: p.num_docs] = c.has_value
+                        if mv_any:
+                            if c.mv_pair_docs is not None:
+                                mv_docs_list.append(c.mv_pair_docs)
+                                mv_ords_list.append(remap[c.mv_pair_ords])
+                            else:
+                                # single-valued shard: its pairs are the
+                                # (doc, value) entries of the dense column
+                                sel = np.flatnonzero(c.has_value)
+                                mv_docs_list.append(sel.astype(np.int32))
+                                mv_ords_list.append(remap[c.values[sel]])
+                    elif mv_any:
+                        mv_docs_list.append(np.array([], np.int32))
+                        mv_ords_list.append(np.array([], np.int32))
                     vals.append(v)
                     has.append(h)
                 g = DocValuesColumn(kind, np.stack(vals), np.stack(has), terms)
+                if mv_any:
+                    pmax = max((len(d) for d in mv_docs_list), default=1) or 1
+                    sd = np.full((self.S, pmax), -1, np.int32)
+                    so = np.zeros((self.S, pmax), np.int32)
+                    for i, (d, o) in enumerate(zip(mv_docs_list, mv_ords_list)):
+                        sd[i, : len(d)] = d
+                        so[i, : len(o)] = o
+                    g.mv_pair_docs = sd
+                    g.mv_pair_ords = so
             else:
                 dtype = np.int64 if kind == "int" else np.float32
                 present_vals = [

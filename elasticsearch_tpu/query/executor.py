@@ -40,6 +40,7 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         "dv_int": {},
         "dv_float": {},
         "dv_ord": {},
+        "dv_mv": {},
         "live": put(pack.live),
         "vec": {},
         "vec_has": {},
@@ -51,6 +52,8 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         dev[key][f] = (put(vals), put(col.has_value))
         if col.uniq_ords is not None:
             dev["dv_int_ord"][f] = put(col.uniq_ords)
+        if col.mv_pair_docs is not None:
+            dev["dv_mv"][f] = (put(col.mv_pair_docs), put(col.mv_pair_ords))
     dev["vec_sq"] = {}
     dev["vec_ivf"] = {}
     for f, vc in pack.vectors.items():

@@ -187,3 +187,24 @@ def test_composite_rejected_as_subagg(rng):
         _search(e, aggs={"t": {"terms": {"field": "cat"},
                                "aggs": {"c": {"composite": {"sources": [
                                    {"s": {"terms": {"field": "sub"}}}]}}}}})
+
+
+def test_multi_valued_keyword_terms_agg():
+    e = Engine(None)
+    e.create_index("mv", {"properties": {"tags": {"type": "keyword"}}})
+    idx = e.indices["mv"]
+    idx.index_doc("1", {"tags": ["a", "b"]})
+    idx.index_doc("2", {"tags": ["b", "c", "c"]})  # dup value counts once
+    idx.index_doc("3", {"tags": "a"})
+    idx.refresh()
+    r = idx.search(aggs={"t": {"terms": {"field": "tags", "size": 10}}})
+    counts = {b["key"]: b["doc_count"] for b in r["aggregations"]["t"]["buckets"]}
+    assert counts == {"a": 2, "b": 2, "c": 1}
+    # terms QUERY matches any value (postings already multi-valued)
+    res = idx.search(query={"term": {"tags": "b"}}, size=10)
+    assert {h["_id"] for h in res["hits"]["hits"]} == {"1", "2"}
+    # filtered agg: only docs matching the query feed the counts
+    r = idx.search(query={"term": {"tags": "a"}},
+                   aggs={"t": {"terms": {"field": "tags", "size": 10}}})
+    counts = {b["key"]: b["doc_count"] for b in r["aggregations"]["t"]["buckets"]}
+    assert counts == {"a": 2, "b": 1}
