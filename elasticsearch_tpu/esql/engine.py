@@ -71,12 +71,17 @@ def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
     columnar table (plus _index and requested metadata columns)."""
     targets = engine.resolve_search(index_expr, allow_no_indices=True)
     col_names: set[str] = set()
+    text_fields: set[str] = set()
     for idx, _ in targets:
         idx._maybe_refresh()
         sp = idx.searcher.sp
         for f, col in sp.global_docvalues.items():
             if f != "_id":
                 col_names.add(f)
+        for f, ft in idx.mappings.fields.items():
+            if ft.type == "text":
+                text_fields.add(f)
+    text_fields -= col_names
     parts: dict[str, list] = {n: [] for n in col_names}
     index_col = []
     id_col = []
@@ -93,6 +98,20 @@ def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
             index_col.extend([idx.name] * len(sel))
             for d in sel:
                 id_col.append(idx.shard_docs[s][d][0] if s < len(idx.shard_docs) else "")
+            for tf_name in text_fields:
+                vals = []
+                for d in sel:
+                    src = (idx.shard_docs[s][d][1]
+                           if s < len(idx.shard_docs) else {})
+                    cur = src
+                    for part in tf_name.split("."):
+                        cur = cur.get(part) if isinstance(cur, dict) else None
+                    vals.append(None if cur is None
+                                else (cur if isinstance(cur, str) else str(cur)))
+                parts.setdefault(tf_name, []).append((
+                    Column(np.array(vals, object),
+                           np.array([v is None for v in vals]), "keyword"),
+                    len(sel)))
             for name in col_names:
                 col = pack.docvalues.get(name)
                 if col is None:
@@ -424,6 +443,88 @@ def _run_stats(t: Table, aggs, by: list[str]) -> Table:
     return Table(columns, len(uniq))
 
 
+def _run_extract(t: Table, kind: str, payload: dict) -> Table:
+    """DISSECT/GROK pipes: per-row pattern extraction into new columns,
+    reusing the ingest processors' parsers (reference behavior: ESQL
+    Dissect/Grok evals share the grok/dissect libs with ingest)."""
+    from ..ingest.processors import (
+        DissectProcessor,
+        GrokProcessor,
+        IngestProcessorError,
+    )
+
+    col = t.columns.get(payload["column"])
+    if col is None:
+        raise IllegalArgumentError(f"Unknown column [{payload['column']}]")
+    if kind == "dissect":
+        proc = DissectProcessor({"field": "_v", "pattern": payload["pattern"]})
+    else:
+        proc = GrokProcessor({"field": "_v", "patterns": [payload["pattern"]]})
+    rows = []
+    new_names: list[str] = []
+    for i in range(t.nrows):
+        out: dict = {}
+        if not col.null[i]:
+            ctx = {"_v": str(col.values[i])}
+            try:
+                proc.process(ctx)
+                out = {}
+
+                def _flatten(d, prefix=""):
+                    for k2, v2 in d.items():
+                        if k2 == "_v" and not prefix:
+                            continue
+                        if isinstance(v2, dict):
+                            _flatten(v2, f"{prefix}{k2}.")
+                        else:
+                            out[f"{prefix}{k2}"] = v2
+
+                _flatten(ctx)
+            except IngestProcessorError:
+                out = {}
+        rows.append(out)
+        for k in out:
+            if k not in new_names:
+                new_names.append(k)
+    for name in new_names:
+        vals = [r.get(name) for r in rows]
+        is_num = all(v is None or isinstance(v, (int, float)) for v in vals)             and any(v is not None for v in vals)
+        if is_num:
+            arr = np.array([0 if v is None else v for v in vals], np.float64)
+            t.columns[name] = Column(arr, np.array([v is None for v in vals]),
+                                     "double")
+        else:
+            t.columns[name] = Column(
+                np.array([None if v is None else str(v) for v in vals], object),
+                np.array([v is None for v in vals]), "keyword")
+    return t
+
+
+def _run_enrich(engine, t: Table, payload: dict) -> Table:
+    from ..xpack import enrich_lookup
+
+    col = t.columns.get(payload["on"])
+    if col is None:
+        raise IllegalArgumentError(f"Unknown column [{payload['on']}]")
+    rows = []
+    names: list[str] = []
+    for i in range(t.nrows):
+        row = None
+        if not col.null[i]:
+            row = enrich_lookup(engine, payload["policy"], col.values[i])
+        rows.append(row or {})
+        for k in (row or {}):
+            if payload["with"] is None or k in payload["with"]:
+                if k not in names:
+                    names.append(k)
+    for name in names:
+        vals = [r.get(name) for r in rows]
+        t.columns[name] = Column(
+            np.array([None if v is None else v for v in vals], object),
+            np.array([v is None for v in vals]), "keyword")
+    return t
+
+
 # ---- driver ---------------------------------------------------------------
 
 def execute(engine, query: str) -> Table:
@@ -488,6 +589,10 @@ def execute(engine, query: str) -> Table:
             for pat in payload:
                 for name in [n for n in t.columns if fnmatch.fnmatchcase(n, pat)]:
                     del t.columns[name]
+        elif kind in ("dissect", "grok"):
+            t = _run_extract(t, kind, payload)
+        elif kind == "enrich":
+            t = _run_enrich(engine, t, payload)
         elif kind == "rename":
             for old, new in payload:
                 if old not in t.columns:

@@ -32,6 +32,7 @@ _KEYWORDS = {
     "from", "row", "where", "eval", "stats", "by", "sort", "limit", "keep",
     "drop", "rename", "as", "asc", "desc", "and", "or", "not", "in", "like",
     "is", "null", "nulls", "first", "last", "metadata", "true", "false",
+    "dissect", "grok", "enrich", "on", "with",
 }
 
 
@@ -253,6 +254,25 @@ def parse(src: str) -> list[tuple]:
             stages.append(("keep", p.name_list()))
         elif (k, v) == ("kw", "drop"):
             stages.append(("drop", p.name_list()))
+        elif (k, v) in (("kw", "dissect"), ("kw", "grok")):
+            col = p.expect("name")
+            kk, pat = p.next()
+            if kk != "str":
+                raise EsqlParseError(f"{v.upper()} requires a quoted pattern")
+            stages.append((v, {"column": col, "pattern": pat}))
+        elif (k, v) == ("kw", "enrich"):
+            policy = p.expect("name")
+            # policy names may contain hyphens, which tokenize as minus
+            while p.peek() == ("op", "-"):
+                p.next()
+                policy += "-" + p.expect("name")
+            p.expect("kw", "on")
+            match_col = p.expect("name")
+            fields = None
+            if p.accept("kw", "with"):
+                fields = p.name_list()
+            stages.append(("enrich", {"policy": policy, "on": match_col,
+                                      "with": fields}))
         elif (k, v) == ("kw", "rename"):
             pairs = []
             while True:

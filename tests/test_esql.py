@@ -163,3 +163,37 @@ def test_sql_having_unaliased_aggregate():
         "SELECT dept, COUNT(*) FROM emp GROUP BY dept HAVING COUNT(*) > 1 ORDER BY dept"})
     assert [r[0] for r in out["rows"]] == ["eng", "ops"]
     assert all(r[1] == 2 for r in out["rows"])
+
+
+def test_esql_dissect_grok_enrich():
+    e = Engine(None)
+    e.create_index("raw", {"properties": {
+        "line": {"type": "text"}, "host": {"type": "keyword"}}})
+    idx = e.indices["raw"]
+    idx.index_doc("1", {"line": "GET /api/users 200", "host": "web1"})
+    idx.index_doc("2", {"line": "POST /api/orders 503", "host": "web2"})
+    idx.refresh()
+    out = esql_query(e, {"query":
+        'FROM raw | DISSECT line "%{method} %{path} %{status}" '
+        '| WHERE status == "503" | KEEP host, method, path'})
+    assert out["values"] == [["web2", "POST", "/api/orders"]]
+
+    out = esql_query(e, {"query":
+        'FROM raw | GROK line "%{WORD:method} %{URIPATH:path} %{INT:status}" '
+        '| KEEP method, status | SORT method'})
+    assert out["values"] == [["GET", "200"], ["POST", "503"]]
+
+    # enrich pipe from an executed policy
+    from elasticsearch_tpu import xpack
+
+    e.create_index("hosts", {"properties": {
+        "name": {"type": "keyword"}, "dc": {"type": "keyword"}}})
+    h = e.indices["hosts"]
+    h.index_doc("a", {"name": "web1", "dc": "us-east"})
+    h.index_doc("b", {"name": "web2", "dc": "eu-west"})
+    xpack.enrich_put_policy(e, "host-dc", {"match": {
+        "indices": "hosts", "match_field": "name", "enrich_fields": ["dc"]}})
+    xpack.enrich_execute_policy(e, "host-dc")
+    out = esql_query(e, {"query":
+        'FROM raw | ENRICH host-dc ON host WITH dc | KEEP host, dc | SORT host'})
+    assert out["values"] == [["web1", "us-east"], ["web2", "eu-west"]]
