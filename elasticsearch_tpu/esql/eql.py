@@ -19,10 +19,13 @@ from .parser import _P, tokenize
 
 _SEQ_RE = re.compile(
     r"^\s*sequence(?:\s+by\s+(?P<by>[\w.@,\s]+?))?"
-    r"(?:\s+with\s+maxspan\s*=\s*(?P<span>\w+))?\s*(?P<rest>\[.*\])\s*$",
+    r"(?:\s+with\s+maxspan\s*=\s*(?P<span>\w+))?\s*"
+    r"(?P<rest>(?:\[[^\]]*\](?:\s+with\s+runs\s*=\s*\d+)?\s*)+?)"
+    r"(?:until\s*\[(?P<until>[^\]]*)\])?\s*$",
     re.IGNORECASE | re.DOTALL,
 )
-_STEP_RE = re.compile(r"\[([^\]]*)\]")
+_STEP_RE = re.compile(r"\[([^\]]*)\](?:\s+with\s+runs\s*=\s*(\d+))?",
+                      re.IGNORECASE)
 
 
 def _parse_condition(text: str):
@@ -105,10 +108,19 @@ def eql_search(engine, index_expr: str, body: dict) -> dict:
         from ..utils.durations import parse_duration_millis
 
         span_ms = parse_duration_millis(m.group("span"))
-    steps = [_parse_condition(s) for s in _STEP_RE.findall(m.group("rest"))]
+    steps = []
+    for cond_text, runs in _STEP_RE.findall(m.group("rest")):
+        parsed = _parse_condition(cond_text)
+        # `with runs=N` repeats the step N times (consecutive matches)
+        for _ in range(max(1, int(runs or 1))):
+            steps.append(parsed)
     if len(steps) < 2:
         raise IllegalArgumentError("sequence requires at least 2 steps")
     masks = [_event_mask(t, cat, ast) for cat, ast in steps]
+    until_mask = None
+    if m.group("until"):
+        ucat, uast = _parse_condition(m.group("until"))
+        until_mask = _event_mask(t, ucat, uast)
     ts_vals = np.asarray(t.columns[ts_field].values, np.int64)
 
     def key_of(i):
@@ -132,6 +144,8 @@ def eql_search(engine, index_expr: str, body: dict) -> dict:
                 partial.pop(k)
                 st = None
             elif masks[step][i]:
+                # a step match consumes the event even when it also matches
+                # `until` (sequence steps take priority)
                 events = events + [i]
                 if step + 1 == len(steps):
                     sequences.append((k, events))
@@ -139,6 +153,10 @@ def eql_search(engine, index_expr: str, body: dict) -> dict:
                 else:
                     partial[k] = (step + 1, first_ts, events)
                 continue
+            elif until_mask is not None and until_mask[i]:
+                # an `until` event expires the key's in-flight sequence
+                partial.pop(k)
+                st = None
         if masks[0][i]:
             if len(steps) == 1:
                 sequences.append((k, [i]))

@@ -197,3 +197,23 @@ def test_esql_dissect_grok_enrich():
     out = esql_query(e, {"query":
         'FROM raw | ENRICH host-dc ON host WITH dc | KEEP host, dc | SORT host'})
     assert out["values"] == [["web1", "us-east"], ["web2", "eu-west"]]
+
+
+def test_eql_sequence_until_and_runs():
+    e = _eql_engine()
+    # until: a file event between process and network kills the h1 sequence
+    out = eql_search(e, "ev", {"query":
+        'sequence by host [process where true] [network where true] '
+        'until [file where true]'})
+    # h1 completes process->network BEFORE its file event; h2 completes too
+    # (no file events for h2, no maxspan here)
+    assert out["hits"]["total"]["value"] == 2
+    out = eql_search(e, "ev", {"query":
+        'sequence by pid [process where true] [network where true] '
+        'until [network where true]'})
+    # until fires on the same event type as step 2: step consumes first
+    assert out["hits"]["total"]["value"] == 2
+    # runs: two consecutive process events never happen per host
+    out = eql_search(e, "ev", {"query":
+        'sequence by host [process where true] with runs=2 [network where true]'})
+    assert out["hits"]["total"]["value"] == 0
