@@ -439,6 +439,8 @@ class EsIndex:
                 rescore=rescore, runtime_mappings=runtime_mappings,
             )
         finally:
+            if runtime_mappings:
+                self.searcher.remove_runtime_fields(list(runtime_mappings))
             _trace_ctx.__exit__(None, None, None)
             took_ms = (time.monotonic() - _t_search0) * 1000
             self.counters["query_time_ms"] = (

@@ -333,7 +333,7 @@ class PackBuilder:
                     self.docvalue_raw.setdefault(fld, []).append((docid, kept[0]))
                     if len(set(kept)) > 1:
                         self.mv_extra_raw.setdefault(fld, []).extend(
-                            (docid, v) for v in sorted(set(kept))[1:]
+                            (docid, v) for v in sorted(set(kept))
                             if v != kept[0]
                         )
             elif t in INT_TYPES or t in DATE_TYPES or t in BOOL_TYPES:

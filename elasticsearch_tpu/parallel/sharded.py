@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -232,8 +234,13 @@ class StackedSearcher:
 
         if not hasattr(self, "_runtime_fields"):
             self._runtime_fields = {}
+            self._runtime_cache = {}       # (name, rtype, src) -> artifacts
+            self._runtime_plan_key = {}    # name -> key compiled plans baked
         src = script.get("source") if isinstance(script, dict) else script
-        cache_key = (name, rtype, src)
+        params = (script.get("params") if isinstance(script, dict) else None) or {}
+        # params are baked into the compiled expression as constants, so they
+        # are part of the field's identity
+        cache_key = (name, rtype, src, json.dumps(params, sort_keys=True))
         if self._runtime_fields.get(name) == cache_key:
             return
         if name in self.sp.global_docvalues and name not in self._runtime_fields:
@@ -244,12 +251,21 @@ class StackedSearcher:
             raise IllegalArgumentError(
                 f"runtime field type [{rtype}] is not supported (numeric only)"
             )
+        # compiled plans may have baked this field's vocab size / shapes — if
+        # the definition changed since they were built, drop all plans
+        # (redefinition is rare; a full flush is exact where name-matching
+        # heuristics over/under-flush)
+        if self._runtime_plan_key.get(name, cache_key) != cache_key:
+            self._cache.clear()
+        self._runtime_plan_key[name] = cache_key
+        cached = self._runtime_cache.get(cache_key)
+        if cached is not None:
+            self._install_runtime_field(name, cache_key, cached)
+            return
         s = src.strip()
         if s.startswith("emit(") and s.endswith(")"):
             s = s[5:-1]
-        compiled = compile_script(
-            {"source": s, "params": (script.get("params") if isinstance(script, dict) else None) or {}}
-        )
+        compiled = compile_script({"source": s, "params": params})
         S = self.sp.S
         n_max = self.sp.n_max
         dtype = np.int64 if rtype in ("long", "date", "boolean") else np.float32
@@ -285,24 +301,52 @@ class StackedSearcher:
                 ords = np.full((S, n_max), -1, np.int32)
                 ords[has] = np.searchsorted(uniq, vals[has]).astype(np.int32)
                 g.uniq_ords = ords
-        self.sp.stacked_docvalues[name] = g
-        self.sp.global_docvalues[name] = g
         # per-shard planning view (prepare() reads pack.docvalues)
+        pcs = []
         for i, p in enumerate(self.sp.shards):
             pc = DocValuesColumn(kind, vals[i, : p.num_docs], has[i, : p.num_docs])
             pc.vmin, pc.vmax = g.vmin, g.vmax
             if g.uniq_values is not None:
                 pc.uniq_values = g.uniq_values
                 pc.uniq_ords = g.uniq_ords[i, : p.num_docs]
-            p.docvalues[name] = pc
+            pcs.append(pc)
         put = (lambda x: jax.device_put(
             x, NamedSharding(self.mesh, P("shards", *([None] * (np.ndim(x) - 1))))
         )) if self.mesh is not None else jnp.asarray
         key = {"int": "dv_int", "float": "dv_float"}[kind]
-        self.dev[key][name] = (put(vals), put(has))
+        dev_entries = {key: (put(vals), put(has))}
         if g.uniq_ords is not None:
-            self.dev["dv_int_ord"][name] = put(g.uniq_ords)
+            dev_entries["dv_int_ord"] = put(g.uniq_ords)
+        artifacts = {"g": g, "pcs": pcs, "dev": dev_entries}
+        if len(self._runtime_cache) >= 16:  # bound memory for one-off scripts
+            self._runtime_cache.pop(next(iter(self._runtime_cache)))
+        self._runtime_cache[cache_key] = artifacts
+        self._install_runtime_field(name, cache_key, artifacts)
+
+    def _install_runtime_field(self, name, cache_key, artifacts) -> None:
+        self.sp.stacked_docvalues[name] = artifacts["g"]
+        self.sp.global_docvalues[name] = artifacts["g"]
+        for p, pc in zip(self.sp.shards, artifacts["pcs"]):
+            p.docvalues[name] = pc
+        for key, val in artifacts["dev"].items():
+            self.dev[key][name] = val
         self._runtime_fields[name] = cache_key
+
+    def remove_runtime_fields(self, names) -> None:
+        """Uninstall request-scoped runtime fields after the request
+        (reference: runtime_mappings are per-search-request; they must not
+        leak into later requests on the same index). Materialized columns
+        stay in _runtime_cache so a repeat of the same request reinstalls
+        without recomputing."""
+        for name in names:
+            if not getattr(self, "_runtime_fields", {}).pop(name, None):
+                continue
+            self.sp.stacked_docvalues.pop(name, None)
+            self.sp.global_docvalues.pop(name, None)
+            for p in self.sp.shards:
+                p.docvalues.pop(name, None)
+            for key in ("dv_int", "dv_float", "dv_int_ord"):
+                self.dev.get(key, {}).pop(name, None)
 
     def _compiled_collapse(self, node, key, fld, k):
         """Field collapsing: best hit per field value (reference behavior:

@@ -932,8 +932,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     @handler
     async def security_create_api_key(request):
         body = await body_json(request, {}) or {}
-        username = request.get("principal", {}).get("username", "_anonymous")
-        return web.json_response(engine.security.create_api_key(username, body))
+        principal = request.get("principal") or {}
+        username = principal.get("username", "_anonymous")
+        return web.json_response(
+            engine.security.create_api_key(username, body,
+                                           principal=principal or None))
 
     def _is_key_manager(request):
         """manage_security holders see/invalidate all keys; everyone else

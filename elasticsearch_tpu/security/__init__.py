@@ -35,6 +35,13 @@ class AuthorizationError(ElasticsearchTpuError):
 
 _PBKDF2_ITERS = 10000
 
+
+def _normalize_limited_by(lb: list) -> list[list[dict]]:
+    """limited_by is a list of role-SETS; round-1 stored one flat role list."""
+    if lb and isinstance(lb[0], dict):
+        return [lb]
+    return lb
+
 CLUSTER_PRIVS = {"all", "monitor", "manage", "manage_security"}
 INDEX_PRIVS = {"all", "read", "write", "index", "delete", "create_index",
                "manage", "view_index_metadata", "monitor"}
@@ -165,6 +172,8 @@ class SecurityService:
         u = self.store["users"].get(username)
         if u is None:
             raise ResourceNotFoundError(f"user [{username}] not found")
+        if len(password) < 6:
+            raise IllegalArgumentError("passwords must be at least 6 characters")
         u["password"] = _hash_password(password)
         self._save()
 
@@ -209,7 +218,20 @@ class SecurityService:
 
     # ---- API keys --------------------------------------------------------
 
-    def create_api_key(self, username: str, body: dict) -> dict:
+    def create_api_key(self, username: str, body: dict,
+                       principal: dict | None = None) -> dict:
+        """Mint an API key for `username`.
+
+        The key's effective permissions are the *intersection* of the
+        requested role_descriptors with the creator's permissions at creation
+        time (reference: ApiKeyService stores "limited-by" role descriptors
+        and AuthorizationService checks both sets) — so a key can only
+        narrow, never escalate, the creator's privileges. `limited_by` is a
+        list of role-SETS, each of which must independently grant an action;
+        a key minted *by* an API key stacks the parent key's descriptor set
+        and its own limited-by sets, so derived keys cannot out-privilege
+        the key that created them.
+        """
         name = (body or {}).get("name")
         if not name:
             raise IllegalArgumentError("api key [name] is required")
@@ -221,12 +243,19 @@ class SecurityService:
 
             expiration = int(time.time() * 1000) + parse_duration_millis(
                 body["expiration"])
+        if principal is not None and principal.get("authentication_type") == "api_key":
+            # derived key: capped by the creating key's own effective sets
+            limited_by = [self._resolved_roles(principal)]
+            limited_by.extend(self._limited_by_sets(principal))
+        else:
+            limited_by = [self._owner_roles(username)]
         self.store["api_keys"][key_id] = {
             "name": name,
             "hash": hashlib.sha256(secret.encode()).hexdigest(),
             "username": username,
             "roles": list((body.get("role_descriptors") or {}).keys()) or None,
             "role_descriptors": body.get("role_descriptors") or {},
+            "limited_by": limited_by,
             "creation": int(time.time() * 1000),
             "expiration": expiration,
             "invalidated": False,
@@ -289,15 +318,24 @@ class SecurityService:
                 raise AuthenticationError("failed to decode api key credentials")
             k = self.store["api_keys"].get(kid)
             if (k is None or k["invalidated"]
-                    or hashlib.sha256(secret.encode()).hexdigest() != k["hash"]):
+                    or not secrets.compare_digest(
+                        hashlib.sha256(secret.encode()).hexdigest(), k["hash"])):
                 raise AuthenticationError("invalid api key")
             if k["expiration"] and time.time() * 1000 > k["expiration"]:
                 raise AuthenticationError("api key is expired")
             owner = self.store["users"].get(k["username"])
             roles = list(k["role_descriptors"].keys()) or (
                 owner["roles"] if owner else [])
+            # keys created before limited_by existed are capped by the
+            # owner's *current* roles instead of a creation-time snapshot
+            limited_by = k.get("limited_by")
+            if limited_by is None:
+                limited_by = [self._owner_roles(k["username"])]
+            else:
+                limited_by = _normalize_limited_by(limited_by)
             return {"username": k["username"], "roles": roles,
                     "role_descriptors": k["role_descriptors"],
+                    "limited_by": limited_by,
                     "authentication_type": "api_key"}
         raise AuthenticationError(f"unsupported authorization scheme [{scheme}]")
 
@@ -314,34 +352,63 @@ class SecurityService:
                 out.append(all_roles[r])
         return out
 
+    def _owner_roles(self, username: str) -> list[dict]:
+        """Resolve a user's current role definitions (for limited-by caps)."""
+        owner = self.store["users"].get(username)
+        all_roles = {**_RESERVED_ROLES, **self.store["roles"]}
+        return [all_roles[r] for r in (owner["roles"] if owner else [])
+                if r in all_roles]
+
+    @staticmethod
+    def _limited_by_sets(principal: dict) -> list[list[dict]]:
+        """The role-sets capping an API-key principal (empty for realm
+        users). Each set must independently grant an action."""
+        if principal.get("authentication_type") != "api_key":
+            return []
+        return _normalize_limited_by(principal.get("limited_by") or [[]])
+
+    @staticmethod
+    def _cluster_granted(roles: list[dict], priv: str) -> bool:
+        for role in roles:
+            cp = set(role.get("cluster") or [])
+            if "all" in cp or priv in cp:
+                return True
+        return False
+
+    @staticmethod
+    def _index_granted(roles: list[dict], priv: str, index: str) -> bool:
+        for role in roles:
+            for spec in role.get("indices") or []:
+                if not any(fnmatch.fnmatchcase(index, p)
+                           for p in spec.get("names") or []):
+                    continue
+                granted = set()
+                for p in spec.get("privileges") or []:
+                    granted |= _INDEX_IMPLIES.get(p, {p})
+                if priv in granted or "all" in spec.get("privileges", []):
+                    return True
+        return False
+
     def authorize(self, principal: dict, action: str, indices: list[str]):
-        """action: 'cluster:<priv>' or 'indices:<priv>'."""
-        roles = self._resolved_roles(principal)
+        """action: 'cluster:<priv>' or 'indices:<priv>'.
+
+        API-key principals must be granted by BOTH the key's role
+        descriptors and the owner's limited-by roles (the intersection —
+        reference: AuthorizationService intersects assigned with limited-by
+        role descriptors), so stored descriptors cannot out-privilege the
+        key's creator.
+        """
+        role_sets = [self._resolved_roles(principal)]
+        role_sets.extend(self._limited_by_sets(principal))
         kind, _, priv = action.partition(":")
         if kind == "cluster":
-            for role in roles:
-                cp = set(role.get("cluster") or [])
-                if "all" in cp or priv in cp:
-                    return
-            raise AuthorizationError(
-                f"action [{action}] is unauthorized for user "
-                f"[{principal['username']}]")
+            if not all(self._cluster_granted(rs, priv) for rs in role_sets):
+                raise AuthorizationError(
+                    f"action [{action}] is unauthorized for user "
+                    f"[{principal['username']}]")
+            return
         for index in indices or ["*"]:
-            ok = False
-            for role in roles:
-                for spec in role.get("indices") or []:
-                    if not any(fnmatch.fnmatchcase(index, p)
-                               for p in spec.get("names") or []):
-                        continue
-                    granted = set()
-                    for p in spec.get("privileges") or []:
-                        granted |= _INDEX_IMPLIES.get(p, {p})
-                    if priv in granted or "all" in spec.get("privileges", []):
-                        ok = True
-                        break
-                if ok:
-                    break
-            if not ok:
+            if not all(self._index_granted(rs, priv, index) for rs in role_sets):
                 raise AuthorizationError(
                     f"action [indices:{priv}] is unauthorized for user "
                     f"[{principal['username']}] on indices [{index}]")

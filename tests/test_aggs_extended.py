@@ -208,3 +208,20 @@ def test_multi_valued_keyword_terms_agg():
                    aggs={"t": {"terms": {"field": "tags", "size": 10}}})
     counts = {b["key"]: b["doc_count"] for b in r["aggregations"]["t"]["buckets"]}
     assert counts == {"a": 2, "b": 1}
+
+
+def test_multi_valued_keyword_unsorted_first_value():
+    """Docs whose FIRST value is not the lexicographically smallest must not
+    lose values (regression: mv-pair collection dropped the smallest extra)."""
+    e = Engine(None)
+    e.create_index("mv2", {"properties": {"tags": {"type": "keyword"}}})
+    idx = e.indices["mv2"]
+    idx.index_doc("1", {"tags": ["b", "a"]})        # first value > smallest
+    idx.index_doc("2", {"tags": ["c", "a", "b"]})
+    idx.index_doc("3", {"tags": ["a"]})
+    idx.refresh()
+    r = idx.search(aggs={"t": {"terms": {"field": "tags", "size": 10}},
+                         "c": {"cardinality": {"field": "tags"}}})
+    counts = {b["key"]: b["doc_count"] for b in r["aggregations"]["t"]["buckets"]}
+    assert counts == {"a": 3, "b": 2, "c": 1}
+    assert r["aggregations"]["c"]["value"] == 3

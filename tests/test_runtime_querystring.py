@@ -158,3 +158,45 @@ async def _template_drive():
 
 def test_search_templates():
     asyncio.run(_template_drive())
+
+
+def test_runtime_field_is_request_scoped():
+    """A runtime field defined in one request must not be visible to later
+    requests without it (reference: per-request runtime_mappings)."""
+    from elasticsearch_tpu.engine import Engine
+
+    e = Engine(None)
+    e.create_index("rts", {"properties": {"price": {"type": "double"}}})
+    idx = e.indices["rts"]
+    idx.index_doc("1", {"price": 2.0})
+    idx.index_doc("2", {"price": 5.0})
+    idx.refresh()
+    rm = {"dbl": {"type": "double", "script": {"source": "emit(price * 2)"}}}
+    r = idx.search(runtime_mappings=rm, aggs={"m": {"max": {"field": "dbl"}}})
+    assert r["aggregations"]["m"]["value"] == 10.0
+    # without the mapping, the field is gone again
+    r2 = idx.search(aggs={"m": {"max": {"field": "dbl"}}})
+    assert r2["aggregations"]["m"].get("value") != 10.0
+    assert "dbl" not in idx.searcher.sp.global_docvalues
+    # and can be redefined with a different script
+    rm2 = {"dbl": {"type": "double", "script": {"source": "emit(price * 3)"}}}
+    r3 = idx.search(runtime_mappings=rm2, aggs={"m": {"max": {"field": "dbl"}}})
+    assert r3["aggregations"]["m"]["value"] == 15.0
+
+
+def test_runtime_field_params_change_recomputes():
+    """Same source with different params is a different field definition."""
+    from elasticsearch_tpu.engine import Engine
+
+    e = Engine(None)
+    e.create_index("rtp", {"properties": {"price": {"type": "double"}}})
+    idx = e.indices["rtp"]
+    idx.index_doc("1", {"price": 5.0})
+    idx.refresh()
+    rm = lambda f: {"dbl": {"type": "double",
+                            "script": {"source": "emit(price * params.f)",
+                                       "params": {"f": f}}}}
+    r = idx.search(runtime_mappings=rm(2), aggs={"m": {"max": {"field": "dbl"}}})
+    assert r["aggregations"]["m"]["value"] == 10.0
+    r = idx.search(runtime_mappings=rm(3), aggs={"m": {"max": {"field": "dbl"}}})
+    assert r["aggregations"]["m"]["value"] == 15.0

@@ -149,3 +149,68 @@ def test_api_key_owner_scoping():
     assert out["invalidated_api_keys"] == []
     out = sec.invalidate_api_key(key_id=k_alice["id"], owner="alice")
     assert out["invalidated_api_keys"] == [k_alice["id"]]
+
+
+def test_api_key_cannot_escalate_owner_privileges():
+    """A key's role_descriptors are capped by the creator's privileges
+    (reference: ApiKeyService limited-by role descriptors)."""
+    e = Engine(None)
+    sec = e.security
+    sec.put_role("logs_reader", {"indices": [
+        {"names": ["logs-*"], "privileges": ["read"]}]})
+    sec.put_user("bob", {"password": "secret1", "roles": ["logs_reader"]})
+
+    # bob mints a key claiming superuser descriptors
+    created = sec.create_api_key("bob", {"name": "sneaky", "role_descriptors": {
+        "root": {"cluster": ["all"],
+                 "indices": [{"names": ["*"], "privileges": ["all"]}]}}})
+    p = sec.authenticate("ApiKey " + created["encoded"])
+    # still only what bob could do
+    sec.authorize(p, "indices:read", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "cluster:manage_security", [])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "indices:write", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "indices:read", ["secrets"])
+
+    # a genuinely narrowed key still works, and the cap is a creation-time
+    # snapshot: widening bob later does not widen the existing key
+    sec.put_user("bob", {"roles": ["superuser"]})
+    p = sec.authenticate("ApiKey " + created["encoded"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p, "cluster:manage_security", [])
+
+
+def test_change_password_enforces_minimum_length():
+    e = Engine(None)
+    sec = e.security
+    sec.put_user("carol", {"password": "secret1", "roles": []})
+    with pytest.raises(Exception, match="6 characters"):
+        sec.change_password("carol", "abc")
+    sec.change_password("carol", "longenough")
+    sec.authenticate(_basic("carol", "longenough"))
+
+
+def test_derived_api_key_capped_by_creating_key():
+    """A key minted *with* an API key is capped by that key's effective
+    permissions, not the owner's full roles."""
+    e = Engine(None)
+    sec = e.security
+    # elastic (superuser) mints a key narrowed to read-only on logs-*
+    narrowed = sec.create_api_key("elastic", {"name": "ro", "role_descriptors": {
+        "ro": {"indices": [{"names": ["logs-*"], "privileges": ["read"]}]}}})
+    p_narrow = sec.authenticate("ApiKey " + narrowed["encoded"])
+    # the narrowed key tries to mint a fully-privileged derived key
+    derived = sec.create_api_key("elastic", {"name": "sneaky", "role_descriptors": {
+        "root": {"cluster": ["all"],
+                 "indices": [{"names": ["*"], "privileges": ["all"]}]}}},
+        principal=p_narrow)
+    p_derived = sec.authenticate("ApiKey " + derived["encoded"])
+    sec.authorize(p_derived, "indices:read", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p_derived, "cluster:manage_security", [])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p_derived, "indices:write", ["logs-web"])
+    with pytest.raises(AuthorizationError):
+        sec.authorize(p_derived, "indices:read", ["secrets"])
