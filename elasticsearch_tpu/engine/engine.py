@@ -423,6 +423,7 @@ class EsIndex:
         self, query=None, size=10, from_=0, aggs=None, knn=None,
         sort=None, search_after=None, script_fields=None,
         collapse=None, rescore=None, runtime_mappings=None,
+        track_total_hits=None,
     ):
         self._maybe_refresh()
         self.counters["query_total"] = self.counters.get("query_total", 0) + 1
@@ -437,6 +438,7 @@ class EsIndex:
                 sort=sort, search_after=search_after,
                 script_fields=script_fields, collapse=collapse,
                 rescore=rescore, runtime_mappings=runtime_mappings,
+                track_total_hits=track_total_hits,
             )
         finally:
             if runtime_mappings:
@@ -454,9 +456,22 @@ class EsIndex:
         self, query=None, size=10, from_=0, aggs=None, knn=None,
         sort=None, search_after=None, script_fields=None,
         collapse=None, rescore=None, runtime_mappings=None,
+        track_total_hits=None,
     ):
         if collapse is not None and rescore is not None:
             raise IllegalArgumentError("cannot use [collapse] in conjunction with [rescore]")
+        # track_total_hits (reference: SearchSourceBuilder.trackTotalHitsUpTo,
+        # default threshold 10_000): true -> exact counting, which disables
+        # block-max pruning; false -> prune freely; int N -> prune only when
+        # the count provably reaches N (relation "gte" in the response)
+        if track_total_hits is None:
+            track_total_hits = 10_000
+        if track_total_hits is True:
+            prune_floor = None
+        elif track_total_hits is False:
+            prune_floor = 0
+        else:
+            prune_floor = int(track_total_hits)
         m_eff = None
         if runtime_mappings:
             import copy
@@ -511,12 +526,15 @@ class EsIndex:
             if had_pipeline and aggregations is not None:
                 apply_pipeline_aggs(aggs_request, aggregations)
             self._resolve_top_hits(aggregations)
+            hits_obj = {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": None,
+                "hits": hits,
+            }
+            if track_total_hits is False:
+                del hits_obj["total"]  # reference omits hits.total entirely
             return {
-                "hits": {
-                    "total": {"value": total, "relation": "eq"},
-                    "max_score": None,
-                    "hits": hits,
-                },
+                "hits": hits_obj,
                 **({"aggregations": aggregations} if aggregations is not None else {}),
             }
         if search_after is not None:
@@ -622,7 +640,8 @@ class EsIndex:
             res.max_score = float(order[0][2]) if order else None
         else:
             res = self.searcher.search(query, size=size, from_=from_, aggs=aggs,
-                                       mappings=m_eff)
+                                       mappings=m_eff,
+                                       prune_floor=None if knn is not None else prune_floor)
         if knn is not None and knn_only:
             res.total = min(res.total, k_total)
         hits = []
@@ -642,12 +661,21 @@ class EsIndex:
         if had_pipeline and res.aggregations is not None:
             apply_pipeline_aggs(aggs_request, res.aggregations)
         self._resolve_top_hits(res.aggregations)
+        relation = getattr(res, "total_relation", "eq")
+        total_value = res.total
+        if relation == "gte" and prune_floor:
+            # the threshold itself is also a proven lower bound (pruning only
+            # engages when max term df >= floor); report the larger
+            total_value = max(total_value, prune_floor)
+        hits_obj = {
+            "total": {"value": total_value, "relation": relation},
+            "max_score": res.max_score,
+            "hits": hits,
+        }
+        if track_total_hits is False:
+            del hits_obj["total"]  # reference omits hits.total entirely
         return {
-            "hits": {
-                "total": {"value": res.total, "relation": "eq"},
-                "max_score": res.max_score,
-                "hits": hits,
-            },
+            "hits": hits_obj,
             **({"aggregations": res.aggregations} if res.aggregations is not None else {}),
         }
 
@@ -1059,15 +1087,23 @@ class Engine:
                 all_hits = [h for r in subs for h in r["hits"]["hits"]]
                 all_hits.sort(key=lambda h: (-(h["_score"] or 0.0),
                                              h["_index"], h["_id"]))
-                total = sum(r["hits"]["total"]["value"] for r in subs)
+                totals = [r["hits"]["total"] for r in subs
+                          if "total" in r["hits"]]
                 max_scores = [r["hits"]["max_score"] for r in subs
                               if r["hits"].get("max_score") is not None]
+                hits_obj = {
+                    "max_score": max(max_scores) if max_scores else None,
+                    "hits": all_hits[from_:from_ + size],
+                }
+                if len(totals) == len(subs):
+                    hits_obj["total"] = {
+                        "value": sum(t["value"] for t in totals),
+                        "relation": ("gte" if any(
+                            t.get("relation") == "gte" for t in totals)
+                            else "eq"),
+                    }
                 return {
-                    "hits": {
-                        "total": {"value": total, "relation": "eq"},
-                        "max_score": max(max_scores) if max_scores else None,
-                        "hits": all_hits[from_:from_ + size],
-                    },
+                    "hits": hits_obj,
                     "_clusters": {
                         "total": len(remote_parts) + (1 if local_parts else 0),
                         "successful": len(subs), "skipped": 0,
@@ -1153,16 +1189,20 @@ class Engine:
                 seen_keys.add(marker)
                 deduped.append(h)
             all_hits = deduped
-        total = sum(r["hits"]["total"]["value"] for r in sub_results)
+        totals = [r["hits"]["total"] for r in sub_results if "total" in r["hits"]]
         max_scores = [r["hits"]["max_score"] for r in sub_results
                       if r["hits"]["max_score"] is not None]
-        return {
-            "hits": {
-                "total": {"value": total, "relation": "eq"},
-                "max_score": max(max_scores) if max_scores else None,
-                "hits": all_hits[from_:from_ + size],
-            },
+        hits_obj = {
+            "max_score": max(max_scores) if max_scores else None,
+            "hits": all_hits[from_:from_ + size],
         }
+        if len(totals) == len(sub_results):
+            hits_obj["total"] = {
+                "value": sum(t["value"] for t in totals),
+                "relation": ("gte" if any(
+                    t.get("relation") == "gte" for t in totals) else "eq"),
+            }
+        return {"hits": hits_obj}
 
     # ---- scroll / point-in-time ------------------------------------------
 
@@ -1206,6 +1246,11 @@ class Engine:
 
         pins = self._pins_for(expression)
         request = dict(kwargs)
+        # scroll clients page until they've read hits.total: totals must be
+        # exact, never a pruned lower bound (the reference rejects
+        # track_total_hits in a scroll context and counts exactly)
+        request["track_total_hits"] = True
+        kwargs = request
         ctx = self.contexts.open(pins, scroll, request=request)
         with pinned(self, ctx):
             res = self.search_multi(expression, **kwargs)

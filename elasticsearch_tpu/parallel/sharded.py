@@ -109,8 +109,8 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
                 "order": put(vc.ivf["order"]),
                 "part_start": put(vc.ivf["part_start"]),
             }
-    if sp.dense_tfn is not None:
-        dev["dense_tfn"] = put(sp.dense_tfn)
+    if getattr(sp, "dense_tf", None) is not None:
+        dev["dense_tf"] = put(sp.dense_tf)
     if sp.pos_keys is not None:
         dev["pos_keys"] = put(sp.pos_keys)
     return dev
@@ -124,6 +124,9 @@ class StackedResult:
     total: int
     max_score: float | None
     aggregations: dict | None = None
+    # "eq" for exhaustive runs; "gte" when block-max pruning made the
+    # total a lower bound (reference: hits.total.relation)
+    total_relation: str = "eq"
 
 
 class StackedSearcher:
@@ -151,6 +154,46 @@ class StackedSearcher:
             "dense-tier packs bake default k1/b; rebuild with dense disabled"
         )
         self._cache: dict = {}
+        self._dense_tfn_fn = None
+        self.refresh_dense_tfn()
+
+    def refresh_dense_tfn(self):
+        """(Re)compute the scored dense tier dev["dense_tfn"] from the raw
+        tf rows + norms + CURRENT per-field avgdl — one elementwise device
+        pass, so stat drift (tiered refresh) never rebuilds the tier on the
+        host or re-transfers it."""
+        if "dense_tf" not in self.dev:
+            return
+        import itertools
+
+        if self._dense_tfn_fn is None:
+            slices = []
+            v0 = 0
+            for fld, group in itertools.groupby(self.sp.dense_fields):
+                c = sum(1 for _ in group)
+                slices.append((fld, v0, v0 + c, fld in self.sp.norms))
+                v0 += c
+            self._dense_slices = slices
+            k1, b = self.ctx.k1, self.ctx.b
+
+            def compute(tf, norms, avgdls):
+                parts = []
+                for i, (fld, a, c, hn) in enumerate(slices):
+                    tfa = tf[:, a:c, :]
+                    if hn:
+                        K = k1 * (1.0 - b + b * norms[fld] / avgdls[i])
+                        parts.append(tfa / (tfa + K[:, None, :]))
+                    else:
+                        parts.append(tfa / (tfa + k1))
+                return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+            self._dense_tfn_fn = jax.jit(compute)
+        avgdls = jnp.asarray(
+            [max(self._avgdl(fld), 1e-9) for fld, _a, _c, _hn in self._dense_slices],
+            jnp.float32,
+        )
+        self.dev["dense_tfn"] = self._dense_tfn_fn(
+            self.dev["dense_tf"], self.dev["norms"], avgdls)
 
     def _avgdl(self, fld):
         st = self.sp.field_stats.get(fld)
@@ -523,6 +566,200 @@ class StackedSearcher:
         )
         return s, ok
 
+    # -- block-max WAND ----------------------------------------------------
+
+    def search_wand(self, node, size: int, from_: int,
+                    floor: int = 0) -> StackedResult | None:
+        """Two-pass block-max pruned disjunction search; None when the query
+        shape doesn't qualify or pruning wouldn't reduce work. The returned
+        total is a LOWER bound (total_relation == "gte").
+
+        See query/wand.py for the plan and the soundness argument
+        (reference: Lucene block-max WAND via
+        search/query/QueryPhaseCollectorManager.java:416; SURVEY §7 hard
+        part #2 — skipping becomes block filtering).
+        """
+        from ..index.pack import BM25_K1, BM25_B
+        from ..query import wand
+
+        if (self.ctx.k1, self.ctx.b) != (BM25_K1, BM25_B):
+            return None
+        terms = wand.should_terms(node)
+        if terms is None:
+            return None
+        if floor:
+            # exact counting promised up to `floor` hits: prune only when
+            # the true total provably reaches it (total >= max term df)
+            if max(self.sp.global_df.get((t.fld, t.term), 0)
+                   for t in terms) < floor:
+                return None
+        S = self.sp.S
+        n = self.sp.n_max
+        if n == 0:
+            return None
+        k = min(max(size + from_, 1), max(n * S, 1))
+        views = [self.sp.shard_view(s) for s in range(S)]
+
+        # ---- host planning: per-term/per-shard sorted block upper bounds.
+        # All weight-free pieces (ubf order, window maxima) are cached on the
+        # pack per (shard, term), so a repeated query's host planning is a
+        # couple of dict hits + scalar scaling.
+        PASS1_ROWS = 4  # blocks/term/shard scored to seed θ (512 postings)
+        ubf_cache = getattr(self.sp, "_wand_ubf", None)
+        if ubf_cache is None:
+            ubf_cache = self.sp._wand_ubf = {}
+        infos = []  # per term: dict(weight, dense_row, rows[s], ubs[s])
+        csr_rows_total = 0
+        for t in terms:
+            params0, _key0 = t.prepare(views[0])  # sets t._dense; global weight
+            weight = float(params0[1])
+            avgdl = float(params0[2])
+            if t._dense:
+                infos.append({"dense": int(params0[0]), "weight": weight,
+                              "avgdl": avgdl})
+                continue
+            rows_s, ubs_s, wub_s = [], [], []
+            has_norms = t.fld in self.ctx.has_norms
+            for s in range(S):
+                p = self.sp.shards[s]
+                ck = (s, t.fld, t.term, round(avgdl, 9))
+                got = ubf_cache.get(ck)
+                if got is None:
+                    start, count, _df = p.term_blocks(t.fld, t.term)
+                    r, u = wand.term_row_ubf(
+                        p, start, count, avgdl, has_norms,
+                        self.ctx.k1, self.ctx.b,
+                    )
+                    wu = wand.window_ub_csr(p, r, u, p.num_docs)
+                    got = ubf_cache[ck] = (r, u, wu)
+                r, u, wu = got
+                rows_s.append(r)
+                ubs_s.append(weight * u)
+                wub_s.append(weight * wu)
+                csr_rows_total += len(r)
+            infos.append({"dense": None, "weight": weight, "avgdl": avgdl,
+                          "rows": rows_s, "ubs": ubs_s, "win": wub_s})
+        n_csr = sum(1 for i in infos if i["dense"] is None)
+        min_rows = getattr(self, "wand_min_rows", None)
+        if min_rows is None:
+            min_rows = max(32, 2 * S * n_csr)
+        if n_csr == 0 or csr_rows_total < min_rows:
+            return None  # too few blocks for pruning to pay for two launches
+
+        # per-shard, per-term window-localized upper bounds: win_ub[s][ti] is
+        # a [WINDOWS] array of the term's max block score per doc-id window
+        # (rare terms bound ~0 over most of doc space — the locality that
+        # makes block-max WAND prune; Lucene gets it from per-range maxes)
+        dense_win = getattr(self.sp, "_dense_win_tfn", None)
+        if dense_win is None:
+            dense_win = self.sp._dense_win_tfn = {}
+        win_ub = [[None] * len(infos) for _ in range(S)]
+        for ti, info in enumerate(infos):
+            for s in range(S):
+                if info["dense"] is not None:
+                    nd = self.sp.shards[s].num_docs
+                    dk = (s, info["dense"], round(info["avgdl"], 9))
+                    got = dense_win.get(dk)
+                    if got is None:
+                        got = wand.window_tfn_dense(
+                            self.sp.dense_tfn_host(info["dense"], s,
+                                                   info["avgdl"]), nd)
+                        dense_win[dk] = got
+                    win_ub[s][ti] = info["weight"] * got
+                else:
+                    win_ub[s][ti] = info["win"][s]
+
+        def synth(row_lists):
+            """params + struct keys for the disjunction with each CSR term's
+            block rows replaced by row_lists[t][s] (bucketed to a common
+            width across shards)."""
+            per_shard_params, term_keys = [], []
+            widths = {}
+            for ti, info in enumerate(infos):
+                if info["dense"] is None:
+                    widths[ti] = wand.bucket_width(max(
+                        len(row_lists[ti][s]) for s in range(S)))
+            for s in range(S):
+                sp_params = []
+                for ti, (t, info) in enumerate(zip(terms, infos)):
+                    w = np.float32(info["weight"])
+                    ad = np.float32(info["avgdl"])
+                    if info["dense"] is not None:
+                        sp_params.append((np.int32(info["dense"]), w, ad))
+                        if s == 0:
+                            term_keys.append(("term_dense", t.fld))
+                    else:
+                        sp_params.append(
+                            (wand.pad_rows_to(row_lists[ti][s], widths[ti]),
+                             w, ad))
+                        if s == 0:
+                            term_keys.append(("term", t.fld, widths[ti]))
+                per_shard_params.append(
+                    ((), (), tuple(sp_params), ()))
+            key = ("bool", ((), (), tuple(term_keys), ()), node._msm())
+            params = _stack_shard_params(
+                [(p, np.float32(node.boost)) for p in per_shard_params])
+            return params, tuple(key for _ in range(S))
+
+        # ---- pass 1: seed θ from each term's best blocks
+        p1_rows = [
+            [i["rows"][s][: min(PASS1_ROWS, len(i["rows"][s]))] for s in range(S)]
+            if i["dense"] is None else None
+            for i in infos
+        ]
+        params1, keys1 = synth(p1_rows)
+        fn1 = self._compiled(node, ("wand1", keys1), k, None, ())
+        g_scores1, _gs1, _gd1, _tot1, _ = jax.device_get(
+            fn1(self.dev, params1, {}))
+        valid1 = np.isfinite(g_scores1)
+        theta = float(g_scores1[k - 1]) if valid1.sum() >= k else -np.inf
+
+        # ---- pass 2: keep blocks that can still reach θ
+        p2_rows = []
+        kept = dropped = 0
+        boost = float(node.boost)
+        for ti, info in enumerate(infos):
+            if info["dense"] is not None:
+                p2_rows.append(None)
+                continue
+            rows_s = []
+            for s in range(S):
+                nd = self.sp.shards[s].num_docs
+                # Σ of the OTHER terms' window bounds (max-of-sum over each
+                # block's span inside prune_blocks is a valid, tighter bound
+                # than sum-of-max)
+                other = np.sum(
+                    [win_ub[s][tj] for tj in range(len(infos)) if tj != ti],
+                    axis=0, dtype=np.float32)
+                surv = wand.prune_blocks(
+                    self.sp.shards[s], nd, info["rows"][s], info["ubs"][s],
+                    other, theta / boost)
+                rows_s.append(surv)
+                kept += len(surv)
+                dropped += len(info["rows"][s]) - len(surv)
+            p2_rows.append(rows_s)
+        if dropped == 0:
+            return None  # pruning bought nothing; use the exhaustive plan
+        params2, keys2 = synth(p2_rows)
+        fn2 = self._compiled(node, ("wand2", keys2), k, None, ())
+        g_scores, g_shard, g_doc, total, _ = jax.device_get(
+            fn2(self.dev, params2, {}))
+        valid = np.isfinite(g_scores)
+        max_score = float(g_scores[0]) if valid.any() else None
+        end = max(size + from_, 0)
+        out = StackedResult(
+            g_shard[valid][from_:end].astype(np.int32),
+            g_doc[valid][from_:end].astype(np.int32),
+            g_scores[valid][from_:end].astype(np.float32),
+            int(total),
+            max_score,
+            None,
+        )
+        out.total_relation = "gte"
+        out.wand_stats = {"rows_kept": kept, "rows_pruned": dropped,
+                          "theta": theta}
+        return out
+
     def search(
         self,
         query: dict | QueryNode | None,
@@ -530,9 +767,17 @@ class StackedSearcher:
         from_: int = 0,
         aggs: dict | None = None,
         mappings=None,
+        prune_floor: int | None = None,
     ) -> StackedResult:
+        """prune_floor: None = exact (no block-max pruning); 0 = prune freely
+        (track_total_hits=false); N > 0 = prune only when the total provably
+        reaches N (the track_total_hits threshold contract)."""
         m = mappings if mappings is not None else self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        if prune_floor is not None and not aggs:
+            res = self.search_wand(node, size, from_, floor=prune_floor)
+            if res is not None:
+                return res
         agg_nodes = None
         if aggs:
             from ..aggs import parse_aggs

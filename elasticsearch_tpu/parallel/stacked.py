@@ -295,9 +295,12 @@ class StackedPack:
         # ---- global dense tier -------------------------------------------
         # tier membership must be a GLOBAL decision (global df) so every
         # shard's query plan routes each term identically — the per-shard
-        # program is traced once for the whole mesh. tfn rows bake the
-        # GLOBAL avgdl (dfs_query_then_fetch stats, like all scoring here).
-        from ..index.pack import compute_tfn, default_dense_min_df
+        # program is traced once for the whole mesh. RAW tf rows are stored
+        # (dense_tf); the scored tfn rows are computed ON DEVICE from
+        # (tf, norms, avgdl) by the searcher — avgdl is a runtime input, so
+        # stat drift from tiered refreshes re-norms the tier with one
+        # elementwise device pass instead of a host rebuild + transfer.
+        from ..index.pack import default_dense_min_df
 
         n_total = sum(p.num_docs for p in shards)
         thresh = dense_min_df if dense_min_df is not None else default_dense_min_df(n_total)
@@ -305,13 +308,12 @@ class StackedPack:
         self.dense_dict: dict[tuple[str, str], int] = {
             k: i for i, k in enumerate(dense_keys)
         }
-        self.dense_tfn = None
+        self.dense_fields: list[str] = [k[0] for k in dense_keys]
+        self.dense_tf = None
         if dense_keys:
-            self.dense_tfn = np.zeros((self.S, len(dense_keys), self.n_max), np.float32)
+            self.dense_tf = np.zeros((self.S, len(dense_keys), self.n_max), np.float32)
             for i, k in enumerate(dense_keys):
                 fld = k[0]
-                st = self.field_stats.get(fld, {"sum_dl": 0.0, "doc_count": 0})
-                avgdl = st["sum_dl"] / max(st["doc_count"], 1) or 1.0
                 for s, p in enumerate(shards):
                     s0, nb, _df = p.term_blocks(fld, k[1])
                     if nb == 0:
@@ -320,9 +322,23 @@ class StackedPack:
                     valid = docs < p.num_docs
                     docs = docs[valid]
                     tfs = p.post_tfs[s0 : s0 + nb].ravel()[valid]
-                    has_norms = fld in p.norms
-                    dls = p.post_dls[s0 : s0 + nb].ravel()[valid] if has_norms else None
-                    self.dense_tfn[s, i, docs] = compute_tfn(tfs, dls, avgdl, has_norms)
+                    self.dense_tf[s, i, docs] = tfs
+
+    def dense_tfn_host(self, row: int, shard: int, avgdl: float,
+                       k1: float | None = None, b: float | None = None) -> np.ndarray:
+        """One dense row's tfn computed host-side with the CURRENT avgdl
+        (WAND planning bounds; the bulk tfn tier lives on device)."""
+        from ..index.pack import BM25_K1, BM25_B
+
+        k1 = BM25_K1 if k1 is None else k1
+        b = BM25_B if b is None else b
+        tf = self.dense_tf[shard, row]
+        fld = self.dense_fields[row]
+        if fld in self.norms:
+            K = k1 * (1.0 - b + b * self.norms[fld][shard] / max(avgdl, 1e-9))
+        else:
+            K = k1
+        return (tf / np.maximum(tf + K, 1e-9)).astype(np.float32)
 
     @property
     def num_docs(self) -> int:
@@ -360,6 +376,10 @@ class StackedPack:
                     walk(v)
 
         walk({k: v for k, v in vars(self).items() if k != "mappings"})
+        if self.dense_tf is not None:
+            # the searcher materializes the derived dense_tfn alongside the
+            # raw tf rows on device — admit both copies
+            total += self.dense_tf.nbytes
         self._nbytes_cache = total
         return total
 
@@ -377,7 +397,8 @@ def route_docs(
 
 
 def build_stacked_pack_routed(
-    routed: list[list[tuple[str, dict]]], mappings: Mappings
+    routed: list[list[tuple[str, dict]]], mappings: Mappings,
+    dense_min_df: int | None = None,
 ) -> StackedPack:
     builders = [PackBuilder(mappings) for _ in range(len(routed))]
     for b, shard_docs in zip(builders, routed):
@@ -391,12 +412,14 @@ def build_stacked_pack_routed(
         # source references (shared with EsIndex.shard_docs) for host-side
         # per-object matching (nested queries, query/nested.py)
         p.doc_sources = [src for _, src in shard_docs]
-    return StackedPack(packs, mappings)
+    return StackedPack(packs, mappings, dense_min_df=dense_min_df)
 
 
 def build_stacked_pack(
-    docs: list[tuple[str, dict]], mappings: Mappings, num_shards: int
+    docs: list[tuple[str, dict]], mappings: Mappings, num_shards: int,
+    dense_min_df: int | None = None,
 ) -> StackedPack:
     """Route (id, source) docs to shards (Murmur3 like the reference) and
     pack each shard."""
-    return build_stacked_pack_routed(route_docs(docs, num_shards), mappings)
+    return build_stacked_pack_routed(
+        route_docs(docs, num_shards), mappings, dense_min_df=dense_min_df)

@@ -98,25 +98,28 @@ class TermNode(QueryNode):
             weight = np.float32(0.0)
         dr = pack.dense_row_of(self.fld, self.term)
         self._dense = dr is not None
+        # avgdl rides as a runtime param (not a trace constant) so compiled
+        # plans survive stat drift as tiered refreshes add documents
+        avgdl = np.float32(pack.avgdl(self.fld))
         if self._dense:
-            return (np.int32(dr), weight), ("term_dense", self.fld)
+            return (np.int32(dr), weight, avgdl), ("term_dense", self.fld)
         rows = _pad_rows(start, count)
-        return (rows, weight), ("term", self.fld, len(rows))
+        return (rows, weight, avgdl), ("term", self.fld, len(rows))
 
     def device_eval(self, dev, params, ctx):
         if self._dense:
             from ..ops.scoring import dense_term_scores
 
-            dr, weight = params
+            dr, weight, _avgdl = params
             return dense_term_scores(dev["dense_tfn"][dr], weight, ctx.num_docs)
-        rows, weight = params
+        rows, weight, avgdl = params
         return term_score_blocks(
             dev["post_docids"],
             dev["post_tfs"],
             dev["post_dls"],
             rows,
             weight,
-            ctx.avgdl.get(self.fld, 1.0),
+            avgdl,
             ctx.num_docs,
             ctx.k1,
             ctx.b,
@@ -468,7 +471,7 @@ class PhraseNode(QueryNode):
         rows = tuple(_pad_rows(ps, nb) for ps, nb, _c, _o in infos)
         offsets = np.array([o for _s, _n, _c, o in infos], np.int64)
         weight = np.float32(self.boost * idf_sum)
-        return (rows, offsets, weight), (
+        return (rows, offsets, weight, np.float32(pack.avgdl(self.fld))), (
             "phrase", self.fld, tuple(len(r) for r in rows),
         )
 
@@ -478,7 +481,7 @@ class PhraseNode(QueryNode):
         if self._no_pos:
             n1 = ctx.num_docs + DEAD_SLOT_PAD
             return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
-        rows, offsets, weight = params
+        rows, offsets, weight, avgdl = params
         n = ctx.num_docs
         n1 = n + DEAD_SLOT_PAD
         pos_keys = dev["pos_keys"]
@@ -498,7 +501,7 @@ class PhraseNode(QueryNode):
         tf = phrase_tf[:n]
         if self.fld in ctx.has_norms:
             dl = dev["norms"][self.fld]
-            denom = tf + ctx.k1 * (1.0 - ctx.b + ctx.b * dl / ctx.avgdl.get(self.fld, 1.0))
+            denom = tf + ctx.k1 * (1.0 - ctx.b + ctx.b * dl / avgdl)
         else:
             denom = tf + ctx.k1
         scores_n = jnp.where(tf > 0, weight * tf / denom, 0.0)
@@ -561,12 +564,12 @@ class ExpandedTermsNode(QueryNode):
         ws = np.zeros(width, np.float32)
         rows[: len(rows_list)] = rows_list
         ws[: len(w_list)] = w_list
-        return (rows, ws, np.float32(self.boost)), (
+        return (rows, ws, np.float32(self.boost), np.float32(pack.avgdl(self.fld))), (
             self.kind, self.fld, self.scored, width,
         )
 
     def device_eval(self, dev, params, ctx):
-        rows, ws, boost = params
+        rows, ws, boost, avgdl = params
         n1 = ctx.num_docs + DEAD_SLOT_PAD
         docids = dev["post_docids"][rows]  # [R, 128]
         tfs = dev["post_tfs"][rows]
@@ -578,7 +581,7 @@ class ExpandedTermsNode(QueryNode):
         has_norms = self.fld in ctx.has_norms
         if has_norms:
             dls = dev["post_dls"][rows]
-            denom = tfs + ctx.k1 * (1.0 - ctx.b + ctx.b * dls / ctx.avgdl.get(self.fld, 1.0))
+            denom = tfs + ctx.k1 * (1.0 - ctx.b + ctx.b * dls / avgdl)
         else:
             denom = tfs + ctx.k1
         lane_scores = ws[:, None] * tfs / denom

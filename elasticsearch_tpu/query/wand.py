@@ -1,0 +1,154 @@
+"""Block-max WAND planning for pure term disjunctions.
+
+TPU-shaped analog of Lucene's block-max WAND early termination (reference
+behavior: Lucene WANDScorer + hit-count thresholds wired through
+search/query/QueryPhaseCollectorManager.java:416). Branchy doc-at-a-time
+skipping becomes a two-launch plan:
+
+  pass 1: score only each term's best few blocks (by per-block upper-bound
+          score) -> the k-th partial score is a LOWER bound θ on the true
+          k-th score (every doc's partial sum <= its true sum);
+  pass 2: keep only blocks whose upper bound could still matter
+          (ub_t(block) + Σ_{t'≠t} max-ub(t') >= θ) and rescore exactly.
+
+Soundness: a true top-k doc d has score(d) >= θ; for any block b∋d of term
+t, ub_t(b) + Σ_{t'≠t} max-ub(t') >= score(d) >= θ, so every block carrying a
+top-k doc survives — pass 2's top-k equals the exhaustive top-k (scores AND
+docids; ties keep on the >= comparison). Pruned blocks only remove score
+mass from docs provably outside the top-k, so the pass-2 hit count is a
+LOWER bound: callers must report hits.total with relation "gte" (exactly the
+reference's track_total_hits threshold contract).
+
+Per-block upper bound (BM25 is monotone ↑ in tf and ↓ in dl):
+
+  ub(block) = weight * max_tf / (max_tf + k1*(1 - b + b*min_dl/avgdl))
+
+computed from the pack's block_max_tf / block_min_len metadata with the
+EXECUTION avgdl (the global dfs stats — not the shard-build avgdl, which
+would be unsound when shards skew).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .nodes import BoolNode, TermNode, _bucket
+
+
+def should_terms(node) -> list[TermNode] | None:
+    """The term list of a pure scoring disjunction, else None.
+
+    Pure = bool with only `should` clauses (>= 2), minimum_should_match <= 1,
+    every clause a TermNode, positive boost. (`match` on text parses to
+    exactly this shape — query/dsl.py.)
+    """
+    if not isinstance(node, BoolNode):
+        return None
+    if node.must or node.filter or node.must_not:
+        return None
+    if len(node.should) < 2:
+        return None
+    if node._msm() > 1:
+        return None
+    if not node.boost > 0.0:
+        return None
+    if not all(type(c) is TermNode for c in node.should):
+        return None
+    if not all(c.boost >= 0.0 for c in node.should):
+        return None
+    return list(node.should)
+
+
+def term_row_ubf(
+    pack, start: int, count: int,
+    avgdl: float, has_norms: bool, k1: float, b: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """-> (rows sorted by tf-saturation upper bound desc, ubf in that order).
+
+    ubf is the WEIGHT-FREE bound (max_tf saturation with the block's most
+    favorable doc length); a term's block score bound = weight * ubf, so one
+    cached (rows, ubf) pair serves every query/boost of the term."""
+    rows = np.arange(start, start + count, dtype=np.int32)
+    mtf = pack.block_max_tf[rows]
+    if has_norms:
+        K = k1 * (1.0 - b + b * pack.block_min_len[rows] / max(avgdl, 1e-9))
+    else:
+        K = np.float32(k1)
+    ubf = mtf / np.maximum(mtf + K, 1e-9)
+    order = np.argsort(-ubf, kind="stable")
+    return rows[order], ubf[order].astype(np.float32)
+
+
+def pad_rows_to(rows: np.ndarray, width: int) -> np.ndarray:
+    """Pad a row list with the reserved all-padding row 0 to `width`."""
+    out = np.zeros(width, np.int32)
+    out[: len(rows)] = rows
+    return out
+
+
+def bucket_width(n: int) -> int:
+    return _bucket(max(n, 1))
+
+
+# number of fixed doc-id windows per shard used to localize the other-terms
+# bound (the analog of Lucene's per-docid-range block maxes: a rare term
+# contributes nothing to ranges it has no postings in)
+WINDOWS = 64
+
+
+def _posting_windows(pack, rows: np.ndarray, num_docs: int):
+    """Per-lane window ids + validity for the given block rows."""
+    docids = pack.post_docids[rows]  # [B, 128]
+    valid = pack.post_tfs[rows] > 0
+    w_of = (docids.astype(np.int64) * WINDOWS // max(num_docs, 1)).clip(
+        0, WINDOWS - 1)
+    return w_of, valid
+
+
+def window_ub_csr(pack, rows, ubs, num_docs: int) -> np.ndarray:
+    """[WINDOWS] per-window max upper-bound score of a CSR term — exact
+    posting coverage: a window only carries a bound where the term actually
+    has postings (a rare term bounds ~0 over most of doc space)."""
+    out = np.zeros(WINDOWS, np.float32)
+    if len(rows) == 0 or num_docs == 0:
+        return out
+    w_of, valid = _posting_windows(pack, rows, num_docs)
+    ub_lanes = np.broadcast_to(np.asarray(ubs)[:, None], w_of.shape)
+    np.maximum.at(out, w_of[valid], ub_lanes[valid])
+    return out
+
+
+def window_tfn_dense(tfn_row: np.ndarray, num_docs: int) -> np.ndarray:
+    """[WINDOWS] per-window max tfn of a dense-tier term's row (weight-free;
+    a term's window score bound = weight * this)."""
+    out = np.zeros(WINDOWS, np.float32)
+    if num_docs == 0:
+        return out
+    edges = (np.arange(WINDOWS + 1) * num_docs) // WINDOWS
+    for w in range(WINDOWS):
+        a, b_ = edges[w], edges[w + 1]
+        if b_ > a:
+            out[w] = float(tfn_row[a:b_].max())
+    return out
+
+
+def prune_blocks(
+    pack,
+    num_docs: int,
+    rows: np.ndarray,
+    ubs: np.ndarray,
+    other_window_ub: np.ndarray,  # [WINDOWS] Σ of OTHER terms' window maxes
+    theta: float,
+) -> np.ndarray:
+    """Surviving block rows of one term: keep block b iff
+    ub(b) + max over b's postings' windows of Σ-other-terms' window bound
+    >= theta (any doc d in b scores <= ub(b) + other_window_ub[window(d)])."""
+    if len(rows) == 0:
+        return rows
+    if not np.isfinite(theta):
+        return rows if theta < 0 else rows[:0]
+    w_of, valid = _posting_windows(pack, rows, num_docs)
+    vals = np.where(valid, other_window_ub[w_of], -np.inf)
+    local = vals.max(axis=1)
+    keep = np.asarray(ubs) + local >= theta
+    return rows[keep]

@@ -1413,6 +1413,21 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- search ----------------------------------------------------------
 
+    def _track_total_hits_param(body, query_params):
+        v = body.get("track_total_hits")
+        if v is None:
+            raw = query_params.get("track_total_hits")
+            if raw is None:
+                return None
+            v = True if raw in ("", "true") else False if raw == "false" else raw
+        if isinstance(v, bool):
+            return v
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"[track_total_hits] must be a boolean or an integer, got [{v}]")
+
     def _bool_param(query_params, name, default=False):
         v = query_params.get(name)
         if v is None:
@@ -1455,6 +1470,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             search_after=search_after, script_fields=body.get("script_fields"),
             collapse=body.get("collapse"), rescore=body.get("rescore"),
             runtime_mappings=body.get("runtime_mappings"),
+            track_total_hits=_track_total_hits_param(body, query_params),
         )
         if pit is not None:
             if not isinstance(pit, dict) or "id" not in pit:
