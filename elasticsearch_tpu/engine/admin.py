@@ -137,7 +137,8 @@ def termvectors(engine, index: str, doc_id: str, body: dict | None,
                 )
                 last = max(last, tok.position)
             pos_base += last + 1 + 100
-        if term_stats and idx.searcher is not None:
+        if term_stats and idx._searcher is not None:
+            # the merging property: tail-tier terms must count in df
             pack = getattr(idx.searcher, "sp", None)
             for term, t in terms.items():
                 df = 0

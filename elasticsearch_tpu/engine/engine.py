@@ -109,8 +109,18 @@ class EsIndex:
         self._wal = None
         self._dirty = True
         self._last_refresh = 0.0
-        self.searcher: StackedSearcher | None = None
+        self._searcher: StackedSearcher | None = None
         self.shard_docs: list[list[tuple[str, dict]]] = []
+        # ---- tiered refresh state (Lucene-segment analog: a sealed base
+        # pack + a small tail pack; deletes/updates flip base live bits;
+        # SURVEY §7 hard part #3) ------------------------------------------
+        self._tail: StackedSearcher | None = None
+        self._tail_shard_docs: list[list[tuple[str, dict]]] = []
+        self._tail_docs: dict[str, dict] = {}  # id -> source, not in base
+        self._base_pos: dict[str, tuple[int, int]] = {}  # id -> (shard, docid)
+        self._base_stats: tuple[dict, dict] | None = None  # at base build
+        self._base_nbytes = 0
+        self._pending: set[str] = set()  # ids touched since last refresh
         # operation counters surfaced by _stats (reference behavior:
         # index/shard/ shard-level CommonStats)
         self.counters: dict[str, int] = {}
@@ -274,6 +284,7 @@ class EsIndex:
         src_json = json.dumps(source, separators=(",", ":"))
         source = json.loads(src_json)
         self.docs[doc_id] = _DocEntry(source, version, seq, True)
+        self._pending.add(doc_id)
         self._wal_append({"op": "index", "id": doc_id, "source": source, "version": version, "seq_no": seq})
         if len(self.mappings.fields) != n_fields:
             self._persist_meta()  # dynamic mappings grew
@@ -298,6 +309,7 @@ class EsIndex:
         e.version += 1
         e.seq_no = self.seq_no
         self.seq_no += 1
+        self._pending.add(doc_id)
         self._wal_append({"op": "delete", "id": doc_id, "version": e.version, "seq_no": e.seq_no})
         self._dirty = True
         self.counters["delete_total"] = self.counters.get("delete_total", 0) + 1
@@ -318,7 +330,82 @@ class EsIndex:
 
     # ---- refresh / search ------------------------------------------------
 
+    @property
+    def searcher(self) -> StackedSearcher | None:
+        """The single merged searcher. Consumers that are not tier-aware
+        (aggs, collapse, ESQL, suggest, …) read this; when a tail tier
+        exists it is merged into a fresh base first — the analog of a
+        force-merge ahead of an operation the tiered form can't serve."""
+        if self._tail is not None:
+            self._merge_tiers()
+        return self._searcher
+
+    @searcher.setter
+    def searcher(self, value):
+        self._searcher = value
+
     def refresh(self, mesh=None):
+        if self._searcher is not None and not self._pending and not self._dirty:
+            return  # nothing written since the last refresh
+        if self._can_refresh_incremental():
+            self._refresh_incremental()
+        else:
+            self._refresh_full(mesh)
+        self._dirty = False
+        self._last_refresh = time.monotonic()
+        self.counters["refresh_total"] = self.counters.get("refresh_total", 0) + 1
+
+    def _can_refresh_incremental(self) -> bool:
+        if self._searcher is None or self._base_stats is None:
+            return False
+        if getattr(self._searcher, "_pinned", False):
+            # a scroll/PIT context pinned this exact searcher: its live
+            # bitmap and stats are part of an immutable snapshot — rebuild
+            # a fresh base instead of mutating it in place
+            return False
+        base_n = sum(len(lst) for lst in self.shard_docs)
+        projected = len(self._tail_docs) + len(self._pending)
+        # tail growth bound: beyond ~10% of the base, merge (rebuild) —
+        # the analog of Lucene's merge policy folding small segments in
+        return projected <= max(256, base_n // 10)
+
+    def _merge_tiers(self):
+        """Fold the tail into a fresh sealed base WITHOUT changing search
+        visibility: rebuilds from exactly the currently-visible docs (live
+        base docs + tail docs), leaving pending unrefreshed writes pending.
+        Used when a non-tier-aware feature needs one merged view."""
+        from ..parallel.stacked import build_stacked_pack_routed, route_docs
+
+        base = self._searcher
+        visible = []
+        for s, lst in enumerate(self.shard_docs):
+            for d, (doc_id, src) in enumerate(lst):
+                if base.sp.live[s, d]:
+                    visible.append((doc_id, src))
+        visible.extend(sorted(self._tail_docs.items()))
+        routed = route_docs(visible, self.num_shards)
+        sp = build_stacked_pack_routed(routed, self.mappings)
+        if self._breaker_account is not None:
+            self._breaker_account(sp.nbytes())
+        self._searcher = StackedSearcher(sp, mesh=base.mesh)
+        self.shard_docs = routed
+        self._tail = None
+        self._tail_shard_docs = []
+        self._tail_docs = {}
+        self._base_pos = {
+            doc_id: (s, d)
+            for s, lst in enumerate(routed)
+            for d, (doc_id, _src) in enumerate(lst)
+        }
+        self._base_stats = (
+            {f: dict(st) for f, st in sp.field_stats.items()},
+            dict(sp.global_df),
+        )
+        self._base_nbytes = sp.nbytes()
+
+    def _refresh_full(self, mesh=None):
+        """Rebuild everything from live docs (a full merge: one sealed base,
+        no tail, stats reset to live-only)."""
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
         live_docs = [(i, e.source) for i, e in self.docs.items() if e.alive]
@@ -332,15 +419,75 @@ class EsIndex:
             # old searcher stays live (HierarchyCircuitBreakerService analog)
             self._breaker_account(sp.nbytes())
         if mesh is None:
-            mesh = make_mesh(self.num_shards)
-        self.searcher = StackedSearcher(sp, mesh=mesh)
+            mesh = (self._searcher.mesh if self._searcher is not None
+                    else make_mesh(self.num_shards))
+        self._searcher = StackedSearcher(sp, mesh=mesh)
         self.shard_docs = routed
-        self._dirty = False
-        self._last_refresh = time.monotonic()
-        self.counters["refresh_total"] = self.counters.get("refresh_total", 0) + 1
+        self._tail = None
+        self._tail_shard_docs = []
+        self._tail_docs = {}
+        self._pending.clear()
+        self._base_pos = {
+            doc_id: (s, d)
+            for s, lst in enumerate(routed)
+            for d, (doc_id, _src) in enumerate(lst)
+        }
+        self._base_stats = (
+            {f: dict(st) for f, st in sp.field_stats.items()},
+            dict(sp.global_df),
+        )
+        self._base_nbytes = sp.nbytes()
+
+    def _refresh_incremental(self):
+        """Refresh proportional to the docs written since the last refresh:
+        flip base live bits for superseded/deleted docs, rebuild only the
+        small tail pack, and re-score both tiers under COMBINED statistics
+        (deleted docs keep counting in df/avgdl until a merge — exactly
+        Lucene's segment-stats behavior)."""
+        from ..parallel.stacked import build_stacked_pack_routed, route_docs
+
+        base = self._searcher
+        for did in self._pending:
+            e = self.docs.get(did)
+            pos = self._base_pos.get(did)
+            if pos is not None:
+                s, d = pos
+                if base.sp.live[s, d]:
+                    base.sp.shards[s].live[d] = False
+                    base.sp.live[s, d] = False
+                    base.sp.dead_count = getattr(base.sp, "dead_count", 0) + 1
+            if e is not None and e.alive:
+                self._tail_docs[did] = e.source
+            else:
+                self._tail_docs.pop(did, None)
+        self._pending.clear()
+        base.update_live()
+        routed = route_docs(sorted(self._tail_docs.items()), self.num_shards)
+        tail_sp = build_stacked_pack_routed(routed, self.mappings,
+                                            dense_min_df=1 << 62)
+        # combined stats = base stats AT BUILD (dead docs included, like
+        # Lucene until merge) + tail stats
+        fs = {f: dict(st) for f, st in self._base_stats[0].items()}
+        for f, st in tail_sp.field_stats.items():
+            g = fs.setdefault(f, {"sum_dl": 0.0, "doc_count": 0})
+            g["sum_dl"] += st["sum_dl"]
+            g["doc_count"] += st["doc_count"]
+        gdf = dict(self._base_stats[1])
+        for key, v in tail_sp.global_df.items():
+            gdf[key] = gdf.get(key, 0) + v
+        override = {"field_stats": fs, "global_df": gdf}
+        base.sp.stats_override = override
+        tail_sp.stats_override = override
+        tail_sp.dead_count = getattr(base.sp, "dead_count", 0)
+        if self._breaker_account is not None:
+            self._breaker_account(self._base_nbytes + tail_sp.nbytes())
+        self._tail = StackedSearcher(tail_sp, mesh=base.mesh)
+        self._tail_shard_docs = routed
+        # avgdl may have drifted: re-norm the base dense tier on device
+        base.refresh_dense_tfn()
 
     def _maybe_refresh(self):
-        if self.searcher is None:  # safety; construction always refreshes
+        if self._searcher is None:  # safety; construction always refreshes
             self.refresh()
             return
         if not self._dirty:
@@ -472,6 +619,18 @@ class EsIndex:
             prune_floor = 0
         else:
             prune_floor = int(track_total_hits)
+        # ---- tiered fast path: base + tail searched separately, merged at
+        # this coordinator (the per-segment search of the reference). Falls
+        # through (auto-merging via the searcher property) for features the
+        # tiered form doesn't serve.
+        if (self._tail is not None and not aggs and sort is None
+                and knn is None and collapse is None and rescore is None
+                and not runtime_mappings and search_after is None
+                and not script_fields):
+            node = self._tier_node(query)
+            if node is not None:
+                return self._search_tiered(node, size, from_, prune_floor,
+                                           track_total_hits)
         m_eff = None
         if runtime_mappings:
             import copy
@@ -679,8 +838,81 @@ class EsIndex:
             **({"aggregations": res.aggregations} if res.aggregations is not None else {}),
         }
 
+    def _tier_node(self, query):
+        """Parse `query` once and return the node if it can be evaluated per
+        tier and merged (every node scores docs independently of other docs'
+        identities), else None. Nodes that resolve documents across the
+        index at prepare time (knn candidates, more-like-this by id,
+        percolate, pinned ids, nested host sets) must see the merged
+        index."""
+        from ..query.dsl import parse_query
+        from ..query.nodes import (
+            BoolNode, ConstantScoreNode, DisMaxNode, ExistsNode,
+            ExpandedTermsNode, MatchAllNode, MatchNoneNode, PhraseNode,
+            RangeNode, TermNode, TermsNode,
+        )
+
+        safe = (TermNode, MatchAllNode, MatchNoneNode, RangeNode, TermsNode,
+                ExistsNode, PhraseNode, ExpandedTermsNode)
+
+        def ok(node):
+            if isinstance(node, BoolNode):
+                return all(ok(c) for grp in (node.must, node.filter,
+                                             node.should, node.must_not)
+                           for c in grp)
+            if isinstance(node, ConstantScoreNode):
+                return ok(node.child)
+            if isinstance(node, DisMaxNode):
+                return all(ok(c) for c in node.children)
+            return isinstance(node, safe)
+
+        try:
+            node = parse_query(query, self.mappings)
+        except Exception:  # noqa: BLE001 - let the normal path raise it
+            return None
+        return node if ok(node) else None
+
+    def _search_tiered(self, node, size, from_, prune_floor,
+                       track_total_hits) -> dict:
+        # the SAME parsed node serves both tiers: each search() call runs
+        # prepare() immediately before its own execution, so per-searcher
+        # prepare state (dense-tier routing) never crosses tiers
+        k = max(size + from_, 1)
+        rb = self._searcher.search(node, size=k, prune_floor=prune_floor)
+        rt = self._tail.search(node, size=k)
+        rows = []
+        for tier, r in ((0, rb), (1, rt)):
+            for rank, (s, d, sc) in enumerate(
+                    zip(r.doc_shards, r.doc_ids, r.scores)):
+                rows.append((-float(sc), tier, rank, int(s), int(d)))
+        # (score desc, tier asc, per-tier rank asc) = Lucene TopDocs.merge
+        # order with tail shards indexed after base shards
+        rows.sort()
+        hits = []
+        for negsc, tier, _rank, s, d in rows[from_: from_ + size]:
+            docs = self.shard_docs if tier == 0 else self._tail_shard_docs
+            doc_id, src = docs[s][d]
+            hits.append({"_index": self.name, "_id": doc_id,
+                         "_score": -negsc, "_source": src})
+        relation = ("gte" if "gte" in (rb.total_relation, rt.total_relation)
+                    else "eq")
+        value = rb.total + rt.total
+        if relation == "gte" and prune_floor:
+            value = max(value, prune_floor)
+        max_score = max((x for x in (rb.max_score, rt.max_score)
+                         if x is not None), default=None)
+        hits_obj = {"total": {"value": value, "relation": relation},
+                    "max_score": max_score, "hits": hits}
+        if track_total_hits is False:
+            del hits_obj["total"]
+        return {"hits": hits_obj}
+
     def count(self, query=None) -> int:
         self._maybe_refresh()
+        if self._tail is not None:
+            node = self._tier_node(query)
+            if node is not None:
+                return self._searcher.count(node) + self._tail.count(node)
         return self.searcher.count(query)
 
     def explain(self, doc_id: str, query=None) -> dict:
@@ -1212,7 +1444,9 @@ class Engine:
         pins = []
         for idx, _ in self.resolve_search(expression):
             idx._maybe_refresh()
-            pins.append(_Pin(idx.name, idx.searcher, idx.shard_docs))
+            searcher = idx.searcher  # merges any tail: pins are single-tier
+            searcher._pinned = True  # incremental refresh must not mutate it
+            pins.append(_Pin(idx.name, searcher, idx.shard_docs))
         return pins
 
     def open_pit(self, expression, keep_alive) -> str:

@@ -196,10 +196,19 @@ class StackedSearcher:
             self.dev["dense_tf"], self.dev["norms"], avgdls)
 
     def _avgdl(self, fld):
-        st = self.sp.field_stats.get(fld)
+        st = self.sp.eff_field_stats.get(fld)
         if not st or st["doc_count"] == 0:
             return 1.0
         return st["sum_dl"] / st["doc_count"]
+
+    def update_live(self):
+        """Re-ship the live-docs bitmap after host-side flips (tiered
+        refresh marks superseded/deleted base docs dead in place)."""
+        if self.mesh is not None:
+            self.dev["live"] = jax.device_put(
+                self.sp.live, NamedSharding(self.mesh, P("shards")))
+        else:
+            self.dev["live"] = jnp.asarray(self.sp.live)
 
     def _compiled(self, node, key, k, agg_nodes, agg_key):
         cache_key = (key, k, agg_key, self.mesh is None)
@@ -589,9 +598,12 @@ class StackedSearcher:
             return None
         if floor:
             # exact counting promised up to `floor` hits: prune only when
-            # the true total provably reaches it (total >= max term df)
-            if max(self.sp.global_df.get((t.fld, t.term), 0)
-                   for t in terms) < floor:
+            # the true total provably reaches it. df counts postings at pack
+            # build; docs deleted in place since (tiered refresh) may be
+            # among them, so the proven bound is max df - dead docs.
+            dead = getattr(self.sp, "dead_count", 0)
+            if max(self.sp.eff_global_df.get((t.fld, t.term), 0)
+                   for t in terms) - dead < floor:
                 return None
         S = self.sp.S
         n = self.sp.n_max

@@ -52,7 +52,7 @@ class _ShardView:
 
     @property
     def field_stats(self):
-        return self.stacked.field_stats
+        return self.stacked.eff_field_stats
 
     @property
     def docvalues(self):
@@ -74,14 +74,14 @@ class _ShardView:
         return self.pack.text_present
 
     def avgdl(self, fld):
-        st = self.stacked.field_stats.get(fld)
+        st = self.stacked.eff_field_stats.get(fld)
         if not st or st["doc_count"] == 0:
             return 1.0
         return st["sum_dl"] / st["doc_count"]
 
     def term_blocks(self, fld, term):
         s, n, df = self.pack.term_blocks(fld, term)
-        return s, n, self.stacked.global_df.get((fld, term), df)
+        return s, n, self.stacked.eff_global_df.get((fld, term), df)
 
     def dense_row_of(self, fld, term):
         # global tier decision: identical on every shard (see StackedPack)
@@ -107,6 +107,11 @@ class StackedPack:
         self.mappings = mappings
         self.S = len(shards)
         self._nbytes_cache: int | None = None
+        # tiered refresh: when this pack is one tier of a (base, tail) pair,
+        # the engine overrides the scoring statistics with the COMBINED
+        # stats so both tiers score identically (the reference's analog:
+        # Lucene collection statistics span all segments at reader open)
+        self.stats_override: dict | None = None
         self.n_max = max((p.num_docs for p in shards), default=0)
         self.nb_max = max((p.num_blocks for p in shards), default=1)
 
@@ -339,6 +344,18 @@ class StackedPack:
         else:
             K = k1
         return (tf / np.maximum(tf + K, 1e-9)).astype(np.float32)
+
+    @property
+    def eff_field_stats(self) -> dict:
+        if self.stats_override is not None:
+            return self.stats_override["field_stats"]
+        return self.field_stats
+
+    @property
+    def eff_global_df(self) -> dict:
+        if self.stats_override is not None:
+            return self.stats_override["global_df"]
+        return self.global_df
 
     @property
     def num_docs(self) -> int:

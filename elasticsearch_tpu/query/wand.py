@@ -124,7 +124,10 @@ def window_tfn_dense(tfn_row: np.ndarray, num_docs: int) -> np.ndarray:
     out = np.zeros(WINDOWS, np.float32)
     if num_docs == 0:
         return out
-    edges = (np.arange(WINDOWS + 1) * num_docs) // WINDOWS
+    # ceil edges: window w covers exactly {d : d*WINDOWS//num_docs == w},
+    # matching _posting_windows' assignment (floor edges would exclude up
+    # to one boundary doc per window and under-bound it)
+    edges = (np.arange(WINDOWS + 1) * num_docs + WINDOWS - 1) // WINDOWS
     for w in range(WINDOWS):
         a, b_ = edges[w], edges[w + 1]
         if b_ > a:

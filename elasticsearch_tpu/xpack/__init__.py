@@ -295,7 +295,7 @@ def enrich_lookup(engine, policy_name: str, value) -> dict | None:
 def health_report(engine) -> dict:
     indicators = {}
     # shards availability: green when every index has a live searcher
-    unassigned = [n for n, i in engine.indices.items() if i.searcher is None]
+    unassigned = [n for n, i in engine.indices.items() if i._searcher is None]
     indicators["shards_availability"] = {
         "status": "red" if unassigned else "green",
         "symptom": ("This cluster has unavailable shards"

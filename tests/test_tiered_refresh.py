@@ -1,0 +1,180 @@
+"""Tiered (incremental) refresh: base pack stays sealed, small writes land
+in a tail pack, deletes/updates flip base live bits; results and scores
+match a full rebuild for pure additions, and heavy features auto-merge.
+
+Reference: Lucene segments + merges under InternalEngine
+(index/engine/InternalEngine.java:1387); SURVEY §7 hard part #3 (tiered
+device packs + host tail).
+"""
+
+import numpy as np
+
+from elasticsearch_tpu.engine import Engine
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "long"},
+                          "tag": {"type": "keyword"}}}
+
+
+def _fill(idx, n, seed=0, prefix="d"):
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        words = " ".join(f"w{int(x) % 50}" for x in rng.integers(0, 50, 6))
+        idx.index_doc(f"{prefix}{i}", {"body": words, "n": i,
+                                       "tag": f"t{i % 7}"})
+
+
+def test_incremental_refresh_keeps_base_sealed():
+    e = Engine(None)
+    e.create_index("t", MAPPING)
+    idx = e.indices["t"]
+    _fill(idx, 3000)
+    idx.refresh()
+    base = idx._searcher
+    base_sp = base.sp
+    # a small write burst refreshes incrementally: base untouched
+    for i in range(10):
+        idx.index_doc(f"new{i}", {"body": f"fresh w{i}", "n": 9000 + i,
+                                  "tag": "fresh"})
+    idx.refresh()
+    assert idx._searcher is base, "base searcher must be reused"
+    assert idx._searcher.sp is base_sp, "base pack must not be rebuilt"
+    assert idx._tail is not None
+    assert sum(len(l) for l in idx._tail_shard_docs) == 10
+
+
+def test_tiered_search_matches_full_rebuild_for_additions():
+    docs = {}
+    e1 = Engine(None)
+    e1.create_index("a", MAPPING)
+    i1 = e1.indices["a"]
+    _fill(i1, 2000, seed=1)
+    i1.refresh()
+    _fill(i1, 30, seed=2, prefix="x")  # writes after the base seal
+    i1.refresh()
+    assert i1._tail is not None
+
+    e2 = Engine(None)
+    e2.create_index("a", MAPPING)
+    i2 = e2.indices["a"]
+    _fill(i2, 2000, seed=1)
+    _fill(i2, 30, seed=2, prefix="x")
+    i2.refresh()
+    assert i2._tail is None
+
+    for q in [
+        {"match": {"body": "w1 w2"}},
+        {"term": {"body": "w3"}},
+        {"bool": {"must": [{"term": {"body": "w5"}}],
+                  "filter": [{"range": {"n": {"lt": 1500}}}]}},
+        {"match_all": {}},
+        None,
+    ]:
+        r1 = i1.search(query=q, size=12)
+        r2 = i2.search(query=q, size=12)
+        assert r1["hits"]["total"] == r2["hits"]["total"], q
+        ids1 = [h["_id"] for h in r1["hits"]["hits"]]
+        ids2 = [h["_id"] for h in r2["hits"]["hits"]]
+        assert ids1 == ids2, (q, ids1, ids2)
+        s1 = [h["_score"] for h in r1["hits"]["hits"]]
+        s2 = [h["_score"] for h in r2["hits"]["hits"]]
+        np.testing.assert_allclose(s1, s2, rtol=1e-5, err_msg=str(q))
+        # counts agree too
+        if q is not None:
+            assert i1.count(q) == i2.count(q)
+
+
+def test_tiered_updates_and_deletes():
+    e = Engine(None)
+    e.create_index("u", MAPPING)
+    idx = e.indices["u"]
+    _fill(idx, 1500, seed=3)
+    idx.refresh()
+    base = idx._searcher
+    # update 5 docs, delete 5 docs
+    for i in range(5):
+        idx.index_doc(f"d{i}", {"body": "updated special", "n": -1,
+                                "tag": "upd"})
+    for i in range(10, 15):
+        idx.delete_doc(f"d{i}")
+    idx.refresh()
+    assert idx._searcher is base  # still incremental
+    assert idx._tail is not None
+    # updated docs found under the new content, not the old
+    r = idx.search(query={"match": {"body": "special"}}, size=10)
+    got = {h["_id"] for h in r["hits"]["hits"]}
+    assert got == {f"d{i}" for i in range(5)}
+    # deleted docs are gone
+    r = idx.search(query={"match_all": {}}, size=2000)
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    for i in range(10, 15):
+        assert f"d{i}" not in ids
+    assert r["hits"]["total"]["value"] == 1495
+    # realtime get agrees
+    assert idx.get_doc("d10") is None
+    assert idx.get_doc("d0")["_source"]["tag"] == "upd"
+
+
+def test_unsupported_features_auto_merge():
+    e = Engine(None)
+    e.create_index("m", MAPPING)
+    idx = e.indices["m"]
+    _fill(idx, 1200, seed=4)
+    idx.refresh()
+    idx.index_doc("extra", {"body": "w1 w1 w1", "n": 77, "tag": "zz"})
+    idx.refresh()
+    assert idx._tail is not None
+    # aggregations need the merged view; the tail doc must be counted
+    r = idx.search(query=None, size=0,
+                   aggs={"m": {"max": {"field": "n"}}})
+    assert idx._tail is None, "aggs should trigger a merge"
+    assert r["aggregations"]["m"]["value"] == 1199.0
+    r = idx.search(query={"term": {"tag": "zz"}}, size=5)
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["extra"]
+
+
+def test_tail_growth_triggers_merge():
+    e = Engine(None)
+    e.create_index("g", MAPPING)
+    idx = e.indices["g"]
+    _fill(idx, 400, seed=5)
+    idx.refresh()
+    base = idx._searcher
+    # tail bound is max(256, base//10) = 256: stay under, then exceed
+    _fill(idx, 200, seed=6, prefix="y")
+    idx.refresh()
+    assert idx._searcher is base and idx._tail is not None
+    _fill(idx, 100, seed=7, prefix="z")
+    idx.refresh()  # 200 + 100 > 256 -> merge
+    assert idx._searcher is not base
+    assert idx._tail is None
+    r = idx.search(query={"match_all": {}}, size=1)
+    assert r["hits"]["total"]["value"] == 700
+
+
+def test_pinned_scroll_survives_incremental_refresh():
+    """A scroll/PIT pin is an immutable snapshot: later incremental
+    refreshes must not flip its live bits or drift its stats."""
+    e = Engine(None)
+    e.create_index("p", MAPPING)
+    idx = e.indices["p"]
+    _fill(idx, 600, seed=8)
+    idx.refresh()
+    r1 = e.scroll_search("p", "1m", query={"match_all": {}}, size=100)
+    sid = r1["_scroll_id"]
+    assert r1["hits"]["total"]["value"] == 600
+    # writes + refresh while the scroll is open
+    idx.delete_doc("d0")
+    idx.index_doc("late", {"body": "w1", "n": 1, "tag": "x"})
+    idx.refresh()
+    # scroll pages keep seeing the pinned snapshot: all 600 originals
+    seen = {h["_id"] for h in r1["hits"]["hits"]}
+    while True:
+        r = e.continue_scroll(sid)
+        if not r["hits"]["hits"]:
+            break
+        seen.update(h["_id"] for h in r["hits"]["hits"])
+    assert len(seen) == 600 and "d0" in seen and "late" not in seen
+    # fresh searches see the new state
+    r = idx.search(query={"match_all": {}}, size=1)
+    assert r["hits"]["total"]["value"] == 600  # -1 +1

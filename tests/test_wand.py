@@ -179,3 +179,16 @@ def test_match_query_engages_wand_through_engine():
         assert t["value"] >= 50
     else:
         assert t == r_exact["hits"]["total"]
+
+
+def test_window_edges_match_posting_assignment():
+    """Every doc's window (docid*W//n) must fall inside the dense window
+    partition's edges for that window — boundary docs must not be excluded
+    from their window's max (soundness of the dense-term bound)."""
+    from elasticsearch_tpu.query.wand import WINDOWS
+
+    for n in [1, 2, 5, 63, 64, 65, 100, 127, 128, 129, 1000, 4097]:
+        edges = (np.arange(WINDOWS + 1) * n + WINDOWS - 1) // WINDOWS
+        d = np.arange(n)
+        w_of = d * WINDOWS // n
+        assert (d >= edges[w_of]).all() and (d < edges[w_of + 1]).all(), n
