@@ -51,6 +51,9 @@ A_MASTER_TASK = "internal:cluster/master_task"
 
 class ClusterNode:
     REPLICATION_TIMEOUT = 5.0
+    # a shard's FIRST search pays pack build + XLA compile (tens of seconds
+    # on a cold process); steady-state searches are milliseconds
+    SEARCH_TIMEOUT = 60.0
 
     def __init__(self, node_id: str, voting_nodes: list[str], network,
                  roles: list[str] | None = None):
@@ -70,7 +73,8 @@ class ClusterNode:
         self.service.register_async_handler(A_BULK_PRIMARY, self._on_bulk_primary)
         self.service.register_handler(A_BULK_REPLICA, self._on_bulk_replica)
         self.service.register_handler(A_GET, self._on_get)
-        self.service.register_handler(A_SHARD_SEARCH, self._on_shard_search)
+        self.service.register_async_handler(A_SHARD_SEARCH,
+                                            self._on_shard_search_async)
         self.service.register_handler(A_START_RECOVERY, self._on_start_recovery)
         self.service.register_async_handler(A_MASTER_TASK, self._on_master_task)
 
@@ -465,14 +469,21 @@ class ClusterNode:
             # coordinator merge: (score desc, shard asc, rank asc)
             hits = []
             total = 0
+            failed = 0
             for sh in sorted(partials):
                 p = partials[sh]
+                if p.get("error"):
+                    failed += 1  # partial results, like the reference's
+                    continue     # per-shard failures under _shards.failed
                 total += p["total"]
                 for rank, h in enumerate(p["hits"]):
                     hits.append((-h["_score"], sh, rank, h))
             hits.sort(key=lambda t: t[:3])
             merged = [h for _, _, _, h in hits[:size]]
             on_done({
+                "_shards": {"total": len(partials),
+                            "successful": len(partials) - failed,
+                            "skipped": 0, "failed": failed},
                 "hits": {
                     "total": {"value": total, "relation": "eq"},
                     "max_score": merged[0]["_score"] if merged else None,
@@ -480,22 +491,51 @@ class ClusterNode:
                 }
             })
 
+        class _LocalChannel:
+            """Local-shard responses go through the same async path as
+            remote ones (so compiles offload to the worker pool)."""
+
+            def __init__(self, shard):
+                self.shard = shard
+
+            def send_response(self, resp):
+                finish(self.shard, resp)
+
+            def send_failure(self, reason):
+                finish(self.shard, {"total": 0, "hits": [],
+                                    "error": str(reason)})
+
         req_body = {"index": index, "body": body, "size": size}
         for s, node in shard_targets.items():
             req = {**req_body, "shard": s}
             if node == self.node_id:
-                try:
-                    finish(s, self._on_shard_search(req, self.node_id))
-                except Exception as ex:
-                    finish(s, {"total": 0, "hits": [], "error": repr(ex)})
+                self._on_shard_search_async(req, self.node_id,
+                                            _LocalChannel(s))
             else:
                 self.service.send_request(
                     node, A_SHARD_SEARCH, req,
                     lambda resp, s=s: finish(s, resp),
                     lambda err, s=s: finish(s, {"total": 0, "hits": [],
                                                 "error": str(err)}),
-                    timeout=self.REPLICATION_TIMEOUT * 2,
+                    timeout=self.SEARCH_TIMEOUT,
                 )
+
+    def _build_shard_entry(self, seqno: int, live: list, mappings_dict: dict):
+        from ..index.mappings import Mappings
+        from ..parallel.sharded import StackedSearcher
+        from ..parallel.stacked import build_stacked_pack_routed
+
+        sp = build_stacked_pack_routed([live], Mappings(mappings_dict))
+        return (seqno, StackedSearcher(sp, mesh=None), live)
+
+    @staticmethod
+    def _hits_response(index: str, res, id_list: list) -> dict:
+        hits = []
+        for _sh, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
+            doc_id, src = id_list[int(d)]
+            hits.append({"_index": index, "_id": doc_id,
+                         "_score": float(score), "_source": src})
+        return {"total": res.total, "hits": hits}
 
     def _on_shard_search(self, req, from_node):
         """Per-shard query execution on the real engine pack (the data-node
@@ -507,30 +547,73 @@ class ClusterNode:
         searcher, id_list = self._searcher_for(index, copy)
         body = req.get("body") or {}
         res = searcher.search(body.get("query"), size=req.get("size", 10))
-        hits = []
-        for sh, d, score in zip(res.doc_shards, res.doc_ids, res.scores):
-            doc_id, src = id_list[int(d)]
-            hits.append({"_index": index, "_id": doc_id, "_score": float(score),
-                         "_source": src})
-        return {"total": res.total, "hits": hits}
+        return self._hits_response(index, res, id_list)
+
+    def _on_shard_search_async(self, req, from_node, channel):
+        """Shard search with long host work (pack build + XLA compile)
+        offloaded to the network's worker pool when it has one (TCP), so
+        the dispatch thread keeps serving leader checks — the reference's
+        separate `search` thread pool. The deterministic simulation network
+        has no pool: runs inline, preserving virtual-time determinism."""
+        offload = getattr(self.network, "offload", None)
+        if offload is None:
+            try:
+                res = self._on_shard_search(req, from_node)
+            except Exception as ex:  # noqa: BLE001
+                channel.send_failure(repr(ex))
+                return
+            channel.send_response(res)
+            return
+        index, s = req["index"], req["shard"]
+        copy = self.shards.get((index, s))
+        if copy is None:
+            channel.send_failure(f"no copy of [{index}][{s}] here")
+            return
+        key = (index, s)
+        body = req.get("body") or {}
+        size = req.get("size", 10)
+        # capture everything on the dispatch thread: the worker must not
+        # observe concurrent bulk mutations of copy.docs or cache evictions
+        cached = self._searchers.get(key)
+        if cached is not None and cached[0] == copy.max_seq_no:
+            entry_snapshot, snapshot = cached, None
+        else:
+            entry_snapshot = None
+            snapshot = (
+                copy.max_seq_no,
+                [(i, d.source) for i, d in sorted(copy.docs.items()) if d.alive],
+                dict(self.state.indices[index].get("mappings") or {}),
+            )
+
+        def work():
+            entry = entry_snapshot
+            if entry is None:
+                seqno, live, mappings = snapshot
+                cur = self._searchers.get(key)
+                if cur is not None and cur[0] == seqno:
+                    entry = cur  # another worker already built this seqno
+                else:
+                    entry = self._build_shard_entry(seqno, live, mappings)
+                    cur = self._searchers.get(key)
+                    if cur is None or cur[0] < seqno:  # never clobber newer
+                        self._searchers[key] = entry
+            _seq, searcher, id_list = entry
+            res = searcher.search(body.get("query"), size=size)
+            return self._hits_response(index, res, id_list)
+
+        offload(work, channel)
 
     def _searcher_for(self, index: str, copy: ShardCopy):
         key = (index, copy.shard_id)
         cached = self._searchers.get(key)
         if cached is not None and cached[0] == copy.max_seq_no:
             return cached[1], cached[2]
-        from ..index.mappings import Mappings
-        from ..parallel.sharded import StackedSearcher
-        from ..parallel.stacked import build_stacked_pack_routed
-
         meta = self.state.indices[index]
-        mappings = Mappings(dict(meta.get("mappings") or {}))
         live = [(i, d.source) for i, d in sorted(copy.docs.items()) if d.alive]
-        sp = build_stacked_pack_routed([live], mappings)
-        searcher = StackedSearcher(sp, mesh=None)
-        entry = (copy.max_seq_no, searcher, live)
+        entry = self._build_shard_entry(
+            copy.max_seq_no, live, dict(meta.get("mappings") or {}))
         self._searchers[key] = entry
-        return searcher, live
+        return entry[1], entry[2]
 
 
 def finish_group_cb(s, finish_group):

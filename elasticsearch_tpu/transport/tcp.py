@@ -1,0 +1,294 @@
+"""TCP transport: length-prefixed JSON frames over real sockets.
+
+The multi-process deployment backend for the control plane (reference
+behavior: transport/TcpTransport.java framing + TransportService dispatch;
+modules/transport-netty4/.../Netty4Transport.java:65 is the event-loop
+implementation, port 9300). The same `TransportService` contract the
+deterministic simulator implements (transport/deterministic.py) runs here
+over real sockets, so cluster code (coordination, replication, recovery)
+is byte-identical in-process and across processes.
+
+Wire format: 4-byte big-endian frame length + UTF-8 JSON:
+
+    {"k": "req", "from": node, "action": a, "rid": n, "body": ...}
+    {"k": "rsp", "from": node, "rid": n, "body": ..., "err": null | str}
+
+Concurrency model: ONE dispatch thread executes every TransportService
+callback (inbound handlers, responses, timeouts) — the single-threaded
+delivery semantics of the deterministic network, so handler code needs no
+locking. Reader threads only decode frames and enqueue work.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 512 * 1024 * 1024
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> dict | None:
+    head = _read_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME:
+        return None
+    body = _read_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+def frame_bytes(msg: dict) -> bytes:
+    body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    return _LEN.pack(len(body)) + body
+
+
+class TcpTransportNetwork:
+    """One node's endpoint: a listening server socket + outbound
+    connections to peers, satisfying the network contract TransportService
+    expects (`send`, `respond`, `schedule`, `attach`).
+
+    Peers are registered with `add_peer(node_id, host, port)` — the analog
+    of seed-host discovery handing out publish addresses.
+    """
+
+    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.node_id = node_id
+        self.host = host
+        self._service = None
+        self._peers: dict[str, tuple[str, int]] = {}
+        self._conns: dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._inbox: queue.Queue = queue.Queue()
+        self._inbound_routes: dict[tuple[str, int], socket.socket] = {}
+        self._timers: list[threading.Timer] = []
+        self._pool = None  # lazy search worker pool (see offload)
+        self._closed = False
+
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(64)
+        self.port = self._server.getsockname()[1]
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name=f"tpu-es-dispatch-{node_id}",
+            daemon=True)
+        self._dispatcher.start()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name=f"tpu-es-accept-{node_id}",
+            daemon=True)
+        self._acceptor.start()
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, node_id: str, service) -> None:
+        assert node_id == self.node_id, "one TcpTransportNetwork per node"
+        self._service = service
+
+    def add_peer(self, node_id: str, host: str, port: int) -> None:
+        self._peers[node_id] = (host, port)
+
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- dispatch thread ---------------------------------------------------
+
+    def _dispatch_loop(self):
+        while True:
+            fn = self._inbox.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a handler bug must not kill IO
+                import traceback
+
+                traceback.print_exc()
+
+    def submit(self, fn) -> None:
+        """Run fn on the dispatch thread (handler-safe entry from other
+        threads, e.g. a client driving the node)."""
+        self._inbox.put(fn)
+
+    def now(self) -> float:
+        """Wall clock (the deterministic network's virtual `queue.now`
+        counterpart)."""
+        import time
+
+        return time.monotonic()
+
+    def offload(self, work, channel) -> None:
+        """Run `work()` on the search worker pool and complete `channel`
+        with its result from the dispatch thread — long host work (pack
+        builds, XLA compiles) must never stall the dispatch thread, or
+        leader checks miss and elections churn (the reference's separate
+        `search` vs `cluster_coordination` thread pools,
+        threadpool/ThreadPool.java:66-110)."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix=f"tpu-es-search-{self.node_id}")
+
+        def run():
+            try:
+                res = work()
+            except Exception as ex:  # noqa: BLE001 - surfaced to the caller
+                self._inbox.put(lambda: channel.send_failure(repr(ex)))
+                return
+            self._inbox.put(lambda: channel.send_response(res))
+
+        self._pool.submit(run)
+
+    def schedule(self, delay: float, fn) -> None:
+        if self._closed:
+            return
+        t = threading.Timer(delay, lambda: self._inbox.put(fn))
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    # -- server side -------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._reader_loop, args=(conn,),
+                                 name=f"tpu-es-reader-{self.node_id}",
+                                 daemon=True)
+            t.start()
+
+    def _reader_loop(self, conn: socket.socket):
+        while not self._closed:
+            msg = read_frame(conn)
+            if msg is None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._inbox.put(lambda m=msg: self._deliver(m, conn))
+
+    def _deliver(self, msg: dict, conn: socket.socket | None = None):
+        svc = self._service
+        if svc is None:
+            return
+        if msg["k"] == "req":
+            if conn is not None:
+                # responses route back over the inbound connection, so
+                # callers outside the address book (clients) work too
+                self._inbound_routes[(msg["from"], msg["rid"])] = conn
+            svc.handle_inbound(msg["from"], msg["action"], msg["body"],
+                               msg["rid"])
+        elif msg["k"] == "rsp":
+            svc.handle_response(msg["rid"], msg["body"], msg.get("err"))
+
+    # -- client side -------------------------------------------------------
+
+    def _get_conn(self, to_node: str) -> socket.socket:
+        with self._conn_lock:
+            conn = self._conns.get(to_node)
+            if conn is not None:
+                return conn
+            addr = self._peers.get(to_node)
+            if addr is None:
+                raise ConnectionError(f"unknown node [{to_node}]")
+            conn = socket.create_connection(addr, timeout=5.0)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(None)
+            self._conns[to_node] = conn
+            # connections are duplex: responses to our requests come back
+            # over the same socket
+            threading.Thread(target=self._reader_loop, args=(conn,),
+                             name=f"tpu-es-reader-{self.node_id}",
+                             daemon=True).start()
+            return conn
+
+    def _transmit(self, to_node: str, msg: dict) -> bool:
+        data = frame_bytes(msg)
+        for _attempt in (0, 1):  # one reconnect on a stale pooled conn
+            try:
+                conn = self._get_conn(to_node)
+                with self._conn_lock:
+                    conn.sendall(data)
+                return True
+            except OSError:
+                with self._conn_lock:
+                    stale = self._conns.pop(to_node, None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+            except ConnectionError:
+                return False
+        return False
+
+    def send(self, from_node: str, to_node: str, action: str, request, rid: int):
+        ok = self._transmit(to_node, {
+            "k": "req", "from": from_node, "action": action,
+            "rid": rid, "body": request,
+        })
+        if not ok:
+            svc = self._service
+            if svc is not None:
+                self._inbox.put(lambda: svc.handle_connection_failure(
+                    rid, f"cannot connect to [{to_node}]"))
+
+    def respond(self, from_node: str, to_node: str, rid: int, response, error):
+        msg = {"k": "rsp", "from": from_node, "rid": rid,
+               "body": response, "err": error}
+        conn = self._inbound_routes.pop((to_node, rid), None)
+        if conn is not None:
+            try:
+                with self._conn_lock:
+                    conn.sendall(frame_bytes(msg))
+                return
+            except OSError:
+                pass  # inbound conn gone; try the address book
+        self._transmit(to_node, msg)
+        # a lost response surfaces as a timeout on the requester
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+        for t in self._timers:
+            t.cancel()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._inbox.put(None)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Demo: 3 OS processes form a cluster over TCP, elect a master, replicate
+writes, serve searches, and survive killing the elected master.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/tcp_cluster_demo.py
+
+Each node runs `elasticsearch_tpu.cluster.server` (the same ClusterNode the
+deterministic simulation tests exercise) over `transport/tcp.py` sockets —
+reference analog: three `bin/elasticsearch` processes on one host
+(transport/TcpTransport.java, port 9300 peers).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elasticsearch_tpu.cluster.server import TcpClient  # noqa: E402
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main():
+    ids = ["n1", "n2", "n3"]
+    ports = free_ports(3)
+    peers = ",".join(f"{i}=127.0.0.1:{p}" for i, p in zip(ids, ports))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = {
+        nid: subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.cluster.server",
+             "--node-id", nid, "--port", str(port), "--peers", peers],
+            env=env)
+        for nid, port in zip(ids, ports)
+    }
+    client = TcpClient()
+    for nid, port in zip(ids, ports):
+        client.add_node(nid, "127.0.0.1", port)
+    try:
+        print("== waiting for election ==")
+        sts = client.wait_for(
+            lambda sts: sum(1 for s in sts if s["mode"] == "LEADER") == 1,
+            ids, timeout=60.0)
+        leader = next(s["node"] for s in sts if s["mode"] == "LEADER")
+        print(f"leader elected: {leader} (term {sts[0]['term']})")
+
+        print("== creating index [logs] (1 shard, 1 replica) ==")
+        r = client.request(ids[0], "client:create_index",
+                           {"index": "logs",
+                            "settings": {"number_of_shards": 2,
+                                         "number_of_replicas": 1}})
+        print("  acknowledged:", r["acknowledged"])
+        client.wait_for(lambda sts: all(s["started_shards"] == 4 for s in sts),
+                        ids, timeout=60.0)
+        print("  all 4 shard copies STARTED")
+
+        print("== replicating 50 docs via a follower ==")
+        ops = [["index", f"doc{i}", {"msg": f"hello world {i}", "n": i}]
+               for i in range(50)]
+        follower = next(i for i in ids if i != leader)
+        r = client.request(follower, "client:bulk",
+                           {"index": "logs", "ops": ops})
+        print("  errors:", r["errors"])
+
+        r = client.request(ids[2], "client:search",
+                           {"index": "logs",
+                            "body": {"query": {"match": {"msg": "hello"}}},
+                            "size": 3}, timeout=90.0)
+        print(f"== search on {ids[2]}: total="
+              f"{r['hits']['total']['value']}, top={[h['_id'] for h in r['hits']['hits']]}")
+
+        print(f"== killing the leader [{leader}] ==")
+        procs[leader].terminate()
+        rest = [i for i in ids if i != leader]
+        t0 = time.monotonic()
+        sts = client.wait_for(
+            lambda sts: sum(1 for s in sts if s["mode"] == "LEADER") == 1
+            and all(s["leader"] in rest for s in sts), rest, timeout=60.0)
+        new_leader = next(s["node"] for s in sts if s["mode"] == "LEADER")
+        print(f"  re-elected {new_leader} in {time.monotonic() - t0:.2f}s")
+        client.wait_for(
+            lambda sts: all(leader not in s["nodes"]
+                            and s["started_shards"] == 4 for s in sts),
+            rest, timeout=60.0)
+        print("  replicas promoted + re-replicated: 4 copies STARTED again")
+
+        r = client.request(rest[0], "client:search",
+                           {"index": "logs",
+                            "body": {"query": {"match_all": {}}}, "size": 1}, timeout=90.0)
+        print(f"== search after failover: total={r['hits']['total']['value']}")
+        print("DEMO OK")
+    finally:
+        client.close()
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    main()

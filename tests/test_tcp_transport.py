@@ -1,0 +1,222 @@
+"""Real-socket TCP transport: framing, RPC semantics, and a 3-node cluster
+(election, replication, search) over loopback — in-process and as three
+separate OS processes.
+
+Reference: transport/TcpTransport.java framing + TransportService.java
+dispatch; the cluster flow mirrors the deterministic-simulation tests in
+test_coordination.py/test_replication.py, now over real sockets.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.server import NodeServer, TcpClient
+from elasticsearch_tpu.transport.base import TransportService
+from elasticsearch_tpu.transport.tcp import TcpTransportNetwork
+
+
+# ---------------------------------------------------------------------------
+# transport-level semantics
+# ---------------------------------------------------------------------------
+
+def test_request_response_and_errors():
+    a = TcpTransportNetwork("a")
+    b = TcpTransportNetwork("b")
+    try:
+        sa = TransportService("a", a)
+        sb = TransportService("b", b)
+        a.add_peer("b", *b.address())
+        b.add_peer("a", *a.address())
+        sb.register_handler("echo", lambda req, frm: {"got": req, "from": frm})
+        sb.register_handler("boom", lambda req, frm: 1 / 0)
+
+        client = TcpClient.__new__(TcpClient)  # reuse sync plumbing
+        client.network = a
+        client.service = sa
+        r = client.request("b", "echo", {"x": [1, 2, 3]})
+        assert r == {"got": {"x": [1, 2, 3]}, "from": "a"}
+        with pytest.raises(Exception, match="ZeroDivisionError"):
+            client.request("b", "boom", {})
+        with pytest.raises(Exception, match="no handler"):
+            client.request("b", "nope", {})
+        with pytest.raises(Exception):
+            client.request("missing-node", "echo", {})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_async_handler_deferred_response():
+    a = TcpTransportNetwork("a")
+    b = TcpTransportNetwork("b")
+    try:
+        sa = TransportService("a", a)
+        sb = TransportService("b", b)
+        a.add_peer("b", *b.address())
+
+        def later(req, frm, channel):
+            b.schedule(0.05, lambda: channel.send_response({"late": True}))
+
+        sb.register_async_handler("later", later)
+        client = TcpClient.__new__(TcpClient)
+        client.network = a
+        client.service = sa
+        assert client.request("b", "later", {}) == {"late": True}
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# 3 real-socket nodes in one process: elect, replicate, search, survive
+# a node death
+# ---------------------------------------------------------------------------
+
+def _boot_cluster():
+    ids = ["n1", "n2", "n3"]
+    servers = {}
+    peers = {}
+    for nid in ids:
+        servers[nid] = NodeServer(nid, ids, {}, port=0)
+        peers[nid] = ("127.0.0.1", servers[nid].port)
+    for nid, srv in servers.items():
+        for other, addr in peers.items():
+            if other != nid:
+                srv.network.add_peer(other, *addr)
+    for srv in servers.values():
+        srv.start()
+    client = TcpClient()
+    for nid, addr in peers.items():
+        client.add_node(nid, *addr)
+    return ids, servers, client
+
+
+def test_three_node_cluster_over_tcp():
+    ids, servers, client = _boot_cluster()
+    try:
+        sts = client.wait_for(
+            lambda sts: sum(1 for s in sts if s["mode"] == "LEADER") == 1
+            and all(s["leader"] for s in sts), ids)
+        leader = sts[0]["leader"]
+        follower = next(i for i in ids if i != leader)
+
+        # create an index (submitted via a FOLLOWER: forwards to master)
+        r = client.request(follower, "client:create_index",
+                           {"index": "logs",
+                            "settings": {"number_of_shards": 2,
+                                         "number_of_replicas": 1}})
+        assert r["acknowledged"], r
+        client.wait_for(lambda sts: all(s["started_shards"] == 4 for s in sts),
+                        ids)
+
+        # replicate writes through whichever node the client picked
+        ops = [["index", f"doc{i}", {"msg": f"hello {i}", "n": i}]
+               for i in range(20)]
+        r = client.request(follower, "client:bulk", {"index": "logs",
+                                                     "ops": ops})
+        assert not r["errors"], r
+
+        r = client.request(leader, "client:get", {"index": "logs",
+                                                  "id": "doc7"})
+        assert r["_source"] == {"msg": "hello 7", "n": 7}
+
+        r = client.request(follower, "client:search",
+                           {"index": "logs",
+                            "body": {"query": {"match": {"msg": "hello"}}},
+                            "size": 5})
+        assert r["hits"]["total"]["value"] == 20
+        assert len(r["hits"]["hits"]) == 5
+
+        # kill the leader: remaining nodes re-elect and keep serving
+        servers[leader].close()
+        rest = [i for i in ids if i != leader]
+        client.wait_for(
+            lambda sts: sum(1 for s in sts if s["mode"] == "LEADER") == 1
+            and all(s["leader"] in rest for s in sts), rest)
+        # dead node removed from the cluster, replicas promoted and
+        # re-replicated onto the survivors
+        client.wait_for(
+            lambda sts: all(leader not in s["nodes"]
+                            and s["started_shards"] == 4 for s in sts), rest)
+        r = client.request(rest[0], "client:search",
+                           {"index": "logs",
+                            "body": {"query": {"match_all": {}}}, "size": 3})
+        assert r["hits"]["total"]["value"] == 20
+    finally:
+        client.close()
+        for srv in servers.values():
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the same flow as 3 separate OS processes (the deployment shape)
+# ---------------------------------------------------------------------------
+
+def test_three_process_cluster_demo():
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    ids = ["p1", "p2", "p3"]
+    peers = ",".join(f"{i}=127.0.0.1:{p}" for i, p in zip(ids, ports))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.cluster.server",
+             "--node-id", nid, "--port", str(port), "--peers", peers],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for nid, port in zip(ids, ports)
+    ]
+    client = TcpClient()
+    for nid, port in zip(ids, ports):
+        client.add_node(nid, "127.0.0.1", port)
+    try:
+        client.wait_for(
+            lambda sts: sum(1 for s in sts if s["mode"] == "LEADER") == 1,
+            ids, timeout=60.0)
+        r = client.request(ids[0], "client:create_index",
+                           {"index": "k", "settings": {"number_of_shards": 1,
+                                                       "number_of_replicas": 1}})
+        assert r["acknowledged"], r
+        client.wait_for(lambda sts: all(s["started_shards"] == 2 for s in sts),
+                        ids, timeout=60.0)
+        r = client.request(ids[1], "client:bulk", {
+            "index": "k",
+            "ops": [["index", "a", {"t": "tpu search"}],
+                    ["index", "b", {"t": "cpu search"}]]}, timeout=60.0)
+        assert not r["errors"], r
+        # first search pays a cold-process XLA compile; under load a shard
+        # can time out into a partial result (_shards.failed > 0) — retry
+        deadline = time.time() + 180
+        while True:
+            r = client.request(ids[2], "client:search",
+                               {"index": "k",
+                                "body": {"query": {"match": {"t": "tpu"}}}},
+                               timeout=120.0)
+            if r.get("_shards", {}).get("failed", 0) == 0:
+                break
+            assert time.time() < deadline, f"shards kept failing: {r}"
+            time.sleep(2)
+        assert r["hits"]["total"]["value"] == 1
+        assert r["hits"]["hits"][0]["_id"] == "a"
+    finally:
+        client.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
