@@ -39,6 +39,12 @@ from .intervals import parse_fixed_interval, parse_calendar_interval
 
 MAX_BUCKETS = 65536
 MAX_SEGMENT_PRODUCT = 1 << 21
+# counting-only scans (two-pass terms pass 1) may use a larger space: one
+# int32 array, no child composition
+COUNT_BUDGET = 1 << 24
+# vocab size above which a terms agg with sub-aggs switches to the
+# two-pass candidate scheme (pass 1 counts, pass 2 children on candidates)
+TWO_PASS_MIN_V = 1 << 16
 
 
 def _col_arrays(dev, fld):
@@ -413,13 +419,56 @@ class TermsAgg(AggNode):
                 raise IllegalArgumentError(f"terms agg on float field [{self.fld}] is not supported")
         V = len(self.keys)
         self.V = V
+        # high-cardinality + sub-aggs: two-pass candidate scheme (reference
+        # analog: GlobalOrdinalsStringTermsAggregator's deferred ("breadth
+        # first") sub-agg collection — here exact, since pass-1 counts are
+        # global before candidate selection). Execution paths that cannot
+        # orchestrate two passes (field sorts) set force_single_pass and
+        # re-prepare: the one-pass budget checks then apply as before.
+        self.two_pass = (bool(self.children) and V > TWO_PASS_MIN_V
+                         and not getattr(self, "force_single_pass", False))
         cparams, ckey = self._prepare_children(pack, mappings)
-        return {"children": cparams}, ("terms", self.fld, V, self.size, ckey)
+        return {"children": cparams, "cand": None}, (
+            "terms", self.fld, V, self.size, self.two_pass, ckey)
 
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         V = self.V
         if V == 0:
             return {"counts": jnp.zeros((nseg, 1), jnp.int32), "children": {}}
+        cand = params.get("cand") if isinstance(params, dict) else None
+        if self.two_pass and cand is None:
+            # pass 1: exact counts over the full vocab, children deferred
+            # until the candidate set is known
+            if nseg * V > COUNT_BUDGET:
+                raise IllegalArgumentError(
+                    f"terms[{self.fld}]: {nseg}x{V} buckets exceeds the "
+                    f"counting budget"
+                )
+            ords, h = _ordinal_column(dev, self.fld)
+            ok = valid & h & (ords >= 0)
+            sub = seg * V + ords
+            counts = _seg_scatter(
+                sub, nseg * V, ok, jnp.ones_like(seg), jnp.int32(0), "add"
+            ).reshape(nseg, V)
+            return {"counts": counts, "children": {}}
+        if self.two_pass:
+            # pass 2: children only, over the candidate slots
+            C = self._C
+            if nseg * C > MAX_SEGMENT_PRODUCT:
+                raise IllegalArgumentError(
+                    f"terms[{self.fld}]: {nseg}x{C} candidate buckets "
+                    f"exceeds bucket budget"
+                )
+            ords, h = _ordinal_column(dev, self.fld)
+            ok = valid & h & (ords >= 0)
+            slots = cand[jnp.where(ok, ords, 0)]
+            ok2 = ok & (slots >= 0)
+            sub = seg * C + jnp.where(slots >= 0, slots, 0)
+            return {
+                "children": self._eval_children(
+                    dev, {"children": params["children"]}, sub, nseg * C,
+                    ok2, ctx),
+            }
         if nseg * V > MAX_SEGMENT_PRODUCT:
             raise IllegalArgumentError(
                 f"terms[{self.fld}]: {nseg}x{V} buckets exceeds bucket budget"
@@ -446,29 +495,60 @@ class TermsAgg(AggNode):
             "children": self._eval_children(dev, {"children": params["children"]}, sub, nseg * V, ok, ctx),
         }
 
+    def _top_indices(self, c: np.ndarray) -> np.ndarray:
+        """Bucket selection for one parent segment (also the candidate
+        chooser for the two-pass scheme — exact, counts are global)."""
+        (order_key, order_dir), = self.order.items()
+        if order_key == "_key":
+            idx = np.arange(len(c)) if order_dir == "asc" else np.arange(len(c))[::-1]
+            return idx[c[idx] > 0][: self.size]
+        # _count desc with _key asc tiebreak: stable sort on -count
+        idx = np.argsort(-c, kind="stable")[: self.size]
+        return idx[c[idx] > 0]
+
+    def select_candidates(self, merged: dict) -> np.ndarray:
+        """From merged pass-1 counts, pick every parent segment's top
+        ordinals and build the [V] ordinal -> candidate-slot map for
+        pass 2. Returns the map (-1 = not a candidate)."""
+        counts = np.asarray(merged["counts"]).reshape(-1, self.V)
+        chosen = sorted({int(j) for i in range(counts.shape[0])
+                         for j in self._top_indices(counts[i])})
+        self._C = 1 << max(len(chosen) - 1, 0).bit_length()
+        self._cand_slot = {j: s for s, j in enumerate(chosen)}
+        cand_map = np.full(self.V, -1, np.int32)
+        if chosen:
+            cand_map[chosen] = np.arange(len(chosen), dtype=np.int32)
+        return cand_map
+
     def finalize(self, out, nseg):
         V = self.V
         counts = np.asarray(out["counts"])
-        child_frags = self._finalize_children(out, nseg * V) if (self.children and V > 0) else None
+        two = self.two_pass and V > 0
+        if two and out.get("children"):
+            C = self._C
+            child_frags = self._finalize_children(
+                {"children": out["children"]}, nseg * C)
+        elif self.children and V > 0 and not two:
+            child_frags = self._finalize_children(out, nseg * V)
+        else:
+            child_frags = None
         res = []
-        (order_key, order_dir), = self.order.items()
         for i in range(nseg):
             c = counts[i]
             if V == 0:
                 res.append({"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0, "buckets": []})
                 continue
-            if order_key == "_key":
-                idx = np.arange(V) if order_dir == "asc" else np.arange(V)[::-1]
-                idx = idx[c[idx] > 0][: self.size]
-            else:
-                # _count desc with _key asc tiebreak: stable sort on -count
-                idx = np.argsort(-c, kind="stable")[: self.size]
-                idx = idx[c[idx] > 0]
+            idx = self._top_indices(c)
             buckets = []
             for j in idx:
                 b = {"key": self.keys[j], "doc_count": int(c[j])}
                 if child_frags is not None:
-                    b.update(child_frags[i * V + j])
+                    if two:
+                        slot = self._cand_slot.get(int(j))
+                        if slot is not None:
+                            b.update(child_frags[i * C + slot])
+                    else:
+                        b.update(child_frags[i * V + j])
                 buckets.append(b)
             res.append(
                 {
@@ -1338,7 +1418,7 @@ class CompositeAgg(AggNode):
     Top-level only, like the reference. The full (static-shaped) bucket
     product is counted on device; pagination trims host-side."""
 
-    _MERGE_RULES = {"counts": "sum"}
+    _MERGE_RULES = {"counts": "sum", "ranks": "concat_sorted"}
 
     def __init__(self, name, sources, size=10, after=None, children=None):
         super().__init__(name, children)
@@ -1346,6 +1426,8 @@ class CompositeAgg(AggNode):
         self.sources = sources
         self.size = int(size)
         self.after = after
+
+    PAGE_RANK_INF = np.int64(1) << 62
 
     def prepare(self, pack, mappings):
         self.plans = []  # per source: dict(kind, V, keys|first+interval)
@@ -1383,20 +1465,84 @@ class CompositeAgg(AggNode):
         shape_key = tuple(
             (p["kind"], p["V"], p.get("interval"), p.get("first")) for p in self.plans
         )
-        return {"children": cparams}, ("composite", tuple(s[2] for s in self.sources),
-                                       shape_key, self.size, ckey)
-
-    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
-        V = 1
+        # bucket-product size decides the execution shape: small products
+        # count the full space in one pass; large ones run the PAGED
+        # two-pass (pass 1: the page's rank keys; pass 2: counts + children
+        # over the page only — nothing vocab-sized ever materializes)
+        vtot = 1
         for p in self.plans:
-            V *= max(p["V"], 1)
-        self.V = V
-        if V == 0 or any(p["V"] == 0 for p in self.plans):
-            return {"counts": jnp.zeros((nseg, 1), jnp.int32), "children": {}}
-        if nseg * V > MAX_SEGMENT_PRODUCT:
-            raise IllegalArgumentError(
-                f"composite [{self.name}]: {V} buckets exceeds bucket budget")
-        sub = seg
+            vtot *= max(p["V"], 1)
+            if vtot >= int(self.PAGE_RANK_INF):
+                raise IllegalArgumentError(
+                    f"composite [{self.name}]: source product overflows")
+        self.two_pass = (vtot > TWO_PASS_MIN_V
+                         and not getattr(self, "force_single_pass", False))
+        self._P = _bucket_pow2(self.size)
+        self._after_rank = self._compute_after_rank() if self.two_pass else None
+        return {"children": cparams, "cand": None}, (
+            "composite", tuple(s[2] for s in self.sources),
+            shape_key, self.size, self.two_pass,
+            self._after_rank if self.two_pass else None, ckey)
+
+    def _adjusted(self, p, idx: int) -> int:
+        """Order-adjusted coordinate: desc sources invert so rank order ==
+        composite key order for every direction mix."""
+        return (p["V"] - 1 - idx) if p["order"] == "desc" else idx
+
+    def _compute_after_rank(self) -> int:
+        """Linearized EXCLUSIVE lower bound from the `after` key. Ranks are
+        lexicographic over order-adjusted coordinates, so `key > after` ==
+        `rank > after_rank`. An after value absent from a terms vocabulary
+        makes the bound inclusive from its insertion position (everything
+        sorting at or past it qualifies)."""
+        if self.after is None:
+            return -1
+        rank = 0
+        consumed = 0
+        inclusive = False
+        for (sname, styp, fld, opts), p in zip(self.sources, self.plans):
+            v = self.after.get(sname)
+            if p["kind"] == "terms":
+                if p["order"] == "desc":
+                    # adjusted order reverses the vocab: insertion position
+                    # in the descending list = first key <= v
+                    keys_adj = list(reversed(p["keys"]))
+                    pos = next((i for i, kk in enumerate(keys_adj) if kk <= v),
+                               p["V"])
+                    hit = pos < p["V"] and keys_adj[pos] == v
+                else:
+                    pos = int(np.searchsorted(np.asarray(p["keys"], dtype=object), v))
+                    hit = pos < p["V"] and p["keys"][pos] == v
+            else:
+                raw = int(np.floor(float(v) / p["interval"])) - p["first"]
+                if p["order"] == "desc":
+                    # adjusted coordinates invert: below-range raw sorts
+                    # past everything, above-range sorts before everything
+                    pos = p["V"] - 1 - raw
+                else:
+                    pos = raw
+                hit = 0 <= pos < p["V"]
+                pos = max(pos, 0)
+            if pos >= p["V"]:
+                # the after key sorts past this source's entire vocab:
+                # nothing with the current prefix qualifies — advance the
+                # prefix itself (inclusive bound at prefix+1, rest zero)
+                rank += 1
+                inclusive = True
+                break
+            rank = rank * p["V"] + pos
+            consumed += 1
+            if not hit:
+                inclusive = True
+                break
+        for p in self.plans[consumed:]:
+            rank *= p["V"]
+        return int(rank) - 1 if inclusive else int(rank)
+
+    def _doc_buckets(self, dev, seg, valid, ctx, adjusted: bool):
+        """Per-doc linearized bucket id (and validity). `adjusted` flips
+        desc sources so the id IS the composite order rank."""
+        sub = seg.astype(jnp.int64) if adjusted else seg
         ok = valid
         for (sname, styp, fld, opts), p in zip(self.sources, self.plans):
             if p["kind"] == "terms":
@@ -1418,7 +1564,52 @@ class CompositeAgg(AggNode):
                     b = (jnp.floor(v.astype(jnp.float64) / p["interval"])
                          .astype(jnp.int32) - p["first"])
                     b = jnp.clip(b, 0, p["V"] - 1)
+            if adjusted and p["order"] == "desc":
+                b = p["V"] - 1 - b
             sub = sub * p["V"] + b
+        return sub, ok
+
+    def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
+        V = 1
+        for p in self.plans:
+            V *= max(p["V"], 1)
+        self.V = V
+        if V == 0 or any(p["V"] == 0 for p in self.plans):
+            return {"counts": jnp.zeros((nseg, 1), jnp.int32), "children": {}}
+        cand = params.get("cand") if isinstance(params, dict) else None
+        if self.two_pass and cand is None:
+            # PAGED pass 1: the page is the `size` smallest distinct
+            # order-adjusted rank keys past `after` — found by sorting the
+            # per-doc ranks, nothing vocab-sized materializes
+            rank, ok = self._doc_buckets(dev, seg * 0, valid, ctx, adjusted=True)
+            INF = jnp.int64(self.PAGE_RANK_INF)
+            r = jnp.where(ok & (rank > jnp.int64(self._after_rank)), rank, INF)
+            s = jnp.sort(r)
+            firsts = jnp.concatenate(
+                [jnp.ones(1, bool), s[1:] != s[:-1]])
+            page = jnp.sort(jnp.where(firsts, s, INF))[: self._P]
+            return {"ranks": page, "children": {}}
+        if self.two_pass:
+            # PAGED pass 2: counts + children over the page slots only
+            P = self._P
+            rank, ok = self._doc_buckets(dev, seg * 0, valid, ctx, adjusted=True)
+            idx = jnp.clip(jnp.searchsorted(cand, rank), 0, P - 1)
+            on_page = ok & (cand[idx] == rank) & (
+                rank < jnp.int64(self.PAGE_RANK_INF))
+            sub = seg * P + idx.astype(seg.dtype)
+            counts = _seg_scatter(
+                sub, nseg * P, on_page, jnp.ones_like(seg), jnp.int32(0), "add"
+            ).reshape(nseg, P)
+            return {
+                "counts": counts,
+                "children": self._eval_children(
+                    dev, {"children": params["children"]}, sub, nseg * P,
+                    on_page, ctx),
+            }
+        if nseg * V > MAX_SEGMENT_PRODUCT:
+            raise IllegalArgumentError(
+                f"composite [{self.name}]: {V} buckets exceeds bucket budget")
+        sub, ok = self._doc_buckets(dev, seg, valid, ctx, adjusted=False)
         counts = _seg_scatter(sub, nseg * V, ok, jnp.ones_like(seg), jnp.int32(0), "add").reshape(nseg, V)
         return {
             "counts": counts,
@@ -1442,7 +1633,65 @@ class CompositeAgg(AggNode):
                 out.append(int((p["first"] + o) * p["interval"]))
         return tuple(out)
 
+    def select_candidates(self, merged: dict) -> np.ndarray:
+        """From merged pass-1 rank keys: the `size` smallest distinct ranks
+        form the page; returns the sorted padded [P] rank array pass 2
+        searches against."""
+        ranks = np.asarray(merged["ranks"]).reshape(-1)
+        ranks = np.unique(ranks[ranks < int(self.PAGE_RANK_INF)])[: self.size]
+        page = np.full(self._P, int(self.PAGE_RANK_INF), np.int64)
+        page[: len(ranks)] = ranks
+        self._page_ranks = [int(x) for x in ranks]
+        self._C = self._P  # pass-2 cache key reads _C
+        return page
+
+    def _key_from_rank(self, rank: int) -> tuple:
+        parts_adj = []
+        rem = int(rank)
+        for p in reversed(self.plans):
+            parts_adj.append(rem % p["V"])
+            rem //= p["V"]
+        parts_adj.reverse()
+        out = []
+        for p, adj in zip(self.plans, parts_adj):
+            raw = (p["V"] - 1 - adj) if p["order"] == "desc" else adj
+            if p["kind"] == "terms":
+                out.append(p["keys"][raw])
+            elif p["kind"] == "histogram":
+                out.append((p["first"] + raw) * p["interval"])
+            else:
+                out.append(int((p["first"] + raw) * p["interval"]))
+        return tuple(out)
+
+    def _finalize_paged(self, out, nseg):
+        P = self._P
+        counts = np.asarray(out["counts"]).reshape(nseg, P)
+        child_frags = (
+            self._finalize_children(out, nseg * P)
+            if (self.children and out.get("children")) else None
+        )
+        res = []
+        for i in range(nseg):
+            buckets = []
+            for slot, rank in enumerate(self._page_ranks):
+                c = int(counts[i, slot])
+                if c <= 0:
+                    continue
+                kt = self._key_from_rank(rank)
+                b = {"key": {s[0]: k for s, k in zip(self.sources, kt)},
+                     "doc_count": c}
+                if child_frags is not None:
+                    b.update(child_frags[i * P + slot])
+                buckets.append(b)
+            frag = {"buckets": buckets}
+            if buckets:
+                frag["after_key"] = buckets[-1]["key"]
+            res.append(frag)
+        return res
+
     def finalize(self, out, nseg):
+        if self.two_pass:
+            return self._finalize_paged(out, nseg)
         V = getattr(self, "V", 1)
         counts = np.asarray(out["counts"]).reshape(nseg, -1)
         child_frags = (
@@ -1483,6 +1732,10 @@ class CompositeAgg(AggNode):
                 frag["after_key"] = buckets[-1]["key"]
             res.append(frag)
         return res
+
+
+def _bucket_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
 
 
 def _pos_rank(k):

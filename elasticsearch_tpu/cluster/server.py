@@ -99,7 +99,13 @@ class TcpClient:
     demos, CLI tooling) — the analog of the low-level Java transport
     client."""
 
-    def __init__(self, client_id: str = "_client"):
+    def __init__(self, client_id: str | None = None):
+        if client_id is None:
+            import uuid
+
+            # unique by default: response routing on the server is keyed by
+            # (sender id, request id), so two clients must not share an id
+            client_id = f"_client-{uuid.uuid4().hex[:8]}"
         self.network = TcpTransportNetwork(client_id)
         self.service = TransportService(client_id, self.network)
 

@@ -821,8 +821,31 @@ class StackedSearcher:
         )
         aggregations = None
         if agg_nodes:
+            from ..aggs import two_pass_plan
+
+            merged = {name: anode.merge_partials(agg_out[name])
+                      for name, anode in agg_nodes.items()}
+            tp = two_pass_plan(agg_nodes)
+            if tp:
+                # candidates from the GLOBAL merged counts (exact — unlike
+                # the reference's per-shard shard_size approximation), then
+                # pass 2 computes sub-aggs over candidate slots only
+                for name, a in tp.items():
+                    cm = a.select_candidates(merged[name])
+                    agg_params[name] = {
+                        **agg_params[name],
+                        "cand": np.broadcast_to(cm, (S, len(cm))).copy(),
+                    }
+                fn2 = self._compiled(
+                    node, tuple(keys), k, agg_nodes,
+                    (agg_key, "tp2",
+                     tuple(sorted((n, a._C) for n, a in tp.items()))))
+                _s1, _s2, _s3, _t, agg_out2 = jax.device_get(
+                    fn2(self.dev, params, agg_params))
+                for name, a in tp.items():
+                    merged[name].update(a.merge_partials(agg_out2[name]))
             aggregations = {
-                name: anode.finalize(anode.merge_partials(agg_out[name]), 1)[0]
+                name: anode.finalize(merged[name], 1)[0]
                 for name, anode in agg_nodes.items()
             }
         valid = np.isfinite(g_scores)
@@ -943,11 +966,22 @@ class StackedSearcher:
         params = _stack_shard_params(per_shard)
         agg_params, agg_key = {}, ()
         if agg_nodes:
+            from ..aggs import two_pass_plan
+
             per_shard_aggs, akeys = [], []
-            for v in views:
-                parts = {nm: a.prepare(v, m) for nm, a in agg_nodes.items()}
-                per_shard_aggs.append({nm: p for nm, (p, _) in parts.items()})
-                akeys.append(tuple((nm, kk) for nm, (_, kk) in sorted(parts.items())))
+            for attempt in (0, 1):
+                per_shard_aggs, akeys = [], []
+                for v in views:
+                    parts = {nm: a.prepare(v, m) for nm, a in agg_nodes.items()}
+                    per_shard_aggs.append({nm: p for nm, (p, _) in parts.items()})
+                    akeys.append(tuple((nm, kk) for nm, (_, kk) in sorted(parts.items())))
+                tp = two_pass_plan(agg_nodes)
+                if not tp:
+                    break
+                # field-sorted execution can't orchestrate two passes: fall
+                # back to single-pass (one-pass budgets apply as before)
+                for a in tp.values():
+                    a.force_single_pass = True
             agg_params = _stack_shard_params(per_shard_aggs)
             agg_key = tuple(akeys)
         k = min(max(size + from_, 1), max(self.sp.n_max, 1))

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..index.pack import ShardPack
 from ..ops.scoring import top_k_with_total
+from ..utils.errors import IllegalArgumentError
 from .dsl import parse_query
 from .nodes import ExecContext, QueryNode
 
@@ -164,6 +165,24 @@ class ShardSearcher:
         )
         aggregations = None
         if agg_nodes:
+            from ..aggs import two_pass_plan
+
+            tp = two_pass_plan(agg_nodes)
+            if tp:
+                # pass 2: exact sub-aggs over the candidate slots only
+                for name, a in tp.items():
+                    agg_params[name] = {
+                        **agg_params[name],
+                        "cand": a.select_candidates(agg_out[name]),
+                    }
+                fn2 = self._compiled(
+                    node, struct_key, k, agg_nodes,
+                    (agg_key, "tp2",
+                     tuple(sorted((n, a._C) for n, a in tp.items()))))
+                _s, _i, _t, agg_out2 = jax.device_get(
+                    fn2(self.dev, params, agg_params))
+                for name in tp:
+                    agg_out[name] = {**agg_out[name], **agg_out2[name]}
             aggregations = {
                 name: anode.finalize(agg_out[name], 1)[0]
                 for name, anode in agg_nodes.items()
@@ -252,6 +271,16 @@ class ShardSearcher:
         agg_params, agg_key = {}, ()
         if agg_nodes:
             parts = {nm: a.prepare(self.pack, m) for nm, a in agg_nodes.items()}
+            from ..aggs import two_pass_plan
+
+            tp = two_pass_plan(agg_nodes)
+            if tp:
+                # field-sorted execution can't orchestrate two passes: fall
+                # back to single-pass (the one-pass budgets apply as before)
+                for a in tp.values():
+                    a.force_single_pass = True
+                parts = {nm: a.prepare(self.pack, m)
+                         for nm, a in agg_nodes.items()}
             agg_params = {nm: p for nm, (p, _) in parts.items()}
             agg_key = tuple((nm, kk) for nm, (_, kk) in sorted(parts.items()))
         k = min(max(size + from_, 1), self.pack.num_docs)

@@ -63,6 +63,73 @@ def frame_bytes(msg: dict) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+class _PeerSender(threading.Thread):
+    """Owns the outbound connection to one peer: connects (blocking, on
+    THIS thread only), writes queued frames, reports request failures."""
+
+    def __init__(self, network: "TcpTransportNetwork", to_node: str):
+        super().__init__(name=f"tpu-es-send-{network.node_id}-{to_node}",
+                         daemon=True)
+        self.network = network
+        self.to_node = to_node
+        self.queue: queue.Queue = queue.Queue()
+        self.conn: socket.socket | None = None
+
+    def enqueue(self, data: bytes, on_fail) -> None:
+        self.queue.put((data, on_fail))
+
+    def _connect(self) -> bool:
+        addr = self.network._peers.get(self.to_node)
+        if addr is None:
+            return False
+        try:
+            conn = socket.create_connection(addr, timeout=5.0)
+        except OSError:
+            return False
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(None)
+        self.conn = conn
+        # connections are duplex: responses to our requests come back over
+        # the same socket
+        threading.Thread(target=self.network._reader_loop, args=(conn,),
+                         name=f"tpu-es-reader-{self.network.node_id}",
+                         daemon=True).start()
+        return True
+
+    def run(self):
+        while True:
+            item = self.queue.get()
+            if item is None:
+                break
+            data, on_fail = item
+            sent = False
+            for _attempt in (0, 1):  # one reconnect on a stale connection
+                if self.conn is None and not self._connect():
+                    break
+                try:
+                    self.conn.sendall(data)
+                    sent = True
+                    break
+                except OSError:
+                    try:
+                        self.conn.close()
+                    except OSError:
+                        pass
+                    self.conn = None
+            if not sent and on_fail is not None:
+                on_fail()
+            if self.network._closed:
+                break
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self.queue.put(None)
+
+
 class TcpTransportNetwork:
     """One node's endpoint: a listening server socket + outbound
     connections to peers, satisfying the network contract TransportService
@@ -77,11 +144,11 @@ class TcpTransportNetwork:
         self.host = host
         self._service = None
         self._peers: dict[str, tuple[str, int]] = {}
-        self._conns: dict[str, socket.socket] = {}
+        self._senders: dict[str, _PeerSender] = {}
         self._conn_lock = threading.Lock()
         self._inbox: queue.Queue = queue.Queue()
         self._inbound_routes: dict[tuple[str, int], socket.socket] = {}
-        self._timers: list[threading.Timer] = []
+        self._timers: set[threading.Timer] = set()
         self._pool = None  # lazy search worker pool (see offload)
         self._closed = False
 
@@ -164,10 +231,17 @@ class TcpTransportNetwork:
     def schedule(self, delay: float, fn) -> None:
         if self._closed:
             return
-        t = threading.Timer(delay, lambda: self._inbox.put(fn))
+        timer_box = []
+
+        def fire():
+            self._timers.discard(timer_box[0])
+            self._inbox.put(fn)
+
+        t = threading.Timer(delay, fire)
+        timer_box.append(t)
         t.daemon = True
+        self._timers.add(t)
         t.start()
-        self._timers.append(t)
 
     # -- server side -------------------------------------------------------
 
@@ -209,56 +283,37 @@ class TcpTransportNetwork:
             svc.handle_response(msg["rid"], msg["body"], msg.get("err"))
 
     # -- client side -------------------------------------------------------
+    # All connecting + writing happens on per-peer sender threads: a dead
+    # or partitioned peer blocks only its own sender, NEVER the dispatch
+    # thread (a blocked dispatch thread would miss leader checks and churn
+    # elections — the stall the worker-pool split exists to prevent).
 
-    def _get_conn(self, to_node: str) -> socket.socket:
+    def _sender_for(self, to_node: str) -> "_PeerSender":
         with self._conn_lock:
-            conn = self._conns.get(to_node)
-            if conn is not None:
-                return conn
-            addr = self._peers.get(to_node)
-            if addr is None:
-                raise ConnectionError(f"unknown node [{to_node}]")
-            conn = socket.create_connection(addr, timeout=5.0)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn.settimeout(None)
-            self._conns[to_node] = conn
-            # connections are duplex: responses to our requests come back
-            # over the same socket
-            threading.Thread(target=self._reader_loop, args=(conn,),
-                             name=f"tpu-es-reader-{self.node_id}",
-                             daemon=True).start()
-            return conn
-
-    def _transmit(self, to_node: str, msg: dict) -> bool:
-        data = frame_bytes(msg)
-        for _attempt in (0, 1):  # one reconnect on a stale pooled conn
-            try:
-                conn = self._get_conn(to_node)
-                with self._conn_lock:
-                    conn.sendall(data)
-                return True
-            except OSError:
-                with self._conn_lock:
-                    stale = self._conns.pop(to_node, None)
-                if stale is not None:
-                    try:
-                        stale.close()
-                    except OSError:
-                        pass
-            except ConnectionError:
-                return False
-        return False
+            s = self._senders.get(to_node)
+            if s is None:
+                s = self._senders[to_node] = _PeerSender(self, to_node)
+                s.start()
+            return s
 
     def send(self, from_node: str, to_node: str, action: str, request, rid: int):
-        ok = self._transmit(to_node, {
-            "k": "req", "from": from_node, "action": action,
-            "rid": rid, "body": request,
-        })
-        if not ok:
+        if to_node not in self._peers:
+            svc = self._service
+            if svc is not None:
+                self._inbox.put(lambda: svc.handle_connection_failure(
+                    rid, f"unknown node [{to_node}]"))
+            return
+
+        def on_fail():
             svc = self._service
             if svc is not None:
                 self._inbox.put(lambda: svc.handle_connection_failure(
                     rid, f"cannot connect to [{to_node}]"))
+
+        self._sender_for(to_node).enqueue(frame_bytes({
+            "k": "req", "from": from_node, "action": action,
+            "rid": rid, "body": request,
+        }), on_fail)
 
     def respond(self, from_node: str, to_node: str, rid: int, response, error):
         msg = {"k": "rsp", "from": from_node, "rid": rid,
@@ -271,24 +326,22 @@ class TcpTransportNetwork:
                 return
             except OSError:
                 pass  # inbound conn gone; try the address book
-        self._transmit(to_node, msg)
+        if to_node in self._peers:
+            self._sender_for(to_node).enqueue(frame_bytes(msg), None)
         # a lost response surfaces as a timeout on the requester
 
     # -- lifecycle ---------------------------------------------------------
 
     def close(self):
         self._closed = True
-        for t in self._timers:
+        for t in list(self._timers):
             t.cancel()
         try:
             self._server.close()
         except OSError:
             pass
         with self._conn_lock:
-            conns, self._conns = list(self._conns.values()), {}
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
+            senders, self._senders = list(self._senders.values()), {}
+        for s in senders:
+            s.stop()
         self._inbox.put(None)

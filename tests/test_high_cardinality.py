@@ -1,0 +1,262 @@
+"""High-cardinality terms aggregations: the two-pass candidate scheme.
+
+Pass 1 counts the full vocab (counting-only budget), candidates are the
+exact global top buckets, pass 2 computes sub-aggs over candidates only —
+so vocab size no longer multiplies into the sub-agg segment space.
+Reference: GlobalOrdinalsStringTermsAggregator.java:61 (deferred/breadth-
+first sub-agg collection); here exact because counts merge globally before
+selection.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.aggs import parse_aggs
+from elasticsearch_tpu.aggs.nodes import MAX_SEGMENT_PRODUCT, TWO_PASS_MIN_V
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.parallel.sharded import StackedSearcher
+from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+MAPPING = Mappings({"properties": {
+    "ip": {"type": "keyword"},
+    "status": {"type": "keyword"},
+    "bytes": {"type": "long"},
+    "body": {"type": "text"},
+}})
+
+N_DOCS = 90_000  # vocab ~ N/zipf-dedup > TWO_PASS_MIN_V (65536)
+
+
+def _docs(n=N_DOCS, seed=11):
+    rng = np.random.default_rng(seed)
+    # most ips unique (high cardinality), a few hot ones (clear top-10)
+    hot = [f"10.0.0.{i}" for i in range(12)]
+    docs = []
+    hot_picks = rng.integers(0, len(hot), n)
+    is_hot = rng.random(n) < 0.02
+    statuses = rng.integers(0, 3, n)
+    nbytes = rng.integers(1, 1000, n)
+    for i in range(n):
+        ip = hot[hot_picks[i]] if is_hot[i] else f"192.168.{i // 250}.{i % 250}"
+        docs.append((f"d{i}", {
+            "ip": ip,
+            "status": ["200", "404", "500"][statuses[i]],
+            "bytes": int(nbytes[i]),
+            "body": "get request",
+        }))
+    return docs
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    return StackedSearcher(build_stacked_pack(_docs(), MAPPING, num_shards=3))
+
+
+def _expect(docs, size=10):
+    """Hand-computed: top ips by count (key-asc tiebreak) + per-ip stats."""
+    from collections import Counter, defaultdict
+
+    counts = Counter(src["ip"] for _, src in docs)
+    top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+    sums = defaultdict(int)
+    stat_counts = defaultdict(Counter)
+    for _, src in docs:
+        sums[src["ip"]] += src["bytes"]
+        stat_counts[src["ip"]][src["status"]] += 1
+    return top, sums, stat_counts
+
+
+def test_high_cardinality_terms_with_metric_subagg(searcher):
+    docs = _docs()
+    aggs = parse_aggs({"ips": {"terms": {"field": "ip", "size": 10},
+                               "aggs": {"b": {"sum": {"field": "bytes"}}}}},
+                      MAPPING)
+    node = aggs["ips"]
+    res = searcher.search(None, size=0, aggs={
+        "ips": {"terms": {"field": "ip", "size": 10},
+                "aggs": {"b": {"sum": {"field": "bytes"}}}}})
+    assert node is not None
+    out = res.aggregations["ips"]
+    top, sums, _ = _expect(docs)
+    got = [(b["key"], b["doc_count"]) for b in out["buckets"]]
+    assert got == top
+    for b in out["buckets"]:
+        assert b["b"]["value"] == float(sums[b["key"]])
+    # and this really was the two-pass path
+    tp_nodes = parse_aggs({"ips": {"terms": {"field": "ip", "size": 10},
+                                   "aggs": {"b": {"sum": {"field": "bytes"}}}}},
+                          MAPPING)
+    v = searcher.sp.shard_view(0)
+    tp_nodes["ips"].prepare(v, MAPPING)
+    assert tp_nodes["ips"].V > TWO_PASS_MIN_V
+    assert tp_nodes["ips"].two_pass
+
+
+def test_high_cardinality_terms_with_terms_subagg(searcher):
+    """vocab x sub-vocab would blow the old 2M-segment budget; candidates
+    keep it tiny."""
+    docs = _docs()
+    body = {"ips": {"terms": {"field": "ip", "size": 10},
+                    "aggs": {"st": {"terms": {"field": "status", "size": 5}}}}}
+    res = searcher.search(None, size=0, aggs=body)
+    out = res.aggregations["ips"]
+    top, _, stat_counts = _expect(docs)
+    assert [(b["key"], b["doc_count"]) for b in out["buckets"]] == top
+    for b in out["buckets"]:
+        got = {sb["key"]: sb["doc_count"] for sb in b["st"]["buckets"]}
+        assert got == dict(stat_counts[b["key"]])
+
+
+def test_high_cardinality_with_query_filter(searcher):
+    docs = _docs()
+    sel = [d for d in docs if d[1]["status"] == "404"]
+    res = searcher.search({"term": {"status": "404"}}, size=0, aggs={
+        "ips": {"terms": {"field": "ip", "size": 10},
+                "aggs": {"b": {"sum": {"field": "bytes"}}}}})
+    out = res.aggregations["ips"]
+    top, sums404, _ = _expect(sel)
+    assert [(b["key"], b["doc_count"]) for b in out["buckets"]] == top
+    for b in out["buckets"]:
+        assert b["b"]["value"] == float(sums404[b["key"]])
+
+
+def test_high_cardinality_without_subagg_single_pass(searcher):
+    """counts-only stays single-pass (no candidate machinery)."""
+    docs = _docs()
+    res = searcher.search(None, size=0,
+                          aggs={"ips": {"terms": {"field": "ip", "size": 5}}})
+    top, _, _ = _expect(docs, size=5)
+    assert [(b["key"], b["doc_count"])
+            for b in res.aggregations["ips"]["buckets"]] == top
+
+
+def test_nested_high_cardinality_rejected(searcher):
+    with pytest.raises(IllegalArgumentError, match="top-level"):
+        searcher.search(None, size=0, aggs={
+            "st": {"terms": {"field": "status", "size": 5},
+                   "aggs": {"ips": {"terms": {"field": "ip", "size": 10},
+                                    "aggs": {"b": {"sum": {"field": "bytes"}}}}}}})
+
+
+def test_low_cardinality_path_unchanged():
+    docs = [(f"d{i}", {"ip": f"ip{i % 7}", "status": "200",
+                       "bytes": i, "body": "x"}) for i in range(200)]
+    s = StackedSearcher(build_stacked_pack(docs, MAPPING, num_shards=2))
+    res = s.search(None, size=0, aggs={
+        "ips": {"terms": {"field": "ip", "size": 3},
+                "aggs": {"b": {"sum": {"field": "bytes"}}}}})
+    from collections import Counter, defaultdict
+
+    counts = Counter(src["ip"] for _, src in docs)
+    sums = defaultdict(int)
+    for _, src in docs:
+        sums[src["ip"]] += src["bytes"]
+    top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    out = res.aggregations["ips"]
+    assert [(b["key"], b["doc_count"]) for b in out["buckets"]] == top
+    for b in out["buckets"]:
+        assert b["b"]["value"] == float(sums[b["key"]])
+
+
+# ---------------------------------------------------------------------------
+# paged composite: the page is found by rank, nothing vocab-sized builds
+# ---------------------------------------------------------------------------
+
+def _composite_expect(docs, size, after=None):
+    from collections import Counter
+
+    counts = Counter((src["ip"], src["status"]) for _, src in docs)
+    keys = sorted(counts)
+    if after is not None:
+        keys = [k for k in keys if k > after]
+    return [(k, counts[k]) for k in keys[:size]]
+
+
+def test_paged_composite_two_sources(searcher):
+    docs = _docs()
+    body = {"c": {"composite": {
+        "size": 7,
+        "sources": [{"ip": {"terms": {"field": "ip"}}},
+                    {"st": {"terms": {"field": "status"}}}],
+    }}}
+    res = searcher.search(None, size=0, aggs=body)
+    out = res.aggregations["c"]
+    expect = _composite_expect(docs, 7)
+    got = [((b["key"]["ip"], b["key"]["st"]), b["doc_count"])
+           for b in out["buckets"]]
+    assert got == expect
+    assert out["after_key"] == {"ip": expect[-1][0][0], "st": expect[-1][0][1]}
+
+    # paginate with after through two more pages
+    after = expect[-1][0]
+    body["c"]["composite"]["after"] = {"ip": after[0], "st": after[1]}
+    res2 = searcher.search(None, size=0, aggs=body)
+    expect2 = _composite_expect(docs, 7, after=after)
+    got2 = [((b["key"]["ip"], b["key"]["st"]), b["doc_count"])
+            for b in res2.aggregations["c"]["buckets"]]
+    assert got2 == expect2
+
+
+def test_paged_composite_with_subagg(searcher):
+    docs = _docs()
+    from collections import defaultdict
+
+    sums = defaultdict(int)
+    for _, src in docs:
+        sums[(src["ip"], src["status"])] += src["bytes"]
+    body = {"c": {"composite": {
+        "size": 5,
+        "sources": [{"ip": {"terms": {"field": "ip"}}},
+                    {"st": {"terms": {"field": "status"}}}],
+    }, "aggs": {"b": {"sum": {"field": "bytes"}}}}}
+    res = searcher.search(None, size=0, aggs=body)
+    out = res.aggregations["c"]
+    expect = _composite_expect(docs, 5)
+    assert [((b["key"]["ip"], b["key"]["st"]), b["doc_count"])
+            for b in out["buckets"]] == expect
+    for b in out["buckets"]:
+        assert b["b"]["value"] == float(sums[(b["key"]["ip"], b["key"]["st"])])
+
+
+def test_paged_composite_desc_order(searcher):
+    docs = _docs()
+    from collections import Counter
+
+    counts = Counter(src["ip"] for _, src in docs)
+    keys = sorted(counts, reverse=True)
+    body = {"c": {"composite": {
+        "size": 6,
+        "sources": [{"ip": {"terms": {"field": "ip", "order": "desc"}}}],
+    }}}
+    res = searcher.search(None, size=0, aggs=body)
+    got = [(b["key"]["ip"], b["doc_count"])
+           for b in res.aggregations["c"]["buckets"]]
+    assert got == [(k, counts[k]) for k in keys[:6]]
+
+
+def test_paged_composite_after_beyond_vocab(searcher):
+    body = {"c": {"composite": {
+        "size": 5,
+        "sources": [{"ip": {"terms": {"field": "ip"}}}],
+        "after": {"ip": "zzzzzz"},  # sorts past every key
+    }}}
+    res = searcher.search(None, size=0, aggs=body)
+    assert res.aggregations["c"]["buckets"] == []
+
+
+def test_high_cardinality_agg_with_sort_falls_back_single_pass(searcher):
+    """Field sorts can't orchestrate two passes: the agg falls back to the
+    one-pass space (fits here: V x 1 metric segment)."""
+    docs = _docs()
+    hits, total, aggregations = searcher.search_sorted(
+        None, __import__("elasticsearch_tpu.query.sort",
+                         fromlist=["parse_sort"]).parse_sort(
+            [{"bytes": "desc"}]),
+        size=3, aggs={"ips": {"terms": {"field": "ip", "size": 5},
+                              "aggs": {"b": {"sum": {"field": "bytes"}}}}})
+    top, sums, _ = _expect(docs, size=5)
+    out = aggregations["ips"]
+    assert [(b["key"], b["doc_count"]) for b in out["buckets"]] == top
+    for b in out["buckets"]:
+        assert b["b"]["value"] == float(sums[b["key"]])
