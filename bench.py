@@ -1,94 +1,454 @@
-"""Headline benchmark: batched BM25 match-query throughput (north-star config 1/2).
+"""Headline benchmarks: the five BASELINE.json configs on real TPU hardware.
 
-Mirrors the reference's headline esrally configuration — `match` / bool-should
-multi-term BM25 top-10 over an msmarco-passage-like corpus (BASELINE.json
-configs[0-1]) — on this framework's batched `_msearch` path
-(elasticsearch_tpu/ops/batched.py): dense-tier term rows scored as one MXU
-matmul, sparse-tail CSR blocks merged scatter-free, fused top-k.
+Corpus scale and honesty (VERDICT round 1, Next-round #2):
+  - 1,000,000 synthetic msmarco-passage-like docs (Zipf term distribution,
+    Poisson(40) lengths over a 100k vocabulary) — large enough that the
+    dense tier (~1k rows x 1M docs) and CSR postings stress HBM capacity
+    and bandwidth, unlike the round-1 30k-doc toy. (Full msmarco is 8.8M
+    passages; at that size the dense tier alone would exceed a single
+    v5e chip's 16 GB HBM in f32 — the 8-chip sharded layout of config 5
+    is the intended deployment for it.)
+  - every batch pays full host-side planning (term lookups, row padding):
+    a fresh query batch is planned per iteration, no plan reuse.
+  - relevance gate: config 1 queries are also run through the bit-exact
+    reference path; top-10 doc sets, order, and totals must agree (nDCG@10
+    parity = identical rankings by construction, reported as a fraction).
 
-Timing is pipelined (all batches submitted, one device sync at the end):
-the tunnel to the TPU adds ~65 ms round-trip latency per *synchronous* call,
-which is transport, not compute — a server overlaps request batches exactly
-the same way.
+Baselines. The reference repo publishes NO numbers (BASELINE.md): its
+benchmarks/README.md delegates to external nightly Rally runs. Baselines
+here are therefore explicit throughput MODELS of ES 8.14 on the 32-vCPU
+host named by BASELINE.json, with the formula printed next to each number
+(see BENCH_NOTES.md for derivations and sources of the per-core rates):
+  C1  match BM25 top-10:   32 cores x 75M WAND-effective postings/s/core
+                           x 0.6 multicore scaling / mean(sum df per query)
+  C2  WAND disjunction:    speedup of the pruned path vs this framework's
+                           own exhaustive execution of the identical query
+                           (result-identical, so the ratio isolates pruning)
+  C3  terms+date_histogram: 300M docs/s aggregate DocValues scan rate
+  C4  exact kNN cosine:    32 cores x 25 GFLOP/s/core effective over
+                           2*D*N FLOP/query (f32 script_score exact scan)
+  C5  8-shard _msearch:    C1's model on the same corpus split 8 ways
+                           (identical total postings) — the TPU side runs
+                           the 8 shards' batched programs on ONE chip
+                           (serialized; on a v5e-8 they run one-per-chip,
+                           validated by __graft_entry__.dryrun_multichip)
 
-The reference repo publishes no absolute numbers (benchmarks/README.md:7-9
-delegates to external nightly Rally runs), so `vs_baseline` is the ratio
-against a fixed stand-in: 1,500 QPS, a representative single-shard
-match-top-10 esrally result for Elasticsearch 8.x on a 32-vCPU host.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with the config-1 headline plus an `extras` object
+carrying the other configs, latencies, MFU, and bandwidth estimates.
+v5e peak rates used for utilization: 197 TFLOP/s bf16 matmul,
+819 GB/s HBM (public TPU v5e spec).
 """
 
 from __future__ import annotations
 
+import gc
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
-BASELINE_QPS = 1500.0  # stand-in: 32-vCPU ES 8.x, single-shard match top-10
-
-N_DOCS = 30_000
-VOCAB = 4_000
-DOC_LEN_MEAN = 40  # msmarco passages average ~55 terms; keep pack build fast
-N_QUERIES = 4096  # one batch = one _msearch fan-in; large batch amortizes tunnel RTT
+N_DOCS = 1_000_000
+VOCAB = 100_000
+DOC_LEN_MEAN = 40
+Q_BATCH = 4096
+N_BATCHES = 6
 TERMS_PER_QUERY = 4
 TOP_K = 10
-WARMUP = 3
-ITERS = 12
+
+if os.environ.get("ES_BENCH_SMOKE"):  # fast correctness pass (CI / CPU)
+    N_DOCS, VOCAB, Q_BATCH, N_BATCHES = 20_000, 5_000, 256, 2
+
+PEAK_BF16_FLOPS = 197e12
+PEAK_HBM_BPS = 819e9
+
+# ---- CPU baseline model parameters (documented in BENCH_NOTES.md) -------
+CORES = 32
+MULTICORE_EFF = 0.6
+POSTINGS_PER_CORE = 75e6  # WAND-effective scored-postings/s/core (Lucene)
+AGG_DOCS_PER_SEC = 300e6  # DocValues scan, 32 cores aggregate
+KNN_FLOPS_PER_CORE = 25e9  # effective f32 GFLOP/s/core for dot products
 
 
-def build_corpus(rng):
-    """Zipf-distributed synthetic passages (term-id strings)."""
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_corpus(rng, n_docs=N_DOCS):
     zipf = 1.0 / np.arange(1, VOCAB + 1)
     zipf /= zipf.sum()
-    lens = rng.poisson(DOC_LEN_MEAN, size=N_DOCS).clip(4, None)
-    all_terms = rng.choice(VOCAB, size=int(lens.sum()), p=zipf)
-    docs, off = [], 0
-    for i, ln in enumerate(lens):
-        body = " ".join(f"t{t}" for t in all_terms[off : off + ln])
+    lens = rng.poisson(DOC_LEN_MEAN, size=n_docs).clip(4, None)
+    tok = rng.choice(VOCAB, size=int(lens.sum()), p=zipf)
+    return lens, tok
+
+
+def sample_queries(rng, lens, tok, n_queries, terms_per_query=TERMS_PER_QUERY):
+    """Query terms drawn from real documents (msmarco queries reference
+    corpus content), deduplicated within a query."""
+    starts = np.concatenate([[0], np.cumsum(lens[:-1])])
+    docs = rng.integers(0, len(lens), size=n_queries)
+    out = []
+    for d in docs:
+        s, ln = starts[d], lens[d]
+        terms = tok[s + rng.integers(0, ln, size=terms_per_query)]
+        out.append([(f"t{t}", 1.0) for t in dict.fromkeys(terms)])
+    return out
+
+
+def build_pack(lens, tok):
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    term_strs = np.array([f"t{i}" for i in range(VOCAB)])
+    doc_terms = term_strs[tok]
+    off = 0
+    for ln in lens:
+        b.add_document({"body": [" ".join(doc_terms[off : off + ln])]})
         off += ln
-        docs.append((f"doc-{i}", {"body": body}))
-    return docs
+    return b.build(), m
 
 
-def main():
+def config1_match(searcher, m, lens, tok, rng):
+    """match BM25 top-10, batched _msearch path, exact-result contract."""
+    from elasticsearch_tpu.ops.batched import BatchTermSearcher
+
+    bs = BatchTermSearcher(searcher)
+    pack = searcher.pack
+    V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
+
+    # mean postings touched per query (for the CPU baseline model)
+    probe = sample_queries(rng, lens, tok, 2048)
+    sum_df = np.mean(
+        [
+            sum(pack.term_blocks("body", t)[2] for t, _ in q)
+            for q in probe
+        ]
+    )
+    baseline_qps = CORES * MULTICORE_EFF * POSTINGS_PER_CORE / max(sum_df, 1.0)
+
+    log(f"[c1] warmup (compiles {V}-row dense tier)...")
+    warm = sample_queries(rng, lens, tok, Q_BATCH)
+    bs.msearch("body", warm, TOP_K)
+
+    lat = []
+    total_q = 0
+    t_all = time.perf_counter()
+    for it in range(N_BATCHES):
+        queries = sample_queries(rng, lens, tok, Q_BATCH)
+        t0 = time.perf_counter()  # includes host planning
+        s, i, t, ex = bs.msearch("body", queries, TOP_K)
+        lat.append(time.perf_counter() - t0)
+        total_q += len(queries)
+        log(f"[c1] batch {it}: {lat[-1]*1e3:.0f} ms, exact(pre-rerun) {ex.mean():.3f}")
+    elapsed = time.perf_counter() - t_all
+    qps = total_q / elapsed
+
+    # parity gate: fast path vs bit-exact path on a fresh sample
+    gate = sample_queries(rng, lens, tok, min(512, Q_BATCH))
+    sf, idf, tf_, _ = bs.msearch("body", gate, TOP_K, fast=True)
+    se, ide, te = [np.asarray(x) for x in bs.run("body", bs.plan("body", gate, TOP_K))]
+    rank_parity = float(np.mean([
+        np.array_equal(idf[q][np.isfinite(sf[q])], ide[q][np.isfinite(se[q])])
+        for q in range(len(gate))
+    ]))
+    totals_parity = float(np.mean((tf_ == te) | (tf_ >= 10_000)))
+
+    # utilization accounting: logical dense-tier matmul flops + HBM traffic
+    flops = 2.0 * total_q * V * N_DOCS
+    mfu = flops / elapsed / PEAK_BF16_FLOPS
+    # per batch: read dense tier per chunk + write/read scores ~3 passes
+    n_chunks = max(1, Q_BATCH // bs._chunk_q(Q_BATCH))
+    bytes_touched = N_BATCHES * (
+        n_chunks * V * N_DOCS * 4 + 3 * Q_BATCH * N_DOCS * 4
+    )
+    hbm_util = bytes_touched / elapsed / PEAK_HBM_BPS
+    return {
+        "qps": round(qps, 1),
+        "p50_batch_ms": round(float(np.median(lat)) * 1e3, 1),
+        "batch_size": Q_BATCH,
+        "mean_sum_df": round(float(sum_df)),
+        "baseline_model_qps": round(baseline_qps, 1),
+        "vs_baseline": round(qps / baseline_qps, 2),
+        "rank_parity": rank_parity,
+        "totals_contract": totals_parity,
+        "dense_matmul_mfu": round(mfu, 4),
+        "hbm_utilization": round(hbm_util, 3),
+    }
+
+
+def config2_wand(sp_mod, pack, m, rng):
+    """bool-should long-postings disjunction: block-max pruned vs
+    exhaustive on identical queries (identical results enforced)."""
+    from elasticsearch_tpu.parallel.sharded import StackedSearcher
+    from elasticsearch_tpu.parallel.stacked import StackedPack
+
+    sp = StackedPack([pack], m)
+    ss = StackedSearcher(sp, mesh=None)
+    # CSR-tail disjunctions: the dense tier needs no WAND (the MXU scores
+    # it exhaustively in one matmul); block-max pruning targets the long
+    # CSR postings below the dense-df threshold, the analog of Lucene
+    # pruning mid-frequency disjunctions
+    qs = []
+    for _ in range(12):
+        terms = rng.integers(900, 3500, size=4)
+        qs.append(
+            {"bool": {"should": [
+                {"term": {"body": f"t{t}"}} for t in terms
+            ]}}
+        )
+    # warm both paths
+    ss.search(qs[0], size=TOP_K, prune_floor=10_000)
+    ss.search(qs[0], size=TOP_K, prune_floor=None)
+
+    t_ex, t_pr, pruned_frac = [], [], []
+    for q in qs:
+        t0 = time.perf_counter()
+        r_ex = ss.search(q, size=TOP_K, prune_floor=None)
+        t_ex.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        r_pr = ss.search(q, size=TOP_K, prune_floor=10_000)
+        t_pr.append(time.perf_counter() - t0)
+        st = getattr(r_pr, "wand_stats", None)
+        if st:
+            pruned_frac.append(
+                st["rows_pruned"] / max(st["rows_kept"] + st["rows_pruned"], 1)
+            )
+        assert list(r_pr.doc_ids) == list(r_ex.doc_ids), "pruning changed top-k"
+    p50_ex = float(np.median(t_ex)) * 1e3
+    p50_pr = float(np.median(t_pr)) * 1e3
+    return {
+        "p50_exhaustive_ms": round(p50_ex, 1),
+        "p50_pruned_ms": round(p50_pr, 1),
+        "speedup": round(p50_ex / p50_pr, 2),
+        "rows_pruned_frac": round(float(np.mean(pruned_frac)) if pruned_frac else 0.0, 3),
+    }
+
+
+def config3_aggs(rng):
+    """terms + date_histogram over an http_logs-like 1M-doc corpus."""
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.parallel.sharded import StackedSearcher
+    from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+
+    n = N_DOCS
+    log(f"[c3] building http_logs-like corpus ({n} docs)...")
+    m = Mappings({"properties": {
+        "status": {"type": "keyword"},
+        "clientip": {"type": "keyword"},
+        "@timestamp": {"type": "date"},
+        "size": {"type": "long"},
+    }})
+    statuses = np.array(["200", "200", "200", "200", "304", "404", "500", "301"])
+    ips = rng.integers(0, 60_000, size=n)  # high-cardinality keyword
+    t0ms = 1_420_070_400_000
+    times = t0ms + rng.integers(0, 30 * 86_400_000, size=n)
+    sizes = rng.integers(100, 100_000, size=n)
+    st = statuses[rng.integers(0, len(statuses), size=n)]
+    docs = [
+        (str(i), {
+            "status": st[i],
+            "clientip": f"10.{ips[i] >> 8 & 255}.{ips[i] & 255}.{ips[i] % 251}",
+            "@timestamp": int(times[i]),
+            "size": int(sizes[i]),
+        })
+        for i in range(n)
+    ]
+    sp = build_stacked_pack(docs, m, num_shards=1)
+    ss = StackedSearcher(sp, mesh=None)
+    aggs = {
+        "by_status": {
+            "terms": {"field": "status"},
+            "aggs": {
+                "over_time": {"date_histogram": {
+                    "field": "@timestamp", "calendar_interval": "day"}},
+                "bytes": {"sum": {"field": "size"}},
+            },
+        }
+    }
+    ss.search(None, size=0, aggs=aggs)  # warm
+    lat = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        r = ss.search(None, size=0, aggs=aggs)
+        lat.append(time.perf_counter() - t0)
+    p50 = float(np.median(lat))
+    baseline_ms = n / AGG_DOCS_PER_SEC * 1e3
+    n_buckets = len(r.aggregations["by_status"]["buckets"])
+    return {
+        "p50_ms": round(p50 * 1e3, 1),
+        "docs_per_s": round(n / p50 / 1e6, 1),
+        "unit_docs_per_s": "M docs/s",
+        "baseline_model_ms": round(baseline_ms, 1),
+        "vs_baseline": round(baseline_ms / (p50 * 1e3), 2),
+        "buckets": n_buckets,
+    }
+
+
+def config4_knn(rng):
+    """dense_vector exact cosine kNN: fused matmul scan, top-10."""
+    from elasticsearch_tpu.ops.kernels import scan_topk
+    import jax
+    import jax.numpy as jnp
+
+    n, dims, q_n = N_DOCS, 384, 256
+    log(f"[c4] building {n}x{dims} vector corpus...")
+    vecs = rng.standard_normal((n, dims), dtype=np.float32)
+    inv = 1.0 / np.linalg.norm(vecs, axis=1)
+    mat_t = jnp.asarray(vecs.T)  # [D, N]
+    aux_doc = jnp.asarray(inv)
+    live = jnp.ones((n,), bool)
+
+    def run_batch(qv):
+        qinv = 1.0 / np.linalg.norm(qv, axis=1)
+        return scan_topk(
+            jnp.asarray(qv), mat_t, live, TOP_K,
+            transform="cosine", aux_doc=aux_doc, aux_q=jnp.asarray(qinv),
+            count_positive=False,
+        )
+    out = run_batch(rng.standard_normal((q_n, dims), dtype=np.float32))
+    np.asarray(out[0])  # warm + sync
+    lat, total_q = [], 0
+    t_all = time.perf_counter()
+    for _ in range(6):
+        qv = rng.standard_normal((q_n, dims), dtype=np.float32)
+        t0 = time.perf_counter()
+        out = run_batch(qv)
+        np.asarray(out[0])
+        lat.append(time.perf_counter() - t0)
+        total_q += q_n
+    elapsed = time.perf_counter() - t_all
+    qps = total_q / elapsed
+    baseline_qps = CORES * MULTICORE_EFF * KNN_FLOPS_PER_CORE / (2.0 * dims * n)
+    flops = 2.0 * total_q * dims * n
+    return {
+        "qps": round(qps, 1),
+        "p50_batch_ms": round(float(np.median(lat)) * 1e3, 1),
+        "batch_size": q_n,
+        "baseline_model_qps": round(baseline_qps, 1),
+        "vs_baseline": round(qps / baseline_qps, 2),
+        "mfu": round(flops / elapsed / PEAK_BF16_FLOPS, 4),
+    }
+
+
+def config5_8shard(lens, tok, rng):
+    """_msearch over an 8-shard index: per-shard batched programs + global
+    top-k merge (Lucene tie-break order). One chip runs the 8 shard
+    programs serially; on a v5e-8 each shard maps to its own chip (the
+    sharding itself is validated by __graft_entry__.dryrun_multichip)."""
     from elasticsearch_tpu.index.mappings import Mappings
     from elasticsearch_tpu.index.pack import PackBuilder
     from elasticsearch_tpu.ops.batched import BatchTermSearcher
     from elasticsearch_tpu.query.executor import ShardSearcher
 
-    rng = np.random.default_rng(42)
+    S = 8
+    log(f"[c5] building {S}-shard corpus...")
     m = Mappings({"properties": {"body": {"type": "text"}}})
-    b = PackBuilder(m)
-    for _, src in build_corpus(rng):
-        b.add_document(m.parse_document(src))
-    searcher = ShardSearcher(b.build(), mappings=m)
-    bs = BatchTermSearcher(searcher)
+    term_strs = np.array([f"t{i}" for i in range(VOCAB)])
+    starts = np.concatenate([[0], np.cumsum(lens[:-1])])
+    shard_of = rng.integers(0, S, size=len(lens))
+    searchers = []
+    for s in range(S):
+        b = PackBuilder(m)
+        for d in np.nonzero(shard_of == s)[0]:
+            st, ln = starts[d], lens[d]
+            b.add_document({"body": [" ".join(term_strs[tok[st : st + ln]])]})
+        searchers.append(ShardSearcher(b.build(), mappings=m))
+    bss = [BatchTermSearcher(s) for s in searchers]
 
-    # Query batch: mid-frequency terms (heads are stopword-like, tails
-    # trivial); mix of dense-tier and sparse-tail terms
-    queries = []
-    for _ in range(N_QUERIES):
-        terms = [f"t{int(t)}" for t in rng.integers(20, VOCAB, size=TERMS_PER_QUERY)]
-        queries.append([(t, 1.0) for t in terms])
-    plan = bs.plan("body", queries, TOP_K)
+    q_n = min(1024, Q_BATCH)
+    warm = sample_queries(rng, lens, tok, q_n)
+    for bs in bss:
+        bs.msearch("body", warm, TOP_K)
+    lat, total_q = [], 0
+    merged_shapes = None
+    t_all = time.perf_counter()
+    for _ in range(3):
+        queries = sample_queries(rng, lens, tok, q_n)
+        t0 = time.perf_counter()
+        per_shard = [bs.msearch("body", queries, TOP_K) for bs in bss]
+        # coordinator merge, (score desc, shard asc, doc asc) — the
+        # reference's SearchPhaseController order
+        allv = np.stack([p[0] for p in per_shard])  # [S, Q, k]
+        alli = np.stack([p[1] for p in per_shard])
+        nq = len(queries)
+        flat_v = allv.transpose(1, 0, 2).reshape(nq, -1)
+        flat_i = alli.transpose(1, 0, 2).reshape(nq, -1)
+        flat_s = np.broadcast_to(
+            np.repeat(np.arange(S), TOP_K)[None, :], flat_v.shape
+        )
+        order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :TOP_K]
+        m_v = np.take_along_axis(flat_v, order, axis=1)
+        m_s = np.take_along_axis(flat_s, order, axis=1)
+        m_d = np.take_along_axis(flat_i, order, axis=1)
+        lat.append(time.perf_counter() - t0)
+        total_q += nq
+        merged_shapes = (m_v.shape, m_s.shape, m_d.shape)
+    elapsed = time.perf_counter() - t_all
+    qps = total_q / elapsed
+    assert merged_shapes == ((q_n, TOP_K),) * 3
+    return {
+        "qps_1chip_serial": round(qps, 1),
+        "p50_batch_ms": round(float(np.median(lat)) * 1e3, 1),
+        "batch_size": q_n,
+        "shards": S,
+        "note": "8 shard programs serialized on one chip; v5e-8 runs them in parallel",
+    }
 
-    for _ in range(WARMUP):
-        out = bs.run("body", plan)
-    _ = np.asarray(out[0])  # sync
 
-    t0 = time.perf_counter()
-    outs = [bs.run("body", plan) for _ in range(ITERS)]
-    _ = [np.asarray(o[0]).ravel()[0] for o in outs]  # force full completion
-    elapsed = time.perf_counter() - t0
-    qps = N_QUERIES * ITERS / elapsed
+def main():
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    from elasticsearch_tpu.utils.jax_env import enable_compile_cache
 
+    enable_compile_cache()
+    rng = np.random.default_rng(42)
+    log(f"[corpus] generating {N_DOCS} docs...")
+    lens, tok = build_corpus(rng)
+    extras = {}
+
+    if only in (None, "c1", "c2"):
+        log("[pack] building 1M-doc text pack...")
+        t0 = time.perf_counter()
+        pack, m = build_pack(lens, tok)
+        log(f"[pack] built in {time.perf_counter()-t0:.0f}s; "
+            f"dense tier {None if pack.dense_tfn is None else pack.dense_tfn.shape}")
+        from elasticsearch_tpu.query.executor import ShardSearcher
+
+        if only in (None, "c1"):
+            searcher = ShardSearcher(pack, mappings=m)
+            extras["match_bm25"] = config1_match(searcher, m, lens, tok, rng)
+            log(f"[c1] {extras['match_bm25']}")
+            del searcher
+            gc.collect()
+        if only in (None, "c2"):
+            extras["wand_disjunction"] = config2_wand(None, pack, m, rng)
+            log(f"[c2] {extras['wand_disjunction']}")
+        del pack
+        gc.collect()
+
+    if only in (None, "c3"):
+        extras["terms_date_histogram"] = config3_aggs(rng)
+        log(f"[c3] {extras['terms_date_histogram']}")
+        gc.collect()
+
+    if only in (None, "c4"):
+        extras["knn_cosine_exact"] = config4_knn(rng)
+        log(f"[c4] {extras['knn_cosine_exact']}")
+        gc.collect()
+
+    if only in (None, "c5"):
+        extras["msearch_8shard"] = config5_8shard(lens, tok, rng)
+        log(f"[c5] {extras['msearch_8shard']}")
+
+    c1 = extras.get("match_bm25", {})
     print(json.dumps({
-        "metric": "bm25_match_top10_batched_qps",
-        "value": round(qps, 1),
+        "metric": "bm25_match_top10_qps_1M_docs",
+        "value": c1.get("qps", 0.0),
         "unit": "queries/s",
-        "vs_baseline": round(qps / BASELINE_QPS, 3),
+        "vs_baseline": c1.get("vs_baseline", 0.0),
+        "extras": extras,
     }))
 
 

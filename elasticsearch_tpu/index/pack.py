@@ -658,7 +658,13 @@ class PackBuilder:
         dense_dict = {k: i for i, k in enumerate(dense_keys)}
         dense_tfn = None
         if dense_keys:
-            dense_tfn = np.zeros((len(dense_keys), N), dtype=np.float32)
+            # row count padded to a multiple of 128: per-shard vocabularies
+            # differ slightly, and a lane-aligned row axis lets every shard
+            # of an index share one compiled batched-query executable
+            # (ops/batched.py W is [Q, V]); padding rows stay all-zero so
+            # they never score or match
+            v_pad = -len(dense_keys) % 128
+            dense_tfn = np.zeros((len(dense_keys) + v_pad, N), dtype=np.float32)
             # per-field scoring constants, indexed by field code
             avgdl_of_field = np.ones(len(field_names), dtype=np.float64)
             has_norms_of_field = np.zeros(len(field_names), dtype=bool)

@@ -15,6 +15,9 @@ def main(argv=None):
     parser.add_argument("--port", type=int, default=9200)
     parser.add_argument("--data-path", default=None, help="durable data directory (WAL, meta)")
     args = parser.parse_args(argv)
+    from ..utils.jax_env import enable_compile_cache
+
+    enable_compile_cache()
     app = make_app(data_path=args.data_path)
     web.run_app(app, host=args.host, port=args.port)
 

@@ -89,3 +89,45 @@ def test_batched_dense_only_pallas_interpret(monkeypatch):
         ref = s.search(node, size=5)
         assert totals[qi] == ref.total
         _assert_hits_match(scores[qi], ids[qi], ref, ctx=("pallas", qi))
+
+
+def test_msearch_fast_matches_exact():
+    """Candidate-cut fast path + bucketed planning + totals contract vs the
+    per-query reference, including forced-cut (tiny M) reruns."""
+    s, rng = _build(n_docs=600, vocab=60, dense_min_df=25)
+    bs = BatchTermSearcher(s)
+    queries = []
+    for _ in range(48):
+        nt = int(rng.integers(1, 6))
+        queries.append([(f"w{int(rng.integers(0, 70))}", 1.0) for _ in range(nt)])
+    queries.append([])  # empty match: no analyzable terms -> matches nothing
+    k = 7
+    scores, ids, totals, exact = bs.msearch("body", queries, k=k, fast=True)
+    # results are exact regardless of `exact` (which only reports whether
+    # the first pass proved it without the rerun)
+    assert totals[-1] == 0
+    for qi, terms in enumerate(queries[:-1]):
+        node = BoolNode(
+            should=[TermNode("body", t) for t, _ in terms], minimum_should_match=1
+        )
+        ref = s.search(node, size=k)
+        # corpus < 10k docs: totals must be exact under the default
+        # track_total_hits contract
+        assert totals[qi] == ref.total, (qi, terms)
+        _assert_hits_match(scores[qi], ids[qi], ref, ctx=(qi, terms))
+
+
+def test_run_fast_cut_flags_and_bounds():
+    """With a deliberately tiny M the cut must either prove exactness or
+    flag, and the totals bracket [lb, lb+dropped] must contain the truth."""
+    s, rng = _build(n_docs=800, vocab=30, dense_min_df=10**9)  # all sparse
+    bs = BatchTermSearcher(s)
+    queries = [[(f"w{i}", 1.0) for i in range(4)] for _ in range(8)]
+    plan = bs.plan("body", queries, k=5)
+    out = bs.run_fast("body", plan, M=8)
+    fv, fi, lb, exact, dropped = [np.asarray(x) for x in out]
+    ev, ei, et = [np.asarray(x) for x in bs.run("body", plan)]
+    for qi in range(len(queries)):
+        assert lb[qi] <= et[qi] <= lb[qi] + dropped[qi]
+        if exact[qi]:
+            np.testing.assert_allclose(fv[qi], ev[qi], rtol=1e-5)
