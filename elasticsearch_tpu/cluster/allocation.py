@@ -195,5 +195,9 @@ def create_index_state(
         "in_sync": {},
         "primary_terms": {},
         "alloc_counter": 0,
+        # distinguishes this index generation from a deleted+recreated one
+        # with the same name (IndexMetadata.INDEX_UUID): stale stores from
+        # an older generation must not seed ops-based recovery
+        "uuid": f"{index}-t{state.term}v{state.version}",
     }
     return allocate(state.with_index(index, meta, {}))

@@ -139,12 +139,29 @@ class Coordinator:
         service: TransportService,
         network,
         node_info: dict | None = None,
+        persist_path: str | None = None,
     ):
         self.node_id = node_id
         self.service = service
         self.network = network
         self.node_info = node_info or {"roles": ["master", "data"]}
         self.cs = CoordinationState(node_id, voting_nodes)
+        # durable coordination metadata (GatewayMetaState analog): term +
+        # vote + accepted state survive restarts; see gateway.py for the
+        # safety obligations on persist ordering
+        self._persist_svc = None
+        if persist_path is not None:
+            from .gateway import PersistedClusterState
+
+            self._persist_svc = PersistedClusterState(persist_path)
+            loaded = self._persist_svc.load()
+            if loaded is not None:
+                self.cs.current_term = loaded["current_term"]
+                self.cs.join_granted_this_term = loaded["join_granted_this_term"]
+                self.cs.last_accepted = ClusterState.from_dict(loaded["accepted"])
+                la = self.cs.last_accepted
+                if loaded["committed"] == (la.term, la.version):
+                    self.cs.last_committed = la
         self.mode = CANDIDATE
         self.leader: str | None = None
         self._last_leader_msg = -1e9
@@ -178,6 +195,17 @@ class Coordinator:
         self._started = True
         self._schedule_election()
         self._schedule_checks()
+
+    def _persist(self):
+        """Write coordination metadata through to disk. Called BEFORE any
+        response leaves the node for a term/vote/accept mutation."""
+        if self._persist_svc is not None:
+            self._persist_svc.persist(
+                self.cs.current_term,
+                self.cs.join_granted_this_term,
+                self.cs.last_accepted.to_dict(),
+                (self.cs.last_committed.term, self.cs.last_committed.version),
+            )
 
     def stop(self):
         self._started = False
@@ -270,6 +298,7 @@ class Coordinator:
         new_term = self.cs.current_term + 1
         self.cs.current_term = new_term
         self.cs.join_granted_this_term = True  # vote for self
+        self._persist()  # self-vote durable before requesting joins
         self._joins = {self.node_id}
         la = self.cs.last_accepted
         req = {"term": new_term, "cand_term": la.term, "cand_version": la.version}
@@ -360,6 +389,7 @@ class Coordinator:
         granted = self.cs.handle_join_request(
             req["term"], req["cand_term"], req["cand_version"]
         )
+        self._persist()  # term + vote durable before the response leaves
         if granted and self.mode == LEADER:
             # we were leader in an older term; a new term started
             self._become_candidate("voted in newer term")
@@ -378,6 +408,7 @@ class Coordinator:
         self._publication = pub
         # self-accept through the same safety core
         ok = self.cs.handle_publish(state)
+        self._persist()
         if not ok:
             self._publication = None
             on_done(False, "rejected locally")
@@ -422,6 +453,7 @@ class Coordinator:
         pub.committed = True
         st = pub.state
         self.cs.handle_commit(st.term, st.version)
+        self._persist()
         self._apply(st)
         msg = {"term": st.term, "version": st.version}
         for p in sorted(set(st.nodes) | set(self.cs.voting_nodes)):
@@ -437,6 +469,7 @@ class Coordinator:
     def _on_publish(self, req, from_node):
         state = ClusterState.from_dict(req["state"])
         accepted = self.cs.handle_publish(state)
+        self._persist()  # accepted state durable before the ack leaves
         if accepted:
             self._become_follower(state.master_id or from_node, state.term)
         return {"accepted": accepted, "term": self.cs.current_term}
@@ -444,6 +477,7 @@ class Coordinator:
     def _on_commit(self, req, from_node):
         applied = self.cs.handle_commit(req["term"], req["version"])
         if applied:
+            self._persist()
             self._last_leader_msg = self._now()
             self._apply(self.cs.last_committed)
         return {"applied": applied}

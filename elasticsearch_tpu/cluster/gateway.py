@@ -1,0 +1,110 @@
+"""On-disk coordination metadata: persisted term, vote, and accepted state.
+
+The reference persists the node's coordination metadata (current term,
+whether it voted this term) and the last-accepted cluster state to a Lucene
+index on disk, and recovers them on node start so a full-cluster restart
+keeps its metadata and its voting safety (reference behavior:
+gateway/PersistedClusterStateService.java:930 writeFullStateAndCommit, :969
+metadata document layout; GatewayMetaState wiring it into Coordinator).
+
+Here the layout is a content-addressed blob per accepted state plus one
+atomically-replaced manifest, the same scheme as the snapshot repository
+(snapshots/repository.py): the manifest names the blob by content hash, a
+crash between blob write and manifest rename leaves the previous manifest
+intact, and unreferenced blobs are garbage-collected on the next persist.
+
+Safety notes (matching CoordinationState.java invariants):
+  - term and vote MUST hit disk before a join response leaves the node —
+    otherwise a restarted node could vote twice in one term and elect two
+    masters;
+  - an accepted state MUST hit disk before the publish ack — otherwise a
+    quorum could "commit" a state that no surviving node remembers;
+  - the committed (term, version) pointer is advisory: on restore the
+    last-committed state is only pre-seeded when it equals the accepted
+    state; otherwise commit-ness is rediscovered from the next election
+    (the reference likewise persists only accepted metadata).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+
+class PersistedClusterState:
+    def __init__(self, path: str):
+        self.path = path
+        self.blob_dir = os.path.join(path, "blobs")
+        os.makedirs(self.blob_dir, exist_ok=True)
+        self._last_blob: str | None = None
+
+    # -- write -------------------------------------------------------------
+
+    def persist(
+        self,
+        current_term: int,
+        join_granted_this_term: bool,
+        accepted: dict,
+        committed_tv: tuple[int, int],
+    ) -> None:
+        payload = json.dumps(accepted, sort_keys=True).encode()
+        digest = hashlib.sha256(payload).hexdigest()
+        blob = f"state-{digest}.json"
+        blob_path = os.path.join(self.blob_dir, blob)
+        if not os.path.exists(blob_path):
+            tmp = blob_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, blob_path)
+        manifest = {
+            "current_term": current_term,
+            "join_granted_this_term": join_granted_this_term,
+            "blob": blob,
+            "committed": list(committed_tv),
+        }
+        mpath = os.path.join(self.path, "manifest.json")
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
+        # the rename itself must be durable before any vote/ack leaves the
+        # node: fsync the directories, or power loss could revert the
+        # manifest and let the node vote twice in one term
+        for d in (self.blob_dir, self.path):
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        if self._last_blob not in (None, blob):
+            try:
+                os.unlink(os.path.join(self.blob_dir, self._last_blob))
+            except OSError:
+                pass
+        self._last_blob = blob
+
+    # -- read --------------------------------------------------------------
+
+    def load(self) -> dict | None:
+        """-> {"current_term", "join_granted_this_term", "accepted": dict,
+        "committed": (term, version)} or None when nothing was persisted."""
+        mpath = os.path.join(self.path, "manifest.json")
+        if not os.path.exists(mpath):
+            return None
+        with open(mpath) as f:
+            manifest = json.load(f)
+        blob_path = os.path.join(self.blob_dir, manifest["blob"])
+        with open(blob_path) as f:
+            accepted = json.load(f)
+        self._last_blob = manifest["blob"]
+        return {
+            "current_term": manifest["current_term"],
+            "join_granted_this_term": manifest["join_granted_this_term"],
+            "accepted": accepted,
+            "committed": tuple(manifest["committed"]),
+        }

@@ -56,15 +56,21 @@ class ClusterNode:
     SEARCH_TIMEOUT = 60.0
 
     def __init__(self, node_id: str, voting_nodes: list[str], network,
-                 roles: list[str] | None = None):
+                 roles: list[str] | None = None, data_path: str | None = None):
         self.node_id = node_id
         self.network = network
         self.service = TransportService(node_id, network)
         self.coordinator = Coordinator(
             node_id, voting_nodes, self.service, network,
             node_info={"roles": roles or ["master", "data"]},
+            persist_path=(data_path + "/_state") if data_path else None,
         )
+        self.last_recovery_mode: str | None = None  # instrumentation
         self.shards: dict[tuple[str, int], ShardCopy] = {}
+        # stores of copies unassigned from this node but not deleted: the
+        # reference keeps the shard directory on disk when routing moves
+        # away, and ops-based recovery reuses it when the shard comes back
+        self._orphan_stores: dict[tuple[str, int], ShardCopy] = {}
         self._searchers: dict[tuple[str, int], tuple[int, object]] = {}
         self._recovering: set[tuple[str, int]] = set()
         self.coordinator.add_applied_listener(self._apply_cluster_state)
@@ -101,7 +107,16 @@ class ClusterNode:
                     seen.add((index, s))
                     copy = self.shards.get((index, s))
                     if copy is None or copy.allocation_id != a["allocation_id"]:
+                        prev = copy or self._orphan_stores.pop((index, s), None)
                         copy = ShardCopy(index, s, a["allocation_id"])
+                        if (prev is not None
+                                and prev.index_uuid == meta.get("uuid")):
+                            # same index generation re-assigned here (node
+                            # rejoined): keep the doc/op state as the base
+                            # for ops-only recovery (the reference reuses
+                            # the on-disk store and recovers the delta)
+                            copy.adopt_store(prev)
+                        copy.index_uuid = meta.get("uuid")
                         self.shards[(index, s)] = copy
                         self._searchers.pop((index, s), None)
                     copy.primary_term = max(
@@ -109,10 +124,17 @@ class ClusterNode:
                     )
                     if a["state"] == "INITIALIZING" and not a["primary"]:
                         self._maybe_start_recovery(state, index, s, a)
-        # drop copies no longer assigned here
+        # no longer assigned here: keep the store aside (deleted only when
+        # its index generation is gone) so a re-assignment recovers ops-only
         for key in [k for k in self.shards if k not in seen]:
-            del self.shards[key]
+            copy = self.shards.pop(key)
             self._searchers.pop(key, None)
+            meta = state.indices.get(key[0])
+            if meta is not None and meta.get("uuid") == copy.index_uuid:
+                self._orphan_stores[key] = copy
+        for key in [k for k in self._orphan_stores
+                    if k[0] not in state.indices]:
+            del self._orphan_stores[key]
 
     # ------------------------------------------------------------------
     # master-side tasks (any node forwards to the elected master)
@@ -370,13 +392,28 @@ class ClusterNode:
             return
         self._recovering.add(key)
         alloc_id = assign["allocation_id"]
+        local_ckpt = -1
+        existing = self.shards.get(key)
+        if existing is not None and existing.allocation_id == alloc_id:
+            # a surviving store (node rejoined): offer its checkpoint so the
+            # primary can send just the missing ops under a retention lease
+            local_ckpt = existing.tracker.checkpoint
 
-        def on_snapshot(snap):
+        def on_snapshot(resp):
             self._recovering.discard(key)
             copy = self.shards.get(key)
             if copy is None or copy.allocation_id != alloc_id:
                 return
-            copy.restore_from_snapshot(snap)
+            self.last_recovery_mode = resp.get("mode", "snapshot")
+            if resp.get("mode") == "ops":
+                for op in resp["ops"]:
+                    copy.apply_op(op)
+                copy.primary_term = max(copy.primary_term, resp["primary_term"])
+                copy.global_checkpoint = max(
+                    copy.global_checkpoint, resp["global_checkpoint"]
+                )
+            else:
+                copy.restore_from_snapshot(resp)
             self._submit_to_master({
                 "kind": "shard_started", "index": index, "shard": s,
                 "allocation_id": alloc_id,
@@ -389,7 +426,8 @@ class ClusterNode:
 
         self.service.send_request(
             primary_node, A_START_RECOVERY,
-            {"index": index, "shard": s},
+            {"index": index, "shard": s, "allocation_id": alloc_id,
+             "local_checkpoint": local_ckpt},
             on_snapshot, on_err, timeout=self.REPLICATION_TIMEOUT * 4,
         )
 
@@ -404,6 +442,27 @@ class ClusterNode:
         copy = self.shards.get((req["index"], req["shard"]))
         if copy is None:
             raise RuntimeError("no local copy to recover from")
+        ckpt = req.get("local_checkpoint", -1)
+        alloc_id = req.get("allocation_id")
+        if alloc_id:
+            # pin history at the recovering copy's checkpoint for the
+            # duration of the transfer (RecoverySourceHandler acquires a
+            # retention lease before deciding the recovery plan)
+            copy.renew_lease(alloc_id, ckpt + 1)
+        # a checkpoint beyond this primary's own is divergent history (ops
+        # acked only by a dead primary); the copy must roll back via the
+        # snapshot path, never resync ops-only (the reference rolls back
+        # the engine on primary-term bump, InternalEngine#rollback)
+        if (0 <= ckpt <= copy.tracker.checkpoint
+                and copy.has_complete_history_since(ckpt)):
+            # ops-only resync: the store already holds everything <= ckpt
+            return {
+                "mode": "ops",
+                "ops": copy.ops_since(ckpt),
+                "max_seq_no": copy.max_seq_no,
+                "primary_term": copy.primary_term,
+                "global_checkpoint": copy.global_checkpoint,
+            }
         return copy.snapshot_for_recovery()
 
     # ------------------------------------------------------------------

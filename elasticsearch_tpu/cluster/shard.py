@@ -52,6 +52,7 @@ class ShardCopy:
         self.index = index
         self.shard_id = shard_id
         self.allocation_id = allocation_id
+        self.index_uuid: str | None = None
         self.docs: dict[str, ShardDoc] = {}
         self.ops: dict[int, dict] = {}  # seq_no -> op record (retained history)
         self.tracker = LocalCheckpointTracker()
@@ -61,6 +62,45 @@ class ShardCopy:
         # primary-only state
         self.next_seq_no = 0
         self.replica_checkpoints: dict[str, int] = {}  # allocation_id -> local ckpt
+        # retention leases: allocation_id -> first seq-no that copy still
+        # needs (its local checkpoint + 1). Ops at/above the minimum lease
+        # are retained so the copy can resync ops-only after a partition
+        # (ReplicationTracker.java retention leases;
+        # RecoverySourceHandler.java:198-205 ops-based recovery plan)
+        self.retention_leases: dict[str, int] = {}
+
+    # -- retention ---------------------------------------------------------
+
+    def renew_lease(self, allocation_id: str, retained_from: int) -> None:
+        prev = self.retention_leases.get(allocation_id, 0)
+        self.retention_leases[allocation_id] = max(prev, retained_from)
+
+    def remove_lease(self, allocation_id: str) -> None:
+        self.retention_leases.pop(allocation_id, None)
+
+    MAX_RETAINED_OPS = 10_000  # lease expiry analog: cap history growth
+
+    def trim_history(self) -> None:
+        """Drop op records no lease can still need. Without leases, history
+        up to the global checkpoint is droppable (every in-sync copy has
+        processed it). A lease holding more than MAX_RETAINED_OPS of
+        history expires (the reference expires leases by age; an expired
+        copy falls back to snapshot recovery)."""
+        floor = min(
+            self.retention_leases.values(), default=self.global_checkpoint + 1
+        )
+        floor = min(floor, self.global_checkpoint + 1)
+        hard_floor = self.max_seq_no - self.MAX_RETAINED_OPS
+        if floor < hard_floor:
+            floor = hard_floor
+            for aid in [a for a, s in self.retention_leases.items() if s < floor]:
+                del self.retention_leases[aid]
+        for s in [s for s in self.ops if s < floor]:
+            del self.ops[s]
+
+    def has_complete_history_since(self, checkpoint: int) -> bool:
+        return all(s in self.ops
+                   for s in range(checkpoint + 1, self.max_seq_no + 1))
 
     # -- op application (both roles) ---------------------------------------
 
@@ -69,6 +109,10 @@ class ShardCopy:
         Returns a result record; stale ops (seq_no <= doc's) are no-ops."""
         seq = op["seq_no"]
         self.ops[seq] = op
+        if len(self.ops) > 2 * self.MAX_RETAINED_OPS:
+            # replicas never run the primary's checkpoint path, so cap
+            # their history here too
+            self.trim_history()
         self.max_seq_no = max(self.max_seq_no, seq)
         # keep the assignable seq-no ahead even when applying as a replica,
         # so a later promotion continues the sequence instead of reusing it
@@ -105,6 +149,7 @@ class ShardCopy:
     def update_replica_checkpoint(self, allocation_id: str, checkpoint: int) -> None:
         prev = self.replica_checkpoints.get(allocation_id, -1)
         self.replica_checkpoints[allocation_id] = max(prev, checkpoint)
+        self.renew_lease(allocation_id, self.replica_checkpoints[allocation_id] + 1)
 
     def compute_global_checkpoint(self, in_sync_allocations: list[str]) -> int:
         """min local checkpoint over in-sync copies (ReplicationTracker:147)."""
@@ -113,6 +158,7 @@ class ShardCopy:
             if aid != self.allocation_id:
                 ckpts.append(self.replica_checkpoints.get(aid, -1))
         self.global_checkpoint = max(self.global_checkpoint, min(ckpts))
+        self.trim_history()
         return self.global_checkpoint
 
     # -- recovery ----------------------------------------------------------
@@ -133,6 +179,14 @@ class ShardCopy:
         }
 
     def restore_from_snapshot(self, snap: dict) -> None:
+        if self.max_seq_no > snap["max_seq_no"]:
+            # local history diverged (ops acked only by a dead primary):
+            # roll the store back before adopting the primary's state, or
+            # orphaned higher-seq docs would mask the snapshot's versions
+            self.docs = {}
+            self.ops = {}
+            self.tracker = LocalCheckpointTracker()
+            self.max_seq_no = -1
         for i, d in snap["docs"].items():
             cur = self.docs.get(i)
             if cur is None or cur.seq_no < d["seq_no"]:
@@ -149,6 +203,17 @@ class ShardCopy:
 
     def ops_since(self, seq_no: int) -> list[dict]:
         return [self.ops[s] for s in sorted(self.ops) if s > seq_no]
+
+    def adopt_store(self, prev: "ShardCopy") -> None:
+        """Take over a previous copy's doc/op state under a new allocation
+        id (node rejoined; the store survived while the routing changed)."""
+        self.docs = prev.docs
+        self.ops = prev.ops
+        self.tracker = prev.tracker
+        self.max_seq_no = prev.max_seq_no
+        self.global_checkpoint = prev.global_checkpoint
+        self.primary_term = prev.primary_term
+        self.next_seq_no = prev.next_seq_no
 
     # -- reads -------------------------------------------------------------
 

@@ -30,17 +30,23 @@ from ..analysis import get_analyzer, Analyzer
 from ..utils.errors import MapperParsingError
 
 TEXT_TYPES = {"text"}
-KEYWORD_TYPES = {"keyword"}
+# flattened is the whole-object keyword family: every leaf value indexes as
+# an exact term under the root field, every leaf path as a dynamic keyword
+# sub-field (reference behavior: x-pack flattened FlattenedFieldMapper)
+KEYWORD_TYPES = {"keyword", "flattened"}
+IP_TYPES = {"ip"}
 INT_TYPES = {"long", "integer", "short", "byte"}
 FLOAT_TYPES = {"double", "float", "half_float", "rank_feature"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 DATE_TYPES = {"date"}
+DATE_NANOS_TYPES = {"date_nanos"}
 BOOL_TYPES = {"boolean"}
 VECTOR_TYPES = {"dense_vector"}
 COMPLETION_TYPES = {"completion"}
 GEO_TYPES = {"geo_point"}
 ALL_TYPES = (
-    TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | BOOL_TYPES | VECTOR_TYPES
+    TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | DATE_NANOS_TYPES
+    | BOOL_TYPES | VECTOR_TYPES | IP_TYPES
     | COMPLETION_TYPES | GEO_TYPES | {"object", "nested", "percolator"}
 )
 
@@ -89,6 +95,127 @@ def parse_date_to_millis(value) -> int:
     raise MapperParsingError(f"failed to parse date value [{value}]")
 
 
+# java DateTimeFormatter tokens -> strptime, longest-first (case matters:
+# MM = month, mm = minute). Covers the pattern vocabulary used by the
+# reference's own test suites; unknown letters fail the pattern (and the
+# next ||-alternative is tried).
+_JAVA_TOKENS = [
+    ("yyyy", "%Y"), ("uuuu", "%Y"), ("yy", "%y"),
+    ("MM", "%m"), ("dd", "%d"), ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+    ("SSS", "%f"), ("epoch_millis", None), ("epoch_second", None),
+]
+
+
+def _java_to_strptime(pattern: str) -> str | None:
+    out = []
+    i = 0
+    while i < len(pattern):
+        for tok, py in _JAVA_TOKENS:
+            if py and pattern.startswith(tok, i):
+                out.append(py)
+                i += len(tok)
+                break
+        else:
+            c = pattern[i]
+            if c.isalpha():
+                return None  # unsupported token letter
+            out.append("%%" if c == "%" else c)
+            i += 1
+    return "".join(out)
+
+
+def parse_date_with_formats(value, formats: str) -> int:
+    """Custom `format` mapping parameter: try each ||-alternative in order
+    (reference: DateFieldMapper with a custom DateFormatter list)."""
+    for fmt in formats.split("||"):
+        fmt = fmt.strip()
+        if fmt in ("epoch_millis",):
+            try:
+                return int(value)
+            except (TypeError, ValueError):
+                continue
+        if fmt == "epoch_second":
+            try:
+                return int(value) * 1000
+            except (TypeError, ValueError):
+                continue
+        if fmt in ("strict_date_optional_time", "date_optional_time",
+                   "strict_date_optional_time_nanos", "basic_date_time",
+                   "date_time", "strict_date_time"):
+            try:
+                return parse_date_to_millis(value)
+            except MapperParsingError:
+                continue
+        py = _java_to_strptime(fmt)
+        if py is None or not isinstance(value, str):
+            continue
+        try:
+            dt = _dt.datetime.strptime(value, py)
+            return int(dt.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)
+        except ValueError:
+            continue
+    raise MapperParsingError(f"failed to parse date value [{value}]")
+
+
+def format_date_millis(ms: int, formats: str | None) -> str | int:
+    """Render epoch millis in the mapping's (first) format."""
+    fmt = (formats or "strict_date_optional_time").split("||")[0].strip()
+    if fmt == "epoch_millis":
+        return int(ms)
+    if fmt == "epoch_second":
+        return int(ms) // 1000
+    dt = _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+    py = _java_to_strptime(fmt)
+    if py is not None and "date_optional_time" not in fmt:
+        out = dt.strftime(py)
+        if "%f" in py:  # java SSS is milliseconds, strftime %f is micros
+            out = out.replace(dt.strftime("%f"), dt.strftime("%f")[:3])
+        return out
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def parse_date_to_nanos(value) -> int:
+    """date_nanos: epoch NANOseconds, preserving sub-millisecond digits
+    (reference: DateFieldMapper.Resolution.NANOSECONDS)."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"failed to parse date [{value}]")
+    if isinstance(value, (int, float)):
+        # numeric input is epoch millis in the default format
+        return int(value) * 1_000_000
+    if isinstance(value, str):
+        s = value.strip()
+        m = re.fullmatch(
+            r"(.*[T ]\d{2}:\d{2}:\d{2})\.(\d{4,9})(Z|[+-]\d{2}:?\d{2})?", s
+        )
+        if m:
+            frac = m.group(2)
+            nanos_frac = int(frac.ljust(9, "0"))
+            base = m.group(1) + (m.group(3) or "")
+            return parse_date_to_millis(base) * 1_000_000 + nanos_frac
+        if re.fullmatch(r"-?\d+", s):
+            return int(s) * 1_000_000
+        return parse_date_to_millis(s) * 1_000_000
+    raise MapperParsingError(f"failed to parse date value [{value}]")
+
+
+def format_date_nanos(nanos: int) -> str:
+    secs, frac_ns = divmod(int(nanos), 1_000_000_000)
+    dt = _dt.datetime.fromtimestamp(secs, tz=_dt.timezone.utc)
+    frac = f"{frac_ns:09d}".rstrip("0") or "0"
+    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac}Z"
+
+
+def ip_sort_key(s: str) -> bytes:
+    """Total order over mixed v4/v6: v4 compares as its v6-mapped form
+    (reference: ES encodes every ip as a 16-byte InetAddress point)."""
+    import ipaddress
+
+    ip = ipaddress.ip_address(s)
+    if ip.version == 4:
+        ip = ipaddress.ip_address(f"::ffff:{s}")
+    return ip.packed
+
+
 @dataclass
 class FieldType:
     name: str  # full dotted path
@@ -103,6 +230,9 @@ class FieldType:
     # ANN index options (dense_vector): partitions for the IVF index (the
     # TPU-native ANN; hnsw/int8_hnsw index_options map onto it)
     ann_nlist: int | None = None
+    # date/date_nanos "format" mapping parameter: ||-separated list of
+    # java patterns / named formats (DateFieldMapper custom formats)
+    format: str | None = None
     fields: dict = field(default_factory=dict)  # sub-fields (e.g. .keyword)
 
     _analyzer_obj: Analyzer | None = None
@@ -203,6 +333,7 @@ class Mappings:
                 ignore_above=spec.get("ignore_above"),
                 dims=spec.get("dims"),
                 similarity=spec.get("similarity", "cosine"),
+                format=spec.get("format"),
             )
             ft._registry = self.analysis_registry
             if ftype == "dense_vector" and not ft.dims:
@@ -294,6 +425,9 @@ class Mappings:
             # builder stores them host-side
             out.setdefault(full, []).append(value)
             return
+        if ft_pre is not None and ft_pre.type == "flattened" and isinstance(value, dict):
+            self._flatten_leaves(ft_pre, full, "", value, out)
+            return
         if isinstance(value, dict):
             self._parse_obj(value, f"{full}.", out)
             return
@@ -317,6 +451,30 @@ class Mappings:
         for sub in ft.fields.values():
             out.setdefault(sub.name, []).append(self._coerce(sub, value))
 
+    def _flatten_leaves(self, root: FieldType, full: str, sub: str, value, out):
+        """flattened object: leaves index as keywords under the root field
+        AND under per-key dynamic keyword sub-fields (keyed access)."""
+        if isinstance(value, dict):
+            for k, v in value.items():
+                self._flatten_leaves(root, full, f"{sub}.{k}" if sub else k, v, out)
+            return
+        if isinstance(value, list):
+            for v in value:
+                self._flatten_leaves(root, full, sub, v, out)
+            return
+        if value is None:
+            return
+        sval = ("true" if value else "false") if isinstance(value, bool) else str(value)
+        out.setdefault(full, []).append(sval)
+        if sub:
+            key_field = f"{full}.{sub}"
+            if key_field not in self.fields:
+                self.fields[key_field] = FieldType(
+                    key_field, "keyword", index=root.index,
+                    doc_values=root.doc_values,
+                )
+            out.setdefault(key_field, []).append(sval)
+
     @staticmethod
     def _coerce(ft: FieldType, value):
         t = ft.type
@@ -324,6 +482,18 @@ class Mappings:
             if isinstance(value, bool):
                 return "true" if value else "false"
             return str(value)
+        if t in IP_TYPES:
+            import ipaddress
+
+            try:
+                return str(ipaddress.ip_address(str(value)))
+            except ValueError:
+                raise MapperParsingError(
+                    f"failed to parse field [{ft.name}] of type [ip]: "
+                    f"'{value}' is not an IP string literal."
+                )
+        if t in DATE_NANOS_TYPES:
+            return parse_date_to_nanos(value)
         if t in INT_TYPES:
             try:
                 iv = int(value)
@@ -339,6 +509,8 @@ class Mappings:
             except (TypeError, ValueError):
                 raise MapperParsingError(f"failed to parse field [{ft.name}] of type [{t}]: [{value}]")
         if t in DATE_TYPES:
+            if ft.format:
+                return parse_date_with_formats(value, ft.format)
             return parse_date_to_millis(value)
         if t in BOOL_TYPES:
             if isinstance(value, bool):

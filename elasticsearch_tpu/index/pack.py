@@ -38,11 +38,14 @@ from .mappings import (
     Mappings,
     TEXT_TYPES,
     KEYWORD_TYPES,
+    IP_TYPES,
     INT_TYPES,
     FLOAT_TYPES,
     DATE_TYPES,
+    DATE_NANOS_TYPES,
     BOOL_TYPES,
     VECTOR_TYPES,
+    ip_sort_key,
 )
 from .smallfloat import quantize_lengths
 
@@ -310,7 +313,7 @@ class PackBuilder:
                     if term in pos_lists:
                         self.positions.setdefault((fld, term), {})[docid] = pos_lists[term]
                 self.doc_field_lengths.setdefault(fld, []).append((docid, length))
-            elif t in KEYWORD_TYPES:
+            elif t in KEYWORD_TYPES or t in IP_TYPES:
                 kept = []
                 for v in values:
                     if ft.ignore_above is not None and len(v) > ft.ignore_above:
@@ -336,7 +339,8 @@ class PackBuilder:
                             (docid, v) for v in sorted(set(kept))
                             if v != kept[0]
                         )
-            elif t in INT_TYPES or t in DATE_TYPES or t in BOOL_TYPES:
+            elif (t in INT_TYPES or t in DATE_TYPES
+                  or t in DATE_NANOS_TYPES or t in BOOL_TYPES):
                 if ft.doc_values and values:
                     self.docvalue_raw.setdefault(fld, []).append((docid, int(values[0])))
             elif t in FLOAT_TYPES:
@@ -564,10 +568,13 @@ class PackBuilder:
             else:
                 ftype = mappings.fields[fld].type
             has = np.zeros(N, dtype=bool)
-            if ftype in KEYWORD_TYPES:
+            if ftype in KEYWORD_TYPES or ftype in IP_TYPES:
                 extras = self.mv_extra_raw.get(fld, [])
+                # ip ordinals sort by address value, not lexicographically,
+                # so ord-range queries and sorts follow numeric ip order
+                sort_key = ip_sort_key if ftype in IP_TYPES else None
                 terms_sorted = sorted({v for _, v in pairs}
-                                      | {v for _, v in extras})
+                                      | {v for _, v in extras}, key=sort_key)
                 ord_of = {t: i for i, t in enumerate(terms_sorted)}
                 vals = np.full(N, -1, dtype=np.int32)
                 for docid, v in pairs:

@@ -279,8 +279,11 @@ class BatchTermSearcher:
     ShardSearcher's device pack."""
 
     # fast-path candidate budget: the post-cut dense gather is [Q, M] at
-    # ~30ns/element, so 1024 keeps it ~16ms for a 512-query chunk
-    FAST_M = 1024
+    # ~30ns/element (~32ms per 512-query chunk at 2048). 2048 covers the
+    # full candidate set of most real queries (sum of sparse-term dfs),
+    # making the cut a no-op — and a no-op cut is provably exact, which is
+    # what keeps the rerun rate (the expensive path) low
+    FAST_M = 2048
     # query-chunk budget: cap the materialized [Qc, N] f32 score matrix
     SCORE_BYTES_BUDGET = 1 << 31  # 2 GB
 
@@ -621,19 +624,30 @@ class BatchTermSearcher:
                 exact[idxs] = ok
                 if not ok.all():
                     pending.append(idxs[~ok])
-        if pending:
-            # rerun flagged queries with M = C (no candidate cut): provably
-            # exact top-k and exact sparse-only totals, while reusing the
+        rerun_m = 4 * self.FAST_M
+        while pending:
+            # escalate the candidate budget for flagged queries (4x per
+            # round, up to M = C where the cut disappears and the result is
+            # provably exact with exact sparse-only totals) — reusing the
             # fast-path program family instead of compiling the legacy path
             redo = np.concatenate(pending)
+            pending = []
             for idxs, plan in self.plan_bucketed(
                 fld, [queries[i] for i in redo], k
             ):
                 C = plan.sparse_rows.shape[1] * plan.sparse_rows.shape[2] * BLOCK
-                ev, ei, et, _, _ = jax.device_get(
-                    self.run_fast(fld, plan, bf16=bf16, M=C)
+                M = min(rerun_m, C)
+                ev, ei, et, eok, edrop = jax.device_get(
+                    self.run_fast(fld, plan, bf16=bf16, M=M)
                 )
-                scores[redo[idxs], : ev.shape[1]] = ev
-                ids[redo[idxs], : ev.shape[1]] = ei
-                totals[redo[idxs]] = et
+                ok = eok & ((edrop == 0) | (et >= track_total_hits))
+                if M >= C:
+                    ok[:] = True
+                done = idxs[ok]
+                scores[redo[done], : ev.shape[1]] = ev[ok]
+                ids[redo[done], : ev.shape[1]] = ei[ok]
+                totals[redo[done]] = et[ok]
+                if not ok.all():
+                    pending.append(redo[idxs[~ok]])
+            rerun_m *= 4
         return scores, ids, totals, exact

@@ -61,9 +61,20 @@ def _field_type(mappings: Mappings, fld: str) -> str | None:
 
 def _coerce_for_field(mappings: Mappings, fld: str, value):
     """-> (kind, coerced_value) where kind selects the docvalue column type."""
+    from ..index.mappings import DATE_NANOS_TYPES, IP_TYPES, parse_date_to_nanos
+
     t = _field_type(mappings, fld)
     if t in DATE_TYPES:
+        ft = mappings.fields.get(fld)
+        if ft is not None and ft.format:
+            from ..index.mappings import parse_date_with_formats
+
+            return "int", parse_date_with_formats(value, ft.format)
         return "int", parse_date_to_millis(value)
+    if t in DATE_NANOS_TYPES:
+        return "int", parse_date_to_nanos(value)
+    if t in IP_TYPES:
+        return "ip", str(value)
     if t in BOOL_TYPES:
         if isinstance(value, str):
             value = value == "true"
@@ -73,6 +84,27 @@ def _coerce_for_field(mappings: Mappings, fld: str, value):
     if t in FLOAT_TYPES:
         return "float", float(value)
     return "ord", str(value)
+
+
+def _ip_value_node(fld: str, value, boost: float):
+    """An ip term: exact address -> postings term on the normalized form;
+    CIDR block -> ordinal range over the address-sorted dictionary
+    (reference: IpFieldMapper termQuery -> InetAddressPoint exact/prefix)."""
+    import ipaddress
+
+    from ..utils.errors import QueryParsingError
+
+    s = str(value)
+    try:
+        if "/" in s:
+            net = ipaddress.ip_network(s, strict=False)
+            return _IpRangeNode(
+                fld, str(net.network_address), str(net.broadcast_address),
+                True, True, boost,
+            )
+        return TermNode(fld, str(ipaddress.ip_address(s)), boost=boost)
+    except ValueError as e:
+        raise QueryParsingError(f"'{s}' is not an IP string literal: {e}")
 
 
 def _parse_match(body, mappings):
@@ -92,6 +124,8 @@ def _parse_match(body, mappings):
     if t is not None and t not in TEXT_TYPES and t not in KEYWORD_TYPES:
         # match on numeric/date/bool degrades to equality, like ES
         kind, v = _coerce_for_field(mappings, fld, text)
+        if kind == "ip":
+            return _ip_value_node(fld, v, boost)
         return RangeNode(fld, v, v, kind=kind, boost=boost)
     ft = mappings.fields.get(fld)
     if ft is not None and ft.type in KEYWORD_TYPES:
@@ -244,9 +278,15 @@ def _parse_term(body, mappings):
     else:
         value, boost = spec, 1.0
     t = _field_type(mappings, fld)
+    if fld == "_id":
+        # _id lives in the reserved ordinal column, not the inverted index
+        # (reference: IdFieldMapper termQuery over the _id metadata field)
+        return TermsNode("_id", [str(value)], kind="ord", boost=boost)
     if t in TEXT_TYPES or t in KEYWORD_TYPES or t is None:
         return TermNode(fld, str(value), boost=boost)
     kind, v = _coerce_for_field(mappings, fld, value)
+    if kind == "ip":
+        return _ip_value_node(fld, v, boost)
     return RangeNode(fld, v, v, kind=kind, boost=boost)
 
 
@@ -261,11 +301,20 @@ def _parse_terms(body, mappings):
     if not isinstance(values, list):
         raise QueryParsingError("[terms] values must be an array")
     t = _field_type(mappings, fld)
-    if t in INT_TYPES or t in DATE_TYPES or t in BOOL_TYPES:
+    from ..index.mappings import DATE_NANOS_TYPES, IP_TYPES
+
+    if fld == "_id":
+        return TermsNode("_id", [str(v) for v in values], kind="ord", boost=boost)
+    if t in INT_TYPES or t in DATE_TYPES or t in DATE_NANOS_TYPES or t in BOOL_TYPES:
         coerced = [_coerce_for_field(mappings, fld, v)[1] for v in values]
         return TermsNode(fld, coerced, kind="int", boost=boost)
     if t in FLOAT_TYPES:
         return TermsNode(fld, [float(v) for v in values], kind="float", boost=boost)
+    if t in IP_TYPES:
+        return ConstantScoreNode(
+            BoolNode(should=[_ip_value_node(fld, v, 1.0) for v in values]),
+            boost=boost,
+        )
     if t in KEYWORD_TYPES or (t is None):
         return TermsNode(fld, [str(v) for v in values], kind="ord", boost=boost)
     # text field: OR of term queries, constant score
@@ -300,6 +349,11 @@ def _parse_range(body, mappings):
         # keyword ranges resolve against the sorted ordinal dictionary at
         # prepare() time; represented as string bounds here
         return _KeywordRangeNode(fld, spec.get("gte", spec.get("gt")), spec.get("lte", spec.get("lt")), inc_lo, inc_hi, boost)
+    if kind == "ip":
+        return _IpRangeNode(
+            fld, spec.get("gte", spec.get("gt")), spec.get("lte", spec.get("lt")),
+            inc_lo, inc_hi, boost,
+        )
     return RangeNode(fld, lo, hi, inc_lo, inc_hi, boost=boost, kind=kind or "int")
 
 
@@ -540,7 +594,12 @@ def _parse_ids(body, mappings):
 
 
 class _KeywordRangeNode(RangeNode):
-    """Range on a keyword field: string bounds -> ordinal bounds at prepare."""
+    """Range on a keyword-family field: string bounds -> ordinal bounds at
+    prepare. Subclasses override _sort_key for dictionaries whose ordinal
+    order is not lexicographic (ip)."""
+
+    _sort_key = staticmethod(lambda s: s)
+    _key_cache_attr: str | None = None
 
     def __init__(self, fld, lo_s, hi_s, inc_lo, inc_hi, boost):
         super().__init__(fld, None, None, inc_lo, inc_hi, boost=boost, kind="ord")
@@ -553,20 +612,27 @@ class _KeywordRangeNode(RangeNode):
 
         col = pack.docvalues.get(self.fld)
         terms = col.ord_terms if col is not None and col.ord_terms else []
-        # map string bounds to ordinal space: find tightest ordinal range
+        keys = terms
+        if self._key_cache_attr is not None and col is not None:
+            keys = getattr(col, self._key_cache_attr, None)
+            if keys is None:
+                keys = [self._sort_key(t) for t in terms]
+                setattr(col, self._key_cache_attr, keys)
+        # map bounds to ordinal space: find tightest ordinal range
         lo_ord, hi_ord = 0, len(terms) - 1
-        inc_lo, inc_hi = True, True
         if self.lo_s is not None:
+            k = self._sort_key(str(self.lo_s))
             lo_ord = (
-                bisect.bisect_left(terms, str(self.lo_s))
+                bisect.bisect_left(keys, k)
                 if self.include_lo
-                else bisect.bisect_right(terms, str(self.lo_s))
+                else bisect.bisect_right(keys, k)
             )
         if self.hi_s is not None:
+            k = self._sort_key(str(self.hi_s))
             hi_ord = (
-                bisect.bisect_right(terms, str(self.hi_s)) - 1
+                bisect.bisect_right(keys, k) - 1
                 if self.include_hi
-                else bisect.bisect_left(terms, str(self.hi_s)) - 1
+                else bisect.bisect_left(keys, k) - 1
             )
         params = (
             np.asarray(lo_ord, np.int64),
@@ -576,6 +642,16 @@ class _KeywordRangeNode(RangeNode):
             np.float32(self.boost),
         )
         return params, ("range", self.fld, "ord", col is None)
+
+
+class _IpRangeNode(_KeywordRangeNode):
+    """Range/CIDR on an ip field: the pack sorts ip ord_terms by address
+    value (ip_sort_key), so a CIDR block is a contiguous ordinal interval."""
+
+    from ..index.mappings import ip_sort_key as _ip_key
+
+    _sort_key = staticmethod(_ip_key)
+    _key_cache_attr = "_ip_keys"
 
 
 def _parse_function_score(body, mappings):

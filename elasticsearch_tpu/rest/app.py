@@ -199,8 +199,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return engine.get_index(engine.resolve_write_index(name))
 
 
-    def _doc_result(r, index_name):
-        return {
+    def _doc_result(r, index_name, request=None):
+        out = {
             "_index": index_name,
             "_id": r["_id"],
             "_version": r["_version"],
@@ -209,6 +209,16 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             "result": r["result"],
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
+        if request is not None:
+            refresh = request.query.get("refresh")
+            # forced_refresh: true when the write itself forced a refresh
+            # (refresh=true or the bare param); wait_for reports false
+            # (reference behavior: DocWriteResponse.forcedRefresh)
+            if refresh in ("", "true"):
+                out["forced_refresh"] = True
+            if request.query.get("routing"):
+                out["_routing"] = request.query["routing"]
+        return out
 
     @handler
     async def put_doc(request):
@@ -223,7 +233,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(idx.refresh)
         status = 201 if r["result"] == "created" else 200
-        return web.json_response(_doc_result(r, name), status=status)
+        return web.json_response(_doc_result(r, name, request), status=status)
 
     @handler
     async def create_doc(request):
@@ -236,7 +246,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         r = await call(idx.index_doc, doc_id, body, "create")
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(idx.refresh)
-        return web.json_response(_doc_result(r, name), status=201)
+        return web.json_response(_doc_result(r, name, request), status=201)
 
     @handler
     async def get_doc(request):
@@ -270,7 +280,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         r = await call(idx.delete_doc, request.match_info["id"])
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(idx.refresh)
-        return web.json_response({**_doc_result(r, idx.name), "result": "deleted"})
+        return web.json_response({**_doc_result(r, idx.name, request), "result": "deleted"})
 
     @handler
     async def update_doc(request):
@@ -282,7 +292,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(_concrete(name).refresh)
         status = 201 if r["result"] == "created" else 200
-        return web.json_response(_doc_result(r, engine.resolve_write_index(name)),
+        return web.json_response(_doc_result(r, engine.resolve_write_index(name), request),
                                  status=status)
 
     async def run_task(request, action, description, fn):
@@ -1344,6 +1354,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             if not index_name:
                 raise IllegalArgumentError("bulk item missing _index")
             doc_id = meta.get("_id")
+            if doc_id is not None:
+                doc_id = str(doc_id)
             source = None
             if action != "delete":
                 while i < len(lines) and not lines[i].strip():
@@ -1535,6 +1547,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             )
         except ElasticsearchTpuError:
             n_shards = 1  # e.g. remote-cluster expressions resolve elsewhere
+        if _bool_param(query_params, "rest_total_hits_as_int"):
+            tot = res.get("hits", {}).get("total")
+            if isinstance(tot, dict):
+                res["hits"]["total"] = tot["value"]
         return {
             "took": took,
             "timed_out": False,
@@ -1565,8 +1581,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             header = json.loads(lines[i])
             body = json.loads(lines[i + 1])
             name = header.get("index", request.match_info.get("index"))
+            # only the reference's msearch-level params apply to every
+            # sub-search; size/from/scroll etc. stay per-body
+            shared = {k: request.query[k]
+                      for k in ("rest_total_hits_as_int", "typed_keys")
+                      if k in request.query}
             try:
-                responses.append({**(await _run_search(name, body, {})), "status": 200})
+                responses.append({**(await _run_search(name, body, shared)),
+                                  "status": 200})
             except ElasticsearchTpuError as ex:
                 responses.append({**ex.to_dict(), "status": ex.status})
         return web.json_response({"took": 0, "responses": responses})
@@ -1625,19 +1647,21 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def mget(request):
         body = await body_json(request, {}) or {}
         default_index = request.match_info.get("index")
+        from ..utils.errors import ActionRequestValidationError
+
         items = []
         if "docs" in body:
             for d in body["docs"]:
                 name = d.get("_index", default_index)
                 if not name:
-                    raise IllegalArgumentError("mget doc missing _index")
+                    raise ActionRequestValidationError("index is missing")
                 if "_id" not in d:
-                    raise IllegalArgumentError("mget doc missing _id")
-                items.append((name, d["_id"]))
+                    raise ActionRequestValidationError("id is missing")
+                items.append((name, str(d["_id"])))
         elif "ids" in body:
             if not default_index:
                 raise IllegalArgumentError("ids form requires an index in the path")
-            items = [(default_index, i) for i in body["ids"]]
+            items = [(default_index, str(i)) for i in body["ids"]]
         else:
             raise IllegalArgumentError("unexpected content, expected [docs] or [ids]")
         docs = await call(engine.mget, items)
@@ -1980,6 +2004,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_post("/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
     app.router.add_post("/_ingest/pipeline/_simulate", simulate_pipeline)
     app.router.add_get("/_cluster/health", cluster_health)
+    app.router.add_get("/_cluster/health/{index}", cluster_health)
     app.router.add_get("/_cluster/settings", get_cluster_settings)
     app.router.add_put("/_cluster/settings", put_cluster_settings)
     app.router.add_put("/_snapshot/{repo}", put_repository)

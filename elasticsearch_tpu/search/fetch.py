@@ -109,15 +109,42 @@ def _norm_field_specs(specs) -> list[tuple[str, str | None]]:
     return out
 
 
-def _format_date(v, fmt: str | None):
-    from ..index.mappings import parse_date_to_millis
+def _format_date(v, fmt: str | None, field_format: str | None = None):
+    """`fields` values for date fields: parse the source value with the
+    field's mapping format, render with the requested format (or the
+    mapping's first format)."""
+    from ..index.mappings import (
+        format_date_millis,
+        parse_date_to_millis,
+        parse_date_with_formats,
+    )
 
+    try:
+        ms = (parse_date_with_formats(v, field_format)
+              if field_format else parse_date_to_millis(v))
+    except Exception:
+        return v
     if fmt == "epoch_millis":
-        try:
-            return parse_date_to_millis(v)
-        except Exception:
-            return v
-    return v
+        return ms
+    if fmt is not None:
+        return format_date_millis(ms, fmt)
+    if field_format is not None:
+        return format_date_millis(ms, field_format)
+    return format_date_millis(ms, None)
+
+
+def _format_date_nanos(v, fmt: str | None):
+    """date_nanos `fields` values normalize to the nanos-precision ISO form
+    (reference: strict_date_optional_time_nanos default output)."""
+    from ..index.mappings import format_date_nanos, parse_date_to_nanos
+
+    try:
+        nanos = parse_date_to_nanos(v)
+    except Exception:
+        return v
+    if fmt == "epoch_millis":
+        return nanos // 1_000_000
+    return format_date_nanos(nanos)
 
 
 def fields_option(hit_source: dict, specs, mappings) -> dict[str, list]:
@@ -130,7 +157,9 @@ def fields_option(hit_source: dict, specs, mappings) -> dict[str, list]:
                 continue
             ft = mappings.fields.get(path)
             if ft is not None and ft.type == "date":
-                values = [_format_date(v, fmt) for v in values]
+                values = [_format_date(v, fmt, ft.format) for v in values]
+            elif ft is not None and ft.type == "date_nanos":
+                values = [_format_date_nanos(v, fmt) for v in values]
             out.setdefault(path, []).extend(values)
     return out
 
@@ -147,7 +176,8 @@ def docvalue_fields_option(hit_source: dict, specs, mappings) -> dict[str, list]
             if ft is None or not ft.doc_values or ft.type == "text":
                 continue
             if ft.type == "date":
-                values = [_format_date(v, fmt or "epoch_millis") for v in values]
+                values = [_format_date(v, fmt or "epoch_millis", ft.format)
+                          for v in values]
             out.setdefault(path, []).extend(values)
     return out
 

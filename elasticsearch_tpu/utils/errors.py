@@ -64,6 +64,18 @@ class IllegalArgumentError(ElasticsearchTpuError):
     type = "illegal_argument_exception"
 
 
+class ActionRequestValidationError(ElasticsearchTpuError):
+    """Pre-execution request validation (the reference's
+    ActionRequestValidationException: reason lists numbered failures)."""
+
+    status = 400
+    type = "action_request_validation_exception"
+
+    def __init__(self, *failures: str):
+        joined = "; ".join(f"{i + 1}: {f}" for i, f in enumerate(failures))
+        super().__init__(f"Validation Failed: {joined};")
+
+
 class ResourceNotFoundError(ElasticsearchTpuError):
     status = 404
     type = "resource_not_found_exception"

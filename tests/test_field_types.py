@@ -1,0 +1,91 @@
+"""ip, date_nanos, and flattened field types.
+
+Reference behaviors: IpFieldMapper (v4/v6 normalization, CIDR term queries,
+address-ordered ranges/sorts), DateFieldMapper.Resolution.NANOSECONDS
+(nanosecond precision preserved), x-pack flattened FlattenedFieldMapper
+(root term matches any leaf; keyed sub-field access).
+"""
+
+import numpy as np
+
+from elasticsearch_tpu.index.mappings import (
+    Mappings,
+    format_date_nanos,
+    parse_date_to_nanos,
+)
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.query import ShardSearcher
+from elasticsearch_tpu.query.dsl import parse_query
+
+
+def _build():
+    m = Mappings({"properties": {
+        "ip": {"type": "ip"},
+        "ts": {"type": "date_nanos"},
+        "flat": {"type": "flattened"},
+    }})
+    b = PackBuilder(m)
+    docs = [
+        {"ip": "192.168.1.7", "ts": "2015-01-01T12:10:30.123456789Z",
+         "flat": {"a": "x", "b": {"c": "y"}}},
+        {"ip": "10.0.0.1", "ts": "2015-01-01T12:10:30.123456788Z",
+         "flat": {"a": "z"}},
+        {"ip": "2001:db8::1", "ts": "2015-01-02T00:00:00Z", "flat": {"a": "x"}},
+    ]
+    for d in docs:
+        b.add_document(m.parse_document(d))
+    return ShardSearcher(b.build(), mappings=m), m
+
+
+def _ids(s, m, body):
+    return sorted(int(x) for x in s.search(parse_query(body, m), size=10).doc_ids)
+
+
+def test_ip_term_cidr_range_terms():
+    s, m = _build()
+    assert _ids(s, m, {"term": {"ip": "10.0.0.1"}}) == [1]
+    # normalization: leading zeros / v6 compression
+    assert _ids(s, m, {"term": {"ip": "2001:0db8:0000::0001"}}) == [2]
+    assert _ids(s, m, {"term": {"ip": "192.168.0.0/16"}}) == [0]
+    assert _ids(s, m, {"term": {"ip": "2001:db8::/32"}}) == [2]
+    assert _ids(s, m, {"range": {"ip": {"gte": "10.0.0.0",
+                                        "lte": "192.168.255.255"}}}) == [0, 1]
+    assert _ids(s, m, {"terms": {"ip": ["10.0.0.1", "192.168.0.0/16"]}}) == [0, 1]
+
+
+def test_ip_sort_is_numeric():
+    s, m = _build()
+    from elasticsearch_tpu.query.sort import parse_sort
+
+    hits, _total, _aggs = s.search_sorted(
+        parse_query(None, m), parse_sort([{"ip": "asc"}]), size=10
+    )
+    # 10.0.0.1 < 192.168.1.7 < 2001:db8::1 (v4 below v6)
+    assert [d for d, _ in hits] == [1, 0, 2]
+
+
+def test_date_nanos_precision_and_format():
+    s, m = _build()
+    assert _ids(s, m, {"range": {"ts": {"gt": "2015-01-01T12:10:30.123456788Z"}}}) == [0, 2]
+    assert _ids(s, m, {"term": {"ts": "2015-01-01T12:10:30.123456789Z"}}) == [0]
+    n = parse_date_to_nanos("2015-01-01T12:10:30.123456789Z")
+    assert n % 1_000_000 == 456789
+    assert format_date_nanos(n) == "2015-01-01T12:10:30.123456789Z"
+    assert parse_date_to_nanos("2015-01-01T00:00:00Z") % 1_000_000_000 == 0
+
+
+def test_flattened_root_and_keyed():
+    s, m = _build()
+    assert _ids(s, m, {"term": {"flat": "x"}}) == [0, 2]
+    assert _ids(s, m, {"term": {"flat": "y"}}) == [0]
+    assert _ids(s, m, {"term": {"flat.a": "x"}}) == [0, 2]
+    assert _ids(s, m, {"term": {"flat.b.c": "y"}}) == [0]
+    assert _ids(s, m, {"term": {"flat.a": "y"}}) == []
+
+
+def test_ip_terms_agg_keys_canonical():
+    s, m = _build()
+    r = s.search(parse_query(None, m), size=0,
+                 aggs={"ips": {"terms": {"field": "ip"}}})
+    keys = [b["key"] for b in r.aggregations["ips"]["buckets"]]
+    assert set(keys) == {"10.0.0.1", "192.168.1.7", "2001:db8::1"}

@@ -1,0 +1,165 @@
+"""Persisted cluster state + retention-lease ops-only recovery.
+
+The reference persists coordination metadata and the accepted cluster state
+per node (gateway/PersistedClusterStateService.java:930) so a full-cluster
+restart keeps index metadata, and retains op history under retention leases
+so a rejoining replica resyncs ops-only (ReplicationTracker.java:68,
+RecoverySourceHandler.java:198-205). These tests drive both through the
+deterministic simulator: kill every node, rebuild the processes on the same
+data paths, and assert the metadata and the ops-only recovery plan.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.cluster.coordination import LEADER
+from elasticsearch_tpu.cluster.node import ClusterNode
+from elasticsearch_tpu.transport import DeterministicTaskQueue, LocalTransportNetwork
+
+
+class PersistentCluster:
+    def __init__(self, n: int, base_path, seed: int = 0):
+        self.base_path = base_path
+        self.node_ids = [f"node-{i}" for i in range(n)]
+        self.queue = DeterministicTaskQueue(seed)
+        self.net = LocalTransportNetwork(self.queue)
+        self.nodes = {}
+        for nid in self.node_ids:
+            self._boot(nid)
+        self.run(60)
+
+    def _boot(self, nid):
+        node = ClusterNode(
+            nid, list(self.node_ids), self.net,
+            data_path=str(self.base_path / nid),
+        )
+        self.nodes[nid] = node
+        node.start()
+        return node
+
+    def run(self, seconds: float):
+        self.queue.run_for(seconds, max_tasks=500_000)
+
+    def master(self) -> ClusterNode:
+        leaders = [n for n in self.nodes.values() if n.coordinator.mode == LEADER]
+        assert len(leaders) == 1, [
+            (n.node_id, n.coordinator.mode) for n in self.nodes.values()
+        ]
+        return leaders[0]
+
+    def restart_all(self):
+        """Kill every process; rebuild from the persisted data paths on a
+        fresh virtual network (same task queue keeps time deterministic)."""
+        for n in self.nodes.values():
+            n.coordinator.stop()
+            self.net.kill(n.node_id)
+        self.nodes = {}
+        self.net = LocalTransportNetwork(self.queue)
+        for nid in self.node_ids:
+            self._boot(nid)
+        self.run(90)
+
+    def create_index(self, name, settings=None):
+        acks = []
+        self.master().create_index(name, {"properties": {"f": {"type": "text"}}},
+                                   settings, on_done=lambda r: acks.append(r))
+        self.run(30)
+        assert acks and acks[0]["acknowledged"], acks
+
+    def bulk(self, index, ops):
+        out = []
+        self.master().client_bulk(index, ops, out.append)
+        self.run(30)
+        assert out and not out[0].get("errors"), out
+        return out[0]
+
+
+def test_full_cluster_restart_preserves_metadata(tmp_path):
+    c = PersistentCluster(3, tmp_path)
+    c.create_index("persisted", {"number_of_shards": 2, "number_of_replicas": 1})
+    st_before = c.master().state
+    assert "persisted" in st_before.indices
+    term_before = st_before.term
+
+    c.restart_all()
+
+    st = c.master().state
+    assert "persisted" in st.indices, "index metadata lost across restart"
+    meta = st.indices["persisted"]
+    assert int(meta["settings"]["number_of_shards"]) == 2
+    assert meta["mappings"]["properties"]["f"]["type"] == "text"
+    assert meta["uuid"] == st_before.indices["persisted"]["uuid"]
+    # terms only move forward (persisted votes prevent double-voting)
+    assert st.term > term_before
+
+
+def test_restart_does_not_regress_votes(tmp_path):
+    """A restarted node must remember its vote: terms never reuse."""
+    c = PersistentCluster(3, tmp_path)
+    terms_seen = {c.master().state.term}
+    for _ in range(2):
+        c.restart_all()
+        t = c.master().state.term
+        assert t not in terms_seen, "term reused after restart"
+        terms_seen.add(t)
+
+
+def test_ops_only_recovery_after_partition(tmp_path):
+    c = PersistentCluster(3, tmp_path)
+    # replicas on every node: the rejoining node must recover its own copy
+    # (with a spare node the shard would simply relocate instead)
+    c.create_index("idx", {"number_of_shards": 1, "number_of_replicas": 2})
+    c.bulk("idx", [("index", f"d{i}", {"f": f"v{i}"}) for i in range(20)])
+
+    st = c.master().state
+    replica_assign = [a for a in st.routing["idx"]["0"]
+                      if not a["primary"] and a["state"] == "STARTED"]
+    assert len(replica_assign) == 2, st.routing
+    replica_node = replica_assign[0]["node"]
+
+    # partition the replica's node away; the master drops it and fails the copy
+    others = [n for n in c.node_ids if n != replica_node]
+    c.net.partition([replica_node], others)
+    c.run(60)
+    assert replica_node not in c.master().state.nodes
+
+    # writes continue on the primary while the replica is gone
+    c.bulk("idx", [("index", f"e{i}", {"f": f"w{i}"}) for i in range(10)])
+
+    # heal: the node rejoins, gets the replica back, recovers ops-only
+    c.net.heal()
+    c.run(120)
+    rejoined = c.nodes[replica_node]
+    assert rejoined.last_recovery_mode == "ops", rejoined.last_recovery_mode
+    copy = rejoined.shards.get(("idx", 0))
+    assert copy is not None
+    assert copy.live_count == 30
+    assert copy.get("e9") is not None
+
+
+def test_expired_history_falls_back_to_snapshot(tmp_path):
+    from elasticsearch_tpu.cluster.shard import ShardCopy
+
+    c = PersistentCluster(3, tmp_path)
+    c.create_index("idx", {"number_of_shards": 1, "number_of_replicas": 2})
+    c.bulk("idx", [("index", "a", {"f": "x"})])
+
+    st = c.master().state
+    replica_node = [a for a in st.routing["idx"]["0"]
+                    if not a["primary"]][0]["node"]
+    others = [n for n in c.node_ids if n != replica_node]
+    c.net.partition([replica_node], others)
+    c.run(60)
+
+    # shrink the retention cap so the lease expires mid-partition
+    old_cap = ShardCopy.MAX_RETAINED_OPS
+    ShardCopy.MAX_RETAINED_OPS = 4
+    try:
+        c.bulk("idx", [("index", f"e{i}", {"f": f"w{i}"}) for i in range(12)])
+        c.net.heal()
+        c.run(120)
+    finally:
+        ShardCopy.MAX_RETAINED_OPS = old_cap
+    rejoined = c.nodes[replica_node]
+    assert rejoined.last_recovery_mode == "snapshot"
+    copy = rejoined.shards.get(("idx", 0))
+    assert copy is not None and copy.live_count == 13

@@ -1,0 +1,374 @@
+"""Runner for the reference's YAML REST behavioral suites.
+
+Executes the upstream test definitions (rest-api-spec/src/yamlRestTest/
+resources/rest-api-spec/test/**/*.yml) against this framework's aiohttp
+app in-process, the analog of the reference's ESClientYamlSuiteTestCase
+(test/yaml-rest-runner/.../ESClientYamlSuiteTestCase.java:79):
+
+  - `do` steps resolve the API name through the reference's own API specs
+    (rest-api-spec/src/main/resources/rest-api-spec/api/*.json) to a
+    method + path, substituting path parts and passing the rest as query
+    params;
+  - assertions implement match / length / is_true / is_false / gt / gte /
+    lt / lte / set / contains / close_to with the upstream dot-path and
+    $stash semantics;
+  - `catch` checks both the named shorthands (missing, conflict, ...) and
+    /regex/ forms against the error body.
+
+The YAML files themselves are UPSTREAM TEST DATA — read from the
+reference checkout at runtime, never copied into this repo.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import re
+from pathlib import Path
+
+import yaml
+
+
+def _json_default(o):
+    """YAML parses unquoted timestamps into date/datetime objects; the wire
+    form must carry them as the original ISO strings."""
+    if isinstance(o, _dt.datetime):
+        return o.isoformat()
+    if isinstance(o, _dt.date):
+        return o.isoformat()
+    raise TypeError(f"not JSON serializable: {o!r}")
+
+REFERENCE = Path("/root/reference/rest-api-spec/src/main/resources/rest-api-spec/api")
+SUITES = Path(
+    "/root/reference/rest-api-spec/src/yamlRestTest/resources/rest-api-spec/test"
+)
+
+_CATCH_STATUS = {
+    "missing": 404,
+    "conflict": 409,
+    "forbidden": 403,
+    "unauthorized": 401,
+    "bad_request": 400,
+    "param": 400,
+    "request": None,  # any 4xx/5xx
+    "request_timeout": 408,
+    "unavailable": 503,
+}
+
+_FEATURES_OK = {
+    "contains",
+    "close_to",
+    "is_after",
+    "allowed_warnings",
+    "allowed_warnings_regex",
+    "warnings",
+    "warnings_regex",
+}
+
+
+class SkipTest(Exception):
+    pass
+
+
+class StepFailure(AssertionError):
+    pass
+
+
+_api_cache: dict[str, list] = {}
+
+
+def _api_spec(name: str):
+    spec = _api_cache.get(name)
+    if spec is None:
+        f = REFERENCE / f"{name}.json"
+        if not f.exists():
+            raise SkipTest(f"no API spec [{name}]")
+        raw = json.loads(f.read_text())[name]
+        spec = []
+        for p in raw["url"]["paths"]:
+            spec.append((p["path"], p["methods"], set(p.get("parts", {}))))
+        _api_cache[name] = spec
+    return spec
+
+
+def _choose_path(spec, params: dict):
+    """Best path = most parts, all satisfiable from params."""
+    best = None
+    for path, methods, parts in spec:
+        if parts <= set(params):
+            if best is None or len(parts) > len(best[2]):
+                best = (path, methods, parts)
+    if best is None:
+        raise SkipTest(f"no path variant for params {sorted(params)}")
+    return best
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, list):
+        return ",".join(_fmt(x) for x in v)
+    return str(v)
+
+
+class Stash(dict):
+    _token = re.compile(r"\$\{?(\w+)\}?")
+
+    def sub(self, v):
+        if isinstance(v, str):
+            m = self._token.fullmatch(v.strip())
+            if m and m.group(1) in self:
+                return self[m.group(1)]
+            return self._token.sub(
+                lambda mm: _fmt(self[mm.group(1)]) if mm.group(1) in self else mm.group(0),
+                v,
+            )
+        if isinstance(v, dict):
+            return {self.sub(k) if isinstance(k, str) else k: self.sub(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [self.sub(x) for x in v]
+        return v
+
+
+def walk(body, path: str, stash: Stash):
+    """Upstream dot-path: segments split on unescaped '.', ints index
+    arrays, '$body' is the root, a $var segment resolves from the stash."""
+    if path == "$body":
+        return body
+    cur = body
+    segs = [s.replace("\0", ".") for s in path.replace("\\.", "\0").split(".")]
+    for seg in segs:
+        if seg.startswith("$"):
+            seg = _fmt(stash.sub(seg))
+        if isinstance(cur, list):
+            cur = cur[int(seg)]
+        elif isinstance(cur, dict):
+            if seg not in cur:
+                raise KeyError(f"[{seg}] missing at [{path}]")
+            cur = cur[seg]
+        else:
+            raise KeyError(f"cannot descend [{seg}] in [{path}]")
+    return cur
+
+
+def _matches(expected, got, stash: Stash) -> bool:
+    expected = stash.sub(expected)
+    if isinstance(expected, str) and len(expected) > 1 and expected.startswith("/") and expected.rstrip().endswith("/"):
+        pat = expected.strip().strip("/")
+        return re.search(pat, str(got), re.VERBOSE) is not None
+    if isinstance(expected, float) and isinstance(got, (int, float)):
+        return abs(expected - float(got)) < 1e-6 * max(1.0, abs(expected))
+    if isinstance(expected, int) and isinstance(got, (int, float)) and not isinstance(got, bool):
+        return float(expected) == float(got)
+    if isinstance(expected, dict) and isinstance(got, dict):
+        if set(expected) != set(got):
+            return False
+        return all(_matches(v, got[k], stash) for k, v in expected.items())
+    if isinstance(expected, list) and isinstance(got, list):
+        return len(expected) == len(got) and all(
+            _matches(e, g, stash) for e, g in zip(expected, got)
+        )
+    return expected == got
+
+
+def _truthy(v) -> bool:
+    return v not in (None, False, "", "false", 0) and v != [] and v != {}
+
+
+class YamlRunner:
+    def __init__(self, client, loop_run):
+        self.client = client
+        self.run = loop_run
+        self.stash = Stash()
+        self.last = None
+        self.last_status = None
+        self.last_headers = None
+
+    # ---- do ------------------------------------------------------------
+    def do(self, step: dict):
+        step = dict(step)
+        step.pop("warnings", None)
+        step.pop("allowed_warnings", None)
+        step.pop("allowed_warnings_regex", None)
+        step.pop("warnings_regex", None)
+        if "node_selector" in step or "headers" in step:
+            raise SkipTest("node_selector/headers not supported")
+        catch = step.pop("catch", None)
+        (api, args), = step.items()
+        args = self.stash.sub(args or {})
+        body = args.pop("body", None)
+        spec = _api_spec(api)
+        path_t, methods, parts = _choose_path(spec, args)
+        path = path_t
+        for part in parts:
+            path = path.replace("{%s}" % part, _fmt(args.pop(part)))
+        method = "POST" if body is not None and "POST" in methods else methods[0]
+        if body is not None and method == "GET" and "POST" in methods:
+            method = "POST"
+        params = {k: _fmt(v) for k, v in args.items() if v is not None}
+        if isinstance(body, list):  # bulk-style NDJSON (lines may be
+            # pre-encoded JSON strings or YAML objects)
+            data = "".join(
+                (x if isinstance(x, str) else json.dumps(self.stash.sub(x), default=_json_default))
+                + "\n"
+                for x in body
+            )
+        elif isinstance(body, str):
+            data = body
+        else:
+            data = (json.dumps(body, default=_json_default)
+                    if body is not None else None)
+
+        async def call():
+            r = await self.client.request(
+                method, path, params=params, data=data,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                out = await r.json()
+            except Exception:
+                out = await r.text()
+            return r.status, out, dict(r.headers)
+
+        status, out, headers = self.run(call())
+        self.last, self.last_status, self.last_headers = out, status, headers
+        if catch:
+            want = _CATCH_STATUS.get(catch)
+            if catch.startswith("/"):
+                if status < 400:
+                    raise StepFailure(f"expected error matching {catch}, got {status}")
+                if not re.search(catch.strip("/"), json.dumps(out), re.VERBOSE):
+                    raise StepFailure(f"error body {out!r} !~ {catch}")
+            elif catch == "request":
+                if status < 400:
+                    raise StepFailure(f"expected any error, got {status}")
+            elif want is not None and status != want:
+                raise StepFailure(f"expected {catch} ({want}), got {status}: {out}")
+        elif status >= 400:
+            raise StepFailure(f"{api} -> {status}: {out}")
+
+    # ---- assertions ----------------------------------------------------
+    def assert_step(self, kind: str, arg):
+        if kind == "match":
+            (path, expected), = arg.items()
+            got = self._get(path)
+            if not _matches(expected, got, self.stash):
+                raise StepFailure(f"match {path}: expected {expected!r}, got {got!r}")
+        elif kind == "length":
+            (path, expected), = arg.items()
+            got = self._get(path)
+            if len(got) != int(self.stash.sub(expected)):
+                raise StepFailure(f"length {path}: expected {expected}, got {len(got)}")
+        elif kind in ("gt", "gte", "lt", "lte"):
+            (path, expected), = arg.items()
+            got = self._get(path)
+            expected = float(self.stash.sub(expected))
+            ok = {"gt": got > expected, "gte": got >= expected,
+                  "lt": got < expected, "lte": got <= expected}[kind]
+            if not ok:
+                raise StepFailure(f"{kind} {path}: {got} vs {expected}")
+        elif kind == "is_true":
+            try:
+                v = self._get(arg)
+            except KeyError:
+                raise StepFailure(f"is_true {arg}: missing")
+            if not _truthy(v):
+                raise StepFailure(f"is_true {arg}: got {v!r}")
+        elif kind == "is_false":
+            try:
+                v = self._get(arg)
+            except (KeyError, IndexError):
+                return
+            if _truthy(v):
+                raise StepFailure(f"is_false {arg}: got {v!r}")
+        elif kind == "set":
+            (path, var), = arg.items()
+            self.stash[var] = self._get(path)
+        elif kind == "contains":
+            (path, expected), = arg.items()
+            got = self._get(path)
+            expected = self.stash.sub(expected)
+            if isinstance(got, list):
+                if not any(_matches(expected, g, self.stash) if not isinstance(expected, dict)
+                           else isinstance(g, dict) and all(
+                               k in g and _matches(v, g[k], self.stash)
+                               for k, v in expected.items())
+                           for g in got):
+                    raise StepFailure(f"contains {path}: {expected!r} not in {got!r}")
+            elif isinstance(got, str):
+                if str(expected) not in got:
+                    raise StepFailure(f"contains {path}: {expected!r} not in {got!r}")
+            else:
+                raise StepFailure(f"contains {path}: not a container: {got!r}")
+        elif kind == "close_to":
+            (path, spec), = arg.items()
+            got = self._get(path)
+            if abs(got - spec["value"]) > spec.get("error", 1e-6):
+                raise StepFailure(f"close_to {path}: {got} vs {spec}")
+        elif kind == "skip":
+            self._skip(arg)
+        else:
+            raise SkipTest(f"unsupported step [{kind}]")
+
+    def _get(self, path):
+        return walk(self.last, str(self.stash.sub(path)), self.stash)
+
+    def _skip(self, arg):
+        if "features" in arg:
+            feats = arg["features"]
+            feats = feats if isinstance(feats, list) else [feats]
+            bad = [f for f in feats if f not in _FEATURES_OK]
+            if bad:
+                raise SkipTest(f"features {bad}")
+        if "version" in arg:
+            v = str(arg["version"]).strip()
+            if v == "all" or _version_in_range(v, (8, 14, 0)):
+                raise SkipTest(f"version skip [{v}] {arg.get('reason', '')}")
+        if "awaits_fix" in arg:
+            raise SkipTest(f"awaits_fix: {arg['awaits_fix']}")
+
+    def steps(self, seq):
+        for step in seq:
+            (kind, arg), = step.items()
+            if kind == "do":
+                self.do(arg)
+            else:
+                self.assert_step(kind, arg)
+
+
+def _version_in_range(expr: str, ver: tuple) -> bool:
+    def parse(s):
+        s = s.strip()
+        if not s:
+            return None
+        ps = [int(x) for x in re.findall(r"\d+", s)[:3]]
+        while len(ps) < 3:
+            ps.append(0)
+        return tuple(ps)
+
+    for rng in expr.split(","):
+        if "-" not in rng:
+            continue
+        lo, hi = rng.split("-", 1)
+        lo_v, hi_v = parse(lo), parse(hi)
+        if (lo_v is None or lo_v <= ver) and (hi_v is None or ver <= hi_v):
+            return True
+    return False
+
+
+def load_suite(rel: str):
+    """-> (setup_steps, teardown_steps, [(test_name, steps)])."""
+    f = SUITES / rel
+    docs = list(yaml.safe_load_all(f.read_text()))
+    setup, teardown, tests = [], [], []
+    for doc in docs:
+        if not doc:
+            continue
+        for name, steps in doc.items():
+            if name == "setup":
+                setup = steps
+            elif name == "teardown":
+                teardown = steps
+            else:
+                tests.append((name, steps))
+    return setup, teardown, tests
