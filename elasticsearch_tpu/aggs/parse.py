@@ -229,4 +229,9 @@ def _build(name, typ, body, children, mappings) -> AggNode:
             name, sources, size=int(body.get("size", 10)),
             after=body.get("after"), children=children or None,
         )
+    from ..plugins import registry
+
+    ext = registry.aggregations.get(typ)
+    if ext is not None:
+        return ext(name, body, children, mappings)
     raise QueryParsingError(f"unknown aggregation type [{typ}]")

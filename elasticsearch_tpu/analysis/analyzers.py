@@ -182,6 +182,11 @@ def get_analyzer(name: str, **kwargs) -> Analyzer:
     try:
         cls = _BUILTIN[name]
     except KeyError:
+        from ..plugins import registry
+
+        ext = registry.analyzers.get(name)
+        if ext is not None:
+            return ext
         from ..utils.errors import IllegalArgumentError
 
         raise IllegalArgumentError(f"unknown analyzer [{name}]")

@@ -157,6 +157,15 @@ class TcpClient:
 
 def main(argv=None):
     import argparse
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # some environments pre-import jax with an accelerator platform in
+        # sitecustomize; the env var alone is then too late — force the
+        # config post-import so data nodes honor the operator's choice
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     ap = argparse.ArgumentParser(description="elasticsearch_tpu cluster node")
     ap.add_argument("--node-id", required=True)

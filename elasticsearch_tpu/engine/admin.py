@@ -515,12 +515,19 @@ def cat_recovery(engine) -> list[dict]:
 
 
 def cat_plugins(engine) -> list[dict]:
+    from ..plugins import registry
+
+    builtin = ("analysis-common", "data-streams", "ingest-common",
+               "lang-expression", "mapper-extras", "percolator",
+               "rank-eval", "reindex", "transform", "x-pack-ccr",
+               "x-pack-ilm", "x-pack-security", "x-pack-slm",
+               "x-pack-watcher", "x-pack-enrich", "x-pack-esql",
+               "x-pack-sql", "x-pack-eql", "x-pack-async-search")
     return [
         {"name": engine.tasks.node, "component": comp, "version": "8.14.0"}
-        for comp in ("analysis-common", "data-streams", "ingest-common",
-                     "lang-expression", "mapper-extras", "percolator",
-                     "rank-eval", "reindex", "transform", "x-pack-ccr",
-                     "x-pack-ilm", "x-pack-security", "x-pack-slm",
-                     "x-pack-watcher", "x-pack-enrich", "x-pack-esql",
-                     "x-pack-sql", "x-pack-eql", "x-pack-async-search")
+        for comp in builtin
+    ] + [
+        {"name": engine.tasks.node, "component": info["name"],
+         "version": "8.14.0"}
+        for info in registry.info()
     ]

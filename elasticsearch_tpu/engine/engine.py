@@ -88,6 +88,7 @@ class EsIndex:
 
         self.name = name
         self.mappings = mappings
+        self.engine = None  # owning Engine backref (query-time inference)
         self.settings = {"number_of_shards": 1, "number_of_replicas": 0, "refresh_interval": "1s"}
         for k, v in (settings or {}).items():
             s = INDEX_SETTINGS.get(k)
@@ -573,6 +574,12 @@ class EsIndex:
         track_total_hits=None,
     ):
         self._maybe_refresh()
+        if self.engine is not None and (knn is not None or query is not None):
+            from ..inference import resolve_query_vector_builders
+
+            svc = self.engine.inference
+            query = resolve_query_vector_builders(query, svc)
+            knn = resolve_query_vector_builders(knn, svc)
         self.counters["query_total"] = self.counters.get("query_total", 0) + 1
         from ..telemetry import TRACER, record_search_slowlog
 
@@ -707,7 +714,10 @@ class EsIndex:
             from ..query.dsl import parse_knn, parse_query
             from ..query.nodes import BoolNode, PinnedScoresNode
 
-            knn_nodes = [parse_knn(k, self.mappings) for k in (knn if isinstance(knn, list) else [knn])]
+            knn_nodes = [
+                parse_knn(k, self.mappings)
+                for k in (knn if isinstance(knn, list) else [knn])
+            ]
             knn_only = query is None
             k_total = sum(kn.k for kn in knn_nodes)
             if not knn_only:
@@ -989,6 +999,9 @@ class Engine:
         self.indices: dict[str, EsIndex] = {}
         self.ingest = IngestService()
         self.ingest.engine = self  # enrich processors look policies up here
+        from ..inference import InferenceService
+
+        self.inference = InferenceService()
         self.tasks = TaskManager()
         from ..tasks.persistent import PersistentTasksService
 
@@ -1088,6 +1101,7 @@ class Engine:
                 fspec["_resolved_set"] = list(rules)
         idx = EsIndex(name, m, settings, self._dir_for(name),
                       breaker_account=self._pack_accounter(name))
+        idx.engine = self
         self.indices[name] = idx
         for alias, props in (aliases or {}).items():
             self.meta.put_alias(name, alias, props)
@@ -1373,11 +1387,21 @@ class Engine:
         size = kwargs.get("size", 10)
         from_ = kwargs.get("from_", 0)
         sub_results = []
+        skipped_shards = 0
+        from ..search.canmatch import can_match
+
         for idx, alias_filter in targets:
             kw = dict(kwargs)
             kw["query"] = with_filter(kw.get("query"), alias_filter)
             kw["size"] = size + from_
             kw["from_"] = 0
+            # can-match pre-filter: a required range outside the index's
+            # column bounds skips the whole index's shards (the reference's
+            # CanMatchPreFilterSearchPhase, at index granularity — shards
+            # of one index run as one SPMD program)
+            if not can_match(idx, kw["query"]):
+                skipped_shards += idx.num_shards
+                continue
             sub_results.append(idx.search(**kw))
         # merge: total sums; hits re-sorted globally (score desc, or the
         # explicit sort's transformed keys which each sub-search returns in
@@ -1434,7 +1458,7 @@ class Engine:
                 "relation": ("gte" if any(
                     t.get("relation") == "gte" for t in totals) else "eq"),
             }
-        return {"hits": hits_obj}
+        return {"hits": hits_obj, "skipped_shards": skipped_shards}
 
     # ---- scroll / point-in-time ------------------------------------------
 

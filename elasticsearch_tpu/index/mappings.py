@@ -233,6 +233,9 @@ class FieldType:
     # date/date_nanos "format" mapping parameter: ||-separated list of
     # java patterns / named formats (DateFieldMapper custom formats)
     format: str | None = None
+    # skip (and record in _ignored) unparseable values instead of failing
+    # the whole document (the ignore_malformed mapping parameter)
+    ignore_malformed: bool = False
     fields: dict = field(default_factory=dict)  # sub-fields (e.g. .keyword)
 
     _analyzer_obj: Analyzer | None = None
@@ -334,6 +337,7 @@ class Mappings:
                 dims=spec.get("dims"),
                 similarity=spec.get("similarity", "cosine"),
                 format=spec.get("format"),
+                ignore_malformed=bool(spec.get("ignore_malformed", False)),
             )
             ft._registry = self.analysis_registry
             if ftype == "dense_vector" and not ft.dims:
@@ -446,8 +450,24 @@ class Mappings:
             ft = self._dynamic_field(full, value)
             if ft is None:
                 return
-        values = out.setdefault(full, [])
-        values.append(self._coerce(ft, value))
+        try:
+            coerced = self._coerce(ft, value)
+        except MapperParsingError:
+            if not ft.ignore_malformed:
+                raise
+            # malformed value skipped; the doc records which fields were
+            # ignored in the _ignored metadata field (reference behavior:
+            # IgnoredFieldMapper + the ignore_malformed mapping parameter)
+            ig = self.fields.get("_ignored")
+            if ig is None:
+                ig = self.fields["_ignored"] = FieldType(
+                    "_ignored", "keyword", index=False
+                )
+            vals = out.setdefault("_ignored", [])
+            if ft.name not in vals:
+                vals.append(ft.name)
+            return
+        out.setdefault(full, []).append(coerced)
         for sub in ft.fields.values():
             out.setdefault(sub.name, []).append(self._coerce(sub, value))
 
@@ -527,6 +547,8 @@ class Mappings:
     def to_dict(self) -> dict:
         props: dict = {}
         for name, ft in sorted(self.fields.items()):
+            if name == "_ignored":  # internal metadata field
+                continue
             if "." in name:
                 parent = name.rsplit(".", 1)[0]
                 pft = self.fields.get(parent)

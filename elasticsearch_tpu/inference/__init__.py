@@ -1,0 +1,237 @@
+"""Inference API: embedding models running natively on the TPU.
+
+The reference's inference plugin exposes `_inference/{task_type}/{id}`
+endpoints that route to configured services and wire into ingest (the
+`inference` processor) and search (knn `query_vector_builder`) — reference
+behavior: x-pack/plugin/inference InferenceBaseRestHandler + service
+registry; TransportInferenceAction. This is the one x-pack surface where a
+TPU-native stack has a structural advantage: embedding is a batched
+matmul pipeline, so it shares the device and the batching machinery with
+scoring.
+
+The built-in service here is `tpu_embedding`: a deterministic hashed
+bag-of-tokens encoder — token hashes index a seeded embedding table, mean
+pool, project, L2-normalize — the shape (not the quality) of a sentence
+encoder, compiled once per (batch, dims) and entirely on-device. Real
+checkpoints would slot into the same Service interface; the API surface,
+ingest wiring, and query-time embedding are what parity is about.
+
+Task types follow the reference: text_embedding (dense), sparse_embedding
+(token -> weight maps, the ELSER shape), rerank, completion (stubbed to
+similarity ranking — no generative model ships in-tree).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+)
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+VOCAB_BUCKETS = 1 << 15
+
+
+def _hash_tokens(text: str) -> np.ndarray:
+    toks = _TOKEN_RE.findall(text.lower())
+    if not toks:
+        return np.zeros(0, np.int32)
+    return np.array(
+        [int.from_bytes(hashlib.blake2b(t.encode(), digest_size=4).digest(),
+                        "little") % VOCAB_BUCKETS
+         for t in toks],
+        np.int32,
+    )
+
+
+class TpuEmbeddingModel:
+    """Hashed bag-of-tokens dense encoder, parameters derived from the
+    model seed so results are reproducible across nodes."""
+
+    def __init__(self, inference_id: str, dims: int = 384, seed: int | None = None):
+        self.inference_id = inference_id
+        self.dims = dims
+        if seed is None:
+            seed = int.from_bytes(
+                hashlib.blake2b(inference_id.encode(), digest_size=4).digest(),
+                "little",
+            )
+        key = jax.random.PRNGKey(seed)
+        # table in bf16: 32k x dims, the embedding analog of the bf16 dense
+        # scoring tier; accumulation in f32
+        self.table = jax.random.normal(
+            key, (VOCAB_BUCKETS, self.dims), jnp.bfloat16
+        )
+        self._embed = jax.jit(self._embed_fn)
+
+    def _embed_fn(self, ids, mask):
+        vecs = self.table[ids].astype(jnp.float32)  # [B, L, D]
+        summed = (vecs * mask[:, :, None]).sum(axis=1)
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        mean = summed / denom
+        norm = jnp.linalg.norm(mean, axis=1, keepdims=True)
+        return mean / jnp.maximum(norm, 1e-6)
+
+    def embed(self, texts: list[str]) -> np.ndarray:
+        tok = [_hash_tokens(t) for t in texts]
+        L = max((len(t) for t in tok), default=1) or 1
+        L = 1 << (L - 1).bit_length()  # pow2 pad: bounded compiled shapes
+        ids = np.zeros((len(texts), L), np.int32)
+        mask = np.zeros((len(texts), L), np.float32)
+        for i, t in enumerate(tok):
+            ids[i, : len(t)] = t
+            mask[i, : len(t)] = 1.0
+        return np.asarray(self._embed(jnp.asarray(ids), jnp.asarray(mask)))
+
+    def sparse_embed(self, texts: list[str]) -> list[dict[str, float]]:
+        """sparse_embedding task shape: token -> weight (tf-saturated)."""
+        out = []
+        for t in texts:
+            toks = _TOKEN_RE.findall(t.lower())
+            counts: dict[str, int] = {}
+            for tk in toks:
+                counts[tk] = counts.get(tk, 0) + 1
+            out.append({tk: round(c / (c + 1.0), 6) for tk, c in counts.items()})
+        return out
+
+
+class InferenceService:
+    """Model registry + execution (TransportPutInferenceModelAction /
+    TransportInferenceAction analogs)."""
+
+    TASK_TYPES = ("text_embedding", "sparse_embedding", "rerank", "completion")
+
+    def __init__(self):
+        self.models: dict[str, dict] = {}
+        self._loaded: dict[str, TpuEmbeddingModel] = {}
+
+    def put(self, inference_id: str, task_type: str, body: dict) -> dict:
+        if task_type not in self.TASK_TYPES:
+            raise IllegalArgumentError(f"unknown task_type [{task_type}]")
+        if inference_id in self.models:
+            raise ResourceAlreadyExistsError(
+                f"inference endpoint [{inference_id}] already exists")
+        service = (body or {}).get("service", "tpu_embedding")
+        settings = dict((body or {}).get("service_settings") or {})
+        dims = int(settings.get("dimensions", 384))
+        cfg = {
+            "inference_id": inference_id,
+            "task_type": task_type,
+            "service": service,
+            "service_settings": {**settings, "dimensions": dims,
+                                 "similarity": settings.get("similarity", "cosine")},
+        }
+        self.models[inference_id] = cfg
+        return cfg
+
+    def get(self, inference_id: str | None = None) -> dict:
+        if inference_id in (None, "_all"):
+            return {"endpoints": sorted(self.models.values(),
+                                        key=lambda c: c["inference_id"])}
+        cfg = self.models.get(inference_id)
+        if cfg is None:
+            raise ResourceNotFoundError(
+                f"Inference endpoint not found [{inference_id}]")
+        return {"endpoints": [cfg]}
+
+    def delete(self, inference_id: str) -> dict:
+        if inference_id not in self.models:
+            raise ResourceNotFoundError(
+                f"Inference endpoint not found [{inference_id}]")
+        del self.models[inference_id]
+        self._loaded.pop(inference_id, None)
+        return {"acknowledged": True}
+
+    def _model(self, inference_id: str) -> TpuEmbeddingModel:
+        cfg = self.models.get(inference_id)
+        if cfg is None:
+            raise ResourceNotFoundError(
+                f"Inference endpoint not found [{inference_id}]")
+        m = self._loaded.get(inference_id)
+        if m is None:
+            ss = cfg["service_settings"]
+            m = TpuEmbeddingModel(inference_id, dims=ss["dimensions"],
+                                  seed=ss.get("seed"))
+            self._loaded[inference_id] = m
+        return m
+
+    def infer(self, inference_id: str, inputs, task_type: str | None = None,
+              query: str | None = None) -> dict:
+        cfg = self.models.get(inference_id)
+        if cfg is None:
+            raise ResourceNotFoundError(
+                f"Inference endpoint not found [{inference_id}]")
+        if task_type is not None and task_type != cfg["task_type"]:
+            raise IllegalArgumentError(
+                f"endpoint [{inference_id}] is of task_type "
+                f"[{cfg['task_type']}], requested [{task_type}]")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not all(isinstance(x, str) for x in inputs):
+            raise IllegalArgumentError("[input] must be a string or string array")
+        tt = cfg["task_type"]
+        model = self._model(inference_id)
+        if tt == "text_embedding":
+            vecs = model.embed(inputs)
+            return {"text_embedding": [
+                {"embedding": [float(x) for x in v]} for v in vecs
+            ]}
+        if tt == "sparse_embedding":
+            return {"sparse_embedding": [
+                {"is_truncated": False, "embedding": e}
+                for e in model.sparse_embed(inputs)
+            ]}
+        if tt == "rerank":
+            if query is None:
+                raise IllegalArgumentError("rerank requires [query]")
+            qv = model.embed([query])[0]
+            dv = model.embed(inputs)
+            scores = dv @ qv
+            order = np.argsort(-scores, kind="stable")
+            return {"rerank": [
+                {"index": int(i), "relevance_score": float(scores[i]),
+                 "text": inputs[int(i)]}
+                for i in order
+            ]}
+        # completion: no generative model in-tree; nearest-tokens echo keeps
+        # the API contract exercisable (documented divergence)
+        return {"completion": [{"result": inp} for inp in inputs]}
+
+    def embed_one(self, inference_id: str, text: str) -> list[float]:
+        """Query-time embedding for knn query_vector_builder."""
+        return [float(x) for x in self._model(inference_id).embed([text])[0]]
+
+
+def resolve_query_vector_builders(obj, service: InferenceService):
+    """Replace every knn `query_vector_builder` in a query/knn body with the
+    embedded `query_vector` (reference behavior: KnnSearchBuilder rewrite +
+    TextEmbeddingQueryVectorBuilder). Walks the whole tree so the builder
+    works in the top-level knn section AND in knn queries nested in bool."""
+    if isinstance(obj, dict):
+        if "query_vector_builder" in obj:
+            b = obj["query_vector_builder"]
+            te = b.get("text_embedding") if isinstance(b, dict) else None
+            if (not isinstance(te, dict) or "model_id" not in te
+                    or "model_text" not in te):
+                raise IllegalArgumentError(
+                    "[query_vector_builder] supports [text_embedding] with "
+                    "[model_id] and [model_text]")
+            out = {k: resolve_query_vector_builders(v, service)
+                   for k, v in obj.items() if k != "query_vector_builder"}
+            out["query_vector"] = service.embed_one(
+                te["model_id"], str(te["model_text"]))
+            return out
+        return {k: resolve_query_vector_builders(v, service)
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [resolve_query_vector_builders(v, service) for v in obj]
+    return obj

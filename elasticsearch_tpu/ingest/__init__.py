@@ -43,10 +43,16 @@ class Pipeline:
             proc = ForeachProcessor(config, build_processor=self._build)
         elif ptype in PROCESSOR_TYPES:
             proc = PROCESSOR_TYPES[ptype](config)
-            if ptype == "enrich":
+            if ptype in ("enrich", "inference"):
                 proc.engine = getattr(self.service, "engine", None)
         else:
-            raise IllegalArgumentError(f"No processor type exists with name [{ptype}]")
+            from ..plugins import registry
+
+            cls = registry.processors.get(ptype)
+            if cls is None:
+                raise IllegalArgumentError(f"No processor type exists with name [{ptype}]")
+            proc = cls(config)
+            proc.engine = getattr(self.service, "engine", None)
         if on_failure:
             proc.on_failure = [self._build(p) for p in on_failure]
         else:

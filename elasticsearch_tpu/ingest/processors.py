@@ -674,6 +674,53 @@ class EnrichProcessor(Processor):
             set_field(ctx, self.target, dict(row))
 
 
+class InferenceProcessor(Processor):
+    """inference: run an inference endpoint over document fields at ingest
+    (reference behavior: x-pack InferenceProcessor — the embedding path of
+    semantic indexing). Config follows the modern `input_output` form:
+    [{"input_field", "output_field"}]. The owning engine is attached by
+    Pipeline._build (`self.engine`)."""
+
+    type = "inference"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.model_id = self._field("model_id")
+        io = self.config.get("input_output")
+        if not isinstance(io, list) or not io:
+            self._fail("inference processor requires [input_output]")
+        for entry in io:
+            if (not isinstance(entry, dict) or "input_field" not in entry
+                    or "output_field" not in entry):
+                self._fail(
+                    "[input_output] entries require [input_field] and "
+                    "[output_field]")
+        self.input_output = [
+            (entry["input_field"], entry["output_field"]) for entry in io
+        ]
+        self.ignore_missing = bool(self.config.get("ignore_missing", False))
+        self.engine = None
+
+    def process(self, ctx):
+        if self.engine is None:
+            self._fail("inference processor has no engine attached")
+        svc = self.engine.inference
+        cfg = svc.models.get(self.model_id)
+        if cfg is None:
+            self._fail(f"Inference endpoint not found [{self.model_id}]")
+        for in_f, out_f in self.input_output:
+            value = get_field(ctx, in_f)
+            if value is None:
+                if self.ignore_missing:
+                    continue
+                self._fail(f"field [{in_f}] is missing")
+            if cfg["task_type"] == "sparse_embedding":
+                out = svc.infer(self.model_id, [str(value)])
+                set_field(ctx, out_f, out["sparse_embedding"][0]["embedding"])
+            else:
+                set_field(ctx, out_f, svc.embed_one(self.model_id, str(value)))
+
+
 PROCESSOR_TYPES = {
     cls.type: cls
     for cls in (
@@ -683,5 +730,6 @@ PROCESSOR_TYPES = {
         AppendProcessor, GsubProcessor, DateProcessor, FailProcessor,
         DropProcessor, JsonProcessor, KvProcessor, CsvProcessor,
         DissectProcessor, GrokProcessor, ScriptProcessor, EnrichProcessor,
+        InferenceProcessor,
     )
 }

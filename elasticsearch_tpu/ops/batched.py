@@ -284,32 +284,15 @@ class BatchTermSearcher:
     # making the cut a no-op — and a no-op cut is provably exact, which is
     # what keeps the rerun rate (the expensive path) low
     FAST_M = 2048
-    # query-chunk budget: cap the materialized [Qc, N] f32 score matrix
-    SCORE_BYTES_BUDGET = 1 << 31  # 2 GB
+    # query-chunk budget: cap the materialized [Qc, N] f32 score matrix.
+    # 4 GB leaves room next to a ~4 GB dense tier + CSR on a 16 GB chip
+    # while halving the number of per-chunk dispatches (each dispatch has
+    # fixed latency; fewer, larger chunks win until HBM pressure)
+    SCORE_BYTES_BUDGET = 1 << 32  # 4 GB
 
     def __init__(self, searcher):
         self.searcher = searcher
         self._cache = {}
-
-    def _compiled(self, key):
-        fn = self._cache.get(key)
-        if fn is None:
-            Ts, B, k, fld = key
-            pack = self.searcher.pack
-            fn = jax.jit(
-                lambda dev, W, sr, sw: batch_term_disjunction(
-                    dev,
-                    (Ts, B, k),
-                    W,
-                    sr,
-                    sw,
-                    avgdl=pack.avgdl(fld),
-                    num_docs=pack.num_docs,
-                    has_norms=fld in self.searcher.ctx.has_norms,
-                )
-            )
-            self._cache[key] = fn
-        return fn
 
     def plan(
         self,
@@ -375,25 +358,43 @@ class BatchTermSearcher:
         # whole batch fits one chunk: round Q up to pow2 (tail-padded)
         return 1 << max(Q - 1, 0).bit_length() if Q > 1 else 1
 
-    def _run_chunked(self, fn, plan: BatchPlan, n_out: int):
-        """Run fn(W, sr, sw) over uniform [qc, ...] slices of the plan
-        (tail chunk zero-padded so all chunks share one executable) and
-        concatenate the n_out outputs, sliced back to the true Q."""
+    def _run_chunked(self, kernel, map_key, plan: BatchPlan, n_out: int):
+        """Run a traceable kernel(dev, extras, W, sr, sw) over uniform
+        [qc, ...] chunks of the plan, one compiled executable shared by all
+        chunks.
+
+        Constraints (measured on real hardware):
+          - the materialized [qc, N] score matrix must stay under
+            SCORE_BYTES_BUDGET, so the query axis is chunked;
+          - each host->device transfer pays a fixed latency (~200ms through
+            a tunneled runtime), so the plan ships as ONE transfer per
+            array and chunks are device-side slices;
+          - a `lax.map` over chunks (single dispatch) was tried and is
+            SLOWER: the scan serializes against XLA's inter-dispatch
+            pipelining and compiles 5-10x longer. The per-chunk dispatch
+            loop overlaps chunk i+1's host work with chunk i's compute."""
         Q = plan.W.shape[0]
         qc = self._chunk_q(Q)
-        outs = []
-        for i in range(0, Q, qc):
-            W = plan.W[i : i + qc]
-            sr = plan.sparse_rows[i : i + qc]
-            sw = plan.sparse_weights[i : i + qc]
-            if W.shape[0] < qc:
-                pad = qc - W.shape[0]
-                W = np.pad(W, ((0, pad), (0, 0)))
-                sr = np.pad(sr, ((0, pad), (0, 0), (0, 0)))
-                sw = np.pad(sw, ((0, pad), (0, 0)))
-            outs.append(fn(W, sr, sw))
+        pad = (-Q) % qc
+        W, sr, sw = plan.W, plan.sparse_rows, plan.sparse_weights
+        if pad:
+            W = np.pad(W, ((0, pad), (0, 0)))
+            sr = np.pad(sr, ((0, pad), (0, 0), (0, 0)))
+            sw = np.pad(sw, ((0, pad), (0, 0)))
+        cache_key = ("chunk", map_key, qc)
+        fn = self._cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(kernel)
+            self._cache[cache_key] = fn
+        extras = self._fast_extras(map_key[-1]) if map_key[0] == "fast" else {}
+        dev = self.searcher.dev
+        dW, dsr, dsw = jnp.asarray(W), jnp.asarray(sr), jnp.asarray(sw)
+        outs = [
+            fn(dev, extras, dW[i : i + qc], dsr[i : i + qc], dsw[i : i + qc])
+            for i in range(0, Q + pad, qc)
+        ]
         if len(outs) == 1:
-            return tuple(o[:Q] for o in outs[0])
+            return tuple(o[:Q] for o in outs[0][:n_out])
         return tuple(
             jnp.concatenate([o[j] for o in outs])[:Q] for j in range(n_out)
         )
@@ -413,16 +414,20 @@ class BatchTermSearcher:
             return scan_topk(
                 jnp.asarray(plan.W), dev["dense_tfn"], dev["live"], plan.k
             )
-        fn = self._compiled(
-            (plan.sparse_rows.shape[1], plan.sparse_rows.shape[2], plan.k, fld)
-        )
-        dev = self.searcher.dev
+        Ts, B = plan.sparse_rows.shape[1], plan.sparse_rows.shape[2]
+        pack = self.searcher.pack
+        avgdl = pack.avgdl(fld)
+        has_norms = fld in self.searcher.ctx.has_norms
+        k = plan.k
+
+        def kernel(dev, extras, W, sr, sw):
+            return batch_term_disjunction(
+                dev, (Ts, B, k), W, sr, sw,
+                avgdl=avgdl, num_docs=pack.num_docs, has_norms=has_norms,
+            )
+
         return self._run_chunked(
-            lambda W, sr, sw: fn(
-                dev, jnp.asarray(W), jnp.asarray(sr), jnp.asarray(sw)
-            ),
-            plan,
-            3,
+            kernel, ("exact", Ts, B, k, fld), plan, 3
         )
 
     def _fast_extras(self, bf16: bool) -> dict:
@@ -449,28 +454,6 @@ class BatchTermSearcher:
             setattr(self, attr, extras)
         return extras
 
-    def _compiled_fast(self, key):
-        fn = self._cache.get(key)
-        if fn is None:
-            Ts, B, k, M, fld, bf16 = key[1:]
-            pack = self.searcher.pack
-            fn = jax.jit(
-                lambda dev, extras, W, sr, sw: batch_term_disjunction_fast(
-                    dev,
-                    extras,
-                    (Ts, B, k, M),
-                    W,
-                    sr,
-                    sw,
-                    avgdl=pack.avgdl(fld),
-                    num_docs=pack.num_docs,
-                    has_norms=fld in self.searcher.ctx.has_norms,
-                    bf16=bf16,
-                )
-            )
-            self._cache[key] = fn
-        return fn
-
     def run_fast(self, fld: str, plan: BatchPlan, *, bf16: bool = False, M: int | None = None):
         """Throughput path -> (scores [Q,k], docids [Q,k], totals_lb [Q],
         exact [Q], dropped [Q]) on device. See batch_term_disjunction_fast
@@ -485,34 +468,40 @@ class BatchTermSearcher:
             # materialization stays under SCORE_BYTES_BUDGET
             from .kernels import scan_topk_xla
 
-            N = dev["dense_tfn"].shape[1]
-            aux_doc = jnp.zeros((N,), jnp.float32)
+            k = plan.k
 
-            def dense_fn(W, sr, sw):
+            def dense_kernel(dv, extras, W, sr, sw):
+                N = dv["dense_tfn"].shape[1]
                 v, i_, t = scan_topk_xla(
-                    jnp.asarray(W),
-                    dev["dense_tfn"],
-                    dev["live"],
-                    aux_doc,
+                    W,
+                    dv["dense_tfn"],
+                    dv["live"],
+                    jnp.zeros((N,), jnp.float32),
                     jnp.zeros((W.shape[0],), jnp.float32),
-                    k=plan.k,
+                    k=k,
                     transform="identity",
                     count_positive=True,
                 )
                 ones = jnp.ones(v.shape[0], bool)
                 return v, i_, t, ones, jnp.zeros(v.shape[0], jnp.int32)
 
-            return self._run_chunked(dense_fn, plan, 5)
-        extras = self._fast_extras(bf16)
+            return self._run_chunked(dense_kernel, ("dense", k), plan, 5)
         Ts, B = plan.sparse_rows.shape[1], plan.sparse_rows.shape[2]
         M = min(M or self.FAST_M, Ts * B * BLOCK)
-        fn = self._compiled_fast(("fast", Ts, B, plan.k, M, fld, bf16))
+        pack = self.searcher.pack
+        avgdl = pack.avgdl(fld)
+        has_norms = fld in self.searcher.ctx.has_norms
+        k = plan.k
+
+        def kernel(dv, extras, W, sr, sw):
+            return batch_term_disjunction_fast(
+                dv, extras, (Ts, B, k, M), W, sr, sw,
+                avgdl=avgdl, num_docs=pack.num_docs, has_norms=has_norms,
+                bf16=bf16,
+            )
+
         return self._run_chunked(
-            lambda W, sr, sw: fn(
-                dev, extras, jnp.asarray(W), jnp.asarray(sr), jnp.asarray(sw)
-            ),
-            plan,
-            5,
+            kernel, ("fast", Ts, B, k, M, fld, bf16), plan, 5
         )
 
     def search(self, fld: str, queries: list[list[tuple[str, float]]], k: int = 10):
@@ -545,13 +534,12 @@ class BatchTermSearcher:
                 if nb > 0:
                     ts += 1
                     maxb = max(maxb, nb)
-            # coarse buckets (Ts: pow2, B: 4x steps from 32). Every extra
-            # group is an extra dispatch with its own full pass over the
-            # dense tier, so grouping is deliberately coarse: dense-only
-            # queries skip the sparse machinery entirely (fused Pallas
-            # path), everything else merges unless its posting width is a
-            # 4x step larger.
-            bb = 32
+            # buckets: Ts pow2, B in 4x steps from 8. The sparse sort/scan
+            # cost per query is proportional to Ts*B, so queries must not
+            # pay a heavier query's padding; executable dispatches are
+            # effectively free once compiled, so more groups only cost
+            # one-time compiles (persisted in the XLA cache).
+            bb = 8
             while bb < maxb:
                 bb *= 4
             shapes.append(
@@ -559,16 +547,16 @@ class BatchTermSearcher:
                  bb if maxb else 0)
             )
         groups: dict[tuple, list[int]] = {}
-        for qi, (ts_b, b_b) in enumerate(shapes):
-            groups.setdefault((min(ts_b, 1), b_b), []).append(qi)
+        for qi, sh in enumerate(shapes):
+            groups.setdefault(sh, []).append(qi)
         out = []
         for (ts_b, b_b), idxs in sorted(groups.items()):
             sub = [queries[i] for i in idxs]
-            pad_ts = max(shapes[i][0] for i in idxs) if ts_b else None
             out.append(
                 (
                     np.asarray(idxs, np.int64),
-                    self.plan(fld, sub, k, pad_ts=pad_ts, pad_b=b_b or None),
+                    self.plan(fld, sub, k,
+                              pad_ts=ts_b or None, pad_b=b_b or None),
                 )
             )
         return out

@@ -1,0 +1,105 @@
+"""Plugin SPI: extension points for queries, aggregations, ingest
+processors, analyzers, and REST handlers.
+
+The reference loads plugins from class-path services and asks each for its
+extensions (reference behavior: plugins/PluginsService.java:69 loading;
+plugins/SearchPlugin.java:64 — getQueries :126, getAggregations :133;
+IngestPlugin#getProcessors; AnalysisPlugin; ActionPlugin#getRestHandlers).
+Here a plugin is a Python class implementing the same getter surface;
+plugins are registered programmatically or loaded from a
+"module.path:ClassName" spec (the entry-point analog of
+META-INF/services).
+
+Extension lookups are consulted by the query DSL, the aggregation parser,
+the ingest pipeline builder, the analysis registry, and the REST app at
+the same points the reference consults its plugin-built registries
+(SearchModule, IngestService.processorFactories, RestController).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from ..utils.errors import IllegalArgumentError
+
+
+class Plugin:
+    """Base class. Override any subset of the extension getters.
+
+    name/description surface in GET _cat/plugins and _nodes/plugins."""
+
+    name = "unnamed"
+    description = ""
+
+    def get_queries(self) -> dict:
+        """{query_name: parser(body, mappings) -> QueryNode}"""
+        return {}
+
+    def get_aggregations(self) -> dict:
+        """{agg_name: parser(name, body, sub_nodes, mappings) -> AggNode}"""
+        return {}
+
+    def get_processors(self) -> dict:
+        """{processor_type: ProcessorClass}"""
+        return {}
+
+    def get_analyzers(self) -> dict:
+        """{analyzer_name: Analyzer instance}"""
+        return {}
+
+    def get_rest_handlers(self) -> list:
+        """[(method, path, async handler(request) -> aiohttp response)]"""
+        return []
+
+
+class PluginRegistry:
+    def __init__(self):
+        self.plugins: list[Plugin] = []
+        self.queries: dict[str, object] = {}
+        self.aggregations: dict[str, object] = {}
+        self.processors: dict[str, type] = {}
+        self.analyzers: dict[str, object] = {}
+        self.rest_handlers: list = []
+
+    def register(self, plugin: Plugin) -> None:
+        for reg, got in (
+            (self.queries, plugin.get_queries()),
+            (self.aggregations, plugin.get_aggregations()),
+            (self.processors, plugin.get_processors()),
+            (self.analyzers, plugin.get_analyzers()),
+        ):
+            for key, val in got.items():
+                if key in reg:
+                    raise IllegalArgumentError(
+                        f"extension [{key}] already registered "
+                        f"(plugin [{plugin.name}])"
+                    )
+                reg[key] = val
+        self.rest_handlers.extend(plugin.get_rest_handlers())
+        self.plugins.append(plugin)
+
+    def load_spec(self, spec: str) -> Plugin:
+        """Load "module.path:ClassName", instantiate, register."""
+        mod_name, _, cls_name = spec.partition(":")
+        if not cls_name:
+            raise IllegalArgumentError(
+                f"plugin spec [{spec}] must be module:ClassName")
+        try:
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+        except (ImportError, AttributeError) as e:
+            raise IllegalArgumentError(f"cannot load plugin [{spec}]: {e}")
+        plugin = cls()
+        self.register(plugin)
+        return plugin
+
+    def info(self) -> list[dict]:
+        return [
+            {"name": p.name, "description": p.description,
+             "classname": type(p).__qualname__}
+            for p in self.plugins
+        ]
+
+
+# node-level registry (the PluginsService singleton analog); tests and
+# embedders may also build private registries and swap them in
+registry = PluginRegistry()

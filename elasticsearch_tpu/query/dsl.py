@@ -50,6 +50,10 @@ def parse_query(q: dict | None, mappings: Mappings) -> QueryNode:
     (kind, body), = q.items()
     parser = _PARSERS.get(kind)
     if parser is None:
+        from ..plugins import registry
+
+        parser = registry.queries.get(kind)
+    if parser is None:
         raise QueryParsingError(f"unknown query [{kind}]")
     return parser(body, mappings)
 
@@ -158,7 +162,7 @@ def _parse_multi_match(body, mappings):
     boost = float(body.get("boost", 1.0))
     if text is None or not fields:
         raise QueryParsingError("[multi_match] requires [query] and [fields]")
-    if mm_type not in ("best_fields", "most_fields", "phrase"):
+    if mm_type not in ("best_fields", "most_fields", "phrase", "bool_prefix"):
         raise QueryParsingError(f"[multi_match] type [{mm_type}] is not supported")
     children = []
     for f in fields:
@@ -166,6 +170,12 @@ def _parse_multi_match(body, mappings):
         if "^" in f:
             f, fb = f.split("^", 1)
             fboost = float(fb)
+        if mm_type == "bool_prefix":
+            child = _parse_match_bool_prefix(
+                {f: {"query": text, "boost": fboost}}, mappings
+            )
+            children.append(child)
+            continue
         if mm_type == "phrase":
             child = _parse_match_phrase(
                 {f: {"query": text, "boost": fboost}}, mappings

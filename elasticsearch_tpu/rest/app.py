@@ -220,6 +220,18 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 out["_routing"] = request.query["routing"]
         return out
 
+    async def _maybe_pipeline(idx, body, request, doc_id):
+        """Apply request/default/final ingest pipelines to a single-doc
+        write; returns None when a drop processor fired."""
+        pipeline = request.query.get("pipeline")
+        s = idx.settings
+        if pipeline or s.get("default_pipeline") \
+                or s.get("index.default_pipeline") \
+                or s.get("final_pipeline") or s.get("index.final_pipeline"):
+            return await call(engine.run_pipelines, idx.name, body,
+                              pipeline, doc_id)
+        return body
+
     @handler
     async def put_doc(request):
         name = request.match_info["index"]
@@ -229,6 +241,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             raise IllegalArgumentError("request body is required")
         op_type = request.query.get("op_type", "index")
         idx = await call(engine.get_or_autocreate, name)
+        body = await _maybe_pipeline(idx, body, request, doc_id)
+        if body is None:  # drop processor fired
+            return web.json_response(
+                {"_index": name, "_id": doc_id, "result": "noop"})
         r = await call(idx.index_doc, doc_id, body, op_type)
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(idx.refresh)
@@ -243,6 +259,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         if not isinstance(body, dict):
             raise IllegalArgumentError("request body is required")
         idx = await call(engine.get_or_autocreate, name)
+        body = await _maybe_pipeline(idx, body, request, doc_id)
+        if body is None:  # drop processor fired
+            return web.json_response(
+                {"_index": name, "_id": doc_id, "result": "noop"})
         r = await call(idx.index_doc, doc_id, body, "create")
         if request.query.get("refresh") in ("", "true", "wait_for"):
             await call(idx.refresh)
@@ -799,6 +819,41 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def enrich_delete(request):
         return web.json_response(await _xcall(
             "xpack", "enrich_delete_policy", request.match_info["name"]))
+
+    # ---- inference -------------------------------------------------------
+
+    @handler
+    async def inference_put(request):
+        body = await body_json(request, {}) or {}
+        task_type = request.match_info.get("task_type", "text_embedding")
+        return web.json_response(await call(
+            engine.inference.put, request.match_info["id"], task_type, body
+        ))
+
+    @handler
+    async def inference_get(request):
+        return web.json_response(await call(
+            engine.inference.get, request.match_info.get("id")
+        ))
+
+    @handler
+    async def inference_delete(request):
+        return web.json_response(await call(
+            engine.inference.delete, request.match_info["id"]
+        ))
+
+    @handler
+    async def inference_infer(request):
+        body = await body_json(request, {}) or {}
+        if "input" not in body:
+            raise IllegalArgumentError("[input] is required")
+        return web.json_response(await call(
+            engine.inference.infer,
+            request.match_info["id"],
+            body["input"],
+            request.match_info.get("task_type"),
+            body.get("query"),
+        ))
 
     @handler
     async def health_report_api(request):
@@ -1502,6 +1557,25 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         took = int((time.monotonic() - t0) * 1000)
         from ..search import apply_fetch_phase
 
+        # fetch options given as URL params (the reference accepts both)
+        if "_source" in query_params and "_source" not in body:
+            rs = query_params["_source"]
+            body = {**body, "_source": (rs == "true") if rs in ("true", "false")
+                    else rs.split(",")}
+        inc = query_params.get("_source_includes")
+        exc = query_params.get("_source_excludes")
+        if (inc or exc) and not isinstance(body.get("_source"), dict):
+            body = {**body, "_source": {
+                "includes": inc.split(",") if inc else [],
+                "excludes": exc.split(",") if exc else [],
+            }}
+        if "docvalue_fields" in query_params and "docvalue_fields" not in body:
+            body = {**body,
+                    "docvalue_fields": query_params["docvalue_fields"].split(",")}
+        if "stored_fields" in query_params and "stored_fields" not in body:
+            body = {**body,
+                    "stored_fields": query_params["stored_fields"].split(",")}
+
         def _mappings_of(name):
             if ":" in name:  # remote (CCS) hit: sub-phases already applied there
                 return None
@@ -1551,13 +1625,15 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             tot = res.get("hits", {}).get("total")
             if isinstance(tot, dict):
                 res["hits"]["total"] = tot["value"]
+        skipped = res.pop("skipped_shards", 0)
         return {
             "took": took,
             "timed_out": False,
             "_shards": {
                 "total": n_shards,
+                # the reference counts skipped shards as successful too
                 "successful": n_shards,
-                "skipped": 0,
+                "skipped": skipped,
                 "failed": 0,
             },
             **res,
@@ -1612,6 +1688,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             raise IllegalArgumentError("scroll_id is required")
         scroll = body.get("scroll") or request.query.get("scroll")
         res = await call(engine.continue_scroll, sid, scroll)
+        res.pop("skipped_shards", None)  # internal coordinator detail
         return web.json_response({"took": 0, "timed_out": False, **res})
 
     @handler
@@ -1650,6 +1727,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         from ..utils.errors import ActionRequestValidationError
 
         items = []
+        specs = []
         if "docs" in body:
             for d in body["docs"]:
                 name = d.get("_index", default_index)
@@ -1658,13 +1736,37 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 if "_id" not in d:
                     raise ActionRequestValidationError("id is missing")
                 items.append((name, str(d["_id"])))
+                specs.append(d.get("_source"))
         elif "ids" in body:
             if not default_index:
                 raise IllegalArgumentError("ids form requires an index in the path")
             items = [(default_index, str(i)) for i in body["ids"]]
+            specs = [None] * len(items)
         else:
             raise IllegalArgumentError("unexpected content, expected [docs] or [ids]")
+        # request-level _source controls (per-doc specs win)
+        req_spec = None
+        if request.query.get("_source") is not None:
+            rs = request.query["_source"]
+            req_spec = (rs == "true") if rs in ("true", "false") else rs.split(",")
+        inc = request.query.get("_source_includes")
+        exc = request.query.get("_source_excludes")
+        if inc or exc:
+            req_spec = {"includes": inc.split(",") if inc else [],
+                        "excludes": exc.split(",") if exc else []}
         docs = await call(engine.mget, items)
+        if req_spec is not None or any(s is not None for s in specs):
+            from ..search.fetch import filter_source
+
+            for doc, spec in zip(docs, specs):
+                spec = spec if spec is not None else req_spec
+                if spec is None or "_source" not in doc:
+                    continue
+                filtered = filter_source(doc["_source"], spec)
+                if filtered is None:
+                    doc.pop("_source", None)
+                else:
+                    doc["_source"] = filtered
         return web.json_response({"docs": docs})
 
     @handler
@@ -2132,6 +2234,15 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_enrich/policy/{name}", enrich_get)
     app.router.add_delete("/_enrich/policy/{name}", enrich_delete)
     app.router.add_get("/_health_report", health_report_api)
+    app.router.add_get("/_inference/_all", inference_get)
+    app.router.add_get("/_inference/{id}", inference_get)
+    app.router.add_put("/_inference/{id}", inference_put)
+    app.router.add_delete("/_inference/{id}", inference_delete)
+    app.router.add_post("/_inference/{id}", inference_infer)
+    app.router.add_put("/_inference/{task_type}/{id}", inference_put)
+    app.router.add_get("/_inference/{task_type}/{id}", inference_get)
+    app.router.add_delete("/_inference/{task_type}/{id}", inference_delete)
+    app.router.add_post("/_inference/{task_type}/{id}", inference_infer)
     app.router.add_put("/_transform/{id}", transform_put)
     app.router.add_get("/_transform", transform_get)
     app.router.add_get("/_transform/{id}", transform_get)
@@ -2227,6 +2338,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_route("*", "/{index}/_explain/{id}", explain_doc)
     app.router.add_route("*", "/{index}/_field_caps", field_caps)
     app.router.add_post("/{index}/_pit", open_pit)
+
+    # plugin-contributed REST handlers (ActionPlugin#getRestHandlers):
+    # wrapped in the same error envelope as built-in routes
+    from ..plugins import registry as _plugin_registry
+
+    for method, path, h in _plugin_registry.rest_handlers:
+        app.router.add_route(method, path, handler(h))
 
     async def on_cleanup(app):
         app["pool"].shutdown(wait=True)

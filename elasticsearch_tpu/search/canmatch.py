@@ -1,0 +1,85 @@
+"""Can-match pre-filter: skip shards that provably match nothing.
+
+The reference runs a lightweight coordinator phase before query dispatch
+that asks each shard whether the query CAN match, using field min/max
+bounds from the shard metadata — the big win is time-series indices where
+a range on @timestamp excludes most backing indices (reference behavior:
+action/search/CanMatchPreFilterSearchPhase.java:62; per-shard
+MinAndMax sort-value pruning).
+
+Here the pruning unit is the index (the shards of one index execute as a
+single SPMD program over the mesh, so intra-index shard skipping saves
+nothing — documented divergence), and the bounds come from the packed
+DocValues columns' vmin/vmax, computed at pack build time.
+
+Conservative by construction: only top-level `range` constraints and
+range constraints strictly required by `bool` (must/filter, recursively)
+prune; anything else returns "can match". A range on a field with no
+values in the index matches nothing, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+
+def _required_ranges(query: dict | None, out: list) -> None:
+    """Collect range clauses every matching doc MUST satisfy."""
+    if not isinstance(query, dict) or len(query) != 1:
+        return
+    (kind, body), = query.items()
+    if kind == "range" and isinstance(body, dict) and len(body) == 1:
+        (fld, spec), = body.items()
+        if isinstance(spec, dict):
+            out.append((fld, spec))
+    elif kind == "bool" and isinstance(body, dict):
+        for sect in ("must", "filter"):
+            clauses = body.get(sect)
+            if isinstance(clauses, dict):
+                clauses = [clauses]
+            for c in clauses or []:
+                _required_ranges(c, out)
+    elif kind == "constant_score" and isinstance(body, dict):
+        _required_ranges(body.get("filter"), out)
+
+
+def can_match(idx, query: dict | None) -> bool:
+    """False only when the query provably matches no document in `idx`."""
+    ranges: list = []
+    _required_ranges(query, ranges)
+    if not ranges:
+        return True
+    try:
+        idx._maybe_refresh()
+        packs = [sv.pack if hasattr(sv, "pack") else sv
+                 for sv in idx.searcher.sp.shards]
+    except Exception:
+        return True  # no searchable state yet: let the search itself decide
+    from ..query.dsl import _coerce_for_field
+
+    for fld, spec in ranges:
+        ft = idx.mappings.fields.get(fld)
+        if ft is None:
+            return False  # unmapped field: a required range matches nothing
+        cols = [p.docvalues.get(fld) for p in packs]
+        cols = [c for c in cols if c is not None and bool(c.has_value.any())]
+        if not cols:
+            return False  # field has no values anywhere in this index
+        vmin = min(c.vmin for c in cols)
+        vmax = max(c.vmax for c in cols)
+        try:
+            for op in ("gte", "gt", "lte", "lt"):
+                if op not in spec:
+                    continue
+                kind, v = _coerce_for_field(idx.mappings, fld, spec[op])
+                if kind not in ("int", "float"):
+                    return True  # ordinal/ip bounds: not pruned here
+                if op == "gte" and vmax < v:
+                    return False
+                if op == "gt" and vmax <= v:
+                    return False
+                if op == "lte" and vmin > v:
+                    return False
+                if op == "lt" and vmin >= v:
+                    return False
+        except Exception:
+            return True  # unparseable bound: fall through to real search
+    return True

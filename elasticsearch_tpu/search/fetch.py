@@ -178,6 +178,10 @@ def docvalue_fields_option(hit_source: dict, specs, mappings) -> dict[str, list]
             if ft.type == "date":
                 values = [_format_date(v, fmt or "epoch_millis", ft.format)
                           for v in values]
+            elif fmt and set(fmt) <= set("#.0,"):
+                # DecimalFormat-style numeric patterns ("#.0" -> 1 decimal)
+                decimals = len(fmt.split(".", 1)[1]) if "." in fmt else 0
+                values = [f"{float(v):.{decimals}f}" for v in values]
             out.setdefault(path, []).extend(values)
     return out
 
@@ -192,8 +196,21 @@ def apply_fetch_phase(hits: list[dict], body: dict, mappings_of) -> None:
     stored_fields = body.get("stored_fields")
     highlight = body.get("highlight")
 
-    suppress_source = stored_fields == "_none_" or (
+    # stored_fields suppresses _source unless it is listed explicitly
+    # (reference behavior: StoredFieldsContext — fetchSource defaults off
+    # when stored_fields are requested; "_none_" suppresses everything and
+    # conflicts with an explicit _source request)
+    has_none = stored_fields == "_none_" or (
         isinstance(stored_fields, list) and "_none_" in stored_fields
+    )
+    if has_none and source_spec not in (None, False):
+        raise IllegalArgumentError(
+            "[stored_fields] cannot be disabled if [_source] is requested")
+    suppress_source = has_none or (
+        stored_fields is not None
+        and source_spec is None
+        and ((isinstance(stored_fields, list) and "_source" not in stored_fields)
+             or (isinstance(stored_fields, str) and stored_fields != "_source"))
     )
 
     for h in hits:

@@ -1,0 +1,68 @@
+"""Can-match pre-filter: range-bounded shard skipping across indices.
+
+Reference behavior: action/search/CanMatchPreFilterSearchPhase.java:62 —
+coordinator-side shard pruning by field bounds before query dispatch;
+time-series multi-index range queries are the headline case.
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.rest import make_app
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_canmatch_skips_out_of_range_indices():
+    async def scenario():
+        app = make_app()
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            for month, idx in (("01", "logs-1"), ("02", "logs-2"), ("03", "logs-3")):
+                await c.put(f"/{idx}", json={"mappings": {"properties": {
+                    "@timestamp": {"type": "date"}, "msg": {"type": "text"}}}})
+                for d in ("05", "15"):
+                    r = await c.put(f"/{idx}/_doc/{month}-{d}?refresh=true",
+                                    json={"@timestamp": f"2024-{month}-{d}",
+                                          "msg": f"event {month} {d}"})
+                    assert r.status == 201
+            # range covering only February: logs-1 and logs-3 skip
+            r = await c.post("/logs-1,logs-2,logs-3/_search", json={
+                "query": {"bool": {"filter": [
+                    {"range": {"@timestamp": {"gte": "2024-02-01",
+                                              "lt": "2024-03-01"}}}
+                ]}}})
+            body = await r.json()
+            assert body["hits"]["total"]["value"] == 2, body
+            assert body["_shards"]["skipped"] == 2, body["_shards"]
+            assert {h["_index"] for h in body["hits"]["hits"]} == {"logs-2"}
+            # range touching all three: nothing skipped
+            r = await c.post("/logs-1,logs-2,logs-3/_search", json={
+                "query": {"range": {"@timestamp": {"gte": "2024-01-10"}}}})
+            body = await r.json()
+            assert body["_shards"]["skipped"] == 0
+            assert body["hits"]["total"]["value"] == 5
+            # required range on an unmapped field: everything skips
+            r = await c.post("/logs-1,logs-2,logs-3/_search", json={
+                "query": {"range": {"nope": {"gte": 1}}}})
+            body = await r.json()
+            assert body["_shards"]["skipped"] == 3
+            assert body["hits"]["total"]["value"] == 0
+            # non-range queries never prune
+            r = await c.post("/logs-1,logs-2,logs-3/_search", json={
+                "query": {"match": {"msg": "event"}}})
+            body = await r.json()
+            assert body["_shards"]["skipped"] == 0
+            assert body["hits"]["total"]["value"] == 6
+        finally:
+            await c.close()
+
+    _run(scenario())

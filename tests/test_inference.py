@@ -1,0 +1,124 @@
+"""Inference API: endpoints, ingest embedding, semantic kNN search.
+
+Reference behaviors: x-pack/plugin/inference REST surface
+(_inference/{task_type}/{id} CRUD + infer), InferenceProcessor at ingest,
+and the knn query_vector_builder text_embedding path (semantic search).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.inference import InferenceService, TpuEmbeddingModel
+from elasticsearch_tpu.rest import make_app
+
+
+def test_embedding_deterministic_and_normalized():
+    m1 = TpuEmbeddingModel("e5-small", dims=64)
+    m2 = TpuEmbeddingModel("e5-small", dims=64)
+    v1 = m1.embed(["hello tpu world", "other text"])
+    v2 = m2.embed(["hello tpu world", "other text"])
+    np.testing.assert_allclose(v1, v2, rtol=1e-6)
+    np.testing.assert_allclose(np.linalg.norm(v1, axis=1), 1.0, rtol=1e-4)
+    # similar texts closer than dissimilar ones
+    a, b, c = m1.embed(["the quick brown fox", "the quick brown foxes", "7 xyzzy"])
+    assert a @ b > a @ c
+
+
+def test_service_crud_and_tasks():
+    svc = InferenceService()
+    svc.put("emb", "text_embedding", {"service_settings": {"dimensions": 32}})
+    assert svc.get("emb")["endpoints"][0]["task_type"] == "text_embedding"
+    out = svc.infer("emb", ["one", "two"])
+    assert len(out["text_embedding"]) == 2
+    assert len(out["text_embedding"][0]["embedding"]) == 32
+
+    svc.put("sparse", "sparse_embedding", {})
+    sp = svc.infer("sparse", ["a a b"])["sparse_embedding"][0]["embedding"]
+    assert sp["a"] > sp["b"] > 0
+
+    svc.put("rr", "rerank", {"service_settings": {"dimensions": 32}})
+    rr = svc.infer("rr", ["snow and ice", "hot sand desert"],
+                   query="cold snow")["rerank"]
+    assert rr[0]["text"] == "snow and ice"
+
+    svc.delete("emb")
+    from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+
+    with pytest.raises(ResourceNotFoundError):
+        svc.get("emb")
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_semantic_search_e2e():
+    async def scenario():
+        app = make_app()
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            # 1. create the inference endpoint
+            r = await c.put("/_inference/text_embedding/mini-embed",
+                            json={"service": "tpu_embedding",
+                                  "service_settings": {"dimensions": 64}})
+            assert r.status == 200, await r.text()
+            # 2. infer directly
+            r = await c.post("/_inference/mini-embed",
+                             json={"input": "standalone call"})
+            assert len((await r.json())["text_embedding"][0]["embedding"]) == 64
+            # 3. ingest pipeline with the inference processor
+            r = await c.put("/_ingest/pipeline/embedder", json={
+                "processors": [{"inference": {
+                    "model_id": "mini-embed",
+                    "input_output": [{"input_field": "body",
+                                      "output_field": "body_vec"}],
+                }}],
+            })
+            assert r.status == 200, await r.text()
+            # 4. index docs with embeddings
+            r = await c.put("/semantic", json={"mappings": {"properties": {
+                "body": {"type": "text"},
+                "body_vec": {"type": "dense_vector", "dims": 64,
+                              "similarity": "cosine"},
+            }}})
+            assert r.status == 200, await r.text()
+            docs = [
+                "winter snow storm in the mountains",
+                "summer beach holiday with hot sand",
+                "cooking pasta with tomato sauce",
+            ]
+            for i, body in enumerate(docs):
+                r = await c.put(f"/semantic/_doc/{i}?pipeline=embedder&refresh=true",
+                                json={"body": body})
+                assert r.status == 201, await r.text()
+            # the stored doc carries the embedding
+            r = await c.get("/semantic/_doc/0")
+            src = (await r.json())["_source"]
+            assert len(src["body_vec"]) == 64
+            # 5. semantic search: query embedded at search time
+            r = await c.post("/semantic/_search", json={
+                "knn": {"field": "body_vec", "k": 2, "num_candidates": 3,
+                        "query_vector_builder": {"text_embedding": {
+                            "model_id": "mini-embed",
+                            "model_text": "snowy winter weather",
+                        }}},
+            })
+            body = await r.json()
+            assert r.status == 200, body
+            hits = body["hits"]["hits"]
+            assert hits[0]["_id"] == "0", hits
+            # 6. errors: unknown endpoint -> 404
+            r = await c.post("/_inference/nope", json={"input": "x"})
+            assert r.status == 404
+        finally:
+            await c.close()
+
+    _run(scenario())

@@ -1,0 +1,131 @@
+"""Plugin SPI: query/agg/processor/REST extension points end to end.
+
+Reference behaviors: plugins/PluginsService.java:69 (loading),
+SearchPlugin#getQueries/#getAggregations, IngestPlugin#getProcessors,
+ActionPlugin#getRestHandlers.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu import plugins as plugins_mod
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.ingest.processors import Processor, get_field, set_field
+from elasticsearch_tpu.plugins import Plugin, PluginRegistry
+from elasticsearch_tpu.query.nodes import RangeNode
+from elasticsearch_tpu.rest import make_app
+
+
+class ExclaimProcessor(Processor):
+    type = "exclaim"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.fld = self._field("field")
+
+    def process(self, ctx):
+        set_field(ctx, self.fld, str(get_field(ctx, self.fld)) + "!")
+
+
+def _parse_at_least(body, mappings):
+    """Custom query: {"at_least": {"field": f, "value": v}} — numeric gte."""
+    return RangeNode(body["field"], body["value"], None, kind="int")
+
+
+def _parse_double_count(name, body, children, mappings):
+    from elasticsearch_tpu.aggs.nodes import ValueCountAgg
+
+    return ValueCountAgg(name, body["field"])
+
+
+async def _ping(request):
+    return web.json_response({"pong": True,
+                              "engine": request.app["engine"] is not None})
+
+
+class DemoPlugin(Plugin):
+    name = "demo-plugin"
+    description = "SPI test plugin"
+
+    def get_queries(self):
+        return {"at_least": _parse_at_least}
+
+    def get_aggregations(self):
+        return {"double_count": _parse_double_count}
+
+    def get_processors(self):
+        return {"exclaim": ExclaimProcessor}
+
+    def get_rest_handlers(self):
+        return [("GET", "/_demo/ping", _ping)]
+
+
+@pytest.fixture
+def demo_registry():
+    old = plugins_mod.registry
+    plugins_mod.registry = PluginRegistry()
+    plugins_mod.registry.load_spec("test_plugins:DemoPlugin")
+    yield plugins_mod.registry
+    plugins_mod.registry = old
+
+
+def test_spi_loading_and_conflicts(demo_registry):
+    assert demo_registry.info()[0]["name"] == "demo-plugin"
+    with pytest.raises(Exception):
+        demo_registry.register(DemoPlugin())  # duplicate extension names
+
+
+def test_plugin_query_agg_processor_rest(demo_registry, tmp_path):
+    async def scenario():
+        app = make_app(data_path=str(tmp_path / "d"))
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            # custom REST handler
+            r = await c.get("/_demo/ping")
+            assert (await r.json())["pong"] is True
+            # custom ingest processor
+            r = await c.put("/_ingest/pipeline/shout", json={
+                "processors": [{"exclaim": {"field": "msg"}}]})
+            assert r.status == 200, await r.text()
+            r = await c.put("/idx/_doc/1?pipeline=shout&refresh=true",
+                            json={"msg": "hello", "n": 5})
+            assert r.status == 201
+            r = await c.get("/idx/_doc/1")
+            assert (await r.json())["_source"]["msg"] == "hello!"
+            # custom query
+            for n, i in ((1, "2"), (9, "3")):
+                await c.put(f"/idx/_doc/{i}?refresh=true",
+                            json={"msg": "x", "n": n})
+            r = await c.post("/idx/_search", json={
+                "query": {"at_least": {"field": "n", "value": 5}}})
+            body = await r.json()
+            assert body["hits"]["total"]["value"] == 2, body
+            # custom aggregation
+            r = await c.post("/idx/_search", json={
+                "size": 0, "aggs": {"c": {"double_count": {"field": "n"}}}})
+            body = await r.json()
+            assert body["aggregations"]["c"]["value"] == 3, body
+            # custom component listed in _cat/plugins
+            r = await c.get("/_cat/plugins?format=json")
+            comps = [row["component"] for row in await r.json()]
+            assert "demo-plugin" in comps
+        finally:
+            await c.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+
+
+def test_unknown_extensions_still_error(tmp_path):
+    from elasticsearch_tpu.query.dsl import parse_query
+    from elasticsearch_tpu.utils.errors import QueryParsingError
+
+    with pytest.raises(QueryParsingError):
+        parse_query({"at_least_nope": {}}, Mappings({}))
