@@ -25,18 +25,25 @@ def changes(engine, index: str, from_seq_no: int, size: int = 512) -> dict:
     seq_no order (the analog of the reference's internal shard changes
     action)."""
     idx = engine.get_index(index)
-    ops = []
-    for doc_id, e in idx.docs.items():
-        if e.seq_no >= from_seq_no:
-            if e.alive:
-                ops.append({"op": "index", "id": doc_id, "seq_no": e.seq_no,
-                            "version": e.version, "source": e.source})
-            else:
-                ops.append({"op": "delete", "id": doc_id, "seq_no": e.seq_no,
-                            "version": e.version})
-    ops.sort(key=lambda o: o["seq_no"])
+    # fast path: tail the seq-ordered op log (the reference reads a
+    # seq-no range out of the translog/Lucene, LuceneChangesSnapshot) —
+    # O(ops since checkpoint), not O(index)
+    ops = idx.ops_since(from_seq_no, size)
+    if ops is None:
+        # checkpoint older than the retained tail: full-scan fallback
+        ops = []
+        for doc_id, e in idx.docs.items():
+            if e.seq_no >= from_seq_no:
+                if e.alive:
+                    ops.append({"op": "index", "id": doc_id, "seq_no": e.seq_no,
+                                "version": e.version, "source": e.source})
+                else:
+                    ops.append({"op": "delete", "id": doc_id, "seq_no": e.seq_no,
+                                "version": e.version})
+        ops.sort(key=lambda o: o["seq_no"])
+        ops = ops[:size]
     return {
-        "ops": ops[:size],
+        "ops": ops,
         "max_seq_no": idx.seq_no - 1,
         "mappings": idx.mappings.to_dict(),
     }

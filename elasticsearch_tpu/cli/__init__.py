@@ -1,0 +1,9 @@
+"""Command-line tools (the bin/ distribution surface).
+
+The reference ships CLI tools built on its cli-launcher lib
+(distribution/tools/*; libs/cli). The ones with in-scope behavior here:
+
+  python -m elasticsearch_tpu.cli.keystore  — secure settings store
+  python -m elasticsearch_tpu.rest.server   — the node itself
+  python -m elasticsearch_tpu.cluster.server — a cluster data node
+"""

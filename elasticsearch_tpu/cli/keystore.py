@@ -1,0 +1,198 @@
+"""elasticsearch-keystore analog: an at-rest-protected secure settings file.
+
+The reference's keystore holds secure settings (repository credentials,
+passwords) encrypted with AES-GCM under a PBKDF2-derived key
+(reference behavior: server/.../common/settings/KeyStoreWrapper.java;
+distribution/tools/keystore-cli). This implementation keeps the same
+contract — create / list / add / remove / has-passwd, values never stored
+in plaintext, integrity-checked on open — with a stdlib cipher:
+PBKDF2-HMAC-SHA256 key derivation, a SHA256-counter keystream, and an
+encrypt-then-MAC HMAC-SHA256 over the ciphertext (documented divergence:
+not AES-GCM, same structure).
+
+Settings consumers read through SecureSettings.get() exactly like
+Setting.secureString in the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import sys
+
+FORMAT_VERSION = 1
+_ITERS = 210_000
+
+
+def _derive(password: bytes, salt: bytes) -> tuple[bytes, bytes]:
+    key = hashlib.pbkdf2_hmac("sha256", password, salt, _ITERS, dklen=64)
+    return key[:32], key[32:]  # cipher key, mac key
+
+
+def _keystream_xor(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < len(data):
+        block = hashlib.sha256(key + nonce + counter.to_bytes(8, "big")).digest()
+        out.extend(block)
+        counter += 1
+    return bytes(x ^ y for x, y in zip(data, out[: len(data)]))
+
+
+class Keystore:
+    def __init__(self, path: str):
+        self.path = path
+        self.entries: dict[str, str] = {}
+        self._password = b""
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self) -> None:
+        salt = secrets.token_bytes(16)
+        nonce = secrets.token_bytes(16)
+        ckey, mkey = _derive(self._password, salt)
+        plain = json.dumps(self.entries).encode()
+        cipher = _keystream_xor(ckey, nonce, plain)
+        mac = hmac.new(mkey, nonce + cipher, hashlib.sha256).digest()
+        blob = {
+            "version": FORMAT_VERSION,
+            "salt": salt.hex(),
+            "nonce": nonce.hex(),
+            "mac": mac.hex(),
+            "data": cipher.hex(),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    @classmethod
+    def load(cls, path: str, password: bytes = b"") -> "Keystore":
+        with open(path) as f:
+            blob = json.load(f)
+        if blob.get("version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported keystore version [{blob.get('version')}]")
+        salt = bytes.fromhex(blob["salt"])
+        nonce = bytes.fromhex(blob["nonce"])
+        cipher = bytes.fromhex(blob["data"])
+        ckey, mkey = _derive(password, salt)
+        mac = hmac.new(mkey, nonce + cipher, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, bytes.fromhex(blob["mac"])):
+            raise ValueError(
+                "keystore integrity check failed (wrong password or corrupted file)")
+        ks = cls(path)
+        ks._password = password
+        ks.entries = json.loads(_keystream_xor(ckey, nonce, cipher))
+        return ks
+
+    # -- SecureSettings view ----------------------------------------------
+
+    def get(self, setting: str, default: str | None = None) -> str | None:
+        return self.entries.get(setting, default)
+
+    def set_password(self, password: bytes) -> None:
+        self._password = password
+
+
+def default_path(config_dir: str | None = None) -> str:
+    base = config_dir or os.environ.get("ES_TPU_CONF", os.path.expanduser("~/.es_tpu"))
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, "elasticsearch.keystore")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="elasticsearch-keystore")
+    ap.add_argument("command", choices=["create", "list", "add", "remove", "show", "has-passwd"])
+    ap.add_argument("setting", nargs="?")
+    ap.add_argument("--path", default=None)
+    ap.add_argument("--password", action="store_true",
+                    help="protect the keystore with a password")
+    ap.add_argument("--stdin", action="store_true",
+                    help="read the value from stdin instead of prompting")
+    ap.add_argument("--force", action="store_true")
+    args = ap.parse_args(argv)
+    path = args.path or default_path()
+
+    def read_password(confirm=False) -> bytes:
+        pw = getpass.getpass("Enter password for the elasticsearch keystore: ")
+        if confirm:
+            again = getpass.getpass("Enter same password again: ")
+            if pw != again:
+                print("Passwords are not equal, exiting.", file=sys.stderr)
+                sys.exit(65)
+        return pw.encode()
+
+    if args.command == "create":
+        if os.path.exists(path) and not args.force:
+            print(f"keystore already exists at [{path}]", file=sys.stderr)
+            sys.exit(65)
+        ks = Keystore(path)
+        if args.password:
+            ks.set_password(read_password(confirm=True))
+        ks.save()
+        print(f"Created elasticsearch keystore in {path}")
+        return
+
+    password = b""
+    try:
+        ks = Keystore.load(path, password)
+    except FileNotFoundError:
+        print(f"ERROR: Elasticsearch keystore not found at [{path}]. "
+              "Use 'create' command to create one.", file=sys.stderr)
+        sys.exit(65)
+    except ValueError:
+        ks = Keystore.load(path, read_password())
+
+    if args.command == "has-passwd":
+        protected = False
+        try:
+            Keystore.load(path, b"")
+        except ValueError:
+            protected = True
+        print("Keystore is" + ("" if protected else " NOT") +
+              " password-protected")
+        sys.exit(0 if protected else 1)
+    if args.command == "list":
+        for name in sorted(ks.entries):
+            print(name)
+        return
+    if not args.setting:
+        print("ERROR: the setting name can not be null", file=sys.stderr)
+        sys.exit(65)
+    if args.command == "add":
+        if args.setting in ks.entries and not args.force:
+            print(f"Setting {args.setting} already exists. "
+                  "Use --force to overwrite.", file=sys.stderr)
+            sys.exit(65)
+        if args.stdin:
+            value = sys.stdin.readline().rstrip("\n")
+        else:
+            value = getpass.getpass(f"Enter value for {args.setting}: ")
+        ks.entries[args.setting] = value
+        ks.save()
+        return
+    if args.command == "remove":
+        if args.setting not in ks.entries:
+            print(f"ERROR: Setting [{args.setting}] does not exist in the keystore.",
+                  file=sys.stderr)
+            sys.exit(65)
+        del ks.entries[args.setting]
+        ks.save()
+        return
+    if args.command == "show":
+        if args.setting not in ks.entries:
+            print(f"ERROR: Setting [{args.setting}] does not exist in the keystore.",
+                  file=sys.stderr)
+            sys.exit(65)
+        print(ks.entries[args.setting])
+
+
+if __name__ == "__main__":
+    main()

@@ -106,6 +106,13 @@ class EsIndex:
         self.docs: dict[str, _DocEntry] = {}
         self.seq_no = 0
         self.primary_term = 1
+        # seq-ordered (seq_no, doc_id) tail for the CCR changes feed: a
+        # follower poll reads just the ops since its checkpoint instead of
+        # scanning the whole doc table (the reference tails the translog
+        # by seq-no range, LuceneChangesSnapshot). Compacted to the last
+        # OP_LOG_RETAIN entries; older checkpoints fall back to a full scan.
+        self._op_log: list[tuple[int, str]] = []
+        self._op_log_min = 0
         self.data_dir = data_dir
         self._wal = None
         self._dirty = True
@@ -233,6 +240,11 @@ class EsIndex:
                             e.seq_no = rec["seq_no"]
                     idx.seq_no = max(idx.seq_no, rec["seq_no"] + 1)
         idx._wal = open(wal_path, "a", encoding="utf-8")
+        # the op-log tail does not survive restarts: mark everything below
+        # the recovered seq-no as outside the tail so a CCR follower whose
+        # checkpoint predates the restart falls back to the full scan
+        # (returning [] here would read as "caught up" — silent data loss)
+        idx._op_log_min = idx.seq_no
         # recovery refresh: replayed ops are searchable after restart, as
         # after the reference's translog recovery
         idx.refresh()
@@ -285,6 +297,7 @@ class EsIndex:
         src_json = json.dumps(source, separators=(",", ":"))
         source = json.loads(src_json)
         self.docs[doc_id] = _DocEntry(source, version, seq, True)
+        self._op_log_append(seq, doc_id)
         self._pending.add(doc_id)
         self._wal_append({"op": "index", "id": doc_id, "source": source, "version": version, "seq_no": seq})
         if len(self.mappings.fields) != n_fields:
@@ -310,11 +323,45 @@ class EsIndex:
         e.version += 1
         e.seq_no = self.seq_no
         self.seq_no += 1
+        self._op_log_append(e.seq_no, doc_id)
         self._pending.add(doc_id)
         self._wal_append({"op": "delete", "id": doc_id, "version": e.version, "seq_no": e.seq_no})
         self._dirty = True
         self.counters["delete_total"] = self.counters.get("delete_total", 0) + 1
         return {"_id": doc_id, "_version": e.version, "_seq_no": e.seq_no, "result": "deleted"}
+
+    OP_LOG_RETAIN = 100_000
+
+    def _op_log_append(self, seq: int, doc_id: str) -> None:
+        self._op_log.append((seq, doc_id))
+        if len(self._op_log) > 2 * self.OP_LOG_RETAIN:
+            del self._op_log[: -self.OP_LOG_RETAIN]
+            self._op_log_min = self._op_log[0][0]
+
+    def ops_since(self, from_seq_no: int, size: int) -> list[dict] | None:
+        """Seq-ordered ops at/after from_seq_no; None when the tail no
+        longer covers that checkpoint (caller falls back to a full scan).
+        Superseded entries (the doc changed again later) are skipped — the
+        newer op appears later in the feed, and replay is idempotent."""
+        import bisect
+
+        if from_seq_no < self._op_log_min:
+            return None
+        lo = bisect.bisect_left(self._op_log, (from_seq_no, ""))
+        out = []
+        for seq, doc_id in self._op_log[lo:]:
+            e = self.docs.get(doc_id)
+            if e is None or e.seq_no != seq:
+                continue  # superseded
+            if e.alive:
+                out.append({"op": "index", "id": doc_id, "seq_no": seq,
+                            "version": e.version, "source": e.source})
+            else:
+                out.append({"op": "delete", "id": doc_id, "seq_no": seq,
+                            "version": e.version})
+            if len(out) >= size:
+                break
+        return out
 
     def get_doc(self, doc_id: str):
         """Realtime get from the version map (reference behavior:

@@ -274,6 +274,54 @@ def batch_term_disjunction_fast(
     return fv, fids, totals_lb, exact, dropped
 
 
+class _RawChunks:
+    """Unsynchronized per-chunk device outputs of a chunked batch run.
+
+    Deliberately NOT a flat device array: any eager device op issued on
+    not-yet-ready outputs (a concatenate, even a [:Q] slice) acts as a
+    dispatch barrier under remote runtimes — measured to serialize
+    multi-group batches ~6x. Stitching therefore happens host-side in
+    numpy after ONE device_get of everything (tuple(self) or np.asarray
+    via __iter__/resolve)."""
+
+    def __init__(self, chunk_outs: list, Q: int, n_out: int):
+        self.chunk_outs = chunk_outs
+        self.Q = Q
+        self.n_out = n_out
+        self._resolved: tuple | None = None
+
+    def resolve(self) -> tuple:
+        """-> n_out numpy arrays, padding stripped. One device round-trip,
+        memoized (indexed access must not re-fetch everything)."""
+        if self._resolved is None:
+            self._resolved = self.resolve_all([self])[0]
+        return self._resolved
+
+    # iterating (or tuple-unpacking) a result resolves it: keeps the
+    # `v, i, t = bs.run(...)` call sites working unchanged
+    def __iter__(self):
+        return iter(self.resolve())
+
+    def __getitem__(self, j):
+        return self.resolve()[j]
+
+    @staticmethod
+    def resolve_all(raws: list["_RawChunks"]) -> list[tuple]:
+        """Resolve several raw results with a single device round-trip."""
+        host = jax.device_get([r.chunk_outs for r in raws])
+        out = []
+        for r, chunks in zip(raws, host):
+            if len(chunks) == 1:
+                out.append(tuple(
+                    np.asarray(o)[: r.Q] for o in chunks[0][: r.n_out]))
+            else:
+                out.append(tuple(
+                    np.concatenate([np.asarray(c[j]) for c in chunks])[: r.Q]
+                    for j in range(r.n_out)
+                ))
+        return out
+
+
 class BatchTermSearcher:
     """Compiled-plan cache for batched term-disjunction queries against one
     ShardSearcher's device pack."""
@@ -285,10 +333,10 @@ class BatchTermSearcher:
     # what keeps the rerun rate (the expensive path) low
     FAST_M = 2048
     # query-chunk budget: cap the materialized [Qc, N] f32 score matrix.
-    # 4 GB leaves room next to a ~4 GB dense tier + CSR on a 16 GB chip
-    # while halving the number of per-chunk dispatches (each dispatch has
-    # fixed latency; fewer, larger chunks win until HBM pressure)
-    SCORE_BYTES_BUDGET = 1 << 32  # 4 GB
+    # 2 GB => 512-query chunks on a 1M-doc shard — measured to be the
+    # per-chunk sweet spot: doubling the chunk to 1024 made per-chunk time
+    # ~2.7x (superlinear top_k/sort behavior at [1024, N]), a net loss
+    SCORE_BYTES_BUDGET = 1 << 31  # 2 GB
 
     def __init__(self, searcher):
         self.searcher = searcher
@@ -366,13 +414,16 @@ class BatchTermSearcher:
         Constraints (measured on real hardware):
           - the materialized [qc, N] score matrix must stay under
             SCORE_BYTES_BUDGET, so the query axis is chunked;
-          - each host->device transfer pays a fixed latency (~200ms through
-            a tunneled runtime), so the plan ships as ONE transfer per
-            array and chunks are device-side slices;
+          - chunks upload as per-chunk host slices, NOT device-side slices
+            of one big array: any eager device op on a not-yet-ready
+            buffer (a slice included) acts as a dispatch barrier under
+            remote runtimes and serializes the whole batch;
+          - for the same reason the outputs return UNRESOLVED
+            (_RawChunks): no concatenate/[:Q] happens on device — callers
+            stitch host-side after one device_get;
           - a `lax.map` over chunks (single dispatch) was tried and is
             SLOWER: the scan serializes against XLA's inter-dispatch
-            pipelining and compiles 5-10x longer. The per-chunk dispatch
-            loop overlaps chunk i+1's host work with chunk i's compute."""
+            pipelining and compiles 5-10x longer."""
         Q = plan.W.shape[0]
         qc = self._chunk_q(Q)
         pad = (-Q) % qc
@@ -388,16 +439,14 @@ class BatchTermSearcher:
             self._cache[cache_key] = fn
         extras = self._fast_extras(map_key[-1]) if map_key[0] == "fast" else {}
         dev = self.searcher.dev
-        dW, dsr, dsw = jnp.asarray(W), jnp.asarray(sr), jnp.asarray(sw)
         outs = [
-            fn(dev, extras, dW[i : i + qc], dsr[i : i + qc], dsw[i : i + qc])
+            fn(dev, extras,
+               jnp.asarray(W[i : i + qc]),
+               jnp.asarray(sr[i : i + qc]),
+               jnp.asarray(sw[i : i + qc]))
             for i in range(0, Q + pad, qc)
         ]
-        if len(outs) == 1:
-            return tuple(o[:Q] for o in outs[0][:n_out])
-        return tuple(
-            jnp.concatenate([o[j] for o in outs])[:Q] for j in range(n_out)
-        )
+        return _RawChunks(outs, Q, n_out)
 
     def run(self, fld: str, plan: BatchPlan):
         """-> (scores [Q,k], docids [Q,k], totals [Q]) on device (async).
@@ -505,7 +554,10 @@ class BatchTermSearcher:
         )
 
     def search(self, fld: str, queries: list[list[tuple[str, float]]], k: int = 10):
-        return jax.device_get(self.run(fld, self.plan(fld, queries, k)))
+        out = self.run(fld, self.plan(fld, queries, k))
+        if isinstance(out, _RawChunks):
+            return out.resolve()
+        return jax.device_get(out)  # dense-only fused path returns arrays
 
     def plan_bucketed(
         self, fld: str, queries: list[list[tuple[str, float]]], k: int
@@ -597,9 +649,27 @@ class BatchTermSearcher:
                 parts.append((idxs, self.run_fast(fld, plan, bf16=bf16)))
             else:
                 parts.append((idxs, self.run(fld, plan)))
-        # one transfer for every group: each device_get pays a full host
-        # round-trip, so groups are fetched as a single pytree
-        parts = jax.device_get(parts)
+        # resolve every group with ONE device round-trip, and only after
+        # every group was dispatched (no intermediate eager ops: those act
+        # as dispatch barriers under remote runtimes). Plain-array groups
+        # (the dense-only fused path under fast=False) join the same fetch.
+        raws = [p.chunk_outs if isinstance(p, _RawChunks) else p
+                for _, p in parts]
+        host = jax.device_get(raws)
+        merged = []
+        for (idxs, p), h in zip(parts, host):
+            if isinstance(p, _RawChunks):
+                if len(h) == 1:
+                    out = tuple(np.asarray(o)[: p.Q] for o in h[0][: p.n_out])
+                else:
+                    out = tuple(
+                        np.concatenate([np.asarray(c[j]) for c in h])[: p.Q]
+                        for j in range(p.n_out)
+                    )
+            else:
+                out = h
+            merged.append((idxs, out))
+        parts = merged
         for idxs, out in parts:
             kk = out[0].shape[1]
             scores[idxs, :kk] = out[0]
@@ -620,16 +690,20 @@ class BatchTermSearcher:
             # fast-path program family instead of compiling the legacy path
             redo = np.concatenate(pending)
             pending = []
+            rerun_parts = []
             for idxs, plan in self.plan_bucketed(
                 fld, [queries[i] for i in redo], k
             ):
                 C = plan.sparse_rows.shape[1] * plan.sparse_rows.shape[2] * BLOCK
                 M = min(rerun_m, C)
-                ev, ei, et, eok, edrop = jax.device_get(
-                    self.run_fast(fld, plan, bf16=bf16, M=M)
-                )
+                rerun_parts.append(
+                    (idxs, M >= C, self.run_fast(fld, plan, bf16=bf16, M=M)))
+            resolved = _RawChunks.resolve_all([r for _, _, r in rerun_parts])
+            for (idxs, uncut, _), (ev, ei, et, eok, edrop) in zip(
+                rerun_parts, resolved
+            ):
                 ok = eok & ((edrop == 0) | (et >= track_total_hits))
-                if M >= C:
+                if uncut:
                     ok[:] = True
                 done = idxs[ok]
                 scores[redo[done], : ev.shape[1]] = ev[ok]

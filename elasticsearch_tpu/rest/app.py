@@ -51,6 +51,30 @@ async def _warnings_middleware(request: web.Request, handler):
 
 
 @web.middleware
+async def _xcontent_middleware(request: web.Request, handler):
+    """Response content negotiation: Accept: application/yaml|cbor (or
+    ?format=) re-encodes the JSON payload in the requested x-content
+    format (XContentType negotiation; SMILE is a documented divergence)."""
+    resp = await handler(request)
+    want = (request.query.get("format") or "").lower()
+    if not want:
+        accept = (request.headers.get("Accept") or "").split(";")[0].strip().lower()
+        want = {"application/yaml": "yaml", "text/yaml": "yaml",
+                "application/cbor": "cbor"}.get(accept, "")
+    if want in ("yaml", "cbor") and resp.content_type == "application/json" \
+            and getattr(resp, "body", None):
+        from ..utils.xcontent import dumps as xdumps
+
+        payload, ctype = xdumps(json.loads(resp.body), want)
+        return web.Response(body=payload, status=resp.status,
+                            content_type=ctype, headers={
+                                k: v for k, v in resp.headers.items()
+                                if k.lower() not in ("content-type",
+                                                     "content-length")})
+    return resp
+
+
+@web.middleware
 async def _security_middleware(request: web.Request, handler):
     engine = request.app["engine"]
     sec = engine.security
@@ -77,7 +101,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     engine = engine or Engine(data_path)
     app = web.Application(
         client_max_size=512 * 1024 * 1024,
-        middlewares=[_warnings_middleware, _security_middleware],
+        middlewares=[_xcontent_middleware, _warnings_middleware,
+                     _security_middleware],
     )
     app["engine"] = engine
     # single-thread executor: serializes engine mutation, keeps the loop free
@@ -104,7 +129,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         raw = await request.read()
         if not raw:
             return default
-        return json.loads(raw)
+        from ..utils.xcontent import loads as xloads
+
+        return xloads(raw, request.headers.get("Content-Type"))
 
     # ---- root / info -----------------------------------------------------
 
@@ -1555,6 +1582,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 **kwargs,
             )
         took = int((time.monotonic() - t0) * 1000)
+        from ..telemetry import metrics as _metrics
+
+        _metrics.counter_inc("es.search.query.total")
+        _metrics.histogram_record("es.search.query.took_ms", took)
         from ..search import apply_fetch_phase
 
         # fetch options given as URL params (the reference accepts both)
@@ -2080,6 +2111,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def nodes_stats(request):
         import jax
 
+        from ..telemetry import metrics
+
         devices = [str(d) for d in jax.devices()]
         total_docs = sum(i.live_count for i in engine.indices.values())
         return web.json_response(
@@ -2093,6 +2126,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         "indices": {"docs": {"count": total_docs}},
                         "breakers": engine.breakers.stats(),
                         "tpu": {"devices": devices},
+                        "metrics": metrics.snapshot(),
                     }
                 },
             }

@@ -140,3 +140,97 @@ def drain_request_warnings() -> list[str]:
 def warning_header_value(message: str) -> str:
     # RFC 7234 warn-code 299 (miscellaneous persistent warning), as ES emits
     return f'299 Elasticsearch-tpu "{message}"'
+
+
+# ---------------------------------------------------------------------------
+# metrics registry (APM metering analog)
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Named counters / gauges / histograms with a snapshot API.
+
+    The reference exposes a metering surface plugins and core register
+    instruments on (reference behavior: server/.../telemetry/metric/
+    MeterRegistry — LongCounter, DoubleGauge, LongHistogram), surfaced
+    through the APM module. Here the registry is in-process and its
+    snapshot feeds the _nodes/stats metrics section."""
+
+    def __init__(self):
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, object] = {}  # name -> callable or value
+        self._histograms: dict[str, list] = {}
+
+    # -- instruments -------------------------------------------------------
+
+    def counter_inc(self, name: str, value: float = 1.0) -> None:
+        self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge_set(self, name: str, value) -> None:
+        """value: a number, or a zero-arg callable sampled at snapshot."""
+        self._gauges[name] = value
+
+    def histogram_record(self, name: str, value: float) -> None:
+        h = self._histograms.setdefault(
+            name, [0, 0.0, float("inf"), float("-inf")])
+        h[0] += 1
+        h[1] += value
+        h[2] = min(h[2], value)
+        h[3] = max(h[3], value)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        gauges = {}
+        for name, v in self._gauges.items():
+            try:
+                gauges[name] = v() if callable(v) else v
+            except Exception:  # a failing gauge must not break stats
+                gauges[name] = None
+        return {
+            "counters": dict(self._counters),
+            "gauges": gauges,
+            "histograms": {
+                name: {"count": h[0], "sum": h[1],
+                       "min": (h[2] if h[0] else 0.0),
+                       "max": (h[3] if h[0] else 0.0),
+                       "avg": (h[1] / h[0] if h[0] else 0.0)}
+                for name, h in self._histograms.items()
+            },
+        }
+
+
+metrics = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# structured (JSON-lines) logging
+# ---------------------------------------------------------------------------
+
+def enable_json_logging(stream=None) -> None:
+    """Switch the root logger to ECS-shaped JSON lines (the reference logs
+    ECS JSON via ecs-logging, config/log4j2.properties)."""
+    import json as _json
+    import logging
+    import time as _time
+
+    class _JsonFormatter(logging.Formatter):
+        def format(self, record):
+            doc = {
+                "@timestamp": _time.strftime(
+                    "%Y-%m-%dT%H:%M:%S", _time.gmtime(record.created))
+                + f".{int(record.msecs):03d}Z",
+                "log.level": record.levelname,
+                "log.logger": record.name,
+                "message": record.getMessage(),
+                "ecs.version": "1.2.0",
+            }
+            if record.exc_info:
+                doc["error.stack_trace"] = self.formatException(record.exc_info)
+            return _json.dumps(doc)
+
+    import sys as _sys
+
+    h = logging.StreamHandler(stream or _sys.stdout)
+    h.setFormatter(_JsonFormatter())
+    root = logging.getLogger()
+    root.handlers = [h]

@@ -1,0 +1,198 @@
+"""x-content: multi-format request/response bodies (JSON, YAML, CBOR).
+
+The reference abstracts content over pluggable binary/text formats
+(reference behavior: libs/x-content XContentType — JSON, SMILE, YAML,
+CBOR — negotiated from Content-Type/Accept). Here JSON is the native
+form, YAML rides PyYAML, and CBOR is a self-contained RFC 8949 codec for
+the JSON data model (ints, floats, text, arrays, maps, bool/null —
+exactly the subset the reference round-trips through maps). SMILE is a
+documented divergence (Jackson-proprietary; negotiating it returns 406).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+from .errors import IllegalArgumentError
+
+
+# ---------------------------------------------------------------------------
+# CBOR (RFC 8949), JSON data model subset
+# ---------------------------------------------------------------------------
+
+def _cbor_head(major: int, arg: int) -> bytes:
+    if arg < 24:
+        return bytes([(major << 5) | arg])
+    if arg < 1 << 8:
+        return bytes([(major << 5) | 24, arg])
+    if arg < 1 << 16:
+        return bytes([(major << 5) | 25]) + arg.to_bytes(2, "big")
+    if arg < 1 << 32:
+        return bytes([(major << 5) | 26]) + arg.to_bytes(4, "big")
+    return bytes([(major << 5) | 27]) + arg.to_bytes(8, "big")
+
+
+def cbor_dumps(obj) -> bytes:
+    out = bytearray()
+
+    def enc(v):
+        if v is None:
+            out.append(0xF6)
+        elif v is True:
+            out.append(0xF5)
+        elif v is False:
+            out.append(0xF4)
+        elif isinstance(v, int):
+            if v >= 0:
+                out.extend(_cbor_head(0, v))
+            else:
+                out.extend(_cbor_head(1, -1 - v))
+        elif isinstance(v, float):
+            out.append(0xFB)
+            out.extend(struct.pack(">d", v))
+        elif isinstance(v, str):
+            b = v.encode()
+            out.extend(_cbor_head(3, len(b)))
+            out.extend(b)
+        elif isinstance(v, bytes):
+            out.extend(_cbor_head(2, len(v)))
+            out.extend(v)
+        elif isinstance(v, (list, tuple)):
+            out.extend(_cbor_head(4, len(v)))
+            for x in v:
+                enc(x)
+        elif isinstance(v, dict):
+            out.extend(_cbor_head(5, len(v)))
+            for k, x in v.items():
+                enc(str(k))
+                enc(x)
+        else:
+            raise IllegalArgumentError(f"cannot encode {type(v).__name__} as CBOR")
+
+    enc(obj)
+    return bytes(out)
+
+
+def cbor_loads(data: bytes):
+    pos = 0
+
+    def need(n):
+        nonlocal pos
+        if pos + n > len(data):
+            raise IllegalArgumentError("truncated CBOR input")
+        chunk = data[pos : pos + n]
+        pos += n
+        return chunk
+
+    def arg(ib):
+        low = ib & 0x1F
+        if low < 24:
+            return low
+        if low == 24:
+            return need(1)[0]
+        if low == 25:
+            return int.from_bytes(need(2), "big")
+        if low == 26:
+            return int.from_bytes(need(4), "big")
+        if low == 27:
+            return int.from_bytes(need(8), "big")
+        raise IllegalArgumentError("indefinite-length CBOR is not supported")
+
+    def dec():
+        ib = need(1)[0]
+        major = ib >> 5
+        if major == 0:
+            return arg(ib)
+        if major == 1:
+            return -1 - arg(ib)
+        if major == 2:
+            return bytes(need(arg(ib)))
+        if major == 3:
+            return need(arg(ib)).decode()
+        if major == 4:
+            return [dec() for _ in range(arg(ib))]
+        if major == 5:
+            return {dec(): dec() for _ in range(arg(ib))}
+        if major == 6:  # tags: decode and ignore the tag
+            arg(ib)
+            return dec()
+        # major 7: simple values / floats
+        low = ib & 0x1F
+        if low == 20:
+            return False
+        if low == 21:
+            return True
+        if low in (22, 23):
+            return None
+        if low == 25:  # half float
+            h = int.from_bytes(need(2), "big")
+            return _half_to_float(h)
+        if low == 26:
+            return struct.unpack(">f", need(4))[0]
+        if low == 27:
+            return struct.unpack(">d", need(8))[0]
+        raise IllegalArgumentError(f"unsupported CBOR simple value [{low}]")
+
+    v = dec()
+    if pos != len(data):
+        raise IllegalArgumentError("trailing bytes after CBOR value")
+    return v
+
+
+def _half_to_float(h: int) -> float:
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0**-24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac / 1024.0) * 2.0 ** (exp - 15)
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+
+TYPES = {
+    "application/json": "json",
+    "application/yaml": "yaml",
+    "text/yaml": "yaml",
+    "application/cbor": "cbor",
+    "application/x-ndjson": "json",  # per-line handling stays with callers
+}
+
+
+def content_format(content_type: str | None) -> str:
+    if not content_type:
+        return "json"
+    base = content_type.split(";")[0].strip().lower()
+    if base in ("application/smile", "application/x-jackson-smile"):
+        raise IllegalArgumentError(
+            "SMILE content is not supported by this implementation")
+    return TYPES.get(base, "json")
+
+
+def loads(data: bytes, content_type: str | None):
+    fmt = content_format(content_type)
+    if not data:
+        return None
+    if fmt == "cbor":
+        return cbor_loads(data)
+    if fmt == "yaml":
+        import yaml
+
+        return yaml.safe_load(data.decode())
+    return json.loads(data)
+
+
+def dumps(obj, fmt: str) -> tuple[bytes, str]:
+    """-> (payload, content_type)."""
+    if fmt == "cbor":
+        return cbor_dumps(obj), "application/cbor"
+    if fmt == "yaml":
+        import yaml
+
+        return yaml.safe_dump(obj, sort_keys=False).encode(), "application/yaml"
+    return json.dumps(obj).encode(), "application/json"

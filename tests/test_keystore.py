@@ -1,0 +1,60 @@
+"""Keystore CLI + SecureSettings (KeyStoreWrapper / keystore-cli analogs)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from elasticsearch_tpu.cli.keystore import Keystore, main
+
+
+def test_keystore_roundtrip_and_integrity(tmp_path):
+    path = str(tmp_path / "es.keystore")
+    ks = Keystore(path)
+    ks.entries["s3.client.default.secret_key"] = "hunter2"
+    ks.save()
+    got = Keystore.load(path)
+    assert got.get("s3.client.default.secret_key") == "hunter2"
+    assert got.get("missing", "dflt") == "dflt"
+    # tamper -> integrity failure
+    raw = open(path).read().replace("\"data\": \"", "\"data\": \"00", 1)
+    open(path, "w").write(raw)
+    with pytest.raises(ValueError):
+        Keystore.load(path)
+
+
+def test_keystore_password_protection(tmp_path):
+    path = str(tmp_path / "es.keystore")
+    ks = Keystore(path)
+    ks.set_password(b"sekrit")
+    ks.entries["x"] = "y"
+    ks.save()
+    with pytest.raises(ValueError):
+        Keystore.load(path)  # no password
+    assert Keystore.load(path, b"sekrit").get("x") == "y"
+
+
+def test_cli_create_add_list_remove(tmp_path, capsys, monkeypatch):
+    path = str(tmp_path / "ks")
+    main(["create", "--path", path])
+    monkeypatch.setattr("sys.stdin", __import__("io").StringIO("value-1\n"))
+    main(["add", "cloud.token", "--path", path, "--stdin"])
+    main(["list", "--path", path])
+    out = capsys.readouterr().out
+    assert "cloud.token" in out
+    main(["show", "cloud.token", "--path", path])
+    assert "value-1" in capsys.readouterr().out
+    main(["remove", "cloud.token", "--path", path])
+    main(["list", "--path", path])
+    assert "cloud.token" not in capsys.readouterr().out.splitlines()[-1:]
+
+
+def test_cli_module_entrypoint(tmp_path):
+    path = str(tmp_path / "ks2")
+    r = subprocess.run(
+        [sys.executable, "-m", "elasticsearch_tpu.cli.keystore",
+         "create", "--path", path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "Created elasticsearch keystore" in r.stdout

@@ -75,3 +75,43 @@ async def _legacy_drive():
 
 def test_legacy_templates_with_deprecation_header():
     asyncio.run(_legacy_drive())
+
+
+def test_metrics_registry_snapshot():
+    from elasticsearch_tpu.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+    m.counter_inc("ops")
+    m.counter_inc("ops", 2)
+    m.gauge_set("static", 7)
+    m.gauge_set("sampled", lambda: 42)
+    m.gauge_set("broken", lambda: 1 / 0)
+    for v in (1.0, 3.0):
+        m.histogram_record("lat", v)
+    snap = m.snapshot()
+    assert snap["counters"]["ops"] == 3
+    assert snap["gauges"] == {"static": 7, "sampled": 42, "broken": None}
+    assert snap["histograms"]["lat"] == {
+        "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0, "avg": 2.0}
+
+
+def test_json_logging(capsys):
+    import json
+    import io
+    import logging
+
+    from elasticsearch_tpu.telemetry import enable_json_logging
+
+    buf = io.StringIO()
+    old_handlers = logging.getLogger().handlers[:]
+    try:
+        enable_json_logging(stream=buf)
+        logging.getLogger("es.test").warning("shard %s failed", 3)
+        line = buf.getvalue().strip().splitlines()[-1]
+        doc = json.loads(line)
+        assert doc["log.level"] == "WARNING"
+        assert doc["log.logger"] == "es.test"
+        assert doc["message"] == "shard 3 failed"
+        assert doc["@timestamp"].endswith("Z")
+    finally:
+        logging.getLogger().handlers = old_handlers

@@ -1,0 +1,79 @@
+"""x-content formats: CBOR codec round-trips + REST content negotiation.
+
+Reference behavior: libs/x-content XContentType (JSON/YAML/CBOR; SMILE is
+a documented divergence) negotiated from Content-Type and Accept.
+"""
+
+import asyncio
+import math
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.rest import make_app
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.utils.xcontent import cbor_dumps, cbor_loads, loads
+
+
+def test_cbor_roundtrip():
+    cases = [
+        None, True, False, 0, 23, 24, 255, 256, 65536, 2**32, -1, -25, -70000,
+        1.5, -2.25, "", "héllo", [], [1, [2, "x"], None],
+        {"a": 1, "b": {"c": [True, 2.5]}, "": "empty-key"},
+    ]
+    for v in cases:
+        assert cbor_loads(cbor_dumps(v)) == v
+    assert math.isclose(cbor_loads(cbor_dumps(3.14159)), 3.14159)
+
+
+def test_cbor_rejects_garbage():
+    with pytest.raises(IllegalArgumentError):
+        cbor_loads(b"\x19\x01")  # truncated
+    with pytest.raises(IllegalArgumentError):
+        cbor_loads(cbor_dumps({"a": 1}) + b"\x00")  # trailing
+
+
+def test_loads_negotiation():
+    assert loads(b'{"a": 1}', "application/json") == {"a": 1}
+    assert loads(b"a: 1\n", "application/yaml") == {"a": 1}
+    assert loads(cbor_dumps({"a": 1}), "application/cbor") == {"a": 1}
+    with pytest.raises(IllegalArgumentError):
+        loads(b"x", "application/smile")
+
+
+def test_rest_yaml_and_cbor():
+    async def scenario():
+        app = make_app()
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            # YAML request body
+            r = await c.put("/x", data="mappings:\n  properties:\n    f: {type: keyword}\n",
+                            headers={"Content-Type": "application/yaml"})
+            assert r.status == 200, await r.text()
+            # CBOR request body
+            r = await c.put("/x/_doc/1?refresh=true",
+                            data=cbor_dumps({"f": "v"}),
+                            headers={"Content-Type": "application/cbor"})
+            assert r.status == 201, await r.text()
+            # YAML response via Accept
+            r = await c.get("/x/_doc/1", headers={"Accept": "application/yaml"})
+            assert r.headers["Content-Type"].startswith("application/yaml")
+            import yaml
+
+            doc = yaml.safe_load(await r.text())
+            assert doc["_source"] == {"f": "v"}
+            # CBOR response via ?format=
+            r = await c.post("/x/_search?format=cbor",
+                             json={"query": {"term": {"f": "v"}}})
+            assert r.headers["Content-Type"].startswith("application/cbor")
+            body = cbor_loads(await r.read())
+            assert body["hits"]["total"]["value"] == 1
+        finally:
+            await c.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
