@@ -140,17 +140,12 @@ def main(argv=None):
         print(f"Created elasticsearch keystore in {path}")
         return
 
-    password = b""
-    try:
-        ks = Keystore.load(path, password)
-    except FileNotFoundError:
+    if not os.path.exists(path):
         print(f"ERROR: Elasticsearch keystore not found at [{path}]. "
               "Use 'create' command to create one.", file=sys.stderr)
         sys.exit(65)
-    except ValueError:
-        ks = Keystore.load(path, read_password())
-
     if args.command == "has-passwd":
+        # never prompts: probing with the empty password answers the question
         protected = False
         try:
             Keystore.load(path, b"")
@@ -159,6 +154,15 @@ def main(argv=None):
         print("Keystore is" + ("" if protected else " NOT") +
               " password-protected")
         sys.exit(0 if protected else 1)
+    try:
+        ks = Keystore.load(path, b"")
+    except ValueError:
+        try:
+            ks = Keystore.load(path, read_password())
+        except ValueError:
+            print("ERROR: Provided keystore password was incorrect",
+                  file=sys.stderr)
+            sys.exit(65)
     if args.command == "list":
         for name in sorted(ks.entries):
             print(name)

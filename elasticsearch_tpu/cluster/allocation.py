@@ -39,6 +39,63 @@ def _node_load(state: ClusterState) -> dict[str, int]:
     return load
 
 
+# max concurrent incoming INITIALIZING recoveries per node (the analog of
+# cluster.routing.allocation.node_concurrent_incoming_recoveries)
+NODE_CONCURRENT_RECOVERIES = 4
+
+
+def _node_attrs(state: ClusterState, node: str) -> dict:
+    info = state.nodes.get(node, {})
+    return {"_name": node, "_id": node, **(info.get("attributes") or {})}
+
+
+def _matches(patterns: str, value: str) -> bool:
+    import fnmatch
+
+    return any(fnmatch.fnmatchcase(value, p.strip())
+               for p in str(patterns).split(",") if p.strip())
+
+
+def can_allocate(state: ClusterState, meta: dict, node: str,
+                 assigns: list, node_shard_counts: dict[str, int],
+                 node_initializing: dict[str, int],
+                 is_recovery: bool = True) -> bool:
+    """Decider chain: every decider must say yes (the reference runs 21
+    deciders under AllocationDeciders.java; these are the behavioral core):
+      - SameShardAllocationDecider: one copy of a shard per node
+      - FilterAllocationDecider: index.routing.allocation.require/include/
+        exclude.{_name,_id,custom attr} against node attributes
+      - ShardsLimitAllocationDecider: index.routing.allocation.total_shards_per_node
+      - ThrottlingAllocationDecider: cap concurrent incoming recoveries
+    """
+    if any(a["node"] == node for a in assigns):
+        return False  # same-shard
+    settings = meta.get("settings", {})
+    attrs = _node_attrs(state, node)
+    for key, val in settings.items():
+        if not isinstance(key, str) or not key.startswith("index.routing.allocation."):
+            continue
+        parts = key.split(".")
+        if len(parts) < 5:
+            continue
+        kind, attr = parts[3], ".".join(parts[4:])
+        have = attrs.get(attr)
+        if kind == "require" and (have is None or not _matches(val, str(have))):
+            return False
+        if kind == "include" and (have is None or not _matches(val, str(have))):
+            return False
+        if kind == "exclude" and have is not None and _matches(val, str(have)):
+            return False
+    limit = settings.get("index.routing.allocation.total_shards_per_node")
+    if limit is not None and node_shard_counts.get(node, 0) >= int(limit):
+        return False
+    # throttling applies to actual recoveries only: a brand-new empty
+    # primary is placed STARTED with no data transfer
+    if is_recovery and node_initializing.get(node, 0) >= NODE_CONCURRENT_RECOVERIES:
+        return False
+    return True
+
+
 def allocate(state: ClusterState) -> ClusterState:
     """Recompute assignments: drop dead nodes, promote in-sync replicas to
     primary (bumping the primary term), backfill missing replicas as
@@ -46,6 +103,14 @@ def allocate(state: ClusterState) -> ClusterState:
     unchanged)."""
     live = set(data_nodes(state))
     load = _node_load(state)
+    # concurrent incoming recoveries per node (ThrottlingAllocationDecider)
+    node_initializing: dict[str, int] = {}
+    for shards in state.routing.values():
+        for assigns_ in shards.values():
+            for a in assigns_:
+                if a["state"] == "INITIALIZING":
+                    node_initializing[a["node"]] = (
+                        node_initializing.get(a["node"], 0) + 1)
     new_indices = {}
     new_routing = {}
     changed = False
@@ -62,6 +127,12 @@ def allocate(state: ClusterState) -> ClusterState:
         def next_alloc_id() -> str:
             meta["alloc_counter"] = meta.get("alloc_counter", 0) + 1
             return f"{index}-a{meta['alloc_counter']}"
+
+        # this index's shard count per node (ShardsLimitAllocationDecider)
+        index_counts: dict[str, int] = {}
+        for assigns_ in routing.values():
+            for a in assigns_:
+                index_counts[a["node"]] = index_counts.get(a["node"], 0) + 1
 
         for s in range(n_shards):
             key = str(s)
@@ -85,8 +156,14 @@ def allocate(state: ClusterState) -> ClusterState:
                 elif not assigns and not in_sync[key]:
                     # brand-new shard: place an empty primary, immediately
                     # started and in-sync
-                    if load:
-                        node = min(load, key=lambda n: (load[n], n))
+                    eligible = {
+                        n: load[n] for n in load
+                        if can_allocate(state, meta, n, assigns,
+                                        index_counts, node_initializing,
+                                        is_recovery=False)
+                    }
+                    if eligible:
+                        node = min(eligible, key=lambda n: (eligible[n], n))
                         aid = next_alloc_id()
                         assigns = [
                             {"node": node, "primary": True, "state": "STARTED",
@@ -94,6 +171,7 @@ def allocate(state: ClusterState) -> ClusterState:
                         ]
                         in_sync[key] = [aid]
                         load[node] += 1
+                        index_counts[node] = index_counts.get(node, 0) + 1
                         changed = True
                 # else: red shard — every in-sync copy is gone; stay
                 # unassigned rather than silently lose acked writes
@@ -104,12 +182,14 @@ def allocate(state: ClusterState) -> ClusterState:
             has_started_primary = any(
                 a["primary"] and a["state"] == "STARTED" for a in assigns
             )
-            while (
-                has_started_primary
-                and n_live_replicas < n_replicas
-                and (live - occupied)
-            ):
-                free = {n: load[n] for n in live - occupied}
+            while has_started_primary and n_live_replicas < n_replicas:
+                free = {
+                    n: load[n] for n in live - occupied
+                    if can_allocate(state, meta, n, assigns,
+                                    index_counts, node_initializing)
+                }
+                if not free:
+                    break  # deciders reject every remaining node
                 node = min(free, key=lambda n: (free[n], n))
                 assigns.append(
                     {"node": node, "primary": False, "state": "INITIALIZING",
@@ -117,6 +197,8 @@ def allocate(state: ClusterState) -> ClusterState:
                 )
                 occupied.add(node)
                 load[node] += 1
+                index_counts[node] = index_counts.get(node, 0) + 1
+                node_initializing[node] = node_initializing.get(node, 0) + 1
                 n_live_replicas += 1
                 changed = True
             # prune in-sync ids whose assignment is gone AND that are not the

@@ -406,6 +406,13 @@ class Coordinator:
         assert self._publication is None, "publication already in flight"
         pub = _Publication(state, {self.node_id}, False, on_done)
         self._publication = pub
+        # a follower in steady state has accepted exactly the previous
+        # state, so publish the diff against it; a peer that answers
+        # need_full (restarted, disrupted) gets the full state re-sent
+        # (reference behavior: PublicationTransportHandler serializes a
+        # diff per node with the previous state, full otherwise)
+        prev = self.cs.last_accepted
+        diff_wire = state.diff_from(prev) if prev.version else None
         # self-accept through the same safety core
         ok = self.cs.handle_publish(state)
         self._persist()
@@ -413,17 +420,23 @@ class Coordinator:
             self._publication = None
             on_done(False, "rejected locally")
             return
-        wire = state.to_dict()
+        full_wire = state.to_dict()
         targets = set(state.nodes) | set(self.cs.voting_nodes)
         targets.discard(self.node_id)
 
-        def on_ack(peer):
+        def on_ack(peer, was_diff):
             def cb(resp):
                 if self._publication is not pub:
                     return
                 if resp.get("accepted"):
                     pub.acked.add(peer)
                     self._maybe_commit(pub)
+                elif resp.get("need_full") and was_diff:
+                    self.service.send_request(
+                        peer, PUBLISH, {"state": full_wire},
+                        on_ack(peer, False), lambda e: None,
+                        timeout=self.PUBLISH_TIMEOUT,
+                    )
                 elif resp.get("term", 0) > self.cs.current_term:
                     self.cs.current_term = resp["term"]
                     self._publication = None
@@ -432,10 +445,16 @@ class Coordinator:
             return cb
 
         for p in sorted(targets):
-            self.service.send_request(
-                p, PUBLISH, {"state": wire}, on_ack(p), lambda e: None,
-                timeout=self.PUBLISH_TIMEOUT,
-            )
+            if diff_wire is not None:
+                self.service.send_request(
+                    p, PUBLISH, {"diff": diff_wire}, on_ack(p, True),
+                    lambda e: None, timeout=self.PUBLISH_TIMEOUT,
+                )
+            else:
+                self.service.send_request(
+                    p, PUBLISH, {"state": full_wire}, on_ack(p, False),
+                    lambda e: None, timeout=self.PUBLISH_TIMEOUT,
+                )
         self._maybe_commit(pub)
         # timeout the publication as a whole
         def timeout():
@@ -467,7 +486,17 @@ class Coordinator:
         self._drain_tasks()
 
     def _on_publish(self, req, from_node):
-        state = ClusterState.from_dict(req["state"])
+        if "diff" in req:
+            d = req["diff"]
+            la = self.cs.last_accepted
+            if (la.term, la.version) != (d["base_term"], d["base_version"]):
+                # not at the diff's base (restarted / missed a round):
+                # ask for the full state
+                return {"accepted": False, "need_full": True,
+                        "term": self.cs.current_term}
+            state = la.apply_diff(d)
+        else:
+            state = ClusterState.from_dict(req["state"])
         accepted = self.cs.handle_publish(state)
         self._persist()  # accepted state durable before the ack leaves
         if accepted:
@@ -501,27 +530,49 @@ class Coordinator:
         self._drain_tasks()
 
     def _drain_tasks(self):
+        """Execute EVERY queued task against one base state and publish the
+        combined result as a single cluster-state version — the reference's
+        MasterService task batching (MasterService.java:204 batched
+        executors): under a burst of shard-started/failed events the
+        cluster converges in one publication instead of N."""
         if self.mode != LEADER or self._publication is not None or not self._pending_tasks:
             return
-        desc, update, on_done = self._pending_tasks.pop(0)
+        batch, self._pending_tasks = self._pending_tasks, []
         base = self.cs.last_accepted
-        try:
-            new_state = update(base)
-            if new_state is not None and new_state is not base:
+        state = base
+        results: list[tuple[Callable, bool, str]] = []
+        for desc, update, on_done in batch:
+            try:
+                out = update(state)
+                if out is not None and out is not state:
+                    state = out
+                results.append((on_done, True, "committed"))
+            except Exception as ex:
+                results.append((on_done, False, f"update failed: {ex!r}"))
+        if state is not base:
+            try:
                 for rec in self.reconcilers:
-                    new_state = rec(new_state)
-        except Exception as ex:
-            on_done(False, f"update failed: {ex!r}")
-            self.network.schedule(0, self._drain_tasks)
+                    state = rec(state)
+            except Exception as ex:
+                for on_done, ok, _why in results:
+                    on_done(False, f"reconcile failed: {ex!r}")
+                return
+        if state is base:
+            for on_done, ok, why in results:
+                on_done(ok, "no change" if ok else why)
             return
-        if new_state is base or new_state is None:
-            on_done(True, "no change")
-            self.network.schedule(0, self._drain_tasks)
-            return
-        new_state = new_state.with_master(
+        state = state.with_master(
             self.cs.current_term, base.version + 1, self.node_id
         )
-        self._publish(new_state, on_done)
+
+        def fan_done(ok: bool, why: str):
+            for on_done, task_ok, task_why in results:
+                if not task_ok:
+                    on_done(False, task_why)
+                else:
+                    on_done(ok, why)
+
+        self._publish(state, fan_done)
 
     # -- failure detection -------------------------------------------------
 

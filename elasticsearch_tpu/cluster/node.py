@@ -56,13 +56,15 @@ class ClusterNode:
     SEARCH_TIMEOUT = 60.0
 
     def __init__(self, node_id: str, voting_nodes: list[str], network,
-                 roles: list[str] | None = None, data_path: str | None = None):
+                 roles: list[str] | None = None, data_path: str | None = None,
+                 attributes: dict | None = None):
         self.node_id = node_id
         self.network = network
         self.service = TransportService(node_id, network)
         self.coordinator = Coordinator(
             node_id, voting_nodes, self.service, network,
-            node_info={"roles": roles or ["master", "data"]},
+            node_info={"roles": roles or ["master", "data"],
+                       "attributes": attributes or {}},
             persist_path=(data_path + "/_state") if data_path else None,
         )
         self.last_recovery_mode: str | None = None  # instrumentation

@@ -3,10 +3,11 @@
 The reference's ClusterState is an immutable, versioned value replicated from
 the elected master to every node (reference behavior: cluster/ClusterState.java,
 published via cluster/coordination/PublicationTransportHandler.java). Here it
-is a frozen value object with copy-on-write `with_*` helpers and a dict wire
-form. Full-state publication only — the reference's diff machinery is an
-optimization this framework does not need at its cluster sizes (documented
-simplification of cluster/ClusterState.java Diff support).
+is a frozen value object with copy-on-write `with_*` helpers, a dict wire
+form, and per-key section diffs (diff_from/apply_diff) that steady-state
+publications ship instead of the full state — a stale follower answers
+need_full and gets the complete state, like the reference's
+PublicationTransportHandler diff/full split.
 """
 
 from __future__ import annotations
@@ -101,6 +102,44 @@ class ClusterState:
             for a in self.routing.get(index, {}).get(str(shard), [])
             if not a["primary"]
         ]
+
+    # -- diffs -------------------------------------------------------------
+
+    def diff_from(self, base: "ClusterState") -> dict:
+        """Wire diff against `base`: per-key set/del for each top-level
+        section (reference behavior: ClusterState.diff /
+        PublicationTransportHandler serializing diffs to nodes that have
+        the previous state)."""
+        out = {
+            "base_term": base.term,
+            "base_version": base.version,
+            "term": self.term,
+            "version": self.version,
+            "master_id": self.master_id,
+        }
+        for sect in ("nodes", "indices", "routing"):
+            mine, theirs = getattr(self, sect), getattr(base, sect)
+            out[sect] = {
+                "set": {k: copy.deepcopy(v) for k, v in mine.items()
+                        if k not in theirs or theirs[k] != v},
+                "del": [k for k in theirs if k not in mine],
+            }
+        return out
+
+    def apply_diff(self, d: dict) -> "ClusterState":
+        """-> the successor state; caller must have checked this state IS
+        the diff's base (term+version equality)."""
+        sections = {}
+        for sect in ("nodes", "indices", "routing"):
+            cur = dict(getattr(self, sect))
+            for k in d[sect]["del"]:
+                cur.pop(k, None)
+            cur.update(copy.deepcopy(d[sect]["set"]))
+            sections[sect] = cur
+        return ClusterState(
+            term=d["term"], version=d["version"], master_id=d["master_id"],
+            **sections,
+        )
 
     # -- wire --------------------------------------------------------------
 

@@ -306,20 +306,24 @@ class _RawChunks:
         return self.resolve()[j]
 
     @staticmethod
+    def stitch(chunks: list, Q: int, n_out: int) -> tuple:
+        """Host-side assembly of fetched chunk outputs: concat + strip
+        the tail padding. THE single copy of this contract."""
+        if len(chunks) == 1:
+            return tuple(np.asarray(o)[:Q] for o in chunks[0][:n_out])
+        return tuple(
+            np.concatenate([np.asarray(c[j]) for c in chunks])[:Q]
+            for j in range(n_out)
+        )
+
+    @staticmethod
     def resolve_all(raws: list["_RawChunks"]) -> list[tuple]:
         """Resolve several raw results with a single device round-trip."""
         host = jax.device_get([r.chunk_outs for r in raws])
-        out = []
-        for r, chunks in zip(raws, host):
-            if len(chunks) == 1:
-                out.append(tuple(
-                    np.asarray(o)[: r.Q] for o in chunks[0][: r.n_out]))
-            else:
-                out.append(tuple(
-                    np.concatenate([np.asarray(c[j]) for c in chunks])[: r.Q]
-                    for j in range(r.n_out)
-                ))
-        return out
+        return [
+            _RawChunks.stitch(chunks, r.Q, r.n_out)
+            for r, chunks in zip(raws, host)
+        ]
 
 
 class BatchTermSearcher:
@@ -656,20 +660,11 @@ class BatchTermSearcher:
         raws = [p.chunk_outs if isinstance(p, _RawChunks) else p
                 for _, p in parts]
         host = jax.device_get(raws)
-        merged = []
-        for (idxs, p), h in zip(parts, host):
-            if isinstance(p, _RawChunks):
-                if len(h) == 1:
-                    out = tuple(np.asarray(o)[: p.Q] for o in h[0][: p.n_out])
-                else:
-                    out = tuple(
-                        np.concatenate([np.asarray(c[j]) for c in h])[: p.Q]
-                        for j in range(p.n_out)
-                    )
-            else:
-                out = h
-            merged.append((idxs, out))
-        parts = merged
+        parts = [
+            (idxs, _RawChunks.stitch(h, p.Q, p.n_out)
+             if isinstance(p, _RawChunks) else h)
+            for (idxs, p), h in zip(parts, host)
+        ]
         for idxs, out in parts:
             kk = out[0].shape[1]
             scores[idxs, :kk] = out[0]

@@ -14,7 +14,13 @@ def main(argv=None):
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=9200)
     parser.add_argument("--data-path", default=None, help="durable data directory (WAL, meta)")
+    parser.add_argument("--json-logs", action="store_true",
+                        help="ECS-shaped JSON-lines logging")
     args = parser.parse_args(argv)
+    if args.json_logs:
+        from ..telemetry import enable_json_logging
+
+        enable_json_logging()
     from ..utils.jax_env import enable_compile_cache
 
     enable_compile_cache()

@@ -234,3 +234,4 @@ def enable_json_logging(stream=None) -> None:
     h.setFormatter(_JsonFormatter())
     root = logging.getLogger()
     root.handlers = [h]
+    root.setLevel(logging.INFO)

@@ -6,7 +6,8 @@ CBOR — negotiated from Content-Type/Accept). Here JSON is the native
 form, YAML rides PyYAML, and CBOR is a self-contained RFC 8949 codec for
 the JSON data model (ints, floats, text, arrays, maps, bool/null —
 exactly the subset the reference round-trips through maps). SMILE is a
-documented divergence (Jackson-proprietary; negotiating it returns 406).
+documented divergence (Jackson-proprietary): a SMILE request body fails
+with a clear 400, and Accept: application/smile falls back to JSON.
 """
 
 from __future__ import annotations

@@ -1,0 +1,156 @@
+"""Allocation deciders + batched master task queue.
+
+Reference behaviors: cluster/routing/allocation/decider/* (filter,
+same-shard, shards-limit, throttling) and MasterService.java:204 task
+batching (one publication for a burst of state updates).
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.cluster.coordination import LEADER
+from elasticsearch_tpu.cluster.node import ClusterNode
+from elasticsearch_tpu.transport import DeterministicTaskQueue, LocalTransportNetwork
+
+
+class Cluster:
+    def __init__(self, n: int, attributes: dict[str, dict] | None = None):
+        self.queue = DeterministicTaskQueue(0)
+        self.net = LocalTransportNetwork(self.queue)
+        self.node_ids = [f"node-{i}" for i in range(n)]
+        self.nodes = {
+            nid: ClusterNode(nid, list(self.node_ids), self.net,
+                             attributes=(attributes or {}).get(nid))
+            for nid in self.node_ids
+        }
+        for nd in self.nodes.values():
+            nd.start()
+        self.run(60)
+
+    def run(self, seconds):
+        self.queue.run_for(seconds, max_tasks=500_000)
+
+    def master(self):
+        leaders = [n for n in self.nodes.values()
+                   if n.coordinator.mode == LEADER]
+        assert len(leaders) == 1
+        return leaders[0]
+
+    def create_index(self, name, settings):
+        acks = []
+        self.master().create_index(name, None, settings,
+                                   on_done=lambda r: acks.append(r))
+        self.run(30)
+        assert acks and acks[0]["acknowledged"], acks
+
+
+def _nodes_of(state, index):
+    return {a["node"] for assigns in state.routing[index].values()
+            for a in assigns}
+
+
+def test_exclude_filter_decider():
+    c = Cluster(3)
+    c.create_index("f", {"number_of_shards": 2, "number_of_replicas": 1,
+                         "index.routing.allocation.exclude._name": "node-0"})
+    c.run(60)
+    assert "node-0" not in _nodes_of(c.master().state, "f")
+
+
+def test_require_attribute_decider():
+    c = Cluster(3, attributes={"node-0": {"zone": "hot"},
+                               "node-1": {"zone": "hot"},
+                               "node-2": {"zone": "cold"}})
+    c.create_index("hot-only", {
+        "number_of_shards": 2, "number_of_replicas": 1,
+        "index.routing.allocation.require.zone": "hot"})
+    c.run(60)
+    assert _nodes_of(c.master().state, "hot-only") <= {"node-0", "node-1"}
+
+
+def test_total_shards_per_node_decider():
+    c = Cluster(3)
+    c.create_index("lim", {"number_of_shards": 3, "number_of_replicas": 0,
+                           "index.routing.allocation.total_shards_per_node": 1})
+    c.run(30)
+    state = c.master().state
+    per_node: dict[str, int] = {}
+    for assigns in state.routing["lim"].values():
+        for a in assigns:
+            per_node[a["node"]] = per_node.get(a["node"], 0) + 1
+    assert all(v == 1 for v in per_node.values()), per_node
+
+
+def test_unsatisfiable_filter_leaves_unassigned():
+    c = Cluster(2)
+    c.create_index("nowhere", {
+        "number_of_shards": 1, "number_of_replicas": 0,
+        "index.routing.allocation.require._name": "no-such-node"})
+    c.run(30)
+    assert c.master().state.routing["nowhere"].get("0", []) == []
+
+
+def test_master_task_batching():
+    c = Cluster(3)
+    m = c.master()
+    before = m.state.version
+    results = []
+    for i in range(5):
+        m.coordinator.submit_state_update(
+            f"t{i}",
+            lambda st, i=i: st.with_index(f"ix{i}", {
+                "mappings": {}, "settings": {"number_of_shards": 1,
+                                             "number_of_replicas": 0},
+                "in_sync": {}, "primary_terms": {}, "alloc_counter": 0,
+                "uuid": f"ix{i}-u"}, {}),
+            on_done=lambda ok, why: results.append((ok, why)),
+        )
+    c.run(30)
+    assert len(results) == 5 and all(ok for ok, _ in results), results
+    after = c.master().state
+    assert all(f"ix{i}" in after.indices for i in range(5))
+    # the 5 updates fit far fewer publications than tasks (first may go
+    # alone; the rest batch into the next publication)
+    assert after.version - before <= 3, (before, after.version)
+
+
+def test_state_diff_roundtrip():
+    from elasticsearch_tpu.cluster.state import ClusterState
+
+    a = ClusterState(term=1, version=5, master_id="m",
+                     nodes={"n1": {"roles": ["data"]}, "n2": {"roles": ["data"]}},
+                     indices={"i1": {"settings": {}}, "i2": {"settings": {}}},
+                     routing={"i1": {"0": []}, "i2": {"0": []}})
+    b = ClusterState(term=1, version=6, master_id="m",
+                     nodes={"n1": {"roles": ["data"]}},  # n2 left
+                     indices={"i1": {"settings": {"x": 1}},  # changed
+                              "i3": {"settings": {}}},  # added, i2 deleted
+                     routing={"i1": {"0": [{"node": "n1", "primary": True,
+                                            "state": "STARTED"}]},
+                              "i3": {}})
+    d = b.diff_from(a)
+    assert set(d["indices"]["set"]) == {"i1", "i3"}
+    assert d["indices"]["del"] == ["i2"]
+    assert d["nodes"]["del"] == ["n2"]
+    restored = a.apply_diff(d)
+    assert restored.to_dict() == b.to_dict()
+
+
+def test_publications_use_diffs_and_fall_back_to_full():
+    """Steady-state publications ship diffs; a node that missed rounds gets
+    the full state via the need_full fallback and still converges."""
+    c = Cluster(3)
+    m = c.master()
+    c.create_index("d1", {"number_of_shards": 1, "number_of_replicas": 0})
+    # partition a follower away, make state progress, heal: the follower's
+    # accepted state is stale, so the next publication's diff must fall
+    # back to a full-state resend for it
+    stale = [n for n in c.node_ids if n != m.node_id][0]
+    others = [n for n in c.node_ids if n != stale]
+    c.net.partition([stale], others)
+    c.run(60)
+    c.create_index("d2", {"number_of_shards": 1, "number_of_replicas": 0})
+    c.net.heal()
+    c.run(120)
+    st = c.nodes[stale].state
+    assert "d2" in st.indices
+    assert st.version == c.master().state.version
