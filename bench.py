@@ -147,11 +147,26 @@ def config1_match(searcher, m, lens, tok, rng):
     elapsed = time.perf_counter() - t_all
     qps = total_q / elapsed
 
-    # parity gate: fast path vs bit-exact path on a fresh sample
+    # parity gate: fast path vs the independent exact path on a fresh
+    # sample. The two paths sum in different orders, so docs whose f32
+    # scores agree to ~1e-5 relative may swap ranks (fp-ties); a query
+    # passes if every positional mismatch is such a tie — the same
+    # contract the test suite enforces against the pure-Python oracle.
     gate = sample_queries(rng, lens, tok, min(512, Q_BATCH))
     sf, idf, tf_, _ = bs.msearch("body", gate, TOP_K, fast=True)
     se, ide, te = [np.asarray(x) for x in bs.run("body", bs.plan("body", gate, TOP_K))]
-    rank_parity = float(np.mean([
+
+    def _rank_ok(q):
+        fm, em = np.isfinite(sf[q]), np.isfinite(se[q])
+        if fm.sum() != em.sum():
+            return False
+        for a, b_, ia, ib in zip(sf[q][fm], se[q][em], idf[q][fm], ide[q][em]):
+            if ia != ib and abs(a - b_) > 1e-5 * max(abs(b_), 1.0):
+                return False
+        return True
+
+    rank_parity = float(np.mean([_rank_ok(q) for q in range(len(gate))]))
+    strict_parity = float(np.mean([
         np.array_equal(idf[q][np.isfinite(sf[q])], ide[q][np.isfinite(se[q])])
         for q in range(len(gate))
     ]))
@@ -174,6 +189,7 @@ def config1_match(searcher, m, lens, tok, rng):
         "baseline_model_qps": round(baseline_qps, 1),
         "vs_baseline": round(qps / baseline_qps, 2),
         "rank_parity": rank_parity,
+        "rank_parity_strict": strict_parity,
         "totals_contract": totals_parity,
         "dense_matmul_mfu": round(mfu, 4),
         "hbm_utilization": round(hbm_util, 3),
@@ -181,20 +197,20 @@ def config1_match(searcher, m, lens, tok, rng):
 
 
 def config2_wand(sp_mod, pack, m, rng):
-    """bool-should long-postings disjunction: block-max pruned vs
-    exhaustive on identical queries (identical results enforced)."""
+    """bool-should long-postings disjunction: doc-level block-max pruned vs
+    exhaustive on identical queries. Engagement and top-k identity are
+    REPORTED (engaged / topk_mismatches fields), never asserted, so the
+    bench always lands its JSON line (VERDICT r2 #2); the test suite is
+    what enforces pruning soundness (tests/test_wand.py parity fuzz)."""
     from elasticsearch_tpu.parallel.sharded import StackedSearcher
     from elasticsearch_tpu.parallel.stacked import StackedPack
 
     sp = StackedPack([pack], m)
     ss = StackedSearcher(sp, mesh=None)
     # CSR-tail disjunctions: the dense tier needs no WAND (the MXU scores
-    # it exhaustively in one matmul); block-max pruning targets the long
-    # CSR postings below the dense-df threshold, the analog of Lucene
-    # pruning mid-frequency disjunctions. prune_floor=0 is the
-    # track_total_hits=false configuration — with counting promised up to
-    # 10k, pruning is (correctly) refused whenever no single term reaches
-    # the threshold, which in this architecture is every CSR term.
+    # it exhaustively in one matmul); pruning targets the long CSR postings
+    # below the dense-df threshold — the analog of Lucene pruning
+    # mid-frequency disjunctions. prune_floor=0 is track_total_hits=false.
     qs = []
     for _ in range(12):
         terms = rng.integers(900, 3500, size=4)
@@ -206,12 +222,13 @@ def config2_wand(sp_mod, pack, m, rng):
     # warm BOTH paths on every query first: the per-query compiled shapes
     # depend on each query's block-bucket widths, and timing a first run
     # would measure compilation, not execution
+    engaged = 0
     for q in qs:
         r = ss.search(q, size=TOP_K, prune_floor=0)
-        assert getattr(r, "wand_stats", None), "WAND plan did not engage"
+        engaged += bool(getattr(r, "wand_stats", None))
         ss.search(q, size=TOP_K, prune_floor=None)
 
-    t_ex, t_pr, pruned_frac = [], [], []
+    t_ex, t_pr, pruned_frac, mismatches = [], [], [], 0
     for q in qs:
         t0 = time.perf_counter()
         r_ex = ss.search(q, size=TOP_K, prune_floor=None)
@@ -224,14 +241,18 @@ def config2_wand(sp_mod, pack, m, rng):
             pruned_frac.append(
                 st["rows_pruned"] / max(st["rows_kept"] + st["rows_pruned"], 1)
             )
-        assert list(r_pr.doc_ids) == list(r_ex.doc_ids), "pruning changed top-k"
+        if list(r_pr.doc_ids) != list(r_ex.doc_ids):
+            mismatches += 1
     p50_ex = float(np.median(t_ex)) * 1e3
     p50_pr = float(np.median(t_pr)) * 1e3
     return {
         "p50_exhaustive_ms": round(p50_ex, 1),
         "p50_pruned_ms": round(p50_pr, 1),
         "speedup": round(p50_ex / p50_pr, 2),
-        "rows_pruned_frac": round(float(np.mean(pruned_frac)) if pruned_frac else 0.0, 3),
+        "postings_pruned_frac": round(
+            float(np.mean(pruned_frac)) if pruned_frac else 0.0, 3),
+        "engaged": f"{engaged}/{len(qs)}",
+        "topk_mismatches": mismatches,
     }
 
 

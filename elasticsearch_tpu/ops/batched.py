@@ -89,13 +89,18 @@ def batch_term_disjunction(
     # a per-element gather (~30ns/element on TPU), measured 5x slower.
     sd, sv = jax.lax.sort((cd, cs), dimension=1, num_keys=1)
     # run sums: csum - (csum just before this run's start), run start base
-    # propagated forward by cummax (csum - sv is non-decreasing: sv >= 0)
-    csum = jnp.cumsum(sv, axis=1)
+    # propagated forward by cummax (csum - sv is non-decreasing: sv >= 0).
+    # f64 prefix sums: a f32 cumsum carries O(prefix/value * 2^-24) noise
+    # (~1e-4 relative at C=8k), enough to randomly split docs whose true
+    # scores tie — this path is the accuracy reference, so it pays for
+    # (slow, emulated) f64 to keep per-doc sums exact to f32 ulps.
+    sv64 = sv.astype(jnp.float64)
+    csum = jnp.cumsum(sv64, axis=1)
     col = jnp.arange(C)
     starts = jnp.where(col[None, :] == 0, True, sd != jnp.roll(sd, 1, axis=1))
-    base = jnp.where(starts, csum - sv, -jnp.inf)
+    base = jnp.where(starts, csum - sv64, -jnp.inf)
     run_base = jax.lax.cummax(base, axis=1)
-    run_sum = csum - run_base
+    run_sum = (csum - run_base).astype(jnp.float32)
     is_end = jnp.where(col[None, :] == C - 1, True, sd != jnp.roll(sd, -1, axis=1))
     live_c = live[jnp.minimum(sd, n - 1)] & (sd < n)
     valid_end = is_end & live_c
@@ -641,6 +646,14 @@ class BatchTermSearcher:
 
         Missing-hit columns carry -inf scores (when fewer than k docs
         match, and when k was clamped to the doc count)."""
+        if fast:
+            from .fused import FusedTermSearcher
+
+            if FusedTermSearcher.usable(self.searcher.pack, k):
+                fs = getattr(self, "_fused", None)
+                if fs is None:
+                    fs = self._fused = FusedTermSearcher(self)
+                return fs.msearch(fld, queries, k)
         Q = len(queries)
         scores = np.full((Q, k), -np.inf, np.float32)
         ids = np.zeros((Q, k), np.int64)

@@ -1,0 +1,721 @@
+"""Fused batched BM25: bf16 dense matmul + one-hot MXU sparse-add + in-kernel
+top-K' + exact match counts, followed by a canonical f32 rescore.
+
+This replaces the round-2 `_msearch` hot path, whose XLA composition paid two
+taxes this kernel removes (measured on a v5e through the remote runtime):
+
+  - `lax.top_k` on a [512, 1M] score matrix costs ~1.25 s — three orders of
+    magnitude over the HBM roofline. Here top-K' selection runs inside the
+    doc-tile scan against a VMEM accumulator (buffered merge, below).
+  - per-element gathers/scatters run on the TPU scalar core (~15-30 ns/elem).
+    The sparse tail (CSR postings below the dense-tier df threshold) is
+    instead ACCUMULATED INTO THE SCORE TILES BY ONE-HOT MATMULS: candidate
+    windows, sorted by (query-subtile, docid), are DMA'd per tile and
+    expanded to
+        At[p, q] = weight_p * (query_p == q)     [P, QSUB]
+        D [p, n] = (docid_p - tile_base == n)    [P, TILE_N]
+    so `scores_tile += At.T @ D` performs a segmented scatter-add on the
+    MXU. Duplicate (query, doc) candidates sum automatically, which deletes
+    the old path's per-(query,doc) run-sum machinery (sort + cummax scan),
+    and dense+sparse overlap resolves by ordinary addition instead of a
+    candidate-list merge.
+
+The dense-tier matmul runs OUTSIDE the kernel: XLA's [512,896]x[896,1M] bf16
+matmul is ~2 ms materialized, and the [Qc, N] bf16 score matrix it writes is
+~1 GB of HBM traffic (~2.5 ms) — cheap, unlike its f32 top_k. Totals are
+exact: a live lane matches iff its combined score is > 0 (every BM25 term
+weight is > 0 — reference behavior: Lucene BM25Similarity idf > 0), and
+rounding preserves sign, so the in-kernel count of positive live lanes is
+the reference's exact hit count (better than the reference's own default,
+which stops counting at 10k — TotalHits.Relation.GREATER_THAN_OR_EQUAL_TO).
+
+Selection in bf16 perturbs near-ties, so the kernel's top-K' (K'=32 >= k) is
+a CANDIDATE SET, not the result: `canonical_rescore` recomputes each
+winner's score in f32 with one shared function used by every path, and the
+final ranking is (rescored score desc, docid asc). A per-query safety test
+flags queries whose kth rescored score is not provably above anything the
+bf16 pass could have excluded; flagged queries re-run on the f32-scores
+variant of the same pipeline. Pattern ties (docs with identical (tf, dl)
+profiles — common under quantized norms) produce bit-identical scores in
+both precisions, so the kernel's docid tie-break already orders them
+correctly; the safety test treats an exact kth==K'th rescored tie as safe
+for that reason.
+
+Reference behavior replaced: the DAAT BulkScorer loop + TopScoreDocCollector
+(reference: search/internal/ContextIndexSearcher.java:411-431) and the
+default hit-count threshold semantics (search/query/QueryPhase.java).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..index.pack import BLOCK
+
+KB = 32  # in-kernel candidate set size (top-K'); final k must be <= KB
+WARM_TILES = 128  # max leading tiles merged unbuffered (warm-up cap)
+TILE_N = 1024
+QSUB = 128  # query sub-tile: one MXU row block
+QC = 512  # fused query-chunk width
+# max docs a fused shard may hold (docid bit budget of the window sort key)
+MAX_DOCS_FUSED = (1 << 21) - 2 * TILE_N
+# relative slack of split-bf16 (hi+lo) selection vs the canonical f32
+# rescore. Inputs carry ~15 mantissa bits (truncating split), sums
+# accumulate in f32: measured max relative error 7.7e-5 on bench-shaped
+# operands; 2e-4 adds margin. The split MUST be built by integer masking:
+# the runtime compiles with --xla_allow_excess_precision=true, which lets
+# XLA elide f32->bf16->f32 round-trips, so `t - bf16(t)` folds to zero and
+# an astype-based split silently degenerates to one bf16 pass (measured).
+EPS_SPLIT = 2e-4
+
+
+def _mask_hi(t):
+    """Truncate to the top 16 bits (sign+exp+7-bit mantissa): an exactly
+    bf16-representable f32 that XLA cannot constant-fold away."""
+    bits = jax.lax.bitcast_convert_type(t, jnp.int32)
+    return jax.lax.bitcast_convert_type(
+        bits & jnp.int32(-65536), jnp.float32
+    )
+
+
+_I0 = np.int32(0)  # index-map constant: python ints trace to i64 under x64
+
+
+def fused_enabled() -> str:
+    """'0' | 'auto' | 'force' — force enables on CPU (interpret, tests)."""
+    return os.environ.get("ES_TPU_FUSED", "auto")
+
+
+def _key_bits(n_pad: int, qsub: int, nsub: int):
+    qb = int(np.log2(qsub))
+    db = max(1, int(np.ceil(np.log2(max(n_pad + 1, 2)))))
+    sb = qb + db
+    nsb = max(1, int(np.ceil(np.log2(max(nsub, 2)))))
+    if sb + nsb > 31:
+        raise ValueError("fused window key overflow: shard too large")
+    return qb, db, sb
+
+
+def _topk_rounds(cand_v, cand_i, k):
+    """Exact top-k of a candidate row-set by (value desc, id asc): k unrolled
+    (max, argmin-id, mask) rounds — VPU reduce/selects, no sort. Same
+    contract as ops.kernels._merge_topk."""
+    out_v, out_i = [], []
+    big = jnp.int32(2**31 - 1)
+    for _ in range(k):
+        vmax = jnp.max(cand_v, axis=1, keepdims=True)
+        ismax = cand_v == vmax
+        imin = jnp.min(jnp.where(ismax, cand_i, big), axis=1, keepdims=True)
+        out_v.append(vmax)
+        out_i.append(imin)
+        cand_v = jnp.where(ismax & (cand_i == imin), -jnp.inf, cand_v)
+    return jnp.concatenate(out_v, axis=1), jnp.concatenate(out_i, axis=1)
+
+
+def _fused_kernel(
+    ptr_ref,  # scalar prefetch [nsub*(nj+1)] i32 exact window starts
+    ptrb_ref,  # scalar prefetch [nsub*(nj+1)] i32 window block indices
+    scores_ref,  # [QSUB, TILE_N] block (bf16 | f32)
+    live_ref,  # [1, TILE_N] f32
+    keya_ref,  # [P/128, 128] i32 key rows of window block ptrb[j]
+    keyb_ref,  # [P/128, 128] i32 key rows of window block ptrb[j]+1
+    vala_ref,  # [P/128, 128] i32 f32-bits of window block ptrb[j]
+    valb_ref,  # [P/128, 128] i32 f32-bits of window block ptrb[j]+1
+    ov_ref,  # [QSUB, KB] f32
+    oi_ref,  # [QSUB, KB] i32
+    ot_ref,  # [QSUB, 1] f32 (exact match counts)
+    of_ref,  # [QSUB, 1] f32 (overflow flags)
+    acc_v,  # VMEM [QC, KB] f32
+    acc_i,  # VMEM [QC, KB] i32
+    cnt,  # VMEM [QC, 1] f32
+    ovf,  # VMEM [QC, 1] f32
+    *,
+    kb, tile_n, P, qsub, qb, db, sb, nj, warm,
+):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when((j == 0) & (i == 0))
+    def _():
+        acc_v[:] = jnp.full_like(acc_v, -jnp.inf)
+        acc_i[:] = jnp.zeros_like(acc_i)
+        cnt[:] = jnp.zeros_like(cnt)
+        ovf[:] = jnp.zeros_like(ovf)
+
+    # ---- candidate window: two consecutive P-blocks around ptr[j] --------
+    # The pipeline streams blocks floor(ptr/P) and floor(ptr/P)+1 via the
+    # scalar-prefetched index maps; entries outside tile j's doc range (or
+    # belonging to another query sub-tile, or sentinel padding) are masked
+    # here, so no exact-start alignment is needed. Coverage is 2P entries;
+    # a longer window loses its tail -> overflow flag -> rerun escalation.
+    # Window entries are stored 128-per-row ([G/128, 128] — dense VMEM
+    # tiles; a [P, 2] layout lane-pads 64x and blows the VMEM budget), and
+    # each row feeds transposed one-hots contracted over the LANE axis.
+    base = i * (nj + 1) + j
+    end = ptr_ref[base + 1]
+
+    # ---- one-hot expansion: the MXU as a segmented scatter-add ----------
+    qrow = jax.lax.broadcasted_iota(jnp.int32, (qsub, 128), 0)
+    nrow = jax.lax.broadcasted_iota(jnp.int32, (tile_n, 128), 0)
+    one = jnp.float32(1.0)
+    zero = jnp.float32(0.0)
+    rows_per_blk = P // 128
+    dn = (((1,), (1,)), ((), ()))
+    sparse = None
+    for c in range(2 * rows_per_blk):
+        if c < rows_per_blk:
+            key = keya_ref[c : c + 1, :]  # [1, 128]
+            val = jax.lax.bitcast_convert_type(
+                vala_ref[c : c + 1, :], jnp.float32
+            )
+        else:
+            key = keyb_ref[c - rows_per_blk : c - rows_per_blk + 1, :]
+            val = jax.lax.bitcast_convert_type(
+                valb_ref[c - rows_per_blk : c - rows_per_blk + 1, :],
+                jnp.float32,
+            )
+        qlow = key & (qsub - 1)
+        doc = jax.lax.shift_right_logical(key, jnp.int32(qb)) & ((1 << db) - 1)
+        off = doc - j * tile_n
+        inwin = (
+            (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
+            & (off >= 0)
+            & (off < tile_n)
+        )
+        At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
+        D = jnp.where((nrow == off) & inwin, one, zero).astype(
+            jnp.bfloat16
+        )  # [tile_n, 128]
+        # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo carries
+        # ~15 mantissa bits through two bf16 MXU passes with f32
+        # accumulation, keeping selection within EPS_SPLIT of the canonical
+        # f32 rescore
+        Ahf = _mask_hi(At)
+        Ah = Ahf.astype(jnp.bfloat16)
+        Al = (At - Ahf).astype(jnp.bfloat16)
+        contrib = jax.lax.dot_general(
+            Ah, D, dn, preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            Al, D, dn, preferred_element_type=jnp.float32
+        )  # [qsub, tile_n]
+        sparse = contrib if sparse is None else sparse + contrib
+
+    dense = scores_ref[:].astype(jnp.float32)
+    lv = live_ref[0:1, :] > 0
+    total = dense + sparse
+    total = jnp.where(lv & (total > 0), total, -jnp.inf)
+    ids = j * tile_n + jax.lax.broadcasted_iota(jnp.int32, total.shape, 1)
+
+    rs = pl.ds(i * qsub, qsub)
+    cnt[rs] += jnp.sum(
+        total > 0, axis=1, keepdims=True, dtype=jnp.float32
+    )
+    lost = end > ptrb_ref[base] * P + 2 * P
+    ovf[rs] += jnp.broadcast_to(lost.astype(jnp.float32), (qsub, 1))
+
+    # ---- top-K' maintenance: buffered merge ------------------------------
+    @pl.when(j < warm)
+    def _():
+        mv, mi = _topk_rounds(
+            jnp.concatenate([acc_v[rs], total], axis=1),
+            jnp.concatenate([acc_i[rs], ids], axis=1),
+            kb,
+        )
+        acc_v[rs] = mv
+        acc_i[rs] = mi
+
+    @pl.when(j >= warm)
+    def _():
+        # post-warm-up fast path: only a tile's top-4 entries are carried
+        # into the accumulator (a 32x36 merge instead of 32x1056). A query
+        # with >4 entries above its current K'th score in ONE tile would
+        # lose entries -> flag it for the rerun escalation. Top-4 (not
+        # top-2) + the nj/8 warm-up keep the flag probability ~1e-4: the
+        # expected new-entry count per tile is kb/j, and P(Poisson(kb/j)>4)
+        # is negligible once j > warm.
+        theta = acc_v[rs][:, kb - 1 : kb]
+        c_above = jnp.sum(
+            total > theta, axis=1, keepdims=True, dtype=jnp.int32
+        )
+        ovf[rs] += (c_above > 4).astype(jnp.float32)
+        t4v, t4i = _topk_rounds(total, ids, 4)
+        mv, mi = _topk_rounds(
+            jnp.concatenate([acc_v[rs], t4v], axis=1),
+            jnp.concatenate([acc_i[rs], t4i], axis=1),
+            kb,
+        )
+        acc_v[rs] = mv
+        acc_i[rs] = mi
+
+    @pl.when(j == nj - 1)
+    def _():
+        ov_ref[:] = acc_v[rs]
+        oi_ref[:] = acc_i[rs]
+        ot_ref[:] = cnt[rs]
+        of_ref[:] = ovf[rs]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kb", "tile_n", "P", "qsub", "warm", "interpret"),
+)
+def fused_sparse_topk(
+    scores,  # [Qc, Npad] bf16 | f32 dense-tier scores (padding cols = 0)
+    live,  # [1, Npad] f32 (0 for dead/padding)
+    keys,  # [Gpad/128, 128] i32 sorted window keys; Gpad % P == 0, with
+    #       >= 2P trailing sentinel entries (key = int32 max)
+    vals,  # [Gpad/128, 128] i32 f32-bits of the per-posting partial scores
+    ptr,  # [nsub*(nj+1)] i32 window starts (entry index) into keys/vals
+    *,
+    kb=KB,
+    tile_n=TILE_N,
+    P=1024,
+    qsub=QSUB,
+    warm=WARM_TILES,
+    interpret=False,
+):
+    """-> (top_v [Qc, kb] f32, top_i [Qc, kb] i32, totals [Qc] i32,
+    overflow [Qc] bool). Selection precision: split-bf16 of the inputs
+    (see EPS_SPLIT); totals exact."""
+    qc, n_pad = scores.shape
+    assert qc % qsub == 0 and n_pad % tile_n == 0 and P % 128 == 0
+    nsub = qc // qsub
+    nj = n_pad // tile_n
+    qb, db, sb = _key_bits(n_pad, qsub, nsub)
+    kernel = functools.partial(
+        _fused_kernel,
+        kb=kb, tile_n=tile_n, P=P, qsub=qsub, qb=qb, db=db, sb=sb,
+        nj=nj, warm=min(warm, max(16, nj // 8)),
+    )
+    nblk = keys.shape[0] * 128 // P
+    ptr_blk = jnp.minimum(ptr // P, nblk - 2)
+    rpb = P // 128
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nj, nsub),
+        in_specs=[
+            pl.BlockSpec((qsub, tile_n), lambda j, i, *_: (i, j)),
+            pl.BlockSpec((1, tile_n), lambda j, i, *_: (_I0, j)),
+            pl.BlockSpec(
+                (rpb, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j], _I0),
+            ),
+            pl.BlockSpec(
+                (rpb, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j] + 1, _I0),
+            ),
+            pl.BlockSpec(
+                (rpb, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j], _I0),
+            ),
+            pl.BlockSpec(
+                (rpb, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j] + 1, _I0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((qsub, kb), lambda j, i, *_: (i, _I0)),
+            pl.BlockSpec((qsub, kb), lambda j, i, *_: (i, _I0)),
+            pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
+            pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qc, kb), jnp.float32),
+            pltpu.VMEM((qc, kb), jnp.int32),
+            pltpu.VMEM((qc, 1), jnp.float32),
+            pltpu.VMEM((qc, 1), jnp.float32),
+        ],
+    )
+    ov, oi, ot, of = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((qc, kb), jnp.float32),
+            jax.ShapeDtypeStruct((qc, kb), jnp.int32),
+            jax.ShapeDtypeStruct((qc, 1), jnp.float32),
+            jax.ShapeDtypeStruct((qc, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ptr, ptr_blk, scores, live, keys, keys, vals, vals)
+    return ov, oi, ot[:, 0].astype(jnp.int32), of[:, 0] > 0
+
+
+# ---------------------------------------------------------------------------
+# canonical rescore: THE score function both precisions rank by
+# ---------------------------------------------------------------------------
+
+
+def canonical_rescore(
+    tier,  # [V, Npad] f32 dense tfn rows (or None)
+    dense_rows,  # [Q, Td] i32 (pad row 0 with weight 0)
+    dense_w,  # [Q, Td] f32
+    row_q,  # [R] i32 owner query of each CSR block row
+    docids,  # [R, BLOCK] i32 gathered postings (pad: docid >= n)
+    parts,  # [R, BLOCK] f32 per-posting partial scores
+    cand_i,  # [Q, KB] i32 kernel winners
+    cand_ok,  # [Q, KB] bool valid lanes
+):
+    """Exact f32 score of each candidate, computed identically by every path:
+    dense part by per-(query, dense-term, winner) tier lookups summed in plan
+    order; sparse part by comparison-reduce over the gathered posting rows
+    and a one-hot f32 matmul segment-sum over block rows. Each (term, doc)
+    contributes at most one posting, so the inner reductions add exact zeros
+    everywhere but one slot and the result does not depend on padding."""
+    Q, kb = cand_i.shape
+    if tier is not None and dense_rows.shape[1] > 0:
+        dg = tier[dense_rows[:, :, None], cand_i[:, None, :]]  # [Q, Td, KB]
+        dsum = jnp.sum(dense_w[:, :, None] * dg, axis=1)
+    else:
+        dsum = jnp.zeros((Q, kb), jnp.float32)
+    if docids.shape[0] > 1:
+        win_row = cand_i[row_q]  # [R, KB] winners of each row's owner query
+        eq = docids[:, :, None] == win_row[:, None, :]
+        row_sum = jnp.sum(
+            jnp.where(eq, parts[:, :, None], 0.0), axis=1
+        )  # [R, KB]
+        qrow = jax.lax.broadcasted_iota(jnp.int32, (Q, docids.shape[0]), 0)
+        onehot = (qrow == row_q[None, :]).astype(jnp.float32)
+        # [Q, R] @ [R, KB]: segment-sum of row contributions by owner query.
+        # Each (q, winner) cell receives <= one nonzero per sparse term.
+        ssum = jnp.matmul(onehot, row_sum, precision=jax.lax.Precision.HIGHEST)
+    else:
+        ssum = jnp.zeros((Q, kb), jnp.float32)
+    return jnp.where(cand_ok, dsum + ssum, -jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# host planning + device pipeline
+# ---------------------------------------------------------------------------
+
+
+class FusedPlan:
+    """Host-side per-chunk inputs. Block-row-major: instead of the legacy
+    [Q, Ts, B] padded layout (~84% padding at Zipf query mixes), the sparse
+    side is one flat list of REAL CSR block rows with an owner query and a
+    term weight per row — no per-query shape bucketing at all. R and Td pad
+    to powers of two so every batch reuses a tiny compiled-shape family."""
+
+    __slots__ = ("W", "rows", "row_q", "row_w", "dense_rows", "dense_w", "k")
+
+    def __init__(self, W, rows, row_q, row_w, dense_rows, dense_w, k):
+        self.W = W
+        self.rows = rows
+        self.row_q = row_q
+        self.row_w = row_w
+        self.dense_rows = dense_rows
+        self.dense_w = dense_w
+        self.k = k
+
+
+def plan_fused(pack, fld, queries, k, qc=QC):
+    """queries: per query a list of (term, boost); -> FusedPlan padded to
+    qc query rows."""
+    from .scoring import bm25_idf
+
+    V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
+    Q = len(queries)
+    doc_count = pack.field_stats.get(fld, {}).get("doc_count") or pack.num_docs
+    W = np.zeros((qc, V), np.float32)
+    rows_l, rowq_l, roww_l = [], [], []
+    dense_l = []
+    td_max = 1
+    for qi, terms in enumerate(queries):
+        dlist = []
+        for term, boost in terms:
+            s0, nb, df = pack.term_blocks(fld, term)
+            if df <= 0:
+                continue
+            w = boost * bm25_idf(doc_count, df)
+            dr = pack.dense_row_of(fld, term)
+            if dr is not None:
+                W[qi, dr] += w
+                dlist.append((dr, w))
+            elif nb > 0:
+                rows_l.append(np.arange(s0, s0 + nb, dtype=np.int32))
+                rowq_l.append(np.full(nb, qi, np.int32))
+                roww_l.append(np.full(nb, w, np.float32))
+        dense_l.append(dlist)
+        td_max = max(td_max, len(dlist))
+    nreal = sum(len(r) for r in rows_l)
+    # quantize R in 4x steps: every distinct R is a fresh XLA compile
+    # (~15s through the remote compile service), and Zipf batches flap
+    # across a pow2 boundary often enough to thrash the cache
+    R = 64
+    while R < nreal:
+        R *= 4
+    rows = np.zeros(R, np.int32)  # row 0 of the pack = all-padding block
+    row_q = np.zeros(R, np.int32)
+    row_w = np.zeros(R, np.float32)
+    if nreal:
+        rows[:nreal] = np.concatenate(rows_l)
+        row_q[:nreal] = np.concatenate(rowq_l)
+        row_w[:nreal] = np.concatenate(roww_l)
+    Td = 1 << (max(td_max, 4) - 1).bit_length()
+    dense_rows = np.zeros((qc, Td), np.int32)
+    dense_w = np.zeros((qc, Td), np.float32)
+    for qi, dlist in enumerate(dense_l):
+        for ti, (dr, w) in enumerate(dlist):
+            dense_rows[qi, ti] = dr
+            dense_w[qi, ti] = w
+    return FusedPlan(W, rows, row_q, row_w, dense_rows, dense_w, k)
+
+
+def _fused_pipeline(
+    fa,  # device dict: tier16/tier32 [V, n_pad], live [1, n_pad], post_*
+    W, rows, row_q, row_w, dense_rows, dense_w,
+    *,
+    k, n, n_pad, avgdl, has_norms, k1, b, P, interpret, qsub=QSUB,
+):
+    """One fused chunk, fully on device. -> (v [Q,k], i, totals, flags)."""
+    qc = W.shape[0]
+    R = rows.shape[0]
+    nsub = qc // qsub
+    nj = n_pad // TILE_N
+    qb, db, sb = _key_bits(n_pad, qsub, nsub)
+
+    # phase A: gather CSR block rows, per-posting partial scores
+    docids = fa["post_docids"][rows]  # [R, BLOCK]
+    tfs = fa["post_tfs"][rows]
+    if has_norms:
+        dls = fa["post_dls"][rows]
+        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
+    else:
+        denom = tfs + k1
+    parts = row_w[:, None] * tfs / denom  # [R, BLOCK]; pad lanes -> 0
+
+    # window sort key: (query subtile | docid | query low bits)
+    q2 = row_q[:, None]
+    key = (
+        ((q2 >> qb) << sb)
+        | (docids << qb)
+        | (q2 & (qsub - 1))
+    )
+    # padding lanes (docid >= n, tf == 0) take the sentinel key: without
+    # this they all fall into the LAST doc tile's window (docid == n is in
+    # range) and their ~30% mass overflows it, flagging every query
+    key = jnp.where(docids >= n, jnp.int32(2**31 - 1), key)
+    skey, sval = jax.lax.sort(
+        (key.reshape(-1), parts.reshape(-1)), num_keys=1
+    )
+    bounds = (
+        (jnp.arange(nsub, dtype=jnp.int32)[:, None] << sb)
+        | (jnp.arange(nj + 1, dtype=jnp.int32)[None, :] * TILE_N << qb)
+    )
+    ptr = jnp.searchsorted(skey, bounds.reshape(-1)).astype(jnp.int32)
+    pad_n = 2 * P + (-(skey.shape[0] + 2 * P)) % P
+    sent = jnp.full((pad_n,), jnp.int32(2**31 - 1))
+    keys2 = jnp.concatenate([skey, sent]).reshape(-1, 128)
+    vals2 = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(sval, jnp.int32), sent]
+    ).reshape(-1, 128)
+
+    # dense tier in split-bf16: hi+lo carries ~16 mantissa bits through
+    # three bf16 MXU passes with f32 accumulation (~3x a single bf16
+    # matmul, ~2x cheaper than 6-pass f32 HIGHEST) — selection lands
+    # within ~2^-16 of the canonical f32 rescore, so EPS_SPLIT = 1e-4
+    # keeps the safety-flag rate near zero even when the 10th..32nd
+    # scores pack within a percent (typical at 1M docs)
+    Whf = _mask_hi(W)
+    Wh = Whf.astype(jnp.bfloat16)
+    Wl = (W - Whf).astype(jnp.bfloat16)
+    scores = (
+        jnp.matmul(Wh, fa["tier16"], preferred_element_type=jnp.float32)
+        + jnp.matmul(Wh, fa["tier16_lo"], preferred_element_type=jnp.float32)
+        + jnp.matmul(Wl, fa["tier16"], preferred_element_type=jnp.float32)
+    )
+    eps = EPS_SPLIT
+    tv, ti, totals, ovf = fused_sparse_topk(
+        scores, fa["live"], keys2, vals2, ptr, P=P, interpret=interpret
+    )
+
+    # canonical rescore + final ranking + safety test
+    cand_ok = tv > -jnp.inf
+    resc = canonical_rescore(
+        fa["tier32"], dense_rows, dense_w, row_q, docids, parts, ti, cand_ok
+    )
+    v, i = rank_topk(resc, ti, k)
+    am_kernel = tv[:, -1]
+    am_resc = jnp.min(jnp.where(cand_ok, resc, jnp.inf), axis=1)
+    rk = v[:, k - 1]
+    bound = am_kernel + eps * jnp.abs(am_kernel)
+    safe = jnp.isneginf(am_kernel) | (rk > bound) | (rk == am_resc)
+    return v, i, totals, ovf | ~safe
+
+
+class FusedTermSearcher:
+    """Batched `_msearch` over one shard pack through the fused kernel.
+
+    Wraps a BatchTermSearcher for planning metadata and as the last-resort
+    fallback; chunks query batches to QC rows; flagged queries escalate
+    bf16 -> f32 scores -> legacy path. All chunks of a call resolve with one
+    device round-trip (remote-runtime dispatch-barrier discipline, see
+    ops/batched._RawChunks)."""
+
+    def __init__(self, bts):
+        self.bts = bts  # BatchTermSearcher
+        self.searcher = bts.searcher
+        self._cache = {}
+        self._fa = None
+
+    @staticmethod
+    def usable(pack, k) -> bool:
+        mode = fused_enabled()
+        if mode == "0" or pltpu is None:
+            return False
+        if pack.dense_tfn is None:
+            return False
+        if not (0 < k <= 16) or pack.num_docs > MAX_DOCS_FUSED:
+            return False
+        if mode == "force":
+            return True
+        return (
+            jax.default_backend() == "tpu"
+            and pack.num_docs >= 4 * TILE_N
+        )
+
+    def _arrays(self):
+        if self._fa is None:
+            dev = self.searcher.dev
+            n = self.searcher.pack.num_docs
+            n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
+            padw = n_pad - n
+
+            # HBM budget: the f32 tier stays SHARED with the legacy path
+            # (unpadded — the rescore only gathers from it); only the
+            # bf16 hi/lo pair is padded for the matmul. One fused jit so
+            # the padded f32 intermediate is a transient, not a resident.
+            @jax.jit
+            def split(t):
+                tp = jnp.pad(t, ((0, 0), (0, padw)))
+                hif = _mask_hi(tp)
+                hi = hif.astype(jnp.bfloat16)
+                lo = (tp - hif).astype(jnp.bfloat16)
+                return hi, lo
+
+            hi, lo = split(dev["dense_tfn"])
+            live = jnp.pad(
+                dev["live"].astype(jnp.float32), (0, padw)
+            )[None, :]
+            self._fa = {
+                "tier32": dev["dense_tfn"],
+                "tier16": hi,
+                "tier16_lo": lo,
+                "live": live,
+                "post_docids": dev["post_docids"],
+                "post_tfs": dev["post_tfs"],
+                "post_dls": dev["post_dls"],
+            }
+        return self._fa
+
+    def _compiled(self, fld, R, Td, k, interpret):
+        pack = self.searcher.pack
+        n = pack.num_docs
+        n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
+        nj = n_pad // TILE_N
+        G = R * BLOCK
+        mean_win = max(1, G // ((QC // QSUB) * nj))
+        # 2x the mean window load: the two-block window covers 4x the mean
+        # (P-block pair), overflow flags catch tail skew. Larger P wastes
+        # VMEM: the [P, 2] kv blocks lane-pad 64x.
+        # floor 1024: the [P/128, 128] window blocks need >= 8 sublanes
+        P = min(4096, max(1024, 1 << (2 * mean_win - 1).bit_length()))
+        key = (fld, R, Td, k, interpret, P)
+        fn = self._cache.get(key)
+        if fn is None:
+            kw = dict(
+                k=k, n=n, n_pad=n_pad,
+                avgdl=pack.avgdl(fld),
+                has_norms=fld in self.searcher.ctx.has_norms,
+                k1=1.2, b=0.75,
+                P=P, interpret=interpret,
+            )
+            fn = jax.jit(functools.partial(_fused_pipeline, **kw))
+            self._cache[key] = fn
+        return fn
+
+    def _dispatch(self, fld, queries, k, qidx):
+        """Plan + launch one <=QC chunk; returns (qidx, device outs)."""
+        interpret = jax.default_backend() != "tpu"
+        plan = plan_fused(self.searcher.pack, fld, queries, k)
+        fn = self._compiled(
+            fld, plan.rows.shape[0], plan.dense_rows.shape[1],
+            k, interpret,
+        )
+        outs = fn(
+            self._arrays(),
+            jnp.asarray(plan.W), jnp.asarray(plan.rows),
+            jnp.asarray(plan.row_q), jnp.asarray(plan.row_w),
+            jnp.asarray(plan.dense_rows), jnp.asarray(plan.dense_w),
+        )
+        return qidx, outs
+
+    def _run_pass(self, fld, queries, k):
+        """One fused pass over all queries -> (v, i, t, flagged_bool)."""
+        Q = len(queries)
+        scores = np.full((Q, k), -np.inf, np.float32)
+        ids = np.zeros((Q, k), np.int64)
+        totals = np.zeros((Q,), np.int64)
+        flagged = np.zeros((Q,), bool)
+        launched = []
+        for s in range(0, Q, QC):
+            qidx = np.arange(s, min(s + QC, Q))
+            launched.append(
+                self._dispatch(fld, [queries[i] for i in qidx], k, qidx)
+            )
+        host = jax.device_get([o for _, o in launched])
+        for (qidx, _), (v, i, t, fl) in zip(launched, host):
+            nq = len(qidx)
+            scores[qidx] = v[:nq]
+            ids[qidx] = i[:nq]
+            totals[qidx] = t[:nq]
+            flagged[qidx] = fl[:nq]
+        return scores, ids, totals, flagged
+
+    def msearch(self, fld, queries, k=10):
+        """-> (scores [Q,k], docids [Q,k], totals [Q] exact,
+        first_pass_ok [Q]) numpy, in input order. Top-k is always the
+        canonical f32 ranking; flagged queries (window overflow, or a
+        top-k boundary the split-precision pass cannot separate) re-run
+        on the legacy exact path, so results never depend on the fused
+        pass. The split-bf16 selection keeps the flag rate near zero."""
+        scores, ids, totals, flagged = self._run_pass(fld, queries, k)
+        first_ok = ~flagged
+        if flagged.any():
+            still = np.nonzero(flagged)[0]
+            # legacy exact path (independent machinery). Its final scores
+            # equal the canonical values only up to ulps; ranking
+            # differences at that level are accepted.
+            sv, si, st = [
+                np.asarray(x)
+                for x in self.bts.run(
+                    fld,
+                    self.bts.plan(fld, [queries[i] for i in still], k),
+                )
+            ]
+            scores[still, : sv.shape[1]] = sv
+            ids[still, : sv.shape[1]] = si
+            totals[still] = st
+        return scores, ids, totals, first_ok
+
+
+def rank_topk(values, ids, k):
+    """(score desc, docid asc) exact order via one int64 rank-key top_k.
+    values must be >= 0 or -inf (IEEE bit-pattern order trick)."""
+    score_bits = jax.lax.bitcast_convert_type(values, jnp.int32).astype(jnp.int64)
+    rank = (score_bits << 32) + (jnp.int64(0xFFFFFFFF) - ids.astype(jnp.int64))
+    _, sel = jax.lax.top_k(rank, k)
+    return (
+        jnp.take_along_axis(values, sel, axis=1),
+        jnp.take_along_axis(ids, sel, axis=1),
+    )

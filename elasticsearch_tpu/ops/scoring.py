@@ -66,8 +66,28 @@ def term_score_blocks(
     """
     docids = post_docids[rows]  # [B, 128]
     tfs = post_tfs[rows]  # [B, 128]
+    dls = post_dls[rows] if has_norms else None
+    return score_posting_arrays(
+        docids, tfs, dls, weight, avgdl, num_docs,
+        k1=k1, b=b, has_norms=has_norms,
+    )
+
+
+def score_posting_arrays(
+    docids: jax.Array,  # [B, BLOCK] int32 (pad: num_docs)
+    tfs: jax.Array,  # [B, BLOCK] float32 (pad: 0)
+    dls: jax.Array | None,  # [B, BLOCK] float32 (None when has_norms=False)
+    weight: jax.Array,
+    avgdl: jax.Array | float,
+    num_docs: int,
+    k1: float = 1.2,
+    b: float = 0.75,
+    has_norms: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Score explicit posting arrays (the tail of term_score_blocks; also
+    the execution form of WAND-pruned synthetic blocks, where surviving
+    postings were compacted host-side — query/wand.prune_postings)."""
     if has_norms:
-        dls = post_dls[rows]
         denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
     else:
         denom = tfs + k1

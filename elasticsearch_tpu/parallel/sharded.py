@@ -634,7 +634,8 @@ class StackedSearcher:
             has_norms = t.fld in self.ctx.has_norms
             for s in range(S):
                 p = self.sp.shards[s]
-                ck = (s, t.fld, t.term, round(avgdl, 9))
+                nw = wand.windows_for(p.num_docs)
+                ck = (s, t.fld, t.term, round(avgdl, 9), p.num_docs)
                 got = ubf_cache.get(ck)
                 if got is None:
                     start, count, _df = p.term_blocks(t.fld, t.term)
@@ -642,7 +643,7 @@ class StackedSearcher:
                         p, start, count, avgdl, has_norms,
                         self.ctx.k1, self.ctx.b,
                     )
-                    wu = wand.window_ub_csr(p, r, u, p.num_docs)
+                    wu = wand.window_ub_csr(p, r, u, p.num_docs, nw)
                     got = ubf_cache[ck] = (r, u, wu)
                 r, u, wu = got
                 rows_s.append(r)
@@ -670,25 +671,33 @@ class StackedSearcher:
             for s in range(S):
                 if info["dense"] is not None:
                     nd = self.sp.shards[s].num_docs
-                    dk = (s, info["dense"], round(info["avgdl"], 9))
+                    nw = wand.windows_for(nd)
+                    dk = (s, info["dense"], round(info["avgdl"], 9), nd)
                     got = dense_win.get(dk)
                     if got is None:
                         got = wand.window_tfn_dense(
                             self.sp.dense_tfn_host(info["dense"], s,
-                                                   info["avgdl"]), nd)
+                                                   info["avgdl"]), nd, nw)
                         dense_win[dk] = got
                     win_ub[s][ti] = info["weight"] * got
                 else:
                     win_ub[s][ti] = info["win"][s]
 
-        def synth(row_lists):
+        def synth(row_lists, inline_lists=None):
             """params + struct keys for the disjunction with each CSR term's
             block rows replaced by row_lists[t][s] (bucketed to a common
-            width across shards)."""
+            width across shards), or — when inline_lists[t] is set — by
+            synthetic posting arrays (docids, tfs, dls) per shard (the
+            doc-level pruned form; TermNode 5-tuple params)."""
             per_shard_params, term_keys = [], []
             widths = {}
             for ti, info in enumerate(infos):
-                if info["dense"] is None:
+                if info["dense"] is not None:
+                    continue
+                if inline_lists is not None and inline_lists[ti] is not None:
+                    widths[ti] = wand.bucket_width(max(
+                        inline_lists[ti][s][0].shape[0] for s in range(S)))
+                else:
                     widths[ti] = wand.bucket_width(max(
                         len(row_lists[ti][s]) for s in range(S)))
             for s in range(S):
@@ -700,6 +709,21 @@ class StackedSearcher:
                         sp_params.append((np.int32(info["dense"]), w, ad))
                         if s == 0:
                             term_keys.append(("term_dense", t.fld))
+                    elif inline_lists is not None and inline_lists[ti] is not None:
+                        d_, t_, l_ = inline_lists[ti][s]
+                        wd = widths[ti]
+                        nd = self.sp.shards[s].num_docs
+                        pad = wd - d_.shape[0]
+                        if pad:
+                            d_ = np.concatenate(
+                                [d_, np.full((pad, d_.shape[1]), nd, np.int32)])
+                            t_ = np.concatenate(
+                                [t_, np.zeros((pad, t_.shape[1]), np.float32)])
+                            l_ = np.concatenate(
+                                [l_, np.ones((pad, l_.shape[1]), np.float32)])
+                        sp_params.append((d_, t_, l_, w, ad))
+                        if s == 0:
+                            term_keys.append(("term_inline", t.fld, wd))
                     else:
                         sp_params.append(
                             (wand.pad_rows_to(row_lists[ti][s], widths[ti]),
@@ -726,33 +750,38 @@ class StackedSearcher:
         valid1 = np.isfinite(g_scores1)
         theta = float(g_scores1[k - 1]) if valid1.sum() >= k else -np.inf
 
-        # ---- pass 2: keep blocks that can still reach θ
-        p2_rows = []
+        # ---- pass 2: doc-level pruning — drop every posting whose exact
+        # self score + other-terms' window bound cannot reach θ, compact
+        # survivors into synthetic blocks (query/wand.prune_postings)
+        p2_inline = []
         kept = dropped = 0
         boost = float(node.boost)
-        for ti, info in enumerate(infos):
+        has_norms_of = {t.fld: t.fld in self.ctx.has_norms for t in terms}
+        for ti, (t, info) in enumerate(zip(terms, infos)):
             if info["dense"] is not None:
-                p2_rows.append(None)
+                p2_inline.append(None)
                 continue
-            rows_s = []
+            arrs_s = []
             for s in range(S):
-                nd = self.sp.shards[s].num_docs
-                # Σ of the OTHER terms' window bounds (max-of-sum over each
-                # block's span inside prune_blocks is a valid, tighter bound
-                # than sum-of-max)
+                p = self.sp.shards[s]
+                nd = p.num_docs
+                nw = wand.windows_for(nd)
+                # Σ of the OTHER terms' window bounds at each window
                 other = np.sum(
                     [win_ub[s][tj] for tj in range(len(infos)) if tj != ti],
                     axis=0, dtype=np.float32)
-                surv = wand.prune_blocks(
-                    self.sp.shards[s], nd, info["rows"][s], info["ubs"][s],
-                    other, theta / boost)
-                rows_s.append(surv)
-                kept += len(surv)
-                dropped += len(info["rows"][s]) - len(surv)
-            p2_rows.append(rows_s)
+                d_, t_, l_, kp, tot = wand.prune_postings(
+                    p, nd, info["rows"][s], info["weight"] * boost,
+                    info["avgdl"], has_norms_of[t.fld],
+                    self.ctx.k1, self.ctx.b,
+                    other * boost, theta, nw)
+                arrs_s.append((d_, t_, l_))
+                kept += kp
+                dropped += tot - kp
+            p2_inline.append(arrs_s)
         if dropped == 0:
             return None  # pruning bought nothing; use the exhaustive plan
-        params2, keys2 = synth(p2_rows)
+        params2, keys2 = synth(None, p2_inline)
         fn2 = self._compiled(node, ("wand2", keys2), k, None, ())
         g_scores, g_shard, g_doc, total, _ = jax.device_get(
             fn2(self.dev, params2, {}))
@@ -768,6 +797,8 @@ class StackedSearcher:
             None,
         )
         out.total_relation = "gte"
+        # kept/dropped count POSTINGS since the round-3 doc-level pruning
+        # (block-level pruning cannot help mid-frequency disjunctions)
         out.wand_stats = {"rows_kept": kept, "rows_pruned": dropped,
                           "theta": theta}
         return out
@@ -1019,3 +1050,115 @@ class StackedSearcher:
             for i, v in zip(take, values)
         ]
         return hits, int(totals.sum()), aggregations
+
+
+def msearch_sharded(ss: "StackedSearcher", fld: str,
+                    queries: list, k: int = 10):
+    """Batched multi-query term-disjunction `_msearch` over the shard mesh.
+
+    The production C5 shape: per-shard batch plans (one BatchPlan per shard,
+    stacked to [S, ...]) run the batched disjunction kernel inside shard_map,
+    and the coordinator merge applies the reference's
+    (score desc, shard asc, doc asc) order (reference behavior:
+    action/search/TransportMultiSearchAction.java fan-out +
+    SearchPhaseController.java:232 TopDocs.merge). On one chip the same body
+    runs under vmap; on a mesh the gather of the [S, Q, k] partials rides
+    ICI collectives.
+
+    -> (scores [Q, k], shard [Q, k], docid [Q, k], totals [Q]) numpy.
+    """
+    from ..ops.batched import BatchTermSearcher, batch_term_disjunction
+
+    sp = ss.sp
+    S = sp.S
+    adapters = [_PlanShardAdapter(sp, s, ss) for s in range(S)]
+    plans = [BatchTermSearcher(a).plan(fld, queries, k) for a in adapters]
+    ts_max = max(p.sparse_rows.shape[1] for p in plans)
+    b_max = max(p.sparse_rows.shape[2] for p in plans)
+    for s in range(S):  # pad in place to the common shape (row 0 = padding)
+        sr = plans[s].sparse_rows
+        plans[s].sparse_rows = np.pad(
+            sr, ((0, 0), (0, ts_max - sr.shape[1]), (0, b_max - sr.shape[2]))
+        )
+        sw = plans[s].sparse_weights
+        plans[s].sparse_weights = np.pad(
+            sw, ((0, 0), (0, ts_max - sw.shape[1]))
+        )
+    Q = len(queries)
+    W = np.stack([p.W for p in plans])  # [S, Q, V]
+    rows = np.stack([p.sparse_rows for p in plans])
+    ws = np.stack([p.sparse_weights for p in plans])
+    # effective (override-aware) stats with the empty-field 1.0 guard —
+    # raw field_stats would diverge from the tier under tiered refresh
+    avgdl = adapters[0].pack.avgdl(fld)
+    has_norms = fld in ss.ctx.has_norms
+    n_max = sp.n_max
+    kk = min(max(k, 1), max(n_max, 1))
+    Ts, B = rows.shape[2], rows.shape[3]
+
+    def shard_body(dev1, W1, rows1, ws1):
+        dev = {
+            "post_docids": dev1["post_docids"][0],
+            "post_tfs": dev1["post_tfs"][0],
+            "post_dls": dev1["post_dls"][0],
+            "live": dev1["live"][0],
+        }
+        if "dense_tfn" in dev1:
+            dev["dense_tfn"] = dev1["dense_tfn"][0]
+        v, i, t = batch_term_disjunction(
+            dev, (Ts, B, kk), W1[0], rows1[0], ws1[0],
+            avgdl=avgdl, num_docs=n_max, has_norms=has_norms,
+        )
+        return v[None], i[None], t[None]
+
+    sub = {key: ss.dev[key] for key in
+           ("post_docids", "post_tfs", "post_dls", "live")}
+    if "dense_tfn" in ss.dev:
+        sub["dense_tfn"] = ss.dev["dense_tfn"]
+    cache_key = ("msearch_sharded", fld, Ts, B, kk, Q)
+    fn = ss._cache.get(cache_key)
+    if fn is None:
+        if ss.mesh is not None:
+            def run(dev, W_, rows_, ws_):
+                specs = jax.tree_util.tree_map(lambda _: P("shards"), dev)
+                return jax.shard_map(
+                    shard_body, mesh=ss.mesh,
+                    in_specs=(specs, P("shards"), P("shards"), P("shards")),
+                    out_specs=(P("shards"), P("shards"), P("shards")),
+                )(dev, W_, rows_, ws_)
+        else:
+            def run(dev, W_, rows_, ws_):
+                def body(d1, w1, r1, s1):
+                    return shard_body(
+                        jax.tree_util.tree_map(lambda x: x[None], d1),
+                        w1[None], r1[None], s1[None],
+                    )
+                v, i, t = jax.vmap(body)(dev, W_, rows_, ws_)
+                return v[:, 0], i[:, 0], t[:, 0]
+        fn = ss._cache[cache_key] = jax.jit(run)
+    v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
+                                jnp.asarray(ws)))
+    # coordinator merge: (score desc, shard asc, doc asc)
+    flat_v = v.transpose(1, 0, 2).reshape(Q, -1)
+    flat_i = i.transpose(1, 0, 2).reshape(Q, -1)
+    flat_s = np.broadcast_to(
+        np.repeat(np.arange(S), kk)[None, :], flat_v.shape
+    )
+    order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :kk]
+    return (
+        np.take_along_axis(flat_v, order, axis=1),
+        np.take_along_axis(flat_s, order, axis=1).astype(np.int32),
+        np.take_along_axis(flat_i, order, axis=1),
+        t.sum(axis=0),
+    )
+
+
+class _PlanShardAdapter:
+    """Minimal BatchTermSearcher host adapter for one shard of a stacked
+    pack (planning only — execution happens in msearch_sharded's SPMD
+    body, not through this object)."""
+
+    def __init__(self, sp: StackedPack, s: int, ss: "StackedSearcher"):
+        self.pack = sp.shard_view(s)
+        self.ctx = ss.ctx
+        self.dev = {}

@@ -87,6 +87,13 @@ class _ShardView:
         # global tier decision: identical on every shard (see StackedPack)
         return self.stacked.dense_dict.get((fld, term))
 
+    @property
+    def dense_tfn(self):
+        # batched planning reads only the row-count shape; expose this
+        # shard's raw stacked tier rows (tf, not tfn — never scored here)
+        dt = getattr(self.stacked, "dense_tf", None)
+        return None if dt is None else dt[self.shard_index]
+
     def terms_for_field(self, fld):
         # expansion is per-shard (each shard enumerates its own dictionary),
         # matching the reference's per-shard MultiTermQuery rewrite

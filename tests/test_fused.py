@@ -1,0 +1,192 @@
+"""Fused batched-BM25 path (ops/fused.py): kernel in interpret mode on the
+CPU mesh vs the legacy exact path and the pure-Python oracle.
+
+The fused path is TPU-targeted; ES_TPU_FUSED=force turns it on here so the
+pallas kernel runs through the interpreter with the same program the TPU
+compiles. Corpora are sized to cross several doc tiles and to produce both
+dense-tier and CSR-tail terms."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _force_fused():
+    # scope the fused-path override to THIS module: a process-wide env set
+    # at import time would reroute test_batched's legacy-path coverage
+    mp = pytest.MonkeyPatch()
+    mp.setenv("ES_TPU_FUSED", "force")
+    yield
+    mp.undo()
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.pack import PackBuilder
+from elasticsearch_tpu.ops.batched import BatchTermSearcher
+from elasticsearch_tpu.ops.fused import FusedTermSearcher, plan_fused
+from elasticsearch_tpu.query.executor import ShardSearcher
+
+from reference_scorer import Oracle
+
+
+N_DOCS = 4000
+VOCAB = 300
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    zipf /= zipf.sum()
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    docs = []
+    for _ in range(N_DOCS):
+        ln = max(3, int(rng.poisson(12)))
+        text = " ".join(f"t{t}" for t in rng.choice(VOCAB, size=ln, p=zipf))
+        docs.append({"body": text})
+        b.add_document(m.parse_document(docs[-1]))
+    # dense_min_df low enough that a real dense tier exists at 4k docs
+    pack = b.build(dense_min_df=64)
+    searcher = ShardSearcher(pack, mappings=m)
+    oracle = Oracle(docs, m)
+    return m, pack, searcher, oracle, rng
+
+
+def _queries(rng, n, terms=4):
+    out = []
+    for _ in range(n):
+        ts = dict.fromkeys(f"t{t}" for t in rng.integers(0, VOCAB, size=terms))
+        out.append([(t, 1.0) for t in ts])
+    return out
+
+
+def _oracle_query(terms):
+    return {
+        "bool": {
+            "should": [
+                {"term": {"body": {"value": t, "boost": w}}} for t, w in terms
+            ]
+        }
+    }
+
+
+def _assert_ranking(got_ids, got_vals, want, ctx=()):
+    """Ranking equality up to fp-ties: the engine scores in f32, the oracle
+    in python f64, so docs whose scores agree to ~1e-5 relative may swap
+    (same contract as test_batched._assert_hits_match)."""
+    want_ids = [d for d, _ in want]
+    want_vals = [s for _, s in want]
+    assert len(got_ids) == len(want_ids), (*ctx, got_ids, want_ids)
+    np.testing.assert_allclose(got_vals, want_vals, rtol=2e-5)
+    for pos, (gi, ri) in enumerate(zip(got_ids, want_ids)):
+        if gi != ri:
+            a, b = float(got_vals[pos]), float(want_vals[pos])
+            assert abs(a - b) <= 2e-5 * max(abs(b), 1.0), (*ctx, pos, gi, ri)
+
+
+def test_fused_usable_under_force(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    assert FusedTermSearcher.usable(pack, 10)
+
+
+def test_fused_matches_oracle(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    bts = BatchTermSearcher(searcher)
+    fs = FusedTermSearcher(bts)
+    queries = _queries(rng, 24)
+    fv, fi, ft, _ = fs.msearch("body", queries, 10)
+    for q, terms in enumerate(queries):
+        ranked, total = oracle.search(_oracle_query(terms), size=10)
+        mask = np.isfinite(fv[q])
+        _assert_ranking(fi[q][mask], fv[q][mask], ranked, (q, terms))
+        assert ft[q] == total
+
+
+def test_fused_matches_legacy_exact_path(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    bts = BatchTermSearcher(searcher)
+    fs = FusedTermSearcher(bts)
+    queries = _queries(rng, 40)
+    fv, fi, ft, fok = fs.msearch("body", queries, 10)
+    ev, ei, et = [
+        np.asarray(x) for x in bts.run("body", bts.plan("body", queries, 10))
+    ]
+    for q in range(len(queries)):
+        fmask = np.isfinite(fv[q])
+        emask = np.isfinite(ev[q])
+        assert fmask.sum() == emask.sum(), f"query {q} hit-count mismatch"
+        # rankings agree except where the two paths' summation orders
+        # produce fp-ties (same tolerance contract as test_batched)
+        for pos, (gi, ri) in enumerate(zip(fi[q][fmask], ei[q][emask])):
+            if gi != ri:
+                a = float(fv[q][fmask][pos])
+                b = float(ev[q][emask][pos])
+                assert abs(a - b) <= 1e-5 * max(abs(b), 1.0), (q, pos, gi, ri)
+    assert np.array_equal(ft, et)
+
+
+def test_fused_msearch_entry_point(corpus):
+    """BatchTermSearcher.msearch routes to the fused path under force."""
+    m, pack, searcher, oracle, rng = corpus
+    bts = BatchTermSearcher(searcher)
+    queries = _queries(rng, 6)
+    sv, si, st, ok = bts.msearch("body", queries, 10)
+    for q, terms in enumerate(queries):
+        ranked, total = oracle.search(_oracle_query(terms), size=10)
+        mask = np.isfinite(sv[q])
+        _assert_ranking(si[q][mask], sv[q][mask], ranked, (q,))
+        assert st[q] == total
+
+
+def test_fused_single_and_absent_terms(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    bts = BatchTermSearcher(searcher)
+    fs = FusedTermSearcher(bts)
+    queries = [
+        [("t0", 1.0)],  # densest term
+        [(f"t{VOCAB-1}", 1.0)],  # rare CSR term
+        [("zz_missing", 1.0)],  # absent term
+        [("t0", 2.5), (f"t{VOCAB-1}", 0.5)],  # boosts
+    ]
+    fv, fi, ft, _ = fs.msearch("body", queries, 10)
+    assert ft[2] == 0 and not np.isfinite(fv[2]).any()
+    for q in (0, 1, 3):
+        ranked, total = oracle.search(_oracle_query(queries[q]), size=10)
+        mask = np.isfinite(fv[q])
+        _assert_ranking(fi[q][mask], fv[q][mask], ranked, (q,))
+        assert ft[q] == total
+
+
+def test_fused_deleted_docs(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    old_live = pack.live
+    live = old_live.copy()
+    live[100:600] = False
+    pack.live = live
+    try:
+        s2 = ShardSearcher(pack, mappings=m)
+        bts2 = BatchTermSearcher(s2)
+        fs2 = FusedTermSearcher(bts2)
+        queries = _queries(rng, 8)
+        fv, fi, ft, _ = fs2.msearch("body", queries, 10)
+        assert not np.isin(
+            fi[np.isfinite(fv)], np.arange(100, 600)
+        ).any()
+        for q, terms in enumerate(queries):
+            ranked_all, _ = oracle.search(_oracle_query(terms), size=N_DOCS)
+            alive = [(d, sc) for d, sc in ranked_all if not 100 <= d < 600]
+            mask = np.isfinite(fv[q])
+            _assert_ranking(fi[q][mask], fv[q][mask], alive[:10], (q,))
+            assert ft[q] == len(alive)
+    finally:
+        pack.live = old_live
+
+
+def test_plan_fused_block_row_layout(corpus):
+    m, pack, searcher, oracle, rng = corpus
+    queries = _queries(rng, 5)
+    plan = plan_fused(pack, "body", queries, 10)
+    assert plan.W.shape[0] == 512
+    assert (plan.row_w[plan.rows == 0] == 0).all()
+    # block rows reference real CSR ranges of their terms
+    assert plan.rows.max() < pack.post_docids.shape[0]
