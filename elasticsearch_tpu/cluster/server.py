@@ -173,6 +173,9 @@ def main(argv=None):
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--peers", required=True,
                     help="n1=host:port,n2=host:port,... (voting config)")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="serve the cluster REST gateway on this port "
+                         "(every node answers every data-plane API)")
     args = ap.parse_args(argv)
 
     peers: dict[str, tuple[str, int]] = {}
@@ -183,11 +186,20 @@ def main(argv=None):
     server = NodeServer(args.node_id, sorted(peers), peers,
                         host=args.host, port=args.port)
     server.start()
-    print(f"node [{args.node_id}] listening on {args.host}:{server.port}",
+    gateway = None
+    if args.http_port is not None:
+        from .http import HttpGateway
+
+        gateway = HttpGateway(server, host=args.host,
+                              port=args.http_port).start()
+    print(f"node [{args.node_id}] listening on {args.host}:{server.port}"
+          + (f", http {gateway.port}" if gateway else ""),
           flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
+        if gateway:
+            gateway.close()
         server.close()
 
 

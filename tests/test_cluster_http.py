@@ -1,0 +1,109 @@
+"""Cluster REST gateway (cluster/http.py): every node serves the data-plane
+REST APIs over the TCP cluster, and a master kill is transparent to HTTP
+clients (reference: every node registers every REST handler —
+ActionModule.java:434,822)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.cluster.http import (
+    HttpGateway,
+    http_request as _http_req,
+    wait_for_http as _wait_for,
+)
+from elasticsearch_tpu.cluster.server import NodeServer
+
+
+def _http(method, port, path, body=None, timeout=30.0):
+    return _http_req(port, method, path, body, timeout=timeout)
+
+
+def _wait(port, pred, path="/_cluster/health", timeout=60.0):
+    return _wait_for(port, pred, path=path, timeout=timeout)
+
+
+@pytest.fixture
+def cluster():
+    ids = ["n1", "n2", "n3"]
+    servers = {nid: NodeServer(nid, ids, {}, port=0) for nid in ids}
+    for nid, s in servers.items():
+        for other, o in servers.items():
+            if other != nid:
+                s.network.add_peer(other, "127.0.0.1", o.port)
+    gateways = {}
+    for nid, s in servers.items():
+        s.start()
+        gateways[nid] = HttpGateway(s).start()
+    try:
+        yield servers, gateways
+    finally:
+        for g in gateways.values():
+            g.close()
+        for s in servers.values():
+            s.close()
+
+
+def test_rest_data_plane_and_master_failover(cluster):
+    servers, gateways = cluster
+    ports = {n: g.port for n, g in gateways.items()}
+
+    h = _wait(ports["n1"], lambda h: h.get("master_node")
+              and h.get("number_of_nodes") == 3)
+    master = h["master_node"]
+
+    # metadata ops through a non-master node
+    other = next(n for n in ports if n != master)
+    st, r = _http("PUT", ports[other], "/docs", {
+        "mappings": {"properties": {"body": {"type": "text"}}},
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+    })
+    assert st == 200 and r["acknowledged"], r
+    _wait(ports["n1"], lambda h: h["status"] == "green", timeout=90.0)
+    st, r = _http("PUT", ports[other], "/docs", {})
+    assert st == 400 and r["error"]["type"] == "resource_already_exists_exception"
+
+    # bulk via one node, doc CRUD + search via the others
+    bulk = "".join(
+        json.dumps({"index": {"_index": "docs", "_id": f"d{i}"}}) + "\n"
+        + json.dumps({"body": f"quick brown fox {i}"}) + "\n"
+        for i in range(12)
+    )
+    st, r = _http("POST", ports["n2"], "/_bulk", bulk, timeout=90.0)
+    assert st == 200 and not r["errors"], r
+    st, g = _http("GET", ports["n3"], "/docs/_doc/d5")
+    assert st == 200 and g["_source"]["body"] == "quick brown fox 5"
+    st, missing = _http("GET", ports["n3"], "/docs/_doc/nope")
+    assert st == 404 and not missing["found"]
+    st, r = _http("POST", ports["n1"], "/docs/_search",
+                  {"query": {"match": {"body": "fox"}}, "size": 3},
+                  timeout=90.0)
+    assert st == 200 and r["hits"]["total"]["value"] == 12
+    st, r = _http("GET", ports["n1"], "/nope/_search")
+    assert st == 404 and r["error"]["type"] == "index_not_found_exception"
+    st, r = _http(
+        "POST", ports["n2"], "/_msearch",
+        json.dumps({"index": "docs"}) + "\n"
+        + json.dumps({"query": {"match": {"body": "quick"}}, "size": 1}) + "\n"
+        + json.dumps({"index": "nope"}) + "\n"
+        + json.dumps({"query": {"match_all": {}}}) + "\n",
+        timeout=90.0)
+    assert r["responses"][0]["hits"]["total"]["value"] == 12
+    assert r["responses"][1]["status"] == 404
+
+    # kill the master PROCESS-equivalent (close its server + gateway);
+    # the surviving nodes re-elect and keep serving reads and writes
+    gateways.pop(master).close()
+    servers.pop(master).close()
+    rest = list(ports)
+    rest.remove(master)
+    h = _wait(ports[rest[0]], lambda h: h.get("master_node") in rest
+              and h.get("number_of_nodes") == 2, timeout=90.0)
+    _wait(ports[rest[0]], lambda h: h["status"] == "green", timeout=90.0)
+    _wait(ports[rest[1]], lambda r: r.get("count") == 12,
+          path="/docs/_count", timeout=60.0)
+    st, r = _http("POST", ports[rest[0]], "/docs/_doc/d12",
+                  {"body": "after failover"}, timeout=90.0)
+    assert st == 201 and r["result"] == "created", r
+    _wait(ports[rest[1]], lambda r: r.get("count") == 13,
+          path="/docs/_count", timeout=60.0)
