@@ -136,6 +136,7 @@ def _fused_kernel(
     oi_ref,  # [QSUB, KB] i32
     ot_ref,  # [QSUB, 1] f32 (exact match counts)
     of_ref,  # [QSUB, 1] f32 (overflow flags)
+    sacc,  # VMEM [QSUB, TILE_N] f32 (per-step sparse accumulator)
     acc_v,  # VMEM [QC, KB] f32
     acc_i,  # VMEM [QC, KB] i32
     cnt,  # VMEM [QC, 1] f32
@@ -166,54 +167,64 @@ def _fused_kernel(
     end = ptr_ref[base + 1]
 
     # ---- one-hot expansion: the MXU as a segmented scatter-add ----------
+    # The window is several times wider than the tile's real candidate run
+    # (block quantization + the >= 1024-entry block floor), so each
+    # 128-entry row is gated by a scalar range test on its sorted keys:
+    # rows that cannot intersect (subtile i, tile j) skip their one-hot
+    # build and both MXU passes — the dominant kernel cost at Zipf loads.
     qrow = jax.lax.broadcasted_iota(jnp.int32, (qsub, 128), 0)
     nrow = jax.lax.broadcasted_iota(jnp.int32, (tile_n, 128), 0)
     one = jnp.float32(1.0)
     zero = jnp.float32(0.0)
     rows_per_blk = P // 128
     dn = (((1,), (1,)), ((), ()))
-    sparse = None
+    key_lo = (i << jnp.int32(sb)) | (j * tile_n << jnp.int32(qb))
+    key_hi = (i << jnp.int32(sb)) | ((j + 1) * tile_n << jnp.int32(qb))
+    sacc[...] = jnp.zeros_like(sacc)
     for c in range(2 * rows_per_blk):
         if c < rows_per_blk:
-            key = keya_ref[c : c + 1, :]  # [1, 128]
-            val = jax.lax.bitcast_convert_type(
-                vala_ref[c : c + 1, :], jnp.float32
-            )
+            key_ref, val_ref, cc = keya_ref, vala_ref, c
         else:
-            key = keyb_ref[c - rows_per_blk : c - rows_per_blk + 1, :]
+            key_ref, val_ref, cc = keyb_ref, valb_ref, c - rows_per_blk
+        first = key_ref[cc, 0]
+        last = key_ref[cc, 127]
+
+        @pl.when((last >= key_lo) & (first < key_hi))
+        def _(key_ref=key_ref, val_ref=val_ref, cc=cc):
+            key = key_ref[cc : cc + 1, :]  # [1, 128]
             val = jax.lax.bitcast_convert_type(
-                valb_ref[c - rows_per_blk : c - rows_per_blk + 1, :],
-                jnp.float32,
+                val_ref[cc : cc + 1, :], jnp.float32
             )
-        qlow = key & (qsub - 1)
-        doc = jax.lax.shift_right_logical(key, jnp.int32(qb)) & ((1 << db) - 1)
-        off = doc - j * tile_n
-        inwin = (
-            (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
-            & (off >= 0)
-            & (off < tile_n)
-        )
-        At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
-        D = jnp.where((nrow == off) & inwin, one, zero).astype(
-            jnp.bfloat16
-        )  # [tile_n, 128]
-        # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo carries
-        # ~15 mantissa bits through two bf16 MXU passes with f32
-        # accumulation, keeping selection within EPS_SPLIT of the canonical
-        # f32 rescore
-        Ahf = _mask_hi(At)
-        Ah = Ahf.astype(jnp.bfloat16)
-        Al = (At - Ahf).astype(jnp.bfloat16)
-        contrib = jax.lax.dot_general(
-            Ah, D, dn, preferred_element_type=jnp.float32
-        ) + jax.lax.dot_general(
-            Al, D, dn, preferred_element_type=jnp.float32
-        )  # [qsub, tile_n]
-        sparse = contrib if sparse is None else sparse + contrib
+            qlow = key & (qsub - 1)
+            doc = jax.lax.shift_right_logical(
+                key, jnp.int32(qb)
+            ) & ((1 << db) - 1)
+            off = doc - j * tile_n
+            inwin = (
+                (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
+                & (off >= 0)
+                & (off < tile_n)
+            )
+            At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
+            D = jnp.where((nrow == off) & inwin, one, zero).astype(
+                jnp.bfloat16
+            )  # [tile_n, 128]
+            # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo
+            # carries ~15 mantissa bits through two bf16 MXU passes with
+            # f32 accumulation, keeping selection within EPS_SPLIT of the
+            # canonical f32 rescore
+            Ahf = _mask_hi(At)
+            Ah = Ahf.astype(jnp.bfloat16)
+            Al = (At - Ahf).astype(jnp.bfloat16)
+            sacc[...] += jax.lax.dot_general(
+                Ah, D, dn, preferred_element_type=jnp.float32
+            ) + jax.lax.dot_general(
+                Al, D, dn, preferred_element_type=jnp.float32
+            )  # [qsub, tile_n]
 
     dense = scores_ref[:].astype(jnp.float32)
     lv = live_ref[0:1, :] > 0
-    total = dense + sparse
+    total = dense + sacc[...]
     total = jnp.where(lv & (total > 0), total, -jnp.inf)
     ids = j * tile_n + jax.lax.broadcasted_iota(jnp.int32, total.shape, 1)
 
@@ -224,8 +235,32 @@ def _fused_kernel(
     lost = end > ptrb_ref[base] * P + 2 * P
     ovf[rs] += jnp.broadcast_to(lost.astype(jnp.float32), (qsub, 1))
 
-    # ---- top-K' maintenance: buffered merge ------------------------------
-    @pl.when(j < warm)
+    # ---- top-K' maintenance: tiered merges --------------------------------
+    # Only a tile's top-T entries enter the accumulator (a kb x (kb+T)
+    # merge instead of kb x (kb+tile_n)); a query with > T entries above
+    # its current K'th score in ONE tile would lose entries -> flag it for
+    # the rerun escalation. The expected new-entry count per tile is
+    # lambda ~ kb/j, so T steps down as the scan warms: full merge while
+    # lambda >= 1 (j < kb), top-8 through the warm-up window
+    # (P(Poisson(1) > 8) ~ 1e-6), top-4 after (lambda <= kb/warm ~ 0.26,
+    # P(X > 4) ~ 1e-4). Starting top-8 at j=8 flagged ~6% of bench
+    # queries (lambda = 4 there -> P(X > 8) ~ 2% per tile).
+    def _carry(t):
+        theta = acc_v[rs][:, kb - 1 : kb]
+        c_above = jnp.sum(
+            total > theta, axis=1, keepdims=True, dtype=jnp.int32
+        )
+        ovf[rs] += (c_above > t).astype(jnp.float32)
+        tv_, ti_ = _topk_rounds(total, ids, t)
+        mv, mi = _topk_rounds(
+            jnp.concatenate([acc_v[rs], tv_], axis=1),
+            jnp.concatenate([acc_i[rs], ti_], axis=1),
+            kb,
+        )
+        acc_v[rs] = mv
+        acc_i[rs] = mi
+
+    @pl.when(j < kb)
     def _():
         mv, mi = _topk_rounds(
             jnp.concatenate([acc_v[rs], total], axis=1),
@@ -235,28 +270,13 @@ def _fused_kernel(
         acc_v[rs] = mv
         acc_i[rs] = mi
 
+    @pl.when((j >= kb) & (j < warm))
+    def _():
+        _carry(8)
+
     @pl.when(j >= warm)
     def _():
-        # post-warm-up fast path: only a tile's top-4 entries are carried
-        # into the accumulator (a 32x36 merge instead of 32x1056). A query
-        # with >4 entries above its current K'th score in ONE tile would
-        # lose entries -> flag it for the rerun escalation. Top-4 (not
-        # top-2) + the nj/8 warm-up keep the flag probability ~1e-4: the
-        # expected new-entry count per tile is kb/j, and P(Poisson(kb/j)>4)
-        # is negligible once j > warm.
-        theta = acc_v[rs][:, kb - 1 : kb]
-        c_above = jnp.sum(
-            total > theta, axis=1, keepdims=True, dtype=jnp.int32
-        )
-        ovf[rs] += (c_above > 4).astype(jnp.float32)
-        t4v, t4i = _topk_rounds(total, ids, 4)
-        mv, mi = _topk_rounds(
-            jnp.concatenate([acc_v[rs], t4v], axis=1),
-            jnp.concatenate([acc_i[rs], t4i], axis=1),
-            kb,
-        )
-        acc_v[rs] = mv
-        acc_i[rs] = mi
+        _carry(4)
 
     @pl.when(j == nj - 1)
     def _():
@@ -296,7 +316,7 @@ def fused_sparse_topk(
     kernel = functools.partial(
         _fused_kernel,
         kb=kb, tile_n=tile_n, P=P, qsub=qsub, qb=qb, db=db, sb=sb,
-        nj=nj, warm=min(warm, max(16, nj // 8)),
+        nj=nj, warm=min(warm, max(kb, nj // 8)),
     )
     nblk = keys.shape[0] * 128 // P
     ptr_blk = jnp.minimum(ptr // P, nblk - 2)
@@ -331,6 +351,7 @@ def fused_sparse_topk(
             pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
         ],
         scratch_shapes=[
+            pltpu.VMEM((qsub, tile_n), jnp.float32),
             pltpu.VMEM((qc, kb), jnp.float32),
             pltpu.VMEM((qc, kb), jnp.int32),
             pltpu.VMEM((qc, 1), jnp.float32),
@@ -406,9 +427,11 @@ class FusedPlan:
     term weight per row — no per-query shape bucketing at all. R and Td pad
     to powers of two so every batch reuses a tiny compiled-shape family."""
 
-    __slots__ = ("W", "rows", "row_q", "row_w", "dense_rows", "dense_w", "k")
+    __slots__ = ("W", "rows", "row_q", "row_w", "dense_rows", "dense_w",
+                 "k", "nreal")
 
-    def __init__(self, W, rows, row_q, row_w, dense_rows, dense_w, k):
+    def __init__(self, W, rows, row_q, row_w, dense_rows, dense_w, k,
+                 nreal=0):
         self.W = W
         self.rows = rows
         self.row_q = row_q
@@ -416,6 +439,7 @@ class FusedPlan:
         self.dense_rows = dense_rows
         self.dense_w = dense_w
         self.k = k
+        self.nreal = nreal
 
 
 def plan_fused(pack, fld, queries, k, qc=QC):
@@ -468,7 +492,8 @@ def plan_fused(pack, fld, queries, k, qc=QC):
         for ti, (dr, w) in enumerate(dlist):
             dense_rows[qi, ti] = dr
             dense_w[qi, ti] = w
-    return FusedPlan(W, rows, row_q, row_w, dense_rows, dense_w, k)
+    return FusedPlan(W, rows, row_q, row_w, dense_rows, dense_w, k,
+                     nreal=nreal)
 
 
 def _fused_pipeline(
@@ -618,17 +643,17 @@ class FusedTermSearcher:
             }
         return self._fa
 
-    def _compiled(self, fld, R, Td, k, interpret):
+    def _compiled(self, fld, R, Td, k, nreal, interpret):
         pack = self.searcher.pack
         n = pack.num_docs
         n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
         nj = n_pad // TILE_N
-        G = R * BLOCK
-        mean_win = max(1, G // ((QC // QSUB) * nj))
-        # 2x the mean window load: the two-block window covers 4x the mean
-        # (P-block pair), overflow flags catch tail skew. Larger P wastes
-        # VMEM: the [P, 2] kv blocks lane-pad 64x.
-        # floor 1024: the [P/128, 128] window blocks need >= 8 sublanes
+        # window sizing follows the REAL posting count (R counts padded
+        # slots — up to ~40% at Zipf loads, which doubles P for nothing),
+        # quantized in pow2 steps so batch-to-batch jitter cannot flap the
+        # compile key; floor 1024: [P/128, 128] blocks need >= 8 sublanes
+        nreal_q = 1 << max(nreal - 1, 1).bit_length()
+        mean_win = max(1, nreal_q * BLOCK // ((QC // QSUB) * nj))
         P = min(4096, max(1024, 1 << (2 * mean_win - 1).bit_length()))
         key = (fld, R, Td, k, interpret, P)
         fn = self._cache.get(key)
@@ -650,7 +675,7 @@ class FusedTermSearcher:
         plan = plan_fused(self.searcher.pack, fld, queries, k)
         fn = self._compiled(
             fld, plan.rows.shape[0], plan.dense_rows.shape[1],
-            k, interpret,
+            k, plan.nreal, interpret,
         )
         outs = fn(
             self._arrays(),
