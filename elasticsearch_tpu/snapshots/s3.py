@@ -1,0 +1,234 @@
+"""S3-compatible object-store repository (VERDICT r2 #7).
+
+The reference's cloud snapshot story is the repository-s3 plugin
+(modules/repository-s3/.../S3Repository.java:1, S3BlobContainer.java) over
+the AWS SDK. Here the Repository blob contract (read/write/exists/delete/
+list) maps straight onto five S3 REST calls — GetObject, PutObject,
+HeadObject, DeleteObject, ListObjectsV2 — with self-contained AWS
+Signature V4 signing (hmac/sha256; the canonical-request recipe is public
+AWS documentation) and an INJECTABLE HTTP transport:
+
+  - production: urllib against any S3-compatible endpoint (AWS, GCS
+    interop, minio, ceph-rgw);
+  - tests: the in-process minio-style fake in tests/test_s3_repository.py
+    (real sockets, verifies the SigV4 header shape) — the analog of the
+    reference's S3HttpFixture-based repository tests.
+
+Credentials resolve like the reference's secure settings
+(s3.client.default.access_key / secret_key in the keystore —
+S3ClientSettings.java) with explicit settings taking precedence.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from ..utils.errors import IllegalArgumentError
+from .repository import Repository, SnapshotMissingError
+
+
+def _urllib_http(method: str, url: str, headers: dict, body: bytes | None):
+    """Default transport: -> (status, body bytes)."""
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60.0) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class SigV4Signer:
+    """AWS Signature Version 4 request signing (public AWS spec)."""
+
+    def __init__(self, access_key: str, secret_key: str, region: str,
+                 service: str = "s3"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.service = service
+
+    def sign(self, method: str, url: str, body: bytes | None,
+             now: datetime.datetime | None = None) -> dict:
+        u = urllib.parse.urlsplit(url)
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        payload_hash = _sha256(body or b"")
+        headers = {
+            "host": u.netloc,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed = ";".join(sorted(headers))
+        canonical_qs = "&".join(
+            sorted(
+                f"{urllib.parse.quote(k, safe='')}="
+                f"{urllib.parse.quote(v, safe='')}"
+                for k, v in urllib.parse.parse_qsl(
+                    u.query, keep_blank_values=True
+                )
+            )
+        )
+        # the path arrives ALREADY percent-encoded (_url quotes the key);
+        # re-quoting here would sign %25.. while the wire carries %.. and
+        # every real endpoint would answer SignatureDoesNotMatch
+        canonical = "\n".join([
+            method,
+            u.path or "/",
+            canonical_qs,
+            "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+            signed,
+            payload_hash,
+        ])
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope, _sha256(canonical.encode()),
+        ])
+        k = _hmac(f"AWS4{self.secret_key}".encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, self.service)
+        k = _hmac(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}"
+        )
+        return headers
+
+
+class S3Repository(Repository):
+    """Blob repository over any S3-compatible endpoint.
+
+    settings: bucket (required), endpoint (required here — no baked-in
+    AWS endpoints in an egressless runtime), base_path, region,
+    access_key/secret_key (else the keystore's
+    s3.client.default.{access_key,secret_key}).
+    """
+
+    def __init__(self, settings: dict, *, http=None, keystore=None):
+        bucket = settings.get("bucket")
+        if not bucket:
+            raise IllegalArgumentError("[bucket] is required for s3 repositories")
+        endpoint = settings.get("endpoint")
+        if not endpoint:
+            raise IllegalArgumentError("[endpoint] is required for s3 repositories")
+        if not endpoint.startswith(("http://", "https://")):
+            endpoint = "https://" + endpoint
+        self.bucket = bucket
+        self.endpoint = endpoint.rstrip("/")
+        self.base_path = (settings.get("base_path") or "").strip("/")
+        region = settings.get("region", "us-east-1")
+
+        def secure(key, fallback):
+            if settings.get(key):
+                return settings[key]
+            if keystore is not None:
+                try:
+                    v = keystore.get(f"s3.client.default.{key}")
+                    if v:
+                        return v
+                except Exception:  # noqa: BLE001 - keystore optional
+                    pass
+            return fallback
+
+        self.signer = SigV4Signer(
+            secure("access_key", "anonymous"),
+            secure("secret_key", "anonymous"),
+            region,
+        )
+        self.http = http or _urllib_http
+
+    # ---- request plumbing ------------------------------------------------
+
+    def _key(self, name: str) -> str:
+        if ".." in name or name.startswith("/"):
+            raise IllegalArgumentError(f"invalid blob name [{name}]")
+        return f"{self.base_path}/{name}" if self.base_path else name
+
+    def _url(self, key: str, query: str = "") -> str:
+        path = f"/{self.bucket}/" + urllib.parse.quote(key)
+        return self.endpoint + path + (f"?{query}" if query else "")
+
+    def _call(self, method: str, key: str, body: bytes | None = None,
+              query: str = ""):
+        url = self._url(key, query)
+        headers = self.signer.sign(method, url, body)
+        if body is not None:
+            headers["content-length"] = str(len(body))
+        return self.http(method, url, headers, body)
+
+    # ---- Repository contract --------------------------------------------
+
+    def read(self, name: str) -> bytes:
+        status, body = self._call("GET", self._key(name))
+        if status == 404:
+            raise SnapshotMissingError(f"blob [{name}] missing")
+        if status != 200:
+            raise IOError(f"s3 GET [{name}] -> {status}")
+        return body
+
+    def write(self, name: str, data: bytes):
+        status, body = self._call("PUT", self._key(name), body=data)
+        if status not in (200, 201):
+            raise IOError(f"s3 PUT [{name}] -> {status}: {body[:200]!r}")
+
+    def exists(self, name: str) -> bool:
+        status, _ = self._call("HEAD", self._key(name))
+        if status not in (200, 404):
+            # 403/5xx must not masquerade as "absent": callers map absence
+            # to snapshot_missing_exception, which would hide auth errors
+            raise IOError(f"s3 HEAD [{name}] -> {status}")
+        return status == 200
+
+    def delete(self, name: str):
+        status, _ = self._call("DELETE", self._key(name))
+        if status not in (200, 204, 404):
+            raise IOError(f"s3 DELETE [{name}] -> {status}")
+
+    def list(self, prefix: str = "") -> list[str]:
+        full_prefix = self._key(prefix) if prefix else self.base_path
+        out: list[str] = []
+        token = None
+        while True:
+            qs = {"list-type": "2", "prefix": full_prefix}
+            if token:
+                qs["continuation-token"] = token
+            query = urllib.parse.urlencode(sorted(qs.items()))
+            url = self.endpoint + f"/{self.bucket}/?{query}"
+            headers = self.signer.sign("GET", url, None)
+            status, body = self.http("GET", url, headers, None)
+            if status != 200:
+                raise IOError(f"s3 LIST [{full_prefix}] -> {status}")
+            ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+            root = ET.fromstring(body)
+            for c in root.findall(f"{ns}Contents/{ns}Key") or root.findall(
+                "Contents/Key"
+            ):
+                key = c.text or ""
+                if self.base_path and key.startswith(self.base_path + "/"):
+                    key = key[len(self.base_path) + 1:]
+                out.append(key)
+            trunc = root.findtext(f"{ns}IsTruncated") or root.findtext(
+                "IsTruncated"
+            )
+            if trunc != "true":
+                break
+            token = root.findtext(
+                f"{ns}NextContinuationToken"
+            ) or root.findtext("NextContinuationToken")
+        return out

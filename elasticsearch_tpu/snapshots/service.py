@@ -48,14 +48,43 @@ class SnapshotService:
     def put_repository(self, name: str, body: dict):
         rtype = body.get("type")
         settings = body.get("settings") or {}
-        if rtype != "fs":
+        if rtype == "fs":
+            repo = FsRepository(settings.get("location"))
+        elif rtype == "s3":
+            from .s3 import S3Repository
+
+            repo = S3Repository(settings, keystore=self._keystore())
+        else:
             raise IllegalArgumentError(
-                f"repository type [{rtype}] does not exist (supported: fs)"
+                f"repository type [{rtype}] does not exist (supported: fs, s3)"
             )
-        repo = FsRepository(settings.get("location"))
-        self.repositories[name] = {"type": rtype, "settings": settings}
+        # credentials never enter repository metadata: GET /_snapshot echoes
+        # settings back to clients (the reference keeps S3 credentials
+        # keystore-only for the same reason — S3ClientSettings.java)
+        public = {k: v for k, v in settings.items()
+                  if k not in ("access_key", "secret_key", "session_token")}
+        self.repositories[name] = {"type": rtype, "settings": public}
         self._repos[name] = repo
         return {"acknowledged": True}
+
+    def _keystore(self):
+        """The node keystore (cli/keystore.py), if one exists under the
+        engine's data path — the s3.client.default.* secure settings
+        source."""
+        import os
+
+        data_path = getattr(self.engine, "data_path", None)
+        if not data_path:
+            return None
+        path = os.path.join(data_path, "elasticsearch.keystore")
+        if not os.path.exists(path):
+            return None
+        from ..cli.keystore import Keystore
+
+        try:
+            return Keystore.load(path)
+        except Exception:  # noqa: BLE001 - wrong password etc: no keystore
+            return None
 
     def get_repository(self, name: str | None = None) -> dict:
         if name in (None, "_all", "*"):

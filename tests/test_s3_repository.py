@@ -1,0 +1,206 @@
+"""S3-compatible repository (snapshots/s3.py) against an in-process
+minio-style fake: snapshot -> delete index -> restore through the object
+store, SigV4 header verification, and a repository-analysis-style
+read-after-write/overwrite/list stress (VERDICT r2 #7; reference:
+modules/repository-s3/.../S3Repository.java:1 and the snapshot-repo-test-kit
+RepositoryAnalyzeAction.java:95)."""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from elasticsearch_tpu.snapshots.repository import SnapshotMissingError
+from elasticsearch_tpu.snapshots.s3 import S3Repository, SigV4Signer
+
+
+class _FakeS3Handler(BaseHTTPRequestHandler):
+    """Just enough S3: object CRUD + ListObjectsV2 with pagination."""
+
+    server_version = "FakeS3/0"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _check_auth(self):
+        auth = self.headers.get("Authorization", "")
+        ok = bool(re.match(
+            r"AWS4-HMAC-SHA256 Credential=\S+/\d{8}/[\w-]+/s3/aws4_request, "
+            r"SignedHeaders=\S+, Signature=[0-9a-f]{64}", auth))
+        self.server.auth_seen.append(ok)
+        return ok
+
+    def _key(self):
+        u = urllib.parse.urlsplit(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        return bucket, key, urllib.parse.parse_qs(u.query)
+
+    def do_PUT(self):
+        self._check_auth()
+        _b, key, _q = self._key()
+        n = int(self.headers.get("Content-Length", 0))
+        self.server.objects[key] = self.rfile.read(n)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        self._check_auth()
+        _b, key, q = self._key()
+        if "list-type" in q:
+            prefix = q.get("prefix", [""])[0]
+            keys = sorted(k for k in self.server.objects if k.startswith(prefix))
+            start = int(q.get("continuation-token", ["0"])[0] or 0)
+            page = keys[start : start + self.server.page_size]
+            truncated = start + len(page) < len(keys)
+            body = ['<?xml version="1.0"?>'
+                    '<ListBucketResult xmlns='
+                    '"http://s3.amazonaws.com/doc/2006-03-01/">']
+            for k in page:
+                body.append(f"<Contents><Key>{k}</Key></Contents>")
+            body.append(f"<IsTruncated>{'true' if truncated else 'false'}"
+                        "</IsTruncated>")
+            if truncated:
+                body.append(f"<NextContinuationToken>{start + len(page)}"
+                            "</NextContinuationToken>")
+            body.append("</ListBucketResult>")
+            data = "".join(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        data = self.server.objects.get(key)
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_HEAD(self):
+        self._check_auth()
+        _b, key, _q = self._key()
+        self.send_response(200 if key in self.server.objects else 404)
+        self.end_headers()
+
+    def do_DELETE(self):
+        self._check_auth()
+        _b, key, _q = self._key()
+        self.server.objects.pop(key, None)
+        self.send_response(204)
+        self.end_headers()
+
+
+@pytest.fixture
+def fake_s3():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3Handler)
+    srv.objects = {}
+    srv.auth_seen = []
+    srv.page_size = 7  # force ListObjectsV2 pagination
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
+
+
+def _repo(srv, **extra):
+    return S3Repository({
+        "bucket": "snaps",
+        "endpoint": f"http://127.0.0.1:{srv.server_address[1]}",
+        "base_path": "cluster-one",
+        "access_key": "AKIATEST",
+        "secret_key": "sekrit",
+        **extra,
+    })
+
+
+def test_blob_contract_and_sigv4(fake_s3):
+    repo = _repo(fake_s3)
+    repo.write("blobs/abc", b"hello world")
+    assert repo.exists("blobs/abc")
+    assert repo.read("blobs/abc") == b"hello world"
+    # overwrite + read-after-write (repo-analysis atomicity check)
+    repo.write("blobs/abc", b"v2")
+    assert repo.read("blobs/abc") == b"v2"
+    repo.delete("blobs/abc")
+    assert not repo.exists("blobs/abc")
+    with pytest.raises(SnapshotMissingError):
+        repo.read("blobs/abc")
+    repo.delete("blobs/abc")  # idempotent
+    # every request carried a well-formed SigV4 Authorization header
+    assert fake_s3.auth_seen and all(fake_s3.auth_seen)
+    # keys live under base_path in the bucket
+    repo.write("index-0", b"{}")
+    assert "cluster-one/index-0" in fake_s3.objects
+
+
+def test_list_paginates(fake_s3):
+    repo = _repo(fake_s3)
+    for i in range(23):
+        repo.write(f"blobs/b{i:02d}", b"x")
+    got = sorted(repo.list("blobs/"))
+    assert got == [f"blobs/b{i:02d}" for i in range(23)]
+    assert repo.list("index-") == []
+
+
+def test_sigv4_is_deterministic():
+    import datetime
+
+    signer = SigV4Signer("AKIA", "secret", "us-east-1")
+    now = datetime.datetime(2026, 1, 2, 3, 4, 5,
+                            tzinfo=datetime.timezone.utc)
+    h1 = signer.sign("GET", "http://host/b/k?a=1&b=2", None, now=now)
+    h2 = signer.sign("GET", "http://host/b/k?b=2&a=1", None, now=now)
+    # canonical query ordering: same signature either way
+    assert h1["authorization"] == h2["authorization"]
+
+
+def test_snapshot_delete_restore_through_s3(fake_s3, tmp_path):
+    from elasticsearch_tpu.engine import Engine
+
+    eng = Engine(str(tmp_path / "data"))
+    try:
+        idx = eng.create_index("logs", {
+            "properties": {"msg": {"type": "text"}}})
+        for i in range(25):
+            idx.index_doc(f"d{i}", {"msg": f"event {i} fox"})
+        idx.refresh()
+        eng.snapshots.put_repository("cloud", {"type": "s3", "settings": {
+            "bucket": "snaps",
+            "endpoint": f"http://127.0.0.1:{fake_s3.server_address[1]}",
+            "base_path": "cluster-one",
+            "access_key": "AKIATEST", "secret_key": "sekrit",
+        }})
+        r = eng.snapshots.create_snapshot("cloud", "snap1")
+        assert r["state"] == "SUCCESS", r
+        assert any(k.startswith("cluster-one/blobs/")
+                   for k in fake_s3.objects), "blobs must live in the store"
+
+        # incrementality: identical data -> no new data blobs
+        n_blobs = sum(1 for k in fake_s3.objects
+                      if k.startswith("cluster-one/blobs/"))
+        eng.snapshots.create_snapshot("cloud", "snap2")
+        n_blobs2 = sum(1 for k in fake_s3.objects
+                       if k.startswith("cluster-one/blobs/"))
+        assert n_blobs2 == n_blobs
+
+        eng.delete_index("logs")
+        eng.snapshots.restore_snapshot("cloud", "snap1")
+        idx2 = eng.get_index("logs")
+        res = idx2.search({"match": {"msg": "fox"}})
+        assert res["hits"]["total"]["value"] == 25
+        got = idx2.get_doc("d7")
+        assert got["_source"]["msg"] == "event 7 fox"
+    finally:
+        eng.close()

@@ -45,7 +45,14 @@ def _blob_count(tmp_path):
 class TestRepository:
     def test_unknown_type_rejected(self, eng):
         with pytest.raises(IllegalArgumentError, match="does not exist"):
+            eng.snapshots.put_repository("bad", {"type": "gcs", "settings": {}})
+
+    def test_s3_requires_bucket_and_endpoint(self, eng):
+        with pytest.raises(IllegalArgumentError, match="bucket"):
             eng.snapshots.put_repository("bad", {"type": "s3", "settings": {}})
+        with pytest.raises(IllegalArgumentError, match="endpoint"):
+            eng.snapshots.put_repository(
+                "bad", {"type": "s3", "settings": {"bucket": "b"}})
 
     def test_missing_repo(self, eng):
         with pytest.raises(RepositoryMissingError):
