@@ -43,6 +43,51 @@ def _node_load(state: ClusterState) -> dict[str, int]:
 # cluster.routing.allocation.node_concurrent_incoming_recoveries)
 NODE_CONCURRENT_RECOVERIES = 4
 
+# disk-threshold watermarks (cluster.routing.allocation.disk.watermark.*;
+# reference: cluster/routing/allocation/decider/DiskThresholdDecider.java:1).
+# The TPU deployment analog is the per-node HBM/host-RAM pack budget: a
+# node advertises {"capacity_bytes": N} in its node info, shard sizes come
+# from index settings ("index.estimated_shard_bytes", defaulting to
+# DEFAULT_SHARD_BYTES). Low: no NEW shard may allocate above it. High: the
+# node must shed shards until back under.
+WATERMARK_LOW = 0.85
+WATERMARK_HIGH = 0.90
+DEFAULT_SHARD_BYTES = 1 << 30
+
+# shard-copy spreading across the "zone" node attribute
+# (cluster.routing.allocation.awareness.attributes; reference:
+# decider/AwarenessAllocationDecider.java). Active whenever any data node
+# carries the attribute.
+AWARENESS_ATTRIBUTE = "zone"
+
+# concurrent shard relocations cluster-wide
+# (cluster.routing.allocation.cluster_concurrent_rebalance; reference:
+# decider/ConcurrentRebalanceAllocationDecider.java)
+CLUSTER_CONCURRENT_REBALANCE = 2
+# rebalance only when the busiest/least-busy shard-count gap exceeds this
+REBALANCE_SLACK = 1
+
+
+def shard_bytes(meta: dict) -> int:
+    v = meta.get("settings", {}).get("index.estimated_shard_bytes")
+    return int(v) if v else DEFAULT_SHARD_BYTES
+
+
+def _node_capacity(state: ClusterState, node: str) -> int | None:
+    cap = state.nodes.get(node, {}).get("capacity_bytes")
+    return int(cap) if cap else None
+
+
+def _node_bytes(state: ClusterState) -> dict[str, int]:
+    """Estimated bytes of shard copies assigned per node."""
+    return _node_bytes_from(state.routing, state.indices, data_nodes(state))
+
+
+def _zone_of(state: ClusterState, node: str) -> str | None:
+    return (state.nodes.get(node, {}).get("attributes") or {}).get(
+        AWARENESS_ATTRIBUTE
+    )
+
 
 def _node_attrs(state: ClusterState, node: str) -> dict:
     info = state.nodes.get(node, {})
@@ -59,7 +104,9 @@ def _matches(patterns: str, value: str) -> bool:
 def can_allocate(state: ClusterState, meta: dict, node: str,
                  assigns: list, node_shard_counts: dict[str, int],
                  node_initializing: dict[str, int],
-                 is_recovery: bool = True) -> bool:
+                 is_recovery: bool = True,
+                 node_bytes: dict[str, int] | None = None,
+                 moving: dict | None = None) -> bool:
     """Decider chain: every decider must say yes (the reference runs 21
     deciders under AllocationDeciders.java; these are the behavioral core):
       - SameShardAllocationDecider: one copy of a shard per node
@@ -67,6 +114,10 @@ def can_allocate(state: ClusterState, meta: dict, node: str,
         exclude.{_name,_id,custom attr} against node attributes
       - ShardsLimitAllocationDecider: index.routing.allocation.total_shards_per_node
       - ThrottlingAllocationDecider: cap concurrent incoming recoveries
+      - DiskThresholdDecider: reject above the low watermark of the node's
+        advertised capacity_bytes (pack-memory budget analog)
+      - AwarenessAllocationDecider: spread copies across the "zone"
+        attribute — a zone may not hold more than ceil(copies/zones)
     """
     if any(a["node"] == node for a in assigns):
         return False  # same-shard
@@ -93,6 +144,29 @@ def can_allocate(state: ClusterState, meta: dict, node: str,
     # primary is placed STARTED with no data transfer
     if is_recovery and node_initializing.get(node, 0) >= NODE_CONCURRENT_RECOVERIES:
         return False
+    # disk threshold (low watermark gates NEW allocations)
+    cap = _node_capacity(state, node)
+    if cap:
+        used = (node_bytes or _node_bytes(state)).get(node, 0)
+        if (used + shard_bytes(meta)) / cap > WATERMARK_LOW:
+            return False
+    # zone awareness: adding here must not over-concentrate a zone. A
+    # relocation's SOURCE copy is discounted — it is cut when the move
+    # completes, and counting it would forbid every same-zone move of a
+    # single-copy shard (the reference decrements the relocating source)
+    zone = _zone_of(state, node)
+    if zone is not None:
+        zones = {z for n in data_nodes(state)
+                 if (z := _zone_of(state, n)) is not None}
+        if len(zones) > 1:
+            counted = [a for a in assigns if a is not moving]
+            copies = len(counted) + 1
+            per_zone = -(-copies // len(zones))  # ceil
+            in_zone = sum(
+                1 for a in counted if _zone_of(state, a["node"]) == zone
+            )
+            if in_zone + 1 > per_zone:
+                return False
     return True
 
 
@@ -103,6 +177,7 @@ def allocate(state: ClusterState) -> ClusterState:
     unchanged)."""
     live = set(data_nodes(state))
     load = _node_load(state)
+    nbytes = _node_bytes(state)
     # concurrent incoming recoveries per node (ThrottlingAllocationDecider)
     node_initializing: dict[str, int] = {}
     for shards in state.routing.values():
@@ -160,7 +235,8 @@ def allocate(state: ClusterState) -> ClusterState:
                         n: load[n] for n in load
                         if can_allocate(state, meta, n, assigns,
                                         index_counts, node_initializing,
-                                        is_recovery=False)
+                                        is_recovery=False,
+                                        node_bytes=nbytes)
                     }
                     if eligible:
                         node = min(eligible, key=lambda n: (eligible[n], n))
@@ -171,6 +247,7 @@ def allocate(state: ClusterState) -> ClusterState:
                         ]
                         in_sync[key] = [aid]
                         load[node] += 1
+                        nbytes[node] = nbytes.get(node, 0) + shard_bytes(meta)
                         index_counts[node] = index_counts.get(node, 0) + 1
                         changed = True
                 # else: red shard — every in-sync copy is gone; stay
@@ -186,7 +263,8 @@ def allocate(state: ClusterState) -> ClusterState:
                 free = {
                     n: load[n] for n in live - occupied
                     if can_allocate(state, meta, n, assigns,
-                                    index_counts, node_initializing)
+                                    index_counts, node_initializing,
+                                    node_bytes=nbytes)
                 }
                 if not free:
                     break  # deciders reject every remaining node
@@ -197,6 +275,7 @@ def allocate(state: ClusterState) -> ClusterState:
                 )
                 occupied.add(node)
                 load[node] += 1
+                nbytes[node] = nbytes.get(node, 0) + shard_bytes(meta)
                 index_counts[node] = index_counts.get(node, 0) + 1
                 node_initializing[node] = node_initializing.get(node, 0) + 1
                 n_live_replicas += 1
@@ -215,33 +294,200 @@ def allocate(state: ClusterState) -> ClusterState:
         new_indices[index] = meta
         new_routing[index] = routing
 
-    if not changed:
+    if changed:
+        from dataclasses import replace
+
+        state = replace(state, indices=new_indices, routing=new_routing)
+    return rebalance(state)
+
+
+def _relocations_in_flight(state: ClusterState) -> int:
+    return sum(
+        1
+        for shards in state.routing.values()
+        for assigns in shards.values()
+        for a in assigns
+        if a.get("relocating_from")
+    )
+
+
+def rebalance(state: ClusterState) -> ClusterState:
+    """Move STARTED shard copies off overloaded nodes (the reference's
+    BalancedShardsAllocator.java:79 rebalancing pass + DiskThresholdDecider
+    high-watermark shedding), throttled to CLUSTER_CONCURRENT_REBALANCE
+    concurrent relocations.
+
+    A move is a copy-then-cut: the target joins as INITIALIZING carrying
+    `relocating_from`; when recovery completes (mark_shard_started) the
+    source assignment is cut, inheriting primary status + a term bump if
+    the source was the primary (the reference's primary handoff).
+
+    Sources, in priority order: nodes above the disk HIGH watermark, then
+    plain shard-count imbalance beyond REBALANCE_SLACK."""
+    live = data_nodes(state)
+    if len(live) < 2:
+        return state
+    budget = CLUSTER_CONCURRENT_REBALANCE - _relocations_in_flight(state)
+    if budget <= 0:
+        return state
+    new_indices = {k: v for k, v in state.indices.items()}
+    new_routing = {
+        idx: {s: [dict(a) for a in assigns] for s, assigns in shards.items()}
+        for idx, shards in state.routing.items()
+    }
+    moved = False
+
+    def load_counts():
+        load = {n: 0 for n in live}
+        for shards in new_routing.values():
+            for assigns in shards.values():
+                for a in assigns:
+                    if a["node"] in load:
+                        load[a["node"]] += 1
+        return load
+
+    def over_watermark():
+        used = _node_bytes_from(new_routing, new_indices, live)
+        out = []
+        for n in live:
+            cap = _node_capacity(state, n)
+            if cap and used[n] / cap > WATERMARK_HIGH:
+                out.append(n)
+        return out
+
+    while budget > 0:
+        load = load_counts()
+        shedding = over_watermark()
+        if shedding:
+            src = max(shedding, key=lambda n: (load[n], n))
+        else:
+            src = max(live, key=lambda n: (load[n], n))
+            low = min(live, key=lambda n: (load[n], n))
+            if load[src] - load[low] <= REBALANCE_SLACK:
+                break
+        move = _pick_move(state, new_indices, new_routing, src, live,
+                          shedding=bool(shedding))
+        if move is None:
+            break
+        index, key, source_assign, target = move
+        meta = copy.deepcopy(new_indices[index])
+        meta["alloc_counter"] = meta.get("alloc_counter", 0) + 1
+        aid = f"{index}-a{meta['alloc_counter']}"
+        new_indices[index] = meta
+        new_routing[index][key].append({
+            "node": target, "primary": False, "state": "INITIALIZING",
+            "allocation_id": aid,
+            "relocating_from": source_assign["allocation_id"],
+        })
+        moved = True
+        budget -= 1
+
+    if not moved:
         return state
     from dataclasses import replace
 
     return replace(state, indices=new_indices, routing=new_routing)
 
 
+def _node_bytes_from(routing, indices, live) -> dict[str, int]:
+    used = {n: 0 for n in live}
+    for index, shards in routing.items():
+        sz = shard_bytes(indices.get(index, {}))
+        for assigns in shards.values():
+            for a in assigns:
+                if a["node"] in used:
+                    used[a["node"]] += sz
+    return used
+
+
+def _pick_move(state, indices, routing, src, live, shedding=False):
+    """A STARTED copy on `src` + a target node every decider accepts.
+    Prefers replicas (primary moves need a handoff at completion). Count
+    moves only go downhill; watermark shedding moves regardless of the
+    target's shard count (the decider chain still gates capacity)."""
+    node_bytes = _node_bytes_from(routing, indices, live)
+    node_initializing: dict[str, int] = {}
+    for shards in routing.values():
+        for assigns in shards.values():
+            for a in assigns:
+                if a["state"] == "INITIALIZING":
+                    node_initializing[a["node"]] = (
+                        node_initializing.get(a["node"], 0) + 1)
+    load = {n: 0 for n in live}
+    for shards in routing.values():
+        for assigns in shards.values():
+            for a in assigns:
+                if a["node"] in load:
+                    load[a["node"]] += 1
+    candidates = []
+    for index, shards in routing.items():
+        meta = indices[index]
+        index_counts: dict[str, int] = {}
+        for assigns in shards.values():
+            for a in assigns:
+                index_counts[a["node"]] = index_counts.get(a["node"], 0) + 1
+        for key, assigns in shards.items():
+            if any(a.get("relocating_from") for a in assigns):
+                continue  # one relocation per shard at a time
+            for a in assigns:
+                if a["node"] != src or a["state"] != "STARTED":
+                    continue
+                for tgt in sorted(live, key=lambda n: (load[n], n)):
+                    if tgt == src:
+                        continue
+                    if not shedding and load[tgt] >= load[src]:
+                        break  # only move downhill
+                    if can_allocate(state, meta, tgt, assigns,
+                                    index_counts, node_initializing,
+                                    node_bytes=node_bytes, moving=a):
+                        candidates.append(
+                            (a["primary"], index, key, a, tgt))
+                        break
+    if not candidates:
+        return None
+    # replicas first (False < True), then stable order
+    candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+    _, index, key, a, tgt = candidates[0]
+    return index, key, a, tgt
+
+
 def mark_shard_started(
     state: ClusterState, index: str, shard: int, allocation_id: str
 ) -> ClusterState:
     """Recovery finished: flip INITIALIZING -> STARTED and add to in-sync
-    (the reference's shard-started cluster state task)."""
+    (the reference's shard-started cluster state task). A relocation
+    target additionally CUTS its source copy, inheriting primary status
+    with a term bump when the source was the primary — the copy-then-cut
+    completion of rebalance()."""
     meta = copy.deepcopy(state.indices.get(index))
     if meta is None:
         return state
     key = str(shard)
     routing = {s: [dict(a) for a in assigns] for s, assigns in state.routing.get(index, {}).items()}
-    hit = False
+    hit = None
     for a in routing.get(key, []):
         if a["allocation_id"] == allocation_id and a["state"] == "INITIALIZING":
             a["state"] = "STARTED"
-            hit = True
-    if not hit:
+            hit = a
+    if hit is None:
         return state
     in_sync = meta.setdefault("in_sync", {}).setdefault(key, [])
     if allocation_id not in in_sync:
         in_sync.append(allocation_id)
+    src_aid = hit.pop("relocating_from", None)
+    if src_aid is not None:
+        src = next((a for a in routing.get(key, [])
+                    if a["allocation_id"] == src_aid), None)
+        if src is not None:
+            routing[key] = [a for a in routing[key]
+                            if a["allocation_id"] != src_aid]
+            meta["in_sync"][key] = [
+                aid for aid in meta["in_sync"][key] if aid != src_aid
+            ]
+            if src["primary"]:
+                hit["primary"] = True
+                terms = meta.setdefault("primary_terms", {})
+                terms[key] = terms.get(key, 1) + 1
     return state.with_index(index, meta, routing)
 
 

@@ -57,14 +57,19 @@ class ClusterNode:
 
     def __init__(self, node_id: str, voting_nodes: list[str], network,
                  roles: list[str] | None = None, data_path: str | None = None,
-                 attributes: dict | None = None):
+                 attributes: dict | None = None,
+                 capacity_bytes: int | None = None):
         self.node_id = node_id
         self.network = network
         self.service = TransportService(node_id, network)
+        info = {"roles": roles or ["master", "data"],
+                "attributes": attributes or {}}
+        if capacity_bytes:
+            # pack-memory budget for the disk-threshold decider analog
+            info["capacity_bytes"] = int(capacity_bytes)
         self.coordinator = Coordinator(
             node_id, voting_nodes, self.service, network,
-            node_info={"roles": roles or ["master", "data"],
-                       "attributes": attributes or {}},
+            node_info=info,
             persist_path=(data_path + "/_state") if data_path else None,
         )
         self.last_recovery_mode: str | None = None  # instrumentation

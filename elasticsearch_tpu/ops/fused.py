@@ -65,8 +65,15 @@ from ..index.pack import BLOCK
 
 KB = 32  # in-kernel candidate set size (top-K'); final k must be <= KB
 WARM_TILES = 128  # max leading tiles merged unbuffered (warm-up cap)
-TILE_N = 1024
-QSUB = 128  # query sub-tile: one MXU row block
+# 512-doc tiles: with the full 512-query chunk as one sub-tile, the
+# [qsub, tile_n] f32 working set (scores block, sparse accumulator, merge
+# transients) must fit scoped VMEM; 512 also halves the one-hot build cost
+# (proportional to window entries x tile_n)
+TILE_N = 512
+# query sub-tile = the full chunk: fewer grid steps beat narrower MXU
+# rows — each (tile, subtile) step pays scalar-core work (6 dynamic-index
+# DMA issues, window gating) that dominated at 4 subtiles x 977 tiles
+QSUB = 512
 QC = 512  # fused query-chunk width
 # max docs a fused shard may hold (docid bit budget of the window sort key)
 MAX_DOCS_FUSED = (1 << 21) - 2 * TILE_N
@@ -178,18 +185,23 @@ def _fused_kernel(
     zero = jnp.float32(0.0)
     rows_per_blk = P // 128
     dn = (((1,), (1,)), ((), ()))
-    key_lo = (i << jnp.int32(sb)) | (j * tile_n << jnp.int32(qb))
-    key_hi = (i << jnp.int32(sb)) | ((j + 1) * tile_n << jnp.int32(qb))
+    # this tile's entries are CONTIGUOUS in the sorted stream: exactly
+    # [ptr[base], ptr[base+1]) — so the active 128-entry rows of the
+    # 2-block window are a range computable from the prefetched SMEM
+    # scalars alone (reading per-row min/max keys out of VMEM vectors
+    # stalls the scalar core and measured ~60x slower end to end)
+    start = ptr_ref[base]
+    blk0 = ptrb_ref[base] * P
+    c_lo = jax.lax.div(start - blk0, jnp.int32(128))
+    c_hi = jax.lax.div(end - blk0 + jnp.int32(127), jnp.int32(128))
     sacc[...] = jnp.zeros_like(sacc)
     for c in range(2 * rows_per_blk):
         if c < rows_per_blk:
             key_ref, val_ref, cc = keya_ref, vala_ref, c
         else:
             key_ref, val_ref, cc = keyb_ref, valb_ref, c - rows_per_blk
-        first = key_ref[cc, 0]
-        last = key_ref[cc, 127]
 
-        @pl.when((last >= key_lo) & (first < key_hi))
+        @pl.when((c >= c_lo) & (c < c_hi))
         def _(key_ref=key_ref, val_ref=val_ref, cc=cc):
             key = key_ref[cc : cc + 1, :]  # [1, 128]
             val = jax.lax.bitcast_convert_type(
@@ -720,12 +732,31 @@ class FusedTermSearcher:
             still = np.nonzero(flagged)[0]
             # legacy exact path (independent machinery). Its final scores
             # equal the canonical values only up to ulps; ranking
-            # differences at that level are accepted.
+            # differences at that level are accepted. The plan pads to a
+            # FIXED (Ts, B) envelope: flagged queries are rare (~1e-3),
+            # and letting each handful mint its own (Ts, B) bucket costs
+            # a fresh multi-minute XLA compile mid-serving.
+            flagged_qs = [queries[i] for i in still]
+            max_ts = max(
+                (sum(1 for t, _ in q
+                     if self.searcher.pack.dense_row_of(fld, t) is None)
+                 for q in flagged_qs),
+                default=1,
+            )
+            pack = self.searcher.pack
+            max_b = max(
+                (pack.term_blocks(fld, t)[1]
+                 for q in flagged_qs for t, _ in q
+                 if pack.dense_row_of(fld, t) is None), default=1)
             sv, si, st = [
                 np.asarray(x)
                 for x in self.bts.run(
                     fld,
-                    self.bts.plan(fld, [queries[i] for i in still], k),
+                    self.bts.plan(
+                        fld, flagged_qs, k,
+                        pad_ts=1 << (max(max_ts, 4) - 1).bit_length(),
+                        pad_b=max(32, 1 << (max(max_b, 1) - 1).bit_length()),
+                    ),
                 )
             ]
             scores[still, : sv.shape[1]] = sv

@@ -209,9 +209,7 @@ class S3Repository(Repository):
             if token:
                 qs["continuation-token"] = token
             query = urllib.parse.urlencode(sorted(qs.items()))
-            url = self.endpoint + f"/{self.bucket}/?{query}"
-            headers = self.signer.sign("GET", url, None)
-            status, body = self.http("GET", url, headers, None)
+            status, body = self._call("GET", "", query=query)
             if status != 200:
                 raise IOError(f"s3 LIST [{full_prefix}] -> {status}")
             ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"

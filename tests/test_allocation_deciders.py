@@ -154,3 +154,146 @@ def test_publications_use_diffs_and_fall_back_to_full():
     st = c.nodes[stale].state
     assert "d2" in st.indices
     assert st.version == c.master().state.version
+
+
+class CapacityCluster(Cluster):
+    """Cluster whose nodes advertise pack-capacity budgets and/or zones."""
+
+    def __init__(self, caps: dict[str, int] | None = None,
+                 attributes: dict[str, dict] | None = None, n: int = 3):
+        self.queue = DeterministicTaskQueue(0)
+        self.net = LocalTransportNetwork(self.queue)
+        self.node_ids = [f"node-{i}" for i in range(n)]
+        self.nodes = {
+            nid: ClusterNode(
+                nid, list(self.node_ids), self.net,
+                attributes=(attributes or {}).get(nid),
+                capacity_bytes=(caps or {}).get(nid),
+            )
+            for nid in self.node_ids
+        }
+        for nd in self.nodes.values():
+            nd.start()
+        self.run(60)
+
+
+def test_disk_threshold_decider_blocks_full_node():
+    """A node over the low watermark takes no new shards (the
+    DiskThresholdDecider analog over advertised pack budgets)."""
+    gb = 1 << 30
+    c = CapacityCluster(caps={"node-0": 100 * gb, "node-1": 100 * gb,
+                              "node-2": 2 * gb})
+    for i in range(6):
+        c.create_index(f"i{i}", {"number_of_shards": 1,
+                                 "number_of_replicas": 1,
+                                 "index.estimated_shard_bytes": 10 * gb})
+    st = c.master().state
+    for idx in st.indices:
+        for assigns in st.routing[idx].values():
+            assert all(a["node"] != "node-2" for a in assigns), (
+                idx, st.routing[idx])
+
+
+def test_zone_awareness_spreads_copies():
+    """Primary+replica land in different zones (AwarenessAllocationDecider
+    analog on the `zone` node attribute)."""
+    attrs = {"node-0": {"zone": "za"}, "node-1": {"zone": "za"},
+             "node-2": {"zone": "zb"}, "node-3": {"zone": "zb"}}
+    c = CapacityCluster(attributes=attrs, n=4)
+    for i in range(4):
+        c.create_index(f"z{i}", {"number_of_shards": 2,
+                                 "number_of_replicas": 1})
+    c.run(120)
+    st = c.master().state
+    zone_of = {"node-0": "za", "node-1": "za", "node-2": "zb", "node-3": "zb"}
+    for idx in st.indices:
+        for key, assigns in st.routing[idx].items():
+            started = [a for a in assigns if a["state"] == "STARTED"]
+            zones = {zone_of[a["node"]] for a in started}
+            assert len(zones) == 2, (idx, key, assigns)
+
+
+def test_rebalance_moves_shards_off_overloaded_node():
+    """When a node exceeds the high watermark (capacity shrinks relative to
+    its load), started shards relocate away with copy-then-cut handoff."""
+    from elasticsearch_tpu.cluster import allocation
+
+    gb = 1 << 30
+    c = CapacityCluster(caps={"node-0": 1000 * gb, "node-1": 1000 * gb,
+                              "node-2": 1000 * gb})
+    for i in range(6):
+        c.create_index(f"r{i}", {"number_of_shards": 1,
+                                 "number_of_replicas": 0,
+                                 "index.estimated_shard_bytes": 10 * gb})
+    c.run(60)
+    st = c.master().state
+    load = {n: 0 for n in c.node_ids}
+    for idx in st.indices:
+        for assigns in st.routing[idx].values():
+            for a in assigns:
+                load[a["node"]] += 1
+    assert max(load.values()) - min(load.values()) <= 1, load
+
+    # shrink node-0's effective capacity: its shards now exceed the high
+    # watermark; the next allocation round must shed them
+    heavy = max(load, key=load.get)
+    shrunk = st.nodes[heavy]["capacity_bytes"] = int(
+        load[heavy] * 10 * gb / allocation.WATERMARK_HIGH * 0.5
+    )
+    assert shrunk > 0
+    st2 = allocation.allocate(st)
+    relocs = [
+        a
+        for shards in st2.routing.values()
+        for assigns in shards.values()
+        for a in assigns
+        if a.get("relocating_from")
+    ]
+    assert relocs, "expected relocations off the overloaded node"
+    assert all(a["node"] != heavy for a in relocs)
+    assert len(relocs) <= allocation.CLUSTER_CONCURRENT_REBALANCE
+
+    # completing a relocation cuts the source copy
+    idx, key, tgt = None, None, None
+    for index, shards in st2.routing.items():
+        for k, assigns in shards.items():
+            for a in assigns:
+                if a.get("relocating_from"):
+                    idx, key, tgt = index, k, a
+                    break
+    src_aid = tgt["relocating_from"]
+    st3 = allocation.mark_shard_started(st2, idx, int(key),
+                                        tgt["allocation_id"])
+    assigns = st3.routing[idx][key]
+    assert all(a["allocation_id"] != src_aid for a in assigns)
+    moved = next(a for a in assigns
+                 if a["allocation_id"] == tgt["allocation_id"])
+    assert moved["state"] == "STARTED" and moved["primary"]
+    assert st3.indices[idx]["primary_terms"][key] == 2
+
+
+def test_rebalance_count_imbalance():
+    """Pure shard-count imbalance (no capacities) also triggers throttled
+    rebalancing toward the least-loaded node."""
+    from dataclasses import replace
+
+    from elasticsearch_tpu.cluster import allocation
+
+    c = Cluster(2)
+    for i in range(6):
+        c.create_index(f"b{i}", {"number_of_shards": 1,
+                                 "number_of_replicas": 0})
+    st = c.master().state
+    # admit a new empty node: allocate() should relocate shards toward it
+    st = replace(st, nodes={**st.nodes,
+                            "node-9": {"roles": ["data"], "attributes": {}}})
+    st2 = allocation.allocate(st)
+    relocs = [
+        a
+        for shards in st2.routing.values()
+        for assigns in shards.values()
+        for a in assigns
+        if a.get("relocating_from")
+    ]
+    assert relocs and all(a["node"] == "node-9" for a in relocs)
+    assert len(relocs) <= allocation.CLUSTER_CONCURRENT_REBALANCE
