@@ -58,6 +58,8 @@ def _np_type(arr) -> str:
 
 
 class Table:
+    shard_of = None  # [nrows] owning shard when rows still map 1:1 to docs
+
     def __init__(self, columns: dict[str, Column], nrows: int):
         self.columns = columns
         self.nrows = nrows
@@ -85,6 +87,8 @@ def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
     parts: dict[str, list] = {n: [] for n in col_names}
     index_col = []
     id_col = []
+    shard_col = []
+    shard_seq = 0
     total = 0
     for idx, _ in targets:
         sp = idx.searcher.sp
@@ -96,6 +100,8 @@ def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
             sel = np.flatnonzero(live)
             total += len(sel)
             index_col.extend([idx.name] * len(sel))
+            shard_col.extend([shard_seq] * len(sel))
+            shard_seq += 1
             for d in sel:
                 id_col.append(idx.shard_docs[s][d][0] if s < len(idx.shard_docs) else "")
             for tf_name in text_fields:
@@ -156,7 +162,11 @@ def _collect_table(engine, index_expr: str, metadata: list[str]) -> Table:
     if "_id" in metadata:
         columns["_id"] = Column(np.array(id_col, object),
                                 np.zeros(total, bool), "keyword")
-    return Table(columns, total)
+    out = Table(columns, total)
+    # row -> owning shard, threaded through row-preserving stages so STATS
+    # can run the per-shard partial + exchange path (esql/exchange.py)
+    out.shard_of = np.asarray(shard_col, np.int32)
+    return out
 
 
 # ---- expression evaluation ------------------------------------------------
@@ -391,6 +401,22 @@ def _agg_value(fn, args, t: Table, sel: np.ndarray):
     raise IllegalArgumentError(f"unknown ES|QL aggregate [{fn}]")
 
 
+def group_keys(t: Table, by: list[str]):
+    """-> (keys per row, sorted unique keys): THE grouping dictionary,
+    shared by the host evaluator and the exchange path so null ordering
+    and tie-breaks cannot drift."""
+    key_cols = [t.columns[b] for b in by]
+    keys = list(zip(*[
+        [None if c.null[i] else (c.values[i].item() if hasattr(c.values[i], "item")
+                                 else c.values[i]) for i in range(t.nrows)]
+        for c in key_cols
+    ])) if t.nrows else []
+    uniq = sorted(set(keys), key=lambda k: tuple(
+        (x is None, x if x is not None else 0) if not isinstance(x, str) else (x is None, x)
+        for x in k))
+    return keys, uniq
+
+
 def _run_stats(t: Table, aggs, by: list[str]) -> Table:
     if not by:
         cols = {}
@@ -405,14 +431,7 @@ def _run_stats(t: Table, aggs, by: list[str]) -> Table:
         if b not in t.columns:
             raise IllegalArgumentError(f"Unknown column [{b}]")
         key_cols.append(t.columns[b])
-    keys = list(zip(*[
-        [None if c.null[i] else (c.values[i].item() if hasattr(c.values[i], "item")
-                                 else c.values[i]) for i in range(t.nrows)]
-        for c in key_cols
-    ])) if t.nrows else []
-    uniq = sorted(set(keys), key=lambda k: tuple(
-        (x is None, x if x is not None else 0) if not isinstance(x, str) else (x is None, x)
-        for x in k))
+    keys, uniq = group_keys(t, by)
     out_cols: dict[str, list] = {b: [] for b in by}
     agg_rows: dict[str, list] = {name: [] for name, _ in aggs}
     agg_types: dict[str, str] = {}
@@ -527,13 +546,15 @@ def _run_enrich(engine, t: Table, payload: dict) -> Table:
 
 # ---- driver ---------------------------------------------------------------
 
-def execute(engine, query: str) -> Table:
+def execute(engine, query: str, mesh=None) -> Table:
     stages = parse(query)
     t: Table | None = None
+    shard_of = None
     for kind, payload in stages:
         if kind == "from":
             t = _collect_table(engine, ",".join(payload["indices"]),
                                payload["metadata"])
+            shard_of = t.shard_of
         elif kind == "row":
             cols = {}
             for name, expr in payload:
@@ -542,12 +563,23 @@ def execute(engine, query: str) -> Table:
             t = Table(cols, 1)
         elif kind == "where":
             mask = _eval_expr(payload, t).values.astype(bool)
-            t = t.take(np.flatnonzero(mask))
+            keep_idx = np.flatnonzero(mask)
+            t = t.take(keep_idx)
+            if shard_of is not None:
+                shard_of = shard_of[keep_idx]
         elif kind == "eval":
             for name, expr in payload:
                 t.columns[name] = _eval_expr(expr, t)
         elif kind == "stats":
-            t = _run_stats(t, payload["aggs"], payload["by"])
+            from .exchange import stats_exchange, supported_stats
+
+            if (shard_of is not None and len(shard_of) == t.nrows
+                    and t.nrows > 0 and supported_stats(payload, t)):
+                t = stats_exchange(t, shard_of, payload["aggs"],
+                                   payload["by"], mesh=mesh)
+            else:
+                t = _run_stats(t, payload["aggs"], payload["by"])
+            shard_of = None
         elif kind == "sort":
             order = np.arange(t.nrows)
             for name, desc, nulls_first in reversed(payload):
@@ -576,8 +608,12 @@ def execute(engine, query: str) -> Table:
                                       else [rank[~nn], rank[nn]])
                 order = order[rank]
             t = t.take(order)
+            if shard_of is not None:
+                shard_of = shard_of[order]
         elif kind == "limit":
             t = t.take(np.arange(min(payload, t.nrows)))
+            if shard_of is not None:
+                shard_of = shard_of[: t.nrows]
         elif kind == "keep":
             keep = []
             for pat in payload:
