@@ -12,11 +12,11 @@ This module implements the same protocol shape, event-driven over the
 Transport abstraction so it runs identically on the deterministic simulation
 network (tests) and the TCP network (real deployments). Simplifications,
 documented: static voting configuration (the reference reconfigures voting
-nodes dynamically, CoordinationState.VoteCollection/VotingConfiguration);
-full-state publication (no diffs); no cluster-state persistence to disk on
-every commit (the reference writes a local Lucene index,
-gateway/PersistedClusterStateService.java:930 — here the data WAL plus
-master re-election recovers metadata).
+nodes dynamically, CoordinationState.VoteCollection/VotingConfiguration).
+Publications ship per-key DIFFS with a full-state fallback for stale
+followers (see _publish), and committed states persist through
+cluster/gateway.py (content-addressed blobs + fsynced manifest), the
+analog of gateway/PersistedClusterStateService.java:930.
 
 Vote safety (why at most one master per term): a node grants at most one
 join (vote) per term, a candidate needs a quorum (majority of the static

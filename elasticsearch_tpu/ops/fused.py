@@ -65,15 +65,8 @@ from ..index.pack import BLOCK
 
 KB = 32  # in-kernel candidate set size (top-K'); final k must be <= KB
 WARM_TILES = 128  # max leading tiles merged unbuffered (warm-up cap)
-# 512-doc tiles: with the full 512-query chunk as one sub-tile, the
-# [qsub, tile_n] f32 working set (scores block, sparse accumulator, merge
-# transients) must fit scoped VMEM; 512 also halves the one-hot build cost
-# (proportional to window entries x tile_n)
-TILE_N = 512
-# query sub-tile = the full chunk: fewer grid steps beat narrower MXU
-# rows — each (tile, subtile) step pays scalar-core work (6 dynamic-index
-# DMA issues, window gating) that dominated at 4 subtiles x 977 tiles
-QSUB = 512
+TILE_N = 1024
+QSUB = 128  # query sub-tile: one MXU row block
 QC = 512  # fused query-chunk width
 # max docs a fused shard may hold (docid bit budget of the window sort key)
 MAX_DOCS_FUSED = (1 << 21) - 2 * TILE_N
@@ -143,6 +136,7 @@ def _fused_kernel(
     oi_ref,  # [QSUB, KB] i32
     ot_ref,  # [QSUB, 1] f32 (exact match counts)
     of_ref,  # [QSUB, 1] f32 (overflow flags)
+    sacc,  # VMEM [QSUB, TILE_N] f32 (per-step sparse accumulator)
     acc_v,  # VMEM [QC, KB] f32
     acc_i,  # VMEM [QC, KB] i32
     cnt,  # VMEM [QC, 1] f32
@@ -173,58 +167,64 @@ def _fused_kernel(
     end = ptr_ref[base + 1]
 
     # ---- one-hot expansion: the MXU as a segmented scatter-add ----------
-    # Unconditional over the 2-block window: per-row pl.when gating is NOT
-    # worth it — each conditional region gets its own scoped-VMEM buffers
-    # (no reuse across regions, blowing the 16MB budget), and at
-    # tile_n=512 the whole window's one-hot build is ~1M lanes/step.
-    # Out-of-tile / foreign-subtile / sentinel entries mask to zero.
+    # The window is several times wider than the tile's real candidate run
+    # (block quantization + the >= 1024-entry block floor), so each
+    # 128-entry row is gated by a scalar range test on its sorted keys:
+    # rows that cannot intersect (subtile i, tile j) skip their one-hot
+    # build and both MXU passes — the dominant kernel cost at Zipf loads.
     qrow = jax.lax.broadcasted_iota(jnp.int32, (qsub, 128), 0)
     nrow = jax.lax.broadcasted_iota(jnp.int32, (tile_n, 128), 0)
     one = jnp.float32(1.0)
     zero = jnp.float32(0.0)
     rows_per_blk = P // 128
     dn = (((1,), (1,)), ((), ()))
-    sparse = None
+    key_lo = (i << jnp.int32(sb)) | (j * tile_n << jnp.int32(qb))
+    key_hi = (i << jnp.int32(sb)) | ((j + 1) * tile_n << jnp.int32(qb))
+    sacc[...] = jnp.zeros_like(sacc)
     for c in range(2 * rows_per_blk):
         if c < rows_per_blk:
             key_ref, val_ref, cc = keya_ref, vala_ref, c
         else:
             key_ref, val_ref, cc = keyb_ref, valb_ref, c - rows_per_blk
-        key = key_ref[cc : cc + 1, :]  # [1, 128]
-        val = jax.lax.bitcast_convert_type(
-            val_ref[cc : cc + 1, :], jnp.float32
-        )
-        qlow = key & (qsub - 1)
-        doc = jax.lax.shift_right_logical(
-            key, jnp.int32(qb)
-        ) & ((1 << db) - 1)
-        off = doc - j * tile_n
-        inwin = (
-            (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
-            & (off >= 0)
-            & (off < tile_n)
-        )
-        At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
-        D = jnp.where((nrow == off) & inwin, one, zero).astype(
-            jnp.bfloat16
-        )  # [tile_n, 128]
-        # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo
-        # carries ~15 mantissa bits through two bf16 MXU passes with
-        # f32 accumulation, keeping selection within EPS_SPLIT of the
-        # canonical f32 rescore
-        Ahf = _mask_hi(At)
-        Ah = Ahf.astype(jnp.bfloat16)
-        Al = (At - Ahf).astype(jnp.bfloat16)
-        contrib = jax.lax.dot_general(
-            Ah, D, dn, preferred_element_type=jnp.float32
-        ) + jax.lax.dot_general(
-            Al, D, dn, preferred_element_type=jnp.float32
-        )  # [qsub, tile_n]
-        sparse = contrib if sparse is None else sparse + contrib
+        first = key_ref[cc, 0]
+        last = key_ref[cc, 127]
+
+        @pl.when((last >= key_lo) & (first < key_hi))
+        def _(key_ref=key_ref, val_ref=val_ref, cc=cc):
+            key = key_ref[cc : cc + 1, :]  # [1, 128]
+            val = jax.lax.bitcast_convert_type(
+                val_ref[cc : cc + 1, :], jnp.float32
+            )
+            qlow = key & (qsub - 1)
+            doc = jax.lax.shift_right_logical(
+                key, jnp.int32(qb)
+            ) & ((1 << db) - 1)
+            off = doc - j * tile_n
+            inwin = (
+                (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
+                & (off >= 0)
+                & (off < tile_n)
+            )
+            At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
+            D = jnp.where((nrow == off) & inwin, one, zero).astype(
+                jnp.bfloat16
+            )  # [tile_n, 128]
+            # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo
+            # carries ~15 mantissa bits through two bf16 MXU passes with
+            # f32 accumulation, keeping selection within EPS_SPLIT of the
+            # canonical f32 rescore
+            Ahf = _mask_hi(At)
+            Ah = Ahf.astype(jnp.bfloat16)
+            Al = (At - Ahf).astype(jnp.bfloat16)
+            sacc[...] += jax.lax.dot_general(
+                Ah, D, dn, preferred_element_type=jnp.float32
+            ) + jax.lax.dot_general(
+                Al, D, dn, preferred_element_type=jnp.float32
+            )  # [qsub, tile_n]
 
     dense = scores_ref[:].astype(jnp.float32)
     lv = live_ref[0:1, :] > 0
-    total = dense + sparse
+    total = dense + sacc[...]
     total = jnp.where(lv & (total > 0), total, -jnp.inf)
     ids = j * tile_n + jax.lax.broadcasted_iota(jnp.int32, total.shape, 1)
 
@@ -245,13 +245,12 @@ def _fused_kernel(
     # (P(Poisson(1) > 8) ~ 1e-6), top-4 after (lambda <= kb/warm ~ 0.26,
     # P(X > 4) ~ 1e-4). Starting top-8 at j=8 flagged ~6% of bench
     # queries (lambda = 4 there -> P(X > 8) ~ 2% per tile).
-    def _carry(t, flag=True):
-        if flag:
-            theta = acc_v[rs][:, kb - 1 : kb]
-            c_above = jnp.sum(
-                total > theta, axis=1, keepdims=True, dtype=jnp.int32
-            )
-            ovf[rs] += (c_above > t).astype(jnp.float32)
+    def _carry(t):
+        theta = acc_v[rs][:, kb - 1 : kb]
+        c_above = jnp.sum(
+            total > theta, axis=1, keepdims=True, dtype=jnp.int32
+        )
+        ovf[rs] += (c_above > t).astype(jnp.float32)
         tv_, ti_ = _topk_rounds(total, ids, t)
         mv, mi = _topk_rounds(
             jnp.concatenate([acc_v[rs], tv_], axis=1),
@@ -261,12 +260,15 @@ def _fused_kernel(
         acc_v[rs] = mv
         acc_i[rs] = mi
 
-    # t = kb is an EXACT merge (top-kb of tile + top-kb accumulator covers
-    # the union's top-kb) with far smaller transients than concatenating
-    # the whole tile into the merge
     @pl.when(j < kb)
     def _():
-        _carry(kb, flag=False)
+        mv, mi = _topk_rounds(
+            jnp.concatenate([acc_v[rs], total], axis=1),
+            jnp.concatenate([acc_i[rs], ids], axis=1),
+            kb,
+        )
+        acc_v[rs] = mv
+        acc_i[rs] = mi
 
     @pl.when((j >= kb) & (j < warm))
     def _():
@@ -349,6 +351,7 @@ def fused_sparse_topk(
             pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
         ],
         scratch_shapes=[
+            pltpu.VMEM((qsub, tile_n), jnp.float32),
             pltpu.VMEM((qc, kb), jnp.float32),
             pltpu.VMEM((qc, kb), jnp.int32),
             pltpu.VMEM((qc, 1), jnp.float32),
@@ -364,6 +367,13 @@ def fused_sparse_topk(
             jax.ShapeDtypeStruct((qc, 1), jnp.float32),
             jax.ShapeDtypeStruct((qc, 1), jnp.float32),
         ],
+        # v5e has 128MB of physical VMEM; Mosaic's default 16MB scoped
+        # budget double-counts per-region transients of the tiered merges
+        compiler_params=(
+            None if interpret else pltpu.CompilerParams(
+                vmem_limit_bytes=64 * 1024 * 1024
+            )
+        ),
         interpret=interpret,
     )(ptr, ptr_blk, scores, live, keys, keys, vals, vals)
     return ov, oi, ot[:, 0].astype(jnp.int32), of[:, 0] > 0
@@ -640,17 +650,18 @@ class FusedTermSearcher:
             }
         return self._fa
 
-    def _compiled(self, fld, R, Td, k, interpret):
+    def _compiled(self, fld, R, Td, k, nreal, interpret):
         pack = self.searcher.pack
         n = pack.num_docs
         n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
         nj = n_pad // TILE_N
-        # fixed window-block size: the 2-block pair covers 2048 entries
-        # per doc tile, >> the mean in-tile run at any sane load, and the
-        # kernel's one-hot cost is proportional to 2P x tile_n, so bigger
-        # P only wastes; overflow (a run beyond the pair) flags the query
-        # to the exact fallback. Fixed P also pins the compile key.
-        P = 1024
+        # window sizing follows the REAL posting count (R counts padded
+        # slots — up to ~40% at Zipf loads, which doubles P for nothing),
+        # quantized in pow2 steps so batch-to-batch jitter cannot flap the
+        # compile key; floor 1024: [P/128, 128] blocks need >= 8 sublanes
+        nreal_q = 1 << max(nreal - 1, 1).bit_length()
+        mean_win = max(1, nreal_q * BLOCK // ((QC // QSUB) * nj))
+        P = min(4096, max(1024, 1 << (2 * mean_win - 1).bit_length()))
         key = (fld, R, Td, k, interpret, P)
         fn = self._cache.get(key)
         if fn is None:
@@ -671,7 +682,7 @@ class FusedTermSearcher:
         plan = plan_fused(self.searcher.pack, fld, queries, k)
         fn = self._compiled(
             fld, plan.rows.shape[0], plan.dense_rows.shape[1],
-            k, interpret,
+            k, plan.nreal, interpret,
         )
         outs = fn(
             self._arrays(),
@@ -721,13 +732,13 @@ class FusedTermSearcher:
             # and letting each handful mint its own (Ts, B) bucket costs
             # a fresh multi-minute XLA compile mid-serving.
             flagged_qs = [queries[i] for i in still]
+            pack = self.searcher.pack
             max_ts = max(
                 (sum(1 for t, _ in q
-                     if self.searcher.pack.dense_row_of(fld, t) is None)
+                     if pack.dense_row_of(fld, t) is None)
                  for q in flagged_qs),
                 default=1,
             )
-            pack = self.searcher.pack
             max_b = max(
                 (pack.term_blocks(fld, t)[1]
                  for q in flagged_qs for t, _ in q
