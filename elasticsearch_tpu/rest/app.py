@@ -1618,32 +1618,38 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 engine.suggest_multi, expression, body["suggest"]
             )
         if body.get("profile"):
-            # per-request phase timing (reference behavior: search/profile/ —
-            # simplified to one coordinator-level breakdown per request)
-            res["profile"] = {
-                "shards": [{
-                    "id": f"[{engine.tasks.node}][{expression or '_all'}][0]",
-                    "searches": [{
-                        "query": [{
-                            "type": "CompiledDeviceQuery",
-                            "description": json.dumps(body.get("query") or {"match_all": {}}),
-                            "time_in_nanos": int((time.monotonic() - t0) * 1e9),
-                            "breakdown": {
-                                "score": int((time.monotonic() - t0) * 1e9),
-                                "build_scorer": 0, "next_doc": 0, "advance": 0,
-                                "create_weight": 0, "match": 0,
-                            },
-                        }],
-                        "rewrite_time": 0,
-                        "collector": [{
-                            "name": "SimpleTopScoreDocCollector",
-                            "reason": "search_top_hits",
-                            "time_in_nanos": int((time.monotonic() - t0) * 1e9),
-                        }],
-                    }],
-                    "aggregations": [],
-                }],
-            }
+            # per-query profile TREE with measured per-subtree timings
+            # (reference behavior: search/profile/query/QueryProfiler —
+            # every node reports type/description/breakdown/children).
+            # Each subtree times as its own device program: create_weight
+            # carries the trace+compile cost, score the fused execution.
+            def _profile():
+                from ..query.dsl import parse_query
+                from ..search.profile import empty_shard, profile_shards
+
+                shards = []
+                took_ns = int((time.monotonic() - t0) * 1e9)
+                for idx, alias_filter in engine.resolve_search(
+                    expression or "_all", True, True
+                ):
+                    if idx.searcher is None:
+                        # never-refreshed index: the shard entry must still
+                        # exist (clients index into profile.shards)
+                        shards.append(empty_shard(idx, engine.tasks.node))
+                        continue
+                    q = body.get("query") or {"match_all": {}}
+                    if alias_filter:
+                        # profile the query that actually executed: a
+                        # filtered alias ANDs its filter in
+                        q = {"bool": {"must": [q],
+                                      "filter": [alias_filter]}}
+                    node = parse_query(q, idx.mappings)
+                    shards.extend(
+                        profile_shards(idx, node, took_ns, engine.tasks.node)
+                    )
+                return {"shards": shards}
+
+            res["profile"] = await call(_profile)
         try:
             n_shards = sum(
                 i.num_shards for i, _ in engine.resolve_search(

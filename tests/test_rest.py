@@ -275,3 +275,36 @@ def test_persistence_across_restart(tmp_path):
     body = loop.run_until_complete(check())
     loop.close()
     assert body["found"] is True and body["_source"]["msg"] == "survives restart"
+
+
+def test_profile_query_tree(client_run):
+    async def scenario(client):
+        await client.put("/pidx", json={"mappings": {"properties": {
+            "t": {"type": "text"}, "n": {"type": "long"}}}})
+        for i in range(20):
+            await client.post(f"/pidx/_doc/p{i}",
+                              json={"t": f"word{i % 3} common", "n": i})
+        await client.post("/pidx/_refresh")
+        r = await client.post("/pidx/_search", json={
+            "profile": True,
+            "query": {"bool": {
+                "must": [{"match": {"t": "common"}}],
+                "filter": [{"range": {"n": {"lt": 15}}}],
+            }},
+        })
+        body = await r.json()
+        assert r.status == 200, body
+        shards = body["profile"]["shards"]
+        assert shards and shards[0]["searches"]
+        tree = shards[0]["searches"][0]["query"][0]
+        # reference contract: type/description/breakdown/children per node
+        assert tree["type"] == "BoolNode"
+        assert "children" in tree and len(tree["children"]) >= 2
+        kinds = {c["type"] for c in tree["children"]}
+        assert "RangeNode" in kinds
+        for node in [tree] + tree["children"]:
+            bd = node["breakdown"]
+            assert {"create_weight", "score", "next_doc"} <= set(bd)
+            assert node["time_in_nanos"] >= bd["score"] >= 0
+
+    client_run(scenario)
