@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+_I0 = np.int32(0)  # index-map constant: python ints trace to i64 under x64
+
 try:  # pltpu import works on CPU too (needed for interpret-mode tests)
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -189,7 +191,7 @@ def _scan_topk_pallas(
         count_positive=count_positive, matmul=matmul,
     )
     m_spec = (
-        pl.BlockSpec((D, tile_n), lambda i, j: (0, j))
+        pl.BlockSpec((D, tile_n), lambda i, j: (_I0, j))
         if matmul
         else pl.BlockSpec((tile_b, tile_n), lambda i, j: (i, j))
     )
@@ -197,16 +199,16 @@ def _scan_topk_pallas(
         kernel,
         grid=(nb, nn),
         in_specs=[
-            pl.BlockSpec((tile_b, qp.shape[1]), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_b, qp.shape[1]), lambda i, j: (i, _I0)),
             m_spec,
-            pl.BlockSpec((1, tile_n), lambda i, j: (0, j)),
-            pl.BlockSpec((1, tile_n), lambda i, j: (0, j)),
-            pl.BlockSpec((tile_b, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((tile_b, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((tile_b, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, _I0)),
+            pl.BlockSpec((tile_b, k), lambda i, j: (i, _I0)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Bp, k), jnp.float32),
