@@ -30,6 +30,7 @@ from __future__ import annotations
 import datetime as _dt
 from dataclasses import dataclass, field as dc_field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -156,11 +157,83 @@ class _FieldMetricAgg(AggNode):
         return {}, (type(self).__name__, self.fld, col is None)
 
 
+# one-hot segmented reduction geometry: XLA's scatter on TPU runs on the
+# scalar core (~30-50 ns/element — measured 37-90 ms per metric agg over
+# 1M docs in round 4), so segment reductions run as blocked one-hot
+# contractions instead whenever the segment count is modest. The doc axis
+# is scanned in blocks sized so the [B, nseg] one-hot transient stays
+# ~2^25 elements; larger segment spaces (high-cardinality compositions up
+# to MAX_SEGMENT_PRODUCT) keep the scatter path, whose cost is then
+# amortized over far more buckets per element.
+_ONEHOT_NSEG_MAX = 4096
+_ONEHOT_ELEMS = 1 << 25
+
+
+def _onehot_blocks(tgt, values, nseg1):
+    """-> (tgt [nb, B], values [nb, B]) padded with dead-slot targets."""
+    n = tgt.shape[0]
+    B = int(min(max(_ONEHOT_ELEMS // nseg1, 512), 1 << 17, max(n, 1)))
+    pad = (-n) % B
+    if pad:
+        tgt = jnp.concatenate([tgt, jnp.full(pad, nseg1 - 1, tgt.dtype)])
+        values = jnp.concatenate(
+            [values, jnp.zeros(pad, values.dtype)])
+    return tgt.reshape(-1, B), values.reshape(-1, B)
+
+
+def _seg_onehot_add(tgt, values, nseg1):
+    """Segmented sum by per-block one-hot matvec on the MXU: [1, B] @
+    [B, nseg1], accumulated in f32 over doc blocks."""
+    if tgt.shape[0] == 0:  # zero-row shard: all segments empty
+        return jnp.zeros(nseg1, jnp.float32)
+    tgt2, val2 = _onehot_blocks(tgt, values.astype(jnp.float32), nseg1)
+    ids = jnp.arange(nseg1, dtype=jnp.int32)
+
+    def block(xs):
+        s, v = xs
+        oh = (s[:, None] == ids[None, :]).astype(jnp.float32)
+        return jax.lax.dot_general(
+            v[None, :], oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[0]
+
+    # carry-free lax.map (a scan carry would need manual-axes casts under
+    # shard_map); the [nb, nseg1] partials are tiny next to the scan
+    return jnp.sum(jax.lax.map(block, (tgt2, val2)), axis=0)
+
+
+def _seg_onehot_extreme(tgt, values, nseg1, init, op):
+    """Segmented min/max: per-block masked [B, nseg1] reduce + cross-block
+    combine (VPU; no scatter)."""
+    if tgt.shape[0] == 0:  # zero-row shard: all segments empty
+        return jnp.full(nseg1, init, values.dtype)
+    tgt2, val2 = _onehot_blocks(tgt, values, nseg1)
+    ids = jnp.arange(nseg1, dtype=jnp.int32)
+    red = jnp.min if op == "min" else jnp.max
+
+    def block(xs):
+        s, v = xs
+        oh = s[:, None] == ids[None, :]
+        return red(jnp.where(oh, v[:, None], init), axis=0)
+
+    return red(jax.lax.map(block, (tgt2, val2)), axis=0)
+
+
 def _seg_scatter(seg, nseg, valid, values, init, op):
     """Scatter-reduce values into [nseg] with a dead slot for invalid."""
     tgt = jnp.where(valid, seg, nseg)
+    vals = jnp.where(valid, values, init)
+    if nseg + 1 <= _ONEHOT_NSEG_MAX:
+        if op == "add" and values.dtype in (jnp.float32, jnp.int32) and (
+                not jnp.issubdtype(values.dtype, jnp.integer)
+                or values.shape[0] < (1 << 24)):
+            out = _seg_onehot_add(tgt, vals, nseg + 1)[:nseg]
+            return out.astype(values.dtype)
+        if op in ("min", "max"):
+            return _seg_onehot_extreme(
+                tgt, vals, nseg + 1, init, op)[:nseg]
     acc = jnp.full(nseg + 1, init, values.dtype)
-    acc = getattr(acc.at[tgt], op)(jnp.where(valid, values, init))
+    acc = getattr(acc.at[tgt], op)(vals)
     return acc[:nseg]
 
 

@@ -219,6 +219,10 @@ class Coordinator:
     def add_applied_listener(self, fn: Callable[[ClusterState], None]):
         self._applied_listeners.append(fn)
 
+    def remove_applied_listener(self, fn: Callable[[ClusterState], None]):
+        if fn in self._applied_listeners:
+            self._applied_listeners.remove(fn)
+
     def _now(self) -> float:
         return self.network.queue.now if hasattr(self.network, "queue") else self.network.now()
 

@@ -186,6 +186,11 @@ class ClusterNode:
                                          task["allocation_id"])
             if kind == "reallocate":
                 return allocate(st)
+            if kind == "engine_op":
+                # full-surface gateway: append one REST mutation to the
+                # replicated op log; every node's engine replica applies
+                # the log in order (cluster/http.py FullSurfaceGateway)
+                return st.with_engine_op(task["op"])
             raise ValueError(f"unknown master task [{kind}]")
 
         self.coordinator.submit_state_update(
@@ -204,6 +209,11 @@ class ClusterNode:
 
     def delete_index(self, name: str, on_done=None):
         self._submit_to_master({"kind": "delete_index", "index": name}, on_done)
+
+    def submit_engine_op(self, op: dict, on_done=None):
+        """Order one REST mutation through the master into the replicated
+        engine-op log (full-surface gateway data path)."""
+        self._submit_to_master({"kind": "engine_op", "op": op}, on_done)
 
     # ------------------------------------------------------------------
     # write path
@@ -302,13 +312,29 @@ class ClusterNode:
         meta = state.indices[index]
         term = meta["primary_terms"].get(str(s), 1)
         in_sync = meta.get("in_sync", {}).get(str(s), [])
-        # apply on primary, assigning seq-nos
+        # apply on primary, assigning seq-nos. `create` on an existing live
+        # doc is a per-item version conflict (reference: create maps to
+        # index-with-op_type=create -> VersionConflictEngineException 409),
+        # checked here under the shard's single-writer discipline
         ops_wire = []
         items = []
         for action, doc_id, source in req["ops"]:
+            if action == "create":
+                cur = copy.docs.get(doc_id)
+                if cur is not None and cur.alive:
+                    items.append({"create": {
+                        "_id": doc_id, "status": 409,
+                        "error": {
+                            "type": "version_conflict_engine_exception",
+                            "reason": f"[{doc_id}]: version conflict, "
+                                      "document already exists",
+                        },
+                    }})
+                    continue
             op = copy.prepare_primary_op(action, doc_id, source)
             r = copy.apply_op(op)
-            items.append({action: {**r, "status": 200}})
+            status = 201 if r.get("result") == "created" else 200
+            items.append({action: {**r, "status": status}})
             ops_wire.append(op)
         self._searchers.pop((index, s), None)
 

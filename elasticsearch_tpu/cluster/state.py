@@ -46,6 +46,15 @@ class ClusterState:
     indices: dict = field(default_factory=dict)
     # index name -> {shard_num(str): [ShardAssignment-dict, ...]}
     routing: dict = field(default_factory=dict)
+    # replicated REST-op log for the full-surface gateway: str(idx) ->
+    # {"method", "path", "body"}. Every node applies the ops in index
+    # order to its local engine replica, so the complete admin/x-pack
+    # REST surface converges on every node (the reference replicates the
+    # same decisions as typed cluster-state metadata custom sections —
+    # cluster/metadata/Metadata.Custom; an op log is this framework's
+    # wire-agnostic equivalent). Append-only; per-key diffs ship only new
+    # ops.
+    engine_ops: dict = field(default_factory=dict)
 
     # -- copy-on-write helpers --------------------------------------------
 
@@ -85,6 +94,11 @@ class ClusterState:
         routing_all[index] = routing
         return replace(self, routing=routing_all)
 
+    def with_engine_op(self, op: dict) -> "ClusterState":
+        ops = dict(self.engine_ops)
+        ops[str(len(ops))] = op
+        return replace(self, engine_ops=ops)
+
     # -- queries -----------------------------------------------------------
 
     def is_newer_than(self, other: "ClusterState") -> bool:
@@ -117,7 +131,7 @@ class ClusterState:
             "version": self.version,
             "master_id": self.master_id,
         }
-        for sect in ("nodes", "indices", "routing"):
+        for sect in ("nodes", "indices", "routing", "engine_ops"):
             mine, theirs = getattr(self, sect), getattr(base, sect)
             out[sect] = {
                 "set": {k: copy.deepcopy(v) for k, v in mine.items()
@@ -130,11 +144,11 @@ class ClusterState:
         """-> the successor state; caller must have checked this state IS
         the diff's base (term+version equality)."""
         sections = {}
-        for sect in ("nodes", "indices", "routing"):
+        for sect in ("nodes", "indices", "routing", "engine_ops"):
             cur = dict(getattr(self, sect))
-            for k in d[sect]["del"]:
+            for k in d.get(sect, {"del": (), "set": {}})["del"]:
                 cur.pop(k, None)
-            cur.update(copy.deepcopy(d[sect]["set"]))
+            cur.update(copy.deepcopy(d.get(sect, {"set": {}})["set"]))
             sections[sect] = cur
         return ClusterState(
             term=d["term"], version=d["version"], master_id=d["master_id"],
@@ -151,6 +165,7 @@ class ClusterState:
             "nodes": copy.deepcopy(self.nodes),
             "indices": copy.deepcopy(self.indices),
             "routing": copy.deepcopy(self.routing),
+            "engine_ops": copy.deepcopy(self.engine_ops),
         }
 
     @staticmethod
@@ -162,4 +177,5 @@ class ClusterState:
             nodes=copy.deepcopy(d.get("nodes", {})),
             indices=copy.deepcopy(d.get("indices", {})),
             routing=copy.deepcopy(d.get("routing", {})),
+            engine_ops=copy.deepcopy(d.get("engine_ops", {})),
         )

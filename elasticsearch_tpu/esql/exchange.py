@@ -44,11 +44,13 @@ def _plain_col(args):
 def supported_stats(payload, t: "Table") -> bool:
     """True when every aggregate takes the device partial+exchange path:
     count(*)/count(col), or sum/avg/min/max over a DOUBLE plain column.
-    Long columns stay on the host evaluator: the device accumulates in
-    f32, which would silently round 64-bit-integer sums that _run_stats
-    computes exactly (and change the reported column type). Row counts
-    are exact up to f32's 2^24 integer range, hence the size gate."""
-    if t.nrows >= (1 << 24):
+    Partials accumulate in float64 (x64 is enabled framework-wide), the
+    same precision as the host evaluator and the reference's double aggs,
+    so counts are exact to 2^53 and there is no magnitude cliff. Long
+    columns stay on the host evaluator: 64-bit-integer sums must stay
+    exact end-to-end (the sharded long path is esql/topn.py's i64 host
+    partials)."""
+    if t.nrows >= (1 << 53):  # count exactness bound in f64
         return False
     for _name, call in payload["aggs"]:
         fn, args = call[1], call[2]
@@ -130,7 +132,7 @@ def stats_exchange(
         parts.append(np.array([], np.int64))
     R = max((len(p) for p in parts), default=1) or 1
     g_pad = np.full((S, R), -1, np.int32)
-    vals_pad = {c: np.zeros((S, R), np.float32) for c in used_cols}
+    vals_pad = {c: np.zeros((S, R), np.float64) for c in used_cols}
     ok_pad = {c: np.zeros((S, R), bool) for c in used_cols}
     for s, idx in enumerate(parts):
         g_pad[s, : len(idx)] = gids[idx]
@@ -141,7 +143,7 @@ def stats_exchange(
 
     cols_stack = (
         np.stack([vals_pad[c] for c in used_cols], axis=1)
-        if used_cols else np.zeros((S, 0, R), np.float32)
+        if used_cols else np.zeros((S, 0, R), np.float64)
     )  # [S, C, R]
     oks_stack = (
         np.stack([ok_pad[c] for c in used_cols], axis=1)
@@ -150,17 +152,19 @@ def stats_exchange(
 
     def shard_partial(g1, v1, o1):
         # one shard's [1, ...] slice -> [G, C, 4] partial (cnt/sum/min/max)
+        # in f64: the host evaluator and the reference aggregate doubles in
+        # double, and +/-inf sentinels need no magnitude bound
         g, v, o = g1[0], v1[0], o1[0]
         onehot = (g[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :])
-        ohf = onehot.astype(jnp.float32)  # [R, G]
-        rows = (g >= 0).astype(jnp.float32)
+        ohf = onehot.astype(jnp.float64)  # [R, G]
+        rows = (g >= 0).astype(jnp.float64)
         row_cnt = jnp.matmul(rows[None, :], ohf)[0]  # [G] rows per group
         out = []
         for ci in range(v.shape[0]):
-            okf = o[ci].astype(jnp.float32)
+            okf = o[ci].astype(jnp.float64)
             cnt = jnp.matmul(okf[None, :], ohf)[0]
             ssum = jnp.matmul((v[ci] * okf)[None, :], ohf)[0]
-            big = jnp.float32(3.4e38)
+            big = jnp.float64(np.inf)
             vmin = jnp.min(
                 jnp.where(onehot & o[ci][:, None], v[ci][:, None], big),
                 axis=0,
@@ -171,7 +175,7 @@ def stats_exchange(
             )
             out.append(jnp.stack([cnt, ssum, vmin, vmax], axis=-1))
         per_col = (jnp.stack(out) if out
-                   else jnp.zeros((0, G, 4), jnp.float32))
+                   else jnp.zeros((0, G, 4), jnp.float64))
         return per_col[None], row_cnt[None]
 
     if mesh is not None:

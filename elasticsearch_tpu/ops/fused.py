@@ -29,17 +29,36 @@ rounding preserves sign, so the in-kernel count of positive live lanes is
 the reference's exact hit count (better than the reference's own default,
 which stops counting at 10k — TotalHits.Relation.GREATER_THAN_OR_EQUAL_TO).
 
-Selection in bf16 perturbs near-ties, so the kernel's top-K' (K'=32 >= k) is
-a CANDIDATE SET, not the result: `canonical_rescore` recomputes each
+Selection in bf16 perturbs near-ties, so the kernel's output is a
+CANDIDATE SET, not the result: `canonical_rescore` recomputes each
 winner's score in f32 with one shared function used by every path, and the
 final ranking is (rescored score desc, docid asc). A per-query safety test
 flags queries whose kth rescored score is not provably above anything the
-bf16 pass could have excluded; flagged queries re-run on the f32-scores
-variant of the same pipeline. Pattern ties (docs with identical (tf, dl)
-profiles — common under quantized norms) produce bit-identical scores in
-both precisions, so the kernel's docid tie-break already orders them
-correctly; the safety test treats an exact kth==K'th rescored tie as safe
-for that reason.
+bf16 pass could have excluded; flagged queries re-run on the legacy exact
+path. Pattern ties (docs with identical (tf, dl) profiles — common under
+quantized norms) produce bit-identical scores in both precisions, so the
+kernel's docid tie-break already orders them correctly; the safety test
+treats an exact kth==K'th rescored tie as safe for that reason.
+
+Round-4 restructure (the round-3 bottleneck was ~3,900 grid steps of fixed
+sequencing/DMA-issue cost plus per-step tiered top-K' accumulator merges of
+up to ~40 VPU reduce rounds — the MXU was <3% busy, BENCH_NOTES.md): the
+kernel no longer maintains a cross-step top-K' accumulator at all. Each
+grid step covers a WIDE doc tile (TILE_N=4096; 4x fewer steps) and emits
+only that tile's top-T candidates (T unrolled reduce rounds); the global
+top-K' merge happens OUTSIDE the kernel as one small `lax.top_k` over the
+[Q, njc*T] per-tile candidates. Losing a true top-K' entry is detectable
+after the fact: if a tile contributed fewer than T of the final K' winners,
+its T-th candidate ranks below the K'-th winner, so everything that tile
+dropped ranks below the K'-th winner too — hence the exact flag "some tile
+saturated its T slots among the K' winners", which composes with the same
+rerun escalation as the window-overflow flag. T is sized so saturation is
+~never hit at bench shapes (P[>=5 of the top-32 in one 4096-doc tile of
+244] ~ 6e-5 per query under exchangeable doc placement). The one-hot
+scatter keeps its measured-best 1024-doc granularity (FINE_N): each coarse
+step processes its 4 fine sub-windows with exact fori_loop row bounds from
+the scalar-prefetched pointers, replacing round 3's unrolled
+every-row-gated window walk.
 
 Reference behavior replaced: the DAAT BulkScorer loop + TopScoreDocCollector
 (reference: search/internal/ContextIndexSearcher.java:411-431) and the
@@ -63,10 +82,14 @@ except ImportError:  # pragma: no cover
 
 from ..index.pack import BLOCK
 
-KB = 32  # in-kernel candidate set size (top-K'); final k must be <= KB
-WARM_TILES = 128  # max leading tiles merged unbuffered (warm-up cap)
-TILE_N = 1024
-QSUB = 128  # query sub-tile: one MXU row block
+KB = 32  # rescored candidate set size (top-K'); final k must be <= KB
+# geometry defaults from the round-4 sweep on a v5e (BENCH_NOTES.md):
+# tile 8192 x qsub 256 measured 4.24x the C1 baseline model vs 3.6x for
+# 4096x128 — fewer grid steps win until VPU/matmul work dominates
+TILE_N = 8192  # coarse doc tile: one grid step scores [QSUB, TILE_N]
+FINE_N = 1024  # one-hot scatter + window-pointer granularity (measured best)
+TILE_T = 5  # per-tile candidates kept (see saturation flag, module doc)
+QSUB = 256  # query sub-tile rows per grid step (2 MXU row blocks)
 QC = 512  # fused query-chunk width
 # max docs a fused shard may hold (docid bit budget of the window sort key)
 MAX_DOCS_FUSED = (1 << 21) - 2 * TILE_N
@@ -123,92 +146,112 @@ def _topk_rounds(cand_v, cand_i, k):
     return jnp.concatenate(out_v, axis=1), jnp.concatenate(out_i, axis=1)
 
 
+def _cfg_tile() -> int:
+    """Coarse tile width; env-overridable for geometry sweeps."""
+    return int(os.environ.get("ES_TPU_FUSED_TILE", TILE_N))
+
+
+def _cfg_qsub() -> int:
+    """Query sub-tile rows per grid step; env-overridable for sweeps."""
+    return int(os.environ.get("ES_TPU_FUSED_QSUB", QSUB))
+
+
+def tile_t_for(njc: int) -> int:
+    """Per-tile candidate count. A tile's share of the top-K' is
+    ~Binomial(KB, 1/njc) under exchangeable doc placement, so t is sized
+    mean + 5*sigma-ish + slack to keep the saturation-flag rate negligible
+    (t=11 at njc=5 measured ~20% flagged; this formula gives 23 there and
+    6 at njc=245). t = KB+1 can never flag or lose (a tile holding the
+    whole top-K' still keeps K'+1 candidates)."""
+    t = int(os.environ.get("ES_TPU_FUSED_T", 0))
+    if t > 0:
+        return t
+    if njc <= 1:
+        return KB + 1
+    mu = KB / njc
+    import math
+
+    return max(TILE_T, min(KB + 1, math.ceil(mu + 5 * math.sqrt(mu) + 4)))
+
+
 def _fused_kernel(
-    ptr_ref,  # scalar prefetch [nsub*(nj+1)] i32 exact window starts
-    ptrb_ref,  # scalar prefetch [nsub*(nj+1)] i32 window block indices
-    scores_ref,  # [QSUB, TILE_N] block (bf16 | f32)
-    live_ref,  # [1, TILE_N] f32
-    keya_ref,  # [P/128, 128] i32 key rows of window block ptrb[j]
-    keyb_ref,  # [P/128, 128] i32 key rows of window block ptrb[j]+1
-    vala_ref,  # [P/128, 128] i32 f32-bits of window block ptrb[j]
-    valb_ref,  # [P/128, 128] i32 f32-bits of window block ptrb[j]+1
-    ov_ref,  # [QSUB, KB] f32
-    oi_ref,  # [QSUB, KB] i32
+    ptr_ref,  # scalar prefetch [nsub*(njf+1)] i32 exact fine window starts
+    ptrb_ref,  # scalar prefetch [nsub*(njc+1)] i32 coarse window block idx
+    scores_ref,  # [QSUB, tile_n] block (bf16 | f32)
+    live_ref,  # [1, tile_n] f32
+    keya_ref,  # [bud, 128] i32 key rows of window block ptrb[j]
+    keyb_ref,  # [bud, 128] i32 key rows of window block ptrb[j]+1
+    vala_ref,  # [bud, 128] i32 f32-bits of window block ptrb[j]
+    valb_ref,  # [bud, 128] i32 f32-bits of window block ptrb[j]+1
+    cv_ref,  # [1, QSUB, t] f32 per-tile candidate scores
+    ci_ref,  # [1, QSUB, t] i32 per-tile candidate docids
     ot_ref,  # [QSUB, 1] f32 (exact match counts)
-    of_ref,  # [QSUB, 1] f32 (overflow flags)
-    sacc,  # VMEM [QSUB, TILE_N] f32 (per-step sparse accumulator)
-    acc_v,  # VMEM [QC, KB] f32
-    acc_i,  # VMEM [QC, KB] i32
+    of_ref,  # [QSUB, 1] f32 (window-overflow flags)
+    sacc,  # VMEM [QSUB, tile_n] f32 (per-step sparse accumulator)
     cnt,  # VMEM [QC, 1] f32
     ovf,  # VMEM [QC, 1] f32
     *,
-    kb, tile_n, P, qsub, qb, db, sb, nj, warm,
+    t, tile_n, fine_n, bud, qsub, qb, db, sb, njc, njf,
 ):
     j = pl.program_id(0)
     i = pl.program_id(1)
 
     @pl.when((j == 0) & (i == 0))
     def _():
-        acc_v[:] = jnp.full_like(acc_v, -jnp.inf)
-        acc_i[:] = jnp.zeros_like(acc_i)
         cnt[:] = jnp.zeros_like(cnt)
         ovf[:] = jnp.zeros_like(ovf)
 
-    # ---- candidate window: two consecutive P-blocks around ptr[j] --------
-    # The pipeline streams blocks floor(ptr/P) and floor(ptr/P)+1 via the
-    # scalar-prefetched index maps; entries outside tile j's doc range (or
-    # belonging to another query sub-tile, or sentinel padding) are masked
-    # here, so no exact-start alignment is needed. Coverage is 2P entries;
-    # a longer window loses its tail -> overflow flag -> rerun escalation.
-    # Window entries are stored 128-per-row ([G/128, 128] — dense VMEM
-    # tiles; a [P, 2] layout lane-pads 64x and blows the VMEM budget), and
-    # each row feeds transposed one-hots contracted over the LANE axis.
-    base = i * (nj + 1) + j
-    end = ptr_ref[base + 1]
-
-    # ---- one-hot expansion: the MXU as a segmented scatter-add ----------
-    # The window is several times wider than the tile's real candidate run
-    # (block quantization + the >= 1024-entry block floor), so each
-    # 128-entry row is gated by a scalar range test on its sorted keys:
-    # rows that cannot intersect (subtile i, tile j) skip their one-hot
-    # build and both MXU passes — the dominant kernel cost at Zipf loads.
+    # ---- candidate window: two consecutive bud-row blocks ----------------
+    # One coarse step owns the sorted-entry range [ptr[i, j*fine],
+    # ptr[i, (j+1)*fine]) — contiguous because the sort key is
+    # (subtile | docid | qlow). The pipeline streams the two bud-row blocks
+    # around its start; rows are walked with EXACT fori_loop bounds per
+    # fine sub-tile (no per-row gating), and per-entry masks handle block
+    # edges, foreign subtiles, and sentinel padding. A range outside the
+    # 2*bud resident rows loses its tail -> overflow flag -> rerun.
+    fine = tile_n // fine_n
+    wrow0 = ptrb_ref[i * (njc + 1) + j] * bud
     qrow = jax.lax.broadcasted_iota(jnp.int32, (qsub, 128), 0)
-    nrow = jax.lax.broadcasted_iota(jnp.int32, (tile_n, 128), 0)
+    nrow = jax.lax.broadcasted_iota(jnp.int32, (fine_n, 128), 0)
     one = jnp.float32(1.0)
     zero = jnp.float32(0.0)
-    rows_per_blk = P // 128
     dn = (((1,), (1,)), ((), ()))
-    key_lo = (i << jnp.int32(sb)) | (j * tile_n << jnp.int32(qb))
-    key_hi = (i << jnp.int32(sb)) | ((j + 1) * tile_n << jnp.int32(qb))
     sacc[...] = jnp.zeros_like(sacc)
-    for c in range(2 * rows_per_blk):
-        if c < rows_per_blk:
-            key_ref, val_ref, cc = keya_ref, vala_ref, c
-        else:
-            key_ref, val_ref, cc = keyb_ref, valb_ref, c - rows_per_blk
-        first = key_ref[cc, 0]
-        last = key_ref[cc, 127]
+    lost = jnp.bool_(False)
+    for f in range(fine):
+        basef = i * (njf + 1) + j * fine + f
+        start = ptr_ref[basef]
+        end = ptr_ref[basef + 1]
+        # >> 7 == // 128: Mosaic's scalar floor_divide lowering recurses
+        # infinitely under x64 (measured; shifts lower cleanly)
+        ra = jnp.maximum((start >> 7) - wrow0, 0)
+        rb_need = ((end + 127) >> 7) - wrow0
+        two_bud = np.int32(2 * bud)
+        rb = jnp.minimum(jnp.maximum(rb_need, ra), two_bud)
+        lost = lost | (rb_need > two_bud)
+        base_doc = (j * fine + f) * fine_n
+        col0 = f * fine_n  # static python int: pl.ds lowers it as a literal
 
-        @pl.when((last >= key_lo) & (first < key_hi))
-        def _(key_ref=key_ref, val_ref=val_ref, cc=cc):
-            key = key_ref[cc : cc + 1, :]  # [1, 128]
+        # ---- one-hot expansion: the MXU as a segmented scatter-add ------
+        def _row(key_ref, val_ref, off_r, c):
+            key = key_ref[pl.ds(c - off_r, 1), :]  # [1, 128]
             val = jax.lax.bitcast_convert_type(
-                val_ref[cc : cc + 1, :], jnp.float32
+                val_ref[pl.ds(c - off_r, 1), :], jnp.float32
             )
             qlow = key & (qsub - 1)
             doc = jax.lax.shift_right_logical(
                 key, jnp.int32(qb)
             ) & ((1 << db) - 1)
-            off = doc - j * tile_n
+            off = doc - base_doc
             inwin = (
                 (jax.lax.shift_right_logical(key, jnp.int32(sb)) == i)
                 & (off >= 0)
-                & (off < tile_n)
+                & (off < fine_n)
             )
             At = jnp.where((qrow == qlow) & inwin, val, zero)  # [qsub, 128]
             D = jnp.where((nrow == off) & inwin, one, zero).astype(
                 jnp.bfloat16
-            )  # [tile_n, 128]
+            )  # [fine_n, 128]
             # split-bf16 weights (masked — see EPS_SPLIT note): hi + lo
             # carries ~15 mantissa bits through two bf16 MXU passes with
             # f32 accumulation, keeping selection within EPS_SPLIT of the
@@ -216,11 +259,20 @@ def _fused_kernel(
             Ahf = _mask_hi(At)
             Ah = Ahf.astype(jnp.bfloat16)
             Al = (At - Ahf).astype(jnp.bfloat16)
-            sacc[...] += jax.lax.dot_general(
+            sacc[:, pl.ds(col0, fine_n)] += jax.lax.dot_general(
                 Ah, D, dn, preferred_element_type=jnp.float32
             ) + jax.lax.dot_general(
                 Al, D, dn, preferred_element_type=jnp.float32
-            )  # [qsub, tile_n]
+            )  # [qsub, fine_n]
+
+        jax.lax.fori_loop(
+            ra, jnp.minimum(rb, bud),
+            lambda c, _, : _row(keya_ref, vala_ref, 0, c) or 0, 0,
+        )
+        jax.lax.fori_loop(
+            jnp.maximum(ra, bud), rb,
+            lambda c, _, : _row(keyb_ref, valb_ref, bud, c) or 0, 0,
+        )
 
     dense = scores_ref[:].astype(jnp.float32)
     lv = live_ref[0:1, :] > 0
@@ -232,151 +284,116 @@ def _fused_kernel(
     cnt[rs] += jnp.sum(
         total > 0, axis=1, keepdims=True, dtype=jnp.float32
     )
-    lost = end > ptrb_ref[base] * P + 2 * P
     ovf[rs] += jnp.broadcast_to(lost.astype(jnp.float32), (qsub, 1))
 
-    # ---- top-K' maintenance: tiered merges --------------------------------
-    # Only a tile's top-T entries enter the accumulator (a kb x (kb+T)
-    # merge instead of kb x (kb+tile_n)); a query with > T entries above
-    # its current K'th score in ONE tile would lose entries -> flag it for
-    # the rerun escalation. The expected new-entry count per tile is
-    # lambda ~ kb/j, so T steps down as the scan warms: full merge while
-    # lambda >= 1 (j < kb), top-8 through the warm-up window
-    # (P(Poisson(1) > 8) ~ 1e-6), top-4 after (lambda <= kb/warm ~ 0.26,
-    # P(X > 4) ~ 1e-4). Starting top-8 at j=8 flagged ~6% of bench
-    # queries (lambda = 4 there -> P(X > 8) ~ 2% per tile).
-    def _carry(t):
-        theta = acc_v[rs][:, kb - 1 : kb]
-        c_above = jnp.sum(
-            total > theta, axis=1, keepdims=True, dtype=jnp.int32
-        )
-        ovf[rs] += (c_above > t).astype(jnp.float32)
-        tv_, ti_ = _topk_rounds(total, ids, t)
-        mv, mi = _topk_rounds(
-            jnp.concatenate([acc_v[rs], tv_], axis=1),
-            jnp.concatenate([acc_i[rs], ti_], axis=1),
-            kb,
-        )
-        acc_v[rs] = mv
-        acc_i[rs] = mi
+    # ---- per-tile top-t: the ONLY selection done in-kernel ---------------
+    tv, ti = _topk_rounds(total, ids, t)
+    cv_ref[_I0] = tv
+    ci_ref[_I0] = ti
 
-    @pl.when(j < kb)
+    @pl.when(j == njc - 1)
     def _():
-        mv, mi = _topk_rounds(
-            jnp.concatenate([acc_v[rs], total], axis=1),
-            jnp.concatenate([acc_i[rs], ids], axis=1),
-            kb,
-        )
-        acc_v[rs] = mv
-        acc_i[rs] = mi
-
-    @pl.when((j >= kb) & (j < warm))
-    def _():
-        _carry(8)
-
-    @pl.when(j >= warm)
-    def _():
-        _carry(4)
-
-    @pl.when(j == nj - 1)
-    def _():
-        ov_ref[:] = acc_v[rs]
-        oi_ref[:] = acc_i[rs]
         ot_ref[:] = cnt[rs]
         of_ref[:] = ovf[rs]
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("kb", "tile_n", "P", "qsub", "warm", "interpret"),
+    static_argnames=("t", "tile_n", "fine_n", "bud", "qsub", "interpret"),
 )
-def fused_sparse_topk(
+def fused_tile_candidates(
     scores,  # [Qc, Npad] bf16 | f32 dense-tier scores (padding cols = 0)
     live,  # [1, Npad] f32 (0 for dead/padding)
-    keys,  # [Gpad/128, 128] i32 sorted window keys; Gpad % P == 0, with
-    #       >= 2P trailing sentinel entries (key = int32 max)
+    keys,  # [Gpad/128, 128] i32 sorted window keys; rows % bud == 0, with
+    #       >= 2*bud trailing sentinel rows (key = int32 max)
     vals,  # [Gpad/128, 128] i32 f32-bits of the per-posting partial scores
-    ptr,  # [nsub*(nj+1)] i32 window starts (entry index) into keys/vals
+    ptr,  # [nsub*(njf+1)] i32 window starts (entry index) into keys/vals
     *,
-    kb=KB,
+    t,
+    bud,
     tile_n=TILE_N,
-    P=1024,
+    fine_n=FINE_N,
     qsub=QSUB,
-    warm=WARM_TILES,
     interpret=False,
 ):
-    """-> (top_v [Qc, kb] f32, top_i [Qc, kb] i32, totals [Qc] i32,
-    overflow [Qc] bool). Selection precision: split-bf16 of the inputs
-    (see EPS_SPLIT); totals exact."""
+    """-> (cand_v [Qc, njc*t] f32, cand_i [Qc, njc*t] i32, totals [Qc] i32,
+    window_lost [Qc] bool). Per-tile top-t candidates by split-bf16
+    selection (see EPS_SPLIT); totals exact. The global merge + saturation
+    flag happen in the caller."""
     qc, n_pad = scores.shape
-    assert qc % qsub == 0 and n_pad % tile_n == 0 and P % 128 == 0
+    assert qc % qsub == 0 and n_pad % tile_n == 0 and tile_n % fine_n == 0
     nsub = qc // qsub
-    nj = n_pad // tile_n
+    njc = n_pad // tile_n
+    njf = n_pad // fine_n
+    fine = tile_n // fine_n
     qb, db, sb = _key_bits(n_pad, qsub, nsub)
     kernel = functools.partial(
         _fused_kernel,
-        kb=kb, tile_n=tile_n, P=P, qsub=qsub, qb=qb, db=db, sb=sb,
-        nj=nj, warm=min(warm, max(kb, nj // 8)),
+        t=t, tile_n=tile_n, fine_n=fine_n, bud=bud, qsub=qsub,
+        qb=qb, db=db, sb=sb, njc=njc, njf=njf,
     )
-    nblk = keys.shape[0] * 128 // P
-    ptr_blk = jnp.minimum(ptr // P, nblk - 2)
-    rpb = P // 128
+    nblk = keys.shape[0] // bud
+    # coarse window start block (units of bud rows), from the fine ptr
+    coarse_start = ptr.reshape(nsub, njf + 1)[:, ::fine]
+    ptrb = jnp.minimum(
+        coarse_start.reshape(-1) // 128 // bud, nblk - 2
+    ).astype(jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(nj, nsub),
+        grid=(njc, nsub),
         in_specs=[
             pl.BlockSpec((qsub, tile_n), lambda j, i, *_: (i, j)),
             pl.BlockSpec((1, tile_n), lambda j, i, *_: (_I0, j)),
             pl.BlockSpec(
-                (rpb, 128),
-                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j], _I0),
+                (bud, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (njc + 1) + j], _I0),
             ),
             pl.BlockSpec(
-                (rpb, 128),
-                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j] + 1, _I0),
+                (bud, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (njc + 1) + j] + 1, _I0),
             ),
             pl.BlockSpec(
-                (rpb, 128),
-                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j], _I0),
+                (bud, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (njc + 1) + j], _I0),
             ),
             pl.BlockSpec(
-                (rpb, 128),
-                lambda j, i, ptr, ptrb: (ptrb[i * (nj + 1) + j] + 1, _I0),
+                (bud, 128),
+                lambda j, i, ptr, ptrb: (ptrb[i * (njc + 1) + j] + 1, _I0),
             ),
         ],
         out_specs=[
-            pl.BlockSpec((qsub, kb), lambda j, i, *_: (i, _I0)),
-            pl.BlockSpec((qsub, kb), lambda j, i, *_: (i, _I0)),
+            pl.BlockSpec((1, qsub, t), lambda j, i, *_: (j, i, _I0)),
+            pl.BlockSpec((1, qsub, t), lambda j, i, *_: (j, i, _I0)),
             pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
             pl.BlockSpec((qsub, 1), lambda j, i, *_: (i, _I0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((qsub, tile_n), jnp.float32),
-            pltpu.VMEM((qc, kb), jnp.float32),
-            pltpu.VMEM((qc, kb), jnp.int32),
             pltpu.VMEM((qc, 1), jnp.float32),
             pltpu.VMEM((qc, 1), jnp.float32),
         ],
     )
-    ov, oi, ot, of = pl.pallas_call(
+    cv, ci, ot, of = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((qc, kb), jnp.float32),
-            jax.ShapeDtypeStruct((qc, kb), jnp.int32),
+            jax.ShapeDtypeStruct((njc, qc, t), jnp.float32),
+            jax.ShapeDtypeStruct((njc, qc, t), jnp.int32),
             jax.ShapeDtypeStruct((qc, 1), jnp.float32),
             jax.ShapeDtypeStruct((qc, 1), jnp.float32),
         ],
         # v5e has 128MB of physical VMEM; Mosaic's default 16MB scoped
-        # budget double-counts per-region transients of the tiered merges
+        # budget double-counts per-region transients
         compiler_params=(
             None if interpret else pltpu.CompilerParams(
                 vmem_limit_bytes=64 * 1024 * 1024
             )
         ),
         interpret=interpret,
-    )(ptr, ptr_blk, scores, live, keys, keys, vals, vals)
-    return ov, oi, ot[:, 0].astype(jnp.int32), of[:, 0] > 0
+    )(ptr, ptrb, scores, live, keys, keys, vals, vals)
+    cv = jnp.swapaxes(cv, 0, 1).reshape(qc, njc * t)
+    ci = jnp.swapaxes(ci, 0, 1).reshape(qc, njc * t)
+    return cv, ci, ot[:, 0].astype(jnp.int32), of[:, 0] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -479,12 +496,15 @@ def plan_fused(pack, fld, queries, k, qc=QC):
         dense_l.append(dlist)
         td_max = max(td_max, len(dlist))
     nreal = sum(len(r) for r in rows_l)
-    # quantize R in 4x steps: every distinct R is a fresh XLA compile
-    # (~15s through the remote compile service), and Zipf batches flap
-    # across a pow2 boundary often enough to thrash the cache
+    # quantize R in pow2 steps: every distinct R is a fresh XLA compile
+    # (~15s through the remote compile service, persistent-cached), and
+    # Zipf batches flap across boundaries often enough to thrash a finer
+    # quantization. (4x steps — the round-3 choice — left the device
+    # sorting ~2x more entries than real on average; the sort is a top-3
+    # chunk cost, so the extra compile variants pay for themselves.)
     R = 64
     while R < nreal:
-        R *= 4
+        R *= 2
     rows = np.zeros(R, np.int32)  # row 0 of the pack = all-padding block
     row_q = np.zeros(R, np.int32)
     row_w = np.zeros(R, np.float32)
@@ -507,13 +527,15 @@ def _fused_pipeline(
     fa,  # device dict: tier16/tier32 [V, n_pad], live [1, n_pad], post_*
     W, rows, row_q, row_w, dense_rows, dense_w,
     *,
-    k, n, n_pad, avgdl, has_norms, k1, b, P, interpret, qsub=QSUB,
+    k, n, n_pad, avgdl, has_norms, k1, b, bud, t, tile_n, interpret,
+    qsub=QSUB,
 ):
     """One fused chunk, fully on device. -> (v [Q,k], i, totals, flags)."""
     qc = W.shape[0]
     R = rows.shape[0]
     nsub = qc // qsub
-    nj = n_pad // TILE_N
+    njf = n_pad // FINE_N
+    njc = n_pad // tile_n
     qb, db, sb = _key_bits(n_pad, qsub, nsub)
 
     # phase A: gather CSR block rows, per-posting partial scores
@@ -542,47 +564,90 @@ def _fused_pipeline(
     )
     bounds = (
         (jnp.arange(nsub, dtype=jnp.int32)[:, None] << sb)
-        | (jnp.arange(nj + 1, dtype=jnp.int32)[None, :] * TILE_N << qb)
+        | (jnp.arange(njf + 1, dtype=jnp.int32)[None, :] * FINE_N << qb)
     )
     ptr = jnp.searchsorted(skey, bounds.reshape(-1)).astype(jnp.int32)
-    pad_n = 2 * P + (-(skey.shape[0] + 2 * P)) % P
+    bude = bud * 128
+    pad_n = 2 * bude + (-(skey.shape[0] + 2 * bude)) % bude
     sent = jnp.full((pad_n,), jnp.int32(2**31 - 1))
     keys2 = jnp.concatenate([skey, sent]).reshape(-1, 128)
     vals2 = jnp.concatenate(
         [jax.lax.bitcast_convert_type(sval, jnp.int32), sent]
     ).reshape(-1, 128)
 
-    # dense tier in split-bf16: hi+lo carries ~16 mantissa bits through
-    # three bf16 MXU passes with f32 accumulation (~3x a single bf16
-    # matmul, ~2x cheaper than 6-pass f32 HIGHEST) — selection lands
-    # within ~2^-16 of the canonical f32 rescore, so EPS_SPLIT = 1e-4
-    # keeps the safety-flag rate near zero even when the 10th..32nd
-    # scores pack within a percent (typical at 1M docs)
+    # dense tier in split-bf16: hi+lo carries ~16 mantissa bits with f32
+    # accumulation — selection lands within ~2^-16 of the canonical f32
+    # rescore, so EPS_SPLIT (2e-4) keeps the safety-flag rate near zero
+    # even when the 10th..32nd scores pack within a percent (typical at
+    # 1M docs). The three logical products (Wh@T16 + Wh@T16lo + Wl@T16)
+    # run as ONE stacked matmul when the pack keeps the [3V, n_pad]
+    # stacked tier resident (measured: three separate [Qc, n_pad] f32
+    # matmul outputs cost ~56 ms/chunk at 1M docs — almost all HBM
+    # round-trips of the intermediates — vs ~18 ms stacked)
     Whf = _mask_hi(W)
     Wh = Whf.astype(jnp.bfloat16)
     Wl = (W - Whf).astype(jnp.bfloat16)
-    scores = (
-        jnp.matmul(Wh, fa["tier16"], preferred_element_type=jnp.float32)
-        + jnp.matmul(Wh, fa["tier16_lo"], preferred_element_type=jnp.float32)
-        + jnp.matmul(Wl, fa["tier16"], preferred_element_type=jnp.float32)
-    )
-    eps = EPS_SPLIT
-    tv, ti, totals, ovf = fused_sparse_topk(
-        scores, fa["live"], keys2, vals2, ptr, P=P, interpret=interpret
+    if "tier16_stack" in fa:
+        W3 = jnp.concatenate([Wh, Wh, Wl], axis=1)  # [Qc, 3V]
+        scores = jnp.matmul(
+            W3, fa["tier16_stack"], preferred_element_type=jnp.float32
+        )
+    else:
+        scores = (
+            jnp.matmul(Wh, fa["tier16"], preferred_element_type=jnp.float32)
+            + jnp.matmul(
+                Wh, fa["tier16_lo"], preferred_element_type=jnp.float32
+            )
+            + jnp.matmul(Wl, fa["tier16"], preferred_element_type=jnp.float32)
+        )
+    cv, ci, totals, wlost = fused_tile_candidates(
+        scores, fa["live"], keys2, vals2, ptr,
+        t=t, bud=bud, tile_n=tile_n, qsub=qsub, interpret=interpret,
     )
 
-    # canonical rescore + final ranking + safety test
-    cand_ok = tv > -jnp.inf
-    resc = canonical_rescore(
-        fa["tier32"], dense_rows, dense_w, row_q, docids, parts, ti, cand_ok
+    # global top-K' over the per-tile candidates. An i64 (score, docid)
+    # rank-key top_k over the WIDE candidate matrix costs ~13 ms/chunk;
+    # instead: f32 top_k by value with a 16-deep margin (~3 ms), then the
+    # exact i64 rank order within that margin set. Docid-order selection
+    # can only go wrong if a bit-identical value-tie cluster at the K'-th
+    # value extends past the margin (pattern ties are common in Zipf
+    # corpora — value-boundary ties alone flagged 20-27% of smoke
+    # queries); that residue is flagged (tie_clip) and escalates.
+    kb_eff = min(KB, cv.shape[1])
+    m_eff = min(kb_eff + 16, cv.shape[1])
+    mv, sel = jax.lax.top_k(cv, m_eff)
+    mi = jnp.take_along_axis(ci, sel, axis=1)
+    kv, ki = rank_topk(mv, mi, kb_eff)
+    cand_ok = kv > -jnp.inf
+    vstar = kv[:, kb_eff - 1 : kb_eff]
+    n_at_vstar = jnp.sum(cv == vstar, axis=1)
+    n_in_margin = jnp.sum(mv == vstar, axis=1)
+    tie_clip = jnp.isfinite(vstar[:, 0]) & (n_at_vstar > n_in_margin)
+
+    # saturation flag: if a tile contributed >= t of the K' winners it may
+    # have dropped entries that also belonged in the K' set (module doc
+    # has the proof sketch)
+    tiles = ki // tile_n
+    same_tile = (
+        (tiles[:, :, None] == tiles[:, None, :])
+        & cand_ok[:, :, None]
+        & cand_ok[:, None, :]
     )
-    v, i = rank_topk(resc, ti, k)
-    am_kernel = tv[:, -1]
+    sat = jnp.any(
+        cand_ok & (jnp.sum(same_tile, axis=2) >= t), axis=1
+    ) | tie_clip
+
+    # canonical rescore + final ranking + safety test
+    resc = canonical_rescore(
+        fa["tier32"], dense_rows, dense_w, row_q, docids, parts, ki, cand_ok
+    )
+    v, i = rank_topk(resc, ki, k)
+    am_kernel = kv[:, -1]
     am_resc = jnp.min(jnp.where(cand_ok, resc, jnp.inf), axis=1)
     rk = v[:, k - 1]
-    bound = am_kernel + eps * jnp.abs(am_kernel)
+    bound = am_kernel + EPS_SPLIT * jnp.abs(am_kernel)
     safe = jnp.isneginf(am_kernel) | (rk > bound) | (rk == am_resc)
-    return v, i, totals, ovf | ~safe
+    return v, i, totals, wlost | sat | ~safe
 
 
 class FusedTermSearcher:
@@ -599,6 +664,7 @@ class FusedTermSearcher:
         self.searcher = bts.searcher
         self._cache = {}
         self._fa = None
+        self._fa_live_of = None
 
     @staticmethod
     def usable(pack, k) -> bool:
@@ -613,56 +679,87 @@ class FusedTermSearcher:
             return True
         return (
             jax.default_backend() == "tpu"
-            and pack.num_docs >= 4 * TILE_N
+            and pack.num_docs >= 4 * FINE_N
         )
 
     def _arrays(self):
+        dev = self.searcher.dev
+        tile_n = _cfg_tile()
+        n = self.searcher.pack.num_docs
+        n_pad = ((n + tile_n - 1) // tile_n) * tile_n
+        padw = n_pad - n
         if self._fa is None:
-            dev = self.searcher.dev
-            n = self.searcher.pack.num_docs
-            n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
-            padw = n_pad - n
-
             # HBM budget: the f32 tier stays SHARED with the legacy path
             # (unpadded — the rescore only gathers from it); only the
             # bf16 hi/lo pair is padded for the matmul. One fused jit so
             # the padded f32 intermediate is a transient, not a resident.
+            self._fa = {
+                "tier32": dev["dense_tfn"],
+                "post_docids": dev["post_docids"],
+                "post_tfs": dev["post_tfs"],
+                "post_dls": dev["post_dls"],
+            }
+            V = dev["dense_tfn"].shape[0]
+            # [3V, n_pad] stacked tier -> ONE dense matmul per chunk (see
+            # _fused_pipeline); costs a duplicate of the hi tier in HBM,
+            # so gate on the stack staying inside a 16 GB chip alongside
+            # tier32, postings, and per-execution score workspaces. Built
+            # by ONE jit straight from the f32 tier so the hi/lo parts
+            # never materialize as separate resident arrays (peak = tier32
+            # + stack, not + 2 intermediate copies).
+            stack_bytes = 3 * V * n_pad * 2
+            use_stack = (
+                os.environ.get("ES_TPU_FUSED_STACK", "1") != "0"
+                and stack_bytes <= 6 * 1024**3
+            )
+
             @jax.jit
             def split(t):
                 tp = jnp.pad(t, ((0, 0), (0, padw)))
                 hif = _mask_hi(tp)
                 hi = hif.astype(jnp.bfloat16)
                 lo = (tp - hif).astype(jnp.bfloat16)
+                if use_stack:
+                    return (jnp.concatenate([hi, lo, hi], axis=0),)
                 return hi, lo
 
-            hi, lo = split(dev["dense_tfn"])
-            live = jnp.pad(
+            if use_stack:
+                (self._fa["tier16_stack"],) = split(dev["dense_tfn"])
+            else:
+                hi, lo = split(dev["dense_tfn"])
+                self._fa["tier16"] = hi
+                self._fa["tier16_lo"] = lo
+        # tiered refresh re-ships dev["live"] (StackedSearcher.update_live)
+        # — rebuild the padded copy whenever the device buffer changes so a
+        # long-lived fused searcher never scores deleted docs. The cache
+        # key is the buffer OBJECT (held, so its id cannot be recycled).
+        if self._fa_live_of is not dev["live"]:
+            self._fa["live"] = jnp.pad(
                 dev["live"].astype(jnp.float32), (0, padw)
             )[None, :]
-            self._fa = {
-                "tier32": dev["dense_tfn"],
-                "tier16": hi,
-                "tier16_lo": lo,
-                "live": live,
-                "post_docids": dev["post_docids"],
-                "post_tfs": dev["post_tfs"],
-                "post_dls": dev["post_dls"],
-            }
+            self._fa_live_of = dev["live"]
         return self._fa
 
     def _compiled(self, fld, R, Td, k, nreal, interpret):
         pack = self.searcher.pack
         n = pack.num_docs
-        n_pad = ((n + TILE_N - 1) // TILE_N) * TILE_N
-        nj = n_pad // TILE_N
+        tile_n = _cfg_tile()
+        qsub = _cfg_qsub()
+        n_pad = ((n + tile_n - 1) // tile_n) * tile_n
+        njc = n_pad // tile_n
+        t = tile_t_for(njc)
         # window sizing follows the REAL posting count (R counts padded
-        # slots — up to ~40% at Zipf loads, which doubles P for nothing),
-        # quantized in pow2 steps so batch-to-batch jitter cannot flap the
-        # compile key; floor 1024: [P/128, 128] blocks need >= 8 sublanes
+        # slots — up to ~40% at Zipf loads, which doubles the budget for
+        # nothing), quantized in pow2 steps so batch-to-batch jitter cannot
+        # flap the compile key; floor 2048 entries: [bud, 128] blocks need
+        # >= 8 sublanes
         nreal_q = 1 << max(nreal - 1, 1).bit_length()
-        mean_win = max(1, nreal_q * BLOCK // ((QC // QSUB) * nj))
-        P = min(4096, max(1024, 1 << (2 * mean_win - 1).bit_length()))
-        key = (fld, R, Td, k, interpret, P)
+        mean_win = max(1, nreal_q * BLOCK // ((QC // qsub) * njc))
+        bude = min(
+            64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
+        )
+        bud = bude // 128
+        key = (fld, R, Td, k, interpret, bud, tile_n, qsub)
         fn = self._cache.get(key)
         if fn is None:
             kw = dict(
@@ -670,7 +767,8 @@ class FusedTermSearcher:
                 avgdl=pack.avgdl(fld),
                 has_norms=fld in self.searcher.ctx.has_norms,
                 k1=1.2, b=0.75,
-                P=P, interpret=interpret,
+                bud=bud, t=t, tile_n=tile_n, qsub=qsub,
+                interpret=interpret,
             )
             fn = jax.jit(functools.partial(_fused_pipeline, **kw))
             self._cache[key] = fn

@@ -821,6 +821,42 @@ class StackedSearcher:
             res = self.search_wand(node, size, from_, floor=prune_floor)
             if res is not None:
                 return res
+        return self.search_batch(
+            [dict(query=node, size=size, from_=from_, aggs=aggs, mappings=m)]
+        )[0]
+
+    def search_batch(self, requests: list[dict]) -> list:
+        """Execute several search/agg requests with batched device
+        round-trips: every request's program is dispatched before any
+        result is fetched, so the fixed dispatch+fetch latency (the
+        dominant cost of a single agg request through a remote runtime —
+        BENCH_NOTES.md) is paid once per WAVE, not once per request.
+        Two waves maximum: pass-1 for everything, then pass-2 for
+        requests whose high-cardinality terms aggs use the two-pass
+        candidate scheme. Each request dict: query (dict | QueryNode |
+        None), size, from_, aggs, mappings.
+
+        The reference has no agg-batching analog (each search is its own
+        scatter/gather); this is the same discipline `ops/batched` applies
+        to the query path, extended to aggregations."""
+        states = [self._agg_dispatch(**r) for r in requests]
+        host = jax.device_get([s["outs"] for s in states])
+        wave2 = []
+        for s, ho in zip(states, host):
+            s["host"] = ho
+            if self._agg_pass2_dispatch(s):
+                wave2.append(s)
+        if wave2:
+            host2 = jax.device_get([s["outs2"] for s in wave2])
+            for s, h2 in zip(wave2, host2):
+                s["host2"] = h2
+        return [self._agg_finalize(s) for s in states]
+
+    def _agg_dispatch(self, query=None, size=10, from_=0, aggs=None,
+                      mappings=None):
+        """Plan + launch one request's pass-1 program (no device fetch)."""
+        m = mappings if mappings is not None else self.sp.mappings
+        node = query if isinstance(query, QueryNode) else parse_query(query, m)
         agg_nodes = None
         if aggs:
             from ..aggs import parse_aggs
@@ -847,38 +883,63 @@ class StackedSearcher:
             agg_key = tuple(akeys)
         k = min(max(size + from_, 1), max(self.sp.n_max * self.sp.S, 1))
         fn = self._compiled(node, tuple(keys), k, agg_nodes, agg_key)
-        g_scores, g_shard, g_doc, total, agg_out = jax.device_get(
-            fn(self.dev, params, agg_params)
-        )
+        return {
+            "node": node, "keys": tuple(keys), "k": k, "size": size,
+            "from_": from_, "agg_nodes": agg_nodes, "agg_key": agg_key,
+            "params": params, "agg_params": agg_params,
+            "outs": fn(self.dev, params, agg_params),
+        }
+
+    def _agg_pass2_dispatch(self, s) -> bool:
+        """Launch pass 2 (two-pass terms candidates) if the request needs
+        it; candidate selection uses the GLOBAL merged counts (exact —
+        unlike the reference's per-shard shard_size approximation)."""
+        agg_nodes = s["agg_nodes"]
+        if not agg_nodes:
+            return False
+        from ..aggs import two_pass_plan
+
+        tp = two_pass_plan(agg_nodes)
+        if not tp:
+            return False
+        _s1, _s2, _s3, _t, agg_out = s["host"]
+        merged = {name: anode.merge_partials(agg_out[name])
+                  for name, anode in agg_nodes.items()}
+        s["merged"] = merged
+        s["tp"] = tp
+        S = self.sp.S
+        agg_params = s["agg_params"]
+        for name, a in tp.items():
+            cm = a.select_candidates(merged[name])
+            agg_params[name] = {
+                **agg_params[name],
+                "cand": np.broadcast_to(cm, (S, len(cm))).copy(),
+            }
+        fn2 = self._compiled(
+            s["node"], s["keys"], s["k"], agg_nodes,
+            (s["agg_key"], "tp2",
+             tuple(sorted((n, a._C) for n, a in tp.items()))))
+        s["outs2"] = fn2(self.dev, s["params"], agg_params)
+        return True
+
+    def _agg_finalize(self, s) -> StackedResult:
+        g_scores, g_shard, g_doc, total, agg_out = s["host"]
+        agg_nodes = s["agg_nodes"]
         aggregations = None
         if agg_nodes:
-            from ..aggs import two_pass_plan
-
-            merged = {name: anode.merge_partials(agg_out[name])
-                      for name, anode in agg_nodes.items()}
-            tp = two_pass_plan(agg_nodes)
-            if tp:
-                # candidates from the GLOBAL merged counts (exact — unlike
-                # the reference's per-shard shard_size approximation), then
-                # pass 2 computes sub-aggs over candidate slots only
-                for name, a in tp.items():
-                    cm = a.select_candidates(merged[name])
-                    agg_params[name] = {
-                        **agg_params[name],
-                        "cand": np.broadcast_to(cm, (S, len(cm))).copy(),
-                    }
-                fn2 = self._compiled(
-                    node, tuple(keys), k, agg_nodes,
-                    (agg_key, "tp2",
-                     tuple(sorted((n, a._C) for n, a in tp.items()))))
-                _s1, _s2, _s3, _t, agg_out2 = jax.device_get(
-                    fn2(self.dev, params, agg_params))
-                for name, a in tp.items():
+            merged = s.get("merged") or {
+                name: anode.merge_partials(agg_out[name])
+                for name, anode in agg_nodes.items()
+            }
+            if "host2" in s:
+                _s1, _s2, _s3, _t, agg_out2 = s["host2"]
+                for name, a in s["tp"].items():
                     merged[name].update(a.merge_partials(agg_out2[name]))
             aggregations = {
                 name: anode.finalize(merged[name], 1)[0]
                 for name, anode in agg_nodes.items()
             }
+        size, from_ = s["size"], s["from_"]
         valid = np.isfinite(g_scores)
         max_score = float(g_scores[0]) if valid.any() else None
         end = max(size + from_, 0)
@@ -1053,7 +1114,7 @@ class StackedSearcher:
 
 
 def msearch_sharded(ss: "StackedSearcher", fld: str,
-                    queries: list, k: int = 10):
+                    queries: list, k: int = 10, _return_program=False):
     """Batched multi-query term-disjunction `_msearch` over the shard mesh.
 
     The production C5 shape: per-shard batch plans (one BatchPlan per shard,
@@ -1136,6 +1197,12 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
                 v, i, t = jax.vmap(body)(dev, W_, rows_, ws_)
                 return v[:, 0], i[:, 0], t[:, 0]
         fn = ss._cache[cache_key] = jax.jit(run)
+    if _return_program:
+        # measurement hook (scripts/c5_mesh_probe.py): the compiled
+        # program + its device inputs, so collective-merge overhead can be
+        # timed against the shard-local portion on a virtual mesh
+        return fn, (sub, jnp.asarray(W), jnp.asarray(rows),
+                    jnp.asarray(ws)), kk
     v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
                                 jnp.asarray(ws)))
     # coordinator merge: (score desc, shard asc, doc asc)

