@@ -245,14 +245,40 @@ def config2_wand(sp_mod, pack, m, rng):
             mismatches += 1
     p50_ex = float(np.median(t_ex)) * 1e3
     p50_pr = float(np.median(t_pr)) * 1e3
+
+    # batched comparison: BOTH paths pipelined over the same 12 queries.
+    # The two-pass plan pays two fixed device round trips + host pruning;
+    # a serving node amortizes them across a batch exactly like _msearch
+    # and the agg path — round 3's net-slowdown was this fixed cost
+    # measured at single-query depth (BENCH_NOTES.md C2).
+    from elasticsearch_tpu.query.dsl import parse_query
+
+    nodes = [parse_query(q, m) for q in qs]
+    ex_reqs = [dict(query=nd, size=TOP_K) for nd in nodes]
+    wd_reqs = [dict(node=nd, size=TOP_K, floor=0) for nd in nodes]
+    ss.search_batch(ex_reqs)
+    ss.search_wand_batch(wd_reqs)  # warm both batched plans
+    t0 = time.perf_counter()
+    r_exb = ss.search_batch(ex_reqs)
+    t_exb = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r_prb = ss.search_wand_batch(wd_reqs)
+    t_prb = time.perf_counter() - t0
+    b_mism = sum(
+        1 for a, b_ in zip(r_prb, r_exb)
+        if a is None or list(a.doc_ids) != list(b_.doc_ids)
+    )
     return {
         "p50_exhaustive_ms": round(p50_ex, 1),
         "p50_pruned_ms": round(p50_pr, 1),
-        "speedup": round(p50_ex / p50_pr, 2),
+        "speedup_single": round(p50_ex / p50_pr, 2),
+        "batch12_exhaustive_ms": round(t_exb * 1e3, 1),
+        "batch12_pruned_ms": round(t_prb * 1e3, 1),
+        "speedup": round(t_exb / t_prb, 2),
         "postings_pruned_frac": round(
             float(np.mean(pruned_frac)) if pruned_frac else 0.0, 3),
         "engaged": f"{engaged}/{len(qs)}",
-        "topk_mismatches": mismatches,
+        "topk_mismatches": mismatches + b_mism,
     }
 
 

@@ -118,6 +118,11 @@ class EsIndex:
         self._dirty = True
         self._last_refresh = 0.0
         self._searcher: StackedSearcher | None = None
+        # searchable-snapshot lazy hydration (snapshots/service.py
+        # mount_snapshot): fetches the mounted snapshot's blobs through
+        # the shared cache on first use; cleared before running so the
+        # hydration's own refresh cannot recurse
+        self._hydrate = None
         self.shard_docs: list[list[tuple[str, dict]]] = []
         # ---- tiered refresh state (Lucene-segment analog: a sealed base
         # pack + a small tail pack; deletes/updates flip base live bits;
@@ -384,6 +389,9 @@ class EsIndex:
         (aggs, collapse, ESQL, suggest, …) read this; when a tail tier
         exists it is merged into a fresh base first — the analog of a
         force-merge ahead of an operation the tiered form can't serve."""
+        if self._hydrate is not None:
+            h, self._hydrate = self._hydrate, None
+            h()
         if self._tail is not None:
             self._merge_tiers()
         return self._searcher
@@ -393,6 +401,9 @@ class EsIndex:
         self._searcher = value
 
     def refresh(self, mesh=None):
+        if self._hydrate is not None:
+            h, self._hydrate = self._hydrate, None
+            h()
         if self._searcher is not None and not self._pending and not self._dirty:
             return  # nothing written since the last refresh
         if self._can_refresh_incremental():
@@ -1073,6 +1084,18 @@ class Engine:
             self.settings.add_consumer(
                 key, lambda raw, c=child: self.breakers.set_limit(c, raw)
             )
+        # shared blob cache for mounted searchable snapshots, byte-
+        # accounted under the request breaker (frozen-tier RAM budget)
+        from ..snapshots.blobcache import SharedBlobCache
+
+        def _cache_breaker(delta: int):
+            if delta >= 0:
+                self.breakers.add_estimate(
+                    "request", delta, "searchable_snapshot_cache")
+            else:
+                self.breakers.release("request", -delta)
+
+        self.blob_cache = SharedBlobCache(breaker=_cache_breaker)
         if data_path:
             os.makedirs(os.path.join(data_path, "indices"), exist_ok=True)
             for name in sorted(os.listdir(os.path.join(data_path, "indices"))):

@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -586,8 +587,45 @@ class StackedSearcher:
         See query/wand.py for the plan and the soundness argument
         (reference: Lucene block-max WAND via
         search/query/QueryPhaseCollectorManager.java:416; SURVEY §7 hard
-        part #2 — skipping becomes block filtering).
-        """
+        part #2 — skipping becomes block filtering)."""
+        out = self.search_wand_batch([dict(node=node, size=size,
+                                           from_=from_, floor=floor)])
+        return out[0]
+
+    def search_wand_batch(self, requests: list[dict]) -> list:
+        """Batched two-pass WAND: every request's pass-1 program launches
+        before any θ is fetched, host pruning runs for the whole batch,
+        then every pass-2 program launches before any result is fetched —
+        two device round trips TOTAL for the batch instead of two per
+        query. The plan overhead that round 3 measured as a net slowdown
+        at single-query scale (BENCH_NOTES.md C2) amortizes exactly like
+        the `_msearch` and agg batch paths. Entries that don't qualify
+        (shape, floor, nothing pruned) come back as None; callers run
+        those exhaustively (search_batch pipelines them the same way)."""
+        states = [
+            self._wand_plan(r["node"], r.get("size", 10),
+                            r.get("from_", 0), r.get("floor", 0))
+            for r in requests
+        ]
+        live = [s for s in states if s is not None]
+        if live:
+            host1 = jax.device_get([s["outs1"] for s in live])
+            for s, h in zip(live, host1):
+                s["host1"] = h
+        wave2 = [s for s in live if self._wand_dispatch2(s)]
+        if wave2:
+            host2 = jax.device_get([s["outs2"] for s in wave2])
+            for s, h in zip(wave2, host2):
+                s["host2"] = h
+        return [
+            self._wand_finalize(s) if s is not None and "host2" in s
+            else None
+            for s in states
+        ]
+
+    def _wand_plan(self, node, size: int, from_: int,
+                   floor: int = 0) -> dict | None:
+        """Host planning + pass-1 launch (no fetch); None = not eligible."""
         from ..index.pack import BM25_K1, BM25_B
         from ..query import wand
 
@@ -655,7 +693,14 @@ class StackedSearcher:
         n_csr = sum(1 for i in infos if i["dense"] is None)
         min_rows = getattr(self, "wand_min_rows", None)
         if min_rows is None:
-            min_rows = max(32, 2 * S * n_csr)
+            # Profitability gate from the round-4 measurement (BENCH_r04
+            # C2 / BENCH_NOTES.md): even batched, the two-pass plan costs
+            # one extra device round trip + a host posting prune, and the
+            # exhaustive batched kernel clears ~1-2G postings/s — pruning
+            # only pays once a query's CSR postings are of order 10^7
+            # (~10^5 block rows). Below that the plan is provably net
+            # negative at identical results, so it must not engage.
+            min_rows = int(os.environ.get("ES_TPU_WAND_MIN_ROWS", 100_000))
         if n_csr == 0 or csr_rows_total < min_rows:
             return None  # too few blocks for pruning to pay for two launches
 
@@ -737,7 +782,7 @@ class StackedSearcher:
                 [(p, np.float32(node.boost)) for p in per_shard_params])
             return params, tuple(key for _ in range(S))
 
-        # ---- pass 1: seed θ from each term's best blocks
+        # ---- pass 1: seed θ from each term's best blocks (launch only)
         p1_rows = [
             [i["rows"][s][: min(PASS1_ROWS, len(i["rows"][s]))] for s in range(S)]
             if i["dense"] is None else None
@@ -745,14 +790,27 @@ class StackedSearcher:
         ]
         params1, keys1 = synth(p1_rows)
         fn1 = self._compiled(node, ("wand1", keys1), k, None, ())
-        g_scores1, _gs1, _gd1, _tot1, _ = jax.device_get(
-            fn1(self.dev, params1, {}))
+        return {
+            "node": node, "terms": terms, "infos": infos, "win_ub": win_ub,
+            "synth": synth, "k": k, "size": size, "from_": from_,
+            "outs1": fn1(self.dev, params1, {}),
+        }
+
+    def _wand_dispatch2(self, st) -> bool:
+        """Host doc-level prune from θ + pass-2 launch; False when pruning
+        bought nothing (caller falls back to the exhaustive plan)."""
+        from ..query import wand
+
+        node, terms, infos = st["node"], st["terms"], st["infos"]
+        win_ub, k = st["win_ub"], st["k"]
+        S = self.sp.S
+        g_scores1, _gs1, _gd1, _tot1, _ = st["host1"]
         valid1 = np.isfinite(g_scores1)
         theta = float(g_scores1[k - 1]) if valid1.sum() >= k else -np.inf
 
-        # ---- pass 2: doc-level pruning — drop every posting whose exact
-        # self score + other-terms' window bound cannot reach θ, compact
-        # survivors into synthetic blocks (query/wand.prune_postings)
+        # doc-level pruning — drop every posting whose exact self score +
+        # other-terms' window bound cannot reach θ, compact survivors into
+        # synthetic blocks (query/wand.prune_postings)
         p2_inline = []
         kept = dropped = 0
         boost = float(node.boost)
@@ -780,11 +838,16 @@ class StackedSearcher:
                 dropped += tot - kp
             p2_inline.append(arrs_s)
         if dropped == 0:
-            return None  # pruning bought nothing; use the exhaustive plan
-        params2, keys2 = synth(None, p2_inline)
+            return False  # pruning bought nothing; use the exhaustive plan
+        params2, keys2 = st["synth"](None, p2_inline)
         fn2 = self._compiled(node, ("wand2", keys2), k, None, ())
-        g_scores, g_shard, g_doc, total, _ = jax.device_get(
-            fn2(self.dev, params2, {}))
+        st.update(theta=theta, kept=kept, dropped=dropped,
+                  outs2=fn2(self.dev, params2, {}))
+        return True
+
+    def _wand_finalize(self, st) -> "StackedResult":
+        g_scores, g_shard, g_doc, total, _ = st["host2"]
+        size, from_ = st["size"], st["from_"]
         valid = np.isfinite(g_scores)
         max_score = float(g_scores[0]) if valid.any() else None
         end = max(size + from_, 0)
@@ -799,8 +862,9 @@ class StackedSearcher:
         out.total_relation = "gte"
         # kept/dropped count POSTINGS since the round-3 doc-level pruning
         # (block-level pruning cannot help mid-frequency disjunctions)
-        out.wand_stats = {"rows_kept": kept, "rows_pruned": dropped,
-                          "theta": theta}
+        out.wand_stats = {"rows_kept": st["kept"],
+                          "rows_pruned": st["dropped"],
+                          "theta": st["theta"]}
         return out
 
     def search(

@@ -2068,6 +2068,19 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                        request.match_info["repo"], request.match_info["snap"])
         )
 
+    @handler
+    async def mount_snapshot(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(
+            await call(engine.snapshots.mount_snapshot,
+                       request.match_info["repo"], request.match_info["snap"],
+                       body)
+        )
+
+    @handler
+    async def searchable_snapshot_cache_stats(request):
+        return web.json_response(engine.blob_cache.stats())
+
     # ---- cluster / cat ---------------------------------------------------
 
     @handler
@@ -2160,6 +2173,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_delete("/_snapshot/{repo}/{snap}", delete_snapshot)
     app.router.add_post("/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     app.router.add_get("/_snapshot/{repo}/{snap}/_status", snapshot_status)
+    app.router.add_post("/_snapshot/{repo}/{snap}/_mount", mount_snapshot)
+    app.router.add_get("/_searchable_snapshots/cache/stats",
+                       searchable_snapshot_cache_stats)
     app.router.add_post("/_aliases", post_aliases)
     app.router.add_get("/_alias", get_alias)
     app.router.add_get("/_alias/{alias}", get_alias, allow_head=False)

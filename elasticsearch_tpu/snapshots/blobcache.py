@@ -1,0 +1,92 @@
+"""Shared blob cache for searchable snapshots (frozen tier).
+
+The reference mounts shards straight from object storage with a shared
+local cache of file regions
+(x-pack/plugin/blob-cache/src/main/java/org/elasticsearch/blobcache/shared/SharedBlobCacheService.java:68);
+this framework's unit of storage is the content-addressed snapshot blob
+(doc chunks / pack components, snapshots/repository.py), so the cache is
+a host-RAM LRU over blob digests shared by every mounted index: a cold
+mount's first search pays the object-store round trips once, every
+re-mount and repeated fetch hits RAM. Byte-accounted against the parent
+circuit breaker when one is wired (common/breaker.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+
+class SharedBlobCache:
+    """Thread-safe LRU of blob payloads with a byte budget."""
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024,
+                 breaker: "Callable[[int], None] | None" = None):
+        """breaker: called with the DELTA of resident bytes (positive on
+        insert, negative on eviction); raising inside it vetoes the
+        insert (the entry is simply not cached — reads still succeed)."""
+        self.max_bytes = int(max_bytes)
+        self._breaker = breaker
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, bytes] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_fetch(self, key: str, fetch: Callable[[], bytes]) -> bytes:
+        with self._lock:
+            got = self._entries.get(key)
+            if got is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return got
+            self.misses += 1
+        payload = fetch()  # outside the lock: object-store latency
+        self._insert(key, payload)
+        return payload
+
+    def _insert(self, key: str, payload: bytes):
+        size = len(payload)
+        if size > self.max_bytes:
+            return  # larger than the whole budget: serve uncached
+        with self._lock:
+            if key in self._entries:
+                return
+            evicted = 0
+            while self._bytes + size > self.max_bytes and self._entries:
+                _k, v = self._entries.popitem(last=False)
+                self._bytes -= len(v)
+                evicted += len(v)
+                self.evictions += 1
+            if self._breaker is not None:
+                try:
+                    self._breaker(size - evicted)
+                except Exception:
+                    return  # breaker veto: keep serving, skip caching
+            self._entries[key] = payload
+            self._bytes += size
+
+    def clear(self):
+        with self._lock:
+            if self._breaker is not None and self._bytes:
+                try:
+                    self._breaker(-self._bytes)
+                except Exception:
+                    pass
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "shared_cache": {
+                    "size_in_bytes": self._bytes,
+                    "region_count": len(self._entries),
+                    "max_size_in_bytes": self.max_bytes,
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "evictions": self.evictions,
+                }
+            }

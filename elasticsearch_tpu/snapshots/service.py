@@ -274,6 +274,69 @@ class SnapshotService:
             }
         }
 
+    # ---- searchable snapshots (frozen tier) ------------------------------
+
+    def mount_snapshot(self, repo_name: str, snap_name: str,
+                       body: dict) -> dict:
+        """Mount a snapshotted index as a read-only searchable-snapshot
+        index (reference: x-pack searchable-snapshots `_mount` +
+        SharedBlobCacheService.java:68). The mount itself moves NO data:
+        index metadata comes from the snapshot manifest; the doc-chunk
+        blobs are demand-fetched through the engine's shared LRU blob
+        cache on the FIRST search (lazy hydration), so a cold mount is
+        instant, a cold search pays the object-store round trips once,
+        and every re-mount hits RAM. The mounted index carries
+        blocks.write (the reference's searchable-snapshot indices are
+        likewise read-only)."""
+        from ..utils.errors import IllegalArgumentError
+
+        body = body or {}
+        name = body.get("index")
+        if not name:
+            raise IllegalArgumentError("[index] is required")
+        repo = self._repo(repo_name)
+        snap = self._load_snap(repo, snap_name)
+        if name not in snap["indices"]:
+            raise IndexNotFoundError(name)
+        new_name = body.get("renamed_index") or name
+        if new_name in self.engine.indices:
+            raise IllegalArgumentError(
+                f"cannot mount index [{new_name}] because an open index "
+                "with same name already exists in the cluster")
+        meta = snap["indices"][name]
+        settings = dict(meta["settings"])
+        settings.update(body.get("index_settings") or {})
+        settings["store.type"] = "snapshot"
+        settings["store.snapshot.repository_name"] = repo_name
+        settings["store.snapshot.snapshot_name"] = snap_name
+        idx = self.engine.create_index(new_name, meta["mappings"], settings)
+        idx.settings["blocks.write"] = True
+        cache = self.engine.blob_cache
+        chunks = list(meta["chunks"])
+
+        def hydrate():
+            idx.settings.pop("blocks.write", None)
+            try:
+                for digest in chunks:
+                    payload = cache.get_or_fetch(
+                        f"{repo_name}/{digest}",
+                        lambda digest=digest: repo.get_blob(digest),
+                    )
+                    for d in json.loads(payload):
+                        idx.index_doc(d["id"], d["source"])
+                idx.refresh()
+            finally:
+                idx.settings["blocks.write"] = True
+
+        idx._hydrate = hydrate
+        return {
+            "snapshot": {
+                "snapshot": snap_name,
+                "indices": [new_name],
+                "shards": {"total": 1, "failed": 0, "successful": 1},
+            }
+        }
+
     def status(self, repo_name: str, snap_name: str) -> dict:
         repo = self._repo(repo_name)
         snap = self._load_snap(repo, snap_name)

@@ -7,10 +7,19 @@ pass/fail/skip per test. Used to curate tests/test_yaml_rest.py's manifest.
 from __future__ import annotations
 
 import asyncio
+import os
 import sys
 import traceback
 
-from aiohttp.test_utils import TestClient, TestServer
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+# this environment's sitecustomize pins the TPU platform at interpreter
+# start; the survey must run CPU-only (same override as tests/conftest.py)
+# so it never contends with a concurrent hardware bench
+jax.config.update("jax_platforms", "cpu")
+
+from aiohttp.test_utils import TestClient, TestServer  # noqa: E402
 
 from elasticsearch_tpu.rest import make_app
 
