@@ -164,6 +164,7 @@ class EngineReplica:
     """
 
     APPLY_TIMEOUT = 30.0
+    APPLY_RETRIES = 5
 
     def __init__(self, server: NodeServer, loop):
         self.server = server
@@ -173,6 +174,7 @@ class EngineReplica:
         self.next_idx = 0
         self.waiting: set = set()
         self.applied: dict = {}
+        self.failed: str | None = None  # poisoned replica: refuse to serve
         self._runner = None
         self._http = None
         self._task = None
@@ -218,17 +220,41 @@ class EngineReplica:
             ops = await self.queue.get()
             while str(self.next_idx) in ops:
                 op = ops[str(self.next_idx)]
-                try:
-                    st, body, ct = await self._call(
-                        op["method"], op["path"],
-                        op["body"].encode("utf-8", "surrogateescape"),
-                        op.get("ct") or "",
-                    )
-                except Exception as e:  # noqa: BLE001
-                    st, body, ct = 500, json.dumps(
-                        {"error": {"type": "replica_apply_exception",
-                                   "reason": str(e)}, "status": 500}
-                    ).encode(), "application/json"
+                # An engine HTTP *response* (any status, incl. 4xx/5xx from
+                # the app) is deterministic — every replica computes the
+                # same one. A loopback *transport* failure is node-local:
+                # skipping the op would silently fork this replica from the
+                # rest of the cluster forever (ADVICE r4 #1). Only a
+                # CONNECT failure is provably pre-send and safe to retry;
+                # any failure after the request may have gone out (response
+                # read, disconnect, timeout) cannot be retried — ops are
+                # not idempotent (scripted updates, bulk create) and a
+                # second application would itself fork the replica. Those
+                # poison the replica: it stops serving rather than serve
+                # diverged data.
+                import aiohttp
+
+                st = body = ct = None
+                for attempt in range(self.APPLY_RETRIES):
+                    try:
+                        st, body, ct = await self._call(
+                            op["method"], op["path"],
+                            op["body"].encode("utf-8", "surrogateescape"),
+                            op.get("ct") or "",
+                        )
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        pre_send = isinstance(e, aiohttp.ClientConnectorError)
+                        if not pre_send or attempt + 1 == self.APPLY_RETRIES:
+                            self.failed = (
+                                f"replica apply failed at op {self.next_idx}"
+                                f" (attempt {attempt + 1}, "
+                                f"{'pre-send' if pre_send else 'post-send'}):"
+                                f" {e}")
+                            async with self.cond:
+                                self.cond.notify_all()
+                            return
+                        await asyncio.sleep(0.05 * (2 ** attempt))
                 async with self.cond:
                     if op.get("id") in self.waiting:
                         self.applied[op["id"]] = (st, body, ct)
@@ -247,6 +273,8 @@ class EngineReplica:
     # -- request handling -------------------------------------------------
 
     async def handle(self, request: web.Request) -> web.Response:
+        if self.failed is not None:
+            return _err(503, "replica_poisoned", self.failed)
         path_qs = str(request.rel_url)
         body = await request.read()
         ct = request.headers.get("Content-Type", "")
@@ -274,9 +302,13 @@ class EngineReplica:
                             str(ack.get("why") or "engine op not committed"))
             async with self.cond:
                 await asyncio.wait_for(
-                    self.cond.wait_for(lambda: op["id"] in self.applied),
+                    self.cond.wait_for(
+                        lambda: op["id"] in self.applied
+                        or self.failed is not None),
                     timeout=self.APPLY_TIMEOUT,
                 )
+                if op["id"] not in self.applied:
+                    return _err(503, "replica_poisoned", self.failed)
                 st, rbody, rct = self.applied.pop(op["id"])
             return web.Response(
                 status=st, body=rbody, content_type=rct.split(";")[0])
@@ -335,10 +367,23 @@ def make_cluster_app(server: NodeServer,
 
     async def health(request):
         st = node.state
-        h = _health_of(st)
+        if replica is not None and replica.engine_port is not None:
+            # full-surface mode: all index data lives in the replica
+            # engines, not the data-plane routing table — index/shard
+            # health MUST come from what the surface actually serves, or
+            # it is vacuously green with 0 shards (ADVICE r4 #4)
+            try:
+                _st, rbody, _ct = await replica._call(
+                    "GET", str(request.rel_url), b"", "")
+                h = json.loads(rbody)
+            except Exception:  # noqa: BLE001 - replica warming up
+                h = _health_of(st)
+        else:
+            h = _health_of(st)
         h.update({
             "cluster_name": "elasticsearch-tpu",
             "number_of_nodes": len(st.nodes),
+            "number_of_data_nodes": len(st.nodes),
             "master_node": node.coordinator.leader,
             "term": st.term,
             "version": st.version,

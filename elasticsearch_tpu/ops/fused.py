@@ -665,6 +665,12 @@ class FusedTermSearcher:
         self._cache = {}
         self._fa = None
         self._fa_live_of = None
+        # geometry snapshot: taken ONCE here so a mid-process env change
+        # (ES_TPU_FUSED_TILE/QSUB/T sweeps) can never mismatch a cached
+        # compiled pipeline against freshly padded arrays (ADVICE r4 #3)
+        self._tile_n = _cfg_tile()
+        self._qsub = _cfg_qsub()
+        self._t_env = int(os.environ.get("ES_TPU_FUSED_T", 0))
 
     @staticmethod
     def usable(pack, k) -> bool:
@@ -684,7 +690,7 @@ class FusedTermSearcher:
 
     def _arrays(self):
         dev = self.searcher.dev
-        tile_n = _cfg_tile()
+        tile_n = self._tile_n
         n = self.searcher.pack.num_docs
         n_pad = ((n + tile_n - 1) // tile_n) * tile_n
         padw = n_pad - n
@@ -743,11 +749,11 @@ class FusedTermSearcher:
     def _compiled(self, fld, R, Td, k, nreal, interpret):
         pack = self.searcher.pack
         n = pack.num_docs
-        tile_n = _cfg_tile()
-        qsub = _cfg_qsub()
+        tile_n = self._tile_n
+        qsub = self._qsub
         n_pad = ((n + tile_n - 1) // tile_n) * tile_n
         njc = n_pad // tile_n
-        t = tile_t_for(njc)
+        t = self._t_env if self._t_env > 0 else tile_t_for(njc)
         # window sizing follows the REAL posting count (R counts padded
         # slots — up to ~40% at Zipf loads, which doubles the budget for
         # nothing), quantized in pow2 steps so batch-to-batch jitter cannot
@@ -759,7 +765,7 @@ class FusedTermSearcher:
             64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
         )
         bud = bude // 128
-        key = (fld, R, Td, k, interpret, bud, tile_n, qsub)
+        key = (fld, R, Td, k, interpret, bud, tile_n, qsub, t)
         fn = self._cache.get(key)
         if fn is None:
             kw = dict(

@@ -32,6 +32,18 @@ from ..query.nodes import ExecContext, QueryNode
 from .stacked import StackedPack
 
 
+def wand_gate_min_rows() -> int:
+    """Resolved WAND profitability gate: minimum total CSR block rows for
+    the two-pass pruned plan to engage. The single source of truth —
+    bench.py's crossover reporting reads THIS, so a retuned default can
+    never desynchronize the bench from production. Derivation: the
+    exhaustive batched kernel clears ~1-2G postings/s while the pruned
+    plan pays an extra device round trip + host posting prune, so pruning
+    pays only once a query's CSR postings are of order 10^7 (~10^5 block
+    rows) — see BENCH_NOTES.md C2."""
+    return int(os.environ.get("ES_TPU_WAND_MIN_ROWS", 100_000))
+
+
 def make_mesh(num_shards: int) -> Mesh | None:
     """Mesh over the first num_shards devices; None -> single-device vmap."""
     devices = jax.devices()
@@ -623,6 +635,36 @@ class StackedSearcher:
             for s in states
         ]
 
+    def search_pruned_batch(self, requests: list[dict]) -> list:
+        """Gate-then-fallback pruned search, batched: block-max WAND for
+        every request the profitability gate accepts, exhaustive execution
+        for the rest — one batched wave each, so a request never costs
+        more than its exhaustive execution plus the (amortized) gate
+        check. Semantically this is `search(prune_floor=...)`'s
+        gate+fallback decision applied to a whole batch; the engine's
+        serving path still runs that decision per query (engine.py
+        `search`), while bench.py times THIS batched form so a
+        non-engaging batch measures as ~the exhaustive batch, never as a
+        no-op (VERDICT r4 weak #2).
+
+        Each request dict: node (QueryNode), size, from_, floor.
+        Returns StackedResults; each carries `.wand_engaged`."""
+        pruned = self.search_wand_batch(requests)
+        fb_idx = [i for i, r in enumerate(pruned) if r is None]
+        if fb_idx:
+            fb = self.search_batch([
+                dict(query=requests[i]["node"],
+                     size=requests[i].get("size", 10),
+                     from_=requests[i].get("from_", 0))
+                for i in fb_idx
+            ])
+            for i, r in zip(fb_idx, fb):
+                pruned[i] = r
+        fb_set = set(fb_idx)
+        for i, r in enumerate(pruned):
+            r.wand_engaged = i not in fb_set
+        return pruned
+
     def _wand_plan(self, node, size: int, from_: int,
                    floor: int = 0) -> dict | None:
         """Host planning + pass-1 launch (no fetch); None = not eligible."""
@@ -693,14 +735,9 @@ class StackedSearcher:
         n_csr = sum(1 for i in infos if i["dense"] is None)
         min_rows = getattr(self, "wand_min_rows", None)
         if min_rows is None:
-            # Profitability gate from the round-4 measurement (BENCH_r04
-            # C2 / BENCH_NOTES.md): even batched, the two-pass plan costs
-            # one extra device round trip + a host posting prune, and the
-            # exhaustive batched kernel clears ~1-2G postings/s — pruning
-            # only pays once a query's CSR postings are of order 10^7
-            # (~10^5 block rows). Below that the plan is provably net
-            # negative at identical results, so it must not engage.
-            min_rows = int(os.environ.get("ES_TPU_WAND_MIN_ROWS", 100_000))
+            # profitability gate (see wand_gate_min_rows): below it the
+            # plan is provably net negative at identical results
+            min_rows = wand_gate_min_rows()
         if n_csr == 0 or csr_rows_total < min_rows:
             return None  # too few blocks for pruning to pay for two launches
 

@@ -61,8 +61,18 @@ class SharedBlobCache:
                 evicted += len(v)
                 self.evictions += 1
             if self._breaker is not None:
+                # account eviction and insert SEPARATELY: the evicted bytes
+                # are gone from the cache regardless of the insert's fate,
+                # so they must always be released — a single net-delta call
+                # that the breaker vetoes would leak `evicted` bytes of
+                # breaker estimate per veto (ADVICE r4 #2)
+                if evicted:
+                    try:
+                        self._breaker(-evicted)
+                    except Exception:
+                        pass  # releases must never raise
                 try:
-                    self._breaker(size - evicted)
+                    self._breaker(size)
                 except Exception:
                     return  # breaker veto: keep serving, skip caching
             self._entries[key] = payload

@@ -117,6 +117,12 @@ class FsRepository(Repository):
     def __init__(self, location: str):
         if not location:
             raise IllegalArgumentError("[location] is required for fs repositories")
+        # relative locations resolve under ES_TPU_PATH_REPO (the reference's
+        # `path.repo` setting, Environment.java repoFiles) so test/demo repos
+        # never land in the process CWD
+        base = os.environ.get("ES_TPU_PATH_REPO")
+        if base and not os.path.isabs(location):
+            location = os.path.join(base, location)
         self.location = location
         os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
 

@@ -66,6 +66,10 @@ def _assert_same_topk(pruned, exact):
 
 def test_wand_prunes_and_matches_exhaustive_csr_only():
     s = _searcher(_wand_corpus(), dense_min_df=BIG)
+    # the profitability gate (wand_min_rows, ~10^5 block rows) would refuse
+    # this small corpus; force engagement — this test checks pruning
+    # *mechanics* (parity + majority-skip), not the gate
+    s.wand_min_rows = 1
     exact = s.search(parse_query(Q4, MAPPING), size=10)
     pruned = s.search_wand(parse_query(Q4, MAPPING), 10, 0)
     assert pruned is not None, "WAND should engage on a CSR disjunction"

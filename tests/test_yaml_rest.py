@@ -19,6 +19,7 @@ scripts — ESRestTestCase.wipeCluster analog).
 from __future__ import annotations
 
 import asyncio
+import os
 from pathlib import Path
 
 import pytest
@@ -156,6 +157,21 @@ def _wipe(client, loop):
                 await client.delete(f"/_snapshot/{repo}")
 
     loop.run_until_complete(go())
+    # clear repository *files* too: registrations are gone, but blobs and
+    # snap-*.json under the shared path.repo dir would otherwise leak into
+    # the next yaml case (name collisions across the engine/cluster
+    # fixtures — the round-4 order-dependent failures)
+    import shutil
+
+    base = os.environ.get("ES_TPU_PATH_REPO")
+    if (base and os.path.isdir(base)
+            and os.path.exists(os.path.join(base, ".es_tpu_test_repos"))):
+        # only a conftest-created (sentinel-marked) dir is ever cleared —
+        # an externally exported ES_TPU_PATH_REPO is user data
+        for entry in os.listdir(base):
+            if entry == ".es_tpu_test_repos":
+                continue
+            shutil.rmtree(os.path.join(base, entry), ignore_errors=True)
 
 
 @pytest.mark.parametrize(
