@@ -23,6 +23,7 @@ import re
 import threading
 import uuid
 
+import aiohttp
 from aiohttp import web
 
 from .server import NodeServer
@@ -181,8 +182,6 @@ class EngineReplica:
         self.engine_port = None
 
     async def start(self):
-        import aiohttp
-
         from ..rest import make_app
 
         self._runner = web.AppRunner(make_app())
@@ -232,8 +231,6 @@ class EngineReplica:
                 # second application would itself fork the replica. Those
                 # poison the replica: it stops serving rather than serve
                 # diverged data.
-                import aiohttp
-
                 st = body = ct = None
                 for attempt in range(self.APPLY_RETRIES):
                     try:
@@ -367,6 +364,10 @@ def make_cluster_app(server: NodeServer,
 
     async def health(request):
         st = node.state
+        if replica is not None and replica.failed is not None:
+            # a poisoned replica must not report healthy while every data
+            # request 503s — surface the failure to monitoring
+            return _err(503, "replica_poisoned", replica.failed)
         if replica is not None and replica.engine_port is not None:
             # full-surface mode: all index data lives in the replica
             # engines, not the data-plane routing table — index/shard

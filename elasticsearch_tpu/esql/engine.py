@@ -550,7 +550,25 @@ def execute(engine, query: str, mesh=None) -> Table:
     stages = parse(query)
     t: Table | None = None
     shard_of = None
-    for kind, payload in stages:
+    si = 0
+    while si < len(stages):
+        kind, payload = stages[si]
+        si += 1
+        if kind == "sort" and si < len(stages) and stages[si][0] == "limit":
+            # SORT|LIMIT fuses into the sharded top-n exchange when rows
+            # still map to shards: per-shard device top-n + rank-key
+            # all-gather merge (esql/topn.py; reference TopNOperator +
+            # ExchangeService) — bit-identical to the host sort+limit
+            from .topn import supported_topn, topn_exchange
+
+            limit = stages[si][1]
+            if (shard_of is not None and len(shard_of) == t.nrows
+                    and t.nrows > 0 and supported_topn(payload, t)):
+                sel = topn_exchange(t, shard_of, payload, limit, mesh=mesh)
+                t = t.take(sel)
+                shard_of = shard_of[sel]
+                si += 1  # the limit stage is consumed by the exchange
+                continue
         if kind == "from":
             t = _collect_table(engine, ",".join(payload["indices"]),
                                payload["metadata"])
@@ -598,6 +616,15 @@ def execute(engine, query: str, mesh=None) -> Table:
                         rank = np.argsort(-inv, kind="stable")
                     else:
                         rank = np.argsort(key, kind="stable")
+                elif np.asarray(vals).dtype.kind in "iu":
+                    # longs sort on exact int64 (a float64 key would merge
+                    # values above 2^53 into one tie — and diverge from
+                    # the exact topn exchange); desc via bitwise-not,
+                    # which reverses int64 order without the overflow of
+                    # negating INT64_MIN
+                    ikey = np.asarray(vals, np.int64)
+                    rank = np.argsort(~ikey if desc else ikey,
+                                      kind="stable")
                 else:
                     nkey = np.asarray(vals, np.float64)
                     rank = np.argsort(-nkey if desc else nkey, kind="stable")

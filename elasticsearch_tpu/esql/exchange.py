@@ -43,13 +43,18 @@ def _plain_col(args):
 
 def supported_stats(payload, t: "Table") -> bool:
     """True when every aggregate takes the device partial+exchange path:
-    count(*)/count(col), or sum/avg/min/max over a DOUBLE plain column.
-    Partials accumulate in float64 (x64 is enabled framework-wide), the
-    same precision as the host evaluator and the reference's double aggs,
-    so counts are exact to 2^53 and there is no magnitude cliff. Long
-    columns stay on the host evaluator: 64-bit-integer sums must stay
-    exact end-to-end (the sharded long path is esql/topn.py's i64 host
-    partials)."""
+    count(*)/count(col), or sum/avg/min/max over a DOUBLE or LONG plain
+    column. Double partials accumulate in float64 (x64 is enabled
+    framework-wide), the same precision as the host evaluator and the
+    reference's double aggs. Long sums stay EXACT on device via the
+    hi/lo split (see stats_exchange): each int64 value splits into
+    hi = v >> 32 (signed) and lo = v & 0xFFFFFFFF, both exactly
+    f64-representable; the segmented reductions then sum at most nrows
+    terms of magnitude < 2^32 (lo) / 2^31 (hi), so with the
+    nrows <= 2^20 guard every partial and the psum total stay < 2^53 —
+    integer-exact in f64 — and the true sum is reconstructed host-side
+    in arbitrary-precision Python ints (reference: ESQL
+    SumLongAggregator's exact long addition)."""
     if t.nrows >= (1 << 53):  # count exactness bound in f64
         return False
     for _name, call in payload["aggs"]:
@@ -61,7 +66,12 @@ def supported_stats(payload, t: "Table") -> bool:
         col = _plain_col(args)
         if col is None or col not in t.columns:
             return False
-        if t.columns[col].type != "double":
+        ty = t.columns[col].type
+        if ty == "long":
+            # exactness bound of the hi/lo split proof above
+            if t.nrows > (1 << 20):
+                return False
+        elif ty != "double":
             return False
     for b in payload["by"]:
         if b not in t.columns:
@@ -125,36 +135,61 @@ def stats_exchange(
             val_names.append(None)
         else:
             val_names.append(_plain_col(args))
-    used_cols = sorted({v for v in val_names if v is not None})
+    used = sorted({v for v in val_names if v is not None})
+    dbl_cols = [c for c in used if t.columns[c].type != "long"]
+    long_cols = [c for c in used if t.columns[c].type == "long"]
     n_owned = int(shard_of.max()) + 1 if len(shard_of) else 1
     parts = split_by_shard(shard_of, n_owned)
     while len(parts) < S:
         parts.append(np.array([], np.int64))
     R = max((len(p) for p in parts), default=1) or 1
     g_pad = np.full((S, R), -1, np.int32)
-    vals_pad = {c: np.zeros((S, R), np.float64) for c in used_cols}
-    ok_pad = {c: np.zeros((S, R), bool) for c in used_cols}
+    vals_pad = {c: np.zeros((S, R), np.float64) for c in dbl_cols}
+    # long columns ship three views: the i64 values (pmin/pmax operate on
+    # them directly) and the hi/lo f64 split (exact matmul sums — proof in
+    # supported_stats)
+    lvals_pad = {c: np.zeros((S, R), np.int64) for c in long_cols}
+    lhilo_pad = {c: np.zeros((S, 2, R), np.float64) for c in long_cols}
+    ok_pad = {c: np.zeros((S, R), bool) for c in used}
     for s, idx in enumerate(parts):
         g_pad[s, : len(idx)] = gids[idx]
-        for c in used_cols:
+        for c in used:
             col = t.columns[c]
-            vals_pad[c][s, : len(idx)] = _numeric(col)[idx]
             ok_pad[c][s, : len(idx)] = ~np.asarray(col.null)[idx]
+            if c in vals_pad:
+                vals_pad[c][s, : len(idx)] = _numeric(col)[idx]
+            else:
+                src = np.asarray(col.values)
+                if src.dtype.kind not in "iu":  # object/nullable columns
+                    src = np.array(
+                        [0 if x is None else int(x) for x in col.values],
+                        np.int64)
+                lv = src.astype(np.int64)[idx]
+                ok = ok_pad[c][s, : len(idx)]
+                lv = np.where(ok, lv, 0)
+                lvals_pad[c][s, : len(idx)] = lv
+                lhilo_pad[c][s, 0, : len(idx)] = (lv >> 32).astype(
+                    np.float64)
+                lhilo_pad[c][s, 1, : len(idx)] = (
+                    lv & 0xFFFFFFFF).astype(np.float64)
 
-    cols_stack = (
-        np.stack([vals_pad[c] for c in used_cols], axis=1)
-        if used_cols else np.zeros((S, 0, R), np.float64)
-    )  # [S, C, R]
-    oks_stack = (
-        np.stack([ok_pad[c] for c in used_cols], axis=1)
-        if used_cols else np.zeros((S, 0, R), bool)
-    )
+    def _stack(d, cols, shape, dt):
+        return (np.stack([d[c] for c in cols], axis=1)
+                if cols else np.zeros(shape, dt))
 
-    def shard_partial(g1, v1, o1):
-        # one shard's [1, ...] slice -> [G, C, 4] partial (cnt/sum/min/max)
-        # in f64: the host evaluator and the reference aggregate doubles in
-        # double, and +/-inf sentinels need no magnitude bound
+    cols_stack = _stack(vals_pad, dbl_cols, (S, 0, R), np.float64)
+    oks_stack = _stack(ok_pad, dbl_cols, (S, 0, R), bool)
+    lv_stack = _stack(lvals_pad, long_cols, (S, 0, R), np.int64)
+    lh_stack = _stack(lhilo_pad, long_cols, (S, 0, 2, R), np.float64)
+    lok_stack = _stack(ok_pad, long_cols, (S, 0, R), bool)
+
+    def shard_partial(g1, v1, o1, lv1, lh1, lo1):
+        # one shard's [1, ...] slices -> double partials [Cd, G, 4]
+        # (cnt/sum/min/max, f64), long partials [Cl, G, 3] f64
+        # (cnt/hisum/losum — integer-exact, see supported_stats) and
+        # [Cl, G, 2] i64 (min/max)
         g, v, o = g1[0], v1[0], o1[0]
+        lv, lh, lo = lv1[0], lh1[0], lo1[0]
         onehot = (g[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :])
         ohf = onehot.astype(jnp.float64)  # [R, G]
         rows = (g >= 0).astype(jnp.float64)
@@ -176,20 +211,37 @@ def stats_exchange(
             out.append(jnp.stack([cnt, ssum, vmin, vmax], axis=-1))
         per_col = (jnp.stack(out) if out
                    else jnp.zeros((0, G, 4), jnp.float64))
-        return per_col[None], row_cnt[None]
+        lout_f, lout_i = [], []
+        ibig = jnp.int64(np.iinfo(np.int64).max)
+        for ci in range(lv.shape[0]):
+            okf = lo[ci].astype(jnp.float64)
+            cnt = jnp.matmul(okf[None, :], ohf)[0]
+            hisum = jnp.matmul((lh[ci, 0] * okf)[None, :], ohf)[0]
+            losum = jnp.matmul((lh[ci, 1] * okf)[None, :], ohf)[0]
+            sel = onehot & lo[ci][:, None]
+            lmin = jnp.min(jnp.where(sel, lv[ci][:, None], ibig), axis=0)
+            lmax = jnp.max(jnp.where(sel, lv[ci][:, None], -ibig - 1),
+                           axis=0)
+            lout_f.append(jnp.stack([cnt, hisum, losum], axis=-1))
+            lout_i.append(jnp.stack([lmin, lmax], axis=-1))
+        lper_f = (jnp.stack(lout_f) if lout_f
+                  else jnp.zeros((0, G, 3), jnp.float64))
+        lper_i = (jnp.stack(lout_i) if lout_i
+                  else jnp.zeros((0, G, 2), jnp.int64))
+        return per_col[None], row_cnt[None], lper_f[None], lper_i[None]
 
     if mesh is not None:
-        def run(g, v, o):
-            def body(g1, v1, o1):
+        def run(g, v, o, lv, lh, lo):
+            def body(g1, v1, o1, lv1, lh1, lo1):
                 # a device may hold several shards: local partials combine
                 # first, then the cross-device EXCHANGE merges partial
-                # [G, C, 4] pages via collectives instead of the
-                # reference's page queues — psum for counts/sums,
-                # pmin/pmax for extrema
-                pcs, rcs = jax.vmap(shard_partial)(
-                    g1[:, None], v1[:, None], o1[:, None]
+                # pages via collectives instead of the reference's page
+                # queues — psum for counts/sums, pmin/pmax for extrema
+                pcs, rcs, lfs, lis = jax.vmap(shard_partial)(
+                    g1[:, None], v1[:, None], o1[:, None],
+                    lv1[:, None], lh1[:, None], lo1[:, None]
                 )
-                pcs, rcs = pcs[:, 0], rcs[:, 0]
+                pcs, rcs, lfs, lis = pcs[:, 0], rcs[:, 0], lfs[:, 0], lis[:, 0]
                 l_cntsum = jnp.sum(pcs[:, :, :, :2], axis=0)
                 l_min = jnp.min(pcs[:, :, :, 2], axis=0)
                 l_max = jnp.max(pcs[:, :, :, 3], axis=0)
@@ -200,43 +252,57 @@ def stats_exchange(
                     [cnt_sum, vmin[..., None], vmax[..., None]], axis=-1
                 )
                 rows = jax.lax.psum(jnp.sum(rcs, axis=0), "shards")
-                return merged[None], rows[None]
+                lsum = jax.lax.psum(jnp.sum(lfs, axis=0), "shards")
+                lmin = jax.lax.pmin(jnp.min(lis[:, :, :, 0], axis=0),
+                                    "shards")
+                lmax = jax.lax.pmax(jnp.max(lis[:, :, :, 1], axis=0),
+                                    "shards")
+                lminmax = jnp.stack([lmin, lmax], axis=-1)
+                return merged[None], rows[None], lsum[None], lminmax[None]
 
-            pc, rc = jax.shard_map(
+            pc, rc, lf, li = jax.shard_map(
                 body, mesh=mesh,
-                in_specs=(P("shards"), P("shards"), P("shards")),
-                out_specs=(P("shards"), P("shards")),
-            )(g, v, o)
-            return pc[0], rc[0]  # exchange output replicated; take one
+                in_specs=(P("shards"),) * 6,
+                out_specs=(P("shards"),) * 4,
+            )(g, v, o, lv, lh, lo)
+            return pc[0], rc[0], lf[0], li[0]  # replicated; take one
 
         fn = jax.jit(run)
     else:
-        def run(g, v, o):
-            pc, rc = jax.vmap(shard_partial)(
-                g[:, None], v[:, None], o[:, None]
+        def run(g, v, o, lv, lh, lo):
+            pc, rc, lf, li = jax.vmap(shard_partial)(
+                g[:, None], v[:, None], o[:, None],
+                lv[:, None], lh[:, None], lo[:, None]
             )
-            pc, rc = pc[:, 0], rc[:, 0]
+            pc, rc, lf, li = pc[:, 0], rc[:, 0], lf[:, 0], li[:, 0]
             cnt_sum = jnp.sum(pc[:, :, :, :2], axis=0)
             vmin = jnp.min(pc[:, :, :, 2], axis=0)
             vmax = jnp.max(pc[:, :, :, 3], axis=0)
+            lminmax = jnp.stack(
+                [jnp.min(li[:, :, :, 0], axis=0),
+                 jnp.max(li[:, :, :, 1], axis=0)], axis=-1)
             return (
                 jnp.concatenate(
                     [cnt_sum, vmin[..., None], vmax[..., None]], axis=-1
                 ),
                 jnp.sum(rc, axis=0),
+                jnp.sum(lf, axis=0),
+                lminmax,
             )
 
         fn = jax.jit(run)
 
     import jax.numpy as jnp  # noqa: F811 (local alias for clarity above)
 
-    pc, row_cnt = jax.device_get(
+    pc, row_cnt, lf, li = jax.device_get(
         fn(jnp.asarray(g_pad), jnp.asarray(cols_stack),
-           jnp.asarray(oks_stack))
+           jnp.asarray(oks_stack), jnp.asarray(lv_stack),
+           jnp.asarray(lh_stack), jnp.asarray(lok_stack))
     )
 
     # ---- finalize --------------------------------------------------------
-    col_of = {c: i for i, c in enumerate(used_cols)}
+    dcol_of = {c: i for i, c in enumerate(dbl_cols)}
+    lcol_of = {c: i for i, c in enumerate(long_cols)}
     out_cols: dict[str, Column] = {}
     for (name, call), vcol in zip(aggs, val_names):
         fn_name = call[1]
@@ -244,7 +310,41 @@ def stats_exchange(
             vals = row_cnt.astype(np.int64)
             out_cols[name] = Column(vals, np.zeros(G, bool), "long")
             continue
-        stats = pc[col_of[vcol]]  # [G, 4]
+        if vcol in lcol_of:
+            cnt = lf[lcol_of[vcol], :, 0]
+            empty = cnt == 0
+            if fn_name == "count":
+                out_cols[name] = Column(cnt.astype(np.int64),
+                                        np.zeros(G, bool), "long")
+                continue
+            if fn_name in ("sum", "avg"):
+                # exact reconstruction: hi/lo partial sums are integer-
+                # exact f64 (supported_stats proof); Python ints carry
+                # arbitrary precision, so the only overflow is the FINAL
+                # long value — reported like the reference's exact long
+                # addition (ESQL SumLongAggregator / Math.addExact)
+                sums = [
+                    int(lf[lcol_of[vcol], g, 1]) * (1 << 32)
+                    + int(lf[lcol_of[vcol], g, 2])
+                    for g in range(G)
+                ]
+                if fn_name == "sum":
+                    if any(not (-(1 << 63) <= v < (1 << 63)) for v in sums):
+                        from ..utils.errors import IllegalArgumentError
+
+                        raise IllegalArgumentError("long overflow")
+                    out_cols[name] = Column(
+                        np.array(sums, np.int64), empty, "long")
+                else:
+                    avg = np.array(
+                        [s / max(c, 1) for s, c in zip(sums, cnt)],
+                        np.float64)
+                    out_cols[name] = Column(avg, empty, "double")
+                continue
+            mmcol = li[lcol_of[vcol], :, 0 if fn_name == "min" else 1]
+            out_cols[name] = Column(mmcol.astype(np.int64), empty, "long")
+            continue
+        stats = pc[dcol_of[vcol]]  # [G, 4]
         cnt, ssum, vmin, vmax = stats.T
         empty = cnt == 0
         if fn_name == "count":

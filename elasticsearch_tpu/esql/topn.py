@@ -1,0 +1,168 @@
+"""ESQL sharded SORT|LIMIT: per-shard device top-n + rank-key all-gather
+merge (VERDICT r4 missing #1 / SURVEY P7).
+
+The reference's TopNOperator keeps a bounded row heap per driver and the
+exchange merges per-shard top-n pages at the coordinator
+(x-pack/plugin/esql/compute/src/main/java/org/elasticsearch/compute/
+operator/topn/TopNOperator.java:1, operator/exchange/ExchangeService.java:49).
+The TPU translation: every sort key is encoded host-side into an
+ORDER-PRESERVING int64 (IEEE-754 total-order bits for doubles, dictionary
+ordinals for keywords, the value itself for longs), `lax.sort` with
+num_keys = len(keys)+1 ranks each shard's rows lexicographically on
+device, and the EXCHANGE is one `all_gather` of the [n] per-shard winners
+over the "shards" mesh axis followed by the same lexicographic sort of
+the S*n gathered candidates — a rank-key merge that rides ICI instead of
+page queues. The appended final key is the global row index, so the
+result is bit-identical to the host evaluator's stable multi-key sort
+(engine.execute "sort": lexicographic by (k1..kn, original row)).
+
+Null ordering matches the host rule (nulls first on desc, last on asc,
+unless overridden): nulls take an extreme sentinel AFTER the desc
+inversion, and within the null group later keys + row index decide — the
+same order the host's stable partition produces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SUPPORTED_TYPES = {"long", "double", "keyword", "boolean"}
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def supported_topn(sort_payload, t) -> bool:
+    """True when every sort key is a plain column of an encodable type."""
+    if t.nrows == 0:
+        return False
+    for name, _desc, _nf in sort_payload:
+        c = t.columns.get(name)
+        if c is None or c.type not in SUPPORTED_TYPES:
+            return False
+    return True
+
+
+def _f64_order_bits(v: np.ndarray) -> np.ndarray:
+    """IEEE-754 double -> int64 whose signed order equals float order.
+    Classic total-order transform: flip all bits of negatives, flip only
+    the sign bit of non-negatives. NaNs are mapped to sort after every
+    real value (numpy argsort behavior in the host evaluator)."""
+    b = np.asarray(v, np.float64).view(np.uint64)
+    neg = (b >> np.uint64(63)) == 1
+    enc_u = np.where(neg, ~b, b | np.uint64(1 << 63))
+    # enc_u is UNSIGNED-ordered; xor the sign bit to shift the range into
+    # signed int64 order (lax.sort and np.lexsort compare signed).
+    # NaN is NOT handled here: it must be pinned after the desc inversion
+    # (encode_sort_keys), or desc would rank NaN rows first while the host
+    # evaluator's np.argsort always ranks them last.
+    return (enc_u ^ np.uint64(1 << 63)).view(np.int64).astype(np.int64)
+
+
+def encode_sort_keys(t, sort_payload) -> list[np.ndarray]:
+    """-> one order-encoded int64 array per sort key (null sentinels and
+    desc inversion applied), ascending-lexicographic == the host order."""
+    keys = []
+    for name, desc, nulls_first in sort_payload:
+        c = t.columns[name]
+        nan = np.zeros(t.nrows, bool)
+        if c.type == "keyword":
+            sv = np.array(["" if x is None else str(x) for x in c.values])
+            uniq = np.unique(sv)
+            enc = np.searchsorted(uniq, sv).astype(np.int64)
+        elif c.type == "boolean":
+            enc = np.asarray(c.values, bool).astype(np.int64)
+        elif c.type == "long" and np.asarray(c.values).dtype.kind in "iu":
+            enc = np.asarray(c.values, np.int64).copy()
+        else:
+            fv = np.asarray(c.values, np.float64)
+            enc = _f64_order_bits(fv)
+            nan = np.isnan(fv)
+        if desc:
+            enc = ~enc  # bitwise-not exactly reverses int64 order
+        # NaN pins after the inversion: the host evaluator's np.argsort
+        # ranks NaN last among non-null values in BOTH directions
+        enc = np.where(nan, _I64_MAX - 1, enc)
+        nf = nulls_first if nulls_first is not None else desc
+        null = np.asarray(c.null, bool)
+        enc = np.where(null, _I64_MIN if nf else _I64_MAX, enc)
+        keys.append(enc)
+    return keys
+
+
+def topn_exchange(
+    t,
+    shard_of: np.ndarray,  # [nrows] owning shard of each row
+    sort_payload,  # [(col, desc, nulls_first)]
+    limit: int,
+    mesh=None,
+) -> np.ndarray:
+    """-> global row indices of the top-`limit` rows in final order.
+
+    Device program per shard: lexicographic lax.sort over the encoded
+    keys + global row index, keep the first n. Exchange: all_gather the
+    per-shard winners, re-sort, keep n. mesh=None runs the identical
+    program under vmap so sharded and unsharded answers are
+    bit-comparable (same discipline as exchange.stats_exchange)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = int(min(limit, t.nrows))
+    if n <= 0:
+        return np.array([], np.int64)
+    keys = encode_sort_keys(t, sort_payload)
+    S = int(shard_of.max()) + 1 if len(shard_of) else 1
+    if mesh is not None:
+        ndev = len(mesh.devices.ravel())
+        S = max(S, ndev)
+        S += (-S) % ndev
+    parts = [np.flatnonzero(shard_of == s) for s in range(S)]
+    R = max(max((len(p) for p in parts), default=1), n, 1)
+    K = len(keys)
+    # pad rows sort last: every key operand takes I64_MAX and so does the
+    # row index (no real row index reaches 2^63)
+    kpad = np.full((S, K + 1, R), _I64_MAX, np.int64)
+    for s, idx in enumerate(parts):
+        for ki, karr in enumerate(keys):
+            kpad[s, ki, : len(idx)] = karr[idx]
+        kpad[s, K, : len(idx)] = idx
+    n_eff = min(n, R)
+
+    def shard_top(ops):  # [K+1, R] -> [K+1, n] sorted winners
+        srt = jax.lax.sort(tuple(ops[i] for i in range(K + 1)),
+                           num_keys=K + 1)
+        return jnp.stack(srt)[:, :n_eff]
+
+    def merge(cand):  # [S', K+1, n] -> [K+1, n] sorted winners
+        flat = cand.transpose(1, 0, 2).reshape(K + 1, -1)
+        srt = jax.lax.sort(tuple(flat[i] for i in range(K + 1)),
+                           num_keys=K + 1)
+        return jnp.stack(srt)[:, :n_eff]
+
+    if mesh is not None:
+        def run(ops):
+            def body(ops1):
+                # a device may hold several shards: per-shard top-n under
+                # vmap, a LOCAL merge, then the cross-device exchange —
+                # all_gather of each device's [K+1, n] winners + the same
+                # rank-key sort (same local-then-global discipline as
+                # stats_exchange)
+                local = merge(jax.vmap(shard_top)(ops1))
+                gathered = jax.lax.all_gather(local, "shards")
+                return merge(gathered)[None]
+
+            out = jax.shard_map(
+                body, mesh=mesh, in_specs=(P("shards"),),
+                out_specs=P("shards"),
+            )(ops)
+            return out[0][K]
+
+        sel = jax.jit(run)(jnp.asarray(kpad))
+    else:
+        def run(ops):
+            return merge(jax.vmap(shard_top)(ops))[K]
+
+        sel = jax.jit(run)(jnp.asarray(kpad))
+    sel = np.asarray(jax.device_get(sel), np.int64)
+    return sel[sel != _I64_MAX][:n]

@@ -1,0 +1,194 @@
+"""Stage-level C1 profile on real TPU at bench shapes (round-5 kernel work).
+
+Times each component of the fused pipeline independently, amortized over
+queued executions (single-call timings through the remote runtime carry
+~80-110 ms fixed overhead — BENCH_NOTES.md). Prints one JSON line.
+
+Stages:
+  dense3   stacked split-bf16 dense matmul (the shipped 3-logical-pass)
+  dense1   single-pass bf16 matmul (candidate cheaper selection tier)
+  gather   CSR row gather + partial scores (phase A)
+  sortkey  window key build + 2-op lax.sort + searchsorted
+  kernel   fused_tile_candidates at the shipped geometry
+  merge    f32 top_k margin + rank_topk + canonical rescore
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from elasticsearch_tpu.ops import fused as F  # noqa: E402
+from elasticsearch_tpu.ops.batched import BatchTermSearcher  # noqa: E402
+from elasticsearch_tpu.query.executor import ShardSearcher  # noqa: E402
+
+REPS = 10
+
+
+def timed(fn, *args, reps=REPS):
+    """Amortized wall time of `reps` queued executions of jitted fn."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(reps)]
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    rng = np.random.default_rng(42)
+    print("[profile] building 1M corpus + pack...", file=sys.stderr)
+    lens, tok = bench.build_corpus(rng)
+    pack, m = bench.build_pack(lens, tok)
+    searcher = ShardSearcher(pack, mappings=m)
+    bts = BatchTermSearcher(searcher)
+    fts = F.FusedTermSearcher(bts)
+    queries = bench.sample_queries(rng, lens, tok, F.QC)
+    k = 10
+
+    plan = F.plan_fused(pack, "body", queries, k)
+    fa = fts._arrays()
+    n = pack.num_docs
+    tile_n = fts._tile_n
+    qsub = fts._qsub
+    n_pad = ((n + tile_n - 1) // tile_n) * tile_n
+    njc = n_pad // tile_n
+    t = F.tile_t_for(njc)
+    R = plan.rows.shape[0]
+    V = pack.dense_tfn.shape[0]
+    res = {"R": R, "V": V, "njc": njc, "tile_n": tile_n, "qsub": qsub,
+           "t": t, "nreal": plan.nreal}
+    print(f"[profile] shapes {res}", file=sys.stderr)
+
+    W = jnp.asarray(plan.W)
+    rows = jnp.asarray(plan.rows)
+    row_q = jnp.asarray(plan.row_q)
+    row_w = jnp.asarray(plan.row_w)
+
+    # ---- dense tiers -----------------------------------------------------
+    @jax.jit
+    def dense3(W):
+        Whf = F._mask_hi(W)
+        Wh = Whf.astype(jnp.bfloat16)
+        Wl = (W - Whf).astype(jnp.bfloat16)
+        W3 = jnp.concatenate([Wh, Wh, Wl], axis=1)
+        return jnp.matmul(W3, fa["tier16_stack"],
+                          preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def dense1(W):
+        Wh = F._mask_hi(W).astype(jnp.bfloat16)
+        return jnp.matmul(Wh, fa["tier16_stack"][:V],
+                          preferred_element_type=jnp.float32)
+
+    res["dense3_ms"] = round(timed(dense3, W) * 1e3, 2)
+    res["dense1_ms"] = round(timed(dense1, W) * 1e3, 2)
+
+    # ---- phase A gather + partials --------------------------------------
+    avgdl = pack.avgdl("body")
+
+    @jax.jit
+    def gather(rows, row_w):
+        docids = fa["post_docids"][rows]
+        tfs = fa["post_tfs"][rows]
+        dls = fa["post_dls"][rows]
+        denom = tfs + 1.2 * (1.0 - 0.75 + 0.75 * dls / avgdl)
+        parts = row_w[:, None] * tfs / denom
+        return docids, parts
+
+    res["gather_ms"] = round(timed(gather, rows, row_w) * 1e3, 2)
+    docids, parts = gather(rows, row_w)
+
+    # ---- sort + ptr ------------------------------------------------------
+    nsub = F.QC // qsub
+    qb, db, sb = F._key_bits(n_pad, qsub, nsub)
+    nreal_q = 1 << max(plan.nreal - 1, 1).bit_length()
+    mean_win = max(1, nreal_q * F.BLOCK // ((F.QC // qsub) * njc))
+    bude = min(64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length()))
+    bud = bude // 128
+    res["bud"] = bud
+    njf = n_pad // F.FINE_N
+
+    @jax.jit
+    def sortkey(docids, parts, row_q):
+        q2 = row_q[:, None]
+        key = (((q2 >> qb) << sb) | (docids << qb) | (q2 & (qsub - 1)))
+        key = jnp.where(docids >= n, jnp.int32(2**31 - 1), key)
+        skey, sval = jax.lax.sort(
+            (key.reshape(-1), parts.reshape(-1)), num_keys=1)
+        bounds = ((jnp.arange(nsub, dtype=jnp.int32)[:, None] << sb)
+                  | (jnp.arange(njf + 1, dtype=jnp.int32)[None, :]
+                     * F.FINE_N << qb))
+        ptr = jnp.searchsorted(skey, bounds.reshape(-1)).astype(jnp.int32)
+        pad_n = 2 * bude + (-(skey.shape[0] + 2 * bude)) % bude
+        sent = jnp.full((pad_n,), jnp.int32(2**31 - 1))
+        keys2 = jnp.concatenate([skey, sent]).reshape(-1, 128)
+        vals2 = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(sval, jnp.int32), sent]
+        ).reshape(-1, 128)
+        return keys2, vals2, ptr
+
+    res["sortkey_ms"] = round(timed(sortkey, docids, parts, row_q) * 1e3, 2)
+    keys2, vals2, ptr = jax.block_until_ready(sortkey(docids, parts, row_q))
+
+    # sort-only ablation
+    @jax.jit
+    def sort_only(docids, parts, row_q):
+        q2 = row_q[:, None]
+        key = (((q2 >> qb) << sb) | (docids << qb) | (q2 & (qsub - 1)))
+        key = jnp.where(docids >= n, jnp.int32(2**31 - 1), key)
+        return jax.lax.sort((key.reshape(-1), parts.reshape(-1)), num_keys=1)
+
+    res["sort_only_ms"] = round(
+        timed(sort_only, docids, parts, row_q) * 1e3, 2)
+
+    # ---- kernel ----------------------------------------------------------
+    scores = dense3(W)
+    kfn = jax.jit(functools.partial(
+        F.fused_tile_candidates, t=t, bud=bud, tile_n=tile_n,
+        qsub=qsub, interpret=False))
+    res["kernel_ms"] = round(
+        timed(kfn, scores, fa["live"], keys2, vals2, ptr) * 1e3, 2)
+    cv, ci, totals, wlost = kfn(scores, fa["live"], keys2, vals2, ptr)
+
+    # ---- merge + rescore -------------------------------------------------
+    dense_rows = jnp.asarray(plan.dense_rows)
+    dense_w = jnp.asarray(plan.dense_w)
+
+    @jax.jit
+    def merge(cv, ci, docids, parts, row_q):
+        kb_eff = min(F.KB, cv.shape[1])
+        m_eff = min(kb_eff + 16, cv.shape[1])
+        mv, sel = jax.lax.top_k(cv, m_eff)
+        mi = jnp.take_along_axis(ci, sel, axis=1)
+        kv, ki = F.rank_topk(mv, mi, kb_eff)
+        cand_ok = kv > -jnp.inf
+        resc = F.canonical_rescore(
+            fa["tier32"], dense_rows, dense_w, row_q, docids, parts,
+            ki, cand_ok)
+        return F.rank_topk(resc, ki, k)
+
+    res["merge_rescore_ms"] = round(
+        timed(merge, cv, ci, docids, parts, row_q) * 1e3, 2)
+
+    # ---- end-to-end current pipeline ------------------------------------
+    fn = fts._compiled("body", R, plan.dense_rows.shape[1], k,
+                       plan.nreal, False)
+    args = (fts._arrays(), W, rows, row_q, row_w, dense_rows, dense_w)
+    res["pipeline_ms"] = round(timed(fn, *args) * 1e3, 2)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
