@@ -6,9 +6,10 @@ DesiredBalanceShardsAllocator.java:46); promotion safety comes from the
 in-sync allocation set persisted in index metadata — only a copy that was
 in-sync for every acked write may become primary
 (index/seqno/ReplicationTracker.java in-sync tracking, IndexMetadata
-inSyncAllocationIds). This module is the same contract with a least-loaded
-placement heuristic instead of the balancer: correctness (in-sync promotion,
-primary terms) is kept, the optimization machinery is not.
+inSyncAllocationIds). This module keeps that contract (in-sync promotion,
+primary terms) and routes placement + rebalancing through the
+desired-balance solver/reconciler pair in cluster/desired_balance.py —
+the reference's DesiredBalanceShardsAllocator design.
 
 Routing entry: {"node", "primary", "state", "allocation_id"}
 Index meta keys used: settings.number_of_shards/number_of_replicas,
@@ -64,8 +65,6 @@ AWARENESS_ATTRIBUTE = "zone"
 # (cluster.routing.allocation.cluster_concurrent_rebalance; reference:
 # decider/ConcurrentRebalanceAllocationDecider.java)
 CLUSTER_CONCURRENT_REBALANCE = 2
-# rebalance only when the busiest/least-busy shard-count gap exceeds this
-REBALANCE_SLACK = 1
 
 
 def shard_bytes(meta: dict) -> int:
@@ -178,6 +177,13 @@ def allocate(state: ClusterState) -> ClusterState:
     live = set(data_nodes(state))
     load = _node_load(state)
     nbytes = _node_bytes(state)
+    # the desired-balance target (cluster/desired_balance.py): new copies
+    # go straight to their target node when the deciders agree, so
+    # placement and rebalancing converge on ONE assignment instead of
+    # fighting each other
+    from . import desired_balance
+
+    desired = desired_balance.compute(state)
     # concurrent incoming recoveries per node (ThrottlingAllocationDecider)
     node_initializing: dict[str, int] = {}
     for shards in state.routing.values():
@@ -239,7 +245,10 @@ def allocate(state: ClusterState) -> ClusterState:
                                         node_bytes=nbytes)
                     }
                     if eligible:
-                        node = min(eligible, key=lambda n: (eligible[n], n))
+                        node = next(
+                            (n for n in desired.get((index, key), [])
+                             if n in eligible),
+                            min(eligible, key=lambda n: (eligible[n], n)))
                         aid = next_alloc_id()
                         assigns = [
                             {"node": node, "primary": True, "state": "STARTED",
@@ -268,7 +277,9 @@ def allocate(state: ClusterState) -> ClusterState:
                 }
                 if not free:
                     break  # deciders reject every remaining node
-                node = min(free, key=lambda n: (free[n], n))
+                node = next(
+                    (n for n in desired.get((index, key), []) if n in free),
+                    min(free, key=lambda n: (free[n], n)))
                 assigns.append(
                     {"node": node, "primary": False, "state": "INITIALIZING",
                      "allocation_id": next_alloc_id()}
@@ -298,7 +309,10 @@ def allocate(state: ClusterState) -> ClusterState:
         from dataclasses import replace
 
         state = replace(state, indices=new_indices, routing=new_routing)
-    return rebalance(state)
+    # reconcile toward the desired balance; the solve from entry is valid
+    # when nothing changed (reconcile recomputes otherwise — placement
+    # just altered the tallies it was computed from)
+    return rebalance(state, desired=None if changed else desired)
 
 
 def _relocations_in_flight(state: ClusterState) -> int:
@@ -311,82 +325,22 @@ def _relocations_in_flight(state: ClusterState) -> int:
     )
 
 
-def rebalance(state: ClusterState) -> ClusterState:
-    """Move STARTED shard copies off overloaded nodes (the reference's
-    BalancedShardsAllocator.java:79 rebalancing pass + DiskThresholdDecider
-    high-watermark shedding), throttled to CLUSTER_CONCURRENT_REBALANCE
-    concurrent relocations.
+def rebalance(state: ClusterState, desired: dict | None = None) -> ClusterState:
+    """Reconcile the routing table toward the desired balance
+    (cluster/desired_balance.py: solver + reconciler, the reference's
+    DesiredBalanceShardsAllocator design), throttled to
+    CLUSTER_CONCURRENT_REBALANCE concurrent relocations.
 
     A move is a copy-then-cut: the target joins as INITIALIZING carrying
     `relocating_from`; when recovery completes (mark_shard_started) the
     source assignment is cut, inheriting primary status + a term bump if
     the source was the primary (the reference's primary handoff).
+    High-watermark shedding falls out of the solver: a copy on a node
+    above WATERMARK_HIGH is never part of the target, so reconciliation
+    moves it off."""
+    from . import desired_balance
 
-    Sources, in priority order: nodes above the disk HIGH watermark, then
-    plain shard-count imbalance beyond REBALANCE_SLACK."""
-    live = data_nodes(state)
-    if len(live) < 2:
-        return state
-    budget = CLUSTER_CONCURRENT_REBALANCE - _relocations_in_flight(state)
-    if budget <= 0:
-        return state
-    new_indices = {k: v for k, v in state.indices.items()}
-    new_routing = {
-        idx: {s: [dict(a) for a in assigns] for s, assigns in shards.items()}
-        for idx, shards in state.routing.items()
-    }
-    moved = False
-
-    def load_counts():
-        load = {n: 0 for n in live}
-        for shards in new_routing.values():
-            for assigns in shards.values():
-                for a in assigns:
-                    if a["node"] in load:
-                        load[a["node"]] += 1
-        return load
-
-    def over_watermark():
-        used = _node_bytes_from(new_routing, new_indices, live)
-        out = []
-        for n in live:
-            cap = _node_capacity(state, n)
-            if cap and used[n] / cap > WATERMARK_HIGH:
-                out.append(n)
-        return out
-
-    while budget > 0:
-        load = load_counts()
-        shedding = over_watermark()
-        if shedding:
-            src = max(shedding, key=lambda n: (load[n], n))
-        else:
-            src = max(live, key=lambda n: (load[n], n))
-            low = min(live, key=lambda n: (load[n], n))
-            if load[src] - load[low] <= REBALANCE_SLACK:
-                break
-        move = _pick_move(state, new_indices, new_routing, src, live,
-                          shedding=bool(shedding))
-        if move is None:
-            break
-        index, key, source_assign, target = move
-        meta = copy.deepcopy(new_indices[index])
-        meta["alloc_counter"] = meta.get("alloc_counter", 0) + 1
-        aid = f"{index}-a{meta['alloc_counter']}"
-        new_indices[index] = meta
-        new_routing[index][key].append({
-            "node": target, "primary": False, "state": "INITIALIZING",
-            "allocation_id": aid,
-            "relocating_from": source_assign["allocation_id"],
-        })
-        moved = True
-        budget -= 1
-
-    if not moved:
-        return state
-    from dataclasses import replace
-
-    return replace(state, indices=new_indices, routing=new_routing)
+    return desired_balance.reconcile(state, desired)
 
 
 def _node_bytes_from(routing, indices, live) -> dict[str, int]:
@@ -398,57 +352,6 @@ def _node_bytes_from(routing, indices, live) -> dict[str, int]:
                 if a["node"] in used:
                     used[a["node"]] += sz
     return used
-
-
-def _pick_move(state, indices, routing, src, live, shedding=False):
-    """A STARTED copy on `src` + a target node every decider accepts.
-    Prefers replicas (primary moves need a handoff at completion). Count
-    moves only go downhill; watermark shedding moves regardless of the
-    target's shard count (the decider chain still gates capacity)."""
-    node_bytes = _node_bytes_from(routing, indices, live)
-    node_initializing: dict[str, int] = {}
-    for shards in routing.values():
-        for assigns in shards.values():
-            for a in assigns:
-                if a["state"] == "INITIALIZING":
-                    node_initializing[a["node"]] = (
-                        node_initializing.get(a["node"], 0) + 1)
-    load = {n: 0 for n in live}
-    for shards in routing.values():
-        for assigns in shards.values():
-            for a in assigns:
-                if a["node"] in load:
-                    load[a["node"]] += 1
-    candidates = []
-    for index, shards in routing.items():
-        meta = indices[index]
-        index_counts: dict[str, int] = {}
-        for assigns in shards.values():
-            for a in assigns:
-                index_counts[a["node"]] = index_counts.get(a["node"], 0) + 1
-        for key, assigns in shards.items():
-            if any(a.get("relocating_from") for a in assigns):
-                continue  # one relocation per shard at a time
-            for a in assigns:
-                if a["node"] != src or a["state"] != "STARTED":
-                    continue
-                for tgt in sorted(live, key=lambda n: (load[n], n)):
-                    if tgt == src:
-                        continue
-                    if not shedding and load[tgt] >= load[src]:
-                        break  # only move downhill
-                    if can_allocate(state, meta, tgt, assigns,
-                                    index_counts, node_initializing,
-                                    node_bytes=node_bytes, moving=a):
-                        candidates.append(
-                            (a["primary"], index, key, a, tgt))
-                        break
-    if not candidates:
-        return None
-    # replicas first (False < True), then stable order
-    candidates.sort(key=lambda c: (c[0], c[1], c[2]))
-    _, index, key, a, tgt = candidates[0]
-    return index, key, a, tgt
 
 
 def mark_shard_started(
