@@ -321,9 +321,11 @@ def _normalize_op(method: str, path: str, body: bytes, ct: str):
     are drawn HERE (the one gateway the client hit), not inside each
     node's engine replica."""
 
+    from ..engine.engine import GATEWAY_AUTO_ID_PREFIX
+
     base = path.split("?", 1)[0]
     if method == "POST" and (base.endswith("/_doc") or base.endswith("/_doc/")):
-        doc_id = uuid.uuid4().hex[:20]
+        doc_id = GATEWAY_AUTO_ID_PREFIX + uuid.uuid4().hex[:16]
         q = ("?" + path.split("?", 1)[1]) if "?" in path else ""
         return "PUT", f"{base.rstrip('/')}/{doc_id}{q}", body, ct
     if base.endswith("/_bulk") or base == "/_bulk":
@@ -341,7 +343,9 @@ def _normalize_op(method: str, path: str, body: bytes, ct: str):
                 action = json.loads(ln)
                 (op_name, meta), = action.items()
                 if op_name in ("index", "create") and "_id" not in meta:
-                    meta["_id"] = uuid.uuid4().hex[:20]
+                    # marked so a TSDB engine re-derives the content id
+                    meta["_id"] = (GATEWAY_AUTO_ID_PREFIX
+                                   + uuid.uuid4().hex[:16])
                 out.append(json.dumps({op_name: meta}))
                 expect_src = op_name in ("index", "create", "update")
             body = ("\n".join(out) + "\n").encode()

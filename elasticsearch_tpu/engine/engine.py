@@ -50,6 +50,16 @@ def _auto_id() -> str:
     return "".join(secrets.choice(_AUTO_ID_ALPHABET) for _ in range(20))
 
 
+# Marker prefix for ids the CLUSTER GATEWAY pre-assigned to id-less write
+# ops (cluster/http.py _normalize_op draws ids before replication so every
+# replica applies a byte-identical op). A time-series engine must IGNORE
+# such an id and derive the deterministic (_tsid, @timestamp) id instead —
+# a random id per point would make duplicate points accumulate on TSDB
+# indices behind the gateway (round-5 review finding). User-supplied ids
+# starting with this prefix are vanishingly unlikely (documented caveat).
+GATEWAY_AUTO_ID_PREFIX = "gwa-"
+
+
 class _StrKey:
     """Orderable wrapper so descending string sort keys compose with numeric
     keys in one tuple sort during the cross-index merge."""
@@ -102,6 +112,11 @@ class EsIndex:
         self.num_shards = int(self.settings["number_of_shards"])
         if self.num_shards < 1:
             raise IllegalArgumentError("number_of_shards must be >= 1")
+        # index.mode=time_series: validated at create; None for standard
+        # indices (index/tsdb.py — dimension routing, _tsid, time bounds)
+        from ..index.tsdb import time_series_mode
+
+        self.ts_mode = time_series_mode(self.settings, self.mappings)
         self._breaker_account = breaker_account
         self.docs: dict[str, _DocEntry] = {}
         self.seq_no = 0
@@ -147,6 +162,31 @@ class EsIndex:
             self.refresh()
 
     # ---- durability ------------------------------------------------------
+
+    def _route_docs(self, docs):
+        """Doc->shard placement. Standard indices: murmur3 of the id.
+        time_series mode: hash of the routing_path dimension values (every
+        doc of one series lands on one shard) with each shard's docs in
+        (_tsid, @timestamp) order — the timestamp-ordered pack layout the
+        reference gets from its TSDB codec (index/codec/tsdb/), which
+        keeps one series' points adjacent in the columnar device arrays."""
+        from ..parallel.stacked import route_docs
+
+        if self.ts_mode is None:
+            return route_docs(docs, self.num_shards)
+        from ..index.tsdb import _parse_ts
+
+        routed = [[] for _ in range(self.num_shards)]
+        for doc_id, src_ in docs:
+            routed[self.ts_mode.shard_of(src_, self.num_shards)].append(
+                (doc_id, src_))
+        for lst in routed:
+            # _parse_ts, NOT check_timestamp: bounds were enforced at
+            # write time; re-checking here would let any bounds drift
+            # make refresh (and thus the whole index) unbuildable
+            lst.sort(key=lambda p: (self.ts_mode.tsid_of(p[1]),
+                                    _parse_ts(p[1]["@timestamp"])))
+        return routed
 
     def _persist_meta(self):
         if not self.data_dir:
@@ -200,6 +240,48 @@ class EsIndex:
         from ..common.settings import IndexScopedSettings
 
         norm = IndexScopedSettings.validate_update(self.settings, updates)
+        raw_end = norm.get("time_series.end_time")
+        raw_start = norm.get("time_series.start_time")
+        if isinstance(norm.get("time_series"), dict):
+            raw_end = norm["time_series"].get("end_time", raw_end)
+            raw_start = norm["time_series"].get("start_time", raw_start)
+        if self.ts_mode is not None and (raw_end is not None
+                                         or raw_start is not None):
+            # a TSDB index's end bound may only GROW (the reference's
+            # TimeSeriesSettings — a shrinking bound would orphan
+            # already-accepted points); a bound change may also never
+            # exclude a point this index already accepted, or the next
+            # refresh would be unbuildable
+            from ..index.tsdb import _parse_ts
+
+            new_end = (_parse_ts(raw_end) if raw_end is not None
+                       else self.ts_mode.end_millis)
+            new_start = (_parse_ts(raw_start) if raw_start is not None
+                         else self.ts_mode.start_millis)
+            if (raw_end is not None and self.ts_mode.end_millis is not None
+                    and new_end < self.ts_mode.end_millis):
+                raise IllegalArgumentError(
+                    f"index.time_series.end_time must be larger than "
+                    f"current value [{self.ts_mode.end_millis}]")
+            for e in self.docs.values():
+                if not e.alive:
+                    continue
+                ts = _parse_ts(e.source.get("@timestamp"))
+                if ((new_start is not None and ts < new_start)
+                        or (new_end is not None and ts >= new_end)):
+                    raise IllegalArgumentError(
+                        "cannot update [index.time_series] bounds: an "
+                        "already-accepted document's @timestamp "
+                        f"[{e.source.get('@timestamp')}] would fall "
+                        "outside the new bounds")
+            self.ts_mode.end_millis = new_end
+            self.ts_mode.start_millis = new_start
+        if (isinstance(norm.get("time_series"), dict)
+                and isinstance(self.settings.get("time_series"), dict)):
+            # partial time_series updates merge into the stored group
+            # instead of replacing it (losing start_time)
+            norm["time_series"] = {**self.settings["time_series"],
+                                   **norm["time_series"]}
         for k, v in norm.items():
             if v is None:
                 self.settings.pop(k, None)
@@ -271,7 +353,20 @@ class EsIndex:
                   if_seq_no: int | None = None, if_primary_term: int | None = None):
         _t_index0 = time.monotonic()
         self._check_writable()
-        if doc_id is None:
+        if self.ts_mode is not None:
+            # time-series writes: @timestamp validated against the index's
+            # time bounds; _id derives from (_tsid, @timestamp) so an
+            # exact duplicate point OVERWRITES (version 2) instead of
+            # duplicating (reference TsidExtractingIdFieldMapper)
+            if doc_id is None or doc_id.startswith(GATEWAY_AUTO_ID_PREFIX):
+                doc_id = self.ts_mode.doc_id_of(source)
+                op_type = "index"
+            else:
+                self.ts_mode.check_timestamp(source)
+            # validate routing extraction NOW: a doc the router cannot
+            # place must be rejected at write time, not blow up refresh
+            self.ts_mode.shard_of(source, self.num_shards)
+        elif doc_id is None:
             doc_id = _auto_id()
             op_type = "create"
         existing = self.docs.get(doc_id)
@@ -442,7 +537,7 @@ class EsIndex:
                 if base.sp.live[s, d]:
                     visible.append((doc_id, src))
         visible.extend(sorted(self._tail_docs.items()))
-        routed = route_docs(visible, self.num_shards)
+        routed = self._route_docs(visible)
         sp = build_stacked_pack_routed(routed, self.mappings)
         if self._breaker_account is not None:
             self._breaker_account(sp.nbytes())
@@ -471,7 +566,7 @@ class EsIndex:
         # one routing pass: the same per-shard (id, source) lists drive both
         # pack building and hit-id resolution, and double as the point-in-time
         # _source snapshot (the analog of stored fields in a sealed segment)
-        routed = route_docs(live_docs, self.num_shards)
+        routed = self._route_docs(live_docs)
         sp = build_stacked_pack_routed(routed, self.mappings)
         if self._breaker_account is not None:
             # admission control BEFORE shipping to the device: on trip, the
@@ -521,7 +616,7 @@ class EsIndex:
                 self._tail_docs.pop(did, None)
         self._pending.clear()
         base.update_live()
-        routed = route_docs(sorted(self._tail_docs.items()), self.num_shards)
+        routed = self._route_docs(sorted(self._tail_docs.items()))
         tail_sp = build_stacked_pack_routed(routed, self.mappings,
                                             dense_min_df=1 << 62)
         # combined stats = base stats AT BUILD (dead docs included, like
@@ -1614,6 +1709,10 @@ class Engine:
         doc_as_upsert, detect_noop (reference behavior:
         action/update/UpdateHelper.java prepare/prepareUpdateScriptRequest)."""
         idx = self.get_or_autocreate(index_name)
+        if idx.ts_mode is not None:
+            raise IllegalArgumentError(
+                f"update is not supported because the destination index "
+                f"[{index_name}] is in time series mode")
         e = idx.docs.get(doc_id)
         exists = e is not None and e.alive
         doc = body.get("doc")
@@ -1998,19 +2097,33 @@ class Engine:
                 return None
         return source
 
-    def bulk(self, operations: list[tuple[str, str, str | None, dict | None]],
+    def bulk(self, operations: list,
              pipeline: str | None = None):
-        """operations: (action, index, id, source). Returns per-item results;
-        failures are per-item, not transactional (reference behavior:
-        TransportShardBulkAction.java:308 executeBulkItemRequest)."""
+        """operations: (action, index, id, source[, routing]). Returns
+        per-item results; failures are per-item, not transactional
+        (reference behavior: TransportShardBulkAction.java:308
+        executeBulkItemRequest)."""
         items = []
         errors = False
-        for action, index_name, doc_id, source in operations:
+        for op_tuple in operations:
+            action, index_name, doc_id, source = op_tuple[:4]
+            routing = op_tuple[4] if len(op_tuple) > 4 else None
             try:
                 # resolve write alias up front so ingest pipeline settings and
                 # item results both see the concrete index
                 index_name = self.resolve_write_index(index_name)
                 idx = self.get_or_autocreate(index_name)
+                if idx.ts_mode is not None:
+                    if routing is not None:
+                        raise IllegalArgumentError(
+                            f"specifying routing is not supported because "
+                            f"the destination index [{index_name}] is in "
+                            f"time series mode")
+                    if action == "update":
+                        raise IllegalArgumentError(
+                            f"update is not supported because the "
+                            f"destination index [{index_name}] is in time "
+                            f"series mode")
                 if action in ("index", "create"):
                     source = self.run_pipelines(index_name, source, pipeline, doc_id)
                     if source is None:  # dropped by pipeline

@@ -237,6 +237,10 @@ class FieldType:
     # the whole document (the ignore_malformed mapping parameter)
     ignore_malformed: bool = False
     fields: dict = field(default_factory=dict)  # sub-fields (e.g. .keyword)
+    # retained mapping attributes with no behavior of their own at the
+    # field level: time_series_dimension / time_series_metric (TSDB mode
+    # reads them — index/tsdb.py; the reference stores them on the mapper)
+    extra: dict = field(default_factory=dict)
 
     _analyzer_obj: Analyzer | None = None
 
@@ -261,6 +265,7 @@ class FieldType:
             d["similarity"] = self.similarity
         if self.ignore_above is not None:
             d["ignore_above"] = self.ignore_above
+        d.update(self.extra)
         if self.fields:
             d["fields"] = {
                 k: sub.to_dict() for k, sub in self.fields.items()
@@ -273,7 +278,9 @@ class Mappings:
     reference (`MapperService.merge` — new fields may be added, existing
     types may not change)."""
 
-    _TOP_LEVEL_KEYS = {"properties", "dynamic", "_source", "_meta", "dynamic_templates", "_routing"}
+    _TOP_LEVEL_KEYS = {"properties", "dynamic", "_source", "_meta",
+                       "dynamic_templates", "_routing",
+                       "_data_stream_timestamp"}
 
     def __init__(self, mapping_dict: dict | None = None, dynamic: str = "true"):
         self.fields: dict[str, FieldType] = {}
@@ -286,6 +293,14 @@ class Mappings:
         self.analysis_registry: dict[str, Analyzer] = {}
         # "true" | "false" | "strict" (ES `dynamic` mapping parameter)
         self.dynamic = dynamic
+        # `_routing: {required: true}` (RoutingFieldMapper): stored so the
+        # TSDB mode check can forbid it (index/tsdb.py)
+        self.routing_required = bool(
+            ((mapping_dict or {}).get("_routing") or {}).get("required"))
+        # `_data_stream_timestamp` meta field (DataStreamTimestampFieldMapper)
+        # — raw config kept for TSDB validation; echo flag set by tsdb mode
+        self.ds_timestamp = (mapping_dict or {}).get("_data_stream_timestamp")
+        self._ds_timestamp_echo = False
         if mapping_dict:
             if mapping_dict.keys() & self._TOP_LEVEL_KEYS or not mapping_dict:
                 props = mapping_dict.get("properties", {})
@@ -338,6 +353,9 @@ class Mappings:
                 similarity=spec.get("similarity", "cosine"),
                 format=spec.get("format"),
                 ignore_malformed=bool(spec.get("ignore_malformed", False)),
+                extra={k: spec[k] for k in
+                       ("time_series_dimension", "time_series_metric")
+                       if k in spec},
             )
             ft._registry = self.analysis_registry
             if ftype == "dense_vector" and not ft.dims:
@@ -559,4 +577,7 @@ class Mappings:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = ft.to_dict()
-        return {"properties": props}
+        out = {"properties": props}
+        if self._ds_timestamp_echo:
+            out["_data_stream_timestamp"] = {"enabled": True}
+        return out

@@ -59,6 +59,12 @@ def parse_query(q: dict | None, mappings: Mappings) -> QueryNode:
 
 
 def _field_type(mappings: Mappings, fld: str) -> str | None:
+    if fld == "_tsid":
+        # the reference's TimeSeriesIdFieldMapper refuses queries: _tsid
+        # exists for aggregations/fetch, not search (tsdb/40_search.yml)
+        from ..utils.errors import IllegalArgumentError
+
+        raise IllegalArgumentError("[_tsid] is not searchable")
     ft = mappings.fields.get(fld)
     return ft.type if ft else None
 

@@ -268,6 +268,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             raise IllegalArgumentError("request body is required")
         op_type = request.query.get("op_type", "index")
         idx = await call(engine.get_or_autocreate, name)
+        if request.query.get("routing") and idx.ts_mode is not None:
+            raise IllegalArgumentError(
+                f"specifying routing is not supported because the "
+                f"destination index [{idx.name}] is in time series mode")
         body = await _maybe_pipeline(idx, body, request, doc_id)
         if body is None:  # drop processor fired
             return web.json_response(
@@ -1446,13 +1450,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     raise IllegalArgumentError("bulk action missing source line")
                 source = json.loads(lines[i])
                 i += 1
-            ops.append((action, index_name, doc_id, source))
+            ops.append((action, index_name, doc_id, source,
+                        meta.get("routing", meta.get("_routing"))))
         import time
 
         t0 = time.monotonic()
         res = await call(engine.bulk, ops, request.query.get("pipeline"))
         if request.query.get("refresh") in ("", "true", "wait_for"):
-            for touched in {n for _, n, _, _ in ops}:
+            for touched in {op[1] for op in ops}:
                 try:
                     await call(_concrete(touched).refresh)
                 except ElasticsearchTpuError:
@@ -1530,6 +1535,20 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     async def _run_search(expression, body, query_params):
         body = body or {}
+        if query_params.get("routing"):
+            # same resolution options as the search itself, so the guard
+            # cannot 404 a request ignore_unavailable would let through
+            for idx, _f in engine.resolve_search(
+                    expression,
+                    ignore_unavailable=_bool_param(
+                        query_params, "ignore_unavailable"),
+                    allow_no_indices=_bool_param(
+                        query_params, "allow_no_indices", True)):
+                if idx.ts_mode is not None:
+                    raise IllegalArgumentError(
+                        f"searching with a specified routing is not "
+                        f"supported because the destination index "
+                        f"[{idx.name}] is in time series mode")
         if body.get("retriever") is not None:
             from ..search.rankeval import rrf_retriever_search
 
@@ -1612,7 +1631,23 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 return None
             return engine.get_index(name).mappings
 
+        # `fields: [_tsid]` on a time-series index: computed from the full
+        # source BEFORE source filtering, attached after the fetch phase
+        # (never fetched by default — reference TimeSeriesIdFieldMapper)
+        want_tsid = any(
+            (f if isinstance(f, str) else (f or {}).get("field")) == "_tsid"
+            for f in (body.get("fields") or []))
+        tsids = {}
+        if want_tsid:
+            for pos, hit in enumerate(res["hits"]["hits"]):
+                tsm = getattr(engine.indices.get(hit.get("_index")),
+                              "ts_mode", None)
+                if tsm is not None and hit.get("_source"):
+                    tsids[pos] = tsm.tsid_of(hit["_source"])
         apply_fetch_phase(res["hits"]["hits"], body, _mappings_of)
+        for pos, tsid in tsids.items():
+            res["hits"]["hits"][pos].setdefault("fields", {})["_tsid"] = [
+                tsid]
         if body.get("suggest"):
             res["suggest"] = await call(
                 engine.suggest_multi, expression, body["suggest"]

@@ -46,6 +46,9 @@ def timed(fn, *args, reps=REPS):
 
 
 def main():
+    from elasticsearch_tpu.utils.jax_env import enable_compile_cache
+
+    enable_compile_cache()
     rng = np.random.default_rng(42)
     print("[profile] building 1M corpus + pack...", file=sys.stderr)
     lens, tok = bench.build_corpus(rng)
@@ -92,7 +95,9 @@ def main():
                           preferred_element_type=jnp.float32)
 
     res["dense3_ms"] = round(timed(dense3, W) * 1e3, 2)
+    print(f"[profile] dense3 {res['dense3_ms']}", file=sys.stderr)
     res["dense1_ms"] = round(timed(dense1, W) * 1e3, 2)
+    print(f"[profile] dense1 {res['dense1_ms']}", file=sys.stderr)
 
     # ---- phase A gather + partials --------------------------------------
     avgdl = pack.avgdl("body")

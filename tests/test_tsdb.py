@@ -1,0 +1,160 @@
+"""index.mode=time_series (index/tsdb.py; reference IndexMode.java:1,
+TimeSeriesIdFieldMapper, IndexRouting.ExtractFromSource, codec/tsdb/).
+
+Mirrors the reference's tsdb yaml behaviors: settings validation,
+dimension routing (one series -> one shard), _tsid/_id synthesis with
+duplicate-point overwrite, time bounds, unsupported operations, and the
+timestamp-ordered pack layout."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+TS_SETTINGS = {
+    "mode": "time_series",
+    "routing_path": ["metricset", "k8s.pod.uid"],
+    "time_series": {"start_time": "2021-04-28T00:00:00Z",
+                    "end_time": "2021-04-29T00:00:00Z"},
+    "number_of_shards": 2,
+}
+TS_MAPPINGS = {
+    "properties": {
+        "@timestamp": {"type": "date"},
+        "metricset": {"type": "keyword", "time_series_dimension": True},
+        "k8s": {"properties": {"pod": {"properties": {
+            "uid": {"type": "keyword", "time_series_dimension": True},
+            "name": {"type": "keyword"},
+            "network": {"properties": {
+                "tx": {"type": "long"}, "rx": {"type": "long"}}},
+        }}}},
+    }
+}
+
+
+def _doc(ts, uid, name="cat", tx=1, rx=2):
+    return {"@timestamp": ts, "metricset": "pod",
+            "k8s": {"pod": {"name": name, "uid": uid,
+                            "network": {"tx": tx, "rx": rx}}}}
+
+
+@pytest.fixture
+def eng():
+    e = Engine()
+    yield e
+    e.close()
+
+
+@pytest.fixture
+def tsdb(eng):
+    return eng.create_index("test", TS_MAPPINGS, dict(TS_SETTINGS))
+
+
+def test_mode_requires_routing_path(eng):
+    with pytest.raises(IllegalArgumentError, match="routing_path"):
+        eng.create_index("bad", TS_MAPPINGS, {"mode": "time_series"})
+
+
+def test_mode_rejects_index_sort(eng):
+    with pytest.raises(IllegalArgumentError,
+                       match=r"incompatible with \[index.sort.field\]"):
+        eng.create_index("bad", TS_MAPPINGS, {
+            "mode": "time_series", "routing_path": ["metricset"],
+            "sort.field": ["a"]})
+
+
+def test_invalid_mode_rejected(eng):
+    with pytest.raises(IllegalArgumentError, match="invalid index mode"):
+        eng.create_index("bad", {}, {"mode": "tsdb"})
+
+
+def test_duplicate_point_overwrites(tsdb):
+    r1 = tsdb.index_doc(None, _doc("2021-04-28T18:50:04.467Z", "u1"))
+    r2 = tsdb.index_doc(None, _doc("2021-04-28T18:50:04.467Z", "u1", tx=9))
+    assert r1["_id"] == r2["_id"], "same (tsid, timestamp) -> same _id"
+    assert r2["_version"] == 2 and r2["result"] == "updated"
+    r3 = tsdb.index_doc(None, _doc("2021-04-28T18:50:05.467Z", "u1"))
+    assert r3["_id"] != r1["_id"]
+
+
+def test_timestamp_required_and_bounded(tsdb):
+    with pytest.raises(IllegalArgumentError, match="@timestamp"):
+        tsdb.index_doc(None, {"metricset": "pod"})
+    with pytest.raises(IllegalArgumentError, match="must be smaller"):
+        tsdb.index_doc(None, _doc("2021-04-30T00:00:00Z", "u1"))
+    with pytest.raises(IllegalArgumentError, match="must be larger"):
+        tsdb.index_doc(None, _doc("2021-04-27T00:00:00Z", "u1"))
+
+
+def test_series_routes_to_one_shard_in_timestamp_order(tsdb):
+    rng = np.random.default_rng(1)
+    uids = [f"uid-{i}" for i in range(20)]
+    stamps = {}
+    for uid in uids:
+        ts_list = sorted(rng.integers(0, 80_000_000, size=8).tolist())
+        stamps[uid] = ts_list
+        for off in ts_list:
+            tsdb.index_doc(None, _doc(1619568000000 + off, uid))
+    # full rebuild: the pack-order property is about the sealed BASE packs
+    # (a small write burst normally lands in the unsorted tail tier)
+    tsdb._refresh_full()
+    # every doc of a series is on ONE shard, and within a shard the pack
+    # order is (_tsid, @timestamp) — a series' points are adjacent and
+    # time-sorted (the timestamp-ordered pack layout)
+    shard_of_uid = {}
+    for s, lst in enumerate(tsdb.shard_docs):
+        prev_key = None
+        for doc_id, src in lst:
+            uid = src["k8s"]["pod"]["uid"]
+            shard_of_uid.setdefault(uid, set()).add(s)
+            key = (tsdb.ts_mode.tsid_of(src), src["@timestamp"])
+            assert prev_key is None or key >= prev_key, "pack order broken"
+            prev_key = key
+    assert all(len(v) == 1 for v in shard_of_uid.values())
+    assert sum(len(lst) for lst in tsdb.shard_docs) == sum(
+        len(set(v)) for v in stamps.values())
+
+
+def test_dimension_and_metric_queries(tsdb):
+    for i, ts in enumerate(["2021-04-28T18:50:04Z", "2021-04-28T18:50:24Z",
+                            "2021-04-28T18:50:44Z", "2021-04-28T18:51:04Z"]):
+        tsdb.index_doc(None, _doc(ts, "u-cat", tx=100 + i))
+    for ts in ["2021-04-28T18:50:03Z", "2021-04-28T18:50:23Z"]:
+        tsdb.index_doc(None, _doc(ts, "u-dog", name="dog", tx=5))
+    tsdb.refresh()
+    r = tsdb.search(query={"match": {"k8s.pod.uid": "u-cat"}})
+    assert r["hits"]["total"]["value"] == 4
+    r = tsdb.search(query={"range": {"k8s.pod.network.tx": {"gt": 102}}})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_tsid_not_searchable(tsdb):
+    tsdb.index_doc(None, _doc("2021-04-28T18:50:04Z", "u1"))
+    tsdb.refresh()
+    with pytest.raises(IllegalArgumentError,
+                       match=r"\[_tsid\] is not searchable"):
+        tsdb.search(query={"term": {"_tsid": "anything"}})
+
+
+def test_update_rejected(eng, tsdb):
+    tsdb.index_doc(None, _doc("2021-04-28T18:50:04Z", "u1"))
+    with pytest.raises(IllegalArgumentError,
+                       match="update is not supported"):
+        eng.update_doc_api("test", "whatever", {"doc": {"x": 1}})
+
+
+def test_bulk_routing_rejected(eng, tsdb):
+    res = eng.bulk([("index", "test", None,
+                     _doc("2021-04-28T18:50:04Z", "u1"), "route-me")])
+    assert res["errors"]
+    err = res["items"][0]["index"]["error"]
+    assert "specifying routing is not supported" in err["reason"]
+
+
+def test_standard_index_keeps_dimension_mapping_inert(eng):
+    idx = eng.create_index("std", TS_MAPPINGS, {})
+    assert idx.ts_mode is None
+    idx.index_doc("1", _doc("2099-01-01T00:00:00Z", "u1"))  # no bounds
+    m = idx.mappings.to_dict()
+    assert m["properties"]["metricset"]["time_series_dimension"] is True

@@ -237,7 +237,11 @@ class YamlRunner:
             if catch.startswith("/"):
                 if status < 400:
                     raise StepFailure(f"expected error matching {catch}, got {status}")
-                if not re.search(catch.strip("/"), json.dumps(out), re.VERBOSE):
+                # upstream DoSection.checkResponseException matches the
+                # catch regex PLAIN against error.toString() — COMMENTS
+                # mode is only used by match-assertions (MatchAssertion
+                # .java:67), so spaced patterns must match literally here
+                if not re.search(catch.strip("/"), json.dumps(out)):
                     raise StepFailure(f"error body {out!r} !~ {catch}")
             elif catch == "request":
                 if status < 400:
