@@ -362,7 +362,14 @@ class EsIndex:
                 doc_id = self.ts_mode.doc_id_of(source)
                 op_type = "index"
             else:
-                self.ts_mode.check_timestamp(source)
+                # an explicit id must BE the derived id (the reference's
+                # TsidExtractingIdFieldMapper): accepting arbitrary ids
+                # would let the same point exist twice under two ids
+                derived = self.ts_mode.doc_id_of(source)
+                if doc_id != derived:
+                    raise IllegalArgumentError(
+                        f"_id must be unset or set to [{derived}] but "
+                        f"was [{doc_id}]")
             # validate routing extraction NOW: a doc the router cannot
             # place must be rejected at write time, not blow up refresh
             self.ts_mode.shard_of(source, self.num_shards)

@@ -1,0 +1,189 @@
+"""ShardPack <-> content-addressed component blobs (searchable snapshots).
+
+The reference's frozen tier mounts Lucene files straight from the
+repository, caching file REGIONS locally
+(x-pack/plugin/blob-cache/src/main/java/org/elasticsearch/blobcache/shared/SharedBlobCacheService.java:68).
+This framework's on-device representation is the ShardPack's numpy
+arrays, so the unit of storage is the pack COMPONENT: every large array
+(postings, norms, docvalues, vectors, dense tier, positions) becomes its
+own content-addressed .npy blob — unchanged components of a re-snapshot
+deduplicate to zero new bytes — and the small host-side state
+(term dictionary, stats, completion/percolator lists) is one JSON meta
+blob. No component is ever deserialized through pickle: a snapshot
+repository is shared, possibly-untrusted storage, and `np.load` runs
+with allow_pickle=False (tampered bytes fail, they cannot execute).
+Mounting an index fetches these through the shared blob cache and
+rebuilds the ShardPack directly: no per-document re-indexing, so a cold
+search costs blob fetch + HBM upload, scaling with pack bytes rather
+than doc count (VERDICT r4 #7).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+from .pack import DocValuesColumn, ShardPack, VectorColumn
+
+FORMAT = 2
+
+# top-level ndarray fields serialized as one component blob each
+_ARRAYS = [
+    "post_docids", "post_tfs", "post_dls", "term_block_start", "term_df",
+    "block_max_tf", "block_min_len", "live", "dense_tfn", "pos_keys",
+    "term_pos_start", "term_pos_count",
+]
+
+
+def _np_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _np_load(payload: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+
+
+def serialize_pack(pack: ShardPack, put_blob) -> dict:
+    """-> JSON-safe manifest; every component stored via put_blob(bytes)
+    -> digest. Array components are .npy; `meta`/`ord_terms` are JSON."""
+    man: dict = {"format": FORMAT, "num_docs": pack.num_docs,
+                 "arrays": {}, "norms": {}, "text_present": {},
+                 "docvalues": {}, "vectors": {}}
+    for name in _ARRAYS:
+        arr = getattr(pack, name)
+        if arr is not None:
+            man["arrays"][name] = put_blob(_np_bytes(arr))
+    for fld, arr in pack.norms.items():
+        man["norms"][fld] = put_blob(_np_bytes(arr))
+    for fld, arr in pack.text_present.items():
+        man["text_present"][fld] = put_blob(_np_bytes(arr))
+    for fld, col in pack.docvalues.items():
+        ent = {"kind": col.kind, "vmin": col.vmin, "vmax": col.vmax,
+               "values": put_blob(_np_bytes(col.values)),
+               "has_value": put_blob(_np_bytes(col.has_value))}
+        for opt in ("uniq_values", "uniq_ords", "mv_pair_docs",
+                    "mv_pair_ords"):
+            arr = getattr(col, opt)
+            if arr is not None:
+                ent[opt] = put_blob(_np_bytes(arr))
+        if col.ord_terms is not None:
+            ent["ord_terms"] = put_blob(_json_bytes(list(col.ord_terms)))
+        man["docvalues"][fld] = ent
+    for fld, vc in pack.vectors.items():
+        ent = {"similarity": vc.similarity, "dims": vc.dims,
+               "values": put_blob(_np_bytes(vc.values)),
+               "has_value": put_blob(_np_bytes(vc.has_value))}
+        if vc.ivf is not None:
+            ivf_arrays = {k: put_blob(_np_bytes(np.asarray(v)))
+                          for k, v in vc.ivf.items()
+                          if isinstance(v, np.ndarray)}
+            ivf_scalars = {k: v for k, v in vc.ivf.items()
+                           if not isinstance(v, np.ndarray)}
+            ent["ivf_arrays"] = ivf_arrays
+            ent["ivf_scalars"] = ivf_scalars
+        man["vectors"][fld] = ent
+    meta = {
+        "term_dict": [[f, t, tid]
+                      for (f, t), tid in sorted(pack.term_dict.items(),
+                                                key=lambda kv: kv[1])],
+        "dense_dict": [[f, t, tid]
+                       for (f, t), tid in sorted(pack.dense_dict.items(),
+                                                 key=lambda kv: kv[1])],
+        "field_stats": pack.field_stats,
+        "completion": {f: [list(x) for x in lst]
+                       for f, lst in pack.completion.items()},
+        "percolator": {f: [list(x) for x in lst]
+                       for f, lst in pack.percolator.items()},
+    }
+    man["meta"] = put_blob(_json_bytes(meta))
+    return man
+
+
+def deserialize_pack(man: dict, get_blob) -> ShardPack:
+    """Rebuild a ShardPack from a serialize_pack manifest; get_blob is
+    digest -> bytes (routed through the shared blob cache by mount)."""
+    if man.get("format") != FORMAT:
+        raise ValueError(f"unknown pack manifest format [{man.get('format')}]")
+    arrays = {name: _np_load(get_blob(d))
+              for name, d in man["arrays"].items()}
+    meta = json.loads(get_blob(man["meta"]))
+    docvalues = {}
+    for fld, ent in man["docvalues"].items():
+        docvalues[fld] = DocValuesColumn(
+            kind=ent["kind"],
+            values=_np_load(get_blob(ent["values"])),
+            has_value=_np_load(get_blob(ent["has_value"])),
+            ord_terms=(json.loads(get_blob(ent["ord_terms"]))
+                       if "ord_terms" in ent else None),
+            uniq_values=(_np_load(get_blob(ent["uniq_values"]))
+                         if "uniq_values" in ent else None),
+            uniq_ords=(_np_load(get_blob(ent["uniq_ords"]))
+                       if "uniq_ords" in ent else None),
+            vmin=ent["vmin"], vmax=ent["vmax"],
+            mv_pair_docs=(_np_load(get_blob(ent["mv_pair_docs"]))
+                          if "mv_pair_docs" in ent else None),
+            mv_pair_ords=(_np_load(get_blob(ent["mv_pair_ords"]))
+                          if "mv_pair_ords" in ent else None),
+        )
+    vectors = {}
+    for fld, ent in man["vectors"].items():
+        ivf = None
+        if "ivf_arrays" in ent:
+            ivf = dict(ent.get("ivf_scalars") or {})
+            for k, d in ent["ivf_arrays"].items():
+                ivf[k] = _np_load(get_blob(d))
+        vectors[fld] = VectorColumn(
+            values=_np_load(get_blob(ent["values"])),
+            has_value=_np_load(get_blob(ent["has_value"])),
+            similarity=ent["similarity"], dims=ent["dims"],
+            ivf=ivf,
+        )
+    return ShardPack(
+        num_docs=man["num_docs"],
+        post_docids=arrays["post_docids"],
+        post_tfs=arrays["post_tfs"],
+        post_dls=arrays["post_dls"],
+        term_block_start=arrays["term_block_start"],
+        term_df=arrays["term_df"],
+        block_max_tf=arrays["block_max_tf"],
+        block_min_len=arrays["block_min_len"],
+        term_dict={(f, t): tid for f, t, tid in meta["term_dict"]},
+        norms={f: _np_load(get_blob(d)) for f, d in man["norms"].items()},
+        text_present={f: _np_load(get_blob(d))
+                      for f, d in man["text_present"].items()},
+        field_stats=meta["field_stats"],
+        docvalues=docvalues,
+        vectors=vectors,
+        live=arrays["live"],
+        dense_tfn=arrays.get("dense_tfn"),
+        dense_dict={(f, t): tid for f, t, tid in meta["dense_dict"]},
+        pos_keys=arrays.get("pos_keys"),
+        term_pos_start=arrays.get("term_pos_start"),
+        term_pos_count=arrays.get("term_pos_count"),
+        completion={f: [tuple(x) for x in lst]
+                    for f, lst in meta["completion"].items()},
+        percolator={f: [tuple(x) for x in lst]
+                    for f, lst in meta["percolator"].items()},
+    )
+
+
+def manifest_digests(man: dict) -> list[str]:
+    """Every blob digest a pack manifest references (GC accounting)."""
+    out = list(man["arrays"].values()) + [man["meta"]]
+    out += list(man["norms"].values()) + list(man["text_present"].values())
+    for ent in man["docvalues"].values():
+        out += [ent[k] for k in ("values", "has_value", "uniq_values",
+                                 "uniq_ords", "mv_pair_docs",
+                                 "mv_pair_ords", "ord_terms") if k in ent]
+    for ent in man["vectors"].values():
+        out += [ent["values"], ent["has_value"]]
+        out += list((ent.get("ivf_arrays") or {}).values())
+    return out

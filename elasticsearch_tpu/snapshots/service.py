@@ -137,6 +137,9 @@ class SnapshotService:
                 "chunks": chunks,
                 "aliases": self.engine.meta.aliases_of(idx.name),
             }
+            packs = self._snapshot_packs(idx, repo)
+            if packs is not None:
+                index_meta[idx.name]["packs"] = packs
         snap = {
             "snapshot": snap_name,
             "uuid": f"{repo_name}-{snap_name}-{int(t0 * 1000)}",
@@ -209,9 +212,7 @@ class SnapshotService:
         # BlobStoreRepository cleanup of unreferenced blobs)
         live: set[str] = set()
         for s in root["snapshots"]:
-            meta = self._load_snap(repo, s["snapshot"])
-            for im in meta["indices"].values():
-                live.update(im["chunks"])
+            live.update(snap_chunks(self._load_snap(repo, s["snapshot"])))
         for digest in set(snap_chunks(snap)) - live:
             repo.delete(f"blobs/{digest}")
         return {"acknowledged": True}
@@ -274,6 +275,68 @@ class SnapshotService:
             }
         }
 
+    def _snapshot_packs(self, idx, repo) -> dict | None:
+        """Snapshot the index's sealed base packs as content-addressed
+        COMPONENT blobs (index/packio.py) plus order-aligned per-shard doc
+        lists, so `_mount` can rebuild the searcher without re-indexing
+        (reference: the frozen tier mounts Lucene files from the repo,
+        SharedBlobCacheService.java:68). Returns None when the live
+        searcher cannot represent the doc set (mid-recovery, hydration
+        pending, ...) — the doc chunks then remain the restore source."""
+        import hashlib
+
+        from ..index.packio import serialize_pack
+        from .repository import CHUNK_DOCS
+
+        from ..parallel.stacked import build_stacked_pack_routed
+
+        try:
+            if idx._hydrate is not None:
+                return None  # an unhydrated mount: blobs already exist
+            # Build a FRESH pack purely for serialization — never touch
+            # the live searcher: a snapshot must not refresh or merge as
+            # a side effect (refresh_interval=-1 relies on writes staying
+            # invisible). The build is a pure function of the alive doc
+            # set (sorted), so an unchanged corpus re-serializes to
+            # byte-identical components and deduplicates to zero.
+            live_docs = [(i, e.source)
+                         for i, e in sorted(idx.docs.items()) if e.alive]
+            routed = idx._route_docs(live_docs)
+            sp_packs = build_stacked_pack_routed(routed, idx.mappings).shards
+
+            # stage every payload in memory FIRST: a mid-serialization
+            # failure must not leave orphaned component blobs that no
+            # manifest references (GC only frees referenced digests)
+            staged: dict[str, bytes] = {}
+
+            def stage(payload: bytes) -> str:
+                digest = hashlib.sha256(payload).hexdigest()
+                staged[digest] = payload
+                return digest
+
+            shard_mans = [serialize_pack(p, stage) for p in sp_packs]
+            doc_chunks = []
+            for lst in routed:
+                digests = []
+                # ORDER-PRESERVING chunking (pack docid d == list position
+                # d), sharing repository.py's chunk size + compact form
+                for off in range(0, len(lst), CHUNK_DOCS):
+                    buf = []
+                    for doc_id, source in lst[off:off + CHUNK_DOCS]:
+                        e = idx.docs.get(doc_id)
+                        buf.append({"id": doc_id, "source": source,
+                                    "version": getattr(e, "version", 1),
+                                    "seq_no": getattr(e, "seq_no", 0)})
+                    digests.append(stage(json.dumps(
+                        buf, separators=(",", ":"), sort_keys=True
+                    ).encode()))
+                doc_chunks.append(digests)
+            for payload in staged.values():
+                repo.put_blob(payload)
+            return {"shards": shard_mans, "docs": doc_chunks}
+        except Exception:  # noqa: BLE001 - components are an optimization
+            return None
+
     # ---- searchable snapshots (frozen tier) ------------------------------
 
     def mount_snapshot(self, repo_name: str, snap_name: str,
@@ -313,22 +376,73 @@ class SnapshotService:
         idx.settings["blocks.write"] = True
         cache = self.engine.blob_cache
         chunks = list(meta["chunks"])
+        packs = meta.get("packs")
 
-        def hydrate():
+        def fetch(digest):
+            return cache.get_or_fetch(
+                f"{repo_name}/{digest}",
+                lambda: repo.get_blob(digest),
+            )
+
+        def hydrate_packs():
+            """Pack-component mount: rebuild ShardPacks + the aligned doc
+            lists straight from blobs — no per-doc re-indexing; first
+            search cost = blob fetch + HBM upload (VERDICT r4 #7)."""
+            from ..index.packio import deserialize_pack
+            from ..parallel.sharded import StackedSearcher, make_mesh
+            from ..parallel.stacked import StackedPack
+            from ..engine.engine import _DocEntry
+
+            shards = [deserialize_pack(man, fetch)
+                      for man in packs["shards"]]
+            routed = []
+            max_seq = 0
+            for s, digests in enumerate(packs["docs"]):
+                lst = []
+                for digest in digests:
+                    for r in json.loads(fetch(digest)):
+                        lst.append((r["id"], r["source"]))
+                        if shards[s].live[len(lst) - 1]:
+                            idx.docs[r["id"]] = _DocEntry(
+                                r["source"], r.get("version", 1),
+                                r.get("seq_no", 0), True)
+                            max_seq = max(max_seq, r.get("seq_no", 0))
+                routed.append(lst)
+            sp = StackedPack(shards, idx.mappings)
+            if idx._breaker_account is not None:
+                # same admission control as every refresh-built searcher:
+                # a frozen mount must not overcommit device memory
+                idx._breaker_account(sp.nbytes())
+            idx._searcher = StackedSearcher(sp, mesh=make_mesh(len(shards)))
+            idx.shard_docs = routed
+            idx._tail = None
+            idx._tail_shard_docs = []
+            idx._tail_docs = {}
+            idx._pending.clear()
+            idx._base_pos = {
+                doc_id: (s, d)
+                for s, lst in enumerate(routed)
+                for d, (doc_id, _src) in enumerate(lst)
+            }
+            idx._base_stats = (
+                {f: dict(st) for f, st in sp.field_stats.items()},
+                dict(sp.global_df),
+            )
+            idx._base_nbytes = sp.nbytes()
+            idx.seq_no = max(idx.seq_no, max_seq + 1)
+            idx._dirty = False
+
+        def hydrate_docs():
             idx.settings.pop("blocks.write", None)
             try:
                 for digest in chunks:
-                    payload = cache.get_or_fetch(
-                        f"{repo_name}/{digest}",
-                        lambda digest=digest: repo.get_blob(digest),
-                    )
-                    for d in json.loads(payload):
+                    for d in json.loads(fetch(digest)):
                         idx.index_doc(d["id"], d["source"])
                 idx.refresh()
             finally:
                 idx.settings["blocks.write"] = True
 
-        idx._hydrate = hydrate
+        idx._hydrate = hydrate_packs if packs else hydrate_docs
         return {
             "snapshot": {
                 "snapshot": snap_name,
@@ -357,7 +471,17 @@ class SnapshotService:
 
 
 def snap_chunks(snap: dict) -> list[str]:
+    """Every blob digest a snapshot references (doc chunks + pack
+    components) — the GC live-set."""
+    from ..index.packio import manifest_digests
+
     out = []
     for im in snap["indices"].values():
         out.extend(im["chunks"])
+        packs = im.get("packs")
+        if packs:
+            for man in packs["shards"]:
+                out.extend(manifest_digests(man))
+            for digests in packs["docs"]:
+                out.extend(digests)
     return out
