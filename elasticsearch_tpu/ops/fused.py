@@ -82,7 +82,11 @@ except ImportError:  # pragma: no cover
 
 from ..index.pack import BLOCK
 
-KB = 32  # rescored candidate set size (top-K'); final k must be <= KB
+KB = 64  # rescored candidate set size (top-K'); final k must be <= KB
+# (round 5: widened 32 -> 64 together with the 2-pass dense tier — the
+# deeper candidate margin is what keeps the cheaper selection's flag
+# rate negligible: measured 5th-pct relative gap between the 10th and
+# 64th dense score is 2.3e-2 vs the 2-pass error bound of 8e-3)
 # geometry defaults from the round-4 sweep on a v5e (BENCH_NOTES.md):
 # tile 8192 x qsub 256 measured 4.24x the C1 baseline model vs 3.6x for
 # 4096x128 — fewer grid steps win until VPU/matmul work dominates
@@ -93,14 +97,19 @@ QSUB = 256  # query sub-tile rows per grid step (2 MXU row blocks)
 QC = 512  # fused query-chunk width
 # max docs a fused shard may hold (docid bit budget of the window sort key)
 MAX_DOCS_FUSED = (1 << 21) - 2 * TILE_N
-# relative slack of split-bf16 (hi+lo) selection vs the canonical f32
-# rescore. Inputs carry ~15 mantissa bits (truncating split), sums
-# accumulate in f32: measured max relative error 7.7e-5 on bench-shaped
-# operands; 2e-4 adds margin. The split MUST be built by integer masking:
-# the runtime compiles with --xla_allow_excess_precision=true, which lets
-# XLA elide f32->bf16->f32 round-trips, so `t - bf16(t)` folds to zero and
-# an astype-based split silently degenerates to one bf16 pass (measured).
-EPS_SPLIT = 2e-4
+# relative slack of the split-bf16 SELECTION tier vs the canonical f32
+# rescore. The dense tier runs TWO logical passes (Wh@T16 + Wh@T16lo):
+# the tier side carries ~15 mantissa bits while the query-weight side is
+# bf16-truncated, so the error is dominated by |W - Wh| ~ 2^-9 relative —
+# measured max 7.4e-3 on bench-shaped operands at 1M docs; 8e-3 is the
+# bound the safety flag uses. (Round 4 ran three passes at 2e-4; round 5
+# trades the third [Qc,N] matmul pass — ~7.7 ms/chunk — for a deeper
+# KB=64 candidate margin, which the measured k10..k64 gap covers.) The
+# split MUST be built by integer masking: the runtime compiles with
+# --xla_allow_excess_precision=true, which lets XLA elide
+# f32->bf16->f32 round-trips, so `t - bf16(t)` folds to zero and an
+# astype-based split silently degenerates to one bf16 pass (measured).
+EPS_SPLIT = 8e-3
 
 
 def _mask_hi(t):
@@ -575,22 +584,18 @@ def _fused_pipeline(
         [jax.lax.bitcast_convert_type(sval, jnp.int32), sent]
     ).reshape(-1, 128)
 
-    # dense tier in split-bf16: hi+lo carries ~16 mantissa bits with f32
-    # accumulation — selection lands within ~2^-16 of the canonical f32
-    # rescore, so EPS_SPLIT (2e-4) keeps the safety-flag rate near zero
-    # even when the 10th..32nd scores pack within a percent (typical at
-    # 1M docs). The three logical products (Wh@T16 + Wh@T16lo + Wl@T16)
-    # run as ONE stacked matmul when the pack keeps the [3V, n_pad]
-    # stacked tier resident (measured: three separate [Qc, n_pad] f32
-    # matmul outputs cost ~56 ms/chunk at 1M docs — almost all HBM
-    # round-trips of the intermediates — vs ~18 ms stacked)
-    Whf = _mask_hi(W)
-    Wh = Whf.astype(jnp.bfloat16)
-    Wl = (W - Whf).astype(jnp.bfloat16)
+    # dense SELECTION tier, 2-pass split-bf16 (Wh@T16 + Wh@T16lo as one
+    # stacked matmul): the tier side keeps ~15 mantissa bits; the
+    # remaining error is the bf16 truncation of the query weights
+    # (~2^-9 relative, EPS_SPLIT bounds it at 8e-3) — covered by the
+    # KB=64 candidate margin + canonical rescore + safety flag. Round
+    # 4's third pass (Wl@T16, 2e-4 error) cost ~7.7 ms/chunk of pure
+    # MXU time for precision the wider margin makes redundant.
+    Wh = _mask_hi(W).astype(jnp.bfloat16)
     if "tier16_stack" in fa:
-        W3 = jnp.concatenate([Wh, Wh, Wl], axis=1)  # [Qc, 3V]
+        W2 = jnp.concatenate([Wh, Wh], axis=1)  # [Qc, 2V]
         scores = jnp.matmul(
-            W3, fa["tier16_stack"], preferred_element_type=jnp.float32
+            W2, fa["tier16_stack"], preferred_element_type=jnp.float32,
         )
     else:
         scores = (
@@ -598,7 +603,6 @@ def _fused_pipeline(
             + jnp.matmul(
                 Wh, fa["tier16_lo"], preferred_element_type=jnp.float32
             )
-            + jnp.matmul(Wl, fa["tier16"], preferred_element_type=jnp.float32)
         )
     cv, ci, totals, wlost = fused_tile_candidates(
         scores, fa["live"], keys2, vals2, ptr,
@@ -706,14 +710,14 @@ class FusedTermSearcher:
                 "post_dls": dev["post_dls"],
             }
             V = dev["dense_tfn"].shape[0]
-            # [3V, n_pad] stacked tier -> ONE dense matmul per chunk (see
-            # _fused_pipeline); costs a duplicate of the hi tier in HBM,
-            # so gate on the stack staying inside a 16 GB chip alongside
+            # [2V, n_pad] stacked tier [T16; T16lo] -> ONE dense matmul
+            # per chunk (the round-5 2-pass selection, _fused_pipeline);
+            # gate on the stack staying inside a 16 GB chip alongside
             # tier32, postings, and per-execution score workspaces. Built
             # by ONE jit straight from the f32 tier so the hi/lo parts
             # never materialize as separate resident arrays (peak = tier32
             # + stack, not + 2 intermediate copies).
-            stack_bytes = 3 * V * n_pad * 2
+            stack_bytes = 2 * V * n_pad * 2
             use_stack = (
                 os.environ.get("ES_TPU_FUSED_STACK", "1") != "0"
                 and stack_bytes <= 6 * 1024**3
@@ -726,7 +730,7 @@ class FusedTermSearcher:
                 hi = hif.astype(jnp.bfloat16)
                 lo = (tp - hif).astype(jnp.bfloat16)
                 if use_stack:
-                    return (jnp.concatenate([hi, lo, hi], axis=0),)
+                    return (jnp.concatenate([hi, lo], axis=0),)
                 return hi, lo
 
             if use_stack:
@@ -780,19 +784,23 @@ class FusedTermSearcher:
             self._cache[key] = fn
         return fn
 
-    def _dispatch(self, fld, queries, k, qidx):
-        """Plan + launch one <=QC chunk; returns (qidx, device outs)."""
+    def _dispatch_plan(self, fld, plan, k, qidx):
+        """Launch one pre-planned chunk (planning may run on a worker
+        thread — see _run_pass — so launch is separated from it)."""
         interpret = jax.default_backend() != "tpu"
-        plan = plan_fused(self.searcher.pack, fld, queries, k)
         fn = self._compiled(
             fld, plan.rows.shape[0], plan.dense_rows.shape[1],
             k, plan.nreal, interpret,
         )
         outs = fn(
             self._arrays(),
-            jnp.asarray(plan.W), jnp.asarray(plan.rows),
-            jnp.asarray(plan.row_q), jnp.asarray(plan.row_w),
-            jnp.asarray(plan.dense_rows), jnp.asarray(plan.dense_w),
+            # numpy passes straight into the jitted call: an eager
+            # jnp.asarray through the remote runtime acts as a DISPATCH
+            # BARRIER on not-yet-ready buffers (BENCH_NOTES.md), which
+            # serialized chunk k+1's upload behind chunk k's execution —
+            # measured 49.6 ms/chunk wall vs 30.3 ms device (round 5)
+            plan.W, plan.rows, plan.row_q, plan.row_w,
+            plan.dense_rows, plan.dense_w,
         )
         return qidx, outs
 
@@ -803,12 +811,24 @@ class FusedTermSearcher:
         ids = np.zeros((Q, k), np.int64)
         totals = np.zeros((Q,), np.int64)
         flagged = np.zeros((Q,), bool)
+        # pipelined host planning: a worker thread plans chunk k+1 while
+        # this thread launches chunk k (dispatch waits on network RTT and
+        # releases the GIL, so the ~11 ms/chunk of numpy/dict planning
+        # overlaps device execution instead of serializing with it —
+        # round-5 profile: wall 49.6 ms/chunk vs 30.3 ms device)
+        from concurrent.futures import ThreadPoolExecutor
+
+        idxs = [np.arange(s, min(s + QC, Q)) for s in range(0, Q, QC)]
         launched = []
-        for s in range(0, Q, QC):
-            qidx = np.arange(s, min(s + QC, Q))
-            launched.append(
-                self._dispatch(fld, [queries[i] for i in qidx], k, qidx)
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            plans = ex.map(
+                lambda qidx: plan_fused(
+                    self.searcher.pack, fld,
+                    [queries[i] for i in qidx], k),
+                idxs,
             )
+            for qidx, plan in zip(idxs, plans):
+                launched.append(self._dispatch_plan(fld, plan, k, qidx))
         host = jax.device_get([o for _, o in launched])
         for (qidx, _), (v, i, t, fl) in zip(launched, host):
             nq = len(qidx)

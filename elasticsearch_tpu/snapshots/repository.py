@@ -25,9 +25,15 @@ import hashlib
 import json
 import os
 import re
+import threading
 import zlib
 
 from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
+
+# process-wide root-index locks per fs-repository location (see
+# FsRepository.root_lock)
+_FS_ROOT_LOCKS: dict[str, threading.Lock] = {}
+_FS_ROOT_LOCKS_GUARD = threading.Lock()
 
 
 class RepositoryMissingError(ElasticsearchTpuError):
@@ -67,6 +73,20 @@ class Repository:
         raise NotImplementedError
 
     # ---- repository-generation helpers ----------------------------------
+
+    def root_lock(self):
+        """Context manager serializing root-index read-modify-write
+        cycles. The base form is a no-op (single-writer repos);
+        FsRepository takes an fcntl file lock so CONCURRENT snapshot
+        operations from several gateway nodes (threads or processes)
+        against one shared filesystem repository cannot lose updates —
+        the race behind round-4's CLUSTER_SKIP yaml exclusions. S3 has no
+        server-side lock; concurrent multi-writer S3 snapshot creation
+        remains a documented divergence (the reference fences via
+        generation CAS on the cluster-state side)."""
+        import contextlib
+
+        return contextlib.nullcontext()
 
     def _gen(self) -> int:
         gens = [int(n.split("-", 1)[1]) for n in self.list("index-")
@@ -110,6 +130,33 @@ class Repository:
         return zlib.decompress(raw)  # pre-zstd repository layout
 
 
+class InMemoryRepository(Repository):
+    """Dict-backed repository: the transport payload of a replica-engine
+    resync (cluster/http.py EngineReplica) and a unit-test double. The
+    whole store round-trips through `store`/a plain dict."""
+
+    def __init__(self, store: dict | None = None):
+        self.store: dict[str, bytes] = dict(store or {})
+
+    def read(self, name: str) -> bytes:
+        try:
+            return self.store[name]
+        except KeyError:
+            raise SnapshotMissingError(f"blob [{name}] missing")
+
+    def write(self, name: str, data: bytes):
+        self.store[name] = data
+
+    def exists(self, name: str) -> bool:
+        return name in self.store
+
+    def delete(self, name: str):
+        self.store.pop(name, None)
+
+    def list(self, prefix: str = "") -> list[str]:
+        return [k for k in self.store if k.startswith(prefix)]
+
+
 class FsRepository(Repository):
     """Shared-filesystem repository (reference: fs type,
     repositories/fs/FsRepository.java)."""
@@ -131,6 +178,30 @@ class FsRepository(Repository):
         if not p.startswith(os.path.normpath(self.location)):
             raise IllegalArgumentError(f"invalid blob name [{name}]")
         return p
+
+    def root_lock(self):
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def lock():
+            # POSIX record locks are per-PROCESS: they do not exclude
+            # threads of this process (the in-process multi-node cluster
+            # fixtures), so take a process-wide lock per location FIRST,
+            # then the fcntl lock for other processes
+            key = os.path.normpath(self.location)
+            with _FS_ROOT_LOCKS_GUARD:
+                tlock = _FS_ROOT_LOCKS.setdefault(key, threading.Lock())
+            with tlock:
+                with open(os.path.join(self.location, "root.lock"),
+                          "a+") as f:
+                    fcntl.lockf(f, fcntl.LOCK_EX)
+                    try:
+                        yield
+                    finally:
+                        fcntl.lockf(f, fcntl.LOCK_UN)
+
+        return lock()
 
     def read(self, name: str) -> bytes:
         try:

@@ -109,12 +109,23 @@ class SnapshotService:
     # ---- snapshots -------------------------------------------------------
 
     def create_snapshot(self, repo_name: str, snap_name: str,
-                        indices="*", include_global_state=True) -> dict:
+                        indices="*", include_global_state=True,
+                        include_packs=True) -> dict:
         repo = self._repo(repo_name)
         if not _NAME_RE.match(snap_name or ""):
             raise InvalidSnapshotNameError(
                 f"[{repo_name}:{snap_name}] Invalid snapshot name: must be lowercase"
             )
+        # root lock held across check-then-append: concurrent snapshot
+        # creations from several gateway nodes serialize instead of
+        # losing root-index updates (round-4 CLUSTER_SKIP race)
+        with repo.root_lock():
+            return self._create_snapshot_locked(
+                repo, repo_name, snap_name, indices, include_global_state,
+                include_packs)
+
+    def _create_snapshot_locked(self, repo, repo_name, snap_name, indices,
+                                include_global_state, include_packs):
         root = repo.load_root()
         if any(s["snapshot"] == snap_name for s in root["snapshots"]):
             raise ResourceAlreadyExistsError(
@@ -137,7 +148,8 @@ class SnapshotService:
                 "chunks": chunks,
                 "aliases": self.engine.meta.aliases_of(idx.name),
             }
-            packs = self._snapshot_packs(idx, repo)
+            packs = (self._snapshot_packs(idx, repo)
+                     if include_packs else None)
             if packs is not None:
                 index_meta[idx.name]["packs"] = packs
         snap = {
@@ -201,6 +213,10 @@ class SnapshotService:
 
     def delete_snapshot(self, repo_name: str, snap_name: str):
         repo = self._repo(repo_name)
+        with repo.root_lock():
+            return self._delete_snapshot_locked(repo, repo_name, snap_name)
+
+    def _delete_snapshot_locked(self, repo, repo_name, snap_name):
         snap = self._load_snap(repo, snap_name)
         root = repo.load_root()
         root["snapshots"] = [s for s in root["snapshots"]

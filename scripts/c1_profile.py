@@ -99,11 +99,14 @@ def main():
     # 5.4GB device arrays as compile-time constants and kills the run) ----
     @jax.jit
     def dense3(W, tier):
+        # 3-pass reference (round-4 default): 2-pass stack + Wl@T16
         Whf = F._mask_hi(W)
         Wh = Whf.astype(jnp.bfloat16)
         Wl = (W - Whf).astype(jnp.bfloat16)
-        W3 = jnp.concatenate([Wh, Wh, Wl], axis=1)
-        return jnp.matmul(W3, tier, preferred_element_type=jnp.float32)
+        W2 = jnp.concatenate([Wh, Wh], axis=1)
+        return (jnp.matmul(W2, tier, preferred_element_type=jnp.float32)
+                + jnp.matmul(Wl, jax.lax.slice_in_dim(tier, 0, V, axis=0),
+                             preferred_element_type=jnp.float32))
 
     @jax.jit
     def dense1(W, tier):
@@ -179,7 +182,7 @@ def main():
         timed(sort_only, docids, parts, row_q) * 1e3, 2)
 
     # ---- kernel ----------------------------------------------------------
-    scores = dense3(W, tier_stack)
+    scores = dense2(W, tier_stack)
     kfn = jax.jit(functools.partial(
         F.fused_tile_candidates, t=t, bud=bud, tile_n=tile_n,
         qsub=qsub, interpret=False))
@@ -213,35 +216,29 @@ def main():
 
     # ---- dense 2-pass variant (Wh @ [T16; T16lo]): error ~2^-9 ----------
     @jax.jit
-    def dense2(W, tier2):
+    def dense2(W, tier):
+        # the SHIPPED selection tier: one matmul over the [2V, N] stack
         Wh = F._mask_hi(W).astype(jnp.bfloat16)
-        return jnp.matmul(Wh, tier2, preferred_element_type=jnp.float32)
+        W2 = jnp.concatenate([Wh, Wh], axis=1)
+        return jnp.matmul(W2, tier, preferred_element_type=jnp.float32)
 
-    tier2 = jnp.concatenate(
-        [tier_stack[:V], tier_stack[V:2 * V]], axis=0)
-    W2 = jnp.concatenate([W, W], axis=1)
-
-    @jax.jit
-    def dense2b(W2, tier2):
-        Wh = F._mask_hi(W2).astype(jnp.bfloat16)
-        return jnp.matmul(Wh, tier2, preferred_element_type=jnp.float32)
-
-    res["dense2_ms"] = round(timed(dense2b, W2, tier2) * 1e3, 2)
+    res["dense2_ms"] = round(timed(dense2, W, tier_stack) * 1e3, 2)
     print(f"[profile] dense2 {res['dense2_ms']}", file=sys.stderr)
 
-    # relative error of 1-pass and 2-pass selection vs canonical f32 on
-    # REAL bench scores (decides which tier the safety flag can afford)
-    s3 = np.asarray(dense3(W, tier_stack)[:, :200_000])
-    s1 = np.asarray(dense1(W, tier_stack[:V])[:, :200_000])
-    s2 = np.asarray(dense2b(W2, tier2)[:, :200_000])
+    # error of cheap selection tiers vs canonical f32 on REAL bench
+    # scores, and the k-th..KB-th score gaps that bound the safety flag
+    COLS = 100_000
+    s3 = np.asarray(dense3(W, tier_stack)[:, :COLS])  # high-precision ref
+    s1 = np.asarray(dense1(W, tier_stack[:V])[:, :COLS])
+    s2 = np.asarray(dense2(W, tier_stack)[:, :COLS])
     nz = np.abs(s3) > 1e-6
     res["dense1_max_rel_err"] = float(
         np.max(np.abs((s1 - s3))[nz] / np.abs(s3)[nz]))
     res["dense2_max_rel_err"] = float(
         np.max(np.abs((s2 - s3))[nz] / np.abs(s3)[nz]))
-    # gap between the k-th and (KB..)-th best score per query: the margin
-    # a cheaper selection tier must clear for the safety test to pass
+    del s1, s2
     top = -np.sort(-s3, axis=1)[:, :80]
+    del s3
     with np.errstate(invalid="ignore", divide="ignore"):
         gap32 = (top[:, 9] - top[:, 31]) / np.abs(top[:, 9])
         gap64 = (top[:, 9] - top[:, 63]) / np.abs(top[:, 9])

@@ -42,21 +42,12 @@ def _load_manifest():
 
 CASES = _load_manifest()
 
-# Manifest entries excluded from the CLUSTER fixture only. The replicated
-# engine applies every mutation on every node; a filesystem snapshot
-# repository is a SHARED side-effect target, so per-replica application
-# races on it (the reference runs snapshot orchestration master-only —
-# lifting these onto the master-task path is tracked future work). The
-# health case waits on engine-level shard states the gateway serves from
-# CLUSTER routing instead.
-CLUSTER_SKIP = {
-    ("snapshot.create/10_basic.yml", "Create a snapshot"),
-    ("snapshot.get/10_basic.yml",
-     "Get snapshot info contains include_global_state"),
-    ("snapshot.get/10_basic.yml", "Get snapshot info without repository names"),
-    ("cluster.health/10_basic.yml",
-     "cluster health basic test, one index with wait for no initializing shards"),
-}
+# Round 5: the CLUSTER_SKIP exclusions are gone. Snapshot create/delete
+# now execute once on the serving node (shared-repository side effects
+# are not replicated — cluster/http.py _is_repository_local) under the
+# repository root lock, and /_cluster/health reflects the replica
+# engines, so every manifest entry runs under BOTH fixtures.
+CLUSTER_SKIP: set = set()
 
 
 @pytest.fixture(scope="module", params=["engine", "cluster"])
