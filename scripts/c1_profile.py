@@ -113,6 +113,13 @@ def main():
         Wh = F._mask_hi(W).astype(jnp.bfloat16)
         return jnp.matmul(Wh, tier, preferred_element_type=jnp.float32)
 
+    @jax.jit
+    def dense2(W, tier):
+        # the SHIPPED selection tier: one matmul over the [2V, N] stack
+        Wh = F._mask_hi(W).astype(jnp.bfloat16)
+        W2 = jnp.concatenate([Wh, Wh], axis=1)
+        return jnp.matmul(W2, tier, preferred_element_type=jnp.float32)
+
     tier_stack = fa["tier16_stack"]
     res["dense3_ms"] = round(timed(dense3, W, tier_stack) * 1e3, 2)
     print(f"[profile] dense3 {res['dense3_ms']}", file=sys.stderr)
@@ -214,14 +221,7 @@ def main():
               dense_rows, dense_w) * 1e3, 2)
     print(f"[profile] merge {res['merge_rescore_ms']}", file=sys.stderr)
 
-    # ---- dense 2-pass variant (Wh @ [T16; T16lo]): error ~2^-9 ----------
-    @jax.jit
-    def dense2(W, tier):
-        # the SHIPPED selection tier: one matmul over the [2V, N] stack
-        Wh = F._mask_hi(W).astype(jnp.bfloat16)
-        W2 = jnp.concatenate([Wh, Wh], axis=1)
-        return jnp.matmul(W2, tier, preferred_element_type=jnp.float32)
-
+    # ---- dense-tier error/gap measurements ------------------------------
     res["dense2_ms"] = round(timed(dense2, W, tier_stack) * 1e3, 2)
     print(f"[profile] dense2 {res['dense2_ms']}", file=sys.stderr)
 
