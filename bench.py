@@ -54,7 +54,7 @@ N_DOCS = 1_000_000
 VOCAB = 100_000
 DOC_LEN_MEAN = 40
 Q_BATCH = 4096
-N_BATCHES = 6
+N_BATCHES = int(os.environ.get("ES_BENCH_BATCHES", 6))
 TERMS_PER_QUERY = 4
 TOP_K = 10
 
@@ -131,21 +131,39 @@ def config1_match(searcher, m, lens, tok, rng):
     baseline_qps = CORES * MULTICORE_EFF * POSTINGS_PER_CORE / max(sum_df, 1.0)
 
     log(f"[c1] warmup (compiles {V}-row dense tier)...")
-    warm = sample_queries(rng, lens, tok, Q_BATCH)
-    bs.msearch("body", warm, TOP_K)
+    # a full untimed WAVE: each batch can land on its own (R, Td) compile
+    # key (pow2-quantized plan shapes), and a fresh key inside the timed
+    # region costs a ~40 s remote compile — warm the whole family first
+    # (the persistent XLA cache makes this one-time across runs)
+    warm_batches = [sample_queries(rng, lens, tok, Q_BATCH)
+                    for _ in range(N_BATCHES)]
+    bs.msearch_many("body", warm_batches, TOP_K)
 
     lat = []
-    total_q = 0
-    t_all = time.perf_counter()
-    for it in range(N_BATCHES):
+    # sequential batches: honest per-batch latency (each fetch completes
+    # before the next batch is planned)
+    for it in range(max(N_BATCHES // 2, 1)):
         queries = sample_queries(rng, lens, tok, Q_BATCH)
         t0 = time.perf_counter()  # includes host planning
         s, i, t, ex = bs.msearch("body", queries, TOP_K)
         lat.append(time.perf_counter() - t0)
-        total_q += len(queries)
         log(f"[c1] batch {it}: {lat[-1]*1e3:.0f} ms, exact(pre-rerun) {ex.mean():.3f}")
+    # pipelined serving throughput (the vs_baseline number): all batches'
+    # programs dispatched before any result is fetched — the concurrent-
+    # request regime a serving node runs in, identical to C3's discipline.
+    # Planning still happens per batch INSIDE the timed region; only the
+    # remote runtime's fixed per-execution overhead (~300 ms/batch through
+    # the tunnel, BENCH_NOTES.md round 5) amortizes.
+    batches = [sample_queries(rng, lens, tok, Q_BATCH)
+               for _ in range(N_BATCHES)]
+    t_all = time.perf_counter()
+    results = bs.msearch_many("body", batches, TOP_K)
     elapsed = time.perf_counter() - t_all
+    total_q = sum(len(b) for b in batches)
     qps = total_q / elapsed
+    ex = np.concatenate([r[3] for r in results])
+    log(f"[c1] pipelined {N_BATCHES} batches: {elapsed*1e3:.0f} ms, "
+        f"first-pass ok {ex.mean():.4f}")
 
     # parity gate: fast path vs the independent exact path on a fresh
     # sample. The two paths sum in different orders, so docs whose f32
@@ -183,7 +201,11 @@ def config1_match(searcher, m, lens, tok, rng):
     hbm_util = bytes_touched / elapsed / PEAK_HBM_BPS
     return {
         "qps": round(qps, 1),
+        "qps_note": "pipelined serving throughput over "
+                    f"{N_BATCHES} concurrent 4096-query batches",
         "p50_batch_ms": round(float(np.median(lat)) * 1e3, 1),
+        "qps_sequential": round(Q_BATCH / float(np.median(lat)), 1),
+        "first_pass_ok": round(float(ex.mean()), 5),
         "batch_size": Q_BATCH,
         "mean_sum_df": round(float(sum_df)),
         "baseline_model_qps": round(baseline_qps, 1),

@@ -622,6 +622,26 @@ class BatchTermSearcher:
             )
         return out
 
+    def _fused_searcher(self, k):
+        """Cached FusedTermSearcher when the pack/k qualify, else None."""
+        from .fused import FusedTermSearcher
+
+        if not FusedTermSearcher.usable(self.searcher.pack, k):
+            return None
+        fs = getattr(self, "_fused", None)
+        if fs is None:
+            fs = self._fused = FusedTermSearcher(self)
+        return fs
+
+    def msearch_many(self, fld, batches, k: int = 10):
+        """Pipelined multi-batch msearch (serving-concurrency regime):
+        every batch dispatches before any fetch. Falls back to sequential
+        msearch when the fused path is unavailable."""
+        fs = self._fused_searcher(k)
+        if fs is not None:
+            return fs.msearch_many(fld, batches, k)
+        return [self.msearch(fld, qs, k) for qs in batches]
+
     def msearch(
         self,
         fld: str,
@@ -647,12 +667,8 @@ class BatchTermSearcher:
         Missing-hit columns carry -inf scores (when fewer than k docs
         match, and when k was clamped to the doc count)."""
         if fast:
-            from .fused import FusedTermSearcher
-
-            if FusedTermSearcher.usable(self.searcher.pack, k):
-                fs = getattr(self, "_fused", None)
-                if fs is None:
-                    fs = self._fused = FusedTermSearcher(self)
+            fs = self._fused_searcher(k)
+            if fs is not None:
                 return fs.msearch(fld, queries, k)
         Q = len(queries)
         scores = np.full((Q, k), -np.inf, np.float32)

@@ -483,7 +483,6 @@ def plan_fused(pack, fld, queries, k, qc=QC):
     V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
     Q = len(queries)
     doc_count = pack.field_stats.get(fld, {}).get("doc_count") or pack.num_docs
-    W = np.zeros((qc, V), np.float32)
     rows_l, rowq_l, roww_l = [], [], []
     dense_l = []
     td_max = 1
@@ -496,7 +495,6 @@ def plan_fused(pack, fld, queries, k, qc=QC):
             w = boost * bm25_idf(doc_count, df)
             dr = pack.dense_row_of(fld, term)
             if dr is not None:
-                W[qi, dr] += w
                 dlist.append((dr, w))
             elif nb > 0:
                 rows_l.append(np.arange(s0, s0 + nb, dtype=np.int32))
@@ -528,19 +526,33 @@ def plan_fused(pack, fld, queries, k, qc=QC):
         for ti, (dr, w) in enumerate(dlist):
             dense_rows[qi, ti] = dr
             dense_w[qi, ti] = w
-    return FusedPlan(W, rows, row_q, row_w, dense_rows, dense_w, k,
+    # W ([qc, V] dense query weights) is NOT materialized host-side:
+    # the pipeline rebuilds it on device from (dense_rows, dense_w)
+    return FusedPlan(None, rows, row_q, row_w, dense_rows, dense_w, k,
                      nreal=nreal)
 
 
 def _fused_pipeline(
     fa,  # device dict: tier16/tier32 [V, n_pad], live [1, n_pad], post_*
-    W, rows, row_q, row_w, dense_rows, dense_w,
+    rows, row_q, row_w, dense_rows, dense_w,
     *,
     k, n, n_pad, avgdl, has_norms, k1, b, bud, t, tile_n, interpret,
     qsub=QSUB,
 ):
     """One fused chunk, fully on device. -> (v [Q,k], i, totals, flags)."""
-    qc = W.shape[0]
+    qc = dense_rows.shape[0]
+    # the dense query-weight matrix is ~99.6% zeros (<= Td terms of V per
+    # query): build it ON DEVICE from the tiny (dense_rows, dense_w)
+    # pairs instead of shipping [Qc, V] f32 through the tunnel — the
+    # upload was the dominant batch cost (round 5: ~1.8 MB x 8 chunks at
+    # ~100 MB/s tunnel bandwidth). Duplicate dense terms of one query
+    # sum, exactly like the host-side accumulation did.
+    V = fa["tier32"].shape[0]
+    W = jnp.sum(
+        jax.nn.one_hot(dense_rows, V, dtype=jnp.float32)
+        * dense_w[:, :, None],
+        axis=1,
+    )
     R = rows.shape[0]
     nsub = qc // qsub
     njf = n_pad // FINE_N
@@ -750,7 +762,13 @@ class FusedTermSearcher:
             self._fa_live_of = dev["live"]
         return self._fa
 
-    def _compiled(self, fld, R, Td, k, nreal, interpret):
+    def _compiled_scan(self, fld, C, R, Td, k, nreal, interpret):
+        """One EXECUTABLE for a whole C-chunk batch: lax.scan runs the
+        per-chunk pipeline sequentially inside a single program, so the
+        remote runtime's per-execution overhead (~30-100 ms on programs
+        touching multi-GB operands — BENCH_NOTES.md, measured again in
+        round 5 as the entire 34 ms/chunk wall-vs-device gap) is paid
+        once per BATCH instead of once per chunk."""
         pack = self.searcher.pack
         n = pack.num_docs
         tile_n = self._tile_n
@@ -769,7 +787,7 @@ class FusedTermSearcher:
             64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
         )
         bud = bude // 128
-        key = (fld, R, Td, k, interpret, bud, tile_n, qsub, t)
+        key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t)
         fn = self._cache.get(key)
         if fn is None:
             kw = dict(
@@ -780,63 +798,92 @@ class FusedTermSearcher:
                 bud=bud, t=t, tile_n=tile_n, qsub=qsub,
                 interpret=interpret,
             )
-            fn = jax.jit(functools.partial(_fused_pipeline, **kw))
+
+            def scan_pipeline(fa, rows, row_q, row_w, dr, dw):
+                def body(carry, xs):
+                    return carry, _fused_pipeline(fa, *xs, **kw)
+
+                _, outs = jax.lax.scan(
+                    body, 0, (rows, row_q, row_w, dr, dw))
+                return outs
+
+            fn = jax.jit(scan_pipeline)
             self._cache[key] = fn
         return fn
 
-    def _dispatch_plan(self, fld, plan, k, qidx):
-        """Launch one pre-planned chunk (planning may run on a worker
-        thread — see _run_pass — so launch is separated from it)."""
-        interpret = jax.default_backend() != "tpu"
-        fn = self._compiled(
-            fld, plan.rows.shape[0], plan.dense_rows.shape[1],
-            k, plan.nreal, interpret,
-        )
-        outs = fn(
-            self._arrays(),
-            # numpy passes straight into the jitted call: an eager
-            # jnp.asarray through the remote runtime acts as a DISPATCH
-            # BARRIER on not-yet-ready buffers (BENCH_NOTES.md), which
-            # serialized chunk k+1's upload behind chunk k's execution —
-            # measured 49.6 ms/chunk wall vs 30.3 ms device (round 5)
-            plan.W, plan.rows, plan.row_q, plan.row_w,
-            plan.dense_rows, plan.dense_w,
-        )
-        return qidx, outs
-
-    def _run_pass(self, fld, queries, k):
-        """One fused pass over all queries -> (v, i, t, flagged_bool)."""
+    def _dispatch_batch(self, fld, queries, k):
+        """Plan + launch one query batch WITHOUT fetching: chunks are
+        planned, padded to one (R, Td) envelope, and executed as ONE
+        scanned program (_compiled_scan). Returns (idxs, device outs)
+        for _collect_batch."""
         Q = len(queries)
+        idxs = [np.arange(s, min(s + QC, Q)) for s in range(0, Q, QC)]
+        # planning is serial host work ahead of the ONE dispatch; across
+        # a multi-batch wave (msearch_many) batch k+1's planning overlaps
+        # batch k's device execution because dispatch does not block
+        plans = [plan_fused(self.searcher.pack, fld,
+                            [queries[i] for i in qidx], k)
+                 for qidx in idxs]
+        C = len(plans)
+        R = max(p.rows.shape[0] for p in plans)
+        Td = max(p.dense_rows.shape[1] for p in plans)
+        nreal = max(p.nreal for p in plans)
+
+        def _padr(a, width):
+            return np.pad(a, [(0, width - a.shape[0])] + [(0, 0)] * (
+                a.ndim - 1))
+
+        rows = np.stack([_padr(p.rows, R) for p in plans])
+        row_q = np.stack([_padr(p.row_q, R) for p in plans])
+        row_w = np.stack([_padr(p.row_w, R) for p in plans])
+        dr = np.stack([
+            np.pad(p.dense_rows, ((0, 0), (0, Td - p.dense_rows.shape[1])))
+            for p in plans])
+        dw = np.stack([
+            np.pad(p.dense_w, ((0, 0), (0, Td - p.dense_w.shape[1])))
+            for p in plans])
+        interpret = jax.default_backend() != "tpu"
+        fn = self._compiled_scan(fld, C, R, Td, k, nreal, interpret)
+        outs = fn(self._arrays(), rows, row_q, row_w, dr, dw)
+        return idxs, outs
+
+    @staticmethod
+    def _collect_batch(Q, k, idxs, host):
         scores = np.full((Q, k), -np.inf, np.float32)
         ids = np.zeros((Q, k), np.int64)
         totals = np.zeros((Q,), np.int64)
         flagged = np.zeros((Q,), bool)
-        # pipelined host planning: a worker thread plans chunk k+1 while
-        # this thread launches chunk k (dispatch waits on network RTT and
-        # releases the GIL, so the ~11 ms/chunk of numpy/dict planning
-        # overlaps device execution instead of serializing with it —
-        # round-5 profile: wall 49.6 ms/chunk vs 30.3 ms device)
-        from concurrent.futures import ThreadPoolExecutor
-
-        idxs = [np.arange(s, min(s + QC, Q)) for s in range(0, Q, QC)]
-        launched = []
-        with ThreadPoolExecutor(max_workers=1) as ex:
-            plans = ex.map(
-                lambda qidx: plan_fused(
-                    self.searcher.pack, fld,
-                    [queries[i] for i in qidx], k),
-                idxs,
-            )
-            for qidx, plan in zip(idxs, plans):
-                launched.append(self._dispatch_plan(fld, plan, k, qidx))
-        host = jax.device_get([o for _, o in launched])
-        for (qidx, _), (v, i, t, fl) in zip(launched, host):
+        v, i, t, fl = host
+        for ci, qidx in enumerate(idxs):
             nq = len(qidx)
-            scores[qidx] = v[:nq]
-            ids[qidx] = i[:nq]
-            totals[qidx] = t[:nq]
-            flagged[qidx] = fl[:nq]
+            scores[qidx] = v[ci][:nq]
+            ids[qidx] = i[ci][:nq]
+            totals[qidx] = t[ci][:nq]
+            flagged[qidx] = fl[ci][:nq]
         return scores, ids, totals, flagged
+
+    def _run_pass(self, fld, queries, k):
+        """One fused pass over all queries -> (v, i, t, flagged_bool)."""
+        idxs, outs = self._dispatch_batch(fld, queries, k)
+        return self._collect_batch(
+            len(queries), k, idxs, jax.device_get(outs))
+
+    def msearch_many(self, fld, batches, k=10):
+        """Pipelined multi-batch msearch: EVERY batch's scanned program is
+        dispatched before any result is fetched, so the remote runtime's
+        fixed per-execution overhead (~300 ms/batch through the tunnel,
+        round-5 measurement) amortizes across the wave — the serving
+        regime of a node answering concurrent _msearch requests (same
+        discipline as StackedSearcher.search_batch for aggs). Returns a
+        list of msearch-style (scores, ids, totals, first_pass_ok)
+        tuples, escalation included."""
+        disp = [self._dispatch_batch(fld, qs, k) for qs in batches]
+        hosts = jax.device_get([outs for _idxs, outs in disp])
+        out = []
+        for qs, (idxs, _), host in zip(batches, disp, hosts):
+            raw = self._collect_batch(len(qs), k, idxs, host)
+            out.append(self._finish(fld, qs, k, *raw))
+        return out
 
     def msearch(self, fld, queries, k=10):
         """-> (scores [Q,k], docids [Q,k], totals [Q] exact,
@@ -846,6 +893,10 @@ class FusedTermSearcher:
         on the legacy exact path, so results never depend on the fused
         pass. The split-bf16 selection keeps the flag rate near zero."""
         scores, ids, totals, flagged = self._run_pass(fld, queries, k)
+        return self._finish(fld, queries, k, scores, ids, totals, flagged)
+
+    def _finish(self, fld, queries, k, scores, ids, totals, flagged):
+        """Escalate flagged queries on the legacy exact path."""
         first_ok = ~flagged
         if flagged.any():
             still = np.nonzero(flagged)[0]

@@ -1,4 +1,5 @@
-"""TCP transport: length-prefixed JSON frames over real sockets.
+"""TCP transport: length-prefixed frames over real sockets, with a
+NEGOTIATED binary v1 wire format + zstd transport compression.
 
 The multi-process deployment backend for the control plane (reference
 behavior: transport/TcpTransport.java framing + TransportService dispatch;
@@ -8,10 +9,31 @@ deterministic simulator implements (transport/deterministic.py) runs here
 over real sockets, so cluster code (coordination, replication, recovery)
 is byte-identical in-process and across processes.
 
-Wire format: 4-byte big-endian frame length + UTF-8 JSON:
+Wire formats (VERDICT r4 #10 — rolling-upgrade story):
 
-    {"k": "req", "from": node, "action": a, "rid": n, "body": ...}
-    {"k": "rsp", "from": node, "rid": n, "body": ..., "err": null | str}
+  v0 (bootstrap + legacy): 4-byte big-endian length + UTF-8 JSON
+      {"k": "req", "from": node, "action": a, "rid": n, "body": ...}
+      {"k": "rsp", "from": node, "rid": n, "body": ..., "err": null|str}
+
+  v1 (negotiated): 4-byte length + binary envelope
+      magic 0xE5 | ver u8 | flags u8 (bit0: zstd body) | kind u8
+      | rid u64 | from u16+utf8 | action/err u32+utf8 | body bytes
+    The body stays JSON-encoded content inside a binary envelope —
+    exactly the reference's layout (TcpTransport's binary header +
+    version int around XContent payloads, StreamInput.java:75), with
+    bodies over 1 KiB zstd-compressed through the native binding
+    (native/zstd.py).
+
+  Negotiation is per-connection and SAFE for mixed-version clusters: a
+  v1 node opens every outbound connection with a JSON {"k": "hello",
+  "ver": 1} frame. A v0 receiver ignores the unknown kind and the
+  connection stays JSON forever; a v1 receiver marks the inbound
+  connection binary-capable for its responses and answers
+  {"k": "hello_ack", "ver": min(theirs, ours)}, upon which the sender
+  switches its outbound frames to v1 (frames already in flight remain
+  v0 — both ends accept both formats on every connection, so the
+  upgrade point needs no synchronization). The reference performs the
+  same dance with its TransportHandshaker version exchange.
 
 Concurrency model: ONE dispatch thread executes every TransportService
 callback (inbound handlers, responses, timeouts) — the single-threaded
@@ -22,6 +44,7 @@ locking. Reader threads only decode frames and enqueue work.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import socket
 import struct
@@ -30,6 +53,79 @@ import threading
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 512 * 1024 * 1024
+WIRE_VERSION = 1
+_MAGIC = 0xE5
+_HDR = struct.Struct(">BBBBQ")  # magic, ver, flags, kind, rid
+_COMPRESS_MIN = 1024
+_KIND = {"req": 0, "rsp": 1}
+_KIND_INV = {v: k for k, v in _KIND.items()}
+
+
+def _wire_enabled() -> bool:
+    """ES_TPU_WIRE_V0=1 pins a node to the legacy JSON format (the
+    "old node" of a mixed-version cluster; also the rollback lever)."""
+    return os.environ.get("ES_TPU_WIRE_V0") != "1"
+
+
+def encode_frame_v1(msg: dict) -> bytes:
+    """Binary v1 envelope; body JSON bytes, zstd over _COMPRESS_MIN."""
+    from ..native import zstd as zstd_codec
+
+    body = json.dumps(msg.get("body"), separators=(",", ":")).encode()
+    flags = 0
+    if len(body) >= _COMPRESS_MIN:
+        body = zstd_codec.compress(body)
+        flags |= 1
+    kind = _KIND[msg["k"]]
+    out = [_HDR.pack(_MAGIC, WIRE_VERSION, flags, kind, msg["rid"])]
+    frm = msg["from"].encode()
+    out.append(struct.pack(">H", len(frm)))
+    out.append(frm)
+    if kind == 0:
+        action = msg["action"].encode()
+        out.append(struct.pack(">I", len(action)))
+        out.append(action)
+    else:
+        err = msg.get("err")
+        if err is None:
+            out.append(struct.pack(">I", 0xFFFFFFFF))
+        else:
+            eb = str(err).encode()
+            out.append(struct.pack(">I", len(eb)))
+            out.append(eb)
+    out.append(body)
+    payload = b"".join(out)
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_frame_v1(payload: bytes) -> dict:
+    from ..native import zstd as zstd_codec
+
+    magic, ver, flags, kind, rid = _HDR.unpack_from(payload, 0)
+    if magic != _MAGIC or ver < 1:
+        raise ValueError(f"bad v1 frame (magic={magic:#x} ver={ver})")
+    off = _HDR.size
+    (flen,) = struct.unpack_from(">H", payload, off)
+    off += 2
+    frm = payload[off:off + flen].decode()
+    off += flen
+    msg = {"k": _KIND_INV[kind], "from": frm, "rid": rid}
+    (slen,) = struct.unpack_from(">I", payload, off)
+    off += 4
+    if kind == 0:
+        msg["action"] = payload[off:off + slen].decode()
+        off += slen
+    else:
+        if slen == 0xFFFFFFFF:
+            msg["err"] = None
+        else:
+            msg["err"] = payload[off:off + slen].decode()
+            off += slen
+    body = payload[off:]
+    if flags & 1:
+        body = zstd_codec.decompress(body)
+    msg["body"] = json.loads(body.decode())
+    return msg
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -50,12 +146,17 @@ def read_frame(sock: socket.socket) -> dict | None:
     if head is None:
         return None
     (length,) = _LEN.unpack(head)
-    if length > MAX_FRAME:
+    if length > MAX_FRAME or length < 1:
         return None
-    body = _read_exact(sock, length)
-    if body is None:
+    payload = _read_exact(sock, length)
+    if payload is None:
         return None
-    return json.loads(body.decode("utf-8"))
+    if payload[0] == _MAGIC:
+        try:
+            return decode_frame_v1(payload)
+        except Exception:  # noqa: BLE001 - corrupt frame closes the conn
+            return None
+    return json.loads(payload.decode("utf-8"))
 
 
 def frame_bytes(msg: dict) -> bytes:
@@ -74,9 +175,13 @@ class _PeerSender(threading.Thread):
         self.to_node = to_node
         self.queue: queue.Queue = queue.Queue()
         self.conn: socket.socket | None = None
+        # negotiated wire version for the CURRENT connection: flips to 1
+        # when the peer's hello_ack arrives (reader thread); reset on
+        # reconnect — a restarted peer may be older
+        self.wire_v1 = False
 
-    def enqueue(self, data: bytes, on_fail) -> None:
-        self.queue.put((data, on_fail))
+    def enqueue(self, msg: dict, on_fail) -> None:
+        self.queue.put((msg, on_fail))
 
     def _connect(self) -> bool:
         addr = self.network._peers.get(self.to_node)
@@ -89,6 +194,16 @@ class _PeerSender(threading.Thread):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.settimeout(None)
         self.conn = conn
+        self.wire_v1 = False
+        if self.network.wire_enabled:
+            # open with the JSON hello: a v0 peer ignores it, a v1 peer
+            # acks and this connection upgrades to binary frames
+            try:
+                conn.sendall(frame_bytes({
+                    "k": "hello", "ver": WIRE_VERSION,
+                    "from": self.network.node_id}))
+            except OSError:
+                pass
         # connections are duplex: responses to our requests come back over
         # the same socket
         threading.Thread(target=self.network._reader_loop, args=(conn,),
@@ -101,11 +216,18 @@ class _PeerSender(threading.Thread):
             item = self.queue.get()
             if item is None:
                 break
-            data, on_fail = item
+            msg, on_fail = item
             sent = False
             for _attempt in (0, 1):  # one reconnect on a stale connection
                 if self.conn is None and not self._connect():
                     break
+                try:
+                    # encode at SEND time so the negotiated version of the
+                    # live connection applies (not the enqueue-time one)
+                    data = (encode_frame_v1(msg) if self.wire_v1
+                            else frame_bytes(msg))
+                except Exception:  # noqa: BLE001 - unserializable body:
+                    break  # fail THIS message, never the sender thread
                 try:
                     self.conn.sendall(data)
                     sent = True
@@ -148,6 +270,13 @@ class TcpTransportNetwork:
         self._conn_lock = threading.Lock()
         self._inbox: queue.Queue = queue.Queue()
         self._inbound_routes: dict[tuple[str, int], socket.socket] = {}
+        # inbound connections whose peer negotiated wire v1 (responses and
+        # the hello_ack on them go binary)
+        self._v1_conns: set = set()
+        # wire capability is fixed at CONSTRUCTION (a node's version does
+        # not change while it runs; per-node in-process test clusters pin
+        # individual nodes via the env var around construction)
+        self.wire_enabled = _wire_enabled()
         self._timers: set[threading.Timer] = set()
         self._pool = None  # lazy search worker pool (see offload)
         self._closed = False
@@ -261,6 +390,7 @@ class TcpTransportNetwork:
         while not self._closed:
             msg = read_frame(conn)
             if msg is None:
+                self._v1_conns.discard(conn)
                 try:
                     conn.close()
                 except OSError:
@@ -269,6 +399,22 @@ class TcpTransportNetwork:
             self._inbox.put(lambda m=msg: self._deliver(m, conn))
 
     def _deliver(self, msg: dict, conn: socket.socket | None = None):
+        if msg.get("k") == "hello":
+            if conn is not None and self.wire_enabled:
+                self._v1_conns.add(conn)
+                try:
+                    conn.sendall(frame_bytes({
+                        "k": "hello_ack",
+                        "ver": min(int(msg.get("ver", 1)), WIRE_VERSION),
+                        "from": self.node_id}))
+                except OSError:
+                    pass
+            return
+        if msg.get("k") == "hello_ack":
+            s = self._senders.get(msg.get("from", ""))
+            if s is not None and self.wire_enabled:
+                s.wire_v1 = int(msg.get("ver", 0)) >= 1
+            return
         svc = self._service
         if svc is None:
             return
@@ -310,10 +456,10 @@ class TcpTransportNetwork:
                 self._inbox.put(lambda: svc.handle_connection_failure(
                     rid, f"cannot connect to [{to_node}]"))
 
-        self._sender_for(to_node).enqueue(frame_bytes({
+        self._sender_for(to_node).enqueue({
             "k": "req", "from": from_node, "action": action,
             "rid": rid, "body": request,
-        }), on_fail)
+        }, on_fail)
 
     def respond(self, from_node: str, to_node: str, rid: int, response, error):
         msg = {"k": "rsp", "from": from_node, "rid": rid,
@@ -321,13 +467,15 @@ class TcpTransportNetwork:
         conn = self._inbound_routes.pop((to_node, rid), None)
         if conn is not None:
             try:
+                data = (encode_frame_v1(msg) if conn in self._v1_conns
+                        else frame_bytes(msg))
                 with self._conn_lock:
-                    conn.sendall(frame_bytes(msg))
+                    conn.sendall(data)
                 return
             except OSError:
-                pass  # inbound conn gone; try the address book
+                self._v1_conns.discard(conn)  # conn gone; address book
         if to_node in self._peers:
-            self._sender_for(to_node).enqueue(frame_bytes(msg), None)
+            self._sender_for(to_node).enqueue(msg, None)
         # a lost response surfaces as a timeout on the requester
 
     # -- lifecycle ---------------------------------------------------------

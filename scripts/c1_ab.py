@@ -1,0 +1,93 @@
+"""Fast A/B of msearch wall-clock configs on the real TPU.
+
+Caches the 1M-doc pack + corpus under /tmp/c1_pack_cache via
+index/packio.py so iterations skip the multi-minute build. Usage:
+    python scripts/c1_ab.py label        # run current env config
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+from elasticsearch_tpu.index import packio  # noqa: E402
+from elasticsearch_tpu.index.mappings import Mappings  # noqa: E402
+from elasticsearch_tpu.ops import fused as F  # noqa: E402
+from elasticsearch_tpu.ops.batched import BatchTermSearcher  # noqa: E402
+from elasticsearch_tpu.query.executor import ShardSearcher  # noqa: E402
+
+CACHE = "/tmp/c1_pack_cache"
+
+
+def load_or_build():
+    man_p = os.path.join(CACHE, "manifest.json")
+    if os.path.exists(man_p):
+        man = json.load(open(man_p))
+        pack = packio.deserialize_pack(
+            man, lambda d: open(os.path.join(CACHE, d), "rb").read())
+        lens = np.load(os.path.join(CACHE, "lens.npy"))
+        tok = np.load(os.path.join(CACHE, "tok.npy"))
+        return pack, lens, tok
+    rng = np.random.default_rng(42)
+    lens, tok = bench.build_corpus(rng)
+    pack, _m = bench.build_pack(lens, tok)
+    os.makedirs(CACHE, exist_ok=True)
+
+    def put(payload: bytes) -> str:
+        import hashlib
+
+        digest = hashlib.sha256(payload).hexdigest()
+        p = os.path.join(CACHE, digest)
+        if not os.path.exists(p):
+            with open(p, "wb") as f:
+                f.write(payload)
+        return digest
+
+    man = packio.serialize_pack(pack, put)
+    json.dump(man, open(man_p, "w"))
+    np.save(os.path.join(CACHE, "lens.npy"), lens)
+    np.save(os.path.join(CACHE, "tok.npy"), tok)
+    return pack, lens, tok
+
+
+def main():
+    from elasticsearch_tpu.utils.jax_env import enable_compile_cache
+
+    enable_compile_cache()
+    label = sys.argv[1] if len(sys.argv) > 1 else "run"
+    t0 = time.perf_counter()
+    pack, lens, tok = load_or_build()
+    print(f"[ab] pack ready in {time.perf_counter()-t0:.0f}s",
+          file=sys.stderr)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    rng = np.random.default_rng(7)
+    fts = F.FusedTermSearcher(BatchTermSearcher(
+        ShardSearcher(pack, mappings=m)))
+    q4096 = bench.sample_queries(rng, lens, tok, 4096)
+    fts.msearch("body", q4096, 10)  # warm
+    walls = []
+    ok_frac = 1.0
+    for _round in range(6):
+        t0 = time.perf_counter()
+        _s, _i, _t, ok = fts.msearch("body", q4096, 10)
+        walls.append(time.perf_counter() - t0)
+        ok_frac = float(np.mean(ok))
+    w = min(walls)
+    print(json.dumps({
+        "label": label, "first_pass_ok": ok_frac,
+        "wall_ms": round(w * 1e3, 1),
+        "per_chunk_ms": round(w * 1e3 / 8, 2),
+        "qps": round(4096 / w, 1),
+        "all_ms": [round(x * 1e3) for x in walls],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -90,7 +90,13 @@ def main():
            "t": t, "nreal": plan.nreal}
     print(f"[profile] shapes {res}", file=sys.stderr)
 
-    W = jnp.asarray(plan.W)
+    # rebuild the dense W matrix the way the device path does (plan.W is
+    # no longer materialized host-side)
+    Wnp = np.zeros((F.QC, V), np.float32)
+    for qi in range(F.QC):
+        for ti in range(plan.dense_rows.shape[1]):
+            Wnp[qi, plan.dense_rows[qi, ti]] += plan.dense_w[qi, ti]
+    W = jnp.asarray(Wnp)
     rows = jnp.asarray(plan.rows)
     row_q = jnp.asarray(plan.row_q)
     row_w = jnp.asarray(plan.row_w)
@@ -266,10 +272,11 @@ def main():
         (time.perf_counter() - t0) * 1e3 / 8, 2)
     print(f"[profile] msearch4096 {res['msearch4096_ms']}", file=sys.stderr)
 
-    # ---- end-to-end current pipeline ------------------------------------
-    fn = fts._compiled("body", R, plan.dense_rows.shape[1], k,
-                       plan.nreal, False)
-    args = (fts._arrays(), W, rows, row_q, row_w, dense_rows, dense_w)
+    # ---- end-to-end current pipeline (C=1 scanned executable) -----------
+    fn = fts._compiled_scan("body", 1, R, plan.dense_rows.shape[1], k,
+                            plan.nreal, False)
+    args = (fts._arrays(), plan.rows[None], plan.row_q[None],
+            plan.row_w[None], plan.dense_rows[None], plan.dense_w[None])
     res["pipeline_ms"] = round(timed(fn, *args) * 1e3, 2)
 
     print(json.dumps(res))

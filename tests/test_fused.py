@@ -186,7 +186,9 @@ def test_plan_fused_block_row_layout(corpus):
     m, pack, searcher, oracle, rng = corpus
     queries = _queries(rng, 5)
     plan = plan_fused(pack, "body", queries, 10)
-    assert plan.W.shape[0] == 512
+    # W is device-built from (dense_rows, dense_w) since round 5
+    assert plan.W is None
+    assert plan.dense_rows.shape[0] == 512
     assert (plan.row_w[plan.rows == 0] == 0).all()
     # block rows reference real CSR ranges of their terms
     assert plan.rows.max() < pack.post_docids.shape[0]

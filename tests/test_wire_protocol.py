@@ -1,0 +1,149 @@
+"""Binary wire protocol v1 + version negotiation (transport/tcp.py;
+reference: TcpTransport binary headers + TransportHandshaker version
+exchange, common/io/stream/StreamInput.java:75).
+
+Covers: codec roundtrip incl. zstd bodies, hello/hello_ack upgrade on
+live connections, and a MIXED cluster (one node pinned to the legacy
+JSON format) that still elects, replicates, and serves reads."""
+
+import struct
+
+import pytest
+
+from elasticsearch_tpu.transport import tcp as wire
+
+
+def test_v1_codec_roundtrip_request():
+    msg = {"k": "req", "from": "node-α", "action": "cluster:join",
+           "rid": (1 << 53) + 7, "body": {"x": [1, 2, 3], "s": "héllo"}}
+    payload = wire.encode_frame_v1(msg)
+    (length,) = struct.unpack(">I", payload[:4])
+    assert length == len(payload) - 4
+    out = wire.decode_frame_v1(payload[4:])
+    assert out == msg
+
+
+def test_v1_codec_roundtrip_response_and_error():
+    for err in (None, "boom"):
+        msg = {"k": "rsp", "from": "n1", "rid": 42,
+               "body": {"ok": True}, "err": err}
+        out = wire.decode_frame_v1(wire.encode_frame_v1(msg)[4:])
+        assert out["err"] == err
+        assert out["body"] == {"ok": True}
+
+
+def test_v1_codec_compresses_large_bodies():
+    big = {"k": "req", "from": "n", "action": "a", "rid": 1,
+           "body": {"blob": "z" * 100_000}}
+    payload = wire.encode_frame_v1(big)
+    assert len(payload) < 20_000, "zstd must engage over the threshold"
+    flags = payload[4 + 2]
+    assert flags & 1
+    assert wire.decode_frame_v1(payload[4:])["body"]["blob"] == "z" * 100_000
+    small = {"k": "req", "from": "n", "action": "a", "rid": 1,
+             "body": {"v": 1}}
+    assert wire.encode_frame_v1(small)[4 + 2] & 1 == 0
+
+
+def test_corrupt_v1_frame_rejected():
+    msg = {"k": "req", "from": "n", "action": "a", "rid": 1, "body": {}}
+    payload = bytearray(wire.encode_frame_v1(msg)[4:])
+    payload[1] = 0  # version 0 inside a magic frame
+    with pytest.raises(ValueError):
+        wire.decode_frame_v1(bytes(payload))
+
+
+def _mk_cluster(monkeypatch, v0_node=None):
+    from elasticsearch_tpu.cluster.server import NodeServer
+
+    ids = ["w1", "w2", "w3"]
+    servers = {}
+    for nid in ids:
+        if nid == v0_node:
+            monkeypatch.setenv("ES_TPU_WIRE_V0", "1")
+        else:
+            monkeypatch.delenv("ES_TPU_WIRE_V0", raising=False)
+        servers[nid] = NodeServer(nid, ids, {}, port=0)
+    monkeypatch.delenv("ES_TPU_WIRE_V0", raising=False)
+    for nid, s in servers.items():
+        for other, o in servers.items():
+            if other != nid:
+                s.network.add_peer(other, "127.0.0.1", o.port)
+    for s in servers.values():
+        s.start()
+    return servers
+
+
+def _wait_green(servers, docs=0):
+    import time
+
+    from elasticsearch_tpu.cluster.server import TcpClient
+
+    c = TcpClient()
+    any_id, any_s = next(iter(servers.items()))
+    for nid, s in servers.items():
+        c.add_node(nid, "127.0.0.1", s.network.port)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            st = c.request(any_id, "client:status", {})
+            if st.get("leader") and len(st.get("nodes", [])) == 3:
+                return c
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise AssertionError("cluster did not form")
+
+
+def test_v1_cluster_negotiates_and_works(monkeypatch):
+    servers = _mk_cluster(monkeypatch)
+    try:
+        c = _wait_green(servers)
+        c.request("w1", "client:create_index", {
+            "index": "wp", "settings": {"number_of_shards": 1}})
+        r = c.request("w1", "client:bulk", {
+            "index": "wp",
+            "ops": [["index", f"d{i}", {"n": i}] for i in range(20)]})
+        assert not r.get("errors"), r
+        # at least one outbound connection negotiated v1
+        upgraded = any(
+            snd.wire_v1
+            for s in servers.values()
+            for snd in s.network._senders.values())
+        assert upgraded, "no connection upgraded to wire v1"
+    finally:
+        for s in servers.values():
+            s.close()
+
+
+def test_mixed_version_cluster_stays_json_with_old_node(monkeypatch):
+    """One node pinned to legacy JSON: the cluster still forms and
+    serves; connections touching the old node stay v0 while
+    new<->new connections upgrade."""
+    servers = _mk_cluster(monkeypatch, v0_node="w2")
+    try:
+        c = _wait_green(servers)
+        c.request("w1", "client:create_index", {
+            "index": "mx", "settings": {"number_of_shards": 1,
+                                        "number_of_replicas": 1}})
+        r = c.request("w2", "client:bulk", {
+            "index": "mx",
+            "ops": [["index", f"d{i}", {"n": i}] for i in range(10)]})
+        assert not r.get("errors"), r
+        import time
+
+        deadline = time.time() + 30
+        got = None
+        while time.time() < deadline:
+            got = c.request("w3", "client:get", {"index": "mx", "id": "d3"})
+            if got.get("_id") == "d3":
+                break
+            time.sleep(0.3)
+        assert got and got.get("_source") == {"n": 3}, got
+        # the old node's outbound connections never upgraded
+        assert not any(
+            snd.wire_v1
+            for snd in servers["w2"].network._senders.values())
+    finally:
+        for s in servers.values():
+            s.close()
