@@ -534,9 +534,12 @@ def plan_fused(pack, fld, queries, k, qc=QC):
 
 def _fused_pipeline(
     fa,  # device dict: tier16/tier32 [V, n_pad], live [1, n_pad], post_*
+    avgdl,  # () f32 — a TRACED arg: baking this per-pack float into the
+    #         HLO caused a fresh ~200 s remote compile per shard in the
+    #         C5 bench (every shard's avgdl differs slightly)
     rows, row_q, row_w, dense_rows, dense_w,
     *,
-    k, n, n_pad, avgdl, has_norms, k1, b, bud, t, tile_n, interpret,
+    k, n, n_pad, has_norms, k1, b, bud, t, tile_n, interpret,
     qsub=QSUB,
 ):
     """One fused chunk, fully on device. -> (v [Q,k], i, totals, flags)."""
@@ -792,16 +795,15 @@ class FusedTermSearcher:
         if fn is None:
             kw = dict(
                 k=k, n=n, n_pad=n_pad,
-                avgdl=pack.avgdl(fld),
                 has_norms=fld in self.searcher.ctx.has_norms,
                 k1=1.2, b=0.75,
                 bud=bud, t=t, tile_n=tile_n, qsub=qsub,
                 interpret=interpret,
             )
 
-            def scan_pipeline(fa, rows, row_q, row_w, dr, dw):
+            def scan_pipeline(fa, avgdl, rows, row_q, row_w, dr, dw):
                 def body(carry, xs):
-                    return carry, _fused_pipeline(fa, *xs, **kw)
+                    return carry, _fused_pipeline(fa, avgdl, *xs, **kw)
 
                 _, outs = jax.lax.scan(
                     body, 0, (rows, row_q, row_w, dr, dw))
@@ -844,7 +846,9 @@ class FusedTermSearcher:
             for p in plans])
         interpret = jax.default_backend() != "tpu"
         fn = self._compiled_scan(fld, C, R, Td, k, nreal, interpret)
-        outs = fn(self._arrays(), rows, row_q, row_w, dr, dw)
+        outs = fn(self._arrays(),
+                  np.float32(self.searcher.pack.avgdl(fld)),
+                  rows, row_q, row_w, dr, dw)
         return idxs, outs
 
     @staticmethod

@@ -275,7 +275,8 @@ def main():
     # ---- end-to-end current pipeline (C=1 scanned executable) -----------
     fn = fts._compiled_scan("body", 1, R, plan.dense_rows.shape[1], k,
                             plan.nreal, False)
-    args = (fts._arrays(), plan.rows[None], plan.row_q[None],
+    args = (fts._arrays(), np.float32(pack.avgdl("body")),
+            plan.rows[None], plan.row_q[None],
             plan.row_w[None], plan.dense_rows[None], plan.dense_w[None])
     res["pipeline_ms"] = round(timed(fn, *args) * 1e3, 2)
 
