@@ -363,6 +363,12 @@ class EngineReplica:
         from ..snapshots.repository import InMemoryRepository
         from ..snapshots.service import SnapshotService
 
+        if self.failed is not None:
+            # a poisoned replica's engine state is ambiguous (it stopped
+            # mid-log, possibly diverged) — serving it to a resyncing
+            # peer would fork the cluster; the error payload makes
+            # _resync fail over to a healthy peer instead
+            return {"error": f"replica poisoned: {self.failed}"}
         applied = self.next_idx
         svc = SnapshotService(self.engine)
         mem = InMemoryRepository()
@@ -638,7 +644,7 @@ def make_cluster_app(server: NodeServer,
     async def index_doc(request):
         index = request.match_info["index"]
         bad = _check_index(index)
-        if bad:
+        if bad is not None:
             return bad
         doc_id = request.match_info.get("id")
         if doc_id is None:
@@ -664,7 +670,7 @@ def make_cluster_app(server: NodeServer,
     async def get_doc(request):
         index = request.match_info["index"]
         bad = _check_index(index)
-        if bad:
+        if bad is not None:
             return bad
         doc_id = request.match_info["id"]
         # client_get resolves to ShardCopy.get's realtime envelope
@@ -718,7 +724,7 @@ def make_cluster_app(server: NodeServer,
             return _err(400, "parse_exception", "malformed bulk body")
         for index in by_index:
             bad = _check_index(index)
-            if bad:
+            if bad is not None:
                 return bad
         results: dict[str, dict] = {}
         for index, ops in by_index.items():
@@ -757,7 +763,7 @@ def make_cluster_app(server: NodeServer,
     async def search(request):
         index = request.match_info["index"]
         bad = _check_index(index)
-        if bad:
+        if bad is not None:
             return bad
         try:
             body = await request.json() if request.can_read_body else {}
@@ -803,7 +809,7 @@ def make_cluster_app(server: NodeServer,
     async def count(request):
         index = request.match_info["index"]
         bad = _check_index(index)
-        if bad:
+        if bad is not None:
             return bad
         try:
             body = await request.json() if request.can_read_body else {}

@@ -108,6 +108,8 @@ def stats_exchange(
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..utils.jax_env import shard_map
+
     S = int(shard_of.max()) + 1 if len(shard_of) else 1
     if mesh is not None:
         ndev = len(mesh.devices.ravel())
@@ -260,7 +262,7 @@ def stats_exchange(
                 lminmax = jnp.stack([lmin, lmax], axis=-1)
                 return merged[None], rows[None], lsum[None], lminmax[None]
 
-            pc, rc, lf, li = jax.shard_map(
+            pc, rc, lf, li = shard_map(
                 body, mesh=mesh,
                 in_specs=(P("shards"),) * 6,
                 out_specs=(P("shards"),) * 4,

@@ -108,6 +108,8 @@ def topn_exchange(
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..utils.jax_env import shard_map
+
     n = int(min(limit, t.nrows))
     if n <= 0:
         return np.array([], np.int64)
@@ -152,7 +154,7 @@ def topn_exchange(
                 gathered = jax.lax.all_gather(local, "shards")
                 return merge(gathered)[None]
 
-            out = jax.shard_map(
+            out = shard_map(
                 body, mesh=mesh, in_specs=(P("shards"),),
                 out_specs=P("shards"),
             )(ops)

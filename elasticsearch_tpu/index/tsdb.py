@@ -122,12 +122,31 @@ class TimeSeriesMode:
 
     # ---- dimensions ------------------------------------------------------
 
+    def _routing_fields(self) -> list[str]:
+        """routing_path entries resolved against the mapped field names:
+        a wildcard pattern (e.g. `k8s.pod.*`) expands to every mapped
+        field it matches (IndexRouting.ExtractFromSource does the same
+        via its pattern list); a literal entry resolves to itself, so
+        dynamic/unmapped literal paths keep working."""
+        import fnmatch
+
+        out: set[str] = set()
+        for pat in self.routing_path:
+            if any(ch in pat for ch in "*?["):
+                out.update(
+                    name for name in self.mappings.fields
+                    if fnmatch.fnmatchcase(name, pat)
+                )
+            else:
+                out.add(pat)
+        return sorted(out)
+
     def _dimension_fields(self) -> list[str]:
         dims = [
             name for name, ft in self.mappings.fields.items()
             if getattr(ft, "extra", {}).get("time_series_dimension")
         ]
-        return sorted(set(dims) | set(self.routing_path))
+        return sorted(set(dims) | set(self._routing_fields()))
 
     @staticmethod
     def _get_path(source: dict, path: str):
@@ -190,10 +209,13 @@ class TimeSeriesMode:
 
     def shard_of(self, source: dict, num_shards: int) -> int:
         """Routing by the routing_path dimension values: every doc of one
-        time series lands on one shard (IndexRouting.ExtractFromSource)."""
+        time series lands on one shard (IndexRouting.ExtractFromSource).
+        Wildcard routing_path entries hash the mapped fields they expand
+        to (_routing_fields) — hashing the literal pattern would extract
+        nothing and make the index unwritable."""
         h = hashlib.sha256()
         found = False
-        for f in sorted(self.routing_path):
+        for f in self._routing_fields():
             v = self._get_path(source, f)
             if v is not None:
                 found = True

@@ -65,11 +65,16 @@ class TpuEmbeddingModel:
                 hashlib.blake2b(inference_id.encode(), digest_size=4).digest(),
                 "little",
             )
-        key = jax.random.PRNGKey(seed)
         # table in bf16: 32k x dims, the embedding analog of the bf16 dense
-        # scoring tier; accumulation in f32
-        self.table = jax.random.normal(
-            key, (VOCAB_BUCKETS, self.dims), jnp.bfloat16
+        # scoring tier; accumulation in f32. Drawn with numpy's seeded
+        # generator, whose bit-exact output is part of its API contract —
+        # jax.random's sampling is an implementation detail that has
+        # changed across releases, and "reproducible across nodes" must
+        # also mean across runtime versions
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(
+            rng.standard_normal((VOCAB_BUCKETS, self.dims), dtype=np.float32),
+            jnp.bfloat16,
         )
         self._embed = jax.jit(self._embed_fn)
 

@@ -42,6 +42,10 @@ class BatchPlan:
     sparse_weights: np.ndarray  # [Q, Ts] f32
     k: int
     dense_only: bool = False  # no sparse terms anywhere -> fused Pallas path
+    # per-query dense (tier row, weight) pairs [Q, Td] (0-padded): the
+    # sparse view of W, for the tiered path's canonical f32 rescore
+    dense_rows: np.ndarray | None = None
+    dense_w: np.ndarray | None = None
 
 
 def batch_term_disjunction(
@@ -393,14 +397,21 @@ class BatchTermSearcher:
         W = np.zeros((Q, V), np.float32)
         rows = np.zeros((Q, max_ts, B), np.int32)
         ws = np.zeros((Q, max_ts), np.float32)
+        td_max = max((len(d) for d, _ in parsed), default=1) or 1
+        Td = 1 << (max(td_max, 4) - 1).bit_length()
+        dense_rows = np.zeros((Q, Td), np.int32)
+        dense_w = np.zeros((Q, Td), np.float32)
         for qi, (dense, sparse) in enumerate(parsed):
-            for dr, w in dense:
+            for ti, (dr, w) in enumerate(dense):
                 W[qi, dr] += w
+                dense_rows[qi, ti] = dr
+                dense_w[qi, ti] = w
             for ti, (s0, nb, w) in enumerate(sparse):
                 rows[qi, ti, :nb] = np.arange(s0, s0 + nb)
                 ws[qi, ti] = w
         dense_only = V > 0 and all(not sparse for _, sparse in parsed)
-        return BatchPlan(W, rows, ws, k, dense_only)
+        return BatchPlan(W, rows, ws, k, dense_only,
+                         dense_rows=dense_rows, dense_w=dense_w)
 
     def _chunk_q(self, Q: int) -> int:
         """Power-of-two chunk width: caps the materialized [Qc, N] f32 score
@@ -436,23 +447,28 @@ class BatchTermSearcher:
         Q = plan.W.shape[0]
         qc = self._chunk_q(Q)
         pad = (-Q) % qc
-        W, sr, sw = plan.W, plan.sparse_rows, plan.sparse_weights
+        arrs = [plan.W, plan.sparse_rows, plan.sparse_weights]
+        if map_key[0] == "dense_tiered":
+            # the tiered kernel rescores against the per-query (tier row,
+            # weight) pairs, so they ride along as chunked operands
+            arrs += [plan.dense_rows, plan.dense_w]
         if pad:
-            W = np.pad(W, ((0, pad), (0, 0)))
-            sr = np.pad(sr, ((0, pad), (0, 0), (0, 0)))
-            sw = np.pad(sw, ((0, pad), (0, 0)))
+            arrs = [np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+                    for a in arrs]
         cache_key = ("chunk", map_key, qc)
         fn = self._cache.get(cache_key)
         if fn is None:
             fn = jax.jit(kernel)
             self._cache[cache_key] = fn
-        extras = self._fast_extras(map_key[-1]) if map_key[0] == "fast" else {}
+        if map_key[0] == "fast":
+            extras = self._fast_extras(map_key[-1])
+        elif map_key[0] == "dense_tiered":
+            extras = self._tiered_extras()
+        else:
+            extras = {}
         dev = self.searcher.dev
         outs = [
-            fn(dev, extras,
-               jnp.asarray(W[i : i + qc]),
-               jnp.asarray(sr[i : i + qc]),
-               jnp.asarray(sw[i : i + qc]))
+            fn(dev, extras, *(jnp.asarray(a[i : i + qc]) for a in arrs))
             for i in range(0, Q + pad, qc)
         ]
         return _RawChunks(outs, Q, n_out)
@@ -512,6 +528,20 @@ class BatchTermSearcher:
             setattr(self, attr, extras)
         return extras
 
+    def _tiered_extras(self) -> dict:
+        """Split-bf16 (hi, lo) copies of the dense tier for the tiered
+        selection kernel — kept out of searcher.dev for the same treedef
+        reasons as _fast_extras."""
+        extras = getattr(self, "_extras_tiered", None)
+        if extras is None:
+            from .kernels import split_bf16
+
+            dev = self.searcher.dev
+            hi, lo = jax.jit(split_bf16)(dev["dense_tfn"])
+            extras = {"dense_hi": hi, "dense_lo": lo}
+            self._extras_tiered = extras
+        return extras
+
     def run_fast(self, fld: str, plan: BatchPlan, *, bf16: bool = False, M: int | None = None):
         """Throughput path -> (scores [Q,k], docids [Q,k], totals_lb [Q],
         exact [Q], dropped [Q]) on device. See batch_term_disjunction_fast
@@ -519,15 +549,51 @@ class BatchTermSearcher:
         results re-run flagged queries with M = C."""
         dev = self.searcher.dev
         if plan.dense_only:
-            # chunked XLA matmul+top_k: at bench batch sizes this beats the
-            # fused Pallas scan (per-step [tile_b, D]x[D, tile_n] matmuls
-            # under-utilize the MXU; XLA's own fusion pipelines the full-
-            # width matmul against the top-k pass), and the [Qc, N] score
-            # materialization stays under SCORE_BYTES_BUDGET
-            from .kernels import scan_topk_xla
+            from .fused import rank_topk
+            from .kernels import (
+                EPS_TIERED, KB_TIERED, fused_topk_enabled, scan_topk_xla,
+                tiered_candidates,
+            )
 
             k = plan.k
+            if (fused_topk_enabled() and k <= KB_TIERED
+                    and plan.dense_rows is not None):
+                # tiered path (ES_TPU_FUSED_TOPK default): split-bf16
+                # selection with a running in-VMEM top-KB on TPU, then the
+                # canonical f32 rescore of the survivors against the f32
+                # tier — flagged queries (margin test) escalate to the
+                # exact scan via msearch's rerun loop
+                kb = min(max(KB_TIERED, k), self.searcher.pack.num_docs)
+                Td = plan.dense_rows.shape[1]
 
+                def dense_kernel(dv, extras, W, sr, sw, dr, dw):
+                    sel_v, sel_i, totals = tiered_candidates(
+                        W, extras["dense_hi"], extras["dense_lo"],
+                        dv["live"], kb,
+                        transform="identity", count_positive=True,
+                    )
+                    cand_ok = jnp.isfinite(sel_v)
+                    dg = dv["dense_tfn"][
+                        dr[:, :, None], sel_i[:, None, :]]  # [Qc, Td, kb]
+                    resc = jnp.sum(dw[:, :, None] * dg, axis=1)
+                    resc = jnp.where(cand_ok & (resc > 0), resc, -jnp.inf)
+                    v, i_ = rank_topk(resc, sel_i, min(k, kb))
+                    am_kernel = sel_v[:, -1]
+                    am_resc = jnp.min(
+                        jnp.where(cand_ok, resc, jnp.inf), axis=1)
+                    rk = v[:, -1]
+                    bound = am_kernel + EPS_TIERED * jnp.abs(am_kernel)
+                    safe = (jnp.isneginf(am_kernel) | (rk > bound)
+                            | (rk == am_resc))
+                    return (v, i_, totals, safe,
+                            jnp.zeros(v.shape[0], jnp.int32))
+
+                return self._run_chunked(
+                    dense_kernel, ("dense_tiered", k, kb, Td), plan, 5)
+
+            # chunked XLA matmul+top_k fallback (ES_TPU_FUSED_TOPK=0 or
+            # k beyond the selection width): the [Qc, N] score
+            # materialization stays under SCORE_BYTES_BUDGET
             def dense_kernel(dv, extras, W, sr, sw):
                 N = dv["dense_tfn"].shape[1]
                 v, i_, t = scan_topk_xla(
@@ -715,13 +781,27 @@ class BatchTermSearcher:
             redo = np.concatenate(pending)
             pending = []
             rerun_parts = []
+            exact_parts = []
             for idxs, plan in self.plan_bucketed(
                 fld, [queries[i] for i in redo], k
             ):
+                if plan.dense_only:
+                    # a tiered-selection flag has no candidate budget to
+                    # widen — escalate straight to the exact scan path
+                    exact_parts.append((idxs, self.run(fld, plan)))
+                    continue
                 C = plan.sparse_rows.shape[1] * plan.sparse_rows.shape[2] * BLOCK
                 M = min(rerun_m, C)
                 rerun_parts.append(
                     (idxs, M >= C, self.run_fast(fld, plan, bf16=bf16, M=M)))
+            for idxs, out in exact_parts:
+                ev, ei, et = [np.asarray(x) for x in (
+                    out.resolve() if isinstance(out, _RawChunks)
+                    else jax.device_get(out))]
+                done = redo[idxs]
+                scores[done, : ev.shape[1]] = ev
+                ids[done, : ev.shape[1]] = ei
+                totals[done] = et
             resolved = _RawChunks.resolve_all([r for _, _, r in rerun_parts])
             for (idxs, uncut, _), (ev, ei, et, eok, edrop) in zip(
                 rerun_parts, resolved

@@ -129,6 +129,15 @@ def fused_enabled() -> str:
     return os.environ.get("ES_TPU_FUSED", "auto")
 
 
+def fused_topk_enabled() -> bool:
+    """ES_TPU_FUSED_TOPK (default on): run the dense-tier matmul INSIDE the
+    Pallas kernel, so the [Qc, N] score matrix lives only as per-tile VMEM
+    transients and the running top-t selection never round-trips HBM.
+    '0' reverts to the round-5 out-of-kernel matmul (scores materialized
+    in HBM, kernel reads tiles of them)."""
+    return os.environ.get("ES_TPU_FUSED_TOPK", "auto") != "0"
+
+
 def _key_bits(n_pad: int, qsub: int, nsub: int):
     qb = int(np.log2(qsub))
     db = max(1, int(np.ceil(np.log2(max(n_pad + 1, 2)))))
@@ -160,6 +169,18 @@ def _cfg_tile() -> int:
     return int(os.environ.get("ES_TPU_FUSED_TILE", TILE_N))
 
 
+def auto_tile_matmul(vp2: int, qsub: int) -> int:
+    """Tile width for the in-kernel-matmul mode: the double-buffered
+    [vp2, tile] bf16 tier block + f32 sacc + dense transient must fit the
+    ~64MB scoped VMEM budget with headroom for the window blocks. At the
+    bench shape (V=896 -> vp2=1792, qsub=256) this lands on 4096."""
+    budget = 40 * 1024 * 1024
+    fixed = 2 * qsub * vp2 * 2  # double-buffered [qsub, vp2] weight block
+    per_col = 2 * vp2 * 2 + 8 * qsub  # tier (x2 buffers) + sacc + dense
+    tile = (budget - fixed) // max(per_col, 1)
+    return max(FINE_N, min(TILE_N, (tile // FINE_N) * FINE_N))
+
+
 def _cfg_qsub() -> int:
     """Query sub-tile rows per grid step; env-overridable for sweeps."""
     return int(os.environ.get("ES_TPU_FUSED_QSUB", QSUB))
@@ -186,22 +207,23 @@ def tile_t_for(njc: int) -> int:
 def _fused_kernel(
     ptr_ref,  # scalar prefetch [nsub*(njf+1)] i32 exact fine window starts
     ptrb_ref,  # scalar prefetch [nsub*(njc+1)] i32 coarse window block idx
-    scores_ref,  # [QSUB, tile_n] block (bf16 | f32)
-    live_ref,  # [1, tile_n] f32
-    keya_ref,  # [bud, 128] i32 key rows of window block ptrb[j]
-    keyb_ref,  # [bud, 128] i32 key rows of window block ptrb[j]+1
-    vala_ref,  # [bud, 128] i32 f32-bits of window block ptrb[j]
-    valb_ref,  # [bud, 128] i32 f32-bits of window block ptrb[j]+1
-    cv_ref,  # [1, QSUB, t] f32 per-tile candidate scores
-    ci_ref,  # [1, QSUB, t] i32 per-tile candidate docids
-    ot_ref,  # [QSUB, 1] f32 (exact match counts)
-    of_ref,  # [QSUB, 1] f32 (window-overflow flags)
-    sacc,  # VMEM [QSUB, tile_n] f32 (per-step sparse accumulator)
-    cnt,  # VMEM [QC, 1] f32
-    ovf,  # VMEM [QC, 1] f32
-    *,
-    t, tile_n, fine_n, bud, qsub, qb, db, sb, njc, njf,
+    *refs,
+    # matmul=False refs: (scores [QSUB, tile_n] bf16|f32, live [1, tile_n]
+    #   f32, keya/keyb/vala/valb [bud, 128] i32, cv [1, QSUB, t] f32,
+    #   ci [1, QSUB, t] i32, ot [QSUB, 1] f32, of [QSUB, 1] f32,
+    #   sacc VMEM [QSUB, tile_n] f32, cnt/ovf VMEM [QC, 1] f32)
+    # matmul=True: scores is replaced by (w [QSUB, Vp2] bf16 split-bf16
+    #   query weights [Wh | Wh], tstack [Vp2, tile_n] bf16 [T16; T16lo]):
+    #   the dense tile is computed HERE on the MXU, so the [Qc, N] score
+    #   matrix never exists outside VMEM (ES_TPU_FUSED_TOPK tentpole)
+    t, tile_n, fine_n, bud, qsub, qb, db, sb, njc, njf, matmul,
 ):
+    if matmul:
+        (w_ref, tier_ref, live_ref, keya_ref, keyb_ref, vala_ref, valb_ref,
+         cv_ref, ci_ref, ot_ref, of_ref, sacc, cnt, ovf) = refs
+    else:
+        (scores_ref, live_ref, keya_ref, keyb_ref, vala_ref, valb_ref,
+         cv_ref, ci_ref, ot_ref, of_ref, sacc, cnt, ovf) = refs
     j = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -283,7 +305,17 @@ def _fused_kernel(
             lambda c, _, : _row(keyb_ref, valb_ref, bud, c) or 0, 0,
         )
 
-    dense = scores_ref[:].astype(jnp.float32)
+    if matmul:
+        # 2-pass split-bf16 selection fused with the scan: [Wh | Wh] @
+        # [T16; T16lo] accumulates Wh@T16 + Wh@T16lo in f32 on the MXU —
+        # same EPS_SPLIT error contract as the out-of-kernel form, but the
+        # [QSUB, tile_n] result is a VMEM transient, not HBM traffic
+        dense = jax.lax.dot_general(
+            w_ref[:], tier_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        dense = scores_ref[:].astype(jnp.float32)
     lv = live_ref[0:1, :] > 0
     total = dense + sacc[...]
     total = jnp.where(lv & (total > 0), total, -jnp.inf)
@@ -311,12 +343,15 @@ def _fused_kernel(
     static_argnames=("t", "tile_n", "fine_n", "bud", "qsub", "interpret"),
 )
 def fused_tile_candidates(
-    scores,  # [Qc, Npad] bf16 | f32 dense-tier scores (padding cols = 0)
+    scores,  # [Qc, Npad] bf16 | f32 dense-tier scores (padding cols = 0),
+    #         OR None with (w, tstack) set: the matmul runs in-kernel
     live,  # [1, Npad] f32 (0 for dead/padding)
     keys,  # [Gpad/128, 128] i32 sorted window keys; rows % bud == 0, with
     #       >= 2*bud trailing sentinel rows (key = int32 max)
     vals,  # [Gpad/128, 128] i32 f32-bits of the per-posting partial scores
     ptr,  # [nsub*(njf+1)] i32 window starts (entry index) into keys/vals
+    w=None,  # [Qc, Vp2] bf16 [Wh | Wh] split query weights (matmul mode)
+    tstack=None,  # [Vp2, Npad] bf16 [T16; T16lo] stacked tier (matmul mode)
     *,
     t,
     bud,
@@ -328,8 +363,17 @@ def fused_tile_candidates(
     """-> (cand_v [Qc, njc*t] f32, cand_i [Qc, njc*t] i32, totals [Qc] i32,
     window_lost [Qc] bool). Per-tile top-t candidates by split-bf16
     selection (see EPS_SPLIT); totals exact. The global merge + saturation
-    flag happen in the caller."""
-    qc, n_pad = scores.shape
+    flag happen in the caller. With (w, tstack) instead of scores, the
+    dense matmul happens inside the kernel per doc tile (the
+    ES_TPU_FUSED_TOPK default): one grid step streams a [Vp2, tile_n] tier
+    block and a [qsub, Vp2] weight block through the MXU instead of
+    reading a precomputed score tile from HBM."""
+    matmul = scores is None
+    if matmul:
+        qc, vp2 = w.shape
+        n_pad = tstack.shape[1]
+    else:
+        qc, n_pad = scores.shape
     assert qc % qsub == 0 and n_pad % tile_n == 0 and tile_n % fine_n == 0
     nsub = qc // qsub
     njc = n_pad // tile_n
@@ -339,7 +383,7 @@ def fused_tile_candidates(
     kernel = functools.partial(
         _fused_kernel,
         t=t, tile_n=tile_n, fine_n=fine_n, bud=bud, qsub=qsub,
-        qb=qb, db=db, sb=sb, njc=njc, njf=njf,
+        qb=qb, db=db, sb=sb, njc=njc, njf=njf, matmul=matmul,
     )
     nblk = keys.shape[0] // bud
     # coarse window start block (units of bud rows), from the fine ptr
@@ -347,11 +391,19 @@ def fused_tile_candidates(
     ptrb = jnp.minimum(
         coarse_start.reshape(-1) // 128 // bud, nblk - 2
     ).astype(jnp.int32)
+    if matmul:
+        score_specs = [
+            pl.BlockSpec((qsub, vp2), lambda j, i, *_: (i, _I0)),
+            pl.BlockSpec((vp2, tile_n), lambda j, i, *_: (_I0, j)),
+        ]
+        score_ops = (w, tstack)
+    else:
+        score_specs = [pl.BlockSpec((qsub, tile_n), lambda j, i, *_: (i, j))]
+        score_ops = (scores,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(njc, nsub),
-        in_specs=[
-            pl.BlockSpec((qsub, tile_n), lambda j, i, *_: (i, j)),
+        in_specs=score_specs + [
             pl.BlockSpec((1, tile_n), lambda j, i, *_: (_I0, j)),
             pl.BlockSpec(
                 (bud, 128),
@@ -399,7 +451,7 @@ def fused_tile_candidates(
             )
         ),
         interpret=interpret,
-    )(ptr, ptrb, scores, live, keys, keys, vals, vals)
+    )(ptr, ptrb, *score_ops, live, keys, keys, vals, vals)
     cv = jnp.swapaxes(cv, 0, 1).reshape(qc, njc * t)
     ci = jnp.swapaxes(ci, 0, 1).reshape(qc, njc * t)
     return cv, ci, ot[:, 0].astype(jnp.int32), of[:, 0] > 0
@@ -541,6 +593,7 @@ def _fused_pipeline(
     *,
     k, n, n_pad, has_norms, k1, b, bud, t, tile_n, interpret,
     qsub=QSUB,
+    inkernel=False,
 ):
     """One fused chunk, fully on device. -> (v [Q,k], i, totals, flags)."""
     qc = dense_rows.shape[0]
@@ -609,9 +662,22 @@ def _fused_pipeline(
     Wh = _mask_hi(W).astype(jnp.bfloat16)
     if "tier16_stack" in fa:
         W2 = jnp.concatenate([Wh, Wh], axis=1)  # [Qc, 2V]
-        scores = jnp.matmul(
-            W2, fa["tier16_stack"], preferred_element_type=jnp.float32,
-        )
+        vp2 = fa["tier16_stack"].shape[0]
+        if vp2 > W2.shape[1]:  # stack rows are lane-padded (see _arrays)
+            W2 = jnp.pad(W2, ((0, 0), (0, vp2 - W2.shape[1])))
+        if inkernel:
+            # ES_TPU_FUSED_TOPK default: the dense matmul runs inside the
+            # kernel per doc tile; no [Qc, N] score array exists at all
+            cv, ci, totals, wlost = fused_tile_candidates(
+                None, fa["live"], keys2, vals2, ptr,
+                w=W2, tstack=fa["tier16_stack"],
+                t=t, bud=bud, tile_n=tile_n, qsub=qsub, interpret=interpret,
+            )
+            scores = None
+        else:
+            scores = jnp.matmul(
+                W2, fa["tier16_stack"], preferred_element_type=jnp.float32,
+            )
     else:
         scores = (
             jnp.matmul(Wh, fa["tier16"], preferred_element_type=jnp.float32)
@@ -619,10 +685,11 @@ def _fused_pipeline(
                 Wh, fa["tier16_lo"], preferred_element_type=jnp.float32
             )
         )
-    cv, ci, totals, wlost = fused_tile_candidates(
-        scores, fa["live"], keys2, vals2, ptr,
-        t=t, bud=bud, tile_n=tile_n, qsub=qsub, interpret=interpret,
-    )
+    if scores is not None:
+        cv, ci, totals, wlost = fused_tile_candidates(
+            scores, fa["live"], keys2, vals2, ptr,
+            t=t, bud=bud, tile_n=tile_n, qsub=qsub, interpret=interpret,
+        )
 
     # global top-K' over the per-tile candidates. An i64 (score, docid)
     # rank-key top_k over the WIDE candidate matrix costs ~13 ms/chunk;
@@ -690,6 +757,21 @@ class FusedTermSearcher:
         self._tile_n = _cfg_tile()
         self._qsub = _cfg_qsub()
         self._t_env = int(os.environ.get("ES_TPU_FUSED_T", 0))
+        # in-kernel matmul mode (ES_TPU_FUSED_TOPK, default ON): needs the
+        # stacked tier layout, and a tile width whose tier block fits VMEM
+        pack = self.searcher.pack
+        V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
+        self._vp2 = -(-2 * V // 128) * 128  # lane-padded [T16; T16lo] rows
+        if (fused_topk_enabled() and V
+                and os.environ.get("ES_TPU_FUSED_TILE") is None):
+            self._tile_n = min(
+                self._tile_n, auto_tile_matmul(self._vp2, self._qsub))
+        n_pad = -(-pack.num_docs // self._tile_n) * self._tile_n
+        self._use_stack = (
+            os.environ.get("ES_TPU_FUSED_STACK", "1") != "0"
+            and self._vp2 * n_pad * 2 <= 6 * 1024**3
+        )
+        self._inkernel = fused_topk_enabled() and self._use_stack and V > 0
 
     @staticmethod
     def usable(pack, k) -> bool:
@@ -725,18 +807,17 @@ class FusedTermSearcher:
                 "post_dls": dev["post_dls"],
             }
             V = dev["dense_tfn"].shape[0]
-            # [2V, n_pad] stacked tier [T16; T16lo] -> ONE dense matmul
-            # per chunk (the round-5 2-pass selection, _fused_pipeline);
-            # gate on the stack staying inside a 16 GB chip alongside
-            # tier32, postings, and per-execution score workspaces. Built
-            # by ONE jit straight from the f32 tier so the hi/lo parts
-            # never materialize as separate resident arrays (peak = tier32
-            # + stack, not + 2 intermediate copies).
-            stack_bytes = 2 * V * n_pad * 2
-            use_stack = (
-                os.environ.get("ES_TPU_FUSED_STACK", "1") != "0"
-                and stack_bytes <= 6 * 1024**3
-            )
+            # [vp2, n_pad] stacked tier [T16; T16lo] (rows lane-padded to
+            # 128 so the in-kernel matmul's blocks tile cleanly) -> ONE
+            # dense matmul per chunk (out-of-kernel mode) or the kernel's
+            # per-tile operand (in-kernel mode, ES_TPU_FUSED_TOPK); gate
+            # on the stack staying inside a 16 GB chip alongside tier32,
+            # postings, and per-execution score workspaces. Built by ONE
+            # jit straight from the f32 tier so the hi/lo parts never
+            # materialize as separate resident arrays (peak = tier32 +
+            # stack, not + 2 intermediate copies).
+            use_stack = self._use_stack
+            rpad = self._vp2 - 2 * V
 
             @jax.jit
             def split(t):
@@ -745,7 +826,8 @@ class FusedTermSearcher:
                 hi = hif.astype(jnp.bfloat16)
                 lo = (tp - hif).astype(jnp.bfloat16)
                 if use_stack:
-                    return (jnp.concatenate([hi, lo], axis=0),)
+                    st = jnp.concatenate([hi, lo], axis=0)
+                    return (jnp.pad(st, ((0, rpad), (0, 0))),)
                 return hi, lo
 
             if use_stack:
@@ -790,7 +872,8 @@ class FusedTermSearcher:
             64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
         )
         bud = bude // 128
-        key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t)
+        key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t,
+               self._inkernel)
         fn = self._cache.get(key)
         if fn is None:
             kw = dict(
@@ -798,7 +881,7 @@ class FusedTermSearcher:
                 has_norms=fld in self.searcher.ctx.has_norms,
                 k1=1.2, b=0.75,
                 bud=bud, t=t, tile_n=tile_n, qsub=qsub,
-                interpret=interpret,
+                interpret=interpret, inkernel=self._inkernel,
             )
 
             def scan_pipeline(fa, avgdl, rows, row_q, row_w, dr, dw):

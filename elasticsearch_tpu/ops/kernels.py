@@ -257,6 +257,219 @@ def scan_topk_xla(q, mat_t, live, aux_doc, aux_q, *, k, transform, count_positiv
 PALLAS_SCORE_BYTES_THRESHOLD = 1 << 31  # 2 GB
 
 
+def fused_topk_enabled() -> bool:
+    """ES_TPU_FUSED_TOPK (default on): route large matmul+top-k scans
+    through the tiered split-bf16 selection + f32 rescore path instead of
+    f32-HIGHEST matmuls / XLA TopK. '0' reverts every wired call site."""
+    return os.environ.get("ES_TPU_FUSED_TOPK", "auto") != "0"
+
+
+def _mask_hi(t):
+    """Truncate f32 to its top 16 bits (exactly bf16-representable) by
+    integer masking — an astype round-trip constant-folds away under
+    --xla_allow_excess_precision (see ops/fused.py EPS_SPLIT note)."""
+    bits = jax.lax.bitcast_convert_type(t, jnp.int32)
+    return jax.lax.bitcast_convert_type(bits & jnp.int32(-65536), jnp.float32)
+
+
+def split_bf16(mat: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """f32 matrix -> (hi, lo) bf16 pair carrying ~15 mantissa bits: the
+    selection-tier layout of the tiered scan (hi = masked top 16 bits,
+    lo = exact residual truncated to bf16)."""
+    hif = _mask_hi(mat)
+    return hif.astype(jnp.bfloat16), (mat - hif).astype(jnp.bfloat16)
+
+
+# relative slack of tiered split-bf16 selection vs the f32 rescore: the
+# query side is bf16-truncated (~2^-9 per element) while the mat side
+# carries ~15 mantissa bits — same regime as ops/fused.EPS_SPLIT, with
+# margin for the transform's score-space amplification
+EPS_TIERED = 2e-2
+# selection width: candidates carried to the f32 rescore (the KB-64
+# margin discipline of ops/fused.py)
+KB_TIERED = 64
+
+
+def _tiered_scan_kernel(
+    q_ref, mh_ref, ml_ref, live_ref, auxd_ref, auxq_ref,
+    ov_ref, oi_ref, ot_ref,
+    acc_v, acc_i, cnt,
+    *, kb, tile_n, transform, count_positive,
+):
+    """Per doc tile: split-bf16 matmul on the MXU (f32 accumulation) +
+    running top-kb selection in VMEM — the tiered arm of _scan_topk_kernel
+    (which runs 6-pass f32 HIGHEST for bit-parity; this arm trades that
+    for ~3x fewer MXU passes and rescores survivors outside)."""
+    j = pl.program_id(1)
+    nn = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_v[:] = jnp.full_like(acc_v, -jnp.inf)
+        acc_i[:] = jnp.zeros_like(acc_i)
+        cnt[:] = jnp.zeros_like(cnt)
+
+    dn = (((1,), (0,)), ((), ()))
+    dots = jax.lax.dot_general(
+        q_ref[:], mh_ref[:], dn, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        q_ref[:], ml_ref[:], dn, preferred_element_type=jnp.float32
+    )
+    scores = _apply_transform(dots, transform, auxd_ref[0, :], auxq_ref[:])
+    ids = j * tile_n + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    ok = live_ref[0, :] > 0
+    scores = jnp.where(ok[None, :], scores, -jnp.inf)
+    if count_positive:
+        # sign survives the split-bf16 rounding (BM25: every product is
+        # >= 0), so the tiered counts equal the exact counts
+        scores = jnp.where(scores > 0, scores, -jnp.inf)
+        cnt[:] += (scores > 0).astype(jnp.float32)
+    else:
+        cnt[:] += jnp.broadcast_to(ok[None, :], scores.shape).astype(
+            jnp.float32)
+    new_v, new_i = _merge_topk(scores, ids, acc_v[:], acc_i[:], kb)
+    acc_v[:] = new_v
+    acc_i[:] = new_i
+
+    @pl.when(j == nn - 1)
+    def _():
+        ov_ref[:] = acc_v[:]
+        oi_ref[:] = acc_i[:]
+        ot_ref[:] = jnp.sum(cnt[:], axis=1, keepdims=True).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kb", "transform", "count_positive", "interpret",
+                     "tiles"),
+)
+def _tiered_candidates_pallas(
+    qh, mat_hi, mat_lo, live, aux_doc, aux_q,
+    *, kb, transform, count_positive, interpret, tiles,
+):
+    B, D = qh.shape
+    N = mat_hi.shape[1]
+    tile_b, tile_n = tiles
+    qp = _pad_to(qh, tile_b, 0, 0)
+    mhp = _pad_to(mat_hi, tile_n, 1, 0)
+    mlp = _pad_to(mat_lo, tile_n, 1, 0)
+    livep = _pad_to(live.astype(jnp.float32)[None, :], tile_n, 1, 0.0)
+    auxdp = _pad_to(aux_doc[None, :], tile_n, 1, 0.0)
+    auxqp = _pad_to(aux_q[:, None], tile_b, 0, 0.0)
+    Bp, Np = qp.shape[0], mhp.shape[1]
+    nb, nn = Bp // tile_b, Np // tile_n
+    kernel = functools.partial(
+        _tiered_scan_kernel,
+        kb=kb, tile_n=tile_n, transform=transform,
+        count_positive=count_positive,
+    )
+    out_v, out_i, out_t = pl.pallas_call(
+        kernel,
+        grid=(nb, nn),
+        in_specs=[
+            pl.BlockSpec((tile_b, D), lambda i, j: (i, _I0)),
+            pl.BlockSpec((D, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((D, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j: (_I0, j)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_b, kb), lambda i, j: (i, _I0)),
+            pl.BlockSpec((tile_b, kb), lambda i, j: (i, _I0)),
+            pl.BlockSpec((tile_b, 1), lambda i, j: (i, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, kb), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, kb), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_b, kb), jnp.float32),
+            pltpu.VMEM((tile_b, kb), jnp.int32),
+            pltpu.VMEM((tile_b, tile_n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, mhp, mlp, livep, auxdp, auxqp)
+    return out_v[:B], out_i[:B], out_t[:B, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kb", "transform", "count_positive")
+)
+def _tiered_candidates_xla(
+    qh, mat_hi, mat_lo, live, aux_doc, aux_q,
+    *, kb, transform, count_positive,
+):
+    """XLA arm with the same selection semantics (non-TPU fast path; the
+    kernel arm is bit-comparable up to f32 accumulation order)."""
+    dots = (
+        jnp.matmul(qh, mat_hi, preferred_element_type=jnp.float32)
+        + jnp.matmul(qh, mat_lo, preferred_element_type=jnp.float32)
+    )
+    auxq = aux_q[:, None] if aux_q.ndim == 1 else aux_q
+    scores = _apply_transform(dots, transform, aux_doc, auxq)
+    scores = jnp.where(live[None, :] > 0, scores, -jnp.inf)
+    if count_positive:
+        scores = jnp.where(scores > 0, scores, -jnp.inf)
+        totals = jnp.sum(scores > 0, axis=1, dtype=jnp.int32)
+    else:
+        totals = jnp.broadcast_to(
+            jnp.sum(live > 0, dtype=jnp.int32), (scores.shape[0],)
+        )
+    sel_v, sel_i = jax.lax.top_k(scores, kb)
+    return sel_v, sel_i.astype(jnp.int32), totals
+
+
+def tiered_candidates(
+    q: jax.Array,  # [B, D] f32 query rows (weights / query vectors)
+    mat_hi: jax.Array,  # [D, N] bf16 hi tier (split_bf16)
+    mat_lo: jax.Array,  # [D, N] bf16 lo tier
+    live: jax.Array,  # [N] mask
+    kb: int,
+    *,
+    transform: str = "identity",
+    aux_doc: jax.Array | None = None,
+    aux_q: jax.Array | None = None,
+    count_positive: bool = True,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Tiered selection pass -> (sel_v [B, kb], sel_i [B, kb], totals [B]).
+
+    sel_v are SELECTION scores (split-bf16, within ~EPS_TIERED of f32);
+    callers must f32-rescore the sel_i candidates and apply the margin
+    safety test (see ops/vector.knn_topk / ops/batched dense tiered path)
+    before treating the ranking as exact. totals are exact (live counts,
+    or sign-exact positive counts — see the kernel comment)."""
+    B, N = q.shape[0], mat_hi.shape[1]
+    kb = max(1, min(kb, N))
+    if aux_doc is None:
+        aux_doc = jnp.zeros((N,), jnp.float32)
+    if aux_q is None:
+        aux_q = jnp.zeros((B,), jnp.float32)
+    qh = _mask_hi(q).astype(jnp.bfloat16)
+    tiles = (
+        _pick_tiles(B, q.shape[1], N, kb) if kb <= MAX_FUSED_K else None
+    )
+    if interpret is None:
+        if not use_pallas(score_bytes=4 * B * N) or tiles is None:
+            return _tiered_candidates_xla(
+                qh, mat_hi, mat_lo, live, aux_doc, aux_q,
+                kb=kb, transform=transform, count_positive=count_positive,
+            )
+        interpret = jax.default_backend() != "tpu"
+    if tiles is None:
+        return _tiered_candidates_xla(
+            qh, mat_hi, mat_lo, live, aux_doc, aux_q,
+            kb=kb, transform=transform, count_positive=count_positive,
+        )
+    return _tiered_candidates_pallas(
+        qh, mat_hi, mat_lo, live, aux_doc, aux_q,
+        kb=kb, transform=transform, count_positive=count_positive,
+        interpret=bool(interpret), tiles=tiles,
+    )
+
+
 def use_pallas(score_bytes: int | None = None) -> bool:
     flag = os.environ.get("ES_TPU_PALLAS", "auto")
     if flag == "0":

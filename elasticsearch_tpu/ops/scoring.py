@@ -132,9 +132,34 @@ def top_k_with_total(
     reproduces Lucene's (score, docid) tie-break order exactly
     (reference behavior: TopScoreDocCollector via
     search/query/QueryPhaseCollectorManager.java:416).
+
+    Behind ES_TPU_FUSED_TOPK (default on), large-corpus selection runs as
+    the streamed Pallas scan (ops/kernels.scan_topk streamed mode: one
+    bandwidth-bound pass holding the running top-k in VMEM) instead of
+    sort-based `lax.top_k` — identical (score desc, docid asc) order and
+    identical totals, so every per-query searcher (executor, the sharded
+    scatter/gather, C2's exhaustive fallback arm) rides the fused path.
+    'force' engages it on CPU through the interpreter (tests).
     """
+    import os
+
     n = live.shape[0]
     ok = match[:n] & live
+    mode = os.environ.get("ES_TPU_FUSED_TOPK", "auto")
+    from .kernels import MAX_FUSED_K
+
+    if mode != "0" and k <= MAX_FUSED_K and n >= 8:
+        force = mode == "force"
+        on_tpu = jax.default_backend() == "tpu"
+        if force or (on_tpu and n >= (1 << 18)):
+            from .kernels import scan_topk
+
+            v, i, t = scan_topk(
+                None, scores[:n][None, :], ok, k,
+                count_positive=False,
+                interpret=(not on_tpu) if force else False,
+            )
+            return v[0], i[0], t[0]
     total = jnp.sum(ok, dtype=jnp.int32)
     masked = jnp.where(ok, scores[:n], -jnp.inf)
     top_scores, top_ids = jax.lax.top_k(masked, k)

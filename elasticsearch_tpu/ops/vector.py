@@ -16,8 +16,135 @@ Score functions match the reference's `_score` conventions:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+
+
+def _aux_for(similarity: str, sq_norms, qvecs):
+    """(aux_doc [N], aux_q [B]) per the kernels._apply_transform contract."""
+    if similarity == "cosine":
+        aux_doc = 1.0 / jnp.maximum(jnp.sqrt(sq_norms), 1e-30)
+        aux_q = 1.0 / jnp.maximum(
+            jnp.sqrt(jnp.sum(qvecs * qvecs, axis=1)), 1e-30)
+        return aux_doc, aux_q
+    if similarity == "l2_norm":
+        return sq_norms, jnp.sum(qvecs * qvecs, axis=1)
+    return None, None
+
+
+@functools.partial(jax.jit, static_argnames=("similarity",))
+def _rescore_knn(qvecs, vectors, cand_i, cand_ok, aux_doc, aux_q,
+                 similarity: str):
+    """Exact f32 scores of the selection candidates: row-gather of the
+    candidate doc vectors (contiguous rows — the TPU-friendly gather
+    shape) + one einsum, then the shared transform."""
+    from .kernels import _apply_transform
+
+    cv = jnp.take(vectors, jnp.maximum(cand_i, 0), axis=0)  # [B, KB, D]
+    dots = jnp.einsum(
+        "bd,bkd->bk", qvecs, cv, precision=jax.lax.Precision.HIGHEST
+    )
+    auxd = (jnp.take(aux_doc, jnp.maximum(cand_i, 0))
+            if aux_doc is not None else jnp.zeros_like(dots))
+    auxq = (aux_q[:, None] if aux_q is not None
+            else jnp.zeros((dots.shape[0], 1), jnp.float32))
+    if similarity == "cosine":
+        scores = (1.0 + dots * auxd * auxq) / 2.0
+    elif similarity == "l2_norm":
+        l2 = jnp.maximum(auxd - 2.0 * dots + auxq, 0.0)
+        scores = 1.0 / (1.0 + l2)
+    else:
+        scores = _apply_transform(dots, similarity, jnp.zeros(()), 0.0)
+    return jnp.where(cand_ok, scores, -jnp.inf)
+
+
+_KNN_EPS = 2e-2  # selection-vs-rescore relative margin (kernels.EPS_TIERED)
+
+
+class TieredKnnScanner:
+    """Exact-kNN scan through the tiered split-bf16 kernel: per doc tile a
+    bf16 matmul pair (hi+lo — ~15 mantissa bits on the corpus side) feeds
+    a running in-VMEM top-KB selection; survivors are rescored in f32 and
+    re-ranked (score desc, docid asc). Queries whose top-k cannot be
+    separated from anything the selection could have dropped (margin test,
+    same discipline as ops/fused) fall back to the f32-HIGHEST scan_topk
+    path, so results are always exact. This replaces 6-pass f32-HIGHEST
+    scoring of the full corpus — the 1.9% MFU of VERDICT weak #6 — with
+    2 bf16 passes + a [B, KB, D] rescore."""
+
+    def __init__(self, vectors, sq_norms, similarity: str, live=None,
+                 kb: int | None = None, interpret: bool | None = None):
+        from .kernels import KB_TIERED, split_bf16
+
+        self.similarity = similarity
+        self.vectors = jnp.asarray(vectors, jnp.float32)  # [N, D]
+        self.sq_norms = jnp.asarray(sq_norms, jnp.float32)
+        N = self.vectors.shape[0]
+        self.live = (jnp.ones((N,), bool) if live is None
+                     else jnp.asarray(live))
+        self.kb = kb or KB_TIERED
+        self.interpret = interpret
+        mat_t = self.vectors.T  # [D, N]
+        self.mat_hi, self.mat_lo = jax.jit(split_bf16)(mat_t)
+        self.mat_t = mat_t  # exact fallback operand
+
+    def search(self, qvecs, k: int):
+        """-> (scores [B, k], ids [B, k], totals [B], first_pass_ok [B])
+        numpy; exact (flagged queries re-run on the f32 scan)."""
+        import numpy as np
+
+        from .kernels import scan_topk, tiered_candidates
+
+        qvecs = jnp.asarray(qvecs, jnp.float32)
+        aux_doc, aux_q = _aux_for(self.similarity, self.sq_norms, qvecs)
+        kb = max(self.kb, k)
+        sel_v, sel_i, totals = tiered_candidates(
+            qvecs, self.mat_hi, self.mat_lo, self.live, kb,
+            transform=self.similarity, aux_doc=aux_doc, aux_q=aux_q,
+            count_positive=False, interpret=self.interpret,
+        )
+        cand_ok = jnp.isfinite(sel_v)
+        resc = _rescore_knn(
+            qvecs, self.vectors, sel_i, cand_ok, aux_doc, aux_q,
+            self.similarity,
+        )
+        # exact (score desc, docid asc): ascending sort on (-score, id)
+        neg, ids = jax.lax.sort(
+            (jnp.where(cand_ok, -resc, jnp.inf), sel_i), num_keys=2
+        )
+        k_eff = min(k, kb)
+        v = -neg[:, :k_eff]
+        i = ids[:, :k_eff]
+        # margin safety: the k-th rescored score must clear everything the
+        # selection pass could have dropped (bounded by the kb-th selection
+        # score inflated by the split error), or the selection must have
+        # kept every candidate (kb-th lane empty / rescored-min tie)
+        sel_kb = sel_v[:, -1]
+        am_resc = jnp.min(jnp.where(cand_ok, resc, jnp.inf), axis=1)
+        rk = v[:, k_eff - 1]
+        bound = sel_kb + _KNN_EPS * jnp.abs(sel_kb) + 1e-6
+        safe = jnp.isneginf(sel_kb) | (rk > bound) | (rk == am_resc)
+        # np.array (copy): device_get can hand back read-only views, and
+        # the flagged-query fallback writes rows in place
+        v, i, totals, safe = (np.array(x) for x in
+                              jax.device_get((v, i, totals, safe)))
+        if k > k_eff:
+            pad = ((0, 0), (0, k - k_eff))
+            v = np.pad(v, pad, constant_values=-np.inf)
+            i = np.pad(i, pad)
+        if not safe.all():
+            flagged = np.nonzero(~safe)[0]
+            fv, fi, _ft = scan_topk(
+                qvecs[flagged], self.mat_t, self.live, k,
+                transform=self.similarity, aux_doc=aux_doc,
+                aux_q=None if aux_q is None else aux_q[flagged],
+                count_positive=False, interpret=self.interpret,
+            )
+            v[flagged] = np.asarray(fv)
+            i[flagged] = np.asarray(fi)
+        return v, i, np.asarray(totals), safe
 
 
 def knn_scores(

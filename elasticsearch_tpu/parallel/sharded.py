@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.scoring import top_k_with_total
 from ..query.dsl import parse_query
+from ..utils.jax_env import shard_map
 from ..utils.errors import IllegalArgumentError
 from ..query.nodes import ExecContext, QueryNode
 from .stacked import StackedPack
@@ -258,7 +259,7 @@ class StackedSearcher:
                     outs = shard_body(sq(dev_s), sq(par_s), sq(agg_s))
                     return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
 
-                return jax.shard_map(
+                return shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=(P("shards"), P("shards"), P("shards")),
@@ -465,7 +466,7 @@ class StackedSearcher:
                     outs = shard_body(sq(dev_s), sq(par_s))
                     return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
 
-                return jax.shard_map(
+                return shard_map(
                     body, mesh=self.mesh,
                     in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
                 )(dev, params)
@@ -566,7 +567,7 @@ class StackedSearcher:
                         outs = shard_body(sq(dev_s), sq(par_s))
                         return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
 
-                    return jax.shard_map(
+                    return shard_map(
                         body, mesh=self.mesh,
                         in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
                     )(dev, params)
@@ -1108,7 +1109,7 @@ class StackedSearcher:
                     outs = shard_body(sq(dev_s), sq(par_s), after_s, sq(agg_s))
                     return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
 
-                return jax.shard_map(
+                return shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=(P("shards"), P("shards"), P(), P("shards")),
@@ -1227,8 +1228,26 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     runs under vmap; on a mesh the gather of the [S, Q, k] partials rides
     ICI collectives.
 
+    With the fused kernel eligible (dense tier present, k <= 16,
+    ES_TPU_FUSED on TPU or forced), each shard runs the fused tiled
+    pipeline (ops/fused._fused_pipeline — in-kernel dense matmul +
+    per-tile top-t + canonical f32 rescore) instead of the legacy
+    disjunction kernel; queries flagged by any shard re-run on the legacy
+    exact arm, so results never depend on the fused pass.
+
     -> (scores [Q, k], shard [Q, k], docid [Q, k], totals [Q]) numpy.
     """
+    fs = _fused_sharded_for(ss)
+    if fs is not None and not _return_program and fs.usable(k):
+        return fs.msearch(fld, queries, k)
+    return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
+
+
+def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,
+                           queries: list, k: int = 10,
+                           _return_program=False):
+    """The legacy exact arm: batched disjunction kernel per shard (also
+    the escalation target of the fused arm's flagged queries)."""
     from ..ops.batched import BatchTermSearcher, batch_term_disjunction
 
     sp = ss.sp
@@ -1283,7 +1302,7 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
         if ss.mesh is not None:
             def run(dev, W_, rows_, ws_):
                 specs = jax.tree_util.tree_map(lambda _: P("shards"), dev)
-                return jax.shard_map(
+                return shard_map(
                     shard_body, mesh=ss.mesh,
                     in_specs=(specs, P("shards"), P("shards"), P("shards")),
                     out_specs=(P("shards"), P("shards"), P("shards")),
@@ -1330,3 +1349,239 @@ class _PlanShardAdapter:
         self.pack = sp.shard_view(s)
         self.ctx = ss.ctx
         self.dev = {}
+
+
+def _fused_sharded_for(ss: "StackedSearcher"):
+    """Cached fused-msearch arm for a StackedSearcher, or None when the
+    pack shape can never qualify (no dense tier / no pallas)."""
+    from ..ops import fused as F
+
+    if F.pltpu is None or F.fused_enabled() == "0":
+        return None
+    if getattr(ss.sp, "dense_tf", None) is None or "dense_tfn" not in ss.dev:
+        return None
+    fs = getattr(ss, "_fused_msearch", None)
+    if fs is None:
+        fs = ss._fused_msearch = _FusedShardedMsearch(ss)
+    return fs
+
+
+class _FusedShardedMsearch:
+    """C5 `_msearch` through the fused kernel, one pipeline per shard.
+
+    The same `ops/fused._fused_pipeline` program that serves single-shard
+    C1 runs as the SPMD shard body here: per shard, the in-kernel dense
+    matmul + per-tile top-t + one-hot sparse scatter + canonical f32
+    rescore (lax.scan over QC-query chunks), with the [S, Q, k] partials
+    gathered and merged by the coordinator in (score desc, shard asc,
+    doc asc) order. Queries flagged by ANY shard (window overflow, tile
+    saturation, margin test) re-run on the legacy exact arm, so results
+    never depend on the fused pass — the same escalation contract as
+    FusedTermSearcher."""
+
+    def __init__(self, ss: "StackedSearcher"):
+        from ..ops import fused as F
+
+        self.ss = ss
+        sp = ss.sp
+        self.S = sp.S
+        V = sp.dense_v
+        # geometry snapshot (one per searcher — see FusedTermSearcher)
+        self._qsub = F._cfg_qsub()
+        self._tile_n = F._cfg_tile()
+        self._t_env = int(os.environ.get("ES_TPU_FUSED_T", 0))
+        self._vp2 = -(-2 * V // 128) * 128
+        if (F.fused_topk_enabled() and V
+                and os.environ.get("ES_TPU_FUSED_TILE") is None):
+            self._tile_n = min(
+                self._tile_n, F.auto_tile_matmul(self._vp2, self._qsub))
+        self.n_max = sp.n_max
+        self.n_pad = -(-max(sp.n_max, 1) // self._tile_n) * self._tile_n
+        # the sharded arm runs stacked-tier-only (one resident layout per
+        # chip); a stack too large for its chip disqualifies the arm
+        self._use_stack = (
+            os.environ.get("ES_TPU_FUSED_STACK", "1") != "0"
+            and self._vp2 * self.n_pad * 2 <= 6 * 1024**3
+        )
+        self._inkernel = F.fused_topk_enabled() and self._use_stack
+        self._fa = None
+        self._fa_live_of = None
+        self._fa_tier_of = None
+        self._cache: dict = {}
+
+    def usable(self, k: int) -> bool:
+        from ..ops import fused as F
+
+        mode = F.fused_enabled()
+        if not (0 < k <= 16) or not self._use_stack:
+            return False
+        if self.n_max > F.MAX_DOCS_FUSED or self.n_max < 1:
+            return False
+        if mode == "force":
+            return True
+        return (jax.default_backend() == "tpu"
+                and self.n_max >= 4 * F.FINE_N)
+
+    def _arrays(self):
+        from ..ops import fused as F
+
+        dev = self.ss.dev
+        if self._fa is None or self._fa_tier_of is not dev["dense_tfn"]:
+            padw = self.n_pad - self.n_max
+            rpad = self._vp2 - 2 * self.ss.sp.dense_v
+
+            @jax.jit
+            def split(t):  # [S, V, n_max] scored tfn -> [S, vp2, n_pad]
+                tp = jnp.pad(t, ((0, 0), (0, 0), (0, padw)))
+                hif = F._mask_hi(tp)
+                hi = hif.astype(jnp.bfloat16)
+                lo = (tp - hif).astype(jnp.bfloat16)
+                st = jnp.concatenate([hi, lo], axis=1)
+                return jnp.pad(st, ((0, 0), (0, rpad), (0, 0)))
+
+            self._fa = {
+                "tier32": dev["dense_tfn"],
+                "post_docids": dev["post_docids"],
+                "post_tfs": dev["post_tfs"],
+                "post_dls": dev["post_dls"],
+                "tier16_stack": split(dev["dense_tfn"]),
+            }
+            self._fa_tier_of = dev["dense_tfn"]
+            self._fa_live_of = None  # force the live rebuild below
+        if self._fa_live_of is not dev["live"]:
+            padw = self.n_pad - self.n_max
+            self._fa["live"] = jnp.pad(
+                dev["live"].astype(jnp.float32), ((0, 0), (0, padw))
+            )[:, None, :]
+            self._fa_live_of = dev["live"]
+        return self._fa
+
+    def _compiled(self, fld, C, R, Td, k, nreal, interpret):
+        from ..index.pack import BLOCK
+        from ..ops import fused as F
+
+        tile_n, qsub = self._tile_n, self._qsub
+        njc = self.n_pad // tile_n
+        t = self._t_env if self._t_env > 0 else F.tile_t_for(njc)
+        nreal_q = 1 << max(nreal - 1, 1).bit_length()
+        mean_win = max(1, nreal_q * BLOCK // ((F.QC // qsub) * njc))
+        bude = min(
+            64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
+        )
+        bud = bude // 128
+        key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t,
+               self._inkernel, self.ss.mesh is None)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+        kw = dict(
+            k=k, n=self.n_max, n_pad=self.n_pad,
+            has_norms=fld in self.ss.ctx.has_norms,
+            k1=1.2, b=0.75,
+            bud=bud, t=t, tile_n=tile_n, qsub=qsub,
+            interpret=interpret, inkernel=self._inkernel,
+        )
+
+        def shard_scan(fa1, avgdl, rows, row_q, row_w, dr, dw):
+            def body(carry, xs):
+                return carry, F._fused_pipeline(fa1, avgdl, *xs, **kw)
+
+            _, outs = jax.lax.scan(body, 0, (rows, row_q, row_w, dr, dw))
+            return outs
+
+        if self.ss.mesh is not None:
+            import jax.tree_util as jtu
+
+            def run(fa, avgdl, rows, row_q, row_w, dr, dw):
+                def body(fa_s, avgdl_s, rows_s, rq_s, rw_s, dr_s, dw_s):
+                    sq = lambda t_: jtu.tree_map(lambda x: x[0], t_)
+                    outs = shard_scan(
+                        sq(fa_s), avgdl_s, rows_s[0], rq_s[0], rw_s[0],
+                        dr_s[0], dw_s[0])
+                    return jtu.tree_map(lambda x: x[None], outs)
+
+                return shard_map(
+                    body, mesh=self.ss.mesh,
+                    in_specs=(P("shards"), P()) + (P("shards"),) * 5,
+                    out_specs=P("shards"),
+                )(fa, avgdl, rows, row_q, row_w, dr, dw)
+        else:
+
+            def run(fa, avgdl, rows, row_q, row_w, dr, dw):
+                return jax.vmap(
+                    shard_scan, in_axes=(0, None, 0, 0, 0, 0, 0)
+                )(fa, avgdl, rows, row_q, row_w, dr, dw)
+
+        fn = self._cache[key] = jax.jit(run)
+        return fn
+
+    def msearch(self, fld, queries, k):
+        from ..ops import fused as F
+
+        ss = self.ss
+        sp = ss.sp
+        S = self.S
+        Q = len(queries)
+        qc = F.QC
+        idxs = [np.arange(s0, min(s0 + qc, Q)) for s0 in range(0, Q, qc)]
+        views = [sp.shard_view(s) for s in range(S)]
+        plans = [
+            [F.plan_fused(v, fld, [queries[i] for i in qidx], k, qc=qc)
+             for qidx in idxs]
+            for v in views
+        ]  # [S][C]
+        C = len(idxs)
+        R = max(p.rows.shape[0] for ps in plans for p in ps)
+        Td = max(p.dense_rows.shape[1] for ps in plans for p in ps)
+        nreal = max(p.nreal for ps in plans for p in ps)
+
+        def _padr(a, width):
+            return np.pad(
+                a, [(0, width - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+
+        rows = np.stack([[_padr(p.rows, R) for p in ps] for ps in plans])
+        row_q = np.stack([[_padr(p.row_q, R) for p in ps] for ps in plans])
+        row_w = np.stack([[_padr(p.row_w, R) for p in ps] for ps in plans])
+        dr = np.stack([
+            [np.pad(p.dense_rows,
+                    ((0, 0), (0, Td - p.dense_rows.shape[1])))
+             for p in ps] for ps in plans])
+        dw = np.stack([
+            [np.pad(p.dense_w, ((0, 0), (0, Td - p.dense_w.shape[1])))
+             for p in ps] for ps in plans])
+        interpret = jax.default_backend() != "tpu"
+        fn = self._compiled(fld, C, R, Td, k, nreal, interpret)
+        avgdl = np.float32(views[0].avgdl(fld))
+        v, i, t, fl = jax.device_get(
+            fn(self._arrays(), avgdl, rows, row_q, row_w, dr, dw))
+        # [S, C, qc, ...] -> per-shard [S, Q, ...]
+        kk = v.shape[-1]
+        scores = np.full((S, Q, kk), -np.inf, np.float32)
+        ids = np.zeros((S, Q, kk), np.int64)
+        totals = np.zeros((S, Q), np.int64)
+        flagged = np.zeros((Q,), bool)
+        for ci, qidx in enumerate(idxs):
+            nq = len(qidx)
+            scores[:, qidx] = v[:, ci, :nq]
+            ids[:, qidx] = i[:, ci, :nq]
+            totals[:, qidx] = t[:, ci, :nq]
+            flagged[qidx] |= fl[:, ci, :nq].any(axis=0)
+        # coordinator merge: (score desc, shard asc, doc asc)
+        flat_v = scores.transpose(1, 0, 2).reshape(Q, -1)
+        flat_i = ids.transpose(1, 0, 2).reshape(Q, -1)
+        flat_s = np.broadcast_to(
+            np.repeat(np.arange(S), kk)[None, :], flat_v.shape)
+        order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :kk]
+        out_v = np.take_along_axis(flat_v, order, axis=1)
+        out_s = np.take_along_axis(flat_s, order, axis=1).astype(np.int32)
+        out_i = np.take_along_axis(flat_i, order, axis=1)
+        out_t = totals.sum(axis=0)
+        if flagged.any():
+            still = np.nonzero(flagged)[0]
+            ev, es, ei, et = _msearch_sharded_exact(
+                self.ss, fld, [queries[i_] for i_ in still], k)
+            out_v[still, : ev.shape[1]] = ev
+            out_s[still, : ev.shape[1]] = es
+            out_i[still, : ev.shape[1]] = ei
+            out_t[still] = et
+        return out_v, out_s, out_i, out_t

@@ -368,6 +368,12 @@ class StackedPack:
     def num_docs(self) -> int:
         return sum(p.num_docs for p in self.shards)
 
+    @property
+    def dense_v(self) -> int:
+        """Dense-tier row count (0 = no tier) — the fused-kernel geometry
+        input shared by the single-shard and sharded fused searchers."""
+        return 0 if self.dense_tf is None else self.dense_tf.shape[1]
+
     def shard_view(self, s: int) -> _ShardView:
         return _ShardView(self.shards[s], self, s)
 
@@ -404,6 +410,12 @@ class StackedPack:
             # the searcher materializes the derived dense_tfn alongside the
             # raw tf rows on device — admit both copies
             total += self.dense_tf.nbytes
+            from ..ops.fused import fused_enabled
+
+            if fused_enabled() != "0":
+                # the fused msearch arm holds the split-bf16 [2V, n_pad]
+                # stack per shard too (~the f32 tier's bytes again)
+                total += self.dense_tf.nbytes
         self._nbytes_cache = total
         return total
 

@@ -128,6 +128,26 @@ class ShardSearcher:
 
     # -- entry points ------------------------------------------------------
 
+    def batched(self):
+        """Cached BatchTermSearcher over this shard's device pack — the
+        `_msearch` fast path. Its dense tier rides the fused Pallas
+        kernel (in-kernel split-bf16 matmul + per-tile top-t + canonical
+        f32 rescore) whenever ES_TPU_FUSED / ES_TPU_FUSED_TOPK and the
+        pack shape allow; per-query `search` keeps the compiled-plan
+        path, whose final selection also streams through the fused
+        scan (ops/scoring.top_k_with_total)."""
+        bs = getattr(self, "_batched", None)
+        if bs is None:
+            from ..ops.batched import BatchTermSearcher
+
+            bs = self._batched = BatchTermSearcher(self)
+        return bs
+
+    def msearch(self, fld: str, queries, k: int = 10, **kw):
+        """Batched term-disjunction `_msearch` -> (scores, docids, totals,
+        first_pass_exact) numpy (see BatchTermSearcher.msearch)."""
+        return self.batched().msearch(fld, queries, k, **kw)
+
     def search(
         self,
         query: dict | QueryNode | None,

@@ -328,3 +328,57 @@ def test_op_log_compaction_and_late_replica_resync(cluster_full):
             break
         time.sleep(0.25)
     assert st == 200 and r["_source"]["v"] == 99
+
+
+def test_poisoned_replica_refuses_engine_dump(cluster_full):
+    """A replica with `failed` set must not serve `engine:dump`: its
+    engine stopped mid-log (possibly diverged), and a resyncing peer
+    restoring that state would fork. The dump returns an error payload
+    and _resync fails over to a healthy peer."""
+    import asyncio
+    import time
+
+    servers, gateways = cluster_full
+    _wait(gateways["f1"].port,
+          lambda h: h.get("master_node") and h.get("number_of_nodes") == 3)
+    port = gateways["f1"].port
+    st, _ = _http("PUT", port, "/p", {
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert st == 200
+    for i in range(40):
+        st, _ = _http("PUT", port, f"/p/_doc/{i}?refresh=true", {"v": i})
+        assert st in (200, 201)
+    # wait for compaction so a fresh replica MUST resync from a peer
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        s = servers["f1"].node.state
+        if s.engine_ops_base >= 40 and len(s.engine_ops) <= 2:
+            break
+        time.sleep(0.25)
+    assert servers["f1"].node.state.engine_ops_base >= 40
+
+    # poison f1 — the alphabetically-first peer, which _resync would
+    # otherwise pick first — and check the dump refusal directly
+    g1 = gateways["f1"]
+    g1.replica.failed = "injected: apply failed at op 7 (post-send)"
+    dump = asyncio.run_coroutine_threadsafe(
+        g1.replica._make_dump(), g1._loop).result(timeout=10)
+    assert "error" in dump and "poisoned" in dump["error"]
+    assert "store" not in dump
+
+    # a fresh f3 replica resyncs by failing over to the healthy f2
+    gateways["f3"].close()
+    gateways["f3"] = HttpGateway(servers["f3"], surface="full").start()
+    p3 = gateways["f3"].port
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            st, r = _http("GET", p3, "/p/_count", timeout=5.0)
+            if st == 200 and r.get("count") == 40:
+                ok = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert ok, "resync must fail over to the healthy peer"

@@ -22,7 +22,7 @@ def _force_fused():
 from elasticsearch_tpu.index.mappings import Mappings
 from elasticsearch_tpu.index.pack import PackBuilder
 from elasticsearch_tpu.ops.batched import BatchTermSearcher
-from elasticsearch_tpu.ops.fused import FusedTermSearcher, plan_fused
+from elasticsearch_tpu.ops.fused import FINE_N, FusedTermSearcher, plan_fused
 from elasticsearch_tpu.query.executor import ShardSearcher
 
 from reference_scorer import Oracle
@@ -192,3 +192,105 @@ def test_plan_fused_block_row_layout(corpus):
     assert (plan.row_w[plan.rows == 0] == 0).all()
     # block rows reference real CSR ranges of their terms
     assert plan.rows.max() < pack.post_docids.shape[0]
+
+
+def test_fused_inkernel_matmul_engaged(corpus):
+    """The ES_TPU_FUSED_TOPK default routes the dense tier through the
+    in-kernel matmul (stacked tier built, no [Qc, N] score matrix)."""
+    m, pack, searcher, oracle, rng = corpus
+    bts = BatchTermSearcher(searcher)
+    fs = FusedTermSearcher(bts)
+    assert fs._inkernel, "in-kernel matmul must be the default"
+    fs.msearch("body", _queries(rng, 4), 10)
+    assert "tier16_stack" in fs._fa
+    # lane-padded stack rows: multiple of 128, >= 2V
+    V = pack.dense_tfn.shape[0]
+    assert fs._fa["tier16_stack"].shape[0] == fs._vp2 >= 2 * V
+    assert fs._fa["tier16_stack"].shape[0] % 128 == 0
+
+
+def test_fused_tile_boundary_doc_counts(corpus):
+    """Parity at doc counts that are NOT a tile multiple: the padding
+    columns (dead live lanes) must never become candidates. The module
+    corpus (4000 docs) already sits off every tile boundary; this drills
+    smaller N by restricting live to a prefix crossing one tile edge."""
+    m, pack, searcher, oracle, rng = corpus
+    old_live = pack.live
+    try:
+        for n_live in (FINE_N - 1, FINE_N + 1, 2 * FINE_N + 37):
+            live = old_live.copy()
+            live[n_live:] = False
+            pack.live = live
+            s2 = ShardSearcher(pack, mappings=m)
+            fs2 = FusedTermSearcher(BatchTermSearcher(s2))
+            queries = _queries(rng, 6)
+            fv, fi, ft, _ = fs2.msearch("body", queries, 10)
+            assert (fi[np.isfinite(fv)] < n_live).all()
+            for q, terms in enumerate(queries):
+                ranked_all, _ = oracle.search(_oracle_query(terms),
+                                              size=N_DOCS)
+                alive = [(d, sc) for d, sc in ranked_all if d < n_live]
+                mask = np.isfinite(fv[q])
+                _assert_ranking(fi[q][mask], fv[q][mask], alive[:10],
+                                (n_live, q))
+                assert ft[q] == len(alive)
+    finally:
+        pack.live = old_live
+
+
+def test_fused_k_exceeds_matches_and_all_zero_queries(corpus):
+    """k > matching docs pads with -inf columns; a batch whose queries
+    all miss the vocabulary returns zero totals and no finite scores."""
+    m, pack, searcher, oracle, rng = corpus
+    fs = FusedTermSearcher(BatchTermSearcher(searcher))
+    # a rare term with df << k=10 would not exercise the pad; use an
+    # absent-term query mixed with a rare term
+    queries = [
+        [("zz_nope", 1.0)],
+        [("zz_nope", 1.0), ("zz_also_nope", 2.0)],
+        [(f"t{VOCAB-1}", 1.0)],  # rarest real term
+    ]
+    fv, fi, ft, _ = fs.msearch("body", queries, 10)
+    assert ft[0] == 0 and ft[1] == 0
+    assert not np.isfinite(fv[0]).any() and not np.isfinite(fv[1]).any()
+    ranked, total = oracle.search(_oracle_query(queries[2]), size=10)
+    mask = np.isfinite(fv[2])
+    assert mask.sum() == min(total, 10)
+    _assert_ranking(fi[2][mask], fv[2][mask], ranked, ("rare",))
+
+
+def test_fused_msearch_sharded_parity():
+    """The sharded `_msearch` fused arm (C5 path) matches the legacy
+    exact arm on both the vmap and mesh executions."""
+    from elasticsearch_tpu.parallel.sharded import (
+        StackedSearcher, _msearch_sharded_exact, make_mesh, msearch_sharded,
+    )
+    from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+
+    rng = np.random.default_rng(11)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    zipf /= zipf.sum()
+    docs = []
+    for i in range(2500):
+        ln = max(3, int(rng.poisson(10)))
+        docs.append((f"d{i}", {"body": " ".join(
+            f"t{t}" for t in rng.choice(VOCAB, size=ln, p=zipf))}))
+    sp = build_stacked_pack(docs, m, num_shards=4, dense_min_df=48)
+    queries = [
+        [(f"t{t}", 1.0) for t in dict.fromkeys(rng.integers(0, VOCAB, 4))]
+        for _ in range(16)
+    ]
+    for mesh in (None, make_mesh(4)):
+        ss = StackedSearcher(sp, mesh=mesh)
+        fv, fsh, fi, ft = msearch_sharded(ss, "body", queries, 10)
+        ev, esh, ei, et = _msearch_sharded_exact(ss, "body", queries, 10)
+        assert np.array_equal(ft, et)
+        for q in range(len(queries)):
+            fm, em = np.isfinite(fv[q]), np.isfinite(ev[q])
+            assert fm.sum() == em.sum(), (mesh is not None, q)
+            for pos in range(int(fm.sum())):
+                if (fi[q][pos], fsh[q][pos]) != (ei[q][pos], esh[q][pos]):
+                    a, b = float(fv[q][pos]), float(ev[q][pos])
+                    assert abs(a - b) <= 1e-5 * max(abs(b), 1.0), (
+                        mesh is not None, q, pos)

@@ -120,3 +120,55 @@ def test_unaligned_shapes(rng):
     mat = rng.normal(size=(D, N)).astype(np.float32)
     live = rng.random(N) > 0.5
     _check(*_run_both(q, mat, live, k, count_positive=False))
+
+
+def test_top_k_with_total_fused_streamed(rng, monkeypatch):
+    """ES_TPU_FUSED_TOPK=force routes per-query top-k selection through
+    the streamed Pallas scan (interpret on CPU) with identical
+    (score desc, docid asc) order and totals — the wiring that puts the
+    executor / sharded searchers / C2 exhaustive arm on the fused path."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.scoring import top_k_with_total
+
+    n, k = 700, 9
+    scores = jnp.asarray(
+        np.round(rng.normal(size=n + 1), 2).astype(np.float32))  # many ties
+    match = jnp.asarray(rng.random(n + 1) > 0.2)
+    live = jnp.asarray(rng.random(n) > 0.3)
+    monkeypatch.setenv("ES_TPU_FUSED_TOPK", "0")
+    wv, wi, wt = [np.asarray(x)
+                  for x in top_k_with_total(scores, match, live, k)]
+    monkeypatch.setenv("ES_TPU_FUSED_TOPK", "force")
+    gv, gi, gt = [np.asarray(x)
+                  for x in top_k_with_total(scores, match, live, k)]
+    np.testing.assert_array_equal(gv, wv)
+    finite = np.isfinite(wv)
+    np.testing.assert_array_equal(gi[finite], wi[finite])
+    assert gt == wt
+
+
+def test_tiered_candidates_matches_xla_arm(rng):
+    """Pallas (interpret) and XLA arms of the tiered selection agree."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.kernels import (
+        split_bf16, tiered_candidates,
+    )
+
+    B, D, N, kb = 6, 32, 900, 16
+    q = rng.normal(size=(B, D)).astype(np.float32)
+    mat = np.abs(rng.normal(size=(D, N))).astype(np.float32)
+    hi, lo = split_bf16(jnp.asarray(mat))
+    live = rng.random(N) > 0.25
+    got = tiered_candidates(
+        jnp.asarray(q), hi, lo, jnp.asarray(live), kb,
+        count_positive=True, interpret=True,
+    )
+    want = tiered_candidates(
+        jnp.asarray(q), hi, lo, jnp.asarray(live), kb,
+        count_positive=True, interpret=None,  # CPU -> XLA arm
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-6, atol=1e-7)

@@ -158,3 +158,32 @@ def test_standard_index_keeps_dimension_mapping_inert(eng):
     idx.index_doc("1", _doc("2099-01-01T00:00:00Z", "u1"))  # no bounds
     m = idx.mappings.to_dict()
     assert m["properties"]["metricset"]["time_series_dimension"] is True
+
+
+def test_wildcard_routing_path_extracts_fields(eng):
+    """`k8s.pod.*` in routing_path must expand against the mapped field
+    names (IndexRouting.ExtractFromSource pattern list) — before the fix
+    the literal pattern extracted nothing and every write failed."""
+    settings = dict(TS_SETTINGS)
+    settings["routing_path"] = ["metricset", "k8s.pod.u*"]
+    idx = eng.create_index("wild", TS_MAPPINGS, settings)
+    r1 = idx.index_doc(None, _doc("2021-04-28T18:50:04Z", "uid-a"))
+    assert r1["result"] == "created"
+    # same dimensions -> same shard, wildcard or literal
+    mode = idx.ts_mode
+    assert mode._routing_fields() == ["k8s.pod.uid", "metricset"]
+    s1 = mode.shard_of(_doc("2021-04-28T18:50:04Z", "uid-a"), 2)
+    s2 = mode.shard_of(_doc("2021-04-28T19:50:04Z", "uid-a"), 2)
+    assert s1 == s2
+    # a doc carrying no routing fields still errors
+    with pytest.raises(IllegalArgumentError, match="routing fields"):
+        mode.shard_of({"@timestamp": "2021-04-28T18:50:04Z"}, 2)
+
+
+def test_wildcard_routing_path_validation_still_applies(eng):
+    """A wildcard matching a non-dimension mapped field keeps failing
+    validation (IndexMode.validateRoutingPath)."""
+    settings = dict(TS_SETTINGS)
+    settings["routing_path"] = ["k8s.pod.n*"]  # matches `name`, no dim
+    with pytest.raises(IllegalArgumentError, match="time_series_dimension"):
+        eng.create_index("badwild", TS_MAPPINGS, settings)
