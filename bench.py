@@ -219,6 +219,16 @@ def config1_match(searcher, m, lens, tok, rng):
     ]))
     totals_parity = float(np.mean((tf_ == te) | (tf_ >= 10_000)))
 
+    # ---- repeated-query (shard request cache) arm -----------------------
+    # real query streams are heavily repetitive; the request cache
+    # (elasticsearch_tpu/cache/) serves warm queries host-side without a
+    # device dispatch. ShardSearcher.msearch is the cache-fronted entry
+    # (bs.msearch above deliberately bypasses it so the headline numbers
+    # stay uncached). Compile warmth comes from a DIFFERENT query set, so
+    # the cold pass is post-compile but cache-cold.
+    cache_arm = _cache_arm(searcher, lens, tok, rng)
+    log(f"[c1] request-cache arm: {cache_arm}")
+
     # utilization accounting: logical dense-tier matmul flops + HBM traffic
     flops = 2.0 * total_q * V * N_DOCS
     mfu = flops / elapsed / PEAK_BF16_FLOPS
@@ -249,6 +259,46 @@ def config1_match(searcher, m, lens, tok, rng):
         "totals_contract": totals_parity,
         "dense_matmul_mfu": round(mfu, 4),
         "hbm_utilization": round(hbm_util, 3),
+        "request_cache": cache_arm,
+    }
+
+
+def _cache_arm(searcher, lens, tok, rng, n_q=512):
+    """Cached-vs-uncached QPS + hit rate for a repeated query batch
+    through the cache-fronted msearch entry (ShardSearcher.msearch)."""
+    from elasticsearch_tpu.cache import request_cache
+
+    rc = request_cache()
+    if not rc.enabled:
+        return {"enabled": False}
+    warm_q = sample_queries(rng, lens, tok, n_q)
+    searcher.msearch("body", warm_q, TOP_K)  # compile-warm, cache-cold next
+    rq = sample_queries(rng, lens, tok, n_q)
+    st0 = rc.stats()
+    t0 = time.perf_counter()
+    cold = searcher.msearch("body", rq, TOP_K)
+    t_cold = time.perf_counter() - t0
+    st_mid = rc.stats()
+    t0 = time.perf_counter()
+    warm = searcher.msearch("body", rq, TOP_K)
+    t_warm = time.perf_counter() - t0
+    st1 = rc.stats()
+    assert np.array_equal(cold[0], warm[0]) and np.array_equal(
+        cold[1], warm[1]), "cached results diverged from uncached"
+
+    def _rate(a, b):
+        lk = b["lookups"] - a["lookups"]
+        return round((b["hit_count"] - a["hit_count"]) / max(lk, 1), 4)
+
+    return {
+        "enabled": True,
+        "batch_size": n_q,
+        "qps_uncached": round(n_q / t_cold, 1),
+        "qps_cached": round(n_q / t_warm, 1),
+        "cache_speedup": round(t_cold / t_warm, 2),
+        "hit_rate_cold_pass": _rate(st0, st_mid),
+        "hit_rate_warm_pass": _rate(st_mid, st1),
+        "parity": "byte-identical (asserted)",
     }
 
 
@@ -614,6 +664,7 @@ def config5_8shard(rng):
     sum_df_total = 0.0
     shard_times = []  # [S][n_iters]
     per_shard = []  # device outputs of the LAST iteration per shard
+    cache_arm = {"enabled": False}
     doc_base = 0
     import hashlib as _hl
 
@@ -682,6 +733,15 @@ def config5_8shard(rng):
             times.append(time.perf_counter() - t0)
         shard_times.append(times)
         per_shard.append((np.asarray(outs[0]), np.asarray(outs[1])))
+        if s == 0:
+            # repeated-query (request cache) arm, measured on shard 0 only
+            # (per-shard entries are exactly the C5 cache design; one
+            # shard bounds the arm's cost while its searcher is resident)
+            cache_arm = _cache_arm(searcher, lens8[lo:hi],
+                                   tok8[int(starts[lo]):
+                                        int(starts[lo]) + int(lens8[lo:hi].sum())],
+                                   np.random.default_rng(7), n_q=512)
+            log(f"[c5] request-cache arm (shard 0): {cache_arm}")
         del bs, searcher, pack
         gc.collect()
         log(f"[c5] shard {s}: batch times {[round(x*1e3) for x in times]} ms")
@@ -747,6 +807,7 @@ def config5_8shard(rng):
         "host_merge_ms": round(t_merge * 1e3, 2),
         "batch_size": q_n,
         "baseline_model_qps_8m": round(baseline_qps, 1),
+        "request_cache": cache_arm,
         "mesh_probe": probe_r,
         "projection": {
             "formula": "q_n / mean_shard_batch_time * (1 - merge_frac)",

@@ -1,0 +1,24 @@
+"""Shard request cache subsystem (reference: indices/IndicesRequestCache).
+
+  - lru.py           sized LRU, breaker-accounted, removal listeners, stats
+  - keys.py          canonical DSL normalization + stable request digests
+  - request_cache.py per-shard entries keyed on (shard, epochs, request),
+                     epoch-invalidated on refresh/delete/merge
+"""
+
+from .keys import canonical_key, canonicalize
+from .lru import SizedLru
+from .request_cache import (
+    ShardRequestCache,
+    next_searcher_token,
+    request_cache,
+)
+
+__all__ = [
+    "SizedLru",
+    "ShardRequestCache",
+    "canonical_key",
+    "canonicalize",
+    "next_searcher_token",
+    "request_cache",
+]

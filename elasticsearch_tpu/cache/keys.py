@@ -1,0 +1,63 @@
+"""Canonical query-key hashing for the shard request cache.
+
+The reference keys its request cache on the serialized request bytes
+(indices/IndicesRequestCache.java Key = shard + reader version + request
+`BytesReference`), so two requests that differ only in JSON key order or
+in the scalar-vs-list spelling of a bool clause miss each other. Here the
+DSL tree is normalized first, so semantically identical requests share an
+entry:
+
+  - object keys are sorted (JSON key order never matters in the DSL);
+  - the bool clause groups (must/filter/should/must_not) accept a single
+    clause object or a list of one — both normalize to the list form;
+  - integral floats normalize to ints (`"boost": 1.0` == `"boost": 1`).
+
+Clause LISTS are deliberately NOT reordered: bool sums its clauses'
+scores in order, and float addition is not associative — reordering could
+hand a request a byte-different cached result than its own execution
+would produce, breaking the cached == uncached contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+_BOOL_GROUPS = ("must", "filter", "should", "must_not")
+
+
+def canonicalize(node):
+    """Semantics-preserving normal form of a DSL tree (also accepts any
+    JSON-able python value — lists/tuples/scalars pass through)."""
+    if isinstance(node, dict):
+        out = {}
+        for k in sorted(node):
+            v = node[k]
+            if k == "bool" and isinstance(v, dict):
+                b = {}
+                for bk in sorted(v):
+                    bv = v[bk]
+                    if bk in _BOOL_GROUPS and isinstance(bv, dict):
+                        bv = [bv]
+                    b[bk] = canonicalize(bv)
+                out[k] = b
+            else:
+                out[k] = canonicalize(v)
+        return out
+    if isinstance(node, (list, tuple)):
+        return [canonicalize(v) for v in node]
+    if isinstance(node, bool):
+        return node
+    if isinstance(node, float) and node.is_integer() and abs(node) < 2**53:
+        return int(node)
+    return node
+
+
+def canonical_key(obj) -> str:
+    """-> stable hex digest of the canonicalized request. `obj` is any
+    JSON-able structure (wrap the query with size/from/aggs/etc. before
+    hashing so every result-affecting input is part of the key)."""
+    canon = canonicalize(obj)
+    payload = json.dumps(canon, sort_keys=True, separators=(",", ":"),
+                         default=str)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()

@@ -1,0 +1,176 @@
+"""Sized LRU with circuit-breaker-accounted memory.
+
+Reference behavior: common/cache/Cache.java (segmented LRU with weigher,
+removal listeners and hit/miss/eviction counters) as instantiated by
+indices/IndicesRequestCache.java:84 (the shard request cache: entries
+weighed in bytes, evicted LRU under `indices.requests.cache.size`, every
+byte charged to the request circuit breaker so a hot cache cannot OOM the
+node).
+
+Design points kept from the reference:
+  - every admitted entry charges its weight to an accounting callback
+    (the breaker); eviction/invalidation releases through the SAME
+    callback that charged it, even if the cache was later re-bound to a
+    different breaker (engine restarts in one process);
+  - a put that trips the breaker is dropped, not raised: a cache is an
+    optimization and must never fail the request it was trying to serve;
+  - stats are internally consistent by construction:
+    hit_count + miss_count == lookups, maintained under one lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass
+class _Entry:
+    value: object
+    nbytes: int
+    account: Callable | None  # the accounting callback that charged us
+
+
+class SizedLru:
+    """Thread-safe byte-sized LRU.
+
+    `account(delta_bytes)` is called with +nbytes on admission and
+    -nbytes on removal; it may raise (circuit breaker trip) to refuse
+    admission. `removal_listener(key, value, reason)` fires for every
+    removal with reason in {"evicted", "invalidated", "replaced"}.
+    """
+
+    def __init__(self, max_bytes: int, account: Callable | None = None,
+                 removal_listener: Callable | None = None):
+        self.max_bytes = int(max_bytes)
+        self.account = account
+        self.removal_listener = removal_listener
+        self._map: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.size_bytes = 0
+        self.hit_count = 0
+        self.miss_count = 0
+        self.evictions = 0
+        self.breaker_trips = 0
+        self.too_large = 0
+
+    # -- core --------------------------------------------------------------
+
+    def get(self, key):
+        with self._lock:
+            e = self._map.get(key)
+            if e is None:
+                self.miss_count += 1
+                return None
+            self.hit_count += 1
+            self._map.move_to_end(key)
+            return e.value
+
+    def put(self, key, value, nbytes: int) -> bool:
+        """Admit `key` -> `value` weighing `nbytes`; returns True when the
+        entry is resident afterwards. Oversized entries and breaker trips
+        are counted and dropped (never raised)."""
+        nbytes = int(nbytes)
+        removed: list[tuple] = []
+        with self._lock:
+            if nbytes > self.max_bytes:
+                self.too_large += 1
+                return False
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._release_locked(old)
+                removed.append((key, old.value, "replaced"))
+            # evict LRU entries until the new entry fits
+            while self.size_bytes + nbytes > self.max_bytes and self._map:
+                k, e = self._map.popitem(last=False)
+                self._release_locked(e)
+                self.evictions += 1
+                removed.append((k, e.value, "evicted"))
+            account = self.account
+            if account is not None:
+                try:
+                    account(nbytes)
+                except Exception:  # breaker trip: drop, don't raise
+                    self.breaker_trips += 1
+                    self._notify(removed)
+                    return False
+            self._map[key] = _Entry(value, nbytes, account)
+            self.size_bytes += nbytes
+        self._notify(removed)
+        return True
+
+    def _release_locked(self, e: _Entry) -> None:
+        self.size_bytes -= e.nbytes
+        if e.account is not None:
+            try:
+                e.account(-e.nbytes)
+            except Exception:  # releases must never fail removal
+                pass
+
+    def _notify(self, removed: list) -> None:
+        if self.removal_listener is None:
+            return
+        for k, v, reason in removed:
+            try:
+                self.removal_listener(k, v, reason)
+            except Exception:  # a bad listener must not break the cache
+                pass
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, key) -> bool:
+        with self._lock:
+            e = self._map.pop(key, None)
+            if e is None:
+                return False
+            self._release_locked(e)
+        self._notify([(key, e.value, "invalidated")])
+        return True
+
+    def invalidate_where(self, pred: Callable) -> int:
+        """Drop every entry whose key satisfies `pred(key)`."""
+        removed = []
+        with self._lock:
+            doomed = [k for k in self._map if pred(k)]
+            for k in doomed:
+                e = self._map.pop(k)
+                self._release_locked(e)
+                removed.append((k, e.value, "invalidated"))
+        self._notify(removed)
+        return len(removed)
+
+    def clear(self) -> int:
+        return self.invalidate_where(lambda _k: True)
+
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """Shrink/grow the budget; shrinking evicts LRU-first."""
+        removed = []
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            while self.size_bytes > self.max_bytes and self._map:
+                k, e = self._map.popitem(last=False)
+                self._release_locked(e)
+                self.evictions += 1
+                removed.append((k, e.value, "evicted"))
+        self._notify(removed)
+
+    # -- stats -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memory_size_in_bytes": self.size_bytes,
+                "max_size_in_bytes": self.max_bytes,
+                "entry_count": len(self._map),
+                "hit_count": self.hit_count,
+                "miss_count": self.miss_count,
+                "lookups": self.hit_count + self.miss_count,
+                "evictions": self.evictions,
+                "breaker_trips": self.breaker_trips,
+                "too_large": self.too_large,
+            }

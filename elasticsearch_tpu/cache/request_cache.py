@@ -1,0 +1,156 @@
+"""Shard request cache: per-shard result entries with epoch invalidation.
+
+Reference behavior: indices/IndicesRequestCache.java — a node-wide cache
+of per-shard search results keyed on (shard, reader version, request
+bytes), invalidated when the shard's reader changes (refresh/merge) and
+sized by `indices.requests.cache.size` with every byte charged to the
+request circuit breaker.
+
+The TPU analog keys an entry on:
+
+    ((searcher_token, shard), (pack_epoch, dfs_stats_epoch), canonical_key)
+
+  - `searcher_token` is a process-unique monotonic id minted per
+    ShardSearcher / StackedSearcher (never reused, unlike `id()`), so a
+    rebuilt searcher after a full refresh can never collide with its
+    predecessor's entries;
+  - `shard` is the shard index within a stacked searcher (-1 for
+    whole-searcher entries such as a merged search result, which depend
+    on every shard);
+  - `pack_epoch` bumps whenever the shard's device-visible data mutates
+    in place (tiered refresh flipping live bits); `dfs_stats_epoch`
+    bumps when the scoring statistics change without the postings
+    changing (stats_override drift under tiered refresh) — either bump
+    makes every older entry unreachable, and the bump also proactively
+    drops them so their memory returns to the breaker;
+  - `canonical_key` is the normalized request digest (cache/keys.py),
+    which folds in k/size/from_/aggs and every other result-affecting
+    input.
+
+Correctness contract: a cached value is only ever served for the exact
+(searcher, epoch, request) triple that produced it, and execution is
+deterministic for that triple, so cached results are byte-identical to
+uncached execution. Enablement: `indices.requests.cache.enable` (dynamic
+setting) and the `ES_TPU_REQUEST_CACHE` env var (set to "0" to force the
+cache off — the CI shuffled-order gate runs this way so the cache can
+never mask an execution bug).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Callable
+
+from .lru import SizedLru
+
+_TOKENS = itertools.count(1)
+_TOKEN_LOCK = threading.Lock()
+
+DEFAULT_SIZE = "64mb"
+
+
+def next_searcher_token() -> int:
+    """Process-unique searcher id (monotonic; never reused, unlike id())."""
+    with _TOKEN_LOCK:
+        return next(_TOKENS)
+
+
+class ShardRequestCache:
+    """Node-level shard request cache over one SizedLru."""
+
+    def __init__(self, max_bytes: int | None = None,
+                 account: Callable | None = None, enabled: bool = True):
+        if max_bytes is None:
+            from ..common.settings import parse_bytes
+
+            max_bytes = parse_bytes(
+                os.environ.get("ES_TPU_REQUEST_CACHE_SIZE", DEFAULT_SIZE))
+        self._enabled = enabled
+        self.lru = SizedLru(max_bytes, account=account,
+                            removal_listener=self._on_removal)
+
+    # -- enablement --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        if os.environ.get("ES_TPU_REQUEST_CACHE", "1") == "0":
+            return False
+        return self._enabled
+
+    def set_enabled(self, flag: bool) -> None:
+        self._enabled = bool(flag)
+        if not flag:
+            self.lru.clear()
+
+    def set_max_bytes(self, max_bytes: int) -> None:
+        self.lru.set_max_bytes(max_bytes)
+
+    def bind_breaker(self, account: Callable | None) -> None:
+        """Future admissions charge `account(delta_bytes)`; entries already
+        resident keep releasing through the callback that charged them."""
+        self.lru.account = account
+
+    # -- entries -----------------------------------------------------------
+
+    @staticmethod
+    def _key(token, epoch, ckey):
+        return (tuple(token), tuple(epoch), ckey)
+
+    def get(self, token, epoch, ckey):
+        if not self.enabled:
+            return None
+        got = self.lru.get(self._key(token, epoch, ckey))
+        from ..telemetry import record_cache_event
+
+        record_cache_event("hit" if got is not None else "miss")
+        return got
+
+    def put(self, token, epoch, ckey, value, nbytes: int) -> bool:
+        if not self.enabled:
+            return False
+        ok = self.lru.put(self._key(token, epoch, ckey), value, nbytes)
+        if ok:
+            from ..telemetry import record_cache_event
+
+            record_cache_event("put")
+        return ok
+
+    def invalidate_searcher(self, searcher_token: int,
+                            shard: int | None = None) -> int:
+        """Drop every entry belonging to `searcher_token`. With `shard`
+        given, drop that shard's entries AND the whole-searcher (-1)
+        entries — a merged result depends on every shard."""
+        if shard is None:
+            pred = lambda k: k[0][0] == searcher_token
+        else:
+            pred = lambda k: (k[0][0] == searcher_token
+                              and k[0][1] in (shard, -1))
+        return self.lru.invalidate_where(pred)
+
+    def _on_removal(self, _key, _value, reason) -> None:
+        if reason == "evicted":
+            from ..telemetry import record_cache_event
+
+            record_cache_event("eviction")
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return self.lru.stats()
+
+
+_singleton: ShardRequestCache | None = None
+_singleton_lock = threading.Lock()
+
+
+def request_cache() -> ShardRequestCache:
+    """The node-wide cache instance every searcher consults. An Engine
+    binds its breaker + settings consumers onto it at construction."""
+    global _singleton
+    if _singleton is None:
+        with _singleton_lock:
+            if _singleton is None:
+                _singleton = ShardRequestCache()
+    return _singleton

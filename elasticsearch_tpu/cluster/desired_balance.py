@@ -33,10 +33,46 @@ target is always realizable.
 
 from __future__ import annotations
 
+import json
+from collections import OrderedDict
+
 THETA_SHARD = 0.45
 THETA_INDEX = 0.55
 THETA_DISK = 2.0
 MAX_ITERS = 500
+
+# solver memo: every allocate() call on the state-update thread runs the
+# solver, but state updates that don't touch routing-relevant inputs
+# (engine ops, acks, metadata-only changes) dominate real traffic — the
+# O(indices x shards x nodes x iters) solve must not re-run for them
+# (ADVICE round-5). Keyed on exactly the inputs compute() reads: the node
+# set with roles/attributes/capacities, each index's settings (replica
+# counts, routing filters, shard-size estimates), and the routing table.
+_MEMO_KEEP = 8
+_memo: OrderedDict[str, dict] = OrderedDict()
+
+
+def _solver_key(state) -> str:
+    """Stable digest of the routing-relevant state inputs. Term/version
+    are deliberately EXCLUDED: two successive states differing only in
+    version (or in solver-irrelevant sections) share a solve."""
+    proj = {
+        "nodes": {
+            n: {
+                "roles": sorted(info.get("roles", ["data"])),
+                "attributes": info.get("attributes") or {},
+                "capacity_bytes": info.get("capacity_bytes"),
+            }
+            for n, info in state.nodes.items()
+        },
+        "indices": {
+            idx: meta.get("settings", {})
+            for idx, meta in state.indices.items()
+        },
+        "routing": state.routing,
+    }
+    return json.dumps(proj, sort_keys=True, separators=(",", ":"),
+                      default=str)
 
 
 def _copies_wanted(meta: dict) -> int:
@@ -47,7 +83,25 @@ def _copies_wanted(meta: dict) -> int:
 def compute(state) -> dict:
     """Solve the desired assignment. Deterministic in `state`; a state
     whose routing already matches the output maps to the same output
-    (fixpoint), so reconciliation converges and then stops."""
+    (fixpoint), so reconciliation converges and then stops.
+
+    Memoized on the routing-relevant inputs (_solver_key): repeated
+    allocate() calls on an unchanged topology return the cached solve
+    instead of re-running the local search. Callers get a fresh copy, so
+    mutation of a returned dict can never poison the memo."""
+    key = _solver_key(state)
+    got = _memo.get(key)
+    if got is None:
+        got = _compute_uncached(state)
+        _memo[key] = got
+        if len(_memo) > _MEMO_KEEP:
+            _memo.popitem(last=False)
+    else:
+        _memo.move_to_end(key)
+    return {k: list(v) for k, v in got.items()}
+
+
+def _compute_uncached(state) -> dict:
     from . import allocation as al
 
     live = al.data_nodes(state)

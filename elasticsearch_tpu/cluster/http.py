@@ -238,18 +238,21 @@ class EngineReplica:
         # serve engine-state dumps to late-joining replicas whose ops were
         # compacted away (runs on the dispatch thread; the dump itself is
         # scheduled onto this replica's event loop for consistency with
-        # the apply loop)
-        try:
-            self.server.node.service.register_async_handler(
-                "engine:dump", self._on_dump_request)
-        except ValueError:
-            # a previous replica on this node registered it; rebind
-            self.server.node.service._async_handlers["engine:dump"] = (
-                self._on_dump_request)
+        # the apply loop). replace_async_handler: a previous replica on
+        # this node may still hold the binding — rebinding through the
+        # registration API (not a raw _async_handlers write) keeps the
+        # registry's invariants, and close() deregisters symmetrically so
+        # no callback stays bound to a closed event loop
+        self.server.node.service.replace_async_handler(
+            "engine:dump", self._on_dump_request)
         self.server.node.coordinator.add_applied_listener(self._on_state)
         self._on_state(self.server.node.state)  # catch up on join/restart
 
     async def close(self):
+        # deregister only if the binding is still OURS: a newer replica
+        # may have replaced it and must keep serving dumps
+        self.server.node.service.unregister_handler(
+            "engine:dump", self._on_dump_request)
         self.server.node.coordinator.remove_applied_listener(self._on_state)
         if self._task is not None:
             self._task.cancel()
@@ -545,18 +548,28 @@ def make_cluster_app(server: NodeServer,
             # a poisoned replica must not report healthy while every data
             # request 503s — surface the failure to monitoring
             return _err(503, "replica_poisoned", replica.failed)
+        status = 200
+        h = None
         if replica is not None and replica.engine_port is not None:
             # full-surface mode: all index data lives in the replica
             # engines, not the data-plane routing table — index/shard
             # health MUST come from what the surface actually serves, or
-            # it is vacuously green with 0 shards (ADVICE r4 #4)
+            # it is vacuously green with 0 shards (ADVICE r4 #4). The
+            # replica's STATUS CODE propagates too: a wait_for_status
+            # timeout is 408 + timed_out:true in the reference, and
+            # flattening it to 200 breaks every health-polling client
+            # (ADVICE r5)
             try:
-                _st, rbody, _ct = await replica._call(
+                rst, rbody, _ct = await replica._call(
                     "GET", str(request.rel_url), b"", "")
-                h = json.loads(rbody)
+                parsed = json.loads(rbody)
+                if isinstance(parsed, dict) and "status" in parsed:
+                    h, status = parsed, rst
             except Exception:  # noqa: BLE001 - replica warming up
-                h = _health_of(st)
-        else:
+                pass
+        if h is None:
+            # replica missing/warming, or its body was not a valid health
+            # document: fall back to data-plane routing health
             h = _health_of(st)
         h.update({
             "cluster_name": "elasticsearch-tpu",
@@ -566,7 +579,7 @@ def make_cluster_app(server: NodeServer,
             "term": st.term,
             "version": st.version,
         })
-        return web.json_response(h)
+        return web.json_response(h, status=status)
 
     async def cat_nodes(request):
         st = node.state

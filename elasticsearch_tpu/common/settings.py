@@ -184,6 +184,11 @@ def default_cluster_settings() -> list[Setting]:
         Setting("indices.breaker.total.limit", "95%", str, dynamic=True),
         Setting("indices.breaker.fielddata.limit", "40%", str, dynamic=True),
         Setting("indices.breaker.request.limit", "60%", str, dynamic=True),
+        # shard request cache (cache/request_cache.py; reference:
+        # IndicesRequestCache INDICES_CACHE_QUERY_SIZE / index-level enable)
+        Setting("indices.requests.cache.enable", True, Setting.bool_,
+                dynamic=True),
+        Setting("indices.requests.cache.size", "64mb", str, dynamic=True),
         Setting("search.default_search_timeout", "-1", str, dynamic=True),
         Setting("search.max_buckets", 65536, Setting.positive_int, dynamic=True),
         Setting("action.auto_create_index", True, Setting.bool_, dynamic=True),

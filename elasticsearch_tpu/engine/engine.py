@@ -516,6 +516,20 @@ class EsIndex:
         self._last_refresh = time.monotonic()
         self.counters["refresh_total"] = self.counters.get("refresh_total", 0) + 1
 
+    def _invalidate_request_cache(self):
+        """Drop every shard-request-cache entry of the searchers about to
+        be replaced (refresh/merge): the new searcher gets a fresh token,
+        so the old entries are unreachable — this returns their memory to
+        the breaker instead of waiting for LRU churn. Called only AFTER
+        the replacement pack passed breaker admission: on a trip the old
+        searcher stays live and its entries stay valid."""
+        from ..cache import request_cache
+
+        rc = request_cache()
+        for s in (self._searcher, self._tail):
+            if s is not None:
+                rc.invalidate_searcher(s.cache_token)
+
     def _can_refresh_incremental(self) -> bool:
         if self._searcher is None or self._base_stats is None:
             return False
@@ -548,6 +562,7 @@ class EsIndex:
         sp = build_stacked_pack_routed(routed, self.mappings)
         if self._breaker_account is not None:
             self._breaker_account(sp.nbytes())
+        self._invalidate_request_cache()
         self._searcher = StackedSearcher(sp, mesh=base.mesh)
         self.shard_docs = routed
         self._tail = None
@@ -582,6 +597,7 @@ class EsIndex:
         if mesh is None:
             mesh = (self._searcher.mesh if self._searcher is not None
                     else make_mesh(self.num_shards))
+        self._invalidate_request_cache()
         self._searcher = StackedSearcher(sp, mesh=mesh)
         self.shard_docs = routed
         self._tail = None
@@ -640,8 +656,16 @@ class EsIndex:
         base.sp.stats_override = override
         tail_sp.stats_override = override
         tail_sp.dead_count = getattr(base.sp, "dead_count", 0)
+        # dfs-stats drift: the combined statistics change every base doc's
+        # score, on top of the live-bit flips update_live already bumped —
+        # cached base results keyed on the old stats epoch must die
+        base.bump_epoch(stats=True)
         if self._breaker_account is not None:
             self._breaker_account(self._base_nbytes + tail_sp.nbytes())
+        if self._tail is not None:
+            from ..cache import request_cache
+
+            request_cache().invalidate_searcher(self._tail.cache_token)
         self._tail = StackedSearcher(tail_sp, mesh=base.mesh)
         self._tail_shard_docs = routed
         # avgdl may have drifted: re-norm the base dense tier on device
@@ -797,7 +821,8 @@ class EsIndex:
             node = self._tier_node(query)
             if node is not None:
                 return self._search_tiered(node, size, from_, prune_floor,
-                                           track_total_hits)
+                                           track_total_hits,
+                                           raw_query=query)
         m_eff = None
         if runtime_mappings:
             import copy
@@ -1043,13 +1068,18 @@ class EsIndex:
         return node if ok(node) else None
 
     def _search_tiered(self, node, size, from_, prune_floor,
-                       track_total_hits) -> dict:
-        # the SAME parsed node serves both tiers: each search() call runs
-        # prepare() immediately before its own execution, so per-searcher
-        # prepare state (dense-tier routing) never crosses tiers
+                       track_total_hits, raw_query=None) -> dict:
+        # each tier parses/prepares its own copy of the query immediately
+        # before its own execution, so per-searcher prepare state
+        # (dense-tier routing) never crosses tiers. The RAW DSL dict is
+        # preferred over the pre-parsed node: plain-dict requests are what
+        # the shard request cache can key, so the hot tiered path stays
+        # cacheable per tier
+        q = raw_query if isinstance(raw_query, dict) or raw_query is None \
+            else node
         k = max(size + from_, 1)
-        rb = self._searcher.search(node, size=k, prune_floor=prune_floor)
-        rt = self._tail.search(node, size=k)
+        rb = self._searcher.search(q, size=k, prune_floor=prune_floor)
+        rt = self._tail.search(q, size=k)
         rows = []
         for tier, r in ((0, rb), (1, rt)):
             for rank, (s, d, sc) in enumerate(
@@ -1082,7 +1112,9 @@ class EsIndex:
         if self._tail is not None:
             node = self._tier_node(query)
             if node is not None:
-                return self._searcher.count(node) + self._tail.count(node)
+                q = query if isinstance(query, dict) or query is None \
+                    else node
+                return self._searcher.count(q) + self._tail.count(q)
         return self.searcher.count(query)
 
     def explain(self, doc_id: str, query=None) -> dict:
@@ -1139,6 +1171,9 @@ class EsIndex:
         }
 
     def close(self):
+        # index teardown (delete/close): its cached shard results can never
+        # be served again — return their memory to the breaker now
+        self._invalidate_request_cache()
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -1186,6 +1221,32 @@ class Engine:
             self.settings.add_consumer(
                 key, lambda raw, c=child: self.breakers.set_limit(c, raw)
             )
+        # shard request cache (cache/): bind THIS engine's request breaker
+        # as the accounting sink (entries admitted earlier keep releasing
+        # through whichever breaker charged them) and expose the dynamic
+        # enable/size settings
+        from ..cache import request_cache
+        from ..common.settings import parse_bytes
+
+        rc = self.request_cache = request_cache()
+
+        def _rc_account(delta: int):
+            if delta >= 0:
+                self.breakers.add_estimate("request", delta, "request_cache")
+            else:
+                self.breakers.release("request", -delta)
+
+        rc.bind_breaker(_rc_account)
+        rc.set_enabled(self.settings.get("indices.requests.cache.enable"))
+        rc.set_max_bytes(parse_bytes(
+            self.settings.get("indices.requests.cache.size"),
+            self.breakers.total))
+        self.settings.add_consumer(
+            "indices.requests.cache.enable", rc.set_enabled)
+        self.settings.add_consumer(
+            "indices.requests.cache.size",
+            lambda raw: rc.set_max_bytes(
+                parse_bytes(raw, self.breakers.total)))
         # shared blob cache for mounted searchable snapshots, byte-
         # accounted under the request breaker (frozen-tier RAM budget)
         from ..snapshots.blobcache import SharedBlobCache

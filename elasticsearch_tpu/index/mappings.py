@@ -291,6 +291,11 @@ class Mappings:
         self.nested_paths: set[str] = set()
         # per-index custom analyzers (settings `analysis` section)
         self.analysis_registry: dict[str, Analyzer] = {}
+        # bumped on every set_analysis: query-time analysis is part of a
+        # parsed query's identity, so the shard request cache folds this
+        # generation into its keys (a synonym-set reload changes results
+        # with no index write — reference ReloadableCustomAnalyzer)
+        self.analysis_generation = 0
         # "true" | "false" | "strict" (ES `dynamic` mapping parameter)
         self.dynamic = dynamic
         # `_routing: {required: true}` (RoutingFieldMapper): stored so the
@@ -313,6 +318,7 @@ class Mappings:
     def set_analysis(self, registry: dict[str, Analyzer]) -> None:
         """Attach custom analyzers built from index settings; field types
         resolve names through this registry before the builtins."""
+        self.analysis_generation += 1
         self.analysis_registry = registry or {}
         for ft in self.fields.values():
             ft._registry = self.analysis_registry

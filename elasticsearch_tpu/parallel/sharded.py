@@ -143,6 +143,34 @@ class StackedResult:
     total_relation: str = "eq"
 
 
+def _copy_stacked_result(res: StackedResult) -> StackedResult:
+    """Defensive copy for cache store/serve: the engine mutates results in
+    place (rescore reorders, pipeline aggs rewrite the agg tree), so the
+    cached original must never be handed out by reference."""
+    import copy as _copy
+
+    out = StackedResult(
+        res.doc_shards.copy(), res.doc_ids.copy(), res.scores.copy(),
+        res.total, res.max_score, _copy.deepcopy(res.aggregations),
+        res.total_relation,
+    )
+    ws = getattr(res, "wand_stats", None)
+    if ws is not None:
+        out.wand_stats = dict(ws)
+    return out
+
+
+def _stacked_result_nbytes(res: StackedResult) -> int:
+    n = int(res.doc_shards.nbytes + res.doc_ids.nbytes
+            + res.scores.nbytes) + 256
+    if res.aggregations:
+        try:
+            n += len(json.dumps(res.aggregations, default=str))
+        except Exception:  # noqa: BLE001 - estimate only
+            n += 4096
+    return n
+
+
 class StackedSearcher:
     """Multi-shard searcher: one mesh-resident stacked pack + compiled plans.
 
@@ -169,7 +197,43 @@ class StackedSearcher:
         )
         self._cache: dict = {}
         self._dense_tfn_fn = None
+        # shard request cache identity: per-shard epochs so one shard's
+        # in-place mutation invalidates only its own entries (plus the
+        # whole-searcher merged-result entries), and a dfs-stats epoch for
+        # scoring-statistics drift under tiered refresh
+        from ..cache import next_searcher_token
+
+        self.cache_token = next_searcher_token()
+        self._shard_epochs = [0] * stacked.S
+        self._stats_epoch = 0
         self.refresh_dense_tfn()
+
+    # -- shard request cache ----------------------------------------------
+
+    def shard_cache_scope(self, s: int):
+        """-> (token, epoch) keying shard `s`'s per-shard cache entries."""
+        return ((self.cache_token, s),
+                (self._shard_epochs[s], self._stats_epoch))
+
+    def cache_scope(self):
+        """-> (token, epoch) for whole-searcher (merged) results; depends
+        on every shard's epoch, so any shard bump invalidates it."""
+        return ((self.cache_token, -1),
+                (tuple(self._shard_epochs), self._stats_epoch))
+
+    def bump_epoch(self, shard: int | None = None, stats: bool = False):
+        """Invalidate cached results after an in-place mutation: all
+        shards (refresh/delete/merge) or one shard; stats=True also marks
+        a dfs-statistics change (stats_override drift)."""
+        if shard is None:
+            self._shard_epochs = [e + 1 for e in self._shard_epochs]
+        else:
+            self._shard_epochs[shard] += 1
+        if stats:
+            self._stats_epoch += 1
+        from ..cache import request_cache
+
+        request_cache().invalidate_searcher(self.cache_token, shard=shard)
 
     def refresh_dense_tfn(self):
         """(Re)compute the scored dense tier dev["dense_tfn"] from the raw
@@ -217,12 +281,16 @@ class StackedSearcher:
 
     def update_live(self):
         """Re-ship the live-docs bitmap after host-side flips (tiered
-        refresh marks superseded/deleted base docs dead in place)."""
+        refresh marks superseded/deleted base docs dead in place). The
+        flip changes every shard's visible result set, so the request
+        cache epoch bumps here — stale entries become unreachable AND are
+        dropped."""
         if self.mesh is not None:
             self.dev["live"] = jax.device_put(
                 self.sp.live, NamedSharding(self.mesh, P("shards")))
         else:
             self.dev["live"] = jnp.asarray(self.sp.live)
+        self.bump_epoch()
 
     def _compiled(self, node, key, k, agg_nodes, agg_key):
         cache_key = (key, k, agg_key, self.mesh is None)
@@ -916,7 +984,41 @@ class StackedSearcher:
     ) -> StackedResult:
         """prune_floor: None = exact (no block-max pruning); 0 = prune freely
         (track_total_hits=false); N > 0 = prune only when the total provably
-        reaches N (the track_total_hits threshold contract)."""
+        reaches N (the track_total_hits threshold contract).
+
+        Plain-DSL requests are served from the shard request cache when
+        warm (whole-searcher scope: the merged result depends on every
+        shard, so any shard's epoch bump invalidates it); QueryNode
+        requests and per-request mapping overrides bypass the cache."""
+        from ..cache import canonical_key, request_cache
+
+        rc = request_cache()
+        ck = scope = None
+        if rc.enabled and mappings is None and not isinstance(query, QueryNode):
+            ck = canonical_key({
+                "op": "stacked_search", "query": query, "aggs": aggs,
+                "size": int(size), "from": int(from_),
+                "prune_floor": prune_floor,
+                # query-time analyzers (synonym-set reloads) change parsed
+                # queries without any index write — part of the identity
+                "ag": getattr(self.sp.mappings, "analysis_generation", 0),
+            })
+            scope = self.cache_scope()
+            hit = rc.get(scope[0], scope[1], ck)
+            if hit is not None:
+                from ..telemetry import CACHE_HIT_SPAN, TRACER
+
+                with TRACER.span(CACHE_HIT_SPAN):
+                    return _copy_stacked_result(hit)
+        res = self._search_uncached(query, size, from_, aggs, mappings,
+                                    prune_floor)
+        if ck is not None:
+            rc.put(scope[0], scope[1], ck, _copy_stacked_result(res),
+                   _stacked_result_nbytes(res))
+        return res
+
+    def _search_uncached(self, query, size, from_, aggs, mappings,
+                         prune_floor) -> StackedResult:
         m = mappings if mappings is not None else self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
         if prune_floor is not None and not aggs:
@@ -1235,19 +1337,128 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     disjunction kernel; queries flagged by any shard re-run on the legacy
     exact arm, so results never depend on the fused pass.
 
+    The shard request cache fronts both arms with per-SHARD entries: each
+    (query, shard) pair's pre-merge top-k row is cached under
+    (shard token, shard epoch, canonical query key), so a partially-warm
+    msearch only re-scores queries with at least one cold shard, reuses
+    warm shards' cached rows at the coordinator merge, and a single
+    shard's epoch bump (in-place mutation) leaves the other shards warm.
+
     -> (scores [Q, k], shard [Q, k], docid [Q, k], totals [Q]) numpy.
     """
+    if not _return_program and queries:
+        from ..cache import request_cache
+
+        rc = request_cache()
+        if rc.enabled:
+            return _msearch_sharded_cached(ss, rc, fld, queries, k)
     fs = _fused_sharded_for(ss)
     if fs is not None and not _return_program and fs.usable(k):
         return fs.msearch(fld, queries, k)
     return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
 
 
+def _merge_shard_rows(v, i, t):
+    """Coordinator merge of per-shard top rows [S, Q, kk]: flat order is
+    (score desc, shard asc, doc asc) — the reference's
+    SearchPhaseController/TopDocs.merge order. -> (scores [Q, kk],
+    shard [Q, kk], docid [Q, kk], totals [Q])."""
+    v, i, t = np.asarray(v), np.asarray(i), np.asarray(t)
+    S, Q, kk = v.shape
+    flat_v = v.transpose(1, 0, 2).reshape(Q, -1)
+    flat_i = i.transpose(1, 0, 2).reshape(Q, -1)
+    flat_s = np.broadcast_to(
+        np.repeat(np.arange(S), kk)[None, :], flat_v.shape
+    )
+    order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :kk]
+    return (
+        np.take_along_axis(flat_v, order, axis=1),
+        np.take_along_axis(flat_s, order, axis=1).astype(np.int32),
+        np.take_along_axis(flat_i, order, axis=1),
+        t.sum(axis=0),
+    )
+
+
+def _msearch_sharded_partials(ss: "StackedSearcher", fld: str,
+                              queries: list, k: int):
+    """Per-shard pre-merge rows (v [S, Q, kk], i [S, Q, kk], t [S, Q])
+    from whichever arm serves this searcher (fused pipeline with per-shard
+    escalation, or the legacy exact kernel)."""
+    fs = _fused_sharded_for(ss)
+    if fs is not None and fs.usable(k):
+        return fs.msearch_partials(fld, queries, k)
+    return _msearch_exact_partials(ss, fld, queries, k)
+
+
+def _msearch_sharded_cached(ss: "StackedSearcher", rc, fld: str,
+                            queries: list, k: int):
+    """Per-shard cached msearch: warm (query, shard) rows come from the
+    cache, queries with any cold shard re-score (one batched SPMD dispatch
+    over the cold subset — the device program always runs all shards, but
+    warm shards' CACHED rows stay authoritative for the merge and warm
+    entries are never re-stored), then one coordinator merge."""
+    from ..cache import canonical_key
+
+    S = ss.sp.S
+    qkeys = [
+        canonical_key({"op": "msearch_sharded", "fld": fld, "k": int(k),
+                       "q": [[t, float(b)] for t, b in q]})
+        for q in queries
+    ]
+    rows: dict[tuple, tuple] = {}
+    cold: list[int] = []
+    for qi, ck in enumerate(qkeys):
+        warm = True
+        for s in range(S):
+            tok, ep = ss.shard_cache_scope(s)
+            got = rc.get(tok, ep, ck)
+            if got is None:
+                warm = False
+            else:
+                rows[(qi, s)] = got
+        if not warm:
+            cold.append(qi)
+    if cold:
+        v, i, t = _msearch_sharded_partials(
+            ss, fld, [queries[qi] for qi in cold], k)
+        v, i, t = np.asarray(v), np.asarray(i), np.asarray(t)
+        for j, qi in enumerate(cold):
+            for s in range(S):
+                if (qi, s) in rows:
+                    continue  # warm per-shard entry stays authoritative
+                row = (v[s, j].copy(), i[s, j].copy(), int(t[s, j]))
+                rows[(qi, s)] = row
+                tok, ep = ss.shard_cache_scope(s)
+                rc.put(tok, ep, qkeys[qi], row,
+                       row[0].nbytes + row[1].nbytes + 96)
+    Q = len(queries)
+    width = max(r[0].shape[0] for r in rows.values())
+    V = np.full((S, Q, width), -np.inf, np.float32)
+    I = np.zeros((S, Q, width), np.int64)
+    T = np.zeros((S, Q), np.int64)
+    for (qi, s), (rv, ri, rt) in rows.items():
+        V[s, qi, : rv.shape[0]] = rv
+        I[s, qi, : ri.shape[0]] = ri
+        T[s, qi] = rt
+    return _merge_shard_rows(V, I, T)
+
+
 def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,
                            queries: list, k: int = 10,
                            _return_program=False):
-    """The legacy exact arm: batched disjunction kernel per shard (also
-    the escalation target of the fused arm's flagged queries)."""
+    """The legacy exact arm: per-shard partials + coordinator merge."""
+    out = _msearch_exact_partials(ss, fld, queries, k, _return_program)
+    if _return_program:
+        return out
+    return _merge_shard_rows(*out)
+
+
+def _msearch_exact_partials(ss: "StackedSearcher", fld: str,
+                            queries: list, k: int = 10,
+                            _return_program=False):
+    """Batched disjunction kernel per shard (also the escalation target of
+    the fused arm's flagged queries) -> pre-merge per-shard rows
+    (v [S, Q, kk], i [S, Q, kk], t [S, Q]) numpy."""
     from ..ops.batched import BatchTermSearcher, batch_term_disjunction
 
     sp = ss.sp
@@ -1325,19 +1536,7 @@ def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,
                     jnp.asarray(ws)), kk
     v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
                                 jnp.asarray(ws)))
-    # coordinator merge: (score desc, shard asc, doc asc)
-    flat_v = v.transpose(1, 0, 2).reshape(Q, -1)
-    flat_i = i.transpose(1, 0, 2).reshape(Q, -1)
-    flat_s = np.broadcast_to(
-        np.repeat(np.arange(S), kk)[None, :], flat_v.shape
-    )
-    order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :kk]
-    return (
-        np.take_along_axis(flat_v, order, axis=1),
-        np.take_along_axis(flat_s, order, axis=1).astype(np.int32),
-        np.take_along_axis(flat_i, order, axis=1),
-        t.sum(axis=0),
-    )
+    return v, i, t
 
 
 class _PlanShardAdapter:
@@ -1516,6 +1715,13 @@ class _FusedShardedMsearch:
         return fn
 
     def msearch(self, fld, queries, k):
+        return _merge_shard_rows(*self.msearch_partials(fld, queries, k))
+
+    def msearch_partials(self, fld, queries, k):
+        """Pre-merge per-shard rows (scores [S, Q, kk], ids, totals
+        [S, Q]); queries flagged by ANY shard have their per-shard rows
+        replaced by the exact arm's partials, so the merge (and any cached
+        per-shard entry) never depends on the fused pass."""
         from ..ops import fused as F
 
         ss = self.ss
@@ -1566,22 +1772,18 @@ class _FusedShardedMsearch:
             ids[:, qidx] = i[:, ci, :nq]
             totals[:, qidx] = t[:, ci, :nq]
             flagged[qidx] |= fl[:, ci, :nq].any(axis=0)
-        # coordinator merge: (score desc, shard asc, doc asc)
-        flat_v = scores.transpose(1, 0, 2).reshape(Q, -1)
-        flat_i = ids.transpose(1, 0, 2).reshape(Q, -1)
-        flat_s = np.broadcast_to(
-            np.repeat(np.arange(S), kk)[None, :], flat_v.shape)
-        order = np.lexsort((flat_i, flat_s, -flat_v), axis=1)[:, :kk]
-        out_v = np.take_along_axis(flat_v, order, axis=1)
-        out_s = np.take_along_axis(flat_s, order, axis=1).astype(np.int32)
-        out_i = np.take_along_axis(flat_i, order, axis=1)
-        out_t = totals.sum(axis=0)
         if flagged.any():
+            # escalation at per-shard granularity: the exact arm's
+            # pre-merge rows REPLACE the fused rows for flagged queries,
+            # so downstream consumers (merge, per-shard cache entries)
+            # see only exact data for them
             still = np.nonzero(flagged)[0]
-            ev, es, ei, et = _msearch_sharded_exact(
+            ev, ei, et = _msearch_exact_partials(
                 self.ss, fld, [queries[i_] for i_ in still], k)
-            out_v[still, : ev.shape[1]] = ev
-            out_s[still, : ev.shape[1]] = es
-            out_i[still, : ev.shape[1]] = ei
-            out_t[still] = et
-        return out_v, out_s, out_i, out_t
+            ke = ev.shape[2]
+            scores[:, still, :] = -np.inf
+            scores[:, still, :ke] = ev
+            ids[:, still, :] = 0
+            ids[:, still, :ke] = ei
+            totals[:, still] = et
+        return scores, ids, totals

@@ -83,6 +83,29 @@ class ShardResult:
     aggregations: dict | None = None
 
 
+def _copy_shard_result(res: "ShardResult") -> "ShardResult":
+    """Defensive copy for cache store/serve: callers may mutate hit arrays
+    or aggregation dicts, and the cached original must stay pristine."""
+    import copy as _copy
+
+    return ShardResult(
+        res.doc_ids.copy(), res.scores.copy(), res.total, res.max_score,
+        _copy.deepcopy(res.aggregations),
+    )
+
+
+def _shard_result_nbytes(res: "ShardResult") -> int:
+    import json as _json
+
+    n = int(res.doc_ids.nbytes + res.scores.nbytes) + 256
+    if res.aggregations:
+        try:
+            n += len(_json.dumps(res.aggregations, default=str))
+        except Exception:
+            n += 4096
+    return n
+
+
 class ShardSearcher:
     def __init__(self, pack: ShardPack, device=None, mappings=None):
         self.pack = pack
@@ -99,6 +122,29 @@ class ShardSearcher:
             "dense-tier packs bake default k1/b; rebuild with dense disabled"
         )
         self._cache: dict = {}
+        # shard request cache identity: a process-unique token (never
+        # reused, unlike id()) + epochs that bump on any in-place mutation
+        # of the device-visible pack / scoring stats (cache/request_cache)
+        from ..cache import next_searcher_token
+
+        self.cache_token = next_searcher_token()
+        self._pack_epoch = 0
+        self._stats_epoch = 0
+
+    def cache_scope(self, shard: int = 0):
+        """-> (token, epoch) pair keying this searcher's cache entries."""
+        return ((self.cache_token, shard),
+                (self._pack_epoch, self._stats_epoch))
+
+    def bump_epoch(self, stats: bool = False):
+        """Invalidate every cached result of this searcher (call after any
+        in-place mutation of the pack or its scoring statistics)."""
+        self._pack_epoch += 1
+        if stats:
+            self._stats_epoch += 1
+        from ..cache import request_cache
+
+        request_cache().invalidate_searcher(self.cache_token)
 
     # -- compilation -------------------------------------------------------
 
@@ -145,10 +191,93 @@ class ShardSearcher:
 
     def msearch(self, fld: str, queries, k: int = 10, **kw):
         """Batched term-disjunction `_msearch` -> (scores, docids, totals,
-        first_pass_exact) numpy (see BatchTermSearcher.msearch)."""
-        return self.batched().msearch(fld, queries, k, **kw)
+        first_pass_exact) numpy (see BatchTermSearcher.msearch).
+
+        Consults the shard request cache per QUERY before dispatching the
+        fused pipeline: warm queries are assembled host-side, only the
+        cold subset is planned and dispatched, and every cold query's
+        result row is stored under (searcher token, epoch, canonical
+        query key) — a repeated query stream never re-enters the device.
+        """
+        from ..cache import canonical_key, request_cache
+
+        rc = request_cache()
+        if not rc.enabled or not queries:
+            return self.batched().msearch(fld, queries, k, **kw)
+        tok, epoch = self.cache_scope()
+        opts = sorted((str(a), v) for a, v in kw.items())
+        qkeys = [
+            canonical_key({"op": "msearch", "fld": fld, "k": int(k),
+                           "opts": opts,
+                           "q": [[t, float(b)] for t, b in q]})
+            for q in queries
+        ]
+        rows: dict[int, tuple] = {}
+        cold: list[int] = []
+        for qi, ck in enumerate(qkeys):
+            got = rc.get(tok, epoch, ck)
+            if got is None:
+                cold.append(qi)
+            else:
+                rows[qi] = got
+        if cold:
+            cv, ci, ct, cex = self.batched().msearch(
+                fld, [queries[qi] for qi in cold], k, **kw)
+            for j, qi in enumerate(cold):
+                row = (cv[j].copy(), ci[j].copy(), int(ct[j]), bool(cex[j]))
+                rows[qi] = row
+                rc.put(tok, epoch, qkeys[qi], row,
+                       row[0].nbytes + row[1].nbytes + 96)
+        Q = len(queries)
+        width = max(r[0].shape[0] for r in rows.values())
+        scores = np.full((Q, width), -np.inf, np.float32)
+        ids = np.zeros((Q, width), np.int64)
+        totals = np.zeros((Q,), np.int64)
+        exact = np.ones((Q,), bool)
+        for qi, (rv, ri, rt, re_) in rows.items():
+            scores[qi, : rv.shape[0]] = rv
+            ids[qi, : ri.shape[0]] = ri
+            totals[qi] = rt
+            exact[qi] = re_
+        return scores, ids, totals, exact
 
     def search(
+        self,
+        query: dict | QueryNode | None,
+        size: int = 10,
+        from_: int = 0,
+        mappings=None,
+        aggs: dict | None = None,
+    ) -> ShardResult:
+        """Compiled-plan per-query search, served from the shard request
+        cache when the request is a plain DSL tree against the searcher's
+        own mappings (cached results are byte-identical: execution is
+        deterministic per (searcher, epoch, canonical request))."""
+        from ..cache import canonical_key, request_cache
+
+        rc = request_cache()
+        ck = scope = None
+        if rc.enabled and mappings is None and not isinstance(query, QueryNode):
+            # analysis generation: query-time analyzers (synonym-set
+            # reloads) change parsed queries without any index write
+            ck = canonical_key({"op": "search", "query": query, "aggs": aggs,
+                                "size": int(size), "from": int(from_),
+                                "ag": getattr(self.mappings,
+                                              "analysis_generation", 0)})
+            scope = self.cache_scope()
+            hit = rc.get(scope[0], scope[1], ck)
+            if hit is not None:
+                from ..telemetry import CACHE_HIT_SPAN, TRACER
+
+                with TRACER.span(CACHE_HIT_SPAN):
+                    return _copy_shard_result(hit)
+        res = self._search_uncached(query, size, from_, mappings, aggs)
+        if ck is not None:
+            rc.put(scope[0], scope[1], ck, _copy_shard_result(res),
+                   _shard_result_nbytes(res))
+        return res
+
+    def _search_uncached(
         self,
         query: dict | QueryNode | None,
         size: int = 10,

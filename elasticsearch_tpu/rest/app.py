@@ -2165,6 +2165,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def nodes_stats(request):
         import jax
 
+        from ..cache import request_cache
         from ..telemetry import metrics
 
         devices = [str(d) for d in jax.devices()]
@@ -2177,7 +2178,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     "node-0": {
                         "name": "node-0",
                         "roles": ["master", "data", "ingest"],
-                        "indices": {"docs": {"count": total_docs}},
+                        "indices": {
+                            "docs": {"count": total_docs},
+                            # reference shape: indices.request_cache
+                            # {memory_size_in_bytes, evictions, hit_count,
+                            # miss_count} (+ framework extras)
+                            "request_cache": request_cache().stats(),
+                        },
                         "breakers": engine.breakers.stats(),
                         "tpu": {"devices": devices},
                         "metrics": metrics.snapshot(),

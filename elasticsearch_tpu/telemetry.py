@@ -202,6 +202,21 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+# ---- shard request cache ---------------------------------------------------
+
+# span name used around cache-served results (the reference traces the
+# query phase regardless of cache outcome; a hit span makes the skipped
+# execution visible in traces instead of looking like a 0ms search)
+CACHE_HIT_SPAN = "shardRequestCache.hit"
+
+
+def record_cache_event(event: str, n: int = 1) -> None:
+    """Count a request-cache event (hit/miss/put/eviction) in the metrics
+    registry so _nodes/stats metrics carry cache counters alongside the
+    cache's own stats() (cache/request_cache.py)."""
+    metrics.counter_inc(f"request_cache.{event}", n)
+
+
 # ---------------------------------------------------------------------------
 # structured (JSON-lines) logging
 # ---------------------------------------------------------------------------

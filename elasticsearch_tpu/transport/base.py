@@ -111,6 +111,29 @@ class TransportService:
             raise ValueError(f"handler already registered for [{action}]")
         self._async_handlers[action] = handler
 
+    def replace_async_handler(self, action: str, handler) -> None:
+        """Register-or-replace: the supported way to rebind an action when
+        a component restarts in-process (a second EngineReplica on the
+        same node). Fails if the action is bound as a SYNC handler —
+        silently flipping handler kinds would change response semantics."""
+        if action in self._handlers:
+            raise ValueError(f"[{action}] is registered as a sync handler")
+        self._async_handlers[action] = handler
+
+    def unregister_handler(self, action: str, handler=None) -> bool:
+        """Remove `action`'s handler (sync or async). With `handler`
+        given, remove only if it is still the registered one — a stopped
+        component must not tear down its successor's rebinding."""
+        for table in (self._handlers, self._async_handlers):
+            cur = table.get(action)
+            if cur is None:
+                continue
+            if handler is not None and cur is not handler:
+                return False
+            del table[action]
+            return True
+        return False
+
     # -- outbound ----------------------------------------------------------
 
     def send_request(

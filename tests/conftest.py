@@ -63,7 +63,14 @@ def pytest_collection_modifyitems(session, config, items):
         by_mod[mod].append(it)
     random.Random(seed).shuffle(order)
     items[:] = [it for mod in order for it in by_mod[mod]]
-    print(f"[conftest] module order shuffled with seed {seed}")
+    # the shuffled-order gate also runs cache-OFF: the shard request
+    # cache must never be able to mask an execution bug (a query served
+    # from cache would hide a regression in the path that computes it).
+    # test_request_cache.py re-enables it per test via its own autouse
+    # fixture, so cache coverage itself survives this gate.
+    os.environ["ES_TPU_REQUEST_CACHE"] = "0"
+    print(f"[conftest] module order shuffled with seed {seed}; "
+          "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate)")
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -72,6 +79,15 @@ def _assert_cpu_mesh():
     assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
     assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
     yield
+    # suite-teardown accounting audit: the shard request cache's counters
+    # must be internally consistent after EVERYTHING the suite did to it
+    # (concurrent lookups, evictions, breaker trips, invalidations)
+    from elasticsearch_tpu.cache import request_cache
+
+    st = request_cache().stats()
+    assert st["hit_count"] + st["miss_count"] == st["lookups"], (
+        f"request cache stats inconsistent at suite teardown: {st}")
+    assert st["memory_size_in_bytes"] >= 0 and st["entry_count"] >= 0, st
 
 
 _HERMETIC_PREFIXES = ("ES_TPU_", "ES_BENCH_", "JAX_")
