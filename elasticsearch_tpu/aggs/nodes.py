@@ -130,6 +130,14 @@ class AggNode:
                 out[key] = arr.any(axis=0)
             elif rule == "concat_sorted":
                 out[key] = np.sort(arr.reshape(-1))
+            elif rule == "sum_exact":
+                # exact-i64 partials: reduce in Python ints so the shard
+                # merge cannot round what the device kept exact
+                out[key] = np.array(
+                    [sum(int(x) for x in arr[:, i])
+                     for i in range(arr.shape[1])],
+                    dtype=object,
+                )
         if "children" in stacked:
             # a bucket agg over an absent field emits children={} (nothing
             # was evaluated); keep it empty rather than recursing
@@ -154,7 +162,10 @@ class _FieldMetricAgg(AggNode):
 
     def prepare(self, pack, mappings):
         col = pack.docvalues.get(self.fld)
-        return {}, (type(self).__name__, self.fld, col is None)
+        # the column kind picks the device program (exact-i64 path for
+        # integer columns vs f32), so it must be in the compile key
+        kind = None if col is None else col.kind
+        return {}, (type(self).__name__, self.fld, col is None, kind)
 
 
 # one-hot segmented reduction geometry: XLA's scatter on TPU runs on the
@@ -237,8 +248,47 @@ def _seg_scatter(seg, nseg, valid, values, init, op):
     return acc[:nseg]
 
 
+# ---- exact i64 metric path -------------------------------------------------
+# `long`-mapped columns live on device as int64; the f32 cast the float
+# metric path uses silently rounds values above 2^24. The ES|QL exchange
+# already solved exact long sums with a hi/lo split (esql/exchange.py:
+# hi = v >> 32 signed, lo = v & 0xFFFFFFFF, both exactly f64-representable,
+# partials < 2^53 when the shard has <= 2^20 rows); this ports that
+# discipline to the main agg path. Larger shards fall back to a native
+# int64 scatter-add (always exact mod int64 wrap — the same wrap the host
+# oracle's int64 arithmetic has). Cross-shard merge reconstructs with
+# arbitrary-precision Python ints ("sum_exact" rule) so no merge step can
+# reintroduce rounding. Exactness costs the scalar-core scatter instead of
+# the one-hot MXU contraction — correct-first; the dense f32 path is
+# untouched for float columns.
+
+_I64_LO_MASK = (1 << 32) - 1
+
+
+def _seg_sum_long_exact(seg, nseg, ok, v):
+    """-> (sum_hi [nseg] f64, sum_lo [nseg] f64): exact int64 segmented
+    sum, split so that total = (int(hi) << 32) + int(lo) per segment."""
+    if v.shape[0] <= (1 << 20):
+        hi = (v >> 32).astype(jnp.float64)
+        lo = (v & _I64_LO_MASK).astype(jnp.float64)
+        return (
+            _seg_scatter(seg, nseg, ok, hi, jnp.float64(0), "add"),
+            _seg_scatter(seg, nseg, ok, lo, jnp.float64(0), "add"),
+        )
+    s = _seg_scatter(seg, nseg, ok, v, jnp.int64(0), "add")
+    return ((s >> 32).astype(jnp.float64),
+            (s & _I64_LO_MASK).astype(jnp.float64))
+
+
+def _exact_int(x) -> int:
+    """Partial -> Python int. Device partials are integral f64 (< 2^53 by
+    construction); merged partials are already arbitrary-precision ints."""
+    return int(x)
+
+
 class SumAgg(_FieldMetricAgg):
-    _MERGE_RULES = {"sum": "sum", "count": "sum"}
+    _MERGE_RULES = {"sum": "sum", "count": "sum",
+                    "sum_hi": "sum_exact", "sum_lo": "sum_exact"}
 
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _numeric_values(dev, self.fld, ctx)
@@ -246,29 +296,54 @@ class SumAgg(_FieldMetricAgg):
             return {"sum": jnp.zeros(nseg, jnp.float32), "count": jnp.zeros(nseg, jnp.int32)}
         v, h, kind = got
         ok = valid & h
+        count = _seg_scatter(seg, nseg, ok, jnp.ones_like(seg), jnp.int32(0), "add")
+        if kind == "int":
+            hi, lo = _seg_sum_long_exact(seg, nseg, ok, v)
+            return {"sum_hi": hi, "sum_lo": lo, "count": count}
         return {
             "sum": _seg_scatter(seg, nseg, ok, v.astype(jnp.float32), jnp.float32(0), "add"),
-            "count": _seg_scatter(seg, nseg, ok, jnp.ones_like(seg), jnp.int32(0), "add"),
+            "count": count,
         }
 
+    def _sum_of(self, out, i):
+        if "sum_hi" in out:
+            return (_exact_int(out["sum_hi"][i]) << 32) \
+                + _exact_int(out["sum_lo"][i])
+        return float(out["sum"][i])
+
     def finalize(self, out, nseg):
-        return [{"value": float(out["sum"][i])} for i in range(nseg)]
+        return [{"value": self._sum_of(out, i)} for i in range(nseg)]
 
 
 class MinAgg(_FieldMetricAgg):
     op, init, resp = "min", np.inf, min
-    _MERGE_RULES = {"v": "min"}
+    _MERGE_RULES = {"v": "min", "v_i64": "min"}
+
+    @property
+    def _i64_sentinel(self):
+        return np.iinfo(np.int64).max if self.op == "min" \
+            else np.iinfo(np.int64).min
 
     def device_eval_segmented(self, dev, params, seg, nseg, valid, ctx):
         got = _numeric_values(dev, self.fld, ctx)
         if got is None:
             return {"v": jnp.full(nseg, self.init, jnp.float32)}
         v, h, kind = got
+        if kind == "int":
+            # int64 end-to-end: no f32 rounding above 2^24 (empty segment
+            # sentinel = the opposing int64 extreme)
+            return {"v_i64": _seg_scatter(
+                seg, nseg, valid & h, v,
+                jnp.int64(self._i64_sentinel), self.op)}
         return {"v": _seg_scatter(seg, nseg, valid & h, v.astype(jnp.float32), jnp.float32(self.init), self.op)}
 
     def finalize(self, out, nseg):
         res = []
         for i in range(nseg):
+            if "v_i64" in out:
+                x = int(out["v_i64"][i])
+                res.append({"value": None if x == self._i64_sentinel else x})
+                continue
             x = float(out["v"][i])
             res.append({"value": None if not np.isfinite(x) else x})
         return res
@@ -276,7 +351,7 @@ class MinAgg(_FieldMetricAgg):
 
 class MaxAgg(MinAgg):
     op, init = "max", -np.inf
-    _MERGE_RULES = {"v": "max"}
+    _MERGE_RULES = {"v": "max", "v_i64": "max"}
 
 
 class ValueCountAgg(_FieldMetricAgg):
@@ -298,7 +373,9 @@ class AvgAgg(SumAgg):
         res = []
         for i in range(nseg):
             c = int(out["count"][i])
-            res.append({"value": float(out["sum"][i]) / c if c else None})
+            # exact-i64 sums divide as Python int / int -> the correctly-
+            # rounded double (what the host oracle computes)
+            res.append({"value": self._sum_of(out, i) / c if c else None})
         return res
 
 

@@ -143,7 +143,9 @@ _READONLY_POST = re.compile(
     r"_ingest/pipeline/(_simulate|[^/]+/_simulate)|"
     r"_index_template/_simulate(_index)?(/[^/]+)?|_graph/explore|"
     r"_percolate|_nodes/reload_secure_settings|_monitoring/bulk|"
-    r"_query|_pit|_inference/[^/]+(/[^/]+)?)"
+    r"_query|_pit|_inference/[^/]+(/[^/]+)?|"
+    r"_ml/anomaly_detectors/[^/]+/results/[^/]+(/[^/]+)?|"
+    r"_ml/datafeeds/[^/]+/_preview)"
     r"([/?]|$)"
 )
 

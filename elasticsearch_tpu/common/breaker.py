@@ -79,6 +79,11 @@ class CircuitBreakerService:
                 "request", parse_bytes(limits.get("request", "60%"), self.total)),
             "in_flight_requests": ChildBreaker(
                 "in_flight_requests", self.total),
+            # live ML model state (ml/job.py set_steady per job) — the
+            # reference's model_inference child breaker
+            "model_inference": ChildBreaker(
+                "model_inference",
+                parse_bytes(limits.get("model_inference", "50%"), self.total)),
         }
         self.parent_trip_count = 0
         self._steady: dict[tuple[str, str], int] = {}

@@ -195,6 +195,16 @@ def default_cluster_settings() -> list[Setting]:
         Setting("cluster.max_shards_per_node", 1000, Setting.positive_int, dynamic=True),
         Setting("logger.*", "info", str, dynamic=True),
         Setting("xpack.security.enabled", False, Setting.bool_, dynamic=True),
+        # machine learning (ml/): job admission + model-state placement.
+        # model_inference is the breaker child accounting live model state
+        # (the reference's ML memory tracker + model_inference breaker)
+        Setting("xpack.ml.enabled", True, Setting.bool_, dynamic=True),
+        Setting("xpack.ml.max_open_jobs", 32, Setting.positive_int,
+                dynamic=True),
+        Setting("xpack.ml.state_repository_path", None, lambda v: v,
+                dynamic=True),
+        Setting("indices.breaker.model_inference.limit", "50%", str,
+                dynamic=True),
         # remote clusters for CCS; the seed is the remote's HTTP endpoint
         # (this framework's transport IS HTTP — reference 9300 seeds analog)
         Setting("cluster.remote.*", None, lambda v: v, dynamic=True),

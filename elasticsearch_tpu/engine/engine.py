@@ -1202,6 +1202,7 @@ class Engine:
 
         self.persistent = PersistentTasksService(self)
         self._security = None
+        self._ml = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1214,10 +1215,14 @@ class Engine:
             "total": self.settings.get("indices.breaker.total.limit"),
             "fielddata": self.settings.get("indices.breaker.fielddata.limit"),
             "request": self.settings.get("indices.breaker.request.limit"),
+            "model_inference": self.settings.get(
+                "indices.breaker.model_inference.limit"),
         })
         for key, child in (("indices.breaker.total.limit", "total"),
                            ("indices.breaker.fielddata.limit", "fielddata"),
-                           ("indices.breaker.request.limit", "request")):
+                           ("indices.breaker.request.limit", "request"),
+                           ("indices.breaker.model_inference.limit",
+                            "model_inference")):
             self.settings.add_consumer(
                 key, lambda raw, c=child: self.breakers.set_limit(c, raw)
             )
@@ -1275,6 +1280,20 @@ class Engine:
         if self._security is None:
             self._security = SecurityService(self)
         return self._security
+
+    @property
+    def ml(self):
+        """ML subsystem (ml/): lazy like security — jobs/datafeeds live in
+        cluster metadata, so a node serving no ML traffic never builds the
+        service. First access registers the persistent-task executor."""
+        from ..ml import MlService
+
+        if self._ml is None:
+            self._ml = MlService(self)
+            self.settings.add_consumer(
+                "xpack.ml.state_repository_path",
+                lambda _v: self._ml.invalidate_repo_cache())
+        return self._ml
 
     def _pack_accounter(self, name: str):
         return lambda n: self.breakers.set_steady(
@@ -2233,5 +2252,7 @@ class Engine:
         return {"errors": errors, "items": items}
 
     def close(self):
+        if self._ml is not None:
+            self._ml.shutdown()  # checkpoints open jobs' model state
         for idx in self.indices.values():
             idx.close()

@@ -1,0 +1,24 @@
+"""Machine learning: anomaly-detection jobs with datafeeds, a native JAX
+model, checkpointed model state, and the `_ml` REST surface.
+
+The reference's x-pack ML plugin (1,085 files) runs anomaly detection in
+sidecar C++ `autodetect` processes fed over named pipes; this framework
+owns JAX on the accelerator, so the model (online seasonal-trend
+decomposition + streaming robust scale estimation, ml/model.py) runs
+in-process where the data already lives, scoring every bucket vectorized
+across detectors and partitions in one device call. Jobs run on the
+persistent-task framework; model state checkpoints through the
+content-addressed blob layout so close/reopen, node restart, and
+failover to another node all resume from learned state.
+"""
+
+from .config import DatafeedConfig, JobConfig, results_index_name
+from .job import MlJobTaskExecutor, MlService
+
+__all__ = [
+    "DatafeedConfig",
+    "JobConfig",
+    "MlJobTaskExecutor",
+    "MlService",
+    "results_index_name",
+]

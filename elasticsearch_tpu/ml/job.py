@@ -1,0 +1,696 @@
+"""ML job lifecycle: persistent-task-backed anomaly-detection jobs with
+checkpointed model state.
+
+Parity targets (reference): x-pack/plugin/ml/.../job/JobManager.java (job
+CRUD + open/close through the persistent task framework,
+OpenJobPersistentTasksExecutor), .../job/process/autodetect/
+AutodetectProcessManager.java (one model per open job, results persisted
+per bucket, model state checkpointed so close/reopen and node failover
+resume seamlessly), and ModelSnapshot retention. The sidecar C++
+autodetect process of the reference is replaced by the in-process JAX
+model (ml/model.py); model state checkpoints ride the content-addressed
+blob layout (snapshots/repository.py) instead of .ml-state documents, so
+a job adopted by ANOTHER node (shared state repository) reopens from the
+exact learned seasonality the failed node last persisted.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..snapshots.repository import FsRepository, InMemoryRepository
+from ..telemetry import record_ml_event
+from ..utils.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+)
+from . import datafeed as datafeed_mod
+from . import model as model_mod
+from . import results as results_mod
+from .config import DatafeedConfig, JobConfig, results_index_name
+
+PERSISTENT_TASK_NAME = "xpack/ml/job"
+SNAPSHOT_RETENTION = 10
+
+
+class JobRuntime:
+    """Open-job state: the live model plus series registry and progress."""
+
+    def __init__(self, cfg: JobConfig):
+        self.cfg = cfg
+        self.state = model_mod.init_state(model_mod.MIN_CAP, cfg.period_buckets)
+        self.series: dict[tuple[int, str | None], int] = {}
+        self.processed_end_ms: int | None = None
+        self.allocation_id = 1
+        self.memory_status = "ok"
+        self.counts = {
+            "processed_record_count": 0,
+            "bucket_count": 0,
+            "latest_record_timestamp": None,
+            "latest_bucket_timestamp": None,
+        }
+
+    def nbytes(self) -> int:
+        return model_mod.state_nbytes(self.state)
+
+    def snapshot_meta(self) -> dict:
+        return {
+            "job_id": self.cfg.job_id,
+            "series": [[di, split, slot]
+                       for (di, split), slot in sorted(self.series.items(),
+                                                       key=lambda kv: kv[1])],
+            "processed_end_ms": self.processed_end_ms,
+            "allocation_id": self.allocation_id,
+            "counts": self.counts,
+        }
+
+    def restore_meta(self, meta: dict):
+        self.series = {(int(di), split): int(slot)
+                       for di, split, slot in meta.get("series", [])}
+        self.processed_end_ms = meta.get("processed_end_ms")
+        self.allocation_id = int(meta.get("allocation_id", 1))
+        self.counts.update(meta.get("counts") or {})
+
+
+class MlJobTaskExecutor:
+    """Persistent-task executor: each scheduler tick advances every open
+    job's started datafeed to the newest complete bucket (real-time mode;
+    lookback-with-end runs synchronously in start_datafeed)."""
+
+    def tick(self, engine, task):
+        ml = engine.ml
+        job_id = (task.get("params") or {}).get("job_id")
+        if job_id not in ml.runtimes:
+            # allocated task without a live model: this node restarted (or
+            # the task failed over here) — reopen from the latest model
+            # snapshot, exactly the reference's job-reallocation path
+            try:
+                ml.open_job(job_id)
+            except ResourceNotFoundError:
+                return  # config gone: orphaned task, nothing to run
+        if job_id not in ml.runtimes:
+            return
+        for df_id, df_cfg in list(ml._datafeeds().items()):
+            st = ml._datafeed_state().get(df_id) or {}
+            if df_cfg.get("job_id") == job_id and st.get("state") == "started":
+                ml._advance_datafeed(df_id, end_ms=int(time.time() * 1000))
+        # periodic checkpoint: content addressing dedups unchanged state,
+        # so an idle tick writes nothing; after progress the latest learned
+        # state is always recoverable (node restart / failover)
+        ml.checkpoint(job_id, reason="scheduled")
+        task["state"]["last_tick_ms"] = int(time.time() * 1000)
+
+
+class MlService:
+    """Node-level ML: job/datafeed registries (cluster metadata), open-job
+    runtimes, model-state repository, breaker-accounted model memory."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.runtimes: dict[str, JobRuntime] = {}
+        self._mem_repo: InMemoryRepository | None = None
+        self._repo_cache: tuple[str, FsRepository] | None = None
+        engine.persistent.register_executor(
+            PERSISTENT_TASK_NAME, MlJobTaskExecutor())
+
+    # ---- stores ----------------------------------------------------------
+
+    def _jobs(self) -> dict:
+        return self.engine.meta.extras.setdefault("ml_jobs", {})
+
+    def _datafeeds(self) -> dict:
+        return self.engine.meta.extras.setdefault("ml_datafeeds", {})
+
+    def _datafeed_state(self) -> dict:
+        return self.engine.meta.extras.setdefault("ml_datafeed_state", {})
+
+    def _check_enabled(self):
+        if not self.engine.settings.get("xpack.ml.enabled"):
+            raise IllegalArgumentError("machine learning is disabled "
+                                       "(xpack.ml.enabled: false)")
+
+    # ---- model-state repository (content-addressed blob layout) ----------
+
+    def repo(self):
+        import os
+
+        path = self.engine.settings.get("xpack.ml.state_repository_path")
+        if not path and self.engine.data_path:
+            path = os.path.join(self.engine.data_path, "ml_state")
+        if not path:
+            if self._mem_repo is None:
+                self._mem_repo = InMemoryRepository()
+            return self._mem_repo
+        if self._repo_cache is None or self._repo_cache[0] != path:
+            self._repo_cache = (path, FsRepository(path))
+        return self._repo_cache[1]
+
+    def invalidate_repo_cache(self):
+        self._repo_cache = None
+
+    def _repo_meta(self, job_id: str) -> dict:
+        repo = self.repo()
+        name = f"ml/jobs/{job_id}.json"
+        if repo.exists(name):
+            return json.loads(repo.read(name))
+        return {"config": None, "datafeeds": {}, "snapshots": [],
+                "snapshot_seq": 0, "current_snapshot": None}
+
+    def _save_repo_meta(self, job_id: str, meta: dict):
+        self.repo().write(f"ml/jobs/{job_id}.json",
+                          json.dumps(meta, sort_keys=True).encode())
+
+    # ---- job CRUD --------------------------------------------------------
+
+    def _cfg(self, job_id: str) -> JobConfig:
+        stored = self._jobs().get(job_id)
+        if stored is None:
+            raise ResourceNotFoundError(
+                f"No known job with id '{job_id}'")
+        return JobConfig(job_id, stored["config"])
+
+    def put_job(self, job_id: str, body: dict) -> dict:
+        self._check_enabled()
+        if job_id in self._jobs():
+            raise ResourceAlreadyExistsError(
+                f"The job cannot be created with the Id '{job_id}'. "
+                "The Id is already used.")
+        cfg = JobConfig(job_id, body or {})
+        entry = {"config": body, "create_time": int(time.time() * 1000),
+                 "state": "closed"}
+        self._jobs()[job_id] = entry
+        self.engine.meta.save()
+        # publish the config to the shared state repository so another
+        # node can adopt the job on failover
+        meta = self._repo_meta(job_id)
+        meta["config"] = body
+        self._save_repo_meta(job_id, meta)
+        record_ml_event("jobs_created")
+        return {**cfg.to_dict(), "create_time": entry["create_time"]}
+
+    def get_jobs(self, job_id: str | None) -> dict:
+        jobs = self._jobs()
+        if job_id and job_id not in ("_all", "*"):
+            if job_id not in jobs:
+                raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+            ids = [job_id]
+        else:
+            ids = sorted(jobs)
+        out = []
+        for jid in ids:
+            cfg = JobConfig(jid, jobs[jid]["config"])
+            out.append({**cfg.to_dict(),
+                        "create_time": jobs[jid].get("create_time")})
+        return {"count": len(out), "jobs": out}
+
+    def delete_job(self, job_id: str, force: bool = False) -> dict:
+        if job_id not in self._jobs():
+            raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+        if job_id in self.runtimes:
+            if not force:
+                raise IllegalArgumentError(
+                    f"Cannot delete job [{job_id}] because the job is opened")
+            self.close_job(job_id)
+        del self._jobs()[job_id]
+        for df_id in [d for d, c in self._datafeeds().items()
+                      if c.get("job_id") == job_id]:
+            del self._datafeeds()[df_id]
+            self._datafeed_state().pop(df_id, None)
+        self.engine.meta.save()
+        name = results_index_name(job_id)
+        if name in self.engine.indices:
+            self.engine.delete_index(name)
+        repo = self.repo()
+        if repo.exists(f"ml/jobs/{job_id}.json"):
+            repo.delete(f"ml/jobs/{job_id}.json")
+        record_ml_event("jobs_deleted")
+        return {"acknowledged": True}
+
+    # ---- open / close / flush -------------------------------------------
+
+    def _adopt_from_repo(self, job_id: str) -> bool:
+        """Failover path: a job created on another node exists only in the
+        shared state repository; copy its config into this node's
+        metadata so it can be opened here."""
+        meta = self._repo_meta(job_id)
+        if meta.get("config") is None:
+            return False
+        self._jobs()[job_id] = {"config": meta["config"],
+                                "create_time": int(time.time() * 1000),
+                                "state": "closed"}
+        for df_id, df_body in (meta.get("datafeeds") or {}).items():
+            self._datafeeds().setdefault(df_id, df_body)
+        self.engine.meta.save()
+        return True
+
+    def open_job(self, job_id: str) -> dict:
+        self._check_enabled()
+        if job_id in self.runtimes:
+            return {"opened": True, "node": self.engine.tasks.node}
+        if job_id not in self._jobs() and not self._adopt_from_repo(job_id):
+            raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+        max_open = self.engine.settings.get("xpack.ml.max_open_jobs")
+        if len(self.runtimes) >= max_open:
+            raise IllegalArgumentError(
+                f"node is full: [{len(self.runtimes)}] opened jobs >= "
+                f"xpack.ml.max_open_jobs [{max_open}]")
+        cfg = self._cfg(job_id)
+        rt = JobRuntime(cfg)
+        meta = self._repo_meta(job_id)
+        snap = self._pick_snapshot(meta)
+        if snap is not None:
+            payload = self.repo().get_blob(snap["digest"])
+            state, smeta = model_mod.deserialize_state(payload)
+            rt.state = state
+            rt.restore_meta(smeta)
+            rt.allocation_id += 1
+            record_ml_event("jobs_restored_from_snapshot")
+        self._account_memory(job_id, rt)
+        self.runtimes[job_id] = rt
+        self._jobs()[job_id]["state"] = "opened"
+        self.engine.meta.save()
+        results_mod.ensure_results_index(self.engine, cfg)
+        task_id = f"job-{job_id}"
+        try:
+            self.engine.persistent.start(
+                task_id, PERSISTENT_TASK_NAME,
+                {"job_id": job_id, "node": self.engine.tasks.node})
+        except ResourceAlreadyExistsError:
+            self.engine.persistent.resume(task_id)
+        record_ml_event("jobs_opened")
+        return {"opened": True, "node": self.engine.tasks.node}
+
+    def _pick_snapshot(self, meta: dict) -> dict | None:
+        snaps = meta.get("snapshots") or []
+        if not snaps:
+            return None
+        current = meta.get("current_snapshot")
+        if current:
+            for s in snaps:
+                if s["snapshot_id"] == current:
+                    return s
+        return snaps[-1]
+
+    def close_job(self, job_id: str, force: bool = False) -> dict:
+        rt = self.runtimes.get(job_id)
+        if rt is None:
+            if job_id in self._jobs():
+                return {"closed": True}
+            raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+        self.checkpoint(job_id, reason="close")
+        for df_id, c in self._datafeeds().items():
+            if c.get("job_id") == job_id:
+                st = self._datafeed_state().setdefault(df_id, {})
+                st["state"] = "stopped"
+        try:
+            self.engine.persistent.remove(f"job-{job_id}")
+        except ResourceNotFoundError:
+            pass
+        self.engine.breakers.set_steady("model_inference", f"ml:{job_id}", 0)
+        del self.runtimes[job_id]
+        self._jobs()[job_id]["state"] = "closed"
+        self.engine.meta.save()
+        record_ml_event("jobs_closed")
+        return {"closed": True}
+
+    def flush_job(self, job_id: str, body: dict | None = None) -> dict:
+        rt = self.runtimes.get(job_id)
+        if rt is None:
+            raise IllegalArgumentError(
+                f"Cannot flush because job [{job_id}] is not open")
+        name = results_index_name(job_id)
+        if name in self.engine.indices:
+            self.engine.indices[name].refresh()
+        out = {"flushed": True}
+        if rt.processed_end_ms is not None:
+            out["last_finalized_bucket_end"] = rt.processed_end_ms
+        return out
+
+    def job_stats(self, job_id: str | None) -> dict:
+        jobs = self.get_jobs(job_id)["jobs"]
+        out = []
+        for j in jobs:
+            jid = j["job_id"]
+            rt = self.runtimes.get(jid)
+            if rt is None:
+                meta = self._repo_meta(jid)
+                snap = self._pick_snapshot(meta)
+                counts, mem, status = {}, 0, "ok"
+                if snap is not None:
+                    counts = snap.get("counts") or {}
+                    mem = snap.get("model_bytes", 0)
+                state = "closed"
+            else:
+                counts, mem, status = rt.counts, rt.nbytes(), rt.memory_status
+                state = "opened"
+            entry = {
+                "job_id": jid,
+                "state": state,
+                "data_counts": {"job_id": jid, **counts},
+                "model_size_stats": {
+                    "job_id": jid,
+                    "model_bytes": mem,
+                    "memory_status": status,
+                    "total_partition_field_count":
+                        len(rt.series) if rt else 0,
+                },
+            }
+            if rt is not None:
+                entry["node"] = {"name": self.engine.tasks.node}
+                entry["allocation_id"] = rt.allocation_id
+            out.append(entry)
+        return {"count": len(out), "jobs": out}
+
+    # ---- model snapshots -------------------------------------------------
+
+    def checkpoint(self, job_id: str, reason: str = "periodic") -> dict:
+        rt = self.runtimes.get(job_id)
+        if rt is None:
+            raise IllegalArgumentError(f"job [{job_id}] is not open")
+        payload = model_mod.serialize_state(rt.state, rt.snapshot_meta())
+        repo = self.repo()
+        digest = repo.put_blob(payload)
+        meta = self._repo_meta(job_id)
+        if meta.get("snapshots") and meta["snapshots"][-1]["digest"] == digest:
+            return meta["snapshots"][-1]  # state unchanged: dedup
+        meta["snapshot_seq"] = int(meta.get("snapshot_seq", 0)) + 1
+        snap = {
+            "job_id": job_id,
+            "snapshot_id": f"{job_id}-{meta['snapshot_seq']}",
+            "timestamp": int(time.time() * 1000),
+            "digest": digest,
+            "description": reason,
+            "snapshot_doc_count": 1,
+            "model_bytes": rt.nbytes(),
+            "counts": dict(rt.counts),
+            "latest_record_time_stamp":
+                rt.counts.get("latest_record_timestamp"),
+        }
+        meta.setdefault("snapshots", []).append(snap)
+        meta["snapshots"] = meta["snapshots"][-SNAPSHOT_RETENTION:]
+        meta["current_snapshot"] = None  # new head supersedes any revert
+        self._save_repo_meta(job_id, meta)
+        record_ml_event("model_snapshots_written")
+        return snap
+
+    def get_model_snapshots(self, job_id: str) -> dict:
+        self._cfg(job_id)  # 404 on unknown job
+        snaps = self._repo_meta(job_id).get("snapshots") or []
+        shaped = [{k: v for k, v in s.items() if k not in ("digest", "counts")}
+                  for s in snaps]
+        return {"count": len(shaped), "model_snapshots": shaped}
+
+    def revert_model_snapshot(self, job_id: str, snapshot_id: str) -> dict:
+        if job_id in self.runtimes:
+            raise IllegalArgumentError(
+                f"Cannot revert snapshot: job [{job_id}] is opened")
+        meta = self._repo_meta(job_id)
+        match = [s for s in meta.get("snapshots", [])
+                 if s["snapshot_id"] == snapshot_id]
+        if not match:
+            raise ResourceNotFoundError(
+                f"No model snapshot with id [{snapshot_id}] exists for job "
+                f"[{job_id}]")
+        meta["current_snapshot"] = snapshot_id
+        self._save_repo_meta(job_id, meta)
+        return {"model": {k: v for k, v in match[0].items()
+                          if k not in ("digest", "counts")}}
+
+    # ---- datafeeds -------------------------------------------------------
+
+    def put_datafeed(self, df_id: str, body: dict) -> dict:
+        self._check_enabled()
+        if df_id in self._datafeeds():
+            raise ResourceAlreadyExistsError(
+                f"A datafeed with id [{df_id}] already exists")
+        cfg = DatafeedConfig(df_id, body or {})
+        if cfg.job_id not in self._jobs():
+            raise ResourceNotFoundError(
+                f"No known job with id '{cfg.job_id}'")
+        if any(c.get("job_id") == cfg.job_id
+               for c in self._datafeeds().values()):
+            raise IllegalArgumentError(
+                f"A datafeed already exists for job [{cfg.job_id}]")
+        self._datafeeds()[df_id] = body
+        self._datafeed_state()[df_id] = {"state": "stopped"}
+        self.engine.meta.save()
+        meta = self._repo_meta(cfg.job_id)
+        meta.setdefault("datafeeds", {})[df_id] = body
+        self._save_repo_meta(cfg.job_id, meta)
+        return cfg.to_dict()
+
+    def get_datafeeds(self, df_id: str | None) -> dict:
+        feeds = self._datafeeds()
+        if df_id and df_id not in ("_all", "*"):
+            if df_id not in feeds:
+                raise ResourceNotFoundError(
+                    f"No datafeed with id [{df_id}] exists")
+            ids = [df_id]
+        else:
+            ids = sorted(feeds)
+        return {"count": len(ids), "datafeeds": [
+            DatafeedConfig(i, feeds[i]).to_dict() for i in ids]}
+
+    def delete_datafeed(self, df_id: str) -> dict:
+        if df_id not in self._datafeeds():
+            raise ResourceNotFoundError(f"No datafeed with id [{df_id}] exists")
+        if (self._datafeed_state().get(df_id) or {}).get("state") == "started":
+            raise IllegalArgumentError(
+                f"Cannot delete datafeed [{df_id}] while its status is started")
+        del self._datafeeds()[df_id]
+        self._datafeed_state().pop(df_id, None)
+        self.engine.meta.save()
+        return {"acknowledged": True}
+
+    def datafeed_stats(self, df_id: str | None) -> dict:
+        got = self.get_datafeeds(df_id)
+        out = []
+        for d in got["datafeeds"]:
+            st = self._datafeed_state().get(d["datafeed_id"]) or {}
+            out.append({
+                "datafeed_id": d["datafeed_id"],
+                "state": st.get("state", "stopped"),
+                "timing_stats": {
+                    "job_id": d["job_id"],
+                    "search_count": st.get("search_count", 0),
+                    "total_search_time_ms": st.get("search_ms", 0.0),
+                },
+            })
+        return {"count": len(out), "datafeeds": out}
+
+    @staticmethod
+    def _parse_time(v, default: int) -> int:
+        if v is None:
+            return default
+        s = str(v)
+        if s.lstrip("-").isdigit():
+            return int(s)
+        import datetime as _dt
+
+        return int(_dt.datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp() * 1000)
+
+    def start_datafeed(self, df_id: str, start=None, end=None) -> dict:
+        self._check_enabled()
+        if df_id not in self._datafeeds():
+            raise ResourceNotFoundError(f"No datafeed with id [{df_id}] exists")
+        df_cfg = DatafeedConfig(df_id, self._datafeeds()[df_id])
+        if df_cfg.job_id not in self.runtimes:
+            raise IllegalArgumentError(
+                f"cannot start datafeed [{df_id}] because job "
+                f"[{df_cfg.job_id}] is not open")
+        st = self._datafeed_state().setdefault(df_id, {})
+        start_ms = self._parse_time(start, 0)
+        end_ms = self._parse_time(end, None) if end is not None else None
+        rt = self.runtimes[df_cfg.job_id]
+        if rt.processed_end_ms is None:
+            rt.processed_end_ms = datafeed_mod.bucket_floor(
+                start_ms, rt.cfg.bucket_span)
+        st["state"] = "started"
+        self.engine.meta.save()
+        record_ml_event("datafeeds_started")
+        if end_ms is not None:
+            # lookback with a bound: run to completion now, then stop
+            self._advance_datafeed(df_id, end_ms=end_ms)
+            st["state"] = "stopped"
+            self.engine.meta.save()
+            self.checkpoint(df_cfg.job_id, reason="datafeed lookback complete")
+        return {"started": True, "node": self.engine.tasks.node}
+
+    def stop_datafeed(self, df_id: str) -> dict:
+        if df_id not in self._datafeeds():
+            raise ResourceNotFoundError(f"No datafeed with id [{df_id}] exists")
+        st = self._datafeed_state().setdefault(df_id, {})
+        st["state"] = "stopped"
+        self.engine.meta.save()
+        return {"stopped": True}
+
+    def preview_datafeed(self, df_id: str) -> list[dict]:
+        if df_id not in self._datafeeds():
+            raise ResourceNotFoundError(f"No datafeed with id [{df_id}] exists")
+        df_cfg = DatafeedConfig(df_id, self._datafeeds()[df_id])
+        return datafeed_mod.preview(self.engine, df_cfg, self._cfg(df_cfg.job_id))
+
+    def _advance_datafeed(self, df_id: str, end_ms: int):
+        df_cfg = DatafeedConfig(df_id, self._datafeeds()[df_id])
+        rt = self.runtimes.get(df_cfg.job_id)
+        if rt is None:
+            return
+        start_ms = rt.processed_end_ms or 0
+        if end_ms <= start_ms:
+            return
+        t0 = time.monotonic()
+        n = self._process(df_cfg, rt, start_ms, end_ms)
+        st = self._datafeed_state().setdefault(df_id, {})
+        st["search_count"] = st.get("search_count", 0) + 1
+        st["search_ms"] = st.get("search_ms", 0.0) \
+            + (time.monotonic() - t0) * 1000
+        if n:
+            self.engine.meta.save()
+
+    # ---- the scoring pipeline -------------------------------------------
+
+    def _assign_slots(self, rt: JobRuntime, keys) -> None:
+        """Register new (detector, partition) series, growing model state
+        under the job's model_memory_limit; over-limit series are dropped
+        and the job reports memory_status=hard_limit (reference
+        semantics: the model stops growing, existing series keep
+        scoring)."""
+        fresh = [k for k in sorted(keys, key=lambda k: (k[0], str(k[1])))
+                 if k not in rt.series]
+        for key in fresh:
+            need = len(rt.series) + 1
+            grown = model_mod.grow_state(rt.state, need)
+            if model_mod.state_nbytes(grown) > rt.cfg.model_memory_limit:
+                rt.memory_status = "hard_limit"
+                record_ml_event("series_dropped_hard_limit")
+                continue
+            rt.state = grown
+            rt.series[key] = len(rt.series)
+
+    def _account_memory(self, job_id: str, rt: JobRuntime):
+        self.engine.breakers.set_steady(
+            "model_inference", f"ml:{job_id}", rt.nbytes(),
+            label=f"ml job [{job_id}] model state")
+
+    def _process(self, df_cfg: DatafeedConfig, rt: JobRuntime,
+                 start_ms: int, end_ms: int) -> int:
+        """Pull [start, end) buckets, score them in one device call, write
+        record/bucket results. -> buckets processed."""
+        cfg = rt.cfg
+        t0 = time.monotonic()
+        pulled = datafeed_mod.pull(self.engine, df_cfg, cfg, start_ms, end_ms)
+        starts = pulled["bucket_starts"]
+        B = len(starts)
+        if B == 0:
+            return 0
+        if pulled["truncated_partitions"]:
+            record_ml_event("partitions_truncated",
+                            pulled["truncated_partitions"])
+        self._assign_slots(rt, pulled["series"].keys())
+        S = len(rt.series)
+        values = np.zeros((B, max(S, 1)), np.float64)
+        present = np.zeros((B, max(S, 1)), bool)
+        for key, (v, m) in pulled["series"].items():
+            slot = rt.series.get(key)
+            if slot is None:
+                continue  # dropped at the memory hard limit
+            values[:, slot] = v
+            present[:, slot] = m
+        span_ms = cfg.bucket_span * 1000
+        phases = ((starts // 1000) // cfg.bucket_span).astype(np.int64)
+        rt.state, scored = model_mod.update_and_score(
+            rt.state, values[:, :max(S, 1)], present, phases)
+        scores = scored["scores"]
+        typical = scored["typical"]
+        # one-sided detectors only flag their direction
+        dets = {d.index: d for d in cfg.detectors}
+        for (di, _split), slot in rt.series.items():
+            side = dets[di].side
+            if side:
+                resid = values[:, slot] - typical[:, slot]
+                scores[:, slot] = np.where(
+                    np.sign(resid) == side, scores[:, slot], 0.0)
+
+        idx = results_mod.ensure_results_index(self.engine, cfg)
+        n_records = 0
+        for (di, split), slot in rt.series.items():
+            det = dets[di]
+            for i in np.flatnonzero(
+                    present[:, slot]
+                    & (scores[:, slot] >= results_mod.RECORD_SCORE_FLOOR)):
+                prob = float(10.0 ** (-scores[i, slot] / 10.0))
+                doc_id, doc = results_mod.record_doc(
+                    cfg, det, int(starts[i]), scores[i, slot],
+                    values[i, slot], typical[i, slot], prob, split)
+                idx.index_doc(doc_id, doc)
+                n_records += 1
+        proc_ms = (time.monotonic() - t0) * 1000
+        bucket_scores = np.where(present, scores, 0.0).max(axis=1) \
+            if S else np.zeros(B)
+        for i in range(B):
+            doc_id, doc = results_mod.bucket_doc(
+                cfg, int(starts[i]), float(bucket_scores[i]),
+                int(pulled["event_counts"][i]), proc_ms / B)
+            idx.index_doc(doc_id, doc)
+        idx.refresh()
+        rt.processed_end_ms = int(starts[-1]) + span_ms
+        rt.counts["processed_record_count"] += int(
+            pulled["event_counts"].sum())
+        rt.counts["bucket_count"] += B
+        rt.counts["latest_bucket_timestamp"] = int(starts[-1])
+        nz = np.flatnonzero(pulled["event_counts"])
+        if len(nz):
+            rt.counts["latest_record_timestamp"] = int(starts[nz[-1]])
+        self._account_memory(cfg.job_id, rt)
+        record_ml_event("buckets_processed", B)
+        record_ml_event("records_written", n_records)
+        from ..telemetry import metrics
+
+        metrics.histogram_record("ml.bucket_processing_time_ms", proc_ms / B)
+        return B
+
+    # ---- observability / shutdown ---------------------------------------
+
+    def node_stats(self) -> dict:
+        return {
+            "anomaly_detectors": {
+                "count": len(self._jobs()),
+                "opened": len(self.runtimes),
+            },
+            "datafeeds": {
+                "count": len(self._datafeeds()),
+                "started": sum(
+                    1 for s in self._datafeed_state().values()
+                    if s.get("state") == "started"),
+            },
+            "model_memory_bytes": sum(
+                rt.nbytes() for rt in self.runtimes.values()),
+        }
+
+    def info(self) -> dict:
+        from .. import __version__
+
+        return {
+            "defaults": {"anomaly_detectors": {
+                "model_memory_limit": "16mb",
+                "categorization_analyzer": None,
+            }},
+            "limits": {"max_open_jobs":
+                       self.engine.settings.get("xpack.ml.max_open_jobs")},
+            "native_code": {"version": f"jax-native {__version__}"},
+            "upgrade_mode": False,
+        }
+
+    def shutdown(self):
+        """Engine close: checkpoint every open job so nothing learned is
+        lost on an orderly node stop."""
+        for job_id in list(self.runtimes):
+            try:
+                self.close_job(job_id)
+            except Exception:  # noqa: BLE001 - best effort on shutdown
+                pass

@@ -1,0 +1,222 @@
+"""Native JAX anomaly-detection model: online seasonal-trend decomposition
+plus streaming robust scale/quantile estimation, scored vectorized across
+every (detector, partition) series in one device call per batch.
+
+The reference delegates this to the spawned C++ autodetect process
+(x-pack/plugin/ml/.../process/AutodetectProcess — one sidecar per job,
+records streamed over named pipes). This framework owns the accelerator,
+so the model runs where the data already lives: a `lax.scan` over the
+batch of buckets, each step updating all S series with pure VPU math
+(BM25S-style eager vectorization — batch everything, no per-series loop).
+
+Per series the state is an additive Holt-Winters decomposition in
+error-correction form (level + damped trend + seasonal component of fixed
+candidate period P) with two robust residual-scale estimators learned
+online: an outlier-clipped exponentially-weighted variance and a
+Robbins-Monro streaming estimate of the median absolute residual (the
+MAD). The anomaly score maps the two-sided normal tail probability of the
+standardized residual to the reference's 0-100 range via
+score = -10*log10(p), the same shape the reference's
+anomaly-score normalizer produces for its probability buckets.
+
+All arrays are padded to a power-of-two series capacity so XLA sees a
+stable shape while partitions are discovered mid-stream; a series mask
+keeps dead slots inert. State lives host-side between batches (it must
+serialize into model snapshots); one jitted call per datafeed batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# learning rates (error-correction Holt-Winters)
+ALPHA = 0.30      # level
+BETA = 0.10       # trend (applied to alpha*resid)
+GAMMA = 0.25      # seasonal
+PHI = 0.98        # trend damping
+RHO = 0.10        # EW variance
+Q_ETA = 0.10      # Robbins-Monro step for the MAD quantile
+WARMUP = 8        # buckets before a series may score
+CLIP_Z = 4.0      # residual clip (in sigmas) for the robust var update
+MIN_CAP = 8
+
+STATE_KEYS = ("n", "level", "trend", "season", "var", "qmad")
+
+
+def init_state(cap: int, period: int) -> dict:
+    """Fresh model state with `cap` series slots and seasonal period
+    `period` buckets (period <= 1 disables the seasonal component)."""
+    cap = max(MIN_CAP, 1 << (int(cap) - 1).bit_length())
+    p = max(1, int(period))
+    return {
+        "n": np.zeros(cap, np.int32),
+        "level": np.zeros(cap, np.float32),
+        "trend": np.zeros(cap, np.float32),
+        "season": np.zeros((cap, p), np.float32),
+        "var": np.zeros(cap, np.float32),
+        "qmad": np.zeros(cap, np.float32),
+    }
+
+
+def state_cap(state: dict) -> int:
+    return int(state["level"].shape[0])
+
+
+def state_period(state: dict) -> int:
+    return int(state["season"].shape[1])
+
+
+def state_nbytes(state: dict) -> int:
+    return int(sum(np.asarray(v).nbytes for v in state.values()))
+
+
+def grow_state(state: dict, need: int) -> dict:
+    """Return state with capacity >= need (power of two); new slots fresh."""
+    cap = state_cap(state)
+    if need <= cap:
+        return state
+    new_cap = 1 << (int(need) - 1).bit_length()
+    out = {}
+    for k in STATE_KEYS:
+        a = np.asarray(state[k])
+        pad = [(0, new_cap - cap)] + [(0, 0)] * (a.ndim - 1)
+        out[k] = np.pad(a, pad)
+    return out
+
+
+def _scale_of(level, var, qmad):
+    """Robust residual scale: EW sigma vs 1.4826*MAD, floored relative to
+    the series level so a near-constant series cannot divide by ~zero."""
+    floor = 0.05 * (jnp.abs(level) + 1e-3)
+    return jnp.maximum(jnp.maximum(jnp.sqrt(var), 1.4826 * qmad), floor)
+
+
+def _step(carry, xs):
+    """One bucket for all series. xs: (x [S], present [S], phase [])."""
+    n, level, trend, season, var, qmad = carry
+    x, present, phase = xs
+    p = season.shape[1]
+    seas = season[:, phase] if p > 1 else jnp.zeros_like(level)
+    pred = level + trend + seas
+    # a fresh series (n == 0) anchors the level at its first observation
+    pred = jnp.where(n == 0, x, pred)
+    resid = x - pred
+    scale = _scale_of(level, var, qmad)
+    warm = n >= WARMUP
+    z = jnp.where(warm & present, resid / scale, 0.0)
+    # two-sided normal tail -> 0..100 (one-sidedness applied by the caller)
+    prob = jax.scipy.special.erfc(jnp.abs(z) * (1.0 / np.sqrt(2.0)))
+    score = jnp.clip(-10.0 * jnp.log10(jnp.maximum(prob, 1e-300)), 0.0, 100.0)
+
+    # --- updates (only where the bucket has a value) ---
+    nf = n.astype(jnp.float32)
+    eff_alpha = jnp.maximum(ALPHA, 1.0 / (nf + 1.0))
+    r_clip = jnp.where(warm, jnp.clip(resid, -CLIP_Z * scale, CLIP_Z * scale),
+                       resid)
+    level2 = level + trend + eff_alpha * resid
+    trend2 = PHI * (trend + BETA * eff_alpha * resid)
+    var2 = jnp.where(n == 0, 0.0,
+                     var + jnp.maximum(RHO, 1.0 / (nf + 1.0))
+                     * (r_clip * r_clip - var))
+    eta = Q_ETA * jnp.maximum(qmad, 0.1 * jnp.abs(r_clip) + 1e-9)
+    qmad2 = jnp.maximum(qmad + eta * jnp.sign(jnp.abs(r_clip) - qmad), 0.0)
+    if p > 1:
+        snew = season[:, phase] + GAMMA * (1.0 - eff_alpha) * r_clip
+        season2 = season.at[:, phase].set(jnp.where(present, snew,
+                                                    season[:, phase]))
+    else:
+        season2 = season
+    upd = lambda new, old: jnp.where(present, new, old)
+    carry2 = (
+        jnp.where(present, n + 1, n),
+        upd(level2, level), upd(trend2, trend), season2,
+        upd(var2, var), upd(qmad2, qmad),
+    )
+    return carry2, (score, pred, scale)
+
+
+@partial(jax.jit, static_argnums=())
+def _run_batch(n, level, trend, season, var, qmad, values, present, phases):
+    carry = (n, level, trend, season, var, qmad)
+    carry, (scores, preds, scales) = jax.lax.scan(
+        _step, carry, (values, present, phases))
+    return carry, scores, preds, scales
+
+
+def update_and_score(state: dict, values: np.ndarray, present: np.ndarray,
+                     phases: np.ndarray) -> tuple[dict, dict]:
+    """Consume `values [B, S]` (S <= capacity; padded on device) with
+    `present [B, S]` masks and per-bucket seasonal `phases [B]`.
+
+    -> (new_state, {"scores": [B, S], "typical": [B, S], "scales": [B, S]})
+    — one jitted device call for the whole batch."""
+    cap = state_cap(state)
+    B, S = values.shape
+    if S > cap:
+        raise ValueError(f"batch has {S} series but capacity is {cap}")
+    v = np.zeros((B, cap), np.float32)
+    v[:, :S] = values
+    m = np.zeros((B, cap), bool)
+    m[:, :S] = present
+    carry, scores, preds, scales = _run_batch(
+        jnp.asarray(state["n"]), jnp.asarray(state["level"]),
+        jnp.asarray(state["trend"]), jnp.asarray(state["season"]),
+        jnp.asarray(state["var"]), jnp.asarray(state["qmad"]),
+        jnp.asarray(v), jnp.asarray(m),
+        jnp.asarray(phases.astype(np.int32) % state_period(state)),
+    )
+    new_state = {k: np.array(a) for k, a in zip(STATE_KEYS, carry)}
+    return new_state, {  # np.array: writable host copies (device buffers
+        # surface as read-only views through np.asarray)
+        "scores": np.array(scores[:, :S]),
+        "typical": np.array(preds[:, :S]),
+        "scales": np.array(scales[:, :S]),
+    }
+
+
+# ---- snapshot serialization ------------------------------------------------
+
+_MAGIC = b"ESTPUML1"
+
+
+def serialize_state(state: dict, meta: dict) -> bytes:
+    """-> one deterministic payload: magic, JSON manifest (array dtypes/
+    shapes + opaque meta), then the raw array bytes. Byte-identical state
+    serializes byte-identically, so the content-addressed blob store
+    dedups unchanged model snapshots for free."""
+    import json
+
+    manifest = {"meta": meta, "arrays": []}
+    blobs = []
+    for k in STATE_KEYS:
+        a = np.ascontiguousarray(state[k])
+        manifest["arrays"].append(
+            {"key": k, "dtype": str(a.dtype), "shape": list(a.shape)})
+        blobs.append(a.tobytes())
+    head = json.dumps(manifest, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return b"".join([_MAGIC, len(head).to_bytes(8, "big"), head] + blobs)
+
+
+def deserialize_state(payload: bytes) -> tuple[dict, dict]:
+    """-> (state, meta); inverse of serialize_state."""
+    import json
+
+    if payload[:8] != _MAGIC:
+        raise ValueError("not an ML model-state payload")
+    hlen = int.from_bytes(payload[8:16], "big")
+    manifest = json.loads(payload[16:16 + hlen])
+    off = 16 + hlen
+    state = {}
+    for spec in manifest["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        count = int(np.prod(spec["shape"])) if spec["shape"] else 1
+        nbytes = dt.itemsize * count
+        state[spec["key"]] = np.frombuffer(
+            payload[off:off + nbytes], dt).reshape(spec["shape"]).copy()
+        off += nbytes
+    return state, manifest["meta"]

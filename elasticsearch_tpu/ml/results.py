@@ -1,0 +1,180 @@
+"""ML result documents: reference-shaped records / buckets written to a
+hidden per-job results index, queryable through the normal search surface.
+
+Parity target: x-pack/plugin/core/.../ml/job/results/{AnomalyRecord,
+Bucket}.java field-for-field (record_score, initial_record_score, typical/
+actual arrays, detector_index, partition fields; bucket anomaly_score,
+event_count) — the results APIs in the reference are themselves just
+searches over .ml-anomalies-*.
+"""
+
+from __future__ import annotations
+
+from ..utils.errors import ResourceNotFoundError
+from .config import JobConfig, results_index_name
+
+# records below this score are not persisted (the reference's unusual-
+# bucket probability cutoff ~3.5% maps to roughly this -10log10(p))
+RECORD_SCORE_FLOOR = 15.0
+
+RESULTS_MAPPINGS = {
+    "properties": {
+        "job_id": {"type": "keyword"},
+        "result_type": {"type": "keyword"},
+        "timestamp": {"type": "date"},
+        "bucket_span": {"type": "long"},
+        "is_interim": {"type": "boolean"},
+        "record_score": {"type": "double"},
+        "initial_record_score": {"type": "double"},
+        "probability": {"type": "double"},
+        "detector_index": {"type": "long"},
+        "function": {"type": "keyword"},
+        "field_name": {"type": "keyword"},
+        "partition_field_name": {"type": "keyword"},
+        "partition_field_value": {"type": "keyword"},
+        "actual": {"type": "double"},
+        "typical": {"type": "double"},
+        "anomaly_score": {"type": "double"},
+        "initial_anomaly_score": {"type": "double"},
+        "event_count": {"type": "long"},
+        "processing_time_ms": {"type": "double"},
+    }
+}
+
+
+def ensure_results_index(engine, job: JobConfig):
+    name = results_index_name(job.job_id)
+    if name not in engine.indices:
+        engine.create_index(name, mappings=RESULTS_MAPPINGS,
+                            settings={"hidden": True})
+    return engine.indices[name]
+
+
+def record_doc(job: JobConfig, det, ts_ms: int, score: float,
+               actual: float, typical: float, probability: float,
+               partition_value: str | None) -> tuple[str, dict]:
+    doc = {
+        "job_id": job.job_id,
+        "result_type": "record",
+        "timestamp": int(ts_ms),
+        "bucket_span": job.bucket_span,
+        "is_interim": False,
+        "record_score": round(float(score), 4),
+        "initial_record_score": round(float(score), 4),
+        "probability": float(probability),
+        "detector_index": det.index,
+        "function": det.function,
+        "actual": [float(actual)],
+        "typical": [float(typical)],
+    }
+    if det.field_name:
+        doc["field_name"] = det.field_name
+    if det.split_field:
+        doc["partition_field_name"] = det.split_field
+        doc["partition_field_value"] = partition_value
+    doc_id = f"{job.job_id}_record_{ts_ms}_{det.index}_{partition_value or ''}"
+    return doc_id, doc
+
+
+def bucket_doc(job: JobConfig, ts_ms: int, anomaly_score: float,
+               event_count: int, processing_time_ms: float) -> tuple[str, dict]:
+    doc = {
+        "job_id": job.job_id,
+        "result_type": "bucket",
+        "timestamp": int(ts_ms),
+        "bucket_span": job.bucket_span,
+        "is_interim": False,
+        "anomaly_score": round(float(anomaly_score), 4),
+        "initial_anomaly_score": round(float(anomaly_score), 4),
+        "event_count": int(event_count),
+        "processing_time_ms": float(processing_time_ms),
+    }
+    return f"{job.job_id}_bucket_{ts_ms}", doc
+
+
+def _time_range_filter(body: dict, extra_filters: list):
+    rng = {}
+    if body.get("start") is not None:
+        rng["gte"] = body["start"]
+    if body.get("end") is not None:
+        rng["lt"] = body["end"]
+    if rng:
+        rng["format"] = "epoch_millis||strict_date_optional_time"
+        extra_filters.append({"range": {"timestamp": rng}})
+
+
+def _query_results(engine, job_id: str, result_type: str, body: dict,
+                   score_field: str, threshold_key: str, default_sort: str):
+    name = results_index_name(job_id)
+    if name not in engine.indices:
+        return 0, []
+    filters: list = [{"term": {"result_type": result_type}}]
+    _time_range_filter(body or {}, filters)
+    threshold = (body or {}).get(threshold_key)
+    if threshold is not None:
+        filters.append({"range": {score_field: {"gte": float(threshold)}}})
+    page = (body or {}).get("page") or {}
+    size = int(page.get("size", 100))
+    from_ = int(page.get("from", 0))
+    sort_field = (body or {}).get("sort", default_sort)
+    desc_raw = (body or {}).get("desc", False)  # may be a query-param string
+    desc = desc_raw if isinstance(desc_raw, bool) \
+        else str(desc_raw).lower() in ("", "true", "1")
+    engine.indices[name]._maybe_refresh()
+    res = engine.search_multi(
+        name, query={"bool": {"filter": filters}},
+        size=size, from_=from_,
+        sort=[{sort_field: {"order": "desc" if desc else "asc"}},
+              {"timestamp": {"order": "asc"}}],
+        track_total_hits=True,
+    )
+    total = res["hits"]["total"]["value"]
+    return total, [h["_source"] for h in res["hits"]["hits"]]
+
+
+def get_records(engine, job_id: str, body: dict | None) -> dict:
+    total, docs = _query_results(
+        engine, job_id, "record", body or {}, "record_score",
+        "record_score", "timestamp")
+    return {"count": total, "records": docs}
+
+
+def get_buckets(engine, job_id: str, body: dict | None,
+                timestamp: str | None = None) -> dict:
+    body = dict(body or {})
+    if timestamp is not None:
+        body["start"] = timestamp
+        body["end"] = int(timestamp) + 1 if str(timestamp).isdigit() else timestamp
+    total, docs = _query_results(
+        engine, job_id, "bucket", body, "anomaly_score",
+        "anomaly_score", "timestamp")
+    if timestamp is not None and not docs:
+        raise ResourceNotFoundError(
+            f"No known bucket with timestamp [{timestamp}]")
+    return {"count": total, "buckets": docs}
+
+
+def get_overall_buckets(engine, job_ids: list[str], body: dict | None) -> dict:
+    """Max bucket anomaly_score per timestamp across jobs (the reference's
+    overall-bucket reduce with top_n=1)."""
+    per_ts: dict[int, dict] = {}
+    span = 0
+    for job_id in job_ids:
+        _, buckets = _query_results(
+            engine, job_id, "bucket", body or {}, "anomaly_score",
+            "overall_score", "timestamp")
+        for b in buckets:
+            span = max(span, b["bucket_span"])
+            entry = per_ts.setdefault(b["timestamp"], {
+                "timestamp": b["timestamp"], "bucket_span": b["bucket_span"],
+                "overall_score": 0.0, "is_interim": False, "jobs": []})
+            entry["jobs"].append({"job_id": b["job_id"],
+                                  "max_anomaly_score": b["anomaly_score"]})
+            entry["overall_score"] = max(entry["overall_score"],
+                                         b["anomaly_score"])
+            entry["is_interim"] = entry["is_interim"] or b["is_interim"]
+    out = [per_ts[k] for k in sorted(per_ts)]
+    threshold = (body or {}).get("overall_score")
+    if threshold is not None:
+        out = [b for b in out if b["overall_score"] >= float(threshold)]
+    return {"count": len(out), "overall_buckets": out}

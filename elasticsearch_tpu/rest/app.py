@@ -890,6 +890,142 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def health_report_api(request):
         return web.json_response(await _xcall("xpack", "health_report"))
 
+    # ---- machine learning (_ml) ------------------------------------------
+    # reference behavior: x-pack/plugin/ml rest/job/RestPutJobAction etc. —
+    # jobs + datafeeds + results + model snapshots under /_ml
+
+    @handler
+    async def ml_put_job(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            engine.ml.put_job, request.match_info["job_id"], body))
+
+    @handler
+    async def ml_get_jobs(request):
+        return web.json_response(await call(
+            engine.ml.get_jobs, request.match_info.get("job_id")))
+
+    @handler
+    async def ml_delete_job(request):
+        return web.json_response(await call(
+            engine.ml.delete_job, request.match_info["job_id"],
+            _bool_param(request.query, "force")))
+
+    @handler
+    async def ml_open_job(request):
+        return web.json_response(await call(
+            engine.ml.open_job, request.match_info["job_id"]))
+
+    @handler
+    async def ml_close_job(request):
+        return web.json_response(await call(
+            engine.ml.close_job, request.match_info["job_id"],
+            _bool_param(request.query, "force")))
+
+    @handler
+    async def ml_flush_job(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            engine.ml.flush_job, request.match_info["job_id"], body))
+
+    @handler
+    async def ml_job_stats(request):
+        return web.json_response(await call(
+            engine.ml.job_stats, request.match_info.get("job_id")))
+
+    @handler
+    async def ml_get_records(request):
+        from ..ml import results as ml_results
+
+        body = await body_json(request, {}) or {}
+        for p in ("start", "end", "record_score", "sort", "desc"):
+            if p in request.query and p not in body:
+                body[p] = request.query[p]
+        return web.json_response(await call(
+            ml_results.get_records, engine, request.match_info["job_id"], body))
+
+    @handler
+    async def ml_get_buckets(request):
+        from ..ml import results as ml_results
+
+        body = await body_json(request, {}) or {}
+        for p in ("start", "end", "anomaly_score", "sort", "desc"):
+            if p in request.query and p not in body:
+                body[p] = request.query[p]
+        return web.json_response(await call(
+            ml_results.get_buckets, engine, request.match_info["job_id"],
+            body, request.match_info.get("timestamp")))
+
+    @handler
+    async def ml_get_overall_buckets(request):
+        from ..ml import results as ml_results
+
+        body = await body_json(request, {}) or {}
+        for p in ("start", "end", "overall_score"):
+            if p in request.query and p not in body:
+                body[p] = request.query[p]
+        expr = request.match_info["job_id"]
+        if expr in ("_all", "*"):
+            job_ids = sorted(engine.ml._jobs())
+        else:
+            job_ids = [j for j in expr.split(",")]
+        return web.json_response(await call(
+            ml_results.get_overall_buckets, engine, job_ids, body))
+
+    @handler
+    async def ml_get_model_snapshots(request):
+        return web.json_response(await call(
+            engine.ml.get_model_snapshots, request.match_info["job_id"]))
+
+    @handler
+    async def ml_revert_model_snapshot(request):
+        return web.json_response(await call(
+            engine.ml.revert_model_snapshot, request.match_info["job_id"],
+            request.match_info["snapshot_id"]))
+
+    @handler
+    async def ml_put_datafeed(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            engine.ml.put_datafeed, request.match_info["datafeed_id"], body))
+
+    @handler
+    async def ml_get_datafeeds(request):
+        return web.json_response(await call(
+            engine.ml.get_datafeeds, request.match_info.get("datafeed_id")))
+
+    @handler
+    async def ml_delete_datafeed(request):
+        return web.json_response(await call(
+            engine.ml.delete_datafeed, request.match_info["datafeed_id"]))
+
+    @handler
+    async def ml_start_datafeed(request):
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            engine.ml.start_datafeed, request.match_info["datafeed_id"],
+            request.query.get("start", body.get("start")),
+            request.query.get("end", body.get("end"))))
+
+    @handler
+    async def ml_stop_datafeed(request):
+        return web.json_response(await call(
+            engine.ml.stop_datafeed, request.match_info["datafeed_id"]))
+
+    @handler
+    async def ml_datafeed_stats(request):
+        return web.json_response(await call(
+            engine.ml.datafeed_stats, request.match_info.get("datafeed_id")))
+
+    @handler
+    async def ml_preview_datafeed(request):
+        return web.json_response(await call(
+            engine.ml.preview_datafeed, request.match_info["datafeed_id"]))
+
+    @handler
+    async def ml_info(request):
+        return web.json_response(await call(engine.ml.info))
+
     # ---- transform / downsample / CCS ------------------------------------
 
     @handler
@@ -2186,6 +2322,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                             "request_cache": request_cache().stats(),
                         },
                         "breakers": engine.breakers.stats(),
+                        # reference shape: _nodes/stats ml section
+                        # (anomaly detectors / datafeeds / model memory)
+                        "ml": engine.ml.node_stats(),
                         "tpu": {"devices": devices},
                         "metrics": metrics.snapshot(),
                     }
@@ -2332,6 +2471,43 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_enrich/policy/{name}", enrich_get)
     app.router.add_delete("/_enrich/policy/{name}", enrich_delete)
     app.router.add_get("/_health_report", health_report_api)
+    app.router.add_put("/_ml/anomaly_detectors/{job_id}", ml_put_job)
+    app.router.add_get("/_ml/anomaly_detectors", ml_get_jobs)
+    app.router.add_get("/_ml/anomaly_detectors/_stats", ml_job_stats)
+    app.router.add_get("/_ml/anomaly_detectors/{job_id}", ml_get_jobs)
+    app.router.add_delete("/_ml/anomaly_detectors/{job_id}", ml_delete_job)
+    app.router.add_post("/_ml/anomaly_detectors/{job_id}/_open", ml_open_job)
+    app.router.add_post("/_ml/anomaly_detectors/{job_id}/_close", ml_close_job)
+    app.router.add_post("/_ml/anomaly_detectors/{job_id}/_flush", ml_flush_job)
+    app.router.add_get("/_ml/anomaly_detectors/{job_id}/_stats", ml_job_stats)
+    app.router.add_route(
+        "*", "/_ml/anomaly_detectors/{job_id}/results/records", ml_get_records)
+    app.router.add_route(
+        "*", "/_ml/anomaly_detectors/{job_id}/results/buckets", ml_get_buckets)
+    app.router.add_route(
+        "*", "/_ml/anomaly_detectors/{job_id}/results/buckets/{timestamp}",
+        ml_get_buckets)
+    app.router.add_route(
+        "*", "/_ml/anomaly_detectors/{job_id}/results/overall_buckets",
+        ml_get_overall_buckets)
+    app.router.add_get("/_ml/anomaly_detectors/{job_id}/model_snapshots",
+                       ml_get_model_snapshots)
+    app.router.add_post(
+        "/_ml/anomaly_detectors/{job_id}/model_snapshots/{snapshot_id}/_revert",
+        ml_revert_model_snapshot)
+    app.router.add_put("/_ml/datafeeds/{datafeed_id}", ml_put_datafeed)
+    app.router.add_get("/_ml/datafeeds", ml_get_datafeeds)
+    app.router.add_get("/_ml/datafeeds/_stats", ml_datafeed_stats)
+    app.router.add_get("/_ml/datafeeds/{datafeed_id}", ml_get_datafeeds)
+    app.router.add_delete("/_ml/datafeeds/{datafeed_id}", ml_delete_datafeed)
+    app.router.add_post("/_ml/datafeeds/{datafeed_id}/_start", ml_start_datafeed)
+    app.router.add_post("/_ml/datafeeds/{datafeed_id}/_stop", ml_stop_datafeed)
+    app.router.add_get("/_ml/datafeeds/{datafeed_id}/_stats", ml_datafeed_stats)
+    app.router.add_get("/_ml/datafeeds/{datafeed_id}/_preview",
+                       ml_preview_datafeed)
+    app.router.add_post("/_ml/datafeeds/{datafeed_id}/_preview",
+                        ml_preview_datafeed)
+    app.router.add_get("/_ml/info", ml_info)
     app.router.add_get("/_inference/_all", inference_get)
     app.router.add_get("/_inference/{id}", inference_get)
     app.router.add_put("/_inference/{id}", inference_put)

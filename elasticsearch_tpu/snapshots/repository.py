@@ -212,6 +212,7 @@ class FsRepository(Repository):
 
     def write(self, name: str, data: bytes):
         p = self._path(name)
+        os.makedirs(os.path.dirname(p), exist_ok=True)  # nested containers
         tmp = p + ".part"
         with open(tmp, "wb") as f:
             f.write(data)

@@ -13,6 +13,15 @@ import time
 
 from ..utils.errors import IllegalArgumentError, ResourceAlreadyExistsError, ResourceNotFoundError
 
+# task types whose executor lives in a lazily-built engine service: the
+# bootstrap touches the service (which registers the executor in its
+# constructor) the first time a persisted task of that type ticks after a
+# node restart — without it, tasks persisted by a previous process would
+# sit idle until something else happened to build the service
+_LAZY_EXECUTOR_BOOTSTRAP = {
+    "xpack/ml/job": lambda engine: engine.ml,
+}
+
 
 class PersistentTasksService:
     """Registry + scheduler for named long-running tasks."""
@@ -44,6 +53,10 @@ class PersistentTasksService:
             "params": params,
             "state": {},
             "allocation_id": 1,
+            # the node currently executing the task (reference behavior:
+            # PersistentTasksCustomMetadata assignment); failover bumps
+            # allocation_id and reassigns
+            "assigned_node": getattr(self.engine.tasks, "node", None),
             "started_ms": int(time.time() * 1000),
             "stopped": False,
         }
@@ -61,6 +74,7 @@ class PersistentTasksService:
         task = self.get(task_id)
         task["stopped"] = False
         task["allocation_id"] += 1
+        task["assigned_node"] = getattr(self.engine.tasks, "node", None)
         self.engine.meta.save()
         return task
 
@@ -89,6 +103,11 @@ class PersistentTasksService:
             if task.get("stopped"):
                 continue
             ex = self.executors.get(task["name"])
+            if ex is None:
+                boot = _LAZY_EXECUTOR_BOOTSTRAP.get(task["name"])
+                if boot is not None:
+                    boot(self.engine)
+                    ex = self.executors.get(task["name"])
             if ex is None:
                 continue
             ex.tick(self.engine, task)

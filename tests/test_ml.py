@@ -1,0 +1,503 @@
+"""ML subsystem: anomaly-detection jobs end-to-end — REST surface,
+native JAX model behavior, model snapshots (close/reopen), persistent-task
+failover to another node, breaker-accounted model memory."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.ml import results as ml_results
+from elasticsearch_tpu.ml import model as ml_model
+from elasticsearch_tpu.rest import make_app
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+SPAN_MS = 3600_000
+T0 = 1700000000000 - (1700000000000 % SPAN_MS)
+
+
+def seed_metric_stream(idx, n_buckets, anomalies=(), *, shift=80.0,
+                       events_per_bucket=4, seed=7, host="h1", start_doc=0):
+    """Daily-seasonal synthetic stream: mean 100, +-10 sinusoid over 24
+    buckets, sigma-3 noise; `anomalies` buckets are shifted by `shift`."""
+    rng = np.random.default_rng(seed)
+    docid = start_doc
+    for b in range(n_buckets):
+        base = 100 + 10 * np.sin(2 * np.pi * (b % 24) / 24)
+        for k in range(events_per_bucket):
+            v = base + rng.normal(0, 3)
+            if b in anomalies:
+                v += shift
+            idx.index_doc(f"{host}-{docid}", {
+                "time": T0 + b * SPAN_MS + k * 600_000,
+                "value": float(v), "host": host})
+            docid += 1
+    idx.refresh()
+    return docid
+
+
+METRICS_MAPPINGS = {"properties": {"time": {"type": "date"},
+                                   "value": {"type": "double"},
+                                   "host": {"type": "keyword"}}}
+
+JOB_BODY = {
+    "analysis_config": {
+        "bucket_span": "1h",
+        "detectors": [{"function": "mean", "field_name": "value"}],
+    },
+    "data_description": {"time_field": "time"},
+}
+
+
+def _mk_engine(tmp_path, name="n1"):
+    return Engine(str(tmp_path / name))
+
+
+def record_buckets(engine, job_id, threshold):
+    recs = ml_results.get_records(engine, job_id,
+                                  {"record_score": threshold})
+    return sorted({(r["timestamp"] - T0) // SPAN_MS for r in recs["records"]})
+
+
+# ---------------------------------------------------------------------------
+# REST end-to-end
+# ---------------------------------------------------------------------------
+
+def test_ml_rest_end_to_end(tmp_path):
+    async def scenario(c):
+        # source index + synthetic stream with injected anomalies via bulk
+        r = await c.put("/metrics", json={"mappings": METRICS_MAPPINGS})
+        assert r.status == 200
+        rng = np.random.default_rng(3)
+        lines = []
+        anomalies = {100, 180}
+        for b in range(240):
+            base = 100 + 10 * np.sin(2 * np.pi * (b % 24) / 24)
+            for k in range(4):
+                v = base + rng.normal(0, 3) + (80 if b in anomalies else 0)
+                lines.append(json.dumps({"index": {"_id": f"{b}-{k}"}}))
+                lines.append(json.dumps(
+                    {"time": T0 + b * SPAN_MS + k * 600_000,
+                     "value": float(v), "host": "h1"}))
+        r = await c.post("/metrics/_bulk?refresh=true",
+                         data="\n".join(lines) + "\n",
+                         headers={"Content-Type": "application/json"})
+        assert r.status == 200 and not (await r.json())["errors"]
+
+        r = await c.put("/_ml/anomaly_detectors/rest-job", json=JOB_BODY)
+        assert r.status == 200
+        body = await r.json()
+        assert body["job_id"] == "rest-job"
+        assert body["analysis_config"]["bucket_span"] == "3600s"
+        # duplicate id rejected
+        r = await c.put("/_ml/anomaly_detectors/rest-job", json=JOB_BODY)
+        assert r.status == 400 and (await r.json())["error"]["type"] \
+            == "resource_already_exists_exception"
+
+        r = await c.post("/_ml/anomaly_detectors/rest-job/_open")
+        assert r.status == 200 and (await r.json())["opened"] is True
+        r = await c.put("/_ml/datafeeds/rest-feed",
+                        json={"job_id": "rest-job", "indices": ["metrics"]})
+        assert r.status == 200
+        r = await c.get("/_ml/datafeeds/rest-feed/_preview")
+        preview = await r.json()
+        assert preview and preview[0]["value"] is not None
+
+        r = await c.post(
+            "/_ml/datafeeds/rest-feed/_start",
+            json={"start": T0, "end": T0 + 240 * SPAN_MS})
+        assert r.status == 200 and (await r.json())["started"] is True
+
+        # records: the injected buckets and ONLY them above the threshold
+        r = await c.post(
+            "/_ml/anomaly_detectors/rest-job/results/records",
+            json={"record_score": 50})
+        recs = await r.json()
+        got = sorted({(x["timestamp"] - T0) // SPAN_MS
+                      for x in recs["records"]})
+        assert got == [100, 180], recs
+        for x in recs["records"]:
+            assert x["function"] == "mean" and x["field_name"] == "value"
+            assert x["actual"][0] > x["typical"][0]
+
+        r = await c.post(
+            "/_ml/anomaly_detectors/rest-job/results/buckets",
+            json={"anomaly_score": 50})
+        buckets = (await r.json())["buckets"]
+        assert sorted({(b["timestamp"] - T0) // SPAN_MS
+                       for b in buckets}) == [100, 180]
+        assert all(b["event_count"] == 4 for b in buckets)
+        # single-bucket lookup + overall buckets
+        ts = buckets[0]["timestamp"]
+        r = await c.get(
+            f"/_ml/anomaly_detectors/rest-job/results/buckets/{ts}")
+        assert (await r.json())["buckets"][0]["timestamp"] == ts
+        r = await c.post(
+            "/_ml/anomaly_detectors/rest-job/results/overall_buckets",
+            json={"overall_score": 50})
+        overall = await r.json()
+        assert {b["jobs"][0]["job_id"] for b in overall["overall_buckets"]} \
+            == {"rest-job"}
+
+        # results are ALSO plain search-surface documents
+        r = await c.post("/.ml-anomalies-rest-job/_search", json={
+            "query": {"bool": {"filter": [
+                {"term": {"result_type": "record"}},
+                {"range": {"record_score": {"gte": 50}}}]}},
+            "size": 10})
+        hits = (await r.json())["hits"]["hits"]
+        assert len(hits) == 2
+
+        r = await c.get("/_ml/anomaly_detectors/rest-job/_stats")
+        stats = (await r.json())["jobs"][0]
+        assert stats["state"] == "opened"
+        assert stats["data_counts"]["bucket_count"] == 240
+        assert stats["data_counts"]["processed_record_count"] == 960
+        assert stats["model_size_stats"]["model_bytes"] > 0
+        assert stats["model_size_stats"]["memory_status"] == "ok"
+
+        r = await c.get("/_nodes/stats")
+        ml_section = (await r.json())["nodes"]["node-0"]["ml"]
+        assert ml_section["anomaly_detectors"]["opened"] == 1
+        assert ml_section["model_memory_bytes"] > 0
+
+        r = await c.post("/_ml/anomaly_detectors/rest-job/_flush")
+        flush = await r.json()
+        assert flush["flushed"] is True
+        assert flush["last_finalized_bucket_end"] == T0 + 240 * SPAN_MS
+
+        r = await c.get("/_ml/anomaly_detectors/rest-job/model_snapshots")
+        snaps = await r.json()
+        assert snaps["count"] >= 1
+
+        r = await c.get("/_ml/info")
+        info = await r.json()
+        assert "jax-native" in info["native_code"]["version"]
+
+        r = await c.post("/_ml/anomaly_detectors/rest-job/_close")
+        assert (await r.json())["closed"] is True
+        r = await c.get("/_ml/anomaly_detectors/rest-job/_stats")
+        assert (await r.json())["jobs"][0]["state"] == "closed"
+
+        r = await c.delete("/_ml/anomaly_detectors/rest-job")
+        assert (await r.json())["acknowledged"] is True
+        assert (await c.get("/.ml-anomalies-rest-job")).status == 404
+        r = await c.get("/_ml/anomaly_detectors/rest-job")
+        assert r.status == 404
+
+    async def wrapper():
+        app = make_app(data_path=str(tmp_path / "data"))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(wrapper())
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# model snapshots: close -> reopen preserves learned state
+# ---------------------------------------------------------------------------
+
+def test_ml_close_reopen_from_snapshot(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    # second-half anomaly lands 4 buckets after reopen: only a model that
+    # kept its learned state can flag it (a fresh model is in warmup)
+    seed_metric_stream(e.indices["metrics"], 240, anomalies={124, 180})
+    ml = e.ml
+    ml.put_job("j1", JOB_BODY)
+    ml.open_job("j1")
+    ml.put_datafeed("df1", {"job_id": "j1", "indices": ["metrics"]})
+    ml.start_datafeed("df1", start=T0, end=T0 + 120 * SPAN_MS)
+    assert record_buckets(e, "j1", 50) == []
+    rt = ml.runtimes["j1"]
+    assert rt.counts["bucket_count"] == 120
+    ml.close_job("j1")
+    assert "j1" not in ml.runtimes
+    assert e.breakers.stats()["model_inference"]["estimated_size_in_bytes"] == 0
+
+    ml.open_job("j1")
+    rt = ml.runtimes["j1"]
+    assert rt.counts["bucket_count"] == 120  # restored, not re-learned
+    assert rt.processed_end_ms == T0 + 120 * SPAN_MS
+    assert rt.allocation_id == 2
+    ml.start_datafeed("df1", start=T0, end=T0 + 240 * SPAN_MS)
+    assert record_buckets(e, "j1", 50) == [124, 180]
+    assert ml.runtimes["j1"].counts["bucket_count"] == 240
+    ml.close_job("j1")
+
+
+def test_ml_revert_model_snapshot(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    seed_metric_stream(e.indices["metrics"], 240)
+    ml = e.ml
+    ml.put_job("j1", JOB_BODY)
+    ml.open_job("j1")
+    ml.put_datafeed("df1", {"job_id": "j1", "indices": ["metrics"]})
+    ml.start_datafeed("df1", start=T0, end=T0 + 120 * SPAN_MS)
+    first = ml.get_model_snapshots("j1")["model_snapshots"][-1]
+    ml.start_datafeed("df1", start=T0, end=T0 + 240 * SPAN_MS)
+    snaps = ml.get_model_snapshots("j1")["model_snapshots"]
+    assert len(snaps) == 2 and snaps[-1]["snapshot_id"] != first["snapshot_id"]
+    with pytest.raises(IllegalArgumentError):
+        ml.revert_model_snapshot("j1", first["snapshot_id"])  # still open
+    ml.close_job("j1")
+    # close checkpointed a third snapshot? state unchanged since lookback
+    # checkpoint -> content-addressed dedup keeps the list at 2
+    assert len(ml.get_model_snapshots("j1")["model_snapshots"]) == 2
+    ml.revert_model_snapshot("j1", first["snapshot_id"])
+    ml.open_job("j1")
+    assert ml.runtimes["j1"].counts["bucket_count"] == 120
+    ml.close_job("j1")
+
+
+# ---------------------------------------------------------------------------
+# failover: another node adopts the job from the shared state repository
+# ---------------------------------------------------------------------------
+
+def test_ml_failover_to_other_node_preserves_state(tmp_path):
+    repo = str(tmp_path / "shared_ml_state")
+    e1 = _mk_engine(tmp_path, "node1")
+    e1.settings.update(
+        {"persistent": {"xpack.ml.state_repository_path": repo}})
+    e1.create_index("metrics", mappings=METRICS_MAPPINGS)
+    seed_metric_stream(e1.indices["metrics"], 120)
+    ml1 = e1.ml
+    ml1.put_job("j1", JOB_BODY)
+    ml1.open_job("j1")
+    ml1.put_datafeed("df1", {"job_id": "j1", "indices": ["metrics"]})
+    ml1.start_datafeed("df1", start=T0, end=T0 + 120 * SPAN_MS)
+    task = e1.persistent.get("job-j1")
+    assert task["assigned_node"] == e1.tasks.node
+    # node1 dies here: NO close_job / engine.close — the only survivor is
+    # the shared state repository the lookback checkpointed into
+
+    e2 = _mk_engine(tmp_path, "node2")
+    e2.settings.update(
+        {"persistent": {"xpack.ml.state_repository_path": repo}})
+    e2.create_index("metrics", mappings=METRICS_MAPPINGS)
+    # the replicated stream continues on the surviving node; anomaly 4
+    # buckets after failover separates restored state from a fresh model
+    seed_metric_stream(e2.indices["metrics"], 240, anomalies={124, 180})
+    ml2 = e2.ml
+    assert "j1" not in ml2._jobs()          # unknown to node2's metadata...
+    ml2.open_job("j1")                      # ...adopted from the repository
+    rt = ml2.runtimes["j1"]
+    assert rt.counts["bucket_count"] == 120
+    assert rt.processed_end_ms == T0 + 120 * SPAN_MS
+    assert rt.allocation_id == 2
+    ml2.start_datafeed("df1", start=T0, end=T0 + 240 * SPAN_MS)
+    assert record_buckets(e2, "j1", 50) == [124, 180]
+    ml2.close_job("j1")
+
+
+# ---------------------------------------------------------------------------
+# persistent task: realtime ticks + node-restart resume
+# ---------------------------------------------------------------------------
+
+def test_ml_persistent_task_realtime_and_restart(tmp_path):
+    import time as _time
+
+    span_s = 60
+    now_ms = int(_time.time() * 1000)
+    t0 = (now_ms // (span_s * 1000) - 100) * span_s * 1000
+    body = {
+        "analysis_config": {"bucket_span": "1m", "period_buckets": 0,
+                            "detectors": [{"function": "count"}]},
+        "data_description": {"time_field": "time"},
+    }
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    idx = e.indices["metrics"]
+    for b in range(100):
+        for k in range(2):
+            idx.index_doc(f"{b}-{k}", {"time": t0 + b * span_s * 1000 + k})
+    idx.refresh()
+    ml = e.ml
+    ml.put_job("rt", body)
+    ml.open_job("rt")
+    ml.put_datafeed("rtfeed", {"job_id": "rt", "indices": ["metrics"]})
+    ml.start_datafeed("rtfeed", start=t0)          # no end: realtime
+    assert e.persistent.tick() == ["job-rt"]       # scheduler drives it
+    processed = ml.runtimes["rt"].counts["bucket_count"]
+    assert processed >= 99
+    assert (ml.datafeed_stats("rtfeed")["datafeeds"][0]["state"]
+            == "started")
+
+    # node restart on the same data path: the persistent task survives in
+    # metadata; the first scheduler tick lazily boots the ML service,
+    # reopens the job from its last snapshot, and keeps going
+    e2 = Engine(str(tmp_path / "n1"))
+    assert e2._ml is None
+    assert e2.persistent.tick() == ["job-rt"]
+    rt = e2.ml.runtimes["rt"]
+    assert rt.counts["bucket_count"] >= processed  # resumed, not restarted
+    assert rt.allocation_id >= 2
+
+
+# ---------------------------------------------------------------------------
+# model behavior
+# ---------------------------------------------------------------------------
+
+def test_model_warmup_and_seasonality():
+    state = ml_model.init_state(1, period=24)
+    rng = np.random.default_rng(0)
+    B = 24 * 8
+    phases = np.arange(B)
+    vals = (100 + 30 * np.sin(2 * np.pi * (phases % 24) / 24)
+            + rng.normal(0, 1, B)).reshape(-1, 1)
+    present = np.ones((B, 1), bool)
+    state, out = ml_model.update_and_score(state, vals, present, phases)
+    assert np.all(out["scores"][:ml_model.WARMUP] == 0)  # warmup never flags
+    assert float(out["scores"][-48:].max()) < 50          # learned the cycle
+    # peak-sized value at the trough phase (phase 18 ~ trough): anomalous;
+    # the SAME value at the peak phase (phase 6): normal
+    trough_phase = np.array([B + (18 - B % 24) % 24])
+    peak_phase = np.array([B + (6 - B % 24) % 24])
+    _, at_trough = ml_model.update_and_score(
+        dict(state), np.array([[130.0]]), np.ones((1, 1), bool), trough_phase)
+    _, at_peak = ml_model.update_and_score(
+        dict(state), np.array([[130.0]]), np.ones((1, 1), bool), peak_phase)
+    assert float(at_trough["scores"][0, 0]) > 50
+    assert float(at_peak["scores"][0, 0]) < 50
+
+
+def test_model_one_sided_detectors(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    seed_metric_stream(e.indices["metrics"], 120, anomalies={100},
+                       shift=-80.0)  # a DROP
+    ml = e.ml
+    body = {
+        "analysis_config": {
+            "bucket_span": "1h",
+            "detectors": [{"function": "high_mean", "field_name": "value"},
+                          {"function": "low_mean", "field_name": "value"}],
+        },
+        "data_description": {"time_field": "time"},
+    }
+    ml.put_job("sided", body)
+    ml.open_job("sided")
+    ml.put_datafeed("sided-df", {"job_id": "sided", "indices": ["metrics"]})
+    ml.start_datafeed("sided-df", start=T0, end=T0 + 120 * SPAN_MS)
+    recs = ml_results.get_records(e, "sided", {"record_score": 50})["records"]
+    assert recs, "the drop must be flagged"
+    assert {r["detector_index"] for r in recs} == {1}  # only low_mean
+    assert all(r["function"] == "low_mean" for r in recs)
+    ml.close_job("sided")
+
+
+def test_model_partitions_and_memory_accounting(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    idx = e.indices["metrics"]
+    doc = 0
+    for host_i in range(3):
+        doc = seed_metric_stream(idx, 60, anomalies={50} if host_i == 2 else (),
+                                 seed=host_i, host=f"host{host_i}",
+                                 start_doc=doc)
+    ml = e.ml
+    body = {
+        "analysis_config": {
+            "bucket_span": "1h",
+            "detectors": [{"function": "mean", "field_name": "value",
+                           "partition_field_name": "host"}],
+        },
+        "data_description": {"time_field": "time"},
+    }
+    ml.put_job("parts", body)
+    ml.open_job("parts")
+    ml.put_datafeed("parts-df", {"job_id": "parts", "indices": ["metrics"]})
+    ml.start_datafeed("parts-df", start=T0, end=T0 + 60 * SPAN_MS)
+    rt = ml.runtimes["parts"]
+    assert len(rt.series) == 3  # one series per partition value
+    recs = ml_results.get_records(e, "parts", {"record_score": 50})["records"]
+    assert recs and all(r["partition_field_value"] == "host2" for r in recs)
+    assert all(r["partition_field_name"] == "host" for r in recs)
+    # model memory rides the model_inference breaker while open
+    used = e.breakers.stats()["model_inference"]["estimated_size_in_bytes"]
+    assert used == rt.nbytes() > 0
+    ml.close_job("parts")
+    assert e.breakers.stats()["model_inference"]["estimated_size_in_bytes"] == 0
+
+
+def test_model_memory_hard_limit(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.create_index("metrics", mappings=METRICS_MAPPINGS)
+    seed_metric_stream(e.indices["metrics"], 30)
+    ml = e.ml
+    body = {
+        "analysis_config": {
+            "bucket_span": "1h",
+            "detectors": [{"function": "mean", "field_name": "value"}],
+        },
+        "data_description": {"time_field": "time"},
+        "analysis_limits": {"model_memory_limit": "1b"},
+    }
+    ml.put_job("tiny", body)
+    ml.open_job("tiny")
+    ml.put_datafeed("tiny-df", {"job_id": "tiny", "indices": ["metrics"]})
+    ml.start_datafeed("tiny-df", start=T0, end=T0 + 30 * SPAN_MS)
+    stats = ml.job_stats("tiny")["jobs"][0]
+    assert stats["model_size_stats"]["memory_status"] == "hard_limit"
+    assert stats["model_size_stats"]["total_partition_field_count"] == 0
+    ml.close_job("tiny")
+
+
+def test_model_state_serialization_roundtrip_and_dedup():
+    state = ml_model.init_state(4, period=12)
+    rng = np.random.default_rng(1)
+    vals = rng.normal(100, 5, (40, 3))
+    state, _ = ml_model.update_and_score(
+        state, vals, np.ones((40, 3), bool), np.arange(40))
+    meta = {"series": [[0, None, 0]], "processed_end_ms": 123}
+    p1 = ml_model.serialize_state(state, meta)
+    p2 = ml_model.serialize_state(state, meta)
+    assert p1 == p2  # deterministic bytes -> content-addressed dedup
+    restored, rmeta = ml_model.deserialize_state(p1)
+    assert rmeta == meta
+    for k in ml_model.STATE_KEYS:
+        np.testing.assert_array_equal(restored[k], state[k])
+
+
+def test_ml_disabled_setting(tmp_path):
+    e = _mk_engine(tmp_path)
+    e.settings.update({"persistent": {"xpack.ml.enabled": False}})
+    with pytest.raises(IllegalArgumentError):
+        e.ml.put_job("nope", JOB_BODY)
+    e.settings.update({"persistent": {"xpack.ml.enabled": None}})
+    e.ml.put_job("yep", JOB_BODY)
+
+
+def test_ml_validation_errors(tmp_path):
+    e = _mk_engine(tmp_path)
+    ml = e.ml
+    with pytest.raises(IllegalArgumentError):
+        ml.put_job("Bad_ID!", JOB_BODY)
+    with pytest.raises(IllegalArgumentError):
+        ml.put_job("nodetectors", {"analysis_config": {
+            "bucket_span": "1h", "detectors": []}})
+    with pytest.raises(IllegalArgumentError):
+        ml.put_job("badfn", {"analysis_config": {
+            "bucket_span": "1h", "detectors": [{"function": "wat"}]}})
+    with pytest.raises(IllegalArgumentError):
+        ml.put_job("countfield", {"analysis_config": {
+            "bucket_span": "1h",
+            "detectors": [{"function": "count", "field_name": "v"}]}})
+    ml.put_job("ok", JOB_BODY)
+    from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+
+    with pytest.raises(ResourceNotFoundError):
+        ml.put_datafeed("nofeed", {"job_id": "missing-job",
+                                   "indices": ["metrics"]})

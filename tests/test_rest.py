@@ -9,10 +9,6 @@ from aiohttp.test_utils import TestClient, TestServer
 from elasticsearch_tpu.rest import make_app
 
 
-def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
-
-
 @pytest.fixture
 def client_run(tmp_path):
     """Returns a runner that executes an async scenario against a fresh app."""

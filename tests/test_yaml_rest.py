@@ -79,6 +79,17 @@ def _hermetic_globals():
                 if k.startswith(("ES_TPU_", "JAX_"))}
     repo_mod._FS_ROOT_LOCKS.clear()  # no snapshot op is in flight between
     # modules; stale entries from crashed tests must not pin old roots
+    # drop everything earlier modules left collectable (leaked engines
+    # hold WAL fds; aiohttp holds sockets) BEFORE the fd-hungry 3-node
+    # cluster fixture builds, and start it with an empty node-wide
+    # request cache — the hermetic-reset half of the round-5 structural
+    # isolation fix (conftest._module_hygiene is the other half)
+    import gc as _gc
+
+    _gc.collect()
+    from elasticsearch_tpu.cache import request_cache as _rc
+
+    _rc().lru.clear()
     yield
     plugins_mod.registry = old_registry
     for k in [k for k in _os.environ
