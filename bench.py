@@ -229,6 +229,16 @@ def config1_match(searcher, m, lens, tok, rng):
     cache_arm = _cache_arm(searcher, lens, tok, rng)
     log(f"[c1] request-cache arm: {cache_arm}")
 
+    # ---- device-cost attribution ----------------------------------------
+    # one profiled batch (small: attribution, not throughput) + the
+    # sequential-batch latency percentiles through the new exponential
+    # histograms — tier/kernel/cache context for every recorded number
+    profile_arm = _profile_arm(
+        lambda: bs.msearch("body", sample_queries(rng, lens, tok, 256),
+                           TOP_K))
+    latency_pcts = _hist_pcts("bench.c1.batch_ms", [x * 1e3 for x in lat])
+    log(f"[c1] profile arm: {profile_arm} pcts: {latency_pcts}")
+
     # utilization accounting: logical dense-tier matmul flops + HBM traffic
     flops = 2.0 * total_q * V * N_DOCS
     mfu = flops / elapsed / PEAK_BF16_FLOPS
@@ -260,7 +270,47 @@ def config1_match(searcher, m, lens, tok, rng):
         "dense_matmul_mfu": round(mfu, 4),
         "hbm_utilization": round(hbm_util, 3),
         "request_cache": cache_arm,
+        "profile": profile_arm,
+        "latency_pcts": latency_pcts,
     }
+
+
+def _profile_arm(run_fn):
+    """Run one batch under the device-cost collector (the `"profile":
+    true` machinery) and summarize tier choice, per-kernel wall ms, and
+    request-cache traffic — so every BENCH_*.json carries attribution and
+    future perf PRs can see WHERE the time went, not just QPS."""
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    with collect_profile_events() as events:
+        run_fn()
+    kernels: dict = {}
+    tiers: dict = {}
+    cache = {"hits": 0, "misses": 0}
+    for e in events:
+        if e["kind"] == "kernel":
+            kernels[e["kernel"]] = round(
+                kernels.get(e["kernel"], 0.0) + float(e.get("ms", 0.0)), 3)
+        elif e["kind"] == "tier":
+            tiers[e["tier"]] = tiers.get(e["tier"], 0) + int(
+                e.get("queries", 1))
+        elif e["kind"] == "cache":
+            cache["hits"] += int(e.get("hits", 0))
+            cache["misses"] += int(e.get("misses", 0))
+    return {"tiers": tiers, "kernel_ms": kernels,
+            "request_cache_events": cache}
+
+
+def _hist_pcts(name, values_ms):
+    """Record latencies into a registry histogram and export its
+    exponential-bucket percentiles (the p50/p99 every config now logs)."""
+    from elasticsearch_tpu.telemetry import metrics
+
+    for v in values_ms:
+        metrics.histogram_record(name, float(v))
+    h = metrics.snapshot()["histograms"][name]
+    return {"p50_ms": round(h["p50"], 2), "p90_ms": round(h["p90"], 2),
+            "p99_ms": round(h["p99"], 2), "n": h["count"]}
 
 
 def _cache_arm(searcher, lens, tok, rng, n_q=512):
@@ -734,6 +784,12 @@ def config5_8shard(rng):
         shard_times.append(times)
         per_shard.append((np.asarray(outs[0]), np.asarray(outs[1])))
         if s == 0:
+            # device-cost attribution, measured once while shard 0's
+            # searcher is resident (tier chosen, kernel ms, cache events)
+            c5_profile = _profile_arm(
+                lambda: bs.msearch("body", warm[:256], TOP_K))
+            log(f"[c5] profile arm (shard 0): {c5_profile}")
+        if s == 0:
             # repeated-query (request cache) arm, measured on shard 0 only
             # (per-shard entries are exactly the C5 cache design; one
             # shard bounds the arm's cost while its searcher is resident)
@@ -808,6 +864,10 @@ def config5_8shard(rng):
         "batch_size": q_n,
         "baseline_model_qps_8m": round(baseline_qps, 1),
         "request_cache": cache_arm,
+        "profile": c5_profile,
+        "latency_pcts": _hist_pcts(
+            "bench.c5.shard_batch_ms",
+            [x * 1e3 for times in shard_times for x in times]),
         "mesh_probe": probe_r,
         "projection": {
             "formula": "q_n / mean_shard_batch_time * (1 - merge_frac)",

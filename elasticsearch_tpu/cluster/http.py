@@ -39,9 +39,14 @@ async def _node_call(server: NodeServer, fn, /, *args, **kwargs):
     """Run a callback-style ClusterNode method on the dispatch thread,
     await its completion on the HTTP loop. The done-check runs ON the loop
     (a dispatch-thread check would race wait_for's cancellation and raise
-    InvalidStateError against a cancelled future)."""
+    InvalidStateError against a cancelled future). The HTTP request's
+    contextvars (trace context, root span) follow the call onto the
+    dispatch thread, so coordinator fan-out requests propagate the trace."""
+    import contextvars
+
     loop = asyncio.get_running_loop()
     fut: asyncio.Future = loop.create_future()
+    ctx = contextvars.copy_context()
 
     def _resolve(setter, value):
         if not fut.done():
@@ -52,7 +57,7 @@ async def _node_call(server: NodeServer, fn, /, *args, **kwargs):
 
     def run():
         try:
-            fn(*args, on_done=on_done, **kwargs)
+            ctx.run(fn, *args, on_done=on_done, **kwargs)
         except Exception as e:  # noqa: BLE001 - surfaced by the middleware
             loop.call_soon_threadsafe(_resolve, fut.set_exception, e)
 
@@ -96,6 +101,39 @@ async def _error_envelope(request, handler):
                     "timed out waiting for the cluster")
     except Exception as e:  # noqa: BLE001
         return _err(500, "internal_server_error", f"{type(e).__name__}: {e}")
+
+
+@web.middleware
+async def _gateway_tracing(request, handler):
+    """Trace boundary of a cluster gateway: accept/mint the trace exactly
+    like the engine REST layer, node-tagged with the SERVING node — the
+    scatter/gather below (client_search -> A_SHARD_SEARCH) propagates it
+    over transport request headers."""
+    from ..telemetry import (TRACER, TraceContext, activate_trace,
+                             format_traceparent, metrics, new_trace_id,
+                             parse_traceparent)
+
+    parsed = parse_traceparent(request.headers.get("traceparent"))
+    ctx = TraceContext(
+        trace_id=parsed[0] if parsed else new_trace_id(),
+        parent_span_id=parsed[1] if parsed else None,
+        task_id=request.headers.get("X-Opaque-Id"),
+    )
+    node = request.app["node_server"].node.node_id
+    import time as _time
+
+    t0 = _time.perf_counter()
+    with activate_trace(ctx, node=node):
+        with TRACER.span(f"http {request.method} {request.path}",
+                         method=request.method, path=request.path) as span:
+            resp = await handler(request)
+            span.attributes["status"] = resp.status
+    metrics.histogram_record("es.rest.request.ms",
+                             (_time.perf_counter() - t0) * 1000)
+    resp.headers["X-Trace-Id"] = ctx.trace_id
+    resp.headers["traceparent"] = format_traceparent(ctx.trace_id,
+                                                     span.span_id)
+    return resp
 
 
 def _health_of(state) -> dict:
@@ -426,14 +464,32 @@ class EngineReplica:
                              {"include_global_state": True})
         self.next_idx = int(dump["applied"])
 
-    async def _call(self, method, path_qs, body, ct):
-        headers = {"Content-Type": ct} if ct else {}
+    async def _call(self, method, path_qs, body, ct, headers=None):
+        hdrs = {"Content-Type": ct} if ct else {}
+        if headers:
+            hdrs.update(headers)
         async with self._http.request(
             method, f"http://127.0.0.1:{self.engine_port}{path_qs}",
-            data=body if body else None, headers=headers,
+            data=body if body else None, headers=hdrs,
         ) as r:
             return r.status, await r.read(), r.headers.get(
                 "Content-Type", "application/json")
+
+    @staticmethod
+    def _trace_forward_headers() -> dict:
+        """traceparent/X-Opaque-Id for the loopback hop into the replica
+        engine app, so its spans join the gateway request's trace."""
+        from ..telemetry import TRACER, current_trace, format_traceparent
+
+        out = {}
+        ctx = current_trace()
+        cur = TRACER.current_span()
+        if ctx is not None and cur is not None:
+            out["traceparent"] = format_traceparent(ctx.trace_id,
+                                                    cur.span_id)
+        if ctx is not None and ctx.task_id:
+            out["X-Opaque-Id"] = ctx.task_id
+        return out
 
     # -- request handling -------------------------------------------------
 
@@ -455,7 +511,8 @@ class EngineReplica:
             # on the replicated op log. Repository registration also
             # replicates — it is pure metadata every replica needs.
             st, rbody, rct = await self._call(
-                request.method, path_qs, body, ct)
+                request.method, path_qs, body, ct,
+                headers=self._trace_forward_headers())
             return web.Response(
                 status=st, body=rbody, content_type=rct.split(";")[0])
         method, path_qs, body, ct = _normalize_op(
@@ -535,7 +592,8 @@ def _normalize_op(method: str, path: str, body: bytes, ct: str):
 def make_cluster_app(server: NodeServer,
                      replica: EngineReplica | None = None) -> web.Application:
     node = server.node
-    app = web.Application(middlewares=[_error_envelope])
+    app = web.Application(middlewares=[_gateway_tracing, _error_envelope])
+    app["node_server"] = server
 
     async def root(request):
         return web.json_response({
@@ -841,10 +899,48 @@ def make_cluster_app(server: NodeServer,
             "_shards": resp.get("_shards", {}),
         })
 
+    async def get_trace(request):
+        """Stitch one trace from spans collected on EVERY cluster node:
+        local spans come from this process's tracer, the rest over the
+        `cluster:monitor/trace/collect` transport action (each node keeps
+        its own recent spans; the reference ships them to an APM server —
+        here the gateway is the collector). Deduped by span_id, so
+        in-process test clusters sharing one tracer stitch correctly."""
+        from ..cluster.node import A_TRACE_COLLECT
+        from ..telemetry import TRACER, stitch_trace
+
+        trace_id = request.match_info["trace_id"].lower()
+        spans = TRACER.spans_for_trace(trace_id)
+        failures = []
+        for peer in sorted(node.state.nodes):
+            if peer == node.node_id:
+                continue
+            try:
+                resp = await _transport_request(
+                    server, peer, A_TRACE_COLLECT,
+                    {"trace_id": trace_id}, timeout=10.0)
+                spans.extend(resp.get("spans") or [])
+            except Exception as e:  # noqa: BLE001 - partial traces beat 500s
+                failures.append({"node": peer, "reason": str(e)})
+        if not spans:
+            return _err(404, "resource_not_found_exception",
+                        f"trace [{trace_id}] not found on any node")
+        out = stitch_trace(spans)
+        if failures:
+            out["failures"] = failures
+        return web.json_response(out)
+
+    async def prometheus(request):
+        from ..telemetry import metrics
+
+        return web.Response(text=metrics.prometheus_text(),
+                            content_type="text/plain", charset="utf-8")
+
     app.router.add_get("/", root)
     app.router.add_get("/_cluster/health", health)
     app.router.add_get("/_cluster/state", cluster_state)
     app.router.add_get("/_cat/nodes", cat_nodes)
+    app.router.add_get("/_trace/{trace_id}", get_trace)
     if replica is not None:
         # full-surface mode: every other route — the complete engine REST
         # surface — is served by the node's replicated engine (reads
@@ -866,6 +962,9 @@ def make_cluster_app(server: NodeServer,
     app.router.add_post("/{index}/_msearch", msearch)
     app.router.add_get("/{index}/_count", count)
     app.router.add_post("/{index}/_count", count)
+    # full-surface mode gets this from the replica engine (breaker/cache
+    # extras included); the data surface serves the registry directly
+    app.router.add_get("/_prometheus/metrics", prometheus)
     return app
 
 

@@ -47,6 +47,7 @@ A_GET = "indices:data/read/get"
 A_SHARD_SEARCH = "indices:data/read/search[shard]"
 A_START_RECOVERY = "internal:index/shard/recovery/start"
 A_MASTER_TASK = "internal:cluster/master_task"
+A_TRACE_COLLECT = "cluster:monitor/trace/collect"
 
 
 class ClusterNode:
@@ -90,6 +91,17 @@ class ClusterNode:
                                             self._on_shard_search_async)
         self.service.register_handler(A_START_RECOVERY, self._on_start_recovery)
         self.service.register_async_handler(A_MASTER_TASK, self._on_master_task)
+        self.service.register_handler(A_TRACE_COLLECT, self._on_trace_collect)
+
+    def _on_trace_collect(self, req, from_node):
+        """Return this process's spans for one trace id (the per-node
+        collection half of `GET /_trace/{id}`; the gateway fans this out
+        to every node and stitches). Spans carry the node they executed
+        on, so in-process test clusters — which share the process-global
+        tracer — dedupe correctly at the stitch."""
+        from ..telemetry import TRACER
+
+        return {"spans": TRACER.spans_for_trace(str(req.get("trace_id", "")))}
 
     def start(self):
         self.coordinator.start()
@@ -642,13 +654,18 @@ class ClusterNode:
     def _on_shard_search(self, req, from_node):
         """Per-shard query execution on the real engine pack (the data-node
         side of the reference's query phase, SearchService.executeQueryPhase)."""
+        from ..telemetry import TRACER
+
         index, s = req["index"], req["shard"]
         copy = self.shards.get((index, s))
         if copy is None:
             raise RuntimeError(f"no copy of [{index}][{s}] here")
-        searcher, id_list = self._searcher_for(index, copy)
-        body = req.get("body") or {}
-        res = searcher.search(body.get("query"), size=req.get("size", 10))
+        # the span joins the coordinator's trace via the transport-header
+        # context activated by handle_inbound, node-tagged with THIS node
+        with TRACER.span("shardSearchPhase", index=index, shard=s):
+            searcher, id_list = self._searcher_for(index, copy)
+            body = req.get("body") or {}
+            res = searcher.search(body.get("query"), size=req.get("size", 10))
         return self._hits_response(index, res, id_list)
 
     def _on_shard_search_async(self, req, from_node, channel):
@@ -688,20 +705,23 @@ class ClusterNode:
             )
 
         def work():
-            entry = entry_snapshot
-            if entry is None:
-                seqno, live, mappings = snapshot
-                cur = self._searchers.get(key)
-                if cur is not None and cur[0] == seqno:
-                    entry = cur  # another worker already built this seqno
-                else:
-                    entry = self._build_shard_entry(seqno, live, mappings)
+            from ..telemetry import TRACER
+
+            with TRACER.span("shardSearchPhase", index=index, shard=s):
+                entry = entry_snapshot
+                if entry is None:
+                    seqno, live, mappings = snapshot
                     cur = self._searchers.get(key)
-                    if cur is None or cur[0] < seqno:  # never clobber newer
-                        self._searchers[key] = entry
-            _seq, searcher, id_list = entry
-            res = searcher.search(body.get("query"), size=size)
-            return self._hits_response(index, res, id_list)
+                    if cur is not None and cur[0] == seqno:
+                        entry = cur  # another worker already built this seqno
+                    else:
+                        entry = self._build_shard_entry(seqno, live, mappings)
+                        cur = self._searchers.get(key)
+                        if cur is None or cur[0] < seqno:  # never clobber newer
+                            self._searchers[key] = entry
+                _seq, searcher, id_list = entry
+                res = searcher.search(body.get("query"), size=size)
+                return self._hits_response(index, res, id_list)
 
         offload(work, channel)
 

@@ -735,7 +735,12 @@ class BatchTermSearcher:
         if fast:
             fs = self._fused_searcher(k)
             if fs is not None:
-                return fs.msearch(fld, queries, k)
+                from ..telemetry import profile_event, time_kernel
+
+                profile_event("tier", tier="fused", queries=len(queries))
+                with time_kernel("fused.msearch", tier="fused",
+                                 queries=len(queries), k=k):
+                    return fs.msearch(fld, queries, k)
         Q = len(queries)
         scores = np.full((Q, k), -np.inf, np.float32)
         ids = np.zeros((Q, k), np.int64)
@@ -752,9 +757,14 @@ class BatchTermSearcher:
         # every group was dispatched (no intermediate eager ops: those act
         # as dispatch barriers under remote runtimes). Plain-array groups
         # (the dense-only fused path under fast=False) join the same fetch.
+        from ..telemetry import profile_event, time_kernel
+
+        profile_event("tier", tier="fast" if fast else "exact", queries=Q)
         raws = [p.chunk_outs if isinstance(p, _RawChunks) else p
                 for _, p in parts]
-        host = jax.device_get(raws)
+        with time_kernel("batched.disjunction",
+                         tier="fast" if fast else "exact", queries=Q, k=k):
+            host = jax.device_get(raws)
         parts = [
             (idxs, _RawChunks.stitch(h, p.Q, p.n_out)
              if isinstance(p, _RawChunks) else h)
@@ -780,6 +790,8 @@ class BatchTermSearcher:
             # fast-path program family instead of compiling the legacy path
             redo = np.concatenate(pending)
             pending = []
+            profile_event("tier", tier="exact_escalation",
+                          queries=int(redo.shape[0]))
             rerun_parts = []
             exact_parts = []
             for idxs, plan in self.plan_bucketed(

@@ -951,9 +951,13 @@ class FusedTermSearcher:
 
     def _run_pass(self, fld, queries, k):
         """One fused pass over all queries -> (v, i, t, flagged_bool)."""
+        from ..telemetry import time_kernel
+
         idxs, outs = self._dispatch_batch(fld, queries, k)
-        return self._collect_batch(
-            len(queries), k, idxs, jax.device_get(outs))
+        with time_kernel("fused.pallas_scan", tier="fused",
+                         queries=len(queries), k=k):
+            host = jax.device_get(outs)
+        return self._collect_batch(len(queries), k, idxs, host)
 
     def msearch_many(self, fld, batches, k=10):
         """Pipelined multi-batch msearch: EVERY batch's scanned program is
@@ -986,7 +990,11 @@ class FusedTermSearcher:
         """Escalate flagged queries on the legacy exact path."""
         first_ok = ~flagged
         if flagged.any():
+            from ..telemetry import profile_event
+
             still = np.nonzero(flagged)[0]
+            profile_event("tier", tier="exact_escalation",
+                          queries=int(still.shape[0]))
             # legacy exact path (independent machinery). Its final scores
             # equal the canonical values only up to ulps; ranking
             # differences at that level are accepted. The plan pads to a
@@ -1005,17 +1013,22 @@ class FusedTermSearcher:
                 (pack.term_blocks(fld, t)[1]
                  for q in flagged_qs for t, _ in q
                  if pack.dense_row_of(fld, t) is None), default=1)
-            sv, si, st = [
-                np.asarray(x)
-                for x in self.bts.run(
-                    fld,
-                    self.bts.plan(
-                        fld, flagged_qs, k,
-                        pad_ts=1 << (max(max_ts, 4) - 1).bit_length(),
-                        pad_b=max(32, 1 << (max(max_b, 1) - 1).bit_length()),
-                    ),
-                )
-            ]
+            from ..telemetry import time_kernel
+
+            with time_kernel("batched.escalation", tier="exact_escalation",
+                             queries=int(still.shape[0]), k=k):
+                sv, si, st = [
+                    np.asarray(x)
+                    for x in self.bts.run(
+                        fld,
+                        self.bts.plan(
+                            fld, flagged_qs, k,
+                            pad_ts=1 << (max(max_ts, 4) - 1).bit_length(),
+                            pad_b=max(32,
+                                      1 << (max(max_b, 1) - 1).bit_length()),
+                        ),
+                    )
+                ]
             scores[still, : sv.shape[1]] = sv
             ids[still, : sv.shape[1]] = si
             totals[still] = st

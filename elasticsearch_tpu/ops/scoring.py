@@ -120,6 +120,29 @@ def dense_term_scores(
     return scores, match
 
 
+def _fused_scan_engages(n: int, k: int) -> bool:
+    """The exact predicate top_k_with_total uses to pick the streamed
+    Pallas scan over sort-based lax.top_k — exposed so profiling can
+    attribute which selection tier a compiled plan actually ran."""
+    import os
+
+    import jax as _jax
+
+    mode = os.environ.get("ES_TPU_FUSED_TOPK", "auto")
+    from .kernels import MAX_FUSED_K
+
+    if mode == "0" or k > MAX_FUSED_K or n < 8:
+        return False
+    if mode == "force":
+        return True
+    return _jax.default_backend() == "tpu" and n >= (1 << 18)
+
+
+def topk_mode(n: int, k: int) -> str:
+    """-> "fused_scan" | "xla_topk": the selection tier for (n, k)."""
+    return "fused_scan" if _fused_scan_engages(n, k) else "xla_topk"
+
+
 def top_k_with_total(
     scores: jax.Array,  # [N+1] f32
     match: jax.Array,  # [N+1] bool
@@ -145,21 +168,17 @@ def top_k_with_total(
 
     n = live.shape[0]
     ok = match[:n] & live
-    mode = os.environ.get("ES_TPU_FUSED_TOPK", "auto")
-    from .kernels import MAX_FUSED_K
-
-    if mode != "0" and k <= MAX_FUSED_K and n >= 8:
-        force = mode == "force"
+    if _fused_scan_engages(n, k):
+        force = os.environ.get("ES_TPU_FUSED_TOPK", "auto") == "force"
         on_tpu = jax.default_backend() == "tpu"
-        if force or (on_tpu and n >= (1 << 18)):
-            from .kernels import scan_topk
+        from .kernels import scan_topk
 
-            v, i, t = scan_topk(
-                None, scores[:n][None, :], ok, k,
-                count_positive=False,
-                interpret=(not on_tpu) if force else False,
-            )
-            return v[0], i[0], t[0]
+        v, i, t = scan_topk(
+            None, scores[:n][None, :], ok, k,
+            count_positive=False,
+            interpret=(not on_tpu) if force else False,
+        )
+        return v[0], i[0], t[0]
     total = jnp.sum(ok, dtype=jnp.int32)
     masked = jnp.where(ok, scores[:n], -jnp.inf)
     top_scores, top_ids = jax.lax.top_k(masked, k)

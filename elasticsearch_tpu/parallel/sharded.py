@@ -688,14 +688,20 @@ class StackedSearcher:
                             r.get("from_", 0), r.get("floor", 0))
             for r in requests
         ]
+        from ..telemetry import time_kernel
+
         live = [s for s in states if s is not None]
         if live:
-            host1 = jax.device_get([s["outs1"] for s in live])
+            with time_kernel("sharded.wand_pass1", tier="wand",
+                             requests=len(live)):
+                host1 = jax.device_get([s["outs1"] for s in live])
             for s, h in zip(live, host1):
                 s["host1"] = h
         wave2 = [s for s in live if self._wand_dispatch2(s)]
         if wave2:
-            host2 = jax.device_get([s["outs2"] for s in wave2])
+            with time_kernel("sharded.wand_pass2", tier="wand",
+                             requests=len(wave2)):
+                host2 = jax.device_get([s["outs2"] for s in wave2])
             for s, h in zip(wave2, host2):
                 s["host2"] = h
         return [
@@ -1006,12 +1012,24 @@ class StackedSearcher:
             scope = self.cache_scope()
             hit = rc.get(scope[0], scope[1], ck)
             if hit is not None:
-                from ..telemetry import CACHE_HIT_SPAN, TRACER
+                from ..telemetry import CACHE_HIT_SPAN, TRACER, profile_event
 
+                profile_event("cache", scope="stacked_search", hits=1,
+                              misses=0)
                 with TRACER.span(CACHE_HIT_SPAN):
                     return _copy_stacked_result(hit)
+            from ..telemetry import profile_event
+
+            profile_event("cache", scope="stacked_search", hits=0, misses=1)
+        import time as _time
+
+        from ..telemetry import metrics as _metrics
+
+        _t0 = _time.perf_counter()
         res = self._search_uncached(query, size, from_, aggs, mappings,
                                     prune_floor)
+        _metrics.histogram_record(
+            "es.shard.search.ms", (_time.perf_counter() - _t0) * 1000)
         if ck is not None:
             rc.put(scope[0], scope[1], ck, _copy_stacked_result(res),
                    _stacked_result_nbytes(res))
@@ -1043,8 +1061,12 @@ class StackedSearcher:
         The reference has no agg-batching analog (each search is its own
         scatter/gather); this is the same discipline `ops/batched` applies
         to the query path, extended to aggregations."""
+        from ..telemetry import time_kernel
+
         states = [self._agg_dispatch(**r) for r in requests]
-        host = jax.device_get([s["outs"] for s in states])
+        with time_kernel("sharded.spmd_topk", shards=self.sp.S,
+                         requests=len(requests)):
+            host = jax.device_get([s["outs"] for s in states])
         wave2 = []
         for s, ho in zip(states, host):
             s["host"] = ho
@@ -1418,6 +1440,12 @@ def _msearch_sharded_cached(ss: "StackedSearcher", rc, fld: str,
                 rows[(qi, s)] = got
         if not warm:
             cold.append(qi)
+    from ..telemetry import profile_event
+
+    for s in range(S):
+        hits = sum(1 for qi in range(len(queries)) if (qi, s) in rows)
+        profile_event("cache", scope="msearch_sharded", shard=s,
+                      hits=hits, misses=len(queries) - hits)
     if cold:
         v, i, t = _msearch_sharded_partials(
             ss, fld, [queries[qi] for qi in cold], k)
@@ -1534,8 +1562,12 @@ def _msearch_exact_partials(ss: "StackedSearcher", fld: str,
         # timed against the shard-local portion on a virtual mesh
         return fn, (sub, jnp.asarray(W), jnp.asarray(rows),
                     jnp.asarray(ws)), kk
-    v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
-                                jnp.asarray(ws)))
+    from ..telemetry import time_kernel
+
+    with time_kernel("sharded.exact_disjunction", tier="exact", shards=S,
+                     queries=Q, k=kk):
+        v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
+                                    jnp.asarray(ws)))
     return v, i, t
 
 
@@ -1758,8 +1790,13 @@ class _FusedShardedMsearch:
         interpret = jax.default_backend() != "tpu"
         fn = self._compiled(fld, C, R, Td, k, nreal, interpret)
         avgdl = np.float32(views[0].avgdl(fld))
-        v, i, t, fl = jax.device_get(
-            fn(self._arrays(), avgdl, rows, row_q, row_w, dr, dw))
+        from ..telemetry import profile_event, time_kernel
+
+        profile_event("tier", tier="fused", queries=Q)
+        with time_kernel("sharded.fused_pipeline", tier="fused", shards=S,
+                         queries=Q, k=k):
+            v, i, t, fl = jax.device_get(
+                fn(self._arrays(), avgdl, rows, row_q, row_w, dr, dw))
         # [S, C, qc, ...] -> per-shard [S, Q, ...]
         kk = v.shape[-1]
         scores = np.full((S, Q, kk), -np.inf, np.float32)
@@ -1778,6 +1815,8 @@ class _FusedShardedMsearch:
             # so downstream consumers (merge, per-shard cache entries)
             # see only exact data for them
             still = np.nonzero(flagged)[0]
+            profile_event("tier", tier="exact_escalation",
+                          queries=int(still.shape[0]))
             ev, ei, et = _msearch_exact_partials(
                 self.ss, fld, [queries[i_] for i_ in still], k)
             ke = ev.shape[2]

@@ -11,6 +11,7 @@ device parameter transfers (block row lists, idf weights).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
@@ -220,6 +221,10 @@ class ShardSearcher:
                 cold.append(qi)
             else:
                 rows[qi] = got
+        from ..telemetry import profile_event
+
+        profile_event("cache", scope="msearch", shard=0,
+                      hits=len(queries) - len(cold), misses=len(cold))
         if cold:
             cv, ci, ct, cex = self.batched().msearch(
                 fld, [queries[qi] for qi in cold], k, **kw)
@@ -267,11 +272,21 @@ class ShardSearcher:
             scope = self.cache_scope()
             hit = rc.get(scope[0], scope[1], ck)
             if hit is not None:
-                from ..telemetry import CACHE_HIT_SPAN, TRACER
+                from ..telemetry import CACHE_HIT_SPAN, TRACER, profile_event
 
+                profile_event("cache", scope="search", shard=0, hits=1,
+                              misses=0)
                 with TRACER.span(CACHE_HIT_SPAN):
                     return _copy_shard_result(hit)
+            from ..telemetry import profile_event
+
+            profile_event("cache", scope="search", shard=0, hits=0, misses=1)
+        from ..telemetry import metrics as _metrics
+
+        _t0 = time.perf_counter()
         res = self._search_uncached(query, size, from_, mappings, aggs)
+        _metrics.histogram_record(
+            "es.shard.search.ms", (time.perf_counter() - _t0) * 1000)
         if ck is not None:
             rc.put(scope[0], scope[1], ck, _copy_shard_result(res),
                    _shard_result_nbytes(res))
@@ -309,9 +324,15 @@ class ShardSearcher:
             agg_key = tuple((n, k) for n, (_, k) in sorted(parts.items()))
         k = min(max(size + from_, 1), self.pack.num_docs)
         fn = self._compiled(node, struct_key, k, agg_nodes, agg_key)
-        top_scores, top_ids, total, agg_out = jax.device_get(
-            fn(self.dev, params, agg_params)
-        )
+        from ..ops.scoring import topk_mode
+        from ..telemetry import time_kernel
+
+        with time_kernel("compiled_plan", shard=0,
+                         tier=topk_mode(self.pack.num_docs, k),
+                         num_docs=self.pack.num_docs, k=k):
+            top_scores, top_ids, total, agg_out = jax.device_get(
+                fn(self.dev, params, agg_params)
+            )
         aggregations = None
         if agg_nodes:
             from ..aggs import two_pass_plan

@@ -38,6 +38,43 @@ def _err_response(ex: Exception) -> web.Response:
 
 
 @web.middleware
+async def _tracing_middleware(request: web.Request, handler):
+    """Distributed tracing at the REST boundary: accept a W3C
+    `traceparent` (+ `X-Opaque-Id` task identity) or mint a fresh trace,
+    run the request under a root span, and hand the trace id back in the
+    response headers — the reference's RestController + ThreadContext
+    trace-header behavior, with the APM agent replaced by the in-process
+    tracer (telemetry.TRACER)."""
+    import time as _time
+
+    from ..telemetry import (TRACER, TraceContext, activate_trace,
+                             format_traceparent, metrics, new_trace_id,
+                             parse_traceparent)
+
+    parsed = parse_traceparent(request.headers.get("traceparent"))
+    ctx = TraceContext(
+        trace_id=parsed[0] if parsed else new_trace_id(),
+        parent_span_id=parsed[1] if parsed else None,
+        task_id=request.headers.get("X-Opaque-Id"),
+    )
+    node = request.app["engine"].tasks.node
+    t0 = _time.perf_counter()
+    with activate_trace(ctx, node=node):
+        with TRACER.span(f"http {request.method} {request.path}",
+                         method=request.method, path=request.path,
+                         **({"task_id": ctx.task_id} if ctx.task_id else {})
+                         ) as span:
+            resp = await handler(request)
+            span.attributes["status"] = resp.status
+    ms = (_time.perf_counter() - t0) * 1000
+    metrics.histogram_record("es.rest.request.ms", ms)
+    resp.headers["X-Trace-Id"] = ctx.trace_id
+    resp.headers["traceparent"] = format_traceparent(ctx.trace_id,
+                                                     span.span_id)
+    return resp
+
+
+@web.middleware
 async def _warnings_middleware(request: web.Request, handler):
     """Deprecation warnings emitted during the request become RFC-7234
     `Warning` response headers (HeaderWarning analog)."""
@@ -101,8 +138,8 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     engine = engine or Engine(data_path)
     app = web.Application(
         client_max_size=512 * 1024 * 1024,
-        middlewares=[_xcontent_middleware, _warnings_middleware,
-                     _security_middleware],
+        middlewares=[_tracing_middleware, _xcontent_middleware,
+                     _warnings_middleware, _security_middleware],
     )
     app["engine"] = engine
     # single-thread executor: serializes engine mutation, keeps the loop free
@@ -110,7 +147,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     async def call(fn, *args, **kwargs):
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(app["pool"], lambda: fn(*args, **kwargs))
+        # carry the request's contextvars (trace context, active span,
+        # profile collector) onto the engine worker thread, so spans and
+        # profiling events recorded there belong to THIS request
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(
+            app["pool"], lambda: ctx.run(fn, *args, **kwargs))
 
     def handler(fn):
         async def wrapped(request: web.Request):
@@ -1713,6 +1757,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         scroll = query_params.get("scroll")
         import time
 
+        # "profile": true activates the device-cost collector around the
+        # MAIN search execution (kernel call sites record tier choice,
+        # Pallas wall timings, cache hits); the per-subtree profile walk
+        # below runs OUTSIDE the collector so its re-executions don't
+        # pollute the request's own attribution
+        _prof_cm = _prof_events = None
+        if body.get("profile"):
+            from ..telemetry import collect_profile_events
+
+            _prof_cm = collect_profile_events()
+            _prof_events = _prof_cm.__enter__()
         t0 = time.monotonic()
         kwargs = dict(
             query=query, size=size, from_=from_, aggs=aggs, knn=knn, sort=sort,
@@ -1721,21 +1776,25 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             runtime_mappings=body.get("runtime_mappings"),
             track_total_hits=_track_total_hits_param(body, query_params),
         )
-        if pit is not None:
-            if not isinstance(pit, dict) or "id" not in pit:
-                raise IllegalArgumentError("[pit] must be an object with an [id]")
-            res = await call(
-                engine.search_pit, pit["id"], pit.get("keep_alive"), **kwargs
-            )
-        elif scroll:
-            res = await call(engine.scroll_search, expression, scroll, **kwargs)
-        else:
-            res = await call(
-                engine.search_multi, expression,
-                ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
-                allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
-                **kwargs,
-            )
+        try:
+            if pit is not None:
+                if not isinstance(pit, dict) or "id" not in pit:
+                    raise IllegalArgumentError("[pit] must be an object with an [id]")
+                res = await call(
+                    engine.search_pit, pit["id"], pit.get("keep_alive"), **kwargs
+                )
+            elif scroll:
+                res = await call(engine.scroll_search, expression, scroll, **kwargs)
+            else:
+                res = await call(
+                    engine.search_multi, expression,
+                    ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
+                    allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
+                    **kwargs,
+                )
+        finally:
+            if _prof_cm is not None:
+                _prof_cm.__exit__(None, None, None)
         took = int((time.monotonic() - t0) * 1000)
         from ..telemetry import metrics as _metrics
 
@@ -1780,7 +1839,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                               "ts_mode", None)
                 if tsm is not None and hit.get("_source"):
                     tsids[pos] = tsm.tsid_of(hit["_source"])
+        _t_fetch = time.monotonic()
         apply_fetch_phase(res["hits"]["hits"], body, _mappings_of)
+        _fetch_ms = (time.monotonic() - _t_fetch) * 1000
         for pos, tsid in tsids.items():
             res["hits"]["hits"][pos].setdefault("fields", {})["_tsid"] = [
                 tsid]
@@ -1800,6 +1861,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
                 shards = []
                 took_ns = int((time.monotonic() - t0) * 1e9)
+                phases = {"query_ms": took, "fetch_ms": round(_fetch_ms, 3)}
                 for idx, alias_filter in engine.resolve_search(
                     expression or "_all", True, True
                 ):
@@ -1816,7 +1878,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                                       "filter": [alias_filter]}}
                     node = parse_query(q, idx.mappings)
                     shards.extend(
-                        profile_shards(idx, node, took_ns, engine.tasks.node)
+                        profile_shards(idx, node, took_ns, engine.tasks.node,
+                                       device_events=_prof_events,
+                                       phases=phases)
                     )
                 return {"shards": shards}
 
@@ -2302,7 +2366,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         import jax
 
         from ..cache import request_cache
-        from ..telemetry import metrics
+        from ..telemetry import TRACER, metrics, recent_slowlogs
 
         devices = [str(d) for d in jax.devices()]
         total_docs = sum(i.live_count for i in engine.indices.values())
@@ -2327,10 +2391,80 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         "ml": engine.ml.node_stats(),
                         "tpu": {"devices": devices},
                         "metrics": metrics.snapshot(),
+                        # tail-latency inspection without log scraping:
+                        # the most recent slowlog entries (now carrying
+                        # trace_id/task_id/node) and finished root spans
+                        "telemetry": {
+                            "recent_slowlogs": list(recent_slowlogs)[-32:],
+                            "recent_spans": TRACER.recent_spans(20),
+                        },
                     }
                 },
             }
         )
+
+    @handler
+    async def get_trace(request):
+        """Debug endpoint: stitch every span of one trace held by this
+        process into a time-ordered tree (the single-node analog of the
+        cluster gateway's fan-out collection)."""
+        from ..telemetry import TRACER, stitch_trace
+
+        trace_id = request.match_info["trace_id"].lower()
+        spans = TRACER.spans_for_trace(trace_id)
+        if not spans:
+            from ..utils.errors import ResourceNotFoundError
+
+            raise ResourceNotFoundError(f"trace [{trace_id}] not found")
+        return web.json_response(stitch_trace(spans))
+
+    @handler
+    async def prometheus_metrics(request):
+        """Prometheus text exposition: every registry instrument plus
+        point-in-time breaker and request-cache state sampled at scrape
+        time (the reference exports these through its APM metering; a
+        scrape endpoint needs no agent)."""
+        from ..cache import request_cache
+        from ..telemetry import metrics
+
+        extra = {}
+        for name, b in engine.breakers.stats().items():
+            if not isinstance(b, dict):
+                continue
+            extra[f"es.breaker.{name}.estimated_bytes"] = \
+                b.get("estimated_size_in_bytes", 0)
+            extra[f"es.breaker.{name}.limit_bytes"] = \
+                b.get("limit_size_in_bytes", 0)
+            extra[f"es.breaker.{name}.tripped"] = b.get("tripped", 0)
+        cs = request_cache().stats()
+        for key in ("memory_size_in_bytes", "evictions", "hit_count",
+                    "miss_count", "entry_count"):
+            if key in cs:
+                extra[f"es.request_cache.{key}"] = cs[key]
+        return web.Response(
+            text=metrics.prometheus_text(extra),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    @handler
+    async def nodes_hot_threads(request):
+        """Python-thread analog of _nodes/hot_threads (reference:
+        monitor/jvm/HotThreads.java): sample stacks over a short window,
+        busiest first — stuck event loop vs device wait at a glance."""
+        from ..telemetry import hot_threads_report
+
+        n = int(request.query.get("threads", 3))
+        snaps = int(request.query.get("snapshots", 10))
+        from ..utils.durations import parse_duration_seconds
+
+        interval = parse_duration_seconds(
+            request.query.get("interval"), 0.03) or 0.03
+        loop = asyncio.get_running_loop()
+        # sampling sleeps — keep it off the event loop (default executor,
+        # NOT the single engine worker, which may be what is stuck)
+        text = await loop.run_in_executor(
+            None, lambda: hot_threads_report(n, snaps, interval))
+        return web.Response(text=text, content_type="text/plain")
 
     app.router.add_get("/", root)
     app.router.add_put("/_ingest/pipeline/{id}", put_pipeline)
@@ -2375,6 +2509,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_delete("/_component_template/{name}", delete_component_template)
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
+    app.router.add_get("/_nodes/hot_threads", nodes_hot_threads)
+    app.router.add_get("/_trace/{trace_id}", get_trace)
+    app.router.add_get("/_prometheus/metrics", prometheus_metrics)
     app.router.add_post("/_bulk", bulk)
     app.router.add_post("/_msearch", msearch)
     app.router.add_post("/_search/scroll", scroll_continue)

@@ -91,6 +91,64 @@ def profile_node(node: QueryNode, searcher, _budget=None) -> dict:
     return out
 
 
+def device_sections(events: list[dict] | None, num_shards: int) -> list[dict]:
+    """Aggregate the profiling events collected while the main search
+    executed (telemetry.collect_profile_events: kernel call sites in
+    ops/fused, ops/batched, query/executor, parallel/sharded) into one
+    device-cost section per shard.
+
+    Events carrying an explicit `shard` attribute (per-shard cache rows)
+    attribute to that shard; the rest describe the ONE SPMD program that
+    executed every shard — those replicate into each shard's section with
+    scope "mesh", because on a TPU mesh per-shard work is a single fused
+    program, not per-shard RPCs (documented divergence from the
+    reference's per-shard profilers)."""
+    shards = [
+        {"tier": None, "tiers": {}, "kernels": [],
+         "request_cache": {"hits": 0, "misses": 0}}
+        for _ in range(max(num_shards, 1))
+    ]
+    # escalation outranks everything (it means the fast arm's result was
+    # replaced); otherwise the last tier event of the main arm wins
+    precedence = {"exact_escalation": 3, "fused": 2, "fast": 1, "exact": 1,
+                  "fused_scan": 1, "xla_topk": 0}
+    best = -1
+    dominant = None
+    for e in (events or []):
+        kind = e.get("kind")
+        s = e.get("shard")
+        targets = ([shards[s]] if isinstance(s, int) and 0 <= s < len(shards)
+                   else shards)
+        if kind == "kernel":
+            entry = {
+                "name": e.get("kernel"),
+                "time_in_nanos": int(float(e.get("ms", 0.0)) * 1e6),
+                "scope": "shard" if isinstance(s, int) else "mesh",
+            }
+            for key in ("tier", "queries", "k", "shards", "num_docs"):
+                if key in e:
+                    entry[key] = e[key]
+            for t in targets:
+                t["kernels"].append(entry)
+            tier = e.get("tier")
+            if tier and precedence.get(tier, 0) > best:
+                best, dominant = precedence.get(tier, 0), tier
+        elif kind == "tier":
+            tier = e.get("tier")
+            n = int(e.get("queries", 1))
+            for t in targets:
+                t["tiers"][tier] = t["tiers"].get(tier, 0) + n
+            if tier and precedence.get(tier, 0) > best:
+                best, dominant = precedence.get(tier, 0), tier
+        elif kind == "cache":
+            for t in targets:
+                t["request_cache"]["hits"] += int(e.get("hits", 0))
+                t["request_cache"]["misses"] += int(e.get("misses", 0))
+    for t in shards:
+        t["tier"] = dominant or "xla_topk"
+    return shards
+
+
 def empty_shard(idx, node_id: str) -> dict:
     """Shard entry for an index with no searcher yet (nothing executed)."""
     return {
@@ -100,21 +158,44 @@ def empty_shard(idx, node_id: str) -> dict:
     }
 
 
-def profile_shards(idx, node: QueryNode, took_ns: int, node_id: str) -> list:
-    """The `profile.shards` payload for one index (single stacked searcher
-    = one profile shard entry, the coordinator view)."""
+def profile_shards(idx, node: QueryNode, took_ns: int, node_id: str,
+                   device_events: list | None = None,
+                   phases: dict | None = None) -> list:
+    """The `profile.shards` payload for one index: one entry PER SHARD
+    (the reference emits `[node][index][shard]` entries per shard copy).
+    All shards of an index execute as one SPMD program, so the measured
+    per-subtree query tree is the same object in every entry; the
+    per-shard `device` section carries tier choice, kernel wall timings,
+    and request-cache hit/miss attribution from the profiled execution
+    (telemetry.collect_profile_events), and `phases` the coordinator's
+    rewrite/query/fetch split."""
+    import time as _time
+
     searcher = idx.searcher
+    t0 = _time.monotonic()
     tree = profile_node(node, searcher)
-    return [{
-        "id": f"[{node_id}][{idx.name}][0]",
-        "searches": [{
-            "query": [tree],
-            "rewrite_time": 0,
-            "collector": [{
-                "name": "FusedTopKCollector",
-                "reason": "search_top_hits",
-                "time_in_nanos": took_ns,
+    rewrite_ns = int((_time.monotonic() - t0) * 1e9)
+    n_shards = max(int(getattr(idx, "num_shards", 1) or 1), 1)
+    devices = device_sections(device_events, n_shards)
+    out = []
+    for s in range(n_shards):
+        entry = {
+            "id": f"[{node_id}][{idx.name}][{s}]",
+            "searches": [{
+                "query": [tree],
+                # reference slot: query-construction work outside scoring —
+                # here the profiled tree walk's compile+measure overhead
+                "rewrite_time": rewrite_ns,
+                "collector": [{
+                    "name": "FusedTopKCollector",
+                    "reason": "search_top_hits",
+                    "time_in_nanos": took_ns,
+                }],
             }],
-        }],
-        "aggregations": [],
-    }]
+            "aggregations": [],
+            "device": devices[s],
+        }
+        if phases:
+            entry["phases"] = dict(phases)
+        out.append(entry)
+    return out

@@ -1,15 +1,23 @@
-"""Telemetry: tracing spans, slow logs, deprecation warnings.
+"""Telemetry: distributed tracing, device-cost profiling, slow logs,
+metrics, deprecation warnings.
 
 Parity targets (reference): telemetry/tracing/Tracer.java:33 (OTel-API
 abstraction; spans started around search phases, SearchService.java:677),
+tasks/TaskManager + ThreadContext header propagation (trace context rides
+transport request headers so coordinator->shard fan-out is one trace),
 index/SearchSlowLog.java + IndexingSlowLog.java (per-index thresholds,
 dedicated loggers), common/logging/HeaderWarning.java (deprecation warnings
-returned as RFC-7234 `Warning` response headers and logged once)."""
+returned as RFC-7234 `Warning` response headers and logged once), and the
+APM metering surface (telemetry/metric/MeterRegistry) — here exported as
+Prometheus text exposition instead of an APM agent."""
 
 from __future__ import annotations
 
 import contextvars
 import logging
+import math
+import os
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -21,6 +29,110 @@ slowlog_index = logging.getLogger("elasticsearch_tpu.slowlog.index")
 deprecation_log = logging.getLogger("elasticsearch_tpu.deprecation")
 
 
+# ---------------------------------------------------------------------------
+# trace context (W3C traceparent + task id propagation)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The propagated identity of one end-to-end request: carried in REST
+    headers (W3C `traceparent` + `X-Opaque-Id`) and threaded through
+    transport request headers so every node's spans join one trace
+    (reference behavior: ThreadContext trace headers + Task#getParentTaskId
+    riding TransportService requests)."""
+
+    trace_id: str                      # 32 lowercase hex chars
+    parent_span_id: str | None = None  # 16 hex: span to parent under
+    task_id: str | None = None         # X-Opaque-Id / task identity
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+_trace_ctx: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "trace_context", default=None)
+_node_name: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "telemetry_node_name", default=None)
+
+
+def current_trace() -> TraceContext | None:
+    return _trace_ctx.get()
+
+
+def current_node_name() -> str:
+    return _node_name.get() or "node-0"
+
+
+@contextmanager
+def activate_trace(ctx: TraceContext | None, node: str | None = None):
+    """Install a trace context (and optionally a node identity) for the
+    duration of a request / transport handler invocation."""
+    t1 = _trace_ctx.set(ctx) if ctx is not None else None
+    t2 = _node_name.set(node) if node is not None else None
+    try:
+        yield ctx
+    finally:
+        if t2 is not None:
+            _node_name.reset(t2)
+        if t1 is not None:
+            _trace_ctx.reset(t1)
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """W3C traceparent `00-<32hex>-<16hex>-<2hex>` -> (trace_id, span_id)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16), int(parts[2], 16)
+    except ValueError:
+        return None
+    if parts[1] == "0" * 32 or parts[2] == "0" * 16:
+        return None
+    return parts[1].lower(), parts[2].lower()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def propagation_headers() -> dict | None:
+    """Transport-request headers carrying the caller's trace identity:
+    the receiving node's handler spans parent under the caller's CURRENT
+    span (the coordinator fan-out span), reconstructing one tree."""
+    ctx = _trace_ctx.get()
+    cur = TRACER.current_span()
+    if ctx is None and cur is None:
+        return None
+    trace_id = cur.trace_id if cur is not None else ctx.trace_id
+    parent = cur.span_id if cur is not None else ctx.parent_span_id
+    out = {"trace_id": trace_id, "parent_span_id": parent}
+    if ctx is not None and ctx.task_id:
+        out["task_id"] = ctx.task_id
+    return out
+
+
+def context_from_headers(headers: dict | None) -> TraceContext | None:
+    if not headers or not headers.get("trace_id"):
+        return None
+    return TraceContext(
+        trace_id=str(headers["trace_id"]),
+        parent_span_id=headers.get("parent_span_id"),
+        task_id=headers.get("task_id"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
 @dataclass
 class Span:
     name: str
@@ -28,26 +140,98 @@ class Span:
     end: float | None = None
     attributes: dict = field(default_factory=dict)
     children: list = field(default_factory=list)
+    # trace identity (PR 4): every span carries the ids needed to stitch a
+    # cross-node trace plus the node it executed on
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str | None = None
+    node: str = ""
+    wall_start: float = 0.0  # epoch seconds (cross-node alignment)
 
     @property
     def duration_ms(self) -> float:
         return ((self.end or time.monotonic()) - self.start) * 1000
 
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "node": self.node,
+            "start_unix": self.wall_start,
+            "duration_ms": round(self.duration_ms, 3),
+            "attributes": dict(self.attributes),
+        }
+
+    def to_otlp(self) -> dict:
+        """One OTLP-shaped span record (the field names of
+        opentelemetry-proto trace Span, JSON encoding)."""
+        start_ns = int(self.wall_start * 1e9)
+        end_ns = start_ns + int(self.duration_ms * 1e6)
+        attrs = [{"key": "node.name",
+                  "value": {"stringValue": self.node}}]
+        for k, v in self.attributes.items():
+            if isinstance(v, bool):
+                attrs.append({"key": k, "value": {"boolValue": v}})
+            elif isinstance(v, int):
+                attrs.append({"key": k, "value": {"intValue": str(v)}})
+            elif isinstance(v, float):
+                attrs.append({"key": k, "value": {"doubleValue": v}})
+            else:
+                attrs.append({"key": k, "value": {"stringValue": str(v)}})
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "name": self.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": attrs,
+        }
+        if self.parent_span_id:
+            out["parentSpanId"] = self.parent_span_id
+        return out
+
+
+def _walk_spans(span: Span):
+    yield span
+    for c in span.children:
+        yield from _walk_spans(c)
+
 
 class Tracer:
     """In-memory tracer: spans nest via a context variable; the last
-    `keep` root spans are retained for inspection (the APM exporter of the
-    reference maps to a log/OTLP sink here)."""
+    `keep` root spans are retained for inspection. Root spans finished
+    while ES_TPU_OTLP_FILE is set are appended there as OTLP-shaped JSON
+    lines (the APM/OTLP exporter of the reference maps to this sink)."""
 
     def __init__(self, keep: int = 256):
         self.finished: deque[Span] = deque(maxlen=keep)
         self._current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
             "current_span", default=None)
 
+    def current_span(self) -> Span | None:
+        return self._current.get()
+
     @contextmanager
     def span(self, name: str, **attributes):
-        s = Span(name=name, start=time.monotonic(), attributes=dict(attributes))
         parent = self._current.get()
+        ctx = _trace_ctx.get()
+        if parent is not None:
+            trace_id = parent.trace_id or new_trace_id()
+            parent_id = parent.span_id or None
+        elif ctx is not None:
+            trace_id = ctx.trace_id
+            parent_id = ctx.parent_span_id
+        else:
+            trace_id = new_trace_id()
+            parent_id = None
+        s = Span(name=name, start=time.monotonic(),
+                 attributes=dict(attributes),
+                 trace_id=trace_id, span_id=new_span_id(),
+                 parent_span_id=parent_id, node=current_node_name(),
+                 wall_start=time.time())
         token = self._current.set(s)
         try:
             yield s
@@ -58,13 +242,128 @@ class Tracer:
                 parent.children.append(s)
             else:
                 self.finished.append(s)
+                self._export_otlp(s)
                 log.debug("span %s %.2fms %s", name, s.duration_ms, s.attributes)
+
+    # -- inspection / export ------------------------------------------------
+
+    def spans_for_trace(self, trace_id: str) -> list[dict]:
+        """Flattened span dicts (this process) belonging to one trace."""
+        out = []
+        for root in list(self.finished):
+            if root.trace_id != trace_id:
+                continue
+            out.extend(s.to_dict() for s in _walk_spans(root))
+        return out
+
+    def recent_spans(self, n: int = 20) -> list[dict]:
+        """Summaries of the most recently finished root spans (newest
+        last), for _nodes/stats."""
+        out = []
+        for root in list(self.finished)[-n:]:
+            d = root.to_dict()
+            d["span_count"] = sum(1 for _ in _walk_spans(root))
+            out.append(d)
+        return out
+
+    def _export_otlp(self, root: Span) -> None:
+        path = os.environ.get("ES_TPU_OTLP_FILE")
+        if not path:
+            return
+        import json as _json
+
+        try:
+            with open(path, "a") as f:
+                for s in _walk_spans(root):
+                    f.write(_json.dumps(s.to_otlp()) + "\n")
+        except OSError:  # an unwritable sink must never fail the request
+            log.debug("OTLP export to %s failed", path)
 
 
 TRACER = Tracer()
 
 
-# ---- slow logs ------------------------------------------------------------
+def stitch_trace(spans: list[dict]) -> dict:
+    """Assemble flattened span dicts (possibly from several nodes) into
+    the `/_trace/{trace_id}` response: deduped, time-ordered, with a
+    parent/child tree reconstructed from span ids."""
+    by_id: dict[str, dict] = {}
+    for s in spans:
+        by_id.setdefault(s["span_id"], s)
+    ordered = sorted(by_id.values(), key=lambda s: s.get("start_unix", 0.0))
+    roots: list[dict] = []
+    for s in ordered:
+        s = dict(s)
+        s["children"] = []
+        by_id[s["span_id"]] = s
+    for s in by_id.values():
+        p = s.get("parent_span_id")
+        if p and p in by_id:
+            by_id[p]["children"].append(s)
+        else:
+            roots.append(s)
+    for s in by_id.values():
+        s["children"].sort(key=lambda c: c.get("start_unix", 0.0))
+    return {
+        "trace_id": spans[0]["trace_id"] if spans else None,
+        "span_count": len(by_id),
+        "nodes": sorted({s["node"] for s in by_id.values()}),
+        "spans": roots,
+    }
+
+
+# ---------------------------------------------------------------------------
+# device-cost profiling ("profile": true collectors)
+# ---------------------------------------------------------------------------
+
+_profile_events: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "profile_events", default=None)
+
+
+@contextmanager
+def collect_profile_events():
+    """Activate the per-request device-cost collector: kernel call sites
+    (ops/fused, ops/batched, query/executor, parallel/sharded) append
+    events while a `"profile": true` search executes. The yielded list is
+    shared by reference, so events recorded on the engine worker thread
+    (contextvars propagate through rest/app.call) are visible here."""
+    events: list[dict] = []
+    token = _profile_events.set(events)
+    try:
+        yield events
+    finally:
+        _profile_events.reset(token)
+
+
+def profile_collector_active() -> bool:
+    return _profile_events.get() is not None
+
+
+def profile_event(kind: str, **fields) -> None:
+    """Record one profiling event (kind: kernel | tier | cache | phase)
+    when a collector is active; free otherwise."""
+    bucket = _profile_events.get()
+    if bucket is not None:
+        bucket.append({"kind": kind, **fields})
+
+
+@contextmanager
+def time_kernel(name: str, **fields):
+    """Wall-time one host-level device dispatch+fetch (the Pallas / XLA
+    call sites). Always feeds the kernel-level latency histogram; also
+    records a profile event when a collector is active."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ms = (time.perf_counter() - t0) * 1000
+        metrics.histogram_record(f"es.kernel.{name}.ms", ms)
+        profile_event("kernel", kernel=name, ms=round(ms, 4), **fields)
+
+
+# ---------------------------------------------------------------------------
+# slow logs
+# ---------------------------------------------------------------------------
 
 _LEVELS = (("warn", logging.WARNING), ("info", logging.INFO),
            ("debug", logging.DEBUG), ("trace", 5))
@@ -83,6 +382,23 @@ def _threshold_ms(settings: dict, prefix: str, level: str):
     return None if sec is None else sec * 1000
 
 
+def _slowlog_identity() -> dict:
+    """trace/task/node identity of the in-flight request, so a slowlog
+    line is joinable against its trace without log scraping (the
+    reference stamps X-Opaque-Id and task ids into its slowlog ECS
+    fields, index/SearchSlowLog.java)."""
+    out = {"node": current_node_name()}
+    cur = TRACER.current_span()
+    ctx = _trace_ctx.get()
+    if cur is not None and cur.trace_id:
+        out["trace_id"] = cur.trace_id
+    elif ctx is not None:
+        out["trace_id"] = ctx.trace_id
+    if ctx is not None and ctx.task_id:
+        out["task_id"] = ctx.task_id
+    return out
+
+
 def record_search_slowlog(index_name: str, settings: dict, took_ms: float,
                           query_desc: str):
     """Log at the highest matching threshold (reference behavior:
@@ -91,7 +407,8 @@ def record_search_slowlog(index_name: str, settings: dict, took_ms: float,
         t = _threshold_ms(settings, "search.slowlog.threshold.query", level)
         if t is not None and took_ms >= t:
             entry = {"index": index_name, "took_ms": round(took_ms, 3),
-                     "level": level, "source": query_desc, "kind": "search"}
+                     "level": level, "source": query_desc, "kind": "search",
+                     **_slowlog_identity()}
             recent_slowlogs.append(entry)
             slowlog_search.log(py_level,
                                "[%s] took[%dms], source[%s]",
@@ -105,7 +422,8 @@ def record_indexing_slowlog(index_name: str, settings: dict, took_ms: float,
         t = _threshold_ms(settings, "indexing.slowlog.threshold.index", level)
         if t is not None and took_ms >= t:
             entry = {"index": index_name, "took_ms": round(took_ms, 3),
-                     "level": level, "id": doc_id, "kind": "indexing"}
+                     "level": level, "id": doc_id, "kind": "indexing",
+                     **_slowlog_identity()}
             recent_slowlogs.append(entry)
             slowlog_index.log(py_level, "[%s] took[%dms], id[%s]",
                               index_name, took_ms, doc_id)
@@ -146,60 +464,265 @@ def warning_header_value(message: str) -> str:
 # metrics registry (APM metering analog)
 # ---------------------------------------------------------------------------
 
+# exponential histogram buckets: 4 per octave (factor 2^(1/4) ~ 1.19), so
+# percentile estimates carry <~19% relative error — the OTel exponential
+# histogram with scale=2, which the reference's APM metering exports
+_HIST_SCALE = 4
+_HIST_LOG_BASE = math.log(2.0) / _HIST_SCALE
+
+
+def _bucket_index(value: float) -> int:
+    # smallest i with 2^(i/4) >= value  (value > 0)
+    return math.ceil(math.log(value) / _HIST_LOG_BASE - 1e-9)
+
+
+def _bucket_upper(idx: int) -> float:
+    return 2.0 ** (idx / _HIST_SCALE)
+
+
+class _Histogram:
+    """Exponential-bucket histogram: count/sum/min/max plus sparse
+    bucket counts keyed by exponent index; <=0 values land in a dedicated
+    zero bucket."""
+
+    __slots__ = ("count", "sum", "min", "max", "zero_count", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.zero_count = 0
+        self.buckets: dict[int, int] = {}
+
+    def record(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if value <= 0.0:
+            self.zero_count += 1
+            return
+        i = _bucket_index(value)
+        self.buckets[i] = self.buckets.get(i, 0) + 1
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (0..1): geometric bucket midpoint of the
+        bucket holding the q*count-th sample, clamped to observed
+        min/max so tails never exceed real data."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = self.zero_count
+        if rank <= seen:
+            return max(self.min, 0.0) if self.zero_count else 0.0
+        for i in sorted(self.buckets):
+            seen += self.buckets[i]
+            if rank <= seen:
+                mid = math.sqrt(_bucket_upper(i - 1) * _bucket_upper(i))
+                return min(max(mid, self.min), self.max)
+        return self.max
+
+    def snapshot(self) -> dict:
+        c = self.count
+        return {
+            "count": c,
+            "sum": self.sum,
+            "min": (self.min if c else 0.0),
+            "max": (self.max if c else 0.0),
+            "avg": (self.sum / c if c else 0.0),
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+        }
+
+
 class MetricsRegistry:
     """Named counters / gauges / histograms with a snapshot API.
 
     The reference exposes a metering surface plugins and core register
     instruments on (reference behavior: server/.../telemetry/metric/
     MeterRegistry — LongCounter, DoubleGauge, LongHistogram), surfaced
-    through the APM module. Here the registry is in-process and its
-    snapshot feeds the _nodes/stats metrics section."""
+    through the APM module. Here the registry is in-process; its snapshot
+    feeds the _nodes/stats metrics section and `prometheus_text()` is the
+    `GET /_prometheus/metrics` exposition body.
+
+    Thread-safe: concurrent aiohttp handlers, the engine worker, and the
+    transport dispatch/search threads all record into one registry — every
+    read-modify-write holds the registry lock (PR 4; the previous plain
+    dict updates raced and lost counts)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, object] = {}  # name -> callable or value
-        self._histograms: dict[str, list] = {}
+        self._histograms: dict[str, _Histogram] = {}
 
     # -- instruments -------------------------------------------------------
 
     def counter_inc(self, name: str, value: float = 1.0) -> None:
-        self._counters[name] = self._counters.get(name, 0.0) + value
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
 
     def gauge_set(self, name: str, value) -> None:
         """value: a number, or a zero-arg callable sampled at snapshot."""
-        self._gauges[name] = value
+        with self._lock:
+            self._gauges[name] = value
 
     def histogram_record(self, name: str, value: float) -> None:
-        h = self._histograms.setdefault(
-            name, [0, 0.0, float("inf"), float("-inf")])
-        h[0] += 1
-        h[1] += value
-        h[2] = min(h[2], value)
-        h[3] = max(h[3], value)
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = _Histogram()
+            h.record(value)
+
+    def reset(self) -> None:
+        """Drop every instrument (test hygiene: wired into the suite's
+        module-boundary cleanup so one module's recordings can never leak
+        into another's assertions)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
 
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges_raw = dict(self._gauges)
+            hists = {name: h.snapshot()
+                     for name, h in self._histograms.items()}
         gauges = {}
-        for name, v in self._gauges.items():
+        for name, v in gauges_raw.items():
             try:
                 gauges[name] = v() if callable(v) else v
             except Exception:  # a failing gauge must not break stats
                 gauges[name] = None
-        return {
-            "counters": dict(self._counters),
-            "gauges": gauges,
-            "histograms": {
-                name: {"count": h[0], "sum": h[1],
-                       "min": (h[2] if h[0] else 0.0),
-                       "max": (h[3] if h[0] else 0.0),
-                       "avg": (h[1] / h[0] if h[0] else 0.0)}
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    def prometheus_text(self, extra_gauges: dict | None = None) -> str:
+        """Prometheus text exposition (format 0.0.4): counters as
+        `_total`, gauges, histograms as cumulative `_bucket{le=...}` +
+        `_sum`/`_count` with the exponential bucket upper bounds.
+        `extra_gauges`: point-in-time values rendered as gauges (breaker /
+        cache state sampled by the endpoint)."""
+        import re as _re
+
+        def san(name: str) -> str:
+            n = _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+            return ("_" + n) if n[:1].isdigit() else n
+
+        def num(v) -> str:
+            f = float(v)
+            if f == int(f) and abs(f) < 1e15:
+                return str(int(f))
+            return repr(f)
+
+        with self._lock:
+            counters = dict(self._counters)
+            gauges_raw = dict(self._gauges)
+            hist_data = {
+                name: (h.count, h.sum, h.zero_count, dict(h.buckets))
                 for name, h in self._histograms.items()
-            },
-        }
+            }
+        lines: list[str] = []
+        for name in sorted(counters):
+            m = san(name)
+            if not m.endswith("_total"):  # prometheus counter convention
+                m += "_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {num(counters[name])}")
+        gauges = {}
+        for name, v in gauges_raw.items():
+            try:
+                gauges[name] = v() if callable(v) else v
+            except Exception:  # noqa: BLE001 - skip broken gauges
+                continue
+        for name, v in (extra_gauges or {}).items():
+            gauges[name] = v
+        for name in sorted(gauges):
+            v = gauges[name]
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                continue
+            m = san(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {num(v)}")
+        for name in sorted(hist_data):
+            count, total, zero_count, buckets = hist_data[name]
+            m = san(name)
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            if zero_count:
+                cum += zero_count
+                lines.append(f'{m}_bucket{{le="0"}} {cum}')
+            for i in sorted(buckets):
+                cum += buckets[i]
+                lines.append(
+                    f'{m}_bucket{{le="{_bucket_upper(i):.6g}"}} {cum}')
+            lines.append(f'{m}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{m}_sum {num(total)}")
+            lines.append(f"{m}_count {count}")
+        return "\n".join(lines) + "\n"
 
 
 metrics = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# hot threads (reference: monitor/jvm/HotThreads.java)
+# ---------------------------------------------------------------------------
+
+_IDLE_FRAME_NAMES = frozenset({
+    "wait", "_wait", "acquire", "select", "poll", "epoll", "get",
+    "recv", "recv_into", "accept", "readinto", "read", "_read_exact",
+    "run_forever", "_run_once", "sleep", "dequeue", "_worker",
+    "wait_for", "join", "channel_get",
+})
+
+
+def hot_threads_report(threads: int = 3, snapshots: int = 10,
+                       interval_s: float = 0.03) -> str:
+    """Sample every Python thread's stack `snapshots` times over a short
+    window and report the busiest first (busy = samples whose innermost
+    frame is not a recognizable wait). Diagnoses a stuck event loop vs a
+    device wait without attaching a debugger — the hot_threads analog;
+    true per-thread CPU time needs OS support the reference gets from the
+    JVM, so sampling stands in for it (documented divergence)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    busy: dict[int, int] = {}
+    last_stack: dict[int, list] = {}
+    for i in range(max(snapshots, 1)):
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = traceback.extract_stack(frame)
+            last_stack[ident] = stack
+            top = stack[-1].name if stack else ""
+            is_idle = top in _IDLE_FRAME_NAMES or top.startswith("_wait")
+            busy[ident] = busy.get(ident, 0) + (0 if is_idle else 1)
+        if i + 1 < snapshots:
+            time.sleep(interval_s)
+    order = sorted(busy, key=lambda t: (-busy[t], names.get(t, "")))
+    n = max(snapshots, 1)
+    out = [f"::: {{{current_node_name()}}}",
+           f"   Hot threads sampled {n} times over "
+           f"{(n - 1) * interval_s * 1000:.0f}ms, "
+           f"busiestThreads={threads}:", ""]
+    for ident in order[:max(threads, 1)]:
+        pct = 100.0 * busy[ident] / n
+        out.append(f"   {pct:5.1f}% busy samples — thread "
+                   f"'{names.get(ident, ident)}'")
+        for fr in (last_stack.get(ident) or [])[-12:]:
+            out.append(f"       at {fr.name} ({fr.filename}:{fr.lineno})")
+        out.append("")
+    return "\n".join(out) + "\n"
 
 
 # ---- shard request cache ---------------------------------------------------

@@ -152,7 +152,14 @@ class TransportService:
             self.network.schedule(
                 timeout, lambda: self._timeout(rid, action, to_node)
             )
-        self.network.send(self.node_id, to_node, action, request, rid)
+        # trace propagation: the caller's trace identity rides the request
+        # as headers (the reference's ThreadContext trace headers on every
+        # TransportService request), so the remote handler's spans join
+        # the same trace, parented under the caller's current span
+        from ..telemetry import propagation_headers
+
+        self.network.send(self.node_id, to_node, action, request, rid,
+                          headers=propagation_headers())
 
     def _timeout(self, rid: int, action: str, to_node: str) -> None:
         handler = self._pending.pop(rid, None)
@@ -163,7 +170,14 @@ class TransportService:
 
     # -- inbound (called by the network impl) ------------------------------
 
-    def handle_inbound(self, from_node: str, action: str, request: Any, rid: int):
+    def handle_inbound(self, from_node: str, action: str, request: Any,
+                       rid: int, headers: dict | None = None):
+        from ..telemetry import activate_trace, context_from_headers
+
+        with activate_trace(context_from_headers(headers), node=self.node_id):
+            self._handle_inbound_traced(from_node, action, request, rid)
+
+    def _handle_inbound_traced(self, from_node, action, request, rid):
         async_handler = self._async_handlers.get(action)
         if async_handler is not None:
             channel = TransportChannel(self.network, self.node_id, from_node, rid)

@@ -139,7 +139,8 @@ class LocalTransportNetwork:
 
     # -- message paths -----------------------------------------------------
 
-    def send(self, from_node: str, to_node: str, action: str, request, rid: int):
+    def send(self, from_node: str, to_node: str, action: str, request,
+             rid: int, headers: dict | None = None):
         svc_from = self._services.get(from_node)
         if (from_node, to_node) in self._disconnects or to_node not in self._services:
             self.queue.schedule(
@@ -157,7 +158,8 @@ class LocalTransportNetwork:
                 return  # lost in flight
             svc = self._services.get(to_node)
             if svc is not None and to_node not in self._dead:
-                svc.handle_inbound(from_node, action, request, rid)
+                svc.handle_inbound(from_node, action, request, rid,
+                                   headers=headers)
 
         self.queue.schedule(self._delay(), deliver)
 

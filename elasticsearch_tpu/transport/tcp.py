@@ -53,7 +53,9 @@ import threading
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 512 * 1024 * 1024
-WIRE_VERSION = 1
+# v2 (PR 4) adds an optional request-headers segment (flags bit1) carrying
+# the trace context; senders only emit it to peers that negotiated >= 2
+WIRE_VERSION = 2
 _MAGIC = 0xE5
 _HDR = struct.Struct(">BBBBQ")  # magic, ver, flags, kind, rid
 _COMPRESS_MIN = 1024
@@ -67,8 +69,11 @@ def _wire_enabled() -> bool:
     return os.environ.get("ES_TPU_WIRE_V0") != "1"
 
 
-def encode_frame_v1(msg: dict) -> bytes:
-    """Binary v1 envelope; body JSON bytes, zstd over _COMPRESS_MIN."""
+def encode_frame_v1(msg: dict, ver: int = WIRE_VERSION) -> bytes:
+    """Binary envelope; body JSON bytes, zstd over _COMPRESS_MIN. `ver` is
+    the NEGOTIATED connection version: the optional headers segment
+    (trace context, flags bit1) is only written to peers that understand
+    >= 2 — a v1 peer never sees a frame layout it cannot parse."""
     from ..native import zstd as zstd_codec
 
     body = json.dumps(msg.get("body"), separators=(",", ":")).encode()
@@ -76,8 +81,12 @@ def encode_frame_v1(msg: dict) -> bytes:
     if len(body) >= _COMPRESS_MIN:
         body = zstd_codec.compress(body)
         flags |= 1
+    hdr_bytes = b""
+    if ver >= 2 and msg.get("hdr"):
+        hdr_bytes = json.dumps(msg["hdr"], separators=(",", ":")).encode()
+        flags |= 2
     kind = _KIND[msg["k"]]
-    out = [_HDR.pack(_MAGIC, WIRE_VERSION, flags, kind, msg["rid"])]
+    out = [_HDR.pack(_MAGIC, min(ver, WIRE_VERSION), flags, kind, msg["rid"])]
     frm = msg["from"].encode()
     out.append(struct.pack(">H", len(frm)))
     out.append(frm)
@@ -93,6 +102,9 @@ def encode_frame_v1(msg: dict) -> bytes:
             eb = str(err).encode()
             out.append(struct.pack(">I", len(eb)))
             out.append(eb)
+    if flags & 2:
+        out.append(struct.pack(">H", len(hdr_bytes)))
+        out.append(hdr_bytes)
     out.append(body)
     payload = b"".join(out)
     return _LEN.pack(len(payload)) + payload
@@ -121,6 +133,11 @@ def decode_frame_v1(payload: bytes) -> dict:
         else:
             msg["err"] = payload[off:off + slen].decode()
             off += slen
+    if flags & 2:
+        (hlen,) = struct.unpack_from(">H", payload, off)
+        off += 2
+        msg["hdr"] = json.loads(payload[off:off + hlen].decode())
+        off += hlen
     body = payload[off:]
     if flags & 1:
         body = zstd_codec.decompress(body)
@@ -175,10 +192,11 @@ class _PeerSender(threading.Thread):
         self.to_node = to_node
         self.queue: queue.Queue = queue.Queue()
         self.conn: socket.socket | None = None
-        # negotiated wire version for the CURRENT connection: flips to 1
-        # when the peer's hello_ack arrives (reader thread); reset on
-        # reconnect — a restarted peer may be older
-        self.wire_v1 = False
+        # negotiated wire version for the CURRENT connection: 0 = legacy
+        # JSON; set to the peer's acked version when its hello_ack arrives
+        # (reader thread); reset on reconnect — a restarted peer may be
+        # older. Truthiness == "binary frames negotiated".
+        self.wire_v1 = 0
 
     def enqueue(self, msg: dict, on_fail) -> None:
         self.queue.put((msg, on_fail))
@@ -194,7 +212,7 @@ class _PeerSender(threading.Thread):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.settimeout(None)
         self.conn = conn
-        self.wire_v1 = False
+        self.wire_v1 = 0
         if self.network.wire_enabled:
             # open with the JSON hello: a v0 peer ignores it, a v1 peer
             # acks and this connection upgrades to binary frames
@@ -223,9 +241,11 @@ class _PeerSender(threading.Thread):
                     break
                 try:
                     # encode at SEND time so the negotiated version of the
-                    # live connection applies (not the enqueue-time one)
-                    data = (encode_frame_v1(msg) if self.wire_v1
-                            else frame_bytes(msg))
+                    # live connection applies (not the enqueue-time one);
+                    # a v1 peer gets no headers segment, a v0 peer gets
+                    # JSON frames (where "hdr" is an ignorable extra key)
+                    data = (encode_frame_v1(msg, self.wire_v1)
+                            if self.wire_v1 else frame_bytes(msg))
                 except Exception:  # noqa: BLE001 - unserializable body:
                     break  # fail THIS message, never the sender thread
                 try:
@@ -347,9 +367,15 @@ class TcpTransportNetwork:
             self._pool = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix=f"tpu-es-search-{self.node_id}")
 
+        # the dispatch thread's trace context must follow the work onto
+        # the pool thread, so shard-search spans join the caller's trace
+        import contextvars
+
+        ctx = contextvars.copy_context()
+
         def run():
             try:
-                res = work()
+                res = ctx.run(work)
             except Exception as ex:  # noqa: BLE001 - surfaced to the caller
                 self._inbox.put(lambda: channel.send_failure(repr(ex)))
                 return
@@ -413,7 +439,8 @@ class TcpTransportNetwork:
         if msg.get("k") == "hello_ack":
             s = self._senders.get(msg.get("from", ""))
             if s is not None and self.wire_enabled:
-                s.wire_v1 = int(msg.get("ver", 0)) >= 1
+                ver = int(msg.get("ver", 0))
+                s.wire_v1 = ver if ver >= 1 else 0
             return
         svc = self._service
         if svc is None:
@@ -424,7 +451,7 @@ class TcpTransportNetwork:
                 # callers outside the address book (clients) work too
                 self._inbound_routes[(msg["from"], msg["rid"])] = conn
             svc.handle_inbound(msg["from"], msg["action"], msg["body"],
-                               msg["rid"])
+                               msg["rid"], headers=msg.get("hdr"))
         elif msg["k"] == "rsp":
             svc.handle_response(msg["rid"], msg["body"], msg.get("err"))
 
@@ -442,7 +469,8 @@ class TcpTransportNetwork:
                 s.start()
             return s
 
-    def send(self, from_node: str, to_node: str, action: str, request, rid: int):
+    def send(self, from_node: str, to_node: str, action: str, request,
+             rid: int, headers: dict | None = None):
         if to_node not in self._peers:
             svc = self._service
             if svc is not None:
@@ -456,10 +484,13 @@ class TcpTransportNetwork:
                 self._inbox.put(lambda: svc.handle_connection_failure(
                     rid, f"cannot connect to [{to_node}]"))
 
-        self._sender_for(to_node).enqueue({
+        msg = {
             "k": "req", "from": from_node, "action": action,
             "rid": rid, "body": request,
-        }, on_fail)
+        }
+        if headers:
+            msg["hdr"] = headers
+        self._sender_for(to_node).enqueue(msg, on_fail)
 
     def respond(self, from_node: str, to_node: str, rid: int, response, error):
         msg = {"k": "rsp", "from": from_node, "rid": rid,

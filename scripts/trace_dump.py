@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Pretty-print a stored trace as a time-aligned tree.
+
+Sources (pick one):
+  --url http://host:port --trace <trace_id>   fetch GET /_trace/{id} from a
+                                              node or cluster gateway
+  --otlp spans.jsonl --trace <trace_id>       read OTLP JSON lines written
+                                              by ES_TPU_OTLP_FILE
+
+Output: one line per span, indented by depth, with a time-aligned bar over
+the trace's wall-clock window, the owning node, and duration — enough to
+see at a glance whether tail latency sat in the coordinator, a shard's
+pack build, or the device.
+
+    $ python scripts/trace_dump.py --url http://127.0.0.1:9200 \
+          --trace 4bf92f3577b34da6a3ce929d0e0e4736
+
+Dependency-free (urllib only), like scripts/tcp_cluster_demo.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+BAR_WIDTH = 40
+
+
+def _fetch_url(url: str, trace_id: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{url.rstrip('/')}/_trace/{trace_id}", timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def _from_otlp_lines(path: str, trace_id: str) -> dict:
+    """Rebuild the /_trace response shape from OTLP JSON lines."""
+    spans = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("traceId") != trace_id:
+                continue
+            start_ns = int(rec["startTimeUnixNano"])
+            end_ns = int(rec["endTimeUnixNano"])
+            attrs = {}
+            node = ""
+            for a in rec.get("attributes", []):
+                v = a.get("value", {})
+                val = (v.get("stringValue") or v.get("intValue")
+                       or v.get("doubleValue") or v.get("boolValue"))
+                if a.get("key") == "node.name":
+                    node = val
+                else:
+                    attrs[a.get("key")] = val
+            spans.append({
+                "name": rec["name"],
+                "trace_id": rec["traceId"],
+                "span_id": rec["spanId"],
+                "parent_span_id": rec.get("parentSpanId"),
+                "node": node,
+                "start_unix": start_ns / 1e9,
+                "duration_ms": (end_ns - start_ns) / 1e6,
+                "attributes": attrs,
+            })
+    from elasticsearch_tpu.telemetry import stitch_trace
+
+    return stitch_trace(spans)
+
+
+def _window(roots: list[dict]) -> tuple[float, float]:
+    lo, hi = float("inf"), float("-inf")
+
+    def visit(s):
+        nonlocal lo, hi
+        lo = min(lo, s["start_unix"])
+        hi = max(hi, s["start_unix"] + s["duration_ms"] / 1000.0)
+        for c in s.get("children", []):
+            visit(c)
+
+    for r in roots:
+        visit(r)
+    return lo, max(hi, lo + 1e-9)
+
+
+def _bar(start: float, dur_ms: float, lo: float, span_s: float) -> str:
+    a = int(BAR_WIDTH * (start - lo) / span_s)
+    b = int(BAR_WIDTH * (start - lo + dur_ms / 1000.0) / span_s)
+    b = max(b, a + 1)
+    return "·" * a + "█" * (b - a) + "·" * max(BAR_WIDTH - b, 0)
+
+
+def render(trace: dict, out=sys.stdout) -> None:
+    roots = trace.get("spans", [])
+    lo, hi = _window(roots)
+    span_s = hi - lo
+    print(f"trace {trace.get('trace_id')}  "
+          f"spans={trace.get('span_count', len(roots))}  "
+          f"nodes={','.join(trace.get('nodes', []))}  "
+          f"window={span_s * 1000:.1f}ms", file=out)
+
+    def visit(s, depth):
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted((s.get("attributes") or {}).items())
+        )
+        print(f"  [{_bar(s['start_unix'], s['duration_ms'], lo, span_s)}] "
+              f"{'  ' * depth}{s['name']}  "
+              f"({s['duration_ms']:.2f}ms, node={s['node']}"
+              f"{', ' + attrs if attrs else ''})", file=out)
+        for c in sorted(s.get("children", []),
+                        key=lambda c: c.get("start_unix", 0.0)):
+            visit(c, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s.get("start_unix", 0.0)):
+        visit(r, 0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="node/gateway base URL to fetch from")
+    ap.add_argument("--otlp", help="OTLP JSON-lines file (ES_TPU_OTLP_FILE)")
+    ap.add_argument("--trace", required=True, help="trace id (32 hex)")
+    args = ap.parse_args(argv)
+    if bool(args.url) == bool(args.otlp):
+        ap.error("exactly one of --url / --otlp is required")
+    trace = (_fetch_url(args.url, args.trace) if args.url
+             else _from_otlp_lines(args.otlp, args.trace))
+    if not trace.get("spans"):
+        print(f"trace {args.trace}: no spans found", file=sys.stderr)
+        return 1
+    render(trace)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

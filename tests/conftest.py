@@ -120,6 +120,12 @@ def _module_hygiene():
     from elasticsearch_tpu.cache import request_cache
 
     request_cache().lru.clear()
+    # metrics hygiene: the registry is a process-global singleton; one
+    # module's recordings (counters, latency histograms) must not leak
+    # into another module's snapshot/percentile assertions
+    from elasticsearch_tpu.telemetry import metrics
+
+    metrics.reset()
     try:
         import resource
 

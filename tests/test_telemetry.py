@@ -91,8 +91,11 @@ def test_metrics_registry_snapshot():
     snap = m.snapshot()
     assert snap["counters"]["ops"] == 3
     assert snap["gauges"] == {"static": 7, "sampled": 42, "broken": None}
-    assert snap["histograms"]["lat"] == {
+    lat = snap["histograms"]["lat"]
+    assert {k: lat[k] for k in ("count", "sum", "min", "max", "avg")} == {
         "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0, "avg": 2.0}
+    # exponential-bucket percentiles ride along (PR 4), clamped to data
+    assert 1.0 <= lat["p50"] <= lat["p90"] <= lat["p99"] <= 3.0
 
 
 def test_json_logging(capsys):
