@@ -279,25 +279,44 @@ def _profile_arm(run_fn):
     """Run one batch under the device-cost collector (the `"profile":
     true` machinery) and summarize tier choice, per-kernel wall ms, and
     request-cache traffic — so every BENCH_*.json carries attribution and
-    future perf PRs can see WHERE the time went, not just QPS."""
+    future perf PRs can see WHERE the time went, not just QPS. PR 5: the
+    kernel events now carry the analytic cost model's FLOPs/bytes and the
+    achieved MFU / bandwidth utilization per dispatch
+    (elasticsearch_tpu/monitoring/costmodel + telemetry.time_kernel) —
+    aggregated here as per-kernel roofline fractions."""
+    from elasticsearch_tpu.monitoring.costmodel import device_peaks
     from elasticsearch_tpu.telemetry import collect_profile_events
 
     with collect_profile_events() as events:
         run_fn()
     kernels: dict = {}
+    util: dict = {}
     tiers: dict = {}
     cache = {"hits": 0, "misses": 0}
     for e in events:
         if e["kind"] == "kernel":
             kernels[e["kernel"]] = round(
                 kernels.get(e["kernel"], 0.0) + float(e.get("ms", 0.0)), 3)
+            if "flops" in e:
+                u = util.setdefault(
+                    e["kernel"], {"ms": 0.0, "flops": 0.0, "bytes": 0.0})
+                u["ms"] += float(e.get("ms", 0.0))
+                u["flops"] += float(e["flops"])
+                u["bytes"] += float(e.get("bytes", 0.0))
         elif e["kind"] == "tier":
             tiers[e["tier"]] = tiers.get(e["tier"], 0) + int(
                 e.get("queries", 1))
         elif e["kind"] == "cache":
             cache["hits"] += int(e.get("hits", 0))
             cache["misses"] += int(e.get("misses", 0))
+    peak_f, peak_b, kind = device_peaks()
+    for u in util.values():
+        sec = max(u["ms"] / 1e3, 1e-9)
+        u["mfu"] = round(u["flops"] / sec / peak_f, 5)
+        u["bw_util"] = round(u["bytes"] / sec / peak_b, 5)
+        u["ms"] = round(u["ms"], 3)
     return {"tiers": tiers, "kernel_ms": kernels,
+            "device_utilization": {"device_kind": kind, "kernels": util},
             "request_cache_events": cache}
 
 
@@ -644,6 +663,13 @@ def config4_knn(rng):
     baseline_qps = CORES * MULTICORE_EFF * KNN_FLOPS_PER_CORE / (2.0 * dims * n)
     flops = 2.0 * total_q * dims * n
     elapsed = total_q / qps
+    # device-cost attribution: one small profiled batch through the new
+    # accounting (vector.knn_tiered carries the cost model's FLOPs/bytes,
+    # so THIS is the recorded C4 roofline fraction — the "driver-recorded
+    # device-bound proof" VERDICT asked for, vs the analytic `mfu` below)
+    c4_profile = _profile_arm(
+        lambda: run_batch(rng.standard_normal((256, dims),
+                                              dtype=np.float32)))
     out = {
         "qps": round(qps, 1),
         "p50_batch_ms": round(float(np.median(lat)) * 1e3, 1),
@@ -653,6 +679,9 @@ def config4_knn(rng):
         "baseline_model_qps": round(baseline_qps, 1),
         "vs_baseline": round(qps / baseline_qps, 2),
         "mfu": round(flops / elapsed / PEAK_BF16_FLOPS, 4),
+        "profile": c4_profile,
+        "latency_pcts": _hist_pcts("bench.c4.batch_ms",
+                                   [x * 1e3 for x in lat]),
     }
     if tiered is not None:
         # A/B: the f32-HIGHEST arm on the same shapes
@@ -995,6 +1024,26 @@ def _summary_line(extras, partial: bool) -> str:
     return json.dumps(body)
 
 
+def _write_record(extras, partial: bool) -> None:
+    """Write the record-so-far to ES_BENCH_RECORD (default
+    ./bench_record.json) ATOMICALLY: serialize to a temp file in the same
+    directory, fsync, rename. Called after EVERY config and from the
+    signal handlers, so even an rc=124 that outraces the stdout flush
+    leaves a complete, parseable JSON file of every finished config —
+    the file can never exist half-written (rename is atomic) and never
+    goes missing once the first config lands."""
+    path = os.environ.get("ES_BENCH_RECORD", "bench_record.json")
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_summary_line(extras, partial) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError as e:  # an unwritable record dir must not kill the run
+        log(f"[bench] record write to {path} failed: {e}")
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
     from elasticsearch_tpu.utils.jax_env import enable_compile_cache
@@ -1008,7 +1057,8 @@ def main():
 
     def _flush_record(signum, frame):
         # SIGTERM/SIGALRM (driver timeout): flush the record-so-far as
-        # the final line before dying
+        # the final line before dying (stdout AND the atomic record file)
+        _write_record(extras, partial=True)
         print(_summary_line(extras, partial=True), flush=True)
         log(f"[bench] killed by signal {signum}; partial record flushed")
         os._exit(124)
@@ -1028,6 +1078,7 @@ def main():
 
             traceback.print_exc(file=sys.stderr)
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
+        _write_record(extras, partial=True)  # temp-file + rename per config
         print(_summary_line(extras, partial=True), flush=True)
 
     if only in (None, "c1", "c2"):
@@ -1064,6 +1115,7 @@ def main():
         if c1q and "error" not in extras.get("msearch_8shard", {}):
             extras["msearch_8shard"]["c1_single_chip_1m_qps"] = c1q
 
+    _write_record(extras, partial=False)
     print(_summary_line(extras, partial=False))
 
 

@@ -180,7 +180,7 @@ _READONLY_POST = re.compile(
     r"_eql/search|_async_search|_mtermvectors|_termvectors(/[^/]+)?|"
     r"_ingest/pipeline/(_simulate|[^/]+/_simulate)|"
     r"_index_template/_simulate(_index)?(/[^/]+)?|_graph/explore|"
-    r"_percolate|_nodes/reload_secure_settings|_monitoring/bulk|"
+    r"_percolate|_nodes/reload_secure_settings|_monitoring/(bulk|_collect)|"
     r"_query|_pit|_inference/[^/]+(/[^/]+)?|"
     r"_ml/anomaly_detectors/[^/]+/results/[^/]+(/[^/]+)?|"
     r"_ml/datafeeds/[^/]+/_preview)"
@@ -288,7 +288,57 @@ class EngineReplica:
         self.server.node.coordinator.add_applied_listener(self._on_state)
         self._on_state(self.server.node.state)  # catch up on join/restart
 
+    def attach_monitoring(self, gateway_port: int) -> None:
+        """Point this replica engine's MonitoringService at the node's
+        gateway: exported documents POST back through the gateway as a
+        normal _bulk, so they ride the replicated op log and EVERY
+        replica holds EVERY node's history (the reference's exporters
+        write the shared .monitoring-es-* indices the same way). Pruning
+        likewise deletes through the gateway. Direct local writes would
+        fork the replicas — the one thing a deterministic replica must
+        never do."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from ..monitoring.collectors import monitoring_index_body
+
+        def _req(method, path, body: bytes | None, ctype: str):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gateway_port}{path}", data=body,
+                headers={"Content-Type": ctype} if body else {},
+                method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=60.0) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        def exporter(index_name: str, docs: list[dict]) -> None:
+            st, _ = _req("PUT", f"/{index_name}",
+                         _json.dumps(monitoring_index_body()).encode(),
+                         "application/json")
+            # 400 resource_already_exists: every node races to create the
+            # day's index once; the replicated op is idempotent by outcome
+            lines = []
+            for doc in docs:
+                lines.append(_json.dumps({"create": {}}))
+                lines.append(_json.dumps(doc))
+            _req("POST", f"/{index_name}/_bulk?refresh=true",
+                 ("\n".join(lines) + "\n").encode(), "application/x-ndjson")
+
+        def pruner(index_names: list[str]) -> None:
+            for name in index_names:
+                _req("DELETE", f"/{name}", None, "")
+
+        mon = self.engine.monitoring
+        mon.node_name = self.server.node.node_id
+        mon.exporter = exporter
+        mon.pruner = pruner
+
     async def close(self):
+        if self.engine._monitoring is not None:
+            self.engine._monitoring.stop()
         # deregister only if the binding is still OURS: a newer replica
         # may have replaced it and must keep serving dumps
         self.server.node.service.unregister_handler(
@@ -1061,6 +1111,10 @@ class HttpGateway:
             await site.start()
             self.port = runner.addresses[0][1]
             self._runner = runner
+            if self.replica is not None:
+                # monitoring exports must replicate: route them back
+                # through this gateway now that its port exists
+                self.replica.attach_monitoring(self.port)
 
         try:
             loop.run_until_complete(boot())

@@ -178,6 +178,12 @@ class ClusterSettings:
         }
 
 
+def _validate_duration(v):
+    from ..utils.durations import parse_duration_seconds
+
+    parse_duration_seconds(v, None)  # raises IllegalArgumentError when bad
+
+
 def default_cluster_settings() -> list[Setting]:
     return [
         Setting("cluster.name", "elasticsearch-tpu"),
@@ -208,6 +214,15 @@ def default_cluster_settings() -> list[Setting]:
         # remote clusters for CCS; the seed is the remote's HTTP endpoint
         # (this framework's transport IS HTTP — reference 9300 seeds analog)
         Setting("cluster.remote.*", None, lambda v: v, dynamic=True),
+        # self-monitoring pipeline (monitoring/): interval collectors
+        # writing .monitoring-es-* TSDB indices on the node's own engine
+        # (the reference's xpack.monitoring.collection.* settings)
+        Setting("xpack.monitoring.collection.enabled", False, Setting.bool_,
+                dynamic=True),
+        Setting("xpack.monitoring.collection.interval", "10s", str,
+                dynamic=True, validator=_validate_duration),
+        Setting("xpack.monitoring.history.duration", "7d", str,
+                dynamic=True, validator=_validate_duration),
     ]
 
 
@@ -225,6 +240,25 @@ INDEX_SETTINGS: dict[str, Setting] = {s.key: s for s in [
     Setting("hidden", False, Setting.bool_, dynamic=True),
     Setting("blocks.read_only", False, Setting.bool_, dynamic=True),
     Setting("blocks.write", False, Setting.bool_, dynamic=True),
+    # per-index slowlog thresholds, dynamic + typed (reference behavior:
+    # SearchSlowLog INDEX_SEARCH_SLOWLOG_THRESHOLD_*_SETTING — durations,
+    # "-1" disables a level). telemetry.record_search_slowlog reads these
+    # from EACH index's settings, so two indices can run different levels
+    *[
+        Setting(f"search.slowlog.threshold.query.{lvl}", None, str,
+                dynamic=True, validator=_validate_duration)
+        for lvl in ("warn", "info", "debug", "trace")
+    ],
+    *[
+        Setting(f"search.slowlog.threshold.fetch.{lvl}", None, str,
+                dynamic=True, validator=_validate_duration)
+        for lvl in ("warn", "info", "debug", "trace")
+    ],
+    *[
+        Setting(f"indexing.slowlog.threshold.index.{lvl}", None, str,
+                dynamic=True, validator=_validate_duration)
+        for lvl in ("warn", "info", "debug", "trace")
+    ],
 ]}
 
 
@@ -235,12 +269,39 @@ class IndexScopedSettings:
     def normalize(key: str) -> str:
         return key.removeprefix("index.")
 
+    # setting groups that arrive as nested objects in REST bodies but are
+    # registered (and read) as dotted keys — flattened before validation,
+    # so `{"search": {"slowlog": {"threshold": {"query": {"warn": ...}}}}}`
+    # and `"search.slowlog.threshold.query.warn"` are the same update
+    _FLATTEN_GROUPS = ("search", "indexing")
+
+    @classmethod
+    def _flatten_groups(cls, updates: dict) -> dict:
+        out = {}
+
+        def walk(prefix: str, val):
+            if isinstance(val, dict) and val:
+                for k2, v2 in val.items():
+                    walk(f"{prefix}.{k2}", v2)
+            else:
+                out[prefix] = val
+
+        for key, raw in updates.items():
+            nk = cls.normalize(key)
+            if nk.split(".", 1)[0] in cls._FLATTEN_GROUPS \
+                    and isinstance(raw, dict):
+                walk(nk, raw)
+            else:
+                out[key] = raw
+        return out
+
     @classmethod
     def validate_update(cls, current: dict, updates: dict) -> dict:
         """-> normalized updates; rejects non-dynamic keys on a live index
         (reference behavior: MetadataUpdateSettingsService — 'final ... ,
         not updateable on open indices')."""
         out = {}
+        updates = cls._flatten_groups(updates)
         for key, raw in updates.items():
             nk = cls.normalize(key)
             s = INDEX_SETTINGS.get(nk)

@@ -435,6 +435,33 @@ def cat_nodes(engine) -> list[dict]:
     }]
 
 
+def cat_tasks(engine) -> list[dict]:
+    """GET /_cat/tasks over the node task manager (reference behavior:
+    rest/action/cat/RestTasksAction columns: action, task_id,
+    parent_task_id, type, start_time, timestamp, running_time, ip, node).
+    Same `v`/`h`/`format` conventions as every other _cat endpoint via
+    cat_render."""
+    from ..tasks import format_running_time
+
+    out = []
+    for t in sorted(engine.tasks.list(), key=lambda t: t.id):
+        nanos = t.running_time_nanos
+        out.append({
+            "action": t.action,
+            "task_id": t.task_id,
+            "parent_task_id": t.parent_task_id or "-",
+            "type": "transport",
+            "start_time": str(t.start_time_millis),
+            "timestamp": time.strftime(
+                "%H:%M:%S", time.gmtime(t.start_time_millis / 1000.0)),
+            "running_time": format_running_time(nanos),
+            "ip": "127.0.0.1",
+            "node": t.node,
+            "description": t.description,
+        })
+    return out
+
+
 def cat_count(engine, expression: str | None) -> list[dict]:
     total = 0
     targets = (

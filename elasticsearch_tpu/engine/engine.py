@@ -94,12 +94,16 @@ class EsIndex:
         _recovering: bool = False,
         breaker_account=None,
     ):
-        from ..common.settings import INDEX_SETTINGS
+        from ..common.settings import INDEX_SETTINGS, IndexScopedSettings
 
         self.name = name
         self.mappings = mappings
         self.engine = None  # owning Engine backref (query-time inference)
         self.settings = {"number_of_shards": 1, "number_of_replicas": 0, "refresh_interval": "1s"}
+        # nested slowlog-group bodies flatten to the dotted keys the
+        # telemetry threshold reader consumes (same normalization as
+        # dynamic updates — IndexScopedSettings._FLATTEN_GROUPS)
+        settings = IndexScopedSettings._flatten_groups(settings or {})
         for k, v in (settings or {}).items():
             s = INDEX_SETTINGS.get(k)
             if s is not None and v is not None:
@@ -1203,6 +1207,7 @@ class Engine:
         self.persistent = PersistentTasksService(self)
         self._security = None
         self._ml = None
+        self._monitoring = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1272,6 +1277,18 @@ class Engine:
                     self.indices[name] = EsIndex.open(
                         name, d, breaker_account=self._pack_accounter(name)
                     )
+        # self-monitoring (monitoring/): dynamic enable/interval consumers
+        # route through the lazy property; a persisted enabled=true starts
+        # collection at boot (after index recovery, so the first tick sees
+        # the recovered indices)
+        self.settings.add_consumer(
+            "xpack.monitoring.collection.enabled",
+            lambda v: self.monitoring.set_enabled(v))
+        self.settings.add_consumer(
+            "xpack.monitoring.collection.interval",
+            lambda v: self.monitoring.set_interval(v))
+        if self.settings.get("xpack.monitoring.collection.enabled"):
+            self.monitoring.start()
 
     @property
     def security(self):
@@ -1294,6 +1311,17 @@ class Engine:
                 "xpack.ml.state_repository_path",
                 lambda _v: self._ml.invalidate_repo_cache())
         return self._ml
+
+    @property
+    def monitoring(self):
+        """Self-monitoring pipeline (monitoring/): lazy — built on first
+        access or when xpack.monitoring.collection.enabled flips on (the
+        __init__ consumers route through this property)."""
+        from ..monitoring import MonitoringService
+
+        if self._monitoring is None:
+            self._monitoring = MonitoringService(self)
+        return self._monitoring
 
     def _pack_accounter(self, name: str):
         return lambda n: self.breakers.set_steady(
@@ -2252,6 +2280,8 @@ class Engine:
         return {"errors": errors, "items": items}
 
     def close(self):
+        if self._monitoring is not None:
+            self._monitoring.stop()  # join the collection thread
         if self._ml is not None:
             self._ml.shutdown()  # checkpoints open jobs' model state
         for idx in self.indices.values():

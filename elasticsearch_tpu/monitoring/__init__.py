@@ -1,0 +1,51 @@
+"""Self-monitoring: device-utilization accounting + the monitoring
+pipeline (PR 5).
+
+Two coupled layers:
+
+  - `costmodel` + `device`: an analytic per-kernel FLOPs/bytes model
+    combined with the PR-4 wall timings (telemetry.time_kernel) reports
+    achieved MFU and bandwidth utilization per kernel per call, plus JIT
+    compile-time / executable-cache counters, HBM live/peak gauges, and
+    padded-lane waste — surfaced in `profile.device`, `_nodes/stats`,
+    and the Prometheus exposition.
+  - `collectors` + `service`: a MonitoringService (the reference's
+    x-pack monitoring plugin analog) runs interval collectors and writes
+    reference-shaped documents into hidden `.monitoring-es-*` TSDB
+    indices on the node's own engine, with retention pruning and the
+    `xpack.monitoring.collection.{enabled,interval}` dynamic settings —
+    the engine dogfoods its own time-series storage, and a prebuilt ML
+    job can watch the engine's own latency for regressions.
+"""
+
+from .costmodel import KERNEL_COSTS, device_peaks, kernel_cost, utilization
+from .device import (
+    device_memory_snapshot,
+    device_stats,
+    install_compile_listener,
+    jit_stats,
+    kernel_utilization,
+    note_executable_cache,
+    pack_padded_waste,
+    padded_waste_bytes,
+)
+from .service import (
+    MONITORING_PREFIX,
+    SELF_WATCH_JOB_ID,
+    MonitoringService,
+    monitoring_index_name,
+    setup_self_watch_job,
+)
+
+# meter XLA compiles from the first time any monitoring-aware code path
+# loads (idempotent; jax.monitoring listener)
+install_compile_listener()
+
+__all__ = [
+    "KERNEL_COSTS", "device_peaks", "kernel_cost", "utilization",
+    "device_memory_snapshot", "device_stats", "install_compile_listener",
+    "jit_stats", "kernel_utilization", "note_executable_cache",
+    "pack_padded_waste", "padded_waste_bytes",
+    "MONITORING_PREFIX", "SELF_WATCH_JOB_ID", "MonitoringService",
+    "monitoring_index_name", "setup_self_watch_job",
+]

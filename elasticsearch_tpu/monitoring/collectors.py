@@ -1,0 +1,185 @@
+"""Interval collectors: reference-shaped monitoring documents.
+
+Parity target: x-pack/plugin/monitoring/.../collector/ — each collector
+samples one facet of the node (NodeStatsCollector, IndexStatsCollector,
+ClusterStatsCollector) into a typed document carrying `type`,
+`cluster_uuid`, a source-node stamp, and a `timestamp`, exported to
+`.monitoring-es-*` indices. Here the documents are TSDB points: `node`
+and `type` (and `index` for index_stats) are time_series_dimension
+fields, so (_tsid, @timestamp) de-duplicates re-collections and one
+series' points pack adjacently in the columnar device arrays."""
+
+from __future__ import annotations
+
+import time
+
+from ..telemetry import metrics
+from .device import device_stats
+
+
+def _iso_utc(ts: float | None = None) -> str:
+    t = time.time() if ts is None else ts
+    ms = int(t * 1000) % 1000
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + f".{ms:03d}Z"
+
+
+# mappings/settings of one .monitoring-es-* index. Dimensions: node +
+# type (+ index for index_stats docs). routing_path routes by node, so a
+# node's whole history lives on one shard of the monitoring index.
+def monitoring_index_body() -> dict:
+    return {
+        "settings": {
+            "index": {
+                "mode": "time_series",
+                "routing_path": ["node"],
+                "hidden": True,
+                "number_of_shards": 1,
+                "refresh_interval": "1s",
+            }
+        },
+        "mappings": {
+            "properties": {
+                "@timestamp": {"type": "date"},
+                "node": {"type": "keyword", "time_series_dimension": True},
+                "type": {"type": "keyword", "time_series_dimension": True},
+                "index": {"type": "keyword", "time_series_dimension": True},
+                "cluster_uuid": {"type": "keyword"},
+            }
+        },
+    }
+
+
+def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict:
+    """One `type: node_stats` document: indices totals, search/indexing
+    counters, request cache, breakers, and the device-utilization
+    snapshot (HBM, per-kernel MFU/bandwidth, JIT compile activity) —
+    the collector the ML self-watch job feeds on
+    (node_stats.indices.search.query_time_in_millis)."""
+    from ..cache import request_cache
+
+    now = time.time() if now is None else now
+    docs_total = 0
+    deleted = 0
+    query_total = 0
+    query_time_ms = 0
+    index_total = 0
+    store_bytes = 0
+    for idx in engine.indices.values():
+        docs_total += idx.live_count
+        deleted += sum(1 for e in idx.docs.values() if not e.alive)
+        query_total += idx.counters.get("query_total", 0)
+        query_time_ms += idx.counters.get("query_time_ms", 0)
+        index_total += idx.counters.get("index_total", 0)
+        store_bytes += getattr(idx, "_base_nbytes", 0)
+    rc = request_cache().stats()
+    breakers = {}
+    for name, b in engine.breakers.stats().items():
+        if isinstance(b, dict):
+            breakers[name] = {
+                "estimated_size_in_bytes": b.get("estimated_size_in_bytes", 0),
+                "limit_size_in_bytes": b.get("limit_size_in_bytes", 0),
+                "tripped": b.get("tripped", 0),
+            }
+    dev = device_stats(engine)
+    # flatten the per-kernel table into bounded numeric leaves: dynamic
+    # mappings grow one field per kernel metric, not per histogram bucket
+    kernels = {}
+    for kname, u in dev["utilization"]["kernels"].items():
+        kernels[kname.replace(".", "_")] = {
+            "calls": u["calls"], "wall_ms": u["wall_ms"],
+            "mfu": u["mfu"], "bw_util": u["bw_util"],
+            "flops": u["flops"], "bytes": u["bytes"],
+        }
+    snap = metrics.snapshot()
+    rest_h = snap["histograms"].get("es.rest.request.ms") or {}
+    shard_h = snap["histograms"].get("es.shard.search.ms") or {}
+    return {
+        "type": "node_stats",
+        "cluster_uuid": "elasticsearch-tpu",
+        "@timestamp": _iso_utc(now),
+        "node": node_name,
+        "node_stats": {
+            "indices": {
+                "docs": {"count": docs_total, "deleted": deleted},
+                "store": {"size_in_bytes": store_bytes},
+                "search": {
+                    "query_total": query_total,
+                    "query_time_in_millis": query_time_ms,
+                    "shard_query_ms_p50": shard_h.get("p50", 0.0),
+                    "shard_query_ms_p99": shard_h.get("p99", 0.0),
+                },
+                "indexing": {"index_total": index_total},
+                "request_cache": {
+                    "memory_size_in_bytes": rc.get("memory_size_in_bytes", 0),
+                    "hit_count": rc.get("hit_count", 0),
+                    "miss_count": rc.get("miss_count", 0),
+                    "evictions": rc.get("evictions", 0),
+                },
+            },
+            "rest": {
+                "request_ms_p50": rest_h.get("p50", 0.0),
+                "request_ms_p99": rest_h.get("p99", 0.0),
+                "request_total": rest_h.get("count", 0),
+            },
+            "breakers": breakers,
+            "device": {
+                "kind": dev["utilization"]["device_kind"],
+                "hbm_live_bytes": dev["memory"].get("live_bytes", 0),
+                "hbm_live_arrays": dev["memory"].get("live_arrays", 0),
+                "hbm_bytes_in_use": dev["memory"].get("bytes_in_use", 0),
+                "hbm_peak_bytes": dev["memory"].get("peak_bytes_in_use", 0),
+                "pack_padded_waste_bytes":
+                    dev["memory"].get("pack_padded_waste_bytes", 0),
+                "kernels": kernels,
+            },
+            "jit": {
+                "compiles": dev["jit"]["compiles"],
+                "compile_time_in_millis": dev["jit"]["compile_time_in_millis"],
+                "cache_hits": dev["jit"]["executable_cache"]["hits"],
+                "cache_misses": dev["jit"]["executable_cache"]["misses"],
+            },
+        },
+    }
+
+
+def collect_index_stats(engine, node_name: str,
+                        now: float | None = None) -> list[dict]:
+    """`type: index_stats` documents, one per non-hidden user index.
+    Dot-prefixed and hidden indices are skipped — the monitoring indices
+    must never monitor themselves into unbounded growth (the reference's
+    collectors likewise skip the .monitoring-* system indices)."""
+    now = time.time() if now is None else now
+    out = []
+    for name in sorted(engine.indices):
+        if name.startswith("."):
+            continue
+        idx = engine.indices[name]
+        if idx.settings.get("hidden"):
+            continue
+        out.append({
+            "type": "index_stats",
+            "cluster_uuid": "elasticsearch-tpu",
+            "@timestamp": _iso_utc(now),
+            "node": node_name,
+            "index": name,
+            "index_stats": {
+                "docs_count": idx.live_count,
+                "docs_deleted": sum(
+                    1 for e in idx.docs.values() if not e.alive),
+                "shards": idx.num_shards,
+                "store_size_in_bytes": getattr(idx, "_base_nbytes", 0),
+                "search_query_total": idx.counters.get("query_total", 0),
+                "search_query_time_in_millis":
+                    idx.counters.get("query_time_ms", 0),
+                "indexing_index_total": idx.counters.get("index_total", 0),
+                "refresh_total": idx.counters.get("refresh_total", 0),
+            },
+        })
+    return out
+
+
+def collect_all(engine, node_name: str) -> list[dict]:
+    """Everything one collection tick exports."""
+    now = time.time()
+    return [collect_node_stats(engine, node_name, now),
+            *collect_index_stats(engine, node_name, now)]

@@ -1,0 +1,283 @@
+"""MonitoringService: the node dogfoods its own TSDB.
+
+Parity target: x-pack/plugin/monitoring/.../MonitoringService.java — an
+interval scheduler runs the collectors and hands their documents to the
+local exporter, which writes `.monitoring-es-<version>-<date>` indices;
+CleanerService prunes indices older than the retention window
+(xpack.monitoring.history.duration). Here the exporter writes through
+the node's OWN engine (the documents land in hidden time_series-mode
+indices, so the cluster's history is queryable through the normal
+search / date_histogram / ES|QL surface), and on a replicated cluster
+node the exporter posts the bulk through the gateway instead, so the
+docs ride the replicated op log and every replica holds every node's
+history (cluster/http.py wires that exporter).
+
+The collection thread is a daemon with jittered-free fixed sleep; all
+engine access happens through the same public calls REST handlers use.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..telemetry import log, metrics
+from .collectors import collect_all, monitoring_index_body
+
+MONITORING_PREFIX = ".monitoring-es-8-"
+
+
+def monitoring_index_name(ts: float | None = None) -> str:
+    """Daily index: .monitoring-es-8-YYYY.MM.DD (UTC)."""
+    t = time.time() if ts is None else ts
+    return MONITORING_PREFIX + time.strftime("%Y.%m.%d", time.gmtime(t))
+
+
+def _index_date(name: str):
+    """-> epoch seconds of the index's UTC date, or None if not a
+    monitoring index name."""
+    if not name.startswith(MONITORING_PREFIX):
+        return None
+    try:
+        import calendar
+
+        st = time.strptime(name[len(MONITORING_PREFIX):], "%Y.%m.%d")
+        return calendar.timegm(st)
+    except ValueError:
+        return None
+
+
+class MonitoringService:
+    """Per-node collection scheduler + exporter + retention cleaner.
+
+    `exporter(index_name, docs)` defaults to writing the node's own
+    engine; `pruner(index_names)` defaults to deleting through it. A
+    cluster gateway overrides both so writes replicate (cluster/http)."""
+
+    def __init__(self, engine, node_name: str | None = None,
+                 exporter=None, pruner=None):
+        self.engine = engine
+        self.node_name = node_name or engine.tasks.node
+        self.exporter = exporter
+        self.pruner = pruner
+        # when set (rest/app.make_app wires the engine worker pool's
+        # submit), every engine-touching step of a tick runs serialized
+        # with REST traffic instead of racing it from this thread. The
+        # EXPORTER deliberately runs outside it: a cluster exporter posts
+        # through the gateway, whose op application needs the worker —
+        # running both on one single-thread pool would deadlock.
+        self.submit = None
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self._stop = False
+        self._lock = threading.Lock()
+        self.collections_total = 0
+        self.documents_written = 0
+        self.last_collection_ms: float | None = None
+        self.last_error: str | None = None
+
+    # -- settings ----------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.engine.settings.get(
+            "xpack.monitoring.collection.enabled"))
+
+    def interval_seconds(self) -> float:
+        from ..utils.durations import parse_duration_seconds
+
+        raw = self.engine.settings.get("xpack.monitoring.collection.interval")
+        sec = parse_duration_seconds(raw, 10.0)
+        return max(sec if sec is not None else 10.0, 0.1)
+
+    def retention_seconds(self) -> float:
+        from ..utils.durations import parse_duration_seconds
+
+        raw = self.engine.settings.get("xpack.monitoring.history.duration")
+        sec = parse_duration_seconds(raw, 7 * 86400.0)
+        return sec if sec is not None else 7 * 86400.0
+
+    def set_enabled(self, value) -> None:
+        """Dynamic-setting consumer: start/stop the collection thread."""
+        if value:
+            self.start()
+        else:
+            self.stop()
+
+    def set_interval(self, _value) -> None:
+        """Dynamic-setting consumer: wake the loop so the new interval
+        takes effect immediately instead of after one stale sleep."""
+        self._wake.set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = False
+            self._wake.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"monitoring-{self.node_name}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._wake.set()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._lock:
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            if self._stop or not self.enabled:
+                return
+            try:
+                self.collect_once()
+            except Exception as e:  # noqa: BLE001 - keep collecting
+                self.last_error = f"{type(e).__name__}: {e}"
+                metrics.counter_inc("es.monitoring.collection_errors")
+                log.debug("monitoring collection failed: %s", e)
+            self._wake.wait(self.interval_seconds())
+            self._wake.clear()
+
+    # -- one tick ----------------------------------------------------------
+
+    def _serialized(self, fn):
+        if self.submit is None:
+            return fn()
+        return self.submit(fn).result(timeout=120)
+
+    def collect_once(self) -> int:
+        """Run every collector, export the documents, prune expired
+        indices. -> number of documents written. Callable directly (the
+        tests and `POST /_monitoring/_collect` use it synchronously).
+        Must NOT be invoked from the engine worker itself when `submit`
+        is wired (the serialized steps would self-deadlock)."""
+        t0 = time.perf_counter()
+        docs = self._serialized(
+            lambda: collect_all(self.engine, self.node_name))
+        index_name = monitoring_index_name()
+        if self.exporter is not None:
+            self.exporter(index_name, docs)
+        else:
+            self._serialized(
+                lambda: self._export_local(index_name, docs))
+        self.prune()
+        self.collections_total += 1
+        self.documents_written += len(docs)
+        self.last_collection_ms = round(
+            (time.perf_counter() - t0) * 1000, 3)
+        metrics.counter_inc("es.monitoring.collections")
+        metrics.counter_inc("es.monitoring.documents", len(docs))
+        return len(docs)
+
+    def _export_local(self, index_name: str, docs: list[dict]) -> None:
+        """Default exporter: the node's own engine. The index is created
+        hidden + time_series on first use; (_tsid, @timestamp) ids make
+        re-export idempotent."""
+        eng = self.engine
+        if index_name not in eng.indices:
+            body = monitoring_index_body()
+            settings = {k: v for k, v in body["settings"]["index"].items()}
+            eng.create_index(index_name, mappings=body["mappings"],
+                             settings=settings)
+        idx = eng.indices[index_name]
+        for doc in docs:
+            idx.index_doc(None, doc)
+        idx.refresh()
+
+    # -- retention ---------------------------------------------------------
+
+    def prune(self) -> list[str]:
+        """Delete .monitoring-es-* indices whose UTC date fell out of the
+        retention window (ILM-style age deletion; the reference's
+        CleanerService). Today's index is never deleted regardless of a
+        tiny retention (the window floors at one day boundary)."""
+        cutoff = time.time() - self.retention_seconds()
+        expired = []
+        for name in list(self.engine.indices):
+            d = _index_date(name)
+            # an index covers its whole UTC day: expire only when the END
+            # of its day predates the cutoff
+            if d is not None and d + 86400.0 < cutoff:
+                expired.append(name)
+        if not expired:
+            return []
+        if self.pruner is not None:
+            self.pruner(expired)
+        else:
+            def _delete():
+                for name in expired:
+                    try:
+                        self.engine.delete_index(name)
+                    except Exception:  # noqa: BLE001 - raced deletion
+                        continue
+
+            self._serialized(_delete)
+        metrics.counter_inc("es.monitoring.indices_pruned", len(expired))
+        return expired
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval": self.engine.settings.get(
+                "xpack.monitoring.collection.interval"),
+            "running": self._thread is not None and self._thread.is_alive(),
+            "collections_total": self.collections_total,
+            "documents_written": self.documents_written,
+            "last_collection_ms": self.last_collection_ms,
+            "last_error": self.last_error,
+            "indices": sorted(n for n in self.engine.indices
+                              if n.startswith(MONITORING_PREFIX)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# prebuilt ML self-watch job
+# ---------------------------------------------------------------------------
+
+SELF_WATCH_JOB_ID = "monitoring-node-latency"
+
+
+def setup_self_watch_job(engine, bucket_span: str = "15m",
+                         open_job: bool = False) -> dict:
+    """Create (idempotently) the prebuilt anomaly job watching the
+    engine's OWN search latency through its monitoring history: a
+    high_mean detector over node_stats.indices.search.query_time_in_millis
+    partitioned by node, fed by a datafeed over .monitoring-es-* — the
+    reference ships the same idea as its preconfigured ML modules. The
+    engine literally watches itself for latency regressions."""
+    ml = engine.ml
+    existing = engine.meta.extras.get("ml_jobs", {})
+    created = SELF_WATCH_JOB_ID not in existing
+    if created:
+        ml.put_job(SELF_WATCH_JOB_ID, {
+            "description": "self-monitoring: node search latency",
+            "analysis_config": {
+                "bucket_span": bucket_span,
+                "detectors": [{
+                    "function": "high_mean",
+                    "field_name":
+                        "node_stats.indices.search.query_time_in_millis",
+                    "partition_field_name": "node",
+                }],
+            },
+            "data_description": {"time_field": "@timestamp"},
+        })
+        ml.put_datafeed(f"datafeed-{SELF_WATCH_JOB_ID}", {
+            "job_id": SELF_WATCH_JOB_ID,
+            "indices": [MONITORING_PREFIX + "*"],
+            "query": {"bool": {"filter": [
+                {"term": {"type": "node_stats"}}]}},
+        })
+    if open_job:
+        ml.open_job(SELF_WATCH_JOB_ID)
+    return {"job_id": SELF_WATCH_JOB_ID, "created": created,
+            "datafeed_id": f"datafeed-{SELF_WATCH_JOB_ID}"}

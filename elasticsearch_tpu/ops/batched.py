@@ -763,7 +763,8 @@ class BatchTermSearcher:
         raws = [p.chunk_outs if isinstance(p, _RawChunks) else p
                 for _, p in parts]
         with time_kernel("batched.disjunction",
-                         tier="fast" if fast else "exact", queries=Q, k=k):
+                         tier="fast" if fast else "exact", queries=Q, k=k,
+                         num_docs=self.searcher.pack.num_docs):
             host = jax.device_get(raws)
         parts = [
             (idxs, _RawChunks.stitch(h, p.Q, p.n_out)

@@ -875,6 +875,9 @@ class FusedTermSearcher:
         key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t,
                self._inkernel)
         fn = self._cache.get(key)
+        from ..monitoring.device import note_executable_cache
+
+        note_executable_cache("fused_scan", fn is not None)
         if fn is None:
             kw = dict(
                 k=k, n=n, n_pad=n_pad,
@@ -949,13 +952,23 @@ class FusedTermSearcher:
             flagged[qidx] = fl[ci][:nq]
         return scores, ids, totals, flagged
 
+    def _cost_fields(self, queries_n: int) -> dict:
+        """Shape fields of one fused pass for the cost model
+        (monitoring/costmodel): dense-tier geometry + corpus size."""
+        pack = self.searcher.pack
+        V = pack.dense_tfn.shape[0] if pack.dense_tfn is not None else 0
+        tile_n = self._tile_n
+        n_pad = -(-pack.num_docs // tile_n) * tile_n
+        return {"v": V, "num_docs": n_pad,
+                "queries": -(-queries_n // QC) * QC}
+
     def _run_pass(self, fld, queries, k):
         """One fused pass over all queries -> (v, i, t, flagged_bool)."""
         from ..telemetry import time_kernel
 
         idxs, outs = self._dispatch_batch(fld, queries, k)
-        with time_kernel("fused.pallas_scan", tier="fused",
-                         queries=len(queries), k=k):
+        with time_kernel("fused.pallas_scan", tier="fused", k=k,
+                         **self._cost_fields(len(queries))):
             host = jax.device_get(outs)
         return self._collect_batch(len(queries), k, idxs, host)
 
@@ -968,8 +981,12 @@ class FusedTermSearcher:
         discipline as StackedSearcher.search_batch for aggs). Returns a
         list of msearch-style (scores, ids, totals, first_pass_ok)
         tuples, escalation included."""
+        from ..telemetry import time_kernel
+
         disp = [self._dispatch_batch(fld, qs, k) for qs in batches]
-        hosts = jax.device_get([outs for _idxs, outs in disp])
+        with time_kernel("fused.pallas_scan", tier="fused", k=k,
+                         **self._cost_fields(sum(len(b) for b in batches))):
+            hosts = jax.device_get([outs for _idxs, outs in disp])
         out = []
         for qs, (idxs, _), host in zip(batches, disp, hosts):
             raw = self._collect_batch(len(qs), k, idxs, host)
@@ -1016,7 +1033,8 @@ class FusedTermSearcher:
             from ..telemetry import time_kernel
 
             with time_kernel("batched.escalation", tier="exact_escalation",
-                             queries=int(still.shape[0]), k=k):
+                             queries=int(still.shape[0]), k=k,
+                             num_docs=pack.num_docs):
                 sv, si, st = [
                     np.asarray(x)
                     for x in self.bts.run(

@@ -95,55 +95,68 @@ class TieredKnnScanner:
         numpy; exact (flagged queries re-run on the f32 scan)."""
         import numpy as np
 
+        from ..telemetry import time_kernel
         from .kernels import scan_topk, tiered_candidates
 
         qvecs = jnp.asarray(qvecs, jnp.float32)
-        aux_doc, aux_q = _aux_for(self.similarity, self.sq_norms, qvecs)
+        B, D = qvecs.shape
+        N = self.vectors.shape[0]
         kb = max(self.kb, k)
-        sel_v, sel_i, totals = tiered_candidates(
-            qvecs, self.mat_hi, self.mat_lo, self.live, kb,
-            transform=self.similarity, aux_doc=aux_doc, aux_q=aux_q,
-            count_positive=False, interpret=self.interpret,
-        )
-        cand_ok = jnp.isfinite(sel_v)
-        resc = _rescore_knn(
-            qvecs, self.vectors, sel_i, cand_ok, aux_doc, aux_q,
-            self.similarity,
-        )
-        # exact (score desc, docid asc): ascending sort on (-score, id)
-        neg, ids = jax.lax.sort(
-            (jnp.where(cand_ok, -resc, jnp.inf), sel_i), num_keys=2
-        )
         k_eff = min(k, kb)
-        v = -neg[:, :k_eff]
-        i = ids[:, :k_eff]
-        # margin safety: the k-th rescored score must clear everything the
-        # selection pass could have dropped (bounded by the kb-th selection
-        # score inflated by the split error), or the selection must have
-        # kept every candidate (kb-th lane empty / rescored-min tie)
-        sel_kb = sel_v[:, -1]
-        am_resc = jnp.min(jnp.where(cand_ok, resc, jnp.inf), axis=1)
-        rk = v[:, k_eff - 1]
-        bound = sel_kb + _KNN_EPS * jnp.abs(sel_kb) + 1e-6
-        safe = jnp.isneginf(sel_kb) | (rk > bound) | (rk == am_resc)
-        # np.array (copy): device_get can hand back read-only views, and
-        # the flagged-query fallback writes rows in place
-        v, i, totals, safe = (np.array(x) for x in
-                              jax.device_get((v, i, totals, safe)))
+        # the timed window spans dispatch THROUGH fetch: on an async
+        # backend compute overlaps dispatch, so a fetch-only window would
+        # undercount the kernel and report impossible >1 MFU
+        with time_kernel("vector.knn_tiered", tier="fused", queries=B,
+                         dims=D, num_docs=N, kb=kb, k=k):
+            aux_doc, aux_q = _aux_for(self.similarity, self.sq_norms, qvecs)
+            sel_v, sel_i, totals = tiered_candidates(
+                qvecs, self.mat_hi, self.mat_lo, self.live, kb,
+                transform=self.similarity, aux_doc=aux_doc, aux_q=aux_q,
+                count_positive=False, interpret=self.interpret,
+            )
+            cand_ok = jnp.isfinite(sel_v)
+            resc = _rescore_knn(
+                qvecs, self.vectors, sel_i, cand_ok, aux_doc, aux_q,
+                self.similarity,
+            )
+            # exact (score desc, docid asc): ascending sort on (-score, id)
+            neg, ids = jax.lax.sort(
+                (jnp.where(cand_ok, -resc, jnp.inf), sel_i), num_keys=2
+            )
+            v = -neg[:, :k_eff]
+            i = ids[:, :k_eff]
+            # margin safety: the k-th rescored score must clear everything
+            # the selection pass could have dropped (bounded by the kb-th
+            # selection score inflated by the split error), or the
+            # selection must have kept every candidate (kb-th lane empty /
+            # rescored-min tie)
+            sel_kb = sel_v[:, -1]
+            am_resc = jnp.min(jnp.where(cand_ok, resc, jnp.inf), axis=1)
+            rk = v[:, k_eff - 1]
+            bound = sel_kb + _KNN_EPS * jnp.abs(sel_kb) + 1e-6
+            safe = jnp.isneginf(sel_kb) | (rk > bound) | (rk == am_resc)
+            # np.array (copy): device_get can hand back read-only views,
+            # and the flagged-query fallback writes rows in place
+            v, i, totals, safe = (np.array(x) for x in
+                                  jax.device_get((v, i, totals, safe)))
         if k > k_eff:
             pad = ((0, 0), (0, k - k_eff))
             v = np.pad(v, pad, constant_values=-np.inf)
             i = np.pad(i, pad)
         if not safe.all():
             flagged = np.nonzero(~safe)[0]
-            fv, fi, _ft = scan_topk(
-                qvecs[flagged], self.mat_t, self.live, k,
-                transform=self.similarity, aux_doc=aux_doc,
-                aux_q=None if aux_q is None else aux_q[flagged],
-                count_positive=False, interpret=self.interpret,
-            )
-            v[flagged] = np.asarray(fv)
-            i[flagged] = np.asarray(fi)
+            with time_kernel("vector.knn_scan", tier="exact_escalation",
+                             queries=int(flagged.shape[0]), dims=D,
+                             num_docs=N, k=k):
+                fv, fi, _ft = scan_topk(
+                    qvecs[flagged], self.mat_t, self.live, k,
+                    transform=self.similarity, aux_doc=aux_doc,
+                    aux_q=None if aux_q is None else aux_q[flagged],
+                    count_positive=False, interpret=self.interpret,
+                )
+                fv, fi = np.asarray(fv), np.asarray(fi)
+            v[flagged] = fv
+            i[flagged] = fi
         return v, i, np.asarray(totals), safe
 
 

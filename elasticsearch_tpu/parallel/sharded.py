@@ -1065,7 +1065,8 @@ class StackedSearcher:
 
         states = [self._agg_dispatch(**r) for r in requests]
         with time_kernel("sharded.spmd_topk", shards=self.sp.S,
-                         requests=len(requests)):
+                         requests=len(requests), queries=len(requests),
+                         num_docs=self.sp.S * self.sp.n_max):
             host = jax.device_get([s["outs"] for s in states])
         wave2 = []
         for s, ho in zip(states, host):
@@ -1565,7 +1566,8 @@ def _msearch_exact_partials(ss: "StackedSearcher", fld: str,
     from ..telemetry import time_kernel
 
     with time_kernel("sharded.exact_disjunction", tier="exact", shards=S,
-                     queries=Q, k=kk):
+                     queries=Q, k=kk, num_docs=S * n_max,
+                     rows=int(np.prod(rows.shape))):
         v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
                                     jnp.asarray(ws)))
     return v, i, t
@@ -1703,6 +1705,9 @@ class _FusedShardedMsearch:
         key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t,
                self._inkernel, self.ss.mesh is None)
         fn = self._cache.get(key)
+        from ..monitoring.device import note_executable_cache
+
+        note_executable_cache("sharded_fused", fn is not None)
         if fn is not None:
             return fn
         kw = dict(
@@ -1794,7 +1799,8 @@ class _FusedShardedMsearch:
 
         profile_event("tier", tier="fused", queries=Q)
         with time_kernel("sharded.fused_pipeline", tier="fused", shards=S,
-                         queries=Q, k=k):
+                         queries=Q, k=k, v=sp.dense_v,
+                         num_docs=S * self.n_pad):
             v, i, t, fl = jax.device_get(
                 fn(self._arrays(), avgdl, rows, row_q, row_w, dr, dw))
         # [S, C, qc, ...] -> per-shard [S, Q, ...]

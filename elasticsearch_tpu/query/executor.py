@@ -152,6 +152,9 @@ class ShardSearcher:
     def _compiled(self, node: QueryNode, struct_key: tuple, k: int, agg_nodes=None, agg_key=()):
         key = (struct_key, k, agg_key)
         fn = self._cache.get(key)
+        from ..monitoring.device import note_executable_cache
+
+        note_executable_cache("compiled_plan", fn is not None)
         if fn is None:
             ctx = self.ctx
             n = self.pack.num_docs
@@ -327,7 +330,7 @@ class ShardSearcher:
         from ..ops.scoring import topk_mode
         from ..telemetry import time_kernel
 
-        with time_kernel("compiled_plan", shard=0,
+        with time_kernel("compiled_plan", shard=0, queries=1,
                          tier=topk_mode(self.pack.num_docs, k),
                          num_docs=self.pack.num_docs, k=k):
             top_scores, top_ids, total, agg_out = jax.device_get(

@@ -144,6 +144,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app["engine"] = engine
     # single-thread executor: serializes engine mutation, keeps the loop free
     app["pool"] = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
+    # the background monitoring tick serializes its engine access through
+    # the same worker instead of racing REST traffic (monitoring/service)
+    engine.monitoring.submit = app["pool"].submit
+    from ..monitoring import install_compile_listener
+
+    install_compile_listener()
 
     async def call(fn, *args, **kwargs):
         loop = asyncio.get_running_loop()
@@ -1558,16 +1564,18 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     cat_master_api = _cat_endpoint(lambda req: _admin.cat_master(engine))
     cat_recovery_api = _cat_endpoint(lambda req: _admin.cat_recovery(engine))
     cat_plugins_api = _cat_endpoint(lambda req: _admin.cat_plugins(engine))
+    cat_tasks_api = _cat_endpoint(lambda req: _admin.cat_tasks(engine))
 
     # ---- task management -------------------------------------------------
 
-    def _tasks_by_node(tasks):
+    def _tasks_by_node(tasks, detailed: bool = True):
         return {
             "nodes": {
                 engine.tasks.node: {
                     "name": engine.tasks.node,
                     "transport_address": "127.0.0.1:9300",
-                    "tasks": {t.task_id: t.to_dict() for t in tasks},
+                    "tasks": {t.task_id: t.to_dict(detailed=detailed)
+                              for t in tasks},
                 }
             }
         } if tasks else {"nodes": {}}
@@ -1578,7 +1586,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             actions=request.query.get("actions"),
             parent_task_id=request.query.get("parent_task_id"),
         )
-        return web.json_response(_tasks_by_node(tasks))
+        # ?detailed=true adds description + human running_time (reference
+        # behavior: TransportListTasksAction detailed flag)
+        detailed = request.query.get("detailed") in ("", "true", "1")
+        return web.json_response(_tasks_by_node(tasks, detailed=detailed))
 
     @handler
     async def tasks_get(request):
@@ -2366,6 +2377,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         import jax
 
         from ..cache import request_cache
+        from ..monitoring import device as _mon_device
         from ..telemetry import TRACER, metrics, recent_slowlogs
 
         devices = [str(d) for d in jax.devices()]
@@ -2390,6 +2402,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # (anomaly detectors / datafeeds / model memory)
                         "ml": engine.ml.node_stats(),
                         "tpu": {"devices": devices},
+                        # device-utilization accounting (monitoring/):
+                        # HBM live/peak + padded waste, per-kernel
+                        # cumulative MFU / bandwidth utilization, JIT
+                        # compile + executable-cache counters
+                        "device": _mon_device.device_stats(engine),
+                        "monitoring": engine.monitoring.stats(),
                         "metrics": metrics.snapshot(),
                         # tail-latency inspection without log scraping:
                         # the most recent slowlog entries (now carrying
@@ -2441,10 +2459,52 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     "miss_count", "entry_count"):
             if key in cs:
                 extra[f"es.request_cache.{key}"] = cs[key]
+        # device-utilization gauges (monitoring/): HBM residency + the
+        # padded-lane waste of the fixed-shape packs; the per-kernel MFU /
+        # bandwidth histograms (es.kernel.*.mfu_pct / .bw_pct) ride the
+        # registry exposition above
+        from ..monitoring import device as _mon_device
+
+        mem = _mon_device.device_memory_snapshot()
+        for key in ("live_bytes", "live_arrays", "bytes_in_use",
+                    "peak_bytes_in_use", "bytes_limit"):
+            if key in mem and mem[key] is not None:
+                extra[f"es.device.hbm.{key}"] = mem[key]
+        extra["es.device.pack_padded_waste_bytes"] = \
+            _mon_device.padded_waste_bytes(engine)
         return web.Response(
             text=metrics.prometheus_text(extra),
             content_type="text/plain", charset="utf-8",
         )
+
+    @handler
+    async def monitoring_collect(request):
+        """POST /_monitoring/_collect: run one collection tick
+        synchronously (tests / operators; the interval thread is the
+        production path). Works whether or not collection is enabled.
+        Runs on the DEFAULT executor, not the engine worker: collect_once
+        serializes its engine-touching steps through the worker itself
+        (monitoring.submit), so running it there would self-deadlock."""
+        loop = asyncio.get_running_loop()
+        n = await loop.run_in_executor(None, engine.monitoring.collect_once)
+        return web.json_response(
+            {"acknowledged": True, "documents": n,
+             **engine.monitoring.stats()})
+
+    @handler
+    async def monitoring_stats(request):
+        return web.json_response(engine.monitoring.stats())
+
+    @handler
+    async def monitoring_setup_ml(request):
+        """POST /_monitoring/ml/_setup: create the prebuilt self-watch
+        anomaly job (datafeed over .monitoring-es-*)."""
+        from ..monitoring import setup_self_watch_job
+
+        body = await body_json(request, {}) or {}
+        return web.json_response(await call(
+            setup_self_watch_job, engine,
+            body.get("bucket_span", "15m"), bool(body.get("open", False))))
 
     @handler
     async def nodes_hot_threads(request):
@@ -2512,6 +2572,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_nodes/hot_threads", nodes_hot_threads)
     app.router.add_get("/_trace/{trace_id}", get_trace)
     app.router.add_get("/_prometheus/metrics", prometheus_metrics)
+    app.router.add_get("/_monitoring", monitoring_stats)
+    app.router.add_post("/_monitoring/_collect", monitoring_collect)
+    app.router.add_post("/_monitoring/ml/_setup", monitoring_setup_ml)
     app.router.add_post("/_bulk", bulk)
     app.router.add_post("/_msearch", msearch)
     app.router.add_post("/_search/scroll", scroll_continue)
@@ -2729,6 +2792,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/recovery", cat_recovery_api)
     app.router.add_get("/_cat/plugins", cat_plugins_api)
     app.router.add_get("/_cat/templates", cat_templates_api)
+    app.router.add_get("/_cat/tasks", cat_tasks_api)
     app.router.add_get("/_tasks", tasks_list)
     app.router.add_get("/_tasks/{task_id}", tasks_get)
     app.router.add_post("/_tasks/_cancel", tasks_cancel)

@@ -125,7 +125,8 @@ def device_sections(events: list[dict] | None, num_shards: int) -> list[dict]:
                 "time_in_nanos": int(float(e.get("ms", 0.0)) * 1e6),
                 "scope": "shard" if isinstance(s, int) else "mesh",
             }
-            for key in ("tier", "queries", "k", "shards", "num_docs"):
+            for key in ("tier", "queries", "k", "shards", "num_docs",
+                        "flops", "bytes", "mfu", "bw_util"):
                 if key in e:
                     entry[key] = e[key]
             for t in targets:

@@ -25,6 +25,20 @@ class TaskCancelledException(ElasticsearchTpuError):
     type = "task_cancelled_exception"
 
 
+def format_running_time(nanos: int) -> str:
+    """Human time the way the reference's TimeValue renders it for
+    _cat/tasks and ?detailed=true (largest single unit, one decimal)."""
+    if nanos < 1_000:
+        return f"{nanos}nanos"
+    if nanos < 1_000_000:
+        return f"{nanos / 1_000:.1f}micros"
+    if nanos < 1_000_000_000:
+        return f"{nanos / 1_000_000:.1f}ms"
+    if nanos < 60 * 1_000_000_000:
+        return f"{nanos / 1_000_000_000:.1f}s"
+    return f"{nanos / 60_000_000_000:.1f}m"
+
+
 @dataclass
 class Task:
     id: int
@@ -61,20 +75,28 @@ class Task:
                 f"task cancelled [{self.cancel_reason or 'by user request'}]"
             )
 
-    def to_dict(self) -> dict:
+    @property
+    def running_time_nanos(self) -> int:
+        return int((time.time() * 1000 - self.start_time_millis) * 1e6)
+
+    def to_dict(self, detailed: bool = True) -> dict:
+        """detailed=False matches the reference's default /_tasks listing
+        (no description / human running time — TransportListTasksAction
+        only computes them under ?detailed=true)."""
+        nanos = self.running_time_nanos
         d = {
             "node": self.node,
             "id": self.id,
             "type": "transport",
             "action": self.action,
-            "description": self.description,
             "start_time_in_millis": self.start_time_millis,
-            "running_time_in_nanos": int(
-                (time.time() * 1000 - self.start_time_millis) * 1e6
-            ),
+            "running_time_in_nanos": nanos,
             "cancellable": self.cancellable,
             "cancelled": self.cancelled,
         }
+        if detailed:
+            d["description"] = self.description
+            d["running_time"] = format_running_time(nanos)
         if self.parent_task_id:
             d["parent_task_id"] = self.parent_task_id
         return d

@@ -351,13 +351,40 @@ def profile_event(kind: str, **fields) -> None:
 def time_kernel(name: str, **fields):
     """Wall-time one host-level device dispatch+fetch (the Pallas / XLA
     call sites). Always feeds the kernel-level latency histogram; also
-    records a profile event when a collector is active."""
+    records a profile event when a collector is active.
+
+    PR 5: the shape fields double as the cost-model input
+    (monitoring/costmodel.KERNEL_COSTS keyed by `name`): when the model
+    resolves, the dispatch also records its FLOPs/bytes and the achieved
+    MFU + bandwidth utilization — per call into the profile event, and
+    cumulatively into es.kernel.<name>.{flops,bytes} counters and
+    .{mfu_pct,bw_pct} histograms (→ _nodes/stats device section,
+    Prometheus exposition, and the .monitoring-es-* collectors)."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        ms = (time.perf_counter() - t0) * 1000
+        sec = time.perf_counter() - t0
+        ms = sec * 1000
         metrics.histogram_record(f"es.kernel.{name}.ms", ms)
+        util = None
+        try:
+            from .monitoring.costmodel import utilization
+
+            util = utilization(name, fields, sec)
+        except Exception:  # noqa: BLE001 - accounting never fails a search
+            util = None
+        if util is not None:
+            metrics.counter_inc(f"es.kernel.{name}.flops", util["flops"])
+            metrics.counter_inc(f"es.kernel.{name}.bytes", util["bytes"])
+            metrics.histogram_record(f"es.kernel.{name}.mfu_pct",
+                                     util["mfu"] * 100.0)
+            metrics.histogram_record(f"es.kernel.{name}.bw_pct",
+                                     util["bw_util"] * 100.0)
+            fields = {**fields, "flops": util["flops"],
+                      "bytes": util["bytes"],
+                      "mfu": round(util["mfu"], 6),
+                      "bw_util": round(util["bw_util"], 6)}
         profile_event("kernel", kernel=name, ms=round(ms, 4), **fields)
 
 

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Render the latest monitoring window as a per-node / per-kernel
+utilization table.
+
+Reads the `.monitoring-es-*` TSDB indices the MonitoringService writes
+(node_stats documents carry the device-utilization snapshot: per-kernel
+MFU, bandwidth utilization, wall ms, plus HBM residency and JIT compile
+counters) and prints the newest sample per node, so "how utilized is the
+device, and what did this node look like" is one command:
+
+    python scripts/usage_report.py --url http://127.0.0.1:9200
+    python scripts/usage_report.py --data /path/to/node/data
+    python scripts/usage_report.py --url ... --window 30m --json
+
+URL mode queries a running node through the normal search surface;
+--data opens a node's data directory offline (same engine code path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+_WINDOW_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+
+
+def _window_seconds(window: str) -> float:
+    import re as _re
+
+    m = _re.fullmatch(r"(\d+(?:\.\d+)?)(s|m|h|d|ms)", window.strip())
+    if not m:
+        raise SystemExit(f"bad --window [{window}] (use e.g. 90s, 15m, 2h)")
+    if m.group(2) == "ms":
+        return float(m.group(1)) / 1000.0
+    return float(m.group(1)) * _WINDOW_UNITS[m.group(2)]
+
+
+def _search_body(window: str) -> dict:
+    import time as _time
+
+    gte = int((_time.time() - _window_seconds(window)) * 1000)
+    return {
+        "size": 200,
+        "query": {"bool": {"filter": [
+            {"term": {"type": "node_stats"}},
+            {"range": {"@timestamp": {"gte": gte,
+                                      "format": "epoch_millis"}}},
+        ]}},
+        "sort": [{"@timestamp": {"order": "desc"}}],
+    }
+
+
+def _fetch_url(url: str, window: str) -> list[dict]:
+    import urllib.request
+
+    body = json.dumps(_search_body(window)).encode()
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/.monitoring-es-*/_search", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        res = json.loads(r.read())
+    return [h["_source"] for h in res.get("hits", {}).get("hits", [])]
+
+
+def _fetch_data_dir(path: str, window: str) -> list[dict]:
+    from elasticsearch_tpu.engine import Engine
+
+    eng = Engine(path)
+    try:
+        body = _search_body(window)
+        res = eng.search_multi(
+            ".monitoring-es-*", query=body["query"], size=body["size"],
+            sort=body["sort"])
+        return [h["_source"] for h in res.get("hits", {}).get("hits", [])]
+    finally:
+        eng.close()
+
+
+def latest_per_node(docs: list[dict]) -> dict[str, dict]:
+    """Newest node_stats doc per node (docs arrive @timestamp-desc)."""
+    out: dict[str, dict] = {}
+    for d in docs:
+        node = d.get("node")
+        if node and node not in out:
+            out[node] = d
+    return out
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("b", "kb", "mb", "gb", "tb"):
+        if n < 1024 or unit == "tb":
+            return f"{n:.1f}{unit}" if unit != "b" else f"{int(n)}b"
+        n /= 1024
+    return f"{n:.1f}tb"
+
+
+def render(per_node: dict[str, dict], out=None) -> None:
+    out = out or sys.stdout
+    if not per_node:
+        print("no node_stats documents in the window "
+              "(is xpack.monitoring.collection.enabled true?)", file=out)
+        return
+    for node in sorted(per_node):
+        d = per_node[node]
+        ns = d.get("node_stats", {})
+        dev = ns.get("device", {})
+        jit = ns.get("jit", {})
+        print(f"node {node}  @ {d.get('@timestamp')}  "
+              f"device={dev.get('kind')}", file=out)
+        print(f"  hbm: live={_fmt_bytes(dev.get('hbm_live_bytes'))} "
+              f"({dev.get('hbm_live_arrays', 0)} arrays)  "
+              f"peak={_fmt_bytes(dev.get('hbm_peak_bytes'))}  "
+              f"padded-waste={_fmt_bytes(dev.get('pack_padded_waste_bytes'))}",
+              file=out)
+        print(f"  jit: compiles={jit.get('compiles', 0)} "
+              f"({jit.get('compile_time_in_millis', 0)}ms)  "
+              f"exec-cache {jit.get('cache_hits', 0)}h/"
+              f"{jit.get('cache_misses', 0)}m", file=out)
+        kernels = dev.get("kernels") or {}
+        if not kernels:
+            print("  (no kernel dispatches recorded)", file=out)
+            continue
+        rows = [("kernel", "calls", "wall_ms", "mfu", "bw_util")]
+        for name in sorted(kernels):
+            u = kernels[name]
+            rows.append((name, str(u.get("calls", 0)),
+                         f"{u.get('wall_ms', 0):.1f}",
+                         f"{u.get('mfu', 0) * 100:.3f}%",
+                         f"{u.get('bw_util', 0) * 100:.3f}%"))
+        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+        for r in rows:
+            print("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  .rstrip(), file=out)
+        print(file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", help="running node, e.g. http://127.0.0.1:9200")
+    ap.add_argument("--data", help="node data directory (offline)")
+    ap.add_argument("--window", default="15m",
+                    help="lookback window (ES duration, default 15m)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw newest-per-node docs as JSON")
+    args = ap.parse_args(argv)
+    if not args.url and not args.data:
+        ap.error("one of --url / --data is required")
+    docs = (_fetch_url(args.url, args.window) if args.url
+            else _fetch_data_dir(args.data, args.window))
+    per_node = latest_per_node(docs)
+    if args.json:
+        print(json.dumps(per_node, indent=2, default=str))
+    else:
+        render(per_node)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
