@@ -911,6 +911,213 @@ def config5_8shard(rng):
     }
 
 
+def config6_serving(rng):
+    """C6 closed-loop serving arm (ROADMAP item 3): N concurrent clients
+    against the continuous-batching front end vs today's per-request
+    dispatch. Both arms run the IDENTICAL request stream through the same
+    single engine thread (the REST `call` discipline); the only variable
+    is whether concurrent requests coalesce into packed device waves.
+    Records QPS, p50/p99, wave occupancy, and per-kernel MFU for both
+    arms — the occupancy→MFU argument of BENCH_NOTES round 10."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from elasticsearch_tpu.engine.engine import Engine
+
+    n_docs = 4_000 if os.environ.get("ES_BENCH_SMOKE") else 100_000
+    n_clients = 64 if os.environ.get("ES_BENCH_SMOKE") else 512
+    reqs_per_client = 4
+    n_reqs = n_clients * reqs_per_client
+
+    log(f"[c6] building {n_docs}-doc engine index...")
+    lens, tok = build_corpus(rng, n_docs=n_docs)
+    import shutil
+    import tempfile
+
+    data_dir = tempfile.mkdtemp(prefix="es_bench_c6_")
+    engine = Engine(data_dir)
+    idx = engine.create_index("c6", {"properties": {"body": {"type": "text"}}})
+    term_strs = np.array([f"t{i}" for i in range(VOCAB)])
+    doc_terms = term_strs[tok]
+    off = 0
+    for ln in lens:
+        idx.index_doc(None, {"body": " ".join(doc_terms[off:off + ln])})
+        off += ln
+    idx.refresh()
+    idx.searcher  # force-merge: the term lane packs on a sealed base
+
+    # request stream: term-lane-eligible match queries (1-3 terms drawn
+    # from real docs), the serving steady state. One fixed stream, both
+    # arms replay it identically.
+    qs = sample_queries(rng, lens, tok, n_reqs, terms_per_query=3)
+    bodies = [{"query": {"match": {"body": " ".join(t for t, _ in q)}},
+               "size": TOP_K} for q in qs]
+
+    pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="c6-engine")
+
+    def _closed_loop(issue_fn, name):
+        """n_clients closed-loop threads drain the shared stream; returns
+        (qps, per-request wall-ms list)."""
+        lat_ms = [0.0] * n_reqs
+        it = iter(range(n_reqs))
+        lock = threading.Lock()
+
+        def client(cid):
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                issue_fn(i, cid)
+                lat_ms[i] = (time.perf_counter() - t0) * 1e3
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t_all = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_all
+        qps = n_reqs / elapsed
+        log(f"[c6] {name}: {n_reqs} reqs / {elapsed:.2f}s = {qps:.0f} QPS")
+        return qps, lat_ms
+
+    # per-arm device utilization comes from the PR-5 cumulative registry
+    # counters (es.kernel.<n>.flops/bytes + .ms histogram sums): the
+    # closed-loop arms run on client/engine threads, outside any one
+    # thread's profile-event collector — the registry sees all of them
+    def _util_delta(before, after):
+        from elasticsearch_tpu.monitoring.costmodel import device_peaks
+
+        peak_f, peak_b, kind = device_peaks()
+        bc, ac = before["counters"], after["counters"]
+        bh, ah = before["histograms"], after["histograms"]
+        kernels = {}
+        for name, v in ac.items():
+            if not (name.startswith("es.kernel.")
+                    and name.endswith(".flops")):
+                continue
+            kern = name[len("es.kernel."):-len(".flops")]
+            flops = v - bc.get(name, 0.0)
+            if flops <= 0:
+                continue
+            byts = (ac.get(f"es.kernel.{kern}.bytes", 0.0)
+                    - bc.get(f"es.kernel.{kern}.bytes", 0.0))
+            ms = (ah.get(f"es.kernel.{kern}.ms", {}).get("sum", 0.0)
+                  - bh.get(f"es.kernel.{kern}.ms", {}).get("sum", 0.0))
+            sec = max(ms / 1e3, 1e-9)
+            kernels[kern] = {"ms": round(ms, 3),
+                             "mfu": round(flops / sec / peak_f, 5),
+                             "bw_util": round(byts / sec / peak_b, 5)}
+        return {"device_kind": kind, "kernels": kernels}
+
+    from elasticsearch_tpu.telemetry import metrics as _metrics
+
+    # ---- arm A: per-request dispatch (today's REST model) ----------------
+    def solo(i, _cid):
+        b = bodies[i]
+        return pool.submit(engine.search_multi, "c6", query=b["query"],
+                           size=b["size"]).result()
+
+    solo(0, 0)  # compile-warm the solo plan family
+    snap0 = _metrics.snapshot()
+    a_qps, a_lat = _closed_loop(solo, "per-request")
+    a_util = _util_delta(snap0, _metrics.snapshot())
+
+    # ---- arm B: continuous-batching serving front end --------------------
+    svc = engine.serving
+    svc.bind_executor(pool.submit)
+    svc.set_enabled(True)
+    entries = [svc.classify("c6", b, {}) for b in bodies]
+    assert all(e is not None for e in entries), "stream must be wave-eligible"
+    # warm the power-of-two wave-tier compile family with untimed bursts
+    for burst in (1, 8, 64, min(256, n_clients)):
+        futs = [svc.submit(dict(entries[i]), tenant="warm")
+                for i in range(burst)]
+        for f in futs:
+            f.result(timeout=600)
+
+    b_results = [None] * n_reqs
+
+    def coalesced(i, cid):
+        b_results[i] = svc.submit(
+            entries[i], tenant=f"client-{cid % 8}").result(timeout=600)
+
+    snap1 = _metrics.snapshot()
+    b_qps, b_lat = _closed_loop(coalesced, "serving")
+    b_util = _util_delta(snap1, _metrics.snapshot())
+    st = svc.stats()
+
+    # ---- parity gates ----------------------------------------------------
+    # (1) the coalescing contract, asserted byte-level: a request packed
+    # into a shared wave returns EXACTLY what it returns dispatched alone
+    # through the same path (pipeline idle -> wave of 1). This is what
+    # coalescing itself must never change.
+    sample = rng.integers(0, n_reqs, size=64)
+    for i in sample:
+        alone = json.dumps(svc.submit(dict(entries[int(i)]),
+                                      tenant="gate").result(timeout=600),
+                           sort_keys=True)
+        assert json.dumps(b_results[int(i)], sort_keys=True) == alone, (
+            f"coalesced result diverged from solo-wave on request {i}")
+    # (2) vs the classic per-request executor: the term-lane kernel and
+    # the compiled plan sum BM25 terms in different fp orders (~1e-7
+    # relative score skew, same contract as the C1 fused gate), so this
+    # level is rank parity with fp-tie tolerance, recorded not assumed.
+    rank_ok = 0
+    gate_n = 128
+    for i in rng.integers(0, n_reqs, size=gate_n):
+        b = bodies[int(i)]
+        classic = engine.search_multi("c6", query=b["query"],
+                                      size=b["size"])
+        co = b_results[int(i)]
+        ch = [(h["_id"], h["_score"]) for h in classic["hits"]["hits"]]
+        gh = [(h["_id"], h["_score"]) for h in co["hits"]["hits"]]
+        rank_ok += (
+            classic["hits"]["total"] == co["hits"]["total"]
+            and len(ch) == len(gh)
+            and all(a_id == g_id
+                    or abs(a_s - g_s) <= 1e-5 * max(abs(a_s), 1.0)
+                    for (a_id, a_s), (g_id, g_s) in zip(ch, gh)))
+    rank_parity = rank_ok / gate_n
+
+    svc.stop()
+    engine.close()
+    pool.shutdown(wait=True)
+    shutil.rmtree(data_dir, ignore_errors=True)
+
+    return {
+        "docs": n_docs,
+        "clients": n_clients,
+        "requests": n_reqs,
+        "per_request": {
+            "qps": round(a_qps, 1),
+            "latency": _hist_pcts("bench.c6.per_request.ms", a_lat),
+            "device_utilization": a_util,
+        },
+        "serving": {
+            "qps": round(b_qps, 1),
+            "latency": _hist_pcts("bench.c6.serving.ms", b_lat),
+            "device_utilization": b_util,
+            "waves": st["waves"],
+            "avg_wave_size": round(st["wave"]["avg_size"], 1),
+            "avg_term_occupancy": st["wave"]["avg_term_occupancy"],
+            "term_packed": st["term_packed"],
+            "shed": st["shed"],
+        },
+        "speedup": round(b_qps / max(a_qps, 1e-9), 2),
+        "parity": {
+            "coalesced_vs_solo_wave": "byte-identical (64-sample asserted)",
+            "rank_parity_vs_classic": rank_parity,
+        },
+        "basis": "identical request stream, identical single engine "
+                 "thread; arm B coalesces concurrent requests into padded "
+                 "power-of-two device waves (serving/)",
+    }
+
+
 def preflight():
     """Compile every kernel geometry the bench will dispatch BEFORE any
     timed run (VERDICT r3 #8: round 3 lost a config mid-bench to an
@@ -1114,6 +1321,10 @@ def main():
         c1q = extras.get("match_bm25", {}).get("qps")
         if c1q and "error" not in extras.get("msearch_8shard", {}):
             extras["msearch_8shard"]["c1_single_chip_1m_qps"] = c1q
+
+    if only in (None, "c6"):
+        _guard("serving_closed_loop", lambda: config6_serving(rng))
+        gc.collect()
 
     _write_record(extras, partial=False)
     print(_summary_line(extras, partial=False))

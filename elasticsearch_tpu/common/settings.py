@@ -223,6 +223,20 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True, validator=_validate_duration),
         Setting("xpack.monitoring.history.duration", "7d", str,
                 dynamic=True, validator=_validate_duration),
+        # continuous-batching serving front end (serving/): admission,
+        # coalescing into device waves, deadline/fairness scheduling,
+        # backpressure. queue.max_depth is the analog of the reference's
+        # search thread-pool queue_size (overflow -> 429), max_wait the
+        # coalescing window a lone request may be held for at most.
+        Setting("serving.enabled", False, Setting.bool_, dynamic=True),
+        Setting("serving.max_wave", 256, Setting.positive_int, dynamic=True),
+        Setting("serving.coalesce.max_wait", "2ms", str, dynamic=True,
+                validator=_validate_duration),
+        Setting("serving.queue.max_depth", 1000, Setting.positive_int,
+                dynamic=True),
+        # per-tenant weighted fair scheduling: "tenantA:4,tenantB:1"
+        # (X-Opaque-Id is the tenant identity; unlisted tenants weigh 1)
+        Setting("serving.tenant.weights", "", str, dynamic=True),
     ]
 
 

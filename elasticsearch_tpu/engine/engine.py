@@ -1002,6 +1002,21 @@ class EsIndex:
                                        prune_floor=None if knn is not None else prune_floor)
         if knn is not None and knn_only:
             res.total = min(res.total, k_total)
+        return self._format_generic_hits(
+            res, track_total_hits, prune_floor, aggs_request, had_pipeline,
+            script_fields=script_fields, collapse=collapse,
+            collapse_keys=collapse_keys,
+        )
+
+    def _format_generic_hits(self, res, track_total_hits, prune_floor,
+                             aggs_request=None, had_pipeline=False,
+                             script_fields=None, collapse=None,
+                             collapse_keys=None) -> dict:
+        """Turn a StackedResult into the response body `_search_inner`
+        returns — shared by the solo path and the serving wave lanes so a
+        coalesced request's response is built by the identical code."""
+        from ..aggs.pipeline import apply_pipeline_aggs
+
         hits = []
         for i, (s, d, score) in enumerate(zip(res.doc_shards, res.doc_ids, res.scores)):
             doc_id, src = self.shard_docs[s][d]
@@ -1084,6 +1099,13 @@ class EsIndex:
         k = max(size + from_, 1)
         rb = self._searcher.search(q, size=k, prune_floor=prune_floor)
         rt = self._tail.search(q, size=k)
+        return self._tiered_merge(rb, rt, size, from_, prune_floor,
+                                  track_total_hits)
+
+    def _tiered_merge(self, rb, rt, size, from_, prune_floor,
+                      track_total_hits) -> dict:
+        """Coordinator merge of the (base, tail) tier results — shared by
+        the solo tiered path and the serving wave's tiered lane."""
         rows = []
         for tier, r in ((0, rb), (1, rt)):
             for rank, (s, d, sc) in enumerate(
@@ -1110,6 +1132,328 @@ class EsIndex:
         if track_total_hits is False:
             del hits_obj["total"]
         return {"hits": hits_obj}
+
+    # ---- serving waves ---------------------------------------------------
+
+    # kwargs the wave lanes serve; anything else falls back to solo search
+    _WAVE_UNSUPPORTED = ("sort", "search_after", "script_fields", "collapse",
+                         "rescore", "runtime_mappings")
+
+    def search_wave_begin(self, entries: list[dict]) -> dict:
+        """Serving front end: begin one coalesced wave of independent
+        search requests against this index. Lane assignment per entry:
+
+          * term lane — a pure single-field term disjunction (match /
+            term / bool-should-of-terms) with no aggs packs into ONE
+            batched msearch program per (field, k), padded to the
+            compiled power-of-two batch tier (parallel/sharded
+            msearch_wave). Scores agree with the compiled-plan path to
+            ~1e-5 (fp summation order) and are byte-identical between
+            coalesced and solo waves.
+          * generic lane — any other wave-eligible request (aggs, knn-
+            only, filtered aliases) runs its OWN compiled program, all
+            dispatched before any fetch (StackedSearcher.search_many) —
+            byte-identical to solo execution by construction.
+          * tiered lane — when the whole wave is tier-capable on a
+            (base, tail) index, both tiers' programs batch and merge
+            per entry exactly like `_search_tiered`.
+          * fallback — anything surprising runs the full solo `search()`.
+
+        Device outputs are left UNFETCHED: `search_wave_fetch` (engine-
+        state-free) pulls them, possibly on a completer thread while the
+        engine thread plans the next wave (the serving double buffer);
+        `search_wave_finish` builds the responses. -> a wave job dict."""
+        import numpy as _np
+
+        from ..query.dsl import parse_query
+        from ..serving.coalesce import term_disjunction_of
+        from ..telemetry import TRACER
+
+        n = len(entries)
+        job = {"entries": entries, "slots": [None] * n, "fmt": [None] * n,
+               "lanes": [], "tiered": None,
+               "t0": time.monotonic(),
+               "meta": {"wave_size": n, "term_packed": 0, "term_waves": []}}
+        with TRACER.span("servingWaveDispatch", index=self.name, entries=n):
+            self._maybe_refresh()
+            kinds = [None] * n
+            for i, e in enumerate(entries):
+                self.counters["query_total"] = (
+                    self.counters.get("query_total", 0) + 1)
+                try:
+                    if any(e.get(kk) is not None
+                           for kk in self._WAVE_UNSUPPORTED) or (
+                            e.get("knn") is not None
+                            and e.get("query") is not None):
+                        kinds[i] = "fallback"
+                    else:
+                        kinds[i] = "wave"
+                except Exception as ex:  # noqa: BLE001 - per-entry envelope
+                    job["slots"][i] = ("error", ex)
+            # fallback entries first: a non-tier-capable solo search may
+            # merge (base, tail) tiers, and the wave lanes must see the
+            # post-merge state exactly like solo sequential execution
+            for i, e in enumerate(entries):
+                if kinds[i] != "fallback":
+                    continue
+                try:
+                    job["slots"][i] = ("resp", self.search(**e))
+                except Exception as ex:  # noqa: BLE001
+                    job["slots"][i] = ("error", ex)
+            wave_ix = [i for i in range(n)
+                       if kinds[i] == "wave" and job["slots"][i] is None]
+            # per-entry effective kwargs + format context
+            plans = {}
+            for i in wave_ix:
+                e = entries[i]
+                try:
+                    query, knn = e.get("query"), e.get("knn")
+                    if self.engine is not None and (knn is not None
+                                                    or query is not None):
+                        from ..inference import resolve_query_vector_builders
+
+                        svc = self.engine.inference
+                        query = resolve_query_vector_builders(query, svc)
+                        knn = resolve_query_vector_builders(knn, svc)
+                    size = int(e.get("size", 10))
+                    from_ = int(e.get("from_", 0))
+                    tth = e.get("track_total_hits")
+                    if tth is None:
+                        tth = 10_000
+                    pf = None if tth is True else (0 if tth is False
+                                                  else int(tth))
+                    plans[i] = {"query": query, "knn": knn, "size": size,
+                                "from_": from_, "tth": tth, "pf": pf,
+                                "aggs": e.get("aggs")}
+                except Exception as ex:  # noqa: BLE001
+                    job["slots"][i] = ("error", ex)
+            wave_ix = [i for i in wave_ix if job["slots"][i] is None]
+            # tiered lane: only when EVERY wave entry is tier-capable (a
+            # single generic entry would merge the tiers when run solo)
+            tiered_nodes = {}
+            if self._tail is not None and wave_ix:
+                for i in wave_ix:
+                    p = plans[i]
+                    if p["aggs"] or p["knn"] is not None:
+                        tiered_nodes = None
+                        break
+                    nd = self._tier_node(p["query"])
+                    if nd is None:
+                        tiered_nodes = None
+                        break
+                    tiered_nodes[i] = nd
+            else:
+                tiered_nodes = None
+            if tiered_nodes:
+                base_reqs, tail_reqs = [], []
+                for i in wave_ix:
+                    p = plans[i]
+                    q = (p["query"] if isinstance(p["query"], dict)
+                         or p["query"] is None else tiered_nodes[i])
+                    k = max(p["size"] + p["from_"], 1)
+                    base_reqs.append(dict(query=q, size=k, from_=0,
+                                          aggs=None, mappings=None,
+                                          prune_floor=p["pf"]))
+                    tail_reqs.append(dict(query=q, size=k, from_=0,
+                                          aggs=None, mappings=None,
+                                          prune_floor=None))
+                    job["fmt"][i] = p
+                job["tiered"] = {
+                    "ix": wave_ix,
+                    "base": (self._searcher,
+                             self._searcher.search_many_begin(base_reqs)),
+                    "tail": (self._tail,
+                             self._tail.search_many_begin(tail_reqs)),
+                }
+                return job
+            if not wave_ix:
+                return job
+            searcher = self.searcher  # merges tiers when present, like solo
+            # term lane extraction (packs into one batched program per
+            # (field, k)); everything else goes generic
+            term_groups: dict[tuple, list] = {}
+            generic_ix, generic_reqs = [], []
+            for i in wave_ix:
+                p = plans[i]
+                spec = None
+                if (not p["aggs"] and p["knn"] is None
+                        and isinstance(p["query"], dict)
+                        and searcher is not None and searcher.sp.n_max > 0):
+                    try:
+                        spec = term_disjunction_of(
+                            parse_query(p["query"], self.mappings))
+                    except Exception:  # noqa: BLE001 - generic lane raises it
+                        spec = None
+                if spec is not None:
+                    fld, terms = spec
+                    k = max(p["size"] + p["from_"], 1)
+                    term_groups.setdefault((fld, k), []).append((i, terms))
+                    job["fmt"][i] = p
+                    continue
+                # generic (incl. knn-only): replicate _search_inner's
+                # eligible prologue
+                try:
+                    aggs_request = p["aggs"]
+                    from ..aggs.pipeline import strip_pipeline_aggs
+
+                    aggs, had_pipeline = strip_pipeline_aggs(aggs_request)
+                    aggs = aggs or None
+                    query, size = p["query"], p["size"]
+                    pf = p["pf"]
+                    knn_clamp = None
+                    if p["knn"] is not None:
+                        from ..query.dsl import parse_knn
+                        from ..query.nodes import BoolNode
+
+                        knn = p["knn"]
+                        knn_nodes = [
+                            parse_knn(kn, self.mappings)
+                            for kn in (knn if isinstance(knn, list)
+                                       else [knn])
+                        ]
+                        k_total = sum(kn.k for kn in knn_nodes)
+                        query = (knn_nodes[0] if len(knn_nodes) == 1 else
+                                 BoolNode(should=knn_nodes,
+                                          minimum_should_match=1))
+                        size = min(size, max(k_total - p["from_"], 0))
+                        knn_clamp = k_total
+                        pf = None
+                    generic_ix.append(i)
+                    generic_reqs.append(dict(
+                        query=query, size=size, from_=p["from_"],
+                        aggs=aggs, mappings=None, prune_floor=pf))
+                    job["fmt"][i] = {**p, "aggs_request": aggs_request,
+                                     "had_pipeline": had_pipeline,
+                                     "knn_clamp": knn_clamp}
+                except Exception as ex:  # noqa: BLE001
+                    job["slots"][i] = ("error", ex)
+            if generic_ix:
+                job["lanes"].append({
+                    "ix": generic_ix, "searcher": searcher,
+                    "state": searcher.search_many_begin(generic_reqs),
+                })
+            # term groups run here (monolithic: the batched msearch
+            # pipeline dispatches every chunk before fetching any — its
+            # own internal pipelining); response building is host-side
+            for (fld, k), members in sorted(term_groups.items()):
+                try:
+                    from ..parallel.sharded import msearch_wave
+
+                    (v, sh, dc, tt), tier = msearch_wave(
+                        searcher, fld, [t for _, t in members], k)
+                    job["meta"]["term_packed"] += len(members)
+                    job["meta"]["term_waves"].append(
+                        (len(members), int(tier)))
+                    for row, (i, _terms) in enumerate(members):
+                        p = job["fmt"][i]
+                        nvalid = int(_np.isfinite(v[row]).sum())
+                        take = list(range(min(nvalid, k)))[
+                            p["from_"]: p["size"] + p["from_"]]
+                        hits = []
+                        for j in take:
+                            doc_id, src = self.shard_docs[
+                                int(sh[row][j])][int(dc[row][j])]
+                            hits.append({"_index": self.name,
+                                         "_id": doc_id,
+                                         "_score": float(v[row][j]),
+                                         "_source": src})
+                        hits_obj = {
+                            "total": {"value": int(tt[row]),
+                                      "relation": "eq"},
+                            "max_score": (float(v[row][0]) if nvalid
+                                          else None),
+                            "hits": hits,
+                        }
+                        if p["tth"] is False:
+                            del hits_obj["total"]
+                        job["slots"][i] = ("resp", {"hits": hits_obj})
+                except Exception as ex:  # noqa: BLE001
+                    for i, _terms in members:
+                        job["slots"][i] = ("error", ex)
+        return job
+
+    @staticmethod
+    def search_wave_fetch(job: dict) -> None:
+        """Pull the wave's pending device outputs. Touches no engine host
+        state — runs on the serving completer thread while the engine
+        thread begins the next wave (double-buffered pipelining)."""
+        for lane in job["lanes"]:
+            lane["searcher"].search_many_fetch(lane["state"])
+        t = job.get("tiered")
+        if t is not None:
+            t["base"][0].search_many_fetch(t["base"][1])
+            t["tail"][0].search_many_fetch(t["tail"][1])
+
+    def search_wave_finish(self, job: dict) -> list:
+        """Finalize a fetched wave -> per-entry response dict (or the
+        entry's exception object) in entry order. Engine thread only:
+        response building reads shard docs and stores cache entries."""
+        from ..telemetry import TRACER, record_search_slowlog
+
+        with TRACER.span("servingWaveFinalize", index=self.name,
+                         entries=len(job["entries"])):
+            for lane in job["lanes"]:
+                results = lane["searcher"].search_many_finish(
+                    lane["state"], raise_errors=False)
+                for i, res in zip(lane["ix"], results):
+                    if isinstance(res, Exception):
+                        job["slots"][i] = ("error", res)
+                        continue
+                    p = job["fmt"][i]
+                    try:
+                        if p.get("knn_clamp") is not None:
+                            res.total = min(res.total, p["knn_clamp"])
+                        job["slots"][i] = ("resp", self._format_generic_hits(
+                            res, p["tth"], p["pf"],
+                            p.get("aggs_request"), p.get("had_pipeline"),
+                        ))
+                    except Exception as ex:  # noqa: BLE001
+                        job["slots"][i] = ("error", ex)
+            t = job.get("tiered")
+            if t is not None:
+                base = t["base"][0].search_many_finish(
+                    t["base"][1], raise_errors=False)
+                tail = t["tail"][0].search_many_finish(
+                    t["tail"][1], raise_errors=False)
+                for i, rb, rt in zip(t["ix"], base, tail):
+                    err = next((r for r in (rb, rt)
+                                if isinstance(r, Exception)), None)
+                    if err is not None:
+                        job["slots"][i] = ("error", err)
+                        continue
+                    p = job["fmt"][i]
+                    try:
+                        job["slots"][i] = ("resp", self._tiered_merge(
+                            rb, rt, p["size"], p["from_"], p["pf"],
+                            p["tth"]))
+                    except Exception as ex:  # noqa: BLE001
+                        job["slots"][i] = ("error", ex)
+            took_ms = (time.monotonic() - job["t0"]) * 1000
+            out = []
+            for i, slot in enumerate(job["slots"]):
+                if slot is None:  # cannot happen; fail loudly per entry
+                    slot = ("error",
+                            RuntimeError("serving wave lost an entry"))
+                kind, payload = slot
+                if kind == "resp":
+                    # the wave wall IS each member's service time; slowlog
+                    # and query_time attribute it per entry
+                    self.counters["query_time_ms"] = (
+                        self.counters.get("query_time_ms", 0)
+                        + int(took_ms))
+                    q = job["entries"][i].get("query")
+                    record_search_slowlog(
+                        self.name, self.settings, took_ms,
+                        json.dumps(q)[:512] if q is not None else "{}")
+                out.append(payload)
+        return out
+
+    def search_wave(self, entries: list[dict]) -> list:
+        """Convenience: begin + fetch + finish in one call (bench/tests;
+        the serving scheduler drives the three stages separately)."""
+        job = self.search_wave_begin(entries)
+        self.search_wave_fetch(job)
+        return self.search_wave_finish(job)
 
     def count(self, query=None) -> int:
         self._maybe_refresh()
@@ -1208,6 +1552,7 @@ class Engine:
         self._security = None
         self._ml = None
         self._monitoring = None
+        self._serving = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1289,6 +1634,19 @@ class Engine:
             lambda v: self.monitoring.set_interval(v))
         if self.settings.get("xpack.monitoring.collection.enabled"):
             self.monitoring.start()
+        # serving front end (serving/): dynamic consumers route through
+        # the lazy property so a node serving no coalesced traffic never
+        # builds the scheduler threads
+        self.settings.add_consumer(
+            "serving.enabled", lambda v: self.serving.set_enabled(v))
+        for key, attr in (("serving.max_wave", "set_max_wave"),
+                          ("serving.coalesce.max_wait", "set_max_wait"),
+                          ("serving.queue.max_depth", "set_queue_depth"),
+                          ("serving.tenant.weights", "set_tenant_weights")):
+            self.settings.add_consumer(
+                key, lambda v, a=attr: getattr(self.serving, a)(v))
+        if self.settings.get("serving.enabled"):
+            self.serving.set_enabled(True)
 
     @property
     def security(self):
@@ -1322,6 +1680,26 @@ class Engine:
         if self._monitoring is None:
             self._monitoring = MonitoringService(self)
         return self._monitoring
+
+    @property
+    def serving(self):
+        """Continuous-batching serving front end (serving/): lazy — the
+        admission queue + wave scheduler between REST and the executor."""
+        from ..serving import ServingService
+
+        if self._serving is None:
+            self._serving = ServingService(self)
+        return self._serving
+
+    def serving_if_enabled(self):
+        """The serving service iff coalescing is enabled — without
+        building the service just to learn it's off (the per-request hot
+        path check)."""
+        if self._serving is not None:
+            return self._serving if self._serving.enabled else None
+        if self.settings.get("serving.enabled"):
+            return self.serving
+        return None
 
     def _pack_accounter(self, name: str):
         return lambda n: self.breakers.set_steady(
@@ -2280,6 +2658,8 @@ class Engine:
         return {"errors": errors, "items": items}
 
     def close(self):
+        if self._serving is not None:
+            self._serving.stop()  # drain + join the scheduler threads
         if self._monitoring is not None:
             self._monitoring.stop()  # join the collection thread
         if self._ml is not None:

@@ -93,6 +93,13 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
     snap = metrics.snapshot()
     rest_h = snap["histograms"].get("es.rest.request.ms") or {}
     shard_h = snap["histograms"].get("es.shard.search.ms") or {}
+    # serving front end (serving/): queue/wave/shed accounting so the
+    # monitoring history shows saturation as occupancy (and MFU) rising
+    # with offered load. Zeros when the node never built the service.
+    sv = getattr(engine, "_serving", None)
+    sv_st = sv.stats() if sv is not None else {}
+    sv_wave = sv_st.get("wave", {})
+    occ_h = snap["histograms"].get("es.serving.wave_occupancy") or {}
     return {
         "type": "node_stats",
         "cluster_uuid": "elasticsearch-tpu",
@@ -137,6 +144,17 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "compile_time_in_millis": dev["jit"]["compile_time_in_millis"],
                 "cache_hits": dev["jit"]["executable_cache"]["hits"],
                 "cache_misses": dev["jit"]["executable_cache"]["misses"],
+            },
+            "serving": {
+                "queue_depth": sv_st.get("queue", {}).get("depth", 0),
+                "admitted": sv_st.get("admitted", 0),
+                "completed": sv_st.get("completed", 0),
+                "shed": sv_st.get("shed", 0),
+                "expired": sv_st.get("expired", 0),
+                "cancelled": sv_st.get("cancelled", 0),
+                "waves": sv_st.get("waves", 0),
+                "avg_wave_size": sv_wave.get("avg_size", 0.0) or 0.0,
+                "term_occupancy_p50": occ_h.get("p50", 0.0),
             },
         },
     }

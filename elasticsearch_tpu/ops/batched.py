@@ -699,6 +699,46 @@ class BatchTermSearcher:
             fs = self._fused = FusedTermSearcher(self)
         return fs
 
+    @staticmethod
+    def wave_q_tier(q: int) -> int:
+        """The compiled batch tier a q-query wave pads to: the next power
+        of two (the same {1, 2, 4, ...} executable family `_chunk_q` and
+        `plan_bucketed` already key their compiled-plan caches on). The
+        serving front end pads coalesced waves to this tier so steady-
+        state traffic reuses a small family of compiled programs, and
+        reports q / wave_q_tier(q) as the wave's device occupancy."""
+        return 1 << max(q - 1, 0).bit_length() if q > 1 else 1
+
+    def msearch_coalesced(self, fld, groups, k: int = 10, **kw):
+        """Coalesced msearch for the serving front end: pack several
+        callers' query lists into ONE batched dispatch and de-interleave
+        the result rows per caller.
+
+        groups: list of per-request query lists (each a list of
+        [(term, boost)] queries). -> list of per-group (scores, ids,
+        totals, exact) numpy tuples, in group order.
+
+        Each query's result row is byte-identical to running its group
+        alone: per-row computations are independent (matmul rows, per-row
+        sorts/top-k), bucketed plan shapes derive from each query's OWN
+        terms, and chunk padding appends zero-weight queries that
+        contribute exact 0.0 to nothing — so coalescing changes only
+        which executable tier the batch pads to, never any row's bytes
+        (asserted by tests/test_serving.py)."""
+        flat = [q for g in groups for q in g]
+        if not flat:
+            return [(np.zeros((0, k), np.float32), np.zeros((0, k), np.int64),
+                     np.zeros((0,), np.int64), np.ones((0,), bool))
+                    for _ in groups]
+        scores, ids, totals, exact = self.msearch(fld, flat, k, **kw)
+        out, pos = [], 0
+        for g in groups:
+            n = len(g)
+            out.append((scores[pos:pos + n], ids[pos:pos + n],
+                        totals[pos:pos + n], exact[pos:pos + n]))
+            pos += n
+        return out
+
     def msearch_many(self, fld, batches, k: int = 10):
         """Pipelined multi-batch msearch (serving-concurrency regime):
         every batch dispatches before any fetch. Falls back to sequential

@@ -996,19 +996,12 @@ class StackedSearcher:
         warm (whole-searcher scope: the merged result depends on every
         shard, so any shard's epoch bump invalidates it); QueryNode
         requests and per-request mapping overrides bypass the cache."""
-        from ..cache import canonical_key, request_cache
+        from ..cache import request_cache
 
         rc = request_cache()
         ck = scope = None
         if rc.enabled and mappings is None and not isinstance(query, QueryNode):
-            ck = canonical_key({
-                "op": "stacked_search", "query": query, "aggs": aggs,
-                "size": int(size), "from": int(from_),
-                "prune_floor": prune_floor,
-                # query-time analyzers (synonym-set reloads) change parsed
-                # queries without any index write — part of the identity
-                "ag": getattr(self.sp.mappings, "analysis_generation", 0),
-            })
+            ck = self._request_cache_key(query, size, from_, aggs, prune_floor)
             scope = self.cache_scope()
             hit = rc.get(scope[0], scope[1], ck)
             if hit is not None:
@@ -1035,6 +1028,18 @@ class StackedSearcher:
                    _stacked_result_nbytes(res))
         return res
 
+    def _request_cache_key(self, query, size, from_, aggs, prune_floor):
+        from ..cache import canonical_key
+
+        return canonical_key({
+            "op": "stacked_search", "query": query, "aggs": aggs,
+            "size": int(size), "from": int(from_),
+            "prune_floor": prune_floor,
+            # query-time analyzers (synonym-set reloads) change parsed
+            # queries without any index write — part of the identity
+            "ag": getattr(self.sp.mappings, "analysis_generation", 0),
+        })
+
     def _search_uncached(self, query, size, from_, aggs, mappings,
                          prune_floor) -> StackedResult:
         m = mappings if mappings is not None else self.sp.mappings
@@ -1046,6 +1051,152 @@ class StackedSearcher:
         return self.search_batch(
             [dict(query=node, size=size, from_=from_, aggs=aggs, mappings=m)]
         )[0]
+
+    # -- serving waves -----------------------------------------------------
+
+    def search_many_begin(self, requests: list[dict]) -> dict:
+        """Wave-shaped entry point for the serving front end: plan and
+        DISPATCH every request's program without fetching anything, so a
+        completer thread can pull the device outputs (`search_many_fetch`,
+        engine-state-free) while the engine thread plans the next wave.
+
+        Each request dict: query, size, from_, aggs, mappings,
+        prune_floor — the `search()` keyword surface. Per-request results
+        are byte-identical to solo `search()` calls: the cache lookup,
+        WAND gate and per-request compiled program are the same code, and
+        every request's program is independent of its wave-mates (the
+        wave only shares the dispatch+fetch round trip, exactly like
+        `search_batch`). A request that raises during planning carries
+        its exception in the state and re-raises at finish."""
+        import time as _time
+
+        from ..cache import request_cache
+
+        rc = request_cache()
+        n = len(requests)
+        st = {"t0": _time.perf_counter(), "requests": requests,
+              "results": [None] * n, "states": [None] * n,
+              "errors": [None] * n, "cache_slots": [None] * n}
+        from ..telemetry import profile_event
+
+        hits = misses = 0
+        for i, r in enumerate(requests):
+            query = r.get("query")
+            size = r.get("size", 10)
+            from_ = r.get("from_", 0)
+            aggs = r.get("aggs")
+            mappings = r.get("mappings")
+            prune_floor = r.get("prune_floor")
+            try:
+                ck = scope = None
+                if (rc.enabled and mappings is None
+                        and not isinstance(query, QueryNode)):
+                    ck = self._request_cache_key(query, size, from_, aggs,
+                                                 prune_floor)
+                    scope = self.cache_scope()
+                    got = rc.get(scope[0], scope[1], ck)
+                    if got is not None:
+                        hits += 1
+                        st["results"][i] = _copy_stacked_result(got)
+                        continue
+                    misses += 1
+                m = mappings if mappings is not None else self.sp.mappings
+                node = (query if isinstance(query, QueryNode)
+                        else parse_query(query, m))
+                if prune_floor is not None and not aggs:
+                    # the WAND gate decision is host-side in the common
+                    # case (profitability rejection); an engaged gate runs
+                    # its own two-round-trip program synchronously — rare
+                    # by measurement (r05: gate engages nowhere)
+                    res = self.search_wand(node, size, from_,
+                                           floor=prune_floor)
+                    if res is not None:
+                        st["results"][i] = res
+                        st["cache_slots"][i] = (ck, scope)
+                        continue
+                st["states"][i] = self._agg_dispatch(
+                    query=node, size=size, from_=from_, aggs=aggs,
+                    mappings=m)
+                st["cache_slots"][i] = (ck, scope)
+            except Exception as ex:  # noqa: BLE001 - per-request envelope
+                st["errors"][i] = ex
+        if hits or misses:
+            profile_event("cache", scope="stacked_search", hits=hits,
+                          misses=misses)
+        st["pending"] = [s["outs"] for s in st["states"] if s is not None]
+        return st
+
+    def search_many_fetch(self, st: dict) -> None:
+        """Pull the wave's device outputs. Touches NO engine/searcher host
+        state — safe to run on a completer thread while the engine thread
+        plans the next wave (the double-buffer stage of the serving
+        pipeline)."""
+        if not st["pending"]:
+            st["host"] = []
+            return
+        from ..telemetry import time_kernel
+
+        with time_kernel("sharded.spmd_topk", shards=self.sp.S,
+                         requests=len(st["pending"]),
+                         queries=len(st["pending"]),
+                         num_docs=self.sp.S * self.sp.n_max):
+            st["host"] = jax.device_get(st["pending"])
+
+    def search_many_finish(self, st: dict,
+                           raise_errors: bool = True) -> list:
+        """Finalize a fetched wave -> per-request StackedResults in
+        request order (or the recorded exception object per slot when
+        raise_errors=False). Two-pass terms aggs run their second wave
+        here synchronously (rare). Runs on the engine thread: cache
+        stores and host merges touch shared state."""
+        import time as _time
+
+        host = iter(st.get("host") or [])
+        from ..cache import request_cache
+
+        rc = request_cache()
+        out = []
+        wave2 = []
+        for i, s in enumerate(st["states"]):
+            if s is not None:
+                s["host"] = next(host)
+                if self._agg_pass2_dispatch(s):
+                    wave2.append(s)
+        if wave2:
+            host2 = jax.device_get([s["outs2"] for s in wave2])
+            for s, h2 in zip(wave2, host2):
+                s["host2"] = h2
+        from ..telemetry import metrics as _metrics
+
+        wave_ms = (_time.perf_counter() - st["t0"]) * 1000
+        for i, s in enumerate(st["states"]):
+            if st["errors"][i] is not None:
+                if raise_errors:
+                    raise st["errors"][i]
+                out.append(st["errors"][i])
+                continue
+            res = st["results"][i] if s is None else self._agg_finalize(s)
+            slot = st["cache_slots"][i]
+            if s is not None or (slot is not None and st["results"][i]
+                                 is not None):
+                # computed this wave (dispatched or WAND): store like solo
+                _metrics.histogram_record("es.shard.search.ms", wave_ms)
+                if slot is not None and slot[0] is not None:
+                    ck, scope = slot
+                    rc.put(scope[0], scope[1], ck,
+                           _copy_stacked_result(res),
+                           _stacked_result_nbytes(res))
+            out.append(res)
+        return out
+
+    def search_many(self, requests: list[dict],
+                    raise_errors: bool = True) -> list:
+        """Cache-aware batched execution of several `search()`-shaped
+        requests: one dispatch wave, one device round trip, per-request
+        results byte-identical to solo execution (see search_many_begin)."""
+        st = self.search_many_begin(requests)
+        self.search_many_fetch(st)
+        return self.search_many_finish(st, raise_errors=raise_errors)
 
     def search_batch(self, requests: list[dict]) -> list:
         """Execute several search/agg requests with batched device
@@ -1379,6 +1530,28 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     if fs is not None and not _return_program and fs.usable(k):
         return fs.msearch(fld, queries, k)
     return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
+
+
+def msearch_wave(ss: "StackedSearcher", fld: str, queries: list,
+                 k: int = 10):
+    """Serving-wave msearch: pad the coalesced term-disjunction batch to
+    the compiled power-of-two batch tier (pad queries are empty — they
+    plan to zero weights and score nothing) so steady-state traffic
+    reuses a small executable family instead of compiling one program per
+    wave size, then strip the pad rows off.
+
+    -> ((scores [Q,k], shard [Q,k], doc [Q,k], totals [Q]), tier) — tier
+    is the padded batch width, so tier/Q is the wave's device occupancy.
+    Each real query's row is byte-identical to a solo 1-query wave: rows
+    are computed independently per query and pad lanes contribute exact
+    zeros (the serving parity contract, tests/test_serving.py)."""
+    from ..ops.batched import BatchTermSearcher
+
+    Q = len(queries)
+    tier = BatchTermSearcher.wave_q_tier(Q)
+    padded = list(queries) + [[] for _ in range(tier - Q)]
+    v, s, d, t = msearch_sharded(ss, fld, padded, k)
+    return (v[:Q], s[:Q], d[:Q], t[:Q]), tier
 
 
 def _merge_shard_rows(v, i, t):

@@ -295,14 +295,12 @@ class ShardSearcher:
                    _shard_result_nbytes(res))
         return res
 
-    def _search_uncached(
-        self,
-        query: dict | QueryNode | None,
-        size: int = 10,
-        from_: int = 0,
-        mappings=None,
-        aggs: dict | None = None,
-    ) -> ShardResult:
+    def _plan_request(self, query, size, from_, mappings, aggs):
+        """Parse/prepare/compile one request and DISPATCH its program (no
+        fetch). -> ("result", ShardResult) for degenerate requests or
+        ("dispatch", state); `_finalize_request` turns the fetched outputs
+        into a ShardResult. Shared by the solo path and `search_many`, so
+        coalesced waves execute byte-identical per-request programs."""
         m = mappings if mappings is not None else self.mappings
         if m is None and (aggs or not isinstance(query, QueryNode)):
             from ..utils.errors import QueryParsingError
@@ -315,10 +313,10 @@ class ShardSearcher:
 
             agg_nodes = parse_aggs(aggs, m)
         if self.pack.num_docs == 0:
-            return ShardResult(
+            return ("result", ShardResult(
                 np.array([], np.int32), np.array([], np.float32), 0, None,
                 {} if aggs else None,
-            )
+            ))
         params, struct_key = node.prepare(self.pack)
         agg_params, agg_key = {}, ()
         if agg_nodes:
@@ -327,15 +325,23 @@ class ShardSearcher:
             agg_key = tuple((n, k) for n, (_, k) in sorted(parts.items()))
         k = min(max(size + from_, 1), self.pack.num_docs)
         fn = self._compiled(node, struct_key, k, agg_nodes, agg_key)
-        from ..ops.scoring import topk_mode
-        from ..telemetry import time_kernel
+        return ("dispatch", {
+            "node": node, "struct_key": struct_key, "k": k,
+            "agg_nodes": agg_nodes, "agg_key": agg_key, "params": params,
+            "agg_params": agg_params, "size": size, "from_": from_,
+            "outs": fn(self.dev, params, agg_params),
+        })
 
-        with time_kernel("compiled_plan", shard=0, queries=1,
-                         tier=topk_mode(self.pack.num_docs, k),
-                         num_docs=self.pack.num_docs, k=k):
-            top_scores, top_ids, total, agg_out = jax.device_get(
-                fn(self.dev, params, agg_params)
-            )
+    def _finalize_request(self, state, host) -> ShardResult:
+        """host = the fetched (top_scores, top_ids, total, agg_out) of a
+        dispatched request; runs the (rare) two-pass agg second program
+        synchronously and builds the ShardResult."""
+        top_scores, top_ids, total, agg_out = host
+        node, struct_key, k = state["node"], state["struct_key"], state["k"]
+        agg_nodes, agg_key = state["agg_nodes"], state["agg_key"]
+        agg_params = state["agg_params"]
+        params = state["params"]
+        size, from_ = state["size"], state["from_"]
         aggregations = None
         if agg_nodes:
             from ..aggs import two_pass_plan
@@ -368,6 +374,88 @@ class ShardSearcher:
         return ShardResult(
             ids.astype(np.int32), scs.astype(np.float32), int(total), max_score, aggregations
         )
+
+    def _search_uncached(
+        self,
+        query: dict | QueryNode | None,
+        size: int = 10,
+        from_: int = 0,
+        mappings=None,
+        aggs: dict | None = None,
+    ) -> ShardResult:
+        kind, state = self._plan_request(query, size, from_, mappings, aggs)
+        if kind == "result":
+            return state
+        from ..ops.scoring import topk_mode
+        from ..telemetry import time_kernel
+
+        k = state["k"]
+        with time_kernel("compiled_plan", shard=0, queries=1,
+                         tier=topk_mode(self.pack.num_docs, k),
+                         num_docs=self.pack.num_docs, k=k):
+            host = jax.device_get(state["outs"])
+        return self._finalize_request(state, host)
+
+    def search_many(self, requests: list[dict]) -> list[ShardResult]:
+        """Wave-shaped entry point: execute several `search()`-shaped
+        request dicts (query, size, from_, mappings, aggs) with every
+        compiled program dispatched before ANY result is fetched — one
+        device round trip per wave instead of one per request. Cache
+        lookups/stores, planning, and per-request programs are the same
+        code as solo `search()`, so wave results are byte-identical to
+        solo execution."""
+        from ..cache import canonical_key, request_cache
+
+        rc = request_cache()
+        n = len(requests)
+        results: list = [None] * n
+        states: list = [None] * n
+        slots: list = [None] * n
+        for i, r in enumerate(requests):
+            query = r.get("query")
+            size = r.get("size", 10)
+            from_ = r.get("from_", 0)
+            mappings = r.get("mappings")
+            aggs = r.get("aggs")
+            ck = scope = None
+            if (rc.enabled and mappings is None
+                    and not isinstance(query, QueryNode)):
+                ck = canonical_key(
+                    {"op": "search", "query": query, "aggs": aggs,
+                     "size": int(size), "from": int(from_),
+                     "ag": getattr(self.mappings, "analysis_generation", 0)})
+                scope = self.cache_scope()
+                hit = rc.get(scope[0], scope[1], ck)
+                if hit is not None:
+                    results[i] = _copy_shard_result(hit)
+                    continue
+            kind, st = self._plan_request(query, size, from_, mappings, aggs)
+            if kind == "result":
+                results[i] = st
+            else:
+                states[i] = st
+                slots[i] = (ck, scope)
+        live = [s for s in states if s is not None]
+        if live:
+            from ..ops.scoring import topk_mode
+            from ..telemetry import time_kernel
+
+            k0 = max(s["k"] for s in live)
+            with time_kernel("compiled_plan", shard=0, queries=len(live),
+                             tier=topk_mode(self.pack.num_docs, k0),
+                             num_docs=self.pack.num_docs, k=k0):
+                host = jax.device_get([s["outs"] for s in live])
+            host = iter(host)
+            for i, s in enumerate(states):
+                if s is None:
+                    continue
+                res = self._finalize_request(s, next(host))
+                results[i] = res
+                if slots[i] is not None and slots[i][0] is not None:
+                    ck, scope = slots[i]
+                    rc.put(scope[0], scope[1], ck, _copy_shard_result(res),
+                           _shard_result_nbytes(res))
+        return results
 
     def count(self, query: dict | QueryNode | None, mappings=None) -> int:
         return self.search(query, size=1, mappings=mappings).total

@@ -27,6 +27,29 @@ from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
 JSON = "application/json"
 
 
+def _track_total_hits_param(body, query_params):
+    v = body.get("track_total_hits")
+    if v is None:
+        raw = query_params.get("track_total_hits")
+        if raw is None:
+            return None
+        v = True if raw in ("", "true") else False if raw == "false" else raw
+    if isinstance(v, bool):
+        return v
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"[track_total_hits] must be a boolean or an integer, got [{v}]")
+
+
+def _bool_param(query_params, name, default=False):
+    v = query_params.get(name)
+    if v is None:
+        return default
+    return v in ("", "true", "1")
+
+
 def _err_response(ex: Exception) -> web.Response:
     if isinstance(ex, ElasticsearchTpuError):
         body = ex.to_dict()
@@ -34,7 +57,14 @@ def _err_response(ex: Exception) -> web.Response:
     else:
         body = {"error": {"type": "exception", "reason": str(ex)}, "status": 500}
         status = 500
-    return web.json_response(body, status=status)
+    headers = None
+    # load-shed errors carry a backoff hint (serving admission, breaker
+    # trips surfaced through it): 429 + Retry-After, the reference's
+    # EsRejectedExecutionException discipline clients already understand
+    retry_after = getattr(ex, "retry_after_s", None)
+    if retry_after is not None:
+        headers = {"Retry-After": str(int(max(1, retry_after)))}
+    return web.json_response(body, status=status, headers=headers)
 
 
 @web.middleware
@@ -147,6 +177,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     # the background monitoring tick serializes its engine access through
     # the same worker instead of racing REST traffic (monitoring/service)
     engine.monitoring.submit = app["pool"].submit
+    # serving waves run their engine-touching stages on the same worker
+    # (one engine thread, searches and mutations serialized), while the
+    # completer thread pulls device outputs off-thread
+    engine.serving.bind_executor(app["pool"].submit)
     from ..monitoring import install_compile_listener
 
     install_compile_listener()
@@ -1703,27 +1737,6 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     # ---- search ----------------------------------------------------------
 
-    def _track_total_hits_param(body, query_params):
-        v = body.get("track_total_hits")
-        if v is None:
-            raw = query_params.get("track_total_hits")
-            if raw is None:
-                return None
-            v = True if raw in ("", "true") else False if raw == "false" else raw
-        if isinstance(v, bool):
-            return v
-        try:
-            return int(v)
-        except (TypeError, ValueError):
-            raise IllegalArgumentError(
-                f"[track_total_hits] must be a boolean or an integer, got [{v}]")
-
-    def _bool_param(query_params, name, default=False):
-        v = query_params.get(name)
-        if v is None:
-            return default
-        return v in ("", "true", "1")
-
     async def _run_search(expression, body, query_params):
         body = body or {}
         if query_params.get("routing"):
@@ -1797,12 +1810,33 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             elif scroll:
                 res = await call(engine.scroll_search, expression, scroll, **kwargs)
             else:
-                res = await call(
-                    engine.search_multi, expression,
-                    ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
-                    allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
-                    **kwargs,
-                )
+                # continuous-batching front end: wave-eligible requests
+                # ride the coalescing queue (packed device waves, tenant
+                # fairness, deadlines, backpressure) instead of a solo
+                # engine dispatch; everything else takes the classic path
+                sv = engine.serving_if_enabled()
+                entry = (sv.classify(expression, body, query_params)
+                         if sv is not None and not _prof_cm else None)
+                if entry is not None:
+                    from ..telemetry import current_trace
+                    from ..utils.durations import parse_duration_seconds
+
+                    tr = current_trace()
+                    tenant = (getattr(tr, "task_id", None) or "_anonymous")
+                    t_raw = body.get("timeout") or query_params.get("timeout")
+                    if t_raw is None:
+                        t_raw = engine.settings.get(
+                            "search.default_search_timeout")
+                    res = await sv.submit_async(
+                        entry, tenant=tenant,
+                        timeout_s=parse_duration_seconds(t_raw, None))
+                else:
+                    res = await call(
+                        engine.search_multi, expression,
+                        ignore_unavailable=_bool_param(query_params, "ignore_unavailable"),
+                        allow_no_indices=_bool_param(query_params, "allow_no_indices", True),
+                        **kwargs,
+                    )
         finally:
             if _prof_cm is not None:
                 _prof_cm.__exit__(None, None, None)
@@ -1935,7 +1969,15 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         lines = [ln for ln in raw.split("\n") if ln.strip()]
         if len(lines) % 2 != 0:
             raise IllegalArgumentError("msearch body must be header/body line pairs")
-        responses = []
+
+        async def one(name, body, shared):
+            try:
+                return {**(await _run_search(name, body, shared)),
+                        "status": 200}
+            except ElasticsearchTpuError as ex:
+                return {**ex.to_dict(), "status": ex.status}
+
+        subs = []
         for i in range(0, len(lines), 2):
             header = json.loads(lines[i])
             body = json.loads(lines[i + 1])
@@ -1945,11 +1987,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             shared = {k: request.query[k]
                       for k in ("rest_total_hits_as_int", "typed_keys")
                       if k in request.query}
-            try:
-                responses.append({**(await _run_search(name, body, shared)),
-                                  "status": 200})
-            except ElasticsearchTpuError as ex:
-                responses.append({**ex.to_dict(), "status": ex.status})
+            subs.append((name, body, shared))
+        if engine.serving_if_enabled() is not None and len(subs) > 1:
+            # concurrent submission: the serving queue coalesces the
+            # sub-searches into one device wave instead of N dispatches
+            responses = list(await asyncio.gather(
+                *(one(*s) for s in subs)))
+        else:
+            responses = [await one(*s) for s in subs]
         return web.json_response({"took": 0, "responses": responses})
 
     @handler
@@ -2408,6 +2453,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # compile + executable-cache counters
                         "device": _mon_device.device_stats(engine),
                         "monitoring": engine.monitoring.stats(),
+                        # continuous-batching front end: queue depth,
+                        # wave occupancy, shed/expiry/cancel accounting
+                        "serving": engine.serving.stats(),
                         "metrics": metrics.snapshot(),
                         # tail-latency inspection without log scraping:
                         # the most recent slowlog entries (now carrying
@@ -2420,6 +2468,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 },
             }
         )
+
+    @handler
+    async def serving_stats(request):
+        """Serving front-end introspection: queue depths per tenant,
+        admission/shed/expiry/cancel counters, wave sizing + term-lane
+        occupancy, backpressure configuration."""
+        return web.json_response({"serving": engine.serving.stats()})
 
     @handler
     async def get_trace(request):
@@ -2569,6 +2624,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_delete("/_component_template/{name}", delete_component_template)
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
+    app.router.add_get("/_serving/stats", serving_stats)
     app.router.add_get("/_nodes/hot_threads", nodes_hot_threads)
     app.router.add_get("/_trace/{trace_id}", get_trace)
     app.router.add_get("/_prometheus/metrics", prometheus_metrics)
@@ -2822,6 +2878,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         app.router.add_route(method, path, handler(h))
 
     async def on_cleanup(app):
+        # serving first: its wave stages run ON the pool, so the pool
+        # must still be alive while in-flight waves drain
+        if engine._serving is not None:
+            engine._serving.stop()
         app["pool"].shutdown(wait=True)
         engine.close()
 

@@ -1,0 +1,29 @@
+"""Continuous-batching serving front end (ROADMAP item 3).
+
+The admission/coalescing layer between REST and the executor: concurrent
+independent search/msearch/kNN requests pack into full device waves
+(grouped by compatible plan shape, padded to the compiled batch tiers
+the executor already caches), with deadline- and fairness-aware
+scheduling (per-tenant weighted queues keyed on X-Opaque-Id), double-
+buffered host↔device pipelining, and backpressure through the
+in_flight_requests breaker plus a bounded queue that sheds with 429 +
+Retry-After. Every future asynchronous workload (ESQL pages, ML
+datafeeds, CCR) shares this admission path.
+"""
+
+from .coalesce import classify_request, term_disjunction_of
+from .queue import (
+    PendingSearch, ServingRejectedError, TenantQueues, parse_tenant_weights,
+)
+from .service import ServingService, reset_all_for_tests
+
+__all__ = [
+    "PendingSearch",
+    "ServingRejectedError",
+    "ServingService",
+    "TenantQueues",
+    "classify_request",
+    "parse_tenant_weights",
+    "reset_all_for_tests",
+    "term_disjunction_of",
+]

@@ -1,0 +1,111 @@
+"""Plan-shape classification for the serving front end.
+
+A REST search is *wave-eligible* when the engine's wave executor
+(EsIndex.search_wave_begin) can serve it: a single concrete target and a
+request surface the coalescing lanes cover. Everything else returns None
+and rides the classic per-request path unchanged — classification must
+never raise, so error behavior (404s, parse errors, validation) stays
+byte-identical to the solo path that will produce it.
+"""
+
+from __future__ import annotations
+
+# body keys that change ENGINE execution; anything outside this set (or
+# the fetch-phase keys below, applied to the response after execution)
+# disqualifies the request from the coalescing lanes
+_EXEC_KEYS = {"query", "knn", "size", "from", "track_total_hits", "timeout",
+              "aggs", "aggregations"}
+# applied by apply_fetch_phase / REST post-processing on the finished
+# response — presence does not affect how the engine executes the search
+_FETCH_KEYS = {"_source", "fields", "docvalue_fields", "stored_fields",
+               "highlight", "version", "seq_no_primary_term", "explain",
+               "indices_boost", "min_score"}
+# query params that alter engine execution or response assembly in ways
+# the wave path does not replicate
+_BLOCKED_PARAMS = {"routing", "scroll", "preference", "q"}
+
+
+def term_disjunction_of(node):
+    """(field, [(term, boost), ...]) when `node` is a pure OR-of-terms the
+    batched msearch kernel serves exactly (match / term / bool-should-of-
+    terms on ONE field, minimum_should_match 1, every boost > 0 — the
+    kernel's 'matches == score > 0' contract), else None."""
+    from ..query.nodes import BoolNode, TermNode
+
+    if isinstance(node, TermNode):
+        if node.boost > 0:
+            return node.fld, [(node.term, float(node.boost))]
+        return None
+    if isinstance(node, BoolNode):
+        if node.must or node.filter or node.must_not:
+            return None
+        if node._msm() != 1 or node.boost != 1.0:
+            return None
+        fld, terms = None, []
+        for c in node.should:
+            if not isinstance(c, TermNode) or c.boost <= 0:
+                return None
+            if fld is None:
+                fld = c.fld
+            elif c.fld != fld:
+                return None
+            terms.append((c.term, float(c.boost)))
+        if fld is None:
+            return None
+        return fld, terms
+    return None
+
+
+def classify_request(engine, expression, body, query_params):
+    """-> a serving entry dict, or None when the request must take the
+    per-request path. The entry carries everything the wave executor
+    needs plus the fallback context (expression/options) for re-resolution
+    at dispatch time."""
+    try:
+        body = body or {}
+        if not isinstance(body, dict):
+            return None
+        if any(k in query_params for k in _BLOCKED_PARAMS):
+            return None
+        if any(k not in _EXEC_KEYS and k not in _FETCH_KEYS for k in body):
+            return None
+        if body.get("profile"):
+            return None
+        if isinstance(expression, str) and ":" in expression:
+            return None  # cross-cluster expressions resolve elsewhere
+        from ..rest.app import _bool_param  # shared param semantics
+
+        iu = _bool_param(query_params, "ignore_unavailable")
+        ani = _bool_param(query_params, "allow_no_indices", True)
+        targets = engine.resolve_search(expression, iu, ani)
+        if len(targets) != 1:
+            return None
+        idx, alias_filter = targets[0]
+        query = body.get("query")
+        if alias_filter is not None:
+            # same wrapping search_multi applies for a filtered alias
+            query = ({"bool": {"filter": [alias_filter]}} if query is None
+                     else {"bool": {"must": [query],
+                                    "filter": [alias_filter]}})
+        size = int(query_params.get("size", body.get("size", 10)))
+        from_ = int(query_params.get("from", body.get("from", 0)))
+        from ..rest.app import _track_total_hits_param
+
+        entry = {
+            "index": idx.name,
+            "kwargs": {
+                "query": query,
+                "knn": body.get("knn"),
+                "size": size,
+                "from_": from_,
+                "aggs": body.get("aggs") or body.get("aggregations"),
+                "track_total_hits": _track_total_hits_param(
+                    body, query_params),
+            },
+            "expression": expression,
+            "iu": iu,
+            "ani": ani,
+        }
+        return entry
+    except Exception:  # noqa: BLE001 - never classify by raising
+        return None

@@ -1,0 +1,509 @@
+"""Continuous-batching wave scheduler: the serving front end's core.
+
+Pipeline (the inference-server treatment for scatter/gather search):
+
+    REST handler ──submit──▶ per-tenant queues ──pop_wave──▶ scheduler
+        ▲  future                                   │ weighted RR + deadlines
+        │                                           ▼
+        │                            engine thread: search_wave_begin
+        │                            (parse/plan/DISPATCH, no fetch)
+        │                                           │ depth-1 handoff
+        │                                           ▼
+        │                            completer thread: search_wave_fetch
+        │                            (device pull — engine-state-free)
+        │                                           │
+        └──────── resolve ◀── engine thread: search_wave_finish ◀──┘
+
+The depth-1 handoff queue is the double buffer: while the completer
+waits on wave k's device outputs, the engine thread is free to plan and
+dispatch wave k+1 — host-side parse/plan of the next wave overlaps
+device execution of the current one (the generalization of the depth-32
+C3 host↔device pipelining to the serving path). Waves close when the
+device pipeline is idle (a lone request dispatches promptly), the wave
+is full, or the oldest entry has waited `serving.coalesce.max_wait`.
+
+Backpressure is layered: a bounded queue sheds with 429 + Retry-After
+(`serving.queue.max_depth`), admission charges the `in_flight_requests`
+breaker (trips shed the same way, before any device memory is
+committed), and the depth-1 handoff bounds in-flight waves at two.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import weakref
+
+from ..common.breaker import CircuitBreakingError
+from ..tasks import TaskCancelledException
+from ..utils.durations import parse_duration_seconds
+from .coalesce import classify_request
+from .queue import (
+    PendingSearch, ServingRejectedError, TenantQueues, parse_tenant_weights,
+)
+
+# live services, for test hygiene (conftest drains/stops them at module
+# boundaries so leaked engines never keep scheduler threads alive)
+_LIVE_SERVICES: "weakref.WeakSet[ServingService]" = weakref.WeakSet()
+
+
+def reset_all_for_tests():
+    for sv in list(_LIVE_SERVICES):
+        sv.reset_for_tests()
+
+
+def _timed_out_response() -> dict:
+    """A search whose queue wait exceeded its deadline degrades the way a
+    shard-timeout does in the reference (partial results, timed_out
+    flag) — here the 'partial result' of a never-dispatched search is
+    empty."""
+    return {
+        "timed_out": True,
+        "hits": {"total": {"value": 0, "relation": "eq"},
+                 "max_score": None, "hits": []},
+    }
+
+
+class ServingService:
+    """Admission + coalescing + deadline/fairness scheduling +
+    backpressure between REST and the executor (ROADMAP item 3)."""
+
+    TASK_ACTION = "indices:data/read/search[serving]"
+
+    def __init__(self, engine):
+        self.engine = engine
+        s = engine.settings
+        self.enabled = False
+        self.max_wave = int(s.get("serving.max_wave"))
+        self.max_wait_s = parse_duration_seconds(
+            s.get("serving.coalesce.max_wait"), 0.002) or 0.0
+        self.queue_cap = int(s.get("serving.queue.max_depth"))
+        self._tenants = TenantQueues()
+        self._tenants.set_weights(
+            parse_tenant_weights(s.get("serving.tenant.weights")))
+        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._inflight: _queue.Queue = _queue.Queue(maxsize=1)
+        self._inflight_count = 0
+        self._threads: list[threading.Thread] = []
+        self._stop = False
+        self._own_pool = None
+        self._submit_engine = None
+        self.counters = {
+            "admitted": 0, "dispatched": 0, "completed": 0, "errors": 0,
+            "shed": 0, "expired": 0, "cancelled": 0, "waves": 0,
+            "coalesced": 0, "term_packed": 0, "fallback_solo": 0,
+        }
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._size_sum = 0
+        self._wave_ms_ema: float | None = None
+        _LIVE_SERVICES.add(self)
+
+    # ---- settings consumers ---------------------------------------------
+
+    def set_enabled(self, v: bool):
+        self.enabled = bool(v)
+        if self.enabled:
+            self._ensure_threads()
+
+    def set_max_wave(self, v):
+        self.max_wave = max(1, int(v))
+
+    def set_max_wait(self, v):
+        self.max_wait_s = parse_duration_seconds(v, 0.002) or 0.0
+
+    def set_queue_depth(self, v):
+        self.queue_cap = max(1, int(v))
+
+    def set_tenant_weights(self, raw):
+        self._tenants.set_weights(parse_tenant_weights(raw))
+
+    def bind_executor(self, submit):
+        """Route engine-touching wave stages through the caller's single
+        engine thread (the REST app pool), preserving the one-writer
+        engine discipline; unbound, the service owns its own."""
+        self._submit_engine = submit
+
+    def _engine_submit(self, fn):
+        if self._submit_engine is not None:
+            return self._submit_engine(fn)
+        if self._own_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._own_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="serving-engine")
+        return self._own_pool.submit(fn)
+
+    # ---- admission -------------------------------------------------------
+
+    def classify(self, expression, body, query_params):
+        return classify_request(self.engine, expression, body, query_params)
+
+    def _retry_after_s(self) -> float:
+        ema = self._wave_ms_ema or 50.0
+        depth = self._tenants.depth
+        return min(30.0, max(1.0, depth * (ema / 1000.0) / self.max_wave))
+
+    def submit(self, entry: dict, tenant: str = "_anonymous",
+               timeout_s: float | None = None,
+               parent_task_id: str | None = None,
+               est_bytes: int = 4096):
+        """Admit one classified search -> concurrent Future resolving to
+        the engine-core response dict. Sheds (429 + Retry-After) on a
+        full queue or an in_flight_requests breaker trip — BEFORE any
+        device work is queued."""
+        from ..telemetry import metrics
+
+        if self._tenants.depth >= self.queue_cap:
+            with self._lock:
+                self.counters["shed"] += 1
+            metrics.counter_inc("es.serving.shed_total")
+            raise ServingRejectedError(
+                f"serving queue full [{self.queue_cap}] — node saturated, "
+                f"retry after backoff", self._retry_after_s())
+        try:
+            self.engine.breakers.add_estimate(
+                "in_flight_requests", est_bytes, "serving_admission")
+        except CircuitBreakingError as ex:
+            with self._lock:
+                self.counters["shed"] += 1
+            metrics.counter_inc("es.serving.shed_total")
+            ex.retry_after_s = self._retry_after_s()
+            raise
+        task = self.engine.tasks.register(
+            self.TASK_ACTION,
+            description=f"serving search [{entry.get('index')}]",
+            cancellable=True, parent_task_id=parent_task_id)
+        now = time.monotonic()
+        ps = PendingSearch(
+            entry=entry, tenant=tenant,
+            deadline=(now + timeout_s) if timeout_s else None,
+            task=task, est_bytes=est_bytes)
+        # cancelling a QUEUED task removes it from the serving queue and
+        # resolves the caller without a device round-trip (satellite fix:
+        # pre-dispatch cancellation previously had no path)
+        task.add_cancel_listener(
+            lambda reason, ps=ps: self._cancel_queued(ps, reason))
+        with self._cv:
+            self._tenants.push(ps)
+            self.counters["admitted"] += 1
+            metrics.gauge_set("es.serving.queue_depth", self._tenants.depth)
+            self._cv.notify_all()
+        self._ensure_threads()
+        return ps.future
+
+    async def submit_async(self, entry: dict, **kw):
+        import asyncio
+
+        return await asyncio.wrap_future(self.submit(entry, **kw))
+
+    # ---- terminal paths --------------------------------------------------
+
+    def _terminal(self, ps: PendingSearch):
+        self.engine.breakers.release("in_flight_requests", ps.est_bytes)
+        if ps.task is not None:
+            self.engine.tasks.unregister(ps.task)
+
+    def _finish_entry(self, ps: PendingSearch, result=None, error=None):
+        self._terminal(ps)
+        with self._lock:
+            self.counters["errors" if error is not None else
+                          "completed"] += 1
+        if ps.future.done():
+            return
+        if error is not None:
+            ps.future.set_exception(error)
+        else:
+            ps.future.set_result(result)
+
+    def _cancel_queued(self, ps: PendingSearch, reason: str):
+        if not self._tenants.claim(ps):
+            return  # already dispatched (or otherwise settled): best-effort
+        with self._lock:
+            self.counters["cancelled"] += 1
+        self._terminal(ps)
+        ps.future.set_exception(TaskCancelledException(
+            f"task cancelled before dispatch [{reason}]"))
+        from ..telemetry import metrics
+
+        metrics.gauge_set("es.serving.queue_depth", self._tenants.depth)
+
+    def _resolve_expired(self, ps: PendingSearch):
+        # cancel through the task manager (flag + listeners fire for any
+        # children), then resolve with the timed-out degradation
+        if ps.task is not None:
+            ps.task.cancel("serving deadline exceeded before dispatch")
+        with self._lock:
+            self.counters["expired"] += 1
+        self._terminal(ps)
+        ps.future.set_result(_timed_out_response())
+
+    # ---- scheduler -------------------------------------------------------
+
+    def _ensure_threads(self):
+        with self._lock:
+            if self._threads and all(t.is_alive() for t in self._threads):
+                return
+            self._stop = False
+            self._threads = [
+                threading.Thread(target=self._scheduler_loop,
+                                 name="serving-scheduler", daemon=True),
+                threading.Thread(target=self._completer_loop,
+                                 name="serving-completer", daemon=True),
+            ]
+            for t in self._threads:
+                t.start()
+
+    def _close_wave(self) -> list[PendingSearch]:
+        """Block until a wave should dispatch, then claim it. Continuous
+        batching: an idle pipeline dispatches whatever is queued at once
+        (a lone request never waits), a busy one accumulates until the
+        wave is full or the oldest entry has waited max_wait."""
+        deadline = None
+        while not self._stop:
+            with self._cv:
+                depth = self._tenants.depth
+                if depth == 0:
+                    deadline = None
+                    self._cv.wait(0.05)
+                    continue
+                if depth >= self.max_wave:
+                    break
+                if self._inflight_count == 0:
+                    break  # pipeline idle: dispatch promptly
+                if deadline is None:
+                    deadline = time.monotonic() + self.max_wait_s
+                if time.monotonic() >= deadline:
+                    break
+                self._cv.wait(max(min(self.max_wait_s, 0.005), 0.0005))
+        if self._stop:
+            return []
+        return self._tenants.pop_wave(self.max_wave)
+
+    def _scheduler_loop(self):
+        from ..telemetry import metrics
+
+        while not self._stop:
+            try:
+                wave = self._close_wave()
+                if self._stop:
+                    break
+                now = time.monotonic()
+                ready = []
+                for ps in wave:
+                    if ps.task is not None and ps.task.cancelled:
+                        with self._lock:
+                            self.counters["cancelled"] += 1
+                        self._terminal(ps)
+                        ps.future.set_exception(TaskCancelledException(
+                            f"task cancelled before dispatch "
+                            f"[{ps.task.cancel_reason}]"))
+                        continue
+                    if ps.expired(now):
+                        self._resolve_expired(ps)
+                        continue
+                    metrics.histogram_record(
+                        "es.serving.coalesce_wait_ms",
+                        (now - ps.enqueue_t) * 1000)
+                    ready.append(ps)
+                metrics.gauge_set(
+                    "es.serving.queue_depth", self._tenants.depth)
+                if not ready:
+                    continue
+                with self._lock:
+                    self._inflight_count += 1
+                    self.counters["dispatched"] += len(ready)
+                try:
+                    state = self._engine_submit(
+                        lambda: self._wave_begin(ready)).result()
+                except Exception as ex:  # noqa: BLE001 - resolve, don't die
+                    for ps in ready:
+                        self._finish_entry(ps, error=ex)
+                    with self._lock:
+                        self._inflight_count -= 1
+                    continue
+                # depth-1 handoff: the double buffer — blocks only while
+                # the completer still owns the PREVIOUS wave
+                handed = False
+                while not self._stop:
+                    try:
+                        self._inflight.put(state, timeout=0.1)
+                        handed = True
+                        break
+                    except _queue.Full:
+                        continue
+                if not handed:
+                    # stopped between dispatch and hand-off: the completer
+                    # is exiting, so resolve this wave's members here —
+                    # abandoned futures would hang their callers forever
+                    for ps in ready:
+                        if not ps.future.done():
+                            self._finish_entry(ps, error=ServingRejectedError(
+                                "serving front end stopped"))
+                    with self._lock:
+                        self._inflight_count -= 1
+            except Exception:  # noqa: BLE001 - scheduler must survive
+                time.sleep(0.01)
+
+    def _completer_loop(self):
+        while True:
+            try:
+                state = self._inflight.get(timeout=0.1)
+            except _queue.Empty:
+                if self._stop:
+                    return
+                continue
+            if state is None:
+                return
+            try:
+                for idx, _members, job in state["jobs"]:
+                    # engine-state-free device pull: overlaps the engine
+                    # thread's planning of the next wave
+                    idx.search_wave_fetch(job)
+            except Exception as ex:  # noqa: BLE001
+                state["fetch_error"] = ex
+            try:
+                self._engine_submit(lambda: self._wave_finish(state)).result()
+            except Exception as ex:  # noqa: BLE001
+                for _idx, members, _job in state["jobs"]:
+                    for ps in members:
+                        if not ps.future.done():
+                            self._finish_entry(ps, error=ex)
+            with self._lock:
+                self._inflight_count -= 1
+
+    # ---- wave stages (engine thread) ------------------------------------
+
+    def _wave_begin(self, ready: list[PendingSearch]) -> dict:
+        state = {"t0": time.monotonic(), "jobs": [], "n": len(ready)}
+        by_index: dict[str, list[PendingSearch]] = {}
+        for ps in ready:
+            by_index.setdefault(ps.entry["index"], []).append(ps)
+        for name, members in by_index.items():
+            idx = self.engine.indices.get(name)
+            if idx is None:
+                # index vanished between classify and dispatch: the solo
+                # path produces the canonical behavior (404 / empty)
+                for ps in members:
+                    with self._lock:
+                        self.counters["fallback_solo"] += 1
+                    try:
+                        res = self.engine.search_multi(
+                            ps.entry.get("expression"),
+                            ignore_unavailable=ps.entry.get("iu", False),
+                            allow_no_indices=ps.entry.get("ani", True),
+                            **ps.entry["kwargs"])
+                        self._finish_entry(ps, result=res)
+                    except Exception as ex:  # noqa: BLE001
+                        self._finish_entry(ps, error=ex)
+                continue
+            job = idx.search_wave_begin([ps.entry["kwargs"]
+                                         for ps in members])
+            state["jobs"].append((idx, members, job))
+        return state
+
+    def _wave_finish(self, state: dict):
+        from ..telemetry import metrics
+
+        err = state.get("fetch_error")
+        for idx, members, job in state["jobs"]:
+            if err is not None:
+                results = [err] * len(members)
+            else:
+                results = idx.search_wave_finish(job)
+            for ps, res in zip(members, results):
+                if isinstance(res, Exception):
+                    self._finish_entry(ps, error=res)
+                else:
+                    self._finish_entry(ps, result=res)
+            meta = job.get("meta", {})
+            with self._lock:
+                self.counters["term_packed"] += meta.get("term_packed", 0)
+            for q, tier in meta.get("term_waves", ()):
+                metrics.histogram_record(
+                    "es.serving.wave_occupancy", q / max(tier, 1))
+                with self._lock:
+                    self._occ_sum += q / max(tier, 1)
+                    self._occ_n += 1
+        wave_ms = (time.monotonic() - state["t0"]) * 1000
+        with self._lock:
+            self.counters["waves"] += 1
+            if state["n"] > 1:
+                self.counters["coalesced"] += state["n"]
+            self._size_sum += state["n"]
+            self._wave_ms_ema = (wave_ms if self._wave_ms_ema is None else
+                                 0.8 * self._wave_ms_ema + 0.2 * wave_ms)
+        metrics.histogram_record("es.serving.wave_size", state["n"])
+
+    # ---- introspection / lifecycle --------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            waves = max(self.counters["waves"], 1)
+            return {
+                "enabled": self.enabled,
+                "queue": {**self._tenants.stats(),
+                          "max_depth": self.queue_cap},
+                "wave": {
+                    "max_wave": self.max_wave,
+                    "max_wait_ms": self.max_wait_s * 1000,
+                    "in_flight": self._inflight_count,
+                    "avg_size": self._size_sum / waves,
+                    "avg_term_occupancy": (self._occ_sum / self._occ_n
+                                           if self._occ_n else None),
+                    "service_ms_ema": self._wave_ms_ema,
+                },
+                **{k: v for k, v in self.counters.items()},
+            }
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait until the queue and in-flight waves are empty."""
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._lock:
+                idle = (self._tenants.depth == 0
+                        and self._inflight_count == 0)
+            if idle:
+                return True
+            time.sleep(0.002)
+        return False
+
+    def stop(self):
+        """Stop the scheduler threads; queued entries resolve as shed."""
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._inflight.put_nowait(None)
+        except _queue.Full:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        # a completer that consumed a real wave before the sentinel may
+        # leave the sentinel queued; clear it for a future restart
+        try:
+            while True:
+                self._inflight.get_nowait()
+        except _queue.Empty:
+            pass
+        self._inflight_count = 0
+        for ps in self._tenants.drain():
+            self._terminal(ps)
+            if not ps.future.done():
+                ps.future.set_exception(ServingRejectedError(
+                    "serving front end stopped"))
+        if self._own_pool is not None:
+            self._own_pool.shutdown(wait=True)
+            self._own_pool = None
+
+    def reset_for_tests(self):
+        self.stop()
+        with self._lock:
+            for k in self.counters:
+                self.counters[k] = 0
+            self._occ_sum = self._occ_n = 0
+            self._size_sum = 0
+            self._wave_ms_ema = None

@@ -52,10 +52,25 @@ class Task:
     cancel_reason: str | None = None
     children: list = field(default_factory=list)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _cancel_listeners: list = field(default_factory=list, repr=False)
 
     @property
     def task_id(self) -> str:
         return f"{self.node}:{self.id}"
+
+    def add_cancel_listener(self, fn):
+        """fn(reason) fires exactly once when this task is cancelled. A
+        QUEUED unit of work (e.g. a search waiting in the serving
+        coalescing queue) registers one so cancellation removes it from
+        its queue immediately — without a listener the cancel flag would
+        only be observed at the next `ensure_not_cancelled` poll, which a
+        never-dispatched task never reaches."""
+        with self._lock:
+            if not self.cancelled:
+                self._cancel_listeners.append(fn)
+                return
+        # already cancelled: fire now (outside the lock)
+        fn(self.cancel_reason or "by user request")
 
     def cancel(self, reason: str = "by user request"):
         with self._lock:
@@ -65,7 +80,15 @@ class Task:
                 self.cancelled = True
                 self.cancel_reason = reason
                 ok = True
+            listeners = self._cancel_listeners if ok else []
+            if ok:
+                self._cancel_listeners = []
         if ok:
+            for fn in listeners:
+                try:
+                    fn(reason)
+                except Exception:  # noqa: BLE001 - listener bugs must not block cancel
+                    pass
             for child in list(self.children):
                 child.cancel(reason)
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the continuous-batching serving front end.
+
+Fires search requests at a running node at a FIXED offered rate
+(open-loop: arrivals don't slow down when the node does — the regime
+that exposes queue growth, deadline expiry, and 429 shedding, which a
+closed-loop bench structurally cannot), spread across tenants via
+X-Opaque-Id, and reports achieved QPS, latency percentiles, and the
+shed/timeout counts alongside the node's own /_serving/stats deltas:
+
+    python scripts/serving_stress.py --url http://127.0.0.1:9200 \
+        --index idx --qps 500 --duration 30s --tenants 8
+
+The node decides whether traffic coalesces (`serving.enabled`); run the
+generator against both settings to see the wave-packing effect. The
+512-way tier-1 stress test covers correctness; this script exists to
+drive a REAL node hard enough to watch `es.serving.wave_occupancy` and
+kernel MFU rise together in /_prometheus/metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def _parse_duration_s(raw: str) -> float:
+    raw = raw.strip()
+    for suf, mul in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if raw.endswith(suf) and raw[: -len(suf)].replace(".", "").isdigit():
+            return float(raw[: -len(suf)]) * mul
+    return float(raw)
+
+
+def _pcts(values: list[float]) -> dict:
+    if not values:
+        return {}
+    xs = sorted(values)
+
+    def p(q):
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)], 2)
+
+    return {"p50_ms": p(0.50), "p90_ms": p(0.90), "p99_ms": p(0.99),
+            "max_ms": round(xs[-1], 2)}
+
+
+async def _run(args) -> dict:
+    import aiohttp
+
+    body = json.loads(args.body) if args.body else {
+        "query": {"match": {args.field: "the quick brown fox"}},
+        "size": 10,
+    }
+    if args.timeout_param:
+        body["timeout"] = args.timeout_param
+    duration = _parse_duration_s(args.duration)
+    interval = 1.0 / args.qps
+    url = f"{args.url.rstrip('/')}/{args.index}/_search"
+    stats = {"sent": 0, "ok": 0, "shed_429": 0, "timed_out": 0,
+             "errors": 0}
+    lat_ms: list[float] = []
+    retry_after: list[float] = []
+    pending: set = set()
+
+    async def serving_stats(session):
+        try:
+            async with session.get(
+                    f"{args.url.rstrip('/')}/_serving/stats") as r:
+                return (await r.json()).get("serving", {})
+        except Exception:  # noqa: BLE001 - older nodes lack the endpoint
+            return {}
+
+    async def one(session, i):
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                    url, json=body,
+                    headers={"X-Opaque-Id":
+                             f"stress-tenant-{i % args.tenants}"}) as r:
+                payload = await r.json()
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+                if r.status == 429:
+                    stats["shed_429"] += 1
+                    if "Retry-After" in r.headers:
+                        retry_after.append(float(r.headers["Retry-After"]))
+                elif r.status == 200:
+                    stats["ok"] += 1
+                    if payload.get("timed_out"):
+                        stats["timed_out"] += 1
+                else:
+                    stats["errors"] += 1
+        except Exception:  # noqa: BLE001 - connection refused under load
+            stats["errors"] += 1
+
+    conn = aiohttp.TCPConnector(limit=args.connections)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        before = await serving_stats(session)
+        t_start = time.perf_counter()
+        i = 0
+        # open-loop: schedule by wall clock, never await the response
+        # before sending the next request
+        while time.perf_counter() - t_start < duration:
+            target = t_start + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            task = asyncio.ensure_future(one(session, i))
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+            stats["sent"] += 1
+            i += 1
+        if pending:
+            await asyncio.wait(pending, timeout=30)
+        elapsed = time.perf_counter() - t_start
+        after = await serving_stats(session)
+
+    node = {}
+    for k in ("admitted", "completed", "shed", "expired", "cancelled",
+              "waves", "coalesced", "term_packed"):
+        if k in after:
+            node[k] = after.get(k, 0) - before.get(k, 0)
+    if after.get("wave"):
+        node["avg_wave_size"] = after["wave"].get("avg_size")
+        node["avg_term_occupancy"] = after["wave"].get("avg_term_occupancy")
+    return {
+        "offered_qps": args.qps,
+        "achieved_qps": round(stats["sent"] / max(elapsed, 1e-9), 1),
+        "completed_qps": round(stats["ok"] / max(elapsed, 1e-9), 1),
+        "duration_s": round(elapsed, 2),
+        **stats,
+        "latency": _pcts(lat_ms),
+        "retry_after_s": _pcts(retry_after) if retry_after else None,
+        "node_serving_delta": node,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:9200")
+    ap.add_argument("--index", default="idx")
+    ap.add_argument("--field", default="body",
+                    help="text field for the default match query")
+    ap.add_argument("--body", default=None,
+                    help="JSON search body (overrides --field default)")
+    ap.add_argument("--qps", type=float, default=200.0,
+                    help="offered request rate (open loop)")
+    ap.add_argument("--duration", default="15s")
+    ap.add_argument("--tenants", type=int, default=8,
+                    help="spread across N X-Opaque-Id tenants")
+    ap.add_argument("--connections", type=int, default=256)
+    ap.add_argument("--timeout-param", default=None,
+                    help="per-request search timeout (e.g. 500ms) to "
+                         "exercise deadline expiry under overload")
+    args = ap.parse_args()
+    out = asyncio.run(_run(args))
+    json.dump(out, sys.stdout, indent=2)
+    print()
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,13 @@ def _module_hygiene():
     import gc
 
     gc.collect()
+    # drain + stop any serving front ends leaked by engines the module
+    # never closed: scheduler/completer threads must not survive the
+    # module boundary (they would pin their engines live and race the
+    # metrics reset below), and queued entries must resolve, not hang
+    from elasticsearch_tpu import serving as _serving
+
+    _serving.reset_all_for_tests()
     from elasticsearch_tpu.cache import request_cache
 
     request_cache().lru.clear()
