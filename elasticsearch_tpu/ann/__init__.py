@@ -1,0 +1,32 @@
+"""Device-resident quantized ANN (PR 7).
+
+The reference serves approximate kNN through per-segment Lucene HNSW
+graphs (index/codec/vectors/Lucene99HnswVectorsFormat, scalar
+quantization in Lucene99ScalarQuantizedVectorsFormat). A graph walk is
+pointer-chasing — the one shape a TPU cannot execute well — so the
+TPU-native ANN is a partitioned brute-force index instead (the
+GPUSparse / ScaNN lineage): k-means-trained IVF partitions packed into
+padded cluster tiles living in HBM, scanned by ONE batched gather-scan
+dispatch for a whole query batch, with quantized corpus tiers (int8
+per-vector scale/offset, split-bf16) shrinking bytes/query and an f32
+rescore of survivors restoring exact scores on the candidates.
+
+Layout:
+    quantize.py  int8 scalar quantization (per-vector scale/offset)
+    index.py     refresh-time build: partitions -> padded tiles + tiers
+    kernels.py   the batched gather-scan (Pallas arm + XLA arm)
+    search.py    AnnSearcher: probe -> scan -> rescore -> (tail) merge
+"""
+
+from .index import AnnBuildError, ann_to_device, build_ann
+from .quantize import dequantize_int8, scalar_quantize_int8
+from .search import AnnSearcher
+
+__all__ = [
+    "AnnBuildError",
+    "AnnSearcher",
+    "ann_to_device",
+    "build_ann",
+    "dequantize_int8",
+    "scalar_quantize_int8",
+]

@@ -1,0 +1,120 @@
+"""Refresh-time ANN build: IVF partitions packed into padded cluster tiles.
+
+The host-side k-means (ops/vector.kmeans_ivf) assigns every present
+vector to a partition; this module turns that ragged partitioning into
+the static-shape, device-resident layout the batched gather-scan
+consumes:
+
+    order   [C, L] int32   docids, cluster-major, -1 padding
+    codes   [C, L, D] int8 scalar-quantized tier (per-slot scale/offset)
+    scale   [C, L] float32
+    offset  [C, L] float32
+    centroids [C, D] float32
+
+L (the tile length) is the largest partition rounded up to the TPU lane
+width, so every cluster is one aligned [L, D] tile and a probe is one
+contiguous DMA — the "parallel inverted lists" layout of GPUSparse,
+shaped for the MXU instead of CUDA warps. The bf16 tier (split-bf16
+hi/lo pair, the ops/fused discipline) and per-slot squared norms carry
+no host storage: they are derived from the f32 vectors at device-put
+time (ann_to_device), so the serialized index stays int8-sized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TILE_LANES = 128  # cluster tiles padded to the TPU lane width
+
+
+class AnnBuildError(ValueError):
+    pass
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((max(n, 1) + mult - 1) // mult) * mult
+
+
+def build_ann(vectors, has_value, nlist: int, tile: int = TILE_LANES):
+    """-> dict(centroids, order, codes, scale, offset, nlist, tile,
+    built_n) or None when the corpus is too small for partitioning to
+    help (same 4*nlist floor as the old host-side build_ivf)."""
+    from ..ops.vector import kmeans_ivf
+
+    from .quantize import scalar_quantize_int8
+
+    vectors = np.asarray(vectors, np.float32)
+    present = np.flatnonzero(has_value)
+    if len(present) < 4 * max(nlist, 1) or nlist <= 1:
+        return None
+    centroids, assign = kmeans_ivf(vectors[present], nlist)
+    C = centroids.shape[0]
+    D = vectors.shape[1]
+    order_local = np.argsort(assign, kind="stable")
+    sizes = np.bincount(assign, minlength=C)
+    L = _round_up(int(sizes.max()), tile)
+    order = np.full((C, L), -1, np.int32)
+    codes = np.zeros((C, L, D), np.int8)
+    scale = np.zeros((C, L), np.float32)
+    offset = np.zeros((C, L), np.float32)
+    start = 0
+    docids = present[order_local].astype(np.int32)
+    for c in range(C):
+        n = int(sizes[c])
+        if n == 0:
+            continue
+        ids = docids[start:start + n]
+        order[c, :n] = ids
+        q, s, o = scalar_quantize_int8(vectors[ids])
+        codes[c, :n] = q
+        scale[c, :n] = s
+        offset[c, :n] = o
+        start += n
+    return {
+        "centroids": centroids.astype(np.float32),
+        "order": order,
+        "codes": codes,
+        "scale": scale,
+        "offset": offset,
+        "nlist": int(C),
+        "tile": int(L),
+        "built_n": int(vectors.shape[0]),
+    }
+
+
+def _gather_packed(values: np.ndarray, order: np.ndarray) -> np.ndarray:
+    """values [..., N, D] gathered by order [..., C, L] -> [..., C, L, D]
+    (pad slots -1 read row 0 and are masked to zero)."""
+    ids = np.maximum(order, 0)
+    if order.ndim == 2:
+        packed = values[ids]
+    else:  # stacked [S, ...]: per-shard gather
+        packed = np.stack([values[s][ids[s]] for s in range(order.shape[0])])
+    packed = np.where(order[..., None] >= 0, packed, 0.0)
+    return packed.astype(np.float32)
+
+
+def ann_to_device(ann: dict, values: np.ndarray, put) -> dict:
+    """Ship one ANN index (or a stacked [S, ...] family) to the device.
+
+    Derived-at-put tiers: the split-bf16 pair and per-slot squared norms
+    come from the f32 vectors — stored nowhere on the host. `put` is the
+    caller's device/sharding placement fn (executor / sharded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.kernels import split_bf16
+
+    packed = _gather_packed(np.asarray(values, np.float32),
+                            np.asarray(ann["order"]))
+    hi, lo = jax.jit(split_bf16)(jnp.asarray(packed))
+    return {
+        "centroids": put(np.asarray(ann["centroids"], np.float32)),
+        "order": put(np.asarray(ann["order"], np.int32)),
+        "codes": put(np.asarray(ann["codes"], np.int8)),
+        "scale": put(np.asarray(ann["scale"], np.float32)),
+        "offset": put(np.asarray(ann["offset"], np.float32)),
+        "hi": put(hi),
+        "lo": put(lo),
+        "sq": put((packed * packed).sum(axis=-1).astype(np.float32)),
+    }

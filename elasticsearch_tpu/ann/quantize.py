@@ -1,0 +1,59 @@
+"""int8 scalar quantization: per-vector scale/offset.
+
+The reference quantizes per segment with a global confidence interval
+(Lucene99ScalarQuantizedVectorsFormat — one [min, max] for the whole
+segment). Per-VECTOR affine ranges are strictly tighter: each vector v
+stores codes c in [-127, 127] with
+
+    v ~= scale * c + offset,   offset = (min(v) + max(v)) / 2,
+                               scale  = (max(v) - min(v)) / 254
+
+so the worst-case per-component error is scale/2 — bounded by the
+vector's own dynamic range, never by an outlier elsewhere in the
+corpus. The dot product against a query q dequantizes for free:
+
+    q . v ~= scale * (q . c) + offset * sum(q)
+
+one fused multiply-add per row after the int8 matmul, which is why the
+scan tier moves D bytes/vector instead of 4D (f32) or 2D (bf16).
+
+Error model (documented for DIVERGENCES): |q.v - q.v~| <=
+(scale/2) * sum|q_i| <= (scale/2) * sqrt(D) * ||q||. The f32 rescore
+of survivors removes this error from every returned score; it only
+affects which candidates survive selection — recall, not precision.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# code range: symmetric so scale * code never overflows the affine form
+_QMAX = 127.0
+_QLEVELS = 254.0
+
+
+def scalar_quantize_int8(vecs: np.ndarray):
+    """[M, D] f32 -> (codes int8 [M, D], scale f32 [M], offset f32 [M]).
+    All-constant vectors (max == min) get scale 0 and exact offset."""
+    vecs = np.asarray(vecs, np.float32)
+    vmin = vecs.min(axis=-1)
+    vmax = vecs.max(axis=-1)
+    offset = (vmin + vmax) / 2.0
+    scale = (vmax - vmin) / _QLEVELS
+    safe = np.where(scale > 0, scale, 1.0)
+    codes = np.rint((vecs - offset[..., None]) / safe[..., None])
+    codes = np.clip(codes, -_QMAX, _QMAX).astype(np.int8)
+    return codes, scale.astype(np.float32), offset.astype(np.float32)
+
+
+def dequantize_int8(codes: np.ndarray, scale: np.ndarray,
+                    offset: np.ndarray) -> np.ndarray:
+    """Inverse of scalar_quantize_int8 (lossy): [M, D] f32."""
+    return (codes.astype(np.float32) * np.asarray(scale)[..., None]
+            + np.asarray(offset)[..., None])
+
+
+def quantization_error_bound(scale: np.ndarray, qvec: np.ndarray) -> float:
+    """Worst-case |q.v - q.v~| over vectors with the given scales — the
+    selection-margin input for tests and the DIVERGENCES error model."""
+    return float(np.max(scale) / 2.0 * np.abs(np.asarray(qvec)).sum())

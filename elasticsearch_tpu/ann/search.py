@@ -1,0 +1,144 @@
+"""AnnSearcher: the device-resident query-time face of the ANN index.
+
+probe -> ONE batched gather-scan over the selected cluster tiles ->
+f32 rescore of survivors -> (optional) exact tail merge. Every stage is
+a named time_kernel dispatch with a monitoring/costmodel entry, so the
+achieved bandwidth utilization of the quantized scan is on record per
+call (ISSUE 7 acceptance: bw_util in profile.device_utilization).
+
+The tail tier: vectors appended to the corpus after the index was
+built (incremental refresh) are not in any cluster tile; they are
+scanned EXACTLY (f32, ops/kernels.scan_topk) and merged into the
+candidate set before the rescore, so a stale partition index can only
+cost speed, never recall, until the next rebuild.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.kernels import scan_topk
+from .index import ann_to_device
+from .kernels import SCAN_TIERS, ann_gather_scan, centroid_topk
+
+# selection width multiple: survivors carried into the f32 rescore per
+# requested k (the KB-margin discipline of ops/kernels, sized for the
+# coarser quantized selection)
+OVERSAMPLE = 4
+
+
+def default_nprobe(nlist: int, tile: int, num_candidates: int) -> int:
+    """Probes sized so the scanned slots cover ~num_candidates vectors,
+    floor 1, plus one for partition-boundary slop."""
+    if nlist <= 0:
+        return 1
+    return min(nlist, max(1, -(-num_candidates // max(tile, 1)) + 1))
+
+
+class AnnSearcher:
+    """Device-resident ANN over one vector corpus.
+
+    vectors/sq_norms are the FULL current corpus (the f32 rescore and
+    the exact tail tier read them); the cluster tiles cover only the
+    first `built_n` rows — everything beyond is tail."""
+
+    def __init__(self, ann: dict, vectors, sq_norms, similarity: str,
+                 live=None, tier: str = "int8",
+                 interpret: bool | None = None, device_put=None):
+        if tier not in SCAN_TIERS:
+            raise ValueError(f"unknown ANN scan tier [{tier}]")
+        put = device_put or jnp.asarray
+        self.similarity = similarity
+        self.tier = tier
+        self.interpret = interpret
+        self.vectors = jnp.asarray(vectors, jnp.float32)  # [N, D]
+        self.sq_norms = jnp.asarray(sq_norms, jnp.float32)
+        N = self.vectors.shape[0]
+        self.live = (jnp.ones((N,), bool) if live is None
+                     else jnp.asarray(live))
+        self.nlist = int(ann["nlist"])
+        self.tile = int(ann["tile"])
+        self.built_n = int(ann["built_n"])
+        self.dev = ann_to_device(ann, np.asarray(vectors, np.float32), put)
+        self._live_slots = None  # derived; invalidated by set_live
+
+    def set_live(self, live):
+        """Deletes: replace the live mask (cluster-tile slot mask is
+        re-derived lazily on the next search)."""
+        self.live = jnp.asarray(live)
+        self._live_slots = None
+
+    def _slot_live(self):
+        if self._live_slots is None:
+            order = self.dev["order"]
+            self._live_slots = jax.jit(
+                lambda o, lv: (o >= 0) & lv[jnp.maximum(o, 0)]
+            )(order, self.live)
+        return self._live_slots
+
+    def search(self, qvecs, k: int, *, nprobe: int | None = None,
+               num_candidates: int | None = None, tier: str | None = None):
+        """-> (scores [B, k], ids [B, k], totals [B]) numpy. Scores are
+        exact f32 (rescored); the candidate SET is approximate — recall
+        governed by nprobe. Dead lanes: -inf score, id -1."""
+        from ..ops.vector import _aux_for, _rescore_knn
+        from ..telemetry import time_kernel
+
+        tier = tier or self.tier
+        qvecs = jnp.asarray(qvecs, jnp.float32)
+        B, D = qvecs.shape
+        nc = num_candidates or max(k * OVERSAMPLE, k)
+        if nprobe is None:
+            nprobe = default_nprobe(self.nlist, self.tile, nc)
+        nprobe = max(1, min(nprobe, self.nlist))
+        kb = min(max(k, min(nc, 128)), nprobe * self.tile)
+        with time_kernel("ann.centroid_probe", tier="ann", queries=B,
+                         dims=D, nlist=self.nlist, nprobe=nprobe):
+            probes = centroid_topk(self.dev["centroids"], qvecs,
+                                   nprobe=nprobe)
+        with time_kernel("ann.gather_scan", tier=f"ann_{tier}", queries=B,
+                         dims=D, nprobe=nprobe, tile=self.tile, kb=kb,
+                         scan_tier=tier, num_docs=self.built_n):
+            sel_v, sel_i, totals = ann_gather_scan(
+                qvecs, probes, self.dev, self._slot_live(), kb,
+                tier=tier, similarity=self.similarity,
+                interpret=self.interpret)
+            sel_ok = jnp.isfinite(sel_v)
+        N = self.vectors.shape[0]
+        if N > self.built_n:
+            # exact tail tier: vectors appended since the last rebuild
+            tail_n = N - self.built_n
+            with time_kernel("ann.tail_scan", tier="ann_tail", queries=B,
+                             dims=D, num_docs=tail_n, k=min(k, tail_n)):
+                taux_d, taux_q = _aux_for(
+                    self.similarity, self.sq_norms[self.built_n:], qvecs)
+                tv, ti, tt = scan_topk(
+                    qvecs, self.vectors[self.built_n:].T,
+                    self.live[self.built_n:], min(k, tail_n),
+                    transform=self.similarity, aux_doc=taux_d,
+                    aux_q=taux_q, count_positive=False,
+                    interpret=self.interpret)
+            sel_i = jnp.concatenate(
+                [sel_i, ti.astype(jnp.int32) + self.built_n], axis=1)
+            sel_ok = jnp.concatenate([sel_ok, jnp.isfinite(tv)], axis=1)
+            totals = totals + tt
+        k_eff = min(k, sel_i.shape[1])
+        with time_kernel("ann.rescore", tier="ann", queries=B, dims=D,
+                         kb=int(sel_i.shape[1]), k=k_eff):
+            aux_doc, aux_q = _aux_for(self.similarity, self.sq_norms, qvecs)
+            resc = _rescore_knn(qvecs, self.vectors, sel_i, sel_ok,
+                                aux_doc, aux_q, self.similarity)
+            # exact result order (score desc, docid asc) over survivors
+            neg, ids = jax.lax.sort(
+                (jnp.where(sel_ok, -resc, jnp.inf), sel_i), num_keys=2)
+            v = -neg[:, :k_eff]
+            i = jnp.where(jnp.isfinite(v), ids[:, :k_eff], -1)
+            v, i, totals = jax.device_get((v, i, totals))
+        v, i = np.array(v), np.array(i)
+        if k > k_eff:
+            pad = ((0, 0), (0, k - k_eff))
+            v = np.pad(v, pad, constant_values=-np.inf)
+            i = np.pad(i, pad, constant_values=-1)
+        return v, i, np.asarray(totals)

@@ -254,6 +254,12 @@ INDEX_SETTINGS: dict[str, Setting] = {s.key: s for s in [
     Setting("hidden", False, Setting.bool_, dynamic=True),
     Setting("blocks.read_only", False, Setting.bool_, dynamic=True),
     Setting("blocks.write", False, Setting.bool_, dynamic=True),
+    # ANN probe width for knn over IVF-indexed dense_vector fields
+    # (ann/): 0 = auto (probes sized to cover ~num_candidates vectors);
+    # dynamic — recall/latency is tunable on a live index, no rebuild
+    Setting("knn.nprobe", 0, Setting.int_, dynamic=True,
+            validator=lambda v: None if v >= 0 else (_ for _ in ()).throw(
+                IllegalArgumentError("knn.nprobe must be >= 0"))),
     # per-index slowlog thresholds, dynamic + typed (reference behavior:
     # SearchSlowLog INDEX_SEARCH_SLOWLOG_THRESHOLD_*_SETTING — durations,
     # "-1" disables a level). telemetry.record_search_slowlog reads these
@@ -287,7 +293,7 @@ class IndexScopedSettings:
     # registered (and read) as dotted keys — flattened before validation,
     # so `{"search": {"slowlog": {"threshold": {"query": {"warn": ...}}}}}`
     # and `"search.slowlog.threshold.query.warn"` are the same update
-    _FLATTEN_GROUPS = ("search", "indexing")
+    _FLATTEN_GROUPS = ("search", "indexing", "knn")
 
     @classmethod
     def _flatten_groups(cls, updates: dict) -> dict:

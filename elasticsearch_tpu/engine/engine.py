@@ -903,12 +903,37 @@ class EsIndex:
             from ..query.dsl import parse_knn, parse_query
             from ..query.nodes import BoolNode, PinnedScoresNode
 
-            knn_nodes = [
-                parse_knn(k, self.mappings)
-                for k in (knn if isinstance(knn, list) else [knn])
-            ]
+            knn_bodies = knn if isinstance(knn, list) else [knn]
+            knn_nodes = [parse_knn(k, self.mappings) for k in knn_bodies]
+            self._apply_knn_settings(knn_nodes)
             knn_only = query is None
             k_total = sum(kn.k for kn in knn_nodes)
+            if (knn_only and self._tail is not None and not aggs
+                    and not had_pipeline and collapse is None
+                    and rescore is None and m_eff is None
+                    and not script_fields):
+                # tiered knn: the base tier rides its ANN index, the tail
+                # tier (docs since the last rebuild — too small to have
+                # one) is scanned EXACTLY, and the coordinator merges —
+                # incremental refresh never forces a base rebuild and
+                # never degrades recall (the ANN exact-tail contract)
+                def _tier_node():
+                    nodes = [parse_knn(k, self.mappings)
+                             for k in knn_bodies]
+                    self._apply_knn_settings(nodes)
+                    return (nodes[0] if len(nodes) == 1 else
+                            BoolNode(should=nodes, minimum_should_match=1))
+
+                eff_size = min(size, max(k_total - from_, 0))
+                k = max(eff_size + from_, 1)
+                rb = self._knn_exec(self._searcher, _tier_node(), k)
+                rt = self._knn_exec(self._tail, _tier_node(), k)
+                out = self._tiered_merge(rb, rt, eff_size, from_, None,
+                                         track_total_hits)
+                if track_total_hits is not False:
+                    tv = out["hits"]["total"]
+                    tv["value"] = min(tv["value"], k_total)
+                return out
             if not knn_only:
                 # hybrid: each knn section first retrieves its GLOBAL top k
                 # (per-shard candidates, cross-shard re-selection), and only
@@ -918,7 +943,7 @@ class EsIndex:
                 S = self.searcher.sp.S
                 pinned = []
                 for kn in knn_nodes:
-                    kres = self.searcher.search(kn, size=kn.k)
+                    kres = self._knn_exec(self.searcher, kn, kn.k)
                     per_shard = [([], []) for _ in range(S)]
                     for s, d, sc in zip(kres.doc_shards, kres.doc_ids, kres.scores):
                         per_shard[s][0].append(int(d))
@@ -1000,6 +1025,13 @@ class EsIndex:
             res = self.searcher.search(query, size=size, from_=from_, aggs=aggs,
                                        mappings=m_eff,
                                        prune_floor=None if knn is not None else prune_floor)
+            if knn is not None and self._knn_mark_starved(
+                    query, len(res.doc_ids) + from_, size + from_):
+                # filtered ANN retrieval could not reach k: re-run with
+                # the marked nodes recompiled onto the exact scan
+                res = self.searcher.search(query, size=size, from_=from_,
+                                           aggs=aggs, mappings=m_eff,
+                                           prune_floor=None)
         if knn is not None and knn_only:
             res.total = min(res.total, k_total)
         return self._format_generic_hits(
@@ -1051,6 +1083,57 @@ class EsIndex:
             "hits": hits_obj,
             **({"aggregations": res.aggregations} if res.aggregations is not None else {}),
         }
+
+    # ---- knn / ANN -------------------------------------------------------
+
+    def _apply_knn_settings(self, knn_nodes):
+        """Fill per-node nprobe from the dynamic `index.knn.nprobe`
+        setting when the request body did not pin one (0 = auto: probes
+        sized to cover ~num_candidates vectors)."""
+        try:
+            np_default = int(self.settings.get("knn.nprobe") or 0)
+        except (TypeError, ValueError):
+            np_default = 0
+        if np_default > 0:
+            for kn in knn_nodes:
+                if kn.nprobe is None:
+                    kn.nprobe = np_default
+
+    @staticmethod
+    def _knn_nodes_of(node):
+        from ..query.nodes import BoolNode, KnnNode
+
+        if isinstance(node, KnnNode):
+            return [node]
+        if isinstance(node, BoolNode):
+            return [c for c in node.should if isinstance(c, KnnNode)]
+        return []
+
+    def _knn_mark_starved(self, node, hits_found: int, window: int) -> bool:
+        """Filtered/thresholded knn on the ANN path that could not fill
+        the requested window is 'starved': the oversampled candidate
+        pool may have been eaten by the filter. Flip those nodes to
+        force_exact (recompiles onto the full scan) and report whether a
+        re-run is needed — the ONLY case the ANN path falls back."""
+        starved = [
+            kn for kn in self._knn_nodes_of(node)
+            if getattr(kn, "_ann", None) is not None
+            and (kn.filter_node is not None
+                 or kn.similarity_threshold is not None)
+        ]
+        if not starved or hits_found >= min(window, sum(
+                kn.k for kn in self._knn_nodes_of(node)) or window):
+            return False
+        for kn in starved:
+            kn.force_exact = True
+        return True
+
+    def _knn_exec(self, searcher, node, k: int):
+        """Search one knn node tree with the starved-filter escalation."""
+        res = searcher.search(node, size=k)
+        if self._knn_mark_starved(node, len(res.doc_ids), k):
+            res = searcher.search(node, size=k)
+        return res
 
     def _tier_node(self, query):
         """Parse `query` once and return the node if it can be evaluated per
@@ -1311,6 +1394,7 @@ class EsIndex:
                             for kn in (knn if isinstance(knn, list)
                                        else [knn])
                         ]
+                        self._apply_knn_settings(knn_nodes)
                         k_total = sum(kn.k for kn in knn_nodes)
                         query = (knn_nodes[0] if len(knn_nodes) == 1 else
                                  BoolNode(should=knn_nodes,
@@ -1324,7 +1408,10 @@ class EsIndex:
                         aggs=aggs, mappings=None, prune_floor=pf))
                     job["fmt"][i] = {**p, "aggs_request": aggs_request,
                                      "had_pipeline": had_pipeline,
-                                     "knn_clamp": knn_clamp}
+                                     "knn_clamp": knn_clamp,
+                                     "knn_query": (query if knn_clamp
+                                                   is not None else None),
+                                     "eff_size": size, "eff_aggs": aggs}
                 except Exception as ex:  # noqa: BLE001
                     job["slots"][i] = ("error", ex)
             if generic_ix:
@@ -1402,6 +1489,16 @@ class EsIndex:
                     p = job["fmt"][i]
                     try:
                         if p.get("knn_clamp") is not None:
+                            # starved filtered-ANN retrieval re-runs solo
+                            # on the exact scan (same escalation as
+                            # _search_inner, so wave == solo results)
+                            if self._knn_mark_starved(
+                                    p["knn_query"],
+                                    len(res.doc_ids) + p["from_"],
+                                    p["eff_size"] + p["from_"]):
+                                res = lane["searcher"].search(
+                                    p["knn_query"], size=p["eff_size"],
+                                    from_=p["from_"], aggs=p["eff_aggs"])
                             res.total = min(res.total, p["knn_clamp"])
                         job["slots"][i] = ("resp", self._format_generic_hits(
                             res, p["tth"], p["pf"],

@@ -230,6 +230,9 @@ class FieldType:
     # ANN index options (dense_vector): partitions for the IVF index (the
     # TPU-native ANN; hnsw/int8_hnsw index_options map onto it)
     ann_nlist: int | None = None
+    # selection-scan quantization tier: int8 (per-vector scale/offset)
+    # or bf16 (split-bf16 pair) — ann/ tier selection
+    ann_quant: str = "int8"
     # date/date_nanos "format" mapping parameter: ||-separated list of
     # java patterns / named formats (DateFieldMapper custom formats)
     format: str | None = None
@@ -373,6 +376,16 @@ class Mappings:
                 if io.get("type") in ("hnsw", "int8_hnsw", "int4_hnsw", "ivf"):
                     # 0 = auto (sqrt(N) at pack-build time)
                     ft.ann_nlist = int(io.get("nlist", 0))
+                    # scan tier: explicit "quantization" for type "ivf";
+                    # hnsw maps to bf16 (full-ish precision selection),
+                    # int8_hnsw/int4_hnsw to the int8 tier
+                    quant = io.get("quantization") or (
+                        "bf16" if io.get("type") == "hnsw" else "int8")
+                    if quant not in ("int8", "bf16"):
+                        raise MapperParsingError(
+                            f"dense_vector [{full}] index_options "
+                            f"quantization must be int8|bf16, got [{quant}]")
+                    ft.ann_quant = quant
             for sub_name, sub_spec in spec.get("fields", {}).items():
                 sub = FieldType(
                     name=f"{full}.{sub_name}",

@@ -131,8 +131,11 @@ class VectorColumn:
     has_value: np.ndarray  # [N] bool
     similarity: str  # cosine | dot_product | l2_norm
     dims: int
-    # optional IVF ANN partition index (ops/vector.build_ivf output)
-    ivf: dict | None = None
+    # optional device-resident ANN index (ann/index.build_ann output:
+    # IVF partitions packed into padded cluster tiles + int8 tier)
+    ann: dict | None = None
+    # selection-scan tier for the ANN path (mapping index_options)
+    ann_quant: str = "int8"
 
 
 @dataclass
@@ -628,12 +631,13 @@ class PackBuilder:
             for docid, vec in pairs:
                 vals[docid] = vec
                 has[docid] = True
-            vc = VectorColumn(vals, has, ft.similarity, ft.dims)
+            vc = VectorColumn(vals, has, ft.similarity, ft.dims,
+                              ann_quant=getattr(ft, "ann_quant", "int8"))
             if ft.ann_nlist is not None:
-                from ..ops.vector import build_ivf
+                from ..ann import build_ann
 
                 nlist = ft.ann_nlist or max(1, int(has.sum() ** 0.5))
-                vc.ivf = build_ivf(vals, has, nlist)
+                vc.ann = build_ann(vals, has, nlist)
             vectors[fld] = vc
 
         # ---- position blocks (vectorized scatter from flat CSR) ----------

@@ -81,14 +81,14 @@ def serialize_pack(pack: ShardPack, put_blob) -> dict:
         ent = {"similarity": vc.similarity, "dims": vc.dims,
                "values": put_blob(_np_bytes(vc.values)),
                "has_value": put_blob(_np_bytes(vc.has_value))}
-        if vc.ivf is not None:
-            ivf_arrays = {k: put_blob(_np_bytes(np.asarray(v)))
-                          for k, v in vc.ivf.items()
-                          if isinstance(v, np.ndarray)}
-            ivf_scalars = {k: v for k, v in vc.ivf.items()
-                           if not isinstance(v, np.ndarray)}
-            ent["ivf_arrays"] = ivf_arrays
-            ent["ivf_scalars"] = ivf_scalars
+        if vc.ann is not None:
+            ent["ann_arrays"] = {k: put_blob(_np_bytes(np.asarray(v)))
+                                 for k, v in vc.ann.items()
+                                 if isinstance(v, np.ndarray)}
+            ent["ann_scalars"] = {k: v for k, v in vc.ann.items()
+                                  if not isinstance(v, np.ndarray)}
+        if vc.ann_quant != "int8":
+            ent["ann_quant"] = vc.ann_quant
         man["vectors"][fld] = ent
     meta = {
         "term_dict": [[f, t, tid]
@@ -135,16 +135,20 @@ def deserialize_pack(man: dict, get_blob) -> ShardPack:
         )
     vectors = {}
     for fld, ent in man["vectors"].items():
-        ivf = None
-        if "ivf_arrays" in ent:
-            ivf = dict(ent.get("ivf_scalars") or {})
-            for k, d in ent["ivf_arrays"].items():
-                ivf[k] = _np_load(get_blob(d))
+        ann = None
+        if "ann_arrays" in ent:
+            ann = dict(ent.get("ann_scalars") or {})
+            for k, d in ent["ann_arrays"].items():
+                ann[k] = _np_load(get_blob(d))
+        # manifests from before PR 7 carry "ivf_arrays": the host-side
+        # probe layout the ann/ subsystem replaced — dropped on load
+        # (the mounted index falls back to the exact scan; a refresh
+        # rebuilds the ANN tiles)
         vectors[fld] = VectorColumn(
             values=_np_load(get_blob(ent["values"])),
             has_value=_np_load(get_blob(ent["has_value"])),
             similarity=ent["similarity"], dims=ent["dims"],
-            ivf=ivf,
+            ann=ann, ann_quant=ent.get("ann_quant", "int8"),
         )
     return ShardPack(
         num_docs=man["num_docs"],
@@ -185,5 +189,6 @@ def manifest_digests(man: dict) -> list[str]:
                                  "mv_pair_ords", "ord_terms") if k in ent]
     for ent in man["vectors"].values():
         out += [ent["values"], ent["has_value"]]
-        out += list((ent.get("ivf_arrays") or {}).values())
+        out += list((ent.get("ann_arrays") or {}).values())
+        out += list((ent.get("ivf_arrays") or {}).values())  # pre-PR-7
     return out

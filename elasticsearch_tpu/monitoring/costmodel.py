@@ -23,9 +23,9 @@ Conventions (documented, asserted by tests/test_monitoring.py):
     chip's headline number) regardless of compute dtype, so an f32 path
     can never look better than the bf16 path it competes with.
 
-Every `time_kernel` dispatch name in ops/ and parallel/ MUST have an
-entry in KERNEL_COSTS (tier-1 lint: test_monitoring.py walks the call
-sites). An entry of None marks a wrapper span whose inner kernels carry
+Every `time_kernel` dispatch name in ops/, parallel/, query/ and ann/
+MUST have an entry in KERNEL_COSTS (tier-1 lint: test_monitoring.py
+walks the call sites). An entry of None marks a wrapper span whose inner kernels carry
 the accounting — a deliberate choice, not a missing model.
 """
 
@@ -147,6 +147,39 @@ def knn_tiered_cost(b: int, d: int, n: int, *, kb: int = 128) -> dict:
     }
 
 
+def ann_gather_scan_cost(b: int, p: int, l: int, d: int, *,
+                         tier: str = "int8") -> dict:
+    """The batched ANN gather-scan (ann/kernels): every (query, probed
+    cluster) pair DMAs its [L, D] tile at the tier's storage dtype —
+    int8 codes + 8 B/slot scale+offset, or the split-bf16 hi+lo pair at
+    4D B/slot — plus 12 B/slot of order/live/aux metadata. Unlike the
+    full-corpus scans, tiles ARE re-read per probing query (that is the
+    gather), so bytes scale with b*p*l, not the corpus. FLOPs: the
+    quantized matmul (2*slots*d), the int8 affine correction or the
+    second bf16 pass, and 2 ops/slot of selection."""
+    slots = float(b * p * l)
+    if tier == "int8":
+        tile_bytes = slots * (d * 1 + 8)
+        mm_flops = 2.0 * slots * d + 2.0 * slots  # matmul + affine fma
+    else:  # bf16 hi+lo pair: two passes over 2-byte tiles
+        tile_bytes = slots * (2 * d * 2)
+        mm_flops = 2.0 * 2.0 * slots * d
+    return {
+        "flops": mm_flops + 2.0 * slots,  # + selection scan
+        "bytes": tile_bytes + slots * 12 + b * d * 4,
+    }
+
+
+def ann_rescore_cost(b: int, kb: int, d: int) -> dict:
+    """f32 rescore of ANN survivors: [b, kb, d] row gather + one einsum
+    + the (score, id) result writes — the rescore term of
+    knn_tiered_cost standing alone."""
+    return {
+        "flops": 2.0 * b * kb * d,
+        "bytes": float(b * kb * d * 4 + b * kb * 8),
+    }
+
+
 def knn_scan_cost(b: int, d: int, n: int) -> dict:
     """f32-HIGHEST exact scan (the escalation arm): one f32 matmul over
     the full corpus + the streamed selection."""
@@ -239,6 +272,31 @@ def _knn_scan(fields: dict) -> dict | None:
     return knn_scan_cost(b, d, n)
 
 
+def _ann_centroid_probe(fields: dict) -> dict | None:
+    """[B, D] @ [D, C] f32 routing matmul + per-centroid selection."""
+    b, d, c = fields.get("queries"), fields.get("dims"), fields.get("nlist")
+    if not (b and d and c):
+        return None
+    mm = matmul_cost(b, d, c, passes=1, a_bytes=4, b_bytes=4, out_bytes=0)
+    return _merge(mm, {"flops": 2.0 * b * c, "bytes": float(b * c * 4)})
+
+
+def _ann_gather_scan(fields: dict) -> dict | None:
+    b, d = fields.get("queries"), fields.get("dims")
+    p, l = fields.get("nprobe"), fields.get("tile")
+    if not (b and d and p and l):
+        return None
+    return ann_gather_scan_cost(b, p, l, d,
+                                tier=fields.get("scan_tier", "int8"))
+
+
+def _ann_rescore(fields: dict) -> dict | None:
+    b, d, kb = fields.get("queries"), fields.get("dims"), fields.get("kb")
+    if not (b and d and kb):
+        return None
+    return ann_rescore_cost(b, kb, d)
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -255,6 +313,10 @@ KERNEL_COSTS: dict[str, object] = {
     "sharded.wand_pass2": None,      #   until finalize — wall time only
     "vector.knn_tiered": _knn_tiered,
     "vector.knn_scan": _knn_scan,
+    "ann.centroid_probe": _ann_centroid_probe,
+    "ann.gather_scan": _ann_gather_scan,
+    "ann.rescore": _ann_rescore,
+    "ann.tail_scan": _knn_scan,      # exact f32 scan of the tail tier
 }
 
 

@@ -75,7 +75,8 @@ class TieredKnnScanner:
     2 bf16 passes + a [B, KB, D] rescore."""
 
     def __init__(self, vectors, sq_norms, similarity: str, live=None,
-                 kb: int | None = None, interpret: bool | None = None):
+                 kb: int | None = None, interpret: bool | None = None,
+                 ann: dict | None = None, ann_tier: str = "int8"):
         from .kernels import KB_TIERED, split_bf16
 
         self.similarity = similarity
@@ -89,15 +90,33 @@ class TieredKnnScanner:
         mat_t = self.vectors.T  # [D, N]
         self.mat_hi, self.mat_lo = jax.jit(split_bf16)(mat_t)
         self.mat_t = mat_t  # exact fallback operand
+        # tier selection: an ANN index (ann/index.build_ann output)
+        # promotes the scan to probe + quantized gather-scan + rescore;
+        # exact tiers above stay the fallback (and serve ann=None)
+        self.ann = None
+        if ann is not None:
+            from ..ann import AnnSearcher
 
-    def search(self, qvecs, k: int):
+            self.ann = AnnSearcher(
+                ann, vectors, sq_norms, similarity, live=live,
+                tier=ann_tier, interpret=interpret)
+
+    def search(self, qvecs, k: int, *, nprobe: int | None = None,
+               num_candidates: int | None = None):
         """-> (scores [B, k], ids [B, k], totals [B], first_pass_ok [B])
-        numpy; exact (flagged queries re-run on the f32 scan)."""
+        numpy; exact (flagged queries re-run on the f32 scan). With an
+        ANN tier the candidate SET is approximate (recall governed by
+        nprobe) while returned scores stay exact f32; first_pass_ok is
+        then all-true — no escalation pass runs."""
         import numpy as np
 
         from ..telemetry import time_kernel
         from .kernels import scan_topk, tiered_candidates
 
+        if self.ann is not None:
+            v, i, t = self.ann.search(
+                qvecs, k, nprobe=nprobe, num_candidates=num_candidates)
+            return v, i, t, np.ones(v.shape[0], bool)
         qvecs = jnp.asarray(qvecs, jnp.float32)
         B, D = qvecs.shape
         N = self.vectors.shape[0]
@@ -213,49 +232,7 @@ def kmeans_ivf(vectors, nlist: int, iters: int = 8):
     return np.asarray(centroids), np.asarray(assign, np.int32)
 
 
-def build_ivf(vectors, has_value, nlist: int):
-    """-> dict(centroids, order, part_start, max_part) partition index over
-    the present vectors; None when the corpus is too small to help."""
-    import numpy as np
-
-    present = np.flatnonzero(has_value)
-    if len(present) < 4 * max(nlist, 1) or nlist <= 1:
-        return None
-    centroids, assign = kmeans_ivf(vectors[present], nlist)
-    C = centroids.shape[0]
-    order_local = np.argsort(assign, kind="stable")
-    order = present[order_local].astype(np.int32)  # partition-sorted docids
-    sizes = np.bincount(assign, minlength=C)
-    part_start = np.zeros(C + 1, np.int64)
-    np.cumsum(sizes, out=part_start[1:])
-    return {
-        "centroids": centroids.astype(np.float32),
-        "order": order,
-        "part_start": part_start.astype(np.int32),
-        "max_part": int(sizes.max()),
-    }
-
-
-def ivf_candidates(
-    ivf_centroids,  # [C, D] f32
-    ivf_order,  # [NV] int32 partition-sorted docids (padded with -1)
-    ivf_part_start,  # [C+1] int32
-    qvec,  # [D]
-    nprobe: int,
-    max_part: int,
-):
-    """-> (cand_ids [nprobe*max_part] int32 with -1 padding). Probes the
-    nprobe closest partitions by centroid distance."""
-    C = ivf_centroids.shape[0]
-    logits = ivf_centroids @ qvec - 0.5 * jnp.sum(
-        ivf_centroids * ivf_centroids, axis=1
-    )
-    _, probe = jax.lax.top_k(logits, min(nprobe, C))
-    starts = ivf_part_start[probe]  # [P]
-    ends = ivf_part_start[probe + 1]
-    offs = jnp.arange(max_part, dtype=jnp.int32)[None, :]
-    idx = starts[:, None] + offs  # [P, max_part]
-    valid = idx < ends[:, None]
-    idx = jnp.clip(idx, 0, ivf_order.shape[0] - 1)
-    ids = jnp.where(valid, ivf_order[idx], -1)
-    return ids.reshape(-1)
+# build_ivf / ivf_candidates (the host-side probe layout) were promoted
+# to the device-resident ANN subsystem in PR 7: see ann/index.build_ann
+# (padded cluster tiles + quantized tiers) and ann/kernels (the batched
+# gather-scan the old per-query host gather became).

@@ -112,17 +112,15 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
         if col.mv_pair_docs is not None:
             dev["dv_mv"][f] = (put(col.mv_pair_docs), put(col.mv_pair_ords))
     dev["vec_sq"] = {}
-    dev["vec_ivf"] = {}
+    dev["vec_ann"] = {}
     for f, vc in sp.vectors.items():
         dev["vec"][f] = put(vc.values)
         dev["vec_has"][f] = put(vc.has_value)
         dev["vec_sq"][f] = put((vc.values * vc.values).sum(axis=-1).astype(np.float32))
-        if vc.ivf is not None:
-            dev["vec_ivf"][f] = {
-                "centroids": put(vc.ivf["centroids"]),
-                "order": put(vc.ivf["order"]),
-                "part_start": put(vc.ivf["part_start"]),
-            }
+        if vc.ann is not None:
+            from ..ann import ann_to_device
+
+            dev["vec_ann"][f] = ann_to_device(vc.ann, vc.values, put)
     if getattr(sp, "dense_tf", None) is not None:
         dev["dense_tf"] = put(sp.dense_tf)
     if sp.pos_keys is not None:

@@ -275,32 +275,38 @@ class StackedPack:
                 if fld in p.vectors:
                     vals[i, : p.num_docs] = p.vectors[fld].values
                     has[i, : p.num_docs] = p.vectors[fld].has_value
-            svc = VectorColumn(vals, has, vc0.similarity, vc0.dims)
-            # stacked IVF: present only when EVERY populated shard built one
-            # (uniform nlist ensured by shared mappings)
-            ivfs = [p.vectors[fld].ivf for p in shards if fld in p.vectors]
-            if ivfs and all(v is not None for v in ivfs):
-                C = max(v["centroids"].shape[0] for v in ivfs)
-                max_part = max(v["max_part"] for v in ivfs)
-                nv_max = max(len(v["order"]) for v in ivfs)
-                # pad centroids get a huge norm so their assignment logit
-                # (c.q - ||c||^2/2) can never win a probe
-                cents = np.full((self.S, C, vc0.dims), 1e6, np.float32)
-                order = np.full((self.S, max(nv_max, 1)), -1, np.int32)
-                pstart = np.zeros((self.S, C + 1), np.int32)
+            svc = VectorColumn(vals, has, vc0.similarity, vc0.dims,
+                               ann_quant=vc0.ann_quant)
+            # stacked ANN: present only when EVERY populated shard built
+            # one (uniform nlist ensured by shared mappings). Shards pad
+            # to the widest (C, L); pad centroids get a huge norm so
+            # their probe logit (c.q - ||c||^2/2) can never win, pad
+            # slots stay -1 (dead lanes in the gather-scan).
+            anns = [p.vectors[fld].ann for p in shards if fld in p.vectors]
+            if anns and all(v is not None for v in anns):
+                C = max(v["centroids"].shape[0] for v in anns)
+                L = max(v["tile"] for v in anns)
+                D = vc0.dims
+                cents = np.full((self.S, C, D), 1e6, np.float32)
+                order = np.full((self.S, C, L), -1, np.int32)
+                codes = np.zeros((self.S, C, L, D), np.int8)
+                scale = np.zeros((self.S, C, L), np.float32)
+                offset = np.zeros((self.S, C, L), np.float32)
                 for i, p in enumerate(shards):
-                    v = p.vectors[fld].ivf if fld in p.vectors else None
+                    v = p.vectors[fld].ann if fld in p.vectors else None
                     if v is None:
                         continue
-                    c_i = v["centroids"].shape[0]
+                    c_i, l_i = v["order"].shape
                     cents[i, :c_i] = v["centroids"]
-                    # empty pad partitions keep start==end at the tail
-                    pstart[i, : c_i + 1] = v["part_start"]
-                    pstart[i, c_i + 1:] = v["part_start"][-1]
-                    order[i, : len(v["order"])] = v["order"]
-                svc.ivf = {
-                    "centroids": cents, "order": order,
-                    "part_start": pstart, "max_part": max_part,
+                    order[i, :c_i, :l_i] = v["order"]
+                    codes[i, :c_i, :l_i] = v["codes"]
+                    scale[i, :c_i, :l_i] = v["scale"]
+                    offset[i, :c_i, :l_i] = v["offset"]
+                svc.ann = {
+                    "centroids": cents, "order": order, "codes": codes,
+                    "scale": scale, "offset": offset,
+                    "nlist": C, "tile": L,
+                    "built_n": max(v["built_n"] for v in anns),
                 }
             self.vectors[fld] = svc
 

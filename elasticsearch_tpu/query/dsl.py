@@ -443,6 +443,9 @@ def parse_knn(body, mappings) -> KnnNode:
             fnode = BoolNode(filter=[parse_query(q, mappings) for q in filt])
         else:
             fnode = parse_query(filt, mappings)
+    nprobe = body.get("nprobe")
+    if nprobe is not None and int(nprobe) < 1:
+        raise QueryParsingError("[knn] nprobe must be >= 1")
     return KnnNode(
         fld=body["field"],
         qvec=[float(x) for x in body["query_vector"]],
@@ -451,6 +454,7 @@ def parse_knn(body, mappings) -> KnnNode:
         filter_node=fnode,
         boost=float(body.get("boost", 1.0)),
         similarity_threshold=float(body["similarity"]) if body.get("similarity") is not None else None,
+        nprobe=int(nprobe) if nprobe is not None else None,
     )
 
 

@@ -341,7 +341,17 @@ class KnnNode(QueryNode):
     filter_node: QueryNode | None = None
     boost: float = 1.0
     similarity_threshold: float | None = None
+    # ANN controls: explicit probe count (None -> the dynamic index
+    # setting / coverage heuristic); force_exact is the engine's
+    # too-selective-filter escalation switch (recompiles to the scan)
+    nprobe: int | None = None
+    force_exact: bool = False
     _sim: str = "cosine"
+
+    # filtered/thresholded ANN: retrieve this many times num_candidates
+    # before post-filtering, so a moderately selective filter still
+    # reaches k (the reference's filtered-HNSW over-probing analog)
+    FILTER_OVERSAMPLE = 4
 
     def prepare(self, pack):
         vc = pack.vectors.get(self.fld)
@@ -362,22 +372,34 @@ class KnnNode(QueryNode):
         self._kk = min(self.num_candidates or self.k, max(pack.num_docs, 1))
         if vc is not None:
             self._sim = vc.similarity
-        # IVF ANN path: only for plain knn (filters/thresholds fall back to
-        # the exact scan — the reference's filtered HNSW analog would need
-        # candidate over-probing); nprobe sized so the probed partitions
-        # cover ~num_candidates vectors
-        self._ivf = None
-        ivf = getattr(vc, "ivf", None) if vc is not None else None
-        if (ivf is not None and self.filter_node is None
-                and self.similarity_threshold is None):
-            C = ivf["centroids"].shape[-2]
-            nv = ivf["order"].shape[-1]
-            avg_part = max(1, nv // max(C, 1))
-            nprobe = min(C, max(1, -(-self._kk // avg_part) + 1))
-            self._ivf = (C, int(ivf["max_part"]), int(nprobe))
+        # device-resident ANN path (ann/): centroid probe + quantized
+        # gather-scan + f32 rescore of survivors, all inside the compiled
+        # plan. Filters/thresholds ride it with oversampled candidate
+        # retrieval + post-filter; the engine re-prepares with
+        # force_exact when the filtered result can't reach k.
+        self._ann = None
+        ann = getattr(vc, "ann", None) if vc is not None else None
+        if ann is not None and not self.force_exact:
+            from ..ann.search import default_nprobe
+
+            C = int(ann["nlist"])
+            L = int(ann["tile"])
+            oversample = (self.FILTER_OVERSAMPLE
+                          if (self.filter_node is not None
+                              or self.similarity_threshold is not None)
+                          else 1)
+            nprobe = self.nprobe or default_nprobe(
+                C, L, self._kk * oversample)
+            nprobe = max(1, min(int(nprobe), C))
+            kcand = min(nprobe * L, max(self._kk * oversample, self._kk))
+            self._ann = (nprobe, kcand, vc.ann_quant)
+            from ..telemetry import profile_event
+
+            profile_event("tier", tier=f"ann_{vc.ann_quant}", queries=1,
+                          nprobe=nprobe, kcand=kcand)
         return (qv, np.float32(self.boost), fp), (
             "knn", self.fld, vc is None, self._kk, self._sim,
-            self.similarity_threshold, fk, self._ivf,
+            self.similarity_threshold, fk, self._ann,
         )
 
     def _score_threshold(self) -> float:
@@ -394,7 +416,7 @@ class KnnNode(QueryNode):
         return t
 
     def device_eval(self, dev, params, ctx):
-        from ..ops.vector import ivf_candidates, knn_scores
+        from ..ops.vector import knn_scores
 
         qv, boost, fp = params
         n1 = ctx.num_docs + 1
@@ -402,22 +424,26 @@ class KnnNode(QueryNode):
             return jnp.zeros(n1, jnp.float32), jnp.zeros(n1, bool)
         vecs = dev["vec"][self.fld]
         has = dev["vec_has"][self.fld]
-        if self._ivf is not None and self.fld in dev.get("vec_ivf", {}):
-            # ANN: score only the probed partitions' vectors, scatter the
-            # candidate scores into the dense accumulator
-            ivf = dev["vec_ivf"][self.fld]
-            C, max_part, nprobe = self._ivf
-            cand = ivf_candidates(
-                ivf["centroids"], ivf["order"], ivf["part_start"],
-                qv, nprobe, max_part,
+        if self._ann is not None and self.fld in dev.get("vec_ann", {}):
+            # ANN: quantized gather-scan of the probed cluster tiles
+            # selects candidates; only they are rescored in f32 and
+            # scattered into the dense accumulator
+            from ..ann.kernels import ann_candidates_traced
+
+            nprobe, kcand, tier = self._ann
+            cand, sel_v, _tot = ann_candidates_traced(
+                dev["vec_ann"][self.fld], qv, dev["live"], kcand,
+                nprobe=nprobe, tier=tier, similarity=self._sim,
             )
+            ok_cand = jnp.isfinite(sel_v)
             safe = jnp.where(cand >= 0, cand, 0)
             sub_scores = knn_scores(
                 vecs[safe], dev["vec_sq"][self.fld][safe], qv, self._sim
             )
-            tgt = jnp.where(cand >= 0, cand, ctx.num_docs)
-            scores_n1 = jnp.zeros(n1, jnp.float32).at[tgt].set(sub_scores)
-            in_cand = jnp.zeros(n1, bool).at[tgt].set(cand >= 0)
+            tgt = jnp.where(ok_cand, cand, ctx.num_docs)
+            scores_n1 = jnp.zeros(n1, jnp.float32).at[tgt].set(
+                jnp.where(ok_cand, sub_scores, 0.0))
+            in_cand = jnp.zeros(n1, bool).at[tgt].set(ok_cand)
             scores = scores_n1[: ctx.num_docs]
             ok = in_cand[: ctx.num_docs] & has & dev["live"]
         else:

@@ -569,6 +569,15 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         knn = body.get("knn")
         if not isinstance(knn, dict):
             raise IllegalArgumentError("[knn] object is required")
+        # top-level filter/num_candidates ride along into the knn search
+        # option (the deprecated API kept them OUTSIDE the knn object —
+        # dropping them silently changed results)
+        knn = dict(knn)
+        if body.get("filter") is not None and knn.get("filter") is None:
+            knn["filter"] = body["filter"]
+        if (body.get("num_candidates") is not None
+                and knn.get("num_candidates") is None):
+            knn["num_candidates"] = body["num_candidates"]
         return web.json_response(await _run_search(
             request.match_info["index"],
             {"knn": knn, "size": knn.get("k", 10),

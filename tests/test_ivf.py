@@ -1,37 +1,50 @@
-"""IVF ANN: partition build, probe correctness, recall vs exact scan."""
+"""IVF ANN (ann/): tile build invariants, probe correctness, engine
+recall vs exact scan. The deep recall harness lives in test_ann.py."""
 
 import numpy as np
 
+from elasticsearch_tpu.ann import build_ann
 from elasticsearch_tpu.engine import Engine
-from elasticsearch_tpu.index.mappings import Mappings
-from elasticsearch_tpu.index.pack import PackBuilder
-from elasticsearch_tpu.ops.vector import build_ivf
 
 
-def test_build_ivf_partitions(rng):
+def test_build_ann_partitions(rng):
     vecs = rng.normal(size=(400, 8)).astype(np.float32)
     has = np.ones(400, bool)
     has[::10] = False
-    ivf = build_ivf(vecs, has, nlist=10)
-    assert ivf is not None
-    C = ivf["centroids"].shape[0]
-    assert C == 10
-    # every present vector appears exactly once, partition-sorted
-    assert sorted(ivf["order"].tolist()) == np.flatnonzero(has).tolist()
-    sizes = np.diff(ivf["part_start"])
-    assert sizes.sum() == has.sum() and ivf["max_part"] == sizes.max()
+    ann = build_ann(vecs, has, nlist=10)
+    assert ann is not None
+    C, L = ann["order"].shape
+    assert C == ann["nlist"] == 10
+    assert L == ann["tile"] and L % 128 == 0
+    # every present vector appears exactly once across the cluster tiles
+    slot_ids = ann["order"][ann["order"] >= 0]
+    assert sorted(slot_ids.tolist()) == np.flatnonzero(has).tolist()
+    # pad slots carry dead quantization rows
+    assert (ann["scale"][ann["order"] < 0] == 0).all()
+    # int8 tier round-trips within the per-vector error bound
+    from elasticsearch_tpu.ann.quantize import dequantize_int8
+
+    c0 = np.flatnonzero((ann["order"][0] >= 0))[:4]
+    ids = ann["order"][0, c0]
+    deq = dequantize_int8(ann["codes"][0, c0], ann["scale"][0, c0],
+                          ann["offset"][0, c0])
+    err = np.abs(deq - vecs[ids])
+    assert (err <= ann["scale"][0, c0, None] / 2 + 1e-6).all()
 
 
-def test_small_corpus_skips_ivf(rng):
+def test_small_corpus_skips_ann(rng):
     vecs = rng.normal(size=(10, 4)).astype(np.float32)
-    assert build_ivf(vecs, np.ones(10, bool), nlist=8) is None
+    assert build_ann(vecs, np.ones(10, bool), nlist=8) is None
 
 
-def _knn_engine(rng, n=600, dims=16, shards=1, nlist=12):
+def _knn_engine(rng, n=600, dims=16, shards=1, nlist=12, quant=None):
     e = Engine(None)
+    io = {"type": "ivf", "nlist": nlist}
+    if quant:
+        io["quantization"] = quant
     e.create_index("v", {"properties": {
         "vec": {"type": "dense_vector", "dims": dims, "similarity": "l2_norm",
-                "index_options": {"type": "ivf", "nlist": nlist}},
+                "index_options": io},
         "tag": {"type": "keyword"},
     }}, settings={"number_of_shards": shards})
     idx = e.indices["v"]
@@ -42,22 +55,21 @@ def _knn_engine(rng, n=600, dims=16, shards=1, nlist=12):
     return e, idx, vecs
 
 
-def test_ivf_full_probe_matches_exact(rng):
+def test_ann_full_probe_matches_exact(rng):
     e, idx, vecs = _knn_engine(rng)
     q = [float(x) for x in rng.normal(size=16)]
-    # num_candidates >= N forces nprobe to cover everything -> exact
-    r_ivf = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
-                            "num_candidates": 600})
-    # filter forces the exact path
+    # nprobe = nlist scans every tile -> exact (rescore is f32)
+    r_ann = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
+                            "num_candidates": 600, "nprobe": 12})
     r_exact = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
-                              "num_candidates": 600,
+                              "num_candidates": 600, "nprobe": 12,
                               "filter": {"match_all": {}}})
-    ids_ivf = [h["_id"] for h in r_ivf["hits"]["hits"]]
+    ids_ann = [h["_id"] for h in r_ann["hits"]["hits"]]
     ids_exact = [h["_id"] for h in r_exact["hits"]["hits"]]
-    assert ids_ivf == ids_exact
+    assert ids_ann == ids_exact
 
 
-def test_ivf_recall_reasonable(rng):
+def test_ann_recall_reasonable(rng):
     e, idx, vecs = _knn_engine(rng)
     hits = 0
     trials = 12
@@ -66,23 +78,51 @@ def test_ivf_recall_reasonable(rng):
         approx = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
                                  "num_candidates": 100})
         exact = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
-                                "num_candidates": 600,
-                                "filter": {"match_all": {}}})
+                                "num_candidates": 600, "nprobe": 12})
         a = {h["_id"] for h in approx["hits"]["hits"]}
         b = {h["_id"] for h in exact["hits"]["hits"]}
         hits += len(a & b) / max(len(b), 1)
     recall = hits / trials
-    assert recall >= 0.5, f"IVF recall@10 too low: {recall}"
+    assert recall >= 0.5, f"ANN recall@10 too low: {recall}"
 
 
-def test_ivf_sharded(rng):
+def test_ann_sharded(rng):
     e, idx, vecs = _knn_engine(rng, shards=3)
     q = [float(x) for x in rng.normal(size=16)]
     r = idx.search(knn={"field": "vec", "query_vector": q, "k": 5,
-                        "num_candidates": 600})
+                        "num_candidates": 600, "nprobe": 12})
     assert len(r["hits"]["hits"]) == 5
     r_exact = idx.search(knn={"field": "vec", "query_vector": q, "k": 5,
-                              "num_candidates": 600,
+                              "num_candidates": 600, "nprobe": 12,
                               "filter": {"match_all": {}}})
     assert [h["_id"] for h in r["hits"]["hits"]] == [
         h["_id"] for h in r_exact["hits"]["hits"]]
+
+
+def test_ann_bf16_tier_via_mapping(rng):
+    e, idx, vecs = _knn_engine(rng, quant="bf16")
+    vc = idx.searcher.sp.vectors["vec"]
+    assert vc.ann_quant == "bf16" and vc.ann is not None
+    q = [float(x) for x in rng.normal(size=16)]
+    r = idx.search(knn={"field": "vec", "query_vector": q, "k": 5,
+                        "nprobe": 12, "num_candidates": 600})
+    r2 = idx.search(knn={"field": "vec", "query_vector": q, "k": 5,
+                         "nprobe": 12, "num_candidates": 600,
+                         "filter": {"match_all": {}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == [
+        h["_id"] for h in r2["hits"]["hits"]]
+
+
+def test_ann_dynamic_nprobe_setting(rng):
+    e, idx, vecs = _knn_engine(rng)
+    # oracle at full probe
+    q = [float(x) for x in rng.normal(size=16)]
+    full = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
+                           "nprobe": 12, "num_candidates": 600})
+    # dynamic setting: force full coverage without a body nprobe
+    idx.update_settings({"knn": {"nprobe": 12}})
+    assert idx.settings.get("knn.nprobe") == 12
+    r = idx.search(knn={"field": "vec", "query_vector": q, "k": 10,
+                        "num_candidates": 600})
+    assert [h["_id"] for h in r["hits"]["hits"]] == [
+        h["_id"] for h in full["hits"]["hits"]]

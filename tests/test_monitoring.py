@@ -105,7 +105,7 @@ def _dispatch_site_names():
     root = os.path.join(os.path.dirname(__file__), "..",
                         "elasticsearch_tpu")
     names = {}
-    for sub in ("ops", "parallel", "query"):
+    for sub in ("ops", "parallel", "query", "ann"):
         for path in glob.glob(os.path.join(root, sub, "*.py")):
             src = open(path, encoding="utf-8").read()
             for m in _TIME_KERNEL_RE.finditer(src):
@@ -131,7 +131,8 @@ def test_every_dispatch_site_has_a_cost_model_entry():
     for expected in ("fused.pallas_scan", "batched.disjunction",
                      "sharded.fused_pipeline", "sharded.spmd_topk",
                      "vector.knn_tiered", "vector.knn_scan",
-                     "compiled_plan"):
+                     "compiled_plan", "ann.centroid_probe",
+                     "ann.gather_scan", "ann.rescore", "ann.tail_scan"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 
@@ -147,6 +148,11 @@ def test_cost_fns_resolve_on_representative_fields():
         "vector.knn_tiered": {"queries": 128, "dims": 64,
                               "num_docs": 50_000, "kb": 128},
         "vector.knn_scan": {"queries": 4, "dims": 64, "num_docs": 50_000},
+        "ann.centroid_probe": {"queries": 128, "dims": 64, "nlist": 256},
+        "ann.gather_scan": {"queries": 128, "dims": 64, "nprobe": 8,
+                            "tile": 512, "kb": 64, "scan_tier": "int8"},
+        "ann.rescore": {"queries": 128, "dims": 64, "kb": 64},
+        "ann.tail_scan": {"queries": 128, "dims": 64, "num_docs": 2_000},
     }
     for name, fields in reps.items():
         c = kernel_cost(name, fields)
