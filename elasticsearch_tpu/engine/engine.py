@@ -674,6 +674,13 @@ class EsIndex:
         self._tail_shard_docs = routed
         # avgdl may have drifted: re-norm the base dense tier on device
         base.refresh_dense_tfn()
+        # ... and re-derive the base impact-code blocks under the combined
+        # stats (one elementwise device pass; the tail searcher derived
+        # its own at construction, AFTER the override was installed) — so
+        # postings written since the last full build stay impact-served
+        # through the exact-by-construction tail tier while the base tier
+        # keeps its gather+sum path, and correctness never depends on it
+        base.refresh_impacts()
 
     def _maybe_refresh(self):
         if self._searcher is None:  # safety; construction always refreshes
@@ -1181,7 +1188,11 @@ class EsIndex:
             else node
         k = max(size + from_, 1)
         rb = self._searcher.search(q, size=k, prune_floor=prune_floor)
-        rt = self._tail.search(q, size=k)
+        from ..telemetry import time_kernel
+
+        with time_kernel("sparse.tail_scan", tier="tail", queries=1,
+                         num_docs=self._tail.sp.S * self._tail.sp.n_max):
+            rt = self._tail.search(q, size=k)
         return self._tiered_merge(rb, rt, size, from_, prune_floor,
                                   track_total_hits)
 
@@ -1580,7 +1591,13 @@ class EsIndex:
                     "filter": [{"ids": {"values": [doc_id]}}],
                 }
             }
-            res = self.searcher.search(parse_query(wrapped, self.mappings), size=1)
+            # explain's per-clause breakdown must be exact BM25, never
+            # the quantized impact tier (query/nodes.mark_exact — the
+            # impact escalation contract)
+            from ..query.nodes import mark_exact
+
+            node = mark_exact(parse_query(wrapped, self.mappings))
+            res = self.searcher.search(node, size=1)
             if res.total == 0:
                 return None
             return float(res.scores[0])

@@ -56,6 +56,116 @@ BLOCK = 128  # TPU lane width; one postings block = one vector register row
 BM25_K1 = 1.2
 BM25_B = 0.75
 
+# ---------------------------------------------------------------------------
+# impact-scored sparse tier (BM25S, https://arxiv.org/pdf/2407.03618):
+# per-(term, doc) BM25 contributions precomputed at index time and
+# quantized to compact integer codes, so query time is a pure gather+sum
+# over code blocks — no tf / doc-length / avgdl math in the hot path.
+#
+# Factorization (what lives where):
+#   impact(t, d) = idf(t) · tfn(t, d),  tfn = tf / (tf + K(dl, avgdl))
+#   code(t, d)   = round(tfn / ubf(t) · QMAX) ∈ [1, QMAX] for tf > 0
+#   ubf(t)       = max_tf / (max_tf + k1·(1 − b))   — tfn's upper bound
+#                  over ANY doc length (K ≥ k1·(1 − b)), so codes can
+#                  never clip however avgdl drifts between refreshes
+#   score(t, d)  = boost · idf(t) · ubf(t) / QMAX · code(t, d)
+#
+# idf stays a per-term query-time scalar (ONE host mul in prepare,
+# sourced from ops/scoring.bm25_idf — the single idf implementation), so
+# dfs-stats overrides flow into the impact weights with no rebuild; only
+# avgdl drift requires re-deriving the codes (an elementwise device pass
+# at refresh, parallel/sharded.StackedSearcher.refresh_impacts).
+#
+# Error model (documented, asserted in tests/test_impact.py): per query
+# term the absolute score error is at most boost · idf · ubf / QMAX
+# (codes round to the nearest level, half a level each way; the clamp to
+# code ≥ 1 that preserves exact match/total semantics can round a
+# sub-half-level impact up by at most one full level). Per-doc error is
+# the sum over the query's impact-served terms. uint16 keeps this below
+# f32 tie noise; int8 is the compact/coarse alternative.
+# ---------------------------------------------------------------------------
+
+IMPACT_QMAX = {"uint16": 65535, "int8": 127}
+_IMPACT_NP_DTYPE = {"uint16": np.uint16, "int8": np.int8}
+
+
+def impact_dtype_default() -> str:
+    """Impact-code storage dtype: ES_TPU_IMPACT_DTYPE ∈ {uint16, int8}."""
+    import os
+
+    d = os.environ.get("ES_TPU_IMPACT_DTYPE", "uint16")
+    return d if d in IMPACT_QMAX else "uint16"
+
+
+def impact_term_ubf(term_block_start: np.ndarray, block_max_tf: np.ndarray,
+                    k1: float = BM25_K1, b: float = BM25_B) -> np.ndarray:
+    """[T] per-term tfn upper bound mtf/(mtf + k1·(1−b)) from the pack's
+    block-max metadata — avgdl-independent, so the per-term code scale
+    survives dfs-stats drift without clipping."""
+    T = len(term_block_start) - 1
+    if T <= 0:
+        return np.zeros(0, np.float32)
+    # every term owns >= 1 contiguous block row, so reduceat is exact
+    mtf = np.maximum.reduceat(block_max_tf, term_block_start[:-1])
+    return (mtf / np.maximum(mtf + k1 * (1.0 - b), 1e-9)).astype(np.float32)
+
+
+def impact_row_terms(term_block_start: np.ndarray,
+                     total_blocks: int) -> np.ndarray:
+    """[total_blocks] term id of each postings block row (-1 for the
+    reserved padding row 0 / rows past the directory)."""
+    out = np.full(total_blocks, -1, np.int32)
+    T = len(term_block_start) - 1
+    if T > 0:
+        counts = term_block_start[1:] - term_block_start[:-1]
+        out[term_block_start[0]: term_block_start[T]] = np.repeat(
+            np.arange(T, dtype=np.int32), counts)
+    return out
+
+
+def impact_row_params(
+    row_terms: np.ndarray,          # [nb] int32 (-1 = padding)
+    term_ubf: np.ndarray,           # [T] f32
+    field_of_term: np.ndarray,      # [T] int
+    avgdl_of_field: np.ndarray,     # [F] f64/f32 (effective stats)
+    has_norms_of_field: np.ndarray,  # [F] bool
+    qmax: int,
+    k1: float = BM25_K1,
+    b: float = BM25_B,
+):
+    """-> (k_base [nb], k_slope [nb], scale_inv [nb]) f32 per-row code
+    parameters: K(dl) = k_base + k_slope·dl, code = tfn·scale_inv. The
+    only stats-dependent piece is k_slope (k1·b/avgdl), recomputed from
+    the EFFECTIVE field stats at every (re)derivation."""
+    t = row_terms
+    safe_t = np.maximum(t, 0)
+    fcode = field_of_term[safe_t]
+    hn = has_norms_of_field[fcode] & (t >= 0)
+    k_base = np.where(hn, k1 * (1.0 - b), k1).astype(np.float32)
+    k_slope = np.where(
+        hn, k1 * b / np.maximum(avgdl_of_field[fcode], 1e-9), 0.0
+    ).astype(np.float32)
+    scale_inv = np.where(
+        t >= 0, qmax / np.maximum(term_ubf[safe_t], 1e-9), 0.0
+    ).astype(np.float32)
+    return k_base, k_slope, scale_inv
+
+
+def impact_codes_host(post_tfs: np.ndarray, post_dls: np.ndarray,
+                      k_base: np.ndarray, k_slope: np.ndarray,
+                      scale_inv: np.ndarray, qmax: int,
+                      dtype: str) -> np.ndarray:
+    """Quantized impact codes (numpy twin of the device derivation in
+    parallel/sharded.StackedSearcher.refresh_impacts — the two are
+    asserted equal by tests/test_impact.py). Shapes broadcast: per-row
+    params [..., nb] against blocked lanes [..., nb, BLOCK]."""
+    K = k_base[..., None] + k_slope[..., None] * post_dls
+    tfn = post_tfs / (post_tfs + K)  # tf == 0 padding -> 0
+    q = np.rint(tfn * scale_inv[..., None])
+    q = np.clip(q, 1, qmax)  # tf > 0 must stay a match (code >= 1)
+    q = np.where(post_tfs > 0, q, 0)
+    return q.astype(_IMPACT_NP_DTYPE[dtype])
+
 # Position keys: docid * POS_L + position, in blocked sorted int64 arrays.
 # POS_L is a GLOBAL constant (not per-pack) so one traced phrase program
 # serves every shard of a mesh. 2^17 positions per doc ~ Lucene's practical
@@ -177,6 +287,13 @@ class ShardPack:
     completion: dict[str, list] = dc_field(default_factory=dict)
     # percolator queries, host-side only: field -> list of (docid, query_dict)
     percolator: dict[str, list] = dc_field(default_factory=dict)
+    # impact-scored sparse tier (BM25S): quantized per-posting BM25
+    # contributions aligned with post_docids, per-term tfn bounds, and the
+    # quantization contract. None = tier absent (old manifests degrade to
+    # the raw-postings scoring path).
+    impact_codes: np.ndarray | None = None  # [num_blocks, BLOCK] u16|i8
+    impact_ubf: np.ndarray | None = None  # [T] f32 per-term tfn bound
+    impact_meta: dict | None = None  # {"dtype", "qmax", "k1", "b"}
 
     def dense_row_of(self, fld: str, term: str) -> int | None:
         return self.dense_dict.get((fld, term))
@@ -206,6 +323,19 @@ class ShardPack:
         s = int(self.term_block_start[tid])
         e = int(self.term_block_start[tid + 1])
         return s, e - s, int(self.term_df[tid])
+
+    def impact_wscale(self, fld: str, term: str) -> float | None:
+        """ubf(t)/QMAX — the per-term dequantization scale of the impact
+        tier; the query-time term weight is boost · idf · this. None when
+        the tier is absent or the term unknown (caller falls back to the
+        raw-postings path)."""
+        if (self.impact_codes is None or self.impact_meta is None
+                or self.impact_ubf is None):
+            return None
+        tid = self.term_dict.get((fld, term))
+        if tid is None:
+            return None
+        return float(self.impact_ubf[tid]) / self.impact_meta["qmax"]
 
     def term_pos_blocks(self, fld: str, term: str) -> tuple[int, int, int]:
         """-> (pos_block_row_start, n_blocks, n_positions); zeros if absent."""
@@ -663,6 +793,32 @@ class PackBuilder:
                 prow_base[:-1][pterm] + plocal // BLOCK, plocal % BLOCK
             ] = flat_pos
 
+        # per-field scoring constants, indexed by field code (dense tier +
+        # impact tier share them)
+        avgdl_of_field = np.ones(len(field_names), dtype=np.float64)
+        has_norms_of_field = np.zeros(len(field_names), dtype=bool)
+        for f, code in fld_code.items():
+            st = field_stats.get(f, {"sum_dl": 0.0, "doc_count": 0})
+            avgdl_of_field[code] = (
+                st["sum_dl"] / max(st["doc_count"], 1)
+            ) or 1.0
+            has_norms_of_field[code] = f in norms
+
+        # ---- impact tier (BM25S): quantized per-posting contributions ----
+        impact_codes = impact_ubf = impact_meta = None
+        if T:
+            dtype = impact_dtype_default()
+            qmax = IMPACT_QMAX[dtype]
+            impact_ubf = impact_term_ubf(term_block_start, block_max_tf)
+            row_terms = impact_row_terms(term_block_start, total_blocks)
+            k_base, k_slope, scale_inv = impact_row_params(
+                row_terms, impact_ubf, field_of_term,
+                avgdl_of_field, has_norms_of_field, qmax)
+            impact_codes = impact_codes_host(
+                post_tfs, post_dls, k_base, k_slope, scale_inv, qmax, dtype)
+            impact_meta = {"dtype": dtype, "qmax": qmax,
+                           "k1": BM25_K1, "b": BM25_B}
+
         # ---- dense tier (vectorized over all dense postings) -------------
         dense_ids = np.flatnonzero(df >= dense_min_df) if T else np.array([], np.int64)
         dense_keys = [keys[i] for i in dense_ids]
@@ -676,15 +832,6 @@ class PackBuilder:
             # they never score or match
             v_pad = -len(dense_keys) % 128
             dense_tfn = np.zeros((len(dense_keys) + v_pad, N), dtype=np.float32)
-            # per-field scoring constants, indexed by field code
-            avgdl_of_field = np.ones(len(field_names), dtype=np.float64)
-            has_norms_of_field = np.zeros(len(field_names), dtype=bool)
-            for f, code in fld_code.items():
-                st = field_stats.get(f, {"sum_dl": 0.0, "doc_count": 0})
-                avgdl_of_field[code] = (
-                    st["sum_dl"] / max(st["doc_count"], 1)
-                ) or 1.0
-                has_norms_of_field[code] = f in norms
             dense_rank = np.full(T, -1, dtype=np.int64)
             dense_rank[dense_ids] = np.arange(len(dense_ids))
             dmask = dense_rank[term_of_post] >= 0
@@ -728,4 +875,7 @@ class PackBuilder:
             term_pos_count=term_pos_count,
             completion=completion,
             percolator=percolator,
+            impact_codes=impact_codes,
+            impact_ubf=impact_ubf,
+            impact_meta=impact_meta,
         )

@@ -29,11 +29,16 @@ from .pack import DocValuesColumn, ShardPack, VectorColumn
 
 FORMAT = 2
 
-# top-level ndarray fields serialized as one component blob each
+# top-level ndarray fields serialized as one component blob each.
+# impact_codes/impact_ubf (the BM25S impact tier, PR 8) are OPTIONAL
+# components: manifests written before the tier existed simply lack the
+# keys, and deserialization degrades to impact_codes=None — the mounted
+# pack scores through the raw-postings path until the next refresh
+# rebuilds the tier (the ann_arrays compatibility discipline).
 _ARRAYS = [
     "post_docids", "post_tfs", "post_dls", "term_block_start", "term_df",
     "block_max_tf", "block_min_len", "live", "dense_tfn", "pos_keys",
-    "term_pos_start", "term_pos_count",
+    "term_pos_start", "term_pos_count", "impact_codes", "impact_ubf",
 ]
 
 
@@ -103,6 +108,8 @@ def serialize_pack(pack: ShardPack, put_blob) -> dict:
         "percolator": {f: [list(x) for x in lst]
                        for f, lst in pack.percolator.items()},
     }
+    if pack.impact_meta is not None:
+        meta["impact_meta"] = pack.impact_meta
     man["meta"] = put_blob(_json_bytes(meta))
     return man
 
@@ -176,6 +183,12 @@ def deserialize_pack(man: dict, get_blob) -> ShardPack:
                     for f, lst in meta["completion"].items()},
         percolator={f: [tuple(x) for x in lst]
                     for f, lst in meta["percolator"].items()},
+        # optional impact tier: all three pieces or none (a partial
+        # manifest — hand-edited or truncated — degrades whole)
+        impact_codes=arrays.get("impact_codes"),
+        impact_ubf=arrays.get("impact_ubf"),
+        impact_meta=(meta.get("impact_meta")
+                     if "impact_codes" in arrays else None),
     )
 
 

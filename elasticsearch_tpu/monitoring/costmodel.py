@@ -134,6 +134,38 @@ def sparse_bm25_cost(rows: int, *, block: int = 128,
     }
 
 
+def impact_gather_cost(q_rows: int, *, block: int = 128,
+                       code_bytes: int = 2) -> dict:
+    """Impact-tier gather+dequant (ops/kernels.impact_gather): each lane
+    reads (docid i32 + code u16|i8) = 4 + code_bytes and writes the
+    (docid i32, score f32) candidate pair = 8 bytes; 1 FLOP/lane (the
+    dequant multiply) + 1 op of lane bookkeeping. q_rows = total gathered
+    block rows across the batch (Q·Ts·B). Compare sparse_bm25_cost's
+    12 B + 7 FLOPs/lane — the bytes/query argument of the BM25S tier."""
+    lanes = q_rows * block
+    return {
+        "flops": 2.0 * lanes,
+        "bytes": float(lanes * (4 + code_bytes + 8)),
+    }
+
+
+def impact_sum_cost(q: int, n: int, *, cands: int = 0) -> dict:
+    """The impact arm's candidate tail (fast_topk_from_candidates): the
+    dominating terms are the [q, cands] multi-operand sort (modeled as
+    log2(cands) compare+select passes over the 8-byte (docid, score)
+    lanes) and the dense-tier selection scan over [q, n]."""
+    import math
+
+    parts = [topk_scan_cost(q, n)]
+    if cands:
+        passes = max(1.0, math.log2(max(cands, 2)))
+        parts.append({
+            "flops": 2.0 * q * cands * passes,
+            "bytes": float(q * cands * 8 * 3),  # read+sort+write passes
+        })
+    return _merge(*parts)
+
+
 def knn_tiered_cost(b: int, d: int, n: int, *, kb: int = 128) -> dict:
     """TieredKnnScanner (ops/vector): 2 bf16 matmul passes over the split
     [D, N] corpus (hi + lo halves), then an f32 rescore of the [b, kb]
@@ -258,6 +290,35 @@ def _sharded_spmd(fields: dict) -> dict | None:
                   {"flops": 2.0 * q * n, "bytes": float(q * n * 4)})
 
 
+def _impact_gather(fields: dict) -> dict | None:
+    rows = fields.get("rows")
+    if not rows:
+        return None
+    return impact_gather_cost(int(rows),
+                              code_bytes=int(fields.get("code_bytes", 2)))
+
+
+def _impact_sum(fields: dict) -> dict | None:
+    q, n = fields.get("queries"), fields.get("num_docs")
+    if not (q and n):
+        return None
+    return impact_sum_cost(q, n, cands=int(fields.get("cands", 0)))
+
+
+def _impact_sharded(fields: dict) -> dict | None:
+    """One SPMD program: code-block gather+dequant per shard + the
+    candidate tail; num_docs is the total scanned (S · n_max)."""
+    q, n = fields.get("queries"), fields.get("num_docs")
+    rows = fields.get("rows")
+    if not (q and n and rows):
+        return None
+    return _merge(
+        impact_gather_cost(int(rows),
+                           code_bytes=int(fields.get("code_bytes", 2))),
+        topk_scan_cost(q, n),
+    )
+
+
 def _knn_tiered(fields: dict) -> dict | None:
     b, d, n = fields.get("queries"), fields.get("dims"), fields.get("num_docs")
     if not (b and d and n):
@@ -311,6 +372,11 @@ KERNEL_COSTS: dict[str, object] = {
     "sharded.fused_pipeline": _fused_pallas_scan,
     "sharded.wand_pass1": None,      # pruned postings subset: rows unknown
     "sharded.wand_pass2": None,      #   until finalize — wall time only
+    # impact-scored sparse tier (BM25S, PR 8)
+    "sparse.impact_gather": _impact_gather,
+    "sparse.impact_sum": _impact_sum,
+    "sharded.impact_disjunction": _impact_sharded,
+    "sparse.tail_scan": _sharded_spmd,  # exact scan of the post-build tail
     "vector.knn_tiered": _knn_tiered,
     "vector.knn_scan": _knn_scan,
     "ann.centroid_probe": _ann_centroid_probe,

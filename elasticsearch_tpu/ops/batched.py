@@ -46,6 +46,10 @@ class BatchPlan:
     # sparse view of W, for the tiered path's canonical f32 rescore
     dense_rows: np.ndarray | None = None
     dense_w: np.ndarray | None = None
+    # impact tier (BM25S): per-sparse-term dequant weights
+    # boost·idf·ubf/qmax [Q, Ts]; None when the pack carries no impact
+    # tier (the raw-postings BM25 arms are the only option then)
+    impact_w: np.ndarray | None = None
 
 
 def batch_term_disjunction(
@@ -59,8 +63,14 @@ def batch_term_disjunction(
     k1: float = 1.2,
     b: float = 0.75,
     has_norms: bool = True,
+    impact_w: jax.Array | None = None,
 ):
-    """-> (scores [Q,k], docids [Q,k], totals [Q]). Jit-traceable."""
+    """-> (scores [Q,k], docids [Q,k], totals [Q]). Jit-traceable.
+
+    With `impact_w` ([Q, Ts] dequant weights) the sparse tail scores from
+    the quantized impact tier (dev["impact_codes"]) instead of the raw
+    tf/dl postings — a pure gather+multiply, no BM25 math; everything
+    downstream (candidate machinery, totals, merge order) is identical."""
     Ts, B, k = plan_shapes
     live = dev["live"]
     n = num_docs
@@ -77,13 +87,17 @@ def batch_term_disjunction(
 
     # ---- sparse tail: explicit candidates, no scatter -------------------
     docids = dev["post_docids"][sparse_rows]  # [Q, Ts, B, 128]
-    tfs = dev["post_tfs"][sparse_rows]
-    if has_norms:
-        dls = dev["post_dls"][sparse_rows]
-        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
+    if impact_w is not None:
+        codes = dev["impact_codes"][sparse_rows].astype(jnp.float32)
+        part = impact_w[:, :, None, None] * codes  # pad lanes -> 0
     else:
-        denom = tfs + k1
-    part = sparse_weights[:, :, None, None] * tfs / denom  # pad lanes -> 0
+        tfs = dev["post_tfs"][sparse_rows]
+        if has_norms:
+            dls = dev["post_dls"][sparse_rows]
+            denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
+        else:
+            denom = tfs + k1
+        part = sparse_weights[:, :, None, None] * tfs / denom  # pad -> 0
     Q = docids.shape[0]
     C = Ts * B * BLOCK
     cd = docids.reshape(Q, C)
@@ -179,6 +193,41 @@ def batch_term_disjunction_fast(
     dropped]; dropped == 0 means totals_lb is exact.
     """
     Ts, B, k, M = plan_shapes
+
+    # ---- sparse tail ----------------------------------------------------
+    docids = dev["post_docids"][sparse_rows]  # [Q, Ts, B, 128]
+    tfs = dev["post_tfs"][sparse_rows]
+    if has_norms:
+        dls = dev["post_dls"][sparse_rows]
+        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
+    else:
+        denom = tfs + k1
+    part = sparse_weights[:, :, None, None] * tfs / denom
+    Q = docids.shape[0]
+    C = Ts * B * BLOCK
+    cd = docids.reshape(Q, C)
+    cs = part.reshape(Q, C)
+    return fast_topk_from_candidates(
+        dev, extras, (k, M), W, cd, cs, num_docs=num_docs, bf16=bf16)
+
+
+def fast_topk_from_candidates(
+    dev: dict,
+    extras: dict,
+    plan_shapes: tuple,  # (k, M) — trace-time constants
+    W: jax.Array,
+    cd: jax.Array,  # [Q, C] i32 candidate docids (pad: num_docs)
+    cs: jax.Array,  # [Q, C] f32 per-lane partial scores (pad: 0)
+    num_docs: int,
+    bf16: bool = False,
+):
+    """The dense tier + candidate sort/run-sum/cut/merge machinery of the
+    fast path, taking explicit per-lane candidates: shared by the raw
+    BM25 gather (batch_term_disjunction_fast) and the impact-tier
+    gather+sum pipeline (BatchTermSearcher.run_impact), so both arms
+    carry the identical exactness-proof and totals contracts — 'exact'
+    means exact for whichever score function produced the lanes."""
+    k, M = plan_shapes
     live = dev["live"]
     n = num_docs
 
@@ -213,19 +262,7 @@ def batch_term_disjunction_fast(
     dv, di = jax.lax.top_k(masked_d, k)
     dense_count = (masked_d > 0).sum(axis=1, dtype=jnp.int32)
 
-    # ---- sparse tail ----------------------------------------------------
-    docids = dev["post_docids"][sparse_rows]  # [Q, Ts, B, 128]
-    tfs = dev["post_tfs"][sparse_rows]
-    if has_norms:
-        dls = dev["post_dls"][sparse_rows]
-        denom = tfs + k1 * (1.0 - b + b * dls / avgdl)
-    else:
-        denom = tfs + k1
-    part = sparse_weights[:, :, None, None] * tfs / denom
-    Q = docids.shape[0]
-    C = Ts * B * BLOCK
-    cd = docids.reshape(Q, C)
-    cs = part.reshape(Q, C)
+    Q, C = cd.shape
     # multi-operand sort replaces argsort + 2x take_along_axis (measured
     # 114ms -> 23ms at [512, 16k]: take_along_axis is itself a gather)
     sd, sv = jax.lax.sort((cd, cs), dimension=1, num_keys=1)
@@ -375,6 +412,7 @@ class BatchTermSearcher:
         Q = len(queries)
         doc_count = pack.field_stats.get(fld, {}).get("doc_count") or pack.num_docs
         max_ts, max_b = 1, 1
+        has_impact = True
         parsed = []
         for terms in queries:
             dense, sparse = [], []
@@ -387,7 +425,10 @@ class BatchTermSearcher:
                 if dr is not None:
                     dense.append((dr, w))
                 elif nb > 0:
-                    sparse.append((s0, nb, w))
+                    isc = pack.impact_wscale(fld, term)
+                    if isc is None:
+                        has_impact = False
+                    sparse.append((s0, nb, w, w * (isc or 0.0)))
                     max_b = max(max_b, nb)
             max_ts = max(max_ts, len(sparse))
             parsed.append((dense, sparse))
@@ -397,6 +438,7 @@ class BatchTermSearcher:
         W = np.zeros((Q, V), np.float32)
         rows = np.zeros((Q, max_ts, B), np.int32)
         ws = np.zeros((Q, max_ts), np.float32)
+        iws = np.zeros((Q, max_ts), np.float32)
         td_max = max((len(d) for d, _ in parsed), default=1) or 1
         Td = 1 << (max(td_max, 4) - 1).bit_length()
         dense_rows = np.zeros((Q, Td), np.int32)
@@ -406,12 +448,14 @@ class BatchTermSearcher:
                 W[qi, dr] += w
                 dense_rows[qi, ti] = dr
                 dense_w[qi, ti] = w
-            for ti, (s0, nb, w) in enumerate(sparse):
+            for ti, (s0, nb, w, iw) in enumerate(sparse):
                 rows[qi, ti, :nb] = np.arange(s0, s0 + nb)
                 ws[qi, ti] = w
+                iws[qi, ti] = iw
         dense_only = V > 0 and all(not sparse for _, sparse in parsed)
         return BatchPlan(W, rows, ws, k, dense_only,
-                         dense_rows=dense_rows, dense_w=dense_w)
+                         dense_rows=dense_rows, dense_w=dense_w,
+                         impact_w=iws if has_impact else None)
 
     def _chunk_q(self, Q: int) -> int:
         """Power-of-two chunk width: caps the materialized [Qc, N] f32 score
@@ -628,6 +672,80 @@ class BatchTermSearcher:
             kernel, ("fast", Ts, B, k, M, fld, bf16), plan, 5
         )
 
+    def impact_usable(self) -> bool:
+        """The impact tier serves this searcher's sparse terms: routing
+        enabled (ES_TPU_IMPACT) and the quantized code blocks resident."""
+        from .scoring import impact_enabled
+
+        return impact_enabled() and "impact_codes" in self.searcher.dev
+
+    def run_impact(self, fld: str, plan: BatchPlan, *, M: int | None = None):
+        """Impact-tier throughput arm (BM25S) -> the run_fast output
+        contract (scores, docids, totals_lb, exact, dropped) on device.
+
+        Two stages, both ahead of the shared candidate tail:
+          1. sparse.impact_gather — ops/kernels.impact_gather fetches the
+             query terms' quantized code blocks and dequantizes with one
+             per-term scalar (Pallas scalar-prefetch arm on TPU, XLA row
+             gather elsewhere). No tf, no doc length, no idf: ~6 bytes
+             per posting (4 docid + 1-2 code) instead of 12, zero
+             arithmetic beyond one multiply.
+          2. sparse.impact_sum — fast_topk_from_candidates: the identical
+             sort/run-sum/cut/dense-merge machinery of run_fast, so the
+             exactness proof and totals contract carry over verbatim
+             ('exact' = exact for the impact score function; the
+             quantization error bound is index/pack.py's documented
+             model, asserted by tests/test_impact.py)."""
+        dev = self.searcher.dev
+        if plan.dense_only or plan.impact_w is None or "impact_codes" not in dev:
+            return self.run_fast(fld, plan, M=M)
+        from .kernels import impact_gather
+
+        Ts, B = plan.sparse_rows.shape[1], plan.sparse_rows.shape[2]
+        C = Ts * B * BLOCK
+        M = min(M or self.FAST_M, C)
+        k = plan.k
+        n = self.searcher.pack.num_docs
+        Q = plan.W.shape[0]
+        qc = self._chunk_q(Q)
+        pad = (-Q) % qc
+        rows_flat = plan.sparse_rows.reshape(Q, Ts * B)
+        w_flat = np.repeat(plan.impact_w, B, axis=1)  # [Q, Ts*B]
+        arrs = [plan.W, rows_flat, w_flat]
+        if pad:
+            arrs = [np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+                    for a in arrs]
+        Wa, rows_a, w_a = arrs
+        fn1 = self._cache.get("impact_gather")
+        if fn1 is None:
+            fn1 = self._cache["impact_gather"] = jax.jit(
+                lambda dv, r, w: impact_gather(
+                    dv["impact_codes"], dv["post_docids"], r, w))
+        key2 = ("impact_sum", k, M)
+        fn2 = self._cache.get(key2)
+        if fn2 is None:
+            def tail(dv, extras, W_, cd, cs):
+                return fast_topk_from_candidates(
+                    dv, extras, (k, M), W_, cd, cs, num_docs=n)
+
+            fn2 = self._cache[key2] = jax.jit(tail)
+        extras = self._fast_extras(False)
+        from ..telemetry import time_kernel
+
+        cands = []
+        for i in range(0, Q + pad, qc):
+            cands.append(fn1(dev, jnp.asarray(rows_a[i: i + qc]),
+                             jnp.asarray(w_a[i: i + qc])))
+        code_bytes = int(np.dtype(dev["impact_codes"].dtype).itemsize)
+        with time_kernel("sparse.impact_gather", tier="impact", queries=Q,
+                         rows=Q * Ts * B, code_bytes=code_bytes):
+            jax.block_until_ready(cands)
+        outs = [
+            fn2(dev, extras, jnp.asarray(Wa[i: i + qc]), cd, cs)
+            for (cd, cs), i in zip(cands, range(0, Q + pad, qc))
+        ]
+        return _RawChunks(outs, Q, 5)
+
     def search(self, fld: str, queries: list[list[tuple[str, float]]], k: int = 10):
         out = self.run(fld, self.plan(fld, queries, k))
         if isinstance(out, _RawChunks):
@@ -782,30 +900,44 @@ class BatchTermSearcher:
                                  queries=len(queries), k=k):
                     return fs.msearch(fld, queries, k)
         Q = len(queries)
+        use_impact = fast and self.impact_usable()
         scores = np.full((Q, k), -np.inf, np.float32)
         ids = np.zeros((Q, k), np.int64)
         totals = np.zeros((Q,), np.int64)
         exact = np.ones((Q,), bool)
         pending: list[np.ndarray] = []
         parts = []
+
+        def _run_first(plan):
+            if not fast:
+                return self.run(fld, plan)
+            if use_impact and plan.impact_w is not None and not plan.dense_only:
+                return self.run_impact(fld, plan)
+            return self.run_fast(fld, plan, bf16=bf16)
+
         for idxs, plan in self.plan_bucketed(fld, queries, k):
-            if fast:
-                parts.append((idxs, self.run_fast(fld, plan, bf16=bf16)))
-            else:
-                parts.append((idxs, self.run(fld, plan)))
+            parts.append((idxs, _run_first(plan)))
         # resolve every group with ONE device round-trip, and only after
         # every group was dispatched (no intermediate eager ops: those act
         # as dispatch barriers under remote runtimes). Plain-array groups
         # (the dense-only fused path under fast=False) join the same fetch.
         from ..telemetry import profile_event, time_kernel
 
-        profile_event("tier", tier="fast" if fast else "exact", queries=Q)
+        tier = ("impact" if use_impact else "fast") if fast else "exact"
+        profile_event("tier", tier=tier, queries=Q)
         raws = [p.chunk_outs if isinstance(p, _RawChunks) else p
                 for _, p in parts]
-        with time_kernel("batched.disjunction",
-                         tier="fast" if fast else "exact", queries=Q, k=k,
-                         num_docs=self.searcher.pack.num_docs):
-            host = jax.device_get(raws)
+        if use_impact:
+            # the impact arm's candidate tail: the gather stage already
+            # synced under its own sparse.impact_gather span (run_impact)
+            with time_kernel("sparse.impact_sum", tier="impact", queries=Q,
+                             k=k, num_docs=self.searcher.pack.num_docs):
+                host = jax.device_get(raws)
+        else:
+            with time_kernel("batched.disjunction",
+                             tier=tier, queries=Q, k=k,
+                             num_docs=self.searcher.pack.num_docs):
+                host = jax.device_get(raws)
         parts = [
             (idxs, _RawChunks.stitch(h, p.Q, p.n_out)
              if isinstance(p, _RawChunks) else h)
@@ -845,8 +977,11 @@ class BatchTermSearcher:
                     continue
                 C = plan.sparse_rows.shape[1] * plan.sparse_rows.shape[2] * BLOCK
                 M = min(rerun_m, C)
-                rerun_parts.append(
-                    (idxs, M >= C, self.run_fast(fld, plan, bf16=bf16, M=M)))
+                if use_impact and plan.impact_w is not None:
+                    rerun = self.run_impact(fld, plan, M=M)
+                else:
+                    rerun = self.run_fast(fld, plan, bf16=bf16, M=M)
+                rerun_parts.append((idxs, M >= C, rerun))
             for idxs, out in exact_parts:
                 ev, ei, et = [np.asarray(x) for x in (
                     out.resolve() if isinstance(out, _RawChunks)

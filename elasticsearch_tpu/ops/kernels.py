@@ -470,6 +470,105 @@ def tiered_candidates(
     )
 
 
+# ---------------------------------------------------------------------------
+# impact-tier gather (BM25S): the sparse arm of the batched disjunction
+# as a pure gather+dequant — block rows of quantized impact codes are
+# fetched and scaled by one per-row weight; no tf/dl/avgdl math exists
+# anywhere downstream of the index build. Two arms like ann/kernels.py:
+# a Pallas kernel whose scalar-prefetched row ids drive the code-block
+# DMA through BlockSpec index maps, and an XLA gather with identical
+# semantics for non-TPU backends.
+# ---------------------------------------------------------------------------
+
+_IMPACT_G = 8  # gathered block rows per grid step (DMA granularity)
+
+
+def _impact_gather_kernel(rows_ref, w_ref, *refs, g):
+    """refs = g code blocks + g docid blocks + (out_scores, out_ids)."""
+    os_ref, oi_ref = refs[-2], refs[-1]
+    for i in range(g):
+        c_ref = refs[i]
+        d_ref = refs[g + i]
+        os_ref[0, i, :] = w_ref[0, i] * c_ref[0, :].astype(jnp.float32)
+        oi_ref[0, i, :] = d_ref[0, :]
+
+
+@functools.partial(jax.jit, static_argnames=("g", "interpret"))
+def _impact_gather_pallas(codes, docids, rows, row_w, *, g, interpret):
+    Q, R = rows.shape  # R is a multiple of g (caller pads with row 0)
+    block = codes.shape[1]
+    kernel = functools.partial(_impact_gather_kernel, g=g)
+
+    def _row_spec(arr, gi):
+        return pl.BlockSpec(
+            (1, block), lambda q, j, r, _gi=gi: (r[q, j * g + _gi], _I0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Q, R // g),
+        in_specs=(
+            [pl.BlockSpec((1, g), lambda q, j, r: (q, j))]
+            + [_row_spec(codes, gi) for gi in range(g)]
+            + [_row_spec(docids, gi) for gi in range(g)]
+        ),
+        out_specs=[
+            pl.BlockSpec((1, g, block), lambda q, j, r: (q, j, _I0)),
+            pl.BlockSpec((1, g, block), lambda q, j, r: (q, j, _I0)),
+        ],
+    )
+    out_s, out_i = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Q, R, block), jnp.float32),
+            jax.ShapeDtypeStruct((Q, R, block), jnp.int32),
+        ],
+        interpret=interpret,
+    )(rows, row_w, *([codes] * g), *([docids] * g))
+    return out_i.reshape(Q, R * block), out_s.reshape(Q, R * block)
+
+
+@jax.jit
+def _impact_gather_xla(codes, docids, rows, row_w):
+    """XLA arm: identical semantics (row gathers are the fast gather
+    class on TPU too — see ops/scoring.term_score_blocks)."""
+    Q, R = rows.shape
+    block = codes.shape[1]
+    scores = row_w[:, :, None] * codes[rows].astype(jnp.float32)
+    return (docids[rows].reshape(Q, R * block),
+            scores.reshape(Q, R * block))
+
+
+def impact_gather(
+    codes: jax.Array,   # [num_blocks, BLOCK] u16|i8 impact codes
+    docids: jax.Array,  # [num_blocks, BLOCK] i32 (pad: num_docs)
+    rows: jax.Array,    # [Q, R] i32 flat block rows (0-padded, row 0 dead)
+    row_w: jax.Array,   # [Q, R] f32 dequant weight (boost·idf·ubf/qmax)
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """-> (ids [Q, R·BLOCK] i32, scores [Q, R·BLOCK] f32): the flattened
+    per-lane candidates of a batch of impact-tier disjunctions. Padding
+    rows (row 0, weight 0) emit docid == num_docs at score 0 — dead lanes
+    for every downstream consumer."""
+    Q, R = rows.shape
+    block = codes.shape[1]
+    g = min(_IMPACT_G, max(R, 1))
+    pad = (-R) % g
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        row_w = jnp.pad(row_w, ((0, 0), (0, pad)))
+    pallas_ok = pltpu is not None
+    if interpret is None:
+        if not use_pallas(score_bytes=Q * (R + pad) * block * 8) or not pallas_ok:
+            return _impact_gather_xla(codes, docids, rows, row_w)
+        interpret = jax.default_backend() != "tpu"
+    if not pallas_ok:
+        return _impact_gather_xla(codes, docids, rows, row_w)
+    return _impact_gather_pallas(
+        codes, docids, rows, row_w, g=g, interpret=bool(interpret))
+
+
 def use_pallas(score_bytes: int | None = None) -> bool:
     flag = os.environ.get("ES_TPU_PALLAS", "auto")
     if flag == "0":

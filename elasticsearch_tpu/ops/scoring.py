@@ -37,10 +37,60 @@ DEAD_SLOT_PAD = 1  # dense accumulators are sized N + 1
 
 
 def bm25_idf(doc_count: int, df: int) -> float:
-    """Host-side idf. doc_count = docs with >=1 term in the field."""
+    """Host-side idf — THE single BM25 idf implementation: query planning
+    (query/nodes, ops/batched) and the impact-tier weight derivation all
+    source this function, so dfs-stats overrides flow identically into
+    every scoring path. doc_count = docs with >=1 term in the field."""
     if df <= 0:
         return 0.0
     return math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+
+def impact_enabled() -> bool:
+    """ES_TPU_IMPACT routing for the eager impact-scored sparse tier
+    (BM25S): 'auto' (default) engages on TPU backends only — the CPU
+    tier-1 suite keeps exercising the exact BM25 reference paths —
+    '1'/'force' engages everywhere (tests, bench A/B arms), '0' disables.
+    The tier is selection-complete but quantized (see index/pack.py error
+    model); explain / scripted similarity / non-default k1,b escalate to
+    the exact path regardless of this flag."""
+    import os
+
+    import jax as _jax
+
+    mode = os.environ.get("ES_TPU_IMPACT", "auto")
+    if mode == "0":
+        return False
+    if mode in ("1", "force"):
+        return True
+    return _jax.default_backend() == "tpu"
+
+
+def impact_term_scores(
+    impact_codes: jax.Array,  # [num_blocks, BLOCK] u16|i8 codes
+    post_docids: jax.Array,  # [num_blocks, BLOCK] int32 (pad: num_docs)
+    rows: jax.Array,  # [B] int32 block rows for this term (0-padded)
+    wscale: jax.Array,  # scalar f32: boost * idf * ubf / qmax
+    num_docs: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Impact-tier scoring of one term: a pure gather+sum. No tf, no doc
+    length, no avgdl, no division — the code IS the (quantized) BM25
+    contribution, dequantized by one per-term scalar multiply.
+
+    Returns (scores[N+1] f32, match[N+1] bool) with identical padding /
+    dead-slot semantics to term_score_blocks (codes of padding lanes are
+    0, and tf > 0 postings always carry code >= 1)."""
+    codes = impact_codes[rows]  # [B, 128]
+    docids = post_docids[rows]
+    block_scores = wscale * codes.astype(jnp.float32)
+    flat_ids = docids.reshape(-1)
+    scores = jnp.zeros(num_docs + DEAD_SLOT_PAD, jnp.float32).at[flat_ids].add(
+        block_scores.reshape(-1), mode="drop"
+    )
+    match = jnp.zeros(num_docs + DEAD_SLOT_PAD, bool).at[flat_ids].set(
+        (codes > 0).reshape(-1), mode="drop"
+    )
+    return scores, match
 
 
 def term_score_blocks(

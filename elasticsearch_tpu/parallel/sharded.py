@@ -45,6 +45,26 @@ def wand_gate_min_rows() -> int:
     return int(os.environ.get("ES_TPU_WAND_MIN_ROWS", 100_000))
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("qmax", "dtype"))
+def _impact_codes_device(tfs, dls, k_base, k_slope, scale_inv, *,
+                         qmax, dtype):
+    """Device twin of index/pack.impact_codes_host (asserted equal by
+    tests/test_impact.py): derive the quantized impact code blocks from
+    the resident postings — ONE elementwise pass at refresh, so dfs-stat
+    drift (stats_override under tiered refresh) re-norms the impact tier
+    without a host rebuild or re-transfer (the refresh_dense_tfn
+    discipline applied to the sparse tier)."""
+    K = k_base[..., None] + k_slope[..., None] * dls
+    tfn = tfs / (tfs + K)
+    q = jnp.rint(tfn * scale_inv[..., None])
+    q = jnp.clip(q, 1, qmax)  # tf > 0 stays a match (code >= 1)
+    q = jnp.where(tfs > 0, q, 0)
+    return q.astype(jnp.uint16 if dtype == "uint16" else jnp.int8)
+
+
 def make_mesh(num_shards: int) -> Mesh | None:
     """Mesh over the first num_shards devices; None -> single-device vmap."""
     devices = jax.devices()
@@ -205,6 +225,7 @@ class StackedSearcher:
         self._shard_epochs = [0] * stacked.S
         self._stats_epoch = 0
         self.refresh_dense_tfn()
+        self.refresh_impacts()
 
     # -- shard request cache ----------------------------------------------
 
@@ -276,6 +297,40 @@ class StackedSearcher:
         if not st or st["doc_count"] == 0:
             return 1.0
         return st["sum_dl"] / st["doc_count"]
+
+    def refresh_impacts(self):
+        """(Re)derive the impact tier's quantized code blocks on device
+        from the CURRENT effective field stats (the length-norm K bakes
+        avgdl; idf stays query-time host math, so dfs-df drift needs no
+        rebuild at all). Called at construction and after every
+        stats_override change (engine tiered refresh); until then the
+        stale basis makes impact_serving() False and planning degrades to
+        the exact raw-postings path."""
+        sp = self.sp
+        if sp.impact_meta is None:
+            return
+        meta = sp.impact_meta
+        if (self.ctx.k1, self.ctx.b) != (meta["k1"], meta["b"]):
+            # a custom-similarity context cannot serve quantized defaults
+            self.dev.pop("impact_codes", None)
+            return
+        fields = sp.impact_fields
+        fld_avgdl = np.array(
+            [max(self._avgdl(f), 1e-9) for f in fields] or [1.0], np.float64)
+        fld_hn = np.array([f in sp.norms for f in fields] or [False])
+        rf = sp.impact_row_field  # [S, nb_max]
+        safe = np.maximum(rf, 0)
+        hn = fld_hn[safe] & (rf >= 0)
+        k1, b = meta["k1"], meta["b"]
+        k_base = np.where(hn, k1 * (1.0 - b), k1).astype(np.float32)
+        k_slope = np.where(hn, k1 * b / fld_avgdl[safe], 0.0).astype(
+            np.float32)
+        self.dev["impact_codes"] = _impact_codes_device(
+            self.dev["post_tfs"], self.dev["post_dls"],
+            jnp.asarray(k_base), jnp.asarray(k_slope),
+            jnp.asarray(sp.impact_row_scale_inv),
+            qmax=meta["qmax"], dtype=meta["dtype"])
+        sp._impact_basis = sp.stats_override
 
     def update_live(self):
         """Re-ship the live-docs bitmap after host-side flips (tiered
@@ -604,8 +659,13 @@ class StackedSearcher:
         """Evaluate `query`'s scores at specific (shard, docid) hits — the
         rescore gather (reference behavior: QueryRescorer.java combines
         window scores)."""
+        from ..query.nodes import mark_exact
+
         m = self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
+        # rescore windows combine raw scores arithmetically: exact BM25,
+        # never the quantized impact tier
+        mark_exact(node)
         S = self.sp.S
         views = [self.sp.shard_view(s) for s in range(S)]
         per_shard, keys = [], []
@@ -1040,9 +1100,14 @@ class StackedSearcher:
 
     def _search_uncached(self, query, size, from_, aggs, mappings,
                          prune_floor) -> StackedResult:
+        from ..query.wand import wand_enabled
+
         m = mappings if mappings is not None else self.sp.mappings
         node = query if isinstance(query, QueryNode) else parse_query(query, m)
-        if prune_floor is not None and not aggs:
+        if prune_floor is not None and not aggs and wand_enabled():
+            # experimental (ES_TPU_WAND=1): six measured rounds say the
+            # batched exhaustive/impact kernels dominate the two-pass
+            # pruned plan on this hardware — see query/wand.py
             res = self.search_wand(node, size, from_, floor=prune_floor)
             if res is not None:
                 return res
@@ -1102,12 +1167,17 @@ class StackedSearcher:
                 node = (query if isinstance(query, QueryNode)
                         else parse_query(query, m))
                 if prune_floor is not None and not aggs:
-                    # the WAND gate decision is host-side in the common
-                    # case (profitability rejection); an engaged gate runs
-                    # its own two-round-trip program synchronously — rare
-                    # by measurement (r05: gate engages nowhere)
-                    res = self.search_wand(node, size, from_,
-                                           floor=prune_floor)
+                    from ..query.wand import wand_enabled
+
+                    # experimental flag (ES_TPU_WAND): the two-pass WAND
+                    # plan lost every measured round to the batched
+                    # exhaustive/impact kernels (r05 sweep engaged
+                    # nowhere; r08 verdict vs the impact tier) — off by
+                    # default, the batched wave below is the production
+                    # path for prune_floor requests
+                    res = (self.search_wand(node, size, from_,
+                                            floor=prune_floor)
+                           if wand_enabled() else None)
                     if res is not None:
                         st["results"][i] = res
                         st["cache_slots"][i] = (ck, scope)
@@ -1573,14 +1643,29 @@ def _merge_shard_rows(v, i, t):
     )
 
 
+def _impact_sharded_usable(ss: "StackedSearcher") -> bool:
+    """The sharded impact arm serves: routing on (ES_TPU_IMPACT), the
+    stacked code blocks derived for the CURRENT effective stats, and
+    resident on device."""
+    from ..ops.scoring import impact_enabled
+
+    return (impact_enabled() and ss.sp.impact_serving()
+            and "impact_codes" in ss.dev)
+
+
 def _msearch_sharded_partials(ss: "StackedSearcher", fld: str,
                               queries: list, k: int):
     """Per-shard pre-merge rows (v [S, Q, kk], i [S, Q, kk], t [S, Q])
-    from whichever arm serves this searcher (fused pipeline with per-shard
-    escalation, or the legacy exact kernel)."""
+    from whichever arm serves this searcher: the fused pipeline (with
+    per-shard escalation), the impact-tier gather+sum, or the legacy
+    exact kernel."""
     fs = _fused_sharded_for(ss)
     if fs is not None and fs.usable(k):
         return fs.msearch_partials(fld, queries, k)
+    if _impact_sharded_usable(ss):
+        out = _msearch_impact_partials(ss, fld, queries, k)
+        if out is not None:
+            return out
     return _msearch_exact_partials(ss, fld, queries, k)
 
 
@@ -1641,6 +1726,97 @@ def _msearch_sharded_cached(ss: "StackedSearcher", rc, fld: str,
         I[s, qi, : ri.shape[0]] = ri
         T[s, qi] = rt
     return _merge_shard_rows(V, I, T)
+
+
+def _msearch_impact_partials(ss: "StackedSearcher", fld: str,
+                             queries: list, k: int = 10):
+    """The sharded impact arm (BM25S): the same SPMD shard body as the
+    exact arm, but the sparse tail is a gather+sum over the stacked
+    quantized impact code blocks (batch_term_disjunction's impact_w
+    mode) — no tf/dl gathers, no BM25 arithmetic, ~half the postings
+    bytes per query. Returns None when any shard's plan cannot ride the
+    tier (caller falls back to the exact arm)."""
+    from ..ops.batched import BatchTermSearcher, batch_term_disjunction
+
+    sp = ss.sp
+    S = sp.S
+    adapters = [_PlanShardAdapter(sp, s, ss) for s in range(S)]
+    plans = [BatchTermSearcher(a).plan(fld, queries, k) for a in adapters]
+    if any(p.impact_w is None for p in plans):
+        return None
+    ts_max = max(p.sparse_rows.shape[1] for p in plans)
+    b_max = max(p.sparse_rows.shape[2] for p in plans)
+    for s in range(S):  # pad in place to the common shape (row 0 = padding)
+        sr = plans[s].sparse_rows
+        plans[s].sparse_rows = np.pad(
+            sr, ((0, 0), (0, ts_max - sr.shape[1]), (0, b_max - sr.shape[2]))
+        )
+        for attr in ("sparse_weights", "impact_w"):
+            a = getattr(plans[s], attr)
+            setattr(plans[s], attr,
+                    np.pad(a, ((0, 0), (0, ts_max - a.shape[1]))))
+    Q = len(queries)
+    W = np.stack([p.W for p in plans])  # [S, Q, V]
+    rows = np.stack([p.sparse_rows for p in plans])
+    ws = np.stack([p.sparse_weights for p in plans])
+    iws = np.stack([p.impact_w for p in plans])
+    avgdl = adapters[0].pack.avgdl(fld)
+    has_norms = fld in ss.ctx.has_norms
+    n_max = sp.n_max
+    kk = min(max(k, 1), max(n_max, 1))
+    Ts, B = rows.shape[2], rows.shape[3]
+
+    def shard_body(dev1, W1, rows1, ws1, iws1):
+        dev = {
+            "post_docids": dev1["post_docids"][0],
+            "impact_codes": dev1["impact_codes"][0],
+            "live": dev1["live"][0],
+        }
+        if "dense_tfn" in dev1:
+            dev["dense_tfn"] = dev1["dense_tfn"][0]
+        v, i, t = batch_term_disjunction(
+            dev, (Ts, B, kk), W1[0], rows1[0], ws1[0],
+            avgdl=avgdl, num_docs=n_max, has_norms=has_norms,
+            impact_w=iws1[0],
+        )
+        return v[None], i[None], t[None]
+
+    sub = {key: ss.dev[key] for key in
+           ("post_docids", "impact_codes", "live")}
+    if "dense_tfn" in ss.dev:
+        sub["dense_tfn"] = ss.dev["dense_tfn"]
+    cache_key = ("msearch_impact", fld, Ts, B, kk, Q)
+    fn = ss._cache.get(cache_key)
+    if fn is None:
+        if ss.mesh is not None:
+            def run(dev, W_, rows_, ws_, iws_):
+                specs = jax.tree_util.tree_map(lambda _: P("shards"), dev)
+                return shard_map(
+                    shard_body, mesh=ss.mesh,
+                    in_specs=(specs,) + (P("shards"),) * 4,
+                    out_specs=(P("shards"), P("shards"), P("shards")),
+                )(dev, W_, rows_, ws_, iws_)
+        else:
+            def run(dev, W_, rows_, ws_, iws_):
+                def body(d1, w1, r1, s1, i1):
+                    return shard_body(
+                        jax.tree_util.tree_map(lambda x: x[None], d1),
+                        w1[None], r1[None], s1[None], i1[None],
+                    )
+                v, i, t = jax.vmap(body)(dev, W_, rows_, ws_, iws_)
+                return v[:, 0], i[:, 0], t[:, 0]
+        fn = ss._cache[cache_key] = jax.jit(run)
+    from ..telemetry import profile_event, time_kernel
+
+    code_bytes = int(np.dtype(ss.dev["impact_codes"].dtype).itemsize)
+    profile_event("tier", tier="impact", queries=Q)
+    with time_kernel("sharded.impact_disjunction", tier="impact", shards=S,
+                     queries=Q, k=kk, num_docs=S * n_max,
+                     rows=int(np.prod(rows.shape)),
+                     code_bytes=code_bytes):
+        v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
+                                    jnp.asarray(ws), jnp.asarray(iws)))
+    return v, i, t
 
 
 def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,

@@ -94,6 +94,21 @@ class _ShardView:
         dt = getattr(self.stacked, "dense_tf", None)
         return None if dt is None else dt[self.shard_index]
 
+    def impact_wscale(self, fld, term):
+        """Impact-tier dequant scale (see ShardPack.impact_wscale), gated
+        on the STACKED serving state: the searcher must have derived code
+        blocks for the current effective stats (refresh_impacts). Returns
+        0.0 — not None — for a term this shard simply lacks, so every
+        shard prepares the same param shape (the rows are all-padding and
+        contribute nothing)."""
+        st = self.stacked
+        if not st.impact_serving():
+            return None
+        tid = self.pack.term_dict.get((fld, term))
+        if tid is None or self.pack.impact_ubf is None:
+            return 0.0
+        return float(self.pack.impact_ubf[tid]) / st.impact_meta["qmax"]
+
     def terms_for_field(self, fld):
         # expansion is per-shard (each shard enumerates its own dictionary),
         # matching the reference's per-shard MultiTermQuery rewrite
@@ -101,6 +116,11 @@ class _ShardView:
 
     def term_pos_blocks(self, fld, term):
         return self.pack.term_pos_blocks(fld, term)
+
+
+# sentinel: "no searcher has derived impact codes for this pack yet" —
+# distinct from stats_override's None so a fresh pack never claims to serve
+_IMPACT_UNSET = object()
 
 
 class StackedPack:
@@ -230,6 +250,55 @@ class StackedPack:
             self.post_tfs[i, : p.num_blocks] = p.post_tfs
             self.post_dls[i, : p.num_blocks] = p.post_dls
             self.live[i, : p.num_docs] = p.live
+        # ---- impact tier planning state (BM25S) --------------------------
+        # Per-shard row->term/field maps + the static per-row code scale
+        # (avgdl-INDEPENDENT: ubf bounds tfn over any doc length, see
+        # index/pack.py). The code BLOCKS themselves are derived on device
+        # by StackedSearcher.refresh_impacts from the EFFECTIVE field
+        # stats — global at build, combined under stats_override — so the
+        # tier re-norms with one elementwise pass per refresh, never a
+        # host rebuild. `_impact_basis` records which stats the resident
+        # codes were derived from; serving is gated on it matching.
+        from ..index.pack import (
+            IMPACT_QMAX, impact_dtype_default, impact_row_terms,
+            impact_term_ubf,
+        )
+
+        self.impact_meta = None
+        self._impact_basis = _IMPACT_UNSET
+        if any(len(p.term_df) for p in shards):
+            dtype = impact_dtype_default()
+            qmax = IMPACT_QMAX[dtype]
+            self.impact_fields = sorted(
+                {f for p in shards for (f, _t) in p.term_dict})
+            fcode = {f: i for i, f in enumerate(self.impact_fields)}
+            self.impact_row_scale_inv = np.zeros(
+                (self.S, self.nb_max), np.float32)
+            self.impact_row_field = np.full(
+                (self.S, self.nb_max), -1, np.int32)
+            for i, p in enumerate(shards):
+                T = len(p.term_df)
+                if T == 0:
+                    continue
+                ubf = p.impact_ubf
+                if ubf is None:
+                    ubf = impact_term_ubf(p.term_block_start, p.block_max_tf)
+                    p.impact_ubf = ubf
+                rt = impact_row_terms(p.term_block_start, p.num_blocks)
+                fields_by_tid = np.array(
+                    [fcode[f] for (f, _t), _tid in sorted(
+                        p.term_dict.items(), key=lambda kv: kv[1])],
+                    np.int32)
+                sel = rt >= 0
+                rows = np.flatnonzero(sel)
+                self.impact_row_scale_inv[i, rows] = (
+                    qmax / np.maximum(ubf[rt[sel]], 1e-9))
+                self.impact_row_field[i, rows] = fields_by_tid[rt[sel]]
+            from ..index.pack import BM25_B, BM25_K1
+
+            self.impact_meta = {"dtype": dtype, "qmax": qmax,
+                                "k1": BM25_K1, "b": BM25_B}
+
         # ---- stacked position blocks -------------------------------------
         self.pos_keys = None
         if any(p.pos_keys is not None for p in shards):
@@ -358,6 +427,15 @@ class StackedPack:
             K = k1
         return (tf / np.maximum(tf + K, 1e-9)).astype(np.float32)
 
+    def impact_serving(self) -> bool:
+        """True when the resident impact code blocks were derived from the
+        CURRENT effective stats (StackedSearcher.refresh_impacts ran after
+        the last stats_override change) — the planning gate for the
+        gather+sum scoring path. A stale basis degrades to the exact
+        raw-postings path, never to wrong scores."""
+        return (self.impact_meta is not None
+                and self._impact_basis is self.stats_override)
+
     @property
     def eff_field_stats(self) -> dict:
         if self.stats_override is not None:
@@ -412,6 +490,12 @@ class StackedPack:
                     walk(v)
 
         walk({k: v for k, v in vars(self).items() if k != "mappings"})
+        if self.impact_meta is not None:
+            # the searcher derives the stacked impact-code blocks on
+            # device (refresh_impacts): [S, nb_max, BLOCK] at the code
+            # dtype, on top of the host planning arrays walked above
+            code_bytes = 2 if self.impact_meta["dtype"] == "uint16" else 1
+            total += self.S * self.nb_max * BLOCK * code_bytes
         if self.dense_tf is not None:
             # the searcher materializes the derived dense_tfn alongside the
             # raw tf rows on device — admit both copies

@@ -70,6 +70,11 @@ def pack_to_device(pack: ShardPack, device=None) -> dict:
         dev["dense_tfn"] = put(pack.dense_tfn)
     if pack.pos_keys is not None:
         dev["pos_keys"] = put(pack.pos_keys)
+    if pack.impact_codes is not None:
+        # impact-scored sparse tier (BM25S): quantized per-posting BM25
+        # contributions — the gather+sum scoring path's only operand
+        # besides post_docids
+        dev["impact_codes"] = put(pack.impact_codes)
     return dev
 
 

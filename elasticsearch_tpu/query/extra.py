@@ -85,7 +85,9 @@ class MoreLikeThisNode(QueryNode):
             df = stacked.global_df.get((fld, term), 0)
             if df < self.min_doc_freq:
                 continue
-            idf = math.log(1 + (n_docs - df + 0.5) / (df + 0.5))
+            from ..ops.scoring import bm25_idf  # THE idf implementation
+
+            idf = bm25_idf(n_docs, df)
             scored.append((f * idf, fld, term))
         scored.sort(key=lambda x: (-x[0], x[1], x[2]))
         return [(fld, term) for _, fld, term in scored[: self.max_query_terms]]

@@ -82,11 +82,20 @@ class QueryNode:
 @dataclass
 class TermNode(QueryNode):
     """Exact term match with BM25 scoring (reference behavior:
-    index/query/TermQueryBuilder.java -> Lucene TermQuery)."""
+    index/query/TermQueryBuilder.java -> Lucene TermQuery).
+
+    When the pack carries the impact-scored sparse tier (BM25S,
+    index/pack.py) and nothing demands exact scores, evaluation is a pure
+    gather+sum over quantized impact codes: idf (from the ONE bm25_idf
+    implementation, effective dfs stats included) folds into a host-side
+    scalar and no tf/dl/avgdl math is traced. `exact_scores` (set by
+    mark_exact for explain / scripted similarity) and non-default
+    ctx.k1/b fall back to the raw-postings path at trace time."""
 
     fld: str
     term: str
     boost: float = 1.0
+    exact_scores: bool = False
     _dense: bool = False
 
     def prepare(self, pack):
@@ -104,6 +113,15 @@ class TermNode(QueryNode):
         if self._dense:
             return (np.int32(dr), weight, avgdl), ("term_dense", self.fld)
         rows = _pad_rows(start, count)
+        if not self.exact_scores:
+            from ..ops.scoring import impact_enabled
+
+            isc = (pack.impact_wscale(self.fld, self.term)
+                   if impact_enabled() else None)
+            if isc is not None:
+                # wscale = boost·idf·ubf/qmax — score = wscale · code
+                return (rows, weight, avgdl, np.float32(weight * isc)), (
+                    "term_imp", self.fld, len(rows))
         return (rows, weight, avgdl), ("term", self.fld, len(rows))
 
     def device_eval(self, dev, params, ctx):
@@ -123,6 +141,19 @@ class TermNode(QueryNode):
                 ctx.k1, ctx.b,
                 has_norms=self.fld in ctx.has_norms,
             )
+        if len(params) == 4:
+            rows, weight, avgdl, wscale = params
+            from ..index.pack import BM25_B, BM25_K1
+            from ..ops.scoring import impact_term_scores
+
+            if ("impact_codes" in dev
+                    and (ctx.k1, ctx.b) == (BM25_K1, BM25_B)):
+                return impact_term_scores(
+                    dev["impact_codes"], dev["post_docids"], rows, wscale,
+                    ctx.num_docs)
+            # escalation: custom k1/b (scripted similarity contexts) or a
+            # searcher without resident codes — raw-postings BM25
+            params = (rows, weight, avgdl)
         rows, weight, avgdl = params
         return term_score_blocks(
             dev["post_docids"],
@@ -462,6 +493,32 @@ class KnnNode(QueryNode):
             jnp.where(match_n, boost * scores, 0.0)
         )
         return score, match
+
+
+def mark_exact(node) -> "QueryNode":
+    """Force exact BM25 scoring on every term in a plan tree — the
+    impact-tier escalation switch for features a quantized score cannot
+    serve: explain's per-clause breakdown, scripted similarity
+    (script_score/function_score read the child's _score), rescore
+    windows. Returns the node for chaining."""
+    if isinstance(node, TermNode):
+        node.exact_scores = True
+    elif isinstance(node, BoolNode):
+        for grp in (node.must, node.filter, node.should, node.must_not):
+            for c in grp:
+                mark_exact(c)
+    elif isinstance(node, DisMaxNode):
+        for c in node.children:
+            mark_exact(c)
+    elif isinstance(node, ConstantScoreNode):
+        if node.child is not None:
+            mark_exact(node.child)
+    else:
+        for attr in ("inner", "child", "filter_node"):
+            c = getattr(node, attr, None)
+            if isinstance(c, QueryNode):
+                mark_exact(c)
+    return node
 
 
 MAX_CLAUSE_COUNT = 4096  # reference behavior: indices.query.bool.max_clause_count

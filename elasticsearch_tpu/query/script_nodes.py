@@ -304,7 +304,11 @@ def parse_script_score(body: dict, mappings, parse_query):
 
     if "query" not in body:
         raise QueryParsingError("[script_score] requires a [query]")
-    inner = parse_query(body["query"], mappings)
+    from .nodes import mark_exact
+
+    # scripted similarity reads the child's _score: escalate the child
+    # off the quantized impact tier (index/pack.py escalation contract)
+    inner = mark_exact(parse_query(body["query"], mappings))
     script = compile_script(body.get("script") or {})
     return ScriptScoreNode(
         inner, script,
@@ -394,6 +398,12 @@ def parse_function_score(body: dict, mappings, parse_query):
         from .nodes import MatchAllNode
 
         inner = MatchAllNode()
+    else:
+        from .nodes import mark_exact
+
+        # boost_mode multiply/avg etc. transform the child's _score —
+        # keep it exact BM25, off the quantized impact tier
+        mark_exact(inner)
     specs = body.get("functions")
     if specs is None:
         # single-function shorthand at top level

@@ -1,4 +1,18 @@
-"""Block-max WAND planning for pure term disjunctions.
+"""Block-max WAND planning for pure term disjunctions — EXPERIMENTAL.
+
+Demoted from the production searchers in PR 8 (ES_TPU_WAND=1 re-enables):
+six rounds of measurement never found a regime where the two-pass pruned
+plan beats batched exhaustive execution on this hardware by the >1.5x the
+ROADMAP demanded — r05's crossover sweep engaged nowhere at 1M docs, and
+the r08 rerun against the eager impact tier (BM25S gather+sum, whose
+bytes/query is a strict subset of WAND pass-2's) only widened the gap:
+pruning saves a fraction of a bandwidth-bound scan that batched kernels
+already amortize, while paying an extra device round trip plus host-side
+posting compaction per query. The planner below stays import-clean and
+flag-gated (search_wand* / search_pruned_batch in parallel/sharded.py,
+exercised by tests/test_wand.py) so the verdict remains re-measurable on
+future hardware; `hits.total` relation semantics are unchanged when it
+engages.
 
 TPU-shaped analog of Lucene's block-max WAND early termination (reference
 behavior: Lucene WANDScorer + hit-count thresholds wired through
@@ -30,9 +44,20 @@ would be unsound when shards skew).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .nodes import BoolNode, TermNode, _bucket
+
+
+def wand_enabled() -> bool:
+    """ES_TPU_WAND (default off): the experimental flag gating block-max
+    WAND in the production searchers. The direct entry points
+    (StackedSearcher.search_wand / search_pruned_batch) ignore the flag —
+    they ARE the experimental path — only the `prune_floor` routing in
+    `search` / the serving waves consults it."""
+    return os.environ.get("ES_TPU_WAND", "0") not in ("0", "")
 
 
 def should_terms(node) -> list[TermNode] | None:

@@ -105,7 +105,7 @@ def _dispatch_site_names():
     root = os.path.join(os.path.dirname(__file__), "..",
                         "elasticsearch_tpu")
     names = {}
-    for sub in ("ops", "parallel", "query", "ann"):
+    for sub in ("ops", "parallel", "query", "ann", "engine"):
         for path in glob.glob(os.path.join(root, sub, "*.py")):
             src = open(path, encoding="utf-8").read()
             for m in _TIME_KERNEL_RE.finditer(src):
@@ -132,7 +132,9 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      "sharded.fused_pipeline", "sharded.spmd_topk",
                      "vector.knn_tiered", "vector.knn_scan",
                      "compiled_plan", "ann.centroid_probe",
-                     "ann.gather_scan", "ann.rescore", "ann.tail_scan"):
+                     "ann.gather_scan", "ann.rescore", "ann.tail_scan",
+                     "sparse.impact_gather", "sparse.impact_sum",
+                     "sharded.impact_disjunction", "sparse.tail_scan"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 
@@ -153,6 +155,14 @@ def test_cost_fns_resolve_on_representative_fields():
                             "tile": 512, "kb": 64, "scan_tier": "int8"},
         "ann.rescore": {"queries": 128, "dims": 64, "kb": 64},
         "ann.tail_scan": {"queries": 128, "dims": 64, "num_docs": 2_000},
+        "sparse.impact_gather": {"queries": 64, "rows": 64 * 4 * 8,
+                                 "code_bytes": 2},
+        "sparse.impact_sum": {"queries": 64, "num_docs": 20_000,
+                              "cands": 4096},
+        "sharded.impact_disjunction": {"queries": 64, "rows": 3 * 64 * 32,
+                                       "num_docs": 3 * 20_000,
+                                       "code_bytes": 2},
+        "sparse.tail_scan": {"queries": 1, "num_docs": 2_000},
     }
     for name, fields in reps.items():
         c = kernel_cost(name, fields)

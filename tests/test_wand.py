@@ -155,9 +155,36 @@ def test_wand_skips_non_disjunctions():
         assert s.search_wand(parse_query(q, MAPPING), 10, 0) is None
 
 
-def test_match_query_engages_wand_through_engine():
+def test_wand_demoted_from_production_routing(monkeypatch):
+    """PR 8 verdict: with ES_TPU_WAND unset (default), prune_floor
+    requests run the batched exhaustive wave — search() never routes to
+    the two-pass plan even when the floor allows pruning."""
+    called = []
+    s = _searcher(_wand_corpus(n_docs=1500, seed=4), dense_min_df=BIG)
+    s.wand_min_rows = 1
+    orig = s.search_wand
+
+    def spy(*a, **kw):
+        called.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(s, "search_wand", spy)
+    monkeypatch.delenv("ES_TPU_WAND", raising=False)
+    r_off = s.search(parse_query(Q4, MAPPING), size=10, prune_floor=0)
+    assert not called and r_off.total_relation == "eq"
+    # the experimental flag restores the old routing (fresh cache scope:
+    # the request cache keys do not include routing flags)
+    monkeypatch.setenv("ES_TPU_WAND", "1")
+    s.bump_epoch()
+    r_on = s.search(parse_query(Q4, MAPPING), size=10, prune_floor=0)
+    assert called and r_on.total_relation == "gte"
+    np.testing.assert_array_equal(r_on.doc_ids, r_off.doc_ids)
+
+
+def test_match_query_engages_wand_through_engine(monkeypatch):
     from elasticsearch_tpu.engine import Engine
 
+    monkeypatch.setenv("ES_TPU_WAND", "1")  # experimental flag (PR 8)
     e = Engine(None)
     e.create_index("w", {"properties": {"body": {"type": "text"}}})
     idx = e.indices["w"]
