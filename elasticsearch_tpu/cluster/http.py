@@ -285,23 +285,33 @@ class EngineReplica:
         # no callback stays bound to a closed event loop
         self.server.node.service.replace_async_handler(
             "engine:dump", self._on_dump_request)
+        # per-node health collection for the gateway's /_health_report
+        # fan-out (the /_trace pattern: the gateway is the collector)
+        self.server.node.service.replace_async_handler(
+            "engine:health", self._on_health_request)
         self.server.node.coordinator.add_applied_listener(self._on_state)
         self._on_state(self.server.node.state)  # catch up on join/restart
 
     def attach_monitoring(self, gateway_port: int) -> None:
-        """Point this replica engine's MonitoringService at the node's
-        gateway: exported documents POST back through the gateway as a
+        """Point this replica engine's MonitoringService AND WatcherService
+        at the node's gateway: exported documents (monitoring points,
+        watch history, alert docs) POST back through the gateway as a
         normal _bulk, so they ride the replicated op log and EVERY
         replica holds EVERY node's history (the reference's exporters
         write the shared .monitoring-es-* indices the same way). Pruning
         likewise deletes through the gateway. Direct local writes would
         fork the replicas — the one thing a deterministic replica must
-        never do."""
+        never do. Scheduled watches additionally fire on ONE node only
+        (the elected master, via should_run): the watch content is
+        replicated to every node, so any node can take over after a
+        failover, but two nodes firing the same watch would double every
+        alert."""
         import json as _json
         import urllib.error
         import urllib.request
 
         from ..monitoring.collectors import monitoring_index_body
+        from ..xpack.watcher import watcher_index_body
 
         def _req(method, path, body: bytes | None, ctype: str):
             req = urllib.request.Request(
@@ -336,13 +346,37 @@ class EngineReplica:
         mon.exporter = exporter
         mon.pruner = pruner
 
+        def watcher_exporter(index_name: str, docs: list[dict]) -> None:
+            _req("PUT", f"/{index_name}",
+                 _json.dumps(watcher_index_body()).encode(),
+                 "application/json")
+            lines = []
+            for doc in docs:
+                doc = dict(doc)
+                did = doc.pop("_id", None)
+                # alert docs carry their watch id so transitions UPSERT
+                # one doc per watch; history docs use unique ids
+                lines.append(_json.dumps(
+                    {"index": {"_id": did}} if did else {"create": {}}))
+                lines.append(_json.dumps(doc))
+            _req("POST", f"/{index_name}/_bulk?refresh=true",
+                 ("\n".join(lines) + "\n").encode(), "application/x-ndjson")
+
+        node = self.server.node
+        wat = self.engine.watcher
+        wat.exporter = watcher_exporter
+        wat.should_run = lambda: node.coordinator.leader == node.node_id
+
     async def close(self):
         if self.engine._monitoring is not None:
             self.engine._monitoring.stop()
+        self.engine.persistent.stop_ticker()  # scheduled-watch thread
         # deregister only if the binding is still OURS: a newer replica
         # may have replaced it and must keep serving dumps
         self.server.node.service.unregister_handler(
             "engine:dump", self._on_dump_request)
+        self.server.node.service.unregister_handler(
+            "engine:health", self._on_health_request)
         self.server.node.coordinator.remove_applied_listener(self._on_state)
         if self._task is not None:
             self._task.cancel()
@@ -436,6 +470,29 @@ class EngineReplica:
         replica's event loop — it must interleave with the apply loop at
         op boundaries, never mid-op."""
         fut = asyncio.run_coroutine_threadsafe(self._make_dump(), self.loop)
+
+        def done(f):
+            try:
+                payload = f.result()
+            except Exception as e:  # noqa: BLE001
+                payload = {"error": str(e)}
+            self.server.network.submit(
+                lambda: channel.send_response(payload))
+
+        fut.add_done_callback(done)
+
+    def _on_health_request(self, req, from_node, channel):
+        """Transport handler (dispatch thread): serve this node's
+        indicator-based health report from its replica engine, scheduled
+        onto the replica's event loop like the dump handler."""
+        import json as _json
+
+        async def get():
+            _st, body, _ct = await self._call(
+                "GET", "/_health_report", b"", "")
+            return _json.loads(body)
+
+        fut = asyncio.run_coroutine_threadsafe(get(), self.loop)
 
         def done(f):
             try:
@@ -986,12 +1043,66 @@ def make_cluster_app(server: NodeServer,
         return web.Response(text=metrics.prometheus_text(),
                             content_type="text/plain", charset="utf-8")
 
+    async def health_report_fanout(request):
+        """Cluster-wide health (the /_trace pattern): local indicators
+        from this node's replica engine, every peer's over the
+        `engine:health` transport action, merged worst-status-wins — one
+        call answers "is the CLUSTER healthy and which node says why"."""
+        from ..xpack.health import worst_status
+
+        per_node: dict[str, dict] = {}
+        failures = []
+        try:
+            _st, body, _ct = await replica._call(
+                "GET", "/_health_report", b"", "")
+            per_node[node.node_id] = json.loads(body)
+        except Exception as e:  # noqa: BLE001 - replica warming up
+            failures.append({"node": node.node_id, "reason": str(e)})
+        for peer in sorted(node.state.nodes):
+            if peer == node.node_id:
+                continue
+            try:
+                resp = await _transport_request(
+                    server, peer, "engine:health", {}, timeout=15.0)
+                if "error" in resp and "indicators" not in resp:
+                    raise RuntimeError(resp["error"])
+                per_node[peer] = resp
+            except Exception as e:  # noqa: BLE001 - partial health beats 500s
+                failures.append({"node": peer, "reason": str(e)})
+        indicators: dict[str, dict] = {}
+        for n in sorted(per_node):
+            for name, ind in (per_node[n].get("indicators") or {}).items():
+                cur = indicators.get(name)
+                node_statuses = (cur or {}).get("nodes", {})
+                if cur is None or worst_status(
+                        [ind.get("status", "unknown"),
+                         cur["status"]]) != cur["status"]:
+                    # the worst node's indicator body wins (its symptom /
+                    # impacts / diagnosis explain the degradation)
+                    indicators[name] = {**ind, "node": n}
+                indicators[name]["nodes"] = {
+                    **node_statuses, n: ind.get("status", "unknown")}
+        status = worst_status(
+            rep.get("status", "unknown") for rep in per_node.values())
+        out = {
+            "status": status if per_node else "unknown",
+            "cluster_name": "elasticsearch-tpu",
+            "nodes": sorted(per_node),
+            "indicators": indicators,
+        }
+        if failures:
+            out["failures"] = failures
+        return web.json_response(out)
+
     app.router.add_get("/", root)
     app.router.add_get("/_cluster/health", health)
     app.router.add_get("/_cluster/state", cluster_state)
     app.router.add_get("/_cat/nodes", cat_nodes)
     app.router.add_get("/_trace/{trace_id}", get_trace)
     if replica is not None:
+        # cluster-wide health fan-out rides the gateway (single-node
+        # health stays a replica read via the catch-all on data surfaces)
+        app.router.add_get("/_health_report", health_report_fanout)
         # full-surface mode: every other route — the complete engine REST
         # surface — is served by the node's replicated engine (reads
         # local, mutations master-ordered through the engine-op log)

@@ -223,6 +223,32 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True, validator=_validate_duration),
         Setting("xpack.monitoring.history.duration", "7d", str,
                 dynamic=True, validator=_validate_duration),
+        # scheduled alerting (xpack/watcher.py): watches fire on their
+        # own triggers via the persistent-task ticker; tick.interval is
+        # the scheduler granularity (the reference's TickerSchedule
+        # TICKER_INTERVAL_SETTING), not a watch's own schedule
+        Setting("xpack.watcher.enabled", True, Setting.bool_, dynamic=True),
+        Setting("xpack.watcher.tick.interval", "1s", str, dynamic=True,
+                validator=_validate_duration),
+        # SLO engine (monitoring/slo.py): declarative objectives over the
+        # node's own measured signals, evaluated on the monitoring
+        # collector interval; 0 / "" disables an objective family.
+        # kernel.floors / custom are JSON documents so operators can
+        # register objectives without a code change (see slo.py docstring)
+        Setting("slo.enabled", True, Setting.bool_, dynamic=True),
+        Setting("slo.search.p99_ms", 60000.0, Setting.float_, dynamic=True),
+        Setting("slo.shard.p99_ms", 0.0, Setting.float_, dynamic=True),
+        Setting("slo.kernel.floors", "", str, dynamic=True),
+        Setting("slo.kernel.min_calls", 3, Setting.positive_int,
+                dynamic=True),
+        Setting("slo.serving.queue_fraction", 0.95, Setting.float_,
+                dynamic=True),
+        Setting("slo.serving.shed_rate", 0.2, Setting.float_, dynamic=True),
+        Setting("slo.breaker.trip_budget", 1000.0, Setting.float_,
+                dynamic=True),
+        Setting("slo.hbm.headroom_fraction", 0.98, Setting.float_,
+                dynamic=True),
+        Setting("slo.custom", "", str, dynamic=True),
         # continuous-batching serving front end (serving/): admission,
         # coalescing into device waves, deadline/fairness scheduling,
         # backpressure. queue.max_depth is the analog of the reference's

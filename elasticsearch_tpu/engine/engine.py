@@ -1667,6 +1667,8 @@ class Engine:
         self._ml = None
         self._monitoring = None
         self._serving = None
+        self._watcher = None
+        self._slo = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1761,6 +1763,26 @@ class Engine:
                 key, lambda v, a=attr: getattr(self.serving, a)(v))
         if self.settings.get("serving.enabled"):
             self.serving.set_enabled(True)
+        # scheduled watcher (xpack/watcher.py): a persisted watcher-driver
+        # task resumes its ticker at boot, so watches keep firing after a
+        # node restart without any request touching the watcher surface
+        self.settings.add_consumer(
+            "xpack.watcher.enabled", self._watcher_enabled_changed)
+        if self.settings.get("xpack.watcher.enabled") and any(
+                t.get("name") == "watcher" and not t.get("stopped")
+                for t in getattr(self.meta, "persistent_tasks", {}).values()):
+            from ..xpack.watcher import ensure_executor
+
+            ensure_executor(self)
+
+    def _watcher_enabled_changed(self, value) -> None:
+        if not value:
+            self.persistent.stop_ticker()
+        elif any(t.get("name") == "watcher" and not t.get("stopped")
+                 for t in getattr(self.meta, "persistent_tasks", {}).values()):
+            from ..xpack.watcher import ensure_executor
+
+            ensure_executor(self)
 
     @property
     def security(self):
@@ -1804,6 +1826,32 @@ class Engine:
         if self._serving is None:
             self._serving = ServingService(self)
         return self._serving
+
+    @property
+    def watcher(self):
+        """Scheduled alerting (xpack/watcher.py): lazy — watches live in
+        cluster metadata; building the service registers the persistent-
+        task executor and the post-tick export flush."""
+        from ..xpack.watcher import WatcherExecutor, WatcherService
+
+        if self._watcher is None:
+            self._watcher = WatcherService(self)
+            if "watcher" not in self.persistent.executors:
+                self.persistent.register_executor("watcher", WatcherExecutor())
+            self.persistent.post_tick_hooks.append(
+                self._watcher.flush_exports)
+        return self._watcher
+
+    @property
+    def slo(self):
+        """SLO engine (monitoring/slo.py): lazy — objectives come from
+        dynamic settings, evaluation reads the live registry/device
+        state."""
+        from ..monitoring.slo import SloEngine
+
+        if self._slo is None:
+            self._slo = SloEngine(self)
+        return self._slo
 
     def serving_if_enabled(self):
         """The serving service iff coalescing is enabled — without
@@ -1919,6 +1967,79 @@ class Engine:
                 continue
             out.append((idx, f))
         return out
+
+    def index_health(self, name: str) -> str:
+        """Per-index health derived from searcher/replica state (PR 9 —
+        the `/_cluster/health`, `_cat/*` rows and the health report's
+        shards_availability indicator all read THIS, so they can never
+        disagree): red when the index has no live searcher (it cannot
+        serve), yellow when replica copies are configured but this
+        single-process engine has no second node to assign them to
+        (reference ClusterHealthStatus semantics), green otherwise."""
+        idx = self.indices.get(name)
+        if idx is None:
+            return "red"
+        if idx._searcher is None and idx._tail is None:
+            return "red"
+        try:
+            replicas = int(idx.settings.get("number_of_replicas") or 0)
+        except (TypeError, ValueError):
+            replicas = 0
+        return "yellow" if replicas > 0 else "green"
+
+    def cluster_health(self, expression: str | None = None) -> dict:
+        """ES-shaped cluster health over this engine's indices (the
+        reference's TransportClusterHealthAction counts). Per-index
+        sections ride the `indices` key; REST decides whether to expose
+        them (`level=indices`)."""
+        names = sorted(self.indices)
+        if expression:
+            try:
+                names = sorted(idx.name for idx, _f in
+                               self.resolve_search(expression))
+            except Exception:  # noqa: BLE001 - unknown index: empty scope
+                names = []
+        per_index = {}
+        active = unassigned_replicas = red_shards = 0
+        for n in names:
+            idx = self.indices[n]
+            h = self.index_health(n)
+            try:
+                replicas = int(idx.settings.get("number_of_replicas") or 0)
+            except (TypeError, ValueError):
+                replicas = 0
+            if h == "red":
+                red_shards += idx.num_shards
+            else:
+                active += idx.num_shards
+            unassigned_replicas += replicas * idx.num_shards
+            per_index[n] = {
+                "status": h,
+                "number_of_shards": idx.num_shards,
+                "number_of_replicas": replicas,
+                "active_shards": 0 if h == "red" else idx.num_shards,
+                "unassigned_shards": (replicas * idx.num_shards
+                                      + (idx.num_shards if h == "red" else 0)),
+            }
+        from ..xpack.health import worst_status
+
+        status = worst_status(v["status"] for v in per_index.values())
+        total = active + red_shards + unassigned_replicas
+        return {
+            "cluster_name": "elasticsearch-tpu",
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": active,
+            "active_shards": active,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": unassigned_replicas + red_shards,
+            "active_shards_percent_as_number": (
+                100.0 if total == 0 else round(100.0 * active / total, 1)),
+            "indices": per_index,
+        }
 
     def get_or_autocreate(self, name: str) -> EsIndex:
         """Auto-create on first write, like the reference's
@@ -2772,6 +2893,9 @@ class Engine:
         return {"errors": errors, "items": items}
 
     def close(self):
+        self.persistent.stop_ticker()  # join the watch-scheduler thread
+        if self._watcher is not None:
+            self._watcher.flush_exports()  # queued alert/history docs
         if self._serving is not None:
             self._serving.stop()  # drain + join the scheduler threads
         if self._monitoring is not None:

@@ -100,6 +100,37 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
     sv_st = sv.stats() if sv is not None else {}
     sv_wave = sv_st.get("wave", {})
     occ_h = snap["histograms"].get("es.serving.wave_occupancy") or {}
+    # closed loop (PR 9): the SLO engine evaluates on THIS collector
+    # interval, and the node's own health status lands in its TSDB — so
+    # health/compliance history is queryable like any other series.
+    # Bounded leaves only (status codes, counts, a joined id string);
+    # failures degrade to empty sections — collection must never stop.
+    slo_doc = {}
+    health_doc = {}
+    try:
+        ev = engine.slo.evaluate()
+        slo_doc = {
+            "compliant": 1 if ev["compliant"] else 0,
+            "breached_count": ev["breached_count"],
+            "objective_count": ev["objective_count"],
+            "breached": ",".join(ev["breached"]),
+        }
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..xpack.health import STATUS_CODES, health_report
+
+        hr = health_report(engine)
+        health_doc = {
+            "status": hr["status"],
+            "status_code": STATUS_CODES.get(hr["status"], 1),
+            "indicators": {
+                name: STATUS_CODES.get(ind["status"], 1)
+                for name, ind in hr["indicators"].items()
+            },
+        }
+    except Exception:  # noqa: BLE001
+        pass
     return {
         "type": "node_stats",
         "cluster_uuid": "elasticsearch-tpu",
@@ -145,6 +176,8 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "cache_hits": dev["jit"]["executable_cache"]["hits"],
                 "cache_misses": dev["jit"]["executable_cache"]["misses"],
             },
+            "health": health_doc,
+            "slo": slo_doc,
             "serving": {
                 "queue_depth": sv_st.get("queue", {}).get("depth", 0),
                 "admitted": sv_st.get("admitted", 0),

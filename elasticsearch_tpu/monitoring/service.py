@@ -33,18 +33,26 @@ def monitoring_index_name(ts: float | None = None) -> str:
     return MONITORING_PREFIX + time.strftime("%Y.%m.%d", time.gmtime(t))
 
 
+# date-suffixed hidden indices the CleanerService owns: the monitoring
+# TSDB and the watcher's execution history (xpack/watcher.py) age out on
+# the same xpack.monitoring.history.duration window
+_DATED_PREFIXES = (MONITORING_PREFIX, ".watcher-history-8-")
+
+
 def _index_date(name: str):
     """-> epoch seconds of the index's UTC date, or None if not a
-    monitoring index name."""
-    if not name.startswith(MONITORING_PREFIX):
-        return None
-    try:
-        import calendar
+    dated monitoring/watcher-history index name."""
+    for prefix in _DATED_PREFIXES:
+        if not name.startswith(prefix):
+            continue
+        try:
+            import calendar
 
-        st = time.strptime(name[len(MONITORING_PREFIX):], "%Y.%m.%d")
-        return calendar.timegm(st)
-    except ValueError:
-        return None
+            st = time.strptime(name[len(prefix):], "%Y.%m.%d")
+            return calendar.timegm(st)
+        except ValueError:
+            return None
+    return None
 
 
 class MonitoringService:

@@ -1,0 +1,285 @@
+"""SLO engine: declarative objectives over the node's own measured signals.
+
+The closed loop the self-monitoring pipeline was missing: PR 4-5 gave
+the node p99 latency histograms, per-kernel MFU/bandwidth from the
+analytic cost model, serving queue/shed accounting, breaker state and
+HBM gauges — this module turns them into machine-checked objectives,
+evaluated on the monitoring collector interval, each materialized as
+both a `_health_report` indicator (xpack/health.py) and the prebuilt
+`slo-compliance` watch (xpack/watcher.py), so a p99 regression or an
+MFU collapse fires an alert instead of waiting for a human to read
+`.monitoring-es-*`. The kernel floors make the BENCH_NOTES roofline
+claims standing invariants: a perf PR that silently drops a kernel
+below its recorded floor flips the kernel-utilization indicator.
+
+Objectives are registered via DYNAMIC settings (slo.*): thresholds
+change on a live node, no restart. `slo.kernel.floors` is a JSON object
+mapping kernel-name patterns to floors, e.g.
+`{"fused.*": {"mfu": 0.01}, "ann.gather_scan": {"bw_util": 0.2}}`;
+`slo.custom` is a JSON list of ad-hoc objectives over the metrics
+snapshot: `[{"id": "...", "path": "histograms.es.rest.request.ms.p99",
+"max": 500}]` (greedy dotted-path resolution — metric names contain
+dots)."""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import time
+
+from ..telemetry import metrics
+
+STATUS_CODES = {"green": 0, "yellow": 1, "red": 2}
+
+
+def _objective(oid: str, kind: str, description: str, measured, threshold,
+               breached: bool | None, direction: str) -> dict:
+    status = ("no_data" if breached is None
+              else "breached" if breached else "compliant")
+    return {
+        "id": oid, "kind": kind, "description": description,
+        "measured": measured, "threshold": threshold,
+        "direction": direction, "status": status,
+    }
+
+
+class SloEngine:
+    """Evaluates every registered objective against the live registry /
+    device / serving / breaker state. `evaluate()` is cheap (one metrics
+    snapshot + arithmetic); `current()` serves a bounded-age cached
+    evaluation to read-heavy callers (health indicators, Prometheus)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.last_evaluation: dict | None = None
+        self._last_eval_monotonic: float | None = None
+
+    @property
+    def enabled(self) -> bool:
+        try:
+            return bool(self.engine.settings.get("slo.enabled"))
+        except Exception:  # noqa: BLE001
+            return True
+
+    def _get(self, key, default=None):
+        try:
+            v = self.engine.settings.get(key)
+        except Exception:  # noqa: BLE001
+            return default
+        return default if v is None else v
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate(self) -> dict:
+        snap = metrics.snapshot()
+        objectives: list[dict] = []
+        if self.enabled:
+            objectives.extend(self._latency_objectives(snap))
+            objectives.extend(self._kernel_objectives())
+            objectives.extend(self._serving_objectives())
+            objectives.extend(self._breaker_objectives())
+            objectives.extend(self._hbm_objectives())
+            objectives.extend(self._custom_objectives(snap))
+        breached = [o["id"] for o in objectives if o["status"] == "breached"]
+        out = {
+            "timestamp": int(time.time() * 1000),
+            "enabled": self.enabled,
+            "objective_count": len(objectives),
+            "objectives": objectives,
+            "breached": breached,
+            "breached_count": len(breached),
+            "compliant": not breached,
+        }
+        metrics.gauge_set("es.slo.compliant", 0 if breached else 1)
+        metrics.gauge_set("es.slo.breached", len(breached))
+        metrics.gauge_set("es.slo.objectives", len(objectives))
+        self.last_evaluation = out
+        self._last_eval_monotonic = time.monotonic()
+        return out
+
+    def current(self, max_age_s: float = 15.0) -> dict:
+        """The last evaluation if it is fresh enough, else a new one."""
+        if (self.last_evaluation is not None
+                and self._last_eval_monotonic is not None
+                and time.monotonic() - self._last_eval_monotonic <= max_age_s):
+            return self.last_evaluation
+        return self.evaluate()
+
+    # -- objective families --------------------------------------------------
+
+    def _latency_objectives(self, snap) -> list[dict]:
+        out = []
+        for oid, setting, hist, what in (
+                ("search-p99-latency", "slo.search.p99_ms",
+                 "es.rest.request.ms", "REST request"),
+                ("shard-query-p99-latency", "slo.shard.p99_ms",
+                 "es.shard.search.ms", "shard query")):
+            thr = float(self._get(setting, 0) or 0)
+            if thr <= 0:
+                continue
+            h = snap["histograms"].get(hist)
+            measured = (round(h["p99"], 3)
+                        if h and h.get("count") else None)
+            out.append(_objective(
+                oid, "latency",
+                f"{what} p99 latency <= {thr:g}ms ({hist})",
+                measured, thr,
+                None if measured is None else measured > thr, "max"))
+        return out
+
+    def _kernel_objectives(self) -> list[dict]:
+        raw = str(self._get("slo.kernel.floors", "") or "").strip()
+        if not raw:
+            return []
+        try:
+            floors = json.loads(raw)
+        except json.JSONDecodeError:
+            return [_objective("kernel-floors", "kernel",
+                               "slo.kernel.floors is not valid JSON",
+                               None, raw, True, "min")]
+        min_calls = int(self._get("slo.kernel.min_calls", 3) or 3)
+        from .device import kernel_utilization
+
+        util = kernel_utilization()["kernels"]
+        out = []
+        for pattern in sorted(floors):
+            spec = floors[pattern] or {}
+            matched = {k: u for k, u in util.items()
+                       if fnmatch.fnmatch(k, pattern)
+                       and u["calls"] >= min_calls}
+            for key, label in (("mfu", "MFU"), ("bw_util", "bandwidth")):
+                floor = spec.get(key)
+                if floor is None:
+                    continue
+                oid = f"kernel-{key}-floor[{pattern}]"
+                if not matched:
+                    out.append(_objective(
+                        oid, "kernel",
+                        f"{label} of kernels matching [{pattern}] >= "
+                        f"{floor:g} (no dispatches yet)",
+                        None, floor, None, "min"))
+                    continue
+                worst = min(matched, key=lambda k: matched[k][key])
+                measured = matched[worst][key]
+                out.append(_objective(
+                    oid, "kernel",
+                    f"{label} of kernel [{worst}] >= {floor:g} "
+                    f"(floor over [{pattern}], cost-model measured)",
+                    measured, floor, measured < floor, "min"))
+        return out
+
+    def _serving_objectives(self) -> list[dict]:
+        sv = getattr(self.engine, "_serving", None)
+        if sv is None:
+            return []
+        st = sv.stats()
+        out = []
+        depth = st.get("queue", {}).get("depth", 0)
+        cap = max(st.get("queue", {}).get("max_depth", 1) or 1, 1)
+        frac = float(self._get("slo.serving.queue_fraction", 0.95) or 0.95)
+        out.append(_objective(
+            "serving-queue-depth", "serving",
+            f"serving queue depth <= {frac:.0%} of max_depth [{cap}]",
+            round(depth / cap, 4), frac, depth / cap > frac, "max"))
+        admitted = st.get("admitted", 0)
+        shed = st.get("shed", 0)
+        budget = float(self._get("slo.serving.shed_rate", 0.2) or 0.2)
+        total = admitted + shed
+        measured = round(shed / total, 4) if total else None
+        out.append(_objective(
+            "serving-shed-rate", "serving",
+            f"serving shed rate <= {budget:.0%} of offered requests",
+            measured, budget,
+            None if measured is None else measured > budget, "max"))
+        return out
+
+    def _breaker_objectives(self) -> list[dict]:
+        budget = float(self._get("slo.breaker.trip_budget", 1000) or 1000)
+        if budget < 0:
+            return []
+        tripped = 0
+        try:
+            for b in self.engine.breakers.stats().values():
+                if isinstance(b, dict):
+                    tripped += int(b.get("tripped", 0))
+        except Exception:  # noqa: BLE001
+            return []
+        return [_objective(
+            "breaker-trips", "breaker",
+            f"cumulative circuit-breaker trips <= {budget:g}",
+            tripped, budget, tripped > budget, "max")]
+
+    def _hbm_objectives(self) -> list[dict]:
+        frac = float(self._get("slo.hbm.headroom_fraction", 0.98) or 0.98)
+        if frac <= 0:
+            return []
+        from .device import device_memory_snapshot
+
+        mem = device_memory_snapshot()
+        limit = mem.get("bytes_limit")
+        used = mem.get("bytes_in_use", mem.get("live_bytes", 0))
+        measured = round(used / limit, 4) if limit else None
+        return [_objective(
+            "hbm-headroom", "device",
+            f"HBM in use <= {frac:.0%} of the allocator limit",
+            measured, frac,
+            None if measured is None else measured > frac, "max")]
+
+    def _custom_objectives(self, snap) -> list[dict]:
+        raw = str(self._get("slo.custom", "") or "").strip()
+        if not raw:
+            return []
+        try:
+            specs = json.loads(raw)
+        except json.JSONDecodeError:
+            return [_objective("custom", "custom",
+                               "slo.custom is not valid JSON",
+                               None, raw, True, "max")]
+        from ..xpack.watcher import resolve_path
+
+        out = []
+        for i, spec in enumerate(specs if isinstance(specs, list) else []):
+            oid = spec.get("id") or f"custom-{i}"
+            path = spec.get("path") or spec.get("metric") or ""
+            got = resolve_path(snap, path)
+            measured = got if isinstance(got, (int, float)) else None
+            breached = None
+            thr = None
+            direction = "max"
+            if measured is not None and spec.get("max") is not None:
+                thr = float(spec["max"])
+                breached = measured > thr
+            elif measured is not None and spec.get("min") is not None:
+                thr, direction = float(spec["min"]), "min"
+                breached = measured < thr
+            out.append(_objective(
+                oid, "custom",
+                spec.get("description") or f"[{path}] within threshold",
+                measured, thr, breached, direction))
+        return out
+
+    # -- the prebuilt watch ---------------------------------------------------
+
+    def ensure_prebuilt_watch(self) -> dict:
+        """Materialize the objectives as a watch: every SLO breach fires
+        through the same alert state machine operators already watch
+        (`.alerts-default` carries the slo-compliance alert; acking it
+        silences the actions until compliance recovers)."""
+        from ..xpack.watcher import SLO_WATCH_ID
+
+        svc = self.engine.watcher
+        if SLO_WATCH_ID in svc._watches():
+            return {"watch_id": SLO_WATCH_ID, "created": False}
+        interval = self._get("xpack.monitoring.collection.interval", "10s")
+        svc.put(SLO_WATCH_ID, {
+            "trigger": {"schedule": {"interval": interval or "10s"}},
+            "input": {"slo": {}},
+            "condition": {"compare": {
+                "ctx.payload.breached_count": {"gt": 0}}},
+            "actions": {"log_breach": {
+                "logging": {"text": "SLO objectives breached"},
+                "throttle_period": "1m",
+            }},
+            "metadata": {"prebuilt": True, "managed_by": "slo"},
+        })
+        return {"watch_id": SLO_WATCH_ID, "created": True}

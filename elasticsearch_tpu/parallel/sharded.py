@@ -1597,6 +1597,15 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     fs = _fused_sharded_for(ss)
     if fs is not None and not _return_program and fs.usable(k):
         return fs.msearch(fld, queries, k)
+    # the uncached fall-through must route the SAME arm priority as the
+    # cached path (_msearch_sharded_partials: fused > impact > exact) —
+    # it previously skipped straight to exact, so disabling the request
+    # cache silently disengaged the impact tier (caught by the shuffled
+    # cache-off gate)
+    if not _return_program and queries and _impact_sharded_usable(ss):
+        out = _msearch_impact_partials(ss, fld, queries, k)
+        if out is not None:
+            return _merge_shard_rows(*out)
     return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from aiohttp import web
@@ -177,6 +178,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     # the background monitoring tick serializes its engine access through
     # the same worker instead of racing REST traffic (monitoring/service)
     engine.monitoring.submit = app["pool"].submit
+    # likewise the persistent-task ticker (scheduled watches, ML realtime,
+    # CCR follows): each pass runs on the engine worker; watcher exports
+    # flush on the ticker thread afterwards (tasks/persistent)
+    engine.persistent.submit = app["pool"].submit
     # serving waves run their engine-touching stages on the same worker
     # (one engine thread, searches and mutations serialized), while the
     # completer thread pulls device outputs off-thread
@@ -922,6 +927,58 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def watcher_execute_api(request):
         return web.json_response(await _xcall(
             "xpack", "watcher_execute", request.match_info["id"]))
+
+    @handler
+    async def watcher_ack_api(request):
+        return web.json_response(await call(
+            engine.watcher.ack, request.match_info["id"],
+            request.match_info.get("action_id")))
+
+    @handler
+    async def watcher_activate_api(request):
+        return web.json_response(await call(
+            engine.watcher.activate, request.match_info["id"], True))
+
+    @handler
+    async def watcher_deactivate_api(request):
+        return web.json_response(await call(
+            engine.watcher.activate, request.match_info["id"], False))
+
+    @handler
+    async def watcher_stats_api(request):
+        st = await call(engine.watcher.stats)
+        return web.json_response({
+            "_nodes": {"total": 1, "successful": 1, "failed": 0},
+            "cluster_name": "elasticsearch-tpu",
+            "manually_stopped": not engine.watcher.enabled,
+            "stats": [{"node_id": engine.tasks.node, **st}],
+        })
+
+    @handler
+    async def watcher_start_api(request):
+        from ..xpack.watcher import ensure_executor
+
+        await call(ensure_executor, engine)
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def watcher_stop_api(request):
+        # default executor, NOT the engine worker: stop joins the ticker
+        # thread, which may itself be waiting on a tick it submitted to
+        # the worker — joining from the worker would stall both
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, engine.persistent.stop_ticker)
+        return web.json_response({"acknowledged": True})
+
+    @handler
+    async def slo_api(request):
+        """GET /_slo: the registered objectives and their latest
+        evaluation (?evaluate=true forces a fresh pass — reads otherwise
+        serve the monitoring-interval cached evaluation)."""
+        force = request.query.get("evaluate") in ("", "true", "1")
+        ev = await call(
+            engine.slo.evaluate if force else engine.slo.current)
+        return web.json_response({"slo": ev})
 
     @handler
     async def enrich_put(request):
@@ -2385,23 +2442,33 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def cluster_health(request):
-        n = len(engine.indices)
-        shards = sum(i.num_shards for i in engine.indices.values())
-        return web.json_response(
-            {
-                "cluster_name": "elasticsearch-tpu",
-                "status": "green",
-                "timed_out": False,
-                "number_of_nodes": 1,
-                "number_of_data_nodes": 1,
-                "active_primary_shards": shards,
-                "active_shards": shards,
-                "relocating_shards": 0,
-                "initializing_shards": 0,
-                "unassigned_shards": 0,
-                "active_shards_percent_as_number": 100.0,
-            }
-        )
+        """Health derived from searcher/replica state (PR 9 — no more
+        hardcoded green): red indices have no live searcher, replicas on
+        a single node are unassigned (yellow). wait_for_status polls
+        until the status is AT LEAST as good as requested, then 408 +
+        timed_out like the reference on expiry."""
+        from ..utils.durations import parse_duration_seconds
+
+        expr = request.match_info.get("index")
+        h = await call(engine.cluster_health, expr)
+        want = request.query.get("wait_for_status")
+        order = {"green": 0, "yellow": 1, "red": 2}
+        if want in order:
+            timeout_s = parse_duration_seconds(
+                request.query.get("timeout", "30s"), 30.0) or 30.0
+            deadline = time.monotonic() + timeout_s
+            while (order[h["status"]] > order[want]
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.05)
+                h = await call(engine.cluster_health, expr)
+            if order[h["status"]] > order[want]:
+                h["timed_out"] = True
+                if request.query.get("level") != "indices":
+                    h.pop("indices", None)
+                return web.json_response(h, status=408)
+        if request.query.get("level") != "indices":
+            h.pop("indices", None)
+        return web.json_response(h)
 
     @handler
     async def cat_indices(request):
@@ -2409,11 +2476,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         for name, idx in sorted(engine.indices.items()):
             rows.append(
                 {
-                    "health": "green",
+                    "health": engine.index_health(name),
                     "status": "open",
                     "index": name,
                     "pri": str(idx.num_shards),
-                    "rep": "0",
+                    "rep": str(idx.settings.get("number_of_replicas") or 0),
                     "docs.count": str(idx.live_count),
                     "docs.deleted": str(sum(1 for e in idx.docs.values() if not e.alive)),
                 }
@@ -2462,6 +2529,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # compile + executable-cache counters
                         "device": _mon_device.device_stats(engine),
                         "monitoring": engine.monitoring.stats(),
+                        # scheduled alerting + SLO compliance (PR 9):
+                        # built lazily — a node that never used them
+                        # reports the cheap placeholder, not a service
+                        "watcher": (engine._watcher.stats()
+                                    if engine._watcher is not None
+                                    else {"watcher_state": "not_built"}),
+                        "slo": (engine._slo.last_evaluation
+                                if engine._slo is not None else None),
                         # continuous-batching front end: queue depth,
                         # wave occupancy, shed/expiry/cancel accounting
                         "serving": engine.serving.stats(),
@@ -2536,6 +2611,19 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 extra[f"es.device.hbm.{key}"] = mem[key]
         extra["es.device.pack_padded_waste_bytes"] = \
             _mon_device.padded_waste_bytes(engine)
+        # closed-loop health/SLO gauges (PR 9): the scrape itself carries
+        # the indicator-based health status and SLO compliance, so a
+        # dashboard alert needs no extra endpoint
+        try:
+            from ..xpack.health import STATUS_CODES, health_report
+
+            hr = health_report(engine)
+            extra["es.health.status"] = STATUS_CODES.get(hr["status"], 1)
+            ev = engine.slo.current()
+            extra["es.slo.compliant"] = 1 if ev["compliant"] else 0
+            extra["es.slo.breached"] = ev["breached_count"]
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
         return web.Response(
             text=metrics.prometheus_text(extra),
             content_type="text/plain", charset="utf-8",
@@ -2730,6 +2818,23 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_watcher/watch/{id}", watcher_get_api)
     app.router.add_delete("/_watcher/watch/{id}", watcher_delete_api)
     app.router.add_post("/_watcher/watch/{id}/_execute", watcher_execute_api)
+    app.router.add_put("/_watcher/watch/{id}/_ack", watcher_ack_api)
+    app.router.add_post("/_watcher/watch/{id}/_ack", watcher_ack_api)
+    app.router.add_put("/_watcher/watch/{id}/_ack/{action_id}",
+                       watcher_ack_api)
+    app.router.add_post("/_watcher/watch/{id}/_ack/{action_id}",
+                        watcher_ack_api)
+    app.router.add_put("/_watcher/watch/{id}/_activate", watcher_activate_api)
+    app.router.add_post("/_watcher/watch/{id}/_activate",
+                        watcher_activate_api)
+    app.router.add_put("/_watcher/watch/{id}/_deactivate",
+                       watcher_deactivate_api)
+    app.router.add_post("/_watcher/watch/{id}/_deactivate",
+                        watcher_deactivate_api)
+    app.router.add_get("/_watcher/stats", watcher_stats_api)
+    app.router.add_post("/_watcher/_start", watcher_start_api)
+    app.router.add_post("/_watcher/_stop", watcher_stop_api)
+    app.router.add_get("/_slo", slo_api)
     app.router.add_put("/_enrich/policy/{name}", enrich_put)
     app.router.add_post("/_enrich/policy/{name}/_execute", enrich_execute)
     app.router.add_get("/_enrich/policy", enrich_get)

@@ -9,7 +9,9 @@ executor on engine start / on each scheduler tick."""
 
 from __future__ import annotations
 
+import threading
 import time
+import weakref
 
 from ..utils.errors import IllegalArgumentError, ResourceAlreadyExistsError, ResourceNotFoundError
 
@@ -20,7 +22,21 @@ from ..utils.errors import IllegalArgumentError, ResourceAlreadyExistsError, Res
 # sit idle until something else happened to build the service
 _LAZY_EXECUTOR_BOOTSTRAP = {
     "xpack/ml/job": lambda engine: engine.ml,
+    "watcher": lambda engine: engine.watcher,
 }
+
+# every service that ever started a ticker thread, so the test suite's
+# module-boundary hygiene can stop threads leaked by engines a test never
+# closed (the serving front end keeps the same registry)
+_LIVE_TICKERS: "weakref.WeakSet[PersistentTasksService]" = weakref.WeakSet()
+
+
+def stop_all_tickers_for_tests() -> None:
+    for svc in list(_LIVE_TICKERS):
+        try:
+            svc.stop_ticker()
+        except Exception:  # noqa: BLE001 - hygiene must not fail teardown
+            pass
 
 
 class PersistentTasksService:
@@ -29,6 +45,24 @@ class PersistentTasksService:
     def __init__(self, engine):
         self.engine = engine
         self.executors: dict[str, object] = {}
+        # scheduled execution (PR 9): a daemon ticker drives tick() on the
+        # watcher interval so persistent tasks (watches, ML realtime, CCR
+        # follows) advance WITHOUT a caller — the reference's scheduler
+        # threads. `submit` (wired by rest/app.make_app to the engine
+        # worker) serializes each pass with REST traffic; post_tick_hooks
+        # run on the ticker thread OUTSIDE that serialization, which is
+        # where the watcher flushes gateway exports (a gateway post needs
+        # the engine worker to apply the op — running it inside `submit`
+        # on the one-thread pool would self-deadlock, the same shape the
+        # monitoring exporter documents).
+        self.submit = None
+        self.post_tick_hooks: list = []
+        self._tick_thread: threading.Thread | None = None
+        self._tick_wake = threading.Event()
+        self._tick_stop = False
+        self._tick_lock = threading.Lock()
+        self.ticks_total = 0
+        self.last_tick_error: str | None = None
 
     # executor: object with tick(engine, task_dict) -> None (mutates
     # task_dict["state"]); called on every scheduler pass while allocated
@@ -115,3 +149,70 @@ class PersistentTasksService:
         if ran:
             self.engine.meta.save()
         return ran
+
+    # -- scheduled ticker ---------------------------------------------------
+
+    def tick_interval_seconds(self) -> float:
+        from ..utils.durations import parse_duration_seconds
+
+        try:
+            raw = self.engine.settings.get("xpack.watcher.tick.interval")
+        except Exception:  # noqa: BLE001 - engines without the setting
+            raw = None
+        sec = parse_duration_seconds(raw, 1.0)
+        return max(sec if sec is not None else 1.0, 0.02)
+
+    def ticker_running(self) -> bool:
+        t = self._tick_thread
+        return t is not None and t.is_alive()
+
+    def start_ticker(self) -> None:
+        with self._tick_lock:
+            if self.ticker_running():
+                return
+            self._tick_stop = False
+            self._tick_wake.clear()
+            self._tick_thread = threading.Thread(
+                target=self._ticker_loop, daemon=True,
+                name=f"persistent-ticker-{getattr(self.engine.tasks, 'node', '?')}")
+            self._tick_thread.start()
+            _LIVE_TICKERS.add(self)
+
+    def stop_ticker(self) -> None:
+        with self._tick_lock:
+            self._tick_stop = True
+            self._tick_wake.set()
+            t = self._tick_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        with self._tick_lock:
+            self._tick_thread = None
+
+    def _ticker_loop(self) -> None:
+        while True:
+            if self._tick_stop:
+                return
+            try:
+                if self.submit is not None:
+                    self.submit(self.tick).result(timeout=120)
+                else:
+                    self.tick()
+                self.ticks_total += 1
+                self.last_tick_error = None
+            except Exception as e:  # noqa: BLE001 - keep ticking
+                self.last_tick_error = f"{type(e).__name__}: {e}"
+            for hook in list(self.post_tick_hooks):
+                try:
+                    hook()
+                except Exception as e:  # noqa: BLE001 - keep ticking
+                    self.last_tick_error = f"{type(e).__name__}: {e}"
+            self._tick_wake.wait(self.tick_interval_seconds())
+            self._tick_wake.clear()
+
+    def ticker_stats(self) -> dict:
+        return {
+            "running": self.ticker_running(),
+            "ticks_total": self.ticks_total,
+            "interval_seconds": self.tick_interval_seconds(),
+            "last_tick_error": self.last_tick_error,
+        }

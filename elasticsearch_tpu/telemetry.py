@@ -628,10 +628,25 @@ class MetricsRegistry:
                 gauges[name] = None
         return {"counters": counters, "gauges": gauges, "histograms": hists}
 
+    # exposition help text for well-known instruments; anything else gets
+    # a generated line (prometheus_client requires HELP/TYPE per family,
+    # and scrapers surface these strings in their metric explorers)
+    HELP_TEXTS = {
+        "es.rest.request.ms": "REST request wall time",
+        "es.shard.search.ms": "per-shard query phase wall time",
+        "es.health.status": "node health: 0=green 1=yellow 2=red",
+        "es.slo.compliant": "1 when every SLO objective holds, else 0",
+        "es.slo.breached": "number of breached SLO objectives",
+        "es.slo.objectives": "number of evaluated SLO objectives",
+        "es.watcher.executions": "watch executions (scheduled + manual)",
+        "es.serving.queue_depth": "serving admission queue depth",
+    }
+
     def prometheus_text(self, extra_gauges: dict | None = None) -> str:
         """Prometheus text exposition (format 0.0.4): counters as
         `_total`, gauges, histograms as cumulative `_bucket{le=...}` +
-        `_sum`/`_count` with the exponential bucket upper bounds.
+        `_sum`/`_count` with the exponential bucket upper bounds; every
+        metric family is preceded by its `# HELP` and `# TYPE` lines.
         `extra_gauges`: point-in-time values rendered as gauges (breaker /
         cache state sampled by the endpoint)."""
         import re as _re
@@ -646,6 +661,12 @@ class MetricsRegistry:
                 return str(int(f))
             return repr(f)
 
+        def head(lines, raw_name, metric, kind):
+            help_text = self.HELP_TEXTS.get(
+                raw_name, f"{raw_name} ({kind})").replace("\n", " ")
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} {kind}")
+
         with self._lock:
             counters = dict(self._counters)
             gauges_raw = dict(self._gauges)
@@ -658,7 +679,7 @@ class MetricsRegistry:
             m = san(name)
             if not m.endswith("_total"):  # prometheus counter convention
                 m += "_total"
-            lines.append(f"# TYPE {m} counter")
+            head(lines, name, m, "counter")
             lines.append(f"{m} {num(counters[name])}")
         gauges = {}
         for name, v in gauges_raw.items():
@@ -675,12 +696,12 @@ class MetricsRegistry:
             if not isinstance(v, (int, float)):
                 continue
             m = san(name)
-            lines.append(f"# TYPE {m} gauge")
+            head(lines, name, m, "gauge")
             lines.append(f"{m} {num(v)}")
         for name in sorted(hist_data):
             count, total, zero_count, buckets = hist_data[name]
             m = san(name)
-            lines.append(f"# TYPE {m} histogram")
+            head(lines, name, m, "histogram")
             cum = 0
             if zero_count:
                 cum += zero_count

@@ -9,7 +9,6 @@ health/HealthService.java (indicator-based _health_report)."""
 
 from __future__ import annotations
 
-import fnmatch
 import time
 
 from ..utils.errors import (
@@ -89,132 +88,38 @@ def slm_execute(engine, pid: str) -> dict:
 
 
 # ---- Watcher --------------------------------------------------------------
+# grown from a manual-execute stub into the scheduled alerting subsystem
+# in xpack/watcher.py (PR 9); these delegates keep the long-standing
+# functional surface (rest/app.py _xcall and older tests) stable.
 
 def watcher_put(engine, wid: str, body: dict) -> dict:
-    if not isinstance((body or {}).get("trigger"), dict):
-        raise IllegalArgumentError("watch requires [trigger]")
-    created = wid not in _bucket(engine, "watches")
-    _bucket(engine, "watches")[wid] = {
-        "trigger": body["trigger"],
-        "input": body.get("input") or {},
-        "condition": body.get("condition") or {"always": {}},
-        "actions": body.get("actions") or {},
-        "status": {"state": {"active": True}, "actions": {}},
-    }
-    engine.meta.save()
-    return {"_id": wid, "created": created}
+    return engine.watcher.put(wid, body)
 
 
 def watcher_get(engine, wid: str) -> dict:
-    w = _bucket(engine, "watches").get(wid)
-    if w is None:
-        raise ResourceNotFoundError(f"watch [{wid}] not found")
-    return {"_id": wid, "found": True, "watch": w, "status": w["status"]}
+    return engine.watcher.get(wid)
 
 
 def watcher_delete(engine, wid: str) -> dict:
-    ws = _bucket(engine, "watches")
-    if wid not in ws:
-        raise ResourceNotFoundError(f"watch [{wid}] not found")
-    del ws[wid]
-    engine.meta.save()
-    return {"_id": wid, "found": True}
-
-
-def _resolve_ctx_path(ctx: dict, path: str):
-    cur = ctx
-    for part in path.split("."):
-        if isinstance(cur, dict) and part in cur:
-            cur = cur[part]
-        else:
-            return None
-    return cur
+    return engine.watcher.delete(wid)
 
 
 def watcher_execute(engine, wid: str, record=True) -> dict:
-    w = _bucket(engine, "watches").get(wid)
-    if w is None:
-        raise ResourceNotFoundError(f"watch [{wid}] not found")
-    # input
-    payload = {}
-    if "search" in w["input"]:
-        req = w["input"]["search"].get("request") or {}
-        body = req.get("body") or {}
-        res = engine.search_multi(
-            ",".join(req.get("indices", ["_all"])),
-            query=body.get("query"), size=int(body.get("size", 10)),
-        )
-        payload = res
-    elif "simple" in w["input"]:
-        payload = dict(w["input"]["simple"])
-    ctx = {"payload": payload}
-    # condition
-    met = True
-    cond = w["condition"]
-    if "compare" in cond:
-        (path, op_spec), = cond["compare"].items()
-        (op, want), = op_spec.items()
-        got = _resolve_ctx_path(ctx, path.replace("ctx.", ""))
-        if got is None:
-            met = False
-        else:
-            met = {
-                "eq": got == want, "not_eq": got != want,
-                "gt": got > want, "gte": got >= want,
-                "lt": got < want, "lte": got <= want,
-            }.get(op, False)
-    elif "never" in cond:
-        met = False
-    # actions
-    executed = []
-    if met:
-        for aname, aspec in w["actions"].items():
-            if "index" in aspec:
-                target = aspec["index"]["index"]
-                doc = {"watch_id": wid, "result": payload,
-                       "timestamp": int(time.time() * 1000)}
-                engine.get_or_autocreate(target).index_doc(None, doc)
-                executed.append(aname)
-            elif "logging" in aspec:
-                text = aspec["logging"].get("text", "")
-                _bucket(engine, "watcher_log").setdefault(wid, []).append(text)
-                executed.append(aname)
-            w["status"]["actions"][aname] = {
-                "ack": {"state": "ackable"},
-                "last_execution": {"successful": True},
-            }
-    if record:
-        engine.meta.save()
-    return {
-        "_id": wid,
-        "watch_record": {
-            "watch_id": wid,
-            "state": "executed" if met else "execution_not_needed",
-            "condition_met": met,
-            "actions_executed": executed,
-        },
-    }
+    return engine.watcher.execute(wid, record=record)
 
 
-class WatcherExecutor:
-    """Persistent-task executor: fires every active watch each tick (the
-    scheduler granularity stands in for the reference's cron triggers)."""
+def watcher_ack(engine, wid: str, action_id: str | None = None) -> dict:
+    return engine.watcher.ack(wid, action_id)
 
-    def tick(self, engine, task):
-        for wid, w in list(_bucket(engine, "watches").items()):
-            if w["status"]["state"].get("active"):
-                try:
-                    watcher_execute(engine, wid, record=False)
-                except Exception:  # noqa: BLE001 - a broken watch must not stop others
-                    pass
-        engine.meta.save()
+
+def watcher_activate(engine, wid: str, active: bool = True) -> dict:
+    return engine.watcher.activate(wid, active)
 
 
 def watcher_ensure_executor(engine):
-    if "watcher" not in engine.persistent.executors:
-        engine.persistent.register_executor("watcher", WatcherExecutor())
-        if "watcher-driver" not in engine.meta.persistent_tasks:
-            engine.persistent.start("watcher-driver", "watcher", {})
+    from .watcher import ensure_executor
+
+    ensure_executor(engine)
 
 
 # ---- Enrich ---------------------------------------------------------------
@@ -291,47 +196,12 @@ def enrich_lookup(engine, policy_name: str, value) -> dict | None:
 
 
 # ---- health report --------------------------------------------------------
+# the 2-indicator stub grew into xpack/health.py (PR 9): ~11 indicators
+# (shards, disk, breakers, HBM, kernel-utilization, serving-backpressure,
+# slo-compliance, watcher, ilm, slm, master) each with ES-shaped
+# symptom/impacts/diagnosis. This delegate keeps the _xcall surface.
 
 def health_report(engine) -> dict:
-    indicators = {}
-    # shards availability: green when every index has a live searcher
-    unassigned = [n for n, i in engine.indices.items() if i._searcher is None]
-    indicators["shards_availability"] = {
-        "status": "red" if unassigned else "green",
-        "symptom": ("This cluster has unavailable shards"
-                    if unassigned else "This cluster has all shards available"),
-        **({"impacts": [{"severity": 1, "description":
-                         f"indices {unassigned} are unavailable"}]}
-           if unassigned else {}),
-    }
-    # disk
-    import shutil as _sh
+    from .health import health_report as _hr
 
-    usage = _sh.disk_usage(engine.data_path or "/")
-    pct = usage.used / usage.total if usage.total else 0.0
-    indicators["disk"] = {
-        "status": "green" if pct < 0.85 else ("yellow" if pct < 0.95 else "red"),
-        "symptom": f"The cluster has enough available disk space ({pct:.0%} used)"
-        if pct < 0.85 else f"Disk usage is high ({pct:.0%})",
-    }
-    # ilm/slm running states
-    indicators["ilm"] = {"status": "green",
-                         "symptom": "ILM is running",
-                         "details": {"policies": len(getattr(engine.meta, "ilm_policies", {}))}}
-    indicators["slm"] = {"status": "green",
-                         "symptom": "SLM is running",
-                         "details": {"policies": len(_bucket(engine, "slm_policies"))}}
-    # master stability (single-node: trivially stable)
-    indicators["master_is_stable"] = {
-        "status": "green",
-        "symptom": "The cluster has a stable master node",
-    }
-    worst = "green"
-    for ind in indicators.values():
-        if ind["status"] == "red":
-            worst = "red"
-            break
-        if ind["status"] == "yellow":
-            worst = "yellow"
-    return {"status": worst, "cluster_name": "elasticsearch-tpu",
-            "indicators": indicators}
+    return _hr(engine)

@@ -1,0 +1,360 @@
+"""Device-aware health report: indicators with impacts + diagnosis.
+
+Parity target: health/HealthService.java — HealthIndicatorService
+implementations each contribute one indicator carrying a status, a
+human symptom, `impacts` (what is degraded, how badly) and `diagnosis`
+(cause, action, affected resources); the report's status is the worst
+indicator. This engine's "JVM" is the XLA runtime and its workload is
+device dispatches, so beyond the reference's shards/disk/master
+indicators the report diagnoses the device: HBM headroom, per-kernel
+MFU/bandwidth against the SLO floors (monitoring/slo.py — the
+BENCH_NOTES rooflines as standing invariants), serving backpressure
+(queue depth / shed rate), SLO compliance, and the watcher's own
+health. The same per-index health feeds `/_cluster/health` and
+`_cat/indices` (engine.index_health), so the REST health surface and
+this report can never disagree about shard availability."""
+
+from __future__ import annotations
+
+import time
+
+GREEN, YELLOW, RED = "green", "yellow", "red"
+_RANK = {GREEN: 0, "unknown": 1, YELLOW: 1, RED: 2}
+STATUS_CODES = {GREEN: 0, YELLOW: 1, RED: 2, "unknown": 1}
+
+
+def _impact(description: str, severity: int = 1,
+            areas: list[str] | None = None) -> dict:
+    return {"severity": severity, "description": description,
+            "impact_areas": areas or ["search"]}
+
+
+def _diagnosis(cause: str, action: str, resources=None) -> dict:
+    return {"cause": cause, "action": action,
+            "affected_resources": resources or []}
+
+
+def worst_status(statuses) -> str:
+    worst = GREEN
+    for s in statuses:
+        if _RANK.get(s, 1) > _RANK[worst]:
+            worst = YELLOW if s == "unknown" else s
+        if worst == RED:
+            break
+    return worst
+
+
+# ---------------------------------------------------------------------------
+# indicators
+# ---------------------------------------------------------------------------
+
+def _shards_indicator(engine) -> dict:
+    red = [n for n in engine.indices
+           if engine.index_health(n) == RED]
+    yellow = [n for n in engine.indices
+              if engine.index_health(n) == YELLOW]
+    if red:
+        return {
+            "status": RED,
+            "symptom": f"{len(red)} indices are unavailable",
+            "impacts": [_impact(
+                f"searches and writes against {red} fail", severity=1,
+                areas=["search", "ingest"])],
+            "diagnosis": [_diagnosis(
+                "indices without a live searcher cannot serve requests",
+                "inspect the engine log for failed refreshes and restore "
+                "from a snapshot if the data is lost", red)],
+        }
+    if yellow:
+        return {
+            "status": YELLOW,
+            "symptom": (f"{len(yellow)} indices have unassigned replica "
+                        "shards"),
+            "impacts": [_impact(
+                f"indices {yellow} have no redundancy; a node loss loses "
+                "data", severity=2, areas=["search", "availability"])],
+            "diagnosis": [_diagnosis(
+                "replica copies require more nodes than the cluster has",
+                "add nodes or set number_of_replicas to 0", yellow)],
+        }
+    return {"status": GREEN,
+            "symptom": "This cluster has all shards available",
+            "details": {"indices": len(engine.indices)}}
+
+
+def _disk_indicator(engine) -> dict:
+    import shutil
+
+    usage = shutil.disk_usage(engine.data_path or "/")
+    pct = usage.used / usage.total if usage.total else 0.0
+    if pct < 0.85:
+        return {"status": GREEN,
+                "symptom": ("The cluster has enough available disk space "
+                            f"({pct:.0%} used)"),
+                "details": {"used_percent": round(pct * 100, 1)}}
+    status = YELLOW if pct < 0.95 else RED
+    return {
+        "status": status,
+        "symptom": f"Disk usage is high ({pct:.0%})",
+        "details": {"used_percent": round(pct * 100, 1)},
+        "impacts": [_impact(
+            "indexing will be blocked when the flood-stage watermark is "
+            "reached", severity=1 if status == RED else 2,
+            areas=["ingest"])],
+        "diagnosis": [_diagnosis(
+            "the data path's filesystem is nearly full",
+            "delete expired indices (xpack.monitoring.history.duration "
+            "prunes monitoring/watcher history) or grow the volume",
+            [engine.data_path or "/"])],
+    }
+
+
+def _breakers_indicator(engine) -> dict:
+    hot = []
+    tripped = 0
+    for name, b in engine.breakers.stats().items():
+        if not isinstance(b, dict):
+            continue
+        tripped += int(b.get("tripped", 0))
+        limit = b.get("limit_size_in_bytes") or 0
+        est = b.get("estimated_size_in_bytes") or 0
+        if limit and est / limit >= 0.85:
+            hot.append((name, round(est / limit, 3)))
+    if hot:
+        return {
+            "status": YELLOW,
+            "symptom": (f"{len(hot)} circuit breakers are above 85% of "
+                        "their limit"),
+            "details": {"hot": dict(hot), "tripped_total": tripped},
+            "impacts": [_impact(
+                "requests that push a breaker past its limit are "
+                "rejected with 429", severity=2)],
+            "diagnosis": [_diagnosis(
+                "memory-accounted state is close to its configured budget",
+                "raise indices.breaker.*.limit or reduce resident state "
+                "(caches, packs, model state)", [n for n, _ in hot])],
+        }
+    return {"status": GREEN,
+            "symptom": "Circuit breakers have headroom",
+            "details": {"tripped_total": tripped}}
+
+
+def _hbm_indicator(engine) -> dict:
+    from ..monitoring.device import device_memory_snapshot
+
+    mem = device_memory_snapshot()
+    limit = mem.get("bytes_limit")
+    used = mem.get("bytes_in_use", mem.get("live_bytes", 0))
+    details = {"live_bytes": mem.get("live_bytes", 0),
+               "live_arrays": mem.get("live_arrays", 0),
+               "bytes_limit": limit}
+    if not limit:
+        return {"status": GREEN,
+                "symptom": ("Device memory is healthy (no allocator "
+                            "limit reported by this backend)"),
+                "details": details}
+    pct = used / limit
+    details["used_percent"] = round(pct * 100, 1)
+    if pct < 0.9:
+        return {"status": GREEN,
+                "symptom": f"HBM has headroom ({pct:.0%} in use)",
+                "details": details}
+    status = YELLOW if pct < 0.98 else RED
+    return {
+        "status": status,
+        "symptom": f"HBM is nearly full ({pct:.0%} in use)",
+        "details": details,
+        "impacts": [_impact(
+            "the next pack build or compile may OOM the device",
+            severity=1, areas=["search", "ingest"])],
+        "diagnosis": [_diagnosis(
+            "resident device arrays are close to the allocator limit",
+            "delete or shrink indices, lower quantization tiers, or "
+            "reduce pack padding (see pack_padded_waste_bytes)", [])],
+    }
+
+
+def _kernel_indicator(engine) -> dict:
+    ev = engine.slo.current()
+    kernel = [o for o in ev["objectives"] if o["kind"] == "kernel"]
+    breached = [o for o in kernel if o["status"] == "breached"]
+    if breached:
+        return {
+            "status": YELLOW,
+            "symptom": (f"{len(breached)} kernel-utilization floors are "
+                        "breached"),
+            "details": {"breached": [o["id"] for o in breached]},
+            "impacts": [_impact(
+                "device kernels run below their recorded roofline "
+                "fraction; throughput claims no longer hold",
+                severity=2, areas=["search", "deployment_management"])],
+            "diagnosis": [_diagnosis(
+                "; ".join(f"{o['description']} — measured "
+                          f"{o['measured']}" for o in breached),
+                "profile the regressed kernel (profile:true device "
+                "sections, scripts/usage_report.py) and compare against "
+                "the BENCH_NOTES round that set the floor",
+                [o["id"] for o in breached])],
+        }
+    if not kernel:
+        return {"status": GREEN,
+                "symptom": ("No kernel-utilization floors configured "
+                            "(slo.kernel.floors)"),
+                "details": {"floors": 0}}
+    return {"status": GREEN,
+            "symptom": (f"All {len(kernel)} kernel-utilization floors "
+                        "hold"),
+            "details": {"floors": len(kernel)}}
+
+
+def _serving_indicator(engine) -> dict:
+    sv = getattr(engine, "_serving", None)
+    if sv is None:
+        return {"status": GREEN,
+                "symptom": ("Serving front end not built on this node "
+                            "(per-request dispatch)")}
+    ev = engine.slo.current()
+    serving = [o for o in ev["objectives"] if o["kind"] == "serving"]
+    breached = [o for o in serving if o["status"] == "breached"]
+    st = sv.stats()
+    details = {"queue_depth": st.get("queue", {}).get("depth", 0),
+               "shed": st.get("shed", 0), "admitted": st.get("admitted", 0)}
+    if breached:
+        return {
+            "status": YELLOW,
+            "symptom": "The serving queue is backing up",
+            "details": details,
+            "impacts": [_impact(
+                "requests are shed with 429 or wait full coalescing "
+                "windows; client p99 rises", severity=2)],
+            "diagnosis": [_diagnosis(
+                "; ".join(o["description"] for o in breached),
+                "raise serving.queue.max_depth / add capacity, or lower "
+                "offered load (the Retry-After header carries the "
+                "measured drain time)", [o["id"] for o in breached])],
+        }
+    return {"status": GREEN,
+            "symptom": "The serving queue is keeping up",
+            "details": details}
+
+
+def _slo_indicator(engine) -> dict:
+    ev = engine.slo.current()
+    if not ev["enabled"]:
+        return {"status": GREEN, "symptom": "SLO evaluation is disabled",
+                "details": {"objectives": 0}}
+    if ev["breached_count"]:
+        breached = [o for o in ev["objectives"]
+                    if o["status"] == "breached"]
+        return {
+            "status": YELLOW,
+            "symptom": (f"{ev['breached_count']} of "
+                        f"{ev['objective_count']} SLO objectives are "
+                        "breached"),
+            "details": {"breached": ev["breached"],
+                        "objective_count": ev["objective_count"]},
+            "impacts": [_impact(
+                "the service is operating outside its declared "
+                "objectives", severity=2)],
+            "diagnosis": [_diagnosis(
+                "; ".join(
+                    f"objective [{o['id']}] breached: {o['description']} "
+                    f"(measured {o['measured']}, threshold "
+                    f"{o['threshold']})" for o in breached),
+                "inspect .monitoring-es-8-* for when the breach began "
+                "and ack the slo-compliance watch once mitigated",
+                ev["breached"])],
+        }
+    return {"status": GREEN,
+            "symptom": (f"All {ev['objective_count']} SLO objectives "
+                        "hold"),
+            "details": {"objective_count": ev["objective_count"]}}
+
+
+def _watcher_indicator(engine) -> dict:
+    svc = engine._watcher
+    tasks = getattr(engine.meta, "persistent_tasks", {})
+    has_task = any(t.get("name") == "watcher" and not t.get("stopped")
+                   for t in tasks.values())
+    if svc is None and not has_task:
+        return {"status": GREEN,
+                "symptom": "Watcher is not in use on this node",
+                "details": {"watch_count": 0}}
+    svc = engine.watcher
+    st = svc.stats()
+    details = {"watch_count": st["watch_count"],
+               "firing": st["firing_watches"],
+               "counters": st["counters"]}
+    if has_task and svc.enabled and not st["ticker"]["running"] \
+            and st["runs_here"]:
+        return {
+            "status": YELLOW,
+            "symptom": ("Watches are registered but the scheduler ticker "
+                        "is not running"),
+            "details": details,
+            "impacts": [_impact(
+                "scheduled watches do not fire; alerting is blind",
+                severity=2, areas=["deployment_management"])],
+            "diagnosis": [_diagnosis(
+                "the persistent-task ticker stopped or was never started",
+                "POST /_watcher/_start (or set xpack.watcher.enabled: "
+                "true)", ["watcher-driver"])],
+        }
+    if st["ticker"]["last_tick_error"]:
+        return {"status": YELLOW,
+                "symptom": "The last watcher tick reported an error",
+                "details": {**details,
+                            "last_tick_error": st["ticker"]["last_tick_error"]},
+                "diagnosis": [_diagnosis(
+                    st["ticker"]["last_tick_error"],
+                    "inspect the watch inputs/actions named in the error",
+                    [])]}
+    return {"status": GREEN,
+            "symptom": f"Watcher is running {st['watch_count']} watches",
+            "details": details}
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+
+def health_report(engine) -> dict:
+    """Every indicator, worst-status rollup. Indicator failures degrade
+    to an `unknown` indicator instead of failing the report — a health
+    API that 500s when the node is sick is useless."""
+    from . import _bucket
+
+    indicators: dict[str, dict] = {}
+
+    def add(name, fn):
+        try:
+            indicators[name] = fn(engine)
+        except Exception as e:  # noqa: BLE001 - degrade, never 500
+            indicators[name] = {
+                "status": "unknown",
+                "symptom": f"indicator failed: {type(e).__name__}: {e}",
+            }
+
+    add("shards_availability", _shards_indicator)
+    add("disk", _disk_indicator)
+    add("breakers", _breakers_indicator)
+    add("hbm", _hbm_indicator)
+    add("kernel_utilization", _kernel_indicator)
+    add("serving_backpressure", _serving_indicator)
+    add("slo_compliance", _slo_indicator)
+    add("watcher", _watcher_indicator)
+    indicators["ilm"] = {
+        "status": GREEN, "symptom": "ILM is running",
+        "details": {"policies": len(getattr(engine.meta, "ilm_policies", {}))}}
+    indicators["slm"] = {
+        "status": GREEN, "symptom": "SLM is running",
+        "details": {"policies": len(_bucket(engine, "slm_policies"))}}
+    indicators["master_is_stable"] = {
+        "status": GREEN,
+        "symptom": "The cluster has a stable master node"}
+    status = worst_status(i["status"] for i in indicators.values())
+    from ..telemetry import metrics
+
+    metrics.gauge_set("es.health.status", STATUS_CODES.get(status, 1))
+    return {"status": status, "cluster_name": "elasticsearch-tpu",
+            "indicators": indicators}

@@ -1,0 +1,613 @@
+"""Scheduled alerting: watches that fire on their own.
+
+Parity target: x-pack/plugin/watcher — a watch is a stored
+trigger -> input -> condition -> actions pipeline (Watch.java), executed
+by TickerScheduleTriggerEngine on its schedule, with per-action ack
+states (ActionStatus: awaits_successful_execution -> ackable -> acked),
+throttle periods deduplicating repeated firings, and every execution
+recorded into `.watcher-history-*` (HistoryStore). Here:
+
+- triggers: `schedule.interval` (ES time value) and a 5-field cron
+  subset (`* */n a,b a-b` per field; minute granularity) — the quartz
+  engine is simplified to the persistent-task ticker's granularity
+  (tasks/persistent.py drives `PersistentTasksService.tick()` on
+  `xpack.watcher.tick.interval`), so watches ride the same machinery as
+  the ML realtime tick and survive restart/failover with it;
+- inputs: `search` (any index via the normal search surface), `simple`,
+  `metrics` (the MetricsRegistry snapshot — p99 histograms, counters,
+  gauges), `monitoring` (the `.monitoring-es-8-*` TSDB via the agg
+  path), and `slo` (the SLO engine's evaluation, monitoring/slo.py);
+- conditions: `always` / `never` / `compare` with GREEDY dotted-path
+  resolution (metric names themselves contain dots);
+- actions: `logging`, `index`, `webhook` (stub: the request is recorded,
+  never sent), each with an ack state machine and throttling;
+- every execution appends a history document and every alert-state
+  TRANSITION (ok -> firing -> acked -> ok) upserts one alert document
+  per watch into `.alerts-default` — written through the engine (or, on
+  a cluster node, exported through the HTTP gateway so the docs ride the
+  replicated op log and every replica can serve them from normal
+  search).
+
+On a replicated cluster only the elected master's replica fires watches
+and exports documents (`should_run`); watch CONTENT replicates through
+the op log (PUT watch is a mutation), watch STATUS (last-fired clocks,
+ack states) is node-local — a failover may refire one throttle window
+early. Documented in DIVERGENCES.md.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+
+from ..telemetry import log, metrics
+from ..utils.durations import parse_duration_seconds
+from ..utils.errors import IllegalArgumentError, ResourceNotFoundError
+
+HISTORY_PREFIX = ".watcher-history-8-"
+ALERTS_INDEX = ".alerts-default"
+DEFAULT_THROTTLE = "5s"
+SLO_WATCH_ID = "slo-compliance"
+
+
+def history_index_name(ts: float | None = None) -> str:
+    """Daily history index: .watcher-history-8-YYYY.MM.DD (UTC) — pruned
+    by the monitoring CleanerService alongside .monitoring-es-8-*."""
+    t = time.time() if ts is None else ts
+    return HISTORY_PREFIX + time.strftime("%Y.%m.%d", time.gmtime(t))
+
+
+def watcher_index_body() -> dict:
+    """Mappings/settings for the hidden history/alert indices."""
+    return {
+        "settings": {"index": {"hidden": True, "number_of_shards": 1,
+                               "refresh_interval": "1s"}},
+        "mappings": {"properties": {
+            "@timestamp": {"type": "date"},
+            "watch_id": {"type": "keyword"},
+            "state": {"type": "keyword"},
+            "status": {"type": "keyword"},
+            "node": {"type": "keyword"},
+        }},
+    }
+
+
+def _iso_utc(ts: float | None = None) -> str:
+    t = time.time() if ts is None else ts
+    ms = int(t * 1000) % 1000
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + f".{ms:03d}Z"
+
+
+# ---------------------------------------------------------------------------
+# dotted paths + cron
+# ---------------------------------------------------------------------------
+
+def resolve_path(obj, path: str):
+    """Dotted-path lookup where KEYS may themselves contain dots
+    ('histograms.es.rest.request.ms.p99' must find the single key
+    'es.rest.request.ms'): at each dict hop try the LONGEST joinable
+    prefix first and backtrack. Integer parts index into lists."""
+    parts = [p for p in path.split(".") if p != ""]
+
+    def rec(cur, i):
+        if i == len(parts):
+            return cur
+        if isinstance(cur, list):
+            try:
+                k = int(parts[i])
+            except ValueError:
+                return None
+            return rec(cur[k], i + 1) if 0 <= k < len(cur) else None
+        if not isinstance(cur, dict):
+            return None
+        for j in range(len(parts), i, -1):
+            key = ".".join(parts[i:j])
+            if key in cur:
+                got = rec(cur[key], j)
+                if got is not None:
+                    return got
+        return None
+
+    return rec(obj, 0)
+
+
+def _cron_field_matches(spec: str, value: int) -> bool:
+    for part in spec.split(","):
+        part = part.strip()
+        if part in ("*", "?"):
+            return True
+        if part.startswith("*/"):
+            step = int(part[2:])
+            if step > 0 and value % step == 0:
+                return True
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            if int(lo) <= value <= int(hi):
+                return True
+            continue
+        if part and int(part) == value:
+            return True
+    return False
+
+
+def cron_matches(expr: str, t: time.struct_time) -> bool:
+    """5-field cron subset (minute hour day-of-month month day-of-week;
+    each field `*`, `*/n`, `a`, `a,b`, `a-b`; dow 0=Sunday). Minute
+    granularity — the quartz second field is not supported."""
+    fields = expr.split()
+    if len(fields) != 5:
+        raise IllegalArgumentError(f"invalid cron expression [{expr}]")
+    dow = (t.tm_wday + 1) % 7  # python Monday=0 -> cron Sunday=0
+    values = (t.tm_min, t.tm_hour, t.tm_mday, t.tm_mon, dow)
+    try:
+        return all(_cron_field_matches(f, v) for f, v in zip(fields, values))
+    except ValueError:
+        raise IllegalArgumentError(f"invalid cron expression [{expr}]")
+
+
+def _validate_trigger(trigger) -> None:
+    if not isinstance(trigger, dict):
+        raise IllegalArgumentError("watch requires [trigger]")
+    sched = trigger.get("schedule")
+    if not isinstance(sched, dict):
+        return  # bare trigger accepted for compat; never due on its own
+    if "interval" in sched:
+        parse_duration_seconds(sched["interval"], 10.0)
+    elif "cron" in sched:
+        cron_matches(str(sched["cron"]), time.gmtime())
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+class WatcherService:
+    """Per-engine watch store + trigger evaluation + execution + export.
+
+    `exporter(index_name, docs)` is None on a single-process engine
+    (history/alert docs write the local engine directly); a cluster
+    gateway overrides it to POST bulks back through itself so the docs
+    replicate (cluster/http.attach_monitoring). `should_run()` gates
+    scheduled firing AND exports to one node (the elected master)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.exporter = None
+        self.should_run = None
+        self._pending: list[tuple[str, list[dict]]] = []
+        self._plock = threading.Lock()
+        self.counters = {
+            "executions": 0, "firings": 0, "throttles": 0, "acks": 0,
+            "errors": 0, "history_docs": 0, "alert_transitions": 0,
+        }
+
+    # -- config ------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        try:
+            return bool(self.engine.settings.get("xpack.watcher.enabled"))
+        except Exception:  # noqa: BLE001 - engines without the setting
+            return True
+
+    def runs_here(self) -> bool:
+        if self.should_run is None:
+            return True
+        try:
+            return bool(self.should_run())
+        except Exception:  # noqa: BLE001 - leadership unknown: stand down
+            return False
+
+    def _watches(self) -> dict:
+        return self.engine.meta.extras.setdefault("watches", {})
+
+    # -- CRUD --------------------------------------------------------------
+
+    def put(self, wid: str, body: dict) -> dict:
+        body = body or {}
+        _validate_trigger(body.get("trigger"))
+        watches = self._watches()
+        created = wid not in watches
+        prev = watches.get(wid) or {}
+        version = (prev.get("status") or {}).get("version", 0) + 1
+        now_ms = int(time.time() * 1000)
+        watch = {
+            "trigger": body["trigger"],
+            "input": body.get("input") or {},
+            "condition": body.get("condition") or {"always": {}},
+            "actions": body.get("actions") or {},
+            "metadata": body.get("metadata") or {},
+            "status": {
+                "version": version,
+                "state": {"active": True, "timestamp": _iso_utc()},
+                "alert": {"state": "ok", "since": now_ms},
+                "actions": {},
+                "last_checked": None,
+                "last_met_condition": None,
+                # a fresh watch waits ONE interval before its first
+                # scheduled firing (the reference schedules the next
+                # trigger from registration time) — firing at creation
+                # would race any manual _execute the creator runs next
+                "last_triggered_ms": now_ms,
+                "execution_state": None,
+            },
+        }
+        if body.get("throttle_period") is not None:
+            parse_duration_seconds(body["throttle_period"], 5.0)
+            watch["throttle_period"] = body["throttle_period"]
+        watches[wid] = watch
+        self.engine.meta.save()
+        return {"_id": wid, "created": created, "_version": version}
+
+    def _get(self, wid: str) -> dict:
+        w = self._watches().get(wid)
+        if w is None:
+            raise ResourceNotFoundError(f"watch [{wid}] not found")
+        return w
+
+    def get(self, wid: str) -> dict:
+        w = self._get(wid)
+        return {"_id": wid, "found": True, "watch": w, "status": w["status"]}
+
+    def delete(self, wid: str) -> dict:
+        ws = self._watches()
+        if wid not in ws:
+            raise ResourceNotFoundError(f"watch [{wid}] not found")
+        del ws[wid]
+        self.engine.meta.save()
+        return {"_id": wid, "found": True}
+
+    def ack(self, wid: str, action_id: str | None = None) -> dict:
+        """Acknowledge ackable actions: acked actions are skipped on
+        subsequent firings until the condition resolves (goes false),
+        which resets them — the reference's _ack semantics."""
+        w = self._get(wid)
+        acked = []
+        for name, ast in w["status"]["actions"].items():
+            if action_id not in (None, "_all") and name != action_id:
+                continue
+            if ast.get("ack", {}).get("state") == "ackable":
+                ast["ack"] = {"state": "acked", "timestamp": _iso_utc()}
+                acked.append(name)
+        if acked:
+            self.counters["acks"] += len(acked)
+            if w["status"]["alert"]["state"] == "firing":
+                self._alert_transition(wid, w, "acked",
+                                       reason="acknowledged by operator")
+        self.engine.meta.save()
+        return {"_id": wid, "status": w["status"], "acked": acked}
+
+    def activate(self, wid: str, active: bool = True) -> dict:
+        w = self._get(wid)
+        w["status"]["state"] = {"active": bool(active),
+                                "timestamp": _iso_utc()}
+        self.engine.meta.save()
+        return {"_id": wid, "status": w["status"]}
+
+    # -- scheduling ---------------------------------------------------------
+
+    def due(self, w: dict, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        sched = (w.get("trigger") or {}).get("schedule") or {}
+        last_ms = w["status"].get("last_triggered_ms") or 0
+        if "interval" in sched:
+            iv = parse_duration_seconds(sched["interval"], 10.0)
+            if iv is None:
+                return False  # "-1": disabled
+            return (now * 1000 - last_ms) >= iv * 1000
+        if "cron" in sched:
+            if not cron_matches(str(sched["cron"]), time.gmtime(now)):
+                return False
+            return int(now // 60) != int((last_ms / 1000) // 60)
+        return False
+
+    def run_scheduled(self, now: float | None = None) -> list[str]:
+        """One scheduler pass: execute every due, active watch. The
+        persistent-task executor calls this each tick."""
+        if not self.enabled or not self.runs_here():
+            return []
+        fired = []
+        for wid, w in list(self._watches().items()):
+            if not w["status"]["state"].get("active"):
+                continue
+            try:
+                if not self.due(w, now):
+                    continue
+                self.execute(wid, record=False, trigger_type="schedule")
+                fired.append(wid)
+            except Exception as e:  # noqa: BLE001 - one bad watch must not stop others
+                self.counters["errors"] += 1
+                log.debug("watch [%s] failed: %s", wid, e)
+        if fired:
+            self.engine.meta.save()
+        return fired
+
+    # -- inputs / conditions ------------------------------------------------
+
+    def _input_payload(self, w: dict) -> dict:
+        inp = w.get("input") or {}
+        if "search" in inp:
+            req = inp["search"].get("request") or {}
+            body = req.get("body") or {}
+            return self.engine.search_multi(
+                ",".join(req.get("indices", ["_all"])),
+                query=body.get("query"), size=int(body.get("size", 10)),
+                aggs=body.get("aggs") or body.get("aggregations"),
+                sort=body.get("sort"),
+            )
+        if "simple" in inp:
+            return dict(inp["simple"])
+        if "metrics" in inp:
+            snap = metrics.snapshot()
+            path = (inp["metrics"] or {}).get("path")
+            if path:
+                return {"value": resolve_path(snap, path)}
+            return snap
+        if "monitoring" in inp:
+            req = inp["monitoring"] or {}
+            body = req.get("body") or {}
+            return self.engine.search_multi(
+                req.get("indices", ".monitoring-es-8-*"),
+                query=body.get("query"), size=int(body.get("size", 0)),
+                aggs=body.get("aggs") or body.get("aggregations"),
+                sort=body.get("sort"),
+            )
+        if "slo" in inp:
+            return self.engine.slo.evaluate()
+        return {}
+
+    @staticmethod
+    def _condition_met(cond: dict, ctx: dict) -> bool:
+        if "never" in cond:
+            return False
+        if "compare" in cond:
+            (path, op_spec), = cond["compare"].items()
+            (op, want), = op_spec.items()
+            got = resolve_path(ctx, path.removeprefix("ctx."))
+            if got is None:
+                return False
+            try:
+                return {
+                    "eq": got == want, "not_eq": got != want,
+                    "gt": got > want, "gte": got >= want,
+                    "lt": got < want, "lte": got <= want,
+                }.get(op, False)
+            except TypeError:
+                return False
+        return True  # always (the default)
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, wid: str, record: bool = True,
+                trigger_type: str = "manual") -> dict:
+        w = self._get(wid)
+        now = time.time()
+        now_ms = int(now * 1000)
+        status = w["status"]
+        status["last_triggered_ms"] = now_ms
+        status["last_checked"] = _iso_utc(now)
+        payload = self._input_payload(w)
+        ctx = {"payload": payload}
+        met = self._condition_met(w.get("condition") or {}, ctx)
+        executed: list[str] = []
+        throttled: list[dict] = []
+        action_results: list[dict] = []
+        if met:
+            status["last_met_condition"] = _iso_utc(now)
+            for aname, aspec in (w.get("actions") or {}).items():
+                ast = status["actions"].setdefault(aname, {
+                    "ack": {"state": "awaits_successful_execution"}})
+                if ast["ack"].get("state") == "acked":
+                    throttled.append({"id": aname, "reason": "acked"})
+                    action_results.append({"id": aname, "status": "acked"})
+                    continue
+                tp = (aspec.get("throttle_period")
+                      or w.get("throttle_period") or DEFAULT_THROTTLE)
+                tps = parse_duration_seconds(tp, 5.0) or 0.0
+                last_ok = ast.get("last_successful_execution_ms") or 0
+                if tps > 0 and (now_ms - last_ok) < tps * 1000:
+                    ast["last_throttle"] = {
+                        "timestamp": _iso_utc(now),
+                        "reason": f"throttled for [{tp}]"}
+                    self.counters["throttles"] += 1
+                    throttled.append({"id": aname, "reason": "throttle_period"})
+                    action_results.append({"id": aname, "status": "throttled"})
+                    continue
+                ok, detail = self._run_action(wid, aname, aspec, payload, now)
+                ast["last_execution"] = {"timestamp": _iso_utc(now),
+                                         "successful": ok}
+                if ok:
+                    ast["last_successful_execution_ms"] = now_ms
+                    if ast["ack"]["state"] == "awaits_successful_execution":
+                        ast["ack"]["state"] = "ackable"
+                    executed.append(aname)
+                action_results.append({
+                    "id": aname,
+                    "status": "executed" if ok else "failure", **detail})
+            new_alert = ("acked" if status["alert"]["state"] == "acked"
+                         else "firing")
+        else:
+            # condition resolved: acked actions re-arm (reference behavior:
+            # AckThrottler resets when the condition goes false)
+            for ast in status["actions"].values():
+                ast["ack"] = {"state": "awaits_successful_execution"}
+            new_alert = "ok"
+        state = ("execution_not_needed" if not met
+                 else "throttled" if throttled and not executed
+                 else "executed")
+        status["execution_state"] = state
+        if new_alert != status["alert"]["state"]:
+            self._alert_transition(wid, w, new_alert, now=now)
+        self.counters["executions"] += 1
+        if met:
+            self.counters["firings"] += 1
+        metrics.counter_inc("es.watcher.executions")
+        history = {
+            "_id": f"{wid}_{now_ms}_{self.counters['executions']}",
+            "watch_id": wid,
+            "@timestamp": _iso_utc(now),
+            "node": getattr(self.engine.tasks, "node", None),
+            "trigger_event": {"type": trigger_type,
+                              "triggered_time": _iso_utc(now)},
+            "state": state,
+            "condition_met": met,
+            "actions": action_results,
+            "alert_state": status["alert"]["state"],
+        }
+        self._export(history_index_name(now), [history])
+        self.counters["history_docs"] += 1
+        if record:
+            self.engine.meta.save()
+        return {
+            "_id": wid,
+            "watch_record": {
+                "watch_id": wid,
+                "state": ("executed" if met else "execution_not_needed"),
+                "condition_met": met,
+                "actions_executed": executed,
+                "actions_throttled": throttled,
+                "alert_state": status["alert"]["state"],
+            },
+        }
+
+    def _run_action(self, wid, aname, aspec, payload, now) -> tuple[bool, dict]:
+        try:
+            if "index" in aspec:
+                target = aspec["index"]["index"]
+                doc = {"watch_id": wid, "result": payload,
+                       "timestamp": int(now * 1000)}
+                self.engine.get_or_autocreate(target).index_doc(None, doc)
+                return True, {"type": "index", "index": target}
+            if "logging" in aspec:
+                text = aspec["logging"].get("text", "")
+                self.engine.meta.extras.setdefault(
+                    "watcher_log", {}).setdefault(wid, []).append(text)
+                return True, {"type": "logging"}
+            if "webhook" in aspec:
+                # stub: the request is RECORDED, never sent — an engine
+                # test suite must not open sockets to operator URLs
+                spec = aspec["webhook"]
+                metrics.counter_inc("es.watcher.webhook_stubs")
+                return True, {"type": "webhook", "stubbed": True,
+                              "request": {
+                                  "method": spec.get("method", "POST"),
+                                  "url": spec.get("url", ""),
+                              }}
+            return True, {"type": "noop"}
+        except Exception as e:  # noqa: BLE001 - a failing action is recorded, not raised
+            self.counters["errors"] += 1
+            return False, {"type": "error", "reason": f"{type(e).__name__}: {e}"}
+
+    def _alert_transition(self, wid, w, new_state, reason=None,
+                          now: float | None = None) -> None:
+        """Advance the per-watch alert state machine and upsert the ONE
+        alert document for this watch (doc id == watch id): transitions,
+        not firings, write — a watch firing every tick costs one doc."""
+        now = time.time() if now is None else now
+        w["status"]["alert"] = {"state": new_state, "since": int(now * 1000)}
+        self.counters["alert_transitions"] += 1
+        metrics.counter_inc("es.watcher.alert_transitions")
+        self._export(ALERTS_INDEX, [{
+            "_id": wid,
+            "watch_id": wid,
+            "status": new_state,
+            "state": new_state,
+            "since": int(now * 1000),
+            "@timestamp": _iso_utc(now),
+            "node": getattr(self.engine.tasks, "node", None),
+            "reason": reason or f"watch [{wid}] is {new_state}",
+            "metadata": w.get("metadata") or {},
+        }])
+
+    # -- export -------------------------------------------------------------
+
+    def _export(self, index_name: str, docs: list[dict]) -> None:
+        if not self.runs_here():
+            return
+        if self.exporter is not None:
+            with self._plock:
+                self._pending.append((index_name, docs))
+        else:
+            self._write_local(index_name, docs)
+
+    def flush_exports(self) -> None:
+        """Drain queued exports through the gateway exporter. Runs on the
+        ticker thread OUTSIDE the engine-worker serialization (a gateway
+        post needs the worker to apply the replicated op)."""
+        with self._plock:
+            pending, self._pending = self._pending, []
+        for index_name, docs in pending:
+            try:
+                self.exporter(index_name, docs)
+            except Exception as e:  # noqa: BLE001 - export failure must not kill the ticker
+                self.counters["errors"] += 1
+                log.debug("watcher export to [%s] failed: %s", index_name, e)
+
+    def _write_local(self, index_name: str, docs: list[dict]) -> None:
+        eng = self.engine
+        if index_name not in eng.indices:
+            body = watcher_index_body()
+            eng.create_index(index_name, mappings=body["mappings"],
+                             settings=dict(body["settings"]["index"]))
+        idx = eng.indices[index_name]
+        for doc in docs:
+            doc = dict(doc)
+            did = doc.pop("_id", None)
+            idx.index_doc(did, doc)
+        idx.refresh()
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        ticker = self.engine.persistent.ticker_stats()
+        watches = self._watches()
+        started = self.enabled and ticker["running"]
+        return {
+            "watcher_state": "started" if started else "stopped",
+            "watch_count": len(watches),
+            "inactive_watches": sum(
+                1 for w in watches.values()
+                if not w["status"]["state"].get("active")),
+            "firing_watches": sorted(
+                wid for wid, w in watches.items()
+                if w["status"]["alert"]["state"] == "firing"),
+            "execution_thread_pool": {
+                "queue_size": len(self._pending), "largest": 1},
+            "counters": dict(self.counters),
+            "ticker": ticker,
+            "runs_here": self.runs_here(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# persistent-task executor + bootstrap
+# ---------------------------------------------------------------------------
+
+class WatcherExecutor:
+    """Persistent-task executor: each scheduler tick fires every DUE
+    watch (the watch's own interval/cron gates firing; the tick is only
+    the clock). Riding tasks/persistent.py means the watcher-driver task
+    survives restart/failover like the ML tick."""
+
+    def tick(self, engine, task):
+        fired = engine.watcher.run_scheduled()
+        task["state"]["last_tick_ms"] = int(time.time() * 1000)
+        if fired:
+            task["state"]["last_fired"] = fired
+
+
+def ensure_executor(engine) -> None:
+    """Idempotently start the scheduled-alerting loop: executor
+    registered, watcher-driver persistent task started, ticker thread
+    running, SLO prebuilt watch materialized."""
+    svc = engine.watcher  # builds the service + registers the executor
+    if "watcher-driver" not in engine.meta.persistent_tasks:
+        engine.persistent.start("watcher-driver", "watcher", {})
+    try:
+        engine.slo.ensure_prebuilt_watch()
+    except Exception as e:  # noqa: BLE001 - the SLO watch is best-effort
+        log.debug("slo prebuilt watch setup failed: %s", e)
+    if svc.enabled:
+        engine.persistent.start_ticker()

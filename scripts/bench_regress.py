@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Bench-regression lint: compare the two newest BENCH_r*.json records.
+
+The bench records carry, per config, QPS, latency percentiles and the
+per-kernel device-utilization attribution (mfu / bw_util from the PR-5
+cost model). This script diffs the newest record against the previous
+one, metric path by metric path, and exits nonzero when any comparable
+metric regressed by more than --threshold (default 20%):
+
+- higher-is-better: `qps`, per-kernel `mfu` / `bw_util` (under a
+  `device_utilization` section) — regression = new < (1 - t) * old;
+- lower-is-better: `p50_ms` / `p90_ms` / `p99_ms` — regression =
+  new > (1 + t) * old.
+
+Only paths present in BOTH records compare (configs/arms come and go
+between rounds). CPU-smoke records (device_kind == "cpu") are ADVISORY:
+BENCH_NOTES documents host-bound CPU numbers as illustrative, not
+criteria — regressions are printed but the exit stays 0 unless --force.
+On a TPU record the MFU floors become machine-checked invariants, the
+same contract the SLO engine (slo.kernel.floors) enforces at runtime.
+
+Wired into scripts/tier1_gate.sh when two or more records exist.
+
+    python scripts/bench_regress.py [--dir .] [--threshold 0.2] [--force]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_LOWER_BETTER = {"p50_ms", "p90_ms", "p99_ms"}
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_records(directory: str) -> list[tuple[int, str]]:
+    out = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def metric_leaves(obj, path=()):
+    """-> {dotted_path: float} for every comparable metric leaf."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)):
+                out.update(metric_leaves(v, path + (k,)))
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if k == "qps" or k in _LOWER_BETTER:
+                out[".".join(path + (k,))] = float(v)
+            elif k in ("mfu", "bw_util") and "device_utilization" in path:
+                out[".".join(path + (k,))] = float(v)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(metric_leaves(v, path + (str(i),)))
+    return out
+
+
+def device_kinds(obj) -> set:
+    kinds = set()
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if k == "device_kind" and isinstance(v, str):
+                kinds.add(v)
+            else:
+                kinds |= device_kinds(v)
+    elif isinstance(obj, list):
+        for v in obj:
+            kinds |= device_kinds(v)
+    return kinds
+
+
+def compare(prev: dict, latest: dict, threshold: float):
+    """-> (regressions, improvements, compared_count)."""
+    a = metric_leaves(prev.get("extras", prev))
+    b = metric_leaves(latest.get("extras", latest))
+    regressions, improvements = [], []
+    compared = 0
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:  # zero/degenerate baselines cannot regress
+            continue
+        compared += 1
+        leaf = path.rsplit(".", 1)[-1]
+        if leaf in _LOWER_BETTER:
+            ratio = new / old
+            entry = (path, old, new, ratio)
+            if ratio > 1.0 + threshold:
+                regressions.append(entry)
+            elif ratio < 1.0 - threshold:
+                improvements.append(entry)
+        else:
+            ratio = new / old
+            entry = (path, old, new, ratio)
+            if ratio < 1.0 - threshold:
+                regressions.append(entry)
+            elif ratio > 1.0 + threshold:
+                improvements.append(entry)
+    return regressions, improvements, compared
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.6g}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative regression threshold (default 0.2)")
+    ap.add_argument("--force", action="store_true",
+                    help="enforce even for CPU-smoke records")
+    args = ap.parse_args(argv)
+    records = find_records(args.dir)
+    if len(records) < 2:
+        print(f"[bench-regress] {len(records)} record(s) in {args.dir} — "
+              "need two to compare; nothing to do")
+        return 0
+    (prev_round, prev_path), (cur_round, cur_path) = records[-2], records[-1]
+    with open(prev_path, encoding="utf-8") as fh:
+        prev = json.load(fh)
+    with open(cur_path, encoding="utf-8") as fh:
+        latest = json.load(fh)
+    regressions, improvements, compared = compare(
+        prev, latest, args.threshold)
+    kinds = device_kinds(prev) | device_kinds(latest)
+    advisory = not args.force and (not kinds or kinds == {"cpu"})
+    print(f"[bench-regress] r{cur_round:02d} vs r{prev_round:02d}: "
+          f"{compared} comparable metrics, {len(regressions)} regressed "
+          f"beyond {args.threshold:.0%}, {len(improvements)} improved "
+          f"(device kinds: {sorted(kinds) or ['unknown']})")
+    for path, old, new, ratio in regressions:
+        print(f"  REGRESSED {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x)")
+    for path, old, new, ratio in improvements[:10]:
+        print(f"  improved  {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x)")
+    if regressions and advisory:
+        print("[bench-regress] ADVISORY: all records are CPU smokes "
+              "(host-bound, non-criteria per BENCH_NOTES) — not failing; "
+              "rerun with --force to enforce")
+        return 0
+    if regressions:
+        print("[bench-regress] FAIL: regression(s) beyond threshold")
+        return 1
+    print("[bench-regress] ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,4 +30,14 @@ else
     JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
         "${COMMON[@]}" -p no:randomly --shuffle-modules "${SEED}" || exit 1
 fi
+
+# bench-regression lint (PR 9): when two or more BENCH_r*.json records
+# exist, diff the newest pair per config (QPS, latency pcts, per-kernel
+# mfu/bw_util) and fail on >20% regression. CPU-smoke records are
+# advisory inside bench_regress itself (host-bound numbers are
+# non-criteria per BENCH_NOTES); TPU records enforce.
+if [ "$(ls BENCH_r*.json 2>/dev/null | wc -l)" -ge 2 ]; then
+    echo "[tier1-gate] bench-regression lint"
+    python scripts/bench_regress.py || exit 1
+fi
 echo "[tier1-gate] both orders green (seed=${SEED})"

@@ -20,7 +20,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+# running as `python scripts/usage_report.py` puts scripts/ (not the
+# repo root) on sys.path; --data mode imports the engine package
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 
 
 _WINDOW_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
@@ -52,16 +58,23 @@ def _search_body(window: str) -> dict:
     }
 
 
-def _fetch_url(url: str, window: str) -> list[dict]:
+def _query_url(url: str, index: str, body: dict) -> list[dict]:
+    import urllib.error
     import urllib.request
 
-    body = json.dumps(_search_body(window)).encode()
     req = urllib.request.Request(
-        f"{url.rstrip('/')}/.monitoring-es-*/_search", data=body,
+        f"{url.rstrip('/')}/{index}/_search", data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=30.0) as r:
-        res = json.loads(r.read())
+    try:
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            res = json.loads(r.read())
+    except urllib.error.HTTPError:
+        return []
     return [h["_source"] for h in res.get("hits", {}).get("hits", [])]
+
+
+def _fetch_url(url: str, window: str) -> list[dict]:
+    return _query_url(url, ".monitoring-es-*", _search_body(window))
 
 
 def _fetch_data_dir(path: str, window: str) -> list[dict]:
@@ -74,6 +87,21 @@ def _fetch_data_dir(path: str, window: str) -> list[dict]:
             ".monitoring-es-*", query=body["query"], size=body["size"],
             sort=body["sort"])
         return [h["_source"] for h in res.get("hits", {}).get("hits", [])]
+    finally:
+        eng.close()
+
+
+def _query_data_dir(path: str, index: str, body: dict) -> list[dict]:
+    from elasticsearch_tpu.engine import Engine
+
+    eng = Engine(path)
+    try:
+        res = eng.search_multi(
+            index, query=body.get("query"), size=body.get("size", 100),
+            sort=body.get("sort"), allow_no_indices=True)
+        return [h["_source"] for h in res.get("hits", {}).get("hits", [])]
+    except Exception:  # noqa: BLE001 - indices absent: empty section
+        return []
     finally:
         eng.close()
 
@@ -137,6 +165,69 @@ def render(per_node: dict[str, dict], out=None) -> None:
         print(file=out)
 
 
+def slo_alert_summary(docs: list[dict], alerts: list[dict],
+                      history: list[dict]) -> dict:
+    """SLO compliance over the window (per-node fraction of node_stats
+    samples whose slo section was compliant), plus the currently-firing
+    alert docs from `.alerts-default` and recent `.watcher-history-*`
+    execution counts (PR 9's closed loop, read back from its own
+    indices)."""
+    per_node: dict[str, dict] = {}
+    for d in docs:
+        node = d.get("node")
+        slo = (d.get("node_stats") or {}).get("slo") or {}
+        if not node or "compliant" not in slo:
+            continue
+        agg = per_node.setdefault(node, {"samples": 0, "compliant": 0,
+                                         "breached": set()})
+        agg["samples"] += 1
+        agg["compliant"] += 1 if slo.get("compliant") else 0
+        for oid in (slo.get("breached") or "").split(","):
+            if oid:
+                agg["breached"].add(oid)
+    compliance = {
+        node: {
+            "samples": a["samples"],
+            "compliance_pct": round(100.0 * a["compliant"] / a["samples"], 1),
+            "breached_objectives": sorted(a["breached"]),
+        } for node, a in per_node.items() if a["samples"]
+    }
+    firing = [a for a in alerts if a.get("state") == "firing"]
+    executions: dict[str, int] = {}
+    for h in history:
+        wid = h.get("watch_id")
+        if wid:
+            executions[wid] = executions.get(wid, 0) + 1
+    return {"compliance": compliance, "firing_alerts": firing,
+            "watch_executions": executions}
+
+
+def render_slo(summary: dict, out=None) -> None:
+    out = out or sys.stdout
+    print("slo / alerting", file=out)
+    if not summary["compliance"]:
+        print("  (no slo samples in the window)", file=out)
+    for node in sorted(summary["compliance"]):
+        c = summary["compliance"][node]
+        line = (f"  {node}: {c['compliance_pct']}% compliant over "
+                f"{c['samples']} samples")
+        if c["breached_objectives"]:
+            line += f"  breached={c['breached_objectives']}"
+        print(line, file=out)
+    firing = summary["firing_alerts"]
+    if firing:
+        for a in firing:
+            print(f"  FIRING: watch [{a.get('watch_id')}] since "
+                  f"{a.get('@timestamp')} — {a.get('reason')}", file=out)
+    else:
+        print("  no alerts currently firing", file=out)
+    if summary["watch_executions"]:
+        per = ", ".join(f"{w}={n}" for w, n in
+                        sorted(summary["watch_executions"].items()))
+        print(f"  watch executions in window: {per}", file=out)
+    print(file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--url", help="running node, e.g. http://127.0.0.1:9200")
@@ -151,10 +242,24 @@ def main(argv=None) -> int:
     docs = (_fetch_url(args.url, args.window) if args.url
             else _fetch_data_dir(args.data, args.window))
     per_node = latest_per_node(docs)
+    alerts_body = {"size": 100, "query": {"match_all": {}}}
+    window_range = _search_body(args.window)["query"]["bool"]["filter"][1]
+    hist_body = {"size": 500, "query": window_range}
+    if args.url:
+        alerts = _query_url(args.url, ".alerts-default", alerts_body)
+        history = _query_url(args.url, ".watcher-history-8-*", hist_body)
+    else:
+        alerts = _query_data_dir(args.data, ".alerts-default", alerts_body)
+        history = _query_data_dir(args.data, ".watcher-history-8-*",
+                                  hist_body)
+    summary = slo_alert_summary(docs, alerts, history)
     if args.json:
-        print(json.dumps(per_node, indent=2, default=str))
+        print(json.dumps({"per_node": per_node, "slo": {
+            **summary,
+        }}, indent=2, default=str))
     else:
         render(per_node)
+        render_slo(summary)
     return 0
 
 

@@ -124,6 +124,12 @@ def _module_hygiene():
     from elasticsearch_tpu import serving as _serving
 
     _serving.reset_all_for_tests()
+    # likewise the persistent-task tickers (scheduled watches, PR 9):
+    # a leaked ticker thread would keep firing watches into the next
+    # module's engines and race the metrics reset below
+    from elasticsearch_tpu.tasks import persistent as _persistent
+
+    _persistent.stop_all_tickers_for_tests()
     from elasticsearch_tpu.cache import request_cache
 
     request_cache().lru.clear()

@@ -231,6 +231,34 @@ def test_sharded_msearch_impact_parity(monkeypatch):
                       initial=0.0) <= 1e-4
 
 
+def test_sharded_impact_engages_with_request_cache_disabled(monkeypatch):
+    """Regression (PR 9's shuffled cache-off gate caught it): the
+    UNCACHED msearch fall-through must route the same arm priority as
+    the cached path — disabling the request cache used to skip straight
+    to the exact arm, silently disengaging the impact tier."""
+    docs, rng = _corpus(n_docs=600, seed=17)
+    queries = [[(f"t{rng.integers(0, 250)}", 1.0)] for _ in range(4)]
+    monkeypatch.setenv("ES_TPU_IMPACT", "force")
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    ss = StackedSearcher(build_stacked_pack(docs, MAPPING, 3))
+    with collect_profile_events() as events:
+        v1, sh1, d1, t1 = msearch_sharded(ss, "body", queries, 8)
+    assert any(e.get("kernel") == "sharded.impact_disjunction"
+               for e in events), events
+    # ...and the uncached impact rows match the exact arm at rank parity
+    monkeypatch.setenv("ES_TPU_IMPACT", "0")
+    v2, sh2, d2, t2 = msearch_sharded(
+        StackedSearcher(build_stacked_pack(docs, MAPPING, 3)),
+        "body", queries, 8)
+    np.testing.assert_array_equal(t1, t2)
+    mism = (d1 != d2) | (sh1 != sh2)
+    assert np.abs(np.where(np.isfinite(v1), v1, 0)
+                  - np.where(np.isfinite(v2), v2, 0))[mism].max(
+                      initial=0.0) <= 1e-4
+
+
 def test_tail_tier_visible_after_incremental_refresh(monkeypatch):
     """Docs written after the last build ride the exact tail tier merged
     at the coordinator — no merge required, results equal the exact path,

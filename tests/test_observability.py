@@ -97,17 +97,26 @@ _PROM_LINE = re.compile(
 def _parse_prometheus(text):
     """Hand-rolled text-format 0.0.4 parser with the semantics
     prometheus_client enforces: every non-comment line is
-    `name[{labels}] value`, TYPE declarations precede their samples,
-    histogram buckets are cumulative and end at +Inf == _count."""
+    `name[{labels}] value`, HELP then TYPE declarations precede their
+    samples, histogram buckets are cumulative and end at
+    +Inf == _count."""
     types = {}
+    helps = {}
     samples = []
     for line in text.splitlines():
         if not line.strip():
             continue
         if line.startswith("#"):
-            parts = line.split()
-            assert parts[1] == "TYPE", line
-            types[parts[2]] = parts[3]
+            parts = line.split(None, 3)
+            assert parts[1] in ("TYPE", "HELP"), line
+            if parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+                # prometheus_client emits HELP before TYPE per family
+                assert parts[2] in helps, f"TYPE before HELP: {line!r}"
+            else:
+                assert len(parts) == 4 and parts[3].strip(), (
+                    f"HELP without text: {line!r}")
+                helps[parts[2]] = parts[3]
             continue
         mo = _PROM_LINE.match(line)
         assert mo, f"unparseable exposition line: {line!r}"
