@@ -977,7 +977,13 @@ def config5_8shard(rng):
     import hashlib as _hl
 
     cache_root = os.environ.get("ES_BENCH_C5_CACHE", "/tmp/es_bench_c5")
-    cache_key = f"{S}x{n_per}v{VOCAB}l{DOC_LEN_MEAN}s4242"
+    # the cache key carries the pack-LAYOUT token: any pack-format/schema
+    # change (new component, renamed array, FORMAT bump) changes the
+    # token, so a stale cached corpus can never silently feed the record
+    from elasticsearch_tpu.index.packio import pack_layout_token
+
+    cache_key = (f"{S}x{n_per}v{VOCAB}l{DOC_LEN_MEAN}s4242-"
+                 f"{pack_layout_token()}")
     for s in range(S):
         lo, hi = s * n_per, (s + 1) * n_per
         # shard packs are a pure function of the deterministic corpus:
@@ -1113,6 +1119,33 @@ def config5_8shard(rng):
         round(q_n / (serial_s / S) * (1.0 - frac), 1)
         if frac is not None else None
     )
+    # the C5/MULTICHIP record criteria (ROADMAP item 5): the mesh
+    # projection against BOTH alternatives, with the merge measured
+    # ON-DEVICE (sharded.global_merge / the pjit all-gather program) and
+    # byte/rank parity asserted between the pjit, shard_map and
+    # single-device paths inside the probe
+    record = {
+        "mesh_projected_qps": projected,
+        "vs_single_chip_serial": (round(projected / max(qps_serial, 1e-9), 2)
+                                  if projected else None),
+        "vs_8m_cpu_model": (round(projected / max(baseline_qps, 1e-9), 2)
+                            if projected else None),
+        "merge_frac_on_device": frac,
+        "merge_measured_on_device": probe_r.get("t_device_merge_ms")
+        is not None,
+        "parity": probe_r.get("parity"),
+        "allgather": probe_r.get("allgather"),
+        "landed": bool(projected is not None
+                       and projected > qps_serial
+                       and projected > baseline_qps),
+        "basis": "mesh = measured mean-shard rate x S x (1 - merge_frac); "
+                 "merge_frac = on-device global merge vs shard-local "
+                 "compute on the 8-device virtual mesh. On a CPU smoke "
+                 "the shard rate is host-bound, so vs_8m_cpu_model is a "
+                 "TPU criterion (BENCH_NOTES r14); vs_single_chip_serial "
+                 "holds on any platform (S-way concurrency minus the "
+                 "measured merge fraction).",
+    }
     return {
         "corpus_docs": S * n_per,
         "shards": S,
@@ -1127,6 +1160,7 @@ def config5_8shard(rng):
             "bench.c5.shard_batch_ms",
             [x * 1e3 for times in shard_times for x in times]),
         "mesh_probe": probe_r,
+        "record": record,
         "projection": {
             "formula": "q_n / mean_shard_batch_time * (1 - merge_frac)",
             "projected_qps_v5e8": projected,
@@ -1134,8 +1168,9 @@ def config5_8shard(rng):
                             if projected else None),
             "basis": "each chip holds one resident 1M-doc shard and runs "
                      "the measured single-chip rate; merge fraction from "
-                     "the 8-device virtual-mesh probe; per-shard "
-                     "build/upload excluded (one-time residency)",
+                     "the 8-device virtual-mesh probe's ON-DEVICE global "
+                     "merge; per-shard build/upload excluded (one-time "
+                     "residency)",
         },
     }
 

@@ -1268,7 +1268,9 @@ class EsIndex:
                "lanes": [], "tiered": None,
                "t0": time.monotonic(),
                "meta": {"wave_size": n, "term_packed": 0, "term_waves": []}}
-        with TRACER.span("servingWaveDispatch", index=self.name, entries=n):
+        with TRACER.span("servingWaveDispatch", index=self.name, entries=n,
+                         spmd=getattr(self._searcher, "_exec", "vmap")
+                         if self._searcher is not None else "vmap"):
             self._maybe_refresh()
             kinds = [None] * n
             for i, e in enumerate(entries):

@@ -29,6 +29,20 @@ from .pack import DocValuesColumn, ShardPack, VectorColumn
 
 FORMAT = 2
 
+
+def pack_layout_token() -> str:
+    """Short digest of the pack's serialized layout: FORMAT plus the
+    component-array inventory. Any pack-format/schema change (a new
+    component, a renamed array, a FORMAT bump) changes the token, so
+    caches of SERIALIZED packs keyed on it (bench.py's C5 corpus cache,
+    ES_BENCH_C5_CACHE) can never silently feed a stale layout to a
+    record run — the cache simply misses and rebuilds."""
+    import hashlib
+
+    basis = json.dumps({"format": FORMAT, "arrays": _ARRAYS},
+                       sort_keys=True).encode()
+    return hashlib.sha256(basis).hexdigest()[:12]
+
 # top-level ndarray fields serialized as one component blob each.
 # impact_codes/impact_ubf (the BM25S impact tier, PR 8) are OPTIONAL
 # components: manifests written before the tier existed simply lack the

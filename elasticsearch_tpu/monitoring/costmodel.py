@@ -55,6 +55,38 @@ DEVICE_PEAKS: list[tuple[str, float, float]] = [
 CPU_PEAK_FLOPS = 3.2e12
 CPU_PEAK_BW = 100e9
 
+# device_kind substring -> peak per-chip ICI (interchip interconnect)
+# bytes/s — the denominator for the collective kernels' traffic
+# (sharded.allgather_topk / sharded.global_merge, PR 10). Public
+# spec-sheet aggregates (links x per-link rate, both directions summed
+# the way the HBM number is); first match wins.
+DEVICE_ICI_PEAKS: list[tuple[str, float]] = [
+    ("v6e", 448e9),    # Trillium: 4 x 896 Gbps
+    ("v5p", 600e9),    # 6 x 800 Gbps
+    ("v5e", 200e9),    # 4 x 400 Gbps
+    ("v5", 200e9),
+    ("v4", 300e9),     # 6 x 400 Gbps
+    ("v3", 162e9),
+    ("v2", 62e9),
+]
+
+# virtual CPU meshes move "collectives" through memcpy; nominal only
+CPU_PEAK_ICI = 50e9
+
+
+def ici_peak() -> float:
+    """-> peak ICI bytes/s of the resident device kind (ES_TPU_PEAK_ICI
+    overrides; CPU/virtual meshes get the nominal memcpy figure)."""
+    env = os.environ.get("ES_TPU_PEAK_ICI")
+    if env:
+        return float(env)
+    _f, _b, kind = device_peaks()
+    lk = kind.lower().replace(" ", "")
+    for pat, bw in DEVICE_ICI_PEAKS:
+        if pat in lk:
+            return bw
+    return CPU_PEAK_ICI
+
 _peaks_cache: tuple[float, float, str] | None = None
 
 
@@ -358,6 +390,51 @@ def _ann_rescore(fields: dict) -> dict | None:
     return ann_rescore_cost(b, kb, d)
 
 
+def allgather_merge_cost(s: int, q: int, k: int, *,
+                         id_bytes: int = 8) -> dict:
+    """The on-device coordinator merge (PR 10): every shard's [q, k]
+    (score f32, id i64) rows all-gather across the s mesh devices, then
+    one lax.top_k over the [q, s*k] gathered field. ici_bytes is the
+    total row volume crossing the interconnect once (s*q*k rows of
+    4+id_bytes B — BENCH_NOTES round 14); HBM bytes are the gathered
+    read + merged [q, k] write; 2 ops/element of selection."""
+    rows = float(s * q * k)
+    ici = rows * (4 + id_bytes)
+    return {
+        "flops": 2.0 * rows,
+        "bytes": ici + float(q * k * (4 + id_bytes + 4)),
+        "ici_bytes": ici,
+    }
+
+
+def _sharded_allgather_topk(fields: dict) -> dict | None:
+    """One pjit SPMD program: per-shard scan (impact gather or raw-BM25
+    disjunction, by tier) + the in-program all-gather top-k merge."""
+    s = fields.get("shards")
+    q, n = fields.get("queries"), fields.get("num_docs")
+    k = fields.get("k")
+    if not (s and q and n and k):
+        return None
+    if fields.get("tier") == "impact":
+        scan = _impact_sharded(fields)
+    else:
+        scan = _batched_disjunction(fields)
+    if scan is None:
+        scan = topk_scan_cost(q, n)
+    merge = allgather_merge_cost(int(s), int(q), int(k))
+    out = _merge(scan, merge)
+    out["ici_bytes"] = merge["ici_bytes"]
+    return out
+
+
+def _sharded_global_merge(fields: dict) -> dict | None:
+    """The standalone merge program (probe / out-of-program rows)."""
+    s, q, k = fields.get("shards"), fields.get("queries"), fields.get("k")
+    if not (s and q and k):
+        return None
+    return allgather_merge_cost(int(s), int(q), int(k))
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -370,6 +447,11 @@ KERNEL_COSTS: dict[str, object] = {
     "sharded.spmd_topk": _sharded_spmd,
     "sharded.exact_disjunction": _batched_disjunction,
     "sharded.fused_pipeline": _fused_pallas_scan,
+    # pjit GSPMD path (PR 10): the one-program scan + all-gather merge,
+    # and the standalone device merge — both carry an ici_bytes term
+    # judged against the ICI peak (ici_util)
+    "sharded.allgather_topk": _sharded_allgather_topk,
+    "sharded.global_merge": _sharded_global_merge,
     "sharded.wand_pass1": None,      # pruned postings subset: rows unknown
     "sharded.wand_pass2": None,      #   until finalize — wall time only
     # impact-scored sparse tier (BM25S, PR 8)
@@ -399,15 +481,22 @@ def kernel_cost(name: str, fields: dict) -> dict | None:
 
 
 def utilization(name: str, fields: dict, seconds: float) -> dict | None:
-    """-> {flops, bytes, mfu, bw_util} for one timed dispatch, or None."""
+    """-> {flops, bytes, mfu, bw_util[, ici_bytes, ici_util]} for one
+    timed dispatch, or None. Collective kernels (an ici_bytes term in
+    their cost) additionally report achieved ICI utilization against
+    the interconnect peak."""
     cost = kernel_cost(name, fields)
     if cost is None:
         return None
     peak_f, peak_b, _kind = device_peaks()
     sec = max(seconds, 1e-9)
-    return {
+    out = {
         "flops": cost["flops"],
         "bytes": cost["bytes"],
         "mfu": cost["flops"] / sec / peak_f,
         "bw_util": cost["bytes"] / sec / peak_b,
     }
+    if cost.get("ici_bytes"):
+        out["ici_bytes"] = cost["ici_bytes"]
+        out["ici_util"] = cost["ici_bytes"] / sec / ici_peak()
+    return out

@@ -101,6 +101,12 @@ def device_memory_snapshot() -> dict:
                 continue
         out["live_arrays"] = count
         out["live_bytes"] = int(live)
+        out["device_count"] = len(jax.devices())
+        from ..parallel.spmd import spmd_mode
+
+        # the slice execution model (PR 10): pjit = GSPMD sharded pack +
+        # on-device all-gather merge; shardmap = legacy per-shard bodies
+        out["spmd_mode"] = spmd_mode()
         stats = None
         try:
             stats = d.memory_stats()

@@ -70,7 +70,14 @@ def batch_term_disjunction(
     With `impact_w` ([Q, Ts] dequant weights) the sparse tail scores from
     the quantized impact tier (dev["impact_codes"]) instead of the raw
     tf/dl postings — a pure gather+multiply, no BM25 math; everything
-    downstream (candidate machinery, totals, merge order) is identical."""
+    downstream (candidate machinery, totals, merge order) is identical.
+
+    GSPMD contract (PR 10): this function is also the vmapped per-shard
+    body of the pjit sharded msearch program (`parallel/sharded.
+    _msearch_merged`), where XLA's SPMD partitioner must shard it over
+    the mesh — it must therefore stay pure XLA (no Pallas/custom calls,
+    which the partitioner cannot split; that is why the FUSED arm keeps
+    the shard_map fallback)."""
     Ts, B, k = plan_shapes
     live = dev["live"]
     n = num_docs

@@ -1,4 +1,14 @@
 from .stacked import StackedPack, build_stacked_pack
 from .sharded import StackedSearcher, make_mesh
+from .spmd import (
+    PACK_PARTITION_RULES,
+    match_partition_rules,
+    maybe_init_distributed,
+    spmd_mode,
+)
 
-__all__ = ["StackedPack", "build_stacked_pack", "StackedSearcher", "make_mesh"]
+__all__ = [
+    "StackedPack", "build_stacked_pack", "StackedSearcher", "make_mesh",
+    "PACK_PARTITION_RULES", "match_partition_rules",
+    "maybe_init_distributed", "spmd_mode",
+]

@@ -66,11 +66,12 @@ def _impact_codes_device(tfs, dls, k_base, k_slope, scale_inv, *,
 
 
 def make_mesh(num_shards: int) -> Mesh | None:
-    """Mesh over the first num_shards devices; None -> single-device vmap."""
-    devices = jax.devices()
-    if num_shards <= 1 or len(devices) < num_shards:
-        return None
-    return Mesh(np.array(devices[:num_shards]), ("shards",))
+    """Mesh over the first num_shards devices; None -> single-device vmap.
+    Delegates to parallel/spmd.make_mesh (which adds the pjit-mode
+    replica axis and the multi-process stretch wiring)."""
+    from .spmd import make_mesh as _mk
+
+    return _mk(num_shards)
 
 
 def _stack_shard_params(per_shard: list):
@@ -98,16 +99,31 @@ def _stack_shard_params(per_shard: list):
 
 
 def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
-    """[S, ...] arrays -> device, sharded over the mesh's shard axis."""
+    """[S, ...] arrays -> device as a SHARDED PYTREE.
+
+    The host tree is built first (numpy leaves), then every leaf ships
+    via `jax.device_put` with the NamedSharding produced by the
+    partition-rule table (spmd.match_partition_rules over leaf names) —
+    the GSPMD discipline SNIPPETS [1][2] apply to params pytrees. A pack
+    component whose name matches no rule is a hard error at upload, not
+    a silently replicated array. mesh=None keeps plain `jnp.asarray`."""
     from ..utils.jax_env import ensure_x64
 
     ensure_x64()
-    if mesh is not None:
-        def put(x):
-            spec = P("shards", *([None] * (np.ndim(x) - 1)))
-            return jax.device_put(x, NamedSharding(mesh, spec))
-    else:
-        put = jnp.asarray
+    host = _stacked_host_tree(sp)
+    if mesh is None:
+        import jax.tree_util as jtu
+
+        return jtu.tree_map(jnp.asarray, host)
+    from .spmd import shard_put
+
+    return shard_put(host, mesh)
+
+
+def _stacked_host_tree(sp: StackedPack) -> dict:
+    """The pack pytree with host (numpy) leaves — the input of the
+    partition-rule matching; leaf PATHS here are the rule vocabulary."""
+    put = np.asarray
     dev = {
         "post_docids": put(sp.post_docids),
         "post_tfs": put(sp.post_tfs),
@@ -199,8 +215,22 @@ class StackedSearcher:
     artifact of distributed nodes, and global stats are free here."""
 
     def __init__(self, stacked: StackedPack, mesh: Mesh | None = None):
+        from .spmd import spmd_mode
+
         self.sp = stacked
         self.mesh = mesh
+        # execution model, resolved at construction (ES_TPU_SPMD):
+        #   vmap     — no mesh: plain vmap over the stacked axis
+        #   pjit     — GSPMD: vmapped bodies over the sharded pack pytree,
+        #              with_sharding_constraint on hot intermediates, the
+        #              global merge on-device (ICI all-gather + lax.top_k)
+        #   shardmap — legacy per-shard shard_map bodies + host merge
+        self._exec = ("vmap" if mesh is None else spmd_mode())
+        if mesh is not None and "replicas" in mesh.axis_names \
+                and self._exec == "shardmap":
+            # the shard_map specs name only "shards"; a replica mesh is a
+            # pjit-mode construct
+            self._exec = "pjit"
         self.dev = stacked_to_device(stacked, mesh)
         self.ctx = ExecContext(
             num_docs=stacked.n_max,
@@ -346,7 +376,7 @@ class StackedSearcher:
         self.bump_epoch()
 
     def _compiled(self, node, key, k, agg_nodes, agg_key):
-        cache_key = (key, k, agg_key, self.mesh is None)
+        cache_key = (key, k, agg_key, self._exec)
         fn = self._cache.get(cache_key)
         if fn is not None:
             return fn
@@ -356,10 +386,15 @@ class StackedSearcher:
         # a shard can contribute at most n_max hits; the global k may exceed it
         k_local = min(k, n)
         k_global = min(k, S * k_local)
+        # inside one GSPMD program the selection tier is plain lax.top_k:
+        # the streamed Pallas scan is a custom call XLA's SPMD partitioner
+        # cannot shard (identical order contract either way)
+        force_xla = self._exec == "pjit"
 
         def shard_body(dev1, par1, agg_par1):
             scores, match = node.device_eval(dev1, par1, ctx)
-            ts, ti, tot = top_k_with_total(scores, match, dev1["live"], k_local)
+            ts, ti, tot = top_k_with_total(scores, match, dev1["live"],
+                                           k_local, force_xla=force_xla)
             agg_out = {}
             if agg_nodes:
                 ok = match[:n] & dev1["live"]
@@ -371,7 +406,7 @@ class StackedSearcher:
                     )
             return ts, ti, tot, agg_out
 
-        if self.mesh is not None:
+        if self._exec == "shardmap":
             import jax.tree_util as jtu
 
             def spmd(dev, params, agg_params):
@@ -389,18 +424,33 @@ class StackedSearcher:
 
             inner = spmd
         else:
+            from .spmd import constrain_shards
 
             def inner(dev, params, agg_params):
-                return jax.vmap(shard_body)(dev, params, agg_params)
+                # GSPMD: the vmapped per-shard body partitions over the
+                # mesh because the pack inputs are sharded; the constraint
+                # pins the [S, ...] outputs to stay shard-local until the
+                # merge below forces the all-gather
+                outs = jax.vmap(shard_body)(dev, params, agg_params)
+                return constrain_shards(outs, self.mesh)
 
         def run(dev, params, agg_params):
             ts, ti, tot, agg_out = inner(dev, params, agg_params)
             # global merge: flat index order = (score desc, shard asc,
-            # local rank asc) — Lucene TopDocs.merge order
+            # local rank asc) — Lucene TopDocs.merge order. In pjit mode
+            # the replication constraint IS the ICI all-gather of the
+            # per-shard (score, doc) rows; the merged result is
+            # replicated, so the host fetch pulls k rows, not S*k.
+            from .spmd import constrain
+
             flat = ts.reshape(-1)
+            flat_i = ti.reshape(-1)
+            if self._exec == "pjit":
+                flat = constrain(flat, self.mesh, P())
+                flat_i = constrain(flat_i, self.mesh, P())
             g_scores, g_idx = jax.lax.top_k(flat, k_global)
             g_shard = (g_idx // k_local).astype(jnp.int32)
-            g_doc = ti.reshape(-1)[g_idx]
+            g_doc = flat_i[g_idx]
             return g_scores, g_shard, g_doc, tot.sum(), agg_out
 
         fn = jax.jit(run)
@@ -541,7 +591,7 @@ class StackedSearcher:
         Groups = global ordinals of `fld`; docs missing the field share the
         null group. Per shard: scatter-max score per group + lowest-docid
         winner; global: max over shards per group, then top-k groups."""
-        cache_key = ("collapse", key, fld, k, self.mesh is None)
+        cache_key = ("collapse", key, fld, k, self._exec)
         fn = self._cache.get(cache_key)
         if fn is not None:
             return fn
@@ -578,7 +628,7 @@ class StackedSearcher:
             )
             return gmax, gdoc, total
 
-        if self.mesh is not None:
+        if self._exec == "shardmap":
             import jax.tree_util as jtu
 
             def inner(dev, params):
@@ -592,9 +642,11 @@ class StackedSearcher:
                     in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
                 )(dev, params)
         else:
+            from .spmd import constrain_shards
 
             def inner(dev, params):
-                return jax.vmap(shard_body)(dev, params)
+                return constrain_shards(jax.vmap(shard_body)(dev, params),
+                                        self.mesh)
 
         def run(dev, params):
             gmax, gdoc, tot = inner(dev, params)  # [S, V+1] x2, [S]
@@ -674,7 +726,7 @@ class StackedSearcher:
             per_shard.append(p)
             keys.append(k_)
         params = _stack_shard_params(per_shard)
-        cache_key = ("scores_at", tuple(keys), len(doc_ids), self.mesh is None)
+        cache_key = ("scores_at", tuple(keys), len(doc_ids), self._exec)
         fn = self._cache.get(cache_key)
         if fn is None:
             ctx = self.ctx
@@ -684,7 +736,7 @@ class StackedSearcher:
                 scores, match = node.device_eval(dev1, par1, ctx)
                 return scores[:n], match[:n] & dev1["live"]
 
-            if self.mesh is not None:
+            if self._exec == "shardmap":
                 import jax.tree_util as jtu
 
                 def inner(dev, params):
@@ -698,9 +750,11 @@ class StackedSearcher:
                         in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
                     )(dev, params)
             else:
+                from .spmd import constrain_shards
 
                 def inner(dev, params):
-                    return jax.vmap(shard_body)(dev, params)
+                    return constrain_shards(
+                        jax.vmap(shard_body)(dev, params), self.mesh)
 
             def run(dev, params, sh, di):
                 scores, match = inner(dev, params)  # [S, n]
@@ -1404,7 +1458,7 @@ class StackedSearcher:
     # -- field-sorted search ----------------------------------------------
 
     def _compiled_sorted(self, node, key_t, k, plan, has_after, agg_nodes, agg_key):
-        cache_key = ("sorted", key_t, k, plan.struct_key(), has_after, agg_key, self.mesh is None)
+        cache_key = ("sorted", key_t, k, plan.struct_key(), has_after, agg_key, self._exec)
         fn = self._cache.get(cache_key)
         if fn is not None:
             return fn
@@ -1444,7 +1498,7 @@ class StackedSearcher:
                 agg_out,
             )
 
-        if self.mesh is not None:
+        if self._exec == "shardmap":
             import jax.tree_util as jtu
 
             def spmd(dev, params, after, agg_params):
@@ -1462,11 +1516,13 @@ class StackedSearcher:
 
             fn = jax.jit(spmd)
         else:
+            from .spmd import constrain_shards
 
             def vm(dev, params, after, agg_params):
-                return jax.vmap(
+                outs = jax.vmap(
                     lambda d, p, a: shard_body(d, p, after, a)
                 )(dev, params, agg_params)
+                return constrain_shards(outs, self.mesh)
 
             fn = jax.jit(vm)
         self._cache[cache_key] = fn
@@ -1597,6 +1653,14 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     fs = _fused_sharded_for(ss)
     if fs is not None and not _return_program and fs.usable(k):
         return fs.msearch(fld, queries, k)
+    # pjit (the default mesh mode): impact > exact, each ONE compiled
+    # SPMD program including the on-device all-gather + top-k merge —
+    # byte-identical rows to the partials + host-merge path below
+    # (tests/test_spmd.py). Keyed on the searcher's RESOLVED mode so a
+    # later env flip cannot split a searcher across execution models.
+    if (not _return_program and queries
+            and getattr(ss, "_exec", "vmap") == "pjit"):
+        return _msearch_merged(ss, fld, queries, k)
     # the uncached fall-through must route the SAME arm priority as the
     # cached path (_msearch_sharded_partials: fused > impact > exact) —
     # it previously skipped straight to exact, so disabling the request
@@ -1737,6 +1801,48 @@ def _msearch_sharded_cached(ss: "StackedSearcher", rc, fld: str,
     return _merge_shard_rows(V, I, T)
 
 
+def _msearch_stack_plans(ss: "StackedSearcher", fld: str, queries: list,
+                         k: int, *, impact: bool = False) -> dict | None:
+    """Shared host planning of the stacked msearch arms: one
+    BatchTermSearcher plan per shard, padded in place to the common
+    (Ts, B) shape (row 0 = padding). -> dict of stacked [S, ...] plan
+    arrays + scoring context; None when impact=True and any shard's plan
+    cannot ride the impact tier."""
+    from ..ops.batched import BatchTermSearcher
+
+    sp = ss.sp
+    S = sp.S
+    adapters = [_PlanShardAdapter(sp, s, ss) for s in range(S)]
+    plans = [BatchTermSearcher(a).plan(fld, queries, k) for a in adapters]
+    if impact and any(p.impact_w is None for p in plans):
+        return None
+    ts_max = max(p.sparse_rows.shape[1] for p in plans)
+    b_max = max(p.sparse_rows.shape[2] for p in plans)
+    attrs = ("sparse_weights", "impact_w") if impact else ("sparse_weights",)
+    for s in range(S):
+        sr = plans[s].sparse_rows
+        plans[s].sparse_rows = np.pad(
+            sr, ((0, 0), (0, ts_max - sr.shape[1]), (0, b_max - sr.shape[2]))
+        )
+        for attr in attrs:
+            a = getattr(plans[s], attr)
+            setattr(plans[s], attr,
+                    np.pad(a, ((0, 0), (0, ts_max - a.shape[1]))))
+    out = {
+        "W": np.stack([p.W for p in plans]),  # [S, Q, V]
+        "rows": np.stack([p.sparse_rows for p in plans]),
+        "ws": np.stack([p.sparse_weights for p in plans]),
+        # effective (override-aware) stats with the empty-field 1.0 guard —
+        # raw field_stats would diverge from the tier under tiered refresh
+        "avgdl": adapters[0].pack.avgdl(fld),
+        "has_norms": fld in ss.ctx.has_norms,
+        "kk": min(max(k, 1), max(sp.n_max, 1)),
+    }
+    if impact:
+        out["iws"] = np.stack([p.impact_w for p in plans])
+    return out
+
+
 def _msearch_impact_partials(ss: "StackedSearcher", fld: str,
                              queries: list, k: int = 10):
     """The sharded impact arm (BM25S): the same SPMD shard body as the
@@ -1745,34 +1851,17 @@ def _msearch_impact_partials(ss: "StackedSearcher", fld: str,
     mode) — no tf/dl gathers, no BM25 arithmetic, ~half the postings
     bytes per query. Returns None when any shard's plan cannot ride the
     tier (caller falls back to the exact arm)."""
-    from ..ops.batched import BatchTermSearcher, batch_term_disjunction
+    from ..ops.batched import batch_term_disjunction
 
     sp = ss.sp
     S = sp.S
-    adapters = [_PlanShardAdapter(sp, s, ss) for s in range(S)]
-    plans = [BatchTermSearcher(a).plan(fld, queries, k) for a in adapters]
-    if any(p.impact_w is None for p in plans):
+    pl = _msearch_stack_plans(ss, fld, queries, k, impact=True)
+    if pl is None:
         return None
-    ts_max = max(p.sparse_rows.shape[1] for p in plans)
-    b_max = max(p.sparse_rows.shape[2] for p in plans)
-    for s in range(S):  # pad in place to the common shape (row 0 = padding)
-        sr = plans[s].sparse_rows
-        plans[s].sparse_rows = np.pad(
-            sr, ((0, 0), (0, ts_max - sr.shape[1]), (0, b_max - sr.shape[2]))
-        )
-        for attr in ("sparse_weights", "impact_w"):
-            a = getattr(plans[s], attr)
-            setattr(plans[s], attr,
-                    np.pad(a, ((0, 0), (0, ts_max - a.shape[1]))))
     Q = len(queries)
-    W = np.stack([p.W for p in plans])  # [S, Q, V]
-    rows = np.stack([p.sparse_rows for p in plans])
-    ws = np.stack([p.sparse_weights for p in plans])
-    iws = np.stack([p.impact_w for p in plans])
-    avgdl = adapters[0].pack.avgdl(fld)
-    has_norms = fld in ss.ctx.has_norms
+    W, rows, ws, iws = pl["W"], pl["rows"], pl["ws"], pl["iws"]
+    avgdl, has_norms, kk = pl["avgdl"], pl["has_norms"], pl["kk"]
     n_max = sp.n_max
-    kk = min(max(k, 1), max(n_max, 1))
     Ts, B = rows.shape[2], rows.shape[3]
 
     def shard_body(dev1, W1, rows1, ws1, iws1):
@@ -1838,39 +1927,144 @@ def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,
     return _merge_shard_rows(*out)
 
 
+def _msearch_merged(ss: "StackedSearcher", fld: str, queries: list, k: int,
+                    _return_program=False):
+    """The pjit msearch arm (PR 10): ONE compiled SPMD program per plan
+    shape — vmapped per-shard disjunction bodies over the sharded pack
+    pytree AND the global top-k merge (`lax.top_k` over the ICI
+    all-gather of the per-shard (score, shard_doc) rows) in the same
+    program. No host round-trip between shard scan and coordinator
+    merge; device->host traffic is k rows per query instead of S*k.
+    Arm priority matches the partials path: impact > exact (the fused
+    Pallas arm stays on its shard_map fallback — custom calls cannot be
+    auto-partitioned by GSPMD)."""
+    if _impact_sharded_usable(ss):
+        out = _msearch_merged_arm(ss, fld, queries, k, impact=True,
+                                  _return_program=_return_program)
+        if out is not None:
+            return out
+    return _msearch_merged_arm(ss, fld, queries, k, impact=False,
+                               _return_program=_return_program)
+
+
+def _msearch_merged_arm(ss: "StackedSearcher", fld: str, queries: list,
+                        k: int, *, impact: bool, _return_program=False):
+    from ..ops.batched import batch_term_disjunction
+
+    sp = ss.sp
+    S = sp.S
+    pl = _msearch_stack_plans(ss, fld, queries, k, impact=impact)
+    if pl is None:
+        return None
+    Q = len(queries)
+    avgdl, has_norms, kk = pl["avgdl"], pl["has_norms"], pl["kk"]
+    n_max = sp.n_max
+    Ts, B = pl["rows"].shape[2], pl["rows"].shape[3]
+    dev_keys = (("post_docids", "impact_codes", "live") if impact
+                else ("post_docids", "post_tfs", "post_dls", "live"))
+    sub = {key: ss.dev[key] for key in dev_keys}
+    if "dense_tfn" in ss.dev:
+        sub["dense_tfn"] = ss.dev["dense_tfn"]
+    cache_key = ("msearch_merged", impact, fld, Ts, B, kk, Q)
+    fn = ss._cache.get(cache_key)
+    if fn is None:
+        from .spmd import (
+            constrain, constrain_shards, merge_topk_rows, replica_axis,
+        )
+
+        mesh = ss.mesh
+        ra = replica_axis(mesh)
+
+        def shard_one(dev1, W1, rows1, ws1, iws1):
+            return batch_term_disjunction(
+                dev1, (Ts, B, kk), W1, rows1, ws1,
+                avgdl=avgdl, num_docs=n_max, has_norms=has_norms,
+                impact_w=(iws1 if impact else None),
+            )
+
+        def run(dev, W_, rows_, ws_, iws_):
+            if ra is not None:
+                # replica groups: the query axis splits over the mesh's
+                # second axis, so each replica group scans the (shard-
+                # local, replicated) pack for its own slice of the wave
+                W_, rows_, ws_, iws_ = (
+                    constrain(x, mesh, P("shards", ra))
+                    for x in (W_, rows_, ws_, iws_))
+            outs = jax.vmap(shard_one)(dev, W_, rows_, ws_, iws_)
+            v, i, t = constrain_shards(outs, mesh)
+            return merge_topk_rows(v, i, t, mesh=mesh)
+
+        fn = ss._cache[cache_key] = jax.jit(run)
+    if _return_program:
+        # measurement hook (scripts/c5_mesh_probe.py): the ONE compiled
+        # program + its device inputs, so the in-program merge cost can
+        # be timed against the shard-local partials program
+        iws0 = pl.get("iws")
+        if iws0 is None:
+            iws0 = np.zeros_like(pl["ws"])
+        return fn, (sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
+                    jnp.asarray(pl["ws"]), jnp.asarray(iws0)), kk
+    from ..telemetry import profile_event, time_kernel
+
+    tier = "impact" if impact else "exact"
+    profile_event("tier", tier=tier, queries=Q)
+    fields = dict(tier=tier, shards=S, queries=Q, k=kk,
+                  num_docs=S * n_max, rows=int(np.prod(pl["rows"].shape)))
+    if impact:
+        fields["code_bytes"] = int(
+            np.dtype(ss.dev["impact_codes"].dtype).itemsize)
+    iws = pl.get("iws")
+    if iws is None:
+        iws = np.zeros_like(pl["ws"])
+    with time_kernel("sharded.allgather_topk", **fields):
+        mv, msh, mi, mt = jax.device_get(
+            fn(sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
+               jnp.asarray(pl["ws"]), jnp.asarray(iws)))
+    return (np.asarray(mv), np.asarray(msh).astype(np.int32),
+            np.asarray(mi), np.asarray(mt))
+
+
+def global_merge_rows(ss: "StackedSearcher", v, i, t):
+    """Standalone on-device coordinator merge of per-shard top rows —
+    the `sharded.global_merge` program. Production arms fold the merge
+    into their own compiled program (`_msearch_merged`); this entry
+    point serves rows produced OUTSIDE one mergeable program (the mesh
+    probe's merge-fraction measurement, tests) and returns the merged
+    (scores [Q, kk], shard, doc, totals [Q]) as numpy."""
+    from ..telemetry import time_kernel
+
+    v = jnp.asarray(v)
+    i = jnp.asarray(i)
+    t = jnp.asarray(t)
+    S, Q, kk = v.shape
+    cache_key = ("global_merge", S, Q, kk)
+    fn = ss._cache.get(cache_key)
+    if fn is None:
+        from .spmd import merge_topk_rows
+
+        fn = ss._cache[cache_key] = jax.jit(
+            lambda v_, i_, t_: merge_topk_rows(v_, i_, t_, mesh=ss.mesh))
+    with time_kernel("sharded.global_merge", shards=S, queries=Q, k=kk):
+        mv, msh, mi, mt = jax.device_get(fn(v, i, t))
+    return (np.asarray(mv), np.asarray(msh).astype(np.int32),
+            np.asarray(mi), np.asarray(mt))
+
+
 def _msearch_exact_partials(ss: "StackedSearcher", fld: str,
                             queries: list, k: int = 10,
                             _return_program=False):
     """Batched disjunction kernel per shard (also the escalation target of
     the fused arm's flagged queries) -> pre-merge per-shard rows
     (v [S, Q, kk], i [S, Q, kk], t [S, Q]) numpy."""
-    from ..ops.batched import BatchTermSearcher, batch_term_disjunction
+    from ..ops.batched import batch_term_disjunction
 
     sp = ss.sp
     S = sp.S
-    adapters = [_PlanShardAdapter(sp, s, ss) for s in range(S)]
-    plans = [BatchTermSearcher(a).plan(fld, queries, k) for a in adapters]
-    ts_max = max(p.sparse_rows.shape[1] for p in plans)
-    b_max = max(p.sparse_rows.shape[2] for p in plans)
-    for s in range(S):  # pad in place to the common shape (row 0 = padding)
-        sr = plans[s].sparse_rows
-        plans[s].sparse_rows = np.pad(
-            sr, ((0, 0), (0, ts_max - sr.shape[1]), (0, b_max - sr.shape[2]))
-        )
-        sw = plans[s].sparse_weights
-        plans[s].sparse_weights = np.pad(
-            sw, ((0, 0), (0, ts_max - sw.shape[1]))
-        )
+    pl = _msearch_stack_plans(ss, fld, queries, k)
     Q = len(queries)
-    W = np.stack([p.W for p in plans])  # [S, Q, V]
-    rows = np.stack([p.sparse_rows for p in plans])
-    ws = np.stack([p.sparse_weights for p in plans])
-    # effective (override-aware) stats with the empty-field 1.0 guard —
-    # raw field_stats would diverge from the tier under tiered refresh
-    avgdl = adapters[0].pack.avgdl(fld)
-    has_norms = fld in ss.ctx.has_norms
+    W, rows, ws = pl["W"], pl["rows"], pl["ws"]
+    avgdl, has_norms, kk = pl["avgdl"], pl["has_norms"], pl["kk"]
     n_max = sp.n_max
-    kk = min(max(k, 1), max(n_max, 1))
     Ts, B = rows.shape[2], rows.shape[3]
 
     def shard_body(dev1, W1, rows1, ws1):

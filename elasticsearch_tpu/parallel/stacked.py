@@ -14,6 +14,13 @@ remapping — the agg reduce rides ICI/host memcpy, not string hashing.
 Per-shard state that stays local: postings + term dictionary (each shard
 scores its own term blocks; per-shard df supports the reference's default
 query_then_fetch idf, global df supports dfs_query_then_fetch).
+
+PR 10: the [S, ...] family built here is consumed as a GSPMD-sharded
+PYTREE — `parallel/sharded._stacked_host_tree` names every leaf and
+`parallel/spmd.PACK_PARTITION_RULES` maps leaf names to PartitionSpecs
+(exactly-one-rule enforced), so adding an array to this class means
+adding its rule, or the upload fails loudly instead of replicating the
+array S-fold in HBM.
 """
 
 from __future__ import annotations

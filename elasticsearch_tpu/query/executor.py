@@ -26,11 +26,26 @@ from .nodes import ExecContext, QueryNode
 
 
 def pack_to_device(pack: ShardPack, device=None) -> dict:
-    """Ship a host ShardPack to HBM as a flat dict-of-arrays pytree."""
+    """Ship a host ShardPack to HBM as a flat dict-of-arrays pytree.
+
+    The single-shard twin of `parallel/sharded.stacked_to_device`: the
+    host tree is built first, then placed in one tree_map pass — leaf
+    PATHS here are the same vocabulary the stacked path's partition-rule
+    table (parallel/spmd.PACK_PARTITION_RULES) matches against, so a new
+    component added here without a rule fails the stacked upload (and
+    tests/test_spmd.py's table lint) instead of silently replicating."""
     from ..utils.jax_env import ensure_x64
 
     ensure_x64()
-    put = lambda x: jax.device_put(x, device) if device else jnp.asarray(x)
+    host = _pack_host_tree(pack)
+    import jax.tree_util as jtu
+
+    put = (lambda x: jax.device_put(x, device)) if device else jnp.asarray
+    return jtu.tree_map(put, host)
+
+
+def _pack_host_tree(pack: ShardPack) -> dict:
+    put = np.asarray
     dev = {
         "post_docids": put(pack.post_docids),
         "post_tfs": put(pack.post_tfs),

@@ -126,7 +126,8 @@ def device_sections(events: list[dict] | None, num_shards: int) -> list[dict]:
                 "scope": "shard" if isinstance(s, int) else "mesh",
             }
             for key in ("tier", "queries", "k", "shards", "num_docs",
-                        "flops", "bytes", "mfu", "bw_util"):
+                        "flops", "bytes", "mfu", "bw_util",
+                        "ici_bytes", "ici_util"):
                 if key in e:
                     entry[key] = e[key]
             for t in targets:

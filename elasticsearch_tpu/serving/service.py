@@ -440,10 +440,15 @@ class ServingService:
     # ---- introspection / lifecycle --------------------------------------
 
     def stats(self) -> dict:
+        from ..parallel.spmd import spmd_mode
+
         with self._lock:
             waves = max(self.counters["waves"], 1)
             return {
                 "enabled": self.enabled,
+                # which slice execution model the wave lanes dispatch into
+                # (pjit = one SPMD program incl. the device merge)
+                "spmd_mode": spmd_mode(),
                 "queue": {**self._tenants.stats(),
                           "max_depth": self.queue_cap},
                 "wave": {

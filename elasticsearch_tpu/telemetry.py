@@ -385,6 +385,13 @@ def time_kernel(name: str, **fields):
                       "bytes": util["bytes"],
                       "mfu": round(util["mfu"], 6),
                       "bw_util": round(util["bw_util"], 6)}
+            if "ici_util" in util:
+                # collective kernels (PR 10): achieved interconnect
+                # utilization of the all-gather merge traffic
+                metrics.histogram_record(f"es.kernel.{name}.ici_pct",
+                                         util["ici_util"] * 100.0)
+                fields["ici_bytes"] = util["ici_bytes"]
+                fields["ici_util"] = round(util["ici_util"], 6)
         profile_event("kernel", kernel=name, ms=round(ms, 4), **fields)
 
 

@@ -1,21 +1,26 @@
-"""C5 collective-overhead probe (VERDICT r3 #9).
+"""C5 collective-overhead probe (VERDICT r3 #9; PR 10 pjit rework).
 
-Runs the production `msearch_sharded` program on an 8-device VIRTUAL CPU
-mesh and measures the ratio of cross-shard merge time to total step time.
-Absolute CPU numbers are meaningless for a TPU projection; the RATIO of
-the collective/global-merge portion to the per-shard compute portion is
-the quantity bench.py uses to project a v5e-8 figure from the measured
+Runs the production sharded `_msearch` programs on an 8-device VIRTUAL
+CPU mesh and measures the cost of the on-device global merge. Absolute
+CPU numbers are meaningless for a TPU projection; the RATIO of the
+merge/collective portion to the per-shard compute portion is the
+quantity bench.py uses to project a v5e-8 figure from the measured
 one-chip serial throughput:
 
     projected_qps_v5e8 = qps_one_chip_serial * S * (1 - merge_frac)
 
-Two timed variants of the SAME per-shard computation:
-  A. shard-local only: out_specs keep [S, Q, k] partials sharded (the
-     host performs the coordinator merge — no cross-device traffic in
-     the program).
-  B. device-side coordinator merge: the [S, Q, k] partials are globally
-     merged in-program by (score desc, shard asc, doc asc) rank keys —
-     XLA inserts the all-gather (ICI on real hardware).
+Three timed programs over the SAME batch:
+  A. shard-local only: the legacy shard_map partials program, out_specs
+     keep [S, Q, k] sharded, nothing crosses the mesh.
+  B. the PR-10 pjit ONE-program path (`_msearch_merged`): vmapped shard
+     bodies over the sharded pack pytree + the in-program
+     `lax.top_k`-over-all-gather merge; the host fetches k rows/query.
+  C. the standalone device merge (`sharded.global_merge`) applied to
+     A's device-resident rows — the merge cost in isolation.
+
+Also asserts byte/rank parity between the pjit, shard_map and
+single-device paths (the acceptance gate), and reports the all-gather
+traffic model + achieved ICI utilization from the cost model.
 
 Prints ONE JSON line. Run as a subprocess (bench.py config5) so the
 parent process can keep the real TPU backend.
@@ -24,6 +29,7 @@ parent process can keep the real TPU backend.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -36,76 +42,120 @@ def main(n_devices=8, docs_per_shard=4096, n_queries=256):
     import __graft_entry__ as graft
 
     graft._ensure_devices(n_devices)
+    os.environ["ES_TPU_REQUEST_CACHE"] = "0"
     import jax
-    import jax.numpy as jnp
 
     from elasticsearch_tpu.utils.jax_env import ensure_x64
 
     ensure_x64()
     from jax.sharding import Mesh
 
+    from elasticsearch_tpu.monitoring.costmodel import utilization
     from elasticsearch_tpu.parallel.sharded import (
         StackedSearcher,
+        _msearch_merged,
+        global_merge_rows,
         msearch_sharded,
     )
     from elasticsearch_tpu.parallel.stacked import build_stacked_pack
 
     S = n_devices
-    mesh = Mesh(np.array(jax.devices()[:S]), ("shards",))
     m = graft._mapping()
     docs = graft._dryrun_corpus(docs_per_shard * S, seed=5)
     sp = build_stacked_pack(docs, m, num_shards=S)
-    ss = StackedSearcher(sp, mesh=mesh)
+
+    def searcher(mode, mesh=True):
+        os.environ["ES_TPU_SPMD"] = mode
+        try:
+            return StackedSearcher(
+                sp, mesh=Mesh(np.array(jax.devices()[:S]), ("shards",))
+                if mesh else None)
+        finally:
+            os.environ["ES_TPU_SPMD"] = "pjit"
+
+    pj = searcher("pjit")
+    sm = searcher("shardmap")
+    single = searcher("pjit", mesh=False)
+
     rng = np.random.default_rng(9)
     queries = []
     for _ in range(n_queries):
         terms = {f"w{int(t)}" for t in rng.integers(0, 60, size=3)}
         queries.append([(t, 1.0) for t in terms])
+    k = 10
 
-    fn, args, kk = msearch_sharded(ss, "body", queries, k=10,
+    # ---- byte/rank parity: pjit vs shard_map vs single-device ----------
+    ref_v, ref_s, ref_d, ref_t = msearch_sharded(pj, "body", queries, k=k)
+    parity = {}
+    for name, ss in (("shardmap", sm), ("single_device", single)):
+        v, s_, d_, t_ = msearch_sharded(ss, "body", queries, k=k)
+        fin = np.isfinite(ref_v)
+        rank_ok = (bool((ref_s == s_)[fin].all())
+                   and bool((ref_d == d_)[fin].all())
+                   and bool((ref_t == t_).all()))
+        parity[f"pjit_vs_{name}"] = (
+            "byte" if rank_ok and np.array_equal(ref_v, v)
+            else ("rank" if rank_ok
+                  and np.allclose(ref_v, v, rtol=1e-6) else "FAIL"))
+    assert "FAIL" not in parity.values(), parity
+
+    # ---- program A: shard-local partials (legacy shard_map, no merge) --
+    fn, args, kk = msearch_sharded(sm, "body", queries, k=k,
                                    _return_program=True)
 
-    def merged(dev, W_, rows_, ws_):
-        v, i, t = fn(dev, W_, rows_, ws_)  # [S, Q, k] sharded
-        # device-side coordinator merge: one int64 rank key encodes
-        # (score desc, shard asc, doc asc); the flat top-k over the
-        # shard-major layout forces the all-gather
-        Q = v.shape[1]
-        flat_v = jnp.swapaxes(v, 0, 1).reshape(Q, -1)
-        flat_i = jnp.swapaxes(i, 0, 1).reshape(Q, -1)
-        sh = jnp.repeat(jnp.arange(S, dtype=jnp.int64), kk)[None, :]
-        bits = jax.lax.bitcast_convert_type(flat_v, jnp.int32)
-        rank = ((bits.astype(jnp.int64) << 32)
-                - (sh << 26)
-                - flat_i.astype(jnp.int64))
-        _, sel = jax.lax.top_k(rank, kk)
-        return (
-            jnp.take_along_axis(flat_v, sel, axis=1),
-            jnp.take_along_axis(flat_i, sel, axis=1),
-            t.sum(axis=0),
-        )
-
-    fn_b = jax.jit(merged)
-
     def bench(f, n=8):
-        jax.block_until_ready(f(*args))
+        jax.block_until_ready(f())
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
-            jax.block_until_ready([f(*args) for _ in range(n)])
+            for _ in range(n):
+                jax.block_until_ready(f())
             ts.append((time.perf_counter() - t0) / n)
         return min(ts)
 
-    t_local = bench(fn)
-    t_merged = bench(fn_b)
-    frac = max(0.0, (t_merged - t_local) / max(t_merged, 1e-9))
+    t_local = bench(lambda: fn(*args))
+
+    # ---- program B: the pjit one-program scan + all-gather merge -------
+    fn_b, args_b, _kk = _msearch_merged(pj, "body", queries, k,
+                                        _return_program=True)
+    t_onep = bench(lambda: fn_b(*args_b))
+
+    # ---- program C: the standalone device merge over A's rows ----------
+    rows_dev = fn(*args)
+    jax.block_until_ready(rows_dev)
+    t_merge = bench(lambda: global_merge_rows(sm, *rows_dev))
+
+    # the projection's merge fraction: the measured on-device merge cost
+    # relative to (shard-local compute + merge). The one-program ratio is
+    # reported separately because on a VIRTUAL CPU mesh XLA's SPMD
+    # partitioner replicates the vmapped scan across devices (measured
+    # ~5x vs shard_map) — a lowering artifact of the probe platform, not
+    # of the merge; on TPU the partitioner shards it (BENCH_NOTES r14)
+    frac = t_merge / max(t_local + t_merge, 1e-9)
+    one_program_frac = max(0.0, (t_onep - t_local) / max(t_onep, 1e-9))
+    util = utilization(
+        "sharded.allgather_topk",
+        dict(tier="exact", shards=S, queries=n_queries, k=kk,
+             num_docs=S * sp.n_max,
+             rows=int(np.prod(np.shape(args[2])))),
+        t_onep) or {}
     print(json.dumps({
         "devices": S,
         "docs_per_shard": docs_per_shard,
         "n_queries": n_queries,
         "t_shard_local_ms": round(t_local * 1e3, 2),
-        "t_with_device_merge_ms": round(t_merged * 1e3, 2),
+        "t_one_program_ms": round(t_onep * 1e3, 2),
+        "t_device_merge_ms": round(t_merge * 1e3, 2),
         "merge_overhead_frac": round(frac, 4),
+        "one_program_overhead_frac": round(one_program_frac, 4),
+        "parity": parity,
+        "allgather": {
+            "rows": S * n_queries * kk,
+            "ici_bytes": util.get("ici_bytes"),
+            "bw_util": round(util["bw_util"], 6) if util else None,
+            "ici_util": (round(util["ici_util"], 6)
+                         if "ici_util" in util else None),
+        },
     }))
 
 

@@ -31,6 +31,14 @@ else
         "${COMMON[@]}" -p no:randomly --shuffle-modules "${SEED}" || exit 1
 fi
 
+# multichip/pjit parity gate (PR 10): the production sharded stack with
+# parity across pjit / shard_map / single-device. Enforcing when the
+# process sees a real multi-device slice; advisory on single-device CPU
+# (the script provisions a virtual mesh itself).
+echo "[tier1-gate] multichip pjit parity"
+JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/multichip_dryrun.py \
+    || exit 1
+
 # bench-regression lint (PR 9): when two or more BENCH_r*.json records
 # exist, diff the newest pair per config (QPS, latency pcts, per-kernel
 # mfu/bw_util) and fail on >20% regression. CPU-smoke records are

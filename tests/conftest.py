@@ -69,8 +69,14 @@ def pytest_collection_modifyitems(session, config, items):
     # test_request_cache.py re-enables it per test via its own autouse
     # fixture, so cache coverage itself survives this gate.
     os.environ["ES_TPU_REQUEST_CACHE"] = "0"
+    # ... and with the GSPMD execution model pinned EXPLICITLY (pjit is
+    # also the auto default): with the cache off, every sharded msearch
+    # rides the one-program all-gather-merge path, so the shuffled gate
+    # doubles as the PR-10 pjit execution gate.
+    os.environ["ES_TPU_SPMD"] = "pjit"
     print(f"[conftest] module order shuffled with seed {seed}; "
-          "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate)")
+          "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate); "
+          "ES_TPU_SPMD=pjit (GSPMD execution gate)")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -134,7 +134,10 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      "compiled_plan", "ann.centroid_probe",
                      "ann.gather_scan", "ann.rescore", "ann.tail_scan",
                      "sparse.impact_gather", "sparse.impact_sum",
-                     "sharded.impact_disjunction", "sparse.tail_scan"):
+                     "sharded.impact_disjunction", "sparse.tail_scan",
+                     # the pjit GSPMD path (PR 10): the one-program
+                     # all-gather merge + the standalone device merge
+                     "sharded.allgather_topk", "sharded.global_merge"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 

@@ -1,0 +1,362 @@
+"""PR 10: GSPMD (pjit) sharding of the pack — partition-rule table,
+byte/rank parity of pjit vs shard_map vs single-device on the 1x8 CPU
+mesh across bool/knn/impact/aggs/serving-wave plans, the on-device
+all-gather top-k merge, replica groups, and the collective cost model.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.parallel.sharded import (
+    StackedSearcher,
+    _merge_shard_rows,
+    _msearch_exact_partials,
+    global_merge_rows,
+    make_mesh,
+    msearch_sharded,
+    msearch_wave,
+)
+from elasticsearch_tpu.parallel.spmd import (
+    PACK_PARTITION_RULES,
+    leaf_paths,
+    match_partition_rules,
+    merge_topk_rows,
+    spmd_mode,
+)
+from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+
+
+def _corpus(n=640, seed=3):
+    rng = np.random.default_rng(seed)
+    words = [f"w{i}" for i in range(60)]
+    docs = []
+    for i in range(n):
+        body = " ".join(rng.choice(words, size=int(rng.integers(4, 12))))
+        if rng.random() < 0.03:
+            body += " rareterm"
+        docs.append((f"doc-{i}", {
+            "body": body,
+            "status": str(rng.choice(["a", "b", "c"])),
+            "bytes": int(rng.integers(1, 1000)),
+            "vec": [float(x) for x in rng.normal(size=8)],
+        }))
+    return docs
+
+
+_MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "status": {"type": "keyword"},
+        "bytes": {"type": "long"},
+        "vec": {"type": "dense_vector", "dims": 8,
+                "similarity": "dot_product"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def sp():
+    return build_stacked_pack(_corpus(), Mappings(_MAPPING), num_shards=4)
+
+
+def _searcher(sp, mode, monkeypatch, mesh=True):
+    monkeypatch.setenv("ES_TPU_SPMD", mode)
+    return StackedSearcher(sp, mesh=make_mesh(4) if mesh else None)
+
+
+def _queries(n=12, seed=11):
+    rng = np.random.default_rng(seed)
+    return [
+        [(f"w{int(t)}", 1.0) for t in sorted(set(rng.integers(0, 60, 3)))]
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# partition-rule table
+# ---------------------------------------------------------------------------
+
+def test_every_pack_leaf_matches_exactly_one_rule(sp):
+    """The full-featured pack (postings, impact codes, dense tier,
+    docvalues, vectors) flattens into leaves that each match EXACTLY one
+    rule — the exhaustiveness contract of the table."""
+    import re
+
+    from elasticsearch_tpu.parallel.sharded import _stacked_host_tree
+
+    host = _stacked_host_tree(sp)
+    paths = leaf_paths(host)
+    assert len(paths) >= 10  # postings, norms, dv, vec at minimum
+    for name, leaf in paths:
+        if np.ndim(leaf) == 0 or int(np.prod(np.shape(leaf))) == 1:
+            continue
+        hits = [rx for rx, _ in PACK_PARTITION_RULES if re.search(rx, name)]
+        assert len(hits) == 1, (name, hits)
+        assert np.shape(leaf)[0] == sp.S, (
+            f"rule-sharded leaf [{name}] must carry the shard axis first")
+    # the matcher itself runs clean over the real tree
+    specs = leaf_paths(match_partition_rules(host))
+    assert len(specs) == len(paths)
+
+
+def test_unmatched_leaf_is_a_hard_error():
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules({"mystery_component": np.zeros((4, 8))})
+
+
+def test_overlapping_rules_are_a_hard_error():
+    from jax.sharding import PartitionSpec as P
+
+    rules = [(r"^post", P("shards")), (r"docids$", P("shards"))]
+    with pytest.raises(ValueError, match="matched 2"):
+        match_partition_rules({"post_docids": np.zeros((4, 8))}, rules)
+
+
+def test_scalars_replicate():
+    from jax.sharding import PartitionSpec as P
+
+    specs = match_partition_rules({"live": np.zeros((4, 8)),
+                                   "nested": {"x": np.float32(1.0)}})
+    assert specs["nested"]["x"] == P()
+    assert specs["live"] == P("shards")
+
+
+# ---------------------------------------------------------------------------
+# byte/rank parity: pjit vs shard_map vs single-device
+# ---------------------------------------------------------------------------
+
+def _same_result(a, b, what):
+    assert a.doc_shards.tolist() == b.doc_shards.tolist(), what
+    assert a.doc_ids.tolist() == b.doc_ids.tolist(), what
+    np.testing.assert_allclose(a.scores, b.scores, rtol=1e-6, err_msg=what)
+    assert a.total == b.total, what
+    assert a.aggregations == b.aggregations, what
+
+
+def test_three_way_parity_bool_knn_aggs(sp, monkeypatch):
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    pj = _searcher(sp, "pjit", monkeypatch)
+    sm = _searcher(sp, "shardmap", monkeypatch)
+    sd = _searcher(sp, "pjit", monkeypatch, mesh=False)
+    assert (pj._exec, sm._exec, sd._exec) == ("pjit", "shardmap", "vmap")
+
+    q = {"bool": {"should": [{"term": {"body": "rareterm"}},
+                             {"term": {"body": "w1"}},
+                             {"term": {"body": "w2"}}]}}
+    aggs = {"by_status": {"terms": {"field": "status"},
+                          "aggs": {"b": {"sum": {"field": "bytes"}}}}}
+    knn = {"knn": {"field": "vec", "query_vector": [0.1] * 8, "k": 5,
+                   "num_candidates": 20}}
+    for req in (dict(query=q, size=7),
+                dict(query=q, size=5, aggs=aggs),
+                dict(query=knn, size=5),
+                dict(query=None, size=0, aggs=aggs)):
+        r_pj = pj.search(**req)
+        _same_result(r_pj, sm.search(**req), ("shardmap", req))
+        _same_result(r_pj, sd.search(**req), ("single", req))
+
+
+def test_msearch_parity_and_device_merge(sp, monkeypatch):
+    """The pjit msearch is ONE program including the merge; its rows are
+    byte-identical to the shard_map partials + host lexsort merge."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    pj = _searcher(sp, "pjit", monkeypatch)
+    sm = _searcher(sp, "shardmap", monkeypatch)
+    sd = _searcher(sp, "pjit", monkeypatch, mesh=False)
+    queries = _queries()
+    ref = msearch_sharded(pj, "body", queries, k=5)
+    for other in (sm, sd):
+        v, s_, d_, t_ = msearch_sharded(other, "body", queries, k=5)
+        np.testing.assert_array_equal(ref[0], v)
+        fin = np.isfinite(ref[0])
+        assert (ref[1] == s_)[fin].all()
+        assert (ref[2] == d_)[fin].all()
+        assert (ref[3] == t_).all()
+
+
+def test_impact_arm_rides_the_merged_program(sp, monkeypatch):
+    """With the impact tier serving, the pjit path scores the sparse tail
+    from the quantized codes inside the same merged program — parity vs
+    the shard_map impact partials + host merge."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    monkeypatch.setenv("ES_TPU_IMPACT", "1")
+    if sp.impact_meta is None:
+        pytest.skip("corpus built without an impact tier")
+    pj = _searcher(sp, "pjit", monkeypatch)
+    sm = _searcher(sp, "shardmap", monkeypatch)
+    assert "impact_codes" in pj.dev
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    queries = _queries(8, seed=23)
+    with collect_profile_events() as events:
+        ref = msearch_sharded(pj, "body", queries, k=5)
+    names = [e.get("kernel") for e in events if e.get("kind") == "kernel"]
+    assert "sharded.allgather_topk" in names
+    tiers = [e.get("tier") for e in events if e.get("kind") == "tier"]
+    assert "impact" in tiers
+    v, s_, d_, t_ = msearch_sharded(sm, "body", queries, k=5)
+    np.testing.assert_array_equal(ref[0], v)
+    fin = np.isfinite(ref[0])
+    assert (ref[1] == s_)[fin].all() and (ref[2] == d_)[fin].all()
+
+
+def test_serving_wave_parity(sp, monkeypatch):
+    """msearch_wave (the serving term lane) pads to the compiled batch
+    tier and rides the merged pjit program — rows byte-identical to the
+    shard_map wave."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    pj = _searcher(sp, "pjit", monkeypatch)
+    sm = _searcher(sp, "shardmap", monkeypatch)
+    queries = _queries(5, seed=29)  # pads to the 8-wide tier
+    (v_a, s_a, d_a, t_a), tier_a = msearch_wave(pj, "body", queries, k=5)
+    (v_b, s_b, d_b, t_b), tier_b = msearch_wave(sm, "body", queries, k=5)
+    assert tier_a == tier_b == 8
+    np.testing.assert_array_equal(v_a, v_b)
+    fin = np.isfinite(v_a)
+    assert (s_a == s_b)[fin].all() and (d_a == d_b)[fin].all()
+    assert (t_a == t_b).all()
+
+
+def test_sorted_and_collapse_parity(sp, monkeypatch):
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    pj = _searcher(sp, "pjit", monkeypatch)
+    sm = _searcher(sp, "shardmap", monkeypatch)
+    from elasticsearch_tpu.query.sort import parse_sort
+
+    q = {"term": {"body": "w1"}}
+    sort = parse_sort([{"bytes": "desc"}])
+    h_pj = pj.search_sorted(q, sort, size=6)
+    h_sm = sm.search_sorted(q, sort, size=6)
+    assert h_pj[0] == h_sm[0] and h_pj[1] == h_sm[1]
+    c_pj = pj.search_collapse(q, "status", size=3)
+    c_sm = sm.search_collapse(q, "status", size=3)
+    assert c_pj.doc_ids.tolist() == c_sm.doc_ids.tolist()
+    assert c_pj.collapse_keys == c_sm.collapse_keys
+
+
+# ---------------------------------------------------------------------------
+# the on-device merge itself
+# ---------------------------------------------------------------------------
+
+def test_device_merge_matches_host_lexsort(sp, monkeypatch):
+    """sharded.global_merge == _merge_shard_rows byte-for-byte, including
+    score ties (flat top_k index order == the host lexsort order given
+    each shard row's internal (score desc, doc asc) order)."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    sd = _searcher(sp, "pjit", monkeypatch, mesh=False)
+    v, i, t = _msearch_exact_partials(sd, "body", _queries(6, seed=41), k=4)
+    hv, hs, hi, ht = _merge_shard_rows(v, i, t)
+    dv, ds, di, dt = global_merge_rows(sd, v, i, t)
+    np.testing.assert_array_equal(hv, dv)
+    np.testing.assert_array_equal(hs, ds)
+    np.testing.assert_array_equal(hi, di)
+    np.testing.assert_array_equal(ht, dt)
+
+
+def test_merge_tie_break_order():
+    """Synthetic ties: equal scores resolve (shard asc, doc asc)."""
+    v = np.full((3, 1, 2), 1.0, np.float32)
+    i = np.array([[[5, 9]], [[2, 7]], [[0, 1]]], np.int64)
+    t = np.ones((3, 1), np.int64)
+    import jax
+
+    mv, ms, mi, mt = jax.device_get(merge_topk_rows(
+        np.asarray(v), np.asarray(i), np.asarray(t)))
+    assert ms[0].tolist() == [0, 0]  # shard 0 wins both tied slots
+    assert mi[0].tolist() == [5, 9]
+    assert mt[0] == 3
+    hv, hs, hi, ht = _merge_shard_rows(v, i, t)
+    np.testing.assert_array_equal(hs, ms)
+    np.testing.assert_array_equal(hi, mi)
+
+
+# ---------------------------------------------------------------------------
+# replica groups
+# ---------------------------------------------------------------------------
+
+def test_replica_mesh_parity(sp, monkeypatch):
+    """ES_TPU_REPLICAS=2 on 8 devices -> a (4, 2) mesh; the pack
+    replicates across the second axis and results stay byte-identical."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    monkeypatch.setenv("ES_TPU_SPMD", "pjit")
+    monkeypatch.setenv("ES_TPU_REPLICAS", "2")
+    mesh = make_mesh(4)
+    assert mesh is not None and mesh.axis_names == ("shards", "replicas")
+    assert mesh.devices.shape == (4, 2)
+    rep = StackedSearcher(sp, mesh=mesh)
+    monkeypatch.delenv("ES_TPU_REPLICAS")
+    sd = _searcher(sp, "pjit", monkeypatch, mesh=False)
+    queries = _queries(9, seed=31)
+    a = msearch_sharded(rep, "body", queries, k=5)
+    b = msearch_sharded(sd, "body", queries, k=5)
+    np.testing.assert_array_equal(a[0], b[0])
+    fin = np.isfinite(a[0])
+    assert (a[1] == b[1])[fin].all() and (a[2] == b[2])[fin].all()
+    r = rep.search({"term": {"body": "w1"}}, size=5)
+    s = sd.search({"term": {"body": "w1"}}, size=5)
+    _same_result(r, s, "replica search")
+
+
+# ---------------------------------------------------------------------------
+# collective cost model
+# ---------------------------------------------------------------------------
+
+def test_allgather_cost_model_hand_computed():
+    from elasticsearch_tpu.monitoring.costmodel import (
+        allgather_merge_cost, ici_peak, kernel_cost, utilization,
+    )
+
+    s, q, k = 8, 256, 10
+    c = allgather_merge_cost(s, q, k)
+    rows = s * q * k
+    assert c["ici_bytes"] == rows * 12  # f32 score + i64 id per row
+    assert c["flops"] == 2.0 * rows
+    assert c["bytes"] == rows * 12 + q * k * 16
+    # the one-program entry = shard scan + merge, tier-aware
+    full = kernel_cost("sharded.allgather_topk",
+                       dict(tier="exact", shards=s, queries=q, k=k,
+                            num_docs=8 * 1024, rows=q * 4))
+    assert full is not None and full["ici_bytes"] == c["ici_bytes"]
+    assert full["bytes"] > c["bytes"]  # scan traffic rides on top
+    util = utilization("sharded.global_merge",
+                       dict(shards=s, queries=q, k=k), 0.01)
+    assert util is not None and util["ici_util"] == pytest.approx(
+        c["ici_bytes"] / 0.01 / ici_peak())
+
+
+def test_ici_peak_env_override(monkeypatch):
+    from elasticsearch_tpu.monitoring import costmodel
+
+    monkeypatch.setenv("ES_TPU_PEAK_ICI", "123e9")
+    assert costmodel.ici_peak() == 123e9
+
+
+def test_time_kernel_records_ici_utilization(sp, monkeypatch):
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    from elasticsearch_tpu.telemetry import collect_profile_events, metrics
+
+    sd = _searcher(sp, "pjit", monkeypatch, mesh=False)
+    v, i, t = _msearch_exact_partials(sd, "body", _queries(4, seed=43), k=3)
+    with collect_profile_events() as events:
+        global_merge_rows(sd, v, i, t)
+    ks = [e for e in events if e.get("kernel") == "sharded.global_merge"]
+    assert ks and "ici_util" in ks[0] and ks[0]["ici_bytes"] > 0
+    snap = metrics.snapshot()
+    assert "es.kernel.sharded.global_merge.ici_pct" in snap["histograms"]
+
+
+# ---------------------------------------------------------------------------
+# env routing
+# ---------------------------------------------------------------------------
+
+def test_spmd_mode_resolution(monkeypatch):
+    monkeypatch.delenv("ES_TPU_SPMD", raising=False)
+    assert spmd_mode() == "pjit"  # auto default
+    monkeypatch.setenv("ES_TPU_SPMD", "shardmap")
+    assert spmd_mode() == "shardmap"
+    monkeypatch.setenv("ES_TPU_SPMD", "pjit")
+    assert spmd_mode() == "pjit"
