@@ -1135,6 +1135,11 @@ def config5_8shard(rng):
         is not None,
         "parity": probe_r.get("parity"),
         "allgather": probe_r.get("allgather"),
+        # PR 11: the fused Pallas arm on the one-program route (embedded
+        # shard_map region + in-program merge) — byte parity vs the
+        # shard_map oracle and its mfu/bw_util/ici_util attribution,
+        # from the same mesh probe
+        "fused_sharded": probe_r.get("fused"),
         "landed": bool(projected is not None
                        and projected > qps_serial
                        and projected > baseline_qps),
@@ -1368,6 +1373,13 @@ def config6_serving(rng):
             "waves": st["waves"],
             "avg_wave_size": round(st["wave"]["avg_size"], 1),
             "avg_term_occupancy": st["wave"]["avg_term_occupancy"],
+            # PR 11: ≤1 dispatch + ≤1 fetch per wave is the end-to-end
+            # fusion contract (r09 term lanes fetched inside begin, so a
+            # mixed wave cost ≥2 blocking rounds and serialized the
+            # scheduler thread; see BENCH_NOTES round 15)
+            "host_transitions_per_wave": {
+                kk: round(vv, 3) for kk, vv in
+                st["wave"]["host_transitions_per_wave"].items()},
             "term_packed": st["term_packed"],
             "shed": st["shed"],
         },
@@ -1516,7 +1528,13 @@ def _write_record(extras, partial: bool) -> None:
 
 
 def main():
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    # one or more config names (e.g. `bench.py c5 c6` -> ONE record
+    # carrying both arms); no args = the full suite
+    configs = set(sys.argv[1:]) or None
+
+    def _want(name):
+        return configs is None or name in configs
+
     from elasticsearch_tpu.utils.jax_env import enable_compile_cache
 
     enable_compile_cache()
@@ -1552,7 +1570,7 @@ def main():
         _write_record(extras, partial=True)  # temp-file + rename per config
         print(_summary_line(extras, partial=True), flush=True)
 
-    if only in (None, "c1", "c2"):
+    if _want("c1") or _want("c2"):
         log("[pack] building 1M-doc text pack...")
         t0 = time.perf_counter()
         pack, m = build_pack(lens, tok)
@@ -1560,33 +1578,33 @@ def main():
             f"dense tier {None if pack.dense_tfn is None else pack.dense_tfn.shape}")
         from elasticsearch_tpu.query.executor import ShardSearcher
 
-        if only in (None, "c1"):
+        if _want("c1"):
             searcher = ShardSearcher(pack, mappings=m)
             _guard("match_bm25",
                    lambda: config1_match(searcher, m, lens, tok, rng))
             del searcher
             gc.collect()
-        if only in (None, "c2"):
+        if _want("c2"):
             _guard("wand_disjunction",
                    lambda: config2_wand(lens, tok, pack, m, rng))
         del pack
         gc.collect()
 
-    if only in (None, "c3"):
+    if _want("c3"):
         _guard("terms_date_histogram", lambda: config3_aggs(rng))
         gc.collect()
 
-    if only in (None, "c4"):
+    if _want("c4"):
         _guard("knn_cosine_exact", lambda: config4_knn(rng))
         gc.collect()
 
-    if only in (None, "c5"):
+    if _want("c5"):
         _guard("msearch_8shard", lambda: config5_8shard(rng))
         c1q = extras.get("match_bm25", {}).get("qps")
         if c1q and "error" not in extras.get("msearch_8shard", {}):
             extras["msearch_8shard"]["c1_single_chip_1m_qps"] = c1q
 
-    if only in (None, "c6"):
+    if _want("c6"):
         _guard("serving_closed_loop", lambda: config6_serving(rng))
         gc.collect()
 

@@ -1240,10 +1240,12 @@ class EsIndex:
           * term lane — a pure single-field term disjunction (match /
             term / bool-should-of-terms) with no aggs packs into ONE
             batched msearch program per (field, k), padded to the
-            compiled power-of-two batch tier (parallel/sharded
-            msearch_wave). Scores agree with the compiled-plan path to
-            ~1e-5 (fp summation order) and are byte-identical between
-            coalesced and solo waves.
+            compiled power-of-two batch tier and dispatched DEFERRED
+            (parallel/sharded msearch_wave_begin — PR 11: the merged
+            one-program route, fetched with the rest of the wave).
+            Scores agree with the compiled-plan path to ~1e-5 (fp
+            summation order) and are byte-identical between coalesced
+            and solo waves.
           * generic lane — any other wave-eligible request (aggs, knn-
             only, filtered aliases) runs its OWN compiled program, all
             dispatched before any fetch (StackedSearcher.search_many) —
@@ -1265,9 +1267,14 @@ class EsIndex:
 
         n = len(entries)
         job = {"entries": entries, "slots": [None] * n, "fmt": [None] * n,
-               "lanes": [], "tiered": None,
+               "lanes": [], "term_lanes": [], "tiered": None,
                "t0": time.monotonic(),
-               "meta": {"wave_size": n, "term_packed": 0, "term_waves": []}}
+               "meta": {"wave_size": n, "term_packed": 0, "term_waves": [],
+                        # host-transition accounting (PR 11): one
+                        # dispatch phase + one combined fetch per wave
+                        # is the contract; extras (escalations, agg
+                        # pass 2, starved-knn reruns) are counted here
+                        "transitions": {"dispatch": 0, "fetch": 0}}}
         with TRACER.span("servingWaveDispatch", index=self.name, entries=n,
                          spmd=getattr(self._searcher, "_exec", "vmap")
                          if self._searcher is not None else "vmap"):
@@ -1361,9 +1368,9 @@ class EsIndex:
                     "tail": (self._tail,
                              self._tail.search_many_begin(tail_reqs)),
                 }
-                return job
+                return self._wave_mark_dispatched(job)
             if not wave_ix:
-                return job
+                return self._wave_mark_dispatched(job)
             searcher = self.searcher  # merges tiers when present, like solo
             # term lane extraction (packs into one batched program per
             # (field, k)); everything else goes generic
@@ -1432,15 +1439,143 @@ class EsIndex:
                     "ix": generic_ix, "searcher": searcher,
                     "state": searcher.search_many_begin(generic_reqs),
                 })
-            # term groups run here (monolithic: the batched msearch
-            # pipeline dispatches every chunk before fetching any — its
-            # own internal pipelining); response building is host-side
+            # term groups DISPATCH here and fetch with the rest of the
+            # wave (PR 11): under the pjit model each (field, k) group
+            # is ONE merged SPMD program whose outputs join the wave's
+            # single combined device_get — the term lane no longer
+            # blocks the scheduler thread inside begin. Response
+            # building moved to search_wave_finish.
             for (fld, k), members in sorted(term_groups.items()):
                 try:
-                    from ..parallel.sharded import msearch_wave
+                    from ..parallel.sharded import msearch_wave_begin
 
-                    (v, sh, dc, tt), tier = msearch_wave(
+                    st = msearch_wave_begin(
                         searcher, fld, [t for _, t in members], k)
+                    job["term_lanes"].append(
+                        {"fld": fld, "k": k, "members": members, "st": st})
+                except Exception as ex:  # noqa: BLE001
+                    for i, _terms in members:
+                        job["slots"][i] = ("error", ex)
+        return self._wave_mark_dispatched(job)
+
+    @staticmethod
+    def _wave_mark_dispatched(job: dict) -> dict:
+        """Count the wave's single program-launch phase: every lane's
+        programs are in flight, nothing fetched — ONE host→device
+        transition regardless of how many programs launched."""
+        pending = any(lane["state"].get("pending")
+                      for lane in job["lanes"])
+        t = job.get("tiered")
+        if t is not None:
+            pending = pending or bool(t["base"][1].get("pending")) \
+                or bool(t["tail"][1].get("pending"))
+        for tl in job.get("term_lanes", ()):
+            m = tl["st"].get("merged")
+            if m is not None and m.get("pending") is not None:
+                pending = True
+        if pending:
+            from ..telemetry import host_transition
+
+            host_transition("dispatch")
+            job["meta"]["transitions"]["dispatch"] += 1
+        return job
+
+    def search_wave_fetch(self, job: dict) -> None:
+        """Pull the wave's pending device outputs — ONE combined blocking
+        `device_get` across every lane (generic, tiered base+tail, and
+        the PR-11 deferred term lanes), so the whole wave costs a single
+        host←device round-trip however many programs it dispatched.
+        Touches no engine host state — runs on the serving completer
+        thread while the engine thread begins the next wave
+        (double-buffered pipelining)."""
+        states = [lane["state"] for lane in job["lanes"]]
+        t = job.get("tiered")
+        if t is not None:
+            states += [t["base"][1], t["tail"][1]]
+        merged = [tl["st"].get("merged")
+                  for tl in job.get("term_lanes", ())]
+        merged = [m for m in merged
+                  if m is not None and m.get("host") is None
+                  and m.get("pending") is not None]
+        pend_states = [s for s in states if s.get("pending")]
+        for s in states:
+            if not s.get("pending"):
+                s["host"] = []
+        if not pend_states and not merged:
+            return
+        import jax
+
+        from ..telemetry import host_transition, time_kernel
+
+        sp = getattr(self._searcher, "sp", None)
+        fields = dict(tier="wave",
+                      shards=(sp.S if sp is not None else 1),
+                      queries=sum(len(s.get("requests", ()))
+                                  for s in pend_states) + len(merged),
+                      k=max([m["fields"].get("k", 10) for m in merged]
+                            or [10]),
+                      num_docs=(sp.S * sp.n_max if sp is not None else 0))
+        with time_kernel("serving.wave_program", **fields):
+            host = jax.device_get(
+                [s["pending"] for s in pend_states]
+                + [m["pending"] for m in merged])
+        hi = iter(host)
+        for s in pend_states:
+            s["host"] = next(hi)
+        for m in merged:
+            m["host"] = next(hi)
+        host_transition("fetch")
+        job["meta"]["transitions"]["fetch"] += 1
+
+    def search_wave_finish(self, job: dict) -> list:
+        """Finalize a fetched wave -> per-entry response dict (or the
+        entry's exception object) in entry order. Engine thread only:
+        response building reads shard docs and stores cache entries."""
+        from ..telemetry import TRACER, record_search_slowlog
+
+        with TRACER.span("servingWaveFinalize", index=self.name,
+                         entries=len(job["entries"])):
+            for lane in job["lanes"]:
+                results = lane["searcher"].search_many_finish(
+                    lane["state"], raise_errors=False)
+                for i, res in zip(lane["ix"], results):
+                    if isinstance(res, Exception):
+                        job["slots"][i] = ("error", res)
+                        continue
+                    p = job["fmt"][i]
+                    try:
+                        if p.get("knn_clamp") is not None:
+                            # starved filtered-ANN retrieval re-runs solo
+                            # on the exact scan (same escalation as
+                            # _search_inner, so wave == solo results)
+                            if self._knn_mark_starved(
+                                    p["knn_query"],
+                                    len(res.doc_ids) + p["from_"],
+                                    p["eff_size"] + p["from_"]):
+                                tr = job["meta"]["transitions"]
+                                tr["dispatch"] += 1
+                                tr["fetch"] += 1
+                                res = lane["searcher"].search(
+                                    p["knn_query"], size=p["eff_size"],
+                                    from_=p["from_"], aggs=p["eff_aggs"])
+                            res.total = min(res.total, p["knn_clamp"])
+                        job["slots"][i] = ("resp", self._format_generic_hits(
+                            res, p["tth"], p["pf"],
+                            p.get("aggs_request"), p.get("had_pipeline"),
+                        ))
+                    except Exception as ex:  # noqa: BLE001
+                        job["slots"][i] = ("error", ex)
+            # deferred term lanes (PR 11): finish the merged programs and
+            # build responses here, after the wave's single fetch
+            import numpy as _np
+
+            for tl in job.get("term_lanes", ()):
+                members = tl["members"]
+                fld, k = tl["fld"], tl["k"]
+                try:
+                    from ..parallel.sharded import msearch_wave_finish
+
+                    (v, sh, dc, tt), tier = msearch_wave_finish(tl["st"])
                     job["meta"]["term_packed"] += len(members)
                     job["meta"]["term_waves"].append(
                         (len(members), int(tier)))
@@ -1470,55 +1605,6 @@ class EsIndex:
                 except Exception as ex:  # noqa: BLE001
                     for i, _terms in members:
                         job["slots"][i] = ("error", ex)
-        return job
-
-    @staticmethod
-    def search_wave_fetch(job: dict) -> None:
-        """Pull the wave's pending device outputs. Touches no engine host
-        state — runs on the serving completer thread while the engine
-        thread begins the next wave (double-buffered pipelining)."""
-        for lane in job["lanes"]:
-            lane["searcher"].search_many_fetch(lane["state"])
-        t = job.get("tiered")
-        if t is not None:
-            t["base"][0].search_many_fetch(t["base"][1])
-            t["tail"][0].search_many_fetch(t["tail"][1])
-
-    def search_wave_finish(self, job: dict) -> list:
-        """Finalize a fetched wave -> per-entry response dict (or the
-        entry's exception object) in entry order. Engine thread only:
-        response building reads shard docs and stores cache entries."""
-        from ..telemetry import TRACER, record_search_slowlog
-
-        with TRACER.span("servingWaveFinalize", index=self.name,
-                         entries=len(job["entries"])):
-            for lane in job["lanes"]:
-                results = lane["searcher"].search_many_finish(
-                    lane["state"], raise_errors=False)
-                for i, res in zip(lane["ix"], results):
-                    if isinstance(res, Exception):
-                        job["slots"][i] = ("error", res)
-                        continue
-                    p = job["fmt"][i]
-                    try:
-                        if p.get("knn_clamp") is not None:
-                            # starved filtered-ANN retrieval re-runs solo
-                            # on the exact scan (same escalation as
-                            # _search_inner, so wave == solo results)
-                            if self._knn_mark_starved(
-                                    p["knn_query"],
-                                    len(res.doc_ids) + p["from_"],
-                                    p["eff_size"] + p["from_"]):
-                                res = lane["searcher"].search(
-                                    p["knn_query"], size=p["eff_size"],
-                                    from_=p["from_"], aggs=p["eff_aggs"])
-                            res.total = min(res.total, p["knn_clamp"])
-                        job["slots"][i] = ("resp", self._format_generic_hits(
-                            res, p["tth"], p["pf"],
-                            p.get("aggs_request"), p.get("had_pipeline"),
-                        ))
-                    except Exception as ex:  # noqa: BLE001
-                        job["slots"][i] = ("error", ex)
             t = job.get("tiered")
             if t is not None:
                 base = t["base"][0].search_many_finish(
@@ -1538,6 +1624,20 @@ class EsIndex:
                             p["tth"]))
                     except Exception as ex:  # noqa: BLE001
                         job["slots"][i] = ("error", ex)
+            # extra device rounds taken during finish (fused escalation,
+            # two-pass aggs) roll into the wave's transition meta —
+            # counted, never hidden
+            tr = job["meta"]["transitions"]
+            extra_states = [lane["state"] for lane in job["lanes"]]
+            extra_states += [tl["st"].get("merged")
+                             for tl in job.get("term_lanes", ())]
+            if t is not None:
+                extra_states += [t["base"][1], t["tail"][1]]
+            for s in extra_states:
+                if s is None:
+                    continue
+                tr["dispatch"] += s.pop("extra_dispatches", 0)
+                tr["fetch"] += s.pop("extra_fetches", 0)
             took_ms = (time.monotonic() - job["t0"]) * 1000
             out = []
             for i, slot in enumerate(job["slots"]):

@@ -435,6 +435,43 @@ def _sharded_global_merge(fields: dict) -> dict | None:
     return allgather_merge_cost(int(s), int(q), int(k))
 
 
+def _fused_sharded_allgather(fields: dict) -> dict | None:
+    """The PR-11 fused one-program route: the per-shard fused Pallas
+    pipeline (split-bf16 in-kernel matmul + per-tile selection, num_docs
+    is the TOTAL padded docs scanned S·n_pad) inside an embedded
+    shard_map region, plus the in-program all-gather top-k merge —
+    ici_bytes judged against the interconnect peak like the other
+    collective kernels."""
+    s, k = fields.get("shards"), fields.get("k")
+    scan = _fused_pallas_scan(fields)
+    if not (s and k) or scan is None:
+        return None
+    merge = allgather_merge_cost(int(s), int(fields["queries"]), int(k))
+    out = _merge(scan, merge)
+    out["ici_bytes"] = merge["ici_bytes"]
+    return out
+
+
+def _serving_wave(fields: dict) -> dict | None:
+    """The end-to-end serving wave (PR 11): every lane's compiled
+    programs dispatched in one phase and pulled by ONE combined fetch —
+    this span wraps that fetch, so its wall time is the wave's device
+    execution. Modeled coarsely as the dominant scan over the wave's
+    total (queries × resident docs) plus the all-gather merge; per-lane
+    precision lives in the per-kernel entries, this one keeps the
+    wave-level roofline honest."""
+    s = fields.get("shards")
+    q, n = fields.get("queries"), fields.get("num_docs")
+    k = fields.get("k")
+    if not (s and q and n and k):
+        return None
+    scan = topk_scan_cost(int(q), int(n))
+    merge = allgather_merge_cost(int(s), int(q), int(k))
+    out = _merge(scan, merge)
+    out["ici_bytes"] = merge["ici_bytes"]
+    return out
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -452,6 +489,11 @@ KERNEL_COSTS: dict[str, object] = {
     # judged against the ICI peak (ici_util)
     "sharded.allgather_topk": _sharded_allgather_topk,
     "sharded.global_merge": _sharded_global_merge,
+    # PR 11: the fused Pallas arm riding the one-program route (embedded
+    # shard_map region + in-program merge), and the serving wave's
+    # single combined fetch — both collective entries with ici_util
+    "sharded.fused_allgather_topk": _fused_sharded_allgather,
+    "serving.wave_program": _serving_wave,
     "sharded.wand_pass1": None,      # pruned postings subset: rows unknown
     "sharded.wand_pass2": None,      #   until finalize — wall time only
     # impact-scored sparse tier (BM25S, PR 8)

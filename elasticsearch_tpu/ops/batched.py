@@ -72,12 +72,14 @@ def batch_term_disjunction(
     tf/dl postings — a pure gather+multiply, no BM25 math; everything
     downstream (candidate machinery, totals, merge order) is identical.
 
-    GSPMD contract (PR 10): this function is also the vmapped per-shard
-    body of the pjit sharded msearch program (`parallel/sharded.
-    _msearch_merged`), where XLA's SPMD partitioner must shard it over
-    the mesh — it must therefore stay pure XLA (no Pallas/custom calls,
-    which the partitioner cannot split; that is why the FUSED arm keeps
-    the shard_map fallback)."""
+    GSPMD contract (PR 10, relaxed PR 11): this function is also the
+    vmapped per-shard body of the pjit sharded msearch program
+    (`parallel/sharded._msearch_merged`), where XLA's SPMD partitioner
+    shards it over the mesh — keep it pure XLA so that stays true. A
+    body that needs Pallas/custom calls is no longer locked out of the
+    one-program route: it rides an embedded shard_map manual region
+    instead (`parallel/spmd.manual_shard_region`, the fused arm's PR-11
+    path) — manual regions never ask the partitioner to split anything."""
     Ts, B, k = plan_shapes
     live = dev["live"]
     n = num_docs

@@ -40,13 +40,15 @@ quantized norms) produce bit-identical scores in both precisions, so the
 kernel's docid tie-break already orders them correctly; the safety test
 treats an exact kth==K'th rescored tie as safe for that reason.
 
-SPMD note (PR 10): these Pallas kernels are custom calls XLA's GSPMD
-partitioner cannot shard, so every sharded consumer
-(`parallel/sharded._FusedShardedMsearch`) keeps the explicit shard_map
-execution model even when ES_TPU_SPMD resolves to pjit — manual
-partitioning is the only way to run a Pallas body per mesh device. The
-pure-XLA arms (exact/impact disjunction) are the ones that ride the
-one-program pjit path with the on-device all-gather merge.
+SPMD note (PR 10, closed PR 11): these Pallas kernels are custom calls
+XLA's GSPMD partitioner cannot shard — but manual partitioning needs no
+partitioner, so the sharded consumer
+(`parallel/sharded._FusedShardedMsearch.msearch_merged_begin`) runs the
+pipeline inside a shard_map region EMBEDDED in the one compiled pjit
+program (`parallel/spmd.manual_shard_region`), feeding the on-device
+all-gather top-k merge in the same program. The standalone shard_map +
+host-merge form survives only as the legacy-execution-model / parity-
+oracle route; there is no `ES_TPU_SPMD` arm matrix for the fused tier.
 
 Round-4 restructure (the round-3 bottleneck was ~3,900 grid steps of fixed
 sequencing/DMA-issue cost plus per-step tiered top-K' accumulator merges of

@@ -27,6 +27,14 @@ score descending, docid ascending on ties, -inf for dead lanes. On non-TPU
 backends `scan_topk` dispatches to an XLA reference implementation with
 identical semantics (tests compare both, running the kernel in interpret
 mode).
+
+Sharded execution (PR 11): these kernels are custom calls GSPMD cannot
+partition, so sharded callers run them inside shard_map manual regions
+embedded in the one compiled SPMD program
+(`parallel/spmd.manual_shard_region`) — per-shard shapes reach the
+kernel exactly as the single-device path builds them, and the
+surrounding program (all-gather top-k merge) stays GSPMD. No caller
+pins the XLA arm for partitionability anymore.
 """
 
 from __future__ import annotations

@@ -198,8 +198,6 @@ def top_k_with_total(
     match: jax.Array,  # [N+1] bool
     live: jax.Array,  # [N] bool
     k: int,
-    *,
-    force_xla: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Global top-k by (score desc, docid asc) + exact total hit count.
 
@@ -216,16 +214,16 @@ def top_k_with_total(
     scatter/gather, C2's exhaustive fallback arm) rides the fused path.
     'force' engages it on CPU through the interpreter (tests).
 
-    force_xla=True pins the sort-based lax.top_k arm regardless of the
-    env routing: callers tracing GSPMD (pjit) shard bodies pass it
-    because the Pallas scan is a custom call XLA's SPMD partitioner
-    cannot shard — the order/totals contract is identical either way.
+    PR 11 note: callers tracing sharded bodies no longer pin the XLA arm
+    (`force_xla` is gone) — pjit shard bodies run inside embedded
+    shard_map manual regions (parallel/spmd.manual_shard_region), where
+    the Pallas scan is legal because nothing asks GSPMD to partition it.
     """
     import os
 
     n = live.shape[0]
     ok = match[:n] & live
-    if not force_xla and _fused_scan_engages(n, k):
+    if _fused_scan_engages(n, k):
         force = os.environ.get("ES_TPU_FUSED_TOPK", "auto") == "force"
         on_tpu = jax.default_backend() == "tpu"
         from .kernels import scan_topk

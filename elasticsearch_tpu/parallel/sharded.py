@@ -386,15 +386,15 @@ class StackedSearcher:
         # a shard can contribute at most n_max hits; the global k may exceed it
         k_local = min(k, n)
         k_global = min(k, S * k_local)
-        # inside one GSPMD program the selection tier is plain lax.top_k:
-        # the streamed Pallas scan is a custom call XLA's SPMD partitioner
-        # cannot shard (identical order contract either way)
-        force_xla = self._exec == "pjit"
 
         def shard_body(dev1, par1, agg_par1):
+            # PR 11: no force_xla pin — the body runs inside an embedded
+            # shard_map manual region, where the streamed Pallas scan is
+            # legal (GSPMD never sees the custom call), so the selection
+            # tier is the SAME one the single-device path picks
             scores, match = node.device_eval(dev1, par1, ctx)
             ts, ti, tot = top_k_with_total(scores, match, dev1["live"],
-                                           k_local, force_xla=force_xla)
+                                           k_local)
             agg_out = {}
             if agg_nodes:
                 ok = match[:n] & dev1["live"]
@@ -406,33 +406,17 @@ class StackedSearcher:
                     )
             return ts, ti, tot, agg_out
 
-        if self._exec == "shardmap":
-            import jax.tree_util as jtu
+        from .spmd import constrain_shards, manual_shard_region
 
-            def spmd(dev, params, agg_params):
-                def body(dev_s, par_s, agg_s):
-                    sq = lambda t: jtu.tree_map(lambda x: x[0], t)
-                    outs = shard_body(sq(dev_s), sq(par_s), sq(agg_s))
-                    return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+        region = manual_shard_region(
+            shard_body, self.mesh,
+            in_specs=(P("shards"), P("shards"), P("shards")))
 
-                return shard_map(
-                    body,
-                    mesh=self.mesh,
-                    in_specs=(P("shards"), P("shards"), P("shards")),
-                    out_specs=P("shards"),
-                )(dev, params, agg_params)
-
-            inner = spmd
-        else:
-            from .spmd import constrain_shards
-
-            def inner(dev, params, agg_params):
-                # GSPMD: the vmapped per-shard body partitions over the
-                # mesh because the pack inputs are sharded; the constraint
-                # pins the [S, ...] outputs to stay shard-local until the
-                # merge below forces the all-gather
-                outs = jax.vmap(shard_body)(dev, params, agg_params)
-                return constrain_shards(outs, self.mesh)
+        def inner(dev, params, agg_params):
+            # the constraint pins the [S, ...] outputs shard-local until
+            # the merge below forces the all-gather
+            return constrain_shards(region(dev, params, agg_params),
+                                    self.mesh)
 
         def run(dev, params, agg_params):
             ts, ti, tot, agg_out = inner(dev, params, agg_params)
@@ -628,25 +612,13 @@ class StackedSearcher:
             )
             return gmax, gdoc, total
 
-        if self._exec == "shardmap":
-            import jax.tree_util as jtu
+        from .spmd import constrain_shards, manual_shard_region
 
-            def inner(dev, params):
-                def body(dev_s, par_s):
-                    sq = lambda t: jtu.tree_map(lambda x: x[0], t)
-                    outs = shard_body(sq(dev_s), sq(par_s))
-                    return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+        region = manual_shard_region(
+            shard_body, self.mesh, in_specs=(P("shards"), P("shards")))
 
-                return shard_map(
-                    body, mesh=self.mesh,
-                    in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
-                )(dev, params)
-        else:
-            from .spmd import constrain_shards
-
-            def inner(dev, params):
-                return constrain_shards(jax.vmap(shard_body)(dev, params),
-                                        self.mesh)
+        def inner(dev, params):
+            return constrain_shards(region(dev, params), self.mesh)
 
         def run(dev, params):
             gmax, gdoc, tot = inner(dev, params)  # [S, V+1] x2, [S]
@@ -736,25 +708,13 @@ class StackedSearcher:
                 scores, match = node.device_eval(dev1, par1, ctx)
                 return scores[:n], match[:n] & dev1["live"]
 
-            if self._exec == "shardmap":
-                import jax.tree_util as jtu
+            from .spmd import constrain_shards, manual_shard_region
 
-                def inner(dev, params):
-                    def body(dev_s, par_s):
-                        sq = lambda t: jtu.tree_map(lambda x: x[0], t)
-                        outs = shard_body(sq(dev_s), sq(par_s))
-                        return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+            region = manual_shard_region(
+                shard_body, self.mesh, in_specs=(P("shards"), P("shards")))
 
-                    return shard_map(
-                        body, mesh=self.mesh,
-                        in_specs=(P("shards"), P("shards")), out_specs=P("shards"),
-                    )(dev, params)
-            else:
-                from .spmd import constrain_shards
-
-                def inner(dev, params):
-                    return constrain_shards(
-                        jax.vmap(shard_body)(dev, params), self.mesh)
+            def inner(dev, params):
+                return constrain_shards(region(dev, params), self.mesh)
 
             def run(dev, params, sh, di):
                 scores, match = inner(dev, params)  # [S, n]
@@ -1285,9 +1245,13 @@ class StackedSearcher:
                 if self._agg_pass2_dispatch(s):
                     wave2.append(s)
         if wave2:
+            # rare two-pass terms aggs: one extra dispatch + fetch round,
+            # recorded so the wave's host-transition meta stays honest
             host2 = jax.device_get([s["outs2"] for s in wave2])
             for s, h2 in zip(wave2, host2):
                 s["host2"] = h2
+            st["extra_dispatches"] = st.get("extra_dispatches", 0) + 1
+            st["extra_fetches"] = st.get("extra_fetches", 0) + 1
         from ..telemetry import metrics as _metrics
 
         wave_ms = (_time.perf_counter() - st["t0"]) * 1000
@@ -1498,33 +1462,17 @@ class StackedSearcher:
                 agg_out,
             )
 
-        if self._exec == "shardmap":
-            import jax.tree_util as jtu
+        from .spmd import constrain_shards, manual_shard_region
 
-            def spmd(dev, params, after, agg_params):
-                def body(dev_s, par_s, after_s, agg_s):
-                    sq = lambda t: jtu.tree_map(lambda x: x[0], t)
-                    outs = shard_body(sq(dev_s), sq(par_s), after_s, sq(agg_s))
-                    return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+        region = manual_shard_region(
+            shard_body, self.mesh,
+            in_specs=(P("shards"), P("shards"), P(), P("shards")))
 
-                return shard_map(
-                    body,
-                    mesh=self.mesh,
-                    in_specs=(P("shards"), P("shards"), P(), P("shards")),
-                    out_specs=P("shards"),
-                )(dev, params, after, agg_params)
+        def run(dev, params, after, agg_params):
+            return constrain_shards(region(dev, params, after, agg_params),
+                                    self.mesh)
 
-            fn = jax.jit(spmd)
-        else:
-            from .spmd import constrain_shards
-
-            def vm(dev, params, after, agg_params):
-                outs = jax.vmap(
-                    lambda d, p, a: shard_body(d, p, after, a)
-                )(dev, params, agg_params)
-                return constrain_shards(outs, self.mesh)
-
-            fn = jax.jit(vm)
+        fn = jax.jit(run)
         self._cache[cache_key] = fn
         return fn
 
@@ -1632,11 +1580,18 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
     ES_TPU_FUSED on TPU or forced), each shard runs the fused tiled
     pipeline (ops/fused._fused_pipeline — in-kernel dense matmul +
     per-tile top-t + canonical f32 rescore) instead of the legacy
-    disjunction kernel; queries flagged by any shard re-run on the legacy
-    exact arm, so results never depend on the fused pass.
+    disjunction kernel. Under the pjit execution model (PR 11) the
+    pipeline runs inside an embedded shard_map manual region of the ONE
+    compiled SPMD program that also merges on-device; the shard_map
+    partials + host-merge form survives only as the legacy-model /
+    test-oracle route. Queries flagged by any shard re-run on the exact
+    arm either way, so results never depend on the fused pass.
 
-    The shard request cache fronts both arms with per-SHARD entries: each
-    (query, shard) pair's pre-merge top-k row is cached under
+    The shard request cache fronts the routes at the storage granularity
+    matching each execution model: pjit searchers key at WAVE scope and
+    store post-merge per-query rows (so the one-program route stays
+    engaged when warm); legacy models keep per-SHARD entries — each
+    (query, shard) pair's pre-merge top-k row cached under
     (shard token, shard epoch, canonical query key), so a partially-warm
     msearch only re-scores queries with at least one cold shard, reuses
     warm shards' cached rows at the coordinator merge, and a single
@@ -1644,33 +1599,27 @@ def msearch_sharded(ss: "StackedSearcher", fld: str,
 
     -> (scores [Q, k], shard [Q, k], docid [Q, k], totals [Q]) numpy.
     """
-    if not _return_program and queries:
-        from ..cache import request_cache
+    if _return_program or not queries:
+        return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
+    from ..cache import request_cache
 
-        rc = request_cache()
-        if rc.enabled:
-            return _msearch_sharded_cached(ss, rc, fld, queries, k)
-    fs = _fused_sharded_for(ss)
-    if fs is not None and not _return_program and fs.usable(k):
-        return fs.msearch(fld, queries, k)
-    # pjit (the default mesh mode): impact > exact, each ONE compiled
-    # SPMD program including the on-device all-gather + top-k merge —
-    # byte-identical rows to the partials + host-merge path below
-    # (tests/test_spmd.py). Keyed on the searcher's RESOLVED mode so a
-    # later env flip cannot split a searcher across execution models.
-    if (not _return_program and queries
-            and getattr(ss, "_exec", "vmap") == "pjit"):
+    rc = request_cache()
+    if rc.enabled:
+        return _msearch_sharded_cached(ss, rc, fld, queries, k)
+    # pjit (the resolved default, incl. single-query meshes): ONE
+    # compiled SPMD program — fused Pallas arm (embedded shard_map
+    # region) > impact > exact, each including the on-device all-gather
+    # + top-k merge. Byte-identical rows to the partials + host-merge
+    # oracle below (tests/test_spmd.py). No per-tier env fork: the arm
+    # is chosen by pack shape alone, the execution model by the
+    # searcher's RESOLVED mode (so a later env flip cannot split a
+    # searcher across execution models).
+    if getattr(ss, "_exec", "vmap") == "pjit":
         return _msearch_merged(ss, fld, queries, k)
-    # the uncached fall-through must route the SAME arm priority as the
-    # cached path (_msearch_sharded_partials: fused > impact > exact) —
-    # it previously skipped straight to exact, so disabling the request
-    # cache silently disengaged the impact tier (caught by the shuffled
-    # cache-off gate)
-    if not _return_program and queries and _impact_sharded_usable(ss):
-        out = _msearch_impact_partials(ss, fld, queries, k)
-        if out is not None:
-            return _merge_shard_rows(*out)
-    return _msearch_sharded_exact(ss, fld, queries, k, _return_program)
+    # legacy execution models (shard_map test oracle / off-mesh vmap):
+    # per-shard partials + host coordinator merge, fused > impact >
+    # exact — the SAME arm priority as the merged route
+    return _merge_shard_rows(*_msearch_sharded_partials(ss, fld, queries, k))
 
 
 def msearch_wave(ss: "StackedSearcher", fld: str, queries: list,
@@ -1686,13 +1635,55 @@ def msearch_wave(ss: "StackedSearcher", fld: str, queries: list,
     Each real query's row is byte-identical to a solo 1-query wave: rows
     are computed independently per query and pad lanes contribute exact
     zeros (the serving parity contract, tests/test_serving.py)."""
+    st = msearch_wave_begin(ss, fld, queries, k)
+    msearch_wave_fetch(st)
+    return msearch_wave_finish(st)
+
+
+def msearch_wave_begin(ss: "StackedSearcher", fld: str, queries: list,
+                       k: int = 10) -> dict:
+    """Wave-deferred term lane (PR 11): pad to the compiled batch tier,
+    consult the request cache, and DISPATCH the cold subset's ONE merged
+    SPMD program without fetching anything — the serving wave's single
+    fetch stage (`engine.search_wave_fetch`) pulls this lane together
+    with every other lane in one host round-trip, so the term lane no
+    longer blocks the scheduler thread inside `search_wave_begin`.
+
+    The deferred merged route serves both the pjit mesh AND the off-mesh
+    vmap model (a single-device merge is still one program with a k-row
+    fetch); only the shard_map oracle resolves synchronously here — it
+    is a test fixture, not a serving model."""
     from ..ops.batched import BatchTermSearcher
 
     Q = len(queries)
     tier = BatchTermSearcher.wave_q_tier(Q)
     padded = list(queries) + [[] for _ in range(tier - Q)]
-    v, s, d, t = msearch_sharded(ss, fld, padded, k)
-    return (v[:Q], s[:Q], d[:Q], t[:Q]), tier
+    st = {"Q": Q, "tier": tier}
+    if getattr(ss, "_exec", "vmap") == "shardmap":
+        st["result"] = msearch_sharded(ss, fld, padded, k)
+        return st
+    st.update(_merged_cached_begin(ss, fld, padded, k))
+    return st
+
+
+def msearch_wave_fetch(st: dict) -> None:
+    """Pull the wave's pending merged-program outputs (no-op when the
+    lane resolved in begin or the engine's combined wave fetch already
+    delivered them)."""
+    m = st.get("merged")
+    if m is not None:
+        _msearch_merged_fetch(m)
+
+
+def msearch_wave_finish(st: dict):
+    """-> ((scores [Q,k], shard, doc, totals [Q]), tier); stores cold
+    rows into the request cache (engine thread)."""
+    if "result" in st:
+        v, s, d, t = st["result"]
+    else:
+        v, s, d, t = _merged_cached_finish(st)
+    Q = st["Q"]
+    return (v[:Q], s[:Q], d[:Q], t[:Q]), st["tier"]
 
 
 def _merge_shard_rows(v, i, t):
@@ -1742,13 +1733,97 @@ def _msearch_sharded_partials(ss: "StackedSearcher", fld: str,
     return _msearch_exact_partials(ss, fld, queries, k)
 
 
+def _merged_cached_begin(ss: "StackedSearcher", fld: str, queries: list,
+                         k: int) -> dict:
+    """Wave-scope cache front for the merged pjit route (PR 11
+    satellite): post-merge per-query rows are the storage unit, keyed
+    under the WHOLE-SEARCHER scope (`cache_scope`: every shard's epoch),
+    so a warm cache serves merged rows directly and the cold subset
+    rides the ONE-program route — previously an enabled cache silently
+    forced every pjit msearch onto the slower partials + host-merge
+    path, whose per-shard rows were the only storage unit. Dispatches
+    the cold subset WITHOUT fetching; `_merged_cached_finish` assembles
+    and stores. With the cache disabled this degrades to cold=everything
+    and no stores."""
+    from ..cache import canonical_key, request_cache
+
+    rc = request_cache()
+    st = {"ss": ss, "fld": fld, "k": k, "queries": queries,
+          "rows": {}, "cold": list(range(len(queries))),
+          "qkeys": None, "scope": None, "merged": None}
+    if rc.enabled:
+        qkeys = [
+            canonical_key({"op": "msearch_merged", "fld": fld, "k": int(k),
+                           "q": [[t, float(b)] for t, b in q]})
+            for q in queries
+        ]
+        tok, ep = ss.cache_scope()
+        cold = []
+        for qi, ck in enumerate(qkeys):
+            got = rc.get(tok, ep, ck)
+            if got is None:
+                cold.append(qi)
+            else:
+                st["rows"][qi] = got
+        from ..telemetry import profile_event
+
+        profile_event("cache", scope="msearch_merged",
+                      hits=len(queries) - len(cold), misses=len(cold))
+        st.update(cold=cold, qkeys=qkeys, scope=(tok, ep))
+    if st["cold"]:
+        st["merged"] = _msearch_merged_begin(
+            ss, fld, [queries[qi] for qi in st["cold"]], k)
+    return st
+
+
+def _merged_cached_finish(st: dict):
+    """Assemble warm + freshly merged rows -> (v [Q, kk], shard, doc,
+    totals [Q]); stores cold rows under the wave-scope keys."""
+    from ..cache import request_cache
+
+    rows, cold = st["rows"], st["cold"]
+    if st["merged"] is not None:
+        cv, csh, ci, ct = _msearch_merged_finish(st["merged"])
+        rc = request_cache()
+        for j, qi in enumerate(cold):
+            row = (cv[j].copy(), csh[j].copy(), ci[j].copy(), int(ct[j]))
+            rows[qi] = row
+            if st["qkeys"] is not None and rc.enabled:
+                tok, ep = st["scope"]
+                rc.put(tok, ep, st["qkeys"][qi], row,
+                       row[0].nbytes + row[1].nbytes + row[2].nbytes + 96)
+    Q = len(st["queries"])
+    width = max((r[0].shape[0] for r in rows.values()), default=st["k"])
+    V = np.full((Q, width), -np.inf, np.float32)
+    SH = np.zeros((Q, width), np.int32)
+    I = np.zeros((Q, width), np.int64)
+    T = np.zeros((Q,), np.int64)
+    for qi, (rv, rs, ri, rt) in rows.items():
+        V[qi, : rv.shape[0]] = rv
+        SH[qi, : rs.shape[0]] = rs
+        I[qi, : ri.shape[0]] = ri
+        T[qi] = rt
+    return V, SH, I, T
+
+
 def _msearch_sharded_cached(ss: "StackedSearcher", rc, fld: str,
                             queries: list, k: int):
-    """Per-shard cached msearch: warm (query, shard) rows come from the
-    cache, queries with any cold shard re-score (one batched SPMD dispatch
-    over the cold subset — the device program always runs all shards, but
-    warm shards' CACHED rows stay authoritative for the merge and warm
+    """Cached msearch. pjit searchers key at WAVE scope and store
+    post-merge rows so the one-program route stays engaged
+    (_merged_cached_begin); legacy execution models keep the per-shard
+    storage unit: warm (query, shard) rows come from the cache, queries
+    with any cold shard re-score (one batched SPMD dispatch over the
+    cold subset — the device program always runs all shards, but warm
+    shards' CACHED rows stay authoritative for the merge and warm
     entries are never re-stored), then one coordinator merge."""
+    if getattr(ss, "_exec", "vmap") == "pjit":
+        st = _merged_cached_begin(ss, fld, queries, k)
+        if st["merged"] is not None:
+            from ..telemetry import host_transition
+
+            host_transition("dispatch")
+            _msearch_merged_fetch(st["merged"])
+        return _merged_cached_finish(st)
     from ..cache import canonical_key
 
     S = ss.sp.S
@@ -1929,26 +2004,76 @@ def _msearch_sharded_exact(ss: "StackedSearcher", fld: str,
 
 def _msearch_merged(ss: "StackedSearcher", fld: str, queries: list, k: int,
                     _return_program=False):
-    """The pjit msearch arm (PR 10): ONE compiled SPMD program per plan
-    shape — vmapped per-shard disjunction bodies over the sharded pack
-    pytree AND the global top-k merge (`lax.top_k` over the ICI
-    all-gather of the per-shard (score, shard_doc) rows) in the same
-    program. No host round-trip between shard scan and coordinator
+    """The one-program msearch route: dispatch + fetch + finish in one
+    call (solo callers; the serving wave drives the stages separately
+    through `msearch_wave_begin/fetch/finish`)."""
+    st = _msearch_merged_begin(ss, fld, queries, k,
+                               _return_program=_return_program)
+    if _return_program:
+        return st
+    from ..telemetry import host_transition
+
+    host_transition("dispatch")
+    _msearch_merged_fetch(st)
+    return _msearch_merged_finish(st)
+
+
+def _msearch_merged_begin(ss: "StackedSearcher", fld: str, queries: list,
+                          k: int, _return_program=False):
+    """Plan + DISPATCH the pjit msearch arm (PR 10, reworked PR 11): ONE
+    compiled SPMD program per plan shape — per-shard scoring bodies over
+    the sharded pack pytree AND the global top-k merge (`lax.top_k` over
+    the ICI all-gather of the per-shard (score, shard_doc) rows) in the
+    same program. No host round-trip between shard scan and coordinator
     merge; device->host traffic is k rows per query instead of S*k.
-    Arm priority matches the partials path: impact > exact (the fused
-    Pallas arm stays on its shard_map fallback — custom calls cannot be
-    auto-partitioned by GSPMD)."""
+    Arm priority matches the partials oracle: fused > impact > exact —
+    the fused Pallas pipeline rides an embedded shard_map manual region
+    inside the SAME compiled program (PR 11: the `ES_TPU_SPMD` arm
+    matrix for the fused tier is gone).
+
+    -> a state dict for `_msearch_merged_fetch` / `_msearch_merged_finish`
+    (or the (fn, args, kk) program triple under _return_program)."""
+    if not _return_program:
+        fs = _fused_sharded_for(ss)
+        if fs is not None and fs.usable(k):
+            return fs.msearch_merged_begin(fld, queries, k)
     if _impact_sharded_usable(ss):
-        out = _msearch_merged_arm(ss, fld, queries, k, impact=True,
-                                  _return_program=_return_program)
+        out = _msearch_merged_arm_begin(ss, fld, queries, k, impact=True,
+                                        _return_program=_return_program)
         if out is not None:
             return out
-    return _msearch_merged_arm(ss, fld, queries, k, impact=False,
-                               _return_program=_return_program)
+    return _msearch_merged_arm_begin(ss, fld, queries, k, impact=False,
+                                     _return_program=_return_program)
 
 
-def _msearch_merged_arm(ss: "StackedSearcher", fld: str, queries: list,
-                        k: int, *, impact: bool, _return_program=False):
+def _msearch_merged_fetch(st: dict) -> None:
+    """Pull the merged program's outputs — the lane's ONE blocking
+    device round-trip. Skips cleanly when the engine's combined wave
+    fetch already delivered `st["host"]`."""
+    if st.get("host") is not None or st.get("pending") is None:
+        return
+    from ..telemetry import host_transition, time_kernel
+
+    with time_kernel(st["kernel"], **st["fields"]):
+        st["host"] = jax.device_get(st["pending"])
+    host_transition("fetch")
+
+
+def _msearch_merged_finish(st: dict):
+    """-> (scores [Q, kk], shard [Q, kk] i32, doc [Q, kk], totals [Q])."""
+    _msearch_merged_fetch(st)  # no-op when the wave fetch already ran
+    return st["finish"](st)
+
+
+def _merged_rows_finish(st: dict):
+    mv, msh, mi, mt = st["host"]
+    return (np.asarray(mv), np.asarray(msh).astype(np.int32),
+            np.asarray(mi), np.asarray(mt))
+
+
+def _msearch_merged_arm_begin(ss: "StackedSearcher", fld: str,
+                              queries: list, k: int, *, impact: bool,
+                              _return_program=False):
     from ..ops.batched import batch_term_disjunction
 
     sp = ss.sp
@@ -1995,16 +2120,16 @@ def _msearch_merged_arm(ss: "StackedSearcher", fld: str, queries: list,
             return merge_topk_rows(v, i, t, mesh=mesh)
 
         fn = ss._cache[cache_key] = jax.jit(run)
+    iws = pl.get("iws")
+    if iws is None:
+        iws = np.zeros_like(pl["ws"])
     if _return_program:
         # measurement hook (scripts/c5_mesh_probe.py): the ONE compiled
         # program + its device inputs, so the in-program merge cost can
         # be timed against the shard-local partials program
-        iws0 = pl.get("iws")
-        if iws0 is None:
-            iws0 = np.zeros_like(pl["ws"])
         return fn, (sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
-                    jnp.asarray(pl["ws"]), jnp.asarray(iws0)), kk
-    from ..telemetry import profile_event, time_kernel
+                    jnp.asarray(pl["ws"]), jnp.asarray(iws)), kk
+    from ..telemetry import profile_event
 
     tier = "impact" if impact else "exact"
     profile_event("tier", tier=tier, queries=Q)
@@ -2013,15 +2138,11 @@ def _msearch_merged_arm(ss: "StackedSearcher", fld: str, queries: list,
     if impact:
         fields["code_bytes"] = int(
             np.dtype(ss.dev["impact_codes"].dtype).itemsize)
-    iws = pl.get("iws")
-    if iws is None:
-        iws = np.zeros_like(pl["ws"])
-    with time_kernel("sharded.allgather_topk", **fields):
-        mv, msh, mi, mt = jax.device_get(
-            fn(sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
-               jnp.asarray(pl["ws"]), jnp.asarray(iws)))
-    return (np.asarray(mv), np.asarray(msh).astype(np.int32),
-            np.asarray(mi), np.asarray(mt))
+    outs = fn(sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
+              jnp.asarray(pl["ws"]), jnp.asarray(iws))
+    return {"pending": outs, "host": None,
+            "kernel": "sharded.allgather_topk", "fields": fields,
+            "finish": _merged_rows_finish}
 
 
 def global_merge_rows(ss: "StackedSearcher", v, i, t):
@@ -2153,14 +2274,24 @@ class _FusedShardedMsearch:
     """C5 `_msearch` through the fused kernel, one pipeline per shard.
 
     The same `ops/fused._fused_pipeline` program that serves single-shard
-    C1 runs as the SPMD shard body here: per shard, the in-kernel dense
-    matmul + per-tile top-t + one-hot sparse scatter + canonical f32
-    rescore (lax.scan over QC-query chunks), with the [S, Q, k] partials
-    gathered and merged by the coordinator in (score desc, shard asc,
-    doc asc) order. Queries flagged by ANY shard (window overflow, tile
-    saturation, margin test) re-run on the legacy exact arm, so results
-    never depend on the fused pass — the same escalation contract as
-    FusedTermSearcher."""
+    C1 runs as the per-shard body here: the in-kernel dense matmul +
+    per-tile top-t + one-hot sparse scatter + canonical f32 rescore
+    (lax.scan over QC-query chunks). Two routes share that body:
+
+      * `msearch_merged_begin` (PR 11, the production pjit route) — the
+        body runs inside an embedded shard_map manual region of ONE
+        compiled SPMD program that also performs the on-device
+        all-gather top-k merge; the host fetches k merged rows + one
+        escalation bool per query.
+      * `msearch` / `msearch_partials` (the shard_map oracle) — [S, Q, k]
+        partials fetched and merged by the host coordinator in
+        (score desc, shard asc, doc asc) order; kept as the parity
+        fixture and the per-shard-cache execution arm of the legacy
+        execution models.
+
+    Queries flagged by ANY shard (window overflow, tile saturation,
+    margin test) re-run on the exact arm, so results never depend on
+    the fused pass — the same escalation contract as FusedTermSearcher."""
 
     def __init__(self, ss: "StackedSearcher"):
         from ..ops import fused as F
@@ -2239,7 +2370,10 @@ class _FusedShardedMsearch:
             self._fa_live_of = dev["live"]
         return self._fa
 
-    def _compiled(self, fld, C, R, Td, k, nreal, interpret):
+    def _geom(self, nreal):
+        """Shared kernel geometry of one fused batch: (bud, tile_n,
+        qsub, t) — window budget from the REAL posting count, pow2-
+        quantized (see FusedTermSearcher._compiled_scan)."""
         from ..index.pack import BLOCK
         from ..ops import fused as F
 
@@ -2251,7 +2385,12 @@ class _FusedShardedMsearch:
         bude = min(
             64 * 1024, max(2048, 1 << (2 * mean_win - 1).bit_length())
         )
-        bud = bude // 128
+        return bude // 128, tile_n, qsub, t
+
+    def _compiled(self, fld, C, R, Td, k, nreal, interpret):
+        from ..ops import fused as F
+
+        bud, tile_n, qsub, t = self._geom(nreal)
         key = (fld, C, R, Td, k, interpret, bud, tile_n, qsub, t,
                self._inkernel, self.ss.mesh is None)
         fn = self._cache.get(key)
@@ -2275,44 +2414,159 @@ class _FusedShardedMsearch:
             _, outs = jax.lax.scan(body, 0, (rows, row_q, row_w, dr, dw))
             return outs
 
-        if self.ss.mesh is not None:
-            import jax.tree_util as jtu
+        from .spmd import manual_shard_region
 
-            def run(fa, avgdl, rows, row_q, row_w, dr, dw):
-                def body(fa_s, avgdl_s, rows_s, rq_s, rw_s, dr_s, dw_s):
-                    sq = lambda t_: jtu.tree_map(lambda x: x[0], t_)
-                    outs = shard_scan(
-                        sq(fa_s), avgdl_s, rows_s[0], rq_s[0], rw_s[0],
-                        dr_s[0], dw_s[0])
-                    return jtu.tree_map(lambda x: x[None], outs)
+        run = manual_shard_region(
+            shard_scan, self.ss.mesh,
+            in_specs=(P("shards"), P()) + (P("shards"),) * 5)
+        fn = self._cache[key] = jax.jit(run)
+        return fn
 
-                return shard_map(
-                    body, mesh=self.ss.mesh,
-                    in_specs=(P("shards"), P()) + (P("shards"),) * 5,
-                    out_specs=P("shards"),
-                )(fa, avgdl, rows, row_q, row_w, dr, dw)
-        else:
+    def _compiled_merged(self, fld, C, R, Td, k, nreal, interpret):
+        """ONE compiled SPMD program (PR 11, ROADMAP item 1): the
+        per-shard fused Pallas pipeline runs inside an embedded
+        shard_map manual region — custom calls cannot be GSPMD-
+        partitioned, but a manual region never asks the partitioner —
+        and its sharded [S, C·qc, k] rows feed the on-device all-gather
+        top-k merge in the SAME program. The per-query escalation flag
+        is OR'd across shards in-program too, so the host fetches
+        merged k-rows + one bool per query: no more fused-tier fork off
+        the one-program route, no S·k-row fetch, no host merge."""
+        from ..ops import fused as F
 
-            def run(fa, avgdl, rows, row_q, row_w, dr, dw):
-                return jax.vmap(
-                    shard_scan, in_axes=(0, None, 0, 0, 0, 0, 0)
-                )(fa, avgdl, rows, row_q, row_w, dr, dw)
+        bud, tile_n, qsub, t = self._geom(nreal)
+        key = ("merged", fld, C, R, Td, k, interpret, bud, tile_n, qsub,
+               t, self._inkernel, self.ss.mesh is None)
+        fn = self._cache.get(key)
+        from ..monitoring.device import note_executable_cache
+
+        note_executable_cache("sharded_fused", fn is not None)
+        if fn is not None:
+            return fn
+        kw = dict(
+            k=k, n=self.n_max, n_pad=self.n_pad,
+            has_norms=fld in self.ss.ctx.has_norms,
+            k1=1.2, b=0.75,
+            bud=bud, t=t, tile_n=tile_n, qsub=qsub,
+            interpret=interpret, inkernel=self._inkernel,
+        )
+
+        def shard_scan(fa1, avgdl, rows, row_q, row_w, dr, dw):
+            def body(carry, xs):
+                return carry, F._fused_pipeline(fa1, avgdl, *xs, **kw)
+
+            _, outs = jax.lax.scan(body, 0, (rows, row_q, row_w, dr, dw))
+            return outs
+
+        from .spmd import constrain_shards, manual_shard_region, \
+            merge_topk_rows
+
+        mesh = self.ss.mesh
+        region = manual_shard_region(
+            shard_scan, mesh,
+            in_specs=(P("shards"), P()) + (P("shards"),) * 5)
+
+        def run(fa, avgdl, rows, row_q, row_w, dr, dw):
+            v, i, tot, fl = region(fa, avgdl, rows, row_q, row_w, dr, dw)
+            S_, C_, qc, kk = v.shape
+            v2, i2, t2 = constrain_shards(
+                (v.reshape(S_, C_ * qc, kk), i.reshape(S_, C_ * qc, kk),
+                 tot.reshape(S_, C_ * qc)), mesh)
+            mv, msh, mi, mt = merge_topk_rows(v2, i2, t2, mesh=mesh)
+            flags = jnp.any(fl.reshape(S_, C_ * qc), axis=0)
+            return mv, msh, mi, mt, flags
 
         fn = self._cache[key] = jax.jit(run)
         return fn
 
     def msearch(self, fld, queries, k):
+        """Shard_map oracle route: per-shard partials + host merge —
+        kept for the legacy execution model and parity fixtures; the
+        production pjit route is `msearch_merged_begin`."""
         return _merge_shard_rows(*self.msearch_partials(fld, queries, k))
 
-    def msearch_partials(self, fld, queries, k):
-        """Pre-merge per-shard rows (scores [S, Q, kk], ids, totals
-        [S, Q]); queries flagged by ANY shard have their per-shard rows
-        replaced by the exact arm's partials, so the merge (and any cached
-        per-shard entry) never depends on the fused pass."""
+    def msearch_merged(self, fld, queries, k):
+        """The one-program fused msearch, begin+fetch+finish in one call
+        (tests/probes; the serving wave drives the stages separately)."""
+        st = self.msearch_merged_begin(fld, queries, k)
+        _msearch_merged_fetch(st)
+        return st["finish"](st)
+
+    def msearch_merged_begin(self, fld, queries, k) -> dict:
+        """Plan + DISPATCH the fused one-program route (no fetch)."""
+        from ..telemetry import profile_event
+
+        idxs, pb = self._plan_batch(fld, queries, k)
+        interpret = jax.default_backend() != "tpu"
+        fn = self._compiled_merged(fld, pb["C"], pb["R"], pb["Td"], k,
+                                   pb["nreal"], interpret)
+        outs = fn(self._arrays(), pb["avgdl"], pb["rows"], pb["row_q"],
+                  pb["row_w"], pb["dr"], pb["dw"])
+        Q = len(queries)
+        profile_event("tier", tier="fused", queries=Q)
+        fields = dict(tier="fused", shards=self.S, queries=Q, k=k,
+                      v=self.ss.sp.dense_v, num_docs=self.S * self.n_pad)
+        return {"pending": outs, "host": None,
+                "kernel": "sharded.fused_allgather_topk", "fields": fields,
+                "finish": self._merged_finish,
+                "idxs": idxs, "queries": queries, "fld": fld, "k": k}
+
+    def _merged_finish(self, st: dict):
+        """Fetched merged outputs -> (scores [Q, k], shard, doc, totals);
+        flagged queries re-run on the exact merged arm (the escalation
+        contract of the oracle route, at merged-row granularity)."""
         from ..ops import fused as F
 
-        ss = self.ss
-        sp = ss.sp
+        mv, msh, mi, mt, fl = [np.asarray(x) for x in st["host"]]
+        queries, k, fld = st["queries"], st["k"], st["fld"]
+        idxs = st["idxs"]
+        Q = len(queries)
+        kk = mv.shape[-1]
+        qc = F.QC
+        scores = np.full((Q, kk), -np.inf, np.float32)
+        shards = np.zeros((Q, kk), np.int32)
+        ids = np.zeros((Q, kk), np.int64)
+        totals = np.zeros((Q,), np.int64)
+        flagged = np.zeros((Q,), bool)
+        for ci, qidx in enumerate(idxs):
+            nq = len(qidx)
+            base = ci * qc
+            scores[qidx] = mv[base:base + nq]
+            shards[qidx] = msh[base:base + nq]
+            ids[qidx] = mi[base:base + nq]
+            totals[qidx] = mt[base:base + nq]
+            flagged[qidx] = fl[base:base + nq]
+        if flagged.any():
+            from ..telemetry import host_transition, profile_event
+
+            still = np.nonzero(flagged)[0]
+            profile_event("tier", tier="exact_escalation",
+                          queries=int(still.shape[0]))
+            st_ex = _msearch_merged_arm_begin(
+                self.ss, fld, [queries[i_] for i_ in still], k,
+                impact=False)
+            host_transition("dispatch")
+            _msearch_merged_fetch(st_ex)
+            ev, esh, ei, et = _merged_rows_finish(st_ex)
+            ke = min(ev.shape[1], kk)
+            scores[still, :] = -np.inf
+            scores[still, :ke] = ev[:, :ke]
+            shards[still, :] = 0
+            shards[still, :ke] = esh[:, :ke]
+            ids[still, :] = 0
+            ids[still, :ke] = ei[:, :ke]
+            totals[still] = et
+            st["extra_dispatches"] = st.get("extra_dispatches", 0) + 1
+            st["extra_fetches"] = st.get("extra_fetches", 0) + 1
+        return scores, shards, ids, totals
+
+    def _plan_batch(self, fld, queries, k):
+        """Host planning shared by the oracle and merged routes: per-
+        shard per-chunk fused plans padded to one (R, Td) envelope.
+        -> (chunk idxs, dict of stacked [S, C, ...] arrays + shapes)."""
+        from ..ops import fused as F
+
+        sp = self.ss.sp
         S = self.S
         Q = len(queries)
         qc = F.QC
@@ -2332,19 +2586,37 @@ class _FusedShardedMsearch:
             return np.pad(
                 a, [(0, width - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
 
-        rows = np.stack([[_padr(p.rows, R) for p in ps] for ps in plans])
-        row_q = np.stack([[_padr(p.row_q, R) for p in ps] for ps in plans])
-        row_w = np.stack([[_padr(p.row_w, R) for p in ps] for ps in plans])
-        dr = np.stack([
-            [np.pad(p.dense_rows,
-                    ((0, 0), (0, Td - p.dense_rows.shape[1])))
-             for p in ps] for ps in plans])
-        dw = np.stack([
-            [np.pad(p.dense_w, ((0, 0), (0, Td - p.dense_w.shape[1])))
-             for p in ps] for ps in plans])
+        return idxs, {
+            "rows": np.stack([[_padr(p.rows, R) for p in ps]
+                              for ps in plans]),
+            "row_q": np.stack([[_padr(p.row_q, R) for p in ps]
+                               for ps in plans]),
+            "row_w": np.stack([[_padr(p.row_w, R) for p in ps]
+                               for ps in plans]),
+            "dr": np.stack([
+                [np.pad(p.dense_rows,
+                        ((0, 0), (0, Td - p.dense_rows.shape[1])))
+                 for p in ps] for ps in plans]),
+            "dw": np.stack([
+                [np.pad(p.dense_w, ((0, 0), (0, Td - p.dense_w.shape[1])))
+                 for p in ps] for ps in plans]),
+            "avgdl": np.float32(views[0].avgdl(fld)),
+            "C": C, "R": R, "Td": Td, "nreal": nreal,
+        }
+
+    def msearch_partials(self, fld, queries, k):
+        """Pre-merge per-shard rows (scores [S, Q, kk], ids, totals
+        [S, Q]); queries flagged by ANY shard have their per-shard rows
+        replaced by the exact arm's partials, so the merge (and any cached
+        per-shard entry) never depends on the fused pass."""
+        ss = self.ss
+        sp = ss.sp
+        S = self.S
+        Q = len(queries)
+        idxs, pb = self._plan_batch(fld, queries, k)
         interpret = jax.default_backend() != "tpu"
-        fn = self._compiled(fld, C, R, Td, k, nreal, interpret)
-        avgdl = np.float32(views[0].avgdl(fld))
+        fn = self._compiled(fld, pb["C"], pb["R"], pb["Td"], k,
+                            pb["nreal"], interpret)
         from ..telemetry import profile_event, time_kernel
 
         profile_event("tier", tier="fused", queries=Q)
@@ -2352,7 +2624,8 @@ class _FusedShardedMsearch:
                          queries=Q, k=k, v=sp.dense_v,
                          num_docs=S * self.n_pad):
             v, i, t, fl = jax.device_get(
-                fn(self._arrays(), avgdl, rows, row_q, row_w, dr, dw))
+                fn(self._arrays(), pb["avgdl"], pb["rows"], pb["row_q"],
+                   pb["row_w"], pb["dr"], pb["dw"]))
         # [S, C, qc, ...] -> per-shard [S, Q, ...]
         kk = v.shape[-1]
         scores = np.full((S, Q, kk), -np.inf, np.float32)

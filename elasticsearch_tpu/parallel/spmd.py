@@ -16,12 +16,18 @@ k because only the merged (replicated) result is fetched.
 
 Execution-mode contract (`ES_TPU_SPMD`):
 
-  * ``pjit`` / ``auto`` (default) — GSPMD: sharded pack pytree, vmapped
-    shard bodies, on-device all-gather merge.
+  * ``pjit`` / ``auto`` (default) — GSPMD: sharded pack pytree, shard
+    bodies embedded as `manual_shard_region` (shard_map-in-jit) regions
+    of the ONE compiled program, on-device all-gather merge. PR 11:
+    the manual region is how the fused Pallas arm rides this program —
+    XLA's SPMD partitioner cannot split a custom call, but a manual
+    region needs no partitioning decisions at all, so the Pallas
+    kernels run per mesh device INSIDE the same compiled SPMD program
+    that merges on-device. No separate code shape, no `force_xla` pin.
   * ``shardmap`` — the legacy PR-1..9 model: per-shard `shard_map`
-    bodies + host coordinator merge. Kept as the fallback because
-    Pallas custom calls cannot be auto-partitioned by GSPMD — the fused
-    msearch arm (`_FusedShardedMsearch`) always routes through it.
+    bodies + HOST coordinator merge. Demoted to a test oracle (parity
+    fixtures, the C5 probe's shard-local timing arm); production
+    routing never selects it unless the env forces it.
 
 Replica groups: when `ES_TPU_REPLICAS=R` (R > 1) and the host exposes
 S*R devices, the mesh gains a second ``replicas`` axis. Pack leaves are
@@ -186,6 +192,50 @@ def replica_axis(mesh: Mesh | None) -> str | None:
     if mesh is not None and "replicas" in mesh.axis_names:
         return "replicas"
     return None
+
+
+def manual_shard_region(shard_body, mesh: Mesh | None, *, in_specs):
+    """Run a per-shard body as ONE region of the caller's jit program.
+
+    On a mesh the body executes inside an embedded `shard_map` — manual
+    partitioning, the only execution form in which Pallas custom calls
+    run per mesh device inside a single compiled SPMD program (GSPMD
+    cannot partition a custom call; a manual region never asks it to).
+    The surrounding program stays GSPMD, so the on-device all-gather
+    top-k merge composes directly with the region's sharded outputs —
+    this is the PR-11 closure of the fused-arm fork (ROADMAP item 1).
+
+    Off-mesh the same body runs under `vmap` over the stacked axis.
+    `in_specs` entries are `P("shards")` for [S, ...]-stacked pytree
+    args (squeezed to the shard-local slice for the body) or `P()` for
+    replicated args passed through whole. Outputs keep the leading
+    shard axis (out_specs P("shards"))."""
+    import jax.tree_util as jtu
+
+    shards_spec = P("shards")
+    if mesh is None:
+        axes = tuple(0 if s == shards_spec else None for s in in_specs)
+
+        def region(*args):
+            return jax.vmap(shard_body, in_axes=axes)(*args)
+
+        return region
+    from ..utils.jax_env import shard_map
+
+    def body(*args_s):
+        def one(spec, t):
+            if spec == shards_spec:
+                return jtu.tree_map(lambda x: x[0], t)
+            return t
+
+        outs = shard_body(*(one(s, a) for s, a in zip(in_specs, args_s)))
+        return jtu.tree_map(lambda x: jnp.asarray(x)[None], outs)
+
+    def region(*args):
+        return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=shards_spec)(*args)
+
+    return region
 
 
 # ---------------------------------------------------------------------------

@@ -456,13 +456,17 @@ class ShardSearcher:
         live = [s for s in states if s is not None]
         if live:
             from ..ops.scoring import topk_mode
-            from ..telemetry import time_kernel
+            from ..telemetry import host_transition, time_kernel
 
+            # the wave contract (PR 11): every program dispatched above,
+            # ONE blocking fetch here — counted like the sharded wave
+            host_transition("dispatch")
             k0 = max(s["k"] for s in live)
             with time_kernel("compiled_plan", shard=0, queries=len(live),
                              tier=topk_mode(self.pack.num_docs, k0),
                              num_docs=self.pack.num_docs, k=k0):
                 host = jax.device_get([s["outs"] for s in live])
+            host_transition("fetch")
             host = iter(host)
             for i, s in enumerate(states):
                 if s is None:

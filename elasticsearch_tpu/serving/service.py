@@ -98,6 +98,11 @@ class ServingService:
         self._occ_sum = 0.0
         self._occ_n = 0
         self._size_sum = 0
+        # host-transition accounting (PR 11): the wave executor proves
+        # end-to-end fusion with one dispatch phase + one combined fetch
+        # per wave; these sums expose the achieved per-wave average
+        self._disp_sum = 0
+        self._fetch_sum = 0
         self._wave_ms_ema: float | None = None
         _LIVE_SERVICES.add(self)
 
@@ -419,8 +424,14 @@ class ServingService:
                 else:
                     self._finish_entry(ps, result=res)
             meta = job.get("meta", {})
+            tr = meta.get("transitions") or {}
+            metrics.histogram_record(
+                "es.serving.host_transitions",
+                tr.get("dispatch", 0) + tr.get("fetch", 0))
             with self._lock:
                 self.counters["term_packed"] += meta.get("term_packed", 0)
+                self._disp_sum += tr.get("dispatch", 0)
+                self._fetch_sum += tr.get("fetch", 0)
             for q, tier in meta.get("term_waves", ()):
                 metrics.histogram_record(
                     "es.serving.wave_occupancy", q / max(tier, 1))
@@ -459,6 +470,12 @@ class ServingService:
                     "avg_term_occupancy": (self._occ_sum / self._occ_n
                                            if self._occ_n else None),
                     "service_ms_ema": self._wave_ms_ema,
+                    # ≤1 dispatch + ≤1 fetch per wave is the PR-11
+                    # contract; extras mean escalations/two-pass aggs
+                    "host_transitions_per_wave": {
+                        "dispatch": self._disp_sum / waves,
+                        "fetch": self._fetch_sum / waves,
+                    },
                 },
                 **{k: v for k, v in self.counters.items()},
             }
@@ -511,4 +528,5 @@ class ServingService:
                 self.counters[k] = 0
             self._occ_sum = self._occ_n = 0
             self._size_sum = 0
+            self._disp_sum = self._fetch_sum = 0
             self._wave_ms_ema = None

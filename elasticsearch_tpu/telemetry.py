@@ -347,6 +347,19 @@ def profile_event(kind: str, **fields) -> None:
         bucket.append({"kind": kind, **fields})
 
 
+def host_transition(kind: str) -> None:
+    """Count one host↔device transition on the serving/sharded wave path
+    (kind: "dispatch" = a program-launch phase handed to the device,
+    "fetch" = a blocking device→host result pull). PR 11: the serving
+    wave executor proves its end-to-end fusion with these — one dispatch
+    phase and ONE combined fetch per wave (extra rounds from rare
+    escalations/two-pass aggs are counted, never hidden). Feeds the
+    cumulative es.device.host_transitions.* counters and, when a
+    collector is active, a per-request "transition" profile event."""
+    metrics.counter_inc(f"es.device.host_transitions.{kind}")
+    profile_event("transition", transition=kind)
+
+
 @contextmanager
 def time_kernel(name: str, **fields):
     """Wall-time one host-level device dispatch+fetch (the Pallas / XLA

@@ -125,6 +125,55 @@ def main(n_devices=8, docs_per_shard=4096, n_queries=256):
     jax.block_until_ready(rows_dev)
     t_merge = bench(lambda: global_merge_rows(sm, *rows_dev))
 
+    # ---- program D: the fused Pallas arm on the one-program route ------
+    # (PR 11) forced on so the interpret-mode kernel runs the exact
+    # program a TPU compiles: embedded shard_map fused pipeline + the
+    # in-program all-gather merge, timed end-to-end with its
+    # mfu/bw/ici attribution from the cost model. Advisory on the
+    # virtual CPU mesh (interpret-mode Pallas is host-bound); on a real
+    # slice the same section is the fused-sharded criterion.
+    fused = {"engaged": False}
+    try:
+        os.environ["ES_TPU_FUSED"] = "force"
+        from elasticsearch_tpu.parallel.sharded import _fused_sharded_for
+
+        spf = build_stacked_pack(
+            graft._dryrun_corpus(1024 * S, seed=7), m, num_shards=S,
+            dense_min_df=64)
+        fpj = StackedSearcher(
+            spf, mesh=Mesh(np.array(jax.devices()[:S]), ("shards",)))
+        fs = _fused_sharded_for(fpj)
+        fq = queries[:64]
+        if fs is not None and fs.usable(k):
+            fused["engaged"] = True
+            fs.msearch_merged("body", fq, k)  # compile-warm
+            t0 = time.perf_counter()
+            fv, fsh, fid, ft = fs.msearch_merged("body", fq, k)
+            t_fused = time.perf_counter() - t0
+            ov, osh, oid, ot = fs.msearch("body", fq, k)
+            finf = np.isfinite(fv)
+            fused["parity_vs_oracle"] = (
+                "byte" if (np.array_equal(fv, ov)
+                           and bool((fsh == osh)[finf].all())
+                           and bool((fid == oid)[finf].all())
+                           and bool((ft == ot).all())) else "FAIL")
+            futil = utilization(
+                "sharded.fused_allgather_topk",
+                dict(tier="fused", shards=S, queries=len(fq), k=k,
+                     v=int(spf.dense_v), num_docs=S * fs.n_pad),
+                t_fused) or {}
+            fused.update({
+                "t_one_program_ms": round(t_fused * 1e3, 2),
+                "mfu": round(futil["mfu"], 6) if futil else None,
+                "bw_util": (round(futil["bw_util"], 6)
+                            if futil else None),
+                "ici_util": (round(futil["ici_util"], 6)
+                             if "ici_util" in futil else None),
+            })
+            assert fused["parity_vs_oracle"] != "FAIL", fused
+    finally:
+        os.environ.pop("ES_TPU_FUSED", None)
+
     # the projection's merge fraction: the measured on-device merge cost
     # relative to (shard-local compute + merge). The one-program ratio is
     # reported separately because on a VIRTUAL CPU mesh XLA's SPMD
@@ -156,6 +205,7 @@ def main(n_devices=8, docs_per_shard=4096, n_queries=256):
             "ici_util": (round(util["ici_util"], 6)
                          if "ici_util" in util else None),
         },
+        "fused": fused,
     }))
 
 

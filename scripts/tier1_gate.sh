@@ -31,10 +31,12 @@ else
         "${COMMON[@]}" -p no:randomly --shuffle-modules "${SEED}" || exit 1
 fi
 
-# multichip/pjit parity gate (PR 10): the production sharded stack with
-# parity across pjit / shard_map / single-device. Enforcing when the
-# process sees a real multi-device slice; advisory on single-device CPU
-# (the script provisions a virtual mesh itself).
+# multichip/pjit parity gate (PR 10; PR 11 adds the fused one-program
+# arm): the production sharded stack with parity across pjit /
+# shard_map-oracle / single-device, including the fused Pallas arm
+# running inside the embedded-shard_map pjit program. Enforcing when
+# the process sees a real multi-device slice; advisory on single-device
+# CPU (the script provisions a virtual mesh itself).
 echo "[tier1-gate] multichip pjit parity"
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/multichip_dryrun.py \
     || exit 1

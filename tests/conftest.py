@@ -69,14 +69,13 @@ def pytest_collection_modifyitems(session, config, items):
     # test_request_cache.py re-enables it per test via its own autouse
     # fixture, so cache coverage itself survives this gate.
     os.environ["ES_TPU_REQUEST_CACHE"] = "0"
-    # ... and with the GSPMD execution model pinned EXPLICITLY (pjit is
-    # also the auto default): with the cache off, every sharded msearch
-    # rides the one-program all-gather-merge path, so the shuffled gate
-    # doubles as the PR-10 pjit execution gate.
-    os.environ["ES_TPU_SPMD"] = "pjit"
+    # No ES_TPU_SPMD pin (PR 11): pjit is the auto default AND the only
+    # production execution model — the fused tier no longer forks on it,
+    # so the arm matrix is gone. With the cache off, every sharded
+    # msearch rides the one-program all-gather-merge path by default.
     print(f"[conftest] module order shuffled with seed {seed}; "
-          "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate); "
-          "ES_TPU_SPMD=pjit (GSPMD execution gate)")
+          "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate; "
+          "GSPMD/pjit is the unpinned default)")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -99,6 +99,11 @@ def test_device_peaks_env_override(monkeypatch):
 # ---------------------------------------------------------------------------
 
 _TIME_KERNEL_RE = re.compile(r'time_kernel\(\s*\n?\s*"([^"]+)"')
+# deferred dispatch states (PR 11) carry their kernel name as a dict
+# literal ('"kernel": "<name>"') and time_kernel receives it dynamically
+# at fetch time — the lint must see those names too, or an unregistered
+# fused-pjit kernel could ship unaccounted
+_KERNEL_FIELD_RE = re.compile(r'"kernel":\s*\n?\s*"([^"]+)"')
 
 
 def _dispatch_site_names():
@@ -108,9 +113,10 @@ def _dispatch_site_names():
     for sub in ("ops", "parallel", "query", "ann", "engine"):
         for path in glob.glob(os.path.join(root, sub, "*.py")):
             src = open(path, encoding="utf-8").read()
-            for m in _TIME_KERNEL_RE.finditer(src):
-                names.setdefault(m.group(1), []).append(
-                    os.path.relpath(path, root))
+            for rx in (_TIME_KERNEL_RE, _KERNEL_FIELD_RE):
+                for m in rx.finditer(src):
+                    names.setdefault(m.group(1), []).append(
+                        os.path.relpath(path, root))
     return names
 
 
@@ -137,7 +143,11 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      "sharded.impact_disjunction", "sparse.tail_scan",
                      # the pjit GSPMD path (PR 10): the one-program
                      # all-gather merge + the standalone device merge
-                     "sharded.allgather_topk", "sharded.global_merge"):
+                     "sharded.allgather_topk", "sharded.global_merge",
+                     # PR 11: the fused arm on the one-program route and
+                     # the serving wave's single combined fetch
+                     "sharded.fused_allgather_topk",
+                     "serving.wave_program"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 

@@ -350,6 +350,208 @@ def test_time_kernel_records_ici_utilization(sp, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# PR 11: the fused Pallas arm inside the ONE compiled SPMD program
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fsp():
+    """Dense-tier stacked pack with synthetic cross-shard score ties:
+    every body is repeated on 4 consecutive docs, and round-robin shard
+    routing lands the copies on DIFFERENT shards — bit-identical scores
+    that must resolve (score desc, shard asc, doc asc) through the
+    merged on-device top-k."""
+    rng = np.random.default_rng(7)
+    zipf = 1.0 / np.arange(1, 65)
+    zipf /= zipf.sum()
+    docs = []
+    for i in range(300):
+        ln = max(3, int(rng.poisson(9)))
+        body = " ".join(f"t{int(t)}" for t in rng.choice(64, size=ln,
+                                                         p=zipf))
+        for r in range(4):
+            docs.append((f"d{i}-{r}", {"body": body}))
+    return build_stacked_pack(
+        docs, Mappings({"properties": {"body": {"type": "text"}}}),
+        num_shards=4, dense_min_df=32)
+
+
+def _fused_queries(n=12, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        [(f"t{int(t)}", 1.0) for t in sorted(set(rng.integers(0, 64, 3)))]
+        for _ in range(n)
+    ]
+
+
+def test_fused_arm_rides_one_program_with_ties(fsp, monkeypatch):
+    """The tentpole: the fused Pallas pipeline runs INSIDE the one
+    compiled pjit program (embedded shard_map region + in-program
+    all-gather merge, `sharded.fused_allgather_topk`) — byte parity vs
+    the shard_map oracle's host merge, rank parity vs single-device,
+    including the synthetic 4-way cross-shard score ties."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    monkeypatch.setenv("ES_TPU_FUSED", "force")
+    from elasticsearch_tpu.parallel.sharded import _fused_sharded_for
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    pj = _searcher(fsp, "pjit", monkeypatch)
+    sm = _searcher(fsp, "shardmap", monkeypatch)
+    sd = _searcher(fsp, "pjit", monkeypatch, mesh=False)
+    fs = _fused_sharded_for(pj)
+    assert fs is not None and fs.usable(5), "fused arm must engage"
+    queries = _fused_queries()
+    with collect_profile_events() as events:
+        ref = msearch_sharded(pj, "body", queries, k=5)
+    names = [e.get("kernel") for e in events if e.get("kind") == "kernel"]
+    assert "sharded.fused_allgather_topk" in names, names
+    ks = [e for e in events
+          if e.get("kernel") == "sharded.fused_allgather_topk"]
+    assert "mfu" in ks[0] and "ici_util" in ks[0] and ks[0]["ici_bytes"] > 0
+    assert "fused" in [e.get("tier") for e in events
+                       if e.get("kind") == "tier"]
+    # the top rows really are cross-shard ties (score-identical copies)
+    assert (ref[0][:, 0] == ref[0][:, 1]).any(), "tie corpus lost its ties"
+    # byte parity vs the shard_map oracle (fused partials + host merge)
+    v, s_, d_, t_ = msearch_sharded(sm, "body", queries, k=5)
+    np.testing.assert_array_equal(ref[0], v)
+    fin = np.isfinite(ref[0])
+    assert (ref[1] == s_)[fin].all() and (ref[2] == d_)[fin].all()
+    assert (ref[3] == t_).all()
+    # rank parity vs single-device (vmap batches the pipeline; fp
+    # summation order may differ at the ulp level — same contract as
+    # tests/test_fused.test_fused_msearch_sharded_parity)
+    v2, s2, d2, t2 = msearch_sharded(sd, "body", queries, k=5)
+    assert (ref[3] == t2).all()
+    np.testing.assert_allclose(ref[0], v2, rtol=1e-6)
+    for q in range(len(queries)):
+        for pos in range(int(fin[q].sum())):
+            if (ref[2][q][pos], ref[1][q][pos]) != (d2[q][pos], s2[q][pos]):
+                a, b = float(ref[0][q][pos]), float(v2[q][pos])
+                assert abs(a - b) <= 1e-5 * max(abs(b), 1.0), (q, pos)
+
+
+def test_pallas_scan_engages_inside_pjit_program(sp, monkeypatch):
+    """The force_xla pin is gone: with ES_TPU_FUSED_TOPK=force the
+    per-shard selection of the compiled `search` program routes through
+    the streamed Pallas scan INSIDE the pjit program's embedded
+    shard_map region — parity vs the sort-based XLA arm."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    q = {"bool": {"should": [{"term": {"body": "w1"}},
+                             {"term": {"body": "w2"}},
+                             {"term": {"body": "rareterm"}}]}}
+    monkeypatch.setenv("ES_TPU_FUSED_TOPK", "force")
+    r_scan = _searcher(sp, "pjit", monkeypatch).search(query=q, size=6)
+    monkeypatch.setenv("ES_TPU_FUSED_TOPK", "0")
+    r_xla = _searcher(sp, "pjit", monkeypatch).search(query=q, size=6)
+    _same_result(r_scan, r_xla, "pallas-scan-in-pjit")
+
+
+# ---------------------------------------------------------------------------
+# PR 11: request cache keys at wave scope on the merged route
+# ---------------------------------------------------------------------------
+
+def test_request_cache_keeps_merged_route_engaged(sp, monkeypatch):
+    """With the cache ON, a pjit msearch stores post-merge rows at wave
+    scope: cold queries ride the one-program route (previously an
+    enabled cache silently forced the partials + host-merge path), warm
+    queries are served with NO device work, and any shard's epoch bump
+    invalidates."""
+    from elasticsearch_tpu.cache import request_cache
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    monkeypatch.delenv("ES_TPU_REQUEST_CACHE", raising=False)
+    request_cache().lru.clear()
+    pj = _searcher(sp, "pjit", monkeypatch)
+    queries = _queries(6, seed=51)
+    with collect_profile_events() as ev1:
+        cold = msearch_sharded(pj, "body", queries, k=5)
+    names = [e.get("kernel") for e in ev1 if e.get("kind") == "kernel"]
+    assert "sharded.allgather_topk" in names, names
+    with collect_profile_events() as ev2:
+        warm = msearch_sharded(pj, "body", queries, k=5)
+    assert not [e for e in ev2 if e.get("kind") == "kernel"], (
+        "warm wave must not touch the device")
+    hits = [e for e in ev2 if e.get("kind") == "cache"
+            and e.get("scope") == "msearch_merged"]
+    assert hits and hits[0]["hits"] == len(queries)
+    for a, b in zip(cold, warm):
+        np.testing.assert_array_equal(a, b)
+    # partially-warm: one new query re-dispatches ONLY the cold subset
+    mixed = queries + _queries(1, seed=77)
+    with collect_profile_events() as ev3:
+        out = msearch_sharded(pj, "body", mixed, k=5)
+    hits3 = [e for e in ev3 if e.get("kind") == "cache"
+             and e.get("scope") == "msearch_merged"]
+    assert hits3[0]["hits"] == len(queries) and hits3[0]["misses"] == 1
+    for a, b in zip(cold, out):
+        np.testing.assert_array_equal(a, b[: len(queries)]
+                                      if a.ndim else b[: len(queries)])
+    # one shard's mutation invalidates the wave-scope rows
+    pj.bump_epoch(shard=1)
+    with collect_profile_events() as ev4:
+        again = msearch_sharded(pj, "body", queries, k=5)
+    assert "sharded.allgather_topk" in [
+        e.get("kernel") for e in ev4 if e.get("kind") == "kernel"]
+    for a, b in zip(cold, again):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# PR 11: host-transition counter — one dispatch + one fetch per wave
+# ---------------------------------------------------------------------------
+
+def test_wave_host_transitions(tmp_path, monkeypatch):
+    """The serving-wave contract: every lane's programs launch in ONE
+    dispatch phase and the whole wave resolves with ONE combined fetch
+    (`serving.wave_program`) — asserted on the job meta AND the
+    transition profile events, for a pure term wave and a mixed
+    term+generic wave."""
+    monkeypatch.setenv("ES_TPU_REQUEST_CACHE", "0")
+    from elasticsearch_tpu.engine.engine import Engine
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    e = Engine(str(tmp_path / "data"))
+    try:
+        idx = e.create_index("w", {"properties": {
+            "body": {"type": "text"}, "tag": {"type": "keyword"}}})
+        for i in range(48):
+            idx.index_doc(str(i), {
+                "body": f"t{i % 7} t{(i + 1) % 7} common",
+                "tag": f"g{i % 3}"})
+        idx.refresh()
+        _ = idx.searcher
+        term_entries = [dict(query={"match": {"body": "t1"}}, size=5),
+                        dict(query={"match": {"body": "t2 t3"}}, size=4),
+                        dict(query={"match": {"body": "common"}}, size=3)]
+        solo = [idx.search(**dict(en)) for en in term_entries]
+        for entries in (term_entries,
+                        term_entries + [dict(query=None, size=0, aggs={
+                            "g": {"terms": {"field": "tag"}}})]):
+            idx.search_wave([dict(en) for en in entries])  # compile-warm
+            with collect_profile_events() as events:
+                job = idx.search_wave_begin([dict(en) for en in entries])
+                idx.search_wave_fetch(job)
+                out = idx.search_wave_finish(job)
+            assert all(isinstance(r, dict) for r in out), out
+            tr = job["meta"]["transitions"]
+            assert tr["dispatch"] <= 1 and tr["fetch"] <= 1, tr
+            kinds = [ev.get("transition") for ev in events
+                     if ev.get("kind") == "transition"]
+            assert kinds.count("dispatch") <= 1, kinds
+            assert kinds.count("fetch") <= 1, kinds
+            ks = [ev.get("kernel") for ev in events
+                  if ev.get("kind") == "kernel"]
+            assert "serving.wave_program" in ks, ks
+            # wave == solo (the serving parity contract)
+            for en, resp in zip(term_entries, out):
+                assert resp["hits"]["hits"] == \
+                    idx.search(**dict(en))["hits"]["hits"]
+        assert solo  # solo responses computed before any wave ran
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
 # env routing
 # ---------------------------------------------------------------------------
 
