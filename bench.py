@@ -322,7 +322,34 @@ def _profile_arm(run_fn):
         u["ms"] = round(u["ms"], 3)
     return {"tiers": tiers, "kernel_ms": kernels,
             "device_utilization": {"device_kind": kind, "kernels": util},
-            "request_cache_events": cache}
+            "request_cache_events": cache,
+            "xla_cost_check": _xla_cost_check(set(kernels))}
+
+
+def _xla_cost_check(kernel_names=None):
+    """PR 12: the in-record ground truth — per-kernel analytic-vs-XLA
+    flops/bytes ratios from the compiled-program cross-check
+    (monitoring/xla_introspect), restricted to the kernels this arm
+    actually dispatched (plus their check statuses), so BENCH_r11+ and
+    the eventual TPU stamp carry the drift alongside the MFU/bw numbers
+    it underwrites. scripts/bench_regress.py treats >20% drift growth
+    between records as advisory output."""
+    from elasticsearch_tpu.monitoring.xla_introspect import drift_table
+
+    table = drift_table()
+    out = {"kernels": {}, "checked": 0, "exempt": 0}
+    for kname, row in table.items():
+        if kernel_names is not None and kname not in kernel_names:
+            continue
+        entry = {"status": row["status"]}
+        if "flops_ratio" in row:
+            entry["flops_ratio"] = row["flops_ratio"]
+            entry["bytes_ratio"] = row.get("bytes_ratio")
+            out["checked"] += 1
+        elif row["status"] == "exempt":
+            out["exempt"] += 1
+        out["kernels"][kname] = entry
+    return out
 
 
 def _hist_pcts(name, values_ms):

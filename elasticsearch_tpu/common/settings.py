@@ -263,6 +263,21 @@ def default_cluster_settings() -> list[Setting]:
         # per-tenant weighted fair scheduling: "tenantA:4,tenantB:1"
         # (X-Opaque-Id is the tenant identity; unlisted tenants weigh 1)
         Setting("serving.tenant.weights", "", str, dynamic=True),
+        # serving-wave flight recorder (PR 12): bounded ring of per-wave
+        # segment timings / tenant mix / kernel deltas, dumped to the
+        # hidden .flight-recorder-* index by the watcher capture action
+        Setting("serving.flight_recorder.size", 256, Setting.positive_int,
+                dynamic=True),
+        # breach-triggered device profiling (monitoring/profiler.py):
+        # duration-bounded jax.profiler traces; trace dirs pruned on the
+        # retention window by the monitoring CleanerService
+        Setting("xpack.profiling.enabled", True, Setting.bool_,
+                dynamic=True),
+        Setting("xpack.profiling.trace_dir", "", str, dynamic=True),
+        Setting("xpack.profiling.max_duration", "10s", str, dynamic=True,
+                validator=_validate_duration),
+        Setting("xpack.profiling.retention", "1h", str, dynamic=True,
+                validator=_validate_duration),
     ]
 
 

@@ -1771,6 +1771,7 @@ class Engine:
         self._serving = None
         self._watcher = None
         self._slo = None
+        self._profiler = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1860,7 +1861,9 @@ class Engine:
         for key, attr in (("serving.max_wave", "set_max_wave"),
                           ("serving.coalesce.max_wait", "set_max_wait"),
                           ("serving.queue.max_depth", "set_queue_depth"),
-                          ("serving.tenant.weights", "set_tenant_weights")):
+                          ("serving.tenant.weights", "set_tenant_weights"),
+                          ("serving.flight_recorder.size",
+                           "set_flight_recorder_size")):
             self.settings.add_consumer(
                 key, lambda v, a=attr: getattr(self.serving, a)(v))
         if self.settings.get("serving.enabled"):
@@ -1954,6 +1957,17 @@ class Engine:
         if self._slo is None:
             self._slo = SloEngine(self)
         return self._slo
+
+    @property
+    def profiler(self):
+        """Bounded jax.profiler capture service (monitoring/profiler.py):
+        lazy — built on the first REST/watcher capture request; trace
+        dirs are pruned by the monitoring CleanerService."""
+        from ..monitoring.profiler import ProfilerService
+
+        if self._profiler is None:
+            self._profiler = ProfilerService(self)
+        return self._profiler
 
     def serving_if_enabled(self):
         """The serving service iff coalescing is enabled — without
@@ -3002,6 +3016,8 @@ class Engine:
             self._serving.stop()  # drain + join the scheduler threads
         if self._monitoring is not None:
             self._monitoring.stop()  # join the collection thread
+        if self._profiler is not None:
+            self._profiler.close()  # stop a still-open trace window
         if self._ml is not None:
             self._ml.shutdown()  # checkpoints open jobs' model state
         for idx in self.indices.values():

@@ -29,12 +29,20 @@ from .device import (
     pack_padded_waste,
     padded_waste_bytes,
 )
+from .profiler import ProfilerService
 from .service import (
     MONITORING_PREFIX,
     SELF_WATCH_JOB_ID,
     MonitoringService,
     monitoring_index_name,
     setup_self_watch_job,
+)
+from .xla_introspect import (
+    XLA_CHECKS,
+    check_dispatch,
+    drift_table,
+    format_drift_table,
+    xla_check_status,
 )
 
 # meter XLA compiles from the first time any monitoring-aware code path
@@ -48,4 +56,6 @@ __all__ = [
     "pack_padded_waste", "padded_waste_bytes",
     "MONITORING_PREFIX", "SELF_WATCH_JOB_ID", "MonitoringService",
     "monitoring_index_name", "setup_self_watch_job",
+    "ProfilerService", "XLA_CHECKS", "check_dispatch", "drift_table",
+    "format_drift_table", "xla_check_status",
 ]

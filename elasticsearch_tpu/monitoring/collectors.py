@@ -90,6 +90,17 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
             "mfu": u["mfu"], "bw_util": u["bw_util"],
             "flops": u["flops"], "bytes": u["bytes"],
         }
+    # PR 12: the registry-wide analytic-vs-XLA drift table rides the
+    # TSDB doc (bounded numeric leaves), so cost-model trust is
+    # queryable history alongside the utilization it underwrites
+    drift = {}
+    for kname, row in (dev["utilization"].get("costmodel_drift")
+                       or {}).items():
+        if "flops_ratio" in row:
+            drift[kname.replace(".", "_")] = {
+                "flops_ratio": row["flops_ratio"],
+                "bytes_ratio": row.get("bytes_ratio", 0.0),
+            }
     snap = metrics.snapshot()
     rest_h = snap["histograms"].get("es.rest.request.ms") or {}
     shard_h = snap["histograms"].get("es.shard.search.ms") or {}
@@ -169,6 +180,7 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "pack_padded_waste_bytes":
                     dev["memory"].get("pack_padded_waste_bytes", 0),
                 "kernels": kernels,
+                "costmodel_drift": drift,
             },
             "jit": {
                 "compiles": dev["jit"]["compiles"],
@@ -188,6 +200,10 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "waves": sv_st.get("waves", 0),
                 "avg_wave_size": sv_wave.get("avg_size", 0.0) or 0.0,
                 "term_occupancy_p50": occ_h.get("p50", 0.0),
+                "host_transitions_dispatch": sv_st.get(
+                    "host_transitions_total", {}).get("dispatch", 0),
+                "host_transitions_fetch": sv_st.get(
+                    "host_transitions_total", {}).get("fetch", 0),
             },
         },
     }

@@ -207,8 +207,18 @@ def kernel_utilization() -> dict:
             entry["mfu_pct_p50"] = round(mh["p50"], 4)
             entry["mfu_pct_max"] = round(mh["max"], 4)
         out[kname] = entry
+    # PR 12: per-kernel analytic-vs-XLA drift (the compiled-program
+    # cross-check) rides the utilization section, so a reader of the
+    # roofline numbers sees how much to trust the numerator
+    from .xla_introspect import OBSERVATIONS, drift_table
+
+    for kname, entry in out.items():
+        o = OBSERVATIONS.get(kname)
+        if o is not None and "drift" in o:
+            entry["xla_drift"] = dict(o["drift"])
     return {"device_kind": kind, "peak_flops": peak_f,
-            "peak_bytes_per_sec": peak_b, "kernels": out}
+            "peak_bytes_per_sec": peak_b, "kernels": out,
+            "costmodel_drift": drift_table()}
 
 
 def device_stats(engine=None) -> dict:

@@ -34,9 +34,11 @@ def monitoring_index_name(ts: float | None = None) -> str:
 
 
 # date-suffixed hidden indices the CleanerService owns: the monitoring
-# TSDB and the watcher's execution history (xpack/watcher.py) age out on
-# the same xpack.monitoring.history.duration window
-_DATED_PREFIXES = (MONITORING_PREFIX, ".watcher-history-8-")
+# TSDB, the watcher's execution history (xpack/watcher.py), and the
+# serving-wave flight-recorder dumps (serving/service.py, PR 12) all age
+# out on the same xpack.monitoring.history.duration window
+_DATED_PREFIXES = (MONITORING_PREFIX, ".watcher-history-8-",
+                   ".flight-recorder-")
 
 
 def _index_date(name: str):
@@ -207,6 +209,15 @@ class MonitoringService:
         CleanerService). Today's index is never deleted regardless of a
         tiny retention (the window floors at one day boundary)."""
         cutoff = time.time() - self.retention_seconds()
+        # profiler trace dirs age out on their own xpack.profiling
+        # retention window (only when the service was ever built — a
+        # prune must not instantiate it)
+        prof = getattr(self.engine, "_profiler", None)
+        if prof is not None:
+            try:
+                prof.prune()
+            except Exception:  # noqa: BLE001 - pruning must keep going
+                pass
         expired = []
         for name in list(self.engine.indices):
             d = _index_date(name)

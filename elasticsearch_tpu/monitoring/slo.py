@@ -32,6 +32,18 @@ from ..telemetry import metrics
 STATUS_CODES = {"green": 0, "yellow": 1, "red": 2}
 
 
+def _default_breach_profile_ms() -> int:
+    """Breach-capture trace window of the PREBUILT watch: 200ms on a
+    real device, 0 (flight-recorder dump only) on the CPU backend —
+    see the capture_diagnostics comment in ensure_prebuilt_watch."""
+    try:
+        import jax
+
+        return 200 if jax.default_backend() == "tpu" else 0
+    except Exception:  # noqa: BLE001 - no backend: no trace
+        return 0
+
+
 def _objective(oid: str, kind: str, description: str, measured, threshold,
                breached: bool | None, direction: str) -> dict:
     status = ("no_data" if breached is None
@@ -279,6 +291,19 @@ class SloEngine:
             "actions": {"log_breach": {
                 "logging": {"text": "SLO objectives breached"},
                 "throttle_period": "1m",
+            }, "capture_diagnostics": {
+                # PR 12: a breach ships evidence — the serving-wave
+                # flight recorder dumped to .flight-recorder-* and, on a
+                # real device, a bounded jax.profiler trace of the
+                # breach window. The scheduled default traces only on
+                # TPU: the CPU XPlane collector in the pinned jaxlib is
+                # not crash-safe under repeated captures with concurrent
+                # cluster traffic (DIVERGENCES "Compiled-program
+                # introspection"); a watch with an explicit profile_ms
+                # still traces on any backend.
+                "capture": {"flight_recorder": True,
+                            "profile_ms": _default_breach_profile_ms()},
+                "throttle_period": "5m",
             }},
             "metadata": {"prebuilt": True, "managed_by": "slo"},
         })

@@ -520,6 +520,15 @@ class BatchTermSearcher:
         else:
             extras = {}
         dev = self.searcher.dev
+        first = tuple(jnp.asarray(a[:qc]) for a in arrs)
+        from ..monitoring.xla_introspect import check_dispatch
+
+        # PR 12: the chunk executable vs its own compiled cost analysis
+        # (one capture per chunk shape; all chunks share the executable)
+        check_dispatch(
+            "batched.disjunction", fn, (dev, extras, *first),
+            fields={"queries": qc, "num_docs": self.searcher.pack.num_docs,
+                    "rows": int(np.prod(plan.sparse_rows[:qc].shape))})
         outs = [
             fn(dev, extras, *(jnp.asarray(a[i : i + qc]) for a in arrs))
             for i in range(0, Q + pad, qc)
@@ -739,16 +748,26 @@ class BatchTermSearcher:
 
             fn2 = self._cache[key2] = jax.jit(tail)
         extras = self._fast_extras(False)
+        from ..monitoring.xla_introspect import check_dispatch
         from ..telemetry import time_kernel
 
+        code_bytes = int(np.dtype(dev["impact_codes"].dtype).itemsize)
+        check_dispatch(
+            "sparse.impact_gather", fn1,
+            (dev, jnp.asarray(rows_a[:qc]), jnp.asarray(w_a[:qc])),
+            fields={"queries": qc, "rows": qc * Ts * B,
+                    "code_bytes": code_bytes})
         cands = []
         for i in range(0, Q + pad, qc):
             cands.append(fn1(dev, jnp.asarray(rows_a[i: i + qc]),
                              jnp.asarray(w_a[i: i + qc])))
-        code_bytes = int(np.dtype(dev["impact_codes"].dtype).itemsize)
         with time_kernel("sparse.impact_gather", tier="impact", queries=Q,
                          rows=Q * Ts * B, code_bytes=code_bytes):
             jax.block_until_ready(cands)
+        check_dispatch(
+            "sparse.impact_sum", fn2,
+            (dev, extras, jnp.asarray(Wa[:qc]), *cands[0]),
+            fields={"queries": qc, "num_docs": n, "cands": M})
         outs = [
             fn2(dev, extras, jnp.asarray(Wa[i: i + qc]), cd, cs)
             for (cd, cs), i in zip(cands, range(0, Q + pad, qc))

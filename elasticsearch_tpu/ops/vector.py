@@ -164,6 +164,24 @@ class TieredKnnScanner:
             i = np.pad(i, pad)
         if not safe.all():
             flagged = np.nonzero(~safe)[0]
+            from ..monitoring.xla_introspect import check_dispatch
+            from .kernels import scan_topk_xla
+
+            # PR 12: the f32 matmul+top-k scan is the dense-matmul parity
+            # anchor of the XLA cross-check — the executed XLA arm (the
+            # CPU/escalation route of scan_topk) lowered against the
+            # analytic knn_scan_cost
+            check_dispatch(
+                "vector.knn_scan", scan_topk_xla,
+                (qvecs[flagged], self.mat_t, self.live,
+                 aux_doc if aux_doc is not None
+                 else jnp.zeros((N,), jnp.float32),
+                 aux_q[flagged] if aux_q is not None
+                 else jnp.zeros((int(flagged.shape[0]),), jnp.float32)),
+                kwargs={"k": k, "transform": self.similarity,
+                        "count_positive": False},
+                fields={"queries": int(flagged.shape[0]), "dims": D,
+                        "num_docs": N, "k": k})
             with time_kernel("vector.knn_scan", tier="exact_escalation",
                              queries=int(flagged.shape[0]), dims=D,
                              num_docs=N, k=k):

@@ -1347,6 +1347,12 @@ class StackedSearcher:
             agg_key = tuple(akeys)
         k = min(max(size + from_, 1), max(self.sp.n_max * self.sp.S, 1))
         fn = self._compiled(node, tuple(keys), k, agg_nodes, agg_key)
+        from ..monitoring.xla_introspect import check_dispatch
+
+        check_dispatch("sharded.spmd_topk", fn,
+                       (self.dev, params, agg_params),
+                       fields={"queries": 1, "k": k,
+                               "num_docs": self.sp.S * self.sp.n_max})
         return {
             "node": node, "keys": tuple(keys), "k": k, "size": size,
             "from_": from_, "agg_nodes": agg_nodes, "agg_key": agg_key,
@@ -1983,12 +1989,17 @@ def _msearch_impact_partials(ss: "StackedSearcher", fld: str,
 
     code_bytes = int(np.dtype(ss.dev["impact_codes"].dtype).itemsize)
     profile_event("tier", tier="impact", queries=Q)
-    with time_kernel("sharded.impact_disjunction", tier="impact", shards=S,
-                     queries=Q, k=kk, num_docs=S * n_max,
-                     rows=int(np.prod(rows.shape)),
-                     code_bytes=code_bytes):
-        v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
-                                    jnp.asarray(ws), jnp.asarray(iws)))
+    fields = dict(tier="impact", shards=S, queries=Q, k=kk,
+                  num_docs=S * n_max, rows=int(np.prod(rows.shape)),
+                  code_bytes=code_bytes)
+    prog_args = (sub, jnp.asarray(W), jnp.asarray(rows), jnp.asarray(ws),
+                 jnp.asarray(iws))
+    from ..monitoring.xla_introspect import check_dispatch
+
+    check_dispatch("sharded.impact_disjunction", fn, prog_args,
+                   fields=fields)
+    with time_kernel("sharded.impact_disjunction", **fields):
+        v, i, t = jax.device_get(fn(*prog_args))
     return v, i, t
 
 
@@ -2138,8 +2149,13 @@ def _msearch_merged_arm_begin(ss: "StackedSearcher", fld: str,
     if impact:
         fields["code_bytes"] = int(
             np.dtype(ss.dev["impact_codes"].dtype).itemsize)
-    outs = fn(sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
-              jnp.asarray(pl["ws"]), jnp.asarray(iws))
+    prog_args = (sub, jnp.asarray(pl["W"]), jnp.asarray(pl["rows"]),
+                 jnp.asarray(pl["ws"]), jnp.asarray(iws))
+    from ..monitoring.xla_introspect import check_dispatch
+
+    # PR 12: the one-program scan+merge vs its own compiled cost analysis
+    check_dispatch("sharded.allgather_topk", fn, prog_args, fields=fields)
+    outs = fn(*prog_args)
     return {"pending": outs, "host": None,
             "kernel": "sharded.allgather_topk", "fields": fields,
             "finish": _merged_rows_finish}
@@ -2165,6 +2181,10 @@ def global_merge_rows(ss: "StackedSearcher", v, i, t):
 
         fn = ss._cache[cache_key] = jax.jit(
             lambda v_, i_, t_: merge_topk_rows(v_, i_, t_, mesh=ss.mesh))
+    from ..monitoring.xla_introspect import check_dispatch
+
+    check_dispatch("sharded.global_merge", fn, (v, i, t),
+                   fields={"shards": S, "queries": Q, "k": kk})
     with time_kernel("sharded.global_merge", shards=S, queries=Q, k=kk):
         mv, msh, mi, mt = jax.device_get(fn(v, i, t))
     return (np.asarray(mv), np.asarray(msh).astype(np.int32),
@@ -2236,11 +2256,15 @@ def _msearch_exact_partials(ss: "StackedSearcher", fld: str,
                     jnp.asarray(ws)), kk
     from ..telemetry import time_kernel
 
-    with time_kernel("sharded.exact_disjunction", tier="exact", shards=S,
-                     queries=Q, k=kk, num_docs=S * n_max,
-                     rows=int(np.prod(rows.shape))):
-        v, i, t = jax.device_get(fn(sub, jnp.asarray(W), jnp.asarray(rows),
-                                    jnp.asarray(ws)))
+    fields = dict(tier="exact", shards=S, queries=Q, k=kk,
+                  num_docs=S * n_max, rows=int(np.prod(rows.shape)))
+    prog_args = (sub, jnp.asarray(W), jnp.asarray(rows), jnp.asarray(ws))
+    from ..monitoring.xla_introspect import check_dispatch
+
+    check_dispatch("sharded.exact_disjunction", fn, prog_args,
+                   fields=fields)
+    with time_kernel("sharded.exact_disjunction", **fields):
+        v, i, t = jax.device_get(fn(*prog_args))
     return v, i, t
 
 

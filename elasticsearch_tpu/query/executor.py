@@ -343,6 +343,14 @@ class ShardSearcher:
             agg_key = tuple((n, k) for n, (_, k) in sorted(parts.items()))
         k = min(max(size + from_, 1), self.pack.num_docs)
         fn = self._compiled(node, struct_key, k, agg_nodes, agg_key)
+        # PR 12: cross-check the analytic cost model against the lowered
+        # program's own cost analysis (bounded: once per plan shape)
+        from ..monitoring.xla_introspect import check_dispatch
+
+        check_dispatch("compiled_plan", fn,
+                       (self.dev, params, agg_params),
+                       fields={"queries": 1, "k": k,
+                               "num_docs": self.pack.num_docs})
         return ("dispatch", {
             "node": node, "struct_key": struct_key, "k": k,
             "agg_nodes": agg_nodes, "agg_key": agg_key, "params": params,

@@ -2561,6 +2561,47 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         return web.json_response({"serving": engine.serving.stats()})
 
     @handler
+    async def serving_flight_recorder(request):
+        """GET /_serving/flight_recorder: the bounded per-wave ring —
+        segment timings (queue/plan/device/finish summing to the wave's
+        wall time), tenant/lane mix, per-kernel utilization deltas,
+        cache traffic, and escalations (PR 12)."""
+        n = request.query.get("n")
+        return web.json_response(
+            engine.serving.flight_recorder(int(n) if n else None))
+
+    @handler
+    async def serving_flight_recorder_dump(request):
+        """POST /_serving/flight_recorder/_dump: persist the ring into
+        the hidden daily .flight-recorder-* index (what the watcher
+        `capture` action does on an SLO breach)."""
+        return web.json_response(
+            await call(engine.serving.dump_flight_recorder))
+
+    @handler
+    async def profiler_start(request):
+        """POST /_profiler/start: begin a duration-bounded jax.profiler
+        trace (body: {"duration": "2s"}); the watchdog force-stops it at
+        the bound even if /stop never arrives."""
+        body = await body_json(request, {}) or {}
+        from ..utils.durations import parse_duration_seconds
+
+        dur = parse_duration_seconds(body.get("duration"), None)
+        out = engine.profiler.start(duration_s=dur, reason="rest")
+        return web.json_response(out, status=200 if out.get("started")
+                                 else 409)
+
+    @handler
+    async def profiler_stop(request):
+        out = engine.profiler.stop()
+        return web.json_response(out, status=200 if out.get("stopped")
+                                 else 409)
+
+    @handler
+    async def profiler_status(request):
+        return web.json_response(engine.profiler.status())
+
+    @handler
     async def get_trace(request):
         """Debug endpoint: stitch every span of one trace held by this
         process into a time-ordered tree (the single-node analog of the
@@ -2624,8 +2665,44 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             extra["es.slo.breached"] = ev["breached_count"]
         except Exception:  # noqa: BLE001 - the scrape must not 500
             pass
+        # PR 12 labeled families: the PR-11 host-transition counters by
+        # kind, and the compiled-program cost-model drift by kernel
+        labeled = {}
+        try:
+            snap_c = metrics.snapshot()["counters"]
+            labeled["es_serving_host_transitions_total"] = {
+                "kind": "counter",
+                "help": "serving/sharded wave host<->device transitions "
+                        "by kind (dispatch = program launches handed to "
+                        "the device, fetch = blocking result pulls)",
+                "samples": [
+                    ({"kind": k},
+                     snap_c.get(f"es.device.host_transitions.{k}", 0))
+                    for k in ("dispatch", "fetch")],
+            }
+            from ..monitoring.xla_introspect import drift_table
+
+            fl, by = [], []
+            for kname, row in drift_table().items():
+                if "flops_ratio" in row:
+                    fl.append(({"kernel": kname}, row["flops_ratio"]))
+                    by.append(({"kernel": kname},
+                               row.get("bytes_ratio", 0.0)))
+            if fl:
+                labeled["es_costmodel_drift_flops"] = {
+                    "kind": "gauge",
+                    "help": "analytic/XLA flops ratio per kernel "
+                            "(compiled-program cross-check)",
+                    "samples": fl}
+                labeled["es_costmodel_drift_bytes"] = {
+                    "kind": "gauge",
+                    "help": "analytic/XLA bytes-accessed ratio per kernel "
+                            "(compiled-program cross-check)",
+                    "samples": by}
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            labeled = labeled or {}
         return web.Response(
-            text=metrics.prometheus_text(extra),
+            text=metrics.prometheus_text(extra, labeled=labeled),
             content_type="text/plain", charset="utf-8",
         )
 
@@ -2722,6 +2799,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
     app.router.add_get("/_serving/stats", serving_stats)
+    app.router.add_get("/_serving/flight_recorder", serving_flight_recorder)
+    app.router.add_post("/_serving/flight_recorder/_dump",
+                        serving_flight_recorder_dump)
+    app.router.add_post("/_profiler/start", profiler_start)
+    app.router.add_post("/_profiler/stop", profiler_stop)
+    app.router.add_get("/_profiler", profiler_status)
     app.router.add_get("/_nodes/hot_threads", nodes_hot_threads)
     app.router.add_get("/_trace/{trace_id}", get_trace)
     app.router.add_get("/_prometheus/metrics", prometheus_metrics)

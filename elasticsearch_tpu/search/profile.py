@@ -130,6 +130,16 @@ def device_sections(events: list[dict] | None, num_shards: int) -> list[dict]:
                         "ici_bytes", "ici_util"):
                 if key in e:
                     entry[key] = e[key]
+            # PR 12: stamp the kernel's analytic-vs-XLA drift so a
+            # profile reader sees how much to trust the mfu/bw numbers
+            try:
+                from ..monitoring.xla_introspect import OBSERVATIONS
+
+                obs = OBSERVATIONS.get(e.get("kernel"))
+                if obs is not None and "drift" in obs:
+                    entry["xla_drift"] = dict(obs["drift"])
+            except Exception:  # noqa: BLE001 - profile must not fail
+                pass
             for t in targets:
                 t["kernels"].append(entry)
             tier = e.get("tier")

@@ -34,6 +34,7 @@ import queue as _queue
 import threading
 import time
 import weakref
+from collections import deque
 
 from ..common.breaker import CircuitBreakingError
 from ..tasks import TaskCancelledException
@@ -42,6 +43,21 @@ from .coalesce import classify_request
 from .queue import (
     PendingSearch, ServingRejectedError, TenantQueues, parse_tenant_weights,
 )
+
+# hidden dump target of the flight recorder (daily, pruned by the
+# monitoring CleanerService alongside .monitoring-es-8-*)
+FLIGHT_INDEX_PREFIX = ".flight-recorder-"
+
+
+def flight_index_name(ts: float | None = None) -> str:
+    t = time.time() if ts is None else ts
+    return FLIGHT_INDEX_PREFIX + time.strftime("%Y.%m.%d", time.gmtime(t))
+
+
+def _iso_utc(ts: float | None = None) -> str:
+    t = time.time() if ts is None else ts
+    ms = int(t * 1000) % 1000
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + f".{ms:03d}Z"
 
 # live services, for test hygiene (conftest drains/stops them at module
 # boundaries so leaked engines never keep scheduler threads alive)
@@ -104,6 +120,16 @@ class ServingService:
         self._disp_sum = 0
         self._fetch_sum = 0
         self._wave_ms_ema: float | None = None
+        # flight recorder (PR 12): bounded ring of per-wave records —
+        # segment timings (admission→claim→dispatch→device→complete),
+        # tenant/lane mix, per-kernel utilization deltas, cache traffic,
+        # escalations. The black box a breach-triggered capture dumps.
+        try:
+            fr_size = int(s.get("serving.flight_recorder.size"))
+        except Exception:  # noqa: BLE001 - engines without the setting
+            fr_size = 256
+        self._flight: deque = deque(maxlen=max(fr_size, 1))
+        self._wave_seq = 0
         _LIVE_SERVICES.add(self)
 
     # ---- settings consumers ---------------------------------------------
@@ -124,6 +150,10 @@ class ServingService:
 
     def set_tenant_weights(self, raw):
         self._tenants.set_weights(parse_tenant_weights(raw))
+
+    def set_flight_recorder_size(self, v):
+        with self._lock:
+            self._flight = deque(self._flight, maxlen=max(1, int(v)))
 
     def bind_executor(self, submit):
         """Route engine-touching wave stages through the caller's single
@@ -297,16 +327,19 @@ class ServingService:
                     break
                 now = time.monotonic()
                 ready = []
+                dropped = {"expired": 0, "cancelled": 0}
                 for ps in wave:
                     if ps.task is not None and ps.task.cancelled:
                         with self._lock:
                             self.counters["cancelled"] += 1
+                        dropped["cancelled"] += 1
                         self._terminal(ps)
                         ps.future.set_exception(TaskCancelledException(
                             f"task cancelled before dispatch "
                             f"[{ps.task.cancel_reason}]"))
                         continue
                     if ps.expired(now):
+                        dropped["expired"] += 1
                         self._resolve_expired(ps)
                         continue
                     metrics.histogram_record(
@@ -329,6 +362,12 @@ class ServingService:
                     with self._lock:
                         self._inflight_count -= 1
                     continue
+                # flight-recorder timestamps: contiguous boundaries so the
+                # per-wave segments sum to the wall time by construction
+                state["t_admit"] = min(ps.enqueue_t for ps in ready)
+                state["t_claim"] = now
+                state["t_dispatched"] = time.monotonic()
+                state["dropped"] = dropped
                 # depth-1 handoff: the double buffer — blocks only while
                 # the completer still owns the PREVIOUS wave
                 handed = False
@@ -362,13 +401,18 @@ class ServingService:
                 continue
             if state is None:
                 return
+            from ..telemetry import collect_profile_events
+
             try:
-                for idx, _members, job in state["jobs"]:
-                    # engine-state-free device pull: overlaps the engine
-                    # thread's planning of the next wave
-                    idx.search_wave_fetch(job)
+                with collect_profile_events() as events:
+                    for idx, _members, job in state["jobs"]:
+                        # engine-state-free device pull: overlaps the
+                        # engine thread's planning of the next wave
+                        idx.search_wave_fetch(job)
+                state.setdefault("events", []).extend(events)
             except Exception as ex:  # noqa: BLE001
                 state["fetch_error"] = ex
+            state["t_fetched"] = time.monotonic()
             try:
                 self._engine_submit(lambda: self._wave_finish(state)).result()
             except Exception as ex:  # noqa: BLE001
@@ -382,63 +426,89 @@ class ServingService:
     # ---- wave stages (engine thread) ------------------------------------
 
     def _wave_begin(self, ready: list[PendingSearch]) -> dict:
-        state = {"t0": time.monotonic(), "jobs": [], "n": len(ready)}
+        from ..telemetry import collect_profile_events
+
+        tenants: dict[str, int] = {}
+        for ps in ready:
+            tenants[ps.tenant] = tenants.get(ps.tenant, 0) + 1
+        state = {"t0": time.monotonic(), "jobs": [], "n": len(ready),
+                 "tenants": tenants, "events": [], "fallback_solo": 0}
         by_index: dict[str, list[PendingSearch]] = {}
         for ps in ready:
             by_index.setdefault(ps.entry["index"], []).append(ps)
-        for name, members in by_index.items():
-            idx = self.engine.indices.get(name)
-            if idx is None:
-                # index vanished between classify and dispatch: the solo
-                # path produces the canonical behavior (404 / empty)
-                for ps in members:
-                    with self._lock:
-                        self.counters["fallback_solo"] += 1
-                    try:
-                        res = self.engine.search_multi(
-                            ps.entry.get("expression"),
-                            ignore_unavailable=ps.entry.get("iu", False),
-                            allow_no_indices=ps.entry.get("ani", True),
-                            **ps.entry["kwargs"])
-                        self._finish_entry(ps, result=res)
-                    except Exception as ex:  # noqa: BLE001
-                        self._finish_entry(ps, error=ex)
-                continue
-            job = idx.search_wave_begin([ps.entry["kwargs"]
-                                         for ps in members])
-            state["jobs"].append((idx, members, job))
+        with collect_profile_events() as events:
+            for name, members in by_index.items():
+                idx = self.engine.indices.get(name)
+                if idx is None:
+                    # index vanished between classify and dispatch: the
+                    # solo path produces the canonical behavior
+                    # (404 / empty)
+                    for ps in members:
+                        with self._lock:
+                            self.counters["fallback_solo"] += 1
+                        state["fallback_solo"] += 1
+                        try:
+                            res = self.engine.search_multi(
+                                ps.entry.get("expression"),
+                                ignore_unavailable=ps.entry.get("iu", False),
+                                allow_no_indices=ps.entry.get("ani", True),
+                                **ps.entry["kwargs"])
+                            self._finish_entry(ps, result=res)
+                        except Exception as ex:  # noqa: BLE001
+                            self._finish_entry(ps, error=ex)
+                    continue
+                job = idx.search_wave_begin([ps.entry["kwargs"]
+                                             for ps in members])
+                state["jobs"].append((idx, members, job))
+        state["events"].extend(events)
         return state
 
     def _wave_finish(self, state: dict):
-        from ..telemetry import metrics
+        from ..telemetry import collect_profile_events, metrics
 
         err = state.get("fetch_error")
-        for idx, members, job in state["jobs"]:
-            if err is not None:
-                results = [err] * len(members)
-            else:
-                results = idx.search_wave_finish(job)
-            for ps, res in zip(members, results):
-                if isinstance(res, Exception):
-                    self._finish_entry(ps, error=res)
+        wave_tr = {"dispatch": 0, "fetch": 0}
+        lanes = {"generic": 0, "term": 0, "tiered": 0,
+                 "fallback_solo": state.get("fallback_solo", 0)}
+        occ = []
+        indices = []
+        with collect_profile_events() as fin_events:
+            for idx, members, job in state["jobs"]:
+                if err is not None:
+                    results = [err] * len(members)
                 else:
-                    self._finish_entry(ps, result=res)
-            meta = job.get("meta", {})
-            tr = meta.get("transitions") or {}
-            metrics.histogram_record(
-                "es.serving.host_transitions",
-                tr.get("dispatch", 0) + tr.get("fetch", 0))
-            with self._lock:
-                self.counters["term_packed"] += meta.get("term_packed", 0)
-                self._disp_sum += tr.get("dispatch", 0)
-                self._fetch_sum += tr.get("fetch", 0)
-            for q, tier in meta.get("term_waves", ()):
+                    results = idx.search_wave_finish(job)
+                for ps, res in zip(members, results):
+                    if isinstance(res, Exception):
+                        self._finish_entry(ps, error=res)
+                    else:
+                        self._finish_entry(ps, result=res)
+                indices.append(idx.name)
+                lanes["generic"] += len(job.get("lanes", ()))
+                lanes["term"] += len(job.get("term_lanes", ()))
+                lanes["tiered"] += 1 if job.get("tiered") else 0
+                meta = job.get("meta", {})
+                tr = meta.get("transitions") or {}
                 metrics.histogram_record(
-                    "es.serving.wave_occupancy", q / max(tier, 1))
+                    "es.serving.host_transitions",
+                    tr.get("dispatch", 0) + tr.get("fetch", 0))
+                wave_tr["dispatch"] += tr.get("dispatch", 0)
+                wave_tr["fetch"] += tr.get("fetch", 0)
                 with self._lock:
-                    self._occ_sum += q / max(tier, 1)
-                    self._occ_n += 1
-        wave_ms = (time.monotonic() - state["t0"]) * 1000
+                    self.counters["term_packed"] += meta.get(
+                        "term_packed", 0)
+                    self._disp_sum += tr.get("dispatch", 0)
+                    self._fetch_sum += tr.get("fetch", 0)
+                for q, tier in meta.get("term_waves", ()):
+                    metrics.histogram_record(
+                        "es.serving.wave_occupancy", q / max(tier, 1))
+                    occ.append(q / max(tier, 1))
+                    with self._lock:
+                        self._occ_sum += q / max(tier, 1)
+                        self._occ_n += 1
+        state.setdefault("events", []).extend(fin_events)
+        t_complete = time.monotonic()
+        wave_ms = (t_complete - state["t0"]) * 1000
         with self._lock:
             self.counters["waves"] += 1
             if state["n"] > 1:
@@ -447,12 +517,145 @@ class ServingService:
             self._wave_ms_ema = (wave_ms if self._wave_ms_ema is None else
                                  0.8 * self._wave_ms_ema + 0.2 * wave_ms)
         metrics.histogram_record("es.serving.wave_size", state["n"])
+        self._record_flight(state, t_complete, wave_tr, lanes, occ,
+                            indices, err)
+
+    # ---- flight recorder -------------------------------------------------
+
+    def _record_flight(self, state, t_complete, wave_tr, lanes, occ,
+                       indices, err) -> None:
+        """Append one per-wave record to the ring. Segment boundaries are
+        contiguous timestamps (admission→claim→dispatched→fetched→
+        complete), so segments_ms sums to wall_ms by construction —
+        asserted by tests. Never raises: the recorder is observability,
+        not the serving path."""
+        try:
+            t_admit = state.get("t_admit", state["t0"])
+            t_claim = state.get("t_claim", state["t0"])
+            t_disp = state.get("t_dispatched", state["t0"])
+            t_fetch = state.get("t_fetched", t_disp)
+            seg = {
+                # admission → wave claimed (queue wait + coalesce window)
+                "queue": (t_claim - t_admit) * 1000,
+                # claim → every lane planned + dispatched (host plan cost)
+                "plan": (t_disp - t_claim) * 1000,
+                # dispatch → combined fetch done (device execution + pull)
+                "device": (t_fetch - t_disp) * 1000,
+                # fetch → futures resolved (host finish/merge/aggs)
+                "finish": (t_complete - t_fetch) * 1000,
+            }
+            seg = {k: round(v, 4) for k, v in seg.items()}
+            kernels: dict = {}
+            cache = {"hits": 0, "misses": 0}
+            escalations = 0
+            for e in state.get("events", ()):
+                kind = e.get("kind")
+                if kind == "kernel":
+                    u = kernels.setdefault(e["kernel"], {
+                        "calls": 0, "ms": 0.0, "flops": 0.0, "bytes": 0.0,
+                        "ici_bytes": 0.0})
+                    u["calls"] += 1
+                    u["ms"] += float(e.get("ms", 0.0))
+                    u["flops"] += float(e.get("flops", 0.0))
+                    u["bytes"] += float(e.get("bytes", 0.0))
+                    u["ici_bytes"] += float(e.get("ici_bytes", 0.0))
+                elif kind == "cache":
+                    cache["hits"] += int(e.get("hits", 0))
+                    cache["misses"] += int(e.get("misses", 0))
+                elif kind == "tier" and "escalation" in str(
+                        e.get("tier", "")):
+                    escalations += int(e.get("queries", 1))
+            from ..monitoring.costmodel import device_peaks, ici_peak
+
+            peak_f, peak_b, _kind = device_peaks()
+            for u in kernels.values():
+                sec = max(u["ms"] / 1e3, 1e-9)
+                u["mfu"] = round(u["flops"] / sec / peak_f, 6)
+                u["bw_util"] = round(u["bytes"] / sec / peak_b, 6)
+                if u["ici_bytes"]:
+                    u["ici_util"] = round(
+                        u["ici_bytes"] / sec / ici_peak(), 6)
+                else:
+                    u.pop("ici_bytes")
+                u["ms"] = round(u["ms"], 4)
+            with self._lock:
+                self._wave_seq += 1
+                rec = {
+                    "wave": self._wave_seq,
+                    "@timestamp": _iso_utc(),
+                    "node": getattr(self.engine.tasks, "node", "node-0"),
+                    "size": state["n"],
+                    "expired": state.get("dropped", {}).get("expired", 0),
+                    "cancelled": state.get("dropped", {}).get(
+                        "cancelled", 0),
+                    "error": (f"{type(err).__name__}: {err}"
+                              if err is not None else None),
+                    "tenants": dict(state.get("tenants") or {}),
+                    "indices": sorted(set(indices)),
+                    "lanes": lanes,
+                    "segments_ms": seg,
+                    "wall_ms": round((t_complete - t_admit) * 1000, 4),
+                    "host_transitions": wave_tr,
+                    "term_occupancy": (round(sum(occ) / len(occ), 4)
+                                       if occ else None),
+                    "kernels": kernels,
+                    "cache": cache,
+                    "escalations": escalations,
+                }
+                self._flight.append(rec)
+        except Exception:  # noqa: BLE001 - recorder must never fail a wave
+            pass
+
+    def flight_recorder(self, n: int | None = None) -> dict:
+        """The recorded waves, oldest first (`GET /_serving/flight_recorder`)."""
+        with self._lock:
+            waves = list(self._flight)
+        if n is not None:
+            waves = waves[-max(int(n), 0):]
+        return {
+            "capacity": self._flight.maxlen,
+            "recorded_total": self._wave_seq,
+            "retained": len(waves),
+            "waves": waves,
+        }
+
+    def dump_flight_recorder(self) -> dict:
+        """Dump the ring into the hidden daily `.flight-recorder-*` index
+        (idempotent per (node, wave): the doc id is the wave sequence).
+        The watcher `capture` action calls this on SLO breach so the
+        breach ships evidence, not just an alert doc."""
+        snap = self.flight_recorder()
+        name = flight_index_name()
+        eng = self.engine
+        if name not in eng.indices:
+            eng.create_index(name, mappings={"properties": {
+                "@timestamp": {"type": "date"},
+                "node": {"type": "keyword"},
+                "wave": {"type": "long"},
+            }}, settings={"hidden": True, "number_of_shards": 1,
+                          "refresh_interval": "1s"})
+        idx = eng.indices[name]
+        for rec in snap["waves"]:
+            idx.index_doc(f"{rec['node']}_{rec['wave']}", dict(rec))
+        idx.refresh()
+        from ..telemetry import metrics
+
+        metrics.counter_inc("es.serving.flight_recorder.dumps")
+        return {"index": name, "docs": len(snap["waves"]),
+                "capacity": snap["capacity"]}
 
     # ---- introspection / lifecycle --------------------------------------
 
     def stats(self) -> dict:
         from ..parallel.spmd import spmd_mode
+        from ..telemetry import metrics
 
+        # cumulative PR-11 host-transition counters (node-wide, also on
+        # the Prometheus scrape as es_serving_host_transitions_total)
+        c = metrics.snapshot()["counters"]
+        transitions_total = {
+            kind: int(c.get(f"es.device.host_transitions.{kind}", 0))
+            for kind in ("dispatch", "fetch")}
         with self._lock:
             waves = max(self.counters["waves"], 1)
             return {
@@ -476,6 +679,12 @@ class ServingService:
                         "dispatch": self._disp_sum / waves,
                         "fetch": self._fetch_sum / waves,
                     },
+                },
+                "host_transitions_total": transitions_total,
+                "flight_recorder": {
+                    "capacity": self._flight.maxlen,
+                    "retained": len(self._flight),
+                    "recorded_total": self._wave_seq,
                 },
                 **{k: v for k, v in self.counters.items()},
             }
@@ -530,3 +739,5 @@ class ServingService:
             self._size_sum = 0
             self._disp_sum = self._fetch_sum = 0
             self._wave_ms_ema = None
+            self._flight.clear()
+            self._wave_seq = 0

@@ -662,13 +662,18 @@ class MetricsRegistry:
         "es.serving.queue_depth": "serving admission queue depth",
     }
 
-    def prometheus_text(self, extra_gauges: dict | None = None) -> str:
+    def prometheus_text(self, extra_gauges: dict | None = None,
+                        labeled: dict | None = None) -> str:
         """Prometheus text exposition (format 0.0.4): counters as
         `_total`, gauges, histograms as cumulative `_bucket{le=...}` +
         `_sum`/`_count` with the exponential bucket upper bounds; every
         metric family is preceded by its `# HELP` and `# TYPE` lines.
         `extra_gauges`: point-in-time values rendered as gauges (breaker /
-        cache state sampled by the endpoint)."""
+        cache state sampled by the endpoint). `labeled`: multi-sample
+        families rendered with label sets (PR 12 — host-transition
+        counters by kind, cost-model drift gauges by kernel):
+        {family_name: {"kind": "counter"|"gauge", "help": str,
+        "samples": [(labels_dict, value), ...]}}."""
         import re as _re
 
         def san(name: str) -> str:
@@ -718,6 +723,21 @@ class MetricsRegistry:
             m = san(name)
             head(lines, name, m, "gauge")
             lines.append(f"{m} {num(v)}")
+        for name in sorted(labeled or {}):
+            fam = labeled[name]
+            m = san(name)
+            kind = fam.get("kind", "gauge")
+            lines.append(f"# HELP {m} "
+                         f"{(fam.get('help') or f'{name} ({kind})')}")
+            lines.append(f"# TYPE {m} {kind}")
+            for labels, v in fam.get("samples", ()):
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    v = int(v) if isinstance(v, bool) else None
+                if v is None:
+                    continue
+                lab = ",".join(f'{san(k)}="{val}"'
+                               for k, val in sorted(labels.items()))
+                lines.append(f"{m}{{{lab}}} {num(v)}")
         for name in sorted(hist_data):
             count, total, zero_count, buckets = hist_data[name]
             m = san(name)

@@ -495,6 +495,28 @@ class WatcherService:
                                   "method": spec.get("method", "POST"),
                                   "url": spec.get("url", ""),
                               }}
+            if "capture" in aspec:
+                # PR 12: breach-triggered evidence — dump the serving
+                # flight recorder and take a duration-bounded
+                # jax.profiler trace, so the alert doc is accompanied by
+                # the last N waves' timings and a device trace of the
+                # breach window (not just an indicator flip)
+                spec = aspec["capture"] or {}
+                detail: dict = {"type": "capture"}
+                if spec.get("flight_recorder", True):
+                    sv = getattr(self.engine, "_serving", None)
+                    if sv is not None:
+                        detail["flight_recorder"] = sv.dump_flight_recorder()
+                    else:
+                        detail["flight_recorder"] = {
+                            "skipped": "serving front end not built"}
+                ms = spec.get("profile_ms", 200)
+                if ms:
+                    detail["profile"] = self.engine.profiler.capture(
+                        duration_s=float(ms) / 1000.0,
+                        reason=f"watch [{wid}]")
+                metrics.counter_inc("es.watcher.captures")
+                return True, detail
             return True, {"type": "noop"}
         except Exception as e:  # noqa: BLE001 - a failing action is recorded, not raised
             self.counters["errors"] += 1

@@ -113,6 +113,64 @@ def _fmt(v: float) -> str:
     return f"{v:.6g}"
 
 
+def drift_ratios(record: dict) -> dict:
+    """-> {"<config>.<kernel>.<flops|bytes>_ratio": value} from the
+    per-arm xla_cost_check sections (PR 12)."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "xla_cost_check" and isinstance(v, dict):
+                    for kname, row in (v.get("kernels") or {}).items():
+                        for rk in ("flops_ratio", "bytes_ratio"):
+                            val = row.get(rk)
+                            if isinstance(val, (int, float)):
+                                out[".".join(path + (kname, rk))] = float(val)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+def drift_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY: cost-model drift that moved by more than `threshold`
+    relative between records — a formula or a compiled program changed
+    under the analytic model's feet. Never fails the lint (the gauge is
+    a trust signal, not a perf criterion): the output is for the reader
+    of the tier-1 log."""
+    a, b = drift_ratios(prev), drift_ratios(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        rel = abs(new - old) / old
+        if rel > threshold:
+            moved.append((path, old, new, rel))
+    return moved
+
+
+def print_drift_table(record_path: str) -> None:
+    """--print-drift: render the newest record's xla_cost_check sections
+    (tier1_gate.sh prints this when records exist)."""
+    with open(record_path, encoding="utf-8") as fh:
+        record = json.load(fh)
+    ratios = drift_ratios(record)
+    if not ratios:
+        print("[bench-regress] no xla_cost_check sections in "
+              f"{os.path.basename(record_path)} (pre-PR-12 record)")
+        return
+    print(f"[bench-regress] cost-model drift table "
+          f"({os.path.basename(record_path)}; analytic/XLA ratio):")
+    for path in sorted(ratios):
+        print(f"  {path:<70} {ratios[path]:.4f}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--dir", default=os.path.join(
@@ -122,8 +180,17 @@ def main(argv=None) -> int:
                     help="relative regression threshold (default 0.2)")
     ap.add_argument("--force", action="store_true",
                     help="enforce even for CPU-smoke records")
+    ap.add_argument("--print-drift", action="store_true",
+                    help="print the newest record's cost-model drift "
+                         "table and exit 0 (PR 12)")
     args = ap.parse_args(argv)
     records = find_records(args.dir)
+    if args.print_drift:
+        if not records:
+            print("[bench-regress] no BENCH_r*.json records")
+            return 0
+        print_drift_table(records[-1][1])
+        return 0
     if len(records) < 2:
         print(f"[bench-regress] {len(records)} record(s) in {args.dir} — "
               "need two to compare; nothing to do")
@@ -147,6 +214,10 @@ def main(argv=None) -> int:
     for path, old, new, ratio in improvements[:10]:
         print(f"  improved  {path}: {_fmt(old)} -> {_fmt(new)} "
               f"({ratio:.2f}x)")
+    for path, old, new, rel in drift_growth(prev, latest, args.threshold):
+        print(f"  DRIFT (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({rel:.0%} moved) — cost model vs XLA shifted; "
+              "re-derive the analytic entry or update BENCH_NOTES")
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -120,12 +120,104 @@ def render(trace: dict, out=sys.stdout) -> None:
         visit(r, 0)
 
 
+# ---------------------------------------------------------------------------
+# flight-recorder rendering (PR 12)
+# ---------------------------------------------------------------------------
+
+_SEG_ORDER = ("queue", "plan", "device", "finish")
+_SEG_CHARS = {"queue": "░", "plan": "▒", "device": "█", "finish": "▓"}
+
+
+def _fetch_flight(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{url.rstrip('/')}/_serving/flight_recorder", timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def _load_flight(path: str) -> dict:
+    """A saved GET /_serving/flight_recorder body, or a JSON-lines dump
+    of `.flight-recorder-*` docs (one wave record per line)."""
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if head == "{":
+            try:
+                return json.load(fh)
+            except json.JSONDecodeError:
+                fh.seek(0)
+        waves = [json.loads(ln) for ln in fh if ln.strip()]
+    return {"capacity": None, "retained": len(waves), "waves": waves}
+
+
+def render_flight(snap: dict, out=sys.stdout) -> None:
+    """One line per recorded wave: a BAR_WIDTH bar partitioned by the
+    wave's segment timings (queue ░ / plan ▒ / device █ / finish ▓ —
+    contiguous, summing to the wall time), plus size/tenant/kernel
+    attribution. The per-wave analog of the span tree above: where did
+    this wave's wall time actually sit."""
+    waves = snap.get("waves", [])
+    print(f"flight recorder: {len(waves)} wave(s) retained "
+          f"(capacity={snap.get('capacity')}, "
+          f"recorded_total={snap.get('recorded_total')})", file=out)
+    legend = "  ".join(f"{_SEG_CHARS[s]} {s}" for s in _SEG_ORDER)
+    print(f"  segments: {legend}", file=out)
+    for w in waves:
+        seg = w.get("segments_ms") or {}
+        wall = max(float(w.get("wall_ms") or 0.0), 1e-9)
+        bar = ""
+        for s in _SEG_ORDER:
+            n = int(round(BAR_WIDTH * float(seg.get(s, 0.0)) / wall))
+            bar += _SEG_CHARS[s] * n
+        bar = (bar + "·" * BAR_WIDTH)[:BAR_WIDTH]
+        tr = w.get("host_transitions") or {}
+        kernels = w.get("kernels") or {}
+        top_kernel = max(kernels, key=lambda k: kernels[k].get("ms", 0.0),
+                         default=None)
+        extras = []
+        if top_kernel:
+            tk = kernels[top_kernel]
+            extras.append(f"top={top_kernel}:{tk.get('ms', 0)}ms"
+                          f" mfu={tk.get('mfu', 0)}")
+        if w.get("escalations"):
+            extras.append(f"esc={w['escalations']}")
+        if w.get("error"):
+            extras.append("ERROR")
+        print(f"  [{bar}] w{w.get('wave'):>4} size={w.get('size'):>3} "
+              f"wall={wall:8.2f}ms "
+              f"q/p/d/f={seg.get('queue', 0):.1f}/{seg.get('plan', 0):.1f}"
+              f"/{seg.get('device', 0):.1f}/{seg.get('finish', 0):.1f} "
+              f"tr={tr.get('dispatch', 0)}+{tr.get('fetch', 0)} "
+              f"tenants={len(w.get('tenants') or {})}"
+              f"{' ' + ' '.join(extras) if extras else ''}", file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", help="node/gateway base URL to fetch from")
     ap.add_argument("--otlp", help="OTLP JSON-lines file (ES_TPU_OTLP_FILE)")
-    ap.add_argument("--trace", required=True, help="trace id (32 hex)")
+    ap.add_argument("--trace", help="trace id (32 hex)")
+    ap.add_argument("--flight", nargs="?", const="-",
+                    help="render the serving flight recorder instead of a "
+                         "trace: with a PATH, read a saved recorder body "
+                         "or a JSON-lines dump; bare --flight fetches "
+                         "GET /_serving/flight_recorder from --url")
     args = ap.parse_args(argv)
+    if args.flight is not None:
+        if args.flight == "-":
+            if not args.url:
+                ap.error("bare --flight needs --url to fetch from")
+            snap = _fetch_flight(args.url)
+        else:
+            snap = _load_flight(args.flight)
+        if not snap.get("waves"):
+            print("flight recorder: no waves recorded", file=sys.stderr)
+            return 1
+        render_flight(snap)
+        return 0
+    if not args.trace:
+        ap.error("--trace is required (or use --flight)")
     if bool(args.url) == bool(args.otlp):
         ap.error("exactly one of --url / --otlp is required")
     trace = (_fetch_url(args.url, args.trace) if args.url

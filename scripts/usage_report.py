@@ -151,14 +151,24 @@ def render(per_node: dict[str, dict], out=None) -> None:
         if not kernels:
             print("  (no kernel dispatches recorded)", file=out)
             continue
-        rows = [("kernel", "calls", "wall_ms", "mfu", "bw_util")]
+        # PR 12: join the node's cost-model drift table so the MFU/bw
+        # columns print beside the ratio saying how far the analytic
+        # numerator sits from XLA's own count for the compiled program
+        drift = dev.get("costmodel_drift") or {}
+        rows = [("kernel", "calls", "wall_ms", "mfu", "bw_util",
+                 "xla_flops_ratio", "xla_bytes_ratio")]
         for name in sorted(kernels):
             u = kernels[name]
+            dr = drift.get(name) or {}
             rows.append((name, str(u.get("calls", 0)),
                          f"{u.get('wall_ms', 0):.1f}",
                          f"{u.get('mfu', 0) * 100:.3f}%",
-                         f"{u.get('bw_util', 0) * 100:.3f}%"))
-        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+                         f"{u.get('bw_util', 0) * 100:.3f}%",
+                         (f"{dr['flops_ratio']:.3f}"
+                          if "flops_ratio" in dr else "-"),
+                         (f"{dr['bytes_ratio']:.3f}"
+                          if "bytes_ratio" in dr else "-")))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
         for r in rows:
             print("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths))
                   .rstrip(), file=out)

@@ -185,6 +185,193 @@ def test_cost_fns_resolve_on_representative_fields():
     assert kernel_cost("fused.msearch", {"queries": 4}) is None  # wrapper
 
 
+def test_every_cost_entry_declares_an_xla_check_status():
+    """PR 12 lint: every KERNEL_COSTS entry must declare its XLA
+    cross-check policy — "checked" (a check_dispatch site is wired at
+    its compiled-plan cache) or "exempt" WITH a recorded reason. A new
+    kernel cannot ship silently un-cross-checked."""
+    from elasticsearch_tpu.monitoring.xla_introspect import (
+        XLA_CHECKS, xla_check_status)
+
+    undeclared = [n for n in KERNEL_COSTS if n not in XLA_CHECKS]
+    assert not undeclared, (
+        f"KERNEL_COSTS entries without an xla_check status: {undeclared} — "
+        "declare them in monitoring/xla_introspect.XLA_CHECKS as checked "
+        "or exempt-with-reason")
+    for name, spec in XLA_CHECKS.items():
+        assert spec.get("status") in ("checked", "exempt"), (name, spec)
+        if spec["status"] == "exempt":
+            assert spec.get("reason"), (
+                f"[{name}] is exempt without a reason — silent exemptions "
+                "fail tier-1")
+    # stale declarations should be pruned with their cost entries
+    stale = [n for n in XLA_CHECKS if n not in KERNEL_COSTS]
+    assert not stale, f"XLA_CHECKS entries without a cost entry: {stale}"
+    # the acceptance anchors stay checked with documented tolerance bands
+    for anchor in ("vector.knn_scan", "sharded.global_merge"):
+        spec = xla_check_status(anchor)
+        assert spec["status"] == "checked" and spec.get("tol"), anchor
+    assert xla_check_status("sharded.allgather_topk")["status"] == "checked"
+
+
+def test_xla_cross_check_dense_matmul_parity():
+    """Acceptance: on the CPU backend the cross-check runs for the dense
+    matmul kernel through its real dispatch site (the vector.knn_scan
+    escalation arm) and the analytic/XLA flops ratio sits inside the
+    tolerance documented in XLA_CHECKS (the analytic model is
+    matmul-dominant, so the band is tight)."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.monitoring import xla_introspect as xi
+    from elasticsearch_tpu.ops.vector import TieredKnnScanner
+
+    # near-tie corpus: every vector within 1e-6 of the query direction,
+    # so the split-bf16 selection margin test MUST flag the query and
+    # the exact f32 scan (the capture site) always runs
+    rng = np.random.default_rng(7)
+    base = rng.normal(size=8).astype(np.float32)
+    vecs = base[None, :] + 1e-6 * rng.normal(size=(300, 8)).astype(
+        np.float32)
+    sq = np.sum(vecs * vecs, axis=1)
+    sc = TieredKnnScanner(jnp.asarray(vecs), jnp.asarray(sq),
+                          "dot_product")
+    _v, _i, _t, safe = sc.search(np.asarray([base], np.float32), k=10)
+    assert not safe.all(), "corpus failed to force the escalation arm"
+    obs = xi.observation("vector.knn_scan")
+    assert obs is not None, "cross-check did not capture at the site"
+    lo, hi = xi.XLA_CHECKS["vector.knn_scan"]["tol"]
+    assert lo <= obs["drift"]["flops"] <= hi, obs
+    blo, bhi = xi.XLA_CHECKS["vector.knn_scan"]["bytes_tol"]
+    assert blo <= obs["drift"]["bytes"] <= bhi, obs
+    # memory_analysis of the compiled executable rode along
+    assert obs["memory"].get("argument_bytes", 0) > 0
+    assert obs["memory"].get("output_bytes", 0) > 0
+    assert obs["memory"]["peak_bytes"] >= obs["memory"]["argument_bytes"]
+    # ...and the drift gauge is in the registry + the drift table
+    from elasticsearch_tpu.monitoring.xla_introspect import drift_table
+    from elasticsearch_tpu.telemetry import metrics
+
+    g = metrics.snapshot()["gauges"]
+    assert g.get("es.costmodel.drift.vector.knn_scan.flops") == \
+        obs["drift"]["flops"]
+    row = drift_table()["vector.knn_scan"]
+    assert row["status"] == "checked"
+    assert row["flops_ratio"] == obs["drift"]["flops"]
+
+
+def test_xla_cross_check_allgather_merge_parity(monkeypatch):
+    """Acceptance: the cross-check runs for the allgather-topk one-program
+    route and the standalone device merge on the pjit CPU mesh; the
+    merge program's analytic/XLA ratio sits inside its documented band
+    (the program is small enough that the 2-ops/element selection
+    convention tracks XLA's sort closely — measured 0.52-0.71 flops,
+    0.96-0.98 bytes on the 4/8-shard CPU meshes)."""
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.monitoring import xla_introspect as xi
+    from elasticsearch_tpu.parallel.sharded import (
+        StackedSearcher, global_merge_rows, make_mesh, msearch_sharded)
+    from elasticsearch_tpu.parallel.stacked import build_stacked_pack
+
+    monkeypatch.setenv("ES_TPU_SPMD", "pjit")
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    docs = [(f"d{i}", {"body": " ".join(rng.choice(words, size=8))})
+            for i in range(320)]
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    sp = build_stacked_pack(docs, m, num_shards=4)
+    ss = StackedSearcher(sp, mesh=make_mesh(4))
+    assert ss._exec == "pjit"
+    queries = [[("w1", 1.0), ("w2", 1.0)], [("w3", 1.0)]] * 4
+    msearch_sharded(ss, "body", queries, k=5)
+    obs = xi.observation("sharded.allgather_topk")
+    assert obs is not None, \
+        "one-program msearch route did not reach the cross-check"
+    assert obs["xla"]["flops"] > 0 and obs["analytic"]["flops"] > 0
+    assert obs["drift"]["flops"] > 0
+    # the standalone merge program: the tight-band anchor
+    v = rng.normal(size=(4, 8, 5)).astype(np.float32)
+    i = rng.integers(0, 64, size=(4, 8, 5)).astype(np.int64)
+    t = np.full((4, 8), 7, np.int64)
+    global_merge_rows(ss, v, i, t)
+    mo = xi.observation("sharded.global_merge")
+    assert mo is not None
+    lo, hi = xi.XLA_CHECKS["sharded.global_merge"]["tol"]
+    assert lo <= mo["drift"]["flops"] <= hi, mo
+    blo, bhi = xi.XLA_CHECKS["sharded.global_merge"]["bytes_tol"]
+    assert blo <= mo["drift"]["bytes"] <= bhi, mo
+
+
+def test_xla_check_disabled_and_bounded(monkeypatch):
+    """ES_TPU_XLA_CHECK=0 turns capture off entirely; with it on, the
+    per-kernel capture budget bounds the work (after MAX captures the
+    call is a dict lookup returning None)."""
+    import jax
+
+    from elasticsearch_tpu.monitoring import xla_introspect as xi
+
+    fn = jax.jit(lambda x: x * 2.0)
+    args = (np.ones((4, 4), np.float32),)
+    monkeypatch.setenv("ES_TPU_XLA_CHECK", "0")
+    assert xi.check_dispatch("compiled_plan", fn, args,
+                             fields={"queries": 1, "num_docs": 4}) is None
+    monkeypatch.delenv("ES_TPU_XLA_CHECK", raising=False)
+    monkeypatch.setenv("ES_TPU_XLA_CHECK_MAX", "1")
+    # exempt kernels never capture
+    assert xi.check_dispatch("fused.pallas_scan", fn, args) is None
+    before = xi._capture_counts.get("compiled_plan", 0)
+    if before == 0:
+        assert xi.check_dispatch(
+            "compiled_plan", fn, args,
+            fields={"queries": 1, "num_docs": 4}) is not None
+    # budget reached: a NEW shape does not capture
+    assert xi.check_dispatch(
+        "compiled_plan", fn, (np.ones((8, 8), np.float32),),
+        fields={"queries": 1, "num_docs": 8}) is None
+
+
+def test_bench_xla_cost_check_section(tmp_path, monkeypatch):
+    """bench._profile_arm records carry the in-record ground truth."""
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = bench
+    spec.loader.exec_module(bench)
+    from elasticsearch_tpu.monitoring import xla_introspect as xi
+    from elasticsearch_tpu.telemetry import time_kernel
+
+    if xi.observation("vector.knn_scan") is None:
+        test_xla_cross_check_dense_matmul_parity()
+
+    def run():
+        with time_kernel("vector.knn_scan", queries=2, dims=8,
+                         num_docs=100, k=5):
+            pass
+
+    arm = bench._profile_arm(run)
+    sec = arm["xla_cost_check"]
+    row = sec["kernels"]["vector.knn_scan"]
+    assert row["status"] == "checked"
+    assert row["flops_ratio"] > 0 and sec["checked"] >= 1
+    # bench_regress renders + diffs drift sections (advisory only)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import bench_regress
+
+    rec = {"extras": {"c1": {"profile": arm}}}
+    ratios = bench_regress.drift_ratios(rec)
+    assert any(p.endswith("vector.knn_scan.flops_ratio") for p in ratios)
+    prev = {"extras": {"c1": {"profile": {
+        "xla_cost_check": {"kernels": {"vector.knn_scan": {
+            "status": "checked", "flops_ratio":
+                row["flops_ratio"] * 2.0, "bytes_ratio": 1.0}}}}}}}
+    moved = bench_regress.drift_growth(prev, rec, 0.2)
+    assert any(p.endswith("vector.knn_scan.flops_ratio")
+               for p, _o, _n, _r in moved)
+
+
 # ---------------------------------------------------------------------------
 # time_kernel -> utilization attribution
 # ---------------------------------------------------------------------------
@@ -473,12 +660,30 @@ def test_rest_device_stats_prometheus_and_collect():
             assert ku["calls"] >= 1 and ku["flops"] > 0
             assert dev["jit"]["compiles"] >= 0
             assert node["monitoring"]["enabled"] is False
+            # PR 12: the compiled-program cross-check table rides
+            # device.utilization — the search above captured the
+            # compiled plan (or an earlier test in this process did)
+            drift = dev["utilization"]["costmodel_drift"]
+            assert drift["compiled_plan"]["status"] == "checked"
+            assert drift["compiled_plan"]["flops_ratio"] > 0
+            assert drift["fused.pallas_scan"]["status"] == "exempt"
+            assert "reason" in drift["fused.pallas_scan"]
+            # ...and the serving section carries the cumulative
+            # host-transition counters (satellite: beyond /_serving/stats)
+            assert "host_transitions_total" in node["serving"]
             # prometheus: device gauges + per-kernel MFU histograms
             text = await (await client.get("/_prometheus/metrics")).text()
             assert "es_device_hbm_live_bytes" in text
             assert "es_device_pack_padded_waste_bytes" in text
             assert "es_kernel_compiled_plan_mfu_pct" in text
             assert "es_kernel_compiled_plan_bw_pct" in text
+            # PR 12 labeled families on the scrape
+            assert 'es_costmodel_drift_flops{kernel="compiled_plan"}' \
+                in text
+            assert 'es_serving_host_transitions_total{kind="dispatch"}' \
+                in text
+            assert 'es_serving_host_transitions_total{kind="fetch"}' \
+                in text
             # one synchronous collection tick through REST
             r = await client.post("/_monitoring/_collect")
             assert r.status == 200
