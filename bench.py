@@ -101,15 +101,19 @@ def sample_queries(rng, lens, tok, n_queries, terms_per_query=TERMS_PER_QUERY):
 def build_pack(lens, tok, dense_min_df=None):
     from elasticsearch_tpu.index.mappings import Mappings
     from elasticsearch_tpu.index.pack import PackBuilder
+    from elasticsearch_tpu.monitoring.refresh_profile import refresh_stage
 
     m = Mappings({"properties": {"body": {"type": "text"}}})
     b = PackBuilder(m)
     term_strs = np.array([f"t{i}" for i in range(VOCAB)])
     doc_terms = term_strs[tok]
     off = 0
-    for ln in lens:
-        b.add_document({"body": [" ".join(doc_terms[off : off + ln])]})
-        off += ln
+    # attributed as the analyze stage of the build_profile record (the
+    # engine path marks the same stage in parallel/stacked.py)
+    with refresh_stage("analyze"):
+        for ln in lens:
+            b.add_document({"body": [" ".join(doc_terms[off : off + ln])]})
+            off += ln
     return b.build(dense_min_df=dense_min_df), m
 
 
@@ -277,6 +281,28 @@ def config1_match(searcher, m, lens, tok, rng):
         "impact": impact_arm,
         "profile": profile_arm,
         "latency_pcts": latency_pcts,
+    }
+
+
+def _build_profile_arm(build_fn, docs):
+    """PR 13 satellite: profile one corpus build through the write-path
+    stage collector (monitoring/refresh_profile) — per-stage wall ms,
+    docs/s, tail_fraction (0.0 by construction for a fresh full build).
+    This is the HOST-build baseline the ROADMAP item-2 device port must
+    beat, with the stage split saying which stage to port first.
+    Returns (build_output, build_profile_record)."""
+    from elasticsearch_tpu.monitoring.refresh_profile import (
+        collect_build_stages)
+
+    with collect_build_stages() as c:
+        out = build_fn()
+    wall_s, stages = c.finish()
+    return out, {
+        "wall_ms": round(wall_s * 1000, 1),
+        "docs": int(docs),
+        "docs_per_s": round(docs / max(wall_s, 1e-9), 1),
+        "tail_fraction": 0.0,
+        "stages_ms": {k: round(v * 1000, 2) for k, v in stages.items()},
     }
 
 
@@ -904,7 +930,11 @@ def _c4_ann_arm(rng, n, dims, q_n, time_arm):
             + rng.standard_normal((n, dims)).astype(np.float32) * 0.6)
     sq = (vecs * vecs).sum(axis=1)
     t0 = time.perf_counter()
-    ann = build_ann(vecs, np.ones(n, bool), nlist=nlist)
+    # build_profile (PR 13): stage-partitioned C4 ANN build baseline
+    # (build.kmeans vs build.ann_tiles is THE split the device port
+    # attacks — batched kmeans as matmul+argmin waves)
+    ann, c4_build = _build_profile_arm(
+        lambda: build_ann(vecs, np.ones(n, bool), nlist=nlist), n)
     build_s = time.perf_counter() - t0
     searcher = AnnSearcher(ann, vecs, sq, "cosine")
 
@@ -943,6 +973,7 @@ def _c4_ann_arm(rng, n, dims, q_n, time_arm):
         "tile": ann["tile"],
         "default_nprobe_nc100": True,
         "build_s": round(build_s, 1),
+        "build_profile": c4_build,
         "recall_at_10": recall,
         "qps_int8": round(qps_ann, 1),
         "qps_bf16": round(qps_bf16, 1),
@@ -1600,9 +1631,15 @@ def main():
     if _want("c1") or _want("c2"):
         log("[pack] building 1M-doc text pack...")
         t0 = time.perf_counter()
-        pack, m = build_pack(lens, tok)
+        # build_profile (PR 13): the C1 host-build baseline record — the
+        # per-stage split the item-2 device port is graded against
+        (pack, m), c1_build = _build_profile_arm(
+            lambda: build_pack(lens, tok), N_DOCS)
+        extras.setdefault("build_profile", {})["c1_pack"] = c1_build
+        _write_record(extras, partial=True)
         log(f"[pack] built in {time.perf_counter()-t0:.0f}s; "
-            f"dense tier {None if pack.dense_tfn is None else pack.dense_tfn.shape}")
+            f"dense tier {None if pack.dense_tfn is None else pack.dense_tfn.shape}; "
+            f"stages {c1_build['stages_ms']}")
         from elasticsearch_tpu.query.executor import ShardSearcher
 
         if _want("c1"):

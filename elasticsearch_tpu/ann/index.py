@@ -39,6 +39,7 @@ def build_ann(vectors, has_value, nlist: int, tile: int = TILE_LANES):
     """-> dict(centroids, order, codes, scale, offset, nlist, tile,
     built_n) or None when the corpus is too small for partitioning to
     help (same 4*nlist floor as the old host-side build_ivf)."""
+    from ..monitoring.refresh_profile import build_stage
     from ..ops.vector import kmeans_ivf
 
     from .quantize import scalar_quantize_int8
@@ -47,29 +48,35 @@ def build_ann(vectors, has_value, nlist: int, tile: int = TILE_LANES):
     present = np.flatnonzero(has_value)
     if len(present) < 4 * max(nlist, 1) or nlist <= 1:
         return None
-    centroids, assign = kmeans_ivf(vectors[present], nlist)
-    C = centroids.shape[0]
     D = vectors.shape[1]
+    # kmeans runs 8 Lloyd iterations as jax matmuls (ops/vector) — the
+    # first write-path stage that is already device-shaped, so its
+    # cost-model MFU is the day-one baseline for the item-2 port
+    with build_stage("build.kmeans", n=len(present), dims=D,
+                     nlist=max(nlist, 1), iters=8):
+        centroids, assign = kmeans_ivf(vectors[present], nlist)
+    C = centroids.shape[0]
     order_local = np.argsort(assign, kind="stable")
     sizes = np.bincount(assign, minlength=C)
     L = _round_up(int(sizes.max()), tile)
-    order = np.full((C, L), -1, np.int32)
-    codes = np.zeros((C, L, D), np.int8)
-    scale = np.zeros((C, L), np.float32)
-    offset = np.zeros((C, L), np.float32)
-    start = 0
-    docids = present[order_local].astype(np.int32)
-    for c in range(C):
-        n = int(sizes[c])
-        if n == 0:
-            continue
-        ids = docids[start:start + n]
-        order[c, :n] = ids
-        q, s, o = scalar_quantize_int8(vectors[ids])
-        codes[c, :n] = q
-        scale[c, :n] = s
-        offset[c, :n] = o
-        start += n
+    with build_stage("build.ann_tiles", nlist=C, tile=L, dims=D):
+        order = np.full((C, L), -1, np.int32)
+        codes = np.zeros((C, L, D), np.int8)
+        scale = np.zeros((C, L), np.float32)
+        offset = np.zeros((C, L), np.float32)
+        start = 0
+        docids = present[order_local].astype(np.int32)
+        for c in range(C):
+            n = int(sizes[c])
+            if n == 0:
+                continue
+            ids = docids[start:start + n]
+            order[c, :n] = ids
+            q, s, o = scalar_quantize_int8(vectors[ids])
+            codes[c, :n] = q
+            scale[c, :n] = s
+            offset[c, :n] = o
+            start += n
     return {
         "centroids": centroids.astype(np.float32),
         "order": order,

@@ -248,6 +248,15 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True),
         Setting("slo.hbm.headroom_fraction", 0.98, Setting.float_,
                 dynamic=True),
+        # write-path SLO floors (PR 13): bound the exact-scan tail-tier
+        # doc fraction and the visibility lag of unrefreshed writes —
+        # the standing invariants ROADMAP item 2's mixed read/write C7
+        # bench arm is graded against. 0 disables (the default: floors
+        # are set from measured baselines, not guessed)
+        Setting("slo.write.tail_fraction", 0.0, Setting.float_,
+                dynamic=True),
+        Setting("slo.write.refresh_lag_ms", 0.0, Setting.float_,
+                dynamic=True),
         Setting("slo.custom", "", str, dynamic=True),
         # continuous-batching serving front end (serving/): admission,
         # coalescing into device waves, deadline/fairness scheduling,
@@ -267,6 +276,11 @@ def default_cluster_settings() -> list[Setting]:
         # segment timings / tenant mix / kernel deltas, dumped to the
         # hidden .flight-recorder-* index by the watcher capture action
         Setting("serving.flight_recorder.size", 256, Setting.positive_int,
+                dynamic=True),
+        # write-path RefreshProfile ring (PR 13): per-refresh stage
+        # timings at GET /_refresh/profile, the refresh-side twin of the
+        # serving flight recorder
+        Setting("indexing.profile.size", 256, Setting.positive_int,
                 dynamic=True),
         # breach-triggered device profiling (monitoring/profiler.py):
         # duration-bounded jax.profiler traces; trace dirs pruned on the

@@ -135,6 +135,11 @@ class EsIndex:
         self.data_dir = data_dir
         self._wal = None
         self._dirty = True
+        # refresh lag (PR 13): monotonic stamp of the OLDEST write not yet
+        # made visible by a refresh — the write-path analog of queue wait,
+        # surfaced as the `indexing.refresh_lag_ms` gauge and bounded by
+        # the slo.write.refresh_lag_ms objective
+        self._dirty_since: float | None = None
         self._last_refresh = 0.0
         self._searcher: StackedSearcher | None = None
         # searchable-snapshot lazy hydration (snapshots/service.py
@@ -414,6 +419,8 @@ class EsIndex:
         if len(self.mappings.fields) != n_fields:
             self._persist_meta()  # dynamic mappings grew
         self._dirty = True
+        if self._dirty_since is None:
+            self._dirty_since = time.monotonic()
         self.counters["index_total"] = self.counters.get("index_total", 0) + 1
         if any(k.startswith("indexing.slowlog") for k in self.settings):
             from ..telemetry import record_indexing_slowlog
@@ -438,6 +445,8 @@ class EsIndex:
         self._pending.add(doc_id)
         self._wal_append({"op": "delete", "id": doc_id, "version": e.version, "seq_no": e.seq_no})
         self._dirty = True
+        if self._dirty_since is None:
+            self._dirty_since = time.monotonic()
         self.counters["delete_total"] = self.counters.get("delete_total", 0) + 1
         return {"_id": doc_id, "_version": e.version, "_seq_no": e.seq_no, "result": "deleted"}
 
@@ -507,16 +516,21 @@ class EsIndex:
         self._searcher = value
 
     def refresh(self, mesh=None):
+        from ..monitoring.refresh_profile import profile_refresh
+
         if self._hydrate is not None:
             h, self._hydrate = self._hydrate, None
             h()
         if self._searcher is not None and not self._pending and not self._dirty:
             return  # nothing written since the last refresh
         if self._can_refresh_incremental():
-            self._refresh_incremental()
+            with profile_refresh(self, "incremental"):
+                self._refresh_incremental()
         else:
-            self._refresh_full(mesh)
+            with profile_refresh(self, "full"):
+                self._refresh_full(mesh)
         self._dirty = False
+        self._dirty_since = None
         self._last_refresh = time.monotonic()
         self.counters["refresh_total"] = self.counters.get("refresh_total", 0) + 1
 
@@ -533,6 +547,33 @@ class EsIndex:
         for s in (self._searcher, self._tail):
             if s is not None:
                 rc.invalidate_searcher(s.cache_token)
+
+    def tier_stats(self) -> dict:
+        """Current (base, tail) tier sizes and the tail-tier doc fraction
+        — the fraction of visible docs served by the exact-scan tail
+        instead of the precomputed base tiers (impact codes, IVF tiles,
+        dense split pairs). The standing write-path invariant: a
+        write-heavy tenant that outruns merging grows this until recall
+        and the exact-scan fraction degrade (ROADMAP item 2), which is
+        exactly what the slo.write.tail_fraction objective bounds."""
+        base = sum(len(lst) for lst in self.shard_docs)
+        dead = (getattr(self._searcher.sp, "dead_count", 0)
+                if self._searcher is not None else 0)
+        base_live = max(base - dead, 0)
+        tail = len(self._tail_docs)
+        total = base_live + tail
+        return {
+            "base_docs": int(base_live),
+            "tail_docs": int(tail),
+            "tail_fraction": (round(tail / total, 6) if total else 0.0),
+        }
+
+    def refresh_lag_ms(self) -> float:
+        """Milliseconds the oldest unrefreshed write has been waiting for
+        visibility; 0 when every write is searchable."""
+        if self._dirty_since is None:
+            return 0.0
+        return (time.monotonic() - self._dirty_since) * 1000.0
 
     def _can_refresh_incremental(self) -> bool:
         if self._searcher is None or self._base_stats is None:
@@ -553,6 +594,8 @@ class EsIndex:
         visibility: rebuilds from exactly the currently-visible docs (live
         base docs + tail docs), leaving pending unrefreshed writes pending.
         Used when a non-tier-aware feature needs one merged view."""
+        from ..monitoring.refresh_profile import (
+            build_stage, profile_refresh, refresh_stage)
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
         base = self._searcher
@@ -562,37 +605,43 @@ class EsIndex:
                 if base.sp.live[s, d]:
                     visible.append((doc_id, src))
         visible.extend(sorted(self._tail_docs.items()))
-        routed = self._route_docs(visible)
-        sp = build_stacked_pack_routed(routed, self.mappings)
-        if self._breaker_account is not None:
-            self._breaker_account(sp.nbytes())
-        self._invalidate_request_cache()
-        self._searcher = StackedSearcher(sp, mesh=base.mesh)
-        self.shard_docs = routed
-        self._tail = None
-        self._tail_shard_docs = []
-        self._tail_docs = {}
-        self._base_pos = {
-            doc_id: (s, d)
-            for s, lst in enumerate(routed)
-            for d, (doc_id, _src) in enumerate(lst)
-        }
-        self._base_stats = (
-            {f: dict(st) for f, st in sp.field_stats.items()},
-            dict(sp.global_df),
-        )
-        self._base_nbytes = sp.nbytes()
+        with profile_refresh(self, "merge"), \
+                build_stage("build.merge", docs=len(visible),
+                            nbytes=self._base_nbytes):
+            with refresh_stage("route"):
+                routed = self._route_docs(visible)
+            sp = build_stacked_pack_routed(routed, self.mappings)
+            if self._breaker_account is not None:
+                self._breaker_account(sp.nbytes())
+            self._invalidate_request_cache()
+            self._searcher = StackedSearcher(sp, mesh=base.mesh)
+            self.shard_docs = routed
+            self._tail = None
+            self._tail_shard_docs = []
+            self._tail_docs = {}
+            self._base_pos = {
+                doc_id: (s, d)
+                for s, lst in enumerate(routed)
+                for d, (doc_id, _src) in enumerate(lst)
+            }
+            self._base_stats = (
+                {f: dict(st) for f, st in sp.field_stats.items()},
+                dict(sp.global_df),
+            )
+            self._base_nbytes = sp.nbytes()
 
     def _refresh_full(self, mesh=None):
         """Rebuild everything from live docs (a full merge: one sealed base,
         no tail, stats reset to live-only)."""
+        from ..monitoring.refresh_profile import refresh_stage
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
         live_docs = [(i, e.source) for i, e in self.docs.items() if e.alive]
         # one routing pass: the same per-shard (id, source) lists drive both
         # pack building and hit-id resolution, and double as the point-in-time
         # _source snapshot (the analog of stored fields in a sealed segment)
-        routed = self._route_docs(live_docs)
+        with refresh_stage("route"):
+            routed = self._route_docs(live_docs)
         sp = build_stacked_pack_routed(routed, self.mappings)
         if self._breaker_account is not None:
             # admission control BEFORE shipping to the device: on trip, the
@@ -625,6 +674,7 @@ class EsIndex:
         small tail pack, and re-score both tiers under COMBINED statistics
         (deleted docs keep counting in df/avgdl until a merge — exactly
         Lucene's segment-stats behavior)."""
+        from ..monitoring.refresh_profile import refresh_stage
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
         base = self._searcher
@@ -643,7 +693,8 @@ class EsIndex:
                 self._tail_docs.pop(did, None)
         self._pending.clear()
         base.update_live()
-        routed = self._route_docs(sorted(self._tail_docs.items()))
+        with refresh_stage("route"):
+            routed = self._route_docs(sorted(self._tail_docs.items()))
         tail_sp = build_stacked_pack_routed(routed, self.mappings,
                                             dense_min_df=1 << 62)
         # combined stats = base stats AT BUILD (dead docs included, like
@@ -1772,6 +1823,7 @@ class Engine:
         self._watcher = None
         self._slo = None
         self._profiler = None
+        self._refresh_recorder = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -1968,6 +2020,60 @@ class Engine:
         if self._profiler is None:
             self._profiler = ProfilerService(self)
         return self._profiler
+
+    @property
+    def refresh_recorder(self):
+        """Write-path RefreshProfile ring (monitoring/refresh_profile.py,
+        PR 13): per-engine so in-process multi-node fixtures never mix
+        nodes' refresh histories. Sized by the dynamic
+        `indexing.profile.size` setting."""
+        from ..monitoring.refresh_profile import RefreshRecorder
+
+        if self._refresh_recorder is None:
+            size = self.settings.get("indexing.profile.size") or 256
+            self._refresh_recorder = RefreshRecorder(size)
+            self.settings.add_consumer(
+                "indexing.profile.size",
+                self._refresh_recorder.set_size)
+        return self._refresh_recorder
+
+    def indexing_stats(self) -> dict:
+        """The `_nodes/stats` `indexing` section: refresh/merge counts +
+        cumulative stage millis from the recorder, plus the CURRENT
+        node-wide tail fraction and refresh lag computed from the live
+        index state (not the last profile — a node idle since its last
+        refresh still reports its true lag). Hidden/system indices are
+        excluded from the tail/lag aggregation so the monitoring
+        pipeline's own 1s-refresh indices never mask a user-index
+        breach."""
+        base = tail = 0
+        lag = 0.0
+        per_index = {}
+        for name, idx in self.indices.items():
+            if name.startswith(".") or idx.settings.get("hidden"):
+                continue
+            try:
+                t = idx.tier_stats()
+            except Exception:  # noqa: BLE001 - stats must never fail
+                continue
+            base += t["base_docs"]
+            tail += t["tail_docs"]
+            lag = max(lag, idx.refresh_lag_ms())
+            if t["tail_docs"]:
+                per_index[name] = t
+        total = base + tail
+        out = self.refresh_recorder.indexing_stats()
+        out["tail_fraction"] = round(tail / total, 6) if total else 0.0
+        out["tail_docs"] = tail
+        out["base_docs"] = base
+        out["refresh_lag_ms"] = round(lag, 3)
+        if per_index:
+            out["tail_by_index"] = per_index
+        from ..telemetry import metrics
+
+        metrics.gauge_set("es.indexing.tail_fraction", out["tail_fraction"])
+        metrics.gauge_set("es.indexing.refresh_lag_ms", out["refresh_lag_ms"])
+        return out
 
     def serving_if_enabled(self):
         """The serving service iff coalescing is enabled — without

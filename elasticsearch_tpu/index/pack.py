@@ -596,22 +596,23 @@ class PackBuilder:
         return keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos
 
     def build(self, dense_min_df: int | None = None) -> ShardPack:
+        from ..monitoring.refresh_profile import build_stage, refresh_stage
+
         N = self.num_docs
         mappings = self.mappings
         if dense_min_df is None:
             dense_min_df = default_dense_min_df(N)
 
         # ---- flat CSR (native accumulator or dict fallback) --------------
-        if self._native is not None:
-            keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos = (
-                self._native.pack()
-            )
-            self._native.close()
-            self._native = None
-        else:
-            keys, post_offsets, flat_docs, flat_tfs, pos_offsets, flat_pos = (
-                self._flat_csr_from_dicts()
-            )
+        with refresh_stage("flat_csr"):
+            if self._native is not None:
+                keys, post_offsets, flat_docs, flat_tfs, pos_offsets, \
+                    flat_pos = self._native.pack()
+                self._native.close()
+                self._native = None
+            else:
+                keys, post_offsets, flat_docs, flat_tfs, pos_offsets, \
+                    flat_pos = self._flat_csr_from_dicts()
         # term dictionary: stable order = sorted by (field, term)
         term_dict = {k: i for i, k in enumerate(keys)}
         T = len(keys)
@@ -620,18 +621,22 @@ class PackBuilder:
         norms: dict[str, np.ndarray] = {}
         text_present: dict[str, np.ndarray] = {}
         field_stats: dict[str, dict] = {}
-        for fld, pairs in self.doc_field_lengths.items():
-            lengths = np.zeros(N, dtype=np.int64)
-            present = np.zeros(N, dtype=bool)
-            for docid, ln in pairs:
-                lengths[docid] += ln
-                present[docid] = True
-            norms[fld] = quantize_lengths(lengths)
-            text_present[fld] = present
-            # Lucene avgdl = sumTotalTermFreq / docCount where docCount counts
-            # docs with at least one term for the field (Terms.getDocCount)
-            docs_with = len({docid for docid, ln in pairs if ln > 0})
-            field_stats[fld] = {"sum_dl": float(lengths.sum()), "doc_count": docs_with}
+        with build_stage("build.norms", num_docs=N,
+                         nfields=len(self.doc_field_lengths)):
+            for fld, pairs in self.doc_field_lengths.items():
+                lengths = np.zeros(N, dtype=np.int64)
+                present = np.zeros(N, dtype=bool)
+                for docid, ln in pairs:
+                    lengths[docid] += ln
+                    present[docid] = True
+                norms[fld] = quantize_lengths(lengths)
+                text_present[fld] = present
+                # Lucene avgdl = sumTotalTermFreq / docCount where docCount
+                # counts docs with at least one term for the field
+                # (Terms.getDocCount)
+                docs_with = len({docid for docid, ln in pairs if ln > 0})
+                field_stats[fld] = {"sum_dl": float(lengths.sum()),
+                                    "doc_count": docs_with}
         # norm-less indexed fields (keyword) still need per-field docCount
         # for idf (Lucene CollectionStatistics.docCount)
         for fld, (_, cnt) in self.field_doc_counts.items():
@@ -642,54 +647,58 @@ class PackBuilder:
         # handled at query time by norm fallback.
 
         # ---- blocked postings (vectorized scatter from flat CSR) ---------
-        df = post_offsets[1:] - post_offsets[:-1]
-        term_df = df.astype(np.int32)
-        nblk = (df + BLOCK - 1) // BLOCK
-        row_base = np.empty(T + 1, dtype=np.int64)
-        row_base[0] = 1  # row 0 reserved all-padding
-        row_base[1:] = 1 + np.cumsum(nblk)
-        total_blocks = int(row_base[-1]) if T else 1
-        term_block_start = row_base.astype(np.int32)
-
-        post_docids = np.full((total_blocks, BLOCK), N, dtype=np.int32)
-        post_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
-        post_dls = np.ones((total_blocks, BLOCK), dtype=np.float32)
-        block_max_tf = np.zeros(total_blocks, dtype=np.float32)
-        block_min_len = np.full(total_blocks, np.inf, dtype=np.float32)
-
         NP = len(flat_docs) if T else 0
-        field_names = sorted({k[0] for k in keys})
-        fld_code = {f: i for i, f in enumerate(field_names)}
-        field_of_term = np.fromiter(
-            (fld_code[k[0]] for k in keys), np.int64, count=T
-        )
-        if NP:
-            term_of_post = np.repeat(np.arange(T), df)
-            local = np.arange(NP, dtype=np.int64) - np.repeat(
-                post_offsets[:-1], df
+        with build_stage("build.csr_assemble", postings=NP, num_docs=N,
+                         terms=T):
+            df = post_offsets[1:] - post_offsets[:-1]
+            term_df = df.astype(np.int32)
+            nblk = (df + BLOCK - 1) // BLOCK
+            row_base = np.empty(T + 1, dtype=np.int64)
+            row_base[0] = 1  # row 0 reserved all-padding
+            row_base[1:] = 1 + np.cumsum(nblk)
+            total_blocks = int(row_base[-1]) if T else 1
+            term_block_start = row_base.astype(np.int32)
+
+            post_docids = np.full((total_blocks, BLOCK), N, dtype=np.int32)
+            post_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
+            post_dls = np.ones((total_blocks, BLOCK), dtype=np.float32)
+            block_max_tf = np.zeros(total_blocks, dtype=np.float32)
+            block_min_len = np.full(total_blocks, np.inf, dtype=np.float32)
+
+            field_names = sorted({k[0] for k in keys})
+            fld_code = {f: i for i, f in enumerate(field_names)}
+            field_of_term = np.fromiter(
+                (fld_code[k[0]] for k in keys), np.int64, count=T
             )
-            dest_row = row_base[:-1][term_of_post] + local // BLOCK
-            dest_col = local % BLOCK
-            post_docids[dest_row, dest_col] = flat_docs
-            post_tfs[dest_row, dest_col] = flat_tfs
-            # per-posting doc length (1.0 for norm-less fields)
-            post_dl_flat = np.ones(NP, dtype=np.float32)
-            fop = field_of_term[term_of_post]
-            for f, nrm in norms.items():
-                code = fld_code.get(f)
-                if code is None:
-                    continue
-                sel = fop == code
-                if sel.any():
-                    post_dl_flat[sel] = nrm[flat_docs[sel]]
-            post_dls[dest_row, dest_col] = post_dl_flat
-            # per-block stats: flat order is block-contiguous, so reduceat
-            # over block-start boundaries gives segment max/min
-            starts = np.flatnonzero(np.diff(dest_row, prepend=-1))
-            block_rows = dest_row[starts]
-            block_max_tf[block_rows] = np.maximum.reduceat(flat_tfs, starts)
-            block_min_len[block_rows] = np.minimum.reduceat(post_dl_flat, starts)
-        block_min_len[~np.isfinite(block_min_len)] = 1.0
+            if NP:
+                term_of_post = np.repeat(np.arange(T), df)
+                local = np.arange(NP, dtype=np.int64) - np.repeat(
+                    post_offsets[:-1], df
+                )
+                dest_row = row_base[:-1][term_of_post] + local // BLOCK
+                dest_col = local % BLOCK
+                post_docids[dest_row, dest_col] = flat_docs
+                post_tfs[dest_row, dest_col] = flat_tfs
+                # per-posting doc length (1.0 for norm-less fields)
+                post_dl_flat = np.ones(NP, dtype=np.float32)
+                fop = field_of_term[term_of_post]
+                for f, nrm in norms.items():
+                    code = fld_code.get(f)
+                    if code is None:
+                        continue
+                    sel = fop == code
+                    if sel.any():
+                        post_dl_flat[sel] = nrm[flat_docs[sel]]
+                post_dls[dest_row, dest_col] = post_dl_flat
+                # per-block stats: flat order is block-contiguous, so
+                # reduceat over block-start boundaries gives segment max/min
+                starts = np.flatnonzero(np.diff(dest_row, prepend=-1))
+                block_rows = dest_row[starts]
+                block_max_tf[block_rows] = np.maximum.reduceat(
+                    flat_tfs, starts)
+                block_min_len[block_rows] = np.minimum.reduceat(
+                    post_dl_flat, starts)
+            block_min_len[~np.isfinite(block_min_len)] = 1.0
 
         # ---- docvalues ---------------------------------------------------
         docvalues: dict[str, DocValuesColumn] = {}
@@ -754,21 +763,22 @@ class PackBuilder:
 
         # ---- vectors -----------------------------------------------------
         vectors: dict[str, VectorColumn] = {}
-        for fld, pairs in self.vector_raw.items():
-            ft = mappings.fields[fld]
-            vals = np.zeros((N, ft.dims), dtype=np.float32)
-            has = np.zeros(N, dtype=bool)
-            for docid, vec in pairs:
-                vals[docid] = vec
-                has[docid] = True
-            vc = VectorColumn(vals, has, ft.similarity, ft.dims,
-                              ann_quant=getattr(ft, "ann_quant", "int8"))
-            if ft.ann_nlist is not None:
-                from ..ann import build_ann
+        with refresh_stage("vectors"):
+            for fld, pairs in self.vector_raw.items():
+                ft = mappings.fields[fld]
+                vals = np.zeros((N, ft.dims), dtype=np.float32)
+                has = np.zeros(N, dtype=bool)
+                for docid, vec in pairs:
+                    vals[docid] = vec
+                    has[docid] = True
+                vc = VectorColumn(vals, has, ft.similarity, ft.dims,
+                                  ann_quant=getattr(ft, "ann_quant", "int8"))
+                if ft.ann_nlist is not None:
+                    from ..ann import build_ann
 
-                nlist = ft.ann_nlist or max(1, int(has.sum() ** 0.5))
-                vc.ann = build_ann(vals, has, nlist)
-            vectors[fld] = vc
+                    nlist = ft.ann_nlist or max(1, int(has.sum() ** 0.5))
+                    vc.ann = build_ann(vals, has, nlist)
+                vectors[fld] = vc
 
         # ---- position blocks (vectorized scatter from flat CSR) ----------
         pos_keys = None
@@ -776,22 +786,25 @@ class PackBuilder:
         term_pos_count = None
         n_positions = int(pos_offsets[-1]) if T else 0
         if n_positions:
-            pos_df = pos_offsets[1:] - pos_offsets[:-1]
-            pnblk = (pos_df + BLOCK - 1) // BLOCK
-            prow_base = np.empty(T + 1, dtype=np.int64)
-            prow_base[0] = 1
-            prow_base[1:] = 1 + np.cumsum(pnblk)
-            total_pos_blocks = int(prow_base[-1])
-            pos_keys = np.full((total_pos_blocks, BLOCK), POS_INF, dtype=np.int64)
-            term_pos_start = prow_base.astype(np.int32)
-            term_pos_count = pos_df.astype(np.int32)
-            pterm = np.repeat(np.arange(T), pos_df)
-            plocal = np.arange(n_positions, dtype=np.int64) - np.repeat(
-                pos_offsets[:-1], pos_df
-            )
-            pos_keys[
-                prow_base[:-1][pterm] + plocal // BLOCK, plocal % BLOCK
-            ] = flat_pos
+            with build_stage("build.csr_assemble", postings=n_positions,
+                             num_docs=N, terms=T):
+                pos_df = pos_offsets[1:] - pos_offsets[:-1]
+                pnblk = (pos_df + BLOCK - 1) // BLOCK
+                prow_base = np.empty(T + 1, dtype=np.int64)
+                prow_base[0] = 1
+                prow_base[1:] = 1 + np.cumsum(pnblk)
+                total_pos_blocks = int(prow_base[-1])
+                pos_keys = np.full((total_pos_blocks, BLOCK), POS_INF,
+                                   dtype=np.int64)
+                term_pos_start = prow_base.astype(np.int32)
+                term_pos_count = pos_df.astype(np.int32)
+                pterm = np.repeat(np.arange(T), pos_df)
+                plocal = np.arange(n_positions, dtype=np.int64) - np.repeat(
+                    pos_offsets[:-1], pos_df
+                )
+                pos_keys[
+                    prow_base[:-1][pterm] + plocal // BLOCK, plocal % BLOCK
+                ] = flat_pos
 
         # per-field scoring constants, indexed by field code (dense tier +
         # impact tier share them)
@@ -809,13 +822,17 @@ class PackBuilder:
         if T:
             dtype = impact_dtype_default()
             qmax = IMPACT_QMAX[dtype]
-            impact_ubf = impact_term_ubf(term_block_start, block_max_tf)
-            row_terms = impact_row_terms(term_block_start, total_blocks)
-            k_base, k_slope, scale_inv = impact_row_params(
-                row_terms, impact_ubf, field_of_term,
-                avgdl_of_field, has_norms_of_field, qmax)
-            impact_codes = impact_codes_host(
-                post_tfs, post_dls, k_base, k_slope, scale_inv, qmax, dtype)
+            with build_stage("build.impact_quantize", rows=total_blocks,
+                             code_bytes=2 if dtype == "uint16" else 1,
+                             basis="host"):
+                impact_ubf = impact_term_ubf(term_block_start, block_max_tf)
+                row_terms = impact_row_terms(term_block_start, total_blocks)
+                k_base, k_slope, scale_inv = impact_row_params(
+                    row_terms, impact_ubf, field_of_term,
+                    avgdl_of_field, has_norms_of_field, qmax)
+                impact_codes = impact_codes_host(
+                    post_tfs, post_dls, k_base, k_slope, scale_inv, qmax,
+                    dtype)
             impact_meta = {"dtype": dtype, "qmax": qmax,
                            "k1": BM25_K1, "b": BM25_B}
 
@@ -830,23 +847,27 @@ class PackBuilder:
             # of an index share one compiled batched-query executable
             # (ops/batched.py W is [Q, V]); padding rows stay all-zero so
             # they never score or match
-            v_pad = -len(dense_keys) % 128
-            dense_tfn = np.zeros((len(dense_keys) + v_pad, N), dtype=np.float32)
-            dense_rank = np.full(T, -1, dtype=np.int64)
-            dense_rank[dense_ids] = np.arange(len(dense_ids))
-            dmask = dense_rank[term_of_post] >= 0
-            rows = dense_rank[term_of_post[dmask]]
-            cols = flat_docs[dmask]
-            tfs_d = flat_tfs[dmask]
-            dls_d = post_dl_flat[dmask]
-            fcode = field_of_term[term_of_post[dmask]]
-            K = np.where(
-                has_norms_of_field[fcode],
-                BM25_K1
-                * (1.0 - BM25_B + BM25_B * dls_d / avgdl_of_field[fcode]),
-                BM25_K1,
-            )
-            dense_tfn[rows, cols] = (tfs_d / (tfs_d + K)).astype(np.float32)
+            with refresh_stage("dense_tier"):
+                v_pad = -len(dense_keys) % 128
+                dense_tfn = np.zeros((len(dense_keys) + v_pad, N),
+                                     dtype=np.float32)
+                dense_rank = np.full(T, -1, dtype=np.int64)
+                dense_rank[dense_ids] = np.arange(len(dense_ids))
+                dmask = dense_rank[term_of_post] >= 0
+                rows = dense_rank[term_of_post[dmask]]
+                cols = flat_docs[dmask]
+                tfs_d = flat_tfs[dmask]
+                dls_d = post_dl_flat[dmask]
+                fcode = field_of_term[term_of_post[dmask]]
+                K = np.where(
+                    has_norms_of_field[fcode],
+                    BM25_K1
+                    * (1.0 - BM25_B + BM25_B * dls_d
+                       / avgdl_of_field[fcode]),
+                    BM25_K1,
+                )
+                dense_tfn[rows, cols] = (
+                    tfs_d / (tfs_d + K)).astype(np.float32)
 
         completion = {
             fld: sorted(entries) for fld, entries in self.completion_raw.items()

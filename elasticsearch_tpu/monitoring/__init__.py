@@ -30,6 +30,13 @@ from .device import (
     padded_waste_bytes,
 )
 from .profiler import ProfilerService
+from .refresh_profile import (
+    RefreshRecorder,
+    build_stage,
+    collect_build_stages,
+    default_recorder,
+    refresh_stage,
+)
 from .service import (
     MONITORING_PREFIX,
     SELF_WATCH_JOB_ID,
@@ -58,4 +65,6 @@ __all__ = [
     "monitoring_index_name", "setup_self_watch_job",
     "ProfilerService", "XLA_CHECKS", "check_dispatch", "drift_table",
     "format_drift_table", "xla_check_status",
+    "RefreshRecorder", "build_stage", "collect_build_stages",
+    "default_recorder", "refresh_stage",
 ]

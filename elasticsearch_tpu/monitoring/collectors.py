@@ -101,6 +101,29 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "flops_ratio": row["flops_ratio"],
                 "bytes_ratio": row.get("bytes_ratio", 0.0),
             }
+    # write-path ground truth (PR 13): refresh/merge counts, cumulative
+    # build-stage millis (bounded: one numeric leaf per stage name),
+    # tail-tier fraction + refresh lag + docs/s EMA — queryable history
+    # for usage_report's write-path table and the tail_fraction trend
+    indexing_doc = {}
+    try:
+        ist = engine.indexing_stats()
+        indexing_doc = {
+            "refresh_total": ist.get("refresh_total", 0),
+            "merge_total": ist.get("merge_total", 0),
+            "refresh_full": ist.get("refresh_kinds", {}).get("full", 0),
+            "refresh_incremental": ist.get("refresh_kinds", {}).get(
+                "incremental", 0),
+            "docs_refreshed_total": ist.get("docs_refreshed_total", 0),
+            "docs_per_s_ema": ist.get("docs_per_s_ema") or 0.0,
+            "tail_fraction": ist.get("tail_fraction", 0.0),
+            "tail_docs": ist.get("tail_docs", 0),
+            "refresh_lag_ms": ist.get("refresh_lag_ms", 0.0),
+            "stage_ms": {k.replace(".", "_"): v
+                         for k, v in (ist.get("stage_ms") or {}).items()},
+        }
+    except Exception:  # noqa: BLE001 - collection must never stop
+        pass
     snap = metrics.snapshot()
     rest_h = snap["histograms"].get("es.rest.request.ms") or {}
     shard_h = snap["histograms"].get("es.shard.search.ms") or {}
@@ -190,6 +213,7 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
             },
             "health": health_doc,
             "slo": slo_doc,
+            "indexing": indexing_doc,
             "serving": {
                 "queue_depth": sv_st.get("queue", {}).get("depth", 0),
                 "admitted": sv_st.get("admitted", 0),

@@ -390,6 +390,87 @@ def _ann_rescore(fields: dict) -> dict | None:
     return ann_rescore_cost(b, kb, d)
 
 
+# ---------------------------------------------------------------------------
+# write-path build stages (PR 13): the refresh/build pipeline gets the
+# same flops/bytes accounting the query kernels carry, so the ROADMAP
+# item-2 device port has a host baseline with per-stage attribution on
+# day one. On the host these run as numpy loops — the MFU/bandwidth
+# fractions are honest "how far from the roofline is this stage" numbers
+# the port must close, not utilization claims.
+# ---------------------------------------------------------------------------
+
+def kmeans_build_cost(n: int, d: int, c: int, *, iters: int = 8) -> dict:
+    """Lloyd k-means (ops/vector.kmeans_ivf): per iteration one [N,D]@[D,C]
+    f32 distance matmul, a 2-ops/element argmax over [N,C], and the
+    centroid scatter update reading the [N,D] corpus once more."""
+    mm = matmul_cost(n, d, c, passes=iters, a_bytes=4, b_bytes=4,
+                     out_bytes=0)
+    return {
+        "flops": mm["flops"] + 2.0 * n * c * iters + 2.0 * n * d * iters,
+        "bytes": mm["bytes"] + float(iters * (n * 4 + c * d * 4)),
+    }
+
+
+def csr_assemble_build_cost(postings: int, *, n_docs: int = 0) -> dict:
+    """Blocked-postings scatter (index/pack.py build): every posting is
+    read from the flat CSR ((docid i32, tf f32) = 8 B) and written into
+    its blocked lane ((docid, tf, dl) = 12 B); 2 ops/posting of index
+    arithmetic; plus the per-doc norm gather."""
+    return {
+        "flops": 2.0 * postings,
+        "bytes": float(postings * (8 + 12) + n_docs * 4),
+    }
+
+
+def norms_build_cost(n_docs: int, nfields: int) -> dict:
+    """Smallfloat norm quantization (index/smallfloat.quantize_lengths):
+    one i64 length read + one u8 norm write per (doc, field) lane, 2
+    ops/lane for the quantize bucket search."""
+    lanes = n_docs * max(nfields, 1)
+    return {"flops": 2.0 * lanes, "bytes": float(lanes * (8 + 1))}
+
+
+def impact_quantize_build_cost(rows: int, *, block: int = 128,
+                               code_bytes: int = 2) -> dict:
+    """Impact-code derivation over the blocked postings ([rows, BLOCK]
+    lanes): tfn = tf/(tf + k_base + k_slope·dl) then scale+round+clip —
+    ~6 FLOPs/lane; reads (tf f32, dl f32), writes one code. Identical
+    model for the host derivation (pack.py, basis="host") and the
+    on-device elementwise pass (sharded.refresh_impacts,
+    basis="device") — the split between the two IS the attribution."""
+    lanes = rows * block
+    return {"flops": 6.0 * lanes, "bytes": float(lanes * (8 + code_bytes))}
+
+
+def ann_tiles_build_cost(c: int, l: int, d: int) -> dict:
+    """ANN tile packing (ann/index.build_ann): every [C, L] slot gathers
+    its f32 vector row, scalar-quantizes it to int8 (~4 ops/element:
+    min/max scan + affine + round) and writes codes + scale/offset/order
+    metadata."""
+    slots = float(c * l)
+    return {
+        "flops": 4.0 * slots * d,
+        "bytes": slots * (d * 4 + d * 1 + 12),
+    }
+
+
+def device_put_build_cost(nbytes: float) -> dict:
+    """Pack upload (sharded.stacked_to_device / update_live): a pure
+    host→device transfer — zero FLOPs, judged on bandwidth only (the
+    denominator is the HBM peak; PCIe/DMA peaks are below it, so the
+    fraction is conservative)."""
+    return {"flops": 0.0, "bytes": float(nbytes)}
+
+
+def merge_build_cost(docs: int, *, nbytes: float = 0.0) -> dict:
+    """Tier merge (engine._merge_tiers): a wrapper over a full rebuild —
+    the inner stages carry the precise accounting; this entry keeps the
+    merge-level roofline honest as one read of the old resident pack plus
+    one write of its replacement, with 2 ops/doc of visibility
+    bookkeeping."""
+    return {"flops": 2.0 * docs, "bytes": float(2.0 * nbytes)}
+
+
 def allgather_merge_cost(s: int, q: int, k: int, *,
                          id_bytes: int = 8) -> dict:
     """The on-device coordinator merge (PR 10): every shard's [q, k]
@@ -472,6 +553,59 @@ def _serving_wave(fields: dict) -> dict | None:
     return out
 
 
+def _build_kmeans(fields: dict) -> dict | None:
+    n, d, c = fields.get("n"), fields.get("dims"), fields.get("nlist")
+    if not (n and d and c):
+        return None
+    return kmeans_build_cost(int(n), int(d), int(c),
+                             iters=int(fields.get("iters", 8)))
+
+
+def _build_csr_assemble(fields: dict) -> dict | None:
+    p = fields.get("postings")
+    if p is None:
+        return None
+    return csr_assemble_build_cost(int(p),
+                                   n_docs=int(fields.get("num_docs", 0)))
+
+
+def _build_norms(fields: dict) -> dict | None:
+    n = fields.get("num_docs")
+    if n is None:
+        return None
+    return norms_build_cost(int(n), int(fields.get("nfields", 1)))
+
+
+def _build_impact_quantize(fields: dict) -> dict | None:
+    rows = fields.get("rows")
+    if rows is None:
+        return None
+    return impact_quantize_build_cost(
+        int(rows), code_bytes=int(fields.get("code_bytes", 2)))
+
+
+def _build_ann_tiles(fields: dict) -> dict | None:
+    c, l, d = fields.get("nlist"), fields.get("tile"), fields.get("dims")
+    if not (c and l and d):
+        return None
+    return ann_tiles_build_cost(int(c), int(l), int(d))
+
+
+def _build_device_put(fields: dict) -> dict | None:
+    nbytes = fields.get("nbytes")
+    if nbytes is None:
+        return None
+    return device_put_build_cost(float(nbytes))
+
+
+def _build_merge(fields: dict) -> dict | None:
+    docs = fields.get("docs")
+    if docs is None:
+        return None
+    return merge_build_cost(int(docs),
+                            nbytes=float(fields.get("nbytes", 0.0)))
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -507,6 +641,17 @@ KERNEL_COSTS: dict[str, object] = {
     "ann.gather_scan": _ann_gather_scan,
     "ann.rescore": _ann_rescore,
     "ann.tail_scan": _knn_scan,      # exact f32 scan of the tail tier
+    # write-path build stages (PR 13): refresh/build gets the same
+    # accounting — dispatched via monitoring/refresh_profile.build_stage
+    # (the lint scans those literals too), host today, the item-2 port's
+    # baseline tomorrow
+    "build.kmeans": _build_kmeans,
+    "build.impact_quantize": _build_impact_quantize,
+    "build.csr_assemble": _build_csr_assemble,
+    "build.norms": _build_norms,
+    "build.ann_tiles": _build_ann_tiles,
+    "build.device_put": _build_device_put,
+    "build.merge": _build_merge,
 }
 
 

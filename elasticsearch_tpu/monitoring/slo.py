@@ -91,6 +91,7 @@ class SloEngine:
             objectives.extend(self._serving_objectives())
             objectives.extend(self._breaker_objectives())
             objectives.extend(self._hbm_objectives())
+            objectives.extend(self._write_objectives())
             objectives.extend(self._custom_objectives(snap))
         breached = [o["id"] for o in objectives if o["status"] == "breached"]
         out = {
@@ -236,6 +237,41 @@ class SloEngine:
             f"HBM in use <= {frac:.0%} of the allocator limit",
             measured, frac,
             None if measured is None else measured > frac, "max")]
+
+    def _write_objectives(self) -> list[dict]:
+        """Write-path floors (PR 13): the exact-scan tail-tier fraction
+        and the refresh lag of unrefreshed writes, measured from the
+        live index state via Engine.indexing_stats(). A write-heavy
+        tenant that outruns merging degrades BOTH the recall contract
+        (tail grows) and freshness (lag grows) — these objectives make
+        the degradation fire the slo-compliance watch with the breaching
+        number on record instead of waiting for a recall regression."""
+        tail_max = float(self._get("slo.write.tail_fraction", 0) or 0)
+        lag_max = float(self._get("slo.write.refresh_lag_ms", 0) or 0)
+        if tail_max <= 0 and lag_max <= 0:
+            return []
+        try:
+            idx_stats = self.engine.indexing_stats()
+        except Exception:  # noqa: BLE001 - stats failure: no_data, not 500
+            idx_stats = {}
+        out = []
+        if tail_max > 0:
+            measured = idx_stats.get("tail_fraction")
+            out.append(_objective(
+                "write-tail-fraction", "write",
+                f"exact-scan tail-tier doc fraction <= {tail_max:g} "
+                "(precomputed base tiers keep serving the corpus)",
+                measured, tail_max,
+                None if measured is None else measured > tail_max, "max"))
+        if lag_max > 0:
+            measured = idx_stats.get("refresh_lag_ms")
+            out.append(_objective(
+                "write-refresh-lag", "write",
+                f"oldest unrefreshed write waits <= {lag_max:g}ms for "
+                "visibility",
+                measured, lag_max,
+                None if measured is None else measured > lag_max, "max"))
+        return out
 
     def _custom_objectives(self, snap) -> list[dict]:
         raw = str(self._get("slo.custom", "") or "").strip()

@@ -106,18 +106,29 @@ def stacked_to_device(sp: StackedPack, mesh: Mesh | None) -> dict:
     partition-rule table (spmd.match_partition_rules over leaf names) —
     the GSPMD discipline SNIPPETS [1][2] apply to params pytrees. A pack
     component whose name matches no rule is a hard error at upload, not
-    a silently replicated array. mesh=None keeps plain `jnp.asarray`."""
+    a silently replicated array. mesh=None keeps plain `jnp.asarray`.
+
+    PR 13: the upload is a profiled build stage (`build.device_put`, the
+    host→device transfer the item-2 device builders will mostly delete)
+    and counts a kind="refresh" host transition, so background merges
+    get the same transition budget the serving waves hold (≤1+1/wave)."""
+    from ..monitoring.refresh_profile import build_stage
+    from ..telemetry import host_transition
     from ..utils.jax_env import ensure_x64
 
     ensure_x64()
-    host = _stacked_host_tree(sp)
-    if mesh is None:
-        import jax.tree_util as jtu
+    host_transition("refresh")
+    # the host-tree assembly (numpy staging copies) is upload prep —
+    # charged to the device_put stage, not the profile residual
+    with build_stage("build.device_put", nbytes=sp.nbytes()):
+        host = _stacked_host_tree(sp)
+        if mesh is None:
+            import jax.tree_util as jtu
 
-        return jtu.tree_map(jnp.asarray, host)
-    from .spmd import shard_put
+            return jtu.tree_map(jnp.asarray, host)
+        from .spmd import shard_put
 
-    return shard_put(host, mesh)
+        return shard_put(host, mesh)
 
 
 def _stacked_host_tree(sp: StackedPack) -> dict:
@@ -344,6 +355,8 @@ class StackedSearcher:
             # a custom-similarity context cannot serve quantized defaults
             self.dev.pop("impact_codes", None)
             return
+        from ..monitoring.refresh_profile import build_stage
+
         fields = sp.impact_fields
         fld_avgdl = np.array(
             [max(self._avgdl(f), 1e-9) for f in fields] or [1.0], np.float64)
@@ -355,11 +368,18 @@ class StackedSearcher:
         k_base = np.where(hn, k1 * (1.0 - b), k1).astype(np.float32)
         k_slope = np.where(hn, k1 * b / fld_avgdl[safe], 0.0).astype(
             np.float32)
-        self.dev["impact_codes"] = _impact_codes_device(
-            self.dev["post_tfs"], self.dev["post_dls"],
-            jnp.asarray(k_base), jnp.asarray(k_slope),
-            jnp.asarray(sp.impact_row_scale_inv),
-            qmax=meta["qmax"], dtype=meta["dtype"])
+        # the device twin of the pack.py host derivation — same kernel
+        # name, basis="device", so the write-path profile shows the
+        # host-vs-device split of impact quantization directly
+        with build_stage("build.impact_quantize",
+                         rows=int(self.sp.S) * int(self.sp.nb_max),
+                         code_bytes=2 if meta["dtype"] == "uint16" else 1,
+                         basis="device"):
+            self.dev["impact_codes"] = _impact_codes_device(
+                self.dev["post_tfs"], self.dev["post_dls"],
+                jnp.asarray(k_base), jnp.asarray(k_slope),
+                jnp.asarray(sp.impact_row_scale_inv),
+                qmax=meta["qmax"], dtype=meta["dtype"])
         sp._impact_basis = sp.stats_override
 
     def update_live(self):
@@ -368,11 +388,16 @@ class StackedSearcher:
         flip changes every shard's visible result set, so the request
         cache epoch bumps here — stale entries become unreachable AND are
         dropped."""
-        if self.mesh is not None:
-            self.dev["live"] = jax.device_put(
-                self.sp.live, NamedSharding(self.mesh, P("shards")))
-        else:
-            self.dev["live"] = jnp.asarray(self.sp.live)
+        from ..monitoring.refresh_profile import build_stage
+        from ..telemetry import host_transition
+
+        host_transition("refresh")
+        with build_stage("build.device_put", nbytes=self.sp.live.nbytes):
+            if self.mesh is not None:
+                self.dev["live"] = jax.device_put(
+                    self.sp.live, NamedSharding(self.mesh, P("shards")))
+            else:
+                self.dev["live"] = jnp.asarray(self.sp.live)
         self.bump_epoch()
 
     def _compiled(self, node, key, k, agg_nodes, agg_key):

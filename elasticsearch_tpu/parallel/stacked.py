@@ -533,10 +533,17 @@ def build_stacked_pack_routed(
     routed: list[list[tuple[str, dict]]], mappings: Mappings,
     dense_min_df: int | None = None,
 ) -> StackedPack:
+    from ..monitoring.refresh_profile import refresh_stage
+
     builders = [PackBuilder(mappings) for _ in range(len(routed))]
-    for b, shard_docs in zip(builders, routed):
-        for doc_id, source in shard_docs:
-            b.add_document(mappings.parse_document(source), doc_id=doc_id)
+    # analysis/tokenization is a collector-only stage: it is host text
+    # processing, not a candidate device kernel, but it must stay visible
+    # in the RefreshProfile instead of hiding in the host_other residual
+    with refresh_stage("analyze"):
+        for b, shard_docs in zip(builders, routed):
+            for doc_id, source in shard_docs:
+                b.add_document(mappings.parse_document(source),
+                               doc_id=doc_id)
     # per-shard dense tiers disabled: StackedPack builds its own global one
     # (global df decisions + global avgdl), so a local tier would only burn
     # build time and host RAM
@@ -545,7 +552,8 @@ def build_stacked_pack_routed(
         # source references (shared with EsIndex.shard_docs) for host-side
         # per-object matching (nested queries, query/nested.py)
         p.doc_sources = [src for _, src in shard_docs]
-    return StackedPack(packs, mappings, dense_min_df=dense_min_df)
+    with refresh_stage("stack"):
+        return StackedPack(packs, mappings, dense_min_df=dense_min_df)
 
 
 def build_stacked_pack(

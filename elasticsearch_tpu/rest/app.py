@@ -2540,6 +2540,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # continuous-batching front end: queue depth,
                         # wave occupancy, shed/expiry/cancel accounting
                         "serving": engine.serving.stats(),
+                        # write-path ground truth (PR 13): refresh/merge
+                        # counts, cumulative build-stage millis, current
+                        # tail-tier fraction, refresh lag, docs/s EMA
+                        "indexing": engine.indexing_stats(),
                         "metrics": metrics.snapshot(),
                         # tail-latency inspection without log scraping:
                         # the most recent slowlog entries (now carrying
@@ -2559,6 +2563,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         admission/shed/expiry/cancel counters, wave sizing + term-lane
         occupancy, backpressure configuration."""
         return web.json_response({"serving": engine.serving.stats()})
+
+    @handler
+    async def refresh_profile(request):
+        """GET /_refresh/profile: the bounded per-refresh RefreshProfile
+        ring — contiguous build-stage timings summing to each refresh's
+        wall time, docs/bytes processed, refresh kind, and the resulting
+        tail-tier state (PR 13, the write-path twin of the serving
+        flight recorder)."""
+        n = request.query.get("n")
+        return web.json_response(
+            engine.refresh_recorder.profiles(int(n) if n else None))
 
     @handler
     async def serving_flight_recorder(request):
@@ -2652,6 +2667,18 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 extra[f"es.device.hbm.{key}"] = mem[key]
         extra["es.device.pack_padded_waste_bytes"] = \
             _mon_device.padded_waste_bytes(engine)
+        # write-path gauges (PR 13): tail-tier fraction + refresh lag +
+        # ingest rate, scraped alongside the kernel utilization they gate
+        try:
+            idx_stats = engine.indexing_stats()
+            extra["es.indexing.tail_fraction"] = idx_stats["tail_fraction"]
+            extra["es.indexing.refresh_lag_ms"] = \
+                idx_stats["refresh_lag_ms"]
+            if idx_stats.get("docs_per_s_ema") is not None:
+                extra["es.indexing.docs_per_s_ema"] = \
+                    idx_stats["docs_per_s_ema"]
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
         # closed-loop health/SLO gauges (PR 9): the scrape itself carries
         # the indicator-based health status and SLO compliance, so a
         # dashboard alert needs no extra endpoint
@@ -2674,11 +2701,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                 "kind": "counter",
                 "help": "serving/sharded wave host<->device transitions "
                         "by kind (dispatch = program launches handed to "
-                        "the device, fetch = blocking result pulls)",
+                        "the device, fetch = blocking result pulls, "
+                        "refresh = refresh-time pack/bitmap uploads — "
+                        "the transition budget item 2's background "
+                        "DEVICE merges must hold)",
                 "samples": [
                     ({"kind": k},
                      snap_c.get(f"es.device.host_transitions.{k}", 0))
-                    for k in ("dispatch", "fetch")],
+                    for k in ("dispatch", "fetch", "refresh")],
             }
             from ..monitoring.xla_introspect import drift_table
 
@@ -2799,6 +2829,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
     app.router.add_get("/_serving/stats", serving_stats)
+    app.router.add_get("/_refresh/profile", refresh_profile)
     app.router.add_get("/_serving/flight_recorder", serving_flight_recorder)
     app.router.add_post("/_serving/flight_recorder/_dump",
                         serving_flight_recorder_dump)

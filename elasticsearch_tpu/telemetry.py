@@ -348,14 +348,17 @@ def profile_event(kind: str, **fields) -> None:
 
 
 def host_transition(kind: str) -> None:
-    """Count one host↔device transition on the serving/sharded wave path
-    (kind: "dispatch" = a program-launch phase handed to the device,
-    "fetch" = a blocking device→host result pull). PR 11: the serving
-    wave executor proves its end-to-end fusion with these — one dispatch
-    phase and ONE combined fetch per wave (extra rounds from rare
-    escalations/two-pass aggs are counted, never hidden). Feeds the
-    cumulative es.device.host_transitions.* counters and, when a
-    collector is active, a per-request "transition" profile event."""
+    """Count one host↔device transition (kind: "dispatch" = a
+    program-launch phase handed to the device, "fetch" = a blocking
+    device→host result pull, "refresh" = a refresh-time pack/bitmap
+    upload). PR 11: the serving wave executor proves its end-to-end
+    fusion with these — one dispatch phase and ONE combined fetch per
+    wave (extra rounds from rare escalations/two-pass aggs are counted,
+    never hidden). PR 13 adds the refresh kind so ROADMAP item 2's
+    background DEVICE merges have a transition budget to hold, not just
+    the serving waves. Feeds the cumulative
+    es.device.host_transitions.* counters and, when a collector is
+    active, a per-request "transition" profile event."""
     metrics.counter_inc(f"es.device.host_transitions.{kind}")
     profile_event("transition", transition=kind)
 
@@ -660,6 +663,12 @@ class MetricsRegistry:
         "es.slo.objectives": "number of evaluated SLO objectives",
         "es.watcher.executions": "watch executions (scheduled + manual)",
         "es.serving.queue_depth": "serving admission queue depth",
+        "es.indexing.tail_fraction":
+            "fraction of visible docs served by the exact-scan tail tier",
+        "es.indexing.refresh_lag_ms":
+            "ms the oldest unrefreshed write has waited for visibility",
+        "es.indexing.docs_per_s_ema":
+            "refresh-over-refresh ingest rate (EMA)",
     }
 
     def prometheus_text(self, extra_gauges: dict | None = None,

@@ -238,6 +238,61 @@ def _serving_indicator(engine) -> dict:
             "details": details}
 
 
+def _indexing_indicator(engine) -> dict:
+    """Write-path health (PR 13): the slo.write.* objectives (tail-tier
+    fraction, refresh lag) plus the refresh recorder's stage breakdown.
+    A breach names BOTH the objective and the dominant build stage —
+    the operator learns which stage to profile (and the item-2 port
+    which stage to move on-device) from the alert itself."""
+    ev = engine.slo.current()
+    write = [o for o in ev["objectives"] if o["kind"] == "write"]
+    breached = [o for o in write if o["status"] == "breached"]
+    stats = engine.indexing_stats()
+    details = {"tail_fraction": stats.get("tail_fraction", 0.0),
+               "refresh_lag_ms": stats.get("refresh_lag_ms", 0.0),
+               "refresh_total": stats.get("refresh_total", 0),
+               "merge_total": stats.get("merge_total", 0),
+               "docs_per_s_ema": stats.get("docs_per_s_ema")}
+    if breached:
+        stage_ms = stats.get("stage_ms") or {}
+        top_stage = max(stage_ms, key=stage_ms.get, default=None)
+        stage_note = (
+            f"; dominant build stage [{top_stage}] at "
+            f"{stage_ms[top_stage]:.1f}ms cumulative "
+            "(GET /_refresh/profile for per-refresh breakdowns)"
+            if top_stage else "")
+        return {
+            "status": YELLOW,
+            "symptom": (f"{len(breached)} write-path SLO objectives are "
+                        "breached"),
+            "details": {**details,
+                        "breached": [o["id"] for o in breached],
+                        "dominant_stage": top_stage},
+            "impacts": [_impact(
+                "refresh is falling behind ingest: the exact-scan tail "
+                "tier grows (query cost rises, ANN/impact coverage "
+                "shrinks) and writes wait longer for visibility",
+                severity=2, areas=["ingest", "search"])],
+            "diagnosis": [_diagnosis(
+                "; ".join(
+                    f"objective [{o['id']}] breached: {o['description']} "
+                    f"(measured {o['measured']}, threshold "
+                    f"{o['threshold']})" for o in breached) + stage_note,
+                "throttle writers or force a merge (POST /{index}/"
+                "_refresh after the backlog drains); compare the stage "
+                "breakdown against the BENCH build_profile baseline",
+                [o["id"] for o in breached])],
+        }
+    if not write:
+        return {"status": GREEN,
+                "symptom": ("No write-path SLO floors configured "
+                            "(slo.write.*)"),
+                "details": details}
+    return {"status": GREEN,
+            "symptom": f"All {len(write)} write-path SLO floors hold",
+            "details": details}
+
+
 def _slo_indicator(engine) -> dict:
     ev = engine.slo.current()
     if not ev["enabled"]:
@@ -341,6 +396,7 @@ def health_report(engine) -> dict:
     add("hbm", _hbm_indicator)
     add("kernel_utilization", _kernel_indicator)
     add("serving_backpressure", _serving_indicator)
+    add("indexing", _indexing_indicator)
     add("slo_compliance", _slo_indicator)
     add("watcher", _watcher_indicator)
     indicators["ilm"] = {

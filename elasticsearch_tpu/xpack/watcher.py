@@ -439,7 +439,18 @@ class WatcherService:
                  else "executed")
         status["execution_state"] = state
         if new_alert != status["alert"]["state"]:
-            self._alert_transition(wid, w, new_alert, now=now)
+            # an SLO-shaped payload carries its breached objective ids:
+            # the alert doc names them (PR 13 — a tail_fraction breach
+            # reads "breached [write-tail-fraction]" from .alerts-*,
+            # not just "is firing")
+            reason = None
+            if new_alert == "firing" and isinstance(payload, dict) \
+                    and payload.get("breached"):
+                names = ", ".join(str(b) for b in payload["breached"][:8])
+                reason = (f"watch [{wid}] is firing: breached "
+                          f"objectives [{names}]")
+            self._alert_transition(wid, w, new_alert, reason=reason,
+                                   now=now)
         self.counters["executions"] += 1
         if met:
             self.counters["firings"] += 1

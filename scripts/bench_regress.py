@@ -10,7 +10,11 @@ metric regressed by more than --threshold (default 20%):
 - higher-is-better: `qps`, per-kernel `mfu` / `bw_util` (under a
   `device_utilization` section) — regression = new < (1 - t) * old;
 - lower-is-better: `p50_ms` / `p90_ms` / `p99_ms` — regression =
-  new > (1 + t) * old.
+  new > (1 + t) * old;
+- ADVISORY: `build_profile` stage wall-ms / docs_per_s movement beyond
+  the threshold is printed but never fails (PR 13 — same convention as
+  the cost-model drift growth check: the host-build baseline is what
+  the item-2 device port beats, not a criterion itself).
 
 Only paths present in BOTH records compare (configs/arms come and go
 between rounds). CPU-smoke records (device_kind == "cpu") are ADVISORY:
@@ -155,6 +159,60 @@ def drift_growth(prev: dict, latest: dict, threshold: float) -> list:
     return moved
 
 
+def build_profile_metrics(record: dict) -> dict:
+    """-> {"<config>...<stage|wall_ms|docs_per_s>": value} from the
+    per-build build_profile sections (PR 13). Stage/wall millis are
+    lower-is-better, docs_per_s higher-is-better — the sign is encoded
+    in the comparison below."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "build_profile" and isinstance(v, dict):
+                    stack = [(path + (k,), v)]
+                    while stack:
+                        p, node = stack.pop()
+                        for kk, vv in node.items():
+                            if isinstance(vv, dict):
+                                stack.append((p + (kk,), vv))
+                            elif isinstance(vv, (int, float)) \
+                                    and not isinstance(vv, bool):
+                                out[".".join(p + (kk,))] = float(vv)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+def build_profile_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY (same convention as drift_growth): build_profile stage
+    regressions beyond `threshold` are printed for the tier-1 log reader
+    but never fail the lint — host-build wall times are the baseline the
+    item-2 device port beats, not a perf criterion themselves."""
+    a, b = build_profile_metrics(prev), build_profile_metrics(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        leaf = path.rsplit(".", 1)[-1]
+        ratio = new / old
+        if leaf == "docs_per_s":
+            regressed = ratio < 1.0 - threshold
+        elif leaf in ("docs", "tail_fraction"):
+            continue  # corpus shape, not a timing
+        else:  # wall_ms + per-stage ms: lower is better
+            regressed = ratio > 1.0 + threshold
+        if regressed:
+            moved.append((path, old, new, ratio))
+    return moved
+
+
 def print_drift_table(record_path: str) -> None:
     """--print-drift: render the newest record's xla_cost_check sections
     (tier1_gate.sh prints this when records exist)."""
@@ -218,6 +276,12 @@ def main(argv=None) -> int:
         print(f"  DRIFT (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
               f"({rel:.0%} moved) — cost model vs XLA shifted; "
               "re-derive the analytic entry or update BENCH_NOTES")
+    for path, old, new, ratio in build_profile_growth(
+            prev, latest, args.threshold):
+        print(f"  BUILD (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x) — write-path build stage moved beyond "
+              f"{args.threshold:.0%}; compare the stage split before "
+              "accepting a slower host build as the item-2 baseline")
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -95,7 +95,8 @@ def _bar(start: float, dur_ms: float, lo: float, span_s: float) -> str:
     return "·" * a + "█" * (b - a) + "·" * max(BAR_WIDTH - b, 0)
 
 
-def render(trace: dict, out=sys.stdout) -> None:
+def render(trace: dict, out=None) -> None:
+    out = out or sys.stdout  # late-bound: an import-time stdout may be a closed capture
     roots = trace.get("spans", [])
     lo, hi = _window(roots)
     span_s = hi - lo
@@ -151,12 +152,13 @@ def _load_flight(path: str) -> dict:
     return {"capacity": None, "retained": len(waves), "waves": waves}
 
 
-def render_flight(snap: dict, out=sys.stdout) -> None:
+def render_flight(snap: dict, out=None) -> None:
     """One line per recorded wave: a BAR_WIDTH bar partitioned by the
     wave's segment timings (queue ░ / plan ▒ / device █ / finish ▓ —
     contiguous, summing to the wall time), plus size/tenant/kernel
     attribution. The per-wave analog of the span tree above: where did
     this wave's wall time actually sit."""
+    out = out or sys.stdout
     waves = snap.get("waves", [])
     print(f"flight recorder: {len(waves)} wave(s) retained "
           f"(capacity={snap.get('capacity')}, "
@@ -193,6 +195,94 @@ def render_flight(snap: dict, out=sys.stdout) -> None:
               f"{' ' + ' '.join(extras) if extras else ''}", file=out)
 
 
+# ---------------------------------------------------------------------------
+# refresh-profile rendering (PR 13)
+# ---------------------------------------------------------------------------
+
+# stages get bar glyphs in first-seen order; the build.* kernels come
+# first so the same stage keeps the same glyph across refreshes
+_REFRESH_SEED_STAGES = ("build.kmeans", "build.impact_quantize",
+                        "build.csr_assemble", "build.norms",
+                        "build.ann_tiles", "build.device_put",
+                        "build.merge", "analyze", "host_other")
+# NOTE: "·" is reserved for bar padding, never a stage glyph
+_REFRESH_GLYPHS = "█▓▒░▞▚◆●○◇•▪▫≋"
+
+
+def _fetch_refresh(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{url.rstrip('/')}/_refresh/profile", timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def _load_refresh(path: str) -> dict:
+    """A saved GET /_refresh/profile body, or JSON lines of RefreshProfile
+    records (one per line)."""
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if head == "{":
+            try:
+                body = json.load(fh)
+                if "profiles" in body:
+                    return body
+                return {"capacity": None, "retained": 1,
+                        "profiles": [body]}
+            except json.JSONDecodeError:
+                fh.seek(0)
+        profs = [json.loads(ln) for ln in fh if ln.strip()]
+    return {"capacity": None, "retained": len(profs), "profiles": profs}
+
+
+def render_refresh(snap: dict, out=None) -> None:
+    """One line per recorded refresh: a BAR_WIDTH bar partitioned by the
+    contiguous build-stage timings (they sum to the wall time by
+    construction — monitoring/refresh_profile), plus kind / docs /
+    tail_fraction — the per-refresh analog of --flight's per-wave bar:
+    where did this refresh's wall time actually sit."""
+    out = out or sys.stdout
+    profs = snap.get("profiles", [])
+    print(f"refresh profiles: {len(profs)} refresh(es) retained "
+          f"(capacity={snap.get('capacity')}, "
+          f"recorded_total={snap.get('recorded_total')})", file=out)
+    glyph_of: dict[str, str] = {}
+
+    def glyph(stage: str) -> str:
+        if stage not in glyph_of:
+            glyph_of[stage] = _REFRESH_GLYPHS[
+                len(glyph_of) % len(_REFRESH_GLYPHS)]
+        return glyph_of[stage]
+
+    for s in _REFRESH_SEED_STAGES:
+        glyph(s)
+    for p in profs:
+        seg = p.get("stages_ms") or {}
+        wall = max(float(p.get("wall_ms") or 0.0), 1e-9)
+        bar = ""
+        for stage in sorted(seg, key=seg.get, reverse=True):
+            n = int(round(BAR_WIDTH * float(seg[stage]) / wall))
+            bar += glyph(stage) * n
+        bar = (bar + "·" * BAR_WIDTH)[:BAR_WIDTH]
+        top = max(seg, key=seg.get, default=None)
+        tiers = p.get("tiers") or {}
+        print(f"  [{bar}] r{p.get('refresh'):>4} "
+              f"{(p.get('kind') or '?'):<11} "
+              f"idx={p.get('index')} docs={p.get('docs'):>6} "
+              f"wall={wall:9.2f}ms "
+              f"tail={p.get('tail_fraction', 0):.4f} "
+              f"(base={tiers.get('base_docs', 0)}"
+              f"+tail={tiers.get('tail_docs', 0)})"
+              f"{f'  top={top}:{seg[top]:.1f}ms' if top else ''}",
+              file=out)
+    used = [s for s in glyph_of if any(s in (p.get("stages_ms") or {})
+                                       for p in profs)]
+    if used:
+        print("  stages: " + "  ".join(f"{glyph_of[s]} {s}"
+                                       for s in used), file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", help="node/gateway base URL to fetch from")
@@ -203,7 +293,25 @@ def main(argv=None) -> int:
                          "trace: with a PATH, read a saved recorder body "
                          "or a JSON-lines dump; bare --flight fetches "
                          "GET /_serving/flight_recorder from --url")
+    ap.add_argument("--refresh", nargs="?", const="-",
+                    help="render the write-path refresh profiles instead "
+                         "of a trace: with a PATH, read a saved "
+                         "GET /_refresh/profile body or JSON-lines "
+                         "RefreshProfile records; bare --refresh fetches "
+                         "from --url (PR 13)")
     args = ap.parse_args(argv)
+    if args.refresh is not None:
+        if args.refresh == "-":
+            if not args.url:
+                ap.error("bare --refresh needs --url to fetch from")
+            snap = _fetch_refresh(args.url)
+        else:
+            snap = _load_refresh(args.refresh)
+        if not snap.get("profiles"):
+            print("refresh profiles: none recorded", file=sys.stderr)
+            return 1
+        render_refresh(snap)
+        return 0
     if args.flight is not None:
         if args.flight == "-":
             if not args.url:
@@ -217,7 +325,7 @@ def main(argv=None) -> int:
         render_flight(snap)
         return 0
     if not args.trace:
-        ap.error("--trace is required (or use --flight)")
+        ap.error("--trace is required (or use --flight / --refresh)")
     if bool(args.url) == bool(args.otlp):
         ap.error("exactly one of --url / --otlp is required")
     trace = (_fetch_url(args.url, args.trace) if args.url

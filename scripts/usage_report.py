@@ -175,6 +175,66 @@ def render(per_node: dict[str, dict], out=None) -> None:
         print(file=out)
 
 
+def indexing_summary(docs: list[dict]) -> dict:
+    """Write-path view over the window (PR 13): the newest node_stats
+    `indexing` section per node plus the tail_fraction TREND (docs
+    arrive @timestamp-desc; the series is reversed to oldest→newest) —
+    whether the exact-scan tail is growing is the first question a
+    write-heavy incident asks."""
+    per_node: dict[str, dict] = {}
+    for d in docs:
+        node = d.get("node")
+        ind = (d.get("node_stats") or {}).get("indexing") or {}
+        if not node or not ind:
+            continue
+        agg = per_node.setdefault(node, {"latest": ind, "tail_series": [],
+                                         "lag_series": []})
+        agg["tail_series"].append(float(ind.get("tail_fraction", 0.0)))
+        agg["lag_series"].append(float(ind.get("refresh_lag_ms", 0.0)))
+    for agg in per_node.values():
+        agg["tail_series"].reverse()
+        agg["lag_series"].reverse()
+    return per_node
+
+
+def render_indexing(per_node: dict[str, dict], out=None) -> None:
+    out = out or sys.stdout
+    print("write path (indexing)", file=out)
+    if not per_node:
+        print("  (no indexing samples in the window)", file=out)
+        print(file=out)
+        return
+    for node in sorted(per_node):
+        agg = per_node[node]
+        ind = agg["latest"]
+        tser = agg["tail_series"]
+        trend = ("stable" if len(tser) < 2 or tser[-1] == tser[0]
+                 else ("rising" if tser[-1] > tser[0] else "falling"))
+        print(f"  {node}: refreshes={ind.get('refresh_total', 0)} "
+              f"(full={ind.get('refresh_full', 0)} "
+              f"incr={ind.get('refresh_incremental', 0)} "
+              f"merge={ind.get('merge_total', 0)})  "
+              f"docs/s={ind.get('docs_per_s_ema', 0)}  "
+              f"lag={ind.get('refresh_lag_ms', 0)}ms", file=out)
+        print(f"    tail_fraction={ind.get('tail_fraction', 0)} "
+              f"({trend} over {len(tser)} samples: "
+              f"{tser[0] if tser else 0} -> {tser[-1] if tser else 0})",
+              file=out)
+        stage_ms = ind.get("stage_ms") or {}
+        if stage_ms:
+            total = sum(stage_ms.values()) or 1.0
+            rows = [("stage", "cum_ms", "share")]
+            for name in sorted(stage_ms, key=stage_ms.get, reverse=True):
+                rows.append((name, f"{stage_ms[name]:.1f}",
+                             f"{100.0 * stage_ms[name] / total:.1f}%"))
+            widths = [max(len(r[i]) for r in rows) for i in range(3)]
+            for r in rows:
+                print("    " + "  ".join(c.ljust(w)
+                                         for c, w in zip(r, widths))
+                      .rstrip(), file=out)
+    print(file=out)
+
+
 def slo_alert_summary(docs: list[dict], alerts: list[dict],
                       history: list[dict]) -> dict:
     """SLO compliance over the window (per-node fraction of node_stats
@@ -263,12 +323,15 @@ def main(argv=None) -> int:
         history = _query_data_dir(args.data, ".watcher-history-8-*",
                                   hist_body)
     summary = slo_alert_summary(docs, alerts, history)
+    indexing = indexing_summary(docs)
     if args.json:
-        print(json.dumps({"per_node": per_node, "slo": {
-            **summary,
-        }}, indent=2, default=str))
+        print(json.dumps({"per_node": per_node, "indexing": indexing,
+                          "slo": {
+                              **summary,
+                          }}, indent=2, default=str))
     else:
         render(per_node)
+        render_indexing(indexing)
         render_slo(summary)
     return 0
 

@@ -144,6 +144,12 @@ def _module_hygiene():
     from elasticsearch_tpu.telemetry import metrics
 
     metrics.reset()
+    # likewise the fallback RefreshProfile recorder (PR 13): standalone
+    # EsIndex instances record refreshes there, and one module's ring /
+    # docs-per-second EMA must not bleed into another's assertions
+    from elasticsearch_tpu.monitoring import refresh_profile
+
+    refresh_profile.default_recorder().reset_for_tests()
     try:
         import resource
 

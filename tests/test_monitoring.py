@@ -104,16 +104,25 @@ _TIME_KERNEL_RE = re.compile(r'time_kernel\(\s*\n?\s*"([^"]+)"')
 # at fetch time — the lint must see those names too, or an unregistered
 # fused-pjit kernel could ship unaccounted
 _KERNEL_FIELD_RE = re.compile(r'"kernel":\s*\n?\s*"([^"]+)"')
+# write-path build stages (PR 13) dispatch through
+# monitoring/refresh_profile.build_stage("<kernel>", ...) — a time_kernel
+# wrapper that also charges the active RefreshProfile collector. The
+# literal is the kernel name, so the same bijection holds: an
+# unregistered build stage fails tier-1.
+_BUILD_STAGE_RE = re.compile(r'build_stage\(\s*\n?\s*"([^"]+)"')
+
+_DISPATCH_DIRS = ("ops", "parallel", "query", "ann", "engine", "index")
+_DISPATCH_REGEXES = (_TIME_KERNEL_RE, _KERNEL_FIELD_RE, _BUILD_STAGE_RE)
 
 
 def _dispatch_site_names():
     root = os.path.join(os.path.dirname(__file__), "..",
                         "elasticsearch_tpu")
     names = {}
-    for sub in ("ops", "parallel", "query", "ann", "engine"):
+    for sub in _DISPATCH_DIRS:
         for path in glob.glob(os.path.join(root, sub, "*.py")):
             src = open(path, encoding="utf-8").read()
-            for rx in (_TIME_KERNEL_RE, _KERNEL_FIELD_RE):
+            for rx in _DISPATCH_REGEXES:
                 for m in rx.finditer(src):
                     names.setdefault(m.group(1), []).append(
                         os.path.relpath(path, root))
@@ -147,7 +156,12 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      # PR 11: the fused arm on the one-program route and
                      # the serving wave's single combined fetch
                      "sharded.fused_allgather_topk",
-                     "serving.wave_program"):
+                     "serving.wave_program",
+                     # PR 13: the write-path build stages (index/, ann/,
+                     # parallel/, engine/ via build_stage literals)
+                     "build.kmeans", "build.impact_quantize",
+                     "build.csr_assemble", "build.norms",
+                     "build.ann_tiles", "build.device_put", "build.merge"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 

@@ -1,0 +1,509 @@
+"""Write-path ground truth (PR 13): refresh/build stage profiling,
+ingest & tail-tier telemetry, and the write SLO floors.
+
+Covers the tentpole acceptance paths: a RefreshProfile's contiguous
+stage timings sum to the refresh wall time BY CONSTRUCTION (full,
+incremental and merge kinds all recorded); tail_fraction is correct
+against a hand-built (base, tail) pack; the `indexing` section lands in
+the monitoring TSDB and is queryable; an injected tail_fraction breach
+flips the new `indexing` health indicator and fires the prebuilt
+slo-compliance watch with the objective named; the extended
+dispatch-site lint fails on an unregistered build stage; refresh-time
+device_put uploads count kind="refresh" host transitions on the
+Prometheus scrape; and the trace_dump --refresh / bench_regress
+build_profile satellites render/compare the new records."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from elasticsearch_tpu import xpack
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.monitoring.costmodel import KERNEL_COSTS, kernel_cost
+from elasticsearch_tpu.monitoring.refresh_profile import (
+    StageCollector,
+    collect_build_stages,
+    default_recorder,
+)
+from elasticsearch_tpu.telemetry import metrics
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..",
+                           "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _index_docs(idx, lo, hi, field="body", word="alpha"):
+    for i in range(lo, hi):
+        idx.index_doc(str(i), {field: f"{word} w{i % 37} common"})
+
+
+# ---------------------------------------------------------------------------
+# stage collector: contiguity by construction
+# ---------------------------------------------------------------------------
+
+def test_stage_collector_sums_exactly_to_wall():
+    c = StageCollector()
+    with c.stage("a"):
+        time.sleep(0.002)
+        with c.stage("b"):  # nested: b's time must NOT double-count in a
+            time.sleep(0.002)
+        time.sleep(0.001)
+    time.sleep(0.001)  # residual -> host_other
+    wall, stages = c.finish()
+    assert set(stages) == {"a", "b", "host_other"}
+    # every segment derives from one boundary-timestamp sequence, so the
+    # sum is EXACTLY the wall time (float addition of the same diffs)
+    assert abs(sum(stages.values()) - wall) < 1e-9
+    assert stages["b"] >= 0.002 and stages["a"] >= 0.003
+
+
+def test_collect_build_stages_charges_active_collector_only():
+    from elasticsearch_tpu.monitoring.refresh_profile import build_stage
+
+    # no active collector: build_stage still times the kernel (metrics)
+    metrics.reset()
+    with build_stage("build.norms", num_docs=10, nfields=1):
+        pass
+    snap = metrics.snapshot()
+    assert "es.kernel.build.norms.ms" in snap["histograms"]
+    with collect_build_stages() as c:
+        with build_stage("build.norms", num_docs=10, nfields=1):
+            pass
+    _wall, stages = c.finish()
+    assert "build.norms" in stages
+
+
+# ---------------------------------------------------------------------------
+# RefreshProfile: kinds, stage sums, tail_fraction
+# ---------------------------------------------------------------------------
+
+def test_refresh_profile_kinds_and_stage_sum():
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 300)
+        idx.refresh()                      # full rebuild
+        _index_docs(idx, 300, 320, word="beta")
+        idx.refresh()                      # incremental: tail pack
+        _ = idx.searcher                   # tier-unaware access -> merge
+        snap = e.refresh_recorder.profiles()
+        assert snap["recorded_total"] >= 3
+        by_kind = {}
+        for p in snap["profiles"]:
+            if p["index"] == "t":
+                by_kind.setdefault(p["kind"], p)
+        assert {"full", "incremental", "merge"} <= set(by_kind)
+        for kind, p in by_kind.items():
+            # acceptance: stage wall times sum to the refresh wall time
+            # (both sides rounded to 4 decimals at record time)
+            ssum = sum(p["stages_ms"].values())
+            assert abs(ssum - p["wall_ms"]) < 0.01, (kind, p)
+            assert p["wall_ms"] > 0 and p["docs"] > 0
+            assert p["node"] and p["@timestamp"]
+        # the profiled build stages are attributed, not lumped: a full
+        # rebuild shows CSR assembly, norms, impact quantization and the
+        # device upload as distinct stages
+        full = by_kind["full"]
+        for stage in ("build.csr_assemble", "build.norms",
+                      "build.impact_quantize", "build.device_put",
+                      "analyze"):
+            assert stage in full["stages_ms"], (stage, full["stages_ms"])
+        # the merge wraps its rebuild in the build.merge kernel stage
+        assert "build.merge" in by_kind["merge"]["stages_ms"]
+        # incremental refresh re-ships the live bitmap + derives tail
+        # codes on device: device_put and impact_quantize both present
+        incr = by_kind["incremental"]
+        assert "build.device_put" in incr["stages_ms"]
+        assert incr["docs"] == 20
+    finally:
+        e.close()
+
+
+def test_tail_fraction_against_hand_built_tiers():
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        # >256 docs: below that, the FIRST data refresh itself rides the
+        # incremental path (a tail-only index on an empty base) — real
+        # engine semantics this test must not fight
+        _index_docs(idx, 0, 300)
+        idx.refresh()
+        t = idx.tier_stats()
+        assert t == {"base_docs": 300, "tail_docs": 0, "tail_fraction": 0.0}
+        _index_docs(idx, 300, 330, word="beta")
+        idx.refresh()  # incremental: 30-doc tail beside the 300-doc base
+        t = idx.tier_stats()
+        assert t["base_docs"] == 300 and t["tail_docs"] == 30
+        assert t["tail_fraction"] == pytest.approx(30 / 330, abs=1e-6)
+        prof = [p for p in e.refresh_recorder.profiles()["profiles"]
+                if p["index"] == "t"][-1]
+        assert prof["kind"] == "incremental"
+        assert prof["tail_fraction"] == pytest.approx(30 / 330, abs=1e-6)
+        assert prof["tiers"] == {"base_docs": 300, "tail_docs": 30}
+        # deleting a base doc shrinks base_live, not the tail
+        idx.delete_doc("0")
+        idx.refresh()
+        t = idx.tier_stats()
+        assert t["base_docs"] == 299 and t["tail_docs"] == 30
+        # merge folds the tail back: fraction returns to 0
+        _ = idx.searcher
+        assert idx.tier_stats() == {
+            "base_docs": 329, "tail_docs": 0, "tail_fraction": 0.0}
+    finally:
+        e.close()
+
+
+def test_standalone_index_records_to_default_recorder():
+    from elasticsearch_tpu.engine.engine import EsIndex
+    from elasticsearch_tpu.index.mappings import Mappings
+
+    default_recorder().reset_for_tests()
+    idx = EsIndex("solo", Mappings({"properties": {
+        "body": {"type": "text"}}}), {}, None)
+    _index_docs(idx, 0, 8)
+    idx.refresh()
+    snap = default_recorder().profiles()
+    assert snap["recorded_total"] >= 1
+    assert snap["profiles"][-1]["index"] == "solo"
+
+
+def test_indexing_stats_refresh_lag_and_ingest_ema():
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 50)
+        time.sleep(0.02)
+        st = e.indexing_stats()
+        assert st["refresh_lag_ms"] >= 20.0  # unrefreshed write is waiting
+        idx.refresh()
+        st = e.indexing_stats()
+        assert st["refresh_lag_ms"] == 0.0
+        _index_docs(idx, 50, 80)
+        idx.refresh()
+        st = e.indexing_stats()
+        assert st["docs_per_s_ema"] and st["docs_per_s_ema"] > 0
+        assert st["refresh_total"] >= 2
+        assert st["stage_ms"].get("build.csr_assemble", 0) > 0
+        # the gauges land in the registry for the Prometheus exposition
+        g = metrics.snapshot()["gauges"]
+        assert g["es.indexing.tail_fraction"] == st["tail_fraction"]
+        assert "es.indexing.refresh_lag_ms" in g
+        # ring size follows the dynamic setting
+        e.settings.update({"persistent": {"indexing.profile.size": 2}})
+        assert e.refresh_recorder.profiles()["capacity"] == 2
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# cost model + extended dispatch-site lint
+# ---------------------------------------------------------------------------
+
+def test_build_kernel_costs_resolve_on_representative_fields():
+    reps = {
+        "build.kmeans": {"n": 10_000, "dims": 64, "nlist": 128,
+                         "iters": 8},
+        "build.impact_quantize": {"rows": 4096, "code_bytes": 2},
+        "build.csr_assemble": {"postings": 500_000, "num_docs": 20_000,
+                               "terms": 5_000},
+        "build.norms": {"num_docs": 20_000, "nfields": 2},
+        "build.ann_tiles": {"nlist": 128, "tile": 512, "dims": 64},
+        "build.merge": {"docs": 20_000, "nbytes": 1 << 24},
+    }
+    for name, fields in reps.items():
+        c = kernel_cost(name, fields)
+        assert c and c["flops"] > 0 and c["bytes"] > 0, (name, c)
+    # device_put is a pure transfer: bandwidth-only by design
+    c = kernel_cost("build.device_put", {"nbytes": 1 << 20})
+    assert c["flops"] == 0.0 and c["bytes"] == float(1 << 20)
+    # missing shape fields degrade to None, never raise
+    assert kernel_cost("build.kmeans", {"n": 10}) is None
+    assert kernel_cost("build.device_put", {}) is None
+    # host-vs-device attribution day one: the same impact model serves
+    # the pack.py host derivation and sharded.refresh_impacts
+    host = kernel_cost("build.impact_quantize",
+                       {"rows": 1024, "code_bytes": 2, "basis": "host"})
+    dev = kernel_cost("build.impact_quantize",
+                      {"rows": 1024, "code_bytes": 2, "basis": "device"})
+    assert host == dev
+
+
+def test_dispatch_lint_covers_build_sites_and_fails_unregistered():
+    """The extended lint (index/ dir + build_stage literals) sees every
+    build stage, each with a KERNEL_COSTS entry and an XLA_CHECKS
+    declaration — and a hypothetical unregistered stage WOULD fail."""
+    tm = importlib.util.module_from_spec(importlib.util.spec_from_file_location(
+        "test_monitoring_lint",
+        os.path.join(os.path.dirname(__file__), "test_monitoring.py")))
+    tm.__spec__.loader.exec_module(tm)
+    assert "index" in tm._DISPATCH_DIRS
+    sites = tm._dispatch_site_names()
+    build_sites = {n: files for n, files in sites.items()
+                   if n.startswith("build.")}
+    assert {"build.kmeans", "build.impact_quantize", "build.csr_assemble",
+            "build.norms", "build.ann_tiles", "build.device_put",
+            "build.merge"} <= set(build_sites)
+    # every scanned build site is registered (cost model + XLA policy)
+    from elasticsearch_tpu.monitoring.xla_introspect import XLA_CHECKS
+
+    for name in build_sites:
+        assert name in KERNEL_COSTS, name
+        assert XLA_CHECKS.get(name, {}).get("status") in (
+            "checked", "exempt"), name
+        if XLA_CHECKS[name]["status"] == "exempt":
+            assert XLA_CHECKS[name].get("reason"), name
+    # the index/ build sites are actually seen BY the scan (pack.py)
+    assert any("pack.py" in f for f in build_sites["build.csr_assemble"])
+    assert any("index.py" in f for f in build_sites["build.kmeans"])
+    # an unregistered stage is caught by the same regex the lint runs —
+    # shipping 'build_stage("build.bogus", ...)' would fail tier-1
+    src = 'with build_stage("build.bogus", rows=1):\n    pass\n'
+    found = [m.group(1) for rx in tm._DISPATCH_REGEXES
+             for m in rx.finditer(src)]
+    assert found == ["build.bogus"]
+    assert "build.bogus" not in KERNEL_COSTS  # -> lint assert would fire
+
+
+# ---------------------------------------------------------------------------
+# TSDB queryability + closed loop (SLO breach -> indicator + watch)
+# ---------------------------------------------------------------------------
+
+def test_indexing_section_lands_in_monitoring_tsdb():
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 300)
+        idx.refresh()
+        _index_docs(idx, 300, 310, word="beta")
+        idx.refresh()  # tail tier exists: fraction 10/310
+        e.monitoring.collect_once()
+        hits = e.search_multi(
+            ".monitoring-es-*", query={"term": {"type": "node_stats"}},
+            size=10)["hits"]["hits"]
+        assert hits
+        ind = hits[0]["_source"]["node_stats"]["indexing"]
+        assert ind["tail_fraction"] == pytest.approx(10 / 310, abs=1e-6)
+        assert ind["refresh_total"] >= 2
+        assert ind["refresh_incremental"] >= 1
+        assert ind["docs_refreshed_total"] >= 310
+        # stage names are dot-sanitized for the dynamic TSDB mappings
+        assert "build_csr_assemble" in ind["stage_ms"]
+        assert "." not in "".join(ind["stage_ms"])
+    finally:
+        e.close()
+
+
+def test_tail_fraction_breach_fires_prebuilt_watch_naming_objective():
+    """Acceptance: an injected tail_fraction breach flips the new
+    `indexing` indicator (diagnosis names objective AND dominant stage)
+    and fires the prebuilt slo-compliance watch."""
+    e = Engine(None)
+    try:
+        e.settings.update({"persistent": {
+            "slo.write.tail_fraction": 0.01,
+            "slo.write.refresh_lag_ms": 60_000.0,
+        }})
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 400)
+        idx.refresh()
+        _index_docs(idx, 400, 420, word="beta")
+        idx.refresh()  # tail 20/420 = 0.0476 > 0.01 -> breach
+        ev = e.slo.evaluate()
+        assert "write-tail-fraction" in ev["breached"]
+        obj = {o["id"]: o for o in ev["objectives"]}["write-tail-fraction"]
+        assert obj["kind"] == "write"
+        assert obj["measured"] == pytest.approx(20 / 420, abs=1e-6)
+        # refresh lag floor holds (objective present, compliant)
+        lag = {o["id"]: o for o in ev["objectives"]}["write-refresh-lag"]
+        assert lag["status"] == "compliant"
+        hr = xpack.health_report(e)
+        ind = hr["indicators"]["indexing"]
+        assert ind["status"] == "yellow"
+        assert "write-tail-fraction" in ind["details"]["breached"]
+        # the diagnosis names the objective AND the breaching stage
+        assert "write-tail-fraction" in ind["diagnosis"][0]["cause"]
+        assert ind["details"]["dominant_stage"]
+        assert ind["details"]["dominant_stage"] in \
+            ind["diagnosis"][0]["cause"]
+        # the prebuilt watch fires through the standard alert machinery
+        xpack.watcher_ensure_executor(e)
+        out = xpack.watcher_execute(e, "slo-compliance")
+        assert out["watch_record"]["condition_met"]
+        assert out["watch_record"]["alert_state"] == "firing"
+        docs = e.search_multi(
+            ".alerts-default",
+            query={"term": {"watch_id": "slo-compliance"}},
+            size=5)["hits"]["hits"]
+        assert len(docs) == 1 and docs[0]["_source"]["state"] == "firing"
+        # the alert doc itself names the breached objective
+        assert "write-tail-fraction" in docs[0]["_source"]["reason"]
+        # recovery: merge folds the tail, the objective recovers
+        _ = idx.searcher
+        ev = e.slo.evaluate()
+        assert "write-tail-fraction" not in ev["breached"]
+        assert xpack.health_report(e)["indicators"]["indexing"][
+            "status"] == "green"
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# refresh-time host transitions (satellite bugfix) + REST surface
+# ---------------------------------------------------------------------------
+
+def test_refresh_device_put_counts_refresh_transitions():
+    metrics.reset()
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 20)
+        idx.refresh()
+        c = metrics.snapshot()["counters"]
+        full_uploads = c.get("es.device.host_transitions.refresh", 0)
+        assert full_uploads >= 1
+        # an incremental refresh re-ships the live bitmap AND uploads
+        # the tail pack: more refresh-kind transitions, no serving ones
+        _index_docs(idx, 20, 25, word="beta")
+        idx.refresh()
+        c = metrics.snapshot()["counters"]
+        assert c.get("es.device.host_transitions.refresh", 0) \
+            > full_uploads
+    finally:
+        e.close()
+
+
+def test_rest_refresh_profile_nodes_stats_and_prometheus():
+    import asyncio
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from elasticsearch_tpu.rest.app import make_app
+
+        client = TestClient(TestServer(make_app()))
+        await client.start_server()
+        try:
+            r = await client.put("/idx", json={"mappings": {"properties": {
+                "body": {"type": "text"}}}})
+            assert r.status == 200
+            for i in range(30):
+                r = await client.put(f"/idx/_doc/{i}",
+                                     json={"body": f"alpha w{i % 7}"})
+                assert r.status in (200, 201)
+            r = await client.post("/idx/_refresh")
+            assert r.status == 200
+            # GET /_refresh/profile: the ring, stage sums == wall
+            r = await client.get("/_refresh/profile")
+            assert r.status == 200
+            body = await r.json()
+            assert body["retained"] >= 1
+            prof = [p for p in body["profiles"]
+                    if p["index"] == "idx"][-1]
+            assert abs(sum(prof["stages_ms"].values())
+                       - prof["wall_ms"]) < 0.01
+            # ?n= bounds the page
+            r = await client.get("/_refresh/profile?n=1")
+            assert len((await r.json())["profiles"]) == 1
+            # _nodes/stats: the new indexing section
+            r = await client.get("/_nodes/stats")
+            ns = (await r.json())["nodes"]["node-0"]
+            assert "indexing" in ns
+            assert ns["indexing"]["refresh_total"] >= 1
+            assert "stage_ms" in ns["indexing"]
+            # Prometheus: refresh-kind transitions + the write gauges
+            r = await client.get("/_prometheus/metrics")
+            text = await r.text()
+            assert 'es_serving_host_transitions_total{kind="refresh"}' \
+                in text
+            assert "es_indexing_tail_fraction" in text
+            assert "es_indexing_refresh_lag_ms" in text
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# satellites: trace_dump --refresh + bench_regress build_profile advisory
+# ---------------------------------------------------------------------------
+
+def test_trace_dump_renders_refresh_profiles(tmp_path):
+    e = Engine(None)
+    try:
+        e.create_index("t", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["t"]
+        _index_docs(idx, 0, 300)
+        idx.refresh()
+        _index_docs(idx, 300, 310, word="beta")
+        idx.refresh()
+        snap = e.refresh_recorder.profiles()
+    finally:
+        e.close()
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    try:
+        import trace_dump
+    finally:
+        sys.path.pop(0)
+    buf = io.StringIO()
+    trace_dump.render_refresh(snap, out=buf)
+    text = buf.getvalue()
+    assert "refresh profiles:" in text
+    assert "incremental" in text and "full" in text
+    assert "build.impact_quantize" in text  # legend names real stages
+    assert "tail=" in text
+    # main() end-to-end from a saved body file
+    path = tmp_path / "refresh.json"
+    path.write_text(json.dumps(snap))
+    assert trace_dump.main(["--refresh", str(path)]) == 0
+    # JSON-lines dumps load too
+    jl = tmp_path / "refresh.jsonl"
+    jl.write_text("\n".join(json.dumps(p) for p in snap["profiles"]))
+    assert trace_dump.main(["--refresh", str(jl)]) == 0
+
+
+def test_bench_regress_build_profile_is_advisory(tmp_path, capsys):
+    br = _load_script("bench_regress")
+    prev = {"extras": {"build_profile": {"c1_pack": {
+        "wall_ms": 1000.0, "docs": 20_000, "docs_per_s": 20_000.0,
+        "tail_fraction": 0.0,
+        "stages_ms": {"build.csr_assemble": 400.0,
+                      "build.impact_quantize": 300.0}}},
+        "c1": {"qps": 100.0}}}
+    latest = {"extras": {"build_profile": {"c1_pack": {
+        "wall_ms": 2000.0,                       # +100%: advisory only
+        "docs": 20_000, "docs_per_s": 10_000.0,  # -50%: advisory only
+        "tail_fraction": 0.0,
+        "stages_ms": {"build.csr_assemble": 1500.0,   # +275%
+                      "build.impact_quantize": 310.0}}},
+        "c1": {"qps": 100.0}}}
+    moved = br.build_profile_growth(prev, latest, 0.2)
+    paths = {p for p, *_ in moved}
+    assert "build_profile.c1_pack.wall_ms" in paths
+    assert "build_profile.c1_pack.docs_per_s" in paths
+    assert "build_profile.c1_pack.stages_ms.build.csr_assemble" in paths
+    assert "build_profile.c1_pack.stages_ms.build.impact_quantize" \
+        not in paths  # +3%: inside the threshold
+    # end-to-end: a build-stage regression alone NEVER fails the lint
+    # (the advisory convention of the drift growth check), even --force
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(prev))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(latest))
+    assert br.main(["--dir", str(tmp_path), "--force"]) == 0
+    out = capsys.readouterr().out
+    assert "BUILD (advisory)" in out
