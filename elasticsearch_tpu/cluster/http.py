@@ -44,6 +44,10 @@ async def _node_call(server: NodeServer, fn, /, *args, **kwargs):
     dispatch thread, so coordinator fan-out requests propagate the trace."""
     import contextvars
 
+    from ..common import faults
+
+    faults.check("cluster.node_call", node=server.node.node_id,
+                 fn=getattr(fn, "__name__", str(fn)))
     loop = asyncio.get_running_loop()
     fut: asyncio.Future = loop.create_future()
     ctx = contextvars.copy_context()
@@ -68,7 +72,13 @@ async def _node_call(server: NodeServer, fn, /, *args, **kwargs):
 async def _transport_request(server: NodeServer, peer: str, action: str,
                              body: dict, timeout: float = 60.0) -> dict:
     """Async TCP-transport request from the HTTP event loop (the
-    peer-to-peer analog of _node_call)."""
+    peer-to-peer analog of _node_call). Rides the PR-14 resilience
+    policy: the gateway's fan-out requests (trace collect, health,
+    engine dumps) are idempotent reads, so transport flakes back off and
+    retry inside the timeout and the peer's circuit breaker fast-fails
+    a dead node instead of eating the timeout per request."""
+    from ..common.resilience import node_resilience, resilient_send
+
     loop = asyncio.get_running_loop()
     fut: asyncio.Future = loop.create_future()
 
@@ -83,8 +93,10 @@ async def _transport_request(server: NodeServer, peer: str, action: str,
         e = err if isinstance(err, Exception) else RuntimeError(str(err))
         loop.call_soon_threadsafe(_resolve, fut.set_exception, e)
 
-    server.network.submit(lambda: server.node.service.send_request(
-        peer, action, body, ok, fail, timeout=timeout))
+    nr = node_resilience(server.node.node_id)
+    server.network.submit(lambda: resilient_send(
+        server.node.service, nr, peer, action, body, ok, fail,
+        timeout=timeout))
     return await asyncio.wait_for(fut, timeout + 5.0)
 
 
@@ -940,6 +952,18 @@ def make_cluster_app(server: NodeServer,
             items.append({op_name: out})
         return web.json_response({"errors": errors, "items": items})
 
+    def _allow_partial(body, query) -> bool:
+        """allow_partial_search_results: body wins, then the query param;
+        default true (ES semantics — false turns any shard failure into
+        a failed request)."""
+        v = (body or {}).get("allow_partial_search_results")
+        if v is None:
+            raw = query.get("allow_partial_search_results")
+            if raw is None:
+                return True
+            return raw in ("", "true", "1")
+        return bool(v)
+
     async def search(request):
         index = request.match_info["index"]
         bad = _check_index(index)
@@ -952,10 +976,13 @@ def make_cluster_app(server: NodeServer,
         size = int(request.query.get(
             "size", (body or {}).get("size", 10)))
         resp = await _node_call(
-            server, node.client_search, index, body or {}, size=size)
+            server, node.client_search, index, body or {}, size=size,
+            allow_partial=_allow_partial(body, request.query))
         if resp.get("error"):
+            extra = ({"failures": resp["failures"]}
+                     if resp.get("failures") else {})
             return _err(503, "search_phase_execution_exception",
-                        str(resp["error"]))
+                        str(resp["error"]), **extra)
         return web.json_response(resp)
 
     async def msearch(request):
@@ -982,8 +1009,16 @@ def make_cluster_app(server: NodeServer,
                 continue
             resp = await _node_call(
                 server, node.client_search, index, body,
-                size=int(body.get("size", 10)))
-            responses.append(resp)
+                size=int(body.get("size", 10)),
+                allow_partial=_allow_partial(body, request.query))
+            if resp.get("error"):
+                responses.append({"error": {
+                    "type": "search_phase_execution_exception",
+                    "reason": str(resp["error"]),
+                    **({"failures": resp["failures"]}
+                       if resp.get("failures") else {})}, "status": 503})
+            else:
+                responses.append(resp)
         return web.json_response({"responses": responses})
 
     async def count(request):

@@ -555,25 +555,44 @@ class ClusterNode:
     # search scatter/gather
     # ------------------------------------------------------------------
 
-    def client_search(self, index: str, body: dict, on_done, size: int = 10):
+    def client_search(self, index: str, body: dict, on_done, size: int = 10,
+                      allow_partial: bool | None = None):
+        """Scatter/gather with replica failover + honest partial results
+        (PR 14). Per shard, the candidate order is primary first, then
+        replicas (any STARTED copy serves reads — the reference routes
+        reads to any active copy); peers whose circuit breaker is OPEN
+        sort last so a sick node stops eating fan-out latency. A failed
+        candidate fails over to the next copy ONCE per copy; a shard
+        with no surviving copy becomes a `_shards.failures[]` entry with
+        the failing node attributed — the request degrades to partial
+        results instead of dying, unless `allow_partial_search_results`
+        is false (ES semantics: default true; false -> the whole request
+        fails)."""
+        from ..common.resilience import node_resilience
+        from ..telemetry import metrics
+
+        if allow_partial is None:
+            allow_partial = True
         state = self.state
         meta = state.indices.get(index)
         if meta is None:
             on_done({"error": f"index [{index}] missing"})
             return
+        nr = node_resilience(self.node_id)
+        open_peers = set(nr.open_peers())
         n_shards = int(meta["settings"].get("number_of_shards", 1))
-        shard_targets = {}
+        shard_candidates: dict[int, list] = {}
         for s in range(n_shards):
             assigns = [a for a in state.routing.get(index, {}).get(str(s), [])
                        if a["state"] == "STARTED"]
-            if not assigns:
-                on_done({"error": f"shard [{s}] unavailable"})
-                return
-            primary = next((a for a in assigns if a["primary"]), assigns[0])
-            shard_targets[s] = primary["node"]
+            # primary first, replicas after (stable by node id), circuit-
+            # open peers demoted to last resort
+            assigns.sort(key=lambda a: (a["node"] in open_peers,
+                                        not a["primary"], a["node"]))
+            shard_candidates[s] = assigns
 
         partials: dict[int, dict] = {}
-        pending = {"n": len(shard_targets)}
+        pending = {"n": n_shards}
 
         def finish(s, resp):
             partials[s] = resp
@@ -583,21 +602,43 @@ class ClusterNode:
             # coordinator merge: (score desc, shard asc, rank asc)
             hits = []
             total = 0
-            failed = 0
+            failures = []
             for sh in sorted(partials):
                 p = partials[sh]
                 if p.get("error"):
-                    failed += 1  # partial results, like the reference's
-                    continue     # per-shard failures under _shards.failed
+                    # partial results, like the reference's per-shard
+                    # failures under _shards.failed — attributed to the
+                    # node that failed last
+                    failures.append({"shard": sh, "index": index,
+                                     "node": p.get("node"),
+                                     "reason": str(p["error"])})
+                    continue
                 total += p["total"]
                 for rank, h in enumerate(p["hits"]):
                     hits.append((-h["_score"], sh, rank, h))
+            failed = len(failures)
+            if failed:
+                nr.count("partial_responses")
+                metrics.counter_inc("es.resilience.partial_responses")
+            if failed >= n_shards and n_shards > 0:
+                on_done({"error": "all shards failed",
+                         "failures": failures})
+                return
+            if failed and not allow_partial:
+                # allow_partial_search_results=false: any shard failure
+                # fails the request (reference: SearchPhaseExecutionException)
+                on_done({"error": f"{failed} shard failure(s) and "
+                                  "allow_partial_search_results is false",
+                         "failures": failures})
+                return
             hits.sort(key=lambda t: t[:3])
             merged = [h for _, _, _, h in hits[:size]]
+            shards = {"total": n_shards, "successful": n_shards - failed,
+                      "skipped": 0, "failed": failed}
+            if failures:
+                shards["failures"] = failures
             on_done({
-                "_shards": {"total": len(partials),
-                            "successful": len(partials) - failed,
-                            "skipped": 0, "failed": failed},
+                "_shards": shards,
                 "hits": {
                     "total": {"value": total, "relation": "eq"},
                     "max_score": merged[0]["_score"] if merged else None,
@@ -609,30 +650,67 @@ class ClusterNode:
             """Local-shard responses go through the same async path as
             remote ones (so compiles offload to the worker pool)."""
 
-            def __init__(self, shard):
-                self.shard = shard
+            def __init__(self, ok, fail):
+                self._ok = ok
+                self._fail = fail
 
             def send_response(self, resp):
-                finish(self.shard, resp)
+                self._ok(resp)
 
             def send_failure(self, reason):
-                finish(self.shard, {"total": 0, "hits": [],
-                                    "error": str(reason)})
+                self._fail(RuntimeError(str(reason)))
 
         req_body = {"index": index, "body": body, "size": size}
-        for s, node in shard_targets.items():
+
+        def attempt(s, ci, last_err):
+            cands = shard_candidates[s]
+            if ci >= len(cands):
+                last_node = cands[-1]["node"] if cands else None
+                finish(s, {"total": 0, "hits": [], "node": last_node,
+                           "error": (str(last_err) if last_err is not None
+                                     else "no active shard copy")})
+                return
+            a = cands[ci]
+            node = a["node"]
+            breaker = nr.breaker(node) if node != self.node_id else None
+            if breaker is not None and not breaker.allow_request():
+                nr.count("fast_fails")
+                metrics.counter_inc("es.resilience.fast_fails")
+                attempt(s, ci + 1,
+                        f"circuit breaker open for peer [{node}]")
+                return
+
+            def ok(resp):
+                if breaker is not None:
+                    breaker.record_success()
+                finish(s, resp)
+
+            def fail(err):
+                if breaker is not None:
+                    breaker.record_failure(str(err))
+                if ci + 1 < len(cands):
+                    # retry once per surviving in-sync copy — the
+                    # reference's AbstractSearchAsyncAction shard
+                    # iterator failover
+                    nr.count("failovers")
+                    metrics.counter_inc("es.resilience.failovers")
+                    attempt(s, ci + 1, err)
+                    return
+                finish(s, {"total": 0, "hits": [], "node": node,
+                           "error": str(err)})
+
             req = {**req_body, "shard": s}
             if node == self.node_id:
                 self._on_shard_search_async(req, self.node_id,
-                                            _LocalChannel(s))
+                                            _LocalChannel(ok, fail))
             else:
                 self.service.send_request(
-                    node, A_SHARD_SEARCH, req,
-                    lambda resp, s=s: finish(s, resp),
-                    lambda err, s=s: finish(s, {"total": 0, "hits": [],
-                                                "error": str(err)}),
+                    node, A_SHARD_SEARCH, req, ok, fail,
                     timeout=self.SEARCH_TIMEOUT,
                 )
+
+        for s in shard_candidates:
+            attempt(s, 0, None)
 
     def _build_shard_entry(self, seqno: int, live: list, mappings_dict: dict):
         from ..index.mappings import Mappings
@@ -657,6 +735,10 @@ class ClusterNode:
         from ..telemetry import TRACER
 
         index, s = req["index"], req["shard"]
+        from ..common import faults
+
+        faults.check("shard.search", index=index, shard=s,
+                     node=self.node_id)
         copy = self.shards.get((index, s))
         if copy is None:
             raise RuntimeError(f"no copy of [{index}][{s}] here")
@@ -705,8 +787,11 @@ class ClusterNode:
             )
 
         def work():
+            from ..common import faults
             from ..telemetry import TRACER
 
+            faults.check("shard.search", index=index, shard=s,
+                         node=self.node_id)
             with TRACER.span("shardSearchPhase", index=index, shard=s):
                 entry = entry_snapshot
                 if entry is None:

@@ -1,0 +1,233 @@
+"""Deterministic fault injection: named points, seeded schedules.
+
+The resilience layer (PR 14) is only trustworthy if every claim it makes
+— failover, partial results, circuit breaking, device-OOM degradation —
+is exercised by injected faults, not asserted. This module is the single
+switchboard: every fan-out / dispatch site in the data plane carries a
+named injection point (`faults.check("<point>", **ctx)`), and a seeded
+schedule decides which calls fail with which error class. Disabled (the
+default), `check` is one global-None comparison — no parsing, no dict
+lookups, no RNG — so the production hot path pays nothing.
+
+Schedule spec (env `ES_TPU_FAULTS`, seed `ES_TPU_FAULTS_SEED`, or the
+test-only REST toggle `POST /_fault_injection`):
+
+    point:key=val,key=val[;point2:...]
+
+    transport.send:p=0.1,error=connect,match=n2
+    device.dispatch:once=1,error=oom
+    shard.search:nth=3,error=error,match=logs
+
+keys:
+    p=<float>     fire with this probability (seeded RNG, deterministic
+                  sequence per rule)
+    nth=<int>     fire exactly on the Nth matching call (1-based)
+    once=1        fire on the first matching call, then never again
+    error=<cls>   connect | timeout | oom | error   (default: error)
+    match=<sub>   only calls whose ctx values contain this substring
+                  (peer / index / node / action — whatever the site puts
+                  in ctx) are eligible
+
+Every rule keeps (checks, fired) counters; `stats()` feeds the REST
+toggle's GET so a chaos run can prove its schedule actually fired.
+
+The tier-1 lint (tests/test_resilience.py) asserts the bijection between
+the `FAULT_POINTS` registry below and the `faults.check("<name>")`
+literals in the source tree — a new fan-out or dispatch site cannot ship
+without a registered injection point, the KERNEL_COSTS discipline
+applied to failure paths.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+# the registry: every name here must appear at >= 1 check() site, and
+# every check() literal must be registered here (tier-1 lint)
+FAULT_POINTS = (
+    "transport.send",    # outbound transport request (per peer/action)
+    "cluster.node_call",  # HTTP gateway -> dispatch-thread coordinator call
+    "shard.search",      # per-shard / per-index query execution body
+    "device.dispatch",   # host -> device program launch
+    "device.fetch",      # blocking device -> host result pull
+    "refresh.build",     # refresh-time pack/tier build
+    "serving.wave",      # serving wave device stage
+)
+
+
+class InjectedFault(Exception):
+    """Base class for injected failures (error=error)."""
+
+
+class InjectedDeviceOOM(InjectedFault):
+    """Injected device allocation failure. The message carries the XLA
+    RESOURCE_EXHAUSTED marker so the degradation wrapper treats it
+    exactly like a real device OOM."""
+
+    def __init__(self, point: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at [{point}]")
+
+
+def _make_error(kind: str, point: str, ctx: dict) -> Exception:
+    where = f"[{point}] {ctx}" if ctx else f"[{point}]"
+    if kind == "connect":
+        from ..transport.base import ConnectTransportError
+
+        return ConnectTransportError(f"injected connect fault at {where}")
+    if kind == "timeout":
+        from ..transport.base import ReceiveTimeoutError
+
+        return ReceiveTimeoutError(f"injected timeout at {where}")
+    if kind == "oom":
+        return InjectedDeviceOOM(point)
+    return InjectedFault(f"injected fault at {where}")
+
+
+class _Rule:
+    __slots__ = ("point", "p", "nth", "once", "error", "match",
+                 "checks", "fired", "_rng", "_done")
+
+    def __init__(self, point: str, spec: dict, seed: int, ordinal: int):
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point [{point}] "
+                             f"(registered: {FAULT_POINTS})")
+        self.point = point
+        self.p = float(spec["p"]) if "p" in spec else None
+        self.nth = int(spec["nth"]) if "nth" in spec else None
+        self.once = str(spec.get("once", "")) in ("1", "true")
+        self.error = spec.get("error", "error")
+        if self.error not in ("connect", "timeout", "oom", "error"):
+            raise ValueError(f"unknown error class [{self.error}]")
+        self.match = spec.get("match")
+        self.checks = 0
+        self.fired = 0
+        # per-rule RNG stream: deterministic for (seed, rule ordinal)
+        # regardless of how many other rules fire
+        self._rng = random.Random(f"{seed}:{ordinal}:{point}")
+        self._done = False
+
+    def eligible(self, ctx: dict) -> bool:
+        if self.match is None:
+            return True
+        return any(self.match in str(v) for v in ctx.values())
+
+    def decide(self) -> bool:
+        """Called once per eligible check; counters already advanced."""
+        if self._done:
+            return False
+        if self.once:
+            self._done = True
+            return True
+        if self.nth is not None:
+            if self.checks == self.nth:
+                self._done = True
+                return True
+            return False
+        if self.p is not None:
+            return self._rng.random() < self.p
+        return True  # bare rule: fire every time (nth/p/once unset)
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "p": self.p, "nth": self.nth,
+                "once": self.once, "error": self.error,
+                "match": self.match, "checks": self.checks,
+                "fired": self.fired, "exhausted": self._done}
+
+
+class FaultPlan:
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self.rules: list[_Rule] = []
+        self.by_point: dict[str, list[_Rule]] = {}
+        for i, part in enumerate(p for p in spec.split(";") if p.strip()):
+            point, _, argstr = part.strip().partition(":")
+            args = {}
+            for kv in argstr.split(","):
+                if not kv.strip():
+                    continue
+                k, _, v = kv.partition("=")
+                args[k.strip()] = v.strip()
+            rule = _Rule(point.strip(), args, self.seed, i)
+            self.rules.append(rule)
+            self.by_point.setdefault(rule.point, []).append(rule)
+
+    def maybe_fire(self, point: str, ctx: dict) -> None:
+        rules = self.by_point.get(point)
+        if not rules:
+            return
+        with self._lock:
+            for rule in rules:
+                if not rule.eligible(ctx):
+                    continue
+                rule.checks += 1
+                if rule.decide():
+                    rule.fired += 1
+                    raise _make_error(rule.error, point, ctx)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out: dict = {"spec": self.spec, "seed": self.seed, "rules": [
+                r.to_dict() for r in self.rules]}
+        per_point: dict[str, dict] = {}
+        for r in out["rules"]:
+            agg = per_point.setdefault(
+                r["point"], {"checks": 0, "fired": 0})
+            agg["checks"] += r["checks"]
+            agg["fired"] += r["fired"]
+        out["points"] = per_point
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module state: None = disabled = the entire cost of check()
+# ---------------------------------------------------------------------------
+
+_ACTIVE: FaultPlan | None = None
+
+
+def check(point: str, **ctx) -> None:
+    """The hot-path hook. A no-op global-None comparison when disabled."""
+    if _ACTIVE is None:
+        return
+    _ACTIVE.maybe_fire(point, ctx)
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def configure(spec: str, seed: int = 0) -> dict:
+    """Install a schedule (REST toggle / tests). Replaces any active one."""
+    global _ACTIVE
+    plan = FaultPlan(spec, seed)
+    _ACTIVE = plan
+    return plan.stats()
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def stats() -> dict:
+    plan = _ACTIVE
+    if plan is None:
+        return {"enabled": False}
+    return {"enabled": True, **plan.stats()}
+
+
+def configure_from_env() -> None:
+    """Read ES_TPU_FAULTS / ES_TPU_FAULTS_SEED (process start, chaos
+    gate subprocesses). A malformed env spec is a hard error — a chaos
+    run silently running fault-free would `pass` vacuously."""
+    spec = os.environ.get("ES_TPU_FAULTS")
+    if spec:
+        configure(spec, int(os.environ.get("ES_TPU_FAULTS_SEED", "0")))
+
+
+configure_from_env()

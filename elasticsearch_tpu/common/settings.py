@@ -196,6 +196,12 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True),
         Setting("indices.requests.cache.size", "64mb", str, dynamic=True),
         Setting("search.default_search_timeout", "-1", str, dynamic=True),
+        # honest partial results (PR 14, reference:
+        # SearchService.DEFAULT_ALLOW_PARTIAL_SEARCH_RESULTS): the
+        # cluster default a request's body/param can override; false
+        # turns ANY shard failure into a 503 instead of partial results
+        Setting("search.default_allow_partial_results", True,
+                Setting.bool_, dynamic=True),
         Setting("search.max_buckets", 65536, Setting.positive_int, dynamic=True),
         Setting("action.auto_create_index", True, Setting.bool_, dynamic=True),
         Setting("cluster.max_shards_per_node", 1000, Setting.positive_int, dynamic=True),

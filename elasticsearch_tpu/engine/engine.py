@@ -516,8 +516,10 @@ class EsIndex:
         self._searcher = value
 
     def refresh(self, mesh=None):
+        from ..common import faults
         from ..monitoring.refresh_profile import profile_refresh
 
+        faults.check("refresh.build", index=self.name)
         if self._hydrate is not None:
             h, self._hydrate = self._hydrate, None
             h()
@@ -833,13 +835,37 @@ class EsIndex:
         _trace_ctx = TRACER.span("executeQueryPhase", index=self.name)
         _trace_span = _trace_ctx.__enter__()
         try:
-            return self._search_inner(
-                query=query, size=size, from_=from_, aggs=aggs, knn=knn,
-                sort=sort, search_after=search_after,
-                script_fields=script_fields, collapse=collapse,
-                rescore=rescore, runtime_mappings=runtime_mappings,
-                track_total_hits=track_total_hits,
-            )
+            def _dispatch():
+                # injection only on the engine-backed data plane (a
+                # standalone EsIndex has no recovery service to stage
+                # the degradation)
+                from ..common import faults
+
+                faults.check("device.dispatch", index=self.name)
+                return self._search_inner(
+                    query=query, size=size, from_=from_, aggs=aggs,
+                    knn=knn, sort=sort, search_after=search_after,
+                    script_fields=script_fields, collapse=collapse,
+                    rescore=rescore, runtime_mappings=runtime_mappings,
+                    track_total_hits=track_total_hits,
+                )
+
+            if self.engine is None:
+                return self._search_inner(
+                    query=query, size=size, from_=from_, aggs=aggs,
+                    knn=knn, sort=sort, search_after=search_after,
+                    script_fields=script_fields, collapse=collapse,
+                    rescore=rescore, runtime_mappings=runtime_mappings,
+                    track_total_hits=track_total_hits,
+                )
+            # device-failure graceful degradation (PR 14): a
+            # RESOURCE_EXHAUSTED at any arm evicts recoverable caches,
+            # halves the serving wave with a recovery ramp, and re-runs
+            # this one search on the exact/XLA arm instead of 500ing
+            from ..common.resilience import run_with_device_recovery
+
+            return run_with_device_recovery(
+                self.engine, _dispatch, where="dispatch")
         finally:
             if runtime_mappings:
                 self.searcher.remove_runtime_fields(list(runtime_mappings))
@@ -1556,7 +1582,10 @@ class EsIndex:
             return
         import jax
 
+        from ..common import faults
         from ..telemetry import host_transition, time_kernel
+
+        faults.check("device.fetch", index=self.name, op="wave")
 
         sp = getattr(self._searcher, "sp", None)
         fields = dict(tier="wave",
@@ -1824,6 +1853,7 @@ class Engine:
         self._slo = None
         self._profiler = None
         self._refresh_recorder = None
+        self._device_degradation = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -2020,6 +2050,18 @@ class Engine:
         if self._profiler is None:
             self._profiler = ProfilerService(self)
         return self._profiler
+
+    @property
+    def device_degradation(self):
+        """Device-OOM graceful degradation (common/resilience.py, PR 14):
+        lazy — built at the first RESOURCE_EXHAUSTED; owns the staged
+        response (cache eviction, serving-wave halving + recovery ramp)
+        and the degradation event log."""
+        from ..common.resilience import DeviceDegradation
+
+        if self._device_degradation is None:
+            self._device_degradation = DeviceDegradation(self)
+        return self._device_degradation
 
     @property
     def refresh_recorder(self):
@@ -2503,8 +2545,12 @@ class Engine:
         from_ = kwargs.get("from_", 0)
         sub_results = []
         skipped_shards = 0
+        failed_shards = 0
+        shard_failures: list[dict] = []
+        from ..common import faults
         from ..search.canmatch import can_match
 
+        node_name = getattr(self.tasks, "node", "node-0")
         for idx, alias_filter in targets:
             kw = dict(kwargs)
             kw["query"] = with_filter(kw.get("query"), alias_filter)
@@ -2517,7 +2563,36 @@ class Engine:
             if not can_match(idx, kw["query"]):
                 skipped_shards += idx.num_shards
                 continue
-            sub_results.append(idx.search(**kw))
+            # honest partial results (PR 14): one index's failure becomes
+            # a _shards.failures entry, not the whole request's death —
+            # the fan-out unit here is the index (its shards run as one
+            # SPMD program), so the failure granularity matches it. The
+            # REST layer decides partial-vs-fail from
+            # allow_partial_search_results.
+            try:
+                faults.check("shard.search", index=idx.name,
+                             node=node_name)
+                sub_results.append(idx.search(**kw))
+            except IllegalArgumentError:
+                raise  # a malformed request is the caller's 400, not a
+                # shard failure to paper over
+            except Exception as ex:  # noqa: BLE001 - per-shard envelope
+                failed_shards += idx.num_shards
+                shard_failures.append({
+                    "shard": 0, "index": idx.name, "node": node_name,
+                    "reason": {"type": type(ex).__name__.lower(),
+                               "reason": str(ex)[:512]},
+                })
+        if shard_failures and not sub_results:
+            # every target failed: no partial to serve (the reference's
+            # all-shards-failed SearchPhaseExecutionException)
+            from ..utils.errors import SearchPhaseExecutionError
+
+            raise SearchPhaseExecutionError(
+                "all shards failed: " + "; ".join(
+                    f"[{f['index']}] {f['reason']['reason']}"
+                    for f in shard_failures),
+                failures=shard_failures)
         # merge: total sums; hits re-sorted globally (score desc, or the
         # explicit sort's transformed keys which each sub-search returns in
         # hit["sort"]) — the coordinator-side TopDocs.merge of the reference
@@ -2573,7 +2648,16 @@ class Engine:
                 "relation": ("gte" if any(
                     t.get("relation") == "gte" for t in totals) else "eq"),
             }
-        return {"hits": hits_obj, "skipped_shards": skipped_shards}
+        out = {"hits": hits_obj, "skipped_shards": skipped_shards}
+        if shard_failures:
+            out["failed_shards"] = failed_shards
+            out["shard_failures"] = shard_failures
+            from ..common.resilience import node_resilience
+            from ..telemetry import metrics
+
+            node_resilience(node_name).count("partial_responses")
+            metrics.counter_inc("es.resilience.partial_responses")
+        return out
 
     # ---- scroll / point-in-time ------------------------------------------
 
@@ -3015,15 +3099,36 @@ class Engine:
             )
         return run_suggest(targets[0][0], body)
 
-    def count_multi(self, expression, query=None, **res_kw) -> int:
+    def count_multi(self, expression, query=None, failures=None,
+                    **res_kw) -> int:
+        """`failures`: optional list the caller owns — per-index count
+        failures are appended there (honest `_shards` accounting at the
+        REST layer, PR 14) instead of killing the whole count; with no
+        list given the first failure raises as before."""
+        from ..common import faults
+
         targets = self.resolve_search(expression, **res_kw)
         total = 0
+        node_name = getattr(self.tasks, "node", "node-0")
         for idx, alias_filter in targets:
             q = query
             if alias_filter is not None:
                 q = {"bool": {"filter": [alias_filter]}} if q is None else \
                     {"bool": {"must": [q], "filter": [alias_filter]}}
-            total += idx.count(q)
+            try:
+                faults.check("shard.search", index=idx.name,
+                             node=node_name, op="count")
+                total += idx.count(q)
+            except IllegalArgumentError:
+                raise
+            except Exception as ex:  # noqa: BLE001 - per-shard envelope
+                if failures is None:
+                    raise
+                failures.append({
+                    "shard": 0, "index": idx.name, "node": node_name,
+                    "reason": {"type": type(ex).__name__.lower(),
+                               "reason": str(ex)[:512]},
+                })
         return total
 
     def run_pipelines(self, index_name: str, source: dict,
@@ -3124,6 +3229,8 @@ class Engine:
             self._monitoring.stop()  # join the collection thread
         if self._profiler is not None:
             self._profiler.close()  # stop a still-open trace window
+        if self._device_degradation is not None:
+            self._device_degradation.close()  # cancel the recovery ramp
         if self._ml is not None:
             self._ml.shutdown()  # checkpoints open jobs' model state
         for idx in self.indices.values():

@@ -1241,8 +1241,11 @@ class StackedSearcher:
         if not st["pending"]:
             st["host"] = []
             return
+        from ..common import faults
         from ..telemetry import time_kernel
 
+        faults.check("device.fetch", shards=self.sp.S,
+                     requests=len(st["pending"]))
         with time_kernel("sharded.spmd_topk", shards=self.sp.S,
                          requests=len(st["pending"]),
                          queries=len(st["pending"]),

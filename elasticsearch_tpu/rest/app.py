@@ -292,21 +292,46 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def refresh_index(request):
+        """`_shards` derives from the actual per-index outcome (PR 14) —
+        a thrown refresh becomes a failures[] entry instead of the
+        unconditional `failed: 0` this block used to hardcode."""
         name = request.match_info.get("index")
         targets = (
             [i for i, _ in engine.resolve_search(name)]
             if name
             else list(engine.indices.values())
         )
+        failures = []
         for idx in targets:
-            await call(idx.refresh)
+            try:
+                await call(idx.refresh)
+            except Exception as ex:  # noqa: BLE001 - per-shard envelope
+                failures.append({
+                    "shard": 0, "index": idx.name,
+                    "node": engine.tasks.node,
+                    "reason": {"type": type(ex).__name__.lower(),
+                               "reason": str(ex)[:512]}})
         n = len(targets)
-        return web.json_response({"_shards": {"total": n, "successful": n, "failed": 0}})
+        shards = {"total": n, "successful": n - len(failures),
+                  "failed": len(failures)}
+        if failures:
+            shards["failures"] = failures
+        # broadcast-op semantics (reference: BroadcastResponse): 200 with
+        # the failure list — partial success is not an HTTP error
+        return web.json_response({"_shards": shards})
 
     @handler
     async def flush_index(request):
         idx = _concrete(request.match_info["index"])
-        await call(idx.flush)
+        try:
+            await call(idx.flush)
+        except Exception as ex:  # noqa: BLE001 - honest _shards envelope
+            return web.json_response({"_shards": {
+                "total": 1, "successful": 0, "failed": 1,
+                "failures": [{"shard": 0, "index": idx.name,
+                              "node": engine.tasks.node,
+                              "reason": {"type": type(ex).__name__.lower(),
+                                         "reason": str(ex)[:512]}}]}})
         return web.json_response({"_shards": {"total": 1, "successful": 1, "failed": 0}})
 
     # ---- documents -------------------------------------------------------
@@ -2009,16 +2034,42 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             if isinstance(tot, dict):
                 res["hits"]["total"] = tot["value"]
         skipped = res.pop("skipped_shards", 0)
+        # honest `_shards` (PR 14): the fan-out reports its real outcome —
+        # failed shards + attributed failures ride the engine result, and
+        # allow_partial_search_results (body > query param > dynamic
+        # cluster default, ES semantics: default true) decides whether a
+        # partial response is served or the request fails with 503
+        failed = res.pop("failed_shards", 0)
+        failures = res.pop("shard_failures", None)
+        if failed:
+            allow = body.get("allow_partial_search_results")
+            if allow is None:
+                raw = query_params.get("allow_partial_search_results")
+                if raw is not None:
+                    allow = raw in ("", "true", "1")
+            if allow is None:
+                allow = bool(engine.settings.get(
+                    "search.default_allow_partial_results"))
+            if not allow:
+                from ..utils.errors import SearchPhaseExecutionError
+
+                raise SearchPhaseExecutionError(
+                    f"{failed} shard failure(s) and "
+                    "allow_partial_search_results is false",
+                    failures=failures)
+        shards = {
+            "total": n_shards,
+            # the reference counts skipped shards as successful too
+            "successful": max(n_shards - failed, 0),
+            "skipped": skipped,
+            "failed": failed,
+        }
+        if failures:
+            shards["failures"] = failures
         return {
             "took": took,
             "timed_out": False,
-            "_shards": {
-                "total": n_shards,
-                # the reference counts skipped shards as successful too
-                "successful": n_shards,
-                "skipped": skipped,
-                "failed": 0,
-            },
+            "_shards": shards,
             **res,
         }
 
@@ -2067,11 +2118,19 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     async def count(request):
         body = await body_json(request, {}) or {}
         expression = request.match_info.get("index")
-        n = await call(engine.count_multi, expression, body.get("query"))
+        failures: list = []
+        n = await call(engine.count_multi, expression, body.get("query"),
+                       failures)
         n_shards = sum(i.num_shards for i, _ in engine.resolve_search(expression))
-        return web.json_response(
-            {"count": n, "_shards": {"total": n_shards, "successful": n_shards, "skipped": 0, "failed": 0}}
-        )
+        failed = sum(
+            engine.indices[f["index"]].num_shards
+            if f["index"] in engine.indices else 1 for f in failures)
+        shards = {"total": n_shards,
+                  "successful": max(n_shards - failed, 0),
+                  "skipped": 0, "failed": failed}
+        if failures:
+            shards["failures"] = failures
+        return web.json_response({"count": n, "_shards": shards})
 
     @handler
     async def scroll_continue(request):
@@ -2498,6 +2557,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         import jax
 
         from ..cache import request_cache
+        from ..common import resilience as _resilience
         from ..monitoring import device as _mon_device
         from ..telemetry import TRACER, metrics, recent_slowlogs
 
@@ -2540,6 +2600,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # continuous-batching front end: queue depth,
                         # wave occupancy, shed/expiry/cancel accounting
                         "serving": engine.serving.stats(),
+                        # data-plane resilience (PR 14): per-peer circuit
+                        # breakers (state/trips), retry + failover +
+                        # partial-response counters, device-degradation
+                        # events and the recovery-ramp state
+                        "resilience": {
+                            **_resilience.resilience_stats(),
+                            "device": (
+                                engine._device_degradation.stats()
+                                if engine._device_degradation is not None
+                                else {"degraded": False}),
+                        },
                         # write-path ground truth (PR 13): refresh/merge
                         # counts, cumulative build-stage millis, current
                         # tail-tier fraction, refresh lag, docs/s EMA
@@ -2592,6 +2663,36 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         `capture` action does on an SLO breach)."""
         return web.json_response(
             await call(engine.serving.dump_flight_recorder))
+
+    @handler
+    async def fault_injection_get(request):
+        """GET /_fault_injection (test-only): the active schedule and its
+        per-rule (checks, fired) counters — a chaos run proves its
+        schedule actually fired from this body."""
+        from ..common import faults
+
+        return web.json_response(faults.stats())
+
+    @handler
+    async def fault_injection_put(request):
+        """POST /_fault_injection {"spec": ..., "seed": N} (test-only):
+        install a seeded fault schedule in this process. The production
+        path costs one global-None check while no schedule is active."""
+        from ..common import faults
+
+        body = await body_json(request, {}) or {}
+        spec = body.get("spec")
+        if not spec:
+            raise IllegalArgumentError("[spec] is required")
+        return web.json_response(
+            faults.configure(str(spec), int(body.get("seed", 0))))
+
+    @handler
+    async def fault_injection_delete(request):
+        from ..common import faults
+
+        faults.clear()
+        return web.json_response({"acknowledged": True})
 
     @handler
     async def profiler_start(request):
@@ -2677,6 +2778,19 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
             if idx_stats.get("docs_per_s_ema") is not None:
                 extra["es.indexing.docs_per_s_ema"] = \
                     idx_stats["docs_per_s_ema"]
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
+        # data-plane resilience gauges (PR 14): open circuits + device
+        # degradation state; the es.resilience.* counters ride the
+        # registry exposition above
+        try:
+            from ..common.resilience import resilience_stats
+
+            extra["es.resilience.open_circuits"] = \
+                resilience_stats()["open_circuits"]
+            extra["es.resilience.device_degraded"] = (
+                1 if (engine._device_degradation is not None
+                      and engine._device_degradation.degraded) else 0)
         except Exception:  # noqa: BLE001 - the scrape must not 500
             pass
         # closed-loop health/SLO gauges (PR 9): the scrape itself carries
@@ -2833,6 +2947,9 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_serving/flight_recorder", serving_flight_recorder)
     app.router.add_post("/_serving/flight_recorder/_dump",
                         serving_flight_recorder_dump)
+    app.router.add_get("/_fault_injection", fault_injection_get)
+    app.router.add_post("/_fault_injection", fault_injection_put)
+    app.router.add_delete("/_fault_injection", fault_injection_delete)
     app.router.add_post("/_profiler/start", profiler_start)
     app.router.add_post("/_profiler/stop", profiler_stop)
     app.router.add_get("/_profiler", profiler_status)

@@ -15,7 +15,7 @@ from .coalesce import classify_request, term_disjunction_of
 from .queue import (
     PendingSearch, ServingRejectedError, TenantQueues, parse_tenant_weights,
 )
-from .service import ServingService, reset_all_for_tests
+from .service import ServingService, reservation_leaks, reset_all_for_tests
 
 __all__ = [
     "PendingSearch",
@@ -24,6 +24,7 @@ __all__ = [
     "TenantQueues",
     "classify_request",
     "parse_tenant_weights",
+    "reservation_leaks",
     "reset_all_for_tests",
     "term_disjunction_of",
 ]

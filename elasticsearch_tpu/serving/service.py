@@ -69,6 +69,21 @@ def reset_all_for_tests():
         sv.reset_for_tests()
 
 
+def reservation_leaks() -> list[dict]:
+    """in_flight_requests reservations still held by live serving
+    services. After reset_all_for_tests drained everything this must be
+    empty — a non-empty list means some rejected/terminal path kept its
+    breaker charge (the PR-14 shed-path bug class). Asserted by the
+    conftest module hygiene."""
+    out = []
+    for sv in list(_LIVE_SERVICES):
+        with sv._lock:
+            if sv._reserved_bytes:
+                out.append({"service": repr(sv),
+                            "reserved_bytes": sv._reserved_bytes})
+    return out
+
+
 def _timed_out_response() -> dict:
     """A search whose queue wait exceeded its deadline degrades the way a
     shard-timeout does in the reference (partial results, timed_out
@@ -130,6 +145,10 @@ class ServingService:
             fr_size = 256
         self._flight: deque = deque(maxlen=max(fr_size, 1))
         self._wave_seq = 0
+        # in_flight_requests bytes this service has charged but not yet
+        # released: the conftest module-hygiene leak assertion reads it
+        # (a rejected request that keeps its reservation is a slow leak)
+        self._reserved_bytes = 0
         _LIVE_SERVICES.add(self)
 
     # ---- settings consumers ---------------------------------------------
@@ -207,25 +226,41 @@ class ServingService:
             metrics.counter_inc("es.serving.shed_total")
             ex.retry_after_s = self._retry_after_s()
             raise
-        task = self.engine.tasks.register(
-            self.TASK_ACTION,
-            description=f"serving search [{entry.get('index')}]",
-            cancellable=True, parent_task_id=parent_task_id)
-        now = time.monotonic()
-        ps = PendingSearch(
-            entry=entry, tenant=tenant,
-            deadline=(now + timeout_s) if timeout_s else None,
-            task=task, est_bytes=est_bytes)
-        # cancelling a QUEUED task removes it from the serving queue and
-        # resolves the caller without a device round-trip (satellite fix:
-        # pre-dispatch cancellation previously had no path)
-        task.add_cancel_listener(
-            lambda reason, ps=ps: self._cancel_queued(ps, reason))
-        with self._cv:
-            self._tenants.push(ps)
-            self.counters["admitted"] += 1
-            metrics.gauge_set("es.serving.queue_depth", self._tenants.depth)
-            self._cv.notify_all()
+        with self._lock:
+            self._reserved_bytes += est_bytes
+        # the breaker is charged: from here EVERY exit path must release
+        # the reservation (PR-14 audit: a task-registration or queue-push
+        # failure after the charge leaked it forever — the breaker crept
+        # toward its limit and shed traffic a restart couldn't explain)
+        task = None
+        try:
+            task = self.engine.tasks.register(
+                self.TASK_ACTION,
+                description=f"serving search [{entry.get('index')}]",
+                cancellable=True, parent_task_id=parent_task_id)
+            now = time.monotonic()
+            ps = PendingSearch(
+                entry=entry, tenant=tenant,
+                deadline=(now + timeout_s) if timeout_s else None,
+                task=task, est_bytes=est_bytes)
+            # cancelling a QUEUED task removes it from the serving queue
+            # and resolves the caller without a device round-trip
+            # (satellite fix: pre-dispatch cancellation had no path)
+            task.add_cancel_listener(
+                lambda reason, ps=ps: self._cancel_queued(ps, reason))
+            with self._cv:
+                self._tenants.push(ps)
+                self.counters["admitted"] += 1
+                metrics.gauge_set("es.serving.queue_depth",
+                                  self._tenants.depth)
+                self._cv.notify_all()
+        except BaseException:
+            self.engine.breakers.release("in_flight_requests", est_bytes)
+            with self._lock:
+                self._reserved_bytes -= est_bytes
+            if task is not None:
+                self.engine.tasks.unregister(task)
+            raise
         self._ensure_threads()
         return ps.future
 
@@ -238,6 +273,8 @@ class ServingService:
 
     def _terminal(self, ps: PendingSearch):
         self.engine.breakers.release("in_flight_requests", ps.est_bytes)
+        with self._lock:
+            self._reserved_bytes -= ps.est_bytes
         if ps.task is not None:
             self.engine.tasks.unregister(ps.task)
 
@@ -401,9 +438,11 @@ class ServingService:
                 continue
             if state is None:
                 return
+            from ..common import faults
             from ..telemetry import collect_profile_events
 
             try:
+                faults.check("serving.wave", n=state["n"])
                 with collect_profile_events() as events:
                     for idx, _members, job in state["jobs"]:
                         # engine-state-free device pull: overlaps the
@@ -467,6 +506,23 @@ class ServingService:
         from ..telemetry import collect_profile_events, metrics
 
         err = state.get("fetch_error")
+        if err is not None:
+            # the wave's DEVICE stage died (injected serving.wave fault,
+            # real device failure): degrade to per-member SOLO re-runs so
+            # one poisoned wave costs its members a slower path, not an
+            # error — and a device OOM additionally runs the staged
+            # degradation before the re-runs
+            from ..common.resilience import (is_device_oom,
+                                             node_resilience)
+
+            if is_device_oom(err):
+                try:
+                    self.engine.device_degradation.on_oom(err, "wave")
+                except Exception:  # noqa: BLE001 - rescue must proceed
+                    pass
+            node_resilience(getattr(
+                self.engine.tasks, "node", "node-0")).count("wave_rescues")
+            metrics.counter_inc("es.serving.wave_rescues")
         wave_tr = {"dispatch": 0, "fetch": 0}
         lanes = {"generic": 0, "term": 0, "tiered": 0,
                  "fallback_solo": state.get("fallback_solo", 0)}
@@ -475,7 +531,7 @@ class ServingService:
         with collect_profile_events() as fin_events:
             for idx, members, job in state["jobs"]:
                 if err is not None:
-                    results = [err] * len(members)
+                    results = self._rescue_solo(members)
                 else:
                     results = idx.search_wave_finish(job)
                 for ps, res in zip(members, results):
@@ -519,6 +575,39 @@ class ServingService:
         metrics.histogram_record("es.serving.wave_size", state["n"])
         self._record_flight(state, t_complete, wave_tr, lanes, occ,
                             indices, err)
+
+    def _rescue_solo(self, members) -> list:
+        """Re-run a poisoned wave's members one by one on the classic
+        engine path (engine thread — _wave_finish runs there). Members
+        whose re-run also fails carry their exception; the rest get real
+        results. Counted per wave in `wave_rescues`."""
+        out = []
+        for ps in members:
+            try:
+                out.append(self.engine.search_multi(
+                    ps.entry.get("expression"),
+                    ignore_unavailable=ps.entry.get("iu", False),
+                    allow_no_indices=ps.entry.get("ani", True),
+                    **ps.entry["kwargs"]))
+            except Exception as ex:  # noqa: BLE001 - per-member envelope
+                out.append(ex)
+        return out
+
+    def record_degradation(self, event: dict) -> None:
+        """Stamp a device-degradation event into the flight recorder ring
+        (PR 14): the black box must show WHEN the degradation happened
+        relative to the waves around it. The record shares the ring and
+        the wave sequence so dumps/pruning treat it uniformly."""
+        with self._lock:
+            self._wave_seq += 1
+            self._flight.append({
+                "wave": self._wave_seq,
+                "@timestamp": _iso_utc(),
+                "node": getattr(self.engine.tasks, "node", "node-0"),
+                "kind": "degradation",
+                "degradation": {k: v for k, v in event.items()
+                                if k != "ts"},
+            })
 
     # ---- flight recorder -------------------------------------------------
 

@@ -147,6 +147,18 @@ class TransportService:
     ) -> None:
         rid = self._next_request_id
         self._next_request_id += 1
+        # fault injection BEFORE registering the pending handler: an
+        # injected send fault behaves exactly like a connect failure —
+        # surfaced asynchronously through on_failure (never raised into
+        # the caller's frame, which may be mid-fan-out)
+        from ..common import faults
+
+        try:
+            faults.check("transport.send", peer=to_node, action=action)
+        except Exception as ex:  # noqa: BLE001 - injected fault classes
+            err = ex  # `ex` unbinds at block exit; the deferred call needs it
+            self.network.schedule(0.0, lambda: on_failure(err))
+            return
         self._pending[rid] = ResponseHandler(on_response, on_failure)
         if timeout is not None:
             self.network.schedule(

@@ -76,6 +76,22 @@ class ActionRequestValidationError(ElasticsearchTpuError):
         super().__init__(f"Validation Failed: {joined};")
 
 
+class SearchPhaseExecutionError(ElasticsearchTpuError):
+    """Shard failures that the request is not allowed to absorb as
+    partial results (all shards failed, or
+    allow_partial_search_results=false) — the reference's
+    SearchPhaseExecutionException, rendered 503 with the per-shard
+    failure list in the envelope."""
+
+    status = 503
+    type = "search_phase_execution_exception"
+
+    def __init__(self, reason: str = "", failures: list | None = None):
+        super().__init__(
+            reason, **({"failed_shards": failures} if failures else {}))
+        self.failures = failures or []
+
+
 class ResourceNotFoundError(ElasticsearchTpuError):
     status = 404
     type = "resource_not_found_exception"

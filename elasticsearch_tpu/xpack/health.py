@@ -293,6 +293,65 @@ def _indexing_indicator(engine) -> dict:
             "details": details}
 
 
+def _resilience_indicator(engine) -> dict:
+    """Data-plane resilience (PR 14): open per-peer circuit breakers
+    (a peer is being routed around — the fan-out is degraded to the
+    surviving copies) and active device degradation (serving waves are
+    halved while the recovery ramp runs). Both are YELLOW: the node is
+    serving, but below its configured shape."""
+    from ..common.resilience import resilience_stats
+
+    st = resilience_stats()
+    deg = engine._device_degradation
+    degraded = deg is not None and deg.degraded
+    open_peers = sorted({p for s in st["nodes"].values()
+                         for p in s["open_circuits"]})
+    counters: dict[str, int] = {}
+    for s in st["nodes"].values():
+        for k, v in s["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+    details = {"open_circuits": open_peers,
+               "device_degraded": degraded,
+               "counters": counters}
+    if open_peers or degraded:
+        symptoms = []
+        diagnoses = []
+        if open_peers:
+            symptoms.append(
+                f"circuit breakers are open for peers {open_peers}")
+            diagnoses.append(_diagnosis(
+                "consecutive transport failures tripped the per-peer "
+                "circuit; fan-out requests fail over to surviving "
+                "copies and the peer is probed after the cooldown",
+                "check the named peers' processes/network; the circuit "
+                "closes itself once a half-open probe succeeds",
+                open_peers))
+        if degraded:
+            symptoms.append(
+                "device degradation active (serving.max_wave halved "
+                "after a RESOURCE_EXHAUSTED; recovery ramp running)")
+            diagnoses.append(_diagnosis(
+                "a device allocation failure triggered the staged "
+                "degradation (caches evicted, wave halved)",
+                "inspect the flight recorder's degradation records and "
+                "HBM gauges; the ramp restores serving.max_wave "
+                "automatically", []))
+        return {
+            "status": YELLOW,
+            "symptom": "; ".join(symptoms),
+            "details": details,
+            "impacts": [_impact(
+                "reads are served from fewer copies / smaller waves; "
+                "latency and redundancy are degraded until recovery",
+                severity=2, areas=["search", "availability"])],
+            "diagnosis": diagnoses,
+        }
+    return {"status": GREEN,
+            "symptom": ("All peer circuits closed, no active device "
+                        "degradation"),
+            "details": details}
+
+
 def _slo_indicator(engine) -> dict:
     ev = engine.slo.current()
     if not ev["enabled"]:
@@ -396,6 +455,7 @@ def health_report(engine) -> dict:
     add("hbm", _hbm_indicator)
     add("kernel_utilization", _kernel_indicator)
     add("serving_backpressure", _serving_indicator)
+    add("data_plane_resilience", _resilience_indicator)
     add("indexing", _indexing_indicator)
     add("slo_compliance", _slo_indicator)
     add("watcher", _watcher_indicator)

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Chaos acceptance loop (PR 14): a seeded fault schedule over a
+closed-loop request run, asserting the resilience contract end to end.
+
+Two stages, both deterministic (seeded schedules, fixed corpora):
+
+  Stage A — cluster scatter/gather: a 3-node deterministic-transport
+  cluster with a replicated index runs searches under a 10%
+  transport-fault schedule on the shard-search action. Every response
+  must be either complete, valid-partial (consistent `_shards`
+  accounting, surviving rows only), or a clean all-shards-failed error
+  envelope; the run must not hang (virtual-time budget) or crash.
+
+  Stage B — single-engine REST closed loop: 200 requests against the
+  full aiohttp surface with per-index shard faults, ONE injected device
+  OOM, and a shed-inducing queue, asserting every HTTP response is
+  200-with-honest-_shards or 429/503 with Retry-After, rank parity of
+  surviving shards against a no-fault oracle, the degradation event in
+  the flight recorder, and zero leaked in_flight_requests reservations.
+
+Exit 0 = contract held. Any violation raises (non-zero exit).
+Run by scripts/chaos_gate.sh (advisory stage of tier1_gate.sh).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SEED = int(os.environ.get("ES_TPU_CHAOS_SEED", "14"))
+N_REQUESTS = int(os.environ.get("ES_TPU_CHAOS_REQUESTS", "200"))
+
+
+def stage_a_cluster() -> dict:
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.transport import (
+        DeterministicTaskQueue, LocalTransportNetwork,
+    )
+
+    queue = DeterministicTaskQueue(SEED)
+    net = LocalTransportNetwork(queue)
+    ids = [f"node-{i}" for i in range(3)]
+    nodes = {nid: ClusterNode(nid, ids, net) for nid in ids}
+    for n in nodes.values():
+        n.start()
+    queue.run_for(60, max_tasks=500_000)
+
+    acks = []
+    master = next(n for n in nodes.values()
+                  if n.coordinator.mode == "LEADER")
+    master.create_index(
+        "chaos", {"properties": {"body": {"type": "text"}}},
+        {"number_of_shards": 3, "number_of_replicas": 1},
+        on_done=acks.append)
+    queue.run_for(120, max_tasks=500_000)
+    assert acks and acks[0]["acknowledged"], acks
+    out = []
+    nodes["node-0"].client_bulk(
+        "chaos", [("index", f"c{i}", {"body": f"stormy weather {i}"})
+                  for i in range(24)], out.append)
+    queue.run_for(60, max_tasks=500_000)
+    assert out and not out[0]["errors"], out
+
+    # 10% transport faults on the shard-search fan-out (seeded)
+    faults.configure(
+        "transport.send:p=0.1,error=connect,match=read/search[shard]",
+        seed=SEED)
+    body = {"query": {"match": {"body": "stormy"}}}
+    outcomes = {"complete": 0, "partial": 0, "failed": 0}
+    for i in range(60):
+        coord = nodes[ids[i % 3]]
+        res = []
+        coord.client_search("chaos", body, res.append, size=24)
+        queue.run_for(90, max_tasks=500_000)
+        assert res, f"request {i} HUNG (no response inside the budget)"
+        r = res[0]
+        if r.get("error"):
+            # only the all-shards-failed shape is an acceptable error
+            assert "failed" in str(r["error"]), r
+            outcomes["failed"] += 1
+            continue
+        sh = r["_shards"]
+        assert sh["successful"] + sh["failed"] == sh["total"], sh
+        if sh["failed"]:
+            assert sh["failures"], sh
+            for f in sh["failures"]:
+                assert f.get("shard") is not None and f.get("reason"), f
+            outcomes["partial"] += 1
+        else:
+            assert r["hits"]["total"]["value"] == 24, r["hits"]["total"]
+            outcomes["complete"] += 1
+        for h in r["hits"]["hits"]:
+            assert h["_source"]["body"].startswith("stormy")
+    st = faults.stats()
+    faults.clear()
+    assert st["points"]["transport.send"]["fired"] >= 1, st
+    assert outcomes["complete"] >= 1, outcomes
+    return {"outcomes": outcomes,
+            "transport_faults_fired": st["points"]["transport.send"]["fired"]}
+
+
+async def _stage_b_async(tmp: str) -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.rest import make_app
+    from elasticsearch_tpu.serving import reservation_leaks
+
+    app = make_app(data_path=os.path.join(tmp, "data"))
+    engine = app["engine"]
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        for name in ("steady", "flaky"):
+            r = await client.put(f"/{name}", json={"mappings": {
+                "properties": {"body": {"type": "text"}}}})
+            assert r.status == 200, await r.text()
+            bulk = "".join(
+                json.dumps({"index": {"_id": f"{name}{i}"}}) + "\n"
+                + json.dumps({"body": f"shared term {name} {i}"}) + "\n"
+                for i in range(8))
+            r = await client.post(
+                f"/{name}/_bulk?refresh=true", data=bulk,
+                headers={"Content-Type": "application/x-ndjson"})
+            assert r.status == 200 and not (await r.json())["errors"]
+        r = await client.put("/_cluster/settings", json={"transient": {
+            "serving.enabled": True}})
+        assert r.status == 200
+
+        q = {"query": {"match": {"body": "shared"}}, "size": 16}
+        oracle = await (await client.post("/steady,flaky/_search",
+                                          json=q)).json()
+        assert oracle["_shards"]["failed"] == 0
+        steady_rows = [h for h in oracle["hits"]["hits"]
+                       if h["_index"] == "steady"]
+
+        # the acceptance schedule: 10% shard faults on one "peer"
+        # (the flaky index's shards) + ONE injected device OOM
+        faults.configure(
+            "shard.search:p=0.1,error=error,match=flaky;"
+            "device.dispatch:once=1,error=oom", seed=SEED)
+        statuses = {200: 0, 429: 0, 503: 0}
+        partials = 0
+        for i in range(N_REQUESTS):
+            if i == N_REQUESTS // 2:
+                # the OOM rides a classic-path dispatch (profile pins it)
+                r = await client.post("/steady/_search", json={
+                    **q, "profile": True})
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["hits"]["total"]["value"] == 8
+                continue
+            r = await client.post("/steady,flaky/_search", json=q)
+            body = await r.json()
+            assert r.status in statuses, (r.status, body)
+            statuses[r.status] += 1
+            if r.status in (429, 503):
+                # clean shed/failure: the ES error envelope, and 429s
+                # carry Retry-After
+                assert body.get("error", {}).get("type"), body
+                if r.status == 429:
+                    assert "Retry-After" in r.headers, dict(r.headers)
+                continue
+            sh = body["_shards"]
+            assert sh["successful"] + sh["failed"] == sh["total"], sh
+            if sh["failed"]:
+                partials += 1
+                assert all(f["index"] == "flaky"
+                           for f in sh["failures"]), sh
+                # surviving-shard rank parity vs the no-fault oracle
+                assert body["hits"]["hits"] == steady_rows, \
+                    "surviving-shard rows diverged from the oracle"
+            else:
+                assert body["hits"]["hits"] == oracle["hits"]["hits"]
+        st = faults.stats()
+        faults.clear()
+        assert st["points"]["shard.search"]["fired"] >= 1, st
+        assert st["points"]["device.dispatch"]["fired"] == 1, st
+        assert partials >= 1, "the schedule never produced a partial"
+
+        # the degradation left its evidence: flight recorder + stats
+        r = await client.get("/_serving/flight_recorder")
+        waves = (await r.json())["waves"]
+        assert any(w.get("kind") == "degradation" for w in waves), \
+            "device OOM left no flight-recorder record"
+        r = await client.get("/_nodes/stats")
+        res = (await r.json())["nodes"]["node-0"]["resilience"]
+        assert res["device"]["recent_events"], res
+        engine.device_degradation.recover_now()
+        assert engine.serving.max_wave == int(
+            engine.settings.get("serving.max_wave"))
+        leaks = reservation_leaks()
+        assert not leaks, f"breaker reservations leaked: {leaks}"
+        return {"statuses": {str(k): v for k, v in statuses.items()},
+                "partials": partials,
+                "faults": st["points"]}
+    finally:
+        await client.close()
+
+
+def stage_b_engine() -> dict:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="es_tpu_chaos_")
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(_stage_b_async(tmp))
+    finally:
+        loop.close()
+
+
+def main() -> int:
+    print(f"[chaos] seed={SEED} requests={N_REQUESTS}")
+    a = stage_a_cluster()
+    print(f"[chaos] stage A (cluster scatter/gather): {a}")
+    b = stage_b_engine()
+    print(f"[chaos] stage B (engine closed loop): {b}")
+    print("[chaos] contract held: no hangs, no crashes, every response "
+          "complete / valid-partial / clean 429-503")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

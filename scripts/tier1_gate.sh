@@ -41,6 +41,18 @@ echo "[tier1-gate] multichip pjit parity"
 JAX_PLATFORMS=cpu timeout -k 10 300 python scripts/multichip_dryrun.py \
     || exit 1
 
+# chaos gate (PR 14, ADVISORY): the closed-loop acceptance run under a
+# seeded fault schedule (transport flakes + one device OOM) plus a
+# tier-1 subset with ES_TPU_FAULTS exported — proves the resilience
+# contract (no hangs, no crashes, valid-partial or clean 429/503) holds
+# on every change. Advisory while the fleet calibrates; flip the `||`
+# into `exit 1` to enforce.
+echo "[tier1-gate] chaos gate (advisory)"
+bash scripts/chaos_gate.sh "${SEED}" \
+    || echo "[tier1-gate] ADVISORY: chaos gate red (seed=${SEED}) —" \
+            "the resilience contract regressed; reproduce with" \
+            "scripts/chaos_gate.sh ${SEED}"
+
 # bench-regression lint (PR 9): when two or more BENCH_r*.json records
 # exist, diff the newest pair per config (QPS, latency pcts, per-kernel
 # mfu/bw_util) and fail on >20% regression. CPU-smoke records are

@@ -129,6 +129,23 @@ def _module_hygiene():
     from elasticsearch_tpu import serving as _serving
 
     _serving.reset_all_for_tests()
+    # in_flight_requests reservation audit (PR 14): after the drain above
+    # every serving service must have released what it charged — a
+    # rejected/terminal path that kept its breaker reservation is a slow
+    # leak that would shed traffic modules later, far from its source
+    leaks = _serving.reservation_leaks()
+    assert not leaks, (
+        f"serving services leaked in_flight_requests reservations: {leaks}")
+    # fault-injection hygiene: a schedule installed by one module's REST
+    # toggle / configure() must never fire into the next module's
+    # engines; an ENV schedule (the chaos gate's ES_TPU_FAULTS) re-arms
+    # fresh so its seeded streams restart per module
+    from elasticsearch_tpu.common import faults as _faults
+    from elasticsearch_tpu.common import resilience as _resilience
+
+    _faults.clear()
+    _faults.configure_from_env()
+    _resilience.reset_for_tests()
     # likewise the persistent-task tickers (scheduled watches, PR 9):
     # a leaked ticker thread would keep firing watches into the next
     # module's engines and race the metrics reset below

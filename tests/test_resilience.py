@@ -1,0 +1,708 @@
+"""Fault-tolerant data plane (PR 14): deterministic fault injection,
+shard/replica failover with honest partial results, per-peer circuit
+breakers, and device-failure graceful degradation.
+
+Every resilience claim is driven by an injected fault — the
+`common/faults.py` schedules make the failure paths as deterministic as
+the success paths. The deterministic 3-node cluster (the
+test_replication DataCluster) supplies the kill-a-node-mid-search e2e;
+the aiohttp test client drives the single-engine REST surface."""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import os
+import re
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import faults, resilience
+from elasticsearch_tpu.transport.base import (
+    ConnectTransportError, ReceiveTimeoutError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """These tests install their own exact schedules — an ambient env
+    schedule (the chaos gate's ES_TPU_FAULTS) is suspended for the
+    test's duration and re-armed after, so fired-count assertions stay
+    exact under the gate too."""
+    faults.clear()
+    resilience.reset_for_tests()
+    yield
+    faults.clear()
+    faults.configure_from_env()
+    resilience.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# fault plan unit behavior
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_schedules_are_deterministic():
+    fired = []
+    for _round in range(2):
+        plan = faults.FaultPlan("shard.search:p=0.5,error=error", seed=7)
+        pattern = []
+        for _ in range(32):
+            try:
+                plan.maybe_fire("shard.search", {"index": "i"})
+                pattern.append(0)
+            except faults.InjectedFault:
+                pattern.append(1)
+        fired.append(pattern)
+    assert fired[0] == fired[1]  # same seed -> identical firing sequence
+    assert 0 < sum(fired[0]) < 32  # p=0.5 actually mixes
+    # a different seed diverges
+    plan2 = faults.FaultPlan("shard.search:p=0.5,error=error", seed=8)
+    pattern2 = []
+    for _ in range(32):
+        try:
+            plan2.maybe_fire("shard.search", {"index": "i"})
+            pattern2.append(0)
+        except faults.InjectedFault:
+            pattern2.append(1)
+    assert pattern2 != fired[0]
+
+
+def test_fault_plan_nth_once_match_and_error_classes():
+    plan = faults.FaultPlan(
+        "transport.send:nth=2,error=connect,match=peer-b;"
+        "device.dispatch:once=1,error=oom;"
+        "cluster.node_call:error=timeout", seed=0)
+    # match filter: peer-a calls are never eligible
+    for _ in range(5):
+        plan.maybe_fire("transport.send", {"peer": "peer-a"})
+    plan.maybe_fire("transport.send", {"peer": "peer-b"})  # eligible #1
+    with pytest.raises(ConnectTransportError):
+        plan.maybe_fire("transport.send", {"peer": "peer-b"})  # the nth=2
+    plan.maybe_fire("transport.send", {"peer": "peer-b"})  # exhausted
+    # once: first call only, and the OOM carries the XLA marker
+    with pytest.raises(faults.InjectedDeviceOOM) as ei:
+        plan.maybe_fire("device.dispatch", {})
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert resilience.is_device_oom(ei.value)
+    plan.maybe_fire("device.dispatch", {})
+    # bare rule fires every time with the mapped class
+    with pytest.raises(ReceiveTimeoutError):
+        plan.maybe_fire("cluster.node_call", {})
+    st = plan.stats()
+    assert st["points"]["transport.send"]["fired"] == 1
+    assert st["points"]["device.dispatch"]["fired"] == 1
+    with pytest.raises(ValueError):
+        faults.FaultPlan("not.a.point:p=1")
+
+
+def test_check_is_noop_when_disabled():
+    assert not faults.enabled()
+    faults.check("shard.search", index="x")  # no plan: must not raise
+    faults.configure("shard.search:error=error")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("shard.search", index="x")
+    faults.clear()
+    faults.check("shard.search", index="x")
+
+
+# ---------------------------------------------------------------------------
+# retry policy + circuit breaker units
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_backoff_and_deadline():
+    pol = resilience.RetryPolicy(max_attempts=4, base_s=0.05, salt=3)
+    d = [pol.delay(i) for i in range(4)]
+    assert d == [pol.delay(i) for i in range(4)]  # deterministic
+    assert all(x > 0 for x in d)
+    # exponential envelope: raw doubles, jitter stays within [0.5, 1.0)
+    for i, x in enumerate(d[:3]):
+        raw = 0.05 * (2 ** i)
+        assert raw * 0.5 <= x < raw
+    assert pol.should_retry(0) and pol.should_retry(2)
+    assert not pol.should_retry(3)  # attempt budget exhausted
+    # a deadline the retry cannot meet forbids it
+    tight = resilience.RetryPolicy(max_attempts=4, base_s=10.0,
+                                   deadline_s=0.01)
+    assert not tight.should_retry(0)
+
+
+def test_peer_breaker_trip_halfopen_close_cycle():
+    transitions = []
+    b = resilience.PeerBreaker(
+        "n2", threshold=3, cooldown_s=0.05,
+        on_transition=lambda p, o, n, r: transitions.append((o, n)))
+    for _ in range(2):
+        b.record_failure("boom")
+    assert b.state == resilience.CLOSED and b.allow_request()
+    b.record_failure("boom")  # third consecutive: trip
+    assert b.state == resilience.OPEN and b.trips == 1
+    assert not b.allow_request()  # fast-fail inside the cooldown
+    time.sleep(0.06)
+    assert b.allow_request()  # the half-open probe
+    assert b.state == resilience.HALF_OPEN
+    assert not b.allow_request()  # only ONE probe
+    b.record_failure("still down")  # probe failed: re-open
+    assert b.state == resilience.OPEN
+    time.sleep(0.06)
+    assert b.allow_request()
+    b.record_success()
+    assert b.state == resilience.CLOSED and b.allow_request()
+    assert (resilience.CLOSED, resilience.OPEN) in transitions
+    assert (resilience.HALF_OPEN, resilience.CLOSED) in transitions
+
+
+# ---------------------------------------------------------------------------
+# tier-1 lint: fan-out/dispatch sites <-> registered fault points
+# ---------------------------------------------------------------------------
+
+_FAULT_CHECK_RE = re.compile(r'faults\.check\(\s*\n?\s*"([^"]+)"')
+
+
+def _fault_check_sites():
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "elasticsearch_tpu")
+    names: dict[str, list[str]] = {}
+    for path in glob.glob(os.path.join(root, "**", "*.py"), recursive=True):
+        if path.endswith(os.path.join("common", "faults.py")):
+            continue  # the registry itself (docstring examples)
+        src = open(path, encoding="utf-8").read()
+        for m in _FAULT_CHECK_RE.finditer(src):
+            names.setdefault(m.group(1), []).append(
+                os.path.relpath(path, root))
+    return names
+
+
+def test_every_fault_point_has_a_site_and_every_site_is_registered():
+    """The dispatch-site lint extended to failure paths (the PR-5
+    KERNEL_COSTS pattern): a fan-out or device dispatch site cannot ship
+    without a registered fault point, and a registered point that lost
+    its last site should be deleted with it."""
+    sites = _fault_check_sites()
+    assert sites, "fault-site scan found nothing — regex rotted?"
+    unregistered = {n: f for n, f in sites.items()
+                    if n not in faults.FAULT_POINTS}
+    assert not unregistered, (
+        f"faults.check sites with unregistered point names: {unregistered}"
+        " — add them to common/faults.FAULT_POINTS")
+    missing = [p for p in faults.FAULT_POINTS if p not in sites]
+    assert not missing, (
+        f"registered fault points with NO injection site: {missing} — "
+        "every fan-out/dispatch site must carry its point")
+    # the load-bearing fan-out sites specifically
+    for point, fragment in [
+        ("transport.send", "transport/base.py"),
+        ("shard.search", "cluster/node.py"),
+        ("shard.search", "engine/engine.py"),
+        ("cluster.node_call", "cluster/http.py"),
+        ("device.dispatch", "engine/engine.py"),
+        ("device.fetch", "parallel/sharded.py"),
+        ("serving.wave", "serving/service.py"),
+        ("refresh.build", "engine/engine.py"),
+    ]:
+        assert any(fragment in f for f in sites[point]), (point, sites)
+
+
+# ---------------------------------------------------------------------------
+# single-engine REST: honest partial results + allow_partial semantics
+# ---------------------------------------------------------------------------
+
+def _run_scenario(tmp_path, scenario):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.rest import make_app
+
+    async def wrapper():
+        app = make_app(data_path=str(tmp_path / "data"))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await scenario(client, app["engine"])
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(wrapper())
+    finally:
+        loop.close()
+
+
+async def _seed_two_indices(c):
+    for name in ("left", "right"):
+        r = await c.put(f"/{name}", json={"mappings": {"properties": {
+            "body": {"type": "text"}}}})
+        assert r.status == 200
+        bulk = "".join(
+            json.dumps({"index": {"_id": f"{name}{i}"}}) + "\n"
+            + json.dumps({"body": f"common token {name} {i}"}) + "\n"
+            for i in range(4))
+        r = await c.post(f"/{name}/_bulk?refresh=true", data=bulk,
+                         headers={"Content-Type": "application/x-ndjson"})
+        assert r.status == 200 and not (await r.json())["errors"]
+
+
+def test_partial_results_and_allow_partial_semantics(tmp_path):
+    async def scenario(c, engine):
+        await _seed_two_indices(c)
+        # no-fault oracle over both indices
+        q = {"query": {"match": {"body": "common"}}, "size": 20}
+        r = await c.post("/left,right/_search", json=q)
+        oracle = await r.json()
+        assert r.status == 200 and oracle["_shards"]["failed"] == 0
+        assert oracle["hits"]["total"]["value"] == 8
+
+        # REST toggle installs the schedule: every shard.search on
+        # [right] fails; [left] survives
+        r = await c.post("/_fault_injection", json={
+            "spec": "shard.search:error=error,match=right", "seed": 1})
+        assert r.status == 200
+        r = await c.post("/left,right/_search", json=q)
+        body = await r.json()
+        assert r.status == 200, body
+        sh = body["_shards"]
+        assert sh["failed"] == 1 and sh["successful"] == sh["total"] - 1
+        assert sh["failures"][0]["index"] == "right"
+        assert sh["failures"][0]["node"]
+        # surviving-shard parity: [left] hits byte-identical to the
+        # oracle's [left] subset
+        left_oracle = [h for h in oracle["hits"]["hits"]
+                       if h["_index"] == "left"]
+        assert body["hits"]["hits"] == left_oracle
+        assert body["hits"]["total"]["value"] == 4
+
+        # allow_partial_search_results=false (body) -> 503 with failures
+        r = await c.post("/left,right/_search", json={
+            **q, "allow_partial_search_results": False})
+        assert r.status == 503
+        err = await r.json()
+        assert err["error"]["type"] == "search_phase_execution_exception"
+        # ... and via the query param
+        r = await c.post(
+            "/left,right/_search?allow_partial_search_results=false",
+            json=q)
+        assert r.status == 503
+        # ... and via the dynamic cluster default
+        r = await c.put("/_cluster/settings", json={"transient": {
+            "search.default_allow_partial_results": False}})
+        assert r.status == 200
+        r = await c.post("/left,right/_search", json=q)
+        assert r.status == 503
+        # explicit true in the body overrides the cluster default
+        r = await c.post("/left,right/_search", json={
+            **q, "allow_partial_search_results": True})
+        assert r.status == 200
+        await c.put("/_cluster/settings", json={"transient": {
+            "search.default_allow_partial_results": None}})
+
+        # every target failing is never partial — 503 regardless
+        r = await c.post("/_fault_injection", json={
+            "spec": "shard.search:error=error"})
+        assert r.status == 200
+        r = await c.post("/left,right/_search", json=q)
+        assert r.status == 503
+        # schedule stats prove the faults fired
+        r = await c.get("/_fault_injection")
+        st = await r.json()
+        assert st["enabled"] and st["points"]["shard.search"]["fired"] >= 1
+        r = await c.delete("/_fault_injection")
+        assert (await r.json())["acknowledged"]
+        r = await c.post("/left,right/_search", json=q)
+        assert (await r.json())["_shards"]["failed"] == 0
+
+    _run_scenario(tmp_path, scenario)
+
+
+def test_count_and_refresh_shards_derive_from_outcome(tmp_path):
+    async def scenario(c, engine):
+        await _seed_two_indices(c)
+        faults.configure("shard.search:error=error,match=right")
+        r = await c.post("/left,right/_count", json={})
+        body = await r.json()
+        assert r.status == 200
+        assert body["count"] == 4  # the surviving index's docs
+        assert body["_shards"]["failed"] == 1
+        assert body["_shards"]["failures"][0]["index"] == "right"
+        faults.clear()
+
+        # refresh: a thrown per-index refresh becomes a failures[] entry
+        # (was unconditionally failed: 0)
+        r = await c.post("/left/_doc/x?refresh=false",
+                         json={"body": "fresh doc"})
+        assert r.status in (200, 201)
+        faults.configure("refresh.build:error=error,match=left")
+        r = await c.post("/_refresh")
+        body = await r.json()
+        assert r.status == 200
+        sh = body["_shards"]
+        assert sh["failed"] == 1 and sh["successful"] == sh["total"] - 1
+        assert sh["failures"][0]["index"] == "left"
+        faults.clear()
+        r = await c.post("/_refresh")
+        assert (await r.json())["_shards"]["failed"] == 0
+
+    _run_scenario(tmp_path, scenario)
+
+
+# ---------------------------------------------------------------------------
+# device-failure graceful degradation
+# ---------------------------------------------------------------------------
+
+def test_device_oom_staged_degradation_and_recovery(tmp_path):
+    async def scenario(c, engine):
+        await _seed_two_indices(c)
+        r = await c.put("/_cluster/settings", json={"transient": {
+            "serving.enabled": True}})
+        assert r.status == 200
+        configured = int(engine.settings.get("serving.max_wave"))
+        assert engine.serving.max_wave == configured
+
+        # one injected RESOURCE_EXHAUSTED at the dispatch site; the
+        # search must SUCCEED via the staged response + exact-arm rerun
+        faults.configure("device.dispatch:once=1,error=oom")
+        q = {"query": {"match": {"body": "common"}}, "size": 10,
+             "profile": True}  # profile pins the classic (non-wave) path
+        r = await c.post("/left/_search", json=q)
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["hits"]["total"]["value"] == 4
+        assert faults.stats()["points"]["device.dispatch"]["fired"] == 1
+
+        # stage 2 observable: serving.max_wave halved, ramp armed
+        assert engine.serving.max_wave == max(1, configured // 2)
+        deg = engine.device_degradation
+        assert deg.degraded
+        st = deg.stats()
+        assert st["recent_events"] and \
+            st["recent_events"][-1]["kind"] == "device_degradation"
+
+        # the degradation event is stamped into the flight recorder ring
+        r = await c.get("/_serving/flight_recorder")
+        waves = (await r.json())["waves"]
+        assert any(w.get("kind") == "degradation" for w in waves)
+
+        # ... and into _nodes/stats resilience + health indicator
+        r = await c.get("/_nodes/stats")
+        res = (await r.json())["nodes"]["node-0"]["resilience"]
+        assert res["device"]["degraded"] is True
+        counters = {}
+        for s in res["nodes"].values():
+            for k, v in s["counters"].items():
+                counters[k] = counters.get(k, 0) + v
+        assert counters.get("device_degradations", 0) >= 1
+        r = await c.get("/_health_report")
+        ind = (await r.json())["indicators"]["data_plane_resilience"]
+        assert ind["status"] == "yellow"
+        assert ind["details"]["device_degraded"] is True
+
+        # recovery ramp restores the configured wave
+        deg.recover_now()
+        assert engine.serving.max_wave == configured
+        assert not deg.degraded
+        r = await c.get("/_health_report")
+        ind = (await r.json())["indicators"]["data_plane_resilience"]
+        assert ind["status"] == "green"
+
+    _run_scenario(tmp_path, scenario)
+
+
+def test_device_recovery_reruns_on_exact_arm(tmp_path):
+    """The stage-3 rerun pins the fused/impact arms off for exactly the
+    retry, then restores the routing env."""
+    from elasticsearch_tpu.common.resilience import run_with_device_recovery
+    from elasticsearch_tpu.engine import Engine
+
+    e = Engine(str(tmp_path / "d"))
+    try:
+        calls = []
+
+        def fn():
+            calls.append(os.environ.get("ES_TPU_FUSED"))
+            if len(calls) == 1:
+                raise faults.InjectedDeviceOOM("device.dispatch")
+            return "ok"
+
+        os.environ.pop("ES_TPU_FUSED", None)
+        assert run_with_device_recovery(e, fn, where="dispatch") == "ok"
+        assert calls == [None, "0"]  # retry ran with the exact arm pinned
+        assert os.environ.get("ES_TPU_FUSED") is None  # restored
+        # a non-OOM error propagates untouched, no degradation recorded
+        before = len(e.device_degradation.events)
+        with pytest.raises(ValueError):
+            run_with_device_recovery(
+                e, lambda: (_ for _ in ()).throw(ValueError("x")),
+                where="dispatch")
+        assert len(e.device_degradation.events) == before
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# serving shed path: the breaker reservation must never leak
+# ---------------------------------------------------------------------------
+
+def test_rejected_admission_releases_breaker_reservation(tmp_path):
+    from elasticsearch_tpu.engine import Engine
+
+    e = Engine(str(tmp_path / "d"))
+    try:
+        sv = e.serving
+        est = e.breakers.stats()["in_flight_requests"]
+        base = est["estimated_size_in_bytes"]
+
+        # failure AFTER the breaker charge (task registration explodes):
+        # the reservation must be released on the rejection path
+        orig = e.tasks.register
+
+        def boom(*a, **k):
+            raise RuntimeError("task registry exploded")
+
+        e.tasks.register = boom
+        with pytest.raises(RuntimeError):
+            sv.submit({"index": "i", "kwargs": {}}, est_bytes=4096)
+        e.tasks.register = orig
+        after = e.breakers.stats()["in_flight_requests"]
+        assert after["estimated_size_in_bytes"] == base
+        assert sv._reserved_bytes == 0
+        from elasticsearch_tpu.serving import reservation_leaks
+
+        assert reservation_leaks() == []
+        # the healthy path still balances: submit + drain -> zero held
+        sv.set_enabled(True)
+        fut = sv.submit({"index": "missing", "expression": "missing",
+                         "iu": True, "ani": True, "kwargs": {}},
+                        est_bytes=2048)
+        fut.result(timeout=10.0)
+        assert sv.drain(5.0)
+        assert sv._reserved_bytes == 0
+        assert e.breakers.stats()["in_flight_requests"][
+            "estimated_size_in_bytes"] == base
+    finally:
+        e.close()
+
+
+def test_poisoned_wave_degrades_to_solo_rescue(tmp_path):
+    """An injected serving.wave fault kills one wave's device stage: its
+    members must each get a REAL response via the solo rescue path, not
+    an error for the whole wave."""
+    async def scenario(c, engine):
+        await _seed_two_indices(c)
+        r = await c.put("/_cluster/settings", json={"transient": {
+            "serving.enabled": True}})
+        assert r.status == 200
+        faults.configure("serving.wave:once=1,error=error")
+        q = {"query": {"match": {"body": "common"}}, "size": 10}
+        r = await c.post("/left/_search", json=q)
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["hits"]["total"]["value"] == 4
+        assert faults.stats()["points"]["serving.wave"]["fired"] == 1
+        assert engine.serving.counters.get("completed", 0) >= 1
+
+    _run_scenario(tmp_path, scenario)
+
+
+# ---------------------------------------------------------------------------
+# 3-node cluster e2e: kill a data node mid-search
+# ---------------------------------------------------------------------------
+
+def _cluster_search(c, node, index, body, size=10, allow_partial=True,
+                    seconds=60):
+    out = []
+    node.client_search(index, body, out.append, size=size,
+                       allow_partial=allow_partial)
+    c.run(seconds)
+    assert out, "search did not complete"
+    return out[0]
+
+
+def _data_cluster(monkeypatch):
+    from tests.test_replication import DataCluster
+
+    monkeypatch.setenv("ES_TPU_BREAKER_COOLDOWN_S", "0.2")
+    resilience.reset_for_tests()  # fresh breakers with the test cooldown
+    return DataCluster(3, seed=41)
+
+
+def test_cluster_replica_failover_parity_and_circuit_cycle(monkeypatch):
+    """Cut the coordinator off from the node serving a shard's primary:
+    the coordinator fails over to the in-sync replica and returns
+    failed: 0 with rows byte-identical to the healthy run; repeated
+    failures trip the peer's circuit (fan-outs fast-fail it, health
+    goes yellow naming it); a successful probe after recovery closes
+    it. The cut is coordinator<->victim only, so the master keeps the
+    victim in routing — the coordinator learns exclusively through its
+    own failing requests, the mid-flight-kill shape."""
+    c = _data_cluster(monkeypatch)
+    c.create_index("docs", mappings={"properties": {
+        "body": {"type": "text"}}},
+        settings={"number_of_shards": 3, "number_of_replicas": 1})
+    c.wait_green("docs")
+    resp = c.bulk(c.nodes["node-0"], "docs",
+                  [("index", f"d{i}", {"body": f"red fox {i}"})
+                   for i in range(12)])
+    assert not resp["errors"]
+
+    st = c.master().state
+    master_id = c.master().node_id
+    # a shard whose primary is NOT the master (so the coord<->victim cut
+    # never touches leader checks)
+    victim = shard = None
+    for s_key, assigns in st.routing["docs"].items():
+        p = next(a["node"] for a in assigns if a["primary"])
+        if p != master_id:
+            victim, shard = p, s_key
+            break
+    assert victim is not None, st.routing["docs"]
+    coord_id = next(n for n in c.node_ids
+                    if n not in (victim, master_id))
+    coord = c.nodes[coord_id]
+    body = {"query": {"match": {"body": "red"}}}
+
+    healthy = _cluster_search(c, coord, "docs", body, size=12)
+    assert healthy["_shards"]["failed"] == 0
+    assert healthy["hits"]["total"]["value"] == 12
+
+    # cut coordinator <-> victim only
+    c.net.disconnect(coord_id, victim)
+    c.net.disconnect(victim, coord_id)
+
+    nr = resilience.node_resilience(coord_id)
+    degraded = _cluster_search(c, coord, "docs", body, size=12)
+    # replica-failover parity: failed-primary rows come back identical
+    assert degraded["_shards"]["failed"] == 0, degraded["_shards"]
+    assert degraded["hits"]["hits"] == healthy["hits"]["hits"]
+    assert nr.counters["failovers"] >= 1
+
+    # repeated fan-outs trip the coordinator's breaker for the dead peer
+    for _ in range(4):
+        r = _cluster_search(c, coord, "docs", body, size=12)
+        assert r["_shards"]["failed"] == 0
+    b = nr.breaker(victim)
+    assert b.trips >= 1 and b.state == resilience.OPEN
+    # health indicator names the peer (process-global registry: any
+    # engine in this process reports it)
+    from elasticsearch_tpu.xpack.health import _resilience_indicator
+
+    class _Eng:
+        _device_degradation = None
+
+    ind = _resilience_indicator(_Eng())
+    assert ind["status"] == "yellow"
+    assert victim in ind["details"]["open_circuits"]
+
+    # inside the cooldown the policy layer fast-fails the dead peer —
+    # no network latency is spent on it
+    out = []
+    from elasticsearch_tpu.cluster.node import A_GET
+    from elasticsearch_tpu.common.resilience import resilient_send
+
+    resilient_send(coord.service, nr, victim, A_GET,
+                   {"index": "docs", "shard": int(shard), "id": "d0"},
+                   out.append, out.append, timeout=10.0)
+    assert out and isinstance(out[0], ConnectTransportError)
+    assert "circuit breaker open" in str(out[0])
+    assert nr.counters["fast_fails"] >= 1
+
+    # node back: heal, wait out the cooldown, then drive a probe through
+    # the SAME policy layer the gateway fan-outs use — the success
+    # closes the circuit
+    c.net.heal()
+    time.sleep(0.25)
+    out = []
+    resilient_send(coord.service, nr, victim, A_GET,
+                   {"index": "docs", "shard": int(shard), "id": "d0"},
+                   out.append, out.append, timeout=10.0)
+    c.run(15)
+    assert out, "probe did not complete"
+    assert not isinstance(out[0], Exception), out[0]
+    assert b.state == resilience.CLOSED
+    assert nr.counters["circuit_closes"] >= 1
+    final = _cluster_search(c, coord, "docs", body, size=12)
+    assert final["_shards"]["failed"] == 0
+    assert final["hits"]["hits"] == healthy["hits"]["hits"]
+
+
+def test_cluster_partial_results_without_replicas(monkeypatch):
+    """No replica to fail over to: the coordinator returns honest
+    partial results with the failure attributed to the dead node, and
+    allow_partial_search_results=false fails the request instead."""
+    c = _data_cluster(monkeypatch)
+    c.create_index("solo", mappings={"properties": {
+        "body": {"type": "text"}}},
+        settings={"number_of_shards": 3, "number_of_replicas": 0})
+    c.wait_green("solo")
+    resp = c.bulk(c.nodes["node-0"], "solo",
+                  [("index", f"s{i}", {"body": f"blue sky {i}"})
+                   for i in range(18)])
+    assert not resp["errors"]
+    st = c.master().state
+    body = {"query": {"match": {"body": "blue"}}}
+
+    # find a shard whose single copy lives on a non-coordinator node
+    coord_id = "node-0"
+    victim = next(
+        a["node"]
+        for sh in st.routing["solo"].values() for a in sh
+        if a["node"] != coord_id)
+    victim_shards = [int(s) for s, sh in st.routing["solo"].items()
+                     if any(a["node"] == victim for a in sh)]
+    for other in c.node_ids:
+        if other != victim:
+            c.net.disconnect(other, victim)
+            c.net.disconnect(victim, other)
+
+    res = _cluster_search(c, c.nodes[coord_id], "solo", body, size=18)
+    sh = res["_shards"]
+    assert sh["failed"] == len(victim_shards), sh
+    assert sh["successful"] == sh["total"] - sh["failed"]
+    assert {f["shard"] for f in sh["failures"]} == set(victim_shards)
+    assert all(f["node"] == victim for f in sh["failures"])
+    # the surviving shards' docs are all present
+    assert res["hits"]["total"]["value"] == 18 - sum(
+        1 for i in range(18)
+        if _shard_of(f"s{i}", 3) in victim_shards)
+
+    denied = _cluster_search(c, c.nodes[coord_id], "solo", body,
+                             size=18, allow_partial=False)
+    assert denied.get("error") and denied.get("failures")
+
+
+def _shard_of(doc_id: str, n: int) -> int:
+    from elasticsearch_tpu.cluster.routing import shard_for_id
+
+    return shard_for_id(doc_id, n)
+
+
+def test_transport_send_injection_degrades_cluster_search(monkeypatch):
+    """The transport.send fault point in action: shard-search sends fail
+    by schedule, the scatter/gather absorbs them as failover/partials —
+    no hang, no crash."""
+    c = _data_cluster(monkeypatch)
+    c.create_index("f", mappings={"properties": {
+        "body": {"type": "text"}}},
+        settings={"number_of_shards": 2, "number_of_replicas": 1})
+    c.wait_green("f")
+    resp = c.bulk(c.nodes["node-1"], "f",
+                  [("index", f"x{i}", {"body": f"green grass {i}"})
+                   for i in range(8)])
+    assert not resp["errors"]
+    faults.configure(
+        "transport.send:p=0.5,error=connect,match=read/search[shard]",
+        seed=11)
+    body = {"query": {"match": {"body": "green"}}}
+    # rotate the coordinator: 2 shards x 2 copies over 3 nodes, so at
+    # least one coordinator must reach some shard over the wire
+    for i in range(9):
+        coord = c.nodes[c.node_ids[i % 3]]
+        res = _cluster_search(c, coord, "f", body, size=8)
+        if res.get("error"):
+            continue  # all copies of a shard refused this round
+        sh = res["_shards"]
+        assert sh["successful"] + sh["failed"] == sh["total"]
+        for h in res["hits"]["hits"]:
+            assert h["_source"]["body"].startswith("green")
+    st = faults.stats()
+    assert st["points"]["transport.send"]["checks"] >= 1
+    assert st["points"]["transport.send"]["fired"] >= 1
