@@ -44,39 +44,53 @@ def build_ann(vectors, has_value, nlist: int, tile: int = TILE_LANES):
 
     from .quantize import scalar_quantize_int8
 
+    from ..index.device_build import (ann_tiles_device,
+                                      device_build_enabled,
+                                      use_device_build)
+
     vectors = np.asarray(vectors, np.float32)
     present = np.flatnonzero(has_value)
     if len(present) < 4 * max(nlist, 1) or nlist <= 1:
         return None
     D = vectors.shape[1]
-    # kmeans runs 8 Lloyd iterations as jax matmuls (ops/vector) — the
-    # first write-path stage that is already device-shaped, so its
-    # cost-model MFU is the day-one baseline for the item-2 port
+    # PR 15: the Lloyd loop is ONE jitted device program (matmul+argmin
+    # waves under lax.while_loop — index/device_build.kmeans_device),
+    # replacing the eager per-iteration dispatches that were ~97% of
+    # the r11 ANN build wall; same KERNEL_COSTS entry, basis records it
+    kmeans_basis = "device" if device_build_enabled() else "host_eager"
     with build_stage("build.kmeans", n=len(present), dims=D,
-                     nlist=max(nlist, 1), iters=8):
+                     nlist=max(nlist, 1), iters=8, basis=kmeans_basis):
         centroids, assign = kmeans_ivf(vectors[present], nlist)
     C = centroids.shape[0]
-    order_local = np.argsort(assign, kind="stable")
     sizes = np.bincount(assign, minlength=C)
     L = _round_up(int(sizes.max()), tile)
-    with build_stage("build.ann_tiles", nlist=C, tile=L, dims=D):
-        order = np.full((C, L), -1, np.int32)
-        codes = np.zeros((C, L, D), np.int8)
-        scale = np.zeros((C, L), np.float32)
-        offset = np.zeros((C, L), np.float32)
-        start = 0
-        docids = present[order_local].astype(np.int32)
-        for c in range(C):
-            n = int(sizes[c])
-            if n == 0:
-                continue
-            ids = docids[start:start + n]
-            order[c, :n] = ids
-            q, s, o = scalar_quantize_int8(vectors[ids])
-            codes[c, :n] = q
-            scale[c, :n] = s
-            offset[c, :n] = o
-            start += n
+    tiles_dev = use_device_build(len(present) * D)
+    with build_stage("build.ann_tiles", nlist=C, tile=L, dims=D,
+                     basis="device" if tiles_dev else "host"):
+        if tiles_dev:
+            # lax-sort/segment tile packing + on-device int8 quantize
+            # (byte-identical to the host loop; test_device_build)
+            order, codes, scale, offset = ann_tiles_device(
+                vectors, present.astype(np.int32), assign, C, L)
+        else:
+            order_local = np.argsort(assign, kind="stable")
+            order = np.full((C, L), -1, np.int32)
+            codes = np.zeros((C, L, D), np.int8)
+            scale = np.zeros((C, L), np.float32)
+            offset = np.zeros((C, L), np.float32)
+            start = 0
+            docids = present[order_local].astype(np.int32)
+            for c in range(C):
+                n = int(sizes[c])
+                if n == 0:
+                    continue
+                ids = docids[start:start + n]
+                order[c, :n] = ids
+                q, s, o = scalar_quantize_int8(vectors[ids])
+                codes[c, :n] = q
+                scale[c, :n] = s
+                offset[c, :n] = o
+                start += n
     return {
         "centroids": centroids.astype(np.float32),
         "order": order,

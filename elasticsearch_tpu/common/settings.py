@@ -278,6 +278,16 @@ def default_cluster_settings() -> list[Setting]:
         # per-tenant weighted fair scheduling: "tenantA:4,tenantB:1"
         # (X-Opaque-Id is the tenant identity; unlisted tenants weigh 1)
         Setting("serving.tenant.weights", "", str, dynamic=True),
+        # background DEVICE index merges as the internal `_merge` tenant
+        # (PR 15): the weighted-RR budget a tail-segment fold takes per
+        # wave visit — low so search waves dominate, never zero-starved
+        # (the RR visits every non-empty tenant)
+        Setting("serving.merge.weight", 1.0, Setting.float_, dynamic=True),
+        # LSM tail-segment bound (PR 15): an incremental refresh packs
+        # its new docs as one sealed segment; beyond this many segments
+        # a background fold merges them (the Lucene merge-policy analog)
+        Setting("indexing.tiers.max_segments", 4, Setting.positive_int,
+                dynamic=True),
         # serving-wave flight recorder (PR 12): bounded ring of per-wave
         # segment timings / tenant mix / kernel deltas, dumped to the
         # hidden .flight-recorder-* index by the watcher capture action

@@ -124,21 +124,21 @@ def pinned(engine, ctx: SearchCtx):
 
                 raise IndexNotFoundError(pin.index_name)
             saved.append((idx, idx._searcher, idx.shard_docs, idx._dirty,
-                          idx._tail, idx._tail_shard_docs))
+                          idx._tails, idx._tail_pos))
             idx._searcher = pin.searcher
             idx.shard_docs = pin.shard_docs
-            # the pin predates any current tail tier: hide it so pinned
-            # searches see exactly the snapshot (it is restored after)
-            idx._tail = None
-            idx._tail_shard_docs = []
+            # the pin predates any current tail segments: hide them so
+            # pinned searches see exactly the snapshot (restored after)
+            idx._tails = []
+            idx._tail_pos = {}
             idx._dirty = False  # block _maybe_refresh while pinned
         yield
     finally:
-        for idx, searcher, shard_docs, dirty, tail, tail_docs in saved:
+        for idx, searcher, shard_docs, dirty, tails, tail_pos in saved:
             idx._searcher = searcher
             idx.shard_docs = shard_docs
-            idx._tail = tail
-            idx._tail_shard_docs = tail_docs
+            idx._tails = tails
+            idx._tail_pos = tail_pos
             idx._dirty = dirty
 
 

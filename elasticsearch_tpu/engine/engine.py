@@ -84,6 +84,26 @@ class _DocEntry:
     alive: bool
 
 
+@dataclass
+class _TailSegment:
+    """One sealed LSM tail segment (PR 15): the docs of one incremental
+    refresh packed and shipped as their own immutable searcher. Newer
+    segments supersede older copies via live-bit flips (the same
+    discipline the base tier uses), so refresh cost is proportional to
+    the NEW docs only — the old (base, tail) model rebuilt the whole
+    tail union every refresh. `stats` freezes the segment's field/df
+    statistics at build; the combined scoring stats are the base stats
+    plus every segment's (superseded copies keep counting until a merge
+    folds them out — Lucene's segment-stats behavior, see DIVERGENCES
+    "Device-side builds")."""
+
+    searcher: object            # StackedSearcher
+    shard_docs: list            # routed [(id, source)] per shard
+    pos: dict                   # id -> (shard, docid) within this segment
+    stats: tuple                # (field_stats, global_df) at build
+    nbytes: int = 0
+
+
 class EsIndex:
     def __init__(
         self,
@@ -148,12 +168,17 @@ class EsIndex:
         # hydration's own refresh cannot recurse
         self._hydrate = None
         self.shard_docs: list[list[tuple[str, dict]]] = []
-        # ---- tiered refresh state (Lucene-segment analog: a sealed base
-        # pack + a small tail pack; deletes/updates flip base live bits;
-        # SURVEY §7 hard part #3) ------------------------------------------
-        self._tail: StackedSearcher | None = None
-        self._tail_shard_docs: list[list[tuple[str, dict]]] = []
+        # ---- LSM tiered refresh state (PR 15; Lucene-segment analog: a
+        # sealed base pack + N sealed tail segments; deletes/updates flip
+        # live bits in whichever tier holds the old copy; background
+        # merges fold segments — SURVEY §7 hard part #3) -------------------
+        self._tails: list[_TailSegment] = []
         self._tail_docs: dict[str, dict] = {}  # id -> source, not in base
+        # id -> (segment ordinal, shard, docid): where the newest
+        # out-of-base copy lives, so an update/delete flips exactly one
+        # older segment's live bit (rebuilt on merge)
+        self._tail_pos: dict[str, tuple[int, int, int]] = {}
+        self._merge_inflight = False  # a background fold is queued/running
         self._base_pos: dict[str, tuple[int, int]] = {}  # id -> (shard, docid)
         self._base_stats: tuple[dict, dict] | None = None  # at base build
         self._base_nbytes = 0
@@ -499,6 +524,47 @@ class EsIndex:
     # ---- refresh / search ------------------------------------------------
 
     @property
+    def _tail(self):
+        """Compat view of the LSM segment list: the newest tail segment's
+        searcher (None = fully merged). Assigning None clears every
+        segment (snapshot restore / PIT paths)."""
+        return self._tails[-1].searcher if self._tails else None
+
+    @_tail.setter
+    def _tail(self, value):
+        if value is not None:
+            raise ValueError(
+                "tail tiers are LSM segments now — append via "
+                "_refresh_incremental, clear by assigning None")
+        self._tails = []
+        self._tail_pos = {}
+
+    @property
+    def _tail_shard_docs(self):
+        """Per-shard (id, source) lists across every tail segment, in
+        segment order — the read-side compat view (stats/tests); the
+        tiered search paths index each segment's own lists instead."""
+        if not self._tails:
+            return []
+        out = [[] for _ in range(self.num_shards)]
+        for seg in self._tails:
+            for s, lst in enumerate(seg.shard_docs):
+                out[s].extend(lst)
+        return out
+
+    @_tail_shard_docs.setter
+    def _tail_shard_docs(self, value):
+        if value:
+            raise ValueError("assign tail segments via _tails")
+
+    def tier_searchers(self) -> list:
+        """Every live tier searcher, base first — the iteration target
+        for memory accounting / cache invalidation."""
+        out = [] if self._searcher is None else [self._searcher]
+        out.extend(seg.searcher for seg in self._tails)
+        return out
+
+    @property
     def searcher(self) -> StackedSearcher | None:
         """The single merged searcher. Consumers that are not tier-aware
         (aggs, collapse, ESQL, suggest, …) read this; when a tail tier
@@ -546,9 +612,8 @@ class EsIndex:
         from ..cache import request_cache
 
         rc = request_cache()
-        for s in (self._searcher, self._tail):
-            if s is not None:
-                rc.invalidate_searcher(s.cache_token)
+        for s in self.tier_searchers():
+            rc.invalidate_searcher(s.cache_token)
 
     def tier_stats(self) -> dict:
         """Current (base, tail) tier sizes and the tail-tier doc fraction
@@ -568,6 +633,7 @@ class EsIndex:
             "base_docs": int(base_live),
             "tail_docs": int(tail),
             "tail_fraction": (round(tail / total, 6) if total else 0.0),
+            "segments": len(self._tails),
         }
 
     def refresh_lag_ms(self) -> float:
@@ -592,14 +658,24 @@ class EsIndex:
         return projected <= max(256, base_n // 10)
 
     def _merge_tiers(self):
-        """Fold the tail into a fresh sealed base WITHOUT changing search
-        visibility: rebuilds from exactly the currently-visible docs (live
-        base docs + tail docs), leaving pending unrefreshed writes pending.
-        Used when a non-tier-aware feature needs one merged view."""
+        """Fold every tier into a fresh sealed base WITHOUT changing
+        search visibility: rebuilds from exactly the currently-visible
+        docs (live base docs + tail docs), leaving pending unrefreshed
+        writes pending. Used when a non-tier-aware feature needs one
+        merged view (the major merge; `_merge_tail_segments` is the
+        LSM minor fold that leaves the base sealed).
+
+        Atomicity contract (PR 15 satellite): every build step runs
+        into locals; searcher/tier state mutates only after the new
+        pack passed breaker admission — an injected `refresh.build`
+        fault (stage=merge) or a real build failure leaves the old
+        tiers fully serving."""
+        from ..common import faults
         from ..monitoring.refresh_profile import (
             build_stage, profile_refresh, refresh_stage)
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
+        faults.check("refresh.build", index=self.name, stage="merge")
         base = self._searcher
         visible = []
         for s, lst in enumerate(self.shard_docs):
@@ -615,11 +691,13 @@ class EsIndex:
             sp = build_stacked_pack_routed(routed, self.mappings)
             if self._breaker_account is not None:
                 self._breaker_account(sp.nbytes())
+            searcher = StackedSearcher(sp, mesh=base.mesh)
+            # ---- atomic install: nothing above touched serving state
             self._invalidate_request_cache()
-            self._searcher = StackedSearcher(sp, mesh=base.mesh)
+            self._searcher = searcher
             self.shard_docs = routed
-            self._tail = None
-            self._tail_shard_docs = []
+            self._tails = []
+            self._tail_pos = {}
             self._tail_docs = {}
             self._base_pos = {
                 doc_id: (s, d)
@@ -655,8 +733,8 @@ class EsIndex:
         self._invalidate_request_cache()
         self._searcher = StackedSearcher(sp, mesh=mesh)
         self.shard_docs = routed
-        self._tail = None
-        self._tail_shard_docs = []
+        self._tails = []
+        self._tail_pos = {}
         self._tail_docs = {}
         self._pending.clear()
         self._base_pos = {
@@ -670,16 +748,65 @@ class EsIndex:
         )
         self._base_nbytes = sp.nbytes()
 
+    def _combined_override(self, tails: list | None = None) -> dict:
+        """Combined scoring statistics across every tier: base stats AT
+        BUILD (dead docs included, like Lucene until merge) + each tail
+        segment's stats at its own build. `tails` overrides the live
+        segment list so merge/refresh can compute the post-install
+        stats before mutating any state (the atomicity contract)."""
+        if tails is None:
+            tails = self._tails
+        fs = {f: dict(st) for f, st in self._base_stats[0].items()}
+        gdf = dict(self._base_stats[1])
+        for seg in tails:
+            for f, st in seg.stats[0].items():
+                g = fs.setdefault(f, {"sum_dl": 0.0, "doc_count": 0})
+                g["sum_dl"] += st["sum_dl"]
+                g["doc_count"] += st["doc_count"]
+            for key, v in seg.stats[1].items():
+                gdf[key] = gdf.get(key, 0) + v
+        return {"field_stats": fs, "global_df": gdf}
+
+    def _install_combined_stats(self, override: dict | None = None):
+        """Install the combined stats override on every tier and re-derive
+        the stats-dependent device structures: base dense tfn + impact
+        code blocks (one elementwise device pass each — never a host
+        rebuild). Every PRE-EXISTING searcher bumps its stats epoch so
+        cached results keyed on the old statistics die; a segment whose
+        resident codes already derive from `override` (the one built
+        this refresh) skips its redundant pass."""
+        base = self._searcher
+        if override is None:
+            override = self._combined_override()
+        base.sp.stats_override = override
+        base.bump_epoch(stats=True)
+        base.refresh_dense_tfn()
+        base.refresh_impacts()
+        for seg in self._tails:
+            sp = seg.searcher.sp
+            if getattr(sp, "_impact_basis", None) is override \
+                    and sp.stats_override is override:
+                continue  # fresh segment: derived at construction
+            sp.stats_override = override
+            seg.searcher.bump_epoch(stats=True)
+            seg.searcher.refresh_impacts()
+
     def _refresh_incremental(self):
-        """Refresh proportional to the docs written since the last refresh:
-        flip base live bits for superseded/deleted docs, rebuild only the
-        small tail pack, and re-score both tiers under COMBINED statistics
-        (deleted docs keep counting in df/avgdl until a merge — exactly
-        Lucene's segment-stats behavior)."""
+        """Refresh proportional to the docs written SINCE THE LAST
+        refresh (PR 15): flip live bits for superseded/deleted docs in
+        whichever tier holds the old copy (base or an older tail
+        segment), pack ONLY the new docs as a fresh sealed tail segment,
+        and re-score every tier under the combined statistics (deleted
+        docs keep counting in df/avgdl until a merge — Lucene
+        segment-stats behavior). The old two-tier model rebuilt the
+        whole tail union every refresh; segments make refresh O(new
+        docs), with background merges bounding the segment count."""
         from ..monitoring.refresh_profile import refresh_stage
         from ..parallel.stacked import build_stacked_pack_routed, route_docs
 
         base = self._searcher
+        new_docs: dict[str, dict] = {}
+        flipped_segs: set[int] = set()
         for did in self._pending:
             e = self.docs.get(did)
             pos = self._base_pos.get(did)
@@ -689,51 +816,162 @@ class EsIndex:
                     base.sp.shards[s].live[d] = False
                     base.sp.live[s, d] = False
                     base.sp.dead_count = getattr(base.sp, "dead_count", 0) + 1
+            tpos = self._tail_pos.pop(did, None)
+            if tpos is not None:
+                g, s, d = tpos
+                seg = self._tails[g]
+                if seg.searcher.sp.live[s, d]:
+                    seg.searcher.sp.shards[s].live[d] = False
+                    seg.searcher.sp.live[s, d] = False
+                    seg.searcher.sp.dead_count = getattr(
+                        seg.searcher.sp, "dead_count", 0) + 1
+                    flipped_segs.add(g)
             if e is not None and e.alive:
+                new_docs[did] = e.source
                 self._tail_docs[did] = e.source
             else:
                 self._tail_docs.pop(did, None)
         self._pending.clear()
         base.update_live()
+        for g in sorted(flipped_segs):
+            self._tails[g].searcher.update_live()
+        if not new_docs:
+            # delete/supersede-only refresh: the live flips above are the
+            # whole visibility change — no empty segment, no stats drift
+            # (dead docs keep counting until a merge, so the frozen
+            # per-tier stats are already correct)
+            return
         with refresh_stage("route"):
-            routed = self._route_docs(sorted(self._tail_docs.items()))
-        tail_sp = build_stacked_pack_routed(routed, self.mappings,
-                                            dense_min_df=1 << 62)
-        # combined stats = base stats AT BUILD (dead docs included, like
-        # Lucene until merge) + tail stats
-        fs = {f: dict(st) for f, st in self._base_stats[0].items()}
-        for f, st in tail_sp.field_stats.items():
-            g = fs.setdefault(f, {"sum_dl": 0.0, "doc_count": 0})
-            g["sum_dl"] += st["sum_dl"]
-            g["doc_count"] += st["doc_count"]
-        gdf = dict(self._base_stats[1])
-        for key, v in tail_sp.global_df.items():
-            gdf[key] = gdf.get(key, 0) + v
-        override = {"field_stats": fs, "global_df": gdf}
-        base.sp.stats_override = override
-        tail_sp.stats_override = override
-        tail_sp.dead_count = getattr(base.sp, "dead_count", 0)
-        # dfs-stats drift: the combined statistics change every base doc's
-        # score, on top of the live-bit flips update_live already bumped —
-        # cached base results keyed on the old stats epoch must die
-        base.bump_epoch(stats=True)
+            routed = self._route_docs(sorted(new_docs.items()))
+        seg_sp = build_stacked_pack_routed(routed, self.mappings,
+                                           dense_min_df=1 << 62)
+        # total deadness across tiers: the WAND prune floor subtracts it
+        # from df before promising an exact count (sharded._wand_plan)
+        seg_sp.dead_count = sum(
+            getattr(s.sp, "dead_count", 0) for s in self.tier_searchers())
         if self._breaker_account is not None:
-            self._breaker_account(self._base_nbytes + tail_sp.nbytes())
-        if self._tail is not None:
+            self._breaker_account(
+                self._base_nbytes
+                + sum(seg.nbytes for seg in self._tails) + seg_sp.nbytes())
+        ordinal = len(self._tails)
+        seg = _TailSegment(
+            searcher=None, shard_docs=routed,
+            pos={doc_id: (s, d)
+                 for s, lst in enumerate(routed)
+                 for d, (doc_id, _src) in enumerate(lst)},
+            stats=({f: dict(st) for f, st in seg_sp.field_stats.items()},
+                   dict(seg_sp.global_df)),
+            nbytes=seg_sp.nbytes(),
+        )
+        # the NEW combined stats are installed on the pack before its
+        # searcher exists, so construction-time impact derivation sees
+        # them; the segment joins the tier list only once fully built
+        override = self._combined_override(self._tails + [seg])
+        seg_sp.stats_override = override
+        seg.searcher = StackedSearcher(seg_sp, mesh=base.mesh)
+        self._tails.append(seg)
+        for doc_id, p in seg.pos.items():
+            self._tail_pos[doc_id] = (ordinal, *p)
+        self._install_combined_stats(override)
+        # LSM merge policy: beyond the segment bound, fold the tail
+        # segments in the background (a low-priority serving tenant when
+        # the front end is up; inline otherwise)
+        if self.merge_pending():
+            self._schedule_tail_merge()
+
+    # ---- LSM tail-segment merging (PR 15) --------------------------------
+
+    def max_tail_segments(self) -> int:
+        """Segment-count bound before a tail fold is scheduled (dynamic
+        `indexing.tiers.max_segments`; the Lucene merge-policy analog)."""
+        try:
+            if self.engine is not None:
+                return max(1, int(self.engine.settings.get(
+                    "indexing.tiers.max_segments") or 4))
+        except Exception:  # noqa: BLE001 - default for standalone indices
+            pass
+        return 4
+
+    def merge_pending(self) -> bool:
+        return len(self._tails) > self.max_tail_segments()
+
+    def _schedule_tail_merge(self):
+        """Route the fold through the engine's serving queue (background
+        DEVICE merge as a low-weight tenant under the PR-6 weighted-RR
+        admission); standalone indices fold inline. Merge failures are
+        swallowed and counted — the atomic-install contract means a
+        failed fold leaves every segment serving."""
+        if self.engine is not None:
+            self.engine.schedule_tail_merge(self)
+            return
+        try:
+            self._merge_tail_segments()
+        except Exception:  # noqa: BLE001 - fold is housekeeping
+            self.counters["merge_failures"] = (
+                self.counters.get("merge_failures", 0) + 1)
+
+    def _merge_tail_segments(self) -> bool:
+        """The LSM minor merge: fold every tail segment into ONE fresh
+        sealed segment WITHOUT touching the base — superseded duplicate
+        copies drop out (the union `_tail_docs` is the fold's input), so
+        the combined stats tighten back toward truth.
+
+        Atomic or not at all (PR 15 satellite): the whole build runs
+        into locals; tier state swaps only after breaker admission. An
+        injected `refresh.build` (stage=merge) fault — or any build
+        failure — leaves the old segments fully serving, and a later
+        fold retries."""
+        from ..common import faults
+        from ..monitoring.refresh_profile import (
+            build_stage, profile_refresh, refresh_stage)
+        from ..parallel.stacked import build_stacked_pack_routed
+
+        base = self._searcher
+        if base is None or len(self._tails) < 2:
+            return False
+        # ctx stage "segment_merge": substring-matchable as either
+        # `match=merge` (any merge kind) or `match=segment_merge` (the
+        # swallowed background-fold path only — what the tier-1 advisory
+        # write-path stage injects)
+        faults.check("refresh.build", index=self.name,
+                     stage="segment_merge")
+        visible = sorted(self._tail_docs.items())
+        old_nbytes = sum(seg.nbytes for seg in self._tails)
+        with profile_refresh(self, "segment_merge"), \
+                build_stage("build.segment_merge", docs=len(visible),
+                            nbytes=old_nbytes):
+            with refresh_stage("route"):
+                routed = self._route_docs(visible)
+            sp = build_stacked_pack_routed(routed, self.mappings,
+                                           dense_min_df=1 << 62)
+            sp.dead_count = getattr(base.sp, "dead_count", 0)
+            if self._breaker_account is not None:
+                self._breaker_account(self._base_nbytes + sp.nbytes())
+            merged = _TailSegment(
+                searcher=None, shard_docs=routed,
+                pos={doc_id: (s, d)
+                     for s, lst in enumerate(routed)
+                     for d, (doc_id, _src) in enumerate(lst)},
+                stats=({f: dict(st) for f, st in sp.field_stats.items()},
+                       dict(sp.global_df)),
+                nbytes=sp.nbytes(),
+            )
+            override = self._combined_override([merged])
+            sp.stats_override = override
+            merged.searcher = StackedSearcher(sp, mesh=base.mesh)
+            # ---- atomic install: nothing above touched serving state
             from ..cache import request_cache
 
-            request_cache().invalidate_searcher(self._tail.cache_token)
-        self._tail = StackedSearcher(tail_sp, mesh=base.mesh)
-        self._tail_shard_docs = routed
-        # avgdl may have drifted: re-norm the base dense tier on device
-        base.refresh_dense_tfn()
-        # ... and re-derive the base impact-code blocks under the combined
-        # stats (one elementwise device pass; the tail searcher derived
-        # its own at construction, AFTER the override was installed) — so
-        # postings written since the last full build stay impact-served
-        # through the exact-by-construction tail tier while the base tier
-        # keeps its gather+sum path, and correctness never depends on it
-        base.refresh_impacts()
+            rc = request_cache()
+            for seg in self._tails:
+                rc.invalidate_searcher(seg.searcher.cache_token)
+            self._tails = [merged]
+            self._tail_pos = {doc_id: (0, s, d)
+                              for doc_id, (s, d) in merged.pos.items()}
+            self._install_combined_stats(override)
+        self.counters["segment_merge_total"] = (
+            self.counters.get("segment_merge_total", 0) + 1)
+        return True
 
     def _maybe_refresh(self):
         if self._searcher is None:  # safety; construction always refreshes
@@ -1010,10 +1248,13 @@ class EsIndex:
 
                 eff_size = min(size, max(k_total - from_, 0))
                 k = max(eff_size + from_, 1)
+                tails = list(self._tails)
                 rb = self._knn_exec(self._searcher, _tier_node(), k)
-                rt = self._knn_exec(self._tail, _tier_node(), k)
-                out = self._tiered_merge(rb, rt, eff_size, from_, None,
-                                         track_total_hits)
+                rts = [self._knn_exec(seg.searcher, _tier_node(), k)
+                       for seg in tails]
+                out = self._tiered_merge(
+                    rb, rts, eff_size, from_, None, track_total_hits,
+                    [seg.shard_docs for seg in tails])
                 if track_total_hits is not False:
                     tv = out["hits"]["total"]
                     tv["value"] = min(tv["value"], k_total)
@@ -1267,37 +1508,51 @@ class EsIndex:
         rb = self._searcher.search(q, size=k, prune_floor=prune_floor)
         from ..telemetry import time_kernel
 
-        with time_kernel("sparse.tail_scan", tier="tail", queries=1,
-                         num_docs=self._tail.sp.S * self._tail.sp.n_max):
-            rt = self._tail.search(q, size=k)
-        return self._tiered_merge(rb, rt, size, from_, prune_floor,
-                                  track_total_hits)
+        # snapshot the segment list: a background fold may swap
+        # self._tails while the per-segment programs run
+        tails = list(self._tails)
+        rts = []
+        for seg in tails:
+            with time_kernel("sparse.tail_scan", tier="tail", queries=1,
+                             num_docs=(seg.searcher.sp.S
+                                       * seg.searcher.sp.n_max)):
+                rts.append(seg.searcher.search(q, size=k))
+        return self._tiered_merge(rb, rts, size, from_, prune_floor,
+                                  track_total_hits,
+                                  [seg.shard_docs for seg in tails])
 
-    def _tiered_merge(self, rb, rt, size, from_, prune_floor,
-                      track_total_hits) -> dict:
-        """Coordinator merge of the (base, tail) tier results — shared by
-        the solo tiered path and the serving wave's tiered lane."""
+    def _tiered_merge(self, rb, rts, size, from_, prune_floor,
+                      track_total_hits, tail_shard_docs) -> dict:
+        """Coordinator merge of the base + N tail-segment tier results —
+        shared by the solo tiered path and the serving wave's tiered
+        lane. `rts` is one result per tail segment, in segment order;
+        `tail_shard_docs` is each segment's routed doc lists CAPTURED AT
+        DISPATCH — a background fold may replace the live segment list
+        before this merge runs, and (shard, docid) coordinates only mean
+        anything against the lists the programs actually scanned."""
         rows = []
-        for tier, r in ((0, rb), (1, rt)):
+        for tier, r in enumerate((rb, *rts)):
             for rank, (s, d, sc) in enumerate(
                     zip(r.doc_shards, r.doc_ids, r.scores)):
                 rows.append((-float(sc), tier, rank, int(s), int(d)))
         # (score desc, tier asc, per-tier rank asc) = Lucene TopDocs.merge
-        # order with tail shards indexed after base shards
+        # order with segment shards indexed after base shards
         rows.sort()
         hits = []
         for negsc, tier, _rank, s, d in rows[from_: from_ + size]:
-            docs = self.shard_docs if tier == 0 else self._tail_shard_docs
+            docs = (self.shard_docs if tier == 0
+                    else tail_shard_docs[tier - 1])
             doc_id, src = docs[s][d]
             hits.append({"_index": self.name, "_id": doc_id,
                          "_score": -negsc, "_source": src})
-        relation = ("gte" if "gte" in (rb.total_relation, rt.total_relation)
-                    else "eq")
-        value = rb.total + rt.total
+        relations = [rb.total_relation] + [r.total_relation for r in rts]
+        relation = "gte" if "gte" in relations else "eq"
+        value = rb.total + sum(r.total for r in rts)
         if relation == "gte" and prune_floor:
             value = max(value, prune_floor)
-        max_score = max((x for x in (rb.max_score, rt.max_score)
-                         if x is not None), default=None)
+        max_score = max(
+            (x for x in (rb.max_score, *(r.max_score for r in rts))
+             if x is not None), default=None)
         hits_obj = {"total": {"value": value, "relation": relation},
                     "max_score": max_score, "hits": hits}
         if track_total_hits is False:
@@ -1411,7 +1666,7 @@ class EsIndex:
             # tiered lane: only when EVERY wave entry is tier-capable (a
             # single generic entry would merge the tiers when run solo)
             tiered_nodes = {}
-            if self._tail is not None and wave_ix:
+            if self._tails and wave_ix:
                 for i in wave_ix:
                     p = plans[i]
                     if p["aggs"] or p["knn"] is not None:
@@ -1438,12 +1693,21 @@ class EsIndex:
                                           aggs=None, mappings=None,
                                           prune_floor=None))
                     job["fmt"][i] = p
+                segs = list(self._tails)
                 job["tiered"] = {
                     "ix": wave_ix,
                     "base": (self._searcher,
                              self._searcher.search_many_begin(base_reqs)),
-                    "tail": (self._tail,
-                             self._tail.search_many_begin(tail_reqs)),
+                    # one batched program per tail segment, all dispatched
+                    # here and pulled by the wave's single combined fetch;
+                    # shard_docs captured NOW — a background fold may swap
+                    # the live segment list before this wave finishes
+                    "tails": [
+                        (seg.searcher, seg.searcher.search_many_begin(
+                            [dict(r) for r in tail_reqs]))
+                        for seg in segs
+                    ],
+                    "tail_shard_docs": [seg.shard_docs for seg in segs],
                 }
                 return self._wave_mark_dispatched(job)
             if not wave_ix:
@@ -1545,7 +1809,7 @@ class EsIndex:
         t = job.get("tiered")
         if t is not None:
             pending = pending or bool(t["base"][1].get("pending")) \
-                or bool(t["tail"][1].get("pending"))
+                or any(bool(st.get("pending")) for _s, st in t["tails"])
         for tl in job.get("term_lanes", ()):
             m = tl["st"].get("merged")
             if m is not None and m.get("pending") is not None:
@@ -1568,7 +1832,7 @@ class EsIndex:
         states = [lane["state"] for lane in job["lanes"]]
         t = job.get("tiered")
         if t is not None:
-            states += [t["base"][1], t["tail"][1]]
+            states += [t["base"][1]] + [st for _s, st in t["tails"]]
         merged = [tl["st"].get("merged")
                   for tl in job.get("term_lanes", ())]
         merged = [m for m in merged
@@ -1689,10 +1953,12 @@ class EsIndex:
             if t is not None:
                 base = t["base"][0].search_many_finish(
                     t["base"][1], raise_errors=False)
-                tail = t["tail"][0].search_many_finish(
-                    t["tail"][1], raise_errors=False)
-                for i, rb, rt in zip(t["ix"], base, tail):
-                    err = next((r for r in (rb, rt)
+                tails = [s.search_many_finish(st, raise_errors=False)
+                         for s, st in t["tails"]]
+                for pos, i in enumerate(t["ix"]):
+                    rb = base[pos]
+                    rts = [tl[pos] for tl in tails]
+                    err = next((r for r in (rb, *rts)
                                 if isinstance(r, Exception)), None)
                     if err is not None:
                         job["slots"][i] = ("error", err)
@@ -1700,8 +1966,8 @@ class EsIndex:
                     p = job["fmt"][i]
                     try:
                         job["slots"][i] = ("resp", self._tiered_merge(
-                            rb, rt, p["size"], p["from_"], p["pf"],
-                            p["tth"]))
+                            rb, rts, p["size"], p["from_"], p["pf"],
+                            p["tth"], t["tail_shard_docs"]))
                     except Exception as ex:  # noqa: BLE001
                         job["slots"][i] = ("error", ex)
             # extra device rounds taken during finish (fused escalation,
@@ -1712,7 +1978,8 @@ class EsIndex:
             extra_states += [tl["st"].get("merged")
                              for tl in job.get("term_lanes", ())]
             if t is not None:
-                extra_states += [t["base"][1], t["tail"][1]]
+                extra_states += [t["base"][1]] + [st for _s, st
+                                                  in t["tails"]]
             for s in extra_states:
                 if s is None:
                     continue
@@ -1747,12 +2014,13 @@ class EsIndex:
 
     def count(self, query=None) -> int:
         self._maybe_refresh()
-        if self._tail is not None:
+        if self._tails:
             node = self._tier_node(query)
             if node is not None:
                 q = query if isinstance(query, dict) or query is None \
                     else node
-                return self._searcher.count(q) + self._tail.count(q)
+                return self._searcher.count(q) + sum(
+                    seg.searcher.count(q) for seg in self._tails)
         return self.searcher.count(query)
 
     def explain(self, doc_id: str, query=None) -> dict:
@@ -1944,6 +2212,7 @@ class Engine:
                           ("serving.coalesce.max_wait", "set_max_wait"),
                           ("serving.queue.max_depth", "set_queue_depth"),
                           ("serving.tenant.weights", "set_tenant_weights"),
+                          ("serving.merge.weight", "set_merge_weight"),
                           ("serving.flight_recorder.size",
                            "set_flight_recorder_size")):
             self.settings.add_consumer(
@@ -2126,6 +2395,53 @@ class Engine:
         if self.settings.get("serving.enabled"):
             return self.serving
         return None
+
+    def schedule_tail_merge(self, idx) -> bool:
+        """Schedule one LSM tail-segment fold for `idx` (PR 15). With
+        the serving front end up, the DEVICE merge rides the serving
+        queue as the low-weight `_merge` internal tenant under the PR-6
+        weighted-RR admission — heavy indexing and heavy search share
+        the chip through ONE scheduler, under the existing breakers and
+        `slo.write.*` floors; otherwise the fold runs inline. Merge
+        failures are swallowed and counted (`merge_failures`): the
+        atomic-install contract means a failed fold leaves every
+        segment serving and a later refresh reschedules.
+
+        -> True when a background merge was queued (or already is)."""
+        def _fold_inline():
+            try:
+                idx._merge_tail_segments()
+            except Exception:  # noqa: BLE001 - fold is housekeeping
+                idx.counters["merge_failures"] = (
+                    idx.counters.get("merge_failures", 0) + 1)
+
+        svc = self.serving_if_enabled()
+        if svc is None:
+            _fold_inline()
+            return False
+        if idx._merge_inflight:
+            return True
+        idx._merge_inflight = True
+        try:
+            fut = svc.submit_merge(lambda: idx._merge_tail_segments(),
+                                   index=idx.name)
+        except Exception:  # noqa: BLE001 - shed/stopped front end
+            idx._merge_inflight = False
+            _fold_inline()
+            return False
+
+        def _done(f):
+            idx._merge_inflight = False
+            try:
+                err = f.exception()
+            except Exception:  # noqa: BLE001 - cancelled future
+                err = None
+            if err is not None:
+                idx.counters["merge_failures"] = (
+                    idx.counters.get("merge_failures", 0) + 1)
+
+        fut.add_done_callback(_done)
+        return True
 
     def _pack_accounter(self, name: str):
         return lambda n: self.breakers.set_steady(

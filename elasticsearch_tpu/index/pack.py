@@ -646,10 +646,18 @@ class PackBuilder:
         # matching Lucene: keyword fields omit norms => norm = 1)
         # handled at query time by norm fallback.
 
-        # ---- blocked postings (vectorized scatter from flat CSR) ---------
+        # ---- blocked postings (segment scatter from flat CSR) ------------
+        # PR 15: above the device-build floor the scatter + block-stat
+        # derivation runs as one jitted segment-scatter kernel
+        # (index/device_build.csr_blocked_scatter_device) — byte parity
+        # with the host path asserted by tests/test_device_build.py
+        from .device_build import (csr_blocked_scatter_device,
+                                   use_device_build)
+
         NP = len(flat_docs) if T else 0
+        csr_dev = use_device_build(NP)
         with build_stage("build.csr_assemble", postings=NP, num_docs=N,
-                         terms=T):
+                         terms=T, basis="device" if csr_dev else "host"):
             df = post_offsets[1:] - post_offsets[:-1]
             term_df = df.astype(np.int32)
             nblk = (df + BLOCK - 1) // BLOCK
@@ -658,12 +666,6 @@ class PackBuilder:
             row_base[1:] = 1 + np.cumsum(nblk)
             total_blocks = int(row_base[-1]) if T else 1
             term_block_start = row_base.astype(np.int32)
-
-            post_docids = np.full((total_blocks, BLOCK), N, dtype=np.int32)
-            post_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
-            post_dls = np.ones((total_blocks, BLOCK), dtype=np.float32)
-            block_max_tf = np.zeros(total_blocks, dtype=np.float32)
-            block_min_len = np.full(total_blocks, np.inf, dtype=np.float32)
 
             field_names = sorted({k[0] for k in keys})
             fld_code = {f: i for i, f in enumerate(field_names)}
@@ -677,8 +679,6 @@ class PackBuilder:
                 )
                 dest_row = row_base[:-1][term_of_post] + local // BLOCK
                 dest_col = local % BLOCK
-                post_docids[dest_row, dest_col] = flat_docs
-                post_tfs[dest_row, dest_col] = flat_tfs
                 # per-posting doc length (1.0 for norm-less fields)
                 post_dl_flat = np.ones(NP, dtype=np.float32)
                 fop = field_of_term[term_of_post]
@@ -689,15 +689,34 @@ class PackBuilder:
                     sel = fop == code
                     if sel.any():
                         post_dl_flat[sel] = nrm[flat_docs[sel]]
-                post_dls[dest_row, dest_col] = post_dl_flat
-                # per-block stats: flat order is block-contiguous, so
-                # reduceat over block-start boundaries gives segment max/min
-                starts = np.flatnonzero(np.diff(dest_row, prepend=-1))
-                block_rows = dest_row[starts]
-                block_max_tf[block_rows] = np.maximum.reduceat(
-                    flat_tfs, starts)
-                block_min_len[block_rows] = np.minimum.reduceat(
-                    post_dl_flat, starts)
+            if NP and csr_dev:
+                (post_docids, post_tfs, post_dls, block_max_tf,
+                 block_min_len) = csr_blocked_scatter_device(
+                    flat_docs, flat_tfs, post_dl_flat, dest_row,
+                    dest_col, total_blocks, BLOCK, N)
+            else:
+                post_docids = np.full((total_blocks, BLOCK), N,
+                                      dtype=np.int32)
+                post_tfs = np.zeros((total_blocks, BLOCK),
+                                    dtype=np.float32)
+                post_dls = np.ones((total_blocks, BLOCK),
+                                   dtype=np.float32)
+                block_max_tf = np.zeros(total_blocks, dtype=np.float32)
+                block_min_len = np.full(total_blocks, np.inf,
+                                        dtype=np.float32)
+                if NP:
+                    post_docids[dest_row, dest_col] = flat_docs
+                    post_tfs[dest_row, dest_col] = flat_tfs
+                    post_dls[dest_row, dest_col] = post_dl_flat
+                    # per-block stats: flat order is block-contiguous, so
+                    # reduceat over block starts gives segment max/min
+                    starts = np.flatnonzero(
+                        np.diff(dest_row, prepend=-1))
+                    block_rows = dest_row[starts]
+                    block_max_tf[block_rows] = np.maximum.reduceat(
+                        flat_tfs, starts)
+                    block_min_len[block_rows] = np.minimum.reduceat(
+                        post_dl_flat, starts)
             block_min_len[~np.isfinite(block_min_len)] = 1.0
 
         # ---- docvalues ---------------------------------------------------
@@ -786,8 +805,11 @@ class PackBuilder:
         term_pos_count = None
         n_positions = int(pos_offsets[-1]) if T else 0
         if n_positions:
+            # position keys stay a host scatter for now: tiny next to the
+            # postings volume, and phrase-heavy corpora are not the C7
+            # write path (documented in BENCH_NOTES round 19)
             with build_stage("build.csr_assemble", postings=n_positions,
-                             num_docs=N, terms=T):
+                             num_docs=N, terms=T, basis="host"):
                 pos_df = pos_offsets[1:] - pos_offsets[:-1]
                 pnblk = (pos_df + BLOCK - 1) // BLOCK
                 prow_base = np.empty(T + 1, dtype=np.int64)
@@ -822,17 +844,28 @@ class PackBuilder:
         if T:
             dtype = impact_dtype_default()
             qmax = IMPACT_QMAX[dtype]
+            imp_dev = use_device_build(total_blocks * BLOCK)
             with build_stage("build.impact_quantize", rows=total_blocks,
                              code_bytes=2 if dtype == "uint16" else 1,
-                             basis="host"):
+                             basis="device" if imp_dev else "host"):
                 impact_ubf = impact_term_ubf(term_block_start, block_max_tf)
                 row_terms = impact_row_terms(term_block_start, total_blocks)
                 k_base, k_slope, scale_inv = impact_row_params(
                     row_terms, impact_ubf, field_of_term,
                     avgdl_of_field, has_norms_of_field, qmax)
-                impact_codes = impact_codes_host(
-                    post_tfs, post_dls, k_base, k_slope, scale_inv, qmax,
-                    dtype)
+                if imp_dev:
+                    # PR 15: the quantization is a pure elementwise pass
+                    # over the blocked CSR values — run it on device (the
+                    # refresh_impacts shape, applied at build)
+                    from .device_build import impact_codes_device
+
+                    impact_codes = np.array(impact_codes_device(
+                        post_tfs, post_dls, k_base, k_slope, scale_inv,
+                        qmax=qmax, dtype=dtype))
+                else:
+                    impact_codes = impact_codes_host(
+                        post_tfs, post_dls, k_base, k_slope, scale_inv,
+                        qmax, dtype)
             impact_meta = {"dtype": dtype, "qmax": qmax,
                            "k1": BM25_K1, "b": BM25_B}
 

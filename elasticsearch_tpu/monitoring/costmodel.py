@@ -471,6 +471,15 @@ def merge_build_cost(docs: int, *, nbytes: float = 0.0) -> dict:
     return {"flops": 2.0 * docs, "bytes": float(2.0 * nbytes)}
 
 
+def segment_merge_build_cost(docs: int, *, nbytes: float = 0.0) -> dict:
+    """LSM tail-segment fold (engine._merge_tail_segments, PR 15): a
+    wrapper over the union rebuild of the tail segments ONLY — the
+    inner build.* stages (csr_assemble, impact_quantize, device_put…)
+    carry the precise accounting; same read-old + write-new convention
+    as build.merge, scoped to the tail bytes instead of the base."""
+    return {"flops": 2.0 * docs, "bytes": float(2.0 * nbytes)}
+
+
 def allgather_merge_cost(s: int, q: int, k: int, *,
                          id_bytes: int = 8) -> dict:
     """The on-device coordinator merge (PR 10): every shard's [q, k]
@@ -606,6 +615,14 @@ def _build_merge(fields: dict) -> dict | None:
                             nbytes=float(fields.get("nbytes", 0.0)))
 
 
+def _build_segment_merge(fields: dict) -> dict | None:
+    docs = fields.get("docs")
+    if docs is None:
+        return None
+    return segment_merge_build_cost(int(docs),
+                                    nbytes=float(fields.get("nbytes", 0.0)))
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -652,6 +669,9 @@ KERNEL_COSTS: dict[str, object] = {
     "build.ann_tiles": _build_ann_tiles,
     "build.device_put": _build_device_put,
     "build.merge": _build_merge,
+    # PR 15: the LSM tail-segment fold (background device merge riding
+    # the serving queue as the `_merge` tenant)
+    "build.segment_merge": _build_segment_merge,
 }
 
 

@@ -162,12 +162,11 @@ def padded_waste_bytes(engine) -> int:
     trigger."""
     total = 0
     for idx in engine.indices.values():
-        for s in (idx._searcher, idx._tail):
-            if s is not None:
-                try:
-                    total += pack_padded_waste(s.sp)
-                except Exception:  # noqa: BLE001 - stats must not fail
-                    continue
+        for s in idx.tier_searchers():
+            try:
+                total += pack_padded_waste(s.sp)
+            except Exception:  # noqa: BLE001 - stats must not fail
+                continue
     return total
 
 

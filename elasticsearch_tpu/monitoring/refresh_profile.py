@@ -221,7 +221,8 @@ class RefreshRecorder:
             return {
                 "refresh_total": sum(self._counts.values()),
                 "refresh_kinds": dict(self._counts),
-                "merge_total": self._counts.get("merge", 0),
+                "merge_total": (self._counts.get("merge", 0)
+                                + self._counts.get("segment_merge", 0)),
                 "stage_ms": {k: round(v, 3)
                              for k, v in sorted(self._stage_ms.items())},
                 "docs_refreshed_total": self._docs_total,
@@ -277,9 +278,11 @@ def profile_refresh(index, kind: str):
     try:
         wall_s, stages = c.finish()
         tiers = index.tier_stats()
-        if kind == "incremental":
+        if kind == "incremental" or kind == "segment_merge":
+            # incremental packs the new docs; a segment fold (PR 15)
+            # reprocesses exactly the tail union — never the base
             docs = tiers["tail_docs"]
-        else:  # full rebuild / merge processes every visible doc
+        else:  # full rebuild / major merge processes every visible doc
             docs = tiers["base_docs"] + tiers["tail_docs"]
         profile = {
             "@timestamp": _iso_utc(),
@@ -292,7 +295,8 @@ def profile_refresh(index, kind: str):
             "wall_ms": round(wall_s * 1000, 4),
             "tail_fraction": tiers["tail_fraction"],
             "tiers": {"base_docs": tiers["base_docs"],
-                      "tail_docs": tiers["tail_docs"]},
+                      "tail_docs": tiers["tail_docs"],
+                      "segments": tiers.get("segments", 0)},
         }
         recorder_for(index).record(profile)
     except Exception:  # noqa: BLE001 - profiling must never fail a refresh

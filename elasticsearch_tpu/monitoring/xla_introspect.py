@@ -125,35 +125,39 @@ XLA_CHECKS: dict[str, dict] = {
         "status": "exempt",
         "reason": "exact f32 tail scan through scan_topk; same program "
                   "family as vector.knn_scan"},
-    # write-path build stages (PR 13): host loops today — exempt with
-    # the port plan on record. When ROADMAP item 2 moves a stage onto
-    # the device, its entry flips to "checked" at the new executable
-    # cache; until then there is no compiled program to cross-check.
+    # write-path build stages (PR 13 substrate; PR 15 device port). The
+    # ported stages are exempt-with-reason on a STRONGER ground than a
+    # cost cross-check: each device kernel is asserted BYTE-IDENTICAL
+    # to its host twin by tests/test_device_build.py, so the analytic
+    # flops/bytes model describes both sides of the basis split.
     "build.kmeans": {
         "status": "exempt",
-        "reason": "Lloyd iterations are per-step jax ops without a "
-                  "caller-visible executable cache; dense-matmul parity "
-                  "is anchored by vector.knn_scan"},
+        "reason": "PR 15: one jitted Lloyd while_loop "
+                  "(device_build.kmeans_device); assignment parity with "
+                  "the eager loop asserted by tests; dense-matmul cost "
+                  "parity anchored by vector.knn_scan"},
     "build.impact_quantize": {
         "status": "exempt",
-        "reason": "host derivation plus one elementwise device jit "
-                  "(sharded._impact_codes_device) asserted BIT-EQUAL to "
-                  "the host twin by tests/test_impact.py — stronger than "
-                  "a cost cross-check"},
+        "reason": "one elementwise device jit "
+                  "(device_build.impact_codes_device) asserted BIT-EQUAL "
+                  "to the host twin by tests/test_impact.py — stronger "
+                  "than a cost cross-check"},
     "build.csr_assemble": {
         "status": "exempt",
-        "reason": "host numpy scatter (no compiled executable); item-2 "
-                  "device port wires check_dispatch at its sort/segment "
-                  "program cache"},
+        "reason": "PR 15: jitted segment-scatter kernel "
+                  "(device_build.csr_blocked_scatter_device) asserted "
+                  "byte-equal to the host numpy scatter by "
+                  "tests/test_device_build.py"},
     "build.norms": {
         "status": "exempt",
         "reason": "host smallfloat quantization loop (no compiled "
                   "executable)"},
     "build.ann_tiles": {
         "status": "exempt",
-        "reason": "host tile-packing loop (no compiled executable); "
-                  "item-2 device port wires check_dispatch at its "
-                  "gather/quantize program cache"},
+        "reason": "PR 15: jitted lax-sort/segment + int8 quantize "
+                  "kernel (device_build.ann_tiles_device) asserted "
+                  "byte-equal to the host tile loop by "
+                  "tests/test_device_build.py"},
     "build.device_put": {
         "status": "exempt",
         "reason": "pure host→device transfer — no program to analyze; "
@@ -162,6 +166,11 @@ XLA_CHECKS: dict[str, dict] = {
         "status": "exempt",
         "reason": "wrapper over a full rebuild; the inner build.* stages "
                   "carry the per-stage accounting"},
+    "build.segment_merge": {
+        "status": "exempt",
+        "reason": "PR 15 wrapper over the tail-union rebuild (the LSM "
+                  "fold); the inner build.* stages carry the per-stage "
+                  "accounting"},
 }
 
 

@@ -222,32 +222,22 @@ def knn_scores(
 
 
 def kmeans_ivf(vectors, nlist: int, iters: int = 8):
-    """Host-driven k-means for the IVF partition index (the TPU-native ANN
-    replacing the reference's HNSW graphs, index/codec/vectors/ — a graph
-    walk is pointer-chasing; nprobe-partitioned brute force is MXU-shaped).
+    """k-means for the IVF partition index (the TPU-native ANN replacing
+    the reference's HNSW graphs, index/codec/vectors/ — a graph walk is
+    pointer-chasing; nprobe-partitioned brute force is MXU-shaped).
 
-    -> (centroids [C, D] f32, assign [N] int32). Runs the Lloyd iterations
-    as jax matmuls (device-accelerated when one is present)."""
-    import numpy as np
+    -> (centroids [C, D] f32, assign [N] int32).
 
-    vecs = jnp.asarray(vectors, jnp.float32)
-    N, D = vecs.shape
-    C = max(1, min(nlist, N))
-    # deterministic strided init over the corpus
-    init_idx = (jnp.arange(C) * (N // C)).astype(jnp.int32)
-    centroids = vecs[init_idx]
-    for _ in range(iters):
-        # argmin ||v-c||^2 == argmax v.c - ||c||^2/2
-        logits = vecs @ centroids.T - 0.5 * jnp.sum(centroids * centroids, axis=1)[None, :]
-        assign = jnp.argmax(logits, axis=1)
-        sums = jnp.zeros((C, D), jnp.float32).at[assign].add(vecs)
-        counts = jnp.zeros((C,), jnp.float32).at[assign].add(1.0)
-        centroids = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centroids
-        )
-    logits = vecs @ centroids.T - 0.5 * jnp.sum(centroids * centroids, axis=1)[None, :]
-    assign = jnp.argmax(logits, axis=1)
-    return np.asarray(centroids), np.asarray(assign, np.int32)
+    PR 15 (ROADMAP item 2): the Lloyd loop runs as ONE jitted device
+    program — matmul+argmin assignment waves under lax.while_loop with
+    an on-device convergence exit (index/device_build.kmeans_device) —
+    instead of the per-iteration eager dispatches that made kmeans ~97%
+    of the r11 ANN build wall."""
+    from ..index.device_build import kmeans_device
+
+    centroids, assign, _iters_run = kmeans_device(vectors, nlist,
+                                                  iters=iters)
+    return centroids, assign
 
 
 # build_ivf / ivf_candidates (the host-side probe layout) were promoted

@@ -48,7 +48,6 @@ def wand_gate_min_rows() -> int:
 import functools
 
 
-@functools.partial(jax.jit, static_argnames=("qmax", "dtype"))
 def _impact_codes_device(tfs, dls, k_base, k_slope, scale_inv, *,
                          qmax, dtype):
     """Device twin of index/pack.impact_codes_host (asserted equal by
@@ -56,13 +55,13 @@ def _impact_codes_device(tfs, dls, k_base, k_slope, scale_inv, *,
     the resident postings — ONE elementwise pass at refresh, so dfs-stat
     drift (stats_override under tiered refresh) re-norms the impact tier
     without a host rebuild or re-transfer (the refresh_dense_tfn
-    discipline applied to the sparse tier)."""
-    K = k_base[..., None] + k_slope[..., None] * dls
-    tfn = tfs / (tfs + K)
-    q = jnp.rint(tfn * scale_inv[..., None])
-    q = jnp.clip(q, 1, qmax)  # tf > 0 stays a match (code >= 1)
-    q = jnp.where(tfs > 0, q, 0)
-    return q.astype(jnp.uint16 if dtype == "uint16" else jnp.int8)
+    discipline applied to the sparse tier). PR 15: the kernel itself
+    moved to index/device_build (shared with the build-time device
+    quantization path)."""
+    from ..index.device_build import impact_codes_device
+
+    return impact_codes_device(tfs, dls, k_base, k_slope, scale_inv,
+                               qmax=qmax, dtype=dtype)
 
 
 def make_mesh(num_shards: int) -> Mesh | None:

@@ -101,6 +101,11 @@ class ServingService:
     backpressure between REST and the executor (ROADMAP item 3)."""
 
     TASK_ACTION = "indices:data/read/search[serving]"
+    # the internal background-merge tenant (PR 15): device index merges
+    # ride the SAME weighted-RR admission as search traffic, at a low
+    # weight — the RR fairness contract means a full search wave can
+    # slow a merge but never block it, and vice versa
+    MERGE_TENANT = "_merge"
 
     def __init__(self, engine):
         self.engine = engine
@@ -111,8 +116,11 @@ class ServingService:
             s.get("serving.coalesce.max_wait"), 0.002) or 0.0
         self.queue_cap = int(s.get("serving.queue.max_depth"))
         self._tenants = TenantQueues()
-        self._tenants.set_weights(
-            parse_tenant_weights(s.get("serving.tenant.weights")))
+        try:
+            self._merge_weight = float(s.get("serving.merge.weight"))
+        except Exception:  # noqa: BLE001 - engines without the setting
+            self._merge_weight = 1.0
+        self.set_tenant_weights(s.get("serving.tenant.weights"))
         self._cv = threading.Condition()
         self._lock = threading.Lock()
         self._inflight: _queue.Queue = _queue.Queue(maxsize=1)
@@ -125,6 +133,7 @@ class ServingService:
             "admitted": 0, "dispatched": 0, "completed": 0, "errors": 0,
             "shed": 0, "expired": 0, "cancelled": 0, "waves": 0,
             "coalesced": 0, "term_packed": 0, "fallback_solo": 0,
+            "merges": 0,
         }
         self._occ_sum = 0.0
         self._occ_n = 0
@@ -168,7 +177,20 @@ class ServingService:
         self.queue_cap = max(1, int(v))
 
     def set_tenant_weights(self, raw):
-        self._tenants.set_weights(parse_tenant_weights(raw))
+        w = parse_tenant_weights(raw)
+        # the merge tenant's weight comes from serving.merge.weight, not
+        # the user weight table (an internal tenant, not a caller)
+        w.setdefault(self.MERGE_TENANT, self._merge_weight)
+        self._tenants.set_weights(w)
+
+    def set_merge_weight(self, v):
+        try:
+            self._merge_weight = max(float(v), 0.0)
+        except (TypeError, ValueError):
+            return
+        w = dict(self._tenants.weights)
+        w[self.MERGE_TENANT] = self._merge_weight
+        self._tenants.set_weights(w)
 
     def set_flight_recorder_size(self, v):
         with self._lock:
@@ -268,6 +290,19 @@ class ServingService:
         import asyncio
 
         return await asyncio.wrap_future(self.submit(entry, **kw))
+
+    def submit_merge(self, fn, *, index: str = "", est_bytes: int = 1024):
+        """Admit one background DEVICE index merge as the low-weight
+        `_merge` internal tenant (PR 15 / ROADMAP item 2): the fold runs
+        on the engine thread inside a wave slot, scheduled by the SAME
+        weighted round-robin that drains search tenants — heavy indexing
+        and heavy search share the chip under the existing breakers,
+        shed path, and SLO floors. -> Future resolving when the merge
+        ran (or shed with 429 under saturation — the caller retries at a
+        later refresh)."""
+        entry = {"internal": fn, "index": index, "kind": "merge"}
+        return self.submit(entry, tenant=self.MERGE_TENANT,
+                           est_bytes=est_bytes)
 
     # ---- terminal paths --------------------------------------------------
 
@@ -472,6 +507,24 @@ class ServingService:
             tenants[ps.tenant] = tenants.get(ps.tenant, 0) + 1
         state = {"t0": time.monotonic(), "jobs": [], "n": len(ready),
                  "tenants": tenants, "events": [], "fallback_solo": 0}
+        # internal lane (PR 15): background merges claimed into this
+        # wave run here on the engine thread (the one-writer discipline)
+        # and resolve immediately — a merge occupies its weighted-RR
+        # slot, the rest of the wave packs search lanes around it
+        searches = []
+        for ps in ready:
+            fn = ps.entry.get("internal")
+            if not callable(fn):
+                searches.append(ps)
+                continue
+            with self._lock:
+                self.counters["merges"] += 1
+            try:
+                res = fn()
+                self._finish_entry(ps, result={"merged": bool(res)})
+            except Exception as ex:  # noqa: BLE001 - per-entry envelope
+                self._finish_entry(ps, error=ex)
+        ready = searches
         by_index: dict[str, list[PendingSearch]] = {}
         for ps in ready:
             by_index.setdefault(ps.entry["index"], []).append(ps)

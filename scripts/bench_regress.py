@@ -213,6 +213,41 @@ def build_profile_growth(prev: dict, latest: dict, threshold: float) -> list:
     return moved
 
 
+def build_speedup_table(prev: dict, latest: dict) -> list:
+    """PR 15: when BOTH records carry `build_profile` sections, the
+    r(N-1)→rN comparison IS the device port's scorecard — render a
+    host-vs-device per-stage speedup table (old ms / new ms per shared
+    stage path, plus wall and docs/s) alongside the advisory movement
+    check. -> [(path, old, new, speedup)] sorted by path."""
+    a, b = build_profile_metrics(prev), build_profile_metrics(latest)
+    rows = []
+    for path in sorted(set(a) & set(b)):
+        leaf = path.rsplit(".", 1)[-1]
+        if leaf in ("docs", "tail_fraction"):
+            continue
+        old, new = a[path], b[path]
+        if old <= 1e-9 or new <= 1e-9:
+            continue
+        if leaf == "docs_per_s":  # higher is better: speedup = new/old
+            rows.append((path, old, new, new / old))
+        else:  # stage/wall ms: speedup = old/new
+            rows.append((path, old, new, old / new))
+    return rows
+
+
+def print_build_speedup(prev: dict, latest: dict,
+                        prev_round: int, cur_round: int) -> None:
+    rows = build_speedup_table(prev, latest)
+    if not rows:
+        return
+    print(f"[bench-regress] build_profile speedup table "
+          f"(r{prev_round:02d} -> r{cur_round:02d}; stage ms old->new, "
+          f"x = speedup; the item-2 port scorecard):")
+    for path, old, new, speedup in rows:
+        print(f"  {path:<64} {_fmt(old):>10} -> {_fmt(new):>10}  "
+              f"{speedup:6.2f}x")
+
+
 def print_drift_table(record_path: str) -> None:
     """--print-drift: render the newest record's xla_cost_check sections
     (tier1_gate.sh prints this when records exist)."""
@@ -282,6 +317,9 @@ def main(argv=None) -> int:
               f"({ratio:.2f}x) — write-path build stage moved beyond "
               f"{args.threshold:.0%}; compare the stage split before "
               "accepting a slower host build as the item-2 baseline")
+    # PR 15: the per-stage host-vs-device scorecard whenever both
+    # records profiled their builds
+    print_build_speedup(prev, latest, prev_round, cur_round)
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -28,6 +28,7 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -219,12 +220,111 @@ def stage_b_engine() -> dict:
         loop.close()
 
 
+def stage_d_write_path() -> dict:
+    """Stage D (PR 15): writers + searchers + ONE injected build fault
+    pinned to the background segment fold. Contract: every search during
+    and after the faulted fold returns complete, correct results (the
+    merge installs atomically or not at all), the fold retries on a
+    later refresh and converges, and the fault demonstrably fired."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.engine import Engine
+
+    e = Engine(None)
+    idx = e.create_index("wchaos", {"properties": {
+        "body": {"type": "text"}, "n": {"type": "long"}}})
+    for i in range(2000):
+        idx.index_doc(f"seed{i}", {"body": f"stormy w{i % 37}", "n": i})
+    idx.refresh()
+    svc = e.serving
+    # the REST discipline: ONE engine thread serializes writes, wave
+    # stages, and the background folds the waves carry
+    pool = ThreadPoolExecutor(max_workers=1,
+                              thread_name_prefix="chaos-engine")
+    svc.bind_executor(pool.submit)
+    svc.set_enabled(True)
+    try:
+        faults.configure(
+            "refresh.build:once=1,error=error,match=segment_merge",
+            seed=SEED)
+        entry = svc.classify(
+            "wchaos", {"query": {"match": {"body": "stormy"}},
+                       "size": 5}, {})
+        assert entry is not None
+        stop = threading.Event()
+        search_errors: list = []
+        searches = {"n": 0}
+
+        def searcher():
+            while not stop.is_set():
+                try:
+                    r = svc.submit(dict(entry),
+                                   tenant="chaos").result(timeout=60)
+                    assert r["hits"]["total"]["value"] >= 2000, r["hits"]
+                    searches["n"] += 1
+                except Exception as ex:  # noqa: BLE001 - collected
+                    search_errors.append(ex)
+                    return
+
+        threads = [threading.Thread(target=searcher) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # writer: bursts + refreshes drive segments past the fold bound
+        # twice — the first fold eats the injected fault (swallowed +
+        # counted), the second converges
+        cap = idx.max_tail_segments()
+        written = 0
+
+        def _write_burst(burst, base_n):
+            for j in range(4):
+                idx.index_doc(f"w{burst}_{j}",
+                              {"body": f"stormy fresh w{j}",
+                               "n": 10_000 + base_n + j})
+            idx.refresh()
+
+        for burst in range(2 * (cap + 1)):
+            # writes ride the same single engine thread as the waves
+            pool.submit(_write_burst, burst, written).result(timeout=60)
+            written += 4
+            time.sleep(0.01)
+        deadline = time.time() + 60
+        while time.time() < deadline and (idx._merge_inflight
+                                          or len(idx._tails) > cap):
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not search_errors, f"search died mid-fold: {search_errors}"
+        st = faults.stats()
+        assert st["points"]["refresh.build"]["fired"] == 1, st
+        assert idx.counters.get("merge_failures", 0) == 1, idx.counters
+        assert len(idx._tails) <= cap, \
+            f"fold never converged: {len(idx._tails)} segments"
+        # final visibility: every acknowledged write is searchable
+        r = idx.search(query={"match_all": {}}, size=1)
+        assert r["hits"]["total"]["value"] == 2000 + written, r["hits"]
+        faults.clear()
+        return {"searches": searches["n"], "written": written,
+                "segments": len(idx._tails),
+                "merge_failures": idx.counters.get("merge_failures", 0),
+                "folds": idx.counters.get("segment_merge_total", 0)}
+    finally:
+        faults.clear()
+        svc.stop()
+        pool.shutdown(wait=True)
+        e.close()
+
+
 def main() -> int:
     print(f"[chaos] seed={SEED} requests={N_REQUESTS}")
     a = stage_a_cluster()
     print(f"[chaos] stage A (cluster scatter/gather): {a}")
     b = stage_b_engine()
     print(f"[chaos] stage B (engine closed loop): {b}")
+    d = stage_d_write_path()
+    print(f"[chaos] stage D (writers + searchers + build fault): {d}")
     print("[chaos] contract held: no hangs, no crashes, every response "
           "complete / valid-partial / clean 429-503")
     return 0

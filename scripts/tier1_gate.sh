@@ -53,6 +53,27 @@ bash scripts/chaos_gate.sh "${SEED}" \
             "the resilience contract regressed; reproduce with" \
             "scripts/chaos_gate.sh ${SEED}"
 
+# write-path fault subset (PR 15, ADVISORY): the tiered-refresh /
+# device-build / LSM suites run with ONE injected refresh.build fault
+# pinned to the background segment fold (match=segment_merge) — the
+# atomic-install + retry-on-next-refresh contract means the fault must
+# be invisible to every functional assertion (the recovery IS the
+# test; test_tiered_refresh.py::test_segment_fold_retry_converges is
+# written to pass with or without the armed schedule). Advisory like
+# the chaos gate; flip to `exit 1` to enforce once the fleet
+# calibrates.
+echo "[tier1-gate] write-path fault subset (advisory): one-shot refresh.build"
+ES_TPU_FAULTS="refresh.build:once=1,match=segment_merge" \
+    JAX_PLATFORMS=cpu timeout -k 10 420 python -m pytest \
+    tests/test_tiered_refresh.py tests/test_lsm_tiers.py \
+    tests/test_device_build.py tests/test_refresh_profile.py \
+    "${COMMON[@]}" -p no:randomly \
+    || echo "[tier1-gate] ADVISORY: write-path fault subset red —" \
+            "a refresh.build fault mid-fold leaked past the" \
+            "atomic-install contract; reproduce with" \
+            "ES_TPU_FAULTS=refresh.build:once=1,match=segment_merge" \
+            "pytest tests/test_lsm_tiers.py tests/test_tiered_refresh.py"
+
 # bench-regression lint (PR 9): when two or more BENCH_r*.json records
 # exist, diff the newest pair per config (QPS, latency pcts, per-kernel
 # mfu/bw_util) and fail on >20% regression. CPU-smoke records are

@@ -1,0 +1,279 @@
+"""Device-side index construction (PR 15, ROADMAP item 2): the build
+kernels in index/device_build must produce BYTE-IDENTICAL packs to the
+host loops they replace — the port changes where the work runs, never
+what it produces — and every device dispatch must ride the PR-13
+`build.*` cost-model entries (basis="device") so host-vs-device
+attribution works from day one.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.device_build import (
+    ann_tiles_device,
+    csr_blocked_scatter_device,
+    device_build_enabled,
+    kmeans_device,
+    use_device_build,
+)
+
+
+@pytest.fixture()
+def force_device_build(monkeypatch):
+    """Drop the size floor so tiny test corpora take the device path."""
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "1")
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD_MIN", "0")
+
+
+@pytest.fixture()
+def force_host_build(monkeypatch):
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "0")
+
+
+# ---------------------------------------------------------------------------
+# kernel-level byte parity vs the host twins
+# ---------------------------------------------------------------------------
+
+def _host_kmeans_reference(vectors, nlist, iters=8):
+    """The pre-PR-15 eager Lloyd loop, verbatim — the parity oracle."""
+    import jax.numpy as jnp
+
+    vecs = jnp.asarray(vectors, jnp.float32)
+    N, D = vecs.shape
+    C = max(1, min(nlist, N))
+    init_idx = (jnp.arange(C) * (N // C)).astype(jnp.int32)
+    centroids = vecs[init_idx]
+    for _ in range(iters):
+        logits = vecs @ centroids.T - 0.5 * jnp.sum(
+            centroids * centroids, axis=1)[None, :]
+        assign = jnp.argmax(logits, axis=1)
+        sums = jnp.zeros((C, D), jnp.float32).at[assign].add(vecs)
+        counts = jnp.zeros((C,), jnp.float32).at[assign].add(1.0)
+        centroids = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts[:, None], 1.0), centroids)
+    logits = vecs @ centroids.T - 0.5 * jnp.sum(
+        centroids * centroids, axis=1)[None, :]
+    assign = jnp.argmax(logits, axis=1)
+    return np.asarray(centroids), np.asarray(assign, np.int32)
+
+
+def test_kmeans_device_matches_eager_loop():
+    rng = np.random.default_rng(7)
+    V = rng.normal(size=(600, 24)).astype(np.float32)
+    ch, ah = _host_kmeans_reference(V, 10)
+    cd, ad, iters_run = kmeans_device(V, 10)
+    assert np.array_equal(ah, ad), "assignments diverged"
+    np.testing.assert_array_equal(ch, cd)
+    assert 1 <= iters_run <= 8
+
+
+def test_kmeans_convergence_exit_is_output_identical():
+    """tol=0 exits only at an exact fixed point, where further Lloyd
+    iterations are no-ops — so fewer iterations, identical output."""
+    rng = np.random.default_rng(3)
+    # two tight, well-separated blobs converge in very few iterations
+    V = np.concatenate([
+        rng.normal(0.0, 0.01, size=(64, 8)),
+        rng.normal(9.0, 0.01, size=(64, 8)),
+    ]).astype(np.float32)
+    c_full, a_full, _ = kmeans_device(V, 2, iters=64)
+    c_tol, a_tol, iters_run = kmeans_device(V, 2, iters=64, tol=0.0)
+    assert iters_run < 64, "converged clusters must exit early"
+    assert np.array_equal(a_full, a_tol)
+    np.testing.assert_array_equal(c_full, c_tol)
+
+
+def test_csr_blocked_scatter_matches_host_reduceat():
+    rng = np.random.default_rng(11)
+    BLOCK, TB, NPOST, N = 128, 97, 7000, 1500
+    # flat order is block-contiguous (term-major), like the real builder
+    dest_row = np.sort(rng.integers(1, TB, NPOST)).astype(np.int64)
+    dest_col = np.zeros(NPOST, np.int64)
+    for r in np.unique(dest_row):
+        sel = dest_row == r
+        dest_col[sel] = np.arange(sel.sum()) % BLOCK
+    fd = rng.integers(0, N, NPOST).astype(np.int32)
+    ft = (rng.random(NPOST) * 5 + 1).astype(np.float32)
+    fl = (rng.random(NPOST) * 9 + 1).astype(np.float32)
+    pd_, pt, pl, bm, bl = csr_blocked_scatter_device(
+        fd, ft, fl, dest_row, dest_col, TB, BLOCK, N)
+    # host twin (the pack.py numpy scatter + reduceat)
+    pdh = np.full((TB, BLOCK), N, np.int32)
+    pth = np.zeros((TB, BLOCK), np.float32)
+    plh = np.ones((TB, BLOCK), np.float32)
+    bmh = np.zeros(TB, np.float32)
+    blh = np.full(TB, np.inf, np.float32)
+    pdh[dest_row, dest_col] = fd
+    pth[dest_row, dest_col] = ft
+    plh[dest_row, dest_col] = fl
+    starts = np.flatnonzero(np.diff(dest_row, prepend=-1))
+    brows = dest_row[starts]
+    bmh[brows] = np.maximum.reduceat(ft, starts)
+    blh[brows] = np.minimum.reduceat(fl, starts)
+    for a, b in ((pdh, pd_), (pth, pt), (plh, pl), (bmh, bm), (blh, bl)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ann_tiles_device_matches_host_loop():
+    from elasticsearch_tpu.ann.quantize import scalar_quantize_int8
+
+    rng = np.random.default_rng(5)
+    V = rng.normal(size=(800, 16)).astype(np.float32)
+    _c, assign, _ = kmeans_device(V, 9)
+    present = np.arange(800)
+    C = 9
+    sizes = np.bincount(assign, minlength=C)
+    L = ((int(sizes.max()) + 127) // 128) * 128
+    # host twin: the pre-PR-15 per-cluster loop
+    order_h = np.full((C, L), -1, np.int32)
+    codes_h = np.zeros((C, L, 16), np.int8)
+    scale_h = np.zeros((C, L), np.float32)
+    offset_h = np.zeros((C, L), np.float32)
+    docids = present[np.argsort(assign, kind="stable")].astype(np.int32)
+    start = 0
+    for c in range(C):
+        n = int(sizes[c])
+        if n == 0:
+            continue
+        ids = docids[start:start + n]
+        order_h[c, :n] = ids
+        q, s, o = scalar_quantize_int8(V[ids])
+        codes_h[c, :n] = q
+        scale_h[c, :n] = s
+        offset_h[c, :n] = o
+        start += n
+    od, cd, sd, ofd = ann_tiles_device(
+        V, present.astype(np.int32), assign, C, L)
+    np.testing.assert_array_equal(order_h, od)
+    np.testing.assert_array_equal(codes_h, cd)
+    np.testing.assert_array_equal(scale_h, sd)  # byte parity, not approx
+    np.testing.assert_array_equal(offset_h, ofd)
+
+
+# ---------------------------------------------------------------------------
+# pack-level byte parity: device-built vs host-built ShardPack
+# ---------------------------------------------------------------------------
+
+def _build_text_pack(n_docs=400, seed=0):
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"body": {"type": "text"},
+                                 "title": {"type": "text"}}})
+    rng = np.random.default_rng(seed)
+    b = PackBuilder(m)
+    for i in range(n_docs):
+        words = " ".join(f"w{int(x) % 80}"
+                         for x in rng.integers(0, 80, 12))
+        b.add_document(m.parse_document(
+            {"body": words, "title": f"t{i % 13} common"}), doc_id=f"d{i}")
+    return b.build()
+
+
+def test_device_built_pack_bytes_equal_host_built(force_device_build,
+                                                  monkeypatch):
+    p_dev = _build_text_pack()
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "0")
+    p_host = _build_text_pack()
+    np.testing.assert_array_equal(p_host.post_docids, p_dev.post_docids)
+    np.testing.assert_array_equal(p_host.post_tfs, p_dev.post_tfs)
+    np.testing.assert_array_equal(p_host.post_dls, p_dev.post_dls)
+    np.testing.assert_array_equal(p_host.block_max_tf, p_dev.block_max_tf)
+    np.testing.assert_array_equal(p_host.block_min_len,
+                                  p_dev.block_min_len)
+    np.testing.assert_array_equal(p_host.impact_codes, p_dev.impact_codes)
+    np.testing.assert_array_equal(p_host.impact_ubf, p_dev.impact_ubf)
+    assert p_host.term_dict == p_dev.term_dict
+
+
+def _build_ann_index(seed=1):
+    from elasticsearch_tpu.ann import build_ann
+
+    rng = np.random.default_rng(seed)
+    V = rng.normal(size=(700, 12)).astype(np.float32)
+    has = np.ones(700, bool)
+    has[::37] = False
+    return build_ann(V, has, nlist=8)
+
+
+def test_device_built_ann_bytes_equal_host_built(force_device_build,
+                                                 monkeypatch):
+    a_dev = _build_ann_index()
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "0")
+    a_host = _build_ann_index()
+    assert a_dev is not None and a_host is not None
+    for key in ("centroids", "order", "codes", "scale", "offset"):
+        np.testing.assert_array_equal(a_host[key], a_dev[key],
+                                      err_msg=key)
+    assert a_host["nlist"] == a_dev["nlist"]
+    assert a_host["tile"] == a_dev["tile"]
+
+
+def test_device_built_engine_rank_parity(force_device_build, monkeypatch):
+    """End to end: an engine index built on the device path returns the
+    same ranked hits (ids AND scores) as one built on the host path."""
+    from elasticsearch_tpu.engine import Engine
+
+    def run():
+        e = Engine(None)
+        e.create_index("p", {"properties": {"body": {"type": "text"}}})
+        idx = e.indices["p"]
+        rng = np.random.default_rng(2)
+        for i in range(500):
+            idx.index_doc(f"d{i}", {"body": " ".join(
+                f"w{int(x) % 60}" for x in rng.integers(0, 60, 9))})
+        idx.refresh()
+        out = []
+        for q in ({"match": {"body": "w1 w2 w3"}},
+                  {"term": {"body": "w7"}}):
+            r = idx.search(query=q, size=15)
+            out.append([(h["_id"], h["_score"])
+                        for h in r["hits"]["hits"]])
+        return out
+
+    dev = run()
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "0")
+    host = run()
+    assert dev == host
+
+
+# ---------------------------------------------------------------------------
+# attribution: the device dispatches ride the PR-13 build.* entries
+# ---------------------------------------------------------------------------
+
+def test_device_build_stages_report_basis_and_utilization(
+        force_device_build):
+    from elasticsearch_tpu.telemetry import collect_profile_events
+
+    with collect_profile_events() as events:
+        _build_text_pack(n_docs=150, seed=4)
+        _build_ann_index(seed=6)
+    by_kernel = {}
+    for ev in events:
+        if ev.get("kind") == "kernel":
+            by_kernel.setdefault(ev["kernel"], []).append(ev)
+    for name in ("build.kmeans", "build.ann_tiles",
+                 "build.csr_assemble", "build.impact_quantize"):
+        assert name in by_kernel, f"missing dispatch for {name}"
+        # the postings csr_assemble runs on device; the position-keys
+        # dispatch of the same kernel stays host (basis="host") — at
+        # least one device-basis dispatch must exist per ported stage
+        devs = [ev for ev in by_kernel[name]
+                if ev.get("basis") == "device"]
+        assert devs, (name, [ev.get("basis") for ev in by_kernel[name]])
+        ev = devs[-1]
+        # the PR-13 cost model attributes the dispatch: mfu/bw_util ride
+        # the event (the C7 arm's device_utilization readout)
+        assert ev.get("flops", 0) > 0 and ev.get("bytes", 0) > 0, name
+        assert "mfu" in ev and "bw_util" in ev, name
+
+
+def test_gate_honors_env(monkeypatch):
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "0")
+    assert not device_build_enabled()
+    assert not use_device_build(1 << 30)
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD", "1")
+    monkeypatch.setenv("ES_TPU_DEVICE_BUILD_MIN", "100")
+    assert use_device_build(100)
+    assert not use_device_build(99)

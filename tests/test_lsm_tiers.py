@@ -1,0 +1,336 @@
+"""LSM tiered refresh (PR 15): N sealed tail segments instead of the
+(base, tail) pair, background DEVICE merges scheduled through the
+serving queue as the low-weight `_merge` tenant, and the atomic-install
+contract under injected `refresh.build` faults.
+
+The standing invariants:
+  - every incremental refresh packs ONLY the new docs (O(new), not
+    O(tail union)); visibility and scores match a full rebuild for
+    pure additions;
+  - updates/deletes flip live bits in whichever tier holds the old
+    copy — base or an older segment — so the newest copy always wins;
+  - beyond `indexing.tiers.max_segments` a fold merges the tail
+    segments (inline without serving; through the weighted-RR queue
+    with it), and a full search wave never starves the merge NOR the
+    merge the searches;
+  - a fault mid-merge leaves every segment fully serving (merge
+    installs atomically or not at all).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.engine import Engine
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "long"}}}
+
+
+def _fill(idx, n, seed=0, prefix="d", start=0):
+    rng = np.random.default_rng(seed)
+    for i in range(start, start + n):
+        words = " ".join(f"w{int(x) % 40}" for x in rng.integers(0, 40, 6))
+        idx.index_doc(f"{prefix}{i}", {"body": words, "n": i})
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# segment accumulation + visibility
+# ---------------------------------------------------------------------------
+
+def test_each_incremental_refresh_seals_one_segment():
+    e = Engine(None)
+    e.create_index("t", MAPPING)
+    idx = e.indices["t"]
+    _fill(idx, 2000)
+    idx.refresh()
+    base = idx._searcher
+    for burst in range(3):
+        _fill(idx, 10, seed=burst + 1, prefix=f"s{burst}_")
+        idx.refresh()
+        assert idx._searcher is base, "base must stay sealed"
+        assert len(idx._tails) == burst + 1
+        # each segment holds exactly its own burst
+        assert sum(len(lst)
+                   for lst in idx._tails[burst].shard_docs) == 10
+    st = idx.tier_stats()
+    assert st["segments"] == 3 and st["tail_docs"] == 30
+    r = idx.search(query={"match_all": {}}, size=1)
+    assert r["hits"]["total"]["value"] == 2030
+
+
+def test_segmented_search_matches_full_rebuild():
+    e1 = Engine(None)
+    e1.create_index("a", MAPPING)
+    i1 = e1.indices["a"]
+    _fill(i1, 1500, seed=1)
+    i1.refresh()
+    for burst in range(3):
+        _fill(i1, 12, seed=10 + burst, prefix=f"x{burst}_")
+        i1.refresh()
+    assert len(i1._tails) == 3
+
+    e2 = Engine(None)
+    e2.create_index("a", MAPPING)
+    i2 = e2.indices["a"]
+    _fill(i2, 1500, seed=1)
+    for burst in range(3):
+        _fill(i2, 12, seed=10 + burst, prefix=f"x{burst}_")
+    i2.refresh()
+    assert not i2._tails
+
+    for q in ({"match": {"body": "w1 w2"}}, {"term": {"body": "w3"}},
+              {"match_all": {}}):
+        r1 = i1.search(query=q, size=15)
+        r2 = i2.search(query=q, size=15)
+        assert r1["hits"]["total"] == r2["hits"]["total"], q
+        assert ([h["_id"] for h in r1["hits"]["hits"]]
+                == [h["_id"] for h in r2["hits"]["hits"]]), q
+        np.testing.assert_allclose(
+            [h["_score"] for h in r1["hits"]["hits"]],
+            [h["_score"] for h in r2["hits"]["hits"]], rtol=1e-5)
+        assert i1.count(q) == i2.count(q)
+
+
+def test_update_supersedes_older_segment_copy():
+    """A doc written after the base seal then updated in a later burst:
+    the older segment's copy must flip dead, the newest must win."""
+    e = Engine(None)
+    e.create_index("u", MAPPING)
+    idx = e.indices["u"]
+    _fill(idx, 1200, seed=2)
+    idx.refresh()
+    idx.index_doc("late", {"body": "version one unique", "n": 1})
+    idx.refresh()
+    assert len(idx._tails) == 1
+    idx.index_doc("late", {"body": "version two unique", "n": 2})
+    idx.refresh()
+    assert len(idx._tails) == 2
+    r = idx.search(query={"match": {"body": "unique"}}, size=5)
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["late"]
+    assert r["hits"]["hits"][0]["_source"]["n"] == 2
+    # ... and a segment-resident doc can be deleted
+    idx.delete_doc("late")
+    idx.refresh()
+    r = idx.search(query={"match": {"body": "unique"}}, size=5)
+    assert r["hits"]["total"]["value"] == 0
+    assert idx.count({"match_all": {}}) == 1200
+
+
+def test_segment_bound_triggers_inline_fold_without_serving():
+    e = Engine(None)
+    e.create_index("f", MAPPING)
+    idx = e.indices["f"]
+    _fill(idx, 3000, seed=3)
+    idx.refresh()
+    base = idx._searcher
+    cap = idx.max_tail_segments()
+    for burst in range(cap + 1):
+        _fill(idx, 5, seed=20 + burst, prefix=f"b{burst}_")
+        idx.refresh()
+    # the fold ran inline (no serving front end): ONE merged segment,
+    # base untouched, everything still visible
+    assert idx._searcher is base
+    assert len(idx._tails) == 1
+    assert idx.counters.get("segment_merge_total", 0) >= 1
+    r = idx.search(query={"match_all": {}}, size=1)
+    assert r["hits"]["total"]["value"] == 3000 + 5 * (cap + 1)
+    # the recorder saw the fold as its own refresh kind
+    prof = [p for p in e.refresh_recorder.profiles()["profiles"]
+            if p["kind"] == "segment_merge"]
+    assert prof and prof[-1]["tiers"]["segments"] == 1
+
+
+# ---------------------------------------------------------------------------
+# merge scheduling priority (the weighted-RR contract, satellite 3)
+# ---------------------------------------------------------------------------
+
+def _serving_engine(tmp_path_factory=None):
+    e = Engine(None)
+    idx = e.create_index("m", MAPPING)
+    _fill(idx, 2500, seed=4)
+    idx.refresh()
+    svc = e.serving
+    svc.set_enabled(True)
+    return e, idx, svc
+
+
+def test_background_merge_never_starves_search():
+    """A background device merge queued behind a full search wave: every
+    concurrent search completes with a bounded in-test p99 while the
+    merge holds only its weighted-RR slot — then the merge itself
+    completes under sustained search load (never starved either way)."""
+    e, idx, svc = _serving_engine()
+    try:
+        cap = idx.max_tail_segments()
+        for burst in range(cap):
+            _fill(idx, 4, seed=40 + burst, prefix=f"m{burst}_")
+            idx.refresh()
+        assert len(idx._tails) == cap and not idx.merge_pending()
+        entry = svc.classify("m", {"query": {"match": {"body": "w1"}},
+                                   "size": 5}, {})
+        assert entry is not None
+        svc.submit(dict(entry), tenant="warm").result(timeout=60)
+
+        # one more refresh crosses the bound and queues the background
+        # merge; immediately flood the queue with searches
+        _fill(idx, 4, seed=99, prefix="last_")
+        idx.refresh()
+        assert idx._merge_inflight or len(idx._tails) == 1
+        lat = []
+        futs = []
+        t0 = time.monotonic()
+        for i in range(64):
+            futs.append((time.monotonic(),
+                         svc.submit(dict(entry), tenant=f"c{i % 8}")))
+        for ts, f in futs:
+            r = f.result(timeout=60)
+            lat.append(time.monotonic() - ts)
+            assert r["hits"]["total"]["value"] >= 1
+        # no search starvation: the whole flood drains promptly even
+        # with the merge in the queue (generous CPU-smoke bound)
+        p99 = sorted(lat)[int(len(lat) * 0.99) - 1]
+        assert p99 < 30.0, f"search p99 {p99:.1f}s under merge load"
+        # no merge starvation: the fold completes under search load
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(idx._tails) != 1:
+            svc.submit(dict(entry), tenant="keepalive").result(timeout=60)
+            time.sleep(0.01)
+        assert len(idx._tails) == 1, "merge starved by search load"
+        assert svc.counters["merges"] >= 1
+        assert not idx._merge_inflight
+        # post-merge: results still complete and correct
+        r = svc.submit(dict(entry), tenant="after").result(timeout=60)
+        assert r["hits"]["total"]["value"] >= 1
+        assert idx.search(query={"match_all": {}}, size=1)[
+            "hits"]["total"]["value"] == 2500 + 4 * (cap + 1)
+    finally:
+        svc.stop()
+        e.close()
+
+
+def test_merge_tenant_weight_is_dynamic():
+    e, idx, svc = _serving_engine()
+    try:
+        assert svc._tenants.weights.get("_merge") == pytest.approx(1.0)
+        e.settings.update({"transient": {"serving.merge.weight": 3.0}})
+        assert svc._tenants.weights.get("_merge") == pytest.approx(3.0)
+        # user tenant-weight updates must not clobber the merge weight
+        e.settings.update({"transient": {
+            "serving.tenant.weights": "gold:4"}})
+        assert svc._tenants.weights.get("_merge") == pytest.approx(3.0)
+        assert svc._tenants.weights.get("gold") == pytest.approx(4.0)
+    finally:
+        svc.stop()
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# fault atomicity (satellite 1): merge installs atomically or not at all
+# ---------------------------------------------------------------------------
+
+def _result_snapshot(idx):
+    out = []
+    for q in ({"match": {"body": "w1 w2"}}, {"match_all": {}}):
+        r = idx.search(query=q, size=10)
+        out.append((r["hits"]["total"]["value"],
+                    [(h["_id"], round(h["_score"] or 0, 5))
+                     for h in r["hits"]["hits"]]))
+    return out
+
+
+def test_fault_mid_merge_leaves_segments_fully_serving():
+    e = Engine(None)
+    e.create_index("c", MAPPING)
+    idx = e.indices["c"]
+    _fill(idx, 1800, seed=5)
+    idx.refresh()
+    for burst in range(3):
+        _fill(idx, 6, seed=60 + burst, prefix=f"c{burst}_")
+        idx.refresh()
+    assert len(idx._tails) == 3
+    before = _result_snapshot(idx)
+    segs_before = list(idx._tails)
+    tail_pos_before = dict(idx._tail_pos)
+
+    faults.configure("refresh.build:once=1,match=merge")
+    with pytest.raises(faults.InjectedFault):
+        idx._merge_tail_segments()
+    # atomic or not at all: no half-built segment is visible anywhere
+    assert idx._tails == segs_before
+    assert idx._tail_pos == tail_pos_before
+    assert _result_snapshot(idx) == before
+    st = faults.stats()
+    assert st["points"]["refresh.build"]["fired"] == 1
+    faults.clear()
+    # the retry succeeds and serves the identical results
+    assert idx._merge_tail_segments()
+    assert len(idx._tails) == 1
+    assert _result_snapshot(idx) == before
+
+
+def test_background_merge_fault_is_swallowed_and_counted():
+    """Through the serving queue, a faulted merge must cost nothing but
+    a counter: searches keep serving the old segments, and the next
+    scheduled fold (fault cleared) succeeds."""
+    e, idx, svc = _serving_engine()
+    try:
+        cap = idx.max_tail_segments()
+        for burst in range(cap):
+            _fill(idx, 4, seed=70 + burst, prefix=f"g{burst}_")
+            idx.refresh()
+        before = _result_snapshot(idx)
+        faults.configure("refresh.build:once=1,match=merge")
+        _fill(idx, 4, seed=98, prefix="trip_")
+        idx.refresh()  # schedules the background fold, which will fault
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and idx._merge_inflight:
+            time.sleep(0.01)
+        assert idx.counters.get("merge_failures", 0) == 1
+        assert len(idx._tails) == cap + 1, "faulted fold must not install"
+        # searches kept serving through the faulted fold
+        r = idx.search(query={"match_all": {}}, size=1)
+        assert r["hits"]["total"]["value"] == 2500 + 4 * (cap + 1)
+        faults.clear()
+        _fill(idx, 4, seed=97, prefix="after_")
+        idx.refresh()  # reschedules; this fold succeeds
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(idx._tails) != 1:
+            time.sleep(0.01)
+        assert len(idx._tails) == 1
+        del before
+    finally:
+        svc.stop()
+        e.close()
+
+
+def test_fault_mid_major_merge_keeps_tiers():
+    """The force-merge path (`searcher` property) has the same atomic
+    contract: a faulted major merge propagates the error but leaves
+    base + segments serving."""
+    e = Engine(None)
+    e.create_index("j", MAPPING)
+    idx = e.indices["j"]
+    _fill(idx, 900, seed=6)
+    idx.refresh()
+    _fill(idx, 5, seed=61, prefix="t_")
+    idx.refresh()
+    assert len(idx._tails) == 1
+    before = _result_snapshot(idx)
+    faults.configure("refresh.build:once=1,match=merge")
+    with pytest.raises(faults.InjectedFault):
+        _ = idx.searcher  # force-merge ahead of a non-tier-aware feature
+    assert len(idx._tails) == 1
+    assert _result_snapshot(idx) == before
+    faults.clear()
+    s = idx.searcher
+    assert s is idx._searcher and not idx._tails

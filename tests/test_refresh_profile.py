@@ -141,7 +141,8 @@ def test_tail_fraction_against_hand_built_tiers():
         _index_docs(idx, 0, 300)
         idx.refresh()
         t = idx.tier_stats()
-        assert t == {"base_docs": 300, "tail_docs": 0, "tail_fraction": 0.0}
+        assert t == {"base_docs": 300, "tail_docs": 0, "tail_fraction": 0.0,
+                     "segments": 0}
         _index_docs(idx, 300, 330, word="beta")
         idx.refresh()  # incremental: 30-doc tail beside the 300-doc base
         t = idx.tier_stats()
@@ -151,7 +152,8 @@ def test_tail_fraction_against_hand_built_tiers():
                 if p["index"] == "t"][-1]
         assert prof["kind"] == "incremental"
         assert prof["tail_fraction"] == pytest.approx(30 / 330, abs=1e-6)
-        assert prof["tiers"] == {"base_docs": 300, "tail_docs": 30}
+        assert prof["tiers"] == {"base_docs": 300, "tail_docs": 30,
+                                 "segments": 1}
         # deleting a base doc shrinks base_live, not the tail
         idx.delete_doc("0")
         idx.refresh()
@@ -160,7 +162,8 @@ def test_tail_fraction_against_hand_built_tiers():
         # merge folds the tail back: fraction returns to 0
         _ = idx.searcher
         assert idx.tier_stats() == {
-            "base_docs": 329, "tail_docs": 0, "tail_fraction": 0.0}
+            "base_docs": 329, "tail_docs": 0, "tail_fraction": 0.0,
+            "segments": 0}
     finally:
         e.close()
 

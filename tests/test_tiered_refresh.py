@@ -152,6 +152,35 @@ def test_tail_growth_triggers_merge():
     assert r["hits"]["total"]["value"] == 700
 
 
+def test_segment_fold_retry_converges():
+    """LSM fold convergence (PR 15), written to hold WITH OR WITHOUT an
+    armed one-shot `refresh.build:match=segment_merge` fault (the
+    tier-1 advisory write-path stage): a faulted background fold
+    installs nothing — atomic or not at all — and the next refresh past
+    the segment bound retries it, so the tail always converges to one
+    merged segment."""
+    e = Engine(None)
+    e.create_index("lsm", MAPPING)
+    idx = e.indices["lsm"]
+    _fill(idx, 3000, seed=11)
+    idx.refresh()
+    cap = idx.max_tail_segments()
+    for burst in range(cap + 1):
+        _fill(idx, 5, seed=30 + burst, prefix=f"r{burst}_")
+        idx.refresh()
+    tries = 0
+    while len(idx._tails) > 1 and tries < 3:
+        # a faulted fold (swallowed + counted) retries on the next
+        # refresh that crosses the bound
+        idx.index_doc(f"retry{tries}", {"body": "w1 retry", "n": -1,
+                                        "tag": "r"})
+        idx.refresh()
+        tries += 1
+    assert len(idx._tails) == 1, "fold never converged"
+    r = idx.search(query={"match_all": {}}, size=1)
+    assert r["hits"]["total"]["value"] == 3000 + 5 * (cap + 1) + tries
+
+
 def test_pinned_scroll_survives_incremental_refresh():
     """A scroll/PIT pin is an immutable snapshot: later incremental
     refreshes must not flip its live bits or drift its stats."""
