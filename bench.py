@@ -98,22 +98,35 @@ def sample_queries(rng, lens, tok, n_queries, terms_per_query=TERMS_PER_QUERY):
     return out
 
 
-def build_pack(lens, tok, dense_min_df=None):
-    from elasticsearch_tpu.index.mappings import Mappings
-    from elasticsearch_tpu.index.pack import PackBuilder
-    from elasticsearch_tpu.monitoring.refresh_profile import refresh_stage
-
-    m = Mappings({"properties": {"body": {"type": "text"}}})
-    b = PackBuilder(m)
+def corpus_docs(lens, tok):
+    """Materialize the synthetic corpus as parse_document-shaped docs.
+    This is HARNESS work (token string joins over the whole corpus) —
+    callers that profile the build hoist it out of the timed region so
+    build_profile grades the ingest path, not the generator; r12 and
+    earlier timed these joins inside the analyze stage (BENCH_NOTES
+    round 20)."""
     term_strs = np.array([f"t{i}" for i in range(VOCAB)])
     doc_terms = term_strs[tok]
     off = 0
-    # attributed as the analyze stage of the build_profile record (the
-    # engine path marks the same stage in parallel/stacked.py)
-    with refresh_stage("analyze"):
-        for ln in lens:
-            b.add_document({"body": [" ".join(doc_terms[off : off + ln])]})
-            off += ln
+    docs = []
+    for ln in lens:
+        docs.append({"body": [" ".join(doc_terms[off : off + ln])]})
+        off += ln
+    return docs
+
+
+def build_pack(lens, tok, dense_min_df=None, docs=None):
+    from elasticsearch_tpu.index.mappings import Mappings
+    from elasticsearch_tpu.index.pack import PackBuilder
+
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    b = PackBuilder(m)
+    if docs is None:
+        docs = corpus_docs(lens, tok)
+    # PR 16: batch-vectorized analysis (analysis/batched.py) replaces
+    # the per-doc Analyzer.analyze loop; stage attribution (analyze or
+    # build.analyze per ES_TPU_ANALYZE) happens inside the batch path
+    b.add_documents_batch(docs)
     return b.build(dense_min_df=dense_min_df), m
 
 
@@ -1452,6 +1465,111 @@ def config6_serving(rng):
     }
 
 
+def _analyze_readout(idx, ind):
+    """PR 16 ingest readout: where analysis time went (host `analyze`
+    loop vs batched/device `build.analyze`), what fraction of the write
+    path it is, and how much of it was hidden under builds by the
+    depth-1 analyze/build overlap (summed per-profile overlap ms)."""
+    from elasticsearch_tpu.analysis.batched import analyze_mode
+    from elasticsearch_tpu.monitoring.refresh_profile import recorder_for
+
+    stage_ms = ind.get("stage_ms") or {}
+    analyze_ms = {k: v for k, v in stage_ms.items()
+                  if k in ("analyze", "build.analyze")}
+    total = sum(stage_ms.values())
+    profs = recorder_for(idx).profiles()["profiles"]
+    overlap = sum(p.get("analyze_overlap_ms", 0.0) for p in profs)
+    return {
+        "mode": analyze_mode(),
+        "stage_ms": {k: round(v, 3) for k, v in analyze_ms.items()},
+        "fraction_of_write_path": (
+            round(sum(analyze_ms.values()) / total, 6) if total else None),
+        "overlap_ms": round(overlap, 3),
+    }
+
+
+def _ingest_burst_ab(rng, n_docs):
+    """Pure write-path A/B (PR 16): one corpus through a fresh 2-shard
+    in-memory engine index via batched `_bulk` + one refresh — auto
+    analysis (native/batched/device per backend, depth-1 analyze/build
+    overlap across the 2 shard builders) vs the ES_TPU_ANALYZE=host
+    per-doc oracle. No search load: this isolates the ingest docs/s the
+    closed loop can't (there, wall is search-bound). The refresh
+    profiles carry the overlap timestamps the acceptance asks for."""
+    from elasticsearch_tpu.engine.engine import Engine
+    from elasticsearch_tpu.monitoring.refresh_profile import recorder_for
+
+    lens2, tok2 = build_corpus(rng, n_docs=n_docs)
+    term_strs = np.array([f"t{i}" for i in range(VOCAB)])
+    doc_terms = term_strs[tok2]
+    bodies = []
+    off = 0
+    for ln in lens2:
+        bodies.append(" ".join(doc_terms[off:off + ln]))
+        off += ln
+    def one_run(env):
+        saved = os.environ.pop("ES_TPU_ANALYZE", None)
+        if env:
+            os.environ["ES_TPU_ANALYZE"] = env
+        try:
+            engine = Engine(None)
+            idx = engine.create_index(
+                "ingest_ab", {"properties": {"body": {"type": "text"}}},
+                settings={"number_of_shards": 2})
+            t0 = time.perf_counter()
+            chunk = 1000
+            for s in range(0, len(bodies), chunk):
+                ops = [("index", "ingest_ab", f"d{s + j}", {"body": b})
+                       for j, b in enumerate(bodies[s:s + chunk])]
+                res = engine.bulk(ops)
+                assert not res["errors"], res
+            idx.refresh()
+            wall = time.perf_counter() - t0
+            profs = recorder_for(idx).profiles()["profiles"]
+            stages: dict = {}
+            overlap = 0.0
+            for p in profs:
+                for k, v in (p.get("stages_ms") or {}).items():
+                    stages[k] = stages.get(k, 0.0) + v
+                overlap += p.get("analyze_overlap_ms", 0.0)
+            return {
+                "wall_ms": round(wall * 1e3, 1),
+                "docs_per_s": round(len(bodies) / wall, 1),
+                "stages_ms": {k: round(v, 2) for k, v in stages.items()},
+                "analyze_overlap_ms": round(overlap, 2),
+            }
+        finally:
+            os.environ.pop("ES_TPU_ANALYZE", None)
+            if saved is not None:
+                os.environ["ES_TPU_ANALYZE"] = saved
+
+    # One untimed pass compiles the build-kernel shape family (csr
+    # scatter, impact quantize) so neither timed arm pays the one-time
+    # XLA compile — the preflight discipline applied to the write path.
+    # Then alternate the arms over REPS repetitions and keep each arm's
+    # best (min-wall) rep: on a shared CPU host the run-to-run scatter
+    # (~15% of wall from scheduler/allocator noise) exceeds the ~10%
+    # analysis delta, and the min statistic is the standard way to read
+    # through it (the per-rep walls are recorded so the scatter is
+    # visible, not hidden).
+    one_run(None)
+    reps = 3
+    arms = (("batched_auto", None), ("host_perdoc", "host"))
+    runs: dict = {label: [] for label, _ in arms}
+    for _ in range(reps):
+        for label, env in arms:
+            runs[label].append(one_run(env))
+    out = {}
+    for label, _ in arms:
+        best = min(runs[label], key=lambda r: r["wall_ms"])
+        best["rep_walls_ms"] = [r["wall_ms"] for r in runs[label]]
+        out[label] = best
+    out["ingest_speedup"] = round(
+        out["host_perdoc"]["wall_ms"]
+        / max(out["batched_auto"]["wall_ms"], 1e-9), 2)
+    return out
+
+
 def config7_mixed(rng):
     """C7 closed-loop mixed read/write arm (ROADMAP item 2 done-
     criterion, PR 15): N writer clients sustain bursts + refreshes while
@@ -1528,13 +1646,18 @@ def config7_mixed(rng):
     lag_samples: list[float] = []
 
     def _write_burst(wid, burst_no, n):
-        for j in range(n):
-            idx.index_doc(f"c7w{wid}_{burst_no}_{j}",
-                          {"body": " ".join(
-                              f"t{int(x)}" for x in
-                              np.random.default_rng(
-                                  wid * 100_003 + burst_no * 131 + j)
-                              .integers(0, VOCAB, 8))})
+        # one batched _bulk per burst (PR 16): index-name resolution and
+        # pipeline-settings lookups amortize across the run instead of
+        # repeating per doc — the log/metrics-firehose front door
+        ops = [("index", "c7", f"c7w{wid}_{burst_no}_{j}",
+                {"body": " ".join(
+                    f"t{int(x)}" for x in
+                    np.random.default_rng(
+                        wid * 100_003 + burst_no * 131 + j)
+                    .integers(0, VOCAB, 8))})
+               for j in range(n)]
+        res = engine.bulk(ops)
+        assert not res["errors"], res
         idx.refresh()
 
     def writer(wid):
@@ -1640,6 +1763,8 @@ def config7_mixed(rng):
             "docs_per_s_ema": ind.get("docs_per_s_ema"),
             "refresh_kinds": ind.get("refresh_kinds"),
             "refresh_lag_ms_max": round(max(lag_samples, default=0.0), 2),
+            "analyze": _analyze_readout(idx, ind),
+            "burst_ab": _ingest_burst_ab(rng, n_docs),
         },
         "tiers": {
             "tail_fraction_max": round(max_tail, 6),
@@ -1854,9 +1979,12 @@ def main():
         log("[pack] building 1M-doc text pack...")
         t0 = time.perf_counter()
         # build_profile (PR 13): the C1 host-build baseline record — the
-        # per-stage split the item-2 device port is graded against
+        # per-stage split the item-2 device port is graded against.
+        # Corpus string materialization happens before the timed region
+        # (PR 16): it is generator work, not ingest
+        _c1_docs = corpus_docs(lens, tok)
         (pack, m), c1_build = _build_profile_arm(
-            lambda: build_pack(lens, tok), N_DOCS)
+            lambda: build_pack(lens, tok, docs=_c1_docs), N_DOCS)
         extras.setdefault("build_profile", {})["c1_pack"] = c1_build
         _write_record(extras, partial=True)
         log(f"[pack] built in {time.perf_counter()-t0:.0f}s; "

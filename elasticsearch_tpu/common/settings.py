@@ -263,6 +263,11 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True),
         Setting("slo.write.refresh_lag_ms", 0.0, Setting.float_,
                 dynamic=True),
+        # PR 16: bound the share of cumulative build-stage time spent in
+        # text analysis (build.analyze + host `analyze`) — the
+        # vectorized-ingest invariant; 0 disables like the other floors
+        Setting("slo.write.analyze_fraction", 0.0, Setting.float_,
+                dynamic=True),
         Setting("slo.custom", "", str, dynamic=True),
         # continuous-batching serving front end (serving/): admission,
         # coalescing into device waves, deadline/fairness scheduling,

@@ -3447,43 +3447,101 @@ class Engine:
                 })
         return total
 
+    def resolve_pipelines(self, idx, pipeline: str | None = None
+                          ) -> tuple[str | None, str | None]:
+        """Resolve the (request pipeline | default_pipeline) +
+        final_pipeline chain for one index ONCE — the per-(index,
+        request) hoist: a 10k-doc _bulk reads the settings once instead
+        of four setting lookups per item (reference behavior:
+        IngestService resolves pipelines per bulk shard request, not
+        per doc). -> (first, final), either None when nothing applies."""
+        settings = idx.settings if idx is not None else {}
+        first = pipeline if pipeline not in (None, "_none") else None
+        if first is None and pipeline != "_none":
+            dp = (settings.get("default_pipeline")
+                  or settings.get("index.default_pipeline"))
+            if dp and dp != "_none":
+                first = dp
+        final = (settings.get("final_pipeline")
+                 or settings.get("index.final_pipeline"))
+        if not final or final == "_none":
+            final = None
+        return first, final
+
+    def run_pipelines_resolved(self, index_name: str, source: dict,
+                               first: str | None, final: str | None,
+                               doc_id: str | None = None):
+        """Apply an already-resolved pipeline chain to one doc. Returns
+        the transformed source, or None if a drop processor fired."""
+        for name in (first, final):
+            if not name:
+                continue
+            source = self.ingest.execute(name, source, index=index_name,
+                                         doc_id=doc_id)
+            if source is None:
+                return None
+        return source
+
     def run_pipelines(self, index_name: str, source: dict,
                       pipeline: str | None = None, doc_id: str | None = None):
         """Apply request/default pipeline then final_pipeline (reference
         behavior: IngestService.executeBulkRequest + the
         index.default_pipeline / index.final_pipeline settings). Returns the
         transformed source, or None if a drop processor fired."""
-        idx = self.indices.get(index_name)
-        settings = idx.settings if idx is not None else {}
-        first = pipeline if pipeline not in (None, "_none") else None
-        if first is None and pipeline != "_none":
-            dp = settings.get("default_pipeline") or settings.get("index.default_pipeline")
-            if dp and dp != "_none":
-                first = dp
-        for name in (first, settings.get("final_pipeline") or settings.get("index.final_pipeline")):
-            if not name or name == "_none":
-                continue
-            source = self.ingest.execute(name, source, index=index_name, doc_id=doc_id)
-            if source is None:
-                return None
-        return source
+        first, final = self.resolve_pipelines(
+            self.indices.get(index_name), pipeline)
+        return self.run_pipelines_resolved(index_name, source, first, final,
+                                           doc_id)
 
     def bulk(self, operations: list,
              pipeline: str | None = None):
         """operations: (action, index, id, source[, routing]). Returns
         per-item results; failures are per-item, not transactional
         (reference behavior: TransportShardBulkAction.java:308
-        executeBulkItemRequest)."""
-        items = []
+        executeBulkItemRequest).
+
+        PR 16 front door: write-alias resolution and pipeline-settings
+        lookups are cached per (raw index name, request), and runs of
+        consecutive index/create items sharing a pipeline chain execute
+        through IngestService.execute_batch — one registry lookup + one
+        ingest timestamp per run instead of per doc — while every
+        per-item error envelope and result stays identical to the
+        per-doc path (asserted by tests/test_ingest.py)."""
+        from ..utils.errors import ElasticsearchTpuError
+
+        items: list = []
         errors = False
+        name_cache: dict = {}   # raw name -> (concrete index name, EsIndex)
+        pipe_cache: dict = {}   # concrete name -> (first, final)
+
+        def _item_error(action, index_name, doc_id, ex):
+            nonlocal errors
+            errors = True
+            if isinstance(ex, ElasticsearchTpuError):
+                err = {"type": ex.type, "reason": ex.reason}
+                status = ex.status
+            else:
+                err = {"type": "exception", "reason": str(ex)}
+                status = 500
+            return {action: {"_index": index_name, "_id": doc_id,
+                             "status": status, "error": err}}
+
+        # pass 1: resolve targets + pipeline chains, validate ts-mode
+        resolved: list = []  # per op: (action, name, idx, doc_id, source,
+        #                               err_item | None)
         for op_tuple in operations:
             action, index_name, doc_id, source = op_tuple[:4]
             routing = op_tuple[4] if len(op_tuple) > 4 else None
             try:
-                # resolve write alias up front so ingest pipeline settings and
-                # item results both see the concrete index
-                index_name = self.resolve_write_index(index_name)
-                idx = self.get_or_autocreate(index_name)
+                # resolve write alias + target index once per raw name so
+                # ingest pipeline settings and item results both see the
+                # concrete index without per-doc lookups
+                cached = name_cache.get(index_name)
+                if cached is None:
+                    concrete = self.resolve_write_index(index_name)
+                    cached = name_cache[index_name] = (
+                        concrete, self.get_or_autocreate(concrete))
+                index_name, idx = cached
                 if idx.ts_mode is not None:
                     if routing is not None:
                         raise IllegalArgumentError(
@@ -3495,8 +3553,54 @@ class Engine:
                             f"update is not supported because the "
                             f"destination index [{index_name}] is in time "
                             f"series mode")
+                if index_name not in pipe_cache:
+                    pipe_cache[index_name] = self.resolve_pipelines(
+                        idx, pipeline)
+                resolved.append((action, index_name, idx, doc_id, source,
+                                 None))
+            except Exception as ex:  # noqa: BLE001 - per-item envelope
+                resolved.append((action, index_name, None, doc_id, source,
+                                 _item_error(action, index_name, doc_id,
+                                             ex)))
+
+        # pass 2: batched pipeline execution over consecutive
+        # index/create runs sharing one (index, chain); outcomes are
+        # per-doc (dict | None dropped | Exception), never a raised error
+        transformed: dict[int, object] = {}
+        i = 0
+        n = len(resolved)
+        while i < n:
+            action, index_name, idx, doc_id, source, err = resolved[i]
+            chain = pipe_cache.get(index_name, (None, None))
+            if (err is not None or action not in ("index", "create")
+                    or chain == (None, None)):
+                i += 1
+                continue
+            j = i
+            while (j < n and resolved[j][5] is None
+                   and resolved[j][0] in ("index", "create")
+                   and resolved[j][1] == index_name):
+                j += 1
+            outs = self.ingest.execute_batch(
+                chain, [resolved[k][4] for k in range(i, j)],
+                index=index_name,
+                doc_ids=[resolved[k][3] for k in range(i, j)])
+            for k, out in zip(range(i, j), outs):
+                transformed[k] = out
+            i = j
+
+        # pass 3: apply, in original order, with per-item envelopes
+        for k, (action, index_name, idx, doc_id, source, err) in (
+                enumerate(resolved)):
+            if err is not None:
+                items.append(err)
+                continue
+            try:
                 if action in ("index", "create"):
-                    source = self.run_pipelines(index_name, source, pipeline, doc_id)
+                    if k in transformed:
+                        source = transformed[k]
+                        if isinstance(source, Exception):
+                            raise source
                     if source is None:  # dropped by pipeline
                         items.append({action: {
                             "_index": index_name, "_id": doc_id,
@@ -3505,34 +3609,30 @@ class Engine:
                         continue
                     r = idx.index_doc(doc_id, source, op_type=action)
                     status = 201 if r["result"] == "created" else 200
-                    items.append({action: {"_index": index_name, **r, "status": status}})
+                    items.append({action: {"_index": index_name, **r,
+                                           "status": status}})
                 elif action == "delete":
                     r = idx.delete_doc(doc_id)
-                    items.append({action: {"_index": index_name, **r, "status": 200}})
+                    items.append({action: {"_index": index_name, **r,
+                                           "status": 200}})
                 elif action == "update":
-                    if not isinstance(source, dict) or not isinstance(source.get("doc"), dict):
-                        raise IllegalArgumentError("update action requires a [doc] object")
+                    if not isinstance(source, dict) or not isinstance(
+                            source.get("doc"), dict):
+                        raise IllegalArgumentError(
+                            "update action requires a [doc] object")
                     e = idx.docs.get(doc_id)
                     if e is None or not e.alive:
-                        raise DocumentMissingError(f"[{doc_id}]: document missing")
+                        raise DocumentMissingError(
+                            f"[{doc_id}]: document missing")
                     merged = {**e.source, **source["doc"]}
                     r = idx.index_doc(doc_id, merged)
-                    items.append({action: {"_index": index_name, **r, "status": 200}})
+                    items.append({action: {"_index": index_name, **r,
+                                           "status": 200}})
                 else:
-                    raise IllegalArgumentError(f"unknown bulk action [{action}]")
+                    raise IllegalArgumentError(
+                        f"unknown bulk action [{action}]")
             except Exception as ex:  # per-item error envelope
-                errors = True
-                from ..utils.errors import ElasticsearchTpuError
-
-                if isinstance(ex, ElasticsearchTpuError):
-                    err = {"type": ex.type, "reason": ex.reason}
-                    status = ex.status
-                else:
-                    err = {"type": "exception", "reason": str(ex)}
-                    status = 500
-                items.append(
-                    {action: {"_index": index_name, "_id": doc_id, "status": status, "error": err}}
-                )
+                items.append(_item_error(action, index_name, doc_id, ex))
         return {"errors": errors, "items": items}
 
     def close(self):

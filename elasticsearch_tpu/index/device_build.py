@@ -61,6 +61,7 @@ __all__ = [
     "csr_blocked_scatter_device",
     "ann_tiles_device",
     "impact_codes_device",
+    "analyze_hash_device",
 ]
 
 # quantization constants mirrored from ann/quantize.py (the host twin)
@@ -294,6 +295,97 @@ def ann_tiles_device(vectors, docids, assign, C: int, L: int):
         jnp.float32(_QLEVELS), int(C), int(L))
     return (np.asarray(order), np.asarray(codes),
             np.asarray(scale), np.asarray(offset))
+
+
+# ---------------------------------------------------------------------------
+# batch text analysis: tokenize + segmented term hashing (PR 16)
+# ---------------------------------------------------------------------------
+
+# padded [values, chars] tensors above this element budget fall back to
+# the batched host path — one dispatch must never provoke a transfer
+# larger than the rest of the refresh combined
+_ANALYZE_MAX_ELEMENTS = 1 << 26
+
+# two independent polynomial hash lanes; term identity on device is the
+# (h1, h2, token_length) triple (collision odds documented in
+# DIVERGENCES "Vectorized ingest")
+_HASH_MULT_1 = 1000003
+_HASH_MULT_2 = 8191
+
+
+@functools.lru_cache(maxsize=1)
+def _analyze_hash_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(chars, lengths):
+        # chars [B, L] uint8 (raw ASCII bytes), lengths [B] int32
+        L = chars.shape[1]
+        valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+        c = chars
+        lower = jnp.where((c >= 65) & (c <= 90), c + 32, c)
+        is_word = ((((lower >= 97) & (lower <= 122))
+                    | ((c >= 48) & (c <= 57))) & valid)
+        # _WORD_RE apostrophe join: 0x27 with word chars on both sides
+        prev_word = jnp.pad(is_word[:, :-1], ((0, 0), (1, 0)))
+        next_word = jnp.pad(is_word[:, 1:], ((0, 0), (0, 1)))
+        joiner = (c == 39) & valid & prev_word & next_word
+        in_tok = is_word | joiner
+        prev_in = jnp.pad(in_tok[:, :-1], ((0, 0), (1, 0)))
+        next_in = jnp.pad(in_tok[:, 1:], ((0, 0), (0, 1)))
+        start = in_tok & ~prev_in
+        end = in_tok & ~next_in
+        # segmented polynomial rolling hash over the LOWERED bytes:
+        # h_i = h_{i-1} * K + byte_i, reset at token starts (multiplier
+        # 0), identity (1, 0) outside tokens. The affine composition
+        # (m, v)∘(m', v') = (m·m', v·m' + v') is associative, so the
+        # whole row reduces in one lax.associative_scan — O(log L)
+        # depth instead of the host's per-char loop.
+        cu = lower.astype(jnp.uint32)
+
+        def seg_hash(mult):
+            m = jnp.where(in_tok,
+                          jnp.where(start, jnp.uint32(0),
+                                    jnp.uint32(mult)),
+                          jnp.uint32(1))
+            v = jnp.where(in_tok, cu, jnp.uint32(0))
+
+            def comb(a, b):
+                return a[0] * b[0], a[1] * b[0] + b[1]
+
+            _, h = jax.lax.associative_scan(comb, (m, v), axis=1)
+            return h
+
+        return (start, end, joiner,
+                seg_hash(_HASH_MULT_1), seg_hash(_HASH_MULT_2))
+
+    return run
+
+
+def analyze_hash_device(chars, lengths):
+    """Standard-analyzer tokenization + term hashing over a padded
+    [values, chars] uint8 tensor as ONE jitted program.
+
+    -> (start, end, joiner, h1, h2) as numpy arrays trimmed back to the
+    input shape: boolean token start/end/apostrophe-join masks plus two
+    uint32 hash lanes whose values AT the end positions are the tokens'
+    polynomial hashes over their lowercased bytes. Returns None when
+    the pow2-padded tensor exceeds the transfer budget (the caller
+    degrades to the batched host path)."""
+    chars = np.asarray(chars, np.uint8)
+    lengths = np.asarray(lengths, np.int32)
+    B, L = chars.shape
+    Bp = _pow2_pad(B, floor=8)
+    Lp = _pow2_pad(L, floor=64)
+    if Bp * Lp > _ANALYZE_MAX_ELEMENTS:
+        return None
+    cp = np.zeros((Bp, Lp), np.uint8)
+    cp[:B, :L] = chars
+    lp = np.zeros((Bp,), np.int32)
+    lp[:B] = lengths
+    out = _analyze_hash_jit()(cp, lp)
+    return tuple(np.asarray(a)[:B, :L] for a in out)
 
 
 # ---------------------------------------------------------------------------

@@ -246,12 +246,28 @@ class FieldType:
     extra: dict = field(default_factory=dict)
 
     _analyzer_obj: Analyzer | None = None
+    # memoized BatchedAnalyzer (analysis/batched.py); keyed to the
+    # analyzer object's identity so an analysis-settings update that
+    # resets _analyzer_obj invalidates this too
+    _batched_obj: object | None = None
 
     def get_analyzer(self) -> Analyzer:
         if self._analyzer_obj is None:
             reg = getattr(self, "_registry", None) or {}
             self._analyzer_obj = reg.get(self.analyzer) or get_analyzer(self.analyzer)
         return self._analyzer_obj
+
+    def get_batched_analyzer(self):
+        """Vectorized counterpart of get_analyzer(), memoized the same
+        way. The identity check (not just None) means even a stale memo
+        that survived a direct _analyzer_obj reset rebuilds correctly."""
+        from ..analysis.batched import BatchedAnalyzer
+
+        an = self.get_analyzer()
+        ba = self._batched_obj
+        if ba is None or ba.analyzer is not an:
+            ba = self._batched_obj = BatchedAnalyzer(an)
+        return ba
 
     def get_search_analyzer(self) -> Analyzer:
         if self.search_analyzer:
@@ -326,9 +342,11 @@ class Mappings:
         for ft in self.fields.values():
             ft._registry = self.analysis_registry
             ft._analyzer_obj = None
+            ft._batched_obj = None
             for sub in ft.fields.values():
                 sub._registry = self.analysis_registry
                 sub._analyzer_obj = None
+                sub._batched_obj = None
 
     # ---- mapping definition parsing -------------------------------------
 

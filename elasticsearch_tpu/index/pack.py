@@ -398,11 +398,15 @@ class PackBuilder:
             elif use_native:
                 raise RuntimeError("native packing requested but unavailable")
 
-    def add_document(self, parsed: dict[str, list], doc_id: str | None = None) -> int:
+    def add_document(self, parsed: dict[str, list], doc_id: str | None = None,
+                     skip_text: bool = False) -> int:
         """parsed = Mappings.parse_document output; returns local docid.
         doc_id, when given, is stored in the reserved `_id` ordinal column so
         ids queries/sorts run on device (the reference indexes _id as a
-        keyword-like metadata field, index/mapper/IdFieldMapper.java)."""
+        keyword-like metadata field, index/mapper/IdFieldMapper.java).
+        skip_text leaves indexed text fields to the caller — the
+        batch-analysis path (add_documents_batch) routes them through
+        one vectorized analyze dispatch per field instead."""
         docid = self.num_docs
         self.num_docs += 1
         if doc_id is not None:
@@ -413,7 +417,7 @@ class PackBuilder:
                 continue
             t = ft.type
             if t in TEXT_TYPES:
-                if not ft.index:
+                if not ft.index or skip_text:
                     continue
                 analyzer = ft.get_analyzer()
                 if self._native is not None:
@@ -553,6 +557,143 @@ class PackBuilder:
                 length += ret
                 pos_base += ret + 100
         self.doc_field_lengths.setdefault(fld, []).append((docid, length))
+
+    def add_documents_batch(self, parsed_docs: list[dict],
+                            doc_ids: list | None = None) -> list[int]:
+        """Batch add: one vectorized analyze dispatch per text field
+        across the whole burst (analysis/batched.py) feeding the same
+        accumulator state as N add_document calls — asserted
+        byte-identical by tests/test_batched_analysis.py. Non-text
+        fields ride the per-doc path unchanged (they were never the
+        wall). ES_TPU_ANALYZE=host degrades to the reference per-doc
+        loop. Returns the local docids."""
+        from ..analysis.batched import analyze_burst, analyze_mode
+
+        if doc_ids is None:
+            doc_ids = [None] * len(parsed_docs)
+        mode = analyze_mode()
+        if mode == "host":
+            from ..monitoring.refresh_profile import refresh_stage
+
+            with refresh_stage("analyze"):
+                return [self.add_document(p, doc_id=d)
+                        for p, d in zip(parsed_docs, doc_ids)]
+        docids: list[int] = []
+        # field -> (docids-with-field, flat values, value->doc ordinal)
+        bursts: dict[str, tuple[list[int], list[str], list[int]]] = {}
+        for parsed, doc_id in zip(parsed_docs, doc_ids):
+            docid = self.add_document(parsed, doc_id=doc_id, skip_text=True)
+            docids.append(docid)
+            for fld, values in parsed.items():
+                ft = self.mappings.fields.get(fld)
+                if ft is None or ft.type not in TEXT_TYPES or not ft.index:
+                    continue
+                fdocs, vals, vdoc = bursts.setdefault(fld, ([], [], []))
+                d_ord = len(fdocs)
+                fdocs.append(docid)
+                vals.extend(values)
+                vdoc.extend([d_ord] * len(values))
+        for fld, (fdocs, vals, vdoc) in bursts.items():
+            ba = self.mappings.fields[fld].get_batched_analyzer()
+            if self._native_burst_eligible(ba, vals, mode):
+                self._ingest_text_burst_native(fld, fdocs, vals, vdoc, ba)
+                continue
+            burst = analyze_burst(
+                ba, vals, np.asarray(vdoc, np.int64), len(fdocs), mode=mode)
+            self._ingest_text_burst(fld, fdocs, burst)
+        return docids
+
+    def _native_burst_eligible(self, ba, vals: list[str], mode: str) -> bool:
+        """auto + C accumulator + plain standard analyzer: the C
+        tokenizer (builder_add_text) is the measured-fastest host
+        analyze+insert route at every burst size (BENCH_NOTES round 20)
+        and is byte-compatible with the oracle by the per-doc path's own
+        contract, so auto prefers it — unless the device kernel claims
+        the burst (accelerator backend, burst past ES_TPU_ANALYZE_MIN).
+        Forced modes (host/batched/device) never take this route: their
+        dispatch is the thing the parity tests pin down."""
+        if mode != "auto" or self._native is None or not ba.device_eligible:
+            return False
+        import jax
+
+        from ..analysis.batched import analyze_device_min
+        from . import device_build as db
+
+        return not (jax.default_backend() != "cpu"
+                    and db.device_build_enabled()
+                    and sum(map(len, vals)) >= analyze_device_min())
+
+    def _ingest_text_burst_native(self, fld: str, fdocs: list[int],
+                                  vals: list[str], vdoc: list[int],
+                                  ba) -> None:
+        """One field's whole burst through the C accumulator under a
+        single costed `build.analyze` dispatch. Routing is per doc via
+        _add_text_native (identical chaining, non-ASCII per-value
+        fallback), so state parity with N add_document calls holds by
+        construction; what the batch buys is one stage dispatch and no
+        per-doc Python parse/setup between values."""
+        from ..monitoring.refresh_profile import build_stage
+
+        with build_stage("build.analyze", nbytes=sum(map(len, vals)),
+                         values=len(vals), docs=len(fdocs)):
+            i = 0
+            n = len(vdoc)
+            for d_ord, docid in enumerate(fdocs):
+                j = i
+                while j < n and vdoc[j] == d_ord:
+                    j += 1
+                self._add_text_native(fld, docid, ba.analyzer, vals[i:j])
+                i = j
+
+    def _ingest_text_burst(self, fld: str, docids: list[int], burst) -> None:
+        """Route one analyzed burst into the accumulator — the batch
+        twin of the per-doc text branch: same postings/positions/
+        field-length state, same POS_L bound on stored positions (term
+        frequencies and lengths still count past it)."""
+        bounds = np.zeros(len(docids) + 1, np.int64)
+        np.cumsum(burst.lengths, out=bounds[1:])
+        if self._native is not None:
+            terms = burst.terms.tolist()
+            pos = burst.positions.tolist()
+            for k, docid in enumerate(docids):
+                s, e = int(bounds[k]), int(bounds[k + 1])
+                # unfiltered positions, like _add_text_native: the C++
+                # accumulator applies the position bound itself
+                self._native.add_tokens(fld, docid, terms[s:e], pos[s:e])
+                self.doc_field_lengths.setdefault(fld, []).append(
+                    (docid, int(burst.lengths[k])))
+            return
+        T = int(burst.terms.size)
+        if T:
+            # intern terms -> codes, then group tokens by (term, doc) in
+            # one stable sort; each segment is one posting
+            vocab: dict[str, int] = {}
+            terms = burst.terms.tolist()
+            tcode = np.fromiter(
+                (vocab.setdefault(t, len(vocab)) for t in terms),
+                np.int64, count=T)
+            uniq = list(vocab)
+            D = len(docids)
+            key = tcode * D + burst.doc_idx
+            order = np.argsort(key, kind="stable")
+            ks = key[order]
+            seg = np.flatnonzero(
+                np.concatenate([[True], ks[1:] != ks[:-1]]))
+            seg_end = np.concatenate([seg[1:], [ks.size]])
+            pos_sorted = burst.positions[order]
+            for s, e in zip(seg.tolist(), seg_end.tolist()):
+                k = int(ks[s])
+                term = uniq[k // D]
+                docid = docids[k % D]
+                self.postings.setdefault((fld, term), {})[docid] = e - s
+                pl = pos_sorted[s:e]
+                pl = pl[pl < POS_L - 64]
+                if pl.size:
+                    self.positions.setdefault(
+                        (fld, term), {})[docid] = pl.tolist()
+        for k, docid in enumerate(docids):
+            self.doc_field_lengths.setdefault(fld, []).append(
+                (docid, int(burst.lengths[k])))
 
     def _flat_csr_from_dicts(self):
         """Convert the dict-form postings/positions to the flat-CSR form the

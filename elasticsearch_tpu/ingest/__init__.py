@@ -146,6 +146,52 @@ class IngestService:
         out.pop("_id", None)
         return out
 
+    def execute_batch(self, pipeline_names, sources: list,
+                      index: str | None = None,
+                      doc_ids: list | None = None) -> list:
+        """Run a batch of documents through an already-resolved pipeline
+        chain (PR 16 bulk front door). Registry lookups and the ingest
+        timestamp are hoisted once per batch instead of per doc. Returns
+        per-doc outcomes aligned with `sources`: the new source dict,
+        None if a drop processor fired, or the captured Exception when
+        that doc's chain failed — the caller owns the per-item error
+        envelope, so this method itself never raises for a bad doc.
+
+        A missing pipeline is raised lazily per doc (not validated up
+        front) so a doc dropped by the first pipeline still reports a
+        drop, never a missing-final-pipeline error — byte-identical to
+        the per-doc execute() path."""
+        pipes = [(name, self._compiled.get(name))
+                 for name in pipeline_names if name]
+        ts = _iso_now()
+        if doc_ids is None:
+            doc_ids = [None] * len(sources)
+        outs: list = []
+        for source, doc_id in zip(sources, doc_ids):
+            try:
+                for name, pipe in pipes:
+                    if pipe is None:
+                        raise IllegalArgumentError(
+                            f"pipeline with id [{name}] does not exist")
+                    ctx = dict(source)
+                    ctx["_ingest"] = {"timestamp": ts, "pipeline": name}
+                    if index is not None:
+                        ctx["_index"] = index
+                    if doc_id is not None:
+                        ctx["_id"] = doc_id
+                    out = pipe.run(ctx)
+                    if out is None:
+                        source = None
+                        break
+                    out.pop("_ingest", None)
+                    out.pop("_index", None)
+                    out.pop("_id", None)
+                    source = out
+                outs.append(source)
+            except Exception as ex:  # noqa: BLE001 - per-doc outcome
+                outs.append(ex)
+        return outs
+
     def simulate(self, config_or_name, docs: list[dict], verbose: bool = False) -> dict:
         """_ingest/pipeline/_simulate."""
         if isinstance(config_or_name, str):

@@ -480,6 +480,21 @@ def segment_merge_build_cost(docs: int, *, nbytes: float = 0.0) -> dict:
     return {"flops": 2.0 * docs, "bytes": float(2.0 * nbytes)}
 
 
+def analyze_build_cost(nbytes: int) -> dict:
+    """Batch text analysis (analysis/batched.py, PR 16): tokenization +
+    term hashing over the burst's packed byte tensor. Bytes-based
+    convention (BENCH_NOTES round 20) — work scales with input
+    CHARACTERS, not docs: ~16 ops/byte (char-class tests, case fold,
+    two segmented polynomial hash lanes with their scan combines) and
+    ~3× the input bytes of traffic (read the char tensor once, write
+    the boundary masks and two u32 hash lanes amortized over scan
+    tiles). The identical model prices the device kernel
+    (basis="device") and the batched host pass (basis="host") — the
+    split between the two IS the attribution, like build.impact_quantize."""
+    nbytes = float(max(int(nbytes), 1))
+    return {"flops": 16.0 * nbytes, "bytes": 3.0 * nbytes}
+
+
 def allgather_merge_cost(s: int, q: int, k: int, *,
                          id_bytes: int = 8) -> dict:
     """The on-device coordinator merge (PR 10): every shard's [q, k]
@@ -623,6 +638,13 @@ def _build_segment_merge(fields: dict) -> dict | None:
                                     nbytes=float(fields.get("nbytes", 0.0)))
 
 
+def _build_analyze(fields: dict) -> dict | None:
+    nbytes = fields.get("nbytes")
+    if nbytes is None:
+        return None
+    return analyze_build_cost(int(nbytes))
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -672,6 +694,9 @@ KERNEL_COSTS: dict[str, object] = {
     # PR 15: the LSM tail-segment fold (background device merge riding
     # the serving queue as the `_merge` tenant)
     "build.segment_merge": _build_segment_merge,
+    # PR 16: batch text analysis — the former host `analyze` wall as a
+    # costed dispatch (bytes-based; analysis/batched.analyze_burst)
+    "build.analyze": _build_analyze,
 }
 
 

@@ -62,6 +62,18 @@ class StageCollector:
         self._last = self._t0
         self._stack: list[str] = [OTHER_STAGE]
         self.stages: dict[str, float] = {}  # name -> seconds
+        # (name, start_s, end_s) spans relative to t0 — the overlap
+        # evidence: main-thread stage windows (events) plus worker
+        # spans (async_events, via note_span), so analyze(k) ∥
+        # build(k−1) is visible in the RefreshProfile timestamps, not
+        # just inferable from sums
+        self.events: list[tuple[str, float, float]] = []
+        self.async_events: list[tuple[str, float, float]] = []
+        # seconds of concurrent work per stage name, charged via
+        # note_span by worker threads — kept OUT of `stages` so the
+        # flat-sum invariant (sum(stages) == wall) stays per-thread
+        self.async_stages: dict[str, float] = {}
+        self._elock = threading.Lock()
 
     def _cut(self) -> None:
         now = time.perf_counter()
@@ -72,12 +84,26 @@ class StageCollector:
     @contextmanager
     def stage(self, name: str):
         self._cut()
+        t_en = self._last
         self._stack.append(name)
         try:
             yield
         finally:
             self._cut()
             self._stack.pop()
+            with self._elock:
+                self.events.append(
+                    (name, t_en - self._t0, self._last - self._t0))
+
+    def note_span(self, name: str, t_start: float, t_end: float) -> None:
+        """Record work done on ANOTHER thread (perf_counter timestamps):
+        an event span for the overlap timeline plus an async stage
+        charge. Thread-safe; never touches the flat-sum clock."""
+        with self._elock:
+            self.async_events.append(
+                (name, t_start - self._t0, t_end - self._t0))
+            self.async_stages[name] = (self.async_stages.get(name, 0.0)
+                                       + (t_end - t_start))
 
     def finish(self) -> tuple[float, dict[str, float]]:
         """-> (wall_seconds, {stage: seconds}). wall is the last boundary
@@ -88,6 +114,14 @@ class StageCollector:
 
 _collector: contextvars.ContextVar[StageCollector | None] = (
     contextvars.ContextVar("refresh_stage_collector", default=None))
+
+
+def active_collector() -> StageCollector | None:
+    """The collector of the refresh being profiled on THIS thread, if
+    any — captured by the stacked build before spawning analyze
+    workers, whose fresh thread contexts see None and report back via
+    note_span."""
+    return _collector.get()
 
 
 @contextmanager
@@ -184,6 +218,12 @@ class RefreshRecorder:
             kind = profile.get("kind", "full")
             self._counts[kind] = self._counts.get(kind, 0) + 1
             for stage, ms in (profile.get("stages_ms") or {}).items():
+                self._stage_ms[stage] = self._stage_ms.get(stage, 0.0) + ms
+            # worker-thread stage time (analyze/build overlap) counts in
+            # the cumulative accounting — the SLO analyze fraction and
+            # the health dominant-stage diagnosis must see every
+            # millisecond, overlapped or not
+            for stage, ms in (profile.get("async_stages_ms") or {}).items():
                 self._stage_ms[stage] = self._stage_ms.get(stage, 0.0) + ms
             docs = int(profile.get("docs", 0))
             self._docs_total += docs
@@ -298,6 +338,35 @@ def profile_refresh(index, kind: str):
                       "tail_docs": tiers["tail_docs"],
                       "segments": tiers.get("segments", 0)},
         }
+        with c._elock:
+            events = list(c.events)
+            async_events = list(c.async_events)
+            async_stages = dict(c.async_stages)
+        profile["stage_events_ms"] = (
+            [[name, round(s * 1000, 3), round(e * 1000, 3), "main"]
+             for name, s, e in events]
+            + [[name, round(s * 1000, 3), round(e * 1000, 3), "worker"]
+               for name, s, e in async_events])
+        if async_stages:
+            # worker-thread time (analyze overlap pipeline): outside the
+            # flat-sum stages by construction, folded into the
+            # recorder's cumulative stage accounting by record()
+            profile["async_stages_ms"] = {
+                k: round(v * 1000, 4) for k, v in async_stages.items()}
+            # overlap evidence as one scalar: worker span time that ran
+            # concurrently with main-thread stage work (main spans
+            # union-merged first — nesting must not double count)
+            merged: list[list[float]] = []
+            for s, e in sorted((s, e) for _n, s, e in events):
+                if merged and s <= merged[-1][1]:
+                    merged[-1][1] = max(merged[-1][1], e)
+                else:
+                    merged.append([s, e])
+            ov = 0.0
+            for _n, a0, a1 in async_events:
+                for m0, m1 in merged:
+                    ov += max(0.0, min(a1, m1) - max(a0, m0))
+            profile["analyze_overlap_ms"] = round(ov * 1000, 4)
         recorder_for(index).record(profile)
     except Exception:  # noqa: BLE001 - profiling must never fail a refresh
         pass

@@ -248,7 +248,8 @@ class SloEngine:
         number on record instead of waiting for a recall regression."""
         tail_max = float(self._get("slo.write.tail_fraction", 0) or 0)
         lag_max = float(self._get("slo.write.refresh_lag_ms", 0) or 0)
-        if tail_max <= 0 and lag_max <= 0:
+        analyze_max = float(self._get("slo.write.analyze_fraction", 0) or 0)
+        if tail_max <= 0 and lag_max <= 0 and analyze_max <= 0:
             return []
         try:
             idx_stats = self.engine.indexing_stats()
@@ -271,6 +272,25 @@ class SloEngine:
                 "visibility",
                 measured, lag_max,
                 None if measured is None else measured > lag_max, "max"))
+        if analyze_max > 0:
+            # PR 16: share of cumulative build-stage time spent in text
+            # analysis (build.analyze + the host-oracle `analyze`
+            # stage). The vectorized path keeps this low; a regression
+            # back to a host analyze wall breaches the floor and the
+            # indexing health indicator names the dominant stage.
+            stage_ms = idx_stats.get("stage_ms") or {}
+            total = sum(stage_ms.values())
+            an = (stage_ms.get("build.analyze", 0.0)
+                  + stage_ms.get("analyze", 0.0))
+            measured = round(an / total, 4) if total > 0 else None
+            out.append(_objective(
+                "write-analyze-fraction", "write",
+                f"text analysis <= {analyze_max:g} of cumulative build "
+                "stage time (vectorized ingest holds the analyze wall "
+                "down)",
+                measured, analyze_max,
+                None if measured is None else measured > analyze_max,
+                "max"))
         return out
 
     def _custom_objectives(self, snap) -> list[dict]:

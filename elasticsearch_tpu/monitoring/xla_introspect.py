@@ -171,6 +171,14 @@ XLA_CHECKS: dict[str, dict] = {
         "reason": "PR 15 wrapper over the tail-union rebuild (the LSM "
                   "fold); the inner build.* stages carry the per-stage "
                   "accounting"},
+    "build.analyze": {
+        "status": "exempt",
+        "reason": "PR 16: batch tokenize+hash kernel "
+                  "(device_build.analyze_hash_device) asserted "
+                  "term/position/length-identical to the host analyzer "
+                  "oracle by tests/test_batched_analysis.py — stronger "
+                  "than a cost cross-check; the batched host basis has "
+                  "no compiled executable to introspect"},
 }
 
 

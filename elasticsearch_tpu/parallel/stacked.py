@@ -25,6 +25,8 @@ array S-fold in HBM.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -529,25 +531,85 @@ def route_docs(
     return routed
 
 
+def _ingest_shard(builder: PackBuilder,
+                  shard_docs: list[tuple[str, dict]],
+                  mappings: Mappings) -> None:
+    """Parse + batch-analyze one shard's docs into its builder (the
+    vectorized dispatch inside tags itself `build.analyze`; the host
+    oracle lane tags the legacy `analyze` stage)."""
+    parsed = [mappings.parse_document(source) for _, source in shard_docs]
+    builder.add_documents_batch(
+        parsed, doc_ids=[doc_id for doc_id, _ in shard_docs])
+
+
 def build_stacked_pack_routed(
     routed: list[list[tuple[str, dict]]], mappings: Mappings,
     dense_min_df: int | None = None,
 ) -> StackedPack:
-    from ..monitoring.refresh_profile import refresh_stage
+    from ..analysis.batched import analyze_mode, analyze_overlap_enabled
+    from ..monitoring.refresh_profile import active_collector, refresh_stage
 
     builders = [PackBuilder(mappings) for _ in range(len(routed))]
-    # analysis/tokenization is a collector-only stage: it is host text
-    # processing, not a candidate device kernel, but it must stay visible
-    # in the RefreshProfile instead of hiding in the host_other residual
-    with refresh_stage("analyze"):
-        for b, shard_docs in zip(builders, routed):
-            for doc_id, source in shard_docs:
-                b.add_document(mappings.parse_document(source),
-                               doc_id=doc_id)
-    # per-shard dense tiers disabled: StackedPack builds its own global one
-    # (global df decisions + global avgdl), so a local tier would only burn
-    # build time and host RAM
-    packs = [b.build(dense_min_df=1 << 62) for b in builders]
+    # analyze stays a named collector stage (the batch dispatch nested
+    # inside charges build.analyze; parse + residual stay in `analyze`)
+    overlap = (len(builders) > 1 and analyze_overlap_enabled()
+               and analyze_mode() != "host")
+    packs: list = []
+    if not overlap:
+        with refresh_stage("analyze"):
+            for b, shard_docs in zip(builders, routed):
+                _ingest_shard(b, shard_docs, mappings)
+        # per-shard dense tiers disabled: StackedPack builds its own
+        # global one (global df decisions + global avgdl), so a local
+        # tier would only burn build time and host RAM
+        packs = [b.build(dense_min_df=1 << 62) for b in builders]
+    else:
+        # depth-1 double buffer (the C3/serving pattern applied to
+        # ingest): a worker thread analyzes shard k+1 while the main
+        # thread builds shard k — the builds release the GIL in the
+        # native accumulator / XLA, so analyze(k+1) ∥ build(k) is real
+        # wall-clock overlap. Worker time can't charge the flat-sum
+        # collector (sum(stages) == wall is per-thread by construction);
+        # it lands as an async span (note_span) so the RefreshProfile
+        # timestamps show the overlap and the cumulative stage
+        # accounting still sees every analyze millisecond.
+        coll = active_collector()
+
+        def _spawn(s: int):
+            box: list[BaseException] = []
+
+            def _run():
+                t0 = time.perf_counter()
+                try:
+                    _ingest_shard(builders[s], routed[s], mappings)
+                except BaseException as ex:  # noqa: BLE001 - rethrown on join
+                    box.append(ex)
+                finally:
+                    if coll is not None:
+                        coll.note_span("build.analyze", t0,
+                                       time.perf_counter())
+
+            th = threading.Thread(target=_run, daemon=True,
+                                  name=f"analyze-shard-{s}")
+            th.start()
+            return th, box
+
+        with refresh_stage("analyze"):
+            _ingest_shard(builders[0], routed[0], mappings)
+        pending = None
+        try:
+            for s in range(len(builders)):
+                pending = _spawn(s + 1) if s + 1 < len(builders) else None
+                packs.append(builders[s].build(dense_min_df=1 << 62))
+                if pending is not None:
+                    th, box = pending
+                    th.join()
+                    pending = None
+                    if box:
+                        raise box[0]
+        finally:
+            if pending is not None:
+                pending[0].join()
     for p, shard_docs in zip(packs, routed):
         # source references (shared with EsIndex.shard_docs) for host-side
         # per-object matching (nested queries, query/nested.py)

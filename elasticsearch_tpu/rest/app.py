@@ -365,12 +365,10 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         """Apply request/default/final ingest pipelines to a single-doc
         write; returns None when a drop processor fired."""
         pipeline = request.query.get("pipeline")
-        s = idx.settings
-        if pipeline or s.get("default_pipeline") \
-                or s.get("index.default_pipeline") \
-                or s.get("final_pipeline") or s.get("index.final_pipeline"):
-            return await call(engine.run_pipelines, idx.name, body,
-                              pipeline, doc_id)
+        first, final = engine.resolve_pipelines(idx, pipeline)
+        if first or final:
+            return await call(engine.run_pipelines_resolved, idx.name, body,
+                              first, final, doc_id)
         return body
 
     @handler

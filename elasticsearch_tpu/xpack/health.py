@@ -261,6 +261,14 @@ def _indexing_indicator(engine) -> dict:
             f"{stage_ms[top_stage]:.1f}ms cumulative "
             "(GET /_refresh/profile for per-refresh breakdowns)"
             if top_stage else "")
+        if top_stage in ("build.analyze", "analyze"):
+            # PR 16: name the analyze-specific remedy — this stage is
+            # supposed to be vectorized, so dominance usually means the
+            # oracle/host mode is pinned or every burst is falling back
+            stage_note += (
+                "; text analysis dominates the write path — check "
+                "ES_TPU_ANALYZE (host pins the per-doc oracle loop) and "
+                "whether custom analyzers force per-value fallbacks")
         return {
             "status": YELLOW,
             "symptom": (f"{len(breached)} write-path SLO objectives are "

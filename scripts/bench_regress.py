@@ -213,6 +213,63 @@ def build_profile_growth(prev: dict, latest: dict, threshold: float) -> list:
     return moved
 
 
+def ingest_metrics(record: dict) -> dict:
+    """-> {"<config>.ingest...": value} from the per-arm `ingest`
+    sections (PR 16): docs_per_s (higher-is-better), the analyze stage
+    millis and write-path fraction (lower-is-better). Mode strings and
+    refresh-kind counters are not timings and are skipped."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "ingest" and isinstance(v, dict):
+                    stack = [(path + (k,), v)]
+                    while stack:
+                        p, node = stack.pop()
+                        for kk, vv in node.items():
+                            if isinstance(vv, dict) \
+                                    and kk != "refresh_kinds":
+                                stack.append((p + (kk,), vv))
+                            elif isinstance(vv, (int, float)) \
+                                    and not isinstance(vv, bool) \
+                                    and kk in ("docs_per_s", "analyze",
+                                               "build.analyze",
+                                               "fraction_of_write_path"):
+                                out[".".join(p + (kk,))] = float(vv)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+def ingest_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY (same convention as build_profile_growth): ingest-side
+    movement beyond `threshold` — C7 docs/s down, or analyze stage
+    millis / write-path analyze fraction up — is printed for the tier-1
+    log reader but never fails the lint (CPU-smoke ingest rates are
+    host-bound, non-criteria per BENCH_NOTES)."""
+    a, b = ingest_metrics(prev), ingest_metrics(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        leaf = path.rsplit(".", 1)[-1]
+        ratio = new / old
+        if leaf == "docs_per_s":
+            regressed = ratio < 1.0 - threshold
+        else:  # analyze ms + analyze fraction: lower is better
+            regressed = ratio > 1.0 + threshold
+        if regressed:
+            moved.append((path, old, new, ratio))
+    return moved
+
+
 def build_speedup_table(prev: dict, latest: dict) -> list:
     """PR 15: when BOTH records carry `build_profile` sections, the
     r(N-1)→rN comparison IS the device port's scorecard — render a
@@ -317,6 +374,12 @@ def main(argv=None) -> int:
               f"({ratio:.2f}x) — write-path build stage moved beyond "
               f"{args.threshold:.0%}; compare the stage split before "
               "accepting a slower host build as the item-2 baseline")
+    for path, old, new, ratio in ingest_growth(
+            prev, latest, args.threshold):
+        print(f"  INGEST (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x) — ingest docs/s or analyze cost moved "
+              f"beyond {args.threshold:.0%}; check ES_TPU_ANALYZE mode "
+              "and per-value oracle fallbacks before accepting")
     # PR 15: the per-stage host-vs-device scorecard whenever both
     # records profiled their builds
     print_build_speedup(prev, latest, prev_round, cur_round)

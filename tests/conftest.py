@@ -73,9 +73,16 @@ def pytest_collection_modifyitems(session, config, items):
     # production execution model — the fused tier no longer forks on it,
     # so the arm matrix is gone. With the cache off, every sharded
     # msearch rides the one-program all-gather-merge path by default.
+    # PR 16: the shuffled pass also pins ES_TPU_ANALYZE=host so the
+    # per-doc oracle analyzer runs under reordering — the batched /
+    # device analysis paths are exercised by the default-order pass and
+    # proven stream-identical by tests/test_batched_analysis.py, which
+    # forces its own modes per test.
+    os.environ["ES_TPU_ANALYZE"] = "host"
     print(f"[conftest] module order shuffled with seed {seed}; "
           "ES_TPU_REQUEST_CACHE=0 (cache-off execution gate; "
-          "GSPMD/pjit is the unpinned default)")
+          "GSPMD/pjit is the unpinned default); ES_TPU_ANALYZE=host "
+          "(oracle analyzer under reordering)")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -204,3 +204,171 @@ def test_engine_final_pipeline_runs_after():
     e.create_index("d", settings={"default_pipeline": "first", "final_pipeline": "last"})
     e.bulk([("index", "d", "1", {})])
     assert e.get_index("d").get_doc("1")["_source"] == {"a": 1, "b": "1"}
+
+
+# ---------------------------------------------------------------------------
+# PR 16: batched _bulk front door — one pipeline-resolution + one registry
+# lookup + one ingest timestamp per consecutive (index, chain) run, with
+# results and per-item error envelopes identical to the per-doc path
+# ---------------------------------------------------------------------------
+
+def _perdoc_execute_batch(self, pipeline_names, sources, index=None,
+                          doc_ids=None):
+    """The pre-batching semantics, built from per-doc execute() calls —
+    the oracle the batched front door is diffed against."""
+    outs = []
+    for s, d in zip(sources, doc_ids or [None] * len(sources)):
+        try:
+            out = s
+            for n in pipeline_names:
+                if not n:
+                    continue
+                out = self.execute(n, out, index=index, doc_id=d)
+                if out is None:
+                    break
+            outs.append(out)
+        except Exception as ex:  # noqa: BLE001 - per-doc outcome
+            outs.append(ex)
+    return outs
+
+
+def _mixed_ops():
+    return [
+        ("index", "docs", "1", {"v": 1}),
+        ("index", "docs", "2", {"v": 2, "skip": True}),   # dropped
+        ("create", "docs", "3", {"v": 3}),
+        ("index", "docs", "4", {"v": 4, "explode": True}),  # fail proc
+        ("index", "other", "5", {"v": 5}),                # chain break
+        ("delete", "docs", "1", None),                    # action break
+        ("index", "docs", "6", {"v": 6}),
+        ("update", "docs", "3", {"doc": {"patched": True}}),
+        ("index", "docs", "6", {"v": 7}),   # same id again, op order
+        ("delete", "docs", "missing", None),
+    ]
+
+
+def _pipeline_engine():
+    e = Engine()
+    e.ingest.put_pipeline("add-tag", {"processors": [
+        {"set": {"field": "tagged", "value": True}},
+        {"drop": {"if": "ctx.skip == true"}},
+        {"fail": {"if": "ctx.explode == true", "message": "boom"}},
+    ]})
+    e.ingest.put_pipeline("finalize", {"processors": [
+        {"set": {"field": "final", "value": "yes"}},
+    ]})
+    e.create_index("docs", settings={"default_pipeline": "add-tag",
+                                     "final_pipeline": "finalize"})
+    e.create_index("other")
+    return e
+
+
+def _doc_state(e):
+    out = {}
+    for name in ("docs", "other"):
+        idx = e.get_index(name)
+        out[name] = {d: (idx.get_doc(d) or {}).get("_source")
+                     for d in ("1", "2", "3", "4", "5", "6")}
+    return out
+
+
+def test_bulk_batched_identical_to_perdoc(monkeypatch):
+    eb = _pipeline_engine()
+    rb = eb.bulk(_mixed_ops())
+    ep = _pipeline_engine()
+    monkeypatch.setattr(IngestService, "execute_batch",
+                        _perdoc_execute_batch)
+    rp = ep.bulk(_mixed_ops())
+    assert rb == rp
+    assert _doc_state(eb) == _doc_state(ep)
+    # spot checks: the fail-processor item carries the per-item envelope
+    assert rb["errors"]
+    err = rb["items"][3]["index"]["error"]
+    assert "boom" in err["reason"]
+    assert rb["items"][9]["delete"]["status"] == 404 or \
+        "error" in rb["items"][9]["delete"]
+    # pipelines + final ran; update applied after its index op
+    assert eb.get_index("docs").get_doc("3")["_source"] == {
+        "v": 3, "tagged": True, "final": "yes", "patched": True}
+    assert eb.get_index("docs").get_doc("6")["_source"]["v"] == 7
+
+
+def test_bulk_unknown_pipeline_per_item_errors():
+    e = Engine()
+    e.create_index("d")
+    res = e.bulk([
+        ("index", "d", "1", {"v": 1}),
+        ("index", "d", "2", {"v": 2}),
+        ("delete", "d", "1", None),
+    ], pipeline="nope")
+    assert res["errors"]
+    for item in res["items"][:2]:
+        err = item["index"]["error"]
+        assert "nope" in err["reason"]
+        assert item["index"]["status"] == 400
+    # the delete never runs a pipeline: its outcome is the ordinary
+    # missing-doc envelope (nothing got indexed), not the bad name
+    d = res["items"][2]["delete"]
+    assert d["error"]["type"] == "document_missing_exception"
+    assert d["status"] == 404
+
+
+def test_bulk_resolution_hoisted_per_index_request(monkeypatch):
+    """Satellite: a 10k-doc _bulk resolves the write target and the
+    pipeline chain once per (index, request), not once per doc."""
+    e = _pipeline_engine()
+    rp_calls, rw_calls = [], []
+    orig_rp = Engine.resolve_pipelines
+    orig_rw = Engine.resolve_write_index
+
+    def count_rp(self, idx, pipeline=None):
+        rp_calls.append(getattr(idx, "name", None))
+        return orig_rp(self, idx, pipeline)
+
+    def count_rw(self, name):
+        rw_calls.append(name)
+        return orig_rw(self, name)
+
+    monkeypatch.setattr(Engine, "resolve_pipelines", count_rp)
+    monkeypatch.setattr(Engine, "resolve_write_index", count_rw)
+    ops = [("index", "docs", str(i), {"v": i}) for i in range(50)]
+    ops += [("index", "other", f"o{i}", {"v": i}) for i in range(50)]
+    res = e.bulk(ops)
+    assert not res["errors"]
+    assert len(rp_calls) == 2  # once per concrete index
+    # bulk resolves once per raw name (get_or_autocreate re-resolves
+    # internally, so the ceiling is 2 per index) — never per doc
+    assert len(rw_calls) <= 4
+
+
+def test_bulk_batch_shares_one_ingest_timestamp():
+    """The hoisted _iso_now(): every doc of one batched run sees the
+    SAME _ingest.timestamp (the reference also stamps a bulk shard
+    request once)."""
+    e = Engine()
+    e.ingest.put_pipeline("stamp", {"processors": [
+        {"set": {"field": "ts", "value": "{{_ingest.timestamp}}"}},
+    ]})
+    e.create_index("d", settings={"default_pipeline": "stamp"})
+    res = e.bulk([("index", "d", str(i), {}) for i in range(20)])
+    assert not res["errors"]
+    idx = e.get_index("d")
+    stamps = {idx.get_doc(str(i))["_source"]["ts"] for i in range(20)}
+    assert len(stamps) == 1
+
+
+def test_execute_batch_drop_hides_missing_final_like_perdoc(svc):
+    """Parity corner: a doc dropped by the first pipeline must never
+    surface a missing-final-pipeline error (the per-doc path looks the
+    final chain up lazily — so does the batch)."""
+    svc.put_pipeline("dropper", {"processors": [{"drop": {}}]})
+    outs = svc.execute_batch(("dropper", "does-not-exist"),
+                             [{"a": 1}, {"b": 2}])
+    assert outs == [None, None]
+    # a doc that is NOT dropped does hit the missing pipeline
+    svc.put_pipeline("maybe", {"processors": [
+        {"drop": {"if": "ctx.skip == true"}}]})
+    outs = svc.execute_batch(("maybe", "does-not-exist"),
+                             [{"skip": True}, {"keep": 1}])
+    assert outs[0] is None
+    assert isinstance(outs[1], IllegalArgumentError)

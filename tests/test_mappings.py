@@ -139,3 +139,31 @@ def test_date_year_and_month_prefixes():
 
 def test_date_nocolon_offset():
     assert parse_date_to_millis("1970-01-01T01:00:00+0100") == 0
+
+
+def test_set_analysis_invalidates_analyzer_memos_including_subfields():
+    """PR 16 satellite: an analysis-settings update must clear BOTH the
+    oracle-analyzer memo and the batched-analyzer memo, on top-level
+    fields AND their sub-fields — a stale sub-field memo would keep
+    tokenizing `.raw`-style multi-fields with the dead analyzer."""
+    from elasticsearch_tpu.analysis.analyzers import StandardAnalyzer
+
+    m = Mappings({"properties": {"body": {
+        "type": "text", "analyzer": "my",
+        "fields": {"raw": {"type": "text", "analyzer": "my"}}}}})
+    m.set_analysis({"my": StandardAnalyzer()})
+    gen = m.analysis_generation
+    ft = m.fields["body"]
+    sub = ft.fields["raw"]
+    an, ban = ft.get_analyzer(), ft.get_batched_analyzer()
+    san, sban = sub.get_analyzer(), sub.get_batched_analyzer()
+    assert ban.analyzer is an and sban.analyzer is san
+    m.set_analysis({"my": StandardAnalyzer(stopwords=["gone"])})
+    assert m.analysis_generation == gen + 1
+    for f in (ft, sub):
+        assert f._analyzer_obj is None
+        assert f._batched_obj is None
+    assert ft.get_analyzer() is not an
+    assert sub.get_analyzer() is not san
+    assert "gone" in ft.get_batched_analyzer().analyzer.stopwords
+    assert "gone" in sub.get_batched_analyzer().analyzer.stopwords

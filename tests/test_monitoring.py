@@ -111,7 +111,10 @@ _KERNEL_FIELD_RE = re.compile(r'"kernel":\s*\n?\s*"([^"]+)"')
 # unregistered build stage fails tier-1.
 _BUILD_STAGE_RE = re.compile(r'build_stage\(\s*\n?\s*"([^"]+)"')
 
-_DISPATCH_DIRS = ("ops", "parallel", "query", "ann", "engine", "index")
+_DISPATCH_DIRS = ("ops", "parallel", "query", "ann", "engine", "index",
+                  # PR 16: the batched analysis pipeline dispatches
+                  # build.analyze from analysis/batched.py
+                  "analysis")
 _DISPATCH_REGEXES = (_TIME_KERNEL_RE, _KERNEL_FIELD_RE, _BUILD_STAGE_RE)
 
 
@@ -161,7 +164,9 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      # parallel/, engine/ via build_stage literals)
                      "build.kmeans", "build.impact_quantize",
                      "build.csr_assemble", "build.norms",
-                     "build.ann_tiles", "build.device_put", "build.merge"):
+                     "build.ann_tiles", "build.device_put", "build.merge",
+                     # PR 16: the batch-vectorized analyze dispatch
+                     "build.analyze"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 
@@ -190,6 +195,8 @@ def test_cost_fns_resolve_on_representative_fields():
                                        "num_docs": 3 * 20_000,
                                        "code_bytes": 2},
         "sparse.tail_scan": {"queries": 1, "num_docs": 2_000},
+        # PR 16: analyze cost is bytes-based (text has no flop shape)
+        "build.analyze": {"nbytes": 1 << 20},
     }
     for name, fields in reps.items():
         c = kernel_cost(name, fields)
