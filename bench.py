@@ -1799,6 +1799,199 @@ def config7_mixed(rng):
     return result
 
 
+def config8_superpack(rng):
+    """C8 tenant-superpack arm (PR 17): ~1,000 SMALL tenant indices
+    share size-class superpacks and serve through the SAME compiled
+    tenant-gather programs, so compiled-program count is O(size-classes)
+    instead of O(tenants). Phases: (1) build + fold every tenant,
+    (2) row-level BIT parity of the tenant-gather lane vs the per-index
+    sharded oracle on a tenant sample, (3) closed-loop serving QPS with
+    superpacks ON, (4) the same request stream with superpacks OFF
+    (per-index dispatch baseline) including service-level response
+    parity on a sample. Records QPS-per-tenant and HBM-per-tenant for
+    both dispatch modes, the compiled-program count against its
+    size-class bound, and the `superpack.tenant_gather` cost-model
+    cross-check. Half the tenants use a narrower vocabulary so TWO
+    block size classes exist — the bucketing itself is exercised."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from elasticsearch_tpu.engine.engine import Engine
+    from elasticsearch_tpu.parallel.sharded import msearch_sharded
+
+    smoke = bool(os.environ.get("ES_BENCH_SMOKE"))
+    n_tenants = 60 if smoke else 1000
+    docs_per_tenant = 24
+    n_search_clients = 32 if smoke else 256
+    reqs_per_client = 4
+    n_reqs = n_search_clients * reqs_per_client
+    env_prev = os.environ.get("ES_TPU_SUPERPACK")
+    os.environ["ES_TPU_SUPERPACK"] = "1"
+    try:
+        log(f"[c8] building {n_tenants} small tenant indices...")
+        engine = Engine(None)
+        names = []
+        t_build = time.perf_counter()
+        for t in range(n_tenants):
+            trng = np.random.default_rng(10_000 + t)
+            # alternate vocab width -> two block size classes on purpose
+            vocab = 40 if t % 2 else 20
+            name = f"tenant{t:04d}"
+            engine.create_index(
+                name, {"properties": {"body": {"type": "text"}}})
+            ops = [("index", name, str(j),
+                    {"body": " ".join(
+                        f"w{int(x)}" for x in trng.integers(0, vocab, 6))})
+                   for j in range(docs_per_tenant)]
+            res = engine.bulk(ops)
+            assert not res["errors"], res
+            engine.indices[name].refresh()
+            names.append(name)
+        build_s = time.perf_counter() - t_build
+
+        mgr = engine.superpacks
+        t_fold = time.perf_counter()
+        adopted = sum(1 for n_ in names
+                      if mgr.adopt(engine.indices[n_]))
+        fold_s = time.perf_counter() - t_fold
+        assert adopted == n_tenants, (adopted, n_tenants)
+        st0 = mgr.stats()
+        n_classes = st0["size_classes"]
+        assert n_classes >= 2, st0  # the bucketing is actually exercised
+        log(f"[c8] {adopted} tenants folded into {n_classes} size "
+            f"classes in {fold_s:.2f}s")
+
+        # ---- row-level bit parity vs the per-index sharded oracle -------
+        sample = names[:: max(1, n_tenants // 50)]
+        queries = [[("w3", 1.0), ("w7", 1.0)], [("w1", 1.0)]]
+        for name in sample:
+            ss = engine.indices[name]._searcher
+            v_sp, _, i_sp, t_sp = mgr.msearch(name, "body", queries, TOP_K)
+            v_px, _, i_px, t_px = msearch_sharded(ss, "body", queries,
+                                                  TOP_K)
+            kk = min(v_sp.shape[-1], v_px.shape[-1])
+            assert np.array_equal(
+                np.asarray(v_sp)[..., :kk].view(np.uint32),
+                np.asarray(v_px)[..., :kk].view(np.uint32)), name
+            assert np.array_equal(np.asarray(i_sp)[..., :kk],
+                                  np.asarray(i_px)[..., :kk]), name
+            assert np.array_equal(np.asarray(t_sp), np.asarray(t_px)), name
+
+        # ---- serving closed loop: superpack ON --------------------------
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix="c8-engine")
+        svc = engine.serving
+        svc.bind_executor(pool.submit)
+        svc.set_enabled(True)
+        bodies = [{"query": {"match": {
+            "body": f"w{i % 20} w{(i * 7) % 20}"}}, "size": TOP_K}
+            for i in range(n_reqs)]
+        entries = [svc.classify(names[i % n_tenants], b, {})
+                   for i, b in enumerate(bodies)]
+        assert all(e is not None for e in entries)
+
+        def _closed_loop():
+            lat = [0.0] * n_reqs
+            out = [None] * n_reqs
+            it = iter(range(n_reqs))
+            lk = threading.Lock()
+
+            def client(cid):
+                while True:
+                    with lk:
+                        i = next(it, None)
+                    if i is None:
+                        return
+                    t0 = time.perf_counter()
+                    r = svc.submit(dict(entries[i]),
+                                   tenant=names[i % n_tenants]) \
+                        .result(timeout=600)
+                    lat[i] = (time.perf_counter() - t0) * 1e3
+                    out[i] = r
+            ths = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_search_clients)]
+            t_all = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            return n_reqs / (time.perf_counter() - t_all), lat, out
+
+        for i in range(min(32, n_reqs)):  # compile warm
+            svc.submit(dict(entries[i]), tenant="warm").result(timeout=600)
+        qps_on, lat_on, out_on = _closed_loop()
+        svc.drain(timeout_s=60)
+        programs = mgr.compiled_program_count()
+        # the tentpole contract: programs bounded by size classes x wave
+        # shape tiers (Q pow2 tiers), NEVER by tenant count
+        bound = n_classes * 8
+        assert programs <= bound, (programs, bound)
+        assert programs < n_tenants, (programs, n_tenants)
+        st1 = mgr.stats()
+
+        # ---- the same stream, per-index dispatch (superpack OFF) --------
+        os.environ["ES_TPU_SUPERPACK"] = "0"
+        for i in range(min(32, n_reqs)):
+            svc.submit(dict(entries[i]), tenant="warm").result(timeout=600)
+        qps_off, lat_off, out_off = _closed_loop()
+        svc.drain(timeout_s=60)
+        parity_n = min(64, n_reqs)
+        for i in range(parity_n):  # service-level response parity
+            assert out_on[i]["hits"] == out_off[i]["hits"], i
+        hbm_px = [sum(int(a.nbytes) for a in
+                      engine.indices[n_]._searcher.dev.values()
+                      if hasattr(a, "nbytes"))
+                  for n_ in names]
+
+        latency_on = _hist_pcts("bench.c8.superpack.ms", lat_on)
+        latency_off = _hist_pcts("bench.c8.per_index.ms", lat_off)
+        result = {
+            "tenants": n_tenants,
+            "docs_per_tenant": docs_per_tenant,
+            "build_s": round(build_s, 2),
+            "fold_s": round(fold_s, 2),
+            "size_classes": n_classes,
+            "compiled_programs": programs,
+            "program_bound": bound,
+            "parity": {
+                "row_bitwise_tenants": len(sample),
+                "service_responses": parity_n,
+                "equal": True,  # asserted above
+            },
+            "superpack": {
+                "qps": round(qps_on, 1),
+                "qps_per_tenant": round(qps_on / n_tenants, 4),
+                "latency": latency_on,
+                "hbm_bytes_per_tenant": st1["hbm_bytes_per_tenant"],
+                "padded_waste_pct": st1["padded_waste_pct"],
+                "folds": mgr.counters.get("folds", 0),
+            },
+            "per_index": {
+                "qps": round(qps_off, 1),
+                "qps_per_tenant": round(qps_off / n_tenants, 4),
+                "latency": latency_off,
+                "hbm_bytes_per_tenant": int(np.mean(hbm_px)),
+            },
+            "qps_vs_per_index": round(qps_on / max(qps_off, 1e-9), 3),
+            "xla_cost_check": _xla_cost_check({"superpack.tenant_gather"}),
+            "basis": "in-memory engine; one engine thread (REST "
+                     "discipline); ON/OFF toggled via ES_TPU_SUPERPACK "
+                     "between identical request streams; HBM-per-tenant "
+                     "= shared-pack bytes / members (superpack) vs mean "
+                     "per-index device bytes (baseline); CPU smokes are "
+                     "host-bound — TPU is the criterion",
+        }
+        svc.stop()
+        engine.close()
+        pool.shutdown(wait=True)
+        return result
+    finally:
+        if env_prev is None:
+            os.environ.pop("ES_TPU_SUPERPACK", None)
+        else:
+            os.environ["ES_TPU_SUPERPACK"] = env_prev
+
+
 def preflight():
     """Compile every kernel geometry the bench will dispatch BEFORE any
     timed run (VERDICT r3 #8: round 3 lost a config mid-bench to an
@@ -2024,6 +2217,10 @@ def main():
 
     if _want("c7"):
         _guard("mixed_read_write", lambda: config7_mixed(rng))
+        gc.collect()
+
+    if _want("c8"):
+        _guard("tenant_superpack", lambda: config8_superpack(rng))
         gc.collect()
 
     _write_record(extras, partial=False)

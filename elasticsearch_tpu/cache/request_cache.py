@@ -121,13 +121,27 @@ class ShardRequestCache:
                             shard: int | None = None) -> int:
         """Drop every entry belonging to `searcher_token`. With `shard`
         given, drop that shard's entries AND the whole-searcher (-1)
-        entries — a merged result depends on every shard."""
+        entries — a merged result depends on every shard.
+
+        Tenant superpacks (PR 17) lean on the `shard` slot for tenant
+        scoping: each member tenant caches under (superpack_token, lane)
+        with a PER-LANE epoch, and a tenant's refold/delete calls this
+        with its lane — so one tenant's churn can never evict (or stale-
+        serve) a neighbor's hot entries in the shared pack. A superpack
+        never writes -1 entries, so the -1 sweep is vacuous there."""
         if shard is None:
             pred = lambda k: k[0][0] == searcher_token
         else:
             pred = lambda k: (k[0][0] == searcher_token
                               and k[0][1] in (shard, -1))
         return self.lru.invalidate_where(pred)
+
+    def invalidate_tenant_lane(self, superpack_token: int,
+                               lane: int) -> int:
+        """Tenant-scoped invalidation for a shared superpack: exactly
+        one member lane's entries drop (the satellite contract — a
+        refreshing tenant leaves its neighbors' caches hot)."""
+        return self.invalidate_searcher(superpack_token, shard=lane)
 
     def _on_removal(self, _key, _value, reason) -> None:
         if reason == "evicted":

@@ -54,6 +54,7 @@ FAULT_POINTS = (
     "device.fetch",      # blocking device -> host result pull
     "refresh.build",     # refresh-time pack/tier build
     "serving.wave",      # serving wave device stage
+    "superpack.fold",    # tenant lane install into a shared superpack
 )
 
 

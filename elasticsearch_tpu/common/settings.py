@@ -288,6 +288,15 @@ def default_cluster_settings() -> list[Setting]:
         # wave visit — low so search waves dominate, never zero-starved
         # (the RR visits every non-empty tenant)
         Setting("serving.merge.weight", 1.0, Setting.float_, dynamic=True),
+        # tenant superpacks (tenancy/, PR 17): many small tenant indices
+        # in one shared size-class device layout served by one compiled
+        # tenant-gather program family. ES_TPU_SUPERPACK=1/0 overrides
+        # the setting (the tier-1 shuffled-gate switch). max_docs bounds
+        # membership: a tenant past it serves per-index (its own pack
+        # amortizes; superpacks exist for the many-small-indices shape)
+        Setting("superpack.enabled", False, Setting.bool_, dynamic=True),
+        Setting("superpack.max_docs", 8192, Setting.positive_int,
+                dynamic=True),
         # LSM tail-segment bound (PR 15): an incremental refresh packs
         # its new docs as one sealed segment; beyond this many segments
         # a background fold merges them (the Lucene merge-policy analog)

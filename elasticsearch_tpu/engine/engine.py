@@ -2117,6 +2117,7 @@ class Engine:
         self._ml = None
         self._monitoring = None
         self._serving = None
+        self._superpacks = None
         self._watcher = None
         self._slo = None
         self._profiler = None
@@ -2284,6 +2285,17 @@ class Engine:
         return self._serving
 
     @property
+    def superpacks(self):
+        """Tenant superpacks (tenancy/): lazy — the size-class-bucketed
+        shared device layouts serving many small tenant indices from one
+        compiled tenant-gather program family (PR 17)."""
+        from ..tenancy import SuperpackManager
+
+        if self._superpacks is None:
+            self._superpacks = SuperpackManager(self)
+        return self._superpacks
+
+    @property
     def watcher(self):
         """Scheduled alerting (xpack/watcher.py): lazy — watches live in
         cluster metadata; building the service registers the persistent-
@@ -2395,6 +2407,15 @@ class Engine:
         if self.settings.get("serving.enabled"):
             return self.serving
         return None
+
+    def superpacks_if_enabled(self):
+        """The superpack manager iff tenant superpacks are on — without
+        building it just to learn they're off (checked once per wave)."""
+        from ..tenancy import superpack_enabled
+
+        if not superpack_enabled(self.settings):
+            return None
+        return self.superpacks
 
     def schedule_tail_merge(self, idx) -> bool:
         """Schedule one LSM tail-segment fold for `idx` (PR 15). With
@@ -2646,6 +2667,9 @@ class Engine:
         idx = self.get_index(name)
         idx.close()
         del self.indices[name]
+        if self._superpacks is not None:
+            # free the lane + drop ONLY this tenant's cache entries
+            self._superpacks.evict(name)
         self.meta.drop_index(name)
         self.breakers.set_steady("fielddata", name, 0)
         d = self._dir_for(name)

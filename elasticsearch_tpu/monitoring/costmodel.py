@@ -662,6 +662,11 @@ KERNEL_COSTS: dict[str, object] = {
     # judged against the ICI peak (ici_util)
     "sharded.allgather_topk": _sharded_allgather_topk,
     "sharded.global_merge": _sharded_global_merge,
+    # PR 17: tenant superpacks — one program scoring a wave that mixes
+    # queries from many tenant lanes of a shared size-class layout; the
+    # body is the batched disjunction over lane-indexed gathers, so the
+    # same cost shape applies (num_docs = the class's padded doc width)
+    "superpack.tenant_gather": _batched_disjunction,
     # PR 11: the fused Pallas arm riding the one-program route (embedded
     # shard_map region + in-program merge), and the serving wave's
     # single combined fetch — both collective entries with ici_util

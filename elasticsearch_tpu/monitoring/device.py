@@ -167,6 +167,14 @@ def padded_waste_bytes(engine) -> int:
                 total += pack_padded_waste(s.sp)
             except Exception:  # noqa: BLE001 - stats must not fail
                 continue
+    # tenant superpacks (PR 17) rent additional padded HBM: vacant lanes
+    # + per-lane size-class padding, the same accounting over the shared
+    # layout (the manager reuses pack_padded_waste via a lane shim)
+    if engine._superpacks is not None:
+        try:
+            total += engine._superpacks.padded_waste_bytes()
+        except Exception:  # noqa: BLE001 - stats must not fail
+            pass
     return total
 
 

@@ -100,6 +100,9 @@ XLA_CHECKS: dict[str, dict] = {
         "status": "exempt",
         "reason": "wave-level combined fetch spanning many per-lane "
                   "programs — each lane's own kernel is cross-checked"},
+    # PR 17: the tenant-gather body is batched.disjunction over
+    # lane-indexed gathers; same sort/cumsum machinery, same cost shape
+    "superpack.tenant_gather": {"status": "checked"},
     "sharded.wand_pass1": {"status": "exempt",
                            "reason": "experimental flag, wall-time-only "
                                      "accounting (no cost entry)"},

@@ -1750,6 +1750,23 @@ def _impact_sharded_usable(ss: "StackedSearcher") -> bool:
             and "impact_codes" in ss.dev)
 
 
+def impact_arm_usable(ss: "StackedSearcher") -> bool:
+    """Public arm-routing probe: would msearch route this searcher to the
+    impact tier? Superpack eligibility (`tenancy/`) must exclude such
+    searchers — members are scored by the exact tenant-gather kernel, and
+    parity is against whatever arm per-index dispatch would pick."""
+    return _impact_sharded_usable(ss)
+
+
+def plan_adapter(ss: "StackedSearcher", s: int) -> "_PlanShardAdapter":
+    """Public host-planning adapter for one shard of a stacked searcher:
+    a BatchTermSearcher over it produces the EXACT per-index plan
+    (weights from effective global stats, shard-local block rows) —
+    shared by the merged-msearch arm and the superpack tenant-gather
+    planner so their plans can never drift apart."""
+    return _PlanShardAdapter(ss.sp, s, ss)
+
+
 def _msearch_sharded_partials(ss: "StackedSearcher", fld: str,
                               queries: list, k: int):
     """Per-shard pre-merge rows (v [S, Q, kk], i [S, Q, kk], t [S, Q])

@@ -2530,18 +2530,22 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     @handler
     async def cat_indices(request):
         rows = []
+        mgr = engine._superpacks  # annotate only — never build the manager
         for name, idx in sorted(engine.indices.items()):
-            rows.append(
-                {
-                    "health": engine.index_health(name),
-                    "status": "open",
-                    "index": name,
-                    "pri": str(idx.num_shards),
-                    "rep": str(idx.settings.get("number_of_replicas") or 0),
-                    "docs.count": str(idx.live_count),
-                    "docs.deleted": str(sum(1 for e in idx.docs.values() if not e.alive)),
-                }
-            )
+            row = {
+                "health": engine.index_health(name),
+                "status": "open",
+                "index": name,
+                "pri": str(idx.num_shards),
+                "rep": str(idx.settings.get("number_of_replicas") or 0),
+                "docs.count": str(idx.live_count),
+                "docs.deleted": str(sum(1 for e in idx.docs.values() if not e.alive)),
+            }
+            if mgr is not None:
+                sp = mgr.member_stats(name)
+                if sp is not None:
+                    row["superpack"] = sp
+            rows.append(row)
         if request.query.get("format") == "json":
             return web.json_response(rows)
         text = "\n".join(
@@ -2598,6 +2602,16 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # continuous-batching front end: queue depth,
                         # wave occupancy, shed/expiry/cancel accounting
                         "serving": engine.serving.stats(),
+                        # tenant superpacks (PR 17): members, size
+                        # classes, compiled-program count, HBM bytes per
+                        # tenant, padded-waste fraction — the numbers
+                        # that make thousand-tenant density a reported,
+                        # bounded quantity (cheap placeholder when the
+                        # manager was never built)
+                        "superpack": (engine._superpacks.stats()
+                                      if engine._superpacks is not None
+                                      else {"enabled": False,
+                                            "members": 0}),
                         # data-plane resilience (PR 14): per-peer circuit
                         # breakers (state/trips), retry + failover +
                         # partial-response counters, device-degradation

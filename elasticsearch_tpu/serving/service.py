@@ -525,10 +525,45 @@ class ServingService:
             except Exception as ex:  # noqa: BLE001 - per-entry envelope
                 self._finish_entry(ps, error=ex)
         ready = searches
+        # superpack lane (PR 17): entries whose member lane is CURRENT in
+        # a shared tenant superpack serve from one tenant-gather program —
+        # a single wave job mixing queries from many small tenant indices
+        # in one dispatch. A failed claim (stale lane, ineligible query)
+        # falls through to the per-index path, byte-identical by contract.
+        sp_members: list[PendingSearch] = []
+        mgr = self.engine.superpacks_if_enabled()
+        if mgr is not None:
+            rest = []
+            for ps in ready:
+                try:
+                    claimed = mgr.wave_claim(ps.entry)
+                except Exception:  # noqa: BLE001 - claim must never poison
+                    claimed = False
+                (sp_members if claimed else rest).append(ps)
+            ready = rest
         by_index: dict[str, list[PendingSearch]] = {}
         for ps in ready:
             by_index.setdefault(ps.entry["index"], []).append(ps)
         with collect_profile_events() as events:
+            if sp_members:
+                try:
+                    job = mgr.search_wave_begin(
+                        [ps.entry for ps in sp_members])
+                    state["jobs"].append((mgr, sp_members, job))
+                except Exception:  # noqa: BLE001 - degrade, don't poison
+                    for ps in sp_members:
+                        with self._lock:
+                            self.counters["fallback_solo"] += 1
+                        state["fallback_solo"] += 1
+                        try:
+                            res = self.engine.search_multi(
+                                ps.entry.get("expression"),
+                                ignore_unavailable=ps.entry.get("iu", False),
+                                allow_no_indices=ps.entry.get("ani", True),
+                                **ps.entry["kwargs"])
+                            self._finish_entry(ps, result=res)
+                        except Exception as ex:  # noqa: BLE001
+                            self._finish_entry(ps, error=ex)
             for name, members in by_index.items():
                 idx = self.engine.indices.get(name)
                 if idx is None:
@@ -592,7 +627,12 @@ class ServingService:
                         self._finish_entry(ps, error=res)
                     else:
                         self._finish_entry(ps, result=res)
-                indices.append(idx.name)
+                # a superpack job serves MANY indices: report the member
+                # names (ordered, unique), not the job owner's synthetic
+                # "_superpack" — flight records must name real tenants
+                for nm in (job.get("index_names") or (idx.name,)):
+                    if nm not in indices:
+                        indices.append(nm)
                 lanes["generic"] += len(job.get("lanes", ()))
                 lanes["term"] += len(job.get("term_lanes", ()))
                 lanes["tiered"] += 1 if job.get("tiered") else 0

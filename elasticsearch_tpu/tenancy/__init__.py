@@ -1,0 +1,13 @@
+"""Tenant superpacks: size-class-bucketed shared device layouts serving
+thousands of small tenant indices from one compiled program family.
+
+  - kernels.py    tenant-gather term-disjunction (lane-indexed twin of
+                  ops/batched.batch_term_disjunction, byte-identical rows)
+  - superpack.py  SuperpackManager: size classes, lane lifecycle (fold as
+                  the `_merge` internal tenant), per-tenant cache epochs,
+                  the duck-typed serving-wave job
+"""
+
+from .superpack import SuperpackManager, size_class_of, superpack_enabled
+
+__all__ = ["SuperpackManager", "size_class_of", "superpack_enabled"]

@@ -1,0 +1,129 @@
+"""Tenant-gather scoring kernel for superpacks (PR 17).
+
+One compiled program scores a serving wave that mixes queries from many
+small tenant indices sharing ONE stacked device layout: every query row
+carries its tenant lane id, and the posting gathers lead with that lane
+index (`dev["post_docids"][tid, rows]`) — the scalar-prefetch discipline
+`ann/kernels.py` uses for probe ids, applied to the tenant axis. The
+scoring body past the gathers is `ops/batched.batch_term_disjunction`
+op-for-op: the same lax.sort candidate machinery, the same f64 run sums,
+the same int64 rank-key merge — so a tenant's rows are byte-identical to
+the rows its own per-index program would produce.
+
+Byte-parity contract (vs per-index dispatch of the SAME index):
+
+  * per-query `avgdl` is a runtime f32 operand instead of the trace-time
+    Python float the per-index program bakes in. A f32 array holding the
+    same value divides bitwise-identically (the baked constant is also
+    embedded at f32), so one program serves every tenant's stats.
+  * members carry no dense tier (superpack eligibility — small tenants
+    sit below `default_dense_min_df`), so `scores_d` is the same zeros
+    tensor the per-index kernel materializes for a dense-less pack.
+  * lane padding beyond a tenant's own blocks holds the class sentinel
+    docid with tf 0 and `live=False` — inert through the candidate
+    machinery exactly like the StackedPack shard-padding discipline.
+
+Programs are cached per (plan-shape tier, batch tier) — NEVER per
+tenant — which is what turns compiled-program count from O(tenants)
+into O(size-classes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..index.pack import BLOCK
+
+
+def tenant_term_disjunction(
+    dev: dict,
+    plan_shapes: tuple,  # (Ts, B, k) — trace-time constants
+    sparse_rows: jax.Array,  # [Q, Ts, B] int32 lane-local block rows
+    sparse_weights: jax.Array,  # [Q, Ts] f32
+    tids: jax.Array,  # [Q] int32 tenant lane per query
+    avgdl_q: jax.Array,  # [Q] f32 per-tenant field avgdl
+    num_docs: int,  # the size class's padded doc width n_pad
+    k1: float = 1.2,
+    b: float = 0.75,
+    has_norms: bool = True,
+):
+    """-> (scores [Q,k], docids [Q,k], totals [Q]). Jit-traceable.
+
+    The multi-tenant twin of `batch_term_disjunction`: identical sparse
+    candidate machinery over lane-indexed gathers. Docids are tenant-
+    local (each lane's blocks keep the tenant's own numbering), so a
+    row maps straight back to the member index's `shard_docs[0]`.
+    """
+    Ts, B, k = plan_shapes
+    n = num_docs
+    Q = sparse_rows.shape[0]
+
+    # members carry no dense tier (eligibility): the zeros tensor the
+    # per-index kernel also materializes for a dense-less pack, kept so
+    # the downstream ops (dg gather, masked_d top-k, totals) stay
+    # op-for-op identical to the baseline
+    scores_d = jnp.zeros((Q, n), jnp.float32)
+
+    # ---- sparse tail: tenant-led gathers --------------------------------
+    t3 = tids[:, None, None]
+    docids = dev["post_docids"][t3, sparse_rows]  # [Q, Ts, B, 128]
+    tfs = dev["post_tfs"][t3, sparse_rows]
+    if has_norms:
+        dls = dev["post_dls"][t3, sparse_rows]
+        denom = tfs + k1 * (1.0 - b + b * dls / avgdl_q[:, None, None, None])
+    else:
+        denom = tfs + k1
+    part = sparse_weights[:, :, None, None] * tfs / denom  # pad -> 0
+    live = dev["live"][tids]  # [Q, n_pad]
+
+    C = Ts * B * BLOCK
+    cd = docids.reshape(Q, C)
+    cs = part.reshape(Q, C)
+    sd, sv = jax.lax.sort((cd, cs), dimension=1, num_keys=1)
+    sv64 = sv.astype(jnp.float64)
+    csum = jnp.cumsum(sv64, axis=1)
+    col = jnp.arange(C)
+    starts = jnp.where(col[None, :] == 0, True, sd != jnp.roll(sd, 1, axis=1))
+    base = jnp.where(starts, csum - sv64, -jnp.inf)
+    run_base = jax.lax.cummax(base, axis=1)
+    run_sum = (csum - run_base).astype(jnp.float32)
+    is_end = jnp.where(col[None, :] == C - 1, True,
+                       sd != jnp.roll(sd, -1, axis=1))
+    live_c = jnp.take_along_axis(live, jnp.minimum(sd, n - 1), axis=1) \
+        & (sd < n)
+    valid_end = is_end & live_c
+    dg = jnp.take_along_axis(scores_d, jnp.minimum(sd, n - 1), axis=1)
+    cand = jnp.where(valid_end, run_sum + dg, -jnp.inf)
+
+    # ---- merge (identical to the baseline's dense-less form) ------------
+    masked_d = jnp.where(live & (scores_d > 0), scores_d, -jnp.inf)
+    dv, di = jax.lax.top_k(masked_d, k)
+    dup = (di[:, :, None] == sd[:, None, :]) & valid_end[:, None, :]
+    dv = jnp.where(dup.any(-1), -jnp.inf, dv)
+    all_v = jnp.concatenate([cand, dv], axis=1)
+    all_i = jnp.concatenate([sd, di], axis=1)
+    score_bits = jax.lax.bitcast_convert_type(all_v, jnp.int32).astype(
+        jnp.int64)
+    rank = (score_bits << 32) + (jnp.int64(0xFFFFFFFF)
+                                 - all_i.astype(jnp.int64))
+    _, fidx = jax.lax.top_k(rank, k)
+    fv = jnp.take_along_axis(all_v, fidx, axis=1)
+    fids = jnp.take_along_axis(all_i, fidx, axis=1)
+
+    totals = (masked_d > 0).sum(axis=1) \
+        + (valid_end & (dg <= 0) & (run_sum > 0)).sum(axis=1)
+    return fv, fids, totals.astype(jnp.int32)
+
+
+def build_gather_program(n_pad: int, plan_shapes: tuple, has_norms: bool):
+    """One jitted tenant-gather program for a size class. The caller
+    caches it under its shape-tier key (Ts, B, kk, Q_tier, has_norms) —
+    tenant identity must NEVER reach the key (the O(size-classes)
+    compiled-program contract, asserted by the C8 bench arm)."""
+    def run(dev, rows, ws, tids, avgdl_q):
+        return tenant_term_disjunction(
+            dev, plan_shapes, rows, ws, tids, avgdl_q, num_docs=n_pad,
+            has_norms=has_norms)
+
+    return jax.jit(run)

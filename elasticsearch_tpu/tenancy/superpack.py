@@ -1,0 +1,858 @@
+"""Tenant superpacks: thousands of small indices in one compiled program.
+
+The millions-of-users shape is not one big index but 10^4..10^6 small
+tenant indices; per-tenant XLA programs and per-tenant device_puts
+cannot amortize at that fan-in (the classic "too many small shards"
+death). A **SuperpackManager** packs many small tenant indices into ONE
+shared stacked device layout — a tenant-id lane beside the shard axis,
+generalizing the `parallel/stacked.py` padding discipline with
+**size-class bucketing** (pow2 (doc, block) buckets, so a 100-doc tenant
+never rents a 1M-doc tenant's padding) — served by one compiled
+tenant-gather program family per class (`tenancy/kernels.py`), byte-
+identical per tenant to per-index dispatch.
+
+Lifecycle rides the machinery already built:
+
+  * a tenant's refresh makes its lane stale; the refold runs as the
+    PR-15 `_merge` internal tenant on the serving queue
+    (`ServingService.submit_merge`) and installs atomically — a faulted
+    fold leaves every neighbor lane byte-identical (`superpack.fold` /
+    `refresh.build` injection sites, chaos stage E);
+  * the PR-2 request cache keys per (superpack token, lane) with a
+    PER-LANE epoch, so one tenant's refresh/delete invalidates ONLY that
+    tenant's entries (satellite: tenant-scoped cache epochs);
+  * serving waves claim eligible member entries in
+    `ServingService._wave_begin` and dispatch them as one duck-typed
+    wave job speaking the same `search_wave_begin/fetch/finish`
+    protocol as `EsIndex`.
+
+Eligibility (checked per claim, cheap): single-shard, base-only (no
+LSM tail, nothing pending), no dense tier (small tenants sit below
+`default_dense_min_df`), exact-arm routing (no impact/fused), and at
+most `superpack.max_docs` documents. Anything else serves per-index —
+correctness never depends on membership.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.pack import BLOCK
+
+MIN_DOC_CLASS = 128  # smallest n_pad tier
+MIN_BLOCK_CLASS = 8  # smallest nb_pad tier
+MIN_LANES = 8  # initial lane capacity per class (grows pow2)
+
+
+def _pow2_at_least(x: int, floor: int) -> int:
+    v = max(int(x), floor)
+    return 1 << (v - 1).bit_length()
+
+
+def size_class_of(num_docs: int, num_blocks: int) -> tuple[int, int]:
+    """Pow2 (n_pad, nb_pad) bucket for a tenant pack: every member of a
+    class shares one device layout and one compiled program family."""
+    return (_pow2_at_least(num_docs, MIN_DOC_CLASS),
+            _pow2_at_least(num_blocks, MIN_BLOCK_CLASS))
+
+
+def superpack_enabled(settings) -> bool:
+    """ES_TPU_SUPERPACK=1 forces on (the tier-1 shuffled-gate pass),
+    =0 forces off; otherwise the dynamic `superpack.enabled` setting."""
+    import os
+
+    env = os.environ.get("ES_TPU_SUPERPACK")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    try:
+        return bool(settings.get("superpack.enabled"))
+    except Exception:  # noqa: BLE001 - settings-less engines
+        return False
+
+
+class _Lane:
+    """One member tenant's slot in a size-class superpack."""
+
+    __slots__ = ("name", "lane", "ss", "num_docs", "num_blocks", "epoch",
+                 "folded_at")
+
+    def __init__(self, name, lane, ss, num_docs, num_blocks, epoch):
+        self.name = name
+        self.lane = lane
+        self.ss = ss  # the member's base StackedSearcher at fold time
+        self.num_docs = num_docs
+        self.num_blocks = num_blocks
+        self.epoch = epoch  # PER-LANE cache epoch (tenant-scoped)
+        self.folded_at = time.monotonic()
+
+
+class Superpack:
+    """One size class: host + device lane arrays and the compiled
+    tenant-gather program family for this (n_pad, nb_pad) shape."""
+
+    def __init__(self, key: tuple[int, int]):
+        self.n_pad, self.nb_pad = key
+        self.key = key
+        self.capacity = 0
+        self.host: dict[str, np.ndarray] = {}
+        self.dev: dict[str, jax.Array] = {}
+        self.lanes: dict[str, _Lane] = {}  # member name -> lane
+        self.free: list[int] = []
+        from ..cache import next_searcher_token
+
+        self.cache_token = next_searcher_token()
+        self._programs: dict = {}  # shape-tier key -> jitted program
+        self.folds = 0
+        self.fold_failures = 0
+
+    # ---- layout ----------------------------------------------------------
+
+    def _blank_host(self, T: int) -> dict[str, np.ndarray]:
+        return {
+            "post_docids": np.full((T, self.nb_pad, BLOCK), self.n_pad,
+                                   np.int32),
+            "post_tfs": np.zeros((T, self.nb_pad, BLOCK), np.float32),
+            "post_dls": np.zeros((T, self.nb_pad, BLOCK), np.float32),
+            "live": np.zeros((T, self.n_pad), bool),
+        }
+
+    def _ensure_capacity(self, want: int) -> None:
+        if want <= self.capacity:
+            return
+        T = _pow2_at_least(want, MIN_LANES)
+        host = self._blank_host(T)
+        if self.capacity:
+            for k, arr in self.host.items():
+                host[k][: self.capacity] = arr
+        from ..monitoring.refresh_profile import build_stage
+        from ..telemetry import host_transition
+
+        host_transition("refresh")
+        with build_stage("build.device_put",
+                         nbytes=sum(a.nbytes for a in host.values())):
+            dev = {k: jax.device_put(v) for k, v in host.items()}
+            for v in dev.values():
+                v.block_until_ready()
+        self.free.extend(range(self.capacity, T))
+        self.host, self.dev, self.capacity = host, dev, T
+
+    # ---- fold (adopt / refold) ------------------------------------------
+
+    def build_lane_arrays(self, ss) -> dict[str, np.ndarray]:
+        """Host lane arrays from a member's single-shard StackedPack.
+        In-block pad slots keep the tenant's own sentinel (docid ==
+        num_docs, dead per `live`); rows past the tenant's blocks hold
+        the class sentinel `n_pad` — both inert through the candidate
+        machinery, the StackedPack padding discipline per lane."""
+        sp = ss.sp
+        p = sp.shards[0]
+        nb = int(p.num_blocks)
+        n = int(p.num_docs)
+        if nb > self.nb_pad or n > self.n_pad:
+            raise ValueError("pack exceeds its size class")
+        out = {
+            "post_docids": np.full((self.nb_pad, BLOCK), self.n_pad,
+                                   np.int32),
+            "post_tfs": np.zeros((self.nb_pad, BLOCK), np.float32),
+            "post_dls": np.zeros((self.nb_pad, BLOCK), np.float32),
+            "live": np.zeros((self.n_pad,), bool),
+        }
+        out["post_docids"][:nb] = p.post_docids
+        out["post_tfs"][:nb] = p.post_tfs
+        out["post_dls"][:nb] = p.post_dls
+        out["live"][:n] = np.asarray(sp.live[0][:n])
+        return out
+
+    def fold(self, name: str, idx, ss) -> _Lane:
+        """Build + atomically install one tenant's lane. Every failure
+        mode (injected `superpack.fold` fault, device OOM) leaves the
+        previous lane state — and every neighbor — byte-identical: the
+        new device arrays are staged and materialized BEFORE any handle
+        swaps, and host mirrors only update after the swap."""
+        from ..common import faults
+
+        member = self.lanes.get(name)
+        lane = member.lane if member is not None else (
+            self.free[-1] if self.free else self.capacity)
+        self._ensure_capacity(lane + 1)
+        arrs = self.build_lane_arrays(ss)
+        faults.check("superpack.fold", index=name, lane=lane)
+        from ..monitoring.refresh_profile import build_stage
+
+        with build_stage("build.device_put",
+                         nbytes=sum(a.nbytes for a in arrs.values())):
+            staged = {k: self.dev[k].at[lane].set(jnp.asarray(v))
+                      for k, v in arrs.items()}
+            for v in staged.values():
+                v.block_until_ready()
+        # ---- commit point: nothing below raises ------------------------
+        self.dev = staged
+        for k, v in arrs.items():
+            self.host[k][lane] = v
+        if member is None and lane in self.free:
+            # `_ensure_capacity` put the grown range (lane included) on
+            # the free list; the lease must drop it wherever it sits or
+            # a later fold re-leases the slot over this tenant's data
+            self.free.remove(lane)
+        p = ss.sp.shards[0]
+        new = _Lane(name, lane, ss, int(p.num_docs), int(p.num_blocks),
+                    (member.epoch + 1) if member is not None else 0)
+        self.lanes[name] = new
+        self.folds += 1
+        self._invalidate_lane(lane)
+        return new
+
+    def release(self, name: str) -> None:
+        member = self.lanes.pop(name, None)
+        if member is None:
+            return
+        lane = member.lane
+        if self.capacity:
+            # dead lane: live all-False makes it inert; arrays stay until
+            # the slot is re-leased (no device work on the delete path)
+            self.host["live"][lane] = False
+            self.dev = dict(self.dev)
+            self.dev["live"] = self.dev["live"].at[lane].set(
+                jnp.zeros((self.n_pad,), bool))
+        self.free.append(lane)
+        self._invalidate_lane(lane)
+
+    def _invalidate_lane(self, lane: int) -> None:
+        """Tenant-scoped cache invalidation (satellite): only this lane's
+        request-cache entries drop — neighbors stay warm."""
+        from ..cache import request_cache
+
+        request_cache().invalidate_tenant_lane(self.cache_token, lane)
+
+    # ---- scope / program cache ------------------------------------------
+
+    def lane_cache_scope(self, member: _Lane):
+        """(token, epoch) scoping ONE tenant's merged rows: the lane id
+        is the 'shard' slot and the epoch is per-lane, so a neighbor's
+        refold can never invalidate (or serve) this tenant's entries.
+        The member searcher's stats epoch rides along: dfs-stats drift
+        changes plan weights, so rows cached under the old stats must
+        miss."""
+        return ((self.cache_token, member.lane),
+                (member.epoch, member.ss._stats_epoch))
+
+    def program(self, Ts: int, B: int, kk: int, Q: int, has_norms: bool):
+        key = (Ts, B, kk, Q, has_norms)
+        fn = self._programs.get(key)
+        if fn is None:
+            from .kernels import build_gather_program
+
+            fn = self._programs[key] = build_gather_program(
+                self.n_pad, (Ts, B, kk), has_norms)
+        return fn
+
+    # ---- accounting ------------------------------------------------------
+
+    def hbm_bytes(self) -> int:
+        return int(sum(a.nbytes for a in self.host.values()))
+
+    def padded_waste_bytes(self) -> int:
+        """The PR-5 `pack_padded_waste` accounting applied to the shared
+        layout: lanes are the shard axis, members are the real payload,
+        vacant + padded lane space is the rent."""
+        from ..monitoring.device import pack_padded_waste
+
+        if not self.capacity:
+            return 0
+        shim = SimpleNamespace(
+            S=self.capacity, n_max=self.n_pad, nb_max=self.nb_pad,
+            shards=[SimpleNamespace(num_docs=m.num_docs,
+                                    num_blocks=m.num_blocks)
+                    for m in self.lanes.values()],
+            post_docids=self.host["post_docids"],
+            post_tfs=self.host["post_tfs"],
+            post_dls=self.host["post_dls"],
+            live=self.host["live"], norms={}, text_present={},
+            dense_tf=None, stacked_docvalues={}, vectors={},
+        )
+        return pack_padded_waste(shim)
+
+    def stats(self) -> dict:
+        hbm = self.hbm_bytes()
+        members = len(self.lanes)
+        return {
+            "size_class": {"n_pad": self.n_pad, "nb_pad": self.nb_pad},
+            "members": members,
+            "lanes": self.capacity,
+            "hbm_bytes": hbm,
+            "hbm_bytes_per_tenant": (hbm // members) if members else 0,
+            "padded_waste_bytes": self.padded_waste_bytes(),
+            "compiled_programs": len(self._programs),
+            "folds": self.folds,
+            "fold_failures": self.fold_failures,
+        }
+
+
+class SuperpackManager:
+    """Engine-scoped registry of size-class superpacks + the duck-typed
+    serving-wave job owner (speaks `search_wave_begin/fetch/finish`)."""
+
+    name = "_superpack"
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.packs: dict[tuple[int, int], Superpack] = {}
+        self._folding: set[str] = set()
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+
+    # ---- enablement ------------------------------------------------------
+
+    def enabled(self) -> bool:
+        return superpack_enabled(getattr(self.engine, "settings", None))
+
+    # ---- membership ------------------------------------------------------
+
+    def member_of(self, name: str) -> _Lane | None:
+        for sp in self.packs.values():
+            m = sp.lanes.get(name)
+            if m is not None:
+                return m
+        return None
+
+    def _eligible_searcher(self, idx, ss) -> bool:
+        """Cheap per-claim gate: the member must be exactly the shape the
+        tenant-gather kernel replicates byte-for-byte. Everything else
+        serves per-index (correct, just unconsolidated)."""
+        try:
+            from ..parallel.sharded import impact_arm_usable
+
+            sp = ss.sp
+            if sp.S != 1 or sp.n_max <= 0:
+                return False
+            if getattr(sp, "dense_tf", None) is not None \
+                    or "dense_tfn" in ss.dev:
+                return False
+            if getattr(ss, "_exec", "vmap") == "shardmap":
+                return False  # the legacy test-oracle execution model
+            if getattr(ss, "mesh", None) is not None:
+                return False
+            if impact_arm_usable(ss):
+                return False  # per-index would route the impact arm
+            if sp.n_max > self._max_docs():
+                return False
+            return True
+        except Exception:  # noqa: BLE001 - eligibility must never raise
+            return False
+
+    def _max_docs(self) -> int:
+        try:
+            return int(self.engine.settings.get("superpack.max_docs"))
+        except Exception:  # noqa: BLE001
+            return 8192
+
+    def _fold_candidate(self, idx) -> bool:
+        """Cheap 'worth scheduling a fold?' pre-check, tolerant of LSM
+        tails (the refold major-merges them). Keeps organic adoption
+        from forcing merges on indices that could never join a pack."""
+        return (idx._searcher is not None and not idx._pending
+                and not idx._dirty and idx._hydrate is None
+                and idx.num_shards == 1
+                and idx.live_count <= self._max_docs())
+
+    def _base_clean(self, idx) -> bool:
+        return (idx._searcher is not None and not idx._pending
+                and not idx._dirty and not idx._tails
+                and idx._hydrate is None)
+
+    def _member_fresh(self, idx, member: _Lane) -> bool:
+        return member.ss is idx._searcher and self._base_clean(idx)
+
+    def adopt(self, idx) -> bool:
+        """Inline fold (engine thread / tests / bench). Serving-path
+        adoption goes through `_schedule_fold` as the `_merge` tenant."""
+        return self.refold(idx.name)
+
+    def refold(self, name: str) -> bool:
+        """(Re)build one tenant's lane from its CURRENT base pack.
+        Engine thread only. A failure (injected fault, ineligible shape)
+        leaves the old lane — and every neighbor — untouched."""
+        from ..common import faults
+
+        idx = self.engine.indices.get(name)
+        if idx is None:
+            self.evict(name)
+            return False
+        faults.check("refresh.build", index=name, op="superpack_fold")
+        if idx._tails and self._fold_candidate(idx):
+            # a refreshed tenant's docs live in LSM tail segments: the
+            # fold majors-merges them into a fresh sealed base (atomic,
+            # `_merge_tiers`) and THAT folds into the shared pack — "a
+            # tenant's refresh folds its tail in as the `_merge` tenant"
+            idx._merge_tiers()
+        if not self._base_clean(idx):
+            return False
+        ss = idx._searcher
+        member = self.member_of(name)
+        if member is not None and member.ss is ss:
+            return True  # already current
+        if not self._eligible_searcher(idx, ss):
+            if member is not None:
+                self.evict(name)
+            return False
+        p = ss.sp.shards[0]
+        key = size_class_of(int(p.num_docs), int(p.num_blocks))
+        old_key = None
+        for k, sp in self.packs.items():
+            if name in sp.lanes:
+                old_key = k
+                break
+        if old_key is not None and old_key != key:
+            self.packs[old_key].release(name)
+        pack = self.packs.get(key)
+        if pack is None:
+            pack = self.packs[key] = Superpack(key)
+        try:
+            pack.fold(name, idx, ss)
+        except Exception:
+            pack.fold_failures += 1
+            self.counters["fold_failures"] = (
+                self.counters.get("fold_failures", 0) + 1)
+            raise
+        self.counters["folds"] = self.counters.get("folds", 0) + 1
+        return True
+
+    def evict(self, name: str) -> None:
+        for sp in self.packs.values():
+            sp.release(name)
+
+    def _schedule_fold(self, idx) -> None:
+        """Queue this tenant's fold as the `_merge` internal tenant (the
+        PR-15 machinery, unchanged): the fold occupies a weighted-RR
+        wave slot on the engine thread, search waves pack around it."""
+        name = idx.name
+        with self._lock:
+            if name in self._folding:
+                return
+            self._folding.add(name)
+        svc = self.engine.serving_if_enabled()
+        if svc is None:
+            with self._lock:
+                self._folding.discard(name)
+            return
+        try:
+            fut = svc.submit_merge(lambda: self.refold(name), index=name)
+        except Exception:  # noqa: BLE001 - shed/stopped front end
+            with self._lock:
+                self._folding.discard(name)
+            return
+
+        def _done(_f):
+            with self._lock:
+                self._folding.discard(name)
+
+        fut.add_done_callback(_done)
+
+    # ---- serving-wave claim ---------------------------------------------
+
+    _BLOCKED_KWARGS = ("aggs", "knn", "sort", "search_after",
+                       "script_fields", "collapse", "rescore", "suggest",
+                       "highlight", "_source", "min_score")
+
+    def wave_claim(self, entry: dict) -> bool:
+        """Engine thread, inside `ServingService._wave_begin`: claim one
+        classified entry for the superpack lane. True only when the
+        member lane is CURRENT and the query is a pure term disjunction
+        the tenant-gather program replicates byte-for-byte; a stale
+        member schedules its background refold and serves per-index
+        this wave."""
+        if not callable(getattr(entry, "get", None)):
+            return False
+        if entry.get("internal") is not None:
+            return False
+        name = entry.get("index")
+        kwargs = entry.get("kwargs")
+        if not name or not isinstance(kwargs, dict):
+            return False
+        idx = self.engine.indices.get(name)
+        if idx is None:
+            return False
+        for k in self._BLOCKED_KWARGS:
+            if kwargs.get(k) is not None:
+                return False
+        member = self.member_of(name)
+        if member is None or not self._member_fresh(idx, member):
+            # a stale member (refresh left LSM tails) or a promising
+            # non-member schedules its background refold — the `_merge`
+            # internal tenant — and serves per-index THIS wave
+            if member is not None or self._fold_candidate(idx):
+                self._schedule_fold(idx)
+            return False
+        query = kwargs.get("query")
+        if not isinstance(query, dict):
+            return False
+        try:
+            from ..query.dsl import parse_query
+            from ..serving.coalesce import term_disjunction_of
+
+            spec = term_disjunction_of(parse_query(query, idx.mappings))
+        except Exception:  # noqa: BLE001 - generic lane handles it
+            spec = None
+        if spec is None:
+            return False
+        fld, terms = spec
+        if not terms:
+            return False
+        size = int(kwargs.get("size", 10))
+        from_ = int(kwargs.get("from_", 0))
+        tth = kwargs.get("track_total_hits")
+        if tth is None:
+            tth = 10_000
+        entry["_superpack"] = {
+            "idx": idx, "member": member, "fld": fld, "terms": terms,
+            "k": max(size + from_, 1), "size": size, "from_": from_,
+            "tth": tth,
+        }
+        return True
+
+    # ---- the wave job (duck-typed EsIndex wave protocol) ----------------
+
+    def search_wave_begin(self, entries: list[dict]) -> dict:
+        """One superpack wave job over claimed entries from MANY member
+        indices: one tenant-gather program per (size class, k, kk,
+        has_norms) group, request-cache consult per (lane, query),
+        dispatch deferred — the completer's fetch pulls everything in
+        one combined device_get, `search_wave_finish` builds per-entry
+        responses byte-identical to the per-index term lane."""
+        from ..cache import canonical_key, request_cache
+        from ..ops.batched import BatchTermSearcher
+        from ..telemetry import profile_event
+
+        n = len(entries)
+        job = {
+            "entries": entries, "slots": [None] * n, "groups": [],
+            "lanes": [], "term_lanes": [], "tiered": None,
+            "index_names": [], "t0": time.monotonic(),
+            "meta": {"wave_size": n, "term_packed": 0, "term_waves": [],
+                     "transitions": {"dispatch": 0, "fetch": 0}},
+        }
+        rc = request_cache()
+        groups: dict[tuple, dict] = {}
+        for i, entry in enumerate(entries):
+            ctx = entry.pop("_superpack", None)
+            if ctx is None:
+                job["slots"][i] = ("error", RuntimeError(
+                    "superpack wave entry lost its claim"))
+                continue
+            idx, member = ctx["idx"], ctx["member"]
+            if idx.name not in job["index_names"]:
+                job["index_names"].append(idx.name)
+            idx.counters["query_total"] = (
+                idx.counters.get("query_total", 0) + 1)
+            ss = member.ss
+            pack = self.packs[size_class_of(member.num_docs,
+                                            member.num_blocks)]
+            gkey = (pack.key, ctx["fld"], ctx["k"],
+                    ctx["fld"] in ss.ctx.has_norms)
+            g = groups.get(gkey)
+            if g is None:
+                g = groups[gkey] = {
+                    "pack": pack, "fld": ctx["fld"], "k": ctx["k"],
+                    "has_norms": gkey[3], "members": [], "st": None,
+                    "rows": {}, "cold": [],
+                }
+            # shard_docs captured NOW (the tiered-lane discipline): a
+            # mid-wave refresh must not swap the doc table under us
+            g["members"].append({
+                "i": i, "ctx": ctx, "shard_docs": idx.shard_docs[0],
+                "idx": idx, "scope": pack.lane_cache_scope(member),
+                "ckey": canonical_key({
+                    "op": "superpack_gather", "fld": ctx["fld"],
+                    "k": int(ctx["k"]),
+                    "q": [[t, float(b)] for t, b in ctx["terms"]]}),
+            })
+        for gkey, g in groups.items():
+            pack, fld, k = g["pack"], g["fld"], g["k"]
+            hits = misses = 0
+            for pos, m in enumerate(g["members"]):
+                got = rc.get(m["scope"][0], m["scope"][1], m["ckey"]) \
+                    if rc.enabled else None
+                if got is None:
+                    g["cold"].append(pos)
+                    misses += 1
+                else:
+                    g["rows"][pos] = got
+                    hits += 1
+            profile_event("cache", scope="superpack_gather", hits=hits,
+                          misses=misses)
+            if not g["cold"]:
+                continue
+            # host planning: each member plans against its OWN pack (the
+            # exact per-index weights/rows), padded to the group tier
+            plans = []
+            for pos in g["cold"]:
+                m = g["members"][pos]
+                ctx = m["ctx"]
+                member = ctx["member"]
+                from ..parallel.sharded import plan_adapter
+
+                bts = BatchTermSearcher(plan_adapter(member.ss, 0))
+                pl = bts.plan(fld, [ctx["terms"]], k)
+                avgdl = member.ss.sp.shard_view(0).avgdl(fld) \
+                    if hasattr(member.ss.sp, "shard_view") else 1.0
+                plans.append((pos, pl, member.lane, float(avgdl)))
+            Ts = max(pl.sparse_rows.shape[1] for _, pl, _, _ in plans)
+            B = max(pl.sparse_rows.shape[2] for _, pl, _, _ in plans)
+            Qc = len(plans)
+            Qt = BatchTermSearcher.wave_q_tier(Qc)
+            kk = min(max(k, 1), pack.n_pad)
+            rows = np.zeros((Qt, Ts, B), np.int32)
+            ws = np.zeros((Qt, Ts), np.float32)
+            tids = np.zeros((Qt,), np.int32)
+            avgdls = np.ones((Qt,), np.float32)
+            for qi, (_pos, pl, lane, avgdl) in enumerate(plans):
+                sr = pl.sparse_rows[0]
+                rows[qi, : sr.shape[0], : sr.shape[1]] = sr
+                sw = pl.sparse_weights[0]
+                ws[qi, : sw.shape[0]] = sw
+                tids[qi] = lane
+                avgdls[qi] = np.float32(max(avgdl, 1e-9))
+            fn = pack.program(Ts, B, kk, Qt, g["has_norms"])
+            sub = {key: pack.dev[key] for key in
+                   ("post_docids", "post_tfs", "post_dls", "live")}
+            fields = dict(tier="superpack", shards=1,
+                          tenants=len({lane for _, _, lane, _ in plans}),
+                          queries=Qt, k=kk, num_docs=pack.n_pad,
+                          rows=int(np.prod(rows.shape)))
+            prog_args = (sub, jnp.asarray(rows), jnp.asarray(ws),
+                         jnp.asarray(tids), jnp.asarray(avgdls))
+            from ..monitoring.xla_introspect import check_dispatch
+
+            check_dispatch("superpack.tenant_gather", fn, prog_args,
+                           fields=fields)
+            outs = fn(*prog_args)
+            g["st"] = {"pending": outs, "host": None,
+                       "kernel": "superpack.tenant_gather",
+                       "fields": fields, "Qc": Qc, "Qt": Qt, "kk": kk,
+                       "plans": [(pos, lane) for pos, _pl, lane, _a
+                                 in plans]}
+        job["groups"] = list(groups.values())
+        job["term_lanes"] = job["groups"]  # the service lane accounting
+        if any(g["st"] is not None for g in job["groups"]):
+            from ..telemetry import host_transition
+
+            host_transition("dispatch")
+            job["meta"]["transitions"]["dispatch"] += 1
+        return job
+
+    def search_wave_fetch(self, job: dict) -> None:
+        """ONE combined blocking device_get across every group program —
+        engine-state-free (completer thread), the same single-round-trip
+        contract as `EsIndex.search_wave_fetch`."""
+        pend = [g["st"] for g in job.get("groups", ())
+                if g["st"] is not None and g["st"].get("host") is None
+                and g["st"].get("pending") is not None]
+        if not pend:
+            return
+        from ..common import faults
+        from ..telemetry import host_transition, time_kernel
+
+        faults.check("device.fetch", index=self.name, op="wave")
+        fields = dict(tier="wave", shards=1,
+                      queries=sum(st["Qt"] for st in pend),
+                      k=max(st["kk"] for st in pend),
+                      num_docs=max(st["fields"]["num_docs"]
+                                   for st in pend))
+        with time_kernel("serving.wave_program", **fields):
+            host = jax.device_get([st["pending"] for st in pend])
+        for st, h in zip(pend, host):
+            st["host"] = h
+        host_transition("fetch")
+        job["meta"]["transitions"]["fetch"] += 1
+
+    def search_wave_finish(self, job: dict) -> list:
+        """Build per-entry responses (entry order) — byte-identical to
+        the per-index term lane's response building, including cache
+        stores for cold rows under each tenant's OWN scope."""
+        from ..cache import request_cache
+        from ..telemetry import record_search_slowlog
+
+        rc = request_cache()
+        for g in job.get("groups", ()):
+            members, k = g["members"], g["k"]
+            try:
+                st = g["st"]
+                if st is not None:
+                    if st.get("host") is None:
+                        from ..telemetry import time_kernel
+
+                        with time_kernel(st["kernel"], **st["fields"]):
+                            st["host"] = jax.device_get(st["pending"])
+                        job["meta"]["transitions"]["fetch"] += 1
+                    cv, ci, ct = (np.asarray(a) for a in st["host"])
+                    kk = st["kk"]
+                    for qi, (pos, _lane) in enumerate(st["plans"]):
+                        m = members[pos]
+                        row = (cv[qi].copy(),
+                               np.zeros((kk,), np.int32),
+                               ci[qi].copy(), int(ct[qi]))
+                        g["rows"][pos] = row
+                        if rc.enabled:
+                            tok, ep = m["scope"]
+                            rc.put(tok, ep, m["ckey"], row,
+                                   row[0].nbytes + row[1].nbytes
+                                   + row[2].nbytes + 96)
+                    job["meta"]["term_waves"].append(
+                        (st["Qc"], int(st["Qt"])))
+                job["meta"]["term_packed"] += len(members)
+                took_ms = (time.monotonic() - job["t0"]) * 1000
+                for pos, m in enumerate(members):
+                    i, ctx = m["i"], m["ctx"]
+                    rv, _rs, ri, rt = g["rows"][pos]
+                    nvalid = int(np.isfinite(rv).sum())
+                    take = list(range(min(nvalid, k)))[
+                        ctx["from_"]: ctx["size"] + ctx["from_"]]
+                    hits = []
+                    for j in take:
+                        doc_id, src = m["shard_docs"][int(ri[j])]
+                        hits.append({"_index": ctx["idx"].name,
+                                     "_id": doc_id,
+                                     "_score": float(rv[j]),
+                                     "_source": src})
+                    hits_obj = {
+                        "total": {"value": int(rt), "relation": "eq"},
+                        "max_score": (float(rv[0]) if nvalid else None),
+                        "hits": hits,
+                    }
+                    if ctx["tth"] is False:
+                        del hits_obj["total"]
+                    job["slots"][i] = ("resp", {"hits": hits_obj})
+                    idx = ctx["idx"]
+                    idx.counters["query_time_ms"] = (
+                        idx.counters.get("query_time_ms", 0)
+                        + int(took_ms))
+                    record_search_slowlog(
+                        idx.name, idx.settings, took_ms,
+                        str(ctx["terms"])[:512])
+            except Exception as ex:  # noqa: BLE001 - per-group envelope
+                for m in members:
+                    if job["slots"][m["i"]] is None:
+                        job["slots"][m["i"]] = ("error", ex)
+        out = []
+        for i, slot in enumerate(job["slots"]):
+            if slot is None:
+                slot = ("error", RuntimeError(
+                    "superpack wave lost an entry"))
+            kind, payload = slot
+            out.append(payload)
+        return out
+
+    # ---- solo oracle (tests / bench) ------------------------------------
+
+    def msearch(self, name: str, fld: str, queries: list, k: int = 10):
+        """Solo tenant-gather msearch for ONE member — the row-level
+        parity fixture against `parallel/sharded.msearch_sharded`.
+        -> (scores [Q, kk], shard zeros, doc [Q, kk], totals [Q])."""
+        from ..ops.batched import BatchTermSearcher
+        from ..parallel.sharded import plan_adapter
+        from ..telemetry import time_kernel
+
+        member = self.member_of(name)
+        if member is None:
+            raise KeyError(f"[{name}] is not a superpack member")
+        pack = self.packs[size_class_of(member.num_docs,
+                                        member.num_blocks)]
+        ss = member.ss
+        bts = BatchTermSearcher(plan_adapter(ss, 0))
+        pl = bts.plan(fld, queries, k)
+        Q = len(queries)
+        Ts, B = pl.sparse_rows.shape[1], pl.sparse_rows.shape[2]
+        Ts = max(Ts, 1)
+        B = max(B, 1)
+        kk = min(max(k, 1), pack.n_pad)
+        has_norms = fld in ss.ctx.has_norms
+        rows = np.zeros((Q, Ts, B), np.int32)
+        rows[:, : pl.sparse_rows.shape[1], : pl.sparse_rows.shape[2]] = \
+            pl.sparse_rows
+        ws = np.zeros((Q, Ts), np.float32)
+        ws[:, : pl.sparse_weights.shape[1]] = pl.sparse_weights
+        tids = np.full((Q,), member.lane, np.int32)
+        avgdl = float(ss.sp.shard_view(0).avgdl(fld))
+        avgdls = np.full((Q,), np.float32(max(avgdl, 1e-9)), np.float32)
+        fn = pack.program(Ts, B, kk, Q, has_norms)
+        sub = {key: pack.dev[key] for key in
+               ("post_docids", "post_tfs", "post_dls", "live")}
+        fields = dict(tier="superpack", shards=1, tenants=1, queries=Q,
+                      k=kk, num_docs=pack.n_pad,
+                      rows=int(np.prod(rows.shape)))
+        prog_args = (sub, jnp.asarray(rows), jnp.asarray(ws),
+                     jnp.asarray(tids), jnp.asarray(avgdls))
+        from ..monitoring.xla_introspect import check_dispatch
+
+        check_dispatch("superpack.tenant_gather", fn, prog_args,
+                       fields=fields)
+        with time_kernel("superpack.tenant_gather", **fields):
+            v, i, t = jax.device_get(fn(*prog_args))
+        return (np.asarray(v), np.zeros_like(np.asarray(i), np.int32),
+                np.asarray(i), np.asarray(t))
+
+    # ---- accounting ------------------------------------------------------
+
+    def compiled_program_count(self) -> int:
+        """Distinct compiled tenant-gather programs across every size
+        class — the number the C8 bench asserts is bounded by size-class
+        count (x the handful of batch tiers), NOT by tenant count."""
+        return sum(len(sp._programs) for sp in self.packs.values())
+
+    def member_count(self) -> int:
+        return sum(len(sp.lanes) for sp in self.packs.values())
+
+    def hbm_bytes(self) -> int:
+        return sum(sp.hbm_bytes() for sp in self.packs.values())
+
+    def padded_waste_bytes(self) -> int:
+        return sum(sp.padded_waste_bytes() for sp in self.packs.values())
+
+    def member_stats(self, name: str) -> dict | None:
+        """Per-index `_cat/indices` superpack annotation."""
+        for sp in self.packs.values():
+            m = sp.lanes.get(name)
+            if m is not None:
+                members = max(len(sp.lanes), 1)
+                return {
+                    "size_class": f"{sp.n_pad}x{sp.nb_pad}",
+                    "lane": m.lane,
+                    "hbm_bytes_per_tenant": sp.hbm_bytes() // members,
+                }
+        return None
+
+    def stats(self) -> dict:
+        """The `_nodes/stats` superpack section; also refreshes the
+        `es.superpack.members` / `es.superpack.waste_pct` gauges."""
+        from ..telemetry import metrics
+
+        classes = {f"{k[0]}x{k[1]}": sp.stats()
+                   for k, sp in sorted(self.packs.items())}
+        members = self.member_count()
+        hbm = self.hbm_bytes()
+        waste = self.padded_waste_bytes()
+        waste_pct = round(100.0 * waste / hbm, 3) if hbm else 0.0
+        out = {
+            "enabled": self.enabled(),
+            "members": members,
+            "size_classes": len(self.packs),
+            "compiled_programs": self.compiled_program_count(),
+            "hbm_bytes": hbm,
+            "hbm_bytes_per_tenant": (hbm // members) if members else 0,
+            "padded_waste_bytes": waste,
+            "padded_waste_pct": waste_pct,
+            "folds": self.counters.get("folds", 0),
+            "fold_failures": self.counters.get("fold_failures", 0),
+            "classes": classes,
+        }
+        metrics.gauge_set("es.superpack.members", members)
+        metrics.gauge_set("es.superpack.waste_pct", waste_pct)
+        return out

@@ -270,6 +270,91 @@ def ingest_growth(prev: dict, latest: dict, threshold: float) -> list:
     return moved
 
 
+def superpack_metrics(record: dict) -> dict:
+    """-> C8 tenant-superpack leaves (PR 17): compiled-program count,
+    QPS-per-tenant and HBM-bytes-per-tenant for BOTH dispatch modes,
+    padded waste, and the superpack/per-index QPS ratio. Tenant count
+    and size-class count are corpus shape, carried for the table but
+    never compared."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "tenant_superpack" and isinstance(v, dict):
+                    for kk in ("tenants", "size_classes",
+                               "compiled_programs", "qps_vs_per_index"):
+                        val = v.get(kk)
+                        if isinstance(val, (int, float)) \
+                                and not isinstance(val, bool):
+                            out[".".join(path + (k, kk))] = float(val)
+                    for mode in ("superpack", "per_index"):
+                        sec = v.get(mode)
+                        if not isinstance(sec, dict):
+                            continue
+                        for kk in ("qps_per_tenant",
+                                   "hbm_bytes_per_tenant",
+                                   "padded_waste_pct"):
+                            val = sec.get(kk)
+                            if isinstance(val, (int, float)) \
+                                    and not isinstance(val, bool):
+                                out[".".join(path + (k, mode, kk))] = \
+                                    float(val)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+_SUPERPACK_SHAPE = {"tenants", "size_classes"}
+_SUPERPACK_LOWER = {"compiled_programs", "hbm_bytes_per_tenant",
+                    "padded_waste_pct"}
+
+
+def superpack_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY (same convention as ingest_growth): C8 movement beyond
+    `threshold` — QPS-per-tenant or the on/off ratio down, or
+    compiled-program count / HBM-per-tenant / padded waste up — is
+    printed for the tier-1 log reader but never fails the lint. A
+    compiled-program count that grew is the loudest signal here: the
+    tentpole contract is O(size-classes), so growth means a new shape
+    tier leaked into the program cache."""
+    a, b = superpack_metrics(prev), superpack_metrics(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        leaf = path.rsplit(".", 1)[-1]
+        if leaf in _SUPERPACK_SHAPE:
+            continue
+        ratio = new / old
+        if leaf in _SUPERPACK_LOWER:
+            regressed = ratio > 1.0 + threshold
+        else:  # qps_per_tenant, qps_vs_per_index: higher is better
+            regressed = ratio < 1.0 - threshold
+        if regressed:
+            moved.append((path, old, new, ratio))
+    return moved
+
+
+def print_superpack_table(latest: dict, cur_round: int) -> None:
+    """Render the newest record's C8 advisory table (compiled programs,
+    QPS-per-tenant and HBM-per-tenant, both dispatch modes) whenever the
+    record carries a tenant_superpack arm."""
+    rows = superpack_metrics(latest)
+    if not rows:
+        return
+    print(f"[bench-regress] tenant-superpack table (r{cur_round:02d}; "
+          "per-tenant QPS/HBM, superpack vs per-index dispatch):")
+    for path in sorted(rows):
+        print(f"  {path:<64} {_fmt(rows[path]):>12}")
+
+
 def build_speedup_table(prev: dict, latest: dict) -> list:
     """PR 15: when BOTH records carry `build_profile` sections, the
     r(N-1)→rN comparison IS the device port's scorecard — render a
@@ -380,9 +465,17 @@ def main(argv=None) -> int:
               f"({ratio:.2f}x) — ingest docs/s or analyze cost moved "
               f"beyond {args.threshold:.0%}; check ES_TPU_ANALYZE mode "
               "and per-value oracle fallbacks before accepting")
+    for path, old, new, ratio in superpack_growth(
+            prev, latest, args.threshold):
+        print(f"  SUPERPACK (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x) — C8 per-tenant economics moved beyond "
+              f"{args.threshold:.0%}; a compiled-program count that grew "
+              "means a shape tier leaked past the size-class bound")
     # PR 15: the per-stage host-vs-device scorecard whenever both
     # records profiled their builds
     print_build_speedup(prev, latest, prev_round, cur_round)
+    # PR 17: the C8 per-tenant advisory table for the newest record
+    print_superpack_table(latest, cur_round)
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -317,6 +317,91 @@ def stage_d_write_path() -> dict:
         e.close()
 
 
+def stage_e_superpack() -> dict:
+    """Stage E (PR 17): tenant-superpack fold fault isolation. Eight
+    small tenants share superpack lanes; ONE tenant's refold eats a
+    seeded superpack.fold fault mid-install. Contract: the install is
+    atomic (every NEIGHBOR lane in the shared pack stays byte-identical
+    and keeps serving identical rows), the victim still serves correct
+    per-index results, the fault demonstrably fired, and a later clean
+    refold converges the victim back into its lane."""
+    import numpy as np
+
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.engine import Engine
+
+    prev_env = os.environ.get("ES_TPU_SUPERPACK")
+    os.environ["ES_TPU_SUPERPACK"] = "1"
+    e = Engine(None)
+    try:
+        names = [f"sp{i}" for i in range(8)]
+        for i, name in enumerate(names):
+            idx = e.create_index(name, {"properties": {
+                "body": {"type": "text"}}})
+            for j in range(6):
+                idx.index_doc(str(j),
+                              {"body": f"stormy w{(i + j) % 5} shared"})
+            idx.refresh()
+        mgr = e.superpacks
+        for name in names:
+            assert mgr.adopt(e.indices[name]), name
+        victim, neighbors = names[0], names[1:]
+        queries = [[("stormy", 1.0)], [("shared", 1.0)]]
+        rows_before = {n: [np.asarray(x).copy() for x in
+                           mgr.msearch(n, "body", queries, 5)]
+                       for n in neighbors}
+        snaps = {key: {k: v.copy() for k, v in pack.host.items()}
+                 for key, pack in mgr.packs.items()}
+
+        vic = e.indices[victim]
+        vic.index_doc("fresh", {"body": "stormy fresh"})
+        vic.refresh()
+        faults.configure(f"superpack.fold:once=1,match={victim}",
+                         seed=SEED)
+        try:
+            mgr.refold(victim)
+            raised = False
+        except faults.InjectedFault:
+            raised = True
+        st = faults.stats()
+        faults.clear()
+        assert raised, "the seeded superpack.fold fault never fired"
+        assert st["points"]["superpack.fold"]["fired"] == 1, st
+        # every neighbor lane is byte-identical through the faulted fold
+        for key, pack in mgr.packs.items():
+            for n in neighbors:
+                if n not in pack.lanes:
+                    continue
+                ln = pack.lanes[n].lane
+                for k, arr in pack.host.items():
+                    assert np.array_equal(snaps[key][k][ln], arr[ln]), \
+                        (key, k, n)
+        for n in neighbors:
+            now = mgr.msearch(n, "body", queries, 5)
+            for x, y in zip(rows_before[n], now):
+                assert np.array_equal(x, np.asarray(y)), \
+                    f"neighbor {n} rows diverged through the faulted fold"
+        # the victim still serves correct, fresh per-index results...
+        r = e.indices[victim].search(
+            query={"match": {"body": "fresh"}}, size=5)
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["fresh"], r
+        # ...and a clean refold converges it back into its lane
+        assert mgr.refold(victim)
+        _, _, _, t = mgr.msearch(victim, "body", [[("fresh", 1.0)]], 5)
+        assert int(np.asarray(t)[0]) == 1
+        return {"tenants": len(names),
+                "fold_faults_fired": st["points"]["superpack.fold"]["fired"],
+                "fold_failures": mgr.counters.get("fold_failures", 0),
+                "folds": mgr.counters.get("folds", 0)}
+    finally:
+        faults.clear()
+        e.close()
+        if prev_env is None:
+            os.environ.pop("ES_TPU_SUPERPACK", None)
+        else:
+            os.environ["ES_TPU_SUPERPACK"] = prev_env
+
+
 def main() -> int:
     print(f"[chaos] seed={SEED} requests={N_REQUESTS}")
     a = stage_a_cluster()
@@ -325,6 +410,8 @@ def main() -> int:
     print(f"[chaos] stage B (engine closed loop): {b}")
     d = stage_d_write_path()
     print(f"[chaos] stage D (writers + searchers + build fault): {d}")
+    ev = stage_e_superpack()
+    print(f"[chaos] stage E (superpack fold fault isolation): {ev}")
     print("[chaos] contract held: no hangs, no crashes, every response "
           "complete / valid-partial / clean 429-503")
     return 0

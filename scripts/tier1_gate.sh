@@ -16,7 +16,7 @@ SEED="${1:-${SEED:-$((RANDOM * 32768 + RANDOM))}}"
 COMMON=(-q -m 'not slow' --continue-on-collection-errors
         -p no:cacheprovider -p no:xdist)
 
-echo "[tier1-gate] pass 1/2: default order"
+echo "[tier1-gate] pass 1/3: default order"
 JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
     "${COMMON[@]}" -p no:randomly || exit 1
 
@@ -26,14 +26,33 @@ if python -c "import pytest_randomly" 2>/dev/null; then
     # in-repo shuffle's job (conftest exports the same pin), so pass 2
     # exercises the oracle under reordering instead of re-running an
     # identical pipeline twice.
-    echo "[tier1-gate] pass 2/2: pytest-randomly, seed=${SEED}," \
+    echo "[tier1-gate] pass 2/3: pytest-randomly, seed=${SEED}," \
          "ES_TPU_ANALYZE=host"
     ES_TPU_ANALYZE=host \
     JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
         "${COMMON[@]}" -p randomly --randomly-seed="${SEED}" || exit 1
 else
-    echo "[tier1-gate] pass 2/2: module-order shuffle (pytest-randomly" \
+    echo "[tier1-gate] pass 2/3: module-order shuffle (pytest-randomly" \
          "not installed), seed=${SEED}"
+    JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
+        "${COMMON[@]}" -p no:randomly --shuffle-modules "${SEED}" || exit 1
+fi
+
+# superpack shuffled pass (PR 17): the same shuffled order with tenant
+# superpacks FORCED ON, so serving-path tests exercise organic superpack
+# adoption + wave claims while asserting unchanged responses — byte
+# parity vs per-index dispatch is the contract, so the suite must not
+# be able to tell the lane apart.
+if python -c "import pytest_randomly" 2>/dev/null; then
+    echo "[tier1-gate] pass 3/3: shuffled, ES_TPU_SUPERPACK=1," \
+         "seed=${SEED}"
+    ES_TPU_SUPERPACK=1 \
+    JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
+        "${COMMON[@]}" -p randomly --randomly-seed="${SEED}" || exit 1
+else
+    echo "[tier1-gate] pass 3/3: module-order shuffle," \
+         "ES_TPU_SUPERPACK=1, seed=${SEED}"
+    ES_TPU_SUPERPACK=1 \
     JAX_PLATFORMS=cpu timeout -k 10 870 python -m pytest tests/ \
         "${COMMON[@]}" -p no:randomly --shuffle-modules "${SEED}" || exit 1
 fi

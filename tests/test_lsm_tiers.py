@@ -200,12 +200,16 @@ def test_background_merge_never_starves_search():
         # with the merge in the queue (generous CPU-smoke bound)
         p99 = sorted(lat)[int(len(lat) * 0.99) - 1]
         assert p99 < 30.0, f"search p99 {p99:.1f}s under merge load"
-        # no merge starvation: the fold completes under search load
+        # no merge starvation: the fold completes under search load.
+        # (<= 1, not == 1: with ES_TPU_SUPERPACK=1 the small index is a
+        # superpack fold candidate, and its organic adoption refold
+        # major-merges EVERY tail into the base — zero tails is the
+        # fold having run, the opposite of starvation)
         deadline = time.monotonic() + 60
-        while time.monotonic() < deadline and len(idx._tails) != 1:
+        while time.monotonic() < deadline and len(idx._tails) > 1:
             svc.submit(dict(entry), tenant="keepalive").result(timeout=60)
             time.sleep(0.01)
-        assert len(idx._tails) == 1, "merge starved by search load"
+        assert len(idx._tails) <= 1, "merge starved by search load"
         assert svc.counters["merges"] >= 1
         assert not idx._merge_inflight
         # post-merge: results still complete and correct

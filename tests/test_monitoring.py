@@ -114,7 +114,10 @@ _BUILD_STAGE_RE = re.compile(r'build_stage\(\s*\n?\s*"([^"]+)"')
 _DISPATCH_DIRS = ("ops", "parallel", "query", "ann", "engine", "index",
                   # PR 16: the batched analysis pipeline dispatches
                   # build.analyze from analysis/batched.py
-                  "analysis")
+                  "analysis",
+                  # PR 17: tenant superpacks dispatch
+                  # superpack.tenant_gather from tenancy/superpack.py
+                  "tenancy")
 _DISPATCH_REGEXES = (_TIME_KERNEL_RE, _KERNEL_FIELD_RE, _BUILD_STAGE_RE)
 
 
@@ -166,7 +169,9 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      "build.csr_assemble", "build.norms",
                      "build.ann_tiles", "build.device_put", "build.merge",
                      # PR 16: the batch-vectorized analyze dispatch
-                     "build.analyze"):
+                     "build.analyze",
+                     # PR 17: the tenant superpack gather dispatch
+                     "superpack.tenant_gather"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 
@@ -197,6 +202,9 @@ def test_cost_fns_resolve_on_representative_fields():
         "sparse.tail_scan": {"queries": 1, "num_docs": 2_000},
         # PR 16: analyze cost is bytes-based (text has no flop shape)
         "build.analyze": {"nbytes": 1 << 20},
+        # PR 17: tenant-gather over a size class's padded doc width
+        "superpack.tenant_gather": {"queries": 32, "num_docs": 1024,
+                                    "rows": 32 * 2 * 8},
     }
     for name, fields in reps.items():
         c = kernel_cost(name, fields)
