@@ -1992,6 +1992,206 @@ def config8_superpack(rng):
             os.environ["ES_TPU_SUPERPACK"] = env_prev
 
 
+def config9_planner(rng):
+    """C9 adaptive-planner mixed-trace arm (PR 18, ROADMAP item 4): one
+    interleaved C1 (match) + C4 (kNN) + C7 (write burst + refresh)
+    request trace is replayed under FOUR routings — the three static
+    arm pins (fused / impact / exact, via planner repricers, the
+    planner's model mode off) and the adaptive planner (model mode on,
+    efficiency EMAs warmed by the static passes' own `time_kernel`
+    observations). Each routing runs on a freshly built engine index
+    (identical corpus + trace), so the only variable is the routing.
+    Records per-routing QPS + p50/p99 and arm-decision counts, the
+    planner's decision-latency percentiles (the < 100 µs budget), and
+    the residual distribution (histogram pcts + per-kernel |residual|
+    EMA). The acceptance read: planner QPS >= every static routing
+    (equal-p99 basis) within the CPU-smoke noise floor."""
+    from elasticsearch_tpu.engine.engine import Engine
+    from elasticsearch_tpu.planner import execution_planner
+    from elasticsearch_tpu.telemetry import metrics as _metrics
+
+    smoke = bool(os.environ.get("ES_BENCH_SMOKE"))
+    n_docs = 2_000 if smoke else 50_000
+    dims = 16 if smoke else 64
+    n_ops = 48 if smoke else 400
+    n_warm = 6
+    prev_fused = os.environ.get("ES_TPU_FUSED")
+    prev_impact = os.environ.get("ES_TPU_IMPACT")
+    os.environ["ES_TPU_FUSED"] = "force"   # all three arms eligible on
+    os.environ["ES_TPU_IMPACT"] = "force"  # CPU (impact is auto=TPU-only)
+    pl = execution_planner()
+
+    log(f"[c9] building {n_docs}-doc mixed corpus (text + {dims}-d vectors)")
+    lens, tok = build_corpus(rng, n_docs=n_docs)
+    term_strs = np.array([f"t{i}" for i in range(VOCAB)])
+    doc_terms = term_strs[tok]
+    starts = np.concatenate([[0], np.cumsum(lens[:-1])])
+    vecs = rng.normal(size=(n_docs, dims)).astype(np.float32)
+    qs = sample_queries(rng, lens, tok, n_ops + n_warm, terms_per_query=3)
+    knn_qs = rng.normal(size=(n_ops + n_warm, dims)).astype(np.float32)
+
+    def _op_kind(i):
+        # 1-in-8 write burst (C7), 1-in-4 kNN (C4), the rest match (C1)
+        return ("write" if i % 8 == 7 else
+                "knn" if i % 4 == 2 else "match")
+
+    def _build():
+        from concurrent.futures import ThreadPoolExecutor
+
+        engine = Engine(None)
+        idx = engine.create_index("c9", {"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": dims,
+                    "similarity": "l2_norm",
+                    "index_options": {"type": "ivf", "nlist": 8}},
+        }})
+        for i in range(n_docs):
+            s, ln = starts[i], lens[i]
+            idx.index_doc(None, {
+                "body": " ".join(doc_terms[s:s + ln]),
+                "vec": [float(x) for x in vecs[i]]})
+        idx.refresh()
+        idx.searcher  # seal the base: the dense tier gates the fused arm
+        # the serving front end is the arm-routed dispatch path (waves
+        # run the executor msearch the planner sites live on); kNN and
+        # writes ride the same single engine thread (REST discipline)
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix="c9-engine")
+        svc = engine.serving
+        svc.bind_executor(pool.submit)
+        svc.set_enabled(True)
+        return engine, idx, svc, pool
+
+    def _do_op(engine, idx, svc, pool, i, routing):
+        kind = _op_kind(i)
+        if kind == "match":
+            body = {"query": {"match": {"body": " ".join(
+                t for t, _ in qs[i])}}, "size": TOP_K}
+            entry = svc.classify("c9", body, {})
+            assert entry is not None, "match stream must be wave-eligible"
+            r = svc.submit(entry, tenant="c9").result(timeout=600)
+            assert "hits" in r
+        elif kind == "knn":
+            r = pool.submit(
+                lambda: idx.search(knn={
+                    "field": "vec",
+                    "query_vector": [float(x) for x in knn_qs[i]],
+                    "k": TOP_K})).result(timeout=600)
+            assert "hits" in r
+        else:
+            def _burst():
+                ops = [("index", "c9", f"c9_{routing}_{i}_{j}",
+                        {"body": " ".join(
+                            f"t{int(x)}" for x in
+                            np.random.default_rng(i * 131 + j)
+                            .integers(0, VOCAB, 8))})
+                       for j in range(16)]
+                res = engine.bulk(ops)
+                assert not res["errors"], res
+                idx.refresh()
+                # fold the tail immediately (an aggressive merge
+                # policy): unfolded tails push every wave entry onto
+                # the tiered lane, which bypasses the arm-routed term
+                # lane this config exists to measure
+                idx.searcher
+            pool.submit(_burst).result(timeout=600)
+
+    pins = {"static_fused": (), "static_impact": ("fused",),
+            "static_exact": ("fused", "impact"), "planner": ()}
+
+    def _run(routing):
+        engine, idx, svc, pool = _build()
+        pl.configure(enabled=(routing == "planner"))
+        for a in pins[routing]:
+            pl.add_repricer(a, "bench-c9", lambda: True)
+        try:
+            for i in range(n_warm):  # compile warm, all op kinds
+                _do_op(engine, idx, svc, pool, n_ops + i, routing + "_w")
+            d0 = dict(pl.stats()["decisions"])
+            lat = []
+            t_all = time.perf_counter()
+            for i in range(n_ops):
+                t0 = time.perf_counter()
+                _do_op(engine, idx, svc, pool, i, routing)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            elapsed = time.perf_counter() - t_all
+        finally:
+            for a in pins[routing]:
+                pl.remove_repricer(a, "bench-c9")
+            svc.stop()
+            engine.close()
+            pool.shutdown(wait=True)
+        d1 = pl.stats()["decisions"]
+        decided = {a: d1.get(a, 0) - d0.get(a, 0)
+                   for a in ("fused", "impact", "exact")
+                   if d1.get(a, 0) - d0.get(a, 0)}
+        return {"qps": round(n_ops / elapsed, 1),
+                "latency": _hist_pcts(f"bench.c9.{routing}.ms", lat),
+                "decisions": decided}
+
+    try:
+        routings = {}
+        # static pins first: their time_kernel observations warm the
+        # efficiency EMAs the adaptive pass then prices arms with
+        for routing in ("static_fused", "static_impact", "static_exact",
+                        "planner"):
+            log(f"[c9] replaying trace under routing={routing}...")
+            routings[routing] = _run(routing)
+            log(f"[c9] {routing}: {routings[routing]}")
+    finally:
+        pl.configure(enabled=True)
+        for key, prev in (("ES_TPU_FUSED", prev_fused),
+                          ("ES_TPU_IMPACT", prev_impact)):
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+
+    snap = _metrics.snapshot()["histograms"]
+    dec_h = snap.get("es.planner.decision_us") or {}
+    res_h = snap.get("es.planner.residual") or {}
+    pst = pl.stats()
+    residual_kernels = {
+        k: {"abs_ema": st["residual_abs_ema"], "n": st["predictions"]}
+        for k, st in pst["kernels"].items() if "residual_abs_ema" in st}
+    planner_qps = routings["planner"]["qps"]
+    static_best = max(v["qps"] for k, v in routings.items()
+                      if k != "planner")
+    return {
+        "docs": n_docs,
+        "trace_ops": n_ops,
+        "op_mix": {"match": sum(_op_kind(i) == "match"
+                                for i in range(n_ops)),
+                   "knn": sum(_op_kind(i) == "knn"
+                              for i in range(n_ops)),
+                   "write_bursts": sum(_op_kind(i) == "write"
+                                       for i in range(n_ops))},
+        "routings": routings,
+        "planner_vs_best_static": round(
+            planner_qps / max(static_best, 1e-9), 4),
+        "planner_matches_or_beats": planner_qps >= static_best * 0.9,
+        "decision_us": {"p50": round(dec_h.get("p50", 0.0), 2),
+                        "p90": round(dec_h.get("p90", 0.0), 2),
+                        "p99": round(dec_h.get("p99", 0.0), 2),
+                        "n": dec_h.get("count", 0),
+                        "within_budget": dec_h.get("p50", 0.0) < 100.0},
+        "residual": {"p50": round(res_h.get("p50", 0.0), 4),
+                     "p90": round(res_h.get("p90", 0.0), 4),
+                     "n": res_h.get("count", 0),
+                     "kernels": residual_kernels},
+        "basis": "identical interleaved trace per routing on a freshly "
+                 "built in-memory engine index; static pins via planner "
+                 "repricers (model mode off), adaptive pass EMA-warm "
+                 "from the static passes' per-wave decision attribution "
+                 "(flight recorder -> observe_wall); ES_TPU_FUSED="
+                 "ES_TPU_IMPACT=force so all three arms stay eligible "
+                 "on CPU; write bursts fold tails immediately so waves "
+                 "stay on the arm-routed term lane; 10% noise tolerance "
+                 "on the matches-or-beats read (CPU smokes are "
+                 "host-bound — TPU is the criterion)",
+    }
+
+
 def preflight():
     """Compile every kernel geometry the bench will dispatch BEFORE any
     timed run (VERDICT r3 #8: round 3 lost a config mid-bench to an
@@ -2221,6 +2421,10 @@ def main():
 
     if _want("c8"):
         _guard("tenant_superpack", lambda: config8_superpack(rng))
+        gc.collect()
+
+    if _want("c9"):
+        _guard("planner_mixed_trace", lambda: config9_planner(rng))
         gc.collect()
 
     _write_record(extras, partial=False)

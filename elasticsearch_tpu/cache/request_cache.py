@@ -107,9 +107,18 @@ class ShardRequestCache:
         record_cache_event("hit" if got is not None else "miss")
         return got
 
-    def put(self, token, epoch, ckey, value, nbytes: int) -> bool:
+    def put(self, token, epoch, ckey, value, nbytes: int,
+            recompute_ms: float | None = None) -> bool:
         if not self.enabled:
             return False
+        if recompute_ms is not None:
+            # PR 18: cost-aware admission — entries whose predicted
+            # recompute cost is below the planner floor aren't worth a
+            # cache slot (floor 0 admits everything, today's behavior)
+            from ..planner import execution_planner
+
+            if not execution_planner().admit_cache(recompute_ms):
+                return False
         ok = self.lru.put(self._key(token, epoch, ckey), value, nbytes)
         if ok:
             from ..telemetry import record_cache_event

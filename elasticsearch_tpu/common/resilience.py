@@ -357,6 +357,14 @@ class DeviceDegradation:
         self._timer: threading.Timer | None = None
         self._target_wave: int | None = None
         self.events: deque = deque(maxlen=32)
+        # PR 18: while degraded, the fused arm is REPRICED to ∞ in the
+        # execution planner — routing shifts off it through ordinary
+        # candidate filtering (and back, the moment the ramp completes)
+        # instead of the PR-14 env-var pins
+        from ..planner import execution_planner
+
+        execution_planner().add_repricer(
+            "fused", self, lambda: self.degraded)
 
     # -- stage 1: evict recoverable state ---------------------------------
 
@@ -466,6 +474,9 @@ class DeviceDegradation:
                     "recent_events": list(self.events)[-8:]}
 
     def close(self):
+        from ..planner import execution_planner
+
+        execution_planner().remove_repricer("fused", self)
         with self._lock:
             if self._timer is not None:
                 self._timer.cancel()
@@ -474,27 +485,21 @@ class DeviceDegradation:
 
 def run_with_device_recovery(engine, fn, where: str):
     """Stage-3 wrapper for a device dispatch/fetch site: a device OOM
-    triggers the staged degradation, then the program re-runs ONCE on
-    the exact/XLA arm (fused Pallas + impact tiers pinned off for the
-    retry — their scratch appetite is what usually OOMs; the exact arm
-    is the smallest-footprint plan that returns correct results). Any
-    other exception propagates untouched."""
+    triggers the staged degradation, then the program re-runs ONCE with
+    the fused Pallas + impact arms REPRICED to ∞ in the execution
+    planner (PR 18) — their scratch appetite is what usually OOMs, and
+    repricing routes the retry onto the exact/XLA arm (the smallest-
+    footprint plan that returns correct results) through ordinary
+    candidate filtering instead of env-var pins. Any other exception
+    propagates untouched."""
     try:
         return fn()
     except Exception as ex:  # noqa: BLE001 - OOM-classified below
         if not is_device_oom(ex):
             raise
         engine.device_degradation.on_oom(ex, where)
-        snap = {k: os.environ.get(k) for k in
-                ("ES_TPU_FUSED", "ES_TPU_FUSED_TOPK", "ES_TPU_IMPACT")}
-        os.environ["ES_TPU_FUSED"] = "0"
-        os.environ["ES_TPU_FUSED_TOPK"] = "0"
-        os.environ["ES_TPU_IMPACT"] = "0"
-        try:
+        from ..planner import execution_planner
+
+        with execution_planner().reprice(
+                ("fused", "impact"), reason=f"device_oom:{where}"):
             return fn()
-        finally:
-            for k, v in snap.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v

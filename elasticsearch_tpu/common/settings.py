@@ -268,7 +268,23 @@ def default_cluster_settings() -> list[Setting]:
         # vectorized-ingest invariant; 0 disables like the other floors
         Setting("slo.write.analyze_fraction", 0.0, Setting.float_,
                 dynamic=True),
+        # PR 18: ceiling on the execution planner's worst per-kernel
+        # |predicted-vs-actual| residual EMA — a drifting cost model is
+        # an SLO breach, not a silent misrouter. 0 disables.
+        Setting("slo.planner.residual", 0.0, Setting.float_, dynamic=True),
         Setting("slo.custom", "", str, dynamic=True),
+        # adaptive execution planner (PR 18, planner/): cost-model-driven
+        # arm selection — predicted wall = analytic cost / measured
+        # achieved-roofline EMA, argmin wins; cold EMAs fall back to the
+        # static priority routing byte-for-byte. knn.target_ms > 0 lets
+        # the planner RAISE nprobe to the largest value meeting the
+        # latency target; cache.min_recompute_us > 0 rejects request-
+        # cache entries cheaper to recompute than the floor.
+        Setting("planner.enabled", True, Setting.bool_, dynamic=True),
+        Setting("planner.ema.alpha", 0.2, Setting.float_, dynamic=True),
+        Setting("planner.knn.target_ms", 0.0, Setting.float_, dynamic=True),
+        Setting("planner.cache.min_recompute_us", 0.0, Setting.float_,
+                dynamic=True),
         # continuous-batching serving front end (serving/): admission,
         # coalescing into device waves, deadline/fairness scheduling,
         # backpressure. queue.max_depth is the analog of the reference's

@@ -2220,6 +2220,26 @@ class Engine:
                 key, lambda v, a=attr: getattr(self.serving, a)(v))
         if self.settings.get("serving.enabled"):
             self.serving.set_enabled(True)
+        # adaptive execution planner (PR 18, planner/): push the dynamic
+        # knobs into the process-wide planner singleton — the dispatch
+        # sites consult it on every arm choice, so a settings update
+        # takes effect on the next wave
+        from ..planner import execution_planner
+
+        def _planner_settings(_v=None):
+            execution_planner().configure(
+                enabled=bool(self.settings.get("planner.enabled")),
+                alpha=float(self.settings.get("planner.ema.alpha")),
+                knn_target_ms=float(
+                    self.settings.get("planner.knn.target_ms")),
+                cache_min_recompute_us=float(
+                    self.settings.get("planner.cache.min_recompute_us")))
+
+        for key in ("planner.enabled", "planner.ema.alpha",
+                    "planner.knn.target_ms",
+                    "planner.cache.min_recompute_us"):
+            self.settings.add_consumer(key, _planner_settings)
+        _planner_settings()
         # scheduled watcher (xpack/watcher.py): a persisted watcher-driver
         # task resumes its ticker at boot, so watches keep firing after a
         # node restart without any request touching the watcher surface

@@ -141,6 +141,27 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
     # failures degrade to empty sections — collection must never stop.
     slo_doc = {}
     health_doc = {}
+    # adaptive execution planner (PR 18): decision/mode counts, knob
+    # adjustments, and the worst-predicted kernel's |residual| EMA land
+    # in the TSDB so cost-model drift is queryable history. Bounded
+    # leaves; failures degrade to an empty section.
+    planner_doc = {}
+    try:
+        from ..planner import execution_planner
+
+        pst = execution_planner().stats()
+        planner_doc = {
+            "enabled": 1 if pst.get("enabled") else 0,
+            "decisions": dict(pst.get("decisions") or {}),
+            "decision_modes": dict(pst.get("decision_modes") or {}),
+            "knobs": dict(pst.get("knobs") or {}),
+            "repriced": ",".join(pst.get("repriced") or ()),
+            "worst_kernel": pst.get("worst_kernel") or "",
+            "worst_abs_residual_ema":
+                pst.get("worst_abs_residual_ema") or 0.0,
+        }
+    except Exception:  # noqa: BLE001
+        pass
     try:
         ev = engine.slo.evaluate()
         slo_doc = {
@@ -229,6 +250,7 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                 "host_transitions_fetch": sv_st.get(
                     "host_transitions_total", {}).get("fetch", 0),
             },
+            "planner": planner_doc,
         },
     }
 

@@ -92,6 +92,7 @@ class SloEngine:
             objectives.extend(self._breaker_objectives())
             objectives.extend(self._hbm_objectives())
             objectives.extend(self._write_objectives())
+            objectives.extend(self._planner_objectives())
             objectives.extend(self._custom_objectives(snap))
         breached = [o["id"] for o in objectives if o["status"] == "breached"]
         out = {
@@ -292,6 +293,27 @@ class SloEngine:
                 None if measured is None else measured > analyze_max,
                 "max"))
         return out
+
+    def _planner_objectives(self) -> list[dict]:
+        """Planner residual ceiling (PR 18): the execution planner's
+        routing is only as good as its cost model, so the worst
+        per-kernel |predicted-vs-actual| residual EMA is a standing
+        objective — drift past the ceiling names the misfitted kernel
+        in the breach instead of silently misrouting waves."""
+        ceiling = float(self._get("slo.planner.residual", 0) or 0)
+        if ceiling <= 0:
+            return []
+        from ..planner import execution_planner
+
+        worst, worst_val = execution_planner().worst_kernel()
+        measured = round(worst_val, 4) if worst_val is not None else None
+        return [_objective(
+            "planner-residual", "planner",
+            f"execution-planner |residual| EMA <= {ceiling:g} "
+            + (f"(worst kernel [{worst}])" if worst
+               else "(no observed dispatches yet)"),
+            measured, ceiling,
+            None if measured is None else measured > ceiling, "max")]
 
     def _custom_objectives(self, snap) -> list[dict]:
         raw = str(self._get("slo.custom", "") or "").strip()

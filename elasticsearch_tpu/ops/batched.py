@@ -918,9 +918,30 @@ class BatchTermSearcher:
 
         Missing-hit columns carry -inf scores (when fewer than k docs
         match, and when k was clamped to the doc count)."""
+        arm = "exact"
         if fast:
+            # PR 18: eligible arms (same gates as before — fused needs a
+            # usable FusedTermSearcher, impact a servable impact tier)
+            # route through the execution planner: static priority
+            # fused > impact > fast while cold, argmin of predicted
+            # walls once the kernel EMAs are warm
+            from ..planner import execution_planner
+
             fs = self._fused_searcher(k)
+            n_docs = self.searcher.pack.num_docs
+            cands = []
             if fs is not None:
+                cands.append(("fused", "fused.pallas_scan",
+                              {"k": k, **fs._cost_fields(len(queries))}))
+            if self.impact_usable():
+                cands.append(("impact", "sparse.impact_sum",
+                              {"queries": len(queries), "k": k,
+                               "num_docs": n_docs}))
+            cands.append(("exact", "batched.disjunction",
+                          {"queries": len(queries), "k": k,
+                           "num_docs": n_docs}))
+            arm = execution_planner().choose_arm("batched.msearch", cands)
+            if arm == "fused":
                 from ..telemetry import profile_event, time_kernel
 
                 profile_event("tier", tier="fused", queries=len(queries))
@@ -928,7 +949,7 @@ class BatchTermSearcher:
                                  queries=len(queries), k=k):
                     return fs.msearch(fld, queries, k)
         Q = len(queries)
-        use_impact = fast and self.impact_usable()
+        use_impact = arm == "impact"
         scores = np.full((Q, k), -np.inf, np.float32)
         ids = np.zeros((Q, k), np.int64)
         totals = np.zeros((Q,), np.int64)

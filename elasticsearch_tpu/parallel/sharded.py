@@ -1117,11 +1117,11 @@ class StackedSearcher:
         _t0 = _time.perf_counter()
         res = self._search_uncached(query, size, from_, aggs, mappings,
                                     prune_floor)
-        _metrics.histogram_record(
-            "es.shard.search.ms", (_time.perf_counter() - _t0) * 1000)
+        _elapsed_ms = (_time.perf_counter() - _t0) * 1000
+        _metrics.histogram_record("es.shard.search.ms", _elapsed_ms)
         if ck is not None:
             rc.put(scope[0], scope[1], ck, _copy_stacked_result(res),
-                   _stacked_result_nbytes(res))
+                   _stacked_result_nbytes(res), recompute_ms=_elapsed_ms)
         return res
 
     def _request_cache_key(self, query, size, from_, aggs, prune_floor):
@@ -1773,10 +1773,27 @@ def _msearch_sharded_partials(ss: "StackedSearcher", fld: str,
     from whichever arm serves this searcher: the fused pipeline (with
     per-shard escalation), the impact-tier gather+sum, or the legacy
     exact kernel."""
+    from ..planner import execution_planner
+
     fs = _fused_sharded_for(ss)
-    if fs is not None and fs.usable(k):
-        return fs.msearch_partials(fld, queries, k)
+    fused_ok = fs is not None and fs.usable(k)
+    S, Q, n_max = ss.sp.S, len(queries), ss.sp.n_max
+    cands = []
+    if fused_ok:
+        cands.append(("fused", "sharded.fused_pipeline",
+                      {"shards": S, "queries": Q, "k": k,
+                       "v": ss.sp.dense_v, "num_docs": S * fs.n_pad}))
     if _impact_sharded_usable(ss):
+        cands.append(("impact", "sharded.impact_disjunction",
+                      {"shards": S, "queries": Q, "k": k,
+                       "num_docs": S * n_max}))
+    cands.append(("exact", "sharded.exact_disjunction",
+                  {"tier": "exact", "shards": S, "queries": Q, "k": k,
+                   "num_docs": S * n_max}))
+    arm = execution_planner().choose_arm("sharded.msearch_partials", cands)
+    if arm == "fused":
+        return fs.msearch_partials(fld, queries, k)
+    if arm == "impact":
         out = _msearch_impact_partials(ss, fld, queries, k)
         if out is not None:
             return out
@@ -1835,13 +1852,28 @@ def _merged_cached_finish(st: dict):
     if st["merged"] is not None:
         cv, csh, ci, ct = _msearch_merged_finish(st["merged"])
         rc = request_cache()
+        recompute_ms = None
+        if st["qkeys"] is not None and rc.enabled and cold:
+            # PR 18: admission hint — the planner's predicted wall for
+            # re-running this merged wave, amortized per cold row (None
+            # while the kernel EMA is cold: admit, today's behavior)
+            from ..planner import execution_planner
+
+            ss = st["ss"]
+            total = execution_planner().predict_ms(
+                "sharded.allgather_topk",
+                {"tier": "exact", "shards": ss.sp.S, "queries": len(cold),
+                 "k": st["k"], "num_docs": ss.sp.S * ss.sp.n_max})
+            if total is not None:
+                recompute_ms = total / len(cold)
         for j, qi in enumerate(cold):
             row = (cv[j].copy(), csh[j].copy(), ci[j].copy(), int(ct[j]))
             rows[qi] = row
             if st["qkeys"] is not None and rc.enabled:
                 tok, ep = st["scope"]
                 rc.put(tok, ep, st["qkeys"][qi], row,
-                       row[0].nbytes + row[1].nbytes + row[2].nbytes + 96)
+                       row[0].nbytes + row[1].nbytes + row[2].nbytes + 96,
+                       recompute_ms=recompute_ms)
     Q = len(st["queries"])
     width = max((r[0].shape[0] for r in rows.values()), default=st["k"])
     V = np.full((Q, width), -np.inf, np.float32)
@@ -2088,11 +2120,40 @@ def _msearch_merged_begin(ss: "StackedSearcher", fld: str, queries: list,
 
     -> a state dict for `_msearch_merged_fetch` / `_msearch_merged_finish`
     (or the (fn, args, kk) program triple under _return_program)."""
+    arm = "exact"
     if not _return_program:
+        # PR 18: the one-program route's arms (same eligibility gates)
+        # arbitrated by the execution planner; cold = today's static
+        # priority, warm = argmin of the predicted walls
+        from ..planner import execution_planner
+
         fs = _fused_sharded_for(ss)
-        if fs is not None and fs.usable(k):
+        fused_ok = fs is not None and fs.usable(k)
+        impact_ok = _impact_sharded_usable(ss)
+        S, Q, n_max = ss.sp.S, len(queries), ss.sp.n_max
+        cands = []
+        if fused_ok:
+            cands.append(("fused", "sharded.fused_allgather_topk",
+                          {"shards": S, "queries": Q, "k": k,
+                           "v": ss.sp.dense_v,
+                           "num_docs": S * fs.n_pad}))
+        if impact_ok:
+            code_b = (int(np.dtype(ss.dev["impact_codes"].dtype).itemsize)
+                      if "impact_codes" in ss.dev else 2)
+            cands.append(("impact", "sharded.allgather_topk",
+                          {"tier": "impact", "shards": S, "queries": Q,
+                           "k": k, "num_docs": S * n_max,
+                           "code_bytes": code_b}))
+        cands.append(("exact", "sharded.allgather_topk",
+                      {"tier": "exact", "shards": S, "queries": Q,
+                       "k": k, "num_docs": S * n_max}))
+        arm = execution_planner().choose_arm(
+            "sharded.msearch_merged", cands)
+        if arm == "fused":
             return fs.msearch_merged_begin(fld, queries, k)
-    if _impact_sharded_usable(ss):
+    elif _impact_sharded_usable(ss):
+        arm = "impact"
+    if arm == "impact":
         out = _msearch_merged_arm_begin(ss, fld, queries, k, impact=True,
                                         _return_program=_return_program)
         if out is not None:

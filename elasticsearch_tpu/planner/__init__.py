@@ -1,0 +1,16 @@
+"""Adaptive execution planner (PR 18): cost-model-driven arm selection.
+
+The closed loop over everything the runtime already measures: predicted
+wall time per eligible arm = analytic cost (monitoring/costmodel) ÷ that
+kernel's MEASURED achieved-roofline EMA (fed by every `time_kernel`
+observation), argmin wins, and the predicted-vs-actual residual comes
+back as a drift gauge — mispredictions are observable, the PR-12
+discipline. See planner/core.py for the subsystem.
+"""
+
+from .core import (  # noqa: F401
+    ARM_SITES,
+    ExecutionPlanner,
+    execution_planner,
+    reset_for_tests,
+)

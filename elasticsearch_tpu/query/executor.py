@@ -247,13 +247,18 @@ class ShardSearcher:
         profile_event("cache", scope="msearch", shard=0,
                       hits=len(queries) - len(cold), misses=len(cold))
         if cold:
+            _t0 = time.perf_counter()
             cv, ci, ct, cex = self.batched().msearch(
                 fld, [queries[qi] for qi in cold], k, **kw)
+            # amortize the measured wave wall over the cold rows — the
+            # per-entry recompute cost the planner's admission floor sees
+            _row_ms = (time.perf_counter() - _t0) * 1000 / len(cold)
             for j, qi in enumerate(cold):
                 row = (cv[j].copy(), ci[j].copy(), int(ct[j]), bool(cex[j]))
                 rows[qi] = row
                 rc.put(tok, epoch, qkeys[qi], row,
-                       row[0].nbytes + row[1].nbytes + 96)
+                       row[0].nbytes + row[1].nbytes + 96,
+                       recompute_ms=_row_ms)
         Q = len(queries)
         width = max(r[0].shape[0] for r in rows.values())
         scores = np.full((Q, width), -np.inf, np.float32)
@@ -306,11 +311,11 @@ class ShardSearcher:
 
         _t0 = time.perf_counter()
         res = self._search_uncached(query, size, from_, mappings, aggs)
-        _metrics.histogram_record(
-            "es.shard.search.ms", (time.perf_counter() - _t0) * 1000)
+        _elapsed_ms = (time.perf_counter() - _t0) * 1000
+        _metrics.histogram_record("es.shard.search.ms", _elapsed_ms)
         if ck is not None:
             rc.put(scope[0], scope[1], ck, _copy_shard_result(res),
-                   _shard_result_nbytes(res))
+                   _shard_result_nbytes(res), recompute_ms=_elapsed_ms)
         return res
 
     def _plan_request(self, query, size, from_, mappings, aggs):

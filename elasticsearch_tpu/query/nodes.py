@@ -422,6 +422,18 @@ class KnnNode(QueryNode):
             nprobe = self.nprobe or default_nprobe(
                 C, L, self._kk * oversample)
             nprobe = max(1, min(int(nprobe), C))
+            if not self.nprobe:
+                # PR 18: with planner.knn.target_ms set (and the scan
+                # kernel's efficiency EMA warm), trade the coverage
+                # heuristic for the LARGEST probe count whose predicted
+                # gather-scan wall meets the latency target — recall
+                # buys latency headroom instead of leaving it idle. An
+                # explicit per-request nprobe is always respected.
+                from ..planner import execution_planner
+
+                nprobe = execution_planner().advise_nprobe(
+                    nprobe, C, {"queries": 1, "dims": int(vc.dims),
+                                "tile": L, "scan_tier": vc.ann_quant})
             kcand = min(nprobe * L, max(self._kk * oversample, self._kk))
             self._ann = (nprobe, kcand, vc.ann_quant)
             from ..telemetry import profile_event

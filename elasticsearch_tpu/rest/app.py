@@ -2561,6 +2561,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         from ..cache import request_cache
         from ..common import resilience as _resilience
         from ..monitoring import device as _mon_device
+        from ..planner import execution_planner as _execution_planner
         from ..telemetry import TRACER, metrics, recent_slowlogs
 
         devices = [str(d) for d in jax.devices()]
@@ -2623,6 +2624,12 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                                 if engine._device_degradation is not None
                                 else {"degraded": False}),
                         },
+                        # adaptive execution planner (PR 18): per-arm
+                        # decision counts and modes (model / static /
+                        # repriced), per-kernel efficiency EMAs +
+                        # predicted-vs-actual residuals, knob adjustment
+                        # counters, currently repriced arms
+                        "planner": _execution_planner().stats(),
                         # write-path ground truth (PR 13): refresh/merge
                         # counts, cumulative build-stage millis, current
                         # tail-tier fraction, refresh lag, docs/s EMA
@@ -2857,6 +2864,39 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     "samples": by}
         except Exception:  # noqa: BLE001 - the scrape must not 500
             labeled = labeled or {}
+        # adaptive-planner families (PR 18): decision counts by arm and
+        # the predicted-vs-actual |residual| EMA by kernel — the scrape
+        # shows WHERE waves are routed and how well the model that
+        # routed them tracks reality
+        try:
+            from ..planner import execution_planner
+
+            pst = execution_planner().stats()
+            extra["es.planner.enabled"] = 1 if pst.get("enabled") else 0
+            if pst.get("worst_abs_residual_ema") is not None:
+                extra["es.planner.worst_abs_residual_ema"] = \
+                    pst["worst_abs_residual_ema"]
+            if pst.get("decisions"):
+                labeled["es_planner_decisions_total"] = {
+                    "kind": "counter",
+                    "help": "execution-planner arm decisions by arm "
+                            "(cost-model argmin routing; cold EMAs fall "
+                            "back to the static priority)",
+                    "samples": [({"arm": a}, n) for a, n in
+                                sorted(pst["decisions"].items())],
+                }
+            res = [({"kernel": k}, kst["residual_abs_ema"])
+                   for k, kst in sorted(pst.get("kernels", {}).items())
+                   if "residual_abs_ema" in kst]
+            if res:
+                labeled["es_planner_residual"] = {
+                    "kind": "gauge",
+                    "help": "execution-planner |predicted-vs-actual| "
+                            "wall residual EMA per kernel (drift in the "
+                            "cost model the routing trusts)",
+                    "samples": res}
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
         return web.Response(
             text=metrics.prometheus_text(extra, labeled=labeled),
             content_type="text/plain", charset="utf-8",

@@ -144,6 +144,11 @@ class ServingService:
         self._disp_sum = 0
         self._fetch_sum = 0
         self._wave_ms_ema: float | None = None
+        # PR 18: inter-arrival EMA — with the drain EMA above, the two
+        # inputs the execution planner's wave-close advisory needs to
+        # size a wave to the arrivals one drain period can deliver
+        self._arrival_rate_ema: float | None = None
+        self._last_arrival: float | None = None
         # flight recorder (PR 12): bounded ring of per-wave records —
         # segment timings (admission→claim→dispatch→device→complete),
         # tenant/lane mix, per-kernel utilization deltas, cache traffic,
@@ -273,6 +278,12 @@ class ServingService:
             with self._cv:
                 self._tenants.push(ps)
                 self.counters["admitted"] += 1
+                if self._last_arrival is not None:
+                    inst = 1.0 / max(now - self._last_arrival, 1e-6)
+                    self._arrival_rate_ema = (
+                        inst if self._arrival_rate_ema is None
+                        else 0.8 * self._arrival_rate_ema + 0.2 * inst)
+                self._last_arrival = now
                 metrics.gauge_set("es.serving.queue_depth",
                                   self._tenants.depth)
                 self._cv.notify_all()
@@ -368,7 +379,10 @@ class ServingService:
         batching: an idle pipeline dispatches whatever is queued at once
         (a lone request never waits), a busy one accumulates until the
         wave is full or the oldest entry has waited max_wait."""
+        from ..planner import execution_planner
+
         deadline = None
+        eff_wave = self.max_wave
         while not self._stop:
             with self._cv:
                 depth = self._tenants.depth
@@ -376,18 +390,25 @@ class ServingService:
                     deadline = None
                     self._cv.wait(0.05)
                     continue
-                if depth >= self.max_wave:
+                # PR 18: the planner sizes the wave to depth + expected
+                # arrivals during one measured drain period, and shrinks
+                # the coalesce window to the time those arrivals need
+                # (cold EMAs -> the configured values, unchanged)
+                eff_wave, eff_wait = execution_planner().advise_wave_close(
+                    self.max_wave, self.max_wait_s, depth,
+                    self._wave_ms_ema, self._arrival_rate_ema)
+                if depth >= eff_wave:
                     break
                 if self._inflight_count == 0:
                     break  # pipeline idle: dispatch promptly
                 if deadline is None:
-                    deadline = time.monotonic() + self.max_wait_s
+                    deadline = time.monotonic() + eff_wait
                 if time.monotonic() >= deadline:
                     break
-                self._cv.wait(max(min(self.max_wait_s, 0.005), 0.0005))
+                self._cv.wait(max(min(eff_wait, 0.005), 0.0005))
         if self._stop:
             return []
-        return self._tenants.pop_wave(self.max_wave)
+        return self._tenants.pop_wave(eff_wave)
 
     def _scheduler_loop(self):
         from ..telemetry import metrics
@@ -730,9 +751,23 @@ class ServingService:
             kernels: dict = {}
             cache = {"hits": 0, "misses": 0}
             escalations = 0
+            decisions: list = []
             for e in state.get("events", ()):
                 kind = e.get("kind")
-                if kind == "kernel":
+                if kind == "planner":
+                    # PR 18: per-wave decision attribution — which arms
+                    # competed, what the planner predicted for each, and
+                    # (below, once kernels are aggregated) what the chosen
+                    # arm actually cost
+                    decisions.append({
+                        "site": e.get("site"), "arm": e.get("arm"),
+                        "mode": e.get("mode"),
+                        "kernel": e.get("priced_kernel"),
+                        "fields": dict(e.get("fields") or {}),
+                        "predicted_ms": dict(e.get("predicted_ms") or {}),
+                        "decision_us": e.get("decision_us"),
+                    })
+                elif kind == "kernel":
                     u = kernels.setdefault(e["kernel"], {
                         "calls": 0, "ms": 0.0, "flops": 0.0, "bytes": 0.0,
                         "ici_bytes": 0.0})
@@ -760,6 +795,32 @@ class ServingService:
                 else:
                     u.pop("ici_bytes")
                 u["ms"] = round(u["ms"], 4)
+            wave_prog = kernels.get("serving.wave_program")
+            for d in decisions:
+                u = kernels.get(d.get("kernel"))
+                if not (u and u.get("calls")) and len(decisions) == 1 \
+                        and wave_prog and wave_prog.get("calls"):
+                    # wave route: the routed arm's own timer folded into
+                    # the ONE combined fetch — with a single decision in
+                    # the wave the attribution is unambiguous, so the
+                    # wave program's wall IS the arm's wall
+                    u = wave_prog
+                fields = d.pop("fields", None)
+                if u and u.get("calls"):
+                    actual = u["ms"] / u["calls"]
+                    d["actual_ms"] = round(actual, 4)
+                    pred = d["predicted_ms"].get(d["arm"])
+                    if pred:
+                        d["residual"] = round((actual - pred) / pred, 4)
+                    if fields:
+                        # feed the efficiency EMA the solo paths feed
+                        # through time_kernel directly: serving traffic
+                        # is what the planner mostly routes, so it must
+                        # also be what warms the model
+                        from ..planner import execution_planner
+
+                        execution_planner().observe_wall(
+                            d["kernel"], fields, actual / 1e3)
             with self._lock:
                 self._wave_seq += 1
                 rec = {
@@ -783,6 +844,7 @@ class ServingService:
                     "kernels": kernels,
                     "cache": cache,
                     "escalations": escalations,
+                    "decisions": decisions,
                 }
                 self._flight.append(rec)
         except Exception:  # noqa: BLE001 - recorder must never fail a wave
@@ -855,6 +917,8 @@ class ServingService:
                     "avg_term_occupancy": (self._occ_sum / self._occ_n
                                            if self._occ_n else None),
                     "service_ms_ema": self._wave_ms_ema,
+                    # PR 18: the wave-close advisory's second input
+                    "arrival_rate_ema": self._arrival_rate_ema,
                     # ≤1 dispatch + ≤1 fetch per wave is the PR-11
                     # contract; extras mean escalations/two-pass aggs
                     "host_transitions_per_wave": {

@@ -391,6 +391,15 @@ def time_kernel(name: str, **fields):
         except Exception:  # noqa: BLE001 - accounting never fails a search
             util = None
         if util is not None:
+            try:
+                # PR 18: feed the execution planner's achieved-roofline
+                # EMA + predicted-vs-actual residual from the SAME
+                # utilization record (pre-augmented fields)
+                from .planner import execution_planner
+
+                execution_planner().observe(name, fields, sec, util)
+            except Exception:  # noqa: BLE001 - advice never fails a search
+                pass
             metrics.counter_inc(f"es.kernel.{name}.flops", util["flops"])
             metrics.counter_inc(f"es.kernel.{name}.bytes", util["bytes"])
             metrics.histogram_record(f"es.kernel.{name}.mfu_pct",

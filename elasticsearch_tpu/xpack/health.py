@@ -360,6 +360,80 @@ def _resilience_indicator(engine) -> dict:
             "details": details}
 
 
+def _planner_indicator(engine) -> dict:
+    """Adaptive execution planner (PR 18): GREEN while the cost model
+    tracks reality (or while cold — cold is static-priority parity, not
+    a fault). YELLOW when arms are repriced (routing is deliberately
+    shifted off them) or when the worst per-kernel |residual| EMA
+    breaches the slo.planner.residual ceiling — the indicator NAMES the
+    worst-predicted kernel so the misfitted cost curve is one lookup
+    away."""
+    from ..planner import execution_planner
+
+    pl = execution_planner()
+    st = pl.stats()
+    worst, worst_val = st.get("worst_kernel"), st.get(
+        "worst_abs_residual_ema")
+    details = {
+        "enabled": st.get("enabled"),
+        "decisions": st.get("decisions"),
+        "decision_modes": st.get("decision_modes"),
+        "repriced": st.get("repriced"),
+        "worst_kernel": worst,
+        "worst_abs_residual_ema": worst_val,
+    }
+    try:
+        ceiling = float(engine.settings.get("slo.planner.residual") or 0)
+    except Exception:  # noqa: BLE001
+        ceiling = 0.0
+    if not st.get("enabled"):
+        return {"status": GREEN,
+                "symptom": ("Execution planner disabled: static priority "
+                            "routing"),
+                "details": details}
+    if ceiling > 0 and worst_val is not None and worst_val > ceiling:
+        return {
+            "status": YELLOW,
+            "symptom": (f"planner cost model drifting: kernel [{worst}] "
+                        f"|residual| EMA {worst_val:g} exceeds the "
+                        f"{ceiling:g} ceiling"),
+            "details": details,
+            "impacts": [_impact(
+                "arm selection may be misrouting waves while the model "
+                "misfits this kernel", severity=3, areas=["search"])],
+            "diagnosis": [_diagnosis(
+                "the analytic cost x efficiency-EMA prediction for the "
+                "named kernel no longer tracks measured walls",
+                "compare flight-recorder decision records "
+                "(predicted_ms vs actual_ms) for the kernel; re-derive "
+                "its cost function or raise slo.planner.residual",
+                [worst] if worst else [])],
+        }
+    if st.get("repriced"):
+        return {
+            "status": YELLOW,
+            "symptom": (f"arms {st['repriced']} repriced to ∞ — routing "
+                        "is shifted onto the surviving arms"),
+            "details": details,
+            "impacts": [_impact(
+                "waves run on smaller-footprint arms until the "
+                "repricing clears", severity=3, areas=["search"])],
+            "diagnosis": [_diagnosis(
+                "a device degradation (or scoped retry) repriced the "
+                "named arms",
+                "inspect the resilience indicator and flight recorder; "
+                "repricing clears when the recovery ramp completes",
+                list(st["repriced"]))],
+        }
+    return {"status": GREEN,
+            "symptom": ("Execution planner tracking: "
+                        + (f"worst kernel [{worst}] |residual| EMA "
+                           f"{worst_val:g}" if worst
+                           else "no observed dispatches yet (static "
+                                "priority parity)")),
+            "details": details}
+
+
 def _slo_indicator(engine) -> dict:
     ev = engine.slo.current()
     if not ev["enabled"]:
@@ -464,6 +538,7 @@ def health_report(engine) -> dict:
     add("kernel_utilization", _kernel_indicator)
     add("serving_backpressure", _serving_indicator)
     add("data_plane_resilience", _resilience_indicator)
+    add("execution_planner", _planner_indicator)
     add("indexing", _indexing_indicator)
     add("slo_compliance", _slo_indicator)
     add("watcher", _watcher_indicator)

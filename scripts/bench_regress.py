@@ -355,6 +355,93 @@ def print_superpack_table(latest: dict, cur_round: int) -> None:
         print(f"  {path:<64} {_fmt(rows[path]):>12}")
 
 
+def planner_metrics(record: dict) -> dict:
+    """-> C9 adaptive-planner leaves (PR 18): per-routing QPS and p99
+    on the shared mixed trace, the planner/best-static QPS ratio, the
+    decision-latency percentiles (the < 100 µs budget), and the
+    residual-distribution percentiles."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "planner_mixed_trace" and isinstance(v, dict):
+                    base = path + (k,)
+                    val = v.get("planner_vs_best_static")
+                    if isinstance(val, (int, float)) \
+                            and not isinstance(val, bool):
+                        out[".".join(base + ("planner_vs_best_static",))] = \
+                            float(val)
+                    for routing, sec in (v.get("routings") or {}).items():
+                        if not isinstance(sec, dict):
+                            continue
+                        q = sec.get("qps")
+                        if isinstance(q, (int, float)):
+                            out[".".join(base + (routing, "qps"))] = float(q)
+                        p99 = (sec.get("latency") or {}).get("p99_ms")
+                        if isinstance(p99, (int, float)):
+                            out[".".join(base + (routing, "p99_ms"))] = \
+                                float(p99)
+                    for kk in ("p50", "p99"):
+                        val = (v.get("decision_us") or {}).get(kk)
+                        if isinstance(val, (int, float)):
+                            out[".".join(base + ("decision_us", kk))] = \
+                                float(val)
+                    for kk in ("p50", "p90"):
+                        val = (v.get("residual") or {}).get(kk)
+                        if isinstance(val, (int, float)):
+                            out[".".join(base + ("residual", kk))] = \
+                                float(val)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+_PLANNER_LOWER = {"p99_ms", "p50", "p99", "p90"}
+
+
+def planner_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY (same convention as superpack_growth): C9 movement
+    beyond `threshold` — routing QPS or the planner/best-static ratio
+    down, or decision latency / p99 / residual spread up — is printed
+    for the tier-1 log reader but never fails the lint. A
+    planner_vs_best_static ratio that fell under 1.0 is the loudest
+    signal: the adaptive routing stopped paying for its decisions."""
+    a, b = planner_metrics(prev), planner_metrics(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        leaf = path.rsplit(".", 1)[-1]
+        ratio = new / old
+        if leaf in _PLANNER_LOWER:
+            regressed = ratio > 1.0 + threshold
+        else:  # qps, planner_vs_best_static: higher is better
+            regressed = ratio < 1.0 - threshold
+        if regressed:
+            moved.append((path, old, new, ratio))
+    return moved
+
+
+def print_planner_table(latest: dict, cur_round: int) -> None:
+    """Render the newest record's C9 advisory table (per-routing QPS +
+    p99 on the mixed trace, decision latency, residual spread) whenever
+    the record carries a planner_mixed_trace arm."""
+    rows = planner_metrics(latest)
+    if not rows:
+        return
+    print(f"[bench-regress] adaptive-planner table (r{cur_round:02d}; "
+          "mixed C1+C4+C7 trace, planner vs static routings):")
+    for path in sorted(rows):
+        print(f"  {path:<64} {_fmt(rows[path]):>12}")
+
+
 def build_speedup_table(prev: dict, latest: dict) -> list:
     """PR 15: when BOTH records carry `build_profile` sections, the
     r(N-1)→rN comparison IS the device port's scorecard — render a
@@ -471,11 +558,20 @@ def main(argv=None) -> int:
               f"({ratio:.2f}x) — C8 per-tenant economics moved beyond "
               f"{args.threshold:.0%}; a compiled-program count that grew "
               "means a shape tier leaked past the size-class bound")
+    for path, old, new, ratio in planner_growth(
+            prev, latest, args.threshold):
+        print(f"  PLANNER (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x) — C9 routing economics moved beyond "
+              f"{args.threshold:.0%}; a planner_vs_best_static ratio "
+              "under 1.0 means the adaptive routing stopped paying for "
+              "its decisions")
     # PR 15: the per-stage host-vs-device scorecard whenever both
     # records profiled their builds
     print_build_speedup(prev, latest, prev_round, cur_round)
     # PR 17: the C8 per-tenant advisory table for the newest record
     print_superpack_table(latest, cur_round)
+    # PR 18: the C9 adaptive-planner advisory table for the newest record
+    print_planner_table(latest, cur_round)
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -18,6 +18,13 @@ Two stages, both deterministic (seeded schedules, fixed corpora):
   surviving shards against a no-fault oracle, the degradation event in
   the flight recorder, and zero leaked in_flight_requests reservations.
 
+  Stage F — planner repricing under device OOM (PR 18): with the fused
+  arm forced eligible, ONE injected device OOM must shift routing off
+  fused through execution-planner repricing (candidate filtering in
+  choose_arm) rather than env-var pins; statuses stay 200/429/503,
+  every 200 matches the routed arm's no-fault oracle, and recovery
+  returns the routing to fused.
+
 Exit 0 = contract held. Any violation raises (non-zero exit).
 Run by scripts/chaos_gate.sh (advisory stage of tier1_gate.sh).
 """
@@ -402,6 +409,155 @@ def stage_e_superpack() -> dict:
             os.environ["ES_TPU_SUPERPACK"] = prev_env
 
 
+async def _stage_f_async(tmp: str) -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.planner import execution_planner, reset_for_tests
+    from elasticsearch_tpu.rest import make_app
+
+    reset_for_tests()
+    pl = execution_planner()
+    app = make_app(data_path=os.path.join(tmp, "data"))
+    engine = app["engine"]
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        # corpus with a real dense tier (df >= 64) so the FORCED fused
+        # arm is eligible; distinct tf counts keep ranks fault-stable
+        r = await client.put("/parm", json={"mappings": {
+            "properties": {"body": {"type": "text"}}}})
+        assert r.status == 200, await r.text()
+        bulk = "".join(
+            json.dumps({"index": {"_id": f"d{i}"}}) + "\n"
+            + json.dumps({"body": " ".join(["stormy"] * (i % 7 + 1))
+                          + f" w{i}"}) + "\n"
+            for i in range(96))
+        r = await client.post("/parm/_bulk?refresh=true", data=bulk,
+                              headers={"Content-Type":
+                                       "application/x-ndjson"})
+        assert r.status == 200 and not (await r.json())["errors"]
+        # the first refresh seals an EMPTY base, so the bulk lands in a
+        # dense-disabled tail segment — force-merge into a sealed base so
+        # the dense tier (the fused arm's eligibility gate) materializes
+        engine.indices["parm"]._merge_tiers()
+        # serving on; request cache OFF so EVERY search dispatches and
+        # its routing decision is observable per-request; model-mode
+        # routing OFF so the loop's arm is a deterministic function of
+        # the REPRICING state alone (repricing filters candidates before
+        # the mode question — it is what this stage asserts)
+        r = await client.put("/_cluster/settings", json={"transient": {
+            "serving.enabled": True,
+            "planner.enabled": False,
+            "indices.requests.cache.enable": False}})
+        assert r.status == 200
+
+        q = {"query": {"match": {"body": "stormy"}}, "size": 8}
+
+        async def _search():
+            r = await client.post("/parm/_search", json=q)
+            body = await r.json()
+            return r.status, body
+
+        # no-fault oracles for BOTH arms: cold planner = static priority
+        # = fused (forced); a scoped reprice yields the exact-arm rows
+        status, oracle_fused = await _search()
+        assert status == 200 and oracle_fused["_shards"]["failed"] == 0
+        assert pl.stats()["decisions"].get("fused", 0) >= 1, \
+            "fused arm was not eligible — stage F needs ES_TPU_FUSED=force"
+        with pl.reprice(("fused", "impact"), reason="stage-f-oracle"):
+            status, oracle_exact = await _search()
+        assert status == 200 and oracle_exact["_shards"]["failed"] == 0
+        assert pl.stats()["decisions"].get("exact", 0) >= 1, \
+            "scoped repricing did not shift routing off the fused arm"
+        assert (oracle_exact["hits"]["total"]["value"]
+                == oracle_fused["hits"]["total"]["value"])
+
+        # ONE injected device OOM: the recovery path REPRICES the fused
+        # and impact arms (planner candidate filtering) instead of
+        # pinning ES_TPU_* env vars; the standing repricer then keeps
+        # fused at ∞ for as long as the degradation ramp runs
+        faults.configure("device.dispatch:once=1,error=oom", seed=SEED)
+        dec_before = dict(pl.stats()["decisions"])
+        statuses = {200: 0, 429: 0, 503: 0}
+        for i in range(24):
+            if i == 4:
+                # the OOM rides a classic-path dispatch (profile pins it)
+                r = await client.post("/parm/_search",
+                                      json={**q, "profile": True})
+                assert r.status == 200, await r.text()
+                assert engine.device_degradation.degraded, \
+                    "the injected OOM never degraded the device"
+                assert "fused" in pl.repriced_arms(), \
+                    "degradation did not reprice the fused arm"
+                continue
+            degraded = engine.device_degradation.degraded
+            status, body = await _search()
+            assert status in statuses, (status, body)
+            statuses[status] += 1
+            if status != 200:
+                assert body.get("error", {}).get("type"), body
+                continue
+            assert body["_shards"]["failed"] == 0, body["_shards"]
+            # parity vs the no-fault oracle of whichever arm the
+            # repricing state routes: exact while degraded, fused before
+            # the OOM / after recovery
+            want = (oracle_exact if degraded
+                    and engine.device_degradation.degraded
+                    else oracle_fused)
+            assert body["hits"]["hits"] == want["hits"]["hits"], \
+                "routed arm's rows diverged from its no-fault oracle"
+        st = faults.stats()
+        faults.clear()
+        assert st["points"]["device.dispatch"]["fired"] == 1, st
+        pst = pl.stats()
+        shifted = (pst["decisions"].get("exact", 0)
+                   - dec_before.get("exact", 0))
+        assert shifted >= 1, \
+            f"no decision shifted onto the exact arm post-OOM: {pst}"
+        assert pst["decision_modes"].get("repriced", 0) >= 1, pst
+
+        # recovery clears the repricing and routing returns to fused
+        engine.device_degradation.recover_now()
+        assert not pl.repriced_arms(), pl.repriced_arms()
+        fused_before = pl.stats()["decisions"].get("fused", 0)
+        status, body = await _search()
+        assert status == 200
+        assert body["hits"]["hits"] == oracle_fused["hits"]["hits"]
+        assert pl.stats()["decisions"].get("fused", 0) > fused_before, \
+            "routing never returned to the fused arm after recovery"
+        return {"statuses": {str(k): v for k, v in statuses.items()},
+                "decisions": pst["decisions"],
+                "modes": pst["decision_modes"],
+                "repriced_counters": {
+                    k: v for k, v in pst.items() if k == "repriced"}}
+    finally:
+        faults.clear()
+        await client.close()
+
+
+def stage_f_planner_repricing() -> dict:
+    """Stage F (PR 18): an injected device OOM must shift routing off
+    the fused arm through PLANNER REPRICING — candidate filtering in
+    choose_arm — not env-var pins; statuses stay 200/429/503, every 200
+    matches the routed arm's no-fault oracle, and recovery returns the
+    routing to fused."""
+    import tempfile
+
+    prev = os.environ.get("ES_TPU_FUSED")
+    os.environ["ES_TPU_FUSED"] = "force"
+    tmp = tempfile.mkdtemp(prefix="es_tpu_chaos_f_")
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(_stage_f_async(tmp))
+    finally:
+        loop.close()
+        if prev is None:
+            os.environ.pop("ES_TPU_FUSED", None)
+        else:
+            os.environ["ES_TPU_FUSED"] = prev
+
+
 def main() -> int:
     print(f"[chaos] seed={SEED} requests={N_REQUESTS}")
     a = stage_a_cluster()
@@ -412,6 +568,8 @@ def main() -> int:
     print(f"[chaos] stage D (writers + searchers + build fault): {d}")
     ev = stage_e_superpack()
     print(f"[chaos] stage E (superpack fold fault isolation): {ev}")
+    f = stage_f_planner_repricing()
+    print(f"[chaos] stage F (planner repricing under device OOM): {f}")
     print("[chaos] contract held: no hangs, no crashes, every response "
           "complete / valid-partial / clean 429-503")
     return 0

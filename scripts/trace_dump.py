@@ -184,6 +184,18 @@ def render_flight(snap: dict, out=None) -> None:
                           f" mfu={tk.get('mfu', 0)}")
         if w.get("escalations"):
             extras.append(f"esc={w['escalations']}")
+        # planner decision attribution (PR 18): chosen arm + mode, the
+        # predicted wall next to what the dispatch actually cost
+        for d in (w.get("decisions") or []):
+            pred = (d.get("predicted_ms") or {}).get(d.get("arm"))
+            col = f"plan={d.get('arm')}[{d.get('mode')}]"
+            if pred is not None:
+                col += f" pred={pred}ms"
+            if d.get("actual_ms") is not None:
+                col += f" act={d['actual_ms']}ms"
+            if d.get("residual") is not None:
+                col += f" res={d['residual']:+}"
+            extras.append(col)
         if w.get("error"):
             extras.append("ERROR")
         print(f"  [{bar}] w{w.get('wave'):>4} size={w.get('size'):>3} "

@@ -188,6 +188,24 @@ def _module_hygiene():
 
 
 @pytest.fixture(autouse=True)
+def _planner_cold():
+    """Every test starts with a COLD execution planner (PR 18). The
+    planner's efficiency EMAs are fed by real measured walls, so warm
+    state accumulated across the suite would reroute arms
+    NONDETERMINISTICALLY (run-to-run timing decides the argmin) under
+    tests that assert a specific arm engages. Cold state is
+    byte-identical to the static fused > impact > exact priority — the
+    planner's own cold-start contract — so pre-planner tests keep the
+    routing they were written against; tests of warm behavior
+    (test_planner.py) seed their own observations."""
+    from elasticsearch_tpu.planner import reset_for_tests as _planner_reset
+
+    _planner_reset()
+    yield
+    _planner_reset()
+
+
+@pytest.fixture(autouse=True)
 def _env_hermetic():
     """Behavior-steering env vars (fused/pallas/wand/wire toggles) must
     never leak across tests: snapshot at test start, restore at test end.

@@ -408,25 +408,42 @@ def test_device_oom_staged_degradation_and_recovery(tmp_path):
 
 
 def test_device_recovery_reruns_on_exact_arm(tmp_path):
-    """The stage-3 rerun pins the fused/impact arms off for exactly the
-    retry, then restores the routing env."""
+    """The stage-3 rerun REPRICES the fused/impact arms (planner
+    candidate filtering) for exactly the retry — the routing env is
+    never touched — and the standing degradation repricer keeps the
+    fused arm priced out until the ramp recovers (PR 18)."""
     from elasticsearch_tpu.common.resilience import run_with_device_recovery
     from elasticsearch_tpu.engine import Engine
+    from elasticsearch_tpu.planner import execution_planner
 
     e = Engine(str(tmp_path / "d"))
+    e.serving  # build the service: degradation state lives on its wave
+    pl = execution_planner()
     try:
         calls = []
 
         def fn():
-            calls.append(os.environ.get("ES_TPU_FUSED"))
+            calls.append((os.environ.get("ES_TPU_FUSED"),
+                          tuple(pl.repriced_arms())))
             if len(calls) == 1:
                 raise faults.InjectedDeviceOOM("device.dispatch")
             return "ok"
 
         os.environ.pop("ES_TPU_FUSED", None)
+        assert not pl.repriced_arms()
         assert run_with_device_recovery(e, fn, where="dispatch") == "ok"
-        assert calls == [None, "0"]  # retry ran with the exact arm pinned
-        assert os.environ.get("ES_TPU_FUSED") is None  # restored
+        # first call: nothing repriced; the retry ran with BOTH dense
+        # arms repriced (scoped) — the env was never pinned either time
+        assert calls[0] == (None, ())
+        assert calls[1][0] is None
+        assert set(calls[1][1]) >= {"fused", "impact"}
+        assert os.environ.get("ES_TPU_FUSED") is None
+        # the scoped reprice ended, but the OOM degraded the device and
+        # its STANDING repricer keeps fused priced out until recovery
+        assert e.device_degradation.degraded
+        assert pl.repriced_arms() == ["fused"]
+        e.device_degradation.recover_now()
+        assert not pl.repriced_arms()
         # a non-OOM error propagates untouched, no degradation recorded
         before = len(e.device_degradation.events)
         with pytest.raises(ValueError):
