@@ -1251,6 +1251,43 @@ def config5_8shard(rng):
     }
 
 
+def _tenant_attribution(svc, engine):
+    """PR 19: the per-tenant device-ms attribution block the serving
+    arms record. Walks the flight recorder and asserts IN-RECORD that
+    every wave's tenant shares sum EXACTLY (`==`, never approximately)
+    to that wave's device segment, then reports the bounded per-tenant
+    ledger. `sum_shares_over_wall` is fsum-over-fsum, so the 1.0 it
+    records is bit-exact, not a tolerance."""
+    import math
+
+    from elasticsearch_tpu.tenancy.metering import shares_sum
+
+    sums, walls = [], []
+    for w in svc.flight_recorder()["waves"]:
+        mix = w.get("tenants") or {}
+        if not mix or w.get("kind") == "degradation":
+            continue
+        if not isinstance(next(iter(mix.values())), dict):
+            continue
+        s = shares_sum(v["device_ms"] for v in mix.values())
+        wall = w["segments_ms"]["device"]
+        assert s == wall, (s, wall, w)
+        sums.append(s)
+        walls.append(wall)
+    wall_total = math.fsum(walls)
+    ratio = (math.fsum(sums) / wall_total) if wall_total else 1.0
+    assert ratio == 1.0, ratio
+    rows = engine.metering.rows()
+    return {
+        "waves_checked": len(sums),
+        "sum_shares_over_wall": ratio,  # asserted == 1.0 above
+        "ledger_rows": len(rows),  # top-K bounded (+ _other fold row)
+        "per_tenant_device_ms": {
+            t: r["device_ms"] for t, r in sorted(
+                rows.items(), key=lambda kv: -kv[1]["device_ms"])},
+    }
+
+
 def config6_serving(rng):
     """C6 closed-loop serving arm (ROADMAP item 3): N concurrent clients
     against the continuous-batching front end vs today's per-request
@@ -1423,6 +1460,7 @@ def config6_serving(rng):
                     for (a_id, a_s), (g_id, g_s) in zip(ch, gh)))
     rank_parity = rank_ok / gate_n
 
+    tattr = _tenant_attribution(svc, engine)
     svc.stop()
     engine.close()
     pool.shutdown(wait=True)
@@ -1455,6 +1493,7 @@ def config6_serving(rng):
             "shed": st["shed"],
         },
         "speedup": round(b_qps / max(a_qps, 1e-9), 2),
+        "tenant_attribution": tattr,
         "parity": {
             "coalesced_vs_solo_wave": "byte-identical (64-sample asserted)",
             "rank_parity_vs_classic": rank_parity,
@@ -1945,6 +1984,7 @@ def config8_superpack(rng):
 
         latency_on = _hist_pcts("bench.c8.superpack.ms", lat_on)
         latency_off = _hist_pcts("bench.c8.per_index.ms", lat_off)
+        tattr = _tenant_attribution(svc, engine)
         result = {
             "tenants": n_tenants,
             "docs_per_tenant": docs_per_tenant,
@@ -1973,6 +2013,7 @@ def config8_superpack(rng):
                 "hbm_bytes_per_tenant": int(np.mean(hbm_px)),
             },
             "qps_vs_per_index": round(qps_on / max(qps_off, 1e-9), 3),
+            "tenant_attribution": tattr,
             "xla_cost_check": _xla_cost_check({"superpack.tenant_gather"}),
             "basis": "in-memory engine; one engine thread (REST "
                      "discipline); ON/OFF toggled via ES_TPU_SUPERPACK "

@@ -144,6 +144,12 @@ class SizedLru:
     def clear(self) -> int:
         return self.invalidate_where(lambda _k: True)
 
+    def bytes_where(self, pred: Callable) -> int:
+        """Resident bytes over keys satisfying `pred(key)` — read-only
+        twin of `invalidate_where` (PR 19 per-tenant cache accounting)."""
+        with self._lock:
+            return sum(e.nbytes for k, e in self._map.items() if pred(k))
+
     def set_max_bytes(self, max_bytes: int) -> None:
         """Shrink/grow the budget; shrinking evicts LRU-first."""
         removed = []

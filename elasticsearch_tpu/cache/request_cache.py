@@ -152,6 +152,18 @@ class ShardRequestCache:
         refreshing tenant leaves its neighbors' caches hot)."""
         return self.invalidate_searcher(superpack_token, shard=lane)
 
+    def bytes_by_lane(self, superpack_token: int) -> dict[int, int]:
+        """lane -> resident bytes under `superpack_token` (PR 19 tenant
+        metering: superpack lane keys make per-tenant cache bytes exact
+        — one keyed scan, no estimation)."""
+        out: dict[int, int] = {}
+        with self.lru._lock:
+            for k, e in self.lru._map.items():
+                if k[0][0] == superpack_token:
+                    lane = k[0][1]
+                    out[lane] = out.get(lane, 0) + e.nbytes
+        return out
+
     def _on_removal(self, _key, _value, reason) -> None:
         if reason == "evicted":
             from ..telemetry import record_cache_event

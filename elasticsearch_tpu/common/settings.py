@@ -272,6 +272,16 @@ def default_cluster_settings() -> list[Setting]:
         # |predicted-vs-actual| residual EMA — a drifting cost model is
         # an SLO breach, not a silent misrouter. 0 disables.
         Setting("slo.planner.residual", 0.0, Setting.float_, dynamic=True),
+        # PR 19: per-tenant budget objectives over the metering ledger —
+        # device-time burn (ms of device wall per wall-clock second),
+        # per-tenant queue-wait p99, per-tenant shed rate. Breaches name
+        # the worst tenant. 0 disables (budgets come from measured
+        # baselines, like the write floors).
+        Setting("slo.tenant.device_ms_per_s", 0.0, Setting.float_,
+                dynamic=True),
+        Setting("slo.tenant.queue_p99_ms", 0.0, Setting.float_,
+                dynamic=True),
+        Setting("slo.tenant.shed_rate", 0.0, Setting.float_, dynamic=True),
         Setting("slo.custom", "", str, dynamic=True),
         # adaptive execution planner (PR 18, planner/): cost-model-driven
         # arm selection — predicted wall = analytic cost / measured
@@ -284,6 +294,21 @@ def default_cluster_settings() -> list[Setting]:
         Setting("planner.ema.alpha", 0.2, Setting.float_, dynamic=True),
         Setting("planner.knn.target_ms", 0.0, Setting.float_, dynamic=True),
         Setting("planner.cache.min_recompute_us", 0.0, Setting.float_,
+                dynamic=True),
+        # PR 19: budget-fed fair scheduling — derive the serving
+        # weighted-RR tenant weights from slo.tenant.device_ms_per_s
+        # budget burn. Advisory and clamped: an over-budget tenant's
+        # weight scales by budget/burn down to min_factor (slowed,
+        # never starved); OFF (the default, the kill switch) leaves the
+        # static serving.tenant.weights table byte-identical.
+        Setting("planner.tenant.fairshare", False, Setting.bool_,
+                dynamic=True),
+        Setting("planner.tenant.fairshare.min_factor", 0.25,
+                Setting.float_, dynamic=True),
+        # PR 19: the tenant metering ledger's row budget — rows beyond
+        # the top-K fold into `_other` (the Prometheus label-cardinality
+        # bound, enforced by lint)
+        Setting("metering.tenant.top_k", 16, Setting.positive_int,
                 dynamic=True),
         # continuous-batching serving front end (serving/): admission,
         # coalescing into device waves, deadline/fairness scheduling,

@@ -462,6 +462,34 @@ def cat_tasks(engine) -> list[dict]:
     return out
 
 
+def cat_tenants(engine) -> list[dict]:
+    """GET /_cat/tenants (PR 19, no reference twin — the reference has
+    no tenant ledger to cat): one row per metered tenant, device-ms
+    descending, with the dominant kernel named per row. Same `v`/`h`/
+    `format` conventions as every other _cat endpoint via cat_render."""
+    meter = engine._metering
+    if meter is None:
+        return []
+    out = []
+    for tenant, r in meter.rows().items():
+        kernels = r.get("kernels") or {}
+        out.append({
+            "tenant": tenant,
+            "requests": r["requests"],
+            "waves": r["waves"],
+            "device_ms": r["device_ms"],
+            "device_ms_per_s": r["device_ms_per_s"],
+            "queue_p99_ms": r["queue_p99_ms"],
+            "sheds": r["sheds"],
+            "shed_rate": r["shed_rate"],
+            "cache.hits": r["cache"]["hits"],
+            "cache.misses": r["cache"]["misses"],
+            "ingest.bytes": r["ingest_bytes"],
+            "dominant_kernel": (next(iter(kernels)) if kernels else "-"),
+        })
+    return out
+
+
 def cat_count(engine, expression: str | None) -> list[dict]:
     total = 0
     targets = (

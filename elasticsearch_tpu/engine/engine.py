@@ -2123,6 +2123,7 @@ class Engine:
         self._profiler = None
         self._refresh_recorder = None
         self._device_degradation = None
+        self._metering = None
         self.meta = MetadataStore(data_path)
         self.contexts = ContextRegistry()
         from ..common.breaker import CircuitBreakerService
@@ -2240,6 +2241,25 @@ class Engine:
                     "planner.cache.min_recompute_us"):
             self.settings.add_consumer(key, _planner_settings)
         _planner_settings()
+        # per-tenant metering (PR 19, tenancy/metering.py): the fair-
+        # share knobs route through the lazy serving property (firing
+        # only on dynamic updates — a node serving no traffic never
+        # builds the scheduler), the ledger bound through the lazy meter
+        def _fairshare_settings(_v=None):
+            self.serving.configure_fairshare(
+                enabled=self.settings.get("planner.tenant.fairshare"),
+                budget_ms_per_s=self.settings.get(
+                    "slo.tenant.device_ms_per_s"),
+                min_factor=self.settings.get(
+                    "planner.tenant.fairshare.min_factor"))
+
+        for key in ("planner.tenant.fairshare",
+                    "planner.tenant.fairshare.min_factor",
+                    "slo.tenant.device_ms_per_s"):
+            self.settings.add_consumer(key, _fairshare_settings)
+        self.settings.add_consumer(
+            "metering.tenant.top_k",
+            lambda v: self.metering.set_top_k(v))
         # scheduled watcher (xpack/watcher.py): a persisted watcher-driver
         # task resumes its ticker at boot, so watches keep firing after a
         # node restart without any request touching the watcher surface
@@ -2363,6 +2383,53 @@ class Engine:
         if self._device_degradation is None:
             self._device_degradation = DeviceDegradation(self)
         return self._device_degradation
+
+    @property
+    def metering(self):
+        """Per-tenant resource ledger (tenancy/metering.py, PR 19):
+        per-engine — like the refresh recorder, in-process multi-node
+        fixtures must never mix nodes' tenants. Fed by the serving
+        waves' exact apportioned shares; read by `_nodes/stats`,
+        `GET /_tenants/stats`, the TSDB collector, and the SLO engine."""
+        from ..tenancy.metering import TenantMeter
+
+        if self._metering is None:
+            try:
+                top_k = int(self.settings.get("metering.tenant.top_k"))
+            except Exception:  # noqa: BLE001 - engines without the setting
+                top_k = 16
+            self._metering = TenantMeter(top_k=top_k)
+        return self._metering
+
+    def tenant_stats(self) -> dict:
+        """The `tenants` section (`_nodes/stats`, `GET /_tenants/stats`):
+        the metering ledger joined with the point-in-time per-tenant
+        state the ledger doesn't own — superpack HBM-resident bytes per
+        lane (exact: the member's share of its shared pack) and
+        request-cache bytes held per superpack lane (exact per lane;
+        non-superpack cache bytes are not tenant-scoped and stay
+        unattributed — see DIVERGENCES.md 'Tenant metering')."""
+        from ..tenancy.metering import normalize_tenant
+
+        out = self.metering.stats()
+        rows = out["tenants"]
+        mgr = self._superpacks
+        if mgr is not None:
+            try:
+                cache_by_member = mgr.cache_bytes_per_member()
+                for name in mgr.member_names():
+                    t = normalize_tenant(name)
+                    row = rows.get(t)
+                    if row is None:
+                        continue
+                    ms = mgr.member_stats(name) or {}
+                    row["superpack_hbm_bytes"] = int(
+                        ms.get("hbm_bytes_per_tenant", 0))
+                    row.setdefault("cache", {})["bytes_held"] = int(
+                        cache_by_member.get(name, 0))
+            except Exception:  # noqa: BLE001 - stats must never fail
+                pass
+        return out
 
     @property
     def refresh_recorder(self):

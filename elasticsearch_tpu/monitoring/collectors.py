@@ -162,6 +162,31 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
         }
     except Exception:  # noqa: BLE001
         pass
+    # per-tenant ledger (PR 19): the exact apportioned device-ms shares
+    # land in the TSDB as history — bounded by the meter's top-K fold
+    # (tenant keys are already charset-sanitized by normalize_tenant,
+    # so they are safe field keys). Flat numeric leaves per tenant.
+    tenants_doc = {}
+    try:
+        meter = engine._metering
+        if meter is not None:
+            tenants_doc = {
+                t: {
+                    "requests": r["requests"],
+                    "waves": r["waves"],
+                    "device_ms": r["device_ms"],
+                    "device_ms_per_s": r["device_ms_per_s"],
+                    "queue_wait_ms": r["queue_wait_ms"],
+                    "queue_p99_ms": r["queue_p99_ms"],
+                    "sheds": r["sheds"],
+                    "shed_rate": r["shed_rate"],
+                    "cache_hits": r["cache"]["hits"],
+                    "cache_misses": r["cache"]["misses"],
+                    "ingest_bytes": r["ingest_bytes"],
+                } for t, r in meter.rows().items()
+            }
+    except Exception:  # noqa: BLE001
+        pass
     try:
         ev = engine.slo.evaluate()
         slo_doc = {
@@ -251,6 +276,7 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
                     "host_transitions_total", {}).get("fetch", 0),
             },
             "planner": planner_doc,
+            "tenants": tenants_doc,
         },
     }
 

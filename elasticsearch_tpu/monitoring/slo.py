@@ -93,6 +93,7 @@ class SloEngine:
             objectives.extend(self._hbm_objectives())
             objectives.extend(self._write_objectives())
             objectives.extend(self._planner_objectives())
+            objectives.extend(self._tenant_objectives())
             objectives.extend(self._custom_objectives(snap))
         breached = [o["id"] for o in objectives if o["status"] == "breached"]
         out = {
@@ -314,6 +315,59 @@ class SloEngine:
                else "(no observed dispatches yet)"),
             measured, ceiling,
             None if measured is None else measured > ceiling, "max")]
+
+    def _tenant_objectives(self) -> list[dict]:
+        """Per-tenant noisy-neighbor budgets (PR 19): every objective
+        reads the exact-apportioned TenantMeter ledger, so a breach
+        names the worst tenant with its real share of the shared device
+        wall, not a sampled guess. All three default to 0 (disabled);
+        the meter is consulted only if already built — a node serving
+        no traffic never constructs it."""
+        budget_ms = float(self._get("slo.tenant.device_ms_per_s", 0) or 0)
+        p99_max = float(self._get("slo.tenant.queue_p99_ms", 0) or 0)
+        shed_max = float(self._get("slo.tenant.shed_rate", 0) or 0)
+        if budget_ms <= 0 and p99_max <= 0 and shed_max <= 0:
+            return []
+        meter = getattr(self.engine, "_metering", None)
+        rows = meter.rows() if meter is not None else {}
+        out = []
+
+        def _worst(key):
+            named = {t: r[key] for t, r in rows.items()
+                     if r.get(key) is not None}
+            if not named:
+                return None, None
+            t = max(named, key=lambda k: (named[k], k))
+            return t, named[t]
+
+        if budget_ms > 0:
+            t, v = _worst("device_ms_per_s")
+            out.append(_objective(
+                "tenant-device-budget", "tenant",
+                f"per-tenant device-ms/s burn <= {budget_ms:g}"
+                + (f" (hungriest tenant [{t}])" if t
+                   else " (no metered waves yet)"),
+                round(v, 3) if v is not None else None, budget_ms,
+                None if v is None else v > budget_ms, "max"))
+        if p99_max > 0:
+            t, v = _worst("queue_p99_ms")
+            out.append(_objective(
+                "tenant-queue-p99", "tenant",
+                f"per-tenant queue-wait p99 <= {p99_max:g}ms"
+                + (f" (worst tenant [{t}])" if t
+                   else " (no metered waits yet)"),
+                round(v, 3) if v is not None else None, p99_max,
+                None if v is None else v > p99_max, "max"))
+        if shed_max > 0:
+            t, v = _worst("shed_rate")
+            out.append(_objective(
+                "tenant-shed-rate", "tenant",
+                f"per-tenant shed rate <= {shed_max:.0%} of its offered "
+                "requests"
+                + (f" (worst tenant [{t}])" if t else ""),
+                round(v, 4) if v is not None else None, shed_max,
+                None if v is None else v > shed_max, "max"))
+        return out
 
     def _custom_objectives(self, snap) -> list[dict]:
         raw = str(self._get("slo.custom", "") or "").strip()

@@ -1688,6 +1688,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     cat_recovery_api = _cat_endpoint(lambda req: _admin.cat_recovery(engine))
     cat_plugins_api = _cat_endpoint(lambda req: _admin.cat_plugins(engine))
     cat_tasks_api = _cat_endpoint(lambda req: _admin.cat_tasks(engine))
+    cat_tenants_api = _cat_endpoint(lambda req: _admin.cat_tenants(engine))
 
     # ---- task management -------------------------------------------------
 
@@ -1770,6 +1771,19 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
         t0 = time.monotonic()
         res = await call(engine.bulk, ops, request.query.get("pipeline"))
+        try:
+            # per-tenant ingest metering (PR 19): the raw NDJSON byte
+            # count is free here (already read) and engine.bulk never
+            # sees the wire form — the ONE place ingest bytes are exact
+            from ..telemetry import current_trace
+            from ..tenancy.metering import normalize_tenant
+
+            engine.metering.note_ingest(
+                normalize_tenant(
+                    getattr(current_trace(), "task_id", None)),
+                len(raw.encode("utf-8")), docs=len(ops))
+        except Exception:  # noqa: BLE001 - metering must not fail a bulk
+            pass
         if request.query.get("refresh") in ("", "true", "wait_for"):
             for touched in {op[1] for op in ops}:
                 try:
@@ -1910,8 +1924,13 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     from ..telemetry import current_trace
                     from ..utils.durations import parse_duration_seconds
 
+                    from ..tenancy.metering import normalize_tenant
+
                     tr = current_trace()
-                    tenant = (getattr(tr, "task_id", None) or "_anonymous")
+                    # X-Opaque-Id -> tenant through the ONE shared
+                    # normalizer (PR 19): the queue, the meter, and the
+                    # cache-accounting join all see the same key
+                    tenant = normalize_tenant(getattr(tr, "task_id", None))
                     t_raw = body.get("timeout") or query_params.get("timeout")
                     if t_raw is None:
                         t_raw = engine.settings.get(
@@ -2634,6 +2653,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # counts, cumulative build-stage millis, current
                         # tail-tier fraction, refresh lag, docs/s EMA
                         "indexing": engine.indexing_stats(),
+                        # per-tenant resource ledger (PR 19): exact
+                        # apportioned device-ms shares, queue waits,
+                        # sheds, cache + ingest traffic per tenant,
+                        # bounded at metering.tenant.top_k rows + _other
+                        "tenants": engine.tenant_stats(),
                         "metrics": metrics.snapshot(),
                         # tail-latency inspection without log scraping:
                         # the most recent slowlog entries (now carrying
@@ -2653,6 +2677,14 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         admission/shed/expiry/cancel counters, wave sizing + term-lane
         occupancy, backpressure configuration."""
         return web.json_response({"serving": engine.serving.stats()})
+
+    @handler
+    async def tenants_stats(request):
+        """GET /_tenants/stats: the per-tenant resource ledger (PR 19)
+        — exact apportioned device-ms (+ burn rate and per-kernel
+        split), queue-wait p99, shed/expiry/cancel counts, request-
+        cache traffic and superpack-lane bytes held, ingest volume."""
+        return web.json_response({"tenants": engine.tenant_stats()})
 
     @handler
     async def refresh_profile(request):
@@ -2897,6 +2929,39 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                     "samples": res}
         except Exception:  # noqa: BLE001 - the scrape must not 500
             pass
+        # per-tenant families (PR 19): label cardinality is HARD-bounded
+        # by the TenantMeter's top-K ledger (overflow folds into the
+        # `_other` row) — tenant strings come from the network, so the
+        # bound is what keeps a scrape from minting unbounded series;
+        # enforced by the cardinality lint in tests/test_tenant_metering
+        try:
+            if engine._metering is not None:
+                rows = engine._metering.rows()
+                for fam, key, kind, help_ in (
+                        ("es_tenant_device_ms_total", "device_ms",
+                         "counter", "exact apportioned device-wall ms "
+                         "per tenant (shares sum to each wave's wall)"),
+                        ("es_tenant_device_ms_per_s", "device_ms_per_s",
+                         "gauge", "per-tenant device-time burn rate "
+                         "over the sliding window"),
+                        ("es_tenant_requests_total", "requests",
+                         "counter", "wave-dispatched requests per "
+                         "tenant"),
+                        ("es_tenant_sheds_total", "sheds", "counter",
+                         "admission-shed (429) requests per tenant"),
+                        ("es_tenant_queue_wait_ms_total",
+                         "queue_wait_ms", "counter",
+                         "cumulative admission-queue wait ms per "
+                         "tenant"),
+                        ("es_tenant_ingest_bytes_total", "ingest_bytes",
+                         "counter", "raw bulk NDJSON bytes per tenant")):
+                    samples = [({"tenant": t}, r[key])
+                               for t, r in rows.items()]
+                    if samples:
+                        labeled[fam] = {"kind": kind, "help": help_,
+                                        "samples": samples}
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
         return web.Response(
             text=metrics.prometheus_text(extra, labeled=labeled),
             content_type="text/plain", charset="utf-8",
@@ -2995,6 +3060,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/indices", cat_indices)
     app.router.add_get("/_nodes/stats", nodes_stats)
     app.router.add_get("/_serving/stats", serving_stats)
+    app.router.add_get("/_tenants/stats", tenants_stats)
     app.router.add_get("/_refresh/profile", refresh_profile)
     app.router.add_get("/_serving/flight_recorder", serving_flight_recorder)
     app.router.add_post("/_serving/flight_recorder/_dump",
@@ -3246,6 +3312,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_cat/plugins", cat_plugins_api)
     app.router.add_get("/_cat/templates", cat_templates_api)
     app.router.add_get("/_cat/tasks", cat_tasks_api)
+    app.router.add_get("/_cat/tenants", cat_tenants_api)
     app.router.add_get("/_tasks", tasks_list)
     app.router.add_get("/_tasks/{task_id}", tasks_get)
     app.router.add_post("/_tasks/_cancel", tasks_cancel)

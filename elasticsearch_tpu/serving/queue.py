@@ -18,6 +18,7 @@ from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
+from ..tenancy.metering import normalize_tenant
 from ..utils.errors import ElasticsearchTpuError
 
 
@@ -80,8 +81,12 @@ class TenantQueues:
         return self._depth
 
     def push(self, ps: PendingSearch) -> int:
-        """-> queue depth after the push."""
+        """-> queue depth after the push. The tenant key normalizes
+        through the shared helper (PR 19 satellite): X-Opaque-Id was
+        trusted raw here — empty ids silently collapsed into one bucket
+        and unbounded ids became unbounded queue/metric keys."""
         with self._lock:
+            ps.tenant = normalize_tenant(ps.tenant)
             dq = self._q.get(ps.tenant)
             if dq is None:
                 dq = self._q[ps.tenant] = deque()

@@ -38,6 +38,9 @@ from collections import deque
 
 from ..common.breaker import CircuitBreakingError
 from ..tasks import TaskCancelledException
+from ..tenancy.metering import (
+    apportion, fairshare_weights, normalize_tenant,
+)
 from ..utils.durations import parse_duration_seconds
 from .coalesce import classify_request
 from .queue import (
@@ -120,6 +123,25 @@ class ServingService:
             self._merge_weight = float(s.get("serving.merge.weight"))
         except Exception:  # noqa: BLE001 - engines without the setting
             self._merge_weight = 1.0
+        # PR 19: budget-fed fair scheduling — static weights stay the
+        # canonical source; the fairshare knob derives EFFECTIVE weights
+        # from per-tenant device-budget burn (off/cold: the static dict
+        # itself, byte-identical — the PR-18 cold-parity discipline)
+        self._static_weights: dict[str, float] = {}
+        try:
+            self._fairshare_on = bool(s.get("planner.tenant.fairshare"))
+        except Exception:  # noqa: BLE001 - engines without the setting
+            self._fairshare_on = False
+        try:
+            self._fairshare_min = float(
+                s.get("planner.tenant.fairshare.min_factor"))
+        except Exception:  # noqa: BLE001
+            self._fairshare_min = 0.25
+        try:
+            self._fairshare_budget = float(
+                s.get("slo.tenant.device_ms_per_s"))
+        except Exception:  # noqa: BLE001
+            self._fairshare_budget = 0.0
         self.set_tenant_weights(s.get("serving.tenant.weights"))
         self._cv = threading.Condition()
         self._lock = threading.Lock()
@@ -182,20 +204,71 @@ class ServingService:
         self.queue_cap = max(1, int(v))
 
     def set_tenant_weights(self, raw):
-        w = parse_tenant_weights(raw)
+        # weight keys pass through the SAME normalizer as queue keys, so
+        # a weight for tenant "team a!" matches its sanitized queue row
+        w = {normalize_tenant(t): v
+             for t, v in parse_tenant_weights(raw).items()}
         # the merge tenant's weight comes from serving.merge.weight, not
         # the user weight table (an internal tenant, not a caller)
         w.setdefault(self.MERGE_TENANT, self._merge_weight)
-        self._tenants.set_weights(w)
+        self._static_weights = w
+        self._apply_fairshare()
 
     def set_merge_weight(self, v):
         try:
             self._merge_weight = max(float(v), 0.0)
         except (TypeError, ValueError):
             return
-        w = dict(self._tenants.weights)
-        w[self.MERGE_TENANT] = self._merge_weight
-        self._tenants.set_weights(w)
+        self._static_weights = dict(self._static_weights)
+        self._static_weights[self.MERGE_TENANT] = self._merge_weight
+        self._apply_fairshare()
+
+    def configure_fairshare(self, enabled=None, budget_ms_per_s=None,
+                            min_factor=None):
+        """Dynamic-settings consumer for the fair-share advisory knob
+        (`planner.tenant.fairshare`, budget from
+        `slo.tenant.device_ms_per_s`). Flipping it off — the kill
+        switch — restores the static weight table on the next call."""
+        if enabled is not None:
+            self._fairshare_on = bool(enabled)
+        if budget_ms_per_s is not None:
+            try:
+                self._fairshare_budget = float(budget_ms_per_s)
+            except (TypeError, ValueError):
+                pass
+        if min_factor is not None:
+            try:
+                self._fairshare_min = float(min_factor)
+            except (TypeError, ValueError):
+                pass
+        self._apply_fairshare()
+
+    def _meter(self):
+        """The engine's per-tenant ledger, or None on stub engines."""
+        try:
+            return self.engine.metering
+        except Exception:  # noqa: BLE001 - test stubs without the property
+            return None
+
+    def _apply_fairshare(self):
+        """Recompute the effective weighted-RR table. With fairshare off
+        (or no budget, or a cold meter) the STATIC dict passes through
+        unchanged — byte-identical scheduling, asserted by tests; with a
+        tenant over its device-ms/s budget, its weight scales by
+        budget/burn clamped to [min_factor, 1.0]: slowed, never starved
+        (pop_wave still visits it every round)."""
+        eff = self._static_weights
+        if self._fairshare_on and self._fairshare_budget > 0.0:
+            meter = self._meter()
+            if meter is not None:
+                burn = {t: r for t, r in meter.burn_rates().items()
+                        if t != self.MERGE_TENANT}
+                eff = fairshare_weights(
+                    self._static_weights, burn, self._fairshare_budget,
+                    self._fairshare_min)
+        if eff is not self._tenants.weights \
+                and eff != self._tenants.weights:
+            self._tenants.set_weights(eff)
 
     def set_flight_recorder_size(self, v):
         with self._lock:
@@ -237,10 +310,17 @@ class ServingService:
         device work is queued."""
         from ..telemetry import metrics
 
+        # satellite fix (PR 19): X-Opaque-Id normalizes ONCE at admission
+        # — the queue key, the shed ledger row, and every metering
+        # surface downstream see the same canonical tenant string
+        tenant = normalize_tenant(tenant)
+        meter = self._meter()
         if self._tenants.depth >= self.queue_cap:
             with self._lock:
                 self.counters["shed"] += 1
             metrics.counter_inc("es.serving.shed_total")
+            if meter is not None:
+                meter.note("sheds", tenant)
             raise ServingRejectedError(
                 f"serving queue full [{self.queue_cap}] — node saturated, "
                 f"retry after backoff", self._retry_after_s())
@@ -251,6 +331,8 @@ class ServingService:
             with self._lock:
                 self.counters["shed"] += 1
             metrics.counter_inc("es.serving.shed_total")
+            if meter is not None:
+                meter.note("sheds", tenant)
             ex.retry_after_s = self._retry_after_s()
             raise
         with self._lock:
@@ -341,6 +423,9 @@ class ServingService:
             return  # already dispatched (or otherwise settled): best-effort
         with self._lock:
             self.counters["cancelled"] += 1
+        meter = self._meter()
+        if meter is not None:
+            meter.note("cancelled", ps.tenant)
         self._terminal(ps)
         ps.future.set_exception(TaskCancelledException(
             f"task cancelled before dispatch [{reason}]"))
@@ -355,6 +440,9 @@ class ServingService:
             ps.task.cancel("serving deadline exceeded before dispatch")
         with self._lock:
             self.counters["expired"] += 1
+        meter = self._meter()
+        if meter is not None:
+            meter.note("expired", ps.tenant)
         self._terminal(ps)
         ps.future.set_result(_timed_out_response())
 
@@ -421,6 +509,7 @@ class ServingService:
                 now = time.monotonic()
                 ready = []
                 dropped = {"expired": 0, "cancelled": 0}
+                meter = self._meter()
                 for ps in wave:
                     if ps.task is not None and ps.task.cancelled:
                         with self._lock:
@@ -435,9 +524,11 @@ class ServingService:
                         dropped["expired"] += 1
                         self._resolve_expired(ps)
                         continue
+                    wait_ms = (now - ps.enqueue_t) * 1000
                     metrics.histogram_record(
-                        "es.serving.coalesce_wait_ms",
-                        (now - ps.enqueue_t) * 1000)
+                        "es.serving.coalesce_wait_ms", wait_ms)
+                    if meter is not None:
+                        meter.note_queue_wait(ps.tenant, wait_ms)
                     ready.append(ps)
                 metrics.gauge_set(
                     "es.serving.queue_depth", self._tenants.depth)
@@ -520,6 +611,59 @@ class ServingService:
 
     # ---- wave stages (engine thread) ------------------------------------
 
+    def _entry_cost(self, ps: PendingSearch, idx=None) -> dict:
+        """Analytic roofline weight for one wave entry (PR 19): the
+        PR-5 cost shapes priced per member, so the shared wave's
+        measured device wall can be apportioned proportional to each
+        entry's modeled work. Superpack-claimed entries price the
+        tenant-gather shape over their size class; per-index entries
+        price the batched disjunction over the index's resident docs.
+        -> {"weight", "flops", "bytes", "kernel"}; weight 0.0 means
+        'shape unavailable' (apportion degrades to equal split)."""
+        from ..monitoring.costmodel import device_peaks, kernel_cost
+
+        out = {"weight": 0.0, "flops": 0.0, "bytes": 0.0, "kernel": None}
+        try:
+            sp = ps.entry.get("_superpack")
+            if sp is not None:
+                from ..tenancy import size_class_of
+
+                member = sp["member"]
+                n_pad, nb_pad = size_class_of(member.num_docs,
+                                              member.num_blocks)
+                fields = {"queries": 1, "num_docs": n_pad,
+                          "rows": len(sp.get("terms") or ()) * nb_pad}
+                kernel = "superpack.tenant_gather"
+            else:
+                n = len(getattr(idx, "docs", None) or ()) or 1
+                fields = {"queries": 1, "num_docs": n}
+                kernel = "batched.disjunction"
+            cost = kernel_cost(kernel, fields)
+            if cost is None:
+                return out
+            peak_f, peak_b, _kind = device_peaks()
+            out["flops"] = float(cost.get("flops", 0.0))
+            out["bytes"] = float(cost.get("bytes", 0.0))
+            out["kernel"] = kernel
+            # roofline seconds: the max of the compute- and bandwidth-
+            # bound walls is the modeled device time — the weight
+            out["weight"] = max(out["flops"] / peak_f,
+                                out["bytes"] / peak_b)
+        except Exception:  # noqa: BLE001 - metering must never fail a wave
+            pass
+        return out
+
+    @staticmethod
+    def _add_cost(tenant_cost: dict, tenant: str, c: dict) -> None:
+        tc = tenant_cost.setdefault(tenant, {"weight": 0.0, "flops": 0.0,
+                                             "bytes": 0.0, "kernels": {}})
+        tc["weight"] += c["weight"]
+        tc["flops"] += c["flops"]
+        tc["bytes"] += c["bytes"]
+        if c["kernel"] is not None:
+            tc["kernels"][c["kernel"]] = (
+                tc["kernels"].get(c["kernel"], 0.0) + (c["weight"] or 1.0))
+
     def _wave_begin(self, ready: list[PendingSearch]) -> dict:
         from ..telemetry import collect_profile_events
 
@@ -527,7 +671,8 @@ class ServingService:
         for ps in ready:
             tenants[ps.tenant] = tenants.get(ps.tenant, 0) + 1
         state = {"t0": time.monotonic(), "jobs": [], "n": len(ready),
-                 "tenants": tenants, "events": [], "fallback_solo": 0}
+                 "tenants": tenants, "tenant_cost": {}, "events": [],
+                 "fallback_solo": 0}
         # internal lane (PR 15): background merges claimed into this
         # wave run here on the engine thread (the one-writer discipline)
         # and resolve immediately — a merge occupies its weighted-RR
@@ -567,10 +712,16 @@ class ServingService:
             by_index.setdefault(ps.entry["index"], []).append(ps)
         with collect_profile_events() as events:
             if sp_members:
+                # priced BEFORE search_wave_begin consumes the claim ctx;
+                # attributed only if the superpack job actually forms
+                sp_costs = [(ps, self._entry_cost(ps))
+                            for ps in sp_members]
                 try:
                     job = mgr.search_wave_begin(
                         [ps.entry for ps in sp_members])
                     state["jobs"].append((mgr, sp_members, job))
+                    for ps, c in sp_costs:
+                        self._add_cost(state["tenant_cost"], ps.tenant, c)
                 except Exception:  # noqa: BLE001 - degrade, don't poison
                     for ps in sp_members:
                         with self._lock:
@@ -608,6 +759,9 @@ class ServingService:
                 job = idx.search_wave_begin([ps.entry["kwargs"]
                                              for ps in members])
                 state["jobs"].append((idx, members, job))
+                for ps in members:
+                    self._add_cost(state["tenant_cost"], ps.tenant,
+                                   self._entry_cost(ps, idx))
         state["events"].extend(events)
         return state
 
@@ -689,6 +843,13 @@ class ServingService:
         metrics.histogram_record("es.serving.wave_size", state["n"])
         self._record_flight(state, t_complete, wave_tr, lanes, occ,
                             indices, err)
+        # PR 19: the ledger just absorbed this wave's shares — refresh
+        # the fair-share effective weights from the new burn rates (a
+        # no-op dict compare when the knob is off or nothing changed)
+        try:
+            self._apply_fairshare()
+        except Exception:  # noqa: BLE001 - advisory, never fails a wave
+            pass
 
     def _rescue_solo(self, members) -> list:
         """Re-run a poisoned wave's members one by one on the classic
@@ -821,6 +982,31 @@ class ServingService:
 
                         execution_planner().observe_wall(
                             d["kernel"], fields, actual / 1e3)
+            # PR 19: apportion the wave's measured device wall across
+            # member tenants proportional to each entry's analytic cost.
+            # The shares sum EXACTLY to segments_ms["device"] (fsum-exact
+            # residual correction in tenancy/metering.apportion) —
+            # asserted by tests, never sampled. Tenants whose entries
+            # never reached a device job (inline merges, solo fallbacks)
+            # carry weight 0 and get a 0.0 share: they did no device
+            # work in this wave.
+            req_counts = dict(state.get("tenants") or {})
+            tcost = state.get("tenant_cost") or {}
+            shares = apportion(
+                seg["device"],
+                {t: (tcost.get(t) or {}).get("weight", 0.0)
+                 for t in req_counts}) if req_counts else {}
+            dev = seg["device"]
+            tenant_mix = {
+                t: {"requests": req_counts[t],
+                    "device_ms": shares.get(t, 0.0),
+                    "share": (shares.get(t, 0.0) / dev) if dev else 0.0}
+                for t in req_counts}
+            meter = self._meter()
+            if meter is not None:
+                meter.record_wave(shares, req_counts, tcost,
+                                  cache_hits=cache["hits"],
+                                  cache_misses=cache["misses"])
             with self._lock:
                 self._wave_seq += 1
                 rec = {
@@ -833,7 +1019,7 @@ class ServingService:
                         "cancelled", 0),
                     "error": (f"{type(err).__name__}: {err}"
                               if err is not None else None),
-                    "tenants": dict(state.get("tenants") or {}),
+                    "tenants": tenant_mix,
                     "indices": sorted(set(indices)),
                     "lanes": lanes,
                     "segments_ms": seg,
@@ -909,6 +1095,15 @@ class ServingService:
                 "spmd_mode": spmd_mode(),
                 "queue": {**self._tenants.stats(),
                           "max_depth": self.queue_cap},
+                # PR 19: the advisory fair-share knob's observable state
+                # — static vs effective weights (equal when off/cold)
+                "fairshare": {
+                    "enabled": self._fairshare_on,
+                    "budget_device_ms_per_s": self._fairshare_budget,
+                    "min_factor": self._fairshare_min,
+                    "static_weights": dict(self._static_weights),
+                    "effective_weights": dict(self._tenants.weights),
+                },
                 "wave": {
                     "max_wave": self.max_wave,
                     "max_wait_ms": self.max_wait_s * 1000,

@@ -6,8 +6,20 @@ thousands of small tenant indices from one compiled program family.
   - superpack.py  SuperpackManager: size classes, lane lifecycle (fold as
                   the `_merge` internal tenant), per-tenant cache epochs,
                   the duck-typed serving-wave job
+  - metering.py   per-tenant resource metering (PR 19): the shared
+                  tenant-identity normalizer, exact sums-to-wall
+                  apportionment of shared wave walls, the bounded
+                  TenantMeter ledger, and budget-fed fair-share weights
 """
 
+from .metering import (
+    DEFAULT_TENANT, OTHER_TENANT, TenantMeter, apportion,
+    fairshare_weights, normalize_tenant, shares_sum,
+)
 from .superpack import SuperpackManager, size_class_of, superpack_enabled
 
-__all__ = ["SuperpackManager", "size_class_of", "superpack_enabled"]
+__all__ = [
+    "SuperpackManager", "size_class_of", "superpack_enabled",
+    "TenantMeter", "apportion", "fairshare_weights", "normalize_tenant",
+    "shares_sum", "DEFAULT_TENANT", "OTHER_TENANT",
+]

@@ -816,6 +816,28 @@ class SuperpackManager:
     def padded_waste_bytes(self) -> int:
         return sum(sp.padded_waste_bytes() for sp in self.packs.values())
 
+    def member_names(self) -> list[str]:
+        return [name for sp in self.packs.values() for name in sp.lanes]
+
+    def cache_bytes_per_member(self) -> dict[str, int]:
+        """member index name -> request-cache bytes held under ITS lane
+        scope (PR 19 metering join). Exact, not estimated: superpack
+        cache entries key on (pack token, lane), so the per-tenant byte
+        census is one keyed scan of the node cache."""
+        from ..cache import request_cache
+
+        rc = request_cache()
+        out: dict[str, int] = {}
+        for sp in self.packs.values():
+            by_lane = rc.bytes_by_lane(sp.cache_token)
+            if not by_lane:
+                continue
+            for m in sp.lanes.values():
+                b = by_lane.get(m.lane, 0)
+                if b:
+                    out[m.name] = out.get(m.name, 0) + b
+        return out
+
     def member_stats(self, name: str) -> dict | None:
         """Per-index `_cat/indices` superpack annotation."""
         for sp in self.packs.values():

@@ -434,6 +434,69 @@ def _planner_indicator(engine) -> dict:
             "details": details}
 
 
+def _tenant_fairness_indicator(engine) -> dict:
+    """Noisy-neighbor indicator (PR 19): reads the TenantMeter ledger's
+    exact apportioned device-time burn. Yellow names the hungriest
+    tenant AND its dominant kernel — the operator's first two questions
+    (who, running what) answered from the indicator alone."""
+    meter = engine._metering
+    if meter is None:
+        return {"status": GREEN,
+                "symptom": "No tenant activity metered on this node yet",
+                "details": {"tenant_count": 0}}
+    rows = meter.rows()
+    burn = {t: r["device_ms_per_s"] for t, r in rows.items()}
+    hungriest = max(burn, key=lambda t: (burn[t], t)) if burn else None
+    details = {
+        "tenant_count": len(rows),
+        "hungriest_tenant": hungriest,
+        "hungriest_device_ms_per_s": burn.get(hungriest),
+        "dominant_kernel": (meter.dominant_kernel(hungriest)
+                            if hungriest else None),
+    }
+    try:
+        budget = float(
+            engine.settings.get("slo.tenant.device_ms_per_s") or 0)
+    except Exception:  # noqa: BLE001
+        budget = 0.0
+    if budget > 0 and hungriest is not None \
+            and burn[hungriest] > budget:
+        kern = details["dominant_kernel"]
+        fair = False
+        try:
+            fair = bool(engine.settings.get("planner.tenant.fairshare"))
+        except Exception:  # noqa: BLE001
+            pass
+        return {
+            "status": YELLOW,
+            "symptom": (f"tenant [{hungriest}] is burning "
+                        f"{burn[hungriest]:g} device-ms/s against the "
+                        f"{budget:g} budget"
+                        + (f", dominated by kernel [{kern}]" if kern
+                           else "")),
+            "details": details,
+            "impacts": [_impact(
+                "one tenant's load is consuming an outsized share of "
+                "the shared device wall; neighbors queue behind it",
+                severity=3, areas=["search"])],
+            "diagnosis": [_diagnosis(
+                "the named tenant's exact apportioned share of serving-"
+                "wave device time exceeds slo.tenant.device_ms_per_s",
+                ("fair-share weighting is already throttling it "
+                 "(planner.tenant.fairshare)" if fair else
+                 "enable planner.tenant.fairshare to scale its serving "
+                 "weight down by budget/burn, or raise the budget"),
+                [hungriest])],
+        }
+    return {"status": GREEN,
+            "symptom": (f"Tenant device-time burn within budget across "
+                        f"{len(rows)} metered tenants"
+                        if budget > 0 else
+                        f"{len(rows)} tenants metered (no "
+                        "slo.tenant.device_ms_per_s budget set)"),
+            "details": details}
+
+
 def _slo_indicator(engine) -> dict:
     ev = engine.slo.current()
     if not ev["enabled"]:
@@ -540,6 +603,7 @@ def health_report(engine) -> dict:
     add("data_plane_resilience", _resilience_indicator)
     add("execution_planner", _planner_indicator)
     add("indexing", _indexing_indicator)
+    add("tenant_fairness", _tenant_fairness_indicator)
     add("slo_compliance", _slo_indicator)
     add("watcher", _watcher_indicator)
     indicators["ilm"] = {

@@ -355,6 +355,54 @@ def print_superpack_table(latest: dict, cur_round: int) -> None:
         print(f"  {path:<64} {_fmt(rows[path]):>12}")
 
 
+def tenant_attribution_metrics(record: dict) -> dict:
+    """-> per-arm tenant_attribution leaves (PR 19): the per-tenant
+    device-ms shares plus the in-record exactness witness
+    (sum_shares_over_wall — asserted == 1.0 when the record was made)
+    and the bounded ledger row count. Shares are attribution, not a
+    perf criterion — rendered for the reader, never compared."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "tenant_attribution" and isinstance(v, dict):
+                    base = path + (k,)
+                    for kk in ("waves_checked", "sum_shares_over_wall",
+                               "ledger_rows"):
+                        val = v.get(kk)
+                        if isinstance(val, (int, float)) \
+                                and not isinstance(val, bool):
+                            out[".".join(base + (kk,))] = float(val)
+                    for t, ms in (v.get("per_tenant_device_ms")
+                                  or {}).items():
+                        if isinstance(ms, (int, float)):
+                            out[".".join(base + ("device_ms", t))] = \
+                                float(ms)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+def print_tenant_table(latest: dict, cur_round: int) -> None:
+    """Render the newest record's per-tenant device-ms attribution
+    (PR 19) whenever an arm carries a tenant_attribution block. Purely
+    advisory: the table answers "who burned the chip in this record",
+    the exactness itself was asserted when the record was written."""
+    rows = tenant_attribution_metrics(latest)
+    if not rows:
+        return
+    print(f"[bench-regress] tenant-attribution table (r{cur_round:02d}; "
+          "per-tenant device-ms, Σshares/wall asserted == 1.0 in-record):")
+    for path in sorted(rows):
+        print(f"  {path:<64} {_fmt(rows[path]):>12}")
+
+
 def planner_metrics(record: dict) -> dict:
     """-> C9 adaptive-planner leaves (PR 18): per-routing QPS and p99
     on the shared mixed trace, the planner/best-static QPS ratio, the
@@ -572,6 +620,9 @@ def main(argv=None) -> int:
     print_superpack_table(latest, cur_round)
     # PR 18: the C9 adaptive-planner advisory table for the newest record
     print_planner_table(latest, cur_round)
+    # PR 19: the per-tenant device-ms attribution table for the newest
+    # record (whichever arms recorded one)
+    print_tenant_table(latest, cur_round)
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

@@ -25,6 +25,13 @@ Two stages, both deterministic (seeded schedules, fixed corpora):
   every 200 matches the routed arm's no-fault oracle, and recovery
   returns the routing to fused.
 
+  Stage G — noisy-neighbor tenant flood (PR 19): a greedy tenant
+  saturates the serving queue alongside two light tenants; the light
+  tenants' p99 stays bounded, every shed is charged to the shedding
+  tenant's own ledger row, light-tenant results keep rank parity with
+  the no-flood oracle, per-wave tenant device shares sum EXACTLY to
+  each wave's device segment, and zero breaker reservations leak.
+
 Exit 0 = contract held. Any violation raises (non-zero exit).
 Run by scripts/chaos_gate.sh (advisory stage of tier1_gate.sh).
 """
@@ -558,6 +565,151 @@ def stage_f_planner_repricing() -> dict:
             os.environ["ES_TPU_FUSED"] = prev
 
 
+def stage_g_noisy_neighbor() -> dict:
+    """Stage G (PR 19): noisy-neighbor fairness under a tenant flood. A
+    greedy tenant hammers the serving queue far past its depth alongside
+    two light tenants. Contract: the light tenants' end-to-end p99 stays
+    bounded (weighted RR keeps draining them), every shed lands in the
+    SHEDDING tenant's ledger row (exact attribution, no cross-charging),
+    every completed light search stays rank-identical to the no-flood
+    oracle, per-wave tenant device shares still sum EXACTLY to each
+    wave's device segment, and zero breaker reservations leak."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from elasticsearch_tpu.engine import Engine
+    from elasticsearch_tpu.serving import (
+        ServingRejectedError, reservation_leaks,
+    )
+    from elasticsearch_tpu.tenancy.metering import shares_sum
+
+    e = Engine(None)
+    idx = e.create_index("gchaos", {"properties": {
+        "body": {"type": "text"}}})
+    for i in range(400):
+        idx.index_doc(f"g{i}", {"body": f"stormy w{i % 23} flood"})
+    idx.refresh()
+    svc = e.serving
+    pool = ThreadPoolExecutor(max_workers=1,
+                              thread_name_prefix="chaos-g-engine")
+    svc.bind_executor(pool.submit)
+    svc.set_enabled(True)
+    svc.set_queue_depth(24)
+    svc.set_max_wave(8)
+    svc.set_tenant_weights("light-a:8,light-b:8,greedy:1")
+    meter = e.metering
+    meter.reset_for_tests()
+    try:
+        entry = svc.classify(
+            "gchaos", {"query": {"match": {"body": "stormy"}},
+                       "size": 10}, {})
+        assert entry is not None
+        oracle = svc.submit(dict(entry), tenant="light-a").result(60)
+        oracle_ids = [h["_id"] for h in oracle["hits"]["hits"]]
+        assert len(oracle_ids) == 10, oracle["hits"]
+
+        sheds = {"greedy": 0, "light-a": 0, "light-b": 0}
+        lat: dict = {"light-a": [], "light-b": []}
+        stop = threading.Event()
+        errors: list = []
+        greedy_futs: list = []
+
+        def greedy():
+            while not stop.is_set():
+                try:
+                    greedy_futs.append(
+                        svc.submit(dict(entry), tenant="greedy"))
+                except ServingRejectedError:
+                    sheds["greedy"] += 1
+                    time.sleep(0.002)
+                except Exception as ex:  # noqa: BLE001 - collected
+                    errors.append(ex)
+                    return
+
+        def light(name):
+            for _ in range(25):
+                t0 = time.monotonic()
+                while True:
+                    try:
+                        r = svc.submit(dict(entry),
+                                       tenant=name).result(timeout=60)
+                        break
+                    except ServingRejectedError:
+                        # honest backoff: the shed is charged to THIS
+                        # tenant's ledger row, then the caller retries
+                        sheds[name] += 1
+                        time.sleep(0.01)
+                    except Exception as ex:  # noqa: BLE001 - collected
+                        errors.append(ex)
+                        return
+                lat[name].append((time.monotonic() - t0) * 1000.0)
+                got = [h["_id"] for h in r["hits"]["hits"]]
+                if got != oracle_ids:
+                    errors.append(AssertionError(
+                        f"{name} rows diverged under the flood: {got}"))
+                    return
+
+        gt = threading.Thread(target=greedy)
+        lts = [threading.Thread(target=light, args=(n,))
+               for n in ("light-a", "light-b")]
+        gt.start()
+        for t in lts:
+            t.start()
+        for t in lts:
+            t.join(timeout=120)
+        stop.set()
+        gt.join(timeout=60)
+        done = 0
+        for f in greedy_futs:
+            try:
+                f.result(timeout=60)
+                done += 1
+            except Exception:  # noqa: BLE001 - shed/cancelled greedy work
+                pass
+        assert not errors, errors
+        assert sheds["greedy"] >= 1, \
+            "the flood never saturated the queue"
+        rows = meter.rows()
+        # exact attribution: every shed sits in the ledger row of the
+        # tenant that CAUSED it — the greedy flood cannot cross-charge
+        for t, n in sheds.items():
+            assert rows.get(t, {}).get("sheds", 0) == n, \
+                (t, n, rows.get(t))
+        # the light tenants stay responsive through the flood: bounded
+        # end-to-end p99, queue waits at or below the greedy tenant's
+        p99s = {}
+        for name in ("light-a", "light-b"):
+            ls = sorted(lat[name])
+            assert ls, f"{name} completed no searches"
+            p99s[name] = ls[min(len(ls) - 1, int(0.99 * len(ls)))]
+            assert p99s[name] < 5000.0, \
+                f"{name} p99 {p99s[name]:.0f}ms unbounded under flood"
+            assert (rows[name]["queue_p99_ms"]
+                    <= rows["greedy"]["queue_p99_ms"] + 1e-9), \
+                (name, rows[name]["queue_p99_ms"],
+                 rows["greedy"]["queue_p99_ms"])
+        # per-wave tenant shares still partition the device segment
+        # EXACTLY (==, never approximately) all the way through the flood
+        mixed = 0
+        for w in svc.flight_recorder()["waves"]:
+            mix = w.get("tenants") or {}
+            if len(mix) < 2 or w.get("kind") == "degradation":
+                continue
+            mixed += 1
+            assert shares_sum(v["device_ms"] for v in mix.values()) \
+                == w["segments_ms"]["device"], w
+        assert mixed >= 1, "the flood never produced a mixed wave"
+        leaks = reservation_leaks()
+        assert not leaks, f"breaker reservations leaked: {leaks}"
+        return {"greedy_done": done, "sheds": dict(sheds),
+                "light_p99_ms": {n: round(v, 1) for n, v in p99s.items()},
+                "mixed_waves": mixed}
+    finally:
+        svc.stop()
+        pool.shutdown(wait=True)
+        e.close()
+
+
 def main() -> int:
     print(f"[chaos] seed={SEED} requests={N_REQUESTS}")
     a = stage_a_cluster()
@@ -570,6 +722,8 @@ def main() -> int:
     print(f"[chaos] stage E (superpack fold fault isolation): {ev}")
     f = stage_f_planner_repricing()
     print(f"[chaos] stage F (planner repricing under device OOM): {f}")
+    g = stage_g_noisy_neighbor()
+    print(f"[chaos] stage G (noisy-neighbor tenant flood): {g}")
     print("[chaos] contract held: no hangs, no crashes, every response "
           "complete / valid-partial / clean 429-503")
     return 0

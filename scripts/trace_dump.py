@@ -205,6 +205,24 @@ def render_flight(snap: dict, out=None) -> None:
               f"tr={tr.get('dispatch', 0)}+{tr.get('fetch', 0)} "
               f"tenants={len(w.get('tenants') or {})}"
               f"{' ' + ' '.join(extras) if extras else ''}", file=out)
+        # per-tenant apportionment bar (PR 19): one sub-line per multi-
+        # tenant wave, partitioning the wave's DEVICE segment by each
+        # tenant's exact apportioned share (the shares sum to the device
+        # wall by construction, so the bar covers the segment exactly)
+        mix = w.get("tenants") or {}
+        if len(mix) > 1 and isinstance(next(iter(mix.values())), dict):
+            dev = max(float(seg.get("device", 0.0)), 1e-9)
+            tbar, parts = "", []
+            glyphs = "▆▄▂▇▅▃▁"
+            order = sorted(mix, key=lambda t: -mix[t].get("device_ms", 0.0))
+            for i, t in enumerate(order):
+                share = float(mix[t].get("device_ms", 0.0))
+                g = glyphs[i % len(glyphs)]
+                tbar += g * int(round(BAR_WIDTH * share / dev))
+                parts.append(f"{g} {t}={share:.2f}ms")
+            tbar = (tbar + "·" * BAR_WIDTH)[:BAR_WIDTH]
+            print(f"  [{tbar}] device split: {'  '.join(parts)}",
+                  file=out)
 
 
 # ---------------------------------------------------------------------------

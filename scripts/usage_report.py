@@ -235,6 +235,45 @@ def render_indexing(per_node: dict[str, dict], out=None) -> None:
     print(file=out)
 
 
+def render_tenants(per_node: dict[str, dict], out=None) -> None:
+    """Per-tenant resource ledger (PR 19): the newest node_stats
+    `tenants` section per node — who is burning the shared device, what
+    they queued for, and what they shed — straight from the exact
+    apportionment ledger the metering subsystem writes into the TSDB."""
+    out = out or sys.stdout
+    print("tenants (resource ledger)", file=out)
+    any_rows = False
+    for node in sorted(per_node):
+        tenants = (per_node[node].get("node_stats") or {}) \
+            .get("tenants") or {}
+        if not tenants:
+            continue
+        any_rows = True
+        print(f"  {node}:", file=out)
+        rows = [("tenant", "reqs", "device_ms", "ms/s", "queue_p99",
+                 "sheds", "cache h/m", "ingest")]
+        order = sorted(tenants,
+                       key=lambda t: -float(tenants[t]
+                                            .get("device_ms", 0.0)))
+        for t in order:
+            r = tenants[t]
+            rows.append((t, str(int(r.get("requests", 0))),
+                         f"{r.get('device_ms', 0.0):.1f}",
+                         f"{r.get('device_ms_per_s', 0.0):.2f}",
+                         f"{r.get('queue_p99_ms', 0.0):.1f}ms",
+                         str(int(r.get("sheds", 0))),
+                         f"{int(r.get('cache_hits', 0))}/"
+                         f"{int(r.get('cache_misses', 0))}",
+                         _fmt_bytes(r.get("ingest_bytes", 0))))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        for r in rows:
+            print("    " + "  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  .rstrip(), file=out)
+    if not any_rows:
+        print("  (no tenant ledger samples in the window)", file=out)
+    print(file=out)
+
+
 def slo_alert_summary(docs: list[dict], alerts: list[dict],
                       history: list[dict]) -> dict:
     """SLO compliance over the window (per-node fraction of node_stats
@@ -332,6 +371,7 @@ def main(argv=None) -> int:
     else:
         render(per_node)
         render_indexing(indexing)
+        render_tenants(per_node)
         render_slo(summary)
     return 0
 

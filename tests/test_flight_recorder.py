@@ -82,11 +82,14 @@ def test_flight_recorder_records_waves_with_attribution(served):
     rec = snap["waves"][-1]
     total = sum(w["size"] for w in snap["waves"])
     assert total == len(_bodies())
-    # tenant mix + lane breakdown + transitions are in-record
+    # tenant mix + lane breakdown + transitions are in-record (PR 19:
+    # each tenant entry carries its request count AND its exact
+    # apportioned share of the wave's device segment)
     all_tenants: dict = {}
     for w in snap["waves"]:
-        for t, n in w["tenants"].items():
-            all_tenants[t] = all_tenants.get(t, 0) + n
+        for t, v in w["tenants"].items():
+            all_tenants[t] = all_tenants.get(t, 0) + v["requests"]
+            assert v["device_ms"] >= 0.0 and 0.0 <= v["share"] <= 1.0
     assert set(all_tenants) == {"tA", "tB"}
     assert rec["indices"] == ["idx"]
     lanes = rec["lanes"]
