@@ -84,6 +84,15 @@ class CircuitBreakerService:
             "model_inference": ChildBreaker(
                 "model_inference",
                 parse_bytes(limits.get("model_inference", "50%"), self.total)),
+            # transient ESQL whole-column materializations (PR 20,
+            # esql/profile.py): each pipe stage's live table bytes are
+            # charged here as a running delta, so an oversized
+            # FROM|STATS trips a 429 naming the dominant operator
+            # instead of OOMing the node
+            "esql.materialization": ChildBreaker(
+                "esql.materialization",
+                parse_bytes(limits.get("esql.materialization", "40%"),
+                            self.total)),
         }
         self.parent_trip_count = 0
         self._steady: dict[tuple[str, str], int] = {}
@@ -110,7 +119,9 @@ class CircuitBreakerService:
                     f"[{new_used}/{new_used}b], which is larger than the limit of "
                     f"[{cb.limit}/{cb.limit}b]",
                     bytes_wanted=new_used, bytes_limit=cb.limit,
-                    durability="TRANSIENT" if child == "request" else "PERMANENT",
+                    durability=("TRANSIENT"
+                                if child in ("request", "esql.materialization")
+                                else "PERMANENT"),
                 )
             parent_new = self._parent_used() + max(n_bytes, 0)
             if n_bytes > 0 and parent_new > self.parent_limit:

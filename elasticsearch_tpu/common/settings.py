@@ -217,6 +217,11 @@ def default_cluster_settings() -> list[Setting]:
                 dynamic=True),
         Setting("indices.breaker.model_inference.limit", "50%", str,
                 dynamic=True),
+        # PR 20: transient ESQL whole-column materializations
+        # (esql/profile.py charges each pipe stage's live table bytes;
+        # trip -> 429 naming the dominant operator, never a node OOM)
+        Setting("indices.breaker.esql.materialization.limit", "40%", str,
+                dynamic=True),
         # remote clusters for CCS; the seed is the remote's HTTP endpoint
         # (this framework's transport IS HTTP — reference 9300 seeds analog)
         Setting("cluster.remote.*", None, lambda v: v, dynamic=True),
@@ -282,6 +287,13 @@ def default_cluster_settings() -> list[Setting]:
         Setting("slo.tenant.queue_p99_ms", 0.0, Setting.float_,
                 dynamic=True),
         Setting("slo.tenant.shed_rate", 0.0, Setting.float_, dynamic=True),
+        # PR 20: ESQL dataflow objectives over the per-operator profile
+        # substrate (esql/profile.py) — query p99 and the peak live
+        # materialized-bytes high-water the item-5 paged port must
+        # drive below one materialization budget. Breaches name the
+        # dominant operator. 0 disables.
+        Setting("slo.esql.p99_ms", 0.0, Setting.float_, dynamic=True),
+        Setting("slo.esql.peak_bytes", 0.0, Setting.float_, dynamic=True),
         Setting("slo.custom", "", str, dynamic=True),
         # adaptive execution planner (PR 18, planner/): cost-model-driven
         # arm selection — predicted wall = analytic cost / measured

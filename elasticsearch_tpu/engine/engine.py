@@ -2122,6 +2122,7 @@ class Engine:
         self._slo = None
         self._profiler = None
         self._refresh_recorder = None
+        self._esql_recorder = None
         self._device_degradation = None
         self._metering = None
         self.meta = MetadataStore(data_path)
@@ -2138,12 +2139,16 @@ class Engine:
             "request": self.settings.get("indices.breaker.request.limit"),
             "model_inference": self.settings.get(
                 "indices.breaker.model_inference.limit"),
+            "esql.materialization": self.settings.get(
+                "indices.breaker.esql.materialization.limit"),
         })
         for key, child in (("indices.breaker.total.limit", "total"),
                            ("indices.breaker.fielddata.limit", "fielddata"),
                            ("indices.breaker.request.limit", "request"),
                            ("indices.breaker.model_inference.limit",
-                            "model_inference")):
+                            "model_inference"),
+                           ("indices.breaker.esql.materialization.limit",
+                            "esql.materialization")):
             self.settings.add_consumer(
                 key, lambda raw, c=child: self.breakers.set_limit(c, raw)
             )
@@ -2446,6 +2451,17 @@ class Engine:
                 "indexing.profile.size",
                 self._refresh_recorder.set_size)
         return self._refresh_recorder
+
+    @property
+    def esql_recorder(self):
+        """ESQL query-profile ring (esql/profile.py, PR 20): per-engine
+        for the same reason as the refresh recorder — in-process
+        multi-node fixtures must never mix nodes' query streams."""
+        from ..esql.profile import EsqlRecorder
+
+        if self._esql_recorder is None:
+            self._esql_recorder = EsqlRecorder()
+        return self._esql_recorder
 
     def indexing_stats(self) -> dict:
         """The `_nodes/stats` `indexing` section: refresh/merge counts +

@@ -546,7 +546,15 @@ def _run_enrich(engine, t: Table, payload: dict) -> Table:
 
 # ---- driver ---------------------------------------------------------------
 
-def execute(engine, query: str, mesh=None) -> Table:
+def execute(engine, query: str, mesh=None, profile=None, task=None) -> Table:
+    """Drive the pipe stages. `profile` is an esql.profile.OperatorProfile
+    (always present under esql_query; None for library callers — zero
+    overhead then); `task` is a cancellable tasks-API task, checked on
+    every operator boundary so cancellation does no further operator
+    work. Each stage runs under a TRACER span (esql.<operator>) so
+    POST /_query produces a span tree at GET /_trace/{id}."""
+    from ..telemetry import TRACER
+
     stages = parse(query)
     t: Table | None = None
     shard_of = None
@@ -554,123 +562,177 @@ def execute(engine, query: str, mesh=None) -> Table:
     while si < len(stages):
         kind, payload = stages[si]
         si += 1
+        if task is not None:
+            task.ensure_not_cancelled()
+        rows_in = 0 if t is None else t.nrows
+        # resolve the operator name BEFORE running the stage: the fused
+        # SORT|LIMIT and the device-vs-host STATS split are named
+        # differently in profiles (reference: TopNOperator vs
+        # ValuesSourceReader + exchange operators)
+        op = "collect" if kind == "from" else kind
+        fused_limit = None
         if kind == "sort" and si < len(stages) and stages[si][0] == "limit":
             # SORT|LIMIT fuses into the sharded top-n exchange when rows
             # still map to shards: per-shard device top-n + rank-key
             # all-gather merge (esql/topn.py; reference TopNOperator +
             # ExchangeService) — bit-identical to the host sort+limit
-            from .topn import supported_topn, topn_exchange
+            from .topn import supported_topn
 
-            limit = stages[si][1]
             if (shard_of is not None and len(shard_of) == t.nrows
                     and t.nrows > 0 and supported_topn(payload, t)):
-                sel = topn_exchange(t, shard_of, payload, limit, mesh=mesh)
-                t = t.take(sel)
-                shard_of = shard_of[sel]
+                fused_limit = stages[si][1]
                 si += 1  # the limit stage is consumed by the exchange
-                continue
-        if kind == "from":
-            t = _collect_table(engine, ",".join(payload["indices"]),
-                               payload["metadata"])
-            shard_of = t.shard_of
-        elif kind == "row":
-            cols = {}
-            for name, expr in payload:
-                one = Table({}, 1)
-                cols[name] = _eval_expr(expr, one)
-            t = Table(cols, 1)
-        elif kind == "where":
-            mask = _eval_expr(payload, t).values.astype(bool)
-            keep_idx = np.flatnonzero(mask)
-            t = t.take(keep_idx)
-            if shard_of is not None:
-                shard_of = shard_of[keep_idx]
-        elif kind == "eval":
-            for name, expr in payload:
-                t.columns[name] = _eval_expr(expr, t)
+                op = "topn_exchange"
         elif kind == "stats":
-            from .exchange import stats_exchange, supported_stats
+            from .exchange import supported_stats
 
             if (shard_of is not None and len(shard_of) == t.nrows
                     and t.nrows > 0 and supported_stats(payload, t)):
-                t = stats_exchange(t, shard_of, payload["aggs"],
-                                   payload["by"], mesh=mesh)
-            else:
-                t = _run_stats(t, payload["aggs"], payload["by"])
-            shard_of = None
-        elif kind == "sort":
-            order = np.arange(t.nrows)
-            for name, desc, nulls_first in reversed(payload):
-                c = t.columns.get(name)
-                if c is None:
-                    raise IllegalArgumentError(f"Unknown column [{name}]")
-                vals = c.values[order]
-                nulls = c.null[order]
-                # desc sorts on an inverted key (reversing a stable argsort
-                # would flip tie order and break secondary sort keys)
-                if c.type == "keyword":
-                    key = np.array([("" if v is None else str(v)) for v in vals])
-                    if desc:
-                        uniq = np.unique(key)
-                        inv = np.searchsorted(uniq, key)
-                        rank = np.argsort(-inv, kind="stable")
-                    else:
-                        rank = np.argsort(key, kind="stable")
-                elif np.asarray(vals).dtype.kind in "iu":
-                    # longs sort on exact int64 (a float64 key would merge
-                    # values above 2^53 into one tie — and diverge from
-                    # the exact topn exchange); desc via bitwise-not,
-                    # which reverses int64 order without the overflow of
-                    # negating INT64_MIN
-                    ikey = np.asarray(vals, np.int64)
-                    rank = np.argsort(~ikey if desc else ikey,
-                                      kind="stable")
-                else:
-                    nkey = np.asarray(vals, np.float64)
-                    rank = np.argsort(-nkey if desc else nkey, kind="stable")
-                # nulls ordering: default nulls last (asc), first (desc)
-                nf = nulls_first if nulls_first is not None else desc
-                nn = nulls[rank]
-                rank = np.concatenate([rank[nn], rank[~nn]] if nf
-                                      else [rank[~nn], rank[nn]])
-                order = order[rank]
-            t = t.take(order)
-            if shard_of is not None:
-                shard_of = shard_of[order]
-        elif kind == "limit":
-            t = t.take(np.arange(min(payload, t.nrows)))
-            if shard_of is not None:
-                shard_of = shard_of[: t.nrows]
-        elif kind == "keep":
-            keep = []
-            for pat in payload:
-                for name in t.columns:
-                    if fnmatch.fnmatchcase(name, pat) and name not in keep:
-                        keep.append(name)
-            t = Table({n: t.columns[n] for n in keep}, t.nrows)
-        elif kind == "drop":
-            for pat in payload:
-                for name in [n for n in t.columns if fnmatch.fnmatchcase(n, pat)]:
-                    del t.columns[name]
-        elif kind in ("dissect", "grok"):
-            t = _run_extract(t, kind, payload)
-        elif kind == "enrich":
-            t = _run_enrich(engine, t, payload)
-        elif kind == "rename":
-            for old, new in payload:
-                if old not in t.columns:
-                    raise IllegalArgumentError(f"Unknown column [{old}]")
-                t.columns = {
-                    (new if n == old else n): c for n, c in t.columns.items()
-                }
+                op = "stats_exchange"
+        with TRACER.span(f"esql.{op}", rows_in=int(rows_in)) as span:
+            t, shard_of = _run_stage(engine, kind, op, payload, t, shard_of,
+                                     fused_limit, mesh)
+            span.attributes["rows_out"] = 0 if t is None else int(t.nrows)
+        if profile is not None:
+            profile.note(op, rows_in, t)
     return t
 
 
-def esql_query(engine, body: dict) -> dict:
+def _run_stage(engine, kind, op, payload, t, shard_of, fused_limit, mesh):
+    """One pipe stage -> (table, shard_of)."""
+    if op == "topn_exchange":
+        from .topn import topn_exchange
+
+        sel = topn_exchange(t, shard_of, payload, fused_limit, mesh=mesh)
+        return t.take(sel), shard_of[sel]
+    if kind == "from":
+        t = _collect_table(engine, ",".join(payload["indices"]),
+                           payload["metadata"])
+        return t, t.shard_of
+    if kind == "row":
+        cols = {}
+        for name, expr in payload:
+            one = Table({}, 1)
+            cols[name] = _eval_expr(expr, one)
+        return Table(cols, 1), shard_of
+    if kind == "where":
+        mask = _eval_expr(payload, t).values.astype(bool)
+        keep_idx = np.flatnonzero(mask)
+        t = t.take(keep_idx)
+        if shard_of is not None:
+            shard_of = shard_of[keep_idx]
+        return t, shard_of
+    if kind == "eval":
+        for name, expr in payload:
+            t.columns[name] = _eval_expr(expr, t)
+        return t, shard_of
+    if kind == "stats":
+        if op == "stats_exchange":
+            from .exchange import stats_exchange
+
+            t = stats_exchange(t, shard_of, payload["aggs"],
+                               payload["by"], mesh=mesh)
+        else:
+            t = _run_stats(t, payload["aggs"], payload["by"])
+        return t, None
+    if kind == "sort":
+        order = np.arange(t.nrows)
+        for name, desc, nulls_first in reversed(payload):
+            c = t.columns.get(name)
+            if c is None:
+                raise IllegalArgumentError(f"Unknown column [{name}]")
+            vals = c.values[order]
+            nulls = c.null[order]
+            # desc sorts on an inverted key (reversing a stable argsort
+            # would flip tie order and break secondary sort keys)
+            if c.type == "keyword":
+                key = np.array([("" if v is None else str(v)) for v in vals])
+                if desc:
+                    uniq = np.unique(key)
+                    inv = np.searchsorted(uniq, key)
+                    rank = np.argsort(-inv, kind="stable")
+                else:
+                    rank = np.argsort(key, kind="stable")
+            elif np.asarray(vals).dtype.kind in "iu":
+                # longs sort on exact int64 (a float64 key would merge
+                # values above 2^53 into one tie — and diverge from
+                # the exact topn exchange); desc via bitwise-not,
+                # which reverses int64 order without the overflow of
+                # negating INT64_MIN
+                ikey = np.asarray(vals, np.int64)
+                rank = np.argsort(~ikey if desc else ikey,
+                                  kind="stable")
+            else:
+                nkey = np.asarray(vals, np.float64)
+                rank = np.argsort(-nkey if desc else nkey, kind="stable")
+            # nulls ordering: default nulls last (asc), first (desc)
+            nf = nulls_first if nulls_first is not None else desc
+            nn = nulls[rank]
+            rank = np.concatenate([rank[nn], rank[~nn]] if nf
+                                  else [rank[~nn], rank[nn]])
+            order = order[rank]
+        t = t.take(order)
+        if shard_of is not None:
+            shard_of = shard_of[order]
+        return t, shard_of
+    if kind == "limit":
+        t = t.take(np.arange(min(payload, t.nrows)))
+        if shard_of is not None:
+            shard_of = shard_of[: t.nrows]
+        return t, shard_of
+    if kind == "keep":
+        keep = []
+        for pat in payload:
+            for name in t.columns:
+                if fnmatch.fnmatchcase(name, pat) and name not in keep:
+                    keep.append(name)
+        return Table({n: t.columns[n] for n in keep}, t.nrows), shard_of
+    if kind == "drop":
+        for pat in payload:
+            for name in [n for n in t.columns if fnmatch.fnmatchcase(n, pat)]:
+                del t.columns[name]
+        return t, shard_of
+    if kind in ("dissect", "grok"):
+        return _run_extract(t, kind, payload), shard_of
+    if kind == "enrich":
+        return _run_enrich(engine, t, payload), shard_of
+    if kind == "rename":
+        for old, new in payload:
+            if old not in t.columns:
+                raise IllegalArgumentError(f"Unknown column [{old}]")
+            t.columns = {
+                (new if n == old else n): c for n, c in t.columns.items()
+            }
+        return t, shard_of
+    return t, shard_of
+
+
+def esql_query(engine, body: dict, task=None) -> dict:
+    """POST /_query: drive the pipe under an OperatorProfile (always —
+    the breaker, metrics, recorder, and tenant attribution hold for
+    every query; `"profile": true` additionally returns the profile
+    body), with cancellation checked between operators."""
+    from ..telemetry import TRACER
+    from .profile import OperatorProfile, recorder_for
+
     query = (body or {}).get("query")
     if not isinstance(query, str):
         raise IllegalArgumentError("[query] string is required")
-    t = execute(engine, query)
+    prof = OperatorProfile(query, breakers=getattr(engine, "breakers", None))
+    rec = recorder_for(engine)
+    try:
+        with TRACER.span("esql.query", query=query[:200]):
+            t = execute(engine, query, profile=prof, task=task)
+    except BaseException as exc:
+        from ..common.breaker import CircuitBreakingError
+
+        summary = prof.finish()  # releases reservations; contiguity holds
+        rec.record(summary, tripped=isinstance(exc, CircuitBreakingError))
+        _note_query_metrics(engine, summary)
+        raise
+    summary = prof.finish()
+    rec.record(summary)
+    _note_query_metrics(engine, summary)
     columns = [{"name": n, "type": c.type} for n, c in t.columns.items()]
     values = []
     for i in range(t.nrows):
@@ -686,4 +748,53 @@ def esql_query(engine, body: dict) -> dict:
                     v = None
                 row.append(v)
         values.append(row)
-    return {"columns": columns, "values": values}
+    out = {"took": int(summary["wall_ms"]), "columns": columns,
+           "values": values}
+    if (body or {}).get("profile"):
+        out["profile"] = {k: summary[k] for k in
+                          ("query", "wall_ms", "rows", "peak_live_bytes",
+                           "dominant_operator", "drivers")}
+    return out
+
+
+def _note_query_metrics(engine, summary: dict) -> None:
+    """Per-query accounting: the es.esql.* histograms/counters plus the
+    TenantMeter apportionment (PR-19 contract — ESQL walls flow through
+    the SAME ledger as serving waves, no parallel accounting; the
+    per-operator walls ride as kernel weights so dominant_kernel IS the
+    query's dominant operator). Never fails a query."""
+    from ..telemetry import metrics
+
+    try:
+        metrics.counter_inc("es.esql.queries")
+        metrics.histogram_record("es.esql.query_ms", summary["wall_ms"])
+        metrics.histogram_record("es.esql.rows", float(summary["rows"]))
+        metrics.histogram_record("es.esql.peak_bytes",
+                                 float(summary["peak_live_bytes"]))
+        per_op: dict[str, float] = {}
+        bytes_total = 0.0
+        for d in summary["drivers"]:
+            for o in d["operators"]:
+                per_op[o["operator"]] = (per_op.get(o["operator"], 0.0)
+                                         + o["took_ms"])
+                bytes_total += float(o["bytes_materialized"])
+        for name, ms in per_op.items():
+            metrics.counter_inc(f"es.esql.operator_ms.{name}", ms)
+    except Exception:  # noqa: BLE001 - accounting never fails a query
+        return
+    try:
+        meter = getattr(engine, "metering", None)
+        wall = summary["wall_ms"]
+        if meter is not None and wall > 0.0:
+            from ..tenancy.metering import normalize_tenant
+            from ..telemetry import current_trace
+
+            tr = current_trace()
+            tenant = normalize_tenant(tr.task_id if tr is not None else None)
+            meter.record_wave(
+                {tenant: wall}, requests={tenant: 1},
+                cost={tenant: {"flops": 0.0, "bytes": bytes_total,
+                               "kernels": {f"esql.{k}": v
+                                           for k, v in per_op.items()}}})
+    except Exception:  # noqa: BLE001 - attribution never fails a query
+        return

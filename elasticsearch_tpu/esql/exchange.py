@@ -296,11 +296,15 @@ def stats_exchange(
 
     import jax.numpy as jnp  # noqa: F811 (local alias for clarity above)
 
-    pc, row_cnt, lf, li = jax.device_get(
-        fn(jnp.asarray(g_pad), jnp.asarray(cols_stack),
-           jnp.asarray(oks_stack), jnp.asarray(lv_stack),
-           jnp.asarray(lh_stack), jnp.asarray(lok_stack))
-    )
+    from ..telemetry import time_kernel
+
+    with time_kernel("esql.stats_exchange", shards=S, rows=R, groups=G,
+                     dbl_cols=len(dbl_cols), long_cols=len(long_cols)):
+        pc, row_cnt, lf, li = jax.device_get(
+            fn(jnp.asarray(g_pad), jnp.asarray(cols_stack),
+               jnp.asarray(oks_stack), jnp.asarray(lv_stack),
+               jnp.asarray(lh_stack), jnp.asarray(lok_stack))
+        )
 
     # ---- finalize --------------------------------------------------------
     dcol_of = {c: i for i, c in enumerate(dbl_cols)}

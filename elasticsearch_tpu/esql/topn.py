@@ -160,11 +160,14 @@ def topn_exchange(
             )(ops)
             return out[0][K]
 
-        sel = jax.jit(run)(jnp.asarray(kpad))
     else:
         def run(ops):
             return merge(jax.vmap(shard_top)(ops))[K]
 
+    from ..telemetry import time_kernel
+
+    with time_kernel("esql.topn_exchange", shards=S, rows=R, keys=K,
+                     n=n_eff):
         sel = jax.jit(run)(jnp.asarray(kpad))
-    sel = np.asarray(jax.device_get(sel), np.int64)
+        sel = np.asarray(jax.device_get(sel), np.int64)
     return sel[sel != _I64_MAX][:n]

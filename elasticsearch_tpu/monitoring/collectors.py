@@ -187,6 +187,33 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
             }
     except Exception:  # noqa: BLE001
         pass
+    # ESQL dataflow ground truth (PR 20): the per-operator recorder's
+    # cumulative walls, materialization high-water marks, and breaker
+    # trips land in the TSDB so operator-level ESQL history (the item-5
+    # paged-operator substrate) is queryable from any node. Operator
+    # keys are the fixed pipe-stage vocabulary plus "driver" — bounded;
+    # dots sanitized like stage_ms above.
+    esql_doc = {}
+    try:
+        from ..esql.profile import recorder_for
+
+        est = recorder_for(engine).stats()
+        esql_h = snap["histograms"].get("es.esql.query_ms") or {}
+        esql_doc = {
+            "queries": est.get("queries", 0),
+            "rows_total": est.get("rows_total", 0),
+            "peak_bytes_hwm": est.get("peak_bytes_hwm", 0),
+            "peak_bytes_last": est.get("peak_bytes_last", 0),
+            "breaker_trips": est.get("breaker_trips", 0),
+            "dominant_operator": est.get("dominant_operator") or "",
+            "query_ms_p50": esql_h.get("p50", 0.0),
+            "query_ms_p99": esql_h.get("p99", 0.0),
+            "operator_ms": {k.replace(".", "_"): v
+                            for k, v in
+                            (est.get("operator_ms") or {}).items()},
+        }
+    except Exception:  # noqa: BLE001
+        pass
     try:
         ev = engine.slo.evaluate()
         slo_doc = {
@@ -277,6 +304,7 @@ def collect_node_stats(engine, node_name: str, now: float | None = None) -> dict
             },
             "planner": planner_doc,
             "tenants": tenants_doc,
+            "esql": esql_doc,
         },
     }
 

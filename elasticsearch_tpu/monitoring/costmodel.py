@@ -31,6 +31,7 @@ the accounting — a deliberate choice, not a missing model.
 
 from __future__ import annotations
 
+import math
 import os
 
 # ---------------------------------------------------------------------------
@@ -645,6 +646,49 @@ def _build_analyze(fields: dict) -> dict | None:
     return analyze_build_cost(int(nbytes))
 
 
+def _esql_stats_exchange(fields: dict) -> dict | None:
+    """STATS partial-aggregation exchange (esql/exchange.py): per-shard
+    one-hot [G,R]x[R] matmul partials per value view (double columns one
+    view; long columns ship i64 + hi/lo f64 = 3 views; the bare count
+    rides the group one-hot), then the [S,...] collective merge. Useful
+    work only — the padded R already prices the padding the layout pays,
+    matching the dense-matmul convention of vector.knn_scan."""
+    s, r, g = fields.get("shards"), fields.get("rows"), fields.get("groups")
+    if not (s and r and g):
+        return None
+    s, r, g = int(s), int(r), int(g)
+    dc = int(fields.get("dbl_cols", 0))
+    lc = int(fields.get("long_cols", 0))
+    views = dc + 3 * lc + 1
+    flops = 2.0 * s * r * g * views
+    bytes_ = (
+        s * r * (4.0                      # group ordinals (i32)
+                 + 9.0 * dc               # f64 values + ok mask
+                 + 33.0 * lc)             # i64 + hi/lo f64 + ok mask
+        + s * g * 8.0 * (4.0 * max(dc, 1) + 2.0 * lc)  # partial outputs
+    )
+    return {"flops": flops, "bytes": bytes_}
+
+
+def _esql_topn_exchange(fields: dict) -> dict | None:
+    """SORT|LIMIT top-n exchange (esql/topn.py): per-shard lexicographic
+    lax.sort over K encoded rank keys + the row index, then the gathered
+    re-sort of S*n winners. Sort flops priced as comparator work
+    ~ rows*log2(rows) per key lane (the sharded.global_merge sort
+    convention); bytes move each [K+1] key lane once in and once out."""
+    s, r = fields.get("shards"), fields.get("rows")
+    if not (s and r):
+        return None
+    s, r = int(s), int(r)
+    k1 = int(fields.get("keys", 1)) + 1
+    n = int(fields.get("n", 1)) or 1
+    lg = max(math.log2(max(r, 2)), 1.0)
+    lgm = max(math.log2(max(s * n, 2)), 1.0)
+    flops = 2.0 * s * k1 * r * lg + 2.0 * k1 * (s * n) * lgm
+    bytes_ = 2.0 * 8.0 * k1 * (s * r + s * n)
+    return {"flops": flops, "bytes": bytes_}
+
+
 # name -> cost fn (None = wrapper span; inner kernels carry the cost).
 # Keys are the literal time_kernel(...) names at the dispatch sites —
 # the tier-1 lint (tests/test_monitoring.py) enforces the bijection.
@@ -702,6 +746,11 @@ KERNEL_COSTS: dict[str, object] = {
     # PR 16: batch text analysis — the former host `analyze` wall as a
     # costed dispatch (bytes-based; analysis/batched.analyze_burst)
     "build.analyze": _build_analyze,
+    # PR 20: the ESQL device exchanges (esql/exchange.py, esql/topn.py) —
+    # the only device dispatches in the whole pipe; host operators are
+    # profiled by esql/profile.py and exempt here by design
+    "esql.stats_exchange": _esql_stats_exchange,
+    "esql.topn_exchange": _esql_topn_exchange,
 }
 
 

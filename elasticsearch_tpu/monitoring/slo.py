@@ -94,6 +94,7 @@ class SloEngine:
             objectives.extend(self._write_objectives())
             objectives.extend(self._planner_objectives())
             objectives.extend(self._tenant_objectives())
+            objectives.extend(self._esql_objectives(snap))
             objectives.extend(self._custom_objectives(snap))
         breached = [o["id"] for o in objectives if o["status"] == "breached"]
         out = {
@@ -367,6 +368,45 @@ class SloEngine:
                 + (f" (worst tenant [{t}])" if t else ""),
                 round(v, 4) if v is not None else None, shed_max,
                 None if v is None else v > shed_max, "max"))
+        return out
+
+    def _esql_objectives(self, snap) -> list[dict]:
+        """ESQL dataflow floors (PR 20): the per-operator profile in
+        esql/profile.py gives every query an exact wall decomposition and
+        a materialization-bytes high-water mark; these objectives put
+        ceilings on both. Breach descriptions name the DOMINANT operator
+        from the recorder's cumulative per-operator walls, so the
+        slo-compliance watch and the esql_dataflow health indicator point
+        at the pipe stage to fix, not just the symptom. Both default to 0
+        (disabled)."""
+        p99_max = float(self._get("slo.esql.p99_ms", 0) or 0)
+        peak_max = float(self._get("slo.esql.peak_bytes", 0) or 0)
+        if p99_max <= 0 and peak_max <= 0:
+            return []
+        from ..esql.profile import recorder_for
+
+        st = recorder_for(self.engine).stats()
+        dom = st.get("dominant_operator")
+        dom_note = (f" (dominant operator [{dom}])" if dom
+                    else " (no profiled queries yet)")
+        out = []
+        if p99_max > 0:
+            h = snap["histograms"].get("es.esql.query_ms")
+            measured = (round(h["p99"], 3)
+                        if h and h.get("count") else None)
+            out.append(_objective(
+                "esql-p99-latency", "esql",
+                f"ESQL query p99 latency <= {p99_max:g}ms" + dom_note,
+                measured, p99_max,
+                None if measured is None else measured > p99_max, "max"))
+        if peak_max > 0:
+            measured = st.get("peak_bytes_hwm") or None
+            out.append(_objective(
+                "esql-peak-bytes", "esql",
+                f"ESQL peak live materialization <= {peak_max:g} bytes"
+                + dom_note,
+                measured, peak_max,
+                None if measured is None else measured > peak_max, "max"))
         return out
 
     def _custom_objectives(self, snap) -> list[dict]:

@@ -182,6 +182,23 @@ XLA_CHECKS: dict[str, dict] = {
                   "oracle by tests/test_batched_analysis.py — stronger "
                   "than a cost cross-check; the batched host basis has "
                   "no compiled executable to introspect"},
+    # PR 20: the ESQL exchange dispatches — per-query inline jits with
+    # no caller-visible executable cache to wire check_dispatch through
+    "esql.stats_exchange": {
+        "status": "exempt",
+        "reason": "PR 20: per-query jit built from the pipe's agg shape "
+                  "(no caller-visible executable cache); the one-hot "
+                  "matmul partials share the dense-matmul parity anchor "
+                  "(vector.knn_scan), and the exchange output is "
+                  "asserted bit-identical to the host _run_stats "
+                  "evaluator by tests/test_esql_exchange.py"},
+    "esql.topn_exchange": {
+        "status": "exempt",
+        "reason": "PR 20: per-query jit over the encoded rank keys; the "
+                  "lax.sort comparator convention is cross-checked via "
+                  "sharded.global_merge, and the selection is asserted "
+                  "bit-identical to the host sort+limit by "
+                  "tests/test_esql_topn.py"},
 }
 
 

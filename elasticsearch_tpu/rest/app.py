@@ -1380,10 +1380,22 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
 
     @handler
     async def esql_api(request):
+        # PR 20: every ESQL query is a registered cancellable task —
+        # cancellation is checked between pipe operators, so POST
+        # /_tasks/{id}/_cancel stops a running pipeline at the next
+        # stage boundary and the 400 carries `cancelled: true`
         from ..esql import esql_query
 
         body = await body_json(request, {}) or {}
-        return web.json_response(await call(esql_query, engine, body))
+        task = engine.tasks.register(
+            "indices:data/read/esql",
+            f"esql[{str(body.get('query') or '')[:120]}]",
+            cancellable=True)
+        try:
+            return web.json_response(
+                await call(esql_query, engine, body, task=task))
+        finally:
+            engine.tasks.unregister(task)
 
     @handler
     async def sql_api(request):
@@ -2658,6 +2670,11 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                         # sheds, cache + ingest traffic per tenant,
                         # bounded at metering.tenant.top_k rows + _other
                         "tenants": engine.tenant_stats(),
+                        # ESQL dataflow ground truth (PR 20): cumulative
+                        # per-operator walls, rows, materialization
+                        # high-water marks and esql.materialization
+                        # breaker trips from the per-query profiler
+                        "esql": engine.esql_recorder.stats(),
                         "metrics": metrics.snapshot(),
                         # tail-latency inspection without log scraping:
                         # the most recent slowlog entries (now carrying
@@ -2696,6 +2713,17 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
         n = request.query.get("n")
         return web.json_response(
             engine.refresh_recorder.profiles(int(n) if n else None))
+
+    @handler
+    async def esql_profile(request):
+        """GET /_esql/profile: the bounded per-query OperatorProfile
+        ring — contiguous per-operator timings summing exactly to each
+        query's wall time, rows/pages in/out, bytes materialized per
+        column, peak-live-bytes high-water and the dominant operator
+        (PR 20, the ESQL twin of GET /_refresh/profile)."""
+        n = request.query.get("n")
+        return web.json_response(
+            engine.esql_recorder.profiles(int(n) if n else None))
 
     @handler
     async def serving_flight_recorder(request):
@@ -2962,6 +2990,25 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
                                         "samples": samples}
         except Exception:  # noqa: BLE001 - the scrape must not 500
             pass
+        # ESQL dataflow (PR 20): per-operator cumulative walls as a
+        # labeled family — cardinality is hard-bounded by the fixed
+        # pipe-stage vocabulary (collect/where/eval/stats_exchange/
+        # topn_exchange/... + driver), never by query content
+        try:
+            est = engine.esql_recorder.stats()
+            extra["es.esql.peak_bytes_hwm"] = est.get("peak_bytes_hwm", 0)
+            extra["es.esql.breaker_trips"] = est.get("breaker_trips", 0)
+            op_samples = [({"operator": k}, v) for k, v in
+                          sorted((est.get("operator_ms") or {}).items())]
+            if op_samples:
+                labeled["es_esql_operator_ms_total"] = {
+                    "kind": "counter",
+                    "help": "cumulative ESQL per-operator wall ms "
+                            "(contiguous segments; per query they sum "
+                            "exactly to the query wall)",
+                    "samples": op_samples}
+        except Exception:  # noqa: BLE001 - the scrape must not 500
+            pass
         return web.Response(
             text=metrics.prometheus_text(extra, labeled=labeled),
             content_type="text/plain", charset="utf-8",
@@ -3062,6 +3109,7 @@ def make_app(engine: Engine | None = None, data_path: str | None = None) -> web.
     app.router.add_get("/_serving/stats", serving_stats)
     app.router.add_get("/_tenants/stats", tenants_stats)
     app.router.add_get("/_refresh/profile", refresh_profile)
+    app.router.add_get("/_esql/profile", esql_profile)
     app.router.add_get("/_serving/flight_recorder", serving_flight_recorder)
     app.router.add_post("/_serving/flight_recorder/_dump",
                         serving_flight_recorder_dump)

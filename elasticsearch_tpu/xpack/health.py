@@ -301,6 +301,64 @@ def _indexing_indicator(engine) -> dict:
             "details": details}
 
 
+def _esql_indicator(engine) -> dict:
+    """ESQL dataflow health (PR 20): the slo.esql.* objectives (query
+    p99, peak materialization bytes) plus the per-operator recorder's
+    cumulative breakdown. A breach names BOTH the objective and the
+    dominant operator — the operator learns which pipe stage to profile
+    (and the item-5 paged-operator port which stage to move) from the
+    alert itself."""
+    from ..esql.profile import recorder_for
+
+    ev = engine.slo.current()
+    esql = [o for o in ev["objectives"] if o["kind"] == "esql"]
+    breached = [o for o in esql if o["status"] == "breached"]
+    st = recorder_for(engine).stats()
+    details = {"queries": st.get("queries", 0),
+               "rows_total": st.get("rows_total", 0),
+               "peak_bytes_hwm": st.get("peak_bytes_hwm", 0),
+               "peak_bytes_last": st.get("peak_bytes_last", 0),
+               "breaker_trips": st.get("breaker_trips", 0)}
+    if breached:
+        op_ms = st.get("operator_ms") or {}
+        dom = st.get("dominant_operator")
+        dom_note = (
+            f"; dominant operator [{dom}] at "
+            f"{op_ms.get(dom, 0.0):.1f}ms cumulative "
+            "(GET /_esql/profile for per-query operator breakdowns)"
+            if dom else "")
+        return {
+            "status": YELLOW,
+            "symptom": (f"{len(breached)} ESQL dataflow SLO objectives "
+                        "are breached"),
+            "details": {**details,
+                        "breached": [o["id"] for o in breached],
+                        "dominant_operator": dom},
+            "impacts": [_impact(
+                "ESQL queries run slow or materialize oversized "
+                "intermediate tables: latency SLOs degrade and the "
+                "esql.materialization breaker trips sooner",
+                severity=2, areas=["search"])],
+            "diagnosis": [_diagnosis(
+                "; ".join(
+                    f"objective [{o['id']}] breached: {o['description']} "
+                    f"(measured {o['measured']}, threshold "
+                    f"{o['threshold']})" for o in breached) + dom_note,
+                "narrow the query (WHERE before STATS/SORT, KEEP fewer "
+                "columns) or raise the floor; compare the per-operator "
+                "walls against the BENCH esql_dataflow baseline",
+                [o["id"] for o in breached])],
+        }
+    if not esql:
+        return {"status": GREEN,
+                "symptom": ("No ESQL SLO floors configured "
+                            "(slo.esql.*)"),
+                "details": details}
+    return {"status": GREEN,
+            "symptom": f"All {len(esql)} ESQL dataflow SLO floors hold",
+            "details": details}
+
+
 def _resilience_indicator(engine) -> dict:
     """Data-plane resilience (PR 14): open per-peer circuit breakers
     (a peer is being routed around — the fan-out is degraded to the
@@ -604,6 +662,7 @@ def health_report(engine) -> dict:
     add("execution_planner", _planner_indicator)
     add("indexing", _indexing_indicator)
     add("tenant_fairness", _tenant_fairness_indicator)
+    add("esql_dataflow", _esql_indicator)
     add("slo_compliance", _slo_indicator)
     add("watcher", _watcher_indicator)
     indicators["ilm"] = {

@@ -477,6 +477,91 @@ def planner_growth(prev: dict, latest: dict, threshold: float) -> list:
     return moved
 
 
+def esql_metrics(record: dict) -> dict:
+    """-> C10 ESQL-dataflow leaves (PR 20): per-query-shape wall_ms,
+    input rows/s, peak live materialization bytes, and the per-operator
+    wall split — the whole-column ground truth the item-5 paged-operator
+    port is graded against (peak_bytes down, rows/s held)."""
+    out = {}
+
+    def walk(obj, path=()):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "esql_dataflow" and isinstance(v, dict):
+                    base = path + (k,)
+                    for qname, sec in (v.get("queries") or {}).items():
+                        if not isinstance(sec, dict):
+                            continue
+                        for kk in ("wall_ms", "input_rows_per_s",
+                                   "peak_live_bytes"):
+                            val = sec.get(kk)
+                            if isinstance(val, (int, float)) \
+                                    and not isinstance(val, bool):
+                                out[".".join(base + (qname, kk))] = \
+                                    float(val)
+                        for op, ms in (sec.get("operator_ms")
+                                       or {}).items():
+                            if isinstance(ms, (int, float)):
+                                out[".".join(base + (qname, "operator_ms",
+                                                     op))] = float(ms)
+                    hwm = (v.get("recorder") or {}).get("peak_bytes_hwm")
+                    if isinstance(hwm, (int, float)) \
+                            and not isinstance(hwm, bool):
+                        out[".".join(base + ("recorder",
+                                             "peak_bytes_hwm"))] = \
+                            float(hwm)
+                elif isinstance(v, (dict, list)):
+                    walk(v, path + (k,))
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                walk(v, path + (str(i),))
+
+    walk(record.get("extras", record))
+    return out
+
+
+_ESQL_LOWER_BETTER = {"wall_ms", "peak_live_bytes", "peak_bytes_hwm"}
+
+
+def esql_growth(prev: dict, latest: dict, threshold: float) -> list:
+    """ADVISORY (same convention as planner_growth): C10 movement
+    beyond `threshold` — a query wall, an operator wall, or the peak
+    materialization bytes up, or input rows/s down — is printed for the
+    tier-1 log reader but never fails the lint. peak_live_bytes GROWTH
+    is the loudest signal: the whole-column engine got hungrier, and
+    item 5's paged port is graded on driving exactly that number down."""
+    a, b = esql_metrics(prev), esql_metrics(latest)
+    moved = []
+    for path in sorted(set(a) & set(b)):
+        old, new = a[path], b[path]
+        if old <= 1e-9:
+            continue
+        leaf = path.rsplit(".", 1)[-1]
+        parts = path.split(".")
+        ratio = new / old
+        if leaf in _ESQL_LOWER_BETTER or "operator_ms" in parts:
+            regressed = ratio > 1.0 + threshold
+        else:  # input_rows_per_s: higher is better
+            regressed = ratio < 1.0 - threshold
+        if regressed:
+            moved.append((path, old, new, ratio))
+    return moved
+
+
+def print_esql_table(latest: dict, cur_round: int) -> None:
+    """Render the newest record's C10 advisory table (per-shape query
+    walls, rows/s, peak materialization bytes, per-operator split)
+    whenever the record carries an esql_dataflow arm."""
+    rows = esql_metrics(latest)
+    if not rows:
+        return
+    print(f"[bench-regress] esql-dataflow table (r{cur_round:02d}; "
+          "per-operator walls sum == query wall in-record; peak bytes "
+          "are the item-5 paged-port target):")
+    for path in sorted(rows):
+        print(f"  {path:<64} {_fmt(rows[path]):>12}")
+
+
 def print_planner_table(latest: dict, cur_round: int) -> None:
     """Render the newest record's C9 advisory table (per-routing QPS +
     p99 on the mixed trace, decision latency, residual spread) whenever
@@ -613,6 +698,13 @@ def main(argv=None) -> int:
               f"{args.threshold:.0%}; a planner_vs_best_static ratio "
               "under 1.0 means the adaptive routing stopped paying for "
               "its decisions")
+    for path, old, new, ratio in esql_growth(
+            prev, latest, args.threshold):
+        print(f"  ESQL (advisory) {path}: {_fmt(old)} -> {_fmt(new)} "
+              f"({ratio:.2f}x) — C10 dataflow moved beyond "
+              f"{args.threshold:.0%}; peak_live_bytes growth means the "
+              "whole-column engine got hungrier (the item-5 paged port "
+              "is graded on driving it down)")
     # PR 15: the per-stage host-vs-device scorecard whenever both
     # records profiled their builds
     print_build_speedup(prev, latest, prev_round, cur_round)
@@ -623,6 +715,8 @@ def main(argv=None) -> int:
     # PR 19: the per-tenant device-ms attribution table for the newest
     # record (whichever arms recorded one)
     print_tenant_table(latest, cur_round)
+    # PR 20: the C10 ESQL-dataflow advisory table for the newest record
+    print_esql_table(latest, cur_round)
     if regressions and advisory:
         print("[bench-regress] ADVISORY: all records are CPU smokes "
               "(host-bound, non-criteria per BENCH_NOTES) — not failing; "

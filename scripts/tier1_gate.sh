@@ -159,7 +159,9 @@ PYEOF
 # exist, diff the newest pair per config (QPS, latency pcts, per-kernel
 # mfu/bw_util) and fail on >20% regression. CPU-smoke records are
 # advisory inside bench_regress itself (host-bound numbers are
-# non-criteria per BENCH_NOTES); TPU records enforce.
+# non-criteria per BENCH_NOTES); TPU records enforce. PR 20 adds the
+# advisory esql table (per-operator walls, peak_live_bytes — the
+# item-5 paged port's grading numbers) to the same invocation.
 if [ "$(ls BENCH_r*.json 2>/dev/null | wc -l)" -ge 2 ]; then
     echo "[tier1-gate] bench-regression lint"
     python scripts/bench_regress.py || exit 1

@@ -313,6 +313,104 @@ def render_refresh(snap: dict, out=None) -> None:
                                        for s in used), file=out)
 
 
+def _fetch_esql(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{url.rstrip('/')}/_esql/profile", timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def _load_esql(path: str) -> dict:
+    """A saved GET /_esql/profile body, a single profile body (e.g. the
+    `profile` section of a POST /_query response), or JSON lines of
+    profile records — including dumped monitoring TSDB docs, whose
+    node_stats.esql sections are skipped (they carry cumulative stats,
+    not per-query operator walls)."""
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if head == "{":
+            try:
+                body = json.load(fh)
+                if "profiles" in body:
+                    return body
+                if "drivers" in body.get("profile", {}):
+                    return {"capacity": None, "retained": 1,
+                            "profiles": [body["profile"]]}
+                return {"capacity": None, "retained": 1,
+                        "profiles": [body]}
+            except json.JSONDecodeError:
+                fh.seek(0)
+        profs = []
+        for ln in fh:
+            if not ln.strip():
+                continue
+            rec = json.loads(ln)
+            src = rec.get("_source", rec)
+            if "drivers" in src:
+                profs.append(src)
+    return {"capacity": None, "retained": len(profs), "profiles": profs}
+
+
+# seed the stable glyph order with the fixed pipe-stage vocabulary so
+# the same operator renders the same glyph across queries (the
+# --refresh convention)
+_ESQL_SEED_OPS = ("collect", "where", "eval", "stats_exchange", "stats",
+                  "topn_exchange", "sort", "limit", "keep", "driver")
+
+
+def render_esql(snap: dict, out=None) -> None:
+    """One line per recorded ESQL query: a BAR_WIDTH bar partitioned by
+    the contiguous per-operator walls (they sum to the query wall
+    EXACTLY — esql/profile.py), plus rows / peak live bytes / dominant
+    operator — the per-query analog of --refresh's per-refresh bar:
+    where did this query's wall time actually sit (PR 20)."""
+    out = out or sys.stdout
+    profs = snap.get("profiles", [])
+    ring = ""
+    if snap.get("capacity") is not None:
+        ring = (f" (capacity={snap.get('capacity')}, "
+                f"recorded_total={snap.get('recorded_total')})")
+    print(f"esql profiles: {len(profs)} quer(ies) retained{ring}",
+          file=out)
+    glyph_of: dict[str, str] = {}
+
+    def glyph(op: str) -> str:
+        if op not in glyph_of:
+            glyph_of[op] = _REFRESH_GLYPHS[
+                len(glyph_of) % len(_REFRESH_GLYPHS)]
+        return glyph_of[op]
+
+    for s in _ESQL_SEED_OPS:
+        glyph(s)
+    seen_ops: set = set()
+    for p in profs:
+        ops = (p.get("drivers") or [{}])[0].get("operators") or []
+        seg = {o["operator"]: float(o.get("took_ms", 0.0)) for o in ops}
+        seen_ops |= set(seg)
+        wall = max(float(p.get("wall_ms") or 0.0), 1e-9)
+        bar = ""
+        for op in seg:  # insertion order == pipeline order (contiguous)
+            n = int(round(BAR_WIDTH * seg[op] / wall))
+            bar += glyph(op) * n
+        bar = (bar + "·" * BAR_WIDTH)[:BAR_WIDTH]
+        top = max(seg, key=seg.get, default=None)
+        q = str(p.get("query") or "?").replace("\n", " ")
+        print(f"  [{bar}] q{p.get('seq', '?'):>4} "
+              f"rows={p.get('rows', 0):>6} "
+              f"wall={wall:9.2f}ms "
+              f"peak={p.get('peak_live_bytes', 0):>10}b "
+              f"dom={p.get('dominant_operator') or '?'}"
+              f"{f'  top={top}:{seg[top]:.1f}ms' if top else ''}"
+              f"  | {q[:60]}",
+              file=out)
+    used = [s for s in glyph_of if s in seen_ops]
+    if used:
+        print("  operators: " + "  ".join(f"{glyph_of[s]} {s}"
+                                          for s in used), file=out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", help="node/gateway base URL to fetch from")
@@ -329,7 +427,26 @@ def main(argv=None) -> int:
                          "GET /_refresh/profile body or JSON-lines "
                          "RefreshProfile records; bare --refresh fetches "
                          "from --url (PR 13)")
+    ap.add_argument("--esql", nargs="?", const="-",
+                    help="render the per-query ESQL operator profiles "
+                         "instead of a trace: with a PATH, read a saved "
+                         "GET /_esql/profile body, a POST /_query "
+                         "profile section, or JSON-lines profile "
+                         "records (TSDB dumps included); bare --esql "
+                         "fetches from --url (PR 20)")
     args = ap.parse_args(argv)
+    if args.esql is not None:
+        if args.esql == "-":
+            if not args.url:
+                ap.error("bare --esql needs --url to fetch from")
+            snap = _fetch_esql(args.url)
+        else:
+            snap = _load_esql(args.esql)
+        if not snap.get("profiles"):
+            print("esql profiles: none recorded", file=sys.stderr)
+            return 1
+        render_esql(snap)
+        return 0
     if args.refresh is not None:
         if args.refresh == "-":
             if not args.url:
@@ -355,7 +472,8 @@ def main(argv=None) -> int:
         render_flight(snap)
         return 0
     if not args.trace:
-        ap.error("--trace is required (or use --flight / --refresh)")
+        ap.error("--trace is required (or use --flight / --refresh / "
+                 "--esql)")
     if bool(args.url) == bool(args.otlp):
         ap.error("exactly one of --url / --otlp is required")
     trace = (_fetch_url(args.url, args.trace) if args.url

@@ -274,6 +274,46 @@ def render_tenants(per_node: dict[str, dict], out=None) -> None:
     print(file=out)
 
 
+def render_esql(per_node: dict[str, dict], out=None) -> None:
+    """ESQL dataflow view (PR 20): the newest node_stats `esql` section
+    per node — query counts / latency percentiles / materialization
+    peak straight from the operator profiler, plus a per-operator
+    cumulative-wall table with each stage's share, since the walls are
+    contiguous boundary segments that sum exactly to the query walls."""
+    out = out or sys.stdout
+    print("esql (operator dataflow)", file=out)
+    any_rows = False
+    for node in sorted(per_node):
+        es = (per_node[node].get("node_stats") or {}).get("esql") or {}
+        if not es or not es.get("queries"):
+            continue
+        any_rows = True
+        print(f"  {node}: queries={int(es.get('queries', 0))} "
+              f"rows={int(es.get('rows_total', 0))}  "
+              f"p50={es.get('query_ms_p50', 0.0):.1f}ms "
+              f"p99={es.get('query_ms_p99', 0.0):.1f}ms  "
+              f"peak={_fmt_bytes(es.get('peak_bytes_hwm'))} "
+              f"(last={_fmt_bytes(es.get('peak_bytes_last'))})  "
+              f"breaker_trips={int(es.get('breaker_trips', 0))}", file=out)
+        op_ms = es.get("operator_ms") or {}
+        if not op_ms:
+            continue
+        total = sum(op_ms.values()) or 1.0
+        dom = es.get("dominant_operator") or ""
+        rows = [("operator", "cum_ms", "share", "")]
+        for name in sorted(op_ms, key=op_ms.get, reverse=True):
+            rows.append((name, f"{op_ms[name]:.1f}",
+                         f"{100.0 * op_ms[name] / total:.1f}%",
+                         "<- dominant" if name == dom else ""))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        for r in rows:
+            print("    " + "  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  .rstrip(), file=out)
+    if not any_rows:
+        print("  (no esql samples in the window)", file=out)
+    print(file=out)
+
+
 def slo_alert_summary(docs: list[dict], alerts: list[dict],
                       history: list[dict]) -> dict:
     """SLO compliance over the window (per-node fraction of node_stats
@@ -372,6 +412,7 @@ def main(argv=None) -> int:
         render(per_node)
         render_indexing(indexing)
         render_tenants(per_node)
+        render_esql(per_node)
         render_slo(summary)
     return 0
 

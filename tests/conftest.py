@@ -174,6 +174,18 @@ def _module_hygiene():
     from elasticsearch_tpu.monitoring import refresh_profile
 
     refresh_profile.default_recorder().reset_for_tests()
+    # ESQL profiler hygiene (PR 20): every OperatorProfile must have
+    # released its esql.materialization reservation by finish() — a
+    # leaked charge would trip queries modules later, far from its
+    # source — and the fallback recorder's ring/cumulative operator
+    # walls must not bleed into another module's assertions
+    from elasticsearch_tpu.esql import profile as _esql_profile
+
+    esql_leaks = _esql_profile.reservation_leaks()
+    assert not esql_leaks, (
+        "ESQL profiles leaked esql.materialization reservations: "
+        f"{esql_leaks}")
+    _esql_profile.default_recorder().reset_for_tests()
     try:
         import resource
 

@@ -1,0 +1,527 @@
+"""ESQL dataflow ground truth (PR 20): per-operator profiling,
+materialization accounting, and the observability surfaces over them.
+
+Covers the tentpole acceptance paths: per-operator walls sum to the
+query wall EXACTLY (`==`, not approx — the wall is defined as the fsum
+of the contiguous boundary segments) across every pipe shape; the
+per-column materialization bytes match the documented hand-computable
+convention and `peak_live_bytes` bounds the largest materialized
+column; an undersized `esql.materialization` breaker trips a 429
+naming the dominant operator (reservation fully released, no leak); a
+`slo.esql.*` breach flips the `esql_dataflow` health indicator (with
+the dominant operator in the diagnosis) and fires the prebuilt
+slo-compliance watch; ESQL walls apportion through the PR-19
+TenantMeter ledger with the per-operator split as kernel weights; a
+query registered as a cancellable task stops at the next operator
+boundary; and a 3-node cluster serves `"profile": true` bodies, the
+`/_esql/profile` ring, and TSDB `esql` node_stats docs from another
+node."""
+
+import json
+import math
+import time
+
+import pytest
+
+from elasticsearch_tpu import telemetry, xpack
+from elasticsearch_tpu.common.breaker import CircuitBreakingError
+from elasticsearch_tpu.engine import Engine
+from elasticsearch_tpu.esql import esql_query
+from elasticsearch_tpu.esql.profile import (
+    DRIVER_OPERATOR,
+    default_recorder,
+    reservation_leaks,
+)
+from elasticsearch_tpu.tasks import TaskCancelledException
+from elasticsearch_tpu.telemetry import TraceContext, activate_trace
+
+
+def _engine():
+    e = Engine(None)
+    e.create_index("emp", {"properties": {
+        "name": {"type": "keyword"}, "dept": {"type": "keyword"},
+        "salary": {"type": "integer"}, "age": {"type": "integer"},
+    }})
+    idx = e.indices["emp"]
+    rows = [
+        ("1", {"name": "ann", "dept": "eng", "salary": 100, "age": 30}),
+        ("2", {"name": "bob", "dept": "eng", "salary": 80, "age": 25}),
+        ("3", {"name": "cat", "dept": "ops", "salary": 60, "age": 40}),
+        ("4", {"name": "dan", "dept": "ops", "salary": 70, "age": 35}),
+        ("5", {"name": "eve", "dept": "sales", "salary": 90}),
+    ]
+    for i, src in rows:
+        idx.index_doc(i, src)
+    idx.refresh()
+    return e
+
+
+def _ops(profile):
+    return profile["drivers"][0]["operators"]
+
+
+# ---------------------------------------------------------------------------
+# tentpole: operator walls sum to the query wall EXACTLY, every shape
+# ---------------------------------------------------------------------------
+
+PIPE_SHAPES = [
+    'FROM emp | WHERE salary >= 70 | EVAL bonus = salary * 0.1 '
+    '| SORT salary DESC | LIMIT 3 | KEEP name, salary, bonus',
+    'FROM emp | STATS c = COUNT(*), avg_sal = AVG(salary) BY dept '
+    '| SORT dept',
+    'FROM emp | STATS n = COUNT(age), m = MAX(age)',
+    'FROM emp | WHERE age IS NULL | KEEP name',
+    'FROM emp | SORT name | LIMIT 2 | DROP age',
+    'FROM emp | RENAME salary AS pay | KEEP name, pay | LIMIT 1',
+    'ROW a = 1, b = "x" | EVAL c = a + 2',
+    'ROW line = "GET /a 200" | DISSECT line "%{method} %{path} %{status}"',
+]
+
+
+def test_operator_walls_sum_exactly_to_query_wall_all_shapes():
+    e = _engine()
+    try:
+        for q in PIPE_SHAPES:
+            out = esql_query(e, {"query": q, "profile": True})
+            prof = out["profile"]
+            ops = _ops(prof)
+            # the exactness contract: float ==, not approx — the wall
+            # is DEFINED as the fsum of the contiguous segments
+            assert math.fsum(o["took_ms"] for o in ops) == prof["wall_ms"], q
+            assert all(o["took_ms"] >= 0.0 for o in ops), q
+            # every drive ends in the named residual operator, and the
+            # first operator is the source (collect / row)
+            assert ops[-1]["operator"] == DRIVER_OPERATOR, q
+            assert ops[0]["operator"] in ("collect", "row"), q
+            assert prof["rows"] == len(out["values"]), q
+            assert out["took"] == int(prof["wall_ms"]), q
+            # rows flow: each operator's rows_in is the previous
+            # operator's rows_out (whole-column port: one page each)
+            for prev, cur in zip(ops, ops[1:-1]):
+                assert cur["rows_in"] == prev["rows_out"], q
+        # without "profile": true the body carries no profile section,
+        # but the recorder accounted every drive anyway
+        out = esql_query(e, {"query": "FROM emp | LIMIT 1"})
+        assert "profile" not in out
+        st = e.esql_recorder.stats()
+        assert st["queries"] == len(PIPE_SHAPES) + 1
+        assert st["rows_total"] > 0
+    finally:
+        e.close()
+
+
+def test_fused_and_exchange_operator_names():
+    e = _engine()
+    try:
+        # SORT|LIMIT on shard-mapped rows fuses into the top-n exchange;
+        # a supported STATS runs as the device stats exchange — both are
+        # named like the reference's exchange operators in the profile
+        out = esql_query(e, {"query":
+            'FROM emp | SORT salary DESC | LIMIT 2 | KEEP name',
+            "profile": True})
+        names = [o["operator"] for o in _ops(out["profile"])]
+        assert "topn_exchange" in names
+        assert "sort" not in names and "limit" not in names
+        out = esql_query(e, {"query":
+            'FROM emp | STATS c = COUNT(*) BY dept', "profile": True})
+        names = [o["operator"] for o in _ops(out["profile"])]
+        assert "stats_exchange" in names or "stats" in names
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# materialization bytes: hand-computed, and peak_live_bytes bounds them
+# ---------------------------------------------------------------------------
+
+def test_column_bytes_match_documented_convention_exactly():
+    e = _engine()
+    try:
+        out = esql_query(e, {"query": 'ROW a = 1, b = "xy"',
+                             "profile": True})
+        row_op = _ops(out["profile"])[0]
+        assert row_op["operator"] == "row"
+        # the documented convention, by hand: int64 column = 8 bytes of
+        # value + 1 byte of null mask per row; object column = 1 byte of
+        # null mask + 8 bytes of reference + the UTF-8 payload
+        assert row_op["columns"]["a"] == 8 + 1
+        assert row_op["columns"]["b"] == 1 + 8 + len(b"xy")
+        assert row_op["bytes_materialized"] == sum(
+            row_op["columns"].values())
+    finally:
+        e.close()
+
+
+def test_peak_live_bytes_bounds_largest_materialized_column():
+    e = _engine()
+    try:
+        out = esql_query(e, {"query":
+            'FROM emp | KEEP name, salary', "profile": True})
+        prof = out["profile"]
+        largest = max(max(o["columns"].values(), default=0)
+                      for o in _ops(prof))
+        assert largest > 0
+        assert prof["peak_live_bytes"] >= largest
+        # the keyword column of the final table, by hand: 5 rows of
+        # (1 null byte + 8 ref bytes) + the 3-byte names
+        keep_op = [o for o in _ops(prof) if o["operator"] == "keep"][-1]
+        assert keep_op["columns"]["name"] == 5 * (1 + 8) + 5 * 3
+        assert prof["peak_live_bytes"] >= keep_op["columns"]["name"]
+        # collect materializes the whole doc-values table — it must
+        # dominate a narrowing pipeline
+        assert prof["dominant_operator"] == "collect"
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# breaker: an oversized materialization trips a 429 naming the
+# dominant operator — never an OOM — and releases every byte
+# ---------------------------------------------------------------------------
+
+def test_breaker_trip_names_dominant_operator_and_releases():
+    e = _engine()
+    try:
+        e.settings.update({"persistent": {
+            "indices.breaker.esql.materialization.limit": "64b"}})
+        with pytest.raises(CircuitBreakingError) as ei:
+            esql_query(e, {"query":
+                'FROM emp | STATS c = COUNT(*) BY dept'})
+        assert ei.value.status == 429
+        assert "esql.materialization" in str(ei.value)
+        # FROM materializes first and biggest: the trip names it
+        assert "esql operator [collect]" in str(ei.value)
+        assert ei.value.durability == "TRANSIENT"
+        st = e.breakers.stats()["esql.materialization"]
+        assert st["tripped"] >= 1
+        # the failed drive released its whole reservation on finish()
+        assert st["estimated_size_in_bytes"] == 0
+        assert not reservation_leaks()
+        # the recorder saw the tripped drive
+        assert e.esql_recorder.stats()["breaker_trips"] >= 1
+        # raising the limit back makes the same query succeed
+        e.settings.update({"persistent": {
+            "indices.breaker.esql.materialization.limit": "40%"}})
+        out = esql_query(e, {"query":
+            'FROM emp | STATS c = COUNT(*) BY dept'})
+        assert len(out["values"]) == 3
+        assert e.breakers.stats()["esql.materialization"][
+            "estimated_size_in_bytes"] == 0
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# trace: POST /_query produces an esql.* span tree (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_esql_query_emits_operator_span_tree():
+    e = _engine()
+    try:
+        ctx = TraceContext(trace_id=telemetry.new_trace_id())
+        with activate_trace(ctx, node="n-esql"):
+            esql_query(e, {"query":
+                'FROM emp | WHERE salary >= 70 | EVAL b = salary * 2'})
+        spans = telemetry.TRACER.spans_for_trace(ctx.trace_id)
+        names = [s["name"] for s in spans]
+        assert "esql.query" in names
+        for op in ("esql.collect", "esql.where", "esql.eval"):
+            assert op in names
+        # operator spans are children of the query span, and GET
+        # /_trace/{id} stitches them into one tree
+        root = telemetry.stitch_trace(spans)
+        tree = root["spans"] if "spans" in root else root
+        assert json.dumps(tree)  # serializable for the REST surface
+        by_name = {s["name"]: s for s in spans}
+        q_span = by_name["esql.query"]
+        assert by_name["esql.collect"]["parent_span_id"] == \
+            q_span["span_id"]
+        assert by_name["esql.collect"]["attributes"]["rows_out"] == 5
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO + health: a breach names the objective AND the dominant operator
+# ---------------------------------------------------------------------------
+
+def test_slo_breach_flips_esql_dataflow_indicator_and_fires_watch():
+    e = _engine()
+    try:
+        # no floors configured -> indicator green, explicitly labeled
+        ind = xpack.health_report(e)["indicators"]["esql_dataflow"]
+        assert ind["status"] == "green"
+        assert "slo.esql" in ind["symptom"]
+        esql_query(e, {"query": 'FROM emp | STATS c = COUNT(*) BY dept'})
+        e.settings.update({"persistent": {
+            "slo.esql.p99_ms": 0.000001, "slo.esql.peak_bytes": 1.0}})
+        ev = e.slo.evaluate()
+        assert "esql-p99-latency" in ev["breached"]
+        assert "esql-peak-bytes" in ev["breached"]
+        objs = {o["id"]: o for o in ev["objectives"]}
+        assert objs["esql-p99-latency"]["kind"] == "esql"
+        # the objective description itself names the dominant operator
+        assert "dominant operator [" in objs["esql-p99-latency"][
+            "description"]
+        ind = xpack.health_report(e)["indicators"]["esql_dataflow"]
+        assert ind["status"] == "yellow"
+        assert set(ind["details"]["breached"]) >= {
+            "esql-p99-latency", "esql-peak-bytes"}
+        dom = ind["details"]["dominant_operator"]
+        assert dom and dom != DRIVER_OPERATOR
+        cause = ind["diagnosis"][0]["cause"]
+        assert "esql-p99-latency" in cause
+        assert f"dominant operator [{dom}]" in cause
+        # the prebuilt watch fires through the standard alert machinery
+        xpack.watcher_ensure_executor(e)
+        out = xpack.watcher_execute(e, "slo-compliance")
+        assert out["watch_record"]["condition_met"]
+        docs = e.search_multi(
+            ".alerts-default",
+            query={"term": {"watch_id": "slo-compliance"}},
+            size=5)["hits"]["hits"]
+        assert docs and docs[0]["_source"]["state"] == "firing"
+        assert "esql-p99-latency" in docs[0]["_source"]["reason"]
+        # clearing the floors recovers the indicator
+        e.settings.update({"persistent": {
+            "slo.esql.p99_ms": 0.0, "slo.esql.peak_bytes": 0.0}})
+        e.slo.evaluate()
+        assert xpack.health_report(e)["indicators"]["esql_dataflow"][
+            "status"] == "green"
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# tenancy: ESQL walls flow through the SAME TenantMeter ledger (PR 19)
+# ---------------------------------------------------------------------------
+
+def test_esql_walls_apportion_through_tenant_meter():
+    e = _engine()
+    try:
+        ctx = TraceContext(trace_id=telemetry.new_trace_id(),
+                           task_id="esql-tenant-a")
+        with activate_trace(ctx):
+            out = esql_query(e, {"query":
+                'FROM emp | WHERE salary >= 70 | STATS c = COUNT(*)',
+                "profile": True})
+        rows = e.metering.rows()
+        assert "esql-tenant-a" in rows
+        r = rows["esql-tenant-a"]
+        assert r["requests"] == 1
+        # conservation: the tenant's device_ms share IS the query wall
+        assert r["device_ms"] == pytest.approx(
+            out["profile"]["wall_ms"], rel=1e-6)
+        # the per-operator walls rode as kernel weights, so the ledger's
+        # dominant kernel IS the query's slowest operator
+        dom = e.metering.dominant_kernel("esql-tenant-a")
+        assert dom is not None and dom.startswith("esql.")
+        ops = _ops(out["profile"])
+        slowest = max(ops, key=lambda o: o["took_ms"])["operator"]
+        assert dom == f"esql.{slowest}"
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# cancellation: checked between operators — no further operator work
+# ---------------------------------------------------------------------------
+
+def test_cancellation_stops_pipeline_at_operator_boundary():
+    e = _engine()
+    try:
+        task = e.tasks.register("indices:data/read/esql",
+                                "esql[test]", cancellable=True)
+        calls = {"n": 0}
+        orig = task.ensure_not_cancelled
+
+        def hook():
+            calls["n"] += 1
+            if calls["n"] == 2:  # cancel arrives after the first stage
+                task.cancel("by user request")
+            orig()
+
+        task.ensure_not_cancelled = hook
+        with pytest.raises(TaskCancelledException):
+            esql_query(e, {"query":
+                'FROM emp | WHERE salary >= 70 '
+                '| EVAL b = salary * 2 | STATS c = COUNT(*)'},
+                task=task)
+        assert task.cancelled
+        assert task.to_dict()["cancelled"] is True
+        e.tasks.unregister(task)
+        # exactly ONE operator ran (collect) before the boundary check
+        # stopped the drive; the residual is the driver bucket
+        last = e.esql_recorder.profiles(1)["profiles"][-1]
+        names = [o["operator"] for o in last["drivers"][0]["operators"]]
+        assert names == ["collect", DRIVER_OPERATOR]
+        # the abandoned drive still sums exactly and leaked nothing
+        assert math.fsum(o["took_ms"]
+                         for o in last["drivers"][0]["operators"]) == \
+            last["wall_ms"]
+        assert not reservation_leaks()
+        assert e.breakers.stats()["esql.materialization"][
+            "estimated_size_in_bytes"] == 0
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# recorder surfaces: /_esql/profile ring + nodes-stats stats()
+# ---------------------------------------------------------------------------
+
+def test_recorder_ring_and_stats_shapes():
+    rec_default = default_recorder()
+    rec_default.reset_for_tests()
+    e = _engine()
+    try:
+        for _ in range(3):
+            esql_query(e, {"query": 'FROM emp | LIMIT 2'})
+        body = e.esql_recorder.profiles(2)
+        assert body["recorded_total"] == 3
+        assert len(body["profiles"]) == 2
+        for p in body["profiles"]:
+            assert p["query"] == 'FROM emp | LIMIT 2'
+            assert "@timestamp" in p and "seq" in p
+        st = e.esql_recorder.stats()
+        assert st["queries"] == 3
+        assert st["rows_total"] == 6
+        # dominant is by CUMULATIVE WALL — which stage wins is timing
+        # (collect usually, but driver/limit can under suite load), so
+        # assert consistency, not a specific winner
+        assert st["dominant_operator"] in st["operator_ms"]
+        assert st["peak_bytes_hwm"] >= st["peak_bytes_last"] > 0
+        # cumulative per-operator walls cover every stage that ran
+        assert {"collect", "limit", DRIVER_OPERATOR} <= set(
+            st["operator_ms"])
+        # engine-bound recorder, not the module fallback
+        assert rec_default.stats()["queries"] == 0
+    finally:
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# 3-node cluster: profile bodies, the /_esql/profile ring, and TSDB
+# esql docs all queryable — from another node
+# ---------------------------------------------------------------------------
+
+def _http(method, port, path, body=None, timeout=60.0):
+    import urllib.error
+    import urllib.request
+
+    data = None
+    headers = {}
+    if body is not None:
+        data = (body if isinstance(body, str)
+                else json.dumps(body)).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, headers=headers,
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_esql_profile_cluster_e2e_3node():
+    from elasticsearch_tpu.cluster.http import HttpGateway, wait_for_http
+    from elasticsearch_tpu.cluster.server import NodeServer
+
+    ids = ["q1", "q2", "q3"]
+    servers = {nid: NodeServer(nid, ids, {}, port=0) for nid in ids}
+    for nid, s in servers.items():
+        for other, o in servers.items():
+            if other != nid:
+                s.network.add_peer(other, "127.0.0.1", o.port)
+    gateways = {}
+    try:
+        for nid, s in servers.items():
+            s.start()
+            gateways[nid] = HttpGateway(s, surface="full").start()
+        port = gateways["q1"].port
+        wait_for_http(port, lambda h: h.get("master_node")
+                      and h.get("number_of_nodes") == 3)
+        st, r = _http("PUT", port, "/emp", {"mappings": {"properties": {
+            "name": {"type": "keyword"}, "salary": {"type": "integer"},
+        }}})
+        assert st == 200, r
+        for i, (n, sal) in enumerate(
+                [("ann", 100), ("bob", 80), ("cat", 60)], 1):
+            st, r = _http("PUT", port, f"/emp/_doc/{i}?refresh=true",
+                          {"name": n, "salary": sal}, timeout=90.0)
+            assert st in (200, 201), r
+        # the profiled query over REST: walls sum exactly, 429-free
+        st, r = _http("POST", port, "/_query", {
+            "query": "FROM emp | WHERE salary >= 70 | STATS c = COUNT(*)",
+            "profile": True}, timeout=90.0)
+        assert st == 200, r
+        ops = r["profile"]["drivers"][0]["operators"]
+        assert math.fsum(o["took_ms"] for o in ops) == \
+            r["profile"]["wall_ms"]
+        assert r["values"] == [[2]]
+        # the ring on the serving node holds the drive
+        st, ring = _http("GET", port, "/_esql/profile", timeout=90.0)
+        assert st == 200 and ring["recorded_total"] >= 1
+        assert any("STATS" in p["query"] for p in ring["profiles"])
+        # a breaker squeezed over replicated cluster settings trips the
+        # REST path with the dominant operator named — never an OOM
+        st, r = _http("PUT", port, "/_cluster/settings", {
+            "persistent": {
+                "indices.breaker.esql.materialization.limit": "64b"}},
+            timeout=90.0)
+        assert st == 200, r
+        st, r = _http("POST", port, "/_query",
+                      {"query": "FROM emp | STATS c = COUNT(*)"},
+                      timeout=90.0)
+        assert st == 429, r
+        assert "esql operator [collect]" in r["error"]["reason"]
+        st, r = _http("PUT", port, "/_cluster/settings", {
+            "persistent": {
+                "indices.breaker.esql.materialization.limit": "40%"}},
+            timeout=90.0)
+        assert st == 200, r
+        # monitoring on: the esql section lands in every node's TSDB
+        # and replicates — query it from a DIFFERENT node
+        st, r = _http("PUT", port, "/_cluster/settings", {
+            "persistent": {
+                "xpack.monitoring.collection.enabled": True,
+                "xpack.monitoring.collection.interval": "500ms",
+            }}, timeout=90.0)
+        assert st == 200, r
+        qport = gateways["q2"].port
+        deadline = time.time() + 120.0
+        found = None
+        while time.time() < deadline:
+            st, res = _http("POST", qport, "/.monitoring-es-*/_search", {
+                "size": 50,
+                "query": {"term": {"type": "node_stats"}}},
+                timeout=90.0)
+            if st == 200:
+                for h in res.get("hits", {}).get("hits", []):
+                    src = h["_source"]
+                    esql_doc = src.get("node_stats", {}).get("esql") or {}
+                    if (src.get("node") == "q1"
+                            and esql_doc.get("queries", 0) >= 1):
+                        found = esql_doc
+                        break
+            if found:
+                break
+            time.sleep(0.5)
+        assert found, "no TSDB node_stats doc carried the esql section"
+        assert found["peak_bytes_hwm"] > 0
+        assert found["breaker_trips"] >= 1
+        # wall-based cumulative dominance is timing-dependent (collect
+        # vs stats_exchange under load) — the deterministic naming
+        # check is the bytes-based 429 reason asserted above
+        assert found["dominant_operator"] in found["operator_ms"]
+        assert "collect" in found["operator_ms"]
+        _http("PUT", port, "/_cluster/settings", {
+            "persistent": {"xpack.monitoring.collection.enabled": False}},
+            timeout=90.0)
+    finally:
+        for g in gateways.values():
+            g.close()
+        for s in servers.values():
+            s.close()

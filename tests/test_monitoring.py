@@ -117,7 +117,10 @@ _DISPATCH_DIRS = ("ops", "parallel", "query", "ann", "engine", "index",
                   "analysis",
                   # PR 17: tenant superpacks dispatch
                   # superpack.tenant_gather from tenancy/superpack.py
-                  "tenancy")
+                  "tenancy",
+                  # PR 20: the ESQL exchange dispatches
+                  # (esql/exchange.py, esql/topn.py)
+                  "esql")
 _DISPATCH_REGEXES = (_TIME_KERNEL_RE, _KERNEL_FIELD_RE, _BUILD_STAGE_RE)
 
 
@@ -171,7 +174,9 @@ def test_every_dispatch_site_has_a_cost_model_entry():
                      # PR 16: the batch-vectorized analyze dispatch
                      "build.analyze",
                      # PR 17: the tenant superpack gather dispatch
-                     "superpack.tenant_gather"):
+                     "superpack.tenant_gather",
+                     # PR 20: the ESQL exchange dispatches
+                     "esql.stats_exchange", "esql.topn_exchange"):
         assert expected in sites, f"dispatch site [{expected}] vanished"
 
 
@@ -205,6 +210,12 @@ def test_cost_fns_resolve_on_representative_fields():
         # PR 17: tenant-gather over a size class's padded doc width
         "superpack.tenant_gather": {"queries": 32, "num_docs": 1024,
                                     "rows": 32 * 2 * 8},
+        # PR 20: the ESQL exchanges (shapes as dispatched by
+        # esql/exchange.py and esql/topn.py)
+        "esql.stats_exchange": {"shards": 8, "rows": 4096, "groups": 32,
+                                "dbl_cols": 1, "long_cols": 1},
+        "esql.topn_exchange": {"shards": 8, "rows": 4096, "keys": 2,
+                               "n": 10},
     }
     for name, fields in reps.items():
         c = kernel_cost(name, fields)
